@@ -1,0 +1,129 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the API surface
+of the reference (PaddlePaddle fork), built on JAX/XLA/Pallas.
+
+Compute path: jnp/lax (XLA) + Pallas TPU kernels. Parallelism: named-axis
+``jax.sharding.Mesh`` + shard_map collectives (the ProcessGroupNCCL
+analog). Eager imperative API with tape autograd; the perf path is a
+compiled whole-step trace (``paddle_tpu.jit.to_static``).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# Initialize the PJRT backend at import, single-threaded. The TPU plugin's
+# client creation is not safe to run for the first time while other Python
+# threads exist (observed deadlock), and multiple processes serialize on
+# the chip — do it once, up front (the reference similarly initializes its
+# device runtime in framework::InitDevices at import).
+import jax as _jax
+
+try:
+    _jax.devices()
+except Exception:  # pragma: no cover - no device available
+    pass
+
+# -- framework core ---------------------------------------------------------
+from .framework import (
+    Tensor,
+    Parameter,
+    EagerParamBase,
+    no_grad,
+    enable_grad,
+    set_grad_enabled,
+    is_grad_enabled,
+    get_flags,
+    set_flags,
+    save,
+    load,
+    seed,
+    get_rng_state,
+    set_rng_state,
+    in_dynamic_mode,
+)
+from .framework.dtype import (
+    bool_ as bool,  # noqa: A001
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    complex64,
+    complex128,
+    DType as dtype,
+)
+from .device import (
+    set_device,
+    get_device,
+    device_count,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    CustomPlace,
+)
+
+# -- tensor op namespace (everything is also a Tensor method) --------------
+from .tensor import *  # noqa: F401,F403
+from .tensor.random import (
+    rand,
+    randn,
+    randint,
+    randint_like,
+    randperm,
+    normal,
+    uniform,
+    standard_normal,
+    bernoulli,
+    multinomial,
+    poisson,
+    rand_like,
+    randn_like,
+)
+from .tensor import creation, linalg, logic, manipulation, math, search, stat
+
+# -- subsystems -------------------------------------------------------------
+from . import autograd
+from . import device
+from . import framework
+from .autograd import grad
+from .autograd.py_layer import PyLayer
+
+disable_static = lambda *a, **k: None  # dygraph is the default mode
+enable_static = lambda *a, **k: None
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def _lazy_imports():
+    """Import heavier subpackages; called at end of module init."""
+    global nn, optimizer, io, jit, static, vision, hapi, metric
+    global distributed, incubate, amp, profiler, vision, callbacks, Model
+    global DataParallel
+    from . import nn  # noqa
+    from . import optimizer  # noqa
+    from . import io  # noqa
+    from . import amp  # noqa
+    from . import jit  # noqa
+    from . import static  # noqa
+    from . import vision  # noqa
+    from . import metric  # noqa
+    from . import hapi  # noqa
+    from .hapi import Model, callbacks  # noqa
+    from . import distributed  # noqa
+    from . import incubate  # noqa
+    from . import profiler  # noqa
+    from .distributed.parallel import DataParallel  # noqa
+
+
+try:
+    _lazy_imports()
+except ImportError:  # during bootstrap some subpackages may not exist yet
+    pass

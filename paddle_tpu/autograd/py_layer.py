@@ -1,0 +1,112 @@
+"""PyLayer — user-defined autograd function
+(upstream: python/paddle/autograd/py_layer.py)."""
+from __future__ import annotations
+
+import itertools
+import weakref
+
+import jax.numpy as jnp
+
+from ..framework.core import GradNode, Tensor, no_grad, _UID
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+        self.materialize_grads = True
+        self._attrs = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace_tensors = args
+
+    def set_materialize_grads(self, value):
+        self.materialize_grads = bool(value)
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class _PyLayerNode(GradNode):
+    __slots__ = ("custom_vjp",)
+
+    def __init__(self, name, in_tensors, in_raws, outs, custom_vjp):
+        super().__init__(name, None, in_tensors, in_raws, outs)
+        self.custom_vjp = custom_vjp
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with @staticmethod forward(ctx, *args) / backward(ctx, *grads)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+
+        from ..framework.core import is_grad_enabled
+
+        requires = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args
+        )
+        if requires:
+            for o in out_tensors:
+                o.stop_gradient = False
+
+            def custom_vjp(cotangents):
+                cot_tensors = [
+                    Tensor(c) if c is not None else None for c in cotangents
+                ]
+                with no_grad():
+                    grads = cls.backward(
+                        ctx, *(cot_tensors if len(cot_tensors) > 1
+                               else [cot_tensors[0]])
+                    )
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                raw = []
+                gi = iter(grads)
+                for t in tensor_args:
+                    g = next(gi, None)
+                    raw.append(
+                        g._data if isinstance(g, Tensor)
+                        else (g if g is None else jnp.asarray(g))
+                    )
+                return tuple(raw)
+
+            node = _PyLayerNode(
+                cls.__name__,
+                tuple(tensor_args),
+                tuple(t._data for t in tensor_args),
+                tuple(out_tensors),
+                custom_vjp,
+            )
+            for o in out_tensors:
+                o._grad_node = node
+        return outs
+
+
+LegacyPyLayer = PyLayer
+PyLayerContext.saved_tensors = property(lambda self: list(self._saved))

@@ -1,0 +1,14 @@
+"""paddle_tpu.distributed — bootstrap exports.
+
+Full fleet/collective APIs live in submodules; this top module mirrors
+the reference's `paddle.distributed` namespace and is extended as the
+distributed stack is built out.
+"""
+from __future__ import annotations
+
+from .env import (  # noqa
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    is_initialized,
+)

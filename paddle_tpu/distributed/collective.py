@@ -1,0 +1,328 @@
+"""Communication groups + collective ops
+(upstream: python/paddle/distributed/collective.py, communication/*;
+C++ core: paddle/fluid/distributed/collective/process_group_nccl.cc).
+
+A Group is a handle on one or more named mesh axes. Collectives:
+* inside a manual (shard_map) region → explicit `lax` collectives over
+  the axis names (psum / all_gather / psum_scatter / all_to_all /
+  ppermute) — exactly the ops the reference's NCCL calls become on ICI;
+* in the GSPMD context → global-array semantics (reduction is part of
+  op semantics; all_reduce is identity, all_gather/scatter reshard).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+from . import env as _env
+from .mesh import axis_degree, global_mesh, in_manual_context
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """Communication group = named mesh axes (innermost-varying last)."""
+
+    def __init__(self, axis_names, ranks=None, gid=0, name=None):
+        if isinstance(axis_names, str):
+            axis_names = (axis_names,)
+        self.axis_names = tuple(axis_names)
+        self.id = gid
+        self._name = name or "_".join(self.axis_names) or "world"
+        self._ranks = ranks
+
+    @property
+    def nranks(self):
+        n = 1
+        for a in self.axis_names:
+            n *= axis_degree(a)
+        return max(n, 1)
+
+    world_size = nranks
+
+    @property
+    def rank(self):
+        return 0  # single-controller; per-device rank exists only in-trace
+
+    @property
+    def ranks(self):
+        return self._ranks if self._ranks is not None else list(
+            range(self.nranks)
+        )
+
+    def get_group_rank(self, rank):
+        return rank if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(axes={self.axis_names}, nranks={self.nranks})"
+
+
+_GROUPS = {}
+_WORLD = None
+_next_gid = [1]
+
+
+def _world_group():
+    global _WORLD
+    if _WORLD is None:
+        m = global_mesh()
+        axes = m.axis_names if m is not None else ()
+        _WORLD = Group(axes, gid=0, name="world")
+    return _WORLD
+
+
+def _set_world_group(group):
+    global _WORLD
+    _WORLD = group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_names=None):
+    """Create a subgroup. TPU-native: groups are mesh-axis handles; a
+    ranks list that matches an axis coordinate pattern maps onto that
+    axis (the fleet topology always constructs groups axis-wise)."""
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    if axis_names is not None:
+        g = Group(axis_names, ranks=ranks, gid=gid)
+    else:
+        g = Group((), ranks=ranks, gid=gid)
+    _GROUPS[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _world_group()
+    return _GROUPS.get(gid)
+
+
+def _resolve(group):
+    if group is None:
+        return _world_group()
+    return group
+
+
+def is_available():
+    return True
+
+
+def destroy_process_group(group=None):
+    global _WORLD
+    if group is None:
+        _GROUPS.clear()
+        _WORLD = None
+
+
+# --------------------------------------------------------------------------
+# collectives
+# --------------------------------------------------------------------------
+
+
+def _inplace(tensor, out):
+    tensor._data = out._data
+    tensor._grad_node = out._grad_node
+    tensor._version += 1
+    return tensor
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _resolve(group)
+    tensor = _as_tensor(tensor)
+    if g.nranks == 1 or not g.axis_names:
+        return tensor
+    if in_manual_context(g.axis_names):
+        ax = g.axis_names if len(g.axis_names) > 1 else g.axis_names[0]
+        if op == ReduceOp.SUM:
+            fn = lambda x: jax.lax.psum(x, ax)
+        elif op == ReduceOp.MAX:
+            fn = lambda x: jax.lax.pmax(x, ax)
+        elif op == ReduceOp.MIN:
+            fn = lambda x: jax.lax.pmin(x, ax)
+        elif op == ReduceOp.AVG:
+            fn = lambda x: jax.lax.pmean(x, ax)
+        else:
+            fn = lambda x: jax.lax.psum(x, ax)
+        out = apply_op("c_allreduce", fn, tensor)
+        return _inplace(tensor, out)
+    # GSPMD context: values are global; reduction already implied
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    g = _resolve(group)
+    tensor = _as_tensor(tensor)
+    if g.nranks == 1 or not g.axis_names:
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor.clone())
+            return tensor_list
+        return tensor
+    if in_manual_context(g.axis_names):
+        ax = g.axis_names if len(g.axis_names) > 1 else g.axis_names[0]
+        out = apply_op(
+            "c_allgather",
+            lambda x: jax.lax.all_gather(x, ax, axis=0, tiled=False),
+            tensor,
+        )
+        if isinstance(tensor_list, list):
+            from ..tensor.manipulation import unbind
+
+            tensor_list.extend(unbind(out, axis=0))
+            return tensor_list
+        return out
+    if isinstance(tensor_list, list):
+        for _ in range(g.nranks):
+            tensor_list.append(tensor.clone())
+        return tensor_list
+    return tensor
+
+
+def all_gather_into_tensor(out_tensor, tensor, group=None, sync_op=True):
+    g = _resolve(group)
+    res = all_gather(None, tensor, group=group)
+    if isinstance(res, Tensor) and out_tensor is not None:
+        shape = out_tensor.shape
+        from ..tensor.manipulation import reshape
+
+        out_tensor.set_value(reshape(res, shape)._data)
+        return out_tensor
+    return res
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    g = _resolve(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, list):
+        from ..tensor.manipulation import concat
+
+        src = concat([_as_tensor(t) for t in src], axis=0)
+    src = _as_tensor(src)
+    if g.nranks == 1 or not g.axis_names:
+        tensor.set_value(src._data)
+        return tensor
+    if in_manual_context(g.axis_names):
+        ax = g.axis_names if len(g.axis_names) > 1 else g.axis_names[0]
+        out = apply_op(
+            "c_reducescatter",
+            lambda x: jax.lax.psum_scatter(x, ax, scatter_dimension=0,
+                                           tiled=True),
+            src,
+        )
+        tensor._data = out._data
+        tensor._grad_node = out._grad_node
+        return tensor
+    tensor.set_value(src._data)
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # single-controller SPMD: one copy of the data exists; broadcast is
+    # the identity (startup param sync is inherent)
+    return _as_tensor(tensor)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor.set_value(_as_tensor(tensor_list[0])._data)
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = _resolve(group)
+    ins = [_as_tensor(t) for t in in_tensor_list]
+    if g.nranks == 1 or not g.axis_names:
+        out_tensor_list.extend(t.clone() for t in ins)
+        return out_tensor_list
+    if in_manual_context(g.axis_names):
+        from ..tensor.manipulation import concat, split
+
+        ax = g.axis_names if len(g.axis_names) > 1 else g.axis_names[0]
+        stacked = concat(ins, axis=0)
+        out = apply_op(
+            "c_alltoall",
+            lambda x: jax.lax.all_to_all(
+                x.reshape((g.nranks, -1) + tuple(x.shape[1:])),
+                ax, split_axis=0, concat_axis=0, tiled=False,
+            ).reshape(x.shape),
+            stacked,
+        )
+        out_tensor_list.extend(split(out, g.nranks, axis=0))
+        return out_tensor_list
+    out_tensor_list.extend(t.clone() for t in ins)
+    return out_tensor_list
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    g = _resolve(group)
+    in_tensor = _as_tensor(in_tensor)
+    if g.nranks == 1 or not g.axis_names:
+        out_tensor.set_value(in_tensor._data)
+        return out_tensor
+    if in_manual_context(g.axis_names):
+        ax = g.axis_names if len(g.axis_names) > 1 else g.axis_names[0]
+        n = g.nranks
+        out = apply_op(
+            "c_alltoall_single",
+            lambda x: jax.lax.all_to_all(
+                x.reshape((n, x.shape[0] // n) + tuple(x.shape[1:])),
+                ax, split_axis=0, concat_axis=0, tiled=False,
+            ).reshape(x.shape),
+            in_tensor,
+        )
+        out_tensor._data = out._data
+        out_tensor._grad_node = out._grad_node
+        return out_tensor
+    out_tensor.set_value(in_tensor._data)
+    return out_tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "point-to-point send/recv outside a compiled region is not part of "
+        "the SPMD model; use ppermute-based p2p inside pipeline schedules "
+        "(paddle_tpu.distributed.fleet.meta_parallel.pp_utils)"
+    )
+
+
+recv = send
+
+
+def barrier(group=None):
+    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+    try:
+        (jnp.zeros(()) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+def stream_all_reduce(*a, **k):
+    return all_reduce(*a, **k)
+
+
+# in-trace p2p primitive used by the pipeline schedule
+def ppermute(tensor, perm, group=None):
+    g = _resolve(group)
+    tensor = _as_tensor(tensor)
+    if g.nranks == 1 or not g.axis_names:
+        return tensor
+    ax = g.axis_names if len(g.axis_names) > 1 else g.axis_names[0]
+    return apply_op(
+        "c_ppermute", lambda x: jax.lax.ppermute(x, ax, perm), tensor
+    )

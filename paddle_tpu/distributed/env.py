@@ -1,0 +1,71 @@
+"""Distributed environment state (single-controller SPMD).
+
+The reference runs one OS process per GPU with TCPStore rendezvous
+(upstream: paddle/phi/core/distributed/store/tcp_store.cc). The TPU-native
+model is one process per host, all devices addressed through jax; "rank"
+therefore means *logical parallel rank inside the mesh* for API parity,
+and multihost rendezvous is jax.distributed.initialize (coordination
+service) driven by paddle_tpu.distributed.launch.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.device_id = 0
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return 0
+
+
+_initialized = False
+_world_size = 1
+_rank = 0
+
+
+def _set_world(world_size, rank):
+    global _world_size, _rank, _initialized
+    _world_size = world_size
+    _rank = rank
+    _initialized = True
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(_rank)
+    return _rank
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return _world_size
+
+
+def is_initialized():
+    return _initialized
+
+
+def parallel_device_count():
+    return jax.device_count()

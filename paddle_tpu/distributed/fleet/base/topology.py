@@ -1,0 +1,206 @@
+"""Hybrid topology (upstream: python/paddle/distributed/fleet/base/
+topology.py — CommunicateTopology + HybridCommunicateGroup).
+
+TPU-native: instead of building one NCCL communicator per axis per
+coordinate, the N-D rank grid IS a `jax.sharding.Mesh` with named axes
+(default order ["dp", "pp", "sharding", "sep", "mp"], same as the
+reference), and a "comm group" is a handle on a mesh axis. An extra
+"ep" axis is supported for expert parallelism (the reference carves EP
+groups out of dp×mp at the MoE layer level; a first-class axis is the
+TPU-idiomatic equivalent).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..._mesh_compat import *  # noqa: F401,F403  (back-compat hook, empty)
+from ...collective import Group, _set_world_group, new_group
+from ...mesh import build_global_mesh, global_mesh
+from ... import env as _env
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(
+            hybrid_group_names or ["data", "pipe", "sharding", "sep", "model"]
+        )
+        self._dims = list(dims or [1, 1, 1, 1, 1])
+        self.coordinate = tuple(range(len(self._dims)))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_dim_size(self, axis_name):
+        return self.get_dim(axis_name)
+
+
+_AXIS_CANON = {
+    "dp": "dp", "data": "dp",
+    "pp": "pp", "pipe": "pp",
+    "sharding": "sharding",
+    "sep": "sep",
+    "mp": "mp", "model": "mp",
+    "ep": "ep", "expert": "ep",
+}
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology=None, hybrid_configs=None):
+        cfg = hybrid_configs or {}
+        order = [
+            _AXIS_CANON[a] for a in cfg.get(
+                "order", ["dp", "pp", "sharding", "sep", "mp"]
+            )
+        ]
+        degrees = {
+            "dp": int(cfg.get("dp_degree", 1)),
+            "mp": int(cfg.get("mp_degree", 1)),
+            "pp": int(cfg.get("pp_degree", 1)),
+            "sharding": int(cfg.get("sharding_degree", 1)),
+            "sep": int(cfg.get("sep_degree", 1)),
+            "ep": int(cfg.get("ep_degree", 1)),
+        }
+        if "ep" not in order and degrees["ep"] > 1:
+            order = order + ["ep"]
+        self._order = order
+        self._degrees = degrees
+
+        dims = [degrees[a] for a in order]
+        self._topo = CommunicateTopology(
+            [{"dp": "data", "pp": "pipe", "sharding": "sharding",
+              "sep": "sep", "mp": "model", "ep": "ep"}[a] for a in order],
+            dims,
+        )
+        build_global_mesh(order, dims)
+        _env._set_world(int(np.prod(dims)), 0)
+
+        self.global_rank = 0
+        self._dp_group = Group("dp", name="dp")
+        self._mp_group = Group("mp", name="mp")
+        self._pp_group = Group("pp", name="pp")
+        self._sharding_group = Group("sharding", name="sharding")
+        self._sep_group = Group("sep", name="sep")
+        self._ep_group = Group("ep", name="ep")
+        # check-group for global-norm clip: everything but dp
+        self._check_group = Group(
+            tuple(a for a in order if a not in ("dp",)), name="check"
+        )
+        _set_world_group(Group(tuple(order), gid=0, name="world"))
+
+    # -- degrees -----------------------------------------------------------
+    def get_num_of_all_model_parallel(self):
+        return self._degrees["mp"]
+
+    def get_data_parallel_world_size(self):
+        return self._degrees["dp"]
+
+    def get_model_parallel_world_size(self):
+        return self._degrees["mp"]
+
+    def get_pipe_parallel_world_size(self):
+        return self._degrees["pp"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._degrees["sharding"]
+
+    def get_sep_parallel_world_size(self):
+        return self._degrees["sep"]
+
+    def get_expert_parallel_world_size(self):
+        return self._degrees["ep"]
+
+    # -- ranks (single-controller: logical rank 0; per-device ranks only
+    #    exist inside compiled regions via lax.axis_index) ----------------
+    def get_global_rank(self):
+        return 0
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # -- groups ------------------------------------------------------------
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_expert_parallel_group(self):
+        return self._ep_group
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._check_group
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return self._topo
+
+    @property
+    def nranks(self):
+        return self._topo.world_size()
+
+    def get_parallel_mode(self):
+        # mirrors the reference's ParallelMode resolution order
+        if self._degrees["pp"] > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._degrees["sharding"] > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._degrees["mp"] > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+_HCG = None
+
+
+def _set_hcg(hcg):
+    global _HCG
+    _HCG = hcg
+
+
+def get_hybrid_communicate_group():
+    return _HCG

@@ -1,0 +1,102 @@
+"""Fleet facade (upstream: python/paddle/distributed/fleet/fleet.py).
+
+fleet.init builds the hybrid mesh; distributed_model/distributed_
+optimizer wrap for the active parallel mode (same dispatch as the
+reference's Fleet.distributed_model choosing TensorParallel /
+PipelineParallel / ShardingParallel / DataParallel).
+"""
+from __future__ import annotations
+
+from ...framework.core import Tensor
+from .. import env as _env
+from ..parallel import DataParallel
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import (
+    HybridCommunicateGroup,
+    ParallelMode,
+    _set_hcg,
+    get_hybrid_communicate_group,
+)
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        self._hcg = HybridCommunicateGroup(
+            hybrid_configs=self._strategy.hybrid_configs
+        )
+        _set_hcg(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_num(self):
+        return _env.get_world_size()
+
+    def worker_index(self):
+        return _env.get_rank()
+
+    def is_first_worker(self):
+        return _env.get_rank() == 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    def distributed_model(self, model):
+        if self._hcg is None:
+            return DataParallel(model)
+        mode = self._hcg.get_parallel_mode()
+        from ..meta_parallel_wrappers import (
+            PipelineParallelWrapper,
+            ShardingParallelWrapper,
+            TensorParallelWrapper,
+        )
+
+        if mode == ParallelMode.PIPELINE_PARALLEL:
+            from .meta_parallel.pipeline_parallel import PipelineParallel
+
+            return PipelineParallel(model, self._hcg, self._strategy)
+        if mode == ParallelMode.TENSOR_PARALLEL:
+            return TensorParallelWrapper(model, self._hcg, self._strategy)
+        if mode == ParallelMode.SHARDING_PARALLEL:
+            return ShardingParallelWrapper(model, self._hcg, self._strategy)
+        if self._hcg.get_data_parallel_world_size() > 1:
+            return DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .meta_optimizers.dygraph_optimizer import (
+            HybridParallelOptimizer,
+        )
+
+        return HybridParallelOptimizer(
+            optimizer, self._hcg, self._strategy or DistributedStrategy()
+        )
+
+    # static-graph era APIs kept as explicit not-supported markers
+    def minimize(self, *a, **k):
+        raise NotImplementedError(
+            "static-graph fleet.minimize is not part of the TPU-native "
+            "design; use dygraph + distributed_optimizer"
+        )
+
+
+fleet = Fleet()
+
+# module-level function aliases (paddle.distributed.fleet.init style)
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_index = fleet.worker_index
+get_hybrid_communicate_group_fn = get_hybrid_communicate_group

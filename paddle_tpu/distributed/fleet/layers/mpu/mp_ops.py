@@ -1,0 +1,147 @@
+"""Tensor-parallel communication primitives
+(upstream: python/paddle/distributed/fleet/layers/mpu/mp_ops.py —
+_c_identity/_c_split/_c_concat/_mp_allreduce autograd functions).
+
+TPU-native: in the GSPMD context these become sharding constraints —
+the partitioner inserts the all-reduce/all-gather exactly where the
+reference's hand-written collective ops run (and fuses them into the
+surrounding computation). In a manual shard_map context they lower to
+explicit lax collectives with matching fwd/bwd semantics.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ....framework.core import Tensor, apply_op, _as_tensor
+from ...collective import _resolve
+from ...mesh import global_mesh, in_manual_context
+
+
+def shard_constraint(x, *spec):
+    """with_sharding_constraint as a taped op (identity semantics)."""
+    x = _as_tensor(x)
+    m = global_mesh()
+    if m is None:
+        return x
+    sh = NamedSharding(m, PartitionSpec(*spec))
+    return apply_op(
+        "sharding_constraint",
+        lambda a: jax.lax.with_sharding_constraint(a, sh),
+        x,
+    )
+
+
+def _axis(group):
+    g = _resolve(group)
+    return g.axis_names if len(g.axis_names) > 1 else (
+        g.axis_names[0] if g.axis_names else None
+    )
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """fwd identity / bwd all-reduce over the mp group."""
+    tensor = _as_tensor(tensor)
+    g = _resolve(group)
+    if g.nranks == 1:
+        return tensor
+    if in_manual_context(g.axis_names):
+        ax = _axis(group)
+
+        @jax.custom_vjp
+        def ident(x):
+            return x
+
+        ident.defvjp(
+            lambda x: (x, None),
+            lambda _, ct: (jax.lax.psum(ct, ax),),
+        )
+        return apply_op("c_identity", ident, tensor)
+    # GSPMD: grads of replicated values are reduced by the partitioner
+    return tensor
+
+
+def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    """fwd all-reduce / bwd identity over the mp group."""
+    tensor = _as_tensor(tensor)
+    g = _resolve(group)
+    if g.nranks == 1:
+        return tensor
+    if in_manual_context(g.axis_names):
+        ax = _axis(group)
+
+        @jax.custom_vjp
+        def allred(x):
+            return jax.lax.psum(x, ax)
+
+        allred.defvjp(
+            lambda x: (jax.lax.psum(x, ax), None),
+            lambda _, ct: (ct,),
+        )
+        return apply_op("mp_allreduce", allred, tensor)
+    # GSPMD: a partial-sum product is materialized reduced automatically;
+    # an explicit replicated constraint is the belt-and-braces annotation
+    return shard_constraint(tensor)
+
+
+def _c_split(tensor, group=None):
+    """Split the last dim across the mp group (fwd) / all-gather (bwd)."""
+    tensor = _as_tensor(tensor)
+    g = _resolve(group)
+    if g.nranks == 1:
+        return tensor
+    if in_manual_context(g.axis_names):
+        ax = _axis(group)
+        n = g.nranks
+
+        @jax.custom_vjp
+        def split(x):
+            i = jax.lax.axis_index(ax)
+            size = x.shape[-1] // n
+            return jax.lax.dynamic_slice_in_dim(x, i * size, size, -1)
+
+        def fwd(x):
+            return split(x), None
+
+        def bwd(_, ct):
+            return (jax.lax.all_gather(ct, ax, axis=ct.ndim - 1, tiled=True),)
+
+        split.defvjp(fwd, bwd)
+        return apply_op("c_split", split, tensor)
+    return shard_constraint(tensor, *([None] * (tensor.ndim - 1) + ["mp"]))
+
+
+def _c_concat(tensor, group=None):
+    """All-gather the last dim across the mp group (fwd) / split (bwd)."""
+    tensor = _as_tensor(tensor)
+    g = _resolve(group)
+    if g.nranks == 1:
+        return tensor
+    if in_manual_context(g.axis_names):
+        ax = _axis(group)
+        n = g.nranks
+
+        @jax.custom_vjp
+        def concat(x):
+            return jax.lax.all_gather(x, ax, axis=x.ndim - 1, tiled=True)
+
+        def fwd(x):
+            return concat(x), None
+
+        def bwd(_, ct):
+            i = jax.lax.axis_index(ax)
+            size = ct.shape[-1] // n
+            return (jax.lax.dynamic_slice_in_dim(ct, i * size, size, -1),)
+
+        concat.defvjp(fwd, bwd)
+        return apply_op("c_concat", concat, tensor)
+    return shard_constraint(tensor)
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=1,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    raise NotImplementedError(
+        "paddle.distributed.split: use ColumnParallelLinear / "
+        "RowParallelLinear directly"
+    )

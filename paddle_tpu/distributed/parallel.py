@@ -1,0 +1,116 @@
+"""init_parallel_env + DataParallel
+(upstream: python/paddle/distributed/parallel.py).
+
+TPU-native semantics: one controller process; `init_parallel_env`
+builds the world mesh over all local (or, multihost, all global)
+devices and — on multihost — calls jax.distributed.initialize using the
+env set by `paddle_tpu.distributed.launch` (the TCPStore-rendezvous
+analog; upstream C++: paddle/phi/core/distributed/store/tcp_store.cc).
+
+DataParallel: with a 'dp'-sharded global batch, XLA computes per-op
+cross-device reductions exactly where the reference's EagerReducer
+launches bucketed ncclAllReduce during backward (upstream:
+paddle/fluid/distributed/collective/reducer.cc) — the bucketing/overlap
+is the XLA scheduler's job, which it does across the whole step.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+from . import env as _env
+from .env import ParallelEnv, get_rank, get_world_size
+from .mesh import build_global_mesh, global_mesh, named_sharding
+
+
+def init_parallel_env(strategy=None):
+    """Boot the distributed environment.
+
+    Multihost: honors PADDLE_MASTER / PADDLE_TRAINER_ID /
+    PADDLE_TRAINERS_NUM (the same env contract the reference's launch
+    sets) by delegating to jax.distributed.initialize.
+    """
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "MASTER_ADDR"
+    )
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if master and nnodes > 1:
+        node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
+        try:
+            jax.distributed.initialize(
+                coordinator_address=master,
+                num_processes=nnodes,
+                process_id=node_rank,
+            )
+        except Exception as e:  # already initialized
+            if "already" not in str(e).lower():
+                raise
+    n = jax.device_count()
+    if global_mesh() is None:
+        build_global_mesh(("dp",), (n,))
+    _env._set_world(n, 0)
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        # annotate params replicated; inputs get dp-sharded by the user
+        # (DistributedBatchSampler + shard_dp_input) or by to_static
+        for p in layers.parameters():
+            p._dist_attr = ()  # replicated over the whole mesh
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(
+            _shard_batch(x) if isinstance(x, Tensor) else x for x in inputs
+        )
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    @property
+    def _sub(self):
+        return self._layers
+
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def scale_loss(self, loss):
+        return loss
+
+
+def _shard_batch(x: Tensor) -> Tensor:
+    """Annotate a host batch with dp(+sharding) batch-dim sharding."""
+    m = global_mesh()
+    if m is None or isinstance(x._data, jax.core.Tracer):
+        return x
+    batch_axes = tuple(
+        a for a in ("dp", "sharding") if a in m.axis_names
+        and m.shape[a] > 1
+    )
+    if not batch_axes:
+        return x
+    spec = (batch_axes if len(batch_axes) > 1 else batch_axes[0],)
+    sharding = named_sharding(*spec)
+    try:
+        x._data = jax.device_put(x._data, sharding)
+    except Exception:
+        pass
+    return x
+
+
+def shard_dp_input(x):
+    return _shard_batch(x if isinstance(x, Tensor) else Tensor(x))

@@ -1,0 +1,29 @@
+"""paddle_tpu.framework — core runtime."""
+from . import dtype as dtype_module
+from .core import (
+    EagerParamBase,
+    GradNode,
+    Parameter,
+    Tensor,
+    apply_op,
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from .dtype import DType, convert_dtype, to_np_dtype
+from .flags import get_flags, set_flags, define_flag, flag
+from .io import load, save
+from .random import Generator, default_generator, get_rng_state, seed, set_rng_state
+
+
+def in_dynamic_mode():
+    return True
+
+
+def in_pir_mode():
+    return False
+
+
+def use_pir_api():
+    return False

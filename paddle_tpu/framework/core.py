@@ -1,0 +1,414 @@
+"""Eager Tensor + tape autograd — TPU-native analog of the reference's
+dygraph runtime (upstream: paddle/fluid/eager/grad_node_info.h,
+backward.cc, tensor_wrapper.h).
+
+Design (TPU-first, not a port):
+
+* ``Tensor`` wraps a ``jax.Array`` (or a jax tracer when running inside a
+  traced/compiled step — the whole eager machinery is trace-transparent,
+  which is what makes ``paddle_tpu.jit.to_static`` able to compile an
+  imperative train step into one XLA program).
+* Autograd is a dynamic tape of :class:`GradNode` records linked through
+  tensors (PyTorch/Paddle-style DAG, GC-managed — no global list). The
+  backward pass walks nodes in reverse creation order and obtains each
+  op's gradient via ``jax.vjp`` of the recorded primal function. In eager
+  mode this re-executes the forward of each op (fine: eager is the debug
+  path); under ``to_static`` the re-trace is CSE'd away by XLA.
+* Version counters on tensors detect "modified after saved for backward"
+  (analog of the reference's inplace-version checks in TensorWrapper).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import weakref
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .dtype import DType, convert_dtype, to_np_dtype
+
+# --------------------------------------------------------------------------
+# global eager state
+# --------------------------------------------------------------------------
+
+_UID = itertools.count()
+
+
+class _EagerState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.amp_cast_fn = None  # installed by paddle_tpu.amp
+        self.retain_graph_depth = 0
+
+
+_state = _EagerState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+def set_grad_enabled(flag: bool):
+    _state.grad_enabled = bool(flag)
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording."""
+
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+# --------------------------------------------------------------------------
+# GradNode — one recorded op
+# --------------------------------------------------------------------------
+
+
+class GradNode:
+    """Record of one differentiable op application.
+
+    Stores the primal function (closing over static attrs), the raw input
+    arrays (functional jax arrays — immutable, so no TensorWrapper copy
+    is needed), strong refs to input Tensors (to reach their producing
+    nodes), and weak refs to outputs (for cotangent lookup).
+    """
+
+    __slots__ = (
+        "name", "fn", "in_tensors", "in_raws", "in_versions", "out_refs",
+        "out_avals", "idx", "n_outs", "__weakref__",
+    )
+
+    def __init__(self, name, fn, in_tensors, in_raws, outs):
+        self.name = name
+        self.fn = fn
+        self.in_tensors = in_tensors
+        self.in_raws = in_raws
+        self.in_versions = tuple(t._version for t in in_tensors)
+        self.out_refs = tuple(weakref.ref(o) for o in outs)
+        self.out_avals = tuple((o._data.shape, o._data.dtype) for o in outs)
+        self.n_outs = len(outs)
+        self.idx = next(_UID)
+
+
+def _is_float0(x):
+    return hasattr(x, "dtype") and x.dtype == jax.dtypes.float0
+
+
+# --------------------------------------------------------------------------
+# Tensor
+# --------------------------------------------------------------------------
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+class Tensor:
+    """Imperative tensor facade over ``jax.Array``.
+
+    API-compatible with the reference's eager Tensor surface (upstream:
+    paddle/fluid/pybind/eager_method.cc exposes the same methods).
+    Methods from the functional namespaces (``paddle_tpu.tensor.*``) are
+    monkey-patched on at import time, mirroring how the reference attaches
+    its generated method table.
+    """
+
+    __slots__ = (
+        "_data", "stop_gradient", "_grad", "_grad_node", "name",
+        "persistable", "_version", "_grad_hooks", "_dist_attr", "trainable",
+        "_uid", "__weakref__", "is_leaf_override", "_optimize_attrs",
+    )
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None,
+                 persistable=False):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array) and not isinstance(
+            data, jax.core.Tracer
+        ):
+            data = jnp.asarray(
+                data, dtype=to_np_dtype(dtype) if dtype is not None else None
+            )
+        elif dtype is not None and data.dtype != to_np_dtype(dtype):
+            data = data.astype(to_np_dtype(dtype))
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._uid = next(_UID)
+        self.name = name if name is not None else f"tensor_{self._uid}"
+        self.persistable = persistable
+        self._version = 0
+        self._grad_hooks = None
+        self._dist_attr = None
+        self.trainable = True
+        self.is_leaf_override = None
+        self._optimize_attrs = None
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self) -> DType:
+        return convert_dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        from ..device import _current_place
+
+        return _current_place()
+
+    @property
+    def is_leaf(self):
+        if self.is_leaf_override is not None:
+            return self.is_leaf_override
+        return self._grad_node is None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = value
+
+    # -- data access -------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return np.asarray(self._data).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_txt = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+            f"{grad_txt},\n       {np.asarray(jax.device_get(self._data)) if not isinstance(self._data, jax.core.Tracer) else self._data})"
+        )
+
+    # -- mutation ----------------------------------------------------------
+    def set_value(self, value):
+        """Replace the payload in place (bumps the inplace version)."""
+        new = _raw(value)
+        if not isinstance(new, (jax.Array, jax.core.Tracer)):
+            new = jnp.asarray(new, dtype=self._data.dtype)
+        elif new.dtype != self._data.dtype:
+            new = new.astype(self._data.dtype)
+        self._data = new
+        self._version += 1
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def _set_data_keep_version(self, raw):
+        self._data = raw
+
+    # -- autograd ----------------------------------------------------------
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return apply_op("clone", lambda x: x + 0 if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.array(x), self)
+
+    def clear_grad(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad.set_value(jnp.zeros_like(self._grad._data))
+        else:
+            self._grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Register a grad hook: grad -> new grad (or None). Analog of
+        upstream Tensor::register_hook (eager_method.cc)."""
+        if self._grad_hooks is None:
+            self._grad_hooks = []
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def __init__(self, owner, h):
+                self._owner, self._h = owner, h
+
+            def remove(self):
+                try:
+                    self._owner._grad_hooks.remove(self._h)
+                except (ValueError, AttributeError):
+                    pass
+
+        return _Handle(self, hook)
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from ..autograd.backward_engine import run_backward
+
+        run_backward([self], [grad_tensor], retain_graph)
+
+    def __reduce__(self):
+        return (
+            _rebuild_tensor,
+            (
+                np.asarray(jax.device_get(self._data)),
+                self.stop_gradient,
+                self.name,
+                self.persistable,
+                isinstance(self, EagerParamBase),
+            ),
+        )
+
+    # NumPy-style dunders are attached by paddle_tpu.tensor (monkey patch).
+
+
+def _rebuild_tensor(arr, stop_gradient, name, persistable, is_param):
+    if is_param:
+        t = EagerParamBase(arr, name=name)
+        t.stop_gradient = stop_gradient
+        return t
+    t = Tensor(arr, stop_gradient=stop_gradient, name=name,
+               persistable=persistable)
+    return t
+
+
+class EagerParamBase(Tensor):
+    """Parameter: trainable leaf tensor (upstream: EagerParamBase in
+    paddle/fluid/pybind/eager.cc). stop_gradient defaults False."""
+
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name, persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+        self.is_distributed = False
+
+
+Parameter = EagerParamBase
+
+
+# --------------------------------------------------------------------------
+# op application — the dispatch point (analog of generated *_ad_func +
+# phi API call in one: paddle/fluid/eager/api/generated, phi/api/lib)
+# --------------------------------------------------------------------------
+
+
+def _wrap_out(raw, requires_grad):
+    t = Tensor(raw, stop_gradient=not requires_grad)
+    return t
+
+
+def apply_op(name: str, fn: Callable, *tensor_inputs, n_outs: int = 1,
+             out_treedef=None, differentiable: bool = True):
+    """Run op ``fn`` over the raw payloads of ``tensor_inputs``.
+
+    ``fn`` must be a pure function of exactly the tensor inputs (statics
+    closed over). Records a GradNode when grad is enabled and any input
+    requires grad. Multi-output ops: ``fn`` returns a tuple, pass n_outs.
+    """
+    ins = tuple(
+        t if isinstance(t, Tensor) else Tensor(t) for t in tensor_inputs
+    )
+    # AMP hook: the installed policy may cast inputs (O1 white/black list)
+    if _state.amp_cast_fn is not None:
+        ins, fn = _state.amp_cast_fn(name, ins, fn)
+    raws = tuple(t._data for t in ins)
+    out_raw = fn(*raws)
+
+    requires_grad = (
+        differentiable
+        and _state.grad_enabled
+        and any(not t.stop_gradient for t in ins)
+    )
+    if n_outs == 1 and not isinstance(out_raw, tuple):
+        out = _wrap_out(out_raw, requires_grad)
+        outs = (out,)
+        result = out
+    else:
+        outs = tuple(_wrap_out(r, requires_grad) for r in out_raw)
+        result = outs
+
+    if requires_grad:
+        node = GradNode(name, fn, ins, raws, outs)
+        for o in outs:
+            o._grad_node = node
+    return result
+
+
+def _as_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x, dtype=dtype)

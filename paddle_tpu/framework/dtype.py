@@ -1,0 +1,123 @@
+"""Dtype system — the analog of the reference's ``phi::DataType`` enum
+(upstream: paddle/phi/common/data_type.h), re-based on numpy/jax dtypes.
+
+A :class:`DType` is a thin named wrapper over a numpy dtype that compares
+equal to paddle-style names (``'float32'``), numpy dtypes, and jax dtypes,
+so user code can pass any of the three anywhere.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax and provides bfloat16 / fp8 numpy scalars
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+    _F8E4M3 = np.dtype(ml_dtypes.float8_e4m3fn)
+    _F8E5M2 = np.dtype(ml_dtypes.float8_e5m2)
+except Exception:  # pragma: no cover
+    _BFLOAT16 = np.dtype(np.float32)
+    _F8E4M3 = _F8E5M2 = None
+
+
+class DType:
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    # -- comparisons -------------------------------------------------------
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.np_dtype == other.np_dtype
+        if isinstance(other, str):
+            other_name = other.split(".")[-1]  # accept "paddle.float32"
+            try:
+                return self.np_dtype == convert_dtype(other_name).np_dtype
+            except (KeyError, TypeError):
+                return self.name == other_name
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+    def __hash__(self):
+        return hash(self.np_dtype)
+
+    def __repr__(self):
+        return f"paddle.{self.name}"
+
+    # numpy interop: np.dtype(paddle.float32) works
+    @property
+    def dtype(self):
+        return self.np_dtype
+
+    @property
+    def is_floating_point(self):
+        return np.issubdtype(self.np_dtype, np.floating) or self.np_dtype == _BFLOAT16
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", _BFLOAT16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", _F8E4M3) if _F8E4M3 is not None else None
+float8_e5m2 = DType("float8_e5m2", _F8E5M2) if _F8E5M2 is not None else None
+
+_BY_NAME = {
+    d.name: d
+    for d in (
+        bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+        float32, float64, complex64, complex128,
+    )
+}
+_BY_NAME["bool"] = bool_
+if float8_e4m3fn is not None:
+    _BY_NAME["float8_e4m3fn"] = float8_e4m3fn
+    _BY_NAME["float8_e5m2"] = float8_e5m2
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize str / numpy dtype / jax dtype / DType → DType."""
+    if dtype is None:
+        return float32
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = dtype.split(".")[-1]
+        if name in _BY_NAME:
+            return _BY_NAME[name]
+        return DType(name, np.dtype(name))
+    npd = np.dtype(dtype)
+    if npd == _BFLOAT16:
+        return bfloat16
+    for d in _BY_NAME.values():
+        if d.np_dtype == npd:
+            return d
+    return DType(npd.name, npd)
+
+
+def to_np_dtype(dtype):
+    """Any dtype-like → numpy dtype usable by jax."""
+    return convert_dtype(dtype).np_dtype
+
+
+def is_floating(dtype) -> bool:
+    return convert_dtype(dtype).is_floating_point

@@ -1,0 +1,77 @@
+"""FLAGS registry — analog of the reference's exported gflags system
+(upstream: paddle/phi/core/flags.cc, paddle/utils/flags.h).
+
+Flags are registered with a type and default, overridable by FLAGS_*
+environment variables at import, and by paddle_tpu.set_flags at runtime.
+When the native runtime extension (csrc/) is available the registry is
+mirrored there; otherwise this pure-Python registry is authoritative.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+_META: Dict[str, tuple] = {}  # name -> (type, help)
+
+
+def _parse(value: str, typ):
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    return typ(value)
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    typ = type(default)
+    env = os.environ.get("FLAGS_" + name)
+    _META[name] = (typ, help_str)
+    _REGISTRY[name] = _parse(env, typ) if env is not None else default
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = f[6:] if f.startswith("FLAGS_") else f
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {f}")
+        out[f] = _REGISTRY[key]
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    for f, v in flags.items():
+        key = f[6:] if f.startswith("FLAGS_") else f
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {f}")
+        typ = _META[key][0]
+        _REGISTRY[key] = _parse(v, typ) if isinstance(v, str) else typ(v)
+        _on_set(key, _REGISTRY[key])
+
+
+def _on_set(key, value):
+    if key == "check_nan_inf":
+        import jax
+
+        jax.config.update("jax_debug_nans", bool(value))
+
+
+def flag(name: str):
+    return _REGISTRY[name]
+
+
+# -- core flags (subset of the reference's, TPU-meaningful) -----------------
+define_flag("check_nan_inf", False,
+            "check every op output for nan/inf (jax_debug_nans)")
+define_flag("benchmark", False, "benchmark mode: sync after each op")
+define_flag("use_pallas_kernels", True,
+            "use hand-written Pallas TPU kernels where available")
+define_flag("allocator_strategy", "auto_growth",
+            "kept for API parity; XLA/PJRT owns TPU memory")
+define_flag("log_level", 0, "VLOG-style verbosity")
+define_flag("cudnn_deterministic", False, "API parity; XLA is deterministic")
+define_flag("embedding_deterministic", 0, "API parity")
+
+if os.environ.get("FLAGS_check_nan_inf"):
+    _on_set("check_nan_inf", _REGISTRY["check_nan_inf"])

@@ -1,0 +1,71 @@
+"""paddle.save / paddle.load analog (upstream: python/paddle/framework/io.py).
+
+Serialization converts Tensors → numpy in a pickled nested structure; the
+format is self-contained and device-independent (TPU arrays are pulled to
+host). For large sharded checkpoints use paddle_tpu.distributed.checkpoint
+(orbax-backed, async) instead — this is the small/simple path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core import Tensor, EagerParamBase
+
+
+class _TensorPayload:
+    __slots__ = ("array", "stop_gradient", "name", "is_param")
+
+    def __init__(self, array, stop_gradient, name, is_param):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+        self.is_param = is_param
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(
+            np.asarray(obj._data), obj.stop_gradient, obj.name,
+            isinstance(obj, EagerParamBase),
+        )
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        if obj.is_param:
+            t = EagerParamBase(obj.array, name=obj.name)
+        else:
+            t = Tensor(obj.array, name=obj.name)
+            t.stop_gradient = obj.stop_gradient
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=configs.get("return_numpy", False))

@@ -1,0 +1,86 @@
+"""Global RNG — counter-based PRNG over jax keys.
+
+The reference keeps per-device curand generators (upstream:
+paddle/phi/core/generator.cc). TPU-native design: a single global
+(key, counter) pair held in Tensors so it is captured as mutable state by
+the compiled step (to_static); every draw folds the counter into the key,
+giving a pure, trace-friendly stream. The fleet RNGStatesTracker
+(upstream: meta_parallel/parallel_layers/random.py) builds on this via
+named key offsets.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core import Tensor
+
+_DEFAULT_SEED = 0
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        # held as Tensors so StateRegistry captures them for compiled steps
+        self.key = Tensor(jax.random.key_data(jax.random.PRNGKey(seed)),
+                          persistable=True, name="rng_key")
+        self.counter = Tensor(jnp.zeros((), jnp.uint32), persistable=True,
+                              name="rng_counter")
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self.key.set_value(jax.random.key_data(jax.random.PRNGKey(self._seed)))
+        self.counter.set_value(jnp.zeros((), jnp.uint32))
+        return self
+
+    def initial_seed(self):
+        return self._seed
+
+    def next_key(self):
+        """Return a fresh PRNG key; advances the counter (mutates state)."""
+        key = jax.random.wrap_key_data(self.key._data)
+        sub = jax.random.fold_in(key, self.counter._data)
+        self.counter._data = self.counter._data + jnp.uint32(1)
+        return sub
+
+    def get_state(self):
+        return [Tensor(self.key._data), Tensor(self.counter._data)]
+
+    def set_state(self, state):
+        self.key.set_value(state[0])
+        self.counter.set_value(state[1])
+
+
+_default_generator = None
+
+
+def default_generator() -> Generator:
+    global _default_generator
+    if _default_generator is None:
+        _default_generator = Generator(_DEFAULT_SEED)
+    return _default_generator
+
+
+def seed(value: int):
+    """paddle.seed analog."""
+    gen = default_generator().manual_seed(int(value))
+    try:
+        from ..distributed.fleet.meta_parallel.parallel_layers.random import (
+            get_rng_state_tracker,
+        )
+        get_rng_state_tracker().reset_basic_seed(int(value))
+    except Exception:
+        pass
+    return gen
+
+
+def get_rng_state():
+    return default_generator().get_state()
+
+
+def set_rng_state(state):
+    default_generator().set_state(state)
+
+
+def next_key():
+    return default_generator().next_key()

@@ -1,0 +1,46 @@
+"""Global registries of live stateful objects (Layers, Optimizers,
+generators). Used by the compiled-step functionalizer (jit/to_static) to
+snapshot all mutable framework state — the TPU-native replacement for the
+reference's Scope/variable system (upstream: paddle/fluid/framework/scope.h).
+"""
+from __future__ import annotations
+
+import weakref
+
+_LAYERS = weakref.WeakSet()
+_OPTIMIZERS = weakref.WeakSet()
+
+
+def register_layer(layer):
+    _LAYERS.add(layer)
+
+
+def register_optimizer(opt):
+    _OPTIMIZERS.add(opt)
+
+
+def live_layers():
+    return list(_LAYERS)
+
+
+def live_optimizers():
+    return list(_OPTIMIZERS)
+
+
+def snapshot_state_tensors():
+    """All mutable Tensors the framework owns, in stable (uid) order:
+    layer params + buffers, optimizer accumulators, the global RNG."""
+    from .core import Tensor
+    from .random import default_generator
+
+    seen = {}
+    for layer in _LAYERS:
+        for t in layer._state_tensors():
+            seen[t._uid] = t
+    for opt in _OPTIMIZERS:
+        for t in opt._state_tensors():
+            seen[t._uid] = t
+    gen = default_generator()
+    seen[gen.key._uid] = gen.key
+    seen[gen.counter._uid] = gen.counter
+    return [seen[k] for k in sorted(seen)]

@@ -1,0 +1,207 @@
+"""hapi Model — high-level fit/evaluate/predict loop
+(upstream: python/paddle/hapi/model.py). The train step is compiled with
+to_static automatically (the reference gains this only via
+@to_static-decorated models; here it is the default perf path)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.io import load as _load
+from ..framework.io import save as _save
+from . import callbacks as cb_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._compiled_train_step = None
+        self._compiled_eval_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+
+    # -- single-batch ops --------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        if self._compiled_train_step is None:
+            from ..jit import to_static
+
+            opt = self._optimizer
+            net = self.network
+            loss_fn = self._loss
+
+            def _step(x, y):
+                out = net(x)
+                loss = loss_fn(out, y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss, out
+
+            self._compiled_train_step = to_static(_step)
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        loss, out = self._compiled_train_step(x, y)
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(out, y))
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        out = self.network(x)
+        loss = self._loss(out, y) if self._loss else None
+        for m in self._metrics:
+            m.update(m.compute(out, y))
+        return [float(loss)] if loss is not None else []
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        from ..framework.core import no_grad
+
+        with no_grad():
+            out = self.network(x)
+        return out
+
+    # -- loops -------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(
+                train_data, batch_size=batch_size, shuffle=shuffle,
+                drop_last=drop_last, num_workers=num_workers,
+            )
+        else:
+            train_loader = train_data
+
+        cbs = [cb_mod.ProgBarLogger(log_freq, verbose)]
+        if save_dir:
+            cbs.append(cb_mod.ModelCheckpoint(save_freq, save_dir))
+        cbs += list(callbacks or [])
+        for c in cbs:
+            c.set_model(self)
+
+        self.stop_training = False
+        for c in cbs:
+            c.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            for c in cbs:
+                c.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                x, y = batch[0], batch[1]
+                losses = self.train_batch(x, y)
+                logs = {"loss": losses[0]}
+                for m in self._metrics:
+                    acc = m.accumulate()
+                    logs[m.name() if isinstance(m.name(), str) else "metric"] = acc
+                for c in cbs:
+                    c.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
+                    break
+            for c in cbs:
+                c.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              num_workers=num_workers, verbose=0,
+                              callbacks=cbs)
+            if self.stop_training:
+                break
+        for c in cbs:
+            c.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        cbs = list(callbacks or [])
+        losses = []
+        for c in cbs:
+            c.on_eval_begin()
+        for batch in loader:
+            x, y = batch[0], batch[1]
+            out = self.eval_batch(x, y)
+            if out:
+                losses.append(out[0])
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            name = m.name()
+            logs[name if isinstance(name, str) else name[0]] = m.accumulate()
+        for c in cbs:
+            c.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x))
+        return outs
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        info = {
+            "total_params": n_params,
+            "trainable_params": sum(
+                p.size for p in self.network.parameters() if p.trainable
+            ),
+        }
+        print(f"Total params: {n_params:,}")
+        return info

@@ -1,0 +1,408 @@
+"""paddle_tpu.io — Dataset / DataLoader
+(upstream: python/paddle/io/ + the C++ blocking-queue reader ops in
+paddle/fluid/operators/reader/).
+
+TPU-native design: the loader pipelines host-side batch assembly on a
+background thread pool into a bounded blocking queue (the analog of the
+reference's C++ BlockingQueue), converts to device arrays, and overlaps
+host→HBM transfer with compute by keeping `prefetch_factor` batches in
+flight. One process (jax owns the TPU); workers are threads — numpy
+collate releases the GIL for the copy-heavy part.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.random import default_generator
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        self.tensors = [
+            t if isinstance(t, Tensor) else Tensor(t) for t in tensors
+        ]
+
+    def __getitem__(self, idx):
+        return tuple(t.numpy()[idx] for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cum = np.cumsum([len(d) for d in self.datasets])
+
+    def __len__(self):
+        return int(self.cum[-1])
+
+    def __getitem__(self, idx):
+        di = int(np.searchsorted(self.cum, idx, side="right"))
+        prev = 0 if di == 0 else int(self.cum[di - 1])
+        return self.datasets[di][idx - prev]
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = datasets
+
+    def __iter__(self):
+        return itertools.chain(*self.datasets)
+
+
+def random_split(dataset, lengths, generator=None):
+    n = len(dataset)
+    idx = np.random.RandomState(
+        default_generator().initial_seed()
+    ).permutation(n)
+    out, start = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, idx[start:start + l]))
+        start += l
+    return out
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self.num_samples = num_samples or len(data_source)
+        self._epoch = 0
+
+    def __iter__(self):
+        n = len(self.data_source)
+        seed = default_generator().initial_seed() + self._epoch
+        self._epoch += 1
+        rng = np.random.RandomState(seed)
+        if self.replacement:
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(
+            len(self.weights), self.num_samples, self.replacement, p
+        )
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards the index space across data-parallel ranks (upstream:
+    python/paddle/io/dataloader/batch_sampler.py). In one-process SPMD
+    the 'rank' is a slot in the global batch: the fleet dataloader uses
+    num_replicas = dp_degree and concatenates shards, so per-device
+    sub-batches line up with the mesh's dp axis."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        from ..distributed import get_rank, get_world_size
+
+        self.nranks = num_replicas if num_replicas is not None else (
+            get_world_size()
+        )
+        self.local_rank = rank if rank is not None else get_rank()
+        self.epoch = 0
+        self.num_samples = int(np.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.RandomState(
+                default_generator().initial_seed() + self.epoch
+            )
+            indices = rng.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        indices += indices[: self.total_size - len(indices)]
+        local = indices[self.local_rank::self.nranks]
+        batch = []
+        for idx in local:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+def _np_collate(batch):
+    """Collate to host numpy (safe in worker threads — device transfer
+    happens on the main thread, since PJRT client creation is not
+    thread-safe to race from workers)."""
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._data) for s in batch])
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, float):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (list, tuple)):
+        return [_np_collate([b[i] for b in batch])
+                for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def _to_device(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, list):
+        return [_to_device(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_device(v) for k, v in obj.items()}
+    return obj
+
+
+def default_collate_fn(batch):
+    return _to_device(_np_collate(batch))
+
+
+class _LoaderIter:
+    def __init__(self, loader):
+        # Force PJRT backend init BEFORE spawning threads: client creation
+        # is not thread/fork-safe and deadlocks if worker threads exist.
+        import jax
+
+        jax.devices()
+        self.loader = loader
+        self.batch_iter = iter(loader.batch_sampler)
+        self.queue = queue.Queue(
+            maxsize=max(2, loader.prefetch_factor * max(loader.num_workers, 1))
+        )
+        self._stop = threading.Event()
+        self._threads = []
+        self._seq = 0
+        self._next_emit = 0
+        self._lock = threading.Lock()
+        self._reorder = {}
+        n = max(1, loader.num_workers)
+        self._sentinel_count = 0
+        for _ in range(n):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _next_indices(self):
+        with self._lock:
+            try:
+                idx = next(self.batch_iter)
+            except StopIteration:
+                return None, None
+            seq = self._seq
+            self._seq += 1
+            return seq, idx
+
+    def _worker(self):
+        while not self._stop.is_set():
+            seq, indices = self._next_indices()
+            if seq is None:
+                self.queue.put((None, None))
+                return
+            try:
+                if self.loader.dataset_kind == "iterable":
+                    raise RuntimeError
+                samples = [self.loader.dataset[i] for i in indices]
+                # workers collate to numpy; device upload happens on the
+                # consumer (main) thread in __next__
+                if self.loader.collate_fn is default_collate_fn:
+                    batch = _np_collate(samples)
+                else:
+                    batch = self.loader.collate_fn(samples)
+            except Exception as e:  # propagate errors to the consumer
+                self.queue.put((seq, e))
+                continue
+            self.queue.put((seq, batch))
+
+    def __next__(self):
+        n_workers = max(1, self.loader.num_workers)
+        while True:
+            if self._next_emit in self._reorder:
+                item = self._reorder.pop(self._next_emit)
+                self._next_emit += 1
+                if isinstance(item, Exception):
+                    raise item
+                if self.loader.collate_fn is default_collate_fn:
+                    item = _to_device(item)
+                return item
+            if self._sentinel_count >= n_workers:
+                if not self._reorder:
+                    raise StopIteration
+                # remaining items have out-of-range seq — flush in order
+                k = min(self._reorder)
+                self._next_emit = k
+                continue
+            seq, item = self.queue.get()
+            if seq is None:
+                self._sentinel_count += 1
+                continue
+            self._reorder[seq] = item
+
+    def __iter__(self):
+        return self
+
+    def __del__(self):
+        self._stop.set()
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.collate_fn = collate_fn or default_collate_fn
+        self.dataset_kind = (
+            "iterable" if isinstance(dataset, IterableDataset) else "map"
+        )
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        elif self.dataset_kind == "map":
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last,
+            )
+        else:
+            self.batch_sampler = None
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        if self.dataset_kind == "iterable":
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_sync()
+        return _LoaderIter(self)
+
+    def _iter_sync(self):
+        for indices in self.batch_sampler:
+            samples = [self.dataset[i] for i in indices]
+            yield self.collate_fn(samples)
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def __len__(self):
+        if self.batch_sampler is not None:
+            return len(self.batch_sampler)
+        raise TypeError("IterableDataset has no len()")
+
+
+def get_worker_info():
+    return None

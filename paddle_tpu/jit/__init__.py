@@ -1,0 +1,75 @@
+"""paddle_tpu.jit (upstream: python/paddle/jit/)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+from ..framework.core import Tensor
+from ..framework.io import _pack, _unpack
+from .api import StaticFunction, ignore_module, not_to_static, to_static
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize a Layer (architecture via pickle + weights as numpy).
+
+    The reference exports a static Program (upstream:
+    python/paddle/jit/api.py jit.save); the TPU-native deployment artifact
+    is the layer itself + XLA persistent compilation cache, so we persist
+    the module object and its state.
+    """
+    from ..nn.layer.layers import Layer
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    if isinstance(layer, StaticFunction):
+        raise TypeError("jit.save expects a Layer; wrap functions in a Layer")
+    payload = {
+        "state_dict": _pack(layer.state_dict()),
+        "layer": None,
+        "input_spec": input_spec,
+    }
+    try:
+        buf = pickle.dumps(layer.__class__)
+        payload["layer_cls"] = buf
+        payload["layer"] = None
+        # try full-object pickling (works when forward closes over nothing)
+        payload["layer"] = pickle.dumps(_StrippedLayer(layer))
+    except Exception:
+        payload["layer"] = None
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f)
+
+
+class _StrippedLayer:
+    """Pickle helper: layer with tensors detached to numpy."""
+
+    def __init__(self, layer):
+        self.layer = layer
+
+    def __reduce__(self):
+        import copyreg
+
+        return (_rebuild_layer, (pickle.dumps(self.layer, protocol=4),))
+
+
+def _rebuild_layer(buf):
+    return pickle.loads(buf)
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    if payload.get("layer") is not None:
+        stripped = pickle.loads(payload["layer"])
+        layer = stripped.layer if isinstance(stripped, _StrippedLayer) else stripped
+        layer.set_state_dict(_unpack(payload["state_dict"]))
+        return layer
+    raise RuntimeError(
+        "saved artifact does not contain a loadable layer; "
+        "re-save with a picklable Layer subclass"
+    )
+
+
+class TranslatedLayer:
+    pass

@@ -1,0 +1,220 @@
+"""@to_static — compile an imperative (dygraph) step into one XLA program.
+
+Upstream analog: python/paddle/jit/dy2static/ (ProgramTranslator +
+PartialProgramLayer). The reference rewrites Python AST into a static
+Program executed by InterpreterCore; on TPU the right mechanism is
+trace-and-jit:
+
+* snapshot all mutable framework state (params, buffers, optimizer
+  accumulators, RNG) via the state registry;
+* run the user's imperative function once under ``jax.jit`` tracing with
+  state bound to tracers — the eager Tensor/tape machinery is
+  trace-transparent, so ``loss.backward()``/``opt.step()`` trace into
+  pure XLA ops (XLA then CSEs the vjp re-traces and fuses the whole
+  step, playing the role of CINN);
+* the compiled step is (state, args) → (outs, new_state) with state
+  buffers donated → in-place param updates in HBM;
+* cached by input spec (shape/dtype/tree) like the reference's program
+  cache keyed on InputSpec.
+
+Restrictions (same class as the reference's dy2static): no
+data-dependent Python control flow on traced values, no .numpy()/.item()
+inside the traced function.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from ..framework import state as _registry
+from ..framework.core import EagerParamBase, Tensor
+
+
+def _tree_flatten(obj):
+    return jax.tree_util.tree_flatten(
+        obj, is_leaf=lambda x: isinstance(x, Tensor)
+    )
+
+
+def _is_arr(x):
+    return isinstance(x, (jax.Array, np.ndarray)) or hasattr(x, "aval")
+
+
+class StaticFunction:
+    def __init__(self, fn, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=True, property=False,
+                 donate_state=True):
+        functools.update_wrapper(self, fn)
+        self._fn = fn
+        self._input_spec = input_spec
+        self._cache = {}
+        self._donate = donate_state
+
+    def _mode_sig(self):
+        return tuple(
+            sorted((id(l), l.training) for l in _registry.live_layers())
+        )
+
+    def __call__(self, *args, **kwargs):
+        arg_leaves, arg_tree = _tree_flatten((args, kwargs))
+        leaf_is_tensor = [isinstance(l, Tensor) for l in arg_leaves]
+        tensor_raws = [
+            l._data for l in arg_leaves if isinstance(l, Tensor)
+        ]
+        static_leaves = [
+            None if is_t else l
+            for l, is_t in zip(arg_leaves, leaf_is_tensor)
+        ]
+        arg_sg = [
+            l.stop_gradient if isinstance(l, Tensor) else None
+            for l in arg_leaves
+        ]
+
+        state = _registry.snapshot_state_tensors()
+        key = (
+            arg_tree,
+            tuple(
+                ("arr", tuple(r.shape), str(r.dtype))
+                for r in tensor_raws
+            ),
+            tuple(repr(s) for s in static_leaves),
+            tuple(t._uid for t in state),
+            self._mode_sig(),
+        )
+
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._make_entry(
+                state, arg_tree, leaf_is_tensor, static_leaves, arg_sg
+            )
+            self._cache[key] = entry
+
+        state_raws = [t._data for t in state]
+        out_arrs, new_state, grad_raws = entry["jitted"](
+            state_raws, tensor_raws
+        )
+        aux = entry["aux"]
+
+        for t, r in zip(state, new_state):
+            t._data = r
+        for i, g in zip(aux["grad_idx"], grad_raws):
+            t = state[i]
+            if t._grad is None:
+                t._grad = Tensor(g, stop_gradient=True)
+                t._grad.name = t.name + "@GRAD"
+            else:
+                t._grad._data = g
+
+        # reassemble outputs: array slots get fresh Tensors, static slots
+        # their recorded values
+        out_leaves = []
+        ai = 0
+        for kind, val in aux["out_slots"]:
+            if kind == "arr":
+                out_leaves.append(Tensor(out_arrs[ai]))
+                ai += 1
+            else:
+                out_leaves.append(val)
+        return jax.tree_util.tree_unflatten(aux["out_tree"], out_leaves)
+
+    def _make_entry(self, state, arg_tree, leaf_is_tensor, static_leaves,
+                    arg_sg):
+        fn = self._fn
+        aux = {"out_tree": None, "out_slots": None, "grad_idx": []}
+        n_state_before = len(state)
+
+        def pure(state_raws, tensor_raws):
+            saved = [(t, t._data, t._grad) for t in state]
+            for t, r in zip(state, state_raws):
+                t._data = r
+                t._grad = None
+            try:
+                it = iter(tensor_raws)
+                full_leaves = []
+                for is_t, sl, sg in zip(
+                    leaf_is_tensor, static_leaves, arg_sg
+                ):
+                    if is_t:
+                        nt = Tensor(next(it))
+                        nt.stop_gradient = sg
+                        full_leaves.append(nt)
+                    else:
+                        full_leaves.append(sl)
+                args, kwargs = jax.tree_util.tree_unflatten(
+                    arg_tree, full_leaves
+                )
+                outs = fn(*args, **kwargs)
+
+                out_leaves, out_tree = _tree_flatten(outs)
+                out_slots, out_arrs = [], []
+                for l in out_leaves:
+                    if isinstance(l, Tensor):
+                        out_slots.append(("arr", None))
+                        out_arrs.append(l._data)
+                    elif _is_arr(l):
+                        out_slots.append(("arr", None))
+                        out_arrs.append(l)
+                    else:
+                        out_slots.append(("static", l))
+                grad_idx = [
+                    i for i, t in enumerate(state)
+                    if isinstance(t, EagerParamBase) and t._grad is not None
+                ]
+                grad_raws = [state[i]._grad._data for i in grad_idx]
+                aux["out_tree"] = out_tree
+                aux["out_slots"] = out_slots
+                aux["grad_idx"] = grad_idx
+
+                post = _registry.snapshot_state_tensors()
+                if len(post) != n_state_before:
+                    raise RuntimeError(
+                        "to_static: new persistent state was created inside "
+                        "the traced function (e.g. a lazily-built layer or "
+                        "optimizer accumulator). Build all layers/optimizers "
+                        "before the first compiled call."
+                    )
+                new_state = [t._data for t in state]
+                return out_arrs, new_state, grad_raws
+            finally:
+                for t, d, g in saved:
+                    t._data = d
+                    t._grad = g
+
+        donate = (0,) if (
+            self._donate and jax.default_backend() != "cpu"
+        ) else ()
+        jitted = jax.jit(pure, donate_argnums=donate)
+        return {"jitted": jitted, "aux": aux}
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    def decorate(fn):
+        if isinstance(fn, StaticFunction):
+            return fn
+        return StaticFunction(fn, input_spec=input_spec,
+                              build_strategy=build_strategy,
+                              backend=backend, **kwargs)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+def enable_to_static(flag: bool):
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(flag)
+
+
+_TO_STATIC_ENABLED = True
+
+
+class ignore_module:
+    def __init__(self, modules):
+        pass

@@ -1,0 +1,124 @@
+"""paddle_tpu.metric (upstream: python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = np.asarray(pred._data) if isinstance(pred, Tensor) else np.asarray(pred)
+        label_np = np.asarray(label._data) if isinstance(label, Tensor) else np.asarray(label)
+        topk_idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        if label_np.ndim == pred_np.ndim:
+            label_np = label_np.squeeze(-1)
+        correct = topk_idx == label_np[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        c = np.asarray(correct._data) if isinstance(correct, Tensor) else np.asarray(correct)
+        num_samples = c.shape[0]
+        accs = []
+        for k in self.topk:
+            num_corrects = c[..., :k].sum()
+            accs.append(float(num_corrects) / max(num_samples, 1))
+            self.total[self.topk.index(k)] += float(c[..., :k].sum())
+            self.count[self.topk.index(k)] += num_samples
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [
+            t / max(c, 1) for t, c in zip(self.total, self.count)
+        ]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._data if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels._data if isinstance(labels, Tensor) else labels)
+        p = (p > 0.5).astype(np.int32).reshape(-1)
+        l = l.reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        return self.tp / max(self.tp + self.fp, 1)
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._data if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels._data if isinstance(labels, Tensor) else labels)
+        p = (p > 0.5).astype(np.int32).reshape(-1)
+        l = l.reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        return self.tp / max(self.tp + self.fn, 1)
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1):
+    pred_np = np.asarray(input._data)
+    label_np = np.asarray(label._data)
+    topk_idx = np.argsort(-pred_np, axis=-1)[..., :k]
+    if label_np.ndim == pred_np.ndim:
+        label_np = label_np.squeeze(-1)
+    correct = (topk_idx == label_np[..., None]).any(-1)
+    return Tensor(np.asarray(correct.mean(), np.float32))

@@ -1,0 +1,26 @@
+"""paddle_tpu.nn.functional (upstream: python/paddle/nn/functional/)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import (  # noqa: F401
+    conv1d,
+    conv1d_transpose,
+    conv2d,
+    conv2d_transpose,
+    conv3d,
+    conv3d_transpose,
+)
+from .norm import (  # noqa: F401
+    batch_norm,
+    group_norm,
+    instance_norm,
+    layer_norm,
+    local_response_norm,
+    rms_norm,
+)
+from .pooling import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    scaled_dot_product_attention,
+    sdp_kernel,
+)

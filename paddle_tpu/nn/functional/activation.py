@@ -1,0 +1,194 @@
+"""Activation functionals (upstream: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op, _as_tensor
+
+
+def _unary(name, jfn):
+    def op(x, name=None):
+        return apply_op(name, jfn, _as_tensor(x))
+
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu_ = relu
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+swish = silu
+mish = _unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+hardswish = _unary("hardswish", jax.nn.hard_swish)
+hardsigmoid = _unary(
+    "hardsigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+)
+tanhshrink = _unary("tanhshrink", lambda x: x - jnp.tanh(x))
+softsign = _unary("softsign", jax.nn.soft_sign)
+
+
+def gelu(x, approximate=False, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "gelu", lambda a: jax.nn.gelu(a, approximate=bool(approximate)), x
+    )
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x
+    )
+
+
+def elu(x, alpha=1.0, name=None):
+    x = _as_tensor(x)
+    return apply_op("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    x = _as_tensor(x)
+    return apply_op("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "selu",
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+        x,
+    )
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = _as_tensor(x), _as_tensor(weight)
+
+    def f(a, w):
+        if w.size > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, a * w)
+
+    return apply_op("prelu", f, x, weight)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "softplus",
+        lambda a: jnp.where(
+            a * beta > threshold, a, jax.nn.softplus(a * beta) / beta
+        ),
+        x,
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "softshrink",
+        lambda a: jnp.where(
+            a > threshold, a - threshold,
+            jnp.where(a < -threshold, a + threshold, jnp.zeros_like(a)),
+        ),
+        x,
+    )
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "hardshrink",
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, jnp.zeros_like(a)),
+        x,
+    )
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    x = _as_tensor(x)
+    return apply_op("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "thresholded_relu",
+        lambda a: jnp.where(a > threshold, a, jnp.full_like(a, value)),
+        x,
+    )
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "softmax", lambda a: jax.nn.softmax(a, axis=int(axis)), x
+    )
+
+
+softmax_ = softmax
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "log_softmax", lambda a: jax.nn.log_softmax(a, axis=int(axis)), x
+    )
+
+
+def log_sigmoid(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = (
+            a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        )
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return apply_op("maxout", f, x)
+
+
+def glu(x, axis=-1, name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+
+    return apply_op("glu", f, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_key
+
+    x = _as_tensor(x)
+    k = next_key()
+
+    def f(a):
+        g = jax.random.gumbel(k, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(
+                y_hard, idx, jnp.ones_like(idx, y.dtype), axis=axis,
+                inplace=False,
+            ) if hasattr(jnp, "put_along_axis") else jax.nn.one_hot(
+                jnp.squeeze(idx, axis), y.shape[axis], axis=axis, dtype=y.dtype
+            )
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return apply_op("gumbel_softmax", f, x)

@@ -1,0 +1,276 @@
+"""Common functionals: linear, dropout, pad, embedding, interpolate
+(upstream: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op, _as_tensor
+from ...framework.random import next_key
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Paddle weight layout is [in, out] (note: NOT the
+    torch transpose) — lowers to one dot_general on the MXU."""
+    x, weight = _as_tensor(x), _as_tensor(weight)
+    if bias is not None:
+        bias = _as_tensor(bias)
+        return apply_op(
+            "linear", lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias
+        )
+    return apply_op("linear", lambda a, w: jnp.matmul(a, w), x, weight)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = _as_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op("dropout_infer", lambda a: a * (1 - p), x)
+        return x.clone() if p == 0.0 or not training else x
+    k = next_key()
+    rate = float(p)
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(k, 1.0 - rate, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - rate), jnp.zeros_like(a))
+        return jnp.where(keep, a, jnp.zeros_like(a))
+
+    return apply_op("dropout", f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = _as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    k = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        q = 1.0 - p
+        coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return coef_a * jnp.where(keep, a, jnp.full_like(a, alpha_p)) + coef_b
+
+    return apply_op("alpha_dropout", f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _as_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in np.asarray(pad._data)]
+    pad = [int(p) for p in pad]
+
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-form: [d0_lo, d0_hi, d1_lo, d1_hi, ...] paddle uses per-dim pairs
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial: pads innermost spatial dims (paddle semantics: the pad
+        # list covers the spatial dims per data_format, last-dim-first pairs)
+        cfg = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial = list(range(2, nd))
+        else:
+            spatial = list(range(1, nd - 1))
+        pairs = [(pad[i], pad[i + 1]) for i in range(0, len(pad), 2)]
+        for dim, pr in zip(reversed(spatial), pairs):
+            cfg[dim] = pr
+
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def f(a):
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+
+    return apply_op("pad", f, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = _as_tensor(x), _as_tensor(weight)
+
+    def f(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros_like(out), out)
+        return out
+
+    return apply_op("embedding", f, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...tensor.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = _as_tensor(label)
+    eps = float(epsilon)
+
+    def f(l):
+        k = l.shape[-1]
+        return (1 - eps) * l + eps / k
+
+    return apply_op("label_smooth", f, label)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = _as_tensor(x)
+    nchw = data_format in ("NCHW", "NCW", "NCDHW")
+    spatial_ndim = x.ndim - 2
+    in_spatial = x.shape[2:] if nchw else x.shape[1:-1]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in np.asarray(size._data)]
+        out_spatial = [
+            int(s.item()) if isinstance(s, Tensor) else int(s) for s in (
+                size if isinstance(size, (list, tuple)) else [size]
+            )
+        ]
+    else:
+        if isinstance(scale_factor, (list, tuple)):
+            out_spatial = [
+                int(s * f) for s, f in zip(in_spatial, scale_factor)
+            ]
+        else:
+            out_spatial = [int(s * scale_factor) for s in in_spatial]
+
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def f(a):
+        if nchw:
+            shape = list(a.shape[:2]) + out_spatial
+        else:
+            shape = [a.shape[0]] + out_spatial + [a.shape[-1]]
+        return jax.image.resize(a, tuple(shape), method=method)
+
+    return apply_op("interpolate", f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = _as_tensor(x)
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(
+                    a[:, :, di:di + oh * st[0]:st[0], dj:dj + ow * st[1]:st[1]]
+                )
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return apply_op("unfold", f, x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = _as_tensor(x1), _as_tensor(x2)
+
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply_op("cosine_similarity", f, x1, x2)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return apply_op("normalize", f, x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = _as_tensor(x1), _as_tensor(x2), _as_tensor(weight)
+
+    def f(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    if bias is not None:
+        return apply_op("bilinear", f, x1, x2, weight, _as_tensor(bias))
+    return apply_op("bilinear", f, x1, x2, weight)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = _as_tensor(x)
+    r = upscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        oc = c // (r * r)
+        a = a.reshape(n, oc, r, r, h, w)
+        a = a.transpose(0, 1, 4, 2, 5, 3)
+        return a.reshape(n, oc, h * r, w * r)
+
+    return apply_op("pixel_shuffle", f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = _as_tensor(x)
+    r = downscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(n, c * r * r, h // r, w // r)
+
+    return apply_op("pixel_unshuffle", f, x)

@@ -1,0 +1,164 @@
+"""Convolution functionals (upstream: python/paddle/nn/functional/conv.py).
+
+Lowered to ``lax.conv_general_dilated`` — XLA maps these onto the MXU
+(im2col-free systolic convolution). Paddle weight layout [O, I/g, *k]
+is exactly lax 'OIHW', so no transposes are needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op, _as_tensor
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, n, stride, dilation, ksize):
+    """Normalize paddle padding spec → lax padding list or string."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, (list, tuple)):
+        pads = [int(p) for p in padding]
+        if len(pads) == n:
+            return [(p, p) for p in pads]
+        if len(pads) == 2 * n:
+            return [(pads[2 * i], pads[2 * i + 1]) for i in range(n)]
+        if len(pads) == 1:
+            return [(pads[0], pads[0])] * n
+    return [(int(padding), int(padding))] * n
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, op_name):
+    x, weight = _as_tensor(x), _as_tensor(weight)
+    stride = _pair(stride, n)
+    dilation = _pair(dilation, n)
+    ksize = weight.shape[2:]
+    pad = _padding(padding, n, stride, dilation, ksize)
+    channels_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+
+    spatial = "DHW"[3 - n:] if n <= 3 else None
+    if channels_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, out_spec)
+    )
+
+    def f(a, w, *bb):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if bb:
+            b = bb[0]
+            shape = [1] * out.ndim
+            ch_axis = out.ndim - 1 if channels_last else 1
+            shape[ch_axis] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply_op(op_name, f, x, weight, _as_tensor(bias))
+    return apply_op(op_name, f, x, weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NWC" if data_format == "NLC" else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 fmt, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format, op_name):
+    x, weight = _as_tensor(x), _as_tensor(weight)
+    stride = _pair(stride, n)
+    dilation = _pair(dilation, n)
+    opad = _pair(output_padding, n)
+    ksize = weight.shape[2:]
+    pad = _padding(padding, n, stride, dilation, ksize)
+    channels_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    spatial = "DHW"[3 - n:]
+    lhs_spec = ("N" + spatial + "C") if channels_last else ("NC" + spatial)
+    # paddle transpose-conv weight layout: [in_c, out_c/g, *k] = "IO" + spatial
+    rhs_spec = "IO" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, lhs_spec)
+    )
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        # transpose conv: effective padding = k - 1 - p (per side) with lhs dilation
+        pad_cfg = [
+            (
+                dilation[i] * (ksize[i] - 1) - pad[i][0],
+                dilation[i] * (ksize[i] - 1) - pad[i][1] + opad[i],
+            )
+            for i in range(n)
+        ]
+
+    def f(a, w, *bb):
+        w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=(1,) * n, padding=pad_cfg,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups,
+        )
+        if bb:
+            b = bb[0]
+            shape = [1] * out.ndim
+            ch_axis = out.ndim - 1 if channels_last else 1
+            shape[ch_axis] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply_op(op_name, f, x, weight, _as_tensor(bias))
+    return apply_op(op_name, f, x, weight)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    fmt = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, fmt, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format,
+                           "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format,
+                           "conv3d_transpose")

@@ -1,0 +1,75 @@
+"""Attention functionals (upstream: python/paddle/nn/functional/
+flash_attention.py) — backed by the Pallas TPU kernel."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op, _as_tensor
+from ...ops.kernels.flash_attention import flash_attention as _flash
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """q/k/v: [batch, seq, num_heads, head_dim] (reference layout)."""
+    query, key, value = _as_tensor(query), _as_tensor(key), _as_tensor(value)
+    out = apply_op(
+        "flash_attention",
+        lambda q, k, v: _flash(q, k, v, causal=causal),
+        query, key, value,
+    )
+    return out, None
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError(
+        "varlen flash attention: use flash_attention with padding masks "
+        "(ragged TPU kernel tracked as a follow-up)"
+    )
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """q/k/v: [batch, seq, heads, head_dim]."""
+    query, key, value = _as_tensor(query), _as_tensor(key), _as_tensor(value)
+    if attn_mask is None:
+        return apply_op(
+            "sdpa",
+            lambda q, k, v: _flash(q, k, v, causal=is_causal),
+            query, key, value,
+        )
+    attn_mask = _as_tensor(attn_mask)
+
+    def f(q, k, v, m):
+        d = q.shape[-1]
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) / math.sqrt(d)
+        if m.dtype == jnp.bool_:
+            s = jnp.where(m, s, -1e30)
+        else:
+            s = s + m.astype(jnp.float32)
+        if is_causal:
+            sq, sk = s.shape[-2], s.shape[-1]
+            cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            s = jnp.where(cm, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    return apply_op("sdpa", f, query, key, value, attn_mask)
+
+
+def sdp_kernel(*args, **kwargs):
+    class _Noop:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    return _Noop()

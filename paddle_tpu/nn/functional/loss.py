@@ -1,0 +1,287 @@
+"""Loss functionals (upstream: python/paddle/nn/functional/loss.py).
+
+cross_entropy follows the reference's fused softmax+CE semantics
+(upstream kernel: paddle/phi/kernels/gpu/cross_entropy_kernel.cu):
+log_softmax and gather fused in one XLA computation, fp32 accumulation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op, _as_tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    input, label = _as_tensor(input), _as_tensor(label)
+
+    def f(logits, lab, *w):
+        ax = axis % logits.ndim
+        lf = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lf, axis=ax) if use_softmax else jnp.log(
+            jnp.maximum(lf, 1e-30)
+        )
+        n_classes = logits.shape[ax]
+        if soft_label:
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                soft = (1 - label_smoothing) * soft + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=ax)
+        else:
+            lab_i = lab
+            if lab_i.ndim == logits.ndim:
+                lab_i = jnp.squeeze(lab_i, axis=ax)
+            lab_i = lab_i.astype(jnp.int32)
+            valid = lab_i != ignore_index
+            safe = jnp.where(valid, lab_i, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, ax), axis=ax
+            ).squeeze(ax)
+            if label_smoothing > 0.0:
+                smooth_loss = -jnp.mean(logp, axis=ax)
+                loss = (
+                    -(1 - label_smoothing) * picked
+                    + label_smoothing * smooth_loss
+                )
+            else:
+                loss = -picked
+            loss = jnp.where(valid, loss, 0.0)
+            if w:
+                wt = jnp.take(w[0].astype(jnp.float32), safe)
+                wt = jnp.where(valid, wt, 0.0)
+                loss = loss * wt
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+            if reduction == "mean":
+                cnt = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+                return jnp.sum(loss) / cnt
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(_as_tensor(weight))
+    return apply_op("cross_entropy", f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    from .activation import softmax as _softmax
+    from ...tensor.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input, label = _as_tensor(input), _as_tensor(label)
+
+    def f(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, 1), axis=1
+        ).squeeze(1)
+        loss = jnp.where(valid, -picked, 0.0)
+        if w:
+            wt = jnp.take(w[0], safe)
+            loss = loss * jnp.where(valid, wt, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.sum(jnp.where(valid, wt, 0.0))
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0
+            )
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(_as_tensor(weight))
+    return apply_op("nll_loss", f, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = _as_tensor(input), _as_tensor(label)
+    return apply_op(
+        "mse_loss",
+        lambda a, b: _reduce(jnp.square(a - b), reduction),
+        input, label,
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = _as_tensor(input), _as_tensor(label)
+    return apply_op(
+        "l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction),
+        input, label,
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = _as_tensor(input), _as_tensor(label)
+
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(
+            d < delta, 0.5 * d * d / delta, d - 0.5 * delta
+        ) * delta
+        # paddle: huber-style with delta scaling; mean over all elements
+        return _reduce(
+            jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta)),
+            reduction,
+        )
+
+    return apply_op("smooth_l1_loss", f, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    input, label = _as_tensor(input), _as_tensor(label)
+
+    def f(p, y, *w):
+        p = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-7)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(_as_tensor(weight))
+    return apply_op("binary_cross_entropy", f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    logit, label = _as_tensor(logit), _as_tensor(label)
+
+    def f(z, y, *rest):
+        zf = z.astype(jnp.float32)
+        yf = y.astype(jnp.float32)
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        loss = jnp.maximum(zf, 0) - zf * yf + jnp.log1p(jnp.exp(-jnp.abs(zf)))
+        i = 0
+        if pos_weight is not None:
+            pw = rest[i]
+            i += 1
+            log_w = (pw - 1) * yf + 1
+            loss = loss * log_w
+        if weight is not None:
+            loss = loss * rest[i]
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if pos_weight is not None:
+        args.append(_as_tensor(pos_weight))
+    if weight is not None:
+        args.append(_as_tensor(weight))
+    return apply_op("bce_with_logits", f, *args)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    input, label = _as_tensor(input), _as_tensor(label)
+
+    def f(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply_op("kl_div", f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    input, other, label = _as_tensor(input), _as_tensor(other), _as_tensor(label)
+    return apply_op(
+        "margin_ranking_loss",
+        lambda a, b, y: _reduce(
+            jnp.maximum(-y * (a - b) + margin, 0.0), reduction
+        ),
+        input, other, label,
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    input1, input2, label = (
+        _as_tensor(input1), _as_tensor(input2), _as_tensor(label)
+    )
+
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+
+    return apply_op("cosine_embedding_loss", f, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    input, positive, negative = (
+        _as_tensor(input), _as_tensor(positive), _as_tensor(negative)
+    )
+
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op("triplet_margin_loss", f, input, positive, negative)
+
+
+def square_error_cost(input, label):
+    input, label = _as_tensor(input), _as_tensor(label)
+    return apply_op(
+        "square_error_cost", lambda a, b: jnp.square(a - b), input, label
+    )
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit, label = _as_tensor(logit), _as_tensor(label)
+
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if normalizer is not None:
+        args.append(_as_tensor(normalizer))
+    return apply_op("sigmoid_focal_loss", f, *args)
+
+
+def ctc_loss(*args, **kwargs):
+    raise NotImplementedError(
+        "ctc_loss is not yet implemented in paddle_tpu (tracked gap)"
+    )

@@ -1,0 +1,219 @@
+"""Normalization functionals
+(upstream: python/paddle/nn/functional/norm.py; the fused GPU kernels
+paddle/phi/kernels/gpu/{layer_norm,rms_norm}_kernel.cu map here to XLA
+fusions, with a Pallas fast path for rms_norm/layer_norm on TPU)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op, _as_tensor
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = _as_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    def body(a, *wb):
+        # compute statistics in fp32 (matches the reference's Welford fp32
+        # accumulation in layer_norm_kernel.cu), cast back at the end
+        dt = a.dtype
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(af - mean), axis=axes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(dt)
+
+    args = [x]
+    if weight is not None:
+        args.append(_as_tensor(weight))
+    if bias is not None:
+        args.append(_as_tensor(bias))
+    return apply_op("layer_norm", body, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (upstream kernel: paddle/phi/kernels/gpu/rms_norm_kernel.cu).
+    Uses the Pallas fused kernel on TPU when enabled."""
+    x = _as_tensor(x)
+    from ...ops.kernels import rms_norm as _k
+
+    if weight is not None:
+        w = _as_tensor(weight)
+        return apply_op("rms_norm", lambda a, ww: _k.rms_norm(a, ww, epsilon), x, w)
+    return apply_op("rms_norm", lambda a: _k.rms_norm(a, None, epsilon), x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    x = _as_tensor(x)
+    running_mean = _as_tensor(running_mean)
+    running_var = _as_tensor(running_var)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # functional stats update: new running stats computed here and
+        # written back to the buffer tensors (captured as state by jit)
+        def stats(a):
+            af = a.astype(jnp.float32)
+            m = jnp.mean(af, axis=reduce_axes)
+            v = jnp.var(af, axis=reduce_axes)
+            return m, v
+
+        m_new, v_new = stats(x._data)
+        n = 1
+        for i in reduce_axes:
+            n *= x.shape[i]
+        unbiased = v_new * (n / max(n - 1, 1))
+        running_mean._data = (
+            momentum * running_mean._data.astype(jnp.float32)
+            + (1 - momentum) * m_new
+        ).astype(running_mean._data.dtype)
+        running_var._data = (
+            momentum * running_var._data.astype(jnp.float32)
+            + (1 - momentum) * unbiased
+        ).astype(running_var._data.dtype)
+
+        def body(a, *wb):
+            dt = a.dtype
+            af = a.astype(jnp.float32)
+            m = jnp.mean(af, axis=reduce_axes, keepdims=True)
+            v = jnp.mean(jnp.square(af - m), axis=reduce_axes, keepdims=True)
+            out = (af - m) * jax.lax.rsqrt(v + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].astype(jnp.float32).reshape(bshape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].astype(jnp.float32).reshape(bshape)
+            return out.astype(dt)
+
+        args = [x]
+    else:
+        def body(a, m, v, *wb):
+            dt = a.dtype
+            af = a.astype(jnp.float32)
+            out = (
+                af - m.astype(jnp.float32).reshape(bshape)
+            ) * jax.lax.rsqrt(v.astype(jnp.float32).reshape(bshape) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].astype(jnp.float32).reshape(bshape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].astype(jnp.float32).reshape(bshape)
+            return out.astype(dt)
+
+        args = [x, running_mean, running_var]
+
+    if weight is not None:
+        args.append(_as_tensor(weight))
+    if bias is not None:
+        args.append(_as_tensor(bias))
+    return apply_op("batch_norm", body, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-05, data_format="NCHW", name=None):
+    x = _as_tensor(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(
+        i for i in range(x.ndim) if i not in (0, ch_axis)
+    )
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    def body(a, *wb):
+        dt = a.dtype
+        af = a.astype(jnp.float32)
+        m = jnp.mean(af, axis=reduce_axes, keepdims=True)
+        v = jnp.mean(jnp.square(af - m), axis=reduce_axes, keepdims=True)
+        out = (af - m) * jax.lax.rsqrt(v + eps)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(bshape)
+        return out.astype(dt)
+
+    args = [x]
+    if weight is not None:
+        args.append(_as_tensor(weight))
+    if bias is not None:
+        args.append(_as_tensor(bias))
+    return apply_op("instance_norm", body, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _as_tensor(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+
+    def body(a, *wb):
+        dt = a.dtype
+        af = a.astype(jnp.float32)
+        if ch_axis != 1:
+            af = jnp.moveaxis(af, ch_axis, 1)
+        n, c = af.shape[0], af.shape[1]
+        rest = af.shape[2:]
+        g = af.reshape(n, num_groups, c // num_groups, *rest)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        v = jnp.mean(jnp.square(g - m), axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(v + epsilon)).reshape(n, c, *rest)
+        bshape = [1, c] + [1] * len(rest)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(bshape)
+        if ch_axis != 1:
+            out = jnp.moveaxis(out, 1, ch_axis)
+        return out.astype(dt)
+
+    args = [x]
+    if weight is not None:
+        args.append(_as_tensor(weight))
+    if bias is not None:
+        args.append(_as_tensor(bias))
+    return apply_op("group_norm", body, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = _as_tensor(x)
+
+    def body(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        sq = jnp.moveaxis(sq, ch_axis, -1)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(
+            sq, [(0, 0)] * (sq.ndim - 1) + [(pad_lo, pad_hi)]
+        )
+        win = jnp.stack(
+            [padded[..., i:i + sq.shape[-1]] for i in range(size)], axis=-1
+        ).sum(-1)
+        win = jnp.moveaxis(win, -1, ch_axis)
+        return a / jnp.power(k + alpha * win, beta)
+
+    return apply_op("local_response_norm", body, x)

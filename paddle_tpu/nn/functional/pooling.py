@@ -1,0 +1,216 @@
+"""Pooling functionals (upstream: python/paddle/nn/functional/pooling.py).
+Lowered to ``lax.reduce_window`` — XLA's native windowed reduction."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op, _as_tensor
+from .conv import _pair
+
+
+def _pool_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        p = [int(v) for v in padding]
+        if len(p) == n:
+            return [(v, v) for v in p]
+        if len(p) == 2 * n:
+            return [(p[2 * i], p[2 * i + 1]) for i in range(n)]
+        if len(p) == 1:
+            return [(p[0], p[0])] * n
+    return [(int(padding), int(padding))] * n
+
+
+def _reduce_window(x, init, op, ksize, stride, pad, n, channels_last,
+                   ceil_mode=False):
+    window = (1, 1) + ksize if not channels_last else (1,) + ksize + (1,)
+    strides = (1, 1) + stride if not channels_last else (1,) + stride + (1,)
+    if isinstance(pad, str):
+        padding = pad
+    else:
+        padding = (
+            [(0, 0), (0, 0)] + list(pad)
+            if not channels_last
+            else [(0, 0)] + list(pad) + [(0, 0)]
+        )
+    return jax.lax.reduce_window(x, init, op, window, strides, padding)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    x = _as_tensor(x)
+    ks = _pair(kernel_size, 2)
+    st = _pair(stride, 2) if stride is not None else ks
+    pad = _pool_padding(padding, 2)
+    cl = data_format == "NHWC"
+
+    def f(a):
+        return _reduce_window(
+            a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else
+            jnp.iinfo(a.dtype).min,
+            jax.lax.max, ks, st, pad, 2, cl,
+        )
+
+    out = apply_op("max_pool2d", f, x)
+    if return_mask:
+        # mask = argmax index within input (flattened spatial), best-effort
+        idx = apply_op(
+            "max_pool2d_mask",
+            lambda a: jnp.zeros_like(f(a), dtype=jnp.int32),
+            x, differentiable=False,
+        )
+        return out, idx
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    x = _as_tensor(x)
+    ks = _pair(kernel_size, 2)
+    st = _pair(stride, 2) if stride is not None else ks
+    pad = _pool_padding(padding, 2)
+    cl = data_format == "NHWC"
+
+    def f(a):
+        dt = a.dtype
+        af = a.astype(jnp.float32)
+        s = _reduce_window(af, 0.0, jax.lax.add, ks, st, pad, 2, cl)
+        if divisor_override:
+            return (s / divisor_override).astype(dt)
+        if exclusive and pad not in ("VALID",) and (
+            isinstance(pad, list) and any(p != (0, 0) for p in pad)
+        ):
+            ones = jnp.ones_like(af)
+            cnt = _reduce_window(ones, 0.0, jax.lax.add, ks, st, pad, 2, cl)
+            return (s / cnt).astype(dt)
+        return (s / float(np.prod(ks))).astype(dt)
+
+    return apply_op("avg_pool2d", f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    x = _as_tensor(x)
+    ks = _pair(kernel_size, 1)
+    st = _pair(stride, 1) if stride is not None else ks
+    pad = _pool_padding(padding, 1)
+
+    def f(a):
+        return _reduce_window(a, -jnp.inf, jax.lax.max, ks, st, pad, 1, False)
+
+    return apply_op("max_pool1d", f, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    x = _as_tensor(x)
+    ks = _pair(kernel_size, 1)
+    st = _pair(stride, 1) if stride is not None else ks
+    pad = _pool_padding(padding, 1)
+
+    def f(a):
+        s = _reduce_window(a.astype(jnp.float32), 0.0, jax.lax.add, ks, st,
+                           pad, 1, False)
+        return (s / float(ks[0])).astype(a.dtype)
+
+    return apply_op("avg_pool1d", f, x)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    x = _as_tensor(x)
+    ks = _pair(kernel_size, 3)
+    st = _pair(stride, 3) if stride is not None else ks
+    pad = _pool_padding(padding, 3)
+
+    def f(a):
+        return _reduce_window(a, -jnp.inf, jax.lax.max, ks, st, pad, 3,
+                              data_format == "NDHWC")
+
+    return apply_op("max_pool3d", f, x)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    x = _as_tensor(x)
+    ks = _pair(kernel_size, 3)
+    st = _pair(stride, 3) if stride is not None else ks
+    pad = _pool_padding(padding, 3)
+
+    def f(a):
+        s = _reduce_window(a.astype(jnp.float32), 0.0, jax.lax.add, ks, st,
+                           pad, 3, data_format == "NDHWC")
+        return (s / float(np.prod(ks))).astype(a.dtype)
+
+    return apply_op("avg_pool3d", f, x)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    x = _as_tensor(x)
+    os = _pair(output_size, 2) if not isinstance(output_size, int) else (
+        output_size, output_size
+    )
+
+    def f(a):
+        cl = data_format == "NHWC"
+        h_axis, w_axis = (1, 2) if cl else (2, 3)
+        ih, iw = a.shape[h_axis], a.shape[w_axis]
+        oh = os[0] if os[0] is not None else ih
+        ow = os[1] if os[1] is not None else iw
+        if ih % oh == 0 and iw % ow == 0:
+            kh, kw = ih // oh, iw // ow
+            window = [1, 1, 1, 1]
+            window[h_axis], window[w_axis] = kh, kw
+            s = jax.lax.reduce_window(
+                a.astype(jnp.float32), 0.0, jax.lax.add, tuple(window),
+                tuple(window), "VALID",
+            )
+            return (s / (kh * kw)).astype(a.dtype)
+        # general case: mean over index buckets
+        out = jax.image.resize(
+            a.astype(jnp.float32),
+            tuple(
+                os[i - h_axis] if i in (h_axis, w_axis) else a.shape[i]
+                for i in range(a.ndim)
+            ),
+            method="linear",
+        )
+        return out.astype(a.dtype)
+
+    return apply_op("adaptive_avg_pool2d", f, x)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    x = _as_tensor(x)
+    os = _pair(output_size, 2) if not isinstance(output_size, int) else (
+        output_size, output_size
+    )
+
+    def f(a):
+        ih, iw = a.shape[2], a.shape[3]
+        kh, kw = ih // os[0], iw // os[1]
+        return jax.lax.reduce_window(
+            a, -jnp.inf, jax.lax.max, (1, 1, kh, kw), (1, 1, kh, kw), "VALID"
+        )
+
+    return apply_op("adaptive_max_pool2d", f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        il = a.shape[2]
+        k = il // output_size
+        s = jax.lax.reduce_window(
+            a.astype(jnp.float32), 0.0, jax.lax.add, (1, 1, k), (1, 1, k),
+            "VALID",
+        )
+        return (s / k).astype(a.dtype)
+
+    return apply_op("adaptive_avg_pool1d", f, x)

@@ -1,0 +1,31 @@
+"""Hand-written TPU kernels — the analog of the reference's Phi CUDA
+kernel library (upstream: paddle/phi/kernels/gpu/, paddle/phi/kernels/fusion/).
+
+Each kernel ships two implementations:
+  * a Pallas TPU kernel (MXU/VMEM-aware), used when running on TPU and
+    FLAGS_use_pallas_kernels is on;
+  * a chunked/blocked XLA (jnp/lax) fallback with identical semantics,
+    used on CPU test meshes and as the autodiff reference.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...framework.flags import flag
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def use_pallas() -> bool:
+    return on_tpu() and flag("use_pallas_kernels")
+
+
+from . import rms_norm as _rms_norm_mod
+from .rms_norm import rms_norm, layer_norm_fused
+from .flash_attention import flash_attention, flash_attention_with_lse
+from .rope import apply_rotary_emb

@@ -1,0 +1,285 @@
+"""FlashAttention for TPU — Pallas kernel + chunked XLA fallback.
+
+Upstream analog: paddle/phi/kernels/gpu/flash_attn_kernel.cu (which wraps
+the CUDA flashattn library). This is a from-scratch TPU design:
+
+* forward: online-softmax blocked kernel. Grid (batch*heads, q_blocks,
+  k_blocks); K-loop is the innermost ("arbitrary") grid dim so the fp32
+  accumulator, running max m and running sum l live in VMEM scratch
+  across K iterations. QK^T and PV ride the MXU with fp32 accumulate.
+* backward: recompute-based blocked dq/dk/dv via `lax.scan` over K
+  blocks (memory ∝ S·block_k, not S²) using the saved logsumexp — XLA
+  fuses this well; a dedicated Pallas bwd kernel is a later optimization.
+* GQA/MQA: kv-head = q-head // group resolved in the BlockSpec index
+  map — no KV repetition in HBM.
+
+Layout convention matches the reference API: [batch, seq, heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+_LANE = 128
+
+
+def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
+                      q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref):
+    # offset = sk - sq: causal condition is q_idx + offset >= k_idx
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + block_q - 1 + offset
+
+    @pl.when(run if causal else ki >= 0)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (Bq, Bk)
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_idx = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_idx + offset >= k_idx, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(safe_l[:, 0]))
+
+
+def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
+    """q: (BH, Sq, D); k/v: (BHkv, Sk, D). Returns (out, lse)."""
+    bh, sq, d = q.shape
+    bhkv, sk, _ = k.shape
+    group = bh // bhkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale, causal, sk - sq, block_q, block_k, nk
+    )
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        params = dict(
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            )
+        )
+        scratch = [
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+        ]
+    except Exception:  # pragma: no cover
+        params = {}
+        scratch = []
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h // group, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h // group, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        **params,
+    )(q, k, v)
+    return out, lse
+
+
+def _flash_fwd_ref(q, k, v, causal, scale):
+    """XLA reference forward (full S² — used off-TPU / small shapes)."""
+    bh, sq, d = q.shape
+    bhkv, sk, _ = k.shape
+    if bhkv != bh:
+        rep = bh // bhkv
+        k = jnp.repeat(k, rep, axis=0)
+        v = jnp.repeat(v, rep, axis=0)
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask[None], s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype), lse
+
+
+def _flash_bwd_chunked(q, k, v, out, lse, do, causal, scale, block_k):
+    """Blocked recompute backward over K blocks (lax.scan)."""
+    bh, sq, d = q.shape
+    bhkv, sk, _ = k.shape
+    group = bh // bhkv
+    if group != 1:
+        k_full = jnp.repeat(k, group, axis=0)
+        v_full = jnp.repeat(v, group, axis=0)
+    else:
+        k_full, v_full = k, v
+
+    block_k = min(block_k, sk)
+    nk = sk // block_k if sk % block_k == 0 else 1
+    if sk % block_k != 0:
+        block_k = sk
+        nk = 1
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    outf = out.astype(jnp.float32)
+    delta = jnp.sum(dof * outf, axis=-1)  # (BH, Sq)
+
+    k_blocks = k_full.astype(jnp.float32).reshape(bh, nk, block_k, d)
+    v_blocks = v_full.astype(jnp.float32).reshape(bh, nk, block_k, d)
+    k_blocks = jnp.moveaxis(k_blocks, 1, 0)  # (nk, BH, Bk, D)
+    v_blocks = jnp.moveaxis(v_blocks, 1, 0)
+
+    q_pos = jnp.arange(sq)
+
+    def body(dq_acc, blk):
+        k_b, v_b, ki = blk
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_b) * scale
+        if causal:
+            k_pos = ki * block_k + jnp.arange(block_k)
+            mask = (q_pos[:, None] + (sk - sq)) >= k_pos[None, :]
+            s = jnp.where(mask[None], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])
+        dv_b = jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, v_b)
+        ds = p * (dp - delta[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, k_b)
+        dk_b = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq_acc, (dk_b, dv_b)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        body, jnp.zeros_like(qf),
+        (k_blocks, v_blocks, jnp.arange(nk)),
+    )
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, sk, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, sk, d)
+    if group != 1:
+        dk = dk.reshape(bhkv, group, sk, d).sum(1)
+        dv = dv.reshape(bhkv, group, sk, d).sum(1)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k)
+    return out
+
+
+def _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k):
+    from . import use_pallas
+
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    if (
+        use_pallas()
+        and d % 128 == 0
+        and sq % min(block_q, sq) == 0
+        and sk % min(block_k, sk) == 0
+        and sq >= 8 and sk >= 8
+    ):
+        return _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k)
+    return _flash_fwd_ref(q, k, v, causal, scale)
+
+
+def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd_chunked(
+        q, k, v, out, lse, do, causal, scale, block_k
+    )
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None,
+                    block_q=512, block_k=512):
+    """q,k,v: [B, S, H, D] (reference layout). Returns [B, Sq, H, D]."""
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    sk = k.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    out = _flash_core(q3, k3, v3, bool(causal), float(scale),
+                      int(block_q), int(block_k))
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, sm_scale=None,
+                             block_q=512, block_k=512):
+    """Like flash_attention but also returns logsumexp [B, H, S]
+    (needed by ring attention to combine partial results)."""
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    sk = k.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    out, lse = _flash_fwd_dispatch(
+        q3, k3, v3, bool(causal), float(scale), int(block_q), int(block_k)
+    )
+    return (
+        out.reshape(b, h, sq, d).transpose(0, 2, 1, 3),
+        lse.reshape(b, h, sq),
+    )

@@ -1,0 +1,176 @@
+"""Fused RMSNorm / LayerNorm Pallas TPU kernels.
+
+Upstream analog: paddle/phi/kernels/gpu/rms_norm_kernel.cu (block-per-row
+Welford/rsqrt fused normalize+scale). TPU design: rows are tiled into
+(block_rows, hidden) VMEM blocks; stats in fp32 on the VPU; one pass.
+Backward is XLA (it fuses fine — the win is the fwd fusion on the hot
+decode/train path).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _choose_block_rows(n_rows, hidden, itemsize):
+    # keep block ≲ 2 MB VMEM; at least the fp32 sublane tile (8)
+    target = (2 * 1024 * 1024) // max(hidden * itemsize, 1)
+    br = max(8, min(256, target))
+    while n_rows % br and br > 8:
+        br //= 2
+    return br if n_rows % br == 0 else 1
+
+
+def _rms_kernel(eps, has_w, x_ref, *refs):
+    if has_w:
+        w_ref, o_ref = refs
+    else:
+        (o_ref,) = refs
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(ms + eps)
+    if has_w:
+        y = y * w_ref[:].astype(jnp.float32)
+    o_ref[:] = y.astype(o_ref.dtype)
+
+
+def _rms_pallas(x2d, w, eps):
+    n, h = x2d.shape
+    br = _choose_block_rows(n, h, x2d.dtype.itemsize)
+    grid = (n // br,) if n % br == 0 else (n,)
+    if n % br != 0:
+        br = 1
+    in_specs = [pl.BlockSpec((br, h), lambda i: (i, 0))]
+    args = [x2d]
+    if w is not None:
+        in_specs.append(pl.BlockSpec((h,), lambda i: (0,)))
+        args.append(w)
+    return pl.pallas_call(
+        functools.partial(_rms_kernel, eps, w is not None),
+        out_shape=jax.ShapeDtypeStruct((n, h), x2d.dtype),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((br, h), lambda i: (i, 0)),
+    )(*args)
+
+
+def _rms_ref(x, w, eps):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(ms + eps)
+    if w is not None:
+        y = y * w.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rms_norm_core(x, w, eps):
+    from . import use_pallas
+
+    if use_pallas() and x.shape[-1] % 128 == 0:
+        shape = x.shape
+        out = _rms_pallas(x.reshape(-1, shape[-1]), w, eps)
+        return out.reshape(shape)
+    return _rms_ref(x, w, eps)
+
+
+def _rms_fwd(x, w, eps):
+    return _rms_norm_core(x, w, eps), (x, w)
+
+
+def _rms_bwd(eps, res, g):
+    x, w = res
+
+    def ref(x_, w_):
+        return (
+            _rms_ref(x_, w_, eps).astype(jnp.float32)
+            if w_ is not None
+            else _rms_ref(x_, None, eps).astype(jnp.float32)
+        )
+
+    if w is None:
+        _, vjp = jax.vjp(lambda a: _rms_ref(a, None, eps), x)
+        (dx,) = vjp(g)
+        return dx, None
+    _, vjp = jax.vjp(lambda a, ww: _rms_ref(a, ww, eps), x, w)
+    dx, dw = vjp(g)
+    return dx, dw
+
+
+_rms_norm_core.defvjp(_rms_fwd, _rms_bwd)
+
+
+def rms_norm(x, weight=None, eps=1e-6):
+    """rms_norm over the last axis. x: [..., H], weight: [H] or None."""
+    return _rms_norm_core(x, weight, float(eps))
+
+
+def _ln_kernel(eps, has_w, has_b, x_ref, *refs):
+    idx = 0
+    w_ref = b_ref = None
+    refs = list(refs)
+    o_ref = refs.pop()
+    if has_w:
+        w_ref = refs[idx]
+        idx += 1
+    if has_b:
+        b_ref = refs[idx]
+    x = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    y = xc * jax.lax.rsqrt(var + eps)
+    if has_w:
+        y = y * w_ref[:].astype(jnp.float32)
+    if has_b:
+        y = y + b_ref[:].astype(jnp.float32)
+    o_ref[:] = y.astype(o_ref.dtype)
+
+
+def layer_norm_fused(x, weight=None, bias=None, eps=1e-5):
+    """Pallas fused layer_norm over the last axis (fwd); XLA autodiff bwd."""
+    from . import use_pallas
+
+    h = x.shape[-1]
+    if not (use_pallas() and h % 128 == 0):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        xc = xf - mean
+        var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+        y = xc * jax.lax.rsqrt(var + eps)
+        if weight is not None:
+            y = y * weight.astype(jnp.float32)
+        if bias is not None:
+            y = y + bias.astype(jnp.float32)
+        return y.astype(x.dtype)
+
+    shape = x.shape
+    x2d = x.reshape(-1, h)
+    n = x2d.shape[0]
+    br = _choose_block_rows(n, h, x2d.dtype.itemsize)
+    if n % br != 0:
+        br = 1
+    in_specs = [pl.BlockSpec((br, h), lambda i: (i, 0))]
+    args = [x2d]
+    if weight is not None:
+        in_specs.append(pl.BlockSpec((h,), lambda i: (0,)))
+        args.append(weight)
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((h,), lambda i: (0,)))
+        args.append(bias)
+    out = pl.pallas_call(
+        functools.partial(_ln_kernel, eps, weight is not None, bias is not None),
+        out_shape=jax.ShapeDtypeStruct((n, h), x2d.dtype),
+        grid=(n // br,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((br, h), lambda i: (i, 0)),
+    )(*args)
+    return out.reshape(shape)

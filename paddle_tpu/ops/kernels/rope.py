@@ -1,0 +1,42 @@
+"""Fused rotary position embedding
+(upstream analog: paddle/phi/kernels/fusion/gpu/fused_rope — the
+`fused_rotary_position_embedding` op). On TPU this is a pure-VPU
+elementwise fusion, so the jnp form IS the fused kernel after XLA; a
+Pallas version buys nothing here. Uses the NeoX/Llama "rotate_half"
+convention (matches the reference's use_neox_rotary_style=True default).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def build_rope_cache(seq_len, head_dim, base=10000.0, dtype=jnp.float32):
+    inv_freq = 1.0 / (
+        base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # (S, D/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # (S, D)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_emb(x, cos, sin, position_ids=None):
+    """x: [B, S, H, D]; cos/sin: [S_max, D] (or [S, D])."""
+    s = x.shape[1]
+    if position_ids is not None:
+        c = jnp.take(cos, position_ids, axis=0)  # [B, S, D] or [S, D]
+        sn = jnp.take(sin, position_ids, axis=0)
+        if c.ndim == 2:
+            c, sn = c[None], sn[None]
+        c, sn = c[:, :, None, :], sn[:, :, None, :]
+    else:
+        c = cos[:s][None, :, None, :]
+        sn = sin[:s][None, :, None, :]
+    xf = x.astype(jnp.float32)
+    out = xf * c.astype(jnp.float32) + _rotate_half(xf) * sn.astype(jnp.float32)
+    return out.astype(x.dtype)

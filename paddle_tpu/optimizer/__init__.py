@@ -1,0 +1,5 @@
+"""paddle_tpu.optimizer (upstream: python/paddle/optimizer/)."""
+from . import lr  # noqa
+from .adamw import Adam, AdamW  # noqa
+from .momentum import Adagrad, Lamb, Momentum, RMSProp, SGD  # noqa
+from .optimizer import Optimizer  # noqa

@@ -1,0 +1,105 @@
+"""AdamW / Adam (upstream: python/paddle/optimizer/adamw.py, adam.py;
+CUDA kernel analog: paddle/phi/kernels/gpu/adamw_kernel.cu).
+
+The per-param update is one fused XLA expression (multiply-adds + rsqrt)
+— under the compiled train step XLA fuses all parameters' updates into
+few kernels, which is what the reference's multi_tensor fused adamw
+achieves with a hand-written CUDA kernel.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .optimizer import Optimizer
+
+
+class AdamW(Optimizer):
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None):
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+        super().__init__(learning_rate, parameters,
+                         weight_decay if weight_decay is not None else 0.0,
+                         grad_clip, name, multi_precision)
+        for p in self._parameter_list:
+            self._aux_state.setdefault(
+                f"{p.name}_beta1_pow_acc_0",
+                Tensor(jnp.asarray(beta1, jnp.float32), persistable=True,
+                       name=f"{p.name}_beta1_pow_acc_0"),
+            )
+            self._aux_state.setdefault(
+                f"{p.name}_beta2_pow_acc_0",
+                Tensor(jnp.asarray(beta2, jnp.float32), persistable=True,
+                       name=f"{p.name}_beta2_pow_acc_0"),
+            )
+
+    def _decoupled(self):
+        return True
+
+    def _apply_one(self, param, grad, lr):
+        m = self._param_accum("moment1", param)
+        v = self._param_accum("moment2", param)
+        b1p = self._aux_state[f"{param.name}_beta1_pow_acc_0"]
+        b2p = self._aux_state[f"{param.name}_beta2_pow_acc_0"]
+        master = self._get_master(param)
+
+        p32 = (master._data if master is not None
+               else param._data).astype(jnp.float32)
+        g32 = grad._data.astype(jnp.float32)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+
+        coeff = self._decay_coeff()
+        if self._apply_decay_param_fun is not None and not (
+            self._apply_decay_param_fun(param.name)
+        ):
+            coeff = 0.0
+        lr_r = self._lr_ratio(param) if self._lr_ratio is not None else 1.0
+        lr_eff = lr.astype(jnp.float32) * lr_r * param.optimize_attr.get(
+            "learning_rate", 1.0
+        )
+
+        if self._decoupled() and coeff:
+            p32 = p32 * (1.0 - lr_eff * coeff)
+        elif coeff:  # Adam + L2: fold decay into the gradient
+            g32 = g32 + coeff * p32
+
+        m_new = b1 * m._data.astype(jnp.float32) + (1 - b1) * g32
+        v_new = b2 * v._data.astype(jnp.float32) + (1 - b2) * g32 * g32
+        m_hat = m_new / (1 - b1p._data)
+        v_hat = v_new / (1 - b2p._data)
+        p_new = p32 - lr_eff * m_hat / (jnp.sqrt(v_hat) + eps)
+
+        m._data = m_new.astype(m._data.dtype)
+        v._data = v_new.astype(v._data.dtype)
+        b1p._data = b1p._data * b1
+        b2p._data = b2p._data * b2
+        if master is not None:
+            master._data = p_new
+            param._data = p_new.astype(param._data.dtype)
+        else:
+            param._data = p_new.astype(param._data.dtype)
+        param._version += 1
+
+
+class Adam(AdamW):
+    """Adam with classic (coupled) L2 regularization semantics."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay if weight_decay is not None else 0.0,
+                         None, None, grad_clip, lazy_mode, multi_precision,
+                         name)
+
+    def _decoupled(self):
+        return False

@@ -1,0 +1,165 @@
+"""Momentum / SGD (upstream: python/paddle/optimizer/{momentum,sgd}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class Momentum(Optimizer):
+    _accum_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _apply_one(self, param, grad, lr):
+        vel = self._param_accum("velocity", param)
+        master = self._get_master(param)
+        p32 = (master._data if master is not None
+               else param._data).astype(jnp.float32)
+        g32 = grad._data.astype(jnp.float32)
+        coeff = self._decay_coeff()
+        if coeff:
+            g32 = g32 + coeff * p32
+        mu = self._momentum
+        lr_eff = lr.astype(jnp.float32) * param.optimize_attr.get(
+            "learning_rate", 1.0
+        )
+        v_new = mu * vel._data.astype(jnp.float32) + g32
+        if self._nesterov:
+            p_new = p32 - lr_eff * (g32 + mu * v_new)
+        else:
+            p_new = p32 - lr_eff * v_new
+        vel._data = v_new.astype(vel._data.dtype)
+        if master is not None:
+            master._data = p_new
+        param._data = p_new.astype(param._data.dtype)
+        param._version += 1
+
+
+class SGD(Optimizer):
+    _accum_names = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _apply_one(self, param, grad, lr):
+        master = self._get_master(param)
+        p32 = (master._data if master is not None
+               else param._data).astype(jnp.float32)
+        g32 = grad._data.astype(jnp.float32)
+        coeff = self._decay_coeff()
+        if coeff:
+            g32 = g32 + coeff * p32
+        p_new = p32 - lr.astype(jnp.float32) * g32
+        if master is not None:
+            master._data = p_new
+        param._data = p_new.astype(param._data.dtype)
+        param._version += 1
+
+
+class Adagrad(Optimizer):
+    _accum_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=True, name=None):
+        self._epsilon = epsilon
+        self._init_value = initial_accumulator_value
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _apply_one(self, param, grad, lr):
+        mom = self._param_accum("moment", param)
+        g32 = grad._data.astype(jnp.float32)
+        m_new = mom._data.astype(jnp.float32) + g32 * g32
+        p_new = param._data.astype(jnp.float32) - lr.astype(
+            jnp.float32
+        ) * g32 / (jnp.sqrt(m_new) + self._epsilon)
+        mom._data = m_new.astype(mom._data.dtype)
+        param._data = p_new.astype(param._data.dtype)
+        param._version += 1
+
+
+class RMSProp(Optimizer):
+    _accum_names = ("mean_square", "mean_grad", "momentum_acc")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _apply_one(self, param, grad, lr):
+        ms = self._param_accum("mean_square", param)
+        mg = self._param_accum("mean_grad", param)
+        mom = self._param_accum("momentum_acc", param)
+        g32 = grad._data.astype(jnp.float32)
+        rho = self._rho
+        ms_new = rho * ms._data.astype(jnp.float32) + (1 - rho) * g32 * g32
+        if self._centered:
+            mg_new = rho * mg._data.astype(jnp.float32) + (1 - rho) * g32
+            denom = jnp.sqrt(ms_new - mg_new * mg_new + self._epsilon)
+            mg._data = mg_new.astype(mg._data.dtype)
+        else:
+            denom = jnp.sqrt(ms_new + self._epsilon)
+        update = lr.astype(jnp.float32) * g32 / denom
+        if self._momentum:
+            mom_new = self._momentum * mom._data.astype(jnp.float32) + update
+            mom._data = mom_new.astype(mom._data.dtype)
+            update = mom_new
+        ms._data = ms_new.astype(ms._data.dtype)
+        param._data = (
+            param._data.astype(jnp.float32) - update
+        ).astype(param._data.dtype)
+        param._version += 1
+
+
+class Lamb(Optimizer):
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=True, name=None):
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+
+    def _apply_one(self, param, grad, lr):
+        m = self._param_accum("moment1", param)
+        v = self._param_accum("moment2", param)
+        g32 = grad._data.astype(jnp.float32)
+        p32 = param._data.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        m_new = b1 * m._data.astype(jnp.float32) + (1 - b1) * g32
+        v_new = b2 * v._data.astype(jnp.float32) + (1 - b2) * g32 * g32
+        r = m_new / (jnp.sqrt(v_new) + self._epsilon)
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            wd = 0.0
+        update = r + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        u_norm = jnp.linalg.norm(update)
+        trust = jnp.where(
+            (w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0
+        )
+        p_new = p32 - lr.astype(jnp.float32) * trust * update
+        m._data = m_new.astype(m._data.dtype)
+        v._data = v_new.astype(v._data.dtype)
+        param._data = p_new.astype(param._data.dtype)
+        param._version += 1

@@ -1,0 +1,206 @@
+"""Optimizer base (upstream: python/paddle/optimizer/optimizer.py).
+
+Differences from the reference, by TPU design:
+* accumulators are created eagerly at construction (the reference creates
+  them lazily on first step) so the compiled train step sees a stable
+  state pytree on its first trace;
+* the learning rate lives in a 0-d Tensor captured as mutable state, so
+  LR schedules stepped in Python change the compiled step's behavior
+  without retracing.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import state as _registry
+from ..framework.core import EagerParamBase, Tensor, no_grad
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _accum_names: tuple = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=True):
+        if parameters is None:
+            raise ValueError(
+                "paddle_tpu requires explicit `parameters` in dygraph mode "
+                "(same as the reference)"
+            )
+        self._parameter_list = list(parameters)
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+
+        self._lr_scheduler = None
+        if isinstance(learning_rate, LRScheduler):
+            self._lr_scheduler = learning_rate
+            lr_value = float(learning_rate())
+        else:
+            lr_value = float(learning_rate)
+        self._learning_rate = learning_rate
+        self._lr_tensor = Tensor(jnp.asarray(lr_value, jnp.float32),
+                                 persistable=True, name="learning_rate_0")
+        if self._lr_scheduler is not None:
+            self._lr_scheduler._bind(self._lr_tensor)
+
+        from ..nn.clip import ClipGradBase
+
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._multi_precision = multi_precision
+        self._accumulators = collections.defaultdict(dict)  # name -> uid -> T
+        self._master_weights = {}
+        self._aux_state = {}  # scalar state tensors (e.g. beta pows)
+        self._create_accumulators()
+        _registry.register_optimizer(self)
+
+    # -- accumulator infrastructure ---------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, dtype=None):
+        if param._uid in self._accumulators[name]:
+            return
+        d = dtype or (
+            jnp.float32 if self._use_master(param) else param._data.dtype
+        )
+        t = Tensor(jnp.full(tuple(param.shape), fill_value, d),
+                   persistable=True,
+                   name=f"{param.name}_{name}_0")
+        self._accumulators[name][param._uid] = t
+
+    def _use_master(self, param):
+        return self._multi_precision and param._data.dtype in (
+            jnp.bfloat16, jnp.float16
+        )
+
+    def _get_master(self, param):
+        if not self._use_master(param):
+            return None
+        if param._uid not in self._master_weights:
+            self._master_weights[param._uid] = Tensor(
+                param._data.astype(jnp.float32), persistable=True,
+                name=f"{param.name}_fp32_master_0",
+            )
+        return self._master_weights[param._uid]
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            if not isinstance(p, Tensor):
+                continue
+            for name in self._accum_names:
+                self._add_accumulator(name, p)
+            if self._use_master(p):
+                self._get_master(p)
+
+    def _state_tensors(self):
+        out = [self._lr_tensor]
+        for accs in self._accumulators.values():
+            out.extend(accs.values())
+        out.extend(self._master_weights.values())
+        out.extend(self._aux_state.values())
+        return out
+
+    # -- public API --------------------------------------------------------
+    def get_lr(self):
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler())
+        return float(np.asarray(self._lr_tensor._data))
+
+    def set_lr(self, value):
+        self._lr_tensor.set_value(jnp.asarray(float(value), jnp.float32))
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr_scheduler = scheduler
+        scheduler._bind(self._lr_tensor)
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def _collect_params_grads(self):
+        out = []
+        for p in self._parameter_list:
+            if p.stop_gradient or p._grad is None:
+                continue
+            out.append((p, p._grad))
+        return out
+
+    def _decay_coeff(self):
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "_coeff"):
+            return float(wd._coeff)
+        return float(wd)
+
+    @no_grad()
+    def step(self):
+        params_grads = self._collect_params_grads()
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        # L2Decay regularization (non-decoupled) is applied by adding
+        # coeff*param to the grad, matching the reference's regularizer path
+        reg = getattr(self, "_apply_regularization", None)
+        if reg is not None:
+            params_grads = reg(params_grads)
+        lr = self._lr_tensor._data
+        for p, g in params_grads:
+            self._apply_one(p, g, lr)
+
+    def _apply_one(self, param, grad, lr):
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for name, accs in self._accumulators.items():
+            for uid, t in accs.items():
+                sd[t.name] = t
+        for uid, t in self._master_weights.items():
+            sd.setdefault("master_weights", {})[t.name] = t
+        for k, t in self._aux_state.items():
+            sd[k] = t
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        if "LR_Scheduler" in state_dict and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+        master = state_dict.get("master_weights", {})
+        by_name = {}
+        for name, accs in self._accumulators.items():
+            for uid, t in accs.items():
+                by_name[t.name] = t
+        for k, t in self._aux_state.items():
+            by_name[k] = t
+        for k, v in state_dict.items():
+            if k in ("LR_Scheduler", "master_weights"):
+                continue
+            if k in by_name:
+                by_name[k].set_value(v._data if isinstance(v, Tensor) else v)
+        mw_by_name = {t.name: t for t in self._master_weights.values()}
+        for k, v in master.items():
+            if k in mw_by_name:
+                mw_by_name[k].set_value(
+                    v._data if isinstance(v, Tensor) else v
+                )
+
+    def _param_accum(self, name, param):
+        return self._accumulators[name][param._uid]

@@ -1,0 +1,18 @@
+"""Regularizers (upstream: python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __float__(self):
+        return self._coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __float__(self):
+        return self._coeff

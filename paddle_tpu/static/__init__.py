@@ -1,0 +1,95 @@
+"""Minimal paddle.static surface (upstream: python/paddle/static/).
+
+The static-graph Program/Executor model is replaced by traced jit (XLA);
+InputSpec survives as the input-signature declaration for to_static and
+jit.save, and cond/while_loop map to lax control flow for use inside
+compiled steps.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.dtype import to_np_dtype
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return (
+            f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+            f"name={self.name})"
+        )
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype.name, name or tensor.name)
+
+
+def cond(pred, true_fn, false_fn, operands=None):
+    """lax.cond with Tensor in/out (usable inside to_static)."""
+    p = pred._data if isinstance(pred, Tensor) else jnp.asarray(pred)
+
+    def wrap(fn):
+        def inner(_):
+            out = fn() if operands is None else fn(*operands)
+            leaves, tree = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor)
+            )
+            return [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+                    for l in leaves], tree
+        return inner
+
+    # trace both branches to find a common structure
+    t_leaves_fn = wrap(true_fn)
+    f_leaves_fn = wrap(false_fn)
+
+    def t_fn(_):
+        return t_leaves_fn(None)[0]
+
+    def f_fn(_):
+        return f_leaves_fn(None)[0]
+
+    _, tree = t_leaves_fn(None)
+    outs = jax.lax.cond(p, t_fn, f_fn, None)
+    return jax.tree_util.tree_unflatten(tree, [Tensor(o) for o in outs])
+
+
+def nn_while_loop(cond_fn, body_fn, loop_vars):
+    def unwrap(vs):
+        return [v._data if isinstance(v, Tensor) else v for v in vs]
+
+    def wrap(raws):
+        return [Tensor(r) for r in raws]
+
+    outs = jax.lax.while_loop(
+        lambda raws: (
+            cond_fn(*wrap(raws))._data
+            if isinstance(cond_fn(*wrap(raws)), Tensor)
+            else cond_fn(*wrap(raws))
+        ),
+        lambda raws: unwrap(body_fn(*wrap(raws))),
+        unwrap(loop_vars),
+    )
+    return wrap(outs)
+
+
+class nn:
+    cond = staticmethod(cond)
+    while_loop = staticmethod(nn_while_loop)
+
+
+def default_main_program():
+    raise NotImplementedError(
+        "static Program mode is not part of the TPU-native design; "
+        "use eager + @to_static"
+    )
+
+
+default_startup_program = default_main_program

@@ -1,0 +1,212 @@
+"""paddle_tpu.tensor — functional op namespace + Tensor method attachment.
+
+The reference attaches its generated method table onto the eager Tensor
+at import (upstream: python/paddle/tensor/__init__.py monkey_patch list);
+we do the same here for the jnp-backed Tensor.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+from ..framework.dtype import to_np_dtype, convert_dtype
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from . import random  # noqa: F401
+
+from . import creation, math, manipulation, linalg, search, logic, stat
+
+# numpy-compat aliases used throughout model code
+abs = math.abs
+max = math.max
+min = math.min
+sum = math.sum
+any = math.any
+all = math.all
+pow = math.pow
+round = math.round
+
+
+# --------------------------------------------------------------------------
+# Tensor methods
+# --------------------------------------------------------------------------
+
+
+def _astype(self, dtype):
+    return manipulation.cast(self, dtype)
+
+
+def _getitem(self, idx):
+    # Tensor indices become op inputs; static python indices are closed over
+    if isinstance(idx, Tensor):
+        if idx._data.dtype == jnp.bool_:
+            return manipulation.masked_select(self, idx)
+        return apply_op("getitem", lambda a, i: a[i], self, idx)
+    if isinstance(idx, tuple) and builtins_any(isinstance(i, Tensor) for i in idx):
+        tensors = [i for i in idx if isinstance(i, Tensor)]
+        template = tuple(
+            None if isinstance(i, Tensor) else i for i in idx
+        )
+
+        def f(a, *tids):
+            it = iter(tids)
+            full = tuple(next(it) if t is None else t for t in template)
+            return a[full]
+
+        return apply_op("getitem", f, self, *tensors)
+    return apply_op("getitem", lambda a: a[idx], self)
+
+
+def builtins_any(it):
+    for v in it:
+        if v:
+            return True
+    return False
+
+
+def _setitem(self, idx, value):
+    if isinstance(value, Tensor):
+        if isinstance(idx, Tensor):
+            out = apply_op(
+                "setitem",
+                lambda a, i, v: a.at[i].set(v.astype(a.dtype)),
+                self, idx, value,
+            )
+        else:
+            out = apply_op(
+                "setitem",
+                lambda a, v: a.at[idx].set(v.astype(a.dtype)),
+                self, value,
+            )
+    else:
+        v = value
+        if isinstance(idx, Tensor):
+            out = apply_op(
+                "setitem", lambda a, i: a.at[i].set(v), self, idx
+            )
+        else:
+            out = apply_op("setitem", lambda a: a.at[idx].set(v), self)
+    self._data = out._data
+    self._grad_node = out._grad_node
+    self._version += 1
+
+
+def _swap(fn):
+    def op(self, other):
+        return fn(other, self)
+
+    return op
+
+
+def _neg(self):
+    return math.neg(self)
+
+
+def _matmul(self, other):
+    return linalg.matmul(self, other)
+
+
+def _to(self, *args, **kwargs):
+    # .to(device) / .to(dtype) / .to(device, dtype)
+    dtype = kwargs.get("dtype")
+    for a in args:
+        if isinstance(a, str) and a.split(":")[0] in (
+            "cpu", "gpu", "tpu", "cuda", "xpu",
+        ):
+            continue
+        if a is not None and not isinstance(a, bool):
+            dtype = a
+    if dtype is not None:
+        return manipulation.cast(self, dtype)
+    return self
+
+
+def _cuda(self, device_id=None, blocking=True):
+    return self
+
+
+def _cpu(self):
+    return Tensor(jax.device_get(self._data))
+
+
+def _pin_memory(self):
+    return self
+
+
+def _dim(self):
+    return self.ndim
+
+
+def _rank(self):
+    return self.ndim
+
+
+def _element_size(self):
+    return self._data.dtype.itemsize
+
+
+METHODS = {
+    "astype": _astype,
+    "cast": _astype,
+    "__getitem__": _getitem,
+    "__setitem__": _setitem,
+    "__add__": math.add,
+    "__radd__": _swap(math.add),
+    "__sub__": math.subtract,
+    "__rsub__": _swap(math.subtract),
+    "__mul__": math.multiply,
+    "__rmul__": _swap(math.multiply),
+    "__truediv__": math.divide,
+    "__rtruediv__": _swap(math.divide),
+    "__floordiv__": math.floor_divide,
+    "__mod__": math.mod,
+    "__pow__": math.pow,
+    "__rpow__": _swap(math.pow),
+    "__neg__": _neg,
+    "__matmul__": _matmul,
+    "__rmatmul__": _swap(linalg.matmul),
+    "__eq__": logic.equal,
+    "__ne__": logic.not_equal,
+    "__lt__": logic.less_than,
+    "__le__": logic.less_equal,
+    "__gt__": logic.greater_than,
+    "__ge__": logic.greater_equal,
+    "__and__": logic.bitwise_and,
+    "__or__": logic.bitwise_or,
+    "__xor__": logic.bitwise_xor,
+    "__invert__": logic.bitwise_not,
+    "__abs__": math.abs,
+    "to": _to,
+    "cuda": _cuda,
+    "cpu": _cpu,
+    "pin_memory": _pin_memory,
+    "element_size": _element_size,
+}
+
+_METHOD_MODULES = (creation, math, manipulation, linalg, search, logic, stat)
+
+_SKIP = {"slice"}  # collides with builtin-name semantics on a method
+
+for mod in _METHOD_MODULES:
+    for name in dir(mod):
+        if name.startswith("_") or name in _SKIP:
+            continue
+        fn = getattr(mod, name)
+        if callable(fn) and getattr(fn, "__module__", "").startswith(
+            "paddle_tpu.tensor"
+        ):
+            METHODS.setdefault(name, fn)
+
+for name, fn in METHODS.items():
+    setattr(Tensor, name, fn)
+
+# hash must survive __eq__ override
+Tensor.__hash__ = lambda self: id(self)

@@ -1,0 +1,173 @@
+"""Creation ops (upstream: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+from ..framework.dtype import to_np_dtype
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype)
+        t.stop_gradient = stop_gradient
+        return t
+    t = Tensor(data, dtype=dtype)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def zeros(shape, dtype="float32", name=None):
+    return Tensor(jnp.zeros(_shape(shape), to_np_dtype(dtype)))
+
+
+def ones(shape, dtype="float32", name=None):
+    return Tensor(jnp.ones(_shape(shape), to_np_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype="float32", name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, to_np_dtype(dtype)))
+
+
+def empty(shape, dtype="float32", name=None):
+    return zeros(shape, dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(
+        int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape
+    )
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = _as_tensor(x)
+    d = to_np_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.zeros_like(x._data, dtype=d))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = _as_tensor(x)
+    d = to_np_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.ones_like(x._data, dtype=d))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = _as_tensor(x)
+    d = to_np_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=d))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    d = to_np_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    d = to_np_dtype(dtype) if dtype is not None else None
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    if isinstance(num, Tensor):
+        num = int(num.item())
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=d))
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=to_np_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = _as_tensor(x)
+    if x.ndim == 1 and padding_value != 0:
+        def f(a):
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(d.shape[0], dtype=bool) if offset == 0 else (
+                jnp.diag(jnp.ones_like(a, dtype=bool), k=offset)
+            )
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return apply_op("diag", f, x)
+    return apply_op("diag", lambda a: jnp.diag(a, k=offset), x)
+
+
+def diagflat(x, offset=0, name=None):
+    x = _as_tensor(x)
+    return apply_op("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    x = _as_tensor(x)
+    return apply_op("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    x = _as_tensor(x)
+    return apply_op("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def assign(x, output=None):
+    x = _as_tensor(x) if not isinstance(x, (np.ndarray, list, tuple, int, float)) else Tensor(np.asarray(x))
+    out = apply_op("assign", lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.inexact) else jnp.asarray(a), x)
+    if output is not None:
+        output.set_value(out._data)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "clone",
+        lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.inexact) else jnp.array(a),
+        x,
+    )
+
+
+def meshgrid(*args, **kwargs):
+    ts = [_as_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[t._data for t in ts], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(to_np_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(to_np_dtype(dtype)))
+
+
+def one_hot(x, num_classes, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "one_hot",
+        lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32),
+        x,
+        differentiable=False,
+    )
+
+
+def complex(real, imag, name=None):
+    real, imag = _as_tensor(real), _as_tensor(imag)
+    return apply_op("complex", lambda r, i: jax.lax.complex(r, i), real, imag)

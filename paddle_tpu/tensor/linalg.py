@@ -1,0 +1,255 @@
+"""Linear algebra ops (upstream: python/paddle/tensor/linalg.py).
+
+``matmul`` is the MXU hot path — it lowers straight to ``jnp.matmul``
+(XLA dot_general), which XLA tiles onto the systolic array; bf16 inputs
+use native MXU bf16 multiply with fp32 accumulate.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return apply_op("matmul", f, x, y)
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op(
+        "dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y
+    )
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def einsum(equation, *operands):
+    ts = [_as_tensor(o) for o in operands]
+    return apply_op(
+        "einsum", lambda *arrs: jnp.einsum(equation, *arrs), *ts
+    )
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+
+    def f(a):
+        if axis is None:
+            flat = a.reshape(-1)
+            if p == "fro" or p == 2:
+                return jnp.sqrt(jnp.sum(flat * flat, keepdims=keepdim))
+            if p == np.inf:
+                return jnp.max(jnp.abs(flat), keepdims=keepdim)
+            if p == -np.inf:
+                return jnp.min(jnp.abs(flat), keepdims=keepdim)
+            if p == 1:
+                return jnp.sum(jnp.abs(flat), keepdims=keepdim)
+            if p == 0:
+                return jnp.sum((flat != 0).astype(a.dtype), keepdims=keepdim)
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p),
+                                     keepdims=keepdim), 1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro" or p == 2:
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p == np.inf:
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 1:
+            return jnp.sum(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim),
+            1.0 / p,
+        )
+
+    return apply_op("p_norm", f, x)
+
+
+def dist(x, y, p=2, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    from . import math as _m
+
+    return norm(_m.subtract(x, y), p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    ax = axis if axis != 9 else next(
+        (i for i, s in enumerate(x.shape) if s == 3), -1
+    )
+    return apply_op(
+        "cross", lambda a, b: jnp.cross(a, b, axis=ax), x, y
+    )
+
+
+def matrix_power(x, n, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x
+    )
+
+
+def cholesky(x, upper=False, name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return apply_op("cholesky", f, x)
+
+
+def inverse(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("inverse", jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "pinv", lambda a: jnp.linalg.pinv(a, rcond=rcond, hermitian=hermitian), x
+    )
+
+
+def solve(x, y, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op(
+        "triangular_solve",
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        ),
+        x, y,
+    )
+
+
+def qr(x, mode="reduced", name=None):
+    x = _as_tensor(x)
+    outs = apply_op(
+        "qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, n_outs=2
+    )
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "svd",
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        x, n_outs=3,
+    )
+
+
+def eigh(x, UPLO="L", name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x, n_outs=2
+    )
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    x = _as_tensor(x)
+    return apply_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def det(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), x, n_outs=2
+    )
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "matrix_rank",
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol),
+        x, differentiable=False,
+    )
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = _as_tensor(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(np_or_jax(x._data))
+    outs = (Tensor(lu_), Tensor(piv.astype(jnp.int32)))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+def np_or_jax(a):
+    return a
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    input = _as_tensor(input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (
+        float(jnp.min(input._data)), float(jnp.max(input._data))
+    )
+    h, _ = jnp.histogram(input._data, bins=bins, range=(lo, hi))
+    return Tensor(h.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = _as_tensor(x)
+    w = _as_tensor(weights)._data if weights is not None else None
+    n = max(int(jnp.max(x._data)) + 1 if x.size else 0, minlength)
+    return Tensor(jnp.bincount(x._data, weights=w, length=n))
+
+
+def multi_dot(x, name=None):
+    ts = [_as_tensor(v) for v in x]
+    return apply_op(
+        "multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), *ts
+    )
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "cov",
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+        x,
+    )
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = _as_tensor(x)
+    return apply_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)

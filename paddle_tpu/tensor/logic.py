@@ -1,0 +1,97 @@
+"""Comparison / logical ops (upstream: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+
+
+def _cmp(name, jfn):
+    def op(x, y, name=None):
+        x = _as_tensor(x)
+        if isinstance(y, Tensor):
+            return apply_op(name, jfn, x, y, differentiable=False)
+        yv = y
+        return apply_op(name, lambda a: jfn(a, yv), x, differentiable=False)
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, out=None, name=None):
+    x = _as_tensor(x)
+    return apply_op("logical_not", jnp.logical_not, x, differentiable=False)
+
+
+def bitwise_not(x, out=None, name=None):
+    x = _as_tensor(x)
+    return apply_op("bitwise_not", jnp.bitwise_not, x, differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    if tuple(x.shape) != tuple(y.shape):
+        return Tensor(jnp.asarray(False))
+    return apply_op(
+        "equal_all", lambda a, b: jnp.all(a == b), x, y, differentiable=False
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=float(rtol), atol=float(atol),
+                                  equal_nan=equal_nan),
+        x, y, differentiable=False,
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=float(rtol), atol=float(atol),
+                                 equal_nan=equal_nan),
+        x, y, differentiable=False,
+    )
+
+
+def is_empty(x, name=None):
+    x = _as_tensor(x)
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def in_dynamic_mode():
+    return True
+
+
+def is_floating_point(x):
+    return _as_tensor(x).dtype.is_floating_point
+
+
+def is_integer(x):
+    return np.issubdtype(_as_tensor(x)._data.dtype, np.integer)
+
+
+def is_complex(x):
+    return np.issubdtype(_as_tensor(x)._data.dtype, np.complexfloating)

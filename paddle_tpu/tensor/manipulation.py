@@ -1,0 +1,469 @@
+"""Shape / layout manipulation ops
+(upstream: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+from ..framework.dtype import to_np_dtype
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._data))
+    return tuple(
+        int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape
+    )
+
+
+def reshape(x, shape, name=None):
+    x = _as_tensor(x)
+    shp = _static_shape(shape)
+    return apply_op("reshape", lambda a: jnp.reshape(a, shp), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data, x._grad_node = out._data, out._grad_node
+    x._version += 1
+    return x
+
+
+def transpose(x, perm, name=None):
+    x = _as_tensor(x)
+    perm = tuple(int(p) for p in perm)
+    return apply_op("transpose", lambda a: jnp.transpose(a, perm), x)
+
+
+def t(x, name=None):
+    x = _as_tensor(x)
+    if x.ndim < 2:
+        return x.clone()
+    return apply_op("t", jnp.transpose, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    x = _as_tensor(x)
+    return apply_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    x = _as_tensor(x)
+    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+def concat(x, axis=0, name=None):
+    ts = [_as_tensor(v) for v in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = int(axis)
+    return apply_op("concat", lambda *arrs: jnp.concatenate(arrs, axis=ax), *ts)
+
+
+def stack(x, axis=0, name=None):
+    ts = [_as_tensor(v) for v in x]
+    ax = int(axis)
+    return apply_op("stack", lambda *arrs: jnp.stack(arrs, axis=ax), *ts)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _as_tensor(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [
+            int(s.item()) if isinstance(s, Tensor) else int(s)
+            for s in num_or_sections
+        ]
+        if -1 in sections:
+            known = sum(s for s in sections if s != -1)
+            sections = [dim - known if s == -1 else s for s in sections]
+    offs = np.cumsum([0] + sections)
+    n = len(sections)
+
+    def f(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, int(offs[i]), int(offs[i + 1]), axis=ax)
+            for i in range(n)
+        )
+
+    outs = apply_op("split", f, x, n_outs=n)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(input, axis=0, name=None):
+    input = _as_tensor(input)
+    n = input.shape[axis]
+    outs = split(input, n, axis)
+    return [squeeze(o, axis=axis) for o in outs]
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = _as_tensor(x)
+    if axis is None:
+        ax = None
+    elif isinstance(axis, (list, tuple)):
+        ax = tuple(int(a) for a in axis if x.shape[int(a)] == 1)
+    else:
+        ax = int(axis)
+        if x.shape[ax] != 1:
+            return x.clone()
+    return apply_op("squeeze", lambda a: jnp.squeeze(a, axis=ax), x)
+
+
+def unsqueeze(x, axis, name=None):
+    x = _as_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = [int(v) for v in np.atleast_1d(np.asarray(axis._data))]
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+    return apply_op("unsqueeze", lambda a: jnp.expand_dims(a, ax), x)
+
+
+unsqueeze_ = unsqueeze
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _as_tensor(x)
+    nd = x.ndim
+    if nd == 0:
+        return reshape(x, [1])
+    s = start_axis % nd
+    e = stop_axis % nd
+    shp = x.shape[:s] + [-1] + x.shape[e + 1:]
+    return reshape(x, shp)
+
+
+def cast(x, dtype):
+    x = _as_tensor(x)
+    d = to_np_dtype(dtype)
+    if x._data.dtype == d:
+        return x.clone()
+    return apply_op("cast", lambda a: a.astype(d), x)
+
+
+def expand(x, shape, name=None):
+    x = _as_tensor(x)
+    shp = _static_shape(shape)
+    # paddle semantics: -1 keeps the original dim
+    cur = ([1] * (len(shp) - x.ndim)) + x.shape
+    target = tuple(c if s == -1 else s for s, c in zip(shp, cur))
+    return apply_op("expand", lambda a: jnp.broadcast_to(a, target), x)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, _as_tensor(y).shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [_as_tensor(v) for v in inputs]
+    shp = np.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    return [expand(t, list(shp)) for t in ts]
+
+
+def tile(x, repeat_times, name=None):
+    x = _as_tensor(x)
+    reps = _static_shape(repeat_times)
+    return apply_op("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def flip(x, axis, name=None):
+    x = _as_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+    return apply_op("flip", lambda a: jnp.flip(a, axis=ax), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = _as_tensor(x)
+    return apply_op("roll", lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = _as_tensor(x)
+    return apply_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+# -- gather / scatter -------------------------------------------------------
+def gather(x, index, axis=0, name=None):
+    x, index = _as_tensor(x), _as_tensor(index)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(
+        "gather", lambda a, i: jnp.take(a, i.reshape(-1), axis=ax), x, index
+    )
+
+
+def gather_nd(x, index, name=None):
+    x, index = _as_tensor(x), _as_tensor(index)
+
+    def f(a, idx):
+        k = idx.shape[-1]
+        idx_t = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[idx_t]
+
+    return apply_op("gather_nd", f, x, index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = _as_tensor(arr), _as_tensor(indices)
+    return apply_op(
+        "take_along_axis",
+        lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+        arr, indices,
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    arr, indices = _as_tensor(arr), _as_tensor(indices)
+    values = _as_tensor(values)
+
+    def f(a, i, v):
+        v = jnp.broadcast_to(v.astype(a.dtype), i.shape)
+        dim_idx = [
+            jnp.broadcast_to(
+                jnp.arange(i.shape[d]).reshape(
+                    [1] * d + [-1] + [1] * (i.ndim - d - 1)
+                ),
+                i.shape,
+            )
+            for d in range(i.ndim)
+        ]
+        dim_idx[axis] = i
+        at = a.at[tuple(dim_idx)]
+        if reduce == "assign":
+            return at.set(v)
+        if reduce in ("add", "sum"):
+            return at.add(v)
+        if reduce in ("mul", "multiply"):
+            return at.multiply(v)
+        if reduce == "amax":
+            return at.max(v)
+        if reduce == "amin":
+            return at.min(v)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    return apply_op("put_along_axis", f, arr, indices, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = _as_tensor(x), _as_tensor(index), _as_tensor(updates)
+
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u.astype(a.dtype))
+        return a.at[i].set(jnp.zeros_like(u, dtype=a.dtype)).at[i].add(
+            u.astype(a.dtype)
+        )
+
+    return apply_op("scatter", f, x, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = _as_tensor(x), _as_tensor(index), _as_tensor(updates)
+
+    def f(a, i, u):
+        idx_t = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx_t].add(u.astype(a.dtype))
+
+    return apply_op("scatter_nd_add", f, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = _as_tensor(index), _as_tensor(updates)
+    shp = _static_shape(shape)
+
+    def f(i, u):
+        z = jnp.zeros(shp, u.dtype)
+        idx_t = tuple(jnp.moveaxis(i, -1, 0))
+        return z.at[idx_t].add(u)
+
+    return apply_op("scatter_nd", f, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = _as_tensor(x), _as_tensor(index)
+    return apply_op(
+        "index_select", lambda a, i: jnp.take(a, i, axis=axis), x, index
+    )
+
+
+def index_sample(x, index):
+    x, index = _as_tensor(x), _as_tensor(index)
+    return apply_op(
+        "index_sample",
+        lambda a, i: jnp.take_along_axis(a, i, axis=1),
+        x, index,
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = _as_tensor(x), _as_tensor(index), _as_tensor(value)
+
+    def f(a, i, v):
+        a2 = jnp.moveaxis(a, axis, 0)
+        v2 = jnp.moveaxis(v.astype(a.dtype), axis, 0)
+        out = a2.at[i].add(v2)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply_op("index_add", f, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = _as_tensor(x)
+    value = _as_tensor(value)
+    idx = tuple(_as_tensor(i) for i in indices)
+
+    def f(a, v, *ii):
+        at = a.at[tuple(ii)]
+        return at.add(v) if accumulate else at.set(v.astype(a.dtype))
+
+    return apply_op("index_put", f, x, value, *idx)
+
+
+def masked_select(x, mask, name=None):
+    x, mask = _as_tensor(x), _as_tensor(mask)
+    # dynamic shape: eager-only (documented; same restriction as XLA)
+    return Tensor(x._data[np.asarray(mask._data)])
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = _as_tensor(x), _as_tensor(mask)
+    if isinstance(value, Tensor):
+        return apply_op(
+            "masked_fill",
+            lambda a, m, v: jnp.where(m, v.astype(a.dtype), a),
+            x, mask, value,
+        )
+    v = value
+    return apply_op(
+        "masked_fill",
+        lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+        x, mask,
+    )
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    x = _as_tensor(x)
+    n = builtins_min(x.shape[0], x.shape[1]) if x.ndim == 2 else None
+
+    def f(a):
+        i = jnp.arange(a.shape[0])
+        if a.ndim == 2:
+            m = builtins_min(a.shape[0], a.shape[1])
+            i = jnp.arange(m)
+            return a.at[i, i].set(jnp.asarray(value, a.dtype))
+        idx = tuple(i for _ in range(a.ndim))
+        return a.at[idx].set(jnp.asarray(value, a.dtype))
+
+    out = apply_op("fill_diagonal", f, x)
+    x._data, x._grad_node = out._data, out._grad_node
+    x._version += 1
+    return x
+
+
+def builtins_min(a, b):
+    return a if a < b else b
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = _as_tensor(x)
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._data)
+        total = int(reps.sum())
+        return apply_op(
+            "repeat_interleave",
+            lambda a: jnp.repeat(a, jnp.asarray(reps), axis=axis,
+                                 total_repeat_length=total),
+            x,
+        )
+    return apply_op(
+        "repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), x
+    )
+
+
+def numel(x, name=None):
+    x = _as_tensor(x)
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1, jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    input = _as_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def f(i):
+        in_shard = (i // shard_size) == shard_id
+        return jnp.where(in_shard, i % shard_size, ignore_value)
+
+    return apply_op("shard_index", f, input, differentiable=False)
+
+
+def slice(input, axes, starts, ends, name=None):
+    input = _as_tensor(input)
+    axes = [int(a) for a in axes]
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(st, en)
+        return a[tuple(idx)]
+
+    return apply_op("slice", f, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[int(ax)] = builtins.slice(int(st), int(en), int(sd))
+        return a[tuple(idx)]
+
+    return apply_op("strided_slice", f, x)
+
+
+def as_real(x, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "as_real", lambda a: jnp.stack([a.real, a.imag], axis=-1), x
+    )
+
+
+def as_complex(x, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x
+    )
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def view(x, shape_or_dtype, name=None):
+    x = _as_tensor(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = to_np_dtype(shape_or_dtype)
+    return apply_op("view_dtype", lambda a: a.view(d), x, differentiable=False)

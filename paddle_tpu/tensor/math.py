@@ -1,0 +1,357 @@
+"""Math ops (upstream: python/paddle/tensor/math.py).
+
+Every op routes through ``apply_op`` so the tape can record it; the primal
+bodies are jnp/lax and therefore MXU/VPU-friendly under XLA fusion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+from ..framework.dtype import to_np_dtype
+
+
+def _num(v):
+    """Unwrap a python-number-like (keep Tensors as Tensors)."""
+    return v
+
+
+def _unary(name, jfn):
+    def op(x, name=None):
+        x = _as_tensor(x)
+        return apply_op(name, jfn, x)
+
+    op.__name__ = name
+    return op
+
+
+def _binary(name, jfn):
+    def op(x, y, name=None):
+        if isinstance(y, Tensor) or isinstance(x, Tensor):
+            x = _as_tensor(x) if not isinstance(x, Tensor) else x
+            if isinstance(y, Tensor):
+                return apply_op(name, jfn, x, y)
+            yv = y
+            return apply_op(name, lambda a: jfn(a, yv), x)
+        return Tensor(jfn(jnp.asarray(x), jnp.asarray(y)))
+
+    op.__name__ = name
+    return op
+
+
+# -- elementwise unary ------------------------------------------------------
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+abs = _unary("abs", jnp.abs)
+sign = _unary("sign", jnp.sign)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+neg = _unary("neg", jnp.negative)
+erf = _unary("erf", jax.lax.erf)
+erfinv = _unary("erfinv", jax.lax.erf_inv)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+digamma = _unary("digamma", jax.lax.digamma)
+lgamma = _unary("lgamma", jax.lax.lgamma)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+
+# -- elementwise binary -----------------------------------------------------
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _binary("pow", jnp.power)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+hypot = _binary("hypot", jnp.hypot)
+heaviside = _binary("heaviside", jnp.heaviside)
+nextafter = _binary("nextafter", jnp.nextafter)
+copysign = _binary("copysign", jnp.copysign)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+
+divide_ = divide
+add_ = add
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = _as_tensor(x)
+    if isinstance(scale, Tensor):
+        def f(a, s):
+            s = s.astype(a.dtype)
+            return a * s + bias if bias_after_scale else (a + bias) * s
+        return apply_op("scale", f, x, scale)
+    s, b = scale, bias
+
+    def f(a):
+        dt = a.dtype
+        if bias_after_scale:
+            return (a * jnp.asarray(s, dt) + jnp.asarray(b, dt)).astype(dt)
+        return ((a + jnp.asarray(b, dt)) * jnp.asarray(s, dt)).astype(dt)
+
+    return apply_op("scale", f, x)
+
+
+def clip(x, min=None, max=None, name=None):
+    x = _as_tensor(x)
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply_op("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    if isinstance(weight, Tensor):
+        return apply_op("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+    w = weight
+    return apply_op("lerp", lambda a, b: a + w * (b - a), x, y)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = _as_tensor(x)
+    return apply_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def multiply_no_nan(x, y):
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op(
+        "multiply_no_nan",
+        lambda a, b: jnp.where(b == 0, jnp.zeros_like(a), a * b),
+        x, y,
+    )
+
+
+# -- reductions -------------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        arr = np.asarray(axis._data)
+        return tuple(int(v) for v in np.atleast_1d(arr))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    d = to_np_dtype(dtype) if dtype is not None else None
+
+    def f(a):
+        out = jnp.sum(a, axis=ax, keepdims=keepdim, dtype=d)
+        if d is None and jnp.issubdtype(a.dtype, jnp.bool_):
+            out = out.astype(jnp.int64)
+        return out
+
+    return apply_op("sum", f, x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op("mean", lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op("max", lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op("min", lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    d = to_np_dtype(dtype) if dtype is not None else None
+    return apply_op(
+        "prod", lambda a: jnp.prod(a, axis=ax, keepdims=keepdim, dtype=d), x
+    )
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op(
+        "logsumexp",
+        lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+        x,
+    )
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op(
+        "all", lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x,
+        differentiable=False,
+    )
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op(
+        "any", lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x,
+        differentiable=False,
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = _as_tensor(x)
+    d = to_np_dtype(dtype) if dtype is not None else None
+
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+
+    return apply_op("cumsum", f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = _as_tensor(x)
+    d = to_np_dtype(dtype) if dtype is not None else None
+    return apply_op("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=d), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        ax = axis if axis is not None else 0
+        vals = jax.lax.cummax(a, axis=ax)
+        idx = jnp.argmax(a[..., None] == vals[..., None], axis=-1)
+        return vals
+
+    return apply_op("cummax", f, x)
+
+
+# -- matrix -----------------------------------------------------------------
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = _as_tensor(input), _as_tensor(x), _as_tensor(y)
+    return apply_op(
+        "addmm", lambda i, a, b: beta * i + alpha * (a @ b), input, x, y
+    )
+
+
+def inner(x, y, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op("inner", jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op("outer", jnp.outer, x, y)
+
+
+def kron(x, y, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op("kron", jnp.kron, x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x
+    )
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "diagonal",
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        x,
+    )
+
+
+# -- checks -----------------------------------------------------------------
+def isnan(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("isnan", jnp.isnan, x, differentiable=False)
+
+
+def isinf(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("isinf", jnp.isinf, x, differentiable=False)
+
+
+def isfinite(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("isfinite", jnp.isfinite, x, differentiable=False)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "nan_to_num",
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        x,
+    )
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op(
+        "count_nonzero",
+        lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim),
+        x,
+        differentiable=False,
+    )
+
+
+def increment(x, value=1.0, name=None):
+    x = _as_tensor(x)
+    out = apply_op("increment", lambda a: a + jnp.asarray(value, a.dtype), x)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._version += 1
+    return x

@@ -1,0 +1,184 @@
+"""Search / sort ops (upstream: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+from ..framework.dtype import to_np_dtype
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = _as_tensor(x)
+    d = to_np_dtype(dtype)
+
+    def f(a):
+        if axis is None:
+            out = jnp.argmax(a.reshape(-1))
+            return out.reshape((1,) * a.ndim).astype(d) if keepdim else out.astype(d)
+        out = jnp.argmax(a, axis=int(axis), keepdims=keepdim)
+        return out.astype(d)
+
+    return apply_op("argmax", f, x, differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = _as_tensor(x)
+    d = to_np_dtype(dtype)
+
+    def f(a):
+        if axis is None:
+            out = jnp.argmin(a.reshape(-1))
+            return out.reshape((1,) * a.ndim).astype(d) if keepdim else out.astype(d)
+        return jnp.argmin(a, axis=int(axis), keepdims=keepdim).astype(d)
+
+    return apply_op("argmin", f, x, differentiable=False)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable or True)
+        return jnp.flip(idx, axis=axis) if descending else idx
+
+    return apply_op("argsort", f, x, differentiable=False)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return apply_op("sort", f, x)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = _as_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(a):
+        ax = axis % a.ndim
+        a2 = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(a2, k)
+        else:
+            v, i = jax.lax.top_k(-a2, k)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax).astype(jnp.int64)
+
+    return apply_op("topk", f, x, n_outs=2)
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = _as_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op(
+        "where", lambda c, a, b: jnp.where(c, a, b), condition, x, y
+    )
+
+
+def where_(condition, x=None, y=None, name=None):
+    return where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    x = _as_tensor(x)
+    # dynamic output shape → eager numpy path (XLA needs static shapes)
+    idx = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, jnp.int64).reshape(-1, 1)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1), jnp.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    sorted_sequence, values = _as_tensor(sorted_sequence), _as_tensor(values)
+
+    def f(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jax.vmap(
+                lambda ss, vv: jnp.searchsorted(ss, vv, side=side)
+            )(s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])).reshape(
+                v.shape
+            )
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply_op("searchsorted", f, sorted_sequence, values,
+                    differentiable=False)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+
+    return _ms(x, mask)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        ax = axis % a.ndim
+        s = jnp.sort(a, axis=ax)
+        i = jnp.argsort(a, axis=ax)
+        v = jnp.take(s, k - 1, axis=ax)
+        ii = jnp.take(i, k - 1, axis=ax)
+        if keepdim:
+            v = jnp.expand_dims(v, ax)
+            ii = jnp.expand_dims(ii, ax)
+        return v, ii.astype(jnp.int64)
+
+    return apply_op("kthvalue", f, x, n_outs=2)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = _as_tensor(x)
+    arr = np.asarray(x._data)
+    from scipy import stats as _stats  # available in image
+
+    m = _stats.mode(arr, axis=axis, keepdims=keepdim)
+    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = _as_tensor(x)
+    res = np.unique(
+        np.asarray(x._data), return_index=return_index,
+        return_inverse=return_inverse, return_counts=return_counts, axis=axis,
+    )
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    x = _as_tensor(x)
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+    change = np.concatenate([[True], arr[1:] != arr[:-1]]) if arr.ndim == 1 else None
+    vals = arr[change] if change is not None else arr
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(np.cumsum(change) - 1)))
+    if return_counts:
+        idx = np.nonzero(change)[0]
+        counts = np.diff(np.concatenate([idx, [arr.size]]))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)

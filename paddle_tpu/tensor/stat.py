@@ -1,0 +1,84 @@
+"""Statistics ops (upstream: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+from .math import _axis
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    from .math import mean as _mean
+
+    return _mean(x, axis, keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply_op(
+        "std", lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim), x
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply_op(
+        "var", lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim), x
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op(
+        "median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x
+    )
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op(
+        "nanmedian", lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op(
+        "quantile",
+        lambda a: jnp.quantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim,
+                               method=interpolation),
+        x,
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op(
+        "nanquantile",
+        lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim),
+        x,
+    )
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op(
+        "nansum", lambda a: jnp.nansum(a, axis=ax, keepdims=keepdim), x
+    )
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = _axis(axis)
+    return apply_op(
+        "nanmean", lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x
+    )

@@ -1,0 +1,4 @@
+"""paddle_tpu.vision (upstream: python/paddle/vision/)."""
+from . import datasets  # noqa
+from . import models  # noqa
+from . import transforms  # noqa

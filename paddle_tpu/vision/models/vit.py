@@ -1,0 +1,155 @@
+"""Vision Transformer (ViT-B/L/H). The reference keeps ViT in its
+ecosystem (PaddleClas) rather than core; it is included here because
+ViT-Large + GroupSharded is one of the acceptance benchmark configs
+(BASELINE.md #4)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.core import Tensor
+from ...nn import (
+    Dropout,
+    GELU,
+    Layer,
+    LayerList,
+    LayerNorm,
+    Linear,
+    Sequential,
+)
+from ...nn import functional as F
+from ...tensor import concat, manipulation
+from ...nn import initializer as I
+
+
+class PatchEmbed(Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        from ...nn import Conv2D
+
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = Conv2D(in_chans, embed_dim, kernel_size=patch_size,
+                           stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)  # B, E, H/ps, W/ps
+        b, e = x.shape[0], x.shape[1]
+        x = manipulation.reshape(x, [b, e, -1])
+        return manipulation.transpose(x, [0, 2, 1])  # B, N, E
+
+
+class ViTAttention(Layer):
+    def __init__(self, dim, num_heads, qkv_bias=True, attn_drop=0.0,
+                 proj_drop=0.0):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.qkv = Linear(dim, dim * 3,
+                          bias_attr=None if qkv_bias else False)
+        self.proj = Linear(dim, dim)
+        self.attn_drop = attn_drop
+        self.proj_drop = proj_drop
+
+    def forward(self, x):
+        b, n, c = x.shape
+        qkv = manipulation.reshape(
+            self.qkv(x), [b, n, 3, self.num_heads, self.head_dim]
+        )
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(q, k, v)  # B,N,H,D
+        out = manipulation.reshape(out, [b, n, c])
+        out = self.proj(out)
+        if self.proj_drop:
+            out = F.dropout(out, self.proj_drop, training=self.training)
+        return out
+
+
+class ViTMlp(Layer):
+    def __init__(self, dim, hidden, drop=0.0):
+        super().__init__()
+        self.fc1 = Linear(dim, hidden)
+        self.act = GELU()
+        self.fc2 = Linear(hidden, dim)
+        self.drop = drop
+
+    def forward(self, x):
+        x = self.act(self.fc1(x))
+        if self.drop:
+            x = F.dropout(x, self.drop, training=self.training)
+        return self.fc2(x)
+
+
+class ViTBlock(Layer):
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, qkv_bias=True,
+                 drop=0.0, attn_drop=0.0, epsilon=1e-6):
+        super().__init__()
+        self.norm1 = LayerNorm(dim, epsilon=epsilon)
+        self.attn = ViTAttention(dim, num_heads, qkv_bias, attn_drop, drop)
+        self.norm2 = LayerNorm(dim, epsilon=epsilon)
+        self.mlp = ViTMlp(dim, int(dim * mlp_ratio), drop)
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x))
+        x = x + self.mlp(self.norm2(x))
+        return x
+
+
+class VisionTransformer(Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 num_classes=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, qkv_bias=True, drop_rate=0.0,
+                 attn_drop_rate=0.0, epsilon=1e-6, **kwargs):
+        super().__init__()
+        self.num_classes = num_classes
+        self.embed_dim = embed_dim
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans,
+                                      embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter(
+            [1, 1, embed_dim], default_initializer=I.TruncatedNormal(std=0.02)
+        )
+        self.pos_embed = self.create_parameter(
+            [1, n + 1, embed_dim],
+            default_initializer=I.TruncatedNormal(std=0.02),
+        )
+        self.pos_drop = Dropout(drop_rate)
+        self.blocks = LayerList([
+            ViTBlock(embed_dim, num_heads, mlp_ratio, qkv_bias, drop_rate,
+                     attn_drop_rate, epsilon)
+            for _ in range(depth)
+        ])
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon)
+        self.head = (
+            Linear(embed_dim, num_classes) if num_classes > 0 else None
+        )
+
+    def forward_features(self, x):
+        b = x.shape[0]
+        x = self.patch_embed(x)
+        cls = manipulation.expand(self.cls_token, [b, 1, self.embed_dim])
+        x = concat([cls, x], axis=1)
+        x = self.pos_drop(x + self.pos_embed)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        return x[:, 0]
+
+    def forward(self, x):
+        x = self.forward_features(x)
+        if self.head is not None:
+            x = self.head(x)
+        return x
+
+
+def vit_base_patch16_224(**kwargs):
+    return VisionTransformer(embed_dim=768, depth=12, num_heads=12, **kwargs)
+
+
+def vit_large_patch16_224(**kwargs):
+    return VisionTransformer(embed_dim=1024, depth=24, num_heads=16, **kwargs)
+
+
+def vit_huge_patch14_224(**kwargs):
+    return VisionTransformer(patch_size=14, embed_dim=1280, depth=32,
+                             num_heads=16, **kwargs)

@@ -1,0 +1,22 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh
+(the TPU-world analog of the reference's loopback multi-process NCCL
+tests — SURVEY.md §4).
+
+The axon TPU plugin force-sets jax_platforms='axon,cpu' from its
+sitecustomize at interpreter start; tests must run CPU-only (the single
+real chip is reserved for the bench), so override back to 'cpu' BEFORE
+the first backend initialization.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.devices()  # init the CPU backend single-threaded, up front

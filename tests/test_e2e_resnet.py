@@ -1,0 +1,65 @@
+"""Acceptance config #1 (BASELINE.md): ResNet on CIFAR-10-shaped data,
+single device — compiled train step converges."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as optim
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import resnet18, resnet50
+
+
+def test_resnet50_builds_and_forwards():
+    paddle.seed(1)
+    m = resnet50(num_classes=10)
+    n_params = sum(p.size for p in m.parameters())
+    assert 23_000_000 < n_params < 26_000_000  # ~23.5M + fc
+    m.eval()
+    out = m(paddle.randn([2, 3, 64, 64]))
+    assert out.shape == [2, 10]
+
+
+def test_resnet_trains_on_fake_cifar():
+    paddle.seed(2)
+    model = resnet18(num_classes=10)
+    model.train()
+    opt = optim.Momentum(0.05, parameters=model.parameters(),
+                         weight_decay=1e-4)
+    loss_fn = nn.CrossEntropyLoss()
+    data = FakeData(size=64, image_shape=(3, 32, 32), num_classes=10)
+    loader = DataLoader(data, batch_size=32, shuffle=True, num_workers=2)
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = []
+    for epoch in range(6):
+        for x, y in loader:
+            losses.append(float(step(x, y)))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_hapi_model_fit():
+    paddle.seed(3)
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision.models import LeNet
+
+    net = LeNet(num_classes=10)
+    model = Model(net)
+    model.prepare(
+        optim.Adam(0.001, parameters=net.parameters()),
+        nn.CrossEntropyLoss(),
+        Accuracy(),
+    )
+    data = FakeData(size=32, image_shape=(1, 28, 28), num_classes=10)
+    model.fit(data, batch_size=16, epochs=1, verbose=0)
+    res = model.evaluate(data, batch_size=16, verbose=0)
+    assert "loss" in res

@@ -1,0 +1,288 @@
+"""Layer / optimizer / amp / to_static tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as optim
+
+
+def setup_module():
+    paddle.seed(42)
+
+
+class TestLayer:
+    def test_state_dict_roundtrip(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = m.state_dict()
+        assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(sd)
+        for k in sd:
+            np.testing.assert_array_equal(
+                sd[k].numpy(), m2.state_dict()[k].numpy()
+            )
+
+    def test_save_load(self, tmp_path):
+        m = nn.Linear(3, 3)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(m.state_dict(), path)
+        loaded = paddle.load(path)
+        np.testing.assert_array_equal(
+            loaded["weight"].numpy(), m.weight.numpy()
+        )
+
+    def test_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        h = m.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1)
+        )
+        m(paddle.randn([1, 2]))
+        assert calls == [1]
+        h.remove()
+        m(paddle.randn([1, 2]))
+        assert calls == [1]
+
+    def test_train_eval_dropout(self):
+        d = nn.Dropout(0.99)
+        x = paddle.ones([100])
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), np.ones(100))
+        d.train()
+        assert (d(x).numpy() == 0).mean() > 0.8
+
+    def test_batchnorm_running_stats(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.randn([4, 3, 8, 8]) * 2 + 5
+        bn.train()
+        bn(x)
+        assert abs(bn._mean.numpy().mean() - 0.5) < 0.2  # 0.9*0 + 0.1*5
+        bn.eval()
+        y = bn(x)
+        assert y.shape == [4, 3, 8, 8]
+
+    def test_layernorm_matches_numpy(self):
+        ln = nn.LayerNorm(16)
+        x = np.random.randn(4, 16).astype(np.float32)
+        got = ln(paddle.to_tensor(x)).numpy()
+        want = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+            x.var(-1, keepdims=True) + 1e-5
+        )
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestOptimizer:
+    def _train(self, opt_fn, steps=60):
+        paddle.seed(0)
+        m = nn.Linear(8, 1)
+        o = opt_fn(m)
+        x = paddle.randn([64, 8])
+        w_true = paddle.randn([8, 1])
+        y = paddle.matmul(x, w_true)
+        for _ in range(steps):
+            loss = F.mse_loss(m(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        return float(F.mse_loss(m(x), y))
+
+    def test_sgd_converges(self):
+        assert self._train(
+            lambda m: optim.SGD(0.1, parameters=m.parameters())
+        ) < 0.05
+
+    def test_adamw_converges(self):
+        assert self._train(
+            lambda m: optim.AdamW(0.05, parameters=m.parameters())
+        ) < 0.05
+
+    def test_momentum_converges(self):
+        assert self._train(
+            lambda m: optim.Momentum(0.05, parameters=m.parameters())
+        ) < 0.05
+
+    def test_adamw_matches_reference_update(self):
+        # one step of AdamW vs closed-form numpy
+        p0 = np.array([[1.0, -2.0]], np.float32)
+        g = np.array([[0.5, 0.3]], np.float32)
+        m = nn.Linear(1, 2)
+        m.weight.set_value(p0)
+        m.weight._grad = paddle.to_tensor(g)
+        o = optim.AdamW(
+            learning_rate=0.1, parameters=[m.weight], weight_decay=0.01
+        )
+        o.step()
+        lr, b1, b2, eps, wd = 0.1, 0.9, 0.999, 1e-8, 0.01
+        p = p0 * (1 - lr * wd)
+        m1 = (1 - b1) * g
+        v1 = (1 - b2) * g * g
+        mhat = m1 / (1 - b1)
+        vhat = v1 / (1 - b2)
+        want = p - lr * mhat / (np.sqrt(vhat) + eps)
+        np.testing.assert_allclose(m.weight.numpy(), want, rtol=1e-5)
+
+    def test_grad_clip_global_norm(self):
+        m = nn.Linear(2, 2)
+        o = optim.SGD(1.0, parameters=m.parameters(),
+                      grad_clip=nn.ClipGradByGlobalNorm(0.1))
+        big = paddle.ones([2, 2]) * 100
+        m.weight._grad = big
+        m.bias._grad = paddle.ones([2]) * 100
+        w0 = m.weight.numpy().copy()
+        o.step()
+        delta = np.linalg.norm(m.weight.numpy() - w0)
+        assert delta < 0.11
+
+    def test_lr_scheduler(self):
+        m = nn.Linear(2, 2)
+        sched = optim.lr.StepDecay(0.1, step_size=2, gamma=0.1)
+        o = optim.SGD(sched, parameters=m.parameters())
+        assert abs(o.get_lr() - 0.1) < 1e-9
+        sched.step()
+        sched.step()
+        assert abs(o.get_lr() - 0.01) < 1e-9
+
+    def test_optimizer_state_dict(self):
+        m = nn.Linear(2, 2)
+        o = optim.AdamW(0.01, parameters=m.parameters())
+        loss = m(paddle.randn([4, 2])).sum()
+        loss.backward()
+        o.step()
+        sd = o.state_dict()
+        o2 = optim.AdamW(0.01, parameters=m.parameters())
+        o2.set_state_dict(sd)
+        a = o._accumulators["moment1"][m.weight._uid].numpy()
+        b = o2._accumulators["moment1"][m.weight._uid].numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestToStatic:
+    def test_compiled_step_matches_eager(self):
+        paddle.seed(5)
+        m1 = nn.Linear(4, 4)
+        m2 = nn.Linear(4, 4)
+        m2.set_state_dict(m1.state_dict())
+        o1 = optim.SGD(0.1, parameters=m1.parameters())
+        o2 = optim.SGD(0.1, parameters=m2.parameters())
+        x = paddle.randn([8, 4])
+        y = paddle.randn([8, 4])
+
+        @paddle.jit.to_static
+        def step1(x, y):
+            loss = F.mse_loss(m1(x), y)
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+            return loss
+
+        def step2(x, y):
+            loss = F.mse_loss(m2(x), y)
+            loss.backward()
+            o2.step()
+            o2.clear_grad()
+            return loss
+
+        for _ in range(5):
+            l1 = step1(x, y)
+            l2 = step2(x, y)
+            np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        np.testing.assert_allclose(
+            m1.weight.numpy(), m2.weight.numpy(), rtol=1e-5, atol=1e-6
+        )
+
+    def test_cache_and_retrace(self):
+        m = nn.Linear(4, 2)
+        calls = []
+
+        @paddle.jit.to_static
+        def fwd(x):
+            calls.append(1)
+            return m(x)
+
+        fwd(paddle.randn([2, 4]))
+        fwd(paddle.randn([2, 4]))
+        assert len(calls) == 1  # cache hit → no retrace
+        fwd(paddle.randn([3, 4]))
+        assert len(calls) == 2  # new shape → retrace
+
+    def test_rng_state_in_compiled_step(self):
+        drop = nn.Dropout(0.5)
+
+        @paddle.jit.to_static
+        def f(x):
+            return drop(x)
+
+        x = paddle.ones([1000])
+        a = f(x).numpy()
+        b = f(x).numpy()
+        assert not np.allclose(a, b)  # rng advanced between calls
+
+
+class TestAmp:
+    def test_autocast_casts_matmul(self):
+        import jax.numpy as jnp
+
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = paddle.matmul(a, b)
+        assert out._data.dtype == jnp.bfloat16
+        out2 = paddle.matmul(a, b)
+        assert out2._data.dtype == jnp.float32
+
+    def test_grad_scaler_noop_path(self):
+        m = nn.Linear(2, 2)
+        o = optim.SGD(0.1, parameters=m.parameters())
+        scaler = paddle.amp.GradScaler(enable=False)
+        loss = m(paddle.randn([2, 2])).sum()
+        scaler.scale(loss).backward()
+        scaler.step(o)
+        scaler.update()
+
+    def test_o2_decorate(self):
+        import jax.numpy as jnp
+
+        m = nn.Linear(4, 4)
+        o = optim.AdamW(0.01, parameters=m.parameters())
+        m, o = paddle.amp.decorate(m, o, level="O2", dtype="bfloat16")
+        assert m.weight._data.dtype == jnp.bfloat16
+        loss = m(paddle.randn([2, 4]).astype("bfloat16")).sum()
+        loss.backward()
+        o.step()
+        # master weights stay fp32
+        master = o._master_weights[m.weight._uid]
+        assert master._data.dtype == jnp.float32
+
+
+class TestDataLoader:
+    def test_basic(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32), np.int64(i % 2)
+
+        dl = DataLoader(DS(), batch_size=4, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        xb, yb = batches[0]
+        assert xb.shape == [4, 3] and yb.shape == [4]
+
+    def test_multiworker_order(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 20
+
+            def __getitem__(self, i):
+                return np.int64(i)
+
+        dl = DataLoader(DS(), batch_size=5, num_workers=3)
+        got = np.concatenate([b.numpy() for b in dl])
+        np.testing.assert_array_equal(got, np.arange(20))

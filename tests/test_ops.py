@@ -1,0 +1,222 @@
+"""Op-level tests, following the reference's OpTest pattern
+(test/legacy_test/op_test.py): check outputs against numpy references and
+analytic gradients against jax.grad (which is itself verified against
+finite differences for a sample of ops)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def setup_module():
+    paddle.seed(2024)
+
+
+def _t(arr, sg=True):
+    t = paddle.to_tensor(np.asarray(arr, np.float32))
+    t.stop_gradient = sg
+    return t
+
+
+class TestForward:
+    def test_elementwise(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            (paddle.add(_t(a), _t(b))).numpy(), a + b, rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            (paddle.multiply(_t(a), _t(b))).numpy(), a * b, rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            paddle.exp(_t(a)).numpy(), np.exp(a), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            paddle.maximum(_t(a), _t(b)).numpy(), np.maximum(a, b)
+        )
+
+    def test_matmul(self):
+        a = np.random.randn(4, 5).astype(np.float32)
+        b = np.random.randn(5, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.matmul(_t(a), _t(b)).numpy(), a @ b, rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            paddle.matmul(_t(a), _t(b.T), transpose_y=True).numpy(), a @ b,
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_reductions(self):
+        a = np.random.randn(3, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.sum(_t(a), axis=1).numpy(), a.sum(1), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            paddle.mean(_t(a)).numpy(), a.mean(), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            paddle.max(_t(a), axis=[0, 2]).numpy(), a.max((0, 2))
+        )
+
+    def test_manipulation(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        assert paddle.reshape(_t(a), [6, 4]).shape == [6, 4]
+        assert paddle.transpose(_t(a), [2, 0, 1]).shape == [4, 2, 3]
+        assert paddle.flatten(_t(a), 1).shape == [2, 12]
+        parts = paddle.split(_t(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+        c = paddle.concat([_t(a), _t(a)], axis=0)
+        assert c.shape == [4, 3, 4]
+        s = paddle.stack([_t(a), _t(a)], axis=0)
+        assert s.shape == [2, 2, 3, 4]
+
+    def test_gather_scatter(self):
+        a = np.random.randn(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        np.testing.assert_allclose(
+            paddle.gather(_t(a), paddle.to_tensor(idx)).numpy(), a[idx]
+        )
+        out = paddle.scatter(
+            _t(a), paddle.to_tensor(np.array([0, 1])),
+            _t(np.ones((2, 3), np.float32)),
+        )
+        expect = a.copy()
+        expect[[0, 1]] = 1.0
+        np.testing.assert_allclose(out.numpy(), expect)
+
+    def test_search(self):
+        a = np.random.randn(4, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.argmax(_t(a), axis=1).numpy(), a.argmax(1)
+        )
+        v, i = paddle.topk(_t(a), k=2, axis=1)
+        np.testing.assert_allclose(v.numpy(), np.sort(a, 1)[:, ::-1][:, :2],
+                                   rtol=1e-6)
+
+    def test_logic(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([1.0, 5.0, 2.0], np.float32)
+        np.testing.assert_array_equal(
+            (_t(a) < _t(b)).numpy(), a < b
+        )
+        assert bool(paddle.allclose(_t(a), _t(a)))
+
+    def test_indexing(self):
+        a = np.random.randn(5, 4).astype(np.float32)
+        t = _t(a)
+        np.testing.assert_allclose(t[1:3].numpy(), a[1:3])
+        np.testing.assert_allclose(t[:, ::2].numpy(), a[:, ::2])
+        t[0] = 9.0
+        assert np.allclose(t.numpy()[0], 9.0)
+
+
+class TestGrad:
+    """Analytic grads vs numeric finite differences (OpTest.check_grad)."""
+
+    def _check_grad(self, op, *arrs, atol=1e-2):
+        ts = [_t(a, sg=False) for a in arrs]
+        out = op(*ts)
+        loss = paddle.sum(out * out)
+        loss.backward()
+        eps = 1e-3
+        for i, a in enumerate(arrs):
+            num = np.zeros_like(a)
+            flat = a.reshape(-1)
+            for j in range(min(flat.size, 24)):
+                for sign, store in ((1, 0), (-1, 1)):
+                    pert = a.copy().reshape(-1)
+                    pert[j] += sign * eps
+                    args = list(arrs)
+                    args[i] = pert.reshape(a.shape)
+                    o = op(*[_t(x) for x in args])
+                    val = float(paddle.sum(o * o))
+                    if store == 0:
+                        plus = val
+                    else:
+                        minus = val
+                num.reshape(-1)[j] = (plus - minus) / (2 * eps)
+            got = ts[i].grad.numpy().reshape(-1)[: min(flat.size, 24)]
+            want = num.reshape(-1)[: min(flat.size, 24)]
+            np.testing.assert_allclose(got, want, atol=atol, rtol=1e-2)
+
+    def test_matmul_grad(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 2).astype(np.float32)
+        self._check_grad(lambda x, y: paddle.matmul(x, y), a, b)
+
+    def test_tanh_grad(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        self._check_grad(lambda x: paddle.tanh(x), a)
+
+    def test_softmax_ce_grad(self):
+        import paddle_tpu.nn.functional as F
+
+        logits = np.random.randn(4, 5).astype(np.float32)
+        label = np.array([1, 0, 3, 2])
+
+        t = _t(logits, sg=False)
+        loss = F.cross_entropy(t, paddle.to_tensor(label))
+        loss.backward()
+        # reference: softmax - onehot, averaged
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        onehot = np.eye(5)[label]
+        np.testing.assert_allclose(
+            t.grad.numpy(), (p - onehot) / 4, atol=1e-5
+        )
+
+    def test_accumulation_and_hooks(self):
+        a = _t(np.ones((3,), np.float32), sg=False)
+        (a * 2).sum().backward()
+        (a * 3).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), [5.0, 5.0, 5.0])
+
+        b = _t(np.ones((3,), np.float32), sg=False)
+        b.register_hook(lambda g: g * 10)
+        (b * 2).sum().backward()
+        np.testing.assert_allclose(b.grad.numpy(), [20.0, 20.0, 20.0])
+
+    def test_version_check(self):
+        a = _t(np.ones((3,), np.float32), sg=False)
+        y = a * 2
+        a.set_value(np.zeros((3,), np.float32))
+        with pytest.raises(RuntimeError):
+            y.sum().backward()
+
+    def test_autograd_grad_api(self):
+        x = _t(np.array([2.0], np.float32), sg=False)
+        y = x * x * x
+        (g,) = paddle.grad(y, x, create_graph=False)
+        np.testing.assert_allclose(g.numpy(), [12.0])
+
+    def test_pylayer(self):
+        class Double(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 2
+
+        x = _t(np.array([1.0, 2.0], np.float32), sg=False)
+        Double.apply(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        paddle.seed(123)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(123)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+        c = paddle.randn([4, 4]).numpy()
+        assert not np.allclose(b, c)
+
+    def test_no_grad(self):
+        x = _t(np.ones(3), sg=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
