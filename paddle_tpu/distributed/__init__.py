@@ -12,3 +12,26 @@ from .env import (  # noqa
     get_world_size,
     is_initialized,
 )
+from .collective import (  # noqa
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_into_tensor,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    is_available,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .parallel import DataParallel, init_parallel_env  # noqa
+from . import fleet  # noqa
+from . import sharding  # noqa

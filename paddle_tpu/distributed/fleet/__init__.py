@@ -1,0 +1,32 @@
+"""paddle_tpu.distributed.fleet — the hybrid-parallel training facade
+(upstream: python/paddle/distributed/fleet/__init__.py)."""
+from __future__ import annotations
+
+from . import meta_parallel  # noqa: F401
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    ParallelMode,
+    get_hybrid_communicate_group,
+)
+from .fleet import (  # noqa: F401
+    Fleet,
+    distributed_model,
+    distributed_optimizer,
+    fleet,
+    init,
+    worker_index,
+)
+from .meta_parallel.parallel_layers.random import (  # noqa: F401
+    get_rng_state_tracker,
+)
+from .recompute import recompute  # noqa: F401
+
+__all__ = [
+    "Fleet", "fleet", "init", "DistributedStrategy",
+    "HybridCommunicateGroup", "CommunicateTopology", "ParallelMode",
+    "get_hybrid_communicate_group", "distributed_model",
+    "distributed_optimizer", "worker_index", "meta_parallel",
+    "get_rng_state_tracker", "recompute",
+]

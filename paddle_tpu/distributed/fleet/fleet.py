@@ -57,20 +57,18 @@ class Fleet:
         if self._hcg is None:
             return DataParallel(model)
         mode = self._hcg.get_parallel_mode()
-        from ..meta_parallel_wrappers import (
-            PipelineParallelWrapper,
-            ShardingParallelWrapper,
-            TensorParallelWrapper,
+        from .meta_parallel import (
+            PipelineParallel,
+            ShardingParallel,
+            TensorParallel,
         )
 
         if mode == ParallelMode.PIPELINE_PARALLEL:
-            from .meta_parallel.pipeline_parallel import PipelineParallel
-
             return PipelineParallel(model, self._hcg, self._strategy)
         if mode == ParallelMode.TENSOR_PARALLEL:
-            return TensorParallelWrapper(model, self._hcg, self._strategy)
+            return TensorParallel(model, self._hcg, self._strategy)
         if mode == ParallelMode.SHARDING_PARALLEL:
-            return ShardingParallelWrapper(model, self._hcg, self._strategy)
+            return ShardingParallel(model, self._hcg, self._strategy)
         if self._hcg.get_data_parallel_world_size() > 1:
             return DataParallel(model)
         return model

@@ -15,12 +15,12 @@ from __future__ import annotations
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ....framework.core import Tensor
-from ....nn import functional as F
-from ....nn import initializer as I
-from ....nn.layer.layers import Layer
-from ...mesh import axis_degree, global_mesh, named_sharding
-from ..base.topology import get_hybrid_communicate_group
+from .....framework.core import Tensor
+from .....nn import functional as F
+from .....nn import initializer as I
+from .....nn.layer.layers import Layer
+from ....mesh import axis_degree, global_mesh, named_sharding
+from ...base.topology import get_hybrid_communicate_group
 from .mp_ops import _c_concat, _c_identity, _c_split, _mp_allreduce, \
     shard_constraint
 

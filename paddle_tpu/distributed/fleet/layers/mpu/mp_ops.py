@@ -13,9 +13,9 @@ from __future__ import annotations
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ....framework.core import Tensor, apply_op, _as_tensor
-from ...collective import _resolve
-from ...mesh import global_mesh, in_manual_context
+from .....framework.core import Tensor, apply_op, _as_tensor
+from ....collective import _resolve
+from ....mesh import global_mesh, in_manual_context
 
 
 def shard_constraint(x, *spec):

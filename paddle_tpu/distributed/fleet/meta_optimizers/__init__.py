@@ -1,0 +1,6 @@
+from .dygraph_optimizer import (
+    DygraphShardingOptimizer,
+    HybridParallelOptimizer,
+)
+
+__all__ = ["DygraphShardingOptimizer", "HybridParallelOptimizer"]

@@ -1,0 +1,4 @@
+from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+from .hybrid_parallel_optimizer import HybridParallelOptimizer
+
+__all__ = ["DygraphShardingOptimizer", "HybridParallelOptimizer"]

@@ -1,0 +1,68 @@
+"""DygraphShardingOptimizer — ZeRO stage 1 (upstream: python/paddle/
+distributed/fleet/meta_optimizers/dygraph_optimizer/
+dygraph_sharding_optimizer.py).
+
+Reference semantics: params are assigned to sharding-group ranks by
+size-balanced partition; each rank keeps optimizer state and runs the
+update for its shard only, then broadcasts updated params. TPU-native:
+the accumulators and fp32 master weights are placed with a NamedSharding
+over the "sharding" mesh axis — each device materializes only its
+1/degree slice of optimizer state, the compiled update runs shard-local,
+and the partitioner re-gathers params where the next forward needs them
+(the reference's broadcast)."""
+from __future__ import annotations
+
+from ....mesh import axis_degree
+from ...meta_parallel.sharding.group_sharded_utils import (
+    apply_zero_sharding,
+)
+
+
+class DygraphShardingOptimizer:
+    def __init__(self, optimizer, hcg=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._sharding_degree = axis_degree("sharding")
+        self._sharded = False
+
+    def _shard_states(self):
+        self._inner_opt._create_accumulators()
+        for t in self._inner_opt._state_tensors():
+            apply_zero_sharding(t)
+        self._sharded = True
+
+    def _create_accumulators(self):
+        self._inner_opt._create_accumulators()
+        if not self._sharded:
+            self._shard_states()
+
+    def step(self):
+        if not self._sharded:
+            self._shard_states()
+        return self._inner_opt.step()
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        return self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def _state_tensors(self):
+        return self._inner_opt._state_tensors()
+
+    @property
+    def _parameter_list(self):
+        return self._inner_opt._parameter_list
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

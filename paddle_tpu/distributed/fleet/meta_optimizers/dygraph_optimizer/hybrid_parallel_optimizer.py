@@ -1,0 +1,62 @@
+"""HybridParallelOptimizer (upstream: python/paddle/distributed/fleet/
+meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py).
+
+Reference responsibilities: (1) make ClipGradByGlobalNorm sum squared
+norms across the mp/pp/sharding groups before clipping (each rank only
+holds a parameter shard); (2) wrap the inner optimizer in
+DygraphShardingOptimizer when sharding_degree > 1; (3) fuse/overlap
+grad comm. Under single-controller SPMD, (1) is automatic — parameters
+and grads are global arrays, so the local norm IS the global norm — and
+(3) is XLA's scheduler. This class keeps the API and does (2)."""
+from __future__ import annotations
+
+from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy):
+        self._hcg = hcg
+        self._strategy = strategy
+        self._need_dp = (
+            hcg is not None and hcg.get_data_parallel_world_size() > 1
+        )
+        if (
+            hcg is not None
+            and hcg.get_sharding_parallel_world_size() > 1
+        ):
+            self._inner_opt = DygraphShardingOptimizer(optimizer, hcg)
+        else:
+            self._inner_opt = optimizer
+
+    def step(self):
+        return self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        return self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def _create_accumulators(self):
+        self._inner_opt._create_accumulators()
+
+    def _state_tensors(self):
+        return self._inner_opt._state_tensors()
+
+    @property
+    def _parameter_list(self):
+        return self._inner_opt._parameter_list
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

@@ -1,0 +1,34 @@
+from .meta_parallel_base import MetaParallelBase
+from .parallel_layers.pp_layers import (
+    LayerDesc,
+    PipelineLayer,
+    SharedLayerDesc,
+)
+from .parallel_layers.random import (
+    RNGStatesTracker,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+from .pipeline_parallel import (
+    PipelineParallel,
+    PipelineParallelWithInterleave,
+)
+from .sharding_parallel import ShardingParallel
+from .tensor_parallel import TensorParallel
+
+# TP layers re-exported here for reference-path parity
+from ..layers.mpu.mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+
+__all__ = [
+    "MetaParallelBase", "LayerDesc", "SharedLayerDesc", "PipelineLayer",
+    "PipelineParallel", "PipelineParallelWithInterleave",
+    "TensorParallel", "ShardingParallel", "RNGStatesTracker",
+    "get_rng_state_tracker", "model_parallel_random_seed",
+    "ColumnParallelLinear", "RowParallelLinear",
+    "VocabParallelEmbedding", "ParallelCrossEntropy",
+]

@@ -1,0 +1,40 @@
+"""Base wrapper for hybrid-parallel model containers (upstream:
+python/paddle/distributed/fleet/meta_parallel/meta_parallel_base.py).
+
+The reference's wrappers broadcast parameters across their comm groups
+at construction (startup sync) and then delegate forward. Under
+single-controller SPMD one global copy of each parameter exists, so
+startup sync is inherent; the wrappers keep the API and the
+parallel-mode-specific preparation (RNG tracker wiring, sharding
+placement)."""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    # delegate the Layer state surface to the wrapped model
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)

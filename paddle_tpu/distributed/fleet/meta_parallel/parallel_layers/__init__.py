@@ -1,0 +1,7 @@
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .random import RNGStatesTracker, get_rng_state_tracker
+
+__all__ = [
+    "LayerDesc", "PipelineLayer", "SharedLayerDesc",
+    "RNGStatesTracker", "get_rng_state_tracker",
+]

@@ -1,0 +1,319 @@
+"""Pipeline stage declaration (upstream: python/paddle/distributed/
+fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc,
+SharedLayerDesc, PipelineLayer).
+
+TPU-native design. The reference's PipelineLayer materializes only the
+local stage's layers in each worker process and exchanges activations
+over NCCL p2p. Under single-controller SPMD the whole model lives in one
+program, so PipelineLayer instead:
+
+* splits the declared layer list into [pre | body | post], where *body*
+  is the maximal run of structurally-identical LayerDescs (transformer
+  blocks). Heterogeneous prefixes/suffixes (embedding, final norm, lm
+  head) run outside the pipelined region, batched over all microbatches
+  at once — bigger matmuls, better MXU utilization than the reference's
+  per-stage placement;
+* builds the body ONCE as a template layer plus **stacked parameters**
+  of shape [n_layers, ...] sharded over the "pp" mesh axis (each stage
+  owns n_layers/num_stages contiguous layers) — this is what makes the
+  compiled pipeline schedule in pipeline_parallel.py a single
+  scan-over-ticks program whose stage shift lowers to an ICI
+  collective-permute;
+* ties SharedLayerDesc occurrences to ONE parameter tensor, so the
+  reference's shared-embedding gradient allreduce across stages becomes
+  ordinary gradient accumulation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .....framework.core import EagerParamBase, Tensor, no_grad
+from .....framework.random import Generator, override_generator
+from .....nn.layer.layers import Layer, LayerList
+from ....mesh import global_mesh
+from ...base.topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    """Deferred layer construction: class + ctor args."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError(f"{layer_func} must be a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def signature(self):
+        """Structural identity used to detect a uniform (stackable) run."""
+        return (
+            self.layer_func,
+            tuple(repr(i) for i in self.inputs),
+            tuple(sorted((k, repr(v)) for k, v in self.kwargs.items())),
+        )
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose parameters are shared between its occurrences
+    (tied input/output embeddings). All occurrences resolve to one
+    built instance; ``forward_func`` overrides the call at this
+    position."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+    def signature(self):
+        return ("shared", self.layer_name, id(self))
+
+
+class _SharedCall(Layer):
+    """Second+ occurrence of a SharedLayerDesc: reuse the built layer,
+    call through forward_func (does NOT re-register the params — they
+    belong to the first occurrence)."""
+
+    def __init__(self, shared_layer, forward_func):
+        super().__init__()
+        object.__setattr__(self, "_shared", shared_layer)
+        self._forward_func = forward_func
+
+    def forward(self, *args, **kwargs):
+        if self._forward_func is not None:
+            return self._forward_func(self._shared, *args, **kwargs)
+        return self._shared(*args, **kwargs)
+
+
+class _StackedBody(Layer):
+    """The pipelined body: one template layer + stacked params
+    [n_layers, ...] (pp-sharded on dim 0)."""
+
+    def __init__(self, desc: LayerDesc, n_layers: int, num_stages: int):
+        super().__init__()
+        self.n_layers = n_layers
+        self.num_stages = num_stages
+        self.template = desc.build_layer()
+        if list(self.template.buffers()):
+            raise ValueError(
+                "pipelined body layers must be buffer-free (e.g. no "
+                "BatchNorm running stats); got buffers in "
+                f"{type(self.template).__name__}"
+            )
+        self._tparams = [p for _, p in self.template.named_parameters()]
+        # draw per-layer inits by rebuilding the desc, then stack
+        per_layer = [[p._data for p in self._tparams]]
+        for _ in range(n_layers - 1):
+            inst = desc.build_layer()
+            per_layer.append(
+                [p._data for _, p in inst.named_parameters()]
+            )
+        mesh = global_mesh()
+        for i, (name, tp) in enumerate(self.template.named_parameters()):
+            stacked = jnp.stack([pl[i] for pl in per_layer])
+            spec = ("pp",) + tuple(tp._dist_attr or ())
+            if mesh is not None and "pp" in mesh.axis_names \
+                    and n_layers % mesh.shape["pp"] == 0:
+                stacked = jax.device_put(
+                    stacked, NamedSharding(mesh, PartitionSpec(*spec))
+                )
+            sp = EagerParamBase(stacked, name=name.replace(".", "_"))
+            sp._dist_attr = spec
+            sp.stop_gradient = tp.stop_gradient
+            self.add_parameter("stacked_" + name.replace(".", "__"), sp)
+        del per_layer
+        # template's own params are detached from training: exclude them
+        # from this Layer's parameter walk by removing the sublayer link
+        # and keeping a plain-object reference for functional binding.
+        tmpl = self.template
+        del self._sub_layers["template"]
+        object.__setattr__(self, "template", tmpl)
+
+    def stacked_params(self):
+        return [
+            p for n, p in self.named_parameters()
+            if n.startswith("stacked_")
+        ]
+
+    def apply_one(self, leaf_raws, x_raw, key_raw):
+        """Pure: apply the template with param leaves bound (used inside
+        the compiled pipeline scan and the sequential fallback)."""
+        tmp = Generator.__new__(Generator)
+        tmp._seed = 0
+        tmp.key = Tensor(jax.random.key_data(key_raw), stop_gradient=True)
+        tmp.counter = Tensor(jnp.zeros((), jnp.uint32), stop_gradient=True)
+        saved = [(p, p._data) for p in self._tparams]
+        try:
+            for p, r in zip(self._tparams, leaf_raws):
+                p._data = r
+            with override_generator(tmp), no_grad():
+                out = self.template(Tensor(x_raw))
+        finally:
+            for p, d in saved:
+                p._data = d
+        return out._data
+
+    def forward(self, x):
+        """Sequential (non-pipelined) application of all n_layers —
+        eval / single-device path."""
+        from .....framework.core import apply_op
+        from .....framework.random import next_key
+
+        params = self.stacked_params()
+        key = next_key()
+
+        def fn(xr, *stacked_raws):
+            h = xr
+            for i in range(self.n_layers):
+                leaves = [s[i] for s in stacked_raws]
+                h = self.apply_one(
+                    leaves, h, jax.random.fold_in(key, i)
+                )
+            return h
+
+        return apply_op("stacked_body_seq", fn, x, *params)
+
+
+class PipelineLayer(Layer):
+    """Declarative pipeline container (API-parity with the reference's
+    PipelineLayer; see module docstring for the TPU-native execution
+    model)."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None, **kwargs):
+        super().__init__()
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            if topology is not None:
+                num_stages = topology.get_dim("pipe")
+            elif hcg is not None:
+                num_stages = hcg.get_pipe_parallel_world_size()
+            else:
+                num_stages = 1
+        self._num_stages = int(num_stages)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._virtual_pp_degree = num_virtual_pipeline_stages or 1
+        self._descs = list(layers)
+        self._shared_built = {}
+
+        pre, body_descs, post = self._segment(self._descs)
+
+        self.pre_layers = LayerList(
+            [self._build(d) for d in pre]
+        )
+        self.post_layers = LayerList(
+            [self._build(d) for d in post]
+        )
+        if body_descs:
+            self.body = _StackedBody(
+                body_descs[0], len(body_descs), self._num_stages
+            )
+        else:
+            self.body = None
+
+    # -- construction ------------------------------------------------------
+    def _build(self, desc):
+        if isinstance(desc, SharedLayerDesc):
+            if desc.layer_name in self._shared_built:
+                return _SharedCall(
+                    self._shared_built[desc.layer_name], desc.forward_func
+                )
+            built = desc.build_layer()
+            self._shared_built[desc.layer_name] = built
+            return built
+        if isinstance(desc, LayerDesc):
+            return desc.build_layer()
+        if isinstance(desc, Layer):
+            return desc
+        if callable(desc):
+            return _FnLayer(desc)
+        raise TypeError(f"cannot build pipeline layer from {desc!r}")
+
+    def _segment(self, descs):
+        """Find the maximal uniform LayerDesc run divisible by
+        num_stages → [pre | body | post]."""
+        sigs = [
+            d.signature() if isinstance(d, LayerDesc)
+            and not isinstance(d, SharedLayerDesc) else None
+            for d in descs
+        ]
+        best = (0, 0)  # (len, start)
+        i = 0
+        while i < len(sigs):
+            if sigs[i] is None:
+                i += 1
+                continue
+            j = i
+            while j < len(sigs) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[0]:
+                best = (j - i, i)
+            i = j
+        run_len, start = best
+        usable = (run_len // self._num_stages) * self._num_stages
+        if usable < 2 or usable < self._num_stages:
+            return descs, [], []
+        # keep the run aligned to its start
+        return (
+            descs[:start],
+            descs[start:start + usable],
+            descs[start + usable:],
+        )
+
+    # -- reference API surface --------------------------------------------
+    def get_num_stages(self):
+        return self._num_stages
+
+    @property
+    def parameters_are_stacked(self):
+        return self.body is not None
+
+    def allreduce_shared_weight_gradients(self):
+        # tied weights are literally one tensor here; grads already
+        # accumulated on it by the tape
+        pass
+
+    def get_stage_from_index(self, layer_idx):
+        n_pre = len(self.pre_layers)
+        n_body = self.body.n_layers if self.body else 0
+        if layer_idx < n_pre:
+            return 0
+        if layer_idx < n_pre + n_body:
+            per = n_body // self._num_stages
+            return (layer_idx - n_pre) // per
+        return self._num_stages - 1
+
+    def forward(self, x):
+        for l in self.pre_layers:
+            x = l(x)
+        if self.body is not None:
+            x = self.body(x)
+        for l in self.post_layers:
+            x = l(x)
+        return x
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+def get_pipeline_model_parallel_world_size():
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_pipe_parallel_world_size() if hcg else 1

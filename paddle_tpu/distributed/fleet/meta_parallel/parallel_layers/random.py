@@ -1,0 +1,88 @@
+"""RNG state tracker for hybrid parallelism (upstream:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py
+— RNGStatesTracker keeps named curand states so dropout inside the TP
+region is identical within an mp group but different across dp).
+
+TPU-native: each named state is a counter-based :class:`Generator`
+(key, counter) pair. Under single-controller GSPMD arrays are *global*,
+so one global key already yields (a) identical masks for replicated
+activations across the mp group and (b) a single consistent global mask
+for activations sharded over dp/mp — the property the reference builds
+from per-rank seed arithmetic falls out of global-array semantics. The
+named states are still real and trace-captured: they give reproducible,
+independent streams per region ("global_seed" vs "local_seed"), survive
+`get_states/set_states` round-trips, and compile into the step function.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .....framework.random import Generator, override_generator
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset_basic_seed(self, basic_seed: int):
+        """Re-key every tracked state off a new basic seed (called by
+        paddle_tpu.seed)."""
+        for i, name in enumerate(sorted(self.states_)):
+            self.states_[name].manual_seed(basic_seed + 1024 + i)
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            if n not in self.states_:
+                self.states_[n] = Generator(0)
+            self.states_[n].set_state(s)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        with override_generator(self.states_[name]):
+            yield
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = 100):
+    """Set up the two standard named states the reference creates in
+    topology init: a tp-region state and the global state."""
+    import paddle_tpu
+
+    global_seed = seed
+    local_seed = seed + 1024
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    paddle_tpu.seed(global_seed)
+
+
+def determinate_rng(*args, **kwargs):
+    raise NotImplementedError(
+        "determinate_rng is an auto-parallel internal; use "
+        "get_rng_state_tracker().rng_state(name)"
+    )

@@ -1,0 +1,102 @@
+"""GroupSharded stage 2 — ZeRO-2 (upstream: python/paddle/distributed/
+fleet/meta_parallel/sharding/group_sharded_stage2.py +
+group_sharded_optimizer_stage2.py).
+
+Reference semantics: gradients are reduced to their owning rank only
+(fused GradStorage buffers), optimizer state lives only on the owner,
+updated params broadcast after step. TPU-native: optimizer accumulators
+get a NamedSharding over the "sharding" axis, and each param's grad is
+constrained to the same sharding — XLA then emits reduce-scatter for
+the grads and runs the update shard-local; the "broadcast" back is the
+partitioner re-gathering params where used."""
+from __future__ import annotations
+
+from .....nn.layer.layers import Layer
+from .group_sharded_utils import apply_zero_sharding, shard_grad_hook
+
+
+class GroupShardedOptimizerStage2:
+    def __init__(self, params, optim, group=None, offload=False,
+                 device="tpu", **kwargs):
+        if offload:
+            raise NotImplementedError(
+                "CPU offload: use jax.checkpoint offload policies / "
+                "host memory kinds; not wired in this release"
+            )
+        self._optim = optim
+        self._params = list(params)
+        self._group = group
+        self._sharded = False
+
+    def _shard_states(self):
+        self._optim._create_accumulators()
+        # all optimizer state (moments, master weights); 0-d state like
+        # the lr tensor is skipped by zero_shard_spec
+        for acc in self._optim._state_tensors():
+            apply_zero_sharding(acc)
+        self._sharded = True
+
+    def step(self):
+        if not self._sharded:
+            self._shard_states()
+        return self._optim.step()
+
+    def clear_grad(self, set_to_zero=False):
+        return self._optim.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        return self._optim.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._optim.set_state_dict(sd)
+
+    def _create_accumulators(self):
+        self._optim._create_accumulators()
+        if not self._sharded:
+            self._shard_states()
+
+    def _state_tensors(self):
+        return self._optim._state_tensors()
+
+    def __getattr__(self, item):
+        return getattr(self._optim, item)
+
+
+class GroupShardedStage2(Layer):
+    def __init__(self, layer, sharding_optimizer, group=None,
+                 sync_buffers=False, buffer_max_size=2 ** 23,
+                 auto_refresh_trainable=True, device="tpu", **kwargs):
+        super().__init__()
+        self._layer = layer
+        self._sharding_optimizers = (
+            sharding_optimizer
+            if isinstance(sharding_optimizer, list)
+            else [sharding_optimizer]
+        )
+        for p in layer.parameters():
+            if not p.stop_gradient:
+                p.register_hook(shard_grad_hook())
+
+    def forward(self, *inputs, **kwargs):
+        return self._layer(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layer.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layer.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layer.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layer.named_parameters(*a, **k)
+
+    def to(self, *a, **k):
+        self._layer.to(*a, **k)
+        return self
+
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()

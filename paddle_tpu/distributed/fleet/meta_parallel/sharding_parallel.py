@@ -1,0 +1,12 @@
+"""ShardingParallel wrapper (upstream: python/paddle/distributed/fleet/
+meta_parallel/sharding_parallel.py). Parameter broadcast across the
+sharding group at startup is inherent under single-controller SPMD; the
+actual ZeRO behavior lives in DygraphShardingOptimizer (stage 1) and
+the GroupSharded stage-2/3 wrappers."""
+from __future__ import annotations
+
+from .meta_parallel_base import MetaParallelBase
+
+
+class ShardingParallel(MetaParallelBase):
+    pass

@@ -1,0 +1,20 @@
+"""TensorParallel wrapper (upstream: python/paddle/distributed/fleet/
+meta_parallel/tensor_parallel.py — broadcasts non-distributed params
+across the mp group and wires the TP RNG tracker)."""
+from __future__ import annotations
+
+from .meta_parallel_base import MetaParallelBase
+from .parallel_layers.random import (
+    MODEL_PARALLEL_RNG,
+    get_rng_state_tracker,
+)
+
+
+class TensorParallel(MetaParallelBase):
+    def _prepare_for_model(self):
+        # startup param sync across mp/dp groups is inherent in
+        # single-controller SPMD (one global array per param); ensure the
+        # TP dropout rng state exists so mp-region dropout is tracked.
+        tracker = get_rng_state_tracker()
+        if MODEL_PARALLEL_RNG not in tracker.states_:
+            tracker.add(MODEL_PARALLEL_RNG, 2048 + 1)

@@ -1,0 +1,169 @@
+"""Activation recomputation (upstream: python/paddle/distributed/fleet/
+recompute/recompute.py — RecomputeFunction PyLayer drops activations and
+replays the forward during backward with saved RNG state).
+
+TPU-native: the whole recomputed region becomes ONE taped op whose
+payload is ``jax.checkpoint`` of the region's pure function. XLA then
+rematerializes the forward inside the backward pass — the same
+FLOPs-for-memory trade the reference implements by hand, but fused and
+scheduled by the compiler. RNG determinism between the forward and the
+replay is guaranteed by threading the (key, counter) PRNG state through
+the checkpointed function as explicit inputs.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ....framework.core import Tensor, apply_op, no_grad
+from ....framework.random import Generator, default_generator, \
+    override_generator
+from ....nn.layer.layers import Layer
+
+
+def _find_owner_layer(function):
+    if isinstance(function, Layer):
+        return function
+    self_obj = getattr(function, "__self__", None)
+    if isinstance(self_obj, Layer):
+        return self_obj
+    return None
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args, **kwargs)`` without saving its internal
+    activations; they are recomputed during backward.
+
+    ``function`` should be a Layer (or a bound method of one) so its
+    parameters can be routed through the region as differentiable
+    inputs; a plain function of its tensor arguments also works.
+    """
+    kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", True)
+    offload_indices = kwargs.pop("offload_indices", None)
+    if offload_indices:
+        raise NotImplementedError(
+            "recompute offload: use jax.checkpoint offloadable policies "
+            "via paddle_tpu.distributed.fleet.recompute checkpoint_policy"
+        )
+
+    owner = _find_owner_layer(function)
+    params = list(owner.parameters()) if owner is not None else []
+
+    leaves, tree = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
+    )
+    t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    arg_tensors = [leaves[i] for i in t_idx]
+    arg_sg = [t.stop_gradient for t in arg_tensors]
+    n_args = len(arg_tensors)
+
+    gen = default_generator()
+    cell = {"n_outs": None, "single": False, "n_draws": 0}
+
+    def pure(key_raw, counter_raw, *raws):
+        arg_raws, param_raws = raws[:n_args], raws[n_args:]
+        tmp = Generator.__new__(Generator)
+        tmp._seed = 0
+        tmp.key = Tensor(key_raw, stop_gradient=True)
+        tmp.counter = Tensor(counter_raw, stop_gradient=True)
+        c0 = tmp.counter._uid  # noqa: F841 (anchor; draws counted below)
+
+        saved = [(p, p._data) for p in params]
+        try:
+            for p, r in zip(params, param_raws):
+                p._data = r
+            new_leaves = list(leaves)
+            for i, r, sg in zip(t_idx, arg_raws, arg_sg):
+                nt = Tensor(r)
+                nt.stop_gradient = sg
+                new_leaves[i] = nt
+            a, k = jax.tree_util.tree_unflatten(tree, new_leaves)
+            draws_before = _DRAW_COUNTER[0]
+            with override_generator(tmp), no_grad():
+                outs = function(*a, **k)
+            cell["n_draws"] = _DRAW_COUNTER[0] - draws_before
+        finally:
+            for p, d in saved:
+                p._data = d
+        if isinstance(outs, Tensor):
+            cell["single"] = True
+            return outs._data
+        out_raws = tuple(
+            o._data if isinstance(o, Tensor) else o for o in outs
+        )
+        cell["n_outs"] = len(out_raws)
+        return out_raws
+
+    ck = jax.checkpoint(pure)
+
+    key_t = Tensor(gen.key._data, stop_gradient=True)
+    ctr_t = Tensor(gen.counter._data, stop_gradient=True)
+    outs = apply_op(
+        "recompute", ck, key_t, ctr_t, *arg_tensors, *params
+    )
+    # advance the real stream past the draws the region consumed
+    if cell["n_draws"]:
+        import jax.numpy as jnp
+
+        gen.counter._data = gen.counter._data + jnp.uint32(cell["n_draws"])
+    return outs
+
+
+# draw counting: Generator.next_key is instrumented lazily the first time
+# recompute is imported, so the replayed region consumes an identical
+# number of keys.
+_DRAW_COUNTER = [0]
+_orig_next_key = Generator.next_key
+
+
+@functools.wraps(_orig_next_key)
+def _counted_next_key(self):
+    _DRAW_COUNTER[0] += 1
+    return _orig_next_key(self)
+
+
+Generator.next_key = _counted_next_key
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Apply a Sequential's sublayers with per-chunk recompute
+    (upstream recompute_sequential)."""
+    segments = (ctx or {}).get("segments", 1)
+    layers = list(functions)
+    if segments <= 1:
+        chunks = [layers]
+    else:
+        per = max(1, len(layers) // segments)
+        chunks = [layers[i:i + per] for i in range(0, len(layers), per)]
+    out = args[0] if len(args) == 1 else args
+    for chunk in chunks:
+        def run_chunk(x, _chunk=chunk):
+            for l in _chunk:
+                x = l(x)
+            return x
+
+        # route params of the whole chunk through the region
+        holder = Layer()
+        for i, l in enumerate(chunk):
+            holder.add_sublayer(str(i), l)
+        out = recompute(_BoundChunk(holder, run_chunk), out, **kwargs)
+    return out
+
+
+class _BoundChunk(Layer):
+    def __init__(self, holder, fn):
+        super().__init__()
+        self.holder = holder
+        self._fn = fn
+
+    def forward(self, x):
+        return self._fn(x)
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """mp/pp-aware variant (upstream recompute_hybrid.py). Under
+    single-controller GSPMD the mp-group RNG and offload bookkeeping the
+    reference does by hand are unnecessary; delegates to recompute."""
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,4 @@
+from . import sequence_parallel_utils  # noqa: F401
+from ..recompute import recompute  # noqa: F401
+
+__all__ = ["sequence_parallel_utils", "recompute"]

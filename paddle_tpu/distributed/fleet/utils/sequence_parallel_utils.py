@@ -1,0 +1,241 @@
+"""Megatron-style sequence parallelism over the mp group (upstream:
+python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp autograd functions,
+ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+mark_as_sequence_parallel_parameter + grad-sync hooks).
+
+TPU-native: "sequence parallel" is a *sharding layout*, not a set of
+hand-written collectives. In the LayerNorm/dropout segments activations
+are sharded over the mp axis on the SEQUENCE dim; entering a column
+linear they re-shard to hidden-dim (the reference's all-gather), and
+leaving a row linear they return to sequence-sharded (the reference's
+reduce-scatter, replacing its plain allreduce — same total bytes,
+halved, as Megatron-SP promises). The partitioner emits exactly those
+collectives from the constraints below and fuses them with the matmuls.
+The reference's "register an allreduce hook for SP-region param grads"
+disappears: gradients of global arrays are already complete.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ....framework.core import Tensor, _as_tensor, apply_op
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer.layers import Layer
+from ...mesh import axis_degree, global_mesh, in_manual_context
+from ..base.topology import get_hybrid_communicate_group
+
+
+def _seq_spec(ndim, seq_axis=0):
+    """[s, b, h] layout (reference uses seq-major in SP regions)."""
+    spec = [None] * ndim
+    spec[seq_axis] = "mp"
+    return spec
+
+
+def _constrain(x: Tensor, spec) -> Tensor:
+    m = global_mesh()
+    if m is None or axis_degree("mp") <= 1:
+        return x
+    sh = NamedSharding(m, PartitionSpec(*spec))
+    return apply_op(
+        "sp_constraint",
+        lambda a: jax.lax.with_sharding_constraint(a, sh),
+        x,
+    )
+
+
+class ScatterOp:
+    """Split along the sequence dim across mp (fwd) / all-gather (bwd)."""
+
+    @staticmethod
+    def apply(input, axis=0):
+        input = _as_tensor(input)
+        if in_manual_context(("mp",)):
+            n = axis_degree("mp")
+
+            @jax.custom_vjp
+            def scat(x):
+                i = jax.lax.axis_index("mp")
+                size = x.shape[axis] // n
+                return jax.lax.dynamic_slice_in_dim(x, i * size, size, axis)
+
+            scat.defvjp(
+                lambda x: (scat(x), None),
+                lambda _, ct: (
+                    jax.lax.all_gather(ct, "mp", axis=axis, tiled=True),
+                ),
+            )
+            return apply_op("sp_scatter", scat, input)
+        return _constrain(input, _seq_spec(input.ndim, axis))
+
+
+class GatherOp:
+    """All-gather along the sequence dim (fwd) / split (bwd)."""
+
+    @staticmethod
+    def apply(input, axis=0):
+        input = _as_tensor(input)
+        if in_manual_context(("mp",)):
+            n = axis_degree("mp")
+
+            @jax.custom_vjp
+            def gath(x):
+                return jax.lax.all_gather(x, "mp", axis=axis, tiled=True)
+
+            def bwd(_, ct):
+                i = jax.lax.axis_index("mp")
+                size = ct.shape[axis] // n
+                return (
+                    jax.lax.dynamic_slice_in_dim(ct, i * size, size, axis),
+                )
+
+            gath.defvjp(lambda x: (gath(x), None), bwd)
+            return apply_op("sp_gather", gath, input)
+        spec = [None] * input.ndim
+        return _constrain(input, spec)
+
+
+class AllGatherOp:
+    """all-gather fwd / reduce-scatter bwd (entering a column linear).
+
+    Distinct from GatherOp: each rank's cotangent for the gathered
+    value differs, so the backward must REDUCE-scatter (sum across
+    ranks), not slice — Megatron-SP's g/ḡ pairing."""
+
+    @staticmethod
+    def apply(input):
+        input = _as_tensor(input)
+        if in_manual_context(("mp",)):
+            @jax.custom_vjp
+            def ag(x):
+                return jax.lax.all_gather(x, "mp", axis=0, tiled=True)
+
+            ag.defvjp(
+                lambda x: (ag(x), None),
+                lambda _, ct: (
+                    jax.lax.psum_scatter(
+                        ct, "mp", scatter_dimension=0, tiled=True
+                    ),
+                ),
+            )
+            return apply_op("sp_allgather", ag, input)
+        spec = [None] * input.ndim
+        return _constrain(input, spec)
+
+
+class ReduceScatterOp:
+    """reduce-scatter fwd / all-gather bwd (leaving a row linear)."""
+
+    @staticmethod
+    def apply(input):
+        input = _as_tensor(input)
+        if in_manual_context(("mp",)):
+            @jax.custom_vjp
+            def rs(x):
+                return jax.lax.psum_scatter(
+                    x, "mp", scatter_dimension=0, tiled=True
+                )
+
+            rs.defvjp(
+                lambda x: (rs(x), None),
+                lambda _, ct: (
+                    jax.lax.all_gather(ct, "mp", axis=0, tiled=True),
+                ),
+            )
+            return apply_op("sp_reduce_scatter", rs, input)
+        return _constrain(input, _seq_spec(input.ndim, 0))
+
+
+def scatter(input, axis=0):
+    return ScatterOp.apply(input, axis)
+
+
+def all_gather(input):
+    return AllGatherOp.apply(input)
+
+
+def reduce_scatter(input):
+    return ReduceScatterOp.apply(input)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Gradients of global arrays are already complete under GSPMD; keep
+    the marker for API parity / checkpoint tooling."""
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, *a, **k):
+    # grads complete by construction (see module docstring)
+    pass
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-split weight; input arrives sequence-sharded and is
+    gathered (fwd) / reduce-scattered (bwd) around the matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        from ..layers.mpu.mp_layers import _place
+
+        _place(self.weight, None, "mp")
+        self.bias = (
+            self.create_parameter([out_features], None, is_bias=True)
+            if has_bias in (True, None) else None
+        )
+        if self.bias is not None:
+            _place(self.bias, "mp")
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)
+        out = F.linear(x, self.weight, self.bias)
+        if not self.gather_output:
+            out = _constrain(
+                out, [None] * (out.ndim - 1) + ["mp"]
+            )
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-split weight; output leaves reduce-scattered over the
+    sequence dim (the Megatron-SP halving of comm volume vs the plain
+    RowParallelLinear allreduce)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        from ..layers.mpu.mp_layers import _place
+
+        _place(self.weight, "mp", None)
+        self.bias = (
+            self.create_parameter([out_features], None, is_bias=True)
+            if has_bias else None
+        )
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        out = ReduceScatterOp.apply(out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def create_fused_allreduce_gradient_hooks(*a, **k):
+    raise NotImplementedError(
+        "grad allreduce hooks are unnecessary under GSPMD; see module "
+        "docstring"
+    )

@@ -1,0 +1,66 @@
+"""Public GroupSharded (ZeRO) API (upstream: python/paddle/distributed/
+sharding/group_sharded.py — group_sharded_parallel /
+save_group_sharded_model)."""
+from __future__ import annotations
+
+import os
+
+from ..fleet.meta_parallel.sharding.group_sharded_stage2 import (
+    GroupShardedOptimizerStage2,
+    GroupShardedStage2,
+)
+from ..fleet.meta_parallel.sharding.group_sharded_stage3 import (
+    GroupShardedStage3,
+)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """Wrap model+optimizer for ZeRO level: "os" (stage 1, optimizer
+    state), "os_g" (stage 2, + grads), "p_g_os" (stage 3, + params)."""
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError("level must be one of 'os', 'os_g', 'p_g_os'")
+    if level == "os":
+        from ..fleet.meta_optimizers.dygraph_optimizer import (
+            DygraphShardingOptimizer,
+        )
+
+        optimizer = DygraphShardingOptimizer(optimizer, None)
+        return model, optimizer, scaler
+    if level == "os_g":
+        optimizer = GroupShardedOptimizerStage2(
+            list(model.parameters()), optimizer, group=group,
+            offload=offload,
+        )
+        model = GroupShardedStage2(
+            model, optimizer, group=group, sync_buffers=sync_buffers,
+            buffer_max_size=buffer_max_size,
+        )
+        optimizer._shard_states()
+        return model, optimizer, scaler
+    model = GroupShardedStage3(
+        model, optimizer=optimizer, group=group,
+        sync_buffers=sync_buffers, segment_size=segment_size,
+        offload=offload, sync_comm=sync_comm, dp_group=dp_group,
+        exclude_layer=exclude_layer,
+    )
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Save a group-sharded model (rank-0 semantics are inherent in
+    single-controller mode)."""
+    from ...framework.io import save
+
+    target = model
+    while hasattr(target, "_layer"):
+        target = target._layer
+    os.makedirs(output, exist_ok=True)
+    save(target.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

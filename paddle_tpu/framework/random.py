@@ -52,13 +52,36 @@ class Generator:
 
 
 _default_generator = None
+_generator_stack = []
 
 
 def default_generator() -> Generator:
     global _default_generator
+    if _generator_stack:
+        return _generator_stack[-1]
     if _default_generator is None:
         _default_generator = Generator(_DEFAULT_SEED)
     return _default_generator
+
+
+class override_generator:
+    """Temporarily make ``gen`` the generator all random draws use.
+
+    Backing for the fleet RNGStatesTracker's named seed states (upstream:
+    python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py
+    swaps curand states; here we swap the (key, counter) pair).
+    """
+
+    def __init__(self, gen: Generator):
+        self._gen = gen
+
+    def __enter__(self):
+        _generator_stack.append(self._gen)
+        return self._gen
+
+    def __exit__(self, *exc):
+        _generator_stack.pop()
+        return False
 
 
 def seed(value: int):
