@@ -1,0 +1,30 @@
+"""Language-model zoo — the flagship training models of the framework.
+
+The reference keeps its LLMs in the PaddleNLP ecosystem built on the
+fleet/meta_parallel primitives (upstream: python/paddle/distributed/
+fleet/layers/mpu/mp_layers.py provides the TP layers those models use);
+this framework ships the acceptance-config model families in-tree:
+
+* :mod:`.llama`  — Llama-2 (RMSNorm / RoPE / GQA / SwiGLU), TP/SP-aware
+* :mod:`.gpt`    — GPT-3 (pre-LN, learned positions, gelu), DP/sharding
+"""
+from . import llama
+from . import gpt
+from .llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    LlamaPretrainingCriterion,
+    llama2_7b,
+    llama2_13b,
+    llama_tiny,
+    llama_pipeline_model,
+)
+from .gpt import (
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    gpt3_1_3b,
+    gpt3_6_7b,
+    gpt_tiny,
+)

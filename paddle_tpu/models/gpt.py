@@ -1,0 +1,211 @@
+"""GPT-3 model family — the DP + sharding-stage-1 acceptance config
+(GPT-3 1.3B), also TP-capable.
+
+Architecture parity with the reference ecosystem's GPT (pre-LN
+transformer, learned position embeddings, gelu MLP, tied lm head
+optional), on the same mpu layers as :mod:`.llama` (upstream analog:
+python/paddle/distributed/fleet/layers/mpu/mp_layers.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..distributed.fleet.layers.mpu.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..distributed.fleet.layers.mpu.mp_ops import shard_constraint
+from ..distributed.mesh import axis_degree
+from ..framework.core import apply_op
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding
+from ..nn.layer.layers import Layer, LayerList
+from ..nn.layer.norm import LayerNorm
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    intermediate_size: int = 8192
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    dropout: float = 0.0
+    tie_word_embeddings: bool = True
+    recompute: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    def num_params(self) -> int:
+        h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        per_layer = 4 * h * h + 2 * h * i + (4 * h + i + h) + 4 * h
+        emb = v * h + self.max_position_embeddings * h
+        if not self.tie_word_embeddings:
+            emb += v * h
+        return per_layer * self.num_hidden_layers + emb + 2 * h
+
+
+def gpt3_1_3b(**kw) -> GPTConfig:
+    return GPTConfig(**kw)
+
+
+def gpt3_6_7b(**kw) -> GPTConfig:
+    return GPTConfig(
+        hidden_size=4096, intermediate_size=16384, num_hidden_layers=32,
+        num_attention_heads=32, **kw,
+    )
+
+
+def gpt_tiny(**kw) -> GPTConfig:
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("intermediate_size", 512)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("max_position_embeddings", 256)
+    return GPTConfig(**kw)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.head_dim
+        self.qkv_proj = ColumnParallelLinear(
+            config.hidden_size, 3 * config.hidden_size,
+            has_bias=True, gather_output=False,
+        )
+        self.out_proj = RowParallelLinear(
+            config.hidden_size, config.hidden_size,
+            has_bias=True, input_is_parallel=True,
+        )
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        nh, hd = self.num_heads, self.head_dim
+        qkv = self.qkv_proj(x)
+
+        def split_heads(r):
+            # qkv is column-split over mp: per-shard layout is
+            # [3, local_heads, hd] interleaved, so reshape head-major
+            r = r.reshape(b, s, 3, nh, hd)
+            return r[:, :, 0], r[:, :, 1], r[:, :, 2]
+
+        q, k, v = apply_op("gpt_split_qkv", split_heads, qkv, n_outs=3)
+        if axis_degree("mp") > 1:
+            spec = ("dp", None, "mp", None)
+            q = shard_constraint(q, *spec)
+            k = shard_constraint(k, *spec)
+            v = shard_constraint(v, *spec)
+        out, _ = F.flash_attention(q, k, v, causal=True)
+        out = apply_op(
+            "merge_heads", lambda o: o.reshape(b, s, nh * hd), out
+        )
+        return self.out_proj(out)
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.fc_in = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size,
+            has_bias=True, gather_output=False,
+        )
+        self.fc_out = RowParallelLinear(
+            config.intermediate_size, config.hidden_size,
+            has_bias=True, input_is_parallel=True,
+        )
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class GPTDecoderLayer(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(
+            config.hidden_size, epsilon=config.layer_norm_eps
+        )
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(
+            config.hidden_size, epsilon=config.layer_norm_eps
+        )
+        self.mlp = GPTMLP(config)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, x):
+        h = x + self.dropout(self.attn(self.ln_1(x)))
+        return h + self.dropout(self.mlp(self.ln_2(h)))
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size
+        )
+        self.wpe = Embedding(
+            config.max_position_embeddings, config.hidden_size
+        )
+        self.drop = Dropout(config.dropout)
+        self.h = LayerList(
+            [GPTDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)]
+        )
+        self.ln_f = LayerNorm(
+            config.hidden_size, epsilon=config.layer_norm_eps
+        )
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = apply_op(
+            "gpt_positions",
+            lambda ids: jnp.arange(s, dtype=jnp.int32)[None, :],
+            input_ids, differentiable=False,
+        )
+        h = self.drop(self.wte(input_ids) + self.wpe(pos))
+        if self.config.recompute:
+            from ..distributed.fleet.recompute import recompute
+
+            for l in self.h:
+                h = recompute(l, h)
+        else:
+            for l in self.h:
+                h = l(h)
+        return self.ln_f(h)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size,
+                has_bias=False, gather_output=False,
+            )
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        if self.config.tie_word_embeddings:
+            w = self.gpt.wte.weight
+            logits = apply_op("tied_lm_head", lambda a, b: a @ b.T, h, w)
+        else:
+            logits = self.lm_head(h)
+        if labels is None:
+            return logits
+        from ..tensor.math import mean
+        from .llama import _shift_for_next_token
+
+        sl, sy = _shift_for_next_token(logits, labels)
+        loss = mean(F.cross_entropy(sl, sy, reduction="none"))
+        return logits, loss

@@ -1,0 +1,403 @@
+"""Llama-2 model family — the flagship TP (mp=8) acceptance config.
+
+Architecture parity with the reference ecosystem's Llama implementation
+(RMSNorm pre-norm, rotary position embedding, grouped-query attention,
+SwiGLU MLP, untied lm head), built on this framework's tensor-parallel
+layers (paddle_tpu/distributed/fleet/layers/mpu/mp_layers.py — the
+analog of upstream python/paddle/distributed/fleet/layers/mpu/
+mp_layers.py Column/RowParallelLinear + VocabParallelEmbedding).
+
+TPU-native notes:
+
+* Parameters are GLOBAL arrays with mp-axis shardings; GSPMD
+  materializes the Megatron collective pattern (identity-fwd /
+  allreduce-bwd around column, allreduce-fwd after row) and fuses it
+  with the matmuls onto the MXU.
+* Attention runs the Pallas flash-attention kernel (causal), heads
+  sharded over mp; with sep_degree > 1 the sequence dimension of
+  activations is sharded over the "sep" axis (context parallelism —
+  ring attention lives in distributed/fleet/utils/
+  sequence_parallel_utils.py).
+* The decoder layer is a single-tensor-signature Layer so it stacks
+  into the compiled 1F1B pipeline schedule (pp_layers._StackedBody).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..distributed.fleet.layers.mpu.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..distributed.fleet.layers.mpu.mp_ops import shard_constraint
+from ..distributed.mesh import axis_degree
+from ..framework.core import apply_op
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import RMSNorm
+from ..ops.kernels.rope import apply_rotary_emb, build_rope_cache
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    sequence_parallel: bool = False  # Megatron-SP over the mp axis
+    recompute: bool = False
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    def num_params(self) -> int:
+        """Total parameter count (for MFU math in bench.py)."""
+        h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        kvh = self.num_key_value_heads * self.head_dim
+        per_layer = (
+            h * h + 2 * h * kvh + h * h  # q k v o
+            + 3 * h * i                   # gate up down
+            + 2 * h                       # two rms norms
+        )
+        emb = v * h * (1 if self.tie_word_embeddings else 2)
+        return per_layer * self.num_hidden_layers + emb + h
+
+
+def llama2_7b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama2_13b(**kw) -> LlamaConfig:
+    return LlamaConfig(
+        hidden_size=5120, intermediate_size=13824, num_hidden_layers=40,
+        num_attention_heads=40, num_key_value_heads=40, **kw,
+    )
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    """Small config for tests / compile checks (GQA 4:2 exercised)."""
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("intermediate_size", 256)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("num_key_value_heads", 2)
+    kw.setdefault("max_position_embeddings", 256)
+    return LlamaConfig(**kw)
+
+
+def _seq_spec(sequence_parallel=False):
+    """Activation PartitionSpec [B, S, H] honoring dp/sep axes. With
+    Megatron-SP (sequence_parallel=True) the sequence dim is also
+    sharded over mp between the matmul regions — GSPMD then places the
+    reference's allgather-fwd/reduce-scatter-bwd pattern
+    (sequence_parallel_utils.py) at the TP-layer boundaries."""
+    if sequence_parallel and axis_degree("mp") > 1:
+        seq = ("sep", "mp") if axis_degree("sep") > 1 else "mp"
+    else:
+        seq = "sep" if axis_degree("sep") > 1 else None
+    return ("dp", seq, None)
+
+
+def _constrain_act(x, sequence_parallel=False):
+    if (
+        axis_degree("dp") > 1 or axis_degree("sep") > 1
+        or (sequence_parallel and axis_degree("mp") > 1)
+    ):
+        return shard_constraint(x, *_seq_spec(sequence_parallel))
+    return x
+
+
+class LlamaMLP(Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x)); gate/up column-split over mp,
+    down row-split (the Megatron pair — one allreduce per MLP)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.gate_proj = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size,
+            has_bias=False, gather_output=False,
+        )
+        self.up_proj = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size,
+            has_bias=False, gather_output=False,
+        )
+        self.down_proj = RowParallelLinear(
+            config.intermediate_size, config.hidden_size,
+            has_bias=False, input_is_parallel=True,
+        )
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaAttention(Layer):
+    """GQA attention: q/k/v column-split over mp (heads sharded), o
+    row-split; rotary embedding fused elementwise; Pallas flash kernel."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        kv_out = self.num_kv_heads * self.head_dim
+        self.q_proj = ColumnParallelLinear(
+            config.hidden_size, config.hidden_size,
+            has_bias=False, gather_output=False,
+        )
+        self.k_proj = ColumnParallelLinear(
+            config.hidden_size, kv_out, has_bias=False, gather_output=False,
+        )
+        self.v_proj = ColumnParallelLinear(
+            config.hidden_size, kv_out, has_bias=False, gather_output=False,
+        )
+        self.o_proj = RowParallelLinear(
+            config.hidden_size, config.hidden_size,
+            has_bias=False, input_is_parallel=True,
+        )
+
+    def forward(self, x):
+        cfg = self.config
+        b, s = x.shape[0], x.shape[1]
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        theta = cfg.rope_theta
+        # the flash kernel resolves kv_head = q_head // group in its
+        # BlockSpec index map — no KV repetition in HBM. Repeat only
+        # when the kv heads don't divide over the mp axis.
+        mp = axis_degree("mp")
+        rep = nh // nkv if (mp > 1 and nkv % mp != 0) else 1
+
+        def attn(qr, kr, vr):
+            qh = qr.reshape(b, s, nh, hd)
+            kh = kr.reshape(b, s, nkv, hd)
+            vh = vr.reshape(b, s, nkv, hd)
+            cos, sin = build_rope_cache(s, hd, base=theta, dtype=jnp.float32)
+            qh = apply_rotary_emb(qh, cos, sin)
+            kh = apply_rotary_emb(kh, cos, sin)
+            if rep > 1:
+                kh = jnp.repeat(kh, rep, axis=2)
+                vh = jnp.repeat(vh, rep, axis=2)
+            return qh, kh, vh
+
+        q, k, v = apply_op("llama_qkv_rope", attn, q, k, v, n_outs=3)
+        if mp > 1:
+            spec = ("dp", None, "mp", None)
+            q = shard_constraint(q, *spec)
+            k = shard_constraint(k, *spec)
+            v = shard_constraint(v, *spec)
+        out, _ = F.flash_attention(q, k, v, causal=True)
+        out = apply_op(
+            "merge_heads", lambda o: o.reshape(b, s, nh * hd), out
+        )
+        return self.o_proj(out)
+
+
+class LlamaDecoderLayer(Layer):
+    """Pre-norm block; single-tensor signature → pipeline-stackable."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self._sp = config.sequence_parallel
+        self.input_layernorm = RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps
+        )
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps
+        )
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x):
+        x = _constrain_act(x, self._sp)
+        h = x + self.self_attn(self.input_layernorm(x))
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        return _constrain_act(out, self._sp)
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size
+        )
+        from ..nn.layer.layers import LayerList
+
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)]
+        )
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        h = self.embed_tokens(input_ids)
+        h = _constrain_act(h, self.config.sequence_parallel)
+        if self.config.recompute:
+            from ..distributed.fleet.recompute import recompute
+
+            for l in self.layers:
+                h = recompute(l, h)
+        else:
+            for l in self.layers:
+                h = l(h)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size,
+                has_bias=False, gather_output=False,
+            )
+        if config.dtype not in ("float32", None):
+            self.astype(config.dtype)
+
+    def forward(self, input_ids, labels=None):
+        h = self.model(input_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            w = self.model.embed_tokens.weight
+            logits = apply_op(
+                "tied_lm_head", lambda a, b: a @ b.T, h, w
+            )
+        if labels is None:
+            return logits
+        return logits, LlamaPretrainingCriterion()(logits, labels)
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Next-token mean CE: predicts labels[:, t+1] from logits[:, t]
+    (labels == input_ids, shifted internally). Logits may arrive
+    vocab-sharded over mp — log_softmax's reduction over that dim
+    becomes the mp collective (the reference's
+    c_softmax_with_cross_entropy)."""
+
+    def __init__(self, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        from ..tensor.math import mean
+
+        logits, labels = _shift_for_next_token(logits, labels)
+        loss = F.cross_entropy(
+            logits, labels, reduction="none",
+            ignore_index=self.ignore_index,
+        )
+        return mean(loss)
+
+
+def _shift_for_next_token(logits, labels):
+    """logits[:, :-1] predicts labels[:, 1:]."""
+    logits = apply_op("shift_logits", lambda l: l[:, :-1], logits)
+    labels = apply_op(
+        "shift_labels", lambda l: l[:, 1:], labels, differentiable=False
+    )
+    return logits, labels
+
+
+# -- pipeline form ----------------------------------------------------------
+
+
+def llama_pipeline_model(config: LlamaConfig, **pp_kwargs):
+    """PipelineLayer with [embed | N×decoder | norm(+head)] segmentation
+    — the decoder run stacks onto the pp axis (compiled 1F1B schedule).
+    With tie_word_embeddings the head is a SharedLayerDesc occurrence of
+    the embedding (one tensor; the reference's shared-embedding grad
+    allreduce becomes ordinary accumulation — pp_layers.py)."""
+    from ..distributed.fleet.meta_parallel.parallel_layers.pp_layers import (
+        LayerDesc,
+        PipelineLayer,
+        SharedLayerDesc,
+    )
+
+    body = [
+        LayerDesc(LlamaDecoderLayer, config)
+        for _ in range(config.num_hidden_layers)
+    ]
+    if config.tie_word_embeddings:
+        descs = [
+            SharedLayerDesc(
+                "llama_embed", _LlamaEmbedding, None, "embed_tokens",
+                config.vocab_size, config.hidden_size,
+            ),
+            *body,
+            LayerDesc(_LlamaNorm, config),
+            SharedLayerDesc(
+                "llama_embed", _LlamaEmbedding, _tied_head_forward,
+                "embed_tokens", config.vocab_size, config.hidden_size,
+            ),
+        ]
+    else:
+        descs = [
+            LayerDesc(
+                _LlamaEmbedding, config.vocab_size, config.hidden_size
+            ),
+            *body,
+            LayerDesc(_LlamaHead, config),
+        ]
+    pp_kwargs.setdefault(
+        "loss_fn", LlamaPretrainingCriterion()
+    )
+    if config.recompute:
+        pp_kwargs.setdefault("recompute_interval", 1)
+    return PipelineLayer(descs, **pp_kwargs)
+
+
+def _tied_head_forward(embed_layer, h):
+    w = embed_layer.embed_tokens.weight
+    return apply_op("tied_lm_head", lambda a, b: a @ b.T, h, w)
+
+
+class _LlamaEmbedding(Layer):
+    def __init__(self, vocab_size, hidden_size):
+        super().__init__()
+        self.embed_tokens = VocabParallelEmbedding(vocab_size, hidden_size)
+
+    def forward(self, input_ids):
+        return _constrain_act(self.embed_tokens(input_ids))
+
+
+class _LlamaNorm(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, h):
+        return self.norm(h)
+
+
+class _LlamaHead(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.lm_head = ColumnParallelLinear(
+            config.hidden_size, config.vocab_size,
+            has_bias=False, gather_output=False,
+        )
+
+    def forward(self, h):
+        return self.lm_head(self.norm(h))

@@ -46,12 +46,14 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm (upstream kernel: paddle/phi/kernels/gpu/rms_norm_kernel.cu).
     Uses the Pallas fused kernel on TPU when enabled."""
     x = _as_tensor(x)
-    from ...ops.kernels import rms_norm as _k
+    from ...ops.kernels.rms_norm import rms_norm as _rms_impl
 
     if weight is not None:
         w = _as_tensor(weight)
-        return apply_op("rms_norm", lambda a, ww: _k.rms_norm(a, ww, epsilon), x, w)
-    return apply_op("rms_norm", lambda a: _k.rms_norm(a, None, epsilon), x)
+        return apply_op(
+            "rms_norm", lambda a, ww: _rms_impl(a, ww, epsilon), x, w
+        )
+    return apply_op("rms_norm", lambda a: _rms_impl(a, None, epsilon), x)
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,

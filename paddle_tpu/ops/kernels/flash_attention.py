@@ -81,7 +81,11 @@ def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, 0] + jnp.log(safe_l[:, 0]))
+        # lse is (Bq,) logically; stored broadcast over an 8-lane minor
+        # dim to satisfy TPU tiling (block minor dim == array minor dim)
+        lse_ref[0] = jnp.broadcast_to(
+            (m_ref[:, :1] + jnp.log(safe_l)), lse_ref.shape[1:]
+        )
 
 
 def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
@@ -124,16 +128,16 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, block_q, 8), lambda h, i, j: (h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 8), jnp.float32),
         ],
         scratch_shapes=scratch,
         **params,
     )(q, k, v)
-    return out, lse
+    return out, lse[..., 0]
 
 
 def _flash_fwd_ref(q, k, v, causal, scale):
