@@ -93,10 +93,14 @@ class GPTAttention(Layer):
         qkv = self.qkv_proj(x)
 
         def split_heads(r):
-            # qkv is column-split over mp: per-shard layout is
-            # [3, local_heads, hd] interleaved, so reshape head-major
-            r = r.reshape(b, s, 3, nh, hd)
-            return r[:, :, 0], r[:, :, 1], r[:, :, 2]
+            # the fused qkv output dim is laid out head-major
+            # [nh, 3, hd] (weights are randomly initialized, so the
+            # interpretation is ours to pick): reshaping splits the nh
+            # factor, which mp divides — the column sharding survives
+            # the reshape with no allgather, unlike a [3, nh, hd]
+            # layout where mp would have to divide 3
+            r = r.reshape(b, s, nh, 3, hd)
+            return r[:, :, :, 0], r[:, :, :, 1], r[:, :, :, 2]
 
         q, k, v = apply_op("gpt_split_qkv", split_heads, qkv, n_outs=3)
         if axis_degree("mp") > 1:
@@ -193,6 +197,9 @@ class GPTForCausalLM(Layer):
                 config.hidden_size, config.vocab_size,
                 has_bias=False, gather_output=False,
             )
+        from .llama import LlamaPretrainingCriterion
+
+        self.criterion = LlamaPretrainingCriterion()
 
     def forward(self, input_ids, labels=None):
         h = self.gpt(input_ids)
@@ -203,9 +210,4 @@ class GPTForCausalLM(Layer):
             logits = self.lm_head(h)
         if labels is None:
             return logits
-        from ..tensor.math import mean
-        from .llama import _shift_for_next_token
-
-        sl, sy = _shift_for_next_token(logits, labels)
-        loss = mean(F.cross_entropy(sl, sy, reduction="none"))
-        return logits, loss
+        return logits, self.criterion(logits, labels)

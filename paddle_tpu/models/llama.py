@@ -300,14 +300,13 @@ class LlamaPretrainingCriterion(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, logits, labels):
-        from ..tensor.math import mean
-
         logits, labels = _shift_for_next_token(logits, labels)
-        loss = F.cross_entropy(
-            logits, labels, reduction="none",
+        # reduction='mean' normalizes by the count of non-ignored
+        # tokens, so padded positions don't deflate the loss
+        return F.cross_entropy(
+            logits, labels, reduction="mean",
             ignore_index=self.ignore_index,
         )
-        return mean(loss)
 
 
 def _shift_for_next_token(logits, labels):
