@@ -1,0 +1,49 @@
+"""Distributed utils — MoE all-to-all ops (upstream:
+python/paddle/distributed/utils/moe_utils.py; CUDA:
+paddle/fluid/operators/collective/global_scatter_op.cu.cc,
+global_gather_op.cu.cc).
+
+TPU-native deviation: the reference ops take per-(rank, expert)
+``local_count``/``global_count`` vectors and exchange VARIABLE-length
+token lists over NCCL all-to-all. XLA needs static shapes, so these
+take capacity-padded tensors: x is (E, C, d) — every expert's slots
+padded to capacity (the MoELayer's dispatch einsum produces exactly
+this) — and the exchange is one ``lax.all_to_all`` over the ep axis.
+In the GSPMD context they are sharding-constraint identities (the
+partitioner inserts the all-to-all where the einsums need it).
+"""
+from __future__ import annotations
+
+import jax
+
+from ...framework.core import apply_op, _as_tensor
+from ..mesh import axis_degree, in_manual_context, named_sharding
+
+
+def _exchange(name, split_axis, concat_axis):
+    def op(x, local_count=None, global_count=None, group=None):
+        x = _as_tensor(x)
+        if axis_degree("ep") <= 1:
+            return x
+        if in_manual_context(("ep",)):
+            return apply_op(
+                name,
+                lambda a: jax.lax.all_to_all(
+                    a, "ep", split_axis=split_axis, concat_axis=concat_axis
+                ),
+                x,
+            )
+        sh = named_sharding("ep", *([None] * (x.ndim - 1)))
+        return apply_op(
+            name, lambda a: jax.lax.with_sharding_constraint(a, sh), x
+        )
+
+    return op
+
+
+#: (E, C, d) tokens -> expert-owning devices (split experts, gather slots)
+global_scatter = _exchange("global_scatter", 0, 1)
+#: inverse of global_scatter
+global_gather = _exchange("global_gather", 1, 0)
+
+__all__ = ["global_scatter", "global_gather"]

@@ -1,0 +1,2 @@
+"""paddle.incubate analog (upstream: python/paddle/incubate/)."""
+from . import distributed  # noqa: F401

@@ -1,0 +1,17 @@
+"""MoE / expert parallelism (upstream:
+python/paddle/incubate/distributed/models/moe/)."""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .grad_clip import ClipGradForMOEByGlobalNorm, ClipGradForMoEByGlobalNorm
+from .moe_layer import ExpertLayer, MoELayer
+from .utils import (
+    _limit_by_capacity,
+    _number_count,
+    _prune_gate_by_capacity,
+    _random_routing,
+)
+
+__all__ = [
+    "MoELayer", "ExpertLayer",
+    "BaseGate", "NaiveGate", "GShardGate", "SwitchGate",
+    "ClipGradForMOEByGlobalNorm", "ClipGradForMoEByGlobalNorm",
+]

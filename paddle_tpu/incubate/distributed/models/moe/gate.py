@@ -1,0 +1,269 @@
+"""MoE gates (upstream: python/paddle/incubate/distributed/models/moe/
+gate/{base_gate,naive_gate,gshard_gate,switch_gate}.py).
+
+TPU-native design: the reference gates emit dynamic-length index lists
+that CUDA routing ops (number_count / limit_by_capacity /
+prune_gate_by_capacity / random_routing — paddle/fluid/operators/) then
+compact. On TPU everything must be static-shape, so each gate computes
+the full GShard-style routing tensors in one shot:
+
+* ``combine_weights``  (N, E, C) — how to weight each expert's output
+  back onto each token (zero where dropped / unrouted);
+* ``dispatch_mask``    (N, E, C) bool — which (expert, capacity-slot)
+  each token occupies;
+* ``aux_loss`` — the gate's load-balancing loss.
+
+Capacity is fixed at trace time (``capacity_factor``), over-capacity
+tokens are dropped by masking (exactly what limit_by_capacity +
+prune_gate_by_capacity do, without the dynamic shapes).
+
+``make_router()`` returns a PURE function of the raw (x, gate_weight)
+arrays — RNG keys are drawn up front (same convention as F.dropout) so
+the tape's vjp re-execution sees identical randomness.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....framework.core import Tensor, apply_op
+from .....framework.random import next_key
+from .....nn import initializer as I
+from .....nn.layer.layers import Layer
+
+
+def _capacity(num_tokens: int, num_experts: int, top_k: int,
+              capacity_factor: float) -> int:
+    cap = int(num_tokens * top_k * capacity_factor / num_experts)
+    return max(cap, 4)
+
+
+def _positions_in_expert(mask, capacity, offset=None):
+    """Running slot index of each token within its expert's capacity.
+
+    mask: (N, E) one-hot routing. Returns (pos (N, E), keep (N, E)) where
+    ``pos`` is the capacity slot and ``keep`` drops tokens past capacity.
+    ``offset`` (E,) shifts start positions (used for 2nd-choice tokens,
+    which queue behind all 1st-choice tokens — gshard_gate semantics).
+    """
+    pos = jnp.cumsum(mask, axis=0) - mask
+    if offset is not None:
+        pos = pos + offset[None, :]
+    keep = mask * (pos < capacity)
+    return pos, keep
+
+
+def _topk_combine_dispatch(gates, top_k, capacity, normalize=True,
+                           second_keep=None):
+    """Shared routing core: softmax gate probs → (combine, dispatch).
+
+    ``second_keep`` optionally masks out k-th choices (k>=2) per token
+    (random_routing). Dropping is greedy by choice rank: all 1st choices
+    claim capacity before any 2nd choice (reference gshard ordering).
+    """
+    n, e = gates.shape
+    combine = jnp.zeros((n, e, capacity), dtype=jnp.float32)
+    masked_gates = gates
+    count_so_far = jnp.zeros((e,), dtype=jnp.int32)
+    chosen_masks, chosen_gates = [], []
+    for k in range(top_k):
+        idx = jnp.argmax(masked_gates, axis=-1)
+        mask = jax.nn.one_hot(idx, e, dtype=jnp.int32)
+        gate_k = jnp.sum(gates * mask, axis=-1)
+        if k >= 1 and second_keep is not None:
+            mask = mask * second_keep[:, None].astype(jnp.int32)
+        chosen_masks.append(mask)
+        chosen_gates.append(gate_k)
+        masked_gates = masked_gates * (1 - mask)
+
+    denom = 1.0
+    if normalize:
+        denom = sum(
+            g * m.max(axis=-1) for g, m in zip(chosen_gates, chosen_masks)
+        )
+        denom = jnp.maximum(denom, 1e-9)
+
+    dispatch = jnp.zeros((n, e, capacity), dtype=bool)
+    for k in range(top_k):
+        mask = chosen_masks[k]
+        pos, keep = _positions_in_expert(mask, capacity, offset=count_so_far)
+        count_so_far = count_so_far + jnp.sum(mask, axis=0)
+        d_k = jax.nn.one_hot(pos, capacity, dtype=jnp.float32) * keep[
+            ..., None
+        ].astype(jnp.float32)
+        w_k = chosen_gates[k] / denom if normalize else chosen_gates[k]
+        combine = combine + d_k * w_k[:, None, None]
+        dispatch = dispatch | d_k.astype(bool)
+    return combine, dispatch
+
+
+class BaseGate(Layer):
+    """Gate base (upstream: gate/base_gate.py). ``num_expert`` is the
+    per-worker count in the reference; ``tot_expert`` is the global
+    expert count, which the ep mesh axis shards."""
+
+    def __init__(self, num_expert, world_size):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self.loss = None
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+    def _topk_forward(self, inp, name, k):
+        def f(x, w):
+            logits = x.astype(jnp.float32) @ w.astype(jnp.float32)
+            return jax.lax.top_k(jax.nn.softmax(logits, -1), k)
+
+        val, idx = apply_op(name, f, inp, self.weight, n_outs=2)
+        idx.stop_gradient = True
+        return val, idx
+
+
+class NaiveGate(BaseGate):
+    """Plain linear top-k gate, no aux loss (upstream: naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size, topk=2):
+        super().__init__(num_expert, world_size)
+        self.d_model = d_model
+        self.top_k = topk
+        self.weight = self.create_parameter(
+            [d_model, self.tot_expert],
+            default_initializer=I.XavierUniform(),
+        )
+
+    def forward(self, inp):
+        """Reference-style return: (topk_val, topk_idx)."""
+        return self._topk_forward(inp, "naive_gate", self.top_k)
+
+    def make_router(self, capacity_factor=None):
+        if capacity_factor is None:
+            capacity_factor = 2.0
+        top_k, e = self.top_k, self.tot_expert
+
+        def route(x, w):
+            cap = _capacity(x.shape[0], e, top_k, capacity_factor)
+            logits = x.astype(jnp.float32) @ w.astype(jnp.float32)
+            gates = jax.nn.softmax(logits, axis=-1)
+            combine, dispatch = _topk_combine_dispatch(
+                gates, top_k, cap, normalize=False
+            )
+            return combine, dispatch, jnp.zeros((), jnp.float32)
+
+        return route
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with GShard load-balancing aux loss, capacity limiting
+    and random 2nd-expert routing (upstream: gate/gshard_gate.py + the
+    random_routing / limit_by_capacity CUDA ops)."""
+
+    def __init__(self, d_model, num_expert, world_size, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        assert topk == 2, "gshard gate requires topk==2"
+        super().__init__(num_expert, world_size)
+        self.d_model = d_model
+        self.top_k = 2
+        self.capacity = capacity
+        self.random_routing = random_routing
+        self.weight = self.create_parameter(
+            [d_model, self.tot_expert],
+            default_initializer=I.XavierUniform(),
+        )
+
+    def forward(self, inp):
+        return self._topk_forward(inp, "gshard_gate", self.top_k)
+
+    def make_router(self, capacity_factor=None):
+        cf = capacity_factor if capacity_factor is not None else (
+            self.capacity[0] if self.training else self.capacity[1]
+        )
+        e = self.tot_expert
+        rand_key = (
+            next_key() if (self.random_routing and self.training) else None
+        )
+
+        def route(x, w):
+            cap = _capacity(x.shape[0], e, 2, cf)
+            logits = x.astype(jnp.float32) @ w.astype(jnp.float32)
+            gates = jax.nn.softmax(logits, axis=-1)
+
+            # aux loss (gshard): E * sum_e mean_n(gate_e) * mean_n(top1_e)
+            top1_mask = jax.nn.one_hot(
+                jnp.argmax(gates, axis=-1), e, dtype=jnp.float32
+            )
+            aux = jnp.sum(
+                jnp.mean(gates, axis=0) * jnp.mean(top1_mask, axis=0)
+            ) * e
+
+            second_keep = None
+            if rand_key is not None:
+                g2 = jnp.max(gates * (1 - top1_mask), axis=-1)
+                u = jax.random.uniform(rand_key, (x.shape[0],))
+                second_keep = u < (2.0 * g2)
+
+            combine, dispatch = _topk_combine_dispatch(
+                gates, 2, cap, normalize=True, second_keep=second_keep
+            )
+            return combine, dispatch, aux
+
+        return route
+
+
+class SwitchGate(BaseGate):
+    """Top-1 Switch-Transformer gate with switch aux loss
+    (upstream: gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        assert topk == 1, "switch gate requires topk==1"
+        super().__init__(num_expert, world_size)
+        self.d_model = d_model
+        self.top_k = 1
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+        self.weight = self.create_parameter(
+            [d_model, self.tot_expert],
+            default_initializer=I.XavierUniform(),
+        )
+
+    def forward(self, inp):
+        return self._topk_forward(inp, "switch_gate", 1)
+
+    def make_router(self, capacity_factor=None):
+        cf = capacity_factor if capacity_factor is not None else (
+            self.capacity[0] if self.training else self.capacity[1]
+        )
+        e = self.tot_expert
+        eps = self.switch_eps if self.training else 0.0
+        noise_key = next_key() if eps > 0 else None
+
+        def route(x, w):
+            cap = _capacity(x.shape[0], e, 1, cf)
+            logits = x.astype(jnp.float32) @ w.astype(jnp.float32)
+            if noise_key is not None:
+                # multiplicative jitter noise (switch paper §2.2)
+                logits = logits * jax.random.uniform(
+                    noise_key, logits.shape,
+                    minval=1.0 - eps, maxval=1.0 + eps,
+                )
+            gates = jax.nn.softmax(logits, axis=-1)
+
+            top1_mask = jax.nn.one_hot(
+                jnp.argmax(gates, axis=-1), e, dtype=jnp.float32
+            )
+            aux = jnp.sum(
+                jnp.mean(gates, axis=0) * jnp.mean(top1_mask, axis=0)
+            ) * e
+
+            combine, dispatch = _topk_combine_dispatch(
+                gates, 1, cap, normalize=False
+            )
+            return combine, dispatch, aux
+
+        return route

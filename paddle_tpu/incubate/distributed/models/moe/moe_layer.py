@@ -1,0 +1,292 @@
+"""MoE layer — expert parallelism over the ``ep`` mesh axis
+(upstream: python/paddle/incubate/distributed/models/moe/moe_layer.py;
+the all-to-all ops: paddle/fluid/operators/collective/
+global_scatter_op.cu.cc, global_gather_op.cu.cc).
+
+TPU-native design (GShard einsum formulation, not a port):
+
+The reference routes tokens with dynamic-length index lists and two
+NCCL all-to-alls (global_scatter / global_gather). On TPU the same
+computation is three static-shape einsums::
+
+    dispatch:  (N,E,C) x (N,d)   -> (E,C,d)     # token -> expert slots
+    experts:   (E,C,d) x (E,d,f) -> (E,C,f)     # batched per-expert FFN
+    combine:   (N,E,C) x (E,C,d) -> (N,d)       # weighted return
+
+With tokens sharded over dp and the stacked expert weights sharded over
+``ep`` (leading E dim), XLA's SPMD partitioner inserts the all-to-all
+pair exactly where global_scatter/global_gather run — on ICI, fused
+with the surrounding matmuls. Inside a manual shard_map region (the
+compiled pipeline), the all-to-alls are explicit ``lax.all_to_all``.
+
+Expert compute is a batched matmul over the E dim — MXU-shaped, unlike
+per-expert kernel launches.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....framework.core import Tensor, apply_op, _as_tensor
+from .....nn import initializer as I
+from .....nn.layer.layers import Layer, LayerList
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+from .....distributed.mesh import (
+    axis_degree,
+    global_mesh,
+    in_manual_context,
+    named_sharding,
+)
+
+
+def _ep_degree() -> int:
+    return axis_degree("ep")
+
+
+def _constrain(raw, *spec):
+    """with_sharding_constraint on a raw array (no-op without a mesh)."""
+    sh = named_sharding(*spec)
+    if sh is None:
+        return raw
+    return jax.lax.with_sharding_constraint(raw, sh)
+
+
+class ExpertLayer(Layer):
+    """One FFN expert (d_model -> d_hidden -> d_model), the unit the
+    reference wraps per-rank (moe_layer.py builds one per local expert)."""
+
+    def __init__(self, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.d_model, self.d_hidden = d_model, d_hidden
+        self.activation = activation
+        self.w0 = self.create_parameter(
+            [d_model, d_hidden], default_initializer=I.XavierUniform()
+        )
+        self.b0 = self.create_parameter([d_hidden], is_bias=True)
+        self.w1 = self.create_parameter(
+            [d_hidden, d_model], default_initializer=I.XavierUniform()
+        )
+        self.b1 = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, x):
+        from .....nn import functional as F
+
+        h = F.linear(x, self.w0, self.b0)
+        h = F.gelu(h, approximate=True) if self.activation == "gelu" else (
+            F.relu(h)
+        )
+        return F.linear(h, self.w1, self.b1)
+
+
+def _make_gate(gate, d_model, num_experts, top_k):
+    if isinstance(gate, BaseGate):
+        return gate
+    if isinstance(gate, dict):
+        kind = gate.get("type", "gshard")
+        kwargs = {k: v for k, v in gate.items() if k != "type"}
+    else:
+        kind, kwargs = (gate or "gshard"), {}
+    kind = str(kind).lower()
+    # an explicit top_k is passed through so the gshard/switch ctor
+    # asserts reject inconsistent values instead of silently overriding;
+    # top_k=None takes each gate's natural k
+    if kind == "gshard":
+        return GShardGate(
+            d_model, num_experts, 1,
+            topk=2 if top_k is None else top_k, **kwargs,
+        )
+    if kind == "switch":
+        return SwitchGate(
+            d_model, num_experts, 1,
+            topk=1 if top_k is None else top_k, **kwargs,
+        )
+    if kind == "naive":
+        return NaiveGate(
+            d_model, num_experts, 1,
+            topk=2 if top_k is None else top_k, **kwargs,
+        )
+    raise ValueError(f"unknown gate type {kind!r}")
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts layer.
+
+    Two construction modes:
+
+    * TPU-first (perf path): ``MoELayer(d_model, num_experts=E,
+      d_hidden=F)`` — stacked expert weights ``(E, d, f)`` sharded over
+      the ``ep`` mesh axis; expert compute is one batched einsum.
+    * Reference-parity: ``MoELayer(d_model, experts=[Layer, ...])`` —
+      arbitrary per-expert Layers, run E-way unrolled on their capacity
+      slices (correct, slower; each expert still static-shape ``(C,d)``).
+
+    ``forward`` keeps the reference contract: returns the combined
+    output, stores the gate's aux loss on ``self.gate.loss`` (fetch via
+    ``self.gate.get_loss()`` and add it to the training loss).
+    """
+
+    def __init__(self, d_model, experts=None, gate="gshard", moe_group=None,
+                 mp_group=None, recompute_interval=0, num_experts=None,
+                 d_hidden=None, top_k=None, capacity_factor=None,
+                 activation="gelu"):
+        super().__init__()
+        self.d_model = d_model
+        self.capacity_factor = capacity_factor
+        self.recompute_interval = recompute_interval
+
+        if experts is not None:
+            self.experts = (
+                experts if isinstance(experts, LayerList)
+                else LayerList(list(experts))
+            )
+            self.num_experts = len(self.experts)
+            self._stacked = False
+        else:
+            assert num_experts and d_hidden, (
+                "MoELayer needs either experts=[...] or "
+                "num_experts=/d_hidden="
+            )
+            self.num_experts = int(num_experts)
+            self.d_hidden = int(d_hidden)
+            self.activation = activation
+            self._stacked = True
+            e, d, f = self.num_experts, d_model, self.d_hidden
+            self.w0 = self.create_parameter(
+                [e, d, f], default_initializer=I.XavierUniform()
+            )
+            self.b0 = self.create_parameter([e, f], is_bias=True)
+            self.w1 = self.create_parameter(
+                [e, f, d], default_initializer=I.XavierUniform()
+            )
+            self.b1 = self.create_parameter([e, d], is_bias=True)
+            for p, spec in (
+                (self.w0, ("ep", None, None)), (self.b0, ("ep", None)),
+                (self.w1, ("ep", None, None)), (self.b1, ("ep", None)),
+            ):
+                self._place_ep(p, spec)
+
+        self.gate = _make_gate(gate, d_model, self.num_experts, top_k)
+
+    @staticmethod
+    def _place_ep(param, spec):
+        param._dist_attr = tuple(spec)
+        m = global_mesh()
+        if m is None or _ep_degree() <= 1:
+            return
+        try:
+            param._data = jax.device_put(
+                param._data, named_sharding(*spec)
+            )
+        except Exception:
+            pass
+        param.is_distributed = True
+
+    # -- forward -----------------------------------------------------------
+
+    def forward(self, inp):
+        inp = _as_tensor(inp)
+        orig_shape = inp.shape
+        router = self.gate.make_router(self.capacity_factor)
+        manual = in_manual_context(("ep",)) and _ep_degree() > 1
+
+        if self._stacked:
+            act = self.activation
+
+            def f(x, gw, w0, b0, w1, b1):
+                lead = x.shape[:-1]
+                xt = x.reshape(-1, x.shape[-1])
+                combine, dispatch, aux = router(xt, gw)
+                if manual:
+                    out = _moe_manual(
+                        xt, combine, dispatch, w0, b0, w1, b1, act
+                    )
+                else:
+                    out = _moe_gspmd(
+                        xt, combine, dispatch, w0, b0, w1, b1, act
+                    )
+                return out.astype(x.dtype).reshape(*lead, -1), aux
+
+            out, aux = apply_op(
+                "moe_layer", f, inp, self.gate.weight,
+                self.w0, self.b0, self.w1, self.b1, n_outs=2,
+            )
+        else:
+            # reference-parity path: unrolled per-expert Layers
+            def fd(x, gw):
+                xt = x.reshape(-1, x.shape[-1])
+                combine, dispatch, aux = router(xt, gw)
+                expert_in = jnp.einsum(
+                    "nec,nd->ecd", dispatch.astype(xt.dtype), xt
+                )
+                return expert_in, combine, aux
+
+            expert_in, combine, aux = apply_op(
+                "moe_dispatch", fd, inp, self.gate.weight, n_outs=3
+            )
+            outs = []
+            for e, expert in enumerate(self.experts):
+                slot = apply_op(
+                    f"moe_slot_{e}", lambda a, _e=e: a[_e], expert_in
+                )
+                outs.append(expert(slot))
+
+            def fc(x, comb, *eouts):
+                eo = jnp.stack(eouts, axis=0)  # (E, C, d)
+                out = jnp.einsum("nec,ecd->nd", comb, eo.astype(jnp.float32))
+                return out.astype(x.dtype).reshape(x.shape)
+
+            out = apply_op("moe_combine", fc, inp, combine, *outs)
+
+        self.gate.loss = aux if isinstance(aux, Tensor) else aux
+        return out
+
+
+def _expert_ffn(expert_in, w0, b0, w1, b1, act):
+    """(E, C, d) -> (E, C, d): batched-over-experts FFN on the MXU."""
+    h = jnp.einsum("ecd,edf->ecf", expert_in, w0) + b0[:, None, :]
+    h = jax.nn.gelu(h, approximate=True) if act == "gelu" else jax.nn.relu(h)
+    return jnp.einsum("ecf,efd->ecd", h, w1) + b1[:, None, :]
+
+
+def _moe_gspmd(xt, combine, dispatch, w0, b0, w1, b1, act):
+    """GSPMD path: shard constraints make the partitioner insert the
+    global_scatter / global_gather all-to-alls."""
+    cdt = xt.dtype
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(cdt), xt)
+    if _ep_degree() > 1:
+        expert_in = _constrain(expert_in, "ep", None, None)
+    expert_out = _expert_ffn(expert_in, w0, b0, w1, b1, act)
+    if _ep_degree() > 1:
+        expert_out = _constrain(expert_out, "ep", None, None)
+    return jnp.einsum(
+        "nec,ecd->nd", combine.astype(jnp.float32),
+        expert_out.astype(jnp.float32),
+    )
+
+
+def _moe_manual(xt, combine, dispatch, w0, b0, w1, b1, act):
+    """Manual (shard_map) path: explicit all_to_all over the ep axis.
+
+    Per-device state: xt is the local token shard, expert weights are
+    the local expert slice (E_local, ...). Dispatch locally to ALL E
+    experts, all_to_all so each device holds its experts' slots from
+    every peer, run local experts, all_to_all back, combine.
+    """
+    cdt = xt.dtype
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(cdt), xt)
+    # global_scatter: (E, C_loc, d) -> (E_local, w*C_loc, d) — each
+    # device ships every peer its slice of that peer's experts and
+    # receives its own experts' slots from everyone
+    expert_in = jax.lax.all_to_all(
+        expert_in, "ep", split_axis=0, concat_axis=1
+    )
+    expert_out = _expert_ffn(expert_in, w0, b0, w1, b1, act)
+    # global_gather: the inverse shuffle
+    expert_out = jax.lax.all_to_all(
+        expert_out, "ep", split_axis=1, concat_axis=0
+    )
+    return jnp.einsum(
+        "nec,ecd->nd", combine.astype(jnp.float32),
+        expert_out.astype(jnp.float32),
+    )

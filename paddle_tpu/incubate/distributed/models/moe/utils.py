@@ -1,0 +1,81 @@
+"""Functional MoE routing ops (upstream CUDA ops:
+paddle/fluid/operators/number_count_op.cu, limit_by_capacity_op.cu,
+prune_gate_by_capacity_op.cu, random_routing_op.cu; Python wrappers in
+python/paddle/incubate/distributed/models/moe/utils.py).
+
+TPU-native: all static-shape jnp reductions/maskings — the dynamic
+compaction the CUDA kernels do is replaced by masking with sentinel -1
+indices (pruned tokens), which the einsum dispatch ignores.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....framework.core import Tensor, apply_op, _as_tensor
+
+
+def _number_count(gate_idx, upper_range):
+    """Tokens-per-expert histogram. gate_idx: int tensor of expert ids;
+    returns (upper_range,) int64-style counts (int32 on TPU)."""
+    gate_idx = _as_tensor(gate_idx)
+
+    def f(idx):
+        oh = jax.nn.one_hot(idx.reshape(-1), upper_range, dtype=jnp.int32)
+        return jnp.sum(oh, axis=0)
+
+    return apply_op("number_count", f, gate_idx, differentiable=False)
+
+
+def _limit_by_capacity(expert_count, capacity, n_worker):
+    """Clamp per-(worker, expert) counts at capacity."""
+    expert_count = _as_tensor(expert_count)
+    capacity = _as_tensor(capacity)
+
+    def f(cnt, cap):
+        return jnp.minimum(
+            cnt.reshape(n_worker, -1), cap[None, :].astype(cnt.dtype)
+        ).reshape(cnt.shape)
+
+    return apply_op(
+        "limit_by_capacity", f, expert_count, capacity, differentiable=False
+    )
+
+
+def _prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker):
+    """Set gate_idx to -1 for tokens past their expert's capacity
+    (position = running count of earlier tokens routed to the same
+    expert — matches the CUDA kernel's atomic-counter semantics)."""
+    gate_idx = _as_tensor(gate_idx)
+    expert_count = _as_tensor(expert_count)
+
+    def f(idx, cnt):
+        flat = idx.reshape(-1)
+        oh = jax.nn.one_hot(flat, n_expert * n_worker, dtype=jnp.int32)
+        pos = jnp.sum((jnp.cumsum(oh, axis=0) - oh) * oh, axis=1)
+        cap = jnp.take(cnt.reshape(-1), flat)
+        return jnp.where(pos < cap, flat, -1).reshape(idx.shape)
+
+    return apply_op(
+        "prune_gate_by_capacity", f, gate_idx, expert_count,
+        differentiable=False,
+    )
+
+
+def _random_routing(topk_idx, topk_value, prob, topk=2):
+    """Drop the 2nd-choice expert where prob >= 2 * gate value
+    (upstream random_routing_op.cu: keep iff p < 2*value)."""
+    assert topk == 2, "only top-2 random routing is defined"
+    topk_idx = _as_tensor(topk_idx)
+    topk_value = _as_tensor(topk_value)
+    prob = _as_tensor(prob)
+
+    def f(idx, val, p):
+        keep = p < (2.0 * val[:, 1])
+        second = jnp.where(keep, idx[:, 1], -1)
+        return jnp.stack([idx[:, 0], second], axis=1)
+
+    return apply_op(
+        "random_routing", f, topk_idx, topk_value, prob,
+        differentiable=False,
+    )

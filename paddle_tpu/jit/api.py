@@ -72,19 +72,33 @@ class StaticFunction:
             for l in arg_leaves
         ]
 
-        state = _registry.snapshot_state_tensors()
-        key = (
-            arg_tree,
-            tuple(
-                ("arr", tuple(r.shape), str(r.dtype))
-                for r in tensor_raws
-            ),
-            tuple(repr(s) for s in static_leaves),
-            tuple(t._uid for t in state),
-            self._mode_sig(),
-        )
+        def make_key(state):
+            return (
+                arg_tree,
+                tuple(
+                    ("arr", tuple(r.shape), str(r.dtype))
+                    for r in tensor_raws
+                ),
+                tuple(repr(s) for s in static_leaves),
+                tuple(t._uid for t in state),
+                self._mode_sig(),
+            )
 
+        state = _registry.snapshot_state_tensors()
+        key = make_key(state)
         entry = self._cache.get(key)
+        if entry is None:
+            # a miss can be spurious: layers/optimizers in cyclic garbage
+            # still sit in the weak registries until the GC runs, so the
+            # snapshot (and key) depends on collection timing. Collect,
+            # re-snapshot, re-check — only a genuinely new (args, state)
+            # signature pays a retrace.
+            import gc
+
+            gc.collect()
+            state = _registry.snapshot_state_tensors()
+            key = make_key(state)
+            entry = self._cache.get(key)
         if entry is None:
             entry = self._make_entry(
                 state, arg_tree, leaf_is_tensor, static_leaves, arg_sg

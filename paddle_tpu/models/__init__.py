@@ -24,7 +24,10 @@ from .gpt import (
     GPTConfig,
     GPTForCausalLM,
     GPTModel,
+    ernie_moe_base,
     gpt3_1_3b,
     gpt3_6_7b,
+    gpt_moe_tiny,
+    gpt_pipeline_model,
     gpt_tiny,
 )

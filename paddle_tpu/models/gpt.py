@@ -38,6 +38,14 @@ class GPTConfig:
     dropout: float = 0.0
     tie_word_embeddings: bool = True
     recompute: bool = False
+    # MoE (ERNIE-MoE-style mp×pp×ep config): num_experts>0 replaces the
+    # dense MLP with a MoELayer on every `moe_every`-th layer
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_gate: str = "gshard"
+    moe_top_k: int = None  # None -> the gate's natural k (gshard 2, switch 1)
+    moe_capacity_factor: float = None
+    moe_aux_loss_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -45,11 +53,31 @@ class GPTConfig:
 
     def num_params(self) -> int:
         h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
-        per_layer = 4 * h * h + 2 * h * i + (4 * h + i + h) + 4 * h
+        attn = 4 * h * h + 4 * h
+        dense_mlp = 2 * h * i + i + h
+        moe_mlp = self.num_experts * (2 * h * i + i + h) + h * self.num_experts
+        lns = 4 * h
+        total = 0
+        for l in range(self.num_hidden_layers):
+            mlp = moe_mlp if _use_moe(self, l) else dense_mlp
+            total += attn + mlp + lns
         emb = v * h + self.max_position_embeddings * h
         if not self.tie_word_embeddings:
             emb += v * h
-        return per_layer * self.num_hidden_layers + emb + 2 * h
+        return total + emb + 2 * h
+
+    def num_active_params(self) -> int:
+        """Per-token active parameters (top-k of the experts) — the
+        FLOPs-relevant count for MoE MFU accounting."""
+        if self.num_experts == 0:
+            return self.num_params()
+        h, i = self.hidden_size, self.intermediate_size
+        k = self.moe_top_k or (1 if self.moe_gate == "switch" else 2)
+        inactive = (self.num_experts - k) * (2 * h * i + i + h)
+        n_moe = sum(
+            1 for l in range(self.num_hidden_layers) if _use_moe(self, l)
+        )
+        return self.num_params() - n_moe * inactive
 
 
 def gpt3_1_3b(**kw) -> GPTConfig:
@@ -71,6 +99,25 @@ def gpt_tiny(**kw) -> GPTConfig:
     kw.setdefault("num_attention_heads", 4)
     kw.setdefault("max_position_embeddings", 256)
     return GPTConfig(**kw)
+
+
+def ernie_moe_base(**kw) -> GPTConfig:
+    """ERNIE-MoE-style acceptance config (mp×pp×ep; BASELINE.md):
+    GPT backbone with an expert MLP on every layer so the pipelined
+    body stacks uniformly (stacked expert params shard pp×ep)."""
+    kw.setdefault("hidden_size", 1024)
+    kw.setdefault("intermediate_size", 4096)
+    kw.setdefault("num_hidden_layers", 12)
+    kw.setdefault("num_attention_heads", 16)
+    kw.setdefault("num_experts", 8)
+    kw.setdefault("moe_every", 1)
+    return GPTConfig(**kw)
+
+
+def gpt_moe_tiny(**kw) -> GPTConfig:
+    kw.setdefault("num_experts", 4)
+    kw.setdefault("moe_every", 1)
+    return gpt_tiny(**kw)
 
 
 class GPTAttention(Layer):
@@ -131,8 +178,17 @@ class GPTMLP(Layer):
         return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
 
 
+def _use_moe(config: GPTConfig, layer_idx: int) -> bool:
+    return (
+        config.num_experts > 0
+        and layer_idx % max(config.moe_every, 1) == (
+            max(config.moe_every, 1) - 1
+        )
+    )
+
+
 class GPTDecoderLayer(Layer):
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
         self.ln_1 = LayerNorm(
             config.hidden_size, epsilon=config.layer_norm_eps
@@ -141,32 +197,41 @@ class GPTDecoderLayer(Layer):
         self.ln_2 = LayerNorm(
             config.hidden_size, epsilon=config.layer_norm_eps
         )
-        self.mlp = GPTMLP(config)
+        self.is_moe = _use_moe(config, layer_idx)
+        if self.is_moe:
+            from ..incubate.distributed.models.moe import MoELayer
+
+            self.mlp = MoELayer(
+                config.hidden_size,
+                num_experts=config.num_experts,
+                d_hidden=config.intermediate_size,
+                gate=config.moe_gate,
+                top_k=config.moe_top_k,
+                capacity_factor=config.moe_capacity_factor,
+            )
+        else:
+            self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.dropout)
 
     def forward(self, x):
         h = x + self.dropout(self.attn(self.ln_1(x)))
         return h + self.dropout(self.mlp(self.ln_2(h)))
 
+    def moe_loss(self):
+        if self.is_moe and self.mlp.gate.loss is not None:
+            return self.mlp.gate.get_loss()
+        return None
 
-class GPTModel(Layer):
-    def __init__(self, config: GPTConfig):
+
+class _GPTEmbedding(Layer):
+    """Token + learned-position embedding (shared by the eager model and
+    the pipeline's embedding stage / tied head)."""
+
+    def __init__(self, vocab_size, hidden_size, max_positions, dropout=0.0):
         super().__init__()
-        self.config = config
-        self.wte = VocabParallelEmbedding(
-            config.vocab_size, config.hidden_size
-        )
-        self.wpe = Embedding(
-            config.max_position_embeddings, config.hidden_size
-        )
-        self.drop = Dropout(config.dropout)
-        self.h = LayerList(
-            [GPTDecoderLayer(config)
-             for _ in range(config.num_hidden_layers)]
-        )
-        self.ln_f = LayerNorm(
-            config.hidden_size, epsilon=config.layer_norm_eps
-        )
+        self.wte = VocabParallelEmbedding(vocab_size, hidden_size)
+        self.wpe = Embedding(max_positions, hidden_size)
+        self.drop = Dropout(dropout)
 
     def forward(self, input_ids):
         s = input_ids.shape[1]
@@ -175,7 +240,35 @@ class GPTModel(Layer):
             lambda ids: jnp.arange(s, dtype=jnp.int32)[None, :],
             input_ids, differentiable=False,
         )
-        h = self.drop(self.wte(input_ids) + self.wpe(pos))
+        return self.drop(self.wte(input_ids) + self.wpe(pos))
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embedding = _GPTEmbedding(
+            config.vocab_size, config.hidden_size,
+            config.max_position_embeddings, config.dropout,
+        )
+        self.h = LayerList(
+            [GPTDecoderLayer(config, i)
+             for i in range(config.num_hidden_layers)]
+        )
+        self.ln_f = LayerNorm(
+            config.hidden_size, epsilon=config.layer_norm_eps
+        )
+
+    @property
+    def wte(self):
+        return self.embedding.wte
+
+    @property
+    def wpe(self):
+        return self.embedding.wpe
+
+    def forward(self, input_ids):
+        h = self.embedding(input_ids)
         if self.config.recompute:
             from ..distributed.fleet.recompute import recompute
 
@@ -185,6 +278,9 @@ class GPTModel(Layer):
             for l in self.h:
                 h = l(h)
         return self.ln_f(h)
+
+
+_warned_moe_recompute = False
 
 
 class GPTForCausalLM(Layer):
@@ -210,4 +306,124 @@ class GPTForCausalLM(Layer):
             logits = self.lm_head(h)
         if labels is None:
             return logits
-        return logits, self.criterion(logits, labels)
+        loss = self.criterion(logits, labels)
+        if self.config.num_experts > 0:
+            if self.config.recompute:
+                # the decoder runs inside jax.checkpoint: the gate's
+                # side-channel aux tensor is a leaked tracer there, so
+                # the balance loss cannot be collected (same limitation
+                # as the pipelined form — see gpt_pipeline_model)
+                global _warned_moe_recompute
+                if not _warned_moe_recompute:
+                    import warnings
+
+                    warnings.warn(
+                        "MoE aux (load-balance) loss is dropped when "
+                        "recompute is enabled; routing still trains "
+                        "through the combine weights"
+                    )
+                    _warned_moe_recompute = True
+            else:
+                aux = None
+                for l in self.gpt.h:
+                    a = l.moe_loss()
+                    if a is not None:
+                        aux = a if aux is None else aux + a
+                if aux is not None:
+                    loss = loss + self.config.moe_aux_loss_weight * aux
+        return logits, loss
+
+
+# -- pipeline form ----------------------------------------------------------
+
+
+class _GPTNorm(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_f = LayerNorm(
+            config.hidden_size, epsilon=config.layer_norm_eps
+        )
+
+    def forward(self, h):
+        return self.ln_f(h)
+
+
+class _GPTHead(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_f = LayerNorm(
+            config.hidden_size, epsilon=config.layer_norm_eps
+        )
+        self.lm_head = ColumnParallelLinear(
+            config.hidden_size, config.vocab_size,
+            has_bias=False, gather_output=False,
+        )
+
+    def forward(self, h):
+        return self.lm_head(self.ln_f(h))
+
+
+def _gpt_tied_head_forward(embed_layer, h):
+    w = embed_layer.wte.weight
+    return apply_op("tied_lm_head", lambda a, b: a @ b.T, h, w)
+
+
+def gpt_pipeline_model(config: GPTConfig, **pp_kwargs):
+    """PipelineLayer form of GPT (incl. the ERNIE-MoE mp×pp×ep config:
+    with num_experts>0 and moe_every=1 every decoder desc is identical,
+    so the body stacks into [n_layers, ...] params sharded pp (+ep for
+    expert weights) — see pp_layers._StackedBody).
+
+    Pipeline caveat: MoE gate aux losses stay inside the compiled stage
+    scan and are not added to the criterion loss (tracked limitation;
+    the dense CE still trains the gate via routing weights).
+    """
+    from ..distributed.fleet.meta_parallel.parallel_layers.pp_layers import (
+        LayerDesc,
+        PipelineLayer,
+        SharedLayerDesc,
+    )
+    from .llama import LlamaPretrainingCriterion
+
+    if config.num_experts > 0 and config.moe_every != 1:
+        # every pipelined body desc must be identical to stack into the
+        # [n_layers, ...] pp-sharded params; LayerDesc carries no
+        # layer_idx, so a moe_every>1 config would silently build
+        # all-dense layers — reject it instead
+        raise ValueError(
+            "gpt_pipeline_model requires moe_every=1 for MoE configs "
+            "(uniform decoder stack); got moe_every="
+            f"{config.moe_every}"
+        )
+    body = [
+        LayerDesc(GPTDecoderLayer, config)
+        for _ in range(config.num_hidden_layers)
+    ]
+    if config.tie_word_embeddings:
+        descs = [
+            SharedLayerDesc(
+                "gpt_embed", _GPTEmbedding, None, "wte",
+                config.vocab_size, config.hidden_size,
+                config.max_position_embeddings, config.dropout,
+            ),
+            *body,
+            LayerDesc(_GPTNorm, config),
+            SharedLayerDesc(
+                "gpt_embed", _GPTEmbedding, _gpt_tied_head_forward, "wte",
+                config.vocab_size, config.hidden_size,
+                config.max_position_embeddings, config.dropout,
+            ),
+        ]
+    else:
+        descs = [
+            LayerDesc(
+                _GPTEmbedding, config.vocab_size, config.hidden_size,
+                config.max_position_embeddings, config.dropout,
+            ),
+            *body,
+            LayerDesc(_GPTHead, config),
+        ]
+    pp_kwargs.setdefault("loss_fn", LlamaPretrainingCriterion())
+    if config.recompute:
+        pp_kwargs.setdefault("recompute_interval", 1)
+    return PipelineLayer(descs, **pp_kwargs)

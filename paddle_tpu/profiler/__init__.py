@@ -1,0 +1,344 @@
+"""Profiler — paddle.profiler-parity API over jax.profiler
+(upstream: python/paddle/profiler/{profiler,profiler_statistic}.py; C++
+tracers: paddle/fluid/platform/profiler/host_tracer.cc,
+cuda_tracer.cc, chrometracinglogger.cc).
+
+TPU-native mapping:
+* HostTracer's RecordEvent instrumentation → :class:`RecordEvent`
+  (host-side ring buffer for ``summary()``) + a
+  ``jax.profiler.TraceAnnotation`` so the range shows up on the device
+  timeline (the role NVTX ranges play for nsight);
+* CudaTracer (CUPTI) → the XLA/TPU trace collected by
+  ``jax.profiler.start_trace`` (XPlane → TensorBoard/Perfetto, the
+  Chrome-trace export analog);
+* the wait/warmup/active scheduler, ProfilerTarget and summary tables
+  keep the reference API shape.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Iterable, Optional
+
+import jax
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "SortedKeys", "SummaryView", "export_chrome_tracing",
+    "export_protobuf", "make_scheduler",
+]
+
+
+class ProfilerState(enum.IntEnum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.IntEnum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class SortedKeys(enum.IntEnum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.IntEnum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+# -- host event collection ---------------------------------------------------
+
+_events_lock = threading.Lock()
+_events = []  # (name, start_s, dur_s)
+_collecting = False
+
+
+class RecordEvent:
+    """Host-side instrumentation range (upstream: RecordEvent in
+    paddle/fluid/platform/profiler/event_tracing.h; Python
+    paddle.profiler.RecordEvent). Also emits a TraceAnnotation so the
+    range appears in the device trace."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        if self._ann is None:
+            return
+        dur = time.perf_counter() - self._t0
+        self._ann.__exit__(None, None, None)
+        self._ann = None
+        if _collecting:
+            with _events_lock:
+                _events.append((self.name, self._t0, dur))
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def _start_collecting():
+    global _collecting
+    with _events_lock:
+        _events.clear()
+    _collecting = True
+
+
+def _stop_collecting():
+    global _collecting
+    _collecting = False
+
+
+# -- scheduler ---------------------------------------------------------------
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-state schedule (upstream: paddle.profiler.make_scheduler):
+    skip_first steps CLOSED, then cycles of [closed CLOSED, ready READY,
+    record RECORD(last=RECORD_AND_RETURN)], `repeat` times (0=forever).
+    """
+    assert closed >= 0 and ready >= 0 and record > 0
+    cycle = closed + ready + record
+
+    def fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return fn
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+# -- trace export callables --------------------------------------------------
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready callable: the collected XPlane trace under
+    ``dir_name`` is TensorBoard/Perfetto-loadable (the reference writes
+    Chrome-trace JSON; XLA's native artifact is the XPlane .pb, viewable
+    in the same tools)."""
+
+    def handle(prof):
+        prof._exported_to = dir_name
+
+    handle._dir = dir_name
+    return handle
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+# -- Profiler ----------------------------------------------------------------
+
+
+class Profiler:
+    """paddle.profiler.Profiler-parity driver.
+
+    with Profiler(scheduler=(2, 5)) as p:
+        for step in range(...):
+            train_step()
+            p.step()
+    p.summary()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready=None, record_shapes=False,
+                 profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None):
+        self.timer_only = timer_only
+        if isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self.scheduler = make_scheduler(
+                closed=max(start - 1, 0),
+                ready=1 if start > 0 else 0,
+                record=end - start, repeat=1,
+            )
+        elif callable(scheduler):
+            self.scheduler = scheduler
+        else:
+            self.scheduler = _default_state_scheduler
+        self.on_trace_ready = on_trace_ready
+        self._dir = getattr(on_trace_ready, "_dir", None) or os.path.join(
+            os.getcwd(), "profiler_log"
+        )
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._tracing = False
+        self._exported_to = None
+        self._step_t0 = None
+        self._step_times = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self.current_state = self.scheduler(self.step_num)
+        self._transit(ProfilerState.CLOSED, self.current_state)
+        self._step_t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        self._transit(self.current_state, ProfilerState.CLOSED)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        if self._step_t0 is not None:
+            dt = time.perf_counter() - self._step_t0
+            self._step_times.append(
+                (dt, num_samples) if num_samples else (dt, None)
+            )
+        self._step_t0 = time.perf_counter()
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self.scheduler(self.step_num)
+        self._transit(prev, self.current_state)
+
+    def _transit(self, prev: ProfilerState, new: ProfilerState):
+        was_on = prev in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+        )
+        now_on = new in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+        )
+        if not was_on and now_on:
+            _start_collecting()
+            if not self.timer_only:
+                try:
+                    os.makedirs(self._dir, exist_ok=True)
+                    jax.profiler.start_trace(self._dir)
+                    self._tracing = True
+                except Exception:
+                    self._tracing = False
+        elif was_on and not now_on:
+            if self._tracing:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                self._tracing = False
+                self._exported_to = self._dir
+            _stop_collecting()
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
+                thread_sep=False, time_unit="ms", views=None):
+        """Print an operator-level stats table from the host events
+        (upstream: profiler_statistic.py summary tables)."""
+        unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+        with _events_lock:
+            ev = list(_events)
+        stats = defaultdict(lambda: [0, 0.0, 0.0])  # name -> [n, tot, mx]
+        for name, _, dur in ev:
+            s = stats[name]
+            s[0] += 1
+            s[1] += dur
+            s[2] = max(s[2], dur)
+        lines = [
+            "-" * 75,
+            f"{'Name':<35}{'Calls':>8}{'Total(' + time_unit + ')':>12}"
+            f"{'Avg(' + time_unit + ')':>10}{'Max(' + time_unit + ')':>10}",
+            "-" * 75,
+        ]
+        for name, (n, tot, mx) in sorted(
+            stats.items(), key=lambda kv: -kv[1][1]
+        ):
+            lines.append(
+                f"{name[:34]:<35}{n:>8}{tot * unit:>12.3f}"
+                f"{tot / n * unit:>10.3f}{mx * unit:>10.3f}"
+            )
+        if self._step_times:
+            tot = sum(t for t, _ in self._step_times)
+            lines.append("-" * 75)
+            lines.append(
+                f"{'[steps]':<35}{len(self._step_times):>8}"
+                f"{tot * unit:>12.3f}"
+                f"{tot / len(self._step_times) * unit:>10.3f}"
+                f"{max(t for t, _ in self._step_times) * unit:>10.3f}"
+            )
+            samples = [n for _, n in self._step_times if n]
+            if samples:
+                ips = sum(samples) / tot
+                lines.append(f"{'[throughput/s]':<35}{ips:>20.2f}")
+        if self._exported_to:
+            lines.append(f"trace exported to: {self._exported_to}")
+        lines.append("-" * 75)
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+
+@contextlib.contextmanager
+def profile(**kwargs):
+    p = Profiler(**kwargs).start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+def start_profiler(log_dir="profiler_log"):
+    """Low-level: begin an XLA trace now (jax.profiler.start_trace)."""
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profiler():
+    jax.profiler.stop_trace()

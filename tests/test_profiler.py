@@ -1,0 +1,69 @@
+"""Profiler + flags/debugging tests (upstream model:
+test/legacy_test/test_profiler.py, test_nan_inf checks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (
+    Profiler,
+    ProfilerState,
+    RecordEvent,
+    make_scheduler,
+)
+
+
+class TestScheduler:
+    def test_make_scheduler_cycle(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sched(i) for i in range(6)]
+        assert states == [
+            ProfilerState.CLOSED,
+            ProfilerState.READY,
+            ProfilerState.RECORD,
+            ProfilerState.RECORD_AND_RETURN,
+            ProfilerState.CLOSED,
+            ProfilerState.CLOSED,
+        ]
+
+    def test_skip_first(self):
+        sched = make_scheduler(closed=0, ready=0, record=1, skip_first=2)
+        assert sched(0) == ProfilerState.CLOSED
+        assert sched(1) == ProfilerState.CLOSED
+        assert sched(2) == ProfilerState.RECORD_AND_RETURN
+
+
+class TestProfiler:
+    def test_record_and_summary(self, tmp_path):
+        p = Profiler(
+            scheduler=make_scheduler(closed=0, ready=0, record=3, repeat=1),
+            on_trace_ready=profiler.export_chrome_tracing(str(tmp_path)),
+            timer_only=True,
+        )
+        p.start()
+        x = paddle.to_tensor(np.ones((8, 8), dtype="float32"))
+        for _ in range(3):
+            with RecordEvent("matmul_step"):
+                y = paddle.matmul(x, x)
+            p.step(num_samples=8)
+        p.stop()
+        text = p.summary()
+        assert "matmul_step" in text
+        assert "[steps]" in text
+
+    def test_context_manager(self):
+        with Profiler(timer_only=True) as p:
+            with RecordEvent("evt"):
+                pass
+            p.step()
+        assert p.step_num == 1
+
+
+class TestNanInfFlag:
+    def test_flag_roundtrip(self):
+        import jax
+
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        assert jax.config.jax_debug_nans
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+        assert not jax.config.jax_debug_nans
