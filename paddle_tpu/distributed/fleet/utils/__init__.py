@@ -1,4 +1,15 @@
+from . import context_parallel  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
 from ..recompute import recompute  # noqa: F401
+from .context_parallel import (  # noqa: F401
+    gather_sequence,
+    ring_flash_attention,
+    scatter_sequence,
+    ulysses_flash_attention,
+)
 
-__all__ = ["sequence_parallel_utils", "recompute"]
+__all__ = [
+    "sequence_parallel_utils", "context_parallel", "recompute",
+    "ring_flash_attention", "ulysses_flash_attention",
+    "scatter_sequence", "gather_sequence",
+]

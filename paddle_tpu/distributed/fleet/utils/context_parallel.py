@@ -1,0 +1,230 @@
+"""Context parallelism over the ``sep`` mesh axis — ring attention and
+Ulysses (all-to-all) attention.
+
+Upstream: core Paddle only plumbs the ``sep`` topology axis
+(python/paddle/distributed/fleet/base/topology.py); the ring/Ulysses
+algorithms live in the PaddleNLP ecosystem on top of sep-group p2p /
+all_to_all. Here both are first-class (SURVEY.md §5):
+
+* **Ring attention**: Q stays put; the sequence-sharded KV block
+  rotates around the sep ring via ``lax.ppermute`` (neighbor-exchange —
+  the ICI-optimal pattern). Each step runs the blockwise flash kernel
+  and merges the (out, lse) partials with the online-softmax rule, so
+  per-device memory is O(S/w) activations — the Blockwise/RingAttention
+  formulation (Liu et al.) on the Pallas flash core. The whole loop is
+  plain differentiable jax (scan + ppermute + custom-vjp flash), so the
+  backward ring (reverse rotation) falls out of AD.
+* **Ulysses**: ``lax.all_to_all`` re-shards sequence→heads around the
+  attention core (heads must divide sep degree), full-sequence
+  attention runs on 1/w of the heads, and a second all_to_all restores
+  sequence sharding.
+
+Causality over contiguous chunks: at ring step t a device holding query
+chunk ``i`` sees KV chunk ``(i - t) mod w`` — earlier chunks attend
+fully, the diagonal attends causally, later chunks are skipped (the
+known ~2x compute imbalance of contiguous ring; a zigzag/striped
+layout is the tracked optimization).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.core import Tensor, apply_op, _as_tensor
+from ....ops.kernels.flash_attention import NEG_INF, _flash_core_lse
+from ...mesh import axis_degree, global_mesh, in_manual_context
+
+_BLOCK = 512
+
+
+def _merge(o, lse, o_t, lse_t):
+    """Online-softmax merge of two normalized partials (..., S, D)/(.., S)."""
+    new_lse = jnp.logaddexp(lse, lse_t)
+    w0 = jnp.exp(lse - new_lse)[..., None]
+    w1 = jnp.exp(lse_t - new_lse)[..., None]
+    return o * w0 + o_t * w1, new_lse
+
+
+def _ring_attention_local(q, k, v, causal, scale, axis_name, w):
+    """Per-device ring loop. q/k/v: (B, S_loc, H[kv], D) local shards."""
+    b, s_loc, h, d = q.shape
+    hkv = k.shape[2]
+    my = jax.lax.axis_index(axis_name)
+
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, s_loc, d)
+    perm = [(i, (i + 1) % w) for i in range(w)]
+
+    def flash(q3, k_t, v_t, causal_flag):
+        k3 = k_t.transpose(0, 2, 1, 3).reshape(b * hkv, s_loc, d)
+        v3 = v_t.transpose(0, 2, 1, 3).reshape(b * hkv, s_loc, d)
+        return _flash_core_lse(
+            q3, k3, v3, causal_flag, scale, _BLOCK, _BLOCK
+        )
+
+    def step(carry, t):
+        k_t, v_t, o, lse = carry
+        src = (my - t) % w
+        if causal:
+            # 0: skip (src chunk is in the future), 1: diagonal
+            # (causal), 2: full (src chunk is in the past)
+            branch = jnp.where(src > my, 0, jnp.where(src == my, 1, 2))
+            o_t, lse_t = jax.lax.switch(
+                branch,
+                [
+                    # pcast-to-varying: the constant outputs must carry the
+                    # varying-over-sep type as the flash branches
+                    lambda q3, kt, vt: jax.lax.pcast(
+                        (
+                            jnp.zeros((b * h, s_loc, d), q3.dtype),
+                            jnp.full((b * h, s_loc), NEG_INF, jnp.float32),
+                        ),
+                        axis_name, to="varying",
+                    ),
+                    functools.partial(flash, causal_flag=True),
+                    functools.partial(flash, causal_flag=False),
+                ],
+                q3, k_t, v_t,
+            )
+        else:
+            o_t, lse_t = flash(q3, k_t, v_t, causal_flag=False)
+        o, lse = _merge(
+            o, lse, o_t.astype(jnp.float32), lse_t.astype(jnp.float32)
+        )
+        # rotate KV one hop around the ring (ICI neighbor exchange)
+        k_t = jax.lax.ppermute(k_t, axis_name, perm)
+        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        return (k_t, v_t, o, lse), None
+
+    o0, lse0 = jax.lax.pcast(
+        (
+            jnp.zeros((b * h, s_loc, d), jnp.float32),
+            jnp.full((b * h, s_loc), NEG_INF, jnp.float32),
+        ),
+        axis_name, to="varying",
+    )
+    (k, v, o, lse), _ = jax.lax.scan(
+        step, (k, v, o0, lse0), jnp.arange(w)
+    )
+    return o.astype(q.dtype).reshape(b, h, s_loc, d).transpose(0, 2, 1, 3)
+
+
+def _ulysses_attention_local(q, k, v, causal, scale, axis_name, w):
+    """Per-device Ulysses: all_to_all seq<->heads around full attention."""
+    from ....ops.kernels.flash_attention import flash_attention
+
+    def seq_to_heads(x):
+        # (B, S_loc, H, D) -> (B, S, H/w, D)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = flash_attention(q, k, v, causal=causal, sm_scale=scale)
+    # heads -> seq: inverse reshard
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def _cp_dispatch(local_fn, name, q, k, v, causal, scale, group):
+    """Run `local_fn` over the sep axis: directly when already inside a
+    manual region, else via a partial-manual shard_map on the global
+    mesh (other axes stay under GSPMD)."""
+    q, k, v = _as_tensor(q), _as_tensor(k), _as_tensor(v)
+    w = axis_degree("sep")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if w <= 1:
+        from ....ops.kernels.flash_attention import flash_attention as fa
+
+        return apply_op(
+            name + "_serial",
+            lambda qr, kr, vr: fa(
+                qr, kr, vr, causal=causal, sm_scale=scale
+            ),
+            q, k, v,
+        )
+
+    if in_manual_context(("sep",)):
+        fn = functools.partial(
+            local_fn, causal=causal, scale=float(scale),
+            axis_name="sep", w=w,
+        )
+        return apply_op(name, fn, q, k, v)
+
+    mesh = global_mesh()
+    spec = jax.sharding.PartitionSpec(None, "sep", None, None)
+
+    def global_fn(qr, kr, vr):
+        return jax.shard_map(
+            functools.partial(
+                local_fn, causal=causal, scale=float(scale),
+                axis_name="sep", w=w,
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            axis_names={"sep"},
+        )(qr, kr, vr)
+
+    return apply_op(name, global_fn, q, k, v)
+
+
+def ring_flash_attention(q, k, v, causal=True, sm_scale=None, group=None):
+    """Ring attention over the sep axis. q/k/v: [B, S, H, D] with S
+    sharded over sep (global arrays in the GSPMD context, local shards
+    inside manual regions)."""
+    return _cp_dispatch(
+        _ring_attention_local, "ring_flash_attention",
+        q, k, v, causal, sm_scale, group,
+    )
+
+
+def ulysses_flash_attention(q, k, v, causal=True, sm_scale=None,
+                            group=None):
+    """Ulysses (DeepSpeed-style all-to-all) attention over the sep
+    axis. Heads (incl. KV heads) must be divisible by the sep degree."""
+    w = axis_degree("sep")
+    if w > 1 and (q.shape[2] % w or k.shape[2] % w):
+        raise ValueError(
+            f"ulysses needs heads divisible by sep degree {w}; got "
+            f"q heads {q.shape[2]}, kv heads {k.shape[2]} "
+            "(use ring_flash_attention for GQA models with few KV heads)"
+        )
+    return _cp_dispatch(
+        _ulysses_attention_local, "ulysses_flash_attention",
+        q, k, v, causal, sm_scale, group,
+    )
+
+
+def _batch_spec():
+    return "dp" if axis_degree("dp") > 1 else None
+
+
+def scatter_sequence(x, group=None):
+    """Shard the sequence dim (axis 1) over sep (annotation in GSPMD);
+    the batch dim keeps its dp sharding."""
+    from ..layers.mpu.mp_ops import shard_constraint
+
+    x = _as_tensor(x)
+    if axis_degree("sep") <= 1:
+        return x
+    return shard_constraint(
+        x, _batch_spec(), "sep", *([None] * (x.ndim - 2))
+    )
+
+
+def gather_sequence(x, group=None):
+    """Replicate the sequence dim again (inverse of scatter_sequence);
+    only the sequence dim's sharding is released."""
+    from ..layers.mpu.mp_ops import shard_constraint
+
+    x = _as_tensor(x)
+    if axis_degree("sep") <= 1:
+        return x
+    return shard_constraint(
+        x, _batch_spec(), None, *([None] * (x.ndim - 2))
+    )

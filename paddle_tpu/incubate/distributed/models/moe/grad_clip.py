@@ -27,15 +27,9 @@ class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
         super().__init__(clip_norm, group_name)
         self.is_expert_param_func = is_expert_param_func or _is_expert_param
         self.moe_group = moe_group
-
-    def _dygraph_clip(self, params_grads):
-        # split for parity/diagnostics; both sets feed one global norm
-        normal, expert = [], []
-        for p, g in params_grads:
-            (expert if self.is_expert_param_func(p) else normal).append(
-                (p, g)
-            )
-        return super()._dygraph_clip(normal + expert)
+        # no _dygraph_clip override: the base global-norm reduction is
+        # order-insensitive and expert params are global arrays, so the
+        # reference's expert/non-expert split would be dead work here
 
 
 ClipGradForMoEByGlobalNorm = ClipGradForMOEByGlobalNorm

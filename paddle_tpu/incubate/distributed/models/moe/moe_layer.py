@@ -238,7 +238,7 @@ class MoELayer(Layer):
 
             out = apply_op("moe_combine", fc, inp, combine, *outs)
 
-        self.gate.loss = aux if isinstance(aux, Tensor) else aux
+        self.gate.loss = aux
         return out
 
 

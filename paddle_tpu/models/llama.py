@@ -54,6 +54,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     sequence_parallel: bool = False  # Megatron-SP over the mp axis
+    # context parallelism over the sep axis when sep_degree>1:
+    # "ring" (ppermute KV rotation) or "ulysses" (all_to_all head swap)
+    context_parallel: str = "ring"
     recompute: bool = False
     dtype: str = "float32"
 
@@ -196,12 +199,31 @@ class LlamaAttention(Layer):
             return qh, kh, vh
 
         q, k, v = apply_op("llama_qkv_rope", attn, q, k, v, n_outs=3)
+        sep = axis_degree("sep")
         if mp > 1:
-            spec = ("dp", None, "mp", None)
+            seq_ax = "sep" if sep > 1 else None
+            spec = ("dp", seq_ax, "mp", None)
             q = shard_constraint(q, *spec)
             k = shard_constraint(k, *spec)
             v = shard_constraint(v, *spec)
-        out, _ = F.flash_attention(q, k, v, causal=True)
+        if sep > 1:
+            from ..distributed.fleet.utils.context_parallel import (
+                ring_flash_attention,
+                ulysses_flash_attention,
+            )
+
+            if cfg.context_parallel == "ulysses":
+                cp = ulysses_flash_attention
+            elif cfg.context_parallel == "ring":
+                cp = ring_flash_attention
+            else:
+                raise ValueError(
+                    "context_parallel must be 'ring' or 'ulysses', got "
+                    f"{cfg.context_parallel!r}"
+                )
+            out = cp(q, k, v, causal=True)
+        else:
+            out, _ = F.flash_attention(q, k, v, causal=True)
         out = apply_op(
             "merge_heads", lambda o: o.reshape(b, s, nh * hd), out
         )

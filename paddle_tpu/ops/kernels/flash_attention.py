@@ -160,8 +160,13 @@ def _flash_fwd_ref(q, k, v, causal, scale):
     return out.astype(q.dtype), lse
 
 
-def _flash_bwd_chunked(q, k, v, out, lse, do, causal, scale, block_k):
-    """Blocked recompute backward over K blocks (lax.scan)."""
+def _flash_bwd_chunked(q, k, v, out, lse, do, causal, scale, block_k,
+                       dlse=None):
+    """Blocked recompute backward over K blocks (lax.scan).
+
+    ``dlse`` (BH, Sq) is the optional cotangent of the logsumexp output
+    (needed when lse feeds the ring-attention combine): since
+    dlse/ds = softmax(s) = p, it adds ``p * dlse`` to ds."""
     bh, sq, d = q.shape
     bhkv, sk, _ = k.shape
     group = bh // bhkv
@@ -199,7 +204,10 @@ def _flash_bwd_chunked(q, k, v, out, lse, do, causal, scale, block_k):
         p = jnp.exp(s - lse[..., None])
         dv_b = jnp.einsum("bqk,bqd->bkd", p, dof)
         dp = jnp.einsum("bqd,bkd->bqk", dof, v_b)
-        ds = p * (dp - delta[..., None]) * scale
+        ds = p * (dp - delta[..., None])
+        if dlse is not None:
+            ds = ds + p * dlse[..., None]
+        ds = ds * scale
         dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, k_b)
         dk_b = jnp.einsum("bqk,bqd->bkd", ds, qf)
         return dq_acc, (dk_b, dv_b)
@@ -252,6 +260,32 @@ def _flash_core_bwd(causal, scale, block_q, block_k, res, do):
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core_lse(q, k, v, causal, scale, block_q, block_k):
+    """Differentiable (out, lse) pair — the unit ring attention scans:
+    the online-combine consumes both, so lse carries a real cotangent."""
+    return _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k)
+
+
+def _flash_core_lse_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd_dispatch(
+        q, k, v, causal, scale, block_q, block_k
+    )
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_core_lse_bwd(causal, scale, block_q, block_k, res, cts):
+    q, k, v, out, lse = res
+    do, dlse = cts
+    dq, dk, dv = _flash_bwd_chunked(
+        q, k, v, out, lse, do, causal, scale, block_k, dlse=dlse
+    )
+    return dq, dk, dv
+
+
+_flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None,

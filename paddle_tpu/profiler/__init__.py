@@ -169,7 +169,9 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
     in the same tools)."""
 
     def handle(prof):
-        prof._exported_to = dir_name
+        # _exported_to is set by the profiler itself, and only when a
+        # trace was actually collected (not under timer_only)
+        pass
 
     handle._dir = dir_name
     return handle
@@ -249,6 +251,11 @@ class Profiler:
         now_on = new in (
             ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
         )
+        if prev == ProfilerState.RECORD_AND_RETURN and now_on:
+            # cycle boundary between adjacent record windows: close the
+            # current trace (firing on_trace_ready) and open a new one
+            self._transit(prev, ProfilerState.CLOSED)
+            was_on = False
         if not was_on and now_on:
             _start_collecting()
             if not self.timer_only:
