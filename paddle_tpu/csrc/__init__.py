@@ -1,0 +1,168 @@
+"""Native runtime loader — builds libpaddle_tpu_rt.so from runtime.cc
+on first import (cached by source hash) and exposes ctypes bindings.
+
+The reference ships its native runtime prebuilt (paddle/fluid/...);
+here the single-file C++ runtime compiles in ~2s with the baked-in
+g++. Every consumer has a pure-Python fallback, so a missing compiler
+degrades gracefully (`available()` -> False).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "runtime.cc")
+
+_lib = None
+_lib_err = None
+_lock = threading.Lock()
+
+
+def _build_and_load():
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    build_dir = os.path.join(_HERE, "_build")
+    so_path = os.path.join(build_dir, f"libpaddle_tpu_rt_{digest}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(build_dir, exist_ok=True)
+        tmp = so_path + f".tmp{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, so_path)
+    lib = ctypes.CDLL(so_path)
+
+    c = ctypes
+    sigs = {
+        "pt_queue_create": ([c.c_int], c.c_void_p),
+        "pt_queue_destroy": ([c.c_void_p], None),
+        "pt_queue_close": ([c.c_void_p], None),
+        "pt_queue_push": ([c.c_void_p, c.c_uint64, c.c_double], c.c_int),
+        "pt_queue_pop": ([c.c_void_p, c.c_double], c.c_int64),
+        "pt_queue_size": ([c.c_void_p], c.c_int),
+        "pt_store_master_start": ([c.c_int], c.c_void_p),
+        "pt_store_master_port": ([c.c_void_p], c.c_int),
+        "pt_store_master_stop": ([c.c_void_p], None),
+        "pt_store_connect": (
+            [c.c_char_p, c.c_int, c.c_double], c.c_void_p,
+        ),
+        "pt_store_set": (
+            [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int], c.c_int,
+        ),
+        "pt_store_get": (
+            [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int], c.c_int64,
+        ),
+        "pt_store_add": ([c.c_void_p, c.c_char_p, c.c_int64], c.c_int64),
+        "pt_store_check": ([c.c_void_p, c.c_char_p], c.c_int),
+        "pt_store_close": ([c.c_void_p], None),
+        "pt_stat_update": ([c.c_int, c.c_int64], None),
+        "pt_stat_current": ([c.c_int], c.c_int64),
+        "pt_stat_peak": ([c.c_int], c.c_int64),
+        "pt_stat_reset_peak": ([c.c_int], None),
+        "pt_events_record": ([c.c_char_p, c.c_double, c.c_double], None),
+        "pt_events_count": ([], c.c_uint64),
+        "pt_events_snapshot": ([c.c_void_p, c.c_int], c.c_int),
+        "pt_events_clear": ([], None),
+        "pt_now": ([], c.c_double),
+        "pt_runtime_version": ([], c.c_int),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    assert lib.pt_runtime_version() == 1
+    return lib
+
+
+def get_lib():
+    """The loaded native library, or None if build/load failed."""
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _lock:
+        if _lib is None and _lib_err is None:
+            try:
+                _lib = _build_and_load()
+            except Exception as e:  # no compiler / sandboxed fs
+                _lib_err = e
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def load_error():
+    get_lib()
+    return _lib_err
+
+
+class NativeEvent(ctypes.Structure):
+    _fields_ = [
+        ("name", ctypes.c_char * 56),
+        ("t0", ctypes.c_double),
+        ("dur", ctypes.c_double),
+    ]
+
+
+class BlockingQueue:
+    """Native bounded token queue carrying Python payloads: the C++
+    queue synchronizes uint64 tokens; a Python-side table maps tokens
+    to objects (no serialization across the ABI)."""
+
+    def __init__(self, capacity: int):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {_lib_err}")
+        self._lib = lib
+        self._h = lib.pt_queue_create(int(capacity))
+        self._payloads = {}
+        self._next_token = 0
+        self._mu = threading.Lock()
+
+    def put(self, obj, timeout=None):
+        with self._mu:
+            tok = self._next_token
+            self._next_token += 1
+            self._payloads[tok] = obj
+        rc = self._lib.pt_queue_push(
+            self._h, tok, -1.0 if timeout is None else float(timeout)
+        )
+        if rc != 0:
+            with self._mu:
+                self._payloads.pop(tok, None)
+            raise (TimeoutError if rc == -1 else RuntimeError)(
+                f"queue push failed rc={rc}"
+            )
+
+    def get(self, timeout=None):
+        tok = self._lib.pt_queue_pop(
+            self._h, -1.0 if timeout is None else float(timeout)
+        )
+        if tok < 0:
+            raise (TimeoutError if tok == -1 else RuntimeError)(
+                f"queue pop failed rc={tok}"
+            )
+        with self._mu:
+            return self._payloads.pop(tok)
+
+    def qsize(self):
+        return self._lib.pt_queue_size(self._h)
+
+    def close(self):
+        self._lib.pt_queue_close(self._h)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pt_queue_close(self._h)
+                self._lib.pt_queue_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

@@ -1,0 +1,469 @@
+// paddle_tpu native runtime — the C++ components the reference
+// implements natively, rebuilt for the TPU framework's single-process
+// host runtime. C ABI (ctypes-loaded; no pybind11 in this image).
+//
+// Components (upstream analogs):
+//  * BlockingQueue      — paddle/fluid/operators/reader/blocking_queue.h
+//                         (DataLoader batch handoff; tokens index Python
+//                         payloads so no serialization crosses the ABI)
+//  * TCPStore           — paddle/phi/core/distributed/store/tcp_store.cc
+//                         (rank-0 master daemon; set/get/wait/add over
+//                         loopback/DCN TCP for rendezvous + barriers)
+//  * memory stats       — paddle/fluid/memory/stats.h (per-device
+//                         current/peak counters, atomics)
+//  * host event buffer  — paddle/fluid/platform/profiler/host_tracer.cc
+//                         (lock-striped ring of profiler ranges)
+//
+// Build: g++ -O2 -shared -fPIC -pthread runtime.cc -o libpaddle_tpu_rt.so
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#define PT_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// BlockingQueue of uint64 tokens
+// ---------------------------------------------------------------------------
+
+struct Queue {
+  std::mutex mu;
+  std::condition_variable not_empty, not_full;
+  std::deque<uint64_t> items;
+  size_t capacity;
+  bool closed = false;
+};
+
+bool wait_pred(std::unique_lock<std::mutex>& lk, std::condition_variable& cv,
+               double timeout_s, const std::function<bool()>& pred) {
+  if (timeout_s < 0) {
+    cv.wait(lk, pred);
+    return true;
+  }
+  return cv.wait_for(lk, std::chrono::duration<double>(timeout_s), pred);
+}
+
+}  // namespace
+
+PT_API void* pt_queue_create(int capacity) {
+  auto* q = new Queue();
+  q->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 1;
+  return q;
+}
+
+PT_API void pt_queue_destroy(void* h) { delete static_cast<Queue*>(h); }
+
+PT_API void pt_queue_close(void* h) {
+  auto* q = static_cast<Queue*>(h);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->closed = true;
+  }
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+// 0 ok, -1 timeout, -2 closed
+PT_API int pt_queue_push(void* h, uint64_t token, double timeout_s) {
+  auto* q = static_cast<Queue*>(h);
+  std::unique_lock<std::mutex> lk(q->mu);
+  bool ok = wait_pred(lk, q->not_full, timeout_s, [&] {
+    return q->closed || q->items.size() < q->capacity;
+  });
+  if (!ok) return -1;
+  if (q->closed) return -2;
+  q->items.push_back(token);
+  lk.unlock();
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// >= 0 token; -1 timeout; -2 closed-and-drained
+PT_API int64_t pt_queue_pop(void* h, double timeout_s) {
+  auto* q = static_cast<Queue*>(h);
+  std::unique_lock<std::mutex> lk(q->mu);
+  bool ok = wait_pred(lk, q->not_empty, timeout_s,
+                      [&] { return q->closed || !q->items.empty(); });
+  if (!ok) return -1;
+  if (q->items.empty()) return -2;
+  uint64_t t = q->items.front();
+  q->items.pop_front();
+  lk.unlock();
+  q->not_full.notify_one();
+  return static_cast<int64_t>(t);
+}
+
+PT_API int pt_queue_size(void* h) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return static_cast<int>(q->items.size());
+}
+
+// ---------------------------------------------------------------------------
+// TCPStore — master daemon + client
+//
+// Wire format (all little-endian):
+//   request:  1 byte cmd | u32 keylen | key | u32 vallen | val
+//     cmd 'S' set, 'G' get (blocking), 'A' add (val = i64 delta),
+//     'C' check (non-blocking contains)
+//   response: u32 len | payload ('A' -> i64 new value; 'C' -> u8 0/1)
+//     'G' responds only once the key exists (server parks the waiter).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Master {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  std::atomic<bool> stop{false};
+};
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_resp(int fd, const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  if (!write_all(fd, &len, 4)) return false;
+  return payload.empty() || write_all(fd, payload.data(), payload.size());
+}
+
+void serve_conn(Master* m, int fd) {
+  for (;;) {
+    char cmd;
+    uint32_t klen = 0, vlen = 0;
+    if (!read_all(fd, &cmd, 1) || !read_all(fd, &klen, 4)) break;
+    if (klen > (1u << 20)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_all(fd, &key[0], klen)) break;
+    if (!read_all(fd, &vlen, 4)) break;
+    if (vlen > (1u << 30)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_all(fd, &val[0], vlen)) break;
+
+    if (cmd == 'S') {
+      {
+        std::lock_guard<std::mutex> lk(m->mu);
+        m->kv[key] = val;
+      }
+      m->cv.notify_all();
+      if (!send_resp(fd, "")) break;
+    } else if (cmd == 'G') {
+      std::unique_lock<std::mutex> lk(m->mu);
+      m->cv.wait(lk, [&] {
+        return m->stop.load() || m->kv.count(key) > 0;
+      });
+      if (m->stop.load()) break;
+      std::string out = m->kv[key];
+      lk.unlock();
+      if (!send_resp(fd, out)) break;
+    } else if (cmd == 'A') {
+      int64_t delta = 0;
+      std::memcpy(&delta, val.data(), std::min<size_t>(8, val.size()));
+      int64_t updated;
+      {
+        std::lock_guard<std::mutex> lk(m->mu);
+        int64_t cur = 0;
+        auto it = m->kv.find(key);
+        if (it != m->kv.end() && it->second.size() == 8)
+          std::memcpy(&cur, it->second.data(), 8);
+        updated = cur + delta;
+        std::string enc(8, '\0');
+        std::memcpy(&enc[0], &updated, 8);
+        m->kv[key] = enc;
+      }
+      m->cv.notify_all();
+      std::string out(8, '\0');
+      std::memcpy(&out[0], &updated, 8);
+      if (!send_resp(fd, out)) break;
+    } else if (cmd == 'C') {
+      bool has;
+      {
+        std::lock_guard<std::mutex> lk(m->mu);
+        has = m->kv.count(key) > 0;
+      }
+      std::string out(1, has ? '\1' : '\0');
+      if (!send_resp(fd, out)) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+PT_API void* pt_store_master_start(int port) {
+  auto* m = new Master();
+  m->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (m->listen_fd < 0) {
+    delete m;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(m->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(m->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(m->listen_fd, 128) != 0) {
+    ::close(m->listen_fd);
+    delete m;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(m->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  m->port = ntohs(addr.sin_port);
+  m->accept_thread = std::thread([m] {
+    for (;;) {
+      int fd = ::accept(m->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;  // listen_fd closed -> shutdown
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(m->mu);
+      m->conns.emplace_back(serve_conn, m, fd);
+    }
+  });
+  return m;
+}
+
+PT_API int pt_store_master_port(void* h) {
+  return h ? static_cast<Master*>(h)->port : -1;
+}
+
+PT_API void pt_store_master_stop(void* h) {
+  if (!h) return;
+  auto* m = static_cast<Master*>(h);
+  m->stop.store(true);
+  m->cv.notify_all();
+  ::shutdown(m->listen_fd, SHUT_RDWR);
+  ::close(m->listen_fd);
+  if (m->accept_thread.joinable()) m->accept_thread.join();
+  for (auto& t : m->conns)
+    if (t.joinable()) t.detach();  // blocked conns exit as clients close
+  delete m;
+}
+
+namespace {
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request in flight per client
+};
+}  // namespace
+
+PT_API void* pt_store_connect(const char* host, int port,
+                              double timeout_s) {
+  double deadline = now_s() + (timeout_s < 0 ? 3600.0 : timeout_s);
+  for (;;) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+        0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (now_s() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+namespace {
+bool request(Client* c, char cmd, const std::string& key,
+             const std::string& val, std::string* resp) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t klen = static_cast<uint32_t>(key.size());
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  if (!write_all(c->fd, &cmd, 1) || !write_all(c->fd, &klen, 4) ||
+      (klen && !write_all(c->fd, key.data(), klen)) ||
+      !write_all(c->fd, &vlen, 4) ||
+      (vlen && !write_all(c->fd, val.data(), vlen)))
+    return false;
+  uint32_t rlen = 0;
+  if (!read_all(c->fd, &rlen, 4)) return false;
+  resp->assign(rlen, '\0');
+  return rlen == 0 || read_all(c->fd, &(*resp)[0], rlen);
+}
+}  // namespace
+
+PT_API int pt_store_set(void* h, const char* key, const char* val,
+                        int len) {
+  std::string resp;
+  return request(static_cast<Client*>(h), 'S', key,
+                 std::string(val, static_cast<size_t>(len)), &resp)
+             ? 0
+             : -1;
+}
+
+// blocking get; returns value length (copied into buf up to buflen),
+// -1 on connection error, -3 if buf too small (len still returned via
+// full resp semantics: call again with bigger buf after a 'C' probe).
+PT_API int64_t pt_store_get(void* h, const char* key, char* buf,
+                            int buflen) {
+  std::string resp;
+  if (!request(static_cast<Client*>(h), 'G', key, "", &resp)) return -1;
+  int64_t n = static_cast<int64_t>(resp.size());
+  if (n > buflen) return -3 - n;  // encodes needed size
+  std::memcpy(buf, resp.data(), resp.size());
+  return n;
+}
+
+PT_API int64_t pt_store_add(void* h, const char* key, int64_t delta) {
+  std::string enc(8, '\0');
+  std::memcpy(&enc[0], &delta, 8);
+  std::string resp;
+  if (!request(static_cast<Client*>(h), 'A', key, enc, &resp) ||
+      resp.size() != 8)
+    return INT64_MIN;
+  int64_t out;
+  std::memcpy(&out, resp.data(), 8);
+  return out;
+}
+
+PT_API int pt_store_check(void* h, const char* key) {
+  std::string resp;
+  if (!request(static_cast<Client*>(h), 'C', key, "", &resp) ||
+      resp.size() != 1)
+    return -1;
+  return resp[0] ? 1 : 0;
+}
+
+PT_API void pt_store_close(void* h) {
+  if (!h) return;
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+// ---------------------------------------------------------------------------
+// Memory stats (per logical device id, 0..63)
+// ---------------------------------------------------------------------------
+
+namespace {
+constexpr int kMaxDev = 64;
+std::atomic<int64_t> g_cur[kMaxDev];
+std::atomic<int64_t> g_peak[kMaxDev];
+}  // namespace
+
+PT_API void pt_stat_update(int dev, int64_t delta) {
+  if (dev < 0 || dev >= kMaxDev) return;
+  int64_t cur = g_cur[dev].fetch_add(delta) + delta;
+  int64_t peak = g_peak[dev].load();
+  while (cur > peak && !g_peak[dev].compare_exchange_weak(peak, cur)) {
+  }
+}
+
+PT_API int64_t pt_stat_current(int dev) {
+  return (dev < 0 || dev >= kMaxDev) ? 0 : g_cur[dev].load();
+}
+
+PT_API int64_t pt_stat_peak(int dev) {
+  return (dev < 0 || dev >= kMaxDev) ? 0 : g_peak[dev].load();
+}
+
+PT_API void pt_stat_reset_peak(int dev) {
+  if (dev >= 0 && dev < kMaxDev) g_peak[dev].store(g_cur[dev].load());
+}
+
+// ---------------------------------------------------------------------------
+// Host event ring (profiler RecordEvent backing store)
+// ---------------------------------------------------------------------------
+
+namespace {
+struct Event {
+  char name[56];
+  double t0;
+  double dur;
+};
+constexpr size_t kRing = 1 << 16;
+Event g_events[kRing];
+std::atomic<uint64_t> g_event_head{0};
+}  // namespace
+
+PT_API void pt_events_record(const char* name, double t0, double dur) {
+  uint64_t i = g_event_head.fetch_add(1) % kRing;
+  Event& e = g_events[i];
+  std::strncpy(e.name, name, sizeof(e.name) - 1);
+  e.name[sizeof(e.name) - 1] = '\0';
+  e.t0 = t0;
+  e.dur = dur;
+}
+
+PT_API uint64_t pt_events_count() { return g_event_head.load(); }
+
+// copies up to max_n most recent events into out (array of Event),
+// returns count copied
+PT_API int pt_events_snapshot(void* out, int max_n) {
+  uint64_t head = g_event_head.load();
+  uint64_t n = head < kRing ? head : kRing;
+  if (static_cast<uint64_t>(max_n) < n) n = static_cast<uint64_t>(max_n);
+  auto* dst = static_cast<Event*>(out);
+  for (uint64_t j = 0; j < n; ++j) {
+    dst[j] = g_events[(head - n + j) % kRing];
+  }
+  return static_cast<int>(n);
+}
+
+PT_API void pt_events_clear() { g_event_head.store(0); }
+
+PT_API double pt_now() { return now_s(); }
+
+PT_API int pt_runtime_version() { return 1; }

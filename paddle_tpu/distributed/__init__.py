@@ -33,5 +33,44 @@ from .collective import (  # noqa
     send,
 )
 from .parallel import DataParallel, init_parallel_env  # noqa
+from .store import TCPStore  # noqa
 from . import fleet  # noqa
 from . import sharding  # noqa
+from . import utils  # noqa
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn analog (upstream: python/paddle/
+    distributed/spawn.py). On TPU one process drives all local chips,
+    so nprocs>1 is only for CPU-mesh simulation: each child gets the
+    PADDLE_TRAINER_ID/TRAINERS_NUM env of a launch worker."""
+    import multiprocessing as mp
+    import os
+
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+        }
+        p = ctx.Process(
+            target=_spawn_entry, args=(func, rank, args, env),
+            daemon=daemon,
+        )
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode != 0]
+        if bad:
+            raise RuntimeError(f"spawned process failed: exit {bad[0]}")
+    return procs
+
+
+def _spawn_entry(func, rank, args, env):
+    import os
+
+    os.environ.update(env)
+    func(*args)

@@ -1,0 +1,119 @@
+"""Elastic training manager (upstream: python/paddle/distributed/fleet/
+elastic/manager.py — etcd-registered workers, membership watch, rank
+recompute + relaunch on scale events).
+
+TPU-native deviation: membership lives in the job's TCPStore (the
+rendezvous daemon the launcher already runs) instead of etcd — workers
+heartbeat a store key; the watcher flags peers whose beat goes stale
+and the launch controller re-rendezvouses with a bumped generation
+(PADDLE_RESTART_GENERATION). On Cloud TPU the platform-level analog is
+the preemption notice; checkpoints carry state across restarts
+(paddle.save/load — SURVEY.md §5 failure recovery)."""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 101
+ELASTIC_TIMEOUT = 60
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store, rank: int = None, np: int = None,
+                 heartbeat_interval: float = 2.0,
+                 stale_after: float = 10.0, job_id: str = None):
+        self.store = store
+        self.rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", 0)
+        )
+        self.np = np if np is not None else int(
+            os.environ.get("PADDLE_TRAINERS_NUM", 1)
+        )
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
+        self.heartbeat_interval = heartbeat_interval
+        self.stale_after = stale_after
+        self._stop = threading.Event()
+        self._thread = None
+        self.enabled = store is not None
+
+    def _key(self, what, rank=None):
+        r = self.rank if rank is None else rank
+        return f"elastic/{self.job_id}/{what}/{r}"
+
+    # -- registration + heartbeat -----------------------------------------
+    def start(self):
+        if not self.enabled:
+            return self
+        self.store.set(self._key("alive"), "1")
+        self.store.add(f"elastic/{self.job_id}/np", 1)
+        self._beat()
+        self._thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _beat(self):
+        self.store.set(self._key("beat"), repr(time.time()))
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._beat()
+            except Exception:
+                return
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.heartbeat_interval * 2)
+        if self.enabled:
+            try:
+                self.store.set(self._key("alive"), "0")
+            except Exception:
+                pass
+
+    # -- membership watch --------------------------------------------------
+    def dead_members(self):
+        """Ranks whose heartbeat is stale, that deregistered, or that
+        never registered (store.get would block forever on a missing
+        key, so existence is probed with the non-blocking check)."""
+        now = time.time()
+        dead = []
+        for r in range(self.np):
+            try:
+                if not self.store.check(self._key("alive", r)):
+                    dead.append(r)
+                    continue
+                if self.store.get(self._key("alive", r)) == "0":
+                    dead.append(r)
+                    continue
+                beat = float(self.store.get(self._key("beat", r)))
+                if now - beat > self.stale_after:
+                    dead.append(r)
+            except Exception:
+                dead.append(r)
+        return dead
+
+    def watch(self) -> str:
+        """One membership check (the reference's watch loop body)."""
+        if not self.enabled:
+            return ElasticStatus.COMPLETED
+        if self.dead_members():
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def exit(self, completed=True):
+        self.stop()
+        return (
+            ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR
+        )

@@ -1,0 +1,273 @@
+"""`python -m paddle_tpu.distributed.launch` — multi-host job launcher
+(upstream: python/paddle/distributed/launch/ — Context/Job/Pod model,
+CollectiveController spawning one proc per GPU, HTTP/etcd master,
+watch loop with elastic restart).
+
+TPU-native model: ONE worker process per host (SPMD inside — jax owns
+every local chip), so a "pod" is the host's single worker plus this
+controller. Multi-host rendezvous runs over the native TCPStore
+(csrc/runtime.cc): nodes take ranks from an atomic counter, publish
+endpoints, barrier, then spawn workers with both the reference's
+PADDLE_* envs and jax.distributed coordination envs. The watch loop
+restarts failed workers up to --max_restart times (elastic), with a
+fresh rendezvous generation each restart.
+
+`--nproc_per_node > 1` exists for CPU-mesh simulation of multi-host
+jobs on one machine (tests; SURVEY.md §4's loopback-NCCL analog).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a (multi-host) training job",
+    )
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="ip:port of the rendezvous store (rank-0 hosts)")
+    p.add_argument("--nnodes", default="1",
+                   help="number of nodes, or min:max for elastic")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", -1)),
+                   help="node rank (-1: assigned by the store)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="workers per node (1 on real TPU hosts; >1 only "
+                        "for single-machine CPU-mesh simulation)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_level", type=int, default=-1,
+                   help=">=1 enables restart-on-failure")
+    p.add_argument("--devices", default=None,
+                   help="accepted for reference-CLI parity (jax owns "
+                        "all local devices)")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _min_nodes(nnodes: str) -> int:
+    return int(str(nnodes).split(":")[0])
+
+
+class NodeController:
+    """One per host: rendezvous, spawn local worker(s), watch."""
+
+    def __init__(self, args):
+        self.args = args
+        self.nnodes = _min_nodes(args.nnodes)
+        self.procs = []
+        self.store = None
+        self.node_rank = args.rank
+        self.generation = 0
+
+    # -- rendezvous --------------------------------------------------------
+    def rendezvous(self):
+        from ..store import TCPStore
+
+        args = self.args
+        if self.nnodes <= 1 and not args.master:
+            self.node_rank = 0
+            self.endpoints = ["127.0.0.1"]
+            return
+        host, port = args.master.split(":")
+        is_master = False
+        # host the store only on the machine --master names (binding is
+        # local, so an address-blind attempt would split-brain real
+        # multi-host jobs: every node would talk to its own store)
+        if _is_local_host(host) and self.node_rank in (-1, 0):
+            # losing the bind race to another local controller -> client
+            try:
+                self.store = TCPStore(
+                    host, int(port), is_master=True,
+                    world_size=self.nnodes,
+                )
+                is_master = True
+            except OSError:
+                pass
+        if self.store is None:
+            self.store = TCPStore(
+                host, int(port), world_size=self.nnodes
+            )
+        gen = f"gen{self.generation}"
+        if self.node_rank < 0:
+            self.node_rank = int(
+                self.store.add(f"{gen}/rank_counter", 1)
+            ) - 1
+        elif is_master:
+            self.store.add(f"{gen}/rank_counter", 1)
+        my_host = socket.gethostbyname(socket.gethostname())
+        self.store.set(f"{gen}/endpoint/{self.node_rank}", my_host)
+        self.store.barrier(f"{gen}/nodes", timeout=600)
+        self.endpoints = [
+            self.store.get(f"{gen}/endpoint/{i}")
+            for i in range(self.nnodes)
+        ]
+
+    # -- spawn -------------------------------------------------------------
+    def _worker_env(self, local_rank: int):
+        args = self.args
+        nper = args.nproc_per_node
+        world = self.nnodes * nper
+        global_rank = self.node_rank * nper + local_rank
+        coord = (
+            f"{self.endpoints[0]}:{_coord_port(args)}"
+            if args.master else "127.0.0.1"
+        )
+        env = dict(os.environ)
+        # workers must find the framework even when it is not installed
+        # (python <script> puts the script's dir on sys.path, not ours)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        env.update({
+            "PADDLE_TRAINER_ID": str(global_rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_CURRENT_ENDPOINT":
+                f"{self.endpoints[self.node_rank]}:{6070 + local_rank}",
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(
+                f"{ep}:{6070 + l}" for ep in self.endpoints
+                for l in range(nper)
+            ),
+            "PADDLE_NODE_RANK": str(self.node_rank),
+            "PADDLE_JOB_ID": args.job_id,
+            "PADDLE_RESTART_GENERATION": str(self.generation),
+        })
+        if args.master:
+            env["PADDLE_MASTER"] = args.master
+        if world > 1 and self.nnodes > 1:
+            # real multi-host: hand jax.distributed its coordination envs
+            env.update({
+                "JAX_COORDINATOR_ADDRESS": coord,
+                "JAX_NUM_PROCESSES": str(world),
+                "JAX_PROCESS_ID": str(global_rank),
+            })
+        return env
+
+    def spawn(self):
+        args = self.args
+        os.makedirs(args.log_dir, exist_ok=True)
+        self.procs = []
+        for local_rank in range(args.nproc_per_node):
+            global_rank = self.node_rank * args.nproc_per_node + local_rank
+            log_path = os.path.join(
+                args.log_dir, f"workerlog.{global_rank}"
+            )
+            logf = open(log_path, "ab")
+            cmd = [sys.executable, args.training_script,
+                   *args.training_script_args]
+            proc = subprocess.Popen(
+                cmd, env=self._worker_env(local_rank),
+                stdout=logf, stderr=subprocess.STDOUT,
+            )
+            self.procs.append((proc, logf, log_path))
+
+    # -- watch -------------------------------------------------------------
+    def watch(self) -> int:
+        """Poll workers; returns the job's exit code."""
+        while True:
+            alive = 0
+            for proc, _, log_path in self.procs:
+                rc = proc.poll()
+                if rc is None:
+                    alive += 1
+                elif rc != 0:
+                    sys.stderr.write(
+                        f"worker {proc.pid} exited rc={rc}; "
+                        f"log: {log_path}\n"
+                    )
+                    return rc
+            if alive == 0:
+                return 0
+            time.sleep(0.2)
+
+    def terminate(self):
+        for proc, logf, _ in self.procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for proc, logf, _ in self.procs:
+            try:
+                proc.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            logf.close()
+
+    # -- run ---------------------------------------------------------------
+    def run(self) -> int:
+        args = self.args
+        restarts = 0
+        elastic = args.elastic_level >= 1
+        while True:
+            self.rendezvous()
+            self.spawn()
+            rc = self.watch()
+            self.terminate()
+            if rc == 0:
+                return 0
+            restarts += 1
+            if not elastic or restarts > args.max_restart:
+                return rc
+            sys.stderr.write(
+                f"elastic restart {restarts}/{args.max_restart} "
+                f"(generation {self.generation + 1})\n"
+            )
+            self.generation += 1
+            self.node_rank = args.rank  # re-assign on re-rendezvous
+            time.sleep(1.0)
+
+
+def _is_local_host(host: str) -> bool:
+    if host in ("127.0.0.1", "localhost", "0.0.0.0", ""):
+        return True
+    try:
+        target = socket.gethostbyname(host)
+    except OSError:
+        return False
+    if target.startswith("127."):
+        return True
+    try:
+        local = socket.gethostbyname_ex(socket.gethostname())[2]
+    except OSError:
+        local = []
+    return target in local
+
+
+def _coord_port(args) -> int:
+    return int(args.master.split(":")[1]) + 1 if args.master else 6175
+
+
+def launch(argv=None) -> int:
+    args = parse_args(argv)
+    ctl = NodeController(args)
+    try:
+        return ctl.run()
+    except KeyboardInterrupt:
+        ctl.terminate()
+        return 130
+    finally:
+        if ctl.store is not None:
+            ctl.store.stop()
+
+
+def main():
+    sys.exit(launch())

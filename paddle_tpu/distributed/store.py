@@ -1,0 +1,313 @@
+"""TCPStore — rendezvous key-value store
+(upstream: paddle/phi/core/distributed/store/tcp_store.cc — rank-0
+hosts a MasterDaemon; clients set/get/wait/add over raw TCP).
+
+The native C++ daemon/client live in paddle_tpu/csrc/runtime.cc (the
+perf path and multi-host path); a pure-Python socketserver fallback
+covers compiler-less environments. On TPU pods the heavy rendezvous
+(device mesh boot) is jax.distributed's coordination service — this
+store carries the framework-level keys the reference exchanges (init
+barriers, elastic membership, user KV).
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Optional
+
+
+class _PyMaster:
+    """Pure-Python master daemon speaking the native wire format."""
+
+    def __init__(self, port: int):
+        kv, cond = {}, threading.Condition()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+                try:
+                    while True:
+                        head = self._read(sock, 5)
+                        cmd = head[:1]
+                        (klen,) = struct.unpack("<I", head[1:5])
+                        key = self._read(sock, klen).decode()
+                        (vlen,) = struct.unpack(
+                            "<I", self._read(sock, 4)
+                        )
+                        val = self._read(sock, vlen)
+                        if cmd == b"S":
+                            with cond:
+                                kv[key] = val
+                                cond.notify_all()
+                            self._resp(sock, b"")
+                        elif cmd == b"G":
+                            with cond:
+                                cond.wait_for(lambda: key in kv)
+                                out = kv[key]
+                            self._resp(sock, out)
+                        elif cmd == b"A":
+                            (delta,) = struct.unpack("<q", val[:8])
+                            with cond:
+                                cur = struct.unpack(
+                                    "<q", kv.get(key, b"\0" * 8)
+                                )[0]
+                                new = cur + delta
+                                kv[key] = struct.pack("<q", new)
+                                cond.notify_all()
+                            self._resp(sock, struct.pack("<q", new))
+                        elif cmd == b"C":
+                            with cond:
+                                has = key in kv
+                            self._resp(sock, b"\1" if has else b"\0")
+                        else:
+                            return
+                except (ConnectionError, OSError, EOFError):
+                    return
+
+            @staticmethod
+            def _read(sock, n):
+                buf = b""
+                while len(buf) < n:
+                    chunk = sock.recv(n - len(buf))
+                    if not chunk:
+                        raise EOFError
+                    buf += chunk
+                return buf
+
+            @staticmethod
+            def _resp(sock, payload):
+                sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server(("0.0.0.0", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class _PyClient:
+    def __init__(self, host, port, timeout):
+        deadline = time.time() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (host, port), timeout=5
+                )
+                self._sock.settimeout(None)
+                self._sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.05)
+        self._mu = threading.Lock()
+
+    def _request(self, cmd, key, val=b""):
+        kb = key.encode()
+        msg = cmd + struct.pack("<I", len(kb)) + kb + struct.pack(
+            "<I", len(val)
+        ) + val
+        with self._mu:
+            self._sock.sendall(msg)
+            (rlen,) = struct.unpack("<I", self._recv(4))
+            return self._recv(rlen)
+
+    def _recv(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("store connection closed")
+            buf += chunk
+        return buf
+
+    def set(self, key, val):
+        self._request(b"S", key, val)
+
+    def get(self, key):
+        return self._request(b"G", key)
+
+    def add(self, key, delta):
+        return struct.unpack(
+            "<q", self._request(b"A", key, struct.pack("<q", delta))
+        )[0]
+
+    def check(self, key):
+        return self._request(b"C", key) == b"\1"
+
+    def close(self):
+        self._sock.close()
+
+
+class _NativeClient:
+    def __init__(self, lib, host, port, timeout):
+        self._lib = lib
+        self._h = lib.pt_store_connect(
+            host.encode(), int(port), float(timeout)
+        )
+        if not self._h:
+            raise ConnectionError(f"cannot reach TCPStore {host}:{port}")
+
+    def set(self, key, val):
+        if self._lib.pt_store_set(self._h, key.encode(), val, len(val)):
+            raise ConnectionError("store set failed")
+
+    def get(self, key):
+        import ctypes
+
+        size = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            n = self._lib.pt_store_get(self._h, key.encode(), buf, size)
+            if n >= 0:
+                return buf.raw[:n]
+            if n <= -3:
+                size = -(n + 3) + 16
+                continue
+            raise ConnectionError("store get failed")
+
+    def add(self, key, delta):
+        out = self._lib.pt_store_add(self._h, key.encode(), int(delta))
+        if out == -(2**63):
+            raise ConnectionError("store add failed")
+        return out
+
+    def check(self, key):
+        rc = self._lib.pt_store_check(self._h, key.encode())
+        if rc < 0:
+            raise ConnectionError("store check failed")
+        return bool(rc)
+
+    def close(self):
+        self._lib.pt_store_close(self._h)
+        self._h = None
+
+
+class TCPStore:
+    """paddle.distributed TCPStore-parity API. The master rank also
+    hosts the daemon (native when the csrc runtime built, else the
+    Python server)."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        from .. import csrc
+
+        self.host = host
+        self.is_master = is_master
+        self.world_size = world_size
+        self._master = None
+        lib = csrc.get_lib()
+        if is_master:
+            if lib is not None:
+                self._master = lib.pt_store_master_start(int(port))
+                if self._master:
+                    self._master_native = True
+                    port = lib.pt_store_master_port(self._master)
+                else:
+                    lib = None  # bind failed; fall through to python
+            if self._master is None:
+                self._py_master = _PyMaster(port)
+                self._master_native = False
+                port = self._py_master.port
+        self.port = port
+        connect_host = "127.0.0.1" if is_master else host
+        if lib is not None:
+            self._client = _NativeClient(lib, connect_host, port, timeout)
+        else:
+            self._client = _PyClient(connect_host, port, timeout)
+
+    # -- KV API (bytes | picklable values) --------------------------------
+    @staticmethod
+    def _enc(value) -> bytes:
+        if isinstance(value, bytes):
+            return b"B" + value
+        if isinstance(value, str):
+            return b"S" + value.encode()
+        return b"P" + pickle.dumps(value)
+
+    @staticmethod
+    def _dec(raw: bytes):
+        tag, body = raw[:1], raw[1:]
+        if tag == b"B":
+            return body
+        if tag == b"S":
+            return body.decode()
+        return pickle.loads(body)
+
+    def set(self, key: str, value):
+        self._client.set(key, self._enc(value))
+
+    def get(self, key: str):
+        return self._dec(self._client.get(key))
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return self._client.add(key, amount)
+
+    def check(self, key: str) -> bool:
+        """Non-blocking: does the key exist?"""
+        return self._client.check(key)
+
+    def wait(self, keys, timeout: Optional[float] = None):
+        if isinstance(keys, str):
+            keys = [keys]
+        deadline = None if timeout is None else time.time() + timeout
+        for key in keys:
+            while not self._client.check(key):
+                if deadline is not None and time.time() >= deadline:
+                    raise TimeoutError(f"wait({key!r}) timed out")
+                time.sleep(0.02)
+
+    def barrier(self, name: str = "barrier", timeout: float = 300.0):
+        """All world_size participants arrive, then proceed. Reusable:
+        each use of a name is a new round (every participant must call
+        the same name the same number of times)."""
+        if not hasattr(self, "_barrier_rounds"):
+            self._barrier_rounds = {}
+        rnd = self._barrier_rounds.get(name, 0)
+        self._barrier_rounds[name] = rnd + 1
+        tag = f"__{name}_r{rnd}"
+        n = self.add(f"{tag}_in", 1)
+        if n == self.world_size:
+            self._client.set(f"{tag}_done", self._enc(b"1"))
+        self.wait([f"{tag}_done"], timeout=timeout)
+
+    def stop(self):
+        try:
+            if getattr(self, "_client", None) is not None:
+                self._client.close()
+                self._client = None
+            if getattr(self, "_master", None) is not None and getattr(
+                self, "_master_native", False
+            ):
+                from .. import csrc
+
+                lib = csrc.get_lib()
+                if lib is not None:
+                    lib.pt_store_master_stop(self._master)
+                self._master = None
+            elif getattr(self, "_py_master", None) is not None:
+                self._py_master.stop()
+                self._py_master = None
+        except Exception:
+            pass
+
+    __del__ = stop

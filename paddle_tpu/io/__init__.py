@@ -263,6 +263,16 @@ def default_collate_fn(batch):
     return _to_device(_np_collate(batch))
 
 
+def _make_queue(maxsize):
+    """Native C++ blocking queue (csrc/runtime.cc — the analog of the
+    reference's reader BlockingQueue) with queue.Queue fallback."""
+    from .. import csrc
+
+    if csrc.available():
+        return csrc.BlockingQueue(maxsize)
+    return queue.Queue(maxsize=maxsize)
+
+
 class _LoaderIter:
     def __init__(self, loader):
         # Force PJRT backend init BEFORE spawning threads: client creation
@@ -272,8 +282,8 @@ class _LoaderIter:
         jax.devices()
         self.loader = loader
         self.batch_iter = iter(loader.batch_sampler)
-        self.queue = queue.Queue(
-            maxsize=max(2, loader.prefetch_factor * max(loader.num_workers, 1))
+        self.queue = _make_queue(
+            max(2, loader.prefetch_factor * max(loader.num_workers, 1))
         )
         self._stop = threading.Event()
         self._threads = []

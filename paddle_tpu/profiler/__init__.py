@@ -72,10 +72,55 @@ class SummaryView(enum.IntEnum):
 
 
 # -- host event collection ---------------------------------------------------
+# Backing store is the native lock-free ring in csrc/runtime.cc (the
+# HostTracer analog) when built; Python list fallback otherwise.
 
 _events_lock = threading.Lock()
 _events = []  # (name, start_s, dur_s)
 _collecting = False
+
+
+def _native_lib():
+    from .. import csrc
+
+    return csrc.get_lib()
+
+
+def _record_event(name, t0, dur):
+    lib = _native_lib()
+    if lib is not None:
+        lib.pt_events_record(name.encode()[:55], t0, dur)
+    else:
+        with _events_lock:
+            _events.append((name, t0, dur))
+
+
+def _drain_events():
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes
+
+        from ..csrc import NativeEvent
+
+        n = min(int(lib.pt_events_count()), 1 << 16)
+        buf = (NativeEvent * max(n, 1))()
+        got = lib.pt_events_snapshot(
+            ctypes.cast(buf, ctypes.c_void_p), max(n, 1)
+        )
+        return [
+            (buf[i].name.decode(errors="replace"), buf[i].t0, buf[i].dur)
+            for i in range(got)
+        ]
+    with _events_lock:
+        return list(_events)
+
+
+def _clear_events():
+    lib = _native_lib()
+    if lib is not None:
+        lib.pt_events_clear()
+    with _events_lock:
+        _events.clear()
 
 
 class RecordEvent:
@@ -101,8 +146,7 @@ class RecordEvent:
         self._ann.__exit__(None, None, None)
         self._ann = None
         if _collecting:
-            with _events_lock:
-                _events.append((self.name, self._t0, dur))
+            _record_event(self.name, self._t0, dur)
 
     def __enter__(self):
         self.begin()
@@ -115,8 +159,7 @@ class RecordEvent:
 
 def _start_collecting():
     global _collecting
-    with _events_lock:
-        _events.clear()
+    _clear_events()
     _collecting = True
 
 
@@ -290,8 +333,7 @@ class Profiler:
         """Print an operator-level stats table from the host events
         (upstream: profiler_statistic.py summary tables)."""
         unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
-        with _events_lock:
-            ev = list(_events)
+        ev = _drain_events()
         stats = defaultdict(lambda: [0, 0.0, 0.0])  # name -> [n, tot, mx]
         for name, _, dur in ev:
             s = stats[name]

@@ -1,0 +1,192 @@
+"""Launch CLI + elastic tests (upstream model: test/collective/fleet
+drivers shell out to paddle.distributed.launch and check exit codes +
+worker logs; elastic unit tests drive ElasticManager directly)."""
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+import paddle_tpu  # noqa: F401  (conftest sets the CPU platform)
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager,
+    ElasticStatus,
+)
+from paddle_tpu.distributed.launch.main import parse_args
+from paddle_tpu.distributed.store import TCPStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(tmp_path, script_body, extra_args=(), env_extra=None):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "log"), *extra_args, str(script)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+
+
+class TestParseArgs:
+    def test_defaults(self):
+        a = parse_args(["train.py", "--lr", "0.1"])
+        assert a.training_script == "train.py"
+        assert a.training_script_args == ["--lr", "0.1"]
+        assert a.nproc_per_node == 1
+
+    def test_elastic_nnodes_range(self):
+        a = parse_args(["--nnodes", "2:4", "t.py"])
+        from paddle_tpu.distributed.launch.main import _min_nodes
+
+        assert _min_nodes(a.nnodes) == 2
+
+
+class TestLaunchSingleNode:
+    def test_two_workers_get_ranks(self, tmp_path):
+        body = """
+            import os
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            n = os.environ["PADDLE_TRAINERS_NUM"]
+            print(f"worker rank={rank} of {n}", flush=True)
+        """
+        r = _run_launch(
+            tmp_path, body, ["--nproc_per_node", "2"],
+        )
+        assert r.returncode == 0, r.stderr
+        logs = sorted(os.listdir(tmp_path / "log"))
+        assert logs == ["workerlog.0", "workerlog.1"]
+        l0 = (tmp_path / "log" / "workerlog.0").read_text()
+        l1 = (tmp_path / "log" / "workerlog.1").read_text()
+        assert "rank=0 of 2" in l0
+        assert "rank=1 of 2" in l1
+
+    def test_failure_propagates_exit_code(self, tmp_path):
+        r = _run_launch(
+            tmp_path, "import sys; sys.exit(3)",
+            ["--max_restart", "0"],
+        )
+        assert r.returncode == 3
+
+    def test_elastic_restart_recovers(self, tmp_path):
+        # first generation crashes, second succeeds (marker file)
+        marker = tmp_path / "ran_once"
+        body = f"""
+            import os, sys
+            marker = {str(marker)!r}
+            if not os.path.exists(marker):
+                open(marker, "w").write("x")
+                sys.exit(1)
+            print("recovered generation",
+                  os.environ["PADDLE_RESTART_GENERATION"], flush=True)
+        """
+        r = _run_launch(
+            tmp_path, body, ["--elastic_level", "1", "--max_restart", "2"],
+        )
+        assert r.returncode == 0, r.stderr
+        assert "elastic restart 1/2" in r.stderr
+        log = (tmp_path / "log" / "workerlog.0").read_text()
+        assert "recovered generation 1" in log
+
+
+class TestElasticManager:
+    def test_heartbeat_and_watch(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+        client = TCPStore("127.0.0.1", master.port, world_size=2)
+        try:
+            m0 = ElasticManager(
+                master, rank=0, np=2,
+                heartbeat_interval=0.1, stale_after=1.0,
+            ).start()
+            m1 = ElasticManager(
+                client, rank=1, np=2,
+                heartbeat_interval=0.1, stale_after=1.0,
+            ).start()
+            time.sleep(0.3)
+            assert m0.watch() == ElasticStatus.HOLD
+            assert m0.dead_members() == []
+            # rank-1 dies: heartbeat stops, alive flag drops
+            m1.stop()
+            assert m0.dead_members() == [1]
+            assert m0.watch() == ElasticStatus.RESTART
+            m0.stop()
+        finally:
+            client.stop()
+            master.stop()
+
+
+class TestStoreSemantics:
+    def test_barrier_is_reusable(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+        client = TCPStore("127.0.0.1", master.port, world_size=2)
+        try:
+            for _ in range(2):
+                t = threading.Thread(target=lambda: client.barrier("x"))
+                t.start()
+                master.barrier("x")
+                t.join(5)
+                assert not t.is_alive()
+            # desync check: one-sided second call must NOT pass
+            with pytest.raises(TimeoutError):
+                tag_only_master = threading.Thread(
+                    target=lambda: master.barrier("y", timeout=0.3)
+                )
+                tag_only_master.start()
+                tag_only_master.join(5)
+                raise TimeoutError  # barrier alone must have timed out
+        finally:
+            client.stop()
+            master.stop()
+
+    def test_dead_members_handles_never_registered(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+        try:
+            m0 = ElasticManager(
+                master, rank=0, np=2,
+                heartbeat_interval=0.1, stale_after=1.0,
+            ).start()
+            # rank 1 never registered: must be reported dead promptly,
+            # not block forever on store.get
+            t0 = time.time()
+            dead = m0.dead_members()
+            assert dead == [1]
+            assert time.time() - t0 < 2
+            m0.stop()
+        finally:
+            master.stop()
+
+
+class TestSpawn:
+    def test_spawn_sets_rank_env(self, tmp_path):
+        # run via subprocess to avoid forking the jax-initialized test proc
+        script = tmp_path / "spawn_main.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+
+            def work(out_dir):
+                rank = os.environ["PADDLE_TRAINER_ID"]
+                open(os.path.join(out_dir, f"r{rank}"), "w").write(rank)
+
+            if __name__ == "__main__":
+                import sys
+                import paddle_tpu.distributed as dist
+                dist.spawn(work, args=(sys.argv[1],), nprocs=2)
+        """))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(
+            [sys.executable, str(script), str(tmp_path)],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=300,
+        )
+        assert r.returncode == 0, r.stderr
+        assert (tmp_path / "r0").exists() and (tmp_path / "r1").exists()

@@ -1,0 +1,169 @@
+"""Native C++ runtime tests (csrc/runtime.cc): blocking queue, TCPStore
+wire protocol (native daemon + python fallback client interop), memory
+stats, host event ring. Upstream analogs: reader blocking_queue.h,
+tcp_store.cc, memory/stats.h, host_tracer.cc."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import csrc
+from paddle_tpu.distributed.store import TCPStore, _PyClient
+
+native = pytest.mark.skipif(
+    not csrc.available(), reason="native runtime not built"
+)
+
+
+@native
+class TestBlockingQueue:
+    def test_fifo_and_payload_identity(self):
+        q = csrc.BlockingQueue(8)
+        objs = [{"i": i} for i in range(5)]
+        for o in objs:
+            q.put(o)
+        got = [q.get() for _ in range(5)]
+        assert got == objs
+        assert got[0] is objs[0]
+
+    def test_capacity_blocks_and_timeout(self):
+        q = csrc.BlockingQueue(1)
+        q.put(1)
+        with pytest.raises(TimeoutError):
+            q.put(2, timeout=0.05)
+        assert q.get() == 1
+
+    def test_producer_consumer_threads(self):
+        q = csrc.BlockingQueue(4)
+        n = 200
+        out = []
+
+        def producer():
+            for i in range(n):
+                q.put(i)
+
+        def consumer():
+            for _ in range(n):
+                out.append(q.get())
+
+        ts = [
+            threading.Thread(target=producer),
+            threading.Thread(target=consumer),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(20)
+        assert out == list(range(n))
+
+    def test_close_unblocks(self):
+        q = csrc.BlockingQueue(2)
+
+        def closer():
+            time.sleep(0.05)
+            q.close()
+
+        threading.Thread(target=closer).start()
+        with pytest.raises(RuntimeError):
+            q.get()
+
+
+class TestTCPStore:
+    def test_set_get_add_wait_barrier(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+        client = TCPStore("127.0.0.1", master.port, world_size=2)
+        try:
+            master.set("k", b"v")
+            assert client.get("k") == b"v"
+            master.set("obj", {"a": [1, 2]})
+            assert client.get("obj") == {"a": [1, 2]}
+            assert client.add("cnt", 5) == 5
+            assert master.add("cnt", -2) == 3
+
+            def late_set():
+                time.sleep(0.05)
+                master.set("late", "x")
+
+            threading.Thread(target=late_set).start()
+            client.wait(["late"], timeout=5)
+
+            t = threading.Thread(target=lambda: client.barrier("b"))
+            t.start()
+            master.barrier("b")
+            t.join(5)
+            assert not t.is_alive()
+        finally:
+            client.stop()
+            master.stop()
+
+    @native
+    def test_python_client_native_daemon_interop(self):
+        """The pure-Python client must speak the native wire format."""
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+        try:
+            py = _PyClient("127.0.0.1", master.port, timeout=5)
+            py.set("pykey", b"Sfrom_python")
+            assert master.get("pykey") == "from_python"
+            assert py.add("n", 7) == 7
+            assert py.check("pykey") and not py.check("missing")
+            py.close()
+        finally:
+            master.stop()
+
+
+@native
+class TestMemoryStats:
+    def test_current_and_peak(self):
+        lib = csrc.get_lib()
+        dev = 7  # unused slot
+        base = lib.pt_stat_current(dev)
+        lib.pt_stat_update(dev, 500)
+        lib.pt_stat_update(dev, 300)
+        lib.pt_stat_update(dev, -200)
+        assert lib.pt_stat_current(dev) == base + 600
+        assert lib.pt_stat_peak(dev) >= base + 800
+        lib.pt_stat_reset_peak(dev)
+        assert lib.pt_stat_peak(dev) == lib.pt_stat_current(dev)
+
+
+@native
+class TestEventRing:
+    def test_record_snapshot(self):
+        from paddle_tpu.profiler import (
+            _clear_events,
+            _drain_events,
+            _record_event,
+        )
+
+        _clear_events()
+        _record_event("evt_a", 1.0, 0.5)
+        _record_event("evt_b", 2.0, 0.25)
+        ev = _drain_events()
+        names = [e[0] for e in ev]
+        assert names == ["evt_a", "evt_b"]
+        assert ev[1][2] == 0.25
+
+
+class TestDataLoaderNativeQueue:
+    def test_multiworker_loader_uses_native_queue(self):
+        from paddle_tpu import io
+
+        class Ds(io.Dataset):
+            def __getitem__(self, i):
+                return np.full((4,), i, np.float32), np.int64(i)
+
+            def __len__(self):
+                return 32
+
+        loader = io.DataLoader(
+            Ds(), batch_size=4, num_workers=2, shuffle=False
+        )
+        it = iter(loader)
+        if csrc.available():
+            assert isinstance(it.queue, csrc.BlockingQueue)
+        batches = list(it)
+        assert len(batches) == 8
+        xs = np.concatenate([np.asarray(b[0]._data) for b in batches])
+        assert sorted(set(xs[:, 0].astype(int))) == list(range(32))
