@@ -37,6 +37,18 @@ from .store import TCPStore  # noqa
 from . import fleet  # noqa
 from . import sharding  # noqa
 from . import utils  # noqa
+from . import auto_parallel  # noqa
+from .auto_parallel import (  # noqa
+    Partial,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+)
 
 
 def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):

@@ -1,0 +1,408 @@
+"""Semi-auto parallel API — ProcessMesh / shard_tensor / placements /
+reshard / Engine (upstream: python/paddle/distributed/auto_parallel/
+{api.py, process_mesh.py, placement_type.py, static/engine.py}; C++
+core: paddle/phi/core/distributed/auto_parallel/dist_tensor.cc and the
+SPMD rules in paddle/phi/infermeta/spmd_rules/).
+
+TPU-native mapping — thinner than the reference because XLA's GSPMD
+partitioner IS the auto-parallel engine:
+
+* ``ProcessMesh``            → a named ``jax.sharding.Mesh`` view;
+* ``shard_tensor/placements``→ ``device_put`` with a ``NamedSharding``
+  (DistTensor = ordinary Tensor whose ``_dist_attr`` records the
+  placements — the local-shard + TensorDistAttr pair is jax.Array's
+  native representation);
+* per-op SPMD rules + reshard passes → GSPMD sharding propagation
+  (what the reference's completer/partitioner implement by hand);
+* explicit ``reshard``       → ``device_put`` to the new sharding
+  (XLA emits the collective: s→r all-gather, r→s slice, cross-mesh
+  permute);
+* ``Engine``                 → the jitted train step (jit/to_static)
+  with dataloader/loss/optimizer wiring.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...framework.core import EagerParamBase, Tensor, _as_tensor
+
+__all__ = [
+    "ProcessMesh", "Placement", "Replicate", "Shard", "Partial",
+    "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+    "shard_optimizer", "get_mesh", "set_mesh", "Engine",
+]
+
+
+# -- placements --------------------------------------------------------------
+
+
+class Placement:
+    def is_replicated(self):
+        return isinstance(self, Replicate)
+
+    def is_shard(self, dim=None):
+        return isinstance(self, Shard) and (
+            dim is None or self.get_dim() == dim
+        )
+
+    def is_partial(self):
+        return isinstance(self, Partial)
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Partial(Placement):
+    """Pending-reduction placement. The reference materializes partial
+    tensors (p→r reshard inserts the allreduce); a committed jax.Array
+    has no partial state — GSPMD keeps partials only inside compiled
+    computations — so shard_tensor rejects it and reshard from it is
+    the identity (the producing op already reduced)."""
+
+    def __init__(self, reduce_type=None):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return "Partial()"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial)
+
+    def __hash__(self):
+        return hash("Partial")
+
+
+# -- ProcessMesh -------------------------------------------------------------
+
+_global_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """N-D logical view over the device list (upstream: ProcessMesh in
+    auto_parallel/process_mesh.py — an ndarray of global ranks + dim
+    names). Here ranks index ``jax.devices()``."""
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        if mesh is None and process_ids is not None:
+            mesh = np.asarray(process_ids).reshape(shape)
+        self._array = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(self._array.ndim)]
+        self._dim_names = list(dim_names)
+        devices = jax.devices()
+        try:
+            dev_arr = np.vectorize(lambda i: devices[i])(self._array)
+        except IndexError as e:
+            raise ValueError(
+                f"ProcessMesh ids {self._array.tolist()} exceed the "
+                f"{len(devices)} visible devices"
+            ) from e
+        self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    # reference API surface
+    @property
+    def shape(self):
+        return list(self._array.shape)
+
+    @property
+    def ndim(self):
+        return self._array.ndim
+
+    @property
+    def process_ids(self):
+        return [int(x) for x in self._array.flatten()]
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._array
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._array.shape[self._dim_names.index(dim_name)]
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._dim_names == other._dim_names
+            and np.array_equal(self._array, other._array)
+        )
+
+    def __repr__(self):
+        return (
+            f"ProcessMesh(shape={self.shape}, "
+            f"dim_names={self._dim_names})"
+        )
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+# -- shard_tensor / reshard --------------------------------------------------
+
+
+def _placements_to_spec(mesh: ProcessMesh, placements, ndim: int,
+                        allow_partial=False):
+    entries = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if pl is None or pl.is_replicated():
+            continue
+        if pl.is_partial():
+            if not allow_partial:
+                raise ValueError(
+                    "Partial() cannot be materialized on a committed "
+                    "tensor (GSPMD reduces partials inside compiled "
+                    "computations); use Replicate() or Shard(dim)"
+                )
+            continue
+        dim = pl.get_dim()
+        name = mesh.dim_names[mesh_dim]
+        if entries[dim] is None:
+            entries[dim] = name
+        elif isinstance(entries[dim], tuple):
+            entries[dim] = entries[dim] + (name,)
+        else:
+            entries[dim] = (entries[dim], name)
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements,
+                 dtype=None, place=None, stop_gradient=None):
+    """Distribute a tensor over the mesh per placements (upstream:
+    paddle.distributed.shard_tensor → DistTensor). Returns the same
+    Tensor type — dist attrs ride on `_dist_attr`, the payload is a
+    globally-addressed sharded jax.Array."""
+    t = _as_tensor(data, dtype=dtype)
+    spec = _placements_to_spec(mesh, placements, t.ndim)
+    sharded = jax.device_put(t._data, NamedSharding(mesh.jax_mesh, spec))
+    if isinstance(t, EagerParamBase):
+        t._data = sharded
+        out = t
+    else:
+        out = Tensor(sharded, stop_gradient=(
+            t.stop_gradient if stop_gradient is None else stop_gradient
+        ))
+    out._dist_attr = {
+        "mesh": mesh, "placements": list(placements), "spec": spec,
+    }
+    return out
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements,
+                    *args, **kwargs):
+    """Build via fn then distribute (upstream: dtensor_from_fn) — with
+    jax the build can run unsharded then commit; XLA shards the init."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x, mesh: ProcessMesh, placements):
+    """Move a tensor to a (new) mesh/placements — XLA emits the
+    transfer collectives (upstream: the reshard pass's s→r/r→s/p→r
+    functions in phi/core/distributed/auto_parallel/reshard/)."""
+    t = _as_tensor(x)
+    spec = _placements_to_spec(
+        mesh, placements, t.ndim, allow_partial=True
+    )
+    out = Tensor(
+        jax.device_put(t._data, NamedSharding(mesh.jax_mesh, spec)),
+        stop_gradient=t.stop_gradient,
+    )
+    out._dist_attr = {
+        "mesh": mesh, "placements": list(placements), "spec": spec,
+    }
+    return out
+
+
+def shard_layer(layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn=None, output_fn=None):
+    """Shard every parameter of a layer (upstream: shard_layer). The
+    default shard_fn replicates; pass shard_fn(name, layer, mesh) to
+    place params (call shard_tensor inside)."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in list(sublayer._parameters.items()):
+                if p is None:
+                    continue
+                shard_tensor(
+                    p, mesh, [Replicate()] * len(mesh.shape)
+                )
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Align optimizer accumulators with their params' placements
+    (upstream: paddle.distributed.shard_optimizer; the ZeRO-style
+    sharding lives in fleet's DygraphShardingOptimizer — this variant
+    mirrors each param's dist attr onto its moments)."""
+    for name, accs in optimizer._accumulators.items():
+        for uid, acc in accs.items():
+            param = next(
+                (p for p in optimizer._parameter_list
+                 if isinstance(p, Tensor) and p._uid == uid), None,
+            )
+            attr = getattr(param, "_dist_attr", None)
+            if param is None or not isinstance(attr, dict):
+                continue
+            mesh, placements = attr["mesh"], attr["placements"]
+            acc._data = jax.device_put(
+                acc._data,
+                NamedSharding(mesh.jax_mesh, attr["spec"]),
+            )
+            acc._dist_attr = dict(attr)
+    return optimizer
+
+
+# -- Engine ------------------------------------------------------------------
+
+
+class Engine:
+    """Static-graph training driver (upstream: python/paddle/
+    distributed/auto_parallel/static/engine.py — prepare/fit/evaluate/
+    predict over the completed+partitioned program). Here `prepare`
+    compiles the step with jit/to_static; GSPMD plays completer and
+    partitioner."""
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics or []
+        self.strategy = strategy
+        self._train_step = None
+        self._eval_step = None
+
+    def prepare(self, *args, **kwargs):
+        from ...jit.api import to_static
+
+        model, loss_fn, opt = self.model, self.loss, self.optimizer
+
+        def train_step(x, y):
+            out = model(x)
+            l = loss_fn(out, y)
+            l.backward()
+            opt.step()
+            opt.clear_grad()
+            return l
+
+        def eval_step(x, y):
+            from ...framework.core import no_grad
+
+            with no_grad():
+                out = model(x)
+                return loss_fn(out, y)
+
+        self._train_step = to_static(train_step)
+        self._eval_step = to_static(eval_step)
+        return self
+
+    def _ensure_prepared(self):
+        if self._train_step is None:
+            self.prepare()
+
+    def fit(self, train_data, epochs=1, steps_per_epoch=None,
+            log_freq=10, verbose=1):
+        self._ensure_prepared()
+        self.model.train()
+        history = []
+        for epoch in range(epochs):
+            for step, batch in enumerate(train_data):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                x, y = batch[0], batch[1]
+                loss = self._train_step(x, y)
+                if step % log_freq == 0:
+                    val = float(np.asarray(loss._data))
+                    history.append(val)
+                    if verbose:
+                        print(
+                            f"epoch {epoch} step {step} loss {val:.5f}"
+                        )
+        return history
+
+    def evaluate(self, eval_data, steps=None, verbose=0):
+        self._ensure_prepared()
+        self.model.eval()
+        losses = []
+        for step, batch in enumerate(eval_data):
+            if steps is not None and step >= steps:
+                break
+            l = self._eval_step(batch[0], batch[1])
+            losses.append(float(np.asarray(l._data)))
+        self.model.train()
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, data, steps=None):
+        from ...framework.core import no_grad
+
+        self.model.eval()
+        outs = []
+        with no_grad():
+            for step, batch in enumerate(data):
+                if steps is not None and step >= steps:
+                    break
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                outs.append(self.model(x))
+        self.model.train()
+        return outs
+
+    def save(self, path, training=True):
+        from ...framework.io import save
+
+        save(self.model.state_dict(), path + ".pdparams")
+        if training and self.optimizer is not None:
+            save(self.optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True):
+        from ...framework.io import load
+
+        self.model.set_state_dict(load(path + ".pdparams"))
+        import os
+
+        if self.optimizer is not None and os.path.exists(path + ".pdopt"):
+            self.optimizer.set_state_dict(load(path + ".pdopt"))
