@@ -106,7 +106,9 @@ def _lazy_imports():
     """Import heavier subpackages; called at end of module init."""
     global nn, optimizer, io, jit, static, vision, hapi, metric
     global distributed, incubate, amp, profiler, vision, callbacks, Model
-    global DataParallel
+    global DataParallel, utils, inference
+    from . import utils  # noqa
+    from . import inference  # noqa
     from . import nn  # noqa
     from . import optimizer  # noqa
     from . import io  # noqa

@@ -21,11 +21,16 @@ from .collective import (  # noqa
     alltoall,
     alltoall_single,
     barrier,
+    batch_isend_irecv,
     broadcast,
     destroy_process_group,
+    gather,
     get_group,
+    irecv,
     is_available,
+    isend,
     new_group,
+    P2POp,
     recv,
     reduce,
     reduce_scatter,
@@ -34,6 +39,7 @@ from .collective import (  # noqa
 )
 from .parallel import DataParallel, init_parallel_env  # noqa
 from .store import TCPStore  # noqa
+from . import checkpoint  # noqa
 from . import fleet  # noqa
 from . import sharding  # noqa
 from . import utils  # noqa

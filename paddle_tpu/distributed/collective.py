@@ -233,10 +233,73 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     return _as_tensor(tensor)
 
 
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather `tensor` from every rank (upstream:
+    python/paddle/distributed/communication/gather.py). Under SPMD the
+    compiled region is rank-uniform, so every rank materializes the
+    gathered list (a strict superset of the reference's dst-only
+    delivery)."""
+    g = _resolve(group)
+    tensor = _as_tensor(tensor)
+    if g.nranks == 1 or not g.axis_names:
+        if gather_list is not None:
+            gather_list.append(tensor.clone())
+            return gather_list
+        return tensor
+    if in_manual_context(g.axis_names):
+        ax = g.axis_names if len(g.axis_names) > 1 else g.axis_names[0]
+        out = apply_op(
+            "c_gather",
+            lambda x: jax.lax.all_gather(x, ax, axis=0, tiled=False),
+            tensor,
+        )
+        if gather_list is not None:
+            from ..tensor.manipulation import unbind
+
+            gather_list.extend(unbind(out, axis=0))
+            return gather_list
+        return out
+    raise RuntimeError(
+        "gather across a real group requires a manual (shard_map) "
+        "context; in the GSPMD context use sharding annotations instead"
+    )
+
+
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if tensor_list:
-        tensor.set_value(_as_tensor(tensor_list[0])._data)
-    return tensor
+    """Scatter chunks from rank `src` (upstream:
+    python/paddle/distributed/communication/scatter.py): rank i receives
+    tensor_list[i] as held by the src rank."""
+    g = _resolve(group)
+    if g.nranks == 1 or not g.axis_names:
+        if tensor_list:
+            tensor.set_value(_as_tensor(tensor_list[0])._data)
+        return tensor
+    if in_manual_context(g.axis_names) and tensor_list:
+        if len(g.axis_names) != 1:
+            raise RuntimeError("scatter needs a single-axis group")
+        ax = g.axis_names[0]
+        if len(tensor_list) != g.nranks:
+            raise ValueError(
+                f"scatter needs {g.nranks} tensors, got {len(tensor_list)}"
+            )
+        from ..tensor.manipulation import stack
+
+        stacked = stack([_as_tensor(t) for t in tensor_list], axis=0)
+
+        def fn(x):
+            # route through the src rank so the data provably originates
+            # there, then take this rank's chunk
+            gathered = jax.lax.all_gather(x, ax, axis=0, tiled=False)
+            idx = jax.lax.axis_index(ax)
+            return gathered[src, idx]
+
+        out = apply_op("c_scatter", fn, stacked)
+        return _inplace(tensor, out)
+    raise RuntimeError(
+        "scatter across a real group requires a manual (shard_map) "
+        "context and a tensor_list; in the GSPMD context use sharding "
+        "annotations instead"
+    )
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -264,8 +327,11 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         )
         out_tensor_list.extend(split(out, g.nranks, axis=0))
         return out_tensor_list
-    out_tensor_list.extend(t.clone() for t in ins)
-    return out_tensor_list
+    raise RuntimeError(
+        "alltoall across a real group requires a manual (shard_map) "
+        "context (silent clone would be a wrong answer); wrap the "
+        "region with mesh.manual_axes or use fleet MoE/sep utilities"
+    )
 
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
@@ -289,19 +355,99 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
         out_tensor._data = out._data
         out_tensor._grad_node = out._grad_node
         return out_tensor
-    out_tensor.set_value(in_tensor._data)
-    return out_tensor
+    raise RuntimeError(
+        "alltoall_single across a real group requires a manual "
+        "(shard_map) context (silent copy would be a wrong answer)"
+    )
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
     raise RuntimeError(
         "point-to-point send/recv outside a compiled region is not part of "
-        "the SPMD model; use ppermute-based p2p inside pipeline schedules "
-        "(paddle_tpu.distributed.fleet.meta_parallel.pp_utils)"
+        "the SPMD model; use batch_isend_irecv (ppermute) inside a manual "
+        "region, or the pipeline schedule's built-in p2p"
     )
 
 
 recv = send
+
+
+def isend(tensor, dst=0, group=None):
+    """Marker for batch_isend_irecv (standalone async p2p has no SPMD
+    meaning — see send)."""
+    return P2POp(isend, tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return P2POp(irecv, tensor, src, group)
+
+
+class P2POp:
+    """Upstream: python/paddle/distributed/communication/batch_isend_irecv.py
+    P2POp(op, tensor, peer, group). Under single-controller SPMD `peer`
+    is a rank *offset pattern*: every rank sends to (rank+peer) % n /
+    receives from (rank-peer) % n — the translation-invariant pattern
+    that covers the reference's pipeline neighbor-exchange usage."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("op must be paddle.distributed.isend/irecv")
+        self.op = op
+        self.tensor = _as_tensor(tensor)
+        self.peer = peer
+        self.group = group
+
+
+class _DoneTask:
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of p2p ops as `ppermute`s inside a manual region.
+
+    Each isend(shift=s) rotates its tensor by +s along the group axis;
+    the positionally matching irecv(shift=s) receives the rotated value
+    into its tensor. Requires a manual (shard_map) context — outside one
+    there is no per-rank data to exchange."""
+    if not p2p_op_list:
+        return []
+    g = _resolve(p2p_op_list[0].group)
+    if g.nranks == 1 or not g.axis_names:
+        # world of one: send-to-self
+        sends = [o for o in p2p_op_list if o.op is isend]
+        recvs = [o for o in p2p_op_list if o.op is irecv]
+        for s, r in zip(sends, recvs):
+            r.tensor.set_value(s.tensor._data)
+        return [_DoneTask()]
+    if not in_manual_context(g.axis_names):
+        raise RuntimeError(
+            "batch_isend_irecv requires a manual (shard_map) context"
+        )
+    if len(g.axis_names) != 1:
+        raise RuntimeError("batch_isend_irecv needs a single-axis group")
+    n = g.nranks
+    sends = [o for o in p2p_op_list if o.op is isend]
+    recvs = [o for o in p2p_op_list if o.op is irecv]
+    if len(sends) != len(recvs):
+        raise ValueError(
+            "batch_isend_irecv needs matching isend/irecv pairs under "
+            f"SPMD (got {len(sends)} sends, {len(recvs)} recvs)"
+        )
+    for s, r in zip(sends, recvs):
+        shift = s.peer % n
+        if shift != (-r.peer) % n and shift != r.peer % n:
+            raise ValueError(
+                "paired isend/irecv offsets disagree: send +%d vs recv %d"
+                % (s.peer, r.peer)
+            )
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        out = ppermute(s.tensor, perm, group=g)
+        _inplace(r.tensor, out)
+    return [_DoneTask()]
 
 
 def barrier(group=None):

@@ -24,6 +24,10 @@ class DygraphShardingOptimizer:
         self._hcg = hcg
         self._sharding_degree = axis_degree("sharding")
         self._sharded = False
+        # shard eagerly (accumulators are created eagerly in this
+        # framework, so their placement can be too) — per-device
+        # optimizer memory shrinks from construction, not first step
+        self._shard_states()
 
     def _shard_states(self):
         self._inner_opt._create_accumulators()

@@ -38,6 +38,19 @@ from .dtype import DType, convert_dtype, to_np_dtype
 _UID = itertools.count()
 
 
+def reset_uid(start=0):
+    """Restart the tensor/param auto-name counters. Auto-generated
+    names (``tensor_N``/``param_N``, and optimizer accumulator keys
+    derived from them) are deterministic in creation order from a fresh
+    counter — process restarts realign naturally; in-process rebuilds
+    (tests, elastic relaunch without exec) call this (via
+    paddle.utils.unique_name.guard) so checkpoints keyed by name keep
+    matching."""
+    global _UID, _PARAM_UID
+    _UID = itertools.count(start)
+    _PARAM_UID = itertools.count(start)
+
+
 class _EagerState(threading.local):
     def __init__(self):
         self.grad_enabled = True
@@ -340,13 +353,23 @@ def _rebuild_tensor(arr, stop_gradient, name, persistable, is_param):
     return t
 
 
+_PARAM_UID = itertools.count()
+
+
 class EagerParamBase(Tensor):
     """Parameter: trainable leaf tensor (upstream: EagerParamBase in
-    paddle/fluid/pybind/eager.cc). stop_gradient defaults False."""
+    paddle/fluid/pybind/eager.cc). stop_gradient defaults False.
+
+    Auto-names use a dedicated ``param_N`` counter (the reference keeps
+    per-prefix unique_name counters too): parameter identity — and the
+    optimizer-accumulator checkpoint keys derived from it — must not
+    shift when unrelated temporary tensors are created."""
 
     __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed")
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
+        if name is None:
+            name = f"param_{next(_PARAM_UID)}"
         super().__init__(data, dtype=dtype, stop_gradient=not trainable,
                          name=name, persistable=True)
         self.trainable = trainable

@@ -1,75 +1,205 @@
-"""paddle_tpu.jit (upstream: python/paddle/jit/)."""
+"""paddle_tpu.jit (upstream: python/paddle/jit/ — api.py jit.save/load,
+translated_layer.py TranslatedLayer).
+
+``jit.save`` exports a **StableHLO artifact** (via jax.export): the
+traced computation is serialized portably (VHLO) together with the
+weights, so ``jit.load`` rehydrates a runnable ``TranslatedLayer``
+WITHOUT the original Python class — the TPU-native equivalent of the
+reference's saved static Program + AnalysisPredictor input. A legacy
+pickle fallback remains readable.
+"""
 from __future__ import annotations
 
 import os
 import pickle
 
-from ..framework.core import Tensor
+import jax
+import numpy as np
+
+from ..framework.core import Tensor, no_grad
 from ..framework.io import _pack, _unpack
 from .api import StaticFunction, ignore_module, not_to_static, to_static
 
+_FORMAT = "stablehlo_v1"
+
+
+def _example_struct(spec_or_tensor, sym_dims):
+    """InputSpec/Tensor -> ShapeDtypeStruct (None dims -> symbolic)."""
+    import jax.numpy as jnp
+
+    from ..static import InputSpec
+
+    if isinstance(spec_or_tensor, InputSpec):
+        shape = tuple(spec_or_tensor.shape)
+        dtype = spec_or_tensor.dtype or "float32"
+    elif isinstance(spec_or_tensor, Tensor):
+        return jax.ShapeDtypeStruct(
+            spec_or_tensor._data.shape, spec_or_tensor._data.dtype
+        )
+    else:
+        arr = np.asarray(spec_or_tensor)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+    if any(d is None or (isinstance(d, int) and d < 0) for d in shape):
+        dims = []
+        for d in shape:
+            if d is None or (isinstance(d, int) and d < 0):
+                name = f"b{len(sym_dims)}"
+                sym_dims.append(name)
+                dims.append(name)
+            else:
+                dims.append(str(d))
+        shape = jax.export.symbolic_shape(", ".join(dims))
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+def _functional_forward(layer, names, sd):
+    """Pure (param_arrays, *input_arrays) -> output arrays view of the
+    layer, by temporarily rebinding its state tensors."""
+
+    def fn(param_arrs, *input_arrs):
+        old = {}
+        try:
+            for n, arr in zip(names, param_arrs):
+                old[n] = sd[n]._data
+                sd[n]._data = arr
+            inputs = [Tensor(a) for a in input_arrs]
+            with no_grad():
+                out = layer(*inputs)
+            leaves, tree = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor)
+            )
+            raws = [l._data if isinstance(l, Tensor) else l for l in leaves]
+            return tuple(raws)
+        finally:
+            for n, arr in old.items():
+                sd[n]._data = arr
+
+    return fn
+
 
 def save(layer, path, input_spec=None, **configs):
-    """Serialize a Layer (architecture via pickle + weights as numpy).
-
-    The reference exports a static Program (upstream:
-    python/paddle/jit/api.py jit.save); the TPU-native deployment artifact
-    is the layer itself + XLA persistent compilation cache, so we persist
-    the module object and its state.
-    """
+    """Export `layer` as StableHLO + weights (upstream jit.save writes
+    Program + params; same two-artifact shape: .pdmodel/.pdiparams)."""
     from ..nn.layer.layers import Layer
 
+    if isinstance(layer, StaticFunction):
+        raise TypeError("jit.save expects a Layer; wrap functions in a Layer")
+    if not isinstance(layer, Layer):
+        raise TypeError(f"jit.save expects a Layer, got {type(layer)}")
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs input_spec (list of paddle.static.InputSpec "
+            "or example Tensors) to trace the export"
+        )
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    if isinstance(layer, StaticFunction):
-        raise TypeError("jit.save expects a Layer; wrap functions in a Layer")
-    payload = {
-        "state_dict": _pack(layer.state_dict()),
-        "layer": None,
-        "input_spec": input_spec,
-    }
+
+    was_training = layer.training
+    layer.eval()
     try:
-        buf = pickle.dumps(layer.__class__)
-        payload["layer_cls"] = buf
-        payload["layer"] = None
-        # try full-object pickling (works when forward closes over nothing)
-        payload["layer"] = pickle.dumps(_StrippedLayer(layer))
-    except Exception:
-        payload["layer"] = None
+        sd = layer.state_dict()
+        names = list(sd.keys())
+        param_structs = [
+            jax.ShapeDtypeStruct(sd[n]._data.shape, sd[n]._data.dtype)
+            for n in names
+        ]
+        sym = []
+        in_structs = [_example_struct(s, sym) for s in input_spec]
+        fn = _functional_forward(layer, names, sd)
+        exported = jax.export.export(jax.jit(fn))(
+            param_structs, *in_structs
+        )
+    finally:
+        if was_training:
+            layer.train()
+
     with open(path + ".pdmodel", "wb") as f:
-        pickle.dump(payload, f)
+        pickle.dump({
+            "format": _FORMAT,
+            "mlir": exported.serialize(),
+            "param_names": names,
+            "n_inputs": len(in_structs),
+        }, f)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(_pack(sd), f)
 
 
-class _StrippedLayer:
-    """Pickle helper: layer with tensors detached to numpy."""
+class TranslatedLayer:
+    """Runnable deserialized artifact (upstream: translated_layer.py).
+    Holds the StableHLO program + weights; no source class needed."""
 
-    def __init__(self, layer):
-        self.layer = layer
+    def __init__(self, exported, names, state, n_inputs=1):
+        self._exported = exported
+        self._param_names = names
+        self._state = state  # name -> Tensor
+        self._n_inputs = n_inputs
+        self.training = False
 
-    def __reduce__(self):
-        import copyreg
+    def eval(self):
+        self.training = False
+        return self
 
-        return (_rebuild_layer, (pickle.dumps(self.layer, protocol=4),))
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer is an inference artifact (the reference's "
+            "TranslatedLayer supports fine-tune; re-train from the "
+            "source Layer instead)"
+        )
 
+    def state_dict(self):
+        return dict(self._state)
 
-def _rebuild_layer(buf):
-    return pickle.loads(buf)
+    def set_state_dict(self, sd):
+        for k, v in sd.items():
+            if k in self._state:
+                self._state[k].set_value(
+                    v._data if isinstance(v, Tensor) else v
+                )
+
+    def parameters(self):
+        return list(self._state.values())
+
+    def forward(self, *inputs):
+        raws = [
+            i._data if isinstance(i, Tensor) else np.asarray(i)
+            for i in inputs
+        ]
+        params = [self._state[n]._data for n in self._param_names]
+        outs = self._exported.call(params, *raws)
+        if isinstance(outs, (list, tuple)):
+            wrapped = tuple(Tensor(o) for o in outs)
+            return wrapped[0] if len(wrapped) == 1 else wrapped
+        return Tensor(outs)
+
+    __call__ = forward
 
 
 def load(path, **configs):
     with open(path + ".pdmodel", "rb") as f:
         payload = pickle.load(f)
+    if payload.get("format") == _FORMAT:
+        exported = jax.export.deserialize(payload["mlir"])
+        with open(path + ".pdiparams", "rb") as f:
+            sd = _unpack(pickle.load(f))
+        return TranslatedLayer(
+            exported, payload["param_names"], sd,
+            n_inputs=payload.get("n_inputs", 1),
+        )
+    # legacy pickle format (round-1 artifacts)
     if payload.get("layer") is not None:
         stripped = pickle.loads(payload["layer"])
-        layer = stripped.layer if isinstance(stripped, _StrippedLayer) else stripped
+        layer = getattr(stripped, "layer", stripped)
         layer.set_state_dict(_unpack(payload["state_dict"]))
         return layer
-    raise RuntimeError(
-        "saved artifact does not contain a loadable layer; "
-        "re-save with a picklable Layer subclass"
-    )
+    raise RuntimeError(f"unrecognized jit.save artifact at {path}")
 
 
-class TranslatedLayer:
-    pass
+class _StrippedLayer:  # round-1 legacy artifact support (see load())
+    def __init__(self, layer):
+        self.layer = layer
+
+
+def _rebuild_layer(buf):
+    """Unpickle hook referenced by round-1 .pdmodel payloads."""
+    return pickle.loads(buf)

@@ -199,6 +199,12 @@ class LlamaAttention(Layer):
             return qh, kh, vh
 
         q, k, v = apply_op("llama_qkv_rope", attn, q, k, v, n_outs=3)
+        return self._attend(q, k, v, b, s)
+
+    def _attend(self, q, k, v, b, s):
+        cfg = self.config
+        nh, hd = self.num_heads, self.head_dim
+        mp = axis_degree("mp")
         sep = axis_degree("sep")
         if mp > 1:
             seq_ax = "sep" if sep > 1 else None
@@ -229,6 +235,67 @@ class LlamaAttention(Layer):
         )
         return self.o_proj(out)
 
+    def decode_step(self, x, cache_k, cache_v, pos):
+        """KV-cache incremental attention (the decode side of the
+        reference's fused_multi_transformer_op.cu: static-shape cache
+        slots updated in place, masked attention over the prefix).
+
+        x: [B, S, H] new tokens occupying positions [pos, pos+S);
+        cache_k/v: [B, S_max, KVH, D]; pos: scalar int32 Tensor (traced
+        — one compiled step serves every position). Returns
+        (out, new_cache_k, new_cache_v)."""
+        import jax
+
+        cfg = self.config
+        b, s = x.shape[0], x.shape[1]
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        theta = cfg.rope_theta
+
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+
+        def f(qr, kr, vr, ck, cv, p):
+            smax = ck.shape[1]
+            qh = qr.reshape(b, s, nh, hd)
+            kh = kr.reshape(b, s, nkv, hd)
+            vh = vr.reshape(b, s, nkv, hd)
+            cos, sin = build_rope_cache(
+                smax, hd, base=theta, dtype=jnp.float32
+            )
+            positions = p + jnp.arange(s, dtype=jnp.int32)
+            qh = apply_rotary_emb(qh, cos, sin, position_ids=positions)
+            kh = apply_rotary_emb(kh, cos, sin, position_ids=positions)
+            ck = jax.lax.dynamic_update_slice(
+                ck, kh.astype(ck.dtype), (0, p, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, vh.astype(cv.dtype), (0, p, 0, 0)
+            )
+            kk, vv = ck, cv
+            if nkv != nh:
+                kk = jnp.repeat(kk, nh // nkv, axis=2)
+                vv = jnp.repeat(vv, nh // nkv, axis=2)
+            scale = 1.0 / (hd ** 0.5)
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                qh.astype(jnp.float32), kk.astype(jnp.float32),
+            ) * scale
+            kpos = jnp.arange(smax, dtype=jnp.int32)
+            mask = kpos[None, :] <= positions[:, None]  # (S, Smax)
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum(
+                "bhqk,bkhd->bqhd", probs, vv.astype(jnp.float32)
+            ).astype(qr.dtype)
+            return out.reshape(b, s, nh * hd), ck, cv
+
+        out, nk, nv = apply_op(
+            "llama_decode_attn", f, q, k, v, cache_k, cache_v, pos,
+            n_outs=3,
+        )
+        return self.o_proj(out), nk, nv
+
 
 class LlamaDecoderLayer(Layer):
     """Pre-norm block; single-tensor signature → pipeline-stackable."""
@@ -250,6 +317,14 @@ class LlamaDecoderLayer(Layer):
         h = x + self.self_attn(self.input_layernorm(x))
         out = h + self.mlp(self.post_attention_layernorm(h))
         return _constrain_act(out, self._sp)
+
+    def decode_step(self, x, cache_k, cache_v, pos):
+        attn_out, nk, nv = self.self_attn.decode_step(
+            self.input_layernorm(x), cache_k, cache_v, pos
+        )
+        h = x + attn_out
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        return out, nk, nv
 
 
 class LlamaModel(Layer):
@@ -280,6 +355,14 @@ class LlamaModel(Layer):
                 h = l(h)
         return self.norm(h)
 
+    def decode_step(self, input_ids, caches, pos):
+        h = self.embed_tokens(input_ids)
+        new_caches = []
+        for l, (ck, cv) in zip(self.layers, caches):
+            h, nk, nv = l.decode_step(h, ck, cv, pos)
+            new_caches.append((nk, nv))
+        return self.norm(h), new_caches
+
 
 class LlamaForCausalLM(Layer):
     def __init__(self, config: LlamaConfig):
@@ -298,16 +381,78 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.model(input_ids)
-        if self.lm_head is not None:
-            logits = self.lm_head(h)
-        else:
-            w = self.model.embed_tokens.weight
-            logits = apply_op(
-                "tied_lm_head", lambda a, b: a @ b.T, h, w
-            )
+        logits = self._head(h)
         if labels is None:
             return logits
         return logits, LlamaPretrainingCriterion()(logits, labels)
+
+    # -- decode / serving --------------------------------------------------
+
+    def _head(self, h):
+        if self.lm_head is not None:
+            return self.lm_head(h)
+        return _tied_logits(h, self.model.embed_tokens.weight)
+
+    def init_cache(self, batch_size, max_length, dtype=None):
+        """Allocate static-shape KV cache slots (one (k, v) pair per
+        layer): [B, max_length, KVH, D]."""
+        from ..framework.core import Tensor
+
+        cfg = self.config
+        if dtype is None:
+            dtype = self.model.embed_tokens.weight._data.dtype
+        shape = (batch_size, max_length, cfg.num_key_value_heads,
+                 cfg.head_dim)
+        return [
+            (Tensor(jnp.zeros(shape, dtype)), Tensor(jnp.zeros(shape, dtype)))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+    def decode_step(self, input_ids, caches, pos):
+        """One incremental step: logits for the new tokens + updated
+        caches. `pos` is a scalar int32 Tensor so a single compiled
+        step serves all positions."""
+        h, new_caches = self.model.decode_step(input_ids, caches, pos)
+        return self._head(h), new_caches
+
+    def generate(self, input_ids, max_new_tokens=32, use_jit=False):
+        """Greedy decode (the minimal serving slice over the KV cache;
+        sampling strategies layer on top). Returns [B, S0+max_new]."""
+        import numpy as np
+
+        from ..framework.core import Tensor, no_grad
+        from ..tensor.creation import to_tensor
+
+        with no_grad():
+            b, s0 = input_ids.shape
+            max_len = s0 + max_new_tokens
+            caches = self.init_cache(b, max_len)
+
+            step = self.decode_step
+            if use_jit:
+                from .. import jit as _jit
+
+                step = _jit.to_static(self.decode_step)
+
+            def pick(logits):
+                return apply_op(
+                    "greedy_pick",
+                    lambda l: jnp.argmax(
+                        l[:, -1].astype(jnp.float32), axis=-1
+                    )[:, None].astype(jnp.int32),
+                    logits,
+                )
+
+            tokens = [input_ids]
+            cur = input_ids  # prefill consumes the prompt, then 1/step
+            for i in range(max_new_tokens):
+                pos = to_tensor(np.int32(0 if i == 0 else s0 + i - 1))
+                logits, caches = step(cur, caches, pos)
+                cur = pick(logits)
+                tokens.append(cur)
+            from ..tensor.manipulation import concat
+
+            return concat(tokens, axis=1)
 
 
 class LlamaPretrainingCriterion(Layer):
@@ -388,9 +533,12 @@ def llama_pipeline_model(config: LlamaConfig, **pp_kwargs):
     return PipelineLayer(descs, **pp_kwargs)
 
 
-def _tied_head_forward(embed_layer, h):
-    w = embed_layer.embed_tokens.weight
+def _tied_logits(h, w):
     return apply_op("tied_lm_head", lambda a, b: a @ b.T, h, w)
+
+
+def _tied_head_forward(embed_layer, h):
+    return _tied_logits(h, embed_layer.embed_tokens.weight)
 
 
 class _LlamaEmbedding(Layer):

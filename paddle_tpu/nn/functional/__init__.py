@@ -21,6 +21,8 @@ from .pooling import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .flash_attention import (  # noqa: F401
     flash_attention,
+    flash_attn_unpadded,
+    flash_attn_varlen_func,
     scaled_dot_product_attention,
     sdp_kernel,
 )

@@ -24,11 +24,65 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
-def flash_attn_unpadded(*args, **kwargs):
-    raise NotImplementedError(
-        "varlen flash attention: use flash_attention with padding masks "
-        "(ragged TPU kernel tracked as a follow-up)"
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None,
+                        scale=None, dropout=0.0, causal=False,
+                        return_softmax=False, fixed_seed_offset=None,
+                        rng_name="", training=True, name=None):
+    """Varlen (packed) attention (upstream: flash_attn varlen path in
+    paddle/phi/kernels/gpu/flash_attn_kernel.cu).
+
+    query: [total_q, num_heads, head_dim] — sequences packed along dim 0
+    with boundaries ``cu_seqlens_q`` (int32, [batch+1]); likewise key/
+    value with ``cu_seqlens_k``. Tokens never attend across sequence
+    boundaries; ``causal`` masks within each sequence.
+
+    TPU note: implemented as segment-masked attention in XLA (static
+    shapes; the segment mask is how ragged batching becomes
+    compiler-friendly on TPU). The blocked-ragged Pallas kernel is the
+    planned fast path for long packed batches.
+    """
+    query, key, value = _as_tensor(query), _as_tensor(key), _as_tensor(value)
+    cu_q = _as_tensor(cu_seqlens_q)
+    cu_k = _as_tensor(cu_seqlens_k)
+
+    def f(q, k, v, cu_q, cu_k):
+        tq, h, d = q.shape
+        tk, hkv, _ = k.shape
+        if hkv != h:
+            k = jnp.repeat(k, h // hkv, axis=1)
+            v = jnp.repeat(v, h // hkv, axis=1)
+        sc = scale if scale is not None else 1.0 / math.sqrt(d)
+        cu_q = cu_q.astype(jnp.int32)
+        cu_k = cu_k.astype(jnp.int32)
+        pos_q = jnp.arange(tq, dtype=jnp.int32)
+        pos_k = jnp.arange(tk, dtype=jnp.int32)
+        seg_q = jnp.searchsorted(cu_q[1:], pos_q, side="right")
+        seg_k = jnp.searchsorted(cu_k[1:], pos_k, side="right")
+        loc_q = pos_q - cu_q[seg_q]
+        loc_k = pos_k - cu_k[seg_k]
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            mask = mask & (loc_q[:, None] >= loc_k[None, :])
+
+        s = jnp.einsum(
+            "qhd,khd->hqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) * sc
+        s = jnp.where(mask[None], s, -1e30)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        p = jnp.exp(s - lse[..., None])
+        out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    out = apply_op(
+        "flash_attn_unpadded", jax.checkpoint(f),
+        query, key, value, cu_q, cu_k,
     )
+    return out, None
+
+
+# reference alias (upstream exposes both names)
+flash_attn_varlen_func = flash_attn_unpadded
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -7,9 +7,13 @@ the CUDA flashattn library). This is a from-scratch TPU design:
   k_blocks); K-loop is the innermost ("arbitrary") grid dim so the fp32
   accumulator, running max m and running sum l live in VMEM scratch
   across K iterations. QK^T and PV ride the MXU with fp32 accumulate.
-* backward: recompute-based blocked dq/dk/dv via `lax.scan` over K
-  blocks (memory ∝ S·block_k, not S²) using the saved logsumexp — XLA
-  fuses this well; a dedicated Pallas bwd kernel is a later optimization.
+* backward: two dedicated Pallas kernels (matching the reference's
+  flash_attn_bwd in paddle/phi/kernels/gpu/flash_attn_kernel.cu):
+  a dk/dv kernel with grid (batch*kv_heads, k_blocks, [group,] q_blocks)
+  accumulating into VMEM scratch across the inner q loop, and a dq
+  kernel with grid (batch*heads, q_blocks, k_blocks) accumulating dq
+  across the inner k loop. delta = sum(do*o) is precomputed in XLA.
+  A chunked `lax.scan` XLA fallback covers non-tileable shapes.
 * GQA/MQA: kv-head = q-head // group resolved in the BlockSpec index
   map — no KV repetition in HBM.
 
@@ -47,12 +51,15 @@ def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
 
     @pl.when(run if causal else ki >= 0)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # dots ride the MXU on the native dtype (single pass for bf16)
+        # with fp32 accumulation; softmax math stays fp32
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         ) * scale  # (Bq, Bk)
         if causal:
             q_idx = qi * block_q + jax.lax.broadcasted_iota(
@@ -70,8 +77,9 @@ def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
         p = jnp.exp(s - m_cur)
         l_cur = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )
         m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
@@ -160,6 +168,206 @@ def _flash_fwd_ref(q, k, v, causal, scale):
     return out.astype(q.dtype), lse
 
 
+def _flash_bwd_dkdv_kernel(scale, causal, offset, block_q, block_k,
+                           group, nq,
+                           q_ref, do_ref, lse_ref, delta_ref,
+                           k_ref, v_ref, dk_ref, dv_ref,
+                           dk_acc, dv_acc):
+    ki = pl.program_id(1)
+    gi = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(jnp.logical_and(gi == 0, qi == 0))
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        # any q row in this block attends to any k col in this block?
+        run = qi * block_q + block_q - 1 + offset >= ki * block_k
+
+    @pl.when(run if causal else qi >= 0)
+    def _():
+        # native-dtype MXU dots, fp32 accumulate; p/ds cast back to the
+        # input dtype before their dots (flash-attn convention)
+        q = q_ref[0]
+        do = do_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * scale  # (Bq, Bk)
+        p = jnp.exp(s - lse)
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_idx = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(q_idx + offset >= k_idx, p, 0.0)
+        # dv += p^T do
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        # dp = do v^T ; ds = p * (dp - delta) * scale
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        ds = p * (dp - delta) * scale
+        # dk += ds^T q
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    @pl.when(jnp.logical_and(gi == group - 1, qi == nq - 1))
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(scale, causal, offset, block_q, block_k, nk,
+                         q_ref, do_ref, lse_ref, delta_ref,
+                         k_ref, v_ref, dq_ref, dq_acc):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + block_q - 1 + offset
+
+    @pl.when(run if causal else ki >= 0)
+    def _():
+        q = q_ref[0]
+        do = do_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_idx = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(q_idx + offset >= k_idx, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        ds = p * (dp - delta) * scale
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale,
+                      block_q, block_k, dlse=None):
+    """Pallas dq/dk/dv. q/do: (BH, Sq, D); k/v: (BHkv, Sk, D);
+    lse: (BH, Sq) fp32. Returns (dq, dk, dv) in input dtypes."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sq, d = q.shape
+    bhkv, sk, _ = k.shape
+    group = bh // bhkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+    offset = sk - sq
+
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (BH, Sq)
+    if dlse is not None:
+        # d(lse)/ds = p, so ds += p*dlse — folded in as delta -= dlse
+        delta = delta - dlse
+    # column-broadcast over an 8-lane minor dim (TPU tiling; see fwd lse)
+    lse8 = jnp.broadcast_to(lse[..., None], (bh, sq, 8))
+    delta8 = jnp.broadcast_to(delta[..., None], (bh, sq, 8))
+
+    qspec = pl.BlockSpec(
+        (1, block_q, d), lambda hk, ki, g, qi: (hk * group + g, qi, 0)
+    )
+    rowspec = pl.BlockSpec(
+        (1, block_q, 8), lambda hk, ki, g, qi: (hk * group + g, qi, 0)
+    )
+    kvspec = pl.BlockSpec((1, block_k, d), lambda hk, ki, g, qi: (hk, ki, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkdv_kernel, scale, causal, offset,
+            block_q, block_k, group, nq,
+        ),
+        grid=(bhkv, nk, group, nq),
+        in_specs=[qspec, qspec, rowspec, rowspec, kvspec, kvspec],
+        out_specs=[kvspec, kvspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bhkv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bhkv, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel", "parallel", "arbitrary", "arbitrary"
+            )
+        ),
+    )(q, do, lse8, delta8, k, v)
+
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0))
+    rowspec2 = pl.BlockSpec((1, block_q, 8), lambda h, i, j: (h, i, 0))
+    kvspec2 = pl.BlockSpec(
+        (1, block_k, d), lambda h, i, j: (h // group, j, 0)
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale, causal, offset,
+            block_q, block_k, nk,
+        ),
+        grid=(bh, nq, nk),
+        in_specs=[qspec2, qspec2, rowspec2, rowspec2, kvspec2, kvspec2],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+    )(q, do, lse8, delta8, k, v)
+    return dq, dk, dv
+
+
 def _flash_bwd_chunked(q, k, v, out, lse, do, causal, scale, block_k,
                        dlse=None):
     """Blocked recompute backward over K blocks (lax.scan).
@@ -224,6 +432,32 @@ def _flash_bwd_chunked(q, k, v, out, lse, do, causal, scale, block_k,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _pallas_ok(q, k, block_q, block_k):
+    from . import use_pallas
+
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    return (
+        use_pallas()
+        and d % 128 == 0
+        and sq % min(block_q, sq) == 0
+        and sk % min(block_k, sk) == 0
+        and sq >= 8 and sk >= 8
+    )
+
+
+def _flash_bwd_dispatch(q, k, v, out, lse, do, causal, scale,
+                        block_q, block_k, dlse=None):
+    if _pallas_ok(q, k, block_q, block_k):
+        return _flash_bwd_pallas(
+            q, k, v, out, lse, do, causal, scale, block_q, block_k,
+            dlse=dlse,
+        )
+    return _flash_bwd_chunked(
+        q, k, v, out, lse, do, causal, scale, block_k, dlse=dlse
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_core(q, k, v, causal, scale, block_q, block_k):
     out, _ = _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k)
@@ -231,17 +465,7 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k):
 
 
 def _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k):
-    from . import use_pallas
-
-    bh, sq, d = q.shape
-    sk = k.shape[1]
-    if (
-        use_pallas()
-        and d % 128 == 0
-        and sq % min(block_q, sq) == 0
-        and sk % min(block_k, sk) == 0
-        and sq >= 8 and sk >= 8
-    ):
+    if _pallas_ok(q, k, block_q, block_k):
         return _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k)
     return _flash_fwd_ref(q, k, v, causal, scale)
 
@@ -253,8 +477,8 @@ def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k):
 
 def _flash_core_bwd(causal, scale, block_q, block_k, res, do):
     q, k, v, out, lse = res
-    dq, dk, dv = _flash_bwd_chunked(
-        q, k, v, out, lse, do, causal, scale, block_k
+    dq, dk, dv = _flash_bwd_dispatch(
+        q, k, v, out, lse, do, causal, scale, block_q, block_k
     )
     return dq, dk, dv
 
@@ -279,8 +503,8 @@ def _flash_core_lse_fwd(q, k, v, causal, scale, block_q, block_k):
 def _flash_core_lse_bwd(causal, scale, block_q, block_k, res, cts):
     q, k, v, out, lse = res
     do, dlse = cts
-    dq, dk, dv = _flash_bwd_chunked(
-        q, k, v, out, lse, do, causal, scale, block_k, dlse=dlse
+    dq, dk, dv = _flash_bwd_dispatch(
+        q, k, v, out, lse, do, causal, scale, block_q, block_k, dlse=dlse
     )
     return dq, dk, dv
 

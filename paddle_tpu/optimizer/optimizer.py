@@ -153,9 +153,23 @@ class Optimizer:
         reg = getattr(self, "_apply_regularization", None)
         if reg is not None:
             params_grads = reg(params_grads)
+        # state offloaded to pinned host (ZeRO-3 offload) must come back
+        # there after the update — record placements before applying
+        pinned = [
+            (t, t._data.sharding) for t in self._state_tensors()
+            if getattr(getattr(t._data, "sharding", None),
+                       "memory_kind", None) == "pinned_host"
+        ]
         lr = self._lr_tensor._data
         for p, g in params_grads:
             self._apply_one(p, g, lr)
+        if pinned:
+            import jax
+
+            for t, sh in pinned:
+                if getattr(t._data.sharding, "memory_kind", None) != \
+                        "pinned_host":
+                    t._data = jax.device_put(t._data, sh)
 
     def _apply_one(self, param, grad, lr):
         raise NotImplementedError

@@ -1,0 +1,21 @@
+"""paddle.utils analog (upstream: python/paddle/utils/)."""
+from . import unique_name  # noqa
+
+try:  # pragma: no cover
+    from ..framework.flags import flag as _flag  # noqa
+except Exception:  # pragma: no cover
+    pass
+
+
+def run_check():
+    """Sanity check that the runtime can execute on the current device
+    (upstream: paddle.utils.install_check.run_check)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((4, 4))
+    y = (x @ x).sum()
+    y.block_until_ready()
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! device: {dev.device_kind}")
+    return True

@@ -1,0 +1,44 @@
+"""Auto-name management (upstream: python/paddle/utils/unique_name.py —
+generate/guard/switch over a name-scope counter)."""
+from __future__ import annotations
+
+import contextlib
+import itertools
+
+_GENS = {}
+
+
+def generate(key):
+    """`generate("fc")` -> "fc_0", "fc_1", ..."""
+    c = _GENS.setdefault(key, itertools.count())
+    return f"{key}_{next(c)}"
+
+
+def switch(new_generator=None):
+    """Reset all name counters (including tensor auto-names)."""
+    global _GENS
+    old = _GENS
+    _GENS = {}
+    from ..framework.core import reset_uid
+
+    reset_uid()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope within which auto-names restart from zero — rebuilding the
+    same model inside the guard reproduces the same tensor/accumulator
+    names (what a process restart does naturally)."""
+    from ..framework import core as _core
+
+    old_uid = _core._UID
+    old_param_uid = _core._PARAM_UID
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        global _GENS
+        _GENS = old
+        _core._UID = old_uid
+        _core._PARAM_UID = old_param_uid

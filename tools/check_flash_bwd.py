@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Correctness check for the Pallas flash-attention fwd+bwd against a
+float64 numpy ground truth, run on the real TPU chip."""
+from __future__ import annotations
+
+import importlib
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+fa = importlib.import_module("paddle_tpu.ops.kernels.flash_attention")
+
+
+def ref_np(q, k, v, do, causal, scale):
+    """float64 attention fwd + grads. q/do: (BH,Sq,D); k/v: (BHkv,Sk,D)."""
+    bh, sq, d = q.shape
+    bhkv, sk, _ = k.shape
+    group = bh // bhkv
+    kf = np.repeat(k, group, axis=0)
+    vf = np.repeat(v, group, axis=0)
+    s = np.einsum("bqd,bkd->bqk", q, kf) * scale
+    if causal:
+        qi = np.arange(sq)[:, None] + (sk - sq)
+        ki = np.arange(sk)[None, :]
+        s = np.where((qi >= ki)[None], s, -np.inf)
+    m = s.max(-1, keepdims=True)
+    p = np.exp(s - m)
+    l = p.sum(-1, keepdims=True)
+    p = p / l
+    out = np.einsum("bqk,bkd->bqd", p, vf)
+    dv = np.einsum("bqk,bqd->bkd", p, do)
+    dp = np.einsum("bqd,bkd->bqk", do, vf)
+    delta = np.sum(do * out, -1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = np.einsum("bqk,bkd->bqd", ds, kf)
+    dk = np.einsum("bqk,bqd->bkd", ds, q)
+    if group != 1:
+        dk = dk.reshape(bhkv, group, sk, d).sum(1)
+        dv = dv.reshape(bhkv, group, sk, d).sum(1)
+    return out, dq, dk, dv
+
+
+def relerr(ref, got):
+    ref = np.asarray(ref, np.float64)
+    got = np.asarray(got, np.float64)
+    return np.max(np.abs(ref - got)) / (np.max(np.abs(ref)) + 1e-9)
+
+
+def check(bh, bhkv, sq, sk, d, causal, block_q, block_k, dtype, tol):
+    rng = np.random.RandomState(0)
+    qn = rng.randn(bh, sq, d)
+    kn = rng.randn(bhkv, sk, d)
+    vn = rng.randn(bhkv, sk, d)
+    don = rng.randn(bh, sq, d)
+    scale = 1.0 / np.sqrt(d)
+
+    q = jnp.asarray(qn, dtype)
+    k = jnp.asarray(kn, dtype)
+    v = jnp.asarray(vn, dtype)
+    do = jnp.asarray(don, dtype)
+    # ground truth from the quantized inputs (so bf16 error measures the
+    # kernel, not input rounding)
+    f64 = [np.asarray(t, np.float64) for t in (q, k, v, do)]
+    out_r, dq_r, dk_r, dv_r = ref_np(*f64, causal, scale)
+
+    out, lse = jax.jit(
+        lambda q, k, v: fa._flash_fwd_pallas(
+            q, k, v, causal, scale, block_q, block_k)
+    )(q, k, v)
+    dq_p, dk_p, dv_p = jax.jit(
+        lambda q, k, v, out, lse, do: fa._flash_bwd_pallas(
+            q, k, v, out, lse, do, causal, scale, block_q, block_k)
+    )(q, k, v, out, lse, do)
+
+    ok = True
+    for name, r, g in [("out", out_r, out), ("dq", dq_r, dq_p),
+                       ("dk", dk_r, dk_p), ("dv", dv_r, dv_p)]:
+        err = relerr(r, g)
+        status = "OK" if err < tol else "FAIL"
+        if err >= tol:
+            ok = False
+        print(f"  {name}: rel_err={err:.2e} [{status}]")
+    return ok
+
+
+def main():
+    cases = [
+        # bh, bhkv, sq, sk, d, causal, bq, bk, dtype, tol
+        (4, 4, 1024, 1024, 128, True, 512, 512, jnp.float32, 1e-4),
+        (4, 4, 1024, 1024, 128, False, 512, 512, jnp.float32, 1e-4),
+        (8, 2, 1024, 1024, 128, True, 512, 512, jnp.float32, 1e-4),
+        (4, 4, 512, 2048, 128, True, 256, 512, jnp.float32, 1e-4),
+        (4, 4, 2048, 2048, 128, True, 512, 512, jnp.bfloat16, 3e-2),
+        (8, 8, 256, 256, 256, True, 256, 256, jnp.float32, 1e-4),
+    ]
+    all_ok = True
+    for c in cases:
+        print(f"case bh={c[0]} bhkv={c[1]} sq={c[2]} sk={c[3]} d={c[4]} "
+              f"causal={c[5]} dtype={c[8].__name__}")
+        all_ok &= check(*c)
+    print("ALL OK" if all_ok else "FAILURES")
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

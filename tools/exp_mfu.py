@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""MFU experiment harness — variants of the flagship bench config.
+
+Usage: python tools/exp_mfu.py [--recompute 0|1] [--batch N] [--seq N]
+       [--block-q N] [--block-k N] [--steps N] [--ckpt-policy name]
+Prints one JSON line like bench.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--recompute", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--block-q", type=int, default=512)
+    ap.add_argument("--block-k", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=14)
+    ap.add_argument("--mode", type=str, default="step",
+                    choices=["fwd", "grad", "step"])
+    ap.add_argument("--profile", type=str, default="")
+    ap.add_argument("--tag", type=str, default="")
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    import paddle_tpu.ops.kernels.flash_attention as fa
+    if args.block_q != 512 or args.block_k != 512:
+        # patch default block sizes
+        orig = fa.flash_attention
+
+        def patched(q, k, v, causal=False, sm_scale=None,
+                    block_q=args.block_q, block_k=args.block_k):
+            return orig(q, k, v, causal, sm_scale, block_q, block_k)
+
+        fa.flash_attention = patched
+        import paddle_tpu.nn.functional as F
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu")
+    on_tpu = dev.platform not in ("cpu",)
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1536, intermediate_size=4224,
+        num_hidden_layers=args.layers, num_attention_heads=12,
+        num_key_value_heads=12, max_position_embeddings=args.seq,
+        tie_word_embeddings=True, recompute=bool(args.recompute),
+    )
+    seq, batch, steps = args.seq, args.batch, args.steps
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    opt = optim.AdamW(3e-4, parameters=model.parameters(),
+                      multi_precision=True)
+    opt._create_accumulators()
+
+    if args.mode == "fwd":
+        @paddle.jit.to_static
+        def train_step(x, y):
+            _, loss = model(x, y)
+            return loss
+    elif args.mode == "grad":
+        @paddle.jit.to_static
+        def train_step(x, y):
+            _, loss = model(x, y)
+            loss.backward()
+            opt.clear_grad()
+            return loss
+    else:
+        @paddle.jit.to_static
+        def train_step(x, y):
+            _, loss = model(x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype("int32")
+    )
+    y = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype("int64")
+    )
+
+    def _sync(t):
+        return float(np.asarray(t._data))
+
+    t0 = time.perf_counter()
+    loss = train_step(x, y)
+    _sync(loss)
+    compile_s = time.perf_counter() - t0
+    loss = train_step(x, y)
+    _sync(loss)
+
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(x, y)
+    loss_val = _sync(loss)
+    elapsed = time.perf_counter() - t0
+    if args.profile:
+        jax.profiler.stop_trace()
+
+    tokens = batch * seq * steps
+    tok_per_s = tokens / elapsed
+    n_params = cfg.num_params()
+    flops_per_token = 6.0 * n_params + 6.0 * cfg.num_hidden_layers \
+        * cfg.hidden_size * seq
+    model_tflops = tok_per_s * flops_per_token / 1e12
+    peak = 197.0 if "v5 lite" in kind else 197.0
+    mfu = 100.0 * model_tflops / peak
+
+    print(json.dumps({
+        "tag": args.tag,
+        "mfu": round(mfu, 2),
+        "recompute": args.recompute,
+        "batch": batch,
+        "block_q": args.block_q,
+        "block_k": args.block_k,
+        "tokens_per_sec_per_chip": round(tok_per_s, 1),
+        "loss": round(loss_val, 4),
+        "compile_s": round(compile_s, 1),
+        "step_ms": round(1000 * elapsed / steps, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
