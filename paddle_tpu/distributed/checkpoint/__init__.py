@@ -156,9 +156,15 @@ def save_state_dict(state_dict, path, process_index=None,
             except (TypeError, ValueError):
                 meta_pkl[name] = leaf
 
+    try:
+        n_procs = getattr(jax, "process_count", lambda: 1)()
+    except Exception:
+        n_procs = 1
+
     def _write():
         shard_file = f"shard_{proc}.bin"
-        manifest = {"format": 1, "process_index": proc, "tensors": {},
+        manifest = {"format": 1, "process_index": proc,
+                    "process_count": n_procs, "tensors": {},
                     "meta": meta_json}
         offset = 0
         with open(os.path.join(path, shard_file), "wb") as f:
@@ -180,7 +186,9 @@ def save_state_dict(state_dict, path, process_index=None,
                     f.write(raw)
                     offset += len(raw)
                 manifest["tensors"][name] = entry
-        if meta_pkl:
+        if meta_pkl and proc == coordinator_rank:
+            # single writer — every process holds the same replicated
+            # non-tensor leaves, so N concurrent writers would only race
             with open(os.path.join(path, "meta.pkl"), "wb") as f:
                 pickle.dump(meta_pkl, f)
         # manifest written last = commit point (partial checkpoints
@@ -189,6 +197,19 @@ def save_state_dict(state_dict, path, process_index=None,
         with open(man_path, "w") as f:
             json.dump(manifest, f)
         if proc == coordinator_rank:
+            # drop manifests from a previous larger-world save into the
+            # same directory, so load doesn't merge stale chunk tables
+            for fn in os.listdir(path):
+                if fn.startswith("manifest_") and fn.endswith(".json"):
+                    try:
+                        p = int(fn[len("manifest_"):-len(".json")])
+                    except ValueError:
+                        continue
+                    if p >= n_procs:
+                        try:
+                            os.remove(os.path.join(path, fn))
+                        except OSError:
+                            pass
             with open(os.path.join(path, "manifest.json"), "w") as f:
                 json.dump(manifest, f)
 
@@ -211,14 +232,33 @@ def save_state_dict(state_dict, path, process_index=None,
 
 
 def _read_manifests(path):
-    """Merge all per-process manifests (chunks union per tensor)."""
+    """Merge the per-process manifests of the LAST save (chunks union
+    per tensor). The coordinator's manifest.json records
+    process_count; only manifest_0..process_count-1 belong to the
+    current checkpoint (higher ranks are stale leftovers)."""
+    n_procs = None
+    top = os.path.join(path, "manifest.json")
+    if os.path.exists(top):
+        with open(top) as f:
+            n_procs = json.load(f).get("process_count")
     manifests = []
     for fn in sorted(os.listdir(path)):
         if fn.startswith("manifest_") and fn.endswith(".json"):
+            try:
+                p = int(fn[len("manifest_"):-len(".json")])
+            except ValueError:
+                continue
+            if n_procs is not None and p >= n_procs:
+                continue
             with open(os.path.join(path, fn)) as f:
                 manifests.append(json.load(f))
+    if n_procs is not None and len(manifests) < n_procs:
+        raise ValueError(
+            f"checkpoint at {path} is torn: expected {n_procs} "
+            f"process manifests, found {len(manifests)}"
+        )
     if not manifests:
-        with open(os.path.join(path, "manifest.json")) as f:
+        with open(top) as f:
             manifests.append(json.load(f))
     merged = {"tensors": {}, "meta": {}}
     for m in manifests:

@@ -68,24 +68,65 @@ class PipelineParallel(MetaParallelBase):
         self.total_loss = None
 
     # -- pipelined forward over M stacked microbatches --------------------
+    #
+    # Schedule (generalizes 1F1B and Megatron interleaved VPP in one
+    # compiled scan): with S stages, V virtual chunks per stage (V=1 =
+    # plain schedule), L layers split into S*V chunks of k'=L/(S*V)
+    # layers, chunk c lives on device c mod S. Chunk c of microbatch
+    # m = r*S + j runs at tick t = r*S*V + j + c. Consecutive chunks
+    # always sit on adjacent devices, so the activation handoff is ONE
+    # ring collective-permute per tick regardless of V. Total ticks
+    # T = M*V + S - 1 of size t_stage/V → absolute bubble time
+    # (S-1)*t_stage/V — the 1/V reduction interleaving exists for.
+    #
+    # Garbage lanes: during warmup/cooldown, lanes whose (t, s) decodes
+    # to no live microbatch compute on junk. Those lanes occupy ticks
+    # the device would spend IDLE in the reference's imperative
+    # schedule (the pipeline bubble) — wasted FLOPs, zero wasted
+    # wall-clock. tests/test_pipeline_parallel.py measures both the
+    # bubble scaling and the activation-memory scaling.
     def _body_pipeline(self, h: Tensor) -> Tensor:
         """h: [M, mb, ...] activations entering the body; returns the
         last stage's outputs, same shape."""
         body = self._layers.body
         S = self.num_stages
+        V = max(int(getattr(self._layers, "_virtual_pp_degree", 1) or 1), 1)
         L = body.n_layers
-        k = L // S
+        if L % (S * V) != 0:
+            raise ValueError(
+                f"n_layers={L} must divide into num_stages*virtual "
+                f"({S}*{V})"
+            )
+        k = L // (S * V)
         remat = self._layers._recompute_interval > 0
         params = body.stacked_params()
         key = next_key()
 
         def fn(hr, *stacked_raws):
+            M = hr.shape[0]
+            if V > 1 and M % S != 0:
+                raise ValueError(
+                    f"interleaved schedule needs accumulate_steps ({M}) "
+                    f"divisible by num_stages ({S})"
+                )
+            # chunk c = v*S + s holds layers [c*k, (c+1)*k): reshape to
+            # [V, S, k, ...]; device s owns [:, s]. (The flat [L, ...]
+            # storage is pp-sharded contiguously, so for V>1 this view
+            # re-lays params block-cyclically over ICI once per step.)
             leaves = [
-                r.reshape((S, k) + tuple(r.shape[1:]))
+                _constrain(
+                    r.reshape((V, S, k) + tuple(r.shape[1:])),
+                    None, "pp",
+                )
                 for r in stacked_raws
             ]
 
-            def apply_stage(stage_leaves, x, skey):
+            def apply_stage(stage_leaves, x, v, skey):
+                # stage_leaves: [V, k, ...] — pick this tick's chunk
+                chunk = [
+                    jax.lax.dynamic_index_in_dim(l, v, 0, keepdims=False)
+                    for l in stage_leaves
+                ]
                 lkeys = jax.vmap(
                     lambda i: jax.random.fold_in(skey, i)
                 )(jnp.arange(k))
@@ -94,36 +135,63 @@ class PipelineParallel(MetaParallelBase):
                     lp, lkey = lp_key
                     return body.apply_one(lp, xc, lkey), None
 
-                xo, _ = jax.lax.scan(step, x, (stage_leaves, lkeys))
+                xo, _ = jax.lax.scan(step, x, (chunk, lkeys))
                 return xo
 
             if remat:
                 apply_stage = jax.checkpoint(apply_stage)
 
-            M = hr.shape[0]
-            T = M + S - 1
-            pad = jnp.zeros((S - 1,) + tuple(hr.shape[1:]), hr.dtype)
-            xs = jnp.concatenate([hr, pad], axis=0)
-            ts = jnp.arange(T)
-            y0 = jnp.zeros((S,) + tuple(hr.shape[1:]), hr.dtype)
-            y0 = _constrain(y0, "pp", "dp")
+            T = M * V + S - 1
+            sv = S * V
+            y0 = _constrain(jnp.zeros((S,) + hr.shape[1:], hr.dtype),
+                            "pp", "dp")
+            out0 = _constrain(jnp.zeros_like(hr), None, "dp")
+            s_idx = jnp.arange(S)
 
-            def tick(prev_y, xt_t):
-                xt, t = xt_t
-                # stage shift: stage s consumes stage s-1's last output;
-                # sharded over pp → XLA collective-permute over ICI
-                buf = jnp.concatenate([xt[None], prev_y[:-1]], axis=0)
-                buf = _constrain(buf, "pp", "dp")
+            def tick(carry, t):
+                prev_y, out_buf = carry
+                # ring shift: lane s receives lane s-1 (lane 0 receives
+                # lane S-1: the chunk-group v -> v+1 handoff). Sharded
+                # over pp -> ICI collective-permute.
+                ring = jnp.roll(prev_y, 1, axis=0)
+                # lane 0 injects microbatch m_in when starting chunk 0
+                m_in = (t // sv) * S + (t % S)
+                inject = jnp.logical_and((t % sv) < S, m_in < M)
+                x_in = hr[jnp.clip(m_in, 0, M - 1)]
+                ring = ring.at[0].set(
+                    jnp.where(inject, x_in, ring[0])
+                )
+                buf = _constrain(ring, "pp", "dp")
+                # per-lane virtual-chunk index this tick
+                u = t - s_idx
+                v_lane = (jnp.clip(u, 0) % sv) // S
                 tkey = jax.random.fold_in(key, t)
                 skeys = jax.vmap(
                     lambda s: jax.random.fold_in(tkey, s)
-                )(jnp.arange(S))
-                y = jax.vmap(apply_stage)(leaves, buf, skeys)
+                )(s_idx)
+                y = jax.vmap(apply_stage, in_axes=(1, 0, 0, 0))(
+                    leaves, buf, v_lane, skeys
+                )
                 y = _constrain(y, "pp", "dp")
-                return y, y[-1]
+                # lane S-1 emits microbatch m_out when finishing the
+                # last chunk
+                u_last = t - (S - 1)
+                m_out = (u_last // sv) * S + (u_last % sv) % S
+                extract = jnp.logical_and(
+                    u_last >= 0,
+                    jnp.logical_and((u_last % sv) // S == V - 1,
+                                    m_out < M),
+                )
+                m_safe = jnp.clip(m_out, 0, M - 1)
+                out_buf = out_buf.at[m_safe].set(
+                    jnp.where(extract, y[-1], out_buf[m_safe])
+                )
+                return (y, out_buf), None
 
-            _, outs = jax.lax.scan(tick, y0, (xs, ts))
-            return outs[S - 1:]
+            (_, outs), _ = jax.lax.scan(
+                tick, (y0, out0), jnp.arange(T)
+            )
+            return outs
 
         return apply_op("pipeline_body", fn, h, *params)
 
@@ -232,13 +300,24 @@ class PipelineParallel(MetaParallelBase):
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """Virtual-pipeline (VPP) schedule (upstream:
-    PipelineParallelWithInterleave). The stacked-scan schedule already
-    assigns n_layers/num_stages consecutive layers per stage and
-    compiles the whole schedule; interleaving's bubble reduction is
-    subsumed by XLA's latency-hiding over the collective-permutes, so
-    this subclass exists for API parity."""
-    pass
+    """Virtual-pipeline (VPP / interleaved 1F1B) schedule (upstream:
+    PipelineParallelWithInterleave). Requires the PipelineLayer to be
+    built with ``num_virtual_pipeline_stages=V > 1``: each device owns
+    V non-contiguous layer chunks (chunk c on device c mod S) and the
+    compiled scan runs T = M*V + S - 1 chunk-sized ticks, cutting the
+    absolute bubble time by 1/V exactly as the reference's interleaved
+    schedule does. The schedule itself lives in
+    PipelineParallel._body_pipeline (V=1 degenerates to the plain
+    pipeline); this subclass validates the configuration."""
+
+    def __init__(self, layers, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        v = getattr(layers, "_virtual_pp_degree", 1) or 1
+        if v <= 1:
+            raise ValueError(
+                "PipelineParallelWithInterleave needs a PipelineLayer "
+                "built with num_virtual_pipeline_stages > 1"
+            )
 
 
 class PipelineParallelMicroStepLocations:

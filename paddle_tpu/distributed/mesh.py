@@ -97,3 +97,15 @@ def named_sharding(*spec) -> Optional[NamedSharding]:
 def reset_mesh():
     _GLOBAL.mesh = None
     _GLOBAL.axis_degrees = {}
+
+
+@contextlib.contextmanager
+def suspend_mesh():
+    """Temporarily hide the global mesh (sharding constraints become
+    no-ops) — used to trace device-agnostic export artifacts."""
+    mesh, degrees = _GLOBAL.mesh, _GLOBAL.axis_degrees
+    _GLOBAL.mesh, _GLOBAL.axis_degrees = None, {}
+    try:
+        yield
+    finally:
+        _GLOBAL.mesh, _GLOBAL.axis_degrees = mesh, degrees

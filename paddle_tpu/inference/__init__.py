@@ -122,7 +122,13 @@ class Predictor:
         raise KeyError(name)
 
     def run(self):
-        args = [t._value for t in self._inputs if t._value is not None]
+        unfed = [t.name for t in self._inputs if t._value is None]
+        if unfed:
+            raise ValueError(
+                f"predictor inputs not set: {unfed}; fill every handle "
+                "via get_input_handle(name).copy_from_cpu(...)"
+            )
+        args = [t._value for t in self._inputs]
         out = self._layer(*args)
         outs = out if isinstance(out, tuple) else (out,)
         self._outputs = []

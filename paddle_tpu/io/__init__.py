@@ -2,12 +2,15 @@
 (upstream: python/paddle/io/ + the C++ blocking-queue reader ops in
 paddle/fluid/operators/reader/).
 
-TPU-native design: the loader pipelines host-side batch assembly on a
-background thread pool into a bounded blocking queue (the analog of the
-reference's C++ BlockingQueue), converts to device arrays, and overlaps
-host→HBM transfer with compute by keeping `prefetch_factor` batches in
-flight. One process (jax owns the TPU); workers are threads — numpy
-collate releases the GIL for the copy-heavy part.
+TPU-native design: the loader pipelines host-side batch assembly into a
+bounded blocking queue (the analog of the reference's C++
+BlockingQueue), converts to device arrays, and overlaps host→HBM
+transfer with compute by keeping `prefetch_factor` batches in flight.
+One process owns the TPU (jax); with ``num_workers > 0`` batches are
+built in true OS worker processes (spawn context — fork is unsafe after
+PJRT init) exactly like the reference's multi-process workers, so
+Python-heavy transforms scale past the GIL. ``num_workers=0`` keeps the
+in-process threaded path.
 """
 from __future__ import annotations
 
@@ -360,6 +363,192 @@ class _LoaderIter:
         self._stop.set()
 
 
+class _WorkerInfo:
+    """get_worker_info() payload inside worker processes (upstream:
+    python/paddle/io/dataloader/worker.py WorkerInfo)."""
+
+    def __init__(self, id, num_workers, seed, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+class _RemoteError(Exception):
+    pass
+
+
+def _mp_worker(dataset, use_default_collate, collate_fn, index_q,
+               result_q, worker_init_fn, wid, num_workers, seed):
+    """Worker-process loop: pull index batches, build+collate to numpy,
+    push back. Never initializes a jax backend (the parent owns the
+    TPU); numpy batches travel back pickled over the queue pipe."""
+    import os as _os
+    import traceback
+
+    _os.environ["JAX_PLATFORMS"] = "cpu"  # belt-and-braces: no TPU grab
+    global _worker_info
+    _worker_info = _WorkerInfo(wid, num_workers, seed + wid, dataset)
+    if worker_init_fn is not None:
+        try:
+            worker_init_fn(wid)
+        except Exception:
+            result_q.put((-1, _RemoteError(traceback.format_exc())))
+            return
+    while True:
+        task = index_q.get()
+        if task is None:
+            result_q.put((None, wid))
+            return
+        seq, indices = task
+        try:
+            samples = [dataset[i] for i in indices]
+            if use_default_collate:
+                batch = _np_collate(samples)
+            else:
+                batch = collate_fn(samples)
+            result_q.put((seq, batch))
+        except Exception:
+            result_q.put((seq, _RemoteError(traceback.format_exc())))
+
+
+class _MPLoaderIter:
+    """Multi-process iterator: an index feeder (this thread) + N worker
+    processes + an in-order reorder buffer (the role the reference's
+    _DataLoaderIterMultiProcess plays over its C++ blocking queue)."""
+
+    def __init__(self, loader):
+        import multiprocessing as mp
+
+        self.loader = loader
+        n = loader.num_workers
+        use_default = loader.collate_fn is default_collate_fn
+        ctx = mp.get_context("spawn")
+        self._index_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        self.batch_iter = iter(loader.batch_sampler)
+        self._seq = 0
+        self._next_emit = 0
+        self._reorder = {}
+        self._sentinels = 0
+        self._exhausted = False
+        seed = 0
+        try:
+            seed = default_generator().initial_seed()
+        except Exception:
+            pass
+        self._procs = [
+            ctx.Process(
+                target=_mp_worker,
+                args=(loader.dataset, use_default,
+                      None if use_default else loader.collate_fn,
+                      self._index_q, self._result_q,
+                      loader.worker_init_fn, wid, n, seed),
+                daemon=True,
+            )
+            for wid in range(n)
+        ]
+        # workers are host-side batch builders and must NEVER attach to
+        # the accelerator: scrub device-plugin env while they boot (the
+        # child interpreter's sitecustomize runs before any of our code)
+        import os as _os
+
+        saved_env = {}
+        for k in ("PALLAS_AXON_POOL_IPS",):
+            if k in _os.environ:
+                saved_env[k] = _os.environ.pop(k)
+        prev_plat = _os.environ.get("JAX_PLATFORMS")
+        _os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for p in self._procs:
+                p.start()
+        finally:
+            _os.environ.update(saved_env)
+            if prev_plat is None:
+                _os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                _os.environ["JAX_PLATFORMS"] = prev_plat
+        # pre-dispatch the pipeline depth
+        for _ in range(max(2, loader.prefetch_factor) * n):
+            self._dispatch()
+
+    def _dispatch(self):
+        if self._exhausted:
+            return
+        try:
+            indices = next(self.batch_iter)
+        except StopIteration:
+            self._exhausted = True
+            for _ in self._procs:
+                self._index_q.put(None)
+            return
+        self._index_q.put((self._seq, indices))
+        self._seq += 1
+
+    def __next__(self):
+        while True:
+            if self._next_emit in self._reorder:
+                item = self._reorder.pop(self._next_emit)
+                self._next_emit += 1
+                self._dispatch()
+                if isinstance(item, _RemoteError):
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker failed:\n{item}"
+                    )
+                if self.loader.collate_fn is default_collate_fn:
+                    item = _to_device(item)
+                return item
+            if self._sentinels >= len(self._procs) and \
+                    self._seq == self._next_emit and not self._reorder:
+                self._shutdown()
+                raise StopIteration
+            import queue as _queue
+
+            try:
+                seq, item = self._result_q.get(timeout=5.0)
+            except _queue.Empty:
+                # liveness check: a worker killed mid-batch (OOM,
+                # segfault in native code) never sends its result or
+                # sentinel — fail loudly instead of hanging forever
+                dead = [
+                    p.pid for p in self._procs
+                    if not p.is_alive() and p.exitcode not in (0, None)
+                ]
+                if dead:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} died unexpectedly"
+                    )
+                continue
+            if seq is None:
+                self._sentinels += 1
+                continue
+            if seq == -1:  # worker_init_fn failure
+                self._shutdown()
+                raise RuntimeError(f"worker_init_fn failed:\n{item}")
+            self._reorder[seq] = item
+
+    def __iter__(self):
+        return self
+
+    def _shutdown(self):
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=5)
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -370,6 +559,8 @@ class DataLoader:
         self.dataset = dataset
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
         self.collate_fn = collate_fn or default_collate_fn
         self.dataset_kind = (
             "iterable" if isinstance(dataset, IterableDataset) else "map"
@@ -391,6 +582,28 @@ class DataLoader:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_sync()
+        if self.use_shared_memory:
+            # reference default: true OS worker processes. Spawn needs
+            # picklable dataset/collate_fn — fall back to the threaded
+            # loader (with a warning) when they aren't, so in-line
+            # datasets keep working.
+            import pickle as _pickle
+
+            try:
+                _pickle.dumps(self.dataset)
+                if self.collate_fn is not default_collate_fn:
+                    _pickle.dumps(self.collate_fn)
+                return _MPLoaderIter(self)
+            except (TypeError, AttributeError, _pickle.PicklingError):
+                import warnings
+
+                warnings.warn(
+                    "DataLoader: dataset/collate_fn is not picklable; "
+                    "num_workers>0 is using in-process threads instead "
+                    "of worker processes (define the dataset at module "
+                    "scope for true multiprocess loading)"
+                )
+        # threaded in-process path (fallback / use_shared_memory=False)
         return _LoaderIter(self)
 
     def _iter_sync(self):
@@ -415,4 +628,6 @@ class DataLoader:
 
 
 def get_worker_info():
-    return None
+    """Inside a worker process: (id, num_workers, seed, dataset);
+    None in the main process (reference semantics)."""
+    return _worker_info

@@ -23,8 +23,10 @@ from .api import StaticFunction, ignore_module, not_to_static, to_static
 _FORMAT = "stablehlo_v1"
 
 
-def _example_struct(spec_or_tensor, sym_dims):
-    """InputSpec/Tensor -> ShapeDtypeStruct (None dims -> symbolic)."""
+def _example_struct(spec_or_tensor, scope_box):
+    """InputSpec/Tensor -> ShapeDtypeStruct (None dims -> symbolic).
+    All symbolic dims share ONE SymbolicScope (scope_box) — per-spec
+    scopes cannot be mixed in a single export."""
     import jax.numpy as jnp
 
     from ..static import InputSpec
@@ -43,12 +45,16 @@ def _example_struct(spec_or_tensor, sym_dims):
         dims = []
         for d in shape:
             if d is None or (isinstance(d, int) and d < 0):
-                name = f"b{len(sym_dims)}"
-                sym_dims.append(name)
+                name = f"b{scope_box['n']}"
+                scope_box["n"] += 1
                 dims.append(name)
             else:
                 dims.append(str(d))
-        shape = jax.export.symbolic_shape(", ".join(dims))
+        if scope_box.get("scope") is None:
+            scope_box["scope"] = jax.export.SymbolicScope()
+        shape = jax.export.symbolic_shape(
+            ", ".join(dims), scope=scope_box["scope"]
+        )
     return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
 
 
@@ -104,12 +110,18 @@ def save(layer, path, input_spec=None, **configs):
             jax.ShapeDtypeStruct(sd[n]._data.shape, sd[n]._data.dtype)
             for n in names
         ]
-        sym = []
-        in_structs = [_example_struct(s, sym) for s in input_spec]
+        scope_box = {"n": 0, "scope": None}
+        in_structs = [_example_struct(s, scope_box) for s in input_spec]
         fn = _functional_forward(layer, names, sd)
-        exported = jax.export.export(jax.jit(fn))(
-            param_structs, *in_structs
-        )
+        # export DEVICE-AGNOSTIC: suspend the global mesh so training
+        # sharding constraints don't pin the artifact to the training
+        # device count (a predictor loads it on any topology)
+        from ..distributed.mesh import suspend_mesh
+
+        with suspend_mesh():
+            exported = jax.export.export(jax.jit(fn))(
+                param_structs, *in_structs
+            )
     finally:
         if was_training:
             layer.train()

@@ -204,11 +204,25 @@ class Optimizer:
                 by_name[t.name] = t
         for k, t in self._aux_state.items():
             by_name[k] = t
+        unmatched = []
         for k, v in state_dict.items():
             if k in ("LR_Scheduler", "master_weights"):
                 continue
             if k in by_name:
                 by_name[k].set_value(v._data if isinstance(v, Tensor) else v)
+            else:
+                unmatched.append(k)
+        if unmatched:
+            import warnings
+
+            warnings.warn(
+                f"optimizer.set_state_dict: {len(unmatched)} state "
+                f"entries did not match any accumulator and were "
+                f"DROPPED (e.g. {unmatched[:3]}); resuming this way "
+                "silently resets those moments. Checkpoints from "
+                "builds that used tensor_N-derived accumulator names "
+                "need re-keying (params are now named param_N)."
+            )
         mw_by_name = {t.name: t for t in self._master_weights.values()}
         for k, v in master.items():
             if k in mw_by_name:
