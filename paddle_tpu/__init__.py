@@ -106,9 +106,10 @@ def _lazy_imports():
     """Import heavier subpackages; called at end of module init."""
     global nn, optimizer, io, jit, static, vision, hapi, metric
     global distributed, incubate, amp, profiler, vision, callbacks, Model
-    global DataParallel, utils, inference
+    global DataParallel, utils, inference, sparse
     from . import utils  # noqa
     from . import inference  # noqa
+    from . import sparse  # noqa
     from . import nn  # noqa
     from . import optimizer  # noqa
     from . import io  # noqa

@@ -153,8 +153,90 @@ def memory_reserved(device=None) -> int:
     return memory_allocated(device)
 
 
+class Stream:
+    """Execution-stream handle (upstream: phi::GPUContext streams).
+
+    On TPU, XLA/PJRT owns stream scheduling — all compute is issued on
+    the runtime's single logical stream and ordering across programs is
+    data-dependency-driven. The handle exists for API parity: wait/
+    synchronize map to real dispatch barriers; there is no user-visible
+    concurrent-stream model to configure."""
+
+    def __init__(self, device=None, priority=None):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_stream(self, stream):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def __repr__(self):
+        return f"Stream(device={self.device})"
+
+
+class Event:
+    """Event marker (upstream: cudaEvent). Records a point in the
+    dispatch order; synchronize() drains outstanding work (PJRT has no
+    finer-grained user fence). elapsed_time uses host wall-clock
+    between two drained records."""
+
+    def __init__(self, enable_timing=True, blocking=False,
+                 interprocess=False):
+        import time as _time
+
+        self._time = _time
+        self._stamp = None
+
+    def record(self, stream=None):
+        synchronize()
+        self._stamp = self._time.perf_counter()
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end_event):
+        if self._stamp is None or end_event._stamp is None:
+            raise RuntimeError("both events must be recorded")
+        return (end_event._stamp - self._stamp) * 1000.0
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def stream_guard(stream):
+    """API parity: all work already rides PJRT's stream; the guard is
+    an ordering no-op (XLA schedules overlap itself)."""
+    yield stream
+
+
 class cuda:
     """Namespace shim: paddle.device.cuda.* parity, backed by TPU stats."""
+
+    Stream = Stream
+    Event = Event
+    current_stream = staticmethod(current_stream)
+    stream_guard = staticmethod(stream_guard)
 
     memory_allocated = staticmethod(memory_allocated)
     max_memory_allocated = staticmethod(max_memory_allocated)

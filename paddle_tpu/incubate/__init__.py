@@ -1,2 +1,3 @@
 """paddle.incubate analog (upstream: python/paddle/incubate/)."""
 from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

@@ -296,8 +296,10 @@ class _LoaderIter:
         self._reorder = {}
         n = max(1, loader.num_workers)
         self._sentinel_count = 0
-        for _ in range(n):
-            t = threading.Thread(target=self._worker, daemon=True)
+        for wid in range(n):
+            t = threading.Thread(
+                target=self._worker, args=(wid,), daemon=True
+            )
             t.start()
             self._threads.append(t)
 
@@ -311,7 +313,15 @@ class _LoaderIter:
             self._seq += 1
             return seq, idx
 
-    def _worker(self):
+    def _worker(self, wid=0):
+        init = getattr(self.loader, "worker_init_fn", None)
+        if init is not None:
+            try:
+                init(wid)
+            except Exception as e:
+                self.queue.put((0, e))
+                self.queue.put((None, None))
+                return
         while not self._stop.is_set():
             seq, indices = self._next_indices()
             if seq is None:
@@ -576,6 +586,7 @@ class DataLoader:
             self.batch_sampler = None
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self._mp_ok = None  # cached spawn-picklability verdict
 
     def __iter__(self):
         if self.dataset_kind == "iterable":
@@ -584,25 +595,32 @@ class DataLoader:
             return self._iter_sync()
         if self.use_shared_memory:
             # reference default: true OS worker processes. Spawn needs
-            # picklable dataset/collate_fn — fall back to the threaded
-            # loader (with a warning) when they aren't, so in-line
-            # datasets keep working.
-            import pickle as _pickle
+            # picklable dataset/collate_fn/worker_init_fn — fall back to
+            # the threaded loader (with a warning) when they aren't, so
+            # in-line datasets keep working. Probe once, not per epoch.
+            if self._mp_ok is None:
+                import pickle as _pickle
 
-            try:
-                _pickle.dumps(self.dataset)
-                if self.collate_fn is not default_collate_fn:
-                    _pickle.dumps(self.collate_fn)
+                try:
+                    _pickle.dumps(self.dataset)
+                    if self.collate_fn is not default_collate_fn:
+                        _pickle.dumps(self.collate_fn)
+                    if self.worker_init_fn is not None:
+                        _pickle.dumps(self.worker_init_fn)
+                    self._mp_ok = True
+                except (TypeError, AttributeError, _pickle.PicklingError):
+                    self._mp_ok = False
+                    import warnings
+
+                    warnings.warn(
+                        "DataLoader: dataset/collate_fn/worker_init_fn "
+                        "is not picklable; num_workers>0 is using "
+                        "in-process threads instead of worker processes "
+                        "(define them at module scope for true "
+                        "multiprocess loading)"
+                    )
+            if self._mp_ok:
                 return _MPLoaderIter(self)
-            except (TypeError, AttributeError, _pickle.PicklingError):
-                import warnings
-
-                warnings.warn(
-                    "DataLoader: dataset/collate_fn is not picklable; "
-                    "num_workers>0 is using in-process threads instead "
-                    "of worker processes (define the dataset at module "
-                    "scope for true multiprocess loading)"
-                )
         # threaded in-process path (fallback / use_shared_memory=False)
         return _LoaderIter(self)
 

@@ -1,0 +1,3 @@
+"""paddle_tpu.ops — kernel library + declarative op registry
+(upstream: paddle/phi/kernels + paddle/phi/api/yaml/ops.yaml)."""
+from .op_table import OpDef, get_op, list_ops, register  # noqa

@@ -1,0 +1,140 @@
+"""Declarative op table (upstream: paddle/phi/api/yaml/ops.yaml +
+paddle/phi/core/kernel_factory.h KernelFactory).
+
+The reference declares ~1200 ops in YAML; codegen produces the C++ API
+and the kernel registry resolves {name, backend, dtype} -> kernel. Here
+the "kernel" is a jnp/lax/Pallas-backed Python callable, so the table
+is a *registry over the live namespaces*: one OpDef per public op with
+its signature module, differentiability, and dtype coverage. Used by
+  * tests/test_op_suite.py — the OpTest-style per-op dtype/grad sweeps;
+  * paddle_tpu.ops.get_op / list_ops — runtime lookup + coverage
+    reporting (`python -m paddle_tpu.ops.op_table` prints the table).
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Callable, Optional
+
+_FLOAT = ("float32", "bfloat16", "float16")
+_ANY = ("float32", "bfloat16", "float16", "int32", "int64", "bool")
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: Callable
+    module: str
+    differentiable: bool = True
+    dtypes: tuple = _FLOAT
+    notes: str = ""
+
+    @property
+    def signature(self):
+        try:
+            return str(inspect.signature(self.fn))
+        except (TypeError, ValueError):
+            return "(...)"
+
+
+_TABLE: dict = {}
+
+
+def register(name, fn, module, differentiable=True, dtypes=_FLOAT,
+             notes=""):
+    _TABLE[name] = OpDef(name, fn, module, differentiable, dtypes, notes)
+
+
+def get_op(name) -> Optional[OpDef]:
+    _populate()
+    return _TABLE.get(name)
+
+
+def list_ops():
+    _populate()
+    return sorted(_TABLE.values(), key=lambda o: (o.module, o.name))
+
+
+_NONDIFF = {
+    # integer/bool-valued or piecewise-constant outputs
+    "sign", "floor", "ceil", "round", "trunc", "frac", "heaviside",
+    "floor_divide", "mod", "remainder", "floor_mod", "gcd", "lcm",
+    "copysign", "nextafter", "isnan", "isinf", "isfinite",
+    "count_nonzero", "argmax", "argmin", "argsort", "nonzero",
+    "searchsorted", "bucketize", "unique", "unique_consecutive",
+    "kthvalue", "mode", "equal", "not_equal", "greater_than",
+    "greater_equal", "less_than", "less_equal", "equal_all", "allclose",
+    "isclose", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "is_empty", "is_tensor", "shard_index", "one_hot", "numel",
+    "tril_indices", "triu_indices", "histogram", "bincount",
+    "increment", "median", "nanmedian",
+}
+
+_CREATION = {
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "eye", "diag",
+    "diagflat", "meshgrid", "to_tensor", "assign", "clone", "tril",
+    "triu", "one_hot", "complex", "tril_indices", "triu_indices",
+}
+
+_POPULATED = False
+
+
+def _populate():
+    """Walk the public tensor/functional namespaces once and register
+    every op (the role codegen plays for the reference's YAML)."""
+    global _POPULATED
+    if _POPULATED:
+        return
+    _POPULATED = True
+    from ..tensor import (
+        creation, linalg, logic, manipulation, math, search, stat,
+    )
+    from ..nn import functional
+
+    for mod, modname in [
+        (math, "tensor.math"),
+        (manipulation, "tensor.manipulation"),
+        (creation, "tensor.creation"),
+        (linalg, "tensor.linalg"),
+        (logic, "tensor.logic"),
+        (search, "tensor.search"),
+        (stat, "tensor.stat"),
+        (functional, "nn.functional"),
+    ]:
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or inspect.isclass(fn):
+                continue
+            if getattr(fn, "__module__", "").startswith("jax"):
+                continue
+            if name in _TABLE:
+                continue  # first module wins (math before functional)
+            diff = name not in _NONDIFF and name not in _CREATION
+            dtypes = _ANY if (name in _NONDIFF or name in _CREATION) \
+                else _FLOAT
+            register(name, fn, modname, differentiable=diff,
+                     dtypes=dtypes)
+
+
+def dump():
+    """ops.yaml-style text dump: name, module, signature, grad."""
+    lines = []
+    for op in list_ops():
+        lines.append(
+            f"- op : {op.name}\n"
+            f"  module : {op.module}\n"
+            f"  args : {op.signature}\n"
+            f"  backward : {'auto (tape vjp)' if op.differentiable else 'none'}\n"
+            f"  dtypes : [{', '.join(op.dtypes)}]"
+        )
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    ops = list_ops()
+    print(dump())
+    print(f"# total: {len(ops)} ops")

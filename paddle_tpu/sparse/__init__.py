@@ -1,0 +1,299 @@
+"""paddle.sparse analog (upstream: python/paddle/sparse/ over
+phi::SparseCooTensor / SparseCsrTensor in paddle/phi/core/sparse_*).
+
+TPU-native: sparse layouts ride jax.experimental.sparse (BCOO/BCSR) —
+XLA compiles gather/scatter/segment-sum patterns for them, the role the
+reference's dedicated sparse CPU/GPU kernels play. The SparseTensor
+facade keeps the reference surface (indices/values/nnz, to_dense,
+elementwise + matmul) and composes with the autograd tape through the
+same apply_op dispatch dense ops use.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+
+__all__ = [
+    "sparse_coo_tensor",
+    "sparse_csr_tensor",
+    "SparseCooTensor",
+    "SparseCsrTensor",
+    "is_same_shape",
+    "add",
+    "subtract",
+    "multiply",
+    "matmul",
+    "masked_matmul",
+    "relu",
+    "sum",
+    "transpose",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (upstream: phi::SparseCooTensor). Wraps a
+    BCOO; `indices` is [sparse_ndim, nnz] (reference layout).
+
+    ``values_tensor``: when the values were produced by a tracked op
+    (e.g. masked_matmul), the live autograd Tensor is kept so
+    to_dense()/values() stay differentiable."""
+
+    def __init__(self, bcoo, values_tensor=None):
+        self._mat = bcoo
+        self._values_t = values_tensor
+
+    # -- construction/conversion -------------------------------------------
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+    def nnz(self):
+        return int(self._mat.nse)
+
+    def indices(self):
+        return Tensor(jnp.transpose(self._mat.indices))
+
+    def values(self):
+        if self._values_t is not None:
+            return self._values_t
+        return Tensor(self._mat.data)
+
+    def to_dense(self):
+        idx = self._mat.indices
+        return apply_op(
+            "sparse_to_dense", lambda d: jsparse.BCOO(
+                (d, idx), shape=tuple(self.shape)
+            ).todense(),
+            self.values(),
+        )
+
+    def to_sparse_csr(self):
+        if len(self.shape) != 2:
+            raise ValueError("CSR needs a 2-D tensor")
+        dense = np.asarray(self._mat.todense())
+        return sparse_csr_tensor_from_dense(dense)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    @property
+    def dtype(self):
+        return self._mat.data.dtype
+
+    def astype(self, dtype):
+        m = jsparse.BCOO(
+            (self._mat.data.astype(dtype), self._mat.indices),
+            shape=self._mat.shape,
+        )
+        return SparseCooTensor(m)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (upstream: phi::SparseCsrTensor) over BCSR."""
+
+    def __init__(self, bcsr):
+        self._mat = bcsr
+
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+    def nnz(self):
+        return int(self._mat.nse)
+
+    def crows(self):
+        return Tensor(self._mat.indptr)
+
+    def cols(self):
+        return Tensor(self._mat.indices)
+
+    def values(self):
+        return Tensor(self._mat.data)
+
+    def to_dense(self):
+        return Tensor(self._mat.todense())
+
+    def to_sparse_coo(self, sparse_dim=2):
+        dense = np.asarray(self._mat.todense())
+        return sparse_coo_tensor_from_dense(dense)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    @property
+    def dtype(self):
+        return self._mat.data.dtype
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """indices: [sparse_ndim, nnz]; values: [nnz, ...dense dims]."""
+    idx = np.asarray(
+        indices._data if isinstance(indices, Tensor) else indices
+    )
+    val = np.asarray(
+        values._data if isinstance(values, Tensor) else values
+    )
+    if dtype is not None:
+        from ..framework.dtype import to_np_dtype
+
+        val = val.astype(to_np_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1)) + \
+            tuple(val.shape[1:])
+    mat = jsparse.BCOO((jnp.asarray(val), jnp.asarray(idx.T)),
+                       shape=tuple(shape))
+    return SparseCooTensor(mat)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    crows = np.asarray(crows._data if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols._data if isinstance(cols, Tensor) else cols)
+    values = np.asarray(
+        values._data if isinstance(values, Tensor) else values
+    )
+    if dtype is not None:
+        from ..framework.dtype import to_np_dtype
+
+        values = values.astype(to_np_dtype(dtype))
+    mat = jsparse.BCSR(
+        (jnp.asarray(values), jnp.asarray(cols), jnp.asarray(crows)),
+        shape=tuple(shape),
+    )
+    return SparseCsrTensor(mat)
+
+
+def sparse_coo_tensor_from_dense(dense):
+    d = np.asarray(dense._data if isinstance(dense, Tensor) else dense)
+    mat = jsparse.BCOO.fromdense(jnp.asarray(d))
+    return SparseCooTensor(mat)
+
+
+def sparse_csr_tensor_from_dense(dense):
+    d = np.asarray(dense._data if isinstance(dense, Tensor) else dense)
+    mat = jsparse.BCSR.fromdense(jnp.asarray(d))
+    return SparseCsrTensor(mat)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _coo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._mat
+    if isinstance(x, SparseCsrTensor):
+        return jsparse.BCOO.fromdense(x._mat.todense())
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def _ew(name, fn, x, y):
+    """Elementwise sparse op via aligned dense math re-sparsified —
+    BCOO lacks general sparse-sparse elementwise; XLA fuses this."""
+    out = fn(_coo(x).todense(), _coo(y).todense())
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def add(x, y, name=None):
+    return _ew("sparse_add", jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return _ew("sparse_subtract", jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return _ew("sparse_multiply", jnp.multiply, x, y)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (the reference's spmm)."""
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        y = y.to_dense()
+    y = _as_tensor(y)
+    mat = x._mat if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else jsparse.BCOO.fromdense(jnp.asarray(x))
+
+    def f(data, yr):
+        if isinstance(x, SparseCsrTensor):
+            m = jsparse.BCSR((data, mat.indices, mat.indptr),
+                             shape=mat.shape)
+        else:
+            m = jsparse.BCOO((data, mat.indices), shape=mat.shape)
+        return m @ yr
+
+    return apply_op("sparse_matmul", f, Tensor(mat.data), y)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at mask's nonzeros (upstream:
+    paddle.sparse.masked_matmul / SDDMM)."""
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+    m = _coo(mask)
+
+    def f(xr, yr):
+        rows = m.indices[:, 0]
+        cols = m.indices[:, 1]
+        vals = jnp.einsum("nk,nk->n", xr[rows], yr[:, cols].T)
+        return vals
+
+    vals = apply_op("sparse_masked_matmul", f, x, y)
+    mat = jsparse.BCOO((vals._data, m.indices), shape=m.shape)
+    return SparseCooTensor(mat, values_tensor=vals)
+
+
+def relu(x, name=None):
+    mat = _coo(x)
+    return SparseCooTensor(
+        jsparse.BCOO((jnp.maximum(mat.data, 0), mat.indices),
+                     shape=mat.shape)
+    )
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dense = _coo(x).todense()
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..framework.dtype import to_np_dtype
+
+        out = out.astype(to_np_dtype(dtype))
+    return Tensor(out)
+
+
+def transpose(x, perm, name=None):
+    mat = _coo(x)
+    return SparseCooTensor(mat.transpose(tuple(perm)))
+
+
+class nn:
+    """paddle.sparse.nn parity: sparse activations as layers."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

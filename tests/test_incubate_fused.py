@@ -1,0 +1,133 @@
+"""incubate.nn fused transformer tests (upstream analog:
+test/legacy_test/test_fused_multi_transformer_op.py etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import (
+    FusedMultiTransformer,
+    fused_feedforward,
+    fused_multi_head_attention,
+    fused_rotary_position_embedding,
+)
+
+E, H, FF, L = 32, 4, 64, 3
+B, S = 2, 10
+
+
+@pytest.fixture()
+def stack():
+    paddle.seed(11)
+    return FusedMultiTransformer(E, H, FF, num_layers=L)
+
+
+def test_forward_shape_and_grad(stack):
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(B, S, E).astype("float32"),
+        stop_gradient=False,
+    )
+    out = stack(x)
+    assert tuple(out.shape) == (B, S, E)
+    out.sum().backward()
+    assert x.grad is not None
+    for p in stack.parameters():
+        assert p.grad is not None, p.name
+
+
+def test_causality(stack):
+    """Changing a future token must not change earlier outputs."""
+    rng = np.random.RandomState(1)
+    a = rng.randn(B, S, E).astype("float32")
+    b = a.copy()
+    b[:, -1] += 1.0
+    oa = stack(paddle.to_tensor(a)).numpy()
+    ob = stack(paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(oa[:, :-1], ob[:, :-1], atol=1e-5)
+    assert np.abs(oa[:, -1] - ob[:, -1]).max() > 1e-4
+
+
+def test_decode_matches_full_context(stack):
+    """Prefill + token-by-token cache decode == full forward."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(B, S, E).astype("float32")
+    full = stack(paddle.to_tensor(x)).numpy()
+
+    max_len = S
+    dt = stack.qkv_weights._data.dtype
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.core import Tensor
+
+    caches = [
+        (Tensor(jnp.zeros((B, max_len, H, E // H), dt)),
+         Tensor(jnp.zeros((B, max_len, H, E // H), dt)))
+        for _ in range(L)
+    ]
+    outs = []
+    for t in range(S):
+        step_in = paddle.to_tensor(x[:, t:t + 1])
+        ts = paddle.to_tensor(np.int32(t))
+        out, caches = stack(step_in, caches=caches, time_step=ts)
+        outs.append(out.numpy()[:, 0])
+    got = np.stack(outs, axis=1)
+    np.testing.assert_allclose(got, full, atol=2e-4, rtol=2e-4)
+
+
+def test_fused_mha_and_ffn_blocks():
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(B, S, E).astype("float32"))
+    qkv_w = paddle.to_tensor(
+        (rng.randn(3, H, E // H, E) * 0.05).astype("float32"))
+    lin_w = paddle.to_tensor(
+        (rng.randn(E, E) * 0.05).astype("float32"))
+    out = fused_multi_head_attention(
+        x, qkv_w, lin_w, pre_layer_norm=True,
+        pre_ln_scale=paddle.to_tensor(np.ones(E, "float32")),
+    )
+    assert tuple(out.shape) == (B, S, E)
+
+    w1 = paddle.to_tensor((rng.randn(E, FF) * 0.05).astype("float32"))
+    w2 = paddle.to_tensor((rng.randn(FF, E) * 0.05).astype("float32"))
+    out2 = fused_feedforward(x, w1, w2, pre_layer_norm=True,
+                             activation="gelu")
+    assert tuple(out2.shape) == (B, S, E)
+
+
+def test_fused_rope_matches_kernel():
+    from paddle_tpu.ops.kernels.rope import apply_rotary_emb, \
+        build_rope_cache
+
+    rng = np.random.RandomState(4)
+    q = rng.randn(B, S, H, 8).astype("float32")
+    k = rng.randn(B, S, H, 8).astype("float32")
+    qo, ko, _ = fused_rotary_position_embedding(
+        paddle.to_tensor(q), paddle.to_tensor(k))
+    cos, sin = build_rope_cache(S, 8)
+    np.testing.assert_allclose(
+        qo.numpy(), np.asarray(apply_rotary_emb(q, cos, sin)), atol=1e-5)
+    np.testing.assert_allclose(
+        ko.numpy(), np.asarray(apply_rotary_emb(k, cos, sin)), atol=1e-5)
+
+
+def test_fused_mha_attn_mask_applied():
+    """A padding mask must actually mask (VERDICT-class silent-wrong)."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(B, S, E).astype("float32")
+    qkv_w = paddle.to_tensor(
+        (rng.randn(3, H, E // H, E) * 0.05).astype("float32"))
+    lin_w = paddle.to_tensor((rng.randn(E, E) * 0.05).astype("float32"))
+    # bool mask hiding the last key position entirely
+    mask = np.ones((B, 1, S, S), bool)
+    mask[..., -1] = False
+    out_m = fused_multi_head_attention(
+        paddle.to_tensor(x), qkv_w, lin_w,
+        attn_mask=paddle.to_tensor(mask))
+    # same computation with the last key's content changed: masked
+    # attention must be invariant to it
+    x2 = x.copy()
+    x2[:, -1] += 3.0
+    out_m2 = fused_multi_head_attention(
+        paddle.to_tensor(x2), qkv_w, lin_w,
+        attn_mask=paddle.to_tensor(mask))
+    np.testing.assert_allclose(
+        out_m.numpy()[:, :-1], out_m2.numpy()[:, :-1], atol=1e-5)

@@ -1,0 +1,467 @@
+"""Declarative op-table test harness (upstream analog:
+test/legacy_test/op_test.py driven by paddle/phi/api/yaml/ops.yaml).
+
+One OpSpec row per op: paddle-level callable, float64 numpy reference,
+input domains, dtype sweep, and (optionally) a gradient check. The
+runner checks every (op, dtype) cell:
+  * forward vs the float64 reference computed on the SAME quantized
+    inputs (so bf16 error measures the op, not input rounding), with
+    per-dtype tolerances;
+  * analytic backward (tape) vs central-difference numeric gradients
+    in float32 — the reference's check_grad.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+from paddle_tpu.tensor import (
+    creation, linalg, logic, manipulation, math as pmath, search, stat,
+)
+
+TOL = {
+    "float32": dict(rtol=2e-5, atol=2e-5),
+    "float16": dict(rtol=2e-2, atol=2e-2),
+    "bfloat16": dict(rtol=6e-2, atol=6e-2),
+}
+
+
+@dataclasses.dataclass
+class OpSpec:
+    name: str
+    fn: Callable                      # paddle-level: Tensors -> Tensor
+    ref: Callable                     # numpy float64 reference
+    shapes: Sequence[tuple]           # one per input
+    domain: tuple = (-2.0, 2.0)       # uniform input range
+    dtypes: Sequence[str] = ("float32", "bfloat16")
+    grad: bool = True                 # run numeric-vs-analytic check
+    grad_eps: float = 1e-3
+    grad_tol: float = 6e-2
+    tol_scale: float = 1.0            # per-op loosening factor
+    positive: bool = False            # inputs strictly positive
+    # (arrs, i) -> bool mask of coordinates of input i that are SAFE
+    # for central differences (away from kinks like x==y or x==0)
+    kink: Optional[Callable] = None
+
+    def gen_inputs(self, dtype, seed=0):
+        import zlib
+
+        # stable per-op seed (str hash is randomized per process)
+        rng = np.random.RandomState(
+            zlib.crc32(self.name.encode()) % 10000 + seed
+        )
+        lo, hi = self.domain
+        outs = []
+        for s in self.shapes:
+            a = rng.uniform(lo, hi, size=s)
+            if self.positive:
+                a = np.abs(a) + 0.1
+            outs.append(a.astype("float32"))
+        return outs
+
+
+def _q(arrs, dtype):
+    """Quantize float32 host arrays through the target dtype."""
+    ts = [paddle.to_tensor(a.astype("float32")).astype(dtype)
+          for a in arrs]
+    qs = [np.asarray(t.astype("float32")._data, np.float64) for t in ts]
+    return ts, qs
+
+
+U = lambda f: (lambda x: f(x))          # noqa: E731
+B = lambda f: (lambda x, y: f(x, y))    # noqa: E731
+
+
+def _away_from_tie(arrs, i, margin=2e-2):
+    """Safe where the two operands aren't nearly equal (max/min kink)."""
+    return np.abs(arrs[0] - arrs[1]) > margin
+
+
+def _away_from_zero(arrs, i, margin=2e-2):
+    return np.abs(arrs[i]) > margin
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+OPS = [
+    # -- elementwise unary --------------------------------------------------
+    OpSpec("exp", U(pmath.exp), np.exp, [(4, 33)]),
+    OpSpec("expm1", U(pmath.expm1), np.expm1, [(4, 33)]),
+    OpSpec("log", U(pmath.log), np.log, [(4, 33)], positive=True),
+    OpSpec("log2", U(pmath.log2), np.log2, [(4, 33)], positive=True),
+    OpSpec("log10", U(pmath.log10), np.log10, [(4, 33)], positive=True),
+    OpSpec("log1p", U(pmath.log1p), np.log1p, [(4, 33)], positive=True),
+    OpSpec("sqrt", U(pmath.sqrt), np.sqrt, [(4, 33)], positive=True),
+    OpSpec("rsqrt", U(pmath.rsqrt), lambda x: 1 / np.sqrt(x), [(4, 33)],
+           positive=True),
+    OpSpec("abs", U(pmath.abs), np.abs, [(4, 33)],
+           kink=_away_from_zero),
+    OpSpec("sign", U(pmath.sign), np.sign, [(4, 33)], grad=False),
+    OpSpec("floor", U(pmath.floor), np.floor, [(4, 33)], grad=False),
+    OpSpec("ceil", U(pmath.ceil), np.ceil, [(4, 33)], grad=False),
+    OpSpec("round", U(pmath.round), np.round, [(4, 33)], grad=False),
+    OpSpec("trunc", U(pmath.trunc), np.trunc, [(4, 33)], grad=False),
+    OpSpec("sin", U(pmath.sin), np.sin, [(4, 33)]),
+    OpSpec("cos", U(pmath.cos), np.cos, [(4, 33)]),
+    OpSpec("tan", U(pmath.tan), np.tan, [(4, 33)], domain=(-1.0, 1.0)),
+    OpSpec("asin", U(pmath.asin), np.arcsin, [(4, 33)],
+           domain=(-0.9, 0.9)),
+    OpSpec("acos", U(pmath.acos), np.arccos, [(4, 33)],
+           domain=(-0.9, 0.9)),
+    OpSpec("atan", U(pmath.atan), np.arctan, [(4, 33)]),
+    OpSpec("sinh", U(pmath.sinh), np.sinh, [(4, 33)]),
+    OpSpec("cosh", U(pmath.cosh), np.cosh, [(4, 33)]),
+    OpSpec("tanh", U(pmath.tanh), np.tanh, [(4, 33)]),
+    OpSpec("asinh", U(pmath.asinh), np.arcsinh, [(4, 33)]),
+    OpSpec("acosh", U(pmath.acosh), np.arccosh, [(4, 33)],
+           domain=(1.1, 3.0)),
+    OpSpec("atanh", U(pmath.atanh), np.arctanh, [(4, 33)],
+           domain=(-0.9, 0.9)),
+    OpSpec("square", U(pmath.square), np.square, [(4, 33)]),
+    OpSpec("reciprocal", U(pmath.reciprocal), lambda x: 1.0 / x,
+           [(4, 33)], positive=True),
+    OpSpec("neg", U(pmath.neg), np.negative, [(4, 33)]),
+    OpSpec("sigmoid", U(pmath.sigmoid),
+           lambda x: 1 / (1 + np.exp(-x)), [(4, 33)]),
+    OpSpec("erf", U(pmath.erf), None, [(4, 33)]),
+    OpSpec("frac", U(pmath.frac), lambda x: x - np.trunc(x), [(4, 33)],
+           grad=False),
+    # -- elementwise binary -------------------------------------------------
+    OpSpec("add", B(pmath.add), np.add, [(4, 33), (4, 33)]),
+    OpSpec("subtract", B(pmath.subtract), np.subtract,
+           [(4, 33), (4, 33)]),
+    OpSpec("multiply", B(pmath.multiply), np.multiply,
+           [(4, 33), (4, 33)]),
+    OpSpec("divide", B(pmath.divide), np.divide, [(4, 33), (4, 33)],
+           positive=True),
+    OpSpec("floor_divide", B(pmath.floor_divide), np.floor_divide,
+           [(4, 33), (4, 33)], positive=True, grad=False),
+    OpSpec("mod", B(pmath.mod), np.mod, [(4, 33), (4, 33)],
+           positive=True, grad=False),
+    OpSpec("pow", B(pmath.pow), np.power, [(4, 33), (4, 33)],
+           positive=True),
+    OpSpec("maximum", B(pmath.maximum), np.maximum, [(4, 33), (4, 33)],
+           kink=_away_from_tie),
+    OpSpec("minimum", B(pmath.minimum), np.minimum, [(4, 33), (4, 33)],
+           kink=_away_from_tie),
+    OpSpec("fmax", B(pmath.fmax), np.fmax, [(4, 33), (4, 33)],
+           kink=_away_from_tie),
+    OpSpec("fmin", B(pmath.fmin), np.fmin, [(4, 33), (4, 33)],
+           kink=_away_from_tie),
+    OpSpec("atan2", B(pmath.atan2), np.arctan2, [(4, 33), (4, 33)],
+           positive=True),
+    OpSpec("logaddexp", B(pmath.logaddexp), np.logaddexp,
+           [(4, 33), (4, 33)]),
+    OpSpec("hypot", B(pmath.hypot), np.hypot, [(4, 33), (4, 33)]),
+    OpSpec("copysign", B(pmath.copysign), np.copysign,
+           [(4, 33), (4, 33)], grad=False),
+    OpSpec("heaviside", B(pmath.heaviside), np.heaviside,
+           [(4, 33), (4, 33)], grad=False),
+    # broadcast variants
+    OpSpec("add_broadcast", B(pmath.add), np.add, [(4, 1, 33), (5, 33)]),
+    OpSpec("mul_broadcast", B(pmath.multiply), np.multiply,
+           [(4, 5, 1), (1, 33)]),
+    # -- scale / clip / lerp ------------------------------------------------
+    OpSpec("scale", lambda x: pmath.scale(x, 2.5, 1.0),
+           lambda x: 2.5 * x + 1.0, [(4, 33)]),
+    OpSpec("clip", lambda x: pmath.clip(x, -0.5, 0.5),
+           lambda x: np.clip(x, -0.5, 0.5), [(4, 33)],
+           kink=lambda arrs, i: np.minimum(np.abs(arrs[0] - 0.5), np.abs(arrs[0] + 0.5)) > 2e-2),
+    OpSpec("lerp", lambda x, y: pmath.lerp(x, y, 0.3),
+           lambda x, y: x + 0.3 * (y - x), [(4, 33), (4, 33)]),
+    # -- reductions ---------------------------------------------------------
+    OpSpec("sum", lambda x: pmath.sum(x), np.sum, [(4, 33)]),
+    OpSpec("sum_axis", lambda x: pmath.sum(x, axis=1),
+           lambda x: np.sum(x, 1), [(4, 33)]),
+    OpSpec("mean", lambda x: pmath.mean(x), np.mean, [(4, 33)]),
+    OpSpec("mean_axis", lambda x: pmath.mean(x, axis=0),
+           lambda x: np.mean(x, 0), [(4, 33)]),
+    OpSpec("max", lambda x: pmath.max(x), np.max, [(4, 33)], grad=False),
+    OpSpec("min", lambda x: pmath.min(x), np.min, [(4, 33)], grad=False),
+    OpSpec("prod", lambda x: pmath.prod(x), np.prod, [(3, 5)],
+           domain=(0.5, 1.5)),
+    OpSpec("logsumexp", lambda x: pmath.logsumexp(x),
+           lambda x: np.log(np.sum(np.exp(x))), [(4, 33)]),
+    OpSpec("cumsum", lambda x: pmath.cumsum(x, axis=1),
+           lambda x: np.cumsum(x, 1), [(4, 33)]),
+    OpSpec("cumprod", lambda x: pmath.cumprod(x, dim=1),
+           lambda x: np.cumprod(x, 1), [(3, 7)], domain=(0.5, 1.5)),
+    OpSpec("std", lambda x: stat.std(x), lambda x: np.std(x, ddof=1),
+           [(4, 33)]),
+    OpSpec("var", lambda x: stat.var(x), lambda x: np.var(x, ddof=1),
+           [(4, 33)]),
+    OpSpec("median", lambda x: stat.median(x), np.median, [(3, 7)],
+           grad=False, dtypes=("float32",)),
+    OpSpec("nansum", lambda x: stat.nansum(x), np.nansum, [(4, 33)],
+           grad=False),
+    OpSpec("count_nonzero", lambda x: pmath.count_nonzero(x),
+           np.count_nonzero, [(4, 33)], grad=False,
+           dtypes=("float32",)),
+    OpSpec("trace", lambda x: pmath.trace(x), np.trace, [(6, 6)]),
+    OpSpec("diagonal", lambda x: pmath.diagonal(x),
+           lambda x: np.diagonal(x), [(6, 6)], grad=False),
+    # -- linalg -------------------------------------------------------------
+    OpSpec("matmul", B(linalg.matmul), np.matmul, [(4, 17), (17, 9)],
+           tol_scale=4.0),
+    OpSpec("matmul_batched", B(linalg.matmul), np.matmul,
+           [(3, 4, 17), (3, 17, 9)], tol_scale=4.0),
+    OpSpec("mm", B(linalg.mm), np.matmul, [(4, 17), (17, 9)],
+           tol_scale=4.0),
+    OpSpec("bmm", B(linalg.bmm), np.matmul, [(3, 4, 7), (3, 7, 5)],
+           tol_scale=4.0),
+    OpSpec("dot", B(linalg.dot), np.dot, [(17,), (17,)], tol_scale=4.0),
+    OpSpec("mv", B(linalg.mv), np.matmul, [(5, 17), (17,)],
+           tol_scale=4.0),
+    OpSpec("outer", B(pmath.outer), np.outer, [(5,), (7,)]),
+    OpSpec("inner", B(pmath.inner), np.inner, [(4, 9), (5, 9)],
+           tol_scale=4.0),
+    OpSpec("kron", B(pmath.kron), np.kron, [(3, 4), (2, 5)]),
+    OpSpec("norm_fro", lambda x: linalg.norm(x),
+           lambda x: np.linalg.norm(x), [(4, 9)]),
+    OpSpec("dist", lambda x, y: linalg.dist(x, y),
+           lambda x, y: np.linalg.norm((x - y).ravel()),
+           [(4, 9), (4, 9)]),
+    OpSpec("cross", lambda x, y: linalg.cross(x, y, axis=1),
+           lambda x, y: np.cross(x, y, axis=1), [(4, 3), (4, 3)]),
+    OpSpec("addmm", lambda a, x, y: pmath.addmm(a, x, y),
+           lambda a, x, y: a + x @ y, [(4, 9), (4, 7), (7, 9)],
+           tol_scale=4.0),
+    # -- manipulation (exactness ops: grad=True, f32 only where int) --------
+    OpSpec("reshape", lambda x: manipulation.reshape(x, [11, 12]),
+           lambda x: x.reshape(11, 12), [(4, 33)]),
+    OpSpec("transpose", lambda x: manipulation.transpose(x, [1, 0]),
+           lambda x: x.T, [(4, 33)]),
+    OpSpec("concat", lambda x, y: manipulation.concat([x, y], axis=1),
+           lambda x, y: np.concatenate([x, y], 1),
+           [(4, 5), (4, 7)]),
+    OpSpec("stack", lambda x, y: manipulation.stack([x, y], axis=0),
+           lambda x, y: np.stack([x, y]), [(4, 5), (4, 5)]),
+    OpSpec("squeeze", lambda x: manipulation.squeeze(x, axis=1),
+           lambda x: x.squeeze(1), [(4, 1, 33)]),
+    OpSpec("unsqueeze", lambda x: manipulation.unsqueeze(x, axis=1),
+           lambda x: x[:, None], [(4, 33)]),
+    OpSpec("flatten", lambda x: manipulation.flatten(x),
+           lambda x: x.reshape(-1), [(4, 3, 5)]),
+    OpSpec("tile", lambda x: manipulation.tile(x, [2, 3]),
+           lambda x: np.tile(x, (2, 3)), [(4, 5)]),
+    OpSpec("flip", lambda x: manipulation.flip(x, axis=[1]),
+           lambda x: np.flip(x, 1), [(4, 5)]),
+    OpSpec("roll", lambda x: manipulation.roll(x, 2, axis=1),
+           lambda x: np.roll(x, 2, 1), [(4, 5)]),
+    OpSpec("rot90", lambda x: manipulation.rot90(x),
+           lambda x: np.rot90(x), [(4, 5)], grad=False),
+    OpSpec("expand", lambda x: manipulation.expand(x, [6, 4, 5]),
+           lambda x: np.broadcast_to(x, (6, 4, 5)), [(4, 5)]),
+    OpSpec("tril", lambda x: creation.tril(x), np.tril, [(5, 5)]),
+    OpSpec("triu", lambda x: creation.triu(x), np.triu, [(5, 5)]),
+    OpSpec("split", lambda x: manipulation.split(x, 2, axis=1)[0],
+           lambda x: np.split(x, 2, 1)[0], [(4, 6)]),
+    OpSpec("chunk", lambda x: manipulation.chunk(x, 3, axis=1)[1],
+           lambda x: np.split(x, 3, 1)[1], [(4, 6)]),
+    # -- activations (functional) ------------------------------------------
+    OpSpec("relu", U(F.relu), lambda x: np.maximum(x, 0), [(4, 33)],
+           kink=_away_from_zero),
+    OpSpec("gelu", U(F.gelu), None, [(4, 33)]),
+    OpSpec("silu", U(F.silu), lambda x: x / (1 + np.exp(-x)), [(4, 33)]),
+    OpSpec("leaky_relu", lambda x: F.leaky_relu(x, 0.1),
+           lambda x: np.where(x > 0, x, 0.1 * x), [(4, 33)],
+           kink=_away_from_zero),
+    OpSpec("elu", lambda x: F.elu(x),
+           lambda x: np.where(x > 0, x, np.exp(x) - 1), [(4, 33)]),
+    OpSpec("softplus", U(F.softplus),
+           lambda x: np.log1p(np.exp(x)), [(4, 33)]),
+    OpSpec("softmax", lambda x: F.softmax(x, axis=-1), _softmax_np,
+           [(4, 33)]),
+    OpSpec("log_softmax", lambda x: F.log_softmax(x, axis=-1),
+           lambda x: np.log(_softmax_np(x)), [(4, 33)]),
+    OpSpec("hardswish", U(F.hardswish),
+           lambda x: x * np.clip(x + 3, 0, 6) / 6, [(4, 33)]),
+    OpSpec("mish", U(F.mish),
+           lambda x: x * np.tanh(np.log1p(np.exp(x))), [(4, 33)]),
+    OpSpec("swish", U(F.swish),
+           lambda x: x / (1 + np.exp(-x)), [(4, 33)]),
+    OpSpec("relu6", U(F.relu6), lambda x: np.clip(x, 0, 6), [(4, 33)],
+           kink=_away_from_zero),
+    OpSpec("hardsigmoid", U(F.hardsigmoid), None, [(4, 33)]),
+    OpSpec("tanhshrink", U(F.tanhshrink),
+           lambda x: x - np.tanh(x), [(4, 33)]),
+    # -- search / logic (forward-only) -------------------------------------
+    OpSpec("argmax", lambda x: search.argmax(x, axis=1),
+           lambda x: np.argmax(x, 1), [(4, 33)], grad=False,
+           dtypes=("float32",)),
+    OpSpec("argmin", lambda x: search.argmin(x, axis=1),
+           lambda x: np.argmin(x, 1), [(4, 33)], grad=False,
+           dtypes=("float32",)),
+    OpSpec("argsort", lambda x: search.argsort(x, axis=1),
+           lambda x: np.argsort(x, 1, kind="stable"), [(4, 9)],
+           grad=False, dtypes=("float32",)),
+    OpSpec("sort", lambda x: search.sort(x, axis=1),
+           lambda x: np.sort(x, 1), [(4, 9)], grad=False,
+           dtypes=("float32",)),
+    OpSpec("where", lambda x, y: search.where(x > 0, x, y),
+           lambda x, y: np.where(x > 0, x, y), [(4, 9), (4, 9)],
+           kink=lambda arrs, i: np.abs(arrs[0]) > 2e-2),
+    OpSpec("isnan", lambda x: pmath.isnan(x), np.isnan, [(4, 9)],
+           grad=False, dtypes=("float32",)),
+    OpSpec("isfinite", lambda x: pmath.isfinite(x), np.isfinite,
+           [(4, 9)], grad=False, dtypes=("float32",)),
+]
+
+_IDS = [o.name for o in OPS]
+assert len(set(_IDS)) == len(_IDS), "duplicate op names"
+
+
+@pytest.mark.parametrize("spec", OPS, ids=_IDS)
+def test_forward_dtype_sweep(spec):
+    for dtype in spec.dtypes:
+        arrs = spec.gen_inputs(dtype)
+        ts, qs = _q(arrs, dtype)
+        out = spec.fn(*ts)
+        got = np.asarray(out.astype("float32")._data
+                         if out._data.dtype != np.bool_ else out._data,
+                         np.float64)
+        if spec.ref is None:
+            continue  # dtype-consistency only (checked vs f32 below)
+        want = np.asarray(spec.ref(*qs), np.float64)
+        tol = {k: v * spec.tol_scale for k, v in TOL[dtype].items()}
+        np.testing.assert_allclose(
+            got, want, **tol,
+            err_msg=f"{spec.name} forward mismatch [{dtype}]",
+        )
+
+
+@pytest.mark.parametrize(
+    "spec", [s for s in OPS if s.ref is None], ids=lambda s: s.name
+)
+def test_forward_low_precision_consistent(spec):
+    """Ops without a closed-form numpy ref: bf16 must track f32."""
+    arrs = spec.gen_inputs("float32")
+    ts32, _ = _q(arrs, "float32")
+    f32 = np.asarray(spec.fn(*ts32).astype("float32")._data, np.float64)
+    for dtype in spec.dtypes:
+        if dtype == "float32":
+            continue
+        ts, _ = _q(arrs, dtype)
+        got = np.asarray(spec.fn(*ts).astype("float32")._data, np.float64)
+        np.testing.assert_allclose(
+            got, f32, rtol=8e-2, atol=8e-2,
+            err_msg=f"{spec.name} [{dtype}] diverges from float32",
+        )
+
+
+@pytest.mark.parametrize(
+    "spec", [s for s in OPS if s.grad], ids=lambda s: s.name
+)
+def test_grad_numeric_vs_analytic(spec):
+    """check_grad: tape backward vs central differences (float32)."""
+    arrs = spec.gen_inputs("float32", seed=1)
+    ts = [paddle.to_tensor(a, stop_gradient=False) for a in arrs]
+    out = spec.fn(*ts)
+    # reduce to scalar with fixed cotangent weights for a stable check
+    w = np.asarray(
+        np.random.RandomState(7).randn(*out.shape), "float32"
+    )
+    loss = pmath.sum(pmath.multiply(out, paddle.to_tensor(w)))
+    loss.backward()
+
+    eps = spec.grad_eps
+    for i, (a, t) in enumerate(zip(arrs, ts)):
+        got = t.grad.numpy().astype(np.float64)
+        num = np.zeros_like(a, np.float64)
+        flat = a.ravel()
+        # probe a bounded subset of coordinates for large inputs
+        idxs = list(range(flat.size)) if flat.size <= 64 else list(
+            np.random.RandomState(3).choice(flat.size, 64, replace=False))
+        if spec.kink is not None:
+            safe = spec.kink(arrs, i).ravel()
+            idxs = [j for j in idxs if safe[j]]
+        for j in idxs:
+            ap = flat.copy()
+            ap[j] += eps
+            am = flat.copy()
+            am[j] -= eps
+            args_p = [x if k != i else ap.reshape(a.shape)
+                      for k, x in enumerate(arrs)]
+            args_m = [x if k != i else am.reshape(a.shape)
+                      for k, x in enumerate(arrs)]
+            fp = float(np.sum(np.asarray(
+                spec.fn(*[paddle.to_tensor(x) for x in args_p])._data,
+                np.float64) * w))
+            fm = float(np.sum(np.asarray(
+                spec.fn(*[paddle.to_tensor(x) for x in args_m])._data,
+                np.float64) * w))
+            num.ravel()[j] = (fp - fm) / (2 * eps)
+        mask = np.zeros_like(a, bool)
+        mask.ravel()[list(idxs)] = True
+        denom = np.abs(num[mask]).max() + 1.0
+        err = np.abs(got[mask] - num[mask]).max() / denom
+        assert err < spec.grad_tol, (
+            f"{spec.name} grad input {i}: rel err {err:.3e}"
+        )
+
+
+class TestOpTable:
+    """The framework-level registry (ops/op_table.py — the ops.yaml
+    analog) must cover the public surface and agree with this suite."""
+
+    def test_table_breadth(self):
+        from paddle_tpu.ops import list_ops
+
+        ops = list_ops()
+        assert len(ops) >= 300, len(ops)
+        mods = {o.module for o in ops}
+        assert {"tensor.math", "tensor.manipulation", "tensor.linalg",
+                "nn.functional"} <= mods
+
+    def test_lookup_and_metadata(self):
+        from paddle_tpu.ops import get_op
+
+        matmul = get_op("matmul")
+        assert matmul is not None and matmul.differentiable
+        argmax = get_op("argmax")
+        assert argmax is not None and not argmax.differentiable
+        assert get_op("definitely_not_an_op") is None
+
+    def test_suite_ops_resolve_in_table(self):
+        from paddle_tpu.ops import get_op
+
+        missing = []
+        for spec in OPS:
+            base = spec.name.split("_axis")[0].split("_broadcast")[0]
+            if get_op(base) is None and get_op(spec.name) is None:
+                missing.append(spec.name)
+        # a few suite rows are compositions (scale with kwargs, etc.)
+        assert len(missing) <= 6, missing
+
+
+class TestDeviceSurface:
+    def test_memory_api(self):
+        import paddle_tpu.device as device
+
+        a = device.memory_allocated()
+        m = device.max_memory_allocated()
+        assert isinstance(a, int) and isinstance(m, int) and m >= a >= 0
+        assert device.cuda.memory_allocated() == device.memory_allocated()
+
+    def test_stream_event(self):
+        import paddle_tpu.device as device
+
+        s = device.current_stream()
+        e0 = device.Event()
+        e0.record()
+        x = paddle.to_tensor(np.ones((64, 64), "float32"))
+        y = pmath.sum(linalg.matmul(x, x))
+        s.synchronize()
+        e1 = s.record_event()
+        assert e0.query() and e1.query()
+        assert e0.elapsed_time(e1) >= 0
+        with device.stream_guard(device.Stream()):
+            _ = float(np.asarray(y._data))

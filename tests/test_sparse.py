@@ -1,0 +1,117 @@
+"""paddle.sparse parity tests (upstream: test/legacy_test/
+test_sparse_*.py over phi::SparseCoo/CsrTensor)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _dense(shape=(4, 5), density=0.4, seed=0):
+    rng = np.random.RandomState(seed)
+    d = rng.randn(*shape).astype("float32")
+    d[rng.rand(*shape) > density] = 0.0
+    return d
+
+
+class TestCoo:
+    def test_roundtrip(self):
+        d = _dense()
+        idx = np.stack(np.nonzero(d))
+        vals = d[np.nonzero(d)]
+        s = sparse.sparse_coo_tensor(idx, vals, d.shape)
+        assert s.is_sparse_coo() and not s.is_sparse_csr()
+        assert s.nnz() == int((d != 0).sum())
+        np.testing.assert_allclose(s.to_dense().numpy(), d)
+        np.testing.assert_array_equal(s.indices().numpy(), idx)
+        np.testing.assert_allclose(s.values().numpy(), vals)
+
+    def test_infer_shape(self):
+        idx = np.array([[0, 1, 2], [1, 2, 0]])
+        s = sparse.sparse_coo_tensor(idx, [1.0, 2.0, 3.0])
+        assert s.shape == [3, 3]
+
+    def test_elementwise_and_relu(self):
+        a, b = _dense(seed=1), _dense(seed=2)
+        sa = sparse.sparse_coo_tensor_from_dense(a)
+        sb = sparse.sparse_coo_tensor_from_dense(b)
+        np.testing.assert_allclose(
+            sparse.add(sa, sb).to_dense().numpy(), a + b, atol=1e-6)
+        np.testing.assert_allclose(
+            sparse.multiply(sa, sb).to_dense().numpy(), a * b, atol=1e-6)
+        np.testing.assert_allclose(
+            sparse.relu(sa).to_dense().numpy(), np.maximum(a, 0),
+            atol=1e-6)
+
+    def test_spmm_matches_dense_and_grads(self):
+        a = _dense((4, 6), seed=3)
+        x = np.random.RandomState(4).randn(6, 3).astype("float32")
+        sa = sparse.sparse_coo_tensor_from_dense(a)
+        xt = paddle.to_tensor(x, stop_gradient=False)
+        out = sparse.matmul(sa, xt)
+        np.testing.assert_allclose(out.numpy(), a @ x, atol=1e-5)
+        out.sum().backward()
+        np.testing.assert_allclose(
+            xt.grad.numpy(), a.T @ np.ones((4, 3), "float32"), atol=1e-5)
+
+    def test_sum_transpose(self):
+        a = _dense((3, 4), seed=5)
+        sa = sparse.sparse_coo_tensor_from_dense(a)
+        np.testing.assert_allclose(
+            float(sparse.sum(sa).numpy()), a.sum(), rtol=1e-6)
+        np.testing.assert_allclose(
+            sparse.transpose(sa, [1, 0]).to_dense().numpy(), a.T)
+
+
+class TestCsr:
+    def test_roundtrip_and_convert(self):
+        d = _dense((4, 5), seed=6)
+        s = sparse.sparse_csr_tensor_from_dense(d)
+        assert s.is_sparse_csr()
+        np.testing.assert_allclose(s.to_dense().numpy(), d)
+        coo = s.to_sparse_coo()
+        assert coo.is_sparse_coo()
+        np.testing.assert_allclose(coo.to_dense().numpy(), d)
+
+    def test_explicit_construction(self):
+        # [[1, 0, 2], [0, 3, 0]]
+        s = sparse.sparse_csr_tensor(
+            crows=[0, 2, 3], cols=[0, 2, 1], values=[1.0, 2.0, 3.0],
+            shape=[2, 3],
+        )
+        np.testing.assert_allclose(
+            s.to_dense().numpy(), [[1, 0, 2], [0, 3, 0]])
+        assert s.nnz() == 3
+
+    def test_csr_spmm(self):
+        d = _dense((4, 6), seed=7)
+        x = np.random.RandomState(8).randn(6, 2).astype("float32")
+        s = sparse.sparse_csr_tensor_from_dense(d)
+        out = sparse.matmul(s, paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), d @ x, atol=1e-5)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.RandomState(9)
+    x = rng.randn(4, 7).astype("float32")
+    y = rng.randn(7, 5).astype("float32")
+    mask_d = _dense((4, 5), seed=10)
+    mask = sparse.sparse_coo_tensor_from_dense(mask_d)
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                               mask)
+    full = x @ y
+    want = np.where(mask_d != 0, full, 0.0)
+    np.testing.assert_allclose(out.to_dense().numpy(), want, atol=1e-5)
+
+
+def test_masked_matmul_grads_flow():
+    rng = np.random.RandomState(11)
+    x = paddle.to_tensor(rng.randn(3, 5).astype("float32"),
+                         stop_gradient=False)
+    y = paddle.to_tensor(rng.randn(5, 4).astype("float32"),
+                         stop_gradient=False)
+    mask = sparse.sparse_coo_tensor_from_dense(_dense((3, 4), seed=12))
+    out = sparse.masked_matmul(x, y, mask)
+    out.to_dense().sum().backward()
+    assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+    assert y.grad is not None and np.abs(y.grad.numpy()).sum() > 0
