@@ -69,6 +69,21 @@ def _emit(rec):
     return rec
 
 
+
+def _timed(step, x, y, steps):
+    """Shared compile/warmup/timed-loop harness for train benches."""
+    t0 = time.perf_counter()
+    _sync(step(x, y))
+    compile_s = time.perf_counter() - t0
+    _sync(step(x, y))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    loss_val = _sync(loss)
+    elapsed = time.perf_counter() - t0
+    return loss_val, compile_s, elapsed
+
+
 # ---------------------------------------------------------------------------
 # headline: Llama causal-LM single-chip MFU (north-star: >=45% on v5e)
 # ---------------------------------------------------------------------------
@@ -182,15 +197,7 @@ def bench_resnet50(steps=20, batch=256):
     x = paddle.to_tensor(rng.randn(batch, 3, 32, 32).astype("float32"))
     y = paddle.to_tensor(rng.randint(0, 10, size=(batch,)).astype("int64"))
 
-    t0 = time.perf_counter()
-    _sync(step(x, y))
-    compile_s = time.perf_counter() - t0
-    _sync(step(x, y))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, y)
-    loss_val = _sync(loss)
-    elapsed = time.perf_counter() - t0
+    loss_val, compile_s, elapsed = _timed(step, x, y, steps)
     return {
         "config": "resnet50_cifar10",
         "mode": "tpu-single-chip" if not kind.startswith("cpu")
@@ -239,15 +246,7 @@ def bench_gpt3(steps=8, seq=1024, batch=8, scaled=True):
         rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype("int32"))
     y = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype("int64"))
-    t0 = time.perf_counter()
-    _sync(step(x, y))
-    compile_s = time.perf_counter() - t0
-    _sync(step(x, y))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, y)
-    loss_val = _sync(loss)
-    elapsed = time.perf_counter() - t0
+    loss_val, compile_s, elapsed = _timed(step, x, y, steps)
 
     n_params = cfg.num_params()
     tok_per_s = batch * seq * steps / elapsed
@@ -302,15 +301,7 @@ def bench_vitl(steps=10, batch=32):
     x = paddle.to_tensor(rng.randn(batch, 3, 224, 224).astype("float32"))
     y = paddle.to_tensor(
         rng.randint(0, 1000, size=(batch,)).astype("int64"))
-    t0 = time.perf_counter()
-    _sync(step(x, y))
-    compile_s = time.perf_counter() - t0
-    _sync(step(x, y))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, y)
-    loss_val = _sync(loss)
-    elapsed = time.perf_counter() - t0
+    loss_val, compile_s, elapsed = _timed(step, x, y, steps)
 
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tokens = 197  # 14x14 patches + cls
@@ -364,15 +355,7 @@ def bench_ernie_moe(steps=8, seq=512, batch=8):
         rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype("int32"))
     y = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype("int64"))
-    t0 = time.perf_counter()
-    _sync(step(x, y))
-    compile_s = time.perf_counter() - t0
-    _sync(step(x, y))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, y)
-    loss_val = _sync(loss)
-    elapsed = time.perf_counter() - t0
+    loss_val, compile_s, elapsed = _timed(step, x, y, steps)
     return {
         "config": "ernie_moe_mp_pp_ep",
         "mode": "tpu-single-chip" if not kind.startswith("cpu")

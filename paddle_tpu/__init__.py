@@ -94,6 +94,12 @@ from . import framework
 from .autograd import grad
 from .autograd.py_layer import PyLayer
 
+# init-time crash handlers + VLOG tiers (upstream: platform/init.cc)
+from .framework import log as _log  # noqa: E402
+
+if framework.flags.flag("enable_signal_handler"):
+    _log.install_signal_handlers()
+
 disable_static = lambda *a, **k: None  # dygraph is the default mode
 enable_static = lambda *a, **k: None
 

@@ -137,11 +137,47 @@ def _inplace(tensor, out):
     return tensor
 
 
+class CollectiveTask:
+    """Async-collective handle (upstream: ProcessGroup::Task — event-
+    backed). XLA dispatch is already asynchronous; wait() is the hard
+    sync (the role of Task::Wait's event block)."""
+
+    def __init__(self, tensor):
+        self._tensor = tensor
+
+    def wait(self, timeout=None):
+        data = getattr(self._tensor, "_data", None)
+        if data is not None and hasattr(data, "block_until_ready"):
+            try:
+                data.block_until_ready()
+            except Exception:
+                pass
+        return True
+
+    def is_completed(self):
+        data = getattr(self._tensor, "_data", None)
+        if data is not None and hasattr(data, "is_ready"):
+            try:
+                return bool(data.is_ready())
+            except Exception:
+                return True
+        return True
+
+    def synchronize(self):
+        self.wait()
+
+
+def _maybe_task(tensor, sync_op):
+    """Reference semantics: sync_op=False returns the async Task;
+    sync_op=True returns the (in-place updated) tensor."""
+    return tensor if sync_op else CollectiveTask(tensor)
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _resolve(group)
     tensor = _as_tensor(tensor)
     if g.nranks == 1 or not g.axis_names:
-        return tensor
+        return _maybe_task(tensor, sync_op)
     if in_manual_context(g.axis_names):
         ax = g.axis_names if len(g.axis_names) > 1 else g.axis_names[0]
         if op == ReduceOp.SUM:
@@ -155,9 +191,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         else:
             fn = lambda x: jax.lax.psum(x, ax)
         out = apply_op("c_allreduce", fn, tensor)
-        return _inplace(tensor, out)
+        _inplace(tensor, out)
+        return _maybe_task(tensor, sync_op)
     # GSPMD context: values are global; reduction already implied
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
@@ -211,7 +248,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
     src = _as_tensor(src)
     if g.nranks == 1 or not g.axis_names:
         tensor.set_value(src._data)
-        return tensor
+        return _maybe_task(tensor, sync_op)
     if in_manual_context(g.axis_names):
         ax = g.axis_names if len(g.axis_names) > 1 else g.axis_names[0]
         out = apply_op(
@@ -222,15 +259,15 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
         )
         tensor._data = out._data
         tensor._grad_node = out._grad_node
-        return tensor
+        return _maybe_task(tensor, sync_op)
     tensor.set_value(src._data)
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     # single-controller SPMD: one copy of the data exists; broadcast is
     # the identity (startup param sync is inherent)
-    return _as_tensor(tensor)
+    return _maybe_task(_as_tensor(tensor), sync_op)
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):

@@ -1,0 +1,239 @@
+"""paddle.distributed.rpc analog (upstream: python/paddle/distributed/
+rpc/ over a brpc-based C++ agent).
+
+TPU-native scope: RPC in the reference serves control-plane patterns
+(parameter-server pushes, elastic coordination, metrics) — never the
+tensor hot path, which is XLA collectives here. This implementation is
+a small real RPC: each worker runs a daemon TCP server executing
+pickled (fn, args, kwargs) requests; worker discovery goes through the
+same TCPStore rendezvous the collective init uses.
+
+Security note (same stance as the reference's agent): endpoints
+deserialize pickled payloads from registered peers — run only on
+trusted cluster networks.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+import traceback
+
+__all__ = [
+    "init_rpc",
+    "shutdown",
+    "rpc_sync",
+    "rpc_async",
+    "get_worker_info",
+    "get_all_worker_infos",
+    "get_current_worker_info",
+    "WorkerInfo",
+]
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip, port):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+_state = {
+    "server": None,
+    "thread": None,
+    "store": None,
+    "me": None,
+    "workers": {},
+    "owns_store": False,
+}
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            req = pickle.loads(_recv_msg(self.request))
+            fn, args, kwargs = req
+            try:
+                result = fn(*args, **kwargs)
+                resp = ("ok", result)
+            except Exception as e:  # executed-function error -> caller
+                resp = ("err", (e, traceback.format_exc()))
+            _send_msg(self.request, pickle.dumps(resp))
+        except (ConnectionError, EOFError):
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC agent and rendezvous with peers.
+    master_endpoint: "ip:port" of the rank-0 TCPStore (defaults to the
+    env the launch CLI sets, or a local one-process group)."""
+    import os
+
+    from ..store import TCPStore
+
+    if _state["server"] is not None:
+        raise RuntimeError("init_rpc already called")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    ep = master_endpoint or os.environ.get("PADDLE_MASTER")
+    if ep is None:
+        # single-node launch sets only PADDLE_TRAINER_ENDPOINTS; every
+        # rank derives the same store endpoint from trainer 0's
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+        if eps and world_size > 1:
+            h0, p0 = eps.split(",")[0].rsplit(":", 1)
+            ep = f"{h0}:{int(p0) + 2000}"
+        else:
+            ep = "127.0.0.1:0"
+    host, port = ep.rsplit(":", 1)
+
+    # bind all interfaces; advertise this host's routable address so
+    # cross-host peers can reach us (the launch CLI records it in
+    # PADDLE_CURRENT_ENDPOINT)
+    server = _Server(("0.0.0.0", 0), _Handler)
+    my_port = server.server_address[1]
+    cur_ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+    my_ip = cur_ep.rsplit(":", 1)[0] if ":" in cur_ep else "127.0.0.1"
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name=f"rpc-{name}")
+    t.start()
+
+    if world_size == 1 and int(port) == 0:
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+        _state["owns_store"] = True
+    else:
+        store = TCPStore(host, int(port), is_master=(rank == 0),
+                         world_size=world_size)
+        _state["owns_store"] = rank == 0
+    me = WorkerInfo(name, rank, my_ip, my_port)
+    store.set(f"rpc/worker/{rank}",
+              {"name": name, "rank": rank, "ip": me.ip, "port": my_port})
+    store.wait([f"rpc/worker/{r}" for r in range(world_size)],
+               timeout=300)
+    workers = {}
+    for r in range(world_size):
+        info = store.get(f"rpc/worker/{r}")
+        w = WorkerInfo(info["name"], info["rank"], info["ip"],
+                       info["port"])
+        workers[w.name] = w
+    _state.update(server=server, thread=t, store=store, me=me,
+                  workers=workers)
+    return me
+
+
+def get_worker_info(name=None):
+    if _state["me"] is None:
+        raise RuntimeError("init_rpc not called")
+    if name is None:
+        return _state["me"]
+    return _state["workers"][name]
+
+
+def get_current_worker_info():
+    return get_worker_info()
+
+
+def get_all_worker_infos():
+    return list(_state["workers"].values())
+
+
+class _Future:
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def wait(self, timeout=None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("rpc result timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def done(self):
+        return self._ev.is_set()
+
+
+def _call(to, fn, args, kwargs, timeout):
+    w = get_worker_info(to)
+    with socket.create_connection((w.ip, w.port), timeout=timeout) as s:
+        _send_msg(s, pickle.dumps((fn, args or (), kwargs or {})))
+        status, payload = pickle.loads(_recv_msg(s))
+    if status == "err":
+        exc, tb = payload
+        raise RuntimeError(
+            f"rpc to {to} failed remotely:\n{tb}"
+        ) from exc
+    return payload
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=60.0):
+    """Execute fn(*args, **kwargs) on worker `to`, blocking."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=60.0):
+    """Async variant: returns a Future with wait()/done()."""
+    fut = _Future()
+
+    def run():
+        try:
+            fut._value = _call(to, fn, args, kwargs, timeout)
+        except Exception as e:
+            fut._exc = e
+        finally:
+            fut._ev.set()
+
+    threading.Thread(target=run, daemon=True).start()
+    return fut
+
+
+def shutdown(graceful=True):
+    server = _state.get("server")
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    store = _state.get("store")
+    if store is not None:
+        try:
+            store.stop()
+        except Exception:
+            pass
+    _state.update(server=None, thread=None, store=None, me=None,
+                  workers={}, owns_store=False)

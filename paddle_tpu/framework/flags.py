@@ -71,6 +71,8 @@ define_flag("allocator_strategy", "auto_growth",
             "kept for API parity; XLA/PJRT owns TPU memory")
 define_flag("log_level", 0, "VLOG-style verbosity")
 define_flag("cudnn_deterministic", False, "API parity; XLA is deterministic")
+define_flag("enable_signal_handler", True,
+            "install faulthandler-based crash/TERM stack dumps at init")
 define_flag("embedding_deterministic", 0, "API parity")
 
 if os.environ.get("FLAGS_check_nan_inf"):

@@ -1,0 +1,97 @@
+"""glog-style logging + crash handlers (upstream:
+paddle/fluid/platform/init.cc InitGLOG/InitSignalHandler — VLOG(n)
+tiers gated by GLOG_v, signal handlers that dump a stack trace).
+
+Python-native equivalents:
+  * ``VLOG(n, msg)`` — emitted when n <= GLOG_v (env, default 0);
+    per-module tiers via GLOG_vmodule="pattern=level,...";
+  * ``install_signal_handlers()`` — faulthandler on SIGSEGV/SIGABRT/
+    SIGBUS/SIGFPE + a SIGTERM python-stack dump, the role of the
+    reference's C++ stack-trace printer. Installed at import by
+    default; FLAGS_enable_signal_handler=0 opts out.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_logger = logging.getLogger("paddle_tpu")
+if not _logger.handlers:
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter(
+        "%(levelname).1s[%(asctime)s %(name)s] %(message)s",
+        datefmt="%m%d %H:%M:%S",
+    ))
+    _logger.addHandler(h)
+    _logger.setLevel(logging.INFO)
+
+_GLOG_V = int(os.environ.get("GLOG_v", "0") or 0)
+_VMODULE = {}
+for part in (os.environ.get("GLOG_vmodule", "") or "").split(","):
+    if "=" in part:
+        mod, lvl = part.split("=", 1)
+        try:
+            _VMODULE[mod.strip()] = int(lvl)
+        except ValueError:
+            pass
+
+
+def vlog_level(module: str = "") -> int:
+    for pat, lvl in _VMODULE.items():
+        if pat and pat in module:
+            return lvl
+    return _GLOG_V
+
+
+def VLOG(level: int, msg: str, *args, module: str = ""):
+    """Verbose log tier n: shown when n <= GLOG_v (or the module's
+    GLOG_vmodule override)."""
+    if level <= vlog_level(module):
+        _logger.info("VLOG(%d) %s", level, msg % args if args else msg)
+
+
+vlog = VLOG
+
+
+def LOG(severity: str, msg: str, *args):
+    getattr(_logger, severity.lower(), _logger.info)(
+        msg % args if args else msg
+    )
+
+
+_installed = False
+
+
+def install_signal_handlers():
+    """faulthandler for fatal signals + SIGTERM stack dump (the
+    reference prints C++ frames; we dump every python thread)."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    import faulthandler
+    import signal
+    import threading
+
+    try:
+        faulthandler.enable(all_threads=True)
+    except Exception:
+        return
+
+    def _dump(signum, frame):
+        sys.stderr.write(
+            f"\n*** paddle_tpu: received signal {signum}; "
+            "python stacks of all threads: ***\n"
+        )
+        faulthandler.dump_traceback(all_threads=True)
+        # then terminate with default behavior
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    # only the main thread may set signal handlers
+    if threading.current_thread() is threading.main_thread():
+        try:
+            signal.signal(signal.SIGTERM, _dump)
+        except (ValueError, OSError):
+            pass

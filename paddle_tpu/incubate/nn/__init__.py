@@ -64,7 +64,14 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
                                ln_epsilon=1e-5, training=True,
                                num_heads=None, name=None):
     """One fused attention block (upstream: fused_attention_op).
-    x: [B, S, E]; qkv_weight: [3, H, D, E] (reference layout)."""
+    x: [B, S, E]; qkv_weight: [3, H, D, E] (reference layout).
+    Attention is bidirectional like the upstream op (mask via
+    attn_mask); use FusedMultiTransformer for causal decoder stacks."""
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention cache_kv: use "
+            "FusedMultiTransformer's caches/time_step decode path"
+        )
     x = _as_tensor(x)
     qkv_w = _as_tensor(qkv_weight)
     lin_w = _as_tensor(linear_weight)
@@ -74,6 +81,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         it = iter(extras)
         pre_s = next(it) if pre_ln_scale is not None else None
         mask = next(it) if attn_mask is not None else None
+        qkv_b = next(it) if qkv_bias is not None else None
+        lin_b = next(it) if linear_bias is not None else None
         b, s, _ = xr.shape
         hidden = xr
         if pre_layer_norm:
@@ -83,9 +92,11 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             if pre_s is not None:
                 hidden = hidden * pre_s
         qkv = jnp.einsum("bse,thde->bsthd", hidden, qkvw)
+        if qkv_b is not None:
+            qkv = qkv + qkv_b.reshape(1, 1, 3, h, d)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if mask is None:
-            out = _flash(q, k, v, causal=True,
+            out = _flash(q, k, v, causal=False,
                          sm_scale=1.0 / math.sqrt(d))
         else:
             # explicit mask (reference: attn_mask added to the logits;
@@ -103,6 +114,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             ).astype(xr.dtype)
         out = out.reshape(b, s, h * d)
         out = jnp.einsum("bsf,fe->bse", out, linw.reshape(h * d, e))
+        if lin_b is not None:
+            out = out + lin_b
         out = xr + out  # residual
         if not pre_layer_norm:
             mu = jnp.mean(out, -1, keepdims=True)
@@ -110,7 +123,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             out = (out - mu) * jax.lax.rsqrt(var + ln_epsilon)
         return out
 
-    extras = [t for t in (pre_ln_scale, attn_mask) if t is not None]
+    extras = [t for t in (pre_ln_scale, attn_mask, qkv_bias, linear_bias)
+              if t is not None]
     return apply_op("fused_multi_head_attention", f, x, qkv_w, lin_w,
                     *[_as_tensor(t) for t in extras])
 
@@ -128,21 +142,45 @@ def fused_feedforward(x, linear1_weight, linear2_weight,
     w2 = _as_tensor(linear2_weight)
     act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
 
-    def f(xr, w1r, w2r):
+    def f(xr, w1r, w2r, *extras):
+        it = iter(extras)
+        b1 = next(it) if linear1_bias is not None else None
+        b2 = next(it) if linear2_bias is not None else None
+        s1 = next(it) if ln1_scale is not None else None
+        sb1 = next(it) if ln1_bias is not None else None
+        s2 = next(it) if ln2_scale is not None else None
+        sb2 = next(it) if ln2_bias is not None else None
         hidden = xr
         if pre_layer_norm:
             mu = jnp.mean(hidden, -1, keepdims=True)
             var = jnp.var(hidden, -1, keepdims=True)
             hidden = (hidden - mu) * jax.lax.rsqrt(var + ln1_epsilon)
-        hidden = act(hidden @ w1r) @ w2r
+            if s1 is not None:
+                hidden = hidden * s1
+            if sb1 is not None:
+                hidden = hidden + sb1
+        hidden = hidden @ w1r
+        if b1 is not None:
+            hidden = hidden + b1
+        hidden = act(hidden) @ w2r
+        if b2 is not None:
+            hidden = hidden + b2
         out = xr + hidden
         if not pre_layer_norm:
             mu = jnp.mean(out, -1, keepdims=True)
             var = jnp.var(out, -1, keepdims=True)
             out = (out - mu) * jax.lax.rsqrt(var + ln2_epsilon)
+            if s2 is not None:
+                out = out * s2
+            if sb2 is not None:
+                out = out + sb2
         return out
 
-    return apply_op("fused_feedforward", f, x, w1, w2)
+    extras = [t for t in (linear1_bias, linear2_bias, ln1_scale,
+                          ln1_bias, ln2_scale, ln2_bias)
+              if t is not None]
+    return apply_op("fused_feedforward", f, x, w1, w2,
+                    *[_as_tensor(t) for t in extras])
 
 
 class FusedMultiTransformer(Layer):

@@ -319,7 +319,8 @@ class _LoaderIter:
             try:
                 init(wid)
             except Exception as e:
-                self.queue.put((0, e))
+                # dedicated sentinel seq — must not collide with batch 0
+                self.queue.put((-1, e))
                 self.queue.put((None, None))
                 return
         while not self._stop.is_set():
@@ -364,6 +365,8 @@ class _LoaderIter:
             if seq is None:
                 self._sentinel_count += 1
                 continue
+            if seq == -1:  # worker_init_fn failure
+                raise RuntimeError(f"worker_init_fn failed: {item!r}")
             self._reorder[seq] = item
 
     def __iter__(self):

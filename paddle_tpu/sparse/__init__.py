@@ -163,6 +163,10 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
 
         val = val.astype(to_np_dtype(dtype))
     if shape is None:
+        if idx.shape[1] == 0:
+            raise ValueError(
+                "shape is required for an empty (nnz==0) sparse tensor"
+            )
         shape = tuple(int(m) + 1 for m in idx.max(axis=1)) + \
             tuple(val.shape[1:])
     mat = jsparse.BCOO((jnp.asarray(val), jnp.asarray(idx.T)),

@@ -1,0 +1,151 @@
+"""RPC agent, VLOG tiers, signal-handler install, async collective
+Task (upstream: python/paddle/distributed/rpc, platform/init.cc,
+ProcessGroup::Task)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _double(x):
+    return x * 2
+
+
+def _add(a, b=0):
+    return a + b
+
+
+def _fail():
+    raise ValueError("remote boom")
+
+
+class TestRpcLoopback:
+    def test_sync_async_and_worker_info(self):
+        from paddle_tpu.distributed import rpc
+
+        info = rpc.init_rpc("worker0")
+        try:
+            assert rpc.get_worker_info().name == "worker0"
+            assert rpc.get_worker_info("worker0").port == info.port
+            assert [w.name for w in rpc.get_all_worker_infos()] == \
+                ["worker0"]
+            assert rpc.rpc_sync("worker0", _double, args=(21,)) == 42
+            fut = rpc.rpc_async("worker0", _add, args=(1,),
+                                kwargs={"b": 2})
+            assert fut.wait(timeout=30) == 3
+            with pytest.raises(RuntimeError, match="failed remotely"):
+                rpc.rpc_sync("worker0", _fail)
+        finally:
+            rpc.shutdown()
+
+    def test_two_process_rpc(self, tmp_path):
+        script = tmp_path / "rpc_worker.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            from paddle_tpu.distributed import rpc
+
+            def whoami():
+                return (rpc.get_worker_info().name, os.getpid())
+
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            rpc.init_rpc(f"worker{rank}")
+            if rank == 0:
+                name, pid = rpc.rpc_sync("worker1", whoami)
+                assert name == "worker1" and pid != os.getpid()
+                print("RPC_OK", flush=True)
+            else:
+                import time
+                time.sleep(3)  # serve until rank0 is done
+            rpc.shutdown()
+        """))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--log_dir", str(tmp_path / "log"),
+             "--nproc_per_node", "2", str(script)],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=300,
+        )
+        assert r.returncode == 0, r.stderr[-800:]
+        log0 = (tmp_path / "log" / "workerlog.0").read_text()
+        assert "RPC_OK" in log0
+
+
+class TestVlog:
+    def test_tier_gating(self, caplog):
+        import logging
+
+        from paddle_tpu.framework import log
+
+        old = log._GLOG_V
+        log._GLOG_V = 2
+        try:
+            with caplog.at_level(logging.INFO, logger="paddle_tpu"):
+                log.VLOG(1, "shown %d", 1)
+                log.VLOG(3, "hidden")
+        finally:
+            log._GLOG_V = old
+        text = caplog.text
+        assert "shown 1" in text and "hidden" not in text
+
+    def test_vmodule_override(self):
+        from paddle_tpu.framework import log
+
+        log._VMODULE["mymod"] = 5
+        try:
+            assert log.vlog_level("paddle_tpu.mymod.sub") == 5
+            assert log.vlog_level("other") == log._GLOG_V
+        finally:
+            log._VMODULE.pop("mymod")
+
+    def test_signal_handlers_installed_flag(self):
+        # import-time install happened (enable_signal_handler default)
+        import faulthandler
+
+        assert faulthandler.is_enabled()
+
+
+class TestAsyncCollectiveTask:
+    def test_all_reduce_async_returns_task(self):
+        import jax
+
+        from paddle_tpu.distributed.mesh import (
+            build_global_mesh, manual_axes, reset_mesh,
+        )
+        from paddle_tpu.framework.core import Tensor
+
+        reset_mesh()
+        mesh = build_global_mesh(("x",), (4,))
+        g = dist.new_group(axis_names=("x",))
+        spec = jax.sharding.PartitionSpec("x")
+
+        def body(local):
+            with manual_axes(("x",)):
+                t = Tensor(local)
+                task = dist.all_reduce(t, group=g, sync_op=False)
+                assert type(task).__name__ == "CollectiveTask"
+                assert task.wait() is True
+                assert task.is_completed()
+                return t._data
+
+        out = jax.shard_map(
+            body, mesh=mesh, in_specs=(spec,), out_specs=spec
+        )(np.arange(8, dtype=np.float32))
+        got = np.asarray(out)
+        reset_mesh()
+        # psum over 4 shards of [0..7]: every pair sums across shards
+        want = np.tile(
+            np.arange(8, dtype=np.float32).reshape(4, 2).sum(0), 4
+        )
+        np.testing.assert_allclose(got, want)
