@@ -148,19 +148,15 @@ class CollectiveTask:
     def wait(self, timeout=None):
         data = getattr(self._tensor, "_data", None)
         if data is not None and hasattr(data, "block_until_ready"):
-            try:
-                data.block_until_ready()
-            except Exception:
-                pass
+            # execution errors (OOM, poisoned buffer) propagate —
+            # upstream Task::Wait does the same
+            data.block_until_ready()
         return True
 
     def is_completed(self):
         data = getattr(self._tensor, "_data", None)
         if data is not None and hasattr(data, "is_ready"):
-            try:
-                return bool(data.is_ready())
-            except Exception:
-                return True
+            return bool(data.is_ready())
         return True
 
     def synchronize(self):
@@ -216,8 +212,10 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             from ..tensor.manipulation import unbind
 
             tensor_list.extend(unbind(out, axis=0))
-            return tensor_list
-        return out
+            return tensor_list if sync_op else CollectiveTask(
+                tensor_list[-1]
+            )
+        return _maybe_task(out, sync_op)
     if isinstance(tensor_list, list):
         for _ in range(g.nranks):
             tensor_list.append(tensor.clone())
@@ -294,8 +292,10 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
             from ..tensor.manipulation import unbind
 
             gather_list.extend(unbind(out, axis=0))
-            return gather_list
-        return out
+            return gather_list if sync_op else CollectiveTask(
+                gather_list[-1]
+            )
+        return _maybe_task(out, sync_op)
     raise RuntimeError(
         "gather across a real group requires a manual (shard_map) "
         "context; in the GSPMD context use sharding annotations instead"
@@ -331,7 +331,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
             return gathered[src, idx]
 
         out = apply_op("c_scatter", fn, stacked)
-        return _inplace(tensor, out)
+        _inplace(tensor, out)
+        return _maybe_task(tensor, sync_op)
     raise RuntimeError(
         "scatter across a real group requires a manual (shard_map) "
         "context and a tensor_list; in the GSPMD context use sharding "
@@ -363,7 +364,9 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
             stacked,
         )
         out_tensor_list.extend(split(out, g.nranks, axis=0))
-        return out_tensor_list
+        return out_tensor_list if sync_op else CollectiveTask(
+            out_tensor_list[-1]
+        )
     raise RuntimeError(
         "alltoall across a real group requires a manual (shard_map) "
         "context (silent clone would be a wrong answer); wrap the "
@@ -391,7 +394,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
         )
         out_tensor._data = out._data
         out_tensor._grad_node = out._grad_node
-        return out_tensor
+        return _maybe_task(out_tensor, sync_op)
     raise RuntimeError(
         "alltoall_single across a real group requires a manual "
         "(shard_map) context (silent copy would be a wrong answer)"

@@ -85,9 +85,17 @@ class _Handler(socketserver.BaseRequestHandler):
             try:
                 result = fn(*args, **kwargs)
                 resp = ("ok", result)
-            except Exception as e:  # executed-function error -> caller
-                resp = ("err", (e, traceback.format_exc()))
-            _send_msg(self.request, pickle.dumps(resp))
+            except Exception:  # executed-function error -> caller
+                # string-only payload: the exception object itself may
+                # be unpicklable, which would drop the diagnostic
+                resp = ("err", traceback.format_exc())
+            try:
+                payload = pickle.dumps(resp)
+            except Exception as e:
+                payload = pickle.dumps(
+                    ("err", f"rpc result not picklable: {e!r}")
+                )
+            _send_msg(self.request, payload)
         except (ConnectionError, EOFError):
             pass
 
@@ -111,7 +119,12 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         else rank
     world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
         if world_size is None else world_size
-    ep = master_endpoint or os.environ.get("PADDLE_MASTER")
+    ep = master_endpoint
+    if ep is None and os.environ.get("PADDLE_MASTER"):
+        # never reuse the launch controller's live store port — offset
+        # to a dedicated rpc rendezvous port on the same master host
+        h0, p0 = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+        ep = f"{h0}:{int(p0) + 2000}"
     if ep is None:
         # single-node launch sets only PADDLE_TRAINER_ENDPOINTS; every
         # rank derives the same store endpoint from trainer 0's
@@ -196,10 +209,7 @@ def _call(to, fn, args, kwargs, timeout):
         _send_msg(s, pickle.dumps((fn, args or (), kwargs or {})))
         status, payload = pickle.loads(_recv_msg(s))
     if status == "err":
-        exc, tb = payload
-        raise RuntimeError(
-            f"rpc to {to} failed remotely:\n{tb}"
-        ) from exc
+        raise RuntimeError(f"rpc to {to} failed remotely:\n{payload}")
     return payload
 
 

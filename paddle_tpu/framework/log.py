@@ -26,7 +26,10 @@ if not _logger.handlers:
     _logger.addHandler(h)
     _logger.setLevel(logging.INFO)
 
-_GLOG_V = int(os.environ.get("GLOG_v", "0") or 0)
+try:
+    _GLOG_V = int(os.environ.get("GLOG_v", "0") or 0)
+except ValueError:  # glog tolerates malformed values; so do we
+    _GLOG_V = 0
 _VMODULE = {}
 for part in (os.environ.get("GLOG_vmodule", "") or "").split(","):
     if "=" in part:

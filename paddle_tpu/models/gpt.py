@@ -161,6 +161,43 @@ class GPTAttention(Layer):
         )
         return self.out_proj(out)
 
+    def decode_step(self, x, cache_k, cache_v, pos):
+        """KV-cache incremental attention (see LlamaAttention.decode_step
+        — same static-cache idiom; upstream analog:
+        fused_multi_transformer_op.cu decode)."""
+        import jax
+
+        b, s = x.shape[0], x.shape[1]
+        nh, hd = self.num_heads, self.head_dim
+        qkv = self.qkv_proj(x)
+
+        def f(qkvr, ck, cv, p):
+            smax = ck.shape[1]
+            r = qkvr.reshape(b, s, nh, 3, hd)
+            q, k, v = r[:, :, :, 0], r[:, :, :, 1], r[:, :, :, 2]
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, p, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, p, 0, 0))
+            scale = 1.0 / (hd ** 0.5)
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                ck.astype(jnp.float32)) * scale
+            positions = p + jnp.arange(s, dtype=jnp.int32)
+            kpos = jnp.arange(smax, dtype=jnp.int32)
+            mask = kpos[None, :] <= positions[:, None]
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum(
+                "bhqk,bkhd->bqhd", probs, cv.astype(jnp.float32)
+            ).astype(qkvr.dtype)
+            return out.reshape(b, s, nh * hd), ck, cv
+
+        out, nk, nv = apply_op(
+            "gpt_decode_attn", f, qkv, cache_k, cache_v, pos, n_outs=3
+        )
+        return self.out_proj(out), nk, nv
+
 
 class GPTMLP(Layer):
     def __init__(self, config: GPTConfig):
@@ -217,6 +254,13 @@ class GPTDecoderLayer(Layer):
         h = x + self.dropout(self.attn(self.ln_1(x)))
         return h + self.dropout(self.mlp(self.ln_2(h)))
 
+    def decode_step(self, x, cache_k, cache_v, pos):
+        attn_out, nk, nv = self.attn.decode_step(
+            self.ln_1(x), cache_k, cache_v, pos
+        )
+        h = x + attn_out
+        return h + self.mlp(self.ln_2(h)), nk, nv
+
     def moe_loss(self):
         if self.is_moe and self.mlp.gate.loss is not None:
             return self.mlp.gate.get_loss()
@@ -233,13 +277,22 @@ class _GPTEmbedding(Layer):
         self.wpe = Embedding(max_positions, hidden_size)
         self.drop = Dropout(dropout)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, pos_offset=None):
         s = input_ids.shape[1]
-        pos = apply_op(
-            "gpt_positions",
-            lambda ids: jnp.arange(s, dtype=jnp.int32)[None, :],
-            input_ids, differentiable=False,
-        )
+        if pos_offset is None:
+            pos = apply_op(
+                "gpt_positions",
+                lambda ids: jnp.arange(s, dtype=jnp.int32)[None, :],
+                input_ids, differentiable=False,
+            )
+        else:
+            pos = apply_op(
+                "gpt_positions_off",
+                lambda ids, p: (
+                    p + jnp.arange(s, dtype=jnp.int32)
+                )[None, :],
+                input_ids, pos_offset, differentiable=False,
+            )
         return self.drop(self.wte(input_ids) + self.wpe(pos))
 
 
@@ -279,6 +332,14 @@ class GPTModel(Layer):
                 h = l(h)
         return self.ln_f(h)
 
+    def decode_step(self, input_ids, caches, pos):
+        h = self.embedding(input_ids, pos_offset=pos)
+        new_caches = []
+        for l, (ck, cv) in zip(self.h, caches):
+            h, nk, nv = l.decode_step(h, ck, cv, pos)
+            new_caches.append((nk, nv))
+        return self.ln_f(h), new_caches
+
 
 _warned_moe_recompute = False
 
@@ -299,11 +360,7 @@ class GPTForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.gpt(input_ids)
-        if self.config.tie_word_embeddings:
-            w = self.gpt.wte.weight
-            logits = apply_op("tied_lm_head", lambda a, b: a @ b.T, h, w)
-        else:
-            logits = self.lm_head(h)
+        logits = self._head(h)
         if labels is None:
             return logits
         loss = self.criterion(logits, labels)
@@ -332,6 +389,67 @@ class GPTForCausalLM(Layer):
                 if aux is not None:
                     loss = loss + self.config.moe_aux_loss_weight * aux
         return logits, loss
+
+    # -- decode / serving (mirror of LlamaForCausalLM's) -------------------
+
+    def _head(self, h):
+        if self.config.tie_word_embeddings:
+            w = self.gpt.wte.weight
+            return apply_op("tied_lm_head", lambda a, b: a @ b.T, h, w)
+        return self.lm_head(h)
+
+    def init_cache(self, batch_size, max_length, dtype=None):
+        from ..framework.core import Tensor
+
+        cfg = self.config
+        if dtype is None:
+            dtype = self.gpt.wte.weight._data.dtype
+        shape = (batch_size, max_length, cfg.num_attention_heads,
+                 cfg.head_dim)
+        return [
+            (Tensor(jnp.zeros(shape, dtype)),
+             Tensor(jnp.zeros(shape, dtype)))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+    def decode_step(self, input_ids, caches, pos):
+        h, new_caches = self.gpt.decode_step(input_ids, caches, pos)
+        return self._head(h), new_caches
+
+    def generate(self, input_ids, max_new_tokens=32, use_jit=False):
+        """Greedy KV-cache decode (see LlamaForCausalLM.generate)."""
+        import numpy as np
+
+        from ..framework.core import no_grad
+        from ..tensor.creation import to_tensor
+        from ..tensor.manipulation import concat
+
+        with no_grad():
+            b, s0 = input_ids.shape
+            caches = self.init_cache(b, s0 + max_new_tokens)
+            step = self.decode_step
+            if use_jit:
+                from .. import jit as _jit
+
+                step = _jit.to_static(self.decode_step)
+
+            def pick(logits):
+                return apply_op(
+                    "greedy_pick",
+                    lambda l: jnp.argmax(
+                        l[:, -1].astype(jnp.float32), axis=-1
+                    )[:, None].astype(jnp.int32),
+                    logits,
+                )
+
+            tokens = [input_ids]
+            cur = input_ids
+            for i in range(max_new_tokens):
+                pos = to_tensor(np.int32(0 if i == 0 else s0 + i - 1))
+                logits, caches = step(cur, caches, pos)
+                cur = pick(logits)
+                tokens.append(cur)
+            return concat(tokens, axis=1)
 
 
 # -- pipeline form ----------------------------------------------------------

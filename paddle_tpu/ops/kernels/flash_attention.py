@@ -437,22 +437,42 @@ def _pallas_ok(q, k, block_q, block_k):
 
     bh, sq, d = q.shape
     sk = k.shape[1]
+    # head dims that aren't lane-multiples (e.g. 64 — GPT-3 1.3B) are
+    # zero-padded to 128 before the kernel (_pad_head_dim): zeros are
+    # inert in QK^T and PV, so results are exact. Cost: the d-dim
+    # matmuls run at 128/d of their useful FLOPs — still far better
+    # than the O(S^2)-memory XLA fallback at training lengths.
     return (
         use_pallas()
-        and d % 128 == 0
         and sq % min(block_q, sq) == 0
         and sk % min(block_k, sk) == 0
         and sq >= 8 and sk >= 8
     )
 
 
+def _pad_head_dim(arrs, d):
+    """Zero-pad the trailing head dim to the 128-lane multiple."""
+    target = -(-d // _LANE) * _LANE
+    if target == d:
+        return arrs
+    return tuple(
+        jnp.pad(a, ((0, 0), (0, 0), (0, target - d))) for a in arrs
+    )
+
+
 def _flash_bwd_dispatch(q, k, v, out, lse, do, causal, scale,
                         block_q, block_k, dlse=None):
     if _pallas_ok(q, k, block_q, block_k):
-        return _flash_bwd_pallas(
-            q, k, v, out, lse, do, causal, scale, block_q, block_k,
+        d = q.shape[-1]
+        qp, outp, dop = _pad_head_dim((q, out, do), d)
+        kp, vp = _pad_head_dim((k, v), d)
+        dq, dk, dv = _flash_bwd_pallas(
+            qp, kp, vp, outp, lse, dop, causal, scale, block_q, block_k,
             dlse=dlse,
         )
+        if dq.shape[-1] != d:
+            dq, dk, dv = dq[..., :d], dk[..., :d], dv[..., :d]
+        return dq, dk, dv
     return _flash_bwd_chunked(
         q, k, v, out, lse, do, causal, scale, block_k, dlse=dlse
     )
@@ -466,7 +486,15 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k):
 
 def _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k):
     if _pallas_ok(q, k, block_q, block_k):
-        return _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k)
+        d = q.shape[-1]
+        (qp,) = _pad_head_dim((q,), d)
+        kp, vp = _pad_head_dim((k, v), d)
+        out, lse = _flash_fwd_pallas(
+            qp, kp, vp, causal, scale, block_q, block_k
+        )
+        if out.shape[-1] != d:
+            out = out[..., :d]
+        return out, lse
     return _flash_fwd_ref(q, k, v, causal, scale)
 
 

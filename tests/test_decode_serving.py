@@ -125,3 +125,45 @@ class TestStableHLOExport:
             np.testing.assert_allclose(
                 loaded(x).numpy(), ref, atol=1e-5
             )
+
+
+class TestGPTDecode:
+    def test_gpt_incremental_matches_full(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+        paddle.seed(17)
+        cfg = gpt_tiny(dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        rng = np.random.RandomState(0)
+        b, s = 2, 7
+        x = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (b, s)).astype("int32"))
+        full = model(x).numpy()
+        caches = model.init_cache(b, s)
+        xs = x.numpy()
+        for t in range(s):
+            logits, caches = model.decode_step(
+                paddle.to_tensor(xs[:, t:t + 1]), caches,
+                paddle.to_tensor(np.int32(t)))
+            np.testing.assert_allclose(
+                logits.numpy()[:, 0], full[:, t], atol=3e-4, rtol=3e-4,
+                err_msg=f"step {t}")
+
+    def test_gpt_generate_matches_no_cache_loop(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+        paddle.seed(19)
+        cfg = gpt_tiny(dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (1, 4)).astype("int32"))
+        ids = x.numpy()
+        for _ in range(5):
+            logits = model(paddle.to_tensor(ids)).numpy()
+            nxt = logits[:, -1].argmax(-1).astype("int32")[:, None]
+            ids = np.concatenate([ids, nxt], axis=1)
+        got = model.generate(x, max_new_tokens=5).numpy()
+        np.testing.assert_array_equal(got, ids)

@@ -57,13 +57,15 @@ class TestRpcLoopback:
 
             rank = int(os.environ["PADDLE_TRAINER_ID"])
             rpc.init_rpc(f"worker{rank}")
+            from paddle_tpu.distributed.rpc import _state
             if rank == 0:
                 name, pid = rpc.rpc_sync("worker1", whoami)
                 assert name == "worker1" and pid != os.getpid()
+                _state["store"].set("rpc_test_done", b"1")
                 print("RPC_OK", flush=True)
             else:
-                import time
-                time.sleep(3)  # serve until rank0 is done
+                # serve until rank0 confirms (no sleep race)
+                _state["store"].wait(["rpc_test_done"], timeout=120)
             rpc.shutdown()
         """))
         env = dict(os.environ)

@@ -68,11 +68,11 @@ def check(bh, bhkv, sq, sk, d, causal, block_q, block_k, dtype, tol):
     out_r, dq_r, dk_r, dv_r = ref_np(*f64, causal, scale)
 
     out, lse = jax.jit(
-        lambda q, k, v: fa._flash_fwd_pallas(
+        lambda q, k, v: fa._flash_fwd_dispatch(
             q, k, v, causal, scale, block_q, block_k)
     )(q, k, v)
     dq_p, dk_p, dv_p = jax.jit(
-        lambda q, k, v, out, lse, do: fa._flash_bwd_pallas(
+        lambda q, k, v, out, lse, do: fa._flash_bwd_dispatch(
             q, k, v, out, lse, do, causal, scale, block_q, block_k)
     )(q, k, v, out, lse, do)
 
@@ -96,6 +96,9 @@ def main():
         (4, 4, 512, 2048, 128, True, 256, 512, jnp.float32, 1e-4),
         (4, 4, 2048, 2048, 128, True, 512, 512, jnp.bfloat16, 3e-2),
         (8, 8, 256, 256, 256, True, 256, 256, jnp.float32, 1e-4),
+        # padded head dims (gate widening: d=64 GPT-3-style heads)
+        (4, 4, 1024, 1024, 64, True, 512, 512, jnp.float32, 1e-4),
+        (4, 4, 1024, 1024, 64, True, 512, 512, jnp.bfloat16, 3e-2),
     ]
     all_ok = True
     for c in cases:
