@@ -89,6 +89,49 @@ def _timed(step, x, y, steps):
 # ---------------------------------------------------------------------------
 
 
+def _flash_bwd_sanity():
+    """On-chip guard: the Pallas flash backward must agree with the
+    chunked-XLA backward on a small case, else fall back (protects the
+    headline from an unvalidated-kernel regression)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.kernels import flash_attention as fa
+
+    try:
+        rng = np.random.RandomState(0)
+        # seq 512 with 256-blocks: 2x2 block grid, so the cross-block
+        # VMEM accumulation and final-flush paths are exercised
+        q = jnp.asarray(rng.randn(2, 512, 128), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(2, 512, 128), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(2, 512, 128), jnp.bfloat16)
+        do = jnp.asarray(rng.randn(2, 512, 128), jnp.bfloat16)
+        out, lse = jax.jit(
+            lambda a, b, c: fa._flash_fwd_pallas(
+                a, b, c, True, 0.088, 256, 256)
+        )(q, k, v)
+        dq_p, dk_p, dv_p = jax.jit(
+            lambda *a: fa._flash_bwd_pallas(*a, True, 0.088, 256, 256)
+        )(q, k, v, out, lse, do)
+        dq_r, dk_r, dv_r = jax.jit(
+            lambda *a: fa._flash_bwd_chunked(*a, True, 0.088, 256)
+        )(q, k, v, out, lse, do)
+        for p, r in ((dq_p, dq_r), (dk_p, dk_r), (dv_p, dv_r)):
+            err = float(jnp.max(jnp.abs(
+                p.astype(jnp.float32) - r.astype(jnp.float32))))
+            ref = float(jnp.max(jnp.abs(r.astype(jnp.float32)))) + 1e-6
+            if err / ref > 5e-2:
+                raise AssertionError(f"bwd mismatch {err / ref:.3e}")
+        return True
+    except Exception as e:
+        print(json.dumps({"warn": "pallas flash bwd sanity failed; "
+                          "using chunked XLA bwd",
+                          "detail": str(e)[:200]}), flush=True)
+        paddle.set_flags({"FLAGS_use_pallas_flash_bwd": False})
+        return False
+
+
 def bench_llama_headline(dry=False, steps=10, seq=2048, batch=8):
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as optim
@@ -96,6 +139,8 @@ def bench_llama_headline(dry=False, steps=10, seq=2048, batch=8):
 
     kind = _device_kind()
     on_tpu = not kind.startswith("cpu")
+    if on_tpu and not dry:
+        _flash_bwd_sanity()
     if dry:
         cfg = llama_tiny()
         seq, batch, steps = 128, 2, 3

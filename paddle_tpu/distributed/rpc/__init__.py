@@ -155,13 +155,18 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
                          world_size=world_size)
         _state["owns_store"] = rank == 0
     me = WorkerInfo(name, rank, my_ip, my_port)
-    store.set(f"rpc/worker/{rank}",
+    # scope keys by job id + restart generation so stale entries from a
+    # previous launch/elastic generation can't alias this rendezvous
+    gen = os.environ.get("PADDLE_RESTART_GENERATION", "0")
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    prefix = f"rpc/{job}/{gen}"
+    store.set(f"{prefix}/worker/{rank}",
               {"name": name, "rank": rank, "ip": me.ip, "port": my_port})
-    store.wait([f"rpc/worker/{r}" for r in range(world_size)],
+    store.wait([f"{prefix}/worker/{r}" for r in range(world_size)],
                timeout=300)
     workers = {}
     for r in range(world_size):
-        info = store.get(f"rpc/worker/{r}")
+        info = store.get(f"{prefix}/worker/{r}")
         w = WorkerInfo(info["name"], info["rank"], info["ip"],
                        info["port"])
         workers[w.name] = w

@@ -35,20 +35,27 @@ from .dtype import DType, convert_dtype, to_np_dtype
 # global eager state
 # --------------------------------------------------------------------------
 
-_UID = itertools.count()
+_UID = itertools.count()          # identity: unique for process lifetime
+_TENSOR_NAME = itertools.count()  # auto-name counters: resettable
 
 
 def reset_uid(start=0):
-    """Restart the tensor/param auto-name counters. Auto-generated
+    """Restart the tensor/param auto-NAME counters. Auto-generated
     names (``tensor_N``/``param_N``, and optimizer accumulator keys
     derived from them) are deterministic in creation order from a fresh
     counter — process restarts realign naturally; in-process rebuilds
     (tests, elastic relaunch without exec) call this (via
     paddle.utils.unique_name.guard) so checkpoints keyed by name keep
-    matching."""
-    global _UID, _PARAM_UID
-    _UID = itertools.count(start)
-    _PARAM_UID = itertools.count(start)
+    matching.
+
+    The identity counter ``_UID`` is deliberately NOT reset: uids key
+    the state-snapshot dedup and compiled-step cache keys, so they must
+    stay unique for the whole process (a reset would let a rebuilt
+    model's params collide with still-live tensors and silently drop
+    them from compiled state)."""
+    global _TENSOR_NAME, _PARAM_NAME
+    _TENSOR_NAME = itertools.count(start)
+    _PARAM_NAME = itertools.count(start)
 
 
 class _EagerState(threading.local):
@@ -180,7 +187,8 @@ class Tensor:
         self._grad = None
         self._grad_node = None
         self._uid = next(_UID)
-        self.name = name if name is not None else f"tensor_{self._uid}"
+        self.name = name if name is not None else \
+            f"tensor_{next(_TENSOR_NAME)}"
         self.persistable = persistable
         self._version = 0
         self._grad_hooks = None
@@ -353,7 +361,7 @@ def _rebuild_tensor(arr, stop_gradient, name, persistable, is_param):
     return t
 
 
-_PARAM_UID = itertools.count()
+_PARAM_NAME = itertools.count()
 
 
 class EagerParamBase(Tensor):
@@ -369,7 +377,7 @@ class EagerParamBase(Tensor):
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         if name is None:
-            name = f"param_{next(_PARAM_UID)}"
+            name = f"param_{next(_PARAM_NAME)}"
         super().__init__(data, dtype=dtype, stop_gradient=not trainable,
                          name=name, persistable=True)
         self.trainable = trainable

@@ -65,6 +65,9 @@ def flag(name: str):
 define_flag("check_nan_inf", False,
             "check every op output for nan/inf (jax_debug_nans)")
 define_flag("benchmark", False, "benchmark mode: sync after each op")
+define_flag("use_pallas_flash_bwd", True,
+            "use the dedicated Pallas flash-attention backward kernels "
+            "(off -> chunked XLA recompute backward)")
 define_flag("use_pallas_kernels", True,
             "use hand-written Pallas TPU kernels where available")
 define_flag("allocator_strategy", "auto_growth",

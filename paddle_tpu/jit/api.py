@@ -53,8 +53,17 @@ class StaticFunction:
         self._donate = donate_state
 
     def _mode_sig(self):
-        return tuple(
-            sorted((id(l), l.training) for l in _registry.live_layers())
+        # flags are trace-time constants (kernel selection, nan checks):
+        # include them so set_flags() takes effect on the NEXT call via
+        # retrace instead of being silently ignored by the cache
+        from ..framework.flags import _REGISTRY as _flags
+
+        return (
+            tuple(
+                sorted((id(l), l.training)
+                       for l in _registry.live_layers())
+            ),
+            tuple(sorted(_flags.items())),
         )
 
     def __call__(self, *args, **kwargs):

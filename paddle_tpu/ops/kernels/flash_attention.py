@@ -462,7 +462,9 @@ def _pad_head_dim(arrs, d):
 
 def _flash_bwd_dispatch(q, k, v, out, lse, do, causal, scale,
                         block_q, block_k, dlse=None):
-    if _pallas_ok(q, k, block_q, block_k):
+    from ...framework.flags import flag
+
+    if flag("use_pallas_flash_bwd") and _pallas_ok(q, k, block_q, block_k):
         d = q.shape[-1]
         qp, outp, dop = _pad_head_dim((q, out, do), d)
         kp, vp = _pad_head_dim((k, v), d)

@@ -32,13 +32,13 @@ def guard(new_generator=None):
     names (what a process restart does naturally)."""
     from ..framework import core as _core
 
-    old_uid = _core._UID
-    old_param_uid = _core._PARAM_UID
+    old_tname = _core._TENSOR_NAME
+    old_pname = _core._PARAM_NAME
     old = switch(new_generator)
     try:
         yield
     finally:
         global _GENS
         _GENS = old
-        _core._UID = old_uid
-        _core._PARAM_UID = old_param_uid
+        _core._TENSOR_NAME = old_tname
+        _core._PARAM_NAME = old_pname

@@ -20,3 +20,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.devices()  # init the CPU backend single-threaded, up front
+
+
+def reset_dist_state():
+    """Shared teardown for distributed tests: drop the global mesh and
+    hybrid topology (use instead of per-file copies)."""
+    from paddle_tpu.distributed.fleet.base.topology import _set_hcg
+    from paddle_tpu.distributed.mesh import reset_mesh
+
+    reset_mesh()
+    _set_hcg(None)

@@ -56,7 +56,8 @@ class TestRpcLoopback:
                 return (rpc.get_worker_info().name, os.getpid())
 
             rank = int(os.environ["PADDLE_TRAINER_ID"])
-            rpc.init_rpc(f"worker{rank}")
+            rpc.init_rpc(f"worker{rank}",
+                         master_endpoint=os.environ["RPC_TEST_MASTER"])
             from paddle_tpu.distributed.rpc import _state
             if rank == 0:
                 name, pid = rpc.rpc_sync("worker1", whoami)
@@ -68,9 +69,15 @@ class TestRpcLoopback:
                 _state["store"].wait(["rpc_test_done"], timeout=120)
             rpc.shutdown()
         """))
+        import socket
+
+        with socket.socket() as s:  # hermetic: a known-free store port
+            s.bind(("127.0.0.1", 0))
+            free_port = s.getsockname()[1]
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env["JAX_PLATFORMS"] = "cpu"
+        env["RPC_TEST_MASTER"] = f"127.0.0.1:{free_port}"
         env.pop("PALLAS_AXON_POOL_IPS", None)
         r = subprocess.run(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",
