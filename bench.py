@@ -97,7 +97,10 @@ def _flash_bwd_sanity():
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
-    from paddle_tpu.ops.kernels import flash_attention as fa
+    # NB: `paddle_tpu.ops.kernels` re-exports a *function* named
+    # flash_attention, so `from ... import flash_attention` would grab
+    # the function and shadow the submodule — import the module itself.
+    import paddle_tpu.ops.kernels.flash_attention as fa
 
     try:
         rng = np.random.RandomState(0)

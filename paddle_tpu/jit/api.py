@@ -52,10 +52,17 @@ class StaticFunction:
         self._cache = {}
         self._donate = donate_state
 
+    # flags that change what gets traced (kernel selection, nan checks).
+    # Others (allocator_strategy, log_level, ...) are runtime-only: keying
+    # on them would force a full retrace/recompile for a no-op change.
+    _TRACE_FLAGS = (
+        "check_nan_inf", "use_pallas_flash_bwd", "use_pallas_kernels",
+    )
+
     def _mode_sig(self):
-        # flags are trace-time constants (kernel selection, nan checks):
-        # include them so set_flags() takes effect on the NEXT call via
-        # retrace instead of being silently ignored by the cache
+        # trace-relevant flags are part of the cache key so set_flags()
+        # takes effect on the NEXT call via retrace instead of being
+        # silently ignored by the cache
         from ..framework.flags import _REGISTRY as _flags
 
         return (
@@ -63,7 +70,7 @@ class StaticFunction:
                 sorted((id(l), l.training)
                        for l in _registry.live_layers())
             ),
-            tuple(sorted(_flags.items())),
+            tuple((k, _flags[k]) for k in self._TRACE_FLAGS),
         )
 
     def __call__(self, *args, **kwargs):

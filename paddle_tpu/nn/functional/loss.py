@@ -281,7 +281,85 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
     return apply_op("sigmoid_focal_loss", f, *args)
 
 
-def ctc_loss(*args, **kwargs):
-    raise NotImplementedError(
-        "ctc_loss is not yet implemented in paddle_tpu (tracked gap)"
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """Connectionist Temporal Classification loss (upstream:
+    python/paddle/nn/functional/loss.py ctc_loss, which wraps warpctc —
+    paddle/phi/kernels/impl/warpctc_kernel_impl.h).
+
+    TPU-first design: instead of the warp-ctc CUDA kernel, the standard
+    alpha (forward) recursion runs in log space as a ``lax.scan`` over
+    time; the CTC gradient falls out of JAX autodiff through the
+    logsumexp recursion (identical math to warpctc's beta/gradient pass).
+
+    ``log_probs``: (T, N, C) unnormalized logits (softmax applied
+    internally, matching the reference); labels: (N, L) int; returns the
+    per-batch negative log likelihood, reduced per ``reduction``.
+    """
+    log_probs = _as_tensor(log_probs)
+    labels = _as_tensor(labels)
+    input_lengths = _as_tensor(input_lengths)
+    label_lengths = _as_tensor(label_lengths)
+    NEG = -1e30
+
+    def f(lp, lb, il, ll):
+        T, N, C = lp.shape
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        lb = lb.astype(jnp.int32)
+        il = il.astype(jnp.int32)
+        ll = ll.astype(jnp.int32)
+        L = lb.shape[1]
+        S = 2 * L + 1
+        # extended label sequence [blank, l1, blank, l2, ..., blank]
+        ext = jnp.full((N, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lb)
+        ext_prev2 = jnp.concatenate(
+            [jnp.full((N, 2), -1, jnp.int32), ext[:, :-2]], axis=1
+        )
+        allow_skip = (ext != blank) & (ext != ext_prev2)  # (N, S)
+
+        emit0 = jnp.take_along_axis(lp[0], ext, axis=1)  # (N, S)
+        alpha0 = jnp.full((N, S), NEG, jnp.float32)
+        alpha0 = alpha0.at[:, 0].set(emit0[:, 0])
+        if S > 1:
+            alpha0 = alpha0.at[:, 1].set(emit0[:, 1])
+
+        def step(alpha, lp_t):
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            a1 = jnp.concatenate(
+                [jnp.full((N, 1), NEG, jnp.float32), alpha[:, :-1]], axis=1
+            )
+            a2 = jnp.concatenate(
+                [jnp.full((N, 2), NEG, jnp.float32), alpha[:, :-2]], axis=1
+            )
+            a2 = jnp.where(allow_skip, a2, NEG)
+            new = emit + jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T,N,S)
+
+        t_idx = jnp.clip(il - 1, 0, T - 1)
+        a_last = alphas[t_idx, jnp.arange(N)]  # (N, S)
+        s_blank = 2 * ll  # final blank position
+        v1 = jnp.take_along_axis(a_last, s_blank[:, None], axis=1)[:, 0]
+        v2 = jnp.take_along_axis(
+            a_last, jnp.maximum(s_blank - 1, 0)[:, None], axis=1
+        )[:, 0]
+        v2 = jnp.where(ll > 0, v2, NEG)  # empty label: blank-only path
+        loss = -jnp.logaddexp(v1, v2)  # (N,)
+        if norm_by_times:
+            loss = loss / jnp.maximum(il.astype(loss.dtype), 1)
+        if reduction == "mean":
+            # reference semantics: per-sample loss / label_length, then
+            # batch mean
+            return jnp.mean(
+                loss / jnp.maximum(ll.astype(loss.dtype), 1)
+            )
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply_op(
+        "ctc_loss", f, log_probs, labels, input_lengths, label_lengths
     )

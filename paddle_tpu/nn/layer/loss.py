@@ -132,3 +132,20 @@ class TripletMarginLoss(Layer):
 
     def forward(self, input, positive, negative):
         return F.triplet_margin_loss(input, positive, negative, *self._args)
+
+
+class CTCLoss(Layer):
+    """CTC loss layer (upstream: python/paddle/nn/layer/loss.py CTCLoss)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(
+            log_probs, labels, input_lengths, label_lengths,
+            blank=self.blank, reduction=self.reduction,
+            norm_by_times=norm_by_times,
+        )

@@ -194,6 +194,68 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, *args, **kwargs):
+    """Spectral normalization of a weight tensor (upstream:
+    python/paddle/nn/layer/norm.py SpectralNorm, paddle/phi/kernels/
+    impl/spectral_norm_kernel_impl.h). ``forward(weight)`` returns
+    ``weight / sigma_max`` where sigma_max is estimated by power
+    iteration on the (dim, rest)-matricized weight. The u/v estimates
+    persist as buffers, so the iteration warm-starts every call."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: tracked gap")
+        self._dim = int(dim)
+        self._power_iters = int(power_iters)
+        self._eps = float(eps)
+        self._shape = list(weight_shape)
+        h = self._shape[self._dim]
+        w = 1
+        for i, s in enumerate(self._shape):
+            if i != self._dim:
+                w *= s
+        rng = np.random.RandomState(0)
+        self.register_buffer(
+            "weight_u",
+            Tensor(_l2normalize_np(rng.randn(h).astype(dtype), eps)),
+        )
+        self.register_buffer(
+            "weight_v",
+            Tensor(_l2normalize_np(rng.randn(w).astype(dtype), eps)),
+        )
+
+    def forward(self, weight):
+        from ...framework.core import _as_tensor, apply_op
+
+        weight = _as_tensor(weight)
+        perm = [self._dim] + [
+            i for i in range(len(self._shape)) if i != self._dim
+        ]
+        h = self._shape[self._dim]
+
+        def _norm(x):
+            return x / (jnp.linalg.norm(x) + self._eps)
+
+        # power iteration warm-started from the buffers; not part of the
+        # differentiated graph (u/v are treated as constants, matching
+        # the reference kernel's stop-gradient semantics)
+        matf = jnp.transpose(weight._data, perm).reshape(h, -1).astype(
+            jnp.float32
+        )
+        u = self.weight_u._data.astype(jnp.float32)
+        v = self.weight_v._data.astype(jnp.float32)
+        for _ in range(self._power_iters):
+            v = _norm(matf.T @ u)
+            u = _norm(matf @ v)
+        self.weight_u._data = u.astype(self.weight_u._data.dtype)
+        self.weight_v._data = v.astype(self.weight_v._data.dtype)
+
+        def fn(w):
+            mat = jnp.transpose(w, perm).reshape(h, -1).astype(jnp.float32)
+            sigma = u @ mat @ v
+            return w / sigma.astype(w.dtype)
+
+        return apply_op("spectral_norm", fn, weight)
+
+
+def _l2normalize_np(x, eps):
+    return x / (np.linalg.norm(x) + eps)

@@ -1,0 +1,187 @@
+"""Parameter reparameterization & vector utilities.
+
+Upstream analogs: python/paddle/nn/utils/{weight_norm_hook.py,
+spectral_norm_hook.py, transform_parameters.py}. TPU-first design: the
+reparameterized weight is recomputed inside the traced step via a
+forward pre-hook, so under ``to_static`` the norm math fuses into the
+compiled graph (no eager-side mutation of compiled state).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...framework.core import EagerParamBase, Tensor, apply_op
+from ..layer.layers import Layer
+
+__all__ = [
+    "weight_norm",
+    "remove_weight_norm",
+    "spectral_norm",
+    "parameters_to_vector",
+    "vector_to_parameters",
+]
+
+
+def _norm_except_dim(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(w)))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes))
+
+
+def _wn_compute(g, v, dim):
+    """weight = g * v / ||v||  (norms taken over all axes but `dim`)."""
+
+    def fn(g_raw, v_raw):
+        n = _norm_except_dim(v_raw.astype(jnp.float32), dim)
+        if dim is not None:
+            bshape = [1] * v_raw.ndim
+            bshape[dim] = v_raw.shape[dim]
+            n = n.reshape(bshape)
+            g_b = g_raw.astype(jnp.float32).reshape(bshape)
+        else:
+            g_b = g_raw.astype(jnp.float32)
+        return (v_raw.astype(jnp.float32) / n * g_b).astype(v_raw.dtype)
+
+    return apply_op("weight_norm", fn, g, v)
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def __call__(self, layer, inputs):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        setattr(layer, self.name, _wn_compute(g, v, self.dim))
+        return inputs
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Reparameterize ``layer.<name>`` as direction ``v`` and magnitude
+    ``g`` (upstream: python/paddle/nn/utils/weight_norm_hook.py).
+    ``dim=None`` uses a single scalar magnitude."""
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"layer has no parameter '{name}'")
+    w_np = np.asarray(w.numpy(), dtype=np.float32)
+    g0 = _norm_except_dim(jnp.asarray(w_np), dim)
+    g = EagerParamBase(np.asarray(g0), name=(w.name or name) + "_g")
+    v = EagerParamBase(w_np.astype(w.numpy().dtype), name=(w.name or name) + "_v")
+    g.stop_gradient = False
+    v.stop_gradient = False
+    # drop the original parameter; keep the computed weight as a plain
+    # attribute refreshed by the pre-hook
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    hook = _WeightNormHook(name, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handles = getattr(layer, "_weight_norm_handles", {})
+    layer._weight_norm_handles[name] = (handle, hook)
+    hook(layer, ())  # materialize layer.<name> immediately
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    """Fold g/v back into a plain parameter and remove the hook."""
+    handles = getattr(layer, "_weight_norm_handles", {})
+    if name not in handles:
+        raise ValueError(f"weight_norm not applied to '{name}'")
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    handle, hook = handles.pop(name)
+    handle.remove()
+    w = _wn_compute(g, v, hook.dim)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer.__dict__.pop(name, None)
+    p = EagerParamBase(np.asarray(w.numpy()), name=name)
+    p.stop_gradient = False
+    layer.add_parameter(name, p)
+    return layer
+
+
+class _SpectralNormHook:
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.dim = dim
+        self.n_power_iterations = n_power_iterations
+        self.eps = eps
+        self._sn = None
+
+    def __call__(self, layer, inputs):
+        from ..layer.norm import SpectralNorm
+
+        orig = getattr(layer, self.name + "_orig")
+        if self._sn is None:
+            self._sn = SpectralNorm(
+                list(orig.shape), dim=self.dim,
+                power_iters=self.n_power_iterations, eps=self.eps,
+            )
+            # share buffers through the owner so state_dict sees them
+            layer.register_buffer(
+                self.name + "_u", self._sn.weight_u, persistable=True
+            )
+            layer.register_buffer(
+                self.name + "_v", self._sn.weight_v, persistable=True
+            )
+        setattr(layer, self.name, self._sn(orig))
+        return inputs
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int | None = None):
+    """Attach spectral normalization to ``layer.<name>`` (upstream:
+    python/paddle/nn/utils/spectral_norm_hook.py)."""
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"layer has no parameter '{name}'")
+    if dim is None:
+        # Linear keeps output features last; conv keeps them first
+        dim = 1 if type(layer).__name__ in ("Linear",) else 0
+    orig = w
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", orig)
+    hook = _SpectralNormHook(name, n_power_iterations, eps, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_handles = getattr(
+        layer, "_spectral_norm_handles", {}
+    )
+    layer._spectral_norm_handles[name] = handle
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """Flatten-and-concat parameters into one 1-D tensor (upstream:
+    python/paddle/nn/utils/transform_parameters.py)."""
+    params = list(parameters)
+    if not params:
+        raise ValueError("no parameters given")
+
+    def fn(*raws):
+        return jnp.concatenate([r.reshape(-1) for r in raws], axis=0)
+
+    return apply_op("parameters_to_vector", fn, *params)
+
+
+def vector_to_parameters(vec: Tensor, parameters) -> None:
+    """Write slices of ``vec`` back into the parameter tensors."""
+    params = list(parameters)
+    sizes = [int(np.prod(p.shape)) if p.shape else 1 for p in params]
+    total = sum(sizes)
+    if total != vec._data.shape[0]:
+        raise ValueError(
+            f"vector length {vec._data.shape[0]} != total parameter "
+            f"size {total}"
+        )
+    offset = 0
+    for p, n in zip(params, sizes):
+        chunk = vec._data[offset:offset + n].reshape(p.shape)
+        p._data = chunk.astype(p._data.dtype)
+        offset += n

@@ -1,0 +1,139 @@
+"""SpectralNorm / weight_norm / CTC loss / parameter-vector tests
+(upstream analogs: test/legacy_test/test_spectral_norm_op.py,
+test_weight_norm_hook.py, test_ctc_loss.py,
+test_transform_parameters.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn import utils as U
+
+
+def setup_module():
+    paddle.seed(7)
+
+
+class TestSpectralNorm:
+    def test_sigma_max_normalized(self):
+        sn = nn.SpectralNorm([6, 10], dim=0, power_iters=20)
+        w = paddle.to_tensor(
+            np.random.RandomState(3).randn(6, 10).astype("float32"),
+            stop_gradient=False,
+        )
+        out = sn(w)
+        s = np.linalg.svd(out.numpy())[1]
+        np.testing.assert_allclose(s[0], 1.0, atol=1e-3)
+        out.sum().backward()
+        assert w.grad is not None and w.grad.shape == [6, 10]
+
+    def test_buffers_warm_start(self):
+        sn = nn.SpectralNorm([4, 4], dim=0, power_iters=1)
+        w = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 4).astype("float32")
+        )
+        u0 = sn.weight_u.numpy().copy()
+        sn(w)
+        u1 = sn.weight_u.numpy()
+        assert not np.allclose(u0, u1)
+        assert "weight_u" in sn.state_dict()
+
+    def test_hook_wrapper_on_linear(self):
+        lin = nn.Linear(5, 3)
+        U.spectral_norm(lin, n_power_iterations=10)
+        x = paddle.to_tensor(np.random.randn(2, 5).astype("float32"))
+        lin(x)
+        s = np.linalg.svd(lin.weight.numpy())[1][0]
+        np.testing.assert_allclose(s, 1.0, atol=1e-2)
+        assert "weight_orig" in lin.state_dict()
+
+
+class TestWeightNorm:
+    def test_reparam_preserves_weight(self):
+        lin = nn.Linear(6, 4)
+        w0 = lin.weight.numpy().copy()
+        U.weight_norm(lin, dim=0)
+        np.testing.assert_allclose(lin.weight.numpy(), w0, atol=1e-5)
+        x = paddle.to_tensor(np.random.randn(2, 6).astype("float32"))
+        y = lin(x)
+        y.sum().backward()
+        assert lin.weight_g.grad is not None
+        assert lin.weight_v.grad is not None
+
+    def test_remove_restores_parameter(self):
+        lin = nn.Linear(6, 4)
+        w0 = lin.weight.numpy().copy()
+        U.weight_norm(lin, dim=0)
+        U.remove_weight_norm(lin)
+        np.testing.assert_allclose(lin.weight.numpy(), w0, atol=1e-5)
+        assert "weight" in dict(lin.named_parameters())
+        assert "weight_g" not in dict(lin.named_parameters())
+
+    def test_scalar_dim_none(self):
+        lin = nn.Linear(3, 2)
+        w0 = lin.weight.numpy().copy()
+        U.weight_norm(lin, dim=None)
+        assert lin.weight_g.shape in ([], [1])
+        np.testing.assert_allclose(lin.weight.numpy(), w0, atol=1e-5)
+
+
+class TestParamVector:
+    def test_roundtrip(self):
+        m = nn.Sequential(nn.Linear(4, 3), nn.ReLU(), nn.Linear(3, 2))
+        vec = U.parameters_to_vector(m.parameters())
+        n = sum(int(np.prod(p.shape)) for p in m.parameters())
+        assert vec.shape == [n]
+        before = [p.numpy().copy() for p in m.parameters()]
+        U.vector_to_parameters(vec * 2.0, m.parameters())
+        for b, p in zip(before, m.parameters()):
+            np.testing.assert_allclose(p.numpy(), 2.0 * b, rtol=1e-6)
+
+
+class TestCTCLoss:
+    def _case(self):
+        rng = np.random.RandomState(0)
+        T, N, C, L = 12, 3, 7, 4
+        logits = rng.randn(T, N, C).astype("float32")
+        labels = rng.randint(1, C, size=(N, L)).astype("int32")
+        il = np.array([12, 10, 8], "int64")
+        ll = np.array([4, 3, 2], "int64")
+        return logits, labels, il, ll
+
+    def test_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        logits, labels, il, ll = self._case()
+        t = torch.tensor(logits, requires_grad=True)
+        ref = torch.nn.functional.ctc_loss(
+            torch.log_softmax(t, -1),
+            torch.tensor(labels.astype("int64")),
+            torch.tensor(il), torch.tensor(ll),
+            blank=0, reduction="none",
+        )
+        x = paddle.to_tensor(logits, stop_gradient=False)
+        loss = F.ctc_loss(
+            x, paddle.to_tensor(labels), paddle.to_tensor(il),
+            paddle.to_tensor(ll), blank=0, reduction="none",
+        )
+        np.testing.assert_allclose(
+            loss.numpy(), ref.detach().numpy(), rtol=1e-5
+        )
+        ref.sum().backward()
+        loss.sum().backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(), t.grad.numpy(), atol=1e-5
+        )
+
+    def test_reductions_and_layer(self):
+        logits, labels, il, ll = self._case()
+        args = (
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(il), paddle.to_tensor(ll),
+        )
+        none = F.ctc_loss(*args, reduction="none").numpy()
+        mean = F.ctc_loss(*args, reduction="mean").numpy()
+        np.testing.assert_allclose(mean, (none / ll).mean(), rtol=1e-6)
+        layer = nn.CTCLoss(blank=0, reduction="sum")
+        np.testing.assert_allclose(
+            layer(*args).numpy(), none.sum(), rtol=1e-6
+        )
