@@ -114,6 +114,7 @@ def _lazy_imports():
     global distributed, incubate, amp, profiler, vision, callbacks, Model
     global DataParallel, utils, inference, sparse
     from . import utils  # noqa
+    from . import fft  # noqa
     from . import inference  # noqa
     from . import sparse  # noqa
     from . import nn  # noqa

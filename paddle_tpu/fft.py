@@ -1,0 +1,123 @@
+"""Discrete Fourier transforms (upstream: python/paddle/fft.py, which
+wraps paddle/phi/kernels/funcs/fft.h — cuFFT/onemkl backends).
+
+TPU-first design: jnp.fft lowers to XLA's FFT HLO, which runs natively
+on TPU (and is differentiable through JAX's fft JVP/transpose rules), so
+every transform routes through ``apply_op`` like any other tape op. Norm
+conventions ("backward" | "ortho" | "forward") match the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import apply_op, _as_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    if norm not in (None, "backward", "ortho", "forward"):
+        raise ValueError(
+            f"norm must be 'backward', 'ortho' or 'forward', got {norm!r}"
+        )
+    return norm or "backward"
+
+
+def _op1d(opname, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        x = _as_tensor(x)
+        nv = None if n is None else int(n)
+        return apply_op(
+            opname,
+            lambda a: jfn(a, n=nv, axis=int(axis), norm=_norm(norm)),
+            x,
+        )
+
+    op.__name__ = opname
+    return op
+
+
+def _op2d(opname, jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        x = _as_tensor(x)
+        sv = None if s is None else tuple(int(v) for v in s)
+        return apply_op(
+            opname,
+            lambda a: jfn(a, s=sv, axes=tuple(axes), norm=_norm(norm)),
+            x,
+        )
+
+    op.__name__ = opname
+    return op
+
+
+def _opnd(opname, jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        x = _as_tensor(x)
+        sv = None if s is None else tuple(int(v) for v in s)
+        av = None if axes is None else tuple(int(v) for v in axes)
+        return apply_op(
+            opname,
+            lambda a: jfn(a, s=sv, axes=av, norm=_norm(norm)),
+            x,
+        )
+
+    op.__name__ = opname
+    return op
+
+
+fft = _op1d("fft", jnp.fft.fft)
+ifft = _op1d("ifft", jnp.fft.ifft)
+rfft = _op1d("rfft", jnp.fft.rfft)
+irfft = _op1d("irfft", jnp.fft.irfft)
+hfft = _op1d("hfft", jnp.fft.hfft)
+ihfft = _op1d("ihfft", jnp.fft.ihfft)
+fft2 = _op2d("fft2", jnp.fft.fft2)
+ifft2 = _op2d("ifft2", jnp.fft.ifft2)
+rfft2 = _op2d("rfft2", jnp.fft.rfft2)
+irfft2 = _op2d("irfft2", jnp.fft.irfft2)
+fftn = _opnd("fftn", jnp.fft.fftn)
+ifftn = _opnd("ifftn", jnp.fft.ifftn)
+rfftn = _opnd("rfftn", jnp.fft.rfftn)
+irfftn = _opnd("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import Tensor
+    from .framework.dtype import to_np_dtype
+
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        out = out.astype(to_np_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import Tensor
+    from .framework.dtype import to_np_dtype
+
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        out = out.astype(to_np_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    x = _as_tensor(x)
+    av = None if axes is None else tuple(
+        int(v) for v in (axes if isinstance(axes, (list, tuple)) else [axes])
+    )
+    return apply_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=av), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    x = _as_tensor(x)
+    av = None if axes is None else tuple(
+        int(v) for v in (axes if isinstance(axes, (list, tuple)) else [axes])
+    )
+    return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=av), x)

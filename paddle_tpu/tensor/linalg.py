@@ -253,3 +253,63 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
 def corrcoef(x, rowvar=True, name=None):
     x = _as_tensor(x)
     return apply_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise distances between row batches (upstream:
+    python/paddle/tensor/linalg.py cdist). p==2 uses the matmul
+    expansion so the work rides the MXU."""
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+
+    def f(a, b):
+        af = a.astype(jnp.float32)
+        bf = b.astype(jnp.float32)
+        if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+            a2 = jnp.sum(af * af, -1, keepdims=True)         # (..., n, 1)
+            b2 = jnp.sum(bf * bf, -1, keepdims=True)         # (..., m, 1)
+            ab = jnp.einsum("...nd,...md->...nm", af, bf)
+            d2 = a2 - 2.0 * ab + jnp.swapaxes(b2, -1, -2)
+            # clamp strictly above 0: sqrt'(0)=inf would turn the zero
+            # cotangent of coincident pairs into NaN in the backward
+            d = jnp.sqrt(jnp.maximum(d2, 1e-12))
+            return jnp.where(d2 > 1e-12, d, 0.0).astype(a.dtype)
+        diff = jnp.abs(af[..., :, None, :] - bf[..., None, :, :])
+        if p == float("inf"):
+            return jnp.max(diff, -1).astype(a.dtype)
+        if p == 0.0:
+            return jnp.sum((diff != 0).astype(jnp.float32), -1).astype(a.dtype)
+        return (jnp.sum(diff ** p, -1) ** (1.0 / p)).astype(a.dtype)
+
+    return apply_op("cdist", f, x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of one point set (upstream:
+    python/paddle/tensor/linalg.py pdist)."""
+    import numpy as _np
+
+    x = _as_tensor(x)
+    n = x.shape[0]
+    iu = _np.triu_indices(n, k=1)
+
+    def f(a):
+        af = a.astype(jnp.float32)
+        if p == 2.0:
+            a2 = jnp.sum(af * af, -1, keepdims=True)
+            d2 = a2 - 2.0 * (af @ af.T) + a2.T
+            # see cdist: clamp away from 0 so the self-distance diagonal
+            # (zero cotangent after the triu gather) can't NaN the vjp
+            d = jnp.where(
+                d2 > 1e-12, jnp.sqrt(jnp.maximum(d2, 1e-12)), 0.0
+            )
+        else:
+            diff = jnp.abs(af[:, None, :] - af[None, :, :])
+            if p == float("inf"):
+                d = jnp.max(diff, -1)
+            else:
+                d = jnp.sum(diff ** p, -1) ** (1.0 / p)
+        return d[jnp.asarray(iu[0]), jnp.asarray(iu[1])].astype(a.dtype)
+
+    return apply_op("pdist", f, x)

@@ -467,3 +467,223 @@ def view(x, shape_or_dtype, name=None):
         return reshape(x, shape_or_dtype)
     d = to_np_dtype(shape_or_dtype)
     return apply_op("view_dtype", lambda a: a.view(d), x, differentiable=False)
+
+
+# -- stack/split families (upstream: python/paddle/tensor/manipulation.py;
+# thin jnp mappings — XLA concat/slice fuse freely) --------------------------
+def _multi_in(name, jfn, tensors):
+    ts = [_as_tensor(t) for t in tensors]
+    return apply_op(name, lambda *rs: jfn(list(rs)), *ts)
+
+
+def hstack(x, name=None):
+    return _multi_in("hstack", jnp.hstack, x)
+
+
+def vstack(x, name=None):
+    return _multi_in("vstack", jnp.vstack, x)
+
+
+def dstack(x, name=None):
+    return _multi_in("dstack", jnp.dstack, x)
+
+
+def column_stack(x, name=None):
+    return _multi_in("column_stack", jnp.column_stack, x)
+
+
+def row_stack(x, name=None):
+    return _multi_in("row_stack", jnp.vstack, x)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = _as_tensor(x)
+    spec = (
+        list(num_or_indices)
+        if isinstance(num_or_indices, (list, tuple))
+        else int(num_or_indices)
+    )
+    n = (
+        len(spec) + 1 if isinstance(spec, list)
+        else int(spec)
+    )
+    out = apply_op(
+        "tensor_split",
+        lambda a: tuple(jnp.array_split(
+            a,
+            spec if isinstance(spec, int) else np.asarray(spec),
+            axis=int(axis),
+        )),
+        x, n_outs=n,
+    )
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = _as_tensor(x)
+    if x.ndim < 1:
+        raise ValueError("hsplit expects at least a 1-D tensor")
+    return tensor_split(x, num_or_indices, axis=0 if x.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    x = _as_tensor(x)
+    if x.ndim < 2:
+        raise ValueError("vsplit expects at least a 2-D tensor")
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    x = _as_tensor(x)
+    if x.ndim < 3:
+        raise ValueError("dsplit expects at least a 3-D tensor")
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def _atleast(name, jfn, inputs):
+    outs = [apply_op(name, jfn, _as_tensor(t)) for t in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_1d(*inputs, name=None):
+    return _atleast("atleast_1d", jnp.atleast_1d, inputs)
+
+
+def atleast_2d(*inputs, name=None):
+    return _atleast("atleast_2d", jnp.atleast_2d, inputs)
+
+
+def atleast_3d(*inputs, name=None):
+    return _atleast("atleast_3d", jnp.atleast_3d, inputs)
+
+
+# -- scatter-style functional updates ---------------------------------------
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions of x from `value` taken in row-major order
+    (upstream: paddle/phi/kernels/masked_scatter_kernel.cc). Static-shape
+    design: a cumsum turns the boolean mask into gather indices, so the
+    op stays XLA-compilable (no dynamic shapes)."""
+    x = _as_tensor(x)
+    mask = _as_tensor(mask)
+    value = _as_tensor(value)
+
+    def f(a, m, v):
+        m_b = jnp.broadcast_to(m, a.shape).reshape(-1)
+        vf = v.reshape(-1)
+        # position i takes vf[(# of True before i)]
+        take = jnp.clip(jnp.cumsum(m_b) - 1, 0, vf.shape[0] - 1)
+        return jnp.where(m_b, vf[take], a.reshape(-1)).reshape(a.shape)
+
+    return apply_op("masked_scatter", f, x, mask, value)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+
+    def f(a, b):
+        mask = jnp.zeros(a.shape, bool)
+        diag_len = jnp.diagonal(
+            a, offset=int(offset), axis1=int(axis1), axis2=int(axis2)
+        ).shape[-1]
+        # place b along the diagonal by building an index grid
+        idx = jnp.arange(diag_len)
+        i1 = idx - builtins.min(int(offset), 0)
+        i2 = idx + builtins.max(int(offset), 0)
+        ind = [builtins.slice(None)] * a.ndim
+        ind[int(axis1)] = i1
+        ind[int(axis2)] = i2
+        return a.at[tuple(ind)].set(
+            jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+        )
+
+    return apply_op("diagonal_scatter", f, x, y)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    x = _as_tensor(x)
+    values = _as_tensor(values)
+
+    def f(a, v):
+        ind = [builtins.slice(None)] * a.ndim
+        ind[int(axis)] = int(index)
+        return a.at[tuple(ind)].set(v.astype(a.dtype))
+
+    return apply_op("select_scatter", f, x, values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    x = _as_tensor(x)
+    value = _as_tensor(value)
+
+    def f(a, v):
+        ind = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            ind[int(ax)] = builtins.slice(int(st), int(en), int(sd))
+        return a.at[tuple(ind)].set(v.astype(a.dtype))
+
+    return apply_op("slice_scatter", f, x, value)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view materialized as a gather (TPU has no aliasing views;
+    upstream: paddle/phi/kernels/stride/as_strided_kernel.cc)."""
+    x = _as_tensor(x)
+    shape = [int(s) for s in shape]
+    stride = [int(s) for s in stride]
+
+    def f(a):
+        flat = a.reshape(-1)
+        idx = jnp.asarray(int(offset))
+        for dim, st in zip(shape, stride):
+            idx = idx[..., None] + jnp.arange(dim) * st
+        return flat[idx.reshape(-1)].reshape(shape)
+
+    return apply_op("as_strided", f, x)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis` appended as a trailing dim
+    (upstream: paddle/phi/kernels/stride/unfold_kernel.cc)."""
+    x = _as_tensor(x)
+
+    def f(a):
+        ax = int(axis) % a.ndim
+        n = (a.shape[ax] - int(size)) // int(step) + 1
+        starts = jnp.arange(n) * int(step)
+        win = starts[:, None] + jnp.arange(int(size))  # (n, size)
+        out = jnp.take(a, win.reshape(-1), axis=ax)
+        out = out.reshape(
+            a.shape[:ax] + (n, int(size)) + a.shape[ax + 1:]
+        )
+        return jnp.moveaxis(out, ax + 1, -1)
+
+    return apply_op("unfold", f, x)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "vander",
+        lambda a: jnp.vander(
+            a, N=(None if n is None else int(n)),
+            increasing=bool(increasing),
+        ),
+        x,
+    )
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor's elements (upstream:
+    python/paddle/tensor/math.py combinations). Index set is computed on
+    host (static shape), the gather stays on device."""
+    import itertools
+
+    x = _as_tensor(x)
+    n = x.shape[0]
+    gen = (
+        itertools.combinations_with_replacement(range(n), int(r))
+        if with_replacement else itertools.combinations(range(n), int(r))
+    )
+    idx = np.asarray(list(gen), np.int32).reshape(-1, int(r))
+    return apply_op("combinations", lambda a: a[jnp.asarray(idx)], x)

@@ -261,16 +261,114 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return apply_op("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=d), x)
 
 
-def cummax(x, axis=None, dtype="int64", name=None):
+def _cum_extreme(opname, better, x, axis, dtype):
+    """Shared cummax/cummin: running extreme + index of its first
+    occurrence via an associative scan over (value, index) pairs
+    (upstream: paddle/phi/kernels/gpu/cum_maxmin_kernel.cu)."""
     x = _as_tensor(x)
+    idt = to_np_dtype(dtype or "int64")
 
     def f(a):
-        ax = axis if axis is not None else 0
-        vals = jax.lax.cummax(a, axis=ax)
-        idx = jnp.argmax(a[..., None] == vals[..., None], axis=-1)
-        return vals
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else int(axis)
 
-    return apply_op("cummax", f, x)
+        def combine(l, r):
+            lv, li = l
+            rv, ri = r
+            take_r = better(rv, lv)  # strict: ties keep the earlier index
+            return jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li)
+
+        n = arr.shape[ax]
+        shape = [1] * arr.ndim
+        shape[ax] = n
+        idx = jnp.broadcast_to(
+            jnp.arange(n, dtype=idt).reshape(shape), arr.shape
+        )
+        vals, inds = jax.lax.associative_scan(combine, (arr, idx), axis=ax)
+        return vals, inds
+
+    return apply_op(opname, f, x, n_outs=2)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme("cummax", jnp.greater, x, axis, dtype)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme("cummin", jnp.less, x, axis, dtype)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """Running logsumexp (upstream: paddle/phi/kernels/impl/
+    logcumsumexp_kernel_impl.h) — numerically-stable associative scan."""
+    x = _as_tensor(x)
+    d = to_np_dtype(dtype) if dtype is not None else None
+
+    def f(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else int(axis)
+        if d is not None:
+            arr = arr.astype(d)
+        elif not jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = arr.astype(jnp.float32)
+        return jax.lax.associative_scan(jnp.logaddexp, arr, axis=ax)
+
+    return apply_op("logcumsumexp", f, x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = _as_tensor(x)
+    extras = []
+    if prepend is not None:
+        extras.append(_as_tensor(prepend))
+    if append is not None:
+        extras.append(_as_tensor(append))
+
+    def f(a, *pa):
+        idx = 0
+        pre = app = None
+        if prepend is not None:
+            pre = pa[idx]
+            idx += 1
+        if append is not None:
+            app = pa[idx]
+        return jnp.diff(a, n=int(n), axis=int(axis), prepend=pre,
+                        append=app)
+
+    return apply_op("diff", f, x, *extras)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = _as_tensor(y)
+    if x is not None:
+        xt = _as_tensor(x)
+        return apply_op(
+            "trapezoid",
+            lambda a, b: jnp.trapezoid(a, b, axis=int(axis)), y, xt,
+        )
+    step = 1.0 if dx is None else float(dx)
+    return apply_op(
+        "trapezoid",
+        lambda a: jnp.trapezoid(a, dx=step, axis=int(axis)), y,
+    )
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    import jax.scipy.integrate as _ji
+
+    y = _as_tensor(y)
+    if x is not None:
+        xt = _as_tensor(x)
+        return apply_op(
+            "cumulative_trapezoid",
+            lambda a, b: _ji.cumulative_trapezoid(a, b, axis=int(axis)),
+            y, xt,
+        )
+    step = 1.0 if dx is None else float(dx)
+    return apply_op(
+        "cumulative_trapezoid",
+        lambda a: _ji.cumulative_trapezoid(a, dx=step, axis=int(axis)), y,
+    )
 
 
 # -- matrix -----------------------------------------------------------------
@@ -355,3 +453,121 @@ def increment(x, value=1.0, name=None):
     x._grad_node = out._grad_node
     x._version += 1
     return x
+
+
+# -- special functions (upstream: paddle/phi/kernels/*_kernel.cu via
+# ops.yaml; here: jax.scipy.special on the VPU) ------------------------------
+import jax.scipy.special as _jss  # noqa: E402
+
+gammaln = _unary("gammaln", _jss.gammaln)
+i0 = _unary("i0", _jss.i0)
+i0e = _unary("i0e", _jss.i0e)
+i1 = _unary("i1", _jss.i1)
+i1e = _unary("i1e", _jss.i1e)
+
+
+def logit(x, eps=None, name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        p = a if eps is None else jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(p) - jnp.log1p(-p)
+
+    return apply_op("logit", f, x)
+
+
+def polygamma(x, n, name=None):
+    x = _as_tensor(x)
+    return apply_op("polygamma", lambda a: _jss.polygamma(int(n), a), x)
+
+
+def multigammaln(x, p, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "multigammaln", lambda a: _jss.multigammaln(a, int(p)), x
+    )
+
+
+def ldexp(x, y, name=None):
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+    return apply_op(
+        "ldexp",
+        lambda a, b: jnp.ldexp(a.astype(jnp.float32)
+                               if not jnp.issubdtype(a.dtype, jnp.floating)
+                               else a, b.astype(jnp.int32)),
+        x, y,
+    )
+
+
+positive = _unary("positive", lambda a: +a)
+negative = _unary("negative", jnp.negative)
+signbit = _unary("signbit", jnp.signbit)
+
+
+def isposinf(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("isposinf", jnp.isposinf, x, differentiable=False)
+
+
+def isneginf(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("isneginf", jnp.isneginf, x, differentiable=False)
+
+
+def isreal(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("isreal", jnp.isreal, x, differentiable=False)
+
+
+def real(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("real", jnp.real, x)
+
+
+def imag(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("imag", jnp.imag, x)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+    return apply_op(
+        "bitwise_left_shift", jnp.left_shift, x, y, differentiable=False
+    )
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    """Arithmetic (sign-propagating) or logical right shift."""
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+    if is_arithmetic:
+        return apply_op(
+            "bitwise_right_shift", jnp.right_shift, x, y,
+            differentiable=False,
+        )
+
+    def f(a, b):
+        ua = a.astype(jnp.uint32) if a.dtype in (jnp.int32.dtype,) else a
+        return jnp.right_shift(ua, b.astype(ua.dtype)).astype(a.dtype)
+
+    return apply_op(
+        "bitwise_right_shift_logical", f, x, y, differentiable=False
+    )
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along `axis` (upstream:
+    paddle/phi/kernels/renorm_kernel.cc)."""
+    x = _as_tensor(x)
+
+    def f(a):
+        ax = int(axis) % a.ndim
+        red = tuple(i for i in range(a.ndim) if i != ax)
+        af = a.astype(jnp.float32)
+        norms = jnp.sum(jnp.abs(af) ** p, axis=red, keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return (af * scale).astype(a.dtype)
+
+    return apply_op("renorm", f, x)

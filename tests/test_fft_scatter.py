@@ -1,0 +1,149 @@
+"""paddle.fft + scatter-family + split-family tests (upstream analogs:
+test/legacy_test/test_fft.py, test_diagonal_scatter_op.py,
+test_masked_scatter.py, test_tensor_split.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a, **k):
+    return paddle.to_tensor(np.asarray(a), **k)
+
+
+class TestFFT:
+    def test_fft_matches_numpy(self):
+        x = np.random.RandomState(0).randn(4, 16).astype("float32")
+        np.testing.assert_allclose(
+            paddle.fft.fft(_t(x)).numpy(), np.fft.fft(x, axis=-1),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            paddle.fft.rfft(_t(x)).numpy(), np.fft.rfft(x, axis=-1),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            paddle.fft.fft2(_t(x)).numpy(), np.fft.fft2(x),
+            rtol=1e-4, atol=1e-3,
+        )
+
+    def test_norm_modes(self):
+        x = np.random.RandomState(1).randn(8).astype("float32")
+        for norm in ("backward", "ortho", "forward"):
+            np.testing.assert_allclose(
+                paddle.fft.fft(_t(x), norm=norm).numpy(),
+                np.fft.fft(x, norm=norm), rtol=1e-4, atol=1e-4,
+            )
+        with pytest.raises(ValueError):
+            paddle.fft.fft(_t(x), norm="bogus")
+
+    def test_roundtrip_and_grad(self):
+        x = _t(np.random.RandomState(2).randn(4, 16).astype("float32"),
+               stop_gradient=False)
+        back = paddle.fft.irfft(paddle.fft.rfft(x), n=16)
+        np.testing.assert_allclose(
+            back.numpy(), x.numpy(), rtol=1e-4, atol=1e-4
+        )
+        back.sum().backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(), np.ones((4, 16), "float32"),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_fftshift_freq(self):
+        np.testing.assert_allclose(
+            paddle.fft.fftfreq(8, d=0.5).numpy(), np.fft.fftfreq(8, 0.5)
+        )
+        x = np.arange(8, dtype="float32")
+        np.testing.assert_allclose(
+            paddle.fft.fftshift(_t(x)).numpy(), np.fft.fftshift(x)
+        )
+
+
+class TestScatterFamily:
+    def test_masked_scatter_order(self):
+        x = _t(np.zeros((2, 3), "float32"))
+        mask = _t(np.array([[True, False, True], [False, True, False]]))
+        vals = _t(np.array([1.0, 2.0, 3.0], "float32"))
+        out = paddle.masked_scatter(x, mask, vals)
+        np.testing.assert_array_equal(
+            out.numpy(), [[1, 0, 2], [0, 3, 0]]
+        )
+
+    def test_masked_scatter_grad(self):
+        x = _t(np.zeros((2, 2), "float32"), stop_gradient=False)
+        mask = _t(np.array([[True, False], [False, True]]))
+        vals = _t(np.array([5.0, 6.0], "float32"), stop_gradient=False)
+        out = paddle.masked_scatter(x, mask, vals)
+        out.sum().backward()
+        np.testing.assert_array_equal(
+            x.grad.numpy(), [[0, 1], [1, 0]]
+        )
+        np.testing.assert_array_equal(vals.grad.numpy(), [1, 1])
+
+    def test_diagonal_scatter_offsets(self):
+        base = np.zeros((3, 4), "float32")
+        for off in (-1, 0, 1):
+            diag_len = np.diagonal(base, offset=off).shape[0]
+            out = paddle.diagonal_scatter(
+                _t(base), _t(np.ones(diag_len, "float32")), offset=off
+            )
+            ref = base.copy()
+            idx = np.arange(diag_len)
+            ref[idx - min(off, 0), idx + max(off, 0)] = 1
+            np.testing.assert_array_equal(out.numpy(), ref)
+
+    def test_select_slice_scatter(self):
+        out = paddle.select_scatter(
+            _t(np.zeros((3, 3), "float32")),
+            _t(np.ones(3, "float32")), 1, 2,
+        )
+        assert out.numpy()[:, 2].tolist() == [1, 1, 1]
+        out2 = paddle.slice_scatter(
+            _t(np.zeros((4, 4), "float32")),
+            _t(np.ones((2, 4), "float32")), [0], [1], [3], [1],
+        )
+        np.testing.assert_array_equal(
+            out2.numpy().sum(1), [0, 4, 4, 0]
+        )
+
+    def test_as_strided(self):
+        x = _t(np.arange(12, dtype="float32").reshape(3, 4))
+        out = paddle.as_strided(x, [2, 3], [4, 1], offset=1)
+        np.testing.assert_array_equal(
+            out.numpy(), [[1, 2, 3], [5, 6, 7]]
+        )
+
+
+class TestSplitFamily:
+    def test_tensor_split_uneven(self):
+        x = _t(np.arange(10, dtype="float32"))
+        parts = paddle.tensor_split(x, 3)
+        assert [p.shape[0] for p in parts] == [4, 3, 3]
+        np.testing.assert_array_equal(
+            np.concatenate([p.numpy() for p in parts]), x.numpy()
+        )
+
+    def test_tensor_split_indices(self):
+        x = _t(np.arange(12, dtype="float32").reshape(2, 6))
+        parts = paddle.tensor_split(x, [2, 5], axis=1)
+        assert [p.shape[1] for p in parts] == [2, 3, 1]
+
+    def test_hvd_split(self):
+        x = _t(np.arange(24, dtype="float32").reshape(2, 3, 4))
+        assert [p.shape for p in paddle.vsplit(x, 2)] == [[1, 3, 4]] * 2
+        assert [p.shape for p in paddle.hsplit(x, 3)] == [[2, 1, 4]] * 3
+        assert [p.shape for p in paddle.dsplit(x, 2)] == [[2, 3, 2]] * 2
+        with pytest.raises(ValueError):
+            paddle.dsplit(_t(np.ones((2, 2), "float32")), 2)
+
+    def test_combinations(self):
+        x = _t(np.array([1.0, 2.0, 3.0], "float32"))
+        np.testing.assert_array_equal(
+            paddle.combinations(x, 2).numpy(),
+            [[1, 2], [1, 3], [2, 3]],
+        )
+        np.testing.assert_array_equal(
+            paddle.combinations(x, 2, with_replacement=True).numpy(),
+            [[1, 1], [1, 2], [1, 3], [2, 2], [2, 3], [3, 3]],
+        )

@@ -313,7 +313,76 @@ OPS = [
            grad=False, dtypes=("float32",)),
     OpSpec("isfinite", lambda x: pmath.isfinite(x), np.isfinite,
            [(4, 9)], grad=False, dtypes=("float32",)),
+    # -- special functions --------------------------------------------------
+    OpSpec("gammaln", U(pmath.gammaln),
+           lambda x: _sps().gammaln(x), [(4, 9)], positive=True,
+           dtypes=("float32",)),
+    OpSpec("i0", U(pmath.i0), lambda x: _sps().i0(x), [(4, 9)],
+           dtypes=("float32",)),
+    OpSpec("i1", U(pmath.i1), lambda x: _sps().i1(x), [(4, 9)],
+           dtypes=("float32",)),
+    OpSpec("logit", lambda x: pmath.logit(x),
+           lambda x: np.log(x / (1 - x)), [(4, 9)],
+           domain=(0.1, 0.9), dtypes=("float32",)),
+    OpSpec("polygamma", lambda x: pmath.polygamma(x, 1),
+           lambda x: _sps().polygamma(1, x), [(4, 9)],
+           positive=True, dtypes=("float32",)),
+    OpSpec("multigammaln", lambda x: pmath.multigammaln(x, 2),
+           lambda x: _sps().multigammaln(x, 2), [(4, 9)],
+           domain=(2.0, 5.0), dtypes=("float32",), grad_tol=0.1),
+    OpSpec("signbit", U(pmath.signbit), np.signbit, [(4, 9)],
+           grad=False, dtypes=("float32",)),
+    # -- scans / diffs ------------------------------------------------------
+    OpSpec("cummax_v", lambda x: pmath.cummax(x, axis=1)[0],
+           lambda x: np.maximum.accumulate(x, 1), [(4, 9)],
+           grad=False),
+    OpSpec("cummin_v", lambda x: pmath.cummin(x, axis=1)[0],
+           lambda x: np.minimum.accumulate(x, 1), [(4, 9)],
+           grad=False),
+    OpSpec("logcumsumexp", lambda x: pmath.logcumsumexp(x, axis=1),
+           lambda x: np.log(np.cumsum(np.exp(x), 1)), [(4, 9)],
+           tol_scale=2.0),
+    OpSpec("diff", lambda x: pmath.diff(x, axis=1),
+           lambda x: np.diff(x, axis=1), [(4, 9)]),
+    OpSpec("trapezoid", lambda x: pmath.trapezoid(x, dx=0.5),
+           lambda x: np.trapezoid(x, dx=0.5), [(4, 9)]),
+    OpSpec("renorm", lambda x: pmath.renorm(x, 2.0, 0, 1.0),
+           lambda x: x * np.minimum(
+               1.0, 1.0 / (np.sqrt((x ** 2).sum(1, keepdims=True))
+                           + 1e-7)),
+           [(4, 9)], grad_tol=0.1, tol_scale=3.0),
+    # -- stack / distance ---------------------------------------------------
+    OpSpec("hstack", lambda x, y: manipulation.hstack([x, y]),
+           lambda x, y: np.hstack([x, y]), [(3, 4), (3, 5)]),
+    OpSpec("vstack", lambda x, y: manipulation.vstack([x, y]),
+           lambda x, y: np.vstack([x, y]), [(3, 4), (2, 4)]),
+    OpSpec("column_stack",
+           lambda x, y: manipulation.column_stack([x, y]),
+           lambda x, y: np.column_stack([x, y]), [(5,), (5,)]),
+    OpSpec("atleast_2d", lambda x: manipulation.atleast_2d(x),
+           np.atleast_2d, [(7,)]),
+    OpSpec("vander", lambda x: manipulation.vander(x),
+           lambda x: np.vander(x), [(5,)], tol_scale=4.0),
+    OpSpec("unfold", lambda x: manipulation.unfold(x, 1, 3, 2),
+           lambda x: np.stack([x[:, i:i + 3] for i in (0, 2, 4)], 1),
+           [(4, 7)]),
+    OpSpec("cdist", B(linalg.cdist),
+           lambda x, y: np.sqrt(
+               ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)),
+           [(5, 4), (6, 4)], tol_scale=4.0,
+           kink=lambda arrs, i: np.ones_like(arrs[i], bool)),
+    OpSpec("pdist", lambda x: linalg.pdist(x),
+           lambda x: np.sqrt(
+               ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1))[
+               np.triu_indices(5, 1)],
+           [(5, 4)], tol_scale=4.0),
 ]
+
+
+def _sps():
+    import scipy.special as sps
+
+    return sps
 
 _IDS = [o.name for o in OPS]
 assert len(set(_IDS)) == len(_IDS), "duplicate op names"
