@@ -115,6 +115,8 @@ def _lazy_imports():
     global DataParallel, utils, inference, sparse
     from . import utils  # noqa
     from . import fft  # noqa
+    from . import signal  # noqa
+    from . import distribution  # noqa
     from . import inference  # noqa
     from . import sparse  # noqa
     from . import nn  # noqa

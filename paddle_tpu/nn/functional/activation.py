@@ -192,3 +192,26 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
         return y
 
     return apply_op("gumbel_softmax", f, x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    """Randomized leaky ReLU (upstream: paddle/phi/kernels/gpu/
+    rrelu_kernel.cu). Training samples the negative slope per element;
+    eval uses the mean slope."""
+    from ...framework.random import next_key
+
+    x = _as_tensor(x)
+    if not training:
+        mid = (lower + upper) / 2.0
+        return apply_op(
+            "rrelu", lambda a: jnp.where(a >= 0, a, a * mid), x
+        )
+    k = next_key()
+
+    def f(a):
+        slope = jax.random.uniform(
+            k, a.shape, jnp.float32, lower, upper
+        ).astype(a.dtype)
+        return jnp.where(a >= 0, a, a * slope)
+
+    return apply_op("rrelu", f, x)

@@ -274,3 +274,217 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
         return a.reshape(n, c * r * r, h // r, w // r)
 
     return apply_op("pixel_unshuffle", f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        if data_format == "NHWC":
+            n, h, w, c = a.shape
+            a = a.reshape(n, h, w, groups, c // groups)
+            a = a.swapaxes(3, 4)
+            return a.reshape(n, h, w, c)
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        a = a.swapaxes(1, 2)
+        return a.reshape(n, c, h, w)
+
+    return apply_op("channel_shuffle", f, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta (N, 2, 3) -> sampling grid (N, H, W, 2) in [-1, 1] coords
+    (upstream: paddle/phi/kernels/impl/affine_grid_kernel_impl.h)."""
+    theta = _as_tensor(theta)
+    n, _, h, w = [int(v) for v in out_shape]
+
+    def f(t):
+        def axis_coords(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size)
+            step = 2.0 / size
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+        ys = axis_coords(h)
+        xs = axis_coords(w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack(
+            [gx, gy, jnp.ones_like(gx)], axis=-1
+        )  # (H, W, 3)
+        return jnp.einsum(
+            "hwk,nck->nhwc", base.astype(t.dtype), t
+        )  # (N, H, W, 2)
+
+    return apply_op("affine_grid", f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Spatial sampling by a normalized coordinate grid (upstream:
+    paddle/phi/kernels/gpu/grid_sample_kernel.cu). Pure gather + lerp —
+    XLA fuses the 4-corner gathers; no scalar loops."""
+    x = _as_tensor(x)
+    grid = _as_tensor(grid)
+
+    def f(a, g):
+        n, c, ih, iw = a.shape
+        gf = g.astype(jnp.float32)
+
+        def unnorm(coord, size):
+            if align_corners:
+                return (coord + 1.0) * 0.5 * (size - 1)
+            return ((coord + 1.0) * size - 1.0) * 0.5
+
+        ix = unnorm(gf[..., 0], iw)  # (N, Ho, Wo)
+        iy = unnorm(gf[..., 1], ih)
+
+        def reflect(coord, size):
+            if align_corners:
+                span = 2.0 * (size - 1)
+                if size == 1:
+                    return jnp.zeros_like(coord)
+                m = jnp.mod(coord, span)
+                return jnp.where(m > (size - 1), span - m, m)
+            span = 2.0 * size
+            m = jnp.mod(coord + 0.5, span)
+            m = jnp.where(m > size, span - m, m) - 0.5
+            return jnp.clip(m, 0, size - 1)
+
+        if padding_mode == "reflection":
+            ix = reflect(ix, iw)
+            iy = reflect(iy, ih)
+
+        af = a.astype(jnp.float32)
+        nb = jnp.arange(n)[:, None, None]
+
+        def fetch(yi, xi):
+            yc = jnp.clip(yi, 0, ih - 1)
+            xc = jnp.clip(xi, 0, iw - 1)
+            val = af[nb, :, yc, xc]  # (N, Ho, Wo, C)
+            if padding_mode == "zeros":
+                ok = (
+                    (yi >= 0) & (yi <= ih - 1) & (xi >= 0) & (xi <= iw - 1)
+                )
+                val = val * ok[..., None]
+            return val
+
+        if mode == "nearest":
+            out = fetch(
+                jnp.round(iy).astype(jnp.int32),
+                jnp.round(ix).astype(jnp.int32),
+            )
+        else:
+            x0 = jnp.floor(ix)
+            y0 = jnp.floor(iy)
+            wx = ix - x0
+            wy = iy - y0
+            x0i = x0.astype(jnp.int32)
+            y0i = y0.astype(jnp.int32)
+            v00 = fetch(y0i, x0i)
+            v01 = fetch(y0i, x0i + 1)
+            v10 = fetch(y0i + 1, x0i)
+            v11 = fetch(y0i + 1, x0i + 1)
+            wx = wx[..., None]
+            wy = wy[..., None]
+            out = (
+                v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx
+            )
+        return jnp.moveaxis(out, -1, 1).astype(a.dtype)  # (N, C, Ho, Wo)
+
+    return apply_op("grid_sample", f, x, grid)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im — inverse of ``unfold`` (upstream:
+    paddle/phi/kernels/impl/fold_kernel_impl.h): scatter-add every
+    column back into its window position."""
+    x = _as_tensor(x)
+
+    def _pair2(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = _pair2(output_sizes)
+    kh, kw = _pair2(kernel_sizes)
+    sh, sw = _pair2(strides)
+    ph, pw = _pair2(paddings)
+    dh, dw = _pair2(dilations)
+
+    def f(a):
+        n, ckk, l = a.shape
+        c = ckk // (kh * kw)
+        nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        cols = a.reshape(n, c, kh, kw, nh, nw)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        # scatter-add each kernel offset's plane (kh*kw static steps)
+        for i in range(kh):
+            for j in range(kw):
+                rows = jnp.arange(nh) * sh + i * dh
+                colsj = jnp.arange(nw) * sw + j * dw
+                out = out.at[
+                    :, :, rows[:, None], colsj[None, :]
+                ].add(cols[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return apply_op("fold", f, x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift (upstream: paddle/phi/kernels/impl/
+    temporal_shift_kernel_impl.h): shift the first channel quarter
+    backward in time, the second forward, keep the rest."""
+    x = _as_tensor(x)
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad_fwd = jnp.zeros_like(v[:, :1, :c1])
+        fwd = jnp.concatenate([v[:, 1:, :c1], pad_fwd], axis=1)
+        pad_bwd = jnp.zeros_like(v[:, :1, c1:c2])
+        bwd = jnp.concatenate([pad_bwd, v[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([fwd, bwd, v[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op("temporal_shift", f, x)
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW",
+          name=None):
+    return pad(x, paddings, mode=mode, value=value,
+               data_format=data_format)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout that drops whole channels (dim-1 features)."""
+    x = _as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    k = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(k, 1.0 - p, shape)
+        q = 1.0 - p
+        coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return coef_a * jnp.where(
+            jnp.broadcast_to(keep, a.shape), a,
+            jnp.full_like(a, alpha_p)
+        ) + coef_b
+
+    return apply_op("feature_alpha_dropout", f, x)

@@ -363,3 +363,189 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     return apply_op(
         "ctc_loss", f, log_probs, labels, input_lengths, label_lengths
     )
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (upstream: python/paddle/nn/functional/loss.py
+    npair_loss): cross-entropy over anchor·positiveᵀ similarities plus
+    an l2 pull on the embeddings."""
+    anchor = _as_tensor(anchor)
+    positive = _as_tensor(positive)
+    labels = _as_tensor(labels)
+
+    def f(a, p, y):
+        b = a.shape[0]
+        yf = y.astype(jnp.float32).reshape(b, 1)
+        same = (yf == yf.T).astype(jnp.float32)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        sim = a.astype(jnp.float32) @ p.astype(jnp.float32).T
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (
+            jnp.mean(jnp.sum(jnp.square(a.astype(jnp.float32)), 1))
+            + jnp.mean(jnp.sum(jnp.square(p.astype(jnp.float32)), 1))
+        ) * 0.25
+        return ce + reg
+
+    return apply_op("npair_loss", f, anchor, positive, labels)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """ArcFace-family combined-margin softmax CE (upstream:
+    paddle/phi/kernels/gpu/margin_cross_entropy_kernel.cu).
+
+    cos(m1*theta + m2) - m3 applied to the target logit. With
+    ``group`` under a model-parallel mesh the class dim is sharded and
+    GSPMD inserts the cross-shard reductions (the reference does this
+    with a hand-written allreduce pair).
+    """
+    logits = _as_tensor(logits)
+    label = _as_tensor(label)
+
+    def f(z, y):
+        zf = z.astype(jnp.float32)
+        n, c = zf.shape
+        onehot = jax.nn.one_hot(y.reshape(-1), c, dtype=jnp.float32)
+        cos = jnp.clip(zf, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = onehot * target + (1.0 - onehot) * cos
+        s = adj * scale
+        logp = jax.nn.log_softmax(s, axis=1)
+        loss = -jnp.sum(onehot * logp, axis=1)
+        if reduction == "mean":
+            lout = jnp.mean(loss)
+        elif reduction == "sum":
+            lout = jnp.sum(loss)
+        else:
+            lout = loss
+        return lout, jnp.exp(logp).astype(z.dtype)
+
+    loss, softmax = apply_op(
+        "margin_cross_entropy", f, logits, label, n_outs=2
+    )
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample negative class centers for partial-fc training (upstream:
+    paddle/phi/kernels/gpu/class_center_sample_kernel.cu). Static-shape
+    TPU design: positives are kept by sorting a presence mask, negatives
+    fill the remainder deterministically from a seeded shuffle; returns
+    (remapped_label, sampled_class_indices[num_total])."""
+    from ...framework.random import next_key
+
+    label = _as_tensor(label)
+    k = next_key()
+
+    def f(y):
+        y = y.reshape(-1).astype(jnp.int32)
+        present = jnp.zeros((num_classes,), jnp.int32).at[y].set(1)
+        # priority: positives first (rank 0), then shuffled negatives
+        noise = jax.random.uniform(k, (num_classes,))
+        order = jnp.argsort(
+            present.astype(jnp.float32) * -10.0 + noise
+        )
+        sampled = order[:num_samples]  # positives + random negatives
+        # remap: position of each label inside `sampled`
+        pos_in_sampled = jnp.zeros(
+            (num_classes,), jnp.int32
+        ).at[sampled].set(jnp.arange(num_samples, dtype=jnp.int32))
+        return pos_in_sampled[y], sampled.astype(jnp.int64)
+
+    return apply_op(
+        "class_center_sample", f, label, n_outs=2, differentiable=False
+    )
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    input, label = _as_tensor(input), _as_tensor(label)
+    return apply_op(
+        "soft_margin_loss",
+        lambda z, y: _reduce(
+            jnp.log1p(jnp.exp(-y.astype(jnp.float32)
+                              * z.astype(jnp.float32))), reduction
+        ),
+        input, label,
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    input, label = _as_tensor(input), _as_tensor(label)
+
+    def f(z, y):
+        zf = z.astype(jnp.float32)
+        loss = jnp.where(
+            y > 0, zf, jnp.maximum(0.0, margin - zf)
+        )
+        return _reduce(loss, reduction)
+
+    return apply_op("hinge_embedding_loss", f, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    input, label = _as_tensor(input), _as_tensor(label)
+
+    def f(z, y, *w):
+        zf = z.astype(jnp.float32)
+        yf = y.astype(jnp.float32)
+        loss = -(
+            yf * jax.nn.log_sigmoid(zf)
+            + (1.0 - yf) * jax.nn.log_sigmoid(-zf)
+        )
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(_as_tensor(weight))
+    return apply_op("multi_label_soft_margin_loss", f, *args)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    input, label = _as_tensor(input), _as_tensor(label)
+
+    def f(z, y):
+        zf = z.astype(jnp.float32)
+        yf = y.astype(jnp.float32)
+        if log_input:
+            loss = jnp.exp(zf) - yf * zf
+        else:
+            loss = zf - yf * jnp.log(zf + epsilon)
+        if full:
+            # Stirling approx for log(y!)
+            stir = (
+                yf * jnp.log(yf + epsilon) - yf
+                + 0.5 * jnp.log(2.0 * jnp.pi * (yf + epsilon))
+            )
+            loss = loss + jnp.where(yf > 1.0, stir, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_op("poisson_nll_loss", f, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    input = _as_tensor(input)
+    label = _as_tensor(label)
+    variance = _as_tensor(variance)
+
+    def f(mu, y, var):
+        vf = jnp.maximum(var.astype(jnp.float32), epsilon)
+        d2 = jnp.square(y.astype(jnp.float32) - mu.astype(jnp.float32))
+        loss = 0.5 * (jnp.log(vf) + d2 / vf)
+        if full:
+            loss = loss + 0.5 * jnp.log(2.0 * jnp.pi)
+        return _reduce(loss, reduction)
+
+    return apply_op("gaussian_nll_loss", f, input, label, variance)

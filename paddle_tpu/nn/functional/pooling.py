@@ -56,12 +56,51 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
     out = apply_op("max_pool2d", f, x)
     if return_mask:
-        # mask = argmax index within input (flattened spatial), best-effort
-        idx = apply_op(
-            "max_pool2d_mask",
-            lambda a: jnp.zeros_like(f(a), dtype=jnp.int32),
-            x, differentiable=False,
-        )
+        # real argmax mask (flattened H*W index per pooled element,
+        # upstream: paddle/phi/kernels/funcs/pooling.h MaxPool2dWithIndex):
+        # extract each window as a patch column, argmax over the patch,
+        # then map the patch-local offset back to input coordinates
+        def fmask(a):
+            if cl:
+                a = jnp.transpose(a, (0, 3, 1, 2))
+            n, c, ih, iw = a.shape
+            if isinstance(pad, str):
+                # resolve SAME/VALID to explicit lo/hi pairs
+                pairs = []
+                for d, (k, s, size) in enumerate(
+                    zip(ks, st, (ih, iw))
+                ):
+                    if pad == "VALID":
+                        pairs.append((0, 0))
+                    else:
+                        o = -(-size // s)
+                        tot = max((o - 1) * s + k - size, 0)
+                        pairs.append((tot // 2, tot - tot // 2))
+            else:
+                pairs = list(pad)
+            # finite large-negative pad: the patch extraction is a conv
+            # with a one-hot kernel, and -inf * 0 would NaN whole windows
+            af = jnp.pad(
+                a.astype(jnp.float32),
+                [(0, 0), (0, 0)] + pairs, constant_values=-1e30,
+            )
+            patches = jax.lax.conv_general_dilated_patches(
+                af, ks, st, "VALID",
+            )  # (N, C*kh*kw, OH, OW), feature order (c, kh, kw)
+            oh, ow = patches.shape[2], patches.shape[3]
+            patches = patches.reshape(n, c, ks[0] * ks[1], oh, ow)
+            loc = jnp.argmax(patches, axis=2)  # (N, C, OH, OW)
+            ph, pw = loc // ks[1], loc % ks[1]
+            ph0 = (jnp.arange(oh) * st[0])[None, None, :, None]
+            pw0 = (jnp.arange(ow) * st[1])[None, None, None, :]
+            row = jnp.clip(ph0 + ph - pairs[0][0], 0, ih - 1)
+            col = jnp.clip(pw0 + pw - pairs[1][0], 0, iw - 1)
+            idx = (row * iw + col).astype(jnp.int32)
+            if cl:
+                idx = jnp.transpose(idx, (0, 2, 3, 1))
+            return idx
+
+        idx = apply_op("max_pool2d_mask", fmask, x, differentiable=False)
         return out, idx
     return out
 
@@ -171,18 +210,37 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
                 tuple(window), "VALID",
             )
             return (s / (kh * kw)).astype(a.dtype)
-        # general case: mean over index buckets
-        out = jax.image.resize(
-            a.astype(jnp.float32),
-            tuple(
-                os[i - h_axis] if i in (h_axis, w_axis) else a.shape[i]
-                for i in range(a.ndim)
-            ),
-            method="linear",
+        # general case: exact adaptive mean over floor/ceil buckets
+        # (reference semantics — NOT interpolation), as one matmul per
+        # spatial axis so it rides the MXU
+        out = a.astype(jnp.float32)
+        out = jnp.tensordot(
+            out, _adaptive_avg_matrix(ih, oh), axes=[[h_axis], [1]]
         )
+        out = jnp.moveaxis(out, -1, h_axis)
+        out = jnp.tensordot(
+            out, _adaptive_avg_matrix(iw, ow), axes=[[w_axis], [1]]
+        )
+        out = jnp.moveaxis(out, -1, w_axis)
         return out.astype(a.dtype)
 
     return apply_op("adaptive_avg_pool2d", f, x)
+
+
+def _adaptive_bounds(in_size, out_size):
+    o = np.arange(out_size)
+    starts = (o * in_size) // out_size
+    ends = -(-((o + 1) * in_size) // out_size)  # ceil division
+    return starts, ends
+
+
+def _adaptive_avg_matrix(in_size, out_size):
+    """(out, in) averaging matrix for exact adaptive pooling."""
+    starts, ends = _adaptive_bounds(in_size, out_size)
+    w = np.zeros((out_size, in_size), np.float32)
+    for o in range(out_size):
+        w[o, starts[o]:ends[o]] = 1.0 / (ends[o] - starts[o])
+    return jnp.asarray(w)
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
@@ -214,3 +272,141 @@ def adaptive_avg_pool1d(x, output_size, name=None):
         return (s / k).astype(a.dtype)
 
     return apply_op("adaptive_avg_pool1d", f, x)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d(return_mask=True): scatter each pooled
+    value back to its argmax position (upstream:
+    paddle/phi/kernels/funcs/pooling.h MaxPool2dWithIndexGrad-style
+    scatter). Functional at[]-scatter — XLA lowers it to an efficient
+    scatter on TPU."""
+    x = _as_tensor(x)
+    indices = _as_tensor(indices)
+    ks = _pair(kernel_size, 2)
+    st = _pair(stride, 2) if stride is not None else ks
+    p = _pool_padding(padding, 2)
+    p0 = p[0][0] if isinstance(p, list) else 0
+    p1 = p[1][0] if isinstance(p, list) else 0
+
+    def f(a, idx):
+        cl = data_format == "NHWC"
+        if cl:
+            a = jnp.transpose(a, (0, 3, 1, 2))
+            idx = jnp.transpose(idx, (0, 3, 1, 2))
+        n, c, oh, ow = a.shape
+        if output_size is not None:
+            ih, iw = output_size[-2], output_size[-1]
+        else:
+            ih = (oh - 1) * st[0] - 2 * p0 + ks[0]
+            iw = (ow - 1) * st[1] - 2 * p1 + ks[1]
+        flat = jnp.zeros((n, c, ih * iw), a.dtype)
+        ii = idx.reshape(n, c, -1).astype(jnp.int32)
+        vv = a.reshape(n, c, -1)
+        out = flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None],
+            ii,
+        ].set(vv)
+        out = out.reshape(n, c, ih, iw)
+        return jnp.transpose(out, (0, 2, 3, 1)) if cl else out
+
+    return apply_op("max_unpool2d", f, x, indices)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    x = _as_tensor(x)
+    if isinstance(output_size, int):
+        os3 = (output_size,) * 3
+    else:
+        os3 = tuple(output_size)
+
+    def f(a):
+        cl = data_format == "NDHWC"
+        axes = (1, 2, 3) if cl else (2, 3, 4)
+        sizes = [a.shape[i] for i in axes]
+        outs = [
+            os3[j] if os3[j] is not None else sizes[j] for j in range(3)
+        ]
+        if all(s % o == 0 for s, o in zip(sizes, outs)):
+            window = [1] * a.ndim
+            for j, ax in enumerate(axes):
+                window[ax] = sizes[j] // outs[j]
+            s = jax.lax.reduce_window(
+                a.astype(jnp.float32), 0.0, jax.lax.add, tuple(window),
+                tuple(window), "VALID",
+            )
+            k = 1
+            for j in range(3):
+                k *= sizes[j] // outs[j]
+            return (s / k).astype(a.dtype)
+        # exact floor/ceil-bucket means (see adaptive_avg_pool2d)
+        out = a.astype(jnp.float32)
+        for j, ax in enumerate(axes):
+            out = jnp.tensordot(
+                out, _adaptive_avg_matrix(sizes[j], outs[j]),
+                axes=[[ax], [1]],
+            )
+            out = jnp.moveaxis(out, -1, ax)
+        return out.astype(a.dtype)
+
+    return apply_op("adaptive_avg_pool3d", f, x)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    x = _as_tensor(x)
+    if isinstance(output_size, int):
+        os3 = (output_size,) * 3
+    else:
+        os3 = tuple(output_size)
+
+    def _sizes(a):
+        sizes = a.shape[2:]
+        outs = [
+            os3[j] if os3[j] is not None else sizes[j] for j in range(3)
+        ]
+        if not all(s % o == 0 for s, o in zip(sizes, outs)):
+            raise NotImplementedError(
+                "adaptive_max_pool3d requires input divisible by output"
+            )
+        return sizes, outs
+
+    def f(a):
+        sizes, outs = _sizes(a)
+        window = (1, 1) + tuple(s // o for s, o in zip(sizes, outs))
+        return jax.lax.reduce_window(
+            a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+            else jnp.iinfo(a.dtype).min,
+            jax.lax.max, window, window, "VALID",
+        )
+
+    out = apply_op("adaptive_max_pool3d", f, x)
+    if not return_mask:
+        return out
+
+    def fmask(a):
+        # divisible windows: reshape to expose each window, argmax over
+        # the window, convert to a flat D*H*W input index
+        sizes, outs = _sizes(a)
+        n, c = a.shape[0], a.shape[1]
+        (d, h, w), (od, oh, ow) = sizes, outs
+        kd, kh, kw = d // od, h // oh, w // ow
+        v = a.reshape(n, c, od, kd, oh, kh, ow, kw)
+        v = jnp.transpose(v, (0, 1, 2, 4, 6, 3, 5, 7))
+        v = v.reshape(n, c, od, oh, ow, kd * kh * kw)
+        loc = jnp.argmax(v, axis=-1)
+        ld = loc // (kh * kw)
+        lh = (loc // kw) % kh
+        lw = loc % kw
+        base_d = (jnp.arange(od) * kd)[None, None, :, None, None]
+        base_h = (jnp.arange(oh) * kh)[None, None, None, :, None]
+        base_w = (jnp.arange(ow) * kw)[None, None, None, None, :]
+        idx = (
+            (base_d + ld) * (h * w) + (base_h + lh) * w + (base_w + lw)
+        )
+        return idx.astype(jnp.int32)
+
+    mask = apply_op(
+        "adaptive_max_pool3d_mask", fmask, x, differentiable=False
+    )
+    return out, mask

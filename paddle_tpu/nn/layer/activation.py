@@ -241,3 +241,13 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self._axis)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._lower = lower
+        self._upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper, self.training)

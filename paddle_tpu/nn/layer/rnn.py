@@ -1,0 +1,499 @@
+"""Recurrent layers (upstream: python/paddle/nn/layer/rnn.py, kernels in
+paddle/phi/kernels/gpu/rnn_kernel.cu — cuDNN RNN).
+
+TPU-first design: the whole sequence loop is ONE ``lax.scan`` inside a
+single ``apply_op`` per (layer, direction) — XLA compiles the scan body
+once and keeps every gate matmul on the MXU; gradients flow through the
+scan's native vjp (no BPTT bookkeeping in Python). The input projection
+``x @ W_ihᵀ`` for all timesteps is hoisted out of the scan as one big
+batched matmul (seq*batch, 4H) — the classic TPU trick cuDNN performs
+internally.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op, _as_tensor
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = [
+    "SimpleRNNCell", "LSTMCell", "GRUCell", "RNNCellBase",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+class RNNCellBase(Layer):
+    """Base for single-step cells (upstream RNNCellBase)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        h = np.full((batch, self.hidden_size), init_value, "float32")
+        if getattr(self, "state_components", 1) == 2:
+            return (Tensor(h), Tensor(h.copy()))
+        return Tensor(h)
+
+
+def _uniform_init(hidden_size):
+    k = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-k, k)
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(x W_ihᵀ + b_ih + h W_hhᵀ + b_hh)."""
+
+    state_components = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        inputs = _as_tensor(inputs)
+        if states is None:
+            states = self.get_initial_states(inputs)
+        states = _as_tensor(states)
+        act = jnp.tanh if self.activation == "tanh" else (
+            lambda v: jnp.maximum(v, 0))
+
+        def f(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+
+        out = apply_op(
+            "simple_rnn_cell", f, inputs, states,
+            self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+        )
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    """Gate order i, f, g(cell), o — matching the reference layout."""
+
+    state_components = 2
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        inputs = _as_tensor(inputs)
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        h, c = _as_tensor(h), _as_tensor(c)
+
+        def f(x, hp, cp, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hp @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            cn = fg * cp + i * g
+            hn = o * jnp.tanh(cn)
+            return hn, cn
+
+        hn, cn = apply_op(
+            "lstm_cell", f, inputs, h, c,
+            self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+            n_outs=2,
+        )
+        return hn, (hn, cn)
+
+
+class GRUCell(RNNCellBase):
+    """Gate order r, z, c — reference (and cuDNN) convention with the
+    candidate using r * (h W_hcᵀ + b_hc)."""
+
+    state_components = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        inputs = _as_tensor(inputs)
+        if states is None:
+            states = self.get_initial_states(inputs)
+        states = _as_tensor(states)
+
+        def f(x, hp, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = hp @ wh.T + bh
+            xr, xz, xc = jnp.split(xg, 3, axis=-1)
+            hr, hz, hc = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            c = jnp.tanh(xc + r * hc)
+            return (1.0 - z) * c + z * hp
+
+        out = apply_op(
+            "gru_cell", f, inputs, states,
+            self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+        )
+        return out, out
+
+
+def _scan_layer(mode, x, h0, c0, wi, wh, bi, bh, reverse, seq_lens):
+    """One (layer, direction) pass: x (B, T, I) -> (B, T, H), hT[, cT].
+
+    Pure jnp: called inside apply_op. The input projection is hoisted
+    out of the scan; the scan body only does the (B,H)x(H,GH) recurrent
+    matmul + gate math.
+    """
+    xs = jnp.swapaxes(x, 0, 1)  # (T, B, I)
+    T = xs.shape[0]
+    xproj = xs @ wi.T + bi      # (T, B, G*H) — one big MXU matmul
+    if reverse:
+        xproj = jnp.flip(xproj, axis=0)
+    t_idx = jnp.arange(T)
+
+    def mask_step(t, new, old):
+        if seq_lens is None:
+            return new
+        # step t is valid for lanes with t < len (forward) or
+        # t >= T - len (reversed input)
+        if reverse:
+            ok = t >= (T - seq_lens)
+        else:
+            ok = t < seq_lens
+        return jnp.where(ok[:, None], new, old)
+
+    if mode == "LSTM":
+        def body(carry, inp):
+            hp, cp = carry
+            t, xp = inp
+            gates = xp + hp @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            cn = fg * cp + i * g
+            hn = o * jnp.tanh(cn)
+            hn = mask_step(t, hn, hp)
+            cn = mask_step(t, cn, cp)
+            return (hn, cn), hn
+
+        (hT, cT), ys = jax.lax.scan(body, (h0, c0), (t_idx, xproj))
+    elif mode == "GRU":
+        def body(hp, inp):
+            t, xp = inp
+            xr, xz, xc = jnp.split(xp, 3, axis=-1)
+            hg = hp @ wh.T + bh
+            hr, hz, hc = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            c = jnp.tanh(xc + r * hc)
+            hn = (1.0 - z) * c + z * hp
+            hn = mask_step(t, hn, hp)
+            return hn, hn
+
+        hT, ys = jax.lax.scan(body, h0, (t_idx, xproj))
+        cT = None
+    else:
+        act = jnp.tanh if mode == "RNN_TANH" else (
+            lambda v: jnp.maximum(v, 0))
+
+        def body(hp, inp):
+            t, xp = inp
+            hn = act(xp + hp @ wh.T + bh)
+            hn = mask_step(t, hn, hp)
+            return hn, hn
+
+        hT, ys = jax.lax.scan(body, h0, (t_idx, xproj))
+        cT = None
+
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    ys = jnp.swapaxes(ys, 0, 1)  # (B, T, H)
+    return ys, hT, cT
+
+
+class _MultiLayerRNN(Layer):
+    """Shared engine for SimpleRNN / LSTM / GRU (upstream rnn op)."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction in ("bidirectional", "bidirect"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(f"unsupported direction: {direction}")
+        self.mode = mode if mode != "RNN" else (
+            "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        )
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        gate_mult = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        init = _uniform_init(hidden_size)
+        self._param_names = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 else (
+                    hidden_size * self.num_directions
+                )
+                sfx = f"_l{layer}" + ("_reverse" if d == 1 else "")
+                names = []
+                for pname, shape, battr, is_bias in (
+                    (f"weight_ih{sfx}", [gate_mult * hidden_size, in_sz],
+                     weight_ih_attr, False),
+                    (f"weight_hh{sfx}",
+                     [gate_mult * hidden_size, hidden_size],
+                     weight_hh_attr, False),
+                    (f"bias_ih{sfx}", [gate_mult * hidden_size],
+                     bias_ih_attr, True),
+                    (f"bias_hh{sfx}", [gate_mult * hidden_size],
+                     bias_hh_attr, True),
+                ):
+                    p = self.create_parameter(
+                        shape, battr, is_bias=is_bias,
+                        default_initializer=init,
+                    )
+                    self.add_parameter(pname, p)
+                    names.append(pname)
+                self._param_names.append(names)
+
+    @property
+    def state_components(self):
+        return 2 if self.mode == "LSTM" else 1
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = _as_tensor(inputs)
+        x = inputs
+        if self.time_major:
+            from ...tensor.manipulation import transpose as _tp
+
+            x = _tp(x, [1, 0, 2])
+        batch = x.shape[0]
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+
+        if initial_states is None:
+            z = np.zeros((L * D, batch, H), "float32")
+            if self.mode == "LSTM":
+                initial_states = (Tensor(z), Tensor(z.copy()))
+            else:
+                initial_states = Tensor(z)
+        if self.mode == "LSTM":
+            h0_all, c0_all = initial_states
+            h0_all, c0_all = _as_tensor(h0_all), _as_tensor(c0_all)
+        else:
+            h0_all = _as_tensor(initial_states)
+            c0_all = None
+
+        seq = _as_tensor(sequence_length) if sequence_length is not None \
+            else None
+
+        params = []
+        for names in self._param_names:
+            params.extend(getattr(self, n) for n in names)
+
+        mode = self.mode
+        dropout = self.dropout
+        training = self.training
+
+        def f(xa, h0a, *rest):
+            idx = 0
+            c0a = None
+            if mode == "LSTM":
+                c0a = rest[0]
+                idx = 1
+            sl = None
+            if seq is not None:
+                sl = rest[idx]
+                idx += 1
+            flat_w = rest[idx:]
+            cur = xa
+            h_outs, c_outs = [], []
+            key = jax.random.PRNGKey(0)
+            for layer in range(L):
+                dir_outs = []
+                for d in range(D):
+                    slot = layer * D + d
+                    wi, wh, bi, bh = flat_w[4 * slot: 4 * slot + 4]
+                    ys, hT, cT = _scan_layer(
+                        mode, cur, h0a[slot],
+                        None if c0a is None else c0a[slot],
+                        wi, wh, bi, bh, reverse=(d == 1), seq_lens=sl,
+                    )
+                    dir_outs.append(ys)
+                    h_outs.append(hT)
+                    if cT is not None:
+                        c_outs.append(cT)
+                cur = (
+                    jnp.concatenate(dir_outs, axis=-1)
+                    if D == 2 else dir_outs[0]
+                )
+                if dropout > 0.0 and training and layer < L - 1:
+                    key, sub = jax.random.split(key)
+                    keep = jax.random.bernoulli(
+                        sub, 1.0 - dropout, cur.shape
+                    )
+                    cur = jnp.where(keep, cur / (1.0 - dropout), 0.0)
+            hs = jnp.stack(h_outs, axis=0)
+            if mode == "LSTM":
+                return cur, hs, jnp.stack(c_outs, axis=0)
+            return cur, hs
+
+        args = [x, h0_all]
+        if mode == "LSTM":
+            args.append(c0_all)
+        if seq is not None:
+            args.append(seq)
+        args.extend(params)
+
+        if mode == "LSTM":
+            out, hN, cN = apply_op(
+                "rnn_" + mode.lower(), f, *args, n_outs=3
+            )
+            final = (hN, cN)
+        else:
+            out, hN = apply_op("rnn_" + mode.lower(), f, *args, n_outs=2)
+            final = hN
+        if self.time_major:
+            from ...tensor.manipulation import transpose as _tp
+
+            out = _tp(out, [1, 0, 2])
+        return out, final
+
+
+class SimpleRNN(_MultiLayerRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation,
+                         **kwargs)
+
+
+class LSTM(_MultiLayerRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_MultiLayerRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class RNN(Layer):
+    """Generic wrapper running any single-step cell over a sequence
+    (upstream paddle.nn.RNN). Python-loop fallback — fine for custom
+    cells; the fused classes above are the fast path."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import stack as _stack
+        from ...tensor.manipulation import transpose as _tp
+
+        inputs = _as_tensor(inputs)
+        x = _tp(inputs, [1, 0, 2]) if self.time_major else inputs
+        T = x.shape[1]
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for t in order:
+            step_in = x[:, t]
+            out, states = self.cell(step_in, states)
+            outs[t] = out
+        y = _stack(outs, axis=1)
+        if self.time_major:
+            y = _tp(y, [1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (upstream
+    paddle.nn.BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat as _concat
+
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        y_fw, s_fw = self.rnn_fw(inputs, states_fw)
+        y_bw, s_bw = self.rnn_bw(inputs, states_bw)
+        return _concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)

@@ -1,0 +1,171 @@
+"""Short-time Fourier transforms (upstream: python/paddle/signal.py).
+
+TPU-first: framing is a static-shape gather (no dynamic slicing), the
+FFT is XLA's native HLO, and istft's overlap-add is a scatter-add —
+all fuse under jit and differentiate through JAX's fft rules.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.core import Tensor, apply_op, _as_tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice ``x`` into overlapping frames along ``axis``."""
+    x = _as_tensor(x)
+
+    def f(a):
+        ax = int(axis) % a.ndim
+        n = a.shape[ax]
+        n_frames = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        win = starts[:, None] + jnp.arange(frame_length)  # (F, L)
+        out = jnp.take(a, win.reshape(-1), axis=ax)
+        out = out.reshape(
+            a.shape[:ax] + (n_frames, frame_length) + a.shape[ax + 1:]
+        )
+        # reference layout: frame_length before num_frames
+        return jnp.swapaxes(out, ax, ax + 1)
+
+    return apply_op("frame", f, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of ``frame``: add overlapping frames (axis=-1 layout:
+    (..., frame_length, n_frames))."""
+    x = _as_tensor(x)
+
+    def f(a):
+        if axis in (-1, a.ndim - 1):
+            fl, nf = a.shape[-2], a.shape[-1]
+            frames = jnp.swapaxes(a, -1, -2)  # (..., nf, fl)
+        else:
+            fl, nf = a.shape[1], a.shape[0]
+            frames = jnp.moveaxis(a, 0, -2) if a.ndim > 2 else a.T
+            frames = frames.reshape((-1, nf, fl)) if a.ndim > 2 else \
+                frames[None]
+        n = (nf - 1) * hop_length + fl
+        starts = jnp.arange(nf) * hop_length
+        idx = starts[:, None] + jnp.arange(fl)  # (nf, fl)
+        flat_lead = frames.reshape((-1, nf, fl))
+        out = jnp.zeros((flat_lead.shape[0], n), a.dtype)
+        out = out.at[:, idx.reshape(-1)].add(
+            flat_lead.reshape(flat_lead.shape[0], -1)
+        )
+        if axis in (-1, a.ndim - 1):
+            return out.reshape(a.shape[:-2] + (n,))
+        if a.ndim == 2:
+            return out[0]
+        return jnp.moveaxis(
+            out.reshape(a.shape[2:] + (n,)), -1, 0
+        )
+
+    return apply_op("overlap_add", f, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """(batch, seq) -> (batch, n_fft//2+1 | n_fft, n_frames) complex
+    (upstream: python/paddle/signal.py stft)."""
+    x = _as_tensor(x)
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+    if window is not None:
+        window = _as_tensor(window)
+
+    def f(a, *w):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if w:
+            win = w[0].astype(jnp.float32)
+        else:
+            win = jnp.ones((wl,), jnp.float32)
+        # center-pad window to n_fft
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            win = jnp.pad(win, (lp, n_fft - wl - lp))
+        if center:
+            a = jnp.pad(
+                a, [(0, 0), (n_fft // 2, n_fft // 2)],
+                mode=pad_mode if pad_mode != "constant" else "constant",
+            )
+        n = a.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop
+        starts = jnp.arange(n_frames) * hop
+        idx = starts[:, None] + jnp.arange(n_fft)
+        frames = a[:, idx.reshape(-1)].reshape(
+            a.shape[0], n_frames, n_fft
+        ).astype(jnp.float32)
+        frames = frames * win[None, None, :]
+        spec = (
+            jnp.fft.rfft(frames, axis=-1) if onesided
+            else jnp.fft.fft(frames, axis=-1)
+        )
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        out = jnp.swapaxes(spec, -1, -2)  # (B, freq, frames)
+        return out[0] if squeeze else out
+
+    args = [x] + ([window] if window is not None else [])
+    return apply_op("stft", f, *args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (upstream:
+    python/paddle/signal.py istft)."""
+    x = _as_tensor(x)
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+    if window is not None:
+        window = _as_tensor(window)
+
+    def f(a, *w):
+        squeeze = a.ndim == 2
+        if squeeze:
+            a = a[None]
+        spec = jnp.swapaxes(a, -1, -2)  # (B, frames, freq)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (
+            jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+            else jnp.fft.ifft(spec, axis=-1).real
+        )
+        if w:
+            win = w[0].astype(jnp.float32)
+        else:
+            win = jnp.ones((wl,), jnp.float32)
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            win = jnp.pad(win, (lp, n_fft - wl - lp))
+        frames = frames * win[None, None, :]
+        nf = frames.shape[1]
+        n = (nf - 1) * hop + n_fft
+        starts = jnp.arange(nf) * hop
+        idx = (starts[:, None] + jnp.arange(n_fft)).reshape(-1)
+        out = jnp.zeros((frames.shape[0], n), jnp.float32)
+        out = out.at[:, idx].add(frames.reshape(frames.shape[0], -1))
+        env = jnp.zeros((n,), jnp.float32).at[idx].add(
+            jnp.tile(win * win, nf)
+        )
+        out = out / jnp.maximum(env, 1e-11)[None]
+        if center:
+            out = out[:, n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            if out.shape[1] < length:
+                out = jnp.pad(
+                    out, [(0, 0), (0, length - out.shape[1])]
+                )
+            out = out[:, :length]
+        return out[0] if squeeze else out
+
+    args = [x] + ([window] if window is not None else [])
+    return apply_op("istft", f, *args)

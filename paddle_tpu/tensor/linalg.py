@@ -313,3 +313,229 @@ def pdist(x, p=2.0, name=None):
         return d[jnp.asarray(iu[0]), jnp.asarray(iu[1])].astype(a.dtype)
 
     return apply_op("pdist", f, x)
+
+
+# -- extended decompositions / solvers (upstream: python/paddle/tensor/
+# linalg.py; kernels in paddle/phi/kernels/*). jnp.linalg lowers to XLA
+# primitives on TPU; general (non-symmetric) eigendecomposition has no
+# TPU lowering, so eig/eigvals run through a host callback like the
+# reference's CPU-fallback for lapack-only ops. -----------------------------
+def inv(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("inv", jnp.linalg.inv, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = axis if axis is None else (
+        tuple(axis) if isinstance(axis, (list, tuple)) else int(axis)
+    )
+
+    def f(a):
+        af = a.astype(jnp.float32) if a.dtype == jnp.bfloat16 else a
+        if p == float("inf"):
+            out = jnp.max(jnp.abs(af), axis=ax, keepdims=keepdim)
+        elif p == float("-inf"):
+            out = jnp.min(jnp.abs(af), axis=ax, keepdims=keepdim)
+        elif p == 0:
+            out = jnp.sum(af != 0, axis=ax, keepdims=keepdim).astype(af.dtype)
+        else:
+            out = jnp.sum(jnp.abs(af) ** p, axis=ax, keepdims=keepdim) \
+                ** (1.0 / p)
+        return out.astype(a.dtype)
+
+    return apply_op("vector_norm", f, x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = tuple(int(v) for v in axis)
+
+    def f(a):
+        # move the matrix axes to the trailing two dims (jnp's
+        # matrix_norm always reduces the last two)
+        a2 = jnp.moveaxis(a, ax, (-2, -1))
+        out = jnp.linalg.matrix_norm(a2, ord=p, keepdims=keepdim)
+        if keepdim:
+            out = jnp.moveaxis(out, (-2, -1), ax)
+        return out
+
+    return apply_op("matrix_norm", f, x)
+
+
+def cond(x, p=None, name=None):
+    x = _as_tensor(x)
+    return apply_op(
+        "cond", lambda a: jnp.linalg.cond(a, p=p), x,
+        differentiable=False,
+    )
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A X = B given the Cholesky factor of A (y)."""
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+    return apply_op(
+        "cholesky_solve",
+        lambda b, c: jax.scipy.linalg.cho_solve((c, not upper), b),
+        x, y,
+    )
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    x = _as_tensor(x)
+
+    def f(c):
+        eye = jnp.eye(c.shape[-1], dtype=c.dtype)
+        return jax.scipy.linalg.cho_solve((c, not upper), eye)
+
+    return apply_op("cholesky_inverse", f, x)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int32), sv
+
+    return apply_op("lstsq", f, x, y, n_outs=4)
+
+
+def matrix_exp(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("matrix_exp", jax.scipy.linalg.expm, x)
+
+
+def eig(x, name=None):
+    """General eigendecomposition. No TPU/XLA lowering exists (same gap
+    as the reference's GPU path, which falls back to CPU lapack —
+    paddle/phi/kernels/cpu/eig_kernel.cc); runs as a host callback."""
+    import numpy as _np
+
+    x = _as_tensor(x)
+
+    def host(a):
+        w, v = _np.linalg.eig(_np.asarray(a))
+        return w.astype(_np.complex64), v.astype(_np.complex64)
+
+    def f(a):
+        n = a.shape[-1]
+        out_shapes = (
+            jax.ShapeDtypeStruct(a.shape[:-1], jnp.complex64),
+            jax.ShapeDtypeStruct(a.shape[:-2] + (n, n), jnp.complex64),
+        )
+        return jax.pure_callback(host, out_shapes, a, vmap_method="sequential")
+
+    return apply_op("eig", f, x, n_outs=2, differentiable=False)
+
+
+def eigvals(x, name=None):
+    import numpy as _np
+
+    x = _as_tensor(x)
+
+    def host(a):
+        return _np.linalg.eigvals(_np.asarray(a)).astype(_np.complex64)
+
+    def f(a):
+        out_shape = jax.ShapeDtypeStruct(a.shape[:-1], jnp.complex64)
+        return jax.pure_callback(host, out_shape, a, vmap_method="sequential")
+
+    return apply_op("eigvals", f, x, differentiable=False)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu_factor output into P, L, U (upstream:
+    paddle/phi/kernels/impl/lu_unpack_kernel_impl.h)."""
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+
+    def f(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(
+            m, k, dtype=lu_.dtype
+        )
+        U = jnp.triu(lu_[..., :k, :])
+        # pivots -> permutation, batched: apply the row swaps in order
+        batch = piv.shape[:-1]
+        perm = jnp.broadcast_to(
+            jnp.arange(m, dtype=jnp.int32), batch + (m,)
+        )
+        for i in range(piv.shape[-1]):
+            j = piv[..., i:i + 1].astype(jnp.int32)  # (..., 1)
+            idx_i = jnp.full(batch + (1,), i, jnp.int32)
+            pi = jnp.take_along_axis(perm, idx_i, axis=-1)
+            pj = jnp.take_along_axis(perm, j, axis=-1)
+            perm = jnp.put_along_axis(perm, idx_i, pj, axis=-1,
+                                      inplace=False)
+            perm = jnp.put_along_axis(perm, j, pi, axis=-1,
+                                      inplace=False)
+        P = jnp.swapaxes(
+            jnp.take(jnp.eye(m, dtype=lu_.dtype), perm, axis=0), -1, -2
+        )
+        return P, L, U
+
+    return apply_op("lu_unpack", f, x, y, n_outs=3)
+
+
+def householder_product(x, tau, name=None):
+    """Accumulate Householder reflectors (geqrf convention) into Q
+    (upstream: paddle/phi/kernels/impl/qr_kernel_impl.h ormqr path)."""
+    x = _as_tensor(x)
+    tau = _as_tensor(tau)
+
+    return apply_op(
+        "householder_product",
+        lambda a, t: jax.lax.linalg.householder_product(a, t), x, tau,
+    )
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (upstream: python/paddle/tensor/linalg.py
+    svd_lowrank — Halko et al. subspace iteration)."""
+    x = _as_tensor(x)
+    rank = int(q)
+
+    def core(a):
+        m, n = a.shape[-2], a.shape[-1]
+        key = jax.random.PRNGKey(0)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, rank), a.dtype)
+        y = a @ omega
+        for _ in range(int(niter)):
+            y = a @ (a.swapaxes(-1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = qmat.swapaxes(-1, -2) @ a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u, s, vh.swapaxes(-1, -2)
+
+    if M is not None:
+        Mt = _as_tensor(M)
+        return apply_op(
+            "svd_lowrank", lambda a, mm: core(a - mm), x, Mt, n_outs=3
+        )
+    return apply_op("svd_lowrank", core, x, n_outs=3)
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by the Q of a geqrf factorization."""
+    x = _as_tensor(x)
+    tau = _as_tensor(tau)
+    other = _as_tensor(other)
+
+    def f(a, t, c):
+        m, n = a.shape[-2], a.shape[-1]
+        # full m x m Q: pad the reflector block with zero columns and
+        # zero taus (identity reflectors)
+        pad_a = [(0, 0)] * (a.ndim - 1) + [(0, m - n)]
+        pad_t = [(0, 0)] * (t.ndim - 1) + [(0, m - t.shape[-1])]
+        q = jax.lax.linalg.householder_product(
+            jnp.pad(a, pad_a), jnp.pad(t, pad_t)
+        )
+        if transpose:
+            q = q.swapaxes(-1, -2)
+        return (q @ c) if left else (c @ q)
+
+    return apply_op("ormqr", f, x, tau, other)

@@ -507,15 +507,17 @@ def tensor_split(x, num_or_indices, axis=0, name=None):
         len(spec) + 1 if isinstance(spec, list)
         else int(spec)
     )
-    out = apply_op(
-        "tensor_split",
-        lambda a: tuple(jnp.array_split(
-            a,
-            spec if isinstance(spec, int) else np.asarray(spec),
-            axis=int(axis),
-        )),
-        x, n_outs=n,
-    )
+    def fsplit(a):
+        if isinstance(spec, int):
+            return tuple(jnp.array_split(a, spec, axis=int(axis)))
+        # numpy/reference semantics allow indices past the dim size
+        # (empty trailing sections) — clamp before jnp.array_split,
+        # which would otherwise compute a negative section size
+        size = a.shape[int(axis)]
+        clamped = np.minimum(np.asarray(spec), size)
+        return tuple(jnp.array_split(a, clamped, axis=int(axis)))
+
+    out = apply_op("tensor_split", fsplit, x, n_outs=n)
     return list(out) if isinstance(out, tuple) else [out]
 
 

@@ -1,0 +1,167 @@
+"""paddle.distribution + paddle.signal tests (upstream analogs:
+test/distribution/test_distribution_*.py, test/legacy_test/
+test_stft_op.py, test_signal.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+D = paddle.distribution
+scipy_stats = pytest.importorskip("scipy.stats")
+
+
+def setup_module():
+    paddle.seed(123)
+
+
+class TestDistributionDensities:
+    def test_normal(self):
+        n = D.Normal(1.0, 2.0)
+        v = paddle.to_tensor(np.array(0.5, "float32"))
+        np.testing.assert_allclose(
+            n.log_prob(v).numpy(), scipy_stats.norm.logpdf(0.5, 1, 2),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            n.entropy().numpy(), scipy_stats.norm.entropy(1, 2),
+            rtol=1e-5,
+        )
+
+    @pytest.mark.parametrize("cls,args,ref", [
+        ("Beta", (2.0, 3.0),
+         lambda v: scipy_stats.beta.logpdf(v, 2, 3)),
+        ("Gamma", (2.0, 3.0),
+         lambda v: scipy_stats.gamma.logpdf(v, 2, scale=1 / 3)),
+        ("Laplace", (0.5, 2.0),
+         lambda v: scipy_stats.laplace.logpdf(v, 0.5, 2)),
+        ("Gumbel", (0.5, 2.0),
+         lambda v: scipy_stats.gumbel_r.logpdf(v, 0.5, 2)),
+        ("Cauchy", (0.5, 2.0),
+         lambda v: scipy_stats.cauchy.logpdf(v, 0.5, 2)),
+        ("Exponential", (1.5,),
+         lambda v: scipy_stats.expon.logpdf(v, scale=1 / 1.5)),
+    ])
+    def test_logpdf_vs_scipy(self, cls, args, ref):
+        d = getattr(D, cls)(*args)
+        v = 0.7
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(np.array(v, "float32"))).numpy(),
+            ref(v), rtol=1e-4,
+        )
+
+    def test_studentt_poisson_geometric(self):
+        t = D.StudentT(5.0, 0.0, 1.0)
+        np.testing.assert_allclose(
+            t.log_prob(paddle.to_tensor(np.array(0.3, "float32"))).numpy(),
+            scipy_stats.t.logpdf(0.3, 5), rtol=1e-5,
+        )
+        p = D.Poisson(3.0)
+        np.testing.assert_allclose(
+            p.log_prob(paddle.to_tensor(np.array(2.0, "float32"))).numpy(),
+            scipy_stats.poisson.logpmf(2, 3), rtol=1e-5,
+        )
+        g = D.Geometric(0.3)
+        np.testing.assert_allclose(
+            g.log_prob(paddle.to_tensor(np.array(4.0, "float32"))).numpy(),
+            scipy_stats.geom.logpmf(5, 0.3), rtol=1e-5,
+        )  # scipy counts trials, ours counts failures
+
+    def test_dirichlet_categorical(self):
+        c = np.array([1.0, 2.0, 3.0], "float32")
+        d = D.Dirichlet(paddle.to_tensor(c))
+        v = np.array([0.2, 0.3, 0.5], "float32")
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(v)).numpy(),
+            scipy_stats.dirichlet.logpdf(v, c), rtol=1e-5,
+        )
+        cat = D.Categorical(paddle.to_tensor(np.log(v)))
+        np.testing.assert_allclose(
+            cat.log_prob(paddle.to_tensor(np.array(2, "int64"))).numpy(),
+            np.log(0.5), rtol=1e-5,
+        )
+
+
+class TestSamplingAndGrad:
+    def test_moments(self):
+        n = D.Normal(1.0, 2.0).sample([20000])
+        assert abs(float(n.numpy().mean()) - 1.0) < 0.1
+        assert abs(float(n.numpy().std()) - 2.0) < 0.1
+        u = D.Uniform(-1.0, 3.0).sample([20000])
+        assert abs(float(u.numpy().mean()) - 1.0) < 0.1
+        b = D.Bernoulli(0.3).sample([20000])
+        assert abs(float(b.numpy().mean()) - 0.3) < 0.05
+
+    def test_rsample_pathwise_grad(self):
+        mu = paddle.to_tensor(np.array(0.0, "float32"),
+                              stop_gradient=False)
+        x = D.Normal(mu, 1.0).rsample([64])
+        x.mean().backward()
+        np.testing.assert_allclose(mu.grad.numpy(), 1.0, rtol=1e-5)
+
+    def test_multinomial_counts(self):
+        m = D.Multinomial(100, paddle.to_tensor(
+            np.array([0.2, 0.3, 0.5], "float32")))
+        s = m.sample([50])
+        counts = s.numpy().mean(0)
+        assert abs(counts.sum() - 100) < 1e-3
+        assert abs(counts[2] - 50) < 5
+
+    def test_categorical_sample_dist(self):
+        logits = paddle.to_tensor(np.log(
+            np.array([0.1, 0.6, 0.3], "float32")))
+        s = D.Categorical(logits).sample([20000]).numpy()
+        freq = np.bincount(s, minlength=3) / 20000
+        np.testing.assert_allclose(freq, [0.1, 0.6, 0.3], atol=0.03)
+
+
+class TestKL:
+    def test_normal_kl_closed_form(self):
+        kl = D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 2.0))
+        ref = np.log(2.0) + (1.0 + 1.0) / 8.0 - 0.5
+        np.testing.assert_allclose(kl.numpy(), ref, rtol=1e-5)
+
+    def test_kl_nonnegative_and_zero_on_self(self):
+        for p, q in [
+            (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)),
+            (D.Gamma(2.0, 1.0), D.Gamma(1.0, 2.0)),
+            (D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0)),
+            (D.Exponential(1.0), D.Exponential(2.0)),
+        ]:
+            assert float(D.kl_divergence(p, q).numpy()) > 0
+            same = D.kl_divergence(p, p)
+            np.testing.assert_allclose(same.numpy(), 0.0, atol=1e-5)
+
+    def test_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Beta(1.0, 1.0))
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 832).astype("float32")
+        win = np.hanning(128).astype("float32")
+        ours = paddle.signal.stft(
+            paddle.to_tensor(x), 128, hop_length=64,
+            window=paddle.to_tensor(win),
+        )
+        ref = torch.stft(
+            torch.tensor(x), 128, hop_length=64,
+            window=torch.tensor(win), return_complex=True,
+        )
+        np.testing.assert_allclose(
+            ours.numpy(), ref.numpy(), atol=1e-3
+        )
+        back = paddle.signal.istft(
+            ours, 128, hop_length=64, window=paddle.to_tensor(win),
+            length=832,
+        )
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-4)
+
+    def test_frame_overlap_add(self):
+        x = np.arange(100, dtype="float32")[None]
+        fr = paddle.signal.frame(paddle.to_tensor(x), 10, 10)
+        assert fr.shape == [1, 10, 10]
+        oa = paddle.signal.overlap_add(fr, 10)
+        np.testing.assert_allclose(oa.numpy(), x)

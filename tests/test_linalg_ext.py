@@ -1,0 +1,132 @@
+"""Extended linalg ops (upstream analogs: test/legacy_test/
+test_linalg_*.py, test_cholesky_solve_op.py, test_lu_unpack_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+L = paddle.linalg
+
+
+def _spd(n, seed=0):
+    a = np.random.RandomState(seed).randn(n, n).astype("float32")
+    return a @ a.T + n * np.eye(n, dtype="float32")
+
+
+class TestSolvers:
+    def test_inv(self):
+        a = _spd(4)
+        out = L.inv(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            out.numpy() @ a, np.eye(4), atol=1e-4
+        )
+
+    def test_cholesky_solve_lower_and_upper(self):
+        a = _spd(4)
+        b = np.random.RandomState(1).randn(4, 2).astype("float32")
+        cl = L.cholesky(paddle.to_tensor(a))
+        xs = L.cholesky_solve(paddle.to_tensor(b), cl)
+        np.testing.assert_allclose(a @ xs.numpy(), b, atol=1e-3)
+        cu = L.cholesky(paddle.to_tensor(a), upper=True)
+        xs2 = L.cholesky_solve(paddle.to_tensor(b), cu, upper=True)
+        np.testing.assert_allclose(a @ xs2.numpy(), b, atol=1e-3)
+
+    def test_cholesky_inverse(self):
+        a = _spd(5)
+        c = L.cholesky(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            L.cholesky_inverse(c).numpy() @ a, np.eye(5), atol=1e-3
+        )
+
+    def test_lstsq(self):
+        rng = np.random.RandomState(2)
+        a = rng.randn(8, 3).astype("float32")
+        x_true = rng.randn(3, 2).astype("float32")
+        b = a @ x_true
+        sol, res, rank, sv = L.lstsq(
+            paddle.to_tensor(a), paddle.to_tensor(b)
+        )
+        np.testing.assert_allclose(sol.numpy(), x_true, atol=1e-3)
+        assert int(rank.numpy()) == 3
+
+    def test_matrix_exp(self):
+        a = np.diag([1.0, 2.0]).astype("float32")
+        np.testing.assert_allclose(
+            L.matrix_exp(paddle.to_tensor(a)).numpy(),
+            np.diag(np.exp([1.0, 2.0])), rtol=1e-5,
+        )
+
+
+class TestDecompositions:
+    def test_eig_symmetric_matches_eigh(self):
+        a = _spd(4)
+        w, v = L.eig(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            np.sort(w.numpy().real),
+            np.sort(np.linalg.eigvalsh(a)), rtol=1e-4,
+        )
+        # right-eigenvector property A v = w v
+        av = a @ v.numpy()
+        wv = v.numpy() * w.numpy()[None, :]
+        np.testing.assert_allclose(av, wv, atol=1e-2)
+
+    def test_eigvals(self):
+        a = np.array([[0.0, 1.0], [-1.0, 0.0]], "float32")  # eigs +-i
+        w = L.eigvals(paddle.to_tensor(a)).numpy()
+        np.testing.assert_allclose(
+            np.sort(w.imag), [-1.0, 1.0], atol=1e-5
+        )
+
+    def test_lu_unpack_reconstructs(self):
+        a = _spd(5, seed=3)
+        lu_, piv = L.lu(paddle.to_tensor(a))
+        P, Lm, U = L.lu_unpack(lu_, piv)
+        np.testing.assert_allclose(
+            P.numpy() @ Lm.numpy() @ U.numpy(), a, atol=1e-3
+        )
+
+    def test_svd_lowrank_reconstructs_lowrank(self):
+        rng = np.random.RandomState(4)
+        base = rng.randn(10, 3).astype("float32")
+        a = base @ rng.randn(3, 8).astype("float32")  # rank 3
+        u, s, v = L.svd_lowrank(paddle.to_tensor(a), q=3, niter=4)
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, a, atol=1e-2)
+
+    def test_householder_product_orthonormal(self):
+        from jax._src.lax import linalg as lxl
+        import jax.numpy as jnp
+
+        m = np.random.RandomState(5).randn(6, 4).astype("float32")
+        a, tau = lxl.geqrf(jnp.asarray(m))
+        q = L.householder_product(
+            paddle.to_tensor(np.asarray(a)),
+            paddle.to_tensor(np.asarray(tau)),
+        )
+        np.testing.assert_allclose(
+            q.numpy().T @ q.numpy(), np.eye(4), atol=1e-4
+        )
+
+
+class TestNorms:
+    def test_vector_norm_orders(self):
+        x = np.array([3.0, -4.0], "float32")
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(L.vector_norm(t).numpy(), 5.0)
+        np.testing.assert_allclose(
+            L.vector_norm(t, p=1).numpy(), 7.0
+        )
+        np.testing.assert_allclose(
+            L.vector_norm(t, p=float("inf")).numpy(), 4.0
+        )
+        np.testing.assert_allclose(L.vector_norm(t, p=0).numpy(), 2.0)
+
+    def test_matrix_norm_and_cond(self):
+        a = np.diag([1.0, 4.0]).astype("float32")
+        np.testing.assert_allclose(
+            L.matrix_norm(paddle.to_tensor(a)).numpy(),
+            np.sqrt(17.0), rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            L.cond(paddle.to_tensor(a)).numpy(), 4.0, rtol=1e-4
+        )

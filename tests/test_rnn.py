@@ -1,0 +1,131 @@
+"""RNN family tests (upstream analogs: test/legacy_test/test_rnn_op.py,
+test_lstm_cell_error.py, test_rnn_cells.py). LSTM/GRU/SimpleRNN are
+checked against torch's cuDNN-convention reference implementation with
+copied weights."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+torch = pytest.importorskip("torch")
+
+
+def setup_module():
+    paddle.seed(11)
+
+
+def _copy_weights(ours, theirs, num_layers, bidirectional, gates):
+    with torch.no_grad():
+        for layer in range(num_layers):
+            for d in range(2 if bidirectional else 1):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                for kind in ("weight_ih", "weight_hh", "bias_ih",
+                             "bias_hh"):
+                    getattr(theirs, kind + sfx).copy_(
+                        torch.tensor(getattr(ours, kind + sfx).numpy())
+                    )
+
+
+class TestFusedRNNs:
+    B, T, I, H = 3, 7, 5, 6
+
+    def _x(self, seed=0):
+        return np.random.RandomState(seed).randn(
+            self.B, self.T, self.I
+        ).astype("float32")
+
+    @pytest.mark.parametrize("mode", ["LSTM", "GRU", "SimpleRNN"])
+    @pytest.mark.parametrize("bidir", [False, True])
+    def test_matches_torch(self, mode, bidir):
+        direction = "bidirectional" if bidir else "forward"
+        ours = getattr(nn, mode)(self.I, self.H, num_layers=2,
+                                 direction=direction)
+        t_cls = {"LSTM": torch.nn.LSTM, "GRU": torch.nn.GRU,
+                 "SimpleRNN": torch.nn.RNN}[mode]
+        theirs = t_cls(self.I, self.H, num_layers=2,
+                       bidirectional=bidir, batch_first=True)
+        _copy_weights(ours, theirs, 2, bidir, mode)
+        x = self._x()
+        out, st = ours(paddle.to_tensor(x))
+        t_out, t_st = theirs(torch.tensor(x))
+        np.testing.assert_allclose(
+            out.numpy(), t_out.detach().numpy(), atol=1e-5
+        )
+        if mode == "LSTM":
+            np.testing.assert_allclose(
+                st[0].numpy(), t_st[0].detach().numpy(), atol=1e-5
+            )
+            np.testing.assert_allclose(
+                st[1].numpy(), t_st[1].detach().numpy(), atol=1e-5
+            )
+        else:
+            np.testing.assert_allclose(
+                st.numpy(), t_st.detach().numpy(), atol=1e-5
+            )
+
+    def test_grad_flows(self):
+        lstm = nn.LSTM(self.I, self.H)
+        x = paddle.to_tensor(self._x(), stop_gradient=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        for p in lstm.parameters():
+            assert p.grad is not None, p.name
+
+    def test_sequence_length_masks_tail(self):
+        lstm = nn.LSTM(self.I, self.H)
+        x = self._x()
+        lens = np.array([7, 4, 2], "int32")
+        out, (h, _) = lstm(
+            paddle.to_tensor(x), sequence_length=paddle.to_tensor(lens)
+        )
+        # final state of lane 1 must equal the T=4 prefix run's final
+        out4, (h4, _) = lstm(paddle.to_tensor(x[1:2, :4]))
+        np.testing.assert_allclose(
+            h.numpy()[0, 1], h4.numpy()[0, 0], atol=1e-5
+        )
+
+    def test_time_major(self):
+        gru = nn.GRU(self.I, self.H, time_major=True)
+        x = self._x()
+        out_tm, _ = gru(paddle.to_tensor(x.transpose(1, 0, 2)))
+        assert out_tm.shape == [self.T, self.B, self.H]
+
+    def test_training_dropout_between_layers(self):
+        lstm = nn.LSTM(self.I, self.H, num_layers=2, dropout=0.5)
+        x = paddle.to_tensor(self._x())
+        lstm.eval()
+        a = lstm(x)[0].numpy()
+        b = lstm(x)[0].numpy()
+        np.testing.assert_array_equal(a, b)  # eval: deterministic
+
+
+class TestCellsAndWrappers:
+    def test_lstm_cell_step(self):
+        cell = nn.LSTMCell(4, 5)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        out, (h, c) = cell(x)
+        assert out.shape == [2, 5] and c.shape == [2, 5]
+
+    def test_rnn_wrapper_matches_fused(self):
+        paddle.seed(3)
+        cell = nn.SimpleRNNCell(4, 5)
+        rnn = nn.RNN(cell)
+        x = np.random.RandomState(1).randn(2, 6, 4).astype("float32")
+        y, h = rnn(paddle.to_tensor(x))
+        # manual unroll
+        ht = None
+        for t in range(6):
+            out, ht = cell(paddle.to_tensor(x[:, t]), ht)
+        np.testing.assert_allclose(
+            y.numpy()[:, -1], out.numpy(), atol=1e-6
+        )
+
+    def test_birnn_concat(self):
+        fw = nn.GRUCell(4, 5)
+        bw = nn.GRUCell(4, 5)
+        bi = nn.BiRNN(fw, bw)
+        x = paddle.to_tensor(np.random.randn(2, 6, 4).astype("float32"))
+        y, (sf, sb) = bi(x)
+        assert y.shape == [2, 6, 10]
