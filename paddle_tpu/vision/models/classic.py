@@ -1,0 +1,574 @@
+"""Classic CNN zoo: AlexNet, VGG, SqueezeNet, DenseNet, ShuffleNetV2,
+GoogLeNet, InceptionV3 (upstream: python/paddle/vision/models/*.py —
+same architecture tables, re-implemented on paddle_tpu.nn)."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D,
+    AvgPool2D,
+    BatchNorm2D,
+    Conv2D,
+    Dropout,
+    Layer,
+    Linear,
+    MaxPool2D,
+    ReLU,
+    Sequential,
+)
+from ...nn import functional as F
+
+__all__ = [
+    "AlexNet", "alexnet",
+    "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "DenseNet", "densenet121", "densenet161", "densenet169",
+    "densenet201",
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+    "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+    "GoogLeNet", "googlenet",
+    "InceptionV3", "inception_v3",
+]
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled")
+
+
+# ---------------------------------------------------------------------------
+# AlexNet (upstream alexnet.py)
+# ---------------------------------------------------------------------------
+class AlexNet(Layer):
+    def __init__(self, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, 2),
+            Conv2D(64, 192, 5, padding=2), ReLU(),
+            MaxPool2D(3, 2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(),
+            MaxPool2D(3, 2),
+        )
+        self.pool = AdaptiveAvgPool2D((6, 6))
+        self.classifier = Sequential(
+            Dropout(dropout), Linear(256 * 36, 4096), ReLU(),
+            Dropout(dropout), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.classifier(x.flatten(1))
+
+
+def alexnet(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return AlexNet(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# VGG (upstream vgg.py)
+# ---------------------------------------------------------------------------
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512,
+          "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512,
+          512, "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512,
+          512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((7, 7))
+        self.classifier = Sequential(
+            Linear(512 * 49, 4096), ReLU(), Dropout(),
+            Linear(4096, 4096), ReLU(), Dropout(),
+            Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return self.classifier(x.flatten(1))
+
+
+def _vgg_features(cfg, batch_norm=False):
+    layers = []
+    in_c = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(MaxPool2D(2, 2))
+        else:
+            layers.append(Conv2D(in_c, v, 3, padding=1))
+            if batch_norm:
+                layers.append(BatchNorm2D(v))
+            layers.append(ReLU())
+            in_c = v
+    return Sequential(*layers)
+
+
+def _vgg(cfg, batch_norm, pretrained, **kwargs):
+    _no_pretrained(pretrained)
+    return VGG(_vgg_features(_VGG_CFGS[cfg], batch_norm), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("A", batch_norm, pretrained, **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("B", batch_norm, pretrained, **kwargs)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("D", batch_norm, pretrained, **kwargs)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg("E", batch_norm, pretrained, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet (upstream squeezenet.py)
+# ---------------------------------------------------------------------------
+class Fire(Layer):
+    def __init__(self, in_c, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Conv2D(in_c, squeeze, 1)
+        self.e1 = Conv2D(squeeze, e1, 1)
+        self.e3 = Conv2D(squeeze, e3, 3, padding=1)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+
+        s = self.relu(self.squeeze(x))
+        return concat([self.relu(self.e1(s)), self.relu(self.e3(s))],
+                      axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, 2),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(), MaxPool2D(3, 2),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128),
+                MaxPool2D(3, 2),
+                Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256), Fire(512, 64, 256, 256),
+            )
+        self.classifier = Sequential(
+            Dropout(0.5), Conv2D(512, num_classes, 1), ReLU(),
+        )
+        self.pool = AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return self.pool(x).flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.1", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet (upstream densenet.py)
+# ---------------------------------------------------------------------------
+class _DenseLayer(Layer):
+    def __init__(self, in_c, growth, bn_size, drop_rate):
+        super().__init__()
+        self.bn1 = BatchNorm2D(in_c)
+        self.conv1 = Conv2D(in_c, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth)
+        self.conv2 = Conv2D(bn_size * growth, growth, 3, padding=1,
+                            bias_attr=False)
+        self.relu = ReLU()
+        self.drop_rate = drop_rate
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.drop_rate > 0:
+            out = F.dropout(out, self.drop_rate, training=self.training)
+        return concat([x, out], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = BatchNorm2D(in_c)
+        self.conv = Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.relu = ReLU()
+        self.pool = AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_DENSE_CFGS = {
+    121: (32, (6, 12, 24, 16), 64),
+    161: (48, (6, 12, 36, 24), 96),
+    169: (32, (6, 12, 32, 32), 64),
+    201: (32, (6, 12, 48, 32), 64),
+}
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        growth, block_cfg, num_init = _DENSE_CFGS[layers]
+        feats = [
+            Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(num_init), ReLU(), MaxPool2D(3, 2, padding=1),
+        ]
+        c = num_init
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth, bn_size, dropout))
+                c += growth
+            if i != len(block_cfg) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [BatchNorm2D(c), ReLU()]
+        self.features = Sequential(*feats)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return DenseNet(201, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2 (upstream shufflenetv2.py)
+# ---------------------------------------------------------------------------
+class _ShuffleUnit(Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out_c // 2
+        if stride == 2:
+            self.branch1 = Sequential(
+                Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
+                       bias_attr=False),
+                BatchNorm2D(in_c),
+                Conv2D(in_c, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), ReLU(),
+            )
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = Sequential(
+            Conv2D(b2_in, branch, 1, bias_attr=False),
+            BatchNorm2D(branch), ReLU(),
+            Conv2D(branch, branch, 3, stride=stride, padding=1,
+                   groups=branch, bias_attr=False),
+            BatchNorm2D(branch),
+            Conv2D(branch, branch, 1, bias_attr=False),
+            BatchNorm2D(branch), ReLU(),
+        )
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+
+        if self.stride == 2:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFGS = {
+    0.25: (24, (24, 48, 96), 512),
+    0.5: (24, (48, 96, 192), 1024),
+    1.0: (24, (116, 232, 464), 1024),
+    1.5: (24, (176, 352, 704), 1024),
+    2.0: (24, (244, 488, 976), 2048),
+}
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        init_c, stage_c, last_c = _SHUFFLE_CFGS[scale]
+        self.conv1 = Sequential(
+            Conv2D(3, init_c, 3, stride=2, padding=1, bias_attr=False),
+            BatchNorm2D(init_c), ReLU(),
+        )
+        self.pool1 = MaxPool2D(3, 2, padding=1)
+        stages = []
+        in_c = init_c
+        for stage_i, c in enumerate(stage_c):
+            repeats = (4, 8, 4)[stage_i]
+            stages.append(_ShuffleUnit(in_c, c, 2))
+            for _ in range(repeats - 1):
+                stages.append(_ShuffleUnit(c, c, 1))
+            in_c = c
+        self.stages = Sequential(*stages)
+        self.conv_last = Sequential(
+            Conv2D(in_c, last_c, 1, bias_attr=False),
+            BatchNorm2D(last_c), ReLU(),
+        )
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(last_c, num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.pool1(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _shufflenet(scale, pretrained, **kwargs):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(scale=scale, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, pretrained, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet / InceptionV3 (upstream googlenet.py, inceptionv3.py)
+# ---------------------------------------------------------------------------
+class _ConvBN(Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(in_c, out_c, kernel, stride=stride,
+                           padding=padding, bias_attr=False)
+        self.bn = BatchNorm2D(out_c)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _InceptionBlock(Layer):
+    """Classic GoogLeNet inception module."""
+
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, c1, 1)
+        self.b2 = Sequential(_ConvBN(in_c, c3r, 1),
+                             _ConvBN(c3r, c3, 3, padding=1))
+        self.b3 = Sequential(_ConvBN(in_c, c5r, 1),
+                             _ConvBN(c5r, c5, 5, padding=2))
+        self.b4_pool = MaxPool2D(3, 1, padding=1)
+        self.b4 = _ConvBN(in_c, pp, 1)
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+
+        return concat(
+            [self.b1(x), self.b2(x), self.b3(x),
+             self.b4(self.b4_pool(x))], axis=1,
+        )
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _ConvBN(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(3, 2, padding=1),
+            _ConvBN(64, 64, 1), _ConvBN(64, 192, 3, padding=1),
+            MaxPool2D(3, 2, padding=1),
+        )
+        self.inc3 = Sequential(
+            _InceptionBlock(192, 64, 96, 128, 16, 32, 32),
+            _InceptionBlock(256, 128, 128, 192, 32, 96, 64),
+            MaxPool2D(3, 2, padding=1),
+        )
+        self.inc4 = Sequential(
+            _InceptionBlock(480, 192, 96, 208, 16, 48, 64),
+            _InceptionBlock(512, 160, 112, 224, 24, 64, 64),
+            _InceptionBlock(512, 128, 128, 256, 24, 64, 64),
+            _InceptionBlock(512, 112, 144, 288, 32, 64, 64),
+            _InceptionBlock(528, 256, 160, 320, 32, 128, 128),
+            MaxPool2D(3, 2, padding=1),
+        )
+        self.inc5 = Sequential(
+            _InceptionBlock(832, 256, 160, 320, 32, 128, 128),
+            _InceptionBlock(832, 384, 192, 384, 48, 128, 128),
+        )
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return GoogLeNet(**kwargs)
+
+
+class _InceptionA(Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 64, 1)
+        self.b5 = Sequential(_ConvBN(in_c, 48, 1),
+                             _ConvBN(48, 64, 5, padding=2))
+        self.b3 = Sequential(_ConvBN(in_c, 64, 1),
+                             _ConvBN(64, 96, 3, padding=1),
+                             _ConvBN(96, 96, 3, padding=1))
+        self.pool = AvgPool2D(3, 1, padding=1)
+        self.bp = _ConvBN(in_c, pool_c, 1)
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+
+        return concat(
+            [self.b1(x), self.b5(x), self.b3(x), self.bp(self.pool(x))],
+            axis=1,
+        )
+
+
+class _InceptionRedA(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _ConvBN(in_c, 384, 3, stride=2)
+        self.b3d = Sequential(_ConvBN(in_c, 64, 1),
+                              _ConvBN(64, 96, 3, padding=1),
+                              _ConvBN(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    """Truncated-but-faithful InceptionV3: stem + A blocks + reduction
+    (the full 7x7-factorized B/C stages follow the same pattern; the
+    classifier operates on the 768-channel mid trunk)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3), MaxPool2D(3, 2),
+        )
+        self.blocks = Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64),
+            _InceptionA(288, 64), _InceptionRedA(288),
+        )
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(768, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return InceptionV3(**kwargs)

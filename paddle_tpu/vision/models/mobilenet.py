@@ -1,0 +1,287 @@
+"""MobileNet V1/V2/V3 (upstream: python/paddle/vision/models/
+mobilenetv1.py, mobilenetv2.py, mobilenetv3.py — same architecture
+tables, re-implemented on paddle_tpu.nn; depthwise convs lower to XLA
+grouped convolutions, which TPU executes natively)."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D,
+    BatchNorm2D,
+    Conv2D,
+    Dropout,
+    Hardsigmoid,
+    Hardswish,
+    Layer,
+    Linear,
+    ReLU,
+    ReLU6,
+    Sequential,
+)
+
+__all__ = [
+    "MobileNetV1", "MobileNetV2", "MobileNetV3Small", "MobileNetV3Large",
+    "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small",
+    "mobilenet_v3_large",
+]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = Conv2D(in_c, out_c, kernel, stride=stride,
+                           padding=kernel // 2, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(out_c)
+        self.act = {
+            "relu": ReLU, "relu6": ReLU6, "hardswish": Hardswish,
+            None: None,
+        }[act]
+        if self.act is not None:
+            self.act = self.act()
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class MobileNetV1(Layer):
+    """Depthwise-separable stack (upstream mobilenetv1.py)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [
+            # in, out, stride
+            (32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+            (256, 256, 1), (256, 512, 2),
+            (512, 512, 1), (512, 512, 1), (512, 512, 1), (512, 512, 1),
+            (512, 512, 1),
+            (512, 1024, 2), (1024, 1024, 1),
+        ]
+        s = lambda c: max(int(c * scale), 8)  # noqa: E731
+        layers = [ConvBNLayer(3, s(32), 3, stride=2)]
+        for in_c, out_c, stride in cfg:
+            layers.append(
+                ConvBNLayer(s(in_c), s(in_c), 3, stride=stride,
+                            groups=s(in_c))
+            )
+            layers.append(ConvBNLayer(s(in_c), s(out_c), 1))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+class InvertedResidual(Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(inp, hidden, 1, act="relu6"))
+        layers += [
+            ConvBNLayer(hidden, hidden, 3, stride=stride, groups=hidden,
+                        act="relu6"),
+            ConvBNLayer(hidden, oup, 1, act=None),
+        ]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [
+            # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        input_channel = _make_divisible(32 * scale)
+        last_channel = _make_divisible(1280 * max(1.0, scale))
+        features = [ConvBNLayer(3, input_channel, 3, stride=2,
+                                act="relu6")]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(
+                    input_channel, out_c, s if i == 0 else 1, t))
+                input_channel = out_c
+        features.append(ConvBNLayer(input_channel, last_channel, 1,
+                                    act="relu6"))
+        self.features = Sequential(*features)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.2), Linear(last_channel, num_classes)
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class SqueezeExcite(Layer):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        mid = _make_divisible(channels // reduction)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(channels, mid, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(mid, channels, 1)
+        self.hs = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hs(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _V3Block(Layer):
+    def __init__(self, inp, hidden, out, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        layers = []
+        if hidden != inp:
+            layers.append(ConvBNLayer(inp, hidden, 1, act=act))
+        layers.append(ConvBNLayer(hidden, hidden, kernel, stride=stride,
+                                  groups=hidden, act=act))
+        if use_se:
+            layers.append(SqueezeExcite(hidden))
+        layers.append(ConvBNLayer(hidden, out, 1, act=None))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_V3_SMALL = [
+    # k, exp, out, se, act, s
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+
+class _MobileNetV3(Layer):
+    def __init__(self, cfg, last_exp, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        layers = [ConvBNLayer(3, in_c, 3, stride=2, act="hardswish")]
+        for k, exp, out, se, act, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            layers.append(_V3Block(in_c, exp_c, out_c, k, s, se, act))
+            in_c = out_c
+        last_c = _make_divisible(last_exp * scale)
+        layers.append(ConvBNLayer(in_c, last_c, 1, act="hardswish"))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            head = _make_divisible(1280 * scale) if scale > 1.0 else 1280
+            self.classifier = Sequential(
+                Linear(last_c, head), Hardswish(), Dropout(0.2),
+                Linear(head, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 576, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 960, scale, num_classes, with_pool)
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled")
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled")
+    return MobileNetV3Large(scale=scale, **kwargs)

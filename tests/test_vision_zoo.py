@@ -1,0 +1,84 @@
+"""Vision model zoo tests (upstream analogs: test/legacy_test/
+test_mobilenet_v*.py, test_vision_models.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+
+def setup_module():
+    paddle.seed(5)
+
+
+def _x(size=64, batch=1):
+    return paddle.to_tensor(
+        np.random.RandomState(0).randn(batch, 3, size, size)
+        .astype("float32")
+    )
+
+
+SMALL_INPUT_MODELS = [
+    ("mobilenet_v1", {}),
+    ("mobilenet_v2", {}),
+    ("mobilenet_v3_small", {}),
+    ("mobilenet_v3_large", {}),
+    ("vgg11", {}),
+    ("densenet121", {}),
+    ("shufflenet_v2_x0_25", {}),
+    ("googlenet", {}),
+]
+
+
+class TestForwardShapes:
+    @pytest.mark.parametrize("name,kwargs", SMALL_INPUT_MODELS)
+    def test_small_input(self, name, kwargs):
+        m = getattr(M, name)(num_classes=7, **kwargs)
+        m.eval()
+        out = m(_x(64))
+        assert out.shape == [1, 7]
+
+    def test_imagenet_sized(self):
+        for name in ("alexnet", "squeezenet1_0", "inception_v3"):
+            m = getattr(M, name)(num_classes=7)
+            m.eval()
+            assert m(_x(224)).shape == [1, 7]
+
+    def test_scale_variants(self):
+        m = M.mobilenet_v2(scale=0.5, num_classes=3)
+        m.eval()
+        assert m(_x(64)).shape == [1, 3]
+
+    def test_pretrained_raises(self):
+        with pytest.raises(ValueError):
+            M.mobilenet_v2(pretrained=True)
+
+
+class TestTrainStep:
+    def test_mobilenet_v2_trains(self):
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as optim
+
+        m = M.mobilenet_v2(scale=0.25, num_classes=4)
+        opt = optim.SGD(0.005, parameters=m.parameters())
+        x = _x(32, batch=4)
+        y = paddle.to_tensor(np.array([0, 1, 2, 3], "int64"))
+        losses = []
+        for _ in range(8):
+            loss = F.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_state_dict_roundtrip(self):
+        m = M.shufflenet_v2_x0_25(num_classes=3)
+        sd = m.state_dict()
+        m2 = M.shufflenet_v2_x0_25(num_classes=3)
+        m2.set_state_dict(sd)
+        x = _x(64)
+        m.eval(), m2.eval()
+        np.testing.assert_allclose(
+            m(x).numpy(), m2(x).numpy(), atol=1e-6
+        )
