@@ -21,7 +21,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     run_backward(
         outputs, grad_outputs,
         retain_graph=bool(retain_graph) or create_graph,
-        capture=capture, accumulate=False,
+        capture=capture, accumulate=False, create_graph=create_graph,
     )
     results = []
     for t in inputs:
@@ -33,6 +33,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "(pass allow_unused=True to return None)"
                 )
             results.append(None)
+        elif isinstance(g, Tensor):
+            # create_graph path: grads are tape-connected Tensors
+            results.append(g)
         else:
             results.append(Tensor(g))
     return results
@@ -40,3 +43,6 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
 def is_pylayer_op(*a, **k):
     return False
+
+
+from .functional import hessian, jacobian  # noqa: E402

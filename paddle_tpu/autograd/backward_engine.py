@@ -42,13 +42,23 @@ def _accumulate(store, key, val):
 
 
 def run_backward(tensors, grad_tensors=None, retain_graph=False,
-                 capture=None, accumulate=True):
+                 capture=None, accumulate=True, create_graph=False):
     """Entry point for ``Tensor.backward`` / ``paddle.autograd.backward``.
 
     capture: optional dict {id(tensor): None} — filled with raw grads for
     those tensors (used by ``autograd.grad``). When ``accumulate`` is
     False leaf ``.grad`` is not touched.
+
+    create_graph: record every vjp computation back onto the tape (each
+    node's backward runs through ``apply_op`` with the node's inputs and
+    cotangents as differentiable inputs), so the captured grads are
+    themselves differentiable — double backward / paddle.grad(
+    create_graph=True) parity (upstream: egr::Backward create_graph).
     """
+    if create_graph:
+        return _run_backward_higher_order(
+            tensors, grad_tensors, retain_graph, capture, accumulate
+        )
     roots = [t for t in tensors if isinstance(t, Tensor)]
     if grad_tensors is None:
         grad_tensors = [None] * len(roots)
@@ -146,6 +156,132 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                     o = o_ref()
                     if o is not None and o._grad_node is node:
                         o._grad_node = None
+
+
+def _run_backward_higher_order(tensors, grad_tensors, retain_graph,
+                               capture, accumulate):
+    """create_graph=True walk: cotangents are Tensors and every node's
+    vjp is re-recorded through ``apply_op``, so the resulting grads are
+    tape-connected (differentiable)."""
+    from ..framework.core import apply_op
+
+    roots = [t for t in tensors if isinstance(t, Tensor)]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+
+    grads = {}  # id(Tensor) -> Tensor cotangent
+    keep = {}
+    for t, g in zip(roots, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar "
+                    f"outputs; got shape {t.shape}"
+                )
+            gt = Tensor(_ones_like(t._data))
+        else:
+            gt = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
+        cur = grads.get(id(t))
+        grads[id(t)] = gt if cur is None else cur + gt
+        keep[id(t)] = t
+
+    nodes = _collect_nodes(roots)
+
+    def _inexact(t):
+        return jnp.issubdtype(t._data.dtype, jnp.inexact)
+
+    for node in nodes:
+        out_grads = []
+        any_grad = False
+        for ref in node.out_refs:
+            o = ref()
+            g = grads.pop(id(o), None) if o is not None else None
+            out_grads.append(g)
+            any_grad = any_grad or g is not None
+        if not any_grad:
+            continue
+
+        for t, v in zip(node.in_tensors, node.in_versions):
+            if t._version != v:
+                raise RuntimeError(
+                    f"a tensor saved for backward of op '{node.name}' "
+                    f"was modified in place afterwards (version "
+                    f"{t._version} != saved {v})"
+                )
+
+        cot_tensors = [
+            g if g is not None else Tensor(jnp.zeros(shape, dtype))
+            for g, (shape, dtype) in zip(out_grads, node.out_avals)
+        ]
+
+        custom = getattr(node, "custom_vjp", None)
+        if custom is not None:
+            # custom vjps (PyLayer) close over saved raws; re-recording
+            # them keeps grads differentiable w.r.t. the cotangents
+            # (enough for grad-of-grad through the chain), though not
+            # w.r.t. values captured inside the closure.
+            def fn_custom(*cots, _c=custom):
+                return _c(tuple(cots))
+
+            in_grads = fn_custom(*(c._data for c in cot_tensors))
+            grad_ts = [
+                Tensor(g) if g is not None else None for g in in_grads
+            ]
+        else:
+            diff_idx = [
+                i for i, t in enumerate(node.in_tensors) if _inexact(t)
+            ]
+            if not diff_idx:
+                continue
+            n_in = len(node.in_tensors)
+
+            def fn_vjp(*args, _node=node, _diff=tuple(diff_idx),
+                       _n_in=n_in):
+                primals = args[:_n_in]
+                cots = args[_n_in:]
+                cot = cots[0] if _node.n_outs == 1 else tuple(cots)
+                _, vf = jax.vjp(_node.fn, *primals)
+                gs = vf(cot)
+                out = tuple(gs[i] for i in _diff)
+                return out[0] if len(out) == 1 else out
+
+            res = apply_op(
+                "grad::" + (node.name or "op"), fn_vjp,
+                *node.in_tensors, *cot_tensors,
+                n_outs=len(diff_idx),
+            )
+            if len(diff_idx) == 1:
+                res = (res,)
+            grad_ts = [None] * len(node.in_tensors)
+            for i, g in zip(diff_idx, res):
+                grad_ts[i] = g
+
+        for t, g in zip(node.in_tensors, grad_ts):
+            if t.stop_gradient or g is None:
+                continue
+            if t._grad_hooks:
+                for hook in list(t._grad_hooks):
+                    res_h = hook(g)
+                    if res_h is not None:
+                        g = res_h if isinstance(res_h, Tensor) \
+                            else Tensor(res_h)
+            if capture is not None and id(t) in capture:
+                cur = capture[id(t)]
+                capture[id(t)] = g if cur is None else cur + g
+            if t._grad_node is None:
+                if accumulate:
+                    if t._grad is None:
+                        t._grad = g
+                        t._grad.name = t.name + "@GRAD"
+                    else:
+                        t._grad = t._grad + g
+            else:
+                cur = grads.get(id(t))
+                grads[id(t)] = g if cur is None else cur + g
+                keep[id(t)] = t
+        # graph is kept: create_graph implies retain_graph
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):

@@ -1,0 +1,123 @@
+"""Functional higher-order autograd API (upstream:
+python/paddle/autograd/autograd.py jacobian/hessian).
+
+Built on the tape's ``create_graph`` backward: each Jacobian row is one
+backward pass with a one-hot cotangent, recorded back onto the tape so
+the result is differentiable (hessian = jacobian ∘ gradient).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from . import grad as _grad
+
+
+def _flat_size(shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """J[i, j] = d ys_flat[i] / d xs_flat[j], reshaped to
+    ys.shape + xs.shape (or (B, my, nx) with ``batch_axis=0``).
+
+    Unlike the reference's lazily-evaluated Jacobian object this
+    materializes eagerly; the result is differentiable, so
+    ``jacobian(jacobian(...))`` composes.
+    """
+    single_x = isinstance(xs, Tensor)
+    xs_list = [xs] if single_x else list(xs)
+    if not isinstance(ys, Tensor):
+        raise TypeError("ys must be a single Tensor")
+
+    from ..tensor.manipulation import reshape, stack
+
+    if batch_axis is None:
+        ny = _flat_size(ys.shape)
+        flat_y = reshape(ys, [ny])
+        rows = []
+        for i in range(ny):
+            onehot = np.zeros((ny,), "float32")
+            onehot[i] = 1.0
+            gs = _grad(
+                flat_y, xs_list,
+                grad_outputs=Tensor(jnp.asarray(onehot)),
+                create_graph=True, retain_graph=True,
+                allow_unused=True,
+            )
+            rows.append([
+                reshape(g, [-1]) if g is not None else Tensor(
+                    jnp.zeros((_flat_size(x.shape),), jnp.float32)
+                )
+                for g, x in zip(gs, xs_list)
+            ])
+        outs = []
+        for j, x in enumerate(xs_list):
+            J = stack([r[j] for r in rows], axis=0)  # (ny, nx)
+            outs.append(
+                reshape(J, list(ys.shape) + list(x.shape))
+            )
+        return outs[0] if single_x else tuple(outs)
+
+    if batch_axis != 0:
+        raise ValueError("batch_axis must be None or 0")
+    b = ys.shape[0]
+    my = _flat_size(ys.shape[1:])
+    flat_y = reshape(ys, [b, my])
+    rows = []
+    for i in range(my):
+        # one backward per output column; batches are independent, so a
+        # sum over the batch gives every batch row's gradient at once
+        col = flat_y[:, i].sum()
+        gs = _grad(col, xs_list, create_graph=True, retain_graph=True,
+                   allow_unused=True)
+        rows.append([
+            reshape(g, [b, -1]) if g is not None else Tensor(
+                jnp.zeros((b, _flat_size(x.shape[1:])), jnp.float32)
+            )
+            for g, x in zip(gs, xs_list)
+        ])
+    outs = []
+    for j, x in enumerate(xs_list):
+        J = stack([r[j] for r in rows], axis=1)  # (B, my, nx)
+        outs.append(J)
+    return outs[0] if single_x else tuple(outs)
+
+
+def hessian(ys, xs, batch_axis=None):
+    """H = d² ys / d xs², for scalar ``ys`` (per batch row with
+    ``batch_axis=0``). Shape xs.shape + xs.shape (single xs, no batch)
+    or (B, n, n)."""
+    if not isinstance(ys, Tensor):
+        raise TypeError("ys must be a single Tensor")
+    single_x = isinstance(xs, Tensor)
+    xs_list = [xs] if single_x else list(xs)
+
+    if batch_axis is None:
+        if ys.size != 1:
+            raise ValueError("hessian expects a scalar ys")
+        g = _grad(ys, xs_list, create_graph=True, retain_graph=True)
+        outs = [jacobian(gi, xi) for gi, xi in zip(g, xs_list)]
+        return outs[0] if single_x else tuple(outs)
+
+    if batch_axis != 0:
+        raise ValueError("batch_axis must be None or 0")
+    from ..tensor.manipulation import reshape
+
+    b = ys.shape[0]
+    if _flat_size(ys.shape) != b:
+        raise ValueError(
+            "hessian with batch_axis=0 expects ys of shape (B,) or (B, 1)"
+        )
+    total = ys.sum()
+    g = _grad(total, xs_list, create_graph=True, retain_graph=True)
+    outs = [
+        jacobian(reshape(gi, [b, -1]), xi, batch_axis=0)
+        for gi, xi in zip(g, xs_list)
+    ]
+    return outs[0] if single_x else tuple(outs)

@@ -1,0 +1,96 @@
+"""Higher-order autograd: create_graph, double backward, jacobian,
+hessian (upstream analogs: test/legacy_test/test_autograd_functional*,
+test_imperative_double_grad.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a, sg=False):
+    return paddle.to_tensor(np.asarray(a, "float32"), stop_gradient=sg)
+
+
+class TestDoubleBackward:
+    def test_triple_derivative(self):
+        x = _t(2.0)
+        y = x * x * x
+        g1 = paddle.grad(y, x, create_graph=True)[0]
+        np.testing.assert_allclose(g1.numpy(), 12.0)
+        g2 = paddle.grad(g1, x, create_graph=True)[0]
+        np.testing.assert_allclose(g2.numpy(), 12.0)
+        g3 = paddle.grad(g2, x)[0]
+        np.testing.assert_allclose(g3.numpy(), 6.0)
+
+    def test_gradient_penalty_backward(self):
+        w = _t([1.0, 2.0])
+        out = (w * w).sum()
+        gw = paddle.grad(out, w, create_graph=True)[0]
+        penalty = (gw * gw).sum()
+        penalty.backward()
+        np.testing.assert_allclose(w.grad.numpy(), [8.0, 16.0])
+
+    def test_create_graph_through_matmul(self):
+        a = _t(np.random.RandomState(0).randn(3, 3))
+        x = _t(np.random.RandomState(1).randn(3))
+        # f = x^T A x; grad = (A + A^T) x; hessian = A + A^T
+        f = (x * (a @ x)).sum()
+        g = paddle.grad(f, x, create_graph=True)[0]
+        ref_g = (a.numpy() + a.numpy().T) @ x.numpy()
+        np.testing.assert_allclose(g.numpy(), ref_g, rtol=1e-5)
+        g2 = paddle.grad(g.sum(), x)[0]
+        np.testing.assert_allclose(
+            g2.numpy(), (a.numpy() + a.numpy().T).sum(0), rtol=1e-5
+        )
+
+    def test_mixed_partials(self):
+        x = _t(1.5)
+        y = _t(2.5)
+        f = x * x * y
+        gx = paddle.grad(f, x, create_graph=True)[0]  # 2xy
+        gxy = paddle.grad(gx, y)[0]  # 2x
+        np.testing.assert_allclose(gxy.numpy(), 3.0)
+
+
+class TestJacobianHessian:
+    def test_jacobian_diag(self):
+        x = _t([1.0, 2.0, 3.0])
+        J = paddle.autograd.jacobian(x * x, x)
+        np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0, 6.0]))
+
+    def test_jacobian_matmul(self):
+        a = np.random.RandomState(0).randn(4, 3).astype("float32")
+        x = _t(np.random.RandomState(1).randn(3))
+        J = paddle.autograd.jacobian(paddle.to_tensor(a) @ x, x)
+        np.testing.assert_allclose(J.numpy(), a, rtol=1e-5)
+
+    def test_jacobian_multi_xs(self):
+        x = _t([1.0, 2.0])
+        y = _t([3.0, 4.0])
+        Jx, Jy = paddle.autograd.jacobian(x * y, [x, y])
+        np.testing.assert_allclose(Jx.numpy(), np.diag([3.0, 4.0]))
+        np.testing.assert_allclose(Jy.numpy(), np.diag([1.0, 2.0]))
+
+    def test_jacobian_batched(self):
+        xb = _t(np.arange(6).reshape(3, 2))
+        Jb = paddle.autograd.jacobian(xb ** 2, xb, batch_axis=0)
+        assert Jb.shape == [3, 2, 2]
+        np.testing.assert_allclose(
+            Jb.numpy()[1], np.diag([4.0, 6.0])
+        )
+
+    def test_hessian_quadratic(self):
+        a = np.random.RandomState(0).randn(3, 3).astype("float32")
+        x = _t(np.random.RandomState(1).randn(3))
+        f = (x * (paddle.to_tensor(a) @ x)).sum()
+        H = paddle.autograd.hessian(f, x)
+        np.testing.assert_allclose(H.numpy(), a + a.T, rtol=1e-4)
+
+    def test_hessian_batched(self):
+        xb = _t(np.random.RandomState(2).randn(4, 3))
+        yb = (xb ** 3).sum(axis=1)
+        Hb = paddle.autograd.hessian(yb, xb, batch_axis=0)
+        assert Hb.shape == [4, 3, 3]
+        np.testing.assert_allclose(
+            Hb.numpy()[0], np.diag(6.0 * xb.numpy()[0]), rtol=1e-4
+        )
