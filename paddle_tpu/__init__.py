@@ -117,6 +117,7 @@ def _lazy_imports():
     from . import fft  # noqa
     from . import signal  # noqa
     from . import distribution  # noqa
+    from . import audio  # noqa
     from . import inference  # noqa
     from . import sparse  # noqa
     from . import nn  # noqa

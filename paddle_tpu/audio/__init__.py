@@ -1,0 +1,19 @@
+"""Audio feature extraction (upstream: python/paddle/audio/ —
+features/layers.py, functional/functional.py, functional/window.py).
+
+TPU-first: everything reduces to the stft in ``paddle_tpu.signal`` (XLA
+FFT HLO) plus small dense matmuls (mel filterbank, DCT) that ride the
+MXU; all ops run through the tape and are differentiable.
+"""
+from . import functional  # noqa
+from .features import (  # noqa
+    LogMelSpectrogram,
+    MelSpectrogram,
+    MFCC,
+    Spectrogram,
+)
+
+__all__ = [
+    "functional", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
+    "MFCC",
+]

@@ -1,0 +1,121 @@
+"""Audio feature layers (upstream: python/paddle/audio/features/
+layers.py — Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import apply_op, _as_tensor
+from ..nn.layer.layers import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window",
+            AF.get_window(window, self.win_length, fftbins=True,
+                          dtype=dtype),
+        )
+
+    def forward(self, x):
+        from ..signal import stft
+
+        spec = stft(
+            x, self.n_fft, hop_length=self.hop_length,
+            win_length=self.win_length, window=self.window,
+            center=self.center, pad_mode=self.pad_mode,
+        )
+
+        def f(s):
+            mag = jnp.abs(s)
+            if self.power == 1.0:
+                return mag
+            return mag ** self.power
+
+        return apply_op("spectrogram_power", f, spec)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(
+            n_fft, hop_length, win_length, window, power, center,
+            pad_mode, dtype,
+        )
+        self.n_mels = n_mels
+        self.register_buffer(
+            "fbank_matrix",
+            AF.compute_fbank_matrix(
+                sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype
+            ),
+        )
+
+    def forward(self, x):
+        spec = self.spectrogram(x)  # (..., freq, frames)
+        fb = self.fbank_matrix
+
+        def f(s, w):
+            return jnp.einsum("mf,...ft->...mt", w, s)
+
+        return apply_op("mel_project", f, spec, fb)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype,
+        )
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(
+            self.mel(x), self.ref_value, self.amin, self.top_db
+        )
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype,
+        )
+        self.register_buffer(
+            "dct_matrix", AF.create_dct(n_mfcc, n_mels, dtype=dtype)
+        )
+
+    def forward(self, x):
+        logmel = self.log_mel(x)  # (..., n_mels, frames)
+        d = self.dct_matrix
+
+        def f(s, w):
+            return jnp.einsum("mk,...mt->...kt", w, s)
+
+        return apply_op("mfcc_dct", f, logmel, d)

@@ -1,0 +1,208 @@
+"""Audio functional utilities (upstream: python/paddle/audio/functional/
+{functional.py, window.py})."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+
+__all__ = [
+    "get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+    "fft_frequencies", "compute_fbank_matrix", "create_dct",
+    "power_to_db",
+]
+
+
+def hz_to_mel(freq, htk=False):
+    """Hertz -> mel. Slaney formula by default (matches the reference);
+    ``htk=True`` uses the HTK formula."""
+    scalar = isinstance(freq, (int, float))
+    f = np.asarray(freq, np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(
+            f >= min_log_hz,
+            min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz)
+            / logstep,
+            mel,
+        )
+    return float(mel) if scalar else mel
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = isinstance(mel, (int, float))
+    m = np.asarray(mel, np.float64)
+    if htk:
+        f = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        f = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        f = np.where(
+            m >= min_log_mel,
+            min_log_hz * np.exp(logstep * (m - min_log_mel)),
+            f,
+        )
+    return float(f) if scalar else f
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = np.linspace(low, high, n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return np.linspace(0, sr / 2.0, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """(n_mels, 1 + n_fft//2) triangular mel filterbank (upstream:
+    audio/functional/functional.py compute_fbank_matrix)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """(n_mels, n_mfcc) DCT-II matrix (upstream create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(dct.astype(dtype))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Named window function (upstream audio/functional/window.py).
+    ``fftbins=True`` gives the periodic variant (DFT-even)."""
+    if isinstance(window, (tuple, list)):
+        name, *args = window
+    else:
+        name, args = window, []
+    m = win_length + 1 if fftbins else win_length
+    n = np.arange(m, dtype=np.float64)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / (m - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / (m - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * n / (m - 1))
+             + 0.08 * np.cos(4 * math.pi * n / (m - 1)))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2.0 * n / (m - 1) - 1.0)
+    elif name == "bohman":
+        x = np.abs(2.0 * n / (m - 1) - 1.0)
+        w = (1 - x) * np.cos(math.pi * x) + np.sin(math.pi * x) / math.pi
+    elif name == "rect" or name == "boxcar":
+        w = np.ones(m)
+    elif name == "gaussian":
+        std = args[0] if args else 0.4 * (m - 1) / 2.0
+        w = np.exp(-0.5 * ((n - (m - 1) / 2.0) / std) ** 2)
+    elif name == "general_gaussian":
+        p = args[0] if args else 1.0
+        sig = args[1] if len(args) > 1 else (m - 1) / 4.0
+        w = np.exp(-0.5 * np.abs((n - (m - 1) / 2.0) / sig) ** (2 * p))
+    elif name == "exponential":
+        tau = args[0] if args else 1.0
+        w = np.exp(-np.abs(n - (m - 1) / 2.0) / tau)
+    elif name == "triang":
+        w = 1.0 - np.abs(2.0 * (n + 1) / (m + 1) - 1.0)
+    elif name in ("cosine", "sine"):
+        w = np.sin(math.pi * (n + 0.5) / m)
+    elif name == "taylor":
+        # 4-term Taylor window, 30 dB sidelobe (scipy default)
+        nbar, sll = 4, 30.0
+        b = 10 ** (sll / 20)
+        a = math.acosh(b) / math.pi
+        s2 = nbar ** 2 / (a ** 2 + (nbar - 0.5) ** 2)
+        ma = np.arange(1, nbar)
+        fm = np.empty(nbar - 1)
+        signs = np.empty_like(ma, float)
+        signs[::2] = 1
+        signs[1::2] = -1
+        m2 = ma ** 2
+        for mi, _ in enumerate(ma):
+            numer = signs[mi] * np.prod(
+                1 - m2[mi] / s2 / (a ** 2 + (ma - 0.5) ** 2)
+            )
+            denom = 2 * np.prod(
+                [1 - m2[mi] / m2[j] for j in range(len(ma)) if j != mi]
+            )
+            fm[mi] = numer / denom
+        w = np.ones(m)
+        for mi, _ in enumerate(ma):
+            w += 2 * fm[mi] * np.cos(
+                2 * math.pi * ma[mi] * (n - (m - 1) / 2.0) / m
+            )
+        w /= w.max()
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        from scipy.special import i0 as _i0
+
+        alpha = (m - 1) / 2.0
+        w = _i0(beta * np.sqrt(
+            1 - ((n - alpha) / alpha) ** 2)) / _i0(beta)
+    elif name == "nuttall":
+        a = (0.3635819, 0.4891775, 0.1365995, 0.0106411)
+        fac = 2 * math.pi * n / (m - 1)
+        w = (a[0] - a[1] * np.cos(fac) + a[2] * np.cos(2 * fac)
+             - a[3] * np.cos(3 * fac))
+    elif name == "tukey":
+        alpha = args[0] if args else 0.5
+        w = np.ones(m)
+        edge = int(alpha * (m - 1) / 2.0)
+        ramp = n[:edge + 1]
+        w[:edge + 1] = 0.5 * (1 + np.cos(
+            math.pi * (2 * ramp / (alpha * (m - 1)) - 1)))
+        w[-(edge + 1):] = w[:edge + 1][::-1]
+    else:
+        raise ValueError(f"unknown window: {name!r}")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(w.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10 * log10(spect / ref) with amin floor and top_db clamp."""
+    spect = _as_tensor(spect)
+
+    def f(s):
+        sf = s.astype(jnp.float32)
+        log_spec = 10.0 * jnp.log10(jnp.maximum(sf, amin))
+        log_spec = log_spec - 10.0 * jnp.log10(
+            jnp.maximum(jnp.asarray(ref_value, jnp.float32), amin)
+        )
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    return apply_op("power_to_db", f, spect)

@@ -201,3 +201,131 @@ class GaussianNLLLoss(Layer):
 
     def forward(self, input, label, variance):
         return F.gaussian_nll_loss(input, label, variance, *self._args)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Efficient softmax approximation with frequency-ordered clusters
+    (upstream: python/paddle/nn/layer/loss.py AdaptiveLogSoftmaxWithLoss).
+
+    TPU-first: instead of gathering per-cluster sample subsets (dynamic
+    shapes), every tail projection is evaluated for the full batch and
+    the per-sample result is selected with masks — static shapes, all
+    matmuls, XLA-friendly. Costs extra FLOPs on small tails, which is
+    the cheap side of the tradeoff on an MXU.
+    """
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (cutoffs != sorted(cutoffs) or min(cutoffs) <= 0
+                or max(cutoffs) > n_classes - 1
+                or len(set(cutoffs)) != len(cutoffs)):
+            raise ValueError(
+                "cutoffs must be unique, positive, increasing, and "
+                "< n_classes"
+            )
+        from .common import Linear
+        from .layers import Sequential
+
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.shortlist_size = self.cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.shortlist_size + self.n_clusters
+        self.head = Linear(in_features, self.head_size,
+                           bias_attr=head_bias if head_bias else False)
+        self.tail = []
+        for i in range(self.n_clusters):
+            hsz = int(in_features // (div_value ** (i + 1)))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = Sequential(
+                Linear(in_features, hsz, bias_attr=False),
+                Linear(hsz, osz, bias_attr=False),
+            )
+            self.add_sublayer(f"tail_{i}", proj)
+            self.tail.append(proj)
+
+    def _head_logprob(self, input):
+        import jax
+
+        from ...framework.core import apply_op
+
+        head_out = self.head(input)
+        return apply_op(
+            "log_softmax", lambda a: jax.nn.log_softmax(a, -1), head_out
+        )
+
+    def forward(self, input, label):
+        import jax
+        import jax.numpy as jnp
+
+        from ...framework.core import apply_op, _as_tensor
+
+        input = _as_tensor(input)
+        label = _as_tensor(label)
+        head_logp = self._head_logprob(input)
+        tail_logps = [
+            t(input) for t in self.tail
+        ]  # raw logits; softmax inside f
+
+        cutoffs = self.cutoffs
+        shortlist = self.shortlist_size
+
+        def f(hlp, lab, *tails):
+            lab = lab.astype(jnp.int32)
+            # shortlist branch
+            safe_short = jnp.clip(lab, 0, shortlist - 1)
+            out = jnp.take_along_axis(
+                hlp, safe_short[:, None], axis=1
+            )[:, 0]
+            in_short = lab < shortlist
+            for i, tl in enumerate(tails):
+                lo, hi = cutoffs[i], cutoffs[i + 1]
+                t_logp = jax.nn.log_softmax(tl, -1)
+                rel = jnp.clip(lab - lo, 0, hi - lo - 1)
+                t_val = jnp.take_along_axis(
+                    t_logp, rel[:, None], axis=1
+                )[:, 0]
+                cluster_lp = hlp[:, shortlist + i] + t_val
+                sel = (lab >= lo) & (lab < hi)
+                out = jnp.where(sel, cluster_lp, out)
+            loss = -jnp.mean(out)
+            return out, loss
+
+        out, loss = apply_op(
+            "adaptive_logsoftmax", f, head_logp, label, *tail_logps,
+            n_outs=2,
+        )
+        return out, loss
+
+    def log_prob(self, input):
+        import jax
+        import jax.numpy as jnp
+
+        from ...framework.core import apply_op, _as_tensor
+
+        input = _as_tensor(input)
+        head_logp = self._head_logprob(input)
+        tail_logps = [t(input) for t in self.tail]
+        cutoffs = self.cutoffs
+        shortlist = self.shortlist_size
+
+        def f(hlp, *tails):
+            parts = [hlp[:, :shortlist]]
+            for i, tl in enumerate(tails):
+                t_logp = jax.nn.log_softmax(tl, -1)
+                parts.append(hlp[:, shortlist + i:shortlist + i + 1]
+                             + t_logp)
+            return jnp.concatenate(parts, axis=1)
+
+        return apply_op(
+            "adaptive_log_prob", f, head_logp, *tail_logps
+        )
+
+    def predict(self, input):
+        from ...tensor.search import argmax
+
+        return argmax(self.log_prob(input), axis=1)

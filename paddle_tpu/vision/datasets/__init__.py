@@ -133,3 +133,92 @@ class MNIST(Dataset):
 
 class FashionMNIST(MNIST):
     pass
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                  ".tif", ".tiff", ".webp")
+
+
+def _pil_loader(path):
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        img = Image.open(f)
+        return img.convert("RGB")
+
+
+class DatasetFolder(Dataset):
+    """Generic class-per-subdirectory dataset (upstream:
+    python/paddle/vision/datasets/folder.py DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _pil_loader
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(tuple(extensions))
+
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(d)):
+                for fname in sorted(files):
+                    p = os.path.join(dirpath, fname)
+                    if is_valid_file(p):
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+        self.targets = [s[1] for s in self.samples]
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive image list without labels (upstream ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _pil_loader
+        extensions = extensions or IMG_EXTENSIONS
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(tuple(extensions))
+
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                p = os.path.join(dirpath, fname)
+                if is_valid_file(p):
+                    self.samples.append(p)
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,171 @@
+"""paddle.audio + AdaptiveLogSoftmaxWithLoss + folder datasets
+(upstream analogs: test/legacy_test/test_audio_functions.py,
+test_adaptive_log_softmax_with_loss.py, test_datasets.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def setup_module():
+    paddle.seed(9)
+
+
+class TestAudioFunctional:
+    def test_windows_match_scipy(self):
+        ss = pytest.importorskip("scipy.signal")
+        for name in ("hann", "hamming", "blackman", "bartlett",
+                     "nuttall", "cosine", "taylor", "triang"):
+            ours = paddle.audio.functional.get_window(name, 64).numpy()
+            ref = ss.get_window(name, 64, fftbins=True)
+            np.testing.assert_allclose(ours, ref, atol=1e-5,
+                                       err_msg=name)
+
+    def test_mel_hz_roundtrip(self):
+        AF = paddle.audio.functional
+        freqs = np.array([0.0, 440.0, 1000.0, 4000.0, 8000.0])
+        back = AF.mel_to_hz(AF.hz_to_mel(freqs))
+        np.testing.assert_allclose(back, freqs, rtol=1e-6)
+        back_htk = AF.mel_to_hz(AF.hz_to_mel(freqs, htk=True), htk=True)
+        np.testing.assert_allclose(back_htk, freqs, rtol=1e-6)
+
+    def test_fbank_partition_of_unity_interior(self):
+        # slaney-normed filters tile the interior spectrum smoothly
+        fb = paddle.audio.functional.compute_fbank_matrix(
+            16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], "float32"))
+        db = paddle.audio.functional.power_to_db(x, top_db=None)
+        np.testing.assert_allclose(db.numpy(), [0.0, 10.0, 20.0],
+                                   atol=1e-5)
+
+
+class TestAudioFeatures:
+    def _tone(self, f=440, sr=16000):
+        t = np.arange(sr, dtype="float32") / sr
+        return paddle.to_tensor(np.sin(2 * np.pi * f * t)[None])
+
+    def test_spectrogram_peak_bin(self):
+        x = self._tone(440)
+        spec = paddle.audio.Spectrogram(n_fft=512)(x)
+        peak = int(np.argmax(spec.numpy()[0].mean(-1)))
+        assert abs(peak - round(440 * 512 / 16000)) <= 1
+
+    def test_mel_pipeline_shapes_and_grad(self):
+        x = self._tone()
+        x.stop_gradient = False
+        mfcc = paddle.audio.MFCC(sr=16000, n_mfcc=13, n_fft=512,
+                                 n_mels=40)(x)
+        assert mfcc.shape[1] == 13
+        mfcc.sum().backward()
+        assert x.grad is not None
+
+    def test_logmel_top_db_floor(self):
+        x = self._tone()
+        lm = paddle.audio.LogMelSpectrogram(
+            sr=16000, n_fft=512, n_mels=40, top_db=60.0)(x)
+        v = lm.numpy()
+        assert v.max() - v.min() <= 60.0 + 1e-4
+
+
+class TestAdaptiveLogSoftmax:
+    def test_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 50, [5, 20])
+        tm = torch.nn.AdaptiveLogSoftmaxWithLoss(
+            16, 50, [5, 20], head_bias=False)
+        with torch.no_grad():
+            tm.head.weight.copy_(torch.tensor(m.head.weight.numpy().T))
+            for i in range(2):
+                ours = getattr(m, f"tail_{i}")
+                tm.tail[i][0].weight.copy_(
+                    torch.tensor(ours[0].weight.numpy().T))
+                tm.tail[i][1].weight.copy_(
+                    torch.tensor(ours[1].weight.numpy().T))
+        x = np.random.RandomState(0).randn(8, 16).astype("float32")
+        y = np.array([0, 3, 7, 19, 20, 35, 49, 2], "int64")
+        out, loss = m(paddle.to_tensor(x), paddle.to_tensor(y))
+        ref = tm(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(
+            out.numpy(), ref.output.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(
+            float(loss.numpy()), float(ref.loss), atol=1e-5)
+        np.testing.assert_allclose(
+            m.log_prob(paddle.to_tensor(x)).numpy(),
+            tm.log_prob(torch.tensor(x)).detach().numpy(), atol=1e-5)
+
+    def test_trains(self):
+        import paddle_tpu.nn.functional as F  # noqa: F401
+        import paddle_tpu.optimizer as optim
+
+        m = nn.AdaptiveLogSoftmaxWithLoss(8, 30, [10])
+        opt = optim.SGD(0.1, parameters=m.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(16, 8).astype("float32"))
+        y = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, 30, 16).astype("int64"))
+        losses = []
+        for _ in range(6):
+            _, loss = m(x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_bad_cutoffs_raise(self):
+        with pytest.raises(ValueError):
+            nn.AdaptiveLogSoftmaxWithLoss(8, 30, [10, 5])
+
+
+class TestFolderDatasets:
+    def _make_tree(self, tmp_path):
+        PIL = pytest.importorskip("PIL")
+        from PIL import Image
+
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                Image.fromarray(
+                    np.random.randint(0, 255, (8, 8, 3), dtype="uint8")
+                ).save(str(d / f"{i}.png"))
+        return str(tmp_path)
+
+    def test_dataset_folder(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder
+
+        root = self._make_tree(tmp_path)
+        ds = DatasetFolder(root)
+        assert ds.classes == ["cat", "dog"]
+        assert len(ds) == 6
+        img, target = ds[0]
+        assert target == 0 and img.size == (8, 8)
+
+    def test_image_folder_and_transform(self, tmp_path):
+        from paddle_tpu.vision.datasets import ImageFolder
+
+        root = self._make_tree(tmp_path)
+        calls = []
+
+        def tf(img):
+            calls.append(1)
+            return np.asarray(img)
+
+        ds = ImageFolder(root, transform=tf)
+        assert len(ds) == 6
+        (arr,) = ds[1]
+        assert arr.shape == (8, 8, 3) and calls
+
+    def test_empty_raises(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder
+
+        with pytest.raises(RuntimeError):
+            DatasetFolder(str(tmp_path))
