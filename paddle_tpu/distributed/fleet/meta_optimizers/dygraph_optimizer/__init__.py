@@ -1,4 +1,10 @@
 from .dygraph_sharding_optimizer import DygraphShardingOptimizer
 from .hybrid_parallel_optimizer import HybridParallelOptimizer
+from .localsgd_dgc import DGCMomentumOptimizer, LocalSGDOptimizer
 
-__all__ = ["DygraphShardingOptimizer", "HybridParallelOptimizer"]
+__all__ = [
+    "DygraphShardingOptimizer",
+    "HybridParallelOptimizer",
+    "LocalSGDOptimizer",
+    "DGCMomentumOptimizer",
+]

@@ -80,3 +80,7 @@ define_flag("embedding_deterministic", 0, "API parity")
 
 if os.environ.get("FLAGS_check_nan_inf"):
     _on_set("check_nan_inf", _REGISTRY["check_nan_inf"])
+define_flag("flash_precision_highest", False,
+            "force fp32-emulated (multi-pass) MXU multiplies in the "
+            "Pallas flash-attention kernels; default uses native bf16 "
+            "single-pass with fp32 accumulation")

@@ -32,6 +32,21 @@ NEG_INF = -1e30
 _LANE = 128
 
 
+def _prec():
+    """MXU dot precision for the flash kernels. DEFAULT keeps native
+    bf16x bf16->fp32 single-pass MXU throughput (the flash-attention
+    convention); the FLAGS_flash_precision_highest escape hatch forces
+    multi-pass fp32-emulated multiplies for debugging numerics."""
+    from ...framework.flags import flag
+
+    try:
+        if flag("flash_precision_highest"):
+            return jax.lax.Precision.HIGHEST
+    except KeyError:
+        pass
+    return jax.lax.Precision.DEFAULT
+
+
 def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
                       q_ref, k_ref, v_ref, o_ref, lse_ref,
                       acc_ref, m_ref, l_ref):
@@ -59,7 +74,7 @@ def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=_prec(),
         ) * scale  # (Bq, Bk)
         if causal:
             q_idx = qi * block_q + jax.lax.broadcasted_iota(
@@ -79,7 +94,7 @@ def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=_prec(),
         )
         m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
@@ -200,7 +215,7 @@ def _flash_bwd_dkdv_kernel(scale, causal, offset, block_q, block_k,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=_prec(),
         ) * scale  # (Bq, Bk)
         p = jnp.exp(s - lse)
         if causal:
@@ -215,20 +230,20 @@ def _flash_bwd_dkdv_kernel(scale, causal, offset, block_q, block_k,
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=_prec(),
         )
         # dp = do v^T ; ds = p * (dp - delta) * scale
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=_prec(),
         )
         ds = p * (dp - delta) * scale
         # dk += ds^T q
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=_prec(),
         )
 
     @pl.when(jnp.logical_and(gi == group - 1, qi == nq - 1))
@@ -262,7 +277,7 @@ def _flash_bwd_dq_kernel(scale, causal, offset, block_q, block_k, nk,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=_prec(),
         ) * scale
         p = jnp.exp(s - lse)
         if causal:
@@ -276,13 +291,13 @@ def _flash_bwd_dq_kernel(scale, causal, offset, block_q, block_k, nk,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=_prec(),
         )
         ds = p * (dp - delta) * scale
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=_prec(),
         )
 
     @pl.when(ki == nk - 1)

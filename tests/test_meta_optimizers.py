@@ -1,0 +1,104 @@
+"""LocalSGD / DGC meta-optimizer tests (upstream analogs:
+test/collective/fleet/test_fleet_localsgd_meta_optimizer.py,
+test_fleet_dgc_meta_optimizer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as optim
+from paddle_tpu.distributed.fleet.meta_optimizers.dygraph_optimizer import (
+    DGCMomentumOptimizer,
+    LocalSGDOptimizer,
+)
+
+
+def setup_module():
+    paddle.seed(21)
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    return (
+        paddle.to_tensor(rng.randn(16, 8).astype("float32")),
+        paddle.to_tensor(rng.randn(16, 4).astype("float32")),
+    )
+
+
+class TestDGC:
+    def test_converges_with_sparsity(self):
+        x, y = _data()
+        m = nn.Linear(8, 4)
+        opt = DGCMomentumOptimizer(
+            0.05, 0.9, parameters=m.parameters(), sparsity=[0.75],
+            rampup_begin_step=2,
+        )
+        losses = []
+        for _ in range(15):
+            loss = F.mse_loss(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.5 * losses[0]
+
+    def test_error_feedback_accumulates(self):
+        x, y = _data()
+        m = nn.Linear(8, 4)
+        opt = DGCMomentumOptimizer(
+            0.05, 0.9, parameters=m.parameters(), sparsity=[0.9],
+            rampup_begin_step=0,
+        )
+        loss = F.mse_loss(m(x), y)
+        loss.backward()
+        opt.step()
+        # after one compressed step the residual store must be nonzero
+        assert opt._e and any(
+            float(abs(np.asarray(e)).sum()) > 0 for e in opt._e.values()
+        )
+
+    def test_rampup_defers_compression(self):
+        x, y = _data()
+        m = nn.Linear(8, 4)
+        opt = DGCMomentumOptimizer(
+            0.05, 0.9, parameters=m.parameters(), sparsity=[0.9],
+            rampup_begin_step=100,
+        )
+        loss = F.mse_loss(m(x), y)
+        loss.backward()
+        opt.step()
+        assert not opt._e  # dense phase: no residual created
+
+
+class TestLocalSGD:
+    def test_steps_and_averaging_schedule(self):
+        x, y = _data()
+        m = nn.Linear(8, 4)
+        inner = optim.SGD(0.05, parameters=m.parameters())
+        opt = LocalSGDOptimizer(inner, k_steps=3)
+        calls = []
+        opt._average_params = lambda: calls.append(opt._step_count)
+        for _ in range(7):
+            loss = F.mse_loss(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert calls == [3, 6]
+
+    def test_single_process_noop_average_trains(self):
+        x, y = _data()
+        m = nn.Linear(8, 4)
+        opt = LocalSGDOptimizer(
+            optim.SGD(0.05, parameters=m.parameters()), k_steps=2
+        )
+        first = last = None
+        for i in range(8):
+            loss = F.mse_loss(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            last = float(loss.numpy())
+            if first is None:
+                first = last
+        assert last < first
