@@ -40,6 +40,7 @@ from .collective import (  # noqa
 from .parallel import DataParallel, init_parallel_env  # noqa
 from .store import TCPStore  # noqa
 from . import checkpoint  # noqa
+from . import stream  # noqa
 from . import fleet  # noqa
 from . import sharding  # noqa
 from . import utils  # noqa

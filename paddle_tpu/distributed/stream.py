@@ -1,0 +1,83 @@
+"""paddle.distributed.stream.* (upstream: python/paddle/distributed/
+communication/stream/*).
+
+The reference's stream variants choose the comm vs. calc CUDA stream;
+under PJRT/XLA there is one ordered execution stream per device, so the
+``use_calc_stream`` knob is accepted for parity and the semantics are
+the plain collectives (already async-task capable). ``sync_op=False``
+returns the same Task the base API returns.
+"""
+from __future__ import annotations
+
+from . import collective as _c
+
+__all__ = [
+    "all_reduce", "all_gather", "reduce_scatter", "broadcast",
+    "reduce", "scatter", "alltoall", "alltoall_single", "send", "recv",
+]
+
+
+def all_reduce(tensor, op=None, group=None, sync_op=True,
+               use_calc_stream=False):
+    kwargs = {"group": group, "sync_op": sync_op}
+    if op is not None:
+        kwargs["op"] = op
+    return _c.all_reduce(tensor, **kwargs)
+
+
+def all_gather(tensor_or_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_gather(tensor_or_list, tensor, group=group,
+                         sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_list, op=None, group=None,
+                   sync_op=True, use_calc_stream=False):
+    kwargs = {"group": group, "sync_op": sync_op}
+    if op is not None:
+        kwargs["op"] = op
+    return _c.reduce_scatter(tensor, tensor_or_list, **kwargs)
+
+
+def broadcast(tensor, src, group=None, sync_op=True,
+              use_calc_stream=False):
+    return _c.broadcast(tensor, src, group=group, sync_op=sync_op)
+
+
+def reduce(tensor, dst, op=None, group=None, sync_op=True,
+           use_calc_stream=False):
+    kwargs = {"group": group, "sync_op": sync_op}
+    if op is not None:
+        kwargs["op"] = op
+    return _c.reduce(tensor, dst, **kwargs)
+
+
+def scatter(tensor, tensor_or_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    return _c.scatter(tensor, tensor_or_list, src=src, group=group,
+                      sync_op=sync_op)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+             use_calc_stream=False):
+    return _c.alltoall(out_tensor_list, in_tensor_list, group=group,
+                       sync_op=sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    return _c.alltoall_single(
+        out_tensor, in_tensor, in_split_sizes, out_split_sizes,
+        group=group, sync_op=sync_op,
+    )
+
+
+def send(tensor, dst=0, group=None, sync_op=True,
+         use_calc_stream=False):
+    return _c.send(tensor, dst=dst, group=group, sync_op=sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True,
+         use_calc_stream=False):
+    return _c.recv(tensor, src=src, group=group, sync_op=sync_op)

@@ -323,3 +323,57 @@ class FusedMultiTransformer(Layer):
             )
             new_caches.append((nk, nv))
         return x, new_caches
+
+
+class FusedLinear(Layer):
+    """Linear whose matmul+bias-add runs as one fused op (upstream:
+    python/paddle/incubate/nn/layer/fused_linear.py). XLA fuses the
+    epilogue into the MXU matmul, matching the reference's cublasLt
+    epilogue fusion; `transpose_weight` stores W transposed so the
+    forward needs no data movement."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = (
+            [out_features, in_features] if transpose_weight
+            else [in_features, out_features]
+        )
+        self.weight = self.create_parameter(shape, weight_attr)
+        self.bias = (
+            self.create_parameter([out_features], bias_attr,
+                                  is_bias=True)
+            if bias_attr is not False else None
+        )
+
+    def forward(self, x):
+        x = _as_tensor(x)
+        tw = self.transpose_weight
+
+        def f(a, w, *b):
+            out = a @ (w.T if tw else w)
+            if b:
+                out = out + b[0]
+            return out
+
+        args = [x, self.weight] + ([self.bias] if self.bias is not None
+                                   else [])
+        return apply_op("fused_linear", f, *args)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False,
+                 name=None):
+    """Functional fused linear (upstream: incubate/nn/functional/
+    fused_matmul_bias.py)."""
+    x = _as_tensor(x)
+    weight = _as_tensor(weight)
+
+    def f(a, w, *b):
+        out = a @ (w.T if transpose_weight else w)
+        if b:
+            out = out + b[0]
+        return out
+
+    args = [x, weight] + ([_as_tensor(bias)] if bias is not None else [])
+    return apply_op("fused_linear", f, *args)

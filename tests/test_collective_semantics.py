@@ -143,3 +143,36 @@ class TestBatchIsendIrecv:
                 dist.batch_isend_irecv(
                     [dist.P2POp(dist.isend, t, 1, group=g)]
                 )
+
+
+def test_stream_namespace_delegates():
+    """paddle.distributed.stream.* variants mirror the base collectives
+    (upstream: python/paddle/distributed/communication/stream/)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import distributed as dist
+
+    t = paddle.to_tensor(np.ones(4, "float32"))
+    dist.stream.all_reduce(t, use_calc_stream=True)  # world=1: no-op
+    np.testing.assert_array_equal(t.numpy(), np.ones(4, "float32"))
+    out = []
+    dist.stream.all_gather(out, t)
+    assert len(out) >= 1
+
+
+def test_fused_linear_matches_linear():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedLinear, fused_linear
+
+    paddle.seed(3)
+    fl = FusedLinear(6, 4)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 6)
+                         .astype("float32"))
+    ref = x.numpy() @ fl.weight.numpy() + fl.bias.numpy()
+    np.testing.assert_allclose(fl(x).numpy(), ref, rtol=1e-5)
+    np.testing.assert_allclose(
+        fused_linear(x, fl.weight, fl.bias).numpy(), ref, rtol=1e-5
+    )
