@@ -71,6 +71,21 @@ def _build_and_load():
         "pt_events_clear": ([], None),
         "pt_now": ([], c.c_double),
         "pt_runtime_version": ([], c.c_int),
+        "pt_shm_create": (
+            [c.c_char_p, c.c_uint32, c.c_uint32], c.c_void_p,
+        ),
+        "pt_shm_open": ([c.c_char_p], c.c_void_p),
+        "pt_shm_close": ([c.c_void_p], None),
+        "pt_shm_n_slots": ([c.c_void_p], c.c_uint32),
+        "pt_shm_slot_bytes": ([c.c_void_p], c.c_uint32),
+        "pt_shm_acquire": ([c.c_void_p, c.c_double], c.c_int32),
+        "pt_shm_write": (
+            [c.c_void_p, c.c_int32, c.c_void_p, c.c_uint64], c.c_int64,
+        ),
+        "pt_shm_read_begin": (
+            [c.c_void_p, c.c_int32, c.POINTER(c.c_void_p)], c.c_int32,
+        ),
+        "pt_shm_release": ([c.c_void_p, c.c_int32], c.c_int32),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)
@@ -166,3 +181,115 @@ class BlockingQueue:
                 self._h = None
         except Exception:
             pass
+
+
+class ShmArena:
+    """Shared-memory batch arena over the native slot protocol
+    (runtime.cc pt_shm_*): fixed slots in a POSIX shm segment with
+    lock-free atomic slot states in the segment header. The DataLoader's
+    worker processes write numpy batches straight into a slot (one
+    memcpy); the parent maps the segment once and reads zero-copy.
+
+    Upstream analog: paddle/fluid/memory/allocation/mmap_allocator.cc
+    (DataLoader shared-memory tensor transport).
+    """
+
+    def __init__(self, handle, name, owner):
+        self._lib = get_lib()
+        self._h = handle
+        self.name = name
+        self._owner = owner
+
+    # -- lifecycle ---------------------------------------------------------
+    @classmethod
+    def create(cls, name: str, n_slots: int, slot_bytes: int):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {_lib_err}")
+        h = lib.pt_shm_create(
+            name.encode(), int(n_slots), int(slot_bytes)
+        )
+        if not h:
+            raise RuntimeError(f"pt_shm_create failed for {name!r}")
+        return cls(h, name, owner=True)
+
+    @classmethod
+    def open(cls, name: str):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {_lib_err}")
+        h = lib.pt_shm_open(name.encode())
+        if not h:
+            raise RuntimeError(f"pt_shm_open failed for {name!r}")
+        return cls(h, name, owner=False)
+
+    def close(self):
+        if self._h:
+            self._lib.pt_shm_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @property
+    def slot_bytes(self) -> int:
+        return int(self._lib.pt_shm_slot_bytes(self._h))
+
+    # -- writer (worker) side ----------------------------------------------
+    def write_arrays(self, arrays, timeout=10.0):
+        """Pack a flat list of numpy arrays into one slot. Returns
+        (slot, meta) where meta = [(shape, dtype_str, offset), ...];
+        None if the payload exceeds slot_bytes (caller falls back)."""
+        import numpy as np
+
+        total = 0
+        meta = []
+        for a in arrays:
+            a = np.ascontiguousarray(a)
+            off = (total + 63) & ~63  # 64B-align each array
+            meta.append((a.shape, a.dtype.str, off))
+            total = off + a.nbytes
+        if total > self.slot_bytes:
+            return None
+        slot = self._lib.pt_shm_acquire(self._h, float(timeout))
+        if slot < 0:
+            raise TimeoutError("no free shm slot")
+        buf = bytearray(total)
+        for a, (_, _, off) in zip(arrays, meta):
+            a = np.ascontiguousarray(a)
+            buf[off:off + a.nbytes] = a.tobytes()
+        src = (ctypes.c_char * total).from_buffer(buf)
+        wrote = self._lib.pt_shm_write(self._h, slot, src, total)
+        if wrote < 0:
+            raise RuntimeError("pt_shm_write failed")
+        return slot, meta
+
+    # -- reader (parent) side ----------------------------------------------
+    def read_arrays(self, slot, meta):
+        """Zero-copy numpy views into the slot. The views are only valid
+        until release(slot) — consumers must copy/upload first."""
+        import numpy as np
+
+        ptr = ctypes.c_void_p()
+        rc = self._lib.pt_shm_read_begin(
+            self._h, int(slot), ctypes.byref(ptr)
+        )
+        if rc != 0:
+            raise RuntimeError(f"pt_shm_read_begin failed rc={rc}")
+        out = []
+        for shape, dtype_str, off in meta:
+            dt = np.dtype(dtype_str)
+            n = int(np.prod(shape)) if shape else 1
+            raw = (ctypes.c_char * (n * dt.itemsize)).from_address(
+                ptr.value + off
+            )
+            out.append(
+                np.frombuffer(raw, dtype=dt).reshape(shape)
+            )
+        return out
+
+    def release(self, slot):
+        self._lib.pt_shm_release(self._h, int(slot))

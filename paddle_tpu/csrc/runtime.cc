@@ -467,3 +467,205 @@ PT_API void pt_events_clear() { g_event_head.store(0); }
 PT_API double pt_now() { return now_s(); }
 
 PT_API int pt_runtime_version() { return 1; }
+
+// ---------------------------------------------------------------------------
+// Shared-memory batch arena (upstream analogs:
+// paddle/fluid/memory/allocation/mmap_allocator.cc — DataLoader's
+// shared-memory tensor transport — and the reader LoDTensorBlockingQueue
+// slot accounting). One arena per worker process: a POSIX shm segment
+// split into fixed slots; slot states are lock-free atomics living in
+// the segment header so BOTH processes coordinate without locks or extra
+// syscalls. The worker memcpys a batch's arrays into a FREE slot and
+// marks it READY; the parent maps the segment once and reads zero-copy
+// (numpy frombuffer view), acking the slot back to FREE after the
+// consumer is done with the device upload.
+// ---------------------------------------------------------------------------
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+
+namespace {
+
+constexpr uint32_t kSlotFree = 0;
+constexpr uint32_t kSlotWriting = 1;
+constexpr uint32_t kSlotReady = 2;
+constexpr uint32_t kSlotReading = 3;
+
+struct ShmHeader {
+  uint64_t magic;          // layout guard
+  uint32_t n_slots;
+  uint32_t slot_bytes;     // payload bytes per slot
+  // one state word per slot follows (padded to cache lines)
+};
+
+constexpr uint64_t kMagic = 0x70745f73686d0001ull;  // "pt_shm" v1
+constexpr size_t kLine = 64;
+
+struct Arena {
+  int fd = -1;
+  void* base = nullptr;
+  size_t total = 0;
+  ShmHeader* hdr = nullptr;
+  std::string name;
+  bool owner = false;
+};
+
+inline std::atomic<uint32_t>* slot_state(ShmHeader* h, uint32_t i) {
+  auto* p = reinterpret_cast<char*>(h) + sizeof(ShmHeader) + i * kLine;
+  return reinterpret_cast<std::atomic<uint32_t>*>(p);
+}
+
+inline char* slot_payload(Arena* a, uint32_t i) {
+  size_t header_sz = sizeof(ShmHeader) + a->hdr->n_slots * kLine;
+  header_sz = (header_sz + 4095) & ~size_t(4095);  // page-align payload
+  return static_cast<char*>(a->base) + header_sz +
+         size_t(i) * a->hdr->slot_bytes;
+}
+
+size_t arena_total(uint32_t n_slots, uint32_t slot_bytes) {
+  size_t header_sz = sizeof(ShmHeader) + size_t(n_slots) * kLine;
+  header_sz = (header_sz + 4095) & ~size_t(4095);
+  return header_sz + size_t(n_slots) * slot_bytes;
+}
+
+}  // namespace
+
+// Create (owner side — the worker) or open (parent side) an arena.
+// Returns an opaque handle, or null on failure.
+PT_API void* pt_shm_create(const char* name, uint32_t n_slots,
+                           uint32_t slot_bytes) {
+  size_t total = arena_total(n_slots, slot_bytes);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* a = new Arena();
+  a->fd = fd;
+  a->base = base;
+  a->total = total;
+  a->hdr = static_cast<ShmHeader*>(base);
+  a->name = name;
+  a->owner = true;
+  a->hdr->magic = kMagic;
+  a->hdr->n_slots = n_slots;
+  a->hdr->slot_bytes = slot_bytes;
+  for (uint32_t i = 0; i < n_slots; ++i)
+    slot_state(a->hdr, i)->store(kSlotFree, std::memory_order_release);
+  return a;
+}
+
+PT_API void* pt_shm_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)sizeof(ShmHeader)) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, static_cast<size_t>(st.st_size),
+                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  auto* hdr = static_cast<ShmHeader*>(base);
+  if (hdr->magic != kMagic ||
+      arena_total(hdr->n_slots, hdr->slot_bytes) >
+          static_cast<size_t>(st.st_size)) {
+    munmap(base, static_cast<size_t>(st.st_size));
+    close(fd);
+    return nullptr;
+  }
+  auto* a = new Arena();
+  a->fd = fd;
+  a->base = base;
+  a->total = static_cast<size_t>(st.st_size);
+  a->hdr = hdr;
+  a->name = name;
+  a->owner = false;
+  return a;
+}
+
+PT_API void pt_shm_close(void* h) {
+  auto* a = static_cast<Arena*>(h);
+  if (!a) return;
+  munmap(a->base, a->total);
+  close(a->fd);
+  if (a->owner) shm_unlink(a->name.c_str());
+  delete a;
+}
+
+PT_API uint32_t pt_shm_n_slots(void* h) {
+  return static_cast<Arena*>(h)->hdr->n_slots;
+}
+
+PT_API uint32_t pt_shm_slot_bytes(void* h) {
+  return static_cast<Arena*>(h)->hdr->slot_bytes;
+}
+
+// Writer: claim a FREE slot (spin with micro-sleeps up to timeout_s;
+// the queue backpressure normally means a slot is free already).
+// Returns slot index or -1 on timeout.
+PT_API int32_t pt_shm_acquire(void* h, double timeout_s) {
+  auto* a = static_cast<Arena*>(h);
+  double deadline = now_s() + timeout_s;
+  while (true) {
+    for (uint32_t i = 0; i < a->hdr->n_slots; ++i) {
+      uint32_t expect = kSlotFree;
+      if (slot_state(a->hdr, i)->compare_exchange_strong(
+              expect, kSlotWriting, std::memory_order_acq_rel)) {
+        return static_cast<int32_t>(i);
+      }
+    }
+    if (timeout_s >= 0 && now_s() > deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::microseconds(200));
+  }
+}
+
+// Writer: copy payload into the claimed slot and publish it.
+// Returns bytes written, or -1 if it does not fit / bad state.
+PT_API int64_t pt_shm_write(void* h, int32_t slot, const void* src,
+                            uint64_t nbytes) {
+  auto* a = static_cast<Arena*>(h);
+  if (slot < 0 || uint32_t(slot) >= a->hdr->n_slots) return -1;
+  if (nbytes > a->hdr->slot_bytes) return -1;
+  if (slot_state(a->hdr, slot)->load(std::memory_order_acquire) !=
+      kSlotWriting)
+    return -1;
+  memcpy(slot_payload(a, slot), src, nbytes);
+  slot_state(a->hdr, slot)->store(kSlotReady, std::memory_order_release);
+  return static_cast<int64_t>(nbytes);
+}
+
+// Reader: take a READY slot into READING state. The payload pointer is
+// returned through *out (valid until pt_shm_release). Returns 0 on
+// success, -1 on bad state.
+PT_API int32_t pt_shm_read_begin(void* h, int32_t slot, void** out) {
+  auto* a = static_cast<Arena*>(h);
+  if (slot < 0 || uint32_t(slot) >= a->hdr->n_slots) return -1;
+  uint32_t expect = kSlotReady;
+  if (!slot_state(a->hdr, slot)->compare_exchange_strong(
+          expect, kSlotReading, std::memory_order_acq_rel))
+    return -1;
+  *out = slot_payload(a, slot);
+  return 0;
+}
+
+// Reader: slot consumed — back to FREE for the writer.
+PT_API int32_t pt_shm_release(void* h, int32_t slot) {
+  auto* a = static_cast<Arena*>(h);
+  if (slot < 0 || uint32_t(slot) >= a->hdr->n_slots) return -1;
+  slot_state(a->hdr, slot)->store(kSlotFree, std::memory_order_release);
+  return 0;
+}

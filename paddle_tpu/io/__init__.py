@@ -394,17 +394,71 @@ class _RemoteError(Exception):
     pass
 
 
+def _flatten_np(obj):
+    """Split a collated batch into (ndarray leaves, structure spec).
+    Non-array leaves travel inside the spec (they're tiny)."""
+    if isinstance(obj, np.ndarray):
+        return [obj], ("arr",)
+    if isinstance(obj, (list, tuple)):
+        leaves, specs = [], []
+        for v in obj:
+            l, s = _flatten_np(v)
+            leaves.extend(l)
+            specs.append(s)
+        kind = "tuple" if isinstance(obj, tuple) else "list"
+        return leaves, (kind, specs)
+    if isinstance(obj, dict):
+        leaves, items = [], []
+        for k in obj:
+            l, s = _flatten_np(obj[k])
+            leaves.extend(l)
+            items.append((k, s))
+        return leaves, ("dict", items)
+    return [], ("value", obj)
+
+
+def _unflatten_np(spec, leaves, pos=0):
+    kind = spec[0]
+    if kind == "arr":
+        return leaves[pos], pos + 1
+    if kind in ("list", "tuple"):
+        out = []
+        for s in spec[1]:
+            v, pos = _unflatten_np(s, leaves, pos)
+            out.append(v)
+        return (tuple(out) if kind == "tuple" else out), pos
+    if kind == "dict":
+        out = {}
+        for k, s in spec[1]:
+            v, pos = _unflatten_np(s, leaves, pos)
+            out[k] = v
+        return out, pos
+    return spec[1], pos
+
+
 def _mp_worker(dataset, use_default_collate, collate_fn, index_q,
-               result_q, worker_init_fn, wid, num_workers, seed):
+               result_q, worker_init_fn, wid, num_workers, seed,
+               shm_name=None):
     """Worker-process loop: pull index batches, build+collate to numpy,
     push back. Never initializes a jax backend (the parent owns the
-    TPU); numpy batches travel back pickled over the queue pipe."""
+    TPU). With ``shm_name`` the arrays go through the native
+    shared-memory arena (one memcpy; the parent reads zero-copy —
+    upstream analog: mmap_allocator.cc transport); batches that exceed
+    a slot fall back to the pickled queue pipe."""
     import os as _os
     import traceback
 
     _os.environ["JAX_PLATFORMS"] = "cpu"  # belt-and-braces: no TPU grab
     global _worker_info
     _worker_info = _WorkerInfo(wid, num_workers, seed + wid, dataset)
+    arena = None
+    if shm_name is not None:
+        try:
+            from .. import csrc
+
+            arena = csrc.ShmArena.open(shm_name)
+        except Exception:
+            arena = None
     if worker_init_fn is not None:
         try:
             worker_init_fn(wid)
@@ -423,7 +477,21 @@ def _mp_worker(dataset, use_default_collate, collate_fn, index_q,
                 batch = _np_collate(samples)
             else:
                 batch = collate_fn(samples)
-            result_q.put((seq, batch))
+            sent = False
+            if arena is not None:
+                leaves, spec = _flatten_np(batch)
+                if leaves:
+                    # generous timeout: blocked only if the consumer
+                    # stalls with every slot in flight
+                    packed = arena.write_arrays(leaves, timeout=300.0)
+                    if packed is not None:
+                        slot, meta = packed
+                        result_q.put(
+                            (seq, ("__shm__", wid, slot, meta, spec))
+                        )
+                        sent = True
+            if not sent:
+                result_q.put((seq, batch))
         except Exception:
             result_q.put((seq, _RemoteError(traceback.format_exc())))
 
@@ -453,13 +521,37 @@ class _MPLoaderIter:
             seed = default_generator().initial_seed()
         except Exception:
             pass
+        # native shared-memory arenas (one per worker, parent-owned so
+        # teardown unlinks them); zero-copy batch transport with the
+        # pickled pipe as automatic fallback
+        self._arenas = {}
+        shm_names = [None] * n
+        from .. import csrc
+
+        if csrc.available():
+            import os as _os2
+
+            depth = max(2, loader.prefetch_factor) + 2
+            slot_bytes = int(
+                getattr(loader, "shm_slot_bytes", 64 << 20)
+            )
+            for wid in range(n):
+                name = f"/pt_dl_{_os2.getpid()}_{id(self) & 0xffff}_{wid}"
+                try:
+                    self._arenas[wid] = csrc.ShmArena.create(
+                        name, depth, slot_bytes
+                    )
+                    shm_names[wid] = name
+                except Exception:
+                    self._arenas.pop(wid, None)
         self._procs = [
             ctx.Process(
                 target=_mp_worker,
                 args=(loader.dataset, use_default,
                       None if use_default else loader.collate_fn,
                       self._index_q, self._result_q,
-                      loader.worker_init_fn, wid, n, seed),
+                      loader.worker_init_fn, wid, n, seed,
+                      shm_names[wid]),
                 daemon=True,
             )
             for wid in range(n)
@@ -501,6 +593,30 @@ class _MPLoaderIter:
         self._index_q.put((self._seq, indices))
         self._seq += 1
 
+    def _materialize(self, item):
+        """Resolve a shm-transported batch: zero-copy views -> device
+        upload (or host copy for custom collate), then free the slot."""
+        if not (isinstance(item, tuple) and len(item) == 5
+                and item[0] == "__shm__"):
+            if self.loader.collate_fn is default_collate_fn:
+                item = _to_device(item)
+            return item
+        _, wid, slot, meta, spec = item
+        arena = self._arenas[wid]
+        views = arena.read_arrays(slot, meta)
+        try:
+            # copy out of the slot BEFORE releasing: jax's CPU backend
+            # may alias a numpy buffer zero-copy, so handing the raw
+            # view to Tensor() would leave a live array pointing into a
+            # recycled (or unmapped) slot -> use-after-free
+            host = [np.array(v) for v in views]
+        finally:
+            arena.release(slot)
+        if self.loader.collate_fn is default_collate_fn:
+            host = [Tensor(v) for v in host]
+        out, _ = _unflatten_np(spec, host)
+        return out
+
     def __next__(self):
         while True:
             if self._next_emit in self._reorder:
@@ -512,9 +628,7 @@ class _MPLoaderIter:
                     raise RuntimeError(
                         f"DataLoader worker failed:\n{item}"
                     )
-                if self.loader.collate_fn is default_collate_fn:
-                    item = _to_device(item)
-                return item
+                return self._materialize(item)
             if self._sentinels >= len(self._procs) and \
                     self._seq == self._next_emit and not self._reorder:
                 self._shutdown()
@@ -554,6 +668,12 @@ class _MPLoaderIter:
                 p.terminate()
         for p in self._procs:
             p.join(timeout=5)
+        for arena in getattr(self, "_arenas", {}).values():
+            try:
+                arena.close()  # parent owns: unlinks the shm segment
+            except Exception:
+                pass
+        self._arenas = {}
 
     def __del__(self):
         try:
