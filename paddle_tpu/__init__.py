@@ -112,12 +112,13 @@ def _lazy_imports():
     """Import heavier subpackages; called at end of module init."""
     global nn, optimizer, io, jit, static, vision, hapi, metric
     global distributed, incubate, amp, profiler, vision, callbacks, Model
-    global DataParallel, utils, inference, sparse
+    global DataParallel, utils, inference, sparse, flops, summary
     from . import utils  # noqa
     from . import fft  # noqa
     from . import signal  # noqa
     from . import distribution  # noqa
     from . import audio  # noqa
+    from . import quantization  # noqa
     from . import inference  # noqa
     from . import sparse  # noqa
     from . import nn  # noqa
@@ -129,7 +130,7 @@ def _lazy_imports():
     from . import vision  # noqa
     from . import metric  # noqa
     from . import hapi  # noqa
-    from .hapi import Model, callbacks  # noqa
+    from .hapi import Model, callbacks, flops, summary  # noqa
     from . import distributed  # noqa
     from . import incubate  # noqa
     from . import profiler  # noqa

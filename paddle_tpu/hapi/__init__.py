@@ -1,9 +1,4 @@
 """paddle_tpu.hapi (upstream: python/paddle/hapi/)."""
 from . import callbacks  # noqa
 from .model import Model  # noqa
-
-
-def summary(net, input_size=None, dtypes=None):
-    n = sum(p.size for p in net.parameters())
-    print(f"Total params: {n:,}")
-    return {"total_params": n}
+from .summary import flops, summary  # noqa

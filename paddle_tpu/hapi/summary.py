@@ -1,0 +1,166 @@
+"""Model summary + FLOP counting (upstream: python/paddle/hapi/
+summary.py, dynamic_flops.py). A forward pass with hooks records each
+leaf layer's output shape and parameter count; flops() adds analytic
+per-layer FLOP formulas for the common compute layers."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary", "flops"]
+
+
+def _shape_of(out):
+    from ..framework.core import Tensor
+
+    if isinstance(out, Tensor):
+        return list(out.shape)
+    if isinstance(out, (list, tuple)) and out:
+        return _shape_of(out[0])
+    return []
+
+
+def _run_with_hooks(net, input_size, dtypes, on_layer):
+    import paddle_tpu as paddle
+
+    handles = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, output=None):
+            on_layer(name, lyr, inputs, output)
+
+        return hook
+
+    targets = list(net.named_sublayers(include_self=False))
+    if not targets:  # the net itself is a single leaf layer
+        targets = [("", net)]
+    for name, layer in targets:
+        if list(layer.children()):
+            continue  # leaves only
+        handles.append(
+            (name, layer, layer.register_forward_post_hook(
+                make_hook(name, layer)))
+        )
+
+    if isinstance(input_size, tuple) and input_size and \
+            isinstance(input_size[0], (tuple, list)):
+        sizes = list(input_size)
+    else:
+        sizes = [input_size]
+    dtypes = dtypes or ["float32"] * len(sizes)
+    if isinstance(dtypes, str):
+        dtypes = [dtypes] * len(sizes)
+    xs = [
+        paddle.to_tensor(
+            np.zeros([int(d) for d in s], dtype=dt)
+        )
+        for s, dt in zip(sizes, dtypes)
+    ]
+    training = net.training
+    net.eval()
+    try:
+        with paddle.no_grad():
+            net(*xs)
+    finally:
+        if training:
+            net.train()
+        for _, _, h in handles:
+            h.remove()
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Layer-by-layer table: output shape + trainable params (upstream
+    paddle.summary). Returns {'total_params': N, 'trainable_params': N}.
+    """
+    rows = []
+
+    def on_layer(name, layer, inputs, output):
+        own = [p for p in layer.parameters(include_sublayers=False)
+               if p is not None]
+        n_params = int(sum(p.size for p in own))
+        rows.append((
+            f"{type(layer).__name__}-{len(rows) + 1}",
+            name,
+            _shape_of(output),
+            n_params,
+        ))
+
+    if input is not None:
+        raise ValueError("pass input_size; `input` tensors unsupported")
+    _run_with_hooks(net, input_size, dtypes, on_layer)
+
+    total = int(sum(p.size for p in net.parameters()))
+    trainable = int(sum(
+        p.size for p in net.parameters() if not p.stop_gradient
+    ))
+    header = f"{'Layer (type)':<28}{'Output Shape':<24}{'Param #':>12}"
+    line = "-" * len(header)
+    print(line)
+    print(header)
+    print("=" * len(header))
+    for disp, _, shape, n in rows:
+        print(f"{disp:<28}{str(shape):<24}{n:>12,}")
+    print("=" * len(header))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def _layer_flops(layer, inputs, output):
+    """Analytic multiply-add counts for the common layers (upstream:
+    python/paddle/hapi/dynamic_flops.py register_hooks table)."""
+    from ..framework.core import Tensor
+
+    name = type(layer).__name__
+    x = inputs[0] if inputs and isinstance(inputs[0], Tensor) else None
+    out_shape = _shape_of(output)
+    n_out = int(np.prod(out_shape)) if out_shape else 0
+    if name == "Linear":
+        in_f = layer.weight.shape[0]
+        return n_out * in_f
+    if name in ("Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose",
+                "Conv2DTranspose", "Conv3DTranspose"):
+        w = layer.weight
+        # weight (out_c, in_c/groups, *k): per output element one MAC
+        # per (in_c/groups * prod(k))
+        per_out = int(np.prod(w.shape[1:]))
+        return n_out * per_out
+    if name in ("BatchNorm", "BatchNorm1D", "BatchNorm2D",
+                "BatchNorm3D", "SyncBatchNorm", "LayerNorm",
+                "GroupNorm", "InstanceNorm2D", "RMSNorm"):
+        return 2 * (int(np.prod(list(x.shape))) if x is not None else 0)
+    if name in ("ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh",
+                "Hardswish", "Hardsigmoid", "LeakyReLU", "SiLU",
+                "Swish", "Softmax"):
+        return n_out
+    if name.startswith(("AvgPool", "MaxPool", "AdaptiveAvgPool",
+                        "AdaptiveMaxPool")):
+        return n_out
+    return 0
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total forward multiply-accumulate count x2 (FLOPs) for one input
+    (upstream paddle.flops)."""
+    total = [0]
+    rows = []
+
+    def on_layer(name, layer, inputs, output):
+        fn = None
+        if custom_ops:
+            fn = custom_ops.get(type(layer))
+        macs = (
+            fn(layer, inputs, output) if fn is not None
+            else _layer_flops(layer, inputs, output)
+        )
+        total[0] += macs
+        if print_detail:
+            rows.append((name, type(layer).__name__, macs))
+
+    _run_with_hooks(net, input_size, None, on_layer)
+    if print_detail:
+        for name, ty, macs in rows:
+            print(f"{name:<40}{ty:<20}{2 * macs:>16,}")
+    print(f"Total Flops: {2 * total[0]:,}")
+    return 2 * total[0]

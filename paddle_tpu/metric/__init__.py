@@ -122,3 +122,57 @@ def accuracy(input, label, k=1):
         label_np = label_np.squeeze(-1)
     correct = (topk_idx == label_np[..., None]).any(-1)
     return Tensor(np.asarray(correct.mean(), np.float32))
+
+
+class Auc(Metric):
+    """Area under the ROC curve via the reference's thresholded
+    histogram accumulation (upstream: python/paddle/metric/metrics.py
+    Auc — same `num_thresholds` bucketing, trapezoid integration)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        self._curve = curve
+        self._num_thresholds = int(num_thresholds)
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        n = self._num_thresholds + 1
+        self._stat_pos = np.zeros(n, np.int64)
+        self._stat_neg = np.zeros(n, np.int64)
+
+    def update(self, preds, labels):
+        p = np.asarray(
+            preds._data if isinstance(preds, Tensor) else preds
+        )
+        l = np.asarray(
+            labels._data if isinstance(labels, Tensor) else labels
+        ).reshape(-1).astype(np.int64)
+        if p.ndim == 2 and p.shape[1] == 2:
+            pos_prob = p[:, 1]
+        else:
+            pos_prob = p.reshape(-1)
+        buckets = np.clip(
+            (pos_prob * self._num_thresholds).astype(np.int64),
+            0, self._num_thresholds,
+        )
+        np.add.at(self._stat_pos, buckets[l == 1], 1)
+        np.add.at(self._stat_neg, buckets[l == 0], 1)
+
+    def accumulate(self):
+        # descending-threshold cumulative TPR/FPR, trapezoid area
+        tot_pos = float(self._stat_pos.sum())
+        tot_neg = float(self._stat_neg.sum())
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        pos_cum = np.cumsum(self._stat_pos[::-1])
+        neg_cum = np.cumsum(self._stat_neg[::-1])
+        tpr = pos_cum / tot_pos
+        fpr = neg_cum / tot_neg
+        area = np.trapezoid(
+            np.concatenate([[0.0], tpr]),
+            np.concatenate([[0.0], fpr]),
+        )
+        return float(area)
+
+    def name(self):
+        return self._name

@@ -1,0 +1,269 @@
+"""Quantization framework (upstream: python/paddle/quantization/ —
+config.py, qat.py, ptq.py, observers/, quanters/).
+
+TPU-first: fake-quantization is expressed with the straight-through
+estimator as ``x + stop_gradient(q(x) - x)`` so the tape/XLA autodiff
+gives the STE gradient for free — no custom backward kernels. Scales
+live in layer buffers, so they ride ``state_dict`` and ``to_static``
+state capture like every other stat.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ",
+    "AbsMaxObserver", "MovingAverageAbsMaxObserver",
+    "FakeQuanterWithAbsMaxObserver", "quanters", "observers",
+]
+
+
+def _fake_quant(x_raw, scale_raw, bits):
+    """Symmetric fake-quant with STE. Pure jnp; used inside apply_op."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale_raw.astype(jnp.float32), 1e-9)
+    xf = x_raw.astype(jnp.float32)
+    q = jnp.clip(jnp.round(xf / s * qmax), -qmax, qmax) * s / qmax
+    out = xf + jax.lax.stop_gradient(q - xf)
+    return out.astype(x_raw.dtype)
+
+
+class _BaseObserver(Layer):
+    """Collects a scale; subclasses define the update rule."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self.register_buffer(
+            "scale", Tensor(np.zeros((), np.float32), persistable=True)
+        )
+
+    def quant_axis(self):
+        return None
+
+    def scales(self):
+        return self.scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class AbsMaxObserver(_BaseObserver):
+    """PTQ calibration observer: running max(|x|) (upstream:
+    observers/abs_max.py). forward passes x through unchanged."""
+
+    def forward(self, x):
+        x = _as_tensor(x)
+        cur = float(jnp.max(jnp.abs(x._data.astype(jnp.float32))))
+        prev = float(np.asarray(self.scale._data))
+        if cur > prev:
+            self.scale._data = jnp.asarray(cur, jnp.float32)
+        return x
+
+
+class MovingAverageAbsMaxObserver(_BaseObserver):
+    """EMA of max(|x|) (upstream: observers/mse.py family /
+    quanter moving-average rule)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self._rate = moving_rate
+
+    def forward(self, x):
+        x = _as_tensor(x)
+        cur = float(jnp.max(jnp.abs(x._data.astype(jnp.float32))))
+        prev = float(np.asarray(self.scale._data))
+        new = cur if prev == 0.0 else (
+            self._rate * prev + (1 - self._rate) * cur
+        )
+        self.scale._data = jnp.asarray(new, jnp.float32)
+        return x
+
+
+class FakeQuanterWithAbsMaxObserver(_BaseObserver):
+    """QAT quanter: update the moving-max scale in training and apply
+    STE fake-quant (upstream: quanters/abs_max.py
+    FakeQuanterWithAbsMaxObserverLayer)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9, dtype="float32"):
+        super().__init__(quant_bits)
+        self._rate = moving_rate
+
+    def forward(self, x):
+        x = _as_tensor(x)
+        if self.training:
+            cur = float(jnp.max(jnp.abs(x._data.astype(jnp.float32))))
+            prev = float(np.asarray(self.scale._data))
+            new = cur if prev == 0.0 else (
+                self._rate * prev + (1 - self._rate) * cur
+            )
+            self.scale._data = jnp.asarray(new, jnp.float32)
+        bits = self._quant_bits
+
+        def f(xr, sr):
+            return _fake_quant(xr, sr, bits)
+
+        return apply_op("fake_quant", f, x, self.scale)
+
+
+class QuantedLayer(Layer):
+    """Wraps a compute layer: fake-quant activations + weights before
+    the wrapped forward (upstream: nn/qat/conv.py, linear.py)."""
+
+    def __init__(self, layer, activation_quanter, weight_quanter):
+        super().__init__()
+        self._layer = layer
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None and \
+                getattr(self._layer, "weight", None) is not None:
+            w = self._layer.weight
+            orig = w._data
+            bits = self.weight_quanter.bit_length()
+            scale = jnp.max(jnp.abs(orig.astype(jnp.float32)))
+            self.weight_quanter.scale._data = scale
+            w._data = _fake_quant(orig, scale, bits)
+            try:
+                out = self._layer(x)
+            finally:
+                w._data = orig
+            return out
+        return self._layer(x)
+
+
+class _TypeConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """Maps layers/types to (activation, weight) quanter factories
+    (upstream: python/paddle/quantization/config.py)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._default = _TypeConfig(activation, weight)
+        self._type_configs = {}
+        self._layer_configs = {}
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        for t in layer_types:
+            self._type_configs[t] = _TypeConfig(activation, weight)
+
+    def add_layer_config(self, layers, activation=None, weight=None):
+        if not isinstance(layers, (list, tuple)):
+            layers = [layers]
+        for l in layers:
+            self._layer_configs[id(l)] = _TypeConfig(activation, weight)
+
+    def _config_for(self, layer):
+        cfg = self._layer_configs.get(id(layer))
+        if cfg is not None:
+            return cfg
+        cfg = self._type_configs.get(type(layer))
+        if cfg is not None:
+            return cfg
+        from ..nn import Conv2D, Linear
+
+        if isinstance(layer, (Linear, Conv2D)):
+            return self._default
+        return None
+
+
+def _swap_layers(model, make_wrapper):
+    for name, child in list(model.named_children()):
+        replaced = make_wrapper(child)
+        if replaced is not None:
+            model.add_sublayer(name, replaced)
+        else:
+            _swap_layers(child, make_wrapper)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (upstream: qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+
+        def wrap(layer):
+            cfg = self._config._config_for(layer)
+            if cfg is None:
+                return None
+            act = (cfg.activation or FakeQuanterWithAbsMaxObserver)()
+            wgt = (cfg.weight or FakeQuanterWithAbsMaxObserver)()
+            return QuantedLayer(layer, act, wgt)
+
+        return _swap_layers(model, wrap)
+
+
+class PTQ:
+    """Post-training quantization driver (upstream: ptq.py): insert
+    observers, run calibration batches, then ``convert`` freezes the
+    scales into fake-quant layers."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+
+        def wrap(layer):
+            cfg = self._config._config_for(layer)
+            if cfg is None:
+                return None
+            act = (cfg.activation or AbsMaxObserver)()
+            return QuantedLayer(layer, act, None)
+
+        return _swap_layers(model, wrap)
+
+    def convert(self, model, inplace=True):
+        """Replace observers with fixed-scale fake-quanters."""
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        for _, sub in model.named_sublayers(include_self=True):
+            if isinstance(sub, QuantedLayer) and isinstance(
+                sub.activation_quanter, _BaseObserver
+            ) and not isinstance(
+                sub.activation_quanter, FakeQuanterWithAbsMaxObserver
+            ):
+                obs = sub.activation_quanter
+                fq = FakeQuanterWithAbsMaxObserver(obs.bit_length())
+                fq.scale._data = obs.scale._data
+                fq.eval()
+                sub.activation_quanter = fq
+        return model
+
+
+class _NS:
+    pass
+
+
+quanters = _NS()
+quanters.FakeQuanterWithAbsMaxObserver = FakeQuanterWithAbsMaxObserver
+observers = _NS()
+observers.AbsMaxObserver = AbsMaxObserver
+observers.MovingAverageAbsMaxObserver = MovingAverageAbsMaxObserver

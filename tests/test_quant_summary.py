@@ -1,0 +1,147 @@
+"""Quantization + summary/flops + Auc tests (upstream analogs:
+test/quantization/test_quant.py, test/legacy_test/test_summary.py,
+test_auc_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as optim
+from paddle_tpu.quantization import (
+    AbsMaxObserver,
+    FakeQuanterWithAbsMaxObserver,
+    PTQ,
+    QAT,
+    QuantConfig,
+)
+
+
+def setup_module():
+    paddle.seed(33)
+
+
+def _xy():
+    rng = np.random.RandomState(0)
+    return (
+        paddle.to_tensor(rng.randn(32, 8).astype("float32")),
+        paddle.to_tensor(rng.randn(32, 4).astype("float32")),
+    )
+
+
+class TestFakeQuant:
+    def test_level_count(self):
+        for bits, levels in ((4, 15), (8, 255)):
+            fq = FakeQuanterWithAbsMaxObserver(quant_bits=bits)
+            x = paddle.to_tensor(
+                np.linspace(-1, 1, 2001).astype("float32"))
+            out = fq(x)
+            assert len(np.unique(out.numpy())) <= levels
+
+    def test_ste_gradient_passthrough(self):
+        fq = FakeQuanterWithAbsMaxObserver()
+        x = paddle.to_tensor(
+            np.linspace(-0.9, 0.9, 64).astype("float32"),
+            stop_gradient=False,
+        )
+        fq(x).sum().backward()
+        # straight-through: grad is 1 inside the clip range
+        np.testing.assert_allclose(
+            x.grad.numpy(), np.ones(64, "float32"), atol=1e-6
+        )
+
+    def test_observer_tracks_absmax(self):
+        obs = AbsMaxObserver()
+        obs(paddle.to_tensor(np.array([1.0, -3.0], "float32")))
+        obs(paddle.to_tensor(np.array([2.0], "float32")))
+        assert float(np.asarray(obs.scale._data)) == 3.0
+
+
+class TestQATPTQ:
+    def test_qat_trains_and_preserves_structure(self):
+        x, y = _xy()
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        q = QAT(QuantConfig()).quantize(model)
+        opt = optim.SGD(0.05, parameters=q.parameters())
+        losses = []
+        for _ in range(10):
+            loss = F.mse_loss(q(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+        # original model untouched (inplace=False deep copy)
+        assert not any(
+            type(c).__name__ == "QuantedLayer" for c in model.children()
+        )
+
+    def test_ptq_calibrate_convert(self):
+        x, _ = _xy()
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        ptq = PTQ(QuantConfig())
+        qm = ptq.quantize(model)
+        qm(x)
+        qm = ptq.convert(qm)
+        # frozen scale: out must be close to fp but not identical
+        ref = model(x).numpy()
+        out = qm(x).numpy()
+        assert np.abs(out - ref).max() > 0
+        np.testing.assert_allclose(out, ref, atol=0.2)
+
+    def test_type_config_selects_layers(self):
+        cfg = QuantConfig(None, None)
+        cfg.add_type_config(nn.Linear)
+        model = nn.Sequential(nn.Linear(4, 4), nn.Conv2D(1, 1, 1))
+        q = QAT(cfg).quantize(model)
+        kinds = [type(c).__name__ for c in q.children()]
+        assert kinds[0] == "QuantedLayer"
+
+
+class TestSummaryFlops:
+    def test_summary_counts(self):
+        from paddle_tpu.vision.models import LeNet
+
+        info = paddle.summary(LeNet(), (1, 1, 28, 28))
+        assert info["total_params"] == 61610
+        assert info["trainable_params"] == 61610
+
+    def test_flops_linear_exact(self):
+        m = nn.Linear(8, 4, bias_attr=False)
+        f = paddle.flops(m, (2, 8))
+        assert f == 2 * 2 * 8 * 4  # 2 * batch * in * out
+
+    def test_flops_conv(self):
+        m = nn.Conv2D(3, 6, 3, padding=1, bias_attr=False)
+        f = paddle.flops(m, (1, 3, 8, 8))
+        assert f == 2 * (6 * 8 * 8) * (3 * 3 * 3)
+
+
+class TestAuc:
+    def test_matches_sklearn(self):
+        skm = pytest.importorskip("sklearn.metrics")
+        from paddle_tpu.metric import Auc
+
+        rng = np.random.RandomState(0)
+        scores = rng.rand(2000).astype("float32")
+        labels = (scores + rng.randn(2000) * 0.3 > 0.5).astype("int64")
+        auc = Auc()
+        # two-chunk update exercises accumulation
+        auc.update(paddle.to_tensor(scores[:1000]),
+                   paddle.to_tensor(labels[:1000]))
+        auc.update(paddle.to_tensor(scores[1000:]),
+                   paddle.to_tensor(labels[1000:]))
+        ref = skm.roc_auc_score(labels, scores)
+        np.testing.assert_allclose(auc.accumulate(), ref, atol=1e-3)
+
+    def test_two_column_probs_and_empty(self):
+        from paddle_tpu.metric import Auc
+
+        auc = Auc()
+        assert auc.accumulate() == 0.0
+        probs = np.array([[0.9, 0.1], [0.2, 0.8]], "float32")
+        auc.update(paddle.to_tensor(probs),
+                   paddle.to_tensor(np.array([0, 1], "int64")))
+        assert auc.accumulate() == 1.0
