@@ -119,6 +119,7 @@ def _lazy_imports():
     from . import distribution  # noqa
     from . import audio  # noqa
     from . import quantization  # noqa
+    from . import text  # noqa
     from . import inference  # noqa
     from . import sparse  # noqa
     from . import nn  # noqa

@@ -1,0 +1,20 @@
+"""paddle_tpu.text (upstream: python/paddle/text/datasets/).
+
+Zero-egress environment: each dataset reads the standard archive when a
+local ``data_file`` exists (same formats the reference downloads),
+otherwise serves deterministic synthetic data with the real schema so
+pipelines remain runnable end-to-end.
+"""
+from .datasets import (  # noqa
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    ViterbiDecoder,
+    viterbi_decode,
+)
+
+__all__ = [
+    "Imdb", "Imikolov", "Movielens", "UCIHousing",
+    "ViterbiDecoder", "viterbi_decode",
+]

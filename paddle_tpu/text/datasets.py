@@ -1,0 +1,314 @@
+"""Text datasets + Viterbi decode (upstream: python/paddle/text/
+datasets/{imdb,imikolov,movielens,uci_housing}.py, viterbi_decode.py)."""
+from __future__ import annotations
+
+import os
+import re
+import string
+import tarfile
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+from ..io import Dataset
+from ..nn.layer.layers import Layer
+
+
+def _default_cache(name):
+    return os.path.expanduser(f"~/.cache/paddle/dataset/{name}")
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (upstream: text/datasets/imdb.py): aclImdb
+    tarball -> (token-id sequence, 0/1 label). Without the archive,
+    synthetic reviews with a consistent vocabulary."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        self.mode = mode
+        path = data_file or _default_cache("imdb/aclImdb_v1.tar.gz")
+        if os.path.exists(path):
+            self._load_tar(path, mode, cutoff)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 512
+            self.word_idx = {
+                w: i for i, w in enumerate(
+                    [f"w{j}" for j in range(cutoff)] + ["<unk>"]
+                )
+            }
+            vocab = len(self.word_idx)
+            self.docs = [
+                rng.randint(0, vocab, size=rng.randint(8, 64)).astype(
+                    np.int64
+                )
+                for _ in range(n)
+            ]
+            self.labels = rng.randint(0, 2, size=n).astype(np.int64)
+
+    def _load_tar(self, path, mode, cutoff):
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        trans = str.maketrans("", "", string.punctuation)
+        freq = {}
+        docs_raw = []
+        labels = []
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if not pat.match(member.name):
+                    continue
+                text = (
+                    tf.extractfile(member).read().decode("latin-1")
+                    .lower().translate(trans)
+                )
+                toks = text.split()
+                docs_raw.append(toks)
+                labels.append(
+                    0 if "/neg/" in member.name else 1
+                )
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+        words = sorted(freq, key=lambda w: (-freq[w], w))[:cutoff]
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        unk = self.word_idx["<unk>"] = len(self.word_idx)
+        self.docs = [
+            np.asarray(
+                [self.word_idx.get(t, unk) for t in toks], np.int64
+            )
+            for toks in docs_raw
+        ]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.int64(self.labels[idx])
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram dataset (upstream: imikolov.py). Yields n-gram
+    windows of token ids."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        self.window = int(window_size)
+        path = data_file or _default_cache(
+            "imikolov/simple-examples.tgz"
+        )
+        if os.path.exists(path):
+            self._load_tar(path, mode, min_word_freq)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            vocab = 200
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+            stream = rng.randint(0, vocab, size=5000).astype(np.int64)
+            self.grams = np.lib.stride_tricks.sliding_window_view(
+                stream, self.window
+            ).copy()
+
+    def _load_tar(self, path, mode, min_word_freq):
+        fname = (
+            "./simple-examples/data/ptb.train.txt" if mode == "train"
+            else "./simple-examples/data/ptb.valid.txt"
+        )
+        with tarfile.open(path) as tf:
+            text = tf.extractfile(fname).read().decode()
+        tokens = text.replace("\n", " <eos> ").split()
+        freq = {}
+        for t in tokens:
+            freq[t] = freq.get(t, 0) + 1
+        words = sorted(
+            (w for w, c in freq.items() if c >= min_word_freq),
+            key=lambda w: (-freq[w], w),
+        )
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        unk = self.word_idx.setdefault("<unk>", len(self.word_idx))
+        ids = np.asarray(
+            [self.word_idx.get(t, unk) for t in tokens], np.int64
+        )
+        self.grams = np.lib.stride_tricks.sliding_window_view(
+            ids, self.window
+        ).copy()
+
+    def __len__(self):
+        return len(self.grams)
+
+    def __getitem__(self, idx):
+        return self.grams[idx]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (upstream: movielens.py): (user feats,
+    movie feats, rating)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        path = data_file or _default_cache("movielens/ml-1m.zip")
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        if os.path.exists(path):
+            self._load_zip(path, mode)
+        else:
+            n = 1024
+            self.rows = [
+                (
+                    np.int64(rng.randint(1, 6041)),   # user id
+                    np.int64(rng.randint(0, 2)),      # gender
+                    np.int64(rng.randint(0, 7)),      # age bucket
+                    np.int64(rng.randint(0, 21)),     # occupation
+                    np.int64(rng.randint(1, 3953)),   # movie id
+                    rng.randint(0, 19, size=3).astype(np.int64),  # genres
+                    np.float32(rng.randint(1, 6)),    # rating
+                )
+                for _ in range(n)
+            ]
+
+    def _load_zip(self, path, mode):
+        import zipfile
+
+        with zipfile.ZipFile(path) as z:
+            ratings = z.read("ml-1m/ratings.dat").decode(
+                "latin-1").strip().split("\n")
+        rows = []
+        for i, line in enumerate(ratings):
+            if (i % 10 == 0) != (mode != "train"):
+                continue
+            u, m, r, _ = line.split("::")
+            rows.append((
+                np.int64(u), np.int64(0), np.int64(0), np.int64(0),
+                np.int64(m), np.zeros(3, np.int64), np.float32(r),
+            ))
+        self.rows = rows
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, idx):
+        return self.rows[idx]
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (upstream: uci_housing.py):
+    13 features -> price."""
+
+    N_FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train"):
+        path = data_file or _default_cache("uci_housing/housing.data")
+        if os.path.exists(path):
+            raw = np.loadtxt(path).astype(np.float32)
+        else:
+            rng = np.random.RandomState(0)
+            x = rng.randn(506, self.N_FEATURES).astype(np.float32)
+            w = rng.randn(self.N_FEATURES).astype(np.float32)
+            y = x @ w + rng.randn(506).astype(np.float32) * 0.1
+            raw = np.concatenate([x, y[:, None]], axis=1)
+        feats = raw[:, :-1]
+        mean, std = feats.mean(0), feats.std(0) + 1e-8
+        feats = (feats - mean) / std
+        split = int(len(raw) * 0.8)
+        if mode == "train":
+            self.x, self.y = feats[:split], raw[:split, -1:]
+        else:
+            self.x, self.y = feats[split:], raw[split:, -1:]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Batched Viterbi decoding (upstream: paddle/phi/kernels/cpu/
+    viterbi_decode_kernel.cc; python/paddle/text/viterbi_decode.py).
+
+    potentials: (B, T, N) unary emissions; transition_params: (N, N);
+    lengths: (B,) int. Returns (scores (B,), paths (B, T)).
+    TPU-first: the max-product recursion is a ``lax.scan`` over time
+    with a backtrace gather — no dynamic shapes.
+    """
+    potentials = _as_tensor(potentials)
+    transition_params = _as_tensor(transition_params)
+    lengths = _as_tensor(lengths)
+
+    def f(pot, trans, ln):
+        b, t, n = pot.shape
+        pot = pot.astype(jnp.float32)
+        trans = trans.astype(jnp.float32)
+        ln = ln.astype(jnp.int32)
+
+        if include_bos_eos_tag:
+            # reference semantics: tag N-2 = BOS, N-1 = EOS; first step
+            # starts from BOS, last transitions to EOS
+            init = pot[:, 0] + trans[n - 2][None, :]
+        else:
+            init = pot[:, 0]
+
+        def step(carry, xt):
+            alpha, tstep = carry
+            # alpha: (B, N); score via best previous tag
+            scores = alpha[:, :, None] + trans[None, :, :]  # (B, N, N)
+            best_prev = jnp.argmax(scores, axis=1)          # (B, N)
+            best_score = jnp.max(scores, axis=1) + xt       # (B, N)
+            # steps beyond a lane's length keep alpha frozen
+            ok = (tstep < ln)[:, None]
+            alpha_new = jnp.where(ok, best_score, alpha)
+            return (alpha_new, tstep + 1), best_prev
+
+        (alpha, _), backptrs = jax.lax.scan(
+            step, (init, jnp.ones((), jnp.int32)),
+            jnp.swapaxes(pot[:, 1:], 0, 1),
+        )  # backptrs: (T-1, B, N)
+
+        if include_bos_eos_tag:
+            alpha = alpha + trans[None, :, n - 1]
+
+        last_tag = jnp.argmax(alpha, axis=1)       # (B,)
+        score = jnp.max(alpha, axis=1)
+
+        # backtrace from each lane's (length-1) step
+        def back(carry, bp_t):
+            tag, tstep = carry
+            prev = jnp.take_along_axis(
+                bp_t, tag[:, None], axis=1
+            )[:, 0]
+            # only steps with tstep < len participate
+            use = (tstep < ln)
+            tag_new = jnp.where(use, prev, tag)
+            return (tag_new, tstep - 1), tag_new
+
+        t_idx = jnp.arange(t - 1, 0, -1)
+        (first_tag, _), rev_tags = jax.lax.scan(
+            back, (last_tag, jnp.asarray(t - 1, jnp.int32)),
+            backptrs[::-1],
+        )
+        # scan emitted tags for steps t-2..0; path = emitted reversed
+        # + last_tag at each lane's final position
+        path = jnp.concatenate(
+            [rev_tags[::-1], last_tag[None]], axis=0
+        )  # (T, B) — path[s] = tag at step s for full-length lanes
+        path = jnp.swapaxes(path, 0, 1)  # (B, T)
+        # mask steps past each lane's length with the lane's last tag
+        steps = jnp.arange(t)[None, :]
+        path = jnp.where(steps < ln[:, None], path, 0)
+        return score, path.astype(jnp.int64)
+
+    return apply_op(
+        "viterbi_decode", f, potentials, transition_params, lengths,
+        n_outs=2, differentiable=False,
+    )
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = _as_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(
+            potentials, self.transitions, lengths,
+            self.include_bos_eos_tag,
+        )

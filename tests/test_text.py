@@ -1,0 +1,101 @@
+"""paddle.text datasets + Viterbi decode (upstream analogs:
+test/legacy_test/test_viterbi_decode_op.py, text dataset tests)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestTextDatasets:
+    def test_imdb_schema(self):
+        ds = paddle.text.Imdb()
+        assert len(ds) > 0
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and int(label) in (0, 1)
+        assert "<unk>" in ds.word_idx
+
+    def test_imikolov_ngrams(self):
+        ds = paddle.text.Imikolov(window_size=5)
+        assert ds[0].shape == (5,)
+
+    def test_uci_housing_normalized(self):
+        tr = paddle.text.UCIHousing(mode="train")
+        te = paddle.text.UCIHousing(mode="test")
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert len(tr) > len(te)
+
+    def test_movielens_fields(self):
+        row = paddle.text.Movielens()[0]
+        assert len(row) == 7
+        assert row[5].shape == (3,)  # genre ids
+
+
+class TestViterbi:
+    def _brute(self, pot, trans, L):
+        n = pot.shape[-1]
+        best, best_p = -1e30, None
+        for p in itertools.product(range(n), repeat=L):
+            s = pot[0, p[0]] + sum(
+                pot[t, p[t]] + trans[p[t - 1], p[t]]
+                for t in range(1, L)
+            )
+            if s > best:
+                best, best_p = s, p
+        return best, list(best_p)
+
+    def test_matches_bruteforce_varlen(self):
+        rng = np.random.RandomState(1)
+        B, T, N = 3, 6, 3
+        pot = rng.randn(B, T, N).astype("float32")
+        trans = rng.randn(N, N).astype("float32")
+        lens = np.array([6, 4, 2], "int64")
+        score, path = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False,
+        )
+        for b in range(B):
+            ref_s, ref_p = self._brute(pot[b], trans, int(lens[b]))
+            np.testing.assert_allclose(
+                score.numpy()[b], ref_s, rtol=1e-5
+            )
+            assert path.numpy()[b].tolist()[:int(lens[b])] == ref_p
+
+    def test_bos_eos_tags(self):
+        rng = np.random.RandomState(2)
+        B, T, N = 2, 4, 5  # tags N-2=BOS, N-1=EOS
+        pot = rng.randn(B, T, N).astype("float32")
+        trans = rng.randn(N, N).astype("float32")
+        lens = np.full(B, T, "int64")
+        score, path = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=True,
+        )
+        # brute force with bos/eos augmentation
+        for b in range(B):
+            best, best_p = -1e30, None
+            for p in itertools.product(range(N), repeat=T):
+                s = (trans[N - 2, p[0]] + pot[b, 0, p[0]]
+                     + sum(pot[b, t, p[t]] + trans[p[t - 1], p[t]]
+                           for t in range(1, T))
+                     + trans[p[-1], N - 1])
+                if s > best:
+                    best, best_p = s, p
+            np.testing.assert_allclose(
+                score.numpy()[b], best, rtol=1e-5
+            )
+            assert path.numpy()[b].tolist() == list(best_p)
+
+    def test_layer_wrapper(self):
+        rng = np.random.RandomState(3)
+        dec = paddle.text.ViterbiDecoder(
+            paddle.to_tensor(rng.randn(4, 4).astype("float32")),
+            include_bos_eos_tag=False,
+        )
+        score, path = dec(
+            paddle.to_tensor(rng.randn(2, 5, 4).astype("float32")),
+            paddle.to_tensor(np.array([5, 5], "int64")),
+        )
+        assert score.shape == [2] and path.shape == [2, 5]
