@@ -86,6 +86,9 @@ def _build_and_load():
             [c.c_void_p, c.c_int32, c.POINTER(c.c_void_p)], c.c_int32,
         ),
         "pt_shm_release": ([c.c_void_p, c.c_int32], c.c_int32),
+        "pt_shm_writer_ptr": ([c.c_void_p, c.c_int32], c.c_void_p),
+        "pt_shm_commit": ([c.c_void_p, c.c_int32], c.c_int32),
+        "pt_shm_abort": ([c.c_void_p, c.c_int32], c.c_int32),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)
@@ -240,15 +243,18 @@ class ShmArena:
 
     # -- writer (worker) side ----------------------------------------------
     def write_arrays(self, arrays, timeout=10.0):
-        """Pack a flat list of numpy arrays into one slot. Returns
-        (slot, meta) where meta = [(shape, dtype_str, offset), ...];
-        None if the payload exceeds slot_bytes (caller falls back)."""
+        """Pack a flat list of numpy arrays into one slot — ONE copy:
+        np.copyto straight into the mapped slot via the writer pointer.
+        Returns (slot, meta) with meta = [(shape, dtype_str, offset),
+        ...]; None if the payload exceeds slot_bytes (caller falls
+        back). On any failure after acquire the slot is aborted back to
+        FREE (no capacity leak)."""
         import numpy as np
 
+        arrays = [np.ascontiguousarray(a) for a in arrays]
         total = 0
         meta = []
         for a in arrays:
-            a = np.ascontiguousarray(a)
             off = (total + 63) & ~63  # 64B-align each array
             meta.append((a.shape, a.dtype.str, off))
             total = off + a.nbytes
@@ -257,14 +263,19 @@ class ShmArena:
         slot = self._lib.pt_shm_acquire(self._h, float(timeout))
         if slot < 0:
             raise TimeoutError("no free shm slot")
-        buf = bytearray(total)
-        for a, (_, _, off) in zip(arrays, meta):
-            a = np.ascontiguousarray(a)
-            buf[off:off + a.nbytes] = a.tobytes()
-        src = (ctypes.c_char * total).from_buffer(buf)
-        wrote = self._lib.pt_shm_write(self._h, slot, src, total)
-        if wrote < 0:
-            raise RuntimeError("pt_shm_write failed")
+        try:
+            ptr = self._lib.pt_shm_writer_ptr(self._h, slot)
+            if not ptr:
+                raise RuntimeError("pt_shm_writer_ptr failed")
+            for a, (_, _, off) in zip(arrays, meta):
+                raw = (ctypes.c_char * a.nbytes).from_address(ptr + off)
+                dst = np.frombuffer(raw, dtype=a.dtype).reshape(a.shape)
+                np.copyto(dst, a)
+            if self._lib.pt_shm_commit(self._h, slot) != 0:
+                raise RuntimeError("pt_shm_commit failed")
+        except Exception:
+            self._lib.pt_shm_abort(self._h, slot)
+            raise
         return slot, meta
 
     # -- reader (parent) side ----------------------------------------------

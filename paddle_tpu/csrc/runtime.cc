@@ -669,3 +669,33 @@ PT_API int32_t pt_shm_release(void* h, int32_t slot) {
   slot_state(a->hdr, slot)->store(kSlotFree, std::memory_order_release);
   return 0;
 }
+
+// Writer-side zero-intermediate path: expose the claimed slot's payload
+// pointer so Python can np.copyto straight into shared memory (ONE
+// copy), then commit (-> READY) or abort (-> FREE on failure, so a
+// write error can't leak the slot in WRITING state).
+PT_API void* pt_shm_writer_ptr(void* h, int32_t slot) {
+  auto* a = static_cast<Arena*>(h);
+  if (slot < 0 || uint32_t(slot) >= a->hdr->n_slots) return nullptr;
+  if (slot_state(a->hdr, slot)->load(std::memory_order_acquire) !=
+      kSlotWriting)
+    return nullptr;
+  return slot_payload(a, slot);
+}
+
+PT_API int32_t pt_shm_commit(void* h, int32_t slot) {
+  auto* a = static_cast<Arena*>(h);
+  if (slot < 0 || uint32_t(slot) >= a->hdr->n_slots) return -1;
+  uint32_t expect = kSlotWriting;
+  if (!slot_state(a->hdr, slot)->compare_exchange_strong(
+          expect, kSlotReady, std::memory_order_acq_rel))
+    return -1;
+  return 0;
+}
+
+PT_API int32_t pt_shm_abort(void* h, int32_t slot) {
+  auto* a = static_cast<Arena*>(h);
+  if (slot < 0 || uint32_t(slot) >= a->hdr->n_slots) return -1;
+  slot_state(a->hdr, slot)->store(kSlotFree, std::memory_order_release);
+  return 0;
+}

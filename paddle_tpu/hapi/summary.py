@@ -41,7 +41,7 @@ def _run_with_hooks(net, input_size, dtypes, on_layer):
                 make_hook(name, layer)))
         )
 
-    if isinstance(input_size, tuple) and input_size and \
+    if isinstance(input_size, (tuple, list)) and input_size and \
             isinstance(input_size[0], (tuple, list)):
         sizes = list(input_size)
     else:

@@ -481,9 +481,12 @@ def _mp_worker(dataset, use_default_collate, collate_fn, index_q,
             if arena is not None:
                 leaves, spec = _flatten_np(batch)
                 if leaves:
-                    # generous timeout: blocked only if the consumer
-                    # stalls with every slot in flight
-                    packed = arena.write_arrays(leaves, timeout=300.0)
+                    try:
+                        packed = arena.write_arrays(leaves, timeout=30.0)
+                    except TimeoutError:
+                        # all slots in flight (consumer lagging) — the
+                        # pickled pipe still works; never fail the epoch
+                        packed = None
                     if packed is not None:
                         slot, meta = packed
                         result_q.put(

@@ -168,7 +168,20 @@ class QuantConfig:
         for l in layers:
             self._layer_configs[id(l)] = _TypeConfig(activation, weight)
 
-    def _config_for(self, layer):
+    def _path_configs(self, model):
+        """Layer configs re-keyed by structural path, resolved on the
+        ORIGINAL model — id()-keyed configs would be silently lost by
+        the deepcopy that quantize(inplace=False) performs."""
+        out = {}
+        for name, sub in model.named_sublayers(include_self=True):
+            cfg = self._layer_configs.get(id(sub))
+            if cfg is not None:
+                out[name] = cfg
+        return out
+
+    def _config_for(self, layer, path=None, path_cfgs=None):
+        if path_cfgs and path in path_cfgs:
+            return path_cfgs[path]
         cfg = self._layer_configs.get(id(layer))
         if cfg is not None:
             return cfg
@@ -182,13 +195,14 @@ class QuantConfig:
         return None
 
 
-def _swap_layers(model, make_wrapper):
+def _swap_layers(model, make_wrapper, prefix=""):
     for name, child in list(model.named_children()):
-        replaced = make_wrapper(child)
+        path = f"{prefix}.{name}" if prefix else name
+        replaced = make_wrapper(child, path)
         if replaced is not None:
             model.add_sublayer(name, replaced)
         else:
-            _swap_layers(child, make_wrapper)
+            _swap_layers(child, make_wrapper, path)
     return model
 
 
@@ -199,13 +213,14 @@ class QAT:
         self._config = config
 
     def quantize(self, model, inplace=False):
+        path_cfgs = self._config._path_configs(model)
         if not inplace:
             import copy
 
             model = copy.deepcopy(model)
 
-        def wrap(layer):
-            cfg = self._config._config_for(layer)
+        def wrap(layer, path):
+            cfg = self._config._config_for(layer, path, path_cfgs)
             if cfg is None:
                 return None
             act = (cfg.activation or FakeQuanterWithAbsMaxObserver)()
@@ -224,13 +239,14 @@ class PTQ:
         self._config = config
 
     def quantize(self, model, inplace=False):
+        path_cfgs = self._config._path_configs(model)
         if not inplace:
             import copy
 
             model = copy.deepcopy(model)
 
-        def wrap(layer):
-            cfg = self._config._config_for(layer)
+        def wrap(layer, path):
+            cfg = self._config._config_for(layer, path, path_cfgs)
             if cfg is None:
                 return None
             act = (cfg.activation or AbsMaxObserver)()

@@ -69,7 +69,12 @@ class Imdb(Dataset):
                 )
                 for t in toks:
                     freq[t] = freq.get(t, 0) + 1
-        words = sorted(freq, key=lambda w: (-freq[w], w))[:cutoff]
+        # reference semantics: cutoff is a minimum-frequency threshold
+        # (keep words appearing more than `cutoff` times), not a top-N
+        words = sorted(
+            (w for w, c in freq.items() if c > cutoff),
+            key=lambda w: (-freq[w], w),
+        )
         self.word_idx = {w: i for i, w in enumerate(words)}
         unk = self.word_idx["<unk>"] = len(self.word_idx)
         self.docs = [
@@ -279,7 +284,6 @@ def viterbi_decode(potentials, transition_params, lengths,
             tag_new = jnp.where(use, prev, tag)
             return (tag_new, tstep - 1), tag_new
 
-        t_idx = jnp.arange(t - 1, 0, -1)
         (first_tag, _), rev_tags = jax.lax.scan(
             back, (last_tag, jnp.asarray(t - 1, jnp.int32)),
             backptrs[::-1],
