@@ -1,3 +1,4 @@
 """paddle.incubate analog (upstream: python/paddle/incubate/)."""
+from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401

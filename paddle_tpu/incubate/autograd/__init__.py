@@ -1,0 +1,139 @@
+"""Functional autograd transforms (upstream: python/paddle/incubate/
+autograd/ — primapi.py jvp/vjp, functional.py Jacobian/Hessian).
+
+Built directly on jax's transforms where the API is functional (jvp,
+vjp take a callable), and on the tape's create_graph machinery where it
+is tensor-based (Jacobian/Hessian over already-computed outputs).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ...framework.core import Tensor, _as_tensor
+from ...autograd.functional import hessian as _hessian
+from ...autograd.functional import jacobian as _jacobian
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "jacobian", "hessian"]
+
+jacobian = _jacobian
+hessian = _hessian
+
+
+def _wrap_func(func):
+    """Lift a Tensor->Tensor function to raw jnp arrays for jax
+    transforms (runs outside the tape; purity is the caller's
+    contract, as in the reference's primitive API)."""
+
+    def raw(*arrs):
+        ins = [Tensor(a) for a in arrs]
+        out = func(*ins)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    return raw
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (func(xs), J @ v) (upstream primapi.jvp)."""
+    xs_list = [xs] if isinstance(xs, Tensor) else list(xs)
+    if v is None:
+        v_list = [Tensor(jax.numpy.ones_like(x._data)) for x in xs_list]
+    else:
+        v_list = [v] if isinstance(v, Tensor) else list(v)
+    raw = _wrap_func(func)
+    out, tangent = jax.jvp(
+        raw,
+        tuple(x._data for x in xs_list),
+        tuple(t._data for t in v_list),
+    )
+    pack = (
+        lambda r: tuple(Tensor(o) for o in r)
+        if isinstance(r, tuple) else Tensor(r)
+    )
+    return pack(out), pack(tangent)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: returns (func(xs), vᵀ @ J) (upstream primapi.vjp)."""
+    xs_list = [xs] if isinstance(xs, Tensor) else list(xs)
+    raw = _wrap_func(func)
+    out, vjp_fn = jax.vjp(raw, *(x._data for x in xs_list))
+    if v is None:
+        if isinstance(out, tuple):
+            cot = tuple(jax.numpy.ones_like(o) for o in out)
+        else:
+            cot = jax.numpy.ones_like(out)
+    else:
+        v_list = [v] if isinstance(v, Tensor) else list(v)
+        cot = (
+            tuple(t._data for t in v_list)
+            if isinstance(out, tuple) else v_list[0]._data
+        )
+    grads = vjp_fn(cot)
+    outs = (
+        tuple(Tensor(o) for o in out) if isinstance(out, tuple)
+        else Tensor(out)
+    )
+    gs = [Tensor(g) for g in grads]
+    return outs, (gs[0] if len(gs) == 1 else gs)
+
+
+class Jacobian:
+    """Lazy row-indexable Jacobian of func at xs (upstream:
+    incubate/autograd/functional.py Jacobian). The full matrix is
+    computed once on first access via jax.jacrev."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = xs
+        self._batched = is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is None:
+            raw = _wrap_func(self._func)
+            x = (
+                self._xs._data if isinstance(self._xs, Tensor)
+                else tuple(t._data for t in self._xs)
+            )
+            if isinstance(self._xs, Tensor):
+                j = jax.jacrev(raw)(x)
+                if self._batched:
+                    # (B, my..., B, nx...) -> take the diagonal batch
+                    b = j.shape[0]
+                    idx = np.arange(b)
+                    j = j[idx, ..., idx, :] if j.ndim >= 3 else j
+                self._mat = Tensor(j)
+            else:
+                raise NotImplementedError(
+                    "multi-input Jacobian: use paddle.autograd.jacobian"
+                )
+        return self._mat
+
+    def __getitem__(self, idx):
+        return self._materialize()[idx]
+
+    @property
+    def shape(self):
+        return self._materialize().shape
+
+    def numpy(self):
+        return self._materialize().numpy()
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian of a scalar-output func (upstream Hessian)."""
+
+    def _materialize(self):
+        if self._mat is None:
+            raw = _wrap_func(self._func)
+            if not isinstance(self._xs, Tensor):
+                raise NotImplementedError(
+                    "multi-input Hessian: use paddle.autograd.hessian"
+                )
+            h = jax.hessian(raw)(self._xs._data)
+            self._mat = Tensor(h)
+        return self._mat

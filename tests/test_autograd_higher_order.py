@@ -94,3 +94,28 @@ class TestJacobianHessian:
         np.testing.assert_allclose(
             Hb.numpy()[0], np.diag(6.0 * xb.numpy()[0]), rtol=1e-4
         )
+
+
+class TestIncubateAutograd:
+    def test_jvp_vjp(self):
+        from paddle_tpu.incubate import autograd as IA
+
+        x = _t([1.0, 2.0, 3.0])
+        _, tangent = IA.jvp(lambda a: a * a, x,
+                            _t([1.0, 1.0, 1.0]))
+        np.testing.assert_allclose(tangent.numpy(), [2.0, 4.0, 6.0])
+        _, grad = IA.vjp(lambda a: (a * a).sum(), x)
+        np.testing.assert_allclose(grad.numpy(), [2.0, 4.0, 6.0])
+
+    def test_lazy_jacobian_hessian(self):
+        from paddle_tpu.incubate import autograd as IA
+
+        x = _t([1.0, 2.0])
+        J = IA.Jacobian(lambda a: a * a, x)
+        np.testing.assert_allclose(
+            J.numpy(), np.diag([2.0, 4.0])
+        )
+        H = IA.Hessian(lambda a: (a ** 3).sum(), x)
+        np.testing.assert_allclose(
+            H.numpy(), np.diag([6.0, 12.0])
+        )
