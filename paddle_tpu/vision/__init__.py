@@ -2,3 +2,4 @@
 from . import datasets  # noqa
 from . import models  # noqa
 from . import transforms  # noqa
+from . import ops  # noqa

@@ -1,0 +1,570 @@
+"""Detection/vision ops (upstream: python/paddle/vision/ops.py, kernels
+in paddle/phi/kernels/gpu/{roi_align,roi_pool,nms,deformable_conv,
+box_coder,yolo_box,prior_box}_kernel.cu).
+
+TPU-first split: the dense, differentiable ops (roi_align, roi_pool,
+deform_conv2d) are pure-jnp gather/matmul compositions that compile and
+differentiate on device; the host-side postprocessing ops with
+data-dependent output shapes (nms, prior box generation) run as eager
+numpy — the same place they sit in a TPU serving pipeline, where
+dynamic-shape suppression can't live inside the compiled graph.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "roi_align", "roi_pool", "nms", "box_coder", "yolo_box",
+    "prior_box", "deform_conv2d", "RoIAlign", "RoIPool", "DeformConv2D",
+    "PSRoIPool", "psroi_pool",
+]
+
+
+def _bilinear_gather(feat, ys, xs):
+    """feat: (C, H, W); ys/xs: arbitrary same-shaped coords. Bilinear
+    sample with zero padding outside."""
+    c, h, w = feat.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+
+    def fetch(yi, xi):
+        ok = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        v = feat[:, yc, xc]  # (C, ...)
+        return v * ok[None]
+
+    v00 = fetch(y0, x0)
+    v01 = fetch(y0, x0 + 1)
+    v10 = fetch(y0 + 1, x0)
+    v11 = fetch(y0 + 1, x0 + 1)
+    return (
+        v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+        + v10 * wy * (1 - wx) + v11 * wy * wx
+    )
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (upstream roi_align): boxes (R, 4) xyxy in input-image
+    coords; boxes_num (B,) partitions rows across the batch."""
+    x = _as_tensor(x)
+    boxes = _as_tensor(boxes)
+    boxes_num = _as_tensor(boxes_num)
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    ratio = int(sampling_ratio) if sampling_ratio > 0 else 2
+
+    def f(feat, bx, bn):
+        n_rois = bx.shape[0]
+        # map each roi row to its batch image
+        img_idx = jnp.repeat(
+            jnp.arange(bn.shape[0]), bn.astype(jnp.int32),
+            total_repeat_length=n_rois,
+        )
+        off = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - off
+        y1 = bx[:, 1] * spatial_scale - off
+        x2 = bx[:, 2] * spatial_scale - off
+        y2 = bx[:, 3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / oh
+        bin_w = rw / ow
+
+        # sample grid: (oh*ratio, ow*ratio) points per roi
+        gy = (jnp.arange(oh * ratio) + 0.5) / ratio  # in bin units
+        gx = (jnp.arange(ow * ratio) + 0.5) / ratio
+
+        def per_roi(i):
+            ys = y1[i] + bin_h[i] * gy  # (oh*r,)
+            xs = x1[i] + bin_w[i] * gx  # (ow*r,)
+            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+            vals = _bilinear_gather(
+                feat[img_idx[i]].astype(jnp.float32), yy, xx
+            )  # (C, oh*r, ow*r)
+            c = vals.shape[0]
+            vals = vals.reshape(c, oh, ratio, ow, ratio)
+            return vals.mean(axis=(2, 4))
+
+        out = jax.vmap(per_roi)(jnp.arange(n_rois))
+        return out.astype(feat.dtype)
+
+    return apply_op("roi_align", f, x, boxes, boxes_num)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """RoIPool (upstream roi_pool): max over quantized bins."""
+    x = _as_tensor(x)
+    boxes = _as_tensor(boxes)
+    boxes_num = _as_tensor(boxes_num)
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+
+    def f(feat, bx, bn):
+        n_rois = bx.shape[0]
+        _, c, h, w = feat.shape
+        img_idx = jnp.repeat(
+            jnp.arange(bn.shape[0]), bn.astype(jnp.int32),
+            total_repeat_length=n_rois,
+        )
+        x1 = jnp.round(bx[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(bx[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(bx[:, 2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(bx[:, 3] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+
+        ys_all = jnp.arange(h)
+        xs_all = jnp.arange(w)
+
+        def per_roi(i):
+            fm = feat[img_idx[i]].astype(jnp.float32)  # (C, H, W)
+
+            def per_bin(ph, pw):
+                hs = y1[i] + (ph * rh[i]) // oh
+                he = y1[i] + ((ph + 1) * rh[i] + oh - 1) // oh
+                ws = x1[i] + (pw * rw[i]) // ow
+                we = x1[i] + ((pw + 1) * rw[i] + ow - 1) // ow
+                m = (
+                    (ys_all[:, None] >= hs) & (ys_all[:, None] < he)
+                    & (xs_all[None, :] >= ws) & (xs_all[None, :] < we)
+                )
+                sel = jnp.where(m[None], fm, -jnp.inf)
+                v = jnp.max(sel, axis=(1, 2))
+                return jnp.where(jnp.isfinite(v), v, 0.0)
+
+            grid = [
+                [per_bin(ph, pw) for pw in range(ow)]
+                for ph in range(oh)
+            ]
+            return jnp.stack(
+                [jnp.stack(row, axis=-1) for row in grid], axis=-2
+            )  # (C, oh, ow)
+
+        out = jax.vmap(per_roi)(jnp.arange(n_rois))
+        return out.astype(feat.dtype)
+
+    return apply_op("roi_pool", f, x, boxes, boxes_num)
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    xx1 = np.maximum(x1[:, None], x1[None, :])
+    yy1 = np.maximum(y1[:, None], y1[None, :])
+    xx2 = np.minimum(x2[:, None], x2[None, :])
+    yy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / np.maximum(union, 1e-9)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (upstream nms): returns kept indices sorted by score.
+    Host-side numpy — output shape is data-dependent (the reference's
+    GPU kernel also ends in a host sync for the same reason)."""
+    b = np.asarray(
+        boxes._data if isinstance(boxes, Tensor) else boxes, np.float32
+    )
+    n = b.shape[0]
+    s = (
+        np.asarray(scores._data if isinstance(scores, Tensor)
+                   else scores, np.float32)
+        if scores is not None else np.arange(n, 0, -1, dtype=np.float32)
+    )
+    cats = (
+        np.asarray(category_idxs._data
+                   if isinstance(category_idxs, Tensor)
+                   else category_idxs)
+        if category_idxs is not None else np.zeros(n, np.int64)
+    )
+    iou = _iou_matrix(b)
+    keep = []
+    for c in (categories if categories is not None
+              else np.unique(cats)):
+        idxs = np.where(cats == c)[0]
+        order = idxs[np.argsort(-s[idxs])]
+        alive = list(order)
+        while alive:
+            i = alive.pop(0)
+            keep.append(i)
+            alive = [j for j in alive if iou[i, j] <= iou_threshold]
+    keep = np.asarray(keep, np.int64)
+    keep = keep[np.argsort(-s[keep])]
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (upstream box_coder)."""
+    prior_box = _as_tensor(prior_box)
+    target_box = _as_tensor(target_box)
+    pvar = prior_box_var
+
+    def f(pb, tb, *rest):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if rest:
+            var = rest[0]
+        elif isinstance(pvar, (list, tuple)):
+            var = jnp.asarray(pvar, jnp.float32)[None, :]
+        else:
+            var = jnp.ones((1, 4), jnp.float32)
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None, :]) / pw[None, :],
+                (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                jnp.log(tw[:, None] / pw[None, :]),
+                jnp.log(th[:, None] / ph[None, :]),
+            ], axis=-1)
+            return out / var[None] if var.ndim == 2 else out / var
+        # decode_center_size: tb (N, M, 4) deltas; priors along `axis`
+        deltas = tb * (var if var.ndim == tb.ndim else var[None])
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (
+                pw[None, :], ph[None, :], pcx[None, :], pcy[None, :]
+            )
+        else:
+            pw_, ph_, pcx_, pcy_ = (
+                pw[:, None], ph[:, None], pcx[:, None], pcy[:, None]
+            )
+        ocx = deltas[..., 0] * pw_ + pcx_
+        ocy = deltas[..., 1] * ph_ + pcy_
+        ow_ = jnp.exp(deltas[..., 2]) * pw_
+        oh_ = jnp.exp(deltas[..., 3]) * ph_
+        return jnp.stack([
+            ocx - ow_ * 0.5, ocy - oh_ * 0.5,
+            ocx + ow_ * 0.5 - norm, ocy + oh_ * 0.5 - norm,
+        ], axis=-1)
+
+    args = [prior_box, target_box]
+    if isinstance(pvar, Tensor):
+        args.append(pvar)
+    return apply_op("box_coder", f, *args)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head output to boxes+scores (upstream yolo_box)."""
+    x = _as_tensor(x)
+    img_size = _as_tensor(img_size)
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = an.shape[0]
+
+    def f(pred, imsz):
+        b, c, h, w = pred.shape
+        pred = pred.reshape(b, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        sx = jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y \
+            - (scale_x_y - 1.0) / 2.0
+        sy = jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y \
+            - (scale_x_y - 1.0) / 2.0
+        cx = (sx + gx[None, None, None, :]) / w
+        cy = (sy + gy[None, None, :, None]) / h
+        anw = jnp.asarray(an[:, 0])[None, :, None, None] / (
+            w * downsample_ratio
+        )
+        anh = jnp.asarray(an[:, 1])[None, :, None, None] / (
+            h * downsample_ratio
+        )
+        bw = jnp.exp(pred[:, :, 2]) * anw
+        bh = jnp.exp(pred[:, :, 3]) * anh
+        obj = jax.nn.sigmoid(pred[:, :, 4])
+        cls = jax.nn.sigmoid(pred[:, :, 5:])
+        scores = obj[:, :, None] * cls  # (B, na, ncls, H, W)
+        imh = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * imw
+        y1 = (cy - bh / 2) * imh
+        x2 = (cx + bw / 2) * imw
+        y2 = (cy + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+        boxes = boxes.reshape(b, -1, 4)
+        scores = jnp.moveaxis(scores, 2, -1).reshape(b, -1, class_num)
+        # zero out low-confidence rows (static shape; reference drops
+        # them, which is data-dependent — mask instead)
+        mask = (obj.reshape(b, -1) >= conf_thresh)[..., None]
+        return boxes * mask, scores * mask
+
+    return apply_op("yolo_box", f, x, img_size, n_outs=2)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (upstream prior_box) — host-side generation."""
+    input = _as_tensor(input)
+    image = _as_tensor(image)
+    h, w = int(input.shape[2]), int(input.shape[3])
+    imh, imw = int(image.shape[2]), int(image.shape[3])
+    step_h = steps[1] or imh / h
+    step_w = steps[0] or imw / w
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    variances = []
+    for i in range(h):
+        for j in range(w):
+            ccx = (j + offset) * step_w
+            ccy = (i + offset) * step_h
+            for k, ms in enumerate(min_sizes):
+                bw = bh = float(ms)
+                boxes.append([
+                    (ccx - bw / 2) / imw, (ccy - bh / 2) / imh,
+                    (ccx + bw / 2) / imw, (ccy + bh / 2) / imh,
+                ])
+                if max_sizes:
+                    big = np.sqrt(ms * max_sizes[k])
+                    boxes.append([
+                        (ccx - big / 2) / imw, (ccy - big / 2) / imh,
+                        (ccx + big / 2) / imw, (ccy + big / 2) / imh,
+                    ])
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    bw = ms * np.sqrt(ar)
+                    bh = ms / np.sqrt(ar)
+                    boxes.append([
+                        (ccx - bw / 2) / imw, (ccy - bh / 2) / imh,
+                        (ccx + bw / 2) / imw, (ccy + bh / 2) / imh,
+                    ])
+    boxes = np.asarray(boxes, np.float32).reshape(h, w, -1, 4)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    variances = np.broadcast_to(
+        np.asarray(variance, np.float32), boxes.shape
+    ).copy()
+    return Tensor(boxes), Tensor(variances)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (upstream deform_conv2d): sample each
+    kernel tap at its learned offset (bilinear), then a dense matmul —
+    gathers + MXU contraction, fully differentiable."""
+    x = _as_tensor(x)
+    offset = _as_tensor(offset)
+    weight = _as_tensor(weight)
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d: groups/deformable_groups > 1 not supported"
+        )
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    extras = []
+    if mask is not None:
+        extras.append(_as_tensor(mask))
+    if bias is not None:
+        extras.append(_as_tensor(bias))
+
+    def f(xa, off, wt, *rest):
+        idx = 0
+        mk = None
+        bs = None
+        if mask is not None:
+            mk = rest[idx]
+            idx += 1
+        if bias is not None:
+            bs = rest[idx]
+        n, cin, h, w = xa.shape
+        cout, _, kh, kw = wt.shape
+        oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        ow = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        base_y = (jnp.arange(oh) * s[0] - p[0])[:, None, None]
+        base_x = (jnp.arange(ow) * s[1] - p[1])[None, :, None]
+        ky = (jnp.arange(kh) * d[0])
+        kx = (jnp.arange(kw) * d[1])
+        kyy, kxx = jnp.meshgrid(ky, kx, indexing="ij")
+        kyy = kyy.reshape(-1)[None, None, :]  # (1,1,KK)
+        kxx = kxx.reshape(-1)[None, None, :]
+        off = off.reshape(n, kh * kw, 2, oh, ow)
+        oy = jnp.moveaxis(off[:, :, 0], 1, -1)  # (N, oh, ow, KK)
+        ox = jnp.moveaxis(off[:, :, 1], 1, -1)
+        ys = base_y[None] + kyy[None] + oy  # (N, oh, ow, KK)
+        xs = base_x[None] + kxx[None] + ox
+
+        def per_image(fm, yy, xx, mm):
+            vals = _bilinear_gather(
+                fm.astype(jnp.float32), yy, xx
+            )  # (C, oh, ow, KK)
+            if mm is not None:
+                vals = vals * jnp.moveaxis(mm, 0, -1)[None]
+            return vals
+
+        if mk is not None:
+            mm = mk.reshape(n, kh * kw, oh, ow)
+            vals = jax.vmap(per_image)(xa, ys, xs, mm)
+        else:
+            vals = jax.vmap(
+                lambda fm, yy, xx: per_image(fm, yy, xx, None)
+            )(xa, ys, xs)
+        # (N, C, oh, ow, KK) x (cout, C*KK)
+        cols = vals.reshape(n, cin, oh, ow, kh * kw)
+        wmat = wt.reshape(cout, cin * kh * kw).astype(jnp.float32)
+        out = jnp.einsum(
+            "nchwk,ock->nohw",
+            jnp.moveaxis(cols, 1, 1),
+            wmat.reshape(cout, cin, kh * kw),
+        )
+        if bs is not None:
+            out = out + bs[None, :, None, None]
+        return out.astype(xa.dtype)
+
+    return apply_op("deform_conv2d", f, x, offset, weight, *extras)
+
+
+def psroi_pool(x, boxes, boxes_num, output_channels, spatial_scale,
+               pooled_height, pooled_width, name=None):
+    """Position-sensitive RoI average pooling (upstream psroi_pool)."""
+    x = _as_tensor(x)
+    boxes = _as_tensor(boxes)
+    boxes_num = _as_tensor(boxes_num)
+    oh, ow = int(pooled_height), int(pooled_width)
+    oc = int(output_channels)
+
+    def f(feat, bx, bn):
+        n_rois = bx.shape[0]
+        _, c, h, w = feat.shape
+        img_idx = jnp.repeat(
+            jnp.arange(bn.shape[0]), bn.astype(jnp.int32),
+            total_repeat_length=n_rois,
+        )
+        x1 = bx[:, 0] * spatial_scale
+        y1 = bx[:, 1] * spatial_scale
+        x2 = bx[:, 2] * spatial_scale
+        y2 = bx[:, 3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        ys_all = jnp.arange(h, dtype=jnp.float32)
+        xs_all = jnp.arange(w, dtype=jnp.float32)
+
+        def per_roi(i):
+            fm = feat[img_idx[i]].astype(jnp.float32)
+            outs = []
+            for ph in range(oh):
+                row = []
+                for pw in range(ow):
+                    hs = y1[i] + rh[i] * ph / oh
+                    he = y1[i] + rh[i] * (ph + 1) / oh
+                    ws = x1[i] + rw[i] * pw / ow
+                    we = x1[i] + rw[i] * (pw + 1) / ow
+                    m = (
+                        (ys_all[:, None] >= jnp.floor(hs))
+                        & (ys_all[:, None] < jnp.ceil(he))
+                        & (xs_all[None, :] >= jnp.floor(ws))
+                        & (xs_all[None, :] < jnp.ceil(we))
+                    )
+                    cnt = jnp.maximum(m.sum(), 1)
+                    ch0 = (ph * ow + pw) * oc
+                    sub = jax.lax.dynamic_slice_in_dim(fm, ch0, oc, 0)
+                    v = jnp.where(m[None], sub, 0.0).sum(
+                        axis=(1, 2)
+                    ) / cnt
+                    row.append(v)
+                outs.append(jnp.stack(row, axis=-1))
+            return jnp.stack(outs, axis=-2)  # (oc, oh, ow)
+
+        out = jax.vmap(per_roi)(jnp.arange(n_rois))
+        return out.astype(feat.dtype)
+
+    return apply_op("psroi_pool", f, x, boxes, boxes_num)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._args[0],
+                         self._args[1])
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._args[0],
+                        self._args[1])
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_channels, spatial_scale, pooled_height,
+                 pooled_width):
+        super().__init__()
+        self._args = (output_channels, spatial_scale, pooled_height,
+                      pooled_width)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, *self._args)
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._meta = (stride, padding, dilation, deformable_groups,
+                      groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            weight_attr,
+        )
+        self.bias = (
+            self.create_parameter([out_channels], bias_attr,
+                                  is_bias=True)
+            if bias_attr is not False else None
+        )
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._meta
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, s, p, d, dg, g, mask
+        )

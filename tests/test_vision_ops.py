@@ -1,0 +1,152 @@
+"""paddle.vision.ops tests (upstream analogs: test/legacy_test/
+test_roi_align_op.py, test_nms_op.py, test_deformable_conv_op.py,
+test_box_coder_op.py, test_yolo_box_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+
+
+def _t(a, **k):
+    return paddle.to_tensor(np.asarray(a), **k)
+
+
+class TestRoI:
+    def test_roi_align_constant_feature(self):
+        feat = np.full((1, 3, 16, 16), 5.0, "float32")
+        boxes = np.array([[2., 2., 10., 10.], [0., 0., 8., 8.]],
+                         "float32")
+        out = V.roi_align(_t(feat), _t(boxes), _t(np.array([2], "int32")),
+                          4)
+        assert out.shape == [2, 3, 4, 4]
+        np.testing.assert_allclose(out.numpy(), 5.0)
+
+    def test_roi_align_gradient(self):
+        x = _t(np.random.RandomState(0).randn(1, 2, 8, 8)
+               .astype("float32"), stop_gradient=False)
+        out = V.roi_align(
+            x, _t(np.array([[1., 1., 6., 6.]], "float32")),
+            _t(np.array([1], "int32")), 2,
+        )
+        out.sum().backward()
+        assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+    def test_roi_align_batch_partition(self):
+        feat = np.zeros((2, 1, 8, 8), "float32")
+        feat[1] = 7.0
+        boxes = np.array([[0., 0., 7., 7.], [0., 0., 7., 7.]],
+                         "float32")
+        out = V.roi_align(_t(feat), _t(boxes),
+                          _t(np.array([1, 1], "int32")), 2)
+        np.testing.assert_allclose(out.numpy()[0], 0.0)
+        np.testing.assert_allclose(out.numpy()[1], 7.0)
+
+    def test_roi_pool_max(self):
+        feat = np.zeros((1, 1, 8, 8), "float32")
+        feat[0, 0, 3, 3] = 9.0
+        out = V.roi_pool(
+            _t(feat), _t(np.array([[0., 0., 7., 7.]], "float32")),
+            _t(np.array([1], "int32")), 2,
+        )
+        assert float(out.numpy().max()) == 9.0
+
+    def test_psroi_pool_shapes(self):
+        feat = np.random.RandomState(1).randn(1, 2 * 2 * 3, 8, 8) \
+            .astype("float32")
+        out = V.psroi_pool(
+            _t(feat), _t(np.array([[0., 0., 7., 7.]], "float32")),
+            _t(np.array([1], "int32")), 3, 1.0, 2, 2,
+        )
+        assert out.shape == [1, 3, 2, 2]
+
+
+class TestNMSBoxes:
+    def test_nms_suppression(self):
+        b = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     "float32")
+        s = np.array([0.9, 0.8, 0.7], "float32")
+        keep = V.nms(_t(b), 0.5, _t(s))
+        assert keep.numpy().tolist() == [0, 2]
+
+    def test_nms_categories_and_topk(self):
+        b = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], "float32")
+        s = np.array([0.5, 0.9], "float32")
+        cats = np.array([0, 1], "int64")
+        keep = V.nms(_t(b), 0.1, _t(s), _t(cats), categories=[0, 1])
+        assert sorted(keep.numpy().tolist()) == [0, 1]  # per-class
+        keep2 = V.nms(_t(b), 0.1, _t(s), _t(cats), categories=[0, 1],
+                      top_k=1)
+        assert keep2.numpy().tolist() == [1]  # highest score wins
+
+    def test_box_coder_roundtrip(self):
+        priors = np.array([[0., 0., 10., 10.], [5., 5., 15., 15.]],
+                          "float32")
+        targets = np.array([[1., 1., 9., 11.]], "float32")
+        enc = V.box_coder(_t(priors), [1., 1., 1., 1.], _t(targets),
+                          "encode_center_size", False)
+        dec = V.box_coder(_t(priors), [1., 1., 1., 1.], enc,
+                          "decode_center_size", False, axis=0)
+        for j in range(2):
+            np.testing.assert_allclose(
+                dec.numpy()[0, j], targets[0], atol=1e-4
+            )
+
+    def test_yolo_box_shapes_and_range(self):
+        rng = np.random.RandomState(0)
+        na, ncls, h = 3, 5, 4
+        x = rng.randn(2, na * (5 + ncls), h, h).astype("float32")
+        boxes, scores = V.yolo_box(
+            _t(x), _t(np.array([[64, 64], [64, 64]], "int32")),
+            [10, 13, 16, 30, 33, 23], ncls, 0.01, 16,
+        )
+        assert boxes.shape == [2, na * h * h, 4]
+        assert scores.shape == [2, na * h * h, ncls]
+        assert float(boxes.numpy().min()) >= 0.0
+        assert float(boxes.numpy().max()) <= 63.0 + 1e-4
+
+    def test_prior_box(self):
+        pb, pv = V.prior_box(
+            _t(np.zeros((1, 3, 4, 4), "float32")),
+            _t(np.zeros((1, 3, 32, 32), "float32")),
+            min_sizes=[8.0], aspect_ratios=[2.0], flip=True, clip=True,
+        )
+        assert pb.shape == [4, 4, 3, 4]
+        assert float(pb.numpy().min()) >= 0.0
+        assert float(pb.numpy().max()) <= 1.0
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 8, 8).astype("float32")
+        w = rng.randn(4, 2, 3, 3).astype("float32")
+        off = np.zeros((1, 18, 6, 6), "float32")
+        dc = V.deform_conv2d(_t(x), _t(off), _t(w))
+        ref = F.conv2d(_t(x), _t(w))
+        np.testing.assert_allclose(dc.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_mask_scales_output(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 6, 6).astype("float32")
+        w = rng.randn(3, 2, 3, 3).astype("float32")
+        off = np.zeros((1, 18, 4, 4), "float32")
+        half = np.full((1, 9, 4, 4), 0.5, "float32")
+        dc_full = V.deform_conv2d(_t(x), _t(off), _t(w))
+        dc_half = V.deform_conv2d(_t(x), _t(off), _t(w), mask=_t(half))
+        np.testing.assert_allclose(
+            dc_half.numpy(), dc_full.numpy() * 0.5, atol=1e-4
+        )
+
+    def test_layer_and_grad(self):
+        layer = V.DeformConv2D(2, 3, 3, padding=1)
+        x = _t(np.random.RandomState(2).randn(1, 2, 6, 6)
+               .astype("float32"), stop_gradient=False)
+        off = _t(np.random.RandomState(3)
+                 .randn(1, 18, 6, 6).astype("float32") * 0.1,
+                 stop_gradient=False)
+        out = layer(x, off)
+        assert out.shape == [1, 3, 6, 6]
+        out.sum().backward()
+        assert x.grad is not None and off.grad is not None
