@@ -126,3 +126,342 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size)(img)
+
+
+def _as_float_chw(img):
+    arr = np.asarray(img, np.float32)
+    if arr.ndim == 2:
+        arr = arr[None]
+    return arr
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return np.ascontiguousarray(np.asarray(img)[..., ::-1, :])
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant",
+                 keys=None):
+        if isinstance(padding, int):
+            padding = (padding,) * 4  # l, t, r, b
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.mode = {"constant": "constant", "reflect": "reflect",
+                     "edge": "edge",
+                     "symmetric": "symmetric"}[padding_mode]
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        cfg = [(0, 0)] * (arr.ndim - 2) + [(t, b), (l, r)]
+        if self.mode == "constant":
+            return np.pad(arr, cfg, mode="constant",
+                          constant_values=self.fill)
+        return np.pad(arr, cfg, mode=self.mode)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) \
+            else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        import jax
+
+        arr = _as_float_chw(img)
+        c, h, w = arr.shape
+        area = h * w
+        for _ in range(10):
+            target_area = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(
+                np.log(self.ratio[0]), np.log(self.ratio[1])
+            ))
+            cw = int(round(np.sqrt(target_area * ar)))
+            ch = int(round(np.sqrt(target_area / ar)))
+            if cw <= w and ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                crop = arr[:, i:i + ch, j:j + cw]
+                break
+        else:
+            crop = arr
+        return np.asarray(jax.image.resize(
+            crop, (c,) + self.size, method="linear"
+        ))
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.fill = fill
+
+    def _apply_image(self, img):
+        from scipy.ndimage import rotate as nd_rotate
+
+        angle = np.random.uniform(*self.degrees)
+        arr = _as_float_chw(img)
+        out = nd_rotate(
+            arr, angle, axes=(-2, -1), reshape=False, order=1,
+            mode="constant", cval=self.fill,
+        )
+        return out.astype(np.float32)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _as_float_chw(img)
+        if arr.shape[0] == 3:
+            gray = (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2])
+        else:
+            gray = arr[0]
+        return np.repeat(gray[None], self.n, axis=0)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.asarray(img, np.float32) * f
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        arr = np.asarray(img, np.float32)
+        mean = arr.mean()
+        return (arr - mean) * f + mean
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        arr = _as_float_chw(img)
+        gray = (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2])[None] \
+            if arr.shape[0] == 3 else arr
+        return gray + (arr - gray) * f
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        import colorsys  # noqa: F401  (rgb<->hsv done vectorized below)
+
+        shift = np.random.uniform(-self.value, self.value)
+        arr = _as_float_chw(img)
+        if arr.shape[0] != 3:
+            return arr
+        scale = 255.0 if arr.max() > 2.0 else 1.0
+        rgb = np.clip(arr / scale, 0, 1)
+        r, g, b = rgb
+        maxc = rgb.max(0)
+        minc = rgb.min(0)
+        v = maxc
+        d = maxc - minc
+        s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0)
+        dz = np.maximum(d, 1e-12)
+        rc = (maxc - r) / dz
+        gc = (maxc - g) / dz
+        bc = (maxc - b) / dz
+        h = np.where(
+            maxc == r, bc - gc,
+            np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc),
+        )
+        h = (h / 6.0) % 1.0
+        h = np.where(d == 0, 0.0, h)
+        h = (h + shift) % 1.0
+        i = np.floor(h * 6.0)
+        f = h * 6.0 - i
+        p = v * (1.0 - s)
+        q = v * (1.0 - s * f)
+        t = v * (1.0 - s * (1.0 - f))
+        i = i.astype(np.int32) % 6
+        r2 = np.choose(i, [v, q, p, p, t, v])
+        g2 = np.choose(i, [t, v, v, q, p, p])
+        b2 = np.choose(i, [p, p, t, v, v, q])
+        return np.stack([r2, g2, b2]) * scale
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.ts = [
+            BrightnessTransform(brightness),
+            ContrastTransform(contrast),
+            SaturationTransform(saturation),
+            HueTransform(hue),
+        ]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(4)
+        for k in order:
+            img = self.ts[k](img)
+        return img
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.array(img, np.float32)
+        h, w = arr.shape[-2:]
+        area = h * w
+        for _ in range(10):
+            ta = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(ta * ar)))
+            ew = int(round(np.sqrt(ta / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                arr[..., i:i + eh, j:j + ew] = self.value
+                return arr
+        return arr
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None,
+                 keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale_rng = scale
+        self.shear = shear
+        self.fill = fill
+
+    def _apply_image(self, img):
+        from scipy.ndimage import affine_transform
+
+        arr = _as_float_chw(img)
+        c, h, w = arr.shape
+        ang = np.deg2rad(np.random.uniform(*self.degrees))
+        sc = (
+            np.random.uniform(*self.scale_rng) if self.scale_rng
+            else 1.0
+        )
+        shx = (
+            np.deg2rad(np.random.uniform(-self.shear, self.shear))
+            if isinstance(self.shear, (int, float)) else 0.0
+        )
+        tx = ty = 0.0
+        if self.translate:
+            tx = np.random.uniform(
+                -self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(
+                -self.translate[1], self.translate[1]) * h
+        cos, sin = np.cos(ang), np.sin(ang)
+        m = np.asarray([
+            [cos * sc, -sin * sc + np.tan(shx)],
+            [sin * sc, cos * sc],
+        ])
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        offset = np.asarray([cy - ty, cx - tx]) - m @ np.asarray(
+            [cy, cx]
+        )
+        out = np.stack([
+            affine_transform(
+                arr[k], m, offset=offset, order=1, mode="constant",
+                cval=self.fill,
+            )
+            for k in range(c)
+        ])
+        return out.astype(np.float32)
+
+
+# -- functional API ---------------------------------------------------------
+def hflip(img):
+    return np.ascontiguousarray(np.asarray(img)[..., ::-1])
+
+
+def vflip(img):
+    return np.ascontiguousarray(np.asarray(img)[..., ::-1, :])
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[..., top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False,
+           center=None, fill=0):
+    from scipy.ndimage import rotate as nd_rotate
+
+    arr = _as_float_chw(img)
+    return nd_rotate(
+        arr, angle, axes=(-2, -1), reshape=bool(expand), order=1,
+        mode="constant", cval=fill,
+    ).astype(np.float32)
+
+
+def adjust_brightness(img, brightness_factor):
+    return np.asarray(img, np.float32) * brightness_factor
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = np.asarray(img, np.float32)
+    mean = arr.mean()
+    return (arr - mean) * contrast_factor + mean
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = np.asarray(img) if inplace else np.array(img)
+    arr[..., i:i + h, j:j + w] = v
+    return arr

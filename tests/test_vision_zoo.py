@@ -82,3 +82,71 @@ class TestTrainStep:
         np.testing.assert_allclose(
             m(x).numpy(), m2(x).numpy(), atol=1e-6
         )
+
+
+class TestTransforms:
+    def _img(self):
+        return np.random.RandomState(0).rand(3, 32, 32).astype(
+            "float32")
+
+    def test_shapes(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img()
+        assert T.RandomResizedCrop(16)(img).shape == (3, 16, 16)
+        assert T.RandomRotation(30)(img).shape == (3, 32, 32)
+        assert T.Grayscale(3)(img).shape == (3, 32, 32)
+        assert T.Pad((1, 2))(img).shape == (3, 36, 34)
+        assert T.RandomAffine(10)(img).shape == (3, 32, 32)
+
+    def test_hue_matches_colorsys(self):
+        import colorsys
+
+        import paddle_tpu.vision.transforms as T
+
+        img = np.random.RandomState(1).rand(3, 3, 3).astype("float32")
+        shift = 0.17
+        t = T.HueTransform(0.5)
+        orig = np.random.uniform
+        np.random.uniform = lambda a, b: shift
+        try:
+            out = t(img)
+        finally:
+            np.random.uniform = orig
+        ref = np.empty_like(img)
+        for i in range(3):
+            for j in range(3):
+                h, s, v = colorsys.rgb_to_hsv(*img[:, i, j])
+                ref[:, i, j] = colorsys.hsv_to_rgb(
+                    (h + shift) % 1.0, s, v)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_random_erasing_and_jitter(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img()
+        erased = T.RandomErasing(prob=1.0, value=0.0)(img)
+        assert (erased == 0).sum() > (img == 0).sum()
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img)
+        assert out.shape == img.shape
+
+    def test_grayscale_weights(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = np.zeros((3, 2, 2), "float32")
+        img[0] = 1.0  # pure red
+        g = T.Grayscale(1)(img)
+        np.testing.assert_allclose(g, 0.299, atol=1e-6)
+
+    def test_functional_ops(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img()
+        np.testing.assert_array_equal(
+            T.hflip(T.hflip(img)), img)
+        np.testing.assert_array_equal(
+            T.crop(img, 2, 3, 10, 12).shape, (3, 10, 12))
+        np.testing.assert_allclose(
+            T.adjust_brightness(img, 2.0), img * 2.0)
+        e = T.erase(img, 0, 0, 4, 4, 9.0)
+        assert (e[..., :4, :4] == 9.0).all()
