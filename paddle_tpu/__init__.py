@@ -120,6 +120,7 @@ def _lazy_imports():
     from . import audio  # noqa
     from . import quantization  # noqa
     from . import text  # noqa
+    from . import geometric  # noqa
     from . import inference  # noqa
     from . import sparse  # noqa
     from . import nn  # noqa

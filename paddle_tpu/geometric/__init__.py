@@ -1,0 +1,181 @@
+"""Graph-learning ops (upstream: python/paddle/geometric/ —
+message_passing/send_recv.py, segment ops in math.py, sampling).
+
+TPU-first: everything lowers to XLA's native segment reductions
+(`jax.ops.segment_*`) — the exact scatter/gather-fusion pattern GNN
+frameworks want on TPU; num_segments is static (pass out_size, or it is
+read from the concrete tensor at trace time).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv",
+]
+
+
+def _n_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    return int(np.asarray(ids._data).max()) + 1 if ids.size else 0
+
+
+def _segment(name, jfn, data, segment_ids, out_size=None):
+    data = _as_tensor(data)
+    segment_ids = _as_tensor(segment_ids)
+    n = _n_segments(segment_ids, out_size)
+
+    def f(d, s):
+        return jfn(d, s.astype(jnp.int32), num_segments=n)
+
+    return apply_op(name, f, data, segment_ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("segment_sum", jax.ops.segment_sum, data,
+                    segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    data = _as_tensor(data)
+    segment_ids = _as_tensor(segment_ids)
+    n = _n_segments(segment_ids, None)
+
+    def f(d, s):
+        s = s.astype(jnp.int32)
+        tot = jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = jax.ops.segment_sum(
+            jnp.ones(d.shape[:1], jnp.float32), s, num_segments=n
+        )
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return tot / jnp.maximum(cnt.reshape(shape), 1.0)
+
+    return apply_op("segment_mean", f, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    out = _segment("segment_max", jax.ops.segment_max, data,
+                   segment_ids)
+    return _finite(out)
+
+
+def segment_min(data, segment_ids, name=None):
+    out = _segment("segment_min", jax.ops.segment_min, data,
+                   segment_ids)
+    return _finite(out)
+
+
+def _finite(t):
+    # empty segments produce +-inf identity values; reference yields 0
+    return apply_op(
+        "segment_finite",
+        lambda a: jnp.where(jnp.isfinite(a), a, 0.0), t,
+    )
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "add": jax.ops.segment_sum,
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum",
+                out_size=None, name=None):
+    """Gather x[src], reduce onto dst (upstream send_u_recv)."""
+    x = _as_tensor(x)
+    src_index = _as_tensor(src_index)
+    dst_index = _as_tensor(dst_index)
+    n = out_size if out_size is not None else x.shape[0]
+    op = reduce_op.lower()
+
+    def f(xa, si, di):
+        msgs = xa[si.astype(jnp.int32)]
+        if op == "mean":
+            tot = jax.ops.segment_sum(
+                msgs, di.astype(jnp.int32), num_segments=int(n))
+            cnt = jax.ops.segment_sum(
+                jnp.ones(msgs.shape[:1], jnp.float32),
+                di.astype(jnp.int32), num_segments=int(n))
+            shape = (int(n),) + (1,) * (msgs.ndim - 1)
+            return tot / jnp.maximum(cnt.reshape(shape), 1.0)
+        out = _REDUCERS[op](
+            msgs, di.astype(jnp.int32), num_segments=int(n))
+        if op in ("max", "min"):
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return out
+
+    return apply_op("send_u_recv", f, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine x[src] with edge feature y, reduce onto dst
+    (upstream send_ue_recv)."""
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+    src_index = _as_tensor(src_index)
+    dst_index = _as_tensor(dst_index)
+    n = out_size if out_size is not None else x.shape[0]
+    mop = message_op.lower()
+    rop = reduce_op.lower()
+
+    def f(xa, ya, si, di):
+        msgs = xa[si.astype(jnp.int32)]
+        if mop in ("add", "sum"):
+            msgs = msgs + ya
+        elif mop == "mul":
+            msgs = msgs * ya
+        elif mop == "sub":
+            msgs = msgs - ya
+        elif mop == "div":
+            msgs = msgs / ya
+        else:
+            raise ValueError(f"unknown message_op {mop}")
+        if rop == "mean":
+            tot = jax.ops.segment_sum(
+                msgs, di.astype(jnp.int32), num_segments=int(n))
+            cnt = jax.ops.segment_sum(
+                jnp.ones(msgs.shape[:1], jnp.float32),
+                di.astype(jnp.int32), num_segments=int(n))
+            shape = (int(n),) + (1,) * (msgs.ndim - 1)
+            return tot / jnp.maximum(cnt.reshape(shape), 1.0)
+        out = _REDUCERS[rop](
+            msgs, di.astype(jnp.int32), num_segments=int(n))
+        if rop in ("max", "min"):
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return out
+
+    return apply_op("send_ue_recv", f, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst] (upstream send_uv)."""
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+    src_index = _as_tensor(src_index)
+    dst_index = _as_tensor(dst_index)
+    mop = message_op.lower()
+
+    def f(xa, ya, si, di):
+        xs = xa[si.astype(jnp.int32)]
+        yd = ya[di.astype(jnp.int32)]
+        if mop in ("add", "sum"):
+            return xs + yd
+        if mop == "mul":
+            return xs * yd
+        if mop == "sub":
+            return xs - yd
+        if mop == "div":
+            return xs / yd
+        raise ValueError(f"unknown message_op {mop}")
+
+    return apply_op("send_uv", f, x, y, src_index, dst_index)

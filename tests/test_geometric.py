@@ -1,0 +1,102 @@
+"""paddle.geometric tests (upstream analogs: test/legacy_test/
+test_segment_ops.py, test_graph_send_recv_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+G = paddle.geometric
+
+
+def _t(a, **k):
+    return paddle.to_tensor(np.asarray(a), **k)
+
+
+class TestSegmentOps:
+    def test_reductions(self):
+        data = _t(np.array([[1., 2.], [3., 4.], [5., 6.]], "float32"))
+        seg = _t(np.array([0, 0, 1], "int64"))
+        np.testing.assert_array_equal(
+            G.segment_sum(data, seg).numpy(), [[4, 6], [5, 6]])
+        np.testing.assert_array_equal(
+            G.segment_mean(data, seg).numpy(), [[2, 3], [5, 6]])
+        np.testing.assert_array_equal(
+            G.segment_max(data, seg).numpy(), [[3, 4], [5, 6]])
+        np.testing.assert_array_equal(
+            G.segment_min(data, seg).numpy(), [[1, 2], [5, 6]])
+
+    def test_empty_segment_zero(self):
+        data = _t(np.array([[1.0]], "float32"))
+        seg = _t(np.array([2], "int64"))  # segments 0,1 empty
+        out = G.segment_max(data, seg)
+        np.testing.assert_array_equal(out.numpy(), [[0], [0], [1]])
+
+    def test_segment_sum_grad(self):
+        data = _t(np.random.RandomState(0).randn(5, 3)
+                  .astype("float32"), stop_gradient=False)
+        seg = _t(np.array([0, 1, 0, 1, 1], "int64"))
+        G.segment_sum(data, seg).sum().backward()
+        np.testing.assert_allclose(
+            data.grad.numpy(), np.ones((5, 3), "float32"))
+
+
+class TestSendRecv:
+    def test_send_u_recv_reduce_ops(self):
+        x = _t(np.array([[1.], [2.], [3.]], "float32"))
+        src = _t(np.array([0, 1, 2, 0], "int64"))
+        dst = _t(np.array([1, 2, 1, 0], "int64"))
+        np.testing.assert_array_equal(
+            G.send_u_recv(x, src, dst, "sum").numpy(),
+            [[1], [4], [2]])
+        np.testing.assert_array_equal(
+            G.send_u_recv(x, src, dst, "max").numpy(),
+            [[1], [3], [2]])
+        np.testing.assert_array_equal(
+            G.send_u_recv(x, src, dst, "mean").numpy(),
+            [[1], [2], [2]])
+
+    def test_send_ue_recv_message_ops(self):
+        x = _t(np.array([[2.], [4.]], "float32"))
+        e = _t(np.array([[1.], [2.]], "float32"))
+        src = _t(np.array([0, 1], "int64"))
+        dst = _t(np.array([0, 0], "int64"))
+        np.testing.assert_array_equal(
+            G.send_ue_recv(x, e, src, dst, "add", "sum",
+                           out_size=2).numpy(),
+            [[9], [0]])  # (2+1) + (4+2)
+        np.testing.assert_array_equal(
+            G.send_ue_recv(x, e, src, dst, "mul", "sum",
+                           out_size=2).numpy(),
+            [[10], [0]])  # 2*1 + 4*2
+
+    def test_send_uv(self):
+        x = _t(np.array([[1.], [2.]], "float32"))
+        y = _t(np.array([[10.], [20.]], "float32"))
+        src = _t(np.array([0, 1], "int64"))
+        dst = _t(np.array([1, 0], "int64"))
+        np.testing.assert_array_equal(
+            G.send_uv(x, y, src, dst, "add").numpy(), [[21], [12]])
+
+    def test_gnn_layer_trains(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as optim
+
+        paddle.seed(1)
+        rng = np.random.RandomState(0)
+        n, d = 12, 8
+        feats = _t(rng.randn(n, d).astype("float32"))
+        src = _t(rng.randint(0, n, 40).astype("int64"))
+        dst = _t(rng.randint(0, n, 40).astype("int64"))
+        y = _t(rng.randn(n, 4).astype("float32"))
+        lin = nn.Linear(d, 4)
+        opt = optim.Adam(0.01, parameters=lin.parameters())
+        losses = []
+        for _ in range(8):
+            h = G.send_u_recv(feats, src, dst, "mean")
+            loss = F.mse_loss(lin(h), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
