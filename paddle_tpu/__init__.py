@@ -40,6 +40,7 @@ from .framework import (
     set_rng_state,
     in_dynamic_mode,
 )
+from .framework.dtype import finfo, iinfo  # noqa
 from .framework.dtype import (
     bool_ as bool,  # noqa: A001
     uint8,

@@ -121,3 +121,53 @@ def to_np_dtype(dtype):
 
 def is_floating(dtype) -> bool:
     return convert_dtype(dtype).is_floating_point
+
+
+class _FInfo:
+    def __init__(self, np_info):
+        self.min = float(np_info.min)
+        self.max = float(np_info.max)
+        self.eps = float(np_info.eps)
+        self.tiny = float(np_info.tiny)
+        self.smallest_normal = float(np_info.tiny)
+        self.resolution = float(np_info.resolution)
+        self.bits = int(np_info.bits)
+        self.dtype = str(np_info.dtype)
+
+
+class _IInfo:
+    def __init__(self, np_info):
+        self.min = int(np_info.min)
+        self.max = int(np_info.max)
+        self.bits = int(np_info.bits)
+        self.dtype = str(np_info.dtype)
+
+
+def finfo(dtype):
+    """paddle.finfo (upstream: python/paddle/framework/dtype.py)."""
+    import numpy as _np
+
+    d = to_np_dtype(dtype)
+    if str(d) == "bfloat16":
+        import jax.numpy as _jnp
+
+        info = _jnp.finfo(_jnp.bfloat16)
+
+        class _B:  # bfloat16 via jnp.finfo (numpy lacks it)
+            min = float(info.min)
+            max = float(info.max)
+            eps = float(info.eps)
+            tiny = float(info.tiny)
+            smallest_normal = float(info.tiny)
+            resolution = float(info.resolution)
+            bits = int(info.bits)
+            dtype = "bfloat16"
+
+        return _B()
+    return _FInfo(_np.finfo(d))
+
+
+def iinfo(dtype):
+    import numpy as _np
+
+    return _IInfo(_np.iinfo(to_np_dtype(dtype)))

@@ -251,3 +251,14 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self._lower, self._upper, self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (upstream
+    paddle.nn.Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+Silu = SiLU  # reference spells it Silu

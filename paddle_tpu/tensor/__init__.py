@@ -193,7 +193,9 @@ METHODS = {
 
 _METHOD_MODULES = (creation, math, manipulation, linalg, search, logic, stat)
 
-_SKIP = {"slice"}  # collides with builtin-name semantics on a method
+# slice collides with builtin-name semantics on a method; shape/rank
+# are top-level functions that must NOT clobber the Tensor property
+_SKIP = {"slice", "shape", "rank"}
 
 for mod in _METHOD_MODULES:
     for name in dir(mod):

@@ -171,3 +171,27 @@ def one_hot(x, num_classes, name=None):
 def complex(real, imag, name=None):
     real, imag = _as_tensor(real), _as_tensor(imag)
     return apply_op("complex", lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def block_diag(inputs, name=None):
+    """Block-diagonal matrix from a list of 2-D tensors (upstream
+    block_diag)."""
+    from ..framework.core import apply_op as _apply
+
+    ts = [_as_tensor(t) for t in inputs]
+
+    def f(*arrs):
+        arrs = [
+            a if a.ndim == 2 else a.reshape(1, -1) for a in arrs
+        ]
+        rows = sum(a.shape[0] for a in arrs)
+        cols = sum(a.shape[1] for a in arrs)
+        out = jnp.zeros((rows, cols), arrs[0].dtype)
+        r = c = 0
+        for a in arrs:
+            out = out.at[r:r + a.shape[0], c:c + a.shape[1]].set(a)
+            r += a.shape[0]
+            c += a.shape[1]
+        return out
+
+    return _apply("block_diag", f, *ts)

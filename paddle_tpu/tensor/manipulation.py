@@ -689,3 +689,86 @@ def combinations(x, r=2, with_replacement=False, name=None):
     )
     idx = np.asarray(list(gen), np.int32).reshape(-1, int(r))
     return apply_op("combinations", lambda a: a[jnp.asarray(idx)], x)
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather over the whole tensor (upstream take)."""
+    x = _as_tensor(x)
+    index = _as_tensor(index)
+
+    def f(a, i):
+        flat = a.reshape(-1)
+        ii = i.astype(jnp.int32)
+        n = flat.shape[0]
+        if mode == "wrap":
+            ii = ((ii % n) + n) % n
+        elif mode == "clip":
+            ii = jnp.clip(ii, -n, n - 1)
+        ii = jnp.where(ii < 0, ii + n, ii)
+        return flat[ii]
+
+    return apply_op("take", f, x, index)
+
+
+def index_fill(x, index, axis, value, name=None):
+    x = _as_tensor(x)
+    index = _as_tensor(index)
+
+    def f(a, i):
+        ind = [builtins.slice(None)] * a.ndim
+        ind[int(axis)] = i.astype(jnp.int32)
+        return a.at[tuple(ind)].set(jnp.asarray(value, a.dtype))
+
+    return apply_op("index_fill", f, x, index)
+
+
+def index_fill_(x, index, axis, value, name=None):
+    out = index_fill(x, index, axis, value)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._version += 1
+    return x
+
+
+def unflatten(x, axis, shape, name=None):
+    x = _as_tensor(x)
+
+    def f(a):
+        ax = int(axis) % a.ndim
+        new_shape = (
+            a.shape[:ax] + tuple(int(s) for s in shape)
+            + a.shape[ax + 1:]
+        )
+        return a.reshape(new_shape)
+
+    return apply_op("unflatten", f, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _as_tensor(x)
+    shp = [int(s) for s in (shape or x.shape)]
+    offs = [int(o) for o in (offsets or [0] * x.ndim)]
+    # -1 in shape: extend to the end
+    shp = [
+        x.shape[i] - offs[i] if s == -1 else s
+        for i, s in enumerate(shp)
+    ]
+
+    def f(a):
+        idx = tuple(
+            builtins.slice(o, o + s) for o, s in zip(offs, shp)
+        )
+        return a[idx]
+
+    return apply_op("crop", f, x)
+
+
+def shape(input, name=None):
+    """Shape as an int32 tensor (upstream paddle.shape)."""
+    input = _as_tensor(input)
+    return Tensor(jnp.asarray(input.shape, jnp.int32))
+
+
+def rank(input, name=None):
+    input = _as_tensor(input)
+    return Tensor(jnp.asarray(input.ndim, jnp.int32))

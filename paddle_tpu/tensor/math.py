@@ -571,3 +571,52 @@ def renorm(x, p, axis, max_norm, name=None):
         return (af * scale).astype(a.dtype)
 
     return apply_op("renorm", f, x)
+
+
+def _inplace(x, out):
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._version += 1
+    return x
+
+
+def fill_(x, value, name=None):
+    x = _as_tensor(x)
+    return _inplace(
+        x, apply_op("fill", lambda a: jnp.full_like(a, value), x)
+    )
+
+
+def zero_(x, name=None):
+    return fill_(x, 0.0)
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+           name=None):
+    from . import math as _m
+
+    return _inplace(x, _m.scale(x, scale, bias, bias_after_scale))
+
+
+def clip_(x, min=None, max=None, name=None):
+    return _inplace(x, clip(x, min, max))
+
+
+def exp_(x, name=None):
+    return _inplace(x, exp(x))
+
+
+def floor_(x, name=None):
+    return _inplace(x, floor(x))
+
+
+def subtract_(x, y, name=None):
+    return _inplace(x, subtract(x, y))
+
+
+def multiply_(x, y, name=None):
+    return _inplace(x, multiply(x, y))
+
+
+def remainder_(x, y, name=None):
+    return _inplace(x, mod(x, y))
