@@ -1,5 +1,45 @@
 """paddle.utils analog (upstream: python/paddle/utils/)."""
+from . import cpp_extension  # noqa
+from . import dlpack  # noqa
 from . import unique_name  # noqa
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module or raise with guidance (upstream try_import)."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed"
+        )
+
+
+def require_version(min_version, max_version=None):
+    """Check the framework version satisfies a range (upstream
+    require_version). This build reports version 0.0.0.dev (source)."""
+    return True
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (upstream deprecated)."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since}: {reason}"
+                + (f"; use {update_to}" if update_to else ""),
+                DeprecationWarning, stacklevel=2,
+            )
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 try:  # pragma: no cover
     from ..framework.flags import flag as _flag  # noqa

@@ -1,0 +1,126 @@
+"""Custom C++ op loading (upstream: python/paddle/utils/cpp_extension/
+— setup/load compile custom operators against the framework).
+
+TPU-native design: custom host ops are C functions compiled with the
+baked-in g++ and exposed two ways:
+  * raw ctypes (``load(...).lib``) for runtime/process utilities, and
+  * as differentiable-graph ops via ``as_paddle_op`` — the C function
+    runs under ``jax.pure_callback`` so it slots into compiled (jit)
+    programs as a host call, the same boundary the reference's custom
+    CPU ops occupy.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import numpy as np
+
+__all__ = ["load", "get_build_directory", "CppExtension", "CUDAExtension"]
+
+_BUILD_ROOT = os.path.expanduser("~/.cache/paddle_tpu_extensions")
+
+
+def get_build_directory(verbose=False):
+    os.makedirs(_BUILD_ROOT, exist_ok=True)
+    return _BUILD_ROOT
+
+
+class CppExtension:
+    """Parity shim for setup(ext_modules=[CppExtension(...)]) — records
+    sources/flags; `load` is the JIT path."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*a, **k):  # pragma: no cover
+    raise RuntimeError(
+        "CUDAExtension is CUDA-only; this framework targets TPU — "
+        "use CppExtension for host ops (device compute belongs in "
+        "Pallas kernels)"
+    )
+
+
+class _Loaded:
+    def __init__(self, name, lib, functions):
+        self.name = name
+        self.lib = lib
+        for fname, (argtypes, restype) in (functions or {}).items():
+            fn = getattr(lib, fname)
+            fn.argtypes = argtypes
+            fn.restype = restype
+            setattr(self, fname, fn)
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
+         extra_include_paths=None, build_directory=None, verbose=False,
+         functions=None):
+    """Compile ``sources`` into a shared library (cached by content
+    hash) and load it. ``functions`` may map exported symbol names to
+    (argtypes, restype) ctypes signatures to pre-bind them."""
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    for flag in (extra_cxx_cflags or []):
+        h.update(flag.encode())
+    so_path = os.path.join(
+        build_dir, f"{name}_{h.hexdigest()[:16]}.so"
+    )
+    if not os.path.exists(so_path):
+        cmd = (
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+             "-pthread"]
+            + [f"-I{p}" for p in (extra_include_paths or [])]
+            + (extra_cxx_cflags or [])
+            + list(sources)
+            + ["-o", so_path + ".tmp"]
+            + (extra_ldflags or [])
+        )
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+        os.replace(so_path + ".tmp", so_path)
+    lib = ctypes.CDLL(so_path)
+    return _Loaded(name, lib, functions)
+
+
+def as_paddle_op(c_fn, out_like=None, n_args=None):
+    """Lift a C function with the convention
+    ``void f(const float* in, float* out, int64 n)`` (elementwise,
+    same-shape) into a differentiable-by-default-off paddle op that
+    works under jit via ``jax.pure_callback``."""
+    import jax
+
+    from ..framework.core import apply_op, _as_tensor
+
+    def op(x):
+        x = _as_tensor(x)
+
+        def host(a):
+            a = np.ascontiguousarray(a, np.float32)
+            out = np.empty_like(a)
+            c_fn(
+                a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ctypes.c_int64(a.size),
+            )
+            return out
+
+        def f(a):
+            return jax.pure_callback(
+                host,
+                jax.ShapeDtypeStruct(a.shape, np.float32),
+                a.astype(np.float32),
+                vmap_method="sequential",
+            ).astype(a.dtype)
+
+        return apply_op("custom_cpp_op", f, x, differentiable=False)
+
+    return op

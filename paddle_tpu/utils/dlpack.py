@@ -1,0 +1,25 @@
+"""DLPack interop (upstream: python/paddle/utils/dlpack.py).
+
+jax arrays speak DLPack natively, so tensors exchange zero-copy with
+torch/numpy/cupy on the same device."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, _as_tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    x = _as_tensor(x)
+    return x._data.__dlpack__()
+
+
+def from_dlpack(capsule):
+    """Accepts a DLPack capsule OR any object with __dlpack__
+    (torch tensor, numpy array, ...)."""
+    arr = jnp.from_dlpack(capsule) if hasattr(capsule, "__dlpack__") \
+        else jax.dlpack.from_dlpack(capsule)
+    return Tensor(arr)

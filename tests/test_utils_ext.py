@@ -1,0 +1,84 @@
+"""utils.cpp_extension + dlpack tests (upstream analogs:
+test/custom_op/test_custom_relu_op_jit.py, test_dlpack.py)."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension, dlpack
+
+_SRC = """
+#include <cstdint>
+extern "C" void square_plus_one(const float* in, float* out,
+                                int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = in[i] * in[i] + 1.0f;
+}
+extern "C" int64_t add_ints(int64_t a, int64_t b) { return a + b; }
+"""
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    src = tmp_path_factory.mktemp("ext") / "my_op.cc"
+    src.write_text(_SRC)
+    return cpp_extension.load(
+        "test_ext", [str(src)],
+        functions={
+            "square_plus_one": (
+                [ctypes.POINTER(ctypes.c_float),
+                 ctypes.POINTER(ctypes.c_float), ctypes.c_int64],
+                None,
+            ),
+            "add_ints": ([ctypes.c_int64, ctypes.c_int64],
+                         ctypes.c_int64),
+        },
+    )
+
+
+class TestCppExtension:
+    def test_raw_symbol(self, ext):
+        assert ext.add_ints(20, 22) == 42
+
+    def test_as_paddle_op_eager_and_jit(self, ext):
+        op = cpp_extension.as_paddle_op(ext.square_plus_one)
+        x = paddle.to_tensor(np.array([1., 2., 3.], "float32"))
+        np.testing.assert_allclose(op(x).numpy(), [2., 5., 10.])
+
+        @paddle.jit.to_static
+        def step(a):
+            return op(a) * 2.0
+
+        np.testing.assert_allclose(step(x).numpy(), [4., 10., 20.])
+
+    def test_build_cache(self, ext, tmp_path):
+        src = tmp_path / "again.cc"
+        src.write_text(_SRC)
+        e2 = cpp_extension.load("test_ext2", [str(src)])
+        assert os.path.exists(
+            cpp_extension.get_build_directory()
+        )
+        assert e2.lib is not None
+
+    def test_cuda_extension_raises(self):
+        with pytest.raises(RuntimeError):
+            cpp_extension.CUDAExtension(["x.cu"])
+
+
+class TestDlpack:
+    def test_torch_roundtrip(self):
+        torch = pytest.importorskip("torch")
+        t = torch.tensor([1.0, 2.0, 3.0])
+        p = dlpack.from_dlpack(t)
+        np.testing.assert_allclose(p.numpy(), [1.0, 2.0, 3.0])
+        back = torch.from_dlpack(
+            dlpack.to_dlpack(paddle.to_tensor(
+                np.array([5.0, 6.0], "float32")))
+        )
+        np.testing.assert_allclose(back.numpy(), [5.0, 6.0])
+
+    def test_numpy_source(self):
+        arr = np.arange(4, dtype="float32")
+        p = dlpack.from_dlpack(arr)
+        np.testing.assert_allclose(p.numpy(), arr)
