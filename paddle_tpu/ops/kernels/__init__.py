@@ -29,3 +29,7 @@ from . import rms_norm as _rms_norm_mod
 from .rms_norm import rms_norm, layer_norm_fused
 from .flash_attention import flash_attention, flash_attention_with_lse
 from .rope import apply_rotary_emb
+from .paged_attention import (  # noqa
+    paged_attention,
+    paged_attention_reference,
+)

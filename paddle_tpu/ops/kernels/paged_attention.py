@@ -1,0 +1,174 @@
+"""Paged KV-cache decode attention — Pallas TPU kernel.
+
+Upstream analogs: paddle/fluid/operators/fused/fused_multi_transformer
+_op.cu's cache-KV decode path and the block-attention kernels the
+reference's serving stacks use (PagedAttention). Design follows the
+TPU paged-attention recipe ("Ragged Paged Attention" — see PAPERS.md):
+
+* the KV cache lives in HBM as fixed-size pages
+  ``(num_pages, page_size, kv_heads, head_dim)``;
+* a per-sequence ``page_table (B, max_pages)`` maps logical pages to
+  physical ones; ``seq_lens (B,)`` bounds the ragged lengths;
+* the kernel grid is (batch, q_heads, logical_pages); the page table
+  rides scalar prefetch so each step's BlockSpec index_map can DMA the
+  right physical page while the previous one computes;
+* online softmax (m, l, acc) accumulates in VMEM scratch across the
+  page loop — one decode token per sequence (q: (B, H, D)).
+
+GQA maps q-head h to kv-head h // (H // KVH) in the index maps — no KV
+replication in HBM. Off-TPU (tests) the same kernel runs in pallas
+interpret mode against a dense reference.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(scale, page_size, kvh_per_q, max_pages,
+                   page_tbl_ref, lens_ref,
+                   q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    seq_len = lens_ref[b]
+    # tokens covered by this logical page: [p*page_size, ...)
+    valid = p * page_size < seq_len
+
+    @pl.when(valid)
+    def _():
+        q = q_ref[0, 0]                   # (1, D) — the decode token
+        k = k_ref[0, 0]                   # (page_size, D)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                          # (1, page_size)
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(pos < seq_len, s, NEG_INF)
+        m_prev = m_ref[0, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s))
+        corr = jnp.exp(m_prev - m_cur)
+        pvals = jnp.exp(s - m_cur)
+        l_ref[0, 0] = corr * l_ref[0, 0] + jnp.sum(pvals)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            pvals.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[0, 0] = m_cur
+
+    @pl.when(p == max_pages - 1)
+    def _():
+        safe_l = jnp.maximum(l_ref[0, 0], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
+                    sm_scale=None, interpret=None):
+    """q: (B, H, D); k_pages/v_pages: (NP, P, KVH, D);
+    page_table: (B, max_pages) int32 physical-page ids;
+    seq_lens: (B,) int32. Returns (B, H, D).
+    """
+    b, h, d = q.shape
+    npages, page_size, kvh, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    group = h // kvh
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    # (NP, P, KVH, D) -> (KVH, NP, P, D): page-major per kv head
+    kp = jnp.transpose(k_pages, (2, 0, 1, 3))
+    vp = jnp.transpose(v_pages, (2, 0, 1, 3))
+    q4 = q.reshape(b, h, 1, d)
+
+    def q_map(b_, h_, p_, tbl, lens):
+        return (b_, h_, 0, 0)
+
+    def kv_map(b_, h_, p_, tbl, lens):
+        return (h_ // group, tbl[b_, p_], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, d), q_map),
+            pl.BlockSpec((1, 1, page_size, d), kv_map),
+            pl.BlockSpec((1, 1, page_size, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, d), q_map),
+        scratch_shapes=[
+            pltpu.SMEM((1, 1), jnp.float32),
+            pltpu.SMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, float(scale), page_size, group, max_pages
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ) if not interpret else None,
+    )(
+        page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+        q4, kp.reshape(kvh, npages, page_size, d),
+        vp.reshape(kvh, npages, page_size, d),
+    )
+    return out.reshape(b, h, d)
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_table,
+                              seq_lens, sm_scale=None):
+    """Dense float32 reference for tests."""
+    import numpy as np
+
+    b, h, d = q.shape
+    npages, page_size, kvh, _ = k_pages.shape
+    group = h // kvh
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    qn = np.asarray(q, np.float32)
+    kn = np.asarray(k_pages, np.float32)
+    vn = np.asarray(v_pages, np.float32)
+    tbl = np.asarray(page_table)
+    lens = np.asarray(seq_lens)
+    out = np.zeros((b, h, d), np.float32)
+    for i in range(b):
+        L = int(lens[i])
+        n_used = -(-L // page_size) if L else 0
+        ks = np.concatenate(
+            [kn[tbl[i, p]] for p in range(n_used)], axis=0
+        )[:L] if n_used else np.zeros((0, kvh, d), np.float32)
+        vs = np.concatenate(
+            [vn[tbl[i, p]] for p in range(n_used)], axis=0
+        )[:L] if n_used else np.zeros((0, kvh, d), np.float32)
+        for j in range(h):
+            kj = ks[:, j // group]
+            vj = vs[:, j // group]
+            s = kj @ qn[i, j] * scale
+            p = np.exp(s - s.max()) if L else s
+            p = p / p.sum() if L else p
+            out[i, j] = p @ vj if L else 0.0
+    return out
