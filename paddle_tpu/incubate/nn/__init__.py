@@ -377,3 +377,6 @@ def fused_linear(x, weight, bias=None, transpose_weight=False,
 
     args = [x, weight] + ([_as_tensor(bias)] if bias is not None else [])
     return apply_op("fused_linear", f, *args)
+
+
+from .paged_cache import PagedKVCacheManager, paged_attention  # noqa

@@ -1,0 +1,134 @@
+"""Paged KV-cache manager for continuous-batching decode (upstream
+analog: the BlockManager/paged cache machinery behind PaddleNLP's
+serving of fused_multi_transformer; kernel side in
+ops/kernels/paged_attention.py).
+
+The manager is host-side bookkeeping (page free-list + per-sequence
+tables); the cache pages themselves are device arrays updated with
+static-shape `dynamic_update_slice` writes, so every op stays
+jit-compilable.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op, _as_tensor
+from ...ops.kernels.paged_attention import paged_attention as _kernel
+
+__all__ = ["PagedKVCacheManager", "paged_attention"]
+
+
+class PagedKVCacheManager:
+    """Fixed pool of KV pages shared by many sequences.
+
+    * ``alloc(seq_id)`` registers a sequence;
+    * ``append(seq_id)`` returns (physical_page, offset) for the next
+      token, growing the sequence's page list from the free list;
+    * ``page_table(seq_ids, max_pages)`` / ``seq_lens`` build the
+      device-side inputs of the paged attention kernel;
+    * ``free(seq_id)`` returns the sequence's pages to the pool.
+    """
+
+    def __init__(self, num_pages, page_size, kv_heads, head_dim,
+                 dtype=jnp.bfloat16):
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.k_pages = jnp.zeros(
+            (num_pages, page_size, kv_heads, head_dim), dtype
+        )
+        self.v_pages = jnp.zeros_like(self.k_pages)
+        self._free = list(range(num_pages))[::-1]
+        self._tables = {}   # seq_id -> [page ids]
+        self._lens = {}     # seq_id -> token count
+
+    # -- bookkeeping -------------------------------------------------------
+    def alloc(self, seq_id):
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        self._tables[seq_id] = []
+        self._lens[seq_id] = 0
+
+    def free(self, seq_id):
+        self._free.extend(reversed(self._tables.pop(seq_id)))
+        self._lens.pop(seq_id)
+
+    def seq_len(self, seq_id):
+        return self._lens[seq_id]
+
+    def _next_slot(self, seq_id):
+        n = self._lens[seq_id]
+        off = n % self.page_size
+        if off == 0:
+            if not self._free:
+                raise RuntimeError("KV page pool exhausted")
+            self._tables[seq_id].append(self._free.pop())
+        return self._tables[seq_id][-1], off
+
+    # -- device writes -----------------------------------------------------
+    def append(self, seq_id, k_tok, v_tok):
+        """Write one token's K/V ((KVH, D) arrays or Tensors) into the
+        sequence's next slot."""
+        page, off = self._next_slot(seq_id)
+        k_tok = k_tok._data if isinstance(k_tok, Tensor) else k_tok
+        v_tok = v_tok._data if isinstance(v_tok, Tensor) else v_tok
+        self.k_pages = jax.lax.dynamic_update_slice(
+            self.k_pages,
+            k_tok[None, None].astype(self.k_pages.dtype),
+            (page, off, 0, 0),
+        )
+        self.v_pages = jax.lax.dynamic_update_slice(
+            self.v_pages,
+            v_tok[None, None].astype(self.v_pages.dtype),
+            (page, off, 0, 0),
+        )
+        self._lens[seq_id] += 1
+        return page, off
+
+    # -- kernel inputs -----------------------------------------------------
+    def page_table(self, seq_ids, max_pages=None):
+        mp = max_pages or max(
+            (len(self._tables[s]) for s in seq_ids), default=1
+        )
+        tbl = np.zeros((len(seq_ids), mp), np.int32)
+        for i, s in enumerate(seq_ids):
+            pages = self._tables[s]
+            tbl[i, :len(pages)] = pages
+        return jnp.asarray(tbl)
+
+    def seq_lens(self, seq_ids):
+        return jnp.asarray(
+            [self._lens[s] for s in seq_ids], jnp.int32
+        )
+
+    def attend(self, q, seq_ids, sm_scale=None):
+        """q: Tensor (B, H, D) — one decode token per listed sequence."""
+        q = _as_tensor(q)
+        tbl = self.page_table(seq_ids)
+        lens = self.seq_lens(seq_ids)
+        kp, vp = self.k_pages, self.v_pages
+
+        def f(qr):
+            return _kernel(qr, kp, vp, tbl, lens, sm_scale=sm_scale)
+
+        return apply_op("paged_attend", f, q, differentiable=False)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
+                    sm_scale=None, name=None):
+    """Functional surface over the Pallas paged decode kernel."""
+    q = _as_tensor(q)
+    k_pages = _as_tensor(k_pages)
+    v_pages = _as_tensor(v_pages)
+    page_table = _as_tensor(page_table)
+    seq_lens = _as_tensor(seq_lens)
+
+    def f(qr, kp, vp, tbl, ln):
+        return _kernel(qr, kp, vp, tbl, ln, sm_scale=sm_scale)
+
+    return apply_op(
+        "paged_attention", f, q, k_pages, v_pages, page_table,
+        seq_lens, differentiable=False,
+    )
