@@ -41,6 +41,11 @@ from .framework import (
     in_dynamic_mode,
 )
 from .framework.dtype import finfo, iinfo  # noqa
+from .framework.dtype import (  # noqa
+    get_default_dtype,
+    is_compiled_with_rocm,
+    set_default_dtype,
+)
 from .framework.dtype import (
     bool_ as bool,  # noqa: A001
     uint8,
@@ -122,6 +127,8 @@ def _lazy_imports():
     from . import quantization  # noqa
     from . import text  # noqa
     from . import geometric  # noqa
+    from . import version  # noqa
+    from . import regularizer  # noqa
     from . import inference  # noqa
     from . import sparse  # noqa
     from . import nn  # noqa

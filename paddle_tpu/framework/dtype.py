@@ -171,3 +171,20 @@ def iinfo(dtype):
     import numpy as _np
 
     return _IInfo(_np.iinfo(to_np_dtype(dtype)))
+
+
+_DEFAULT_DTYPE = ["float32"]
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype (upstream framework/framework.py)."""
+    name = str(convert_dtype(d))
+    _DEFAULT_DTYPE[0] = name.replace("paddle.", "")
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_compiled_with_rocm():
+    return False

@@ -1,0 +1,27 @@
+"""Version info (upstream: python/paddle/version/__init__.py,
+generated at build time)."""
+full_version = "3.0.0-tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+cuda_version = "False"  # TPU build
+cudnn_version = "False"
+tensorrt_version = "False"
+xpu_version = "False"
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("cuda: False (TPU build — XLA/PJRT backend)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
