@@ -162,7 +162,22 @@ def _run_backward_higher_order(tensors, grad_tensors, retain_graph,
                                capture, accumulate):
     """create_graph=True walk: cotangents are Tensors and every node's
     vjp is re-recorded through ``apply_op``, so the resulting grads are
-    tape-connected (differentiable)."""
+    tape-connected (differentiable).
+
+    Runs under ``enable_grad()``: create_graph must record even inside
+    a ``no_grad`` region (optimizer ``step`` is @no_grad-decorated, and
+    SAM-style optimizers compute grad(create_graph=True) inside it).
+    """
+    from ..framework.core import apply_op, enable_grad
+
+    with enable_grad():
+        return _run_backward_higher_order_impl(
+            tensors, grad_tensors, retain_graph, capture, accumulate
+        )
+
+
+def _run_backward_higher_order_impl(tensors, grad_tensors, retain_graph,
+                                    capture, accumulate):
     from ..framework.core import apply_op
 
     roots = [t for t in tensors if isinstance(t, Tensor)]
@@ -218,17 +233,23 @@ def _run_backward_higher_order(tensors, grad_tensors, retain_graph,
 
         custom = getattr(node, "custom_vjp", None)
         if custom is not None:
-            # custom vjps (PyLayer) close over saved raws; re-recording
-            # them keeps grads differentiable w.r.t. the cotangents
-            # (enough for grad-of-grad through the chain), though not
-            # w.r.t. values captured inside the closure.
-            def fn_custom(*cots, _c=custom):
-                return _c(tuple(cots))
-
-            in_grads = fn_custom(*(c._data for c in cot_tensors))
-            grad_ts = [
-                Tensor(g) if g is not None else None for g in in_grads
-            ]
+            # PyLayer: run the user's backward grad-ENABLED on Tensor
+            # cotangents — its ops over the saved tensors record onto
+            # the tape, so grad-of-grad w.r.t. both the cotangents AND
+            # the original inputs works (torch custom-Function
+            # semantics). Falls back to the raw closure (detached) for
+            # nodes predating custom_vjp_tensor.
+            tensor_vjp = getattr(node, "custom_vjp_tensor", None)
+            if tensor_vjp is not None:
+                grad_ts = list(tensor_vjp(tuple(cot_tensors)))
+            else:
+                in_grads = custom(
+                    tuple(c._data for c in cot_tensors)
+                )
+                grad_ts = [
+                    Tensor(g) if g is not None else None
+                    for g in in_grads
+                ]
         else:
             diff_idx = [
                 i for i, t in enumerate(node.in_tensors) if _inexact(t)
@@ -265,18 +286,34 @@ def _run_backward_higher_order(tensors, grad_tensors, retain_graph,
                 for hook in list(t._grad_hooks):
                     res_h = hook(g)
                     if res_h is not None:
-                        g = res_h if isinstance(res_h, Tensor) \
-                            else Tensor(res_h)
+                        if not isinstance(res_h, Tensor):
+                            import warnings
+
+                            warnings.warn(
+                                "grad hook returned a raw array under "
+                                "create_graph=True: the hook's "
+                                "contribution is detached from the "
+                                "tape (return a Tensor to keep "
+                                "double-backward exact)"
+                            )
+                            res_h = Tensor(res_h)
+                        g = res_h
             if capture is not None and id(t) in capture:
                 cur = capture[id(t)]
                 capture[id(t)] = g if cur is None else cur + g
             if t._grad_node is None:
                 if accumulate:
+                    # leaf .grad gets a DETACHED copy (first-order
+                    # parity): storing the live tape-connected grad
+                    # would retain the whole re-recorded graph in .grad
+                    # and let later in-place .grad updates corrupt
+                    # saved-tensor versions
+                    g_det = Tensor(g._data, stop_gradient=True)
                     if t._grad is None:
-                        t._grad = g
+                        t._grad = g_det
                         t._grad.name = t.name + "@GRAD"
                     else:
-                        t._grad = t._grad + g
+                        t._grad.set_value(t._grad._data + g_det._data)
             else:
                 cur = grads.get(id(t))
                 grads[id(t)] = g if cur is None else cur + g

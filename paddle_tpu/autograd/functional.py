@@ -40,19 +40,20 @@ def jacobian(ys, xs, batch_axis=None):
     if batch_axis is None:
         ny = _flat_size(ys.shape)
         flat_y = reshape(ys, [ny])
+        y_dt = ys._data.dtype
         rows = []
         for i in range(ny):
-            onehot = np.zeros((ny,), "float32")
-            onehot[i] = 1.0
+            onehot = np.zeros((ny,), np.float32)
+            onehot[i] = 1.0  # cast to ys dtype in the asarray below
             gs = _grad(
                 flat_y, xs_list,
-                grad_outputs=Tensor(jnp.asarray(onehot)),
+                grad_outputs=Tensor(jnp.asarray(onehot, y_dt)),
                 create_graph=True, retain_graph=True,
                 allow_unused=True,
             )
             rows.append([
                 reshape(g, [-1]) if g is not None else Tensor(
-                    jnp.zeros((_flat_size(x.shape),), jnp.float32)
+                    jnp.zeros((_flat_size(x.shape),), x._data.dtype)
                 )
                 for g, x in zip(gs, xs_list)
             ])
@@ -78,7 +79,7 @@ def jacobian(ys, xs, batch_axis=None):
                    allow_unused=True)
         rows.append([
             reshape(g, [b, -1]) if g is not None else Tensor(
-                jnp.zeros((b, _flat_size(x.shape[1:])), jnp.float32)
+                jnp.zeros((b, _flat_size(x.shape[1:])), x._data.dtype)
             )
             for g, x in zip(gs, xs_list)
         ])
@@ -101,8 +102,16 @@ def hessian(ys, xs, batch_axis=None):
     if batch_axis is None:
         if ys.size != 1:
             raise ValueError("hessian expects a scalar ys")
-        g = _grad(ys, xs_list, create_graph=True, retain_graph=True)
-        outs = [jacobian(gi, xi) for gi, xi in zip(g, xs_list)]
+        g = _grad(ys, xs_list, create_graph=True, retain_graph=True,
+                  allow_unused=True)
+        outs = []
+        for gi, xi in zip(g, xs_list):
+            if gi is None:  # unused input: zero block, like jacobian
+                n = _flat_size(xi.shape)
+                outs.append(Tensor(jnp.zeros(
+                    tuple(xi.shape) + tuple(xi.shape), xi._data.dtype)))
+            else:
+                outs.append(jacobian(gi, xi))
         return outs[0] if single_x else tuple(outs)
 
     if batch_axis != 0:
@@ -115,9 +124,14 @@ def hessian(ys, xs, batch_axis=None):
             "hessian with batch_axis=0 expects ys of shape (B,) or (B, 1)"
         )
     total = ys.sum()
-    g = _grad(total, xs_list, create_graph=True, retain_graph=True)
-    outs = [
-        jacobian(reshape(gi, [b, -1]), xi, batch_axis=0)
-        for gi, xi in zip(g, xs_list)
-    ]
+    g = _grad(total, xs_list, create_graph=True, retain_graph=True,
+              allow_unused=True)
+    outs = []
+    for gi, xi in zip(g, xs_list):
+        if gi is None:
+            n = _flat_size(xi.shape[1:])
+            outs.append(Tensor(jnp.zeros((b, n, n), xi._data.dtype)))
+        else:
+            outs.append(jacobian(reshape(gi, [b, -1]), xi,
+                                 batch_axis=0))
     return outs[0] if single_x else tuple(outs)

@@ -34,7 +34,7 @@ class PyLayerContext:
 
 
 class _PyLayerNode(GradNode):
-    __slots__ = ("custom_vjp",)
+    __slots__ = ("custom_vjp", "custom_vjp_tensor")
 
     def __init__(self, name, in_tensors, in_raws, outs, custom_vjp):
         super().__init__(name, None, in_tensors, in_raws, outs)
@@ -96,6 +96,30 @@ class PyLayer(metaclass=PyLayerMeta):
                     )
                 return tuple(raw)
 
+            def custom_vjp_tensor(cot_tensors):
+                """create_graph path: run the user's backward with grad
+                RECORDING ON, so ops over saved tensors land on the
+                tape — true double-backward through PyLayer (the torch
+                custom-Function semantics)."""
+                cots = [
+                    c if isinstance(c, Tensor) or c is None else Tensor(c)
+                    for c in cot_tensors
+                ]
+                grads = cls.backward(
+                    ctx, *(cots if len(cots) > 1 else [cots[0]])
+                )
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                out = []
+                gi = iter(grads)
+                for _t in tensor_args:
+                    g = next(gi, None)
+                    out.append(
+                        g if isinstance(g, Tensor) or g is None
+                        else Tensor(jnp.asarray(g))
+                    )
+                return tuple(out)
+
             node = _PyLayerNode(
                 cls.__name__,
                 tuple(tensor_args),
@@ -103,6 +127,7 @@ class PyLayer(metaclass=PyLayerMeta):
                 tuple(out_tensors),
                 custom_vjp,
             )
+            node.custom_vjp_tensor = custom_vjp_tensor
             for o in out_tensors:
                 o._grad_node = node
         return outs

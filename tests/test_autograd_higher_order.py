@@ -119,3 +119,63 @@ class TestIncubateAutograd:
         np.testing.assert_allclose(
             H.numpy(), np.diag([6.0, 12.0])
         )
+
+
+class TestHigherOrderEdgeCases:
+    def test_pylayer_double_backward(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 2.0 * x
+
+        x = _t(3.0)
+        g = paddle.grad(Square.apply(x), x, create_graph=True)[0]
+        np.testing.assert_allclose(g.numpy(), 6.0)
+        np.testing.assert_allclose(
+            paddle.grad(g, x)[0].numpy(), 2.0
+        )
+
+    def test_create_graph_inside_no_grad(self):
+        x = _t(2.0)
+        y = x * x * x
+        with paddle.no_grad():  # optimizer.step is @no_grad
+            g = paddle.grad(y, x, create_graph=True)[0]
+        np.testing.assert_allclose(
+            paddle.grad(g, x)[0].numpy(), 12.0
+        )
+
+    def test_jacobian_fp16_bf16(self):
+        for dt in ("float16", "bfloat16"):
+            x = paddle.to_tensor(
+                np.array([1.0, 2.0], "float32"), stop_gradient=False
+            ).astype(dt)
+            x.stop_gradient = False
+            J = paddle.autograd.jacobian(x * x, x)
+            np.testing.assert_allclose(
+                np.asarray(J.numpy(), np.float32),
+                np.diag([2.0, 4.0]), atol=1e-2,
+            )
+
+    def test_hessian_unused_input_zero_block(self):
+        a = _t([1.0, 2.0])
+        b = _t([3.0])
+        Ha, Hb = paddle.autograd.hessian((a * a).sum(), [a, b])
+        np.testing.assert_allclose(Ha.numpy(), np.diag([2.0, 2.0]))
+        np.testing.assert_allclose(Hb.numpy(), [[0.0]])
+
+    def test_leaf_grad_detached_after_create_graph_backward(self):
+        from paddle_tpu.autograd.backward_engine import run_backward
+
+        w = _t([1.0, 2.0])
+        run_backward([(w * w).sum()], create_graph=True)
+        assert w.grad.stop_gradient
+        assert w.grad._grad_node is None
+        np.testing.assert_allclose(w.grad.numpy(), [2.0, 4.0])
