@@ -1,0 +1,90 @@
+"""paddle.incubate.nn.functional (upstream: python/paddle/incubate/nn/
+functional/) — fused-op functional surface."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op, _as_tensor
+from . import (  # noqa: F401
+    fused_feedforward,
+    fused_linear,
+    fused_multi_head_attention,
+    fused_rotary_position_embedding,
+    paged_attention,
+)
+
+__all__ = [
+    "fused_feedforward", "fused_linear", "fused_multi_head_attention",
+    "fused_rotary_position_embedding", "paged_attention", "swiglu",
+    "fused_rms_norm", "fused_layer_norm", "fused_matmul_bias",
+]
+
+fused_matmul_bias = fused_linear
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU activation (upstream: incubate/nn/functional/swiglu.py):
+    silu(x) * y; with y=None, x is split in half on the last axis.
+    XLA fuses this into the surrounding matmuls."""
+    x = _as_tensor(x)
+    if y is not None:
+        y = _as_tensor(y)
+        return apply_op(
+            "swiglu", lambda a, b: jax.nn.silu(a) * b, x, y
+        )
+
+    def f(a):
+        u, v = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(u) * v
+
+    return apply_op("swiglu", f, x)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, name=None):
+    """RMSNorm over the trailing axis, fused via the Pallas kernel when
+    shapes allow (upstream: fused_rms_norm op)."""
+    from ...ops.kernels.rms_norm import rms_norm as _rms
+
+    x = _as_tensor(x)
+    norm_weight = _as_tensor(norm_weight)
+    args = [x, norm_weight]
+    if norm_bias is not None:
+        args.append(_as_tensor(norm_bias))
+
+    def f(a, w, *b):
+        out = _rms(a, w, eps=epsilon)
+        if b:
+            out = out + b[0]
+        return out
+
+    return apply_op("fused_rms_norm", f, *args)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, name=None):
+    """LayerNorm fused epilogue (upstream: fused_layer_norm op)."""
+    x = _as_tensor(x)
+    args = [x]
+    if norm_weight is not None:
+        args.append(_as_tensor(norm_weight))
+    if norm_bias is not None:
+        args.append(_as_tensor(norm_bias))
+    has_w = norm_weight is not None
+    has_b = norm_bias is not None
+
+    def f(a, *wb):
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(af - mean), axis=-1, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if has_b:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    return apply_op("fused_layer_norm", f, *args)
