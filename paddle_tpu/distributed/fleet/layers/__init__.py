@@ -1,0 +1,2 @@
+"""fleet.layers (upstream: python/paddle/distributed/fleet/layers/)."""
+from . import mpu  # noqa

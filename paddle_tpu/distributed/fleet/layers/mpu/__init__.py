@@ -1,0 +1,9 @@
+"""Model-parallel utility layers (upstream: python/paddle/distributed/
+fleet/layers/mpu/__init__.py)."""
+from .mp_layers import (  # noqa
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from . import mp_ops  # noqa
