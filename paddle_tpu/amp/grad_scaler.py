@@ -1,0 +1,4 @@
+"""Path parity: upstream keeps GradScaler in amp/grad_scaler.py."""
+from . import GradScaler  # noqa: F401
+
+__all__ = ["GradScaler"]

@@ -1,0 +1,4 @@
+"""paddle.distributed.communication path parity (upstream keeps the
+collective implementations here; ours live in distributed.collective)."""
+from ..collective import *  # noqa: F401,F403
+from .. import stream  # noqa: F401

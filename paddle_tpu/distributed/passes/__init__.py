@@ -1,0 +1,34 @@
+"""paddle.distributed.passes parity (upstream: program-rewrite passes
+for the static graph — fuse-allreduce, overlap, pipeline scheduling).
+Under GSPMD/XLA those rewrites are the compiler's: sharding
+propagation, collective fusion/overlap and scheduling happen inside
+XLA (SURVEY §2.6 'Distributed passes: absorbed'). The registry below
+keeps the API importable and documents the absorption."""
+
+_ABSORBED = {
+    "fuse_all_reduce": "XLA collective combiner",
+    "auto_parallel_sharding": "GSPMD propagation",
+    "pipeline_scheduler_FThenB": "compiled tick-scan schedule",
+    "pipeline_scheduler_1F1B": "compiled tick-scan schedule",
+    "overlap_grad_comm": "XLA latency-hiding scheduler",
+}
+
+
+class PassContext:
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, k, v):
+        self._attrs[k] = v
+
+    def get_attr(self, k, default=None):
+        return self._attrs.get(k, default)
+
+
+def new_pass(name, attrs=None):
+    if name in _ABSORBED:
+        raise NotImplementedError(
+            f"pass '{name}' is performed by {_ABSORBED[name]} during "
+            "XLA compilation; no manual pass is needed on TPU"
+        )
+    raise ValueError(f"unknown pass {name!r}")

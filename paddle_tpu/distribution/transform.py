@@ -1,0 +1,222 @@
+"""Distribution transforms (upstream: python/paddle/distribution/
+transform.py): bijections with forward/inverse/log_det_jacobian, and
+TransformedDistribution support."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+from . import Distribution
+
+__all__ = [
+    "Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+    "SigmoidTransform", "TanhTransform", "AbsTransform",
+    "ChainTransform", "SoftmaxTransform", "StackTransform",
+    "TransformedDistribution",
+]
+
+
+def _op(name, fn, *ts):
+    return apply_op(name, fn, *[_as_tensor(t) for t in ts])
+
+
+class Transform:
+    """Bijection base (upstream Transform); subclasses implement the
+    raw-jnp _forward/_inverse/_log_det."""
+
+    def forward(self, x):
+        return _op(type(self).__name__ + "_fwd", self._forward, x)
+
+    def inverse(self, y):
+        return _op(type(self).__name__ + "_inv", self._inverse, y)
+
+    def forward_log_det_jacobian(self, x):
+        return _op(type(self).__name__ + "_fldj", self._log_det, x)
+
+    def inverse_log_det_jacobian(self, y):
+        inv = self.inverse(y)
+        fldj = self.forward_log_det_jacobian(inv)
+        from ..tensor.math import neg
+
+        return neg(fldj)
+
+    # subclass hooks (raw jnp)
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _log_det(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def forward(self, x):
+        return _op("affine_fwd", lambda a, l, s: l + s * a,
+                   x, self.loc, self.scale)
+
+    def inverse(self, y):
+        return _op("affine_inv", lambda a, l, s: (a - l) / s,
+                   y, self.loc, self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return _op(
+            "affine_fldj",
+            lambda a, s: jnp.broadcast_to(
+                jnp.log(jnp.abs(s)), a.shape
+            ),
+            x, self.scale,
+        )
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _log_det(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _as_tensor(power)
+
+    def forward(self, x):
+        return _op("power_fwd", lambda a, p: jnp.power(a, p),
+                   x, self.power)
+
+    def inverse(self, y):
+        return _op("power_inv", lambda a, p: jnp.power(a, 1.0 / p),
+                   y, self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return _op(
+            "power_fldj",
+            lambda a, p: jnp.log(jnp.abs(p * jnp.power(a, p - 1.0))),
+            x, self.power,
+        )
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _log_det(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-7, 1 - 1e-7))
+
+    def _log_det(self, x):
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+    def _log_det(self, x):
+        return jnp.zeros_like(x)
+
+
+class SoftmaxTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        from ..tensor.math import add
+
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else add(total, ld)
+            x = t.forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def forward(self, x):
+        from ..tensor.manipulation import split, stack
+
+        parts = split(x, len(self.transforms), self.axis)
+        outs = [
+            t.forward(p) for t, p in zip(self.transforms, parts)
+        ]
+        from ..tensor.manipulation import concat
+
+        return concat(outs, self.axis)
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a transform (upstream
+    TransformedDistribution)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = (
+            transforms[0] if len(transforms) == 1
+            else ChainTransform(transforms)
+        )
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        from ..tensor.math import subtract
+
+        x = self.transform.inverse(value)
+        base_lp = self.base.log_prob(x)
+        ldj = self.transform.forward_log_det_jacobian(x)
+        return subtract(base_lp, ldj)

@@ -1,0 +1,14 @@
+"""paddle.io.dataloader path parity (upstream package layout; the
+implementations live in paddle_tpu.io)."""
+from .. import (  # noqa: F401
+    BatchSampler,
+    DataLoader,
+    Dataset,
+    DistributedBatchSampler,
+    IterableDataset,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    default_collate_fn,
+    get_worker_info,
+)

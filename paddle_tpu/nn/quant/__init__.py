@@ -1,0 +1,60 @@
+"""paddle.nn.quant (upstream: python/paddle/nn/quant/) — weight-only
+quant helpers over the quantization framework."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_op, _as_tensor
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear"]
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Symmetric per-channel int8 quantization: returns (int8 weight,
+    fp scale per out-channel) (upstream: nn/quant/quantized_linear.py).
+    """
+    x = _as_tensor(x)
+    w = np.asarray(x._data, np.float32)
+    scale = np.abs(w).max(axis=0) / 127.0
+    scale = np.maximum(scale, 1e-9)
+    q = np.clip(np.round(w / scale[None, :]), -127, 127).astype(np.int8)
+    return Tensor(q), Tensor(scale.astype(np.float32))
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8"):
+    x = _as_tensor(x)
+    scale = _as_tensor(scale)
+    return apply_op(
+        "weight_dequantize",
+        lambda q, s: q.astype(jnp.float32) * s[None, :],
+        x, scale,
+    )
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """x @ dequant(weight) + bias — the weight stays int8 in HBM and
+    dequantizes on the fly (XLA fuses the scale into the matmul)."""
+    x = _as_tensor(x)
+    weight = _as_tensor(weight)
+    args = [x, weight]
+    if weight_scale is not None:
+        args.append(_as_tensor(weight_scale))
+    if bias is not None:
+        args.append(_as_tensor(bias))
+    has_scale = weight_scale is not None
+    has_bias = bias is not None
+
+    def f(a, w, *rest):
+        i = 0
+        wf = w.astype(jnp.float32)
+        if has_scale:
+            wf = wf * rest[i][None, :]
+            i += 1
+        out = a.astype(jnp.float32) @ wf
+        if has_bias:
+            out = out + rest[i]
+        return out.astype(a.dtype)
+
+    return apply_op("weight_only_linear", f, *args)

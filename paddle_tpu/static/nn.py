@@ -1,0 +1,51 @@
+"""paddle.static.nn parity surface. The static-graph program builder is
+absorbed by @to_static/XLA (SURVEY §2.4); the common builders here run
+eagerly so simple static-style code still executes."""
+from __future__ import annotations
+
+from ..nn import functional as F
+
+__all__ = ["fc", "batch_norm", "embedding", "conv2d", "sequence_expand"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    import numpy as np
+
+    from ..framework.core import _as_tensor
+    from ..nn import Linear
+
+    x = _as_tensor(x)
+    in_features = int(np.prod(x.shape[num_flatten_dims:]))
+    layer = Linear(in_features, size, weight_attr=weight_attr,
+                   bias_attr=bias_attr)
+    flat = x.reshape(list(x.shape[:num_flatten_dims]) + [-1])
+    out = layer(flat)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def batch_norm(input, *a, **k):
+    raise NotImplementedError(
+        "static.nn.batch_norm: use paddle.nn.BatchNorm under to_static"
+    )
+
+
+def embedding(input, size, **k):
+    raise NotImplementedError(
+        "static.nn.embedding: use paddle.nn.Embedding under to_static"
+    )
+
+
+def conv2d(input, *a, **k):
+    raise NotImplementedError(
+        "static.nn.conv2d: use paddle.nn.Conv2D under to_static"
+    )
+
+
+def sequence_expand(*a, **k):
+    raise NotImplementedError(
+        "sequence ops (LoD) are not part of the TPU framework; use "
+        "dense padded batches"
+    )

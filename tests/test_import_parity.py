@@ -1,0 +1,121 @@
+"""Reference import-path parity: every module path a PaddlePaddle user
+would import must resolve here (upstream package layout)."""
+import importlib
+
+import numpy as np
+import pytest
+
+PATHS = [
+    "paddle_tpu.amp.grad_scaler",
+    "paddle_tpu.audio.features",
+    "paddle_tpu.audio.functional",
+    "paddle_tpu.distributed.auto_parallel",
+    "paddle_tpu.distributed.checkpoint",
+    "paddle_tpu.distributed.communication",
+    "paddle_tpu.distributed.fleet.base.distributed_strategy",
+    "paddle_tpu.distributed.fleet.base.topology",
+    "paddle_tpu.distributed.fleet.elastic",
+    "paddle_tpu.distributed.fleet.layers.mpu",
+    "paddle_tpu.distributed.fleet.meta_optimizers",
+    "paddle_tpu.distributed.fleet.meta_parallel",
+    "paddle_tpu.distributed.fleet.recompute",
+    "paddle_tpu.distributed.fleet.utils.sequence_parallel_utils",
+    "paddle_tpu.distributed.launch",
+    "paddle_tpu.distributed.passes",
+    "paddle_tpu.distributed.rpc",
+    "paddle_tpu.distributed.sharding",
+    "paddle_tpu.distributed.stream",
+    "paddle_tpu.distribution.transform",
+    "paddle_tpu.fft",
+    "paddle_tpu.geometric",
+    "paddle_tpu.incubate.autograd",
+    "paddle_tpu.incubate.distributed.models.moe",
+    "paddle_tpu.incubate.nn.functional",
+    "paddle_tpu.io.dataloader",
+    "paddle_tpu.jit.api",
+    "paddle_tpu.nn.initializer",
+    "paddle_tpu.nn.quant",
+    "paddle_tpu.nn.utils",
+    "paddle_tpu.optimizer.lr",
+    "paddle_tpu.quantization",
+    "paddle_tpu.signal",
+    "paddle_tpu.static.nn",
+    "paddle_tpu.text",
+    "paddle_tpu.utils.cpp_extension",
+    "paddle_tpu.utils.dlpack",
+    "paddle_tpu.version",
+    "paddle_tpu.vision.ops",
+]
+
+
+@pytest.mark.parametrize("path", PATHS)
+def test_imports(path):
+    importlib.import_module(path)
+
+
+def test_key_symbols_at_reference_paths():
+    from paddle_tpu.distributed.fleet.layers.mpu import (  # noqa
+        ColumnParallelLinear,
+        ParallelCrossEntropy,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+    from paddle_tpu.io.dataloader import DataLoader  # noqa
+    from paddle_tpu.amp.grad_scaler import GradScaler  # noqa
+    from paddle_tpu.distributed.communication import all_reduce  # noqa
+
+
+def test_weight_only_linear():
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.quant import (
+        weight_dequantize,
+        weight_only_linear,
+        weight_quantize,
+    )
+
+    w = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 4).astype("float32"))
+    qw, s = weight_quantize(w)
+    assert str(qw.numpy().dtype) == "int8"
+    deq = weight_dequantize(qw, s)
+    np.testing.assert_allclose(deq.numpy(), w.numpy(), atol=0.05)
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(2, 8).astype("float32"))
+    out = weight_only_linear(x, qw, weight_scale=s)
+    np.testing.assert_allclose(
+        out.numpy(), x.numpy() @ w.numpy(), atol=0.1)
+
+
+def test_transformed_distribution_lognormal():
+    scipy_stats = pytest.importorskip("scipy.stats")
+    import paddle_tpu as paddle
+    from paddle_tpu.distribution import Normal
+    from paddle_tpu.distribution.transform import (
+        ExpTransform,
+        TransformedDistribution,
+    )
+
+    ln = TransformedDistribution(Normal(0.0, 1.0), [ExpTransform()])
+    v = paddle.to_tensor(np.array(2.0, "float32"))
+    np.testing.assert_allclose(
+        ln.log_prob(v).numpy(),
+        scipy_stats.lognorm.logpdf(2.0, 1.0), atol=1e-5,
+    )
+    s = ln.sample([2000])
+    assert (s.numpy() > 0).all()
+
+
+def test_transform_inverses():
+    import paddle_tpu as paddle
+    from paddle_tpu.distribution import transform as T
+
+    x = paddle.to_tensor(
+        np.linspace(-2, 2, 11).astype("float32"))
+    for t in (T.ExpTransform(), T.SigmoidTransform(),
+              T.TanhTransform(), T.AffineTransform(1.0, 3.0)):
+        y = t.forward(x)
+        back = t.inverse(y)
+        np.testing.assert_allclose(
+            back.numpy(), x.numpy(), atol=1e-4,
+            err_msg=type(t).__name__,
+        )
