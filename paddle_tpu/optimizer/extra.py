@@ -1,0 +1,320 @@
+"""Additional optimizers (upstream: python/paddle/optimizer/
+{adamax,adadelta,nadam,radam,rprop,asgd}.py). Same accumulator
+machinery as the rest of the family: fp32 master math, per-param
+accumulators captured as compiled-step state."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["Adamax", "Adadelta", "NAdam", "RAdam", "Rprop", "ASGD"]
+
+
+class Adamax(Optimizer):
+    """Adam with infinity-norm second moment (upstream adamax.py)."""
+
+    _accum_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+        for p in self._parameter_list:
+            self._aux_state.setdefault(
+                f"{p.name}_amax_b1p",
+                Tensor(jnp.asarray(beta1, jnp.float32),
+                       persistable=True, name=f"{p.name}_amax_b1p"),
+            )
+
+    def _apply_one(self, param, grad, lr):
+        m = self._param_accum("moment", param)
+        u = self._param_accum("inf_norm", param)
+        b1p = self._aux_state[f"{param.name}_amax_b1p"]
+        master = self._get_master(param)
+        p32 = (master._data if master is not None
+               else param._data).astype(jnp.float32)
+        g32 = grad._data.astype(jnp.float32)
+        coeff = self._decay_coeff()
+        if coeff:
+            g32 = g32 + coeff * p32
+        m_new = self._beta1 * m._data.astype(jnp.float32) \
+            + (1 - self._beta1) * g32
+        u_new = jnp.maximum(
+            self._beta2 * u._data.astype(jnp.float32), jnp.abs(g32)
+        )
+        lr32 = lr.astype(jnp.float32)
+        p_new = p32 - lr32 / (1.0 - b1p._data) * m_new / (
+            u_new + self._epsilon
+        )
+        b1p._data = b1p._data * self._beta1
+        m._data = m_new.astype(m._data.dtype)
+        u._data = u_new.astype(u._data.dtype)
+        if master is not None:
+            master._data = p_new
+        param._data = p_new.astype(param._data.dtype)
+        param._version += 1
+
+
+class Adadelta(Optimizer):
+    _accum_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        self._epsilon = epsilon
+        self._rho = rho
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+
+    def _apply_one(self, param, grad, lr):
+        eg = self._param_accum("avg_squared_grad", param)
+        ex = self._param_accum("avg_squared_update", param)
+        master = self._get_master(param)
+        p32 = (master._data if master is not None
+               else param._data).astype(jnp.float32)
+        g32 = grad._data.astype(jnp.float32)
+        coeff = self._decay_coeff()
+        if coeff:
+            g32 = g32 + coeff * p32
+        rho, eps = self._rho, self._epsilon
+        eg_new = rho * eg._data.astype(jnp.float32) + (1 - rho) * g32 * g32
+        update = -jnp.sqrt(
+            (ex._data.astype(jnp.float32) + eps) / (eg_new + eps)
+        ) * g32
+        ex_new = rho * ex._data.astype(jnp.float32) \
+            + (1 - rho) * update * update
+        p_new = p32 + lr.astype(jnp.float32) * update
+        eg._data = eg_new.astype(eg._data.dtype)
+        ex._data = ex_new.astype(ex._data.dtype)
+        if master is not None:
+            master._data = p_new
+        param._data = p_new.astype(param._data.dtype)
+        param._version += 1
+
+
+class NAdam(Optimizer):
+    """Adam with Nesterov momentum (upstream nadam.py)."""
+
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._psi = momentum_decay
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+        for p in self._parameter_list:
+            for key, init in (
+                ("nadam_step", 0.0), ("nadam_mu_prod", 1.0),
+                ("nadam_b2p", 1.0),
+            ):
+                self._aux_state.setdefault(
+                    f"{p.name}_{key}",
+                    Tensor(jnp.asarray(init, jnp.float32),
+                           persistable=True, name=f"{p.name}_{key}"),
+                )
+
+    def _apply_one(self, param, grad, lr):
+        m = self._param_accum("moment1", param)
+        v = self._param_accum("moment2", param)
+        step_t = self._aux_state[f"{param.name}_nadam_step"]
+        mu_prod = self._aux_state[f"{param.name}_nadam_mu_prod"]
+        b2p = self._aux_state[f"{param.name}_nadam_b2p"]
+        master = self._get_master(param)
+        p32 = (master._data if master is not None
+               else param._data).astype(jnp.float32)
+        g32 = grad._data.astype(jnp.float32)
+        coeff = self._decay_coeff()
+        if coeff:
+            g32 = g32 + coeff * p32
+        t = step_t._data + 1.0
+        b1, b2, psi = self._beta1, self._beta2, self._psi
+        mu_t = b1 * (1.0 - 0.5 * jnp.power(0.96, t * psi))
+        mu_t1 = b1 * (1.0 - 0.5 * jnp.power(0.96, (t + 1.0) * psi))
+        mu_prod_new = mu_prod._data * mu_t
+        m_new = b1 * m._data.astype(jnp.float32) + (1 - b1) * g32
+        v_new = b2 * v._data.astype(jnp.float32) + (1 - b2) * g32 * g32
+        b2p_new = b2p._data * b2
+        m_hat = (
+            mu_t1 * m_new / (1.0 - mu_prod_new * mu_t1)
+            + (1.0 - mu_t) * g32 / (1.0 - mu_prod_new)
+        )
+        v_hat = v_new / (1.0 - b2p_new)
+        p_new = p32 - lr.astype(jnp.float32) * m_hat / (
+            jnp.sqrt(v_hat) + self._epsilon
+        )
+        step_t._data = t
+        mu_prod._data = mu_prod_new
+        b2p._data = b2p_new
+        m._data = m_new.astype(m._data.dtype)
+        v._data = v_new.astype(v._data.dtype)
+        if master is not None:
+            master._data = p_new
+        param._data = p_new.astype(param._data.dtype)
+        param._version += 1
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (upstream radam.py)."""
+
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+        for p in self._parameter_list:
+            self._aux_state.setdefault(
+                f"{p.name}_radam_step",
+                Tensor(jnp.asarray(0.0, jnp.float32),
+                       persistable=True, name=f"{p.name}_radam_step"),
+            )
+
+    def _apply_one(self, param, grad, lr):
+        m = self._param_accum("moment1", param)
+        v = self._param_accum("moment2", param)
+        step_t = self._aux_state[f"{param.name}_radam_step"]
+        master = self._get_master(param)
+        p32 = (master._data if master is not None
+               else param._data).astype(jnp.float32)
+        g32 = grad._data.astype(jnp.float32)
+        coeff = self._decay_coeff()
+        if coeff:
+            g32 = g32 + coeff * p32
+        b1, b2 = self._beta1, self._beta2
+        t = step_t._data + 1.0
+        m_new = b1 * m._data.astype(jnp.float32) + (1 - b1) * g32
+        v_new = b2 * v._data.astype(jnp.float32) + (1 - b2) * g32 * g32
+        b1p = jnp.power(b1, t)
+        b2p = jnp.power(b2, t)
+        rho_inf = 2.0 / (1.0 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2p / (1.0 - b2p)
+        m_hat = m_new / (1.0 - b1p)
+        lr32 = lr.astype(jnp.float32)
+        r_num = (rho_t - 4.0) * (rho_t - 2.0) * rho_inf
+        r_den = (rho_inf - 4.0) * (rho_inf - 2.0) * rho_t
+        rect = jnp.sqrt(jnp.maximum(r_num, 1e-30)
+                        / jnp.maximum(r_den, 1e-30))
+        v_hat = jnp.sqrt(v_new / (1.0 - b2p)) + self._epsilon
+        adaptive = p32 - lr32 * rect * m_hat / v_hat
+        sgd_like = p32 - lr32 * m_hat
+        p_new = jnp.where(rho_t > 5.0, adaptive, sgd_like)
+        step_t._data = t
+        m._data = m_new.astype(m._data.dtype)
+        v._data = v_new.astype(v._data.dtype)
+        if master is not None:
+            master._data = p_new
+        param._data = p_new.astype(param._data.dtype)
+        param._version += 1
+
+
+class Rprop(Optimizer):
+    """Resilient backprop — full-batch sign-based steps (upstream
+    rprop.py)."""
+
+    _accum_names = ("prev_grad", "learning_rate_local")
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=True, name=None):
+        self._lr_range = learning_rate_range
+        self._etas = etas
+        self._init_lr = learning_rate
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name, multi_precision)
+
+    def _param_accum(self, name, param):
+        acc = super()._param_accum(name, param)
+        if name == "learning_rate_local":
+            initd = getattr(self, "_rprop_initd", None)
+            if initd is None:
+                initd = self._rprop_initd = set()
+            if id(acc) not in initd:
+                acc._data = jnp.full_like(
+                    acc._data.astype(jnp.float32), self._init_lr
+                )
+                initd.add(id(acc))
+        return acc
+
+    def _apply_one(self, param, grad, lr):
+        prev = self._param_accum("prev_grad", param)
+        lrl = self._param_accum("learning_rate_local", param)
+        master = self._get_master(param)
+        p32 = (master._data if master is not None
+               else param._data).astype(jnp.float32)
+        g32 = grad._data.astype(jnp.float32)
+        eta_minus, eta_plus = self._etas
+        lo, hi = self._lr_range
+        sign = jnp.sign(g32 * prev._data.astype(jnp.float32))
+        factor = jnp.where(
+            sign > 0, eta_plus, jnp.where(sign < 0, eta_minus, 1.0)
+        )
+        lr_new = jnp.clip(
+            lrl._data.astype(jnp.float32) * factor, lo, hi
+        )
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        p_new = p32 - lr_new * jnp.sign(g_eff)
+        prev._data = g_eff.astype(prev._data.dtype)
+        lrl._data = lr_new.astype(lrl._data.dtype)
+        if master is not None:
+            master._data = p_new
+        param._data = p_new.astype(param._data.dtype)
+        param._version += 1
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (upstream asgd.py): plain SGD steps plus a running
+    average of the iterates exposed as ``averaged_params``."""
+
+    _accum_names = ("averaged_param",)
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        self._t = 0
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+
+    def step(self):
+        self._t += 1
+        super().step()
+
+    def _apply_one(self, param, grad, lr):
+        avg = self._param_accum("averaged_param", param)
+        master = self._get_master(param)
+        p32 = (master._data if master is not None
+               else param._data).astype(jnp.float32)
+        g32 = grad._data.astype(jnp.float32)
+        coeff = self._decay_coeff()
+        if coeff:
+            g32 = g32 + coeff * p32
+        p_new = p32 - lr.astype(jnp.float32) * g32
+        t = float(self._t)
+        avg._data = (
+            avg._data.astype(jnp.float32) * ((t - 1.0) / t)
+            + p_new / t
+        ).astype(avg._data.dtype)
+        if master is not None:
+            master._data = p_new
+        param._data = p_new.astype(param._data.dtype)
+        param._version += 1
+
+    def averaged_params(self):
+        return {
+            p.name: self._param_accum("averaged_param", p)
+            for p in self._parameter_list
+        }

@@ -1,0 +1,98 @@
+"""Adamax/Adadelta/NAdam/RAdam/Rprop/ASGD (upstream analogs:
+test/legacy_test/test_{adamax,adadelta,nadam,radam,rprop,asgd}_op.py).
+Stepwise parity against torch's implementations where the update rule
+is the same."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as optim
+
+torch = pytest.importorskip("torch")
+
+
+def _problem():
+    w0 = np.random.RandomState(0).randn(4, 3).astype("float32")
+    x = np.random.RandomState(1).randn(8, 4).astype("float32")
+    y = np.random.RandomState(2).randn(8, 3).astype("float32")
+    return w0, x, y
+
+
+@pytest.mark.parametrize("ours_cls,torch_cls,kw_ours,kw_torch", [
+    (optim.Adamax, torch.optim.Adamax,
+     dict(learning_rate=0.01), dict(lr=0.01)),
+    (optim.Adadelta, torch.optim.Adadelta,
+     dict(learning_rate=1.0, rho=0.9), dict(lr=1.0, rho=0.9)),
+    (optim.NAdam, torch.optim.NAdam,
+     dict(learning_rate=0.01), dict(lr=0.01)),
+    (optim.RAdam, torch.optim.RAdam,
+     dict(learning_rate=0.01), dict(lr=0.01)),
+    (optim.Rprop, torch.optim.Rprop,
+     dict(learning_rate=0.01), dict(lr=0.01)),
+])
+def test_matches_torch(ours_cls, torch_cls, kw_ours, kw_torch):
+    paddle.seed(0)
+    w0, x, y = _problem()
+    pw = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    opt = ours_cls(parameters=[pw], **kw_ours)
+    tw = torch.tensor(w0.copy(), requires_grad=True)
+    topt = torch_cls([tw], **kw_torch)
+    for _ in range(6):
+        loss = ((paddle.to_tensor(x) @ pw
+                 - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        tl = ((torch.tensor(x) @ tw - torch.tensor(y)) ** 2).mean()
+        topt.zero_grad()
+        tl.backward()
+        topt.step()
+    np.testing.assert_allclose(
+        pw.numpy(), tw.detach().numpy(), atol=1e-4
+    )
+
+
+def test_asgd_average_tracks_iterates():
+    paddle.seed(0)
+    w0, x, y = _problem()
+    pw = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    opt = optim.ASGD(learning_rate=0.05, parameters=[pw])
+    iterates = []
+    for _ in range(5):
+        loss = ((paddle.to_tensor(x) @ pw
+                 - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        iterates.append(pw.numpy().copy())
+    avg = opt.averaged_params()[pw.name].numpy()
+    np.testing.assert_allclose(
+        avg, np.mean(iterates, axis=0), atol=1e-5
+    )
+
+
+def test_all_work_under_to_static():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    _, x, y = _problem()
+    for cls in (optim.Adamax, optim.Adadelta, optim.NAdam,
+                optim.RAdam):
+        paddle.seed(0)
+        lin = nn.Linear(4, 3)
+        opt = cls(learning_rate=0.01, parameters=lin.parameters())
+        opt._create_accumulators()
+
+        @paddle.jit.to_static
+        def step(xx, yy):
+            loss = F.mse_loss(lin(xx), yy)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        l0 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+        for _ in range(4):
+            l1 = float(step(paddle.to_tensor(x),
+                            paddle.to_tensor(y)).numpy())
+        assert l1 < l0, cls.__name__
