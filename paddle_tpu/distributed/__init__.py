@@ -41,6 +41,11 @@ from .parallel import DataParallel, init_parallel_env  # noqa
 from .store import TCPStore  # noqa
 from . import checkpoint  # noqa
 from . import stream  # noqa
+from .object_collectives import (  # noqa
+    all_gather_object,
+    broadcast_object_list,
+    scatter_object_list,
+)
 from . import fleet  # noqa
 from . import sharding  # noqa
 from . import utils  # noqa

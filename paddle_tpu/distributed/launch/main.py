@@ -85,6 +85,16 @@ class NodeController:
         if self.nnodes <= 1 and not args.master:
             self.node_rank = 0
             self.endpoints = ["127.0.0.1"]
+            # single-node jobs still get a control-plane store (object
+            # collectives, barriers) on an ephemeral port; exported to
+            # workers via PADDLE_MASTER below
+            try:
+                self.store = TCPStore(
+                    "127.0.0.1", 0, is_master=True,
+                    world_size=args.nproc_per_node,
+                )
+            except Exception:
+                self.store = None
             return
         host, port = args.master.split(":")
         is_master = False
@@ -154,6 +164,8 @@ class NodeController:
         })
         if args.master:
             env["PADDLE_MASTER"] = args.master
+        elif self.store is not None:
+            env["PADDLE_MASTER"] = f"127.0.0.1:{self.store.port}"
         if world > 1 and self.nnodes > 1:
             # real multi-host: hand jax.distributed its coordination envs
             env.update({

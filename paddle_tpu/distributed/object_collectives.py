@@ -1,0 +1,116 @@
+"""Object collectives (upstream: python/paddle/distributed/
+communication/{all_gather,broadcast,scatter}.py *_object variants).
+
+Objects travel over the TCPStore control plane (pickle -> store keys
+with a per-call sequence number), NOT the tensor data plane: arbitrary
+Python objects can't ride XLA collectives, and the reference similarly
+serializes through tensors on the comm stream. Single-process worlds
+degrade to local semantics.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from .env import get_rank, get_world_size
+
+__all__ = [
+    "all_gather_object", "broadcast_object_list",
+    "scatter_object_list",
+]
+
+_SEQ = [0]
+_STORE = [None]
+
+
+def _proc_info():
+    """(store, rank, world) for the PROCESS-level world (one entry per
+    launch process; the in-process mesh axes share one process)."""
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if world <= 1:
+        return None, 0, 1
+    if _STORE[0] is None:
+        from .store import TCPStore
+
+        master = (
+            os.environ.get("PADDLE_MASTER")
+            or os.environ.get("MASTER_ADDR", "")
+        )
+        host, _, port = master.partition(":")
+        if not port:
+            raise RuntimeError(
+                "object collectives need PADDLE_MASTER=host:port (set "
+                "by paddle.distributed.launch)"
+            )
+        # the launch controller hosts the store daemon; every worker
+        # (rank 0 included) connects as a client
+        _STORE[0] = TCPStore(
+            host, int(port), is_master=False, world_size=world,
+        )
+    return _STORE[0], rank, world
+
+
+def _exchange(obj, tag):
+    """Everyone publishes, everyone reads all — returns list by rank."""
+    store, rank, world = _proc_info()
+    if world == 1:
+        return [obj]
+    seq = _SEQ[0]
+    _SEQ[0] += 1
+    key = f"__obj_{tag}_{seq}"
+    store.set(f"{key}_r{rank}", pickle.dumps(obj))
+    out = []
+    for r in range(world):
+        out.append(pickle.loads(store.get(f"{key}_r{r}")))
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Gather every rank's object into object_list (upstream
+    all_gather_object)."""
+    object_list.extend(_exchange(obj, "ag"))
+    return object_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Replace object_list contents with src's (upstream
+    broadcast_object_list)."""
+    store, rank, world = _proc_info()
+    if world == 1:
+        return object_list
+    seq = _SEQ[0]
+    _SEQ[0] += 1
+    key = f"__obj_bc_{seq}"
+    if rank == src:
+        store.set(key, pickle.dumps(list(object_list)))
+        got = list(object_list)
+    else:
+        got = pickle.loads(store.get(key))
+    object_list[:] = got
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Each rank receives its slot of src's list (upstream
+    scatter_object_list)."""
+    store, rank, world = _proc_info()
+    if world == 1:
+        out_object_list[:] = [
+            (in_object_list or [None])[0]
+        ]
+        return out_object_list
+    seq = _SEQ[0]
+    _SEQ[0] += 1
+    key = f"__obj_sc_{seq}"
+    if rank == src:
+        if in_object_list is None or len(in_object_list) != world:
+            raise ValueError(
+                "scatter_object_list: in_object_list must have one "
+                "entry per rank on src"
+            )
+        for r in range(world):
+            store.set(f"{key}_r{r}", pickle.dumps(in_object_list[r]))
+    out_object_list[:] = [pickle.loads(store.get(f"{key}_r{rank}"))]
+    return out_object_list

@@ -201,3 +201,34 @@ class TestSpawn:
         )
         assert r.returncode == 0, r.stderr
         assert (tmp_path / "r0").exists() and (tmp_path / "r1").exists()
+
+
+def test_object_collectives_across_processes(tmp_path):
+    """all_gather/broadcast/scatter of Python objects over the store
+    (upstream: communication/*_object APIs)."""
+    r = _run_launch(
+        tmp_path,
+        """
+        import os
+        import paddle_tpu.distributed as dist
+
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        gathered = []
+        dist.all_gather_object(gathered, {"rank": rank, "tag": rank * 10})
+        assert [g["tag"] for g in gathered] == [0, 10], gathered
+
+        objs = [f"hello-{rank}"] if rank == 0 else [None]
+        dist.broadcast_object_list(objs, src=0)
+        assert objs == ["hello-0"], objs
+
+        out = [None]
+        dist.scatter_object_list(
+            out, [["for-r0"], ["for-r1"]][0:2] if rank == 0 else None,
+            src=0,
+        )
+        assert out[0] == [f"for-r{rank}"], out
+        print(f"OBJ_OK rank={rank}")
+        """,
+        extra_args=("--nproc_per_node", "2"),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
