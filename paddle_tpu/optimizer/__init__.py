@@ -2,5 +2,5 @@
 from . import lr  # noqa
 from .adamw import Adam, AdamW  # noqa
 from .momentum import Adagrad, Lamb, Momentum, RMSProp, SGD  # noqa
-from .extra import ASGD, Adadelta, Adamax, NAdam, RAdam, Rprop  # noqa
+from .extra import ASGD, Adadelta, Adamax, LBFGS, NAdam, RAdam, Rprop  # noqa
 from .optimizer import Optimizer  # noqa

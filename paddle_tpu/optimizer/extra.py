@@ -5,11 +5,12 @@ accumulators captured as compiled-step state."""
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.core import Tensor
 from .optimizer import Optimizer
 
-__all__ = ["Adamax", "Adadelta", "NAdam", "RAdam", "Rprop", "ASGD"]
+__all__ = ["Adamax", "Adadelta", "NAdam", "RAdam", "Rprop", "ASGD", "LBFGS"]
 
 
 class Adamax(Optimizer):
@@ -318,3 +319,186 @@ class ASGD(Optimizer):
             p.name: self._param_accum("averaged_param", p)
             for p in self._parameter_list
         }
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with the two-loop recursion (upstream:
+    python/paddle/optimizer/lbfgs.py). ``step(closure)`` re-evaluates
+    the loss/gradients as the line search probes new points — the same
+    closure contract as the reference."""
+
+    _accum_names = ()
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+        self._lr0 = learning_rate
+        self._max_iter = max_iter
+        self._max_eval = max_eval or max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._hist = history_size
+        self._line_search = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+
+    # -- flat views --------------------------------------------------------
+    def _gather_flat_grad(self):
+        parts = []
+        for p in self._parameter_list:
+            g = p._grad._data if p._grad is not None else \
+                jnp.zeros_like(p._data)
+            parts.append(g.astype(jnp.float32).reshape(-1))
+        return jnp.concatenate(parts)
+
+    def _gather_flat_params(self):
+        return jnp.concatenate([
+            p._data.astype(jnp.float32).reshape(-1)
+            for p in self._parameter_list
+        ])
+
+    def _set_flat_params(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p._data.shape)) if p._data.shape else 1
+            p._data = flat[off:off + n].reshape(p._data.shape).astype(
+                p._data.dtype
+            )
+            p._version += 1
+            off += n
+
+    def _directional_evaluate(self, closure, x, t, d):
+        self._set_flat_params(x + t * d)
+        loss = closure()
+        lval = float(np.asarray(
+            loss._data if hasattr(loss, "_data") else loss
+        ))
+        g = self._gather_flat_grad()
+        return lval, g
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        loss = closure()
+        lval = float(np.asarray(
+            loss._data if hasattr(loss, "_data") else loss
+        ))
+        flat_grad = self._gather_flat_grad()
+        if float(jnp.max(jnp.abs(flat_grad))) <= self._tol_grad:
+            return loss
+        n_evals = 1
+        for _ in range(self._max_iter):
+            # two-loop recursion
+            q = flat_grad
+            alphas = []
+            for s, y in zip(reversed(self._s), reversed(self._y)):
+                rho = 1.0 / float(jnp.dot(y, s))
+                a = rho * float(jnp.dot(s, q))
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if self._y:
+                y_last, s_last = self._y[-1], self._s[-1]
+                gamma = float(jnp.dot(s_last, y_last)) / float(
+                    jnp.dot(y_last, y_last)
+                )
+                q = q * gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * float(jnp.dot(y, q))
+                q = q + s * (a - b)
+            d = -q
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -1e-32:
+                break
+            x0 = self._gather_flat_params()
+            t = self._lr0 if self._s else min(
+                1.0, 1.0 / float(jnp.sum(jnp.abs(flat_grad)))
+            ) * self._lr0
+            if self._line_search == "strong_wolfe":
+                def evaluate(tt, _x0=x0, _d=d):
+                    return self._directional_evaluate(
+                        closure, _x0, tt, _d
+                    )
+
+                evaluate.gtd = lambda g, _d=d: float(jnp.dot(g, _d))
+                t, lval, flat_grad_new, evals = _strong_wolfe(
+                    evaluate, lval, gtd, t,
+                )
+                n_evals += evals
+                self._set_flat_params(x0 + t * d)
+            else:
+                self._set_flat_params(x0 + t * d)
+                loss_new = closure()
+                lval_new = float(np.asarray(loss_new._data))
+                flat_grad_new = self._gather_flat_grad()
+                n_evals += 1
+                lval = lval_new
+            s_vec = t * d
+            y_vec = flat_grad_new - flat_grad
+            if float(jnp.dot(s_vec, y_vec)) > 1e-10:
+                self._s.append(s_vec)
+                self._y.append(y_vec)
+                if len(self._s) > self._hist:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            delta = float(jnp.max(jnp.abs(s_vec)))
+            flat_grad = flat_grad_new
+            if (float(jnp.max(jnp.abs(flat_grad))) <= self._tol_grad
+                    or delta <= self._tol_change
+                    or n_evals >= self._max_eval):
+                break
+        return loss
+
+
+def _strong_wolfe(evaluate, f0, gtd0, t, d=None, c1=1e-4, c2=0.9,
+                  max_evals=25):
+    """Strong-Wolfe line search: bracket + bisection zoom (upstream
+    lbfgs.py _strong_wolfe). ``evaluate(t)`` returns (f, flat_grad);
+    the directional derivative uses the caller-closed direction via
+    ``evaluate.gtd(g)``."""
+    gtd = evaluate.gtd
+    t_prev, f_prev, g_prev, gtd_prev = 0.0, f0, None, gtd0
+    evals = 0
+    bracket = None
+    for _ in range(max_evals):
+        f_t, g_t = evaluate(t)
+        evals += 1
+        gtd_t = gtd(g_t)
+        if f_t > f0 + c1 * t * gtd0 or (evals > 1 and f_t >= f_prev):
+            bracket = (t_prev, f_prev, g_prev, gtd_prev,
+                       t, f_t, g_t, gtd_t)
+            break
+        if abs(gtd_t) <= -c2 * gtd0:
+            return t, f_t, g_t, evals
+        if gtd_t >= 0:
+            bracket = (t, f_t, g_t, gtd_t,
+                       t_prev, f_prev, g_prev, gtd_prev)
+            break
+        t_prev, f_prev, g_prev, gtd_prev = t, f_t, g_t, gtd_t
+        t = t * 2.0
+    if bracket is None:
+        return t, f_t, g_t, evals
+    lo_t, lo_f, lo_g, lo_gtd, hi_t, hi_f, hi_g, hi_gtd = bracket
+    if lo_g is None:
+        lo_f, lo_g = evaluate(lo_t)
+        evals += 1
+        lo_gtd = gtd(lo_g)
+    for _ in range(max_evals - evals):
+        t = 0.5 * (lo_t + hi_t)
+        f_t, g_t = evaluate(t)
+        evals += 1
+        gtd_t = gtd(g_t)
+        if f_t > f0 + c1 * t * gtd0 or f_t >= lo_f:
+            hi_t, hi_f, hi_g, hi_gtd = t, f_t, g_t, gtd_t
+        else:
+            if abs(gtd_t) <= -c2 * gtd0:
+                return t, f_t, g_t, evals
+            if gtd_t * (hi_t - lo_t) >= 0:
+                hi_t, hi_f, hi_g, hi_gtd = lo_t, lo_f, lo_g, lo_gtd
+            lo_t, lo_f, lo_g, lo_gtd = t, f_t, g_t, gtd_t
+        if abs(hi_t - lo_t) < 1e-9:
+            break
+    return lo_t, lo_f, lo_g, evals

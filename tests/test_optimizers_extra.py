@@ -96,3 +96,39 @@ def test_all_work_under_to_static():
             l1 = float(step(paddle.to_tensor(x),
                             paddle.to_tensor(y)).numpy())
         assert l1 < l0, cls.__name__
+
+
+class TestLBFGS:
+    def _quadratic(self):
+        rng = np.random.RandomState(1)
+        A = rng.randn(6, 6).astype("float32")
+        A = A @ A.T + 6 * np.eye(6, dtype="float32")
+        b = np.random.RandomState(2).randn(6).astype("float32")
+        return A, b
+
+    @pytest.mark.parametrize("ls", [None, "strong_wolfe"])
+    def test_converges_to_optimum(self, ls):
+        A, b = self._quadratic()
+        w0 = np.random.RandomState(0).randn(6).astype("float32")
+        pw = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        opt = optim.LBFGS(parameters=[pw], line_search_fn=ls,
+                          learning_rate=1.0 if ls else 0.1)
+
+        def closure():
+            opt.clear_grad()
+            loss = (0.5 * (pw * (paddle.to_tensor(A) @ pw)).sum()
+                    - (paddle.to_tensor(b) * pw).sum())
+            loss.backward()
+            return loss
+
+        for _ in range(10):
+            opt.step(closure)
+        x_star = np.linalg.solve(A, b)
+        np.testing.assert_allclose(pw.numpy(), x_star, atol=1e-3)
+
+    def test_requires_closure(self):
+        pw = paddle.to_tensor(np.zeros(2, "float32"),
+                              stop_gradient=False)
+        opt = optim.LBFGS(parameters=[pw])
+        with pytest.raises(ValueError):
+            opt.step()
