@@ -1,0 +1,72 @@
+"""A small spectral-norm GAN (generator + discriminator adversarial
+loop) on synthetic 16x16 images.
+
+Run: python examples/dcgan_mnist.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn import utils as U
+
+
+def build_generator(z_dim=32):
+    return nn.Sequential(
+        nn.Linear(z_dim, 128), nn.ReLU(),
+        nn.Linear(128, 256), nn.ReLU(),
+        nn.Linear(256, 16 * 16), nn.Tanh(),
+    )
+
+
+def build_discriminator():
+    d = nn.Sequential(
+        nn.Linear(16 * 16, 128), nn.LeakyReLU(0.2),
+        nn.Linear(128, 64), nn.LeakyReLU(0.2),
+        nn.Linear(64, 1),
+    )
+    U.spectral_norm(d[0])  # Lipschitz control on the first layer
+    return d
+
+
+def main(steps=20, batch=32, z_dim=32):
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    gen, disc = build_generator(z_dim), build_discriminator()
+    g_opt = paddle.optimizer.Adam(2e-4, parameters=gen.parameters())
+    d_opt = paddle.optimizer.Adam(2e-4, parameters=disc.parameters())
+    real_data = rng.randn(512, 16 * 16).astype("float32") * 0.5
+
+    d_losses, g_losses = [], []
+    for step in range(steps):
+        real = paddle.to_tensor(
+            real_data[rng.randint(0, 512, batch)])
+        z = paddle.to_tensor(rng.randn(batch, z_dim).astype("float32"))
+        fake = gen(z)
+        # discriminator step
+        d_real = disc(real)
+        d_fake = disc(fake.detach())
+        ones = paddle.to_tensor(np.ones((batch, 1), "float32"))
+        zeros = paddle.to_tensor(np.zeros((batch, 1), "float32"))
+        d_loss = (
+            F.binary_cross_entropy_with_logits(d_real, ones)
+            + F.binary_cross_entropy_with_logits(d_fake, zeros)
+        )
+        d_loss.backward()
+        d_opt.step()
+        d_opt.clear_grad()
+        # generator step
+        g_loss = F.binary_cross_entropy_with_logits(disc(fake), ones)
+        g_loss.backward()
+        g_opt.step()
+        g_opt.clear_grad()
+        d_losses.append(float(d_loss.numpy()))
+        g_losses.append(float(g_loss.numpy()))
+        if step % 5 == 0:
+            print(f"step {step}: d={d_losses[-1]:.3f} "
+                  f"g={g_losses[-1]:.3f}")
+    return d_losses, g_losses
+
+
+if __name__ == "__main__":
+    main()

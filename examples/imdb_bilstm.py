@@ -1,0 +1,63 @@
+"""Text classification: bidirectional LSTM over IMDB.
+
+Run: python examples/imdb_bilstm.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class SentimentNet(nn.Layer):
+    def __init__(self, vocab, emb=64, hidden=64):
+        super().__init__()
+        self.embedding = nn.Embedding(vocab, emb)
+        self.lstm = nn.LSTM(emb, hidden, direction="bidirectional")
+        self.head = nn.Linear(2 * hidden, 2)
+
+    def forward(self, ids, lengths):
+        x = self.embedding(ids)
+        _, (h, _) = self.lstm(x, sequence_length=lengths)
+        # concat the two directions' final states
+        feat = paddle.concat([h[0], h[1]], axis=-1)
+        return self.head(feat)
+
+
+def _pad_batch(docs, labels, max_len=64):
+    ids = np.zeros((len(docs), max_len), "int64")
+    lens = np.zeros((len(docs),), "int32")
+    for i, d in enumerate(docs):
+        n = min(len(d), max_len)
+        ids[i, :n] = d[:n]
+        lens[i] = max(n, 1)
+    return (paddle.to_tensor(ids), paddle.to_tensor(lens),
+            paddle.to_tensor(np.asarray(labels, "int64")))
+
+
+def main(steps=30, batch_size=32):
+    ds = paddle.text.Imdb(mode="train")
+    vocab = len(ds.word_idx)
+    paddle.seed(0)
+    net = SentimentNet(vocab)
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    order = np.random.RandomState(0).permutation(len(ds))
+    losses = []
+    for step in range(steps):
+        idx = order[(step * batch_size) % len(ds):][:batch_size]
+        docs = [ds[i][0] for i in idx]
+        labels = [int(ds[i][1]) for i in idx]
+        ids, lens, y = _pad_batch(docs, labels)
+        logits = net(ids, lens)
+        loss = F.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+        if step % 10 == 0:
+            print(f"step {step}: loss {losses[-1]:.4f}")
+    return losses
+
+
+if __name__ == "__main__":
+    main()

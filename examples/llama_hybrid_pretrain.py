@@ -1,0 +1,51 @@
+"""Tiny Llama pretraining under hybrid parallelism (dp x mp x
+sharding) — runs on the 8-device virtual CPU mesh or real chips alike.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     JAX_PLATFORMS=cpu python examples/llama_hybrid_pretrain.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as optim
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+
+def main(steps=5, batch=4, seq=64):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 2, "mp_degree": 2, "sharding_degree": 2,
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    cfg = llama_tiny()
+    cfg.max_position_embeddings = seq
+    model = LlamaForCausalLM(cfg)
+    opt = fleet.distributed_optimizer(
+        optim.AdamW(3e-4, parameters=model.parameters()))
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        _, loss = model(x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    losses = []
+    for step in range(steps):
+        x = paddle.to_tensor(rng.randint(
+            0, cfg.vocab_size, (batch, seq)).astype("int32"))
+        y = paddle.to_tensor(rng.randint(
+            0, cfg.vocab_size, (batch, seq)).astype("int64"))
+        loss = train_step(x, y)
+        losses.append(float(np.asarray(loss._data)))
+        print(f"step {step}: loss {losses[-1]:.4f}")
+    return losses
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""LeNet on MNIST with the high-level hapi.Model loop.
+
+Run: python examples/mnist_lenet.py [--epochs N]
+(MNIST reads ~/.cache/paddle/dataset/mnist if present; otherwise a
+synthetic same-shape dataset keeps the example runnable offline.)
+"""
+import argparse
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def main(epochs=1, batch_size=64, limit_batches=None):
+    train = MNIST(mode="train")
+    loader = DataLoader(train, batch_size=batch_size, shuffle=True)
+    if limit_batches:
+        import itertools
+
+        loader = list(itertools.islice(iter(loader), limit_batches))
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare(
+        paddle.optimizer.Adam(1e-3, parameters=net.parameters()),
+        paddle.nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy(),
+    )
+    model.fit(loader, epochs=epochs, verbose=1)
+    return model
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    args = ap.parse_args()
+    main(epochs=args.epochs)

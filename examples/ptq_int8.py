@@ -1,0 +1,49 @@
+"""Post-training quantization: calibrate a trained classifier and
+convert to fixed-scale int8 simulation.
+
+Run: python examples/ptq_int8.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.quantization import PTQ, QuantConfig
+
+
+def main(train_steps=20, calib_batches=4):
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 16).astype("float32")
+    y = (x[:, :4].sum(1) > 0).astype("int64")
+
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                        nn.Linear(32, 2))
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    for step in range(train_steps):
+        xb = paddle.to_tensor(x[step::train_steps][:64])
+        yb = paddle.to_tensor(y[step::train_steps][:64])
+        loss = F.cross_entropy(net(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    ptq = PTQ(QuantConfig())
+    qnet = ptq.quantize(net)
+    for i in range(calib_batches):  # calibration passes
+        qnet(paddle.to_tensor(x[i * 64:(i + 1) * 64]))
+    qnet = ptq.convert(qnet)
+
+    fp_acc = _acc(net, x, y)
+    q_acc = _acc(qnet, x, y)
+    print(f"fp32 acc={fp_acc:.3f}  int8-sim acc={q_acc:.3f}")
+    return fp_acc, q_acc
+
+
+def _acc(m, x, y):
+    pred = np.argmax(m(paddle.to_tensor(x)).numpy(), -1)
+    return float((pred == y).mean())
+
+
+if __name__ == "__main__":
+    main()

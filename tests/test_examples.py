@@ -1,0 +1,46 @@
+"""The examples/ scripts must run end-to-end (shortened) — they are
+the migration-facing entry points."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "examples"))
+
+
+def test_mnist_lenet():
+    import mnist_lenet
+
+    model = mnist_lenet.main(epochs=1, batch_size=32, limit_batches=4)
+    assert model is not None
+
+
+def test_imdb_bilstm():
+    import imdb_bilstm
+
+    losses = imdb_bilstm.main(steps=8, batch_size=16)
+    assert losses[-1] < losses[0] * 1.5  # moving, not diverging
+
+
+def test_dcgan():
+    import dcgan_mnist
+
+    d_losses, g_losses = dcgan_mnist.main(steps=6, batch=16)
+    assert all(np.isfinite(d_losses)) and all(np.isfinite(g_losses))
+
+
+def test_llama_hybrid():
+    import llama_hybrid_pretrain
+
+    losses = llama_hybrid_pretrain.main(steps=3, batch=2, seq=32)
+    assert all(np.isfinite(losses))
+
+
+def test_ptq():
+    import ptq_int8
+
+    fp_acc, q_acc = ptq_int8.main(train_steps=10, calib_batches=2)
+    assert q_acc > 0.6  # quantization keeps most accuracy
+    assert abs(fp_acc - q_acc) < 0.3
