@@ -167,6 +167,39 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
               "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
 
+    if align_corners and mode in ("bilinear", "linear", "trilinear"):
+        # jax.image.resize is half-pixel (align_corners=False); exact
+        # align_corners maps output index i to input coordinate
+        # i*(in-1)/(out-1) and lerps — do it axis by axis
+        def f(a):
+            if not nchw:
+                a = jnp.moveaxis(a, -1, 1)
+            for dim, (n_in, n_out) in enumerate(
+                zip(a.shape[2:], out_spatial)
+            ):
+                if n_in == n_out:
+                    continue
+                ax = 2 + dim
+                pos = (
+                    jnp.arange(n_out, dtype=jnp.float32)
+                    * (max(n_in - 1, 1) / max(n_out - 1, 1))
+                )
+                lo = jnp.floor(pos).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, n_in - 1)
+                w = (pos - lo).astype(a.dtype)
+                shape = [1] * a.ndim
+                shape[ax] = n_out
+                w = w.reshape(shape)
+                a = (
+                    jnp.take(a, lo, axis=ax) * (1 - w)
+                    + jnp.take(a, hi, axis=ax) * w
+                )
+            if not nchw:
+                a = jnp.moveaxis(a, 1, -1)
+            return a
+
+        return apply_op("interpolate", f, x)
+
     def f(a):
         if nchw:
             shape = list(a.shape[:2]) + out_spatial

@@ -147,3 +147,37 @@ class TestSplitFamily:
             paddle.combinations(x, 2, with_replacement=True).numpy(),
             [[1, 1], [1, 2], [1, 3], [2, 2], [2, 3], [3, 3]],
         )
+
+
+class TestInterpolateAlignCorners:
+    def test_matches_torch_both_modes(self):
+        torch = pytest.importorskip("torch")
+        import paddle_tpu.nn.functional as F
+
+        x = np.random.RandomState(0).randn(2, 3, 7, 5).astype(
+            "float32")
+        for ac in (True, False):
+            ours = F.interpolate(
+                _t(x), size=[14, 10], mode="bilinear",
+                align_corners=ac)
+            ref = torch.nn.functional.interpolate(
+                torch.tensor(x), size=(14, 10), mode="bilinear",
+                align_corners=ac)
+            np.testing.assert_allclose(
+                ours.numpy(), ref.numpy(), atol=1e-5,
+                err_msg=f"align_corners={ac}")
+
+    def test_trilinear_align_corners(self):
+        torch = pytest.importorskip("torch")
+        import paddle_tpu.nn.functional as F
+
+        x = np.random.RandomState(1).randn(1, 2, 4, 5, 6).astype(
+            "float32")
+        ours = F.interpolate(
+            _t(x), size=[8, 10, 12], mode="trilinear",
+            align_corners=True, data_format="NCDHW")
+        ref = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(8, 10, 12), mode="trilinear",
+            align_corners=True)
+        np.testing.assert_allclose(
+            ours.numpy(), ref.numpy(), atol=1e-5)
