@@ -51,18 +51,49 @@ def _proc_info():
     return _STORE[0], rank, world
 
 
+_KEY_WINDOW = 64  # keys rotate so the master's kv store stays bounded
+
+
+def _put(store, key, seq, obj):
+    store.set(key, pickle.dumps((seq, obj)))
+
+
+def _get_seq(store, key, seq, timeout=300.0):
+    """Blocking read of generation `seq` from a rotating key: the store
+    get blocks until the key exists; stale generations (overwritten
+    later by design) spin briefly until the writer catches up."""
+    import time
+
+    deadline = time.time() + timeout
+    while True:
+        got_seq, obj = pickle.loads(store.get(key))
+        if got_seq == seq:
+            return obj
+        if got_seq > seq:
+            raise RuntimeError(
+                f"object collective out of sync: wanted gen {seq}, "
+                f"store has {got_seq} (caller skipped a collective?)"
+            )
+        if time.time() > deadline:
+            raise TimeoutError(f"object collective timed out on {key}")
+        time.sleep(0.005)
+
+
 def _exchange(obj, tag):
-    """Everyone publishes, everyone reads all — returns list by rank."""
+    """Everyone publishes, everyone reads all — returns list by rank.
+    Keys rotate modulo a fixed window (values are overwritten in
+    place), so the control-plane master's memory stays bounded no
+    matter how many collectives a long run issues."""
     store, rank, world = _proc_info()
     if world == 1:
         return [obj]
     seq = _SEQ[0]
     _SEQ[0] += 1
-    key = f"__obj_{tag}_{seq}"
-    store.set(f"{key}_r{rank}", pickle.dumps(obj))
+    key = f"__obj_{tag}_{seq % _KEY_WINDOW}"
+    _put(store, f"{key}_r{rank}", seq, obj)
     out = []
     for r in range(world):
-        out.append(pickle.loads(store.get(f"{key}_r{r}")))
+        out.append(_get_seq(store, f"{key}_r{r}", seq))
     return out
 
 
@@ -81,12 +112,12 @@ def broadcast_object_list(object_list, src=0, group=None):
         return object_list
     seq = _SEQ[0]
     _SEQ[0] += 1
-    key = f"__obj_bc_{seq}"
+    key = f"__obj_bc_{seq % _KEY_WINDOW}"
     if rank == src:
-        store.set(key, pickle.dumps(list(object_list)))
+        _put(store, key, seq, list(object_list))
         got = list(object_list)
     else:
-        got = pickle.loads(store.get(key))
+        got = _get_seq(store, key, seq)
     object_list[:] = got
     return object_list
 
@@ -103,7 +134,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
         return out_object_list
     seq = _SEQ[0]
     _SEQ[0] += 1
-    key = f"__obj_sc_{seq}"
+    key = f"__obj_sc_{seq % _KEY_WINDOW}"
     if rank == src:
         if in_object_list is None or len(in_object_list) != world:
             raise ValueError(
@@ -111,6 +142,6 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
                 "entry per rank on src"
             )
         for r in range(world):
-            store.set(f"{key}_r{r}", pickle.dumps(in_object_list[r]))
-    out_object_list[:] = [pickle.loads(store.get(f"{key}_r{rank}"))]
+            _put(store, f"{key}_r{r}", seq, in_object_list[r])
+    out_object_list[:] = [_get_seq(store, f"{key}_r{rank}", seq)]
     return out_object_list

@@ -43,8 +43,9 @@ def swiglu(x, y=None, name=None):
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1, name=None):
-    """RMSNorm over the trailing axis, fused via the Pallas kernel when
-    shapes allow (upstream: fused_rms_norm op)."""
+    """RMSNorm over axes [begin_norm_axis, ndim) (upstream:
+    fused_rms_norm op); the trailing-axis case rides the Pallas
+    kernel."""
     from ...ops.kernels.rms_norm import rms_norm as _rms
 
     x = _as_tensor(x)
@@ -52,9 +53,17 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
     args = [x, norm_weight]
     if norm_bias is not None:
         args.append(_as_tensor(norm_bias))
+    bna = begin_norm_axis % x.ndim
 
     def f(a, w, *b):
-        out = _rms(a, w, eps=epsilon)
+        if bna == a.ndim - 1:
+            out = _rms(a, w, eps=epsilon)
+        else:
+            axes = tuple(range(bna, a.ndim))
+            af = a.astype(jnp.float32)
+            ms = jnp.mean(jnp.square(af), axis=axes, keepdims=True)
+            out = (af * jax.lax.rsqrt(ms + epsilon)
+                   * w.astype(jnp.float32)).astype(a.dtype)
         if b:
             out = out + b[0]
         return out
@@ -73,18 +82,24 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
         args.append(_as_tensor(norm_bias))
     has_w = norm_weight is not None
     has_b = norm_bias is not None
+    bna = begin_norm_axis % x.ndim
 
     def f(a, *wb):
+        axes = tuple(range(bna, a.ndim))
         af = a.astype(jnp.float32)
-        mean = jnp.mean(af, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(af - mean), axis=-1, keepdims=True)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(af - mean), axis=axes, keepdims=True)
         out = (af - mean) * jax.lax.rsqrt(var + epsilon)
         i = 0
         if has_w:
-            out = out * wb[i].astype(jnp.float32)
+            out = out * wb[i].astype(jnp.float32).reshape(
+                a.shape[bna:]
+            )
             i += 1
         if has_b:
-            out = out + wb[i].astype(jnp.float32)
+            out = out + wb[i].astype(jnp.float32).reshape(
+                a.shape[bna:]
+            )
         return out.astype(a.dtype)
 
     return apply_op("fused_layer_norm", f, *args)

@@ -245,9 +245,14 @@ class Rprop(Optimizer):
             if initd is None:
                 initd = self._rprop_initd = set()
             if id(acc) not in initd:
-                acc._data = jnp.full_like(
-                    acc._data.astype(jnp.float32), self._init_lr
-                )
+                # seed the per-weight step sizes ONLY from the blank
+                # (all-zero) accumulator state — a checkpoint-restored
+                # accumulator is strictly positive (lr range clips at
+                # 1e-5) and must keep its adapted values across resume
+                if bool(jnp.all(acc._data == 0)):
+                    acc._data = jnp.full_like(
+                        acc._data.astype(jnp.float32), self._init_lr
+                    )
                 initd.add(id(acc))
         return acc
 
@@ -278,15 +283,19 @@ class Rprop(Optimizer):
 
 
 class ASGD(Optimizer):
-    """Averaged SGD (upstream asgd.py): plain SGD steps plus a running
-    average of the iterates exposed as ``averaged_params``."""
+    """Averaged SGD (upstream asgd.py): the update direction is the
+    running sum of the last ``batch_num`` gradients —
+    ``d <- d - y + g;  param -= lr * d / n;  y <- g`` with ``n``
+    ramping up to batch_num — plus a running average of the iterates
+    exposed as ``averaged_params``."""
 
-    _accum_names = ("averaged_param",)
+    _accum_names = ("averaged_param", "asgd_d", "asgd_y")
 
     def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
                  weight_decay=None, grad_clip=None,
                  multi_precision=True, name=None):
         self._t = 0
+        self._batch_num = max(int(batch_num), 1)
         super().__init__(learning_rate, parameters, weight_decay,
                          grad_clip, name, multi_precision)
 
@@ -296,6 +305,8 @@ class ASGD(Optimizer):
 
     def _apply_one(self, param, grad, lr):
         avg = self._param_accum("averaged_param", param)
+        d = self._param_accum("asgd_d", param)
+        y = self._param_accum("asgd_y", param)
         master = self._get_master(param)
         p32 = (master._data if master is not None
                else param._data).astype(jnp.float32)
@@ -303,12 +314,17 @@ class ASGD(Optimizer):
         coeff = self._decay_coeff()
         if coeff:
             g32 = g32 + coeff * p32
-        p_new = p32 - lr.astype(jnp.float32) * g32
+        n = float(min(self._t, self._batch_num))
+        d_new = d._data.astype(jnp.float32) \
+            - y._data.astype(jnp.float32) + g32
+        p_new = p32 - lr.astype(jnp.float32) * d_new / n
         t = float(self._t)
         avg._data = (
             avg._data.astype(jnp.float32) * ((t - 1.0) / t)
             + p_new / t
         ).astype(avg._data.dtype)
+        d._data = d_new.astype(d._data.dtype)
+        y._data = g32.astype(y._data.dtype)
         if master is not None:
             master._data = p_new
         param._data = p_new.astype(param._data.dtype)

@@ -8,8 +8,15 @@ from ..nn import functional as F
 __all__ = ["fc", "batch_norm", "embedding", "conv2d", "sequence_expand"]
 
 
+_FC_LAYERS = {}
+
+
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
+    """Static-style fc. The layer is CACHED BY NAME so repeated calls
+    share (trainable) weights — a fresh layer per call would silently
+    train nothing. Pass ``name=``; anonymous fcs reuse one layer per
+    (in_features, size) signature."""
     import numpy as np
 
     from ..framework.core import _as_tensor
@@ -17,8 +24,13 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
 
     x = _as_tensor(x)
     in_features = int(np.prod(x.shape[num_flatten_dims:]))
-    layer = Linear(in_features, size, weight_attr=weight_attr,
-                   bias_attr=bias_attr)
+    key = name or f"__anon_fc_{in_features}_{size}"
+    layer = _FC_LAYERS.get(key)
+    if layer is None:
+        layer = _FC_LAYERS[key] = Linear(
+            in_features, size, weight_attr=weight_attr,
+            bias_attr=bias_attr,
+        )
     flat = x.reshape(list(x.shape[:num_flatten_dims]) + [-1])
     out = layer(flat)
     if activation:

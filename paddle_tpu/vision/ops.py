@@ -52,6 +52,37 @@ def _bilinear_gather(feat, ys, xs):
     )
 
 
+def _roi_bilinear_gather(feat, ys, xs):
+    """RoIAlign border semantics (upstream bilinear_interpolate in
+    paddle/phi/kernels/funcs/roi_align_functor.h): coords in (-1, 0]
+    clamp to 0 / (H-1, H) clamp to the edge with full weight; only
+    coords beyond 1 pixel outside contribute zero. Differs from the
+    zero-padding `_bilinear_gather` used by deformable conv."""
+    c, h, w = feat.shape
+    inside = (ys > -1.0) & (ys < h) & (xs > -1.0) & (xs < w)
+    ys_c = jnp.clip(ys, 0.0, h - 1)
+    xs_c = jnp.clip(xs, 0.0, w - 1)
+    y0 = jnp.floor(ys_c)
+    x0 = jnp.floor(xs_c)
+    wy = ys_c - y0
+    wx = xs_c - x0
+
+    def fetch(yi, xi):
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        return feat[:, yc, xc]
+
+    v00 = fetch(y0, x0)
+    v01 = fetch(y0, x0 + 1)
+    v10 = fetch(y0 + 1, x0)
+    v11 = fetch(y0 + 1, x0 + 1)
+    out = (
+        v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+        + v10 * wy * (1 - wx) + v11 * wy * wx
+    )
+    return out * inside[None]
+
+
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     """RoIAlign (upstream roi_align): boxes (R, 4) xyxy in input-image
@@ -93,7 +124,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             ys = y1[i] + bin_h[i] * gy  # (oh*r,)
             xs = x1[i] + bin_w[i] * gx  # (ow*r,)
             yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
-            vals = _bilinear_gather(
+            vals = _roi_bilinear_gather(
                 feat[img_idx[i]].astype(jnp.float32), yy, xx
             )  # (C, oh*r, ow*r)
             c = vals.shape[0]
@@ -445,8 +476,7 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         cols = vals.reshape(n, cin, oh, ow, kh * kw)
         wmat = wt.reshape(cout, cin * kh * kw).astype(jnp.float32)
         out = jnp.einsum(
-            "nchwk,ock->nohw",
-            jnp.moveaxis(cols, 1, 1),
+            "nchwk,ock->nohw", cols,
             wmat.reshape(cout, cin, kh * kw),
         )
         if bs is not None:

@@ -132,3 +132,40 @@ class TestLBFGS:
         opt = optim.LBFGS(parameters=[pw])
         with pytest.raises(ValueError):
             opt.step()
+
+
+def test_rprop_restore_keeps_adapted_step_sizes():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    m = nn.Linear(3, 2)
+    o1 = optim.Rprop(learning_rate=0.01, parameters=m.parameters())
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 3).astype("float32"))
+    y = paddle.to_tensor(
+        np.random.RandomState(1).randn(4, 2).astype("float32"))
+    for _ in range(3):
+        F.mse_loss(m(x), y).backward()
+        o1.step()
+        o1.clear_grad()
+    sd = o1.state_dict()
+    lr1 = np.asarray(
+        o1._param_accum("learning_rate_local", m.weight)._data).copy()
+    assert not np.allclose(lr1, 0.01)  # adapted
+    o2 = optim.Rprop(learning_rate=0.01, parameters=m.parameters())
+    o2.set_state_dict(sd)
+    lr2 = np.asarray(
+        o2._param_accum("learning_rate_local", m.weight)._data)
+    np.testing.assert_allclose(lr2, lr1)
+
+
+def test_asgd_batch_num_window():
+    pw = paddle.to_tensor(np.zeros(1, "float32"), stop_gradient=False)
+    opt = optim.ASGD(learning_rate=1.0, batch_num=2, parameters=[pw])
+    for gval in (1.0, 3.0):
+        (pw * gval).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    # step1: d=g1=1, n=1 -> p=-1; step2: d=1-1+3=3, n=2 -> p=-2.5
+    np.testing.assert_allclose(pw.numpy(), [-2.5])

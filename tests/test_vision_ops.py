@@ -150,3 +150,15 @@ class TestDeformConv:
         assert out.shape == [1, 3, 6, 6]
         out.sum().backward()
         assert x.grad is not None and off.grad is not None
+
+
+def test_roi_align_edge_box_full_weight():
+    """Boxes touching the image border keep full value (upstream
+    clamps (-1, 0] samples to the edge; zero-padding would halve
+    them)."""
+    feat = np.full((1, 1, 8, 8), 3.0, "float32")
+    out = V.roi_align(
+        _t(feat), _t(np.array([[0., 0., 4., 4.]], "float32")),
+        _t(np.array([1], "int32")), 2,
+    )
+    np.testing.assert_allclose(out.numpy(), 3.0)
