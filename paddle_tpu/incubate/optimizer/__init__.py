@@ -1,0 +1,119 @@
+"""Incubating optimizer wrappers (upstream: python/paddle/incubate/
+optimizer/{lookahead,modelaverage}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, no_grad
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k-step lookahead: slow weights interpolate toward the fast
+    optimizer's weights every k steps (upstream LookAhead)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k:
+            return
+        with no_grad():
+            for p in self._parameter_list:
+                slow = self._slow.get(p._uid)
+                if slow is None:
+                    slow = self._slow[p._uid] = (
+                        p._data.astype(jnp.float32)
+                    )
+                    continue
+                slow = slow + self.alpha * (
+                    p._data.astype(jnp.float32) - slow
+                )
+                self._slow[p._uid] = slow
+                p._data = slow.astype(p._data.dtype)
+                p._version += 1
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self, *a, **k):
+        return self.inner_optimizer.clear_grad(*a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+
+class ModelAverage:
+    """Maintains an exponential/window average of parameters; use
+    ``apply()`` to evaluate with averaged weights and ``restore()`` to
+    return to the training weights (upstream ModelAverage)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.rate = float(average_window_rate)
+        self._parameter_list = list(parameters or [])
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._n = 0
+        self._sum = {}
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current weights into the running average."""
+        self._n += 1
+        with no_grad():
+            for p in self._parameter_list:
+                cur = p._data.astype(jnp.float32)
+                acc = self._sum.get(p._uid)
+                if acc is None or self._n > self.max_window:
+                    self._sum[p._uid] = cur
+                    if self._n > self.max_window:
+                        self._n = 1
+                else:
+                    self._sum[p._uid] = acc + cur
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap in the averaged weights (context-manager friendly)."""
+        if self._n == 0:
+            return self
+        self._backup = {
+            p._uid: p._data for p in self._parameter_list
+        }
+        with no_grad():
+            for p in self._parameter_list:
+                acc = self._sum.get(p._uid)
+                if acc is not None:
+                    p._data = (acc / self._n).astype(p._data.dtype)
+                    p._version += 1
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._parameter_list:
+            if p._uid in self._backup:
+                p._data = self._backup[p._uid]
+                p._version += 1
+        self._backup = None
+
+    def __enter__(self):
+        self.apply()
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()

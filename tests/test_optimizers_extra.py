@@ -169,3 +169,55 @@ def test_asgd_batch_num_window():
         opt.clear_grad()
     # step1: d=g1=1, n=1 -> p=-1; step2: d=1-1+3=3, n=2 -> p=-2.5
     np.testing.assert_allclose(pw.numpy(), [-2.5])
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_interpolates_and_trains(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate.optimizer import LookAhead
+
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        la = LookAhead(optim.SGD(0.1, parameters=m.parameters()),
+                       alpha=0.5, k=2)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 4).astype("float32"))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randn(8, 2).astype("float32"))
+        losses = []
+        for _ in range(8):
+            loss = F.mse_loss(m(x), y)
+            loss.backward()
+            la.step()
+            la.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+        assert la._slow  # slow weights engaged
+
+    def test_model_average_apply_restore(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate.optimizer import ModelAverage
+
+        paddle.seed(1)
+        m = nn.Linear(4, 2)
+        sgd = optim.SGD(0.1, parameters=m.parameters())
+        ma = ModelAverage(0.15, parameters=m.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 4).astype("float32"))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randn(8, 2).astype("float32"))
+        snapshots = []
+        for _ in range(5):
+            loss = F.mse_loss(m(x), y)
+            loss.backward()
+            sgd.step()
+            sgd.clear_grad()
+            ma.step()
+            snapshots.append(m.weight.numpy().copy())
+        w_train = m.weight.numpy().copy()
+        with ma:
+            np.testing.assert_allclose(
+                m.weight.numpy(), np.mean(snapshots, 0), atol=1e-6)
+        np.testing.assert_allclose(m.weight.numpy(), w_train)
