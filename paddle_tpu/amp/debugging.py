@@ -1,0 +1,123 @@
+"""AMP debugging utilities (upstream: python/paddle/amp/debugging.py).
+
+TPU mapping: nan/inf checking rides jax's debug_nans machinery (the
+same hook FLAGS_check_nan_inf uses); operator stats come from the
+framework's dispatch-level op counters.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, _as_tensor
+
+__all__ = [
+    "enable_operator_stats_collection",
+    "disable_operator_stats_collection",
+    "collect_operator_stats",
+    "enable_tensor_checker",
+    "disable_tensor_checker",
+    "check_numerics",
+    "TensorCheckerConfig",
+    "DebugMode",
+]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None,
+                 stack_height_limit=None):
+        self.enable = enable
+        self.debug_mode = debug_mode
+
+
+_OP_STATS = collections.Counter()
+_COLLECTING = [False]
+_ORIG_APPLY = [None]
+
+
+def _install_counter():
+    from ..framework import core
+
+    if _ORIG_APPLY[0] is not None:
+        return
+    orig = core.apply_op
+
+    def counting_apply(name, fn, *a, **k):
+        if _COLLECTING[0]:
+            out = orig(name, fn, *a, **k)
+            first = out[0] if isinstance(out, tuple) else out
+            dt = str(first._data.dtype) if isinstance(first, Tensor) \
+                else "other"
+            _OP_STATS[f"{name}:{dt}"] += 1
+            return out
+        return orig(name, fn, *a, **k)
+
+    _ORIG_APPLY[0] = orig
+    core.apply_op = counting_apply
+
+
+def enable_operator_stats_collection():
+    _install_counter()
+    _OP_STATS.clear()
+    _COLLECTING[0] = True
+
+
+def disable_operator_stats_collection():
+    _COLLECTING[0] = False
+    rows = sorted(_OP_STATS.items())
+    if rows:
+        print("<------------------- op list ------------------->")
+        for key, cnt in rows:
+            print(f"  {key:<40} calls={cnt}")
+        print("<----------------------------------------------->")
+    return dict(_OP_STATS)
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def enable_tensor_checker(checker_config=None):
+    import paddle_tpu as paddle
+
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    import paddle_tpu as paddle
+
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Count nan/inf in a tensor; raises in ABORT mode (upstream
+    check_numerics op)."""
+    t = _as_tensor(tensor)
+    arr = t._data.astype(jnp.float32)
+    n_nan = int(jnp.sum(jnp.isnan(arr)))
+    n_inf = int(jnp.sum(jnp.isinf(arr)))
+    if (debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT
+            and (n_nan or n_inf)):
+        raise FloatingPointError(
+            f"check_numerics[{op_type}/{var_name}]: "
+            f"{n_nan} nan, {n_inf} inf"
+        )
+    stats = Tensor(np.asarray([n_nan, n_inf], np.int64))
+    return stats
