@@ -866,3 +866,127 @@ def _kl_laplace(p, q):
         )
 
     return apply_op("kl_laplace", f, p.loc, p.scale, q.loc, q.scale)
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs) (upstream: distribution/binomial.py)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _param(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    def sample(self, shape=()):
+        shape = _shape_tuple(shape)
+        k = next_key()
+        n = self.total_count
+
+        def f(p):
+            return jnp.sum(
+                jax.random.bernoulli(
+                    k, p, (n,) + shape + tuple(p.shape)
+                ).astype(jnp.float32),
+                axis=0,
+            )
+
+        return apply_op("binomial_sample", f, self.probs,
+                        differentiable=False)
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        n = self.total_count
+
+        def f(v, p):
+            from jax.scipy.special import gammaln
+
+            vf = v.astype(jnp.float32)
+            pc = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return (
+                gammaln(n + 1.0) - gammaln(vf + 1.0)
+                - gammaln(n - vf + 1.0)
+                + vf * jnp.log(pc) + (n - vf) * jnp.log1p(-pc)
+            )
+
+        return apply_op("binomial_log_prob", f, value, self.probs)
+
+    @property
+    def mean(self):
+        from ..tensor.math import scale as _scale
+
+        return _scale(self.probs, float(self.total_count))
+
+
+class MultivariateNormal(Distribution):
+    """MVN with full covariance (upstream: distribution/
+    multivariate_normal.py). Sampling goes through the Cholesky factor
+    (reparameterized); log_prob solves against it."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _param(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError(
+                "give exactly one of covariance_matrix / scale_tril"
+            )
+        if scale_tril is not None:
+            self.scale_tril = _param(scale_tril)
+        else:
+            cov = _param(covariance_matrix)
+            self.scale_tril = apply_op(
+                "mvn_chol", jnp.linalg.cholesky, cov
+            )
+        super().__init__(tuple(self.loc.shape)[:-1],
+                         tuple(self.loc.shape)[-1:])
+
+    def rsample(self, shape=()):
+        shape = _shape_tuple(shape)
+        k = next_key()
+
+        def f(mu, L):
+            eps = jax.random.normal(
+                k, shape + mu.shape, jnp.float32
+            )
+            return mu + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return apply_op("mvn_rsample", f, self.loc, self.scale_tril)
+
+    def sample(self, shape=()):
+        s = self.rsample(shape)
+        s.stop_gradient = True
+        return s
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+
+        def f(v, mu, L):
+            d = mu.shape[-1]
+            diff = v.astype(jnp.float32) - mu
+            sol = jax.scipy.linalg.solve_triangular(
+                L, diff[..., None], lower=True
+            )[..., 0]
+            maha = jnp.sum(jnp.square(sol), axis=-1)
+            logdet = jnp.sum(
+                jnp.log(jnp.abs(jnp.diagonal(
+                    L, axis1=-2, axis2=-1))), axis=-1
+            )
+            return (
+                -0.5 * maha - logdet
+                - 0.5 * d * math.log(2.0 * math.pi)
+            )
+
+        return apply_op("mvn_log_prob", f, value, self.loc,
+                        self.scale_tril)
+
+    def entropy(self):
+        def f(mu, L):
+            d = mu.shape[-1]
+            logdet = jnp.sum(
+                jnp.log(jnp.abs(jnp.diagonal(
+                    L, axis1=-2, axis2=-1))), axis=-1
+            )
+            return 0.5 * d * (1.0 + math.log(2.0 * math.pi)) + logdet
+
+        return apply_op("mvn_entropy", f, self.loc, self.scale_tril)
+
+
+__all__.extend(["Binomial", "MultivariateNormal"])

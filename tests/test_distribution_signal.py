@@ -165,3 +165,55 @@ class TestSignal:
         assert fr.shape == [1, 10, 10]
         oa = paddle.signal.overlap_add(fr, 10)
         np.testing.assert_allclose(oa.numpy(), x)
+
+
+class TestBinomialMVN:
+    def test_binomial_logpmf(self):
+        b = D.Binomial(10, 0.3)
+        np.testing.assert_allclose(
+            b.log_prob(paddle.to_tensor(
+                np.array(4.0, "float32"))).numpy(),
+            scipy_stats.binom.logpmf(4, 10, 0.3), rtol=1e-5,
+        )
+        np.testing.assert_allclose(b.mean.numpy(), 3.0, rtol=1e-6)
+
+    def test_mvn_scipy_parity(self):
+        mu = np.array([1.0, -1.0], "float32")
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], "float32")
+        mvn = D.MultivariateNormal(
+            paddle.to_tensor(mu),
+            covariance_matrix=paddle.to_tensor(cov),
+        )
+        v = np.array([0.5, 0.2], "float32")
+        np.testing.assert_allclose(
+            mvn.log_prob(paddle.to_tensor(v)).numpy(),
+            scipy_stats.multivariate_normal.logpdf(v, mu, cov),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            mvn.entropy().numpy(),
+            scipy_stats.multivariate_normal.entropy(mu, cov),
+            rtol=1e-5,
+        )
+
+    def test_mvn_sample_moments_and_rsample_grad(self):
+        mu = np.array([1.0, -1.0], "float32")
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], "float32")
+        mvn = D.MultivariateNormal(
+            paddle.to_tensor(mu),
+            covariance_matrix=paddle.to_tensor(cov),
+        )
+        s = mvn.sample([20000])
+        np.testing.assert_allclose(
+            np.cov(s.numpy().T), cov, atol=0.1)
+        loc = paddle.to_tensor(mu, stop_gradient=False)
+        mvn2 = D.MultivariateNormal(
+            loc, covariance_matrix=paddle.to_tensor(cov))
+        mvn2.rsample([16]).mean().backward()
+        np.testing.assert_allclose(
+            loc.grad.numpy(), [0.5, 0.5], atol=1e-5)
+
+    def test_mvn_requires_one_param(self):
+        with pytest.raises(ValueError):
+            D.MultivariateNormal(paddle.to_tensor(
+                np.zeros(2, "float32")))
