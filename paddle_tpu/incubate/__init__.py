@@ -1,4 +1,5 @@
 """paddle.incubate analog (upstream: python/paddle/incubate/)."""
+from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401

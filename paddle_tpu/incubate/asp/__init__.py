@@ -1,0 +1,134 @@
+"""Automatic SParsity (upstream: python/paddle/incubate/asp/ —
+utils.py mask generation, asp.py prune/decorate workflow).
+
+n:m structured sparsity: every group of m consecutive weights keeps
+the n largest-magnitude entries. On TPU the masked weights ride the
+dense MXU (sparsity is a model-compression/regularization workflow
+here, as on most hardware); masks persist and are re-applied after
+every optimizer step by the decorated optimizer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, no_grad
+
+__all__ = [
+    "calculate_density", "create_mask", "check_mask_1d",
+    "check_mask_2d", "prune_model", "decorate", "reset_excluded_layers",
+    "set_excluded_layers",
+]
+
+_EXCLUDED = set()
+_MASKS = {}  # param uid -> jnp mask
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    """n:m magnitude mask. mask_1d groups along the last axis;
+    mask_2d applies 1d masking to both the rows and the columns view
+    (the reference's best-effort 2d pattern)."""
+    arr = np.asarray(
+        tensor._data if isinstance(tensor, Tensor) else tensor,
+        np.float32,
+    )
+    if func_name in ("mask_1d", "get_mask_1d"):
+        mask = _mask_1d(arr, n, m)
+    elif func_name in ("mask_2d_greedy", "mask_2d_best", "mask_2d",
+                       "get_mask_2d_greedy", "get_mask_2d_best"):
+        m1 = _mask_1d(arr, n, m)
+        m2 = _mask_1d(arr.T, n, m).T
+        # keep the pattern with better preserved magnitude
+        mask = m1 if (np.abs(arr) * m1).sum() >= (
+            np.abs(arr) * m2).sum() else m2
+    else:
+        raise ValueError(f"unknown mask function {func_name!r}")
+    return Tensor(mask.astype(arr.dtype))
+
+
+def _mask_1d(arr, n, m):
+    flat = arr.reshape(-1)
+    pad = (-len(flat)) % m
+    padded = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    groups = np.abs(padded).reshape(-1, m)
+    thresh_idx = np.argsort(-groups, axis=1)[:, :n]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, thresh_idx, 1.0, axis=1)
+    mask = mask.reshape(-1)[:len(flat)].reshape(arr.shape)
+    return mask
+
+
+def check_mask_1d(mat, n=2, m=4) -> bool:
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    flat = (arr != 0).astype(np.int64).reshape(-1)
+    pad = (-len(flat)) % m
+    padded = np.concatenate([flat, np.zeros(pad, np.int64)])
+    return bool((padded.reshape(-1, m).sum(1) <= n).all())
+
+
+def check_mask_2d(mat, n=2, m=4) -> bool:
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    return check_mask_1d(arr, n, m) or check_mask_1d(arr.T, n, m)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable(p):
+    return (
+        p is not None and not p.stop_gradient and p.ndim >= 2
+        and p.name not in _EXCLUDED
+    )
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d",
+                with_mask=True):
+    """Mask every prunable weight in place; masks are remembered so a
+    decorated optimizer can re-apply them after updates."""
+    pruned = {}
+    with no_grad():
+        for name, p in model.named_parameters():
+            if not _prunable(p):
+                continue
+            mask = create_mask(p, mask_algo, n, m)
+            p._data = (p._data * mask._data.astype(p._data.dtype))
+            p._version += 1
+            if with_mask:
+                _MASKS[p._uid] = mask._data
+            pruned[name] = calculate_density(p)
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so each step re-applies the sparsity masks
+    (upstream OptimizerWithSparsityGuarantee)."""
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def step(self, *a, **k):
+            out = self._inner.step(*a, **k)
+            with no_grad():
+                for p in self._inner._parameter_list:
+                    mask = _MASKS.get(p._uid)
+                    if mask is not None:
+                        p._data = p._data * mask.astype(p._data.dtype)
+                        p._version += 1
+            return out
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    return _ASPOptimizer(optimizer)

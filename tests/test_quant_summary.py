@@ -145,3 +145,48 @@ class TestAuc:
         auc.update(paddle.to_tensor(probs),
                    paddle.to_tensor(np.array([0, 1], "int64")))
         assert auc.accumulate() == 1.0
+
+
+class TestASP:
+    def test_mask_pattern_and_density(self):
+        from paddle_tpu.incubate import asp
+
+        w = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 16).astype("float32"))
+        mask = asp.create_mask(w, "mask_1d", n=2, m=4)
+        assert asp.check_mask_1d(mask, 2, 4)
+        np.testing.assert_allclose(
+            float(mask.numpy().mean()), 0.5)
+        # kept entries are the top-2 of each group of 4
+        grp = np.abs(w.numpy()).reshape(-1, 4)
+        kept = mask.numpy().reshape(-1, 4)
+        top2 = np.sort(grp, 1)[:, 2:]
+        assert ((grp * kept).sum() >=
+                top2.sum() - 1e-4)
+
+    def test_prune_and_decorated_step_keeps_sparsity(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                          nn.Linear(32, 4))
+        density = asp.prune_model(m, n=2, m=4)
+        assert all(abs(d - 0.5) < 1e-6 for d in density.values())
+        opt = asp.decorate(
+            optim.SGD(0.05, parameters=m.parameters()))
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(8, 16).astype("float32"))
+        y = paddle.to_tensor(
+            np.random.RandomState(2).randn(8, 4).astype("float32"))
+        for _ in range(3):
+            loss = F.mse_loss(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # sparsity maintained through optimizer updates
+        assert abs(asp.calculate_density(m[0].weight) - 0.5) < 1e-6
+        assert asp.check_mask_1d(
+            (m[0].weight.numpy() != 0).astype("float32"), 2, 4)
