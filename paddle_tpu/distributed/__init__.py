@@ -60,6 +60,7 @@ from .auto_parallel import (  # noqa
     shard_layer,
     shard_optimizer,
     shard_tensor,
+    to_static,
 )
 
 

@@ -406,3 +406,61 @@ class Engine:
 
         if self.optimizer is not None and os.path.exists(path + ".pdopt"):
             self.optimizer.set_state_dict(load(path + ".pdopt"))
+
+
+class DistModel:
+    """Callable returned by ``distributed.to_static`` (upstream:
+    python/paddle/distributed/auto_parallel/api.py DistModel): wraps
+    the layer + loss + optimizer into one compiled distributed train
+    step; ``train()``/``eval()`` pick the mode like the reference."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
+        from ...jit.api import to_static as _ts
+
+        self.network = layer
+        self._loss = loss
+        self._opt = optimizer
+        self._mode = "train"
+
+        def _train(x, y):
+            out = layer(x)
+            l = loss(out, y) if loss is not None else out
+            l.backward()
+            if optimizer is not None:
+                optimizer.step()
+                optimizer.clear_grad()
+            return l
+
+        def _eval(x, y):
+            out = layer(x)
+            return loss(out, y) if loss is not None else out
+
+        self._train_step = _ts(_train)
+        self._eval_step = _ts(_eval)
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            return self._train_step(*args)
+        return self._eval_step(*args)
+
+    def state_dict(self, *a, **k):
+        return self.network.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self.network.set_state_dict(*a, **k)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy=None):
+    """Semi-auto API: one call turns (layer, loss, optimizer) into a
+    compiled distributed step (upstream distributed.to_static)."""
+    return DistModel(layer, loader, loss, optimizer, strategy)

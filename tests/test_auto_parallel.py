@@ -141,3 +141,28 @@ class TestEngine:
         assert ev["loss"] is not None and np.isfinite(ev["loss"])
         preds = engine.predict(data(), steps=1)
         assert preds[0].shape == [16, 1]
+
+
+def test_distributed_to_static_dist_model():
+    """distributed.to_static wraps (layer, loss, opt) into a compiled
+    distributed step (upstream auto_parallel/api.py DistModel)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    m = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    dm = dist.to_static(m, loss=nn.MSELoss(), optimizer=opt)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 8).astype("float32"))
+    y = paddle.to_tensor(
+        np.random.RandomState(1).randn(8, 4).astype("float32"))
+    losses = [float(np.asarray(dm(x, y)._data)) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    dm.eval()
+    eval_loss = float(np.asarray(dm(x, y)._data))
+    assert np.isfinite(eval_loss)
+    assert "weight" in dm.state_dict()
