@@ -479,7 +479,11 @@ def _flash_bwd_dispatch(q, k, v, out, lse, do, causal, scale,
                         block_q, block_k, dlse=None):
     from ...framework.flags import flag
 
-    if flag("use_pallas_flash_bwd") and _pallas_ok(q, k, block_q, block_k):
+    from . import record_dispatch
+
+    ok = flag("use_pallas_flash_bwd") and _pallas_ok(q, k, block_q, block_k)
+    record_dispatch("flash_bwd", ok)
+    if ok:
         d = q.shape[-1]
         qp, outp, dop = _pad_head_dim((q, out, do), d)
         kp, vp = _pad_head_dim((k, v), d)
@@ -502,7 +506,11 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k):
 
 
 def _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k):
-    if _pallas_ok(q, k, block_q, block_k):
+    from . import record_dispatch
+
+    ok = _pallas_ok(q, k, block_q, block_k)
+    record_dispatch("flash_fwd", ok)
+    if ok:
         d = q.shape[-1]
         (qp,) = _pad_head_dim((q,), d)
         kp, vp = _pad_head_dim((k, v), d)
