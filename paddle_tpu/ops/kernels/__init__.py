@@ -52,4 +52,5 @@ from .rope import apply_rotary_emb
 from .paged_attention import (  # noqa
     paged_attention,
     paged_attention_reference,
+    paged_prefill_attention,
 )

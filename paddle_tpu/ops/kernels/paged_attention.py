@@ -172,3 +172,129 @@ def paged_attention_reference(q, k_pages, v_pages, page_table,
             p = p / p.sum() if L else p
             out[i, j] = p @ vj if L else 0.0
     return out
+
+
+def _prefill_kernel(scale, page_size, group, max_pages, t,
+                    page_tbl_ref, lens_ref,
+                    q_ref, k_ref, v_ref, o_ref,
+                    m_ref, l_ref, acc_ref):
+    """Chunked-prefill: T new tokens per sequence attend causally to
+    the whole paged prefix (the new tokens' K/V already live in the
+    pages; seq_lens counts them)."""
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    seq_len = lens_ref[b]
+    valid = p * page_size < seq_len
+
+    @pl.when(valid)
+    def _():
+        q = q_ref[0, 0]                   # (T, D)
+        k = k_ref[0, 0]                   # (page_size, D)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                          # (T, page_size)
+        kpos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        # row r is absolute position seq_len - T + r
+        qpos = seq_len - t + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0
+        )
+        s = jnp.where(
+            (kpos <= qpos) & (kpos < seq_len), s, NEG_INF
+        )
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)
+        pv = jnp.exp(s - m_cur)
+        l_ref[:] = jnp.broadcast_to(
+            corr * l_ref[:, :1]
+            + jnp.sum(pv, axis=-1, keepdims=True),
+            l_ref.shape,
+        )
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            pv.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+
+    @pl.when(p == max_pages - 1)
+    def _():
+        safe_l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
+                            sm_scale=None, interpret=None):
+    """Ragged chunked-prefill over a paged KV cache.
+
+    q: (B, T, H, D) — the T newest tokens of each sequence, whose K/V
+    have already been appended to the pages; seq_lens counts them.
+    Rows of lanes whose true new-token count < T should be masked by
+    the caller (positions follow seq_len). Returns (B, T, H, D).
+    """
+    b, t, h, d = q.shape
+    npages, page_size, kvh, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    group = h // kvh
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    kp = jnp.transpose(k_pages, (2, 0, 1, 3)).reshape(
+        kvh, npages, page_size, d
+    )
+    vp = jnp.transpose(v_pages, (2, 0, 1, 3)).reshape(
+        kvh, npages, page_size, d
+    )
+    q4 = jnp.transpose(q, (0, 2, 1, 3))  # (B, H, T, D)
+
+    def q_map(b_, h_, p_, tbl, lens):
+        return (b_, h_, 0, 0)
+
+    def kv_map(b_, h_, p_, tbl, lens):
+        return (h_ // group, tbl[b_, p_], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, t, d), q_map),
+            pl.BlockSpec((1, 1, page_size, d), kv_map),
+            pl.BlockSpec((1, 1, page_size, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, t, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((t, 8), jnp.float32),
+            pltpu.VMEM((t, 8), jnp.float32),
+            pltpu.VMEM((t, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel, float(scale), page_size, group, max_pages, t
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ) if not interpret else None,
+    )(
+        page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+        q4, kp, vp,
+    )
+    return jnp.transpose(out, (0, 2, 1, 3))

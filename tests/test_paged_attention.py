@@ -117,3 +117,70 @@ class TestPagedKVCacheManager:
         mgr.alloc("t")
         mgr.append("t", k, k)  # pool usable again
         assert mgr.seq_len("t") == 1
+
+
+class TestPagedPrefill:
+    def _ref(self, q, kp, vp, tbl, lens, P, H, KVH, D, T):
+        import math
+
+        B = q.shape[0]
+        res = np.zeros((B, T, H, D), np.float32)
+        scale = 1 / math.sqrt(D)
+        for b in range(B):
+            L = int(lens[b])
+            n_used = -(-L // P)
+            ks = np.concatenate(
+                [np.asarray(kp)[tbl[b, p]] for p in range(n_used)],
+                0)[:L]
+            vs = np.concatenate(
+                [np.asarray(vp)[tbl[b, p]] for p in range(n_used)],
+                0)[:L]
+            for r in range(T):
+                qpos = L - T + r
+                for h in range(H):
+                    kh = ks[:qpos + 1, h // (H // KVH)]
+                    vh = vs[:qpos + 1, h // (H // KVH)]
+                    s = kh @ np.asarray(q)[b, r, h] * scale
+                    pr = np.exp(s - s.max())
+                    pr /= pr.sum()
+                    res[b, r, h] = pr @ vh
+        return res
+
+    def test_causal_ragged_prefill(self):
+        import importlib
+
+        pa = importlib.import_module(
+            "paddle_tpu.ops.kernels.paged_attention")
+        rng = np.random.RandomState(0)
+        B, T, H, KVH, D = 2, 4, 4, 2, 32
+        NP, P, MAXP = 10, 8, 4
+        kp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+        tbl = jnp.asarray(
+            rng.permutation(NP)[:B * MAXP].reshape(B, MAXP),
+            jnp.int32)
+        lens = jnp.asarray([27, 12], jnp.int32)
+        q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+        out = pa.paged_prefill_attention(q, kp, vp, tbl, lens)
+        ref = self._ref(q, kp, vp, tbl, lens, P, H, KVH, D, T)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+    def test_prefill_agrees_with_decode_on_last_token(self):
+        import importlib
+
+        pa = importlib.import_module(
+            "paddle_tpu.ops.kernels.paged_attention")
+        rng = np.random.RandomState(1)
+        B, T, H, KVH, D = 2, 3, 4, 4, 32
+        NP, P, MAXP = 8, 8, 3
+        kp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+        tbl = jnp.asarray(
+            rng.permutation(NP)[:B * MAXP].reshape(B, MAXP),
+            jnp.int32)
+        lens = jnp.asarray([20, 9], jnp.int32)
+        q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+        pre = pa.paged_prefill_attention(q, kp, vp, tbl, lens)
+        dec = pa.paged_attention(q[:, -1], kp, vp, tbl, lens)
+        np.testing.assert_allclose(
+            np.asarray(pre[:, -1]), np.asarray(dec), atol=1e-5)
