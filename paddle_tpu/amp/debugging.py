@@ -43,39 +43,30 @@ class TensorCheckerConfig:
 
 
 _OP_STATS = collections.Counter()
-_COLLECTING = [False]
-_ORIG_APPLY = [None]
 
 
-def _install_counter():
-    from ..framework import core
-
-    if _ORIG_APPLY[0] is not None:
-        return
-    orig = core.apply_op
-
-    def counting_apply(name, fn, *a, **k):
-        if _COLLECTING[0]:
-            out = orig(name, fn, *a, **k)
-            first = out[0] if isinstance(out, tuple) else out
-            dt = str(first._data.dtype) if isinstance(first, Tensor) \
-                else "other"
-            _OP_STATS[f"{name}:{dt}"] += 1
-            return out
-        return orig(name, fn, *a, **k)
-
-    _ORIG_APPLY[0] = orig
-    core.apply_op = counting_apply
+def _stats_hook(name, ins):
+    # dispatch-level hook INSIDE apply_op (core._state.op_stats_hook):
+    # call sites import apply_op by value, so rebinding core.apply_op
+    # would miss every op outside framework/core.py
+    dt = (
+        str(ins[0]._data.dtype)
+        if ins and isinstance(ins[0], Tensor) else "other"
+    )
+    _OP_STATS[f"{name}:{dt}"] += 1
 
 
 def enable_operator_stats_collection():
-    _install_counter()
+    from ..framework.core import _state
+
     _OP_STATS.clear()
-    _COLLECTING[0] = True
+    _state.op_stats_hook = _stats_hook
 
 
 def disable_operator_stats_collection():
-    _COLLECTING[0] = False
+    from ..framework.core import _state
+
+    _state.op_stats_hook = None
     rows = sorted(_OP_STATS.items())
     if rows:
         print("<------------------- op list ------------------->")
@@ -97,6 +88,8 @@ def collect_operator_stats():
 def enable_tensor_checker(checker_config=None):
     import paddle_tpu as paddle
 
+    if checker_config is not None and not checker_config.enable:
+        return
     paddle.set_flags({"FLAGS_check_nan_inf": True})
 
 

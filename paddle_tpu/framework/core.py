@@ -62,6 +62,7 @@ class _EagerState(threading.local):
     def __init__(self):
         self.grad_enabled = True
         self.amp_cast_fn = None  # installed by paddle_tpu.amp
+        self.op_stats_hook = None  # installed by amp.debugging
         self.retain_graph_depth = 0
 
 
@@ -416,6 +417,8 @@ def apply_op(name: str, fn: Callable, *tensor_inputs, n_outs: int = 1,
     # AMP hook: the installed policy may cast inputs (O1 white/black list)
     if _state.amp_cast_fn is not None:
         ins, fn = _state.amp_cast_fn(name, ins, fn)
+    if _state.op_stats_hook is not None:
+        _state.op_stats_hook(name, ins)
     raws = tuple(t._data for t in ins)
     out_raw = fn(*raws)
 

@@ -42,14 +42,36 @@ def create_mask(tensor, func_name="mask_1d", n=2, m=4):
         mask = _mask_1d(arr, n, m)
     elif func_name in ("mask_2d_greedy", "mask_2d_best", "mask_2d",
                        "get_mask_2d_greedy", "get_mask_2d_best"):
-        m1 = _mask_1d(arr, n, m)
-        m2 = _mask_1d(arr.T, n, m).T
-        # keep the pattern with better preserved magnitude
-        mask = m1 if (np.abs(arr) * m1).sum() >= (
-            np.abs(arr) * m2).sum() else m2
+        mask = _mask_2d_greedy(arr, n, m)
     else:
         raise ValueError(f"unknown mask function {func_name!r}")
     return Tensor(mask.astype(arr.dtype))
+
+
+def _mask_2d_greedy(arr, n, m):
+    """Per m x m block: pick entries by descending magnitude subject
+    to <= n per row AND <= n per column (upstream get_mask_2d_greedy)."""
+    h, w = arr.shape[-2], arr.shape[-1]
+    a2 = arr.reshape(-1, w) if arr.ndim > 2 else arr
+    rows = a2.shape[0]
+    pad_r = (-rows) % m
+    pad_c = (-w) % m
+    padded = np.pad(np.abs(a2), ((0, pad_r), (0, pad_c)))
+    mask = np.zeros_like(padded)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = padded[bi:bi + m, bj:bj + m]
+            order = np.argsort(-block, axis=None)
+            rcnt = np.zeros(m, np.int64)
+            ccnt = np.zeros(m, np.int64)
+            for flat in order:
+                r, c = divmod(int(flat), m)
+                if rcnt[r] < n and ccnt[c] < n:
+                    mask[bi + r, bj + c] = 1.0
+                    rcnt[r] += 1
+                    ccnt[c] += 1
+    mask = mask[:rows, :w]
+    return mask.reshape(arr.shape)
 
 
 def _mask_1d(arr, n, m):
@@ -73,8 +95,10 @@ def check_mask_1d(mat, n=2, m=4) -> bool:
 
 
 def check_mask_2d(mat, n=2, m=4) -> bool:
+    """The n:m pattern must hold along BOTH rows and columns (upstream
+    check_mask_2d semantics — an OR would falsely pass 1-d masks)."""
     arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
-    return check_mask_1d(arr, n, m) or check_mask_1d(arr.T, n, m)
+    return check_mask_1d(arr, n, m) and check_mask_1d(arr.T, n, m)
 
 
 def set_excluded_layers(param_names, main_program=None):
@@ -120,13 +144,24 @@ def decorate(optimizer):
 
         def step(self, *a, **k):
             out = self._inner.step(*a, **k)
+            self._reapply_masks()
+            return out
+
+        def minimize(self, loss, *a, **k):
+            # the inner minimize would call the INNER step and bypass
+            # the mask re-application
+            loss.backward()
+            self.step()
+            self._inner.clear_grad()
+            return None, None
+
+        def _reapply_masks(self):
             with no_grad():
                 for p in self._inner._parameter_list:
                     mask = _MASKS.get(p._uid)
                     if mask is not None:
                         p._data = p._data * mask.astype(p._data.dtype)
                         p._version += 1
-            return out
 
         def __getattr__(self, name):
             return getattr(self._inner, name)

@@ -76,16 +76,17 @@ class ModelAverage:
     def step(self):
         """Accumulate the current weights into the running average."""
         self._n += 1
+        # window restart decided ONCE for the whole step — resetting
+        # inside the per-param loop would restart only the first
+        # parameter's sum and divide the rest by the wrong count
+        if self._n > self.max_window:
+            self._n = 1
+            self._sum.clear()
         with no_grad():
             for p in self._parameter_list:
                 cur = p._data.astype(jnp.float32)
                 acc = self._sum.get(p._uid)
-                if acc is None or self._n > self.max_window:
-                    self._sum[p._uid] = cur
-                    if self._n > self.max_window:
-                        self._n = 1
-                else:
-                    self._sum[p._uid] = acc + cur
+                self._sum[p._uid] = cur if acc is None else acc + cur
 
     def apply(self, executor=None, need_restore=True):
         """Swap in the averaged weights (context-manager friendly)."""

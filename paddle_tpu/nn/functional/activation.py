@@ -7,11 +7,13 @@ import jax.numpy as jnp
 from ...framework.core import Tensor, apply_op, _as_tensor
 
 
-def _unary(name, jfn):
+def _unary(op_name, jfn):
+    # NB: the paddle-API `name=None` kwarg must not shadow the op name
+    # (it silently recorded every activation as op None on the tape)
     def op(x, name=None):
-        return apply_op(name, jfn, _as_tensor(x))
+        return apply_op(op_name, jfn, _as_tensor(x))
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

@@ -18,26 +18,28 @@ def _num(v):
     return v
 
 
-def _unary(name, jfn):
+def _unary(op_name, jfn):
+    # the paddle-API `name=None` kwarg must not shadow the op name
+    # (it recorded every elementwise op as op None on the tape)
     def op(x, name=None):
         x = _as_tensor(x)
-        return apply_op(name, jfn, x)
+        return apply_op(op_name, jfn, x)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
-def _binary(name, jfn):
+def _binary(op_name, jfn):
     def op(x, y, name=None):
         if isinstance(y, Tensor) or isinstance(x, Tensor):
             x = _as_tensor(x) if not isinstance(x, Tensor) else x
             if isinstance(y, Tensor):
-                return apply_op(name, jfn, x, y)
+                return apply_op(op_name, jfn, x, y)
             yv = y
-            return apply_op(name, lambda a: jfn(a, yv), x)
+            return apply_op(op_name, lambda a: jfn(a, yv), x)
         return Tensor(jfn(jnp.asarray(x), jnp.asarray(y)))
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
