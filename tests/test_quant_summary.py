@@ -190,3 +190,54 @@ class TestASP:
         assert abs(asp.calculate_density(m[0].weight) - 0.5) < 1e-6
         assert asp.check_mask_1d(
             (m[0].weight.numpy() != 0).astype("float32"), 2, 4)
+
+
+class TestAmpDebugging:
+    def test_operator_stats_see_all_dispatches(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.amp import debugging as dbg
+
+        with dbg.collect_operator_stats():
+            x = paddle.to_tensor(
+                np.random.RandomState(0).randn(2, 4).astype("float32"))
+            F.relu(x)
+            _ = x + x
+            paddle.exp(x)
+        names = {k.split(":")[0] for k in dbg._OP_STATS}
+        assert {"relu", "add", "exp"} <= names
+
+    def test_tensor_checker_config_respected(self):
+        from paddle_tpu.amp import debugging as dbg
+        from paddle_tpu.framework.flags import flag
+
+        dbg.disable_tensor_checker()
+        dbg.enable_tensor_checker(
+            dbg.TensorCheckerConfig(enable=False))
+        assert flag("check_nan_inf") is False
+        dbg.enable_tensor_checker()
+        assert flag("check_nan_inf") is True
+        dbg.disable_tensor_checker()
+
+    def test_check_numerics(self):
+        from paddle_tpu.amp import debugging as dbg
+
+        bad = paddle.to_tensor(
+            np.array([1.0, float("nan"), float("inf")], "float32"))
+        stats = dbg.check_numerics(bad)
+        assert stats.numpy().tolist() == [1, 1]
+        with pytest.raises(FloatingPointError):
+            dbg.check_numerics(
+                bad, debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT)
+
+
+def test_op_names_recorded_on_tape():
+    """Regression: the `name=None` API kwarg must not shadow op names
+    (every activation/elementwise op recorded as None before)."""
+    x = paddle.to_tensor(np.array([1.0], "float32"),
+                         stop_gradient=False)
+    y = paddle.exp(x)
+    assert y._grad_node is not None and y._grad_node.name == "exp"
+    import paddle_tpu.nn.functional as F
+
+    z = F.relu(x)
+    assert z._grad_node.name == "relu"
