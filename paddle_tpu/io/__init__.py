@@ -775,3 +775,52 @@ def get_worker_info():
     """Inside a worker process: (id, num_workers, seed, dataset);
     None in the main process (reference semantics)."""
     return _worker_info
+
+
+class ComposeDataset(Dataset):
+    """Zip-style composition: sample i concatenates the fields of every
+    dataset's sample i (upstream: io/dataloader/dataset.py
+    ComposeDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("datasets must not be empty")
+        n = len(self.datasets[0])
+        for d in self.datasets[1:]:
+            if len(d) != n:
+                raise ValueError(
+                    "ComposeDataset requires equal-length datasets"
+                )
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            sample = d[idx]
+            if isinstance(sample, (list, tuple)):
+                out.extend(sample)
+            else:
+                out.append(sample)
+        return tuple(out)
+
+
+class SubsetRandomSampler(Sampler):
+    """Random permutation over a fixed index subset (upstream
+    SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+        if not self.indices:
+            raise ValueError("indices must not be empty")
+
+    def __iter__(self):
+        import numpy as _np
+
+        order = _np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)
