@@ -40,6 +40,15 @@ from .framework import (
     set_rng_state,
     in_dynamic_mode,
 )
+from .framework.conveniences import (  # noqa
+    broadcast_shape,
+    device_guard,
+    disable_signal_handler,
+    get_cudnn_version,
+    is_compiled_with_cinn,
+    is_compiled_with_custom_device,
+    set_printoptions,
+)
 from .framework.dtype import finfo, iinfo  # noqa
 from .framework.dtype import (  # noqa
     get_default_dtype,

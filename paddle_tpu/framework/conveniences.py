@@ -1,0 +1,63 @@
+"""Small top-level conveniences (upstream: scattered across python/paddle/framework|base)."""
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor print formatting (upstream paddle.set_printoptions) —
+    forwards to numpy's printoptions (Tensor repr prints via numpy)."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Resulting broadcast shape (upstream paddle.broadcast_shape)."""
+    import numpy as _np
+
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def disable_signal_handler():
+    """Uninstall the faulthandler-based crash dumps (upstream
+    paddle.disable_signal_handler)."""
+    import faulthandler
+
+    try:
+        faulthandler.disable()
+    except Exception:
+        pass
+
+
+def get_cudnn_version():
+    return None  # TPU build: no cuDNN
+
+
+def device_guard(device=None):
+    """Context manager scoping the active device (upstream
+    paddle.static.device_guard; single-device TPU: a no-op scope)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        yield
+
+    return _guard()
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
