@@ -3,6 +3,12 @@ loop) on synthetic 16x16 images.
 
 Run: python examples/dcgan_mnist.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run without installing
+
 import numpy as np
 
 import paddle_tpu as paddle

@@ -2,6 +2,12 @@
 
 Run: python examples/imdb_bilstm.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run without installing
+
 import numpy as np
 
 import paddle_tpu as paddle

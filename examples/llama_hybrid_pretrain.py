@@ -4,6 +4,12 @@ sharding) — runs on the 8-device virtual CPU mesh or real chips alike.
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
      JAX_PLATFORMS=cpu python examples/llama_hybrid_pretrain.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run without installing
+
 import numpy as np
 
 import paddle_tpu as paddle

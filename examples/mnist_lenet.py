@@ -4,6 +4,12 @@ Run: python examples/mnist_lenet.py [--epochs N]
 (MNIST reads ~/.cache/paddle/dataset/mnist if present; otherwise a
 synthetic same-shape dataset keeps the example runnable offline.)
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run without installing
+
 import argparse
 
 import paddle_tpu as paddle

@@ -3,6 +3,12 @@ convert to fixed-scale int8 simulation.
 
 Run: python examples/ptq_int8.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run without installing
+
 import numpy as np
 
 import paddle_tpu as paddle
