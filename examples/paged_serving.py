@@ -1,0 +1,147 @@
+"""Continuous-batching decode on the paged KV cache.
+
+A toy 2-layer decoder serves three sequences that ENTER AND LEAVE the
+batch at different times (the continuous-batching pattern); every
+step's attention runs through the Pallas paged-attention kernel via
+PagedKVCacheManager, and the script cross-checks each sequence's
+logits against an offline dense forward of the same weights.
+
+Run: python examples/paged_serving.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run without installing
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.nn import PagedKVCacheManager
+
+
+class TinyDecoder(nn.Layer):
+    """2 layers of (paged attention + MLP); enough to exercise the
+    per-layer page pools like a real serving stack."""
+
+    def __init__(self, vocab=101, dim=64, heads=4, layers=2,
+                 page_size=4, num_pages=64):
+        super().__init__()
+        import jax.numpy as jnp
+
+        self.dim, self.heads, self.hd = dim, heads, dim // heads
+        self.embed = nn.Embedding(vocab, dim)
+        self.layers_n = layers
+        self.qkv = nn.LayerList(
+            [nn.Linear(dim, 3 * dim) for _ in range(layers)])
+        self.out = nn.LayerList(
+            [nn.Linear(dim, dim) for _ in range(layers)])
+        self.mlp = nn.LayerList(
+            [nn.Linear(dim, dim) for _ in range(layers)])
+        self.head = nn.Linear(dim, vocab)
+        self.caches = [
+            PagedKVCacheManager(num_pages, page_size, heads, self.hd,
+                                dtype=jnp.float32)
+            for _ in range(layers)
+        ]
+
+    # -- serving-side single-token step ---------------------------------
+    def alloc(self, sid):
+        for c in self.caches:
+            c.alloc(sid)
+
+    def free(self, sid):
+        for c in self.caches:
+            c.free(sid)
+
+    def decode_token(self, token_ids, seq_ids):
+        """token_ids: list[int] — one new token per listed sequence."""
+        import jax.numpy as jnp
+
+        x = self.embed(paddle.to_tensor(
+            np.asarray(token_ids, "int64")[:, None]))[:, 0]  # (B, D)
+        for li in range(self.layers_n):
+            qkv = self.qkv[li](x).reshape([len(seq_ids), 3,
+                                           self.heads, self.hd])
+            q = qkv[:, 0]
+            k = qkv[:, 1]
+            v = qkv[:, 2]
+            for bi, sid in enumerate(seq_ids):
+                self.caches[li].append(
+                    sid, k.numpy()[bi], v.numpy()[bi])
+            attn = self.caches[li].attend(q, seq_ids)  # (B, H, hd)
+            x = x + self.out[li](
+                attn.reshape([len(seq_ids), self.dim]))
+            x = x + paddle.nn.functional.relu(self.mlp[li](x))
+        return self.head(x)  # (B, vocab)
+
+    # -- offline dense reference ----------------------------------------
+    def dense_forward(self, tokens):
+        import jax.numpy as jnp
+
+        ids = paddle.to_tensor(np.asarray(tokens, "int64")[None])
+        x = self.embed(ids)[0]  # (T, D)
+        T = x.shape[0]
+        for li in range(self.layers_n):
+            qkv = self.qkv[li](x).reshape([T, 3, self.heads, self.hd])
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            qn, kn, vn = q.numpy(), k.numpy(), v.numpy()
+            attn = np.zeros_like(qn)
+            scale = 1.0 / np.sqrt(self.hd)
+            for t in range(T):
+                for h in range(self.heads):
+                    s = kn[:t + 1, h] @ qn[t, h] * scale
+                    p = np.exp(s - s.max())
+                    p /= p.sum()
+                    attn[t, h] = p @ vn[:t + 1, h]
+            x = x + self.out[li](paddle.to_tensor(
+                attn.reshape(T, self.dim)))
+            x = x + paddle.nn.functional.relu(self.mlp[li](x))
+        return self.head(x)  # (T, vocab)
+
+
+def main():
+    paddle.seed(7)
+    net = TinyDecoder()
+    rng = np.random.RandomState(0)
+    prompts = {
+        "a": rng.randint(1, 100, 6).tolist(),
+        "b": rng.randint(1, 100, 9).tolist(),
+        "c": rng.randint(1, 100, 4).tolist(),
+    }
+    logits = {s: [] for s in prompts}
+    # continuous batching: b joins at step 2, a leaves when exhausted
+    net.alloc("a")
+    net.alloc("c")
+    active = {"a": 0, "c": 0}
+    step = 0
+    while active:
+        if step == 2 and "b" in prompts and "b" not in active \
+                and not logits["b"]:
+            net.alloc("b")
+            active["b"] = 0
+        sids = sorted(active)
+        toks = [prompts[s][active[s]] for s in sids]
+        out = net.decode_token(toks, sids)
+        for bi, s in enumerate(sids):
+            logits[s].append(out.numpy()[bi])
+            active[s] += 1
+            if active[s] >= len(prompts[s]):
+                net.free(s)
+                del active[s]
+        step += 1
+    # verify against offline dense forwards
+    worst = 0.0
+    for s, toks in prompts.items():
+        ref = net.dense_forward(toks).numpy()
+        got = np.stack(logits[s])
+        worst = max(worst, float(np.abs(ref - got).max()))
+    print(f"served {len(prompts)} interleaved sequences; "
+          f"max |paged - dense| = {worst:.2e}")
+    assert worst < 1e-3
+    return worst
+
+
+if __name__ == "__main__":
+    main()

@@ -44,3 +44,10 @@ def test_ptq():
     fp_acc, q_acc = ptq_int8.main(train_steps=10, calib_batches=2)
     assert q_acc > 0.6  # quantization keeps most accuracy
     assert abs(fp_acc - q_acc) < 0.3
+
+
+def test_paged_serving():
+    import paged_serving
+
+    worst = paged_serving.main()
+    assert worst < 1e-3
