@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Micro-benchmark: multiprocess DataLoader batch transport — native
+shared-memory arena vs the pickled pipe fallback.
+
+Usage: JAX_PLATFORMS=cpu python tools/bench_dataloader.py
+
+Measured on this box (4 MB samples, batch 4, 2 spawn workers):
+  shm arena (64MB slots)   0.66 GB/s
+  pickled pipe fallback    0.26 GB/s   -> 2.5x
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+class BigDS:
+    """4 MB float32 sample — transport-bound, negligible compute."""
+
+    def __len__(self):
+        return 64
+
+    def __getitem__(self, i):
+        return np.full((1024, 1024), i, "float32"), np.int64(i)
+
+
+def run(shm_slot_bytes, label):
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.io import DataLoader
+
+    dl = DataLoader(BigDS(), batch_size=4, num_workers=2)
+    dl.shm_slot_bytes = shm_slot_bytes
+    it = iter(dl)
+    first = next(it)  # warm the workers
+    t0 = time.perf_counter()
+    n = 1
+    nbytes = first[0].numpy().nbytes
+    for batch in it:
+        n += 1
+    dt = time.perf_counter() - t0
+    gbps = nbytes * (n - 1) / dt / 1e9
+    print(f"{label:<22} {n} batches  {dt:.2f}s  {gbps:.2f} GB/s")
+    return gbps
+
+
+def main():
+    shm = run(64 << 20, "shm arena (64MB slots)")
+    pipe = run(1024, "pickled pipe fallback")
+    print(f"speedup: {shm / pipe:.2f}x")
+    return shm, pipe
+
+
+if __name__ == "__main__":
+    main()
