@@ -49,7 +49,11 @@ class Distribution:
         return self._event_shape
 
     def sample(self, shape=()):
-        raise NotImplementedError
+        # default: detached reparameterized draw — distributions with
+        # an rsample get sample() for free; discrete ones override
+        s = self.rsample(shape)
+        s.stop_gradient = True
+        return s
 
     def rsample(self, shape=()):
         raise NotImplementedError
@@ -92,11 +96,6 @@ class Normal(Distribution):
 
         return square(self.scale)
 
-    def sample(self, shape=()):
-        s = self.rsample(shape)
-        s.stop_gradient = True
-        return s
-
     def rsample(self, shape=()):
         shape = _shape_tuple(shape)
         k = next_key()
@@ -133,11 +132,6 @@ class LogNormal(Normal):
 
         return exp(super().rsample(shape))
 
-    def sample(self, shape=()):
-        s = self.rsample(shape)
-        s.stop_gradient = True
-        return s
-
     def log_prob(self, value):
         value = _as_tensor(value)
 
@@ -165,11 +159,6 @@ class Uniform(Distribution):
         self.high = _param(high)
         super().__init__(np.broadcast_shapes(
             tuple(self.low.shape), tuple(self.high.shape)))
-
-    def sample(self, shape=()):
-        s = self.rsample(shape)
-        s.stop_gradient = True
-        return s
 
     def rsample(self, shape=()):
         shape = _shape_tuple(shape)
@@ -414,11 +403,6 @@ class Exponential(Distribution):
         self.rate = _param(rate)
         super().__init__(tuple(self.rate.shape))
 
-    def sample(self, shape=()):
-        s = self.rsample(shape)
-        s.stop_gradient = True
-        return s
-
     def rsample(self, shape=()):
         shape = _shape_tuple(shape)
         k = next_key()
@@ -540,11 +524,6 @@ class Gumbel(Distribution):
 
         return apply_op("gumbel_rsample", f, self.loc, self.scale)
 
-    def sample(self, shape=()):
-        s = self.rsample(shape)
-        s.stop_gradient = True
-        return s
-
     def log_prob(self, value):
         value = _as_tensor(value)
 
@@ -577,11 +556,6 @@ class Laplace(Distribution):
             return mu + b * jax.random.laplace(k, out)
 
         return apply_op("laplace_rsample", f, self.loc, self.scale)
-
-    def sample(self, shape=()):
-        s = self.rsample(shape)
-        s.stop_gradient = True
-        return s
 
     def log_prob(self, value):
         value = _as_tensor(value)
@@ -645,11 +619,6 @@ class Cauchy(Distribution):
             return mu + g * jax.random.cauchy(k, out)
 
         return apply_op("cauchy_rsample", f, self.loc, self.scale)
-
-    def sample(self, shape=()):
-        s = self.rsample(shape)
-        s.stop_gradient = True
-        return s
 
     def log_prob(self, value):
         value = _as_tensor(value)
@@ -963,11 +932,6 @@ class MultivariateNormal(Distribution):
             return mu + jnp.einsum("...ij,...j->...i", L, eps)
 
         return apply_op("mvn_rsample", f, self.loc, self.scale_tril)
-
-    def sample(self, shape=()):
-        s = self.rsample(shape)
-        s.stop_gradient = True
-        return s
 
     def log_prob(self, value):
         value = _as_tensor(value)

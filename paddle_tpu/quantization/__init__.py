@@ -274,12 +274,12 @@ class PTQ:
         return model
 
 
-class _NS:
-    pass
+import types as _types
 
-
-quanters = _NS()
-quanters.FakeQuanterWithAbsMaxObserver = FakeQuanterWithAbsMaxObserver
-observers = _NS()
-observers.AbsMaxObserver = AbsMaxObserver
-observers.MovingAverageAbsMaxObserver = MovingAverageAbsMaxObserver
+quanters = _types.SimpleNamespace(
+    FakeQuanterWithAbsMaxObserver=FakeQuanterWithAbsMaxObserver,
+)
+observers = _types.SimpleNamespace(
+    AbsMaxObserver=AbsMaxObserver,
+    MovingAverageAbsMaxObserver=MovingAverageAbsMaxObserver,
+)
