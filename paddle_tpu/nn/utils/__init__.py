@@ -16,6 +16,8 @@ from ...framework.core import EagerParamBase, Tensor, apply_op
 from ..layer.layers import Layer
 
 __all__ = [
+    "clip_grad_norm_",
+    "clip_grad_value_",
     "weight_norm",
     "remove_weight_norm",
     "spectral_norm",
@@ -185,3 +187,47 @@ def vector_to_parameters(vec: Tensor, parameters) -> None:
         chunk = vec._data[offset:offset + n].reshape(p.shape)
         p._data = chunk.astype(p._data.dtype)
         offset += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clipping over .grad (upstream:
+    python/paddle/nn/utils/clip_grad_norm_.py). Returns the total
+    norm BEFORE clipping."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p._grad for p in parameters if p._grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros((), jnp.float32))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([
+            jnp.max(jnp.abs(g._data.astype(jnp.float32)))
+            for g in grads
+        ]))
+    else:
+        total = jnp.sum(jnp.stack([
+            jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type)
+            for g in grads
+        ])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"gradient norm is non-finite ({float(total)}); set "
+            "error_if_nonfinite=False to clip anyway"
+        )
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._data = (g._data.astype(jnp.float32) * scale).astype(
+            g._data.dtype
+        )
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place element clipping of .grad to [-clip_value, clip_value]
+    (upstream clip_grad_value_.py)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    cv = float(clip_value)
+    for p in parameters:
+        if p._grad is not None:
+            p._grad._data = jnp.clip(p._grad._data, -cv, cv)

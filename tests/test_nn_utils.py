@@ -137,3 +137,35 @@ class TestCTCLoss:
         np.testing.assert_allclose(
             layer(*args).numpy(), none.sum(), rtol=1e-6
         )
+
+
+class TestGradClipping:
+    def test_clip_grad_norm(self):
+        m = nn.Linear(4, 4)
+        (m(paddle.to_tensor(np.ones((2, 4), "float32"))) * 100) \
+            .sum().backward()
+        total = U.clip_grad_norm_(m.parameters(), max_norm=1.0)
+        assert float(total.numpy()) > 1.0
+        gn = np.sqrt(sum(
+            (p.grad.numpy() ** 2).sum()
+            for p in m.parameters() if p.grad is not None))
+        np.testing.assert_allclose(gn, 1.0, rtol=1e-4)
+
+    def test_clip_grad_value(self):
+        m = nn.Linear(4, 4)
+        (m(paddle.to_tensor(np.ones((2, 4), "float32"))) * 100) \
+            .sum().backward()
+        U.clip_grad_value_(m.parameters(), 0.01)
+        mx = max(
+            abs(p.grad.numpy()).max()
+            for p in m.parameters() if p.grad is not None)
+        assert mx <= 0.01 + 1e-9
+
+    def test_clip_norm_nonfinite_raises(self):
+        m = nn.Linear(2, 2)
+        (m(paddle.to_tensor(np.ones((1, 2), "float32")))).sum() \
+            .backward()
+        m.weight.grad._data = m.weight.grad._data * float("inf")
+        with pytest.raises(RuntimeError):
+            U.clip_grad_norm_(m.parameters(), 1.0,
+                              error_if_nonfinite=True)
