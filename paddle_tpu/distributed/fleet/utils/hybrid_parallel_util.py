@@ -1,0 +1,82 @@
+"""Hybrid-parallel gradient utilities (upstream: python/paddle/
+distributed/fleet/utils/hybrid_parallel_util.py) — the helpers
+PaddleNLP-style training loops import by name.
+
+TPU mapping: gradients computed inside a compiled step over the mesh
+are already summed across dp by GSPMD (the grad psum is part of the
+traced program), so the allreduce helpers are real ops only in the
+eager/manual path and documented no-ops under to_static.
+"""
+from __future__ import annotations
+
+from ....framework.core import Tensor, no_grad
+
+__all__ = [
+    "fused_allreduce_gradients",
+    "broadcast_input_data",
+    "broadcast_mp_parameters",
+    "broadcast_dp_parameters",
+    "broadcast_sharding_parameters",
+]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Allreduce every parameter's .grad across the data-parallel group
+    (upstream fuses into buckets; XLA's collective combiner plays that
+    role here)."""
+    from ... import env
+    from ...collective import all_reduce
+
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    world = group.nranks if group is not None else env.get_world_size()
+    if world <= 1:
+        return
+    with no_grad():
+        for p in parameter_list:
+            if p._grad is not None:
+                all_reduce(p._grad, group=group)
+                p._grad._data = (
+                    p._grad._data / world
+                ).astype(p._grad._data.dtype)
+
+
+def _broadcast_params(parameters, group):
+    from ...collective import broadcast
+
+    world = group.nranks if group is not None else 1
+    if world <= 1:
+        return
+    with no_grad():
+        for p in parameters:
+            broadcast(p, 0, group=group)
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """Model-parallel ranks consume identical inputs; under one-process
+    SPMD the same arrays are already visible to every shard, so this
+    returns the inputs unchanged (the reference broadcasts over the mp
+    comm group)."""
+    if kwargs:
+        return list(inputs) + [kwargs]
+    return inputs if len(inputs) != 1 else inputs[0]
+
+
+def broadcast_mp_parameters(model, hcg):
+    _broadcast_params(
+        model.parameters(),
+        hcg.get_model_parallel_group() if hcg else None,
+    )
+
+
+def broadcast_dp_parameters(model, hcg):
+    _broadcast_params(
+        model.parameters(),
+        hcg.get_data_parallel_group() if hcg else None,
+    )
+
+
+def broadcast_sharding_parameters(model, hcg):
+    _broadcast_params(
+        model.parameters(),
+        hcg.get_sharding_parallel_group() if hcg else None,
+    )

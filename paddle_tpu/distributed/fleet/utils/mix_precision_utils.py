@@ -1,0 +1,81 @@
+"""Mixed-precision training helpers (upstream: python/paddle/
+distributed/fleet/utils/mix_precision_utils.py): main-grad wrappers
+that keep an fp32 master gradient next to bf16/fp16 params."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....framework.core import Tensor, no_grad
+
+__all__ = ["MixPrecisionLayer", "MixPrecisionOptimizer"]
+
+
+class MixPrecisionLayer:
+    """Wraps a Layer so every backward accumulates an fp32 main_grad
+    (upstream MixPrecisionLayer). The wrapper is transparent: call it
+    like the inner layer."""
+
+    def __init__(self, layers, dtype="bfloat16"):
+        self._layers = layers
+        self._main_grads = {}
+        for p in layers.parameters():
+            if p.stop_gradient:
+                continue
+            p._grad_hooks = p._grad_hooks or []
+
+            def make_hook(param):
+                def hook(grad):
+                    mg = self._main_grads.get(param._uid)
+                    g32 = grad._data.astype(jnp.float32)
+                    self._main_grads[param._uid] = (
+                        g32 if mg is None else mg + g32
+                    )
+                    return grad
+
+                return hook
+
+            p.register_hook(make_hook(p))
+
+    def main_grad(self, param):
+        g = self._main_grads.get(param._uid)
+        return Tensor(g) if g is not None else None
+
+    def clear_main_grads(self):
+        self._main_grads.clear()
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+
+class MixPrecisionOptimizer:
+    """Steps the inner optimizer using the fp32 main grads collected by
+    MixPrecisionLayer (upstream MixPrecisionOptimizer)."""
+
+    def __init__(self, optimizer, mp_layer=None):
+        self._inner = optimizer
+        self._mp_layer = mp_layer
+
+    def step(self):
+        if self._mp_layer is not None:
+            with no_grad():
+                for p in self._inner._parameter_list:
+                    mg = self._mp_layer._main_grads.get(p._uid)
+                    if mg is not None:
+                        if p._grad is None:
+                            p._grad = Tensor(
+                                mg.astype(p._data.dtype))
+                        else:
+                            p._grad._data = mg.astype(
+                                p._grad._data.dtype)
+        return self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        if self._mp_layer is not None:
+            self._mp_layer.clear_main_grads()
+        return self._inner.clear_grad(*a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

@@ -70,3 +70,40 @@ def test_sp_ops_gspmd_identity():
         np.testing.assert_allclose(
             np.asarray(y._data), np.asarray(x._data)
         )
+
+
+def test_hybrid_parallel_util_and_mix_precision():
+    """fused_allreduce_gradients + main-grad wrappers (upstream:
+    fleet/utils/hybrid_parallel_util.py, mix_precision_utils.py)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import (
+        fused_allreduce_gradients,
+    )
+    from paddle_tpu.distributed.fleet.utils.mix_precision_utils import (
+        MixPrecisionLayer,
+        MixPrecisionOptimizer,
+    )
+
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    mp = MixPrecisionLayer(m)
+    opt = MixPrecisionOptimizer(
+        paddle.optimizer.SGD(0.1, parameters=m.parameters()), mp)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 4).astype("float32"))
+    y = paddle.to_tensor(
+        np.random.RandomState(1).randn(4, 2).astype("float32"))
+    losses = []
+    for _ in range(5):
+        loss = F.mse_loss(mp(x), y)
+        loss.backward()
+        fused_allreduce_gradients(m.parameters())
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    assert not mp._main_grads  # cleared with clear_grad
