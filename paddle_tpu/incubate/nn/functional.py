@@ -18,6 +18,7 @@ __all__ = [
     "fused_feedforward", "fused_linear", "fused_multi_head_attention",
     "fused_rotary_position_embedding", "paged_attention", "swiglu",
     "fused_rms_norm", "fused_layer_norm", "fused_matmul_bias",
+    "fused_dropout_add", "fused_bias_dropout_residual_layer_norm",
 ]
 
 fused_matmul_bias = fused_linear
@@ -103,3 +104,68 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
         return out.astype(a.dtype)
 
     return apply_op("fused_layer_norm", f, *args)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one op (upstream: incubate/nn/functional/
+    fused_dropout_add.py) — XLA fuses the mask+add epilogue."""
+    from ...framework.random import next_key
+
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+    if not training or p == 0.0:
+        return apply_op("fused_dropout_add", lambda a, b: a + b, x, y)
+    k = next_key()
+
+    def f(a, b):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            a = jnp.where(keep, a / (1.0 - p), 0.0)
+        else:
+            a = jnp.where(keep, a, 0.0)
+        return a + b
+
+    return apply_op("fused_dropout_add", f, x, y)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """layer_norm(residual + dropout(x + bias)) (upstream:
+    incubate/nn/functional/fused_transformer.py)."""
+    from ...framework.random import next_key
+
+    x = _as_tensor(x)
+    residual = _as_tensor(residual)
+    args = [x, residual]
+    for extra in (bias, ln_scale, ln_bias):
+        if extra is not None:
+            args.append(_as_tensor(extra))
+    has = (bias is not None, ln_scale is not None, ln_bias is not None)
+    k = next_key() if (training and dropout_rate > 0.0) else None
+
+    def f(a, r, *rest):
+        i = 0
+        if has[0]:
+            a = a + rest[i]
+            i += 1
+        if k is not None:
+            keep = jax.random.bernoulli(k, 1.0 - dropout_rate, a.shape)
+            if mode == "upscale_in_train":
+                a = jnp.where(keep, a / (1.0 - dropout_rate), 0.0)
+            else:
+                a = jnp.where(keep, a, 0.0)
+        out = (r + a).astype(jnp.float32)
+        mean = jnp.mean(out, -1, keepdims=True)
+        var = jnp.mean(jnp.square(out - mean), -1, keepdims=True)
+        out = (out - mean) * jax.lax.rsqrt(var + ln_epsilon)
+        if has[1]:
+            out = out * rest[i].astype(jnp.float32)
+            i += 1
+        if has[2]:
+            out = out + rest[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    return apply_op("fused_bias_dropout_residual_ln", f, *args)
