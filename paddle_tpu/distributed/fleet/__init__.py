@@ -17,6 +17,7 @@ from .fleet import (  # noqa: F401
     fleet,
     init,
     worker_index,
+    worker_num,
 )
 from .meta_parallel.parallel_layers.random import (  # noqa: F401
     get_rng_state_tracker,
@@ -27,6 +28,6 @@ __all__ = [
     "Fleet", "fleet", "init", "DistributedStrategy",
     "HybridCommunicateGroup", "CommunicateTopology", "ParallelMode",
     "get_hybrid_communicate_group", "distributed_model",
-    "distributed_optimizer", "worker_index", "meta_parallel",
+    "distributed_optimizer", "worker_index", "worker_num", "meta_parallel",
     "get_rng_state_tracker", "recompute",
 ]

@@ -98,3 +98,8 @@ distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
 worker_index = fleet.worker_index
 get_hybrid_communicate_group_fn = get_hybrid_communicate_group
+
+
+def worker_num():
+    """Module-level alias (upstream fleet.worker_num())."""
+    return fleet.worker_num
