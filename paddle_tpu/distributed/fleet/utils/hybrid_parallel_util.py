@@ -53,12 +53,9 @@ def _broadcast_params(parameters, group):
 
 def broadcast_input_data(hcg, *inputs, **kwargs):
     """Model-parallel ranks consume identical inputs; under one-process
-    SPMD the same arrays are already visible to every shard, so this
-    returns the inputs unchanged (the reference broadcasts over the mp
-    comm group)."""
-    if kwargs:
-        return list(inputs) + [kwargs]
-    return inputs if len(inputs) != 1 else inputs[0]
+    SPMD the same arrays are already visible to every shard. Upstream
+    contract: returns (inputs, kwargs)."""
+    return inputs, kwargs
 
 
 def broadcast_mp_parameters(model, hcg):

@@ -18,10 +18,10 @@ class MixPrecisionLayer:
     def __init__(self, layers, dtype="bfloat16"):
         self._layers = layers
         self._main_grads = {}
+        self._hook_handles = []
         for p in layers.parameters():
             if p.stop_gradient:
                 continue
-            p._grad_hooks = p._grad_hooks or []
 
             def make_hook(param):
                 def hook(grad):
@@ -34,7 +34,18 @@ class MixPrecisionLayer:
 
                 return hook
 
-            p.register_hook(make_hook(p))
+            # keep the removable handles: a second wrap of the same
+            # layer must not leave the old wrapper's hooks (and its
+            # grad copies) installed forever
+            self._hook_handles.append(p.register_hook(make_hook(p)))
+
+    def remove_hooks(self):
+        for h in self._hook_handles:
+            try:
+                h.remove()
+            except Exception:
+                pass
+        self._hook_handles.clear()
 
     def main_grad(self, param):
         g = self._main_grads.get(param._uid)
@@ -64,12 +75,13 @@ class MixPrecisionOptimizer:
                 for p in self._inner._parameter_list:
                     mg = self._mp_layer._main_grads.get(p._uid)
                     if mg is not None:
+                        # hand the optimizer the fp32 main grad as-is;
+                        # downcasting here would throw away exactly the
+                        # fp32 accumulation this wrapper preserves
                         if p._grad is None:
-                            p._grad = Tensor(
-                                mg.astype(p._data.dtype))
+                            p._grad = Tensor(mg)
                         else:
-                            p._grad._data = mg.astype(
-                                p._grad._data.dtype)
+                            p._grad._data = mg
         return self._inner.step()
 
     def clear_grad(self, *a, **k):

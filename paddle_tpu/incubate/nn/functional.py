@@ -106,6 +106,20 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
     return apply_op("fused_layer_norm", f, *args)
 
 
+def _apply_dropout_raw(a, key, p, training, mode):
+    """Shared dropout core (same semantics as nn.functional.dropout) so
+    the fused variants can't drift from the original — incl. the
+    downscale_in_infer inference scaling."""
+    if p == 0.0:
+        return a
+    if not training:
+        return a * (1.0 - p) if mode == "downscale_in_infer" else a
+    keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, a / (1.0 - p), 0.0)
+    return jnp.where(keep, a, 0.0)
+
+
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
                       name=None):
     """dropout(x) + y in one op (upstream: incubate/nn/functional/
@@ -114,17 +128,10 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
 
     x = _as_tensor(x)
     y = _as_tensor(y)
-    if not training or p == 0.0:
-        return apply_op("fused_dropout_add", lambda a, b: a + b, x, y)
-    k = next_key()
+    k = next_key() if (training and p > 0.0) else None
 
     def f(a, b):
-        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
-        if mode == "upscale_in_train":
-            a = jnp.where(keep, a / (1.0 - p), 0.0)
-        else:
-            a = jnp.where(keep, a, 0.0)
-        return a + b
+        return _apply_dropout_raw(a, k, p, training, mode) + b
 
     return apply_op("fused_dropout_add", f, x, y)
 
@@ -151,12 +158,7 @@ def fused_bias_dropout_residual_layer_norm(
         if has[0]:
             a = a + rest[i]
             i += 1
-        if k is not None:
-            keep = jax.random.bernoulli(k, 1.0 - dropout_rate, a.shape)
-            if mode == "upscale_in_train":
-                a = jnp.where(keep, a / (1.0 - dropout_rate), 0.0)
-            else:
-                a = jnp.where(keep, a, 0.0)
+        a = _apply_dropout_raw(a, k, dropout_rate, training, mode)
         out = (r + a).astype(jnp.float32)
         mean = jnp.mean(out, -1, keepdims=True)
         var = jnp.mean(jnp.square(out - mean), -1, keepdims=True)

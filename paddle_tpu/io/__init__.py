@@ -817,9 +817,15 @@ class SubsetRandomSampler(Sampler):
             raise ValueError("indices must not be empty")
 
     def __iter__(self):
-        import numpy as _np
-
-        order = _np.random.permutation(len(self.indices))
+        # seeded like RandomSampler: reproducible under paddle.seed and
+        # consistent across data-parallel ranks
+        seed = default_generator().initial_seed() + getattr(
+            self, "_epoch", 0
+        )
+        self._epoch = getattr(self, "_epoch", 0) + 1
+        order = np.random.RandomState(seed).permutation(
+            len(self.indices)
+        )
         return iter([self.indices[i] for i in order])
 
     def __len__(self):
