@@ -46,6 +46,14 @@ def _astype(self, dtype):
 
 def _getitem(self, idx):
     # Tensor indices become op inputs; static python indices are closed over
+    if isinstance(idx, int) and self.ndim > 0:
+        # jnp silently clamps out-of-range indices, which would make
+        # python's __getitem__-based iteration fallback loop forever
+        n = self.shape[0]
+        if idx >= n or idx < -n:
+            raise IndexError(
+                f"index {idx} out of range for axis 0 of size {n}"
+            )
     if isinstance(idx, Tensor):
         if idx._data.dtype == jnp.bool_:
             return manipulation.masked_select(self, idx)
@@ -206,6 +214,22 @@ for mod in _METHOD_MODULES:
             "paddle_tpu.tensor"
         ):
             METHODS.setdefault(name, fn)
+
+def _tensor_iter(self):
+    if self.ndim == 0:
+        raise TypeError("iteration over a 0-d tensor")
+    for i in range(self.shape[0]):
+        yield self[i]
+
+
+def _tensor_len(self):
+    if self.ndim == 0:
+        raise TypeError("len() of a 0-d tensor")
+    return self.shape[0]
+
+
+METHODS["__iter__"] = _tensor_iter
+METHODS["__len__"] = _tensor_len
 
 for name, fn in METHODS.items():
     setattr(Tensor, name, fn)

@@ -220,3 +220,22 @@ class TestRandom:
         with paddle.no_grad():
             y = x * 2
         assert y.stop_gradient
+
+
+class TestTensorProtocols:
+    def test_iteration_terminates_and_len(self):
+        """Regression: jnp clamps out-of-range indexing, so python's
+        __getitem__ iteration fallback used to loop forever."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        t = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        vals = [float(v.numpy()) for v in t]
+        assert vals == [1.0, 2.0, 3.0]
+        assert len(t) == 3
+        with pytest.raises(IndexError):
+            t[3]
+        assert float(t[-1].numpy()) == 3.0
+        with pytest.raises(TypeError):
+            iter(paddle.to_tensor(1.0)).__next__()
