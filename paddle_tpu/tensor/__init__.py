@@ -228,8 +228,28 @@ def _tensor_len(self):
     return self.shape[0]
 
 
+def _tensor_format(self, spec):
+    if self.ndim == 0 or self.size == 1:
+        return format(self.item(), spec)
+    if not spec:
+        return repr(self)
+    raise TypeError(
+        "format spec on a non-scalar Tensor is ambiguous; call "
+        ".numpy() first"
+    )
+
+
+def _tensor_contains(self, value):
+    import numpy as _np
+
+    v = value.numpy() if isinstance(value, Tensor) else value
+    return bool(_np.any(_np.asarray(self._data) == v))
+
+
 METHODS["__iter__"] = _tensor_iter
 METHODS["__len__"] = _tensor_len
+METHODS["__format__"] = _tensor_format
+METHODS["__contains__"] = _tensor_contains
 
 for name, fn in METHODS.items():
     setattr(Tensor, name, fn)
