@@ -38,6 +38,21 @@ class ParallelEnv:
     def dev_id(self):
         return 0
 
+    @property
+    def current_endpoint(self):
+        import os as _os
+
+        return _os.environ.get(
+            "PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170"
+        )
+
+    @property
+    def trainer_endpoints(self):
+        import os as _os
+
+        eps = _os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]
+
 
 _initialized = False
 _world_size = 1
