@@ -195,3 +195,15 @@ def block_diag(inputs, name=None):
         return out
 
     return _apply("block_diag", f, *ts)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    """paddle.logspace (upstream creation.py)."""
+    from ..framework.dtype import to_np_dtype
+
+    d = to_np_dtype(dtype) if dtype is not None else jnp.float32
+    out = jnp.logspace(
+        float(start), float(stop), int(num), base=float(base),
+        dtype=jnp.float32,
+    )
+    return Tensor(out.astype(d))

@@ -622,3 +622,73 @@ def multiply_(x, y, name=None):
 
 def remainder_(x, y, name=None):
     return _inplace(x, mod(x, y))
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (upstream multiplex):
+    out[i] = inputs[index[i]][i]."""
+    ts = [_as_tensor(t) for t in inputs]
+    index = _as_tensor(index)
+
+    def f(idx, *arrs):
+        stacked = jnp.stack(arrs, axis=0)  # (K, N, ...)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1).astype(jnp.int32), rows]
+
+    return apply_op("multiplex", f, index, *ts)
+
+
+def sgn(x, name=None):
+    """sign for real; x/|x| for complex (upstream sgn)."""
+    x = _as_tensor(x)
+
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.maximum(mag, 1e-30))
+        return jnp.sign(a)
+
+    return apply_op("sgn", f, x)
+
+
+def polar(abs, angle, name=None):
+    """Complex from magnitude and phase (upstream polar)."""
+    abs = _as_tensor(abs)
+    angle = _as_tensor(angle)
+    return apply_op(
+        "polar",
+        lambda r, t: (r * jnp.cos(t) + 1j * r * jnp.sin(t)).astype(
+            jnp.complex64
+        ),
+        abs, angle,
+    )
+
+
+gammainc = _binary(
+    "gammainc", lambda a, x: _jss.gammainc(a, x)
+)
+gammaincc = _binary(
+    "gammaincc", lambda a, x: _jss.gammaincc(a, x)
+)
+igamma = gammainc
+igammac = gammaincc
+
+
+def trunc_(x, name=None):
+    return _inplace(x, trunc(x))
+
+
+def frac_(x, name=None):
+    return _inplace(x, frac(x))
+
+
+def tril_(x, diagonal=0, name=None):
+    from .creation import tril
+
+    return _inplace(x, tril(x, diagonal))
+
+
+def masked_fill_(x, mask, value, name=None):
+    from .search import masked_fill
+
+    return _inplace(x, masked_fill(x, mask, value))
