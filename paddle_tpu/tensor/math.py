@@ -689,6 +689,6 @@ def tril_(x, diagonal=0, name=None):
 
 
 def masked_fill_(x, mask, value, name=None):
-    from .search import masked_fill
+    from .manipulation import masked_fill
 
     return _inplace(x, masked_fill(x, mask, value))
