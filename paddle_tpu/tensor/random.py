@@ -142,3 +142,51 @@ def normal_(x, mean=0.0, std=1.0, name=None):
         jax.random.normal(k, tuple(x.shape), x._data.dtype) * std + mean
     )
     return x
+
+
+def binomial(count, prob, name=None):
+    """Elementwise binomial draws (upstream paddle.binomial)."""
+    from ..framework.random import next_key
+
+    count = _as_tensor(count)
+    prob = _as_tensor(prob)
+    k = next_key()
+
+    def f(n, p):
+        if hasattr(jax.random, "binomial"):
+            return jax.random.binomial(
+                k, n.astype(jnp.float32), p
+            ).astype(jnp.int64)
+        mean = n * p
+        std = jnp.sqrt(n * p * (1 - p))
+        g = jax.random.normal(k, jnp.broadcast_shapes(n.shape, p.shape))
+        return jnp.clip(jnp.round(mean + std * g), 0, n).astype(
+            jnp.int64
+        )
+
+    return apply_op("binomial", f, count, prob, differentiable=False)
+
+
+def standard_gamma(x, name=None):
+    """Gamma(alpha=x, scale=1) draws (upstream standard_gamma)."""
+    from ..framework.random import next_key
+
+    x = _as_tensor(x)
+    k = next_key()
+    return apply_op(
+        "standard_gamma",
+        lambda a: jax.random.gamma(k, a.astype(jnp.float32)),
+        x, differentiable=False,
+    )
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """Log-normal draws (upstream log_normal)."""
+    from ..framework.random import next_key
+
+    k = next_key()
+    shp = tuple(int(s) for s in (shape or [1]))
+    out = jnp.exp(
+        float(mean) + float(std) * jax.random.normal(k, shp)
+    )
+    return Tensor(out)
