@@ -97,6 +97,9 @@ from .tensor.random import (
     bernoulli,
     multinomial,
     poisson,
+    binomial,
+    standard_gamma,
+    log_normal,
     rand_like,
     randn_like,
 )
