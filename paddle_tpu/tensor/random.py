@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.core import Tensor, _as_tensor
+from ..framework.core import Tensor, _as_tensor, apply_op
 from ..framework.dtype import to_np_dtype
 from ..framework.random import next_key
 from .creation import _shape
