@@ -146,6 +146,19 @@ def _is_float0(x):
     return hasattr(x, "dtype") and x.dtype == jax.dtypes.float0
 
 
+def concrete_value(data):
+    """np.ndarray view of `data` when it holds concrete values, None
+    under tracing — for host-side reference-parity validation checks
+    that must not break `jit`/`to_static`."""
+    import numpy as np
+
+    try:
+        return np.asarray(data)
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        return None
+
+
 # --------------------------------------------------------------------------
 # Tensor
 # --------------------------------------------------------------------------

@@ -84,3 +84,6 @@ define_flag("flash_precision_highest", False,
             "force fp32-emulated (multi-pass) MXU multiplies in the "
             "Pallas flash-attention kernels; default uses native bf16 "
             "single-pass with fp32 accumulation")
+define_flag("flash_pallas_interpret", False,
+            "run the Pallas flash-attention kernels in interpret mode "
+            "off-TPU (CI coverage of the kernel path on CPU)")

@@ -442,6 +442,22 @@ def class_center_sample(label, num_classes, num_samples, group=None,
     from ...framework.random import next_key
 
     label = _as_tensor(label)
+    # the reference guarantees every positive class is retained; with
+    # more distinct positives than num_samples that is impossible, and
+    # the remap table would silently alias — error out (host-side
+    # check; skipped under tracing where values are abstract)
+    import numpy as _np
+
+    from ...framework.core import concrete_value
+
+    y_np = concrete_value(label._data)
+    n_pos = None if y_np is None else int(_np.unique(y_np).size)
+    if n_pos is not None and n_pos > num_samples:
+        raise ValueError(
+            f"class_center_sample: {n_pos} distinct positive classes "
+            f"exceed num_samples={num_samples}; positives must all be "
+            "retained (reference guarantee)"
+        )
     k = next_key()
 
     def f(y):

@@ -111,7 +111,8 @@ def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
         )
 
 
-def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
+def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                      interpret=False):
     """q: (BH, Sq, D); k/v: (BHkv, Sk, D). Returns (out, lse)."""
     bh, sq, d = q.shape
     bhkv, sk, _ = k.shape
@@ -124,22 +125,18 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
     kernel = functools.partial(
         _flash_fwd_kernel, scale, causal, sk - sq, block_q, block_k, nk
     )
-    try:
-        from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental.pallas import tpu as pltpu
 
-        params = dict(
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel", "arbitrary")
-            )
+    params = dict(interpret=True) if interpret else dict(
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
         )
-        scratch = [
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, _LANE), jnp.float32),
-            pltpu.VMEM((block_q, _LANE), jnp.float32),
-        ]
-    except Exception:  # pragma: no cover
-        params = {}
-        scratch = []
+    )
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, _LANE), jnp.float32),
+        pltpu.VMEM((block_q, _LANE), jnp.float32),
+    ]
 
     out, lse = pl.pallas_call(
         kernel,
@@ -306,7 +303,7 @@ def _flash_bwd_dq_kernel(scale, causal, offset, block_q, block_k, nk,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale,
-                      block_q, block_k, dlse=None):
+                      block_q, block_k, dlse=None, interpret=False):
     """Pallas dq/dk/dv. q/do: (BH, Sq, D); k/v: (BHkv, Sk, D);
     lse: (BH, Sq) fp32. Returns (dq, dk, dv) in input dtypes."""
     from jax.experimental.pallas import tpu as pltpu
@@ -354,11 +351,13 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(
-                "parallel", "parallel", "arbitrary", "arbitrary"
+        **(dict(interpret=True) if interpret else dict(
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=(
+                    "parallel", "parallel", "arbitrary", "arbitrary"
+                )
             )
-        ),
+        )),
     )(q, do, lse8, delta8, k, v)
 
     qspec2 = pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0))
@@ -376,9 +375,11 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale,
         out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
-        ),
+        **(dict(interpret=True) if interpret else dict(
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            )
+        )),
     )(q, do, lse8, delta8, k, v)
     return dq, dk, dv
 
@@ -447,6 +448,16 @@ def _flash_bwd_chunked(q, k, v, out, lse, do, causal, scale, block_k,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _interpret():
+    """True when the Pallas flash path should run in interpret mode
+    (CI coverage on CPU via FLAGS_flash_pallas_interpret)."""
+    from . import on_tpu
+
+    from ...framework.flags import flag
+
+    return (not on_tpu()) and flag("flash_pallas_interpret")
+
+
 def _pallas_ok(q, k, block_q, block_k):
     from . import use_pallas
 
@@ -458,7 +469,7 @@ def _pallas_ok(q, k, block_q, block_k):
     # matmuls run at 128/d of their useful FLOPs — still far better
     # than the O(S^2)-memory XLA fallback at training lengths.
     return (
-        use_pallas()
+        (use_pallas() or _interpret())
         and sq % min(block_q, sq) == 0
         and sk % min(block_k, sk) == 0
         and sq >= 8 and sk >= 8
@@ -489,7 +500,7 @@ def _flash_bwd_dispatch(q, k, v, out, lse, do, causal, scale,
         kp, vp = _pad_head_dim((k, v), d)
         dq, dk, dv = _flash_bwd_pallas(
             qp, kp, vp, outp, lse, dop, causal, scale, block_q, block_k,
-            dlse=dlse,
+            dlse=dlse, interpret=_interpret(),
         )
         if dq.shape[-1] != d:
             dq, dk, dv = dq[..., :d], dk[..., :d], dv[..., :d]
@@ -515,7 +526,8 @@ def _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k):
         (qp,) = _pad_head_dim((q,), d)
         kp, vp = _pad_head_dim((k, v), d)
         out, lse = _flash_fwd_pallas(
-            qp, kp, vp, causal, scale, block_q, block_k
+            qp, kp, vp, causal, scale, block_q, block_k,
+            interpret=_interpret(),
         )
         if out.shape[-1] != d:
             out = out[..., :d]
@@ -591,7 +603,10 @@ def flash_attention_with_lse(q, k, v, causal=False, sm_scale=None,
     q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     k3 = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
     v3 = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
-    out, lse = _flash_fwd_dispatch(
+    # _flash_core_lse (not the raw dispatch): differentiating the public
+    # API must hit the custom VJP — autodiff straight through pallas_call
+    # would fail on TPU.
+    out, lse = _flash_core_lse(
         q3, k3, v3, bool(causal), float(scale), int(block_q), int(block_k)
     )
     return (

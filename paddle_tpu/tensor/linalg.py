@@ -208,7 +208,9 @@ def matrix_rank(x, tol=None, hermitian=False, name=None):
 def lu(x, pivot=True, get_infos=False, name=None):
     x = _as_tensor(x)
     lu_, piv = jax.scipy.linalg.lu_factor(np_or_jax(x._data))
-    outs = (Tensor(lu_), Tensor(piv.astype(jnp.int32)))
+    # reference returns 1-based LAPACK pivots (paddle/phi/kernels/
+    # impl/lu_kernel_impl.h); jax.scipy gives 0-based
+    outs = (Tensor(lu_), Tensor((piv + 1).astype(jnp.int32)))
     if get_infos:
         return outs + (Tensor(jnp.zeros((), jnp.int32)),)
     return outs
@@ -465,7 +467,8 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
             jnp.arange(m, dtype=jnp.int32), batch + (m,)
         )
         for i in range(piv.shape[-1]):
-            j = piv[..., i:i + 1].astype(jnp.int32)  # (..., 1)
+            # pivots are 1-based (LAPACK convention, matching lu())
+            j = piv[..., i:i + 1].astype(jnp.int32) - 1  # (..., 1)
             idx_i = jnp.full(batch + (1,), i, jnp.int32)
             pi = jnp.take_along_axis(perm, idx_i, axis=-1)
             pj = jnp.take_along_axis(perm, j, axis=-1)
@@ -499,9 +502,12 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
     x = _as_tensor(x)
     rank = int(q)
 
+    from ..framework.random import next_key
+
+    key = next_key()
+
     def core(a):
         m, n = a.shape[-2], a.shape[-1]
-        key = jax.random.PRNGKey(0)
         omega = jax.random.normal(key, a.shape[:-2] + (n, rank), a.dtype)
         y = a @ omega
         for _ in range(int(niter)):

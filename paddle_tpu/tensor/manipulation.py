@@ -568,6 +568,21 @@ def masked_scatter(x, mask, value, name=None):
     x = _as_tensor(x)
     mask = _as_tensor(mask)
     value = _as_tensor(value)
+    # reference kernel errors when value has fewer elements than True
+    # positions; the cumsum-gather below would silently reuse the last
+    # value (host-side check; skipped under tracing)
+    from ..framework.core import concrete_value
+
+    m_np = concrete_value(mask._data)
+    n_true = (
+        None if m_np is None
+        else int(np.broadcast_to(m_np, tuple(x.shape)).sum())
+    )
+    if n_true is not None and int(value._data.size) < n_true:
+        raise ValueError(
+            f"masked_scatter: value has {int(value._data.size)} "
+            f"elements but mask selects {n_true} positions"
+        )
 
     def f(a, m, v):
         m_b = jnp.broadcast_to(m, a.shape).reshape(-1)

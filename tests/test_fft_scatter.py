@@ -81,6 +81,25 @@ class TestScatterFamily:
         )
         np.testing.assert_array_equal(vals.grad.numpy(), [1, 1])
 
+    def test_masked_scatter_too_few_values_raises(self):
+        # reference kernel errors instead of reusing the last value
+        import pytest
+
+        x = _t(np.zeros((2, 3), "float32"))
+        mask = _t(np.ones((2, 3), bool))
+        vals = _t(np.array([1.0, 2.0], "float32"))
+        with pytest.raises(ValueError, match="masked_scatter"):
+            paddle.masked_scatter(x, mask, vals)
+
+    def test_class_center_sample_overflow_raises(self):
+        import pytest
+
+        import paddle_tpu.nn.functional as F
+
+        label = _t(np.arange(8, dtype="int64"))
+        with pytest.raises(ValueError, match="class_center_sample"):
+            F.class_center_sample(label, num_classes=16, num_samples=4)
+
     def test_diagonal_scatter_offsets(self):
         base = np.zeros((3, 4), "float32")
         for off in (-1, 0, 1):

@@ -1,0 +1,191 @@
+"""Interpret-mode CI for the flash-attention Pallas kernels.
+
+The reference's flash kernels (upstream:
+paddle/phi/kernels/gpu/flash_attn_kernel.cu) are exercised by OpTests on
+real devices; here the TPU Pallas fwd/bwd kernels run in Pallas interpret
+mode on CPU against the XLA reference / autodiff ground truth, so a broken
+index map or accumulator fails the suite without a chip (VERDICT r2 #2).
+"""
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+fa = importlib.import_module("paddle_tpu.ops.kernels.flash_attention")
+
+
+def _mk(bh=4, sq=256, sk=256, d=128, bhkv=None, dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    bhkv = bh if bhkv is None else bhkv
+    q = jnp.asarray(rng.randn(bh, sq, d), dtype) * 0.5
+    k = jnp.asarray(rng.randn(bhkv, sk, d), dtype) * 0.5
+    v = jnp.asarray(rng.randn(bhkv, sk, d), dtype) * 0.5
+    return q, k, v
+
+
+def _ref_with_grads(q, k, v, causal, scale, do, dlse=None):
+    """fp32 autodiff ground truth through the dense reference."""
+
+    def f(q, k, v):
+        out, lse = fa._flash_fwd_ref(q, k, v, causal, scale)
+        loss = jnp.vdot(out.astype(jnp.float32), do.astype(jnp.float32))
+        if dlse is not None:
+            loss = loss + jnp.vdot(lse, dlse)
+        return loss
+
+    return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+
+SCALE = 0.125
+
+
+class TestFlashFwdPallasInterpret:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_fwd_matches_reference(self, causal):
+        q, k, v = _mk()
+        out, lse = fa._flash_fwd_pallas(
+            q, k, v, causal, SCALE, 128, 128, interpret=True)
+        ref_out, ref_lse = fa._flash_fwd_ref(q, k, v, causal, SCALE)
+        np.testing.assert_allclose(out, ref_out, atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(lse, ref_lse, atol=2e-5, rtol=2e-5)
+
+    def test_fwd_gqa_groups(self):
+        q, k, v = _mk(bh=8, bhkv=2)
+        out, lse = fa._flash_fwd_pallas(
+            q, k, v, True, SCALE, 128, 128, interpret=True)
+        ref_out, ref_lse = fa._flash_fwd_ref(q, k, v, True, SCALE)
+        np.testing.assert_allclose(out, ref_out, atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(lse, ref_lse, atol=2e-5, rtol=2e-5)
+
+    def test_fwd_rectangular_causal_offset(self):
+        # causal with Sq < Sk: the mask is offset by sk-sq (decode-style
+        # suffix alignment, matching the reference's convention)
+        q, k, v = _mk(sq=128, sk=384)
+        out, lse = fa._flash_fwd_pallas(
+            q, k, v, True, SCALE, 128, 128, interpret=True)
+        ref_out, ref_lse = fa._flash_fwd_ref(q, k, v, True, SCALE)
+        np.testing.assert_allclose(out, ref_out, atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(lse, ref_lse, atol=2e-5, rtol=2e-5)
+
+    def test_fwd_causal_sq_gt_sk_fully_masked_rows_zero(self):
+        # Sq > Sk: rows q_idx < sq-sk attend to nothing. The kernel
+        # outputs exact zeros there (flash-attn convention); the dense
+        # reference's finite NEG_INF yields a uniform-softmax artifact,
+        # so only the well-defined suffix is compared.
+        sq, sk = 384, 128
+        q, k, v = _mk(sq=sq, sk=sk)
+        out, _ = fa._flash_fwd_pallas(
+            q, k, v, True, SCALE, 128, 128, interpret=True)
+        ref_out, _ = fa._flash_fwd_ref(q, k, v, True, SCALE)
+        cut = sq - sk
+        np.testing.assert_allclose(
+            out[:, cut:], ref_out[:, cut:], atol=2e-5, rtol=2e-5)
+        assert np.all(np.asarray(out[:, :cut]) == 0.0)
+
+    def test_fwd_bf16(self):
+        q, k, v = _mk(dtype=jnp.bfloat16)
+        out, _ = fa._flash_fwd_pallas(
+            q, k, v, True, SCALE, 128, 128, interpret=True)
+        ref_out, _ = fa._flash_fwd_ref(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), True, SCALE)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), ref_out, atol=3e-2, rtol=3e-2)
+
+
+class TestFlashBwdPallasInterpret:
+    def _run(self, q, k, v, causal, dlse=None, block=128):
+        out, lse = fa._flash_fwd_ref(q, k, v, causal, SCALE)
+        rng = np.random.RandomState(7)
+        do = jnp.asarray(rng.randn(*out.shape), q.dtype) * 0.5
+        dq, dk, dv = fa._flash_bwd_pallas(
+            q, k, v, out, lse, do, causal, SCALE, block, block,
+            dlse=dlse, interpret=True)
+        rq, rk, rv = _ref_with_grads(q, k, v, causal, SCALE, do, dlse=dlse)
+        np.testing.assert_allclose(dq, rq, atol=5e-5, rtol=5e-5)
+        np.testing.assert_allclose(dk, rk, atol=5e-5, rtol=5e-5)
+        np.testing.assert_allclose(dv, rv, atol=5e-5, rtol=5e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_bwd_matches_autodiff(self, causal):
+        q, k, v = _mk(bh=2)
+        self._run(q, k, v, causal)
+
+    def test_bwd_gqa_groups(self):
+        # dk/dv kernel must sum over the group axis (grid dim 2)
+        q, k, v = _mk(bh=8, bhkv=2)
+        self._run(q, k, v, True)
+
+    def test_bwd_rectangular(self):
+        q, k, v = _mk(bh=2, sq=128, sk=384)
+        self._run(q, k, v, True)
+
+    def test_bwd_dlse_cotangent(self):
+        # lse carries a real cotangent in the ring-attention combine
+        q, k, v = _mk(bh=2)
+        rng = np.random.RandomState(11)
+        dlse = jnp.asarray(rng.randn(2, 256), jnp.float32) * 0.1
+        self._run(q, k, v, True, dlse=dlse)
+
+
+class TestFlashDispatchInterpret:
+    """Public API e2e through the Pallas path via
+    FLAGS_flash_pallas_interpret (the CI stand-in for on_tpu)."""
+
+    @pytest.fixture()
+    def interp_flag(self):
+        paddle.set_flags({"FLAGS_flash_pallas_interpret": True})
+        from paddle_tpu.ops.kernels import kernel_dispatch_stats
+
+        kernel_dispatch_stats(reset=True)
+        yield
+        paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+
+    def test_public_api_takes_pallas_and_matches_fallback(self, interp_flag):
+        from paddle_tpu.ops.kernels import kernel_dispatch_stats
+
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 256, 4, 64).astype("float32") * 0.5
+        qkv = [jnp.asarray(x + i) for i in range(3)]
+
+        def loss(q, k, v):
+            o = fa.flash_attention(q, k, v, causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        g_pallas = jax.grad(loss, argnums=(0, 1, 2))(*qkv)
+        stats = kernel_dispatch_stats(reset=True)
+        assert stats.get("flash_fwd:pallas", 0) >= 1, stats
+        assert stats.get("flash_bwd:pallas", 0) >= 1, stats
+
+        paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+        g_ref = jax.grad(loss, argnums=(0, 1, 2))(*qkv)
+        for gp, gr in zip(g_pallas, g_ref):
+            np.testing.assert_allclose(gp, gr, atol=5e-4, rtol=5e-4)
+
+    def test_with_lse_differentiable_through_custom_vjp(self, interp_flag):
+        # flash_attention_with_lse must route through _flash_core_lse:
+        # grad w.r.t. BOTH outputs, via the Pallas kernels
+        from paddle_tpu.ops.kernels import kernel_dispatch_stats
+
+        rng = np.random.RandomState(5)
+        q = jnp.asarray(rng.randn(1, 256, 2, 64).astype("float32"))
+        k = jnp.asarray(rng.randn(1, 256, 2, 64).astype("float32"))
+        v = jnp.asarray(rng.randn(1, 256, 2, 64).astype("float32"))
+
+        def loss(q, k, v):
+            o, lse = fa.flash_attention_with_lse(q, k, v, causal=True)
+            return jnp.sum(o ** 2) + jnp.sum(lse * 0.1)
+
+        g_pallas = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        stats = kernel_dispatch_stats(reset=True)
+        assert stats.get("flash_bwd:pallas", 0) >= 1, stats
+
+        paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+        g_ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for gp, gr in zip(g_pallas, g_ref):
+            np.testing.assert_allclose(gp, gr, atol=5e-4, rtol=5e-4)

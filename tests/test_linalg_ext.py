@@ -85,6 +85,18 @@ class TestDecompositions:
             P.numpy() @ Lm.numpy() @ U.numpy(), a, atol=1e-3
         )
 
+    def test_lu_pivots_match_torch_1based(self):
+        # reference convention: 1-based LAPACK pivots (ADVICE r2)
+        import torch
+
+        a = _spd(5, seed=9)
+        _, piv = L.lu(paddle.to_tensor(a))
+        _, tpiv = torch.linalg.lu_factor(torch.tensor(a))
+        np.testing.assert_array_equal(
+            piv.numpy(), tpiv.numpy().astype("int32")
+        )
+        assert piv.numpy().min() >= 1
+
     def test_svd_lowrank_reconstructs_lowrank(self):
         rng = np.random.RandomState(4)
         base = rng.randn(10, 3).astype("float32")
@@ -92,6 +104,22 @@ class TestDecompositions:
         u, s, v = L.svd_lowrank(paddle.to_tensor(a), q=3, niter=4)
         rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
         np.testing.assert_allclose(rec, a, atol=1e-2)
+
+    def test_svd_lowrank_uses_framework_rng(self):
+        # draws from the framework generator: paddle.seed reproduces,
+        # successive calls differ (ADVICE r2)
+        rng = np.random.RandomState(5)
+        a = paddle.to_tensor(
+            (rng.randn(12, 4) @ rng.randn(4, 9)).astype("float32")
+        )
+        paddle.seed(77)
+        u1, s1, _ = L.svd_lowrank(a, q=3)
+        u2, _, _ = L.svd_lowrank(a, q=3)
+        paddle.seed(77)
+        u3, s3, _ = L.svd_lowrank(a, q=3)
+        np.testing.assert_allclose(u1.numpy(), u3.numpy(), atol=1e-6)
+        np.testing.assert_allclose(s1.numpy(), s3.numpy(), atol=1e-6)
+        assert not np.allclose(u1.numpy(), u2.numpy())
 
     def test_householder_product_orthonormal(self):
         from jax._src.lax import linalg as lxl
