@@ -37,30 +37,48 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     value with ``cu_seqlens_k``. Tokens never attend across sequence
     boundaries; ``causal`` masks within each sequence.
 
-    TPU note: implemented as segment-masked attention in XLA (static
-    shapes; the segment mask is how ragged batching becomes
-    compiler-friendly on TPU). The blocked-ragged Pallas kernel is the
-    planned fast path for long packed batches.
+    TPU note: the fast path is the blocked-ragged Pallas kernel
+    (ops/kernels/flash_varlen.py) — segment metadata rides the scalar
+    prefetch channel so fully-masked (cross-sequence / above-diagonal)
+    tiles are skipped, costing ~O(sum_i s_i^2) instead of O(T^2). The
+    segment-masked XLA path below remains the oracle and the fallback
+    for non-tileable shapes.
     """
     query, key, value = _as_tensor(query), _as_tensor(key), _as_tensor(value)
     cu_q = _as_tensor(cu_seqlens_q)
     cu_k = _as_tensor(cu_seqlens_k)
 
+    from ...ops.kernels import record_dispatch
+    from ...ops.kernels.flash_varlen import varlen_attention, varlen_ok
+
+    tq = int(query.shape[0])
+    tk = int(key.shape[0])
+    ok = dropout == 0.0 and varlen_ok(tq, tk, 512, 512)
+    record_dispatch("flash_varlen", ok)
+    if ok:
+        d = int(query.shape[-1])
+        sc = scale if scale is not None else 1.0 / math.sqrt(d)
+
+        out = apply_op(
+            "flash_attn_unpadded",
+            lambda q, k, v, cq, ck: varlen_attention(
+                q, k, v, cq, ck, causal, sc
+            ),
+            query, key, value, cu_q, cu_k,
+        )
+        return out, None
+
     def f(q, k, v, cu_q, cu_k):
+        from ...ops.kernels.flash_varlen import _segments
+
         tq, h, d = q.shape
         tk, hkv, _ = k.shape
         if hkv != h:
             k = jnp.repeat(k, h // hkv, axis=1)
             v = jnp.repeat(v, h // hkv, axis=1)
         sc = scale if scale is not None else 1.0 / math.sqrt(d)
-        cu_q = cu_q.astype(jnp.int32)
-        cu_k = cu_k.astype(jnp.int32)
-        pos_q = jnp.arange(tq, dtype=jnp.int32)
-        pos_k = jnp.arange(tk, dtype=jnp.int32)
-        seg_q = jnp.searchsorted(cu_q[1:], pos_q, side="right")
-        seg_k = jnp.searchsorted(cu_k[1:], pos_k, side="right")
-        loc_q = pos_q - cu_q[seg_q]
-        loc_k = pos_k - cu_k[seg_k]
+        seg_q, loc_q = _segments(cu_q, tq)
+        seg_k, loc_k = _segments(cu_k, tk)
         mask = seg_q[:, None] == seg_k[None, :]
         if causal:
             mask = mask & (loc_q[:, None] >= loc_k[None, :])

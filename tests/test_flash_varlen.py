@@ -44,6 +44,130 @@ def test_unpadded_matches_per_sequence(causal):
         off += n
 
 
+class TestVarlenPallasInterpret:
+    """Blocked-ragged Pallas kernel (interpret mode) vs the segment-
+    masked XLA oracle (VERDICT r2 #3)."""
+
+    def _case(self, lens, h=4, hkv=None, d=64, dtype="float32", seed=0):
+        rng = np.random.RandomState(seed)
+        hkv = h if hkv is None else hkv
+        t = sum(lens)
+        q = (rng.randn(t, h, d) * 0.5).astype(dtype)
+        k = (rng.randn(t, hkv, d) * 0.5).astype(dtype)
+        v = (rng.randn(t, hkv, d) * 0.5).astype(dtype)
+        return q, k, v, _cu(lens)
+
+    def _compare(self, lens, causal, h=4, hkv=None, d=64, block=64,
+                 atol=5e-5, seed=0):
+        import importlib
+
+        import jax.numpy as jnp
+
+        fv = importlib.import_module(
+            "paddle_tpu.ops.kernels.flash_varlen")
+        q, k, v, cu = self._case(lens, h=h, hkv=hkv, d=d, seed=seed)
+
+        paddle.set_flags({"FLAGS_flash_pallas_interpret": True})
+        try:
+            got = fv.varlen_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                jnp.asarray(cu), jnp.asarray(cu), causal,
+                1.0 / np.sqrt(d), block_q=block, block_k=block,
+            )
+        finally:
+            paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+
+        ref, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), paddle.to_tensor(cu),
+            paddle.to_tensor(cu), max(lens), max(lens), causal=causal,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), ref.numpy(), atol=atol, rtol=atol)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_oracle_multiblock(self, causal):
+        # 512 packed tokens, block 64 -> 8x8 tiles; ragged boundaries
+        # cross tile edges
+        self._compare([100, 260, 152], causal)
+
+    def test_gqa_groups(self):
+        self._compare([130, 126], True, h=8, hkv=2)
+
+    def test_block_aligned_boundaries(self):
+        # sequence boundaries exactly on tile edges (skip logic edge)
+        self._compare([64, 128, 64], True)
+
+    def test_single_long_sequence(self):
+        # degenerate packing: one sequence == dense causal attention
+        self._compare([256], True)
+
+    def test_many_tiny_sequences(self):
+        self._compare([8] * 32, True)
+
+    def test_grad_matches_oracle(self):
+        import importlib
+
+        import jax
+        import jax.numpy as jnp
+
+        fv = importlib.import_module(
+            "paddle_tpu.ops.kernels.flash_varlen")
+        lens = [100, 156]
+        d = 64
+        q, k, v, cu = self._case(lens, d=d, seed=3)
+        rng = np.random.RandomState(9)
+        do = (rng.randn(*q.shape) * 0.5).astype("float32")
+
+        def loss_kernel(q, k, v):
+            o = fv.varlen_attention(
+                q, k, v, jnp.asarray(cu), jnp.asarray(cu), True,
+                1.0 / np.sqrt(d), block_q=64, block_k=64)
+            return jnp.vdot(o, jnp.asarray(do))
+
+        paddle.set_flags({"FLAGS_flash_pallas_interpret": True})
+        try:
+            gq, gk, gv = jax.grad(loss_kernel, argnums=(0, 1, 2))(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        finally:
+            paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+
+        # oracle grads through the public masked path
+        qt = paddle.to_tensor(q, stop_gradient=False)
+        kt = paddle.to_tensor(k, stop_gradient=False)
+        vt = paddle.to_tensor(v, stop_gradient=False)
+        out, _ = F.flash_attn_unpadded(
+            qt, kt, vt, paddle.to_tensor(cu), paddle.to_tensor(cu),
+            max(lens), max(lens), causal=True)
+        (out * paddle.to_tensor(do)).sum().backward()
+        np.testing.assert_allclose(
+            np.asarray(gq), qt.grad.numpy(), atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(gk), kt.grad.numpy(), atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(gv), vt.grad.numpy(), atol=1e-4, rtol=1e-4)
+
+    def test_public_dispatch_takes_kernel(self):
+        from paddle_tpu.ops.kernels import kernel_dispatch_stats
+
+        lens = [200, 312]  # total 512 — tileable
+        q, k, v, cu = self._case(lens)
+        paddle.set_flags({"FLAGS_flash_pallas_interpret": True})
+        kernel_dispatch_stats(reset=True)
+        try:
+            qt = paddle.to_tensor(q, stop_gradient=False)
+            out, _ = F.flash_attn_unpadded(
+                qt, paddle.to_tensor(k), paddle.to_tensor(v),
+                paddle.to_tensor(cu), paddle.to_tensor(cu),
+                max(lens), max(lens), causal=True)
+            out.sum().backward()
+            stats = kernel_dispatch_stats(reset=True)
+            assert stats.get("flash_varlen:pallas", 0) >= 1, stats
+            assert np.isfinite(qt.grad.numpy()).all()
+        finally:
+            paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+
+
 def test_unpadded_gqa_and_grad():
     rng = np.random.RandomState(1)
     lens = [4, 6]
