@@ -305,8 +305,8 @@ def bench_varlen(steps=20, total=8192, h=16, d=128):
         total, h, steps = 512, 2, 2
         lens = [256, 128, 64, 64]
     else:
-        lens = [2048, 1536, 1024, 1024, 512, 512, 512, 512,
-                256, 256, 64, 32, 16, 8, 8, 8]
+        lens = [2048, 1536, 1024, 512, 512, 512, 512,
+                256, 256, 64, 32, 16, 8, 8, 8]  # sum 7304
         lens += [8] * ((total - sum(lens)) // 8)
     assert sum(lens) == total, sum(lens)
     cu = jnp.asarray(
@@ -364,6 +364,89 @@ def bench_varlen(steps=20, total=8192, h=16, d=128):
         "masked_ms": round(1000 * t_masked, 2),
         "speedup": round(t_masked / t_kernel, 2),
         "kernel_tflops": round(flops / t_kernel / 1e12, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# aux: serving decode throughput — paged kernel vs dense-cache attention
+# ---------------------------------------------------------------------------
+
+
+def bench_decode(steps=64, ctx=1024, h=16, d=128):
+    """Decode-attention tokens/sec: the Pallas paged kernel (ragged
+    page table) vs a dense padded KV cache, across page_size {16,64}
+    and batch {1,8,32} (VERDICT r2 #4)."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.kernels.paged_attention import (
+        paged_attention as paged_kernel,
+    )
+
+    kind = _device_kind()
+    cpu = kind.startswith("cpu")
+    page_sizes = (16,) if cpu else (16, 64)
+    batches = (1, 2) if cpu else (1, 8, 32)
+    if cpu:
+        ctx, h, steps = 64, 2, 4
+    dt = jnp.float32 if cpu else jnp.bfloat16
+    scale = 1.0 / math.sqrt(d)
+    rng = np.random.RandomState(0)
+    grid = {}
+    for b in batches:
+        lens = np.linspace(ctx // 2, ctx, b).astype(np.int32)
+        q = jnp.asarray(rng.randn(b, h, d) * 0.5, dt)
+        # dense-cache baseline: (B, ctx, H, D) padded KV + length mask
+        kd = jnp.asarray(rng.randn(b, ctx, h, d) * 0.5, dt)
+        vd = jnp.asarray(rng.randn(b, ctx, h, d) * 0.5, dt)
+        lens_j = jnp.asarray(lens)
+
+        def dense(q, kd, vd):
+            s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                           kd.astype(jnp.float32)) * scale
+            mask = jnp.arange(ctx)[None, None, :] < lens_j[:, None, None]
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhk,bkhd->bhd", p,
+                              vd.astype(jnp.float32)).astype(q.dtype)
+
+        def timed(fn, *args):
+            g = jax.jit(fn)
+            g(*args).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                r = g(*args)
+            r.block_until_ready()
+            return (time.perf_counter() - t0) / steps
+
+        t_dense = timed(dense, q, kd, vd)
+        for ps in page_sizes:
+            max_pages = -(-ctx // ps)
+            npages = max(b * max_pages + 1, 8)
+            kp = jnp.asarray(
+                rng.randn(npages, ps, h, d) * 0.5, dt)
+            vp = jnp.asarray(
+                rng.randn(npages, ps, h, d) * 0.5, dt)
+            tbl = jnp.asarray(
+                rng.permutation(npages)[: b * max_pages].reshape(
+                    b, max_pages), jnp.int32)
+            t_paged = timed(
+                lambda q_, kp_, vp_: paged_kernel(
+                    q_, kp_, vp_, tbl, lens_j, sm_scale=scale),
+                q, kp, vp)
+            grid[f"b{b}_p{ps}"] = {
+                "paged_us_tok": round(1e6 * t_paged / b, 1),
+                "paged_tok_s": round(b / t_paged, 0),
+                "dense_tok_s": round(b / t_dense, 0),
+                "speedup_vs_dense": round(t_dense / t_paged, 2),
+            }
+    return {
+        "config": "decode_throughput",
+        "mode": "tpu-single-chip" if not cpu else "cpu",
+        "ctx": ctx, "heads": h, "head_dim": d,
+        "grid": grid,
     }
 
 
@@ -710,7 +793,7 @@ def main() -> int:
     ap.add_argument("--dry", action="store_true")
     ap.add_argument("--only", type=str, default=None,
                     choices=["llama", "resnet50", "gpt3", "vitl",
-                             "ernie_moe", "varlen"])
+                             "ernie_moe", "varlen", "decode"])
     ap.add_argument("--cpu-mesh", type=str, default=None,
                     choices=sorted(_CPU_MESH))
     ap.add_argument("--steps", type=int, default=10)
@@ -773,6 +856,9 @@ def main() -> int:
     if args.only in (None, "varlen"):
         configs["flash_varlen_8k"] = _single(
             "flash_varlen_8k", bench_varlen)
+    if args.only in (None, "decode"):
+        configs["decode_throughput"] = _single(
+            "decode_throughput", bench_decode)
 
     if args.only in (None, "llama"):
         # the headline must not eat the matrix: a failure here still

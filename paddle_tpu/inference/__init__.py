@@ -18,7 +18,12 @@ __all__ = [
     "Tensor",
     "create_predictor",
     "PlaceType",
+    "Request",
+    "BatchScheduler",
+    "RequestState",
 ]
+
+from .serving import BatchScheduler, Request, RequestState  # noqa: E402
 
 
 class PlaceType:

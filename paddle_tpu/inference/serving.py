@@ -1,0 +1,251 @@
+"""Continuous-batching decode scheduler over the paged KV cache.
+
+Upstream analog: the serving role of
+paddle/fluid/operators/fused/fused_multi_transformer_op.cu plus the
+request batching that PaddleNLP's serving stack layers on top of it.
+TPU-native design: the attention per step is ONE paged-attention Pallas
+kernel call over the whole active batch (static shapes; ragged context
+lengths live in the page table + seq_lens, not in the tensor shapes),
+and the scheduler is host-side bookkeeping only.
+
+Token-level continuous batching (Orca-style): every scheduler step
+advances each active sequence by exactly one token — prompt tokens for
+sequences still in prefill, sampled tokens for sequences in decode —
+so arrivals and completions interleave freely without padding the
+batch to a common length.
+
+Admission control: a request is admitted only while (a) the active
+batch is below ``max_batch_size`` and (b) the page pool would stay
+under the high watermark after reserving the request's worst-case page
+need (prompt + max_new_tokens, across every layer's cache). This is
+what keeps a burst of long prompts from deadlocking the pool mid-
+generation.
+"""
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Request", "BatchScheduler", "RequestState"]
+
+
+class RequestState:
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+
+
+@dataclass
+class Request:
+    """One generation request.
+
+    ``on_token(request, token_id, is_prompt)`` fires for every token
+    the scheduler commits for this request — the streaming-detokenize
+    hook (called on the host thread; keep it cheap)."""
+
+    req_id: str
+    prompt_ids: List[int]
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+    on_token: Optional[Callable] = None
+    state: str = RequestState.QUEUED
+    generated_ids: List[int] = field(default_factory=list)
+    _pos: int = 0  # prompt tokens consumed so far
+    _reserved: int = 0  # worst-case page reservation at admission
+
+    @property
+    def finished(self) -> bool:
+        return self.state == RequestState.FINISHED
+
+    def total_tokens(self) -> int:
+        return len(self.prompt_ids) + self.max_new_tokens
+
+
+class BatchScheduler:
+    """Drives a paged decoder model with continuous batching.
+
+    ``model`` must provide the paged-serving protocol:
+      * ``alloc(seq_id)`` / ``free(seq_id)`` — per-sequence cache slots
+      * ``decode_token(token_ids, seq_ids) -> logits (B, vocab)`` — one
+        token per listed sequence through the paged-attention kernel
+      * ``caches`` — iterable of PagedKVCacheManager (for the
+        admission watermark; one per layer)
+    """
+
+    def __init__(self, model, max_batch_size=32, page_watermark=0.95,
+                 sampler=None):
+        self.model = model
+        self.max_batch_size = int(max_batch_size)
+        self.page_watermark = float(page_watermark)
+        self.sampler = sampler or (lambda logits: int(np.argmax(logits)))
+        self._queue = collections.deque()
+        self._active = {}
+        self._finished = {}
+
+    # -- pool accounting ---------------------------------------------------
+    def _pool(self):
+        caches = list(self.model.caches)
+        total = sum(c.num_pages for c in caches)
+        free = sum(len(c._free) for c in caches)
+        return total, free
+
+    def _pages_needed(self, req: Request) -> int:
+        need = 0
+        for c in self.model.caches:
+            need += -(-req.total_tokens() // c.page_size)
+        return need
+
+    def page_pool_stats(self):
+        total, free = self._pool()
+        return {
+            "total_pages": total,
+            "free_pages": free,
+            "reserved_pages": self._reserved_pages_outstanding(),
+            "utilization": 1.0 - free / max(total, 1),
+        }
+
+    # -- request lifecycle -------------------------------------------------
+    def submit(self, req: Request) -> str:
+        if not req.prompt_ids:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 0:
+            raise ValueError("max_new_tokens must be >= 0")
+        self._queue.append(req)
+        return req.req_id
+
+    def _try_admit(self):
+        while self._queue and len(self._active) < self.max_batch_size:
+            req = self._queue[0]
+            need = self._pages_needed(req)
+            total, free = self._pool()
+            # admit only if worst-case reservation keeps the pool under
+            # the watermark (reservations of already-active requests
+            # are counted; their already-used pages are no longer free,
+            # so subtract usage double-counted inside reservations)
+            used = total - free
+            projected = used + self._reserved_pages_outstanding() + need
+            if projected > self.page_watermark * total:
+                return
+            self._queue.popleft()
+            self.model.alloc(req.req_id)
+            req.state = RequestState.PREFILL
+            req._reserved = need
+            self._active[req.req_id] = req
+
+    def _reserved_pages_outstanding(self) -> int:
+        """Worst-case pages still unclaimed by active requests."""
+        out = 0
+        for req in self._active.values():
+            used = 0
+            done = req._pos + len(req.generated_ids)
+            for c in self.model.caches:
+                used += -(-done // c.page_size) if done else 0
+            out += max(req._reserved - used, 0)
+        return out
+
+    def _retire(self, req: Request):
+        self.model.free(req.req_id)
+        req.state = RequestState.FINISHED
+        del self._active[req.req_id]
+        self._finished[req.req_id] = req
+
+    # -- the step ----------------------------------------------------------
+    def step(self) -> dict:
+        """One scheduler iteration: admit, advance every active
+        sequence by one token, retire completions. Returns event
+        counters (admitted/advanced/finished)."""
+        n_before = len(self._active)
+        self._try_admit()
+        admitted = len(self._active) - n_before
+        if not self._active:
+            return {"admitted": admitted, "advanced": 0, "finished": 0}
+
+        sids = sorted(self._active)
+        feed = []
+        for s in sids:
+            req = self._active[s]
+            if req.state == RequestState.PREFILL:
+                feed.append(req.prompt_ids[req._pos])
+            else:
+                feed.append(req.generated_ids[-1])
+        logits = self.model.decode_token(feed, sids)
+        logits_np = np.asarray(
+            logits.numpy() if hasattr(logits, "numpy") else logits
+        )
+
+        finished = 0
+        for bi, s in enumerate(sids):
+            req = self._active[s]
+            if req.state == RequestState.PREFILL:
+                tok = req.prompt_ids[req._pos]
+                req._pos += 1
+                if req.on_token is not None:
+                    req.on_token(req, tok, True)
+                if req._pos == len(req.prompt_ids):
+                    if req.max_new_tokens == 0:
+                        # prefill-only (scoring): no sampling
+                        self._retire(req)
+                        finished += 1
+                        continue
+                    req.state = RequestState.DECODE
+                    # the last prompt position's logits sample the
+                    # first generated token
+                    first = self.sampler(logits_np[bi])
+                    req.generated_ids.append(first)
+                    if req.on_token is not None:
+                        req.on_token(req, first, False)
+                    if self._done(req, first):
+                        self._retire(req)
+                        finished += 1
+                continue
+            tok = self.sampler(logits_np[bi])
+            req.generated_ids.append(tok)
+            if req.on_token is not None:
+                req.on_token(req, tok, False)
+            if self._done(req, tok):
+                self._retire(req)
+                finished += 1
+        return {
+            "admitted": admitted,
+            "advanced": len(sids),
+            "finished": finished,
+        }
+
+    def _done(self, req: Request, last_tok: int) -> bool:
+        if req.eos_id is not None and last_tok == req.eos_id:
+            return True
+        return len(req.generated_ids) >= req.max_new_tokens
+
+    def run_until_complete(self, max_steps=10_000) -> dict:
+        """Drain the queue + active set; returns finished requests by
+        id."""
+        for _ in range(max_steps):
+            if not self._queue and not self._active:
+                break
+            ev = self.step()
+            if (ev["advanced"] == 0 and ev["admitted"] == 0
+                    and self._queue):
+                raise RuntimeError(
+                    "scheduler stalled: queue non-empty but nothing "
+                    "admissible (pool too small for the smallest "
+                    f"queued request; {self.page_pool_stats()})"
+                )
+        else:
+            raise RuntimeError(f"not drained after {max_steps} steps")
+        return dict(self._finished)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def num_active(self):
+        return len(self._active)
+
+    @property
+    def num_queued(self):
+        return len(self._queue)
+
+    def result(self, req_id: str) -> Request:
+        return self._finished[req_id]

@@ -49,5 +49,5 @@ def test_ptq():
 def test_paged_serving():
     import paged_serving
 
-    worst = paged_serving.main()
-    assert worst < 1e-3
+    n_generated = paged_serving.main()
+    assert n_generated >= 9  # 4 + 2 + 3 new tokens across requests

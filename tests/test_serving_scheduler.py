@@ -1,0 +1,189 @@
+"""BatchScheduler continuous-batching serving (upstream analog: the
+request batching over fused_multi_transformer's serving kernels).
+Checks admission watermarks, streaming hooks, interleaved lifecycles,
+and paged-vs-dense logits equality on a tiny decoder."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.nn import PagedKVCacheManager
+from paddle_tpu.inference import BatchScheduler, Request, RequestState
+
+
+class TinyPagedDecoder(nn.Layer):
+    """1-layer paged-attention decoder implementing the scheduler's
+    model protocol (alloc/free/decode_token/caches)."""
+
+    def __init__(self, vocab=37, dim=32, heads=2, page_size=4,
+                 num_pages=32):
+        super().__init__()
+        self.dim, self.heads, self.hd = dim, heads, dim // heads
+        self.embed = nn.Embedding(vocab, dim)
+        self.qkv = nn.Linear(dim, 3 * dim)
+        self.head = nn.Linear(dim, vocab)
+        self.caches = [
+            PagedKVCacheManager(num_pages, page_size, heads, self.hd,
+                                dtype=jnp.float32)
+        ]
+
+    def alloc(self, sid):
+        for c in self.caches:
+            c.alloc(sid)
+
+    def free(self, sid):
+        for c in self.caches:
+            c.free(sid)
+
+    def decode_token(self, token_ids, seq_ids):
+        b = len(seq_ids)
+        x = self.embed(paddle.to_tensor(
+            np.asarray(token_ids, "int64")[:, None]))[:, 0]
+        qkv = self.qkv(x).reshape([b, 3, self.heads, self.hd])
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        for bi, sid in enumerate(seq_ids):
+            self.caches[0].append(sid, k.numpy()[bi], v.numpy()[bi])
+        attn = self.caches[0].attend(q, seq_ids)
+        return self.head(x + attn.reshape([b, self.dim]))
+
+    def dense_logits(self, tokens):
+        """Offline reference for one sequence."""
+        ids = paddle.to_tensor(np.asarray(tokens, "int64")[None])
+        x = self.embed(ids)[0]
+        t = x.shape[0]
+        qkv = self.qkv(x).reshape([t, 3, self.heads, self.hd])
+        qn, kn, vn = (qkv[:, i].numpy() for i in range(3))
+        attn = np.zeros_like(qn)
+        scale = 1.0 / np.sqrt(self.hd)
+        for ti in range(t):
+            for h in range(self.heads):
+                s = kn[: ti + 1, h] @ qn[ti, h] * scale
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                attn[ti, h] = p @ vn[: ti + 1, h]
+        return self.head(
+            paddle.to_tensor(x.numpy() + attn.reshape(t, self.dim))
+        ).numpy()
+
+
+def _mk(num_pages=32, page_size=4, **kw):
+    paddle.seed(11)
+    model = TinyPagedDecoder(num_pages=num_pages, page_size=page_size)
+    return model, BatchScheduler(model, **kw)
+
+
+class TestBatchScheduler:
+    def test_single_request_greedy_matches_dense(self):
+        model, sched = _mk()
+        prompt = [3, 17, 5, 9]
+        sched.submit(Request("r0", prompt, max_new_tokens=4))
+        done = sched.run_until_complete()
+        req = done["r0"]
+        assert len(req.generated_ids) == 4
+        # greedy rollout on the dense reference must match token-for-
+        # token (same weights, paged kernel vs dense attention)
+        toks = list(prompt)
+        for expect in req.generated_ids:
+            logits = model.dense_logits(toks)
+            nxt = int(np.argmax(logits[-1]))
+            assert nxt == expect
+            toks.append(nxt)
+
+    def test_interleaved_arrivals_and_streaming_order(self):
+        model, sched = _mk()
+        seen = []
+        reqs = {
+            "a": Request("a", [1, 2, 3], max_new_tokens=3,
+                         on_token=lambda r, t, p: seen.append(
+                             (r.req_id, t, p))),
+            "b": Request("b", [4, 5], max_new_tokens=2,
+                         on_token=lambda r, t, p: seen.append(
+                             (r.req_id, t, p))),
+        }
+        sched.submit(reqs["a"])
+        sched.step()  # a admitted, consumes prompt token 1
+        sched.submit(reqs["b"])  # b joins mid-flight
+        done = sched.run_until_complete()
+        assert set(done) == {"a", "b"}
+        # streaming: prompt tokens flagged True, generated False, and
+        # per-request ordering is prompt* then generated*
+        for rid, req in reqs.items():
+            stream = [(t, p) for r, t, p in seen if r == rid]
+            toks = [t for t, _ in stream]
+            assert toks == req.prompt_ids + req.generated_ids
+            flags = [p for _, p in stream]
+            assert flags == [True] * len(req.prompt_ids) + \
+                [False] * len(req.generated_ids)
+
+    def test_admission_blocks_on_page_watermark_then_recovers(self):
+        # pool of 8 pages x4 tokens; each request worst-case needs
+        # ceil((4+12)/4)=4 pages -> only 2 admissible at once
+        model, sched = _mk(num_pages=8, page_size=4, max_batch_size=8,
+                           page_watermark=1.0)
+        for i in range(4):
+            sched.submit(Request(f"r{i}", [1 + i, 2, 3, 4],
+                                 max_new_tokens=12))
+        sched.step()
+        assert sched.num_active == 2 and sched.num_queued == 2
+        done = sched.run_until_complete()
+        assert set(done) == {"r0", "r1", "r2", "r3"}
+        for r in done.values():
+            assert len(r.generated_ids) == 12
+        # all pages returned
+        assert sched.page_pool_stats()["free_pages"] == 8
+
+    def test_max_batch_size_respected(self):
+        model, sched = _mk(max_batch_size=2)
+        for i in range(5):
+            sched.submit(Request(f"r{i}", [i + 1], max_new_tokens=2))
+        sched.step()
+        assert sched.num_active <= 2
+        done = sched.run_until_complete()
+        assert len(done) == 5
+
+    def test_eos_stops_early(self):
+        model, sched = _mk()
+        sched.submit(Request("r", [2, 3], max_new_tokens=50))
+        done = sched.run_until_complete()
+        base = done["r"].generated_ids
+        assert len(base) >= 2
+        # pick a MID-STREAM token whose value hasn't occurred earlier,
+        # so "stop at eos" has an unambiguous expected cut point past
+        # the first decode step (fall back to 0 for degenerate rollouts)
+        cut = next((i for i in range(1, len(base))
+                    if base[i] not in base[:i]), 0)
+        eos = base[cut]
+        model2, sched2 = _mk()
+        sched2.submit(Request("r", [2, 3], max_new_tokens=50,
+                              eos_id=eos))
+        done2 = sched2.run_until_complete()
+        assert done2["r"].generated_ids == base[: cut + 1]
+        assert done2["r"].state == RequestState.FINISHED
+
+    def test_oversized_request_stalls_loudly(self):
+        model, sched = _mk(num_pages=2, page_size=4)
+        sched.submit(Request("big", [1] * 4, max_new_tokens=32))
+        with pytest.raises(RuntimeError, match="stalled"):
+            sched.run_until_complete()
+
+    def test_prefill_only_request_generates_nothing(self):
+        # max_new_tokens=0 = scoring/prefill-only: no sampled token,
+        # no decode-phase streaming callback
+        model, sched = _mk()
+        seen = []
+        sched.submit(Request(
+            "p", [5, 6, 7], max_new_tokens=0,
+            on_token=lambda r, t, p: seen.append((t, p))))
+        done = sched.run_until_complete()
+        assert done["p"].generated_ids == []
+        assert seen == [(5, True), (6, True), (7, True)]
+        assert sched.page_pool_stats()["free_pages"] == \
+            sched.page_pool_stats()["total_pages"]
+
+    def test_pool_stats_shape(self):
+        model, sched = _mk()
+        s = sched.page_pool_stats()
+        assert {"total_pages", "free_pages", "reserved_pages",
+                "utilization"} <= set(s)
