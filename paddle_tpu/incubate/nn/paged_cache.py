@@ -58,6 +58,10 @@ class PagedKVCacheManager:
     def seq_len(self, seq_id):
         return self._lens[seq_id]
 
+    @property
+    def num_free_pages(self) -> int:
+        return len(self._free)
+
     def _next_slot(self, seq_id):
         n = self._lens[seq_id]
         off = n % self.page_size

@@ -90,7 +90,7 @@ class BatchScheduler:
     def _pool(self):
         caches = list(self.model.caches)
         total = sum(c.num_pages for c in caches)
-        free = sum(len(c._free) for c in caches)
+        free = sum(c.num_free_pages for c in caches)
         return total, free
 
     def _pages_needed(self, req: Request) -> int:
@@ -114,6 +114,17 @@ class BatchScheduler:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 0:
             raise ValueError("max_new_tokens must be >= 0")
+        # reject requests that could NEVER be admitted (worst-case page
+        # need above the watermark even with an empty pool) instead of
+        # letting them block the FIFO queue forever
+        need = self._pages_needed(req)
+        total, _ = self._pool()
+        if need > self.page_watermark * total:
+            raise ValueError(
+                f"request {req.req_id!r} needs {need} pages worst-case "
+                f"but the pool watermark admits at most "
+                f"{int(self.page_watermark * total)} of {total}"
+            )
         self._queue.append(req)
         return req.req_id
 
@@ -141,7 +152,11 @@ class BatchScheduler:
         out = 0
         for req in self._active.values():
             used = 0
+            # tokens actually appended to the caches: the most recent
+            # sampled token is only fed (and written) next step
             done = req._pos + len(req.generated_ids)
+            if req.state == RequestState.DECODE:
+                done -= 1
             for c in self.model.caches:
                 used += -(-done // c.page_size) if done else 0
             out += max(req._reserved - used, 0)
@@ -229,10 +244,13 @@ class BatchScheduler:
             ev = self.step()
             if (ev["advanced"] == 0 and ev["admitted"] == 0
                     and self._queue):
+                # defensive: submit() rejects never-admissible requests
+                # and active requests always finish, so this fires only
+                # on an accounting bug or external pool interference
                 raise RuntimeError(
-                    "scheduler stalled: queue non-empty but nothing "
-                    "admissible (pool too small for the smallest "
-                    f"queued request; {self.page_pool_stats()})"
+                    "scheduler stalled: nothing active yet the queue "
+                    "head cannot be admitted; "
+                    f"{self.page_pool_stats()}"
                 )
         else:
             raise RuntimeError(f"not drained after {max_steps} steps")

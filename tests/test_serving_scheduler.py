@@ -162,11 +162,32 @@ class TestBatchScheduler:
         assert done2["r"].generated_ids == base[: cut + 1]
         assert done2["r"].state == RequestState.FINISHED
 
-    def test_oversized_request_stalls_loudly(self):
+    def test_oversized_request_rejected_at_submit(self):
+        # a request that could NEVER be admitted must not poison the
+        # FIFO queue: submit() rejects it up front
         model, sched = _mk(num_pages=2, page_size=4)
-        sched.submit(Request("big", [1] * 4, max_new_tokens=32))
-        with pytest.raises(RuntimeError, match="stalled"):
-            sched.run_until_complete()
+        with pytest.raises(ValueError, match="pages worst-case"):
+            sched.submit(Request("big", [1] * 4, max_new_tokens=32))
+        # smaller requests behind it still serve
+        sched.submit(Request("small", [1, 2], max_new_tokens=2))
+        done = sched.run_until_complete()
+        assert len(done["small"].generated_ids) == 2
+
+    def test_reservation_no_oversubscribe_at_page_boundary(self):
+        # regression (r3 review): the freshly-sampled token is not yet
+        # in the cache; counting it released reservations one step
+        # early, which let admission oversubscribe the pool and blow up
+        # with 'KV page pool exhausted' at the next page boundary.
+        # pool: 4 pages x4 tokens; r0 needs ceil(8/4)=2, r1 ceil(8/4)=2,
+        # r2 ceil(5/4)=2 -> r2 must wait until r0 or r1 frees.
+        model, sched = _mk(num_pages=4, page_size=4, max_batch_size=8,
+                           page_watermark=1.0)
+        sched.submit(Request("r0", [1], max_new_tokens=7))
+        sched.submit(Request("r1", [2], max_new_tokens=7))
+        sched.submit(Request("r2", [3], max_new_tokens=4))
+        done = sched.run_until_complete()  # must not raise
+        assert {len(done[r].generated_ids) for r in ("r0", "r1")} == {7}
+        assert len(done["r2"].generated_ids) == 4
 
     def test_prefill_only_request_generates_nothing(self):
         # max_new_tokens=0 = scoring/prefill-only: no sampled token,
