@@ -1,0 +1,247 @@
+"""Transformer-scale hybrid-parallel trajectory equivalence
+(VERDICT r2 #7): a 4-layer D=512 Llama trained 10 steps on the 8-way
+CPU mesh must reproduce the single-device loss trajectory under every
+major parallelism grid — the reference's "parallel == serial loss
+curve" pattern (SURVEY.md §4) at a scale where RNG/reshard/
+accumulation drift actually shows.
+
+Grids: dp2xmp4, mp2xpp2xdp2, dp2xsharding4 (ZeRO stage2 and stage3),
+mp2xpp2xep2 (MoE), sep2xmp2xdp2 (ring and Ulysses context parallel).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as optim
+from paddle_tpu.distributed import fleet
+
+from conftest import reset_dist_state as _reset
+
+SEED = 123
+STEPS = 10
+BATCH = 8
+SEQ = 32
+RTOL = 5e-4
+
+
+def _llama_cfg(**kw):
+    from paddle_tpu.models import LlamaConfig
+
+    base = dict(
+        vocab_size=512, hidden_size=512, intermediate_size=1024,
+        num_hidden_layers=4, num_attention_heads=8,
+        num_key_value_heads=8, max_position_embeddings=SEQ,
+    )
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def _batches():
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(STEPS):
+        x = rng.randint(0, 512, (BATCH, SEQ)).astype("int32")
+        y = rng.randint(0, 512, (BATCH, SEQ)).astype("int64")
+        out.append((x, y))
+    return out
+
+
+def _train_llama(cfg, wrap=None):
+    """Plain (non-pipeline) training loop; `wrap` optionally maps
+    (model, opt) -> (model, opt) after construction (ZeRO)."""
+    with paddle.utils.unique_name.guard():
+        paddle.seed(SEED)
+        from paddle_tpu.models import LlamaForCausalLM
+
+        model = LlamaForCausalLM(cfg)
+        opt = optim.AdamW(1e-3, parameters=model.parameters())
+    if wrap is not None:
+        model, opt = wrap(model, opt)
+
+    @paddle.jit.to_static
+    def step(x, y):
+        _, loss = model(x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = []
+    for x, y in _batches():
+        losses.append(float(step(
+            paddle.to_tensor(x), paddle.to_tensor(y))))
+    return losses
+
+
+_SERIAL = {}
+
+
+def _serial_llama(key="plain", **cfg_kw):
+    """Single-device baseline, computed once per config flavor."""
+    if key not in _SERIAL:
+        _reset()
+        _SERIAL[key] = _train_llama(_llama_cfg(**cfg_kw))
+        assert _SERIAL[key][-1] < _SERIAL[key][0], _SERIAL[key]
+    return _SERIAL[key]
+
+
+def _grid(**hybrid):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = hybrid
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+class TestHybridEquivalence:
+    def test_dp2_mp4(self):
+        serial = _serial_llama()
+        _grid(dp_degree=2, mp_degree=4)
+        try:
+            got = _train_llama(_llama_cfg())
+        finally:
+            _reset()
+        np.testing.assert_allclose(got, serial, rtol=RTOL, atol=RTOL)
+
+    @pytest.mark.parametrize("level", ["os_g", "p_g_os"])
+    def test_dp2_sharding4_zero(self, level):
+        from paddle_tpu.distributed.sharding import (
+            group_sharded_parallel,
+        )
+
+        serial = _serial_llama()
+        _grid(dp_degree=2, sharding_degree=4)
+
+        def wrap(model, opt):
+            m, o, _ = group_sharded_parallel(model, opt, level)
+            return m, o
+
+        try:
+            got = _train_llama(_llama_cfg(), wrap=wrap)
+        finally:
+            _reset()
+        np.testing.assert_allclose(got, serial, rtol=RTOL, atol=RTOL)
+
+    @pytest.mark.parametrize("mode", ["ring", "ulysses"])
+    def test_sep2_mp2_dp2_context_parallel(self, mode):
+        serial = _serial_llama()
+        _grid(dp_degree=2, mp_degree=2, sep_degree=2)
+        try:
+            got = _train_llama(_llama_cfg(context_parallel=mode))
+        finally:
+            _reset()
+        np.testing.assert_allclose(got, serial, rtol=RTOL, atol=RTOL)
+
+    @staticmethod
+    def _serial_weights():
+        """Initial weights of the serial LlamaForCausalLM (same seed
+        the baseline trajectory starts from)."""
+        from paddle_tpu.models import LlamaForCausalLM
+
+        _reset()
+        with paddle.utils.unique_name.guard():
+            paddle.seed(SEED)
+            m = LlamaForCausalLM(_llama_cfg())
+        return {n: p.numpy() for n, p in m.named_parameters()}
+
+    @staticmethod
+    def _port_weights(pipe_model, serial_w, n_layers=4):
+        """Load serial per-layer weights into the pipeline model's
+        stacked representation, so both trajectories share the exact
+        same starting point (init draw ORDER differs between the two
+        construction paths; the math after porting must not)."""
+        direct = {
+            "pre_layers.0.embed_tokens.weight":
+                serial_w["model.embed_tokens.weight"],
+            "post_layers.0.norm.weight": serial_w["model.norm.weight"],
+            "post_layers.0.lm_head.weight": serial_w["lm_head.weight"],
+        }
+        for name, p in pipe_model.named_parameters():
+            if name in direct:
+                p.set_value(direct[name])
+                continue
+            assert name.startswith("body.stacked_"), name
+            rest = name[len("body.stacked_"):].replace("__", ".")
+            stacked = np.stack([
+                serial_w[f"model.layers.{i}.{rest}"]
+                for i in range(n_layers)
+            ])
+            p.set_value(stacked)
+
+    def _train_pipeline(self, serial_w):
+        from paddle_tpu.models import llama_pipeline_model
+
+        with paddle.utils.unique_name.guard():
+            paddle.seed(SEED)
+            model = fleet.distributed_model(
+                llama_pipeline_model(_llama_cfg(), num_stages=2))
+            self._port_weights(model, serial_w)
+            opt = fleet.distributed_optimizer(
+                optim.AdamW(1e-3, parameters=model.parameters()))
+        losses = []
+        for x, y in _batches():
+            loss = model.train_batch(
+                (paddle.to_tensor(x), paddle.to_tensor(y)), opt)
+            losses.append(float(np.asarray(loss._data)))
+        return losses
+
+    def test_mp2_pp2_dp2(self):
+        serial = _serial_llama()
+        serial_w = self._serial_weights()
+        strategy = _grid(dp_degree=2, mp_degree=2, pp_degree=2)
+        strategy.pipeline_configs = {
+            "micro_batch_size": BATCH // 2, "accumulate_steps": 2,
+        }
+        try:
+            got = self._train_pipeline(serial_w)
+        finally:
+            _reset()
+        np.testing.assert_allclose(got, serial, rtol=RTOL, atol=RTOL)
+
+    def _train_moe_pipeline(self, micro_accum=2):
+        from paddle_tpu.models import gpt_moe_tiny, gpt_pipeline_model
+
+        cfg = gpt_moe_tiny(
+            num_hidden_layers=4, hidden_size=512, intermediate_size=1024,
+            num_attention_heads=8, dropout=0.0,
+        )
+        with paddle.utils.unique_name.guard():
+            paddle.seed(SEED)
+            model = fleet.distributed_model(
+                gpt_pipeline_model(cfg, num_stages=2))
+            opt = fleet.distributed_optimizer(
+                optim.AdamW(1e-3, parameters=model.parameters()))
+        losses = []
+        for x, y in _batches():
+            loss = model.train_batch(
+                (paddle.to_tensor(x), paddle.to_tensor(y)), opt)
+            losses.append(float(np.asarray(loss._data)))
+        return losses
+
+    def test_mp2_pp2_ep2_moe(self):
+        # baseline: the same MoE model under pure pp2 (pipeline
+        # semantics held fixed; mp+ep must not change the trajectory —
+        # pp2 == serial is covered by test_mp2_pp2_dp2 + the pipeline
+        # suite's interleaved==sequential checks)
+        # ep axis must exist in the mesh even at degree 1 (the MoE
+        # layer's PartitionSpec names it), so pin the order explicitly
+        strategy = _grid(
+            pp_degree=2,
+            order=["dp", "pp", "sharding", "sep", "mp", "ep"])
+        strategy.pipeline_configs = {
+            "micro_batch_size": BATCH // 2, "accumulate_steps": 2,
+        }
+        try:
+            base = self._train_moe_pipeline()
+        finally:
+            _reset()
+        assert base[-1] < base[0], base
+
+        strategy = _grid(mp_degree=2, pp_degree=2, ep_degree=2)
+        strategy.pipeline_configs = {
+            "micro_batch_size": BATCH // 2, "accumulate_steps": 2,
+        }
+        try:
+            got = self._train_moe_pipeline()
+        finally:
+            _reset()
+        np.testing.assert_allclose(got, base, rtol=RTOL, atol=RTOL)
