@@ -46,6 +46,13 @@ def parse_args(argv=None):
     p.add_argument("--max_restart", type=int, default=3)
     p.add_argument("--elastic_level", type=int, default=-1,
                    help=">=1 enables restart-on-failure")
+    p.add_argument("--min_nproc_per_node", type=int, default=None,
+                   help="elastic scale-down floor: after a worker "
+                        "failure, restart the pod with one fewer "
+                        "worker (down to this floor) instead of the "
+                        "same count — the single-host analog of "
+                        "re-rendezvousing a smaller membership "
+                        "(upstream: ElasticManager rank recompute)")
     p.add_argument("--devices", default=None,
                    help="accepted for reference-CLI parity (jax owns "
                         "all local devices)")
@@ -82,6 +89,14 @@ class NodeController:
         from ..store import TCPStore
 
         args = self.args
+        if self.store is not None:
+            # elastic re-rendezvous: release the previous generation's
+            # store daemon/port before binding a fresh one
+            try:
+                self.store.stop()
+            except Exception:
+                pass
+            self.store = None
         if self.nnodes <= 1 and not args.master:
             self.node_rank = 0
             self.endpoints = ["127.0.0.1"]
@@ -239,6 +254,22 @@ class NodeController:
             restarts += 1
             if not elastic or restarts > args.max_restart:
                 return rc
+            if (args.min_nproc_per_node is not None
+                    and args.nproc_per_node > args.min_nproc_per_node):
+                if self.nnodes > 1:
+                    # a per-node decrement would desync world size and
+                    # global ranks across controllers (only the failing
+                    # node observes the crash) — refuse rather than hang
+                    sys.stderr.write(
+                        "--min_nproc_per_node scale-down is single-node "
+                        "only; ignoring for nnodes>1\n"
+                    )
+                else:
+                    args.nproc_per_node -= 1
+                    sys.stderr.write(
+                        f"elastic scale-down to "
+                        f"{args.nproc_per_node} workers\n"
+                    )
             sys.stderr.write(
                 f"elastic restart {restarts}/{args.max_restart} "
                 f"(generation {self.generation + 1})\n"

@@ -21,16 +21,21 @@ from paddle_tpu.distributed.store import TCPStore
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_launch(tmp_path, script_body, extra_args=(), env_extra=None):
-    script = tmp_path / "worker.py"
-    script.write_text(textwrap.dedent(script_body))
+def _hermetic_env():
+    """CPU-hermetic subprocess env: keep worker procs off the real TPU
+    tunnel (the axon sitecustomize registers its platform whenever
+    PALLAS_AXON_POOL_IPS is set, and it outranks JAX_PLATFORMS)."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
-    # keep worker procs off the real TPU tunnel (the axon sitecustomize
-    # registers its platform whenever PALLAS_AXON_POOL_IPS is set, and
-    # it outranks JAX_PLATFORMS) — launch tests must be CPU-hermetic
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def _run_launch(tmp_path, script_body, extra_args=(), env_extra=None):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    env = _hermetic_env()
     env.update(env_extra or {})
     return subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
@@ -201,6 +206,223 @@ class TestSpawn:
         )
         assert r.returncode == 0, r.stderr
         assert (tmp_path / "r0").exists() and (tmp_path / "r1").exists()
+
+
+def test_composed_failure_drill(tmp_path):
+    """The full fault-tolerance story in ONE flow (VERDICT r2 #8):
+    4 launch workers train data-parallel (grads averaged over the
+    store), async-checkpoint every step, one worker SIGKILLs itself
+    mid-step, the controller elastically re-rendezvouses onto 3 ranks
+    (scale-down), training resumes from the checkpoint, and the loss
+    curve CONTINUES (no restart-from-scratch jump)."""
+    import json
+
+    import numpy as np
+
+    ckpt = tmp_path / "ckpt"
+    out = tmp_path / "out"
+    out.mkdir()
+    body = f"""
+        import json, os, signal, sys
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import checkpoint as dck
+
+        CKPT = {str(ckpt)!r}
+        OUT = {str(out)!r}
+        TOTAL, KILL_AT, D = 8, 3, 16
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        world = int(os.environ["PADDLE_TRAINERS_NUM"])
+        gen = int(os.environ.get("PADDLE_RESTART_GENERATION", "0"))
+
+        with paddle.utils.unique_name.guard():
+            paddle.seed(7)
+            model = nn.Linear(D, D)
+            opt = paddle.optimizer.AdamW(
+                1e-2, parameters=model.parameters())
+            opt._create_accumulators()
+
+        start = 0
+        if os.path.exists(os.path.join(CKPT, "manifest.json")):
+            state = {{"model": model.state_dict(),
+                      "opt": opt.state_dict(), "step": 0}}
+            dck.load_state_dict(state, CKPT, process_index=rank)
+            model.set_state_dict(state["model"])
+            opt.set_state_dict(state["opt"])
+            start = int(np.asarray(state["step"]))
+
+        fixed_w = np.linalg.qr(
+            np.random.RandomState(0).randn(D, D))[0].astype("float32")
+        ev = np.random.RandomState(999)
+        ex = paddle.to_tensor(ev.randn(8, D).astype("float32"))
+        ey = paddle.to_tensor((ex.numpy() @ fixed_w))
+
+        def eval_loss():
+            with paddle.no_grad():
+                o = model(ex)
+                return float(np.asarray(paddle.tensor.math.mean(
+                    (o - ey) * (o - ey))._data))
+
+        losses = []
+        evals = []
+        handle = None
+        for s in range(start, TOTAL):
+            if handle is not None:
+                handle.wait()  # previous async save durable
+            evals.append(eval_loss())
+            print(f"EVAL gen={{gen}} rank={{rank}} s={{s}} "
+                  f"v={{evals[-1]:.6f}}", flush=True)
+            # per-(step, rank) batch; loss target is a fixed linear map
+            rs = np.random.RandomState(1000 + s * 16 + rank)
+            x = paddle.to_tensor(rs.randn(8, D).astype("float32"))
+            y = paddle.to_tensor((x.numpy() @ fixed_w))
+            outp = model(x)
+            loss = paddle.tensor.math.mean((outp - y) * (outp - y))
+            loss.backward()
+            if rank == world - 1 and gen == 0 and s == KILL_AT:
+                os.kill(os.getpid(), signal.SIGKILL)  # mid-step!
+            # dp grad average over the store control plane
+            grads = [p.grad.numpy() for _, p in
+                     sorted(model.named_parameters())]
+            allg = []
+            dist.all_gather_object(allg, grads)
+            for (_, p), gs in zip(sorted(model.named_parameters()),
+                                  zip(*allg)):
+                p.grad.set_value(np.mean(gs, axis=0))
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+            handle = dck.save_state_dict(
+                {{"model": model.state_dict(),
+                  "opt": opt.state_dict(), "step": s + 1}},
+                CKPT, process_index=rank, async_save=True)
+        if handle is not None:
+            handle.wait()
+        json.dump(
+            {{"gen": gen, "world": world, "start": start,
+              "losses": losses, "evals": evals}},
+            open(os.path.join(OUT, f"g{{gen}}_r{{rank}}.json"), "w"))
+        print(f"DRILL_OK gen={{gen}} rank={{rank}} start={{start}} "
+              f"world={{world}}", flush=True)
+    """
+    r = _run_launch(
+        tmp_path, body,
+        extra_args=("--nproc_per_node", "4", "--elastic_level", "1",
+                    "--max_restart", "2", "--min_nproc_per_node", "3"),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "elastic scale-down to 3 workers" in r.stderr, r.stderr
+    # generation 0: killed mid-step by rank 3 (no g0 result files for
+    # the survivors either — they were blocked in the grad exchange)
+    # generation 1: 3 ranks, resumed from the step-3 checkpoint
+    g1 = [json.load(open(out / f"g1_r{r}.json")) for r in range(3)]
+    assert not (out / "g1_r3.json").exists()
+    for rec in g1:
+        assert rec["world"] == 3
+        assert rec["start"] == 3, rec  # resumed, not from scratch
+        assert len(rec["losses"]) == 5  # steps 3..7
+    # loss curve CONTINUES: generation-1's first eval (on the restored
+    # weights, fixed eval batch) must equal generation-0's eval at the
+    # kill step — checkpoint-exact resume, not restart-from-scratch —
+    # and training keeps improving from there
+    log0 = (tmp_path / "log" / "workerlog.0").read_text()
+    g0_evals = {}
+    for line in log0.splitlines():
+        if line.startswith("EVAL gen=0 rank=0"):
+            parts = dict(kv.split("=") for kv in line.split()[1:])
+            g0_evals[int(parts["s"])] = float(parts["v"])
+    assert set(g0_evals) == {0, 1, 2, 3}, g0_evals
+    for rec in g1:
+        np.testing.assert_allclose(
+            rec["evals"][0], g0_evals[3], rtol=1e-5)
+        assert rec["evals"][-1] < rec["evals"][0], rec["evals"]
+        assert rec["evals"][-1] < g0_evals[0], (rec["evals"], g0_evals)
+
+
+def test_multi_node_rendezvous_dp4(tmp_path):
+    """Multi-node simulation (VERDICT r2 #9): TWO controller processes
+    (one per fake node) rendezvous through the --master store, each
+    spawns 2 workers, and the resulting dp4 world runs a data-parallel
+    step over loopback — every rank must see all 4 grad contributions
+    and compute the identical average."""
+    import json
+    import socket
+
+    import numpy as np
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    out = tmp_path / "out"
+    out.mkdir()
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import hashlib, json, os
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.distributed as dist
+
+        OUT = {str(out)!r}
+        D = 8
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        world = int(os.environ["PADDLE_TRAINERS_NUM"])
+        node = int(os.environ["PADDLE_NODE_RANK"])
+
+        with paddle.utils.unique_name.guard():
+            paddle.seed(5)
+            model = nn.Linear(D, D)
+        x = paddle.to_tensor(
+            np.random.RandomState(100 + rank).randn(4, D)
+            .astype("float32"))
+        out_t = model(x)
+        loss = paddle.tensor.math.mean(out_t * out_t)
+        loss.backward()
+        grads = [p.grad.numpy() for _, p in
+                 sorted(model.named_parameters())]
+        allg = []
+        dist.all_gather_object(allg, grads)
+        assert len(allg) == 4, len(allg)
+        avg = [np.mean(gs, axis=0) for gs in zip(*allg)]
+        digest = hashlib.sha1(
+            b"".join(a.round(6).tobytes() for a in avg)).hexdigest()
+        json.dump(
+            {{"rank": rank, "world": world, "node": node,
+              "digest": digest}},
+            open(os.path.join(OUT, f"r{{rank}}.json"), "w"))
+        print(f"DP4_OK rank={{rank}} node={{node}}", flush=True)
+    """))
+
+    env = _hermetic_env()
+
+    def controller(node_rank):
+        return subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--master", f"127.0.0.1:{port}", "--nnodes", "2",
+             "--rank", str(node_rank), "--nproc_per_node", "2",
+             "--log_dir", str(tmp_path / f"log{node_rank}"),
+             str(script)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+    c0 = controller(0)
+    time.sleep(0.5)
+    c1 = controller(1)
+    out0, err0 = c0.communicate(timeout=240)
+    out1, err1 = c1.communicate(timeout=240)
+    assert c0.returncode == 0, err0 + out0
+    assert c1.returncode == 0, err1 + out1
+
+    recs = [json.load(open(out / f"r{r}.json")) for r in range(4)]
+    assert [r["world"] for r in recs] == [4, 4, 4, 4]
+    # ranks 0,1 came from node 0; ranks 2,3 from node 1
+    assert [r["node"] for r in recs] == [0, 0, 1, 1]
+    # every rank computed the identical dp4 grad average
+    assert len({r["digest"] for r in recs}) == 1, recs
 
 
 def test_object_collectives_across_processes(tmp_path):
