@@ -28,6 +28,8 @@ class OpDef:
     differentiable: bool = True
     dtypes: tuple = _FLOAT
     notes: str = ""
+    declared: bool = False       # metadata explicitly declared below
+    sweep_waiver: str = ""       # non-empty: why the op-suite skips it
 
     @property
     def signature(self):
@@ -78,6 +80,83 @@ _CREATION = {
     "triu", "one_hot", "complex", "tril_indices", "triu_indices",
 }
 
+# -- explicit sweep waivers (VERDICT r2 #6: "every registry entry is
+# either swept or explicitly waived"). Each group lists ops the
+# OpTest-style dtype/grad sweep (tests/test_op_suite.py) deliberately
+# does not cover, with the reason. Everything else in the registry MUST
+# have an OpSpec row — enforced by TestOpTable.test_swept_or_waived.
+_WAIVER_GROUPS = {
+    "creation op: output determined by shape/argument metadata, no "
+    "numeric kernel to sweep (semantics in tests/test_ops.py)":
+        "arange assign clone empty empty_like eye full full_like "
+        "linspace logspace meshgrid ones ones_like to_tensor "
+        "tril_indices triu_indices zeros zeros_like cast",
+    "in-place variant: aliases the swept out-of-place op (in-place "
+    "semantics tested in tests/test_ops.py)":
+        "add_ clip_ divide_ exp_ fill_ fill_diagonal_ floor_ frac_ "
+        "index_fill_ masked_fill_ multiply_ relu_ remainder_ reshape_ "
+        "scale_ softmax_ subtract_ tril_ trunc_ unsqueeze_ where_ "
+        "zero_",
+    "alias of a swept op (same kernel)":
+        "negative remainder floor_mod inverse igamma igammac view "
+        "positive",
+    "stochastic output: RNG/determinism contracts tested in dedicated "
+    "suites (test_ops dropout tests, test_distribution_signal)":
+        "alpha_dropout dropout dropout2d dropout3d "
+        "feature_alpha_dropout gumbel_softmax rrelu "
+        "class_center_sample",
+    "attention/fused kernel: covered by dedicated equivalence suites "
+    "(test_flash_pallas, test_flash_varlen, test_paged_attention, "
+    "test_incubate_fused)":
+        "flash_attention flash_attn_unpadded flash_attn_varlen_func "
+        "scaled_dot_product_attention rms_norm",
+    "factorization with sign/permutation/phase ambiguity: "
+    "reconstruction-tested in test_linalg_ext":
+        "eig eigh eigvals eigvalsh qr svd lu lu_unpack lstsq "
+        "householder_product ormqr svd_lowrank",
+    "data-dependent output shape: incompatible with a static-shape "
+    "sweep (semantics in test_ops / test_fft_scatter)":
+        "nonzero unique unique_consecutive masked_select combinations",
+    "complex-dtype surface: swept inputs are real; covered in "
+    "test_distribution_signal (fft) and test_ops":
+        "angle as_complex as_real complex conj imag is_complex isreal "
+        "polar real",
+    "shape/metadata predicate or structural helper (exercised "
+    "throughout every suite)":
+        "is_empty is_floating_point is_integer is_tensor numel rank "
+        "shape atleast_1d atleast_3d broadcast_tensors as_strided "
+        "in_dynamic_mode",
+    "sequence-level loss with its own torch-parity suite "
+    "(test_nn_utils CTC tests)":
+        "ctc_loss",
+    "distributed-semantics op (rank-dependent output): covered by "
+    "multi-process tests (test_launch_elastic, test_models)":
+        "shard_index",
+    "API-parity context manager / no-op shim":
+        "sdp_kernel",
+}
+
+SWEEP_WAIVERS = {
+    name: reason
+    for reason, names in _WAIVER_GROUPS.items()
+    for name in names.split()
+}
+
+# names the dir()-walk must NOT register: internal helpers that leak
+# through public module namespaces
+_NOT_OPS = {
+    "apply_op", "np_or_jax", "next_key", "to_np_dtype", "builtins_min",
+}
+
+
+def undeclared_ops():
+    """The lint (VERDICT r2 #6): registry entries whose metadata came
+    from dir()-walk defaults rather than an explicit declaration
+    (_NONDIFF/_CREATION membership or a sweep waiver)."""
+    _populate()
+    return sorted(o.name for o in _TABLE.values() if not o.declared)
+
+
 _POPULATED = False
 
 
@@ -104,7 +183,7 @@ def _populate():
         (functional, "nn.functional"),
     ]:
         for name in dir(mod):
-            if name.startswith("_"):
+            if name.startswith("_") or name in _NOT_OPS:
                 continue
             fn = getattr(mod, name)
             if not callable(fn) or inspect.isclass(fn):
@@ -118,6 +197,12 @@ def _populate():
                 else _FLOAT
             register(name, fn, modname, differentiable=diff,
                      dtypes=dtypes)
+            od = _TABLE[name]
+            od.declared = (
+                name in _NONDIFF or name in _CREATION
+                or name in SWEEP_WAIVERS
+            )
+            od.sweep_waiver = SWEEP_WAIVERS.get(name, "")
 
 
 def dump():

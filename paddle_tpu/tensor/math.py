@@ -356,21 +356,42 @@ def trapezoid(y, x=None, dx=None, axis=-1, name=None):
 
 
 def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
-    import jax.scipy.integrate as _ji
+    # (jax.scipy.integrate has no cumulative_trapezoid; closed form:
+    # cumsum of successive trapezoid areas along `axis`)
+    def _pair(a):
+        ax = int(axis) % a.ndim
+        lo = jax.lax.slice_in_dim(a, 0, a.shape[ax] - 1, axis=ax)
+        hi = jax.lax.slice_in_dim(a, 1, a.shape[ax], axis=ax)
+        return lo, hi, ax
 
     y = _as_tensor(y)
     if x is not None:
         xt = _as_tensor(x)
-        return apply_op(
-            "cumulative_trapezoid",
-            lambda a, b: _ji.cumulative_trapezoid(a, b, axis=int(axis)),
-            y, xt,
-        )
+
+        def f(a, b):
+            alo, ahi, ax = _pair(a)
+            if b.ndim == 1 and a.ndim > 1:
+                # 1-D sample points integrate along `axis` (scipy
+                # contract): shape them to broadcast there, not on
+                # the trailing dim
+                shape = [1] * a.ndim
+                shape[ax] = b.shape[0]
+                b = b.reshape(shape)
+                blo = jax.lax.slice_in_dim(
+                    b, 0, b.shape[ax] - 1, axis=ax)
+                bhi = jax.lax.slice_in_dim(b, 1, b.shape[ax], axis=ax)
+            else:
+                blo, bhi, _ = _pair(b)
+            return jnp.cumsum((ahi + alo) / 2 * (bhi - blo), axis=ax)
+
+        return apply_op("cumulative_trapezoid", f, y, xt)
     step = 1.0 if dx is None else float(dx)
-    return apply_op(
-        "cumulative_trapezoid",
-        lambda a: _ji.cumulative_trapezoid(a, dx=step, axis=int(axis)), y,
-    )
+
+    def g(a):
+        lo, hi, ax = _pair(a)
+        return jnp.cumsum((hi + lo) / 2 * step, axis=ax)
+
+    return apply_op("cumulative_trapezoid", g, y)
 
 
 # -- matrix -----------------------------------------------------------------
