@@ -110,9 +110,12 @@ class PipelineParallel(MetaParallelBase):
                     f"divisible by num_stages ({S})"
                 )
             # chunk c = v*S + s holds layers [c*k, (c+1)*k): reshape to
-            # [V, S, k, ...]; device s owns [:, s]. (The flat [L, ...]
-            # storage is pp-sharded contiguously, so for V>1 this view
-            # re-lays params block-cyclically over ICI once per step.)
+            # [V, S, k, ...]; device s owns [:, s]. Measured (tools/
+            # exp_vpp.py --hlo + test_vpp_no_param_relayout_collectives):
+            # GSPMD keeps this view local — the compiled program's
+            # collective profile is byte-identical for V=1 and V>1
+            # (ring permutes move only activation buffers), so the
+            # block-cyclic view costs no per-step ICI re-layout.
             leaves = [
                 _constrain(
                     r.reshape((V, S, k) + tuple(r.shape[1:])),

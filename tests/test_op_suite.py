@@ -958,6 +958,23 @@ OPS += [
            lambda x: pmath.cumulative_trapezoid(x, axis=-1),
            lambda x: np.cumsum((x[..., 1:] + x[..., :-1]) / 2, -1),
            [(4, 8)]),
+    OpSpec("cumulative_trapezoid_x",
+           lambda y, x: pmath.cumulative_trapezoid(
+               y, x=pmath.cumsum(pmath.abs(x), axis=-1), axis=-1),
+           lambda y, x: np.cumsum(
+               (y[..., 1:] + y[..., :-1]) / 2
+               * np.diff(np.cumsum(np.abs(x), -1), axis=-1), -1),
+           [(4, 8), (4, 8)], op="cumulative_trapezoid"),
+    OpSpec("cumulative_trapezoid_x1d",
+           # 1-D sample points along a NON-last axis (the branch that
+           # broadcasts x onto `axis`)
+           lambda y: pmath.cumulative_trapezoid(
+               y, x=_t64(np.array([0.0, 1.0, 3.0, 3.5],
+                                  "float32")), axis=0),
+           lambda y: np.cumsum(
+               (y[1:] + y[:-1]) / 2
+               * np.diff([0.0, 1.0, 3.0, 3.5])[:, None], 0),
+           [(4, 8)], op="cumulative_trapezoid"),
     OpSpec("kthvalue",
            lambda x: search.kthvalue(x, 3, axis=-1)[0],
            None, [(4, 9)], grad=False),

@@ -119,6 +119,34 @@ def test_pipeline_body_params_pp_sharded(pp_env):
 
 
 class TestInterleavedVPP:
+    def test_vpp_no_param_relayout_collectives(self, pp_env):
+        """VERDICT r2 #5: the V>1 block-cyclic chunk view must not add
+        per-step resharding collectives — the compiled step's
+        collective profile (kinds, counts, operand bytes) must be
+        IDENTICAL to V=1, with ring permutes moving only activation
+        buffers. Measured property; this pins it against regression."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "exp_vpp", "tools/exp_vpp.py")
+        exp = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(exp)
+
+        profiles = {}
+        for V in (1, 2):
+            pp, model = exp._build(V)
+            lowered, _ = exp._lower(pp, model)
+            profiles[V] = exp.collective_profile(
+                lowered.compile().as_text())
+        assert profiles[1] == profiles[2], profiles
+        # ring permutes carry the [S, mb, D] activation buffer, not
+        # the [L, ...] parameter stacks (whose minor dim is 2*D)
+        hidden = str(2 * exp.D_DEFAULT)
+        perm_shapes = [s for k, s in profiles[2]
+                       if k == "collective-permute"]
+        assert perm_shapes, profiles
+        assert all(hidden not in s for s in perm_shapes), perm_shapes
+
     def test_interleaved_matches_sequential(self, pp_env):
         """V=2 interleaved schedule == sequential layers == V=1."""
         from paddle_tpu.distributed.fleet.meta_parallel import (

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""VPP re-layout tax experiment (VERDICT r2 #5).
+
+The interleaved pipeline stores body params as flat [L, ...] arrays
+pp-sharded contiguously; for V>1 the schedule's chunk c = v*S + s view
+reshapes them [V, S, k, ...] with pp on axis 1 — a block-cyclic
+re-layout the compiler may implement as per-step collectives.
+
+Modes:
+  python tools/exp_vpp.py --hlo      # CPU mesh: count resharding
+                                     # collectives in the compiled step
+                                     # for V=1 vs V>1 (runs anywhere)
+  python tools/exp_vpp.py            # on-chip step-time sweep V=1/2/4
+                                     # at fixed M*S (needs the TPU)
+"""
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+D_DEFAULT = 256
+
+
+def _build(V, S=4, L=8, M=8, D=D_DEFAULT, steps=0):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc,
+        PipelineLayer,
+        PipelineParallel,
+    )
+    from paddle_tpu.tensor.math import mean
+
+    class Block(nn.Layer):
+        def __init__(self, d=D):
+            super().__init__()
+            self.fc1 = nn.Linear(d, d * 2)
+            self.fc2 = nn.Linear(d * 2, d)
+
+        def forward(self, x):
+            return x + self.fc2(nn.functional.gelu(self.fc1(x)))
+
+    paddle.seed(5)
+    model = PipelineLayer(
+        layers=[LayerDesc(Block) for _ in range(L)],
+        num_stages=S,
+        loss_fn=lambda o, y: mean((o - y) * (o - y)),
+        virtual_pp_degree=V,
+    )
+    hcg = fleet.fleet.get_hybrid_communicate_group()
+    strategy = fleet.DistributedStrategy()
+    pp = PipelineParallel(model, hcg, strategy)
+    pp.accumulate_steps = M
+    return pp, model
+
+
+def _lower(pp, model, M=8, D=D_DEFAULT):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.core import Tensor
+
+    def run(hr):
+        return jax.grad(
+            lambda h: jnp.mean(pp._body_pipeline(Tensor(h))._data ** 2)
+        )(hr)
+
+    h = jnp.zeros((M, 2, D), jnp.float32)
+    return jax.jit(run).lower(h), h
+
+
+_COLL = re.compile(
+    r"(all-to-all|collective-permute|all-gather|all-reduce|"
+    r"reduce-scatter)", re.I)
+
+
+def collective_profile(txt):
+    """[(kind, result_shape_str)] for every collective in HLO text —
+    the one extraction shared by hlo_mode and the pipeline-suite
+    regression test."""
+    prof = []
+    for line in txt.splitlines():
+        m = _COLL.search(line)
+        if m and "=" in line:
+            shape = line.split("=", 1)[1].strip().split(" ")[0]
+            prof.append((m.group(1).lower(), shape))
+    return sorted(prof)
+
+
+def hlo_mode(vs=(1, 2)):
+    from paddle_tpu.distributed import fleet
+
+    out = {}
+    for V in vs:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 4}
+        fleet.init(is_collective=True, strategy=strategy)
+        pp, model = _build(V)
+        lowered, _ = _lower(pp, model)
+        txt = lowered.compile().as_text()
+        counts = {}
+        byts = {}
+        shape_re = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+        for k, shape in collective_profile(txt):
+            counts[k] = counts.get(k, 0) + 1
+            sm = shape_re.search(shape)
+            if sm and sm.group(2):
+                n = 1
+                for d in sm.group(2).split(","):
+                    n *= int(d)
+                width = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4,
+                         "u32": 4, "f64": 8}.get(sm.group(1), 4)
+                byts[k] = byts.get(k, 0) + n * width
+        mem = lowered.compile().memory_analysis()
+        out[f"V{V}"] = {
+            "collectives": counts,
+            "collective_out_bytes": byts,
+            "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+        }
+        from paddle_tpu.distributed.fleet.base.topology import _set_hcg
+        from paddle_tpu.distributed.mesh import reset_mesh
+
+        reset_mesh()
+        _set_hcg(None)
+    print(json.dumps({"mode": "hlo-cpu-mesh", **out}, indent=1))
+    return out
+
+
+def chip_mode(vs=(1, 2, 4), steps=20):
+    """On-chip: a single chip still executes the full schedule (mesh
+    axes size 1), so V differences isolate the re-layout + schedule
+    overhead without ICI; on a real pod rerun with pp>1."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+
+    out = {}
+    for V in vs:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        # single-chip: S=4 virtual stages on one device
+        pp, model = _build(V)
+        import paddle_tpu.optimizer as optim
+
+        opt = optim.AdamW(1e-3, parameters=model.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 2, 256).astype("float32"))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randn(8, 2, 1).astype("float32"))
+        pp.train_batch((x, y), opt)  # compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = pp.train_batch((x, y), opt)
+        float(np.asarray(loss._data))
+        out[f"V{V}"] = {
+            "step_ms": round(
+                1000 * (time.perf_counter() - t0) / steps, 2),
+        }
+        from paddle_tpu.distributed.fleet.base.topology import _set_hcg
+        from paddle_tpu.distributed.mesh import reset_mesh
+
+        reset_mesh()
+        _set_hcg(None)
+    print(json.dumps({"mode": "tpu-single-chip", **out}, indent=1))
+    return out
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hlo", action="store_true")
+    a = ap.parse_args()
+    if a.hlo:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        hlo_mode()
+    else:
+        chip_mode()
