@@ -301,7 +301,7 @@ def bench_varlen(steps=20, total=8192, h=16, d=128):
     interp_smoke = kind.startswith("cpu")
     if interp_smoke:
         # smoke only: interpret-mode Pallas at a tiny size
-        paddle.set_flags({"FLAGS_flash_pallas_interpret": True})
+        paddle.set_flags({"FLAGS_pallas_interpret": True})
         total, h, steps = 512, 2, 2
         lens = [256, 128, 64, 64]
     else:
@@ -351,7 +351,7 @@ def bench_varlen(steps=20, total=8192, h=16, d=128):
         t_masked = timed(jax.checkpoint(masked))
     finally:
         if interp_smoke:
-            paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+            paddle.set_flags({"FLAGS_pallas_interpret": False})
     # useful attention flops (causal within segments, fwd+bwd ~3.5x)
     flops = sum(3.5 * 4 * h * d * (s * s) / 2 for s in lens)
     return {

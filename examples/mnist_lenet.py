@@ -18,9 +18,17 @@ from paddle_tpu.vision.datasets import MNIST
 from paddle_tpu.vision.models import LeNet
 
 
-def main(epochs=1, batch_size=64, limit_batches=None):
+def main(epochs=1, batch_size=64, limit_batches=None, num_workers=2):
+    # Multiprocess loading (spawn workers + shared-memory batch
+    # transport) requires the dataset and collate_fn to be PICKLABLE:
+    # define them at module level (as here — MNIST is an importable
+    # class), never inline in __main__ or a notebook cell, and keep the
+    # `if __name__ == "__main__":` guard below (spawn re-imports
+    # __main__). Unpicklable datasets silently downgrade to GIL-bound
+    # threads with only a warning.
     train = MNIST(mode="train")
-    loader = DataLoader(train, batch_size=batch_size, shuffle=True)
+    loader = DataLoader(train, batch_size=batch_size, shuffle=True,
+                        num_workers=num_workers)
     if limit_batches:
         import itertools
 

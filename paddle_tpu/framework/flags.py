@@ -84,6 +84,9 @@ define_flag("flash_precision_highest", False,
             "force fp32-emulated (multi-pass) MXU multiplies in the "
             "Pallas flash-attention kernels; default uses native bf16 "
             "single-pass with fp32 accumulation")
-define_flag("flash_pallas_interpret", False,
-            "run the Pallas flash-attention kernels in interpret mode "
+define_flag("pallas_interpret", False,
+            "run the Pallas kernels in interpret mode "
             "off-TPU (CI coverage of the kernel path on CPU)")
+if os.environ.get("FLAGS_flash_pallas_interpret"):
+    # pre-rename env alias (was flash-only before covering all kernels)
+    _REGISTRY["pallas_interpret"] = True

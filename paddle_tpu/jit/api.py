@@ -57,7 +57,7 @@ class StaticFunction:
     # on them would force a full retrace/recompile for a no-op change.
     _TRACE_FLAGS = (
         "check_nan_inf", "use_pallas_flash_bwd", "use_pallas_kernels",
-        "flash_precision_highest", "flash_pallas_interpret",
+        "flash_precision_highest", "pallas_interpret",
     )
 
     def _mode_sig(self):

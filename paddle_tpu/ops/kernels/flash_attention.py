@@ -449,13 +449,9 @@ def _flash_bwd_chunked(q, k, v, out, lse, do, causal, scale, block_k,
 
 
 def _interpret():
-    """True when the Pallas flash path should run in interpret mode
-    (CI coverage on CPU via FLAGS_flash_pallas_interpret)."""
-    from . import on_tpu
+    from . import interpret_mode
 
-    from ...framework.flags import flag
-
-    return (not on_tpu()) and flag("flash_pallas_interpret")
+    return interpret_mode()
 
 
 def _pallas_ok(q, k, block_q, block_k):

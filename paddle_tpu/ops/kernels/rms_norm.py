@@ -42,7 +42,7 @@ def _rms_kernel(eps, has_w, x_ref, *refs):
     o_ref[:] = y.astype(o_ref.dtype)
 
 
-def _rms_pallas(x2d, w, eps):
+def _rms_pallas(x2d, w, eps, interpret=False):
     n, h = x2d.shape
     br = _choose_block_rows(n, h, x2d.dtype.itemsize)
     grid = (n // br,) if n % br == 0 else (n,)
@@ -59,6 +59,7 @@ def _rms_pallas(x2d, w, eps):
         grid=grid,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((br, h), lambda i: (i, 0)),
+        interpret=interpret,
     )(*args)
 
 
@@ -73,11 +74,14 @@ def _rms_ref(x, w, eps):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _rms_norm_core(x, w, eps):
-    from . import use_pallas
+    from . import interpret_mode, record_dispatch, use_pallas
 
-    if use_pallas() and x.shape[-1] % 128 == 0:
+    ok = (use_pallas() or interpret_mode()) and x.shape[-1] % 128 == 0
+    record_dispatch("rms_norm", ok)
+    if ok:
         shape = x.shape
-        out = _rms_pallas(x.reshape(-1, shape[-1]), w, eps)
+        out = _rms_pallas(x.reshape(-1, shape[-1]), w, eps,
+                          interpret=interpret_mode())
         return out.reshape(shape)
     return _rms_ref(x, w, eps)
 
@@ -135,22 +139,31 @@ def _ln_kernel(eps, has_w, has_b, x_ref, *refs):
     o_ref[:] = y.astype(o_ref.dtype)
 
 
+def _ln_ref(x, weight, bias, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    y = xc * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def layer_norm_fused(x, weight=None, bias=None, eps=1e-5):
-    """Pallas fused layer_norm over the last axis (fwd); XLA autodiff bwd."""
-    from . import use_pallas
+    """Pallas fused layer_norm over the last axis (fwd); XLA autodiff
+    bwd via the reference formula (pallas_call itself has no transpose
+    rule, so reverse-mode MUST go through this custom VJP)."""
+    from . import interpret_mode, record_dispatch, use_pallas
 
     h = x.shape[-1]
-    if not (use_pallas() and h % 128 == 0):
-        xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=-1, keepdims=True)
-        xc = xf - mean
-        var = jnp.mean(xc * xc, axis=-1, keepdims=True)
-        y = xc * jax.lax.rsqrt(var + eps)
-        if weight is not None:
-            y = y * weight.astype(jnp.float32)
-        if bias is not None:
-            y = y + bias.astype(jnp.float32)
-        return y.astype(x.dtype)
+    ok = (use_pallas() or interpret_mode()) and h % 128 == 0
+    record_dispatch("layer_norm_fused", ok)
+    if not ok:
+        return _ln_ref(x, weight, bias, eps)
 
     shape = x.shape
     x2d = x.reshape(-1, h)
@@ -172,5 +185,32 @@ def layer_norm_fused(x, weight=None, bias=None, eps=1e-5):
         grid=(n // br,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((br, h), lambda i: (i, 0)),
+        interpret=interpret_mode(),
     )(*args)
     return out.reshape(shape)
+
+
+def _ln_fwd(x, weight, bias, eps):
+    return layer_norm_fused(x, weight, bias, eps), (x, weight, bias)
+
+
+def _ln_bwd(eps, res, g):
+    x, weight, bias = res
+    diff = [x] + [a for a in (weight, bias) if a is not None]
+
+    def f(*aa):
+        it = iter(aa)
+        xx = next(it)
+        ww = next(it) if weight is not None else None
+        bb = next(it) if bias is not None else None
+        return _ln_ref(xx, ww, bb, eps)
+
+    _, vjp = jax.vjp(f, *diff)
+    grads = list(vjp(g))
+    dx = grads.pop(0)
+    dw = grads.pop(0) if weight is not None else None
+    db = grads.pop(0) if bias is not None else None
+    return dx, dw, db
+
+
+layer_norm_fused.defvjp(_ln_fwd, _ln_bwd)

@@ -135,16 +135,16 @@ class TestFlashBwdPallasInterpret:
 
 class TestFlashDispatchInterpret:
     """Public API e2e through the Pallas path via
-    FLAGS_flash_pallas_interpret (the CI stand-in for on_tpu)."""
+    FLAGS_pallas_interpret (the CI stand-in for on_tpu)."""
 
     @pytest.fixture()
     def interp_flag(self):
-        paddle.set_flags({"FLAGS_flash_pallas_interpret": True})
+        paddle.set_flags({"FLAGS_pallas_interpret": True})
         from paddle_tpu.ops.kernels import kernel_dispatch_stats
 
         kernel_dispatch_stats(reset=True)
         yield
-        paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+        paddle.set_flags({"FLAGS_pallas_interpret": False})
 
     def test_public_api_takes_pallas_and_matches_fallback(self, interp_flag):
         from paddle_tpu.ops.kernels import kernel_dispatch_stats
@@ -162,7 +162,7 @@ class TestFlashDispatchInterpret:
         assert stats.get("flash_fwd:pallas", 0) >= 1, stats
         assert stats.get("flash_bwd:pallas", 0) >= 1, stats
 
-        paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+        paddle.set_flags({"FLAGS_pallas_interpret": False})
         g_ref = jax.grad(loss, argnums=(0, 1, 2))(*qkv)
         for gp, gr in zip(g_pallas, g_ref):
             np.testing.assert_allclose(gp, gr, atol=5e-4, rtol=5e-4)
@@ -185,7 +185,7 @@ class TestFlashDispatchInterpret:
         stats = kernel_dispatch_stats(reset=True)
         assert stats.get("flash_bwd:pallas", 0) >= 1, stats
 
-        paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+        paddle.set_flags({"FLAGS_pallas_interpret": False})
         g_ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
         for gp, gr in zip(g_pallas, g_ref):
             np.testing.assert_allclose(gp, gr, atol=5e-4, rtol=5e-4)

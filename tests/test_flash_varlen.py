@@ -67,7 +67,7 @@ class TestVarlenPallasInterpret:
             "paddle_tpu.ops.kernels.flash_varlen")
         q, k, v, cu = self._case(lens, h=h, hkv=hkv, d=d, seed=seed)
 
-        paddle.set_flags({"FLAGS_flash_pallas_interpret": True})
+        paddle.set_flags({"FLAGS_pallas_interpret": True})
         try:
             got = fv.varlen_attention(
                 jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
@@ -75,7 +75,7 @@ class TestVarlenPallasInterpret:
                 1.0 / np.sqrt(d), block_q=block, block_k=block,
             )
         finally:
-            paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+            paddle.set_flags({"FLAGS_pallas_interpret": False})
 
         ref, _ = F.flash_attn_unpadded(
             paddle.to_tensor(q), paddle.to_tensor(k),
@@ -125,12 +125,12 @@ class TestVarlenPallasInterpret:
                 1.0 / np.sqrt(d), block_q=64, block_k=64)
             return jnp.vdot(o, jnp.asarray(do))
 
-        paddle.set_flags({"FLAGS_flash_pallas_interpret": True})
+        paddle.set_flags({"FLAGS_pallas_interpret": True})
         try:
             gq, gk, gv = jax.grad(loss_kernel, argnums=(0, 1, 2))(
                 jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
         finally:
-            paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+            paddle.set_flags({"FLAGS_pallas_interpret": False})
 
         # oracle grads through the public masked path
         qt = paddle.to_tensor(q, stop_gradient=False)
@@ -152,7 +152,7 @@ class TestVarlenPallasInterpret:
 
         lens = [200, 312]  # total 512 — tileable
         q, k, v, cu = self._case(lens)
-        paddle.set_flags({"FLAGS_flash_pallas_interpret": True})
+        paddle.set_flags({"FLAGS_pallas_interpret": True})
         kernel_dispatch_stats(reset=True)
         try:
             qt = paddle.to_tensor(q, stop_gradient=False)
@@ -165,7 +165,7 @@ class TestVarlenPallasInterpret:
             assert stats.get("flash_varlen:pallas", 0) >= 1, stats
             assert np.isfinite(qt.grad.numpy()).all()
         finally:
-            paddle.set_flags({"FLAGS_flash_pallas_interpret": False})
+            paddle.set_flags({"FLAGS_pallas_interpret": False})
 
 
 def test_unpadded_gqa_and_grad():

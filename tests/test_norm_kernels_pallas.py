@@ -1,0 +1,124 @@
+"""Interpret-mode CI for the rms_norm / fused layer_norm Pallas
+kernels (the same treatment VERDICT r2 #2 prescribed for flash: the
+kernels must run in every suite execution, vs the XLA reference).
+Upstream analog: paddle/phi/kernels/gpu/rms_norm_kernel.cu,
+layer_norm_kernel.cu OpTests."""
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+rn = importlib.import_module("paddle_tpu.ops.kernels.rms_norm")
+
+
+@pytest.fixture()
+def interp_flag():
+    from paddle_tpu.ops.kernels import kernel_dispatch_stats
+
+    paddle.set_flags({"FLAGS_pallas_interpret": True})
+    kernel_dispatch_stats(reset=True)
+    yield
+    paddle.set_flags({"FLAGS_pallas_interpret": False})
+
+
+def _x(shape=(4, 6, 256), dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(*shape) * 1.5 + 0.3, dtype)
+
+
+class TestRmsNormPallasInterpret:
+    def test_matches_ref(self, interp_flag):
+        from paddle_tpu.ops.kernels import kernel_dispatch_stats
+
+        x = _x()
+        w = _x((256,), seed=1)
+        got = rn.rms_norm(x, w)
+        stats = kernel_dispatch_stats(reset=True)
+        assert stats.get("rms_norm:pallas", 0) >= 1, stats
+        ref = rn._rms_ref(x, w, 1e-6)
+        np.testing.assert_allclose(got, ref, atol=1e-6, rtol=1e-6)
+
+    def test_no_weight(self, interp_flag):
+        x = _x()
+        np.testing.assert_allclose(
+            rn.rms_norm(x), rn._rms_ref(x, None, 1e-6),
+            atol=1e-6, rtol=1e-6)
+
+    def test_bf16(self, interp_flag):
+        x = _x(dtype=jnp.bfloat16)
+        w = _x((256,), dtype=jnp.bfloat16, seed=1)
+        got = rn.rms_norm(x, w).astype(jnp.float32)
+        ref = rn._rms_ref(
+            x.astype(jnp.float32), w.astype(jnp.float32), 1e-6)
+        np.testing.assert_allclose(got, ref, atol=3e-2, rtol=3e-2)
+
+    def test_grad_through_custom_vjp(self, interp_flag):
+        from paddle_tpu.ops.kernels import kernel_dispatch_stats
+
+        x = _x((8, 128))
+        w = _x((128,), seed=2)
+
+        def loss(x, w):
+            return jnp.sum(rn.rms_norm(x, w) ** 2)
+
+        gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+        stats = kernel_dispatch_stats(reset=True)
+        assert stats.get("rms_norm:pallas", 0) >= 1, stats
+
+        paddle.set_flags({"FLAGS_pallas_interpret": False})
+        rx, rw = jax.grad(loss, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(gx, rx, atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(gw, rw, atol=1e-5, rtol=1e-5)
+
+    def test_fallback_for_non_lane_multiple(self, interp_flag):
+        from paddle_tpu.ops.kernels import kernel_dispatch_stats
+
+        rn.rms_norm(_x((4, 100)))  # 100 % 128 != 0
+        stats = kernel_dispatch_stats(reset=True)
+        assert stats.get("rms_norm:xla_fallback", 0) >= 1, stats
+
+
+class TestLayerNormFusedPallasInterpret:
+    @pytest.mark.parametrize("has_w,has_b", [
+        (False, False), (True, False), (True, True)])
+    def test_grad_through_custom_vjp(self, interp_flag, has_w, has_b):
+        # pallas_call has no transpose rule: reverse-mode through the
+        # fused path MUST take the custom VJP (r3 review finding)
+        from paddle_tpu.ops.kernels import kernel_dispatch_stats
+
+        x = _x((8, 128))
+        w = _x((128,), seed=5) if has_w else None
+        b = _x((128,), seed=6) if has_b else None
+
+        def loss(x):
+            return jnp.sum(rn.layer_norm_fused(x, w, b) ** 2)
+
+        gx = jax.grad(loss)(x)
+        stats = kernel_dispatch_stats(reset=True)
+        assert stats.get("layer_norm_fused:pallas", 0) >= 1, stats
+        paddle.set_flags({"FLAGS_pallas_interpret": False})
+        rx = jax.grad(loss)(x)
+        np.testing.assert_allclose(gx, rx, atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("has_w,has_b", [
+        (False, False), (True, False), (True, True)])
+    def test_matches_xla(self, interp_flag, has_w, has_b):
+        from paddle_tpu.ops.kernels import kernel_dispatch_stats
+
+        x = _x()
+        w = _x((256,), seed=3) if has_w else None
+        b = _x((256,), seed=4) if has_b else None
+        got = rn.layer_norm_fused(x, w, b)
+        stats = kernel_dispatch_stats(reset=True)
+        assert stats.get("layer_norm_fused:pallas", 0) >= 1, stats
+
+        paddle.set_flags({"FLAGS_pallas_interpret": False})
+        ref = rn.layer_norm_fused(x, w, b)  # XLA fallback path
+        stats = kernel_dispatch_stats(reset=True)
+        assert stats.get("layer_norm_fused:xla_fallback", 0) >= 1
+        np.testing.assert_allclose(got, ref, atol=1e-6, rtol=1e-6)
