@@ -91,6 +91,27 @@ class PagedKVCacheManager:
         self._lens[seq_id] += 1
         return page, off
 
+    def append_batch(self, seq_ids, k_toks, v_toks):
+        """Write one token's K/V for EVERY listed sequence in one
+        scatter per pages array (the hot serving path: B sequences x
+        L layers must not issue B*L separate updates). k_toks/v_toks:
+        (B, KVH, D) arrays or Tensors."""
+        k_toks = k_toks._data if isinstance(k_toks, Tensor) else k_toks
+        v_toks = v_toks._data if isinstance(v_toks, Tensor) else v_toks
+        pages = []
+        offs = []
+        for s in seq_ids:
+            page, off = self._next_slot(s)
+            self._lens[s] += 1
+            pages.append(page)
+            offs.append(off)
+        pg = jnp.asarray(pages, jnp.int32)
+        of = jnp.asarray(offs, jnp.int32)
+        self.k_pages = self.k_pages.at[pg, of].set(
+            k_toks.astype(self.k_pages.dtype))
+        self.v_pages = self.v_pages.at[pg, of].set(
+            v_toks.astype(self.v_pages.dtype))
+
     # -- kernel inputs -----------------------------------------------------
     def page_table(self, seq_ids, max_pages=None):
         mp = max_pages or max(

@@ -21,9 +21,11 @@ __all__ = [
     "Request",
     "BatchScheduler",
     "RequestState",
+    "PagedLlamaAdapter",
 ]
 
 from .serving import BatchScheduler, Request, RequestState  # noqa: E402
+from .paged_llama import PagedLlamaAdapter  # noqa: E402
 
 
 class PlaceType:

@@ -1,0 +1,108 @@
+"""Paged-cache serving adapter for LlamaForCausalLM.
+
+Upstream analog: PaddleNLP's serving of fused_multi_transformer —
+a trained model served with a paged (block) KV cache instead of the
+dense per-request cache. This adapter exposes a trained
+``LlamaForCausalLM`` through the BatchScheduler model protocol
+(``alloc`` / ``free`` / ``decode_token`` / ``caches``): every decode
+step is ONE paged-attention Pallas kernel call per layer over the
+whole ragged batch, with pages shared from a fixed pool.
+
+The adapter reuses the model's own weights/layers (no copy): embed →
+per layer (rms_norm → qkv → RoPE at each sequence's own position →
+paged append + attend → o_proj → mlp) → final norm → lm head.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, no_grad
+from ..incubate.nn import PagedKVCacheManager
+from ..ops.kernels.rope import apply_rotary_emb, build_rope_cache
+from ..tensor.manipulation import reshape
+
+__all__ = ["PagedLlamaAdapter"]
+
+
+class PagedLlamaAdapter:
+    """Serve a LlamaForCausalLM from a paged KV pool.
+
+    ``num_pages`` x ``page_size`` tokens per layer; ``max_length``
+    bounds RoPE positions. Works with the BatchScheduler or driven
+    directly via decode_token.
+    """
+
+    def __init__(self, model, num_pages=256, page_size=16,
+                 max_length=None, dtype=None):
+        self.model = model
+        cfg = model.config
+        self.cfg = cfg
+        if dtype is None:
+            dtype = model.model.embed_tokens.weight._data.dtype
+        self.max_length = int(max_length or cfg.max_position_embeddings)
+        self.caches = [
+            PagedKVCacheManager(
+                num_pages, page_size, cfg.num_key_value_heads,
+                cfg.head_dim, dtype=dtype,
+            )
+            for _ in range(cfg.num_hidden_layers)
+        ]
+        self._cos, self._sin = build_rope_cache(
+            self.max_length, cfg.head_dim, base=cfg.rope_theta,
+            dtype=jnp.float32,
+        )
+
+    # -- scheduler protocol ------------------------------------------------
+    def alloc(self, seq_id):
+        for c in self.caches:
+            c.alloc(seq_id)
+
+    def free(self, seq_id):
+        for c in self.caches:
+            c.free(seq_id)
+
+    def decode_token(self, token_ids, seq_ids):
+        """One token per listed sequence; returns logits (B, vocab)."""
+        cfg = self.cfg
+        b = len(seq_ids)
+        nh, nkv, hd = (cfg.num_attention_heads,
+                       cfg.num_key_value_heads, cfg.head_dim)
+        # this token's position in each sequence = tokens already cached
+        lens = [self.caches[0].seq_len(s) for s in seq_ids]
+        over = [s for s, n in zip(seq_ids, lens) if n >= self.max_length]
+        if over:
+            # jnp.take would silently clamp the RoPE position, rotating
+            # every later token with the wrong phase — fail loudly
+            raise ValueError(
+                f"sequences {over} reached max_length="
+                f"{self.max_length}; positions beyond it cannot be "
+                "rotary-encoded"
+            )
+        pos = jnp.asarray(lens, jnp.int32)[:, None]  # (B, 1)
+
+        with no_grad():
+            ids = Tensor(np.asarray(token_ids, "int64")[:, None])
+            x = self.model.model.embed_tokens(ids)[:, 0]  # (B, H)
+            for li, layer in enumerate(self.model.model.layers):
+                xi = layer.input_layernorm(x)
+                q = layer.self_attn.q_proj(xi)
+                k = layer.self_attn.k_proj(xi)
+                v = layer.self_attn.v_proj(xi)
+                qh = q._data.reshape(b, 1, nh, hd)
+                kh = k._data.reshape(b, 1, nkv, hd)
+                vh = v._data.reshape(b, 1, nkv, hd)
+                qh = apply_rotary_emb(
+                    qh, self._cos, self._sin, position_ids=pos)
+                kh = apply_rotary_emb(
+                    kh, self._cos, self._sin, position_ids=pos)
+                self.caches[li].append_batch(
+                    seq_ids, kh[:, 0], vh[:, 0])
+                attn = self.caches[li].attend(
+                    Tensor(qh[:, 0]), seq_ids)  # (B, nh, hd)
+                attn_flat = reshape(attn, [b, nh * hd])
+                x = x + layer.self_attn.o_proj(attn_flat)
+                x = x + layer.mlp(layer.post_attention_layernorm(x))
+            h = self.model.model.norm(x)
+            return self.model._head(h)
