@@ -1,0 +1,281 @@
+"""InferMeta — systematic per-op shape/dtype inference + validation.
+
+Upstream analog: paddle/phi/infermeta/{unary,binary,ternary,multiary}.cc
+— one rule per op family, shared by every execution path, raising
+actionable errors BEFORE the kernel runs. Here the rules are pure
+shape functions over ShapeSpec-like tuples: the eager path calls them
+from the public API wrappers for the error-prone op families (matmul/
+bmm, elementwise broadcast, concat/stack, conv/pool, norm, gather/
+scatter, reductions), and `infer_meta(op, *specs)` exposes them for
+static analysis (InputSpec checking, cost models).
+
+Under tracing the validations still run — shapes are static in XLA —
+so a bad program fails at trace time with a paddle-style message
+instead of deep inside an XLA primitive.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+__all__ = ["MetaError", "infer_meta", "register_meta", "has_meta"]
+
+
+class MetaError(ValueError):
+    """Shape/dtype contract violation, named after the op that raised
+    it (the reference's PADDLE_ENFORCE surface)."""
+
+    def __init__(self, op: str, msg: str):
+        super().__init__(f"{op}: {msg}")
+        self.op = op
+
+
+_RULES = {}
+
+
+def register_meta(name):
+    def deco(fn):
+        _RULES[name] = fn
+        return fn
+
+    return deco
+
+
+def has_meta(name) -> bool:
+    return name in _RULES
+
+
+def infer_meta(name, *shapes, **kw) -> Tuple[int, ...]:
+    """Validate + return the output shape for op `name` given input
+    shapes (tuples). Raises MetaError on contract violations."""
+    if name not in _RULES:
+        raise KeyError(f"no InferMeta rule for op {name!r}")
+    return _RULES[name](*[tuple(s) for s in shapes], **kw)
+
+
+# -- helpers ---------------------------------------------------------------
+
+
+def _bcast(op, a: Sequence[int], b: Sequence[int]) -> Tuple[int, ...]:
+    out = []
+    for da, db in zip(((1,) * len(b) + tuple(a))[-max(len(a), len(b)):],
+                      ((1,) * len(a) + tuple(b))[-max(len(a), len(b)):]):
+        if da != db and 1 not in (da, db):
+            raise MetaError(
+                op,
+                f"operands could not be broadcast together: shapes "
+                f"{tuple(a)} vs {tuple(b)} (dim {da} vs {db})",
+            )
+        out.append(max(da, db))
+    return tuple(out)
+
+
+def _norm_axis(op, axis: int, rank: int) -> int:
+    if not -rank <= axis < rank:
+        raise MetaError(
+            op, f"axis {axis} out of range for rank-{rank} input "
+            f"(expected [-{rank}, {rank}))"
+        )
+    return axis % rank
+
+
+# -- rules -----------------------------------------------------------------
+
+
+@register_meta("elementwise")
+def _elementwise(a, b, op="elementwise"):
+    return _bcast(op, a, b)
+
+
+@register_meta("matmul")
+def _matmul(a, b, transpose_x=False, transpose_y=False):
+    if len(a) == 0 or len(b) == 0:
+        raise MetaError("matmul", "inputs must be at least 1-D")
+    av = a if not transpose_x or len(a) < 2 else \
+        a[:-2] + (a[-1], a[-2])
+    bv = b if not transpose_y or len(b) < 2 else \
+        b[:-2] + (b[-1], b[-2])
+    if len(av) == 1:
+        av = (1,) + av
+    if len(bv) == 1:
+        bv = bv + (1,)
+    if av[-1] != bv[-2]:
+        raise MetaError(
+            "matmul",
+            f"contracted dims mismatch: x{tuple(a)}"
+            f"{'^T' if transpose_x else ''} @ y{tuple(b)}"
+            f"{'^T' if transpose_y else ''} needs K=={av[-1]} on x and "
+            f"K=={bv[-2]} on y",
+        )
+    batch = _bcast("matmul", av[:-2], bv[:-2])
+    out = batch + (av[-2], bv[-1])
+    if len(a) == 1:
+        out = out[:-2] + (out[-1],)
+    if len(b) == 1:
+        out = out[:-1]
+    return out
+
+
+@register_meta("bmm")
+def _bmm(a, b):
+    if len(a) != 3 or len(b) != 3:
+        raise MetaError("bmm", f"inputs must be rank-3, got {a} and {b}")
+    if a[0] != b[0]:
+        raise MetaError("bmm", f"batch dims differ: {a[0]} vs {b[0]}")
+    if a[2] != b[1]:
+        raise MetaError(
+            "bmm", f"contracted dims mismatch: {a} @ {b}")
+    return (a[0], a[1], b[2])
+
+
+@register_meta("concat")
+def _concat(*shapes, axis=0):
+    if not shapes:
+        raise MetaError("concat", "needs at least one input")
+    rank = len(shapes[0])
+    ax = _norm_axis("concat", axis, rank)
+    out = list(shapes[0])
+    for i, s in enumerate(shapes[1:], 1):
+        if len(s) != rank:
+            raise MetaError(
+                "concat",
+                f"input {i} has rank {len(s)}, expected {rank}")
+        for d in range(rank):
+            if d != ax and s[d] != out[d]:
+                raise MetaError(
+                    "concat",
+                    f"input {i} shape {s} differs from {tuple(shapes[0])} "
+                    f"on non-concat dim {d}")
+        out[ax] += s[ax]
+    return tuple(out)
+
+
+@register_meta("stack")
+def _stack(*shapes, axis=0):
+    first = shapes[0]
+    for i, s in enumerate(shapes[1:], 1):
+        if s != first:
+            raise MetaError(
+                "stack", f"input {i} shape {s} != input 0 shape {first}")
+    ax = _norm_axis("stack", axis, len(first) + 1)
+    return first[:ax] + (len(shapes),) + first[ax:]
+
+
+@register_meta("reduce")
+def _reduce(a, axis=None, keepdim=False, op="reduce"):
+    if axis is None:
+        return (1,) * len(a) if keepdim else ()
+    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+    axes = {_norm_axis(op, ax, len(a)) for ax in axes}
+    if keepdim:
+        return tuple(1 if i in axes else d for i, d in enumerate(a))
+    return tuple(d for i, d in enumerate(a) if i not in axes)
+
+
+def _conv_out(op, i, k, stride, pad, dilation):
+    eff = (k - 1) * dilation + 1
+    o = (i + 2 * pad - eff) // stride + 1
+    if o <= 0:
+        raise MetaError(
+            op,
+            f"output size {o} <= 0: input {i} too small for kernel {k} "
+            f"(stride={stride}, padding={pad}, dilation={dilation})")
+    return o
+
+
+@register_meta("conv")
+def _conv(x, w, stride=1, padding=0, dilation=1, groups=1, op="conv"):
+    nsp = len(x) - 2
+    if len(w) != nsp + 2:
+        raise MetaError(
+            op, f"weight rank {len(w)} does not match input rank "
+            f"{len(x)} (expected {nsp + 2})")
+    if x[1] != w[1] * groups:
+        raise MetaError(
+            op,
+            f"input channels {x[1]} != weight in-channels {w[1]} x "
+            f"groups {groups}")
+    if w[0] % groups:
+        raise MetaError(
+            op, f"out channels {w[0]} not divisible by groups {groups}")
+    sp = tuple(
+        _conv_out(op, x[2 + i], w[2 + i], stride, padding, dilation)
+        for i in range(nsp)
+    )
+    return (x[0], w[0]) + sp
+
+
+@register_meta("pool")
+def _pool(x, kernel_size, stride=None, padding=0, op="pool"):
+    nsp = len(x) - 2
+    stride = stride or kernel_size
+    sp = tuple(
+        _conv_out(op, x[2 + i], kernel_size, stride, padding, 1)
+        for i in range(nsp)
+    )
+    return x[:2] + sp
+
+
+@register_meta("layer_norm")
+def _layer_norm(x, normalized_shape, weight=None, bias=None):
+    ns = tuple(normalized_shape) if isinstance(
+        normalized_shape, (tuple, list)) else (normalized_shape,)
+    if tuple(x[-len(ns):]) != ns:
+        raise MetaError(
+            "layer_norm",
+            f"normalized_shape {ns} does not match input trailing dims "
+            f"{tuple(x[-len(ns):])} of shape {tuple(x)}")
+    for nm, s in (("weight", weight), ("bias", bias)):
+        if s is not None and tuple(s) != ns:
+            raise MetaError(
+                "layer_norm",
+                f"{nm} shape {tuple(s)} != normalized_shape {ns}")
+    return tuple(x)
+
+
+@register_meta("gather")
+def _gather(x, index, axis=0):
+    ax = _norm_axis("gather", axis, len(x))
+    if len(index) != 1:
+        raise MetaError(
+            "gather", f"index must be 1-D, got rank {len(index)}")
+    return x[:ax] + (index[0],) + x[ax + 1:]
+
+
+@register_meta("scatter")
+def _scatter(x, index, updates):
+    if len(index) != 1:
+        raise MetaError(
+            "scatter", f"index must be 1-D, got rank {len(index)}")
+    if updates[0] != index[0]:
+        raise MetaError(
+            "scatter",
+            f"updates dim 0 ({updates[0]}) != index length ({index[0]})")
+    if tuple(updates[1:]) != tuple(x[1:]):
+        raise MetaError(
+            "scatter",
+            f"updates trailing shape {tuple(updates[1:])} != x trailing "
+            f"shape {tuple(x[1:])}")
+    return tuple(x)
+
+
+@register_meta("embedding")
+def _embedding(ids, weight):
+    if len(weight) != 2:
+        raise MetaError(
+            "embedding", f"weight must be rank-2, got {tuple(weight)}")
+    return tuple(ids) + (weight[1],)
+
+
+@register_meta("linear")
+def _linear(x, w, b=None):
+    if len(w) != 2:
+        raise MetaError(
+            "linear", f"weight must be rank-2 [in, out], got {tuple(w)}")
+    if x[-1] != w[0]:
+        raise MetaError(
+            "linear",
+            f"input features {x[-1]} != weight in-features {w[0]}")
+    if b is not None and tuple(b) != (w[1],):
+        raise MetaError(
+            "linear", f"bias shape {tuple(b)} != (out={w[1]},)")
+    return tuple(x[:-1]) + (w[1],)

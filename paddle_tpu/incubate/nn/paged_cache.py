@@ -98,6 +98,17 @@ class PagedKVCacheManager:
         (B, KVH, D) arrays or Tensors."""
         k_toks = k_toks._data if isinstance(k_toks, Tensor) else k_toks
         v_toks = v_toks._data if isinstance(v_toks, Tensor) else v_toks
+        # atomicity: validate capacity BEFORE any bookkeeping mutation,
+        # so exhaustion cannot leave some sequences' lens ahead of
+        # their actual device writes
+        new_pages_needed = sum(
+            1 for s in seq_ids if self._lens[s] % self.page_size == 0
+        )
+        if new_pages_needed > len(self._free):
+            raise RuntimeError(
+                f"KV page pool exhausted: batch needs "
+                f"{new_pages_needed} new pages, {len(self._free)} free"
+            )
         pages = []
         offs = []
         for s in seq_ids:

@@ -114,6 +114,14 @@ class BatchScheduler:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 0:
             raise ValueError("max_new_tokens must be >= 0")
+        # context-length bound (models that declare one): rejecting at
+        # submit beats a mid-batch crash for every co-batched request
+        limit = getattr(self.model, "max_length", None)
+        if limit is not None and req.total_tokens() > limit:
+            raise ValueError(
+                f"request {req.req_id!r} needs {req.total_tokens()} "
+                f"positions but the model serves at most {limit}"
+            )
         # reject requests that could NEVER be admitted (worst-case page
         # need above the watermark even with an empty pool) instead of
         # letting them block the FIFO queue forever

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...framework.core import Tensor, apply_op, _as_tensor
+from ...framework.infermeta import infer_meta
 from ...framework.random import next_key
 
 
@@ -16,9 +17,11 @@ def linear(x, weight, bias=None, name=None):
     x, weight = _as_tensor(x), _as_tensor(weight)
     if bias is not None:
         bias = _as_tensor(bias)
+        infer_meta("linear", x.shape, weight.shape, bias.shape)
         return apply_op(
             "linear", lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias
         )
+    infer_meta("linear", x.shape, weight.shape)
     return apply_op("linear", lambda a, w: jnp.matmul(a, w), x, weight)
 
 
@@ -113,6 +116,7 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     x, weight = _as_tensor(x), _as_tensor(weight)
+    infer_meta("embedding", x.shape, weight.shape)
 
     def f(ids, w):
         out = jnp.take(w, ids, axis=0)

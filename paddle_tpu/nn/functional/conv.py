@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor, apply_op, _as_tensor
+from ...framework.infermeta import infer_meta
 
 
 def _pair(v, n):
@@ -36,11 +37,18 @@ def _padding(padding, n, stride, dilation, ksize):
 def _conv(x, weight, bias, stride, padding, dilation, groups, n,
           data_format, op_name):
     x, weight = _as_tensor(x), _as_tensor(weight)
+    orig_padding = padding
     stride = _pair(stride, n)
     dilation = _pair(dilation, n)
     ksize = weight.shape[2:]
     pad = _padding(padding, n, stride, dilation, ksize)
     channels_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    if (not channels_last and len(set(stride)) == 1
+            and len(set(dilation)) == 1
+            and isinstance(orig_padding, int)):
+            infer_meta("conv", tuple(x.shape), tuple(weight.shape),
+                   stride=stride[0], padding=orig_padding,
+                   dilation=dilation[0], groups=groups, op=op_name)
 
     spatial = "DHW"[3 - n:] if n <= 3 else None
     if channels_last:

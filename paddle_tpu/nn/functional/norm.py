@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor, apply_op, _as_tensor
+from ...framework.infermeta import infer_meta
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
@@ -15,6 +16,13 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
     x = _as_tensor(x)
     if isinstance(normalized_shape, int):
         normalized_shape = [normalized_shape]
+    infer_meta(
+        "layer_norm", x.shape,
+        normalized_shape=tuple(normalized_shape),
+        weight=None if weight is None else tuple(
+            _as_tensor(weight).shape),
+        bias=None if bias is None else tuple(_as_tensor(bias).shape),
+    )
     n_axes = len(tuple(normalized_shape))
     axes = tuple(range(x.ndim - n_axes, x.ndim))
 

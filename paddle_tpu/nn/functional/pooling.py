@@ -45,6 +45,13 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     ks = _pair(kernel_size, 2)
     st = _pair(stride, 2) if stride is not None else ks
     pad = _pool_padding(padding, 2)
+    if (data_format == "NCHW" and len(set(ks)) == 1
+            and len(set(st)) == 1 and isinstance(padding, int)
+            and not ceil_mode):
+        from ...framework.infermeta import infer_meta
+
+        infer_meta("pool", x.shape, kernel_size=ks[0], stride=st[0],
+                   padding=padding, op="max_pool2d")
     cl = data_format == "NHWC"
 
     def f(a):
@@ -112,6 +119,13 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     ks = _pair(kernel_size, 2)
     st = _pair(stride, 2) if stride is not None else ks
     pad = _pool_padding(padding, 2)
+    if (data_format == "NCHW" and len(set(ks)) == 1
+            and len(set(st)) == 1 and isinstance(padding, int)
+            and not ceil_mode):
+        from ...framework.infermeta import infer_meta
+
+        infer_meta("pool", x.shape, kernel_size=ks[0], stride=st[0],
+                   padding=padding, op="avg_pool2d")
     cl = data_format == "NHWC"
 
     def f(a):

@@ -146,6 +146,7 @@ SWEEP_WAIVERS = {
 # through public module namespaces
 _NOT_OPS = {
     "apply_op", "np_or_jax", "next_key", "to_np_dtype", "builtins_min",
+    "infer_meta",
 }
 
 

@@ -11,10 +11,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.core import Tensor, apply_op, _as_tensor
+from ..framework.infermeta import infer_meta
 
 
-def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
-    x, y = _as_tensor(x), _as_tensor(y)
+def _matmul_apply(x, y, transpose_x=False, transpose_y=False):
+    """apply_op body shared by matmul/mm/bmm — callers validate."""
 
     def f(a, b):
         if transpose_x:
@@ -26,12 +27,21 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     return apply_op("matmul", f, x, y)
 
 
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    infer_meta("matmul", x.shape, y.shape,
+               transpose_x=transpose_x, transpose_y=transpose_y)
+    return _matmul_apply(x, y, transpose_x, transpose_y)
+
+
 def mm(input, mat2, name=None):
     return matmul(input, mat2)
 
 
 def bmm(x, y, name=None):
-    return matmul(x, y)
+    x, y = _as_tensor(x), _as_tensor(y)
+    infer_meta("bmm", x.shape, y.shape)  # stricter: rank-3, equal batch
+    return _matmul_apply(x, y)
 
 
 def dot(x, y, name=None):

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.core import Tensor, apply_op, _as_tensor
+from ..framework.infermeta import infer_meta
 from ..framework.dtype import to_np_dtype
 
 
@@ -61,12 +62,14 @@ def concat(x, axis=0, name=None):
     if isinstance(axis, Tensor):
         axis = int(axis.item())
     ax = int(axis)
+    infer_meta("concat", *[t.shape for t in ts], axis=ax)
     return apply_op("concat", lambda *arrs: jnp.concatenate(arrs, axis=ax), *ts)
 
 
 def stack(x, axis=0, name=None):
     ts = [_as_tensor(v) for v in x]
     ax = int(axis)
+    infer_meta("stack", *[t.shape for t in ts], axis=ax)
     return apply_op("stack", lambda *arrs: jnp.stack(arrs, axis=ax), *ts)
 
 
@@ -204,6 +207,8 @@ def rot90(x, k=1, axes=(0, 1), name=None):
 def gather(x, index, axis=0, name=None):
     x, index = _as_tensor(x), _as_tensor(index)
     ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    if len(index.shape) == 1:
+        infer_meta("gather", x.shape, index.shape, axis=ax)
     return apply_op(
         "gather", lambda a, i: jnp.take(a, i.reshape(-1), axis=ax), x, index
     )
@@ -264,6 +269,8 @@ def put_along_axis(arr, indices, values, axis, reduce="assign",
 
 def scatter(x, index, updates, overwrite=True, name=None):
     x, index, updates = _as_tensor(x), _as_tensor(index), _as_tensor(updates)
+    if len(index.shape) == 1:
+        infer_meta("scatter", x.shape, index.shape, updates.shape)
 
     def f(a, i, u):
         i = i.reshape(-1)

@@ -34,6 +34,9 @@ def _binary(op_name, jfn):
         if isinstance(y, Tensor) or isinstance(x, Tensor):
             x = _as_tensor(x) if not isinstance(x, Tensor) else x
             if isinstance(y, Tensor):
+                from ..framework.infermeta import infer_meta
+
+                infer_meta("elementwise", x.shape, y.shape, op=op_name)
                 return apply_op(op_name, jfn, x, y)
             yv = y
             return apply_op(op_name, lambda a: jfn(a, yv), x)
@@ -169,8 +172,11 @@ def _axis(axis):
 
 
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..framework.infermeta import infer_meta
+
     x = _as_tensor(x)
     ax = _axis(axis)
+    infer_meta("reduce", x.shape, axis=ax, keepdim=keepdim, op="sum")
     d = to_np_dtype(dtype) if dtype is not None else None
 
     def f(a):
@@ -183,8 +189,11 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
 
 
 def mean(x, axis=None, keepdim=False, name=None):
+    from ..framework.infermeta import infer_meta
+
     x = _as_tensor(x)
     ax = _axis(axis)
+    infer_meta("reduce", x.shape, axis=ax, keepdim=keepdim, op="mean")
     return apply_op("mean", lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
 
 
