@@ -451,6 +451,71 @@ def bench_decode(steps=64, ctx=1024, h=16, d=128):
 
 
 # ---------------------------------------------------------------------------
+# aux: end-to-end serving throughput — BatchScheduler + PagedLlamaAdapter
+# ---------------------------------------------------------------------------
+
+
+def bench_serving(n_requests=16, prompt_len=32, new_tokens=32):
+    """Generated tokens/sec through the full serving stack (scheduler
+    admission + paged KV pool + per-layer paged-attention kernel) on a
+    llama model — the model-level companion to decode_throughput."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (
+        BatchScheduler,
+        PagedLlamaAdapter,
+        Request,
+    )
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    kind = _device_kind()
+    cpu = kind.startswith("cpu")
+    if cpu:
+        n_requests, prompt_len, new_tokens = 4, 8, 8
+        cfg = llama_tiny(num_hidden_layers=2,
+                         max_position_embeddings=128)
+    else:
+        cfg = llama_tiny(
+            hidden_size=512, intermediate_size=1024,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=2048,
+        )
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    adapter = PagedLlamaAdapter(
+        model, num_pages=max(64, n_requests * 8), page_size=16)
+    rng = np.random.RandomState(0)
+
+    def run_round():
+        sched = BatchScheduler(adapter, max_batch_size=n_requests)
+        for i in range(n_requests):
+            sched.submit(Request(
+                f"r{i}",
+                rng.randint(1, cfg.vocab_size, prompt_len).tolist(),
+                max_new_tokens=new_tokens,
+            ))
+        return sched.run_until_complete()
+
+    # warmup: the first round walks the same batch-size trajectory, so
+    # per-shape kernel compiles land outside the timed round
+    run_round()
+    t0 = time.perf_counter()
+    done = run_round()
+    elapsed = time.perf_counter() - t0
+    generated = sum(len(r.generated_ids) for r in done.values())
+    processed = generated + n_requests * prompt_len
+    return {
+        "config": "serving_throughput",
+        "mode": "tpu-single-chip" if not cpu else "cpu",
+        "requests": n_requests,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "generated_tok_s": round(generated / elapsed, 1),
+        "total_tok_s": round(processed / elapsed, 1),
+        "wall_s": round(elapsed, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
 # config 2: GPT-3 1.3B, DP + sharding stage 1
 # ---------------------------------------------------------------------------
 
@@ -793,7 +858,8 @@ def main() -> int:
     ap.add_argument("--dry", action="store_true")
     ap.add_argument("--only", type=str, default=None,
                     choices=["llama", "resnet50", "gpt3", "vitl",
-                             "ernie_moe", "varlen", "decode"])
+                             "ernie_moe", "varlen", "decode",
+                             "serving"])
     ap.add_argument("--cpu-mesh", type=str, default=None,
                     choices=sorted(_CPU_MESH))
     ap.add_argument("--steps", type=int, default=10)
@@ -859,6 +925,9 @@ def main() -> int:
     if args.only in (None, "decode"):
         configs["decode_throughput"] = _single(
             "decode_throughput", bench_decode)
+    if args.only in (None, "serving"):
+        configs["serving_throughput"] = _single(
+            "serving_throughput", bench_serving)
 
     if args.only in (None, "llama"):
         # the headline must not eat the matrix: a failure here still
