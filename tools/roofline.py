@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Static roofline analysis of the headline Llama train step.
+
+Chip-independent evidence for perf review when no TPU is attached:
+lower the SAME train step bench.py times, pull XLA's cost analysis
+(flops, bytes accessed) from the compiled program, and bound the
+achievable step time on a target chip by max(compute, HBM) — the
+roofline. This does NOT replace an on-chip measurement (bench.py);
+it documents the arithmetic intensity the program ships with.
+
+Run: JAX_PLATFORMS=cpu python tools/roofline.py [--seq 2048 --batch 8]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+CHIPS = {
+    # (peak bf16 TFLOP/s, HBM GB/s)
+    "v5e": (197.0, 819.0),
+    "v4": (275.0, 1228.0),
+    "v5p": (459.0, 2765.0),
+    "v6e": (918.0, 1640.0),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--hidden", type=int, default=2048)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    # the bench's scaled headline shape family (bf16 weights/acts)
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=args.hidden,
+        intermediate_size=args.hidden * 11008 // 4096,
+        num_hidden_layers=args.layers,
+        num_attention_heads=args.hidden // 128,
+        num_key_value_heads=args.hidden // 128,
+        max_position_embeddings=args.seq, dtype="bfloat16",
+    )
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = optim.AdamW(3e-4, parameters=model.parameters())
+
+    @paddle.jit.to_static
+    def step(x, y):
+        _, loss = model(x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size,
+                    (args.batch, args.seq)).astype("int32"))
+    y = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size,
+                    (args.batch, args.seq)).astype("int64"))
+    step(x, y)  # compile
+
+    # AOT-lower the cached jitted step with the same (state, args)
+    # signature StaticFunction.__call__ feeds it
+    from paddle_tpu.framework import state as _registry
+
+    entry = next(iter(step._cache.values()))
+    state_raws = [t._data for t in _registry.snapshot_state_tensors()]
+    lowered = entry["jitted"].lower(state_raws, [x._data, y._data])
+    cost = lowered.compile().cost_analysis()
+    c = cost[0] if isinstance(cost, (list, tuple)) else cost
+    flops = float(c.get("flops", 0.0))
+    bytes_ = float(c.get("bytes accessed", 0.0))
+    tokens = args.batch * args.seq
+    out = {
+        "config": {
+            "hidden": args.hidden, "layers": args.layers,
+            "seq": args.seq, "batch": args.batch,
+            "n_params": cfg.num_params(),
+        },
+        "per_step": {
+            "flops": flops,
+            "bytes_accessed": bytes_,
+            "arithmetic_intensity": round(flops / max(bytes_, 1), 1),
+            "tokens": tokens,
+        },
+    }
+    for chip, (tf, bw) in CHIPS.items():
+        t_compute = flops / (tf * 1e12)
+        t_mem = bytes_ / (bw * 1e9)
+        bound = max(t_compute, t_mem)
+        out[chip] = {
+            "compute_bound_s": round(t_compute, 4),
+            "hbm_bound_s": round(t_mem, 4),
+            "roofline_tokens_per_sec": round(tokens / bound, 0),
+            "mfu_ceiling_pct": round(100 * t_compute / bound, 1),
+        }
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
