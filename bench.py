@@ -159,7 +159,7 @@ def _flash_bwd_sanity():
 def bench_llama_headline(dry=False, steps=10, seq=2048, batch=8):
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as optim
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, llama_tiny
+    from paddle_tpu.models import LlamaForCausalLM, llama_headline, llama_tiny
 
     kind = _device_kind()
     on_tpu = not kind.startswith("cpu")
@@ -171,12 +171,8 @@ def bench_llama_headline(dry=False, steps=10, seq=2048, batch=8):
     else:
         # ~470M params: MXU-saturating matmuls, fits one chip with fp32
         # Adam states; head_dim 128 -> Pallas flash fwd+bwd kernels
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1536, intermediate_size=4224,
-            num_hidden_layers=14, num_attention_heads=12,
-            num_key_value_heads=12, max_position_embeddings=seq,
-            tie_word_embeddings=True, recompute=False,
-        )
+        cfg = llama_headline(
+            max_position_embeddings=seq, recompute=False)
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)

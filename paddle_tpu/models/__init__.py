@@ -16,6 +16,7 @@ from .llama import (
     LlamaModel,
     LlamaPretrainingCriterion,
     llama2_7b,
+    llama_headline,
     llama2_13b,
     llama_tiny,
     llama_pipeline_model,

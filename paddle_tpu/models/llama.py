@@ -88,6 +88,21 @@ def llama2_13b(**kw) -> LlamaConfig:
     )
 
 
+def llama_headline(**kw) -> LlamaConfig:
+    """The single-chip headline-bench config (~470M params): shared by
+    bench.py, tools/exp_mfu.py, and tools/roofline.py so the benchmark
+    and its analysis tools can never desynchronize."""
+    kw.setdefault("vocab_size", 32000)
+    kw.setdefault("hidden_size", 1536)
+    kw.setdefault("intermediate_size", 4224)
+    kw.setdefault("num_hidden_layers", 14)
+    kw.setdefault("num_attention_heads", 12)
+    kw.setdefault("num_key_value_heads", 12)
+    kw.setdefault("max_position_embeddings", 2048)
+    kw.setdefault("tie_word_embeddings", True)
+    return LlamaConfig(**kw)
+
+
 def llama_tiny(**kw) -> LlamaConfig:
     """Small config for tests / compile checks (GQA 4:2 exercised)."""
     kw.setdefault("vocab_size", 512)

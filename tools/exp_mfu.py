@@ -52,11 +52,12 @@ def main() -> int:
     kind = getattr(dev, "device_kind", "cpu")
     on_tpu = dev.platform not in ("cpu",)
 
-    cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=1536, intermediate_size=4224,
-        num_hidden_layers=args.layers, num_attention_heads=12,
-        num_key_value_heads=12, max_position_embeddings=args.seq,
-        tie_word_embeddings=True, recompute=bool(args.recompute),
+    from paddle_tpu.models import llama_headline
+
+    cfg = llama_headline(
+        num_hidden_layers=args.layers,
+        max_position_embeddings=args.seq,
+        recompute=bool(args.recompute),
     )
     seq, batch, steps = args.seq, args.batch, args.steps
 

@@ -33,6 +33,10 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--hidden", type=int, default=2048)
+    ap.add_argument("--headline", action="store_true",
+                    help="mirror bench_llama_headline's exact config "
+                         "(~470M params, hidden 1536 x 14 layers, "
+                         "tied embeddings)")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -43,20 +47,36 @@ def main():
 
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as optim
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-
-    # the bench's scaled headline shape family (bf16 weights/acts)
-    cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=args.hidden,
-        intermediate_size=args.hidden * 11008 // 4096,
-        num_hidden_layers=args.layers,
-        num_attention_heads=args.hidden // 128,
-        num_key_value_heads=args.hidden // 128,
-        max_position_embeddings=args.seq, dtype="bfloat16",
+    from paddle_tpu.models import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        llama_headline,
     )
+
+    if args.headline:
+        # bench_llama_headline's exact config via the shared factory
+        cfg = llama_headline(max_position_embeddings=args.seq)
+    else:
+        # the scaled headline shape family (bf16 weights/acts)
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=args.hidden,
+            intermediate_size=args.hidden * 11008 // 4096,
+            num_hidden_layers=args.layers,
+            num_attention_heads=args.hidden // 128,
+            num_key_value_heads=args.hidden // 128,
+            max_position_embeddings=args.seq, dtype="bfloat16",
+        )
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
-    opt = optim.AdamW(3e-4, parameters=model.parameters())
+    if args.headline:
+        # the bench's TPU step: bf16 model, fp32 master weights + fp32
+        # Adam moments (multi_precision) — traffic must match
+        model.bfloat16()
+        opt = optim.AdamW(3e-4, parameters=model.parameters(),
+                          multi_precision=True)
+        opt._create_accumulators()
+    else:
+        opt = optim.AdamW(3e-4, parameters=model.parameters())
 
     @paddle.jit.to_static
     def step(x, y):
@@ -89,8 +109,10 @@ def main():
     tokens = args.batch * args.seq
     out = {
         "config": {
-            "hidden": args.hidden, "layers": args.layers,
+            "hidden": cfg.hidden_size,
+            "layers": cfg.num_hidden_layers,
             "seq": args.seq, "batch": args.batch,
+            "headline": bool(args.headline),
             "n_params": cfg.num_params(),
         },
         "per_step": {
