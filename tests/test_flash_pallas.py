@@ -7,6 +7,8 @@ mode on CPU against the XLA reference / autodiff ground truth, so a broken
 index map or accumulator fails the suite without a chip (VERDICT r2 #2).
 """
 import importlib
+import os
+import sys
 
 import numpy as np
 import pytest
@@ -189,3 +191,29 @@ class TestFlashDispatchInterpret:
         g_ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
         for gp, gr in zip(g_pallas, g_ref):
             np.testing.assert_allclose(gp, gr, atol=5e-4, rtol=5e-4)
+
+
+class TestBenchSanityGuard:
+    """bench.py's on-chip kernel guard, executed here in interpret mode
+    on every suite run.
+
+    Round 1 and round 3 both shipped a bench whose sanity guard failed
+    at IMPORT time (module-attribute shadowing) and silently fell back
+    to the chunked-XLA backward — the headline then benchmarked the
+    wrong kernel stack. Running the guard itself under CI makes that
+    class of regression loud."""
+
+    def test_flash_bwd_sanity_passes_interpret(self):
+        sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+        try:
+            bench = importlib.import_module("bench")
+        finally:
+            sys.path.pop(0)
+        prev = paddle.get_flags(["FLAGS_use_pallas_flash_bwd"])
+        try:
+            assert bench._flash_bwd_sanity(interpret=True) is True, (
+                "the bench kernel guard fell back to the chunked-XLA "
+                "backward; the headline would not measure the Pallas bwd"
+            )
+        finally:
+            paddle.set_flags(prev)

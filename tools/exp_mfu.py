@@ -36,7 +36,12 @@ def main() -> int:
     import paddle_tpu.optimizer as optim
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
-    import paddle_tpu.ops.kernels.flash_attention as fa
+    # importlib: the kernels package re-exports a function named
+    # flash_attention, which `import pkg.flash_attention as fa` would
+    # resolve instead of the submodule.
+    import importlib
+
+    fa = importlib.import_module("paddle_tpu.ops.kernels.flash_attention")
     if args.block_q != 512 or args.block_k != 512:
         # patch default block sizes
         orig = fa.flash_attention
@@ -46,7 +51,13 @@ def main() -> int:
             return orig(q, k, v, causal, sm_scale, block_q, block_k)
 
         fa.flash_attention = patched
-        import paddle_tpu.nn.functional as F
+        # nn/functional bound the kernel at import time
+        # (`from ...kernels.flash_attention import flash_attention as
+        # _flash`), so patching the kernels module alone never reaches
+        # the model — rebind the wrapper's early-bound reference too.
+        fwrap = importlib.import_module(
+            "paddle_tpu.nn.functional.flash_attention")
+        fwrap._flash = patched
 
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "cpu")
