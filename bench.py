@@ -907,71 +907,114 @@ def main() -> int:
         _emit({"warn": "TPU unreachable (axon tunnel down?); "
                "running the CPU-mesh matrix only"})
 
+    # Stall watchdog: a wedged axon tunnel blocks device-result fetches
+    # indefinitely (observed twice across rounds: a claim granted, then
+    # the connection goes silent mid-flight). The watchdog guarantees
+    # the driver always gets the final aggregate line with every config
+    # completed so far, instead of a silent zero-record hang.
+    import threading
+
+    state = {"configs": {}, "headline": None, "last": time.monotonic()}
+    state_lock = threading.Lock()
+    headline_expected = args.only in (None, "llama")
+
+    def _error_headline(msg):
+        if headline_expected:
+            return {"metric": "llama_train_mfu", "value": 0.0,
+                    "unit": "%", "vs_baseline": 0.0, "error": msg}
+        return {"metric": "bench_matrix_subset", "value": 0.0,
+                "unit": "ok", "vs_baseline": 0.0, "error": msg}
+
+    def _emit_final_and_exit():
+        with state_lock:
+            headline = dict(state["headline"] or _error_headline(
+                "bench stalled before the headline completed "
+                "(axon tunnel wedge); partial configs attached"))
+            headline["configs"] = dict(state["configs"])
+        headline.setdefault("stalled", True)
+        _emit(headline)
+        sys.stdout.flush()
+        os._exit(2)
+
+    stall_s = float(os.environ.get("BENCH_STALL_TIMEOUT_S", "1500"))
+
+    def _watchdog():
+        while True:
+            time.sleep(30)
+            if time.monotonic() - state["last"] > stall_s:
+                _emit({"warn": f"no bench progress for {stall_s:.0f}s; "
+                       "emitting partial aggregate and exiting"})
+                _emit_final_and_exit()
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     def _single(key, fn):
         if not tpu_ok:
-            return _emit({"config": key,
-                          "error": "TPU unreachable; single-chip "
-                          "bench skipped"})
-        try:
-            return _emit(fn())
-        except Exception as e:
-            return _emit({"config": key, "error": str(e)[:300]})
-
-    configs = {}
-    if args.only in (None, "resnet50"):
-        configs["resnet50_cifar10"] = _single(
-            "resnet50_cifar10", bench_resnet50)
-    if args.only in (None, "gpt3"):
-        configs["gpt3_single"] = _single(
-            "gpt3_1p3b_dp_sharding1", bench_gpt3)
-        configs["gpt3_mesh"] = _emit(_run_cpu_mesh_subprocess("gpt3"))
-    if args.only in (None, "vitl"):
-        configs["vitl_single"] = _single(
-            "vit_large_sharded23", bench_vitl)
-        configs["vitl_mesh"] = _emit(_run_cpu_mesh_subprocess("vitl"))
-    if args.only in (None, "ernie_moe"):
-        configs["ernie_moe_single"] = _single(
-            "ernie_moe_mp_pp_ep", bench_ernie_moe)
-        configs["ernie_moe_mesh"] = _emit(
-            _run_cpu_mesh_subprocess("ernie_moe"))
-    if args.only in (None, "llama"):
-        configs["llama_mp8_mesh"] = _emit(
-            _run_cpu_mesh_subprocess("llama_mp8"))
-
-    if args.only in (None, "varlen"):
-        configs["flash_varlen_8k"] = _single(
-            "flash_varlen_8k", bench_varlen)
-    if args.only in (None, "decode"):
-        configs["decode_throughput"] = _single(
-            "decode_throughput", bench_decode)
-    if args.only in (None, "serving"):
-        configs["serving_throughput"] = _single(
-            "serving_throughput", bench_serving)
-
-    if args.only in (None, "llama"):
-        # the headline must not eat the matrix: a failure here still
-        # emits the aggregate record with every completed config
-        if not tpu_ok:
-            headline = {
-                "metric": "llama_train_mfu", "value": 0.0, "unit": "%",
-                "vs_baseline": 0.0,
-                "error": "TPU unreachable (axon tunnel down); see "
-                         "configs for the CPU-mesh matrix",
-            }
+            rec = _emit({"config": key,
+                         "error": "TPU unreachable; single-chip "
+                         "bench skipped"})
         else:
             try:
-                headline = bench_llama_headline(
-                    steps=args.steps, seq=args.seq, batch=args.batch)
+                rec = _emit(fn())
             except Exception as e:
-                headline = {
-                    "metric": "llama_train_mfu", "value": 0.0,
-                    "unit": "%", "vs_baseline": 0.0,
-                    "error": str(e)[:300],
-                }
-    else:
-        headline = {"metric": "bench_matrix_subset", "value": 1.0,
-                    "unit": "ok", "vs_baseline": 1.0}
-    headline["configs"] = configs
+                rec = _emit({"config": key, "error": str(e)[:300]})
+        with state_lock:
+            state["configs"][key] = rec
+            state["last"] = time.monotonic()
+        return rec
+
+    def _mesh(key, name):
+        rec = _emit(_run_cpu_mesh_subprocess(name))
+        with state_lock:
+            state["configs"][key] = rec
+            state["last"] = time.monotonic()
+        return rec
+
+    # The headline is the round's primary record — run it FIRST so a
+    # tunnel wedge later in the matrix can't cost the MFU number.
+    if headline_expected:
+        if not tpu_ok:
+            hl = _error_headline(
+                "TPU unreachable (axon tunnel down); see "
+                "configs for the CPU-mesh matrix")
+        else:
+            try:
+                hl = bench_llama_headline(
+                    steps=args.steps, seq=args.seq, batch=args.batch)
+                _emit(hl)
+            except Exception as e:
+                hl = _error_headline(str(e)[:300])
+        with state_lock:
+            state["headline"] = hl
+            state["last"] = time.monotonic()
+    if args.only in (None, "resnet50"):
+        _single("resnet50_cifar10", bench_resnet50)
+    if args.only in (None, "gpt3"):
+        _single("gpt3_single", bench_gpt3)
+        _mesh("gpt3_mesh", "gpt3")
+    if args.only in (None, "vitl"):
+        _single("vitl_single", bench_vitl)
+        _mesh("vitl_mesh", "vitl")
+    if args.only in (None, "ernie_moe"):
+        _single("ernie_moe_single", bench_ernie_moe)
+        _mesh("ernie_moe_mesh", "ernie_moe")
+    if args.only in (None, "llama"):
+        _mesh("llama_mp8_mesh", "llama_mp8")
+
+    if args.only in (None, "varlen"):
+        _single("flash_varlen_8k", bench_varlen)
+    if args.only in (None, "decode"):
+        _single("decode_throughput", bench_decode)
+    if args.only in (None, "serving"):
+        _single("serving_throughput", bench_serving)
+
+    with state_lock:
+        if headline_expected:
+            headline = dict(state["headline"])
+        else:
+            headline = {"metric": "bench_matrix_subset", "value": 1.0,
+                        "unit": "ok", "vs_baseline": 1.0}
+        headline["configs"] = dict(state["configs"])
     _emit(headline)
     return 0
 

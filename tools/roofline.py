@@ -37,7 +37,14 @@ def main():
                     help="mirror bench_llama_headline's exact config "
                          "(~470M params, hidden 1536 x 14 layers, "
                          "tied embeddings)")
+    ap.add_argument("--recompute", action="store_true",
+                    help="candidate shapes only: enable activation "
+                         "recompute (raises hardware flops, lowers "
+                         "activation memory)")
     args = ap.parse_args()
+    if args.headline and args.recompute:
+        ap.error("--recompute only applies to candidate shapes; "
+                 "--headline mirrors bench.py exactly (recompute off)")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -57,26 +64,25 @@ def main():
         # bench_llama_headline's exact config via the shared factory
         cfg = llama_headline(max_position_embeddings=args.seq)
     else:
-        # the scaled headline shape family (bf16 weights/acts)
+        # candidate headline shapes (same bench treatment below)
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=args.hidden,
             intermediate_size=args.hidden * 11008 // 4096,
             num_hidden_layers=args.layers,
             num_attention_heads=args.hidden // 128,
             num_key_value_heads=args.hidden // 128,
-            max_position_embeddings=args.seq, dtype="bfloat16",
+            max_position_embeddings=args.seq,
+            tie_word_embeddings=True,
+            recompute=args.recompute,
         )
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
-    if args.headline:
-        # the bench's TPU step: bf16 model, fp32 master weights + fp32
-        # Adam moments (multi_precision) — traffic must match
-        model.bfloat16()
-        opt = optim.AdamW(3e-4, parameters=model.parameters(),
-                          multi_precision=True)
-        opt._create_accumulators()
-    else:
-        opt = optim.AdamW(3e-4, parameters=model.parameters())
+    # the bench's TPU step: bf16 model, fp32 master weights + fp32
+    # Adam moments (multi_precision) — traffic must match
+    model.bfloat16()
+    opt = optim.AdamW(3e-4, parameters=model.parameters(),
+                      multi_precision=True)
+    opt._create_accumulators()
 
     @paddle.jit.to_static
     def step(x, y):
@@ -102,11 +108,21 @@ def main():
     entry = next(iter(step._cache.values()))
     state_raws = [t._data for t in _registry.snapshot_state_tensors()]
     lowered = entry["jitted"].lower(state_raws, [x._data, y._data])
-    cost = lowered.compile().cost_analysis()
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
     c = cost[0] if isinstance(cost, (list, tuple)) else cost
     flops = float(c.get("flops", 0.0))
     bytes_ = float(c.get("bytes accessed", 0.0))
     tokens = args.batch * args.seq
+    try:
+        mem = compiled.memory_analysis()
+        mem_gb = {
+            "args_gb": round(mem.argument_size_in_bytes / 2**30, 2),
+            "temp_gb": round(mem.temp_size_in_bytes / 2**30, 2),
+            "output_gb": round(mem.output_size_in_bytes / 2**30, 2),
+        }
+    except Exception:
+        mem_gb = None
     out = {
         "config": {
             "hidden": cfg.hidden_size,
@@ -121,7 +137,16 @@ def main():
             "arithmetic_intensity": round(flops / max(bytes_, 1), 1),
             "tokens": tokens,
         },
+        "memory": mem_gb,
     }
+    # MFU counts model flops (6N per token), not hardware flops — with
+    # recompute the two diverge; report both so ceilings stay honest.
+    model_flops = 6.0 * cfg.num_params() * tokens \
+        + 6.0 * cfg.num_hidden_layers * cfg.hidden_size \
+        * args.seq * tokens
+    out["per_step"]["model_flops"] = model_flops
+    out["per_step"]["hw_over_model_flops"] = round(
+        flops / max(model_flops, 1), 3)
     for chip, (tf, bw) in CHIPS.items():
         t_compute = flops / (tf * 1e12)
         t_mem = bytes_ / (bw * 1e9)
@@ -130,7 +155,10 @@ def main():
             "compute_bound_s": round(t_compute, 4),
             "hbm_bound_s": round(t_mem, 4),
             "roofline_tokens_per_sec": round(tokens / bound, 0),
-            "mfu_ceiling_pct": round(100 * t_compute / bound, 1),
+            # MFU convention: model flops (6N/token), not hardware
+            # flops — under recompute the two differ
+            "mfu_ceiling_pct": round(
+                100 * model_flops / (tf * 1e12 * bound), 1),
         }
     print(json.dumps(out, indent=1))
     return 0
