@@ -171,3 +171,31 @@ def fused_bias_dropout_residual_layer_norm(
         return out.astype(a.dtype)
 
     return apply_op("fused_bias_dropout_residual_ln", f, *args)
+
+
+def fused_linear_cross_entropy(h, w, labels, ignore_index=-100,
+                               chunk=4096, reduction="mean",
+                               transpose_w=False, name=None):
+    """Fused linear head + softmax cross-entropy, chunked over vocab so
+    the [tokens, vocab] logits never materialize in HBM (the backward
+    recomputes each chunk from the saved logsumexp).
+
+    h: [T, H] or [B, S, H]; w: [V, H] ([H, V] with transpose_w=True,
+    the ColumnParallelLinear layout); labels: int [T] / [B, S].
+    Reference analog: fused softmax-with-CE (upstream:
+    paddle/phi/kernels/gpu/cross_entropy_kernel.cu); see
+    ops/kernels/fused_loss.py for the TPU design.
+    """
+    from ...ops.kernels.fused_loss import (
+        fused_linear_cross_entropy as _core,
+    )
+
+    h, w, labels = _as_tensor(h), _as_tensor(w), _as_tensor(labels)
+
+    def f(hr, wr, lr):
+        if transpose_w:
+            wr = wr.T
+        return _core(hr, wr, lr, ignore_index=ignore_index,
+                     chunk=chunk, reduction=reduction)
+
+    return apply_op("fused_linear_cross_entropy", f, h, w, labels)

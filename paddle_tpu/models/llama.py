@@ -58,6 +58,10 @@ class LlamaConfig:
     # "ring" (ppermute KV rotation) or "ulysses" (all_to_all head swap)
     context_parallel: str = "ring"
     recompute: bool = False
+    # chunked fused linear+CE loss head: never materializes the [T, V]
+    # logits (ops/kernels/fused_loss.py). Single-replica-vocab only;
+    # forward returns (None, loss) when engaged.
+    fused_head_loss: bool = False
     dtype: str = "float32"
 
     @property
@@ -100,6 +104,9 @@ def llama_headline(**kw) -> LlamaConfig:
     kw.setdefault("num_key_value_heads", 12)
     kw.setdefault("max_position_embeddings", 2048)
     kw.setdefault("tie_word_embeddings", True)
+    # chunked fused CE head: ~4GB less HBM traffic per step at this
+    # vocab/batch (tests/test_fused_loss.py pins trajectory parity)
+    kw.setdefault("fused_head_loss", True)
     return LlamaConfig(**kw)
 
 
@@ -396,10 +403,29 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.model(input_ids)
+        if labels is not None and self._fused_loss_active():
+            from ..incubate.nn.functional import fused_linear_cross_entropy
+
+            tied = self.lm_head is None
+            w = (self.model.embed_tokens.weight if tied
+                 else self.lm_head.weight)  # [V,H] tied / [H,V] linear
+            # logits[:, :-1] predicts labels[:, 1:] — shift h/labels;
+            # the chunked kernel never builds [T, V] logits, so there
+            # are no logits to return
+            h_s = apply_op("shift_hidden", lambda a: a[:, :-1], h)
+            lab_s = apply_op("shift_labels", lambda a: a[:, 1:], labels,
+                             differentiable=False)
+            return None, fused_linear_cross_entropy(
+                h_s, w, lab_s, transpose_w=not tied)
         logits = self._head(h)
         if labels is None:
             return logits
         return logits, LlamaPretrainingCriterion()(logits, labels)
+
+    def _fused_loss_active(self):
+        # the chunked lse is over the full vocab — with a vocab-sharded
+        # head (mp>1) the unfused criterion's collective path applies
+        return self.config.fused_head_loss and axis_degree("mp") == 1
 
     # -- decode / serving --------------------------------------------------
 

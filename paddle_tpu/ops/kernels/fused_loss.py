@@ -1,0 +1,154 @@
+"""Fused linear + softmax cross-entropy over vocab chunks.
+
+The headline train step's loss head is HBM-heavy when written naively:
+``logits = h @ w.T`` materializes a [T, V] tensor (T = B*S tokens,
+V = vocab), log_softmax round-trips it in fp32, and the backward
+materializes d_logits at the same size — several GB of traffic per
+step for Llama-class vocabs, all of it bandwidth- not compute-bound.
+
+This kernel never materializes the full logits: the forward scans the
+vocab in chunks, maintaining a running (max, sumexp) online-logsumexp
+plus the label's logit; the backward re-computes each chunk's logits
+from the saved (h, lse) and accumulates dh / per-chunk dw directly.
+The trade is one extra [T,H]x[H,C] matmul per chunk in the backward
+(~+2 T·H·V flops, a few percent of the step) for O(T·V) less HBM
+traffic and a [T, V] activation that no longer occupies HBM between
+forward and backward — which in turn frees room for larger batches.
+
+Reference analog: the fused softmax-with-cross-entropy family
+(upstream: paddle/phi/kernels/gpu/cross_entropy_kernel.cu and fleet's
+c_softmax_with_cross_entropy); the chunking strategy mirrors public
+"fused linear cross entropy" kernels. TPU-first design: the chunk loop
+is a `lax.scan` over a reshaped weight — XLA pipelines the per-chunk
+matmuls on the MXU with fp32 accumulation via preferred_element_type,
+no Pallas needed (the matmul IS the kernel; only the fusion pattern
+around it matters).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _pick_chunk(v: int, target: int) -> int:
+    """Largest divisor of ``v`` that is <= target (>= 1)."""
+    c = min(target, v)
+    while v % c:
+        c -= 1
+    return c
+
+
+def _chunk_logits(h, w_chunk):
+    """[T,H] x [C,H] -> [T,C] fp32-accumulated on the MXU."""
+    return jax.lax.dot_general(
+        h, w_chunk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy_sum(h, w, labels, ignore_index, chunk):
+    """Sum of per-token CE of ``h @ w.T`` against ``labels``, plus the
+    count of non-ignored tokens. Returns (loss_sum f32, count f32)."""
+    loss, count, _ = _fwd_core(h, w, labels, ignore_index, chunk)
+    return loss, count
+
+
+def _fwd_core(h, w, labels, ignore_index, chunk):
+    t, hidden = h.shape
+    v = w.shape[0]
+    c = _pick_chunk(v, chunk)
+    nc = v // c
+    w3 = w.reshape(nc, c, hidden)
+    valid = labels != ignore_index
+    lab = jnp.where(valid, labels, 0).astype(jnp.int32)
+
+    def body(carry, xs):
+        m, s, ll = carry
+        w_chunk, off = xs
+        logits = _chunk_logits(h, w_chunk)  # [T, C] f32
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]).sum(axis=-1)
+        rel = lab - off
+        in_chunk = (rel >= 0) & (rel < c)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(rel, 0, c - 1)[:, None], axis=-1)[:, 0]
+        ll = jnp.where(in_chunk, picked, ll)
+        return (m_new, s, ll), None
+
+    init = (jnp.full((t,), NEG_INF, jnp.float32),
+            jnp.zeros((t,), jnp.float32),
+            jnp.zeros((t,), jnp.float32))
+    offsets = jnp.arange(nc, dtype=jnp.int32) * c
+    (m, s, ll), _ = jax.lax.scan(body, init, (w3, offsets))
+    lse = jnp.log(s) + m
+    per_tok = jnp.where(valid, lse - ll, 0.0)
+    count = valid.sum().astype(jnp.float32)
+    return per_tok.sum(), count, lse
+
+
+def _fwd_rule(h, w, labels, ignore_index, chunk):
+    loss, count, lse = _fwd_core(h, w, labels, ignore_index, chunk)
+    return (loss, count), (h, w, labels, lse)
+
+
+def _bwd_rule(ignore_index, chunk, res, cots):
+    h, w, labels, lse = res
+    dloss, _dcount = cots  # count is integer-valued; its cot is unused
+    t, hidden = h.shape
+    v = w.shape[0]
+    c = _pick_chunk(v, chunk)
+    nc = v // c
+    w3 = w.reshape(nc, c, hidden)
+    valid = labels != ignore_index
+    lab = jnp.where(valid, labels, 0).astype(jnp.int32)
+    # d(per_tok)/d(logits_j) = softmax_j - onehot_label_j, scaled by the
+    # incoming cotangent on the summed loss; ignored tokens contribute 0
+    g = jnp.where(valid, dloss, 0.0).astype(jnp.float32)  # [T]
+
+    def body(dh, xs):
+        w_chunk, off = xs
+        logits = _chunk_logits(h, w_chunk)  # recompute [T, C] f32
+        p = jnp.exp(logits - lse[:, None])
+        rel = lab - off
+        in_chunk = (rel >= 0) & (rel < c)
+        onehot = jax.nn.one_hot(
+            jnp.where(in_chunk, rel, -1), c, dtype=jnp.float32)
+        dlogits = (p - onehot) * g[:, None]  # [T, C] f32
+        dlogits = dlogits.astype(h.dtype)
+        dh = dh + jax.lax.dot_general(
+            dlogits, w_chunk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dw_chunk = jax.lax.dot_general(
+            dlogits, h, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(w.dtype)
+        return dh, dw_chunk
+
+    offsets = jnp.arange(nc, dtype=jnp.int32) * c
+    dh, dw3 = jax.lax.scan(
+        body, jnp.zeros((t, hidden), jnp.float32), (w3, offsets))
+    dlabels = np.zeros(labels.shape, jax.dtypes.float0)
+    return dh.astype(h.dtype), dw3.reshape(v, hidden), dlabels
+
+
+fused_linear_cross_entropy_sum.defvjp(_fwd_rule, _bwd_rule)
+
+
+def fused_linear_cross_entropy(h, w, labels, ignore_index=-100,
+                               chunk=4096, reduction="mean"):
+    """Mean/sum CE of the linear head ``h @ w.T`` without materializing
+    logits. h: [T, H] (or [B, S, H]), w: [V, H], labels: [T] / [B, S]."""
+    if h.ndim == 3:
+        h = h.reshape(-1, h.shape[-1])
+    labels = labels.reshape(-1)
+    loss, count = fused_linear_cross_entropy_sum(
+        h, w, labels, int(ignore_index), int(chunk))
+    if reduction == "sum":
+        return loss
+    return loss / jnp.maximum(count, 1.0)

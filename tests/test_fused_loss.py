@@ -1,0 +1,190 @@
+"""Fused linear+CE head (ops/kernels/fused_loss.py): the chunked
+kernel must match the naive logits path in loss AND grads — it feeds
+the headline bench, so drift here is a silent training-quality bug.
+Upstream analog: softmax_with_cross_entropy OpTests
+(test/legacy_test/test_softmax_with_cross_entropy_op.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.kernels.fused_loss import (
+    _pick_chunk,
+    fused_linear_cross_entropy,
+)
+
+from conftest import reset_dist_state  # noqa: F401
+
+
+def _naive(h, w, labels, ignore_index=-100):
+    logits = (h @ w.T).astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    valid = labels != ignore_index
+    lab = jnp.where(valid, labels, 0)
+    picked = jnp.take_along_axis(logits, lab[:, None], axis=-1)[:, 0]
+    per_tok = jnp.where(valid, lse - picked, 0.0)
+    return per_tok.sum() / jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
+
+
+class TestFusedLinearCE:
+    def test_pick_chunk_divides(self):
+        assert _pick_chunk(32000, 4096) == 4000
+        assert _pick_chunk(50304, 4096) == 3144
+        assert _pick_chunk(7, 4096) == 7
+        assert _pick_chunk(4096, 4096) == 4096
+
+    @pytest.mark.parametrize("vocab,chunk", [(96, 32), (100, 48), (64, 64)])
+    def test_loss_and_grads_match_naive(self, vocab, chunk):
+        rng = np.random.RandomState(0)
+        t, hidden = 24, 16
+        h = jnp.asarray(rng.randn(t, hidden), jnp.float32)
+        w = jnp.asarray(rng.randn(vocab, hidden), jnp.float32) * 0.1
+        labels = jnp.asarray(rng.randint(0, vocab, t), jnp.int32)
+        labels = labels.at[3].set(-100).at[17].set(-100)
+
+        ref, (dh_r, dw_r) = jax.value_and_grad(_naive, argnums=(0, 1))(
+            h, w, labels)
+        got, (dh_f, dw_f) = jax.value_and_grad(
+            lambda a, b: fused_linear_cross_entropy(
+                a, b, labels, chunk=chunk), argnums=(0, 1))(h, w)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        np.testing.assert_allclose(dh_f, dh_r, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(dw_f, dw_r, rtol=1e-4, atol=1e-6)
+
+    def test_all_ignored_is_zero_not_nan(self):
+        h = jnp.ones((4, 8), jnp.float32)
+        w = jnp.ones((16, 8), jnp.float32)
+        labels = jnp.full((4,), -100, jnp.int32)
+        out = fused_linear_cross_entropy(h, w, labels, chunk=8)
+        assert float(out) == 0.0
+
+    def test_bf16_inputs_fp32_loss(self):
+        rng = np.random.RandomState(1)
+        h = jnp.asarray(rng.randn(8, 16), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(32, 16), jnp.bfloat16)
+        labels = jnp.asarray(rng.randint(0, 32, 8), jnp.int32)
+        out = fused_linear_cross_entropy(h, w, labels, chunk=16)
+        assert out.dtype == jnp.float32
+        ref = _naive(h, w, labels)
+        np.testing.assert_allclose(float(out), float(ref), rtol=2e-2)
+
+    def test_sum_reduction(self):
+        rng = np.random.RandomState(2)
+        h = jnp.asarray(rng.randn(6, 8), jnp.float32)
+        w = jnp.asarray(rng.randn(24, 8), jnp.float32)
+        labels = jnp.asarray(rng.randint(0, 24, 6), jnp.int32)
+        s = fused_linear_cross_entropy(h, w, labels, chunk=8,
+                                       reduction="sum")
+        m = fused_linear_cross_entropy(h, w, labels, chunk=8)
+        np.testing.assert_allclose(float(s), float(m) * 6, rtol=1e-5)
+
+
+class TestLlamaFusedHeadLoss:
+    """End-to-end: fused_head_loss=True trains the same model to the
+    same losses/grads as the naive logits path."""
+
+    def _train_losses(self, fused, tie, steps=3):
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        cfg = llama_tiny(fused_head_loss=fused, tie_word_embeddings=tie)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = optim.AdamW(1e-3, parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (2, 64)).astype("int32"))
+        y = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (2, 64)).astype("int64"))
+        losses = []
+        for _ in range(steps):
+            _, loss = model(x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        return losses
+
+    @pytest.mark.parametrize("tie", [True, False])
+    def test_trajectory_matches_naive(self, tie):
+        naive = self._train_losses(False, tie)
+        fused = self._train_losses(True, tie)
+        np.testing.assert_allclose(fused, naive, rtol=2e-5, atol=2e-6)
+
+    def test_fused_under_jit(self):
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        cfg = llama_tiny(fused_head_loss=True, tie_word_embeddings=True)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = optim.AdamW(1e-3, parameters=model.parameters())
+
+        @paddle.jit.to_static
+        def step(x, y):
+            _, loss = model(x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (2, 64)).astype("int32"))
+        y = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (2, 64)).astype("int64"))
+        l0 = float(np.asarray(step(x, y)._data))
+        l5 = l0
+        for _ in range(5):
+            l5 = float(np.asarray(step(x, y)._data))
+        assert l5 < l0
+
+
+class TestFusedHeadLossDP:
+    """fused_head_loss under a dp mesh: batch-sharded h/labels with a
+    replicated head weight must reproduce the serial fused trajectory
+    (the headline's multi-chip dp analog)."""
+
+    def test_dp2_matches_serial(self):
+        from paddle_tpu.distributed import fleet
+        from conftest import reset_dist_state as _reset
+
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        def train():
+            cfg = llama_tiny(fused_head_loss=True,
+                             tie_word_embeddings=True)
+            paddle.seed(7)
+            model = LlamaForCausalLM(cfg)
+            opt = optim.AdamW(1e-3, parameters=model.parameters())
+
+            @paddle.jit.to_static
+            def step(x, y):
+                _, loss = model(x, y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+
+            rng = np.random.RandomState(3)
+            losses = []
+            for _ in range(4):
+                x = paddle.to_tensor(
+                    rng.randint(0, 512, (4, 64)).astype("int32"))
+                y = paddle.to_tensor(
+                    rng.randint(0, 512, (4, 64)).astype("int64"))
+                losses.append(float(np.asarray(step(x, y)._data)))
+            return losses
+
+        serial = train()
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            dp = train()
+        finally:
+            _reset()
+        np.testing.assert_allclose(dp, serial, rtol=5e-5, atol=5e-6)
