@@ -15,6 +15,10 @@ The trade is one extra [T,H]x[H,C] matmul per chunk in the backward
 traffic and a [T, V] activation that no longer occupies HBM between
 forward and backward — which in turn frees room for larger batches.
 
+Vocab sizes that aren't a multiple of the chunk are padded with
+masked (-inf) columns so the chunk size never collapses (a prime
+vocab would otherwise degrade the scan to [T,1] matmuls).
+
 Reference analog: the fused softmax-with-cross-entropy family
 (upstream: paddle/phi/kernels/gpu/cross_entropy_kernel.cu and fleet's
 c_softmax_with_cross_entropy); the chunking strategy mirrors public
@@ -36,11 +40,17 @@ NEG_INF = -1e30
 
 
 def _pick_chunk(v: int, target: int) -> int:
-    """Largest divisor of ``v`` that is <= target (>= 1)."""
+    """Chunk size for vocab ``v``: the largest divisor <= target when a
+    reasonable one exists, else ``target`` itself with the tail padded
+    (divisor-only picking would collapse to 1 for prime vocabs)."""
     c = min(target, v)
     while v % c:
         c -= 1
-    return c
+    # accept the divisor only if it keeps chunks near-target; otherwise
+    # pad: e.g. v=32003 (prime) -> chunk=target with 1 padded tail
+    if c >= max(1, min(target, v) // 2):
+        return c
+    return min(target, v)
 
 
 def _chunk_logits(h, w_chunk):
@@ -51,20 +61,31 @@ def _chunk_logits(h, w_chunk):
     )
 
 
+def _padded_w3(w, c):
+    """Reshape w [V,H] to chunks [nc, C, H], zero-padding the tail."""
+    v, hidden = w.shape
+    nc = -(-v // c)
+    pad = nc * c - v
+    if pad:
+        w = jnp.pad(w, ((0, pad), (0, 0)))
+    return w.reshape(nc, c, hidden), nc, pad
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def fused_linear_cross_entropy_sum(h, w, labels, ignore_index, chunk):
-    """Sum of per-token CE of ``h @ w.T`` against ``labels``, plus the
-    count of non-ignored tokens. Returns (loss_sum f32, count f32)."""
-    loss, count, _ = _fwd_core(h, w, labels, ignore_index, chunk)
-    return loss, count
+def fused_linear_cross_entropy_per_token(h, w, labels, ignore_index,
+                                         chunk):
+    """Per-token CE of ``h @ w.T`` against ``labels`` (0 where
+    ignored), plus the count of non-ignored tokens. Returns
+    (per_tok f32 [T], count f32)."""
+    per_tok, count, _ = _fwd_core(h, w, labels, ignore_index, chunk)
+    return per_tok, count
 
 
 def _fwd_core(h, w, labels, ignore_index, chunk):
-    t, hidden = h.shape
+    t, _hidden = h.shape
     v = w.shape[0]
     c = _pick_chunk(v, chunk)
-    nc = v // c
-    w3 = w.reshape(nc, c, hidden)
+    w3, nc, pad = _padded_w3(w, c)
     valid = labels != ignore_index
     lab = jnp.where(valid, labels, 0).astype(jnp.int32)
 
@@ -72,6 +93,9 @@ def _fwd_core(h, w, labels, ignore_index, chunk):
         m, s, ll = carry
         w_chunk, off = xs
         logits = _chunk_logits(h, w_chunk)  # [T, C] f32
+        if pad:
+            col_ok = (off + jnp.arange(c)) < v
+            logits = jnp.where(col_ok[None, :], logits, NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.exp(
             logits - m_new[:, None]).sum(axis=-1)
@@ -90,31 +114,33 @@ def _fwd_core(h, w, labels, ignore_index, chunk):
     lse = jnp.log(s) + m
     per_tok = jnp.where(valid, lse - ll, 0.0)
     count = valid.sum().astype(jnp.float32)
-    return per_tok.sum(), count, lse
+    return per_tok, count, lse
 
 
 def _fwd_rule(h, w, labels, ignore_index, chunk):
-    loss, count, lse = _fwd_core(h, w, labels, ignore_index, chunk)
-    return (loss, count), (h, w, labels, lse)
+    per_tok, count, lse = _fwd_core(h, w, labels, ignore_index, chunk)
+    return (per_tok, count), (h, w, labels, lse)
 
 
 def _bwd_rule(ignore_index, chunk, res, cots):
     h, w, labels, lse = res
-    dloss, _dcount = cots  # count is integer-valued; its cot is unused
+    dper_tok, _dcount = cots  # count is integer-valued; cot unused
     t, hidden = h.shape
     v = w.shape[0]
     c = _pick_chunk(v, chunk)
-    nc = v // c
-    w3 = w.reshape(nc, c, hidden)
+    w3, nc, pad = _padded_w3(w, c)
     valid = labels != ignore_index
     lab = jnp.where(valid, labels, 0).astype(jnp.int32)
-    # d(per_tok)/d(logits_j) = softmax_j - onehot_label_j, scaled by the
-    # incoming cotangent on the summed loss; ignored tokens contribute 0
-    g = jnp.where(valid, dloss, 0.0).astype(jnp.float32)  # [T]
+    # d(per_tok)/d(logits_j) = softmax_j - onehot_label_j, scaled by
+    # each token's incoming cotangent; ignored tokens contribute 0
+    g = jnp.where(valid, dper_tok, 0.0).astype(jnp.float32)  # [T]
 
     def body(dh, xs):
         w_chunk, off = xs
         logits = _chunk_logits(h, w_chunk)  # recompute [T, C] f32
+        if pad:
+            col_ok = (off + jnp.arange(c)) < v
+            logits = jnp.where(col_ok[None, :], logits, NEG_INF)
         p = jnp.exp(logits - lse[:, None])
         rel = lab - off
         in_chunk = (rel >= 0) & (rel < c)
@@ -133,22 +159,32 @@ def _bwd_rule(ignore_index, chunk, res, cots):
     offsets = jnp.arange(nc, dtype=jnp.int32) * c
     dh, dw3 = jax.lax.scan(
         body, jnp.zeros((t, hidden), jnp.float32), (w3, offsets))
+    dw = dw3.reshape(nc * c, hidden)[:v]
     dlabels = np.zeros(labels.shape, jax.dtypes.float0)
-    return dh.astype(h.dtype), dw3.reshape(v, hidden), dlabels
+    return dh.astype(h.dtype), dw, dlabels
 
 
-fused_linear_cross_entropy_sum.defvjp(_fwd_rule, _bwd_rule)
+fused_linear_cross_entropy_per_token.defvjp(_fwd_rule, _bwd_rule)
 
 
 def fused_linear_cross_entropy(h, w, labels, ignore_index=-100,
                                chunk=4096, reduction="mean"):
-    """Mean/sum CE of the linear head ``h @ w.T`` without materializing
-    logits. h: [T, H] (or [B, S, H]), w: [V, H], labels: [T] / [B, S]."""
+    """CE of the linear head ``h @ w.T`` without materializing logits.
+    h: [T, H] (or [B, S, H]), w: [V, H], labels: [T] / [B, S].
+    reduction: "mean" (over non-ignored tokens), "sum", or "none"
+    (per-token losses in the labels' shape, 0 at ignored positions)."""
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(
+            f"fused_linear_cross_entropy: unknown reduction "
+            f"{reduction!r} (expected 'mean', 'sum' or 'none')")
+    shape = labels.shape
     if h.ndim == 3:
         h = h.reshape(-1, h.shape[-1])
     labels = labels.reshape(-1)
-    loss, count = fused_linear_cross_entropy_sum(
+    per_tok, count = fused_linear_cross_entropy_per_token(
         h, w, labels, int(ignore_index), int(chunk))
+    if reduction == "none":
+        return per_tok.reshape(shape)
     if reduction == "sum":
-        return loss
-    return loss / jnp.maximum(count, 1.0)
+        return per_tok.sum()
+    return per_tok.sum() / jnp.maximum(count, 1.0)

@@ -188,3 +188,45 @@ class TestFusedHeadLossDP:
         finally:
             _reset()
         np.testing.assert_allclose(dp, serial, rtol=5e-5, atol=5e-6)
+
+
+class TestFusedCEReductionsAndRagged:
+    def test_reduction_none_shape_and_values(self):
+        rng = np.random.RandomState(4)
+        h = jnp.asarray(rng.randn(3, 10, 8), jnp.float32)
+        w = jnp.asarray(rng.randn(40, 8), jnp.float32)
+        labels = jnp.asarray(rng.randint(0, 40, (3, 10)), jnp.int32)
+        labels = labels.at[1, 2].set(-100)
+        per = fused_linear_cross_entropy(h, w, labels, chunk=16,
+                                         reduction="none")
+        assert per.shape == (3, 10)
+        assert float(per[1, 2]) == 0.0
+        mean = fused_linear_cross_entropy(h, w, labels, chunk=16)
+        np.testing.assert_allclose(float(per.sum() / 29), float(mean),
+                                   rtol=1e-5)
+
+    def test_unknown_reduction_raises(self):
+        h = jnp.ones((2, 4)); w = jnp.ones((8, 4))
+        labels = jnp.zeros((2,), jnp.int32)
+        with pytest.raises(ValueError, match="unknown reduction"):
+            fused_linear_cross_entropy(h, w, labels, reduction="nope")
+
+    @pytest.mark.parametrize("vocab", [101, 97])  # prime: forces padding
+    def test_ragged_vocab_matches_naive(self, vocab):
+        rng = np.random.RandomState(5)
+        t, hidden = 12, 8
+        h = jnp.asarray(rng.randn(t, hidden), jnp.float32)
+        w = jnp.asarray(rng.randn(vocab, hidden), jnp.float32) * 0.1
+        labels = jnp.asarray(rng.randint(0, vocab, t), jnp.int32)
+        ref, (dh_r, dw_r) = jax.value_and_grad(_naive, argnums=(0, 1))(
+            h, w, labels)
+        got, (dh_f, dw_f) = jax.value_and_grad(
+            lambda a, b: fused_linear_cross_entropy(
+                a, b, labels, chunk=32), argnums=(0, 1))(h, w)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        np.testing.assert_allclose(dh_f, dh_r, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(dw_f, dw_r, rtol=1e-4, atol=1e-6)
+
+    def test_prime_vocab_keeps_chunk_wide(self):
+        assert _pick_chunk(32003, 4096) == 4096
+        assert _pick_chunk(151937, 4096) == 4096
