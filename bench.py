@@ -97,6 +97,44 @@ def _emit(rec):
     return rec
 
 
+_HEADLINE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_HEADLINE_LAST.json")
+
+
+def _save_headline_cache(rec, config=None):
+    """Persist the last SUCCESSFUL on-chip headline so a transient axon
+    wedge in a later run can't erase the evidence that the number was
+    measured (round-2 lost a whole round to exactly that)."""
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            cwd=os.path.dirname(_HEADLINE_CACHE)).stdout.strip()
+    except Exception:
+        rev = "unknown"
+    try:
+        # Atomic replace: a mid-write kill (the very wedge this cache
+        # guards against) must not truncate the previous evidence.
+        tmp = _HEADLINE_CACHE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"measured_at_unix": int(time.time()),
+                       "git_rev": rev, "record": rec,
+                       "config": config or {},
+                       "note": "last successful on-chip headline; "
+                       "attached as `last_measured` when a later run "
+                       "cannot reach the chip"}, f, indent=1)
+        os.replace(tmp, _HEADLINE_CACHE)
+    except OSError:
+        pass
+
+
+def _load_headline_cache():
+    try:
+        with open(_HEADLINE_CACHE) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
 
 def _timed(step, x, y, steps):
     """Shared compile/warmup/timed-loop harness for train benches."""
@@ -920,8 +958,12 @@ def main() -> int:
 
     def _error_headline(msg):
         if headline_expected:
-            return {"metric": "llama_train_mfu", "value": 0.0,
-                    "unit": "%", "vs_baseline": 0.0, "error": msg}
+            rec = {"metric": "llama_train_mfu", "value": 0.0,
+                   "unit": "%", "vs_baseline": 0.0, "error": msg}
+            cached = _load_headline_cache()
+            if cached:
+                rec["last_measured"] = cached
+            return rec
         return {"metric": "bench_matrix_subset", "value": 0.0,
                 "unit": "ok", "vs_baseline": 0.0, "error": msg}
 
@@ -982,6 +1024,13 @@ def main() -> int:
                 hl = bench_llama_headline(
                     steps=args.steps, seq=args.seq, batch=args.batch)
                 _emit(hl)
+                # Only an on-chip number is evidence; a CPU-platform run
+                # (e.g. JAX_PLATFORMS=cpu smoke) must not overwrite it.
+                if "error" not in hl and \
+                        not str(hl.get("device", "cpu")).startswith("cpu"):
+                    _save_headline_cache(
+                        hl, config={"steps": args.steps, "seq": args.seq,
+                                    "batch": args.batch})
             except Exception as e:
                 hl = _error_headline(str(e)[:300])
         with state_lock:
