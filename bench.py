@@ -108,7 +108,8 @@ def _save_headline_cache(rec, config=None):
     try:
         rev = subprocess.run(
             ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
-            cwd=os.path.dirname(_HEADLINE_CACHE)).stdout.strip()
+            cwd=os.path.dirname(_HEADLINE_CACHE)).stdout.strip() \
+            or "unknown"
     except Exception:
         rev = "unknown"
     try:
@@ -117,6 +118,8 @@ def _save_headline_cache(rec, config=None):
         tmp = _HEADLINE_CACHE + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"measured_at_unix": int(time.time()),
+                       "measured_at": time.strftime(
+                           "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                        "git_rev": rev, "record": rec,
                        "config": config or {},
                        "note": "last successful on-chip headline; "

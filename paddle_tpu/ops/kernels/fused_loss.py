@@ -15,9 +15,11 @@ The trade is one extra [T,H]x[H,C] matmul per chunk in the backward
 traffic and a [T, V] activation that no longer occupies HBM between
 forward and backward — which in turn frees room for larger batches.
 
-Vocab sizes that aren't a multiple of the chunk are padded with
-masked (-inf) columns so the chunk size never collapses (a prime
-vocab would otherwise degrade the scan to [T,1] matmuls).
+Vocab sizes that aren't a multiple of the chunk keep the scan on the
+divisible prefix and process the ragged tail as one extra unpadded
+chunk after the scan (a prime vocab would otherwise degrade the scan
+to [T,1] matmuls, and padding the whole weight would re-materialize a
+[V,H] copy per call — HBM traffic this kernel exists to avoid).
 
 Reference analog: the fused softmax-with-cross-entropy family
 (upstream: paddle/phi/kernels/gpu/cross_entropy_kernel.cu and fleet's
@@ -41,13 +43,14 @@ NEG_INF = -1e30
 
 def _pick_chunk(v: int, target: int) -> int:
     """Chunk size for vocab ``v``: the largest divisor <= target when a
-    reasonable one exists, else ``target`` itself with the tail padded
-    (divisor-only picking would collapse to 1 for prime vocabs)."""
+    reasonable one exists, else ``target`` itself with the remainder
+    handled as a ragged tail chunk after the scan (divisor-only picking
+    would collapse to 1 for prime vocabs)."""
     c = min(target, v)
     while v % c:
         c -= 1
     # accept the divisor only if it keeps chunks near-target; otherwise
-    # pad: e.g. v=32003 (prime) -> chunk=target with 1 padded tail
+    # go ragged: e.g. v=32003 -> 7 full chunks of 4096 + a 3331-row tail
     if c >= max(1, min(target, v) // 2):
         return c
     return min(target, v)
@@ -61,14 +64,20 @@ def _chunk_logits(h, w_chunk):
     )
 
 
-def _padded_w3(w, c):
-    """Reshape w [V,H] to chunks [nc, C, H], zero-padding the tail."""
-    v, hidden = w.shape
-    nc = -(-v // c)
-    pad = nc * c - v
-    if pad:
-        w = jnp.pad(w, ((0, pad), (0, 0)))
-    return w.reshape(nc, c, hidden), nc, pad
+def _split_w(w, c):
+    """Chunk plan for w [V,H]: ``nc_full`` scan chunks of ``c`` rows
+    plus an unpadded ragged tail [tail, H] (tail may be 0). The scan
+    body reads its chunk with ``dynamic_slice`` straight out of ``w``
+    — no padded or re-stacked copy of the weights is materialized."""
+    v, _hidden = w.shape
+    nc_full = v // c
+    tail = v - nc_full * c
+    w_tail = w[nc_full * c:] if tail else None
+    return nc_full, w_tail, tail
+
+
+def _w_chunk(w, off, c):
+    return jax.lax.dynamic_slice_in_dim(w, off, c, axis=0)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -85,32 +94,34 @@ def _fwd_core(h, w, labels, ignore_index, chunk):
     t, _hidden = h.shape
     v = w.shape[0]
     c = _pick_chunk(v, chunk)
-    w3, nc, pad = _padded_w3(w, c)
+    nc_full, w_tail, tail = _split_w(w, c)
     valid = labels != ignore_index
     lab = jnp.where(valid, labels, 0).astype(jnp.int32)
 
-    def body(carry, xs):
+    def step(carry, w_chunk, off, ncols):
         m, s, ll = carry
-        w_chunk, off = xs
-        logits = _chunk_logits(h, w_chunk)  # [T, C] f32
-        if pad:
-            col_ok = (off + jnp.arange(c)) < v
-            logits = jnp.where(col_ok[None, :], logits, NEG_INF)
+        logits = _chunk_logits(h, w_chunk)  # [T, ncols] f32
         m_new = jnp.maximum(m, logits.max(axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.exp(
             logits - m_new[:, None]).sum(axis=-1)
         rel = lab - off
-        in_chunk = (rel >= 0) & (rel < c)
+        in_chunk = (rel >= 0) & (rel < ncols)
         picked = jnp.take_along_axis(
-            logits, jnp.clip(rel, 0, c - 1)[:, None], axis=-1)[:, 0]
+            logits, jnp.clip(rel, 0, ncols - 1)[:, None], axis=-1)[:, 0]
         ll = jnp.where(in_chunk, picked, ll)
-        return (m_new, s, ll), None
+        return (m_new, s, ll)
+
+    def body(carry, off):
+        return step(carry, _w_chunk(w, off, c), off, c), None
 
     init = (jnp.full((t,), NEG_INF, jnp.float32),
             jnp.zeros((t,), jnp.float32),
             jnp.zeros((t,), jnp.float32))
-    offsets = jnp.arange(nc, dtype=jnp.int32) * c
-    (m, s, ll), _ = jax.lax.scan(body, init, (w3, offsets))
+    offsets = jnp.arange(nc_full, dtype=jnp.int32) * c
+    carry, _ = jax.lax.scan(body, init, offsets)
+    if tail:
+        carry = step(carry, w_tail, nc_full * c, tail)
+    m, s, ll = carry
     lse = jnp.log(s) + m
     per_tok = jnp.where(valid, lse - ll, 0.0)
     count = valid.sum().astype(jnp.float32)
@@ -128,25 +139,21 @@ def _bwd_rule(ignore_index, chunk, res, cots):
     t, hidden = h.shape
     v = w.shape[0]
     c = _pick_chunk(v, chunk)
-    w3, nc, pad = _padded_w3(w, c)
+    nc_full, w_tail, tail = _split_w(w, c)
     valid = labels != ignore_index
     lab = jnp.where(valid, labels, 0).astype(jnp.int32)
     # d(per_tok)/d(logits_j) = softmax_j - onehot_label_j, scaled by
     # each token's incoming cotangent; ignored tokens contribute 0
     g = jnp.where(valid, dper_tok, 0.0).astype(jnp.float32)  # [T]
 
-    def body(dh, xs):
-        w_chunk, off = xs
-        logits = _chunk_logits(h, w_chunk)  # recompute [T, C] f32
-        if pad:
-            col_ok = (off + jnp.arange(c)) < v
-            logits = jnp.where(col_ok[None, :], logits, NEG_INF)
+    def step(dh, w_chunk, off, ncols):
+        logits = _chunk_logits(h, w_chunk)  # recompute [T, ncols] f32
         p = jnp.exp(logits - lse[:, None])
         rel = lab - off
-        in_chunk = (rel >= 0) & (rel < c)
+        in_chunk = (rel >= 0) & (rel < ncols)
         onehot = jax.nn.one_hot(
-            jnp.where(in_chunk, rel, -1), c, dtype=jnp.float32)
-        dlogits = (p - onehot) * g[:, None]  # [T, C] f32
+            jnp.where(in_chunk, rel, -1), ncols, dtype=jnp.float32)
+        dlogits = (p - onehot) * g[:, None]  # [T, ncols] f32
         dlogits = dlogits.astype(h.dtype)
         dh = dh + jax.lax.dot_general(
             dlogits, w_chunk, (((1,), (0,)), ((), ())),
@@ -156,10 +163,16 @@ def _bwd_rule(ignore_index, chunk, res, cots):
             preferred_element_type=jnp.float32).astype(w.dtype)
         return dh, dw_chunk
 
-    offsets = jnp.arange(nc, dtype=jnp.int32) * c
+    def body(dh, off):
+        return step(dh, _w_chunk(w, off, c), off, c)
+
+    offsets = jnp.arange(nc_full, dtype=jnp.int32) * c
     dh, dw3 = jax.lax.scan(
-        body, jnp.zeros((t, hidden), jnp.float32), (w3, offsets))
-    dw = dw3.reshape(nc * c, hidden)[:v]
+        body, jnp.zeros((t, hidden), jnp.float32), offsets)
+    dw = dw3.reshape(nc_full * c, hidden)
+    if tail:
+        dh, dw_tail = step(dh, w_tail, nc_full * c, tail)
+        dw = jnp.concatenate([dw, dw_tail], axis=0)
     dlabels = np.zeros(labels.shape, jax.dtypes.float0)
     return dh.astype(h.dtype), dw, dlabels
 

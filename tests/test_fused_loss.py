@@ -211,13 +211,18 @@ class TestFusedCEReductionsAndRagged:
         with pytest.raises(ValueError, match="unknown reduction"):
             fused_linear_cross_entropy(h, w, labels, reduction="nope")
 
-    @pytest.mark.parametrize("vocab", [101, 97])  # prime: forces padding
+    @pytest.mark.parametrize("vocab", [101, 97])  # prime: forces tail
     def test_ragged_vocab_matches_naive(self, vocab):
+        # Guard: chunk=32 must NOT resolve to a divisor, or this test
+        # silently stops covering the ragged-tail fwd/bwd branches.
+        assert vocab % _pick_chunk(vocab, 32) != 0
         rng = np.random.RandomState(5)
         t, hidden = 12, 8
         h = jnp.asarray(rng.randn(t, hidden), jnp.float32)
         w = jnp.asarray(rng.randn(vocab, hidden), jnp.float32) * 0.1
         labels = jnp.asarray(rng.randint(0, vocab, t), jnp.int32)
+        # labels in the tail chunk AND an ignored position
+        labels = labels.at[0].set(vocab - 1).at[5].set(-100)
         ref, (dh_r, dw_r) = jax.value_and_grad(_naive, argnums=(0, 1))(
             h, w, labels)
         got, (dh_f, dw_f) = jax.value_and_grad(
