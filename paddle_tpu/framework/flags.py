@@ -87,6 +87,10 @@ define_flag("flash_precision_highest", False,
 define_flag("pallas_interpret", False,
             "run the Pallas kernels in interpret mode "
             "off-TPU (CI coverage of the kernel path on CPU)")
+define_flag("moe_dense_dispatch", False,
+            "route MoE tokens via the dense (N,E,C) one-hot "
+            "dispatch/combine einsums instead of the sparse index "
+            "scatter/gather path (oracle/debug; same semantics)")
 if os.environ.get("FLAGS_flash_pallas_interpret"):
     # pre-rename env alias (was flash-only before covering all kernels)
     _REGISTRY["pallas_interpret"] = True

@@ -53,48 +53,79 @@ def _positions_in_expert(mask, capacity, offset=None):
     return pos, keep
 
 
-def _topk_combine_dispatch(gates, top_k, capacity, normalize=True,
-                           second_keep=None):
-    """Shared routing core: softmax gate probs → (combine, dispatch).
+def _route_choices(gates, top_k, capacity, normalize=True,
+                   second_keep=None):
+    """Shared routing core: per-choice (expert, slot, weight, keep).
 
     ``second_keep`` optionally masks out k-th choices (k>=2) per token
     (random_routing). Dropping is greedy by choice rank: all 1st choices
     claim capacity before any 2nd choice (reference gshard ordering).
-    """
-    n, e = gates.shape
-    combine = jnp.zeros((n, e, capacity), dtype=jnp.float32)
+
+    Returns a list over k of dicts with ``eid`` (N,) int32 chosen
+    expert, ``pos``/``keep`` (N, E) capacity bookkeeping (nonzero only
+    at the chosen expert's column), ``slot`` (N,) int32 capacity slot at
+    the chosen expert, ``kept`` (N,) bool survived capacity/random
+    masking, and ``w`` (N,) f32 the (normalized) combine weight.
+    Both the dense (N,E,C) one-hot tensors and the sparse index
+    representation are derived from these same arrays, so the two
+    dispatch paths cannot drift."""
     masked_gates = gates
-    count_so_far = jnp.zeros((e,), dtype=jnp.int32)
-    chosen_masks, chosen_gates = [], []
+    chosen = []
     for k in range(top_k):
         idx = jnp.argmax(masked_gates, axis=-1)
-        mask = jax.nn.one_hot(idx, e, dtype=jnp.int32)
+        mask = jax.nn.one_hot(idx, gates.shape[-1], dtype=jnp.int32)
         gate_k = jnp.sum(gates * mask, axis=-1)
         if k >= 1 and second_keep is not None:
             mask = mask * second_keep[:, None].astype(jnp.int32)
-        chosen_masks.append(mask)
-        chosen_gates.append(gate_k)
+        chosen.append({"eid": idx.astype(jnp.int32), "mask": mask,
+                       "g": gate_k})
         masked_gates = masked_gates * (1 - mask)
 
     denom = 1.0
     if normalize:
-        denom = sum(
-            g * m.max(axis=-1) for g, m in zip(chosen_gates, chosen_masks)
-        )
+        denom = sum(c["g"] * c["mask"].max(axis=-1) for c in chosen)
         denom = jnp.maximum(denom, 1e-9)
 
+    count_so_far = jnp.zeros((gates.shape[-1],), dtype=jnp.int32)
+    for c in chosen:
+        pos, keep = _positions_in_expert(
+            c["mask"], capacity, offset=count_so_far)
+        count_so_far = count_so_far + jnp.sum(c["mask"], axis=0)
+        c["pos"], c["keep"] = pos, keep
+        c["slot"] = jnp.sum(pos * c["mask"], axis=-1).astype(jnp.int32)
+        c["kept"] = jnp.max(keep, axis=-1).astype(bool)
+        c["w"] = c["g"] / denom if normalize else c["g"]
+    return chosen
+
+
+def _topk_combine_dispatch(gates, top_k, capacity, normalize=True,
+                           second_keep=None):
+    """Dense GShard tensors: (combine (N,E,C) f32, dispatch (N,E,C)
+    bool) built from :func:`_route_choices` (the oracle path)."""
+    n, e = gates.shape
+    combine = jnp.zeros((n, e, capacity), dtype=jnp.float32)
     dispatch = jnp.zeros((n, e, capacity), dtype=bool)
-    for k in range(top_k):
-        mask = chosen_masks[k]
-        pos, keep = _positions_in_expert(mask, capacity, offset=count_so_far)
-        count_so_far = count_so_far + jnp.sum(mask, axis=0)
-        d_k = jax.nn.one_hot(pos, capacity, dtype=jnp.float32) * keep[
-            ..., None
-        ].astype(jnp.float32)
-        w_k = chosen_gates[k] / denom if normalize else chosen_gates[k]
-        combine = combine + d_k * w_k[:, None, None]
+    for c in _route_choices(gates, top_k, capacity, normalize,
+                            second_keep):
+        d_k = jax.nn.one_hot(c["pos"], capacity, dtype=jnp.float32) \
+            * c["keep"][..., None].astype(jnp.float32)
+        combine = combine + d_k * c["w"][:, None, None]
         dispatch = dispatch | d_k.astype(bool)
     return combine, dispatch
+
+
+def _topk_sparse(gates, top_k, capacity, normalize=True,
+                 second_keep=None):
+    """Sparse index routing: (eid (N,K) int32, slot (N,K) int32,
+    wgt (N,K) f32 — zero where the choice was dropped). O(N·K) instead
+    of the dense O(N·E·C) one-hot tensors; derived from the same
+    :func:`_route_choices` bookkeeping as the dense oracle."""
+    ch = _route_choices(gates, top_k, capacity, normalize, second_keep)
+    eid = jnp.stack([c["eid"] for c in ch], axis=1)
+    slot = jnp.stack([c["slot"] for c in ch], axis=1)
+    wgt = jnp.stack(
+        [c["w"] * c["kept"].astype(jnp.float32) for c in ch], axis=1)
+    return eid, slot, wgt
 
 
 class BaseGate(Layer):
@@ -141,7 +172,7 @@ class NaiveGate(BaseGate):
         """Reference-style return: (topk_val, topk_idx)."""
         return self._topk_forward(inp, "naive_gate", self.top_k)
 
-    def make_router(self, capacity_factor=None):
+    def make_router(self, capacity_factor=None, sparse=False):
         if capacity_factor is None:
             capacity_factor = 2.0
         top_k, e = self.top_k, self.tot_expert
@@ -150,10 +181,14 @@ class NaiveGate(BaseGate):
             cap = _capacity(x.shape[0], e, top_k, capacity_factor)
             logits = x.astype(jnp.float32) @ w.astype(jnp.float32)
             gates = jax.nn.softmax(logits, axis=-1)
+            aux = jnp.zeros((), jnp.float32)
+            if sparse:
+                return _topk_sparse(
+                    gates, top_k, cap, normalize=False), aux, cap
             combine, dispatch = _topk_combine_dispatch(
                 gates, top_k, cap, normalize=False
             )
-            return combine, dispatch, jnp.zeros((), jnp.float32)
+            return combine, dispatch, aux
 
         return route
 
@@ -179,7 +214,7 @@ class GShardGate(BaseGate):
     def forward(self, inp):
         return self._topk_forward(inp, "gshard_gate", self.top_k)
 
-    def make_router(self, capacity_factor=None):
+    def make_router(self, capacity_factor=None, sparse=False):
         cf = capacity_factor if capacity_factor is not None else (
             self.capacity[0] if self.training else self.capacity[1]
         )
@@ -207,6 +242,10 @@ class GShardGate(BaseGate):
                 u = jax.random.uniform(rand_key, (x.shape[0],))
                 second_keep = u < (2.0 * g2)
 
+            if sparse:
+                return _topk_sparse(
+                    gates, 2, cap, normalize=True,
+                    second_keep=second_keep), aux, cap
             combine, dispatch = _topk_combine_dispatch(
                 gates, 2, cap, normalize=True, second_keep=second_keep
             )
@@ -235,7 +274,7 @@ class SwitchGate(BaseGate):
     def forward(self, inp):
         return self._topk_forward(inp, "switch_gate", 1)
 
-    def make_router(self, capacity_factor=None):
+    def make_router(self, capacity_factor=None, sparse=False):
         cf = capacity_factor if capacity_factor is not None else (
             self.capacity[0] if self.training else self.capacity[1]
         )
@@ -261,6 +300,9 @@ class SwitchGate(BaseGate):
                 jnp.mean(gates, axis=0) * jnp.mean(top1_mask, axis=0)
             ) * e
 
+            if sparse:
+                return _topk_sparse(
+                    gates, 1, cap, normalize=False), aux, cap
             combine, dispatch = _topk_combine_dispatch(
                 gates, 1, cap, normalize=False
             )
