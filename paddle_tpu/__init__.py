@@ -118,8 +118,21 @@ from .framework import log as _log  # noqa: E402
 if framework.flags.flag("enable_signal_handler"):
     _log.install_signal_handlers()
 
-disable_static = lambda *a, **k: None  # dygraph is the default mode
-enable_static = lambda *a, **k: None
+def enable_static():
+    """Enter static-graph mode: ops record into
+    ``static.default_main_program()`` until ``disable_static()``."""
+    from .static import _enable_static
+
+    _enable_static()
+
+
+def disable_static():
+    """Back to dygraph (the default mode)."""
+    from .static import _disable_static
+
+    _disable_static()
+
+
 
 
 def is_grad_enabled_():

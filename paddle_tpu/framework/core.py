@@ -64,6 +64,7 @@ class _EagerState(threading.local):
         self.amp_cast_fn = None  # installed by paddle_tpu.amp
         self.op_stats_hook = None  # installed by amp.debugging
         self.retain_graph_depth = 0
+        self.static_program = None  # paddle.static recording Program
 
 
 _state = _EagerState()
@@ -188,7 +189,12 @@ class Tensor:
                  persistable=False):
         if isinstance(data, Tensor):
             data = data._data
-        if not isinstance(data, jax.Array) and not isinstance(
+        if isinstance(data, jax.ShapeDtypeStruct):
+            # symbolic payload: a static-graph placeholder/op result —
+            # shape/dtype only, no values (paddle.static recording)
+            if dtype is not None and data.dtype != to_np_dtype(dtype):
+                data = jax.ShapeDtypeStruct(data.shape, to_np_dtype(dtype))
+        elif not isinstance(data, jax.Array) and not isinstance(
             data, jax.core.Tracer
         ):
             data = jnp.asarray(
@@ -254,6 +260,11 @@ class Tensor:
 
     # -- data access -------------------------------------------------------
     def numpy(self):
+        if isinstance(self._data, jax.ShapeDtypeStruct):
+            raise RuntimeError(
+                f"Tensor '{self.name}' is a static-graph placeholder "
+                f"(shape {tuple(self._data.shape)}); it has no value "
+                f"until Executor.run — fetch it via fetch_list instead")
         return np.asarray(self._data)
 
     def item(self, *args):
@@ -427,6 +438,15 @@ def apply_op(name: str, fn: Callable, *tensor_inputs, n_outs: int = 1,
     ins = tuple(
         t if isinstance(t, Tensor) else Tensor(t) for t in tensor_inputs
     )
+    # paddle.static recording: when a Program is active and any input is
+    # symbolic, don't execute — infer output shapes (jax.eval_shape) and
+    # append the op to the Program. Ops over purely-concrete inputs
+    # (parameter creation/initializers) still run eagerly, which is the
+    # startup-program role. Replay happens in Executor.run.
+    if _state.static_program is not None and any(
+        isinstance(t._data, jax.ShapeDtypeStruct) for t in ins
+    ):
+        return _state.static_program._record(name, fn, ins, n_outs)
     # AMP hook: the installed policy may cast inputs (O1 white/black list)
     if _state.amp_cast_fn is not None:
         ins, fn = _state.amp_cast_fn(name, ins, fn)
@@ -459,3 +479,16 @@ def _as_tensor(x, dtype=None):
     if isinstance(x, Tensor):
         return x
     return Tensor(x, dtype=dtype)
+
+
+def assign_state(dst, src):
+    """State write-back ``dst._data = src._data`` (running stats, beta
+    pows, ...). Under static-graph recording the source is symbolic, so
+    the assignment is recorded on the Program and performed at
+    Executor-replay time instead (where jit captures it as state)."""
+    if _state.static_program is not None and isinstance(
+        src._data, jax.ShapeDtypeStruct
+    ):
+        _state.static_program._record_writeback(dst, src)
+        return
+    dst._data = src._data

@@ -196,14 +196,26 @@ class MoELayer(Layer):
             # (gshard/switch draw a key at build time), so the sparse
             # and dense paths see identical randomness under one seed.
             sparse = not flag("moe_dense_dispatch")
-            try:
-                router = self.gate.make_router(
-                    self.capacity_factor, sparse=sparse)
-            except TypeError:
-                # user BaseGate subclass predating the sparse= kwarg:
-                # it can only produce dense tensors — honor that
-                router = self.gate.make_router(self.capacity_factor)
-                sparse = False
+            if sparse:
+                # user BaseGate subclasses predating the sparse= kwarg
+                # can only produce dense tensors — honor that (checked
+                # by signature, NOT try/except: a TypeError inside a
+                # sparse-aware router must propagate, and a retry would
+                # consume a second RNG key)
+                import inspect
+
+                try:
+                    params = inspect.signature(
+                        self.gate.make_router).parameters
+                    sparse = "sparse" in params or any(
+                        p.kind is inspect.Parameter.VAR_KEYWORD
+                        for p in params.values())
+                except (TypeError, ValueError):
+                    sparse = False
+            router = (
+                self.gate.make_router(self.capacity_factor, sparse=sparse)
+                if sparse
+                else self.gate.make_router(self.capacity_factor))
 
             def f(x, gw, w0, b0, w1, b1):
                 lead = x.shape[:-1]

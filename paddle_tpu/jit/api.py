@@ -58,6 +58,7 @@ class StaticFunction:
     _TRACE_FLAGS = (
         "check_nan_inf", "use_pallas_flash_bwd", "use_pallas_kernels",
         "flash_precision_highest", "pallas_interpret",
+        "moe_dense_dispatch",
     )
 
     def _mode_sig(self):

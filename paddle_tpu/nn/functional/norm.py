@@ -7,7 +7,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...framework.core import Tensor, apply_op, _as_tensor
+from ...framework.core import Tensor, apply_op, _as_tensor, assign_state
 from ...framework.infermeta import infer_meta
 
 
@@ -77,27 +77,30 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     use_batch_stats = training and not use_global_stats
 
     if use_batch_stats:
-        # functional stats update: new running stats computed here and
-        # written back to the buffer tensors (captured as state by jit)
-        def stats(a):
-            af = a.astype(jnp.float32)
-            m = jnp.mean(af, axis=reduce_axes)
-            v = jnp.var(af, axis=reduce_axes)
-            return m, v
-
-        m_new, v_new = stats(x._data)
+        # functional stats update: new running stats computed as a
+        # (non-differentiable) op and written back to the buffer
+        # tensors (captured as state by jit; deferred to replay time
+        # under static-graph recording)
         n = 1
         for i in reduce_axes:
             n *= x.shape[i]
-        unbiased = v_new * (n / max(n - 1, 1))
-        running_mean._data = (
-            momentum * running_mean._data.astype(jnp.float32)
-            + (1 - momentum) * m_new
-        ).astype(running_mean._data.dtype)
-        running_var._data = (
-            momentum * running_var._data.astype(jnp.float32)
-            + (1 - momentum) * unbiased
-        ).astype(running_var._data.dtype)
+
+        def stats(a, rm, rv):
+            af = a.astype(jnp.float32)
+            m_new = jnp.mean(af, axis=reduce_axes)
+            unbiased = jnp.var(af, axis=reduce_axes) * (n / max(n - 1, 1))
+            new_rm = (momentum * rm.astype(jnp.float32)
+                      + (1 - momentum) * m_new).astype(rm.dtype)
+            new_rv = (momentum * rv.astype(jnp.float32)
+                      + (1 - momentum) * unbiased).astype(rv.dtype)
+            return new_rm, new_rv
+
+        new_rm, new_rv = apply_op(
+            "batch_norm_stats", stats, x, running_mean, running_var,
+            n_outs=2, differentiable=False,
+        )
+        assign_state(running_mean, new_rm)
+        assign_state(running_var, new_rv)
 
         def body(a, *wb):
             dt = a.dtype

@@ -93,10 +93,10 @@ _WAIVER_GROUPS = {
         "tril_indices triu_indices zeros zeros_like cast",
     "in-place variant: aliases the swept out-of-place op (in-place "
     "semantics tested in tests/test_ops.py)":
-        "add_ clip_ divide_ exp_ fill_ fill_diagonal_ floor_ frac_ "
-        "index_fill_ masked_fill_ multiply_ relu_ remainder_ reshape_ "
-        "scale_ softmax_ subtract_ tril_ trunc_ unsqueeze_ where_ "
-        "zero_",
+        "add_ clip_ divide_ exp_ fill_ fill_diagonal_ flatten_ floor_ "
+        "frac_ index_fill_ masked_fill_ multiply_ relu_ remainder_ "
+        "reshape_ scale_ softmax_ subtract_ tril_ trunc_ unsqueeze_ "
+        "where_ zero_",
     "alias of a swept op (same kernel)":
         "negative remainder floor_mod inverse igamma igammac view "
         "positive",

@@ -29,15 +29,20 @@ class AdamW(Optimizer):
         super().__init__(learning_rate, parameters,
                          weight_decay if weight_decay is not None else 0.0,
                          grad_clip, name, multi_precision)
+        self._init_param_state()
+
+    def _init_param_state(self):
         for p in self._parameter_list:
             self._aux_state.setdefault(
                 f"{p.name}_beta1_pow_acc_0",
-                Tensor(jnp.asarray(beta1, jnp.float32), persistable=True,
+                Tensor(jnp.asarray(self._beta1, jnp.float32),
+                       persistable=True,
                        name=f"{p.name}_beta1_pow_acc_0"),
             )
             self._aux_state.setdefault(
                 f"{p.name}_beta2_pow_acc_0",
-                Tensor(jnp.asarray(beta2, jnp.float32), persistable=True,
+                Tensor(jnp.asarray(self._beta2, jnp.float32),
+                       persistable=True,
                        name=f"{p.name}_beta2_pow_acc_0"),
             )
 

@@ -26,10 +26,13 @@ class Adamax(Optimizer):
         self._epsilon = epsilon
         super().__init__(learning_rate, parameters, weight_decay,
                          grad_clip, name, multi_precision)
+        self._init_param_state()
+
+    def _init_param_state(self):
         for p in self._parameter_list:
             self._aux_state.setdefault(
                 f"{p.name}_amax_b1p",
-                Tensor(jnp.asarray(beta1, jnp.float32),
+                Tensor(jnp.asarray(self._beta1, jnp.float32),
                        persistable=True, name=f"{p.name}_amax_b1p"),
             )
 
@@ -114,6 +117,9 @@ class NAdam(Optimizer):
         self._psi = momentum_decay
         super().__init__(learning_rate, parameters, weight_decay,
                          grad_clip, name, multi_precision)
+        self._init_param_state()
+
+    def _init_param_state(self):
         for p in self._parameter_list:
             for key, init in (
                 ("nadam_step", 0.0), ("nadam_mu_prod", 1.0),
@@ -178,6 +184,9 @@ class RAdam(Optimizer):
         self._epsilon = epsilon
         super().__init__(learning_rate, parameters, weight_decay,
                          grad_clip, name, multi_precision)
+        self._init_param_state()
+
+    def _init_param_state(self):
         for p in self._parameter_list:
             self._aux_state.setdefault(
                 f"{p.name}_radam_step",

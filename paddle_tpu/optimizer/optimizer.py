@@ -27,10 +27,16 @@ class Optimizer:
                  weight_decay=None, grad_clip=None, name=None,
                  multi_precision=True):
         if parameters is None:
-            raise ValueError(
-                "paddle_tpu requires explicit `parameters` in dygraph mode "
-                "(same as the reference)"
-            )
+            from ..framework.core import _state
+
+            if _state.static_program is None:
+                raise ValueError(
+                    "paddle_tpu requires explicit `parameters` in dygraph "
+                    "mode (same as the reference)"
+                )
+            # static-graph mode: resolved at minimize() from the
+            # parameters the recorded program actually touches
+            parameters = []
         self._parameter_list = list(parameters)
         self._param_groups = None
         if self._parameter_list and isinstance(self._parameter_list[0], dict):
@@ -98,6 +104,12 @@ class Optimizer:
                 self._add_accumulator(name, p)
             if self._use_master(p):
                 self._get_master(p)
+
+    def _init_param_state(self):
+        """Per-parameter aux state (beta pows, step counters, ...) —
+        overridden by optimizers that need it. Must be idempotent
+        (setdefault): called from __init__ AND again when the static-
+        graph minimize() binds parameters late."""
 
     def _state_tensors(self):
         out = [self._lr_tensor]
@@ -176,6 +188,23 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        import jax
+
+        from ..framework.core import _state
+
+        if _state.static_program is not None and isinstance(
+            loss._data, jax.ShapeDtypeStruct
+        ):
+            # static-graph mode: mark the program trainable — the
+            # backward + update run inside Executor.run's compiled
+            # replay (the append-backward-ops role)
+            if not self._parameter_list:
+                self._parameter_list = list(
+                    _state.static_program._trainable_params())
+                self._create_accumulators()
+                self._init_param_state()
+            _state.static_program._mark_trainable(self, loss)
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -1,9 +1,14 @@
-"""Minimal paddle.static surface (upstream: python/paddle/static/).
+"""paddle.static surface (upstream: python/paddle/static/).
 
-The static-graph Program/Executor model is replaced by traced jit (XLA);
-InputSpec survives as the input-signature declaration for to_static and
-jit.save, and cond/while_loop map to lax control flow for use inside
-compiled steps.
+A WORKING static-graph mode over the eager core: ``Program`` records
+ops symbolically at the ``apply_op`` choke point (shape inference via
+``jax.eval_shape``, no kernels run at build), ``Executor.run`` replays
+the graph through the normal tape inside one ``@to_static``-compiled
+step — XLA plays the reference executor/pass-stack's role, and
+``optimizer.minimize(loss)`` marks the program trainable so the replay
+runs backward + update (the append-backward role). InputSpec remains
+the input-signature declaration for to_static/jit.save; cond/while_loop
+map to lax control flow.
 """
 from __future__ import annotations
 
@@ -80,16 +85,446 @@ def nn_while_loop(cond_fn, body_fn, loop_vars):
     return wrap(outs)
 
 
-class nn:
-    cond = staticmethod(cond)
-    while_loop = staticmethod(nn_while_loop)
+from . import nn  # noqa: E402  (static.nn builders: fc, embedding, ...)
+
+nn.cond = cond
+nn.while_loop = nn_while_loop
+
+
+# ---------------------------------------------------------------------------
+# Program / Executor: a working static-graph mode over the eager core
+# (upstream: python/paddle/static/ + fluid Program/Executor;
+#  paddle/fluid/framework/program_desc.cc holds the reference's C++ graph).
+#
+# TPU-native design — NOT an IR: under an active Program, ``apply_op``
+# (the single op choke point) records each op symbolically instead of
+# executing: outputs come from ``jax.eval_shape`` over
+# ``ShapeDtypeStruct`` placeholders, so graph building runs no kernels.
+# ``Executor.run`` replays the recorded ops through the normal eager
+# tape inside one ``@to_static``-compiled step — the replay IS the
+# "executor", XLA is the optimizer/scheduler, and training reuses the
+# existing autograd/optimizer machinery (``optimizer.minimize(loss)``
+# on a symbolic loss marks the program trainable; the compiled replay
+# then runs loss.backward + opt.step). Parameters stay live eager
+# tensors: creation/initialization at layer-construction time plays the
+# startup-program role, and Executor.run(startup_program) is a no-op.
+# ---------------------------------------------------------------------------
+
+
+class _OpNode:
+    __slots__ = ("name", "fn", "in_refs", "out_uids", "n_outs",
+                 "writeback")
+
+    def __init__(self, name, fn, in_refs, out_uids, n_outs,
+                 writeback=None):
+        self.name, self.fn = name, fn
+        self.in_refs, self.out_uids = in_refs, out_uids
+        self.n_outs = n_outs
+        self.writeback = writeback  # live Tensor to assign env[in_refs[0]]
+
+
+class Program:
+    """A recorded op graph. Build ops under ``program_guard`` (or after
+    ``paddle.enable_static()``), feed/fetch through ``Executor.run``."""
+
+    def __init__(self):
+        self._nodes = []
+        self._feeds = {}          # name -> placeholder Tensor
+        self._feed_shapes = {}    # name -> declared shape (None dims kept)
+        self._params = {}         # uid -> live parameter Tensor (ordered)
+        self._train_spec = None   # (optimizer, loss_uid)
+        self._version = 0
+
+    # -- recording (called from framework.core.apply_op) -------------------
+
+    def _record(self, name, fn, ins, n_outs):
+        from ..framework.core import Tensor
+
+        out_shapes = jax.eval_shape(fn, *(t._data for t in ins))
+        single = n_outs == 1 and not isinstance(out_shapes, tuple)
+        outs_raw = (out_shapes,) if single else tuple(out_shapes)
+        outs = tuple(
+            Tensor(jax.ShapeDtypeStruct(o.shape, o.dtype)) for o in outs_raw
+        )
+        in_refs = tuple(
+            t._uid if isinstance(t._data, jax.ShapeDtypeStruct) else t
+            for t in ins
+        )
+        for t in ins:
+            if not isinstance(t._data, jax.ShapeDtypeStruct) \
+                    and not t.stop_gradient and t.trainable:
+                self._params.setdefault(t._uid, t)
+        self._nodes.append(_OpNode(
+            name, fn, in_refs, tuple(o._uid for o in outs), n_outs))
+        self._version += 1
+        return outs[0] if single else outs
+
+    def _trainable_params(self):
+        return list(self._params.values())
+
+    def _record_writeback(self, dst, src):
+        """A deferred ``dst._data = src`` (running-stat style state
+        update): performed during replay, where jit captures the
+        mutation as step state."""
+        self._nodes.append(_OpNode(
+            "__writeback__", None, (src._uid,), (), 0, writeback=dst))
+        self._version += 1
+
+    def _register_feed(self, name, tensor):
+        if name in self._feeds:
+            raise ValueError(
+                f"static.data: duplicate feed name {name!r} in this Program")
+        self._feeds[name] = tensor
+        self._version += 1
+
+    def _mark_trainable(self, optimizer, loss):
+        self._train_spec = (optimizer, loss._uid)
+        self._version += 1
+
+    def clone(self, for_test=False):
+        """Share the recorded graph (and the live parameters) under a
+        new Program. ``for_test=True`` drops the train spec and the
+        running-stat writebacks — the reference's inference-program
+        idiom ``test_program = main.clone(for_test=True)``."""
+        if for_test and any(
+            n.name == "batch_norm_stats" for n in self._nodes
+        ):
+            # the recorded train-mode batch_norm normalizes with BATCH
+            # stats (its closure was fixed at record time); silently
+            # keeping it would corrupt small-batch inference. The
+            # reference rewires is_test=True; here, rebuild instead.
+            raise NotImplementedError(
+                "clone(for_test=True) on a program recorded with "
+                "train-mode batch_norm: rebuild the test program under "
+                "a fresh program_guard with the layers in .eval() mode "
+                "(static.nn layers are cached by name, so parameters "
+                "are shared)")
+        p = Program()
+        p._nodes = [n for n in self._nodes
+                    if not (for_test and n.writeback is not None)]
+        p._feeds = dict(self._feeds)
+        p._feed_shapes = dict(self._feed_shapes)
+        p._params = dict(self._params)
+        p._train_spec = None if for_test else self._train_spec
+        return p
+
+    # -- introspection ------------------------------------------------------
+
+    def num_ops(self):
+        return len(self._nodes)
+
+    def __repr__(self):
+        ops = ", ".join(n.name for n in self._nodes[:8])
+        more = "..." if len(self._nodes) > 8 else ""
+        return (f"Program(feeds={sorted(self._feeds)}, "
+                f"ops=[{ops}{more}] ({len(self._nodes)}), "
+                f"trainable={self._train_spec is not None})")
+
+
+_default_main = Program()
+_default_startup = Program()
 
 
 def default_main_program():
-    raise NotImplementedError(
-        "static Program mode is not part of the TPU-native design; "
-        "use eager + @to_static"
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+class program_guard:
+    """Route op recording into ``main_program`` within the block
+    (``startup_program`` accepted for API parity; parameter init runs
+    eagerly at creation, which is the startup role here)."""
+
+    def __init__(self, main_program, startup_program=None):
+        self._program = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        from ..framework.core import _state
+
+        self._prev = _state.static_program
+        _state.static_program = self._program
+        return self._program, self._startup
+
+    def __exit__(self, *exc):
+        from ..framework.core import _state
+
+        _state.static_program = self._prev
+        return False
+
+
+def _enable_static():
+    from ..framework.core import _state
+
+    _state.static_program = _default_main
+
+
+def _disable_static():
+    from ..framework.core import _state
+
+    _state.static_program = None
+
+
+def _in_static_mode():
+    from ..framework.core import _state
+
+    return _state.static_program is not None
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed placeholder in the current Program. ``None`` /
+    ``-1`` leading dims default to 1 at run time unless the fed array
+    overrides them (XLA compiles per concrete shape; a new feed shape
+    triggers a recompile of the replay step, same as to_static)."""
+    from ..framework.core import Tensor, _state
+
+    prog = _state.static_program
+    if prog is None:
+        raise RuntimeError(
+            "static.data() outside static mode: call paddle.enable_static() "
+            "or use static.program_guard(...)")
+    concrete = tuple(
+        1 if (d is None or (isinstance(d, int) and d < 0)) else int(d)
+        for d in shape
     )
+    t = Tensor(jax.ShapeDtypeStruct(concrete, to_np_dtype(dtype)), name=name)
+    prog._register_feed(name, t)
+    prog._feed_shapes[name] = tuple(
+        None if (d is None or (isinstance(d, int) and d < 0)) else int(d)
+        for d in shape
+    )
+    return t
 
 
-default_startup_program = default_main_program
+class _ProgramLayer:
+    """Adapter giving a recorded Program the Layer interface jit.save
+    expects: parameters are the program's live tensors, forward is the
+    (inference-only) replay. Defined lazily to avoid import cycles."""
+
+    def __new__(cls, program, feed_names, fetch_uids):
+        from ..nn.layer.layers import Layer
+
+        class _Impl(Layer):
+            def __init__(self):
+                super().__init__()
+                self._program = program
+                self._feed_names = feed_names
+                self._fetch_uids = fetch_uids
+                # only parameters the pruned inference slice touches
+                used, seen = [], set()
+                for node in Executor._prune(program, fetch_uids):
+                    for r in node.in_refs:
+                        if not isinstance(r, int) and not r.stop_gradient \
+                                and r.trainable and id(r) not in seen:
+                            seen.add(id(r))
+                            used.append(r)
+                for i, p in enumerate(used):
+                    self.add_parameter(f"p{i}", p)
+
+            def forward(self, *feeds):
+                step = Executor._build_step(
+                    self._program, self._feed_names, self._fetch_uids,
+                    train=False, compiled=False, prune=True)
+                outs = step(*feeds)
+                return outs[0] if len(outs) == 1 else tuple(outs)
+
+        return _Impl()
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **configs):
+    """Export the inference slice of a static Program as the portable
+    StableHLO artifact (upstream: paddle.static.save_inference_model
+    writes the pruned Program + params; jit.load serves either)."""
+    from .. import jit
+    from ..framework.core import Tensor, _state
+
+    program = program or _state.static_program or _default_main
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    name_of = {t._uid: n for n, t in program._feeds.items()}
+    feed_names = tuple(name_of[t._uid] for t in feed_vars)
+    fetch_uids = tuple(t._uid for t in fetch_vars)
+    layer = _ProgramLayer(program, feed_names, fetch_uids)
+    specs = [
+        InputSpec(
+            program._feed_shapes.get(n, tuple(program._feeds[n]._data.shape)),
+            str(program._feeds[n]._data.dtype), n)
+        for n in feed_names
+    ]
+    jit.save(layer, path_prefix, input_spec=specs)
+    import json
+
+    with open(path_prefix + ".inference.json", "w") as f:
+        json.dump({"feed_names": list(feed_names),
+                   "fetch_names": [
+                       getattr(t, "name", f"fetch_{i}")
+                       for i, t in enumerate(fetch_vars)]}, f)
+
+
+class _LoadedProgram:
+    """What load_inference_model returns as element 0: callable (like
+    jit.load's result) AND runnable through ``Executor.run(prog,
+    feed=..., fetch_list=...)`` — the reference's usage pattern."""
+
+    def __init__(self, loaded, feed_names, fetch_names):
+        self._loaded = loaded
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+
+    def __call__(self, *args, **kw):
+        return self._loaded(*args, **kw)
+
+
+def load_inference_model(path_prefix, executor=None, **configs):
+    """Load an exported inference artifact. Returns the reference's
+    triple ``[program, feed_names, fetch_targets]`` when the sidecar
+    metadata exists (run it via ``exe.run(program, feed=...,
+    fetch_list=fetch_targets)`` or call ``program(x)`` directly);
+    falls back to the bare jit.load callable for artifacts exported by
+    plain ``jit.save``."""
+    import json
+
+    from .. import jit
+
+    loaded = jit.load(path_prefix)
+    try:
+        with open(path_prefix + ".inference.json") as f:
+            meta = json.load(f)
+    except OSError:
+        return loaded
+    prog = _LoadedProgram(loaded, meta["feed_names"], meta["fetch_names"])
+    return [prog, prog.feed_names, prog.fetch_names]
+
+
+class Executor:
+    """Replays a recorded Program as one compiled step (feed -> fetch).
+
+    ``run(startup_program)`` is a no-op (parameters initialize eagerly
+    at creation). For a trainable program (``optimizer.minimize(loss)``
+    was called under recording), each ``run`` executes forward +
+    backward + optimizer step, compiled once and cached."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        import numpy as np
+
+        from .. import jit
+        from ..framework.core import Tensor, _as_tensor, _state
+
+        program = program if program is not None else _default_main
+        if isinstance(program, _LoadedProgram):
+            feed = feed or {}
+            missing = [n for n in program.feed_names if n not in feed]
+            if missing:
+                raise ValueError(f"Executor.run: missing feeds {missing}")
+            args = [_as_tensor(np.asarray(feed[n]))
+                    for n in program.feed_names]
+            outs = program(*args)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            if return_numpy:
+                return [o.numpy() for o in outs]
+            return list(outs)
+        if program is _default_startup or not program._nodes:
+            return []
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        fetch_uids = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                fetch_uids.append(f._uid)
+            elif isinstance(f, str) and f in program._feeds:
+                fetch_uids.append(program._feeds[f]._uid)
+            else:
+                raise ValueError(
+                    f"fetch_list entry {f!r}: pass the symbolic Tensor "
+                    f"returned while building the program (or a feed name)")
+        feed_names = tuple(sorted(program._feeds))
+        missing = [n for n in feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"Executor.run: missing feeds {missing}")
+
+        key = (id(program), program._version, tuple(fetch_uids))
+        step = self._cache.get(key)
+        if step is None:
+            step = self._build_step(program, feed_names, tuple(fetch_uids))
+            self._cache[key] = step
+
+        args = [_as_tensor(np.asarray(feed[n])) for n in feed_names]
+        outs = step(*args)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        if return_numpy:
+            return [o.numpy() for o in outs]
+        return list(outs)
+
+    @staticmethod
+    def _prune(program, fetch_uids):
+        """Backward slice: only the nodes the fetches depend on (the
+        reference's program-pruning role in save_inference_model).
+        Writeback (running-stat) nodes are dropped — they are training
+        state updates, not part of an inference slice."""
+        needed = set(fetch_uids)
+        keep = []
+        for node in reversed(program._nodes):
+            if node.writeback is not None:
+                continue
+            if any(u in needed for u in node.out_uids):
+                keep.append(node)
+                needed.update(
+                    r for r in node.in_refs if isinstance(r, int))
+        return list(reversed(keep))
+
+    @staticmethod
+    def _build_step(program, feed_names, fetch_uids, train=True,
+                    compiled=True, prune=False):
+        from .. import jit
+        from ..framework.core import _state
+        from ..framework.core import apply_op
+
+        nodes = (Executor._prune(program, fetch_uids)
+                 if prune else program._nodes)
+
+        def replay(*feed_tensors):
+            # replay must run EAGERLY (recording off) so the tape sees
+            # real ops — guard against a still-active static mode
+            prev = _state.static_program
+            _state.static_program = None
+            try:
+                env = {
+                    program._feeds[n]._uid: t
+                    for n, t in zip(feed_names, feed_tensors)
+                }
+                for node in nodes:
+                    if node.writeback is not None:
+                        node.writeback._data = env[node.in_refs[0]]._data
+                        continue
+                    ins = [
+                        env[r] if isinstance(r, int) else r
+                        for r in node.in_refs
+                    ]
+                    out = apply_op(
+                        node.name, node.fn, *ins, n_outs=node.n_outs)
+                    outs = out if isinstance(out, tuple) else (out,)
+                    for uid, o in zip(node.out_uids, outs):
+                        env[uid] = o
+                if train and program._train_spec is not None:
+                    opt, loss_uid = program._train_spec
+                    loss = env[loss_uid]
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                return [env[u] for u in fetch_uids]
+            finally:
+                _state.static_program = prev
+
+        return jit.to_static(replay) if compiled else replay

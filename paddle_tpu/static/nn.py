@@ -1,14 +1,15 @@
-"""paddle.static.nn parity surface. The static-graph program builder is
-absorbed by @to_static/XLA (SURVEY §2.4); the common builders here run
-eagerly so simple static-style code still executes."""
+"""paddle.static.nn builders (upstream: python/paddle/static/nn/).
+
+These work both eagerly and under an active ``static.Program`` (the
+op-recording mode in ``paddle_tpu.static``): with placeholder inputs
+they record into the program; layers are cached BY NAME so repeated
+calls share trainable weights, playing the global parameter scope's
+role."""
 from __future__ import annotations
 
 from ..nn import functional as F
 
 __all__ = ["fc", "batch_norm", "embedding", "conv2d", "sequence_expand"]
-
-
-_FC_LAYERS = {}
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
@@ -24,36 +25,85 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
 
     x = _as_tensor(x)
     in_features = int(np.prod(x.shape[num_flatten_dims:]))
-    key = name or f"__anon_fc_{in_features}_{size}"
-    layer = _FC_LAYERS.get(key)
-    if layer is None:
-        layer = _FC_LAYERS[key] = Linear(
-            in_features, size, weight_attr=weight_attr,
-            bias_attr=bias_attr,
-        )
-    flat = x.reshape(list(x.shape[:num_flatten_dims]) + [-1])
+    layer = _cached_layer(
+        "fc", name or f"__anon_{in_features}_{size}",
+        lambda: Linear(in_features, size, weight_attr=weight_attr,
+                       bias_attr=bias_attr))
+    # 0-dims copy the input's runtime dims — build-time placeholder
+    # shapes must not be baked in (static-graph replay feeds real
+    # batch sizes)
+    flat = x.reshape([0] * num_flatten_dims + [-1])
     out = layer(flat)
     if activation:
         out = getattr(F, activation)(out)
     return out
 
 
-def batch_norm(input, *a, **k):
-    raise NotImplementedError(
-        "static.nn.batch_norm: use paddle.nn.BatchNorm under to_static"
-    )
+_NAMED_LAYERS = {}
 
 
-def embedding(input, size, **k):
-    raise NotImplementedError(
-        "static.nn.embedding: use paddle.nn.Embedding under to_static"
-    )
+def _cached_layer(kind, key, build):
+    """Static-style builders share weights across calls BY NAME (the
+    reference resolves this through the global program's parameter
+    scope; here a name-keyed cache plays that role)."""
+    full = f"{kind}:{key}"
+    layer = _NAMED_LAYERS.get(full)
+    if layer is None:
+        layer = _NAMED_LAYERS[full] = build()
+    return layer
 
 
-def conv2d(input, *a, **k):
-    raise NotImplementedError(
-        "static.nn.conv2d: use paddle.nn.Conv2D under to_static"
-    )
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               data_layout="NCHW", name=None, **k):
+    from ..framework.core import _as_tensor
+    from ..nn import BatchNorm2D
+
+    x = _as_tensor(input)
+    ch = x.shape[1] if data_layout == "NCHW" else x.shape[-1]
+    layer = _cached_layer(
+        "batch_norm",
+        name or f"__anon_{ch}_{momentum}_{epsilon}_{data_layout}",
+        lambda: BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                            data_format=data_layout))
+    out = layer(x)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    from ..framework.core import _as_tensor
+    from ..nn import Embedding
+
+    x = _as_tensor(input)
+    layer = _cached_layer(
+        "embedding", name or f"__anon_{size[0]}_{size[1]}_{padding_idx}",
+        lambda: Embedding(size[0], size[1], padding_idx=padding_idx,
+                          weight_attr=param_attr))
+    return layer(x)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    from ..framework.core import _as_tensor
+    from ..nn import Conv2D
+
+    x = _as_tensor(input)
+    in_ch = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    layer = _cached_layer(
+        "conv2d",
+        name or (f"__anon_{in_ch}_{num_filters}_{filter_size}_{stride}"
+                 f"_{padding}_{dilation}_{groups}_{data_format}"),
+        lambda: Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format))
+    out = layer(x)
+    if act:
+        out = getattr(F, act)(out)
+    return out
 
 
 def sequence_expand(*a, **k):

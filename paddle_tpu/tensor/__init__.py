@@ -246,6 +246,12 @@ def _tensor_contains(self, value):
     return bool(_np.any(_np.asarray(self._data) == v))
 
 
+# in-place RNG fillers are Tensor methods in the reference
+# (random isn't in _METHOD_MODULES: its sampling FUNCTIONS take shape,
+# not self, and must not become methods)
+for _rng_m in ("normal_", "uniform_", "exponential_", "geometric_"):
+    METHODS.setdefault(_rng_m, getattr(random, _rng_m))
+
 METHODS["__iter__"] = _tensor_iter
 METHODS["__len__"] = _tensor_len
 METHODS["__format__"] = _tensor_format

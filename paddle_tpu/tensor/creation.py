@@ -108,6 +108,29 @@ def diag(x, offset=0, padding_value=0, name=None):
     return apply_op("diag", lambda a: jnp.diag(a, k=offset), x)
 
 
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal matrices: the LAST dim of ``input`` becomes the
+    ``offset`` diagonal of a new square matrix spanning output dims
+    (dim1, dim2) (upstream paddle.diag_embed)."""
+    x = _as_tensor(input)
+
+    def f(a):
+        k = a.shape[-1]
+        m = k + abs(int(offset))
+        base = jnp.zeros(a.shape[:-1] + (m, m), a.dtype)
+        idx = jnp.arange(k)
+        rows = idx + (-offset if offset < 0 else 0)
+        cols = idx + (offset if offset > 0 else 0)
+        out = base.at[..., rows, cols].set(a)
+        nd = out.ndim
+        d1, d2 = (dim1 + nd) % nd, (dim2 + nd) % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+
+    return apply_op("diag_embed", f, x)
+
+
 def diagflat(x, offset=0, name=None):
     x = _as_tensor(x)
     return apply_op("diagflat", lambda a: jnp.diagflat(a, k=offset), x)

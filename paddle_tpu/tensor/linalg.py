@@ -239,6 +239,25 @@ def histogram(input, bins=100, min=0, max=0, name=None):
     return Tensor(h.astype(jnp.int64))
 
 
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """D-dimensional histogram of an [N, D] sample (upstream
+    paddle.histogramdd). Returns (hist, list of edge tensors)."""
+    x = _as_tensor(x)
+    w = _as_tensor(weights)._data if weights is not None else None
+    if isinstance(bins, (list, tuple)) and bins and \
+            isinstance(bins[0], Tensor):
+        bins = [b._data for b in bins]
+    rng = None
+    if ranges is not None:
+        flat = [float(v) for v in ranges]
+        rng = [(flat[2 * i], flat[2 * i + 1])
+               for i in range(len(flat) // 2)]
+    h, edges = jnp.histogramdd(
+        x._data, bins=bins, range=rng, weights=w, density=density)
+    return Tensor(h), [Tensor(e) for e in edges]
+
+
 def bincount(x, weights=None, minlength=0, name=None):
     x = _as_tensor(x)
     w = _as_tensor(weights)._data if weights is not None else None

@@ -52,6 +52,18 @@ def equal_all(x, y, name=None):
     )
 
 
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    """Elementwise membership of x in test_x (upstream paddle.isin)."""
+    x = _as_tensor(x)
+    test_x = _as_tensor(test_x)
+    return apply_op(
+        "isin",
+        lambda a, t: jnp.isin(a, t, assume_unique=assume_unique,
+                              invert=invert),
+        x, test_x, differentiable=False,
+    )
+
+
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
     x, y = _as_tensor(x), _as_tensor(y)
     return apply_op(
@@ -82,7 +94,9 @@ def is_tensor(x):
 
 
 def in_dynamic_mode():
-    return True
+    from ..framework.core import _state
+
+    return _state.static_program is None
 
 
 def is_floating_point(x):

@@ -24,7 +24,15 @@ def _static_shape(shape):
 def reshape(x, shape, name=None):
     x = _as_tensor(x)
     shp = _static_shape(shape)
-    return apply_op("reshape", lambda a: jnp.reshape(a, shp), x)
+
+    def f(a):
+        # reference semantics: a 0 in the target shape copies the input
+        # dim at that position (resolved per-call, so static-graph
+        # replay sees the fed batch size, not the build-time one)
+        s = tuple(a.shape[i] if d == 0 else d for i, d in enumerate(shp))
+        return jnp.reshape(a, s)
+
+    return apply_op("reshape", f, x)
 
 
 def reshape_(x, shape, name=None):
@@ -146,8 +154,20 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
         return reshape(x, [1])
     s = start_axis % nd
     e = stop_axis % nd
-    shp = x.shape[:s] + [-1] + x.shape[e + 1:]
-    return reshape(x, shp)
+
+    def f(a):
+        # shape derived INSIDE the op so static-graph replay sees the
+        # fed dims, not the build-time placeholder defaults
+        return jnp.reshape(a, a.shape[:s] + (-1,) + a.shape[e + 1:])
+
+    return apply_op("flatten", f, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._data, x._grad_node = out._data, out._grad_node
+    x._version += 1
+    return x
 
 
 def cast(x, dtype):
@@ -711,6 +731,20 @@ def combinations(x, r=2, with_replacement=False, name=None):
     )
     idx = np.asarray(list(gen), np.int32).reshape(-1, int(r))
     return apply_op("combinations", lambda a: a[jnp.asarray(idx)], x)
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors: [N, len(x)] rows (upstream
+    paddle.cartesian_prod; same meshgrid-then-flatten semantics)."""
+    ts = [_as_tensor(v) for v in x]
+    if len(ts) == 1:
+        return apply_op("cartesian_prod", lambda a: a.reshape(-1), ts[0])
+
+    def f(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return apply_op("cartesian_prod", f, *ts)
 
 
 def take(x, index, mode="raise", name=None):

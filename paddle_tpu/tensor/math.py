@@ -532,6 +532,31 @@ def ldexp(x, y, name=None):
     )
 
 
+def frexp(x, name=None):
+    """Decompose x into (mantissa, exponent) with x = m * 2**e,
+    0.5 <= |m| < 1 (upstream paddle.frexp; both outputs carry x's
+    float dtype, unlike numpy's int exponent)."""
+    x = _as_tensor(x)
+
+    def f(a):
+        af = a if jnp.issubdtype(a.dtype, jnp.floating) \
+            else a.astype(jnp.float32)
+        m, e = jnp.frexp(af)
+        return m, e.astype(af.dtype)
+
+    return apply_op("frexp", f, x, n_outs=2, differentiable=False)
+
+
+def float_power(x, y, name=None):
+    """x ** y computed in the widest available float (upstream
+    paddle.float_power promotes to float64; on TPU-native fp32-default
+    configs (jax x64 off) the computation is fp32)."""
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+    return apply_op(
+        "float_power", lambda a, b: jnp.float_power(a, b), x, y)
+
+
 positive = _unary("positive", lambda a: +a)
 negative = _unary("negative", jnp.negative)
 signbit = _unary("signbit", jnp.signbit)

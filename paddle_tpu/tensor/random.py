@@ -144,6 +144,19 @@ def normal_(x, mean=0.0, std=1.0, name=None):
     return x
 
 
+def geometric_(x, probs, name=None):
+    """Fill x in-place with Geometric(probs) draws, support {1, 2, ...}
+    (upstream Tensor.geometric_): k = ceil(log U / log(1 - p))."""
+    x = _as_tensor(x)
+    p = _as_tensor(probs)._data if not isinstance(probs, float) else probs
+    k = next_key()
+    u = jax.random.uniform(
+        k, tuple(x.shape), minval=jnp.finfo(jnp.float32).tiny)
+    draws = jnp.ceil(jnp.log(u) / jnp.log1p(-p))
+    x.set_value(draws.astype(x._data.dtype))
+    return x
+
+
 def binomial(count, prob, name=None):
     """Elementwise binomial draws (upstream paddle.binomial)."""
     from ..framework.random import next_key

@@ -1161,6 +1161,39 @@ OPS += [
            lambda x: pmath.ldexp(
                x, paddle.to_tensor(np.full((4, 9), 2, np.int32))),
            lambda x: np.ldexp(x, 2), [(4, 9)]),
+    OpSpec("frexp_mantissa", lambda x: pmath.frexp(x)[0],
+           lambda x: np.frexp(x)[0], [(4, 9)], grad=False, op="frexp"),
+    OpSpec("frexp_exponent", lambda x: pmath.frexp(x)[1],
+           lambda x: np.frexp(x)[1].astype(np.float64), [(4, 9)],
+           grad=False, op="frexp"),
+    OpSpec("float_power", B(pmath.float_power),
+           lambda x, y: np.float_power(x, y).astype(np.float64),
+           [(4, 9), (4, 9)], positive=True),
+    OpSpec("isin",
+           lambda x: logic.isin(
+               x.astype("int32"),
+               paddle.to_tensor(np.arange(2, dtype=np.int32))),
+           lambda x: np.isin(x.astype(np.int32), np.arange(2)),
+           [(4, 9)], domain=(-3.0, 3.0), grad=False, op="isin"),
+    OpSpec("diag_embed", U(creation.diag_embed),
+           lambda x: np.stack([np.diag(r) for r in x]), [(3, 4)]),
+    OpSpec("diag_embed_offset",
+           lambda x: creation.diag_embed(x, offset=1),
+           lambda x: np.stack([np.diag(r, k=1) for r in x]), [(3, 4)],
+           op="diag_embed"),
+    OpSpec("cartesian_prod",
+           lambda x: manipulation.cartesian_prod(
+               [x, paddle.to_tensor(np.arange(3, dtype="float32"))]),
+           lambda x: np.stack([
+               np.repeat(x, 3), np.tile(np.arange(3.0), x.shape[0])],
+               axis=-1),
+           [(4,)]),
+    OpSpec("histogramdd",
+           lambda x: linalg.histogramdd(
+               x, bins=3, ranges=[-2, 2, -2, 2])[0],
+           lambda x: np.histogramdd(
+               x, bins=3, range=[(-2, 2), (-2, 2)])[0],
+           [(30, 2)], grad=False),
     OpSpec("digamma", U(pmath.digamma), None, [(4, 9)],
            positive=True),
     OpSpec("lgamma", U(pmath.lgamma), None, [(4, 9)], positive=True),

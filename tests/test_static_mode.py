@@ -1,0 +1,235 @@
+"""Static-graph Program/Executor mode (upstream test model:
+test/legacy_test/test_program.py, test_executor_*.py — build under
+program_guard, run via Executor with feed/fetch; training appends
+backward via optimizer.minimize)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as optim
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _dygraph_after():
+    yield
+    paddle.disable_static()
+
+
+def _regression_data(n=64):
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype("float32")
+    x = rng.randn(n, 4).astype("float32")
+    y = x @ w + 0.01 * rng.randn(n, 1).astype("float32")
+    return x, y
+
+
+class TestProgramBuild:
+    def test_record_no_execution(self):
+        """Graph building must run no kernels: outputs are symbolic."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4])
+            h = x * 2.0 + 1.0
+        assert main.num_ops() == 2
+        assert h.shape == [1, 4]  # None defaults to 1 at build
+        with pytest.raises(RuntimeError, match="placeholder"):
+            h.numpy()
+
+    def test_enable_static_routes_to_default_program(self):
+        paddle.enable_static()
+        assert not paddle.in_dynamic_mode()
+        before = static.default_main_program().num_ops()
+        x = static.data("x_def_%d" % before, [2, 3])
+        _ = x + 1.0
+        assert static.default_main_program().num_ops() == before + 1
+        paddle.disable_static()
+        assert paddle.in_dynamic_mode()
+
+    def test_duplicate_feed_name_raises(self):
+        main = static.Program()
+        with static.program_guard(main):
+            static.data("x", [2])
+            with pytest.raises(ValueError, match="duplicate"):
+                static.data("x", [2])
+
+
+class TestExecutor:
+    def test_train_linear_regression(self):
+        X, Y = _regression_data()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4])
+            y = static.data("y", [None, 1])
+            paddle.seed(0)
+            pred = static.nn.fc(x, 1, name="reg_fc")
+            loss = ((pred - y) ** 2).mean()
+            optim.SGD(0.1).minimize(loss)
+        exe = static.Executor()
+        assert exe.run(startup) == []
+        losses = [
+            float(exe.run(main, feed={"x": X, "y": Y},
+                          fetch_list=[loss])[0])
+            for _ in range(40)
+        ]
+        assert losses[-1] < 0.01 * losses[0]
+
+    def test_batch_size_polymorphic_fetch(self):
+        X, Y = _regression_data()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4])
+            y = static.data("y", [None, 1])
+            paddle.seed(0)
+            pred = static.nn.fc(x, 1, name="poly_fc")
+            ((pred - y) ** 2).mean()
+        exe = static.Executor()
+        for bs in (64, 4, 1):
+            (pv,) = exe.run(main, feed={"x": X[:bs], "y": Y[:bs]},
+                            fetch_list=[pred])
+            assert pv.shape == (bs, 1)
+
+    def test_missing_feed_and_bad_fetch(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2])
+            out = x + 1.0
+        exe = static.Executor()
+        with pytest.raises(ValueError, match="missing feeds"):
+            exe.run(main, feed={}, fetch_list=[out])
+        with pytest.raises(ValueError, match="fetch_list"):
+            exe.run(main, feed={"x": np.zeros((2, 2), "float32")},
+                    fetch_list=["not_a_feed"])
+
+    def test_nn_layers_under_program_guard(self):
+        """paddle.nn Layers (not just static.nn builders) record too."""
+        import paddle_tpu.nn as nn
+
+        X, Y = _regression_data()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4])
+            y = static.data("y", [None, 1])
+            paddle.seed(0)
+            model = nn.Sequential(
+                nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+            loss = ((model(x) - y) ** 2).mean()
+            optim.Adam(5e-2, parameters=model.parameters()).minimize(loss)
+        exe = static.Executor()
+        losses = [
+            float(exe.run(main, feed={"x": X, "y": Y},
+                          fetch_list=[loss])[0])
+            for _ in range(80)
+        ]
+        assert losses[-1] < 0.05 * losses[0], losses[::10]
+
+    def test_static_nn_builders(self):
+        main = static.Program()
+        with static.program_guard(main):
+            ids = static.data("ids", [None, 6], "int64")
+            img = static.data("img", [None, 3, 8, 8], "float32")
+            paddle.seed(0)
+            emb = static.nn.embedding(ids, size=[16, 4], name="emb0")
+            cv = static.nn.conv2d(img, 4, 3, padding=1, name="cv0",
+                                  act="relu")
+            bn = static.nn.batch_norm(cv, name="bn0")
+        exe = static.Executor()
+        rng = np.random.RandomState(1)
+        ev, cvv, bnv = exe.run(main, feed={
+            "ids": rng.randint(0, 16, (2, 6)).astype("int64"),
+            "img": rng.randn(2, 3, 8, 8).astype("float32"),
+        }, fetch_list=[emb, cv, bn])
+        assert ev.shape == (2, 6, 4)
+        assert cvv.shape == (2, 4, 8, 8) and (cvv >= 0).all()
+        assert bnv.shape == (2, 4, 8, 8)
+
+    def test_save_load_inference_model(self, tmp_path):
+        """Classic static serving flow: clone(for_test=True) off a
+        TRAINABLE program, export the pruned inference slice (the loss/
+        label nodes and the optimizer must NOT ship), load back the
+        StableHLO artifact, same outputs, batch-polymorphic."""
+        X, Y = _regression_data(16)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4])
+            y = static.data("y", [None, 1])
+            paddle.seed(0)
+            pred = static.nn.fc(x, 1, name="sim_fc", activation="tanh")
+            loss = ((pred - y) ** 2).mean()
+            optim.SGD(0.1).minimize(loss)
+        exe = static.Executor()
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        test_prog = main.clone(for_test=True)
+        (ref,) = exe.run(test_prog, feed={"x": X, "y": Y},
+                         fetch_list=[pred])
+        # the clone must not step the optimizer: identical refetch
+        (ref2,) = exe.run(test_prog, feed={"x": X, "y": Y},
+                          fetch_list=[pred])
+        np.testing.assert_array_equal(ref, ref2)
+        path = str(tmp_path / "inf_model")
+        # export needs only the x feed — loss/label slice pruned away
+        static.save_inference_model(path, [x], [pred], exe,
+                                    program=test_prog)
+        # reference triple + Executor.run on the loaded program
+        prog, feed_names, fetch_targets = \
+            static.load_inference_model(path, exe)
+        assert feed_names == ["x"]
+        (out,) = exe.run(prog, feed={"x": X}, fetch_list=fetch_targets)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # and direct-callable, batch-polymorphic
+        out4 = prog(paddle.to_tensor(X[:4]))
+        out4 = out4[0] if isinstance(out4, (list, tuple)) else out4
+        assert list(out4.shape) == [4, 1]
+
+    def test_flatten_polymorphic_batch(self):
+        """Ops deriving shapes inside the kernel must see the FED batch,
+        not the build-time placeholder default of 1."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4, 5])
+            out = paddle.flatten(x, start_axis=1)
+        exe = static.Executor()
+        (v,) = exe.run(main, feed={"x": np.zeros((32, 4, 5), "float32")},
+                       fetch_list=[out])
+        assert v.shape == (32, 20)
+
+    def test_clone_for_test_rejects_train_batch_norm(self):
+        main = static.Program()
+        with static.program_guard(main):
+            img = static.data("imgbn", [None, 3, 8, 8])
+            paddle.seed(0)
+            static.nn.batch_norm(img, name="bn_t")
+        with pytest.raises(NotImplementedError, match="batch_norm"):
+            main.clone(for_test=True)
+
+    def test_anonymous_conv_cache_respects_hyperparams(self):
+        from paddle_tpu.static.nn import conv2d
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, 8, 8).astype("float32"))
+        paddle.seed(0)
+        a = conv2d(x, 4, 3, stride=1, padding=1)
+        b = conv2d(x, 4, 3, stride=2, padding=1)
+        assert list(a.shape) == [1, 4, 8, 8]
+        assert list(b.shape) == [1, 4, 4, 4]  # stride-2 layer, not cached
+
+    def test_optimizer_without_parameters_collects_from_program(self):
+        """Reference pattern: optimizer constructed with no parameter
+        list in static mode discovers the program's trainables."""
+        X, Y = _regression_data()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4])
+            y = static.data("y", [None, 1])
+            paddle.seed(0)
+            pred = static.nn.fc(x, 1, name="auto_fc")
+            loss = ((pred - y) ** 2).mean()
+            sgd = optim.SGD(0.1)
+            sgd.minimize(loss)
+        assert len(sgd._parameter_list) == 2  # weight + bias
+        exe = static.Executor()
+        l0 = float(exe.run(main, feed={"x": X, "y": Y},
+                           fetch_list=[loss])[0])
+        l1 = float(exe.run(main, feed={"x": X, "y": Y},
+                           fetch_list=[loss])[0])
+        assert l1 < l0
