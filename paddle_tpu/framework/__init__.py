@@ -18,7 +18,9 @@ from .random import Generator, default_generator, get_rng_state, seed, set_rng_s
 
 
 def in_dynamic_mode():
-    return True
+    from .core import _state
+
+    return _state.static_program is None
 
 
 def in_pir_mode():

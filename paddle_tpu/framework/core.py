@@ -446,7 +446,8 @@ def apply_op(name: str, fn: Callable, *tensor_inputs, n_outs: int = 1,
     if _state.static_program is not None and any(
         isinstance(t._data, jax.ShapeDtypeStruct) for t in ins
     ):
-        return _state.static_program._record(name, fn, ins, n_outs)
+        return _state.static_program._record(
+            name, fn, ins, n_outs, differentiable=differentiable)
     # AMP hook: the installed policy may cast inputs (O1 white/black list)
     if _state.amp_cast_fn is not None:
         ins, fn = _state.amp_cast_fn(name, ins, fn)

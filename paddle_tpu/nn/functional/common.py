@@ -259,6 +259,20 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
     return apply_op("cosine_similarity", f, x1, x2)
 
 
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """p-norm of (x - y + epsilon) over the last axis (upstream
+    paddle.nn.functional.pairwise_distance; epsilon added like the
+    reference to keep the gradient finite at x == y)."""
+    x, y = _as_tensor(x), _as_tensor(y)
+
+    def f(a, b):
+        d = a - b + epsilon
+        out = jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+        return out
+
+    return apply_op("pairwise_distance", f, x, y)
+
+
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
     x = _as_tensor(x)
 

@@ -254,6 +254,201 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,
     return apply_op("triplet_margin_loss", f, input, positive, negative)
 
 
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """Huber loss (upstream paddle.nn.functional.huber_loss): quadratic
+    below ``delta``, linear above — NOT delta-rescaled like
+    smooth_l1_loss."""
+    input, label = _as_tensor(input), _as_tensor(label)
+
+    def f(a, b):
+        d = jnp.abs(a - b)
+        return _reduce(
+            jnp.where(d <= delta, 0.5 * d * d,
+                      delta * (d - 0.5 * delta)),
+            reduction,
+        )
+
+    return apply_op("huber_loss", f, input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin (hinge) loss (upstream multi_margin_loss):
+    mean_j max(0, margin - x[y] + x[j])^p over j != y."""
+    input, label = _as_tensor(input), _as_tensor(label)
+
+    def f(x, y, *w):
+        c = x.shape[1]
+        y = y.astype(jnp.int32)
+        xy = jnp.take_along_axis(x, y[:, None], axis=1)  # (N, 1)
+        m = jnp.maximum(0.0, margin - xy + x)
+        if p != 1:
+            m = m ** p
+        if w:
+            m = m * jnp.take(w[0], y)[:, None]
+        m = m * (1 - jax.nn.one_hot(y, c, dtype=m.dtype))
+        return _reduce(jnp.sum(m, axis=1) / c, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(_as_tensor(weight))
+    return apply_op("multi_margin_loss", f, *args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Triplet margin loss with a custom distance callable (upstream
+    triplet_margin_with_distance_loss; default distance is pairwise L2)."""
+    input, positive, negative = (
+        _as_tensor(input), _as_tensor(positive), _as_tensor(negative)
+    )
+    if distance_function is not None:
+        # Tensor-level distance callable: compute distances through the
+        # normal op path so autograd sees them
+        dp = distance_function(input, positive)
+        dn = distance_function(input, negative)
+        if swap:
+            dn2 = distance_function(positive, negative)
+            dn = apply_op(
+                "minimum", lambda a, b: jnp.minimum(a, b), dn, dn2)
+        return apply_op(
+            "triplet_margin_with_distance_loss",
+            lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0),
+                                 reduction),
+            dp, dn,
+        )
+
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos, axis=-1)
+        dn = jnp.linalg.norm(a - neg, axis=-1)
+        if swap:
+            dn = jnp.minimum(dn, jnp.linalg.norm(pos - neg, axis=-1))
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op(
+        "triplet_margin_with_distance_loss", f, input, positive, negative)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss over class probabilities (upstream dice_loss: label is
+    int class ids with trailing 1-dim; per-sample dice over all
+    non-batch dims, batch-meaned)."""
+    input, label = _as_tensor(input), _as_tensor(label)
+
+    def f(p, y):
+        c = p.shape[-1]
+        oh = jax.nn.one_hot(
+            y.squeeze(-1).astype(jnp.int32), c, dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * oh, axis=red)
+        denom = jnp.sum(p, axis=red) + jnp.sum(oh, axis=red)
+        return jnp.mean(1.0 - 2.0 * inter / (denom + epsilon))
+
+    return apply_op("dice_loss", f, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Elementwise negative log likelihood of probabilities (upstream
+    log_loss; no reduction)."""
+    input, label = _as_tensor(input), _as_tensor(label)
+    return apply_op(
+        "log_loss",
+        lambda p, y: (-y * jnp.log(p + epsilon)
+                      - (1.0 - y) * jnp.log(1.0 - p + epsilon)),
+        input, label,
+    )
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss (upstream: python/paddle/nn/functional/loss.py
+    rnnt_loss, wrapping warp-transducer —
+    paddle/phi/kernels/impl/warprnnt_kernel_impl.h).
+
+    TPU-first design: the transducer alpha recursion
+    ``α(t,u) = logadd(α(t-1,u) + blank(t-1,u), α(t,u-1) + y(t,u-1))``
+    runs as a ``lax.scan`` over time with an inner scan over the label
+    axis (static shapes, log-space); the gradient — warprnnt's beta
+    pass — falls out of JAX autodiff through the recursion.
+
+    ``input``: (B, T, U+1, C) unnormalized logits (log_softmax applied
+    internally, matching the reference); ``label``: (B, U) int.
+    Only ``fastemit_lambda == 0`` is supported: FastEmit is a
+    gradient-scaling regularizer baked into warprnnt's backward; a
+    loss-level surrogate would silently train differently.
+    """
+    if fastemit_lambda:
+        raise ValueError(
+            "rnnt_loss: fastemit_lambda != 0 is not supported (FastEmit "
+            "modifies warprnnt's gradient pass, not the loss value; a "
+            "surrogate here would silently train differently)")
+    input = _as_tensor(input)
+    label = _as_tensor(label)
+    input_lengths = _as_tensor(input_lengths)
+    label_lengths = _as_tensor(label_lengths)
+    NEG = -1e30
+
+    def f(lp, lb, il, ll):
+        B, T, U1, C = lp.shape
+        U = U1 - 1
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        lb = lb.astype(jnp.int32)
+        il = il.astype(jnp.int32)
+        ll = ll.astype(jnp.int32)
+        # emissions: blank(t,u) and label y(t,u) = lp[t,u,lb[u]]
+        blk = lp[..., blank]                                  # (B,T,U+1)
+        lab = jnp.take_along_axis(
+            lp[:, :, :U, :], lb[:, None, :, None], axis=3
+        )[..., 0]                                             # (B,T,U)
+        # mask label transitions beyond each sample's label length
+        u_idx = jnp.arange(U)[None, None, :]
+        lab = jnp.where(u_idx < ll[:, None, None], lab, NEG)
+
+        # first row: α(0,u) = cumsum of label emissions at t=0
+        a0 = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.float32),
+             jnp.cumsum(lab[:, 0, :], axis=1)], axis=1)       # (B,U+1)
+
+        def time_step(alpha, xs):
+            blk_prev, lab_t = xs  # (B,U+1) at t-1, (B,U) at t
+            stay = alpha + blk_prev  # arrived by consuming a frame
+
+            def u_step(prev, xs_u):
+                stay_u, lab_u = xs_u  # (B,), (B,)
+                new = jnp.logaddexp(stay_u, prev + lab_u)
+                return new, new
+
+            first = stay[:, 0]
+            _, rest = jax.lax.scan(
+                u_step, first,
+                (stay[:, 1:].T, lab_t.T))                     # (U,B)
+            new = jnp.concatenate([first[:, None], rest.T], axis=1)
+            return new, new
+
+        _, alphas = jax.lax.scan(
+            time_step, a0,
+            (jnp.moveaxis(blk[:, :-1, :], 1, 0),
+             jnp.moveaxis(lab[:, 1:, :], 1, 0)))
+        alphas = jnp.concatenate([a0[None], alphas], axis=0)  # (T,B,U+1)
+
+        t_idx = jnp.clip(il - 1, 0, T - 1)
+        a_last = alphas[t_idx, jnp.arange(B)]                 # (B,U+1)
+        a_final = jnp.take_along_axis(
+            a_last, ll[:, None], axis=1)[:, 0]
+        blk_final = blk[jnp.arange(B), t_idx, ll]
+        loss = -(a_final + blk_final)                         # (B,)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply_op(
+        "rnnt_loss", f, input, label, input_lengths, label_lengths)
+
+
 def square_error_cost(input, label):
     input, label = _as_tensor(input), _as_tensor(label)
     return apply_op(

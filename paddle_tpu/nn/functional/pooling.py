@@ -145,6 +145,46 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return apply_op("avg_pool2d", f, x)
 
 
+def _maxpool_mask_nd(a, ks, st, pad, nd):
+    """Flat argmax index per pooled element for N spatial dims (a is
+    channels-first): patch extraction via a one-hot conv, argmax over
+    the patch, offsets mapped back to input coordinates (upstream:
+    paddle/phi/kernels/funcs/pooling.h MaxPoolWithIndex family)."""
+    n, c = a.shape[0], a.shape[1]
+    spatial = a.shape[2:]
+    if isinstance(pad, str):
+        pairs = []
+        for k, s, size in zip(ks, st, spatial):
+            if pad == "VALID":
+                pairs.append((0, 0))
+            else:
+                o = -(-size // s)
+                tot = max((o - 1) * s + k - size, 0)
+                pairs.append((tot // 2, tot - tot // 2))
+    else:
+        pairs = list(pad)
+    af = jnp.pad(a.astype(jnp.float32), [(0, 0), (0, 0)] + pairs,
+                 constant_values=-1e30)
+    patches = jax.lax.conv_general_dilated_patches(af, ks, st, "VALID")
+    osp = patches.shape[2:]
+    patches = patches.reshape((n, c, int(np.prod(ks))) + tuple(osp))
+    loc = jnp.argmax(patches, axis=2)  # (N, C, *osp)
+    # decompose the patch-local offset (row-major over ks), map each
+    # dim back to input coordinates, flatten row-major over spatial
+    offs = []
+    rem = loc
+    for d in reversed(range(nd)):
+        offs.append((d, rem % ks[d]))
+        rem = rem // ks[d]
+    idx = jnp.zeros_like(loc)
+    for d, off in offs:
+        shape = [1, 1] + [osp[i] if i == d else 1 for i in range(nd)]
+        base = (jnp.arange(osp[d]) * st[d]).reshape(shape)
+        coord = jnp.clip(base + off - pairs[d][0], 0, spatial[d] - 1)
+        idx = idx + coord * int(np.prod(spatial[d + 1:], dtype=np.int64))
+    return idx.astype(jnp.int32)
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
     x = _as_tensor(x)
@@ -155,7 +195,14 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     def f(a):
         return _reduce_window(a, -jnp.inf, jax.lax.max, ks, st, pad, 1, False)
 
-    return apply_op("max_pool1d", f, x)
+    out = apply_op("max_pool1d", f, x)
+    if return_mask:
+        idx = apply_op(
+            "max_pool1d_mask",
+            lambda a: _maxpool_mask_nd(a, ks, st, pad, 1), x,
+            differentiable=False)
+        return out, idx
+    return out
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -184,7 +231,19 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
         return _reduce_window(a, -jnp.inf, jax.lax.max, ks, st, pad, 3,
                               data_format == "NDHWC")
 
-    return apply_op("max_pool3d", f, x)
+    out = apply_op("max_pool3d", f, x)
+    if return_mask:
+        cl = data_format == "NDHWC"
+
+        def fmask(a):
+            if cl:
+                a = jnp.moveaxis(a, -1, 1)
+            idx = _maxpool_mask_nd(a, ks, st, pad, 3)
+            return jnp.moveaxis(idx, 1, -1) if cl else idx
+
+        idx = apply_op("max_pool3d_mask", fmask, x, differentiable=False)
+        return out, idx
+    return out
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -286,6 +345,89 @@ def adaptive_avg_pool1d(x, output_size, name=None):
         return (s / k).astype(a.dtype)
 
     return apply_op("adaptive_avg_pool1d", f, x)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    """Adaptive max pool with the reference's variable windows
+    [floor(i*L/out), ceil((i+1)*L/out)) — handles L not divisible by
+    output_size (window boundaries are static python ints)."""
+    x = _as_tensor(x)
+    os_ = int(output_size)
+    il = x.shape[2]
+    bounds = [(i * il // os_, -(-(i + 1) * il // os_)) for i in range(os_)]
+    uniform = len({hi - lo for lo, hi in bounds}) == 1 and \
+        bounds[0][1] - bounds[0][0] > 0 and il % os_ == 0
+
+    def f(a):
+        if uniform:
+            k = il // os_
+            return jax.lax.reduce_window(
+                a, -jnp.inf, jax.lax.max, (1, 1, k), (1, 1, k), "VALID"
+            )
+        return jnp.stack(
+            [a[:, :, lo:hi].max(axis=-1) for lo, hi in bounds], axis=-1)
+
+    out = apply_op("adaptive_max_pool1d", f, x)
+    if return_mask:
+        def fm(a):
+            return jnp.stack(
+                [jnp.argmax(a[:, :, lo:hi], axis=-1).astype(jnp.int32)
+                 + lo for lo, hi in bounds], axis=-1)
+
+        return out, apply_op("adaptive_max_pool1d_mask", fm, x,
+                             differentiable=False)
+    return out
+
+
+def _max_unpool_nd(name, nd):
+    """Shared N-D inverse-maxpool builder: scatter each pooled value to
+    its flat argmax index (same contract as max_unpool2d below)."""
+
+    cl_format = {1: "NLC", 3: "NDHWC"}[nd]
+
+    def unpool(x, indices, kernel_size, stride=None, padding=0,
+               output_size=None, data_format=None, name_=None):
+        x = _as_tensor(x)
+        indices = _as_tensor(indices)
+        ks = _pair(kernel_size, nd)
+        st = _pair(stride, nd) if stride is not None else ks
+        pd = _pair(padding, nd)
+        cl = data_format == cl_format
+
+        def f(a, idx):
+            if cl:
+                a = jnp.moveaxis(a, -1, 1)
+                idx = jnp.moveaxis(idx, -1, 1)
+            n, c = a.shape[0], a.shape[1]
+            ospatial = a.shape[2:]
+            if output_size is not None:
+                ishape = tuple(output_size[-nd:])
+            else:
+                ishape = tuple(
+                    (ospatial[i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                    for i in range(nd)
+                )
+            numel = 1
+            for d in ishape:
+                numel *= d
+            flat = jnp.zeros((n, c, numel), a.dtype)
+            ii = idx.reshape(n, c, -1).astype(jnp.int32)
+            vv = a.reshape(n, c, -1)
+            out = flat.at[
+                jnp.arange(n)[:, None, None],
+                jnp.arange(c)[None, :, None],
+                ii,
+            ].set(vv)
+            out = out.reshape((n, c) + ishape)
+            return jnp.moveaxis(out, 1, -1) if cl else out
+
+        return apply_op(name, f, x, indices)
+
+    return unpool
+
+
+max_unpool1d = _max_unpool_nd("max_unpool1d", 1)
+max_unpool3d = _max_unpool_nd("max_unpool3d", 3)
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,

@@ -140,6 +140,14 @@ class LogSoftmax(Layer):
         return F.log_softmax(x, self._axis)
 
 
+class LogSigmoid(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.log_sigmoid(x)
+
+
 class Softplus(Layer):
     def __init__(self, beta=1, threshold=20, name=None):
         super().__init__()

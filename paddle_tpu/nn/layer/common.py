@@ -123,6 +123,33 @@ class Flatten(Layer):
         return flatten(x, self.start_axis, self.stop_axis)
 
 
+class Unflatten(Layer):
+    """Expand one axis into the given shape (upstream nn.Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        from ...tensor.manipulation import reshape
+
+        nd = x.ndim
+        ax = self.axis % nd
+        new_shape = list(x.shape[:ax]) + self.shape \
+            + list(x.shape[ax + 1:])
+        return reshape(x, new_shape)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._args = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, *self._args)
+
+
 class Pad1D(Layer):
     def __init__(self, padding, mode="constant", value=0.0,
                  data_format="NCL", name=None):

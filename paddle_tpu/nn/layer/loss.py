@@ -160,6 +160,63 @@ class SoftMarginLoss(Layer):
         return F.soft_margin_loss(input, label, self.reduction)
 
 
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self.reduction = reduction
+        self.delta = delta
+
+    def forward(self, input, label):
+        return F.huber_loss(input, label, delta=self.delta,
+                            reduction=self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (p, margin)
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(
+            input, label, *self._args, weight=self.weight,
+            reduction=self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self._args = (margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        margin, swap, reduction = self._args
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative,
+            distance_function=self.distance_function, margin=margin,
+            swap=swap, reduction=reduction)
+
+
+class RNNTLoss(Layer):
+    """RNN-Transducer loss layer (upstream nn.RNNTLoss)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(
+            input, label, input_lengths, label_lengths,
+            blank=self.blank, fastemit_lambda=self.fastemit_lambda,
+            reduction=self.reduction)
+
+
 class HingeEmbeddingLoss(Layer):
     def __init__(self, margin=1.0, reduction="mean", name=None):
         super().__init__()

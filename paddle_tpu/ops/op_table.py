@@ -127,8 +127,8 @@ _WAIVER_GROUPS = {
         "shape atleast_1d atleast_3d broadcast_tensors as_strided "
         "in_dynamic_mode",
     "sequence-level loss with its own torch-parity suite "
-    "(test_nn_utils CTC tests)":
-        "ctc_loss",
+    "(test_nn_utils CTC tests; test_rnnt_loss DP-oracle suite)":
+        "ctc_loss rnnt_loss",
     "distributed-semantics op (rank-dependent output): covered by "
     "multi-process tests (test_launch_elastic, test_models)":
         "shard_index",

@@ -113,14 +113,22 @@ nn.while_loop = nn_while_loop
 
 class _OpNode:
     __slots__ = ("name", "fn", "in_refs", "out_uids", "n_outs",
-                 "writeback")
+                 "writeback", "differentiable")
 
     def __init__(self, name, fn, in_refs, out_uids, n_outs,
-                 writeback=None):
+                 writeback=None, differentiable=True):
         self.name, self.fn = name, fn
         self.in_refs, self.out_uids = in_refs, out_uids
         self.n_outs = n_outs
         self.writeback = writeback  # live Tensor to assign env[in_refs[0]]
+        self.differentiable = differentiable
+
+
+# ops whose wrapper draws an RNG key at trace/build time; recording
+# freezes the draw, so static programs replay identical randomness
+_STOCHASTIC_OPS = frozenset(
+    "dropout alpha_dropout dropout2d dropout3d feature_alpha_dropout "
+    "gumbel_softmax rrelu".split())
 
 
 class Program:
@@ -137,9 +145,18 @@ class Program:
 
     # -- recording (called from framework.core.apply_op) -------------------
 
-    def _record(self, name, fn, ins, n_outs):
+    def _record(self, name, fn, ins, n_outs, differentiable=True):
         from ..framework.core import Tensor
 
+        if name in _STOCHASTIC_OPS:
+            import warnings
+
+            warnings.warn(
+                f"static recording of '{name}': the RNG draw happened "
+                f"at build time, so every Executor.run replays the "
+                f"SAME randomness (build the program with the layer in "
+                f".eval() mode, or use dygraph + to_static for fresh "
+                f"draws per step)", stacklevel=4)
         out_shapes = jax.eval_shape(fn, *(t._data for t in ins))
         single = n_outs == 1 and not isinstance(out_shapes, tuple)
         outs_raw = (out_shapes,) if single else tuple(out_shapes)
@@ -155,7 +172,8 @@ class Program:
                     and not t.stop_gradient and t.trainable:
                 self._params.setdefault(t._uid, t)
         self._nodes.append(_OpNode(
-            name, fn, in_refs, tuple(o._uid for o in outs), n_outs))
+            name, fn, in_refs, tuple(o._uid for o in outs), n_outs,
+            differentiable=differentiable))
         self._version += 1
         return outs[0] if single else outs
 
@@ -186,19 +204,21 @@ class Program:
         new Program. ``for_test=True`` drops the train spec and the
         running-stat writebacks — the reference's inference-program
         idiom ``test_program = main.clone(for_test=True)``."""
-        if for_test and any(
-            n.name == "batch_norm_stats" for n in self._nodes
-        ):
-            # the recorded train-mode batch_norm normalizes with BATCH
-            # stats (its closure was fixed at record time); silently
-            # keeping it would corrupt small-batch inference. The
-            # reference rewires is_test=True; here, rebuild instead.
+        offenders = sorted({
+            n.name for n in self._nodes
+            if n.name == "batch_norm_stats" or n.name in _STOCHASTIC_OPS
+        }) if for_test else []
+        if offenders:
+            # recorded train-mode ops (batch-stat normalization,
+            # frozen dropout masks) have their mode fixed in the
+            # closure; silently keeping them would corrupt inference.
+            # The reference rewires is_test=True; here, rebuild instead.
             raise NotImplementedError(
-                "clone(for_test=True) on a program recorded with "
-                "train-mode batch_norm: rebuild the test program under "
-                "a fresh program_guard with the layers in .eval() mode "
-                "(static.nn layers are cached by name, so parameters "
-                "are shared)")
+                f"clone(for_test=True) on a program recorded with "
+                f"train-mode ops {offenders}: rebuild the test program "
+                f"under a fresh program_guard with the layers in "
+                f".eval() mode (static.nn layers are cached by name, "
+                f"so parameters are shared)")
         p = Program()
         p._nodes = [n for n in self._nodes
                     if not (for_test and n.writeback is not None)]
@@ -513,7 +533,8 @@ class Executor:
                         for r in node.in_refs
                     ]
                     out = apply_op(
-                        node.name, node.fn, *ins, n_outs=node.n_outs)
+                        node.name, node.fn, *ins, n_outs=node.n_outs,
+                        differentiable=node.differentiable)
                     outs = out if isinstance(out, tuple) else (out,)
                     for uid, o in zip(node.out_uids, outs):
                         env[uid] = o

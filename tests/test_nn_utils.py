@@ -169,3 +169,127 @@ class TestGradClipping:
         with pytest.raises(RuntimeError):
             U.clip_grad_norm_(m.parameters(), 1.0,
                               error_if_nonfinite=True)
+
+
+class TestNewLayers:
+    """Layers added for reference parity: torch-checked where torch has
+    the same op, else closed-form."""
+
+    def test_log_sigmoid_pairwise_unflatten(self):
+        import torch
+
+        x = np.random.RandomState(0).randn(4, 6).astype("float32")
+        y = np.random.RandomState(1).randn(4, 6).astype("float32")
+        np.testing.assert_allclose(
+            nn.LogSigmoid()(paddle.to_tensor(x)).numpy(),
+            torch.nn.LogSigmoid()(torch.tensor(x)).numpy(),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            nn.PairwiseDistance()(paddle.to_tensor(x),
+                                  paddle.to_tensor(y)).numpy(),
+            torch.nn.PairwiseDistance()(torch.tensor(x),
+                                        torch.tensor(y)).numpy(),
+            rtol=1e-5, atol=1e-5)
+        u = nn.Unflatten(1, [2, 3])(paddle.to_tensor(x))
+        assert list(u.shape) == [4, 2, 3]
+        np.testing.assert_array_equal(u.numpy().reshape(4, 6), x)
+
+    def test_new_losses_match_torch(self):
+        import torch
+
+        rng = np.random.RandomState(2)
+        x = rng.randn(5, 7).astype("float32")
+        y = rng.randn(5, 7).astype("float32")
+        lbl = rng.randint(0, 7, 5).astype("int64")
+        np.testing.assert_allclose(
+            nn.HuberLoss(delta=0.7)(paddle.to_tensor(x),
+                                    paddle.to_tensor(y)).numpy(),
+            torch.nn.HuberLoss(delta=0.7)(torch.tensor(x),
+                                          torch.tensor(y)).numpy(),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            nn.MultiMarginLoss()(paddle.to_tensor(x),
+                                 paddle.to_tensor(lbl)).numpy(),
+            torch.nn.MultiMarginLoss()(torch.tensor(x),
+                                       torch.tensor(lbl)).numpy(),
+            rtol=1e-5, atol=1e-6)
+        a, p, n = (rng.randn(5, 7).astype("float32") for _ in range(3))
+        np.testing.assert_allclose(
+            nn.TripletMarginWithDistanceLoss()(
+                paddle.to_tensor(a), paddle.to_tensor(p),
+                paddle.to_tensor(n)).numpy(),
+            torch.nn.TripletMarginWithDistanceLoss()(
+                torch.tensor(a), torch.tensor(p),
+                torch.tensor(n)).numpy(),
+            rtol=1e-5, atol=1e-5)
+        # custom distance callable
+        got = nn.TripletMarginWithDistanceLoss(
+            distance_function=lambda u, v: ((u - v) ** 2).sum(-1))(
+            paddle.to_tensor(a), paddle.to_tensor(p),
+            paddle.to_tensor(n)).numpy()
+        want = torch.nn.TripletMarginWithDistanceLoss(
+            distance_function=lambda u, v: ((u - v) ** 2).sum(-1))(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestPoolMasks13D:
+    """max_pool{1,3}d return_mask was silently ignored (callers
+    unpacked the pooled tensor along dim 0); pin the torch-checked
+    mask + unpool roundtrip."""
+
+    def test_max_pool1d_mask_matches_torch(self):
+        import torch
+
+        x = np.random.RandomState(0).randn(2, 3, 10).astype("float32")
+        out, idx = F.max_pool1d(paddle.to_tensor(x), 3, stride=2,
+                                return_mask=True)
+        tout, tidx = torch.nn.functional.max_pool1d(
+            torch.tensor(x), 3, stride=2, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), tidx.numpy())
+
+    def test_max_pool3d_mask_matches_torch(self):
+        import torch
+
+        x = np.random.RandomState(1).randn(1, 2, 4, 6, 6).astype("float32")
+        out, idx = F.max_pool3d(paddle.to_tensor(x), 2, return_mask=True)
+        tout, tidx = torch.nn.functional.max_pool3d(
+            torch.tensor(x), 2, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), tidx.numpy())
+
+    def test_unpool_roundtrip_1d_3d(self):
+        rng = np.random.RandomState(2)
+        x1 = paddle.to_tensor(rng.randn(2, 3, 8).astype("float32"))
+        p1, i1 = F.max_pool1d(x1, 2, return_mask=True)
+        r1 = F.max_unpool1d(p1, i1, 2).numpy()
+        m = r1 != 0
+        np.testing.assert_allclose(r1[m], x1.numpy()[m])
+        x3 = paddle.to_tensor(rng.randn(1, 2, 4, 4, 4).astype("float32"))
+        p3, i3 = F.max_pool3d(x3, 2, return_mask=True)
+        r3 = F.max_unpool3d(p3, i3, 2).numpy()
+        m3 = r3 != 0
+        np.testing.assert_allclose(r3[m3], x3.numpy()[m3])
+
+    def test_adaptive_max_pool1d_non_divisible_matches_torch(self):
+        import torch
+
+        for L, o in [(10, 4), (7, 3), (12, 5)]:
+            x = np.random.RandomState(L).randn(2, 3, L).astype("float32")
+            out, idx = F.adaptive_max_pool1d(
+                paddle.to_tensor(x), o, return_mask=True)
+            tout, tidx = torch.nn.functional.adaptive_max_pool1d(
+                torch.tensor(x), o, return_indices=True)
+            np.testing.assert_allclose(out.numpy(), tout.numpy(),
+                                       rtol=1e-6)
+            np.testing.assert_array_equal(idx.numpy(), tidx.numpy())
+
+    def test_max_unpool_channels_last(self):
+        p = paddle.to_tensor(
+            np.random.RandomState(2).randn(2, 4, 3).astype("float32"))
+        i = paddle.to_tensor(np.tile(
+            np.arange(0, 8, 2, dtype="int64")[None, :, None], (2, 1, 3)))
+        r = F.max_unpool1d(p, i, 2, data_format="NLC")
+        assert list(r.shape) == [2, 8, 3]
+        np.testing.assert_allclose(r.numpy()[:, ::2, :], p.numpy())

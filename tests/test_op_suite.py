@@ -715,6 +715,41 @@ OPS += [
                np.sqrt(((a - p) ** 2).sum(1) + 1e-6)
                - np.sqrt(((a - n) ** 2).sum(1) + 1e-6) + 1.0, 0)),
            [(4, 8), (4, 8), (4, 8)], grad=False, tol_scale=2.0),
+    OpSpec("triplet_margin_with_distance_loss",
+           lambda a, p, n: F.triplet_margin_with_distance_loss(a, p, n),
+           lambda a, p, n: np.mean(np.maximum(
+               np.sqrt(((a - p) ** 2).sum(1))
+               - np.sqrt(((a - n) ** 2).sum(1)) + 1.0, 0)),
+           [(4, 8), (4, 8), (4, 8)], grad=False, tol_scale=2.0),
+    OpSpec("huber_loss", B(F.huber_loss),
+           lambda x, y: np.mean(np.where(
+               np.abs(x - y) <= 1.0, 0.5 * (x - y) ** 2,
+               np.abs(x - y) - 0.5)),
+           [(4, 8), (4, 8)]),
+    OpSpec("multi_margin_loss",
+           lambda x: F.multi_margin_loss(x, _t64(_LBL)),
+           lambda x: np.mean([
+               np.sum(np.maximum(
+                   0.0, 1.0 - x[i, _LBL[i]] + x[i]
+               ) * (np.arange(8) != _LBL[i])) / 8.0
+               for i in range(4)]),
+           [(4, 8)]),
+    OpSpec("pairwise_distance", B(F.pairwise_distance),
+           lambda x, y: np.sqrt(((x - y + 1e-6) ** 2).sum(-1)),
+           [(4, 8), (4, 8)]),
+    OpSpec("dice_loss",
+           lambda x: F.dice_loss(
+               F.softmax(x, -1),
+               _t64(_LBL.reshape(4, 1))),
+           None, [(4, 8)]),
+    OpSpec("log_loss",
+           lambda x: F.log_loss(x, _t64(
+               np.tile([0.0, 1.0], 16).astype("float32").reshape(4, 8))),
+           lambda x: (
+               -np.tile([0.0, 1.0], 16).reshape(4, 8) * np.log(x + 1e-4)
+               - (1 - np.tile([0.0, 1.0], 16).reshape(4, 8))
+               * np.log(1 - x + 1e-4)),
+           [(4, 8)], domain=(0.05, 0.95)),
     # -- linalg solves / factors ---------------------------------------------
     OpSpec("det", lambda x: linalg.det(pmath.add(
                x, _t64(3 * np.eye(4, dtype="float32")))),
@@ -1132,6 +1167,19 @@ OPS += [
            lambda x: F.max_unpool2d(
                x, _t64(_UNPOOL_IDX), 2),
            lambda x: _max_unpool_np(x), [(1, 1, 2, 2)]),
+    OpSpec("max_unpool1d",
+           lambda x: F.max_unpool1d(
+               x, _t64(np.array([[[0, 3]]], np.int64)), 2),
+           lambda x: np.stack([[[x[0, 0, 0], 0.0, 0.0, x[0, 0, 1]]]]),
+           [(1, 1, 2)]),
+    OpSpec("max_unpool3d",
+           lambda x: F.max_unpool3d(
+               x, _t64(np.array([[[[[0]]]]], np.int64)), 2),
+           lambda x: np.pad(x, ((0, 0), (0, 0), (0, 1), (0, 1), (0, 1))),
+           [(1, 1, 1, 1, 1)]),
+    OpSpec("adaptive_max_pool1d",
+           lambda x: F.adaptive_max_pool1d(x, 2),
+           lambda x: x.reshape(1, 1, 2, 3).max(-1), [(1, 1, 6)]),
     OpSpec("margin_cross_entropy",
            lambda x: F.margin_cross_entropy(x, _t64(_LBL)),
            lambda x: _margin_ce_np(x), [(4, 8)], domain=(-0.95, 0.95),

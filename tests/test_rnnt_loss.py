@@ -1,0 +1,127 @@
+"""RNN-Transducer loss vs an independent numpy DP oracle and, for tiny
+cases, brute-force path enumeration (reference analog: warp-transducer
+tests behind paddle.nn.functional.rnnt_loss)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _np_rnnt(logits, labels, T, U, blank=0):
+    """Forward-variable DP in log space, straightforward numpy."""
+    lp = logits - np.log(
+        np.exp(logits - logits.max(-1, keepdims=True)).sum(
+            -1, keepdims=True)) - logits.max(-1, keepdims=True)
+    alpha = np.full((T, U + 1), -np.inf)
+    alpha[0, 0] = 0.0
+    for t in range(T):
+        for u in range(U + 1):
+            cands = []
+            if t == 0 and u == 0:
+                continue
+            if t > 0:
+                cands.append(alpha[t - 1, u] + lp[t - 1, u, blank])
+            if u > 0:
+                cands.append(alpha[t, u - 1] + lp[t, u - 1, labels[u - 1]])
+            alpha[t, u] = np.logaddexp.reduce(cands)
+    return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+
+def _brute_force(logits, labels, T, U, blank=0):
+    """Enumerate every monotonic alignment (T blanks + U labels, with
+    the final blank fixed) and sum path probabilities."""
+    lp = logits - np.log(
+        np.exp(logits - logits.max(-1, keepdims=True)).sum(
+            -1, keepdims=True)) - logits.max(-1, keepdims=True)
+    # a path is an interleaving of T blank-steps and U label-steps,
+    # ending with the final blank at (T-1, U)
+    total = -np.inf
+    steps = ["b"] * (T - 1) + ["l"] * U   # final blank appended
+    for perm in set(itertools.permutations(steps)):
+        t, u, s = 0, 0, 0.0
+        for mv in perm:
+            if mv == "b":
+                s += lp[t, u, blank]
+                t += 1
+            else:
+                s += lp[t, u, labels[u]]
+                u += 1
+        s += lp[T - 1, U, blank]
+        total = np.logaddexp(total, s)
+    return -total
+
+
+class TestRNNTLoss:
+    def test_matches_numpy_dp(self):
+        rng = np.random.RandomState(0)
+        B, T, U, C = 3, 5, 3, 6
+        logits = rng.randn(B, T, U + 1, C).astype("float32")
+        labels = rng.randint(1, C, (B, U)).astype("int32")
+        il = np.array([5, 4, 3], "int32")
+        ll = np.array([3, 2, 1], "int32")
+        got = F.rnnt_loss(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(il), paddle.to_tensor(ll),
+            reduction="none").numpy()
+        want = np.array([
+            _np_rnnt(logits[b, :il[b], :ll[b] + 1], labels[b], il[b],
+                     ll[b])
+            for b in range(B)
+        ])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_matches_brute_force(self):
+        rng = np.random.RandomState(1)
+        T, U, C = 3, 2, 4
+        logits = rng.randn(1, T, U + 1, C).astype("float32")
+        labels = np.array([[2, 1]], "int32")
+        got = float(F.rnnt_loss(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(np.array([T], "int32")),
+            paddle.to_tensor(np.array([U], "int32")),
+            reduction="sum").numpy())
+        want = _brute_force(logits[0], labels[0], T, U)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_reductions_and_layer(self):
+        rng = np.random.RandomState(2)
+        B, T, U, C = 2, 4, 2, 5
+        logits = paddle.to_tensor(
+            rng.randn(B, T, U + 1, C).astype("float32"))
+        labels = paddle.to_tensor(rng.randint(1, C, (B, U)).astype("int32"))
+        il = paddle.to_tensor(np.full(B, T, "int32"))
+        ll = paddle.to_tensor(np.full(B, U, "int32"))
+        none = F.rnnt_loss(logits, labels, il, ll, reduction="none").numpy()
+        s = float(F.rnnt_loss(logits, labels, il, ll,
+                              reduction="sum").numpy())
+        m = float(nn.RNNTLoss()(logits, labels, il, ll).numpy())
+        np.testing.assert_allclose(s, none.sum(), rtol=1e-6)
+        np.testing.assert_allclose(m, none.mean(), rtol=1e-6)
+
+    def test_gradient_flows(self):
+        rng = np.random.RandomState(3)
+        B, T, U, C = 2, 4, 2, 5
+        logits = paddle.to_tensor(
+            rng.randn(B, T, U + 1, C).astype("float32"),
+            stop_gradient=False)
+        labels = paddle.to_tensor(rng.randint(1, C, (B, U)).astype("int32"))
+        il = paddle.to_tensor(np.full(B, T, "int32"))
+        ll = paddle.to_tensor(np.full(B, U, "int32"))
+        loss = F.rnnt_loss(logits, labels, il, ll)
+        loss.backward()
+        g = logits.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+        # posteriors sum to 1 per (t,u) cell reached => grad rows sum ~0
+        np.testing.assert_allclose(g.sum(-1), 0.0, atol=1e-5)
+
+    def test_fastemit_rejected(self):
+        z = paddle.to_tensor(np.zeros((1, 2, 2, 3), "float32"))
+        lb = paddle.to_tensor(np.array([[1]], "int32"))
+        one = paddle.to_tensor(np.array([2], "int32"))
+        u = paddle.to_tensor(np.array([1], "int32"))
+        with pytest.raises(ValueError, match="fastemit"):
+            F.rnnt_loss(z, lb, one, u, fastemit_lambda=0.01)

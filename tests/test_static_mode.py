@@ -233,3 +233,20 @@ class TestExecutor:
         l1 = float(exe.run(main, feed={"x": X, "y": Y},
                            fetch_list=[loss])[0])
         assert l1 < l0
+
+
+class TestStochasticGuards:
+    def test_dropout_record_warns_and_clone_rejects(self):
+        import warnings
+
+        import paddle_tpu.nn.functional as F
+
+        main = static.Program()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            with static.program_guard(main):
+                x = static.data("x", [4, 8])
+                F.dropout(x, p=0.5, training=True)
+            assert any("SAME randomness" in str(i.message) for i in w)
+        with pytest.raises(NotImplementedError, match="dropout"):
+            main.clone(for_test=True)
