@@ -17,10 +17,7 @@ from .io import load, save
 from .random import Generator, default_generator, get_rng_state, seed, set_rng_state
 
 
-def in_dynamic_mode():
-    from .core import _state
-
-    return _state.static_program is None
+from .core import in_dynamic_mode  # noqa: F401 (canonical definition)
 
 
 def in_pir_mode():

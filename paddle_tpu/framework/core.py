@@ -70,6 +70,13 @@ class _EagerState(threading.local):
 _state = _EagerState()
 
 
+def in_dynamic_mode() -> bool:
+    """True in dygraph (the default); False while a static Program is
+    recording. Single definition — framework/__init__ and tensor.logic
+    re-export it."""
+    return _state.static_program is None
+
+
 def is_grad_enabled() -> bool:
     return _state.grad_enabled
 

@@ -7,9 +7,21 @@ this framework ships the acceptance-config model families in-tree:
 
 * :mod:`.llama`  — Llama-2 (RMSNorm / RoPE / GQA / SwiGLU), TP/SP-aware
 * :mod:`.gpt`    — GPT-3 (pre-LN, learned positions, gelu), DP/sharding
+* :mod:`.bert`   — BERT (bidirectional post-norm encoder, MLM +
+  sequence-classification heads), non-causal flash path
 """
 from . import llama
 from . import gpt
+from . import bert
+from .bert import (
+    BertConfig,
+    BertForMaskedLM,
+    BertForSequenceClassification,
+    BertModel,
+    bert_base,
+    bert_large,
+    bert_tiny,
+)
 from .llama import (
     LlamaConfig,
     LlamaForCausalLM,

@@ -1,0 +1,283 @@
+"""BERT model family — bidirectional encoder (masked-LM pretraining +
+sequence classification heads).
+
+Architecture parity with the reference ecosystem's BERT (learned
+absolute position embeddings, token-type embeddings, post-norm
+transformer encoder, GELU intermediate, tanh pooler over [CLS], MLM
+head tied to the word embeddings). Built on the same tensor-parallel
+layers as the Llama/GPT families (mp_layers.py Column/RowParallelLinear
++ VocabParallelEmbedding), so mp sharding works unchanged.
+
+TPU-native notes:
+
+* Unmasked (or fully-dense) attention takes the Pallas flash kernel's
+  non-causal path; with a padding ``attention_mask`` the masked
+  ``scaled_dot_product_attention`` fallback runs (the blocked-ragged
+  varlen kernel covers packed-sequence training via
+  ``flash_attn_unpadded`` for users who pack instead of pad).
+* Everything is a single-tensor-signature Layer stackable into the
+  compiled pipeline schedule, like the other families.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..distributed.fleet.layers.mpu.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..framework.core import apply_op
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.common import Dropout, Embedding, Linear
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    num_labels: int = 2
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    def num_params(self) -> int:
+        h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        per_layer = 4 * h * h + 4 * h + 2 * h * i + i + h + 4 * h
+        emb = (v + self.max_position_embeddings
+               + self.type_vocab_size) * h + 2 * h
+        pooler = h * h + h
+        return per_layer * self.num_hidden_layers + emb + pooler
+
+
+def bert_base(**kw) -> BertConfig:
+    return BertConfig(**kw)
+
+
+def bert_large(**kw) -> BertConfig:
+    kw.setdefault("hidden_size", 1024)
+    kw.setdefault("num_hidden_layers", 24)
+    kw.setdefault("num_attention_heads", 16)
+    kw.setdefault("intermediate_size", 4096)
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw) -> BertConfig:
+    """Small config for tests / compile checks."""
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("intermediate_size", 256)
+    kw.setdefault("max_position_embeddings", 128)
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = Embedding(
+            config.type_vocab_size, config.hidden_size)
+        self.layer_norm = LayerNorm(
+            config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        mpe = self.position_embeddings.weight.shape[0]
+        if s > mpe:
+            raise ValueError(
+                f"BERT input sequence length {s} exceeds "
+                f"max_position_embeddings {mpe}")
+        we = self.word_embeddings(input_ids)
+        pos = apply_op(
+            "bert_positions",
+            lambda ids: jnp.broadcast_to(
+                jnp.arange(s, dtype=jnp.int32), ids.shape),
+            input_ids, differentiable=False,
+        )
+        pe = self.position_embeddings(pos)
+        if token_type_ids is None:
+            token_type_ids = apply_op(
+                "zeros_like_ids",
+                lambda ids: jnp.zeros_like(ids), input_ids,
+                differentiable=False,
+            )
+        te = self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(we + pe + te))
+
+
+class BertSelfAttention(Layer):
+    """Bidirectional MHA, heads sharded over mp (column q/k/v, row out).
+    Unmasked input takes the non-causal Pallas flash path."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.head_dim
+        self.attn_dropout_p = config.attention_probs_dropout_prob
+        h = config.hidden_size
+        self.q_proj = ColumnParallelLinear(h, h, gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, h, gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, h, gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
+
+    def forward(self, x, attention_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        nh, hd = self.num_heads, self.head_dim
+        q, k, v = self.q_proj(x), self.k_proj(x), self.v_proj(x)
+        split = lambda t: t.reshape([b, s, nh, hd])
+        q, k, v = split(q), split(k), split(v)
+        drop = self.attn_dropout_p if self.training else 0.0
+        if attention_mask is None and not drop:
+            out, _ = F.flash_attention(q, k, v, causal=False)
+        else:
+            # additive mask broadcast over heads/query positions
+            # ((B, 1, 1, S)); attention-prob dropout forces this dense
+            # path (flash never materializes the probabilities)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attention_mask,
+                dropout_p=drop, training=self.training)
+        out = out.reshape([b, s, nh * hd])
+        return self.out_proj(out)
+
+
+class BertLayer(Layer):
+    """Post-norm encoder block (attention -> add&norm -> FFN ->
+    add&norm), the original BERT residual arrangement."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = LayerNorm(
+            config.hidden_size, epsilon=config.layer_norm_eps)
+        self.intermediate = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size,
+            gather_output=False)
+        self.output = RowParallelLinear(
+            config.intermediate_size, config.hidden_size,
+            input_is_parallel=True)
+        self.ffn_norm = LayerNorm(
+            config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        a = self.attention(x, attention_mask)
+        x = self.attn_norm(x + self.dropout(a))
+        f = self.output(F.gelu(self.intermediate(x)))
+        return self.ffn_norm(x + self.dropout(f))
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden_states):
+        return F.tanh(self.dense(hidden_states[:, 0]))
+
+
+class BertModel(Layer):
+    """Encoder trunk; returns (sequence_output, pooled_output)
+    (upstream contract of the reference ecosystem's BertModel)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.layers = [BertLayer(config)
+                       for _ in range(config.num_hidden_layers)]
+        for i, l in enumerate(self.layers):
+            self.add_sublayer(f"layer_{i}", l)
+        self.pooler = BertPooler(config)
+
+    def _additive_mask(self, attention_mask):
+        if attention_mask is None:
+            return None
+        return apply_op(
+            "bert_attn_mask",
+            lambda m: (1.0 - m.astype(jnp.float32))[:, None, None, :]
+            * -1e30,
+            attention_mask, differentiable=False,
+        )
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        am = self._additive_mask(attention_mask)
+        for layer in self.layers:
+            x = layer(x, am)
+        return x, self.pooler(x)
+
+
+class BertForMaskedLM(Layer):
+    """MLM head: dense + gelu + LN + decoder tied to the word
+    embeddings. ``forward(ids, labels)`` returns (logits, loss) with
+    ignore_index=-100, like the other families' ForCausalLM."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = LayerNorm(
+            config.hidden_size, epsilon=config.layer_norm_eps)
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True)
+
+    def forward(self, input_ids, labels=None, token_type_ids=None,
+                attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        w = self.bert.embeddings.word_embeddings.weight  # (V, H)
+        logits = apply_op(
+            "bert_mlm_logits",
+            lambda a, ww, bb: jnp.einsum("bsh,vh->bsv", a, ww) + bb,
+            h, w, self.decoder_bias,
+        )
+        if labels is None:
+            return logits, None
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]), ignore_index=-100)
+        return logits, loss
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, config.num_labels)
+
+    def forward(self, input_ids, labels=None, token_type_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits, None
+        if self.config.num_labels == 1:
+            loss = F.mse_loss(logits.reshape([-1]),
+                              labels.astype(self.config.dtype))
+        else:
+            loss = F.cross_entropy(logits, labels)
+        return logits, loss

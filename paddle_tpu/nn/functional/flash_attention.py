@@ -106,34 +106,49 @@ flash_attn_varlen_func = flash_attn_unpadded
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
-    """q/k/v: [batch, seq, heads, head_dim]."""
+    """q/k/v: [batch, seq, heads, head_dim]. ``dropout_p`` drops
+    attention PROBABILITIES (reference semantics) — it forces the
+    masked/dense path since flash never materializes the probs."""
     query, key, value = _as_tensor(query), _as_tensor(key), _as_tensor(value)
-    if attn_mask is None:
+    drop = dropout_p if (dropout_p and training) else 0.0
+    if attn_mask is None and not drop:
         return apply_op(
             "sdpa",
             lambda q, k, v: _flash(q, k, v, causal=is_causal),
             query, key, value,
         )
-    attn_mask = _as_tensor(attn_mask)
+    drop_key = None
+    if drop:
+        from ...framework.random import next_key
 
-    def f(q, k, v, m):
+        drop_key = next_key()
+
+    def f(q, k, v, *rest):
         d = q.shape[-1]
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
         ) / math.sqrt(d)
-        if m.dtype == jnp.bool_:
-            s = jnp.where(m, s, -1e30)
-        else:
-            s = s + m.astype(jnp.float32)
+        if rest:
+            m = rest[0]
+            if m.dtype == jnp.bool_:
+                s = jnp.where(m, s, -1e30)
+            else:
+                s = s + m.astype(jnp.float32)
         if is_causal:
             sq, sk = s.shape[-2], s.shape[-1]
             cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
             s = jnp.where(cm, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
+        if drop:
+            keep = jax.random.bernoulli(drop_key, 1.0 - drop, p.shape)
+            p = jnp.where(keep, p / (1.0 - drop), 0.0)
         out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
         return out.astype(q.dtype)
 
-    return apply_op("sdpa", f, query, key, value, attn_mask)
+    args = [query, key, value]
+    if attn_mask is not None:
+        args.append(_as_tensor(attn_mask))
+    return apply_op("sdpa", f, *args)
 
 
 def sdp_kernel(*args, **kwargs):

@@ -64,48 +64,12 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     out = apply_op("max_pool2d", f, x)
     if return_mask:
         # real argmax mask (flattened H*W index per pooled element,
-        # upstream: paddle/phi/kernels/funcs/pooling.h MaxPool2dWithIndex):
-        # extract each window as a patch column, argmax over the patch,
-        # then map the patch-local offset back to input coordinates
+        # upstream: paddle/phi/kernels/funcs/pooling.h MaxPool2dWithIndex)
         def fmask(a):
             if cl:
                 a = jnp.transpose(a, (0, 3, 1, 2))
-            n, c, ih, iw = a.shape
-            if isinstance(pad, str):
-                # resolve SAME/VALID to explicit lo/hi pairs
-                pairs = []
-                for d, (k, s, size) in enumerate(
-                    zip(ks, st, (ih, iw))
-                ):
-                    if pad == "VALID":
-                        pairs.append((0, 0))
-                    else:
-                        o = -(-size // s)
-                        tot = max((o - 1) * s + k - size, 0)
-                        pairs.append((tot // 2, tot - tot // 2))
-            else:
-                pairs = list(pad)
-            # finite large-negative pad: the patch extraction is a conv
-            # with a one-hot kernel, and -inf * 0 would NaN whole windows
-            af = jnp.pad(
-                a.astype(jnp.float32),
-                [(0, 0), (0, 0)] + pairs, constant_values=-1e30,
-            )
-            patches = jax.lax.conv_general_dilated_patches(
-                af, ks, st, "VALID",
-            )  # (N, C*kh*kw, OH, OW), feature order (c, kh, kw)
-            oh, ow = patches.shape[2], patches.shape[3]
-            patches = patches.reshape(n, c, ks[0] * ks[1], oh, ow)
-            loc = jnp.argmax(patches, axis=2)  # (N, C, OH, OW)
-            ph, pw = loc // ks[1], loc % ks[1]
-            ph0 = (jnp.arange(oh) * st[0])[None, None, :, None]
-            pw0 = (jnp.arange(ow) * st[1])[None, None, None, :]
-            row = jnp.clip(ph0 + ph - pairs[0][0], 0, ih - 1)
-            col = jnp.clip(pw0 + pw - pairs[1][0], 0, iw - 1)
-            idx = (row * iw + col).astype(jnp.int32)
-            if cl:
-                idx = jnp.transpose(idx, (0, 2, 3, 1))
-            return idx
+            idx = _maxpool_mask_nd(a, ks, st, pad, 2)
+            return jnp.transpose(idx, (0, 2, 3, 1)) if cl else idx
 
         idx = apply_op("max_pool2d_mask", fmask, x, differentiable=False)
         return out, idx
@@ -386,7 +350,7 @@ def _max_unpool_nd(name, nd):
     cl_format = {1: "NLC", 3: "NDHWC"}[nd]
 
     def unpool(x, indices, kernel_size, stride=None, padding=0,
-               output_size=None, data_format=None, name_=None):
+               output_size=None, data_format=None, name=None):
         x = _as_tensor(x)
         indices = _as_tensor(indices)
         ks = _pair(kernel_size, nd)

@@ -93,10 +93,7 @@ def is_tensor(x):
     return isinstance(x, Tensor)
 
 
-def in_dynamic_mode():
-    from ..framework.core import _state
-
-    return _state.static_program is None
+from ..framework.core import in_dynamic_mode  # noqa: F401,E402
 
 
 def is_floating_point(x):
