@@ -1,0 +1,266 @@
+"""Decoding strategies over the KV-cache decode_step (upstream analog:
+the reference ecosystem's generation_utils — greedy/sampling/beam — on
+top of fused decode kernels; here every strategy is a static-shape
+jittable step over the same caches the paged/serving stack uses).
+
+TPU-native notes:
+
+* All strategies keep static shapes: top-k uses ``lax.top_k``, top-p
+  masks the sorted cumulative distribution (no dynamic vocab pruning),
+  beam search keeps a fixed ``num_beams`` lane per sequence and
+  re-indexes the KV cache with a batched gather each step.
+* The per-step python loop feeds ONE compiled ``decode_step`` (pos is a
+  traced scalar), so a generate call compiles the step once for the
+  prefill shape and once for the single-token shape.
+* RNG: one framework key per sampling step (``framework.random``), so
+  ``paddle.seed`` reproduces generations.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import apply_op, no_grad
+from ..tensor.creation import to_tensor
+from ..tensor.manipulation import concat
+
+
+def _apply_repetition_penalty(logits, seen_mask, penalty):
+    """HF semantics: scores of already-generated tokens are divided by
+    ``penalty`` when positive, multiplied when negative."""
+    pen = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen_mask, pen, logits)
+
+
+def _filter_top_k_top_p(logits, top_k, top_p):
+    v = logits.shape[-1]
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, min(int(top_k), v))[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens while the cumulative mass BEFORE them is < top_p
+        # (always keeps the most probable token)
+        keep_sorted = (cum - probs) < top_p
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_l, jnp.inf), axis=-1,
+            keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
+def _step_sample(logits_last, seen_mask, key, *, do_sample, temperature,
+                 top_k, top_p, repetition_penalty):
+    l = logits_last.astype(jnp.float32)
+    if repetition_penalty and repetition_penalty != 1.0:
+        l = _apply_repetition_penalty(l, seen_mask, repetition_penalty)
+    if not do_sample:
+        return jnp.argmax(l, axis=-1).astype(jnp.int32)
+    if temperature and temperature != 1.0:
+        l = l / temperature
+    l = _filter_top_k_top_p(l, top_k, top_p)
+    return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
+
+
+def generate(model, input_ids, max_new_tokens=32, do_sample=False,
+             temperature=1.0, top_k=0, top_p=1.0,
+             repetition_penalty=1.0, eos_token_id=None, num_beams=1,
+             length_penalty=1.0, use_jit=False):
+    """Decode ``max_new_tokens`` from a CausalLM with ``decode_step``/
+    ``init_cache``. Greedy by default; ``do_sample=True`` enables
+    temperature / top-k / top-p sampling; ``num_beams > 1`` runs beam
+    search (beam search is deterministic — ``do_sample`` must be
+    False, like the reference). Returns [B, S0 + max_new_tokens]
+    (best beam for beam search); after ``eos_token_id`` a sequence
+    keeps emitting eos."""
+    if num_beams > 1:
+        if do_sample:
+            raise ValueError(
+                "generate: num_beams > 1 with do_sample=True is not "
+                "supported (beam search is deterministic, same as the "
+                "reference's beam strategy)")
+        return _beam_search(
+            model, input_ids, max_new_tokens, num_beams,
+            eos_token_id=eos_token_id, length_penalty=length_penalty,
+            repetition_penalty=repetition_penalty, use_jit=use_jit)
+
+    from ..framework.random import next_key
+
+    with no_grad():
+        b, s0 = input_ids.shape
+        v = model.config.vocab_size
+        max_len = s0 + max_new_tokens
+        caches = model.init_cache(b, max_len)
+        step = model.decode_step
+        if use_jit:
+            from .. import jit as _jit
+
+            step = _jit.to_static(model.decode_step)
+
+        # fixed-arity step state: seen-token mask (repetition penalty)
+        # and per-row done flag (eos) always exist — both are tiny
+        need_seen = bool(repetition_penalty) and repetition_penalty != 1.0
+        seen = apply_op(
+            "seen_init",
+            lambda ids: (
+                jnp.zeros((b, v), bool).at[
+                    jnp.arange(b)[:, None], ids].set(True)
+                if need_seen else jnp.zeros((b, 1), bool)),
+            input_ids, differentiable=False,
+        )
+        done = apply_op(
+            "done_init", lambda ids: jnp.zeros((b,), bool), input_ids,
+            differentiable=False,
+        )
+
+        tokens = [input_ids]
+        cur = input_ids
+        for i in range(max_new_tokens):
+            pos = to_tensor(np.int32(0 if i == 0 else s0 + i - 1))
+            logits, caches = step(cur, caches, pos)
+            key = next_key() if do_sample else None
+
+            def pick(l, sm, dn):
+                nxt = _step_sample(
+                    l[:, -1], sm if need_seen else None, key,
+                    do_sample=do_sample, temperature=temperature,
+                    top_k=top_k, top_p=top_p,
+                    repetition_penalty=repetition_penalty)
+                if eos_token_id is not None:
+                    nxt = jnp.where(dn, eos_token_id, nxt)
+                    dn = dn | (nxt == eos_token_id)
+                sm2 = sm.at[jnp.arange(b), nxt].set(True) \
+                    if need_seen else sm
+                return nxt[:, None], sm2, dn
+
+            cur, seen, done = apply_op(
+                "generate_pick", pick, logits, seen, done, n_outs=3,
+                differentiable=False)
+            tokens.append(cur)
+        return concat(tokens, axis=1)
+
+
+def _beam_search(model, input_ids, max_new_tokens, num_beams,
+                 eos_token_id=None, length_penalty=1.0,
+                 repetition_penalty=1.0, use_jit=False):
+    """Fixed-width beam search: the prompt prefills ONCE at B lanes,
+    caches/logits then expand to B*K; each step takes top-K over K*V
+    and re-indexes the KV caches with a batched gather. Finished beams
+    (emitted eos) are frozen: they keep emitting eos at zero cost and
+    stop growing their decoded length. Repetition penalty applies to
+    RAW logits (greedy-path semantics) with the seen-set seeded from
+    the prompt. Final pick: score / length**length_penalty with each
+    beam's ACTUAL decoded length (eos-frozen beams stay short)."""
+    with no_grad():
+        b, s0 = input_ids.shape
+        k = int(num_beams)
+        v = model.config.vocab_size
+        need_pen = bool(repetition_penalty) and repetition_penalty != 1.0
+        max_len = s0 + max_new_tokens
+        step = model.decode_step
+        if use_jit:
+            from .. import jit as _jit
+
+            step = _jit.to_static(model.decode_step)
+
+        # prefill once at B lanes, then expand state to B*K
+        caches = model.init_cache(b, max_len)
+        logits, caches = step(input_ids, caches, to_tensor(np.int32(0)))
+        rep = lambda t: apply_op(
+            "beam_lane_expand",
+            lambda a: jnp.repeat(a, k, axis=0), t, differentiable=False)
+        caches = [(rep(ck), rep(cv)) for ck, cv in caches]
+        last = apply_op(
+            "beam_last_expand",
+            lambda l: jnp.repeat(l[:, -1], k, axis=0), logits,
+            differentiable=False)  # (B*K, V) raw logits
+
+        def init_state(ids):
+            scores = jnp.tile(
+                jnp.asarray([0.0] + [-1e30] * (k - 1), jnp.float32), b)
+            alive = jnp.ones((b * k,), bool)
+            lengths = jnp.zeros((b * k,), jnp.int32)
+            seen = (
+                jnp.zeros((b * k, v), bool).at[
+                    jnp.arange(b * k)[:, None],
+                    jnp.repeat(ids, k, axis=0)].set(True)
+                if need_pen else jnp.zeros((b * k, 1), bool))
+            return scores, alive, lengths, seen
+
+        scores, alive, lengths, seen = apply_op(
+            "beam_state_init", init_state, input_ids, n_outs=4,
+            differentiable=False)
+
+        generated = None  # (B*K, T) grows by concat (python loop)
+        for i in range(max_new_tokens):
+            if i > 0:
+                pos = to_tensor(np.int32(s0 + i - 1))
+                logits, caches = step(cur, caches, pos)
+                last = apply_op(
+                    "beam_last", lambda l: l[:, -1], logits,
+                    differentiable=False)
+
+            def expand(lraw, sc, al, ln_, sm):
+                lraw = lraw.astype(jnp.float32)
+                if need_pen:
+                    lraw = _apply_repetition_penalty(
+                        lraw, sm, repetition_penalty)
+                lp = jax.nn.log_softmax(lraw, axis=-1)      # (B*K, V)
+                if eos_token_id is not None:
+                    # frozen beams: only eos allowed, at zero cost
+                    frozen = jnp.full((v,), -1e30).at[
+                        eos_token_id].set(0.0)
+                    lp = jnp.where(al[:, None], lp, frozen[None, :])
+                total = (sc[:, None] + lp).reshape(b, k * v)
+                top_sc, top_ix = jax.lax.top_k(total, k)    # (B, K)
+                beam_ix = top_ix // v
+                tok = (top_ix % v).astype(jnp.int32).reshape(-1)
+                lane = (jnp.arange(b)[:, None] * k + beam_ix).reshape(-1)
+                al_prev = al[lane]
+                new_len = ln_[lane] + al_prev.astype(jnp.int32)
+                new_al = al_prev
+                if eos_token_id is not None:
+                    new_al = new_al & (tok != eos_token_id)
+                sm2 = sm[lane]
+                if need_pen:
+                    sm2 = sm2.at[jnp.arange(b * k), tok].set(True)
+                return (tok[:, None], top_sc.reshape(-1), new_al,
+                        new_len, lane.astype(jnp.int32), sm2)
+
+            cur, scores, alive, lengths, lane, seen = apply_op(
+                "beam_expand_step", expand, last, scores, alive,
+                lengths, seen, n_outs=6, differentiable=False,
+            )
+            # re-index caches and generated history onto the new lanes
+            caches = [
+                (apply_op("beam_gather",
+                          lambda c, ln: c[ln], ck, lane,
+                          differentiable=False),
+                 apply_op("beam_gather",
+                          lambda c, ln: c[ln], cv, lane,
+                          differentiable=False))
+                for ck, cv in caches
+            ]
+            if generated is None:
+                generated = cur
+            else:
+                generated = apply_op(
+                    "beam_hist",
+                    lambda g, ln, t: jnp.concatenate(
+                        [g[ln], t], axis=1),
+                    generated, lane, cur, differentiable=False,
+                )
+
+        def best(g, sc, ln_):
+            lens = jnp.maximum(ln_.reshape(b, k), 1).astype(jnp.float32)
+            norm = sc.reshape(b, k) / (lens ** length_penalty)
+            pick = jnp.argmax(norm, axis=-1)
+            return g.reshape(b, k, -1)[jnp.arange(b), pick]
+
+        out = apply_op("beam_best", best, generated, scores, lengths,
+                       differentiable=False)
+        return concat([input_ids, out], axis=1)

@@ -416,40 +416,14 @@ class GPTForCausalLM(Layer):
         h, new_caches = self.gpt.decode_step(input_ids, caches, pos)
         return self._head(h), new_caches
 
-    def generate(self, input_ids, max_new_tokens=32, use_jit=False):
-        """Greedy KV-cache decode (see LlamaForCausalLM.generate)."""
-        import numpy as np
+    def generate(self, input_ids, max_new_tokens=32, use_jit=False,
+                 **kwargs):
+        """KV-cache decode: greedy / sampling / beam (see
+        LlamaForCausalLM.generate and :mod:`.generation`)."""
+        from .generation import generate as _generate
 
-        from ..framework.core import no_grad
-        from ..tensor.creation import to_tensor
-        from ..tensor.manipulation import concat
-
-        with no_grad():
-            b, s0 = input_ids.shape
-            caches = self.init_cache(b, s0 + max_new_tokens)
-            step = self.decode_step
-            if use_jit:
-                from .. import jit as _jit
-
-                step = _jit.to_static(self.decode_step)
-
-            def pick(logits):
-                return apply_op(
-                    "greedy_pick",
-                    lambda l: jnp.argmax(
-                        l[:, -1].astype(jnp.float32), axis=-1
-                    )[:, None].astype(jnp.int32),
-                    logits,
-                )
-
-            tokens = [input_ids]
-            cur = input_ids
-            for i in range(max_new_tokens):
-                pos = to_tensor(np.int32(0 if i == 0 else s0 + i - 1))
-                logits, caches = step(cur, caches, pos)
-                cur = pick(logits)
-                tokens.append(cur)
-            return concat(tokens, axis=1)
+        return _generate(self, input_ids, max_new_tokens=max_new_tokens,
+                         use_jit=use_jit, **kwargs)
 
 
 # -- pipeline form ----------------------------------------------------------

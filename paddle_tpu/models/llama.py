@@ -456,44 +456,16 @@ class LlamaForCausalLM(Layer):
         h, new_caches = self.model.decode_step(input_ids, caches, pos)
         return self._head(h), new_caches
 
-    def generate(self, input_ids, max_new_tokens=32, use_jit=False):
-        """Greedy decode (the minimal serving slice over the KV cache;
-        sampling strategies layer on top). Returns [B, S0+max_new]."""
-        import numpy as np
+    def generate(self, input_ids, max_new_tokens=32, use_jit=False,
+                 **kwargs):
+        """Decode over the KV cache. Greedy by default; sampling
+        (do_sample/temperature/top_k/top_p/repetition_penalty/
+        eos_token_id) and beam search (num_beams) via
+        :mod:`.generation`. Returns [B, S0+max_new]."""
+        from .generation import generate as _generate
 
-        from ..framework.core import Tensor, no_grad
-        from ..tensor.creation import to_tensor
-
-        with no_grad():
-            b, s0 = input_ids.shape
-            max_len = s0 + max_new_tokens
-            caches = self.init_cache(b, max_len)
-
-            step = self.decode_step
-            if use_jit:
-                from .. import jit as _jit
-
-                step = _jit.to_static(self.decode_step)
-
-            def pick(logits):
-                return apply_op(
-                    "greedy_pick",
-                    lambda l: jnp.argmax(
-                        l[:, -1].astype(jnp.float32), axis=-1
-                    )[:, None].astype(jnp.int32),
-                    logits,
-                )
-
-            tokens = [input_ids]
-            cur = input_ids  # prefill consumes the prompt, then 1/step
-            for i in range(max_new_tokens):
-                pos = to_tensor(np.int32(0 if i == 0 else s0 + i - 1))
-                logits, caches = step(cur, caches, pos)
-                cur = pick(logits)
-                tokens.append(cur)
-            from ..tensor.manipulation import concat
-
-            return concat(tokens, axis=1)
+        return _generate(self, input_ids, max_new_tokens=max_new_tokens,
+                         use_jit=use_jit, **kwargs)
 
 
 class LlamaPretrainingCriterion(Layer):

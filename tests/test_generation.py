@@ -1,0 +1,148 @@
+"""Decoding strategies (reference analog: generation_utils greedy /
+sampling / beam tests). Properties over a tiny Llama: top_k=1 ==
+greedy, beam(1) == greedy, beam(k) never scores below greedy,
+eos freezes sequences, repetition penalty suppresses repeats,
+seeded sampling reproduces."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.models.generation import _filter_top_k_top_p
+
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny()).eval()
+
+
+def _prompt(b=2, s=6, v=512, seed=1):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randint(4, v, (b, s)).astype("int32"))
+
+
+def _seq_logprob(model, seq, s0):
+    """Teacher-forced log-prob of seq[:, s0:] under the model."""
+    logits = model(seq)  # labels=None -> bare logits
+    if isinstance(logits, tuple):
+        logits = logits[0]
+    lp = np.asarray(logits._data).astype(np.float64)
+    lp = lp - np.log(np.exp(lp - lp.max(-1, keepdims=True)).sum(
+        -1, keepdims=True)) - lp.max(-1, keepdims=True)
+    ids = np.asarray(seq._data)
+    tot = np.zeros(ids.shape[0])
+    for t in range(s0, ids.shape[1]):
+        tot += lp[np.arange(ids.shape[0]), t - 1, ids[:, t]]
+    return tot
+
+
+class TestFilters:
+    def test_top_k(self):
+        l = jnp.asarray([[1.0, 3.0, 2.0, 0.0]])
+        out = np.asarray(_filter_top_k_top_p(l, 2, 1.0))
+        assert np.isfinite(out[0, [1, 2]]).all()
+        assert np.isinf(out[0, [0, 3]]).all()
+
+    def test_top_p_keeps_head(self):
+        l = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+        out = np.asarray(_filter_top_k_top_p(l, 0, 0.7))
+        # cumulative-before: 0, .5, .8, .95 -> keep first two
+        assert np.isfinite(out[0, [0, 1]]).all()
+        assert np.isinf(out[0, [2, 3]]).all()
+
+    def test_top_p_always_keeps_best(self):
+        l = jnp.log(jnp.asarray([[0.9, 0.1]]))
+        out = np.asarray(_filter_top_k_top_p(l, 0, 0.01))
+        assert np.isfinite(out[0, 0]) and np.isinf(out[0, 1])
+
+
+class TestStrategies:
+    def test_top_k1_and_beam1_equal_greedy(self, model):
+        ids = _prompt()
+        greedy = model.generate(ids, max_new_tokens=6).numpy()
+        paddle.seed(3)
+        k1 = model.generate(ids, max_new_tokens=6, do_sample=True,
+                            top_k=1).numpy()
+        beam1 = model.generate(ids, max_new_tokens=6, num_beams=1).numpy()
+        np.testing.assert_array_equal(greedy, k1)
+        np.testing.assert_array_equal(greedy, beam1)
+
+    def test_seeded_sampling_reproduces_and_varies(self, model):
+        ids = _prompt()
+        paddle.seed(7)
+        a = model.generate(ids, max_new_tokens=8, do_sample=True,
+                           temperature=1.5).numpy()
+        paddle.seed(7)
+        b = model.generate(ids, max_new_tokens=8, do_sample=True,
+                           temperature=1.5).numpy()
+        paddle.seed(8)
+        c = model.generate(ids, max_new_tokens=8, do_sample=True,
+                           temperature=1.5).numpy()
+        np.testing.assert_array_equal(a, b)
+        assert (a != c).any()
+
+    def test_eos_freezes_sequence(self, model):
+        ids = _prompt()
+        greedy = model.generate(ids, max_new_tokens=8).numpy()
+        s0 = ids.shape[1]
+        eos = int(greedy[0, s0 + 2])  # token emitted at step 3, row 0
+        out = model.generate(ids, max_new_tokens=8,
+                             eos_token_id=eos).numpy()
+        row = out[0, s0:]
+        hits = np.where(row == eos)[0]
+        assert hits.size > 0
+        assert (row[hits[0]:] == eos).all()
+
+    def test_repetition_penalty_suppresses_repeats(self, model):
+        ids = _prompt(b=1)
+        out = model.generate(ids, max_new_tokens=8,
+                             repetition_penalty=1e6).numpy()
+        s0 = ids.shape[1]
+        gen = out[0, s0:]
+        prompt = set(out[0, :s0].tolist())
+        seen = set(prompt)
+        for t in gen.tolist():
+            assert t not in seen, (gen, prompt)
+            seen.add(t)
+
+    def test_beam_search_not_worse_than_greedy(self, model):
+        ids = _prompt()
+        s0 = ids.shape[1]
+        greedy = model.generate(ids, max_new_tokens=5)
+        beam = model.generate(ids, max_new_tokens=5, num_beams=4)
+        lp_g = _seq_logprob(model, greedy, s0)
+        lp_b = _seq_logprob(model, beam, s0)
+        assert (lp_b >= lp_g - 1e-4).all(), (lp_b, lp_g)
+
+    def test_beam_repetition_penalty_covers_prompt(self, model):
+        """Beam path must seed the seen-set from the prompt like the
+        greedy path (review caught it starting empty)."""
+        ids = _prompt(b=1)
+        out = model.generate(ids, max_new_tokens=6, num_beams=3,
+                             repetition_penalty=1e6).numpy()
+        s0 = ids.shape[1]
+        gen = out[0, s0:]
+        seen = set(out[0, :s0].tolist())
+        for t in gen.tolist():
+            assert t not in seen, (gen, seen)
+            seen.add(t)
+
+    def test_beam_eos_freezes_and_lengths_differ(self, model):
+        ids = _prompt()
+        greedy = model.generate(ids, max_new_tokens=8).numpy()
+        s0 = ids.shape[1]
+        eos = int(greedy[0, s0 + 1])
+        out = model.generate(ids, max_new_tokens=8, num_beams=3,
+                             eos_token_id=eos).numpy()
+        row = out[0, s0:]
+        h = np.where(row == eos)[0]
+        if h.size:
+            assert (row[h[0]:] == eos).all()
+
+    def test_beam_rejects_sampling(self, model):
+        with pytest.raises(ValueError, match="num_beams"):
+            model.generate(_prompt(), max_new_tokens=2, num_beams=2,
+                           do_sample=True)
