@@ -1,0 +1,190 @@
+"""HuggingFace checkpoint conversion — the migration story for users
+switching from the reference ecosystem: load HF-format weights into
+this framework's model families and get the same logits.
+
+Upstream analog: the reference ecosystem's community checkpoint
+converters; here conversion is a pure name/orientation mapping because
+the families were built HF-naming-compatible (Llama keys are identical;
+torch ``nn.Linear`` stores [out, in] while this framework's linears
+store [in, out], so 2-D projection weights transpose).
+
+Logit-level parity against ``transformers`` is pinned in
+``tests/test_hf_convert.py`` — the strongest architectural-correctness
+evidence available without hardware.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _np(t):
+    """torch.Tensor / np.ndarray / jax array -> numpy."""
+    if hasattr(t, "detach"):
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _assign(param, arr, name):
+    arr = np.asarray(arr)
+    want = tuple(param.shape)
+    if tuple(arr.shape) != want:
+        raise ValueError(
+            f"convert: shape mismatch for {name!r}: checkpoint "
+            f"{tuple(arr.shape)} vs model {want}")
+    param.set_value(arr.astype(np.asarray(param._data).dtype))
+
+
+def load_hf_llama(model, state_dict, strict=True):
+    """Load a HF-format Llama state dict into ``LlamaForCausalLM``.
+
+    Key names already match (model.layers.N.self_attn.q_proj.weight,
+    ...); 2-D linear weights transpose from torch's [out, in]. With
+    ``tie_word_embeddings`` the HF ``lm_head.weight`` entry (if
+    present) is ignored — the head reads the embedding."""
+    own = model.state_dict()
+    used = set()
+    for name, param in own.items():
+        if name not in state_dict:
+            if strict:
+                raise KeyError(f"convert: missing HF key {name!r}")
+            continue
+        arr = _np(state_dict[name])
+        if name.endswith(".weight") and arr.ndim == 2 \
+                and "embed_tokens" not in name:
+            arr = arr.T
+        _assign(param, arr, name)
+        used.add(name)
+    if strict:
+        tied = getattr(model, "lm_head", None) is None
+        leftovers = [
+            k for k in state_dict
+            if k not in used and not (tied and k == "lm_head.weight")
+            and not k.endswith("rotary_emb.inv_freq")
+        ]
+        if leftovers:
+            raise KeyError(f"convert: unused HF keys {leftovers[:5]}"
+                           f"{'...' if len(leftovers) > 5 else ''}")
+    return model
+
+
+# HF BertModel key -> this framework's BertModel key (N = layer index).
+# Weights of mapped ".dense"/projection entries transpose.
+_BERT_MAP = {
+    "embeddings.word_embeddings.weight":
+        "embeddings.word_embeddings.weight",
+    "embeddings.position_embeddings.weight":
+        "embeddings.position_embeddings.weight",
+    "embeddings.token_type_embeddings.weight":
+        "embeddings.token_type_embeddings.weight",
+    "embeddings.LayerNorm.weight": "embeddings.layer_norm.weight",
+    "embeddings.LayerNorm.bias": "embeddings.layer_norm.bias",
+    "pooler.dense.weight": "pooler.dense.weight",
+    "pooler.dense.bias": "pooler.dense.bias",
+}
+
+_BERT_LAYER_MAP = {
+    "attention.self.query": "attention.q_proj",
+    "attention.self.key": "attention.k_proj",
+    "attention.self.value": "attention.v_proj",
+    "attention.output.dense": "attention.out_proj",
+    "attention.output.LayerNorm": "attn_norm",
+    "intermediate.dense": "intermediate",
+    "output.dense": "output",
+    "output.LayerNorm": "ffn_norm",
+}
+
+_BERT_MLM_MAP = {
+    "cls.predictions.transform.dense.weight": "transform.weight",
+    "cls.predictions.transform.dense.bias": "transform.bias",
+    "cls.predictions.transform.LayerNorm.weight":
+        "transform_norm.weight",
+    "cls.predictions.transform.LayerNorm.bias": "transform_norm.bias",
+    "cls.predictions.bias": "decoder_bias",
+}
+
+
+def _map_bert_key(k):
+    if k in _BERT_MAP:
+        return _BERT_MAP[k]
+    if k.startswith("encoder.layer."):
+        rest = k[len("encoder.layer."):]
+        n, sub = rest.split(".", 1)
+        for hf, ours in _BERT_LAYER_MAP.items():
+            if sub.startswith(hf + "."):
+                leaf = sub[len(hf) + 1:]
+                return f"layer_{n}.{ours}.{leaf}"
+    return None
+
+
+def load_hf_bert(model, state_dict, strict=True):
+    """Load a HF-format BERT state dict into ``BertModel``,
+    ``BertForMaskedLM`` or ``BertForSequenceClassification``.
+
+    Accepts both bare-trunk keys (``embeddings...``) and headed
+    checkpoints (``bert.embeddings...`` + ``cls.predictions...``).
+    The MLM decoder weight is tied to the word embeddings on both
+    sides, so only its bias transfers."""
+    trunk = model if type(model).__name__ == "BertModel" \
+        else model.bert
+    own_trunk = trunk.state_dict()
+    own_head = {} if trunk is model else model.state_dict()
+    used = set()
+    filled = set()
+    for k, v in state_dict.items():
+        key = k[len("bert."):] if k.startswith("bert.") else k
+        ours = _map_bert_key(key)
+        target = None
+        if ours is not None and ours in own_trunk:
+            target = own_trunk[ours]
+            filled.add(f"bert.{ours}" if own_head else ours)
+        elif k in _BERT_MLM_MAP and _BERT_MLM_MAP[k] in own_head:
+            ours = _BERT_MLM_MAP[k]
+            target = own_head[ours]
+            filled.add(ours)
+        elif k in ("classifier.weight", "classifier.bias") \
+                and k in own_head:
+            ours = k
+            target = own_head[k]
+            filled.add(k)
+        if target is None:
+            continue
+        arr = _np(v)
+        if ours.endswith(".weight") and arr.ndim == 2 \
+                and "embeddings." not in ours:
+            arr = arr.T
+        _assign(target, arr, ours)
+        used.add(k)
+    if strict:
+        skippable = ("cls.predictions.decoder",  # tied to embeddings
+                     "cls.seq_relationship",     # NSP head (not kept)
+                     "position_ids")
+        leftovers = [k for k in state_dict if k not in used
+                     and not any(s in k for s in skippable)]
+        if leftovers:
+            raise KeyError(
+                f"convert: unmapped HF keys {leftovers[:5]}"
+                f"{'...' if len(leftovers) > 5 else ''}")
+        # unlike the trunk-only case, a HEADED model must find its
+        # head weights in the checkpoint — a silently random head
+        # would produce garbage logits (classifier heads are exempt:
+        # fine-tuning from a bare trunk initializes them fresh)
+        missing = [n for n in own_head
+                   if n not in filled and not n.startswith("bert.")
+                   and not n.startswith("classifier.")]
+        if missing:
+            raise KeyError(
+                f"convert: checkpoint has no weights for head "
+                f"parameters {missing[:5]}"
+                f"{'...' if len(missing) > 5 else ''}")
+    return model
+
+
+def from_hf(model, state_dict, strict=True):
+    """Dispatch on the model family."""
+    name = type(model).__name__
+    if name.startswith("Llama"):
+        return load_hf_llama(model, state_dict, strict=strict)
+    if name.startswith("Bert"):
+        return load_hf_bert(model, state_dict, strict=strict)
+    raise TypeError(
+        f"from_hf: no converter for {name} (supported: Llama*, Bert*)")
