@@ -31,7 +31,7 @@ def _assign(param, arr, name):
         raise ValueError(
             f"convert: shape mismatch for {name!r}: checkpoint "
             f"{tuple(arr.shape)} vs model {want}")
-    param.set_value(arr.astype(np.asarray(param._data).dtype))
+    param.set_value(arr.astype(param._data.dtype))
 
 
 def load_hf_llama(model, state_dict, strict=True):
@@ -129,23 +129,24 @@ def load_hf_bert(model, state_dict, strict=True):
     own_trunk = trunk.state_dict()
     own_head = {} if trunk is model else model.state_dict()
     used = set()
-    filled = set()
+    filled_trunk = set()   # keys of own_trunk
+    filled_head = set()    # keys of own_head
     for k, v in state_dict.items():
         key = k[len("bert."):] if k.startswith("bert.") else k
         ours = _map_bert_key(key)
         target = None
         if ours is not None and ours in own_trunk:
             target = own_trunk[ours]
-            filled.add(f"bert.{ours}" if own_head else ours)
+            filled_trunk.add(ours)
         elif k in _BERT_MLM_MAP and _BERT_MLM_MAP[k] in own_head:
             ours = _BERT_MLM_MAP[k]
             target = own_head[ours]
-            filled.add(ours)
+            filled_head.add(ours)
         elif k in ("classifier.weight", "classifier.bias") \
                 and k in own_head:
             ours = k
             target = own_head[k]
-            filled.add(k)
+            filled_head.add(k)
         if target is None:
             continue
         arr = _np(v)
@@ -164,12 +165,25 @@ def load_hf_bert(model, state_dict, strict=True):
             raise KeyError(
                 f"convert: unmapped HF keys {leftovers[:5]}"
                 f"{'...' if len(leftovers) > 5 else ''}")
-        # unlike the trunk-only case, a HEADED model must find its
-        # head weights in the checkpoint — a silently random head
-        # would produce garbage logits (classifier heads are exempt:
-        # fine-tuning from a bare trunk initializes them fresh)
+        # every TRUNK parameter must have been filled — a checkpoint
+        # from a smaller config would otherwise leave deeper layers
+        # silently random (llama's path raises the same way). The
+        # pooler is exempt: HF headed checkpoints are saved with
+        # add_pooling_layer=False, and heads don't read it.
+        missing_trunk = [n for n in own_trunk
+                         if n not in filled_trunk
+                         and not n.startswith("pooler.")]
+        if missing_trunk:
+            raise KeyError(
+                f"convert: checkpoint has no weights for trunk "
+                f"parameters {missing_trunk[:5]}"
+                f"{'...' if len(missing_trunk) > 5 else ''}")
+        # a HEADED model must find its head weights too — a silently
+        # random head would produce garbage logits (classifier heads
+        # are exempt: fine-tuning from a bare trunk initializes them
+        # fresh)
         missing = [n for n in own_head
-                   if n not in filled and not n.startswith("bert.")
+                   if n not in filled_head and not n.startswith("bert.")
                    and not n.startswith("classifier.")]
         if missing:
             raise KeyError(

@@ -126,14 +126,22 @@ def unstack(x, axis=0, num=None, name=None):
 def squeeze(x, axis=None, name=None):
     x = _as_tensor(x)
     if axis is None:
-        ax = None
+        ax_spec = None
     elif isinstance(axis, (list, tuple)):
-        ax = tuple(int(a) for a in axis if x.shape[int(a)] == 1)
+        ax_spec = tuple(int(a) for a in axis)
     else:
-        ax = int(axis)
-        if x.shape[ax] != 1:
-            return x.clone()
-    return apply_op("squeeze", lambda a: jnp.squeeze(a, axis=ax), x)
+        ax_spec = (int(axis),)
+
+    def f(a):
+        # which requested axes are actually 1 is decided per-call, so
+        # static-graph replay sees the fed dims (reference semantics:
+        # non-1 axes are silently kept)
+        if ax_spec is None:
+            return jnp.squeeze(a)
+        ax = tuple(i for i in ax_spec if a.shape[i] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+
+    return apply_op("squeeze", f, x)
 
 
 def unsqueeze(x, axis, name=None):
@@ -181,10 +189,15 @@ def cast(x, dtype):
 def expand(x, shape, name=None):
     x = _as_tensor(x)
     shp = _static_shape(shape)
-    # paddle semantics: -1 keeps the original dim
-    cur = ([1] * (len(shp) - x.ndim)) + x.shape
-    target = tuple(c if s == -1 else s for s, c in zip(shp, cur))
-    return apply_op("expand", lambda a: jnp.broadcast_to(a, target), x)
+
+    def f(a):
+        # paddle semantics: -1 keeps the original dim (resolved
+        # per-call for static-graph replay)
+        cur = ([1] * (len(shp) - a.ndim)) + list(a.shape)
+        target = tuple(c if s == -1 else s for s, c in zip(shp, cur))
+        return jnp.broadcast_to(a, target)
+
+    return apply_op("expand", f, x)
 
 
 def broadcast_to(x, shape, name=None):
@@ -192,7 +205,9 @@ def broadcast_to(x, shape, name=None):
 
 
 def expand_as(x, y, name=None):
-    return expand(x, _as_tensor(y).shape)
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op(
+        "expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
 
 
 def broadcast_tensors(inputs, name=None):

@@ -143,3 +143,16 @@ class TestBertParity:
         ours = BertForMaskedLM(bert_tiny())
         with pytest.raises(KeyError, match="head parameters"):
             from_hf(ours, hf_trunk.state_dict())
+
+    def test_smaller_checkpoint_trunk_raises(self):
+        """A 1-layer checkpoint into a 2-layer model must raise, not
+        leave layer 1 randomly initialized (review finding)."""
+        cfg = transformers.BertConfig(
+            vocab_size=512, hidden_size=128, num_hidden_layers=1,
+            num_attention_heads=4, intermediate_size=256,
+            max_position_embeddings=128, attn_implementation="eager")
+        hf = transformers.BertModel(cfg).eval()
+        paddle.seed(0)
+        ours = BertModel(bert_tiny())  # 2 layers
+        with pytest.raises(KeyError, match="trunk parameters"):
+            from_hf(ours, hf.state_dict())

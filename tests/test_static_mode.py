@@ -250,3 +250,35 @@ class TestStochasticGuards:
             assert any("SAME randomness" in str(i.message) for i in w)
         with pytest.raises(NotImplementedError, match="dropout"):
             main.clone(for_test=True)
+
+
+class TestReplaySafeShapes:
+    """Wrappers must derive shapes inside the op fn, not from the
+    build-time placeholder defaults (the flatten bug class)."""
+
+    def test_squeeze_expand_polymorphic(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 1, 4])
+            sq = paddle.squeeze(x, axis=1)          # squeezes dim 1
+            sq_all = paddle.squeeze(x)              # must NOT eat batch
+            ex = paddle.expand(paddle.unsqueeze(sq, 1), [-1, 3, -1])
+        exe = static.Executor()
+        a, b, c = exe.run(
+            main, feed={"x": np.zeros((32, 1, 4), "float32")},
+            fetch_list=[sq, sq_all, ex])
+        assert a.shape == (32, 4)
+        assert b.shape == (32, 4)
+        assert c.shape == (32, 3, 4)
+
+    def test_expand_as_symbolic_target(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 1])
+            y = static.data("y", [None, 5])
+            out = paddle.expand_as(x, y)
+        exe = static.Executor()
+        (v,) = exe.run(main, feed={
+            "x": np.ones((7, 1), "float32"),
+            "y": np.zeros((7, 5), "float32")}, fetch_list=[out])
+        assert v.shape == (7, 5)
