@@ -168,11 +168,13 @@ def load_hf_bert(model, state_dict, strict=True):
         # every TRUNK parameter must have been filled — a checkpoint
         # from a smaller config would otherwise leave deeper layers
         # silently random (llama's path raises the same way). The
-        # pooler is exempt: HF headed checkpoints are saved with
-        # add_pooling_layer=False, and heads don't read it.
+        # pooler is exempt only for HEADED models: HF headed
+        # checkpoints are saved with add_pooling_layer=False and heads
+        # don't read it — but a bare BertModel exposes pooled output,
+        # so there a missing pooler must error.
         missing_trunk = [n for n in own_trunk
                          if n not in filled_trunk
-                         and not n.startswith("pooler.")]
+                         and not (own_head and n.startswith("pooler."))]
         if missing_trunk:
             raise KeyError(
                 f"convert: checkpoint has no weights for trunk "
@@ -193,6 +195,91 @@ def load_hf_bert(model, state_dict, strict=True):
     return model
 
 
+# HF GPT2 key suffix -> this framework's GPT key suffix. GPT2's Conv1D
+# already stores [in, out], so projection weights do NOT transpose;
+# only the fused qkv needs a column permutation (see below).
+_GPT2_MAP = {
+    "wte.weight": "gpt.embedding.wte.weight",
+    "wpe.weight": "gpt.embedding.wpe.weight",
+    "ln_f.weight": "gpt.ln_f.weight",
+    "ln_f.bias": "gpt.ln_f.bias",
+}
+
+_GPT2_LAYER_MAP = {
+    "ln_1": "ln_1",
+    "attn.c_attn": "attn.qkv_proj",
+    "attn.c_proj": "attn.out_proj",
+    "ln_2": "ln_2",
+    "mlp.c_fc": "mlp.fc_in",
+    "mlp.c_proj": "mlp.fc_out",
+}
+
+
+def load_hf_gpt2(model, state_dict, strict=True):
+    """Load a HF GPT-2 state dict into ``GPTForCausalLM``.
+
+    The fused qkv layouts differ: GPT2's ``c_attn`` output columns are
+    component-major [3, nh, hd] (q block | k block | v block) while
+    this framework's ``qkv_proj`` is head-major [nh, 3, hd] (mp shards
+    heads, so the head factor must lead) — the conversion permutes the
+    fused columns; everything else maps by name."""
+    cfg = model.config
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+
+    def permute_qkv(arr, name):
+        # [..., 3*H] component-major -> head-major
+        if arr.shape[-1] != 3 * nh * hd:
+            raise ValueError(
+                f"convert: shape mismatch for {name!r}: checkpoint "
+                f"fused-qkv dim {arr.shape[-1]} vs model "
+                f"{3 * nh * hd} (3*nh*hd)")
+        lead = arr.shape[:-1]
+        a = arr.reshape(lead + (3, nh, hd))
+        a = np.moveaxis(a, -3, -2)  # (..., nh, 3, hd)
+        return a.reshape(lead + (3 * nh * hd,))
+
+    own = model.state_dict()
+    # GPTForCausalLM nests the trunk under "gpt."; a bare GPTModel's
+    # keys have no prefix — support both
+    prefix = "gpt." if any(k.startswith("gpt.") for k in own) else ""
+    used = set()
+    filled = set()
+    for k, v in state_dict.items():
+        key = k[len("transformer."):] if k.startswith("transformer.") \
+            else k
+        ours = _GPT2_MAP.get(key)
+        if ours is None and key.startswith("h."):
+            n, sub = key[2:].split(".", 1)
+            for hf, mine in _GPT2_LAYER_MAP.items():
+                if sub.startswith(hf + "."):
+                    leaf = sub[len(hf) + 1:]
+                    ours = f"gpt.h.{n}.{mine}.{leaf}"
+                    break
+        if ours is not None and not prefix:
+            ours = ours[len("gpt."):]
+        if ours is None or ours not in own:
+            continue
+        arr = _np(v)
+        if "qkv_proj" in ours:
+            arr = permute_qkv(arr, ours)
+        _assign(own[ours], arr, ours)
+        used.add(k)
+        filled.add(ours)
+    if strict:
+        skippable = ("attn.bias", "attn.masked_bias", "lm_head.weight")
+        leftovers = [k for k in state_dict if k not in used
+                     and not any(k.endswith(s) for s in skippable)]
+        if leftovers:
+            raise KeyError(f"convert: unmapped HF keys {leftovers[:5]}"
+                           f"{'...' if len(leftovers) > 5 else ''}")
+        missing = [n for n in own if n not in filled]
+        if missing:
+            raise KeyError(
+                f"convert: checkpoint has no weights for "
+                f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+    return model
+
+
 def from_hf(model, state_dict, strict=True):
     """Dispatch on the model family."""
     name = type(model).__name__
@@ -200,5 +287,8 @@ def from_hf(model, state_dict, strict=True):
         return load_hf_llama(model, state_dict, strict=strict)
     if name.startswith("Bert"):
         return load_hf_bert(model, state_dict, strict=strict)
+    if name.startswith("GPT"):
+        return load_hf_gpt2(model, state_dict, strict=strict)
     raise TypeError(
-        f"from_hf: no converter for {name} (supported: Llama*, Bert*)")
+        f"from_hf: no converter for {name} "
+        f"(supported: Llama*, Bert*, GPT*)")
