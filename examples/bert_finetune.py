@@ -1,0 +1,116 @@
+"""Fine-tune BERT for sequence classification — the classic downstream
+flow (reference analog: the ecosystem's glue fine-tune scripts).
+
+Demonstrates: the BERT family, optional HF checkpoint conversion,
+padding masks, AdamW with linear warmup-decay, and a compiled train
+step. Runs on CPU in ~a minute with the tiny config; pass --base to
+use bert_base shapes (TPU-scale).
+
+    python examples/bert_finetune.py
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as optim
+from paddle_tpu.models import BertForSequenceClassification, bert_tiny, \
+    bert_base
+
+
+def synthetic_task(n, seq, vocab, n_cls, seed=0):
+    """Toy classification task with signal: the label is determined by
+    which 'topic token' appears in the sequence."""
+    rng = np.random.RandomState(seed)
+    topics = rng.choice(np.arange(10, vocab), size=n_cls, replace=False)
+    ids = rng.randint(10, vocab, size=(n, seq))
+    labels = rng.randint(0, n_cls, size=n)
+    lengths = rng.randint(seq // 2, seq + 1, size=n)
+    mask = (np.arange(seq)[None, :] < lengths[:, None])
+    ids[~mask] = 0  # pad
+    # the topic token sits at position 0 (the [CLS] slot the pooler
+    # reads). A from-scratch post-norm encoder plateaus near chance for
+    # ~15 epochs then breaks through (the usual no-pretraining
+    # dynamics) — with a pretrained --hf-checkpoint convergence is
+    # immediate and the planted position wouldn't matter.
+    ids[:, 0] = topics[labels]
+    return (ids.astype("int64"), mask.astype("float32"),
+            labels.astype("int64"))
+
+
+def main(epochs=25, batch=16, base=False, hf_checkpoint=None,
+         min_accuracy=0.9):
+    cfg = (bert_base if base else bert_tiny)(num_labels=4)
+    paddle.seed(0)
+    model = BertForSequenceClassification(cfg)
+    if hf_checkpoint:
+        import torch
+
+        from paddle_tpu.models.convert import from_hf
+
+        from_hf(model, torch.load(hf_checkpoint,
+                                  map_location="cpu"), strict=False)
+
+    n_train, seq = 256, 32
+    if not 0 < batch <= n_train:
+        raise ValueError(
+            f"batch must be in [1, {n_train}], got {batch}")
+    ids, mask, labels = synthetic_task(
+        n_train, seq, cfg.vocab_size, cfg.num_labels)
+
+    steps_per_epoch = n_train // batch
+    sched = optim.lr.LinearWarmup(
+        optim.lr.PolynomialDecay(
+            1e-3, decay_steps=epochs * steps_per_epoch,
+            end_lr=0.0),
+        warmup_steps=steps_per_epoch // 2, start_lr=0.0, end_lr=1e-3)
+    opt = optim.AdamW(sched, parameters=model.parameters(),
+                      weight_decay=0.01)
+
+    @paddle.jit.to_static
+    def train_step(x, m, y):
+        _, loss = model(x, labels=y, attention_mask=m)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    epoch_losses = []
+    for epoch in range(epochs):
+        perm = np.random.RandomState(epoch).permutation(n_train)
+        tot = 0.0
+        for i in range(steps_per_epoch):
+            sl = perm[i * batch:(i + 1) * batch]
+            loss = train_step(
+                paddle.to_tensor(ids[sl]),
+                paddle.to_tensor(mask[sl]),
+                paddle.to_tensor(labels[sl]))
+            sched.step()
+            tot += float(np.asarray(loss._data))
+        epoch_losses.append(tot / steps_per_epoch)
+        print(f"epoch {epoch}: loss {epoch_losses[-1]:.4f}")
+
+    model.eval()
+    logits, _ = model(paddle.to_tensor(ids),
+                      attention_mask=paddle.to_tensor(mask))
+    acc = (logits.numpy().argmax(-1) == labels).mean()
+    print(f"train accuracy: {acc:.3f}")
+    if min_accuracy is not None:
+        assert acc > min_accuracy, \
+            "fine-tune failed to fit the planted signal"
+    return acc, epoch_losses
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base", action="store_true",
+                    help="bert_base shapes instead of tiny")
+    ap.add_argument("--epochs", type=int, default=25)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--hf-checkpoint", type=str, default=None,
+                    help="optional torch .pt/.bin state dict to load "
+                    "via models.convert.from_hf")
+    a = ap.parse_args()
+    main(epochs=a.epochs, batch=a.batch, base=a.base,
+         hf_checkpoint=a.hf_checkpoint,
+         min_accuracy=0.9 if a.epochs >= 15 else None)
