@@ -24,6 +24,24 @@ def _np(t):
     return np.asarray(t)
 
 
+def _strict_report(state_dict, used, own, filled, skippable=(),
+                   exempt=None):
+    """Shared strict-mode contract: every checkpoint key is accounted
+    for (minus ``skippable`` substrings) and every model parameter got
+    weights (minus keys the ``exempt`` predicate waves through)."""
+    leftovers = [k for k in state_dict if k not in used
+                 and not any(s in k for s in skippable)]
+    if leftovers:
+        raise KeyError(f"convert: unmapped HF keys {leftovers[:5]}"
+                       f"{'...' if len(leftovers) > 5 else ''}")
+    missing = [n for n in own if n not in filled
+               and not (exempt and exempt(n))]
+    if missing:
+        raise KeyError(
+            f"convert: checkpoint has no weights for "
+            f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+
+
 def _assign(param, arr, name):
     arr = np.asarray(arr)
     want = tuple(param.shape)
@@ -266,17 +284,110 @@ def load_hf_gpt2(model, state_dict, strict=True):
         used.add(k)
         filled.add(ours)
     if strict:
-        skippable = ("attn.bias", "attn.masked_bias", "lm_head.weight")
-        leftovers = [k for k in state_dict if k not in used
-                     and not any(k.endswith(s) for s in skippable)]
-        if leftovers:
-            raise KeyError(f"convert: unmapped HF keys {leftovers[:5]}"
-                           f"{'...' if len(leftovers) > 5 else ''}")
-        missing = [n for n in own if n not in filled]
-        if missing:
+        _strict_report(
+            state_dict, used, own, filled,
+            skippable=("attn.bias", "attn.masked_bias",
+                       "lm_head.weight"))
+    return model
+
+
+# HF ViTModel key (modulo "vit." prefix) -> VisionTransformer key.
+_VIT_MAP = {
+    "embeddings.cls_token": "cls_token",
+    "embeddings.position_embeddings": "pos_embed",
+    "embeddings.patch_embeddings.projection.weight":
+        "patch_embed.proj.weight",
+    "embeddings.patch_embeddings.projection.bias":
+        "patch_embed.proj.bias",
+    "layernorm.weight": "norm.weight",
+    "layernorm.bias": "norm.bias",
+    "classifier.weight": "head.weight",
+    "classifier.bias": "head.bias",
+}
+
+_VIT_LAYER_MAP = {
+    "layernorm_before": "norm1",
+    "layernorm_after": "norm2",
+    "attention.output.dense": "attn.proj",
+    "intermediate.dense": "mlp.fc1",
+    "output.dense": "mlp.fc2",
+}
+
+
+def load_hf_vit(model, state_dict, strict=True):
+    """Load a HF ViT state dict into ``VisionTransformer``.
+
+    HF keeps separate query/key/value projections; this framework's
+    ViT fuses them as Linear(dim, 3*dim) with component-major output
+    columns (q | k | v), so the three HF weights concatenate (after
+    the usual [out,in] -> [in,out] transpose). Conv patch embedding
+    keeps torch's [out,in,kh,kw] layout."""
+    own = model.state_dict()
+    used = set()
+    filled = set()
+    qkv_parts = {}  # (layer, 'weight'|'bias') -> {comp: arr}
+    for k, v in state_dict.items():
+        key = k[len("vit."):] if k.startswith("vit.") else k
+        arr = _np(v)
+        if key.startswith("encoder.layer."):
+            rest = key[len("encoder.layer."):]
+            n, sub = rest.split(".", 1)
+            qkv_hit = False
+            for comp in ("query", "key", "value"):
+                pre = f"attention.attention.{comp}."
+                if sub.startswith(pre):
+                    leaf = sub[len(pre):]
+                    # only mark used if the target layer exists —
+                    # stray layers must still trip the strict check
+                    if f"blocks.{n}.attn.qkv.{leaf}" in own:
+                        qkv_parts.setdefault((n, leaf), {})[comp] = arr
+                        used.add(k)
+                    qkv_hit = True
+                    break
+            if qkv_hit:
+                continue
+            ours = None
+            for hf, mine in _VIT_LAYER_MAP.items():
+                if sub.startswith(hf + "."):
+                    ours = f"blocks.{n}.{mine}.{sub[len(hf) + 1:]}"
+                    break
+            if ours is None or ours not in own:
+                continue
+            if ours.endswith(".weight") and arr.ndim == 2:
+                arr = arr.T
+            _assign(own[ours], arr, ours)
+            used.add(k)
+            filled.add(ours)
+            continue
+        ours = _VIT_MAP.get(key)
+        if ours is None or ours not in own:
+            continue
+        if ours == "head.weight":
+            arr = arr.T
+        _assign(own[ours], arr, ours)
+        used.add(k)
+        filled.add(ours)
+    for (n, leaf), parts in qkv_parts.items():
+        ours = f"blocks.{n}.attn.qkv.{leaf}"
+        if ours not in own:
+            continue
+        if set(parts) != {"query", "key", "value"}:
             raise KeyError(
-                f"convert: checkpoint has no weights for "
-                f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+                f"convert: incomplete qkv for layer {n} "
+                f"({sorted(parts)})")
+        if leaf == "weight":
+            arr = np.concatenate(
+                [parts["query"].T, parts["key"].T, parts["value"].T],
+                axis=1)
+        else:
+            arr = np.concatenate(
+                [parts["query"], parts["key"], parts["value"]])
+        _assign(own[ours], arr, ours)
+        filled.add(ours)
+    if strict:
+        _strict_report(
+            state_dict, used, own, filled, skippable=("pooler.",),
+            exempt=lambda n: n.startswith("head."))
     return model
 
 
@@ -289,6 +400,8 @@ def from_hf(model, state_dict, strict=True):
         return load_hf_bert(model, state_dict, strict=strict)
     if name.startswith("GPT"):
         return load_hf_gpt2(model, state_dict, strict=strict)
+    if name in ("VisionTransformer",) or name.startswith("ViT"):
+        return load_hf_vit(model, state_dict, strict=strict)
     raise TypeError(
         f"from_hf: no converter for {name} "
-        f"(supported: Llama*, Bert*, GPT*)")
+        f"(supported: Llama*, Bert*, GPT*, VisionTransformer)")

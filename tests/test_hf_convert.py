@@ -212,3 +212,29 @@ class TestGPT2Parity:
                                         intermediate_size=256))
         with pytest.raises(ValueError, match="shape mismatch"):
             from_hf(small, hf.state_dict())
+
+
+class TestViTParity:
+    def test_logits_match_transformers(self):
+        from paddle_tpu.vision.models.vit import VisionTransformer
+
+        cfg = transformers.ViTConfig(
+            hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=128, image_size=32, patch_size=8,
+            num_channels=3, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0, layer_norm_eps=1e-6,
+            attn_implementation="eager")
+        torch.manual_seed(3)
+        hf = transformers.ViTForImageClassification(cfg).eval()
+        # HF num_labels defaults to 2
+        paddle.seed(0)
+        ours = VisionTransformer(
+            img_size=32, patch_size=8, num_classes=2, embed_dim=64,
+            depth=2, num_heads=4, mlp_ratio=2.0, epsilon=1e-6)
+        ours.eval()
+        from_hf(ours, hf.state_dict())
+        x = np.random.RandomState(0).randn(2, 3, 32, 32).astype("float32")
+        with torch.no_grad():
+            ref = hf(torch.tensor(x)).logits.numpy()
+        got = ours(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
