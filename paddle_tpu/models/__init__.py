@@ -13,6 +13,14 @@ this framework ships the acceptance-config model families in-tree:
 from . import llama
 from . import gpt
 from . import bert
+from . import t5
+from .t5 import (
+    T5Config,
+    T5ForConditionalGeneration,
+    t5_base,
+    t5_small,
+    t5_tiny,
+)
 from .bert import (
     BertConfig,
     BertForMaskedLM,

@@ -24,13 +24,14 @@ def _np(t):
     return np.asarray(t)
 
 
-def _strict_report(state_dict, used, own, filled, skippable=(),
+def _strict_report(state_dict, used, own, filled, skip=None,
                    exempt=None):
     """Shared strict-mode contract: every checkpoint key is accounted
-    for (minus ``skippable`` substrings) and every model parameter got
-    weights (minus keys the ``exempt`` predicate waves through)."""
+    for (minus keys the ``skip`` predicate waves through) and every
+    model parameter got weights (minus keys the ``exempt`` predicate
+    waves through)."""
     leftovers = [k for k in state_dict if k not in used
-                 and not any(s in k for s in skippable)]
+                 and not (skip and skip(k))]
     if leftovers:
         raise KeyError(f"convert: unmapped HF keys {leftovers[:5]}"
                        f"{'...' if len(leftovers) > 5 else ''}")
@@ -286,8 +287,8 @@ def load_hf_gpt2(model, state_dict, strict=True):
     if strict:
         _strict_report(
             state_dict, used, own, filled,
-            skippable=("attn.bias", "attn.masked_bias",
-                       "lm_head.weight"))
+            skip=lambda k: k.endswith(
+                ("attn.bias", "attn.masked_bias", "lm_head.weight")))
     return model
 
 
@@ -386,8 +387,95 @@ def load_hf_vit(model, state_dict, strict=True):
         filled.add(ours)
     if strict:
         _strict_report(
-            state_dict, used, own, filled, skippable=("pooler.",),
+            state_dict, used, own, filled,
+            skip=lambda k: "pooler." in k,
             exempt=lambda n: n.startswith("head."))
+    return model
+
+
+# HF T5 sub-layer key -> this framework's T5Block attribute, per
+# stack. layer.0 = self-attention everywhere; layer.1 is cross-attn in
+# the decoder but the FF in the encoder; layer.2 is the decoder FF.
+def _t5_sub_map(is_decoder):
+    m = {
+        "layer.0.SelfAttention.q": "self_attn.q",
+        "layer.0.SelfAttention.k": "self_attn.k",
+        "layer.0.SelfAttention.v": "self_attn.v",
+        "layer.0.SelfAttention.o": "self_attn.o",
+        "layer.0.SelfAttention.relative_attention_bias":
+            "self_attn.relative_attention_bias",
+        "layer.0.layer_norm": "self_norm",
+    }
+    ff = "layer.2" if is_decoder else "layer.1"
+    if is_decoder:
+        m.update({
+            "layer.1.EncDecAttention.q": "cross_attn.q",
+            "layer.1.EncDecAttention.k": "cross_attn.k",
+            "layer.1.EncDecAttention.v": "cross_attn.v",
+            "layer.1.EncDecAttention.o": "cross_attn.o",
+            "layer.1.layer_norm": "cross_norm",
+        })
+    m.update({
+        f"{ff}.DenseReluDense.wi": "ff.wi",
+        f"{ff}.DenseReluDense.wi_0": "ff.wi_0",
+        f"{ff}.DenseReluDense.wi_1": "ff.wi_1",
+        f"{ff}.DenseReluDense.wo": "ff.wo",
+        f"{ff}.layer_norm": "ff_norm",
+    })
+    return m
+
+
+def load_hf_t5(model, state_dict, strict=True):
+    """Load a HF T5 state dict into ``T5ForConditionalGeneration``.
+
+    Linear weights transpose ([out,in] -> [in,out]); the relative
+    bias tables and norms copy as-is; ``lm_head.weight`` transfers
+    only for untied configs."""
+    own = model.state_dict()
+    used = set()
+    filled = set()
+    sub_maps = {"encoder": _t5_sub_map(False),
+                "decoder": _t5_sub_map(True)}
+    for k, v in state_dict.items():
+        ours = None
+        if k == "shared.weight":
+            ours = "shared.weight"
+        elif k in ("encoder.embed_tokens.weight",
+                   "decoder.embed_tokens.weight"):
+            used.add(k)  # alias of shared
+            continue
+        elif k == "lm_head.weight":
+            if "lm_head.weight" not in own:
+                used.add(k)  # tied: the head reads shared
+                continue
+            ours = "lm_head.weight"
+        elif k.endswith("final_layer_norm.weight"):
+            stack = k.split(".")[0]
+            ours = f"{stack}.final_norm.weight"
+        else:
+            for stack, smap in sub_maps.items():
+                pre = f"{stack}.block."
+                if not k.startswith(pre):
+                    continue
+                n, sub = k[len(pre):].split(".", 1)
+                for hf, mine in smap.items():
+                    if sub.startswith(hf + "."):
+                        leaf = sub[len(hf) + 1:]
+                        ours = f"{stack}.block_{n}.{mine}.{leaf}"
+                        break
+                break
+        if ours is None or ours not in own:
+            continue
+        arr = _np(v)
+        if arr.ndim == 2 and not (
+            "shared" in ours or "relative_attention_bias" in ours
+        ):
+            arr = arr.T
+        _assign(own[ours], arr, ours)
+        used.add(k)
+        filled.add(ours)
+    if strict:
+        _strict_report(state_dict, used, own, filled)
     return model
 
 
@@ -402,6 +490,8 @@ def from_hf(model, state_dict, strict=True):
         return load_hf_gpt2(model, state_dict, strict=strict)
     if name in ("VisionTransformer",) or name.startswith("ViT"):
         return load_hf_vit(model, state_dict, strict=strict)
+    if name.startswith("T5"):
+        return load_hf_t5(model, state_dict, strict=strict)
     raise TypeError(
         f"from_hf: no converter for {name} "
-        f"(supported: Llama*, Bert*, GPT*, VisionTransformer)")
+        f"(supported: Llama*, Bert*, GPT*, VisionTransformer, T5*)")

@@ -1,0 +1,402 @@
+"""T5 encoder-decoder family (upstream analog: the reference
+ecosystem's T5 implementation on the same TP primitives).
+
+Completes the architecture matrix next to Llama (decoder-only) and
+BERT (encoder-only): bidirectional encoder + causal decoder with
+cross-attention, T5's bucketed relative position bias (shared across
+layers, one table per stack), pre-RMSNorm blocks (T5LayerNorm == RMS),
+no biases anywhere, tied shared embedding with the 1/sqrt(d) logit
+scaling of the original checkpoints, and both the v1.0 relu MLP and
+the v1.1 gated-gelu MLP.
+
+TPU-native notes: the relative position bias is an additive (1, H, Sq,
+Sk) mask, so attention takes the masked dense sdpa path (the bias must
+be materialized either way); everything else is static-shape and
+jittable. ``generate`` re-runs the full decoder prefix each step
+(O(n²) in decode length — simple and correct; the KV-cached O(n)
+incremental path is the decoder-only families' domain).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op, no_grad
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import RMSNorm
+from ..nn.layer.common import Dropout, Linear, Embedding
+
+
+@dataclass
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: int = None
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    dropout_rate: float = 0.1
+    layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"  # or "gated-gelu" (v1.1)
+    tie_word_embeddings: bool = True
+    decoder_start_token_id: int = 0
+    pad_token_id: int = 0
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.num_decoder_layers is None:
+            self.num_decoder_layers = self.num_layers
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.d_kv
+
+
+def t5_small(**kw) -> T5Config:
+    return T5Config(**kw)
+
+
+def t5_base(**kw) -> T5Config:
+    kw.setdefault("d_model", 768)
+    kw.setdefault("d_ff", 3072)
+    kw.setdefault("num_layers", 12)
+    kw.setdefault("num_heads", 12)
+    return T5Config(**kw)
+
+
+def t5_tiny(**kw) -> T5Config:
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("d_model", 64)
+    kw.setdefault("d_kv", 16)
+    kw.setdefault("d_ff", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("dropout_rate", 0.0)
+    return T5Config(**kw)
+
+
+def _relative_position_bucket(rel, bidirectional, num_buckets,
+                              max_distance):
+    """T5's log-bucketed relative positions (exact reference math)."""
+    ret = 0
+    if bidirectional:
+        num_buckets //= 2
+        ret += (rel > 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(rel)
+    else:
+        n = jnp.maximum(-rel, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+class T5Attention(Layer):
+    """Multi-head attention without biases; the FIRST layer of each
+    stack owns the shared relative-position-bias table."""
+
+    def __init__(self, config: T5Config, has_bias_table=False):
+        super().__init__()
+        self.cfg = config
+        self.num_heads = config.num_heads
+        self.d_kv = config.d_kv
+        inner = config.inner_dim
+        self.q = Linear(config.d_model, inner, bias_attr=False)
+        self.k = Linear(config.d_model, inner, bias_attr=False)
+        self.v = Linear(config.d_model, inner, bias_attr=False)
+        self.o = Linear(inner, config.d_model, bias_attr=False)
+        self.relative_attention_bias = (
+            Embedding(config.relative_attention_num_buckets,
+                      config.num_heads)
+            if has_bias_table else None
+        )
+        self.dropout_rate = config.dropout_rate
+
+    def compute_bias(self, q_len, k_len, bidirectional):
+        """(1, H, Sq, Sk) additive bias from the bucketed table."""
+        table = self.relative_attention_bias.weight
+
+        def f(w):
+            ctx = jnp.arange(q_len)[:, None]
+            mem = jnp.arange(k_len)[None, :]
+            bucket = _relative_position_bucket(
+                mem - ctx, bidirectional,
+                self.cfg.relative_attention_num_buckets,
+                self.cfg.relative_attention_max_distance)
+            bias = w[bucket]                        # (Sq, Sk, H)
+            return jnp.transpose(bias, (2, 0, 1))[None]
+
+        return apply_op("t5_rel_bias", f, table)
+
+    def forward(self, x, kv=None, position_bias=None, mask=None):
+        """kv: cross-attention memory (defaults to x). position_bias /
+        mask are additive (broadcastable to (B, H, Sq, Sk))."""
+        b, sq = x.shape[0], x.shape[1]
+        mem = kv if kv is not None else x
+        sk = mem.shape[1]
+        nh, dk = self.num_heads, self.d_kv
+        q = self.q(x).reshape([b, sq, nh, dk])
+        k = self.k(mem).reshape([b, sk, nh, dk])
+        v = self.v(mem).reshape([b, sk, nh, dk])
+
+        add = None
+        if position_bias is not None and mask is not None:
+            add = position_bias + mask
+        elif position_bias is not None:
+            add = position_bias
+        elif mask is not None:
+            add = mask
+
+        drop = self.dropout_rate if self.training else 0.0
+        drop_key = None
+        if drop:
+            from ..framework.random import next_key
+
+            drop_key = next_key()
+
+        def attend(qr, kr, vr, *rest):
+            # T5 does NOT scale by sqrt(d_kv)
+            s = jnp.einsum("bqhd,bkhd->bhqk", qr.astype(jnp.float32),
+                           kr.astype(jnp.float32))
+            if rest:
+                s = s + rest[0].astype(jnp.float32)
+            p = jax.nn.softmax(s, axis=-1)
+            if drop:
+                # reference drops the softmaxed attention weights
+                keep = jax.random.bernoulli(drop_key, 1.0 - drop,
+                                            p.shape)
+                p = jnp.where(keep, p / (1.0 - drop), 0.0)
+            out = jnp.einsum("bhqk,bkhd->bqhd", p,
+                             vr.astype(jnp.float32))
+            return out.astype(qr.dtype).reshape(b, sq, nh * dk)
+
+        args = [q, k, v] + ([add] if add is not None else [])
+        out = apply_op("t5_attention", attend, *args)
+        return self.o(out)
+
+
+class T5FF(Layer):
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.gated = "gated" in config.feed_forward_proj
+        act = config.feed_forward_proj.split("-")[-1]
+        self.act_name = "relu" if act == "relu" else act
+        if self.gated:
+            self.wi_0 = Linear(config.d_model, config.d_ff,
+                               bias_attr=False)
+            self.wi_1 = Linear(config.d_model, config.d_ff,
+                               bias_attr=False)
+        else:
+            self.wi = Linear(config.d_model, config.d_ff,
+                             bias_attr=False)
+        self.wo = Linear(config.d_ff, config.d_model, bias_attr=False)
+        self.dropout = Dropout(config.dropout_rate)
+
+    def _act(self, x):
+        if self.act_name == "gelu":
+            # T5 v1.1 uses the tanh-approx gelu (HF NewGELUActivation)
+            return F.gelu(x, approximate=True)
+        return getattr(F, self.act_name)(x)
+
+    def forward(self, x):
+        if self.gated:
+            h = self._act(self.wi_0(x)) * self.wi_1(x)
+        else:
+            h = self._act(self.wi(x))
+        # reference drops inside the FF, between activation and wo
+        return self.wo(self.dropout(h))
+
+
+class T5Block(Layer):
+    def __init__(self, config: T5Config, is_decoder,
+                 has_bias_table=False):
+        super().__init__()
+        self.is_decoder = is_decoder
+        eps = config.layer_norm_epsilon
+        self.self_norm = RMSNorm(config.d_model, epsilon=eps)
+        self.self_attn = T5Attention(config, has_bias_table)
+        if is_decoder:
+            self.cross_norm = RMSNorm(config.d_model, epsilon=eps)
+            self.cross_attn = T5Attention(config)
+        self.ff_norm = RMSNorm(config.d_model, epsilon=eps)
+        self.ff = T5FF(config)
+        self.dropout = Dropout(config.dropout_rate)
+
+    def forward(self, x, enc=None, self_bias=None, self_mask=None,
+                cross_mask=None):
+        a = self.self_attn(self.self_norm(x), position_bias=self_bias,
+                           mask=self_mask)
+        x = x + self.dropout(a)
+        if self.is_decoder:
+            c = self.cross_attn(self.cross_norm(x), kv=enc,
+                                mask=cross_mask)
+            x = x + self.dropout(c)
+        return x + self.dropout(self.ff(self.ff_norm(x)))
+
+
+def _causal_mask(s):
+    m = jnp.tril(jnp.ones((s, s), bool))
+    return jnp.where(m, 0.0, -1e30)[None, None]
+
+
+def _pad_mask(mask_arr):
+    return (1.0 - mask_arr.astype(jnp.float32))[:, None, None, :] * -1e30
+
+
+class T5Stack(Layer):
+    def __init__(self, config: T5Config, is_decoder, embed):
+        super().__init__()
+        self.cfg = config
+        self.is_decoder = is_decoder
+        self.embed = embed
+        n = config.num_decoder_layers if is_decoder else config.num_layers
+        self.blocks = [
+            T5Block(config, is_decoder, has_bias_table=(i == 0))
+            for i in range(n)
+        ]
+        for i, blk in enumerate(self.blocks):
+            self.add_sublayer(f"block_{i}", blk)
+        self.final_norm = RMSNorm(config.d_model,
+                                  epsilon=config.layer_norm_epsilon)
+        self.dropout = Dropout(config.dropout_rate)
+
+    def forward(self, ids, enc=None, attention_mask=None,
+                enc_attention_mask=None):
+        s = ids.shape[1]
+        x = self.dropout(self.embed(ids))
+        bias = self.blocks[0].self_attn.compute_bias(
+            s, s, bidirectional=not self.is_decoder)
+        self_mask = None
+        if self.is_decoder:
+            self_mask = apply_op(
+                "t5_causal_mask", lambda i: _causal_mask(s), ids,
+                differentiable=False)
+        if attention_mask is not None:
+            pm = apply_op("t5_pad_mask", _pad_mask, attention_mask,
+                          differentiable=False)
+            self_mask = pm if self_mask is None else self_mask + pm
+        cross_mask = None
+        if enc_attention_mask is not None:
+            cross_mask = apply_op(
+                "t5_cross_mask", _pad_mask, enc_attention_mask,
+                differentiable=False)
+        for blk in self.blocks:
+            x = blk(x, enc=enc, self_bias=bias, self_mask=self_mask,
+                    cross_mask=cross_mask)
+        return self.dropout(self.final_norm(x))
+
+
+class T5ForConditionalGeneration(Layer):
+    """Seq2seq LM: shared embedding, encoder + decoder stacks, tied (or
+    separate) lm head with the original T5 1/sqrt(d_model) scaling when
+    tied."""
+
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.config = config
+        self.shared = Embedding(config.vocab_size, config.d_model)
+        self.encoder = T5Stack(config, is_decoder=False,
+                               embed=self.shared)
+        self.decoder = T5Stack(config, is_decoder=True,
+                               embed=self.shared)
+        self.lm_head = (
+            None if config.tie_word_embeddings
+            else Linear(config.d_model, config.vocab_size,
+                        bias_attr=False))
+
+    def encode(self, input_ids, attention_mask=None):
+        return self.encoder(input_ids, attention_mask=attention_mask)
+
+    def _head(self, h):
+        if self.lm_head is not None:
+            return self.lm_head(h)
+        w = self.shared.weight
+        scale = self.config.d_model ** -0.5
+
+        def f(a, ww):
+            return (a.astype(jnp.float32) * scale) @ \
+                ww.astype(jnp.float32).T
+
+        return apply_op("t5_tied_head", f, h, w)
+
+    def forward(self, input_ids, decoder_input_ids=None, labels=None,
+                attention_mask=None, decoder_attention_mask=None):
+        """With ``labels`` (and no decoder_input_ids), the decoder
+        input is the right-shifted labels (reference semantics);
+        returns (logits, loss) with -100 ignored."""
+        if decoder_input_ids is None:
+            if labels is None:
+                raise ValueError(
+                    "T5 forward needs decoder_input_ids or labels")
+            start = self.config.decoder_start_token_id
+            pad = self.config.pad_token_id
+            decoder_input_ids = apply_op(
+                "t5_shift_right",
+                lambda l: jnp.concatenate(
+                    [jnp.full((l.shape[0], 1), start, l.dtype),
+                     jnp.where(l[:, :-1] == -100, pad, l[:, :-1])],
+                    axis=1),
+                labels, differentiable=False)
+        enc = self.encode(input_ids, attention_mask)
+        h = self.decoder(decoder_input_ids, enc=enc,
+                         attention_mask=decoder_attention_mask,
+                         enc_attention_mask=attention_mask)
+        logits = self._head(h)
+        if labels is None:
+            return logits, None
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]), ignore_index=-100)
+        return logits, loss
+
+    def generate(self, input_ids, max_new_tokens=32,
+                 attention_mask=None, eos_token_id=1):
+        """Greedy seq2seq decode: encode once, then grow the decoder
+        sequence token by token (full-prefix decoder re-run per step —
+        correct and simple; the KV-cached incremental path is the
+        decoder-only families' domain). Returns the generated ids
+        INCLUDING the leading decoder_start token."""
+        from ..tensor.creation import to_tensor
+        from ..tensor.manipulation import concat
+
+        with no_grad():
+            b = input_ids.shape[0]
+            enc = self.encode(input_ids, attention_mask)
+            cross_mask = attention_mask
+            cur = to_tensor(np.full(
+                (b, 1), self.config.decoder_start_token_id, np.int32))
+            done = to_tensor(np.zeros((b,), bool))
+            for _ in range(max_new_tokens):
+                h = self.decoder(cur, enc=enc,
+                                 enc_attention_mask=cross_mask)
+                logits = self._head(h)
+
+                pad = self.config.pad_token_id
+
+                def pick(l, dn):
+                    nxt = jnp.argmax(
+                        l[:, -1].astype(jnp.float32), axis=-1
+                    ).astype(jnp.int32)
+                    # finished rows pad with pad_token_id (reference
+                    # semantics), and padding must not re-trigger eos
+                    new_done = dn | (nxt == eos_token_id)
+                    nxt = jnp.where(dn, pad, nxt)
+                    return nxt[:, None], new_done
+
+                nxt, done = apply_op("t5_pick", pick, logits, done,
+                                     n_outs=2, differentiable=False)
+                cur = concat([cur, nxt], axis=1)
+            return cur
