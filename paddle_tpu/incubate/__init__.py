@@ -4,3 +4,146 @@ from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+
+
+def _num_segments(segment_ids, explicit=None):
+    """Output row count: paddle infers max(ids)+1 from the data — a
+    host-side read, so under jit tracing pass the count explicitly
+    (out_size / the trace sees a concrete upper bound)."""
+    if explicit is not None:
+        return int(explicit)
+    raw = segment_ids._data
+    if isinstance(raw, jax.core.Tracer):
+        raise ValueError(
+            "segment reduction under jit needs an explicit out_size "
+            "(the reference infers max(segment_ids)+1 from data, which "
+            "is not traceable)")
+    return int(jnp.max(raw)) + 1 if raw.size else 0
+
+
+def _segment_reduce(name, jax_fn, mask_untouched):
+    def op(data, segment_ids, out_size=None):
+        data = _as_tensor(data)
+        segment_ids = _as_tensor(segment_ids)
+        n = _num_segments(segment_ids, out_size)
+
+        def f(a, ids):
+            ids = ids.astype(jnp.int32)
+            out = jax_fn(a, ids, num_segments=n)
+            if mask_untouched:
+                # reference semantics: empty segments yield 0, not the
+                # reduction's identity (+-inf for max/min)
+                touched = jax.ops.segment_sum(
+                    jnp.ones((a.shape[0],), jnp.float32), ids,
+                    num_segments=n) > 0
+                out = jnp.where(
+                    touched[(...,) + (None,) * (a.ndim - 1)], out, 0)
+            return out
+
+        return apply_op(name, f, data, segment_ids)
+
+    op.__name__ = name
+    op.__doc__ = (
+        f"Segment {name.split('_')[1]} over rows of ``data`` grouped "
+        f"by ``segment_ids`` (upstream paddle.incubate.{name}; CUDA "
+        f"kernel paddle/phi/kernels/gpu/segment_pool_kernel.cu). "
+        f"Empty segments yield 0.")
+    return op
+
+
+segment_sum = _segment_reduce("segment_sum", jax.ops.segment_sum, False)
+segment_max = _segment_reduce("segment_max", jax.ops.segment_max, True)
+segment_min = _segment_reduce("segment_min", jax.ops.segment_min, True)
+
+
+def segment_mean(data, segment_ids, out_size=None):
+    """Segment mean (empty segments yield 0), upstream
+    paddle.incubate.segment_mean."""
+    data = _as_tensor(data)
+    segment_ids = _as_tensor(segment_ids)
+    n = _num_segments(segment_ids, out_size)
+
+    def f(a, ids):
+        ids = ids.astype(jnp.int32)
+        s = jax.ops.segment_sum(a.astype(jnp.float32), ids,
+                                num_segments=n)
+        c = jax.ops.segment_sum(
+            jnp.ones((a.shape[0],), jnp.float32), ids, num_segments=n)
+        return (s / jnp.maximum(c, 1.0)[
+            (...,) + (None,) * (a.ndim - 1)]).astype(a.dtype)
+
+    return apply_op("segment_mean", f, data, segment_ids)
+
+
+def graph_send_recv(x, src_index, dst_index, reduce_op="sum",
+                    out_size=None, name=None):
+    """Message passing: gather rows of x at ``src_index``, reduce them
+    into ``dst_index`` slots (upstream paddle.incubate.graph_send_recv
+    / paddle.geometric.send_u_recv)."""
+    x = _as_tensor(x)
+    src_index = _as_tensor(src_index)
+    dst_index = _as_tensor(dst_index)
+    kind = reduce_op.lower()
+    if kind not in ("sum", "mean", "max", "min"):
+        raise ValueError(
+            f"graph_send_recv: unknown reduce_op {reduce_op!r}")
+    n = int(out_size) if out_size is not None else x.shape[0]
+    jax_fn = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+              "min": jax.ops.segment_min}.get(kind)
+
+    def f(a, si, di):
+        msgs = a[si.astype(jnp.int32)]
+        di = di.astype(jnp.int32)
+        if kind == "mean":
+            s = jax.ops.segment_sum(msgs.astype(jnp.float32), di,
+                                    num_segments=n)
+            c = jax.ops.segment_sum(
+                jnp.ones((msgs.shape[0],), jnp.float32), di,
+                num_segments=n)
+            return (s / jnp.maximum(c, 1.0)[
+                (...,) + (None,) * (a.ndim - 1)]).astype(a.dtype)
+        out = jax_fn(msgs, di, num_segments=n)
+        if kind in ("max", "min"):
+            # reference yields 0 for untouched slots, not +-inf
+            touched = jax.ops.segment_sum(
+                jnp.ones((msgs.shape[0],), jnp.float32), di,
+                num_segments=n) > 0
+            out = jnp.where(
+                touched[(...,) + (None,) * (a.ndim - 1)], out, 0)
+        return out
+
+    return apply_op("graph_send_recv", f, x, src_index, dst_index)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one op (upstream:
+    paddle.incubate.softmax_mask_fuse, CUDA kernel
+    paddle/fluid/operators/fused_softmax_mask_op.cu — on TPU, XLA
+    fuses the add into the softmax; the API exists for parity)."""
+    x, mask = _as_tensor(x), _as_tensor(mask)
+    return apply_op(
+        "softmax_mask_fuse",
+        lambda a, m: jax.nn.softmax(
+            a.astype(jnp.float32) + m.astype(jnp.float32), axis=-1
+        ).astype(a.dtype),
+        x, mask)
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss (upstream paddle.incubate.identity_loss:
+    used by custom-loss graphs; reduction none/sum/mean, with the
+    reference's integer codes sum=0, mean=1, none=2)."""
+    x = _as_tensor(x)
+    if reduction in ("none", 2):
+        return apply_op("identity_loss", lambda a: a, x)
+    if reduction in ("sum", 0):
+        return apply_op("identity_loss", lambda a: jnp.sum(a), x)
+    if reduction in ("mean", 1):
+        return apply_op("identity_loss", lambda a: jnp.mean(a), x)
+    raise ValueError(f"identity_loss: unknown reduction {reduction!r}")

@@ -449,6 +449,66 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
         "rnnt_loss", f, input, label, input_lengths, label_lengths)
 
 
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (upstream: paddle/phi/kernels/impl/
+    hierarchical_sigmoid_kernel_impl.h over MatrixBitCodeFunctor).
+
+    Default tree: paddle's SimpleCode heap layout — for class c, code =
+    c + num_classes; the node visited at depth d is (code >> (d+1)) - 1
+    and the target bit is (code >> d) & 1; path length is
+    floor(log2(code)). Variable path lengths become a static
+    [N, max_depth] mask (TPU-friendly). Custom trees pass
+    ``path_table``/``path_code`` with -1 padding. Returns [N, 1]
+    per-sample summed BCE over the path."""
+    input = _as_tensor(input)
+    label = _as_tensor(label)
+    weight = _as_tensor(weight)
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(_as_tensor(bias))
+    custom = path_table is not None
+    if custom:
+        if path_code is None:
+            raise ValueError(
+                "hsigmoid_loss: path_table needs path_code")
+        args.append(_as_tensor(path_table))
+        args.append(_as_tensor(path_code))
+    has_bias = bias is not None
+    c = int(num_classes)
+    # static max depth of the SimpleCode heap: code < 2*num_classes,
+    # so paths have at most bit_length(2c - 1) - 1 edges
+    max_d = max(1, (2 * c - 1).bit_length() - 1)
+
+    def f(x, lab, w, *rest):
+        b_ = rest[0] if has_bias else None
+        if custom:
+            table = rest[-2].astype(jnp.int32)   # (N, L)
+            code = rest[-1].astype(jnp.float32)  # (N, L)
+            valid = table >= 0
+            idx = jnp.maximum(table, 0)
+        else:
+            heap = lab.astype(jnp.int32) + c     # (N,)
+            d = jnp.arange(max_d, dtype=jnp.int32)
+            idx = (heap[:, None] >> (d[None, :] + 1)) - 1   # (N, L)
+            code = ((heap[:, None] >> d[None, :]) & 1
+                    ).astype(jnp.float32)
+            valid = (heap[:, None] >> (d[None, :] + 1)) > 0
+            idx = jnp.maximum(idx, 0)
+        wrows = w[idx]                           # (N, L, D)
+        z = jnp.einsum("nd,nld->nl", x.astype(jnp.float32),
+                       wrows.astype(jnp.float32))
+        if b_ is not None:
+            z = z + b_[idx].astype(jnp.float32)
+        bce = jnp.maximum(z, 0) - z * code + jnp.log1p(
+            jnp.exp(-jnp.abs(z)))
+        return jnp.sum(jnp.where(valid, bce, 0.0),
+                       axis=1, keepdims=True)
+
+    return apply_op("hsigmoid_loss", f, *args)
+
+
 def square_error_cost(input, label):
     input, label = _as_tensor(input), _as_tensor(label)
     return apply_op(

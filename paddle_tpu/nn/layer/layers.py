@@ -21,6 +21,33 @@ class HookRemoveHelper:
         self._hooks.pop(self._hook_id, None)
 
 
+def make_parameter(shape, dtype="float32", name=None, attr=None,
+                   is_bias=False, default_initializer=None):
+    """Single definition of the ParamAttr/initializer wiring behind
+    both ``Layer.create_parameter`` and the standalone
+    ``paddle.create_parameter``."""
+    from .. import initializer as I
+    from ..param_attr import ParamAttr
+
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    if attr is not None and attr.initializer is not None:
+        init = attr.initializer
+    elif default_initializer is not None:
+        init = default_initializer
+    else:
+        init = I.Constant(0.0) if is_bias else I.XavierUniform()
+    data = init(list(shape), to_np_dtype(dtype))
+    p = Parameter(data, name=name or (attr.name if attr else None))
+    if attr is not None:
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.trainable = attr.trainable
+        p.stop_gradient = not attr.trainable
+    return p
+
+
 class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
         self.training = True
@@ -122,28 +149,9 @@ class Layer:
     # -- parameter management ---------------------------------------------
     def create_parameter(self, shape, attr=None, dtype=None,
                          is_bias=False, default_initializer=None):
-        from .. import initializer as I
-        from ..param_attr import ParamAttr
-
-        dtype = dtype or self._dtype
-        attr = ParamAttr._to_attr(attr)
-        if attr is False:
-            return None
-        init = None
-        if attr is not None and attr.initializer is not None:
-            init = attr.initializer
-        elif default_initializer is not None:
-            init = default_initializer
-        else:
-            init = I.Constant(0.0) if is_bias else I.XavierUniform()
-        data = init(shape, to_np_dtype(dtype))
-        p = Parameter(data, name=(attr.name if attr else None))
-        if attr is not None:
-            p.optimize_attr["learning_rate"] = attr.learning_rate
-            p.regularizer = attr.regularizer
-            p.trainable = attr.trainable
-            p.stop_gradient = not attr.trainable
-        return p
+        return make_parameter(
+            shape, dtype or self._dtype, attr=attr, is_bias=is_bias,
+            default_initializer=default_initializer)
 
     def add_parameter(self, name, parameter):
         if parameter is None:

@@ -88,9 +88,9 @@ _CREATION = {
 _WAIVER_GROUPS = {
     "creation op: output determined by shape/argument metadata, no "
     "numeric kernel to sweep (semantics in tests/test_ops.py)":
-        "arange assign clone empty empty_like eye full full_like "
-        "linspace logspace meshgrid ones ones_like to_tensor "
-        "tril_indices triu_indices zeros zeros_like cast",
+        "arange assign clone create_parameter empty empty_like eye "
+        "full full_like linspace logspace meshgrid ones ones_like "
+        "to_tensor tril_indices triu_indices zeros zeros_like cast",
     "in-place variant: aliases the swept out-of-place op (in-place "
     "semantics tested in tests/test_ops.py)":
         "add_ clip_ divide_ exp_ fill_ fill_diagonal_ flatten_ floor_ "

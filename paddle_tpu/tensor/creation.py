@@ -108,6 +108,18 @@ def diag(x, offset=0, padding_value=0, name=None):
     return apply_op("diag", lambda a: jnp.diag(a, k=offset), x)
 
 
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone trainable parameter (upstream
+    paddle.create_parameter; same ParamAttr/initializer wiring as
+    Layer.create_parameter — one shared implementation)."""
+    from ..nn.layer.layers import make_parameter
+
+    return make_parameter(shape, dtype, name=name, attr=attr,
+                          is_bias=is_bias,
+                          default_initializer=default_initializer)
+
+
 def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
     """Batched diagonal matrices: the LAST dim of ``input`` becomes the
     ``offset`` diagonal of a new square matrix spanning output dims

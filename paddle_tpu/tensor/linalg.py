@@ -477,6 +477,30 @@ def eigvals(x, name=None):
     return apply_op("eigvals", f, x, differentiable=False)
 
 
+def lu_solve(b, lu_data, lu_pivots, trans="N", name=None):
+    """Solve A x = b from lu()'s packed factors + 1-based pivots
+    (upstream paddle.linalg.lu_solve over the LAPACK getrs role)."""
+    b = _as_tensor(b)
+    lu_data = _as_tensor(lu_data)
+    lu_pivots = _as_tensor(lu_pivots)
+    trans_code = {"N": 0, "T": 1, "C": 2}.get(trans)
+    if trans_code is None:
+        raise ValueError(
+            f"lu_solve: trans must be 'N', 'T' or 'C', got {trans!r}")
+
+    def f(rhs, lu_, piv):
+        import jax.scipy.linalg as jsl
+
+        # back to jax's 0-based pivot convention; rhs promotes to the
+        # factor dtype (triangular_solve requires matching dtypes)
+        out = jsl.lu_solve(
+            (lu_, piv.astype(jnp.int32) - 1),
+            rhs.astype(lu_.dtype), trans=trans_code)
+        return out.astype(rhs.dtype)
+
+    return apply_op("lu_solve", f, b, lu_data, lu_pivots)
+
+
 def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     """Unpack lu_factor output into P, L, U (upstream:
     paddle/phi/kernels/impl/lu_unpack_kernel_impl.h)."""

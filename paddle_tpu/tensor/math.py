@@ -532,6 +532,36 @@ def ldexp(x, y, name=None):
     )
 
 
+def deg2rad(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("deg2rad", lambda a: jnp.deg2rad(
+        a.astype(jnp.float32) if not jnp.issubdtype(a.dtype, jnp.floating)
+        else a), x)
+
+
+def rad2deg(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("rad2deg", lambda a: jnp.rad2deg(
+        a.astype(jnp.float32) if not jnp.issubdtype(a.dtype, jnp.floating)
+        else a), x)
+
+
+def exp2(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("exp2", lambda a: jnp.exp2(a), x)
+
+
+def logaddexp2(x, y, name=None):
+    x, y = _as_tensor(x), _as_tensor(y)
+    return apply_op(
+        "logaddexp2", lambda a, b: jnp.logaddexp2(a, b), x, y)
+
+
+def sinc(x, name=None):
+    x = _as_tensor(x)
+    return apply_op("sinc", lambda a: jnp.sinc(a), x)
+
+
 def frexp(x, name=None):
     """Decompose x into (mantissa, exponent) with x = m * 2**e,
     0.5 <= |m| < 1 (upstream paddle.frexp; both outputs carry x's

@@ -1,0 +1,142 @@
+"""incubate parity ops: segment reductions, graph_send_recv,
+softmax_mask_fuse, identity_loss, hsigmoid_loss (upstream:
+python/paddle/incubate/*, paddle/phi/kernels/gpu/
+segment_pool_kernel.cu, graph_send_recv_kernel.cu,
+hierarchical_sigmoid_kernel_impl.h)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestSegmentOps:
+    def test_sum_mean_max_min(self):
+        data = _t(np.arange(12, dtype="float32").reshape(6, 2))
+        ids = _t(np.array([0, 0, 1, 1, 1, 3], "int64"))
+        np.testing.assert_allclose(
+            paddle.incubate.segment_sum(data, ids).numpy(),
+            [[2, 4], [18, 21], [0, 0], [10, 11]])
+        np.testing.assert_allclose(
+            paddle.incubate.segment_mean(data, ids).numpy()[1], [6, 7])
+        np.testing.assert_allclose(
+            paddle.incubate.segment_max(data, ids).numpy()[1], [8, 9])
+        np.testing.assert_allclose(
+            paddle.incubate.segment_min(data, ids).numpy()[0], [0, 1])
+        # empty segments (id 2, and out_size beyond max+1) yield 0,
+        # not the reduction identity (reference semantics)
+        np.testing.assert_allclose(
+            paddle.incubate.segment_max(data, ids).numpy()[2], [0, 0])
+        np.testing.assert_allclose(
+            paddle.incubate.segment_min(
+                data, ids, out_size=6).numpy()[5], [0, 0])
+
+    def test_gradient_flows(self):
+        data = _t(np.ones((4, 3), "float32"))
+        data.stop_gradient = False
+        ids = _t(np.array([0, 1, 1, 0], "int64"))
+        paddle.incubate.segment_sum(data, ids).sum().backward()
+        np.testing.assert_allclose(data.grad.numpy(),
+                                   np.ones((4, 3)))
+
+    def test_out_size_and_jit_guard(self):
+        data = _t(np.ones((3, 2), "float32"))
+        ids = _t(np.array([0, 0, 1], "int64"))
+        out = paddle.incubate.segment_sum(data, ids, out_size=5)
+        assert list(out.shape) == [5, 2]
+
+    def test_graph_send_recv_reduces(self):
+        x = _t(np.eye(4, dtype="float32"))
+        src = _t(np.array([0, 1, 2], "int64"))
+        dst = _t(np.array([1, 1, 3], "int64"))
+        s = paddle.incubate.graph_send_recv(x, src, dst, "sum").numpy()
+        np.testing.assert_allclose(s[1], [1, 1, 0, 0])
+        np.testing.assert_allclose(s[0], [0, 0, 0, 0])
+        m = paddle.incubate.graph_send_recv(x, src, dst, "mean").numpy()
+        np.testing.assert_allclose(m[1], [0.5, 0.5, 0, 0])
+        mx = paddle.incubate.graph_send_recv(x, src, dst, "max").numpy()
+        # untouched slots are 0, not -inf
+        np.testing.assert_allclose(mx[2], [0, 0, 0, 0])
+        with pytest.raises(ValueError, match="reduce_op"):
+            paddle.incubate.graph_send_recv(x, src, dst, "prod")
+
+
+class TestFusedAndIdentity:
+    def test_softmax_mask_fuse(self):
+        x = _t(np.zeros((1, 4), "float32"))
+        mask = _t(np.array([[0, -1e30, 0, -1e30]], "float32"))
+        out = paddle.incubate.softmax_mask_fuse(x, mask).numpy()
+        np.testing.assert_allclose(out, [[0.5, 0, 0.5, 0]], atol=1e-6)
+
+    def test_identity_loss(self):
+        x = _t(np.array([1.0, 3.0], "float32"))
+        assert float(paddle.incubate.identity_loss(x, "mean").numpy()) \
+            == 2.0
+        assert float(paddle.incubate.identity_loss(x, "sum").numpy()) \
+            == 4.0
+        np.testing.assert_allclose(
+            paddle.incubate.identity_loss(x, "none").numpy(), [1, 3])
+        # reference integer codes: sum=0, mean=1, none=2
+        assert float(paddle.incubate.identity_loss(x, 0).numpy()) == 4.0
+        assert float(paddle.incubate.identity_loss(x, 1).numpy()) == 2.0
+        np.testing.assert_allclose(
+            paddle.incubate.identity_loss(x, 2).numpy(), [1, 3])
+
+
+class TestHSigmoid:
+    @pytest.mark.parametrize("num_classes", [6, 8, 17])
+    def test_matches_simplecode_reference(self, num_classes):
+        rng = np.random.RandomState(num_classes)
+        n, d, c = 5, 8, num_classes
+        x = rng.randn(n, d).astype("float32")
+        w = rng.randn(c - 1, d).astype("float32") * 0.3
+        b = rng.randn(c - 1).astype("float32") * 0.1
+        lab = rng.randint(0, c, n).astype("int64")
+        got = F.hsigmoid_loss(_t(x), _t(lab), c, _t(w), _t(b)).numpy()
+        ref = np.zeros((n, 1))
+        for i in range(n):
+            code = int(lab[i]) + c
+            for dd in range(code.bit_length() - 1):
+                idx = (code >> (dd + 1)) - 1
+                bit = (code >> dd) & 1
+                z = x[i] @ w[idx] + b[idx]
+                ref[i, 0] += max(z, 0) - z * bit \
+                    + np.log1p(np.exp(-abs(z)))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_custom_path_table(self):
+        rng = np.random.RandomState(0)
+        n, d = 3, 4
+        x = rng.randn(n, d).astype("float32")
+        w = rng.randn(5, d).astype("float32")
+        # per-sample paths with -1 padding
+        table = np.array([[0, 2, -1], [1, 3, 4], [0, -1, -1]], "int64")
+        code = np.array([[1, 0, 0], [0, 1, 1], [1, 0, 0]], "int64")
+        got = F.hsigmoid_loss(
+            _t(x), _t(np.zeros(n, "int64")), 6, _t(w),
+            path_table=_t(table), path_code=_t(code)).numpy()
+        ref = np.zeros((n, 1))
+        for i in range(n):
+            for j in range(3):
+                if table[i, j] < 0:
+                    continue
+                z = x[i] @ w[table[i, j]]
+                bit = code[i, j]
+                ref[i, 0] += max(z, 0) - z * bit \
+                    + np.log1p(np.exp(-abs(z)))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_gradient_flows(self):
+        rng = np.random.RandomState(1)
+        x = _t(rng.randn(4, 6).astype("float32"))
+        x.stop_gradient = False
+        w = _t(rng.randn(7, 6).astype("float32"))
+        w.stop_gradient = False
+        lab = _t(rng.randint(0, 8, 4).astype("int64"))
+        F.hsigmoid_loss(x, lab, 8, w).sum().backward()
+        assert np.abs(x.grad.numpy()).sum() > 0
+        assert np.abs(w.grad.numpy()).sum() > 0

@@ -1209,6 +1209,25 @@ OPS += [
            lambda x: pmath.ldexp(
                x, paddle.to_tensor(np.full((4, 9), 2, np.int32))),
            lambda x: np.ldexp(x, 2), [(4, 9)]),
+    OpSpec("deg2rad", U(pmath.deg2rad), np.deg2rad, [(4, 9)]),
+    OpSpec("rad2deg", U(pmath.rad2deg), np.rad2deg, [(4, 9)]),
+    OpSpec("exp2", U(pmath.exp2), np.exp2, [(4, 9)]),
+    OpSpec("logaddexp2", B(pmath.logaddexp2), np.logaddexp2,
+           [(4, 9), (4, 9)]),
+    OpSpec("sinc", U(pmath.sinc), np.sinc, [(4, 9)],
+           kink=lambda arrs, i: np.abs(arrs[0]) > 1e-2),
+    OpSpec("lu_solve",
+           lambda b: linalg.lu_solve(
+               b, *linalg.lu(_t64(
+                   (np.eye(4) * 4 + 0.3).astype("float32")))),
+           lambda b: np.linalg.solve(np.eye(4) * 4 + 0.3, b),
+           [(4, 2)]),
+    OpSpec("hsigmoid_loss",
+           lambda x: F.hsigmoid_loss(
+               x, _t64(_LBL.clip(0, 5)), 6,
+               _t64((np.arange(40, dtype="float32")
+                     .reshape(5, 8) / 40))),
+           None, [(4, 8)]),
     OpSpec("frexp_mantissa", lambda x: pmath.frexp(x)[0],
            lambda x: np.frexp(x)[0], [(4, 9)], grad=False, op="frexp"),
     OpSpec("frexp_exponent", lambda x: pmath.frexp(x)[1],
