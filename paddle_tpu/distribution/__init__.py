@@ -968,3 +968,131 @@ class MultivariateNormal(Distribution):
 
 
 __all__.extend(["Binomial", "MultivariateNormal"])
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (upstream
+    python/paddle/distribution/exponential_family.py): subclasses
+    expose natural parameters + log-normalizer; entropy falls out via
+    the Bregman identity (autodiff of the log normalizer)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """H = A(η) - <η, ∇A(η)> - E[log h(x)] via autodiff of the log
+        normalizer (∇A = E[T]); ``_mean_carrier_measure`` is E[log h],
+        the torch/paddle convention."""
+        nat = [_as_tensor(p) for p in self._natural_parameters]
+
+        def f(*raws):
+            raws = [r.astype(jnp.float32) for r in raws]
+            # A(η) is elementwise over the batch, so grad-of-sum gives
+            # the per-element ∇A; entropy keeps the batch shape
+            grads = jax.grad(
+                lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+                argnums=tuple(range(len(raws))))(*raws)
+            a = self._log_normalizer(*raws)
+            ent = a - sum(g * r for g, r in zip(grads, raws))
+            return ent - self._mean_carrier_measure
+
+        return apply_op("expfam_entropy", f, *nat)
+
+
+class ContinuousBernoulli(Distribution):
+    """Continuous Bernoulli on [0, 1] (upstream
+    python/paddle/distribution/continuous_bernoulli.py; Loaiza-Ganem &
+    Cunningham 2019). ``probs`` parametrizes the un-normalized density
+    p^x (1-p)^(1-x) with the closed-form normalizing constant."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _as_tensor(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape), ())
+
+    def _safe_p(self, p):
+        lo, hi = self._lims
+        # the normalizer has a removable singularity at p=1/2 — clamp
+        # the window like the reference
+        cut = jnp.where((p >= lo) & (p <= hi), lo, p)
+        return jnp.clip(cut, 1e-6, 1 - 1e-6)
+
+    def _log_norm(self, p):
+        # log C(p), C = 2 atanh(1-2p) / (1-2p)
+        return jnp.log(2.0 * jnp.arctanh(1.0 - 2.0 * p)) \
+            - jnp.log(1.0 - 2.0 * p)
+
+    @property
+    def mean(self):
+        def f(pr):
+            p = self._safe_p(pr.astype(jnp.float32))
+            return p / (2.0 * p - 1.0) \
+                + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * p))
+
+        return apply_op("cb_mean", f, self.probs)
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+
+        def f(pr, x):
+            p = self._safe_p(pr.astype(jnp.float32))
+            x = x.astype(jnp.float32)
+            return (x * jnp.log(p) + (1.0 - x) * jnp.log1p(-p)
+                    + self._log_norm(p))
+
+        return apply_op("cb_log_prob", f, self.probs, value)
+
+    def rsample(self, shape=()):
+        k = next_key()
+        shp = tuple(shape) + tuple(self.probs.shape)
+
+        def f(pr):
+            p = self._safe_p(pr.astype(jnp.float32))
+            u = jax.random.uniform(
+                k, shp, minval=1e-6, maxval=1.0 - 1e-6)
+            # inverse CDF: x = [atanh((2p-1)(2u-1)... ] closed form:
+            # F^-1(u) = (log(u*(2p-1)/(1-p) + 1) / log(p/(1-p)))
+            ratio = jnp.log(p) - jnp.log1p(-p)
+            x = jnp.log1p(u * (jnp.exp(ratio) - 1.0)) / ratio
+            return jnp.clip(x, 0.0, 1.0)
+
+        return apply_op("cb_rsample", f, self.probs)
+
+    def sample(self, shape=()):
+        s = self.rsample(shape)
+        s.stop_gradient = True
+        return s
+
+
+__all__.extend(["ExponentialFamily", "ContinuousBernoulli"])
+
+# transforms live in their own module but surface here like the
+# reference (paddle.distribution.AffineTransform, ...)
+from .transform import (  # noqa: E402,F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    PowerTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    TanhTransform,
+    Transform,
+    TransformedDistribution,
+)
+
+__all__.extend([
+    "Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+    "SigmoidTransform", "TanhTransform", "AbsTransform",
+    "ChainTransform", "SoftmaxTransform", "StackTransform",
+    "TransformedDistribution",
+])

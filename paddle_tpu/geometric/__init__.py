@@ -38,46 +38,34 @@ def _segment(name, jfn, data, segment_ids, out_size=None):
     return apply_op(name, f, data, segment_ids)
 
 
+# segment reductions: upstream these are literal aliases of the
+# incubate ops — delegate to the canonical implementations there
+# (touched-mask zero fill that preserves legitimate +-inf data,
+# out_size for jit; lazy import avoids a package cycle)
+
+
 def segment_sum(data, segment_ids, name=None):
-    return _segment("segment_sum", jax.ops.segment_sum, data,
-                    segment_ids)
+    from ..incubate import segment_sum as _impl
+
+    return _impl(data, segment_ids)
 
 
 def segment_mean(data, segment_ids, name=None):
-    data = _as_tensor(data)
-    segment_ids = _as_tensor(segment_ids)
-    n = _n_segments(segment_ids, None)
+    from ..incubate import segment_mean as _impl
 
-    def f(d, s):
-        s = s.astype(jnp.int32)
-        tot = jax.ops.segment_sum(d, s, num_segments=n)
-        cnt = jax.ops.segment_sum(
-            jnp.ones(d.shape[:1], jnp.float32), s, num_segments=n
-        )
-        shape = (n,) + (1,) * (d.ndim - 1)
-        return tot / jnp.maximum(cnt.reshape(shape), 1.0)
-
-    return apply_op("segment_mean", f, data, segment_ids)
+    return _impl(data, segment_ids)
 
 
 def segment_max(data, segment_ids, name=None):
-    out = _segment("segment_max", jax.ops.segment_max, data,
-                   segment_ids)
-    return _finite(out)
+    from ..incubate import segment_max as _impl
+
+    return _impl(data, segment_ids)
 
 
 def segment_min(data, segment_ids, name=None):
-    out = _segment("segment_min", jax.ops.segment_min, data,
-                   segment_ids)
-    return _finite(out)
+    from ..incubate import segment_min as _impl
 
-
-def _finite(t):
-    # empty segments produce +-inf identity values; reference yields 0
-    return apply_op(
-        "segment_finite",
-        lambda a: jnp.where(jnp.isfinite(a), a, 0.0), t,
-    )
+    return _impl(data, segment_ids)
 
 
 _REDUCERS = {
@@ -90,30 +78,15 @@ _REDUCERS = {
 
 def send_u_recv(x, src_index, dst_index, reduce_op="sum",
                 out_size=None, name=None):
-    """Gather x[src], reduce onto dst (upstream send_u_recv)."""
-    x = _as_tensor(x)
-    src_index = _as_tensor(src_index)
-    dst_index = _as_tensor(dst_index)
-    n = out_size if out_size is not None else x.shape[0]
+    """Gather x[src], reduce onto dst (upstream send_u_recv — the
+    same op as paddle.incubate.graph_send_recv; one implementation)."""
+    from ..incubate import graph_send_recv
+
     op = reduce_op.lower()
-
-    def f(xa, si, di):
-        msgs = xa[si.astype(jnp.int32)]
-        if op == "mean":
-            tot = jax.ops.segment_sum(
-                msgs, di.astype(jnp.int32), num_segments=int(n))
-            cnt = jax.ops.segment_sum(
-                jnp.ones(msgs.shape[:1], jnp.float32),
-                di.astype(jnp.int32), num_segments=int(n))
-            shape = (int(n),) + (1,) * (msgs.ndim - 1)
-            return tot / jnp.maximum(cnt.reshape(shape), 1.0)
-        out = _REDUCERS[op](
-            msgs, di.astype(jnp.int32), num_segments=int(n))
-        if op in ("max", "min"):
-            out = jnp.where(jnp.isfinite(out), out, 0.0)
-        return out
-
-    return apply_op("send_u_recv", f, x, src_index, dst_index)
+    if op == "add":
+        op = "sum"
+    return graph_send_recv(x, src_index, dst_index, op,
+                           out_size=out_size)
 
 
 def send_ue_recv(x, y, src_index, dst_index, message_op="add",
