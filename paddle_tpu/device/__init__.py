@@ -126,6 +126,37 @@ def synchronize(device=None):
         pass
 
 
+def get_available_device():
+    """All visible devices as place strings (upstream
+    paddle.device.get_available_device)."""
+    kind = "tpu" if is_compiled_with_tpu() and any(
+        d.platform not in ("cpu",) for d in jax.devices()
+    ) else "cpu"
+    return [f"{kind}:{i}" for i in range(jax.device_count())]
+
+
+def get_available_custom_device():
+    """Custom-device places (upstream analog; TPU is this framework's
+    first-class device, not a custom plugin — empty list)."""
+    return []
+
+
+class _XPUShim:
+    """paddle.device.xpu parity veneer: XPU (Kunlun) hardware is out of
+    scope on TPU (SURVEY §7); every query reports absence."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def synchronize(device=None):
+        return None
+
+
+xpu = _XPUShim()
+
+
 # -- memory observability (upstream: paddle/fluid/memory/stats.h) ----------
 def memory_allocated(device=None) -> int:
     try:

@@ -29,10 +29,12 @@ from .collective import (  # noqa
     irecv,
     is_available,
     isend,
+    monitored_barrier,
     new_group,
     P2POp,
     recv,
     reduce,
+    wait,
     reduce_scatter,
     scatter,
     send,

@@ -498,6 +498,47 @@ def barrier(group=None):
         pass
 
 
+def wait(tensor, group=None, use_calc_stream=True):
+    """Block until ``tensor``'s producing collective lands (upstream
+    paddle.distributed.wait; PJRT's single ordered stream means
+    block_until_ready is the whole contract)."""
+    t = _as_tensor(tensor)
+    try:
+        t._data.block_until_ready()
+    except Exception:
+        pass
+    return t
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    """Barrier that raises if peers don't arrive within ``timeout``
+    seconds (upstream monitored_barrier over gloo). Uses the launch
+    store (the object-collectives rendezvous) for cross-process
+    arrival counting; in-process / single-rank it reduces to
+    barrier()."""
+    from .object_collectives import _proc_info
+
+    st, rank, world = _proc_info()
+    if st is not None and world > 1:
+        import time as _time
+
+        key = f"__monitored_barrier_{_MONITORED_SEQ[0]}"
+        _MONITORED_SEQ[0] += 1
+        st.add(key, 1)
+        eff_timeout = 300.0 if timeout is None else float(timeout)
+        deadline = _time.monotonic() + eff_timeout
+        while int(st.get(key) or 0) < world:
+            if _time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"monitored_barrier: rank {rank} timed out after "
+                    f"{eff_timeout}s waiting for {world} ranks")
+            _time.sleep(0.01)
+    barrier(group)
+
+
+_MONITORED_SEQ = [0]
+
+
 def stream_all_reduce(*a, **k):
     return all_reduce(*a, **k)
 

@@ -1021,14 +1021,19 @@ class ContinuousBernoulli(Distribution):
     def _safe_p(self, p):
         lo, hi = self._lims
         # the normalizer has a removable singularity at p=1/2 — clamp
-        # the window like the reference
-        cut = jnp.where((p >= lo) & (p <= hi), lo, p)
+        # to the NEAREST window edge like the reference (p just above
+        # 1/2 must stay above it)
+        cut = jnp.where(
+            (p >= lo) & (p <= hi),
+            jnp.where(p < 0.5, lo, hi), p)
         return jnp.clip(cut, 1e-6, 1 - 1e-6)
 
     def _log_norm(self, p):
-        # log C(p), C = 2 atanh(1-2p) / (1-2p)
-        return jnp.log(2.0 * jnp.arctanh(1.0 - 2.0 * p)) \
-            - jnp.log(1.0 - 2.0 * p)
+        # log C(p); C = 2 atanh(1-2p) / (1-2p) is positive for all
+        # p != 1/2 (both factors flip sign together), so the log is
+        # taken of the RATIO
+        return jnp.log(
+            2.0 * jnp.arctanh(1.0 - 2.0 * p) / (1.0 - 2.0 * p))
 
     @property
     def mean(self):
@@ -1052,7 +1057,7 @@ class ContinuousBernoulli(Distribution):
 
     def rsample(self, shape=()):
         k = next_key()
-        shp = tuple(shape) + tuple(self.probs.shape)
+        shp = _shape_tuple(shape) + tuple(self.probs.shape)
 
         def f(pr):
             p = self._safe_p(pr.astype(jnp.float32))

@@ -21,23 +21,6 @@ __all__ = [
 ]
 
 
-def _n_segments(ids, out_size):
-    if out_size is not None:
-        return int(out_size)
-    return int(np.asarray(ids._data).max()) + 1 if ids.size else 0
-
-
-def _segment(name, jfn, data, segment_ids, out_size=None):
-    data = _as_tensor(data)
-    segment_ids = _as_tensor(segment_ids)
-    n = _n_segments(segment_ids, out_size)
-
-    def f(d, s):
-        return jfn(d, s.astype(jnp.int32), num_segments=n)
-
-    return apply_op(name, f, data, segment_ids)
-
-
 # segment reductions: upstream these are literal aliases of the
 # incubate ops — delegate to the canonical implementations there
 # (touched-mask zero fill that preserves legitimate +-inf data,
@@ -113,18 +96,24 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
             msgs = msgs / ya
         else:
             raise ValueError(f"unknown message_op {mop}")
+        di32 = di.astype(jnp.int32)
         if rop == "mean":
-            tot = jax.ops.segment_sum(
-                msgs, di.astype(jnp.int32), num_segments=int(n))
+            tot = jax.ops.segment_sum(msgs, di32, num_segments=int(n))
             cnt = jax.ops.segment_sum(
-                jnp.ones(msgs.shape[:1], jnp.float32),
-                di.astype(jnp.int32), num_segments=int(n))
+                jnp.ones(msgs.shape[:1], jnp.float32), di32,
+                num_segments=int(n))
             shape = (int(n),) + (1,) * (msgs.ndim - 1)
             return tot / jnp.maximum(cnt.reshape(shape), 1.0)
-        out = _REDUCERS[rop](
-            msgs, di.astype(jnp.int32), num_segments=int(n))
+        out = _REDUCERS["sum" if rop == "add" else rop](
+            msgs, di32, num_segments=int(n))
         if rop in ("max", "min"):
-            out = jnp.where(jnp.isfinite(out), out, 0.0)
+            # zero only UNTOUCHED slots (legitimate +-inf message
+            # values survive — same semantics as send_u_recv)
+            touched = jax.ops.segment_sum(
+                jnp.ones(msgs.shape[:1], jnp.float32), di32,
+                num_segments=int(n)) > 0
+            out = jnp.where(
+                touched[(...,) + (None,) * (msgs.ndim - 1)], out, 0)
         return out
 
     return apply_op("send_ue_recv", f, x, y, src_index, dst_index)

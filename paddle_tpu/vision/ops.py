@@ -598,3 +598,34 @@ class DeformConv2D(Layer):
         return deform_conv2d(
             x, offset, self.weight, self.bias, s, p, d, dg, g, mask
         )
+
+
+def read_file(filename, name=None):
+    """Read a file's raw bytes as a uint8 tensor (upstream
+    paddle.vision.ops.read_file — host-side IO, like the reference's
+    CPU-only kernel)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (upstream
+    paddle.vision.ops.decode_jpeg; host-side via PIL, the TPU analog
+    of the reference's CPU/nvjpeg decode)."""
+    import io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(_as_tensor(x)._data, dtype=np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(jnp.asarray(arr))

@@ -86,6 +86,25 @@ class TestContinuousBernoulli:
         assert np.isfinite(float(cb.log_prob(_t([0.4])).numpy()))
         assert np.isfinite(float(cb.mean.numpy()))
 
+    def test_upper_half_probs(self):
+        """p > 0.5 must be finite (review caught log-of-negative NaN)
+        with the symmetry CB(p).log_prob(x) == CB(1-p).log_prob(1-x)."""
+        for p in (0.7, 0.9):
+            cb = D.ContinuousBernoulli(_t([p]))
+            lp = float(cb.log_prob(_t([0.6])).numpy())
+            assert np.isfinite(lp)
+            mirror = float(D.ContinuousBernoulli(
+                _t([1 - p])).log_prob(_t([0.4])).numpy())
+            np.testing.assert_allclose(lp, mirror, rtol=1e-5)
+            assert float(cb.mean.numpy()) > 0.5
+        # just above the singularity window: stays on the upper side
+        assert float(D.ContinuousBernoulli(
+            _t([0.5009])).mean.numpy()) >= 0.5
+        # int sample shape normalizes like the other distributions
+        paddle.seed(3)
+        s = D.ContinuousBernoulli(_t([0.7])).rsample(5)
+        assert list(s.shape) == [5, 1]
+
     def test_rsample_grad_flows(self):
         probs = _t([0.3])
         probs.stop_gradient = False

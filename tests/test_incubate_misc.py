@@ -140,3 +140,31 @@ class TestHSigmoid:
         F.hsigmoid_loss(x, lab, 8, w).sum().backward()
         assert np.abs(x.grad.numpy()).sum() > 0
         assert np.abs(w.grad.numpy()).sum() > 0
+
+
+class TestQuickWins:
+    def test_read_file_decode_jpeg(self, tmp_path):
+        import io
+
+        from PIL import Image
+
+        img = (np.random.RandomState(0).rand(16, 20, 3) * 255
+               ).astype("uint8")
+        p = str(tmp_path / "t.jpg")
+        Image.fromarray(img).save(p, format="JPEG")
+        raw = paddle.vision.ops.read_file(p)
+        assert raw.dtype.name == "uint8"
+        dec = paddle.vision.ops.decode_jpeg(raw, mode="rgb")
+        assert list(dec.shape) == [3, 16, 20]
+        assert list(paddle.vision.ops.decode_jpeg(
+            raw, mode="gray").shape) == [1, 16, 20]
+
+    def test_device_and_dist_helpers(self):
+        devs = paddle.device.get_available_device()
+        assert devs and all(":" in d for d in devs)
+        assert paddle.device.xpu.device_count() == 0
+        assert paddle.device.get_available_custom_device() == []
+        t = paddle.to_tensor(np.ones(2, "float32"))
+        assert paddle.distributed.wait(t) is t
+        paddle.distributed.monitored_barrier(timeout=5)
+        paddle.jit.enable_to_static(True)
