@@ -19,6 +19,8 @@ __all__ = [
     "fused_rotary_position_embedding", "paged_attention", "swiglu",
     "fused_rms_norm", "fused_layer_norm", "fused_matmul_bias",
     "fused_dropout_add", "fused_bias_dropout_residual_layer_norm",
+    "fused_linear_cross_entropy", "fused_linear_activation",
+    "fused_bias_act", "variable_length_memory_efficient_attention",
 ]
 
 fused_matmul_bias = fused_linear
@@ -199,3 +201,127 @@ def fused_linear_cross_entropy(h, w, labels, ignore_index=-100,
                      chunk=chunk, reduction=reduction)
 
     return apply_op("fused_linear_cross_entropy", f, h, w, labels)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation=None, name=None):
+    """matmul(+bias)+activation in one op (upstream:
+    fused_linear_activation over cublasLt epilogues; default no
+    activation like the reference; on TPU, XLA fuses the epilogue into
+    the matmul — the API exists for parity)."""
+    x, y = _as_tensor(x), _as_tensor(y)
+    args = [x, y]
+    has_b = bias is not None
+    if has_b:
+        args.append(_as_tensor(bias))
+    act = (activation or "none").lower()
+    if act not in ("gelu", "relu", "none", ""):
+        raise ValueError(
+            f"fused_linear_activation: unsupported activation "
+            f"{activation!r} (gelu/relu/none)")
+
+    def f(a, w, *b):
+        if trans_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if trans_y:
+            w = jnp.swapaxes(w, -1, -2)
+        out = a @ w
+        if b:
+            out = out + b[0]
+        if act == "gelu":
+            out = jax.nn.gelu(out, approximate=False)
+        elif act == "relu":
+            out = jax.nn.relu(out)
+        return out
+
+    return apply_op("fused_linear_activation", f, *args)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", name=None, **kwargs):
+    """bias-add + activation (upstream fused_bias_act; the quant-path
+    arguments are NOT supported — silently ignoring them would return
+    un-(de)quantized values, so they raise)."""
+    if kwargs:
+        raise ValueError(
+            f"fused_bias_act: unsupported arguments {sorted(kwargs)} "
+            f"(quantized paths are out of scope — use "
+            f"paddle.quantization)")
+    x = _as_tensor(x)
+    args = [x]
+    has_b = bias is not None
+    if has_b:
+        args.append(_as_tensor(bias))
+    act = act_method.lower()
+    acts = {
+        "gelu": lambda a: jax.nn.gelu(a, approximate=False),
+        "relu": jax.nn.relu,
+        "swiglu": None,  # handled below (halves the last dim)
+        "geglu": None,
+        "silu": jax.nn.silu,
+    }
+    if act not in acts:
+        raise ValueError(
+            f"fused_bias_act: unsupported act_method {act_method!r}")
+
+    def f(a, *b):
+        if b:
+            a = a + b[0]
+        if act in ("swiglu", "geglu"):
+            u, v = jnp.split(a, 2, axis=-1)
+            g = jax.nn.silu(u) if act == "swiglu" else \
+                jax.nn.gelu(u, approximate=False)
+            return g * v
+        return acts[act](a)
+
+    return apply_op("fused_bias_act", f, *args)
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0):
+    """Batched attention with per-sample valid lengths (upstream:
+    variable_length_memory_efficient_attention, the inference-side
+    varlen op; the packed TRAINING path is flash_attn_unpadded's
+    blocked-ragged Pallas kernel). q/k/v: [B, H, S, D]; seq_lens /
+    kv_seq_lens: [B] or [B, 1] valid lengths. Lengths become additive
+    masks over the dense sdpa — on TPU the mask fuses into the
+    attention softmax."""
+    query, key, value = (_as_tensor(query), _as_tensor(key),
+                         _as_tensor(value))
+    seq_lens = _as_tensor(seq_lens)
+    kv_seq_lens = _as_tensor(kv_seq_lens)
+    args = [query, key, value, seq_lens, kv_seq_lens]
+    has_mask = mask is not None
+    if has_mask:
+        args.append(_as_tensor(mask))
+
+    def f(q, k, v, ql, kl, *m):
+        d = q.shape[-1]
+        sc = scale if scale is not None else 1.0 / (d ** 0.5)
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q.astype(jnp.float32),
+            k.astype(jnp.float32)) * sc
+        if m:
+            s = s + m[0].astype(jnp.float32)
+        sq, sk = q.shape[2], k.shape[2]
+        qpos = jnp.arange(sq)
+        kpos = jnp.arange(sk)
+        qv = qpos[None, :] < ql.reshape(-1, 1)          # (B, Sq)
+        kv_ = kpos[None, :] < kl.reshape(-1, 1)         # (B, Sk)
+        ok = qv[:, None, :, None] & kv_[:, None, None, :]
+        if causal:
+            # align last query with last key so decode (Sq=1 against a
+            # long cache, incl. pre_cache prefix) sees the whole cache
+            ok = ok & (kpos[None, None, None, :]
+                       <= qpos[None, None, :, None] + (sk - sq))
+        s = jnp.where(ok, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        # fully-masked rows (padded queries, or kv length 0) softmax
+        # to uniform junk — zero them
+        valid_row = qv & (kl.reshape(-1, 1) > 0)
+        p = jnp.where(valid_row[:, None, :, None], p, 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    return apply_op(
+        "variable_length_memory_efficient_attention", f, *args)

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
 from paddle_tpu.incubate.nn import (
     FusedMultiTransformer,
     fused_feedforward,
@@ -131,3 +132,105 @@ def test_fused_mha_attn_mask_applied():
         attn_mask=paddle.to_tensor(mask))
     np.testing.assert_allclose(
         out_m.numpy()[:, :-1], out_m2.numpy()[:, :-1], atol=1e-5)
+
+
+class TestFusedFunctionalAdditions:
+    def test_fused_linear_activation_matches_reference(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        w = paddle.to_tensor(rng.randn(8, 6).astype("float32"))
+        b = paddle.to_tensor(rng.randn(6).astype("float32"))
+        out = IF.fused_linear_activation(x, w, b, activation="relu")
+        ref = np.maximum(x.numpy() @ w.numpy() + b.numpy(), 0)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+        # trans_y + gelu
+        wt = paddle.to_tensor(rng.randn(6, 8).astype("float32"))
+        out2 = IF.fused_linear_activation(
+            x, wt, trans_y=True, activation="none")
+        np.testing.assert_allclose(out2.numpy(),
+                                   x.numpy() @ wt.numpy().T, rtol=1e-5)
+        with pytest.raises(ValueError, match="activation"):
+            IF.fused_linear_activation(x, w, activation="tanhh")
+
+    def test_fused_bias_act_variants(self):
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(2, 8).astype("float32"))
+        b = paddle.to_tensor(rng.randn(8).astype("float32"))
+        relu = IF.fused_bias_act(x, b, act_method="relu").numpy()
+        np.testing.assert_allclose(
+            relu, np.maximum(x.numpy() + b.numpy(), 0), rtol=1e-6)
+        sw = IF.fused_bias_act(x, act_method="swiglu").numpy()
+        u, v = np.split(x.numpy(), 2, -1)
+        np.testing.assert_allclose(
+            sw, (u / (1 + np.exp(-u))) * v, rtol=1e-5)
+
+    def test_varlen_memory_efficient_attention(self):
+        rng = np.random.RandomState(2)
+        B, H, S, D = 2, 3, 10, 8
+        q = rng.randn(B, H, S, D).astype("float32")
+        k = rng.randn(B, H, S, D).astype("float32")
+        v = rng.randn(B, H, S, D).astype("float32")
+        lens = np.array([7, 4], "int32")
+        out = IF.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), paddle.to_tensor(lens),
+            paddle.to_tensor(lens)).numpy()
+        for bi in range(B):
+            L = lens[bi]
+            s = np.einsum("hqd,hkd->hqk", q[bi, :, :L],
+                          k[bi, :, :L]) / np.sqrt(D)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            ref = np.einsum("hqk,hkd->hqd", p, v[bi, :, :L])
+            np.testing.assert_allclose(out[bi, :, :L], ref,
+                                       rtol=2e-4, atol=2e-5)
+            np.testing.assert_allclose(out[bi, :, L:], 0.0, atol=1e-6)
+
+    def test_varlen_causal(self):
+        rng = np.random.RandomState(3)
+        B, H, S, D = 1, 2, 6, 4
+        q = rng.randn(B, H, S, D).astype("float32")
+        out = IF.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(q),
+            paddle.to_tensor(np.array([6], "int32")),
+            paddle.to_tensor(np.array([6], "int32")),
+            causal=True).numpy()
+        # first position attends only to itself
+        np.testing.assert_allclose(out[0, :, 0], q[0, :, 0],
+                                   rtol=1e-5)
+
+    def test_varlen_decode_shape_and_empty_kv(self):
+        """Sq=1 against a long cache must see the WHOLE cache (causal
+        aligns last query to last key — review finding), and kv_len=0
+        rows return zeros, not a uniform average."""
+        rng = np.random.RandomState(4)
+        B, H, D, SK = 2, 2, 4, 8
+        q = rng.randn(B, H, 1, D).astype("float32")
+        k = rng.randn(B, H, SK, D).astype("float32")
+        v = rng.randn(B, H, SK, D).astype("float32")
+        out = IF.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v),
+            paddle.to_tensor(np.array([1, 1], "int32")),
+            paddle.to_tensor(np.array([SK, 0], "int32")),
+            causal=True).numpy()
+        s = np.einsum("hqd,hkd->hqk", q[0], k[0]) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hqk,hkd->hqd", p, v[0])
+        np.testing.assert_allclose(out[0], ref, rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(out[1], 0.0, atol=1e-6)
+
+    def test_fused_bias_act_rejects_quant_kwargs(self):
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        with pytest.raises(ValueError, match="quant"):
+            IF.fused_bias_act(x, quant_scale=0.5)
+
+    def test_fused_linear_activation_default_is_identity(self):
+        rng = np.random.RandomState(5)
+        x = paddle.to_tensor(rng.randn(3, 4).astype("float32"))
+        w = paddle.to_tensor(rng.randn(4, 5).astype("float32"))
+        out = IF.fused_linear_activation(x, w)  # default: NO activation
+        np.testing.assert_allclose(out.numpy(),
+                                   x.numpy() @ w.numpy(), rtol=1e-5)
