@@ -259,6 +259,7 @@ METHODS["__contains__"] = _tensor_contains
 
 for name, fn in METHODS.items():
     setattr(Tensor, name, fn)
+del name, fn  # loop vars would otherwise star-export (paddle.fn leak)
 
 # hash must survive __eq__ override
 Tensor.__hash__ = lambda self: id(self)
