@@ -21,6 +21,7 @@ __all__ = [
     "fused_dropout_add", "fused_bias_dropout_residual_layer_norm",
     "fused_linear_cross_entropy", "fused_linear_activation",
     "fused_bias_act", "variable_length_memory_efficient_attention",
+    "masked_multihead_attention",
 ]
 
 fused_matmul_bias = fused_linear
@@ -325,3 +326,96 @@ def variable_length_memory_efficient_attention(
 
     return apply_op(
         "variable_length_memory_efficient_attention", f, *args)
+
+
+def masked_multihead_attention(
+        x, cache_kv=None, src_mask=None, sequence_lengths=None,
+        rotary_tensor=None, rotary_emb_dims=0, num_heads=None,
+        use_neox_rotary_style=False, out_scale=-1, name=None, **kwargs):
+    """Single-step fused decode attention over a static KV cache
+    (upstream: paddle.incubate.nn.functional.masked_multihead_attention
+    — paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel;
+    the per-token decode hot op of the fused inference stack).
+
+    Supported subset (quantization and beam offsets are out of scope —
+    they raise): ``x`` [B, 3*H*D] is this step's fused qkv; ``cache_kv``
+    [2, B, H, Smax, D] holds K and V; ``sequence_lengths`` [B] (or
+    [B,1]) is each row's current length (the new token is written at
+    that slot; rows attend to positions <= their own length);
+    ``src_mask`` broadcastable to [B, H, 1, Smax] is added to the
+    scores. Returns (out [B, H*D], updated cache_kv) — same contract as
+    the reference.
+    """
+    if kwargs:
+        raise ValueError(
+            f"masked_multihead_attention: unsupported arguments "
+            f"{sorted(kwargs)} (quant/beam paths out of scope)")
+    if out_scale not in (-1, -1.0):
+        raise ValueError(
+            "masked_multihead_attention: out_scale quantization is "
+            "out of scope")
+    if rotary_emb_dims:
+        raise ValueError(
+            "masked_multihead_attention: apply rope before the call "
+            "(fused_rotary_position_embedding); rotary_tensor is not "
+            "supported")
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention needs cache_kv")
+    if sequence_lengths is None:
+        raise ValueError(
+            "masked_multihead_attention: sequence_lengths is required "
+            "(each row's current length; the reference infers the "
+            "timestep internally, which this subset does not)")
+    x = _as_tensor(x)
+    cache_kv = _as_tensor(cache_kv)
+    b = x.shape[0]
+    smax = cache_kv.shape[3]
+    h = num_heads if num_heads is not None else cache_kv.shape[2]
+    d = cache_kv.shape[4]
+    sequence_lengths = _as_tensor(sequence_lengths)
+    import jax as _jax
+
+    if not isinstance(sequence_lengths._data, _jax.core.Tracer):
+        mx = int(jnp.max(sequence_lengths._data)) if \
+            sequence_lengths.size else 0
+        if mx >= smax:
+            raise ValueError(
+                f"masked_multihead_attention: sequence length {mx} "
+                f"would write past the cache (Smax={smax}) — the JAX "
+                f"scatter would silently drop it")
+    args = [x, cache_kv]
+    has_mask = src_mask is not None
+    if has_mask:
+        args.append(_as_tensor(src_mask))
+    args.append(sequence_lengths)
+
+    def f(xr, ck, *rest):
+        rest = list(rest)
+        m = rest.pop(0) if has_mask else None
+        lens = rest.pop(0).reshape(-1).astype(jnp.int32)  # (B,)
+        qkv = xr.reshape(b, 3, h, d)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        # write this step's K/V at each row's slot
+        bidx = jnp.arange(b)
+        kc = ck[0].astype(xr.dtype)
+        vc = ck[1].astype(xr.dtype)
+        kc = kc.at[bidx, :, lens, :].set(k_new)
+        vc = vc.at[bidx, :, lens, :].set(v_new)
+        s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                       kc.astype(jnp.float32)) / (d ** 0.5)
+        if m is not None:
+            mb = jnp.broadcast_to(
+                m.astype(jnp.float32).reshape(
+                    m.shape if m.ndim == 4 else
+                    (1,) * (4 - m.ndim) + tuple(m.shape)),
+                (b, h, 1, smax))
+            s = s + mb[:, :, 0, :]
+        pos = jnp.arange(smax)
+        ok = pos[None, :] <= lens[:, None]        # (B, Smax)
+        s = jnp.where(ok[:, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", p, vc.astype(jnp.float32))
+        new_cache = jnp.stack([kc, vc]).astype(ck.dtype)
+        return out.astype(xr.dtype).reshape(b, h * d), new_cache
+
+    return apply_op("masked_multihead_attention", f, *args, n_outs=2)

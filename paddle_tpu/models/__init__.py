@@ -38,6 +38,8 @@ from .llama import (
     llama2_7b,
     llama_headline,
     llama2_13b,
+    llama3_8b,
+    llama3_70b,
     llama_tiny,
     llama_pipeline_model,
 )

@@ -92,6 +92,31 @@ def llama2_13b(**kw) -> LlamaConfig:
     )
 
 
+def llama3_8b(**kw) -> LlamaConfig:
+    """Llama-3-8B: GQA 32:8, 128k vocab, rope theta 500k."""
+    kw.setdefault("vocab_size", 128256)
+    kw.setdefault("hidden_size", 4096)
+    kw.setdefault("intermediate_size", 14336)
+    kw.setdefault("num_hidden_layers", 32)
+    kw.setdefault("num_attention_heads", 32)
+    kw.setdefault("num_key_value_heads", 8)
+    kw.setdefault("max_position_embeddings", 8192)
+    kw.setdefault("rope_theta", 500000.0)
+    return LlamaConfig(**kw)
+
+
+def llama3_70b(**kw) -> LlamaConfig:
+    kw.setdefault("vocab_size", 128256)
+    kw.setdefault("hidden_size", 8192)
+    kw.setdefault("intermediate_size", 28672)
+    kw.setdefault("num_hidden_layers", 80)
+    kw.setdefault("num_attention_heads", 64)
+    kw.setdefault("num_key_value_heads", 8)
+    kw.setdefault("max_position_embeddings", 8192)
+    kw.setdefault("rope_theta", 500000.0)
+    return LlamaConfig(**kw)
+
+
 def llama_headline(**kw) -> LlamaConfig:
     """The single-chip headline-bench config (~470M params): shared by
     bench.py, tools/exp_mfu.py, and tools/roofline.py so the benchmark

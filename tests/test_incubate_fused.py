@@ -234,3 +234,61 @@ class TestFusedFunctionalAdditions:
         out = IF.fused_linear_activation(x, w)  # default: NO activation
         np.testing.assert_allclose(out.numpy(),
                                    x.numpy() @ w.numpy(), rtol=1e-5)
+
+    def test_masked_multihead_attention_decode(self):
+        """Single-step fused decode attention vs per-row reference:
+        cache updated at each row's slot, attention over the prefix."""
+        rng = np.random.RandomState(6)
+        Bm, Hm, Dm, SMAX = 2, 3, 4, 8
+        cache = rng.randn(2, Bm, Hm, SMAX, Dm).astype("float32")
+        lens = np.array([3, 5], "int32")
+        x = rng.randn(Bm, 3 * Hm * Dm).astype("float32")
+        out, new_cache = IF.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(lens))
+        out, new_cache = out.numpy(), new_cache.numpy()
+        qkv = x.reshape(Bm, 3, Hm, Dm)
+        for b in range(Bm):
+            L = lens[b]
+            kc = cache[0, b].copy()
+            vc = cache[1, b].copy()
+            kc[:, L] = qkv[b, 1]
+            vc[:, L] = qkv[b, 2]
+            np.testing.assert_allclose(new_cache[0, b], kc, rtol=1e-6)
+            s = np.einsum("hd,hsd->hs", qkv[b, 0],
+                          kc[:, :L + 1]) / np.sqrt(Dm)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            ref = np.einsum("hs,hsd->hd", p,
+                            vc[:, :L + 1]).reshape(Hm * Dm)
+            np.testing.assert_allclose(out[b], ref, rtol=2e-4,
+                                       atol=2e-5)
+        with pytest.raises(ValueError, match="unsupported"):
+            IF.masked_multihead_attention(
+                paddle.to_tensor(x), paddle.to_tensor(cache),
+                qkv_out_scale=1.0)
+
+    def test_masked_multihead_attention_broadcast_mask_and_bounds(self):
+        rng = np.random.RandomState(7)
+        Bm, Hm, Dm, SMAX = 2, 2, 4, 6
+        cache = np.zeros((2, Bm, Hm, SMAX, Dm), "float32")
+        x = rng.randn(Bm, 3 * Hm * Dm).astype("float32")
+        lens = np.array([2, 3], "int32")
+        # shared (1,1,1,Smax) additive mask hiding slot 0 everywhere
+        mask = np.zeros((1, 1, 1, SMAX), "float32")
+        mask[..., 0] = -1e30
+        out, _ = IF.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            src_mask=paddle.to_tensor(mask),
+            sequence_lengths=paddle.to_tensor(lens))
+        assert np.isfinite(out.numpy()).all()
+        # sequence_lengths is mandatory in this subset
+        with pytest.raises(ValueError, match="sequence_lengths"):
+            IF.masked_multihead_attention(
+                paddle.to_tensor(x), paddle.to_tensor(cache))
+        # writing past the cache fails loudly, not silently
+        with pytest.raises(ValueError, match="past the cache"):
+            IF.masked_multihead_attention(
+                paddle.to_tensor(x), paddle.to_tensor(cache),
+                sequence_lengths=paddle.to_tensor(
+                    np.array([SMAX, 0], "int32")))
