@@ -373,16 +373,18 @@ def masked_multihead_attention(
     h = num_heads if num_heads is not None else cache_kv.shape[2]
     d = cache_kv.shape[4]
     sequence_lengths = _as_tensor(sequence_lengths)
-    import jax as _jax
-
-    if not isinstance(sequence_lengths._data, _jax.core.Tracer):
-        mx = int(jnp.max(sequence_lengths._data)) if \
-            sequence_lengths.size else 0
-        if mx >= smax:
+    if not isinstance(sequence_lengths._data, jax.core.Tracer):
+        if sequence_lengths.size:
+            mx = int(jnp.max(sequence_lengths._data))
+            mn = int(jnp.min(sequence_lengths._data))
+        else:
+            mx = mn = 0
+        if mx >= smax or mn < 0:
             raise ValueError(
-                f"masked_multihead_attention: sequence length {mx} "
-                f"would write past the cache (Smax={smax}) — the JAX "
-                f"scatter would silently drop it")
+                f"masked_multihead_attention: sequence lengths must "
+                f"be in [0, {smax}) (got min {mn}, max {mx}) — an "
+                f"out-of-range JAX scatter would silently wrap or "
+                f"drop the write")
     args = [x, cache_kv]
     has_mask = src_mask is not None
     if has_mask:
@@ -395,12 +397,12 @@ def masked_multihead_attention(
         lens = rest.pop(0).reshape(-1).astype(jnp.int32)  # (B,)
         qkv = xr.reshape(b, 3, h, d)
         q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-        # write this step's K/V at each row's slot
+        # write ONLY this step's K/V at each row's slot, in the
+        # cache's own dtype — round-tripping the whole cache through
+        # x's dtype would erode previously cached values step by step
         bidx = jnp.arange(b)
-        kc = ck[0].astype(xr.dtype)
-        vc = ck[1].astype(xr.dtype)
-        kc = kc.at[bidx, :, lens, :].set(k_new)
-        vc = vc.at[bidx, :, lens, :].set(v_new)
+        kc = ck[0].at[bidx, :, lens, :].set(k_new.astype(ck.dtype))
+        vc = ck[1].at[bidx, :, lens, :].set(v_new.astype(ck.dtype))
         s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
                        kc.astype(jnp.float32)) / (d ** 0.5)
         if m is not None:
@@ -415,7 +417,7 @@ def masked_multihead_attention(
         s = jnp.where(ok[:, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum("bhs,bhsd->bhd", p, vc.astype(jnp.float32))
-        new_cache = jnp.stack([kc, vc]).astype(ck.dtype)
+        new_cache = jnp.stack([kc, vc])
         return out.astype(xr.dtype).reshape(b, h * d), new_cache
 
     return apply_op("masked_multihead_attention", f, *args, n_outs=2)

@@ -363,14 +363,20 @@ class T5ForConditionalGeneration(Layer):
         return logits, loss
 
     def generate(self, input_ids, max_new_tokens=32,
-                 attention_mask=None, eos_token_id=1):
-        """Greedy seq2seq decode: encode once, then grow the decoder
-        sequence token by token (full-prefix decoder re-run per step —
-        correct and simple; the KV-cached incremental path is the
-        decoder-only families' domain). Returns the generated ids
-        INCLUDING the leading decoder_start token."""
+                 attention_mask=None, eos_token_id=1, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0):
+        """Seq2seq decode: encode once, then grow the decoder sequence
+        token by token (full-prefix decoder re-run per step — correct
+        and simple; the KV-cached incremental path is the decoder-only
+        families' domain). Greedy by default; ``do_sample=True``
+        enables temperature / top-k / top-p via the shared strategy
+        core (models/generation.py). Returns the generated ids
+        INCLUDING the leading decoder_start token; finished rows pad
+        with pad_token_id."""
+        from ..framework.random import next_key
         from ..tensor.creation import to_tensor
         from ..tensor.manipulation import concat
+        from .generation import _step_sample
 
         with no_grad():
             b = input_ids.shape[0]
@@ -379,17 +385,18 @@ class T5ForConditionalGeneration(Layer):
             cur = to_tensor(np.full(
                 (b, 1), self.config.decoder_start_token_id, np.int32))
             done = to_tensor(np.zeros((b,), bool))
+            pad = self.config.pad_token_id
             for _ in range(max_new_tokens):
                 h = self.decoder(cur, enc=enc,
                                  enc_attention_mask=cross_mask)
                 logits = self._head(h)
-
-                pad = self.config.pad_token_id
+                key = next_key() if do_sample else None
 
                 def pick(l, dn):
-                    nxt = jnp.argmax(
-                        l[:, -1].astype(jnp.float32), axis=-1
-                    ).astype(jnp.int32)
+                    nxt = _step_sample(
+                        l[:, -1], None, key, do_sample=do_sample,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, repetition_penalty=1.0)
                     # finished rows pad with pad_token_id (reference
                     # semantics), and padding must not re-trigger eos
                     new_done = dn | (nxt == eos_token_id)

@@ -286,9 +286,27 @@ class TestFusedFunctionalAdditions:
         with pytest.raises(ValueError, match="sequence_lengths"):
             IF.masked_multihead_attention(
                 paddle.to_tensor(x), paddle.to_tensor(cache))
-        # writing past the cache fails loudly, not silently
-        with pytest.raises(ValueError, match="past the cache"):
+        # writing past the cache (or negative lengths) fails loudly
+        with pytest.raises(ValueError, match="out-of-range"):
             IF.masked_multihead_attention(
                 paddle.to_tensor(x), paddle.to_tensor(cache),
                 sequence_lengths=paddle.to_tensor(
                     np.array([SMAX, 0], "int32")))
+        with pytest.raises(ValueError, match="out-of-range"):
+            IF.masked_multihead_attention(
+                paddle.to_tensor(x), paddle.to_tensor(cache),
+                sequence_lengths=paddle.to_tensor(
+                    np.array([-1, 0], "int32")))
+        # mixed-precision: a float32 cache must NOT erode through a
+        # bf16 activation step (review finding)
+        cache32 = paddle.to_tensor(
+            rng.randn(2, Bm, Hm, SMAX, Dm).astype("float32"))
+        xb = paddle.to_tensor(x).astype("bfloat16")
+        _, nc = IF.masked_multihead_attention(
+            xb, cache32, sequence_lengths=paddle.to_tensor(lens))
+        assert nc.numpy().dtype == np.float32
+        ref = cache32.numpy().copy()
+        got = nc.numpy()
+        for b in range(Bm):
+            ref[:, b, :, lens[b], :] = got[:, b, :, lens[b], :]
+        np.testing.assert_array_equal(got, ref)  # untouched slots exact

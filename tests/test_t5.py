@@ -93,3 +93,21 @@ class TestT5:
         c, _ = m(src, decoder_input_ids=dec)
         d, _ = m(src, decoder_input_ids=dec)
         np.testing.assert_array_equal(c.numpy(), d.numpy())
+
+    def test_sampling_generate(self):
+        paddle.seed(0)
+        m = T5ForConditionalGeneration(t5_tiny()).eval()
+        rng = np.random.RandomState(5)
+        src = paddle.to_tensor(rng.randint(2, 512, (2, 6)).astype("int64"))
+        greedy = m.generate(src, max_new_tokens=5, eos_token_id=-1).numpy()
+        paddle.seed(9)
+        k1 = m.generate(src, max_new_tokens=5, eos_token_id=-1,
+                        do_sample=True, top_k=1).numpy()
+        np.testing.assert_array_equal(greedy, k1)  # top_k=1 == greedy
+        paddle.seed(9)
+        a = m.generate(src, max_new_tokens=5, eos_token_id=-1,
+                       do_sample=True, temperature=1.5).numpy()
+        paddle.seed(9)
+        b = m.generate(src, max_new_tokens=5, eos_token_id=-1,
+                       do_sample=True, temperature=1.5).numpy()
+        np.testing.assert_array_equal(a, b)  # seeded reproducibility

@@ -72,8 +72,12 @@ NAMESPACES = [
 
 
 def _public(mod):
+    # a curated __all__ IS the public surface; otherwise fall back to
+    # public callables/classes (re-exported helpers excluded by the
+    # module-type/underscore filters only)
+    declared = getattr(mod, "__all__", None)
     names = []
-    for n in sorted(dir(mod)):
+    for n in sorted(declared if declared is not None else dir(mod)):
         if n.startswith("_"):
             continue
         obj = getattr(mod, n, None)
