@@ -1,0 +1,58 @@
+"""Decoding strategies end-to-end: greedy, sampling, beam — optionally
+on a converted HuggingFace checkpoint.
+
+    python examples/generation_demo.py                 # random tiny llama
+    python examples/generation_demo.py --hf ckpt.pt    # converted weights
+
+Shows the full strategy surface of ``generate()``
+(models/generation.py) on the KV-cache decode path.
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+
+def main(hf_checkpoint=None, max_new=12):
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny()).eval()
+    if hf_checkpoint:
+        import torch
+
+        from paddle_tpu.models.convert import from_hf
+
+        from_hf(model, torch.load(hf_checkpoint, map_location="cpu"))
+
+    prompt = paddle.to_tensor(
+        np.random.RandomState(7).randint(
+            4, model.config.vocab_size, (1, 6)).astype("int32"))
+    runs = {}
+
+    runs["greedy"] = model.generate(prompt, max_new_tokens=max_new)
+    paddle.seed(11)
+    runs["top-k 40, T=0.8"] = model.generate(
+        prompt, max_new_tokens=max_new, do_sample=True, top_k=40,
+        temperature=0.8)
+    paddle.seed(11)
+    runs["nucleus top-p 0.9"] = model.generate(
+        prompt, max_new_tokens=max_new, do_sample=True, top_p=0.9)
+    runs["repetition penalty 1.3"] = model.generate(
+        prompt, max_new_tokens=max_new, repetition_penalty=1.3)
+    runs["beam search (4)"] = model.generate(
+        prompt, max_new_tokens=max_new, num_beams=4)
+
+    s0 = prompt.shape[1]
+    print("prompt:", prompt.numpy()[0].tolist())
+    for name, out in runs.items():
+        print(f"{name:>24}: {out.numpy()[0, s0:].tolist()}")
+    return runs
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hf", type=str, default=None)
+    ap.add_argument("--max-new", type=int, default=12)
+    a = ap.parse_args()
+    main(hf_checkpoint=a.hf, max_new=a.max_new)

@@ -62,3 +62,14 @@ def test_bert_finetune():
                                      min_accuracy=None)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 1.5
+
+
+def test_generation_demo():
+    import generation_demo
+
+    runs = generation_demo.main(max_new=5)
+    assert set(runs) == {"greedy", "top-k 40, T=0.8",
+                         "nucleus top-p 0.9", "repetition penalty 1.3",
+                         "beam search (4)"}
+    for out in runs.values():
+        assert out.shape == [1, 11]

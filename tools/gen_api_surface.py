@@ -71,14 +71,22 @@ NAMESPACES = [
 ]
 
 
+# framework-internal helpers that leak through star imports; they are
+# not API and are excluded from the inventory
+_NOISE = {
+    "apply_op", "infer_meta", "next_key", "np_or_jax", "builtins_any",
+    "builtins_min", "convert_dtype", "to_np_dtype", "annotations",
+}
+
+
 def _public(mod):
-    # a curated __all__ IS the public surface; otherwise fall back to
-    # public callables/classes (re-exported helpers excluded by the
-    # module-type/underscore filters only)
-    declared = getattr(mod, "__all__", None)
+    # union of the curated __all__ (if any) and the filtered dir()
+    # walk: a stale __all__ must not hide real public symbols, and the
+    # dir() walk alone would include leaked helpers (_NOISE)
+    declared = set(getattr(mod, "__all__", ()) or ())
     names = []
-    for n in sorted(declared if declared is not None else dir(mod)):
-        if n.startswith("_"):
+    for n in sorted(declared | set(dir(mod))):
+        if n.startswith("_") or (n in _NOISE and n not in declared):
             continue
         obj = getattr(mod, n, None)
         if isinstance(obj, types.ModuleType):
@@ -121,7 +129,7 @@ def main():
         out.append("")
         out.append(", ".join(f"`{n}`" for n in names) or "(none)")
         out.append("")
-    out.insert(4, f"**Total public symbols: {total}**")
+    out.insert(5, f"**Total public symbols: {total}**")
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "API_SURFACE.md")
     with open(path, "w") as f:
