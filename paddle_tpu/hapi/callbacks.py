@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import time
 
+import numpy as np
+
 
 class Callback:
     def set_params(self, params):
@@ -88,7 +90,7 @@ class EarlyStopping(Callback):
         self.wait = 0
         self.best = None
         self.stopped = False
-        self.mode = "min" if mode in ("auto", "min") else "max"
+        self.mode = _resolve_mode(mode, self.monitor)
 
     def on_eval_end(self, logs=None):
         cur = (logs or {}).get(self.monitor)
@@ -129,3 +131,96 @@ class LRScheduler(Callback):
         s = self._sched()
         if self.by_epoch and s is not None:
             s.step()
+
+
+def _resolve_mode(mode, monitor):
+    """'auto' sniffs accuracy-style monitors (upstream semantics)."""
+    if mode in ("min", "max"):
+        return mode
+    up = ("acc", "auc", "f1", "precision", "recall", "map", "iou")
+    return "max" if any(t in monitor.lower() for t in up) else "min"
+
+
+class ReduceLROnPlateau(Callback):
+    """Drive an optimizer.lr.ReduceOnPlateau scheduler from a monitored
+    metric at epoch end (upstream hapi callback of the same name)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0,
+                 verbose=1):
+        self.monitor = monitor
+        self.kw = dict(factor=factor, patience=patience,
+                       threshold=min_delta, cooldown=cooldown,
+                       min_lr=min_lr)
+        self.mode = _resolve_mode(mode, monitor)
+        self.verbose = verbose
+        self._sched = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        if self._sched is None:
+            if getattr(opt, "_lr_scheduler", None) is not None:
+                raise ValueError(
+                    "ReduceLROnPlateau callback: the optimizer already "
+                    "has an LR scheduler bound — two schedulers would "
+                    "fight over the learning rate; use one or the "
+                    "other")
+            from ..optimizer.lr import ReduceOnPlateau
+
+            self._sched = ReduceOnPlateau(
+                learning_rate=float(opt.get_lr()),
+                mode=self.mode, **self.kw)
+            self._sched._bind(opt._lr_tensor)
+            opt._lr_scheduler = self._sched
+        before = float(self._sched())
+        self._sched.step(float(np.asarray(cur)))
+        after = float(self._sched())
+        if self.verbose and after < before:
+            print(f"Epoch {epoch}: ReduceLROnPlateau reducing "
+                  f"learning rate to {after:.6g}.")
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (upstream hapi.callbacks.VisualDL writes
+    VisualDL event files; that toolkit isn't in the TPU image, so this
+    stand-in appends JSONL records — one object per step/epoch — which
+    the profiler/monitoring stack can tail)."""
+
+    def __init__(self, log_dir="vdl_log"):
+        self.log_dir = log_dir
+        self._fh = None
+        self._step = 0
+
+    def _write(self, kind, idx, logs):
+        import json
+        import os
+
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(
+                os.path.join(self.log_dir, "scalars.jsonl"), "a")
+        rec = {"kind": kind, "index": int(idx)}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(np.asarray(v))
+            except (TypeError, ValueError):
+                continue
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._write("step", self._step, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("epoch", epoch, logs)
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

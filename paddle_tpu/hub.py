@@ -1,0 +1,49 @@
+"""paddle.hub (upstream: python/paddle/hapi/hub.py) — load models from
+a hubconf.py. Remote sources (github/gitee) need egress the TPU pods
+don't have, so only ``source='local'`` is functional; remote requests
+raise with that explanation instead of hanging on a download."""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+
+def _hubconf(repo_dir, source):
+    if source != "local":
+        raise ValueError(
+            f"hub: source={source!r} needs network egress, which TPU "
+            f"pods in this environment don't have — clone the repo and "
+            f"use source='local'")
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"hub: no hubconf.py under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _entrypoint(repo_dir, model, source):
+    fn = getattr(_hubconf(repo_dir, source), model, None)
+    if fn is None:
+        raise ValueError(f"hub: no entrypoint {model!r} in {repo_dir}")
+    return fn
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """Entrypoints exposed by the repo's hubconf.py."""
+    mod = _hubconf(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    return _entrypoint(repo_dir, model, source).__doc__
+
+
+def load(repo_dir, model, *args, source="github", force_reload=False,
+         **kwargs):
+    """Instantiate ``model`` from the repo's hubconf.py entrypoint."""
+    return _entrypoint(repo_dir, model, source)(*args, **kwargs)

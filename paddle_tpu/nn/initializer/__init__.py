@@ -202,3 +202,28 @@ def set_global_initializer(weight_init, bias_init=None):
 
 _GLOBAL_WEIGHT_INIT = None
 _GLOBAL_BIAS_INIT = None
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernels for transposed-conv upsampling
+    (upstream nn.initializer.Bilinear): weight shape
+    [C_out, C_in, kH, kW]; each spatial kernel is the separable
+    bilinear hat filter."""
+
+    def __call__(self, shape, dtype=np.float32):
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer needs a 4-D conv weight shape, "
+                f"got {list(shape)}")
+        co, ci, kh, kw = (int(s) for s in shape)
+
+        def hat(k):
+            f = math.ceil(k / 2.0)
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            x = np.arange(k)
+            return 1 - np.abs(x / f - c)
+
+        kern = np.outer(hat(kh), hat(kw)).astype(np.float32)
+        # upstream fills EVERY (out, in) slice with the hat kernel
+        w = np.broadcast_to(kern, (co, ci, kh, kw)).copy()
+        return jnp.asarray(w).astype(dtype)

@@ -3,6 +3,8 @@ softmax_mask_fuse, identity_loss, hsigmoid_loss (upstream:
 python/paddle/incubate/*, paddle/phi/kernels/gpu/
 segment_pool_kernel.cu, graph_send_recv_kernel.cu,
 hierarchical_sigmoid_kernel_impl.h)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -168,3 +170,59 @@ class TestQuickWins:
         assert paddle.distributed.wait(t) is t
         paddle.distributed.monitored_barrier(timeout=5)
         paddle.jit.enable_to_static(True)
+
+
+class TestHubBilinearCallbacks:
+    def test_hub_local_flow(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_mlp(width=8):\n"
+            "    'a tiny mlp entrypoint'\n"
+            "    import paddle_tpu.nn as nn\n"
+            "    return nn.Linear(4, width)\n")
+        d = str(tmp_path)
+        assert paddle.hub.list(d, source="local") == ["tiny_mlp"]
+        # a remote source must raise even when repo_dir exists locally
+        with pytest.raises(ValueError, match="egress"):
+            paddle.hub.list(d, source="github")
+        assert "tiny" in paddle.hub.help(d, "tiny_mlp", source="local")
+        m = paddle.hub.load(d, "tiny_mlp", width=6, source="local")
+        out = m(paddle.to_tensor(np.ones((1, 4), "float32")))
+        assert list(out.shape) == [1, 6]
+        with pytest.raises(ValueError, match="egress"):
+            paddle.hub.load("no/such/repo", "x", source="github")
+
+    def test_bilinear_initializer(self):
+        w = np.asarray(paddle.nn.initializer.Bilinear()([2, 2, 4, 4]))
+        assert w.shape == (2, 2, 4, 4)
+        np.testing.assert_allclose(w[0, 0], w[0, 0].T)
+        # upstream semantics: every (out, in) slice carries the kernel
+        np.testing.assert_allclose(w[0, 1], w[0, 0])
+        np.testing.assert_allclose(w[1, 0], w[0, 0])
+        with pytest.raises(ValueError, match="4-D"):
+            paddle.nn.initializer.Bilinear()([3, 3])
+
+    def test_visualdl_and_reduce_lr_callbacks(self, tmp_path):
+        import json
+
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.io import TensorDataset
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        model = Model(net)
+        X = np.random.RandomState(0).randn(64, 4).astype("float32")
+        Y = (X @ np.ones((4, 1))).astype("float32")
+        ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(Y)])
+        opt = optim.Adam(1e-2, parameters=net.parameters())
+        model.prepare(opt, paddle.nn.MSELoss())
+        d = str(tmp_path / "vdl")
+        model.fit(ds, epochs=3, batch_size=16, verbose=0, callbacks=[
+            paddle.callbacks.ReduceLROnPlateau(
+                monitor="loss", patience=1, factor=0.5),
+            paddle.callbacks.VisualDL(log_dir=d)])
+        recs = [json.loads(l) for l in
+                open(os.path.join(d, "scalars.jsonl"))]
+        assert any(r["kind"] == "epoch" for r in recs)
+        assert any(r["kind"] == "step" for r in recs)
