@@ -99,7 +99,7 @@ _WAIVER_GROUPS = {
         "where_ zero_",
     "alias of a swept op (same kernel)":
         "negative remainder floor_mod inverse igamma igammac view "
-        "positive",
+        "view_as positive",
     "stochastic output: RNG/determinism contracts tested in dedicated "
     "suites (test_ops dropout tests, test_distribution_signal)":
         "alpha_dropout dropout dropout2d dropout3d "

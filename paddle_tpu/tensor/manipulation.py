@@ -511,6 +511,13 @@ def view(x, shape_or_dtype, name=None):
     return apply_op("view_dtype", lambda a: a.view(d), x, differentiable=False)
 
 
+def view_as(x, other, name=None):
+    """Reshape x to other's shape (upstream paddle.view_as)."""
+    x, other = _as_tensor(x), _as_tensor(other)
+    return apply_op(
+        "view_as", lambda a, b: jnp.reshape(a, b.shape), x, other)
+
+
 # -- stack/split families (upstream: python/paddle/tensor/manipulation.py;
 # thin jnp mappings — XLA concat/slice fuse freely) --------------------------
 def _multi_in(name, jfn, tensors):
