@@ -87,6 +87,14 @@ define_flag("flash_precision_highest", False,
 define_flag("pallas_interpret", False,
             "run the Pallas kernels in interpret mode "
             "off-TPU (CI coverage of the kernel path on CPU)")
+define_flag("dy2static_convert_control_flow", True,
+            "AST-convert if/while in @to_static functions for traced-"
+            "predicate dispatch (upstream: jit/dy2static transformers)")
+define_flag("compilation_cache_dir", "",
+            "persistent XLA compilation-cache directory (empty -> "
+            "~/.cache/paddle_tpu/xla_cache; 'off' disables). Analog of "
+            "the reference persisting optimized inference programs "
+            "(paddle/fluid/inference/api/analysis_predictor.cc)")
 define_flag("moe_dense_dispatch", False,
             "route MoE tokens via the dense (N,E,C) one-hot "
             "dispatch/combine einsums instead of the sparse index "

