@@ -18,7 +18,12 @@ def _unary(op_name, jfn):
 
 
 relu = _unary("relu", jax.nn.relu)
-relu_ = relu
+
+
+def relu_(x, name=None):
+    from ...tensor.math import _inplace
+
+    return _inplace(x, relu(x))
 relu6 = _unary("relu6", jax.nn.relu6)
 sigmoid = _unary("sigmoid", jax.nn.sigmoid)
 tanh = _unary("tanh", jnp.tanh)
@@ -133,7 +138,10 @@ def softmax(x, axis=-1, dtype=None, name=None):
     )
 
 
-softmax_ = softmax
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...tensor.math import _inplace
+
+    return _inplace(x, softmax(x, axis=axis, dtype=dtype))
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):

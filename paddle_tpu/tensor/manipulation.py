@@ -152,7 +152,11 @@ def unsqueeze(x, axis, name=None):
     return apply_op("unsqueeze", lambda a: jnp.expand_dims(a, ax), x)
 
 
-unsqueeze_ = unsqueeze
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._data, x._grad_node = out._data, out._grad_node
+    x._version += 1
+    return x
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):

@@ -108,8 +108,6 @@ copysign = _binary("copysign", jnp.copysign)
 gcd = _binary("gcd", jnp.gcd)
 lcm = _binary("lcm", jnp.lcm)
 
-divide_ = divide
-add_ = add
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
@@ -695,6 +693,14 @@ def exp_(x, name=None):
 
 def floor_(x, name=None):
     return _inplace(x, floor(x))
+
+
+def add_(x, y, name=None):
+    return _inplace(x, add(x, y))
+
+
+def divide_(x, y, name=None):
+    return _inplace(x, divide(x, y))
 
 
 def subtract_(x, y, name=None):

@@ -1419,6 +1419,211 @@ def _affine_grid_np(th, h, w):
          np.ones((h, w))], -1)  # (H, W, 3)
     return np.einsum("hwk,nok->nhwo", grid, th)
 
+# -- r4 sweep growth (VERDICT r3 missing #6): the rows the reference
+# sweeps hardest — conv/pool edge shapes, int dtype grids over the
+# dtype-generic ops, and in-place variants -------------------------------
+
+_INT = ("int32", "int64")
+
+
+def _int_row(name, fn, ref, shapes, op, domain=(-9.0, 9.0)):
+    return OpSpec(f"{name}_int", fn, ref, shapes, domain=domain,
+                  dtypes=_INT, grad=False, op=op)
+
+
+OPS += [
+    # conv edge shapes (low-precision-consistency + numeric grad)
+    OpSpec("conv1d_dilated",
+           lambda x, w: F.conv1d(x, w, dilation=2, padding=2), None,
+           [(2, 3, 8), (4, 3, 3)], op="conv1d"),
+    OpSpec("conv1d_groups", lambda x, w: F.conv1d(x, w, groups=3), None,
+           [(2, 6, 8), (6, 2, 3)], op="conv1d"),
+    OpSpec("conv2d_dilated",
+           lambda x, w: F.conv2d(x, w, dilation=2, padding=2), None,
+           [(1, 3, 6, 6), (4, 3, 3, 3)], op="conv2d"),
+    OpSpec("conv2d_asym_stride",
+           lambda x, w: F.conv2d(x, w, stride=(2, 1), padding=(1, 0)),
+           None, [(1, 3, 6, 6), (4, 3, 3, 3)], op="conv2d"),
+    OpSpec("conv2d_1x1", lambda x, w: F.conv2d(x, w), None,
+           [(1, 3, 5, 5), (6, 3, 1, 1)], op="conv2d"),
+    OpSpec("conv2d_depthwise",
+           lambda x, w: F.conv2d(x, w, groups=4, padding=1), None,
+           [(1, 4, 6, 6), (4, 1, 3, 3)], op="conv2d"),
+    OpSpec("conv2d_rect_kernel",
+           lambda x, w: F.conv2d(x, w, padding=(0, 1)), None,
+           [(1, 3, 5, 6), (4, 3, 1, 3)], op="conv2d"),
+    OpSpec("conv3d_stride2", lambda x, w: F.conv3d(x, w, stride=2),
+           None, [(1, 2, 5, 5, 5), (3, 2, 2, 2, 2)], op="conv3d"),
+    OpSpec("conv2d_transpose_outpad",
+           lambda x, w: F.conv2d_transpose(
+               x, w, stride=2, output_padding=1), None,
+           [(1, 4, 4, 4), (4, 3, 3, 3)], op="conv2d_transpose"),
+    OpSpec("conv1d_transpose_pad",
+           lambda x, w: F.conv1d_transpose(x, w, stride=2, padding=1),
+           None, [(2, 3, 5), (3, 4, 3)], op="conv1d_transpose"),
+    OpSpec("conv3d_transpose_stride2",
+           lambda x, w: F.conv3d_transpose(x, w, stride=2), None,
+           [(1, 2, 3, 3, 3), (2, 3, 2, 2, 2)], op="conv3d_transpose"),
+    # pool edge shapes
+    OpSpec("max_pool2d_overlap",
+           lambda x: F.max_pool2d(x, 3, stride=1, padding=1), None,
+           [(2, 3, 6, 6)], op="max_pool2d"),
+    OpSpec("max_pool2d_ceil",
+           lambda x: F.max_pool2d(x, 2, stride=2, ceil_mode=True), None,
+           [(1, 2, 5, 5)], op="max_pool2d"),
+    OpSpec("max_pool2d_gaps",
+           lambda x: F.max_pool2d(x, 2, stride=3), None,
+           [(2, 3, 8, 8)], op="max_pool2d"),
+    OpSpec("avg_pool2d_overlap",
+           lambda x: F.avg_pool2d(x, 3, stride=2, padding=1), None,
+           [(2, 3, 6, 6)], op="avg_pool2d"),
+    OpSpec("avg_pool2d_inclusive",
+           lambda x: F.avg_pool2d(x, 3, stride=2, padding=1,
+                                  exclusive=False), None,
+           [(2, 3, 6, 6)], op="avg_pool2d"),
+    OpSpec("max_pool1d_pad",
+           lambda x: F.max_pool1d(x, 3, stride=2, padding=1), None,
+           [(2, 3, 9)], op="max_pool1d"),
+    OpSpec("avg_pool3d_stride1",
+           lambda x: F.avg_pool3d(x, 2, stride=1), None,
+           [(1, 2, 4, 4, 4)], op="avg_pool3d"),
+    OpSpec("adaptive_avg_pool2d_uneven",
+           lambda x: F.adaptive_avg_pool2d(x, 3), None,
+           [(2, 3, 5, 5)], op="adaptive_avg_pool2d"),
+    OpSpec("adaptive_max_pool1d_uneven",
+           lambda x: F.adaptive_max_pool1d(x, 3), None,
+           [(2, 3, 7)], op="adaptive_max_pool1d"),
+    OpSpec("pad_reflect_nchw",
+           lambda x: F.pad(x, [1, 1, 1, 1], mode="reflect"), None,
+           [(1, 3, 5, 5)], op="pad"),
+    OpSpec("pad_circular_nchw",
+           lambda x: F.pad(x, [1, 1, 1, 1], mode="circular"), None,
+           [(1, 3, 5, 5)], op="pad"),
+    OpSpec("interpolate_bilinear_align",
+           lambda x: F.interpolate(x, scale_factor=2, mode="bilinear",
+                                   align_corners=True), None,
+           [(1, 3, 4, 4)], op="interpolate"),
+    OpSpec("grid_sample_nearest",
+           lambda x: F.grid_sample(
+               x, paddle.to_tensor(np.random.RandomState(5).uniform(
+                   -0.9, 0.9, (1, 4, 4, 2)).astype("float32")),
+               mode="nearest"), None,
+           [(1, 3, 5, 5)], grad=False, op="grid_sample"),
+    # int dtype grids over the dtype-generic ops
+    _int_row("add", lambda x, y: pmath.add(x, y), np.add,
+             [(4, 5), (4, 5)], "add"),
+    _int_row("subtract", lambda x, y: pmath.subtract(x, y), np.subtract,
+             [(4, 5), (4, 5)], "subtract"),
+    _int_row("multiply", lambda x, y: pmath.multiply(x, y), np.multiply,
+             [(4, 5), (4, 5)], "multiply", domain=(-6.0, 6.0)),
+    _int_row("clip", lambda x: pmath.clip(x, -3, 3),
+             lambda x: np.clip(x, -3, 3), [(4, 5)], "clip"),
+    _int_row("abs", lambda x: pmath.abs(x), np.abs, [(4, 5)], "abs"),
+    _int_row("sum", lambda x: pmath.sum(x, axis=1),
+             lambda x: x.sum(1), [(4, 5)], "sum"),
+    _int_row("prod", lambda x: pmath.prod(x, axis=1),
+             lambda x: x.prod(1), [(4, 5)], "prod", domain=(1.0, 3.0)),
+    _int_row("cumsum", lambda x: pmath.cumsum(x, axis=1),
+             lambda x: x.cumsum(1), [(4, 5)], "cumsum"),
+    _int_row("max", lambda x: pmath.max(x, axis=0),
+             lambda x: x.max(0), [(4, 5)], "max"),
+    _int_row("min", lambda x: pmath.min(x, axis=0),
+             lambda x: x.min(0), [(4, 5)], "min"),
+    _int_row("maximum", lambda x, y: pmath.maximum(x, y), np.maximum,
+             [(4, 5), (4, 5)], "maximum"),
+    _int_row("minimum", lambda x, y: pmath.minimum(x, y), np.minimum,
+             [(4, 5), (4, 5)], "minimum"),
+    _int_row("concat",
+             lambda x, y: manipulation.concat([x, y], axis=1),
+             lambda x, y: np.concatenate([x, y], 1),
+             [(4, 3), (4, 2)], "concat"),
+    _int_row("reshape", lambda x: manipulation.reshape(x, [5, 4]),
+             lambda x: x.reshape(5, 4), [(4, 5)], "reshape"),
+    _int_row("transpose",
+             lambda x: manipulation.transpose(x, [1, 0]),
+             lambda x: x.T, [(4, 5)], "transpose"),
+    _int_row("stack",
+             lambda x, y: manipulation.stack([x, y], axis=0),
+             lambda x, y: np.stack([x, y], 0),
+             [(4, 5), (4, 5)], "stack"),
+    _int_row("tile", lambda x: manipulation.tile(x, [2, 3]),
+             lambda x: np.tile(x, (2, 3)), [(4, 5)], "tile"),
+    _int_row("flip", lambda x: manipulation.flip(x, axis=1),
+             lambda x: x[:, ::-1], [(4, 5)], "flip"),
+    _int_row("roll", lambda x: manipulation.roll(x, 2, axis=1),
+             lambda x: np.roll(x, 2, 1), [(4, 5)], "roll"),
+    _int_row("sort", lambda x: search.sort(x, axis=1),
+             lambda x: np.sort(x, 1), [(4, 5)], "sort"),
+    _int_row("squeeze",
+             lambda x: manipulation.squeeze(x, axis=1),
+             lambda x: x.squeeze(1), [(4, 1, 5)], "squeeze"),
+    _int_row("gather",
+             lambda x: manipulation.gather(
+                 x, paddle.to_tensor(_IDX8.astype(np.int64))),
+             lambda x: x[_IDX8], [(8, 3)], "gather"),
+    _int_row("index_select",
+             lambda x: manipulation.index_select(
+                 x, paddle.to_tensor(np.array([2, 0], np.int64)),
+                 axis=0),
+             lambda x: x[np.array([2, 0])], [(4, 5)], "index_select"),
+    _int_row("take",
+             lambda x: manipulation.take(
+                 x, paddle.to_tensor(np.array([1, 5, 7], np.int64))),
+             lambda x: np.take(x, [1, 5, 7]), [(4, 5)], "take"),
+    _int_row("where",
+             lambda x, y: search.where(logic.greater_than(x, y), x, y),
+             lambda x, y: np.where(x > y, x, y),
+             [(4, 5), (4, 5)], "where"),
+    _int_row("topk_values",
+             lambda x: search.topk(x, 3, axis=1)[0],
+             lambda x: -np.sort(-x, 1)[:, :3], [(4, 7)], "topk"),
+    # in-place variants: semantics == out-of-place, applied in place
+    OpSpec("add_", lambda x, y: pmath.add_(x, y), np.add,
+           [(4, 5), (4, 5)], grad=False, op="add_"),
+    OpSpec("subtract_", lambda x, y: pmath.subtract_(x, y), np.subtract,
+           [(4, 5), (4, 5)], grad=False, op="subtract_"),
+    OpSpec("multiply_", lambda x, y: pmath.multiply_(x, y), np.multiply,
+           [(4, 5), (4, 5)], grad=False, op="multiply_"),
+    OpSpec("divide_", lambda x, y: pmath.divide_(x, y), np.divide,
+           [(4, 5), (4, 5)], grad=False, positive=True, op="divide_"),
+    OpSpec("clip_", lambda x: pmath.clip_(x, -1.0, 1.0),
+           lambda x: np.clip(x, -1.0, 1.0), [(4, 5)], grad=False,
+           op="clip_"),
+    OpSpec("exp_", lambda x: pmath.exp_(x), np.exp, [(4, 5)],
+           grad=False, op="exp_"),
+    OpSpec("floor_", lambda x: pmath.floor_(x), np.floor, [(4, 5)],
+           grad=False, op="floor_"),
+    OpSpec("trunc_", lambda x: pmath.trunc_(x), np.trunc, [(4, 5)],
+           grad=False, op="trunc_"),
+    OpSpec("frac_", lambda x: pmath.frac_(x),
+           lambda x: x - np.trunc(x), [(4, 5)], grad=False, op="frac_"),
+    OpSpec("fill_", lambda x: pmath.fill_(x, 1.5),
+           lambda x: np.full_like(x, 1.5), [(4, 5)], grad=False,
+           op="fill_"),
+    OpSpec("zero_", lambda x: pmath.zero_(x),
+           lambda x: np.zeros_like(x), [(4, 5)], grad=False, op="zero_"),
+    OpSpec("scale_", lambda x: pmath.scale_(x, 2.0, 0.5),
+           lambda x: 2.0 * x + 0.5, [(4, 5)], grad=False, op="scale_"),
+    OpSpec("tril_", lambda x: pmath.tril_(x), np.tril, [(5, 5)],
+           grad=False, op="tril_"),
+    OpSpec("remainder_", lambda x, y: pmath.remainder_(x, y),
+           lambda x, y: np.mod(x, y), [(4, 5), (4, 5)], grad=False,
+           positive=True, op="remainder_"),
+    OpSpec("reshape_", lambda x: manipulation.reshape_(x, [5, 4]),
+           lambda x: x.reshape(5, 4), [(4, 5)], grad=False,
+           op="reshape_"),
+    OpSpec("unsqueeze_", lambda x: manipulation.unsqueeze_(x, 1),
+           lambda x: x[:, None, :], [(4, 5)], grad=False,
+           op="unsqueeze_"),
+    OpSpec("relu_", lambda x: F.relu_(x),
+           lambda x: np.maximum(x, 0.0), [(4, 5)], grad=False,
+           op="relu_"),
+    OpSpec("softmax_", lambda x: F.softmax_(x, axis=-1),
+           lambda x: np.exp(x - x.max(-1, keepdims=True))
+           / np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True),
+           [(4, 5)], grad=False, op="softmax_"),
+]
+
 _IDS = [o.name for o in OPS]
 assert len(set(_IDS)) == len(_IDS), "duplicate op names"
 
@@ -1564,17 +1769,17 @@ class TestOpTable:
         stale = sorted(set(SWEEP_WAIVERS) & swept)
         assert not stale, f"waived ops now swept: {stale}"
 
-    def test_undeclared_lint(self):
-        """dir()-walk defaults are allowed only for ops the sweep
-        declares via an OpSpec row; anything else must be explicitly
-        declared (nondiff/creation sets or a waiver) in op_table.py."""
+    def test_no_undeclared_ops(self):
+        """VERDICT r3 missing #6: the dir()-walk default is an ERROR.
+        Every registry entry must carry explicitly declared metadata —
+        a _DECL_GROUPS profile, _NONDIFF/_CREATION membership, or a
+        waiver. A new public op without a declaration fails here."""
         from paddle_tpu.ops.op_table import undeclared_ops
 
-        swept = {s.op or s.name for s in OPS}
-        bare = [n for n in undeclared_ops() if n not in swept]
+        bare = undeclared_ops()
         assert not bare, (
-            f"ops with neither declared metadata nor a sweep row: "
-            f"{bare}"
+            f"{len(bare)} registry ops carry guessed (dir()-walk) "
+            f"metadata — declare them in op_table._DECL_GROUPS: {bare}"
         )
 
 

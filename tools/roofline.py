@@ -212,6 +212,13 @@ def _peak_live_bytes(jaxpr, donated_invars=frozenset()):
             live += s
         if live > peak:
             peak, peak_at = live, i
+        # outputs never consumed later (DropVars, dead values XLA
+        # would DCE) must not stay counted for the program's remainder
+        for v in eqn.outvars:
+            vid = id(v)
+            if vid not in last_use and vid not in outset \
+                    and vid in sizes:
+                live -= sizes.pop(vid)
         for v in eqn.invars:
             vid = id(v) if not isinstance(v, Literal) else None
             if vid is not None and last_use.get(vid) == i \
@@ -223,6 +230,35 @@ def _peak_live_bytes(jaxpr, donated_invars=frozenset()):
                     continue
                 live -= sizes.pop(vid)
     return peak, peak_at, len(jaxpr.eqns)
+
+
+def trace_compiled_step(step, x, y):
+    """Build the StaticFunction entry for (x, y) and TRACE the exact
+    compiled-step closure to a jaxpr — no compile, no execution.
+    Shared by --liveness and tools/scale_7b.py so the fragile private
+    plumbing (_make_entry convention, state-leaves-first donation)
+    lives in one place. Returns (jaxpr, state, donated_invar_ids)."""
+    import jax
+
+    from paddle_tpu.framework import state as _registry
+    from paddle_tpu.jit.api import _tree_flatten
+
+    _, arg_tree = _tree_flatten(((x, y), {}))
+    state = _registry.snapshot_state_tensors()
+    entry = step._make_entry(state, arg_tree, [True, True], [None, None],
+                             [True, True])
+    state_structs = [
+        jax.ShapeDtypeStruct(tuple(t._data.shape), t._data.dtype)
+        for t in state
+    ]
+    arg_structs = [
+        jax.ShapeDtypeStruct(tuple(x._data.shape), x._data.dtype),
+        jax.ShapeDtypeStruct(tuple(y._data.shape), y._data.dtype),
+    ]
+    closed = jax.make_jaxpr(entry["jitted"].__wrapped__)(
+        state_structs, arg_structs)
+    donated = {id(v) for v in closed.jaxpr.invars[:len(state_structs)]}
+    return closed.jaxpr, state, donated
 
 
 def liveness(argv=None):
@@ -275,22 +311,12 @@ def liveness(argv=None):
     # Build the EXACT compiled-step closure StaticFunction runs, but
     # only TRACE it (no CPU compile/execute of the 470M model): the
     # jaxpr is the platform-independent program the TPU compiles.
-    from paddle_tpu.framework import state as _registry
-    from paddle_tpu.jit.api import _tree_flatten
-
-    _, arg_tree = _tree_flatten(((x, y), {}))
-    state = _registry.snapshot_state_tensors()
-    entry = step._make_entry(state, arg_tree, [True, True], [None, None],
-                             [x.stop_gradient, y.stop_gradient])
-    state_raws = [t._data for t in state]
-    closed = jax.make_jaxpr(entry["jitted"].__wrapped__)(
-        state_raws, [x._data, y._data])
-    jaxpr = closed.jaxpr
-    n_state_leaves = len(jax.tree_util.tree_leaves(state_raws))
-    donated = {id(v) for v in jaxpr.invars[:n_state_leaves]}
+    jaxpr, state, donated = trace_compiled_step(step, x, y)
     peak, peak_at, n_eqns = _peak_live_bytes(jaxpr, donated)
 
-    state_gb = sum(r.size * r.dtype.itemsize for r in state_raws) / 2**30
+    state_gb = sum(
+        int(np.prod(t._data.shape)) * t._data.dtype.itemsize
+        for t in state) / 2**30
     out = {
         "mode": "jaxpr-liveness peak (pre-XLA-fusion upper bound)",
         "config": {"hidden": cfg.hidden_size,
