@@ -168,14 +168,17 @@ class StaticFunction:
             )
             self._cache[key] = entry
 
-        state_raws = [t._data for t in state]
-        out_arrs, new_state, grad_raws = entry["jitted"](
-            state_raws, tensor_raws
+        if "jitted" not in entry:
+            self._finalize_entry(entry, state, tensor_raws)
+        rw_raws = [state[i]._data for i in entry["rw_idx"]]
+        ro_raws = [state[i]._data for i in entry["ro_idx"]]
+        out_arrs, changed_state, grad_raws = entry["jitted"](
+            rw_raws, ro_raws, tensor_raws
         )
         aux = entry["aux"]
 
-        for t, r in zip(state, new_state):
-            t._data = r
+        for i, r in zip(entry["changed_idx"], changed_state):
+            state[i]._data = r
         for i, g in zip(aux["grad_idx"], grad_raws):
             t = state[i]
             if t._grad is None:
@@ -259,12 +262,93 @@ class StaticFunction:
                     t._data = d
                     t._grad = g
 
+        return {"pure": pure, "aux": aux, "n_state": len(state)}
+
+    def _finalize_entry(self, entry, state, tensor_raws):
+        """Trace ``pure`` once (no compile), then DEAD-STRIP the state:
+        the registry snapshot is global, so an unrelated live model's
+        params would otherwise ride through every compiled step — extra
+        transfers, and (worse) the step's output commits them to
+        whatever mesh is active, which changes their sharding and
+        forces a full jax retrace on the next call (the r3→r4
+        order-dependent cache flake). The pruned jaxpr keeps only
+        state inputs the program reads and state outputs that differ
+        from their input (real writes); everything else never enters
+        the compiled program."""
+        import jax.extend.core as jex
+
         ensure_compilation_cache()
+        pure, aux = entry["pure"], entry["aux"]
+        n_s = entry["n_state"]
+        s_structs = [jax.ShapeDtypeStruct(t._data.shape, t._data.dtype)
+                     for t in state]
+        t_structs = [jax.ShapeDtypeStruct(r.shape, r.dtype)
+                     for r in tensor_raws]
+        closed = jax.make_jaxpr(pure)(s_structs, t_structs)
+        j = closed.jaxpr
+
+        n_out = sum(1 for k, _ in aux["out_slots"] if k == "arr")
+        out_arr_vars = list(j.outvars[:n_out])
+        state_out = list(j.outvars[n_out:n_out + n_s])
+        grad_vars = list(j.outvars[n_out + n_s:])
+        state_in = list(j.invars[:n_s])
+
+        changed_idx = [i for i in range(n_s)
+                       if state_out[i] is not state_in[i]]
+        kept_out = out_arr_vars + [state_out[i] for i in changed_idx] \
+            + grad_vars
+        used = set()
+        for eqn in j.eqns:
+            for v in eqn.invars:
+                used.add(id(v))
+        for v in kept_out:
+            used.add(id(v))
+        kept_state_idx = [i for i in range(n_s)
+                          if id(state_in[i]) in used]
+        # Donation splits the kept state: only WRITTEN state (changed
+        # outputs exist to alias into) may be donated — donating a
+        # read-only input would let XLA alias its buffer into some
+        # output and delete the array while state[i]._data still
+        # points at it (second call would read a deleted buffer).
+        changed_set = set(changed_idx)
+        rw_idx = [i for i in kept_state_idx if i in changed_set]
+        ro_idx = [i for i in kept_state_idx if i not in changed_set]
+        kept_order = {i: pos for pos, i in enumerate(kept_state_idx)}
+        kept_in = [state_in[i] for i in kept_state_idx] \
+            + list(j.invars[n_s:])
+        pruned = jex.ClosedJaxpr(
+            jex.Jaxpr(j.constvars, kept_in, kept_out, j.eqns, j.effects,
+                      debug_info=j.debug_info),
+            closed.consts)
+        fn = jex.jaxpr_as_fun(pruned)
+        n_changed = len(changed_idx)
+        rw_pos = [kept_order[i] for i in rw_idx]
+        ro_pos = [kept_order[i] for i in ro_idx]
+        n_kept = len(kept_state_idx)
+
+        def runner(rw_state, ro_state, t_raws):
+            flat_state = [None] * n_kept
+            for p, v in zip(rw_pos, rw_state):
+                flat_state[p] = v
+            for p, v in zip(ro_pos, ro_state):
+                flat_state[p] = v
+            flat = fn(*flat_state, *t_raws)
+            return (tuple(flat[:n_out]),
+                    tuple(flat[n_out:n_out + n_changed]),
+                    tuple(flat[n_out + n_changed:]))
+
         donate = (0,) if (
             self._donate and jax.default_backend() != "cpu"
         ) else ()
-        jitted = jax.jit(pure, donate_argnums=donate)
-        return {"jitted": jitted, "aux": aux}
+        entry["jitted"] = jax.jit(runner, donate_argnums=donate)
+        entry["pruned_jaxpr"] = pruned
+        entry["rw_idx"] = rw_idx
+        entry["ro_idx"] = ro_idx
+        entry["kept_state_idx"] = kept_state_idx
+        entry["changed_idx"] = changed_idx
+        # pure's closure strongly references every snapshot tensor
+        # (zombies included) — drop it now that the jaxpr is the program
+        del entry["pure"]
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

@@ -148,7 +148,7 @@ SWEEP_WAIVERS = {
 _DECL_GROUPS = [
     (True, _FLOAT,
      "float elementwise/unary: tape vjp backward, float dtype sweep",
-     "abs acos acosh asin asinh atan atan2 atanh celu cos cosh deg2rad "
+     "acos acosh asin asinh atan atan2 atanh celu cos cosh deg2rad "
      "digamma elu erf erfinv exp exp2 expm1 float_power gammainc "
      "gammaincc gammaln gelu hardshrink hardsigmoid hardswish hardtanh "
      "hypot i0 i0e i1 i1e label_smooth ldexp leaky_relu lerp lgamma "
@@ -162,12 +162,12 @@ _DECL_GROUPS = [
      "float reduction / linalg / matrix: tape vjp backward",
      "addmm amax amin bmm cdist cholesky cholesky_inverse "
      "cholesky_solve cond corrcoef cov cross cummax cummin cumprod "
-     "cumsum cumulative_trapezoid det diff dist dot einsum fmax fmin "
+     "cumulative_trapezoid det diff dist dot einsum fmax fmin "
      "inner inv kron logcumsumexp logsumexp lu_solve matmul matrix_exp "
-     "matrix_norm matrix_power max mean min mm multi_dot mv nanmean "
-     "nanquantile nansum norm normalize outer pinv prod quantile "
-     "slogdet solve std sum t tensordot trace trapezoid "
-     "triangular_solve vander var vector_norm maximum minimum "
+     "matrix_norm matrix_power mean mm multi_dot mv nanmean "
+     "nanquantile nansum norm normalize outer pinv quantile "
+     "slogdet solve std t tensordot trace trapezoid "
+     "triangular_solve vander var vector_norm "
      "cosine_similarity pairwise_distance pdist"),
     (True, _FLOAT,
      "nn kernel (conv/pool/norm/loss/embedding/resample): tape vjp "
@@ -204,6 +204,10 @@ _DECL_GROUPS = [
      "strided_slice subtract swapaxes take take_along_axis "
      "tensor_split tile topk transpose unbind unflatten unsqueeze "
      "unstack vsplit vstack where"),
+    (True, _ANY,
+     "dtype-generic arithmetic/reduction: int32/int64 swept value-only "
+     "alongside the float grad sweep",
+     "abs cumsum max maximum min minimum prod sum"),
     (False, _ANY,
      "predicate / integer-valued / bit op: no backward",
      "all any bitwise_left_shift bitwise_right_shift frexp "

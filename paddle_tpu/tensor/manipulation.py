@@ -36,10 +36,9 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
-    out = reshape(x, shape)
-    x._data, x._grad_node = out._data, out._grad_node
-    x._version += 1
-    return x
+    from .math import _inplace
+
+    return _inplace(x, reshape(x, shape))
 
 
 def transpose(x, perm, name=None):
@@ -153,10 +152,9 @@ def unsqueeze(x, axis, name=None):
 
 
 def unsqueeze_(x, axis, name=None):
-    out = unsqueeze(x, axis)
-    x._data, x._grad_node = out._data, out._grad_node
-    x._version += 1
-    return x
+    from .math import _inplace
+
+    return _inplace(x, unsqueeze(x, axis))
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -176,10 +174,9 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
 
 
 def flatten_(x, start_axis=0, stop_axis=-1, name=None):
-    out = flatten(x, start_axis, stop_axis)
-    x._data, x._grad_node = out._data, out._grad_node
-    x._version += 1
-    return x
+    from .math import _inplace
+
+    return _inplace(x, flatten(x, start_axis, stop_axis))
 
 
 def cast(x, dtype):

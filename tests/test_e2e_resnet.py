@@ -63,3 +63,10 @@ def test_hapi_model_fit():
     model.fit(data, batch_size=16, epochs=1, verbose=0)
     res = model.evaluate(data, batch_size=16, verbose=0)
     assert "loss" in res
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

@@ -73,3 +73,10 @@ def test_generation_demo():
                          "beam search (4)"}
     for out in runs.values():
         assert out.shape == [1, 11]
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

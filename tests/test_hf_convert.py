@@ -288,3 +288,10 @@ class TestT5Parity:
                             max_new_tokens=6).numpy()
         n = min(ref.shape[1], got.shape[1])
         np.testing.assert_array_equal(got[:, :n], ref[:, :n])
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

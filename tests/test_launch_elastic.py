@@ -454,3 +454,10 @@ def test_object_collectives_across_processes(tmp_path):
         extra_args=("--nproc_per_node", "2"),
     )
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

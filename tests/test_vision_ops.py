@@ -162,3 +162,10 @@ def test_roi_align_edge_box_full_weight():
         _t(np.array([1], "int32")), 2,
     )
     np.testing.assert_allclose(out.numpy(), 3.0)
+
+
+# Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
+# the fast default path; run with `pytest -m slow` (see pytest.ini).
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

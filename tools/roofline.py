@@ -106,8 +106,13 @@ def main():
     from paddle_tpu.framework import state as _registry
 
     entry = next(iter(step._cache.values()))
-    state_raws = [t._data for t in _registry.snapshot_state_tensors()]
-    lowered = entry["jitted"].lower(state_raws, [x._data, y._data])
+    state = _registry.snapshot_state_tensors()
+    # the jitted runner takes the PRUNED state split into written /
+    # read-only groups (see StaticFunction._finalize_entry)
+    lowered = entry["jitted"].lower(
+        [state[i]._data for i in entry["rw_idx"]],
+        [state[i]._data for i in entry["ro_idx"]],
+        [x._data, y._data])
     compiled = lowered.compile()
     cost = compiled.cost_analysis()
     c = cost[0] if isinstance(cost, (list, tuple)) else cost
@@ -233,13 +238,12 @@ def _peak_live_bytes(jaxpr, donated_invars=frozenset()):
 
 
 def trace_compiled_step(step, x, y):
-    """Build the StaticFunction entry for (x, y) and TRACE the exact
-    compiled-step closure to a jaxpr — no compile, no execution.
+    """Build the StaticFunction entry for (x, y) and trace+prune it to
+    the EXACT jaxpr the compiled step ships (dead-stripped state,
+    donation only on written state) — no compile, no execution.
     Shared by --liveness and tools/scale_7b.py so the fragile private
-    plumbing (_make_entry convention, state-leaves-first donation)
-    lives in one place. Returns (jaxpr, state, donated_invar_ids)."""
-    import jax
-
+    plumbing lives in one place. Returns (jaxpr, state,
+    donated_invar_ids)."""
     from paddle_tpu.framework import state as _registry
     from paddle_tpu.jit.api import _tree_flatten
 
@@ -247,18 +251,13 @@ def trace_compiled_step(step, x, y):
     state = _registry.snapshot_state_tensors()
     entry = step._make_entry(state, arg_tree, [True, True], [None, None],
                              [True, True])
-    state_structs = [
-        jax.ShapeDtypeStruct(tuple(t._data.shape), t._data.dtype)
-        for t in state
-    ]
-    arg_structs = [
-        jax.ShapeDtypeStruct(tuple(x._data.shape), x._data.dtype),
-        jax.ShapeDtypeStruct(tuple(y._data.shape), y._data.dtype),
-    ]
-    closed = jax.make_jaxpr(entry["jitted"].__wrapped__)(
-        state_structs, arg_structs)
-    donated = {id(v) for v in closed.jaxpr.invars[:len(state_structs)]}
-    return closed.jaxpr, state, donated
+    step._finalize_entry(entry, state, [x._data, y._data])
+    jaxpr = entry["pruned_jaxpr"].jaxpr
+    kept = entry["kept_state_idx"]
+    rw = set(entry["rw_idx"])
+    donated = {id(v) for pos, v in enumerate(jaxpr.invars[:len(kept)])
+               if kept[pos] in rw}
+    return jaxpr, state, donated
 
 
 def liveness(argv=None):
