@@ -1,0 +1,47 @@
+"""Append one guaranteed-valid JSONL record for a chip-window step.
+
+Usage: python tools/_window_log.py LOG NAME RC OUT_FILE ERR_FILE
+Takes the LAST parseable JSON line of OUT_FILE as the step result
+(bench.py's contract); anything else is recorded as raw text. All
+strings go through json.dumps, so tracebacks with quotes/backslashes/
+control chars can never corrupt the log (the failure records are the
+ones the log exists to preserve).
+"""
+import json
+import sys
+import time
+
+
+def main():
+    log, name, rc, out_file, err_file = sys.argv[1:6]
+    rec = {"step": name, "rc": int(rc),
+           "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    try:
+        lines = open(out_file, errors="replace").read().strip().splitlines()
+    except OSError:
+        lines = []
+    result = None
+    for line in reversed(lines):
+        try:
+            result = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if result is not None:
+        rec["result"] = result
+        if int(rc) != 0:
+            rec["note"] = ("nonzero exit; result is the last JSON line "
+                           "printed BEFORE the failure")
+    elif lines:
+        rec["raw_tail"] = "\n".join(lines[-3:])[-400:]
+    if int(rc) != 0:
+        try:
+            rec["err"] = open(err_file, errors="replace").read()[-400:]
+        except OSError:
+            pass
+    with open(log, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

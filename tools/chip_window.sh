@@ -1,0 +1,55 @@
+#!/bin/bash
+# One-command capture of everything a TPU tunnel window allows, in
+# priority order (VERDICT r3 next-round #1). Run ONLY after a probe
+# shows the chip ([TPU v5 lite] in jax.devices()) and run it SOLO —
+# no concurrent pytest/python touching jax (axon claim wedges).
+#
+#   timeout 90 python -c "import jax; print(jax.devices())"  # probe
+#   bash tools/chip_window.sh                                # capture
+#
+# Every step appends one validated JSONL record (tools/_window_log.py)
+# to BENCH_WINDOW_r04.jsonl, so a mid-window wedge loses only the step
+# in flight. Priority: headline MFU (+ profiler trace in the same
+# run), the never-measured single-chip configs, kernel/serving staged
+# benches, experiments, and the recompute-headline experiment.
+set -u
+cd "$(dirname "$0")/.."
+LOG=BENCH_WINDOW_r04.jsonl
+echo "{\"window_start\": \"$(date -u +%FT%TZ)\", \"rev\": \"$(git rev-parse --short HEAD)\"}" >> "$LOG"
+
+FIRST=1
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  # cool-down BEFORE each claim cycle except the first (axon playbook:
+  # leave minutes between cycles; a failed/wedged claim needs it most).
+  # No trailing sleep burns window time after the last step.
+  if [ "$FIRST" -eq 0 ]; then sleep 20; fi
+  FIRST=0
+  echo "=== $name ($(date -u +%T)) ===" >&2
+  timeout "$tmo" env BENCH_SKIP_PREFLIGHT=1 "$@" \
+    > /tmp/chip_step_out 2> /tmp/chip_step_err
+  local rc=$?
+  python tools/_window_log.py "$LOG" "$name" "$rc" \
+    /tmp/chip_step_out /tmp/chip_step_err
+  return $rc
+}
+
+# 1. headline MFU + profiler trace (the round's primary record)
+run headline_llama 2400 env BENCH_PROFILE=1 python bench.py --only llama
+# 2. the four never-measured single-chip configs
+run resnet50 1200 python bench.py --only resnet50
+run gpt3 1500 python bench.py --only gpt3
+run vitl 1500 python bench.py --only vitl
+run ernie_moe 1500 python bench.py --only ernie_moe
+# 3. staged kernel/serving benches
+run varlen 900 python bench.py --only varlen
+run decode 900 python bench.py --only decode
+run serving 1200 python bench.py --only serving
+# 4. experiments (best-effort)
+run exp_mfu 1800 python tools/exp_mfu.py
+run exp_vpp 1800 python tools/exp_vpp.py
+# 5. headline again with explicit recompute (SCALE_7B resolving experiment)
+run headline_recompute 2400 env BENCH_RECOMPUTE=1 python bench.py --only llama
+
+echo "{\"window_end\": \"$(date -u +%FT%TZ)\"}" >> "$LOG"
+echo "window capture complete; see $LOG" >&2
