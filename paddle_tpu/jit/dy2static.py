@@ -5,8 +5,9 @@ transformers/) — the reference rewrites the Python AST of a decorated
 function so data-dependent ``if``/``while`` become cond/while ops.
 
 TPU-native design: the rewrite targets RUNTIME DISPATCH helpers, not
-graph ops. Every ``if``/``while`` in the decorated function's own
-source is rewritten to call ``_cvt_if``/``_cvt_while``:
+graph ops. Every ``if``/``while``/``for ... in range()`` in the
+decorated function's own source is rewritten to call
+``_cvt_if``/``_cvt_while``/``_cvt_for_range``:
 
 * predicate concrete (plain Python / eager Tensor) -> the original
   Python branch/loop runs, byte-for-byte semantics;
@@ -25,6 +26,10 @@ predicate then raises the loud trace-time error from
 ``framework.core``): branches/bodies containing return/break/continue/
 yield/global/nonlocal/import or nested def/class; side-effect-only
 branches (no variable assigned); loops carrying non-array state.
+A converted ``for`` carries its loop variable out with python's leak
+semantics (last executed value; pre-bound value survives an empty
+range); iteration over non-range iterables (lists, concrete tensors)
+is left untouched — it unrolls correctly at trace time.
 """
 from __future__ import annotations
 
@@ -133,9 +138,23 @@ def _is_arr(x):
                           bool, complex)) and not isinstance(x, Undefined)
 
 
-def _cvt_while(cond_fn, body_fn, operands, names):
+def _seed_trips(operands, names, trip_seeds):
+    """Seed still-Undefined slots that are NESTED for-range trip
+    variables with 0 — the nested converted loop overwrites them from
+    its own trip counter before any read, but the enclosing carry
+    needs a typed initial value."""
+    if not trip_seeds:
+        return operands
+    return tuple(
+        0 if (isinstance(v, Undefined) and names[k] in trip_seeds) else v
+        for k, v in enumerate(operands)
+    )
+
+
+def _cvt_while(cond_fn, body_fn, operands, names, trip_seeds=()):
     from ..framework.core import Tensor, no_grad
 
+    operands = _seed_trips(operands, names, trip_seeds)
     first = cond_fn(operands)
     if not _is_traced(first):
         vals = operands
@@ -200,9 +219,112 @@ def _cvt_while(cond_fn, body_fn, operands, names):
     )
 
 
+def _cvt_for_range(rargs, body_fn, operands, names, target,
+                   trip_seeds=()):
+    """``for t in range(...)`` dispatch: concrete bounds run the plain
+    Python loop; a traced stop/start lowers to lax.while_loop with the
+    trip variable in the carry (body under no_grad, like _cvt_while).
+    The target is CARRIED (python's loop-variable leak semantics:
+    after the loop it holds the last executed value; a pre-bound value
+    survives a zero-iteration range). The range step must be a
+    concrete Python int (its sign fixes the loop direction at trace
+    time)."""
+    from ..framework.core import Tensor, no_grad
+
+    if len(rargs) == 1:
+        start, stop, step = 0, rargs[0], 1
+    elif len(rargs) == 2:
+        start, stop, step = rargs[0], rargs[1], 1
+    else:
+        start, stop, step = rargs
+
+    if _is_traced(step):
+        raise TypeError(
+            "converted `for` over range(): the step must be a concrete "
+            "Python int (a traced step would make the loop direction "
+            "unknowable at trace time)"
+        )
+    step = int(step)
+    if step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+
+    # seed an unbound target slot with `start` — the body overwrites it
+    # from the trip variable on every iteration anyway
+    t_slot = names.index(target)
+    if isinstance(operands[t_slot], Undefined):
+        operands = tuple(
+            start if k == t_slot else v for k, v in enumerate(operands)
+        )
+    operands = _seed_trips(operands, names, trip_seeds)
+
+    if not (_is_traced(start) or _is_traced(stop)):
+        vals = operands
+        for i in range(int(start), int(stop), step):
+            vals = body_fn(i, vals)
+        return vals
+
+    for name, v in zip(names, operands):
+        if isinstance(v, Undefined):
+            raise TypeError(
+                f"converted `for` on a traced range: loop variable "
+                f"'{name}' is unbound before the loop"
+            )
+        raw = v._data if isinstance(v, Tensor) else v
+        if not (isinstance(raw, (jax.Array, jax.core.Tracer))
+                or _is_arr(raw)):
+            raise TypeError(
+                f"converted `for` on a traced range: loop variable "
+                f"'{name}' ({type(v).__name__}) is not an array; a "
+                "traced loop can only carry tensors/scalars"
+            )
+
+    was_tensor = [isinstance(v, Tensor) for v in operands]
+    raws = [v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            for v in operands]
+    s_raw = start._data if isinstance(start, Tensor) else jnp.asarray(start)
+    e_raw = stop._data if isinstance(stop, Tensor) else jnp.asarray(stop)
+
+    def wrap(rs):
+        return tuple(
+            Tensor(r, stop_gradient=True) if wt else r
+            for r, wt in zip(rs, was_tensor)
+        )
+
+    def c(carry):
+        i = carry[0]
+        return (i < e_raw) if step > 0 else (i > e_raw)
+
+    def b(carry):
+        i = carry[0]
+        with no_grad():
+            outs = body_fn(Tensor(i, stop_gradient=True),
+                           wrap(carry[1:]))
+        return (i + step,) + tuple(
+            o._data if isinstance(o, Tensor) else jnp.asarray(o)
+            for o in outs
+        )
+
+    try:
+        final = jax.lax.while_loop(
+            c, b, (jnp.asarray(s_raw),) + tuple(raws))
+    except TypeError as e:
+        raise TypeError(
+            "converted `for` on a traced range: a loop-carried "
+            f"variable ({', '.join(names)}) changed dtype/shape "
+            "between iterations; keep each loop variable's dtype and "
+            f"shape fixed (initialize with an explicit dtype). "
+            f"From jax: {e}"
+        ) from e
+    return tuple(
+        Tensor(r, stop_gradient=True) if wt else r
+        for r, wt in zip(final[1:], was_tensor)
+    )
+
+
 _HELPERS = {
     "__pt_cvt_if": _cvt_if,
     "__pt_cvt_while": _cvt_while,
+    "__pt_cvt_for": _cvt_for_range,
     "__pt_pack": _pack,
 }
 
@@ -257,9 +379,28 @@ def _name_targets(t):
         yield from _name_targets(t.value)
 
 
+def _nested_range_targets(stmts):
+    """Trip-variable names of for-range loops anywhere in the block
+    (over-approximation of 'will be converted' is safe: seeds apply
+    only to slots that are still Undefined at runtime)."""
+    out = set()
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, ast.For):
+                it = node.iter
+                if (isinstance(it, ast.Call)
+                        and isinstance(it.func, ast.Name)
+                        and it.func.id == "range"
+                        and isinstance(node.target, ast.Name)):
+                    out.add(node.target.id)
+    return out
+
+
 def _assigned(stmts):
     """Plain names (re)bound anywhere in the statement list (subscript/
-    attribute stores are excluded — _safe_block already rejects them)."""
+    attribute stores are excluded — _safe_block already rejects them).
+    Targets of nested CONVERTIBLE for-range loops are included (the
+    converted loop carries its own target out, python-semantics)."""
     names = set()
     for s in stmts:
         for node in ast.walk(s):
@@ -350,6 +491,72 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             value=call)
         return [t_def, f_def, assign]
 
+    def visit_For(self, node):
+        """Convert ``for <name> in range(...)`` (the reference's
+        for->while transform). Anything else — iteration over a plain
+        Python iterable or a concrete Tensor — unrolls correctly at
+        trace time and is left alone. The target rides the carry, so
+        python's loop-variable leak semantics hold."""
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and 1 <= len(it.args) <= 3
+                and not it.keywords
+                and isinstance(node.target, ast.Name)
+                and not node.orelse and _safe_block(node.body)):
+            self.generic_visit(node)
+            return node
+        target = node.target.id
+        names = sorted(_assigned(node.body) | {target})
+        names = [n for n in names if not n.startswith("__pt_")]
+        if names == [target]:
+            self.generic_visit(node)
+            return node
+        self.n += 1
+        self.converted += 1
+        i = self.n
+        b_name = f"__pt_forbody_{i}"
+        body = [ast.Assign(
+            targets=[ast.Name(id=target, ctx=ast.Store())],
+            value=ast.Name(id="__pt_i", ctx=ast.Load()))] + node.body
+        b_def = ast.FunctionDef(
+            name=b_name,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg="__pt_i"), ast.arg(arg="__pt_args")],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store())
+                          for n in names],
+                    ctx=ast.Store())],
+                value=ast.Name(id="__pt_args", ctx=ast.Load()))]
+            + body
+            + [ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+                ctx=ast.Load()))],
+            decorator_list=[], returns=None)
+        b_def = self.generic_visit(b_def)
+        call = ast.Call(
+            func=ast.Name(id="__pt_cvt_for", ctx=ast.Load()),
+            args=[ast.Tuple(elts=list(it.args), ctx=ast.Load()),
+                  ast.Name(id=b_name, ctx=ast.Load()),
+                  self._pack_call(names),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load()),
+                  ast.Constant(value=target),
+                  ast.Tuple(elts=[
+                      ast.Constant(value=n)
+                      for n in sorted(_nested_range_targets(node.body))],
+                      ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                ctx=ast.Store())],
+            value=call)
+        return [b_def, assign]
+
     def visit_While(self, node):
         if node.orelse or not _safe_block(node.body):
             self.generic_visit(node)
@@ -390,7 +597,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                   ast.Name(id=b_name, ctx=ast.Load()),
                   self._pack_call(names),
                   ast.Tuple(elts=[ast.Constant(value=n) for n in names],
-                            ctx=ast.Load())],
+                            ctx=ast.Load()),
+                  ast.Tuple(elts=[
+                      ast.Constant(value=n)
+                      for n in sorted(_nested_range_targets(node.body))],
+                      ctx=ast.Load())],
             keywords=[])
         assign = ast.Assign(
             targets=[ast.Tuple(

@@ -219,6 +219,105 @@ class TestConversionSafety:
             fn(paddle.to_tensor(np.array([8], np.int32)))
 
 
+class TestConvertedForRange:
+    def test_traced_stop_lowers_to_loop(self):
+        @paddle.jit.to_static
+        def fn(x, n):
+            acc = paddle.zeros_like(x)
+            for i in range(n):
+                # i is an int on the concrete path, a Tensor on the
+                # traced path — arithmetic works for both
+                acc = acc + x * (i + 1.0)
+            return acc
+
+        x = paddle.to_tensor(np.full((3,), 1.0, np.float32))
+        out = fn(x, paddle.to_tensor(np.int32(4)))
+        # 1+2+3+4 = 10
+        np.testing.assert_allclose(_val(out), np.full(3, 10.0), rtol=1e-6)
+        out2 = fn(x, paddle.to_tensor(np.int32(2)))
+        np.testing.assert_allclose(_val(out2), np.full(3, 3.0), rtol=1e-6)
+
+    def test_concrete_range_semantics_preserved(self):
+        @paddle.jit.to_static
+        def fn(x):
+            acc = x
+            for k in range(3):
+                acc = acc * 2.0
+            return acc
+
+        out = fn(paddle.to_tensor(np.float32([1.0])))
+        np.testing.assert_allclose(_val(out), [8.0])
+
+    def test_start_stop_with_step(self):
+        @paddle.jit.to_static
+        def fn(x, n):
+            s = paddle.zeros_like(x)
+            for i in range(1, n, 2):
+                s = s + i * 1.0
+            return s
+
+        out = fn(paddle.to_tensor(np.float32([0.0])),
+                 paddle.to_tensor(np.int32(6)))
+        np.testing.assert_allclose(_val(out), [1.0 + 3.0 + 5.0])
+
+    def test_nested_for_with_traced_outer_bound(self):
+        @paddle.jit.to_static
+        def fn(x, n):
+            s = paddle.zeros_like(x)
+            for i in range(n):
+                for j in range(3):
+                    s = s + x
+            return s
+
+        out = fn(paddle.to_tensor(np.float32([1.0])),
+                 paddle.to_tensor(np.int32(2)))
+        np.testing.assert_allclose(_val(out), [6.0])
+
+    def test_loop_variable_leaks_like_python(self):
+        def raw(x):
+            k = 10.0
+            for k in range(3):
+                x = x + 1.0
+            return x * (k * 1.0 + 1.0)
+
+        st = paddle.jit.to_static(raw)
+        x = paddle.to_tensor(np.float32([1.0]))
+        np.testing.assert_allclose(_val(st(x)), _val(raw(x)))
+        # zero-iteration range: pre-bound value survives
+        def raw0(x):
+            k = 7.0
+            for k in range(0):
+                x = x + 1.0
+            return x * k
+
+        st0 = paddle.jit.to_static(raw0)
+        np.testing.assert_allclose(_val(st0(x)), _val(raw0(x)))
+
+    def test_for_dtype_drift_raises_loud(self):
+        @paddle.jit.to_static
+        def fn(x, n):
+            c = x
+            for i in range(n):
+                c = c / 2
+            return c
+
+        with pytest.raises(TypeError, match="dtype"):
+            fn(paddle.to_tensor(np.array([8], np.int32)),
+               paddle.to_tensor(np.int32(3)))
+
+    def test_iter_over_concrete_tensor_unrolls(self):
+        # non-range iteration is untouched: concrete tensors unroll
+        @paddle.jit.to_static
+        def fn(x):
+            s = paddle.zeros([2])
+            for row in x:
+                s = s + row
+            return s
+
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        np.testing.assert_allclose(_val(fn(x)), [6.0, 9.0])
+
+
 class TestCacheStability:
     def test_foreign_state_pruned_from_compiled_step(self):
         """The registry snapshot is global; the compiled step must
