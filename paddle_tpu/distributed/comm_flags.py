@@ -1,0 +1,102 @@
+"""Communication/compiler flag propagation — the TPU-native seat of
+the reference's comm_overlap/bucketing options.
+
+Upstream, overlap is hand-built: the EagerReducer buckets gradients and
+launches async ncclAllReduce on a comm stream during backward
+(paddle/fluid/distributed/collective/reducer.cc). On TPU that job
+belongs to the XLA latency-hiding scheduler, which lowers collectives
+to async start/done pairs and schedules compute into the gap — and on
+current XLA/libtpu builds it is ON BY DEFAULT, so there is nothing to
+inject for the common case. (Historic spellings like
+``--xla_tpu_enable_latency_hiding_scheduler`` are not even registered
+in this jaxlib build — XLA aborts the process on unknown XLA_FLAGS,
+verified locally — so blind injection would be worse than nothing.)
+
+What still needs a mechanism is DEPLOYMENT flag propagation: tuning
+flags (e.g. ``--xla_tpu_scoped_vmem_limit_kib``, SparseCore offload
+toggles, collective-matmul thresholds) must reach EVERY worker's
+environment before its backend initializes. This module is that
+mechanism:
+
+* ``FLAGS_xla_comm_extra_flags`` — a space-separated XLA flag string
+  (set via env ``FLAGS_xla_comm_extra_flags=...`` or
+  ``paddle_tpu.set_flags``);
+* ``apply(env)`` — merge into a worker environment dict; the launch
+  CLI calls it for every spawned worker;
+* ``apply_in_process()`` — best-effort for single-process runs: only
+  applies if the jax backend has not been created yet, and logs why
+  when it cannot (flags set after backend init are silently inert —
+  the failure mode worth a loud message).
+"""
+from __future__ import annotations
+
+import os
+
+
+def _extra() -> str:
+    try:
+        from ..framework.flags import flag
+
+        return str(flag("xla_comm_extra_flags")).strip()
+    except Exception:
+        return ""
+
+
+def flag_string(existing: str = "") -> str:
+    """Configured extra flags whose NAME is not already pinned in
+    `existing` (exact name comparison — XLA flag names share long
+    prefixes, so substring matching would silently drop flags)."""
+    pinned = {tok.split("=")[0] for tok in existing.split()}
+    return " ".join(
+        tok for tok in _extra().split()
+        if tok.split("=")[0] not in pinned
+    )
+
+
+def apply(env: dict) -> dict:
+    """Merge the configured flags into a worker environment dict
+    (no-op for flags the user already pinned in XLA_FLAGS)."""
+    add = flag_string(env.get("XLA_FLAGS", ""))
+    if add:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + add).strip()
+    return env
+
+
+def backend_initialized() -> bool:
+    """Has a jax backend already been created in this process?"""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception as e:
+        # private-API drift (no public is-initialized signal exists):
+        # be conservative — never claim flags took effect when they
+        # might not have — but say WHY, loudly, once
+        import logging
+
+        logging.getLogger("paddle_tpu").warning(
+            "cannot determine jax backend state (%s); assuming "
+            "initialized — FLAGS_xla_comm_extra_flags will only apply "
+            "via the launch CLI or a pre-set XLA_FLAGS env", e)
+        return True
+
+
+def apply_in_process() -> bool:
+    """Single-process path (fleet.init without the launch CLI): set the
+    flags if the backend hasn't initialized yet. Returns True when the
+    flags will take effect."""
+    add = flag_string(os.environ.get("XLA_FLAGS", ""))
+    if not add:
+        return True  # nothing configured / already all present
+    if backend_initialized():
+        import logging
+
+        logging.getLogger("paddle_tpu").warning(
+            "FLAGS_xla_comm_extra_flags not applied: the jax backend "
+            "is already initialized. Launch via paddle_tpu."
+            "distributed.launch (which sets them for every worker) or "
+            "export XLA_FLAGS='%s' before starting python.", add)
+        return False
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + add).strip()
+    return True

@@ -27,6 +27,9 @@ class Fleet:
 
     def init(self, role_maker=None, is_collective=True, strategy=None,
              log_level="INFO"):
+        from ..comm_flags import apply_in_process
+
+        apply_in_process()
         self._strategy = strategy or DistributedStrategy()
         self._hcg = HybridCommunicateGroup(
             hybrid_configs=self._strategy.hybrid_configs

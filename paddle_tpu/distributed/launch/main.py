@@ -164,6 +164,11 @@ class NodeController:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get(
             "PYTHONPATH", ""
         )
+        # comm-overlap compiler flags must be in the environment BEFORE
+        # the worker's jax backend initializes (see comm_flags module)
+        from ..comm_flags import apply as _apply_comm_flags
+
+        _apply_comm_flags(env)
         env.update({
             "PADDLE_TRAINER_ID": str(global_rank),
             "PADDLE_TRAINERS_NUM": str(world),

@@ -87,6 +87,11 @@ define_flag("flash_precision_highest", False,
 define_flag("pallas_interpret", False,
             "run the Pallas kernels in interpret mode "
             "off-TPU (CI coverage of the kernel path on CPU)")
+define_flag("xla_comm_extra_flags", "",
+            "space-separated XLA flags propagated to every launched "
+            "worker's environment before backend init (deployment "
+            "tuning; distributed/comm_flags.py). The latency-hiding "
+            "scheduler itself is default-on in current XLA")
 define_flag("dy2static_convert_control_flow", True,
             "AST-convert if/while in @to_static functions for traced-"
             "predicate dispatch (upstream: jit/dy2static transformers)")

@@ -1,0 +1,65 @@
+"""XLA flag propagation to workers (distributed/comm_flags.py) — the
+reference comm_overlap-analog's configuration path. Upstream:
+DistributedStrategy options reach every rank because the launcher
+re-execs them; here XLA_FLAGS must be in each worker env before its
+backend initializes.
+"""
+import paddle_tpu as paddle
+from paddle_tpu.distributed import comm_flags
+
+
+class TestCommFlags:
+    def teardown_method(self):
+        paddle.set_flags({"FLAGS_xla_comm_extra_flags": ""})
+
+    def test_apply_merges_without_duplicates(self):
+        paddle.set_flags({"FLAGS_xla_comm_extra_flags":
+                          "--xla_foo=1 --xla_bar=2"})
+        env = {"XLA_FLAGS": "--xla_foo=0"}
+        comm_flags.apply(env)
+        # user-pinned --xla_foo wins; --xla_bar appended once
+        assert env["XLA_FLAGS"] == "--xla_foo=0 --xla_bar=2"
+        comm_flags.apply(env)
+        assert env["XLA_FLAGS"].count("--xla_bar") == 1
+
+    def test_prefix_name_not_confused(self):
+        # --xla_dump must survive when --xla_dump_to is pinned
+        paddle.set_flags({"FLAGS_xla_comm_extra_flags": "--xla_dump=hlo"})
+        env = {"XLA_FLAGS": "--xla_dump_to=/tmp"}
+        comm_flags.apply(env)
+        assert "--xla_dump=hlo" in env["XLA_FLAGS"]
+
+    def test_apply_noop_when_unconfigured(self):
+        env = {}
+        comm_flags.apply(env)
+        assert "XLA_FLAGS" not in env
+
+    def test_in_process_refuses_after_backend_init(self):
+        # conftest initialized the CPU backend long ago
+        paddle.set_flags({"FLAGS_xla_comm_extra_flags": "--xla_baz=1"})
+        assert comm_flags.backend_initialized()
+        assert comm_flags.apply_in_process() is False
+        import os
+
+        assert "--xla_baz" not in os.environ.get("XLA_FLAGS", "")
+
+    def test_launch_worker_env_carries_flags(self):
+        paddle.set_flags({"FLAGS_xla_comm_extra_flags":
+                          "--xla_quux=7"})
+        import argparse
+
+        from paddle_tpu.distributed.launch.main import NodeController
+
+        args = argparse.Namespace(
+            nproc_per_node=2, master=None, nnodes="1", node_rank=0,
+            job_id="t", log_dir="/tmp/pt_launch_test", devices=None,
+        )
+        c = NodeController.__new__(NodeController)
+        c.args = args
+        c.nnodes = 1
+        c.node_rank = 0
+        c.endpoints = ["127.0.0.1"]
+        c.store = None
+        c.generation = 0
+        env = c._worker_env(0)
+        assert "--xla_quux=7" in env["XLA_FLAGS"]
