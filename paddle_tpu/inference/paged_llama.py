@@ -39,6 +39,17 @@ class PagedLlamaAdapter:
         self.model = model
         cfg = model.config
         self.cfg = cfg
+        w = int(getattr(cfg, "sliding_window", 0) or 0)
+        if w and w < int(max_length or cfg.max_position_embeddings):
+            # the paged attend has no window mask yet — serving a
+            # Mistral-style model past its window would silently attend
+            # to the full prefix (wrong logits); fail loudly instead
+            raise NotImplementedError(
+                f"PagedLlamaAdapter: sliding_window={w} is narrower "
+                f"than max_length; the paged decode path has no window "
+                "mask yet. Cap max_length at the window or use "
+                "LlamaForCausalLM.generate (dense cache, windowed)."
+            )
         if dtype is None:
             dtype = model.model.embed_tokens.weight._data.dtype
         self.max_length = int(max_length or cfg.max_position_embeddings)

@@ -42,6 +42,9 @@ from .llama import (
     llama3_70b,
     llama_tiny,
     llama_pipeline_model,
+    mistral_7b,
+    qwen2_0_5b,
+    qwen2_7b,
 )
 from .gpt import (
     GPTConfig,
