@@ -57,3 +57,4 @@ from .gpt import (
     gpt_pipeline_model,
     gpt_tiny,
 )
+from .generation import generate, speculative_generate  # noqa: E402

@@ -144,6 +144,116 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         return concat(tokens, axis=1)
 
 
+def speculative_generate(model, draft_model, input_ids,
+                         max_new_tokens=32, draft_k=4,
+                         eos_token_id=None, return_stats=False):
+    """Greedy speculative decoding: ``draft_model`` proposes
+    ``draft_k`` tokens autoregressively, ``model`` verifies them in
+    ONE decode_step, and the longest matching prefix (+ the target's
+    own next token) is accepted — output is token-for-token identical
+    to ``model``-alone greedy decoding, in fewer target forwards when
+    the draft agrees.
+
+    TPU-native mechanics: the KV caches are FUNCTIONAL arrays, so
+    rejection needs no rollback — rejected positions hold stale K/V
+    that the next window (k+1 tokens wide, advancing by at least one)
+    always overwrites before any mask can expose them. Exactly two
+    compiled programs run per round (a 1-token draft step and a
+    (k+1)-token verify step), each with a traced ``pos`` — shapes
+    never change, so both compile once.
+
+    Batch size must be 1 (per-row acceptance lengths would desync the
+    shared scalar cache position). Returns [1, S0 + n_generated]
+    (n_generated <= max_new_tokens; stops early at eos)."""
+    b, s0 = input_ids.shape
+    if b != 1:
+        raise ValueError(
+            "speculative_generate supports batch_size=1 (per-row "
+            "acceptance lengths would desync the cache position); got "
+            f"batch {b}")
+    if draft_k < 1:
+        raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+    if max_new_tokens <= 0:
+        return (input_ids, {"target_calls": 0, "tokens": 0,
+                            "tokens_per_target_call": 0.0}) \
+            if return_stats else input_ids
+
+    with no_grad():
+        max_len = s0 + max_new_tokens + draft_k + 1
+        t_caches = model.init_cache(b, max_len)
+        d_caches = draft_model.init_cache(b, max_len)
+
+        def _argmax_last(l):
+            return jnp.argmax(l[:, -1], axis=-1).astype(jnp.int32)
+
+        # prefill both models on the prompt; target's argmax is the
+        # first committed token
+        t_logits, t_caches = model.decode_step(
+            input_ids, t_caches, to_tensor(np.int32(0)))
+        _, d_caches = draft_model.decode_step(
+            input_ids, d_caches, to_tensor(np.int32(0)))
+        first = apply_op("spec_argmax", _argmax_last, t_logits,
+                         differentiable=False)
+        out = [int(np.asarray(first._data)[0])]
+        n_target_calls = 1
+
+        while len(out) < max_new_tokens and (
+                eos_token_id is None or out[-1] != eos_token_id):
+            base = s0 + len(out) - 1  # position of out[-1]
+            # --- draft proposes k tokens from its own cache. The
+            # chain stays ON DEVICE ([1,1] argmax fed straight back);
+            # proposal values reach the host in one pull afterwards,
+            # so dispatch never stalls mid-draft ---------------------
+            cur = to_tensor(np.array([[out[-1]]], np.int32))
+            props = []
+            for j in range(draft_k):
+                dl, d_caches = draft_model.decode_step(
+                    cur, d_caches, to_tensor(np.int32(base + j)))
+                cur = apply_op(
+                    "spec_argmax1",
+                    lambda l: jnp.argmax(
+                        l[:, -1], axis=-1)[:, None].astype(jnp.int32),
+                    dl, differentiable=False)
+                props.append(cur)
+            proposal = [int(np.asarray(p._data)[0, 0]) for p in props]
+            # --- target verifies the whole window in one step -------
+            window = np.array([[out[-1]] + proposal], np.int32)
+            tl, t_caches = model.decode_step(
+                to_tensor(window), t_caches, to_tensor(np.int32(base)))
+            n_target_calls += 1
+            preds = np.asarray(apply_op(
+                "spec_argmax_all",
+                lambda l: jnp.argmax(l, axis=-1).astype(jnp.int32),
+                tl, differentiable=False)._data)[0]
+            # preds[j] = target's next token after window[:j+1]
+            n_acc = 0
+            while n_acc < draft_k and proposal[n_acc] == int(preds[n_acc]):
+                n_acc += 1
+                if eos_token_id is not None \
+                        and proposal[n_acc - 1] == eos_token_id:
+                    break
+            accepted = proposal[:n_acc]
+            if (eos_token_id is None or
+                    (not accepted or accepted[-1] != eos_token_id)):
+                accepted = accepted + [int(preds[n_acc])]  # bonus token
+            room = max_new_tokens - len(out)
+            out.extend(accepted[:room])
+
+        ids = np.concatenate(
+            [np.asarray(input_ids._data if hasattr(input_ids, "_data")
+                        else input_ids),
+             np.array([out], np.int32)], axis=1)
+        result = to_tensor(ids.astype(np.int32))
+        if return_stats:
+            return result, {
+                "target_calls": n_target_calls,
+                "tokens": len(out),
+                "tokens_per_target_call": round(
+                    len(out) / max(1, n_target_calls), 2),
+            }
+        return result
+
+
 def _beam_search(model, input_ids, max_new_tokens, num_beams,
                  eos_token_id=None, length_penalty=1.0,
                  repetition_penalty=1.0, use_jit=False):
