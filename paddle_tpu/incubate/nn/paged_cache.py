@@ -139,21 +139,24 @@ class PagedKVCacheManager:
             [self._lens[s] for s in seq_ids], jnp.int32
         )
 
-    def attend(self, q, seq_ids, sm_scale=None):
-        """q: Tensor (B, H, D) — one decode token per listed sequence."""
+    def attend(self, q, seq_ids, sm_scale=None, window=0):
+        """q: Tensor (B, H, D) — one decode token per listed sequence.
+        ``window`` > 0: sliding-window attention over the last
+        ``window`` cached tokens (out-of-window pages skipped)."""
         q = _as_tensor(q)
         tbl = self.page_table(seq_ids)
         lens = self.seq_lens(seq_ids)
         kp, vp = self.k_pages, self.v_pages
 
         def f(qr):
-            return _kernel(qr, kp, vp, tbl, lens, sm_scale=sm_scale)
+            return _kernel(qr, kp, vp, tbl, lens, sm_scale=sm_scale,
+                           window=window)
 
         return apply_op("paged_attend", f, q, differentiable=False)
 
 
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
-                    sm_scale=None, name=None):
+                    sm_scale=None, window=0, name=None):
     """Functional surface over the Pallas paged decode kernel."""
     q = _as_tensor(q)
     k_pages = _as_tensor(k_pages)
@@ -162,7 +165,8 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
     seq_lens = _as_tensor(seq_lens)
 
     def f(qr, kp, vp, tbl, ln):
-        return _kernel(qr, kp, vp, tbl, ln, sm_scale=sm_scale)
+        return _kernel(qr, kp, vp, tbl, ln, sm_scale=sm_scale,
+                       window=window)
 
     return apply_op(
         "paged_attention", f, q, k_pages, v_pages, page_table,

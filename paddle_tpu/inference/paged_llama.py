@@ -39,17 +39,9 @@ class PagedLlamaAdapter:
         self.model = model
         cfg = model.config
         self.cfg = cfg
-        w = int(getattr(cfg, "sliding_window", 0) or 0)
-        if w and w < int(max_length or cfg.max_position_embeddings):
-            # the paged attend has no window mask yet — serving a
-            # Mistral-style model past its window would silently attend
-            # to the full prefix (wrong logits); fail loudly instead
-            raise NotImplementedError(
-                f"PagedLlamaAdapter: sliding_window={w} is narrower "
-                f"than max_length; the paged decode path has no window "
-                "mask yet. Cap max_length at the window or use "
-                "LlamaForCausalLM.generate (dense cache, windowed)."
-            )
+        # Mistral-style sliding window rides through the paged decode
+        # kernel's banded mask (out-of-window pages skipped)
+        self._window = int(getattr(cfg, "sliding_window", 0) or 0)
         if dtype is None:
             dtype = model.model.embed_tokens.weight._data.dtype
         self.max_length = int(max_length or cfg.max_position_embeddings)
@@ -111,7 +103,8 @@ class PagedLlamaAdapter:
                 self.caches[li].append_batch(
                     seq_ids, kh[:, 0], vh[:, 0])
                 attn = self.caches[li].attend(
-                    Tensor(qh[:, 0]), seq_ids)  # (B, nh, hd)
+                    Tensor(qh[:, 0]), seq_ids,
+                    window=self._window)  # (B, nh, hd)
                 attn_flat = reshape(attn, [b, nh * hd])
                 x = x + layer.self_attn.o_proj(attn_flat)
                 x = x + layer.mlp(layer.post_attention_layernorm(x))

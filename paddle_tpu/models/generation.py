@@ -196,17 +196,31 @@ def speculative_generate(model, draft_model, input_ids,
                          differentiable=False)
         out = [int(np.asarray(first._data)[0])]
         n_target_calls = 1
+        d_next = s0  # first draft-cache position not yet written
 
         while len(out) < max_new_tokens and (
                 eos_token_id is None or out[-1] != eos_token_id):
             base = s0 + len(out) - 1  # position of out[-1]
-            # --- draft proposes k tokens from its own cache. The
-            # chain stays ON DEVICE ([1,1] argmax fed straight back);
-            # proposal values reach the host in one pull afterwards,
-            # so dispatch never stalls mid-draft ---------------------
-            cur = to_tensor(np.array([[out[-1]]], np.int32))
-            props = []
-            for j in range(draft_k):
+            # --- catch the draft up on committed tokens it hasn't
+            # consumed (the bonus token; after a full acceptance also
+            # the last proposal, which was never fed back) — without
+            # this, position base+k stays a hole in the draft cache
+            # after every full-acceptance round and acceptance
+            # collapses exactly when the draft is good ---------------
+            catchup = [out[p - s0] for p in range(d_next, base + 1)]
+            cur = to_tensor(np.array([catchup], np.int32))
+            dl, d_caches = draft_model.decode_step(
+                cur, d_caches, to_tensor(np.int32(d_next)))
+            # --- draft proposes k tokens; the chain stays ON DEVICE
+            # ([1,1] argmax fed straight back), proposal values reach
+            # the host in one pull afterwards ------------------------
+            cur = apply_op(
+                "spec_argmax1",
+                lambda l: jnp.argmax(
+                    l[:, -1], axis=-1)[:, None].astype(jnp.int32),
+                dl, differentiable=False)
+            props = [cur]
+            for j in range(1, draft_k):
                 dl, d_caches = draft_model.decode_step(
                     cur, d_caches, to_tensor(np.int32(base + j)))
                 cur = apply_op(
@@ -238,6 +252,10 @@ def speculative_generate(model, draft_model, input_ids,
                 accepted = accepted + [int(preds[n_acc])]  # bonus token
             room = max_new_tokens - len(out)
             out.extend(accepted[:room])
+            # draft-cache positions valid AND committed: the draft loop
+            # wrote through base+k-1; a rejection invalidates from the
+            # bonus position (base+n_acc+1) onward
+            d_next = base + min(draft_k - 1, n_acc) + 1
 
         ids = np.concatenate(
             [np.asarray(input_ids._data if hasattr(input_ids, "_data")

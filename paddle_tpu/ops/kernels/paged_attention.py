@@ -31,7 +31,7 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _decode_kernel(scale, page_size, kvh_per_q, max_pages,
+def _decode_kernel(scale, page_size, kvh_per_q, max_pages, window,
                    page_tbl_ref, lens_ref,
                    q_ref, k_ref, v_ref, o_ref,
                    m_ref, l_ref, acc_ref):
@@ -45,8 +45,13 @@ def _decode_kernel(scale, page_size, kvh_per_q, max_pages,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     seq_len = lens_ref[b]
-    # tokens covered by this logical page: [p*page_size, ...)
+    # tokens covered by this logical page: [p*page_size, ...). With a
+    # sliding window the decode token (position seq_len-1) only sees
+    # keys >= seq_len - window, so pages wholly below that are skipped
+    # (real work saved, not just masked).
     valid = p * page_size < seq_len
+    if window:
+        valid = valid & ((p + 1) * page_size > seq_len - window)
 
     @pl.when(valid)
     def _():
@@ -60,7 +65,10 @@ def _decode_kernel(scale, page_size, kvh_per_q, max_pages,
         pos = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1
         )
-        s = jnp.where(pos < seq_len, s, NEG_INF)
+        keep = pos < seq_len
+        if window:
+            keep = keep & (pos >= seq_len - window)
+        s = jnp.where(keep, s, NEG_INF)
         m_prev = m_ref[0, 0]
         m_cur = jnp.maximum(m_prev, jnp.max(s))
         corr = jnp.exp(m_prev - m_cur)
@@ -79,10 +87,12 @@ def _decode_kernel(scale, page_size, kvh_per_q, max_pages,
 
 
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
-                    sm_scale=None, interpret=None):
+                    sm_scale=None, interpret=None, window=0):
     """q: (B, H, D); k_pages/v_pages: (NP, P, KVH, D);
     page_table: (B, max_pages) int32 physical-page ids;
-    seq_lens: (B,) int32. Returns (B, H, D).
+    seq_lens: (B,) int32. ``window`` > 0 keeps only the last
+    ``window`` keys (Mistral sliding attention; out-of-window pages
+    are skipped entirely). Returns (B, H, D).
     """
     b, h, d = q.shape
     npages, page_size, kvh, _ = k_pages.shape
@@ -122,7 +132,8 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
         ],
     )
     kernel = functools.partial(
-        _decode_kernel, float(scale), page_size, group, max_pages
+        _decode_kernel, float(scale), page_size, group, max_pages,
+        int(window or 0),
     )
     out = pl.pallas_call(
         kernel,
@@ -141,7 +152,7 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
 
 
 def paged_attention_reference(q, k_pages, v_pages, page_table,
-                              seq_lens, sm_scale=None):
+                              seq_lens, sm_scale=None, window=0):
     """Dense float32 reference for tests."""
     import numpy as np
 
@@ -164,6 +175,8 @@ def paged_attention_reference(q, k_pages, v_pages, page_table,
         vs = np.concatenate(
             [vn[tbl[i, p]] for p in range(n_used)], axis=0
         )[:L] if n_used else np.zeros((0, kvh, d), np.float32)
+        if window and L > window:
+            ks, vs = ks[L - window:], vs[L - window:]
         for j in range(h):
             kj = ks[:, j // group]
             vj = vs[:, j // group]
@@ -174,13 +187,15 @@ def paged_attention_reference(q, k_pages, v_pages, page_table,
     return out
 
 
-def _prefill_kernel(scale, page_size, group, max_pages, t,
+def _prefill_kernel(scale, page_size, group, max_pages, t, window,
                     page_tbl_ref, lens_ref,
                     q_ref, k_ref, v_ref, o_ref,
                     m_ref, l_ref, acc_ref):
     """Chunked-prefill: T new tokens per sequence attend causally to
     the whole paged prefix (the new tokens' K/V already live in the
-    pages; seq_lens counts them)."""
+    pages; seq_lens counts them). ``window`` > 0 bands the mask
+    (0 <= qpos - kpos < window) and skips pages below every row's
+    window."""
     b = pl.program_id(0)
     p = pl.program_id(2)
 
@@ -192,6 +207,11 @@ def _prefill_kernel(scale, page_size, group, max_pages, t,
 
     seq_len = lens_ref[b]
     valid = p * page_size < seq_len
+    if window:
+        # lowest row position is seq_len - t; its window floor is
+        # seq_len - t - window + 1
+        valid = valid & (
+            (p + 1) * page_size > seq_len - t - window + 1)
 
     @pl.when(valid)
     def _():
@@ -209,9 +229,10 @@ def _prefill_kernel(scale, page_size, group, max_pages, t,
         qpos = seq_len - t + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 0
         )
-        s = jnp.where(
-            (kpos <= qpos) & (kpos < seq_len), s, NEG_INF
-        )
+        keep = (kpos <= qpos) & (kpos < seq_len)
+        if window:
+            keep = keep & (qpos - kpos < window)
+        s = jnp.where(keep, s, NEG_INF)
         m_prev = m_ref[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m_prev - m_cur)
@@ -234,7 +255,7 @@ def _prefill_kernel(scale, page_size, group, max_pages, t,
 
 
 def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
-                            sm_scale=None, interpret=None):
+                            sm_scale=None, interpret=None, window=0):
     """Ragged chunked-prefill over a paged KV cache.
 
     q: (B, T, H, D) — the T newest tokens of each sequence, whose K/V
@@ -283,7 +304,8 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
         ],
     )
     kernel = functools.partial(
-        _prefill_kernel, float(scale), page_size, group, max_pages, t
+        _prefill_kernel, float(scale), page_size, group, max_pages, t,
+        int(window or 0),
     )
     out = pl.pallas_call(
         kernel,

@@ -189,10 +189,12 @@ class TestSpeculativeDecoding:
             target, target, ids, max_new_tokens=9, draft_k=3,
             return_stats=True)
         np.testing.assert_array_equal(got.numpy(), ref)
-        # a near-perfect draft accepts multiple tokens per verify
-        # (exact k+1 acceptance can break on float tie-breaks between
-        # the 1-token and windowed step); require a real speedup
-        assert stats["tokens_per_target_call"] > 1.5, stats
+        # a self-draft should accept essentially every proposal (the
+        # draft cache is fully caught up each round — regression guard
+        # for the post-full-acceptance cache hole); leave headroom
+        # only for rare float tie-breaks between the 1-token and
+        # windowed steps
+        assert stats["tokens_per_target_call"] > 2.5, stats
 
     def test_batch_gt_one_rejected(self):
         from paddle_tpu.models import speculative_generate

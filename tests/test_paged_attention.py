@@ -54,6 +54,20 @@ class TestPagedAttention:
         np.testing.assert_allclose(
             np.asarray(out, np.float32), ref, atol=3e-2, rtol=3e-2)
 
+    @pytest.mark.parametrize("window", [8, 16, 24, 100])
+    def test_sliding_window_matches_reference(self, window):
+        # window crossing page boundaries (P=16): 8 (within last
+        # page), 16 (exactly one page), 24 (page-misaligned), 100
+        # (wider than every lane -> full attention)
+        q, kp, vp, tbl, lens = _case(lens=(40, 17))
+        out = paged_attention(q, kp, vp, tbl, lens, window=window)
+        ref = paged_attention_reference(q, kp, vp, tbl, lens,
+                                        window=window)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+        full = paged_attention_reference(q, kp, vp, tbl, lens)
+        if window < int(min(np.asarray(lens))):
+            assert not np.allclose(np.asarray(out), full, atol=1e-4)
+
     def test_under_jit(self):
         q, kp, vp, tbl, lens = _case()
         f = jax.jit(lambda *a: paged_attention(*a, interpret=True))
@@ -120,7 +134,7 @@ class TestPagedKVCacheManager:
 
 
 class TestPagedPrefill:
-    def _ref(self, q, kp, vp, tbl, lens, P, H, KVH, D, T):
+    def _ref(self, q, kp, vp, tbl, lens, P, H, KVH, D, T, window=0):
         import math
 
         B = q.shape[0]
@@ -137,9 +151,10 @@ class TestPagedPrefill:
                 0)[:L]
             for r in range(T):
                 qpos = L - T + r
+                lo = max(0, qpos - window + 1) if window else 0
                 for h in range(H):
-                    kh = ks[:qpos + 1, h // (H // KVH)]
-                    vh = vs[:qpos + 1, h // (H // KVH)]
+                    kh = ks[lo:qpos + 1, h // (H // KVH)]
+                    vh = vs[lo:qpos + 1, h // (H // KVH)]
                     s = kh @ np.asarray(q)[b, r, h] * scale
                     pr = np.exp(s - s.max())
                     pr /= pr.sum()
@@ -163,6 +178,31 @@ class TestPagedPrefill:
         q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
         out = pa.paged_prefill_attention(q, kp, vp, tbl, lens)
         ref = self._ref(q, kp, vp, tbl, lens, P, H, KVH, D, T)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+    @pytest.mark.parametrize("window", [5, 8, 11, 64])
+    def test_windowed_prefill_matches_reference(self, window):
+        # window below/at/above the page size (P=8) and wider than
+        # every lane; lens page-misaligned, one lane shorter than T
+        # would be masked by the caller so both lens exceed T here
+        import importlib
+
+        pa = importlib.import_module(
+            "paddle_tpu.ops.kernels.paged_attention")
+        rng = np.random.RandomState(7)
+        B, T, H, KVH, D = 2, 4, 4, 2, 32
+        NP, P, MAXP = 10, 8, 4
+        kp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+        tbl = jnp.asarray(
+            rng.permutation(NP)[:B * MAXP].reshape(B, MAXP),
+            jnp.int32)
+        lens = jnp.asarray([27, 12], jnp.int32)
+        q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+        out = pa.paged_prefill_attention(q, kp, vp, tbl, lens,
+                                         window=window)
+        ref = self._ref(q, kp, vp, tbl, lens, P, H, KVH, D, T,
+                        window=window)
         np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
 
     def test_prefill_agrees_with_decode_on_last_token(self):
