@@ -340,34 +340,14 @@ class LlamaAttention(Layer):
                     f"{cfg.context_parallel!r}"
                 )
             out = cp(q, k, v, causal=True)
-        elif w and w < s:
-            # Mistral banded causal mask: keep keys j with
-            # 0 <= i - j < w (XLA path; a windowed Pallas kernel is a
-            # perf follow-up — at w >= s this reduces to full causal
-            # and takes the flash kernel below)
-            import jax
-
-            def banded(qh, kh, vh):
-                if kh.shape[2] != qh.shape[2]:  # GQA: group kv heads
-                    g = qh.shape[2] // kh.shape[2]
-                    kh = jnp.repeat(kh, g, axis=2)
-                    vh = jnp.repeat(vh, g, axis=2)
-                scale = 1.0 / (hd ** 0.5)
-                scores = jnp.einsum(
-                    "bqhd,bkhd->bhqk", qh.astype(jnp.float32),
-                    kh.astype(jnp.float32)) * scale
-                i = jnp.arange(s)
-                mask = (i[None, :] <= i[:, None]) \
-                    & (i[:, None] - i[None, :] < w)
-                scores = jnp.where(mask[None, None], scores, -1e30)
-                p = jax.nn.softmax(scores, axis=-1)
-                return jnp.einsum(
-                    "bhqk,bkhd->bqhd", p, vh.astype(jnp.float32)
-                ).astype(qh.dtype)
-
-            out = apply_op("sliding_window_attention", banded, q, k, v)
         else:
-            out, _ = F.flash_attention(q, k, v, causal=True)
+            # windowed flash: the Pallas kernels band the mask AND skip
+            # out-of-band blocks, so long-context Mistral training is
+            # O(S*w), not O(S^2); w >= s makes the band inert (plain
+            # causal flash)
+            out, _ = F.flash_attention(
+                q, k, v, causal=True,
+                window=w if (w and w < s) else 0)
         out = apply_op(
             "merge_heads", lambda o: o.reshape(b, s, nh * hd), out
         )

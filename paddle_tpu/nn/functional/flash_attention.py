@@ -13,12 +13,14 @@ from ...ops.kernels.flash_attention import flash_attention as _flash
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, fixed_seed_offset=None,
-                    rng_name="", training=True, name=None):
-    """q/k/v: [batch, seq, num_heads, head_dim] (reference layout)."""
+                    rng_name="", training=True, window=0, name=None):
+    """q/k/v: [batch, seq, num_heads, head_dim] (reference layout).
+    ``window`` > 0 (with causal): Mistral sliding-window band — the
+    Pallas kernels skip out-of-band blocks."""
     query, key, value = _as_tensor(query), _as_tensor(key), _as_tensor(value)
     out = apply_op(
         "flash_attention",
-        lambda q, k, v: _flash(q, k, v, causal=causal),
+        lambda q, k, v: _flash(q, k, v, causal=causal, window=window),
         query, key, value,
     )
     return out, None

@@ -47,10 +47,13 @@ def _prec():
     return jax.lax.Precision.DEFAULT
 
 
-def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
+def _flash_fwd_kernel(scale, causal, window, offset, block_q, block_k,
+                      nk,
                       q_ref, k_ref, v_ref, o_ref, lse_ref,
                       acc_ref, m_ref, l_ref):
-    # offset = sk - sq: causal condition is q_idx + offset >= k_idx
+    # offset = sk - sq: causal condition is q_idx + offset >= k_idx;
+    # window > 0 additionally requires q_idx + offset - k_idx < window
+    # (Mistral band) — whole out-of-band k blocks are skipped
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -63,6 +66,11 @@ def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
     run = True
     if causal:
         run = ki * block_k <= qi * block_q + block_q - 1 + offset
+        if window:
+            run = jnp.logical_and(
+                run,
+                ki * block_k + block_k - 1
+                >= qi * block_q + offset - window + 1)
 
     @pl.when(run if causal else ki >= 0)
     def _():
@@ -83,7 +91,10 @@ def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
             k_idx = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_idx + offset >= k_idx, s, NEG_INF)
+            keep = q_idx + offset >= k_idx
+            if window:
+                keep = keep & (q_idx + offset - k_idx < window)
+            s = jnp.where(keep, s, NEG_INF)
 
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
@@ -112,7 +123,7 @@ def _flash_fwd_kernel(scale, causal, offset, block_q, block_k, nk,
 
 
 def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                      interpret=False):
+                      interpret=False, window=0):
     """q: (BH, Sq, D); k/v: (BHkv, Sk, D). Returns (out, lse)."""
     bh, sq, d = q.shape
     bhkv, sk, _ = k.shape
@@ -123,7 +134,8 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     nk = pl.cdiv(sk, block_k)
 
     kernel = functools.partial(
-        _flash_fwd_kernel, scale, causal, sk - sq, block_q, block_k, nk
+        _flash_fwd_kernel, scale, causal, int(window or 0), sk - sq,
+        block_q, block_k, nk
     )
     from jax.experimental.pallas import tpu as pltpu
 
@@ -160,7 +172,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     return out, lse[..., 0]
 
 
-def _flash_fwd_ref(q, k, v, causal, scale):
+def _flash_fwd_ref(q, k, v, causal, scale, window=0):
     """XLA reference forward (full S² — used off-TPU / small shapes)."""
     bh, sq, d = q.shape
     bhkv, sk, _ = k.shape
@@ -173,6 +185,10 @@ def _flash_fwd_ref(q, k, v, causal, scale):
     ) * scale
     if causal:
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        if window:
+            diff = (jnp.arange(sq)[:, None] + (sk - sq)
+                    - jnp.arange(sk)[None, :])
+            mask = mask & (diff < window)
         s = jnp.where(mask[None], s, NEG_INF)
     lse = jax.scipy.special.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
@@ -180,8 +196,8 @@ def _flash_fwd_ref(q, k, v, causal, scale):
     return out.astype(q.dtype), lse
 
 
-def _flash_bwd_dkdv_kernel(scale, causal, offset, block_q, block_k,
-                           group, nq,
+def _flash_bwd_dkdv_kernel(scale, causal, window, offset, block_q,
+                           block_k, group, nq,
                            q_ref, do_ref, lse_ref, delta_ref,
                            k_ref, v_ref, dk_ref, dv_ref,
                            dk_acc, dv_acc):
@@ -198,6 +214,11 @@ def _flash_bwd_dkdv_kernel(scale, causal, offset, block_q, block_k,
     if causal:
         # any q row in this block attends to any k col in this block?
         run = qi * block_q + block_q - 1 + offset >= ki * block_k
+        if window:
+            run = jnp.logical_and(
+                run,
+                qi * block_q + offset
+                <= ki * block_k + block_k - 1 + window - 1)
 
     @pl.when(run if causal else qi >= 0)
     def _():
@@ -222,7 +243,10 @@ def _flash_bwd_dkdv_kernel(scale, causal, offset, block_q, block_k,
             k_idx = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            p = jnp.where(q_idx + offset >= k_idx, p, 0.0)
+            keep = q_idx + offset >= k_idx
+            if window:
+                keep = keep & (q_idx + offset - k_idx < window)
+            p = jnp.where(keep, p, 0.0)
         # dv += p^T do
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -249,7 +273,8 @@ def _flash_bwd_dkdv_kernel(scale, causal, offset, block_q, block_k,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd_dq_kernel(scale, causal, offset, block_q, block_k, nk,
+def _flash_bwd_dq_kernel(scale, causal, window, offset, block_q,
+                         block_k, nk,
                          q_ref, do_ref, lse_ref, delta_ref,
                          k_ref, v_ref, dq_ref, dq_acc):
     qi = pl.program_id(1)
@@ -262,6 +287,11 @@ def _flash_bwd_dq_kernel(scale, causal, offset, block_q, block_k, nk,
     run = True
     if causal:
         run = ki * block_k <= qi * block_q + block_q - 1 + offset
+        if window:
+            run = jnp.logical_and(
+                run,
+                ki * block_k + block_k - 1
+                >= qi * block_q + offset - window + 1)
 
     @pl.when(run if causal else ki >= 0)
     def _():
@@ -284,7 +314,10 @@ def _flash_bwd_dq_kernel(scale, causal, offset, block_q, block_k, nk,
             k_idx = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            p = jnp.where(q_idx + offset >= k_idx, p, 0.0)
+            keep = q_idx + offset >= k_idx
+            if window:
+                keep = keep & (q_idx + offset - k_idx < window)
+            p = jnp.where(keep, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -303,7 +336,8 @@ def _flash_bwd_dq_kernel(scale, causal, offset, block_q, block_k, nk,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale,
-                      block_q, block_k, dlse=None, interpret=False):
+                      block_q, block_k, dlse=None, interpret=False,
+                      window=0):
     """Pallas dq/dk/dv. q/do: (BH, Sq, D); k/v: (BHkv, Sk, D);
     lse: (BH, Sq) fp32. Returns (dq, dk, dv) in input dtypes."""
     from jax.experimental.pallas import tpu as pltpu
@@ -337,8 +371,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale,
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkdv_kernel, scale, causal, offset,
-            block_q, block_k, group, nq,
+            _flash_bwd_dkdv_kernel, scale, causal, int(window or 0),
+            offset, block_q, block_k, group, nq,
         ),
         grid=(bhkv, nk, group, nq),
         in_specs=[qspec, qspec, rowspec, rowspec, kvspec, kvspec],
@@ -367,8 +401,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale,
     )
     dq = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel, scale, causal, offset,
-            block_q, block_k, nk,
+            _flash_bwd_dq_kernel, scale, causal, int(window or 0),
+            offset, block_q, block_k, nk,
         ),
         grid=(bh, nq, nk),
         in_specs=[qspec2, qspec2, rowspec2, rowspec2, kvspec2, kvspec2],
@@ -385,7 +419,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale,
 
 
 def _flash_bwd_chunked(q, k, v, out, lse, do, causal, scale, block_k,
-                       dlse=None):
+                       dlse=None, window=0):
     """Blocked recompute backward over K blocks (lax.scan).
 
     ``dlse`` (BH, Sq) is the optional cotangent of the logsumexp output
@@ -423,7 +457,10 @@ def _flash_bwd_chunked(q, k, v, out, lse, do, causal, scale, block_k,
         s = jnp.einsum("bqd,bkd->bqk", qf, k_b) * scale
         if causal:
             k_pos = ki * block_k + jnp.arange(block_k)
-            mask = (q_pos[:, None] + (sk - sq)) >= k_pos[None, :]
+            diff = q_pos[:, None] + (sk - sq) - k_pos[None, :]
+            mask = diff >= 0
+            if window:
+                mask = mask & (diff < window)
             s = jnp.where(mask[None], s, NEG_INF)
         p = jnp.exp(s - lse[..., None])
         dv_b = jnp.einsum("bqk,bqd->bkd", p, dof)
@@ -483,7 +520,7 @@ def _pad_head_dim(arrs, d):
 
 
 def _flash_bwd_dispatch(q, k, v, out, lse, do, causal, scale,
-                        block_q, block_k, dlse=None):
+                        block_q, block_k, dlse=None, window=0):
     from ...framework.flags import flag
 
     from . import record_dispatch
@@ -496,23 +533,26 @@ def _flash_bwd_dispatch(q, k, v, out, lse, do, causal, scale,
         kp, vp = _pad_head_dim((k, v), d)
         dq, dk, dv = _flash_bwd_pallas(
             qp, kp, vp, outp, lse, dop, causal, scale, block_q, block_k,
-            dlse=dlse, interpret=_interpret(),
+            dlse=dlse, interpret=_interpret(), window=window,
         )
         if dq.shape[-1] != d:
             dq, dk, dv = dq[..., :d], dk[..., :d], dv[..., :d]
         return dq, dk, dv
     return _flash_bwd_chunked(
-        q, k, v, out, lse, do, causal, scale, block_k, dlse=dlse
+        q, k, v, out, lse, do, causal, scale, block_k, dlse=dlse,
+        window=window,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q, k, v, causal, scale, block_q, block_k):
-    out, _ = _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, scale, block_q, block_k, window=0):
+    out, _ = _flash_fwd_dispatch(q, k, v, causal, scale, block_q,
+                                 block_k, window)
     return out
 
 
-def _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k):
+def _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k,
+                        window=0):
     from . import record_dispatch
 
     ok = _pallas_ok(q, k, block_q, block_k)
@@ -523,23 +563,26 @@ def _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k):
         kp, vp = _pad_head_dim((k, v), d)
         out, lse = _flash_fwd_pallas(
             qp, kp, vp, causal, scale, block_q, block_k,
-            interpret=_interpret(),
+            interpret=_interpret(), window=window,
         )
         if out.shape[-1] != d:
             out = out[..., :d]
         return out, lse
-    return _flash_fwd_ref(q, k, v, causal, scale)
+    return _flash_fwd_ref(q, k, v, causal, scale, window=window)
 
 
-def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k):
-    out, lse = _flash_fwd_dispatch(q, k, v, causal, scale, block_q, block_k)
+def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k,
+                    window=0):
+    out, lse = _flash_fwd_dispatch(q, k, v, causal, scale, block_q,
+                                   block_k, window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_core_bwd(causal, scale, block_q, block_k, res, do):
+def _flash_core_bwd(causal, scale, block_q, block_k, window, res, do):
     q, k, v, out, lse = res
     dq, dk, dv = _flash_bwd_dispatch(
-        q, k, v, out, lse, do, causal, scale, block_q, block_k
+        q, k, v, out, lse, do, causal, scale, block_q, block_k,
+        window=window,
     )
     return dq, dk, dv
 
@@ -574,8 +617,12 @@ _flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None,
-                    block_q=512, block_k=512):
-    """q,k,v: [B, S, H, D] (reference layout). Returns [B, Sq, H, D]."""
+                    block_q=512, block_k=512, window=0):
+    """q,k,v: [B, S, H, D] (reference layout). Returns [B, Sq, H, D].
+    ``window`` > 0 (requires causal): sliding-window band
+    0 <= q_pos - k_pos < window with out-of-band blocks skipped."""
+    if window and not causal:
+        raise ValueError("flash_attention: window requires causal=True")
     b, sq, h, d = q.shape
     hkv = k.shape[2]
     sk = k.shape[1]
@@ -584,7 +631,7 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
     k3 = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
     v3 = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
     out = _flash_core(q3, k3, v3, bool(causal), float(scale),
-                      int(block_q), int(block_k))
+                      int(block_q), int(block_k), int(window or 0))
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
