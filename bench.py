@@ -651,6 +651,18 @@ def bench_decode(steps=64, ctx=1024, h=16, d=128):
                 "dense_tok_s": round(b / t_dense, 0),
                 "speedup_vs_dense": round(t_dense / t_paged, 2),
             }
+            # sliding-window decode (Mistral serving): out-of-window
+            # pages are skipped, so this should beat full attention at
+            # long contexts — measured at window = ctx/4
+            w = max(ps, ctx // 4)
+            t_win = timed(
+                lambda q_, kp_, vp_: paged_kernel(
+                    q_, kp_, vp_, tbl, lens_j, sm_scale=scale,
+                    window=w),
+                q, kp, vp)
+            grid[f"b{b}_p{ps}"]["windowed_tok_s"] = round(b / t_win, 0)
+            grid[f"b{b}_p{ps}"]["window_speedup"] = round(
+                t_paged / t_win, 2)
     return {
         "config": "decode_throughput",
         "mode": "tpu-single-chip" if not cpu else "cpu",
