@@ -337,7 +337,7 @@ def bench_llama_headline(dry=False, steps=10, seq=2048, batch=8):
         # ~470M params: MXU-saturating matmuls, fits one chip with fp32
         # Adam states; head_dim 128 -> Pallas flash fwd+bwd kernels.
         # recompute=False leans on XLA auto-remat (jaxpr-liveness peak
-        # 28.4 GB > 16 GB HBM, tools/roofline.py --liveness) and is
+        # 26.2 GB > 16 GB HBM, tools/roofline.py --liveness) and is
         # what the 46.08% r3 headline measured; BENCH_RECOMPUTE=1
         # flips to the predictable-schedule variant (peak 11.4 GB).
         cfg = llama_headline(

@@ -428,7 +428,7 @@ def analytic(args=None):
     # flops for memory — but the schedule (and step time) is then
     # compiler-chosen. `--liveness` measures the pre-fusion upper
     # bound on the exact traced step; recompute=True brings the peak
-    # under HBM by construction (measured: 28.4 GB -> 11.4 GB for the
+    # under HBM by construction (measured: 26.2 GB -> 11.3 GB for the
     # headline) and is the predictable configuration for chips where
     # total_gb > 0.95 * HBM.
     resident["fits_v5e_16gb_without_remat"] = \
