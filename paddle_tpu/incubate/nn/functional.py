@@ -189,11 +189,36 @@ def fused_linear_cross_entropy(h, w, labels, ignore_index=-100,
     paddle/phi/kernels/gpu/cross_entropy_kernel.cu); see
     ops/kernels/fused_loss.py for the TPU design.
     """
+    from ...distributed.mesh import axis_degree
     from ...ops.kernels.fused_loss import (
         fused_linear_cross_entropy as _core,
+        fused_linear_cross_entropy_vocab_parallel as _vp_core,
     )
 
     h, w, labels = _as_tensor(h), _as_tensor(w), _as_tensor(labels)
+
+    mp = axis_degree("mp")
+    v = w.shape[1] if transpose_w else w.shape[0]
+    seq = labels.shape[-1]
+    if mp > 1 and seq % mp == 0 and v % mp == 0:
+        # TP-sharded head: the vocab-parallel kernel (local chunked
+        # lse + mp-collective combine, the c_softmax_with_cross_entropy
+        # role). Needs [B, S, H]/[B, S] layout for the SP seq sharding;
+        # a flat [T, H] input is treated as one sequence. Non-divisible
+        # shapes keep the single-replica kernel below (GSPMD gathers
+        # the vocab-sharded w — correct, just not vocab-parallel).
+        def fvp(hr, wr, lr):
+            h3 = hr[None] if hr.ndim == 2 else hr
+            l2 = lr[None] if lr.ndim == 1 else lr
+            out = _vp_core(h3, wr, l2, ignore_index=ignore_index,
+                           chunk=chunk, reduction=reduction,
+                           transpose_w=transpose_w)
+            if reduction == "none" and lr.ndim == 1:
+                out = out[0]
+            return out
+
+        return apply_op("fused_linear_cross_entropy_vp", fvp,
+                        h, w, labels)
 
     def f(hr, wr, lr):
         if transpose_w:

@@ -502,15 +502,29 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.model(input_ids)
-        if labels is not None and self._fused_loss_active():
+        if labels is not None and self._fused_loss_active(labels):
             from ..incubate.nn.functional import fused_linear_cross_entropy
 
             tied = self.lm_head is None
             w = (self.model.embed_tokens.weight if tied
                  else self.lm_head.weight)  # [V,H] tied / [H,V] linear
-            # logits[:, :-1] predicts labels[:, 1:] — shift h/labels;
             # the chunked kernel never builds [T, V] logits, so there
             # are no logits to return
+            if axis_degree("mp") > 1:
+                # vocab-parallel path: keep the full S (the SP seq
+                # sharding needs S % mp == 0 — slicing to S-1 would
+                # break it); shift by PADDING labels instead:
+                # labels_next[:, t] = labels[:, t+1], ignore at S-1
+                ii = -100
+                lab_s = apply_op(
+                    "shift_labels_pad",
+                    lambda a: jnp.concatenate(
+                        [a[:, 1:],
+                         jnp.full((a.shape[0], 1), ii, a.dtype)], axis=1),
+                    labels, differentiable=False)
+                return None, fused_linear_cross_entropy(
+                    h, w, lab_s, ignore_index=ii, transpose_w=not tied)
+            # single-replica head: logits[:, :-1] predicts labels[:, 1:]
             h_s = apply_op("shift_hidden", lambda a: a[:, :-1], h)
             lab_s = apply_op("shift_labels", lambda a: a[:, 1:], labels,
                              differentiable=False)
@@ -521,10 +535,20 @@ class LlamaForCausalLM(Layer):
             return logits
         return logits, LlamaPretrainingCriterion()(logits, labels)
 
-    def _fused_loss_active(self):
-        # the chunked lse is over the full vocab — with a vocab-sharded
-        # head (mp>1) the unfused criterion's collective path applies
-        return self.config.fused_head_loss and axis_degree("mp") == 1
+    def _fused_loss_active(self, labels=None):
+        # mp==1: the single-replica chunked kernel. mp>1: the vocab-
+        # parallel kernel (shard-local chunked lse + mp-collective
+        # combine) — engages when seq and vocab divide the mp degree,
+        # else the unfused criterion's collective path applies.
+        if not self.config.fused_head_loss:
+            return False
+        mp = axis_degree("mp")
+        if mp == 1:
+            return True
+        if labels is None:
+            return False
+        s = labels.shape[-1]
+        return s % mp == 0 and self.config.vocab_size % mp == 0
 
     # -- decode / serving --------------------------------------------------
 

@@ -90,13 +90,17 @@ def fused_linear_cross_entropy_per_token(h, w, labels, ignore_index,
     return per_tok, count
 
 
-def _fwd_core(h, w, labels, ignore_index, chunk):
+def _online_lse(h, w, lab, chunk, base=0, varying_axes=None):
+    """Chunked online-logsumexp pieces over ``w``'s rows, whose GLOBAL
+    vocab ids start at ``base`` (nonzero for a TP vocab shard). Returns
+    (m, s, ll): running max, sum-exp relative to m, and the label's
+    logit (0 where the label falls outside [base, base+rows)).
+    ``varying_axes``: manual mesh axes the scan runs under (the carry
+    init must be pcast to varying for the vma type system)."""
     t, _hidden = h.shape
     v = w.shape[0]
     c = _pick_chunk(v, chunk)
     nc_full, w_tail, tail = _split_w(w, c)
-    valid = labels != ignore_index
-    lab = jnp.where(valid, labels, 0).astype(jnp.int32)
 
     def step(carry, w_chunk, off, ncols):
         m, s, ll = carry
@@ -112,16 +116,24 @@ def _fwd_core(h, w, labels, ignore_index, chunk):
         return (m_new, s, ll)
 
     def body(carry, off):
-        return step(carry, _w_chunk(w, off, c), off, c), None
+        return step(carry, _w_chunk(w, off - base, c), off, c), None
 
     init = (jnp.full((t,), NEG_INF, jnp.float32),
             jnp.zeros((t,), jnp.float32),
             jnp.zeros((t,), jnp.float32))
-    offsets = jnp.arange(nc_full, dtype=jnp.int32) * c
+    if varying_axes:
+        init = jax.lax.pcast(init, tuple(varying_axes), to="varying")
+    offsets = base + jnp.arange(nc_full, dtype=jnp.int32) * c
     carry, _ = jax.lax.scan(body, init, offsets)
     if tail:
-        carry = step(carry, w_tail, nc_full * c, tail)
-    m, s, ll = carry
+        carry = step(carry, w_tail, base + nc_full * c, tail)
+    return carry
+
+
+def _fwd_core(h, w, labels, ignore_index, chunk):
+    valid = labels != ignore_index
+    lab = jnp.where(valid, labels, 0).astype(jnp.int32)
+    m, s, ll = _online_lse(h, w, lab, chunk)
     lse = jnp.log(s) + m
     per_tok = jnp.where(valid, lse - ll, 0.0)
     count = valid.sum().astype(jnp.float32)
@@ -133,18 +145,14 @@ def _fwd_rule(h, w, labels, ignore_index, chunk):
     return (per_tok, count), (h, w, labels, lse)
 
 
-def _bwd_rule(ignore_index, chunk, res, cots):
-    h, w, labels, lse = res
-    dper_tok, _dcount = cots  # count is integer-valued; cot unused
+def _grad_scan(h, w, lab, g, lse, chunk, base=0, varying_axes=None):
+    """Recompute each chunk's logits and accumulate gradients.
+    ``base`` is the global vocab id of w's first row (TP shard offset).
+    Returns (dh fp32 [T,H] — UNREDUCED across vocab shards, dw [v,H])."""
     t, hidden = h.shape
     v = w.shape[0]
     c = _pick_chunk(v, chunk)
     nc_full, w_tail, tail = _split_w(w, c)
-    valid = labels != ignore_index
-    lab = jnp.where(valid, labels, 0).astype(jnp.int32)
-    # d(per_tok)/d(logits_j) = softmax_j - onehot_label_j, scaled by
-    # each token's incoming cotangent; ignored tokens contribute 0
-    g = jnp.where(valid, dper_tok, 0.0).astype(jnp.float32)  # [T]
 
     def step(dh, w_chunk, off, ncols):
         logits = _chunk_logits(h, w_chunk)  # recompute [T, ncols] f32
@@ -164,20 +172,188 @@ def _bwd_rule(ignore_index, chunk, res, cots):
         return dh, dw_chunk
 
     def body(dh, off):
-        return step(dh, _w_chunk(w, off, c), off, c)
+        return step(dh, _w_chunk(w, off - base, c), off, c)
 
-    offsets = jnp.arange(nc_full, dtype=jnp.int32) * c
-    dh, dw3 = jax.lax.scan(
-        body, jnp.zeros((t, hidden), jnp.float32), offsets)
+    offsets = base + jnp.arange(nc_full, dtype=jnp.int32) * c
+    dh0 = jnp.zeros((t, hidden), jnp.float32)
+    if varying_axes:
+        dh0 = jax.lax.pcast(dh0, tuple(varying_axes), to="varying")
+    dh, dw3 = jax.lax.scan(body, dh0, offsets)
     dw = dw3.reshape(nc_full * c, hidden)
     if tail:
-        dh, dw_tail = step(dh, w_tail, nc_full * c, tail)
+        dh, dw_tail = step(dh, w_tail, base + nc_full * c, tail)
         dw = jnp.concatenate([dw, dw_tail], axis=0)
+    return dh, dw
+
+
+def _bwd_rule(ignore_index, chunk, res, cots):
+    h, w, labels, lse = res
+    dper_tok, _dcount = cots  # count is integer-valued; cot unused
+    valid = labels != ignore_index
+    lab = jnp.where(valid, labels, 0).astype(jnp.int32)
+    # d(per_tok)/d(logits_j) = softmax_j - onehot_label_j, scaled by
+    # each token's incoming cotangent; ignored tokens contribute 0
+    g = jnp.where(valid, dper_tok, 0.0).astype(jnp.float32)  # [T]
+    dh, dw = _grad_scan(h, w, lab, g, lse, chunk)
     dlabels = np.zeros(labels.shape, jax.dtypes.float0)
     return dh.astype(h.dtype), dw, dlabels
 
 
 fused_linear_cross_entropy_per_token.defvjp(_fwd_rule, _bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# Vocab-parallel variant (TP-sharded head over the mp axis)
+# ---------------------------------------------------------------------------
+#
+# Upstream analog: c_softmax_with_cross_entropy (paddle/fluid/operators/
+# collective/c_softmax_with_cross_entropy_op.cu) — each mp rank holds a
+# [V/mp, H] vocab shard, computes LOCAL chunked online-logsumexp pieces,
+# and the global softmax statistics are combined with mp collectives
+# (pmax for the max, psum for the sum-exp and the label logit). The
+# full [tokens, V] — and even the [tokens, V/mp] per-rank — logits are
+# never materialized; memory per rank is O(T) stats + one chunk.
+#
+# TPU-first structure (Megatron-SP compatible):
+#   entry:   h arrives SEQUENCE-sharded over mp ([B, S/mp, H] per rank,
+#            the sequence_parallel boundary layout) -> all_gather(seq)
+#            inside, exactly the reference's pre-head SP all-gather;
+#   exit bwd: dh is reduce-scattered back to the sequence shard
+#            (psum_scatter), the SP backward pattern;
+#   dw stays local to the rank's vocab shard — no weight collective.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _vp_per_token(h_loc, w_local, labels, ignore_index, chunk, axis_name):
+    """Per-token CE inside a manual-``axis_name`` region.
+
+    h_loc: [B, S/deg, H] (this rank's sequence shard); w_local:
+    [V/deg, H] (this rank's vocab shard, rows base..base+V/deg);
+    labels: int [B, S] (full sequence, mp-invariant). Returns per-token
+    f32 [B, S], replicated over the axis."""
+    per_tok, _ = _vp_fwd(h_loc, w_local, labels, ignore_index, chunk,
+                         axis_name)
+    return per_tok
+
+
+def _vp_core(h_loc, w_local, labels, ignore_index, chunk, axis_name):
+    h_full = jax.lax.all_gather(h_loc, axis_name, axis=1, tiled=True)
+    b, s, hidden = h_full.shape
+    h2 = h_full.reshape(-1, hidden)
+    lab2 = labels.reshape(-1)
+    valid = lab2 != ignore_index
+    lab = jnp.where(valid, lab2, 0).astype(jnp.int32)
+    v_local = w_local.shape[0]
+    base = jax.lax.axis_index(axis_name).astype(jnp.int32) * v_local
+    return h2, lab, valid, base, (b, s, hidden)
+
+
+def _vp_fwd(h_loc, w_local, labels, ignore_index, chunk, axis_name):
+    h2, lab, valid, base, (b, s, _hd) = _vp_core(
+        h_loc, w_local, labels, ignore_index, chunk, axis_name)
+    m, sm, ll = _online_lse(h2, w_local, lab, chunk, base=base,
+                            varying_axes=(axis_name,))
+    # combine the shard-local softmax pieces over the vocab axis
+    m_g = jax.lax.pmax(m, axis_name)
+    s_g = jax.lax.psum(sm * jnp.exp(m - m_g), axis_name)
+    ll_g = jax.lax.psum(ll, axis_name)  # exactly one rank owns the label
+    lse = jnp.log(s_g) + m_g
+    per_tok = jnp.where(valid, lse - ll_g, 0.0).reshape(b, s)
+    return per_tok, lse
+
+
+def _vp_fwd_rule(h_loc, w_local, labels, ignore_index, chunk, axis_name):
+    per_tok, lse = _vp_fwd(h_loc, w_local, labels, ignore_index, chunk,
+                           axis_name)
+    # save the SEQUENCE SHARD (not the gathered h): the bwd re-gathers,
+    # trading one all-gather for deg-fold less fwd->bwd residency
+    return per_tok, (h_loc, w_local, labels, lse)
+
+
+def _vp_bwd_rule(ignore_index, chunk, axis_name, res, ct):
+    h_loc, w_local, labels, lse = res
+    h2, lab, valid, base, (b, s, hidden) = _vp_core(
+        h_loc, w_local, labels, ignore_index, chunk, axis_name)
+    g = jnp.where(valid, ct.reshape(-1), 0.0).astype(jnp.float32)
+    dh_full, dw = _grad_scan(h2, w_local, lab, g, lse, chunk, base=base,
+                             varying_axes=(axis_name,))
+    # dh_full is this rank's partial (its vocab shard's contribution);
+    # the true dh = psum over mp, and h_loc is the rank's seq shard:
+    # fuse both as a reduce-scatter — the Megatron-SP backward.
+    dh_loc = jax.lax.psum_scatter(
+        dh_full.reshape(b, s, hidden), axis_name,
+        scatter_dimension=1, tiled=True)
+    dlabels = np.zeros(labels.shape, jax.dtypes.float0)
+    return dh_loc.astype(h_loc.dtype), dw, dlabels
+
+
+_vp_per_token.defvjp(_vp_fwd_rule, _vp_bwd_rule)
+
+
+def fused_linear_cross_entropy_vocab_parallel(
+        h, w, labels, ignore_index=-100, chunk=4096, reduction="mean",
+        transpose_w=False, axis="mp"):
+    """Vocab-parallel fused chunked CE over GLOBAL (GSPMD) arrays.
+
+    h: [B, S, H]; w: [V, H] vocab-sharded over ``axis`` ([H, V] with
+    transpose_w=True, the ColumnParallelLinear layout); labels: [B, S].
+    Enters a partial-manual shard_map over ``axis`` (other mesh axes —
+    dp/sep — stay under GSPMD inside); requires S and V divisible by
+    the axis degree. reduction as in fused_linear_cross_entropy."""
+    from ...distributed.mesh import axis_degree, global_mesh, \
+        in_manual_context
+
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(
+            f"fused_linear_cross_entropy_vocab_parallel: unknown "
+            f"reduction {reduction!r}")
+    deg = axis_degree(axis)
+    ii = int(ignore_index)
+    ck = int(chunk)
+    manual = deg > 1 and in_manual_context((axis,))
+    # in a manual region w is already the per-rank LOCAL shard (its
+    # global vocab divisibility is implied by construction); outside,
+    # w is the global array and both dims must divide the axis
+    v = w.shape[1] if transpose_w else w.shape[0]
+    b, s = labels.shape
+    if deg > 1 and (s % deg or (not manual and v % deg)):
+        raise ValueError(
+            f"vocab-parallel CE needs seq ({s}) and vocab ({v}) "
+            f"divisible by the {axis} degree {deg}")
+
+    if deg <= 1:
+        # no vocab axis — the single-replica kernel is the same math
+        w2 = w.T if transpose_w else w
+        per_tok, _ = fused_linear_cross_entropy_per_token(
+            h.reshape(-1, h.shape[-1]), w2, labels.reshape(-1), ii, ck)
+        per_tok = per_tok.reshape(b, s)
+    elif manual:
+        w_local = w.T if transpose_w else w
+        per_tok = _vp_per_token(h, w_local, labels, ii, ck, axis)
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        mesh = global_mesh()
+
+        def body(hr, wr, lr):
+            w_local = wr.T if transpose_w else wr
+            return _vp_per_token(hr, w_local, lr, ii, ck, axis)
+
+        per_tok = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, axis, None),
+                      P(None, axis) if transpose_w else P(axis, None),
+                      P()),
+            out_specs=P(),
+            axis_names={axis},
+        )(h, w, labels)
+
+    if reduction == "none":
+        return per_tok
+    if reduction == "sum":
+        return per_tok.sum()
+    count = (labels != ii).sum().astype(jnp.float32)
+    return per_tok.sum() / jnp.maximum(count, 1.0)
 
 
 def fused_linear_cross_entropy(h, w, labels, ignore_index=-100,

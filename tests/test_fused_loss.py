@@ -237,6 +237,125 @@ class TestFusedCEReductionsAndRagged:
         assert _pick_chunk(151937, 4096) == 4096
 
 
+class TestVocabParallelFusedCE:
+    """TP-sharded head: the vocab-parallel kernel (shard-local chunked
+    lse + mp-collective combine, the c_softmax_with_cross_entropy
+    role — upstream test/collective/test_parallel_margin_cross_entropy
+    discipline) must match the dense oracle in loss AND grads."""
+
+    def _oracle_btv(self, h, w, labels, ignore_index=-100):
+        logits = jnp.einsum("bsh,vh->bsv", h, w).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        valid = labels != ignore_index
+        lab = jnp.where(valid, labels, 0)
+        picked = jnp.take_along_axis(
+            logits, lab[..., None], axis=-1)[..., 0]
+        per = jnp.where(valid, lse - picked, 0.0)
+        return per.sum() / jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
+
+    def test_kernel_matches_oracle_mp4(self):
+        from paddle_tpu.distributed.mesh import build_global_mesh
+        from paddle_tpu.ops.kernels.fused_loss import (
+            fused_linear_cross_entropy_vocab_parallel as vp_ce,
+        )
+
+        build_global_mesh(("dp", "mp"), (2, 4))
+        try:
+            rng = np.random.RandomState(0)
+            b, s, hidden, v = 2, 8, 16, 24
+            h = jnp.asarray(rng.randn(b, s, hidden), jnp.float32)
+            w = jnp.asarray(rng.randn(v, hidden) * 0.1, jnp.float32)
+            labels = jnp.asarray(rng.randint(0, v, (b, s)), jnp.int32)
+            labels = labels.at[0, 3].set(-100)
+            ref, (dh_r, dw_r) = jax.value_and_grad(
+                self._oracle_btv, argnums=(0, 1))(h, w, labels)
+            got, (dh_f, dw_f) = jax.value_and_grad(
+                lambda a, b_: vp_ce(a, b_, labels, chunk=8),
+                argnums=(0, 1))(h, w)
+            np.testing.assert_allclose(got, ref, rtol=1e-5)
+            np.testing.assert_allclose(dh_f, dh_r, rtol=1e-4, atol=1e-6)
+            np.testing.assert_allclose(dw_f, dw_r, rtol=1e-4, atol=1e-6)
+            # ColumnParallelLinear layout [H, V]
+            got_t, (_, dwt) = jax.value_and_grad(
+                lambda a, b_: vp_ce(a, b_, labels, chunk=8,
+                                    transpose_w=True),
+                argnums=(0, 1))(h, w.T)
+            np.testing.assert_allclose(got_t, ref, rtol=1e-5)
+            np.testing.assert_allclose(dwt, dw_r.T, rtol=1e-4, atol=1e-6)
+        finally:
+            reset_dist_state()
+
+    def test_reduction_none_and_divisibility(self):
+        from paddle_tpu.distributed.mesh import build_global_mesh
+        from paddle_tpu.ops.kernels.fused_loss import (
+            fused_linear_cross_entropy_vocab_parallel as vp_ce,
+        )
+
+        build_global_mesh(("mp",), (4,))
+        try:
+            rng = np.random.RandomState(1)
+            h = jnp.asarray(rng.randn(1, 8, 8), jnp.float32)
+            w = jnp.asarray(rng.randn(16, 8), jnp.float32)
+            labels = jnp.asarray(rng.randint(0, 16, (1, 8)), jnp.int32)
+            per = vp_ce(h, w, labels, chunk=8, reduction="none")
+            assert per.shape == (1, 8)
+            ref = self._oracle_btv(h, w, labels)
+            np.testing.assert_allclose(per.mean(), ref, rtol=1e-5)
+            # S=6 not divisible by mp=4 -> loud error, not silence
+            with pytest.raises(ValueError, match="divisible"):
+                vp_ce(h[:, :6], w, labels[:, :6])
+        finally:
+            reset_dist_state()
+
+    @pytest.mark.parametrize("sp", [False, True])
+    def test_llama_mp2_fused_matches_criterion(self, sp):
+        """E2E under fleet mp2: fused_head_loss=True (vocab-parallel
+        kernel) must train to the same losses as the criterion path
+        (vocab-sharded log_softmax) on the same mesh."""
+        from paddle_tpu.distributed import fleet
+
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        def train(fused):
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2}
+            fleet.init(is_collective=True, strategy=strategy)
+            try:
+                cfg = llama_tiny(fused_head_loss=fused,
+                                 tie_word_embeddings=True,
+                                 sequence_parallel=sp)
+                paddle.seed(11)
+                model = LlamaForCausalLM(cfg)
+                assert model._fused_loss_active(
+                    paddle.to_tensor(np.zeros((2, 64), "int64"))) == fused
+                opt = optim.AdamW(1e-3, parameters=model.parameters())
+
+                @paddle.jit.to_static
+                def step(x, y):
+                    _, loss = model(x, y)
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    return loss
+
+                rng = np.random.RandomState(5)
+                losses = []
+                for _ in range(3):
+                    x = paddle.to_tensor(rng.randint(
+                        0, cfg.vocab_size, (2, 64)).astype("int32"))
+                    y = paddle.to_tensor(rng.randint(
+                        0, cfg.vocab_size, (2, 64)).astype("int64"))
+                    losses.append(float(np.asarray(step(x, y)._data)))
+                return losses
+            finally:
+                reset_dist_state()
+
+        fused = train(True)
+        naive = train(False)
+        np.testing.assert_allclose(fused, naive, rtol=5e-5, atol=5e-6)
+
+
 # Tiering (VERDICT r3 weak #7): multi-minute suite - excluded from
 # the fast default path; run with `pytest -m slow` (see pytest.ini).
 import pytest as _pytest_tier
