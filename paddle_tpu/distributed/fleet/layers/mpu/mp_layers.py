@@ -37,23 +37,29 @@ def _place(param: Tensor, *spec):
     m = global_mesh()
     if m is None:
         return param
+    from .....ops.kernels import record_dispatch
+
+    # keep the try scoped to device_put alone: a bookkeeping failure
+    # after a SUCCESSFUL placement must not log a false "FAILED"
     try:
-        param._data = jax.device_put(
+        placed = jax.device_put(
             param._data, NamedSharding(m, PartitionSpec(*spec))
         )
-        from .....ops.kernels import record_dispatch
-
-        record_dispatch("tp_param_place", True)
+        ok = True
     except Exception as e:
+        ok = False
+        err = e
+    if ok:
+        param._data = placed
+        record_dispatch("tp_param_place", True)
+    else:
         import logging
-
-        from .....ops.kernels import record_dispatch
 
         record_dispatch("tp_param_place", False)
         logging.getLogger("paddle_tpu").warning(
             "TP param placement FAILED — param %s stays replicated "
             "(an mp-fold memory regression on a real mesh): spec=%s "
-            "mesh=%s: %s", tuple(param.shape), spec, m.shape, e)
+            "mesh=%s: %s", tuple(param.shape), spec, m.shape, err)
     return param
 
 

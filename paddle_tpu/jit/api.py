@@ -26,6 +26,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..framework import state as _registry
@@ -172,6 +173,25 @@ class StaticFunction:
             self._finalize_entry(entry, state, tensor_raws)
         rw_raws = [state[i]._data for i in entry["rw_idx"]]
         ro_raws = [state[i]._data for i in entry["ro_idx"]]
+        if entry.get("donates"):
+            # a buffer aliased into a donated rw slot AND any other
+            # reference — an ro/tensor input, another rw slot, or a
+            # snapshot state tensor PRUNED from the jaxpr — would be
+            # deleted by donation while still referenced. Count every
+            # live holder; donate a copy when a buffer has >1.
+            # (Aliasing across slots is rare; normal steps only pay
+            # the id() sweep.)
+            counts = {}
+            for t in state:
+                k = id(t._data)
+                counts[k] = counts.get(k, 0) + 1
+            for r in tensor_raws:
+                counts[id(r)] = counts.get(id(r), 0) + 1
+            rw_raws = [
+                jnp.array(r, copy=True) if counts.get(id(r), 0) > 1
+                else r
+                for r in rw_raws
+            ]
         out_arrs, changed_state, grad_raws = entry["jitted"](
             rw_raws, ro_raws, tensor_raws
         )
@@ -341,6 +361,7 @@ class StaticFunction:
             self._donate and jax.default_backend() != "cpu"
         ) else ()
         entry["jitted"] = jax.jit(runner, donate_argnums=donate)
+        entry["donates"] = bool(donate)
         entry["pruned_jaxpr"] = pruned
         entry["rw_idx"] = rw_idx
         entry["ro_idx"] = ro_idx

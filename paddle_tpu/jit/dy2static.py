@@ -86,9 +86,22 @@ def _cvt_if(pred, true_fn, false_fn, operands, names):
     if not _is_traced(pred):
         return true_fn(operands) if pred else false_fn(operands)
 
+    praw = pred._data if isinstance(pred, Tensor) else jnp.asarray(pred)
+    if getattr(praw, "size", 1) != 1:
+        # eager Python would raise the ambiguous-truth-value error for
+        # a multi-element predicate; silently where-selecting would
+        # broadcast outputs to unintended shapes. Checked BEFORE
+        # tracing the branches so a body that itself chokes on the
+        # multi-element assumption can't mask this diagnostic.
+        raise TypeError(
+            f"converted `if` predicate has shape "
+            f"{tuple(getattr(praw, 'shape', ()))}: the truth value of "
+            "a multi-element tensor is ambiguous (use paddle.where "
+            "for elementwise selection, or reduce the predicate with "
+            ".any()/.all())"
+        )
     t_out = true_fn(operands)
     f_out = false_fn(operands)
-    praw = pred._data if isinstance(pred, Tensor) else jnp.asarray(pred)
     out = []
     for name, t, f in zip(names, t_out, f_out):
         if t is f:

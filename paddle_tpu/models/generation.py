@@ -157,10 +157,11 @@ def speculative_generate(model, draft_model, input_ids,
     TPU-native mechanics: the KV caches are FUNCTIONAL arrays, so
     rejection needs no rollback — rejected positions hold stale K/V
     that the next window (k+1 tokens wide, advancing by at least one)
-    always overwrites before any mask can expose them. Exactly two
-    compiled programs run per round (a 1-token draft step and a
-    (k+1)-token verify step), each with a traced ``pos`` — shapes
-    never change, so both compile once.
+    always overwrites before any mask can expose them. A BOUNDED set
+    of compiled shapes runs per round — the 1-token draft step, the
+    (k+1)-token verify step, and a catch-up draft step that is 1 token
+    wide after a partial acceptance or 2 after a full one — each with
+    a traced ``pos``, so every shape compiles once.
 
     Batch size must be 1 (per-row acceptance lengths would desync the
     shared scalar cache position). Returns [1, S0 + n_generated]

@@ -58,8 +58,13 @@ class LlamaConfig:
     # "ring" (ppermute KV rotation) or "ulysses" (all_to_all head swap)
     context_parallel: str = "ring"
     recompute: bool = False
+    # "full" replays the whole layer in backward; "selective"/
+    # "core_attn" keep matmul outputs and replay only the cheap glue
+    # (upstream recompute_granularity — fleet/recompute)
+    recompute_granularity: str = "full"
     # chunked fused linear+CE loss head: never materializes the [T, V]
-    # logits (ops/kernels/fused_loss.py). Single-replica-vocab only;
+    # logits (ops/kernels/fused_loss.py). At mp>1 the vocab-parallel
+    # variant engages (shard-local lse + mp-collective combine);
     # forward returns (None, loss) when engaged.
     fused_head_loss: bool = False
     # Qwen2-style bias on q/k/v projections (o_proj stays bias-free)
@@ -470,7 +475,8 @@ class LlamaModel(Layer):
             from ..distributed.fleet.recompute import recompute
 
             for l in self.layers:
-                h = recompute(l, h)
+                h = recompute(
+                    l, h, granularity=self.config.recompute_granularity)
         else:
             for l in self.layers:
                 h = l(h)

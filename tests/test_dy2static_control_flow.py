@@ -34,6 +34,21 @@ class TestConvertedIf:
         np.testing.assert_allclose(_val(fn(xp)), np.full(4, 5.0), rtol=1e-6)
         np.testing.assert_allclose(_val(fn(xn)), np.full(4, -6.0), rtol=1e-6)
 
+    def test_multi_element_predicate_raises_loud(self):
+        # eager Python raises the ambiguous-truth-value error for
+        # `if tensor:` on a multi-element tensor; the converted `if`
+        # must not silently turn it into an elementwise where-select
+        @paddle.jit.to_static
+        def fn(x):
+            if x > 0:  # x has 3 elements -> ambiguous
+                y = x * 2.0
+            else:
+                y = x - 3.0
+            return y
+
+        with pytest.raises(TypeError, match="ambiguous"):
+            fn(paddle.to_tensor(np.float32([1.0, -2.0, 3.0])))
+
     def test_eager_equivalence(self):
         def raw(x):
             if paddle.mean(x) > 0:

@@ -34,6 +34,69 @@ def test_recompute_matches_plain():
     np.testing.assert_allclose(gw1, gw0, rtol=1e-5, atol=1e-6)
 
 
+def test_selective_granularity_matches_plain():
+    """recompute_granularity='selective' (dots-saveable policy —
+    upstream fleet recompute_granularity) must be numerically
+    identical; unknown granularity must raise loudly."""
+    import pytest
+
+    paddle.seed(77)
+    blk = nn.Sequential(nn.Linear(8, 16), nn.Silu(), nn.Linear(16, 8))
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype("float32"))
+    x.stop_gradient = False
+    out_p = blk(x)
+    loss_p = paddle.tensor.math.mean(out_p * out_p)
+    loss_p.backward()
+    g_p = np.asarray(x.grad._data).copy()
+    x.clear_gradient()
+    for p in blk.parameters():
+        p.clear_gradient()
+    out_s = recompute(blk, x, granularity="selective")
+    loss_s = paddle.tensor.math.mean(out_s * out_s)
+    loss_s.backward()
+    np.testing.assert_allclose(
+        float(np.asarray(loss_s._data)), float(np.asarray(loss_p._data)),
+        rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(x.grad._data), g_p,
+                               rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="granularity"):
+        recompute(blk, x, granularity="bogus")
+
+
+def test_llama_selective_recompute_trajectory():
+    """LlamaConfig.recompute_granularity='selective' trains to the
+    same losses as full recompute and as no recompute."""
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    def train(rc, gran):
+        cfg = llama_tiny(recompute=rc, recompute_granularity=gran,
+                         tie_word_embeddings=True)
+        paddle.seed(3)
+        model = LlamaForCausalLM(cfg)
+        opt = optim.AdamW(1e-3, parameters=model.parameters())
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (2, 32)).astype("int32"))
+        y = paddle.to_tensor(
+            ((np.asarray(x._data) + 1) % cfg.vocab_size).astype("int64"))
+        out = []
+        for _ in range(2):
+            _, loss = model(x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            out.append(float(np.asarray(loss._data)))
+        return out
+
+    none = train(False, "full")
+    full = train(True, "full")
+    sel = train(True, "selective")
+    np.testing.assert_allclose(full, none, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(sel, none, rtol=2e-5, atol=2e-6)
+
+
 def test_recompute_multi_arg():
     paddle.seed(3)
 
