@@ -21,11 +21,23 @@ decorated function's own source is rewritten to call
     leaves, body/cond run under ``no_grad`` (reverse-mode through a
     dynamic-trip-count loop is undefined in XLA, matching jax).
 
+``return``/``break``/``continue`` are DESUGARED first
+(``_EarlyExitDesugar``, the upstream return/break-continue transformer
+role): early returns thread a ``__pt_v_ret`` done-flag plus
+``__pt_v_rv*`` result slots with every following statement gated on
+the flag; break/continue become per-loop guard flags, and convertible
+loops stop via the runtime converters' ``stop_names`` support. Sound
+only when every return site has one arity and a value-returning
+function ends with a top-level return; early return inside a TRACED
+loop stays unsupported (the result's shape is unknown before the
+first iteration — the converter raises with the break-based rewrite).
+
 Conversion restrictions (the node is left unconverted and a traced
 predicate then raises the loud trace-time error from
-``framework.core``): branches/bodies containing return/break/continue/
-yield/global/nonlocal/import or nested def/class; side-effect-only
-branches (no variable assigned); loops carrying non-array state.
+``framework.core``): branches/bodies containing undesugared
+return/break/continue/yield/global/nonlocal/import or nested
+def/class; side-effect-only branches (no variable assigned); loops
+carrying non-array state.
 A converted ``for`` carries its loop variable out with python's leak
 semantics (last executed value; pre-bound value survives an empty
 range); iteration over non-range iterables (lists, concrete tensors)
@@ -80,7 +92,7 @@ def _pack(loc, names):
     )
 
 
-def _cvt_if(pred, true_fn, false_fn, operands, names):
+def _cvt_if(pred, true_fn, false_fn, operands, names, gated=False):
     from ..framework.core import Tensor
 
     if not _is_traced(pred):
@@ -113,6 +125,23 @@ def _cvt_if(pred, true_fn, false_fn, operands, names):
             out.append(t)
             continue
         if t_undef or f_undef:
+            if gated or name.startswith("__pt_v_rv"):
+                # early-return slot — or any name first bound inside a
+                # desugar-generated GATE if: the gating invariant
+                # guarantees such a name is READ only on paths where
+                # the gate predicate selected the defined side, so the
+                # undefined side merges as zeros of the defined side's
+                # shape/dtype — never observable
+                d = f if t_undef else t
+                dt = d if isinstance(d, Tensor) else Tensor(
+                    jnp.asarray(d))
+                z = Tensor(jnp.zeros_like(dt._data))
+                tt, ft = (z, dt) if t_undef else (dt, z)
+                from .. import tensor as _t
+
+                cond_t = pred if isinstance(pred, Tensor) else Tensor(praw)
+                out.append(_t.where(cond_t, tt, ft))
+                continue
             raise TypeError(
                 f"converted `if` on a traced predicate: variable "
                 f"'{name}' is assigned in only one branch; a traced "
@@ -164,21 +193,52 @@ def _seed_trips(operands, names, trip_seeds):
     )
 
 
-def _cvt_while(cond_fn, body_fn, operands, names, trip_seeds=()):
+def _stop_raw(v):
+    from ..framework.core import Tensor
+
+    return v._data if isinstance(v, Tensor) else v
+
+
+def _cvt_while(cond_fn, body_fn, operands, names, trip_seeds=(),
+               stop_names=()):
     from ..framework.core import Tensor, no_grad
 
     operands = _seed_trips(operands, names, trip_seeds)
+    stop_idx = [names.index(s) for s in stop_names if s in names]
     first = cond_fn(operands)
     if not _is_traced(first):
         vals = operands
         cur = first
+        bail = False
         while cur:
             vals = body_fn(vals)
+            # break/early-return desugar: the body set a stop flag —
+            # exit NOW (remaining body statements were gated inside).
+            # A TRACED flag (concrete while-test but data-dependent
+            # break) can't drive a Python loop: restart as a
+            # lax.while_loop from the original operands (the partial
+            # eager iteration is dead code XLA removes).
+            flags = [_stop_raw(vals[i]) for i in stop_idx]
+            if any(isinstance(f, jax.core.Tracer) for f in flags):
+                bail = True
+                break
+            if any(bool(f) for f in flags):
+                break
             cur = cond_fn(vals)
-        return vals
+        if not bail:
+            return vals
 
     for name, v in zip(names, operands):
         if isinstance(v, Undefined):
+            if name.startswith("__pt_v_rv"):
+                raise TypeError(
+                    "converted `while` on a traced predicate: early "
+                    "`return` inside a traced while-loop is "
+                    "unsupported (the return value's shape is unknown "
+                    "before the first iteration); restructure to "
+                    "compute the result into a pre-initialized "
+                    "variable and `break`, returning after the loop"
+                )
             raise TypeError(
                 f"converted `while` on a traced predicate: loop "
                 f"variable '{name}' is unbound before the loop"
@@ -204,7 +264,10 @@ def _cvt_while(cond_fn, body_fn, operands, names, trip_seeds=()):
     def c(rs):
         with no_grad():
             r = cond_fn(wrap(rs))
-        return r._data if isinstance(r, Tensor) else jnp.asarray(r)
+        raw = r._data if isinstance(r, Tensor) else jnp.asarray(r)
+        for i in stop_idx:
+            raw = jnp.logical_and(raw, jnp.logical_not(rs[i]))
+        return raw
 
     def b(rs):
         with no_grad():
@@ -233,7 +296,7 @@ def _cvt_while(cond_fn, body_fn, operands, names, trip_seeds=()):
 
 
 def _cvt_for_range(rargs, body_fn, operands, names, target,
-                   trip_seeds=()):
+                   trip_seeds=(), stop_names=()):
     """``for t in range(...)`` dispatch: concrete bounds run the plain
     Python loop; a traced stop/start lowers to lax.while_loop with the
     trip variable in the carry (body under no_grad, like _cvt_while).
@@ -270,14 +333,36 @@ def _cvt_for_range(rargs, body_fn, operands, names, target,
         )
     operands = _seed_trips(operands, names, trip_seeds)
 
+    stop_idx = [names.index(s) for s in stop_names if s in names]
     if not (_is_traced(start) or _is_traced(stop)):
         vals = operands
+        bail = False
         for i in range(int(start), int(stop), step):
             vals = body_fn(i, vals)
-        return vals
+            flags = [_stop_raw(vals[k]) for k in stop_idx]
+            if any(isinstance(f, jax.core.Tracer) for f in flags):
+                # concrete bounds but a data-dependent break: a traced
+                # flag can't drive a Python loop — restart as a
+                # lax.while_loop from the original operands (the
+                # partial eager iteration is dead code XLA removes)
+                bail = True
+                break
+            if any(bool(f) for f in flags):
+                break
+        if not bail:
+            return vals
 
     for name, v in zip(names, operands):
         if isinstance(v, Undefined):
+            if name.startswith("__pt_v_rv"):
+                raise TypeError(
+                    "converted `for` on a traced range: early "
+                    "`return` inside the loop is unsupported (the "
+                    "return value's shape is unknown before the first "
+                    "iteration); compute the result into a "
+                    "pre-initialized variable and `break`, returning "
+                    "after the loop"
+                )
             raise TypeError(
                 f"converted `for` on a traced range: loop variable "
                 f"'{name}' is unbound before the loop"
@@ -305,7 +390,10 @@ def _cvt_for_range(rargs, body_fn, operands, names, target,
 
     def c(carry):
         i = carry[0]
-        return (i < e_raw) if step > 0 else (i > e_raw)
+        cond = (i < e_raw) if step > 0 else (i > e_raw)
+        for k in stop_idx:
+            cond = jnp.logical_and(cond, jnp.logical_not(carry[1 + k]))
+        return cond
 
     def b(carry):
         i = carry[0]
@@ -334,11 +422,37 @@ def _cvt_for_range(rargs, body_fn, operands, names, target,
     )
 
 
+def _pt_not(x):
+    """Flag negation usable on python bools AND traced arrays (plain
+    `not` would hit the ambiguous-truth-value error under trace)."""
+    from ..framework.core import Tensor
+
+    raw = x._data if isinstance(x, Tensor) else x
+    if isinstance(raw, (jax.Array, jax.core.Tracer)):
+        return jnp.logical_not(raw)
+    return not raw
+
+
+def _pt_or(*xs):
+    from ..framework.core import Tensor
+
+    raws = [x._data if isinstance(x, Tensor) else x for x in xs]
+    if any(isinstance(r, (jax.Array, jax.core.Tracer)) for r in raws):
+        out = jnp.asarray(raws[0], bool) if not isinstance(
+            raws[0], (jax.Array, jax.core.Tracer)) else raws[0]
+        for r in raws[1:]:
+            out = jnp.logical_or(out, r)
+        return out
+    return any(raws)
+
+
 _HELPERS = {
     "__pt_cvt_if": _cvt_if,
     "__pt_cvt_while": _cvt_while,
     "__pt_cvt_for": _cvt_for_range,
     "__pt_pack": _pack,
+    "__pt_not": _pt_not,
+    "__pt_or": _pt_or,
 }
 
 
@@ -358,15 +472,18 @@ _BANNED = (ast.Return, ast.Break, ast.Continue, ast.Global, ast.Nonlocal,
            ast.Try, ast.With)
 
 
-def _safe_block(stmts):
+def _safe_block(stmts, allow=()):
     """A block is convertible only if re-execution/selection preserves
     its semantics: no control-flow escapes, no scope escapes, and no
     in-place side effects (subscript/attribute stores, bare
     side-effect calls like `buf.append(x)`) — a traced conversion
-    executes BOTH if-branches, so ungated mutation would be wrong."""
+    executes BOTH if-branches, so ungated mutation would be wrong.
+    ``allow`` lifts specific bans (the early-exit desugar checks
+    convertibility of a body whose break/continue/return it is about
+    to remove)."""
     for s in stmts:
         for node in ast.walk(s):
-            if isinstance(node, _BANNED):
+            if isinstance(node, _BANNED) and not isinstance(node, allow):
                 return False
             if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
                 targets = node.targets if isinstance(node, ast.Assign) \
@@ -429,6 +546,315 @@ def _assigned(stmts):
     return names
 
 
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+           ast.Lambda)
+_LOOPS = (ast.For, ast.While, ast.AsyncFor)
+
+
+class _SkipDesugar(Exception):
+    """A construct prevents a sound early-exit desugar; the function
+    is left as-is (the existing loud trace-time errors cover misuse)."""
+
+
+def _walk_scoped(node, loop_boundary=False):
+    """node + descendants, not descending into nested scopes (and,
+    with loop_boundary, not into nested loops — a break/continue in a
+    nested loop binds there, not here)."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, _SCOPES):
+            continue
+        if loop_boundary and isinstance(child, _LOOPS):
+            continue
+        yield from _walk_scoped(child, loop_boundary)
+
+
+def _has_node(node, types, loop_boundary=False):
+    return any(
+        isinstance(n, types) and n is not node
+        for n in _walk_scoped(node, loop_boundary)
+    )
+
+
+def _is_range_for(node):
+    it = node.iter
+    return (isinstance(node, ast.For) and isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name) and it.func.id == "range"
+            and 1 <= len(it.args) <= 3 and not it.keywords
+            and isinstance(node.target, ast.Name) and not node.orelse)
+
+
+def _ret_arity(r):
+    if r.value is None or (isinstance(r.value, ast.Constant)
+                           and r.value.value is None):
+        return 0
+    if isinstance(r.value, ast.Tuple):
+        return len(r.value.elts)
+    return 1
+
+
+def _asg(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value)
+
+
+def _not_flags(flags):
+    """AST for ``__pt_not(f)`` / ``__pt_not(__pt_or(f1, f2, ...))``."""
+    loads = [ast.Name(id=f, ctx=ast.Load()) for f in sorted(flags)]
+    inner = loads[0] if len(loads) == 1 else ast.Call(
+        func=ast.Name(id="__pt_or", ctx=ast.Load()), args=loads,
+        keywords=[])
+    return ast.Call(func=ast.Name(id="__pt_not", ctx=ast.Load()),
+                    args=[inner], keywords=[])
+
+
+class _EarlyExitDesugar:
+    """Rewrite early ``return``/``break``/``continue`` into flag
+    threading (upstream: dy2static's return and break_continue
+    transformers), so the generic if/while converters can trace them:
+
+    * ``return e`` -> ``__pt_v_rv* = e; __pt_v_ret = True``; every
+      following statement is gated on the flag, enclosing convertible
+      loops stop via the runtime ``stop_names`` support, and the
+      function ends with one ``return __pt_v_rv*``.
+    * ``break``    -> ``__pt_v_brk<i> = True`` + gating + loop stop.
+    * ``continue`` -> ``__pt_v_cont<i> = True`` + gating of the rest
+      of the body (the flag resets each iteration).
+
+    Applied only when sound: every return site has the same arity, a
+    value-returning function must END with a top-level return (so all
+    paths bind the result — python's implicit ``return None`` on a
+    fall-off path cannot merge with arrays under trace), and
+    return/break/continue must not sit inside try/with/match or a
+    loop-else. Break/continue are desugared only when their nearest
+    loop is convertible (``while`` / ``for-range``); in other loops
+    they stay untouched (eager semantics, loud when traced)."""
+
+    def __init__(self):
+        self.applied = 0
+        self.arity = None
+        self._n = 0
+
+    def run(self, fdef):
+        # each function scope (the decorated fn + any nested defs)
+        # desugars independently — _walk_scoped stops at scope
+        # boundaries, so inner returns never leak into outer flags
+        import copy
+
+        def child_defs(stmts):
+            out, stack = [], list(stmts)
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    out.append(n)  # its innards handled on its turn
+                    continue
+                if isinstance(n, (ast.ClassDef, ast.Lambda)):
+                    continue
+                stack.extend(ast.iter_child_nodes(n))
+            return out
+
+        work = [fdef]
+        while work:
+            scope = work.pop()
+            # rewrite a COPY first: a mid-rewrite _SkipDesugar must
+            # not leave the real tree half-desugared. On success the
+            # new body (with copied nested defs) replaces the old, so
+            # child scopes are re-discovered from the new tree.
+            trial = copy.deepcopy(scope)
+            try:
+                self._run(trial)
+                scope.body = trial.body
+            except _SkipDesugar:
+                pass
+            work.extend(child_defs(scope.body))
+
+    # -- analysis ----------------------------------------------------------
+
+    def _any_loop_bc(self, fdef):
+        for n in _walk_scoped(fdef):
+            if isinstance(n, ast.While) or (
+                    isinstance(n, ast.For) and _is_range_for(n)):
+                if any(_has_node(s, (ast.Break, ast.Continue),
+                                 loop_boundary=True) or
+                       isinstance(s, (ast.Break, ast.Continue))
+                       for s in n.body):
+                    return True
+        return False
+
+    def _run(self, fdef):
+        flagged = (ast.Return, ast.Break, ast.Continue)
+        guards = [ast.Try, ast.With, ast.AsyncWith]
+        if hasattr(ast, "Match"):
+            guards.append(ast.Match)
+        for n in _walk_scoped(fdef):
+            if isinstance(n, tuple(guards)) and _has_node(n, flagged):
+                raise _SkipDesugar
+            if isinstance(n, _LOOPS) and n.orelse and (
+                    _has_node(n, flagged)):
+                raise _SkipDesugar
+
+        rets = [n for n in _walk_scoped(fdef)
+                if isinstance(n, ast.Return)]
+        trailing = bool(fdef.body) and isinstance(fdef.body[-1],
+                                                  ast.Return)
+        early = [r for r in rets
+                 if not (trailing and r is fdef.body[-1])]
+        needs_ret = bool(early)
+        if not needs_ret and not self._any_loop_bc(fdef):
+            return
+        if needs_ret:
+            arities = {_ret_arity(r) for r in rets}
+            if len(arities) != 1:
+                raise _SkipDesugar  # mixed return arity
+            self.arity = arities.pop()
+            if self.arity > 0 and not trailing:
+                raise _SkipDesugar  # a fall-off path would return None
+
+        body = self._block(list(fdef.body), needs_ret, None)
+        prologue = ([_asg("__pt_v_ret", ast.Constant(value=False))]
+                    if needs_ret else [])
+        epilogue = []
+        if needs_ret and self.arity == 1:
+            epilogue = [ast.Return(value=ast.Name(id="__pt_v_rv0",
+                                                  ctx=ast.Load()))]
+        elif needs_ret and self.arity > 1:
+            epilogue = [ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=f"__pt_v_rv{j}", ctx=ast.Load())
+                      for j in range(self.arity)],
+                ctx=ast.Load()))]
+        fdef.body = prologue + body + epilogue
+        self.applied += 1
+
+    # -- rewriting ---------------------------------------------------------
+
+    def _block(self, stmts, ret, loop):
+        out = []
+        for idx, s in enumerate(stmts):
+            repl, sets = self._stmt(s, ret, loop)
+            out.extend(repl)
+            if sets:
+                rest = self._block(stmts[idx + 1:], ret, loop)
+                if rest:
+                    gate = ast.If(test=_not_flags(sets), body=rest,
+                                  orelse=[])
+                    gate._pt_gate = True
+                    out.append(gate)
+                return out
+        return out
+
+    def _sets_of(self, s, ret, loop):
+        sets = set()
+        if ret and (isinstance(s, ast.Return)
+                    or _has_node(s, ast.Return)):
+            sets.add("__pt_v_ret")
+        if loop:
+            direct = isinstance(s, (ast.Break, ast.Continue))
+            if loop.get("brk") and (
+                    isinstance(s, ast.Break)
+                    or (not isinstance(s, _LOOPS) and not direct
+                        and _has_node(s, ast.Break, loop_boundary=True))):
+                sets.add(loop["brk"])
+            if loop.get("cont") and (
+                    isinstance(s, ast.Continue)
+                    or (not isinstance(s, _LOOPS) and not direct
+                        and _has_node(s, ast.Continue,
+                                      loop_boundary=True))):
+                sets.add(loop["cont"])
+        return sets
+
+    def _stmt(self, s, ret, loop):
+        sets = self._sets_of(s, ret, loop)
+        if isinstance(s, ast.Return):
+            if not ret:
+                # only loop break/continue are being desugared; a
+                # plain return is untouched (it can only be the
+                # trailing one or sit outside converted regions)
+                return [s], set()
+            repl = []
+            if self.arity == 1:
+                repl.append(_asg("__pt_v_rv0", s.value))
+            elif self.arity > 1:
+                for j, e in enumerate(s.value.elts):
+                    repl.append(_asg(f"__pt_v_rv{j}", e))
+            repl.append(_asg("__pt_v_ret", ast.Constant(value=True)))
+            return repl, sets
+        if isinstance(s, ast.Break):
+            if not (loop and loop.get("brk")):
+                return [s], set()  # non-convertible loop: untouched
+            return [_asg(loop["brk"], ast.Constant(value=True))], sets
+        if isinstance(s, ast.Continue):
+            if not (loop and loop.get("cont")):
+                return [s], set()
+            return [_asg(loop["cont"], ast.Constant(value=True))], sets
+        if isinstance(s, ast.If):
+            s.body = self._block(s.body, ret, loop)
+            s.orelse = self._block(s.orelse, ret, loop)
+            return [s], sets
+        if isinstance(s, ast.While) or (
+                isinstance(s, ast.For) and _is_range_for(s)):
+            return self._loop(s, ret)
+        if isinstance(s, ast.For):
+            # non-convertible loop (iterable/tensor): break/continue
+            # stay python; a `return` inside still threads the flag —
+            # the loop can't stop early, so gate the WHOLE body per
+            # iteration (post-return iterations become no-ops)
+            if ret and _has_node(s, ast.Return):
+                inner = self._block(s.body, ret,
+                                    {"brk": None, "cont": None})
+                gate = ast.If(test=_not_flags({"__pt_v_ret"}),
+                              body=inner, orelse=[])
+                gate._pt_gate = True
+                s.body = [gate]
+                return [s], {"__pt_v_ret"}
+            return [s], set()
+        return [s], sets
+
+    def _loop(self, node, ret):
+        # the flag/stop rewrite is only sound when the TRANSFORMER will
+        # actually convert this loop (the runtime stop_names support is
+        # what ends it): a body _safe_block rejects for other reasons
+        # (bare calls, subscript stores, ...) must keep its raw
+        # break/continue/return — a desugared break in a loop that then
+        # stays plain Python would simply never fire
+        if not _safe_block(node.body,
+                           allow=(ast.Return, ast.Break, ast.Continue)):
+            return [node], set()
+        has_ret = ret and _has_node(node, ast.Return)
+        has_brk = any(
+            not isinstance(s, _LOOPS) and (
+                isinstance(s, ast.Break)
+                or _has_node(s, ast.Break, loop_boundary=True))
+            for s in node.body)
+        has_cont = any(
+            not isinstance(s, _LOOPS) and (
+                isinstance(s, ast.Continue)
+                or _has_node(s, ast.Continue, loop_boundary=True))
+            for s in node.body)
+        brk = cont = None
+        if has_brk:
+            self._n += 1
+            brk = f"__pt_v_brk{self._n}"
+        if has_cont:
+            self._n += 1
+            cont = f"__pt_v_cont{self._n}"
+        body = self._block(node.body, ret, {"brk": brk, "cont": cont})
+        pre = []
+        if cont:
+            # reset each iteration; pre-bind so the carry is typed
+            body = [_asg(cont, ast.Constant(value=False))] + body
+            pre.append(_asg(cont, ast.Constant(value=False)))
+        if brk:
+            pre.append(_asg(brk, ast.Constant(value=False)))
+        node.body = body
+        stops = tuple(
+            f for f in (brk, "__pt_v_ret" if has_ret else None) if f)
+        if stops:
+            node._pt_stops = stops
+        sets = {"__pt_v_ret"} if has_ret else set()
+        return pre + [node], sets
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.n = 0
@@ -476,7 +902,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             self.generic_visit(node)
             return node
         names = sorted(_assigned(node.body) | _assigned(node.orelse))
-        if not names or any(n.startswith("__pt_") for n in names):
+        # "__pt_v_*" names are the early-exit desugar's own flag/value
+        # variables — legitimate loop/branch-carried data; any other
+        # "__pt_*" name collides with generated internals
+        if not names or any(
+                n.startswith("__pt_") and not n.startswith("__pt_v_")
+                for n in names):
             self.generic_visit(node)
             return node
         self.n += 1
@@ -496,7 +927,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                   self._pack_call(names),
                   ast.Tuple(elts=[ast.Constant(value=n) for n in names],
                             ctx=ast.Load())],
-            keywords=[])
+            keywords=([ast.keyword(arg="gated",
+                                   value=ast.Constant(value=True))]
+                      if getattr(node, "_pt_gate", False) else []))
         assign = ast.Assign(
             targets=[ast.Tuple(
                 elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
@@ -520,7 +953,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return node
         target = node.target.id
         names = sorted(_assigned(node.body) | {target})
-        names = [n for n in names if not n.startswith("__pt_")]
+        names = [n for n in names
+                 if not n.startswith("__pt_") or n.startswith("__pt_v_")]
         if names == [target]:
             self.generic_visit(node)
             return node
@@ -562,7 +996,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                       ast.Constant(value=n)
                       for n in sorted(_nested_range_targets(node.body))],
                       ctx=ast.Load())],
-            keywords=[])
+            keywords=[ast.keyword(
+                arg="stop_names",
+                value=ast.Tuple(
+                    elts=[ast.Constant(value=n)
+                          for n in getattr(node, "_pt_stops", ())],
+                    ctx=ast.Load()))])
         assign = ast.Assign(
             targets=[ast.Tuple(
                 elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
@@ -578,7 +1017,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         # read (limits, modules, params) stay closure-resolved so
         # non-array objects never enter the lax.while_loop carry
         names = sorted(_assigned(node.body))
-        names = [n for n in names if not n.startswith("__pt_")]
+        names = [n for n in names
+                 if not n.startswith("__pt_") or n.startswith("__pt_v_")]
         if not names:
             self.generic_visit(node)
             return node
@@ -615,7 +1055,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                       ast.Constant(value=n)
                       for n in sorted(_nested_range_targets(node.body))],
                       ctx=ast.Load())],
-            keywords=[])
+            keywords=[ast.keyword(
+                arg="stop_names",
+                value=ast.Tuple(
+                    elts=[ast.Constant(value=n)
+                          for n in getattr(node, "_pt_stops", ())],
+                    ctx=ast.Load()))])
         assign = ast.Assign(
             targets=[ast.Tuple(
                 elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
@@ -645,9 +1090,11 @@ def convert_control_flow(fn):
         if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
             return fn
         fdef.decorator_list = []
+        pre = _EarlyExitDesugar()
+        pre.run(fdef)
         tr = _ControlFlowTransformer()
         tr.visit(fdef)
-        if not tr.converted:
+        if not (tr.converted or pre.applied):
             return fn
         ast.fix_missing_locations(tree)
 
