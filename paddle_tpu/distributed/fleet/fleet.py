@@ -67,6 +67,13 @@ class Fleet:
         )
 
         if mode == ParallelMode.PIPELINE_PARALLEL:
+            # upstream picks the interleaved (VPP) runner when the
+            # PipelineLayer was built with virtual stages
+            if (getattr(model, "_virtual_pp_degree", 1) or 1) > 1:
+                from .meta_parallel import PipelineParallelWithInterleave
+
+                return PipelineParallelWithInterleave(
+                    model, self._hcg, self._strategy)
             return PipelineParallel(model, self._hcg, self._strategy)
         if mode == ParallelMode.TENSOR_PARALLEL:
             return TensorParallel(model, self._hcg, self._strategy)
