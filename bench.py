@@ -339,10 +339,16 @@ def bench_llama_headline(dry=False, steps=10, seq=2048, batch=8):
         # recompute=False leans on XLA auto-remat (jaxpr-liveness peak
         # 26.2 GB > 16 GB HBM, tools/roofline.py --liveness) and is
         # what the 46.08% r3 headline measured; BENCH_RECOMPUTE=1
-        # flips to the predictable-schedule variant (peak 11.4 GB).
+        # flips to full explicit recompute (peak 11.4 GB) and
+        # BENCH_RECOMPUTE=selective to the dots-saveable policy the r5
+        # SCALE_7B plan runs — the three-way comparison separates
+        # remat flops from residual overhead (VERDICT r4 weak #2).
+        rc = os.environ.get("BENCH_RECOMPUTE", "")
         cfg = llama_headline(
             max_position_embeddings=seq,
-            recompute=os.environ.get("BENCH_RECOMPUTE") == "1")
+            recompute=rc in ("1", "selective"),
+            recompute_granularity=("selective" if rc == "selective"
+                                   else "full"))
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)

@@ -121,3 +121,60 @@ def ifftshift(x, axes=None, name=None):
         int(v) for v in (axes if isinstance(axes, (list, tuple)) else [axes])
     )
     return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=av), x)
+
+
+def _swap_norm(norm):
+    """hfft-family norm swap (numpy convention: the c2r/r2c pair runs
+    the OPPOSITE direction internally, so backward<->forward flip and
+    ortho stays)."""
+    n = _norm(norm)
+    return {"backward": "forward", "forward": "backward"}.get(n, n)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D FFT of a Hermitian-symmetric signal (upstream paddle.fft
+    .hfft2): irfft2 of the conjugate with the direction-swapped norm —
+    the same construction numpy's 1-D hfft uses."""
+    x = _as_tensor(x)
+    sv = None if s is None else tuple(int(v) for v in s)
+    return apply_op(
+        "hfft2",
+        lambda a: jnp.fft.irfft2(jnp.conj(a), s=sv, axes=tuple(axes),
+                                 norm=_swap_norm(norm)),
+        x,
+    )
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    x = _as_tensor(x)
+    sv = None if s is None else tuple(int(v) for v in s)
+    return apply_op(
+        "ihfft2",
+        lambda a: jnp.conj(jnp.fft.rfft2(a, s=sv, axes=tuple(axes),
+                                         norm=_swap_norm(norm))),
+        x,
+    )
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = _as_tensor(x)
+    sv = None if s is None else tuple(int(v) for v in s)
+    av = None if axes is None else tuple(int(v) for v in axes)
+    return apply_op(
+        "hfftn",
+        lambda a: jnp.fft.irfftn(jnp.conj(a), s=sv, axes=av,
+                                 norm=_swap_norm(norm)),
+        x,
+    )
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = _as_tensor(x)
+    sv = None if s is None else tuple(int(v) for v in s)
+    av = None if axes is None else tuple(int(v) for v in axes)
+    return apply_op(
+        "ihfftn",
+        lambda a: jnp.conj(jnp.fft.rfftn(a, s=sv, axes=av,
+                                         norm=_swap_norm(norm))),
+        x,
+    )

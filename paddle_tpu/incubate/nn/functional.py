@@ -446,3 +446,54 @@ def masked_multihead_attention(
         return out.astype(xr.dtype).reshape(b, h * d), new_cache
 
     return apply_op("masked_multihead_attention", f, *args, n_outs=2)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (upstream incubate softmax_mask_fuse —
+    on TPU, XLA fuses the additive mask into the softmax)."""
+    x = _as_tensor(x)
+    mask = _as_tensor(mask)
+
+    def f(a, m):
+        return jax.nn.softmax(a.astype(jnp.float32)
+                              + m.astype(jnp.float32),
+                              axis=-1).astype(a.dtype)
+
+    return apply_op("softmax_mask_fuse", f, x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (upstream incubate
+    softmax_mask_fuse_upper_triangle): positions j > i masked out."""
+    x = _as_tensor(x)
+
+    def f(a):
+        s = a.shape[-1]
+        i = jnp.arange(a.shape[-2])[:, None]
+        j = jnp.arange(s)[None, :]
+        af = jnp.where(j <= i, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(af, axis=-1).astype(a.dtype)
+
+    return apply_op("softmax_mask_fuse_upper_triangle", f, x)
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None,
+                                dropout_p=0.0, is_causal=False,
+                                training=True, name=None):
+    """Alias surface of scaled_dot_product_attention (upstream
+    incubate fused_dot_product_attention over cuDNN; here the flash
+    Pallas/XLA path IS the fused kernel)."""
+    from ...nn.functional import scaled_dot_product_attention
+
+    return scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training)
+
+
+def fused_gemm_epilogue(x, y, bias, trans_x=False, trans_y=False,
+                        activation="none", name=None):
+    """Alias of fused_linear_activation (upstream fused_gemm_epilogue
+    over cublasLt epilogues; XLA fuses bias+act into the matmul)."""
+    return fused_linear_activation(
+        x, y, bias, trans_x=trans_x, trans_y=trans_y,
+        activation=None if activation in ("none", None) else activation)

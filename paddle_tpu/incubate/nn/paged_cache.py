@@ -58,6 +58,21 @@ class PagedKVCacheManager:
     def seq_len(self, seq_id):
         return self._lens[seq_id]
 
+    def truncate(self, seq_id, n):
+        """Roll a sequence back to ``n`` tokens (speculative-decoding
+        rejection: stale K/V beyond ``n`` is never attended — the
+        kernels mask by seq_len — and pages past ceil(n/P) return to
+        the pool)."""
+        cur = self._lens[seq_id]
+        if n > cur:
+            raise ValueError(
+                f"truncate({seq_id!r}, {n}): sequence has only {cur}")
+        keep = -(-n // self.page_size) if n else 0
+        tbl = self._tables[seq_id]
+        while len(tbl) > keep:
+            self._free.append(tbl.pop())
+        self._lens[seq_id] = n
+
     @property
     def num_free_pages(self) -> int:
         return len(self._free)

@@ -14,8 +14,11 @@ paged append + attend → o_proj → mlp) → final norm → lm head.
 """
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor, no_grad
@@ -110,3 +113,85 @@ class PagedLlamaAdapter:
                 x = x + layer.mlp(layer.post_attention_layernorm(x))
             h = self.model.model.norm(x)
             return self.model._head(h)
+
+
+def _window_logits(self, token_windows, seq_ids):
+    """Verify a w-token window per sequence in ONE forward pass
+    (the speculative-decoding verify step; upstream: the serving role
+    of fused_multi_transformer's multi-token branch).
+
+    token_windows: (B, w) ints. Appends all w tokens to the caches
+    (reject by rolling back with ``cache.truncate``) and returns
+    logits (B, w, vocab): logits[:, j] conditions on everything
+    through window token j.
+
+    TPU-first: the w queries attend over the paged pool via a DENSE
+    gather of each sequence's pages + one masked attention einsum —
+    regular compute XLA tiles onto the MXU, instead of w sequential
+    single-token kernel calls (which would erase the speculative
+    speedup)."""
+    cfg = self.cfg
+    toks = np.asarray(token_windows, "int64")
+    b, w = toks.shape
+    nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    group = nh // nkv
+    lens0 = [self.caches[0].seq_len(s) for s in seq_ids]
+    over = [s for s, n in zip(seq_ids, lens0)
+            if n + w > self.max_length]
+    if over:
+        raise ValueError(
+            f"sequences {over} would exceed max_length="
+            f"{self.max_length} verifying a {w}-token window")
+    pos = (jnp.asarray(lens0, jnp.int32)[:, None]
+           + jnp.arange(w, dtype=jnp.int32)[None, :])  # (B, w)
+
+    with no_grad():
+        x = self.model.model.embed_tokens(Tensor(toks))  # (B, w, H)
+        xr = x._data
+        for li, layer in enumerate(self.model.model.layers):
+            xi = layer.input_layernorm(Tensor(xr))
+            q = layer.self_attn.q_proj(xi)
+            k = layer.self_attn.k_proj(xi)
+            v = layer.self_attn.v_proj(xi)
+            qh = q._data.reshape(b, w, nh, hd)
+            kh = k._data.reshape(b, w, nkv, hd)
+            vh = v._data.reshape(b, w, nkv, hd)
+            qh = apply_rotary_emb(qh, self._cos, self._sin,
+                                  position_ids=pos)
+            kh = apply_rotary_emb(kh, self._cos, self._sin,
+                                  position_ids=pos)
+            for j in range(w):
+                self.caches[li].append_batch(
+                    seq_ids, kh[:, j], vh[:, j])
+            c = self.caches[li]
+            tbl = c.page_table(seq_ids)          # (B, MP)
+            kd = c.k_pages[tbl]                  # (B, MP, P, KVH, D)
+            vd = c.v_pages[tbl]
+            mp = tbl.shape[1]
+            kd = kd.reshape(b, mp * c.page_size, nkv, hd)
+            vd = vd.reshape(b, mp * c.page_size, nkv, hd)
+            if group > 1:
+                kd = jnp.repeat(kd, group, axis=2)
+                vd = jnp.repeat(vd, group, axis=2)
+            s = jnp.einsum(
+                "bwhd,bkhd->bhwk", qh.astype(jnp.float32),
+                kd.astype(jnp.float32)) / math.sqrt(hd)
+            kpos = jnp.arange(mp * c.page_size)[None, None, None, :]
+            ok = kpos <= pos[:, None, :, None]  # causal within window
+            if self._window:
+                ok = ok & (kpos > pos[:, None, :, None] - self._window)
+            s = jnp.where(ok, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("bhwk,bkhd->bwhd", p,
+                              vd.astype(jnp.float32))
+            attn = attn.astype(xr.dtype).reshape(b, w, nh * hd)
+            xr = xr + layer.self_attn.o_proj(Tensor(attn))._data
+            h2 = layer.mlp(layer.post_attention_layernorm(Tensor(xr)))
+            xr = xr + h2._data
+        h = self.model.model.norm(Tensor(xr))
+        return self.model._head(h)  # (B, w, V)
+
+
+PagedLlamaAdapter.decode_window = _window_logits
+del _window_logits

@@ -77,7 +77,7 @@ class BatchScheduler:
     """
 
     def __init__(self, model, max_batch_size=32, page_watermark=0.95,
-                 sampler=None):
+                 sampler=None, draft_model=None, draft_k=4):
         self.model = model
         self.max_batch_size = int(max_batch_size)
         self.page_watermark = float(page_watermark)
@@ -85,18 +85,39 @@ class BatchScheduler:
         self._queue = collections.deque()
         self._active = {}
         self._finished = {}
+        # speculative decoding (upstream: the serving role of
+        # fused_multi_transformer's draft-verify deployments): a small
+        # draft adapter proposes draft_k tokens per sequence per round;
+        # the target verifies the whole window in ONE decode_window
+        # call. Greedy acceptance — output token-identical to the
+        # non-speculative scheduler. Batch>1 is native: per-row
+        # acceptance lengths live in the paged caches' per-sequence
+        # lens (rejections roll back with cache.truncate).
+        self.draft = draft_model
+        self.draft_k = int(draft_k)
+        if draft_model is not None and sampler is not None:
+            raise ValueError(
+                "speculative scheduling is greedy-only (a custom "
+                "sampler would break the token-identity guarantee); "
+                "use models.speculative_generate for sampled "
+                "speculative decoding")
+        self.spec_stats = {"rounds": 0, "target_calls": 0,
+                           "draft_calls": 0, "committed_tokens": 0}
 
     # -- pool accounting ---------------------------------------------------
-    def _pool(self):
-        caches = list(self.model.caches)
+    def _pool(self, model=None):
+        caches = list((model or self.model).caches)
         total = sum(c.num_pages for c in caches)
         free = sum(c.num_free_pages for c in caches)
         return total, free
 
-    def _pages_needed(self, req: Request) -> int:
+    def _pages_needed(self, req: Request, model=None) -> int:
         need = 0
-        for c in self.model.caches:
-            need += -(-req.total_tokens() // c.page_size)
+        # speculative windows transiently overshoot the committed
+        # length by up to draft_k+1 tokens before the rollback
+        slack = (self.draft_k + 1) if self.draft is not None else 0
+        for c in (model or self.model).caches:
+            need += -(-(req.total_tokens() + slack) // c.page_size)
         return need
 
     def page_pool_stats(self):
@@ -117,6 +138,12 @@ class BatchScheduler:
         # context-length bound (models that declare one): rejecting at
         # submit beats a mid-batch crash for every co-batched request
         limit = getattr(self.model, "max_length", None)
+        if limit is not None and self.draft is not None:
+            # a speculative verify window transiently appends up to
+            # draft_k+1 tokens beyond the committed prefix before the
+            # rollback — admission must leave that headroom or
+            # decode_window raises mid-batch near the end
+            limit = limit - (self.draft_k + 1)
         if limit is not None and req.total_tokens() > limit:
             raise ValueError(
                 f"request {req.req_id!r} needs {req.total_tokens()} "
@@ -149,8 +176,24 @@ class BatchScheduler:
             projected = used + self._reserved_pages_outstanding() + need
             if projected > self.page_watermark * total:
                 return
+            if self.draft is not None:
+                # the draft pool is budgeted too (it may be sized
+                # differently): worst-case draft need for every active
+                # request + this one must fit under the watermark
+                need_d = self._pages_needed(req, self.draft)
+                total_d, free_d = self._pool(self.draft)
+                used_d = total_d - free_d
+                # conservative: the full worst-case draft need of every
+                # active request (already-used pages count toward it)
+                out_d = sum(self._pages_needed(r, self.draft)
+                            for r in self._active.values())
+                if max(out_d, used_d) + need_d > \
+                        self.page_watermark * total_d:
+                    return
             self._queue.popleft()
             self.model.alloc(req.req_id)
+            if self.draft is not None:
+                self.draft.alloc(req.req_id)
             req.state = RequestState.PREFILL
             req._reserved = need
             self._active[req.req_id] = req
@@ -172,6 +215,8 @@ class BatchScheduler:
 
     def _retire(self, req: Request):
         self.model.free(req.req_id)
+        if self.draft is not None:
+            self.draft.free(req.req_id)
         req.state = RequestState.FINISHED
         del self._active[req.req_id]
         self._finished[req.req_id] = req
@@ -186,6 +231,9 @@ class BatchScheduler:
         admitted = len(self._active) - n_before
         if not self._active:
             return {"admitted": admitted, "advanced": 0, "finished": 0}
+
+        if self.draft is not None:
+            return self._step_spec(admitted)
 
         sids = sorted(self._active)
         feed = []
@@ -237,6 +285,109 @@ class BatchScheduler:
             "advanced": len(sids),
             "finished": finished,
         }
+
+    def _step_spec(self, admitted) -> dict:
+        """Speculative scheduler step: prefill rows advance one prompt
+        token on BOTH adapters; decode rows run one draft-propose /
+        target-verify round each, committing 1..draft_k+1 tokens.
+        Output is token-identical to the plain greedy scheduler."""
+        sids = sorted(self._active)
+        pre = [s for s in sids
+               if self._active[s].state == RequestState.PREFILL]
+        dec = [s for s in sids
+               if self._active[s].state == RequestState.DECODE]
+        finished = 0
+        advanced = 0
+
+        if pre:
+            feed = [self._active[s].prompt_ids[self._active[s]._pos]
+                    for s in pre]
+            logits = self.model.decode_token(feed, pre)
+            self.draft.decode_token(feed, pre)  # mirror the prompt
+            logits_np = np.asarray(
+                logits.numpy() if hasattr(logits, "numpy") else logits)
+            for bi, s in enumerate(pre):
+                req = self._active[s]
+                tok = req.prompt_ids[req._pos]
+                req._pos += 1
+                if req.on_token is not None:
+                    req.on_token(req, tok, True)
+                if req._pos == len(req.prompt_ids):
+                    if req.max_new_tokens == 0:
+                        self._retire(req)
+                        finished += 1
+                        continue
+                    req.state = RequestState.DECODE
+                    first = int(np.argmax(logits_np[bi]))
+                    req.generated_ids.append(first)
+                    if req.on_token is not None:
+                        req.on_token(req, first, False)
+                    if self._done(req, first):
+                        self._retire(req)
+                        finished += 1
+            advanced += len(pre)
+
+        if dec:
+            k = self.draft_k
+            base_t = {s: self.model.caches[0].seq_len(s) for s in dec}
+            base_d = {s: self.draft.caches[0].seq_len(s) for s in dec}
+            cur = [self._active[s].generated_ids[-1] for s in dec]
+            props = []
+            for _ in range(k):
+                dl = np.asarray(self.draft.decode_token(cur, dec)._data)
+                cur = [int(np.argmax(dl[i])) for i in range(len(dec))]
+                props.append(cur)
+            # feed the k-th proposal too, so the draft cache never lags
+            # the committed prefix (rejections roll back by truncate)
+            self.draft.decode_token(cur, dec)
+            windows = np.asarray(
+                [[self._active[s].generated_ids[-1]]
+                 + [props[j][i] for j in range(k)]
+                 for i, s in enumerate(dec)], np.int64)
+            tl = self.model.decode_window(windows, dec)
+            preds = np.argmax(np.asarray(tl._data), axis=-1)  # (B, k+1)
+            self.spec_stats["rounds"] += 1
+            self.spec_stats["target_calls"] += 1
+            self.spec_stats["draft_calls"] += k + 1
+
+            for i, s in enumerate(dec):
+                req = self._active[s]
+                n_acc = 0
+                while (n_acc < k
+                       and props[n_acc][i] == int(preds[i, n_acc])):
+                    n_acc += 1
+                    if (req.eos_id is not None
+                            and props[n_acc - 1][i] == req.eos_id):
+                        break
+                accepted = [props[j][i] for j in range(n_acc)]
+                if (req.eos_id is None or not accepted
+                        or accepted[-1] != req.eos_id):
+                    accepted.append(int(preds[i, n_acc]))
+                done = False
+                committed = 0
+                for t in accepted:
+                    req.generated_ids.append(t)
+                    committed += 1
+                    self.spec_stats["committed_tokens"] += 1
+                    if req.on_token is not None:
+                        req.on_token(req, t, False)
+                    if self._done(req, t):
+                        done = True
+                        break
+                if done:
+                    self._retire(req)
+                    finished += 1
+                else:
+                    # committed prefix back in the caches: everything
+                    # except the newest token (fed next round)
+                    for c in self.model.caches:
+                        c.truncate(s, base_t[s] + committed)
+                    for c in self.draft.caches:
+                        c.truncate(s, base_d[s] + committed)
+            advanced += len(dec)
+
+        return {"admitted": admitted, "advanced": advanced,
+                "finished": finished}
 
     def _done(self, req: Request, last_tok: int) -> bool:
         if req.eos_id is not None and last_tok == req.eos_id:

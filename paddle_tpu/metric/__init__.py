@@ -176,3 +176,31 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def auc(stat_pos=None, stat_neg=None, input=None, label=None,
+        curve="ROC", num_thresholds=4095, name=None):
+    """Functional AUC (upstream: the static auc op). Accepts either
+    (input, label) score/label tensors or accumulated pos/neg
+    histograms. Both branches reuse Auc.accumulate — one accumulation
+    implementation, no drift."""
+    import numpy as _np
+
+    from ..framework.core import Tensor as _T
+
+    if curve != "ROC":
+        raise ValueError(
+            f"auc: unsupported curve {curve!r} (only 'ROC')")
+    a = Auc(num_thresholds=num_thresholds)
+    if input is not None and label is not None:
+        p = _np.asarray(input._data if isinstance(input, _T) else input)
+        l_ = _np.asarray(label._data if isinstance(label, _T) else label)
+        a.update(p, l_)
+    else:
+        sp = _np.asarray(stat_pos._data if isinstance(stat_pos, _T)
+                         else stat_pos, _np.float64)
+        sn = _np.asarray(stat_neg._data if isinstance(stat_neg, _T)
+                         else stat_neg, _np.float64)
+        a._stat_pos = sp
+        a._stat_neg = sn
+    return _T(_np.float32(a.accumulate()))

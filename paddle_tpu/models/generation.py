@@ -144,9 +144,58 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         return concat(tokens, axis=1)
 
 
+def _spec_accept_sampled(p_logits, proposals, q_probs, key,
+                         temperature):
+    """Device-side speculative-sampling acceptance (the Leviathan /
+    Chen et al. rule — upstream: the sampling-mode acceptance of
+    speculative serving stacks). All math runs on device; the caller
+    pulls (n_acc, tokens) in ONE host transfer per round.
+
+    p_logits: [k+1, V] target logits over the verify window;
+    proposals: [k] int32 draft tokens; q_probs: [k, V] the draft's
+    (temperature-applied) proposal distributions; key: PRNG key.
+
+    Accept x_j while u_j < p_j(x_j)/q_j(x_j); at the first rejection
+    sample the replacement from norm(max(p_j - q_j, 0)); after k
+    acceptances sample the bonus from p_{k+1}. Output distribution is
+    EXACTLY target-alone sampling (the telescoping identity
+    q(x)min(1, p/q) + P(reject) norm(max(p-q)) = p).
+    Returns (n_acc int32, tokens int32 [k+1]).
+    """
+    k = proposals.shape[0]
+    p = jax.nn.softmax(
+        p_logits.astype(jnp.float32) / temperature, axis=-1)
+    ku, kr = jax.random.split(key)
+    u = jax.random.uniform(ku, (k,), jnp.float32)
+    p_sel = jnp.take_along_axis(p[:k], proposals[:, None], axis=1)[:, 0]
+    q_sel = jnp.take_along_axis(q_probs, proposals[:, None],
+                                axis=1)[:, 0]
+    accept = u < p_sel / jnp.maximum(q_sel, 1e-20)
+    acc_prefix = jnp.cumprod(accept.astype(jnp.int32))
+    n_acc = acc_prefix.sum().astype(jnp.int32)
+    # final slot: bonus dist at full acceptance, residual otherwise
+    p_at = jax.lax.dynamic_index_in_dim(p, n_acc, axis=0,
+                                        keepdims=False)
+    q_at = jax.lax.dynamic_index_in_dim(
+        jnp.concatenate([q_probs, jnp.zeros((1, q_probs.shape[1]),
+                                            jnp.float32)]),
+        n_acc, axis=0, keepdims=False)
+    resid = jnp.maximum(p_at - q_at, 0.0)
+    total = resid.sum()
+    dist = jnp.where(total > 0, resid / jnp.maximum(total, 1e-20),
+                     p_at)
+    final = jax.random.categorical(kr, jnp.log(
+        jnp.maximum(dist, 1e-38)))
+    toks = jnp.concatenate(
+        [proposals, jnp.zeros((1,), proposals.dtype)])
+    toks = toks.at[n_acc].set(final.astype(proposals.dtype))
+    return n_acc, toks
+
+
 def speculative_generate(model, draft_model, input_ids,
                          max_new_tokens=32, draft_k=4,
-                         eos_token_id=None, return_stats=False):
+                         eos_token_id=None, return_stats=False,
+                         do_sample=False, temperature=1.0):
     """Greedy speculative decoding: ``draft_model`` proposes
     ``draft_k`` tokens autoregressively, ``model`` verifies them in
     ONE decode_step, and the longest matching prefix (+ the target's
@@ -163,9 +212,18 @@ def speculative_generate(model, draft_model, input_ids,
     wide after a partial acceptance or 2 after a full one — each with
     a traced ``pos``, so every shape compiles once.
 
-    Batch size must be 1 (per-row acceptance lengths would desync the
-    shared scalar cache position). Returns [1, S0 + n_generated]
-    (n_generated <= max_new_tokens; stops early at eos)."""
+    ``do_sample=True`` switches to SAMPLED acceptance (the
+    Leviathan/Chen speculative-sampling rule, `_spec_accept_sampled`):
+    draft proposals are sampled from q, accepted with prob
+    min(1, p/q), the first rejection resamples from norm(max(p-q, 0)),
+    and the output distribution is exactly target-alone sampling. The
+    accept math runs fused on device — one host pull per round.
+
+    Batch size must be 1 here (the dense KV cache has one shared
+    scalar position); BATCHED speculative decoding lives in the
+    serving path — ``BatchScheduler(draft_model=...)`` — where per-row
+    acceptance lengths ride the paged cache's per-sequence lens.
+    Returns [1, S0 + n_generated] (stops early at eos)."""
     b, s0 = input_ids.shape
     if b != 1:
         raise ValueError(
@@ -179,7 +237,13 @@ def speculative_generate(model, draft_model, input_ids,
                             "tokens_per_target_call": 0.0}) \
             if return_stats else input_ids
 
+    temperature = float(temperature)
+    if do_sample and temperature <= 0:
+        raise ValueError("do_sample needs temperature > 0")
+
     with no_grad():
+        from ..framework.random import next_key
+
         max_len = s0 + max_new_tokens + draft_k + 1
         t_caches = model.init_cache(b, max_len)
         d_caches = draft_model.init_cache(b, max_len)
@@ -187,14 +251,21 @@ def speculative_generate(model, draft_model, input_ids,
         def _argmax_last(l):
             return jnp.argmax(l[:, -1], axis=-1).astype(jnp.int32)
 
-        # prefill both models on the prompt; target's argmax is the
-        # first committed token
+        def _sample_last(l):
+            lf = l[:, -1].astype(jnp.float32) / temperature
+            return jax.random.categorical(next_key(), lf,
+                                          axis=-1).astype(jnp.int32)
+
+        # prefill both models on the prompt; the target's pick is the
+        # first committed token (sampled under do_sample — target-
+        # alone semantics)
         t_logits, t_caches = model.decode_step(
             input_ids, t_caches, to_tensor(np.int32(0)))
         _, d_caches = draft_model.decode_step(
             input_ids, d_caches, to_tensor(np.int32(0)))
-        first = apply_op("spec_argmax", _argmax_last, t_logits,
-                         differentiable=False)
+        first = apply_op(
+            "spec_pick", _sample_last if do_sample else _argmax_last,
+            t_logits, differentiable=False)
         out = [int(np.asarray(first._data)[0])]
         n_target_calls = 1
         d_next = s0  # first draft-cache position not yet written
@@ -213,44 +284,76 @@ def speculative_generate(model, draft_model, input_ids,
             dl, d_caches = draft_model.decode_step(
                 cur, d_caches, to_tensor(np.int32(d_next)))
             # --- draft proposes k tokens; the chain stays ON DEVICE
-            # ([1,1] argmax fed straight back), proposal values reach
+            # ([1,1] pick fed straight back), proposal values reach
             # the host in one pull afterwards ------------------------
-            cur = apply_op(
-                "spec_argmax1",
-                lambda l: jnp.argmax(
-                    l[:, -1], axis=-1)[:, None].astype(jnp.int32),
-                dl, differentiable=False)
-            props = [cur]
+            if do_sample:
+                def _draft_pick(l):
+                    lf = l[:, -1].astype(jnp.float32) / temperature
+                    q = jax.nn.softmax(lf, axis=-1)
+                    tok = jax.random.categorical(
+                        next_key(), lf, axis=-1)
+                    return tok[:, None].astype(jnp.int32), q
+            else:
+                def _draft_pick(l):
+                    return (jnp.argmax(l[:, -1], axis=-1)[:, None]
+                            .astype(jnp.int32), l[:, -1] * 0)
+
+            cur, q0 = apply_op("spec_draft_pick", _draft_pick, dl,
+                               n_outs=2, differentiable=False)
+            props, qs = [cur], [q0]
             for j in range(1, draft_k):
                 dl, d_caches = draft_model.decode_step(
                     cur, d_caches, to_tensor(np.int32(base + j)))
-                cur = apply_op(
-                    "spec_argmax1",
-                    lambda l: jnp.argmax(
-                        l[:, -1], axis=-1)[:, None].astype(jnp.int32),
-                    dl, differentiable=False)
+                cur, qj = apply_op("spec_draft_pick", _draft_pick, dl,
+                                   n_outs=2, differentiable=False)
                 props.append(cur)
+                qs.append(qj)
             proposal = [int(np.asarray(p._data)[0, 0]) for p in props]
             # --- target verifies the whole window in one step -------
             window = np.array([[out[-1]] + proposal], np.int32)
             tl, t_caches = model.decode_step(
                 to_tensor(window), t_caches, to_tensor(np.int32(base)))
             n_target_calls += 1
-            preds = np.asarray(apply_op(
-                "spec_argmax_all",
-                lambda l: jnp.argmax(l, axis=-1).astype(jnp.int32),
-                tl, differentiable=False)._data)[0]
-            # preds[j] = target's next token after window[:j+1]
-            n_acc = 0
-            while n_acc < draft_k and proposal[n_acc] == int(preds[n_acc]):
-                n_acc += 1
-                if eos_token_id is not None \
-                        and proposal[n_acc - 1] == eos_token_id:
-                    break
-            accepted = proposal[:n_acc]
-            if (eos_token_id is None or
-                    (not accepted or accepted[-1] != eos_token_id)):
-                accepted = accepted + [int(preds[n_acc])]  # bonus token
+            if do_sample:
+                # device-side fused acceptance; ONE host pull/round
+                prop_dev = jnp.asarray(
+                    [proposal], jnp.int32)[0]
+                q_dev = jnp.concatenate(
+                    [q._data[:1] for q in qs], axis=0)  # [k, V]
+                n_acc_d, toks_d = _spec_accept_sampled(
+                    tl._data[0], prop_dev, q_dev, next_key(),
+                    temperature)
+                n_acc = int(np.asarray(n_acc_d))
+                toks = np.asarray(toks_d)
+                accepted = [int(t) for t in toks[:n_acc]]
+                # eos inside the accepted prefix ends the output there
+                if eos_token_id is not None:
+                    for ei, t in enumerate(accepted):
+                        if t == eos_token_id:
+                            accepted = accepted[:ei + 1]
+                            n_acc = ei + 1
+                            break
+                    else:
+                        accepted = accepted + [int(toks[n_acc])]
+                else:
+                    accepted = accepted + [int(toks[n_acc])]
+            else:
+                preds = np.asarray(apply_op(
+                    "spec_argmax_all",
+                    lambda l: jnp.argmax(l, axis=-1).astype(jnp.int32),
+                    tl, differentiable=False)._data)[0]
+                # preds[j] = target's next token after window[:j+1]
+                n_acc = 0
+                while (n_acc < draft_k
+                       and proposal[n_acc] == int(preds[n_acc])):
+                    n_acc += 1
+                    if eos_token_id is not None \
+                            and proposal[n_acc - 1] == eos_token_id:
+                        break
+                accepted = proposal[:n_acc]
+                if (eos_token_id is None or
+                        (not accepted or accepted[-1] != eos_token_id)):
+                    accepted = accepted + [int(preds[n_acc])]  # bonus
             room = max_new_tokens - len(out)
             out.extend(accepted[:room])
             # draft-cache positions valid AND committed: the draft loop

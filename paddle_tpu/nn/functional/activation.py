@@ -225,3 +225,22 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
         return jnp.where(a >= 0, a, a * slope)
 
     return apply_op("rrelu", f, x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...tensor.math import _inplace
+
+    return _inplace(x, elu(x, alpha))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from ...tensor.math import _inplace
+
+    return _inplace(x, leaky_relu(x, negative_slope))
+
+
+def rrelu_(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True,
+           name=None):
+    from ...tensor.math import _inplace
+
+    return _inplace(x, rrelu(x, lower, upper, training))

@@ -539,3 +539,41 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
         ) + coef_b
 
     return apply_op("feature_alpha_dropout", f, x)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Row mask from lengths (upstream sequence_mask op): out[..., j] =
+    j < x[...]."""
+    from ...framework.dtype import to_np_dtype
+
+    x = _as_tensor(x)
+
+    def f(a):
+        m = int(maxlen) if maxlen is not None else int(a.max())
+        return (jnp.arange(m) < a[..., None]).astype(to_np_dtype(dtype))
+
+    return apply_op("sequence_mask", f, x, differentiable=False)
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (upstream gather_tree op): walk parent
+    pointers from the last step to recover full beams.
+    ids/parents: [max_time, batch, beam]."""
+    ids = _as_tensor(ids)
+    parents = _as_tensor(parents)
+
+    def f(idr, par):
+        t, b, k = idr.shape
+
+        def step(beam, ti):
+            # beam: [batch, k] parent slot at time ti+1; emit ids[ti]
+            out = jnp.take_along_axis(idr[ti], beam, axis=1)
+            nxt = jnp.take_along_axis(par[ti], beam, axis=1)
+            return nxt, out
+
+        init = jnp.tile(jnp.arange(k)[None, :], (b, 1))
+        _, outs = jax.lax.scan(step, init, jnp.arange(t - 1, -1, -1))
+        return outs[::-1]
+
+    return apply_op("gather_tree", f, ids, parents,
+                    differentiable=False)

@@ -820,3 +820,74 @@ def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
         return _reduce(loss, reduction)
 
     return apply_op("gaussian_nll_loss", f, input, label, variance)
+
+
+def identity_loss(x, reduction="none", name=None):
+    """Mark a value as a loss (upstream identity_loss op: used by the
+    IPU path; semantics are reduce-or-passthrough)."""
+    x = _as_tensor(x)
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if red == "none":
+        return apply_op("identity_loss", lambda a: a, x)
+    if red == "mean":
+        return apply_op("identity_loss", jnp.mean, x)
+    if red == "sum":
+        return apply_op("identity_loss", jnp.sum, x)
+    raise ValueError(f"identity_loss: unknown reduction {reduction!r}")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight,
+                                   tail_weights, cutoffs,
+                                   head_bias=None, name=None):
+    """Adaptive softmax (upstream adaptive_log_softmax_with_loss,
+    python/paddle/nn/functional/loss.py): the vocab splits into a
+    shortlist head [0, cutoffs[0]) plus cluster buckets; cluster c
+    covers [cutoffs[c], cutoffs[c+1]) and projects through
+    tail_weights[c] = [W_proj [in, hid_c], W_out [hid_c, size_c]].
+    logprob(word in cluster c) = head cluster-logit's log_softmax +
+    in-cluster log_softmax. Returns (per-sample target logprob, mean
+    NLL loss)."""
+    input = _as_tensor(input)
+    label = _as_tensor(label)
+    head_weight = _as_tensor(head_weight)
+    tails = [t for pair in tail_weights for t in
+             (_as_tensor(pair[0]), _as_tensor(pair[1]))]
+    args = [input, label, head_weight] + tails
+    has_hb = head_bias is not None
+    if has_hb:
+        args.append(_as_tensor(head_bias))
+    cuts = [int(c) for c in cutoffs]
+    shortlist = cuts[0]
+    n_clusters = len(cuts)
+    # bucket c spans [lo_c, hi_c): lo_0 = cutoffs[0]; the last bucket
+    # size comes from its W_out width at call time
+
+    def f(x, y, hw, *rest):
+        tws = rest[:2 * (n_clusters)]
+        hb = rest[2 * n_clusters] if has_hb else None
+        xf = x.astype(jnp.float32)
+        head_logits = xf @ hw.astype(jnp.float32)
+        if hb is not None:
+            head_logits = head_logits + hb.astype(jnp.float32)
+        head_lp = jax.nn.log_softmax(head_logits, axis=-1)
+        y = y.astype(jnp.int32)
+        short = jnp.take_along_axis(
+            head_lp, jnp.clip(y, 0, shortlist - 1)[:, None], axis=1
+        )[:, 0]
+        out = jnp.where(y < shortlist, short, 0.0)
+        lo = shortlist
+        for c in range(n_clusters):
+            wp = tws[2 * c].astype(jnp.float32)
+            wo = tws[2 * c + 1].astype(jnp.float32)
+            size_c = wo.shape[-1]
+            hi = lo + size_c
+            clp = jax.nn.log_softmax((xf @ wp) @ wo, axis=-1)
+            rel = jnp.clip(y - lo, 0, size_c - 1)
+            word_lp = head_lp[:, shortlist + c] + jnp.take_along_axis(
+                clp, rel[:, None], axis=1)[:, 0]
+            out = jnp.where((y >= lo) & (y < hi), word_lp, out)
+            lo = hi
+        return out, -jnp.mean(out)
+
+    return apply_op("adaptive_log_softmax_with_loss", f, *args,
+                    n_outs=2)

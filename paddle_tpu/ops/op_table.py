@@ -93,14 +93,20 @@ _WAIVER_GROUPS = {
         "to_tensor tril_indices triu_indices zeros zeros_like cast",
     "in-place variant with tensor-valued fill/mask arguments: aliases "
     "a swept op; in-place semantics tested in tests/test_ops.py":
-        "fill_diagonal_ flatten_ index_fill_ masked_fill_ where_",
+        "fill_diagonal_ flatten_ index_fill_ masked_fill_ where_ "
+        "index_add_ index_put_ masked_scatter_ put_along_axis_ "
+        "scatter_ fill_diagonal_tensor_",
     "alias of a swept op (same kernel)":
         "negative remainder floor_mod inverse igamma igammac view "
         "view_as positive",
+    "in-place twin of a predicate/int op: aliases the swept "
+    "out-of-place kernel; in-place semantics in tests/test_ops.py":
+        "floor_divide_ gcd_ lcm_ logical_and_ logical_not_ "
+        "logical_or_ logical_xor_",
     "stochastic output: RNG/determinism contracts tested in dedicated "
     "suites (test_ops dropout tests, test_distribution_signal)":
         "alpha_dropout dropout dropout2d dropout3d "
-        "feature_alpha_dropout gumbel_softmax rrelu "
+        "feature_alpha_dropout gumbel_softmax rrelu rrelu_ "
         "class_center_sample",
     "attention/fused kernel: covered by dedicated equivalence suites "
     "(test_flash_pallas, test_flash_varlen, test_paged_attention, "
@@ -131,6 +137,86 @@ _WAIVER_GROUPS = {
         "shard_index",
     "API-parity context manager / no-op shim":
         "sdp_kernel",
+    "spectral op, Hermitian family: complex-in/real-out, "
+    "parity-tested in test_fft_scatter":
+        "hfft2 ihfft2 hfftn ihfftn",
+    "alias of a swept/covered kernel (documented absorption)":
+        "fused_dot_product_attention fused_gemm_epilogue "
+        "bitwise_invert bitwise_invert_ sparse_sync_batch_norm",
+    "in-place bitwise twin: aliases the swept out-of-place kernel; "
+    "in-place semantics in tests/test_ops.py":
+        "bitwise_and_ bitwise_or_ bitwise_xor_ bitwise_not_ "
+        "bitwise_left_shift_ bitwise_right_shift_",
+    "structured/integer output (boxes, beams, masks, metrics): "
+    "covered by dedicated suites (test_vision_ops, test_nn_utils, "
+    "test_incubate_misc)":
+        "sequence_mask gather_tree viterbi_decode accuracy auc "
+        "matrix_nms distribute_fpn_proposals",
+    "adaptive softmax: full-softmax oracle test in test_op_suite "
+    "TestAdaptiveSoftmax":
+        "adaptive_log_softmax_with_loss",
+    "randomized sketch factorization: reconstruction-tested in "
+    "test_linalg_ext":
+        "pca_lowrank",
+    "optimizer update kernel: trajectory-parity-tested against the "
+    "Optimizer classes in test_optimizer_functional":
+        "sgd_ momentum_ adam_ adamw_ adagrad_ adadelta_ adamax_ "
+        "rmsprop_ lamb_ asgd_ lars_momentum_ rprop_ merged_adam_ "
+        "merged_momentum_",
+    "quantization grid op: grid/round-trip-tested in "
+    "test_quant_summary":
+        "quantize_linear dequantize_linear fake_quantize_abs_max "
+        "fake_channel_wise_quantize_abs_max",
+    "random sampling op: RNG/determinism contracts tested in "
+    "test_distribution_signal / test_ops":
+        "cauchy_ "
+        "bernoulli bernoulli_ binomial exponential_ geometric_ "
+        "log_normal multinomial normal normal_ poisson rand rand_like "
+        "randint randint_like randn randn_like randperm standard_gamma "
+        "standard_normal uniform uniform_",
+    "spectral op over complex dtypes: parity-tested against numpy in "
+    "test_distribution_signal / test_fft_scatter":
+        "fft ifft fft2 ifft2 fftn ifftn rfft irfft rfft2 irfft2 rfftn "
+        "irfftn hfft ihfft fftfreq rfftfreq fftshift ifftshift stft "
+        "istft frame overlap_add",
+    "sparse COO/CSR operand: the dense-array sweep cannot drive it; "
+    "covered by the sparse suites (test_sparse)":
+        "sparse_add sparse_is_same_shape sparse_masked_matmul "
+        "sparse_matmul sparse_multiply sparse_relu sparse_subtract "
+        "sparse_sum sparse_transpose "
+        "sparse_sparse_coo_tensor sparse_sparse_csr_tensor "
+        "sparse_sparse_coo_tensor_from_dense "
+        "sparse_sparse_csr_tensor_from_dense "
+        "sparse_sin sparse_sinh sparse_tan sparse_tanh sparse_asin "
+        "sparse_asinh sparse_atan sparse_atanh sparse_sqrt "
+        "sparse_square sparse_log1p sparse_abs sparse_expm1 "
+        "sparse_neg sparse_deg2rad sparse_rad2deg sparse_pow "
+        "sparse_cast sparse_coalesce sparse_to_dense "
+        "sparse_relu6 sparse_leaky_relu sparse_softmax "
+        "sparse_attention sparse_conv2d sparse_conv3d "
+        "sparse_subm_conv2d sparse_subm_conv3d sparse_max_pool3d "
+        "sparse_batch_norm sparse_mv sparse_addmm sparse_divide",
+    "vision op with structured box/index/file semantics: covered by "
+    "test_vision_ops":
+        "box_coder decode_jpeg deform_conv2d nms prior_box psroi_pool "
+        "read_file roi_align roi_pool yolo_box",
+    "graph/segment op with index operands: covered by test_geometric":
+        "segment_max segment_mean segment_min segment_sum send_u_recv "
+        "send_ue_recv send_uv",
+    "audio DSP helper (window/filterbank construction): covered by "
+    "test_audio_misc":
+        "compute_fbank_matrix create_dct fft_frequencies get_window "
+        "hz_to_mel mel_frequencies mel_to_hz power_to_db",
+    "fused kernel: covered by dedicated equivalence suites "
+    "(test_incubate_fused, test_paged_attention, test_fused_loss)":
+        "fused_bias_act fused_bias_dropout_residual_layer_norm "
+        "fused_dropout_add fused_feedforward fused_layer_norm "
+        "fused_linear fused_linear_activation "
+        "fused_linear_cross_entropy fused_matmul_bias "
+        "fused_multi_head_attention fused_rms_norm "
+        "fused_rotary_position_embedding masked_multihead_attention "
+        "paged_attention swiglu "
+        "variable_length_memory_efficient_attention",
 }
 
 SWEEP_WAIVERS = {
@@ -217,7 +303,120 @@ _DECL_GROUPS = [
      "tape); swept value-only against the out-of-place reference",
      "add_ clip_ divide_ exp_ fill_ floor_ frac_ multiply_ relu_ "
      "remainder_ reshape_ scale_ softmax_ subtract_ tril_ trunc_ "
-     "unsqueeze_ zero_"),
+     "unsqueeze_ zero_ "
+     "abs_ acos_ acosh_ asin_ asinh_ atan_ atan2_ atanh_ ceil_ cos_ "
+     "cosh_ cumprod_ cumsum_ digamma_ erf_ erfinv_ expm1_ heaviside_ "
+     "hypot_ i0_ ldexp_ lerp_ lgamma_ log_ log10_ log1p_ log2_ logit_ "
+     "multigammaln_ nan_to_num_ neg_ nextafter_ pow_ reciprocal_ "
+     "renorm_ round_ rsqrt_ sigmoid_ sin_ sinh_ sqrt_ square_ squeeze_ "
+     "t_ tan_ tanh_ triu_"),
+    (False, _ANY,
+     "in-place variant over int/bool-capable ops: mutates x; swept "
+     "value-only or covered by in-place semantics tests",
+     "floor_divide_ gcd_ lcm_ logical_and_ logical_not_ logical_or_ "
+     "logical_xor_ index_add_ index_put_ masked_scatter_ "
+     "put_along_axis_ scatter_"),
+    (False, _FLOAT,
+     "random sampling op: draws through the counter-based PRNG "
+     "(framework.random); nondiff, determinism-tested",
+     "bernoulli bernoulli_ binomial exponential_ geometric_ log_normal "
+     "multinomial normal normal_ poisson rand rand_like randint "
+     "randint_like randn randn_like randperm standard_gamma "
+     "standard_normal uniform uniform_"),
+    (True, _FLOAT,
+     "spectral/framing op (jnp.fft-backed; complex in/out supported)",
+     "fft ifft fft2 ifft2 fftn ifftn rfft irfft rfft2 irfft2 rfftn "
+     "irfftn hfft ihfft stft istft frame overlap_add"),
+    (False, _ANY,
+     "spectral helper: frequency grids / index shifts, no backward",
+     "fftfreq rfftfreq fftshift ifftshift"),
+    (True, _FLOAT,
+     "sparse COO/CSR compute op (jax.experimental.sparse-backed "
+     "values kernels; indices pass through)",
+     "sparse_add sparse_masked_matmul sparse_matmul sparse_multiply "
+     "sparse_relu sparse_subtract sparse_sum sparse_transpose "
+     "sparse_sin sparse_sinh sparse_tan sparse_tanh sparse_asin "
+     "sparse_asinh sparse_atan sparse_atanh sparse_sqrt sparse_square "
+     "sparse_log1p sparse_abs sparse_expm1 sparse_neg sparse_deg2rad "
+     "sparse_rad2deg sparse_pow sparse_to_dense "
+     "sparse_relu6 sparse_leaky_relu sparse_softmax sparse_attention "
+     "sparse_conv2d sparse_conv3d sparse_subm_conv2d "
+     "sparse_subm_conv3d sparse_max_pool3d sparse_batch_norm"),
+    (False, _ANY,
+     "sparse constructor / structural predicate",
+     "sparse_is_same_shape sparse_sparse_coo_tensor "
+     "sparse_sparse_csr_tensor sparse_sparse_coo_tensor_from_dense "
+     "sparse_sparse_csr_tensor_from_dense sparse_cast "
+     "sparse_coalesce"),
+    (True, _FLOAT,
+     "vision kernel with spatial gather/interp backward",
+     "deform_conv2d psroi_pool roi_align roi_pool"),
+    (False, _FLOAT,
+     "vision op with structured box/index/file output: no backward",
+     "box_coder decode_jpeg nms prior_box read_file yolo_box"),
+    (True, _FLOAT,
+     "graph/segment op: differentiable w.r.t. node/edge values",
+     "segment_max segment_mean segment_min segment_sum send_u_recv "
+     "send_ue_recv send_uv"),
+    (False, _FLOAT,
+     "audio DSP construction helper (windows, filterbanks, scales)",
+     "compute_fbank_matrix create_dct fft_frequencies get_window "
+     "hz_to_mel mel_frequencies mel_to_hz power_to_db"),
+    (True, _FLOAT,
+     "fused kernel (incubate): XLA/Pallas-fused training op",
+     "fused_bias_act fused_bias_dropout_residual_layer_norm "
+     "fused_dropout_add fused_feedforward fused_layer_norm "
+     "fused_linear fused_linear_activation fused_linear_cross_entropy "
+     "fused_matmul_bias fused_multi_head_attention fused_rms_norm "
+     "fused_rotary_position_embedding swiglu"),
+    (False, _FLOAT,
+     "fused serving/decode kernel: forward-only by design",
+     "masked_multihead_attention paged_attention "
+     "variable_length_memory_efficient_attention"),
+    (True, _FLOAT,
+     "spectral op, Hermitian family (conj + irfft/rfft with "
+     "direction-swapped norm, the numpy construction)",
+     "hfft2 ihfft2 hfftn ihfftn"),
+    (False, _ANY,
+     "in-place bitwise twin: mutates x, no backward",
+     "bitwise_and_ bitwise_or_ bitwise_xor_ bitwise_not_ "
+     "bitwise_invert_ bitwise_left_shift_ bitwise_right_shift_"),
+    (False, _ANY,
+     "alias of bitwise_not (upstream 2.6 rename)",
+     "bitwise_invert"),
+    (True, _FLOAT,
+     "float math long tail: tape vjp backward",
+     "clip_by_norm matrix_transpose vecdot "
+     "adaptive_log_softmax_with_loss identity_loss "
+     "softmax_mask_fuse softmax_mask_fuse_upper_triangle "
+     "fused_dot_product_attention fused_gemm_epilogue "
+     "fill_diagonal_tensor"),
+    (False, _FLOAT,
+     "in-place/aliasing variant of a float op",
+     "addmm_ polygamma_ elu_ leaky_relu_ rrelu_ "
+     "fill_diagonal_tensor_ cauchy_"),
+    (False, _ANY,
+     "structural/integer-output helper: no backward",
+     "histogram_bin_edges sequence_mask gather_tree viterbi_decode "
+     "accuracy auc matrix_nms distribute_fpn_proposals"),
+    (False, _FLOAT,
+     "randomized factorization (PRNG-seeded sketch): "
+     "reconstruction-tested, no grad sweep",
+     "pca_lowrank"),
+    (True, _FLOAT,
+     "sparse compute long tail",
+     "sparse_mv sparse_addmm sparse_divide sparse_sync_batch_norm"),
+    (False, _FLOAT,
+     "optimizer update kernel (upstream ops.yaml sgd_/adam_ family): "
+     "in-place fused param/state update, nondiff by definition",
+     "sgd_ momentum_ adam_ adamw_ adagrad_ adadelta_ adamax_ "
+     "rmsprop_ lamb_ asgd_ lars_momentum_ rprop_ merged_adam_ "
+     "merged_momentum_"),
+    (False, _FLOAT,
+     "quantization op: round/clip grid maps, straight-through or "
+     "forward-only",
+     "quantize_linear dequantize_linear fake_quantize_abs_max "
+     "fake_channel_wise_quantize_abs_max"),
 ]
 
 _DECLARED = {}
@@ -254,28 +453,53 @@ def _populate():
         return
     _POPULATED = True
     from ..tensor import (
-        creation, linalg, logic, manipulation, math, search, stat,
+        creation, linalg, logic, manipulation, math, random, search,
+        stat,
     )
     from ..nn import functional
+    from .. import fft, geometric, metric, quantization, signal, \
+        sparse, text
+    from ..optimizer import functional as optimizer_functional
+    from ..sparse.nn import functional as sparse_nn_functional
+    from ..audio import functional as audio_functional
+    from ..incubate.nn import functional as incubate_functional
+    from ..vision import ops as vision_ops
 
-    for mod, modname in [
-        (math, "tensor.math"),
-        (manipulation, "tensor.manipulation"),
-        (creation, "tensor.creation"),
-        (linalg, "tensor.linalg"),
-        (logic, "tensor.logic"),
-        (search, "tensor.search"),
-        (stat, "tensor.stat"),
-        (functional, "nn.functional"),
+    for mod, modname, prefix in [
+        (math, "tensor.math", ""),
+        (manipulation, "tensor.manipulation", ""),
+        (creation, "tensor.creation", ""),
+        (linalg, "tensor.linalg", ""),
+        (logic, "tensor.logic", ""),
+        (search, "tensor.search", ""),
+        (stat, "tensor.stat", ""),
+        (functional, "nn.functional", ""),
+        (random, "tensor.random", ""),
+        (fft, "fft", ""),
+        (signal, "signal", ""),
+        # sparse names collide with dense ops (add/matmul/relu/...):
+        # registered under the sparse_ prefix, mirroring how the
+        # reference keeps them in a separate sparse_ops.yaml
+        (sparse, "sparse", "sparse_"),
+        (sparse_nn_functional, "sparse.nn.functional", "sparse_"),
+        (audio_functional, "audio.functional", ""),
+        (geometric, "geometric", ""),
+        (incubate_functional, "incubate.nn.functional", ""),
+        (vision_ops, "vision.ops", ""),
+        (text, "text", ""),
+        (metric, "metric", ""),
+        (quantization, "quantization", ""),
+        (optimizer_functional, "optimizer.functional", ""),
     ]:
-        for name in dir(mod):
-            if name.startswith("_") or name in _NOT_OPS:
+        for rawname in dir(mod):
+            if rawname.startswith("_") or rawname in _NOT_OPS:
                 continue
-            fn = getattr(mod, name)
+            fn = getattr(mod, rawname)
             if not callable(fn) or inspect.isclass(fn):
                 continue
             if getattr(fn, "__module__", "").startswith("jax"):
                 continue
+            name = prefix + rawname
             if name in _TABLE:
                 continue  # first module wins (math before functional)
             if name in _DECLARED:

@@ -1,0 +1,334 @@
+"""Functional optimizer update kernels (upstream: the optimizer op
+family in paddle/phi/api/yaml/ops.yaml — sgd_, momentum_, adam_,
+adamw_, adagrad_, adadelta_, adamax_, rmsprop_, lamb_, asgd_ ... —
+each a fused in-place parameter/state update the reference's optimizer
+classes dispatch to).
+
+TPU-native: each kernel is one jnp expression over (param, grad,
+state...) that XLA fuses into a single elementwise pass; the Optimizer
+classes' step() remains the user surface, while these expose the raw
+update rules with the reference's op signatures (mutating ``param``
+and state tensors in place and returning them).
+
+All math runs in fp32 and casts back to the param dtype — the
+multi-precision behavior the reference's kernels implement with a
+master-weight input is composed at the Optimizer level here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op, _as_tensor
+
+
+def _upd(name, fn, *tensors, n_outs):
+    outs = apply_op(name, fn, *tensors, n_outs=n_outs,
+                    differentiable=False)
+    return outs if n_outs > 1 else (outs,)
+
+
+def _write(t, new):
+    t._data = new._data
+    t._version += 1
+    return t
+
+
+def _f32(a):
+    return a.astype(jnp.float32)
+
+
+def sgd_(param, learning_rate, grad, name=None):
+    """param <- param - lr * grad (upstream sgd_ op)."""
+    param, grad = _as_tensor(param), _as_tensor(grad)
+    lr = float(learning_rate)
+    (new,) = _upd("sgd", lambda p, g: (
+        _f32(p) - lr * _f32(g)).astype(p.dtype), param, grad, n_outs=1)
+    return _write(param, new)
+
+
+def momentum_(param, grad, velocity, learning_rate, mu=0.9,
+              use_nesterov=False, name=None):
+    """Heavy-ball / Nesterov momentum (upstream momentum_ op)."""
+    param, grad, velocity = (_as_tensor(param), _as_tensor(grad),
+                             _as_tensor(velocity))
+    lr, mu = float(learning_rate), float(mu)
+
+    def f(p, g, v):
+        vf = mu * _f32(v) + _f32(g)
+        if use_nesterov:
+            pf = _f32(p) - lr * (_f32(g) + mu * vf)
+        else:
+            pf = _f32(p) - lr * vf
+        return pf.astype(p.dtype), vf.astype(v.dtype)
+
+    new_p, new_v = _upd("momentum", f, param, grad, velocity, n_outs=2)
+    return _write(param, new_p), _write(velocity, new_v)
+
+
+def adam_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+          learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-8,
+          name=None):
+    """Adam update (upstream adam_ op). beta*_pow are the running
+    bias-correction accumulators; updated in place alongside the
+    moments."""
+    ts = [_as_tensor(t) for t in (param, grad, moment1, moment2,
+                                  beta1_pow, beta2_pow)]
+    param, grad, m1, m2, b1p, b2p = ts
+    lr = float(learning_rate)
+
+    def f(p, g, m, v, bp1, bp2):
+        gf = _f32(g)
+        mf = beta1 * _f32(m) + (1 - beta1) * gf
+        vf = beta2 * _f32(v) + (1 - beta2) * gf * gf
+        nbp1 = _f32(bp1) * beta1
+        nbp2 = _f32(bp2) * beta2
+        mhat = mf / (1 - nbp1)
+        vhat = vf / (1 - nbp2)
+        pf = _f32(p) - lr * mhat / (jnp.sqrt(vhat) + epsilon)
+        return (pf.astype(p.dtype), mf.astype(m.dtype),
+                vf.astype(v.dtype), nbp1.astype(bp1.dtype),
+                nbp2.astype(bp2.dtype))
+
+    outs = _upd("adam", f, param, grad, m1, m2, b1p, b2p, n_outs=5)
+    for t, n in zip((param, m1, m2, b1p, b2p), outs):
+        _write(t, n)
+    return param, m1, m2, b1p, b2p
+
+
+def adamw_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+           learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-8,
+           weight_decay=0.01, lr_ratio=1.0, name=None):
+    """AdamW: decoupled weight decay applied before the Adam step
+    (upstream adamw_ op)."""
+    param = _as_tensor(param)
+    lr = float(learning_rate) * float(lr_ratio)
+    (dec,) = _upd(
+        "adamw_decay",
+        lambda p: (_f32(p) * (1 - lr * weight_decay)).astype(p.dtype),
+        param, n_outs=1)
+    _write(param, dec)
+    return adam_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+                 lr, beta1, beta2, epsilon)
+
+
+def adagrad_(param, grad, moment, learning_rate, epsilon=1e-6,
+             name=None):
+    """Adagrad (upstream adagrad_ op)."""
+    param, grad, moment = (_as_tensor(param), _as_tensor(grad),
+                           _as_tensor(moment))
+    lr = float(learning_rate)
+
+    def f(p, g, a):
+        gf = _f32(g)
+        af = _f32(a) + gf * gf
+        pf = _f32(p) - lr * gf / (jnp.sqrt(af) + epsilon)
+        return pf.astype(p.dtype), af.astype(a.dtype)
+
+    new_p, new_a = _upd("adagrad", f, param, grad, moment, n_outs=2)
+    return _write(param, new_p), _write(moment, new_a)
+
+
+def adadelta_(param, grad, avg_squared_grad, avg_squared_update,
+              learning_rate=1.0, rho=0.95, epsilon=1e-6, name=None):
+    """Adadelta (upstream adadelta_ op)."""
+    ts = [_as_tensor(t) for t in (param, grad, avg_squared_grad,
+                                  avg_squared_update)]
+    param, grad, asg, asu = ts
+    lr = float(learning_rate)
+
+    def f(p, g, e_g2, e_dx2):
+        gf = _f32(g)
+        eg = rho * _f32(e_g2) + (1 - rho) * gf * gf
+        dx = jnp.sqrt(_f32(e_dx2) + epsilon) / jnp.sqrt(eg + epsilon) * gf
+        ed = rho * _f32(e_dx2) + (1 - rho) * dx * dx
+        pf = _f32(p) - lr * dx
+        return (pf.astype(p.dtype), eg.astype(e_g2.dtype),
+                ed.astype(e_dx2.dtype))
+
+    new_p, new_g2, new_dx2 = _upd("adadelta", f, param, grad, asg, asu,
+                                  n_outs=3)
+    return (_write(param, new_p), _write(asg, new_g2),
+            _write(asu, new_dx2))
+
+
+def adamax_(param, grad, moment, inf_norm, beta1_pow, learning_rate,
+            beta1=0.9, beta2=0.999, epsilon=1e-8, name=None):
+    """Adamax (upstream adamax_ op): infinity-norm second moment."""
+    ts = [_as_tensor(t) for t in (param, grad, moment, inf_norm,
+                                  beta1_pow)]
+    param, grad, m, u, b1p = ts
+    lr = float(learning_rate)
+
+    def f(p, g, mm, uu, bp):
+        gf = _f32(g)
+        mf = beta1 * _f32(mm) + (1 - beta1) * gf
+        uf = jnp.maximum(beta2 * _f32(uu), jnp.abs(gf))
+        nbp = _f32(bp) * beta1
+        pf = _f32(p) - lr / (1 - nbp) * mf / (uf + epsilon)
+        return (pf.astype(p.dtype), mf.astype(mm.dtype),
+                uf.astype(uu.dtype), nbp.astype(bp.dtype))
+
+    outs = _upd("adamax", f, param, grad, m, u, b1p, n_outs=4)
+    for t, n in zip((param, m, u, b1p), outs):
+        _write(t, n)
+    return param, m, u, b1p
+
+
+def rmsprop_(param, grad, mean_square, moment, learning_rate,
+             mean_grad=None, rho=0.95, epsilon=1e-6, momentum=0.0,
+             centered=False, name=None):
+    """RMSProp (upstream rmsprop_ op), plain or centered."""
+    ts = [_as_tensor(t) for t in (param, grad, mean_square, moment)]
+    param, grad, ms, mom = ts
+    mg = _as_tensor(mean_grad) if centered else None
+    lr = float(learning_rate)
+
+    def f(p, g, s, v, *rest):
+        gf = _f32(g)
+        sf = rho * _f32(s) + (1 - rho) * gf * gf
+        if centered:
+            gavg = rho * _f32(rest[0]) + (1 - rho) * gf
+            denom = sf - gavg * gavg
+        else:
+            gavg = None
+            denom = sf
+        vf = momentum * _f32(v) + lr * gf / jnp.sqrt(denom + epsilon)
+        pf = _f32(p) - vf
+        outs = [pf.astype(p.dtype), sf.astype(s.dtype),
+                vf.astype(v.dtype)]
+        if centered:
+            outs.append(gavg.astype(rest[0].dtype))
+        return tuple(outs)
+
+    args = [param, grad, ms, mom] + ([mg] if centered else [])
+    outs = _upd("rmsprop", f, *args, n_outs=4 if centered else 3)
+    _write(param, outs[0])
+    _write(ms, outs[1])
+    _write(mom, outs[2])
+    if centered:
+        _write(mg, outs[3])
+    return param
+
+
+def lamb_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+          learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-6,
+          weight_decay=0.01, name=None):
+    """LAMB (upstream lamb_ op): Adam direction scaled by the
+    layerwise trust ratio ||p|| / ||update||."""
+    ts = [_as_tensor(t) for t in (param, grad, moment1, moment2,
+                                  beta1_pow, beta2_pow)]
+    param, grad, m1, m2, b1p, b2p = ts
+    lr = float(learning_rate)
+
+    def f(p, g, m, v, bp1, bp2):
+        gf = _f32(g)
+        pf = _f32(p)
+        mf = beta1 * _f32(m) + (1 - beta1) * gf
+        vf = beta2 * _f32(v) + (1 - beta2) * gf * gf
+        nbp1 = _f32(bp1) * beta1
+        nbp2 = _f32(bp2) * beta2
+        mhat = mf / (1 - nbp1)
+        vhat = vf / (1 - nbp2)
+        r = mhat / (jnp.sqrt(vhat) + epsilon) + weight_decay * pf
+        p_norm = jnp.sqrt(jnp.sum(pf * pf))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((p_norm > 0) & (r_norm > 0),
+                          p_norm / r_norm, 1.0)
+        new_p = pf - lr * trust * r
+        return (new_p.astype(p.dtype), mf.astype(m.dtype),
+                vf.astype(v.dtype), nbp1.astype(bp1.dtype),
+                nbp2.astype(bp2.dtype))
+
+    outs = _upd("lamb", f, param, grad, m1, m2, b1p, b2p, n_outs=5)
+    for t, n in zip((param, m1, m2, b1p, b2p), outs):
+        _write(t, n)
+    return param, m1, m2, b1p, b2p
+
+
+def asgd_(param, grad, d, y, n, learning_rate, name=None):
+    """ASGD (upstream asgd_ op): finite-sum averaged gradient step
+    d <- d - y + g; y <- g; param <- param - lr/n * d."""
+    ts = [_as_tensor(t) for t in (param, grad, d, y)]
+    param, grad, dt, yt = ts
+    lr = float(learning_rate)
+    nf = float(n if not isinstance(n, Tensor) else n.item())
+
+    def f(p, g, dd, yy):
+        gf = _f32(g)
+        df = _f32(dd) - _f32(yy) + gf
+        pf = _f32(p) - (lr / nf) * df
+        return pf.astype(p.dtype), df.astype(dd.dtype), gf.astype(
+            yy.dtype)
+
+    new_p, new_d, new_y = _upd("asgd", f, param, grad, dt, yt, n_outs=3)
+    return (_write(param, new_p), _write(dt, new_d), _write(yt, new_y))
+
+
+def lars_momentum_(param, grad, velocity, learning_rate, mu=0.9,
+                   lars_coeff=0.001, lars_weight_decay=0.0005,
+                   epsilon=0.0, name=None):
+    """LARS momentum (upstream lars_momentum op): local lr scaled by
+    ||p|| / (||g|| + wd * ||p||)."""
+    ts = [_as_tensor(t) for t in (param, grad, velocity)]
+    param, grad, vel = ts
+    lr = float(learning_rate)
+
+    def f(p, g, v):
+        pf, gf = _f32(p), _f32(g)
+        p_norm = jnp.sqrt(jnp.sum(pf * pf))
+        g_norm = jnp.sqrt(jnp.sum(gf * gf))
+        local = lr * lars_coeff * p_norm / (
+            g_norm + lars_weight_decay * p_norm + epsilon + 1e-20)
+        vf = mu * _f32(v) + local * (gf + lars_weight_decay * pf)
+        new_p = pf - vf
+        return new_p.astype(p.dtype), vf.astype(v.dtype)
+
+    new_p, new_v = _upd("lars_momentum", f, param, grad, vel, n_outs=2)
+    return _write(param, new_p), _write(vel, new_v)
+
+
+def merged_adam_(params, grads, moments1, moments2, beta1_pows,
+                 beta2_pows, learning_rate, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, name=None):
+    """Multi-tensor Adam (upstream merged_adam_ op): one fused update
+    over a parameter list — under jit, XLA fuses the whole sweep."""
+    for p, g, m1, m2, b1, b2 in zip(params, grads, moments1, moments2,
+                                    beta1_pows, beta2_pows):
+        adam_(p, g, m1, m2, b1, b2, learning_rate, beta1, beta2,
+              epsilon)
+    return params
+
+
+def merged_momentum_(params, grads, velocities, learning_rate, mu=0.9,
+                     use_nesterov=False, name=None):
+    """Multi-tensor momentum (upstream merged_momentum_ op)."""
+    for p, g, v in zip(params, grads, velocities):
+        momentum_(p, g, v, learning_rate, mu, use_nesterov)
+    return params
+
+
+def rprop_(param, grad, prev_grad, learning_rate, learning_rate_range=(
+        1e-5, 50.0), etas=(0.5, 1.2), name=None):
+    """Rprop (upstream rprop_ op): per-weight step sizes grown/shrunk
+    by the sign agreement of successive gradients."""
+    ts = [_as_tensor(t) for t in (param, grad, prev_grad)]
+    param, grad, prev = ts
+    lr = _as_tensor(learning_rate)
+    eta_n, eta_p = float(etas[0]), float(etas[1])
+    lo, hi = float(learning_rate_range[0]), float(learning_rate_range[1])
+
+    def f(p, g, pg, lrs):
+        gf, pgf = _f32(g), _f32(pg)
+        sign = jnp.sign(gf * pgf)
+        factor = jnp.where(sign > 0, eta_p,
+                           jnp.where(sign < 0, eta_n, 1.0))
+        new_lr = jnp.clip(_f32(lrs) * factor, lo, hi)
+        gf = jnp.where(sign < 0, 0.0, gf)
+        new_p = _f32(p) - jnp.sign(gf) * new_lr
+        return (new_p.astype(p.dtype), new_lr.astype(lrs.dtype),
+                gf.astype(pg.dtype))
+
+    new_p, new_lr, new_pg = _upd("rprop", f, param, grad, prev, lr,
+                                 n_outs=3)
+    return (_write(param, new_p), _write(lr, new_lr),
+            _write(prev, new_pg))

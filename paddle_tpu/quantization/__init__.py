@@ -283,3 +283,83 @@ observers = _types.SimpleNamespace(
     AbsMaxObserver=AbsMaxObserver,
     MovingAverageAbsMaxObserver=MovingAverageAbsMaxObserver,
 )
+
+
+def quantize_linear(x, scale, zero_point=None, bit_length=8,
+                    quant_axis=-1, name=None):
+    """Affine quantize to the int grid (upstream quantize_linear op):
+    q = clip(round(x / scale + zp), -2^(b-1)+1, 2^(b-1)-1)."""
+    x = _as_tensor(x)
+    scale = _as_tensor(scale)
+    bnd = float(2 ** (bit_length - 1) - 1)
+
+    def f(a, s):
+        sf = s.astype(jnp.float32)
+        if quant_axis >= 0 and sf.ndim:
+            shape = [1] * a.ndim
+            shape[quant_axis] = -1
+            sf = sf.reshape(shape)
+        q = jnp.round(a.astype(jnp.float32) / sf)
+        if zero_point is not None:
+            q = q + zero_point
+        return jnp.clip(q, -bnd, bnd).astype(a.dtype)
+
+    return apply_op("quantize_linear", f, x, scale,
+                    differentiable=False)
+
+
+def dequantize_linear(x, scale, zero_point=None, bit_length=8,
+                      quant_axis=-1, name=None):
+    """Inverse of quantize_linear (upstream dequantize_linear op)."""
+    x = _as_tensor(x)
+    scale = _as_tensor(scale)
+
+    def f(a, s):
+        sf = s.astype(jnp.float32)
+        if quant_axis >= 0 and sf.ndim:
+            shape = [1] * a.ndim
+            shape[quant_axis] = -1
+            sf = sf.reshape(shape)
+        af = a.astype(jnp.float32)
+        if zero_point is not None:
+            af = af - zero_point
+        return (af * sf).astype(jnp.float32)
+
+    return apply_op("dequantize_linear", f, x, scale,
+                    differentiable=False)
+
+
+def fake_quantize_abs_max(x, bit_length=8, name=None):
+    """Quantize-dequantize with the abs-max scale (upstream
+    fake_quantize_abs_max op); straight-through backward via the
+    _fake_quant core. Returns (out, scale)."""
+    x = _as_tensor(x)
+    bnd = float(2 ** (bit_length - 1) - 1)
+
+    def f(a):
+        s = jnp.max(jnp.abs(a.astype(jnp.float32)))
+        s = jnp.where(s == 0, 1e-8, s)
+        q = jnp.clip(jnp.round(a.astype(jnp.float32) / s * bnd),
+                     -bnd, bnd)
+        return (q * s / bnd).astype(a.dtype), s
+
+    return apply_op("fake_quantize_abs_max", f, x, n_outs=2)
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0,
+                                       name=None):
+    """Per-channel abs-max fake quant (upstream
+    fake_channel_wise_quantize_dequantize_abs_max op)."""
+    x = _as_tensor(x)
+    bnd = float(2 ** (bit_length - 1) - 1)
+
+    def f(a):
+        af = a.astype(jnp.float32)
+        axes = tuple(d for d in range(a.ndim) if d != quant_axis)
+        s = jnp.max(jnp.abs(af), axis=axes, keepdims=True)
+        s = jnp.where(s == 0, 1e-8, s)
+        q = jnp.clip(jnp.round(af / s * bnd), -bnd, bnd)
+        return (q * s / bnd).astype(a.dtype), s.reshape(-1)
+
+    return apply_op("fake_channel_wise_quantize_abs_max", f, x,
+                    n_outs=2)

@@ -295,9 +295,115 @@ def transpose(x, perm, name=None):
     return SparseCooTensor(mat.transpose(tuple(perm)))
 
 
-class nn:
-    """paddle.sparse.nn parity: sparse activations as layers."""
+# -- zero-preserving unary family (upstream: paddle/sparse/unary.py —
+# the reference registers a sparse kernel per op that maps values and
+# keeps indices; identical structure here over BCOO.data) -------------------
 
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
+def _values_unary(opname, fn):
+    def op(x, name=None):
+        mat = _coo(x)
+        return SparseCooTensor(
+            jsparse.BCOO((fn(mat.data), mat.indices), shape=mat.shape))
+
+    op.__name__ = opname
+    op.__qualname__ = opname
+    op.__doc__ = (
+        f"Sparse {opname} (upstream: paddle.sparse.{opname}): applies "
+        f"the zero-preserving map to the stored values; indices are "
+        f"unchanged.")
+    return op
+
+
+for _n, _f in (
+    ("sin", jnp.sin), ("sinh", jnp.sinh), ("tan", jnp.tan),
+    ("tanh", jnp.tanh), ("asin", jnp.arcsin), ("asinh", jnp.arcsinh),
+    ("atan", jnp.arctan), ("atanh", jnp.arctanh), ("sqrt", jnp.sqrt),
+    ("square", jnp.square), ("log1p", jnp.log1p), ("abs", jnp.abs),
+    ("expm1", jnp.expm1), ("neg", jnp.negative),
+    ("deg2rad", jnp.deg2rad), ("rad2deg", jnp.rad2deg),
+):
+    globals()[_n] = _values_unary(_n, _f)
+    __all__.append(_n)
+del _n, _f
+
+
+def pow(x, factor, name=None):
+    """Sparse elementwise power of the stored values (zero-preserving
+    for factor > 0; upstream paddle.sparse.pow)."""
+    mat = _coo(x)
+    return SparseCooTensor(
+        jsparse.BCOO((jnp.power(mat.data, factor), mat.indices),
+                     shape=mat.shape))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """Cast stored values and/or indices (upstream paddle.sparse.cast)."""
+    from ..framework.dtype import to_np_dtype
+
+    mat = _coo(x)
+    data, idx = mat.data, mat.indices
+    if value_dtype is not None:
+        data = data.astype(to_np_dtype(value_dtype))
+    if index_dtype is not None:
+        idx = idx.astype(to_np_dtype(index_dtype))
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=mat.shape))
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices, summing their values (upstream
+    paddle.sparse.coalesce)."""
+    mat = _coo(x)
+    return SparseCooTensor(mat.sum_duplicates())
+
+
+def to_dense(x, name=None):
+    """Densify (module-level twin of SparseCooTensor.to_dense)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return x.to_dense()
+    return _as_tensor(x)
+
+
+__all__ += ["pow", "cast", "coalesce", "to_dense"]
+
+from . import nn  # noqa: E402,F401  (sparse.nn subpackage)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix @ dense vector (upstream paddle.sparse.mv)."""
+    v = _as_tensor(vec)
+    mat = _coo(x) if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else jsparse.BCOO.fromdense(jnp.asarray(x))
+
+    def f(data, vr):
+        m = jsparse.BCOO((data, mat.indices), shape=mat.shape)
+        return m @ vr
+
+    return apply_op("sparse_mv", f, Tensor(mat.data), v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) with sparse x (upstream
+    paddle.sparse.addmm)."""
+    inp = input.to_dense() if isinstance(
+        input, (SparseCooTensor, SparseCsrTensor)) else _as_tensor(input)
+    prod = matmul(x, y)
+    from ..tensor import math as _m
+
+    return _m.add(_m.scale(inp, beta), _m.scale(prod, alpha))
+
+
+__all__ += ["mv", "addmm"]
+
+
+def divide(x, y, name=None):
+    """Elementwise divide over the UNION pattern: slots absent in both
+    operands stay absent (never 0/0 -> NaN); slots present in x with a
+    zero/absent divisor give inf, like the reference."""
+    xd = _coo(x).todense()
+    yd = _coo(y).todense()
+    mask = (xd != 0) | (yd != 0)
+    out = jnp.where(mask, xd / jnp.where(mask, yd, 1.0), 0.0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+__all__.append("divide")

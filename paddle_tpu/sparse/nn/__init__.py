@@ -1,0 +1,47 @@
+"""paddle.sparse.nn analog (upstream: python/paddle/sparse/nn/):
+layer facades over sparse.nn.functional kernels."""
+from __future__ import annotations
+
+from . import functional  # noqa: F401
+from .functional import (  # noqa: F401
+    attention,
+    batch_norm,
+    conv2d,
+    conv3d,
+    leaky_relu,
+    max_pool3d,
+    relu,
+    relu6,
+    softmax,
+    subm_conv2d,
+    subm_conv3d,
+)
+
+
+class _Act:
+    def __init__(self, fn, **kw):
+        self._fn = fn
+        self._kw = kw
+
+    def __call__(self, x):
+        return self._fn(x, **self._kw)
+
+
+class ReLU(_Act):
+    def __init__(self):
+        super().__init__(relu)
+
+
+class ReLU6(_Act):
+    def __init__(self):
+        super().__init__(relu6)
+
+
+class LeakyReLU(_Act):
+    def __init__(self, negative_slope=0.01):
+        super().__init__(leaky_relu, negative_slope=negative_slope)
+
+
+class Softmax(_Act):
+    def __init__(self, axis=-1):
+        super().__init__(softmax, axis=axis)

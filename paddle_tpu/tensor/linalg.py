@@ -598,3 +598,48 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None):
         return (q @ c) if left else (c @ q)
 
     return apply_op("ormqr", f, x, tau, other)
+
+
+def matrix_transpose(x, name=None):
+    """Swap the last two dims (upstream paddle.linalg.matrix_transpose)."""
+    x = _as_tensor(x)
+    return apply_op(
+        "matrix_transpose", lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """Vector dot along an axis (upstream paddle.linalg.vecdot)."""
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+    return apply_op(
+        "vecdot", lambda a, b: jnp.sum(a * b, axis=axis), x, y)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized low-rank PCA (upstream paddle.linalg.pca_lowrank;
+    the Halko-Martinsson-Tropp subspace iteration, like the
+    reference). Returns (U, S, V) with q components."""
+    from ..framework.random import next_key
+
+    x = _as_tensor(x)
+    m, n = x.shape[-2], x.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    key = next_key()
+
+    def f(a):
+        af = a.astype(jnp.float32)
+        if center:
+            af = af - af.mean(axis=-2, keepdims=True)
+        g = jax.random.normal(key, a.shape[:-2] + (n, q), jnp.float32)
+        y = af @ g
+        for _ in range(int(niter)):
+            y = af @ (af.swapaxes(-1, -2) @ y)
+            y, _ = jnp.linalg.qr(y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = qmat.swapaxes(-1, -2) @ af
+        u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        return (qmat @ u).astype(a.dtype), s.astype(a.dtype), \
+            vt.swapaxes(-1, -2).astype(a.dtype)
+
+    return apply_op("pca_lowrank", f, x, n_outs=3)

@@ -106,3 +106,56 @@ def is_integer(x):
 
 def is_complex(x):
     return np.issubdtype(_as_tensor(x)._data.dtype, np.complexfloating)
+
+
+def logical_and_(x, y, name=None):
+    from .math import _inplace
+
+    return _inplace(x, logical_and(x, y))
+
+
+def logical_or_(x, y, name=None):
+    from .math import _inplace
+
+    return _inplace(x, logical_or(x, y))
+
+
+def logical_xor_(x, y, name=None):
+    from .math import _inplace
+
+    return _inplace(x, logical_xor(x, y))
+
+
+def logical_not_(x, name=None):
+    from .math import _inplace
+
+    return _inplace(x, logical_not(x))
+
+
+def bitwise_and_(x, y, name=None):
+    from .math import _inplace
+
+    return _inplace(x, bitwise_and(x, y))
+
+
+def bitwise_or_(x, y, name=None):
+    from .math import _inplace
+
+    return _inplace(x, bitwise_or(x, y))
+
+
+def bitwise_xor_(x, y, name=None):
+    from .math import _inplace
+
+    return _inplace(x, bitwise_xor(x, y))
+
+
+def bitwise_not_(x, name=None):
+    from .math import _inplace
+
+    return _inplace(x, bitwise_not(x))
+
+
+# upstream 2.6 alias
+bitwise_invert = bitwise_not
+bitwise_invert_ = bitwise_not_

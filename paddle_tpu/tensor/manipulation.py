@@ -851,3 +851,80 @@ def shape(input, name=None):
 def rank(input, name=None):
     input = _as_tensor(input)
     return Tensor(jnp.asarray(input.ndim, jnp.int32))
+
+
+def squeeze_(x, axis=None, name=None):
+    from .math import _inplace
+
+    return _inplace(x, squeeze(x, axis))
+
+
+def t_(x, name=None):
+    from .math import _inplace
+
+    return _inplace(x, t(x))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from .math import _inplace
+
+    return _inplace(x, scatter(x, index, updates, overwrite))
+
+
+def put_along_axis_(x, indices, values, axis, reduce="assign",
+                    name=None):
+    from .math import _inplace
+
+    return _inplace(x, put_along_axis(x, indices, values, axis, reduce))
+
+
+def index_add_(x, index, axis, value, name=None):
+    from .math import _inplace
+
+    return _inplace(x, index_add(x, index, axis, value))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    from .math import _inplace
+
+    return _inplace(x, index_put(x, indices, value, accumulate))
+
+
+def masked_scatter_(x, mask, value, name=None):
+    from .math import _inplace
+
+    return _inplace(x, masked_scatter(x, mask, value))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write y into x's (dim1, dim2) diagonal band (upstream
+    fill_diagonal_tensor op)."""
+    x = _as_tensor(x)
+    y = _as_tensor(y)
+
+    def f(a, b):
+        n = min(a.shape[dim1], a.shape[dim2])
+        if offset >= 0:
+            k = min(n, a.shape[dim2] - offset)
+            i = jnp.arange(k)
+            j = i + offset
+        else:
+            k = min(a.shape[dim1] + offset, n)
+            i = jnp.arange(k) - offset
+            j = jnp.arange(k)
+        # move the two diagonal dims to front for a single scatter
+        perm = ([dim1, dim2]
+                + [d for d in range(a.ndim) if d not in (dim1, dim2)])
+        inv = [perm.index(d) for d in range(a.ndim)]
+        at = jnp.transpose(a, perm)
+        bt = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+        at = at.at[i, j].set(bt)
+        return jnp.transpose(at, inv)
+
+    return apply_op("fill_diagonal_tensor", f, x, y)
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    from .math import _inplace
+
+    return _inplace(x, fill_diagonal_tensor(x, y, offset, dim1, dim2))

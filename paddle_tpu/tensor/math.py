@@ -783,3 +783,94 @@ def masked_fill_(x, mask, value, name=None):
     from .manipulation import masked_fill
 
     return _inplace(x, masked_fill(x, mask, value))
+
+
+def triu_(x, diagonal=0, name=None):
+    from .creation import triu
+
+    return _inplace(x, triu(x, diagonal))
+
+
+# -- generated in-place twins ----------------------------------------------
+# Upstream declares an `op_` inplace twin for most unary/binary math
+# ops (paddle/phi/api/yaml inplace entries + python inplace_apis);
+# each twin funnels through _inplace so the version counter guards
+# the autograd tape exactly like the hand-written ones above.
+_INPLACE_GEN = (
+    # unary
+    "abs acos acosh asin asinh atan atanh ceil cos cosh digamma erf "
+    "erfinv expm1 i0 lgamma log log10 log1p log2 logit nan_to_num neg "
+    "reciprocal round rsqrt sigmoid sin sinh sqrt square tan tanh "
+    # binary
+    "atan2 floor_divide gcd heaviside hypot lcm ldexp nextafter pow "
+    # reductions / parameterized
+    "cumsum cumprod lerp multigammaln renorm"
+).split()
+
+
+def _gen_inplace(base_name):
+    base = globals()[base_name]
+
+    def inner(x, *args, **kwargs):
+        kwargs.pop("name", None)
+        x = _as_tensor(x)
+        return _inplace(x, base(x, *args, **kwargs))
+
+    inner.__name__ = base_name + "_"
+    inner.__qualname__ = inner.__name__
+    inner.__doc__ = (
+        f"In-place {base_name} (upstream: paddle.Tensor.{base_name}_)"
+        f" — mutates and returns x; bumps the inplace version counter."
+    )
+    return inner
+
+
+for _n in _INPLACE_GEN:
+    if _n + "_" not in globals():
+        globals()[_n + "_"] = _gen_inplace(_n)
+del _n
+
+
+def bitwise_left_shift_(x, y, is_arithmetic=True, name=None):
+    return _inplace(x, bitwise_left_shift(x, y, is_arithmetic))
+
+
+def bitwise_right_shift_(x, y, is_arithmetic=True, name=None):
+    return _inplace(x, bitwise_right_shift(x, y, is_arithmetic))
+
+
+def addmm_(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _inplace(input, addmm(input, x, y, beta, alpha))
+
+
+def polygamma_(x, n, name=None):
+    return _inplace(x, polygamma(x, n))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Scale x so its L2 norm is at most max_norm (upstream: the
+    clip_by_norm op behind paddle.nn.ClipGradByNorm)."""
+    x = _as_tensor(x)
+
+    def f(a):
+        n = jnp.sqrt(jnp.sum(jnp.square(a.astype(jnp.float32))))
+        s = jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+        return (a.astype(jnp.float32) * s).astype(a.dtype)
+
+    return apply_op("clip_by_norm", f, x)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    """Bin edges only (upstream histogram_bin_edges op): uniform grid
+    over [min, max] (or the data range when min == max == 0)."""
+    input = _as_tensor(input)
+
+    def f(a):
+        lo, hi = (jnp.min(a), jnp.max(a)) if (min == 0 and max == 0) \
+            else (jnp.asarray(min, jnp.float32),
+                  jnp.asarray(max, jnp.float32))
+        hi = jnp.where(hi == lo, lo + 1.0, hi)
+        return jnp.linspace(lo, hi, int(bins) + 1).astype(jnp.float32)
+
+    return apply_op("histogram_bin_edges", f, input,
+                    differentiable=False)

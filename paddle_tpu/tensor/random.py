@@ -203,3 +203,19 @@ def log_normal(mean=1.0, std=2.0, shape=None, name=None):
         float(mean) + float(std) * jax.random.normal(k, shp)
     )
     return Tensor(out)
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Fill x in place with Cauchy(loc, scale) draws (upstream
+    paddle.Tensor.cauchy_)."""
+    from .math import _inplace
+
+    x = _as_tensor(x)
+    k = next_key()
+
+    def f(a):
+        u = jax.random.uniform(k, a.shape, jnp.float32, 1e-7, 1 - 1e-7)
+        v = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+        return v.astype(a.dtype)
+
+    return _inplace(x, apply_op("cauchy", f, x, differentiable=False))

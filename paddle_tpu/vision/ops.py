@@ -629,3 +629,114 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = np.transpose(arr, (2, 0, 1))
     return Tensor(jnp.asarray(arr))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0,
+               normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (upstream matrix_nms op, SOLOv2): soft-suppression
+    via the pairwise-IoU decay matrix instead of sequential greedy
+    suppression — a regular O(k^2) matmul-style computation, which is
+    exactly the TPU-friendly formulation."""
+    import numpy as np_
+
+    b = np_.asarray(bboxes._data if hasattr(bboxes, "_data") else bboxes)
+    s = np_.asarray(scores._data if hasattr(scores, "_data") else scores)
+    outs, idxs, nums = [], [], []
+    eps = 0.0 if normalized else 1.0
+    for bi in range(b.shape[0]):
+        dets, keep_idx = [], []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[bi, c]
+            sel = np_.nonzero(sc > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = sel[np_.argsort(-sc[sel])][:nms_top_k]
+            bb = b[bi, order]
+            cs = sc[order]
+            x1, y1, x2, y2 = bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3]
+            area = (x2 - x1 + eps) * (y2 - y1 + eps)
+            ix1 = np_.maximum(x1[:, None], x1[None, :])
+            iy1 = np_.maximum(y1[:, None], y1[None, :])
+            ix2 = np_.minimum(x2[:, None], x2[None, :])
+            iy2 = np_.minimum(y2[:, None], y2[None, :])
+            iw = np_.clip(ix2 - ix1 + eps, 0, None)
+            ih = np_.clip(iy2 - iy1 + eps, 0, None)
+            inter = iw * ih
+            iou = inter / (area[:, None] + area[None, :] - inter)
+            iou = np_.triu(iou, k=1)
+            # compensate IoU: each SUPPRESSOR row i is discounted by
+            # its own max overlap with anything scored above it
+            # (upstream matrix_nms kernel; SOLOv2 eq. decay_j =
+            # min_i f(iou_ij) / f(iou_cmax_i))
+            iou_cmax = iou.max(axis=0)  # per box: col max = cmax_i
+            if use_gaussian:
+                decay = np_.exp(
+                    (iou_cmax[:, None] ** 2 - iou ** 2)
+                    * gaussian_sigma)
+            else:
+                decay = (1.0 - iou) / np_.clip(
+                    1.0 - iou_cmax[:, None], 1e-12, None)
+            decay = np_.minimum(decay.min(axis=0), 1.0)
+            new_s = cs * decay
+            keep = new_s > post_threshold
+            for j in np_.nonzero(keep)[0]:
+                dets.append([c, new_s[j], *bb[j]])
+                keep_idx.append(order[j])
+        if dets:
+            dets = np_.asarray(dets, np_.float32)
+            order = np_.argsort(-dets[:, 1])[:keep_top_k]
+            dets = dets[order]
+            keep_idx = np_.asarray(keep_idx)[order]
+        else:
+            dets = np_.zeros((0, 6), np_.float32)
+            keep_idx = np_.zeros((0,), np_.int64)
+        outs.append(dets)
+        idxs.append(keep_idx)
+        nums.append(len(dets))
+    from ..framework.core import Tensor as _T
+
+    out = _T(np_.concatenate(outs, 0) if outs else
+             np_.zeros((0, 6), np_.float32))
+    rois_num = _T(np_.asarray(nums, np_.int32))
+    if return_index:
+        index = _T(np_.concatenate(idxs, 0).astype(np_.int64))
+        return (out, index, rois_num) if return_rois_num \
+            else (out, index)
+    return (out, rois_num) if return_rois_num else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level,
+                             refer_level, refer_scale,
+                             pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels by scale (upstream
+    distribute_fpn_proposals op): level = floor(refer_level +
+    log2(sqrt(area) / refer_scale)), clipped to [min, max]."""
+    import numpy as np_
+
+    r = np_.asarray(fpn_rois._data if hasattr(fpn_rois, "_data")
+                    else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    w = r[:, 2] - r[:, 0] + off
+    h = r[:, 3] - r[:, 1] + off
+    scale = np_.sqrt(np_.clip(w * h, 1e-12, None))
+    lvl = np_.floor(refer_level + np_.log2(scale / refer_scale + 1e-12))
+    lvl = np_.clip(lvl, min_level, max_level).astype(np_.int64)
+    from ..framework.core import Tensor as _T
+
+    multi_rois, restore = [], np_.zeros(len(r), np_.int64)
+    nums_per_level = []
+    pos = 0
+    for lv in range(min_level, max_level + 1):
+        sel = np_.nonzero(lvl == lv)[0]
+        multi_rois.append(_T(r[sel]))
+        nums_per_level.append(len(sel))
+        restore[sel] = np_.arange(pos, pos + len(sel))
+        pos += len(sel)
+    return multi_rois, _T(restore), [
+        _T(np_.asarray([n], np_.int32)) for n in nums_per_level]

@@ -203,3 +203,172 @@ class TestSpeculativeDecoding:
         ids = paddle.to_tensor(np.zeros((2, 4), np.int32))
         with pytest.raises(ValueError, match="batch_size=1"):
             speculative_generate(target, draft, ids)
+
+
+class TestSpeculativeSampling:
+    """Sampled-acceptance speculative decoding (VERDICT r4 weak #4):
+    the Leviathan/Chen acceptance rule with a device-side fused accept
+    — output distribution must equal target-alone sampling."""
+
+    def test_accept_kernel_distribution_is_target(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import _spec_accept_sampled
+
+        V, k = 8, 3
+        rng = np.random.RandomState(0)
+        p_logits = jnp.asarray(rng.randn(k + 1, V) * 1.5, jnp.float32)
+        ql = rng.randn(k, V) * 1.5
+        q_probs = jnp.asarray(
+            np.exp(ql) / np.exp(ql).sum(-1, keepdims=True), jnp.float32)
+        p = np.asarray(jax.nn.softmax(p_logits, axis=-1))
+
+        def one(key):
+            kq, ka = jax.random.split(key)
+            props = jax.random.categorical(
+                kq, jnp.log(q_probs), axis=-1).astype(jnp.int32)
+            return _spec_accept_sampled(p_logits, props, q_probs, ka,
+                                        1.0)
+
+        N = 20000
+        n_accs, tokss = jax.vmap(one)(
+            jax.random.split(jax.random.PRNGKey(42), N))
+        n_accs = np.asarray(n_accs)
+        tokss = np.asarray(tokss)
+        # slot 0 is always committed: its marginal must be p[0]
+        freq0 = np.bincount(tokss[:, 0], minlength=V) / N
+        assert 0.5 * np.abs(freq0 - p[0]).sum() < 0.02
+        # slot 1 conditioned on >=1 acceptance must be p[1]
+        m = n_accs >= 1
+        freq1 = np.bincount(tokss[m, 1], minlength=V) / m.sum()
+        assert 0.5 * np.abs(freq1 - p[1]).sum() < 0.03
+
+    def test_self_draft_sampled_accepts_all(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import _spec_accept_sampled
+
+        V, k = 6, 4
+        rng = np.random.RandomState(1)
+        p_logits = jnp.asarray(rng.randn(k + 1, V), jnp.float32)
+        q = jax.nn.softmax(p_logits[:k], axis=-1)
+
+        def one(key):
+            kq, ka = jax.random.split(key)
+            props = jax.random.categorical(
+                kq, p_logits[:k], axis=-1).astype(jnp.int32)
+            n_acc, _ = _spec_accept_sampled(p_logits, props, q, ka, 1.0)
+            return n_acc
+
+        accs = np.asarray(jax.vmap(one)(
+            jax.random.split(jax.random.PRNGKey(7), 1000)))
+        assert (accs == k).all()  # q == p: always full acceptance
+
+    def test_sampled_generate_runs_and_is_seeded(self):
+        from paddle_tpu.models import (
+            LlamaForCausalLM, llama_tiny, speculative_generate,
+        )
+
+        paddle.seed(0)
+        target = LlamaForCausalLM(llama_tiny()).eval()
+        paddle.seed(1)
+        draft = LlamaForCausalLM(llama_tiny(
+            num_hidden_layers=1, hidden_size=32,
+            intermediate_size=64)).eval()
+        ids = paddle.to_tensor(np.random.RandomState(3)
+                               .randint(4, 512, (1, 6)).astype("int32"))
+        paddle.seed(123)
+        a, stats = speculative_generate(
+            target, draft, ids, max_new_tokens=8, draft_k=3,
+            do_sample=True, temperature=0.9, return_stats=True)
+        paddle.seed(123)
+        b = speculative_generate(
+            target, draft, ids, max_new_tokens=8, draft_k=3,
+            do_sample=True, temperature=0.9)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        assert a.numpy().shape[1] <= 6 + 8
+        assert stats["target_calls"] >= 1
+
+
+class TestSchedulerSpeculative:
+    """BatchScheduler + draft adapter: batched speculative decoding
+    over the paged cache (per-row acceptance via per-sequence lens +
+    cache truncate) must be token-identical to the plain scheduler."""
+
+    def test_batched_spec_token_identical(self):
+        from paddle_tpu.inference.paged_llama import PagedLlamaAdapter
+        from paddle_tpu.inference.serving import BatchScheduler, Request
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        cfg = llama_tiny()
+        paddle.seed(0)
+        target = LlamaForCausalLM(cfg)
+        paddle.seed(1)
+        draft = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+                   for n in (5, 9, 3)]
+
+        def run(spec):
+            ad = PagedLlamaAdapter(target, num_pages=256, page_size=4)
+            kw = {}
+            if spec:
+                kw = dict(draft_model=PagedLlamaAdapter(
+                    draft, num_pages=256, page_size=4), draft_k=3)
+            sched = BatchScheduler(ad, max_batch_size=4, **kw)
+            for i, p in enumerate(prompts):
+                sched.submit(Request(req_id=f"r{i}", prompt_ids=p,
+                                     max_new_tokens=10))
+            done = sched.run_until_complete()
+            return ({k: v.generated_ids for k, v in done.items()},
+                    sched.spec_stats)
+
+        plain, _ = run(False)
+        spec, stats = run(True)
+        assert plain == spec
+        assert stats["rounds"] > 0
+        tpc = stats["committed_tokens"] / stats["target_calls"]
+        assert tpc > 1.0, stats  # strictly better than 1 token/call
+
+    def test_decode_window_matches_sequential(self):
+        from paddle_tpu.inference.paged_llama import PagedLlamaAdapter
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        cfg = llama_tiny()
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        a1 = PagedLlamaAdapter(model, num_pages=64, page_size=4)
+        a2 = PagedLlamaAdapter(model, num_pages=64, page_size=4)
+        for s in ("r0", "r1"):
+            a1.alloc(s)
+            a2.alloc(s)
+        rng = np.random.RandomState(0)
+        toks = rng.randint(0, cfg.vocab_size, (2, 6))
+        outs1 = []
+        for j in range(6):
+            l = a1.decode_token(toks[:, j].tolist(), ["r0", "r1"])
+            outs1.append(np.asarray(l._data))
+        outs1 = np.stack(outs1, axis=1)
+        for j in range(3):
+            a2.decode_token(toks[:, j].tolist(), ["r0", "r1"])
+        outs2 = np.asarray(
+            a2.decode_window(toks[:, 3:], ["r0", "r1"])._data)
+        np.testing.assert_allclose(outs2, outs1[:, 3:], rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_cache_truncate_rollback(self):
+        from paddle_tpu.incubate.nn import PagedKVCacheManager
+
+        c = PagedKVCacheManager(8, 4, 2, 8)
+        c.alloc("s")
+        for _ in range(10):
+            c.append("s", np.zeros((2, 8), "float32"),
+                     np.zeros((2, 8), "float32"))
+        free_before = c.num_free_pages
+        c.truncate("s", 5)
+        assert c.seq_len("s") == 5
+        assert c.num_free_pages == free_before + 1  # 3 pages -> 2
+        with pytest.raises(ValueError):
+            c.truncate("s", 99)

@@ -158,3 +158,20 @@ class TestNorms:
         np.testing.assert_allclose(
             L.cond(paddle.to_tensor(a)).numpy(), 4.0, rtol=1e-4
         )
+
+
+class TestPcaLowrank:
+    def test_reconstruction(self):
+        from paddle_tpu.tensor.linalg import pca_lowrank
+
+        rng = np.random.RandomState(0)
+        # a genuinely rank-3 (after centering) matrix
+        a = (rng.randn(20, 3) @ rng.randn(3, 8)).astype("float32")
+        u, s, v = pca_lowrank(paddle.to_tensor(a), q=3)
+        un, sn, vn = (np.asarray(t._data) for t in (u, s, v))
+        centered = a - a.mean(0, keepdims=True)
+        rec = un @ np.diag(sn) @ vn.T
+        np.testing.assert_allclose(rec, centered, rtol=1e-3, atol=1e-3)
+        # orthonormal factors
+        np.testing.assert_allclose(un.T @ un, np.eye(3), atol=1e-4)
+        np.testing.assert_allclose(vn.T @ vn, np.eye(3), atol=1e-4)

@@ -293,3 +293,34 @@ class TestPoolMasks13D:
         r = F.max_unpool1d(p, i, 2, data_format="NLC")
         assert list(r.shape) == [2, 8, 3]
         np.testing.assert_allclose(r.numpy()[:, ::2, :], p.numpy())
+
+
+class TestSequenceMaskGatherTree:
+    """sequence_mask + gather_tree (registry growth r5; upstream
+    test_sequence_mask / test_gather_tree_op)."""
+
+    def test_sequence_mask(self):
+        import paddle_tpu.nn.functional as F
+
+        lens = paddle.to_tensor(np.array([1, 3, 0], np.int64))
+        m = np.asarray(F.sequence_mask(lens, maxlen=4)._data)
+        ref = np.array([[1, 0, 0, 0], [1, 1, 1, 0], [0, 0, 0, 0]])
+        np.testing.assert_array_equal(m, ref)
+        # maxlen defaults to lens.max()
+        m2 = np.asarray(F.sequence_mask(lens)._data)
+        assert m2.shape == (3, 3)
+
+    def test_gather_tree_backtrace(self):
+        import paddle_tpu.nn.functional as F
+
+        # T=3, batch=1, beam=2; beam 0 at t2 came from parent 1, whose
+        # t1 parent is 0
+        ids = np.array([[[10, 11]], [[20, 21]], [[30, 31]]], np.int32)
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int32)
+        out = np.asarray(F.gather_tree(
+            paddle.to_tensor(ids), paddle.to_tensor(parents))._data)
+        # beam 0 path: t2 id 30, parent 1 -> t1 id 21, its parent 0 ->
+        # t0 id 10
+        np.testing.assert_array_equal(out[:, 0, 0], [10, 21, 30])
+        # beam 1 path: t2 id 31, parent 0 -> t1 id 20 -> t0 id 10
+        np.testing.assert_array_equal(out[:, 0, 1], [10, 20, 31])

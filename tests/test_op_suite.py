@@ -95,6 +95,13 @@ def _softmax_np(x, axis=-1):
     return e / e.sum(axis=axis, keepdims=True)
 
 
+def _fill_diag_np(x, y):
+    out = x.copy()
+    n = min(x.shape[0], x.shape[1])
+    out[np.arange(n), np.arange(n)] = y[:n]
+    return out
+
+
 OPS = [
     # -- elementwise unary --------------------------------------------------
     OpSpec("exp", U(pmath.exp), np.exp, [(4, 33)]),
@@ -1624,6 +1631,185 @@ OPS += [
            [(4, 5)], grad=False, op="softmax_"),
 ]
 
+# -- generated in-place rows (registry growth r5): each `op_` twin
+# must reproduce its out-of-place reference value-for-value, on the
+# SAME domain/dtype profile as the base row (grad machinery for
+# in-place is exercised by the version-counter tests in test_ops)
+_INPLACE_FROM_BASE = (
+    "abs acos acosh asin asinh atan atan2 atanh ceil cos cosh digamma "
+    "erf erfinv expm1 heaviside hypot i0 lgamma log log10 log1p "
+    "log2 logit neg nextafter pow reciprocal round rsqrt sigmoid sin "
+    "sinh sqrt square tan tanh nan_to_num"
+).split()
+_BY_NAME = {o.name: o for o in OPS}
+for _b in _INPLACE_FROM_BASE:
+    _src = _BY_NAME[_b]
+    _ifn = getattr(pmath, _b + "_")
+    OPS.append(dataclasses.replace(
+        _src, name=_b + "_", fn=_ifn, grad=False, op=_b + "_"))
+
+# bases whose out-of-place row binds extra constants: mirror the row
+# (same ref/domain), swapping in the in-place call with those constants
+for _b, _fn in [
+    ("cumsum", lambda x: pmath.cumsum_(x, axis=1)),
+    ("cumprod", lambda x: pmath.cumprod_(x, dim=1)),
+    ("lerp", lambda x, y: pmath.lerp_(x, y, 0.3)),
+    ("multigammaln", lambda x: pmath.multigammaln_(x, 2)),
+    ("renorm", lambda x: pmath.renorm_(x, 2.0, 0, 1.0)),
+    ("ldexp", lambda x: pmath.ldexp_(
+        x, paddle.to_tensor(np.full((4, 9), 2, np.int32)))),
+]:
+    OPS.append(dataclasses.replace(
+        _BY_NAME[_b], name=_b + "_", fn=_fn, grad=False, op=_b + "_"))
+
+# float long-tail rows (registry growth r5)
+OPS += [
+    OpSpec("matrix_transpose",
+           lambda x: linalg.matrix_transpose(x),
+           lambda x: np.swapaxes(x, -1, -2), [(3, 4, 5)],
+           op="matrix_transpose"),
+    OpSpec("vecdot", lambda x, y: linalg.vecdot(x, y),
+           lambda x, y: (x * y).sum(-1), [(3, 5), (3, 5)],
+           op="vecdot"),
+    OpSpec("clip_by_norm", lambda x: pmath.clip_by_norm(x, 1.5),
+           lambda x: x * min(1.0, 1.5 / max(np.sqrt((x ** 2).sum()),
+                                            1e-12)),
+           [(4, 5)], op="clip_by_norm"),
+    OpSpec("identity_loss",
+           lambda x: F.identity_loss(x, "mean"), np.mean, [(4, 5)],
+           op="identity_loss"),
+    OpSpec("softmax_mask_fuse",
+           lambda x, m: __import__(
+               "paddle_tpu.incubate.nn.functional", fromlist=["x"]
+           ).softmax_mask_fuse(x, m),
+           lambda x, m: _softmax_np(x + m), [(2, 4, 6), (2, 4, 6)],
+           op="softmax_mask_fuse"),
+    OpSpec("softmax_mask_fuse_upper_triangle",
+           lambda x: __import__(
+               "paddle_tpu.incubate.nn.functional", fromlist=["x"]
+           ).softmax_mask_fuse_upper_triangle(x),
+           lambda x: _softmax_np(
+               np.where(np.arange(x.shape[-1])[None, :]
+                        <= np.arange(x.shape[-2])[:, None], x, -1e30)),
+           [(2, 5, 5)], op="softmax_mask_fuse_upper_triangle"),
+    OpSpec("fill_diagonal_tensor",
+           lambda x, y: manipulation.fill_diagonal_tensor(x, y),
+           lambda x, y: _fill_diag_np(x, y), [(4, 4), (4,)],
+           grad=False, op="fill_diagonal_tensor"),
+    OpSpec("histogram_bin_edges",
+           lambda x: pmath.histogram_bin_edges(x, bins=5),
+           lambda x: np.linspace(x.min(), x.max(), 6), [(4, 5)],
+           grad=False, op="histogram_bin_edges"),
+]
+
+# in-place twins from their base rows (mirroring the base constants)
+for _b, _fn in [
+    ("elu", lambda x: F.elu_(x)),
+    ("leaky_relu", lambda x: F.leaky_relu_(x, 0.1)),
+    ("addmm", lambda a, x, y: pmath.addmm_(a, x, y)),
+    ("polygamma", lambda x: pmath.polygamma_(x, 1)),
+]:
+    OPS.append(dataclasses.replace(
+        _BY_NAME[_b], name=_b + "_", fn=_fn, grad=False, op=_b + "_"))
+
+OPS += [
+    OpSpec("squeeze_", lambda x: manipulation.squeeze_(x, 1),
+           lambda x: x.reshape(4, 5), [(4, 1, 5)], grad=False,
+           op="squeeze_"),
+    OpSpec("t_", lambda x: manipulation.t_(x), lambda x: x.T, [(4, 5)],
+           grad=False, op="t_"),
+    OpSpec("triu_", lambda x: pmath.triu_(x), np.triu, [(5, 5)],
+           grad=False, op="triu_"),
+]
+
+# -- broadcasting variants: binary ops must follow numpy broadcasting
+# (a distinct code path from the aligned-shape rows above)
+_BCAST_BASES = ("add subtract multiply divide maximum minimum pow "
+                "atan2 hypot fmax fmin logaddexp ldexp heaviside "
+                "nextafter copysign float_power lerp_").split()
+for _b in _BCAST_BASES:
+    _src = _BY_NAME.get(_b)
+    if _src is None or len(_src.shapes) != 2:
+        continue
+    OPS.append(dataclasses.replace(
+        _src, name=_b + "_bcast", shapes=[(4, 5), (5,)],
+        op=_src.op or _b))
+
+# -- reduction axis/keepdim variants: axis resolution and keepdim
+# shape logic are their own kernel paths
+OPS += [
+    OpSpec("sum_axis0", lambda x: pmath.sum(x, axis=0),
+           lambda x: x.sum(0), [(4, 5)], op="sum"),
+    OpSpec("sum_keepdim",
+           lambda x: pmath.sum(x, axis=1, keepdim=True),
+           lambda x: x.sum(1, keepdims=True), [(4, 5)], op="sum"),
+    OpSpec("mean_axis0", lambda x: pmath.mean(x, axis=0),
+           lambda x: x.mean(0), [(4, 5)], op="mean"),
+    OpSpec("mean_keepdim",
+           lambda x: pmath.mean(x, axis=1, keepdim=True),
+           lambda x: x.mean(1, keepdims=True), [(4, 5)], op="mean"),
+    OpSpec("max_axis0", lambda x: pmath.max(x, axis=0),
+           lambda x: x.max(0), [(4, 5)], grad=False, op="max"),
+    OpSpec("min_axis0", lambda x: pmath.min(x, axis=0),
+           lambda x: x.min(0), [(4, 5)], grad=False, op="min"),
+    OpSpec("prod_axis0", lambda x: pmath.prod(x, axis=0),
+           lambda x: x.prod(0), [(4, 5)], op="prod"),
+    OpSpec("amax_axis0", lambda x: pmath.amax(x, axis=0),
+           lambda x: x.max(0), [(4, 5)], grad=False, op="amax"),
+    OpSpec("amin_axis0", lambda x: pmath.amin(x, axis=0),
+           lambda x: x.min(0), [(4, 5)], grad=False, op="amin"),
+    OpSpec("std_axis0", lambda x: stat.std(x, axis=0),
+           lambda x: x.std(0, ddof=1), [(4, 5)], op="std"),
+    OpSpec("var_axis0", lambda x: stat.var(x, axis=0),
+           lambda x: x.var(0, ddof=1), [(4, 5)], op="var"),
+    OpSpec("logsumexp_axis0", lambda x: pmath.logsumexp(x, axis=0),
+           lambda x: np.log(np.exp(x).sum(0)), [(4, 5)],
+           op="logsumexp"),
+    OpSpec("nanmean_axis0", lambda x: stat.nanmean(x, axis=0),
+           lambda x: np.nanmean(x, 0), [(4, 5)], grad=False,
+           op="nanmean"),
+    OpSpec("nansum_axis0", lambda x: stat.nansum(x, axis=0),
+           lambda x: np.nansum(x, 0), [(4, 5)], grad=False,
+           op="nansum"),
+    OpSpec("cumsum_axis0", lambda x: pmath.cumsum(x, axis=0),
+           lambda x: np.cumsum(x, 0), [(4, 5)], op="cumsum"),
+    OpSpec("cumprod_axis0", lambda x: pmath.cumprod(x, dim=0),
+           lambda x: np.cumprod(x, 0), [(4, 5)], op="cumprod"),
+    OpSpec("norm_l1", lambda x: linalg.norm(x, p=1),
+           lambda x: np.abs(x).sum(), [(4, 5)],
+           kink=_away_from_zero, op="norm"),
+    OpSpec("norm_inf", lambda x: linalg.norm(x, p=np.inf),
+           lambda x: np.abs(x).max(), [(4, 5)], grad=False,
+           op="norm"),
+    OpSpec("softmax_axis0", lambda x: F.softmax(x, axis=0),
+           lambda x: _softmax_np(x, 0), [(4, 5)], op="softmax"),
+    OpSpec("log_softmax_axis0", lambda x: F.log_softmax(x, axis=0),
+           lambda x: np.log(_softmax_np(x, 0)), [(4, 5)],
+           op="log_softmax"),
+    OpSpec("concat_axis1",
+           lambda x, y: manipulation.concat([x, y], axis=1),
+           lambda x, y: np.concatenate([x, y], 1),
+           [(4, 3), (4, 2)], op="concat"),
+    OpSpec("stack_axis1",
+           lambda x, y: manipulation.stack([x, y], axis=1),
+           lambda x, y: np.stack([x, y], 1), [(4, 3), (4, 3)],
+           op="stack"),
+    OpSpec("flip_axis0", lambda x: manipulation.flip(x, axis=0),
+           lambda x: x[::-1].copy(), [(4, 5)], op="flip"),
+    OpSpec("roll_shift2", lambda x: manipulation.roll(x, 2, axis=1),
+           lambda x: np.roll(x, 2, 1), [(4, 5)], op="roll"),
+    OpSpec("transpose_permute",
+           lambda x: manipulation.transpose(x, [2, 0, 1]),
+           lambda x: np.transpose(x, (2, 0, 1)), [(3, 4, 5)],
+           op="transpose"),
+    OpSpec("clip_min_only", lambda x: pmath.clip(x, min=0.0),
+           lambda x: np.clip(x, 0.0, None), [(4, 5)],
+           kink=_away_from_zero, op="clip"),
+    OpSpec("scale_bias_before",
+           lambda x: pmath.scale(x, 2.0, 1.0, bias_after_scale=False),
+           lambda x: 2.0 * (x + 1.0), [(4, 5)], op="scale"),
+]
+
 _IDS = [o.name for o in OPS]
 assert len(set(_IDS)) == len(_IDS), "duplicate op names"
 
@@ -1806,3 +1992,42 @@ class TestDeviceSurface:
         assert e0.elapsed_time(e1) >= 0
         with device.stream_guard(device.Stream()):
             _ = float(np.asarray(y._data))
+
+
+class TestAdaptiveSoftmax:
+    """adaptive_log_softmax_with_loss vs the exact full-softmax oracle
+    (upstream test_adaptive_log_softmax_with_loss)."""
+
+    def test_matches_full_softmax_oracle(self):
+        import scipy.special as sps
+
+        rng = np.random.RandomState(0)
+        N, D = 6, 8
+        cutoffs = [10, 16]  # head [0,10) + clusters [10,16), [16,20)
+        x = rng.randn(N, D).astype("float32")
+        y = np.array([1, 5, 11, 15, 17, 19], "int64")
+        hw = rng.randn(D, 12).astype("float32") * 0.3
+        t0 = [rng.randn(D, 4).astype("float32") * 0.3,
+              rng.randn(4, 6).astype("float32") * 0.3]
+        t1 = [rng.randn(D, 2).astype("float32") * 0.3,
+              rng.randn(2, 4).astype("float32") * 0.3]
+        lp, loss = F.adaptive_log_softmax_with_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y),
+            paddle.to_tensor(hw),
+            [[paddle.to_tensor(a) for a in t0],
+             [paddle.to_tensor(a) for a in t1]], cutoffs)
+        hl = x @ hw
+        hlp = hl - sps.logsumexp(hl, -1, keepdims=True)
+        ref = np.zeros(N)
+        for i, yy in enumerate(y):
+            if yy < 10:
+                ref[i] = hlp[i, yy]
+            elif yy < 16:
+                cl = (x[i] @ t0[0]) @ t0[1]
+                ref[i] = hlp[i, 10] + (cl - sps.logsumexp(cl))[yy - 10]
+            else:
+                cl = (x[i] @ t1[0]) @ t1[1]
+                ref[i] = hlp[i, 11] + (cl - sps.logsumexp(cl))[yy - 16]
+        np.testing.assert_allclose(np.asarray(lp._data), ref, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(np.asarray(loss._data)), -ref.mean(), rtol=1e-5)

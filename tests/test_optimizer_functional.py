@@ -1,0 +1,156 @@
+"""Functional optimizer update kernels (optimizer/functional.py) vs
+numpy references — the upstream ops.yaml sgd_/adam_ op family
+(upstream OpTests: test/legacy_test/test_adam_op.py etc.)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer.functional as opf
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def _v(t):
+    return np.asarray(t._data, np.float64)
+
+
+def test_sgd_():
+    rng = np.random.RandomState(0)
+    p, g = rng.randn(4, 3), rng.randn(4, 3)
+    pt, gt = _t(p), _t(g)
+    opf.sgd_(pt, 0.1, gt)
+    np.testing.assert_allclose(_v(pt), p - 0.1 * g, rtol=1e-6)
+
+
+def test_momentum_and_nesterov():
+    rng = np.random.RandomState(1)
+    p, g, v = rng.randn(5), rng.randn(5), rng.randn(5)
+    pt, gt, vt = _t(p), _t(g), _t(v)
+    opf.momentum_(pt, gt, vt, 0.1, mu=0.9)
+    v_ref = 0.9 * v + g
+    np.testing.assert_allclose(_v(vt), v_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_v(pt), p - 0.1 * v_ref, rtol=1e-6)
+
+
+def test_adam_matches_reference_two_steps():
+    rng = np.random.RandomState(2)
+    p = rng.randn(6).astype(np.float64)
+    m = np.zeros(6)
+    v = np.zeros(6)
+    b1p, b2p = 1.0, 1.0
+    pt, mt, vt = _t(p), _t(m), _t(v)
+    b1t, b2t = _t(1.0), _t(1.0)
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    for step in range(2):
+        g = rng.randn(6)
+        opf.adam_(pt, _t(g), mt, vt, b1t, b2t, lr, b1, b2, eps)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        b1p *= b1
+        b2p *= b2
+        p = p - lr * (m / (1 - b1p)) / (np.sqrt(v / (1 - b2p)) + eps)
+    np.testing.assert_allclose(_v(pt), p, rtol=1e-5)
+    np.testing.assert_allclose(float(_v(b1t)), b1p, rtol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    p = np.ones(4)
+    pt = _t(p)
+    mt, vt = _t(np.zeros(4)), _t(np.zeros(4))
+    opf.adamw_(pt, _t(np.zeros(4)), mt, vt, _t(1.0), _t(1.0),
+               0.1, weight_decay=0.5)
+    # zero grad: only the decay moves the param: p *= (1 - lr*wd)
+    np.testing.assert_allclose(_v(pt), p * (1 - 0.1 * 0.5), rtol=1e-6)
+
+
+def test_adagrad_():
+    rng = np.random.RandomState(3)
+    p, g = rng.randn(4), rng.randn(4)
+    pt, gt, at = _t(p), _t(g), _t(np.zeros(4))
+    opf.adagrad_(pt, gt, at, 0.1, epsilon=1e-6)
+    acc = g * g
+    np.testing.assert_allclose(
+        _v(pt), p - 0.1 * g / (np.sqrt(acc) + 1e-6), rtol=1e-5)
+
+
+def test_adadelta_():
+    rng = np.random.RandomState(4)
+    p, g = rng.randn(4), rng.randn(4)
+    pt, gt = _t(p), _t(g)
+    e_g2, e_dx2 = _t(np.zeros(4)), _t(np.zeros(4))
+    opf.adadelta_(pt, gt, e_g2, e_dx2, 1.0, rho=0.95, epsilon=1e-6)
+    eg = 0.05 * g * g
+    dx = np.sqrt(1e-6) / np.sqrt(eg + 1e-6) * g
+    np.testing.assert_allclose(_v(pt), p - dx, rtol=1e-5)
+
+
+def test_adamax_():
+    rng = np.random.RandomState(5)
+    p, g = rng.randn(4), rng.randn(4)
+    pt, gt = _t(p), _t(g)
+    mt, ut, bt = _t(np.zeros(4)), _t(np.zeros(4)), _t(1.0)
+    opf.adamax_(pt, gt, mt, ut, bt, 0.01)
+    m = 0.1 * g
+    u = np.abs(g)
+    np.testing.assert_allclose(
+        _v(pt), p - 0.01 / (1 - 0.9) * m / (u + 1e-8), rtol=1e-5)
+
+
+def test_rmsprop_plain_and_centered():
+    rng = np.random.RandomState(6)
+    p, g = rng.randn(4), rng.randn(4)
+    pt, gt = _t(p), _t(g)
+    st, vt = _t(np.zeros(4)), _t(np.zeros(4))
+    opf.rmsprop_(pt, gt, st, vt, 0.1, rho=0.9, epsilon=1e-6)
+    s = 0.1 * g * g
+    v = 0.1 * g / np.sqrt(s + 1e-6)
+    np.testing.assert_allclose(_v(pt), p - v, rtol=1e-5)
+    # centered variant runs and moves the mean-grad state
+    mgt = _t(np.zeros(4))
+    opf.rmsprop_(_t(p), _t(g), _t(np.zeros(4)), _t(np.zeros(4)), 0.1,
+                 mean_grad=mgt, centered=True)
+    np.testing.assert_allclose(_v(mgt), 0.05 * g, rtol=1e-5)
+
+
+def test_lamb_trust_ratio():
+    p = np.full(4, 2.0)
+    g = np.full(4, 1.0)
+    pt, gt = _t(p), _t(g)
+    mt, vt = _t(np.zeros(4)), _t(np.zeros(4))
+    opf.lamb_(pt, gt, mt, vt, _t(1.0), _t(1.0), 0.1,
+              weight_decay=0.0)
+    # step 1: mhat = g, vhat = g^2 -> r = 1s; trust = ||p||/||r|| = 2
+    upd = 1.0 / (1.0 + 1e-6)
+    np.testing.assert_allclose(
+        _v(pt), p - 0.1 * 2.0 * upd, rtol=1e-4)
+
+
+def test_asgd_and_rprop_and_lars_run():
+    rng = np.random.RandomState(7)
+    p, g = rng.randn(4), rng.randn(4)
+    pt = _t(p)
+    opf.asgd_(pt, _t(g), _t(np.zeros(4)), _t(np.zeros(4)), 2, 0.1)
+    np.testing.assert_allclose(_v(pt), p - 0.05 * g, rtol=1e-5)
+
+    pt2, lrt = _t(p), _t(np.full(4, 0.01))
+    opf.rprop_(pt2, _t(g), _t(g), lrt)
+    # same-sign grads: per-weight lr grows by eta_plus
+    np.testing.assert_allclose(_v(lrt), np.full(4, 0.012), rtol=1e-5)
+    np.testing.assert_allclose(
+        _v(pt2), p - np.sign(g) * 0.012, rtol=1e-5)
+
+    pt3, vt3 = _t(p), _t(np.zeros(4))
+    opf.lars_momentum_(pt3, _t(g), vt3, 0.1)
+    assert np.isfinite(_v(pt3)).all() and not np.allclose(_v(pt3), p)
+
+
+def test_merged_variants():
+    rng = np.random.RandomState(8)
+    ps = [rng.randn(3) for _ in range(2)]
+    gs = [rng.randn(3) for _ in range(2)]
+    pts = [_t(a) for a in ps]
+    vts = [_t(np.zeros(3)) for _ in range(2)]
+    opf.merged_momentum_(pts, [_t(a) for a in gs], vts, 0.1)
+    for p, g, pt in zip(ps, gs, pts):
+        np.testing.assert_allclose(_v(pt), p - 0.1 * g, rtol=1e-5)

@@ -241,3 +241,74 @@ def test_op_names_recorded_on_tape():
 
     z = F.relu(x)
     assert z._grad_node.name == "relu"
+
+
+class TestQuantFunctionalOps:
+    """quantize_linear/dequantize_linear + fake-quant grid ops
+    (upstream test_fake_quantize_op / test_quant_linear_op)."""
+
+    def test_quant_dequant_roundtrip(self):
+        from paddle_tpu.quantization import (
+            dequantize_linear, quantize_linear,
+        )
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 6).astype("float32")
+        scale = paddle.to_tensor(np.float32(0.05))
+        q = quantize_linear(paddle.to_tensor(x), scale)
+        qd = np.asarray(q._data)
+        assert np.all(qd == np.round(qd))  # on the int grid
+        assert qd.max() <= 127 and qd.min() >= -127
+        dq = dequantize_linear(q, scale)
+        np.testing.assert_allclose(
+            np.asarray(dq._data), np.clip(
+                np.round(x / 0.05), -127, 127) * 0.05, rtol=1e-5)
+
+    def test_fake_quantize_abs_max(self):
+        from paddle_tpu.quantization import fake_quantize_abs_max
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(5, 5).astype("float32")
+        out, scale = fake_quantize_abs_max(paddle.to_tensor(x))
+        s = float(np.asarray(scale._data))
+        np.testing.assert_allclose(s, np.abs(x).max(), rtol=1e-6)
+        ref = np.clip(np.round(x / s * 127), -127, 127) * s / 127
+        np.testing.assert_allclose(np.asarray(out._data), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_channel_wise(self):
+        from paddle_tpu.quantization import (
+            fake_channel_wise_quantize_abs_max,
+        )
+
+        rng = np.random.RandomState(2)
+        x = rng.randn(3, 7).astype("float32")
+        out, scales = fake_channel_wise_quantize_abs_max(
+            paddle.to_tensor(x), quant_axis=0)
+        sn = np.asarray(scales._data)
+        np.testing.assert_allclose(sn, np.abs(x).max(1), rtol=1e-6)
+        err = np.abs(np.asarray(out._data) - x)
+        assert err.max() <= sn.max() / 127 + 1e-6
+
+
+def test_functional_auc_matches_class():
+    import numpy as np
+
+    from paddle_tpu.metric import Auc, auc
+
+    rng = np.random.RandomState(0)
+    scores = rng.rand(200, 2).astype("float32")
+    labels = (rng.rand(200) > 0.5).astype("int64")
+    a = Auc()
+    a.update(scores, labels)
+    ref = a.accumulate()
+    got = float(np.asarray(auc(input=scores, label=labels)._data))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    # histogram branch reuses the same accumulation
+    got2 = float(np.asarray(
+        auc(stat_pos=a._stat_pos, stat_neg=a._stat_neg)._data))
+    np.testing.assert_allclose(got2, ref, rtol=1e-6)
+    import pytest
+
+    with pytest.raises(ValueError, match="curve"):
+        auc(input=scores, label=labels, curve="PR")

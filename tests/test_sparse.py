@@ -122,3 +122,114 @@ def test_masked_matmul_grads_flow():
 import pytest as _pytest_tier
 
 pytestmark = _pytest_tier.mark.slow
+
+
+class TestSparseFamilyR5:
+    """Registry-growth r5 sparse family: unary values-maps, conv/pool
+    (dense-formulation, see sparse/nn/functional.py docstring), mv,
+    addmm, divide (upstream test/legacy_test/test_sparse_*_op.py)."""
+
+    def _dense(self, t):
+        return np.asarray(t.to_dense()._data if hasattr(t, "to_dense")
+                          else t._data)
+
+    def test_unary_family_matches_dense(self):
+        import paddle_tpu.sparse as sp
+
+        rng = np.random.RandomState(0)
+        d = (rng.randn(4, 6) * (rng.rand(4, 6) > 0.6)).astype("float32")
+        x = sp.sparse_coo_tensor_from_dense(d)
+        for name, ref in [("sin", np.sin), ("tanh", np.tanh),
+                          ("sqrt", lambda a: np.sqrt(np.abs(a))),
+                          ("abs", np.abs), ("expm1", np.expm1),
+                          ("neg", np.negative)]:
+            src = np.abs(d) if name == "sqrt" else d
+            xs = sp.sparse_coo_tensor_from_dense(
+                src.astype("float32"))
+            got = self._dense(getattr(sp, name)(xs))
+            want = np.where(src != 0, ref(src), 0.0)
+            np.testing.assert_allclose(got, want, rtol=1e-5,
+                                       atol=1e-6, err_msg=name)
+
+    def test_mv_addmm_divide(self):
+        import paddle_tpu.sparse as sp
+
+        rng = np.random.RandomState(1)
+        d = (rng.randn(4, 6) * (rng.rand(4, 6) > 0.5)).astype("float32")
+        x = sp.sparse_coo_tensor_from_dense(d)
+        v = rng.randn(6).astype("float32")
+        np.testing.assert_allclose(
+            np.asarray(sp.mv(x, paddle.to_tensor(v))._data), d @ v,
+            rtol=1e-5)
+        y = rng.randn(6, 3).astype("float32")
+        inp = rng.randn(4, 3).astype("float32")
+        got = sp.addmm(paddle.to_tensor(inp), x, paddle.to_tensor(y),
+                       beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   0.5 * inp + 2.0 * (d @ y), rtol=1e-5)
+        # divide over matching SPARSE patterns: present/present -> 1,
+        # absent/absent -> 0 (never 0/0 -> NaN)
+        x2 = sp.sparse_coo_tensor_from_dense(d)
+        got2 = self._dense(sp.divide(x2, x2))
+        np.testing.assert_allclose(
+            got2, (d != 0).astype("float32"), rtol=1e-6)
+        assert np.isfinite(got2).all()
+
+    def test_subm_conv3d_sites_and_values(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        import paddle_tpu.sparse as sp
+        import paddle_tpu.sparse.nn.functional as spf
+
+        rng = np.random.RandomState(2)
+        xb = (rng.randn(1, 4, 4, 4, 2)
+              * (rng.rand(1, 4, 4, 4, 1) > 0.7)).astype("float32")
+        xs = sp.SparseCooTensor(
+            jsparse.BCOO.fromdense(jnp.asarray(xb), n_dense=1))
+        w = (rng.randn(3, 3, 3, 2, 5) * 0.1).astype("float32")
+        out = spf.subm_conv3d(xs, paddle.to_tensor(w), padding=1)
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(xb), jnp.asarray(w), (1, 1, 1), [(1, 1)] * 3,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                xb.shape, w.shape, ("NDHWC", "DHWIO", "NDHWC")))
+        od = np.asarray(out.to_dense()._data)
+        sites = np.any(xb != 0, axis=-1)
+        np.testing.assert_allclose(od[sites], np.asarray(ref)[sites],
+                                   rtol=1e-4, atol=1e-5)
+        assert np.all(od[~sites] == 0)
+
+    def test_conv3d_max_pool3d_softmax(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        import paddle_tpu.sparse as sp
+        import paddle_tpu.sparse.nn.functional as spf
+
+        rng = np.random.RandomState(3)
+        xb = (rng.randn(1, 4, 4, 4, 2)
+              * (rng.rand(1, 4, 4, 4, 1) > 0.6)).astype("float32")
+        xs = sp.SparseCooTensor(
+            jsparse.BCOO.fromdense(jnp.asarray(xb), n_dense=1))
+        w = (rng.randn(2, 2, 2, 2, 3) * 0.2).astype("float32")
+        out = spf.conv3d(xs, paddle.to_tensor(w), stride=2)
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(xb), jnp.asarray(w), (2, 2, 2), [(0, 0)] * 3,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                xb.shape, w.shape, ("NDHWC", "DHWIO", "NDHWC")))
+        np.testing.assert_allclose(np.asarray(out.to_dense()._data),
+                                   np.asarray(ref), rtol=1e-4,
+                                   atol=1e-5)
+        mp = spf.max_pool3d(xs, 2, 2)
+        assert list(mp.shape) == [1, 2, 2, 2, 2]
+        # sparse softmax: stored entries of each row softmax to 1
+        d = (rng.randn(3, 5) * (rng.rand(3, 5) > 0.4)).astype("float32")
+        x2 = sp.sparse_coo_tensor_from_dense(d)
+        sm = np.asarray(spf.softmax(x2).to_dense()._data)
+        for i in range(3):
+            m = d[i] != 0
+            if m.any():
+                np.testing.assert_allclose(sm[i][m].sum(), 1.0,
+                                           rtol=1e-5)

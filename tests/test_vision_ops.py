@@ -169,3 +169,64 @@ def test_roi_align_edge_box_full_weight():
 import pytest as _pytest_tier
 
 pytestmark = _pytest_tier.mark.slow
+
+
+class TestMatrixNmsAndFpn:
+    """matrix_nms + distribute_fpn_proposals (registry growth r5;
+    upstream test_matrix_nms_op / test_distribute_fpn_proposals_op)."""
+
+    def test_matrix_nms_suppresses_duplicates(self):
+        from paddle_tpu.vision.ops import matrix_nms
+
+        boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.85, 0.8]  # class 1; class 0 = background
+        out, rois_num = matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.3, nms_top_k=10,
+            keep_top_k=10)
+        o = np.asarray(out._data)
+        # the duplicate box's score decays hard (IoU=1); the far box
+        # survives untouched
+        assert int(np.asarray(rois_num._data)[0]) >= 2
+        top = o[0]
+        np.testing.assert_allclose(top[1], 0.9, rtol=1e-5)
+        kept_far = [r for r in o if r[2] == 20.0]
+        assert kept_far and abs(kept_far[0][1] - 0.8) < 1e-5
+
+    def test_matrix_nms_partial_overlap_decays(self):
+        # IoU < 1 must STILL decay (regression: a wrong compensate
+        # broadcast makes linear decay identically 1 for iou < 1)
+        from paddle_tpu.vision.ops import matrix_nms
+
+        b1 = [0.0, 0.0, 10.0, 10.0]
+        b2 = [0.0, 2.0, 10.0, 12.0]  # IoU 2/3 with b1
+        boxes = np.array([[b1, b2]], np.float32)
+        scores = np.zeros((1, 2, 2), np.float32)
+        scores[0, 1] = [0.9, 0.85]
+        out, _ = matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.0, nms_top_k=10,
+            keep_top_k=10)
+        o = np.asarray(out._data)
+        low = min(o[:, 1])
+        # linear decay: 0.85 * (1 - 2/3) / (1 - 0) = 0.2833
+        np.testing.assert_allclose(low, 0.85 * (1 - 2 / 3), rtol=1e-4)
+
+    def test_distribute_fpn_levels(self):
+        from paddle_tpu.vision.ops import distribute_fpn_proposals
+
+        rois = np.array([
+            [0, 0, 14, 14],      # ~14 -> low level
+            [0, 0, 112, 112],    # ~112 -> mid
+            [0, 0, 448, 448],    # ~448 -> high
+        ], np.float32)
+        multi, restore, nums = distribute_fpn_proposals(
+            paddle.to_tensor(rois), min_level=2, max_level=5,
+            refer_level=4, refer_scale=224)
+        sizes = [len(np.asarray(m._data)) for m in multi]
+        assert sum(sizes) == 3
+        assert sizes[0] == 1 and sizes[-1] == 1  # extremes routed out
+        r = np.asarray(restore._data)
+        assert sorted(r.tolist()) == [0, 1, 2]

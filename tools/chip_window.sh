@@ -50,6 +50,7 @@ run exp_mfu 1800 python tools/exp_mfu.py
 run exp_vpp 1800 python tools/exp_vpp.py
 # 5. headline again with explicit recompute (SCALE_7B resolving experiment)
 run headline_recompute 2400 env BENCH_RECOMPUTE=1 python bench.py --only llama
+run headline_recompute_selective 2400 env BENCH_RECOMPUTE=selective python bench.py --only llama
 
 echo "{\"window_end\": \"$(date -u +%FT%TZ)\"}" >> "$LOG"
 echo "window capture complete; see $LOG" >&2
