@@ -75,6 +75,10 @@ class TestAudioFeatures:
         assert v.max() - v.min() <= 60.0 + 1e-4
 
 
+import pytest as _pt_tier
+
+
+@_pt_tier.mark.slow
 class TestAdaptiveLogSoftmax:
     def test_matches_torch(self):
         torch = pytest.importorskip("torch")

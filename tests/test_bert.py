@@ -158,3 +158,11 @@ class TestBertTraining:
         c, _ = m(ids)
         d, _ = m(ids)
         np.testing.assert_array_equal(c.numpy(), d.numpy())
+
+
+# Tiering (VERDICT r4 weak #5 / next #8): multi-minute model-zoo /
+# mesh / subprocess suite — slow tier; the full gate
+# (`pytest -m "slow or not slow"`) still runs it.
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

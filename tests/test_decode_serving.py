@@ -167,3 +167,11 @@ class TestGPTDecode:
             ids = np.concatenate([ids, nxt], axis=1)
         got = model.generate(x, max_new_tokens=5).numpy()
         np.testing.assert_array_equal(got, ids)
+
+
+# Tiering (VERDICT r4 weak #5 / next #8): multi-minute model-zoo /
+# mesh / subprocess suite — slow tier; the full gate
+# (`pytest -m "slow or not slow"`) still runs it.
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

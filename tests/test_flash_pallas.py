@@ -338,3 +338,10 @@ class TestBenchSanityGuard:
             )
         finally:
             paddle.set_flags(prev)
+
+
+# Tiering: interpret-mode Pallas sweeps are multi-minute; the fast
+# tier keeps tests/test_flash_smoke.py as the always-on kernel signal.
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

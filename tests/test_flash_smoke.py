@@ -1,0 +1,53 @@
+"""Fast-tier flash-attention smoke: ONE small fwd+bwd oracle check per
+kernel family, so the default `pytest -q` still exercises the hot-path
+Pallas kernels end-to-end (the exhaustive interpret-mode sweeps live
+in the slow tier: test_flash_pallas.py / test_flash_varlen.py)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.kernels.flash_attention import flash_attention
+
+
+def _sdpa(q, k, v, causal):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = (jnp.arange(sk)[None, :]
+                <= jnp.arange(sq)[:, None] + (sk - sq))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+
+def test_flash_fwd_bwd_smoke():
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 16, 2, 32), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 16, 2, 32), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 16, 2, 32), jnp.float32)
+
+    def loss_f(fn):
+        return lambda a, b, c: (fn(a, b, c) ** 2).sum()
+
+    ref, gr = jax.value_and_grad(
+        loss_f(lambda a, b, c: _sdpa(a, b, c, True)),
+        argnums=(0, 1, 2))(q, k, v)
+    got, gf = jax.value_and_grad(
+        loss_f(lambda a, b, c: flash_attention(a, b, c, causal=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_flash_window_smoke():
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 16, 2, 32), jnp.float32)
+    out_w = flash_attention(q, q, q, causal=True, window=8)
+    # windowed output differs from full-causal (the band masks history)
+    out_f = flash_attention(q, q, q, causal=True)
+    assert not np.allclose(np.asarray(out_w), np.asarray(out_f))
+    assert np.isfinite(np.asarray(out_w)).all()

@@ -200,3 +200,9 @@ def test_unpadded_gqa_and_grad():
     np.testing.assert_allclose(
         out.numpy()[lens[0]:], out2.numpy()[lens[0]:], atol=1e-6
     )
+
+
+# Tiering: see test_flash_pallas.py (fast signal: test_flash_smoke.py)
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

@@ -47,6 +47,10 @@ def test_causality(stack):
     assert np.abs(oa[:, -1] - ob[:, -1]).max() > 1e-4
 
 
+import pytest as _pt_tier
+
+
+@_pt_tier.mark.slow
 def test_decode_matches_full_context(stack):
     """Prefill + token-by-token cache decode == full forward."""
     rng = np.random.RandomState(2)

@@ -176,3 +176,11 @@ class TestSparseRepresentation:
                 shape = getattr(getattr(v, "aval", None), "shape", ())
                 assert not is_dense_routing(shape), (
                     f"dense routing intermediate {shape} in {eqn.primitive}")
+
+
+# Tiering (VERDICT r4 weak #5 / next #8): multi-minute model-zoo /
+# mesh / subprocess suite — slow tier; the full gate
+# (`pytest -m "slow or not slow"`) still runs it.
+import pytest as _pytest_tier
+
+pytestmark = _pytest_tier.mark.slow

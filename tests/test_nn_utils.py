@@ -90,6 +90,10 @@ class TestParamVector:
             np.testing.assert_allclose(p.numpy(), 2.0 * b, rtol=1e-6)
 
 
+import pytest as _pt_tier
+
+
+@_pt_tier.mark.slow
 class TestCTCLoss:
     def _case(self):
         rng = np.random.RandomState(0)
@@ -233,6 +237,7 @@ class TestNewLayers:
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
+@_pt_tier.mark.slow
 class TestPoolMasks13D:
     """max_pool{1,3}d return_mask was silently ignored (callers
     unpacked the pooled tensor along dim 0); pin the torch-checked

@@ -1814,6 +1814,11 @@ _IDS = [o.name for o in OPS]
 assert len(set(_IDS)) == len(_IDS), "duplicate op names"
 
 
+# Tiering (VERDICT r4 next #8): the full per-op sweeps are the bulk
+# of the old 20-minute fast tier — slow tier now; the registry GATES
+# (TestOpTable) stay fast so `pytest -q` still enforces
+# undeclared_ops()==[] and swept-or-waived.
+@pytest.mark.slow
 @pytest.mark.parametrize("spec", OPS, ids=_IDS)
 def test_forward_dtype_sweep(spec):
     for dtype in spec.dtypes:
@@ -1833,6 +1838,7 @@ def test_forward_dtype_sweep(spec):
         )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "spec", [s for s in OPS if s.ref is None], ids=lambda s: s.name
 )
@@ -1852,6 +1858,7 @@ def test_forward_low_precision_consistent(spec):
         )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "spec", [s for s in OPS if s.grad], ids=lambda s: s.name
 )

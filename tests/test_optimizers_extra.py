@@ -98,6 +98,10 @@ def test_all_work_under_to_static():
         assert l1 < l0, cls.__name__
 
 
+import pytest as _pt_tier
+
+
+@_pt_tier.mark.slow
 class TestLBFGS:
     def _quadratic(self):
         rng = np.random.RandomState(1)

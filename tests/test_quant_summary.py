@@ -57,6 +57,10 @@ class TestFakeQuant:
         assert float(np.asarray(obs.scale._data)) == 3.0
 
 
+import pytest as _pt_tier
+
+
+@_pt_tier.mark.slow
 class TestQATPTQ:
     def test_qat_trains_and_preserves_structure(self):
         x, y = _xy()

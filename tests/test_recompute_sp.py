@@ -64,6 +64,10 @@ def test_selective_granularity_matches_plain():
         recompute(blk, x, granularity="bogus")
 
 
+import pytest as _pt
+
+
+@_pt.mark.slow
 def test_llama_selective_recompute_trajectory():
     """LlamaConfig.recompute_granularity='selective' trains to the
     same losses as full recompute and as no recompute."""

@@ -55,6 +55,10 @@ def _brute_force(logits, labels, T, U, blank=0):
     return -total
 
 
+import pytest as _pt_tier
+
+
+@_pt_tier.mark.slow
 class TestRNNTLoss:
     def test_matches_numpy_dp(self):
         rng = np.random.RandomState(0)
