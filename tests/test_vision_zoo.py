@@ -59,6 +59,10 @@ class TestTrainStep:
         import paddle_tpu.nn.functional as F
         import paddle_tpu.optimizer as optim
 
+        # seed: the init draws from the global stream, so without this
+        # the trajectory depends on which tests ran earlier in the
+        # worker (observed as an ordering-dependent flake under xdist)
+        paddle.seed(7)
         m = M.mobilenet_v2(scale=0.25, num_classes=4)
         opt = optim.SGD(0.005, parameters=m.parameters())
         x = _x(32, batch=4)
