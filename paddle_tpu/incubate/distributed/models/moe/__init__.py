@@ -1,6 +1,7 @@
 """MoE / expert parallelism (upstream:
 python/paddle/incubate/distributed/models/moe/)."""
-from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .gate import BaseGate, GShardGate, MixtralGate, \
+    NaiveGate, SwitchGate
 from .grad_clip import ClipGradForMOEByGlobalNorm, ClipGradForMoEByGlobalNorm
 from .moe_layer import ExpertLayer, MoELayer
 from .utils import (
@@ -13,5 +14,6 @@ from .utils import (
 __all__ = [
     "MoELayer", "ExpertLayer",
     "BaseGate", "NaiveGate", "GShardGate", "SwitchGate",
+    "MixtralGate",
     "ClipGradForMOEByGlobalNorm", "ClipGradForMoEByGlobalNorm",
 ]

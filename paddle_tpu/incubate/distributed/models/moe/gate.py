@@ -309,3 +309,53 @@ class SwitchGate(BaseGate):
             return combine, dispatch, aux
 
         return route
+
+
+class MixtralGate(BaseGate):
+    """Mixtral-style top-k router (upstream ecosystem: the
+    MixtralSparseMoeBlock router): softmax over experts, top-k
+    selected, combine weights RENORMALIZED over the selected experts,
+    and the HF load-balancing aux loss
+    ``E * sum_e f_e * P_e`` with ``f_e`` the fraction of (token,
+    choice) slots routed to expert e and ``P_e`` the mean router
+    probability."""
+
+    def __init__(self, d_model, num_expert, world_size, topk=2,
+                 group=None):
+        super().__init__(num_expert, world_size)
+        assert 1 <= int(topk) <= self.tot_expert, (
+            f"mixtral gate: topk ({topk}) must be in "
+            f"[1, num experts ({self.tot_expert})]")
+        self.d_model = d_model
+        self.top_k = int(topk)
+        self.weight = self.create_parameter(
+            [d_model, self.tot_expert],
+            default_initializer=I.XavierUniform(),
+        )
+
+    def forward(self, inp):
+        return self._topk_forward(inp, "mixtral_gate", self.top_k)
+
+    def make_router(self, capacity_factor=None, sparse=False):
+        cf = 2.0 if capacity_factor is None else capacity_factor
+        e = self.tot_expert
+        k = self.top_k
+
+        def route(x, w):
+            cap = _capacity(x.shape[0], e, k, cf)
+            logits = x.astype(jnp.float32) @ w.astype(jnp.float32)
+            gates = jax.nn.softmax(logits, axis=-1)
+            _, topi = jax.lax.top_k(gates, k)
+            sel = jax.nn.one_hot(topi, e, dtype=jnp.float32)  # (N,K,E)
+            f_e = jnp.mean(sel, axis=(0, 1))
+            p_e = jnp.mean(gates, axis=0)
+            aux = jnp.sum(f_e * p_e) * e
+            if sparse:
+                return _topk_sparse(
+                    gates, k, cap, normalize=True), aux, cap
+            combine, dispatch = _topk_combine_dispatch(
+                gates, k, cap, normalize=True
+            )
+            return combine, dispatch, aux
+
+        return route

@@ -31,7 +31,8 @@ from .....framework.core import Tensor, apply_op, _as_tensor
 from .....framework.flags import flag
 from .....nn import initializer as I
 from .....nn.layer.layers import Layer, LayerList
-from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .gate import BaseGate, GShardGate, MixtralGate, \
+    NaiveGate, SwitchGate
 
 from .....distributed.mesh import (
     axis_degree,
@@ -107,6 +108,11 @@ def _make_gate(gate, d_model, num_experts, top_k):
             d_model, num_experts, 1,
             topk=2 if top_k is None else top_k, **kwargs,
         )
+    if kind == "mixtral":
+        return MixtralGate(
+            d_model, num_experts, 1,
+            topk=2 if top_k is None else top_k, **kwargs,
+        )
     raise ValueError(f"unknown gate type {kind!r}")
 
 
@@ -153,10 +159,11 @@ class MoELayer(Layer):
             self.activation = activation
             self._stacked = True
             e, d, f = self.num_experts, d_model, self.d_hidden
+            f0 = 2 * f if activation == "swiglu" else f
             self.w0 = self.create_parameter(
-                [e, d, f], default_initializer=I.XavierUniform()
+                [e, d, f0], default_initializer=I.XavierUniform()
             )
-            self.b0 = self.create_parameter([e, f], is_bias=True)
+            self.b0 = self.create_parameter([e, f0], is_bias=True)
             self.w1 = self.create_parameter(
                 [e, f, d], default_initializer=I.XavierUniform()
             )
@@ -276,9 +283,17 @@ class MoELayer(Layer):
 
 
 def _expert_ffn(expert_in, w0, b0, w1, b1, act):
-    """(E, C, d) -> (E, C, d): batched-over-experts FFN on the MXU."""
+    """(E, C, d) -> (E, C, d): batched-over-experts FFN on the MXU.
+    act "swiglu": w0 is (E, d, 2f) — gate/up fused in one matmul,
+    silu(u) * v (the Mixtral expert), then w1 (E, f, d)."""
     h = jnp.einsum("ecd,edf->ecf", expert_in, w0) + b0[:, None, :]
-    h = jax.nn.gelu(h, approximate=True) if act == "gelu" else jax.nn.relu(h)
+    if act == "swiglu":
+        u, v = jnp.split(h, 2, axis=-1)
+        h = jax.nn.silu(u) * v
+    elif act == "gelu":
+        h = jax.nn.gelu(h, approximate=True)
+    else:
+        h = jax.nn.relu(h)
     return jnp.einsum("ecf,efd->ecd", h, w1) + b1[:, None, :]
 
 

@@ -43,6 +43,8 @@ from .llama import (
     llama_tiny,
     llama_pipeline_model,
     mistral_7b,
+    mixtral_8x7b,
+    mixtral_tiny,
     qwen2_0_5b,
     qwen2_7b,
 )

@@ -38,6 +38,8 @@ class GPTConfig:
     dropout: float = 0.0
     tie_word_embeddings: bool = True
     recompute: bool = False
+    # "full" or "selective"/"core_attn" (fleet recompute granularity)
+    recompute_granularity: str = "full"
     # MoE (ERNIE-MoE-style mp×pp×ep config): num_experts>0 replaces the
     # dense MLP with a MoELayer on every `moe_every`-th layer
     num_experts: int = 0
@@ -326,7 +328,9 @@ class GPTModel(Layer):
             from ..distributed.fleet.recompute import recompute
 
             for l in self.h:
-                h = recompute(l, h)
+                h = recompute(
+                    l, h,
+                    granularity=self.config.recompute_granularity)
         else:
             for l in self.h:
                 h = l(h)
@@ -365,29 +369,11 @@ class GPTForCausalLM(Layer):
             return logits
         loss = self.criterion(logits, labels)
         if self.config.num_experts > 0:
-            if self.config.recompute:
-                # the decoder runs inside jax.checkpoint: the gate's
-                # side-channel aux tensor is a leaked tracer there, so
-                # the balance loss cannot be collected (same limitation
-                # as the pipelined form — see gpt_pipeline_model)
-                global _warned_moe_recompute
-                if not _warned_moe_recompute:
-                    import warnings
+            from .moe_common import add_moe_aux_loss
 
-                    warnings.warn(
-                        "MoE aux (load-balance) loss is dropped when "
-                        "recompute is enabled; routing still trains "
-                        "through the combine weights"
-                    )
-                    _warned_moe_recompute = True
-            else:
-                aux = None
-                for l in self.gpt.h:
-                    a = l.moe_loss()
-                    if a is not None:
-                        aux = a if aux is None else aux + a
-                if aux is not None:
-                    loss = loss + self.config.moe_aux_loss_weight * aux
+            loss = add_moe_aux_loss(
+                loss, self.gpt.h, self.config.moe_aux_loss_weight,
+                recompute=self.config.recompute, family="gpt-moe")
         return logits, loss
 
     # -- decode / serving (mirror of LlamaForCausalLM's) -------------------

@@ -72,6 +72,14 @@ class LlamaConfig:
     # Mistral-style sliding-window attention: 0 = full causal; w > 0
     # keeps keys j with 0 <= i - j < w (HF semantics)
     sliding_window: int = 0
+    # Mixtral-style sparse-MoE MLP: num_local_experts > 0 replaces the
+    # dense SwiGLU MLP with a top-k routed expert mixture (MixtralGate:
+    # softmax top-k renormalized over the selected experts + the HF
+    # load-balancing aux loss, weighted by router_aux_loss_coef)
+    num_local_experts: int = 0
+    num_experts_per_tok: int = 2
+    router_aux_loss_coef: float = 0.02
+    moe_capacity_factor: float = 2.0
     dtype: str = "float32"
 
     @property
@@ -82,9 +90,16 @@ class LlamaConfig:
         """Total parameter count (for MFU math in bench.py)."""
         h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
         kvh = self.num_key_value_heads * self.head_dim
+        if self.num_local_experts > 0:
+            e = self.num_local_experts
+            # stacked SwiGLU experts (E, h, 2i) + (E, i, h) + biases,
+            # plus the router weight [h, E]
+            mlp = e * (h * 2 * i + 2 * i + i * h + h) + h * e
+        else:
+            mlp = 3 * h * i               # gate up down
         per_layer = (
             h * h + 2 * h * kvh + h * h  # q k v o
-            + 3 * h * i                   # gate up down
+            + mlp
             + 2 * h                       # two rms norms
         )
         if self.attention_bias:
@@ -171,6 +186,38 @@ def mistral_7b(**kw) -> LlamaConfig:
     kw.setdefault("num_key_value_heads", 8)
     kw.setdefault("max_position_embeddings", 32768)
     kw.setdefault("sliding_window", 4096)
+    return LlamaConfig(**kw)
+
+
+_warned_moe_recompute_llama = False
+
+
+def mixtral_8x7b(**kw) -> LlamaConfig:
+    """Mixtral-8x7B: Mistral trunk + 8-expert top-2 sparse MoE MLP."""
+    kw.setdefault("vocab_size", 32000)
+    kw.setdefault("hidden_size", 4096)
+    kw.setdefault("intermediate_size", 14336)
+    kw.setdefault("num_hidden_layers", 32)
+    kw.setdefault("num_attention_heads", 32)
+    kw.setdefault("num_key_value_heads", 8)
+    kw.setdefault("max_position_embeddings", 32768)
+    kw.setdefault("rope_theta", 1000000.0)
+    kw.setdefault("num_local_experts", 8)
+    kw.setdefault("num_experts_per_tok", 2)
+    return LlamaConfig(**kw)
+
+
+def mixtral_tiny(**kw) -> LlamaConfig:
+    """Test-scale Mixtral topology (4 experts, top-2)."""
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("num_key_value_heads", 2)
+    kw.setdefault("max_position_embeddings", 256)
+    kw.setdefault("num_local_experts", 4)
+    kw.setdefault("num_experts_per_tok", 2)
     return LlamaConfig(**kw)
 
 
@@ -423,6 +470,33 @@ class LlamaAttention(Layer):
         return self.o_proj(out), nk, nv
 
 
+class LlamaSparseMoeBlock(Layer):
+    """Mixtral-style sparse-MoE MLP (upstream ecosystem analog:
+    MixtralSparseMoeBlock). TPU-first: stacked (E, d, 2f)/(E, f, d)
+    SwiGLU experts batched over the MXU with capacity-based dispatch
+    (the incubate MoELayer machinery, ep-shardable), routed by
+    MixtralGate — softmax top-k renormalized over the selected
+    experts, HF load-balancing aux loss on ``self.gate.loss``."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        from ..incubate.distributed.models.moe import MoELayer
+
+        self.moe = MoELayer(
+            config.hidden_size,
+            num_experts=config.num_local_experts,
+            d_hidden=config.intermediate_size,
+            gate="mixtral",
+            top_k=config.num_experts_per_tok,
+            capacity_factor=config.moe_capacity_factor,
+            activation="swiglu",
+        )
+        self.gate = self.moe.gate  # aux-loss surface (gate.get_loss())
+
+    def forward(self, x):
+        return self.moe(x)
+
+
 class LlamaDecoderLayer(Layer):
     """Pre-norm block; single-tensor signature → pipeline-stackable."""
 
@@ -436,7 +510,9 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = RMSNorm(
             config.hidden_size, epsilon=config.rms_norm_eps
         )
-        self.mlp = LlamaMLP(config)
+        self.is_moe = config.num_local_experts > 0
+        self.mlp = (LlamaSparseMoeBlock(config) if self.is_moe
+                    else LlamaMLP(config))
 
     def forward(self, x):
         x = _constrain_act(x, self._sp)
@@ -451,6 +527,12 @@ class LlamaDecoderLayer(Layer):
         h = x + attn_out
         out = h + self.mlp(self.post_attention_layernorm(h))
         return out, nk, nv
+
+    def moe_loss(self):
+        if getattr(self, "is_moe", False) and \
+                self.mlp.gate.loss is not None:
+            return self.mlp.gate.get_loss()
+        return None
 
 
 class LlamaModel(Layer):
@@ -528,18 +610,36 @@ class LlamaForCausalLM(Layer):
                         [a[:, 1:],
                          jnp.full((a.shape[0], 1), ii, a.dtype)], axis=1),
                     labels, differentiable=False)
-                return None, fused_linear_cross_entropy(
-                    h, w, lab_s, ignore_index=ii, transpose_w=not tied)
+                return None, self._with_moe_aux(
+                    fused_linear_cross_entropy(
+                        h, w, lab_s, ignore_index=ii,
+                        transpose_w=not tied))
             # single-replica head: logits[:, :-1] predicts labels[:, 1:]
             h_s = apply_op("shift_hidden", lambda a: a[:, :-1], h)
             lab_s = apply_op("shift_labels", lambda a: a[:, 1:], labels,
                              differentiable=False)
-            return None, fused_linear_cross_entropy(
-                h_s, w, lab_s, transpose_w=not tied)
+            return None, self._with_moe_aux(fused_linear_cross_entropy(
+                h_s, w, lab_s, transpose_w=not tied))
         logits = self._head(h)
         if labels is None:
             return logits
-        return logits, LlamaPretrainingCriterion()(logits, labels)
+        loss = self._with_moe_aux(
+            LlamaPretrainingCriterion()(logits, labels))
+        return logits, loss
+
+    def _with_moe_aux(self, loss):
+        """Add the routers' load-balance aux losses (Mixtral
+        router_aux_loss_coef). Under recompute the gate's side-channel
+        tensor is a leaked tracer inside jax.checkpoint and cannot be
+        collected — same limitation as the GPT-MoE path; routing still
+        trains through the combine weights."""
+        if self.config.num_local_experts == 0:
+            return loss
+        from .moe_common import add_moe_aux_loss
+
+        return add_moe_aux_loss(
+            loss, self.model.layers, self.config.router_aux_loss_coef,
+            recompute=self.config.recompute, family="mixtral")
 
     def _fused_loss_active(self, labels=None):
         # mp==1: the single-replica chunked kernel. mp>1: the vocab-
@@ -636,6 +736,15 @@ def llama_pipeline_model(config: LlamaConfig, **pp_kwargs):
     With tie_word_embeddings the head is a SharedLayerDesc occurrence of
     the embedding (one tensor; the reference's shared-embedding grad
     allreduce becomes ordinary accumulation — pp_layers.py)."""
+    if config.num_local_experts > 0:
+        import warnings
+
+        warnings.warn(
+            "llama_pipeline_model with Mixtral MoE: the router "
+            "load-balance aux loss stays inside the compiled stage "
+            "and is NOT added to the pipeline loss (same caveat as "
+            "gpt_pipeline_model); routing still trains through the "
+            "combine weights")
     from ..distributed.fleet.meta_parallel.parallel_layers.pp_layers import (
         LayerDesc,
         PipelineLayer,

@@ -1,0 +1,35 @@
+"""Shared MoE aux-loss collection for the model zoo (one algorithm for
+the GPT-MoE and Mixtral paths, so they cannot drift).
+
+Under recompute the gate's side-channel aux tensor is a leaked tracer
+inside jax.checkpoint and cannot be collected; the warning fires once
+per family and routing still trains through the combine weights.
+"""
+from __future__ import annotations
+
+_warned = set()
+
+
+def add_moe_aux_loss(loss, layers, coef, recompute=False,
+                     family="moe"):
+    """loss + coef * sum(layer.moe_loss()) over ``layers`` (layers
+    without an moe_loss / with no stored loss contribute nothing)."""
+    if recompute:
+        if family not in _warned:
+            import warnings
+
+            warnings.warn(
+                f"{family}: MoE aux (load-balance) loss is dropped "
+                "when recompute is enabled; routing still trains "
+                "through the combine weights")
+            _warned.add(family)
+        return loss
+    aux = None
+    for l in layers:
+        fn = getattr(l, "moe_loss", None)
+        a = fn() if fn is not None else None
+        if a is not None:
+            aux = a if aux is None else aux + a
+    if aux is not None:
+        loss = loss + coef * aux
+    return loss
