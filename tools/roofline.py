@@ -269,6 +269,12 @@ def liveness(argv=None):
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--recompute", action="store_true")
+    ap.add_argument(
+        "--granularity", default="full",
+        choices=["full", "selective", "core_attn", "dots",
+                 "dots_with_no_batch_dims"],
+        help="recompute granularity (implies --recompute when not "
+             "'full')")
     ap.add_argument("--liveness", action="store_true")  # consumed
     args = ap.parse_args(argv)
 
@@ -282,8 +288,13 @@ def liveness(argv=None):
     import paddle_tpu.optimizer as optim
     from paddle_tpu.models import LlamaForCausalLM, llama_headline
 
+    if args.granularity != "full":
+        # a granularity without recompute would silently measure the
+        # no-recompute program — make the knob imply what it needs
+        args.recompute = True
     cfg = llama_headline(max_position_embeddings=args.seq,
-                         recompute=args.recompute)
+                         recompute=args.recompute,
+                         recompute_granularity=args.granularity)
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     model.bfloat16()
@@ -322,6 +333,7 @@ def liveness(argv=None):
                    "layers": cfg.num_hidden_layers,
                    "seq": args.seq, "batch": args.batch,
                    "recompute": bool(args.recompute),
+                   "granularity": args.granularity,
                    "n_params": cfg.num_params()},
         "n_eqns": n_eqns,
         "peak_live_gb": round(peak / 2**30, 2),
