@@ -53,6 +53,20 @@ def _assign(param, arr, name):
     param.set_value(arr.astype(param._data.dtype))
 
 
+def _llama_strict_leftovers(state_dict, used, model):
+    """Shared llama-family strict check: every checkpoint key consumed,
+    modulo the tied head and rotary buffers."""
+    tied = getattr(model, "lm_head", None) is None
+    leftovers = [
+        k for k in state_dict
+        if k not in used and not (tied and k == "lm_head.weight")
+        and not k.endswith("rotary_emb.inv_freq")
+    ]
+    if leftovers:
+        raise KeyError(f"convert: unused HF keys {leftovers[:5]}"
+                       f"{'...' if len(leftovers) > 5 else ''}")
+
+
 def load_hf_llama(model, state_dict, strict=True):
     """Load a HF-format Llama state dict into ``LlamaForCausalLM``.
 
@@ -74,15 +88,7 @@ def load_hf_llama(model, state_dict, strict=True):
         _assign(param, arr, name)
         used.add(name)
     if strict:
-        tied = getattr(model, "lm_head", None) is None
-        leftovers = [
-            k for k in state_dict
-            if k not in used and not (tied and k == "lm_head.weight")
-            and not k.endswith("rotary_emb.inv_freq")
-        ]
-        if leftovers:
-            raise KeyError(f"convert: unused HF keys {leftovers[:5]}"
-                           f"{'...' if len(leftovers) > 5 else ''}")
+        _llama_strict_leftovers(state_dict, used, model)
     return model
 
 
@@ -483,6 +489,8 @@ def from_hf(model, state_dict, strict=True):
     """Dispatch on the model family."""
     name = type(model).__name__
     if name.startswith("Llama"):
+        if getattr(model.config, "num_local_experts", 0) > 0:
+            return load_hf_mixtral(model, state_dict, strict=strict)
         return load_hf_llama(model, state_dict, strict=strict)
     if name.startswith("Bert"):
         return load_hf_bert(model, state_dict, strict=strict)
@@ -495,3 +503,74 @@ def from_hf(model, state_dict, strict=True):
     raise TypeError(
         f"from_hf: no converter for {name} "
         f"(supported: Llama*, Bert*, GPT*, VisionTransformer, T5*)")
+
+
+def load_hf_mixtral(model, state_dict, strict=True):
+    """Load a HF-format Mixtral state dict into
+    ``LlamaForCausalLM(mixtral_8x7b()/...)``.
+
+    Non-MoE keys follow the Llama path (transposed 2-D linears). The
+    per-expert HF tensors map onto the stacked SwiGLU experts:
+    ``experts.E.w1`` (gate) and ``.w3`` (up) concatenate into our
+    fused ``mlp.moe.w0[E] = [gate | up]`` (the swiglu split order in
+    the expert kernel), ``.w2`` (down) becomes ``mlp.moe.w1[E]``, and
+    ``block_sparse_moe.gate`` transposes into the router weight.
+    Expert biases stay zero (HF Mixtral has none)."""
+    cfg = model.config
+    own = model.state_dict()
+    used = set()
+    for name, param in own.items():
+        if ".mlp.moe." in name or ".mlp.gate." in name:
+            continue  # expert/router tensors handled below
+        if name not in state_dict:
+            if strict:
+                raise KeyError(f"convert: missing HF key {name!r}")
+            continue
+        arr = _np(state_dict[name])
+        if name.endswith(".weight") and arr.ndim == 2 \
+                and "embed_tokens" not in name:
+            arr = arr.T
+        _assign(param, arr, name)
+        used.add(name)
+
+    e_cnt = cfg.num_local_experts
+    for n in range(cfg.num_hidden_layers):
+        base = f"model.layers.{n}"
+        hf_base = f"{base}.block_sparse_moe"
+        gate_k = f"{hf_base}.gate.weight"
+        if gate_k not in state_dict:
+            if strict:
+                raise KeyError(f"convert: missing HF key {gate_k!r}")
+            continue
+        _assign(own[f"{base}.mlp.moe.gate.weight"],
+                _np(state_dict[gate_k]).T, gate_k)
+        used.add(gate_k)
+        w0s, w1s = [], []
+        expert_keys = [
+            (f"{hf_base}.experts.{e}.w1.weight",
+             f"{hf_base}.experts.{e}.w3.weight",
+             f"{hf_base}.experts.{e}.w2.weight")
+            for e in range(e_cnt)
+        ]
+        missing = [k for ks in expert_keys for k in ks
+                   if k not in state_dict]
+        if missing:
+            if strict:
+                raise KeyError(
+                    f"convert: missing HF key {missing[0]!r}")
+            continue  # strict=False: skip this layer's experts
+        for kg, ku, kd in expert_keys:
+            g = _np(state_dict[kg]).T   # (h, f) gate proj
+            u = _np(state_dict[ku]).T   # (h, f) up proj
+            d = _np(state_dict[kd]).T   # (f, h) down proj
+            w0s.append(np.concatenate([g, u], axis=1))
+            w1s.append(d)
+            used.update((kg, ku, kd))
+        _assign(own[f"{base}.mlp.moe.w0"], np.stack(w0s),
+                f"{base}.mlp.moe.w0")
+        _assign(own[f"{base}.mlp.moe.w1"], np.stack(w1s),
+                f"{base}.mlp.moe.w1")
+
+    if strict:
+        _llama_strict_leftovers(state_dict, used, model)
+    return model

@@ -391,3 +391,37 @@ class TestT5Parity:
 import pytest as _pytest_tier
 
 pytestmark = _pytest_tier.mark.slow
+
+
+class TestMixtralParity:
+    """HF MixtralForCausalLM -> LlamaForCausalLM(mixtral config):
+    logit parity pins the router convention (softmax -> top-k ->
+    renormalize), the fused [gate|up] expert layout, and the w2
+    transpose. capacity_factor is raised so no token drops — HF
+    computes every selected expert exactly."""
+
+    def test_logits_match(self):
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256,
+            num_local_experts=4, num_experts_per_tok=2,
+            rms_norm_eps=1e-5, rope_theta=10000.0,
+            attn_implementation="eager",
+        )
+        torch.manual_seed(0)
+        hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+
+        from paddle_tpu.models import LlamaForCausalLM, mixtral_tiny
+
+        cfg = mixtral_tiny(moe_capacity_factor=4.0)
+        ours = LlamaForCausalLM(cfg)
+        from_hf(ours, hf.state_dict())
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 512, (2, 16))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(
+            ours(paddle.to_tensor(ids.astype("int32")))._data)
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
