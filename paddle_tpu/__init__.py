@@ -17,6 +17,21 @@ __version__ = "0.1.0"
 # device runtime in framework::InitDevices at import).
 import jax as _jax
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    # An EXPLICIT CPU request (host-side tooling: registry dumps, doc
+    # builds, analysis scripts) must win over a TPU plugin
+    # sitecustomize that force-sets the platform list — otherwise the
+    # device probe below blocks on a dead tunnel. In-process config
+    # override only: the environment is left intact so subprocesses
+    # (distributed launch workers copy os.environ) still see the
+    # plugin's pool settings.
+    try:
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover
+        pass
+
 try:
     _jax.devices()
 except Exception:  # pragma: no cover - no device available

@@ -540,6 +540,9 @@ def dump():
 
 
 if __name__ == "__main__":  # pragma: no cover
+    # run as `JAX_PLATFORMS=cpu python -m paddle_tpu.ops.op_table`:
+    # the package import honors the explicit CPU request (see
+    # paddle_tpu/__init__.py) so the dump never probes a TPU tunnel
     ops = list_ops()
     print(dump())
     print(f"# total: {len(ops)} ops")
