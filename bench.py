@@ -164,6 +164,17 @@ def _emit_final(headline, configs, stalled=False):
     full["git_rev"] = _git_rev()
     if stalled:
         full["stalled"] = True
+    try:
+        # per-program trace-time lint summaries (framework/analysis.py)
+        # for every step this run compiled — ride along in the detail
+        # artifact so BENCH_*.json rounds carry the hazard counts
+        from paddle_tpu.framework.analysis import live_lint_summaries
+
+        lint = live_lint_summaries()
+        if lint:
+            full["jit_lint"] = lint
+    except Exception:
+        pass
     _atomic_json_dump(_DETAIL_FILE, full)
 
     compact = {}

@@ -94,6 +94,18 @@ def named_sharding(*spec) -> Optional[NamedSharding]:
     return NamedSharding(m, PartitionSpec(*spec))
 
 
+def active_axis_info() -> dict:
+    """Introspection view of the active global mesh for tooling (the
+    jit linter's collective-axis checks, framework/analysis.py): axis
+    names, per-axis degrees, and total device count."""
+    m = global_mesh()
+    return {
+        "axes": set(m.axis_names) if m is not None else set(),
+        "degrees": dict(_GLOBAL.axis_degrees),
+        "n_devices": int(m.size) if m is not None else 1,
+    }
+
+
 def reset_mesh():
     _GLOBAL.mesh = None
     _GLOBAL.axis_degrees = {}

@@ -1,0 +1,843 @@
+"""Trace-time program linter: jaxpr hazard analysis for compiled steps.
+
+Upstream analog: CINN's graph passes and the static-graph checks that
+run over a Program before execution (paddle/fluid/framework/ir/*_pass).
+Here every ``@to_static`` program already materializes a closed jaxpr
+(jit/api.py) — this module walks it and reports the pathologies that
+otherwise only surface as slow steps or hangs on real TPUs:
+
+  rule id                    severity  hazard
+  -------------------------  --------  --------------------------------
+  dtype-drift                warning   bf16/fp16 operand promoted to
+                                       f32/f64 outside the accumulation
+                                       allowlist (silent upcast)
+  donation-miss              warning   large written-each-step state
+                                       buffer not donated (HBM copy)
+  collective-axis            critical  psum/all_gather/... over an axis
+                                       name absent from the active mesh
+  collective-branch          critical  collective in only some branches
+                                       of a cond (deadlock on TPU)
+  recompile-static-scalar    warning   python int/float argument in the
+                                       input-spec cache key (a retrace
+                                       per distinct value)
+  recompile-weak-scalar      info      weak-typed scalar closed over and
+                                       baked into the program as a const
+  recompile-cache-pressure   warning   one StaticFunction holding many
+                                       cache entries (spec churn)
+  unsharded-compute          warning   matmul/conv eqn above the FLOPs
+                                       threshold with every operand
+                                       replicated on a >1-device mesh
+
+Modes (FLAGS_jit_lint): ``off`` — analysis never runs, compiled
+programs are bit-for-bit unaffected; ``warn`` (default) — findings go
+to the report + VLOG(1), criticals also to the console; ``strict`` —
+any warning/critical finding raises ``JitLintError`` at compile time.
+
+Suppression: ``FLAGS_jit_lint_suppress="dtype-drift,..."`` globally,
+``@to_static(lint_suppress=("dtype-drift",))`` per function, or
+``paddle.jit.analyze(fn, suppress=(...))`` per analysis call.
+
+On-demand API: ``paddle.jit.analyze(fn_or_compiled, *example_args)``
+traces (without executing) and returns an ``AnalysisReport``.
+
+CLI: ``python -m paddle_tpu.framework.analysis script.py [--json out]``
+execs the script, collects every compiled StaticFunction, and prints
+(or dumps as JSON) the per-program reports.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+SEVERITIES = ("info", "warning", "critical")
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleDef:
+    rule_id: str
+    severity: str
+    summary: str
+
+
+RULES: Dict[str, RuleDef] = {}
+
+
+def _rule(rule_id: str, severity: str, summary: str) -> str:
+    RULES[rule_id] = RuleDef(rule_id, severity, summary)
+    return rule_id
+
+
+DTYPE_DRIFT = _rule(
+    "dtype-drift", "warning",
+    "bf16/fp16 operand promoted to float32/float64 outside the "
+    "accumulation allowlist")
+DONATION_MISS = _rule(
+    "donation-miss", "warning",
+    "large state buffer written each step but not donated into the "
+    "compiled program")
+COLLECTIVE_AXIS = _rule(
+    "collective-axis", "critical",
+    "collective over an axis name absent from the active mesh")
+COLLECTIVE_BRANCH = _rule(
+    "collective-branch", "critical",
+    "collective appears in only some branches of a cond "
+    "(deadlock hazard on TPU)")
+RECOMPILE_STATIC_SCALAR = _rule(
+    "recompile-static-scalar", "warning",
+    "python scalar argument keys the input-spec cache: every distinct "
+    "value pays a retrace/recompile")
+RECOMPILE_WEAK_SCALAR = _rule(
+    "recompile-weak-scalar", "info",
+    "weak-typed scalar constant closed over and baked into the program")
+RECOMPILE_CACHE_PRESSURE = _rule(
+    "recompile-cache-pressure", "warning",
+    "one compiled function holds many cache entries (input-spec churn)")
+UNSHARDED_COMPUTE = _rule(
+    "unsharded-compute", "warning",
+    "matmul/conv eqn above the FLOPs threshold with all operands "
+    "replicated on a multi-device mesh")
+
+# primitives allowed to consume low precision and produce wide floats:
+# numerically-motivated accumulation (the reference's CINN/AMP lists
+# keep reductions and MXU matmuls accumulating in fp32)
+DTYPE_ACCUM_ALLOWLIST = frozenset({
+    "dot_general", "conv_general_dilated", "reduce_sum", "reduce_max",
+    "reduce_min", "reduce_prod", "cumsum", "cumprod", "cumlogsumexp",
+    "reduce_precision",
+})
+
+_LOW_DTYPES = ("bfloat16", "float16")
+_WIDE_DTYPES = ("float32", "float64")
+
+# primitive names that lower to ICI collectives (psum2 is the
+# rewrite-inserted variant inside shard_map regions)
+_COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "pmax", "pmin", "ppermute", "pbroadcast",
+    "all_gather", "all_to_all", "reduce_scatter", "psum_scatter",
+    "axis_index", "pgather",
+})
+
+_MANUAL_REGION_PRIMS = frozenset({"shard_map", "xla_pmap", "pmap"})
+
+# findings per rule before aggregation into a single "...and N more"
+_MAX_PER_RULE = 8
+_CACHE_PRESSURE_N = 8
+
+
+class JitLintError(RuntimeError):
+    """Raised under FLAGS_jit_lint=strict when a compiled program has
+    warning/critical findings (compile-time failure, before any step
+    runs on the device)."""
+
+    def __init__(self, report: "AnalysisReport"):
+        self.report = report
+        super().__init__(
+            "jit lint (strict): %d blocking finding(s) in '%s'\n%s\n"
+            "Suppress individual rules with "
+            "FLAGS_jit_lint_suppress='<rule-id>,...' or "
+            "@to_static(lint_suppress=(...)), or set FLAGS_jit_lint=warn."
+            % (len(report.blocking()), report.name, report.format())
+        )
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: str
+    message: str
+    where: str = ""
+    suggestion: str = ""
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "severity": self.severity,
+             "message": self.message}
+        if self.where:
+            d["where"] = self.where
+        if self.suggestion:
+            d["suggestion"] = self.suggestion
+        return d
+
+
+class AnalysisReport:
+    """Structured result of one lint pass over a compiled program."""
+
+    def __init__(self, name: str, n_eqns: int = 0):
+        self.name = name
+        self.n_eqns = n_eqns
+        self.findings: List[Finding] = []
+        self.suppressed: Dict[str, int] = {}
+
+    # -- accumulation -------------------------------------------------
+    def add(self, rule: str, message: str, where: str = "",
+            suggestion: str = "", severity: str = ""):
+        self.findings.append(Finding(
+            rule, severity or RULES[rule].severity, message, where,
+            suggestion))
+
+    # -- queries ------------------------------------------------------
+    def by_severity(self, severity: str) -> List[Finding]:
+        return [f for f in self.findings if f.severity == severity]
+
+    def critical(self) -> List[Finding]:
+        return self.by_severity("critical")
+
+    def warnings(self) -> List[Finding]:
+        return self.by_severity("warning")
+
+    def blocking(self) -> List[Finding]:
+        """Findings that fail the program under FLAGS_jit_lint=strict."""
+        return [f for f in self.findings
+                if f.severity in ("warning", "critical")]
+
+    def counts(self) -> Dict[str, int]:
+        c = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            c[f.severity] += 1
+        return c
+
+    # -- serialization ------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "program": self.name,
+            "n_eqns": self.n_eqns,
+            "counts": self.counts(),
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": dict(self.suppressed),
+        }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+    def format(self) -> str:
+        if not self.findings and not self.suppressed:
+            return "  (clean)"
+        lines = []
+        for f in self.findings:
+            lines.append("  [%s] %s: %s" % (f.severity, f.rule, f.message))
+            if f.where:
+                lines.append("      at %s" % f.where)
+            if f.suggestion:
+                lines.append("      fix: %s" % f.suggestion)
+        for rid, n in sorted(self.suppressed.items()):
+            lines.append("  [suppressed] %s: %d finding(s)" % (rid, n))
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        c = self.counts()
+        return "AnalysisReport('%s', %d eqns, %d critical / %d warning " \
+            "/ %d info)\n%s" % (self.name, self.n_eqns, c["critical"],
+                                c["warning"], c["info"], self.format())
+
+    def __repr__(self) -> str:
+        return self.__str__()
+
+    @classmethod
+    def merge(cls, reports: Sequence["AnalysisReport"],
+              name: str = "") -> "AnalysisReport":
+        merged = cls(name or (reports[0].name if reports else "<empty>"))
+        for r in reports:
+            merged.n_eqns += r.n_eqns
+            merged.findings.extend(r.findings)
+            for k, v in r.suppressed.items():
+                merged.suppressed[k] = merged.suppressed.get(k, 0) + v
+        return merged
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(eqn):
+    import jax.extend.core as jex
+
+    out = []
+    for v in eqn.params.values():
+        for x in (v if isinstance(v, (tuple, list)) else (v,)):
+            if isinstance(x, jex.ClosedJaxpr):
+                out.append(x.jaxpr)
+            elif isinstance(x, jex.Jaxpr):
+                out.append(x)
+    return out
+
+
+def _walk(jaxpr, path: str = "", manual: int = 0, acc=None):
+    """Flatten a jaxpr (recursing into cond/scan/pjit/shard_map bodies)
+    into (eqn, path, manual_region_depth) triples."""
+    if acc is None:
+        acc = []
+    for i, eqn in enumerate(jaxpr.eqns):
+        name = eqn.primitive.name
+        p = "%seqns[%d]:%s" % (path, i, name)
+        acc.append((eqn, p, manual))
+        m2 = manual + (1 if name in _MANUAL_REGION_PRIMS else 0)
+        for sub in _sub_jaxprs(eqn):
+            _walk(sub, p + "/", m2, acc)
+    return acc
+
+
+def _aval_dtype(v) -> str:
+    aval = getattr(v, "aval", None)
+    dt = getattr(aval, "dtype", None)
+    return str(dt) if dt is not None else ""
+
+
+def _aval_shape(v) -> Tuple[int, ...]:
+    aval = getattr(v, "aval", None)
+    return tuple(getattr(aval, "shape", ()) or ())
+
+
+def _collective_axes(eqn) -> Tuple[str, ...]:
+    """Normalize the axis-name payload of a collective eqn (params are
+    'axes' on psum-family, 'axis_name' on the rest; values are a str or
+    a tuple mixing names and positional ints)."""
+    raw = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(raw, (str,)):
+        raw = (raw,)
+    return tuple(a for a in raw if isinstance(a, str))
+
+
+def _collectives_in(jaxpr) -> set:
+    sigs = set()
+    for eqn, _, _ in _walk(jaxpr):
+        if eqn.primitive.name in _COLLECTIVE_PRIMS:
+            sigs.add((eqn.primitive.name, _collective_axes(eqn)))
+    return sigs
+
+
+def _prod(xs) -> float:
+    out = 1.0
+    for x in xs:
+        out *= float(x)
+    return out
+
+
+def _table_matmul_flops(b: float, m: float, n: float, k: float):
+    """Route the dot FLOPs count through the op table's estimator
+    (ops/op_table.py OpDef.flops) so the linter and API-level reporting
+    share one formula; falls back to the closed form if the registry is
+    unavailable (partial import)."""
+    try:
+        from ..ops import op_table
+
+        od = op_table.get_op("matmul")
+        if od is not None and od.flops is not None:
+            return od.flops(((int(b * m), int(k)), (int(k), int(n))))
+    except Exception:
+        pass
+    return 2.0 * b * m * n * k
+
+
+def _eqn_flops(eqn) -> float:
+    """Static FLOPs estimate for the compute-heavy primitives."""
+    name = eqn.primitive.name
+    if name == "dot_general":
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lhs, rhs = _aval_shape(eqn.invars[0]), _aval_shape(eqn.invars[1])
+        if not lhs or not rhs:
+            return 0.0
+        batch = _prod(lhs[i] for i in lb)
+        k = _prod(lhs[i] for i in lc)
+        m = _prod(lhs[i] for i in range(len(lhs))
+                  if i not in set(lc) | set(lb))
+        n = _prod(rhs[i] for i in range(len(rhs))
+                  if i not in set(rc) | set(rb))
+        return float(_table_matmul_flops(batch, m, n, k))
+    if name == "conv_general_dilated":
+        out = _aval_shape(eqn.outvars[0])
+        kernel = _aval_shape(eqn.invars[1])
+        if not out or len(kernel) < 3:
+            return 0.0
+        groups = int(eqn.params.get("feature_group_count", 1) or 1)
+        # out already includes batch/out-channel/spatial; multiply by
+        # the per-output-element dot length: Cin/g * prod(k_spatial)
+        return 2.0 * _prod(out) * float(kernel[1]) * _prod(kernel[2:]) \
+            / max(groups, 1)
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# suppression plumbing
+# ---------------------------------------------------------------------------
+
+def _flag(name, default=None):
+    try:
+        from .flags import flag
+
+        return flag(name)
+    except Exception:
+        return default
+
+
+def resolve_suppressions(extra: Sequence[str] = ()) -> set:
+    """Union of FLAGS_jit_lint_suppress and per-call suppressions.
+    Unknown ids passed explicitly raise (typo guard); unknown ids in
+    the flag are ignored with a VLOG note (env-set, can't raise)."""
+    sup = set()
+    for rid in (s.strip() for s in str(
+            _flag("jit_lint_suppress", "") or "").split(",")):
+        if not rid:
+            continue
+        if rid in RULES:
+            sup.add(rid)
+        else:
+            _vlog(1, "jit_lint: unknown rule id %r in "
+                  "FLAGS_jit_lint_suppress (known: %s)", rid,
+                  ", ".join(sorted(RULES)))
+    for rid in extra:
+        if rid not in RULES:
+            raise ValueError(
+                "unknown lint rule id %r (known: %s)"
+                % (rid, ", ".join(sorted(RULES))))
+        sup.add(rid)
+    return sup
+
+
+def _vlog(level, msg, *args):
+    try:
+        from .log import VLOG
+
+        VLOG(level, msg, *args, module="framework.analysis")
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+class _RuleLimiter:
+    """Caps per-rule findings at _MAX_PER_RULE, folding the overflow
+    into one aggregate entry (a 100-layer model would otherwise emit a
+    finding per layer)."""
+
+    def __init__(self, report: AnalysisReport, suppress: set):
+        self.report = report
+        self.suppress = suppress
+        self.counts: Dict[str, int] = {}
+        self.overflow: Dict[str, int] = {}
+
+    def add(self, rule, message, where="", suggestion="", severity=""):
+        if rule in self.suppress:
+            self.report.suppressed[rule] = \
+                self.report.suppressed.get(rule, 0) + 1
+            return
+        n = self.counts.get(rule, 0)
+        self.counts[rule] = n + 1
+        if n < _MAX_PER_RULE:
+            self.report.add(rule, message, where, suggestion, severity)
+        else:
+            self.overflow[rule] = self.overflow.get(rule, 0) + 1
+
+    def finish(self):
+        for rule, n in sorted(self.overflow.items()):
+            self.report.add(rule, "... and %d more %s finding(s) "
+                            "(first %d shown)" % (n, rule, _MAX_PER_RULE))
+
+
+def _check_dtype_drift(items, out: _RuleLimiter):
+    for eqn, path, _ in items:
+        name = eqn.primitive.name
+        if name in DTYPE_ACCUM_ALLOWLIST:
+            continue
+        in_dts = {_aval_dtype(v) for v in eqn.invars}
+        if not in_dts.intersection(_LOW_DTYPES):
+            continue
+        out_wide = [dt for dt in (_aval_dtype(v) for v in eqn.outvars)
+                    if dt in _WIDE_DTYPES]
+        if not out_wide:
+            continue
+        low = sorted(in_dts.intersection(_LOW_DTYPES))[0]
+        out.add(
+            DTYPE_DRIFT,
+            "%s promotes %s -> %s outside the accumulation allowlist "
+            "(silent upcast: 2x HBM traffic and MXU downgrade on the "
+            "wide path)" % (name, low, out_wide[0]),
+            where=path,
+            suggestion="keep the op in %s (check python-scalar operands "
+            "and explicit .astype casts), or suppress 'dtype-drift' if "
+            "the upcast is an intentional accumulation" % low,
+        )
+
+
+def _check_collectives(items, mesh_axes: Optional[set],
+                       out: _RuleLimiter):
+    for eqn, path, _ in items:
+        name = eqn.primitive.name
+        if name not in _COLLECTIVE_PRIMS:
+            continue
+        for ax in _collective_axes(eqn):
+            if mesh_axes is None or ax not in mesh_axes:
+                have = "no mesh is active" if not mesh_axes else \
+                    "active mesh has axes %s" % sorted(mesh_axes)
+                out.add(
+                    COLLECTIVE_AXIS,
+                    "%s over axis %r but %s — on TPU this program "
+                    "cannot lower (or lowers against a stale mesh)"
+                    % (name, ax, have),
+                    where=path,
+                    suggestion="build the global mesh with this axis "
+                    "before tracing (distributed.mesh."
+                    "build_global_mesh) or fix the axis name",
+                )
+
+
+def _check_cond_branches(items, out: _RuleLimiter):
+    for eqn, path, _ in items:
+        if eqn.primitive.name != "cond":
+            continue
+        branches = eqn.params.get("branches") or ()
+        per_branch = []
+        for br in branches:
+            j = br.jaxpr if hasattr(br, "jaxpr") else br
+            per_branch.append(_collectives_in(j))
+        if len(per_branch) < 2:
+            continue
+        union = set().union(*per_branch)
+        inter = set.intersection(*per_branch)
+        for prim, axes in sorted(union - inter):
+            missing = [i for i, s in enumerate(per_branch)
+                       if (prim, axes) not in s]
+            out.add(
+                COLLECTIVE_BRANCH,
+                "%s over %s appears in only some branches of this cond "
+                "(missing from branch %s): devices taking different "
+                "branches deadlock on TPU"
+                % (prim, list(axes) or "<implicit>", missing),
+                where=path,
+                suggestion="hoist the collective out of the cond, or "
+                "make every branch perform the same collectives in the "
+                "same order",
+            )
+
+
+def _check_unsharded_compute(items, mesh_info: dict,
+                             out: _RuleLimiter):
+    n_dev = int(mesh_info.get("n_devices", 1) or 1)
+    if n_dev <= 1:
+        return
+    # a program that constrains sharding anywhere is GSPMD-partitioned;
+    # without whole-program propagation we only flag the fully
+    # replicated case (no constraint eqns, outside manual regions)
+    if any(eqn.primitive.name == "sharding_constraint"
+           for eqn, _, _ in items):
+        return
+    threshold = float(_flag("jit_lint_flops_threshold", 1e10) or 1e10)
+    for eqn, path, manual in items:
+        if manual:
+            continue
+        flops = _eqn_flops(eqn)
+        if flops <= threshold:
+            continue
+        out.add(
+            UNSHARDED_COMPUTE,
+            "%s runs %.3g FLOPs with all operands replicated on a "
+            "%d-device mesh (threshold %.3g): every chip repeats the "
+            "full computation" % (eqn.primitive.name, flops, n_dev,
+                                  threshold),
+            where=path,
+            suggestion="shard an operand over a mesh axis "
+            "(shard_tensor / with_sharding_constraint) or run the op "
+            "inside a manual shard_map region",
+        )
+
+
+def _check_weak_consts(closed, out: _RuleLimiter):
+    constvars = getattr(closed.jaxpr, "constvars", ())
+    for i, v in enumerate(constvars):
+        aval = getattr(v, "aval", None)
+        if aval is None or getattr(aval, "shape", None) != ():
+            continue
+        if not getattr(aval, "weak_type", False):
+            continue
+        try:
+            val = closed.consts[i]
+        except Exception:
+            val = "?"
+        out.add(
+            RECOMPILE_WEAK_SCALAR,
+            "weak-typed scalar constant %r (%s) is closed over and "
+            "baked into the program: changing the python value will "
+            "NOT change the compiled step, and weak promotion can "
+            "shift op dtypes" % (val, _aval_dtype(v)),
+            suggestion="pass the scalar as a Tensor argument, or pin "
+            "it with an explicit dtype (e.g. np.float32(x))",
+        )
+
+
+def _check_static_scalars(static_meta, t_shapes, out: _RuleLimiter):
+    dims = set()
+    for shp in t_shapes or ():
+        dims.update(int(d) for d in shp)
+    for pos, typename, value in static_meta or ():
+        if typename not in ("int", "float"):
+            continue
+        shape_leak = typename == "int" and value is not None \
+            and int(value) in dims and int(value) > 1
+        extra = (" — the value matches a traced input dimension, a "
+                 "likely python-int shape leak") if shape_leak else ""
+        out.add(
+            RECOMPILE_STATIC_SCALAR,
+            "argument leaf %d is a python %s (%r): it keys the "
+            "input-spec cache, so every distinct value pays a full "
+            "retrace + recompile%s" % (pos, typename, value, extra),
+            suggestion="pass it as a Tensor (traced, one compile) or "
+            "derive it from tensor shapes inside the function",
+        )
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def analyze_jaxpr(closed, *, name: str = "<jaxpr>",
+                  mesh_axes: Optional[set] = None,
+                  mesh_devices: Optional[int] = None,
+                  suppress: Sequence[str] = (),
+                  static_meta=None, t_shapes=None,
+                  donation=None) -> AnalysisReport:
+    """Lint a ClosedJaxpr. ``mesh_axes``/``mesh_devices`` default to the
+    active global mesh (distributed/mesh.py); ``donation`` is an
+    optional dict from the jit/api donation logic (see
+    lint_static_entry)."""
+    mesh_info = {"axes": mesh_axes, "n_devices": mesh_devices}
+    if mesh_axes is None or mesh_devices is None:
+        try:
+            from ..distributed.mesh import active_axis_info
+
+            live = active_axis_info()
+        except Exception:
+            live = {"axes": set(), "n_devices": 1}
+        if mesh_axes is None:
+            mesh_info["axes"] = live["axes"]
+        if mesh_devices is None:
+            mesh_info["n_devices"] = live["n_devices"]
+
+    items = _walk(closed.jaxpr)
+    report = AnalysisReport(name, n_eqns=len(items))
+    out = _RuleLimiter(report, resolve_suppressions(suppress))
+
+    _check_dtype_drift(items, out)
+    _check_collectives(items, mesh_info["axes"], out)
+    _check_cond_branches(items, out)
+    _check_unsharded_compute(items, mesh_info, out)
+    _check_weak_consts(closed, out)
+    _check_static_scalars(static_meta, t_shapes, out)
+    if donation:
+        _check_donation(donation, out)
+
+    out.finish()
+    return report
+
+
+def _check_donation(donation: dict, out: _RuleLimiter):
+    """donation dict (from lint_static_entry): intent (donate_state
+    arg), active (donation actually applied), backend, and the written
+    (rw) buffers as (name, nbytes). Respects the CPU-backend skip in
+    jit/api.py: donation intentionally off on cpu is not a finding."""
+    threshold = int(_flag("jit_lint_donation_min_bytes", 1 << 20)
+                    or (1 << 20))
+    if donation.get("active"):
+        return  # every written buffer is donated (donate_argnums=(0,))
+    if donation.get("intent") and donation.get("backend") == "cpu":
+        return  # the deliberate cpu skip (jit/api.py donate guard)
+    offenders = [(nm, nb) for nm, nb in donation.get("rw_buffers", ())
+                 if nb >= threshold]
+    if not offenders:
+        return
+    offenders.sort(key=lambda p: -p[1])
+    total_mb = sum(nb for _, nb in offenders) / 2**20
+    head = ", ".join("%s (%.1f MiB)" % (nm, nb / 2**20)
+                     for nm, nb in offenders[:4])
+    more = "" if len(offenders) <= 4 else \
+        ", +%d more" % (len(offenders) - 4)
+    out.add(
+        DONATION_MISS,
+        "%d state buffer(s) totalling %.1f MiB are written every step "
+        "but not donated (%s%s): each step keeps a second HBM copy "
+        "alive and pays a device-to-device write"
+        % (len(offenders), total_mb, head, more),
+        suggestion="drop donate_state=False from @to_static (donation "
+        "is safe: written state is aliased into its own output slot)",
+    )
+
+
+def lint_static_entry(static_fn, entry,
+                      suppress: Sequence[str] = ()) -> AnalysisReport:
+    """Lint one finalized StaticFunction cache entry (jit/api.py) —
+    the pruned jaxpr plus the donation/cache context only the
+    StaticFunction knows."""
+    import jax
+
+    name = getattr(static_fn, "__name__", None) or getattr(
+        getattr(static_fn, "_fn", None), "__name__", "<to_static>")
+    state_meta = entry.get("state_meta") or {}
+    rw_buffers = [state_meta[i] for i in entry.get("rw_idx", ())
+                  if i in state_meta]
+    donation = {
+        "intent": bool(entry.get("donate_intent", True)),
+        "active": bool(entry.get("donates")),
+        "backend": jax.default_backend(),
+        "rw_buffers": rw_buffers,
+    }
+    extra = tuple(suppress) + tuple(
+        getattr(static_fn, "_lint_suppress", ()) or ())
+    report = analyze_jaxpr(
+        entry["pruned_jaxpr"], name=name, suppress=extra,
+        static_meta=entry.get("static_meta"),
+        t_shapes=entry.get("t_shapes"), donation=donation)
+    n_entries = len(getattr(static_fn, "_cache", ()) or ())
+    if n_entries >= _CACHE_PRESSURE_N:
+        limiter = _RuleLimiter(report, resolve_suppressions(extra))
+        limiter.add(
+            RECOMPILE_CACHE_PRESSURE,
+            "'%s' holds %d compiled cache entries: the input-spec "
+            "cache is churning (varying shapes, python scalars, or "
+            "flag flips)" % (name, n_entries),
+            suggestion="pad inputs to bucketed shapes and pass python "
+            "scalars as Tensors",
+        )
+        limiter.finish()
+    return report
+
+
+def emit_report(report: AnalysisReport, mode: str):
+    """Route a report per FLAGS_jit_lint: VLOG(1) for everything,
+    console warning for criticals under 'warn', JitLintError under
+    'strict' when any warning/critical finding survived."""
+    for f in report.findings:
+        _vlog(1, "jit_lint[%s] %s %s: %s", report.name, f.severity,
+              f.rule, f.message)
+    crits = report.critical()
+    if mode == "strict" and report.blocking():
+        raise JitLintError(report)
+    if crits:
+        try:
+            from .log import LOG
+
+            LOG("warning",
+                "jit_lint: %d CRITICAL finding(s) in compiled program "
+                "'%s' (FLAGS_jit_lint=strict to fail the compile):\n%s",
+                len(crits), report.name,
+                "\n".join("  %s: %s" % (f.rule, f.message)
+                          for f in crits))
+        except Exception:
+            pass
+
+
+def live_lint_summaries() -> List[dict]:
+    """Compact per-program lint summaries for every compiled
+    StaticFunction alive in the process — attached by bench.py /
+    tools/roofline.py to their JSON artifacts. Honors
+    FLAGS_jit_lint=off ('off skips analysis entirely'): returns no
+    rows and runs no late lint passes."""
+    out = []
+    if _flag("jit_lint", "warn") == "off":
+        return out
+    try:
+        from ..jit.api import live_static_functions
+    except Exception:
+        return out
+    for sf in live_static_functions():
+        for entry in sf._finalized_entries():
+            rep = entry.get("lint_report")
+            if rep is None:
+                try:
+                    rep = lint_static_entry(sf, entry)
+                except Exception:
+                    continue
+            row = {"program": rep.name, "n_eqns": rep.n_eqns}
+            row.update(rep.counts())
+            rules = {}
+            for f in rep.findings:
+                rules[f.rule] = rules.get(f.rule, 0) + 1
+            if rules:
+                row["rules"] = rules
+            out.append(row)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m paddle_tpu.framework.analysis script.py [--json out]
+# ---------------------------------------------------------------------------
+
+def _cli_collect_reports(suppress):
+    from ..jit.api import live_static_functions
+
+    reports = []
+    for sf in live_static_functions():
+        for entry in sf._finalized_entries():
+            reports.append(lint_static_entry(sf, entry,
+                                             suppress=suppress))
+    return reports
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+    import runpy
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.framework.analysis",
+        description="Lint the compiled (@to_static) programs an "
+        "entrypoint builds. The script is exec'd (not as __main__); "
+        "if it compiles nothing at import, its main() is called. "
+        "Run host-side with JAX_PLATFORMS=cpu.")
+    ap.add_argument("entrypoint",
+                    help="script path, optionally :callable to invoke "
+                    "after import (default tries main())")
+    ap.add_argument("--json", metavar="PATH", default="",
+                    help="write the full report list as JSON "
+                    "('-' for stdout)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any warning/critical finding "
+                    "(default: only criticals fail)")
+    ap.add_argument("--suppress", default="",
+                    help="comma-separated rule ids to suppress")
+    args = ap.parse_args(argv)
+
+    entry, fn_name = args.entrypoint, ""
+    if ":" in entry and not os.path.exists(entry):
+        entry, fn_name = entry.rsplit(":", 1)
+    suppress = tuple(s for s in args.suppress.split(",") if s)
+
+    ns = runpy.run_path(entry, run_name="__jit_lint__")
+    target = ns.get(fn_name or "main")
+    if fn_name and target is None:
+        print("error: %r has no callable %r" % (entry, fn_name),
+              file=sys.stderr)
+        return 2
+    reports = _cli_collect_reports(suppress)
+    if callable(target) and not reports:
+        target()
+        reports = _cli_collect_reports(suppress)
+
+    if not reports:
+        print("no compiled @to_static programs found in %r (call the "
+              "compiled step at import, or expose main())" % entry,
+              file=sys.stderr)
+        return 2
+
+    payload = {"version": 1, "entrypoint": args.entrypoint,
+               "programs": [r.to_dict() for r in reports]}
+    if args.json == "-":
+        print(json.dumps(payload, indent=1))
+    else:
+        for r in reports:
+            print(r)
+            print()
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(payload, f, indent=1)
+            print("wrote %s" % args.json)
+
+    n_crit = sum(len(r.critical()) for r in reports)
+    n_block = sum(len(r.blocking()) for r in reports)
+    return 1 if (n_crit or (args.strict and n_block)) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

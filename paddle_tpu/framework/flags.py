@@ -100,6 +100,24 @@ define_flag("compilation_cache_dir", "",
             "~/.cache/paddle_tpu/xla_cache; 'off' disables). Analog of "
             "the reference persisting optimized inference programs "
             "(paddle/fluid/inference/api/analysis_predictor.cc)")
+define_flag("jit_lint", "warn",
+            "trace-time jaxpr linter over @to_static programs "
+            "(framework/analysis.py): 'off' skips analysis entirely, "
+            "'warn' logs findings (criticals to the console, the rest "
+            "to VLOG(1)), 'strict' raises JitLintError at compile on "
+            "any warning/critical finding")
+define_flag("jit_lint_suppress", "",
+            "comma-separated lint rule ids to suppress globally "
+            "(e.g. 'dtype-drift,donation-miss'; see "
+            "framework/analysis.RULES for the id list)")
+define_flag("jit_lint_donation_min_bytes", 1 << 20,
+            "donation-miss threshold: written-each-step state buffers "
+            "at least this large must be donated into the compiled "
+            "step (jit/api.py donate_argnums) or the rule fires")
+define_flag("jit_lint_flops_threshold", 1e10,
+            "unsharded-compute threshold: a single matmul/conv eqn "
+            "above this many FLOPs with every operand replicated on a "
+            ">1-device mesh fires the rule")
 define_flag("moe_dense_dispatch", False,
             "route MoE tokens via the dense (N,E,C) one-hot "
             "dispatch/combine einsums instead of the sparse index "

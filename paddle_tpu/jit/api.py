@@ -31,9 +31,20 @@ import numpy as np
 
 from ..framework import state as _registry
 from ..framework.core import EagerParamBase, Tensor
+from ..framework.flags import flag
 
 
 _CACHE_WIRED = False
+
+# every constructed StaticFunction, for process-wide lint reporting
+# (framework/analysis.py live_lint_summaries + the analysis CLI)
+import weakref
+
+_LIVE_STATICS: "weakref.WeakSet[StaticFunction]" = weakref.WeakSet()
+
+
+def live_static_functions():
+    return list(_LIVE_STATICS)
 
 
 def ensure_compilation_cache():
@@ -89,7 +100,7 @@ def _is_arr(x):
 class StaticFunction:
     def __init__(self, fn, input_spec=None, build_strategy=None,
                  backend=None, full_graph=True, property=False,
-                 donate_state=True):
+                 donate_state=True, lint_suppress=()):
         functools.update_wrapper(self, fn)
         from .dy2static import convert_control_flow
 
@@ -97,6 +108,8 @@ class StaticFunction:
         self._input_spec = input_spec
         self._cache = {}
         self._donate = donate_state
+        self._lint_suppress = tuple(lint_suppress)
+        _LIVE_STATICS.add(self)
 
     # flags that change what gets traced (kernel selection, nan checks).
     # Others (allocator_strategy, log_level, ...) are runtime-only: keying
@@ -121,7 +134,11 @@ class StaticFunction:
             tuple((k, _flags[k]) for k in self._TRACE_FLAGS),
         )
 
-    def __call__(self, *args, **kwargs):
+    def _prepare(self, args, kwargs):
+        """Flatten args, snapshot state, and resolve (or build) the
+        cache entry for this (args, state) signature — everything
+        __call__ does short of finalizing/executing. Shared with the
+        no-execute analysis path (paddle.jit.analyze)."""
         arg_leaves, arg_tree = _tree_flatten((args, kwargs))
         leaf_is_tensor = [isinstance(l, Tensor) for l in arg_leaves]
         tensor_raws = [
@@ -168,9 +185,41 @@ class StaticFunction:
                 state, arg_tree, leaf_is_tensor, static_leaves, arg_sg
             )
             self._cache[key] = entry
+        return entry, state, tensor_raws
 
+    def _finalized_entries(self):
+        return [e for e in self._cache.values() if "jitted" in e]
+
+    def trace_for_analysis(self, *args, **kwargs):
+        """Build + finalize (trace, prune — no compile, no execution)
+        the cache entry for example args; returns the entry. The
+        automatic lint hook is skipped: the caller (paddle.jit.analyze)
+        runs its own analysis with its own suppressions and must get a
+        report back regardless of FLAGS_jit_lint."""
+        entry, state, tensor_raws = self._prepare(args, kwargs)
+        if "jitted" not in entry:
+            self._finalize_entry(entry, state, tensor_raws, lint=False)
+        return entry
+
+    def __call__(self, *args, **kwargs):
+        entry, state, tensor_raws = self._prepare(args, kwargs)
         if "jitted" not in entry:
             self._finalize_entry(entry, state, tensor_raws)
+        elif flag("jit_lint") == "strict":
+            # entry may have been finalized under warn/off (or via
+            # trace_for_analysis) before the flag flipped — strict must
+            # keep failing on every call, linting now if it never ran
+            from ..framework import analysis
+
+            rep = entry.get("lint_report")
+            if rep is None:
+                try:
+                    rep = analysis.lint_static_entry(self, entry)
+                    entry["lint_report"] = rep
+                except Exception:
+                    rep = None
+            if rep is not None and rep.blocking():
+                raise analysis.JitLintError(rep)
         rw_raws = [state[i]._data for i in entry["rw_idx"]]
         ro_raws = [state[i]._data for i in entry["ro_idx"]]
         if entry.get("donates"):
@@ -282,9 +331,19 @@ class StaticFunction:
                     t._data = d
                     t._grad = g
 
-        return {"pure": pure, "aux": aux, "n_state": len(state)}
+        return {
+            "pure": pure, "aux": aux, "n_state": len(state),
+            # python-scalar args for the linter's recompilation checks
+            # (values only — no object refs pinned)
+            "static_meta": [
+                (i, type(l).__name__,
+                 l if isinstance(l, (int, float, bool)) else None)
+                for i, l in enumerate(static_leaves)
+                if l is not None and not isinstance(l, str)
+            ],
+        }
 
-    def _finalize_entry(self, entry, state, tensor_raws):
+    def _finalize_entry(self, entry, state, tensor_raws, lint=True):
         """Trace ``pure`` once (no compile), then DEAD-STRIP the state:
         the registry snapshot is global, so an unrelated live model's
         params would otherwise ride through every compiled step — extra
@@ -336,9 +395,13 @@ class StaticFunction:
         kept_order = {i: pos for pos, i in enumerate(kept_state_idx)}
         kept_in = [state_in[i] for i in kept_state_idx] \
             + list(j.invars[n_s:])
+        # debug_info names the ORIGINAL invars/outvars; after the
+        # dead-strip their counts differ and Jaxpr.__init__ asserts.
+        # It is cosmetic (pretty-printing) — drop it for the pruned
+        # program rather than fabricating per-slot names.
         pruned = jex.ClosedJaxpr(
             jex.Jaxpr(j.constvars, kept_in, kept_out, j.eqns, j.effects,
-                      debug_info=j.debug_info),
+                      debug_info=None),
             closed.consts)
         fn = jex.jaxpr_as_fun(pruned)
         n_changed = len(changed_idx)
@@ -371,6 +434,36 @@ class StaticFunction:
         # (zombies included) — drop it now that the jaxpr is the program
         del entry["pure"]
 
+        # context the trace-time linter (framework/analysis.py) needs
+        # beyond the jaxpr itself: buffer names/sizes for the donation
+        # rule, input shapes for the shape-leak heuristic. Metadata
+        # only — the compiled program above is untouched.
+        entry["state_meta"] = {
+            i: (state[i].name,
+                int(np.prod(state[i]._data.shape))
+                * state[i]._data.dtype.itemsize)
+            for i in kept_state_idx
+        }
+        entry["t_shapes"] = [tuple(r.shape) for r in tensor_raws]
+        entry["donate_intent"] = self._donate
+
+        mode = flag("jit_lint")
+        if lint and mode != "off":
+            from ..framework import analysis
+
+            report = None
+            try:
+                report = analysis.lint_static_entry(self, entry)
+                entry["lint_report"] = report
+            except Exception as e:  # the linter must never break a
+                # compile — strict failures are raised below, not here
+                from ..framework.log import VLOG
+
+                VLOG(1, "jit_lint: analysis failed: %r", e,
+                     module="jit.api")
+            if report is not None:
+                analysis.emit_report(report, mode)
+
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, **kwargs):
@@ -384,6 +477,47 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     if function is not None:
         return decorate(function)
     return decorate
+
+
+def analyze(function, *example_args, suppress=(), **example_kwargs):
+    """Run the trace-time linter (framework/analysis.py) on a compiled
+    function and return an ``AnalysisReport`` — without executing it.
+
+    * ``analyze(static_fn)`` — lint every program variant the
+      ``@to_static`` function has already compiled;
+    * ``analyze(fn_or_static_fn, *example_args)`` — trace the function
+      against the example inputs (array-likes are promoted to Tensors,
+      shapes/dtypes are what matter) and lint the resulting program.
+
+    Runs regardless of FLAGS_jit_lint (the flag only governs the
+    automatic compile-time hook); ``suppress`` silences rule ids for
+    this call."""
+    from ..framework import analysis
+
+    sf = function if isinstance(function, StaticFunction) \
+        else StaticFunction(function)
+    if example_args or example_kwargs:
+        def as_tensor(x):
+            return Tensor(x) if _is_arr(x) and not isinstance(x, Tensor) \
+                else x
+
+        args = tuple(as_tensor(a) for a in example_args)
+        kwargs = {k: as_tensor(v) for k, v in example_kwargs.items()}
+        entries = [sf.trace_for_analysis(*args, **kwargs)]
+    else:
+        entries = sf._finalized_entries()
+        if not entries:
+            raise ValueError(
+                "analyze(fn) without example args needs an already-"
+                "compiled @to_static function (call it once, or pass "
+                "example inputs: analyze(fn, x, y))"
+            )
+    reports = [analysis.lint_static_entry(sf, e, suppress=suppress)
+               for e in entries]
+    if len(reports) == 1:
+        return reports[0]
+    return analysis.AnalysisReport.merge(
+        reports, name=reports[0].name + " (%d variants)" % len(reports))
 
 
 def not_to_static(fn=None):

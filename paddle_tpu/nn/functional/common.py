@@ -80,7 +80,10 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     x = _as_tensor(x)
     if isinstance(pad, Tensor):
-        pad = [int(v) for v in np.asarray(pad._data)]
+        # eager-only: a Tensor-valued pad spec must collapse to python
+        # ints (jnp.pad takes static config); under trace this op
+        # requires a list/tuple pad
+        pad = [int(v) for v in np.asarray(pad._data)]  # trace-lint: ok(eager-only pad spec)
     pad = [int(p) for p in pad]
 
     nd = x.ndim
@@ -154,7 +157,8 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     in_spatial = x.shape[2:] if nchw else x.shape[1:-1]
     if size is not None:
         if isinstance(size, Tensor):
-            size = [int(v) for v in np.asarray(size._data)]
+            # eager-only: output size must be static for jax.image
+            size = [int(v) for v in np.asarray(size._data)]  # trace-lint: ok(eager-only size spec)
         out_spatial = [
             int(s.item()) if isinstance(s, Tensor) else int(s) for s in (
                 size if isinstance(size, (list, tuple)) else [size]

@@ -30,6 +30,12 @@ class OpDef:
     notes: str = ""
     declared: bool = False       # metadata explicitly declared below
     sweep_waiver: str = ""       # non-empty: why the op-suite skips it
+    # optional FLOPs estimator: flops(shapes, **kw) -> float, where
+    # shapes is a sequence of operand shapes. Backfilled from
+    # _FLOPS_ESTIMATORS for the compute-heavy ops; consumed by the
+    # trace-time linter's unsharded-compute rule
+    # (framework/analysis.py) and available for API-level reporting.
+    flops: Optional[Callable] = None
 
     @property
     def signature(self):
@@ -42,9 +48,72 @@ class OpDef:
 _TABLE: dict = {}
 
 
+def _prod(xs):
+    out = 1.0
+    for x in xs:
+        out *= float(x)
+    return out
+
+
+def _mm_flops(shapes, **kw):
+    """Stacked-matmul FLOPs: leading dims broadcast-batch, contract
+    lhs[-1] with rhs[-2] (paddle.matmul semantics)."""
+    a, b = tuple(shapes[0]), tuple(shapes[1])
+    m = a[-2] if len(a) >= 2 else 1
+    k = a[-1]
+    n = b[-1] if len(b) >= 2 else 1
+    batch = max(_prod(a[:-2]), _prod(b[:-2]), 1.0)
+    return 2.0 * batch * m * n * k
+
+
+def _linear_flops(shapes, **kw):
+    x, w = tuple(shapes[0]), tuple(shapes[1])
+    return 2.0 * _prod(x[:-1]) * x[-1] * w[-1]
+
+
+def _conv_flops(shapes, **kw):
+    """Direct-conv FLOPs, stride-1 'same' output assumed (an estimate:
+    exact spatial dims need stride/pad/dilation). x: (N, Cin, *sp),
+    w: (Cout, Cin/groups, *k)."""
+    x, w = tuple(shapes[0]), tuple(shapes[1])
+    return 2.0 * x[0] * _prod(x[2:]) * w[0] * w[1] * _prod(w[2:])
+
+
+def _attention_flops(shapes, **kw):
+    """QK^T + PV FLOPs for (batch, seq, heads, head_dim) q/k layouts
+    (flash_attention / SDPA convention in nn/functional)."""
+    q, k = tuple(shapes[0]), tuple(shapes[1])
+    b, sq, h, d = q[0], q[1], q[2], q[3]
+    sk = k[1]
+    return 4.0 * b * h * sq * sk * d
+
+
+# backfill for the compute-heavy ops (matmul/conv/attention families);
+# everything else keeps flops=None ("no estimator declared")
+_FLOPS_ESTIMATORS = {
+    "matmul": _mm_flops,
+    "mm": _mm_flops,
+    "bmm": _mm_flops,
+    "addmm": _mm_flops,
+    "linear": _linear_flops,
+    "fused_linear": _linear_flops,
+    "conv1d": _conv_flops,
+    "conv2d": _conv_flops,
+    "conv3d": _conv_flops,
+    "conv1d_transpose": _conv_flops,
+    "conv2d_transpose": _conv_flops,
+    "conv3d_transpose": _conv_flops,
+    "flash_attention": _attention_flops,
+    "scaled_dot_product_attention": _attention_flops,
+    "fused_multi_head_attention": _attention_flops,
+    "fused_dot_product_attention": _attention_flops,
+}
+
+
 def register(name, fn, module, differentiable=True, dtypes=_FLOAT,
-             notes=""):
-    _TABLE[name] = OpDef(name, fn, module, differentiable, dtypes, notes)
+             notes="", flops=None):
+    _TABLE[name] = OpDef(name, fn, module, differentiable, dtypes, notes,
+                         flops=flops or _FLOPS_ESTIMATORS.get(name))
 
 
 def get_op(name) -> Optional[OpDef]:
@@ -440,6 +509,37 @@ def undeclared_ops():
     (_NONDIFF/_CREATION membership or a sweep waiver)."""
     _populate()
     return sorted(o.name for o in _TABLE.values() if not o.declared)
+
+
+def nearest_registered(name, pool=None):
+    """Closest registered (or given) op name — for actionable failure
+    messages ('did you mean ...?' when a declaration has a typo)."""
+    import difflib
+
+    _populate()
+    candidates = difflib.get_close_matches(
+        name, list(pool if pool is not None else _TABLE), n=1,
+        cutoff=0.6)
+    return candidates[0] if candidates else ""
+
+
+def describe_ops(names, pool=None):
+    """One actionable line per op name: the module it was registered
+    from plus its nearest neighbor in ``pool`` (default: the whole
+    registry). Used by the op-suite's undeclared/waiver failure
+    messages so new-op authors see WHERE the op leaked from and the
+    likely declaration typo, not a bare name list."""
+    _populate()
+    lines = []
+    for n in names:
+        od = _TABLE.get(n)
+        module = od.module if od is not None else "<not in registry>"
+        near = nearest_registered(
+            n, pool=[p for p in (pool if pool is not None else _TABLE)
+                     if p != n])
+        hint = " (nearest declared/registered: %r)" % near if near else ""
+        lines.append("  %s  [module %s]%s" % (n, module, hint))
+    return "\n".join(lines)
 
 
 _POPULATED = False

@@ -1,0 +1,194 @@
+"""AST self-lint over paddle_tpu/ — the codebase-level companion of the
+trace-time jaxpr linter (paddle_tpu/framework/analysis.py).
+
+Checks:
+
+1. traced-path hygiene: modules whose code runs INSIDE jit traces
+   (ops/kernels, nn/functional, jit/dy2static.py) must not call
+   ``jax.device_get`` / ``np.asarray`` / ``time.time`` — each is a
+   host sync that either breaks under tracing or silently forces a
+   device->host transfer per step. Waivers:
+     * a trailing ``# trace-lint: ok(<reason>)`` comment on the line
+       (deliberate eager-only paths);
+     * any function whose name ends in ``_reference`` (host-side test
+       oracles are not traced).
+2. op-table coverage: every public callable in the op namespaces must
+   resolve in ops/op_table.py's registry — raw jax/jnp functions
+   leaking through a public module surface are flagged, as are ops
+   with guessed (undeclared) metadata.
+
+Run: JAX_PLATFORMS=cpu python tools/lint_codebase.py
+Wired as a tier-1 test in tests/test_lint_codebase.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# modules whose function bodies execute inside jit traces
+TRACED_PATH_DIRS = (
+    os.path.join("paddle_tpu", "ops", "kernels"),
+    os.path.join("paddle_tpu", "nn", "functional"),
+)
+TRACED_PATH_FILES = (
+    os.path.join("paddle_tpu", "jit", "dy2static.py"),
+)
+
+# (module-alias head, attribute) pairs forbidden in traced code
+_FORBIDDEN = {
+    ("jax", "device_get"): "materializes device buffers on host",
+    ("np", "asarray"): "host-materializes a traced value "
+                       "(use jnp.asarray for in-graph conversion)",
+    ("numpy", "asarray"): "host-materializes a traced value "
+                          "(use jnp.asarray for in-graph conversion)",
+    ("time", "time"): "wall-clock reads trace to a constant "
+                      "(and defeat step timing)",
+}
+
+_WAIVER_MARK = "# trace-lint: ok"
+
+
+def _dotted_head(node):
+    """For a Call like np.asarray(x) return ('np', 'asarray')."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return fn.value.id, fn.attr
+    return None
+
+
+class _TracedPathVisitor(ast.NodeVisitor):
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+        self._func_stack = []
+
+    def _in_reference_fn(self):
+        return any(name.endswith("_reference")
+                   for name in self._func_stack)
+
+    def visit_FunctionDef(self, node):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        head = _dotted_head(node)
+        if head in _FORBIDDEN and not self._in_reference_fn():
+            line = self.lines[node.lineno - 1] \
+                if node.lineno - 1 < len(self.lines) else ""
+            if _WAIVER_MARK not in line:
+                self.violations.append(
+                    "%s:%d: %s.%s in traced-path module (%s); fix it "
+                    "or waive with '%s(<reason>)'"
+                    % (self.relpath, node.lineno, head[0], head[1],
+                       _FORBIDDEN[head], _WAIVER_MARK))
+        self.generic_visit(node)
+
+
+def lint_file(path, text=None):
+    """Traced-path check for one file; returns violation strings."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _TracedPathVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_traced_paths(root=REPO):
+    files = []
+    for d in TRACED_PATH_DIRS:
+        full = os.path.join(root, d)
+        for fn in sorted(os.listdir(full)):
+            if fn.endswith(".py"):
+                files.append(os.path.join(full, fn))
+    files += [os.path.join(root, f) for f in TRACED_PATH_FILES]
+    out = []
+    for path in files:
+        out.extend(lint_file(path))
+    return out
+
+
+def check_op_table():
+    """Public callables in the op namespaces must resolve in the
+    registry; undeclared (guessed-metadata) registry entries are also
+    flagged (same contract the op-suite enforces, surfaced here with
+    module + nearest-neighbor hints for new-op authors)."""
+    import inspect
+
+    from paddle_tpu.ops import op_table
+
+    op_table._populate()
+    out = []
+    mods = [
+        ("paddle_tpu.tensor.math", ""),
+        ("paddle_tpu.tensor.manipulation", ""),
+        ("paddle_tpu.tensor.creation", ""),
+        ("paddle_tpu.tensor.linalg", ""),
+        ("paddle_tpu.tensor.logic", ""),
+        ("paddle_tpu.tensor.search", ""),
+        ("paddle_tpu.tensor.stat", ""),
+        ("paddle_tpu.nn.functional", ""),
+        ("paddle_tpu.sparse", "sparse_"),
+    ]
+    import importlib
+
+    for modname, prefix in mods:
+        mod = importlib.import_module(modname)
+        for rawname in dir(mod):
+            if rawname.startswith("_") or rawname in op_table._NOT_OPS:
+                continue
+            fn = getattr(mod, rawname)
+            if not callable(fn) or inspect.isclass(fn):
+                continue
+            name = prefix + rawname
+            if getattr(fn, "__module__", "").startswith("jax"):
+                out.append(
+                    "%s.%s: public op namespace leaks a raw jax "
+                    "callable (%s) — wrap it or underscore-prefix the "
+                    "import" % (modname, rawname,
+                                getattr(fn, "__module__", "?")))
+                continue
+            if op_table.get_op(name) is None:
+                near = op_table.nearest_registered(name)
+                out.append(
+                    "%s.%s: public op missing from op_table registry"
+                    "%s" % (modname, rawname,
+                            " (nearest: %r)" % near if near else ""))
+    for name in op_table.undeclared_ops():
+        out.append("op_table: %r carries guessed (dir()-walk) metadata "
+                   "— declare it in _DECL_GROUPS or waive it:\n%s"
+                   % (name, op_table.describe_ops([name])))
+    return out
+
+
+def run_lint(root=REPO, with_op_table=True):
+    out = check_traced_paths(root)
+    if with_op_table:
+        out.extend(check_op_table())
+    return out
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+    violations = run_lint()
+    for v in violations:
+        print(v)
+    print("%d violation(s)" % len(violations))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

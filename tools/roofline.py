@@ -348,6 +348,17 @@ def liveness(argv=None):
                 "relies on it — prefer recompute=True for a "
                 "predictable schedule",
     }
+    try:
+        # trace_compiled_step finalized the entry, so the trace-time
+        # linter (framework/analysis.py) already ran — attach its
+        # per-program summary to the artifact
+        from paddle_tpu.framework.analysis import live_lint_summaries
+
+        lint = live_lint_summaries()
+        if lint:
+            out["jit_lint"] = lint
+    except Exception:
+        pass
     print(json.dumps(out, indent=1))
     return 0
 
