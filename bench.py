@@ -753,6 +753,110 @@ def bench_serving(n_requests=16, prompt_len=32, new_tokens=32):
     }
 
 
+# aux: shared-prefix serving — radix prefix cache on vs off
+# ---------------------------------------------------------------------------
+
+
+_SERVING_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_SERVING_LAST.json")
+
+
+def bench_prefix_serving(users=8, turns=3, system_len=48, msg_len=8,
+                         new_tokens=8):
+    """Synthetic shared-prefix workload (ISSUE 2): N users x M turns
+    over a common system prompt, served twice through the full
+    scheduler + paged-llama stack — radix prefix cache ON vs OFF.
+    Reports prefill-tokens-saved, hit rate, and tokens/sec per mode;
+    greedy outputs must be identical (cached pages are the SAME bytes
+    the uncached path would recompute)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (
+        BatchScheduler,
+        PagedLlamaAdapter,
+        Request,
+    )
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    kind = _device_kind()
+    cpu = kind.startswith("cpu")
+    page_size = 4
+    if cpu:
+        users, turns, system_len, msg_len, new_tokens = 4, 3, 24, 4, 4
+        cfg = llama_tiny(num_hidden_layers=2,
+                         max_position_embeddings=256)
+    else:
+        cfg = llama_tiny(
+            hidden_size=512, intermediate_size=1024,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=2048,
+        )
+        page_size = 16
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    system = rng.randint(1, cfg.vocab_size, system_len).tolist()
+    msgs = {(u, t): rng.randint(1, cfg.vocab_size, msg_len).tolist()
+            for u in range(users) for t in range(turns)}
+    final_len = system_len + turns * (msg_len + new_tokens)
+    num_pages = 2 * users * (-(-final_len // page_size)) + 16
+
+    def run(prefix):
+        # a fresh adapter per mode: private page pool, shared weights
+        adapter = PagedLlamaAdapter(
+            model, num_pages=num_pages, page_size=page_size,
+            max_length=cfg.max_position_embeddings)
+        sched = BatchScheduler(adapter, max_batch_size=users,
+                               prefix_cache=prefix)
+        history = {u: list(system) for u in range(users)}
+        gen = {}
+        t0 = time.perf_counter()
+        for t in range(turns):
+            for u in range(users):
+                history[u] += msgs[(u, t)]
+                sched.submit(Request(
+                    f"u{u}t{t}", list(history[u]),
+                    max_new_tokens=new_tokens))
+            done = sched.run_until_complete()
+            for u in range(users):
+                out = done[f"u{u}t{t}"].generated_ids
+                gen[(u, t)] = out
+                history[u] += out
+        wall = time.perf_counter() - t0
+        return gen, sched, wall
+
+    run(None)  # warmup: kernel compiles land outside both timed runs
+    gen_off, sched_off, wall_off = run(None)
+    gen_on, sched_on, wall_on = run(True)
+
+    pc = sched_on.prefix_stats
+    prompt_tokens = pc["prompt_tokens"]
+    saved = pc["hit_tokens"]
+    generated = sum(len(g) for g in gen_on.values())
+    rec = {
+        "config": "serving_prefix_cache",
+        "mode": "tpu-single-chip" if not cpu else "cpu",
+        "users": users,
+        "turns": turns,
+        "system_len": system_len,
+        "msg_len": msg_len,
+        "new_tokens": new_tokens,
+        "page_size": page_size,
+        "prompt_tokens": prompt_tokens,
+        "prefill_tokens_saved": saved,
+        "prefill_skip_frac": round(saved / max(prompt_tokens, 1), 4),
+        "request_hit_rate": round(
+            pc["request_hits"] / max(pc["requests"], 1), 4),
+        "greedy_identical": gen_on == gen_off,
+        "tok_s_cache_on": round(generated / wall_on, 1),
+        "tok_s_cache_off": round(generated / wall_off, 1),
+        "speedup": round(wall_off / wall_on, 3),
+        "cow_forks": sched_on.page_pool_stats()["cow_forks"],
+        "prefix_cache": sched_on.prefix_cache.summary(),
+    }
+    _atomic_json_dump(_SERVING_FILE, dict(rec, git_rev=_git_rev()))
+    return rec
+
+
 # ---------------------------------------------------------------------------
 # config 2: GPT-3 1.3B, DP + sharding stage 1
 # ---------------------------------------------------------------------------
@@ -1106,6 +1210,10 @@ def main() -> int:
                              "serving"])
     ap.add_argument("--cpu-mesh", type=str, default=None,
                     choices=sorted(_CPU_MESH))
+    ap.add_argument("--serving", action="store_true",
+                    help="run only the shared-prefix serving workload "
+                         "(radix prefix cache on vs off); emits "
+                         "BENCH_SERVING_LAST.json")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--batch", type=int, default=8)
@@ -1114,6 +1222,24 @@ def main() -> int:
     if args.cpu_mesh:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         _emit(_CPU_MESH[args.cpu_mesh]())
+        return 0
+
+    if args.serving:
+        # standalone shared-prefix serving workload: runs on whatever
+        # platform is available (the bench scales itself down on CPU).
+        # Its artifact is BENCH_SERVING_LAST.json (written inside
+        # bench_prefix_serving) — do NOT go through _emit_final, which
+        # would overwrite the full-matrix BENCH_DETAIL_LAST.json and
+        # its preserved on-chip headline
+        rec = _emit(bench_prefix_serving())
+        ok = bool(rec.get("greedy_identical")) and \
+            rec.get("prefill_skip_frac", 0.0) >= 0.5
+        _emit({"metric": "serving_prefix_cache",
+               "value": rec.get("prefill_skip_frac", 0.0),
+               "unit": "prefill_skip_frac",
+               "vs_baseline": 1.0 if ok else 0.0,
+               "artifact": os.path.basename(_SERVING_FILE),
+               "git_rev": _git_rev()})
         return 0
 
     if args.dry:
@@ -1249,6 +1375,7 @@ def main() -> int:
         _single("decode_throughput", bench_decode)
     if args.only in (None, "serving"):
         _single("serving_throughput", bench_serving)
+        _single("serving_prefix_cache", bench_prefix_serving)
 
     with state_lock:
         if headline_expected:

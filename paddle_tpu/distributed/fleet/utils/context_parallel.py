@@ -35,7 +35,12 @@ import jax.numpy as jnp
 
 from ....framework.core import Tensor, apply_op, _as_tensor
 from ....ops.kernels.flash_attention import NEG_INF, _flash_core_lse
-from ...mesh import axis_degree, global_mesh, in_manual_context
+from ...mesh import (
+    axis_degree,
+    global_mesh,
+    in_manual_context,
+    shard_map,
+)
 
 _BLOCK = 512
 
@@ -159,7 +164,7 @@ def _cp_dispatch(local_fn, name, q, k, v, causal, scale, group):
     spec = jax.sharding.PartitionSpec(None, "sep", None, None)
 
     def global_fn(qr, kr, vr):
-        return jax.shard_map(
+        return shard_map(
             functools.partial(
                 local_fn, causal=causal, scale=float(scale),
                 axis_name="sep", w=w,

@@ -52,6 +52,31 @@ def in_manual_context(names) -> bool:
     return all(n in s for n in names)
 
 
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None):
+    """Version-portable shard_map: ``jax.shard_map`` only exists from
+    jax 0.5/0.6; older installs (this image ships 0.4.37) carry it at
+    jax.experimental.shard_map with ``auto=`` (the complement of the
+    newer ``axis_names=``) and a ``check_rep`` flag whose replication
+    checker rejects some valid collectives — so it is disabled on the
+    legacy path, matching the new API's default behavior."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return sm(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    kwargs = {"check_rep": False}
+    if axis_names is not None:
+        auto = set(mesh.axis_names) - set(axis_names)
+        if auto:
+            kwargs["auto"] = frozenset(auto)
+    return _legacy(f, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, **kwargs)
+
+
 class GlobalMesh:
     def __init__(self):
         self.mesh: Optional[Mesh] = None

@@ -7,8 +7,26 @@ The manager is host-side bookkeeping (page free-list + per-sequence
 tables); the cache pages themselves are device arrays updated with
 static-shape `dynamic_update_slice` writes, so every op stays
 jit-compilable.
+
+Pages are REFERENCE-COUNTED so they can be shared across owners — the
+enabler for cross-request prefix caching (inference/prefix_cache.py):
+
+* every page in use carries a refcount; the free list is exactly the
+  refcount-zero set;
+* ``attach(seq_id, pages, length)`` registers a sequence directly on
+  an existing (shared) page chain instead of empty — each chain page
+  gains a reference;
+* a write into a shared page (refcount > 1) forks it first
+  (copy-on-write): the writer gets a private copy, every other owner
+  keeps the original bytes;
+* ``free``/``truncate`` only drop references; a page returns to the
+  pool when its last reference dies;
+* ``incref``/``decref`` let a non-sequence owner (the radix prefix
+  tree) hold pages alive after the sequence that wrote them retires.
 """
 from __future__ import annotations
+
+import collections
 
 import numpy as np
 
@@ -25,11 +43,15 @@ class PagedKVCacheManager:
     """Fixed pool of KV pages shared by many sequences.
 
     * ``alloc(seq_id)`` registers a sequence;
+    * ``attach(seq_id, pages, length)`` registers a sequence on a
+      SHARED page chain (prefix-cache hit) — appends past ``length``
+      copy-on-write the last page if it is shared;
     * ``append(seq_id)`` returns (physical_page, offset) for the next
       token, growing the sequence's page list from the free list;
     * ``page_table(seq_ids, max_pages)`` / ``seq_lens`` build the
       device-side inputs of the paged attention kernel;
-    * ``free(seq_id)`` returns the sequence's pages to the pool.
+    * ``free(seq_id)`` drops the sequence's references; pages return
+      to the pool when their refcount hits zero.
     """
 
     def __init__(self, num_pages, page_size, kv_heads, head_dim,
@@ -43,6 +65,12 @@ class PagedKVCacheManager:
         self._free = list(range(num_pages))[::-1]
         self._tables = {}   # seq_id -> [page ids]
         self._lens = {}     # seq_id -> token count
+        self._refcnt = [0] * num_pages
+        # references held by non-sequence owners (the prefix tree),
+        # tracked separately so invariants are checkable without the
+        # owner's cooperation
+        self._ext_refs = collections.Counter()
+        self.cow_forks = 0  # lifetime count of copy-on-write forks
 
     # -- bookkeeping -------------------------------------------------------
     def alloc(self, seq_id):
@@ -51,18 +79,114 @@ class PagedKVCacheManager:
         self._tables[seq_id] = []
         self._lens[seq_id] = 0
 
+    def attach(self, seq_id, pages, length):
+        """Register ``seq_id`` on an existing page chain covering its
+        first ``length`` tokens (a prefix-cache hit). Every chain page
+        gains a reference; the content is shared until this sequence
+        writes into the (partial) last page, which forks it."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        need = -(-int(length) // self.page_size) if length else 0
+        if len(pages) != need:
+            raise ValueError(
+                f"attach({seq_id!r}): {length} tokens span {need} "
+                f"pages, got a chain of {len(pages)}")
+        for p in pages:
+            if self._refcnt[p] == 0:
+                raise ValueError(
+                    f"attach({seq_id!r}): page {p} is on the free "
+                    "list (dangling chain)")
+        for p in pages:
+            self._refcnt[p] += 1
+        self._tables[seq_id] = list(pages)
+        self._lens[seq_id] = int(length)
+
     def free(self, seq_id):
-        self._free.extend(reversed(self._tables.pop(seq_id)))
+        tbl = self._tables.pop(seq_id, None)
+        if tbl is None:
+            raise KeyError(
+                f"free({seq_id!r}): unknown or already-freed sequence "
+                "(double-free would corrupt the page free list)")
+        for p in reversed(tbl):
+            self._release_page(p)
         self._lens.pop(seq_id)
+
+    # -- reference counting ------------------------------------------------
+    def incref(self, pages):
+        """Add an external (non-sequence) reference to each page —
+        used by the prefix tree to keep a retired sequence's prefix
+        alive past ``free``."""
+        for p in pages:
+            if self._refcnt[p] == 0:
+                raise ValueError(
+                    f"incref: page {p} is free (cannot resurrect)")
+            self._refcnt[p] += 1
+            self._ext_refs[p] += 1
+
+    def decref(self, pages):
+        """Drop external references; returns how many pages that
+        released back to the pool."""
+        freed = 0
+        for p in pages:
+            if self._ext_refs[p] <= 0:
+                raise ValueError(
+                    f"decref: page {p} holds no external reference")
+            self._ext_refs[p] -= 1
+            if self._ext_refs[p] == 0:
+                del self._ext_refs[p]
+            freed += self._release_page(p)
+        return freed
+
+    def _release_page(self, p):
+        c = self._refcnt[p] - 1
+        if c < 0:
+            raise AssertionError(f"page {p} refcount underflow")
+        self._refcnt[p] = c
+        if c == 0:
+            self._free.append(p)
+            return 1
+        return 0
+
+    def _alloc_page(self):
+        if not self._free:
+            raise RuntimeError("KV page pool exhausted")
+        p = self._free.pop()
+        self._refcnt[p] = 1
+        return p
+
+    def _fork_page(self, src):
+        """Copy-on-write: give the writer a private copy of ``src``
+        (which stays intact for its other owners)."""
+        dst = self._alloc_page()
+        self._copy_page(dst, src)
+        self._refcnt[src] -= 1  # src was shared: cannot hit zero here
+        self.cow_forks += 1
+        return dst
+
+    def _copy_page(self, dst, src):
+        self.k_pages = self.k_pages.at[dst].set(self.k_pages[src])
+        self.v_pages = self.v_pages.at[dst].set(self.v_pages[src])
 
     def seq_len(self, seq_id):
         return self._lens[seq_id]
 
+    def seq_pages(self, seq_id):
+        """The sequence's physical page chain (copy)."""
+        return list(self._tables[seq_id])
+
+    def pending_cow(self, seq_id) -> bool:
+        """True if the sequence's next append must fork a shared page
+        (admission accounting: that fork draws one page from the
+        pool)."""
+        tbl = self._tables[seq_id]
+        return (bool(tbl) and self._lens[seq_id] % self.page_size != 0
+                and self._refcnt[tbl[-1]] > 1)
+
     def truncate(self, seq_id, n):
         """Roll a sequence back to ``n`` tokens (speculative-decoding
         rejection: stale K/V beyond ``n`` is never attended — the
-        kernels mask by seq_len — and pages past ceil(n/P) return to
-        the pool)."""
+        kernels mask by seq_len — and pages past ceil(n/P) drop this
+        sequence's reference)."""
         cur = self._lens[seq_id]
         if n > cur:
             raise ValueError(
@@ -70,21 +194,53 @@ class PagedKVCacheManager:
         keep = -(-n // self.page_size) if n else 0
         tbl = self._tables[seq_id]
         while len(tbl) > keep:
-            self._free.append(tbl.pop())
+            self._release_page(tbl.pop())
         self._lens[seq_id] = n
 
     @property
     def num_free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def num_shared_pages(self) -> int:
+        """Pages currently owned by more than one reference."""
+        return sum(1 for c in self._refcnt if c > 1)
+
+    def assert_ref_invariants(self):
+        """Crash loudly if the refcount state is inconsistent:
+        per-page refcount == occurrences across sequence tables plus
+        external references, and the free list is exactly the
+        refcount-zero set (no duplicates)."""
+        expect = collections.Counter()
+        for tbl in self._tables.values():
+            expect.update(tbl)
+        expect.update(self._ext_refs)
+        for p in range(self.num_pages):
+            if self._refcnt[p] != expect.get(p, 0):
+                raise AssertionError(
+                    f"page {p}: refcount {self._refcnt[p]} != "
+                    f"{expect.get(p, 0)} tracked references")
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            raise AssertionError("duplicate pages on the free list")
+        zero = {p for p in range(self.num_pages)
+                if self._refcnt[p] == 0}
+        if free_set != zero:
+            raise AssertionError(
+                f"free list {sorted(free_set)} != refcount-zero set "
+                f"{sorted(zero)}")
+        return True
+
     def _next_slot(self, seq_id):
         n = self._lens[seq_id]
         off = n % self.page_size
+        tbl = self._tables[seq_id]
         if off == 0:
-            if not self._free:
-                raise RuntimeError("KV page pool exhausted")
-            self._tables[seq_id].append(self._free.pop())
-        return self._tables[seq_id][-1], off
+            tbl.append(self._alloc_page())
+        elif self._refcnt[tbl[-1]] > 1:
+            # divergent write into a shared page: fork first
+            tbl[-1] = self._fork_page(tbl[-1])
+        return tbl[-1], off
 
     # -- device writes -----------------------------------------------------
     def append(self, seq_id, k_tok, v_tok):
@@ -115,9 +271,12 @@ class PagedKVCacheManager:
         v_toks = v_toks._data if isinstance(v_toks, Tensor) else v_toks
         # atomicity: validate capacity BEFORE any bookkeeping mutation,
         # so exhaustion cannot leave some sequences' lens ahead of
-        # their actual device writes
+        # their actual device writes. A mid-page write into a shared
+        # page forks it — that draws a page just like opening a new one
         new_pages_needed = sum(
-            1 for s in seq_ids if self._lens[s] % self.page_size == 0
+            1 for s in seq_ids
+            if self._lens[s] % self.page_size == 0
+            or self.pending_cow(s)
         )
         if new_pages_needed > len(self._free):
             raise RuntimeError(

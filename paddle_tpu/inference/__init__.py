@@ -22,10 +22,13 @@ __all__ = [
     "BatchScheduler",
     "RequestState",
     "PagedLlamaAdapter",
+    "RadixPrefixCache",
+    "PrefixMatch",
 ]
 
 from .serving import BatchScheduler, Request, RequestState  # noqa: E402
 from .paged_llama import PagedLlamaAdapter  # noqa: E402
+from .prefix_cache import RadixPrefixCache, PrefixMatch  # noqa: E402
 
 
 class PlaceType:

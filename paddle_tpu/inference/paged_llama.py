@@ -69,6 +69,23 @@ class PagedLlamaAdapter:
         for c in self.caches:
             c.free(seq_id)
 
+    # -- prefix-cache hooks (inference/prefix_cache.py) --------------------
+    def attach_prefix(self, seq_id, chains, length):
+        """Cached prefill: register ``seq_id`` on shared page chains
+        (one per layer) covering its first ``length`` tokens. The
+        pages stay shared until the sequence's first write into the
+        partial tail page, which the pool forks copy-on-write."""
+        if len(chains) != len(self.caches):
+            raise ValueError(
+                f"{len(chains)} chains for {len(self.caches)} layers")
+        for c, chain in zip(self.caches, chains):
+            c.attach(seq_id, chain, length)
+
+    def seq_page_chains(self, seq_id):
+        """The sequence's physical page chain per layer — what the
+        scheduler hands the radix tree at retire."""
+        return [c.seq_pages(seq_id) for c in self.caches]
+
     def decode_token(self, token_ids, seq_ids):
         """One token per listed sequence; returns logits (B, vocab)."""
         cfg = self.cfg

@@ -20,6 +20,17 @@ under the high watermark after reserving the request's worst-case page
 need (prompt + max_new_tokens, across every layer's cache). This is
 what keeps a burst of long prompts from deadlocking the pool mid-
 generation.
+
+Prefix caching (``prefix_cache=True``): a radix tree over token ids
+(inference/prefix_cache.py) remembers retired sequences' KV pages. On
+admission the prompt is matched against the tree, the matched page
+chains are pinned and ATTACHED (shared, refcounted — see
+incubate/nn/paged_cache.py), and prefill starts at the first uncached
+token; the worst-case reservation shrinks by the full pages the hit
+covers, so admission control stays deadlock-free. On retire the
+sequence's cached tokens are inserted into the tree instead of dying
+with the sequence, and an LRU-by-leaf evictor reclaims unpinned
+cached pages whenever admission would otherwise cross the watermark.
 """
 from __future__ import annotations
 
@@ -55,7 +66,8 @@ class Request:
     state: str = RequestState.QUEUED
     generated_ids: List[int] = field(default_factory=list)
     _pos: int = 0  # prompt tokens consumed so far
-    _reserved: int = 0  # worst-case page reservation at admission
+    _prefix_hit: int = 0  # prompt tokens served from the prefix cache
+    _prefix_path: tuple = ()  # pinned radix nodes (unpinned at retire)
 
     @property
     def finished(self) -> bool:
@@ -77,7 +89,8 @@ class BatchScheduler:
     """
 
     def __init__(self, model, max_batch_size=32, page_watermark=0.95,
-                 sampler=None, draft_model=None, draft_k=4):
+                 sampler=None, draft_model=None, draft_k=4,
+                 prefix_cache=None):
         self.model = model
         self.max_batch_size = int(max_batch_size)
         self.page_watermark = float(page_watermark)
@@ -85,6 +98,32 @@ class BatchScheduler:
         self._queue = collections.deque()
         self._active = {}
         self._finished = {}
+        # cross-request prefix KV cache (inference/prefix_cache.py):
+        # True builds a RadixPrefixCache over the model's own caches;
+        # or pass a pre-built instance (shared across schedulers)
+        if prefix_cache:
+            if draft_model is not None:
+                raise ValueError(
+                    "prefix caching is not supported with speculative "
+                    "decoding: the draft adapter keeps its OWN KV "
+                    "pool, so a cached (skipped) target prefill would "
+                    "leave the draft cache without the prompt")
+            if prefix_cache is True:
+                from .prefix_cache import RadixPrefixCache
+
+                prefix_cache = RadixPrefixCache(list(model.caches))
+        else:
+            prefix_cache = None
+        self.prefix_cache = prefix_cache
+        # (req_id, tree mutation count) -> PrefixMatch: avoids
+        # re-walking the tree for a head-of-queue request blocked on
+        # admission across steps (see _try_admit)
+        self._match_memo = None
+        self.prefix_stats = {
+            "requests": 0, "request_hits": 0,
+            "prompt_tokens": 0, "hit_tokens": 0,
+            "inserted_tokens": 0,
+        }
         # speculative decoding (upstream: the serving role of
         # fused_multi_transformer's draft-verify deployments): a small
         # draft adapter proposes draft_k tokens per sequence per round;
@@ -111,23 +150,41 @@ class BatchScheduler:
         free = sum(c.num_free_pages for c in caches)
         return total, free
 
-    def _pages_needed(self, req: Request, model=None) -> int:
+    def _pages_needed(self, req: Request, model=None,
+                      hit_tokens=0) -> int:
         need = 0
         # speculative windows transiently overshoot the committed
         # length by up to draft_k+1 tokens before the rollback
         slack = (self.draft_k + 1) if self.draft is not None else 0
         for c in (model or self.model).caches:
-            need += -(-(req.total_tokens() + slack) // c.page_size)
+            n = -(-(req.total_tokens() + slack) // c.page_size)
+            # a prefix-cache hit shares its FULL pages; the hit's
+            # partial tail page still costs one draw (the COW fork on
+            # the first divergent write), so only full pages reduce
+            # the worst-case reservation
+            need += max(n - hit_tokens // c.page_size, 0)
         return need
 
     def page_pool_stats(self):
         total, free = self._pool()
-        return {
+        caches = list(self.model.caches)
+        stats = {
             "total_pages": total,
             "free_pages": free,
             "reserved_pages": self._reserved_pages_outstanding(),
             "utilization": 1.0 - free / max(total, 1),
+            "shared_pages": sum(
+                getattr(c, "num_shared_pages", 0) for c in caches),
+            "cow_forks": sum(
+                getattr(c, "cow_forks", 0) for c in caches),
         }
+        if self.prefix_cache is not None:
+            # scheduler-side counters (admission-level) and tree-side
+            # counters (lookup-level) share names like hit_tokens but
+            # mean different things — keep them in separate blocks
+            stats["prefix_cache"] = dict(self.prefix_stats)
+            stats["prefix_cache"]["tree"] = self.prefix_cache.summary()
+        return stats
 
     # -- request lifecycle -------------------------------------------------
     def submit(self, req: Request) -> str:
@@ -164,9 +221,33 @@ class BatchScheduler:
         return req.req_id
 
     def _try_admit(self):
+        hit_tokens_admitted = 0
         while self._queue and len(self._active) < self.max_batch_size:
             req = self._queue[0]
-            need = self._pages_needed(req)
+            hit = None
+            if self.prefix_cache is not None:
+                # a blocked head-of-queue request would re-walk the
+                # tree every step, inflating lookup stats and bumping
+                # LRU recency for a request that never got admitted —
+                # reuse the previous match while the tree is unchanged
+                key = (req.req_id, self.prefix_cache.mutations)
+                if self._match_memo is not None \
+                        and self._match_memo[0] == key:
+                    hit = self._match_memo[1]
+                else:
+                    # cap the match one token short of the prompt: the
+                    # LAST prompt position must run through the model
+                    # to produce the logits that sample the first new
+                    # token
+                    hit = self.prefix_cache.match(
+                        req.prompt_ids, limit=len(req.prompt_ids) - 1)
+                    self._match_memo = (key, hit)
+                if hit.length:
+                    # protect the matched chain from the evictor
+                    # until the request retires
+                    self.prefix_cache.pin(hit.path)
+            hit_len = hit.length if hit is not None else 0
+            need = self._pages_needed(req, hit_tokens=hit_len)
             total, free = self._pool()
             # admit only if worst-case reservation keeps the pool under
             # the watermark (reservations of already-active requests
@@ -174,8 +255,22 @@ class BatchScheduler:
             # so subtract usage double-counted inside reservations)
             used = total - free
             projected = used + self._reserved_pages_outstanding() + need
+            if (projected > self.page_watermark * total
+                    and self.prefix_cache is not None):
+                # cached pages count as "used": reclaim unpinned
+                # cached chains (LRU leaf first) before refusing
+                deficit = int(np.ceil(
+                    projected - self.page_watermark * total))
+                if self.prefix_cache.evict(deficit):
+                    total, free = self._pool()
+                    used = total - free
+                    projected = (used
+                                 + self._reserved_pages_outstanding()
+                                 + need)
             if projected > self.page_watermark * total:
-                return
+                if hit_len:
+                    self.prefix_cache.unpin(hit.path)
+                return hit_tokens_admitted
             if self.draft is not None:
                 # the draft pool is budgeted too (it may be sized
                 # differently): worst-case draft need for every active
@@ -189,37 +284,95 @@ class BatchScheduler:
                             for r in self._active.values())
                 if max(out_d, used_d) + need_d > \
                         self.page_watermark * total_d:
-                    return
+                    return hit_tokens_admitted
             self._queue.popleft()
-            self.model.alloc(req.req_id)
+            self._match_memo = None
+            if hit_len:
+                # cached prefill: share the matched chain and start
+                # prefill at the first uncached token
+                self._attach_prefix(req.req_id, hit.chains, hit_len)
+                req._prefix_hit = hit_len
+                req._prefix_path = hit.path
+                req._pos = hit_len
+                hit_tokens_admitted += hit_len
+                if req.on_token is not None:
+                    # the skipped prompt tokens still stream in order
+                    for t in req.prompt_ids[:hit_len]:
+                        req.on_token(req, t, True)
+            else:
+                self.model.alloc(req.req_id)
+            if self.prefix_cache is not None:
+                self.prefix_stats["requests"] += 1
+                self.prefix_stats["prompt_tokens"] += \
+                    len(req.prompt_ids)
+                self.prefix_stats["hit_tokens"] += hit_len
+                if hit_len:
+                    self.prefix_stats["request_hits"] += 1
             if self.draft is not None:
                 self.draft.alloc(req.req_id)
             req.state = RequestState.PREFILL
-            req._reserved = need
             self._active[req.req_id] = req
+        return hit_tokens_admitted
 
     def _reserved_pages_outstanding(self) -> int:
-        """Worst-case pages still unclaimed by active requests."""
+        """Worst-case free-list draws still ahead of active requests:
+        pages to reach the worst-case table size, measured from the
+        caches' actual state (the freshly sampled token is only
+        appended next step, and an attached prefix chain was shared
+        rather than drawn), plus one draw per cache whose partial tail
+        page is still shared (the pending copy-on-write fork)."""
+        slack = (self.draft_k + 1) if self.draft is not None else 0
         out = 0
         for req in self._active.values():
-            used = 0
-            # tokens actually appended to the caches: the most recent
-            # sampled token is only fed (and written) next step
-            done = req._pos + len(req.generated_ids)
-            if req.state == RequestState.DECODE:
-                done -= 1
+            worst = req.total_tokens() + slack
             for c in self.model.caches:
-                used += -(-done // c.page_size) if done else 0
-            out += max(req._reserved - used, 0)
+                n = c.seq_len(req.req_id)
+                have = -(-n // c.page_size) if n else 0
+                rem = -(-worst // c.page_size) - have
+                pcow = getattr(c, "pending_cow", None)
+                if pcow is not None and pcow(req.req_id):
+                    rem += 1
+                out += max(rem, 0)
         return out
 
+    def _attach_prefix(self, seq_id, chains, length):
+        """Model hook with a caches-level fallback, so any model
+        whose ``caches`` are PagedKVCacheManager serves cached
+        prefills without opting in."""
+        fn = getattr(self.model, "attach_prefix", None)
+        if fn is not None:
+            fn(seq_id, chains, length)
+        else:
+            for c, chain in zip(self.model.caches, chains):
+                c.attach(seq_id, chain, length)
+
+    def _seq_chains(self, seq_id):
+        fn = getattr(self.model, "seq_page_chains", None)
+        if fn is not None:
+            return fn(seq_id)
+        return [c.seq_pages(seq_id) for c in self.model.caches]
+
     def _retire(self, req: Request):
-        self.model.free(req.req_id)
+        rid = req.req_id
+        if self.prefix_cache is not None:
+            # keep the sequence's prefix: insert the cached tokens
+            # (everything actually appended — the newest sampled token
+            # never was) into the radix tree, which increfs the pages
+            # so the free() below only drops THIS sequence's refs
+            n = self.model.caches[0].seq_len(rid)
+            toks = (req.prompt_ids + req.generated_ids)[:n]
+            inserted = self.prefix_cache.insert(
+                toks, self._seq_chains(rid))
+            self.prefix_stats["inserted_tokens"] += inserted
+            if req._prefix_path:
+                self.prefix_cache.unpin(req._prefix_path)
+                req._prefix_path = ()
+        self.model.free(rid)
         if self.draft is not None:
-            self.draft.free(req.req_id)
+            self.draft.free(rid)
         req.state = RequestState.FINISHED
-        del self._active[req.req_id]
-        self._finished[req.req_id] = req
+        del self._active[rid]
+        self._finished[rid] = req
 
     # -- the step ----------------------------------------------------------
     def step(self) -> dict:
@@ -227,10 +380,11 @@ class BatchScheduler:
         sequence by one token, retire completions. Returns event
         counters (admitted/advanced/finished)."""
         n_before = len(self._active)
-        self._try_admit()
+        hit_tokens = self._try_admit()
         admitted = len(self._active) - n_before
         if not self._active:
-            return {"admitted": admitted, "advanced": 0, "finished": 0}
+            return {"admitted": admitted, "advanced": 0, "finished": 0,
+                    "prefix_hit_tokens": hit_tokens}
 
         if self.draft is not None:
             return self._step_spec(admitted)
@@ -284,6 +438,7 @@ class BatchScheduler:
             "admitted": admitted,
             "advanced": len(sids),
             "finished": finished,
+            "prefix_hit_tokens": hit_tokens,
         }
 
     def _step_spec(self, admitted) -> dict:
@@ -386,8 +541,11 @@ class BatchScheduler:
                         c.truncate(s, base_d[s] + committed)
             advanced += len(dec)
 
+        # prefix caching is mutually exclusive with speculative
+        # decoding (see __init__), but the step summary keeps a
+        # uniform shape across both schedulers
         return {"admitted": admitted, "advanced": advanced,
-                "finished": finished}
+                "finished": finished, "prefix_hit_tokens": 0}
 
     def _done(self, req: Request, last_tok: int) -> bool:
         if req.eos_id is not None and last_tok == req.eos_id:

@@ -301,7 +301,7 @@ def fused_linear_cross_entropy_vocab_parallel(
     dp/sep — stay under GSPMD inside); requires S and V divisible by
     the axis degree. reduction as in fused_linear_cross_entropy."""
     from ...distributed.mesh import axis_degree, global_mesh, \
-        in_manual_context
+        in_manual_context, shard_map
 
     if reduction not in ("mean", "sum", "none"):
         raise ValueError(
@@ -339,7 +339,7 @@ def fused_linear_cross_entropy_vocab_parallel(
             w_local = wr.T if transpose_w else wr
             return _vp_per_token(hr, w_local, lr, ii, ck, axis)
 
-        per_tok = jax.shard_map(
+        per_tok = shard_map(
             body, mesh=mesh,
             in_specs=(P(None, axis, None),
                       P(None, axis) if transpose_w else P(axis, None),

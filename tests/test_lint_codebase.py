@@ -63,6 +63,54 @@ class TestSelfLint:
         assert lint_codebase.lint_file("fake/j.py", text=text) == []
 
 
+class TestHostOnlyLint:
+    """The prefix-cache subsystem (inference/prefix_cache.py) is
+    declared pure host bookkeeping — the lint must catch any jax
+    usage creeping into the scheduler's admission path."""
+
+    def test_catches_seeded_jax_usage(self):
+        bad = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def match(tokens):\n"
+            "    return jnp.asarray(tokens), jax.device_count()\n"
+        )
+        v = lint_codebase.lint_host_only_file("fake/pc.py", text=bad)
+        rules = "\n".join(v)
+        assert len(v) == 4, v
+        assert "import jax" in rules
+        assert "jnp.asarray" in rules
+        assert "jax.device_count" in rules
+
+    def test_plain_host_code_clean(self):
+        text = (
+            "import collections\n"
+            "def match(tokens):\n"
+            "    return collections.Counter(tokens)\n"
+        )
+        assert lint_codebase.lint_host_only_file(
+            "fake/pc.py", text=text) == []
+
+    def test_waiver_comment_suppresses(self):
+        text = (
+            "import jax  # trace-lint: ok(test waiver)\n"
+        )
+        assert lint_codebase.lint_host_only_file(
+            "fake/pc.py", text=text) == []
+
+    def test_prefix_cache_module_is_covered(self):
+        covered = [os.path.join(REPO, f)
+                   for f in lint_codebase.HOST_ONLY_FILES]
+        assert any(p.endswith(os.path.join("inference",
+                                           "prefix_cache.py"))
+                   for p in covered)
+        for p in covered:
+            assert os.path.exists(p), p
+
+    def test_inference_surface_leak_free(self):
+        assert lint_codebase.check_inference_surface() == []
+
+
 class TestOpTableMessages:
     """The small-fix satellite: undeclared/waiver failures must name
     the offending module and the nearest registered op."""

@@ -1,0 +1,430 @@
+"""Radix prefix KV cache (inference/prefix_cache.py) + refcounted
+paged pool (incubate/nn/paged_cache.py): cross-request page sharing.
+
+Covers the ISSUE-2 acceptance matrix: (a) cached prefill is
+bitwise-identical to the uncached path, (b) copy-on-write forks leave
+the cached branch's bytes intact, (c) eviction never reclaims a pinned
+chain, (d) the refcount invariant survives a randomized
+admit/retire/evict fuzz, plus the double-free regression."""
+import collections
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.nn import PagedKVCacheManager
+from paddle_tpu.inference import (
+    BatchScheduler,
+    RadixPrefixCache,
+    Request,
+)
+
+
+class HostPool(PagedKVCacheManager):
+    """Bookkeeping-only pool: device writes elided (these tests
+    exercise refcounts and page tables, not bytes)."""
+
+    def __init__(self, num_pages=32, page_size=4):
+        super().__init__(num_pages, page_size, kv_heads=1, head_dim=2,
+                         dtype=jnp.float32)
+
+    def _copy_page(self, dst, src):
+        pass
+
+    def append_host(self, seq_id, n=1):
+        for _ in range(n):
+            self._next_slot(seq_id)
+            self._lens[seq_id] += 1
+
+
+# ---------------------------------------------------------------------------
+# pool-level refcounting
+# ---------------------------------------------------------------------------
+
+
+class TestRefcountedPool:
+    def test_double_free_raises(self):
+        # regression: double-free used to silently push the pages back
+        # onto the free list twice, corrupting it for every later alloc
+        pool = HostPool()
+        pool.alloc("a")
+        pool.append_host("a", 6)
+        pool.free("a")
+        with pytest.raises(KeyError, match="double-free"):
+            pool.free("a")
+        pool.assert_ref_invariants()
+        assert pool.num_free_pages == pool.num_pages
+
+    def test_free_of_unknown_sequence_raises(self):
+        pool = HostPool()
+        with pytest.raises(KeyError):
+            pool.free("never-allocated")
+
+    def test_attach_shares_pages_free_keeps_them_alive(self):
+        pool = HostPool(page_size=4)
+        pool.alloc("a")
+        pool.append_host("a", 8)  # 2 full pages
+        chain = pool.seq_pages("a")
+        pool.attach("b", chain, 8)
+        assert pool.seq_pages("b") == chain
+        assert pool.num_shared_pages == 2
+        pool.free("a")  # b's references keep the pages alive
+        assert pool.num_free_pages == pool.num_pages - 2
+        pool.free("b")
+        assert pool.num_free_pages == pool.num_pages
+        pool.assert_ref_invariants()
+
+    def test_attach_rejects_dangling_chain(self):
+        pool = HostPool()
+        pool.alloc("a")
+        pool.append_host("a", 4)
+        chain = pool.seq_pages("a")
+        pool.free("a")  # chain pages returned to the pool
+        with pytest.raises(ValueError, match="free list"):
+            pool.attach("b", chain, 4)
+
+    def test_append_into_shared_page_forks(self):
+        pool = HostPool(page_size=4)
+        pool.alloc("a")
+        pool.append_host("a", 6)  # page1 is partial (2/4)
+        chain = pool.seq_pages("a")
+        pool.attach("b", chain, 6)
+        assert pool.pending_cow("b") and pool.pending_cow("a")
+        pool.append_host("b", 1)  # divergent write -> fork
+        assert pool.cow_forks == 1
+        tb, ta = pool.seq_pages("b"), pool.seq_pages("a")
+        assert tb[0] == ta[0]          # full page still shared
+        assert tb[1] != ta[1]          # partial page forked
+        assert not pool.pending_cow("a")  # page1 private again
+        pool.assert_ref_invariants()
+
+    def test_truncate_drops_only_own_reference(self):
+        pool = HostPool(page_size=4)
+        pool.alloc("a")
+        pool.append_host("a", 8)
+        chain = pool.seq_pages("a")
+        pool.attach("b", chain, 8)
+        pool.truncate("b", 0)
+        # a's pages survive b's rollback
+        assert pool.seq_pages("a") == chain
+        assert pool.num_free_pages == pool.num_pages - 2
+        pool.assert_ref_invariants()
+
+
+# ---------------------------------------------------------------------------
+# radix tree semantics (host-only pool)
+# ---------------------------------------------------------------------------
+
+
+def _cache_seq(pool, tree, tokens, sid="src"):
+    """Run one sequence through the pool and publish it in the tree
+    (what the scheduler does at retire)."""
+    pool.alloc(sid)
+    pool.append_host(sid, len(tokens))
+    tree.insert(list(tokens), [pool.seq_pages(sid)])
+    pool.free(sid)
+
+
+class TestRadixTree:
+    def test_match_longest_prefix_and_limit(self):
+        pool = HostPool(page_size=4)
+        tree = RadixPrefixCache([pool])
+        _cache_seq(pool, tree, [1, 2, 3, 4, 5, 6])
+        m = tree.match([1, 2, 3, 4, 5, 6, 7, 8])
+        assert m.length == 6
+        assert len(m.chains[0]) == 2
+        assert tree.match([1, 2, 9]).length == 2
+        assert tree.match([9, 9]).length == 0
+        assert tree.match([1, 2, 3, 4, 5, 6], limit=5).length == 5
+
+    def test_mid_page_split_shares_boundary_page(self):
+        pool = HostPool(page_size=4)
+        tree = RadixPrefixCache([pool])
+        _cache_seq(pool, tree, [1, 2, 3, 4, 5, 6], "s0")  # pages p0,p1
+        chain0 = tree.match([1, 2, 3, 4, 5, 6]).chains[0]
+        # second sequence diverges at token index 3 (mid-page): attach
+        # the 3-token hit, fork on the divergent append
+        m = tree.match([1, 2, 3, 9, 9], limit=4)
+        assert m.length == 3
+        tree.pin(m.path)
+        pool.attach("s1", m.chains[0], 3)
+        pool.append_host("s1", 2)
+        assert pool.cow_forks == 1
+        tree.insert([1, 2, 3, 9, 9], [pool.seq_pages("s1")])
+        tree.unpin(m.path)
+        pool.free("s1")
+        # both branches resolve to their own boundary-page copy
+        a = tree.match([1, 2, 3, 4, 5, 6])
+        b = tree.match([1, 2, 3, 9, 9])
+        assert a.length == 6 and b.length == 5
+        assert a.chains[0] == chain0
+        assert b.chains[0][0] != a.chains[0][0]  # forked copy
+        pool.assert_ref_invariants()
+
+    def test_insert_existing_prefix_is_noop(self):
+        pool = HostPool(page_size=4)
+        tree = RadixPrefixCache([pool])
+        _cache_seq(pool, tree, [1, 2, 3, 4], "s0")
+        before = tree.cached_pages
+        pool.alloc("s1")
+        pool.append_host("s1", 3)
+        assert tree.insert([1, 2, 3], [pool.seq_pages("s1")]) == 0
+        pool.free("s1")
+        assert tree.cached_pages == before
+        pool.assert_ref_invariants()
+
+    def test_mismatched_page_sizes_rejected(self):
+        with pytest.raises(ValueError, match="page sizes differ"):
+            RadixPrefixCache([HostPool(page_size=4),
+                              HostPool(page_size=8)])
+
+
+class TestEviction:
+    def _two_branches(self):
+        pool = HostPool(page_size=4)
+        tree = RadixPrefixCache([pool])
+        _cache_seq(pool, tree, [0, 1, 2, 3, 4, 5, 6, 7], "a")
+        _cache_seq(pool, tree, [0, 1, 2, 3, 8, 9, 10, 11], "b")
+        return pool, tree
+
+    def test_lru_leaf_eviction_frees_pages(self):
+        pool, tree = self._two_branches()
+        held = tree.cached_pages
+        assert pool.num_free_pages == pool.num_pages - held
+        # freshen the [8..11] branch: the untouched [4..7] leaf is LRU
+        tree.match([0, 1, 2, 3, 8, 9, 10, 11])
+        freed = tree.evict(1)
+        assert freed >= 1
+        assert tree.match([0, 1, 2, 3, 4, 5, 6, 7]).length == 4
+        assert tree.match([0, 1, 2, 3, 8, 9, 10, 11]).length == 8
+        pool.assert_ref_invariants()
+
+    def test_pinned_chain_never_reclaimed(self):
+        pool, tree = self._two_branches()
+        m = tree.match([0, 1, 2, 3, 4, 5, 6, 7])
+        tree.pin(m.path)
+        tree.evict(10 ** 6)  # watermark pressure: take everything
+        # the pinned chain survives in full; the other branch is gone
+        assert tree.match([0, 1, 2, 3, 4, 5, 6, 7]).length == 8
+        assert tree.match([0, 1, 2, 3, 8, 9, 10, 11]).length == 4
+        for p in m.chains[0]:
+            assert pool._refcnt[p] > 0
+        tree.unpin(m.path)
+        tree.evict(10 ** 6)
+        assert tree.num_nodes == 0
+        assert pool.num_free_pages == pool.num_pages
+        pool.assert_ref_invariants()
+
+    def test_clear_flushes_everything_unpinned(self):
+        pool, tree = self._two_branches()
+        tree.clear()
+        assert tree.num_nodes == 0
+        assert pool.num_free_pages == pool.num_pages
+        pool.assert_ref_invariants()
+
+
+# ---------------------------------------------------------------------------
+# refcount-invariant fuzz: randomized admit / append / retire / evict
+# ---------------------------------------------------------------------------
+
+
+class TestRefcountFuzz:
+    def test_invariants_hold_over_1000_random_ops(self):
+        P = 4
+        pool = HostPool(num_pages=48, page_size=P)
+        tree = RadixPrefixCache([pool])
+        rng = random.Random(0)
+        # shared prefix library forces real tree structure (splits,
+        # shared boundary pages, deep chains)
+        prefixes = [[1, 2, 3, 4], [1, 2, 3, 4, 5, 6, 7, 8],
+                    [1, 2, 9, 9], [7]]
+        active = {}  # sid -> (tokens, pinned path)
+        next_id = 0
+
+        def check():
+            pool.assert_ref_invariants()
+            held = collections.Counter()
+            for node in tree.iter_nodes():
+                held.update(node.pages[0])
+            assert held == pool._ext_refs, (
+                "tree-held pages diverged from the pool's external "
+                "references")
+
+        for _ in range(1000):
+            op = rng.random()
+            if op < 0.45 and len(active) < 8:  # admit
+                toks = (list(rng.choice(prefixes))
+                        + [rng.randrange(2, 30)
+                           for _ in range(rng.randrange(0, 6))])
+                m = tree.match(toks, limit=len(toks) - 1)
+                tree.pin(m.path)
+                # worst case: every page past the hit's full pages,
+                # plus one COW fork of the shared tail
+                need = (-(-len(toks) // P)) - m.length // P + 1
+                if pool.num_free_pages < need:
+                    tree.evict(need - pool.num_free_pages)
+                if pool.num_free_pages < need:
+                    tree.unpin(m.path)
+                    continue
+                sid = f"s{next_id}"
+                next_id += 1
+                if m.length:
+                    pool.attach(sid, m.chains[0], m.length)
+                else:
+                    pool.alloc(sid)
+                pool.append_host(sid, len(toks) - m.length)
+                active[sid] = (toks, m.path)
+            elif op < 0.85 and active:  # retire -> publish in tree
+                sid = rng.choice(sorted(active))
+                toks, path = active.pop(sid)
+                tree.insert(toks, [pool.seq_pages(sid)])
+                tree.unpin(path)
+                pool.free(sid)
+            else:  # eviction pressure
+                tree.evict(rng.randrange(1, 8))
+            check()
+
+        for sid in sorted(active):
+            toks, path = active.pop(sid)
+            tree.unpin(path)
+            pool.free(sid)
+        tree.clear()
+        check()
+        assert pool.num_free_pages == pool.num_pages
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: cached prefill bitwise-identical to the uncached path
+# ---------------------------------------------------------------------------
+
+
+class TinyPagedDecoder(nn.Layer):
+    """1-layer paged decoder implementing the scheduler protocol."""
+
+    def __init__(self, vocab=37, dim=16, heads=2, page_size=4,
+                 num_pages=32):
+        super().__init__()
+        self.dim, self.heads, self.hd = dim, heads, dim // heads
+        self.embed = nn.Embedding(vocab, dim)
+        self.qkv = nn.Linear(dim, 3 * dim)
+        self.head = nn.Linear(dim, vocab)
+        self.caches = [
+            PagedKVCacheManager(num_pages, page_size, heads, self.hd,
+                                dtype=jnp.float32)
+        ]
+
+    def alloc(self, sid):
+        self.caches[0].alloc(sid)
+
+    def free(self, sid):
+        self.caches[0].free(sid)
+
+    def decode_token(self, token_ids, seq_ids):
+        b = len(seq_ids)
+        x = self.embed(paddle.to_tensor(
+            np.asarray(token_ids, "int64")[:, None]))[:, 0]
+        qkv = self.qkv(x).reshape([b, 3, self.heads, self.hd])
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        for bi, sid in enumerate(seq_ids):
+            self.caches[0].append(sid, k.numpy()[bi], v.numpy()[bi])
+        attn = self.caches[0].attend(q, seq_ids)
+        return self.head(x + attn.reshape([b, self.dim]))
+
+
+class _Recorder:
+    """Wraps decode_token, recording each sequence's logits rows in
+    feed order."""
+
+    def __init__(self, model):
+        self.model = model
+        self.rows = collections.defaultdict(list)
+
+    def __getattr__(self, name):
+        return getattr(self.model, name)
+
+    def decode_token(self, token_ids, seq_ids):
+        out = self.model.decode_token(token_ids, seq_ids)
+        arr = np.asarray(out.numpy())
+        for bi, sid in enumerate(seq_ids):
+            self.rows[sid].append(arr[bi])
+        return out
+
+
+def _run(prefix_cache, prompts, seed=11):
+    paddle.seed(seed)
+    rec = _Recorder(TinyPagedDecoder())
+    sched = BatchScheduler(rec, prefix_cache=prefix_cache)
+    for rid, (prompt, when) in prompts.items():
+        if when == 0:
+            sched.submit(Request(rid, list(prompt), max_new_tokens=4))
+    sched.run_until_complete()
+    for rid, (prompt, when) in prompts.items():
+        if when == 1:
+            sched.submit(Request(rid, list(prompt), max_new_tokens=4))
+    done = sched.run_until_complete()
+    return sched, rec, done
+
+
+class TestCachedPrefillIdentity:
+    def test_shared_prompt_bitwise_identical_logits(self):
+        shared = [3, 17, 5, 9, 2, 8, 4, 11, 6]  # 9 tokens, page=4
+        prompts = {
+            "warm": (shared, 0),           # populates the tree
+            "hit1": (shared, 1),           # same prompt -> cached
+            "hit2": (shared + [1], 1),     # extends the cached prefix
+        }
+        s_on, rec_on, done_on = _run(True, prompts)
+        s_off, rec_off, done_off = _run(None, prompts)
+
+        # identical greedy tokens with and without the cache
+        for rid in prompts:
+            assert (done_on[rid].generated_ids
+                    == done_off[rid].generated_ids), rid
+
+        # the cache actually served: both late requests hit
+        pc = s_on.prefix_stats
+        assert pc["request_hits"] == 2
+        assert pc["hit_tokens"] >= 2 * (len(shared) - 1) // 4 * 4
+        assert s_on.page_pool_stats()["cow_forks"] >= 0
+
+        # bitwise identity of every logits row the cached run DID
+        # compute (its prefill starts at the first uncached token, so
+        # compare against the tail of the uncached run's rows)
+        for rid in ("hit1", "hit2"):
+            on, off = rec_on.rows[rid], rec_off.rows[rid]
+            assert 0 < len(on) < len(off)
+            for got, want in zip(on, off[len(off) - len(on):]):
+                np.testing.assert_array_equal(got, want, err_msg=rid)
+
+    def test_pool_drains_and_invariants_after_serving(self):
+        shared = [3, 17, 5, 9, 2, 8, 4, 11, 6]
+        s_on, _, _ = _run(True, {"warm": (shared, 0),
+                                 "hit": (shared, 1)})
+        model = s_on.model
+        # all live references are the tree's; flushing it returns the
+        # whole pool
+        model.caches[0].assert_ref_invariants()
+        s_on.prefix_cache.clear()
+        assert (model.caches[0].num_free_pages
+                == model.caches[0].num_pages)
+        model.caches[0].assert_ref_invariants()
+
+    def test_watermark_eviction_keeps_serving(self):
+        # pool sized so the second wave cannot be admitted without
+        # evicting the first wave's cached chains
+        paddle.seed(7)
+        model = TinyPagedDecoder(num_pages=9)
+        sched = BatchScheduler(model, prefix_cache=True,
+                               page_watermark=1.0, max_batch_size=2)
+        rng = np.random.RandomState(0)
+        for i in range(4):
+            prompt = rng.randint(1, 30, size=8).tolist()
+            sched.submit(Request(f"r{i}", prompt, max_new_tokens=4))
+        done = sched.run_until_complete()
+        assert len(done) == 4
+        assert sched.prefix_cache.stats["evicted_pages"] > 0
+        model.caches[0].assert_ref_invariants()

@@ -30,11 +30,17 @@ def mp_mesh():
 
 
 def _mp_shard_count(param, axis_index):
-    """Number of distinct shard index-slices along the given dim."""
+    """Number of distinct shard index-slices along the given dim.
+    slice objects only became hashable in Python 3.12 — key on their
+    (start, stop, step) triple so the count works on 3.10 too."""
     sh = param._data.sharding
     assert isinstance(sh, NamedSharding), sh
     idx = sh.devices_indices_map(tuple(param.shape))
-    return len({ix[axis_index] for ix in idx.values()})
+
+    def key(s):
+        return (s.start, s.stop, s.step) if isinstance(s, slice) else s
+
+    return len({key(ix[axis_index]) for ix in idx.values()})
 
 
 def test_params_carry_named_sharding(mp_mesh):

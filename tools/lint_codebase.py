@@ -16,6 +16,12 @@ Checks:
    resolve in ops/op_table.py's registry — raw jax/jnp functions
    leaking through a public module surface are flagged, as are ops
    with guessed (undeclared) metadata.
+3. host-only hygiene (the prefix-cache subsystem): modules declared
+   pure host bookkeeping (inference/prefix_cache.py) must not touch
+   jax/jnp at all — device compute or a host<->device sync inside the
+   scheduler's admission path stalls every step. The public
+   ``paddle_tpu.inference`` surface is also checked for raw jax
+   callables leaking through.
 
 Run: JAX_PLATFORMS=cpu python tools/lint_codebase.py
 Wired as a tier-1 test in tests/test_lint_codebase.py.
@@ -49,6 +55,15 @@ _FORBIDDEN = {
 }
 
 _WAIVER_MARK = "# trace-lint: ok"
+
+# modules that must stay PURE host bookkeeping: the prefix-cache
+# subsystem runs inside the scheduler's admission loop, where any jax
+# import means device compute (or a device sync) per admitted request
+HOST_ONLY_FILES = (
+    os.path.join("paddle_tpu", "inference", "prefix_cache.py"),
+)
+
+_HOST_ONLY_BANNED_MODULES = ("jax", "jax.numpy")
 
 
 def _dotted_head(node):
@@ -120,6 +135,94 @@ def check_traced_paths(root=REPO):
     return out
 
 
+class _HostOnlyVisitor(ast.NodeVisitor):
+    """Flags any jax/jnp import or attribute use in a module declared
+    pure host bookkeeping."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+
+    def _flag(self, lineno, what):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        if _WAIVER_MARK not in line:
+            self.violations.append(
+                "%s:%d: %s in a host-only module (prefix-cache "
+                "bookkeeping runs in the scheduler's admission loop; "
+                "no device compute or sync allowed); fix it or waive "
+                "with '%s(<reason>)'"
+                % (self.relpath, lineno, what, _WAIVER_MARK))
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            head = alias.name.split(".")[0]
+            if head == "jax":
+                self._flag(node.lineno, "import %s" % alias.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        mod = node.module or ""
+        if mod.split(".")[0] == "jax":
+            self._flag(node.lineno, "from %s import ..." % mod)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if isinstance(node.value, ast.Name) \
+                and node.value.id in ("jax", "jnp"):
+            self._flag(node.lineno,
+                       "%s.%s" % (node.value.id, node.attr))
+        self.generic_visit(node)
+
+
+def lint_host_only_file(path, text=None):
+    """Host-only check for one file; returns violation strings."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _HostOnlyVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_host_only(root=REPO):
+    out = []
+    for f in HOST_ONLY_FILES:
+        out.extend(lint_host_only_file(os.path.join(root, f)))
+    return out
+
+
+def check_inference_surface():
+    """No raw jax callable may leak through the public
+    ``paddle_tpu.inference`` namespace (same leak rule the op
+    namespaces get, without requiring op-table registration — the
+    serving surface exports classes and factories, not ops)."""
+    import importlib
+    import inspect
+
+    out = []
+    mod = importlib.import_module("paddle_tpu.inference")
+    for rawname in getattr(mod, "__all__", dir(mod)):
+        if rawname.startswith("_"):
+            continue
+        fn = getattr(mod, rawname, None)
+        if fn is None or not callable(fn) or inspect.isclass(fn):
+            continue
+        if getattr(fn, "__module__", "").startswith("jax"):
+            out.append(
+                "paddle_tpu.inference.%s: public serving namespace "
+                "leaks a raw jax callable (%s) — wrap it or "
+                "underscore-prefix the import"
+                % (rawname, getattr(fn, "__module__", "?")))
+    return out
+
+
 def check_op_table():
     """Public callables in the op namespaces must resolve in the
     registry; undeclared (guessed-metadata) registry entries are also
@@ -175,8 +278,10 @@ def check_op_table():
 
 def run_lint(root=REPO, with_op_table=True):
     out = check_traced_paths(root)
+    out.extend(check_host_only(root))
     if with_op_table:
         out.extend(check_op_table())
+        out.extend(check_inference_surface())
     return out
 
 
