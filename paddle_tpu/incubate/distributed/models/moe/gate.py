@@ -316,9 +316,11 @@ class MixtralGate(BaseGate):
     MixtralSparseMoeBlock router): softmax over experts, top-k
     selected, combine weights RENORMALIZED over the selected experts,
     and the HF load-balancing aux loss
-    ``E * sum_e f_e * P_e`` with ``f_e`` the fraction of (token,
+    ``E * K * sum_e f_e * P_e`` with ``f_e`` the fraction of (token,
     choice) slots routed to expert e and ``P_e`` the mean router
-    probability."""
+    probability (the ``K`` factor matches HF's
+    load_balancing_loss_func, which sums tokens_per_expert over the
+    kept top_k dim)."""
 
     def __init__(self, d_model, num_expert, world_size, topk=2,
                  group=None):
@@ -347,9 +349,17 @@ class MixtralGate(BaseGate):
             gates = jax.nn.softmax(logits, axis=-1)
             _, topi = jax.lax.top_k(gates, k)
             sel = jax.nn.one_hot(topi, e, dtype=jnp.float32)  # (N,K,E)
+            # HF load_balancing_loss_func: tokens_per_expert is the
+            # mean over TOKENS only (keeping the top_k dim), then the
+            # sum runs over both (k, e) — equivalent to
+            # E * K * sum_e(f_e * P_e) with f_e the mean over
+            # (token, choice) slots. The K factor matters: without it
+            # the HF-default router_aux_loss_coef exerts 1/K of HF's
+            # load-balance pressure (ADVICE r5; parity pinned in
+            # tests/test_moe.py).
             f_e = jnp.mean(sel, axis=(0, 1))
             p_e = jnp.mean(gates, axis=0)
-            aux = jnp.sum(f_e * p_e) * e
+            aux = jnp.sum(f_e * p_e) * e * k
             if sparse:
                 return _topk_sparse(
                     gates, k, cap, normalize=True), aux, cap

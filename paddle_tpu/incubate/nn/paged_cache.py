@@ -23,6 +23,21 @@ enabler for cross-request prefix caching (inference/prefix_cache.py):
   pool when its last reference dies;
 * ``incref``/``decref`` let a non-sequence owner (the radix prefix
   tree) hold pages alive after the sequence that wrote them retires.
+
+Quantized pages (``kv_dtype="int8"``): pages store int8 with a
+per-page, PER-HEAD float32 scale sidecar ``k_scales``/``v_scales``
+(num_pages, kv_heads) — half the HBM bytes per token, so the same HBM
+budget holds ~2x the sequences. The sidecar rides the same physical
+page ids as the payload, so refcount/COW/prefix sharing need no extra
+bookkeeping: shared pages share their scale row, and a copy-on-write
+fork copies the scale row with the bytes. Appends requantize: a token
+whose abs-max exceeds the page's current scale grows the scale and
+rescales the already-stored slots (round(q_old * old/new) — bounded
+extra rounding, page_size slots at most). Dequant is fused into the
+paged-attention kernels (scales ride scalar prefetch). The sidecar is
+pool-private state: serving layers must never write
+``k_scales``/``v_scales`` directly (enforced by
+tools/lint_codebase.py).
 """
 from __future__ import annotations
 
@@ -35,6 +50,7 @@ import jax.numpy as jnp
 
 from ...framework.core import Tensor, apply_op, _as_tensor
 from ...ops.kernels.paged_attention import paged_attention as _kernel
+from ...ops.kernels.quant import kv_head_scale, quantize_kv
 
 __all__ = ["PagedKVCacheManager", "paged_attention"]
 
@@ -54,14 +70,35 @@ class PagedKVCacheManager:
       to the pool when their refcount hits zero.
     """
 
+    _KV_DTYPES = {
+        "int8": jnp.int8, "bf16": jnp.bfloat16,
+        "bfloat16": jnp.bfloat16, "fp32": jnp.float32,
+        "float32": jnp.float32, "fp16": jnp.float16,
+        "float16": jnp.float16,
+    }
+
     def __init__(self, num_pages, page_size, kv_heads, head_dim,
-                 dtype=jnp.bfloat16):
+                 dtype=jnp.bfloat16, kv_dtype=None):
         self.page_size = int(page_size)
         self.num_pages = int(num_pages)
+        if kv_dtype is not None:
+            if kv_dtype not in self._KV_DTYPES:
+                raise ValueError(
+                    f"kv_dtype must be one of "
+                    f"{sorted(self._KV_DTYPES)}, got {kv_dtype!r}")
+            dtype = self._KV_DTYPES[kv_dtype]
+        self.kv_dtype = jnp.dtype(dtype).name
+        self.quantized = self.kv_dtype == "int8"
         self.k_pages = jnp.zeros(
             (num_pages, page_size, kv_heads, head_dim), dtype
         )
         self.v_pages = jnp.zeros_like(self.k_pages)
+        if self.quantized:
+            # per-page, per-head scale sidecars (pool-private: mutate
+            # ONLY through the append/COW paths below)
+            self.k_scales = jnp.zeros((num_pages, kv_heads),
+                                      jnp.float32)
+            self.v_scales = jnp.zeros_like(self.k_scales)
         self._free = list(range(num_pages))[::-1]
         self._tables = {}   # seq_id -> [page ids]
         self._lens = {}     # seq_id -> token count
@@ -152,6 +189,11 @@ class PagedKVCacheManager:
             raise RuntimeError("KV page pool exhausted")
         p = self._free.pop()
         self._refcnt[p] = 1
+        if self.quantized:
+            # a fresh page is all-zero: its scale must restart at 0 or
+            # the first append would inherit a dead page's calibration
+            self.k_scales = self.k_scales.at[p].set(0.0)
+            self.v_scales = self.v_scales.at[p].set(0.0)
         return p
 
     def _fork_page(self, src):
@@ -166,6 +208,14 @@ class PagedKVCacheManager:
     def _copy_page(self, dst, src):
         self.k_pages = self.k_pages.at[dst].set(self.k_pages[src])
         self.v_pages = self.v_pages.at[dst].set(self.v_pages[src])
+        if self.quantized:
+            # the fork COPIES the scale row (the source chain keeps
+            # its own); from here the two pages recalibrate
+            # independently
+            self.k_scales = self.k_scales.at[dst].set(
+                self.k_scales[src])
+            self.v_scales = self.v_scales.at[dst].set(
+                self.v_scales[src])
 
     def seq_len(self, seq_id):
         return self._lens[seq_id]
@@ -242,6 +292,46 @@ class PagedKVCacheManager:
             tbl[-1] = self._fork_page(tbl[-1])
         return tbl[-1], off
 
+    # -- quantized writes --------------------------------------------------
+    def _quant_write(self, pages, offs, k_toks, v_toks):
+        """Quantized token write: grow each written page's per-head
+        scale to cover the new token (requantizing the already-stored
+        slots by round(q * old/new) — exact when the scale is
+        unchanged), then store the tokens as int8. ``pages`` holds
+        DISTINCT physical ids (each page has exactly one writer — a
+        shared page is forked before any write reaches here).
+
+        Steady state (scales already cover the token — the common
+        decode case once a page has seen a few tokens) writes ONLY the
+        token's slot; the full-page requantize gather/scatter runs
+        only when a scale actually grows. The host-side branch costs
+        one device read per append batch — this pool is host-driven
+        bookkeeping by design (see module docstring)."""
+        pg = jnp.asarray(pages, jnp.int32)
+        of = jnp.asarray(offs, jnp.int32)
+        rows = jnp.arange(pg.shape[0])
+        for name_p, name_s, toks in (
+            ("k_pages", "k_scales", k_toks),
+            ("v_pages", "v_scales", v_toks),
+        ):
+            all_pages = getattr(self, name_p)
+            all_scales = getattr(self, name_s)
+            tok_s = kv_head_scale(toks, keep_leading=1)   # (B, KVH)
+            old_s = all_scales[pg]
+            new_s = jnp.maximum(old_s, tok_s)
+            if bool(jnp.any(new_s > old_s)):
+                ratio = jnp.where(
+                    new_s > 0, old_s / jnp.maximum(new_s, 1e-20), 1.0)
+                body = jnp.round(
+                    all_pages[pg].astype(jnp.float32)
+                    * ratio[:, None, :, None]).astype(jnp.int8)
+                body = body.at[rows, of].set(quantize_kv(toks, new_s))
+                setattr(self, name_p, all_pages.at[pg].set(body))
+                setattr(self, name_s, all_scales.at[pg].set(new_s))
+            else:
+                setattr(self, name_p, all_pages.at[pg, of].set(
+                    quantize_kv(toks, old_s)))
+
     # -- device writes -----------------------------------------------------
     def append(self, seq_id, k_tok, v_tok):
         """Write one token's K/V ((KVH, D) arrays or Tensors) into the
@@ -249,6 +339,10 @@ class PagedKVCacheManager:
         page, off = self._next_slot(seq_id)
         k_tok = k_tok._data if isinstance(k_tok, Tensor) else k_tok
         v_tok = v_tok._data if isinstance(v_tok, Tensor) else v_tok
+        if self.quantized:
+            self._quant_write([page], [off], k_tok[None], v_tok[None])
+            self._lens[seq_id] += 1
+            return page, off
         self.k_pages = jax.lax.dynamic_update_slice(
             self.k_pages,
             k_tok[None, None].astype(self.k_pages.dtype),
@@ -290,6 +384,9 @@ class PagedKVCacheManager:
             self._lens[s] += 1
             pages.append(page)
             offs.append(off)
+        if self.quantized:
+            self._quant_write(pages, offs, k_toks, v_toks)
+            return
         pg = jnp.asarray(pages, jnp.int32)
         of = jnp.asarray(offs, jnp.int32)
         self.k_pages = self.k_pages.at[pg, of].set(
@@ -316,33 +413,90 @@ class PagedKVCacheManager:
     def attend(self, q, seq_ids, sm_scale=None, window=0):
         """q: Tensor (B, H, D) — one decode token per listed sequence.
         ``window`` > 0: sliding-window attention over the last
-        ``window`` cached tokens (out-of-window pages skipped)."""
+        ``window`` cached tokens (out-of-window pages skipped).
+        Quantized pools pass their scale sidecars into the kernel
+        (dequant fused after the page DMA)."""
         q = _as_tensor(q)
         tbl = self.page_table(seq_ids)
         lens = self.seq_lens(seq_ids)
         kp, vp = self.k_pages, self.v_pages
+        ks = self.k_scales if self.quantized else None
+        vs = self.v_scales if self.quantized else None
 
         def f(qr):
             return _kernel(qr, kp, vp, tbl, lens, sm_scale=sm_scale,
-                           window=window)
+                           window=window, k_scales=ks, v_scales=vs)
 
         return apply_op("paged_attend", f, q, differentiable=False)
 
+    def dense_kv(self, seq_ids):
+        """Dense (dequantized) gather of the listed sequences' pages:
+        returns (page_table (B, MP), k (B, MP, P, KVH, D),
+        v (...)) with k/v in compute dtype — the supported way for
+        serving layers to read quantized pages without touching the
+        scale sidecars (multi-token verify windows use this)."""
+        tbl = self.page_table(seq_ids)
+        kd = self.k_pages[tbl]
+        vd = self.v_pages[tbl]
+        if self.quantized:
+            kd = (kd.astype(jnp.float32)
+                  * self.k_scales[tbl][:, :, None, :, None])
+            vd = (vd.astype(jnp.float32)
+                  * self.v_scales[tbl][:, :, None, :, None])
+        return tbl, kd, vd
+
+    @staticmethod
+    def page_bytes(page_size, kv_heads, head_dim,
+                   dtype=jnp.bfloat16, kv_dtype=None) -> int:
+        """HBM bytes one page costs (K + V payload plus, when
+        quantized, the scale sidecar rows) — pure arithmetic, usable
+        for pool sizing BEFORE allocating anything."""
+        if kv_dtype is not None:
+            dtype = PagedKVCacheManager._KV_DTYPES[kv_dtype]
+        dtype = jnp.dtype(dtype)
+        per = page_size * kv_heads * head_dim * dtype.itemsize * 2
+        if dtype.name == "int8":
+            per += kv_heads * 4 * 2
+        return per
+
+    @property
+    def page_nbytes(self) -> int:
+        return self.page_bytes(
+            self.page_size, self.k_pages.shape[2],
+            self.k_pages.shape[3], dtype=self.k_pages.dtype)
+
+    @property
+    def pool_nbytes(self) -> int:
+        return self.page_nbytes * self.num_pages
+
 
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
-                    sm_scale=None, window=0, name=None):
-    """Functional surface over the Pallas paged decode kernel."""
+                    sm_scale=None, window=0, k_scales=None,
+                    v_scales=None, name=None):
+    """Functional surface over the Pallas paged decode kernel.
+    ``k_scales``/``v_scales`` (NP, KVH): int8 pages with fused
+    dequant."""
     q = _as_tensor(q)
     k_pages = _as_tensor(k_pages)
     v_pages = _as_tensor(v_pages)
     page_table = _as_tensor(page_table)
     seq_lens = _as_tensor(seq_lens)
+    args = [q, k_pages, v_pages, page_table, seq_lens]
+    quant = k_scales is not None
+    if quant != (v_scales is not None):
+        # mirror the kernel's guard here: dropping one scale silently
+        # would attend over raw int8 codes
+        raise ValueError(
+            "paged_attention: pass both k_scales and v_scales or "
+            "neither")
+    if quant:
+        args += [_as_tensor(k_scales), _as_tensor(v_scales)]
 
-    def f(qr, kp, vp, tbl, ln):
+    def f(qr, kp, vp, tbl, ln, *scales):
+        ks, vs = scales if quant else (None, None)
         return _kernel(qr, kp, vp, tbl, ln, sm_scale=sm_scale,
-                       window=window)
+                       window=window, k_scales=ks, v_scales=vs)
 
     return apply_op(
-        "paged_attention", f, q, k_pages, v_pages, page_table,
-        seq_lens, differentiable=False,
+        "paged_attention", f, *args, differentiable=False,
     )

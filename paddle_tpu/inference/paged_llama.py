@@ -35,24 +35,65 @@ class PagedLlamaAdapter:
     ``num_pages`` x ``page_size`` tokens per layer; ``max_length``
     bounds RoPE positions. Works with the BatchScheduler or driven
     directly via decode_token.
+
+    Quantized serving knobs (docs/QUANTIZATION.md):
+
+    * ``kv_cache_dtype="int8"`` — pages store int8 with per-page,
+      per-head scale sidecars; dequant fuses into the paged-attention
+      kernel. Halves page bytes, so the same HBM budget holds ~2x the
+      sequences.
+    * ``weight_dtype="int8"|"int4"`` — runs
+      quantization.quantize_for_serving over the wrapped model IN
+      PLACE at adapter construction (the serving analog of
+      quantize-on-checkpoint-load): attention/MLP linears swap to
+      WeightOnlyLinear. The report lands on ``self.quant_report``.
+    * ``page_pool_bytes`` — size the pool by HBM budget instead of
+      page count: ``num_pages`` becomes
+      ``page_pool_bytes // (layers * page_nbytes)``, so switching
+      kv_cache_dtype at a FIXED byte budget changes capacity, not
+      spend.
     """
 
     def __init__(self, model, num_pages=256, page_size=16,
-                 max_length=None, dtype=None):
+                 max_length=None, dtype=None, kv_cache_dtype=None,
+                 weight_dtype=None, page_pool_bytes=None):
         self.model = model
         cfg = model.config
         self.cfg = cfg
         # Mistral-style sliding window rides through the paged decode
         # kernel's banded mask (out-of-window pages skipped)
         self._window = int(getattr(cfg, "sliding_window", 0) or 0)
+        self.weight_dtype = weight_dtype
+        self.quant_report = None
+        if weight_dtype is not None:
+            from ..quantization import quantize_for_serving
+
+            self.quant_report = quantize_for_serving(
+                model, weight_dtype=weight_dtype)
         if dtype is None:
             dtype = model.model.embed_tokens.weight._data.dtype
+        self.kv_cache_dtype = kv_cache_dtype
         self.max_length = int(max_length or cfg.max_position_embeddings)
-        self.caches = [
-            PagedKVCacheManager(
-                num_pages, page_size, cfg.num_key_value_heads,
-                cfg.head_dim, dtype=dtype,
+
+        def make_cache(n):
+            return PagedKVCacheManager(
+                n, page_size, cfg.num_key_value_heads,
+                cfg.head_dim, dtype=dtype, kv_dtype=kv_cache_dtype,
             )
+
+        if page_pool_bytes is not None:
+            per_page = PagedKVCacheManager.page_bytes(
+                page_size, cfg.num_key_value_heads, cfg.head_dim,
+                dtype=dtype, kv_dtype=kv_cache_dtype)
+            num_pages = int(page_pool_bytes) // (
+                cfg.num_hidden_layers * per_page)
+            if num_pages < 1:
+                raise ValueError(
+                    f"page_pool_bytes={page_pool_bytes} cannot hold "
+                    f"one page per layer "
+                    f"({cfg.num_hidden_layers} x {per_page} bytes)")
+        self.caches = [
+            make_cache(num_pages)
             for _ in range(cfg.num_hidden_layers)
         ]
         self._cos, self._sin = build_rope_cache(
@@ -182,9 +223,9 @@ def _window_logits(self, token_windows, seq_ids):
                 self.caches[li].append_batch(
                     seq_ids, kh[:, j], vh[:, j])
             c = self.caches[li]
-            tbl = c.page_table(seq_ids)          # (B, MP)
-            kd = c.k_pages[tbl]                  # (B, MP, P, KVH, D)
-            vd = c.v_pages[tbl]
+            # pool-API read: dense_kv dequantizes int8 pages against
+            # the scale sidecars (serving code never touches them)
+            tbl, kd, vd = c.dense_kv(seq_ids)    # (B, MP, P, KVH, D)
             mp = tbl.shape[1]
             kd = kd.reshape(b, mp * c.page_size, nkv, hd)
             vd = vd.reshape(b, mp * c.page_size, nkv, hd)

@@ -177,6 +177,16 @@ class BatchScheduler:
                 getattr(c, "num_shared_pages", 0) for c in caches),
             "cow_forks": sum(
                 getattr(c, "cow_forks", 0) for c in caches),
+            # quantized-serving accounting: page bytes as stored
+            # (int8 pages + scale sidecars report their true HBM
+            # footprint — the capacity story of docs/QUANTIZATION.md)
+            "kv_dtype": sorted({
+                getattr(c, "kv_dtype", "unknown") for c in caches}),
+            "pool_bytes": sum(
+                getattr(c, "pool_nbytes", 0) for c in caches),
+            "used_bytes": sum(
+                getattr(c, "page_nbytes", 0)
+                * (c.num_pages - c.num_free_pages) for c in caches),
         }
         if self.prefix_cache is not None:
             # scheduler-side counters (admission-level) and tree-side

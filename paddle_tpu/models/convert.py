@@ -485,17 +485,32 @@ def load_hf_t5(model, state_dict, strict=True):
     return model
 
 
-def from_hf(model, state_dict, strict=True):
-    """Dispatch on the model family."""
+def from_hf(model, state_dict, strict=True, weight_dtype=None,
+            group_size=64):
+    """Dispatch on the model family.
+
+    ``weight_dtype="int8"|"int4"``: quantize-on-load for serving —
+    after the fp weights land, every attention/MLP linear is abs-max
+    quantized and swapped for a WeightOnlyLinear
+    (quantization/ptq_llm.py), so the fp copies never persist in HBM
+    past checkpoint load. Llama/GPT/Mixtral only (the decoder families
+    the paged serving stack drives)."""
     name = type(model).__name__
     if name.startswith("Llama"):
         if getattr(model.config, "num_local_experts", 0) > 0:
-            return load_hf_mixtral(model, state_dict, strict=strict)
-        return load_hf_llama(model, state_dict, strict=strict)
+            model = load_hf_mixtral(model, state_dict, strict=strict)
+        else:
+            model = load_hf_llama(model, state_dict, strict=strict)
+        return _maybe_quantize(model, weight_dtype, group_size)
+    if name.startswith("GPT"):
+        model = load_hf_gpt2(model, state_dict, strict=strict)
+        return _maybe_quantize(model, weight_dtype, group_size)
+    if weight_dtype is not None:
+        raise ValueError(
+            f"from_hf: weight_dtype={weight_dtype!r} is a serving "
+            f"knob for the decoder families (Llama*/GPT*), not {name}")
     if name.startswith("Bert"):
         return load_hf_bert(model, state_dict, strict=strict)
-    if name.startswith("GPT"):
-        return load_hf_gpt2(model, state_dict, strict=strict)
     if name in ("VisionTransformer",) or name.startswith("ViT"):
         return load_hf_vit(model, state_dict, strict=strict)
     if name.startswith("T5"):
@@ -503,6 +518,16 @@ def from_hf(model, state_dict, strict=True):
     raise TypeError(
         f"from_hf: no converter for {name} "
         f"(supported: Llama*, Bert*, GPT*, VisionTransformer, T5*)")
+
+
+def _maybe_quantize(model, weight_dtype, group_size):
+    if weight_dtype is None:
+        return model
+    from ..quantization import quantize_for_serving
+
+    model._hf_quant_report = quantize_for_serving(
+        model, weight_dtype=weight_dtype, group_size=group_size)
+    return model
 
 
 def load_hf_mixtral(model, state_dict, strict=True):

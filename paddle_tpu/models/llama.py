@@ -193,7 +193,19 @@ _warned_moe_recompute_llama = False
 
 
 def mixtral_8x7b(**kw) -> LlamaConfig:
-    """Mixtral-8x7B: Mistral trunk + 8-expert top-2 sparse MoE MLP."""
+    """Mixtral-8x7B: Mistral trunk + 8-expert top-2 sparse MoE MLP.
+
+    Capacity caveat (vs HF): experts here dispatch with a FIXED
+    per-expert capacity (``moe_capacity_factor``, default 2.0 —
+    static shapes for the TPU batched-expert matmul), while HF's
+    MixtralSparseMoeBlock gathers dynamically and processes every
+    routed token. Under heavily skewed routing, tokens past an
+    expert's capacity are DROPPED from that expert's contribution
+    (the residual path still carries them), so logits can diverge
+    from HF even with identical weights. Raise ``moe_capacity_factor``
+    toward ``num_local_experts / num_experts_per_tok`` for exact-coverage
+    dispatch at the cost of padding FLOPs. See docs/ARCHITECTURE.md
+    ("MoE capacity")."""
     kw.setdefault("vocab_size", 32000)
     kw.setdefault("hidden_size", 4096)
     kw.setdefault("intermediate_size", 14336)
@@ -476,7 +488,13 @@ class LlamaSparseMoeBlock(Layer):
     SwiGLU experts batched over the MXU with capacity-based dispatch
     (the incubate MoELayer machinery, ep-shardable), routed by
     MixtralGate — softmax top-k renormalized over the selected
-    experts, HF load-balancing aux loss on ``self.gate.loss``."""
+    experts, HF load-balancing aux loss on ``self.gate.loss``.
+
+    NOT token-exact vs HF under skewed routing: capacity-based
+    dispatch (``config.moe_capacity_factor``) drops tokens past an
+    expert's fixed capacity, where HF's dynamic gather processes all
+    of them — see the :func:`mixtral_8x7b` docstring for the full
+    caveat and the capacity knob that recovers exact coverage."""
 
     def __init__(self, config: LlamaConfig):
         super().__init__()

@@ -1,41 +1,62 @@
 """paddle.nn.quant (upstream: python/paddle/nn/quant/) — weight-only
-quant helpers over the quantization framework."""
+quant helpers over the quantization framework.
+
+The math lives in ops/kernels/quant.py (symmetric abs-max layouts:
+int8 per-out-channel, int4 packed two-nibbles-per-byte per-group);
+this namespace is the reference-compatible functional surface."""
 from __future__ import annotations
 
 import jax.numpy as jnp
-import numpy as np
 
 from ...framework.core import Tensor, apply_op, _as_tensor
+from ...ops.kernels import quant as _Q
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear"]
 
 
-def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
-    """Symmetric per-channel int8 quantization: returns (int8 weight,
-    fp scale per out-channel) (upstream: nn/quant/quantized_linear.py).
-    """
+def _algo_dtype(algo):
+    if algo in ("weight_only_int8", "int8"):
+        return "int8"
+    if algo in ("weight_only_int4", "int4"):
+        return "int4"
+    raise ValueError(
+        f"unsupported weight-only algo {algo!r} "
+        "(weight_only_int8 | weight_only_int4)")
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None,
+                    group_size=-1):
+    """Symmetric abs-max quantization (upstream:
+    nn/quant/quantized_linear.py). int8: per-out-channel scale,
+    returns (int8 [in, out], f32 [out]). int4: per-group scale along
+    the IN axis, returns (uint8 packed [in//2, out],
+    f32 [in//group_size, out]); ``group_size=-1`` means one group."""
     x = _as_tensor(x)
-    w = np.asarray(x._data, np.float32)
-    scale = np.abs(w).max(axis=0) / 127.0
-    scale = np.maximum(scale, 1e-9)
-    q = np.clip(np.round(w / scale[None, :]), -127, 127).astype(np.int8)
-    return Tensor(q), Tensor(scale.astype(np.float32))
+    if _algo_dtype(algo) == "int8":
+        q, scale = _Q.quantize_int8(x._data)
+    else:
+        q, scale = _Q.quantize_int4(x._data, group_size)
+    return Tensor(q), Tensor(scale)
 
 
-def weight_dequantize(x, scale, algo="weight_only_int8"):
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      group_size=-1):
     x = _as_tensor(x)
     scale = _as_tensor(scale)
+    if _algo_dtype(algo) == "int8":
+        return apply_op("weight_dequantize", _Q.dequantize_int8,
+                        x, scale)
     return apply_op(
         "weight_dequantize",
-        lambda q, s: q.astype(jnp.float32) * s[None, :],
-        x, scale,
-    )
+        lambda q, s: _Q.dequantize_int4(q, s, group_size),
+        x, scale)
 
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
-    """x @ dequant(weight) + bias — the weight stays int8 in HBM and
-    dequantizes on the fly (XLA fuses the scale into the matmul)."""
+    """x @ dequant(weight) + bias — the weight stays int8/int4 in HBM
+    and dequantizes on the fly (XLA fuses the scale into the matmul;
+    int8 applies the per-out-channel scale AFTER the contraction)."""
     x = _as_tensor(x)
     weight = _as_tensor(weight)
     args = [x, weight]
@@ -46,15 +67,25 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     has_scale = weight_scale is not None
     has_bias = bias is not None
 
+    if not has_scale and weight_dtype != "int8":
+        # the int8 fallback (identity scale = treat the grid as the
+        # values) has no int4 analog: the per-group scale shape
+        # depends on group_size and sits on the contraction axis
+        raise ValueError(
+            "weight_only_linear: weight_scale is required for "
+            f"weight_dtype={weight_dtype!r}")
+
     def f(a, w, *rest):
         i = 0
-        wf = w.astype(jnp.float32)
         if has_scale:
-            wf = wf * rest[i][None, :]
+            scale = rest[i]
             i += 1
-        out = a.astype(jnp.float32) @ wf
-        if has_bias:
-            out = out + rest[i]
-        return out.astype(a.dtype)
+        else:
+            # unscaled int8 payload: treat the grid as the values
+            scale = jnp.ones((w.shape[-1],), jnp.float32)
+        b = rest[i] if has_bias else None
+        return _Q.weight_only_matmul(
+            a, w, scale, bias=b, weight_dtype=weight_dtype,
+            group_size=group_size)
 
     return apply_op("weight_only_linear", f, *args)

@@ -32,10 +32,18 @@ NEG_INF = -1e30
 
 
 def _decode_kernel(scale, page_size, kvh_per_q, max_pages, window,
-                   page_tbl_ref, lens_ref,
-                   q_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref):
+                   quant, *refs):
+    if quant:
+        # int8 pages: per-page, per-head scale sidecars ride scalar
+        # prefetch; dequant happens in VMEM right after the page DMA
+        (page_tbl_ref, lens_ref, k_scale_ref, v_scale_ref,
+         q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        (page_tbl_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+         m_ref, l_ref, acc_ref) = refs
+        k_scale_ref = v_scale_ref = None
     b = pl.program_id(0)
+    hq = pl.program_id(1)
     p = pl.program_id(2)
 
     @pl.when(p == 0)
@@ -58,6 +66,12 @@ def _decode_kernel(scale, page_size, kvh_per_q, max_pages, window,
         q = q_ref[0, 0]                   # (1, D) — the decode token
         k = k_ref[0, 0]                   # (page_size, D)
         v = v_ref[0, 0]
+        if quant:
+            phys = page_tbl_ref[b, p]
+            kvh = hq // kvh_per_q
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32) * k_scale_ref[phys, kvh]
+            v = v.astype(jnp.float32) * v_scale_ref[phys, kvh]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -87,18 +101,29 @@ def _decode_kernel(scale, page_size, kvh_per_q, max_pages, window,
 
 
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
-                    sm_scale=None, interpret=None, window=0):
+                    sm_scale=None, interpret=None, window=0,
+                    k_scales=None, v_scales=None):
     """q: (B, H, D); k_pages/v_pages: (NP, P, KVH, D);
     page_table: (B, max_pages) int32 physical-page ids;
     seq_lens: (B,) int32. ``window`` > 0 keeps only the last
     ``window`` keys (Mistral sliding attention; out-of-window pages
     are skipped entirely). Returns (B, H, D).
+
+    Quantized pages: pass int8 k_pages/v_pages plus per-page, per-head
+    scale sidecars k_scales/v_scales (NP, KVH) f32 — the pages DMA as
+    int8 (half the HBM traffic) and dequantize in VMEM inside the
+    kernel, scales riding scalar prefetch.
     """
     b, h, d = q.shape
     npages, page_size, kvh, _ = k_pages.shape
     max_pages = page_table.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     group = h // kvh
+    quant = k_scales is not None
+    if quant != (v_scales is not None):
+        raise ValueError(
+            "paged_attention: pass both k_scales and v_scales or "
+            "neither")
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -110,14 +135,20 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
     vp = jnp.transpose(v_pages, (2, 0, 1, 3))
     q4 = q.reshape(b, h, 1, d)
 
-    def q_map(b_, h_, p_, tbl, lens):
+    def q_map(b_, h_, p_, *pref):
         return (b_, h_, 0, 0)
 
-    def kv_map(b_, h_, p_, tbl, lens):
+    def kv_map(b_, h_, p_, tbl, *pref):
         return (h_ // group, tbl[b_, p_], 0, 0)
 
+    scalar_args = [page_table.astype(jnp.int32),
+                   seq_lens.astype(jnp.int32)]
+    if quant:
+        scalar_args += [k_scales.astype(jnp.float32),
+                        v_scales.astype(jnp.float32)]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(scalar_args),
         grid=(b, h, max_pages),
         in_specs=[
             pl.BlockSpec((1, 1, 1, d), q_map),
@@ -133,7 +164,7 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
     )
     kernel = functools.partial(
         _decode_kernel, float(scale), page_size, group, max_pages,
-        int(window or 0),
+        int(window or 0), quant,
     )
     out = pl.pallas_call(
         kernel,
@@ -144,7 +175,7 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ) if not interpret else None,
     )(
-        page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+        *scalar_args,
         q4, kp.reshape(kvh, npages, page_size, d),
         vp.reshape(kvh, npages, page_size, d),
     )
@@ -152,7 +183,8 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
 
 
 def paged_attention_reference(q, k_pages, v_pages, page_table,
-                              seq_lens, sm_scale=None, window=0):
+                              seq_lens, sm_scale=None, window=0,
+                              k_scales=None, v_scales=None):
     """Dense float32 reference for tests."""
     import numpy as np
 
@@ -163,6 +195,9 @@ def paged_attention_reference(q, k_pages, v_pages, page_table,
     qn = np.asarray(q, np.float32)
     kn = np.asarray(k_pages, np.float32)
     vn = np.asarray(v_pages, np.float32)
+    if k_scales is not None:
+        kn = kn * np.asarray(k_scales, np.float32)[:, None, :, None]
+        vn = vn * np.asarray(v_scales, np.float32)[:, None, :, None]
     tbl = np.asarray(page_table)
     lens = np.asarray(seq_lens)
     out = np.zeros((b, h, d), np.float32)
@@ -188,15 +223,22 @@ def paged_attention_reference(q, k_pages, v_pages, page_table,
 
 
 def _prefill_kernel(scale, page_size, group, max_pages, t, window,
-                    page_tbl_ref, lens_ref,
-                    q_ref, k_ref, v_ref, o_ref,
-                    m_ref, l_ref, acc_ref):
+                    quant, *refs):
     """Chunked-prefill: T new tokens per sequence attend causally to
     the whole paged prefix (the new tokens' K/V already live in the
     pages; seq_lens counts them). ``window`` > 0 bands the mask
     (0 <= qpos - kpos < window) and skips pages below every row's
-    window."""
+    window. ``quant``: int8 pages dequantized in VMEM via the
+    scalar-prefetched per-page scale sidecars."""
+    if quant:
+        (page_tbl_ref, lens_ref, k_scale_ref, v_scale_ref,
+         q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        (page_tbl_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+         m_ref, l_ref, acc_ref) = refs
+        k_scale_ref = v_scale_ref = None
     b = pl.program_id(0)
+    hq = pl.program_id(1)
     p = pl.program_id(2)
 
     @pl.when(p == 0)
@@ -218,6 +260,12 @@ def _prefill_kernel(scale, page_size, group, max_pages, t, window,
         q = q_ref[0, 0]                   # (T, D)
         k = k_ref[0, 0]                   # (page_size, D)
         v = v_ref[0, 0]
+        if quant:
+            phys = page_tbl_ref[b, p]
+            kvh = hq // group
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32) * k_scale_ref[phys, kvh]
+            v = v.astype(jnp.float32) * v_scale_ref[phys, kvh]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -255,19 +303,27 @@ def _prefill_kernel(scale, page_size, group, max_pages, t, window,
 
 
 def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
-                            sm_scale=None, interpret=None, window=0):
+                            sm_scale=None, interpret=None, window=0,
+                            k_scales=None, v_scales=None):
     """Ragged chunked-prefill over a paged KV cache.
 
     q: (B, T, H, D) — the T newest tokens of each sequence, whose K/V
     have already been appended to the pages; seq_lens counts them.
     Rows of lanes whose true new-token count < T should be masked by
     the caller (positions follow seq_len). Returns (B, T, H, D).
+    Int8 pages: pass k_scales/v_scales (NP, KVH) as in
+    :func:`paged_attention`.
     """
     b, t, h, d = q.shape
     npages, page_size, kvh, _ = k_pages.shape
     max_pages = page_table.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     group = h // kvh
+    quant = k_scales is not None
+    if quant != (v_scales is not None):
+        raise ValueError(
+            "paged_prefill_attention: pass both k_scales and v_scales "
+            "or neither")
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -282,14 +338,20 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
     )
     q4 = jnp.transpose(q, (0, 2, 1, 3))  # (B, H, T, D)
 
-    def q_map(b_, h_, p_, tbl, lens):
+    def q_map(b_, h_, p_, *pref):
         return (b_, h_, 0, 0)
 
-    def kv_map(b_, h_, p_, tbl, lens):
+    def kv_map(b_, h_, p_, tbl, *pref):
         return (h_ // group, tbl[b_, p_], 0, 0)
 
+    scalar_args = [page_table.astype(jnp.int32),
+                   seq_lens.astype(jnp.int32)]
+    if quant:
+        scalar_args += [k_scales.astype(jnp.float32),
+                        v_scales.astype(jnp.float32)]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(scalar_args),
         grid=(b, h, max_pages),
         in_specs=[
             pl.BlockSpec((1, 1, t, d), q_map),
@@ -305,7 +367,7 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
     )
     kernel = functools.partial(
         _prefill_kernel, float(scale), page_size, group, max_pages, t,
-        int(window or 0),
+        int(window or 0), quant,
     )
     out = pl.pallas_call(
         kernel,
@@ -316,7 +378,7 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ) if not interpret else None,
     )(
-        page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+        *scalar_args,
         q4, kp, vp,
     )
     return jnp.transpose(out, (0, 2, 1, 3))

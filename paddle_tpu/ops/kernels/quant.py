@@ -1,0 +1,213 @@
+"""Quantization kernels for the serving stack — weight-only int8/int4
+and int8 KV-page helpers.
+
+Upstream analogs: paddle/phi/kernels/fusion's weight_only_linear /
+weight_quantize kernel family and the cache-KV int8 path of
+fused_multi_transformer_op.cu. Design follows the bytes-are-the-
+bottleneck argument of EQuARX (XLA-level quantization, see PAPERS.md):
+TPU decode is HBM-bandwidth-bound, so weights and KV pages live in HBM
+as int8 (or packed int4) and dequantize in registers AFTER the DMA —
+the matmul/attention reads half (or a quarter) of the bytes, and XLA
+fuses the scale multiply into the consuming op.
+
+Layouts (all symmetric, zero-point-free — abs-max calibration):
+
+* int8 weights:  ``q[in, out] int8`` + ``scale[out] f32`` per
+  OUT-channel (``w ≈ q * scale``). The scale applies AFTER the matmul
+  (``(x @ q) * scale``), so the MXU contraction itself runs on the
+  quantized payload.
+* int4 weights:  two nibbles per byte along the IN axis —
+  ``packed[in//2, out] uint8`` where row ``i`` holds logical rows
+  ``2i`` (low nibble) and ``2i+1`` (high nibble) — + per-GROUP scales
+  ``scale[in//group_size, out] f32`` (group_size along IN). Per-group
+  scaling must happen before the contraction, so int4 dequantizes to
+  f32 in registers first.
+* int8 KV pages: pages store int8; a per-page, PER-HEAD scale sidecar
+  ``(num_pages, kv_heads) f32`` rides next to the pool (see
+  incubate/nn/paged_cache.py). Dequant is fused into the paged
+  attention kernels (ops/kernels/paged_attention.py): scales ride
+  scalar prefetch and multiply in VMEM after the page DMA.
+
+Everything here is pure jnp (traced-path clean); host-side reference
+oracles live in the ``*_reference`` functions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+INT8_QMAX = 127.0
+INT4_QMAX = 7.0
+
+__all__ = [
+    "quantize_int8", "dequantize_int8",
+    "quantize_int4", "dequantize_int4",
+    "pack_int4", "unpack_int4",
+    "quantize_kv", "dequantize_kv", "kv_head_scale",
+    "weight_only_matmul",
+]
+
+
+# ---------------------------------------------------------------------------
+# int8 per-channel weights
+# ---------------------------------------------------------------------------
+
+
+def quantize_int8(w):
+    """Symmetric per-out-channel int8: w[in, out] -> (q int8,
+    scale[out] f32) with q = round(w / scale), scale = absmax/127."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=0) / INT8_QMAX
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(wf / scale[None, :]), -INT8_QMAX, INT8_QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_int8(q, scale):
+    return q.astype(jnp.float32) * scale[None, :]
+
+
+# ---------------------------------------------------------------------------
+# int4 per-group weights (two nibbles per byte)
+# ---------------------------------------------------------------------------
+
+
+def pack_int4(q):
+    """Pack int8 values in [-8, 7] two-per-byte along axis 0.
+
+    q[in, out] (in even) -> packed[in//2, out] uint8; packed row i
+    holds logical rows 2i (low nibble) and 2i+1 (high nibble)."""
+    qu = q.astype(jnp.uint8)  # two's complement wrap keeps the nibble
+    lo = qu[0::2] & 0xF
+    hi = (qu[1::2] & 0xF) << 4
+    return hi | lo
+
+
+def unpack_int4(packed):
+    """Inverse of :func:`pack_int4`: uint8[n, out] -> int8[2n, out]
+    with nibble sign extension."""
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = (packed >> 4).astype(jnp.int8)
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    n, out = packed.shape
+    return jnp.stack([lo, hi], axis=1).reshape(2 * n, out)
+
+
+def quantize_int4(w, group_size=64):
+    """Symmetric per-group int4: w[in, out] -> (packed[in//2, out]
+    uint8, scale[in//group_size, out] f32). Groups run along the IN
+    axis; ``in`` must divide by group_size (and group_size by 2)."""
+    din, dout = w.shape
+    if group_size <= 0:
+        group_size = din
+    if din % group_size or group_size % 2:
+        raise ValueError(
+            f"int4 group quant: in-features {din} must divide by an "
+            f"even group_size (got {group_size})")
+    wf = w.astype(jnp.float32).reshape(din // group_size, group_size,
+                                       dout)
+    scale = jnp.max(jnp.abs(wf), axis=1) / INT4_QMAX  # (G, out)
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(wf / scale[:, None, :]),
+                 -INT4_QMAX, INT4_QMAX)
+    q = q.reshape(din, dout).astype(jnp.int8)
+    return pack_int4(q), scale
+
+
+def dequantize_int4(packed, scale, group_size=64):
+    """packed[in//2, out] + scale[G, out] -> f32[in, out]."""
+    q = unpack_int4(packed)
+    din, dout = q.shape
+    if group_size <= 0:
+        group_size = din
+    wf = q.astype(jnp.float32).reshape(din // group_size, group_size,
+                                       dout)
+    return (wf * scale[:, None, :]).reshape(din, dout)
+
+
+# ---------------------------------------------------------------------------
+# the weight-only contraction
+# ---------------------------------------------------------------------------
+
+
+def weight_only_matmul(x, qweight, scale, bias=None,
+                       weight_dtype="int8", group_size=-1):
+    """x @ dequant(qweight) + bias with the weight resident as
+    int8/int4. int8 keeps the scale OUTSIDE the contraction
+    ((x @ q) * scale — same math, the MXU reads int8); int4 dequants
+    per group in registers first (the scale varies along the
+    contraction axis)."""
+    xf = x.astype(jnp.float32)
+    lead = xf.shape[:-1]
+    xf2 = xf.reshape(-1, xf.shape[-1])
+    if weight_dtype == "int8":
+        out = (xf2 @ qweight.astype(jnp.float32)) * scale[None, :]
+    elif weight_dtype == "int4":
+        wf = dequantize_int4(qweight, scale, group_size)
+        out = xf2 @ wf
+    else:
+        raise ValueError(
+            f"weight_only_matmul: weight_dtype must be int8|int4, "
+            f"got {weight_dtype!r}")
+    if bias is not None:
+        out = out + bias
+    return out.reshape(lead + (out.shape[-1],)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# int8 KV pages
+# ---------------------------------------------------------------------------
+
+
+def quantize_kv(kv, scale):
+    """Quantize token K/V slabs against a fixed per-head scale.
+
+    kv: (..., KVH, D) float; scale: (..., KVH) f32 broadcastable over
+    the leading axes. Returns int8 of kv's shape. A zero scale (empty
+    page) quantizes to zeros."""
+    s = jnp.maximum(scale, 1e-20)[..., None]
+    q = jnp.round(kv.astype(jnp.float32) / s)
+    return jnp.clip(q, -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+
+
+def dequantize_kv(q, scale):
+    """int8 (..., KVH, D) + per-head scale (..., KVH) -> f32."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def kv_head_scale(kv, keep_leading=0):
+    """Per-head abs-max scale of a K/V slab: reduce every axis except
+    the KVH axis (-2) and the first ``keep_leading`` batch axes
+    (scale = absmax / 127 — the page-granularity calibration rule).
+
+    (P, KVH, D) -> (KVH,); with keep_leading=1, (B, KVH, D) -> (B, KVH)
+    (one scale per written token per head)."""
+    red = tuple(range(keep_leading, kv.ndim - 2)) + (kv.ndim - 1,)
+    return jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=red) \
+        / INT8_QMAX
+
+
+# ---------------------------------------------------------------------------
+# host-side oracles (tests)
+# ---------------------------------------------------------------------------
+
+
+def weight_only_matmul_reference(x, w, weight_dtype="int8",
+                                 group_size=-1):
+    """Quantize w on the fly and run the fp contraction — the quality
+    oracle quant tests compare against."""
+    import numpy as np
+
+    xf = np.asarray(x, np.float32)
+    wf = np.asarray(w, np.float32)
+    if weight_dtype == "int8":
+        scale = np.maximum(np.abs(wf).max(axis=0) / INT8_QMAX, 1e-9)
+        q = np.clip(np.round(wf / scale[None, :]), -127, 127)
+        return xf @ (q * scale[None, :])
+    din, dout = wf.shape
+    gs = din if group_size <= 0 else group_size
+    wg = wf.reshape(din // gs, gs, dout)
+    scale = np.maximum(np.abs(wg).max(axis=1) / INT4_QMAX, 1e-9)
+    q = np.clip(np.round(wg / scale[:, None, :]), -7, 7)
+    return xf @ (q * scale[:, None, :]).reshape(din, dout)

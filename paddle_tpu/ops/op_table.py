@@ -500,6 +500,8 @@ for _diff, _dts, _profile, _names in _DECL_GROUPS:
 _NOT_OPS = {
     "apply_op", "np_or_jax", "next_key", "to_np_dtype", "builtins_min",
     "infer_meta",
+    # model-surgery driver (quantization/ptq_llm.py), not a tensor op
+    "quantize_for_serving",
 }
 
 

@@ -21,6 +21,8 @@ __all__ = [
     "QuantConfig", "QAT", "PTQ",
     "AbsMaxObserver", "MovingAverageAbsMaxObserver",
     "FakeQuanterWithAbsMaxObserver", "quanters", "observers",
+    # serving-side weight-only PTQ (ptq_llm.py)
+    "WeightOnlyLinear", "quantize_for_serving",
 ]
 
 
@@ -273,6 +275,8 @@ class PTQ:
                 sub.activation_quanter = fq
         return model
 
+
+from .ptq_llm import WeightOnlyLinear, quantize_for_serving  # noqa: E402
 
 import types as _types
 

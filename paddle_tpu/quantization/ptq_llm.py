@@ -1,0 +1,186 @@
+"""Post-training weight-only quantization for the serving stack.
+
+Upstream analog: PaddleNLP's weight-only serving path
+(paddle.nn.quant.weight_only_linear over weight_quantize'd
+checkpoints) — the real-deployment counterpart of this package's
+fake-quant/QAT simulation layers.
+
+Decode on TPU is HBM-bandwidth-bound and weight bytes dominate the
+per-token read traffic, so serving wants the weights RESIDENT in HBM
+as int8 (per-out-channel scale) or packed int4 (two nibbles per byte,
+per-group scale) and dequantized after the DMA — see
+ops/kernels/quant.py for the layouts. This module does the model
+surgery:
+
+* :class:`WeightOnlyLinear` — drop-in serving replacement for a
+  Linear / ColumnParallelLinear / RowParallelLinear: holds the
+  quantized payload + scales as buffers and runs
+  ``nn.quant.weight_only_linear``;
+* :func:`quantize_for_serving` — abs-max-calibrate and swap every
+  matching linear in a model (Llama/GPT/Mixtral attention + MLP
+  projections) in place, returning a byte-accounting report;
+* checkpoint-load integration: ``models.convert.from_hf(...,
+  weight_dtype="int8")`` loads the fp checkpoint then calls
+  :func:`quantize_for_serving`, so the fp weights never outlive load.
+
+Scope: single-replica serving (mp degree 1). The tensor-parallel
+linears carry collective semantics that the swapped layer does not
+reproduce; quantize_for_serving refuses under an active mp mesh.
+Mixtral's stacked expert tensors (``mlp.moe.w0/w1``) are 3-D batched
+weights, not linears — they stay fp (documented limitation; the
+attention/router linears still quantize).
+"""
+from __future__ import annotations
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.kernels import quant as Q
+
+__all__ = ["WeightOnlyLinear", "quantize_for_serving",
+           "DEFAULT_SKIP_PATTERNS"]
+
+# embeddings and the lm head stay fp by default: the embedding gather
+# reads one row per token (not bandwidth-bound) and head logit error
+# lands directly on the sampled distribution
+DEFAULT_SKIP_PATTERNS = ("embed", "lm_head", "wte", "wpe", "shared")
+
+
+class WeightOnlyLinear(Layer):
+    """Serving linear with the weight resident as int8/int4.
+
+    Buffers (persistable — they ride ``state_dict``):
+      * ``qweight`` — int8 [in, out], or uint8 [in//2, out] packed
+        nibbles for int4;
+      * ``weight_scale`` — f32 [out] (int8) or [in//group_size, out]
+        (int4);
+      * ``bias`` — optional f32 [out].
+    """
+
+    def __init__(self, in_features, out_features, qweight, scale,
+                 bias=None, weight_dtype="int8", group_size=-1):
+        super().__init__()
+        if weight_dtype not in ("int8", "int4"):
+            raise ValueError(
+                f"weight_dtype must be int8|int4, got {weight_dtype!r}")
+        self._in_features = int(in_features)
+        self._out_features = int(out_features)
+        self.weight_dtype = weight_dtype
+        self.group_size = int(group_size)
+        self.register_buffer("qweight", _as_buffer(qweight))
+        self.register_buffer("weight_scale", _as_buffer(scale))
+        if bias is not None:
+            self.register_buffer("bias", _as_buffer(bias))
+        else:
+            self.bias = None
+
+    @classmethod
+    def from_linear(cls, layer, weight_dtype="int8", group_size=64):
+        """Abs-max-quantize ``layer.weight`` ([in, out]) and build the
+        serving replacement."""
+        w = layer.weight._data
+        din, dout = int(w.shape[0]), int(w.shape[1])
+        if weight_dtype == "int4" and din % 2:
+            # int4 packs two IN-axis rows per byte: an odd in_features
+            # cannot pack — degrade this layer to int8 rather than
+            # crash or pad (per-layer dtype, the rest stay int4)
+            weight_dtype = "int8"
+        if weight_dtype == "int8":
+            q, s = Q.quantize_int8(w)
+            group_size = -1
+        else:
+            if din % max(group_size, 1):
+                group_size = din  # whole-axis group for odd multiples
+            q, s = Q.quantize_int4(w, group_size)
+        bias = getattr(layer, "bias", None)
+        return cls(din, dout, q, s,
+                   bias=None if bias is None else bias._data,
+                   weight_dtype=weight_dtype, group_size=group_size)
+
+    def forward(self, x):
+        from ..nn.quant import weight_only_linear
+
+        return weight_only_linear(
+            x, self.qweight, bias=self.bias,
+            weight_scale=self.weight_scale,
+            weight_dtype=self.weight_dtype,
+            group_size=self.group_size)
+
+    def weight_nbytes(self) -> int:
+        """HBM bytes of the quantized payload + scales."""
+        n = self.qweight._data.size * self.qweight._data.dtype.itemsize
+        n += (self.weight_scale._data.size
+              * self.weight_scale._data.dtype.itemsize)
+        return int(n)
+
+    def extra_repr(self):
+        g = f", group_size={self.group_size}" \
+            if self.weight_dtype == "int4" else ""
+        return (f"in_features={self._in_features}, "
+                f"out_features={self._out_features}, "
+                f"weight_dtype={self.weight_dtype}{g}")
+
+
+def _as_buffer(x):
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    t.persistable = True
+    t.stop_gradient = True
+    return t
+
+
+def _linear_types():
+    from ..distributed.fleet.layers.mpu.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+    )
+    from ..nn.layer.common import Linear
+
+    return (Linear, ColumnParallelLinear, RowParallelLinear)
+
+
+def quantize_for_serving(model, weight_dtype="int8", group_size=64,
+                         skip_patterns=DEFAULT_SKIP_PATTERNS):
+    """Swap every linear whose path avoids ``skip_patterns`` for a
+    :class:`WeightOnlyLinear`, IN PLACE (serving wants the fp copies
+    gone from HBM, not shadowed). Returns a report dict:
+    ``{"layers": n, "fp_bytes": ..., "quant_bytes": ...,
+    "weight_dtype": ...}``.
+    """
+    from ..distributed.mesh import axis_degree
+
+    if axis_degree("mp") > 1:
+        raise NotImplementedError(
+            "quantize_for_serving: tensor-parallel (mp>1) linears "
+            "carry collective semantics the weight-only swap drops; "
+            "quantize before entering the mesh or serve mp=1")
+    lin_types = _linear_types()
+    report = {"layers": 0, "fp_bytes": 0, "quant_bytes": 0,
+              "weight_dtype": weight_dtype, "group_size": group_size,
+              "paths": []}
+
+    def visit(layer, prefix=""):
+        for name, child in list(layer.named_children()):
+            path = f"{prefix}.{name}" if prefix else name
+            if isinstance(child, lin_types):
+                if any(pat in path for pat in skip_patterns):
+                    continue
+                wol = WeightOnlyLinear.from_linear(
+                    child, weight_dtype=weight_dtype,
+                    group_size=group_size)
+                w = child.weight._data
+                report["fp_bytes"] += int(
+                    w.size * w.dtype.itemsize)
+                report["quant_bytes"] += wol.weight_nbytes()
+                report["layers"] += 1
+                report["paths"].append(path)
+                layer.add_sublayer(name, wol)
+            elif isinstance(child, WeightOnlyLinear):
+                continue  # idempotent re-entry
+            else:
+                visit(child, path)
+
+    visit(model)
+    if not report["layers"]:
+        raise ValueError(
+            "quantize_for_serving: no quantizable linears found "
+            f"(skip_patterns={skip_patterns!r})")
+    return report

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
-from ...framework.core import Tensor, _as_tensor
+from ...framework.core import Tensor, _as_tensor, assign_state
 from .. import SparseCooTensor, SparseCsrTensor, _coo
 
 
@@ -207,11 +207,30 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             "sparse batch_norm needs a dense channel tail: build the "
             "COO with values of shape [nnz, C] (sparse spatial dims, "
             "dense channels)")
-    rm = _as_tensor(running_mean)._data
-    rv = _as_tensor(running_var)._data
+    running_mean = _as_tensor(running_mean)
+    running_var = _as_tensor(running_var)
+    rm = running_mean._data
+    rv = running_var._data
     if training and not use_global_stats:
         mean = v.mean(axis=0)
         var = v.var(axis=0)
+        # momentum blend of the running stats, exactly the dense
+        # batch_norm rule (nn/functional/norm.py): the reference
+        # updates them in training so eval normalizes with learned
+        # statistics, not the stale initial zeros/ones
+        nnz = v.shape[0]
+        unbiased = var * (nnz / max(nnz - 1, 1))
+        new_rm = (momentum * rm.astype(jnp.float32)
+                  + (1 - momentum) * mean.astype(jnp.float32)
+                  ).astype(rm.dtype)
+        new_rv = (momentum * rv.astype(jnp.float32)
+                  + (1 - momentum) * unbiased.astype(jnp.float32)
+                  ).astype(rv.dtype)
+        # assign_state, not a bare ._data write: the same writeback
+        # path the dense batch_norm uses (static-graph recording
+        # replays it at Executor time instead of capturing a tracer)
+        assign_state(running_mean, Tensor(new_rm))
+        assign_state(running_var, Tensor(new_rv))
     else:
         mean, var = rm, rv
     out = (v - mean) * jax.lax.rsqrt(var + epsilon)

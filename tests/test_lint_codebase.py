@@ -128,3 +128,56 @@ class TestOpTableMessages:
 
         msg = describe_ops(["matmul"])
         assert "tensor.linalg" in msg
+
+
+class TestQuantSidecarRule:
+    """ISSUE-3 satellite: the int8 KV pool's per-page scale sidecars
+    (k_scales/v_scales) are pool-private; a serving-layer write
+    bypassing the requantize/COW paths must be flagged."""
+
+    def test_seeded_direct_assignment_flagged(self):
+        bad = (
+            "class S:\n"
+            "    def step(self, cache):\n"
+            "        cache.k_scales = None\n"
+            "        cache.v_scales += 1\n"
+        )
+        v = lint_codebase.lint_quant_sidecar_file(
+            "fake/serving.py", text=bad)
+        assert len(v) == 2, v
+        assert "k_scales" in v[0] and "v_scales" in v[1]
+
+    def test_seeded_functional_update_flagged(self):
+        bad = (
+            "def evict(cache, p):\n"
+            "    cache.k_scales = cache.k_scales.at[p].set(0.0)\n"
+        )
+        v = lint_codebase.lint_quant_sidecar_file(
+            "fake/serving.py", text=bad)
+        # both the rebind and the .at[...] update are caught
+        assert len(v) == 2, v
+        assert any(".at[...]" in s for s in v)
+
+    def test_reads_allowed(self):
+        ok = (
+            "def stats(cache):\n"
+            "    return cache.k_scales, cache.v_scales.shape\n"
+        )
+        assert lint_codebase.lint_quant_sidecar_file(
+            "fake/serving.py", text=ok) == []
+
+    def test_waiver_comment_suppresses(self):
+        text = (
+            "def f(cache):\n"
+            "    cache.k_scales = 0  # trace-lint: ok(test waiver)\n"
+        )
+        assert lint_codebase.lint_quant_sidecar_file(
+            "fake/serving.py", text=text) == []
+
+    def test_serving_modules_are_covered(self):
+        assert lint_codebase.check_quant_sidecar_writes() == []
+        dirs = [os.path.join(REPO, d)
+                for d in lint_codebase.QUANT_SIDECAR_DIRS]
+        assert any(d.endswith("inference") for d in dirs)
+        for d in dirs:
+            assert os.path.isdir(d), d

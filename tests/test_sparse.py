@@ -233,3 +233,45 @@ class TestSparseFamilyR5:
             if m.any():
                 np.testing.assert_allclose(sm[i][m].sum(), 1.0,
                                            rtol=1e-5)
+
+    def test_batch_norm_updates_running_stats(self):
+        # regression (ADVICE r5): training-mode sparse batch_norm must
+        # blend running_mean/running_var with momentum, exactly the
+        # dense rule — eval after training used to normalize with the
+        # stale initial zeros/ones
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        import paddle_tpu.sparse as sp
+        import paddle_tpu.sparse.nn.functional as spf
+
+        rng = np.random.RandomState(5)
+        vals = (rng.randn(6, 3) * 2 + 1.5).astype("float32")
+        idx = np.stack([np.zeros(6), np.arange(6)]).astype("int64")
+        x = sp.SparseCooTensor(jsparse.BCOO(
+            (jnp.asarray(vals), jnp.asarray(idx.T)), shape=(1, 8, 3)))
+        rm = paddle.to_tensor(np.zeros(3, "float32"))
+        rv = paddle.to_tensor(np.ones(3, "float32"))
+        momentum = 0.9
+        out = spf.batch_norm(x, rm, rv, training=True,
+                             momentum=momentum)
+        mean = vals.mean(axis=0)
+        var = vals.var(axis=0)
+        unbiased = var * 6 / 5
+        np.testing.assert_allclose(
+            rm.numpy(), (1 - momentum) * mean, rtol=1e-5)
+        np.testing.assert_allclose(
+            rv.numpy(), momentum + (1 - momentum) * unbiased,
+            rtol=1e-5)
+        # the normalization itself still uses the BATCH stats
+        got = np.asarray(out.values()._data)
+        want = (vals - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        # eval mode consumes (and does not touch) the running stats
+        rm2, rv2 = rm.numpy().copy(), rv.numpy().copy()
+        out_eval = spf.batch_norm(x, rm, rv, training=False)
+        np.testing.assert_allclose(rm.numpy(), rm2)
+        np.testing.assert_allclose(rv.numpy(), rv2)
+        got = np.asarray(out_eval.values()._data)
+        want = (vals - rm2) / np.sqrt(rv2 + 1e-5)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

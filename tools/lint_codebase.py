@@ -22,6 +22,13 @@ Checks:
    scheduler's admission path stalls every step. The public
    ``paddle_tpu.inference`` surface is also checked for raw jax
    callables leaking through.
+4. quantized-page sidecar ownership: the int8 KV pool's per-page
+   scale sidecars (``k_scales``/``v_scales`` on PagedKVCacheManager)
+   are pool-private calibration state — a serving-layer write that
+   bypasses the pool's requantize-on-append / COW-copy paths silently
+   corrupts every shared reader of the page. Serving modules
+   (paddle_tpu/inference/) may READ them through the pool API but
+   must never assign, aug-assign, or ``.at[...]``-update them.
 
 Run: JAX_PLATFORMS=cpu python tools/lint_codebase.py
 Wired as a tier-1 test in tests/test_lint_codebase.py.
@@ -198,6 +205,89 @@ def check_host_only(root=REPO):
     return out
 
 
+# serving-layer modules barred from writing the quantized-page scale
+# sidecars (pool-private state; see paddle_cache's _quant_write)
+QUANT_SIDECAR_DIRS = (
+    os.path.join("paddle_tpu", "inference"),
+)
+
+_SIDECAR_ATTRS = ("k_scales", "v_scales")
+
+
+class _SidecarWriteVisitor(ast.NodeVisitor):
+    """Flags writes to the quantized-page scale sidecars from serving
+    code: attribute assignment (x.k_scales = ..., x.k_scales += ...)
+    and functional updates (x.k_scales.at[...] — the jnp mutation
+    idiom, which is always followed by a rebind)."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+
+    def _flag(self, lineno, what):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        if _WAIVER_MARK not in line:
+            self.violations.append(
+                "%s:%d: %s — quantized-page scale sidecars are pool-"
+                "private (mutate only via the PagedKVCacheManager "
+                "append/COW paths); fix it or waive with '%s(<reason>)'"
+                % (self.relpath, lineno, what, _WAIVER_MARK))
+
+    def _sidecar_target(self, node):
+        return (isinstance(node, ast.Attribute)
+                and node.attr in _SIDECAR_ATTRS)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            for sub in ast.walk(t):
+                if self._sidecar_target(sub):
+                    self._flag(node.lineno,
+                               "assignment to .%s" % sub.attr)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        for sub in ast.walk(node.target):
+            if self._sidecar_target(sub):
+                self._flag(node.lineno,
+                           "augmented assignment to .%s" % sub.attr)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        # x.k_scales.at[...] — the functional-update idiom
+        if node.attr == "at" and self._sidecar_target(node.value):
+            self._flag(node.lineno,
+                       ".%s.at[...] update" % node.value.attr)
+        self.generic_visit(node)
+
+
+def lint_quant_sidecar_file(path, text=None):
+    """Sidecar-write check for one file; returns violation strings."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _SidecarWriteVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_quant_sidecar_writes(root=REPO):
+    out = []
+    for d in QUANT_SIDECAR_DIRS:
+        full = os.path.join(root, d)
+        for fn in sorted(os.listdir(full)):
+            if fn.endswith(".py"):
+                out.extend(
+                    lint_quant_sidecar_file(os.path.join(full, fn)))
+    return out
+
+
 def check_inference_surface():
     """No raw jax callable may leak through the public
     ``paddle_tpu.inference`` namespace (same leak rule the op
@@ -279,6 +369,7 @@ def check_op_table():
 def run_lint(root=REPO, with_op_table=True):
     out = check_traced_paths(root)
     out.extend(check_host_only(root))
+    out.extend(check_quant_sidecar_writes(root))
     if with_op_table:
         out.extend(check_op_table())
         out.extend(check_inference_surface())
