@@ -14,9 +14,13 @@ verified locally — so blind injection would be worse than nothing.)
 
 What still needs a mechanism is DEPLOYMENT flag propagation: tuning
 flags (e.g. ``--xla_tpu_scoped_vmem_limit_kib``, SparseCore offload
-toggles, collective-matmul thresholds) must reach EVERY worker's
-environment before its backend initializes. This module is that
-mechanism:
+toggles) must reach EVERY worker's environment before its backend
+initializes. This module is that mechanism. (Collective-matmul
+thresholds are NOT an XLA flag here: the ring decomposition of the
+TP/SP collective+matmul pairs is native — ops/kernels/
+collective_matmul.py behind ``FLAGS_collective_matmul`` /
+``FLAGS_collective_matmul_min_bytes``, framework/flags.py; see
+docs/OVERLAP.md.)
 
 * ``FLAGS_xla_comm_extra_flags`` — a space-separated XLA flag string
   (set via env ``FLAGS_xla_comm_extra_flags=...`` or

@@ -22,7 +22,7 @@ from .....nn.layer.layers import Layer
 from ....mesh import axis_degree, global_mesh, named_sharding
 from ...base.topology import get_hybrid_communicate_group
 from .mp_ops import _c_concat, _c_identity, _c_split, _mp_allreduce, \
-    shard_constraint
+    collective_matmul_dispatch, shard_constraint
 
 
 def _place(param: Tensor, *spec):
@@ -127,6 +127,14 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         hcg = get_hybrid_communicate_group()
         g = hcg.get_model_parallel_group() if hcg else None
+        if self.gather_output and _mp_degree() > 1:
+            # matmul + output all-gather as a weight-rotating ring
+            # (FLAGS_collective_matmul); the ring's VJP completes the
+            # grad psum, so _c_identity is folded in
+            out = collective_matmul_dispatch(
+                "mm_ag", x, self.weight, bias=self.bias, group=g)
+            if out is not None:
+                return out
         x = _c_identity(x, group=g)
         out = F.linear(x, self.weight, self.bias)
         if _mp_degree() > 1:
@@ -168,6 +176,14 @@ class RowParallelLinear(Layer):
         g = hcg.get_model_parallel_group() if hcg else None
         if not self.input_is_parallel and _mp_degree() > 1:
             x = _c_split(x, group=g)
+        if _mp_degree() > 1:
+            # matmul + allreduce decomposed as a ring matmul-reduce-
+            # scatter plus a tiled re-gather: the reduction half rides
+            # the ring (FLAGS_collective_matmul)
+            out = collective_matmul_dispatch(
+                "mm_ar", x, self.weight, bias=self.bias, group=g)
+            if out is not None:
+                return out
         out = F.linear(x, self.weight, None)
         if _mp_degree() > 1:
             out = _mp_allreduce(out, group=g)

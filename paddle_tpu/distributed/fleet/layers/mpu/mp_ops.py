@@ -7,8 +7,19 @@ the partitioner inserts the all-reduce/all-gather exactly where the
 reference's hand-written collective ops run (and fuses them into the
 surrounding computation). In a manual shard_map context they lower to
 explicit lax collectives with matching fwd/bwd semantics.
+
+``collective_matmul_dispatch`` is the single routing point for the
+*dependent* collective+matmul pairs these layers emit: behind
+FLAGS_collective_matmul it replaces the blocking chain with the
+ring-decomposed kernels (ops/kernels/collective_matmul.py), either
+directly inside an active manual region or via a partial-manual
+shard_map over the mp axis in the GSPMD context. New TP/SP code must
+route matmul+collective pairs through it rather than hand-rolling
+blocking chains (tools/lint_codebase.py enforces this).
 """
 from __future__ import annotations
+
+import functools
 
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
@@ -137,6 +148,200 @@ def _c_concat(tensor, group=None):
         concat.defvjp(fwd, bwd)
         return apply_op("c_concat", concat, tensor)
     return shard_constraint(tensor)
+
+
+# ---------------------------------------------------------------------------
+# collective matmul routing (FLAGS_collective_matmul)
+# ---------------------------------------------------------------------------
+
+_CM_KINDS = ("ag_mm", "mm_rs", "mm_ar", "mm_ag")
+
+# one jit'd shard_map per (kind, axis, degree, seq-axis, rank, mesh) —
+# see the cache note at the build site
+_CM_JIT_CACHE: dict = {}
+
+
+def _rows(t):
+    """Row count with the trailing (feature) dim collapsed."""
+    return t.size // t.shape[-1]
+
+
+def _cm_axis(group, axis):
+    """Resolve (axis_name, degree) for the decomposition ring from an
+    explicit comm group (mp_layers) or a bare axis name (SP utils)."""
+    if group is not None or axis is None:
+        g = _resolve(group)
+        ax = _axis(group)
+        return (ax, g.nranks) if isinstance(ax, str) else (None, 1)
+    from ....mesh import axis_degree
+
+    return axis, axis_degree(axis)
+
+
+def collective_matmul_dispatch(kind, x, w, bias=None, group=None,
+                               axis=None, seq_axis=0):
+    """Route a dependent collective+matmul pair through the ring-
+    decomposed subsystem (ops/kernels/collective_matmul.py).
+
+    kinds:
+      ag_mm  all_gather(x, seq_axis) @ w      SP/column entry
+      mm_rs  psum_scatter(x @ w, seq_axis)    SP/row exit
+      mm_ar  psum(x @ w)                      RowParallelLinear
+                                              (= mm_rs + all_gather:
+                                              the reduce half rides
+                                              the ring)
+      mm_ag  all_gather(x @ w, -1)            ColumnParallelLinear
+                                              gather_output
+
+    Returns the output Tensor (bias included), or None when the policy
+    declines — FLAGS_collective_matmul off/auto-below-threshold, degree
+    1, or a chunk dim that doesn't divide the ring — in which case the
+    caller falls through to its plain blocking chain UNCHANGED (the
+    off-path lowering stays bit-identical).
+    """
+    from .....ops.kernels import collective_matmul as cm
+
+    if cm.decompose_mode() == "off" or kind not in _CM_KINDS:
+        return None
+    ax, ws = _cm_axis(group, axis)
+    if ax is None or ws <= 1:
+        return None
+    x, w = _as_tensor(x), _as_tensor(w)
+    if x.ndim < 2 or w.ndim != 2:
+        return None
+    itemsize = jax.numpy.dtype(x._data.dtype).itemsize
+    manual = in_manual_context((ax,))
+    if not manual:
+        m = global_mesh()
+        if m is None or ax not in m.axis_names:
+            return None
+        # jax<0.5 legacy shard_map cannot lower ring collectives in a
+        # PARTIAL-manual region under an outer SPMD partition when any
+        # other mesh axis is live (XLA rejects the axis_index/ppermute
+        # lowering with PartitionId / manual-subgroup check failures —
+        # verified in-container; the sep-axis ring attention has the
+        # same latent limit). Decompose only when the ring axis is the
+        # sole >1-degree axis; newer jax keeps the multi-axis path.
+        if getattr(jax, "shard_map", None) is None:
+            from ....mesh import active_axis_info
+
+            degrees = active_axis_info()["degrees"]
+            if any(d > 1 for name, d in degrees.items() if name != ax):
+                return None
+
+    rows = _rows(x)
+    n_out = int(w.shape[-1])
+    if kind == "ag_mm":
+        comm = x.size * itemsize * (ws if manual else 1)
+    elif kind == "mm_ag":
+        comm = rows * n_out * itemsize * (ws if manual else 1)
+    else:  # mm_rs / mm_ar: the partial product fed to the reduction
+        comm = rows * n_out * itemsize
+
+    if kind == "mm_ar":
+        # the reduced output is re-gathered tiled over a leading dim;
+        # pick the first one the ring divides
+        sa = next((i for i in range(x.ndim - 1)
+                   if x.shape[i] % ws == 0), None)
+        if sa is None:
+            return None
+    else:
+        sa = seq_axis
+
+    if manual:
+        ok = {
+            "ag_mm": True,
+            "mm_rs": x.shape[sa] % ws == 0,
+            "mm_ar": True,
+            "mm_ag": bias is None,  # out is full-dim; bias is a shard
+        }[kind]
+    else:
+        ok = {
+            "ag_mm": x.shape[sa] % ws == 0 and w.shape[1] % ws == 0,
+            "mm_rs": x.shape[-1] % ws == 0 and w.shape[0] % ws == 0
+            and x.shape[sa] % ws == 0,
+            "mm_ar": x.shape[-1] % ws == 0 and w.shape[0] % ws == 0,
+            "mm_ag": w.shape[1] % ws == 0,
+        }[kind]
+    if not cm.should_decompose(comm, ws, ok):
+        return None
+
+    # ONE local ring per kind, shared by both execution contexts so the
+    # lowerings cannot desynchronize. mm_ar/mm_ag take the cotangent
+    # convention switch: tape_ct under the manual tape (replicated,
+    # complete cotangents), shard_map-transpose semantics otherwise —
+    # see the kernel docstrings.
+    local = {
+        "ag_mm": functools.partial(
+            cm.all_gather_matmul, axis_name=ax, axis_size=ws,
+            gather_axis=sa),
+        "mm_rs": functools.partial(
+            cm.matmul_reduce_scatter, axis_name=ax, axis_size=ws,
+            scatter_axis=sa),
+        "mm_ar": functools.partial(
+            cm.matmul_all_reduce, axis_name=ax, axis_size=ws,
+            scatter_axis=sa, tape_ct=manual),
+        "mm_ag": functools.partial(
+            cm.matmul_all_gather, axis_name=ax, axis_size=ws,
+            tape_ct=manual),
+    }[kind]
+
+    if manual:
+        out = apply_op("collective_matmul_" + kind, local, x, w)
+        return out if bias is None else out + bias
+
+    from ....mesh import shard_map as _shard_map
+
+    nd = x.ndim
+    none = [None] * nd
+    x_seq = list(none)
+    x_seq[sa] = ax
+    x_hid = list(none)
+    x_hid[-1] = ax
+    out_hid = list(none)
+    out_hid[-1] = ax
+    in_specs, out_specs = {
+        "ag_mm": ((PartitionSpec(*x_seq), PartitionSpec(None, ax)),
+                  PartitionSpec(*out_hid)),
+        "mm_rs": ((PartitionSpec(*x_hid), PartitionSpec(ax, None)),
+                  PartitionSpec(*x_seq)),
+        "mm_ar": ((PartitionSpec(*x_hid), PartitionSpec(ax, None)),
+                  PartitionSpec(*none)),
+        "mm_ag": ((PartitionSpec(*none), PartitionSpec(None, ax)),
+                  PartitionSpec(*none)),
+    }[kind]
+    mesh = global_mesh()
+
+    def sm_fn(xr, wr, local=local, in_specs=in_specs,
+              out_specs=out_specs):
+        return _shard_map(
+            local, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs, axis_names={ax},
+        )(xr, wr)
+
+    # Context-sensitive wrapping: inside an enclosing trace
+    # (@to_static step) the shard_map must lower DIRECTLY into the
+    # surrounding program — a nested pjit makes the outer SPMD
+    # partitioner reject the manual axis_index lowering
+    # (PartitionId). In eager mode the opposite holds: the legacy
+    # shard_map auto path only lowers under a jit, so wrap — cached
+    # per routing signature so eager layers reuse the compile instead
+    # of paying a retrace per forward.
+    if isinstance(x._data, jax.core.Tracer) \
+            or isinstance(w._data, jax.core.Tracer):
+        global_fn = sm_fn
+    else:
+        key = (kind, ax, ws, sa, nd, mesh)
+        global_fn = _CM_JIT_CACHE.get(key)
+        if global_fn is None:
+            # evict signatures of dead meshes (rebuilt via
+            # build_global_mesh) so retired executables don't pile up
+            for k in [k for k in _CM_JIT_CACHE if k[-1] is not mesh]:
+                del _CM_JIT_CACHE[k]
+            global_fn = _CM_JIT_CACHE[key] = jax.jit(sm_fn)
+
+    out = apply_op("collective_matmul_" + kind, global_fn, x, w)
+    return out if bias is None else out + bias
 
 
 def split(x, size, operation="linear", axis=0, num_partitions=1,

@@ -14,6 +14,12 @@ halved, as Megatron-SP promises). The partitioner emits exactly those
 collectives from the constraints below and fuses them with the matmuls.
 The reference's "register an allreduce hook for SP-region param grads"
 disappears: gradients of global arrays are already complete.
+
+The SP linears' dependent collective+matmul pairs (gather-then-matmul
+entering, matmul-then-reduce-scatter leaving) additionally route
+through mp_ops.collective_matmul_dispatch: behind
+FLAGS_collective_matmul they decompose into chunked ppermute rings
+that hide the collective behind the chunk matmuls (docs/OVERLAP.md).
 """
 from __future__ import annotations
 
@@ -196,8 +202,17 @@ class ColumnSequenceParallelLinear(Layer):
             _place(self.bias, "mp")
 
     def forward(self, x):
-        x = AllGatherOp.apply(x)
-        out = F.linear(x, self.weight, self.bias)
+        from ..layers.mpu.mp_ops import collective_matmul_dispatch
+
+        # SP entry: the sequence all-gather + matmul pair, ring-
+        # decomposed behind FLAGS_collective_matmul (plain chain kept
+        # bit-identical when the policy declines)
+        out = collective_matmul_dispatch(
+            "ag_mm", x, self.weight, bias=self.bias, axis="mp",
+            seq_axis=0)
+        if out is None:
+            x = AllGatherOp.apply(x)
+            out = F.linear(x, self.weight, self.bias)
         if not self.gather_output:
             out = _constrain(
                 out, [None] * (out.ndim - 1) + ["mp"]
@@ -227,8 +242,15 @@ class RowSequenceParallelLinear(Layer):
         )
 
     def forward(self, x):
-        out = F.linear(x, self.weight, None)
-        out = ReduceScatterOp.apply(out)
+        from ..layers.mpu.mp_ops import collective_matmul_dispatch
+
+        # SP exit: the matmul + sequence reduce-scatter pair, ring-
+        # decomposed behind FLAGS_collective_matmul
+        out = collective_matmul_dispatch(
+            "mm_rs", x, self.weight, axis="mp", seq_axis=0)
+        if out is None:
+            out = F.linear(x, self.weight, None)
+            out = ReduceScatterOp.apply(out)
         if self.bias is not None:
             out = out + self.bias
         return out

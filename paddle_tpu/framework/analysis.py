@@ -27,6 +27,11 @@ otherwise only surface as slow steps or hangs on real TPUs:
   unsharded-compute          warning   matmul/conv eqn above the FLOPs
                                        threshold with every operand
                                        replicated on a >1-device mesh
+  overlap-miss               warning   blocking all_gather whose sole
+                                       consumer is an over-threshold
+                                       dot_general (a pair the
+                                       collective-matmul ring would
+                                       decompose; docs/OVERLAP.md)
 
 Modes (FLAGS_jit_lint): ``off`` — analysis never runs, compiled
 programs are bit-for-bit unaffected; ``warn`` (default) — findings go
@@ -97,6 +102,11 @@ UNSHARDED_COMPUTE = _rule(
     "unsharded-compute", "warning",
     "matmul/conv eqn above the FLOPs threshold with all operands "
     "replicated on a multi-device mesh")
+OVERLAP_MISS = _rule(
+    "overlap-miss", "warning",
+    "blocking all_gather whose sole consumer is a large dot_general: "
+    "the dependent pair serializes instead of riding the "
+    "collective-matmul ring")
 
 # primitives allowed to consume low precision and produce wide floats:
 # numerically-motivated accumulation (the reference's CINN/AMP lists
@@ -542,6 +552,44 @@ def _check_unsharded_compute(items, mesh_info: dict,
         )
 
 
+def _check_overlap_miss(items, out: _RuleLimiter):
+    """A blocking ``all_gather`` feeding ONLY a ``dot_general`` is the
+    exact dependent pair XLA's latency-hiding scheduler cannot overlap
+    (it can reorder independent collectives, not decompose a
+    dependency). Above the collective-matmul size threshold this is
+    the overlap the ring decomposition would recover — the pair means
+    FLAGS_collective_matmul is off, declining, or bypassed by a
+    hand-rolled chain."""
+    threshold = float(
+        _flag("collective_matmul_min_bytes", 4 << 20) or (4 << 20))
+    consumers: Dict[int, list] = {}
+    for eqn, _, _ in items:
+        for v in eqn.invars:
+            consumers.setdefault(id(v), []).append(eqn)
+    for eqn, path, _ in items:
+        if eqn.primitive.name != "all_gather" or len(eqn.outvars) != 1:
+            continue
+        cons = consumers.get(id(eqn.outvars[0]), [])
+        if len(cons) != 1 or cons[0].primitive.name != "dot_general":
+            continue
+        shape = _aval_shape(eqn.outvars[0])
+        dt = getattr(getattr(eqn.outvars[0], "aval", None), "dtype", None)
+        nbytes = _prod(shape) * float(getattr(dt, "itemsize", 4) or 4)
+        if nbytes < threshold:
+            continue
+        out.add(
+            OVERLAP_MISS,
+            "all_gather of %.3g MiB feeds only a dot_general: the "
+            "gather blocks the matmul it could overlap (threshold "
+            "%.3g MiB)" % (nbytes / 2**20, threshold / 2**20),
+            where=path,
+            suggestion="route the pair through the collective-matmul "
+            "subsystem (ops/kernels/collective_matmul.py via "
+            "mp_ops.collective_matmul_dispatch) or enable "
+            "FLAGS_collective_matmul; see docs/OVERLAP.md",
+        )
+
+
 def _check_weak_consts(closed, out: _RuleLimiter):
     constvars = getattr(closed.jaxpr, "constvars", ())
     for i, v in enumerate(constvars):
@@ -621,6 +669,7 @@ def analyze_jaxpr(closed, *, name: str = "<jaxpr>",
     _check_collectives(items, mesh_info["axes"], out)
     _check_cond_branches(items, out)
     _check_unsharded_compute(items, mesh_info, out)
+    _check_overlap_miss(items, out)
     _check_weak_consts(closed, out)
     _check_static_scalars(static_meta, t_shapes, out)
     if donation:

@@ -118,6 +118,20 @@ define_flag("jit_lint_flops_threshold", 1e10,
             "unsharded-compute threshold: a single matmul/conv eqn "
             "above this many FLOPs with every operand replicated on a "
             ">1-device mesh fires the rule")
+define_flag("collective_matmul", "auto",
+            "ring-decomposed collective+matmul for the TP/SP hot path "
+            "(ops/kernels/collective_matmul.py): 'off' keeps the plain "
+            "blocking all_gather/reduce-scatter chains (bit-identical "
+            "lowering), 'on' decomposes wherever structurally possible, "
+            "'auto' decomposes only above "
+            "FLAGS_collective_matmul_min_bytes — tiny matmuls lose to "
+            "ring hop latency (docs/OVERLAP.md; the deployment-tuning "
+            "companion of distributed/comm_flags.py)")
+define_flag("collective_matmul_min_bytes", 4 << 20,
+            "auto-mode decomposition threshold: decompose a dependent "
+            "collective+matmul pair only when the blocking collective "
+            "would move at least this many bytes; also the trace "
+            "linter's overlap-miss threshold (framework/analysis.py)")
 define_flag("moe_dense_dispatch", False,
             "route MoE tokens via the dense (N,E,C) one-hot "
             "dispatch/combine einsums instead of the sparse index "

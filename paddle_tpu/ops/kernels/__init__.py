@@ -60,3 +60,9 @@ from .paged_attention import (  # noqa
     paged_attention_reference,
     paged_prefill_attention,
 )
+from .collective_matmul import (  # noqa
+    all_gather_matmul,
+    matmul_all_gather,
+    matmul_all_reduce,
+    matmul_reduce_scatter,
+)

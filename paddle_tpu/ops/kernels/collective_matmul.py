@@ -1,0 +1,396 @@
+"""Collective matmul — ring-decomposed collective+matmul pairs for the
+tensor-parallel hot path.
+
+The TP/SP layers (fleet/layers/mpu, fleet/utils/sequence_parallel_utils)
+emit *dependent* collective+matmul pairs: ``all_gather -> dot`` entering
+a column-parallel linear and ``dot -> psum_scatter`` (or ``psum``)
+leaving a row-parallel one. XLA's latency-hiding scheduler overlaps
+*independent* collectives with compute, but it cannot decompose a
+dependency — the gather must finish before the first MXU tile starts.
+T3 (arxiv 2401.16677) and fused computation-collective ops (arxiv
+2305.06942) show that chunking the pair into a ``lax.ppermute`` ring —
+multiply the locally-held shard while the next shard is in flight —
+hides most of the collective time. This module is that decomposition,
+following the ring pattern proven in fleet/utils/context_parallel.py.
+
+Three decompositions, each with a custom VJP whose backward is ALSO a
+ring (the transpose of an AG-matmul is a matmul-RS and vice versa, so
+overlap is preserved through autodiff):
+
+  all_gather_matmul      AG(x, axis) @ w          SP entry (column)
+  matmul_reduce_scatter  psum_scatter(x @ w)      SP exit (row)
+  matmul_all_gather      AG(x @ w, last-dim)      column out-gather;
+                                                  rotates WEIGHT shards
+                                                  (K x N/w per hop vs
+                                                  S x N/w for outputs)
+
+A matmul+allreduce (plain RowParallelLinear) decomposes as
+``all_gather(matmul_reduce_scatter(x, w))`` — the reduce half rides the
+ring, only the gather half stays blocking.
+
+Ring layout (w = axis size, step t in 0..w-1, device d):
+  * AG-matmul rotates the x shard: the shard held at step t came from
+    device (d - t) mod w, so its product lands in output chunk
+    (d - t) mod w. One ppermute per step, overlapped with the chunk
+    matmul by XLA's async collective scheduling.
+  * matmul-RS rotates the partial-sum carry: at step t device d adds
+    its local product for row-chunk (d - 1 - t) mod w to the incoming
+    carry; after w steps the carry at d is the fully-reduced chunk d.
+
+Numerics: per-chunk products are the same matmuls the plain path runs
+(row/column blocks are independent), so AG-matmul and matmul-AG match
+the fused path to roundoff-identical values; ring reductions add
+partial sums in neighbor order, which differs from ``psum_scatter``'s
+reduction order only in floating-point association (same tolerance
+class as any collective reorder).
+
+Policy (`FLAGS_collective_matmul`): "off" — never decompose, callers
+keep their plain blocking chains bit-for-bit; "on" — decompose wherever
+structurally possible; "auto" — decompose only when the blocking
+collective would move at least FLAGS_collective_matmul_min_bytes (tiny
+matmuls lose to ring latency: w-1 hops of launch overhead against a
+sub-microsecond gather).
+
+This module is jax-only (no host-side imports): every function body
+runs inside jit traces under shard_map; tools/lint_codebase.py enforces
+the discipline.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+_MODES = ("auto", "on", "off")
+
+
+def decompose_mode() -> str:
+    """FLAGS_collective_matmul, normalized; unknown values read 'off'
+    (a typo'd deployment flag must not silently change lowering)."""
+    try:
+        from ...framework.flags import flag
+
+        mode = str(flag("collective_matmul")).lower()
+    except Exception:
+        return "off"
+    return mode if mode in _MODES else "off"
+
+
+def min_bytes() -> int:
+    try:
+        from ...framework.flags import flag
+
+        return int(flag("collective_matmul_min_bytes"))
+    except Exception:
+        return 1 << 62
+
+
+def should_decompose(comm_bytes, axis_size, divisible=True) -> bool:
+    """The auto/on/off gate shared by the layer dispatch
+    (mp_ops.collective_matmul_dispatch) and the trace linter's
+    overlap-miss threshold. ``comm_bytes`` is the payload the blocking
+    collective would move; ``divisible`` is the structural check (chunk
+    dims divide the axis size — a remainder chunk would need a second,
+    unbalanced ring)."""
+    if axis_size <= 1 or not divisible:
+        return False
+    mode = decompose_mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return int(comm_bytes) >= min_bytes()
+
+
+# ---------------------------------------------------------------------------
+# ring helpers
+# ---------------------------------------------------------------------------
+
+
+def _ring_perm(ws):
+    # one hop toward the next rank: after t hops the block held at
+    # device d originated at (d - t) mod ws — the ICI neighbor exchange
+    return [(i, (i + 1) % ws) for i in range(ws)]
+
+
+def _chunk(x, i, size, axis):
+    return jax.lax.dynamic_slice_in_dim(x, i * size, size, axis)
+
+
+def _put_chunk(buf, part, i, size, axis):
+    return jax.lax.dynamic_update_slice_in_dim(buf, part, i * size, axis)
+
+
+def _batch_dims(x):
+    """Contraction dims for the dW accumulation: everything but the
+    trailing feature dim, on both operands."""
+    return tuple(range(x.ndim - 1))
+
+
+# ---------------------------------------------------------------------------
+# all_gather_matmul: AG(x, gather_axis) @ w
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ag_matmul(axis_name, ws, gather_axis, x, w):
+    my = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(ws)
+    s_loc = x.shape[gather_axis]
+    cur = x
+    out = None
+    for t in range(ws):
+        part = jnp.matmul(cur, w)
+        if out is None:
+            shape = list(part.shape)
+            shape[gather_axis] = s_loc * ws
+            out = jnp.zeros(shape, part.dtype)
+        src = (my - t) % ws
+        out = _put_chunk(out, part, src, s_loc, gather_axis)
+        if t < ws - 1:
+            cur = jax.lax.ppermute(cur, axis_name, perm)
+    return out
+
+
+def _ag_matmul_fwd(axis_name, ws, gather_axis, x, w):
+    return _ag_matmul(axis_name, ws, gather_axis, x, w), (x, w)
+
+
+def _ag_matmul_bwd(axis_name, ws, gather_axis, res, ct):
+    # dx = psum_scatter(ct @ w^T, gather_axis)  -> carry ring
+    # dw = AG(x)^T @ ct                          -> shard ring
+    # one fused loop, two in-flight ppermutes per step
+    x, w = res
+    my = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(ws)
+    s_loc = x.shape[gather_axis]
+    wt = jnp.swapaxes(w, 0, 1)
+    dims = _batch_dims(x)
+    cur = x
+    carry = None
+    dw = None
+    for t in range(ws):
+        c = (my - 1 - t) % ws
+        p = jnp.matmul(_chunk(ct, c, s_loc, gather_axis), wt)
+        if carry is None:
+            carry = p
+        else:
+            carry = jax.lax.ppermute(carry, axis_name, perm) + p
+        src = (my - t) % ws
+        contrib = jnp.tensordot(
+            cur, _chunk(ct, src, s_loc, gather_axis), axes=(dims, dims))
+        dw = contrib if dw is None else dw + contrib
+        if t < ws - 1:
+            cur = jax.lax.ppermute(cur, axis_name, perm)
+    return carry, dw.astype(w.dtype)
+
+
+_ag_matmul.defvjp(_ag_matmul_fwd, _ag_matmul_bwd)
+
+
+def all_gather_matmul(x, w, *, axis_name, axis_size, gather_axis=0):
+    """Ring-decomposed ``all_gather(x, gather_axis) @ w`` over a manual
+    mesh axis. x: the LOCAL shard (chunk ``axis_index`` of the gathered
+    operand); w: the local weight (full or column-shard — the ring
+    never moves it). Output carries the full gathered leading dim."""
+    return _ag_matmul(axis_name, int(axis_size), int(gather_axis), x, w)
+
+
+# ---------------------------------------------------------------------------
+# matmul_reduce_scatter: psum_scatter(x @ w, scatter_axis)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _matmul_rs(axis_name, ws, scatter_axis, x, w):
+    my = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(ws)
+    s_loc = x.shape[scatter_axis] // ws
+    carry = None
+    for t in range(ws):
+        c = (my - 1 - t) % ws
+        p = jnp.matmul(_chunk(x, c, s_loc, scatter_axis), w)
+        if carry is None:
+            carry = p
+        else:
+            carry = jax.lax.ppermute(carry, axis_name, perm) + p
+    return carry
+
+
+def _matmul_rs_fwd(axis_name, ws, scatter_axis, x, w):
+    return _matmul_rs(axis_name, ws, scatter_axis, x, w), (x, w)
+
+
+def _matmul_rs_bwd(axis_name, ws, scatter_axis, res, ct):
+    # dx = AG(ct, scatter_axis) @ w^T  and  dw = x^T @ AG(ct): both
+    # consume the rotating ct shard — a single ring serves both.
+    x, w = res
+    my = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(ws)
+    s_loc = ct.shape[scatter_axis]
+    wt = jnp.swapaxes(w, 0, 1)
+    dims = _batch_dims(x)
+    cur = ct
+    dx = None
+    dw = None
+    for t in range(ws):
+        src = (my - t) % ws
+        p = jnp.matmul(cur, wt)
+        if dx is None:
+            shape = list(p.shape)
+            shape[scatter_axis] = s_loc * ws
+            dx = jnp.zeros(shape, p.dtype)
+        dx = _put_chunk(dx, p, src, s_loc, scatter_axis)
+        contrib = jnp.tensordot(
+            _chunk(x, src, s_loc, scatter_axis), cur, axes=(dims, dims))
+        dw = contrib if dw is None else dw + contrib
+        if t < ws - 1:
+            cur = jax.lax.ppermute(cur, axis_name, perm)
+    return dx, dw.astype(w.dtype)
+
+
+_matmul_rs.defvjp(_matmul_rs_fwd, _matmul_rs_bwd)
+
+
+def matmul_reduce_scatter(x, w, *, axis_name, axis_size, scatter_axis=0):
+    """Ring-decomposed ``psum_scatter(x @ w, scatter_axis)`` over a
+    manual mesh axis. x: local rows with the FULL scatter dim (it must
+    divide axis_size); w: the local (row-shard) weight. Output holds
+    this device's reduced chunk of the scatter dim."""
+    return _matmul_rs(axis_name, int(axis_size), int(scatter_axis), x, w)
+
+
+# -- tiled re-gather with the eager-tape VJP convention ---------------------
+# jax's own all_gather transposes to psum_scatter: correct under
+# shard_map AD (per-device cotangents), but under the framework's
+# manual-region tape the cotangent arrives replicated and COMPLETE, so
+# that transpose over-counts by the axis size. The tape-convention
+# gather slices this device's chunk instead — the _c_concat rule.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _tape_all_gather(axis_name, ws, axis, x):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def _tape_ag_fwd(axis_name, ws, axis, x):
+    return _tape_all_gather(axis_name, ws, axis, x), x.shape[axis]
+
+
+def _tape_ag_bwd(axis_name, ws, axis, s_loc, ct):
+    my = jax.lax.axis_index(axis_name)
+    return (_chunk(ct, my, s_loc, axis),)
+
+
+_tape_all_gather.defvjp(_tape_ag_fwd, _tape_ag_bwd)
+
+
+def matmul_all_reduce(x, w, *, axis_name, axis_size, scatter_axis=0,
+                      tape_ct=False):
+    """Ring-decomposed ``psum(x @ w)``: the matmul-reduce-scatter ring
+    (the reduction half, overlapped) followed by a tiled re-gather of
+    the reduced chunks (the only blocking half left). ``tape_ct=True``
+    selects the eager-tape backward convention of the framework's
+    manual regions for the re-gather (replicated, already-complete
+    cotangents are SLICED, not psum-scattered — the same convention
+    switch matmul_all_gather takes)."""
+    part = matmul_reduce_scatter(
+        x, w, axis_name=axis_name, axis_size=axis_size,
+        scatter_axis=scatter_axis)
+    if tape_ct:
+        return _tape_all_gather(
+            axis_name, int(axis_size), int(scatter_axis), part)
+    return jax.lax.all_gather(
+        part, axis_name, axis=scatter_axis, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# matmul_all_gather: AG(x @ w, last dim) — weight-rotating ring
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _matmul_ag(axis_name, ws, tape_ct, x, w):
+    my = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(ws)
+    n_loc = w.shape[1]
+    axis = x.ndim - 1
+    cur = w
+    out = None
+    for t in range(ws):
+        part = jnp.matmul(x, cur)
+        if out is None:
+            shape = list(part.shape)
+            shape[axis] = n_loc * ws
+            out = jnp.zeros(shape, part.dtype)
+        src = (my - t) % ws
+        out = _put_chunk(out, part, src, n_loc, axis)
+        if t < ws - 1:
+            cur = jax.lax.ppermute(cur, axis_name, perm)
+    return out
+
+
+def _matmul_ag_fwd(axis_name, ws, tape_ct, x, w):
+    return _matmul_ag(axis_name, ws, tape_ct, x, w), (x, w)
+
+
+def _matmul_ag_bwd(axis_name, ws, tape_ct, res, ct):
+    # dx = ct @ W_full^T = sum over column chunks (rotate w again; the
+    # ring sums every weight shard locally, REPLACING the plain path's
+    # grad psum). dw = x^T @ (the summed-over-devices ct chunk that hit
+    # THIS device's columns): the output is replicated over the axis,
+    # so the chunk cotangent must be reduced across devices — a second
+    # carry on the same ring, the transpose of the forward's gather
+    # (algebraically psum_scatter(ct)[my], exactly what the plain
+    # lowering's all_gather transpose produces). Under the eager-tape
+    # manual-region convention (tape_ct=True) cotangents arrive
+    # replicated and already complete — there the plain chain
+    # (_c_concat's hand-written VJP) slices locally, so we must too.
+    x, w = res
+    my = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(ws)
+    n_loc = w.shape[1]
+    axis = x.ndim - 1
+    dims = _batch_dims(x)
+    cur = w
+    dx = None
+    carry = None
+    for t in range(ws):
+        src = (my - t) % ws
+        contrib = jnp.matmul(
+            _chunk(ct, src, n_loc, axis), jnp.swapaxes(cur, 0, 1))
+        dx = contrib if dx is None else dx + contrib
+        if not tape_ct:
+            c = (my - 1 - t) % ws
+            piece = _chunk(ct, c, n_loc, axis)
+            if carry is None:
+                carry = piece
+            else:
+                carry = jax.lax.ppermute(carry, axis_name, perm) + piece
+        if t < ws - 1:
+            cur = jax.lax.ppermute(cur, axis_name, perm)
+    if tape_ct:
+        carry = _chunk(ct, my, n_loc, axis)
+    dw = jnp.tensordot(x, carry, axes=(dims, dims))
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_matmul_ag.defvjp(_matmul_ag_fwd, _matmul_ag_bwd)
+
+
+def matmul_all_gather(x, w, *, axis_name, axis_size, tape_ct=False):
+    """Ring-decomposed ``all_gather(x @ w, axis=-1)`` over a manual
+    mesh axis, rotating the WEIGHT column-shard (K x N/w bytes per hop
+    instead of the S x N/w output chunk). x: local activations
+    (replicated over the axis); w: this device's column shard. Output
+    is the full gathered feature dim, identical on every device.
+    ``tape_ct=True`` selects the eager-tape backward convention of the
+    framework's manual regions (replicated, already-complete
+    cotangents) instead of shard_map transpose semantics."""
+    return _matmul_ag(axis_name, int(axis_size), bool(tape_ct), x, w)

@@ -502,6 +502,10 @@ _NOT_OPS = {
     "infer_meta",
     # model-surgery driver (quantization/ptq_llm.py), not a tensor op
     "quantize_for_serving",
+    # state-writeback helper (framework/core.py) leaking through
+    # sparse.nn.functional's namespace since the batch-norm momentum
+    # fix — an internal mechanism, not a tensor op
+    "assign_state",
 }
 
 

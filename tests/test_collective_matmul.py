@@ -1,0 +1,473 @@
+"""Collective matmul (ops/kernels/collective_matmul.py + the
+mp_ops.collective_matmul_dispatch routing): the ring-decomposed
+all_gather-matmul / matmul-reduce_scatter / matmul-all_gather must be
+numerically equivalent to the plain blocking chains — forward AND
+grads — on CPU meshes at mp in {2, 4}, with odd chunk remainders and
+in bf16 as well as fp32; and FLAGS_collective_matmul=off must restore
+the exact prior lowering (bit-identical jaxpr)."""
+import contextlib
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.mesh import (
+    build_global_mesh,
+    reset_mesh,
+    shard_map,
+)
+from paddle_tpu.framework.flags import _REGISTRY as _FLAGS
+from paddle_tpu.ops.kernels import collective_matmul as cm
+
+from conftest import reset_dist_state as _reset
+
+
+@contextlib.contextmanager
+def flags(**kw):
+    saved = {k: _FLAGS[k] for k in kw}
+    paddle.set_flags({"FLAGS_" + k: v for k, v in kw.items()})
+    try:
+        yield
+    finally:
+        paddle.set_flags({"FLAGS_" + k: v for k, v in saved.items()})
+
+
+def _tol(dtype):
+    # ring reductions re-associate partial sums (same class of reorder
+    # as any collective implementation change)
+    return 1e-4 if dtype == jnp.float32 else 3e-1
+
+
+# ---------------------------------------------------------------------------
+# kernel level: ring vs plain chain inside one shard_map
+# ---------------------------------------------------------------------------
+
+# odd per-shard chunk (3 rows) — no power-of-two assumptions in the ring
+S_LOC, B, K, N = 3, 2, 8, 16
+
+
+@pytest.fixture(params=[2, 4], ids=["mp2", "mp4"])
+def mp_mesh(request):
+    reset_mesh()
+    mesh = build_global_mesh(("mp",), (request.param,))
+    yield request.param, mesh
+    reset_mesh()
+
+
+def _data(ws, dtype, rng_seed=0):
+    rng = np.random.RandomState(rng_seed)
+    x = jnp.asarray(rng.randn(S_LOC * ws, B, K), dtype)
+    w = jnp.asarray(rng.randn(K, N), dtype)
+    cot = jnp.asarray(rng.randn(S_LOC * ws, B, N), dtype)
+    return x, w, cot
+
+
+def _check_pair(f_plain, f_ring, x, w, cot, tol):
+    o_p = np.asarray(f_plain(x, w), np.float32)
+    o_r = np.asarray(f_ring(x, w), np.float32)
+    np.testing.assert_allclose(o_r, o_p, rtol=tol, atol=tol)
+
+    def loss(fn):
+        return lambda a, b: jnp.sum(
+            fn(a, b).astype(jnp.float32) * cot.astype(jnp.float32))
+
+    g_p = jax.grad(loss(f_plain), argnums=(0, 1))(x, w)
+    g_r = jax.grad(loss(f_ring), argnums=(0, 1))(x, w)
+    for a, b in zip(g_p, g_r):
+        np.testing.assert_allclose(
+            np.asarray(b, np.float32), np.asarray(a, np.float32),
+            rtol=tol * 10, atol=tol * 10)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["fp32", "bf16"])
+class TestRingKernels:
+    def test_all_gather_matmul(self, mp_mesh, dtype):
+        ws, mesh = mp_mesh
+        x, w, cot = _data(ws, dtype)
+        specs = dict(in_specs=(P("mp", None, None), P(None, "mp")),
+                     out_specs=P(None, None, "mp"))
+
+        def plain(xl, wl):
+            return jnp.matmul(
+                jax.lax.all_gather(xl, "mp", axis=0, tiled=True), wl)
+
+        ring = functools.partial(
+            cm.all_gather_matmul, axis_name="mp", axis_size=ws,
+            gather_axis=0)
+        _check_pair(
+            shard_map(plain, mesh=mesh, **specs),
+            shard_map(lambda a, b: ring(a, b), mesh=mesh, **specs),
+            x, w, cot, _tol(dtype))
+
+    def test_matmul_reduce_scatter(self, mp_mesh, dtype):
+        ws, mesh = mp_mesh
+        x, w, cot = _data(ws, dtype)
+        specs = dict(in_specs=(P(None, None, "mp"), P("mp", None)),
+                     out_specs=P("mp", None, None))
+
+        def plain(xl, wl):
+            return jax.lax.psum_scatter(
+                jnp.matmul(xl, wl), "mp", scatter_dimension=0,
+                tiled=True)
+
+        ring = functools.partial(
+            cm.matmul_reduce_scatter, axis_name="mp", axis_size=ws,
+            scatter_axis=0)
+        _check_pair(
+            shard_map(plain, mesh=mesh, **specs),
+            shard_map(lambda a, b: ring(a, b), mesh=mesh, **specs),
+            x, w, cot, _tol(dtype))
+
+    def test_matmul_all_gather(self, mp_mesh, dtype):
+        ws, mesh = mp_mesh
+        x, w, cot = _data(ws, dtype)
+        specs = dict(in_specs=(P(None, None, None), P(None, "mp")),
+                     out_specs=P(None, None, None))
+
+        def plain(xl, wl):
+            return jax.lax.all_gather(
+                jnp.matmul(xl, wl), "mp", axis=2, tiled=True)
+
+        ring = functools.partial(
+            cm.matmul_all_gather, axis_name="mp", axis_size=ws)
+        _check_pair(
+            shard_map(plain, mesh=mesh, **specs),
+            shard_map(lambda a, b: ring(a, b), mesh=mesh, **specs),
+            x, w, cot, _tol(dtype))
+
+    def test_matmul_all_gather_matches_true_grads(self, mp_mesh, dtype):
+        # the replicated-output transpose is the subtle one (the chunk
+        # cotangent must be ring-reduced across devices): pin against
+        # the unsharded ground truth, not just the plain chain
+        ws, mesh = mp_mesh
+        x, w, cot = _data(ws, dtype)
+        ring = functools.partial(
+            cm.matmul_all_gather, axis_name="mp", axis_size=ws)
+        f_r = shard_map(
+            lambda a, b: ring(a, b), mesh=mesh,
+            in_specs=(P(None, None, None), P(None, "mp")),
+            out_specs=P(None, None, None))
+        tol = _tol(dtype) * 10
+        g_t = jax.grad(
+            lambda a, b: jnp.sum(
+                jnp.matmul(a, b).astype(jnp.float32)
+                * cot.astype(jnp.float32)), argnums=(0, 1))(x, w)
+        g_r = jax.grad(
+            lambda a, b: jnp.sum(
+                f_r(a, b).astype(jnp.float32)
+                * cot.astype(jnp.float32)), argnums=(0, 1))(x, w)
+        for a, b in zip(g_t, g_r):
+            np.testing.assert_allclose(
+                np.asarray(b, np.float32), np.asarray(a, np.float32),
+                rtol=tol, atol=tol)
+
+
+class TestPolicy:
+    def test_mode_normalization(self):
+        with flags(collective_matmul="on"):
+            assert cm.decompose_mode() == "on"
+        with flags(collective_matmul="bogus"):
+            assert cm.decompose_mode() == "off"
+
+    def test_should_decompose_gates(self):
+        with flags(collective_matmul="auto",
+                   collective_matmul_min_bytes=1024):
+            assert cm.should_decompose(2048, 4)
+            assert not cm.should_decompose(512, 4)
+            assert not cm.should_decompose(2048, 1)
+            assert not cm.should_decompose(2048, 4, divisible=False)
+        with flags(collective_matmul="on"):
+            assert cm.should_decompose(0, 2)
+        with flags(collective_matmul="off"):
+            assert not cm.should_decompose(1 << 40, 8)
+
+
+# ---------------------------------------------------------------------------
+# layer level: dispatch routing under a hybrid mp mesh (GSPMD context)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=[2, 4], ids=["mp2", "mp4"])
+def mp_grid(request):
+    _reset()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1,
+                               "mp_degree": request.param}
+    fleet.init(is_collective=True, strategy=strategy)
+    yield request.param
+    _reset()
+
+
+def _run_layer(ctor, x_np, mode):
+    """Forward + backward one layer under FLAGS_collective_matmul=mode;
+    returns (out, dx, dw) as float32 numpy."""
+    with flags(collective_matmul=mode):
+        paddle.seed(0)
+        with paddle.utils.unique_name.guard():
+            layer = ctor()
+        xt = paddle.to_tensor(x_np.copy())
+        xt.stop_gradient = False
+        out = layer(xt)
+        (out * out).sum().backward()
+        return (np.asarray(out._data, np.float32),
+                np.asarray(xt.grad._data, np.float32),
+                np.asarray(layer.weight.grad._data, np.float32))
+
+
+def _assert_on_matches_off(ctor, x_np, tol=2e-4):
+    ref = _run_layer(ctor, x_np, "off")
+    got = _run_layer(ctor, x_np, "on")
+    for a, b in zip(got, ref):
+        np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
+
+
+class TestLayerDispatch:
+    def test_row_parallel_linear(self, mp_grid):
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+            RowParallelLinear,
+        )
+
+        x = np.random.RandomState(0).randn(8, 12, 32).astype("float32")
+        _assert_on_matches_off(
+            lambda: RowParallelLinear(32, 16, has_bias=True,
+                                      input_is_parallel=True), x)
+
+    def test_column_parallel_linear_gather_output(self, mp_grid):
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+            ColumnParallelLinear,
+        )
+
+        x = np.random.RandomState(1).randn(4, 6, 32).astype("float32")
+        _assert_on_matches_off(
+            lambda: ColumnParallelLinear(32, 16, has_bias=True,
+                                         gather_output=True), x)
+
+    def test_column_sequence_parallel_linear(self, mp_grid):
+        from paddle_tpu.distributed.fleet.utils.\
+            sequence_parallel_utils import ColumnSequenceParallelLinear
+
+        x = np.random.RandomState(2).randn(8, 2, 32).astype("float32")
+        _assert_on_matches_off(
+            lambda: ColumnSequenceParallelLinear(32, 16,
+                                                 has_bias=True), x)
+
+    def test_row_sequence_parallel_linear(self, mp_grid):
+        from paddle_tpu.distributed.fleet.utils.\
+            sequence_parallel_utils import RowSequenceParallelLinear
+
+        x = np.random.RandomState(3).randn(8, 2, 32).astype("float32")
+        _assert_on_matches_off(
+            lambda: RowSequenceParallelLinear(32, 16, has_bias=True), x)
+
+    def test_indivisible_dims_decline(self, mp_grid):
+        # no leading dim the ring can chunk: dispatch must decline
+        # (plain lowering, still correct) instead of mis-slicing
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+            RowParallelLinear,
+        )
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_ops import (
+            collective_matmul_dispatch,
+        )
+
+        ws = mp_grid
+        # batch 3 and seq 5 are coprime with mp in {2, 4}
+        x = np.random.RandomState(4).randn(3, 5, 32).astype("float32")
+        ref = _run_layer(
+            lambda: RowParallelLinear(32, 16, has_bias=False,
+                                      input_is_parallel=True), x, "off")
+        got = _run_layer(
+            lambda: RowParallelLinear(32, 16, has_bias=False,
+                                      input_is_parallel=True), x, "on")
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+        # and the dispatcher itself reports the decline (None)
+        with flags(collective_matmul="on"):
+            w = paddle.to_tensor(
+                np.zeros((32, 16), np.float32))
+            assert collective_matmul_dispatch(
+                "mm_rs", paddle.to_tensor(x), w, axis="mp") is None
+            assert collective_matmul_dispatch(
+                "mm_ar", paddle.to_tensor(x), w, axis="mp") is None
+
+
+class TestLowering:
+    """Jaxpr-level contract: 'on' decomposes (ppermute ring, no
+    blocking pair), 'off' restores the prior lowering bit-for-bit,
+    'auto' thresholds on FLAGS_collective_matmul_min_bytes."""
+
+    def _trace(self, layer, x):
+        # make_jaxpr caches on function identity — always trace a
+        # fresh closure
+        return str(jax.make_jaxpr(
+            lambda xr: layer(paddle.to_tensor(xr))._data)(x))
+
+    def _layer(self):
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+            RowParallelLinear,
+        )
+
+        paddle.seed(0)
+        with paddle.utils.unique_name.guard():
+            return RowParallelLinear(32, 16, has_bias=False,
+                                     input_is_parallel=True)
+
+    def test_on_emits_ring_off_is_plain(self, mp_grid):
+        layer = self._layer()
+        x = np.random.RandomState(0).randn(8, 6, 32).astype("float32")
+        with flags(collective_matmul="on"):
+            j_on = self._trace(layer, x)
+        with flags(collective_matmul="off"):
+            j_off = self._trace(layer, x)
+        assert "ppermute" in j_on
+        assert "ppermute" not in j_off
+
+    def test_off_restores_prior_lowering_bitwise(self, mp_grid):
+        # 'prior' == the plain chain with the dispatcher hard-disabled
+        # (the code path that existed before the subsystem)
+        from paddle_tpu.distributed.fleet.layers.mpu import mp_layers
+
+        layer = self._layer()
+        x = np.random.RandomState(0).randn(8, 6, 32).astype("float32")
+        with flags(collective_matmul="off"):
+            j_off = self._trace(layer, x)
+        orig = mp_layers.collective_matmul_dispatch
+        mp_layers.collective_matmul_dispatch = \
+            lambda *a, **k: None
+        try:
+            j_prior = self._trace(layer, x)
+        finally:
+            mp_layers.collective_matmul_dispatch = orig
+        assert j_off == j_prior
+
+    def test_auto_threshold(self, mp_grid):
+        layer = self._layer()
+        x = np.random.RandomState(0).randn(8, 6, 32).astype("float32")
+        with flags(collective_matmul="auto",
+                   collective_matmul_min_bytes=1):
+            j_lo = self._trace(layer, x)
+        with flags(collective_matmul="auto",
+                   collective_matmul_min_bytes=1 << 40):
+            j_hi = self._trace(layer, x)
+        assert "ppermute" in j_lo
+        assert "ppermute" not in j_hi
+
+
+# ---------------------------------------------------------------------------
+# manual-context routing (framework-managed shard_map regions)
+# ---------------------------------------------------------------------------
+
+
+class TestManualContext:
+    def test_sp_linears_decompose_in_manual_region(self, mp_grid):
+        """Inside a manual mp region the SP linears must route through
+        the ring and match the plain chain (tape-convention VJPs)."""
+        from paddle_tpu.distributed.mesh import (
+            global_mesh,
+            manual_axes,
+        )
+        from paddle_tpu.framework.core import Tensor
+
+        ws = mp_grid
+        mesh = global_mesh()
+        rng = np.random.RandomState(0)
+        x = rng.randn(S_LOC * ws, B, K).astype("float32")
+        w = rng.randn(K, N).astype("float32")
+
+        def run(mode):
+            def local(xl, wl):
+                with manual_axes(("mp",)):
+                    with flags(collective_matmul=mode):
+                        from paddle_tpu.distributed.fleet.layers.mpu.\
+                            mp_ops import collective_matmul_dispatch
+
+                        out = collective_matmul_dispatch(
+                            "ag_mm", Tensor(xl), Tensor(wl), axis="mp")
+                        if out is None:
+                            g = jax.lax.all_gather(
+                                xl, "mp", axis=0, tiled=True)
+                            return jnp.matmul(g, wl)
+                        return out._data
+
+            return np.asarray(shard_map(
+                local, mesh=mesh,
+                in_specs=(P("mp", None, None), P(None, "mp")),
+                out_specs=P(None, None, "mp"),
+            )(x, w), np.float32)
+
+        np.testing.assert_allclose(
+            run("on"), run("off"), rtol=1e-4, atol=1e-4)
+
+    def test_mm_ar_tape_grads_in_manual_region(self, mp_grid):
+        """mm_ar's re-gather must take the tape cotangent convention
+        in manual regions: with jax's stock all_gather transpose
+        (psum_scatter) the replicated tape cotangents are SUMMED and
+        dx/dw come out exactly mp-degree times too large (code-review
+        repro for this PR)."""
+        from paddle_tpu.distributed.mesh import (
+            global_mesh,
+            manual_axes,
+        )
+        from paddle_tpu.framework.core import Tensor, apply_op
+
+        ws = mp_grid
+        mesh = global_mesh()
+        rng = np.random.RandomState(1)
+        rows = 2 * ws
+        x = rng.randn(rows, 4, K).astype("float32")
+        w = rng.randn(K, N).astype("float32")
+
+        def run(mode):
+            def local(xl, wl):
+                with manual_axes(("mp",)):
+                    with flags(collective_matmul=mode):
+                        from paddle_tpu.distributed.fleet.layers.mpu.\
+                            mp_ops import collective_matmul_dispatch
+
+                        xt, wt = Tensor(xl), Tensor(wl)
+                        xt.stop_gradient = False
+                        wt.stop_gradient = False
+                        out = collective_matmul_dispatch(
+                            "mm_ar", xt, wt, axis="mp")
+                        if out is None:
+                            # the plain manual chain: matmul + the
+                            # _mp_allreduce convention (psum fwd,
+                            # identity bwd)
+                            out = apply_op(
+                                "mm", lambda a, b: jnp.matmul(a, b),
+                                xt, wt)
+
+                            @jax.custom_vjp
+                            def allred(v):
+                                return jax.lax.psum(v, "mp")
+
+                            allred.defvjp(
+                                lambda v: (jax.lax.psum(v, "mp"),
+                                           None),
+                                lambda _, ct: (ct,),
+                            )
+                            out = apply_op("ar", allred, out)
+                        (out * out).sum().backward()
+                        return (out._data, xt.grad._data,
+                                wt.grad._data)
+
+            return shard_map(
+                local, mesh=mesh,
+                in_specs=(P(None, None, "mp"), P("mp", None)),
+                out_specs=(P(None, None, None), P(None, None, "mp"),
+                           P("mp", None)),
+            )(x, w)
+
+        ref = run("off")
+        got = run("on")
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-4, atol=1e-4)

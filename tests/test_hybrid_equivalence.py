@@ -121,6 +121,39 @@ class TestHybridEquivalence:
             _reset()
         np.testing.assert_allclose(got, serial, rtol=RTOL, atol=RTOL)
 
+    def test_mp4_collective_matmul_on(self):
+        # ISSUE-4: the ring-decomposed collective matmul engaged on
+        # every TP linear (FLAGS_collective_matmul=on forces
+        # decomposition; pure-TP grid — on jax<0.5 the dispatcher
+        # declines when another mesh axis is live, see mp_ops) must
+        # reproduce the plain-chain trajectory step for step.
+        _grid(mp_degree=4)
+        try:
+            paddle.set_flags({"FLAGS_collective_matmul": "off"})
+            base = _train_llama(_llama_cfg())
+            paddle.set_flags({"FLAGS_collective_matmul": "on"})
+            got = _train_llama(_llama_cfg())
+        finally:
+            paddle.set_flags({"FLAGS_collective_matmul": "auto"})
+            _reset()
+        np.testing.assert_allclose(got, base, rtol=RTOL, atol=RTOL)
+
+    def test_dp2_mp4_collective_matmul_on_grid_safe(self):
+        # multi-axis grid with the flag forced on: on jax<0.5 the
+        # legacy-shard_map gate must keep the lowering identical to
+        # plain (decline, not crash); on newer jax the decomposition
+        # itself must hold the match
+        _grid(dp_degree=2, mp_degree=4)
+        try:
+            paddle.set_flags({"FLAGS_collective_matmul": "off"})
+            base = _train_llama(_llama_cfg())
+            paddle.set_flags({"FLAGS_collective_matmul": "on"})
+            got = _train_llama(_llama_cfg())
+        finally:
+            paddle.set_flags({"FLAGS_collective_matmul": "auto"})
+            _reset()
+        np.testing.assert_allclose(got, base, rtol=RTOL, atol=RTOL)
+
     @pytest.mark.parametrize("mode", ["ring", "ulysses"])
     def test_sep2_mp2_dp2_context_parallel(self, mode):
         serial = _serial_llama()

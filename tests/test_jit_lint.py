@@ -478,3 +478,98 @@ class TestReporting:
         assert progs and any(
             f["rule"] == "dtype-drift"
             for p in progs for f in p["findings"])
+
+
+# ---------------------------------------------------------------------------
+# rule family 6: overlap-miss (collective-matmul satellite)
+# ---------------------------------------------------------------------------
+
+class TestOverlapMiss:
+    """A blocking all_gather whose sole consumer is an over-threshold
+    dot_general is the dependent pair FLAGS_collective_matmul would
+    decompose — the linter must point at it."""
+
+    def _ag_dot_jaxpr(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mp_mesh()
+
+        def local(xl, wl):
+            g = jax.lax.all_gather(xl, "mp", axis=0, tiled=True)
+            return jnp.matmul(g, wl)
+
+        f = shard_map(local, mesh=mesh,
+                      in_specs=(P("mp", None), P(None, None)),
+                      out_specs=P(None, None), check_rep=False)
+        return jax.make_jaxpr(f)(
+            jnp.ones((8, 16), jnp.float32),
+            jnp.ones((16, 8), jnp.float32))
+
+    def test_seeded_ag_dot_pair_fires(self):
+        with flags(collective_matmul_min_bytes=1):
+            rep = analysis.analyze_jaxpr(
+                self._ag_dot_jaxpr(), mesh_axes={"mp"})
+        f = next(f for f in rep.findings if f.rule == "overlap-miss")
+        assert f.severity == "warning"
+        assert "collective_matmul" in f.suggestion
+
+    def test_below_threshold_clean(self):
+        with flags(collective_matmul_min_bytes=1 << 30):
+            rep = analysis.analyze_jaxpr(
+                self._ag_dot_jaxpr(), mesh_axes={"mp"})
+        assert "overlap-miss" not in _rules(rep)
+
+    def test_decomposed_ring_clean(self):
+        # the ring replacement (ppermute chunks, no blocking gather)
+        # must NOT fire the rule
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.ops.kernels import collective_matmul as cm
+
+        mesh = _mp_mesh()
+
+        def local(xl, wl):
+            return cm.all_gather_matmul(
+                xl, wl, axis_name="mp", axis_size=2, gather_axis=0)
+
+        f = shard_map(local, mesh=mesh,
+                      in_specs=(P("mp", None), P(None, None)),
+                      out_specs=P(None, None), check_rep=False)
+        closed = jax.make_jaxpr(f)(
+            jnp.ones((8, 16), jnp.float32),
+            jnp.ones((16, 8), jnp.float32))
+        with flags(collective_matmul_min_bytes=1):
+            rep = analysis.analyze_jaxpr(closed, mesh_axes={"mp"})
+        assert "overlap-miss" not in _rules(rep)
+
+    def test_gather_with_second_consumer_clean(self):
+        # the gathered value escaping to a second consumer is not the
+        # pure dependent pair (decomposition would change live ranges)
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mp_mesh()
+
+        def local(xl, wl):
+            g = jax.lax.all_gather(xl, "mp", axis=0, tiled=True)
+            return jnp.matmul(g, wl) + g[:, :8]
+
+        f = shard_map(local, mesh=mesh,
+                      in_specs=(P("mp", None), P(None, None)),
+                      out_specs=P(None, None), check_rep=False)
+        closed = jax.make_jaxpr(f)(
+            jnp.ones((8, 16), jnp.float32),
+            jnp.ones((16, 8), jnp.float32))
+        with flags(collective_matmul_min_bytes=1):
+            rep = analysis.analyze_jaxpr(closed, mesh_axes={"mp"})
+        assert "overlap-miss" not in _rules(rep)
+
+    def test_suppression(self):
+        with flags(collective_matmul_min_bytes=1):
+            rep = analysis.analyze_jaxpr(
+                self._ag_dot_jaxpr(), mesh_axes={"mp"},
+                suppress=("overlap-miss",))
+        assert "overlap-miss" not in _rules(rep)
+        assert rep.suppressed.get("overlap-miss", 0) >= 1

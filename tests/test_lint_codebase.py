@@ -181,3 +181,95 @@ class TestQuantSidecarRule:
         assert any(d.endswith("inference") for d in dirs)
         for d in dirs:
             assert os.path.isdir(d), d
+
+
+class TestCollectiveMatmulDiscipline:
+    """ISSUE-4 satellite: the collective-matmul kernel module is
+    jax-only, and the TP/SP layer modules must route dependent
+    matmul+collective pairs through the subsystem instead of
+    hand-rolling new blocking chains."""
+
+    def test_seeded_host_import_flagged(self):
+        bad = (
+            "import jax\n"
+            "import numpy as np\n"
+            "import time, os\n"
+            "from threading import Lock\n"
+            "import functools\n"
+        )
+        v = lint_codebase.lint_jax_only_file("fake/cm.py", text=bad)
+        rules = "\n".join(v)
+        assert len(v) == 4, v
+        assert "import numpy" in rules
+        assert "import time" in rules and "import os" in rules
+        assert "from threading import" in rules
+
+    def test_relative_and_jax_imports_allowed(self):
+        ok = (
+            "from __future__ import annotations\n"
+            "import functools\n"
+            "import math\n"
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "from ...framework.flags import flag\n"
+        )
+        assert lint_codebase.lint_jax_only_file(
+            "fake/cm.py", text=ok) == []
+
+    def test_kernel_module_is_covered(self):
+        covered = [os.path.join(REPO, f)
+                   for f in lint_codebase.JAX_ONLY_FILES]
+        assert any(p.endswith("collective_matmul.py") for p in covered)
+        for p in covered:
+            assert os.path.exists(p), p
+        assert lint_codebase.check_jax_only() == []
+
+    def test_seeded_blocking_pair_flagged(self):
+        bad = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def forward(x, w):\n"
+            "    g = jax.lax.all_gather(x, 'mp', axis=0, tiled=True)\n"
+            "    return jnp.matmul(g, w)\n"
+        )
+        v = lint_codebase.lint_tp_routing_file("fake/mp.py", text=bad)
+        assert len(v) == 1, v
+        assert "collective_matmul_dispatch" in v[0]
+        assert "all_gather" in v[0] and "matmul" in v[0]
+
+    def test_pair_split_across_scopes_clean(self):
+        # the sanctioned structure: collective in a dedicated VJP
+        # closure, matmul in the enclosing layer body
+        ok = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def forward(x, w):\n"
+            "    def gather(v):\n"
+            "        return jax.lax.all_gather(v, 'mp', axis=0,\n"
+            "                                  tiled=True)\n"
+            "    return jnp.matmul(x, w)\n"
+        )
+        assert lint_codebase.lint_tp_routing_file(
+            "fake/mp.py", text=ok) == []
+
+    def test_waiver_comment_suppresses(self):
+        bad = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def forward(x, w):\n"
+            "    g = jax.lax.all_gather(x, 'mp')"
+            "  # trace-lint: ok(test waiver)\n"
+            "    return jnp.matmul(g, w)\n"
+        )
+        assert lint_codebase.lint_tp_routing_file(
+            "fake/mp.py", text=bad) == []
+
+    def test_tp_modules_are_covered(self):
+        covered = [os.path.join(REPO, f)
+                   for f in lint_codebase.TP_ROUTING_FILES]
+        names = "\n".join(covered)
+        assert "mp_layers.py" in names and "mp_ops.py" in names
+        assert "sequence_parallel_utils.py" in names
+        for p in covered:
+            assert os.path.exists(p), p
+        assert lint_codebase.check_tp_routing() == []

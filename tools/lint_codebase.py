@@ -29,6 +29,15 @@ Checks:
    corrupts every shared reader of the page. Serving modules
    (paddle_tpu/inference/) may READ them through the pool API but
    must never assign, aug-assign, or ``.at[...]``-update them.
+5. collective-matmul discipline: ops/kernels/collective_matmul.py is
+   jax-only (every body runs inside jit traces under shard_map) — no
+   host-side module imports (os/sys/time/numpy/threading/...); and the
+   TP/SP layer modules (mpu/mp_layers.py, mpu/mp_ops.py,
+   sequence_parallel_utils.py) must route dependent matmul+collective
+   pairs through the subsystem (mp_ops.collective_matmul_dispatch)
+   instead of hand-rolling new blocking chains: no single function may
+   call both a raw lax collective (all_gather/psum/psum_scatter/...)
+   and a raw matmul (jnp.matmul/dot_general/F.linear/...).
 
 Run: JAX_PLATFORMS=cpu python tools/lint_codebase.py
 Wired as a tier-1 test in tests/test_lint_codebase.py.
@@ -288,6 +297,185 @@ def check_quant_sidecar_writes(root=REPO):
     return out
 
 
+# modules that must stay pure-jax: collective-matmul ring kernels run
+# entirely inside jit traces under shard_map — a host-side import is
+# either dead weight or a per-step host sync waiting to happen
+JAX_ONLY_FILES = (
+    os.path.join("paddle_tpu", "ops", "kernels", "collective_matmul.py"),
+)
+
+# allowed top-level imports in a jax-only module (relative, in-package
+# imports are always allowed — e.g. the framework flags registry)
+_JAX_ONLY_ALLOWED = ("jax", "functools", "math", "typing", "__future__")
+
+
+class _JaxOnlyImportVisitor(ast.NodeVisitor):
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+
+    def _flag(self, lineno, what):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        if _WAIVER_MARK not in line:
+            self.violations.append(
+                "%s:%d: %s in a jax-only kernel module (the collective-"
+                "matmul rings run inside jit traces under shard_map; "
+                "host-side imports are banned); fix it or waive with "
+                "'%s(<reason>)'"
+                % (self.relpath, lineno, what, _WAIVER_MARK))
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            head = alias.name.split(".")[0]
+            if head not in _JAX_ONLY_ALLOWED:
+                self._flag(node.lineno, "import %s" % alias.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.level:  # relative (in-package) import
+            self.generic_visit(node)
+            return
+        head = (node.module or "").split(".")[0]
+        if head not in _JAX_ONLY_ALLOWED:
+            self._flag(node.lineno,
+                       "from %s import ..." % (node.module or "?"))
+        self.generic_visit(node)
+
+
+def lint_jax_only_file(path, text=None):
+    """Jax-only import check for one file; returns violation strings."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _JaxOnlyImportVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_jax_only(root=REPO):
+    out = []
+    for f in JAX_ONLY_FILES:
+        out.extend(lint_jax_only_file(os.path.join(root, f)))
+    return out
+
+
+# TP/SP modules that must route matmul+collective pairs through the
+# collective-matmul subsystem instead of hand-rolling blocking chains
+TP_ROUTING_FILES = (
+    os.path.join("paddle_tpu", "distributed", "fleet", "layers", "mpu",
+                 "mp_layers.py"),
+    os.path.join("paddle_tpu", "distributed", "fleet", "layers", "mpu",
+                 "mp_ops.py"),
+    os.path.join("paddle_tpu", "distributed", "fleet", "utils",
+                 "sequence_parallel_utils.py"),
+)
+
+_RAW_COLLECTIVE_CALLS = frozenset({
+    "all_gather", "psum", "psum_scatter", "ppermute", "all_to_all",
+    "pmean",
+})
+_RAW_MATMUL_CALLS = frozenset({
+    "matmul", "dot", "dot_general", "einsum", "tensordot", "linear",
+})
+
+
+class _TPRoutingVisitor(ast.NodeVisitor):
+    """Per innermost function: a raw lax collective AND a raw matmul in
+    the same body is a hand-rolled blocking pair — it belongs in
+    ops/kernels/collective_matmul.py behind
+    mp_ops.collective_matmul_dispatch."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+
+    def _call_name(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        if isinstance(fn, ast.Name):
+            return fn.id
+        return None
+
+    def _scoped_calls(self, node):
+        """Call nodes in node's own scope — nested def/lambda bodies
+        are separate scopes (they get their own visit / are VJP-closure
+        territory)."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            if isinstance(sub, ast.Call):
+                yield sub
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _check_fn(self, node):
+        colls, mms = [], []
+        for sub in self._scoped_calls(node):
+            name = self._call_name(sub)
+            if name in _RAW_COLLECTIVE_CALLS:
+                colls.append((sub.lineno, name))
+            elif name in _RAW_MATMUL_CALLS:
+                mms.append((sub.lineno, name))
+        if colls and mms:
+            lineno = min(colls + mms)[0]
+            line = self.lines[lineno - 1] \
+                if lineno - 1 < len(self.lines) else ""
+            if _WAIVER_MARK not in line:
+                self.violations.append(
+                    "%s:%d: function %r pairs a raw collective (%s) "
+                    "with a raw matmul (%s) — a hand-rolled blocking "
+                    "chain; route it through mp_ops."
+                    "collective_matmul_dispatch (ops/kernels/"
+                    "collective_matmul.py) or waive with '%s(<reason>)'"
+                    % (self.relpath, lineno, node.name,
+                       ", ".join(sorted({n for _, n in colls})),
+                       ", ".join(sorted({n for _, n in mms})),
+                       _WAIVER_MARK))
+
+    def visit_FunctionDef(self, node):
+        self._check_fn(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def lint_tp_routing_file(path, text=None):
+    """Matmul+collective pairing check; returns violation strings.
+
+    Walks only direct (non-nested-def) statements of each function, so
+    the sanctioned wrappers — a collective in a dedicated VJP closure,
+    a matmul in the layer body — don't pair up across scopes."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _TPRoutingVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_tp_routing(root=REPO):
+    out = []
+    for f in TP_ROUTING_FILES:
+        out.extend(lint_tp_routing_file(os.path.join(root, f)))
+    return out
+
+
 def check_inference_surface():
     """No raw jax callable may leak through the public
     ``paddle_tpu.inference`` namespace (same leak rule the op
@@ -370,6 +558,8 @@ def run_lint(root=REPO, with_op_table=True):
     out = check_traced_paths(root)
     out.extend(check_host_only(root))
     out.extend(check_quant_sidecar_writes(root))
+    out.extend(check_jax_only(root))
+    out.extend(check_tp_routing(root))
     if with_op_table:
         out.extend(check_op_table())
         out.extend(check_inference_surface())
