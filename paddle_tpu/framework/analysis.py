@@ -24,6 +24,11 @@ otherwise only surface as slow steps or hangs on real TPUs:
                                        baked into the program as a const
   recompile-cache-pressure   warning   one StaticFunction holding many
                                        cache entries (spec churn)
+  recompile-serving-shape    warning   cache entries whose token dim
+                                       grows monotonically call to
+                                       call (unbucketed-prefill
+                                       signature: a compile per
+                                       prompt length)
   unsharded-compute          warning   matmul/conv eqn above the FLOPs
                                        threshold with every operand
                                        replicated on a >1-device mesh
@@ -98,6 +103,11 @@ RECOMPILE_WEAK_SCALAR = _rule(
 RECOMPILE_CACHE_PRESSURE = _rule(
     "recompile-cache-pressure", "warning",
     "one compiled function holds many cache entries (input-spec churn)")
+RECOMPILE_SERVING_SHAPE = _rule(
+    "recompile-serving-shape", "warning",
+    "a traced argument dimension grows monotonically across the "
+    "function's compiled entries — the unbucketed ragged-prefill "
+    "signature (every longer feed pays a fresh compile)")
 UNSHARDED_COMPUTE = _rule(
     "unsharded-compute", "warning",
     "matmul/conv eqn above the FLOPs threshold with all operands "
@@ -133,6 +143,9 @@ _MANUAL_REGION_PRIMS = frozenset({"shard_map", "xla_pmap", "pmap"})
 # findings per rule before aggregation into a single "...and N more"
 _MAX_PER_RULE = 8
 _CACHE_PRESSURE_N = 8
+# entries whose shapes must grow strictly before the serving-shape
+# rule fires (2 growing shapes are normal warmup; 4 is a trend)
+_SERVING_SHAPE_N = 4
 
 
 class JitLintError(RuntimeError):
@@ -711,6 +724,73 @@ def _check_donation(donation: dict, out: _RuleLimiter):
     )
 
 
+def _serving_shape_growth(shape_lists):
+    """Detect the unbucketed-prefill signature across a compiled
+    function's cache entries: ``shape_lists`` is the per-entry list of
+    traced-arg shapes in FIRST-COMPILE order; returns (leaf, dim,
+    values) triples where one dimension grew STRICTLY monotonically —
+    but sub-geometrically — across at least _SERVING_SHAPE_N
+    structurally-alike entries. A growing token axis keying the
+    input-spec cache means every longer prompt/chunk pays a fresh
+    retrace + XLA compile. The sub-geometric condition (some step
+    less than doubling) is what separates raw token growth from a
+    BUCKETED caller legitimately warming up its power-of-two ladder:
+    bucket sets grow geometrically, prompt lengths do not."""
+    try:
+        sanctioned = set(int(s) for s in str(
+            _flag("serving_buckets", "") or "").replace(
+                " ", "").split(",") if s)
+    except ValueError:
+        sanctioned = set()
+    groups: Dict[tuple, list] = {}
+    for shapes in shape_lists:
+        key = tuple(len(s) for s in shapes)
+        groups.setdefault(key, []).append(shapes)
+    out = []
+    for rows in groups.values():
+        if len(rows) < _SERVING_SHAPE_N:
+            continue
+        for leaf in range(len(rows[0])):
+            for dim in range(len(rows[0][leaf])):
+                vals = [int(r[leaf][dim]) for r in rows]
+                monotone = all(a < b for a, b in zip(vals, vals[1:]))
+                sub_geo = any(b < 2 * a
+                              for a, b in zip(vals, vals[1:]))
+                # a dimension stepping exclusively through the
+                # CONFIGURED serving buckets is the sanctioned ladder
+                # even when the ladder is not geometric
+                bucketed = sanctioned and all(
+                    v in sanctioned for v in vals)
+                if monotone and sub_geo and not bucketed:
+                    out.append((leaf, dim, vals))
+    return out
+
+
+def _check_serving_shapes(static_fn, entry, out: _RuleLimiter):
+    entries = getattr(static_fn, "_finalized_entries", lambda: [])()
+    shape_lists = [e["t_shapes"] for e in entries
+                   if e.get("t_shapes")]
+    # the growth is a FUNCTION-level signature: report it only on the
+    # newest entry's lint, so a merged analyze(fn) report carries one
+    # finding instead of one per cache entry (each later compile that
+    # extends the growth is a fresh violation and fires again)
+    if not entries or entry is not entries[-1]:
+        return
+    for leaf, dim, vals in _serving_shape_growth(shape_lists):
+        out.add(
+            RECOMPILE_SERVING_SHAPE,
+            "traced argument leaf %d dim %d grew monotonically across "
+            "%d compiled entries (%d -> %d): the unbucketed-prefill "
+            "signature — every longer token feed keys a new cache "
+            "entry and pays a full retrace + XLA compile"
+            % (leaf, dim, len(vals), vals[0], vals[-1]),
+            suggestion="pad the growing axis up to a fixed bucket set "
+            "before the call (serving feeds: "
+            "paddle_tpu.inference.bucket_packed_tokens / "
+            "FLAGS_serving_buckets) and mask the tail",
+        )
+
+
 def lint_static_entry(static_fn, entry,
                       suppress: Sequence[str] = ()) -> AnalysisReport:
     """Lint one finalized StaticFunction cache entry (jit/api.py) —
@@ -736,8 +816,8 @@ def lint_static_entry(static_fn, entry,
         static_meta=entry.get("static_meta"),
         t_shapes=entry.get("t_shapes"), donation=donation)
     n_entries = len(getattr(static_fn, "_cache", ()) or ())
+    limiter = _RuleLimiter(report, resolve_suppressions(extra))
     if n_entries >= _CACHE_PRESSURE_N:
-        limiter = _RuleLimiter(report, resolve_suppressions(extra))
         limiter.add(
             RECOMPILE_CACHE_PRESSURE,
             "'%s' holds %d compiled cache entries: the input-spec "
@@ -746,7 +826,11 @@ def lint_static_entry(static_fn, entry,
             suggestion="pad inputs to bucketed shapes and pass python "
             "scalars as Tensors",
         )
-        limiter.finish()
+    # the cache-pressure companion: not just MANY entries, but entries
+    # whose token dimension keeps GROWING — the serving anti-pattern
+    # the chunked-prefill bucket helper exists to prevent
+    _check_serving_shapes(static_fn, entry, limiter)
+    limiter.finish()
     return report
 
 

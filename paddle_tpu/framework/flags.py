@@ -132,6 +132,24 @@ define_flag("collective_matmul_min_bytes", 4 << 20,
             "collective+matmul pair only when the blocking collective "
             "would move at least this many bytes; also the trace "
             "linter's overlap-miss threshold (framework/analysis.py)")
+define_flag("prefill_chunk_tokens", 64,
+            "chunked-prefill token budget for the paged serving "
+            "scheduler (inference/serving.py): each BatchScheduler "
+            "step packs every active decode row plus up to this many "
+            "pending prompt tokens (split across sequences, resuming "
+            "mid-prompt) into ONE ragged model call via "
+            "PagedLlamaAdapter.prefill_chunk — Sarathi-style budget "
+            "packing keeps decode latency flat while prefill "
+            "saturates the chip (docs/SERVING.md)")
+define_flag("serving_buckets", "8,16,32,64,128,256",
+            "comma-separated packed-token buckets for the chunked-"
+            "prefill ragged dispatch: the per-step packed token count "
+            "(decode rows + prefill chunk tokens) is padded up to the "
+            "smallest bucket >= count (tail masked), so steady-state "
+            "serving compiles at most len(buckets) ragged programs "
+            "instead of one per distinct packed length. Counts beyond "
+            "the largest bucket round up to the next power of two "
+            "(each such shape is one extra compile)")
 define_flag("moe_dense_dispatch", False,
             "route MoE tokens via the dense (N,E,C) one-hot "
             "dispatch/combine einsums instead of the sparse index "

@@ -50,6 +50,9 @@ import jax.numpy as jnp
 
 from ...framework.core import Tensor, apply_op, _as_tensor
 from ...ops.kernels.paged_attention import paged_attention as _kernel
+from ...ops.kernels.paged_attention import (
+    paged_prefill_attention as _prefill_kernel,
+)
 from ...ops.kernels.quant import kv_head_scale, quantize_kv
 
 __all__ = ["PagedKVCacheManager", "paged_attention"]
@@ -299,7 +302,9 @@ class PagedKVCacheManager:
         slots by round(q * old/new) — exact when the scale is
         unchanged), then store the tokens as int8. ``pages`` holds
         DISTINCT physical ids (each page has exactly one writer — a
-        shared page is forked before any write reaches here).
+        shared page is forked before any write reaches here, and
+        append_ragged's wave replay feeds at most one token per
+        sequence per call).
 
         Steady state (scales already cover the token — the common
         decode case once a page has seen a few tokens) writes ONLY the
@@ -394,16 +399,86 @@ class PagedKVCacheManager:
         self.v_pages = self.v_pages.at[pg, of].set(
             v_toks.astype(self.v_pages.dtype))
 
+    def ragged_pages_needed(self, seq_ids, counts) -> int:
+        """Free-list draws a ragged append of ``counts[i]`` tokens per
+        sequence would make: new pages opened past each sequence's
+        current tail, plus one draw per sequence whose first write
+        lands mid-page on a SHARED page (the copy-on-write fork) —
+        the page-granular reservation a chunk boundary must respect."""
+        need = 0
+        for s, c in zip(seq_ids, counts):
+            if not c:
+                continue
+            n = self._lens[s]
+            have = -(-n // self.page_size) if n else 0
+            need += -(-(n + c) // self.page_size) - have
+            if self.pending_cow(s):
+                need += 1
+        return need
+
+    def append_ragged(self, seq_ids, counts, k_toks, v_toks):
+        """Write ``counts[i]`` consecutive tokens' K/V for EVERY listed
+        sequence in one scatter per pages array (the chunked-prefill
+        hot path: a mixed batch of multi-token chunks and single-token
+        decode rows must not issue one update per token per layer).
+        k_toks/v_toks: (sum(counts), KVH, D) arrays or Tensors, rows
+        ordered sequence-major (seq_ids[0]'s tokens first)."""
+        k_toks = k_toks._data if isinstance(k_toks, Tensor) else k_toks
+        v_toks = v_toks._data if isinstance(v_toks, Tensor) else v_toks
+        counts = [int(c) for c in counts]
+        if sum(counts) != k_toks.shape[0]:
+            raise ValueError(
+                f"append_ragged: counts sum to {sum(counts)} but "
+                f"{k_toks.shape[0]} token rows were passed")
+        # atomicity: validate capacity BEFORE any bookkeeping mutation
+        # (same contract as append_batch) — a mid-chunk exhaustion must
+        # not leave some sequences' lens ahead of their device writes
+        need = self.ragged_pages_needed(seq_ids, counts)
+        if need > len(self._free):
+            raise RuntimeError(
+                f"KV page pool exhausted: ragged append needs {need} "
+                f"new pages, {len(self._free)} free")
+        pages = []
+        offs = []
+        for s, c in zip(seq_ids, counts):
+            for _ in range(c):
+                page, off = self._next_slot(s)
+                self._lens[s] += 1
+                pages.append(page)
+                offs.append(off)
+        if not pages:
+            return
+        if self.quantized:
+            # replay the per-token calibration ORDER (wave j = the
+            # j-th token of every chunk): scale growth requantizes
+            # through the same intermediate scales the token-per-step
+            # path would use, so chunked-prefill int8 pages are
+            # BIT-identical to sequential appends (greedy identity —
+            # tests/test_chunked_prefill.py). Same per-token write
+            # cost as the legacy path; the chunking win is in the
+            # attention/projection dispatch, not the pool write.
+            offsets = np.concatenate(
+                [[0], np.cumsum(counts)]).astype(np.int64)
+            for j in range(max(counts)):
+                rows = np.asarray([offsets[i] + j
+                                   for i, c in enumerate(counts)
+                                   if j < c])
+                self._quant_write(
+                    [pages[r] for r in rows],
+                    [offs[r] for r in rows],
+                    k_toks[rows], v_toks[rows])
+            return
+        pg = jnp.asarray(pages, jnp.int32)
+        of = jnp.asarray(offs, jnp.int32)
+        self.k_pages = self.k_pages.at[pg, of].set(
+            k_toks.astype(self.k_pages.dtype))
+        self.v_pages = self.v_pages.at[pg, of].set(
+            v_toks.astype(self.v_pages.dtype))
+
     # -- kernel inputs -----------------------------------------------------
     def page_table(self, seq_ids, max_pages=None):
-        mp = max_pages or max(
-            (len(self._tables[s]) for s in seq_ids), default=1
-        )
-        tbl = np.zeros((len(seq_ids), mp), np.int32)
-        for i, s in enumerate(seq_ids):
-            pages = self._tables[s]
-            tbl[i, :len(pages)] = pages
-        return jnp.asarray(tbl)
+        return self._padded_kernel_inputs(
+            seq_ids, len(seq_ids), max_pages)[0]
 
     def seq_lens(self, seq_ids):
         return jnp.asarray(
@@ -416,9 +491,36 @@ class PagedKVCacheManager:
         ``window`` cached tokens (out-of-window pages skipped).
         Quantized pools pass their scale sidecars into the kernel
         (dequant fused after the page DMA)."""
+        return self.attend_padded(q, seq_ids, sm_scale=sm_scale,
+                                  window=window)
+
+    def _padded_kernel_inputs(self, seq_ids, rows_pad, max_pages):
+        """Page table + lens padded to ``rows_pad`` rows x
+        ``max_pages`` columns. Padding rows carry seq_len 0, which
+        both paged kernels treat as inert (no page is valid, output
+        exact zeros) — the shape-bucketing enabler for the chunked-
+        prefill dispatch."""
+        rows_pad = max(int(rows_pad or len(seq_ids)), len(seq_ids))
+        mp = max((len(self._tables[s]) for s in seq_ids), default=1)
+        mp = max(int(max_pages or mp), mp, 1)
+        tbl = np.zeros((rows_pad, mp), np.int32)
+        lens = np.zeros((rows_pad,), np.int32)
+        for i, s in enumerate(seq_ids):
+            pages = self._tables[s]
+            tbl[i, :len(pages)] = pages
+            lens[i] = self._lens[s]
+        return jnp.asarray(tbl), jnp.asarray(lens)
+
+    def attend_padded(self, q, seq_ids, rows_pad=None, max_pages=None,
+                      sm_scale=None, window=0):
+        """Decode attend over a row/column-PADDED batch: ``q`` is
+        (rows_pad, H, D) whose first ``len(seq_ids)`` rows are real
+        decode tokens; padding rows (any content) return exact zeros.
+        ``max_pages`` pads the page-table width. The shape-stable
+        flavor of :meth:`attend` the bucketed ragged dispatch needs."""
         q = _as_tensor(q)
-        tbl = self.page_table(seq_ids)
-        lens = self.seq_lens(seq_ids)
+        tbl, lens = self._padded_kernel_inputs(
+            seq_ids, rows_pad, max_pages)
         kp, vp = self.k_pages, self.v_pages
         ks = self.k_scales if self.quantized else None
         vs = self.v_scales if self.quantized else None
@@ -428,6 +530,31 @@ class PagedKVCacheManager:
                            window=window, k_scales=ks, v_scales=vs)
 
         return apply_op("paged_attend", f, q, differentiable=False)
+
+    def attend_prefill(self, q, seq_ids, q_lens, rows_pad=None,
+                       max_pages=None, sm_scale=None, window=0):
+        """Chunked-prefill attend over a padded ragged batch: ``q`` is
+        (rows_pad, T, H, D); row i's last ``q_lens[i]`` rows are the
+        newest tokens of seq_ids[i] (K/V already appended — seq_len
+        counts them), earlier rows and batch-padding rows return exact
+        zeros. One fused kernel call for the whole mixed batch."""
+        q = _as_tensor(q)
+        tbl, lens = self._padded_kernel_inputs(
+            seq_ids, rows_pad, max_pages)
+        ql = jnp.zeros((tbl.shape[0],), jnp.int32)
+        ql = ql.at[:len(seq_ids)].set(
+            jnp.asarray(list(q_lens), jnp.int32))
+        kp, vp = self.k_pages, self.v_pages
+        ks = self.k_scales if self.quantized else None
+        vs = self.v_scales if self.quantized else None
+
+        def f(qr):
+            return _prefill_kernel(
+                qr, kp, vp, tbl, lens, sm_scale=sm_scale,
+                window=window, k_scales=ks, v_scales=vs, q_lens=ql)
+
+        return apply_op("paged_prefill_attend", f, q,
+                        differentiable=False)
 
     def dense_kv(self, seq_ids):
         """Dense (dequantized) gather of the listed sequences' pages:

@@ -24,9 +24,15 @@ __all__ = [
     "PagedLlamaAdapter",
     "RadixPrefixCache",
     "PrefixMatch",
+    "bucket_packed_tokens",
 ]
 
-from .serving import BatchScheduler, Request, RequestState  # noqa: E402
+from .serving import (  # noqa: E402
+    BatchScheduler,
+    Request,
+    RequestState,
+    bucket_packed_tokens,
+)
 from .paged_llama import PagedLlamaAdapter  # noqa: E402
 from .prefix_cache import RadixPrefixCache, PrefixMatch  # noqa: E402
 

@@ -100,6 +100,24 @@ class PagedLlamaAdapter:
             self.max_length, cfg.head_dim, base=cfg.rope_theta,
             dtype=jnp.float32,
         )
+        # chunked-prefill dispatch accounting (docs/SERVING.md):
+        # _dispatch_shapes holds the distinct BUCKETED packed token
+        # counts prefill_chunk has been fed — each is one compiled
+        # ragged program, so len() is the steady-state compile count
+        # the scheduler and bench report; _kernel_shapes tracks the
+        # (kind, rows, T, max_pages) signatures of the pow2-padded
+        # attention sub-calls underneath.
+        self._dispatch_shapes = set()
+        self._kernel_shapes = set()
+        self.chunk_stats = {"calls": 0, "packed_tokens": 0,
+                            "padded_tokens": 0}
+
+    @property
+    def compile_count(self) -> int:
+        """Distinct bucketed packed shapes the ragged chunked-prefill
+        dispatch has compiled (<= number of configured buckets in
+        steady state)."""
+        return len(self._dispatch_shapes)
 
     # -- scheduler protocol ------------------------------------------------
     def alloc(self, seq_id):
@@ -251,5 +269,164 @@ def _window_logits(self, token_windows, seq_ids):
         return self.model._head(h)  # (B, w, V)
 
 
+def _pow2(n: int) -> int:
+    return 1 << (max(int(n), 1) - 1).bit_length()
+
+
+def _prefill_chunk(self, token_ids, seq_ids, start_positions=None,
+                   pad_to=None):
+    """One ragged mixed prefill/decode step (the Ragged Paged
+    Attention shape — see PAPERS.md): row i appends the
+    ``len(token_ids[i])`` tokens of ``token_ids[i]`` to sequence
+    ``seq_ids[i]`` and the call returns the logits of every row's
+    LAST token, (B, vocab) — single-token rows are exactly
+    ``decode_token`` rows, multi-token rows are prefill chunks
+    resuming at ``start_positions[i]`` (validated against the cache;
+    mid-prompt resume and mid-page cached-prefix resume both work).
+
+    All dense compute (embed / qkv / o_proj / mlp / norms) runs over
+    ONE flat packed token axis padded to ``pad_to`` (the scheduler
+    buckets it — serving.bucket_packed_tokens — so steady-state
+    serving compiles one program per bucket, not per packed length).
+    Attention routes per row kind: single-token rows through the
+    paged DECODE kernel, multi-token rows right-aligned through
+    ``paged_prefill_attention`` (fused int8-KV dequant included),
+    each padded to power-of-two row/length/page-table shapes so the
+    kernel programs are shape-stable too."""
+    cfg = self.cfg
+    b = len(seq_ids)
+    counts = [len(t) for t in token_ids]
+    if b != len(counts) or b == 0:
+        raise ValueError(
+            f"prefill_chunk: {len(counts)} token rows for {b} "
+            "sequences")
+    if min(counts) < 1:
+        raise ValueError(
+            "prefill_chunk: every row must carry at least one token "
+            f"(counts={counts})")
+    nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    lens0 = [self.caches[0].seq_len(s) for s in seq_ids]
+    if start_positions is not None:
+        sp = [int(p) for p in start_positions]
+        if sp != lens0:
+            raise ValueError(
+                f"prefill_chunk: start_positions {sp} disagree with "
+                f"the cached lengths {lens0} — a chunk must resume "
+                "exactly where the cache left off")
+    over = [s for s, n, c in zip(seq_ids, lens0, counts)
+            if n + c > self.max_length]
+    if over:
+        raise ValueError(
+            f"sequences {over} would exceed max_length="
+            f"{self.max_length}; positions beyond it cannot be "
+            "rotary-encoded")
+
+    flat = np.concatenate(
+        [np.asarray(t, "int64") for t in token_ids])
+    n_real = int(flat.shape[0])
+    pad_to = int(pad_to) if pad_to else n_real
+    if pad_to < n_real:
+        raise ValueError(
+            f"prefill_chunk: pad_to={pad_to} below the packed token "
+            f"count {n_real}")
+    flat = np.concatenate(
+        [flat, np.zeros(pad_to - n_real, "int64")])
+    pos_np = np.zeros(pad_to, np.int32)
+    starts = np.zeros(b, np.int64)
+    off = 0
+    for i, (n, c) in enumerate(zip(lens0, counts)):
+        starts[i] = off
+        pos_np[off:off + c] = np.arange(n, n + c)
+        off += c
+    last_idx = starts + np.asarray(counts) - 1
+    pos = jnp.asarray(pos_np)[None, :]             # (1, N)
+
+    singles = [i for i, c in enumerate(counts) if c == 1]
+    multis = [i for i, c in enumerate(counts) if c > 1]
+    self._dispatch_shapes.add(pad_to)
+    self.chunk_stats["calls"] += 1
+    self.chunk_stats["packed_tokens"] += n_real
+    self.chunk_stats["padded_tokens"] += pad_to - n_real
+
+    # gather/scatter plans (host-built once, shared by every layer)
+    if singles:
+        bs = len(singles)
+        bs_pad = _pow2(bs)
+        s_idx = jnp.asarray(
+            np.concatenate([last_idx[singles],
+                            np.zeros(bs_pad - bs, np.int64)]),
+            jnp.int32)
+        s_seqs = [seq_ids[i] for i in singles]
+    if multis:
+        t_pad = _pow2(max(counts[i] for i in multis))
+        bm = len(multis)
+        bm_pad = _pow2(bm)
+        gm = np.zeros((bm_pad, t_pad), np.int64)
+        q_lens = []
+        m_rows = []                               # (row, col) per token
+        m_flat = []                               # flat slot per token
+        for r, i in enumerate(multis):
+            c = counts[i]
+            gm[r, t_pad - c:] = np.arange(starts[i], starts[i] + c)
+            q_lens.append(c)
+            for j in range(c):
+                m_rows.append((r, t_pad - c + j))
+                m_flat.append(starts[i] + j)
+        gm = jnp.asarray(gm, jnp.int32)
+        m_seqs = [seq_ids[i] for i in multis]
+        mr = jnp.asarray([r for r, _ in m_rows], jnp.int32)
+        mc = jnp.asarray([cc for _, cc in m_rows], jnp.int32)
+        m_flat = jnp.asarray(m_flat, jnp.int32)
+    # every layer's cache shares one page size (adapter construction),
+    # so the padded page-table width is loop-invariant
+    mp_pad = _pow2(max(
+        -(-(n + c) // self.caches[0].page_size)
+        for n, c in zip(lens0, counts)))
+
+    with no_grad():
+        ids = Tensor(flat[:, None])
+        x = self.model.model.embed_tokens(ids)[:, 0]     # (N, H)
+        for li, layer in enumerate(self.model.model.layers):
+            cache = self.caches[li]
+            xi = layer.input_layernorm(x)
+            q = layer.self_attn.q_proj(xi)
+            k = layer.self_attn.k_proj(xi)
+            v = layer.self_attn.v_proj(xi)
+            qh = q._data.reshape(1, pad_to, nh, hd)
+            kh = k._data.reshape(1, pad_to, nkv, hd)
+            vh = v._data.reshape(1, pad_to, nkv, hd)
+            qh = apply_rotary_emb(
+                qh, self._cos, self._sin, position_ids=pos)[0]
+            kh = apply_rotary_emb(
+                kh, self._cos, self._sin, position_ids=pos)[0]
+            vh = vh[0]
+            cache.append_ragged(
+                seq_ids, counts, kh[:n_real], vh[:n_real])
+            attn = jnp.zeros((pad_to, nh, hd), qh.dtype)
+            if singles:
+                qs = qh[s_idx]                   # (bs_pad, nh, hd)
+                self._kernel_shapes.add(("decode", bs_pad, 1, mp_pad))
+                out = cache.attend_padded(
+                    Tensor(qs), s_seqs, rows_pad=bs_pad,
+                    max_pages=mp_pad, window=self._window)
+                attn = attn.at[s_idx[:bs]].set(out._data[:bs])
+            if multis:
+                qm = qh[gm]                      # (bm_pad, t_pad, nh, hd)
+                self._kernel_shapes.add(
+                    ("prefill", bm_pad, t_pad, mp_pad))
+                out = cache.attend_prefill(
+                    Tensor(qm), m_seqs, q_lens, rows_pad=bm_pad,
+                    max_pages=mp_pad, window=self._window)
+                attn = attn.at[m_flat].set(out._data[mr, mc])
+            attn_flat = Tensor(attn.reshape(pad_to, nh * hd))
+            x = x + layer.self_attn.o_proj(attn_flat)
+            x = x + layer.mlp(layer.post_attention_layernorm(x))
+        x_last = Tensor(x._data[jnp.asarray(last_idx, jnp.int32)])
+        h = self.model.model.norm(x_last)
+        return self.model._head(h)               # (B, vocab)
+
+
 PagedLlamaAdapter.decode_window = _window_logits
-del _window_logits
+PagedLlamaAdapter.prefill_chunk = _prefill_chunk
+del _window_logits, _prefill_chunk

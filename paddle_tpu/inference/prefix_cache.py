@@ -143,11 +143,22 @@ class RadixPrefixCache:
 
     # -- lookup ------------------------------------------------------------
     def match(self, tokens: Sequence[int],
-              limit: Optional[int] = None) -> PrefixMatch:
+              limit: Optional[int] = None,
+              align: int = 1) -> PrefixMatch:
         """Longest cached prefix of ``tokens`` (capped at ``limit``).
         Touches the walked nodes for LRU. The returned chains are
         valid until an eviction — pin the path before any operation
-        that could evict."""
+        that could evict.
+
+        ``align`` > 1 rounds the match DOWN to a multiple of that many
+        tokens (chunk-aligned lookup offsets): with
+        ``align=page_size`` a hit covers only FULL pages, so a
+        chunked-prefill resume starts at a page boundary and never
+        pays the shared-tail copy-on-write fork — trading at most
+        align-1 cached tokens for one fewer worst-case page draw at
+        admission. The trimmed chains still cover exactly
+        ceil(length/page_size) pages; the walked path keeps its tail
+        node (pinning a little extra is harmless)."""
         tokens = list(tokens)
         n = len(tokens) if limit is None else min(limit, len(tokens))
         stamp = self._tick()
@@ -169,6 +180,13 @@ class RadixPrefixCache:
             if j < len(child.key):
                 break
             node = child
+        if align > 1 and matched % align:
+            matched -= matched % align
+            keep = _ceil_div(matched, self.page_size)
+            chains = [chain[:keep] for chain in chains]
+            if matched == 0:
+                path = []
+                chains = [[] for _ in self.caches]
         self.stats["lookup_tokens"] += len(tokens)
         if matched:
             self.stats["hits"] += 1

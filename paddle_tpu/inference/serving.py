@@ -9,10 +9,22 @@ lengths live in the page table + seq_lens, not in the tensor shapes),
 and the scheduler is host-side bookkeeping only.
 
 Token-level continuous batching (Orca-style): every scheduler step
-advances each active sequence by exactly one token — prompt tokens for
-sequences still in prefill, sampled tokens for sequences in decode —
-so arrivals and completions interleave freely without padding the
-batch to a common length.
+advances each active sequence — sampled tokens for sequences in
+decode, prompt tokens for sequences still in prefill — so arrivals
+and completions interleave freely without padding the batch to a
+common length.
+
+Chunked prefill (Sarathi-style, default when the model implements
+``prefill_chunk``): instead of one prompt token per step, each step
+packs EVERY active decode row plus up to ``prefill_chunk_tokens``
+pending prompt tokens (split across sequences, resuming mid-prompt)
+into ONE ragged model call — multi-token rows ride the paged prefill
+kernel, single-token rows the decode kernel. The packed token count
+is padded up to a bucket from ``FLAGS_serving_buckets``
+(:func:`bucket_packed_tokens`) so steady-state serving compiles at
+most len(buckets) ragged programs. Decode rows keep advancing one
+token per step (latency stays flat) while prefill saturates the chip;
+a 432-token prompt costs ceil(432/budget) steps instead of 432.
 
 Admission control: a request is admitted only while (a) the active
 batch is below ``max_batch_size`` and (b) the page pool would stay
@@ -40,7 +52,41 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["Request", "BatchScheduler", "RequestState"]
+from ..framework.flags import flag
+
+__all__ = ["Request", "BatchScheduler", "RequestState",
+           "bucket_packed_tokens"]
+
+
+def _parse_buckets(spec) -> tuple:
+    """Normalize a bucket spec ('8,16,64' / iterable of ints) into a
+    sorted tuple of positive ints."""
+    if isinstance(spec, str):
+        vals = [int(s) for s in spec.replace(" ", "").split(",") if s]
+    else:
+        vals = [int(v) for v in spec]
+    if not vals or min(vals) < 1:
+        raise ValueError(f"invalid serving bucket spec {spec!r}")
+    return tuple(sorted(set(vals)))
+
+
+def bucket_packed_tokens(n: int, buckets=None) -> int:
+    """Round a packed ragged token count up to the smallest configured
+    bucket (FLAGS_serving_buckets by default). Every packed feed the
+    scheduler hands the model goes through here — padding to a small
+    fixed shape set is what bounds steady-state XLA compiles to
+    len(buckets) programs (enforced by tools/lint_codebase.py).
+    Counts beyond the largest bucket round up to the next power of
+    two, each such shape costing one extra compile."""
+    buckets = _parse_buckets(
+        flag("serving_buckets") if buckets is None else buckets)
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"cannot bucket a packed count of {n}")
+    for b in buckets:
+        if n <= b:
+            return b
+    return 1 << (n - 1).bit_length()
 
 
 class RequestState:
@@ -90,7 +136,9 @@ class BatchScheduler:
 
     def __init__(self, model, max_batch_size=32, page_watermark=0.95,
                  sampler=None, draft_model=None, draft_k=4,
-                 prefix_cache=None):
+                 prefix_cache=None, chunked_prefill=None,
+                 prefill_chunk_tokens=None, serving_buckets=None,
+                 prefix_align=1):
         self.model = model
         self.max_batch_size = int(max_batch_size)
         self.page_watermark = float(page_watermark)
@@ -98,6 +146,33 @@ class BatchScheduler:
         self._queue = collections.deque()
         self._active = {}
         self._finished = {}
+        # chunked prefill (module docstring): None -> auto (on when
+        # the model implements prefill_chunk), True/False force.
+        # Models that only speak decode_token keep the token-per-step
+        # path — also the oracle the chunked tests pin against.
+        if chunked_prefill is None:
+            chunked_prefill = hasattr(model, "prefill_chunk")
+        if chunked_prefill and not hasattr(model, "prefill_chunk"):
+            raise ValueError(
+                "chunked_prefill=True but the model has no "
+                "prefill_chunk(token_ids, seq_ids, start_positions) "
+                "entry (see PagedLlamaAdapter)")
+        self.chunked_prefill = bool(chunked_prefill)
+        self.prefill_chunk_tokens = max(1, int(
+            flag("prefill_chunk_tokens")
+            if prefill_chunk_tokens is None else prefill_chunk_tokens))
+        self.serving_buckets = _parse_buckets(
+            serving_buckets if serving_buckets is not None
+            else flag("serving_buckets"))
+        # speculative prompt phase rides chunked prefill only when the
+        # DRAFT adapter can mirror the chunks too
+        self._spec_chunked = self.chunked_prefill and (
+            draft_model is None
+            or hasattr(draft_model, "prefill_chunk"))
+        self.chunk_stats = {
+            "steps": 0, "chunk_calls": 0, "prefill_tokens": 0,
+            "decode_tokens": 0, "packed_tokens": 0, "padded_tokens": 0,
+        }
         # cross-request prefix KV cache (inference/prefix_cache.py):
         # True builds a RadixPrefixCache over the model's own caches;
         # or pass a pre-built instance (shared across schedulers)
@@ -115,6 +190,13 @@ class BatchScheduler:
         else:
             prefix_cache = None
         self.prefix_cache = prefix_cache
+        # chunk-aligned prefix lookups (prefix_cache.match(align=...)):
+        # align=page_size makes every cached-prefill resume start at a
+        # page boundary, trading <= align-1 hit tokens for never
+        # paying the shared-tail COW draw the reservation must
+        # otherwise hold (docs/SERVING.md). align=1 keeps mid-page
+        # resumes (the default; chunked prefill handles both).
+        self.prefix_align = max(1, int(prefix_align))
         # (req_id, tree mutation count) -> PrefixMatch: avoids
         # re-walking the tree for a head-of-queue request blocked on
         # admission across steps (see _try_admit)
@@ -250,7 +332,8 @@ class BatchScheduler:
                     # to produce the logits that sample the first new
                     # token
                     hit = self.prefix_cache.match(
-                        req.prompt_ids, limit=len(req.prompt_ids) - 1)
+                        req.prompt_ids, limit=len(req.prompt_ids) - 1,
+                        align=self.prefix_align)
                     self._match_memo = (key, hit)
                 if hit.length:
                     # protect the matched chain from the evictor
@@ -386,25 +469,32 @@ class BatchScheduler:
 
     # -- the step ----------------------------------------------------------
     def step(self) -> dict:
-        """One scheduler iteration: admit, advance every active
-        sequence by one token, retire completions. Returns event
-        counters (admitted/advanced/finished)."""
+        """One scheduler iteration: admit, advance the active set,
+        retire completions. Returns event counters
+        (admitted/advanced/finished plus the prefill/decode token
+        split and, under chunked prefill, chunk_utilization and the
+        adapter's ragged-dispatch compile count)."""
         n_before = len(self._active)
         hit_tokens = self._try_admit()
         admitted = len(self._active) - n_before
         if not self._active:
             return {"admitted": admitted, "advanced": 0, "finished": 0,
-                    "prefix_hit_tokens": hit_tokens}
+                    "prefix_hit_tokens": hit_tokens,
+                    "prefill_tokens": 0, "decode_tokens": 0}
 
         if self.draft is not None:
             return self._step_spec(admitted)
+        if self.chunked_prefill:
+            return self._step_chunked(admitted, hit_tokens)
 
         sids = sorted(self._active)
         feed = []
+        n_pre = 0
         for s in sids:
             req = self._active[s]
             if req.state == RequestState.PREFILL:
                 feed.append(req.prompt_ids[req._pos])
+                n_pre += 1
             else:
                 feed.append(req.generated_ids[-1])
         logits = self.model.decode_token(feed, sids)
@@ -449,13 +539,123 @@ class BatchScheduler:
             "advanced": len(sids),
             "finished": finished,
             "prefix_hit_tokens": hit_tokens,
+            "prefill_tokens": n_pre,
+            "decode_tokens": len(sids) - n_pre,
+        }
+
+    def _chunk_feeds(self, sids):
+        """Pack one ragged step: EVERY decode row (one token each)
+        plus up to ``prefill_chunk_tokens`` pending prompt tokens,
+        split across prefilling sequences in id order and resuming
+        mid-prompt. Prefill sequences the budget cannot reach this
+        step are simply left out (they advance on a later step —
+        budget >= 1 guarantees progress). Returns (rows, feeds,
+        starts, prefill_tokens, decode_rows)."""
+        budget = self.prefill_chunk_tokens
+        rows, feeds, starts = [], [], []
+        n_pre = n_dec = 0
+        for s in sids:
+            req = self._active[s]
+            if req.state == RequestState.DECODE:
+                rows.append(s)
+                feeds.append([req.generated_ids[-1]])
+                starts.append(self.model.caches[0].seq_len(s))
+                n_dec += 1
+            elif budget > 0:
+                take = min(len(req.prompt_ids) - req._pos, budget)
+                budget -= take
+                rows.append(s)
+                feeds.append(req.prompt_ids[req._pos:req._pos + take])
+                starts.append(req._pos)
+                n_pre += take
+        return rows, feeds, starts, n_pre, n_dec
+
+    def _advance_prefill_row(self, req, toks, logits_row) -> int:
+        """Commit one chunk of prompt tokens for a PREFILL row:
+        stream them, and when the chunk finishes the prompt either
+        retire (prefill-only) or sample the first generated token
+        from the chunk's last-position logits — the shared completion
+        logic of the chunked step and the speculative prompt phase
+        (in spec mode ``self.sampler`` is the greedy argmax default:
+        a custom sampler is rejected at construction). Returns 1 if
+        the request retired."""
+        req._pos += len(toks)
+        if req.on_token is not None:
+            for t in toks:
+                req.on_token(req, t, True)
+        if req._pos < len(req.prompt_ids):
+            return 0
+        if req.max_new_tokens == 0:
+            # prefill-only (scoring): no sampling
+            self._retire(req)
+            return 1
+        req.state = RequestState.DECODE
+        first = self.sampler(logits_row)
+        req.generated_ids.append(first)
+        if req.on_token is not None:
+            req.on_token(req, first, False)
+        if self._done(req, first):
+            self._retire(req)
+            return 1
+        return 0
+
+    def _step_chunked(self, admitted, hit_tokens) -> dict:
+        """Chunked-prefill scheduler step: one ragged
+        ``prefill_chunk`` call advances every decode row by one token
+        and every budget-reached prefill row by its whole chunk —
+        greedy outputs are token-identical to the token-per-step path
+        (pinned in tests/test_chunked_prefill.py)."""
+        sids = sorted(self._active)
+        rows, feeds, starts, n_pre, n_dec = self._chunk_feeds(sids)
+        packed = sum(len(f) for f in feeds)
+        pad_to = bucket_packed_tokens(packed, self.serving_buckets)
+        logits = self.model.prefill_chunk(
+            feeds, rows, starts, pad_to=pad_to)
+        logits_np = np.asarray(
+            logits.numpy() if hasattr(logits, "numpy") else logits)
+
+        finished = 0
+        for bi, s in enumerate(rows):
+            req = self._active[s]
+            if req.state == RequestState.PREFILL:
+                finished += self._advance_prefill_row(
+                    req, feeds[bi], logits_np[bi])
+                continue
+            tok = self.sampler(logits_np[bi])
+            req.generated_ids.append(tok)
+            if req.on_token is not None:
+                req.on_token(req, tok, False)
+            if self._done(req, tok):
+                self._retire(req)
+                finished += 1
+
+        cs = self.chunk_stats
+        cs["steps"] += 1
+        cs["chunk_calls"] += 1
+        cs["prefill_tokens"] += n_pre
+        cs["decode_tokens"] += n_dec
+        cs["packed_tokens"] += packed
+        cs["padded_tokens"] += pad_to - packed
+        return {
+            "admitted": admitted,
+            "advanced": len(rows),
+            "finished": finished,
+            "prefix_hit_tokens": hit_tokens,
+            "prefill_tokens": n_pre,
+            "decode_tokens": n_dec,
+            "chunk_utilization": round(packed / pad_to, 4),
+            "compile_count": getattr(self.model, "compile_count",
+                                     None),
         }
 
     def _step_spec(self, admitted) -> dict:
-        """Speculative scheduler step: prefill rows advance one prompt
-        token on BOTH adapters; decode rows run one draft-propose /
-        target-verify round each, committing 1..draft_k+1 tokens.
-        Output is token-identical to the plain greedy scheduler."""
+        """Speculative scheduler step: prefill rows advance on BOTH
+        adapters — chunked (one ``prefill_chunk`` call per adapter
+        under the shared token budget) when both adapters implement
+        it, one prompt token per step otherwise; decode rows run one
+        draft-propose / target-verify round each, committing
+        1..draft_k+1 tokens. Output is token-identical to the plain
+        greedy scheduler."""
         sids = sorted(self._active)
         pre = [s for s in sids
                if self._active[s].state == RequestState.PREFILL]
@@ -463,8 +663,32 @@ class BatchScheduler:
                if self._active[s].state == RequestState.DECODE]
         finished = 0
         advanced = 0
+        pre_tokens = 0
+        dec_tokens = 0
 
-        if pre:
+        if pre and self._spec_chunked:
+            rows, feeds, starts, n_pre, _ = self._chunk_feeds(pre)
+            packed = sum(len(f) for f in feeds)
+            pad_to = bucket_packed_tokens(packed, self.serving_buckets)
+            logits = self.model.prefill_chunk(
+                feeds, rows, starts, pad_to=pad_to)
+            # mirror the prompt chunks into the draft's own KV pool
+            self.draft.prefill_chunk(feeds, rows, starts,
+                                     pad_to=pad_to)
+            logits_np = np.asarray(
+                logits.numpy() if hasattr(logits, "numpy") else logits)
+            cs = self.chunk_stats
+            cs["steps"] += 1
+            cs["chunk_calls"] += 2
+            cs["prefill_tokens"] += n_pre
+            cs["packed_tokens"] += packed
+            cs["padded_tokens"] += pad_to - packed
+            pre_tokens = n_pre
+            for bi, s in enumerate(rows):
+                finished += self._advance_prefill_row(
+                    self._active[s], feeds[bi], logits_np[bi])
+            advanced += len(rows)
+        elif pre:
             feed = [self._active[s].prompt_ids[self._active[s]._pos]
                     for s in pre]
             logits = self.model.decode_token(feed, pre)
@@ -491,6 +715,7 @@ class BatchScheduler:
                         self._retire(req)
                         finished += 1
             advanced += len(pre)
+            pre_tokens = len(pre)
 
         if dec:
             k = self.draft_k
@@ -533,6 +758,7 @@ class BatchScheduler:
                 for t in accepted:
                     req.generated_ids.append(t)
                     committed += 1
+                    dec_tokens += 1
                     self.spec_stats["committed_tokens"] += 1
                     if req.on_token is not None:
                         req.on_token(req, t, False)
@@ -555,7 +781,9 @@ class BatchScheduler:
         # decoding (see __init__), but the step summary keeps a
         # uniform shape across both schedulers
         return {"admitted": admitted, "advanced": advanced,
-                "finished": finished, "prefix_hit_tokens": 0}
+                "finished": finished, "prefix_hit_tokens": 0,
+                "prefill_tokens": pre_tokens,
+                "decode_tokens": dec_tokens}
 
     def _done(self, req: Request, last_tok: int) -> bool:
         if req.eos_id is not None and last_tok == req.eos_id:

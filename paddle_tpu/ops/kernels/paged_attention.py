@@ -223,20 +223,27 @@ def paged_attention_reference(q, k_pages, v_pages, page_table,
 
 
 def _prefill_kernel(scale, page_size, group, max_pages, t, window,
-                    quant, *refs):
+                    quant, ragged, *refs):
     """Chunked-prefill: T new tokens per sequence attend causally to
     the whole paged prefix (the new tokens' K/V already live in the
     pages; seq_lens counts them). ``window`` > 0 bands the mask
     (0 <= qpos - kpos < window) and skips pages below every row's
     window. ``quant``: int8 pages dequantized in VMEM via the
-    scalar-prefetched per-page scale sidecars."""
+    scalar-prefetched per-page scale sidecars. ``ragged``: a
+    scalar-prefetched q_lens vector marks how many TRAILING rows of
+    each sequence's T-row block are real new tokens (mixed
+    prefill/decode batches right-align shorter chunks); the padded
+    leading rows produce exact zeros."""
+    refs = list(refs)
+    page_tbl_ref = refs.pop(0)
+    lens_ref = refs.pop(0)
+    q_lens_ref = refs.pop(0) if ragged else None
     if quant:
-        (page_tbl_ref, lens_ref, k_scale_ref, v_scale_ref,
-         q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref) = refs
+        k_scale_ref = refs.pop(0)
+        v_scale_ref = refs.pop(0)
     else:
-        (page_tbl_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-         m_ref, l_ref, acc_ref) = refs
         k_scale_ref = v_scale_ref = None
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
     hq = pl.program_id(1)
     p = pl.program_id(2)
@@ -280,6 +287,12 @@ def _prefill_kernel(scale, page_size, group, max_pages, t, window,
         keep = (kpos <= qpos) & (kpos < seq_len)
         if window:
             keep = keep & (qpos - kpos < window)
+        if ragged:
+            # rows below t - q_lens[b] are padding (right-aligned
+            # chunk shorter than the block): mask their scores too so
+            # the softmax state stays finite
+            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            keep = keep & (row >= t - q_lens_ref[b])
         s = jnp.where(keep, s, NEG_INF)
         m_prev = m_ref[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -299,19 +312,26 @@ def _prefill_kernel(scale, page_size, group, max_pages, t, window,
     @pl.when(p == max_pages - 1)
     def _():
         safe_l = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        out = acc_ref[:] / safe_l
+        if ragged:
+            row = jax.lax.broadcasted_iota(jnp.int32, out.shape, 0)
+            out = jnp.where(row >= t - q_lens_ref[b], out, 0.0)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
                             sm_scale=None, interpret=None, window=0,
-                            k_scales=None, v_scales=None):
+                            k_scales=None, v_scales=None, q_lens=None):
     """Ragged chunked-prefill over a paged KV cache.
 
     q: (B, T, H, D) — the T newest tokens of each sequence, whose K/V
     have already been appended to the pages; seq_lens counts them.
-    Rows of lanes whose true new-token count < T should be masked by
-    the caller (positions follow seq_len). Returns (B, T, H, D).
-    Int8 pages: pass k_scales/v_scales (NP, KVH) as in
+    ``q_lens`` (B,) optionally marks how many TRAILING rows of each
+    sequence are real new tokens (a ragged batch right-aligns chunks
+    shorter than T); the padded leading rows return exact zeros.
+    Without q_lens every row is treated as real (positions follow
+    seq_len) and short rows must be masked by the caller. Returns
+    (B, T, H, D). Int8 pages: pass k_scales/v_scales (NP, KVH) as in
     :func:`paged_attention`.
     """
     b, t, h, d = q.shape
@@ -344,8 +364,11 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
     def kv_map(b_, h_, p_, tbl, *pref):
         return (h_ // group, tbl[b_, p_], 0, 0)
 
+    ragged = q_lens is not None
     scalar_args = [page_table.astype(jnp.int32),
                    seq_lens.astype(jnp.int32)]
+    if ragged:
+        scalar_args.append(jnp.asarray(q_lens).astype(jnp.int32))
     if quant:
         scalar_args += [k_scales.astype(jnp.float32),
                         v_scales.astype(jnp.float32)]
@@ -367,7 +390,7 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
     )
     kernel = functools.partial(
         _prefill_kernel, float(scale), page_size, group, max_pages, t,
-        int(window or 0), quant,
+        int(window or 0), quant, ragged,
     )
     out = pl.pallas_call(
         kernel,

@@ -235,6 +235,60 @@ class TestRecompileHazards:
         rep = paddle.jit.analyze(lambda x, y: x * y, _x32(), _x32())
         assert "recompile-static-scalar" not in _rules(rep)
 
+    def test_monotone_token_growth_fires_serving_shape(self):
+        # the unbucketed-prefill signature: the same compiled function
+        # fed strictly longer token batches call after call — one full
+        # retrace + compile per prompt length
+        sf = paddle.jit.to_static(lambda x: (x * 2.0).sum())
+        for n in (8, 12, 16, 20):
+            sf(_x32((1, n)))
+        rep = paddle.jit.analyze(sf)
+        assert "recompile-serving-shape" in _rules(rep)
+        f = next(f for f in rep.findings
+                 if f.rule == "recompile-serving-shape")
+        assert f.severity == "warning"
+        assert "8 -> 20" in f.message
+        assert "bucket" in f.suggestion
+
+    def test_bucketed_shapes_clean(self):
+        # a bucketed caller warming up its power-of-two ladder grows
+        # GEOMETRICALLY — that is legitimate, not the signature (and
+        # repeats are cache hits that add no entries at all)
+        sf = paddle.jit.to_static(lambda x: (x * 2.0).sum())
+        for n in (8, 16, 32, 64, 16, 8):
+            sf(_x32((1, n)))
+        rep = paddle.jit.analyze(sf)
+        assert "recompile-serving-shape" not in _rules(rep)
+
+    def test_configured_bucket_ladder_clean_even_non_geometric(self):
+        # a NON-geometric bucket set is valid config; warming it up in
+        # increasing order must not trip the rule — values that are
+        # all members of FLAGS_serving_buckets are the sanctioned
+        # ladder by definition
+        with flags(serving_buckets="8,16,32,48,64"):
+            sf = paddle.jit.to_static(lambda x: (x * 2.0).sum())
+            for n in (8, 16, 32, 48, 64):
+                sf(_x32((1, n)))
+            rep = paddle.jit.analyze(sf)
+        assert "recompile-serving-shape" not in _rules(rep)
+
+    def test_few_growing_entries_clean(self):
+        # 2-3 growing shapes are normal warmup, not a trend
+        sf = paddle.jit.to_static(lambda x: (x * 2.0).sum())
+        for n in (8, 16, 32):
+            sf(_x32((1, n)))
+        rep = paddle.jit.analyze(sf)
+        assert "recompile-serving-shape" not in _rules(rep)
+
+    def test_serving_shape_suppression(self):
+        sf = paddle.jit.to_static(lambda x: (x * 2.0).sum())
+        for n in (8, 12, 16, 20):
+            sf(_x32((1, n)))
+        rep = paddle.jit.analyze(
+            sf, suppress=("recompile-serving-shape",))
+        assert "recompile-serving-shape" not in _rules(rep)
+        assert rep.suppressed.get("recompile-serving-shape", 0) >= 1
+
 
 # ---------------------------------------------------------------------------
 # rule family 5: oversized unsharded compute

@@ -183,6 +183,73 @@ class TestQuantSidecarRule:
             assert os.path.isdir(d), d
 
 
+class TestServingBucketRule:
+    """ISSUE-5 satellite: the serving scheduler must never hand the
+    model an unbucketed ragged token batch — every packed feed goes
+    through the bucket helper (bucket_packed_tokens) before a
+    prefill_chunk call."""
+
+    def test_seeded_unbucketed_feed_flagged(self):
+        bad = (
+            "class Sched:\n"
+            "    def step(self):\n"
+            "        feeds, rows, starts = self._pack()\n"
+            "        return self.model.prefill_chunk(\n"
+            "            feeds, rows, starts)\n"
+        )
+        v = lint_codebase.lint_serving_bucket_file("fake/serving.py",
+                                                   text=bad)
+        assert len(v) == 1, v
+        assert "bucket_packed_tokens" in v[0]
+        assert "prefill_chunk" in v[0]
+
+    def test_bucketed_feed_clean(self):
+        ok = (
+            "class Sched:\n"
+            "    def step(self):\n"
+            "        feeds, rows, starts = self._pack()\n"
+            "        pad = bucket_packed_tokens(sum(map(len, feeds)),\n"
+            "                                   self.buckets)\n"
+            "        return self.model.prefill_chunk(\n"
+            "            feeds, rows, starts, pad_to=pad)\n"
+        )
+        assert lint_codebase.lint_serving_bucket_file(
+            "fake/serving.py", text=ok) == []
+
+    def test_helper_in_nested_scope_does_not_count(self):
+        # the bucket call must be in the SAME scope as the feed — a
+        # nested def that never runs cannot sanction the call site
+        bad = (
+            "class Sched:\n"
+            "    def step(self):\n"
+            "        def unused():\n"
+            "            return bucket_packed_tokens(8)\n"
+            "        return self.model.prefill_chunk(f, r, s)\n"
+        )
+        v = lint_codebase.lint_serving_bucket_file("fake/serving.py",
+                                                   text=bad)
+        assert len(v) == 1, v
+
+    def test_waiver_comment_suppresses(self):
+        bad = (
+            "class Sched:\n"
+            "    def step(self):\n"
+            "        return self.model.prefill_chunk(f, r, s)"
+            "  # trace-lint: ok(test waiver)\n"
+        )
+        assert lint_codebase.lint_serving_bucket_file(
+            "fake/serving.py", text=bad) == []
+
+    def test_serving_module_is_covered_and_clean(self):
+        covered = [os.path.join(REPO, f)
+                   for f in lint_codebase.SERVING_BUCKET_FILES]
+        assert any(p.endswith(os.path.join("inference", "serving.py"))
+                   for p in covered)
+        for p in covered:
+            assert os.path.exists(p), p
+        assert lint_codebase.check_serving_buckets() == []
+
+
 class TestCollectiveMatmulDiscipline:
     """ISSUE-4 satellite: the collective-matmul kernel module is
     jax-only, and the TP/SP layer modules must route dependent

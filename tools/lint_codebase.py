@@ -29,7 +29,14 @@ Checks:
    corrupts every shared reader of the page. Serving modules
    (paddle_tpu/inference/) may READ them through the pool API but
    must never assign, aug-assign, or ``.at[...]``-update them.
-5. collective-matmul discipline: ops/kernels/collective_matmul.py is
+5. serving-bucket discipline: inference/serving.py must never hand
+   the model an UNBUCKETED ragged token batch — a packed feed whose
+   length varies freely keys a fresh XLA compile per distinct length
+   (the recompile-serving-shape hazard the trace linter flags). Any
+   function in the scheduler module that calls ``*.prefill_chunk(...)``
+   must also call the sanctioned pad-to-bucket helper
+   (``bucket_packed_tokens``) in the same scope.
+6. collective-matmul discipline: ops/kernels/collective_matmul.py is
    jax-only (every body runs inside jit traces under shard_map) — no
    host-side module imports (os/sys/time/numpy/threading/...); and the
    TP/SP layer modules (mpu/mp_layers.py, mpu/mp_ops.py,
@@ -297,6 +304,102 @@ def check_quant_sidecar_writes(root=REPO):
     return out
 
 
+# the serving scheduler module: every packed ragged feed it hands the
+# model must be padded through the bucket helper first (otherwise each
+# distinct packed length compiles a fresh XLA program)
+SERVING_BUCKET_FILES = (
+    os.path.join("paddle_tpu", "inference", "serving.py"),
+)
+
+# the model entry that consumes a packed ragged token batch, and the
+# sanctioned helper that buckets it
+_RAGGED_MODEL_CALLS = frozenset({"prefill_chunk"})
+_BUCKET_HELPER_CALLS = frozenset({"bucket_packed_tokens"})
+
+
+class _ServingBucketVisitor(ast.NodeVisitor):
+    """Per innermost function: a ``*.prefill_chunk(...)`` call without
+    a ``bucket_packed_tokens`` call in the same scope feeds the model
+    a raw packed length — the unbucketed ragged batch the trace
+    linter's recompile-serving-shape rule exists to catch at runtime;
+    this catches it at review time."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+
+    def _call_name(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        if isinstance(fn, ast.Name):
+            return fn.id
+        return None
+
+    def _scoped_calls(self, node):
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            if isinstance(sub, ast.Call):
+                yield sub
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _check_fn(self, node):
+        ragged, bucketed = [], False
+        for sub in self._scoped_calls(node):
+            name = self._call_name(sub)
+            if name in _RAGGED_MODEL_CALLS:
+                ragged.append((sub.lineno, name))
+            elif name in _BUCKET_HELPER_CALLS:
+                bucketed = True
+        if ragged and not bucketed:
+            lineno, name = min(ragged)
+            line = self.lines[lineno - 1] \
+                if lineno - 1 < len(self.lines) else ""
+            if _WAIVER_MARK not in line:
+                self.violations.append(
+                    "%s:%d: function %r calls %s without bucketing "
+                    "the packed feed (bucket_packed_tokens) — an "
+                    "unbucketed ragged token batch compiles one XLA "
+                    "program per distinct packed length; pad to a "
+                    "FLAGS_serving_buckets bucket or waive with "
+                    "'%s(<reason>)'"
+                    % (self.relpath, lineno, node.name, name,
+                       _WAIVER_MARK))
+
+    def visit_FunctionDef(self, node):
+        self._check_fn(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def lint_serving_bucket_file(path, text=None):
+    """Bucketed-ragged-feed check; returns violation strings."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _ServingBucketVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_serving_buckets(root=REPO):
+    out = []
+    for f in SERVING_BUCKET_FILES:
+        out.extend(lint_serving_bucket_file(os.path.join(root, f)))
+    return out
+
+
 # modules that must stay pure-jax: collective-matmul ring kernels run
 # entirely inside jit traces under shard_map — a host-side import is
 # either dead weight or a per-step host sync waiting to happen
@@ -558,6 +661,7 @@ def run_lint(root=REPO, with_op_table=True):
     out = check_traced_paths(root)
     out.extend(check_host_only(root))
     out.extend(check_quant_sidecar_writes(root))
+    out.extend(check_serving_buckets(root))
     out.extend(check_jax_only(root))
     out.extend(check_tp_routing(root))
     if with_op_table:
