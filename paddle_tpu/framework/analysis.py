@@ -890,6 +890,51 @@ def live_lint_summaries() -> List[dict]:
     return out
 
 
+def static_check_inventory() -> dict:
+    """Every static check in the repo, one inventory: the trace-time
+    jaxpr rules above, the KV page-pool sanitizer's violation classes
+    (incubate/nn/page_sanitizer.py — the dynamic checker whose
+    coverage the codebase lint guarantees), and the AST rules of
+    tools/lint_codebase.py. Emitted in the CLI's --json payload under
+    ``static_checks`` and printable standalone with ``--rules``."""
+    inv = {"jaxpr": [dataclasses.asdict(r) for r in RULES.values()]}
+    try:
+        from ..incubate.nn.page_sanitizer import VIOLATIONS
+
+        inv["page_sanitizer"] = [
+            {"rule_id": rid, "severity": "critical", "summary": s}
+            for rid, s in VIOLATIONS.items()]
+    except Exception:  # pragma: no cover - circulars in odd installs
+        inv["page_sanitizer"] = []
+    inv["codebase_lint"] = []
+    try:
+        import importlib.util
+        import os as _os
+
+        path = _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.dirname(
+                _os.path.abspath(__file__)))),
+            "tools", "lint_codebase.py")
+        if _os.path.exists(path):
+            spec = importlib.util.spec_from_file_location(
+                "_lint_codebase_inventory", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            inv["codebase_lint"] = [
+                {"rule_id": rid, "severity": "error", "summary": s}
+                for rid, s in mod.RULES]
+    except Exception as e:  # pragma: no cover
+        # absence is handled by the exists() guard above — a FAILURE
+        # to exec must not silently pass off an empty list as "the
+        # complete inventory"
+        import sys as _sys
+
+        print("static_check_inventory: could not load "
+              "tools/lint_codebase.py rules: %s" % (e,),
+              file=_sys.stderr)
+    return inv
+
+
 # ---------------------------------------------------------------------------
 # CLI: python -m paddle_tpu.framework.analysis script.py [--json out]
 # ---------------------------------------------------------------------------
@@ -917,18 +962,46 @@ def main(argv=None) -> int:
         "entrypoint builds. The script is exec'd (not as __main__); "
         "if it compiles nothing at import, its main() is called. "
         "Run host-side with JAX_PLATFORMS=cpu.")
-    ap.add_argument("entrypoint",
+    ap.add_argument("entrypoint", nargs="?", default=None,
                     help="script path, optionally :callable to invoke "
-                    "after import (default tries main())")
+                    "after import (default tries main()); optional "
+                    "with --rules")
     ap.add_argument("--json", metavar="PATH", default="",
                     help="write the full report list as JSON "
                     "('-' for stdout)")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the full static-check inventory "
+                    "(jaxpr lint rules + page-sanitizer violation "
+                    "classes + codebase AST lint rules) and exit; "
+                    "honors --json")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on any warning/critical finding "
                     "(default: only criticals fail)")
     ap.add_argument("--suppress", default="",
                     help="comma-separated rule ids to suppress")
     args = ap.parse_args(argv)
+
+    if args.rules:
+        inv = static_check_inventory()
+        if args.json:
+            payload = json.dumps({"version": 1,
+                                  "static_checks": inv}, indent=1)
+            if args.json == "-":
+                print(payload)
+            else:
+                with open(args.json, "w") as f:
+                    f.write(payload)
+                print("wrote %s" % args.json)
+        else:
+            for group, rules in inv.items():
+                print("%s (%d rules)" % (group, len(rules)))
+                for r in rules:
+                    print("  %-26s %-8s %s" % (
+                        r["rule_id"], r["severity"], r["summary"]))
+                print()
+        return 0
+    if args.entrypoint is None:
+        ap.error("entrypoint is required unless --rules is given")
 
     entry, fn_name = args.entrypoint, ""
     if ":" in entry and not os.path.exists(entry):
@@ -952,8 +1025,12 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
-    payload = {"version": 1, "entrypoint": args.entrypoint,
-               "programs": [r.to_dict() for r in reports]}
+    if args.json:
+        # the inventory exec's tools/lint_codebase.py from disk —
+        # build it only when a JSON payload is actually emitted
+        payload = {"version": 1, "entrypoint": args.entrypoint,
+                   "programs": [r.to_dict() for r in reports],
+                   "static_checks": static_check_inventory()}
     if args.json == "-":
         print(json.dumps(payload, indent=1))
     else:

@@ -150,6 +150,34 @@ define_flag("serving_buckets", "8,16,32,64,128,256",
             "instead of one per distinct packed length. Counts beyond "
             "the largest bucket round up to the next power of two "
             "(each such shape is one extra compile)")
+define_flag("page_sanitizer", "off",
+            "KV page-pool sanitizer for the paged serving stack "
+            "(incubate/nn/page_sanitizer.py): 'off' (default) is "
+            "zero-cost — no shadow objects are allocated and every "
+            "instrumented pool mutation is a single attribute check; "
+            "'warn' mirrors every PagedKVCacheManager mutation into a "
+            "shadow heap, validates it (use-after-free via page "
+            "generations, double-free, refcount leaks, copy-on-write "
+            "violations, stale page-table rows, capacity drift) and "
+            "logs violations as RuntimeWarning; 'strict' raises "
+            "PageSanitizerError carrying the journal tail, and "
+            "BatchScheduler additionally runs "
+            "assert_ref_invariants() at the epoch stride "
+            "(docs/ANALYSIS.md)")
+define_flag("page_sanitizer_journal", 512,
+            "bounded event-journal chunk size for the page sanitizer: "
+            "the journal keeps a shadow-heap snapshot plus up to this "
+            "many typed events, so a dumped journal always replays "
+            "(python -m paddle_tpu.incubate.nn.page_sanitizer "
+            "--replay <file>) from a sound state regardless of how "
+            "long the pool ran")
+define_flag("page_sanitizer_stride", 16,
+            "epoch cross-check stride for the page sanitizer: every "
+            "this many BatchScheduler steps the shadow heap is "
+            "compared against the real pool (refcounts, free list, "
+            "sequence lens, num_free_pages capacity accounting) and, "
+            "in strict mode, assert_ref_invariants() runs on every "
+            "cache")
 define_flag("moe_dense_dispatch", False,
             "route MoE tokens via the dense (N,E,C) one-hot "
             "dispatch/combine einsums instead of the sparse index "

@@ -380,3 +380,7 @@ def fused_linear(x, weight, bias=None, transpose_weight=False,
 
 
 from .paged_cache import PagedKVCacheManager, paged_attention  # noqa
+from .page_sanitizer import (  # noqa
+    PageSanitizer,
+    PageSanitizerError,
+)

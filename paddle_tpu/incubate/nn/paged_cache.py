@@ -38,6 +38,21 @@ paged-attention kernels (scales ride scalar prefetch). The sidecar is
 pool-private state: serving layers must never write
 ``k_scales``/``v_scales`` directly (enforced by
 tools/lint_codebase.py).
+
+Sanitizer (``FLAGS_page_sanitizer`` or the ``sanitizer=`` kwarg;
+incubate/nn/page_sanitizer.py): in ``warn``/``strict`` mode every
+mutation here — alloc/attach/incref/decref/free/truncate, the
+copy-on-write fork, each append flavor, and every page table handed
+to a kernel — is mirrored as a typed event into a bounded journal and
+validated against a shadow heap with per-page generation counters
+(use-after-free, double-free, refcount leaks, COW violations, stale
+kernel inputs, capacity drift). ``off`` (the default) allocates no
+shadow objects: each instrumented method pays one ``is None`` check.
+ALL pool state (``k_pages``/``v_pages``/``k_scales``/``v_scales``,
+``_refcnt``/``_free``/``_tables``/``_lens``/``_ext_refs``) is
+pool-private — tools/lint_codebase.py's mutation audit rejects writes
+or private-method calls from serving code, so the sanitizer's event
+coverage is complete by construction.
 """
 from __future__ import annotations
 
@@ -49,6 +64,7 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor, apply_op, _as_tensor
+from ...framework.flags import flag
 from ...ops.kernels.paged_attention import paged_attention as _kernel
 from ...ops.kernels.paged_attention import (
     paged_prefill_attention as _prefill_kernel,
@@ -81,7 +97,7 @@ class PagedKVCacheManager:
     }
 
     def __init__(self, num_pages, page_size, kv_heads, head_dim,
-                 dtype=jnp.bfloat16, kv_dtype=None):
+                 dtype=jnp.bfloat16, kv_dtype=None, sanitizer=None):
         self.page_size = int(page_size)
         self.num_pages = int(num_pages)
         if kv_dtype is not None:
@@ -111,11 +127,25 @@ class PagedKVCacheManager:
         # owner's cooperation
         self._ext_refs = collections.Counter()
         self.cow_forks = 0  # lifetime count of copy-on-write forks
+        # lifecycle sanitizer (page_sanitizer.py): 'off' is zero-cost
+        # by constructing NOTHING — every instrumented method below
+        # guards on `self._san is not None` only
+        mode = sanitizer if sanitizer is not None \
+            else flag("page_sanitizer")
+        if mode and mode != "off":
+            from .page_sanitizer import PageSanitizer
+
+            self._san = PageSanitizer(self.num_pages, self.page_size,
+                                      mode=mode)
+        else:
+            self._san = None
 
     # -- bookkeeping -------------------------------------------------------
     def alloc(self, seq_id):
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already allocated")
+        if self._san is not None:
+            self._san.event("alloc", seq=seq_id)
         self._tables[seq_id] = []
         self._lens[seq_id] = 0
 
@@ -131,50 +161,92 @@ class PagedKVCacheManager:
             raise ValueError(
                 f"attach({seq_id!r}): {length} tokens span {need} "
                 f"pages, got a chain of {len(pages)}")
+        if self._san is not None:
+            # strict mode raises here (with the journal) on a dangling
+            # chain, before the pool's own ValueError below
+            self._san.event("attach", seq=seq_id,
+                            pages=[int(p) for p in pages],
+                            length=int(length))
         for p in pages:
             if self._refcnt[p] == 0:
                 raise ValueError(
                     f"attach({seq_id!r}): page {p} is on the free "
-                    "list (dangling chain)")
-        for p in pages:
-            self._refcnt[p] += 1
+                    "list (dangling chain)" + self._san_tail())
+        self._ref_pages(pages)
         self._tables[seq_id] = list(pages)
         self._lens[seq_id] = int(length)
+        if self._san is not None:
+            self._san.verify_pages(pages, self)
+
+    def _ref_pages(self, pages):
+        """Take one reference per chain page (attach)."""
+        for p in pages:
+            self._refcnt[p] += 1
 
     def free(self, seq_id):
-        tbl = self._tables.pop(seq_id, None)
+        tbl = self._tables.get(seq_id)
+        if self._san is not None:
+            # emitted BEFORE the lookup raise: a double-free lands in
+            # the journal, strict mode raises with the event tail
+            self._san.event(
+                "free", seq=seq_id,
+                pages=None if tbl is None else [int(p) for p in tbl])
         if tbl is None:
             raise KeyError(
                 f"free({seq_id!r}): unknown or already-freed sequence "
-                "(double-free would corrupt the page free list)")
-        for p in reversed(tbl):
-            self._release_page(p)
+                "(double-free would corrupt the page free list)"
+                + self._san_tail())
+        del self._tables[seq_id]
+        self._drop_refs(tbl)
         self._lens.pop(seq_id)
+        if self._san is not None:
+            self._san.verify_pages(tbl, self)
+
+    def _drop_refs(self, pages):
+        """Release a retiring sequence's references (free)."""
+        for p in reversed(pages):
+            self._release_page(p)
+
+    def _san_tail(self) -> str:
+        return ("\n" + self._san.format_tail()
+                if self._san is not None else "")
 
     # -- reference counting ------------------------------------------------
     def incref(self, pages):
         """Add an external (non-sequence) reference to each page —
         used by the prefix tree to keep a retired sequence's prefix
         alive past ``free``."""
+        pages = list(pages)
+        if self._san is not None:
+            self._san.event("incref", pages=[int(p) for p in pages])
         for p in pages:
             if self._refcnt[p] == 0:
                 raise ValueError(
-                    f"incref: page {p} is free (cannot resurrect)")
+                    f"incref: page {p} is free (cannot resurrect)"
+                    + self._san_tail())
             self._refcnt[p] += 1
             self._ext_refs[p] += 1
+        if self._san is not None:
+            self._san.verify_pages(pages, self)
 
     def decref(self, pages):
         """Drop external references; returns how many pages that
         released back to the pool."""
+        pages = list(pages)
+        if self._san is not None:
+            self._san.event("decref", pages=[int(p) for p in pages])
         freed = 0
         for p in pages:
             if self._ext_refs[p] <= 0:
                 raise ValueError(
-                    f"decref: page {p} holds no external reference")
+                    f"decref: page {p} holds no external reference"
+                    + self._san_tail())
             self._ext_refs[p] -= 1
             if self._ext_refs[p] == 0:
                 del self._ext_refs[p]
             freed += self._release_page(p)
+        if self._san is not None:
+            self._san.verify_pages(pages, self)
         return freed
 
     def _release_page(self, p):
@@ -246,9 +318,15 @@ class PagedKVCacheManager:
                 f"truncate({seq_id!r}, {n}): sequence has only {cur}")
         keep = -(-n // self.page_size) if n else 0
         tbl = self._tables[seq_id]
+        dropped = tbl[keep:]
+        if self._san is not None:
+            self._san.event("truncate", seq=seq_id, n=int(n),
+                            dropped=[int(p) for p in dropped])
         while len(tbl) > keep:
             self._release_page(tbl.pop())
         self._lens[seq_id] = n
+        if self._san is not None and dropped:
+            self._san.verify_pages(dropped, self)
 
     @property
     def num_free_pages(self) -> int:
@@ -284,15 +362,76 @@ class PagedKVCacheManager:
                 f"{sorted(zero)}")
         return True
 
+    # -- lifecycle sanitizer surface (page_sanitizer.py) -------------------
+    @property
+    def sanitizer(self):
+        """The pool's PageSanitizer, or None when off."""
+        return self._san
+
+    @property
+    def sanitizer_stats(self):
+        """Event/violation counters, or None when off."""
+        return None if self._san is None else self._san.stats()
+
+    def sanitizer_page_gens(self, pages):
+        """Current shadow generation of each listed page (None when
+        the sanitizer is off). Capture these next to a held chain —
+        a later :meth:`sanitizer_check_chain` proves no page was
+        recycled underneath the holder."""
+        return (None if self._san is None
+                else self._san.page_gens(pages))
+
+    def sanitizer_check_chain(self, pages, gens, what="chain"):
+        """Validate a generation-tagged chain captured earlier (the
+        radix prefix tree checks its node chains on every match)."""
+        if self._san is not None and gens is not None:
+            self._san.check_chain(pages, gens, what=what)
+
+    def sanitizer_note(self, op, **fields):
+        """Journal a context-only event (prefix-cache pin / unpin /
+        evict / insert) — diagnosis breadcrumbs, no shadow
+        semantics."""
+        if self._san is not None:
+            self._san.note(op, **fields)
+
+    def sanitizer_crosscheck(self):
+        """Epoch cross-check: compare the shadow heap against the real
+        pool (refcounts, free list, lens, ``num_free_pages``) and, in
+        strict mode, run :meth:`assert_ref_invariants` too — the
+        BatchScheduler calls this every FLAGS_page_sanitizer_stride
+        steps. Returns the sanitizer stats dict, or None when off."""
+        if self._san is None:
+            return None
+        self._san.crosscheck(self)
+        if self._san.mode == "strict":
+            try:
+                self.assert_ref_invariants()
+            except AssertionError as e:
+                raise AssertionError(
+                    str(e) + "\n" + self._san.format_tail()) from None
+        return self._san.stats()
+
+    def _san_check_table(self, seq_ids, tbl, lens):
+        self._san.check_table(
+            seq_ids, np.asarray(tbl), np.asarray(lens))
+
+    def _needs_fork(self, page) -> bool:
+        """A mid-page write must fork when the page is shared."""
+        return self._refcnt[page] > 1
+
     def _next_slot(self, seq_id):
         n = self._lens[seq_id]
         off = n % self.page_size
         tbl = self._tables[seq_id]
         if off == 0:
             tbl.append(self._alloc_page())
-        elif self._refcnt[tbl[-1]] > 1:
+        elif self._needs_fork(tbl[-1]):
             # divergent write into a shared page: fork first
-            tbl[-1] = self._fork_page(tbl[-1])
+            src = tbl[-1]
+            tbl[-1] = self._fork_page(src)
+            if self._san is not None:
+                self._san.event("fork", seq=seq_id, src=int(src),
+                                dst=int(tbl[-1]), pool=self)
         return tbl[-1], off
 
     # -- quantized writes --------------------------------------------------
@@ -346,19 +485,22 @@ class PagedKVCacheManager:
         v_tok = v_tok._data if isinstance(v_tok, Tensor) else v_tok
         if self.quantized:
             self._quant_write([page], [off], k_tok[None], v_tok[None])
-            self._lens[seq_id] += 1
-            return page, off
-        self.k_pages = jax.lax.dynamic_update_slice(
-            self.k_pages,
-            k_tok[None, None].astype(self.k_pages.dtype),
-            (page, off, 0, 0),
-        )
-        self.v_pages = jax.lax.dynamic_update_slice(
-            self.v_pages,
-            v_tok[None, None].astype(self.v_pages.dtype),
-            (page, off, 0, 0),
-        )
+        else:
+            self.k_pages = jax.lax.dynamic_update_slice(
+                self.k_pages,
+                k_tok[None, None].astype(self.k_pages.dtype),
+                (page, off, 0, 0),
+            )
+            self.v_pages = jax.lax.dynamic_update_slice(
+                self.v_pages,
+                v_tok[None, None].astype(self.v_pages.dtype),
+                (page, off, 0, 0),
+            )
         self._lens[seq_id] += 1
+        if self._san is not None:
+            self._san.event("append", seq_ids=[seq_id], counts=[1],
+                            pages=[int(page)], offs=[int(off)],
+                            pool=self)
         return page, off
 
     def append_batch(self, seq_ids, k_toks, v_toks):
@@ -391,13 +533,18 @@ class PagedKVCacheManager:
             offs.append(off)
         if self.quantized:
             self._quant_write(pages, offs, k_toks, v_toks)
-            return
-        pg = jnp.asarray(pages, jnp.int32)
-        of = jnp.asarray(offs, jnp.int32)
-        self.k_pages = self.k_pages.at[pg, of].set(
-            k_toks.astype(self.k_pages.dtype))
-        self.v_pages = self.v_pages.at[pg, of].set(
-            v_toks.astype(self.v_pages.dtype))
+        else:
+            pg = jnp.asarray(pages, jnp.int32)
+            of = jnp.asarray(offs, jnp.int32)
+            self.k_pages = self.k_pages.at[pg, of].set(
+                k_toks.astype(self.k_pages.dtype))
+            self.v_pages = self.v_pages.at[pg, of].set(
+                v_toks.astype(self.v_pages.dtype))
+        if self._san is not None:
+            self._san.event("append_batch", seq_ids=list(seq_ids),
+                            counts=[1] * len(pages),
+                            pages=[int(p) for p in pages],
+                            offs=[int(o) for o in offs], pool=self)
 
     def ragged_pages_needed(self, seq_ids, counts) -> int:
         """Free-list draws a ragged append of ``counts[i]`` tokens per
@@ -448,6 +595,11 @@ class PagedKVCacheManager:
                 offs.append(off)
         if not pages:
             return
+        if self._san is not None:
+            self._san.event("append_ragged", seq_ids=list(seq_ids),
+                            counts=list(counts),
+                            pages=[int(p) for p in pages],
+                            offs=[int(o) for o in offs], pool=self)
         if self.quantized:
             # replay the per-token calibration ORDER (wave j = the
             # j-th token of every chunk): scale growth requantizes
@@ -477,8 +629,11 @@ class PagedKVCacheManager:
 
     # -- kernel inputs -----------------------------------------------------
     def page_table(self, seq_ids, max_pages=None):
-        return self._padded_kernel_inputs(
-            seq_ids, len(seq_ids), max_pages)[0]
+        tbl, lens = self._padded_kernel_inputs(
+            seq_ids, len(seq_ids), max_pages)
+        if self._san is not None:
+            self._san_check_table(seq_ids, tbl, lens)
+        return tbl
 
     def seq_lens(self, seq_ids):
         return jnp.asarray(
@@ -521,6 +676,8 @@ class PagedKVCacheManager:
         q = _as_tensor(q)
         tbl, lens = self._padded_kernel_inputs(
             seq_ids, rows_pad, max_pages)
+        if self._san is not None:
+            self._san_check_table(seq_ids, tbl, lens)
         kp, vp = self.k_pages, self.v_pages
         ks = self.k_scales if self.quantized else None
         vs = self.v_scales if self.quantized else None
@@ -541,6 +698,8 @@ class PagedKVCacheManager:
         q = _as_tensor(q)
         tbl, lens = self._padded_kernel_inputs(
             seq_ids, rows_pad, max_pages)
+        if self._san is not None:
+            self._san_check_table(seq_ids, tbl, lens)
         ql = jnp.zeros((tbl.shape[0],), jnp.int32)
         ql = ql.at[:len(seq_ids)].set(
             jnp.asarray(list(q_lens), jnp.int32))

@@ -52,11 +52,16 @@ class PagedLlamaAdapter:
       ``page_pool_bytes // (layers * page_nbytes)``, so switching
       kv_cache_dtype at a FIXED byte budget changes capacity, not
       spend.
+    * ``sanitizer`` — per-adapter override of ``FLAGS_page_sanitizer``
+      (``"off"``/``"warn"``/``"strict"``): every per-layer pool gets
+      the lifecycle shadow heap + event journal of
+      incubate/nn/page_sanitizer.py.
     """
 
     def __init__(self, model, num_pages=256, page_size=16,
                  max_length=None, dtype=None, kv_cache_dtype=None,
-                 weight_dtype=None, page_pool_bytes=None):
+                 weight_dtype=None, page_pool_bytes=None,
+                 sanitizer=None):
         self.model = model
         cfg = model.config
         self.cfg = cfg
@@ -79,6 +84,7 @@ class PagedLlamaAdapter:
             return PagedKVCacheManager(
                 n, page_size, cfg.num_key_value_heads,
                 cfg.head_dim, dtype=dtype, kv_dtype=kv_cache_dtype,
+                sanitizer=sanitizer,
             )
 
         if page_pool_bytes is not None:

@@ -46,17 +46,22 @@ class _Node:
     """One radix-tree edge+node: ``key`` is the token span entering
     this node, ``start`` its absolute token offset from the root, and
     ``pages[l]`` the physical pages of layer ``l`` overlapping
-    [start, start + len(key))."""
+    [start, start + len(key)). ``gens`` (page-sanitizer runs only)
+    carries the per-layer page GENERATIONS captured when the node took
+    its references — a later match proves the pages were never
+    recycled underneath the tree (a skipped incref turns into an
+    immediate use-after-free report instead of silent KV aliasing)."""
 
     __slots__ = ("key", "start", "children", "parent", "pages",
-                 "last_use", "pin")
+                 "gens", "last_use", "pin")
 
-    def __init__(self, key, start, pages, parent):
+    def __init__(self, key, start, pages, parent, gens=None):
         self.key: List[int] = key
         self.start: int = start
         self.children: Dict[int, "_Node"] = {}
         self.parent: Optional["_Node"] = parent
         self.pages: List[List[int]] = pages  # per layer
+        self.gens = gens  # per layer or None (sanitizer off)
         self.last_use: int = 0
         self.pin: int = 0
 
@@ -114,6 +119,37 @@ class RadixPrefixCache:
     def _tick(self) -> int:
         self._clock += 1
         return self._clock
+
+    def _note(self, op, **fields):
+        """Breadcrumb into each pool's sanitizer journal (no-op when
+        the sanitizer is off)."""
+        for c in self.caches:
+            fn = getattr(c, "sanitizer_note", None)
+            if fn is not None:
+                fn(op, **fields)
+
+    def _capture_gens(self, pages):
+        """Per-layer page generations for a freshly referenced chain
+        (None when the sanitizer is off)."""
+        gens = []
+        any_on = False
+        for cache, chain in zip(self.caches, pages):
+            fn = getattr(cache, "sanitizer_page_gens", None)
+            g = fn(chain) if fn is not None else None
+            any_on = any_on or g is not None
+            gens.append(g)
+        return gens if any_on else None
+
+    def _check_node(self, node):
+        """Validate a walked node's generation-tagged chains against
+        each pool's shadow heap (match-time use-after-free check)."""
+        if node.gens is None:
+            return
+        for cache, chain, g in zip(self.caches, node.pages,
+                                   node.gens):
+            fn = getattr(cache, "sanitizer_check_chain", None)
+            if fn is not None and g is not None:
+                fn(chain, g, what="prefix-match")
 
     def _node_page_span(self, start, end):
         """Page indices [lo, hi) overlapping token span [start, end)."""
@@ -173,6 +209,7 @@ class RadixPrefixCache:
             j = self._common_len(child.key, tokens[matched:n])
             if j == 0:
                 break
+            self._check_node(child)
             self._overlay(chains, child, child.start + j)
             child.last_use = stamp
             path.append(child)
@@ -202,12 +239,16 @@ class RadixPrefixCache:
         the lifetime of the request that attached the chains)."""
         for node in path:
             node.pin += 1
+        if path:
+            self._note("pin", nodes=len(path))
 
     def unpin(self, path):
         for node in path:
             if node.pin <= 0:
                 raise AssertionError("unpin of an unpinned node")
             node.pin -= 1
+        if path:
+            self._note("unpin", nodes=len(path))
 
     # -- insert ------------------------------------------------------------
     def insert(self, tokens: Sequence[int],
@@ -258,13 +299,18 @@ class RadixPrefixCache:
         pages = [list(chain[lo:hi]) for chain in chains]
         for cache, chain in zip(self.caches, pages):
             cache.incref(chain)
+        # generation capture AFTER incref: from here the pages cannot
+        # be recycled while this node exists, so a generation change
+        # seen by a later match proves a reference was lost
         leaf = _Node(key=tokens[pos:n], start=pos, pages=pages,
-                     parent=parent)
+                     parent=parent, gens=self._capture_gens(pages))
         leaf.last_use = stamp
         parent.children[tokens[pos]] = leaf
         self.mutations += 1
         self.stats["inserted_tokens"] += n - pos
         self.stats["inserted_nodes"] += 1
+        self._note("prefix-insert", tokens=n - pos,
+                   pages=sum(len(p) for p in pages))
 
     def _split(self, node, j):
         """Split ``node`` after j key tokens; returns the new upper
@@ -280,8 +326,18 @@ class RadixPrefixCache:
         if up_hi > low_lo:  # mid-page split: boundary page shared
             for cache, p in zip(self.caches, node.pages):
                 cache.incref([p[low_lo - lo]])
+        # generation tags split with the pages (the shared boundary
+        # page keeps the same generation in both halves)
+        upper_gens = lower_gens = None
+        if node.gens is not None:
+            upper_gens = [None if g is None else g[up_lo - lo:up_hi - lo]
+                          for g in node.gens]
+            lower_gens = [None if g is None
+                          else g[low_lo - lo:low_hi - lo]
+                          for g in node.gens]
         upper = _Node(key=node.key[:j], start=node.start,
-                      pages=upper_pages, parent=node.parent)
+                      pages=upper_pages, parent=node.parent,
+                      gens=upper_gens)
         upper.last_use = node.last_use
         # pins stay on the LOWER half (the object match paths hold):
         # eviction is leaf-only, so the pinned child protects the new
@@ -290,6 +346,7 @@ class RadixPrefixCache:
         node.key = node.key[j:]
         node.start = cut
         node.pages = lower_pages
+        node.gens = lower_gens
         node.parent = upper
         upper.children[node.key[0]] = node
         return upper
@@ -338,6 +395,7 @@ class RadixPrefixCache:
         self.mutations += 1
         self.stats["evicted_nodes"] += 1
         self.stats["evicted_pages"] += freed
+        self._note("evict", tokens=len(leaf.key), pages_freed=freed)
         return freed
 
     def clear(self) -> int:

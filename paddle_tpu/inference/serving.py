@@ -33,6 +33,15 @@ need (prompt + max_new_tokens, across every layer's cache). This is
 what keeps a burst of long prompts from deadlocking the pool mid-
 generation.
 
+Page sanitizer (``FLAGS_page_sanitizer=warn|strict``): every pool the
+model serves from mirrors its mutations into a shadow heap
+(incubate/nn/page_sanitizer.py), and the scheduler runs an epoch
+cross-check every ``FLAGS_page_sanitizer_stride`` steps — shadow vs.
+real refcounts/free-list/lens plus, in strict mode,
+``assert_ref_invariants()`` on every cache. ``page_pool_stats()``
+reports the event/violation counters under ``"sanitizer"``. Off (the
+default) costs one attribute check per stride.
+
 Prefix caching (``prefix_cache=True``): a radix tree over token ids
 (inference/prefix_cache.py) remembers retired sequences' KV pages. On
 admission the prompt is matched against the tree, the matched page
@@ -224,6 +233,11 @@ class BatchScheduler:
                 "speculative decoding")
         self.spec_stats = {"rounds": 0, "target_calls": 0,
                            "draft_calls": 0, "committed_tokens": 0}
+        # page-sanitizer epoch cross-check (page_sanitizer.py): every
+        # stride steps, shadow-vs-real on every cache; strict-mode
+        # pools also run assert_ref_invariants there
+        self._san_stride = max(1, int(flag("page_sanitizer_stride")))
+        self._san_steps = 0
 
     # -- pool accounting ---------------------------------------------------
     def _pool(self, model=None):
@@ -276,7 +290,36 @@ class BatchScheduler:
             # mean different things — keep them in separate blocks
             stats["prefix_cache"] = dict(self.prefix_stats)
             stats["prefix_cache"]["tree"] = self.prefix_cache.summary()
+        all_caches = caches + (list(self.draft.caches)
+                               if self.draft is not None else [])
+        san = [s for s in (getattr(c, "sanitizer_stats", None)
+                           for c in all_caches) if s]
+        if san:
+            stats["sanitizer"] = {
+                "mode": san[0]["mode"],
+                "events": sum(s["events"] for s in san),
+                "violations": sum(s["violations"] for s in san),
+                "crosschecks": sum(
+                    s["by_op"].get("crosscheck", 0) for s in san),
+            }
         return stats
+
+    def _sanitizer_epoch(self):
+        """Every FLAGS_page_sanitizer_stride steps: cross-check each
+        cache's shadow heap against the real pool (and, on strict
+        pools, run assert_ref_invariants) — the epoch half of the
+        page sanitizer. A single counter bump when the sanitizer is
+        off."""
+        self._san_steps += 1
+        if self._san_steps % self._san_stride:
+            return
+        models = [self.model] + (
+            [self.draft] if self.draft is not None else [])
+        for m in models:
+            for c in m.caches:
+                chk = getattr(c, "sanitizer_crosscheck", None)
+                if chk is not None:
+                    chk()
 
     # -- request lifecycle -------------------------------------------------
     def submit(self, req: Request) -> str:
@@ -474,6 +517,7 @@ class BatchScheduler:
         (admitted/advanced/finished plus the prefill/decode token
         split and, under chunked prefill, chunk_utilization and the
         adapter's ragged-dispatch compile count)."""
+        self._sanitizer_epoch()
         n_before = len(self._active)
         hit_tokens = self._try_admit()
         admitted = len(self._active) - n_before

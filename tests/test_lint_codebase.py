@@ -340,3 +340,120 @@ class TestCollectiveMatmulDiscipline:
         for p in covered:
             assert os.path.exists(p), p
         assert lint_codebase.check_tp_routing() == []
+
+
+class TestPoolMutationAudit:
+    """ISSUE-6 static half: PagedKVCacheManager state writes and
+    pool-private method calls outside the pool module are lint
+    errors — the guarantee that the page sanitizer's instrumented
+    entry points are the ONLY mutation paths."""
+
+    def test_seeded_state_writes_flagged(self):
+        bad = (
+            "def evict(cache, p):\n"
+            "    cache._refcnt[p] = 0\n"
+            "    cache._free.append(p)\n"
+            "    cache.k_pages = cache.k_pages.at[p].set(0)\n"
+            "    cache._lens['s'] += 1\n"
+        )
+        v = lint_codebase.lint_pool_state_file("fake/srv.py", text=bad)
+        joined = "\n".join(v)
+        assert "_refcnt" in joined
+        assert "_free.append" in joined
+        assert ".k_pages" in joined and ".at[...]" in joined
+        assert "_lens" in joined
+        assert len(v) >= 4, v
+
+    def test_container_mutations_flagged(self):
+        bad = (
+            "def steal(cache):\n"
+            "    return cache._free.pop()\n"
+        )
+        v = lint_codebase.lint_pool_state_file("fake/s.py", text=bad)
+        assert len(v) == 1 and "_free.pop" in v[0]
+
+    def test_tree_node_pages_not_flagged(self):
+        # the radix tree's OWN node.pages lists are tree state
+        ok = (
+            "def split(node, lower_pages):\n"
+            "    node.pages = lower_pages\n"
+            "    node.pages.append([1, 2])\n"
+        )
+        assert lint_codebase.lint_pool_state_file(
+            "fake/tree.py", text=ok) == []
+
+    def test_reads_allowed_in_state_rule(self):
+        ok = (
+            "def stats(cache):\n"
+            "    return len(cache.k_pages), cache.k_scales.sum()\n"
+        )
+        assert lint_codebase.lint_pool_state_file(
+            "fake/r.py", text=ok) == []
+
+    def test_state_write_waiver_suppresses(self):
+        text = (
+            "def f(cache):\n"
+            "    cache._refcnt[0] = 1  # trace-lint: ok(test waiver)\n"
+        )
+        assert lint_codebase.lint_pool_state_file(
+            "fake/w.py", text=text) == []
+
+    def test_seeded_private_calls_flagged(self):
+        bad = (
+            "def fast_path(cache, sid):\n"
+            "    page, off = cache._next_slot(sid)\n"
+            "    cache._release_page(page)\n"
+            "    return cache._padded_kernel_inputs([sid], 1, None)\n"
+        )
+        v = lint_codebase.lint_pool_api_file("fake/api.py", text=bad)
+        joined = "\n".join(v)
+        assert "_next_slot" in joined
+        assert "_release_page" in joined
+        assert "_padded_kernel_inputs" in joined
+        assert len(v) == 3, v
+
+    def test_bookkeeping_reads_flagged_in_api_files(self):
+        bad = (
+            "def peek(cache):\n"
+            "    return cache._refcnt[0], len(cache._tables)\n"
+        )
+        v = lint_codebase.lint_pool_api_file("fake/p.py", text=bad)
+        assert len(v) == 2, v
+
+    def test_public_api_clean(self):
+        ok = (
+            "def step(cache, sid, k, v):\n"
+            "    cache.append_batch([sid], k, v)\n"
+            "    cache.attend(k, [sid])\n"
+            "    n = cache.num_free_pages\n"
+            "    return cache.seq_pages(sid), n\n"
+        )
+        assert lint_codebase.lint_pool_api_file(
+            "fake/ok.py", text=ok) == []
+
+    def test_private_call_waiver_suppresses(self):
+        text = (
+            "def f(cache, s):\n"
+            "    return cache._next_slot(s)"
+            "  # trace-lint: ok(test waiver)\n"
+        )
+        assert lint_codebase.lint_pool_api_file(
+            "fake/w2.py", text=text) == []
+
+    def test_audit_covers_serving_stack_and_is_clean(self):
+        for f in lint_codebase.POOL_API_FILES:
+            assert os.path.exists(os.path.join(REPO, f)), f
+        names = "\n".join(lint_codebase.POOL_API_FILES)
+        assert "serving.py" in names
+        assert "prefix_cache.py" in names
+        assert "paged_llama.py" in names
+        # the pool module itself is exempt (it IS the audited API)
+        assert any("paged_cache.py" in f
+                   for f in lint_codebase.POOL_MUTATION_EXEMPT)
+        assert lint_codebase.check_pool_mutation_audit() == []
+
+    def test_rule_inventory_has_pool_rules(self):
+        ids = [r for r, _ in lint_codebase.RULES]
+        assert "pool-mutation-audit" in ids
+        assert "pool-private-api" in ids
+        assert len(ids) == len(set(ids))

@@ -315,6 +315,64 @@ class TestRefcountFuzz:
 
 
 # ---------------------------------------------------------------------------
+# sanitizer fuzz (ISSUE 6): the PR-2-era fuzz rebased onto the page
+# sanitizer's strict-mode entry point — real device writes, so int8
+# scale sidecars and append_ragged mid-page COW resumes are exercised
+# ---------------------------------------------------------------------------
+
+
+class TestSanitizerFuzz:
+    def test_strict_fuzzer_clean_fast_slice(self):
+        # runs twice via the module fixture (float32 + int8 pages);
+        # the int8 arm keeps the step count small — every quantized
+        # append syncs on the scale-growth check
+        from paddle_tpu.incubate.nn.page_sanitizer import fuzz_pool
+
+        steps = 60 if KV_DTYPE == "float32" else 24
+        stats = fuzz_pool(seed=7, steps=steps, kv_dtype=KV_DTYPE,
+                          prefix_cache=True)
+        assert stats["violations"] == 0
+        # the hazards the shadow heap must track stayed silent while
+        # actually being exercised: ragged mid-prompt appends, COW
+        # forks after shared-tail attaches, tree-held generation
+        # checks, epoch cross-checks
+        assert stats["by_op"].get("append_ragged", 0) > 0
+        assert stats["by_op"].get("attach", 0) > 0
+        assert stats["by_op"].get("fork", 0) > 0
+        assert stats["by_op"].get("chain-check", 0) > 0
+        assert stats["by_op"].get("crosscheck", 0) > 0
+
+    @pytest.mark.slow
+    def test_strict_fuzzer_full_matrix(self):
+        # kv_dtype (module fixture) x prefix-cache on/off x seeds
+        from paddle_tpu.incubate.nn.page_sanitizer import fuzz_pool
+
+        steps = 300 if KV_DTYPE == "float32" else 90
+        for prefix in (True, False):
+            for seed in (0, 1, 2):
+                stats = fuzz_pool(seed=seed, steps=steps,
+                                  kv_dtype=KV_DTYPE,
+                                  prefix_cache=prefix)
+                assert stats["violations"] == 0, (seed, prefix)
+                assert stats["free_pages"] == 48  # fully drained
+
+    @pytest.mark.slow
+    def test_strict_fuzzer_catches_injections_both_dtypes(self):
+        # the teeth, on THIS module's dtype matrix: a skipped incref
+        # and a dropped fork must be caught with quantized pages too
+        from paddle_tpu.incubate.nn.page_sanitizer import (
+            PageSanitizerError,
+            fuzz_pool,
+        )
+
+        for inject in ("use-after-free", "cow-write-shared"):
+            with pytest.raises(PageSanitizerError) as ei:
+                fuzz_pool(seed=3, steps=250, kv_dtype=KV_DTYPE,
+                          inject=inject)
+            assert ei.value.rule == inject
+
+
+# ---------------------------------------------------------------------------
 # end-to-end: cached prefill bitwise-identical to the uncached path
 # ---------------------------------------------------------------------------
 

@@ -36,7 +36,22 @@ Checks:
    function in the scheduler module that calls ``*.prefill_chunk(...)``
    must also call the sanctioned pad-to-bucket helper
    (``bucket_packed_tokens``) in the same scope.
-6. collective-matmul discipline: ops/kernels/collective_matmul.py is
+6. pool-mutation audit (the static half of the KV page-pool
+   sanitizer, incubate/nn/page_sanitizer.py): the paged pool's state
+   — page payloads (``k_pages``/``v_pages``), quantization sidecars
+   (``k_scales``/``v_scales``), and refcount bookkeeping
+   (``_refcnt``/``_free``/``_tables``/``_lens``/``_ext_refs``) — may
+   be written ONLY inside PagedKVCacheManager methods
+   (paged_cache.py). Any other inference/incubate module assigning,
+   aug-assigning, or ``.at[...]``-updating them bypasses the
+   sanitizer's event instrumentation; and the serving consumers
+   (inference/serving.py, prefix_cache.py, paged_llama.py) must stay
+   on the public audited pool API — calling a pool-private underscore
+   method (``_next_slot``/``_release_page``/``_fork_page``/...) or
+   touching the private bookkeeping attrs from there is an error.
+   Together these guarantee the dynamic sanitizer's event coverage
+   statically: there is no un-instrumented mutation path.
+7. collective-matmul discipline: ops/kernels/collective_matmul.py is
    jax-only (every body runs inside jit traces under shard_map) — no
    host-side module imports (os/sys/time/numpy/threading/...); and the
    TP/SP layer modules (mpu/mp_layers.py, mpu/mp_ops.py,
@@ -301,6 +316,201 @@ def check_quant_sidecar_writes(root=REPO):
             if fn.endswith(".py"):
                 out.extend(
                     lint_quant_sidecar_file(os.path.join(full, fn)))
+    return out
+
+
+# pool-mutation audit (static half of the page sanitizer): pool state
+# writable ONLY inside PagedKVCacheManager (incubate/nn/paged_cache.py)
+POOL_MUTATION_DIRS = (
+    os.path.join("paddle_tpu", "inference"),
+    os.path.join("paddle_tpu", "incubate", "nn"),
+)
+POOL_MUTATION_EXEMPT = (
+    os.path.join("paddle_tpu", "incubate", "nn", "paged_cache.py"),
+)
+
+# every attr here is PagedKVCacheManager-private mutable state; the
+# tree's own `node.pages` lists are tree state and deliberately NOT in
+# this set (the pool's page payloads are k_pages/v_pages)
+_POOL_STATE_ATTRS = (
+    "k_pages", "v_pages", "k_scales", "v_scales",
+    "_refcnt", "_free", "_tables", "_lens", "_ext_refs",
+)
+# the refcount-bookkeeping subset: reading these from serving code is
+# also an API bypass (the pool exposes num_free_pages/seq_pages/...)
+_POOL_BOOKKEEPING_ATTRS = (
+    "_refcnt", "_free", "_tables", "_lens", "_ext_refs",
+)
+
+# serving modules restricted to the PUBLIC audited pool API
+POOL_API_FILES = (
+    os.path.join("paddle_tpu", "inference", "serving.py"),
+    os.path.join("paddle_tpu", "inference", "prefix_cache.py"),
+    os.path.join("paddle_tpu", "inference", "paged_llama.py"),
+)
+
+# pool-private methods a serving module must never call (each is an
+# un-instrumented mutation or kernel-input path the sanitizer's event
+# coverage depends on)
+_POOL_PRIVATE_METHODS = (
+    "_next_slot", "_release_page", "_alloc_page", "_fork_page",
+    "_copy_page", "_quant_write", "_padded_kernel_inputs",
+    "_ref_pages", "_drop_refs", "_needs_fork",
+)
+
+
+class _PoolStateWriteVisitor(ast.NodeVisitor):
+    """Flags writes to PagedKVCacheManager state from outside the pool
+    module: attribute assignment (x.k_pages = ..., x._refcnt[p] = ...,
+    x._free += ...) and functional updates (x.k_pages.at[...])."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+
+    def _flag(self, lineno, what):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        if _WAIVER_MARK not in line:
+            self.violations.append(
+                "%s:%d: %s — PagedKVCacheManager state is pool-"
+                "private (mutate only through the audited API in "
+                "incubate/nn/paged_cache.py, whose methods the page "
+                "sanitizer instruments); fix it or waive with "
+                "'%s(<reason>)'"
+                % (self.relpath, lineno, what, _WAIVER_MARK))
+
+    def _pool_target(self, node):
+        # x.k_pages, x.k_pages[i], x._free[0] ... any write whose
+        # innermost attribute is a pool state attr
+        while isinstance(node, (ast.Subscript, ast.Starred)):
+            node = node.value
+        return (isinstance(node, ast.Attribute)
+                and node.attr in _POOL_STATE_ATTRS)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            for sub in ast.walk(t):
+                if self._pool_target(sub):
+                    self._flag(node.lineno,
+                               "assignment to .%s"
+                               % self._attr_name(sub))
+                    break
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if self._pool_target(node.target):
+            self._flag(node.lineno,
+                       "augmented assignment to .%s"
+                       % self._attr_name(node.target))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        # x.k_pages.at[...] — the jnp functional-update idiom
+        if node.attr == "at" and isinstance(node.value, ast.Attribute) \
+                and node.value.attr in _POOL_STATE_ATTRS:
+            self._flag(node.lineno,
+                       ".%s.at[...] update" % node.value.attr)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        # x._free.pop() / x._tables.update(...) — container mutation
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in (
+                "append", "pop", "extend", "insert", "remove",
+                "clear", "update", "setdefault", "popitem") \
+                and self._pool_target(fn.value):
+            self._flag(node.lineno,
+                       ".%s.%s(...) mutation"
+                       % (self._attr_name(fn.value), fn.attr))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _attr_name(node):
+        while isinstance(node, (ast.Subscript, ast.Starred)):
+            node = node.value
+        return node.attr if isinstance(node, ast.Attribute) else "?"
+
+
+class _PoolPrivateAPIVisitor(ast.NodeVisitor):
+    """Flags serving modules stepping off the public pool API: calls
+    into pool-private underscore methods and any access to the
+    refcount-bookkeeping attrs."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+
+    def _flag(self, lineno, what):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        if _WAIVER_MARK not in line:
+            self.violations.append(
+                "%s:%d: %s — serving modules may only use the PUBLIC "
+                "audited PagedKVCacheManager API (the page sanitizer "
+                "instruments exactly those entry points); fix it or "
+                "waive with '%s(<reason>)'"
+                % (self.relpath, lineno, what, _WAIVER_MARK))
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) \
+                and fn.attr in _POOL_PRIVATE_METHODS:
+            self._flag(node.lineno,
+                       "call into pool-private .%s()" % fn.attr)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if node.attr in _POOL_BOOKKEEPING_ATTRS:
+            self._flag(node.lineno,
+                       "access to pool-private .%s" % node.attr)
+        self.generic_visit(node)
+
+
+def lint_pool_state_file(path, text=None):
+    """Pool-state write audit for one file; returns violations."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _PoolStateWriteVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def lint_pool_api_file(path, text=None):
+    """Public-pool-API audit for one serving file; returns
+    violations."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _PoolPrivateAPIVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_pool_mutation_audit(root=REPO):
+    out = []
+    exempt = {os.path.join(root, f) for f in POOL_MUTATION_EXEMPT}
+    for d in POOL_MUTATION_DIRS:
+        full = os.path.join(root, d)
+        for fn in sorted(os.listdir(full)):
+            path = os.path.join(full, fn)
+            if fn.endswith(".py") and path not in exempt:
+                out.extend(lint_pool_state_file(path))
+    for f in POOL_API_FILES:
+        out.extend(lint_pool_api_file(os.path.join(root, f)))
     return out
 
 
@@ -657,10 +867,52 @@ def check_op_table():
     return out
 
 
+# rule inventory: (rule id, one-line summary) for every AST check in
+# this linter — merged into `python -m paddle_tpu.framework.analysis
+# --rules` alongside the jaxpr rules and the page-sanitizer violation
+# classes, so one CLI lists every static check in the repo
+RULES = (
+    ("traced-path-hygiene",
+     "no host syncs (device_get / np.asarray / time.time) in modules "
+     "whose code runs inside jit traces"),
+    ("op-table-coverage",
+     "public op-namespace callables must resolve in the op_table "
+     "registry; no raw jax callables leaking through"),
+    ("host-only-hygiene",
+     "declared host-only modules (prefix_cache.py) must not touch "
+     "jax/jnp at all"),
+    ("inference-surface-leak",
+     "no raw jax callable through the public paddle_tpu.inference "
+     "namespace"),
+    ("quant-sidecar-ownership",
+     "serving code must never write the int8 KV scale sidecars "
+     "(k_scales/v_scales are pool-private calibration state)"),
+    ("pool-mutation-audit",
+     "PagedKVCacheManager state (k_pages/v_pages/k_scales/v_scales/"
+     "_refcnt/_free/_tables/_lens/_ext_refs) is writable only inside "
+     "the pool module — everything else goes through the sanitizer-"
+     "instrumented public API"),
+    ("pool-private-api",
+     "serving.py/prefix_cache.py/paged_llama.py may only call the "
+     "public audited pool API — no pool-private underscore methods "
+     "or bookkeeping attrs"),
+    ("serving-bucket-discipline",
+     "every prefill_chunk feed must be padded via "
+     "bucket_packed_tokens (bounded XLA compile count)"),
+    ("jax-only-kernel-imports",
+     "collective-matmul kernel module must not import host-side "
+     "modules"),
+    ("tp-collective-routing",
+     "no hand-rolled raw collective + matmul pair in the TP/SP layer "
+     "modules — route through collective_matmul_dispatch"),
+)
+
+
 def run_lint(root=REPO, with_op_table=True):
     out = check_traced_paths(root)
     out.extend(check_host_only(root))
     out.extend(check_quant_sidecar_writes(root))
+    out.extend(check_pool_mutation_audit(root))
     out.extend(check_serving_buckets(root))
     out.extend(check_jax_only(root))
     out.extend(check_tp_routing(root))
