@@ -761,6 +761,23 @@ _SERVING_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_SERVING_LAST.json")
 
 
+def _merge_serving_rec(key, rec):
+    """Merge one arm's record into BENCH_SERVING_LAST.json under
+    ``key`` (read-modify-write; a missing or corrupt artifact starts
+    fresh) — the one place the artifact protocol lives."""
+    data = {}
+    if os.path.exists(_SERVING_FILE):
+        try:
+            with open(_SERVING_FILE) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            data = {}
+    data[key] = rec
+    data["git_rev"] = _git_rev()
+    _atomic_json_dump(_SERVING_FILE, data)
+    return rec
+
+
 def bench_prefix_serving(users=8, turns=3, system_len=48, msg_len=8,
                          new_tokens=8):
     """Synthetic shared-prefix workload (ISSUE 2): N users x M turns
@@ -984,17 +1001,7 @@ def bench_chunked_prefill(users=8, prompt_len=96, new_tokens=8,
         "num_buckets": n_buckets,
         "budgets": arms,
     }
-    data = {}
-    if os.path.exists(_SERVING_FILE):
-        try:
-            with open(_SERVING_FILE) as f:
-                data = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            data = {}
-    data["chunked_prefill"] = rec
-    data["git_rev"] = _git_rev()
-    _atomic_json_dump(_SERVING_FILE, data)
-    return rec
+    return _merge_serving_rec("chunked_prefill", rec)
 
 
 # aux: page-sanitizer overhead — strict shadow-heap checking vs off
@@ -1128,17 +1135,229 @@ def bench_sanitizer_serving(users=4, prompt_len=48, new_tokens=8,
         "off_sanitizer_alloc_blocks": int(traced["new_blocks"] or 0),
         "off_zero_alloc": (traced["new_blocks"] or 0) == 0,
     }
-    data = {}
-    if os.path.exists(_SERVING_FILE):
-        try:
-            with open(_SERVING_FILE) as f:
-                data = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            data = {}
-    data["sanitizer"] = rec
-    data["git_rev"] = _git_rev()
-    _atomic_json_dump(_SERVING_FILE, data)
-    return rec
+    return _merge_serving_rec("sanitizer", rec)
+
+
+# aux: runtime-telemetry overhead — trace spans + metrics vs off
+# ---------------------------------------------------------------------------
+
+
+def bench_telemetry_serving(users=4, prompt_len=48, new_tokens=8,
+                            budget=32):
+    """Telemetry arm (ISSUE 7): the chunked-prefill workload re-run
+    with FLAGS_telemetry=trace — serving.step/admit/prefill_chunk/
+    decode/retire spans into the ring, TTFT/TPOT/queue-wait/retire
+    histograms into the registry — and the per-step overhead (% step
+    p50 delta vs off) recorded into BENCH_SERVING_LAST.json under
+    "telemetry" together with the registry snapshot (the TTFT/TPOT
+    p50/p99 + queue-wait columns now come from the registry, not
+    ad-hoc timing). Off mode is gated at EXACTLY zero allocations
+    attributed to framework/telemetry.py (the 'off allocates nothing'
+    contract, same tracemalloc gate as the page sanitizer), greedy
+    outputs must be identical in both modes, and the exported trace
+    must load back as valid Chrome trace JSON with the four step
+    spans present and non-empty TTFT/TPOT histograms."""
+    import tracemalloc
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import telemetry
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.inference import (
+        BatchScheduler,
+        PagedLlamaAdapter,
+        Request,
+    )
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    kind = _device_kind()
+    cpu = kind.startswith("cpu")
+    page_size = 4
+    if cpu:
+        # new_tokens sets the number of paired decode steps each
+        # run contributes to the overhead estimate — the true per-
+        # step telemetry cost is ~50us against ~400ms steps, so the
+        # estimator lives entirely on sample count
+        users, prompt_len, new_tokens = 4, 32, 14
+        cfg = llama_tiny(num_hidden_layers=2,
+                         max_position_embeddings=256)
+    else:
+        cfg = llama_tiny(
+            hidden_size=512, intermediate_size=1024,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=2048,
+        )
+        page_size = 16
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(users)]
+    pages_per_seq = -(-(prompt_len + new_tokens) // page_size)
+    num_pages = 2 * users * pages_per_seq + 16
+
+    def _mk_sched(mode):
+        telemetry.reset()
+        set_flags({"telemetry": mode})
+        adapter = PagedLlamaAdapter(
+            model, num_pages=num_pages, page_size=page_size,
+            max_length=cfg.max_position_embeddings)
+        sched = BatchScheduler(adapter, max_batch_size=users,
+                               chunked_prefill=True,
+                               prefill_chunk_tokens=budget)
+        for i, p in enumerate(prompts):
+            sched.submit(Request(f"r{i}", list(p),
+                                 max_new_tokens=new_tokens))
+        return sched
+
+    def run(mode, trace_alloc=False):
+        """Un-timed single run: the warmup pass and the off-mode
+        zero-alloc probe (timing lives in run_pair)."""
+        sched = _mk_sched(mode)
+        snap0 = None
+        if trace_alloc:
+            tracemalloc.start()
+            snap0 = tracemalloc.take_snapshot()
+        while sched.num_active or sched.num_queued:
+            sched.step()
+        new_blocks = None
+        if trace_alloc:
+            snap1 = tracemalloc.take_snapshot()
+            tracemalloc.stop()
+            filt = [tracemalloc.Filter(True, telemetry.__file__)]
+            diff = snap1.filter_traces(filt).compare_to(
+                snap0.filter_traces(filt), "filename")
+            new_blocks = sum(max(d.count_diff, 0) for d in diff)
+        gen = {f"r{i}": sched.result(f"r{i}").generated_ids
+               for i in range(users)}
+        return {"gen": gen, "new_blocks": new_blocks}
+
+    def _hist_cols(metrics, name):
+        h = metrics.get("serving", {}).get(name) or {}
+        return {
+            "count": int(h.get("count") or 0),
+            "p50_ms": round(1e3 * h["p50"], 3)
+            if h.get("p50") is not None else None,
+            "p99_ms": round(1e3 * h["p99"], 3)
+            if h.get("p99") is not None else None,
+        }
+
+    def run_pair():
+        """One interleaved off/trace measurement: two schedulers over
+        the SAME weights execute the identical deterministic step
+        schedule with their steps alternated in time, so machine-state
+        drift (GC, noisy CPU neighbors — 2x per-run swings observed on
+        the bench box) hits both sides of each comparison step about
+        equally. Per-run medians cannot resolve a microsecond-scale
+        per-step cost against ~ms steps under that noise; per-step
+        interleaving can."""
+        sched_off = _mk_sched("off")
+        sched_tr = _mk_sched("trace")
+        tr = telemetry.tracer()  # capture before the flag flips back
+        set_flags({"telemetry": "off"})
+        w_off, w_tr = [], []
+        flip = False
+        while (sched_off.num_active or sched_off.num_queued
+               or sched_tr.num_active or sched_tr.num_queued):
+            # alternate who steps first: the second runner of an
+            # iteration sees warm caches, a systematic edge that
+            # would otherwise masquerade as (negative) overhead
+            order = [(sched_off, w_off), (sched_tr, w_tr)]
+            if flip:
+                order.reverse()
+            flip = not flip
+            for sched, walls in order:
+                if sched.num_active or sched.num_queued:
+                    ts = time.perf_counter()
+                    sched.step()
+                    walls.append(time.perf_counter() - ts)
+        gen_off = {f"r{i}": sched_off.result(f"r{i}").generated_ids
+                   for i in range(users)}
+        gen_tr = {f"r{i}": sched_tr.result(f"r{i}").generated_ids
+                  for i in range(users)}
+        assert gen_off == gen_tr, \
+            "telemetry mode changed the greedy outputs"
+        out = {
+            "w_off": w_off,
+            "w_tr": w_tr,
+            "metrics": sched_tr.metrics(),
+            "gen": gen_tr,
+        }
+        # per-STEP paired ratios: step j of both schedulers does the
+        # identical work within ~a second of wall time, the finest
+        # pairing available — run-level medians still swing several %
+        # under this box's CPU-throughput fluctuation, per-step pairs
+        # (order alternating) do not
+        assert len(w_off) == len(w_tr), (len(w_off), len(w_tr))
+        out["ratios"] = [(t - o) / max(o, 1e-9)
+                         for o, t in zip(w_off, w_tr)]
+        out["pct"] = 100.0 * float(np.median(out["ratios"]))
+        # the export must survive a JSON round trip and carry the
+        # four step-phase spans
+        chrome = json.loads(json.dumps(tr.to_chrome()))
+        out["chrome_events"] = len(chrome.get("traceEvents", []))
+        out["span_names"] = sorted(
+            {e["name"] for e in chrome.get("traceEvents", [])})
+        return out
+
+    try:
+        run("off")                 # warmup: compiles out of timing
+        pairs = [run_pair() for _ in range(5)][1:]  # [0] re-warms
+        alloc_probe = run("off", trace_alloc=True)
+    finally:
+        set_flags({"telemetry": "off"})
+        telemetry.reset()
+    pair_pct = [p["pct"] for p in pairs]
+    # the reported overhead and both headline p50 columns come from
+    # the SAME pooled population — every paired step of every pair
+    # (~70 samples) — so the columns agree with overhead_pct and the
+    # estimator's noise floor (~1%) sits well under the 2% gate for
+    # a true per-step cost of ~50us against ~ms steps; the per-pair
+    # medians ride along for transparency
+    pooled = [r for p in pairs for r in p["ratios"]]
+    pooled_off = [w for p in pairs for w in p["w_off"]]
+    pooled_tr = [w for p in pairs for w in p["w_tr"]]
+    med = pairs[-1]  # snapshot/spans: any pair records the same set
+    assert alloc_probe["gen"] == med["gen"], \
+        "telemetry mode changed the greedy outputs"
+    m = med["metrics"]
+    span_names = med.get("span_names", [])
+    rec = {
+        "config": "serving_telemetry",
+        "mode": "tpu-single-chip" if not cpu else "cpu",
+        "users": users,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "budget": budget,
+        "greedy_identical": True,  # asserted above
+        "off_step_p50_ms": round(
+            1e3 * float(np.median(pooled_off)), 3),
+        "trace_step_p50_ms": round(
+            1e3 * float(np.median(pooled_tr)), 3),
+        "overhead_pct": round(100.0 * float(np.median(pooled)), 1),
+        "overhead_pct_pairs": [round(p, 1) for p in pair_pct],
+        "paired_steps": len(pooled),
+        # the latency columns, sourced from the registry snapshot
+        # (not ad-hoc timing): TTFT/TPOT/queue-wait p50/p99
+        "ttft": _hist_cols(m, "ttft_s"),
+        "tpot": _hist_cols(m, "tpot_s"),
+        "queue_wait": _hist_cols(m, "queue_wait_s"),
+        "chrome_events": med.get("chrome_events", 0),
+        "chrome_valid": med.get("chrome_events", 0) > 0,
+        "step_spans_present": all(
+            any(want in name for name in span_names)
+            for want in ("serving.admit", "serving.prefill_chunk",
+                         "serving.decode", "serving.retire")),
+        "span_names": span_names,
+        # the off-mode zero-cost gate: tracemalloc saw NO allocation
+        # attributed to framework/telemetry.py across the loop
+        "off_telemetry_alloc_blocks": int(
+            alloc_probe["new_blocks"] or 0),
+        "off_zero_alloc": (alloc_probe["new_blocks"] or 0) == 0,
+        # the full unified snapshot (BatchScheduler.metrics()) rides
+        # the artifact for offline inspection
+        "metrics": m,
+    }
+    return _merge_serving_rec("telemetry", rec)
 
 
 # aux: quantized serving — int8 weights + int8 KV pages vs fp baseline
@@ -1267,17 +1486,7 @@ def bench_quant_serving(n_requests=8, prompt_len=24, new_tokens=16):
         "quant_layers": ad_q.quant_report["layers"],
     }
     # merge next to the prefix-cache record rather than clobbering it
-    data = {}
-    if os.path.exists(_SERVING_FILE):
-        try:
-            with open(_SERVING_FILE) as f:
-                data = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            data = {}
-    data["quantized"] = rec
-    data["git_rev"] = _git_rev()
-    _atomic_json_dump(_SERVING_FILE, data)
-    return rec
+    return _merge_serving_rec("quantized", rec)
 
 
 # ---------------------------------------------------------------------------
@@ -1744,7 +1953,9 @@ def main() -> int:
                     help="run only the serving workloads: shared-"
                          "prefix (radix prefix cache on vs off), "
                          "quantized, chunked-prefill budget sweep, "
-                         "and the page-sanitizer overhead arm; emits "
+                         "the page-sanitizer overhead arm, and the "
+                         "runtime-telemetry overhead arm (trace vs "
+                         "off + TTFT/TPOT columns); emits "
                          "BENCH_SERVING_LAST.json")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--seq", type=int, default=2048)
@@ -1769,6 +1980,7 @@ def main() -> int:
         qrec = _emit(bench_quant_serving())
         crec = _emit(bench_chunked_prefill())
         srec = _emit(bench_sanitizer_serving())
+        trec = _emit(bench_telemetry_serving())
         # the gate covers ALL arms: the prefix-cache contract, the
         # ISSUE-3 quantized acceptance (token-identical greedy decode,
         # >= 1.8x sequence capacity at equal HBM budget), and the
@@ -1788,11 +2000,23 @@ def main() -> int:
             bool(srec.get("greedy_identical")) and \
             srec.get("sanitizer_violations", 1) == 0 and \
             srec.get("sanitizer_events", 0) > 0
+        # ISSUE-7 telemetry acceptance: trace mode greedy-identical at
+        # <= 2% step-time overhead, off mode allocates NOTHING in
+        # telemetry.py, the export loads as valid Chrome JSON with
+        # the admit/prefill/decode/retire spans, and the TTFT/TPOT
+        # histograms are non-empty
+        tel_ok = bool(trec.get("greedy_identical")) and \
+            bool(trec.get("off_zero_alloc")) and \
+            bool(trec.get("chrome_valid")) and \
+            bool(trec.get("step_spans_present")) and \
+            trec.get("overhead_pct", 100.0) <= 2.0 and \
+            trec.get("ttft", {}).get("count", 0) > 0 and \
+            trec.get("tpot", {}).get("count", 0) > 0
         ok = bool(rec.get("greedy_identical")) and \
             rec.get("prefill_skip_frac", 0.0) >= 0.5 and \
             qrec.get("greedy_match_rate", 0.0) >= 1.0 and \
             qrec.get("seq_capacity_ratio", 0.0) >= 1.8 and \
-            chunk_ok and san_ok
+            chunk_ok and san_ok and tel_ok
         _emit({"metric": "serving_prefix_cache",
                "value": rec.get("prefill_skip_frac", 0.0),
                "unit": "prefill_skip_frac",
@@ -1814,6 +2038,19 @@ def main() -> int:
                "sanitizer_events": srec.get("sanitizer_events", 0),
                "sanitizer_off_zero_alloc":
                    bool(srec.get("off_zero_alloc")),
+               "telemetry_overhead_pct": trec.get("overhead_pct"),
+               "telemetry_ttft_p50_ms":
+                   trec.get("ttft", {}).get("p50_ms"),
+               "telemetry_ttft_p99_ms":
+                   trec.get("ttft", {}).get("p99_ms"),
+               "telemetry_tpot_p50_ms":
+                   trec.get("tpot", {}).get("p50_ms"),
+               "telemetry_queue_wait_p50_ms":
+                   trec.get("queue_wait", {}).get("p50_ms"),
+               "telemetry_off_zero_alloc":
+                   bool(trec.get("off_zero_alloc")),
+               "telemetry_chrome_valid":
+                   bool(trec.get("chrome_valid")),
                "artifact": os.path.basename(_SERVING_FILE),
                "git_rev": _git_rev()})
         return 0
@@ -1959,6 +2196,7 @@ def main() -> int:
         _single("serving_quantized", bench_quant_serving)
         _single("serving_chunked_prefill", bench_chunked_prefill)
         _single("serving_sanitizer", bench_sanitizer_serving)
+        _single("serving_telemetry", bench_telemetry_serving)
 
     with state_lock:
         if headline_expected:

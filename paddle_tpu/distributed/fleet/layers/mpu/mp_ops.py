@@ -201,19 +201,25 @@ def collective_matmul_dispatch(kind, x, w, bias=None, group=None,
     """
     from .....ops.kernels import collective_matmul as cm
 
-    if cm.decompose_mode() == "off" or kind not in _CM_KINDS:
+    if kind not in _CM_KINDS:
+        return None
+    if cm.decompose_mode() == "off":
+        cm.record_dispatch(kind, False, "off")
         return None
     ax, ws = _cm_axis(group, axis)
     if ax is None or ws <= 1:
+        cm.record_dispatch(kind, False, "degree")
         return None
     x, w = _as_tensor(x), _as_tensor(w)
     if x.ndim < 2 or w.ndim != 2:
+        cm.record_dispatch(kind, False, "shape")
         return None
     itemsize = jax.numpy.dtype(x._data.dtype).itemsize
     manual = in_manual_context((ax,))
     if not manual:
         m = global_mesh()
         if m is None or ax not in m.axis_names:
+            cm.record_dispatch(kind, False, "no_mesh")
             return None
         # jax<0.5 legacy shard_map cannot lower ring collectives in a
         # PARTIAL-manual region under an outer SPMD partition when any
@@ -227,6 +233,7 @@ def collective_matmul_dispatch(kind, x, w, bias=None, group=None,
 
             degrees = active_axis_info()["degrees"]
             if any(d > 1 for name, d in degrees.items() if name != ax):
+                cm.record_dispatch(kind, False, "legacy_multi_axis")
                 return None
 
     rows = _rows(x)
@@ -244,6 +251,7 @@ def collective_matmul_dispatch(kind, x, w, bias=None, group=None,
         sa = next((i for i in range(x.ndim - 1)
                    if x.shape[i] % ws == 0), None)
         if sa is None:
+            cm.record_dispatch(kind, False, "indivisible")
             return None
     else:
         sa = seq_axis
@@ -263,8 +271,11 @@ def collective_matmul_dispatch(kind, x, w, bias=None, group=None,
             "mm_ar": x.shape[-1] % ws == 0 and w.shape[0] % ws == 0,
             "mm_ag": w.shape[1] % ws == 0,
         }[kind]
-    if not cm.should_decompose(comm, ws, ok):
+    deny = cm.decline_reason(comm, ws, ok)
+    if deny is not None:
+        cm.record_dispatch(kind, False, deny)
         return None
+    cm.record_dispatch(kind, True, chunks=ws)
 
     # ONE local ring per kind, shared by both execution contexts so the
     # lowerings cannot desynchronize. mm_ar/mm_ag take the cotangent

@@ -894,10 +894,21 @@ def static_check_inventory() -> dict:
     """Every static check in the repo, one inventory: the trace-time
     jaxpr rules above, the KV page-pool sanitizer's violation classes
     (incubate/nn/page_sanitizer.py — the dynamic checker whose
-    coverage the codebase lint guarantees), and the AST rules of
-    tools/lint_codebase.py. Emitted in the CLI's --json payload under
-    ``static_checks`` and printable standalone with ``--rules``."""
+    coverage the codebase lint guarantees), the runtime-telemetry
+    metric/span surface (framework/telemetry.py — the observability
+    layer the serving and compile paths report through), and the AST
+    rules of tools/lint_codebase.py. Emitted in the CLI's --json
+    payload under ``static_checks`` and printable standalone with
+    ``--rules``."""
     inv = {"jaxpr": [dataclasses.asdict(r) for r in RULES.values()]}
+    try:
+        from .telemetry import SURFACE
+
+        inv["telemetry"] = [
+            {"rule_id": name, "severity": kind, "summary": s}
+            for name, kind, s in SURFACE]
+    except Exception:  # pragma: no cover - circulars in odd installs
+        inv["telemetry"] = []
     try:
         from ..incubate.nn.page_sanitizer import VIOLATIONS
 

@@ -178,6 +178,31 @@ define_flag("page_sanitizer_stride", 16,
             "sequence lens, num_free_pages capacity accounting) and, "
             "in strict mode, assert_ref_invariants() runs on every "
             "cache")
+define_flag("telemetry", "off",
+            "runtime telemetry (framework/telemetry.py): 'off' "
+            "(default) allocates NOTHING — no registry, no tracer, "
+            "every instrumented site pays one attribute check (same "
+            "zero-cost discipline as FLAGS_page_sanitizer=off, gated "
+            "at zero tracemalloc blocks in bench.py --serving); "
+            "'metrics' activates the process-wide MetricsRegistry "
+            "(counters/gauges/histograms: serving TTFT/TPOT/queue-"
+            "wait, pool occupancy/COW, prefix hits, compile events, "
+            "collective-matmul dispatch — docs/OBSERVABILITY.md); "
+            "'trace' additionally records nested wall-clock spans "
+            "(admit/prefill-chunk/decode/retire, jit.compile) into a "
+            "bounded ring exportable as Chrome trace JSON. The mode "
+            "is read when a scheduler/pool/cache is CONSTRUCTED")
+define_flag("telemetry_ring", 8192,
+            "span ring-buffer capacity for the telemetry tracer: the "
+            "newest this-many finished spans are retained (rollover "
+            "drops the oldest; exports stay valid Chrome JSON "
+            "regardless of how long the process ran)")
+define_flag("telemetry_samples", 4096,
+            "per-histogram raw-sample reservoir for the telemetry "
+            "registry: percentile readout (p50/p90/p99) is EXACT "
+            "while a histogram has seen at most this many values, "
+            "and exact over the newest this-many after that (the "
+            "log2 bucket counts always cover everything)")
 define_flag("moe_dense_dispatch", False,
             "route MoE tokens via the dense (N,E,C) one-hot "
             "dispatch/combine einsums instead of the sparse index "

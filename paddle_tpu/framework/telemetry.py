@@ -1,0 +1,717 @@
+"""Runtime telemetry — a process-wide metrics registry and a nestable
+span tracer for the serving and compile paths.
+
+Upstream analog: the role paddle/fluid/platform/profiler's host tracer
+plays for operator timing, generalized into the framework-level
+instrumentation T3 (PAPERS.md, arxiv 2401.16677) argues for:
+instrument ONCE at the framework layer so every workload — serving,
+bench, the future async engine — reports from the same counters
+instead of growing ad-hoc per-step dicts.
+
+Two surfaces, both behind ``FLAGS_telemetry=off|metrics|trace``:
+
+* :class:`MetricsRegistry` — named counters, gauges, and log2-bucketed
+  histograms with EXACT p50/p90/p99 readout (a bounded raw-sample
+  reservoir rides next to the bucket counts; percentiles are exact
+  while a histogram has seen at most ``FLAGS_telemetry_samples``
+  values, and exact over the newest window after that). Metric names
+  are ``namespace.metric`` (``serving.ttft_s``, ``pool.cow_forks``,
+  ``compile.count`` — the full inventory is :data:`SURFACE`, also
+  printed by ``python -m paddle_tpu.framework.analysis --rules``).
+* :class:`Tracer` — nestable wall-clock spans (monotonic clock, never
+  ``time.time``) with attributes, kept in a bounded ring buffer
+  (``FLAGS_telemetry_ring``); dumps to JSONL and exports Chrome trace
+  JSON (the ``chrome://tracing`` / Perfetto "traceEvents" format the
+  legacy profiler module documents). The legacy
+  ``paddle_tpu.profiler`` ``RecordEvent`` ranges feed the SAME ring
+  (the bridge in profiler/__init__.py), so one export carries both
+  streams.
+
+Zero-cost off mode (the ``FLAGS_page_sanitizer=off`` discipline):
+``registry()``/``tracer()`` return ``None`` when the flag is off and
+this module allocates NOTHING — instrumented call sites cache the
+handle at construction and pay one ``is None`` check per event.
+``bench.py --serving`` gates off mode at literally zero tracemalloc
+blocks attributed to this file.
+
+CLI::
+
+    python -m paddle_tpu.framework.telemetry --summarize trace.jsonl
+    python -m paddle_tpu.framework.telemetry --export-chrome trace.jsonl -o trace.json
+
+``--summarize`` prints the aggregated span tree plus the counter/
+gauge/histogram table from the snapshot record; ``--export-chrome``
+converts the JSONL stream to a Chrome-trace JSON file loadable in
+``chrome://tracing`` or https://ui.perfetto.dev.
+
+This module is HOST-ONLY by contract: no jax import, ever (it is
+consumed by the jax-free prefix cache and must never pull device
+state into the scheduler's admission loop) — enforced by
+tools/lint_codebase.py's host-only rule. The same linter's
+clock-discipline rule makes this module the SINGLE timing path for
+the serving stack: ``inference/serving.py``, ``paged_cache.py`` and
+``prefix_cache.py`` may not call ``time.*`` clocks directly.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import time as _time
+
+from .flags import flag
+
+__all__ = [
+    "MetricsRegistry", "Histogram", "Tracer", "Span",
+    "telemetry_mode", "metrics_on", "tracing_on", "registry", "tracer",
+    "clock", "reset", "arm_tracer", "disarm_tracer", "export_chrome",
+    "summarize_jsonl", "chrome_from_jsonl", "SURFACE", "NULL_SPAN",
+]
+
+# the sanctioned wall clock (monotonic; tests substitute a fake):
+# every timestamp this module (and, transitively, the serving stack)
+# records comes from here
+_clock = _time.perf_counter
+
+
+def clock() -> float:
+    """Monotonic wall clock (seconds) — the single timing source of
+    the instrumented serving/compile paths."""
+    return _clock()
+
+
+_MODES = ("off", "metrics", "trace")
+
+
+def telemetry_mode() -> str:
+    """FLAGS_telemetry, normalized; unknown values read 'off' (a
+    typo'd deployment flag must not silently allocate telemetry
+    state)."""
+    mode = str(flag("telemetry")).lower()
+    return mode if mode in _MODES else "off"
+
+
+def metrics_on() -> bool:
+    return telemetry_mode() in ("metrics", "trace")
+
+
+def tracing_on() -> bool:
+    return telemetry_mode() == "trace" or _ARMED > 0
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+def _bucket_exp(v: float) -> Optional[int]:
+    """Log2 bucket of ``v``: the exponent ``e`` with
+    ``2**(e-1) < v <= 2**e`` (None for v <= 0 — the zero bucket)."""
+    if v <= 0.0:
+        return None
+    m, e = math.frexp(v)  # v = m * 2**e, 0.5 <= m < 1
+    return e if m > 0.5 else e - 1
+
+
+class Histogram:
+    """Log2-bucketed histogram with an exact-percentile reservoir.
+
+    ``observe`` is O(1): one bucket increment plus an append into a
+    bounded deque of raw samples. ``percentile`` sorts the reservoir
+    on read (readout is rare) and applies the nearest-rank method —
+    EXACT while ``count <= capacity``, exact over the newest
+    ``capacity`` samples after rollover (``summary()["exact"]`` says
+    which). Bucket counts always cover every observation."""
+
+    __slots__ = ("count", "total", "min", "max", "_buckets",
+                 "_samples")
+
+    def __init__(self, samples: Optional[int] = None):
+        cap = int(flag("telemetry_samples")) if samples is None \
+            else int(samples)
+        self._samples = collections.deque(maxlen=max(1, cap))
+        self._buckets: Dict[Optional[int], int] = {}
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, value) -> None:
+        v = float(value)
+        self.count += 1
+        self.total += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+        e = _bucket_exp(v)
+        self._buckets[e] = self._buckets.get(e, 0) + 1
+        self._samples.append(v)
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Nearest-rank percentile over the retained samples (exact —
+        an actually-observed value, never an interpolation)."""
+        if not self._samples:
+            return None
+        s = sorted(self._samples)
+        rank = max(1, math.ceil(p / 100.0 * len(s)))
+        return s[min(rank, len(s)) - 1]
+
+    def buckets(self) -> List[Tuple[float, int]]:
+        """Sorted (upper_bound, count) pairs; bound 0.0 holds the
+        non-positive observations."""
+        out = []
+        for e, n in self._buckets.items():
+            out.append((0.0 if e is None else float(2.0 ** e), n))
+        return sorted(out)
+
+    def summary(self) -> dict:
+        cap = self._samples.maxlen
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.min,
+            "max": self.max,
+            "avg": (self.total / self.count) if self.count else None,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+            "exact": self.count <= cap,
+            "buckets": self.buckets(),
+        }
+
+
+class MetricsRegistry:
+    """Named counters / gauges / histograms, namespaced by the first
+    dot of the metric name (``serving.ttft_s`` lands under
+    ``snapshot()["serving"]["ttft_s"]``). All access through the
+    registry is serialized on one lock — a bare :class:`Histogram`
+    held outside the registry is NOT thread-safe on its own."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    # -- writes ------------------------------------------------------------
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(n)
+
+    def gauge(self, name: str, value) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists.setdefault(name, Histogram())
+            h.observe(value)
+
+    # -- reads -------------------------------------------------------------
+    def counter(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+    def gauge_value(self, name: str) -> Optional[float]:
+        return self._gauges.get(name)
+
+    def histogram(self, name: str) -> Optional[Histogram]:
+        return self._hists.get(name)
+
+    def snapshot(self) -> dict:
+        """One nested dict: {namespace: {metric: value}} — counters as
+        ints, gauges as floats, histograms as their summary dicts."""
+        out: Dict[str, dict] = {}
+
+        def put(name, value):
+            ns, _, key = name.partition(".")
+            out.setdefault(ns, {})[key or ns] = value
+
+        with self._lock:
+            for name, v in sorted(self._counters.items()):
+                put(name, v)
+            for name, v in sorted(self._gauges.items()):
+                put(name, v)
+            # summaries sort the sample reservoirs — build them under
+            # the lock so a concurrent observe cannot mutate a deque
+            # mid-sort
+            for name, h in sorted(self._hists.items()):
+                put(name, h.summary())
+        return out
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    """One finished (or in-flight) wall span. ``path`` is the
+    slash-joined ancestor chain captured at begin ("serving.step/"
+    "serving.admit"), which keeps the tree reconstructible after
+    ring rollover drops parents."""
+
+    __slots__ = ("name", "cat", "t0", "dur", "tid", "depth", "path",
+                 "attrs")
+
+    def __init__(self, name, cat="app", attrs=None):
+        self.name = str(name)
+        self.cat = cat
+        self.attrs = attrs or {}
+        self.t0 = 0.0
+        self.dur = 0.0
+        self.tid = threading.get_ident()
+        self.depth = 0
+        self.path = self.name
+
+    def to_dict(self) -> dict:
+        return {"type": "span", "name": self.name, "cat": self.cat,
+                "ts": self.t0, "dur": self.dur, "tid": self.tid,
+                "depth": self.depth, "path": self.path,
+                "args": dict(self.attrs)}
+
+
+class _NullSpan:
+    """Reentrant, stateless no-op context manager — module singleton
+    (:data:`NULL_SPAN`) so an off-mode call site enters a span-shaped
+    ``with`` without allocating anything."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+def _chrome_event(name, cat, tid, ts, dur, args, base, pid):
+    """One Chrome "traceEvents" complete event (µs, rebased to the
+    stream's earliest timestamp) — the single place the event shape
+    lives, shared by live exports (Tracer.to_chrome) and JSONL
+    post-processing (chrome_from_jsonl)."""
+    return {
+        "name": name, "cat": cat, "ph": "X", "pid": pid, "tid": tid,
+        "ts": round((ts - base) * 1e6, 3),
+        "dur": round(dur * 1e6, 3), "args": dict(args),
+    }
+
+
+class _SpanCtx:
+    __slots__ = ("_tr", "_span")
+
+    def __init__(self, tr, span):
+        self._tr = tr
+        self._span = span
+
+    def __enter__(self) -> Span:
+        s = self._span
+        stack = self._tr._stack()
+        s.depth = len(stack)
+        if stack:
+            s.path = stack[-1].path + "/" + s.name
+        stack.append(s)
+        s.t0 = clock()
+        return s
+
+    def __exit__(self, *exc):
+        s = self._span
+        s.dur = clock() - s.t0
+        stack = self._tr._stack()
+        if stack and stack[-1] is s:
+            stack.pop()
+        elif s in stack:  # mis-nested exit: drop up to and incl. s
+            del stack[stack.index(s):]
+        self._tr._commit(s)
+        return False
+
+
+class Tracer:
+    """Bounded ring of finished spans + a per-thread open-span stack
+    for nesting. ``span()`` is the context-manager entry point;
+    ``add_complete()`` records an externally timed range (the legacy
+    profiler RecordEvent bridge)."""
+
+    def __init__(self, ring: Optional[int] = None):
+        cap = int(flag("telemetry_ring")) if ring is None \
+            else int(ring)
+        self._ring = collections.deque(maxlen=max(16, cap))
+        self._tls = threading.local()
+        # serializes commits against ring reads: exporting from one
+        # thread while another finishes a span must not hit "deque
+        # mutated during iteration"
+        self._lock = threading.Lock()
+        self.dropped = 0  # spans evicted by ring rollover
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    def _commit(self, span: Span) -> None:
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(span)
+
+    def span(self, name: str, cat: str = "app", **attrs) -> _SpanCtx:
+        """``with tracer.span("serving.admit", admitted=2): ...`` —
+        nestable; attributes land in the Chrome export's ``args``."""
+        return _SpanCtx(self, Span(name, cat, attrs))
+
+    def add_complete(self, name, t0, dur, cat="event",
+                     attrs=None) -> Span:
+        """Record an already-timed range (t0 from :func:`clock`)."""
+        s = Span(name, cat, attrs)
+        s.t0 = float(t0)
+        s.dur = float(dur)
+        self._commit(s)
+        return s
+
+    # -- readout -----------------------------------------------------------
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def to_chrome(self) -> dict:
+        """Chrome trace JSON ("traceEvents" complete events, µs) —
+        loadable in chrome://tracing and Perfetto. Valid regardless
+        of rollover: "X" events carry their own duration and need no
+        parent."""
+        spans = sorted(self.spans(), key=lambda s: s.t0)
+        base = spans[0].t0 if spans else 0.0
+        pid = os.getpid()
+        events = [
+            _chrome_event(s.name, s.cat, s.tid, s.t0, s.dur, s.attrs,
+                          base, pid)
+            for s in spans]
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def dump_jsonl(self, path: str, registry=None) -> str:
+        """Write the ring as JSONL span records plus, when a registry
+        is given, one trailing ``{"type": "metrics"}`` snapshot —
+        the stream the module CLI summarizes."""
+        with open(path, "w") as f:
+            for s in sorted(self.spans(), key=lambda sp: sp.t0):
+                f.write(json.dumps(s.to_dict(), default=str) + "\n")
+            if registry is not None:
+                f.write(json.dumps(
+                    {"type": "metrics", "data": registry.snapshot()},
+                    default=str) + "\n")
+        return path
+
+
+# ---------------------------------------------------------------------------
+# process-wide singletons (lazily built; nothing exists while off)
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Optional[MetricsRegistry] = None
+_TRACER: Optional[Tracer] = None
+_ARMED = 0  # profiler-window arming (profiler/__init__.py bridge)
+# guards singleton creation and the arm counter: two threads building
+# schedulers concurrently must cache the SAME registry, or the
+# loser's metrics silently vanish from every snapshot
+_STATE_LOCK = threading.Lock()
+
+
+def registry() -> Optional[MetricsRegistry]:
+    """The process-wide registry, or None when FLAGS_telemetry=off.
+    Instrumented sites cache this at construction and guard with one
+    ``is None`` check per event (the zero-cost-off contract)."""
+    global _REGISTRY
+    if not metrics_on():
+        return None
+    if _REGISTRY is None:
+        with _STATE_LOCK:
+            if _REGISTRY is None:
+                _REGISTRY = MetricsRegistry()
+    return _REGISTRY
+
+
+def tracer() -> Optional[Tracer]:
+    """The process-wide tracer — present in trace mode or while a
+    legacy profiler RECORD window is armed; None otherwise."""
+    global _TRACER
+    if not tracing_on():
+        return None
+    if _TRACER is None:
+        with _STATE_LOCK:
+            if _TRACER is None:
+                _TRACER = Tracer()
+    return _TRACER
+
+
+def arm_tracer() -> Tracer:
+    """Force-enable span collection regardless of FLAGS_telemetry —
+    the legacy profiler's make_scheduler RECORD states call this so
+    an explicit Profiler window always collects (and only RECORD
+    windows do, when the flag is off). Balanced by
+    :func:`disarm_tracer`."""
+    global _ARMED
+    with _STATE_LOCK:
+        _ARMED += 1
+    return tracer()
+
+
+def disarm_tracer() -> None:
+    global _ARMED
+    with _STATE_LOCK:
+        _ARMED = max(0, _ARMED - 1)
+
+
+def reset() -> None:
+    """Drop the process-wide registry and tracer (bench/test arm
+    isolation). Handles cached by live schedulers/pools keep working
+    against the detached objects."""
+    global _REGISTRY, _TRACER, _ARMED
+    with _STATE_LOCK:
+        _REGISTRY = None
+        _TRACER = None
+        _ARMED = 0
+
+
+def export_chrome(path: str, tracer_obj: Optional[Tracer] = None):
+    """Write the current (or given) tracer's ring as a Chrome-trace
+    JSON file; returns the path, or None when no tracer ever existed.
+    Reads ``_TRACER`` directly (not :func:`tracer`) so a just-closed
+    profiler window can still export its spans."""
+    tr = tracer_obj if tracer_obj is not None else _TRACER
+    if tr is None:
+        return None
+    with open(path, "w") as f:
+        json.dump(tr.to_chrome(), f, default=str)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# metric/span inventory — merged into `framework.analysis --rules`
+# ---------------------------------------------------------------------------
+
+SURFACE: Tuple[Tuple[str, str, str], ...] = (
+    # serving (inference/serving.py — BatchScheduler.metrics())
+    ("serving.ttft_s", "histogram",
+     "request submit -> first generated token (time-to-first-token)"),
+    ("serving.tpot_s", "histogram",
+     "interval between consecutive generated tokens (per request)"),
+    ("serving.queue_wait_s", "histogram",
+     "request submit -> admission into the active batch"),
+    ("serving.retire_s", "histogram",
+     "retire latency: prefix insert + page free per finished request"),
+    ("serving.steps", "counter", "scheduler iterations"),
+    ("serving.prefill_tokens", "counter",
+     "prompt tokens advanced (chunked or token-per-step)"),
+    ("serving.decode_tokens", "counter",
+     "decode-ROW tokens advanced per step (a request's FIRST "
+     "generated token commits on a prefill row and lands only in "
+     "generated_tokens)"),
+    ("serving.generated_tokens", "counter",
+     "generated tokens committed (every TTFT/TPOT event; the "
+     "throughput numerator)"),
+    ("serving.prefix_hit_tokens", "counter",
+     "prompt tokens served from the prefix cache at admission"),
+    ("serving.requests_admitted", "counter", "requests admitted"),
+    ("serving.requests_finished", "counter", "requests retired"),
+    # KV page pool (incubate/nn/paged_cache.py)
+    ("pool.cow_forks", "counter",
+     "copy-on-write page forks (summed across layer pools)"),
+    ("pool.page_allocs", "counter", "pages drawn from the free list"),
+    ("pool.page_frees", "counter",
+     "pages returned to the free list (last reference dropped)"),
+    ("pool.total_pages", "gauge", "pool capacity (all layer caches)"),
+    ("pool.free_pages", "gauge", "free pages right now"),
+    ("pool.utilization", "gauge", "1 - free/total"),
+    ("pool.shared_pages", "gauge", "pages with refcount > 1"),
+    ("pool.used_bytes", "gauge", "HBM bytes of in-use pages"),
+    # prefix cache (inference/prefix_cache.py)
+    ("prefix.hits", "counter", "prompt lookups that matched"),
+    ("prefix.misses", "counter", "prompt lookups that missed"),
+    ("prefix.hit_tokens", "counter", "tokens covered by matches"),
+    ("prefix.lookup_tokens", "counter", "tokens looked up"),
+    ("prefix.inserted_tokens", "counter", "tokens inserted at retire"),
+    ("prefix.inserted_nodes", "counter", "radix nodes created"),
+    ("prefix.evicted_pages", "counter", "pages reclaimed by eviction"),
+    ("prefix.evicted_nodes", "counter", "radix leaves evicted"),
+    ("prefix.cached_tokens", "gauge", "tokens reachable in the tree"),
+    ("prefix.cached_pages", "gauge",
+     "tree-held page references (summed across layers)"),
+    ("prefix.nodes", "gauge", "radix nodes in the tree"),
+    # compile path (jit/api.py)
+    ("compile.count", "counter",
+     "to_static trace/lower events (recompile-storm visibility)"),
+    ("compile.wall_s", "histogram",
+     "wall time per to_static trace+lower (lint included)"),
+    # collective-matmul dispatch (ops/kernels/collective_matmul.py)
+    ("collective.decomposed.<kind>", "counter",
+     "ring decompositions taken, by dispatch kind "
+     "(ag_mm/mm_rs/mm_ar/mm_ag)"),
+    ("collective.declined.<reason>", "counter",
+     "dispatch declines, by reason (off/degree/indivisible/"
+     "below_threshold/shape/no_mesh/legacy_multi_axis)"),
+    ("collective.ring_chunks", "counter",
+     "total ring hops dispatched (overlap coverage)"),
+    # spans (trace mode)
+    ("span:serving.step", "span", "one scheduler iteration"),
+    ("span:serving.admit", "span", "admission pass of a step"),
+    ("span:serving.prefill_chunk", "span",
+     "the ragged model call (packed/pad_to/prefill/decode attrs)"),
+    ("span:serving.decode", "span",
+     "logits -> token commit (sampling + bookkeeping)"),
+    ("span:serving.retire", "span", "one request's retirement"),
+    ("span:jit.compile", "span",
+     "one to_static trace (program/variant/n_eqns/lint attrs)"),
+)
+
+
+# ---------------------------------------------------------------------------
+# JSONL post-processing + CLI
+# ---------------------------------------------------------------------------
+
+
+def _load_jsonl(path: str):
+    spans, metrics = [], None
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{ln}: not a telemetry JSONL record ({e})")
+            if rec.get("type") == "span":
+                spans.append(rec)
+            elif rec.get("type") == "metrics":
+                metrics = rec.get("data") or {}
+    return spans, metrics
+
+
+def chrome_from_jsonl(path: str, out: str) -> str:
+    """Convert a dumped JSONL stream into a Chrome-trace JSON file."""
+    spans, _ = _load_jsonl(path)
+    spans.sort(key=lambda s: s.get("ts", 0.0))
+    base = spans[0].get("ts", 0.0) if spans else 0.0
+    pid = os.getpid()
+    events = [
+        _chrome_event(s.get("name", "?"), s.get("cat", "app"),
+                      s.get("tid", 0), s.get("ts", 0.0),
+                      s.get("dur", 0.0), s.get("args", {}),
+                      base, pid)
+        for s in spans]
+    with open(out, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"},
+                  f, default=str)
+    return out
+
+
+def _fmt_val(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def summarize_jsonl(path: str) -> str:
+    """Aggregated span tree (count/total/avg/max, indented by nest
+    depth) plus the metrics table from the snapshot record."""
+    spans, metrics = _load_jsonl(path)
+    lines = []
+    agg: Dict[str, list] = {}  # path -> [count, total, max]
+    for s in spans:
+        a = agg.setdefault(s.get("path", s.get("name", "?")),
+                           [0, 0.0, 0.0])
+        a[0] += 1
+        a[1] += s.get("dur", 0.0)
+        a[2] = max(a[2], s.get("dur", 0.0))
+    lines.append(f"spans ({len(spans)} records, "
+                 f"{len(agg)} distinct paths)")
+    lines.append(f"{'span':<44}{'calls':>7}{'total_ms':>11}"
+                 f"{'avg_ms':>9}{'max_ms':>9}")
+    for p in sorted(agg):
+        n, tot, mx = agg[p]
+        depth = p.count("/")
+        name = ("  " * depth) + p.rsplit("/", 1)[-1]
+        lines.append(f"{name[:43]:<44}{n:>7}{tot * 1e3:>11.3f}"
+                     f"{tot / n * 1e3:>9.3f}{mx * 1e3:>9.3f}")
+    if metrics:
+        lines.append("")
+        lines.append("histograms")
+        lines.append(f"{'metric':<28}{'count':>7}{'p50':>11}{'p90':>11}"
+                     f"{'p99':>11}{'max':>11}")
+        plain = []
+        for ns in sorted(metrics):
+            group = metrics[ns]
+            if not isinstance(group, dict):
+                plain.append((ns, group))
+                continue
+            for key in sorted(group):
+                v = group[key]
+                name = f"{ns}.{key}"
+                if isinstance(v, dict) and "p50" in v:
+                    lines.append(
+                        f"{name[:27]:<28}{v.get('count', 0):>7}"
+                        f"{_fmt_val(v.get('p50')):>11}"
+                        f"{_fmt_val(v.get('p90')):>11}"
+                        f"{_fmt_val(v.get('p99')):>11}"
+                        f"{_fmt_val(v.get('max')):>11}")
+                else:
+                    plain.append((name, v))
+        if plain:
+            lines.append("")
+            lines.append("counters / gauges")
+            for name, v in plain:
+                lines.append(f"{name[:43]:<44}{_fmt_val(v):>12}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.framework.telemetry",
+        description="Post-process a telemetry JSONL dump "
+        "(Tracer.dump_jsonl): print an aggregated span tree + metric "
+        "table, or convert to Chrome trace JSON.")
+    ap.add_argument("--summarize", metavar="TRACE_JSONL", default=None,
+                    help="print the span tree and histogram table")
+    ap.add_argument("--export-chrome", metavar="TRACE_JSONL",
+                    default=None,
+                    help="convert the JSONL stream to Chrome trace "
+                    "JSON (chrome://tracing / Perfetto)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path for --export-chrome "
+                    "(default: <input>.chrome.json)")
+    args = ap.parse_args(argv)
+
+    if args.summarize is None and args.export_chrome is None:
+        ap.error("pass --summarize and/or --export-chrome")
+    if args.summarize is not None:
+        print(summarize_jsonl(args.summarize))
+    if args.export_chrome is not None:
+        out = args.out or (args.export_chrome + ".chrome.json")
+        chrome_from_jsonl(args.export_chrome, out)
+        print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

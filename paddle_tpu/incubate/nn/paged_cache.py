@@ -63,6 +63,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ...framework import telemetry
 from ...framework.core import Tensor, apply_op, _as_tensor
 from ...framework.flags import flag
 from ...ops.kernels.paged_attention import paged_attention as _kernel
@@ -139,6 +140,10 @@ class PagedKVCacheManager:
                                       mode=mode)
         else:
             self._san = None
+        # runtime telemetry (framework/telemetry.py): lifetime pool
+        # counters under the "pool." namespace; None when
+        # FLAGS_telemetry=off — each event site pays one check
+        self._reg = telemetry.registry()
 
     # -- bookkeeping -------------------------------------------------------
     def alloc(self, seq_id):
@@ -256,6 +261,8 @@ class PagedKVCacheManager:
         self._refcnt[p] = c
         if c == 0:
             self._free.append(p)
+            if self._reg is not None:
+                self._reg.inc("pool.page_frees")
             return 1
         return 0
 
@@ -264,6 +271,8 @@ class PagedKVCacheManager:
             raise RuntimeError("KV page pool exhausted")
         p = self._free.pop()
         self._refcnt[p] = 1
+        if self._reg is not None:
+            self._reg.inc("pool.page_allocs")
         if self.quantized:
             # a fresh page is all-zero: its scale must restart at 0 or
             # the first append would inherit a dead page's calibration
@@ -278,6 +287,8 @@ class PagedKVCacheManager:
         self._copy_page(dst, src)
         self._refcnt[src] -= 1  # src was shared: cannot hit zero here
         self.cow_forks += 1
+        if self._reg is not None:
+            self._reg.inc("pool.cow_forks")
         return dst
 
     def _copy_page(self, dst, src):

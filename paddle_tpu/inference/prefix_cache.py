@@ -35,6 +35,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..framework import telemetry
+
 __all__ = ["RadixPrefixCache", "PrefixMatch"]
 
 
@@ -114,6 +116,11 @@ class RadixPrefixCache:
             "inserted_tokens": 0, "inserted_nodes": 0,
             "evicted_nodes": 0, "evicted_pages": 0,
         }
+        # runtime telemetry (framework/telemetry.py, itself jax-free
+        # so this module stays host-only): the same counters mirrored
+        # into the process registry under "prefix." — None when
+        # FLAGS_telemetry=off (one check per lookup/insert/evict)
+        self._reg = telemetry.registry()
 
     # -- helpers -----------------------------------------------------------
     def _tick(self) -> int:
@@ -230,6 +237,13 @@ class RadixPrefixCache:
             self.stats["hit_tokens"] += matched
         else:
             self.stats["misses"] += 1
+        if self._reg is not None:
+            self._reg.inc("prefix.lookup_tokens", len(tokens))
+            if matched:
+                self._reg.inc("prefix.hits")
+                self._reg.inc("prefix.hit_tokens", matched)
+            else:
+                self._reg.inc("prefix.misses")
         return PrefixMatch(length=matched, chains=chains,
                            path=tuple(path))
 
@@ -309,6 +323,9 @@ class RadixPrefixCache:
         self.mutations += 1
         self.stats["inserted_tokens"] += n - pos
         self.stats["inserted_nodes"] += 1
+        if self._reg is not None:
+            self._reg.inc("prefix.inserted_tokens", n - pos)
+            self._reg.inc("prefix.inserted_nodes")
         self._note("prefix-insert", tokens=n - pos,
                    pages=sum(len(p) for p in pages))
 
@@ -395,6 +412,9 @@ class RadixPrefixCache:
         self.mutations += 1
         self.stats["evicted_nodes"] += 1
         self.stats["evicted_pages"] += freed
+        if self._reg is not None:
+            self._reg.inc("prefix.evicted_nodes")
+            self._reg.inc("prefix.evicted_pages", freed)
         self._note("evict", tokens=len(leaf.key), pages_freed=freed)
         return freed
 

@@ -52,6 +52,21 @@ covers, so admission control stays deadlock-free. On retire the
 sequence's cached tokens are inserted into the tree instead of dying
 with the sequence, and an LRU-by-leaf evictor reclaims unpinned
 cached pages whenever admission would otherwise cross the watermark.
+
+Telemetry (``FLAGS_telemetry=metrics|trace``; framework/telemetry.py):
+the scheduler is the primary producer of the ``serving.*`` registry
+namespace — per-request TTFT / TPOT / queue-wait / retire-latency
+histograms and token/request counters, surfaced through
+:meth:`BatchScheduler.metrics` as ONE namespaced snapshot (pool,
+prefix and sanitizer counters fold into the same shape; the legacy
+``page_pool_stats()`` keys stay as aliases). In trace mode every step
+additionally records nested wall spans — ``serving.step`` >
+``serving.admit`` / ``serving.prefill_chunk`` / ``serving.decode`` /
+``serving.retire`` — into the telemetry ring (Chrome-trace
+exportable). Off (the default) allocates nothing and costs one
+``is None`` check per site; all timing goes through
+``telemetry.clock()`` — tools/lint_codebase.py's clock-discipline
+rule bans direct ``time.*`` reads in this module.
 """
 from __future__ import annotations
 
@@ -61,7 +76,9 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..framework import telemetry
 from ..framework.flags import flag
+from ..framework.telemetry import NULL_SPAN as _NULL
 
 __all__ = ["Request", "BatchScheduler", "RequestState",
            "bucket_packed_tokens"]
@@ -123,6 +140,10 @@ class Request:
     _pos: int = 0  # prompt tokens consumed so far
     _prefix_hit: int = 0  # prompt tokens served from the prefix cache
     _prefix_path: tuple = ()  # pinned radix nodes (unpinned at retire)
+    # telemetry timestamps (telemetry.clock(); 0.0 = never stamped —
+    # only written when the scheduler's registry handle is live)
+    _t_submit: float = 0.0
+    _t_last_tok: float = 0.0
 
     @property
     def finished(self) -> bool:
@@ -238,6 +259,11 @@ class BatchScheduler:
         # pools also run assert_ref_invariants there
         self._san_stride = max(1, int(flag("page_sanitizer_stride")))
         self._san_steps = 0
+        # runtime telemetry (framework/telemetry.py): mode read HERE,
+        # like the sanitizer — off holds None handles and every
+        # instrumented site below pays one `is None` check
+        self._metrics = telemetry.registry()
+        self._tracer = telemetry.tracer()
 
     # -- pool accounting ---------------------------------------------------
     def _pool(self, model=None):
@@ -304,6 +330,47 @@ class BatchScheduler:
             }
         return stats
 
+    def metrics(self) -> dict:
+        """ONE namespaced telemetry snapshot for the whole serving
+        stack — the unified replacement for the three divergent stats
+        shapes (``page_pool_stats()`` / ``prefix_stats`` / sanitizer
+        counters, all of which keep their old keys as aliases):
+
+        * ``serving`` — TTFT/TPOT/queue-wait/retire histograms (exact
+          p50/p90/p99) and token/request counters;
+        * ``pool`` — occupancy gauges (refreshed here) + lifetime
+          COW-fork/alloc/free counters;
+        * ``prefix`` — hit/insert/evict counters + tree-size gauges;
+        * ``compile`` / ``collective`` — whatever the compile path and
+          the collective-matmul dispatch recorded in this process;
+        * ``sanitizer`` — event/violation counters when a sanitizer
+          is live.
+
+        Returns ``{"telemetry": "off"}`` when FLAGS_telemetry was off
+        at scheduler construction (nothing was ever recorded)."""
+        if self._metrics is None:
+            return {"telemetry": "off"}
+        m = self._metrics
+        # ONE source of truth for the aggregation: the legacy-shape
+        # snapshot computes the pool/prefix/sanitizer sums, and the
+        # gauges here are those same numbers published into the
+        # registry (the shapes cannot drift)
+        stats = self.page_pool_stats()
+        for key in ("total_pages", "free_pages", "utilization",
+                    "shared_pages", "used_bytes"):
+            m.gauge("pool." + key, stats[key])
+        tree = stats.get("prefix_cache", {}).get("tree")
+        if tree is not None:
+            m.gauge("prefix.cached_tokens", tree["cached_tokens"])
+            m.gauge("prefix.cached_pages", tree["cached_pages"])
+            m.gauge("prefix.nodes", tree["nodes"])
+        snap = m.snapshot()
+        snap["telemetry"] = ("trace" if self._tracer is not None
+                             else "metrics")
+        if "sanitizer" in stats:
+            snap["sanitizer"] = stats["sanitizer"]
+        return snap
+
     def _sanitizer_epoch(self):
         """Every FLAGS_page_sanitizer_stride steps: cross-check each
         cache's shadow heap against the real pool (and, on strict
@@ -352,6 +419,8 @@ class BatchScheduler:
                 f"but the pool watermark admits at most "
                 f"{int(self.page_watermark * total)} of {total}"
             )
+        if self._metrics is not None:
+            req._t_submit = telemetry.clock()
         self._queue.append(req)
         return req.req_id
 
@@ -448,6 +517,11 @@ class BatchScheduler:
                 self.draft.alloc(req.req_id)
             req.state = RequestState.PREFILL
             self._active[req.req_id] = req
+            if self._metrics is not None:
+                self._metrics.observe(
+                    "serving.queue_wait_s",
+                    telemetry.clock() - req._t_submit)
+                self._metrics.inc("serving.requests_admitted")
         return hit_tokens_admitted
 
     def _reserved_pages_outstanding(self) -> int:
@@ -488,7 +562,44 @@ class BatchScheduler:
             return fn(seq_id)
         return [c.seq_pages(seq_id) for c in self.model.caches]
 
+    def _span(self, name, **attrs):
+        """Span context for a step phase — NULL_SPAN when no tracer
+        is live (the off path never enters telemetry.py; the guard
+        lives here so call sites cannot forget it)."""
+        tr = self._tracer
+        return tr.span(name, **attrs) if tr is not None else _NULL
+
+    def _note_gen_token(self, req: Request):
+        """TTFT/TPOT accounting — call right after a GENERATED token
+        is appended (prompt tokens never count). The first token
+        closes the submit->first-token span (TTFT); later tokens
+        record the inter-token gap (TPOT). Speculative rounds commit
+        bursts, so their intra-round TPOT is near zero by design —
+        that IS the latency the client observes."""
+        if self._metrics is None:
+            return
+        self._metrics.inc("serving.generated_tokens")
+        now = telemetry.clock()
+        if len(req.generated_ids) == 1:
+            self._metrics.observe("serving.ttft_s",
+                                  now - req._t_submit)
+        else:
+            self._metrics.observe("serving.tpot_s",
+                                  now - req._t_last_tok)
+        req._t_last_tok = now
+
     def _retire(self, req: Request):
+        # span and histogram gate independently: a tracer armed by a
+        # profiler window (metrics off) still gets its retire spans
+        t0 = telemetry.clock() if self._metrics is not None else 0.0
+        with self._span("serving.retire", req=req.req_id):
+            self._retire_impl(req)
+        if self._metrics is not None:
+            self._metrics.observe("serving.retire_s",
+                                  telemetry.clock() - t0)
+            self._metrics.inc("serving.requests_finished")
+
+    def _retire_impl(self, req: Request):
         rid = req.req_id
         if self.prefix_cache is not None:
             # keep the sequence's prefix: insert the cached tokens
@@ -516,10 +627,27 @@ class BatchScheduler:
         retire completions. Returns event counters
         (admitted/advanced/finished plus the prefill/decode token
         split and, under chunked prefill, chunk_utilization and the
-        adapter's ragged-dispatch compile count)."""
+        adapter's ragged-dispatch compile count). Under telemetry the
+        whole iteration is a ``serving.step`` span and the counters
+        also land in the ``serving.*`` registry namespace
+        (:meth:`metrics`)."""
+        with self._span("serving.step"):
+            ev = self._step_impl()
+        if self._metrics is not None:
+            m = self._metrics
+            m.inc("serving.steps")
+            m.inc("serving.prefill_tokens",
+                  ev.get("prefill_tokens", 0))
+            m.inc("serving.decode_tokens", ev.get("decode_tokens", 0))
+            m.inc("serving.prefix_hit_tokens",
+                  ev.get("prefix_hit_tokens", 0))
+        return ev
+
+    def _step_impl(self) -> dict:
         self._sanitizer_epoch()
         n_before = len(self._active)
-        hit_tokens = self._try_admit()
+        with self._span("serving.admit"):
+            hit_tokens = self._try_admit()
         admitted = len(self._active) - n_before
         if not self._active:
             return {"admitted": admitted, "advanced": 0, "finished": 0,
@@ -541,43 +669,50 @@ class BatchScheduler:
                 n_pre += 1
             else:
                 feed.append(req.generated_ids[-1])
-        logits = self.model.decode_token(feed, sids)
-        logits_np = np.asarray(
-            logits.numpy() if hasattr(logits, "numpy") else logits
-        )
+        # one serving.decode span covers the model forward AND the
+        # sampling/commit loop — the same meaning the chunked path
+        # gives it (the documented span schema: retire nests inside)
+        with self._span("serving.decode", rows=len(sids),
+                        prefill=n_pre):
+            logits = self.model.decode_token(feed, sids)
+            logits_np = np.asarray(
+                logits.numpy() if hasattr(logits, "numpy") else logits
+            )
 
-        finished = 0
-        for bi, s in enumerate(sids):
-            req = self._active[s]
-            if req.state == RequestState.PREFILL:
-                tok = req.prompt_ids[req._pos]
-                req._pos += 1
-                if req.on_token is not None:
-                    req.on_token(req, tok, True)
-                if req._pos == len(req.prompt_ids):
-                    if req.max_new_tokens == 0:
-                        # prefill-only (scoring): no sampling
-                        self._retire(req)
-                        finished += 1
-                        continue
-                    req.state = RequestState.DECODE
-                    # the last prompt position's logits sample the
-                    # first generated token
-                    first = self.sampler(logits_np[bi])
-                    req.generated_ids.append(first)
+            finished = 0
+            for bi, s in enumerate(sids):
+                req = self._active[s]
+                if req.state == RequestState.PREFILL:
+                    tok = req.prompt_ids[req._pos]
+                    req._pos += 1
                     if req.on_token is not None:
-                        req.on_token(req, first, False)
-                    if self._done(req, first):
-                        self._retire(req)
-                        finished += 1
-                continue
-            tok = self.sampler(logits_np[bi])
-            req.generated_ids.append(tok)
-            if req.on_token is not None:
-                req.on_token(req, tok, False)
-            if self._done(req, tok):
-                self._retire(req)
-                finished += 1
+                        req.on_token(req, tok, True)
+                    if req._pos == len(req.prompt_ids):
+                        if req.max_new_tokens == 0:
+                            # prefill-only (scoring): no sampling
+                            self._retire(req)
+                            finished += 1
+                            continue
+                        req.state = RequestState.DECODE
+                        # the last prompt position's logits sample the
+                        # first generated token
+                        first = self.sampler(logits_np[bi])
+                        req.generated_ids.append(first)
+                        self._note_gen_token(req)
+                        if req.on_token is not None:
+                            req.on_token(req, first, False)
+                        if self._done(req, first):
+                            self._retire(req)
+                            finished += 1
+                    continue
+                tok = self.sampler(logits_np[bi])
+                req.generated_ids.append(tok)
+                self._note_gen_token(req)
+                if req.on_token is not None:
+                    req.on_token(req, tok, False)
+                if self._done(req, tok):
+                    self._retire(req)
+                    finished += 1
         return {
             "admitted": admitted,
             "advanced": len(sids),
@@ -636,6 +771,7 @@ class BatchScheduler:
         req.state = RequestState.DECODE
         first = self.sampler(logits_row)
         req.generated_ids.append(first)
+        self._note_gen_token(req)
         if req.on_token is not None:
             req.on_token(req, first, False)
         if self._done(req, first):
@@ -653,25 +789,31 @@ class BatchScheduler:
         rows, feeds, starts, n_pre, n_dec = self._chunk_feeds(sids)
         packed = sum(len(f) for f in feeds)
         pad_to = bucket_packed_tokens(packed, self.serving_buckets)
-        logits = self.model.prefill_chunk(
-            feeds, rows, starts, pad_to=pad_to)
-        logits_np = np.asarray(
-            logits.numpy() if hasattr(logits, "numpy") else logits)
+        with self._span("serving.prefill_chunk", rows=len(rows),
+                        packed=packed, pad_to=pad_to, prefill=n_pre,
+                        decode=n_dec):
+            logits = self.model.prefill_chunk(
+                feeds, rows, starts, pad_to=pad_to)
+            logits_np = np.asarray(
+                logits.numpy() if hasattr(logits, "numpy")
+                else logits)
 
         finished = 0
-        for bi, s in enumerate(rows):
-            req = self._active[s]
-            if req.state == RequestState.PREFILL:
-                finished += self._advance_prefill_row(
-                    req, feeds[bi], logits_np[bi])
-                continue
-            tok = self.sampler(logits_np[bi])
-            req.generated_ids.append(tok)
-            if req.on_token is not None:
-                req.on_token(req, tok, False)
-            if self._done(req, tok):
-                self._retire(req)
-                finished += 1
+        with self._span("serving.decode", rows=len(rows)):
+            for bi, s in enumerate(rows):
+                req = self._active[s]
+                if req.state == RequestState.PREFILL:
+                    finished += self._advance_prefill_row(
+                        req, feeds[bi], logits_np[bi])
+                    continue
+                tok = self.sampler(logits_np[bi])
+                req.generated_ids.append(tok)
+                self._note_gen_token(req)
+                if req.on_token is not None:
+                    req.on_token(req, tok, False)
+                if self._done(req, tok):
+                    self._retire(req)
+                    finished += 1
 
         cs = self.chunk_stats
         cs["steps"] += 1
@@ -714,13 +856,19 @@ class BatchScheduler:
             rows, feeds, starts, n_pre, _ = self._chunk_feeds(pre)
             packed = sum(len(f) for f in feeds)
             pad_to = bucket_packed_tokens(packed, self.serving_buckets)
-            logits = self.model.prefill_chunk(
-                feeds, rows, starts, pad_to=pad_to)
-            # mirror the prompt chunks into the draft's own KV pool
-            self.draft.prefill_chunk(feeds, rows, starts,
-                                     pad_to=pad_to)
-            logits_np = np.asarray(
-                logits.numpy() if hasattr(logits, "numpy") else logits)
+            with self._span("serving.prefill_chunk", rows=len(rows),
+                            packed=packed, pad_to=pad_to,
+                            prefill=n_pre, decode=0):
+                logits = self.model.prefill_chunk(
+                    feeds, rows, starts, pad_to=pad_to)
+                # mirror the prompt chunks into the draft's own pool
+                self.draft.prefill_chunk(feeds, rows, starts,
+                                         pad_to=pad_to)
+                # the blocking device->host sync belongs to the model
+                # call's span, as in the non-spec paths
+                logits_np = np.asarray(
+                    logits.numpy() if hasattr(logits, "numpy")
+                    else logits)
             cs = self.chunk_stats
             cs["steps"] += 1
             cs["chunk_calls"] += 2
@@ -753,6 +901,7 @@ class BatchScheduler:
                     req.state = RequestState.DECODE
                     first = int(np.argmax(logits_np[bi]))
                     req.generated_ids.append(first)
+                    self._note_gen_token(req)
                     if req.on_token is not None:
                         req.on_token(req, first, False)
                     if self._done(req, first):
@@ -766,59 +915,69 @@ class BatchScheduler:
             base_t = {s: self.model.caches[0].seq_len(s) for s in dec}
             base_d = {s: self.draft.caches[0].seq_len(s) for s in dec}
             cur = [self._active[s].generated_ids[-1] for s in dec]
-            props = []
-            for _ in range(k):
-                dl = np.asarray(self.draft.decode_token(cur, dec)._data)
-                cur = [int(np.argmax(dl[i])) for i in range(len(dec))]
-                props.append(cur)
-            # feed the k-th proposal too, so the draft cache never lags
-            # the committed prefix (rejections roll back by truncate)
-            self.draft.decode_token(cur, dec)
-            windows = np.asarray(
-                [[self._active[s].generated_ids[-1]]
-                 + [props[j][i] for j in range(k)]
-                 for i, s in enumerate(dec)], np.int64)
-            tl = self.model.decode_window(windows, dec)
-            preds = np.argmax(np.asarray(tl._data), axis=-1)  # (B, k+1)
-            self.spec_stats["rounds"] += 1
-            self.spec_stats["target_calls"] += 1
-            self.spec_stats["draft_calls"] += k + 1
+            with self._span("serving.decode", rows=len(dec),
+                            draft_k=k):
+                props = []
+                for _ in range(k):
+                    dl = np.asarray(
+                        self.draft.decode_token(cur, dec)._data)
+                    cur = [int(np.argmax(dl[i]))
+                           for i in range(len(dec))]
+                    props.append(cur)
+                # feed the k-th proposal too, so the draft cache never
+                # lags the committed prefix (rejections roll back by
+                # truncate)
+                self.draft.decode_token(cur, dec)
+                windows = np.asarray(
+                    [[self._active[s].generated_ids[-1]]
+                     + [props[j][i] for j in range(k)]
+                     for i, s in enumerate(dec)], np.int64)
+                tl = self.model.decode_window(windows, dec)
+                preds = np.argmax(
+                    np.asarray(tl._data), axis=-1)  # (B, k+1)
+                self.spec_stats["rounds"] += 1
+                self.spec_stats["target_calls"] += 1
+                self.spec_stats["draft_calls"] += k + 1
 
-            for i, s in enumerate(dec):
-                req = self._active[s]
-                n_acc = 0
-                while (n_acc < k
-                       and props[n_acc][i] == int(preds[i, n_acc])):
-                    n_acc += 1
-                    if (req.eos_id is not None
-                            and props[n_acc - 1][i] == req.eos_id):
-                        break
-                accepted = [props[j][i] for j in range(n_acc)]
-                if (req.eos_id is None or not accepted
-                        or accepted[-1] != req.eos_id):
-                    accepted.append(int(preds[i, n_acc]))
-                done = False
-                committed = 0
-                for t in accepted:
-                    req.generated_ids.append(t)
-                    committed += 1
-                    dec_tokens += 1
-                    self.spec_stats["committed_tokens"] += 1
-                    if req.on_token is not None:
-                        req.on_token(req, t, False)
-                    if self._done(req, t):
-                        done = True
-                        break
-                if done:
-                    self._retire(req)
-                    finished += 1
-                else:
-                    # committed prefix back in the caches: everything
-                    # except the newest token (fed next round)
-                    for c in self.model.caches:
-                        c.truncate(s, base_t[s] + committed)
-                    for c in self.draft.caches:
-                        c.truncate(s, base_d[s] + committed)
+                # accept/commit (and retire/rollback) stay inside the
+                # decode span — same schema as the non-spec paths
+                for i, s in enumerate(dec):
+                    req = self._active[s]
+                    n_acc = 0
+                    while (n_acc < k
+                           and props[n_acc][i] == int(preds[i, n_acc])):
+                        n_acc += 1
+                        if (req.eos_id is not None
+                                and props[n_acc - 1][i] == req.eos_id):
+                            break
+                    accepted = [props[j][i] for j in range(n_acc)]
+                    if (req.eos_id is None or not accepted
+                            or accepted[-1] != req.eos_id):
+                        accepted.append(int(preds[i, n_acc]))
+                    done = False
+                    committed = 0
+                    for t in accepted:
+                        req.generated_ids.append(t)
+                        self._note_gen_token(req)
+                        committed += 1
+                        dec_tokens += 1
+                        self.spec_stats["committed_tokens"] += 1
+                        if req.on_token is not None:
+                            req.on_token(req, t, False)
+                        if self._done(req, t):
+                            done = True
+                            break
+                    if done:
+                        self._retire(req)
+                        finished += 1
+                    else:
+                        # committed prefix back in the caches:
+                        # everything except the newest token (fed
+                        # next round)
+                        for c in self.model.caches:
+                            c.truncate(s, base_t[s] + committed)
+                        for c in self.draft.caches:
+                            c.truncate(s, base_d[s] + committed)
             advanced += len(dec)
 
         # prefix caching is mutually exclusive with speculative

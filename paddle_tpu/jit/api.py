@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import state as _registry
+from ..framework import telemetry as _telemetry
 from ..framework.core import EagerParamBase, Tensor
 from ..framework.flags import flag
 
@@ -357,6 +358,15 @@ class StaticFunction:
         import jax.extend.core as jex
 
         ensure_compilation_cache()
+        # telemetry compile event (framework/telemetry.py): one
+        # counter bump + wall-time histogram sample + trace span per
+        # to_static trace, attributed to the program and its variant
+        # count — a recompile storm shows up as a run of jit.compile
+        # spans with a climbing variant number. Off costs nothing.
+        _reg = _telemetry.registry()
+        _tr = _telemetry.tracer()
+        _t0 = _telemetry.clock() \
+            if (_reg is not None or _tr is not None) else None
         pure, aux = entry["pure"], entry["aux"]
         n_s = entry["n_state"]
         s_structs = [jax.ShapeDtypeStruct(t._data.shape, t._data.dtype)
@@ -448,10 +458,10 @@ class StaticFunction:
         entry["donate_intent"] = self._donate
 
         mode = flag("jit_lint")
+        report = None
         if lint and mode != "off":
             from ..framework import analysis
 
-            report = None
             try:
                 report = analysis.lint_static_entry(self, entry)
                 entry["lint_report"] = report
@@ -463,6 +473,21 @@ class StaticFunction:
                      module="jit.api")
             if report is not None:
                 analysis.emit_report(report, mode)
+
+        if _t0 is not None:
+            dur = _telemetry.clock() - _t0
+            prog = getattr(self, "__name__", "<static>")
+            variants = len(self._finalized_entries())
+            lint_counts = report.counts() if report is not None else {}
+            if _reg is not None:
+                _reg.inc("compile.count")
+                _reg.observe("compile.wall_s", dur)
+            if _tr is not None:
+                _tr.add_complete(
+                    "jit.compile", _t0, dur, cat="compile",
+                    attrs={"program": prog, "variant": variants,
+                           "n_eqns": len(j.eqns),
+                           "lint": lint_counts})
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

@@ -91,6 +91,25 @@ def min_bytes() -> int:
         return 1 << 62
 
 
+def decline_reason(comm_bytes, axis_size, divisible=True):
+    """Why the policy would decline this pair — None means decompose.
+    The reason string feeds the telemetry decline counters
+    (:func:`record_dispatch`), so overlap coverage is quantifiable:
+    'degree' (ring of 1), 'indivisible' (chunk dims don't divide the
+    ring), 'off' (flag), 'below_threshold' (auto mode, payload under
+    FLAGS_collective_matmul_min_bytes)."""
+    if axis_size <= 1:
+        return "degree"
+    if not divisible:
+        return "indivisible"
+    mode = decompose_mode()
+    if mode == "off":
+        return "off"
+    if mode != "on" and int(comm_bytes) < min_bytes():
+        return "below_threshold"
+    return None
+
+
 def should_decompose(comm_bytes, axis_size, divisible=True) -> bool:
     """The auto/on/off gate shared by the layer dispatch
     (mp_ops.collective_matmul_dispatch) and the trace linter's
@@ -98,14 +117,28 @@ def should_decompose(comm_bytes, axis_size, divisible=True) -> bool:
     collective would move; ``divisible`` is the structural check (chunk
     dims divide the axis size — a remainder chunk would need a second,
     unbalanced ring)."""
-    if axis_size <= 1 or not divisible:
-        return False
-    mode = decompose_mode()
-    if mode == "off":
-        return False
-    if mode == "on":
-        return True
-    return int(comm_bytes) >= min_bytes()
+    return decline_reason(comm_bytes, axis_size, divisible) is None
+
+
+def record_dispatch(kind, decomposed, reason=None, chunks=0):
+    """Telemetry counters for one dispatch decision (called by
+    mp_ops.collective_matmul_dispatch, NOT by the trace linter — the
+    linter's should_decompose probes must not inflate coverage
+    stats): ``collective.decomposed.<kind>`` + ``ring_chunks`` on
+    take, ``collective.declined.<reason>`` on decline. A no-op (one
+    registry check) when FLAGS_telemetry=off. Host-side work at
+    dispatch/trace time only — nothing here enters the ring's traced
+    body."""
+    from ...framework import telemetry
+
+    reg = telemetry.registry()
+    if reg is None:
+        return
+    if decomposed:
+        reg.inc("collective.decomposed." + str(kind))
+        reg.inc("collective.ring_chunks", int(chunks))
+    else:
+        reg.inc("collective.declined." + str(reason or "policy"))
 
 
 # ---------------------------------------------------------------------------

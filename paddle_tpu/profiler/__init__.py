@@ -13,6 +13,18 @@ TPU-native mapping:
   Chrome-trace export analog);
 * the wait/warmup/active scheduler, ProfilerTarget and summary tables
   keep the reference API shape.
+
+Telemetry bridge (framework/telemetry.py): this module's host events
+and the runtime-telemetry tracer share ONE stream. Every
+:class:`RecordEvent` range lands in the telemetry span ring whenever a
+tracer is live — either because ``FLAGS_telemetry=trace``, or because
+a profiler RECORD window armed it (``make_scheduler`` states gate
+collection: outside a RECORD window, with the flag off, nothing is
+recorded). :func:`export_chrome_tracing` exports that unified ring as
+an actual Chrome-trace JSON file (RecordEvent ranges, scheduler
+serving spans, jit.compile events — everything the ring holds), so
+the stub stops being dead plumbing. ``summary()`` keeps reading the
+legacy host-event store for its tables.
 """
 from __future__ import annotations
 
@@ -25,6 +37,8 @@ from collections import defaultdict
 from typing import Callable, Iterable, Optional
 
 import jax
+
+from ..framework import telemetry as _telemetry
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
@@ -147,6 +161,13 @@ class RecordEvent:
         self._ann = None
         if _collecting:
             _record_event(self.name, self._t0, dur)
+        # telemetry bridge: the range also lands in the unified span
+        # ring — present when FLAGS_telemetry=trace OR while a
+        # profiler RECORD window has the tracer armed (None otherwise:
+        # make_scheduler's CLOSED/READY states really collect nothing)
+        tr = _telemetry.tracer()
+        if tr is not None:
+            tr.add_complete(self.name, self._t0, dur, cat="profiler")
 
     def __enter__(self):
         self.begin()
@@ -160,12 +181,23 @@ class RecordEvent:
 def _start_collecting():
     global _collecting
     _clear_events()
+    # arm the telemetry tracer for the window: an explicit Profiler
+    # RECORD state collects spans even with FLAGS_telemetry=off (the
+    # user asked for a trace), and releases at window close. When the
+    # profiler is what drives collection (flag not 'trace'), the ring
+    # restarts per window — matching _clear_events, so each window's
+    # chrome export holds ONLY that window. A trace-mode application
+    # ring is the user's; never wipe it.
+    tr = _telemetry.arm_tracer()
+    if tr is not None and _telemetry.telemetry_mode() != "trace":
+        tr.clear()
     _collecting = True
 
 
 def _stop_collecting():
     global _collecting
     _collecting = False
+    _telemetry.disarm_tracer()
 
 
 # -- scheduler ---------------------------------------------------------------
@@ -206,15 +238,23 @@ def _default_state_scheduler(step: int) -> ProfilerState:
 
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
-    """on_trace_ready callable: the collected XPlane trace under
-    ``dir_name`` is TensorBoard/Perfetto-loadable (the reference writes
-    Chrome-trace JSON; XLA's native artifact is the XPlane .pb, viewable
-    in the same tools)."""
+    """on_trace_ready callable: writes the unified telemetry span ring
+    (RecordEvent ranges + any serving/compile spans collected in the
+    window) as a real Chrome-trace JSON file under ``dir_name`` —
+    loadable in chrome://tracing / Perfetto. The XPlane trace XLA
+    collects (non-timer_only runs) lands in the same directory for
+    TensorBoard."""
 
     def handle(prof):
-        # _exported_to is set by the profiler itself, and only when a
-        # trace was actually collected (not under timer_only)
-        pass
+        worker = worker_name or f"worker_{os.getpid()}"
+        try:
+            os.makedirs(dir_name, exist_ok=True)
+            path = _telemetry.export_chrome(
+                os.path.join(dir_name, f"{worker}.chrome_trace.json"))
+        except OSError:
+            path = None
+        if path is not None:
+            prof._exported_to = prof._exported_to or path
 
     handle._dir = dir_name
     return handle

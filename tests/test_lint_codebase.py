@@ -107,8 +107,83 @@ class TestHostOnlyLint:
         for p in covered:
             assert os.path.exists(p), p
 
+    def test_telemetry_module_is_covered(self):
+        # the jax-free-import contract of the telemetry layer: it is
+        # imported BY host-only modules and must stay host-only itself
+        assert any(
+            f.endswith(os.path.join("framework", "telemetry.py"))
+            for f in lint_codebase.HOST_ONLY_FILES)
+
     def test_inference_surface_leak_free(self):
         assert lint_codebase.check_inference_surface() == []
+
+
+class TestClockDiscipline:
+    """Telemetry clock discipline: the instrumented serving modules
+    (serving.py / paged_cache.py / prefix_cache.py) must not read
+    wall clocks directly — spans / telemetry.clock() are the single
+    timing path."""
+
+    def test_seeded_dotted_clock_calls_flagged(self):
+        bad = (
+            "import time\n"
+            "def step(self):\n"
+            "    t0 = time.time()\n"
+            "    t1 = time.perf_counter()\n"
+            "    t2 = time.monotonic()\n"
+            "    return t1 - t0, t2\n"
+        )
+        v = lint_codebase.lint_clock_discipline_file(
+            "fake/serving.py", text=bad)
+        rules = "\n".join(v)
+        assert len(v) == 3, v
+        assert "time.time()" in rules
+        assert "time.perf_counter()" in rules
+        assert "time.monotonic()" in rules
+        assert "single timing path" in rules.lower() or \
+            "SINGLE timing" in rules
+
+    def test_seeded_from_import_flagged(self):
+        bad = (
+            "from time import perf_counter\n"
+            "def step(self):\n"
+            "    return perf_counter()\n"
+        )
+        v = lint_codebase.lint_clock_discipline_file(
+            "fake/serving.py", text=bad)
+        assert len(v) == 1, v
+        assert "from time import perf_counter" in v[0]
+
+    def test_telemetry_helper_clean(self):
+        text = (
+            "from ..framework import telemetry\n"
+            "import time\n"          # import alone is fine (sleep..)
+            "def step(self):\n"
+            "    time.sleep(0)\n"    # non-clock time attr is fine
+            "    if self._metrics is not None:\n"
+            "        t0 = telemetry.clock()\n"
+            "    return t0\n"
+        )
+        assert lint_codebase.lint_clock_discipline_file(
+            "fake/serving.py", text=text) == []
+
+    def test_waiver_comment_suppresses(self):
+        text = (
+            "import time\n"
+            "def step(self):\n"
+            "    return time.time()  # trace-lint: ok(test waiver)\n"
+        )
+        assert lint_codebase.lint_clock_discipline_file(
+            "fake/serving.py", text=text) == []
+
+    def test_serving_modules_are_covered_and_clean(self):
+        files = lint_codebase.CLOCK_DISCIPLINE_FILES
+        endings = {os.path.join("inference", "serving.py"),
+                   os.path.join("inference", "prefix_cache.py"),
+                   os.path.join("nn", "paged_cache.py")}
+        for want in endings:
+            assert any(f.endswith(want) for f in files), want
+        assert lint_codebase.check_clock_discipline() == []
 
 
 class TestOpTableMessages:

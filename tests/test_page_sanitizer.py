@@ -480,6 +480,6 @@ class TestCLI:
         payload = json.loads(capsys.readouterr().out)
         groups = payload["static_checks"]
         assert set(groups) == {"jaxpr", "page_sanitizer",
-                               "codebase_lint"}
+                               "codebase_lint", "telemetry"}
         assert {r["rule_id"] for r in groups["page_sanitizer"]} \
             == set(VIOLATIONS)

@@ -64,6 +64,7 @@ NAMESPACES = [
     "paddle_tpu.audio",
     "paddle_tpu.quantization",
     "paddle_tpu.inference",
+    "paddle_tpu.framework.telemetry",
     "paddle_tpu.profiler",
     "paddle_tpu.models",
     "paddle_tpu.models.convert",

@@ -51,7 +51,16 @@ Checks:
    touching the private bookkeeping attrs from there is an error.
    Together these guarantee the dynamic sanitizer's event coverage
    statically: there is no un-instrumented mutation path.
-7. collective-matmul discipline: ops/kernels/collective_matmul.py is
+7. clock discipline (the framework/telemetry.py observability
+   contract): the instrumented serving modules
+   (inference/serving.py, incubate/nn/paged_cache.py,
+   inference/prefix_cache.py) must not read wall clocks directly —
+   telemetry spans and ``telemetry.clock()`` are the single timing
+   path, so TTFT/TPOT/span accounting can never silently fork from
+   an ad-hoc ``time.time()``. framework/telemetry.py itself is also
+   held jax-free (HOST_ONLY_FILES): it is imported by host-only
+   modules and backs the admission loop's accounting.
+8. collective-matmul discipline: ops/kernels/collective_matmul.py is
    jax-only (every body runs inside jit traces under shard_map) — no
    host-side module imports (os/sys/time/numpy/threading/...); and the
    TP/SP layer modules (mpu/mp_layers.py, mpu/mp_ops.py,
@@ -96,9 +105,13 @@ _WAIVER_MARK = "# trace-lint: ok"
 
 # modules that must stay PURE host bookkeeping: the prefix-cache
 # subsystem runs inside the scheduler's admission loop, where any jax
-# import means device compute (or a device sync) per admitted request
+# import means device compute (or a device sync) per admitted request;
+# the telemetry module is imported BY host-only modules and must
+# itself never pull jax in (the jax-free contract of
+# docs/OBSERVABILITY.md)
 HOST_ONLY_FILES = (
     os.path.join("paddle_tpu", "inference", "prefix_cache.py"),
+    os.path.join("paddle_tpu", "framework", "telemetry.py"),
 )
 
 _HOST_ONLY_BANNED_MODULES = ("jax", "jax.numpy")
@@ -233,6 +246,89 @@ def check_host_only(root=REPO):
     out = []
     for f in HOST_ONLY_FILES:
         out.extend(lint_host_only_file(os.path.join(root, f)))
+    return out
+
+
+# clock discipline (the observability contract of framework/
+# telemetry.py): the instrumented serving modules must have exactly
+# ONE timing path — telemetry spans / telemetry.clock(). A direct
+# time.time()/perf_counter() read in the scheduler or the caches is
+# ad-hoc timing the telemetry layer cannot see (and time.time is not
+# even monotonic), so latency accounting silently forks.
+CLOCK_DISCIPLINE_FILES = (
+    os.path.join("paddle_tpu", "inference", "serving.py"),
+    os.path.join("paddle_tpu", "inference", "prefix_cache.py"),
+    os.path.join("paddle_tpu", "incubate", "nn", "paged_cache.py"),
+)
+
+# clock attributes of the time module (dotted calls time.X(...))
+_CLOCK_ATTRS = frozenset({
+    "time", "time_ns", "perf_counter", "perf_counter_ns",
+    "monotonic", "monotonic_ns", "process_time", "process_time_ns",
+    "thread_time", "thread_time_ns", "clock_gettime",
+    "clock_gettime_ns",
+})
+
+
+class _ClockDisciplineVisitor(ast.NodeVisitor):
+    """Flags direct wall-clock reads: ``time.<clock>()`` calls and
+    ``from time import <clock>`` (which would make the later bare
+    call invisible to a call-site check)."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+
+    def _flag(self, lineno, what):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        if _WAIVER_MARK not in line:
+            self.violations.append(
+                "%s:%d: %s in a telemetry-disciplined serving module "
+                "(spans / telemetry.clock() are the SINGLE timing "
+                "path — ad-hoc clock reads fork the latency "
+                "accounting; framework/telemetry.py); route it "
+                "through the telemetry layer or waive with "
+                "'%s(<reason>)'"
+                % (self.relpath, lineno, what, _WAIVER_MARK))
+
+    def visit_Call(self, node):
+        dotted = _dotted_head(node)
+        if dotted is not None and dotted[0] == "time" \
+                and dotted[1] in _CLOCK_ATTRS:
+            self._flag(node.lineno, "time.%s()" % dotted[1])
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if (node.module or "") == "time":
+            names = sorted(a.name for a in node.names
+                           if a.name in _CLOCK_ATTRS or a.name == "*")
+            if names:
+                self._flag(node.lineno,
+                           "from time import %s" % ", ".join(names))
+        self.generic_visit(node)
+
+
+def lint_clock_discipline_file(path, text=None):
+    """Clock-discipline check for one file; returns violations."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _ClockDisciplineVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_clock_discipline(root=REPO):
+    out = []
+    for f in CLOCK_DISCIPLINE_FILES:
+        out.extend(lint_clock_discipline_file(os.path.join(root, f)))
     return out
 
 
@@ -879,8 +975,12 @@ RULES = (
      "public op-namespace callables must resolve in the op_table "
      "registry; no raw jax callables leaking through"),
     ("host-only-hygiene",
-     "declared host-only modules (prefix_cache.py) must not touch "
-     "jax/jnp at all"),
+     "declared host-only modules (prefix_cache.py, framework/"
+     "telemetry.py) must not touch jax/jnp at all"),
+    ("clock-discipline",
+     "no direct time.time/perf_counter reads in serving.py/"
+     "paged_cache.py/prefix_cache.py — telemetry spans/clock() are "
+     "the single timing path"),
     ("inference-surface-leak",
      "no raw jax callable through the public paddle_tpu.inference "
      "namespace"),
@@ -911,6 +1011,7 @@ RULES = (
 def run_lint(root=REPO, with_op_table=True):
     out = check_traced_paths(root)
     out.extend(check_host_only(root))
+    out.extend(check_clock_discipline(root))
     out.extend(check_quant_sidecar_writes(root))
     out.extend(check_pool_mutation_audit(root))
     out.extend(check_serving_buckets(root))
