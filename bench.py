@@ -1195,15 +1195,26 @@ def bench_telemetry_serving(users=4, prompt_len=48, new_tokens=8,
     pages_per_seq = -(-(prompt_len + new_tokens) // page_size)
     num_pages = 2 * users * pages_per_seq + 16
 
+    # generous SLO bounds (CPU bench wall times are noise-dominated):
+    # the POINT is exercising the goodput/attainment pipeline — with
+    # bounds this wide every request must meet them, so the gate can
+    # assert goodput == 1.0 from the registry
+    slo = telemetry.SLOConfig(ttft_p99_s=600.0, tpot_p99_s=600.0,
+                              queue_wait_p99_s=600.0)
+
     def _mk_sched(mode):
         telemetry.reset()
         set_flags({"telemetry": mode})
         adapter = PagedLlamaAdapter(
             model, num_pages=num_pages, page_size=page_size,
             max_length=cfg.max_position_embeddings)
+        # the off arm must not pass slo= (the scheduler warns that an
+        # explicit SLO is discarded without live metrics — correct,
+        # but here off-mode is the deliberate baseline)
         sched = BatchScheduler(adapter, max_batch_size=users,
                                chunked_prefill=True,
-                               prefill_chunk_tokens=budget)
+                               prefill_chunk_tokens=budget,
+                               slo=slo if mode != "off" else None)
         for i, p in enumerate(prompts):
             sched.submit(Request(f"r{i}", list(p),
                                  max_new_tokens=new_tokens))
@@ -1253,6 +1264,7 @@ def bench_telemetry_serving(users=4, prompt_len=48, new_tokens=8,
         sched_off = _mk_sched("off")
         sched_tr = _mk_sched("trace")
         tr = telemetry.tracer()  # capture before the flag flips back
+        book = telemetry.request_traces()
         set_flags({"telemetry": "off"})
         w_off, w_tr = [], []
         flip = False
@@ -1292,19 +1304,82 @@ def bench_telemetry_serving(users=4, prompt_len=48, new_tokens=8,
                          for o, t in zip(w_off, w_tr)]
         out["pct"] = 100.0 * float(np.median(out["ratios"]))
         # the export must survive a JSON round trip and carry the
-        # four step-phase spans
-        chrome = json.loads(json.dumps(tr.to_chrome()))
-        out["chrome_events"] = len(chrome.get("traceEvents", []))
+        # four step-phase spans PLUS one named lane per request
+        # (the per-request chrome lanes of ISSUE 8)
+        chrome = json.loads(json.dumps(
+            telemetry.chrome_payload(tr, book)))
+        events = chrome.get("traceEvents", [])
+        out["chrome_events"] = len(events)
         out["span_names"] = sorted(
-            {e["name"] for e in chrome.get("traceEvents", [])})
+            {e["name"] for e in events if e.get("ph") != "M"})
+        lane_names = {e["args"]["name"] for e in events
+                      if e.get("ph") == "M"
+                      and e.get("name") == "thread_name"}
+        out["request_lanes"] = sorted(lane_names)
+        out["lanes_complete"] = all(
+            f"req r{i}" in lane_names for i in range(users))
+        lane_tids = {e["tid"] for e in events
+                     if e.get("ph") == "M"}
+        out["lane_phases_ok"] = all(
+            {"queued", "prefill", "decode"} <= {
+                e["name"] for e in events
+                if e.get("tid") == tid and e.get("ph") == "X"}
+            for tid in lane_tids)
         return out
+
+    def trip_recompile_watchdog():
+        """Deliberately trip the recompile-storm watchdog (ISSUE 8
+        acceptance): serve with pathological per-integer serving
+        buckets and a growing active set, so nearly every step packs
+        a DISTINCT bucketed token count — a fresh ragged program per
+        step, exactly the unbucketed-shape storm the detector exists
+        to catch. A tight Watchdog (warmup 2, window 6) must record
+        at least one recompile-storm event within the run."""
+        import warnings as _warnings
+
+        from paddle_tpu.framework.watchdog import Watchdog
+
+        telemetry.reset()
+        set_flags({"telemetry": "metrics",
+                   "telemetry_watchdog_stride": 1})
+        reg = telemetry.registry()
+        wd = Watchdog(reg, mode="warn", window=6, warmup=2,
+                      storm_compiles=3)
+        adapter = PagedLlamaAdapter(
+            model, num_pages=num_pages, page_size=page_size,
+            max_length=cfg.max_position_embeddings)
+        sched = BatchScheduler(
+            adapter, max_batch_size=users, chunked_prefill=True,
+            prefill_chunk_tokens=4,
+            serving_buckets=list(range(1, 65)),  # one bucket per count
+            watchdog=wd)
+        for i in range(users):
+            sched.submit(Request(f"w{i}", [7] * (2 + i),
+                                 max_new_tokens=4))
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore", RuntimeWarning)
+            steps = 0
+            while (sched.num_active or sched.num_queued) \
+                    and steps < 200:
+                sched.step()
+                steps += 1
+        summ = sched.metrics().get("watchdog", {})
+        return {
+            "tripped": summ.get("by_class", {}).get(
+                "recompile-storm", 0) > 0,
+            "events": int(summ.get("events", 0)),
+            "by_class": summ.get("by_class", {}),
+            "compile_count": adapter.compile_count,
+        }
 
     try:
         run("off")                 # warmup: compiles out of timing
         pairs = [run_pair() for _ in range(5)][1:]  # [0] re-warms
         alloc_probe = run("off", trace_alloc=True)
+        wd_trip = trip_recompile_watchdog()
     finally:
-        set_flags({"telemetry": "off"})
+        set_flags({"telemetry": "off",
+                   "telemetry_watchdog_stride": 32})
         telemetry.reset()
     pair_pct = [p["pct"] for p in pairs]
     # the reported overhead and both headline p50 columns come from
@@ -1341,6 +1416,16 @@ def bench_telemetry_serving(users=4, prompt_len=48, new_tokens=8,
         "ttft": _hist_cols(m, "ttft_s"),
         "tpot": _hist_cols(m, "tpot_s"),
         "queue_wait": _hist_cols(m, "queue_wait_s"),
+        # SLO/goodput columns (ISSUE 8), straight from the registry:
+        # with the generous bench SLO every request must attain
+        "slo": m.get("slo"),
+        "goodput": m.get("serving", {}).get("goodput"),
+        "slo_attain_ttft":
+            m.get("serving", {}).get("slo_attain_ttft"),
+        "slo_attain_tpot":
+            m.get("serving", {}).get("slo_attain_tpot"),
+        "slo_attain_queue_wait":
+            m.get("serving", {}).get("slo_attain_queue_wait"),
         "chrome_events": med.get("chrome_events", 0),
         "chrome_valid": med.get("chrome_events", 0) > 0,
         "step_spans_present": all(
@@ -1348,6 +1433,15 @@ def bench_telemetry_serving(users=4, prompt_len=48, new_tokens=8,
             for want in ("serving.admit", "serving.prefill_chunk",
                          "serving.decode", "serving.retire")),
         "span_names": span_names,
+        # per-request chrome lanes: one named track per request with
+        # the queued/prefill/decode phase spans present
+        "request_lanes": med.get("request_lanes", []),
+        "lanes_complete": bool(med.get("lanes_complete")),
+        "lane_phases_ok": bool(med.get("lane_phases_ok")),
+        # the deliberately tripped recompile-storm watchdog
+        "watchdog_tripped": bool(wd_trip.get("tripped")),
+        "watchdog_events": wd_trip.get("events", 0),
+        "watchdog_by_class": wd_trip.get("by_class", {}),
         # the off-mode zero-cost gate: tracemalloc saw NO allocation
         # attributed to framework/telemetry.py across the loop
         "off_telemetry_alloc_blocks": int(
@@ -2012,6 +2106,19 @@ def main() -> int:
             trec.get("overhead_pct", 100.0) <= 2.0 and \
             trec.get("ttft", {}).get("count", 0) > 0 and \
             trec.get("tpot", {}).get("count", 0) > 0
+        # ISSUE-8 request-lifecycle acceptance: goodput + per-SLO
+        # attainment columns sourced from the registry (generous SLO
+        # -> every request attains), one named chrome lane per
+        # request with the lifecycle phase spans, and the recompile-
+        # storm watchdog deliberately tripped via unbucketed shapes
+        tel_ok = tel_ok and \
+            trec.get("goodput") == 1.0 and \
+            trec.get("slo_attain_ttft") == 1.0 and \
+            trec.get("slo_attain_tpot") == 1.0 and \
+            trec.get("slo_attain_queue_wait") == 1.0 and \
+            bool(trec.get("lanes_complete")) and \
+            bool(trec.get("lane_phases_ok")) and \
+            bool(trec.get("watchdog_tripped"))
         ok = bool(rec.get("greedy_identical")) and \
             rec.get("prefill_skip_frac", 0.0) >= 0.5 and \
             qrec.get("greedy_match_rate", 0.0) >= 1.0 and \
@@ -2051,6 +2158,13 @@ def main() -> int:
                    bool(trec.get("off_zero_alloc")),
                "telemetry_chrome_valid":
                    bool(trec.get("chrome_valid")),
+               "telemetry_goodput": trec.get("goodput"),
+               "telemetry_slo_attain_ttft":
+                   trec.get("slo_attain_ttft"),
+               "telemetry_lanes_complete":
+                   bool(trec.get("lanes_complete")),
+               "telemetry_watchdog_tripped":
+                   bool(trec.get("watchdog_tripped")),
                "artifact": os.path.basename(_SERVING_FILE),
                "git_rev": _git_rev()})
         return 0

@@ -896,8 +896,10 @@ def static_check_inventory() -> dict:
     (incubate/nn/page_sanitizer.py — the dynamic checker whose
     coverage the codebase lint guarantees), the runtime-telemetry
     metric/span surface (framework/telemetry.py — the observability
-    layer the serving and compile paths report through), and the AST
-    rules of tools/lint_codebase.py. Emitted in the CLI's --json
+    layer the serving and compile paths report through), the anomaly
+    watchdog classes (framework/watchdog.py — the registry-read-only
+    detectors the scheduler runs at the watchdog stride), and the
+    AST rules of tools/lint_codebase.py. Emitted in the CLI's --json
     payload under ``static_checks`` and printable standalone with
     ``--rules``."""
     inv = {"jaxpr": [dataclasses.asdict(r) for r in RULES.values()]}
@@ -909,6 +911,14 @@ def static_check_inventory() -> dict:
             for name, kind, s in SURFACE]
     except Exception:  # pragma: no cover - circulars in odd installs
         inv["telemetry"] = []
+    try:
+        from .watchdog import WATCHDOG_CLASSES
+
+        inv["watchdog"] = [
+            {"rule_id": rid, "severity": "warning", "summary": s}
+            for rid, s in WATCHDOG_CLASSES]
+    except Exception:  # pragma: no cover - circulars in odd installs
+        inv["watchdog"] = []
     try:
         from ..incubate.nn.page_sanitizer import VIOLATIONS
 

@@ -203,6 +203,62 @@ define_flag("telemetry_samples", 4096,
             "while a histogram has seen at most this many values, "
             "and exact over the newest this-many after that (the "
             "log2 bucket counts always cover everything)")
+define_flag("telemetry_request_traces", 256,
+            "bounded LRU of COMPLETED per-request traces kept by the "
+            "request-trace book (framework/telemetry.py "
+            "RequestTraceBook, live in trace mode): each retired "
+            "request's submit -> admit -> prefill-chunk -> token -> "
+            "retire timeline is retained until this many completed "
+            "traces exist, then the oldest is dropped — memory stays "
+            "fixed under load. Active (in-flight) traces are never "
+            "dropped")
+define_flag("telemetry_window", 128,
+            "sliding-window size in SCHEDULER STEP EPOCHS (not wall "
+            "clock, so windowed views stay deterministic under a fake "
+            "clock) for the request-lifecycle observability layer: "
+            "windowed percentile views over the latency histograms, "
+            "the SLO/goodput attainment window over retired requests, "
+            "and the rate window every watchdog detector computes "
+            "deltas over (framework/watchdog.py)")
+define_flag("telemetry_slo", "",
+            "declarative serving SLO spec consumed by BatchScheduler "
+            "when FLAGS_telemetry is on: comma-separated "
+            "'ttft_p99_s=<s>,tpot_p99_s=<s>,queue_wait_p99_s=<s>' "
+            "(any subset; empty disables SLO accounting). A retired "
+            "request 'meets' the SLO set when its TTFT, its p99 "
+            "inter-token gap, and its queue wait are each within the "
+            "configured bounds; serving.goodput is the fraction of "
+            "requests retired inside the FLAGS_telemetry_window that "
+            "met ALL configured SLOs (per-SLO attainment gauges ride "
+            "alongside) — the admission-control signal of ROADMAP "
+            "item 1 (docs/OBSERVABILITY.md)")
+define_flag("telemetry_watchdog", "off",
+            "anomaly watchdogs over the telemetry registry "
+            "(framework/watchdog.py): 'off' (default) builds nothing; "
+            "'warn' runs the registry-READ-ONLY detector pass every "
+            "FLAGS_telemetry_watchdog_stride scheduler steps — "
+            "recompile storm, page-pool high-watermark / alloc-free "
+            "churn, prefix-cache hit-rate collapse, decode stall, "
+            "sanitizer-violation spike — appending structured events "
+            "to a bounded log and raising RuntimeWarning; 'strict' "
+            "raises WatchdogError at the detecting step instead. "
+            "Requires FLAGS_telemetry=metrics|trace (detectors only "
+            "read registry state)")
+define_flag("telemetry_watchdog_stride", 32,
+            "scheduler-step stride of the watchdog detector pass AND "
+            "of the periodic FLAGS_telemetry_export_path snapshot "
+            "write: every this many BatchScheduler.step() calls the "
+            "pool/prefix/sanitizer gauges are refreshed, every "
+            "watchdog detector runs, and (when an export path is "
+            "set) the Prometheus snapshot is rewritten")
+define_flag("telemetry_export_path", "",
+            "when non-empty and FLAGS_telemetry is on, the scheduler "
+            "rewrites this file with a Prometheus text-format "
+            "snapshot of the metrics registry every "
+            "FLAGS_telemetry_watchdog_stride steps (atomic tmp+rename "
+            "write, so a scraper or the multi-host router never reads "
+            "a torn file; the renderer is jax-free — "
+            "telemetry.prometheus_text / --export-prom)")
 define_flag("moe_dense_dispatch", False,
             "route MoE tokens via the dense (N,E,C) one-hot "
             "dispatch/combine einsums instead of the sparse index "

@@ -34,15 +34,37 @@ handle at construction and pay one ``is None`` check per event.
 ``bench.py --serving`` gates off mode at literally zero tracemalloc
 blocks attributed to this file.
 
+Request-lifecycle layer (PR 8, on top of the two surfaces above):
+
+* :class:`RequestTraceBook` — per-request trace assembly keyed by
+  request id (submit -> admit -> prefill chunks -> tokens -> retire),
+  bounded LRU of completed traces, JSONL records, and per-request
+  LANES in the Chrome export (one named track per request).
+* :class:`SLOConfig` + windowed histogram views — declarative latency
+  SLOs and ``serving.goodput`` attainment, windowed by scheduler STEP
+  EPOCH (not wall clock) so the accounting is deterministic under a
+  fake clock.
+* :func:`prometheus_text` / :func:`write_prometheus` — a jax-free
+  Prometheus text-format renderer over the registry, periodically
+  snapshotted to ``FLAGS_telemetry_export_path``.
+* the anomaly watchdogs live in the sibling
+  :mod:`paddle_tpu.framework.watchdog` (registry-READ-ONLY by lint
+  contract).
+
 CLI::
 
     python -m paddle_tpu.framework.telemetry --summarize trace.jsonl
     python -m paddle_tpu.framework.telemetry --export-chrome trace.jsonl -o trace.json
+    python -m paddle_tpu.framework.telemetry --export-prom trace.jsonl
 
-``--summarize`` prints the aggregated span tree plus the counter/
-gauge/histogram table from the snapshot record; ``--export-chrome``
-converts the JSONL stream to a Chrome-trace JSON file loadable in
-``chrome://tracing`` or https://ui.perfetto.dev.
+``--summarize`` prints the aggregated span tree, the per-request
+trace and watchdog-event digests, plus the counter/gauge/histogram
+table from the snapshot record (a truncated final line — a process
+killed mid-write — is tolerated and noted in the footer);
+``--export-chrome`` converts the JSONL stream to a Chrome-trace JSON
+file loadable in ``chrome://tracing`` or https://ui.perfetto.dev;
+``--export-prom`` renders the snapshot record in the Prometheus text
+exposition format.
 
 This module is HOST-ONLY by contract: no jax import, ever (it is
 consumed by the jax-free prefix cache and must never pull device
@@ -67,9 +89,12 @@ from .flags import flag
 
 __all__ = [
     "MetricsRegistry", "Histogram", "Tracer", "Span",
+    "SLOConfig", "RequestTrace", "RequestTraceBook",
     "telemetry_mode", "metrics_on", "tracing_on", "registry", "tracer",
-    "clock", "reset", "arm_tracer", "disarm_tracer", "export_chrome",
-    "summarize_jsonl", "chrome_from_jsonl", "SURFACE", "NULL_SPAN",
+    "request_traces", "clock", "reset", "arm_tracer", "disarm_tracer",
+    "export_chrome", "chrome_payload", "prometheus_text",
+    "write_prometheus", "summarize_jsonl", "chrome_from_jsonl",
+    "SURFACE", "NULL_SPAN",
 ]
 
 # the sanctioned wall clock (monotonic; tests substitute a fake):
@@ -85,6 +110,18 @@ def clock() -> float:
 
 
 _MODES = ("off", "metrics", "trace")
+
+
+def _nearest_rank(sorted_vals, p: float):
+    """Nearest-rank percentile over an ALREADY-SORTED list — exact
+    (an actually-observed value, never an interpolation). The single
+    rank convention shared by Histogram readouts and per-request SLO
+    verdicts, so the two can never silently diverge."""
+    n = len(sorted_vals)
+    if not n:
+        return None
+    rank = max(1, math.ceil(p / 100.0 * n))
+    return sorted_vals[min(rank, n) - 1]
 
 
 def telemetry_mode() -> str:
@@ -125,7 +162,15 @@ class Histogram:
     on read (readout is rare) and applies the nearest-rank method —
     EXACT while ``count <= capacity``, exact over the newest
     ``capacity`` samples after rollover (``summary()["exact"]`` says
-    which). Bucket counts always cover every observation."""
+    which). Bucket counts always cover every observation.
+
+    Samples are EPOCH-stamped (the registry stamps its current step
+    epoch at observe time): :meth:`windowed` reads back an exact
+    summary over only the samples recorded at or after a given epoch
+    — the sliding-window percentile views the SLO/goodput layer and
+    the watchdogs consume. Windowing by step epoch rather than wall
+    clock keeps every windowed readout deterministic under a fake
+    clock."""
 
     __slots__ = ("count", "total", "min", "max", "_buckets",
                  "_samples")
@@ -133,6 +178,7 @@ class Histogram:
     def __init__(self, samples: Optional[int] = None):
         cap = int(flag("telemetry_samples")) if samples is None \
             else int(samples)
+        # reservoir of (epoch, value) pairs, newest last
         self._samples = collections.deque(maxlen=max(1, cap))
         self._buckets: Dict[Optional[int], int] = {}
         self.count = 0
@@ -140,7 +186,7 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
 
-    def observe(self, value) -> None:
+    def observe(self, value, epoch: int = 0) -> None:
         v = float(value)
         self.count += 1
         self.total += v
@@ -150,16 +196,46 @@ class Histogram:
             self.max = v
         e = _bucket_exp(v)
         self._buckets[e] = self._buckets.get(e, 0) + 1
-        self._samples.append(v)
+        self._samples.append((int(epoch), v))
 
-    def percentile(self, p: float) -> Optional[float]:
+    def samples(self) -> List[Tuple[int, float]]:
+        """The retained ``(epoch, value)`` reservoir, oldest first —
+        the read-only surface the watchdog detectors window over.
+        Prefer :meth:`MetricsRegistry.hist_samples`, which copies
+        under the registry lock."""
+        return list(self._samples)
+
+    def percentile(self, p: float,
+                   min_epoch: Optional[int] = None) -> Optional[float]:
         """Nearest-rank percentile over the retained samples (exact —
-        an actually-observed value, never an interpolation)."""
-        if not self._samples:
-            return None
-        s = sorted(self._samples)
-        rank = max(1, math.ceil(p / 100.0 * len(s)))
-        return s[min(rank, len(s)) - 1]
+        an actually-observed value, never an interpolation).
+        ``min_epoch`` restricts to samples stamped at or after that
+        step epoch (the sliding-window view)."""
+        if min_epoch is None:
+            s = sorted(v for _, v in self._samples)
+        else:
+            s = sorted(v for e, v in self._samples if e >= min_epoch)
+        return _nearest_rank(s, p)
+
+    def windowed(self, min_epoch: int) -> dict:
+        """Exact summary over only the samples stamped at or after
+        ``min_epoch`` — deterministic under the fake clock because
+        the window is keyed by step epoch, never wall time. One
+        filter + one sort; the three quantiles index the same sorted
+        list (a periodic scrape calls this per histogram per pass)."""
+        s = sorted(v for e, v in self._samples if e >= min_epoch)
+        n = len(s)
+
+        return {
+            "count": n,
+            "min": s[0] if n else None,
+            "max": s[-1] if n else None,
+            "avg": (sum(s) / n) if n else None,
+            "p50": _nearest_rank(s, 50),
+            "p90": _nearest_rank(s, 90),
+            "p99": _nearest_rank(s, 99),
+            "from_epoch": int(min_epoch),
+        }
 
     def buckets(self) -> List[Tuple[float, int]]:
         """Sorted (upper_bound, count) pairs; bound 0.0 holds the
@@ -197,6 +273,10 @@ class MetricsRegistry:
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Histogram] = {}
+        # the current scheduler step epoch: stamped onto every
+        # histogram sample so windowed views (SLO attainment,
+        # watchdog rates) are keyed by step count, not wall clock
+        self.epoch = 0
 
     # -- writes ------------------------------------------------------------
     def inc(self, name: str, n: int = 1) -> None:
@@ -212,7 +292,27 @@ class MetricsRegistry:
             h = self._hists.get(name)
             if h is None:
                 h = self._hists.setdefault(name, Histogram())
-            h.observe(value)
+            h.observe(value, self.epoch)
+
+    def advance_epoch(self) -> int:
+        """Advance the REGISTRY-OWNED monotonic epoch stamp by one
+        and return it — the scheduler calls this once per step,
+        BEFORE the step's observations land. The registry owns the
+        counter (not the scheduler) so two live schedulers sharing
+        the process-wide registry advance ONE monotonic stamp
+        instead of rewinding each other's windowed views."""
+        with self._lock:
+            self.epoch += 1
+            return self.epoch
+
+    def set_epoch(self, epoch: int) -> None:
+        """Pin the epoch stamp to an explicit value (test/bench
+        fixtures hand-stepping a fake clock). Never rewinds: the
+        epoch is the monotonic window key of every windowed view, so
+        a stale setter (an older scheduler, a replayed fixture) must
+        not invalidate samples already stamped ahead of it."""
+        with self._lock:
+            self.epoch = max(self.epoch, int(epoch))
 
     # -- reads -------------------------------------------------------------
     def counter(self, name: str) -> int:
@@ -223,6 +323,32 @@ class MetricsRegistry:
 
     def histogram(self, name: str) -> Optional[Histogram]:
         return self._hists.get(name)
+
+    def hist_windowed(self, name: str,
+                      min_epoch: int) -> Optional[dict]:
+        """A histogram's :meth:`Histogram.windowed` summary computed
+        under the registry lock — the sanctioned windowed read (a
+        scrape thread sorting the reservoir while the serving thread
+        observes into it would hit "deque mutated during
+        iteration")."""
+        with self._lock:
+            h = self._hists.get(name)
+            return None if h is None else h.windowed(min_epoch)
+
+    def hist_samples(self, name: str,
+                     min_epoch: Optional[int] = None
+                     ) -> List[Tuple[int, float]]:
+        """Copy of a histogram's (epoch, value) reservoir, taken
+        under the registry lock — the sanctioned read for watchdog
+        detectors (no mutation surface)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                return []
+            s = h.samples()
+        if min_epoch is not None:
+            s = [(e, v) for e, v in s if e >= min_epoch]
+        return s
 
     def snapshot(self) -> dict:
         """One nested dict: {namespace: {metric: value}} — counters as
@@ -244,6 +370,271 @@ class MetricsRegistry:
             for name, h in sorted(self._hists.items()):
                 put(name, h.summary())
         return out
+
+
+# ---------------------------------------------------------------------------
+# SLO config (the declarative half of goodput accounting)
+# ---------------------------------------------------------------------------
+
+
+class SLOConfig:
+    """Declarative serving SLOs, all in seconds: ``ttft_p99_s`` (time
+    to first token), ``tpot_p99_s`` (bound on a request's p99
+    inter-token gap), ``queue_wait_p99_s`` (submit -> admission).
+    ``None`` disables a bound. A retired request *meets* the config
+    when every configured bound holds for it; the scheduler's
+    ``serving.goodput`` gauge is the fraction of requests retired in
+    the trailing ``FLAGS_telemetry_window`` step epochs that met ALL
+    bounds — the signal the future admission controller gates on."""
+
+    __slots__ = ("ttft_p99_s", "tpot_p99_s", "queue_wait_p99_s")
+    FIELDS = ("ttft_p99_s", "tpot_p99_s", "queue_wait_p99_s")
+
+    def __init__(self, ttft_p99_s=None, tpot_p99_s=None,
+                 queue_wait_p99_s=None):
+        self.ttft_p99_s = None if ttft_p99_s is None \
+            else float(ttft_p99_s)
+        self.tpot_p99_s = None if tpot_p99_s is None \
+            else float(tpot_p99_s)
+        self.queue_wait_p99_s = None if queue_wait_p99_s is None \
+            else float(queue_wait_p99_s)
+
+    def enabled(self) -> bool:
+        return any(getattr(self, f) is not None for f in self.FIELDS)
+
+    def to_dict(self) -> dict:
+        return {f: getattr(self, f) for f in self.FIELDS}
+
+    @classmethod
+    def from_flag(cls, spec: Optional[str] = None) -> "SLOConfig":
+        """Parse ``FLAGS_telemetry_slo`` (or an explicit spec):
+        ``'ttft_p99_s=0.5,tpot_p99_s=0.05'`` — any subset of the
+        fields; empty spec -> an all-None (disabled) config."""
+        spec = flag("telemetry_slo") if spec is None else spec
+        kw = {}
+        for part in str(spec).replace(" ", "").split(","):
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            if key not in cls.FIELDS or not val:
+                raise ValueError(
+                    f"bad FLAGS_telemetry_slo entry {part!r} "
+                    f"(expected <field>=<seconds> with field in "
+                    f"{cls.FIELDS})")
+            kw[key] = float(val)
+        return cls(**kw)
+
+    @staticmethod
+    def p99(values) -> Optional[float]:
+        """Nearest-rank p99 over one request's own samples (its
+        inter-token gaps) — exact, matching the histogram method."""
+        return _nearest_rank(sorted(values), 99)
+
+    def request_meets(self, ttft, tpot_p99, queue_wait) -> dict:
+        """Per-SLO verdicts for one retired request (only configured
+        bounds appear; a missing measurement counts as met — e.g. a
+        single-token request has no inter-token gap)."""
+        out = {}
+        if self.ttft_p99_s is not None:
+            out["ttft"] = ttft is None or ttft <= self.ttft_p99_s
+        if self.tpot_p99_s is not None:
+            out["tpot"] = tpot_p99 is None \
+                or tpot_p99 <= self.tpot_p99_s
+        if self.queue_wait_p99_s is not None:
+            out["queue_wait"] = queue_wait is None \
+                or queue_wait <= self.queue_wait_p99_s
+        return out
+
+
+# ---------------------------------------------------------------------------
+# per-request traces
+# ---------------------------------------------------------------------------
+
+
+class RequestTrace:
+    """One request's lifecycle timeline: an ordered list of
+    ``{"t": wall, "epoch": step, "kind": ..., **payload}`` events
+    from ``submit`` through ``admit`` / ``prefill_chunk`` (token
+    counts + prefix-hit tokens) / ``token`` to the terminal
+    ``retire`` (or ``evict``, once preemption exists). ``lane`` is
+    the stable integer track id the Chrome export renders the
+    request under."""
+
+    __slots__ = ("req_id", "lane", "events", "done")
+
+    def __init__(self, req_id: str, lane: int):
+        self.req_id = str(req_id)
+        self.lane = int(lane)
+        self.events: List[dict] = []
+        self.done = False
+
+    def event(self, kind: str, t: float, epoch: int,
+              **payload) -> dict:
+        ev = {"t": float(t), "epoch": int(epoch), "kind": str(kind)}
+        ev.update(payload)
+        self.events.append(ev)
+        return ev
+
+    def first(self, kind: str) -> Optional[dict]:
+        for ev in self.events:
+            if ev["kind"] == kind:
+                return ev
+        return None
+
+    def kinds(self) -> List[str]:
+        return [ev["kind"] for ev in self.events]
+
+    def to_dict(self) -> dict:
+        return {"type": "request", "req_id": self.req_id,
+                "lane": self.lane, "done": self.done,
+                "events": list(self.events)}
+
+
+class RequestTraceBook:
+    """Per-request trace accumulator keyed by request id. Active
+    traces live until their terminal event; completed traces sit in
+    a bounded LRU (``FLAGS_telemetry_request_traces``) so memory is
+    fixed no matter how many requests retire. Unknown request ids
+    are ignored on :meth:`event`/:meth:`complete` — a scheduler
+    built before the book existed must not crash it."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        cap = int(flag("telemetry_request_traces")) \
+            if capacity is None else int(capacity)
+        self.capacity = max(1, cap)
+        self._lock = threading.Lock()
+        self._active: Dict[str, RequestTrace] = {}
+        self._done = collections.OrderedDict()
+        self._lane_seq = 0
+        self.dropped = 0  # completed traces evicted by the LRU
+
+    def begin(self, req_id: str, t: float, epoch: int,
+              **payload) -> RequestTrace:
+        with self._lock:
+            tr = self._active.get(req_id)
+            if tr is None:
+                self._lane_seq += 1
+                tr = RequestTrace(req_id, self._lane_seq)
+                self._active[req_id] = tr
+        tr.event("submit", t, epoch, **payload)
+        return tr
+
+    def event(self, req_id: str, kind: str, t: float, epoch: int,
+              **payload) -> None:
+        tr = self._active.get(req_id)
+        if tr is not None:
+            tr.event(kind, t, epoch, **payload)
+
+    def complete(self, req_id: str, kind: str, t: float, epoch: int,
+                 **payload) -> None:
+        """Record the terminal event (``retire`` today; ``evict``
+        reserved for preemption) and move the trace to the LRU."""
+        with self._lock:
+            tr = self._active.pop(req_id, None)
+            if tr is None:
+                return
+            tr.event(kind, t, epoch, **payload)
+            tr.done = True
+            self._done[req_id] = tr
+            while len(self._done) > self.capacity:
+                self._done.popitem(last=False)
+                self.dropped += 1
+
+    # -- readout -----------------------------------------------------------
+    def get(self, req_id: str) -> Optional[RequestTrace]:
+        return self._active.get(req_id) or self._done.get(req_id)
+
+    def traces(self) -> List[RequestTrace]:
+        with self._lock:
+            return list(self._active.values()) + list(
+                self._done.values())
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    @property
+    def completed_count(self) -> int:
+        return len(self._done)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._done.clear()
+            self.dropped = 0
+
+    def summary(self) -> dict:
+        return {"active": self.active_count,
+                "completed": self.completed_count,
+                "dropped": self.dropped,
+                "capacity": self.capacity}
+
+    def to_jsonl_records(self) -> List[dict]:
+        return [tr.to_dict() for tr in self.traces()]
+
+    def chrome_events(self, base: float, pid: int) -> List[dict]:
+        """Per-request LANES for the Chrome export: each request is
+        one track (tid = its lane, named via thread_name metadata),
+        carrying phase spans derived from the lifecycle timestamps —
+        ``queued`` (submit -> admit), ``prefill`` (admit -> first
+        token), ``decode`` (first token -> retire) — plus an instant
+        event per recorded chunk/token."""
+        return _request_lane_events(
+            self.to_jsonl_records(), base, pid)
+
+    def min_ts(self) -> Optional[float]:
+        ts = [tr.events[0]["t"] for tr in self.traces() if tr.events]
+        return min(ts) if ts else None
+
+
+_LANE_TID_BASE = 1 << 20  # keep request lanes clear of thread ids
+
+
+def _request_lane_events(records, base, pid) -> List[dict]:
+    """Chrome lane events from dumped request records (shared by the
+    live book and JSONL post-processing). One metadata thread_name
+    event names the lane after the request id; lifecycle phases
+    become "X" spans, chunk/token events become instants."""
+    out = []
+    phases = (("submit", "queued"), ("admit", "prefill"),
+              ("first_token", "decode"))
+    for rec in records:
+        events = rec.get("events") or []
+        if not events:
+            continue
+        tid = _LANE_TID_BASE + int(rec.get("lane", 0))
+        rid = rec.get("req_id", "?")
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": f"req {rid}"}})
+        marks = {}
+        for ev in events:
+            k = ev["kind"]
+            if k == "token" and "first_token" not in marks:
+                marks["first_token"] = ev["t"]
+            marks.setdefault(k, ev["t"])
+        end = events[-1]["t"]
+        bounds = [marks.get(k) for k, _ in phases] + [end]
+        for i, (key, phase) in enumerate(phases):
+            t0 = bounds[i]
+            if t0 is None:
+                continue
+            t1 = next((b for b in bounds[i + 1:] if b is not None),
+                      t0)
+            out.append(_chrome_event(
+                phase, "request", tid, t0, max(t1 - t0, 0.0),
+                {"req_id": rid}, base, pid))
+        for ev in events:
+            if ev["kind"] not in ("prefill_chunk", "token"):
+                continue
+            args = {k: v for k, v in ev.items()
+                    if k not in ("t", "kind")}
+            out.append({
+                "name": ev["kind"], "cat": "request", "ph": "i",
+                "s": "t", "pid": pid, "tid": tid,
+                "ts": round((ev["t"] - base) * 1e6, 3),
+                "args": args,
+            })
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -304,6 +695,30 @@ def _chrome_event(name, cat, tid, ts, dur, args, base, pid):
         "ts": round((ts - base) * 1e6, 3),
         "dur": round(dur * 1e6, 3), "args": dict(args),
     }
+
+
+def _chrome_doc(span_recs, request_recs) -> dict:
+    """The full Chrome-trace dict from span RECORDS (Span.to_dict
+    shapes) plus request-trace records — the ONE render path behind
+    Tracer.to_chrome, chrome_payload, and chrome_from_jsonl, so the
+    event shape and the shared time origin can never diverge between
+    the live exports and JSONL post-processing. The origin is the
+    earliest span start or request timestamp across BOTH streams
+    (request lanes must line up against the spans in Perfetto)."""
+    spans = sorted(span_recs, key=lambda s: s.get("ts", 0.0))
+    bases = [s.get("ts", 0.0) for s in spans[:1]]
+    bases += [r["events"][0]["t"] for r in request_recs
+              if r.get("events")]
+    base = min(bases) if bases else 0.0
+    pid = os.getpid()
+    events = [
+        _chrome_event(s.get("name", "?"), s.get("cat", "app"),
+                      s.get("tid", 0), s.get("ts", 0.0),
+                      s.get("dur", 0.0), s.get("args", {}),
+                      base, pid)
+        for s in spans]
+    events.extend(_request_lane_events(request_recs, base, pid))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 class _SpanCtx:
@@ -394,22 +809,24 @@ class Tracer:
         loadable in chrome://tracing and Perfetto. Valid regardless
         of rollover: "X" events carry their own duration and need no
         parent."""
-        spans = sorted(self.spans(), key=lambda s: s.t0)
-        base = spans[0].t0 if spans else 0.0
-        pid = os.getpid()
-        events = [
-            _chrome_event(s.name, s.cat, s.tid, s.t0, s.dur, s.attrs,
-                          base, pid)
-            for s in spans]
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return _chrome_doc([s.to_dict() for s in self.spans()], [])
 
-    def dump_jsonl(self, path: str, registry=None) -> str:
-        """Write the ring as JSONL span records plus, when a registry
-        is given, one trailing ``{"type": "metrics"}`` snapshot —
-        the stream the module CLI summarizes."""
+    def dump_jsonl(self, path: str, registry=None, traces=None,
+                   watchdog=None) -> str:
+        """Write the ring as JSONL span records plus, when given, the
+        per-request trace records (``{"type": "request"}``), the
+        watchdog event log (``{"type": "watchdog_event"}``), and one
+        trailing ``{"type": "metrics"}`` registry snapshot — the
+        stream the module CLI summarizes."""
         with open(path, "w") as f:
             for s in sorted(self.spans(), key=lambda sp: sp.t0):
                 f.write(json.dumps(s.to_dict(), default=str) + "\n")
+            if traces is not None:
+                for rec in traces.to_jsonl_records():
+                    f.write(json.dumps(rec, default=str) + "\n")
+            if watchdog is not None:
+                for rec in watchdog.to_records():
+                    f.write(json.dumps(rec, default=str) + "\n")
             if registry is not None:
                 f.write(json.dumps(
                     {"type": "metrics", "data": registry.snapshot()},
@@ -423,6 +840,7 @@ class Tracer:
 
 _REGISTRY: Optional[MetricsRegistry] = None
 _TRACER: Optional[Tracer] = None
+_TRACES: Optional[RequestTraceBook] = None
 _ARMED = 0  # profiler-window arming (profiler/__init__.py bridge)
 # guards singleton creation and the arm counter: two threads building
 # schedulers concurrently must cache the SAME registry, or the
@@ -457,6 +875,21 @@ def tracer() -> Optional[Tracer]:
     return _TRACER
 
 
+def request_traces() -> Optional[RequestTraceBook]:
+    """The process-wide per-request trace book — present in trace
+    mode (or while a profiler window is armed), None otherwise.
+    Cached by the scheduler at construction, same zero-cost-off
+    contract as :func:`registry`/:func:`tracer`."""
+    global _TRACES
+    if not tracing_on():
+        return None
+    if _TRACES is None:
+        with _STATE_LOCK:
+            if _TRACES is None:
+                _TRACES = RequestTraceBook()
+    return _TRACES
+
+
 def arm_tracer() -> Tracer:
     """Force-enable span collection regardless of FLAGS_telemetry —
     the legacy profiler's make_scheduler RECORD states call this so
@@ -476,26 +909,45 @@ def disarm_tracer() -> None:
 
 
 def reset() -> None:
-    """Drop the process-wide registry and tracer (bench/test arm
-    isolation). Handles cached by live schedulers/pools keep working
-    against the detached objects."""
-    global _REGISTRY, _TRACER, _ARMED
+    """Drop the process-wide registry, tracer, and request-trace book
+    (bench/test arm isolation). Handles cached by live
+    schedulers/pools keep working against the detached objects."""
+    global _REGISTRY, _TRACER, _TRACES, _ARMED
     with _STATE_LOCK:
         _REGISTRY = None
         _TRACER = None
+        _TRACES = None
         _ARMED = 0
 
 
-def export_chrome(path: str, tracer_obj: Optional[Tracer] = None):
-    """Write the current (or given) tracer's ring as a Chrome-trace
-    JSON file; returns the path, or None when no tracer ever existed.
-    Reads ``_TRACER`` directly (not :func:`tracer`) so a just-closed
-    profiler window can still export its spans."""
+def chrome_payload(tracer_obj: Optional[Tracer] = None,
+                   traces: Optional[RequestTraceBook] = None
+                   ) -> Optional[dict]:
+    """The unified Chrome-trace dict: the span ring PLUS one lane per
+    request from the trace book (tid = lane id, named "req <id>" via
+    thread_name metadata). Either side may be absent; None when
+    neither ever existed."""
     tr = tracer_obj if tracer_obj is not None else _TRACER
-    if tr is None:
+    book = traces if traces is not None else _TRACES
+    if tr is None and book is None:
+        return None
+    return _chrome_doc(
+        [s.to_dict() for s in tr.spans()] if tr is not None else [],
+        book.to_jsonl_records() if book is not None else [])
+
+
+def export_chrome(path: str, tracer_obj: Optional[Tracer] = None,
+                  traces: Optional[RequestTraceBook] = None):
+    """Write the unified Chrome-trace JSON (span ring + per-request
+    lanes when a trace book exists) to ``path``; returns the path, or
+    None when neither a tracer nor a book ever existed. Reads the
+    module singletons directly (not :func:`tracer`) so a just-closed
+    profiler window can still export its spans."""
+    payload = chrome_payload(tracer_obj, traces)
+    if payload is None:
         return None
     with open(path, "w") as f:
-        json.dump(tr.to_chrome(), f, default=str)
+        json.dump(payload, f, default=str)
     return path
 
 
@@ -527,6 +979,42 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
      "prompt tokens served from the prefix cache at admission"),
     ("serving.requests_admitted", "counter", "requests admitted"),
     ("serving.requests_finished", "counter", "requests retired"),
+    ("serving.step_wall_s", "histogram",
+     "wall time of one scheduler step (epoch-stamped; the decode-"
+     "stall watchdog windows over it)"),
+    ("serving.step_epoch", "gauge",
+     "current scheduler step epoch (the window key of every "
+     "windowed view)"),
+    ("serving.uptime_s", "gauge",
+     "wall seconds since scheduler construction"),
+    ("serving.steps_per_s", "gauge", "steps / uptime"),
+    ("serving.active_requests", "gauge", "requests mid-generation"),
+    ("serving.queued_requests", "gauge", "requests awaiting admission"),
+    ("serving.retired_requests", "gauge", "requests finished so far"),
+    ("serving.compile_count", "gauge",
+     "the model's distinct compiled ragged programs "
+     "(adapter.compile_count; the recompile-storm watchdog's "
+     "serving-side signal)"),
+    ("serving.admit_reject_pool", "counter",
+     "admission refusals on page-pool capacity (head-of-queue "
+     "blocked after any eviction attempt)"),
+    ("serving.admit_reject_draft_pool", "counter",
+     "admission refusals on the DRAFT adapter's pool capacity"),
+    ("serving.admit_evict_then_admit", "counter",
+     "admissions that succeeded only after evicting unpinned "
+     "prefix-cache chains"),
+    ("serving.goodput", "gauge",
+     "fraction of requests retired in the trailing "
+     "FLAGS_telemetry_window epochs meeting ALL configured SLOs "
+     "(SLOConfig; the admission-control signal)"),
+    ("serving.slo_attain_ttft", "gauge",
+     "windowed fraction of retired requests meeting the TTFT SLO"),
+    ("serving.slo_attain_tpot", "gauge",
+     "windowed fraction meeting the per-request p99 TPOT SLO"),
+    ("serving.slo_attain_queue_wait", "gauge",
+     "windowed fraction meeting the queue-wait SLO"),
+    ("serving.slo_window_requests", "gauge",
+     "retired requests inside the SLO window right now"),
     # KV page pool (incubate/nn/paged_cache.py)
     ("pool.cow_forks", "counter",
      "copy-on-write page forks (summed across layer pools)"),
@@ -538,6 +1026,9 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
     ("pool.utilization", "gauge", "1 - free/total"),
     ("pool.shared_pages", "gauge", "pages with refcount > 1"),
     ("pool.used_bytes", "gauge", "HBM bytes of in-use pages"),
+    ("pool.peak_utilization", "gauge",
+     "high watermark: max fraction of pages ever simultaneously in "
+     "use (peak_used_pages summed across layer pools)"),
     # prefix cache (inference/prefix_cache.py)
     ("prefix.hits", "counter", "prompt lookups that matched"),
     ("prefix.misses", "counter", "prompt lookups that missed"),
@@ -551,11 +1042,22 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
     ("prefix.cached_pages", "gauge",
      "tree-held page references (summed across layers)"),
     ("prefix.nodes", "gauge", "radix nodes in the tree"),
+    ("prefix.hit_frac", "histogram",
+     "per-lookup hit fraction (matched/looked-up tokens, epoch-"
+     "stamped — the prefix-collapse watchdog windows over it)"),
     # compile path (jit/api.py)
     ("compile.count", "counter",
      "to_static trace/lower events (recompile-storm visibility)"),
     ("compile.wall_s", "histogram",
      "wall time per to_static trace+lower (lint included)"),
+    ("compile.by_program.<name>", "counter",
+     "to_static trace/lower events per program (storm attribution)"),
+    # sanitizer mirror (published by the scheduler's watchdog stride)
+    ("sanitizer.events", "gauge",
+     "page-sanitizer events recorded (summed across pools)"),
+    ("sanitizer.violations", "gauge",
+     "page-sanitizer violations recorded (the sanitizer-spike "
+     "watchdog's signal)"),
     # collective-matmul dispatch (ops/kernels/collective_matmul.py)
     ("collective.decomposed.<kind>", "counter",
      "ring decompositions taken, by dispatch kind "
@@ -579,43 +1081,154 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
 
 
 # ---------------------------------------------------------------------------
+# Prometheus text-format export
+# ---------------------------------------------------------------------------
+
+
+def _prom_name(raw: str) -> str:
+    """Sanitize a dotted metric name into a Prometheus identifier."""
+    s = "".join(ch if (ch.isalnum() or ch == "_") else "_"
+                for ch in raw)
+    return "_" + s if s[:1].isdigit() else s
+
+
+def _prom_val(v) -> str:
+    if v is None:
+        return "NaN"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def prometheus_text(snapshot: Optional[dict] = None,
+                    registry: Optional[MetricsRegistry] = None,
+                    prefix: str = "paddle") -> str:
+    """Render a registry snapshot in the Prometheus text exposition
+    format: counters (ints) as ``counter``, gauges (floats) as
+    ``gauge``, histograms as cumulative ``_bucket{le=...}`` series
+    (log2 upper bounds; bound 0 holds the non-positive observations)
+    plus ``_sum``/``_count`` and EXACT nearest-rank quantiles as a
+    sibling ``_quantile{quantile=...}`` gauge series (labelled
+    ``exactness="exact"`` while the reservoir has seen everything,
+    ``"windowed-exact"`` after rollover). Non-numeric leaves are
+    skipped. Jax-free by the module's host-only contract, so a
+    scraper-facing sidecar can render a box's state without touching
+    device runtime."""
+    if snapshot is None:
+        reg = registry if registry is not None else _REGISTRY
+        if reg is None:
+            return "# no telemetry registry (FLAGS_telemetry=off)\n"
+        snapshot = reg.snapshot()
+    lines = []
+    for ns in sorted(snapshot):
+        group = snapshot[ns]
+        if not isinstance(group, dict):
+            continue  # e.g. the "telemetry": "<mode>" marker
+        for key in sorted(group):
+            v = group[key]
+            name = _prom_name(f"{prefix}_{ns}_{key}")
+            if isinstance(v, dict) and "buckets" in v:
+                lines.append(f"# TYPE {name} histogram")
+                cum = 0
+                for ub, n in v.get("buckets") or []:
+                    cum += int(n)
+                    lines.append(
+                        f'{name}_bucket{{le="{float(ub):g}"}} {cum}')
+                lines.append(f'{name}_bucket{{le="+Inf"}} '
+                             f'{int(v.get("count") or 0)}')
+                lines.append(f"{name}_sum {_prom_val(v.get('sum'))}")
+                lines.append(f"{name}_count "
+                             f"{int(v.get('count') or 0)}")
+                exact = "exact" if v.get("exact", True) \
+                    else "windowed-exact"
+                for q, k in ((0.5, "p50"), (0.9, "p90"),
+                             (0.99, "p99")):
+                    if v.get(k) is not None:
+                        lines.append(
+                            f'{name}_quantile{{quantile="{q}",'
+                            f'exactness="{exact}"}} '
+                            f'{_prom_val(v[k])}')
+            elif isinstance(v, bool):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {int(v)}")
+            elif isinstance(v, int):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {v}")
+            elif isinstance(v, float):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {_prom_val(v)}")
+            # anything else (strings, lists, nested summaries) is
+            # not a scrapeable sample — skipped by design
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path: str,
+                     registry: Optional[MetricsRegistry] = None,
+                     snapshot: Optional[dict] = None,
+                     prefix: str = "paddle") -> str:
+    """Atomically (tmp + rename) write :func:`prometheus_text` to
+    ``path`` — the FLAGS_telemetry_export_path periodic snapshot the
+    scheduler refreshes every watchdog stride. A concurrent reader
+    never observes a torn file."""
+    text = prometheus_text(snapshot=snapshot, registry=registry,
+                           prefix=prefix)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
 # JSONL post-processing + CLI
 # ---------------------------------------------------------------------------
 
 
-def _load_jsonl(path: str):
-    spans, metrics = [], None
+def _load_jsonl(path: str) -> dict:
+    """Parse a telemetry JSONL dump into its record streams. A
+    malformed FINAL line that is missing its newline terminator is
+    tolerated (a killed process mid-write leaves exactly that) and
+    reported via ``"truncated"``; malformed content anywhere else —
+    including a garbage final line that IS newline-terminated —
+    still raises."""
+    out = {"spans": [], "metrics": None, "requests": [],
+           "watchdog": [], "truncated": False}
+    # streamed one line at a time (dumps can be tens of MB, never
+    # buffered whole). A malformed line missing its newline
+    # terminator can only be the file's LAST line — the torn
+    # mid-write cut that is tolerated; a newline-terminated
+    # malformed line is corruption and raises wherever it sits.
     with open(path) as f:
-        for ln, line in enumerate(f, 1):
-            line = line.strip()
+        for ln, raw in enumerate(f, 1):
+            line = raw.strip()
             if not line:
                 continue
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError as e:
+                if not raw.endswith("\n"):
+                    out["truncated"] = True
+                    continue
                 raise ValueError(
-                    f"{path}:{ln}: not a telemetry JSONL record ({e})")
-            if rec.get("type") == "span":
-                spans.append(rec)
-            elif rec.get("type") == "metrics":
-                metrics = rec.get("data") or {}
-    return spans, metrics
+                    f"{path}:{ln}: not a telemetry JSONL record "
+                    f"({e})")
+            kind = rec.get("type")
+            if kind == "span":
+                out["spans"].append(rec)
+            elif kind == "metrics":
+                out["metrics"] = rec.get("data") or {}
+            elif kind == "request":
+                out["requests"].append(rec)
+            elif kind == "watchdog_event":
+                out["watchdog"].append(rec)
+    return out
 
 
 def chrome_from_jsonl(path: str, out: str) -> str:
-    """Convert a dumped JSONL stream into a Chrome-trace JSON file."""
-    spans, _ = _load_jsonl(path)
-    spans.sort(key=lambda s: s.get("ts", 0.0))
-    base = spans[0].get("ts", 0.0) if spans else 0.0
-    pid = os.getpid()
-    events = [
-        _chrome_event(s.get("name", "?"), s.get("cat", "app"),
-                      s.get("tid", 0), s.get("ts", 0.0),
-                      s.get("dur", 0.0), s.get("args", {}),
-                      base, pid)
-        for s in spans]
+    """Convert a dumped JSONL stream into a Chrome-trace JSON file
+    (span events plus one lane per dumped request record)."""
+    loaded = _load_jsonl(path)
     with open(out, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"},
+        json.dump(_chrome_doc(loaded["spans"], loaded["requests"]),
                   f, default=str)
     return out
 
@@ -630,8 +1243,10 @@ def _fmt_val(v) -> str:
 
 def summarize_jsonl(path: str) -> str:
     """Aggregated span tree (count/total/avg/max, indented by nest
-    depth) plus the metrics table from the snapshot record."""
-    spans, metrics = _load_jsonl(path)
+    depth), the per-request trace and watchdog-event digests, plus
+    the metrics table from the snapshot record."""
+    loaded = _load_jsonl(path)
+    spans, metrics = loaded["spans"], loaded["metrics"]
     lines = []
     agg: Dict[str, list] = {}  # path -> [count, total, max]
     for s in spans:
@@ -678,6 +1293,33 @@ def summarize_jsonl(path: str) -> str:
             lines.append("counters / gauges")
             for name, v in plain:
                 lines.append(f"{name[:43]:<44}{_fmt_val(v):>12}")
+    if loaded["requests"]:
+        lines.append("")
+        lines.append(f"request traces ({len(loaded['requests'])})")
+        lines.append(f"{'request':<20}{'events':>8}{'tokens':>8}"
+                     f"{'wall_ms':>10}  terminal")
+        for rec in loaded["requests"]:
+            evs = rec.get("events") or []
+            toks = sum(1 for e in evs if e.get("kind") == "token")
+            wall = (evs[-1]["t"] - evs[0]["t"]) * 1e3 if evs else 0.0
+            term = evs[-1]["kind"] if (
+                evs and rec.get("done")) else "(active)"
+            lines.append(
+                f"{str(rec.get('req_id', '?'))[:19]:<20}"
+                f"{len(evs):>8}{toks:>8}{wall:>10.3f}  {term}")
+    if loaded["watchdog"]:
+        lines.append("")
+        lines.append(f"watchdog events ({len(loaded['watchdog'])})")
+        for rec in loaded["watchdog"]:
+            lines.append(
+                f"  epoch {rec.get('epoch', '?'):>6}  "
+                f"{rec.get('class', '?'):<18}"
+                f"{json.dumps(rec.get('detail', {}), default=str)[:60]}")
+    if loaded["truncated"]:
+        lines.append("")
+        lines.append("note: final JSONL line was truncated "
+                     "(no newline terminator — the writing process "
+                     "was likely killed mid-write); it was ignored")
     return "\n".join(lines)
 
 
@@ -695,19 +1337,41 @@ def main(argv=None) -> int:
                     default=None,
                     help="convert the JSONL stream to Chrome trace "
                     "JSON (chrome://tracing / Perfetto)")
+    ap.add_argument("--export-prom", metavar="TRACE_JSONL",
+                    default=None,
+                    help="render the dump's metrics snapshot in the "
+                    "Prometheus text exposition format (stdout, or "
+                    "--prom-out FILE)")
     ap.add_argument("-o", "--out", default=None,
                     help="output path for --export-chrome "
                     "(default: <input>.chrome.json)")
+    ap.add_argument("--prom-out", default=None,
+                    help="output path for --export-prom "
+                    "(default: print to stdout)")
     args = ap.parse_args(argv)
 
-    if args.summarize is None and args.export_chrome is None:
-        ap.error("pass --summarize and/or --export-chrome")
+    if args.summarize is None and args.export_chrome is None \
+            and args.export_prom is None:
+        ap.error("pass --summarize, --export-chrome and/or "
+                 "--export-prom")
     if args.summarize is not None:
         print(summarize_jsonl(args.summarize))
     if args.export_chrome is not None:
         out = args.out or (args.export_chrome + ".chrome.json")
         chrome_from_jsonl(args.export_chrome, out)
         print(f"wrote {out}")
+    if args.export_prom is not None:
+        snap = _load_jsonl(args.export_prom)["metrics"]
+        if snap is None:
+            ap.error(f"{args.export_prom} carries no metrics "
+                     "snapshot record (dump_jsonl with a registry)")
+        text = prometheus_text(snapshot=snap)
+        if args.prom_out:
+            with open(args.prom_out, "w") as f:
+                f.write(text)
+            print(f"wrote {args.prom_out}")
+        else:
+            print(text, end="")
     return 0
 
 

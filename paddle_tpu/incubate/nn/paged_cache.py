@@ -128,6 +128,10 @@ class PagedKVCacheManager:
         # owner's cooperation
         self._ext_refs = collections.Counter()
         self.cow_forks = 0  # lifetime count of copy-on-write forks
+        # high watermark: most pages ever simultaneously in use —
+        # pool.peak_utilization in BatchScheduler.metrics(), and the
+        # pool-pressure watchdog's capacity-planning evidence
+        self.peak_used_pages = 0
         # lifecycle sanitizer (page_sanitizer.py): 'off' is zero-cost
         # by constructing NOTHING — every instrumented method below
         # guards on `self._san is not None` only
@@ -271,6 +275,9 @@ class PagedKVCacheManager:
             raise RuntimeError("KV page pool exhausted")
         p = self._free.pop()
         self._refcnt[p] = 1
+        used = self.num_pages - len(self._free)
+        if used > self.peak_used_pages:
+            self.peak_used_pages = used
         if self._reg is not None:
             self._reg.inc("pool.page_allocs")
         if self.quantized:

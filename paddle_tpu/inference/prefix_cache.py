@@ -244,6 +244,12 @@ class RadixPrefixCache:
                 self._reg.inc("prefix.hit_tokens", matched)
             else:
                 self._reg.inc("prefix.misses")
+            # epoch-stamped per-lookup hit fraction: the windowed
+            # series the prefix-collapse watchdog compares against
+            # its trailing baseline (framework/watchdog.py)
+            if tokens:
+                self._reg.observe("prefix.hit_frac",
+                                  matched / len(tokens))
         return PrefixMatch(length=matched, chains=chains,
                            path=tuple(path))
 

@@ -71,6 +71,7 @@ rule bans direct ``time.*`` reads in this module.
 from __future__ import annotations
 
 import collections
+import warnings
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence
 
@@ -144,6 +145,12 @@ class Request:
     # only written when the scheduler's registry handle is live)
     _t_submit: float = 0.0
     _t_last_tok: float = 0.0
+    # per-request SLO measurements (set only under live metrics):
+    # TTFT, queue wait, and every inter-token gap — the inputs to
+    # SLOConfig.request_meets at retire
+    _ttft: Optional[float] = None
+    _qwait: Optional[float] = None
+    _gaps: Optional[List[float]] = None
 
     @property
     def finished(self) -> bool:
@@ -168,7 +175,7 @@ class BatchScheduler:
                  sampler=None, draft_model=None, draft_k=4,
                  prefix_cache=None, chunked_prefill=None,
                  prefill_chunk_tokens=None, serving_buckets=None,
-                 prefix_align=1):
+                 prefix_align=1, slo=None, watchdog=None):
         self.model = model
         self.max_batch_size = int(max_batch_size)
         self.page_watermark = float(page_watermark)
@@ -264,6 +271,67 @@ class BatchScheduler:
         # instrumented site below pays one `is None` check
         self._metrics = telemetry.registry()
         self._tracer = telemetry.tracer()
+        # per-request trace assembly (trace mode / armed profiler
+        # window): submit -> admit -> prefill chunks -> tokens ->
+        # retire timelines, bounded by FLAGS_telemetry_request_traces
+        self._traces = telemetry.request_traces()
+        # request-lifecycle accounting (PR 8): step-epoch window
+        # anchor, SLO/goodput window, watchdogs, periodic Prometheus
+        # export — ALL of it exists only under live metrics (off
+        # allocates nothing beyond these None handles).
+        # _step_epoch mirrors the REGISTRY-owned monotonic epoch (two
+        # schedulers share one stamp); _steps counts THIS scheduler's
+        # iterations (throughput + stride accounting)
+        self._step_epoch = 0
+        self._steps = 0
+        self._slo = None
+        self._slo_window = None
+        self._watchdog = None
+        self._export_path = None
+        self._t_start = 0.0
+        if self._metrics is None:
+            if slo is not None or watchdog is not None:
+                warnings.warn(
+                    "BatchScheduler got an explicit "
+                    + " and ".join(
+                        n for n, v in (("slo=", slo),
+                                       ("watchdog=", watchdog))
+                        if v is not None)
+                    + " but FLAGS_telemetry is off — no SLO "
+                    "accounting or watchdog checks will run (set "
+                    "FLAGS_telemetry=metrics|trace)",
+                    RuntimeWarning, stacklevel=2)
+        else:
+            self._t_start = telemetry.clock()
+            # join the shared stamp where it stands: trace events
+            # recorded before this scheduler's first step must not
+            # rewind behind samples other schedulers already stamped
+            self._step_epoch = self._metrics.epoch
+            self._win = max(1, int(flag("telemetry_window")))
+            cfg = slo if slo is not None \
+                else telemetry.SLOConfig.from_flag()
+            self._slo = cfg if cfg.enabled() else None
+            # (epoch, met_all, {slo: met}) per retired request,
+            # pruned to the trailing window at publish time, with
+            # running met-counts maintained on append/prune so every
+            # retire publishes in O(1) instead of re-summing the
+            # whole window on the latency-sensitive retire path
+            self._slo_window = collections.deque()
+            self._slo_met_all = 0
+            self._slo_met = collections.Counter()
+            wd_mode = str(flag("telemetry_watchdog")).lower()
+            if watchdog is not None:
+                self._watchdog = watchdog
+            elif wd_mode in ("warn", "strict"):
+                from ..framework.watchdog import Watchdog
+
+                self._watchdog = Watchdog(self._metrics,
+                                          mode=wd_mode,
+                                          window=self._win)
+            self._wd_stride = max(
+                1, int(flag("telemetry_watchdog_stride")))
+            self._export_path = \
+                str(flag("telemetry_export_path")) or None
 
     # -- pool accounting ---------------------------------------------------
     def _pool(self, model=None):
@@ -346,30 +414,80 @@ class BatchScheduler:
         * ``sanitizer`` — event/violation counters when a sanitizer
           is live.
 
+        Plus, since PR 8: self-describing ``serving`` gauges (uptime,
+        steps/sec, active/queued/retired request counts), SLO/goodput
+        attainment when an :class:`telemetry.SLOConfig` is configured,
+        sliding-window percentile views (``"window"`` sub-dict on
+        each latency histogram, keyed by step epoch), and — when live
+        — ``watchdog`` and ``request_traces`` digests.
+
         Returns ``{"telemetry": "off"}`` when FLAGS_telemetry was off
         at scheduler construction (nothing was ever recorded)."""
         if self._metrics is None:
             return {"telemetry": "off"}
         m = self._metrics
-        # ONE source of truth for the aggregation: the legacy-shape
-        # snapshot computes the pool/prefix/sanitizer sums, and the
-        # gauges here are those same numbers published into the
-        # registry (the shapes cannot drift)
-        stats = self.page_pool_stats()
-        for key in ("total_pages", "free_pages", "utilization",
-                    "shared_pages", "used_bytes"):
-            m.gauge("pool." + key, stats[key])
-        tree = stats.get("prefix_cache", {}).get("tree")
-        if tree is not None:
-            m.gauge("prefix.cached_tokens", tree["cached_tokens"])
-            m.gauge("prefix.cached_pages", tree["cached_pages"])
-            m.gauge("prefix.nodes", tree["nodes"])
+        stats = self._publish_gauges()
         snap = m.snapshot()
         snap["telemetry"] = ("trace" if self._tracer is not None
                              else "metrics")
         if "sanitizer" in stats:
             snap["sanitizer"] = stats["sanitizer"]
+        # sliding-window percentile views, windowed by step epoch —
+        # the deterministic "last N steps" read the SLO layer and the
+        # admission controller consume (full-history summaries stay)
+        lo = self._step_epoch - self._win
+        for name in ("ttft_s", "tpot_s", "queue_wait_s",
+                     "step_wall_s"):
+            w = m.hist_windowed("serving." + name, lo)
+            if w is not None and name in snap.get("serving", {}):
+                snap["serving"][name]["window"] = w
+        if self._slo is not None:
+            snap["slo"] = self._slo.to_dict()
+        if self._watchdog is not None:
+            snap["watchdog"] = self._watchdog.summary()
+        if self._traces is not None:
+            snap["request_traces"] = self._traces.summary()
         return snap
+
+    def _publish_gauges(self) -> dict:
+        """Publish every derived gauge into the registry and return
+        the legacy-shape stats dict. ONE source of truth for the
+        aggregation: the ``page_pool_stats()`` snapshot computes the
+        pool/prefix/sanitizer sums, and the gauges here are those
+        same numbers published into the registry (the shapes cannot
+        drift)."""
+        m = self._metrics
+        stats = self.page_pool_stats()
+        for key in ("total_pages", "free_pages", "utilization",
+                    "shared_pages", "used_bytes"):
+            m.gauge("pool." + key, stats[key])
+        peak = sum(getattr(c, "peak_used_pages", 0)
+                   for c in self.model.caches)
+        m.gauge("pool.peak_utilization",
+                peak / max(stats["total_pages"], 1))
+        tree = stats.get("prefix_cache", {}).get("tree")
+        if tree is not None:
+            m.gauge("prefix.cached_tokens", tree["cached_tokens"])
+            m.gauge("prefix.cached_pages", tree["cached_pages"])
+            m.gauge("prefix.nodes", tree["nodes"])
+        san = stats.get("sanitizer")
+        if san is not None:
+            m.gauge("sanitizer.events", san["events"])
+            m.gauge("sanitizer.violations", san["violations"])
+        # self-describing serving gauges (ISSUE 8 satellite): the
+        # snapshot carries its own uptime/throughput/population so a
+        # reader needs no bench context; step()'s counters remain the
+        # aliases
+        uptime = telemetry.clock() - self._t_start
+        m.gauge("serving.uptime_s", uptime)
+        m.gauge("serving.steps_per_s",
+                self._steps / uptime if uptime > 0 else 0.0)
+        m.gauge("serving.step_epoch", self._step_epoch)
+        m.gauge("serving.active_requests", len(self._active))
+        m.gauge("serving.queued_requests", len(self._queue))
+        m.gauge("serving.retired_requests", len(self._finished))
+        self._publish_slo_gauges()
+        return stats
 
     def _sanitizer_epoch(self):
         """Every FLAGS_page_sanitizer_stride steps: cross-check each
@@ -421,6 +539,11 @@ class BatchScheduler:
             )
         if self._metrics is not None:
             req._t_submit = telemetry.clock()
+        if self._traces is not None:
+            self._traces.begin(
+                req.req_id, telemetry.clock(), self._step_epoch,
+                prompt_tokens=len(req.prompt_ids),
+                max_new_tokens=req.max_new_tokens)
         self._queue.append(req)
         return req.req_id
 
@@ -460,6 +583,7 @@ class BatchScheduler:
             # so subtract usage double-counted inside reservations)
             used = total - free
             projected = used + self._reserved_pages_outstanding() + need
+            evicted = False
             if (projected > self.page_watermark * total
                     and self.prefix_cache is not None):
                 # cached pages count as "used": reclaim unpinned
@@ -467,6 +591,7 @@ class BatchScheduler:
                 deficit = int(np.ceil(
                     projected - self.page_watermark * total))
                 if self.prefix_cache.evict(deficit):
+                    evicted = True
                     total, free = self._pool()
                     used = total - free
                     projected = (used
@@ -475,6 +600,12 @@ class BatchScheduler:
             if projected > self.page_watermark * total:
                 if hit_len:
                     self.prefix_cache.unpin(hit.path)
+                # admission-side failure accounting (ISSUE 8): a
+                # pool-capacity reject is ITS OWN signal — the future
+                # admission controller must distinguish "the pool is
+                # full" from "we made room by evicting cached pages"
+                if self._metrics is not None:
+                    self._metrics.inc("serving.admit_reject_pool")
                 return hit_tokens_admitted
             if self.draft is not None:
                 # the draft pool is budgeted too (it may be sized
@@ -489,6 +620,9 @@ class BatchScheduler:
                             for r in self._active.values())
                 if max(out_d, used_d) + need_d > \
                         self.page_watermark * total_d:
+                    if self._metrics is not None:
+                        self._metrics.inc(
+                            "serving.admit_reject_draft_pool")
                     return hit_tokens_admitted
             self._queue.popleft()
             self._match_memo = None
@@ -518,10 +652,18 @@ class BatchScheduler:
             req.state = RequestState.PREFILL
             self._active[req.req_id] = req
             if self._metrics is not None:
-                self._metrics.observe(
-                    "serving.queue_wait_s",
-                    telemetry.clock() - req._t_submit)
+                req._qwait = telemetry.clock() - req._t_submit
+                self._metrics.observe("serving.queue_wait_s",
+                                      req._qwait)
                 self._metrics.inc("serving.requests_admitted")
+                if evicted:
+                    self._metrics.inc(
+                        "serving.admit_evict_then_admit")
+            if self._traces is not None:
+                self._traces.event(
+                    req.req_id, "admit", telemetry.clock(),
+                    self._step_epoch, prefix_hit_tokens=hit_len,
+                    evicted_for_room=evicted)
         return hit_tokens_admitted
 
     def _reserved_pages_outstanding(self) -> int:
@@ -576,16 +718,24 @@ class BatchScheduler:
         record the inter-token gap (TPOT). Speculative rounds commit
         bursts, so their intra-round TPOT is near zero by design —
         that IS the latency the client observes."""
+        if self._traces is not None:
+            self._traces.event(
+                req.req_id, "token", telemetry.clock(),
+                self._step_epoch, token=req.generated_ids[-1],
+                n=len(req.generated_ids))
         if self._metrics is None:
             return
         self._metrics.inc("serving.generated_tokens")
         now = telemetry.clock()
         if len(req.generated_ids) == 1:
-            self._metrics.observe("serving.ttft_s",
-                                  now - req._t_submit)
+            req._ttft = now - req._t_submit
+            self._metrics.observe("serving.ttft_s", req._ttft)
         else:
-            self._metrics.observe("serving.tpot_s",
-                                  now - req._t_last_tok)
+            gap = now - req._t_last_tok
+            self._metrics.observe("serving.tpot_s", gap)
+            if req._gaps is None:
+                req._gaps = []
+            req._gaps.append(gap)
         req._t_last_tok = now
 
     def _retire(self, req: Request):
@@ -594,10 +744,68 @@ class BatchScheduler:
         t0 = telemetry.clock() if self._metrics is not None else 0.0
         with self._span("serving.retire", req=req.req_id):
             self._retire_impl(req)
+        met = None
         if self._metrics is not None:
             self._metrics.observe("serving.retire_s",
                                   telemetry.clock() - t0)
             self._metrics.inc("serving.requests_finished")
+            met = self._slo_note_retire(req)
+        if self._traces is not None:
+            self._traces.complete(
+                req.req_id, "retire", telemetry.clock(),
+                self._step_epoch,
+                generated_tokens=len(req.generated_ids),
+                prefix_hit_tokens=req._prefix_hit,
+                slo_met=met)
+
+    def _slo_note_retire(self, req: Request):
+        """Per-request SLO verdicts at retire: record the request in
+        the goodput window (epoch-keyed) and republish the attainment
+        gauges. Returns the per-SLO verdict dict (None when no SLO is
+        configured)."""
+        if self._slo is None:
+            return None
+        met = self._slo.request_meets(
+            req._ttft,
+            telemetry.SLOConfig.p99(req._gaps or []),
+            req._qwait)
+        ok = all(met.values())
+        self._slo_window.append((self._step_epoch, ok, met))
+        self._slo_met_all += ok
+        for key, v in met.items():
+            self._slo_met[key] += v
+        self._publish_slo_gauges()
+        return met
+
+    def _publish_slo_gauges(self):
+        """Prune the goodput window to the trailing step epochs and
+        publish serving.goodput + per-SLO attainment — the exact
+        numbers the future admission controller gates on. An EMPTY
+        window (nothing retired recently) republishes goodput 1.0
+        with slo_window_requests 0, so a stale miss never outlives
+        its window: consumers weigh the fraction by the population."""
+        if self._slo is None:
+            return
+        lo = self._step_epoch - self._win
+        win = self._slo_window
+        while win and win[0][0] < lo:
+            _, ok, met = win.popleft()
+            self._slo_met_all -= ok
+            for key, v in met.items():
+                self._slo_met[key] -= v
+        m = self._metrics
+        n = len(win)
+        m.gauge("serving.slo_window_requests", n)
+        if not win:
+            if m.gauge_value("serving.goodput") is not None:
+                m.gauge("serving.goodput", 1.0)
+                for key in self._slo.request_meets(None, None, None):
+                    m.gauge("serving.slo_attain_" + key, 1.0)
+            return
+        m.gauge("serving.goodput", self._slo_met_all / n)
+        for key in win[0][2]:
+            m.gauge("serving.slo_attain_" + key,
+                    self._slo_met[key] / n)
 
     def _retire_impl(self, req: Request):
         rid = req.req_id
@@ -630,7 +838,26 @@ class BatchScheduler:
         adapter's ragged-dispatch compile count). Under telemetry the
         whole iteration is a ``serving.step`` span and the counters
         also land in the ``serving.*`` registry namespace
-        (:meth:`metrics`)."""
+        (:meth:`metrics`); every ``FLAGS_telemetry_watchdog_stride``
+        steps the gauges refresh, the watchdog detectors run, and
+        the Prometheus snapshot (``FLAGS_telemetry_export_path``)
+        rewrites."""
+        t0 = 0.0
+        if self._metrics is not None:
+            # advance the epoch FIRST: every observation this step
+            # lands (TTFT, gaps, step wall) is stamped with it — the
+            # deterministic window key of the SLO/watchdog layer.
+            # The registry owns the counter (monotonic, shared), so a
+            # second scheduler never rewinds this one's windows
+            self._step_epoch = self._metrics.advance_epoch()
+            self._steps += 1
+            t0 = telemetry.clock()
+        elif self._traces is not None:
+            # an armed profiler window with FLAGS_telemetry=off still
+            # collects request traces — the epoch must advance so the
+            # dumped events correlate to steps instead of all
+            # stamping 0
+            self._step_epoch += 1
         with self._span("serving.step"):
             ev = self._step_impl()
         if self._metrics is not None:
@@ -641,7 +868,67 @@ class BatchScheduler:
             m.inc("serving.decode_tokens", ev.get("decode_tokens", 0))
             m.inc("serving.prefix_hit_tokens",
                   ev.get("prefix_hit_tokens", 0))
+            m.observe("serving.step_wall_s", telemetry.clock() - t0)
+            cc = getattr(self.model, "compile_count", None)
+            if cc is not None:
+                m.gauge("serving.compile_count", cc)
+            # stride on THIS scheduler's own step count: with two
+            # schedulers interleaving, the shared epoch advances by 2
+            # per iteration and `epoch % stride` could starve one of
+            # them forever
+            if self._steps % self._wd_stride == 0:
+                self._observability_epoch()
         return ev
+
+    def _observability_epoch(self):
+        """The watchdog-stride housekeeping pass: refresh the
+        pool/prefix/sanitizer/serving gauges, run the watchdog
+        detectors (read-only; evidence like the sanitizer journal
+        tail is gathered HERE, through public pool API, and handed
+        in), and rewrite the Prometheus export file."""
+        self._publish_gauges()
+        if self._watchdog is not None:
+            context = {}
+            # THIS scheduler's own adapter program count — the shared
+            # serving.compile_count gauge is last-writer-wins across
+            # schedulers, so the storm detector needs the per-caller
+            # series handed in
+            cc = getattr(self.model, "compile_count", None)
+            if cc is not None:
+                context["compile_count"] = cc
+            # evidence for a sanitizer-spike event: the journal tail
+            # of the pool that actually recorded the most violations,
+            # searched across EVERY cache (draft included) — not just
+            # layer 0's
+            caches = list(self.model.caches) + (
+                list(self.draft.caches)
+                if self.draft is not None else [])
+            worst, worst_n = None, 0
+            for c in caches:
+                san = getattr(c, "sanitizer", None)
+                if san is None:
+                    continue
+                n = san.stats().get("violations", 0)
+                if n > worst_n:
+                    worst, worst_n = san, n
+            if worst is not None:
+                context["sanitizer_journal_tail"] = worst.tail(16)
+            self._watchdog.check(self._step_epoch,
+                                 context=context or None)
+        if self._export_path is not None:
+            # a scrape-file failure must never take down serving:
+            # warn once and stop trying (the observability layer may
+            # not perturb the hot path)
+            try:
+                telemetry.write_prometheus(self._export_path,
+                                           registry=self._metrics)
+            except OSError as e:
+                warnings.warn(
+                    "FLAGS_telemetry_export_path "
+                    f"({self._export_path!r}) is unwritable: {e}; "
+                    "disabling the periodic Prometheus export",
+                    RuntimeWarning)
+                self._export_path = None
 
     def _step_impl(self) -> dict:
         self._sanitizer_epoch()
@@ -685,6 +972,12 @@ class BatchScheduler:
                 if req.state == RequestState.PREFILL:
                     tok = req.prompt_ids[req._pos]
                     req._pos += 1
+                    if self._traces is not None:
+                        # token-per-step prefill is a 1-token chunk
+                        self._traces.event(
+                            req.req_id, "prefill_chunk",
+                            telemetry.clock(), self._step_epoch,
+                            tokens=1, pos=req._pos)
                     if req.on_token is not None:
                         req.on_token(req, tok, True)
                     if req._pos == len(req.prompt_ids):
@@ -759,6 +1052,10 @@ class BatchScheduler:
         a custom sampler is rejected at construction). Returns 1 if
         the request retired."""
         req._pos += len(toks)
+        if self._traces is not None:
+            self._traces.event(
+                req.req_id, "prefill_chunk", telemetry.clock(),
+                self._step_epoch, tokens=len(toks), pos=req._pos)
         if req.on_token is not None:
             for t in toks:
                 req.on_token(req, t, True)
@@ -891,6 +1188,11 @@ class BatchScheduler:
                 req = self._active[s]
                 tok = req.prompt_ids[req._pos]
                 req._pos += 1
+                if self._traces is not None:
+                    self._traces.event(
+                        req.req_id, "prefill_chunk",
+                        telemetry.clock(), self._step_epoch,
+                        tokens=1, pos=req._pos)
                 if req.on_token is not None:
                     req.on_token(req, tok, True)
                 if req._pos == len(req.prompt_ids):

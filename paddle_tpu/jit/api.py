@@ -481,6 +481,10 @@ class StaticFunction:
             lint_counts = report.counts() if report is not None else {}
             if _reg is not None:
                 _reg.inc("compile.count")
+                # per-program attribution: when the recompile-storm
+                # watchdog fires, the by_program counters in its
+                # event snapshot name the offender
+                _reg.inc("compile.by_program." + str(prog))
                 _reg.observe("compile.wall_s", dur)
             if _tr is not None:
                 _tr.add_complete(

@@ -240,9 +240,11 @@ def _default_state_scheduler(step: int) -> ProfilerState:
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
     """on_trace_ready callable: writes the unified telemetry span ring
     (RecordEvent ranges + any serving/compile spans collected in the
-    window) as a real Chrome-trace JSON file under ``dir_name`` —
-    loadable in chrome://tracing / Perfetto. The XPlane trace XLA
-    collects (non-timer_only runs) lands in the same directory for
+    window, plus one named LANE per serving request when the
+    request-trace book collected any — telemetry.RequestTraceBook)
+    as a real Chrome-trace JSON file under ``dir_name`` — loadable in
+    chrome://tracing / Perfetto. The XPlane trace XLA collects
+    (non-timer_only runs) lands in the same directory for
     TensorBoard."""
 
     def handle(prof):

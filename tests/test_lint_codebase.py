@@ -186,6 +186,95 @@ class TestClockDiscipline:
         assert lint_codebase.check_clock_discipline() == []
 
 
+class TestWatchdogReadOnly:
+    """Watchdog read-only discipline (ISSUE 8): detector code may
+    only READ the telemetry registry — no registry mutators, no
+    pool-private calls, no pool state writes."""
+
+    def test_seeded_registry_mutators_flagged(self):
+        bad = (
+            "def check(self, epoch):\n"
+            "    self.registry.inc('serving.steps')\n"
+            "    self.registry.gauge('pool.utilization', 1.0)\n"
+            "    self.registry.observe('serving.ttft_s', 0.1)\n"
+            "    self.registry.set_epoch(epoch)\n"
+        )
+        v = lint_codebase.lint_watchdog_file(
+            "fake/watchdog.py", text=bad)
+        rules = "\n".join(v)
+        assert len(v) == 4, v
+        assert ".inc(...)" in rules
+        assert ".gauge(...)" in rules
+        assert ".observe(...)" in rules
+        assert ".set_epoch(...)" in rules
+        assert "READ" in rules
+
+    def test_seeded_pool_private_call_flagged(self):
+        bad = (
+            "def check(self, epoch, pool):\n"
+            "    pool._release_page(3)\n"
+            "    return pool._padded_kernel_inputs()\n"
+        )
+        v = lint_codebase.lint_watchdog_file(
+            "fake/watchdog.py", text=bad)
+        assert len(v) == 2, v
+        assert "pool-private ._release_page()" in v[0]
+
+    def test_seeded_pool_state_write_flagged(self):
+        bad = (
+            "def check(self, epoch, pool):\n"
+            "    pool._refcnt[3] = 0\n"
+            "    pool.k_pages = None\n"
+            "    pool._lens['s'] += 1\n"
+        )
+        v = lint_codebase.lint_watchdog_file(
+            "fake/watchdog.py", text=bad)
+        rules = "\n".join(v)
+        assert len(v) == 3, v
+        assert "._refcnt" in v[0]
+        assert ".k_pages" in v[1]
+        assert "._lens" in v[2]
+        assert "registry-READ-ONLY" in rules
+
+    def test_reads_and_internal_state_clean(self):
+        text = (
+            "import collections\n"
+            "def check(self, epoch):\n"
+            "    n = self.registry.counter('compile.count')\n"
+            "    u = self.registry.gauge_value('pool.utilization')\n"
+            "    s = self.registry.hist_samples('serving.x')\n"
+            "    snap = self.registry.snapshot()\n"
+            "    self.events.append({'n': n, 'u': u})\n"
+            "    self.counts['x'] = self.counts.get('x', 0) + 1\n"
+            "    return s, snap\n"
+        )
+        assert lint_codebase.lint_watchdog_file(
+            "fake/watchdog.py", text=text) == []
+
+    def test_waiver_comment_suppresses(self):
+        text = (
+            "def check(self, epoch):\n"
+            "    self.registry.inc('x')"
+            "  # trace-lint: ok(test waiver)\n"
+        )
+        assert lint_codebase.lint_watchdog_file(
+            "fake/watchdog.py", text=text) == []
+
+    def test_watchdog_module_is_covered_and_clean(self):
+        assert any(
+            f.endswith(os.path.join("framework", "watchdog.py"))
+            for f in lint_codebase.WATCHDOG_FILES)
+        # the real module passes its own rule AND the host-only rule
+        assert lint_codebase.check_watchdog_readonly() == []
+        assert any(
+            f.endswith(os.path.join("framework", "watchdog.py"))
+            for f in lint_codebase.HOST_ONLY_FILES)
+
+    def test_rule_inventory_has_watchdog_rule(self):
+        ids = [r for r, _ in lint_codebase.RULES]
+        assert "watchdog-read-only" in ids
+
+
 class TestOpTableMessages:
     """The small-fix satellite: undeclared/waiver failures must name
     the offending module and the nearest registered op."""

@@ -480,6 +480,7 @@ class TestCLI:
         payload = json.loads(capsys.readouterr().out)
         groups = payload["static_checks"]
         assert set(groups) == {"jaxpr", "page_sanitizer",
-                               "codebase_lint", "telemetry"}
+                               "codebase_lint", "telemetry",
+                               "watchdog"}
         assert {r["rule_id"] for r in groups["page_sanitizer"]} \
             == set(VIOLATIONS)

@@ -2,10 +2,15 @@
 math, span nesting + ring rollover + Chrome export validity, off-mode
 zero allocation, scheduler TTFT/TPOT correctness against a
 hand-stepped fake clock, the module CLI round trip, and the legacy
-profiler bridge."""
+profiler bridge. PR 8 adds the request-lifecycle layer: epoch-windowed
+views, SLO/goodput exactness under the fake clock, per-request trace
+completeness across the chunked-prefill / prefix-hit / spec-decode
+paths, one seeded trigger per watchdog class (framework/watchdog.py),
+the Prometheus export surface, and truncated-JSONL tolerance."""
 import json
 import random
 import tracemalloc
+import warnings
 
 import numpy as np
 import pytest
@@ -13,6 +18,11 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.framework import telemetry
 from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.framework.watchdog import (
+    WATCHDOG_CLASSES,
+    Watchdog,
+    WatchdogError,
+)
 from paddle_tpu.inference import BatchScheduler, Request
 
 
@@ -62,13 +72,22 @@ class _FakeCache:
     def seq_len(self, s):
         return self.lens[s]
 
+    def truncate(self, s, n):
+        self.lens[s] = n
+
+    def attach(self, s, pages, length):
+        self.lens[s] = int(length)
+
+    def seq_pages(self, s):
+        return []
+
 
 class _FakeModel:
     """Deterministic token-per-step decoder: always emits token 1."""
 
-    def __init__(self, vocab=16):
+    def __init__(self, vocab=16, num_pages=1024):
         self.vocab = vocab
-        self.caches = [_FakeCache()]
+        self.caches = [_FakeCache(num_pages=num_pages)]
 
     def alloc(self, sid):
         self.caches[0].lens[sid] = 0
@@ -83,6 +102,85 @@ class _FakeModel:
         logits = np.zeros((len(sids), self.vocab), np.float32)
         logits[:, 1] = 1.0
         return logits
+
+
+class _L:
+    """Tensor-shaped wrapper (the spec scheduler reads ._data)."""
+
+    def __init__(self, data):
+        self._data = data
+
+
+class _FakeChunkModel(_FakeModel):
+    """Ragged chunked-prefill + spec-decode fake: implements
+    prefill_chunk and decode_window on host arrays, always emitting
+    token 1 (so draft and target agree and every proposal is
+    accepted)."""
+
+    def prefill_chunk(self, feeds, rows, starts, pad_to=None):
+        c = self.caches[0]
+        for s, f in zip(rows, feeds):
+            c.lens[s] += len(f)
+        logits = np.zeros((len(rows), self.vocab), np.float32)
+        logits[:, 1] = 1.0
+        return logits
+
+    def decode_token(self, feed, sids):
+        return _L(super().decode_token(feed, sids))
+
+    def decode_window(self, windows, sids):
+        c = self.caches[0]
+        w = windows.shape[1]
+        for s in sids:
+            c.lens[s] += w
+        logits = np.zeros((len(sids), w, self.vocab), np.float32)
+        logits[:, :, 1] = 1.0
+        return _L(logits)
+
+
+class _StubPrefixCache:
+    """Minimal prefix-cache stand-in (host-only): a fixed-length hit
+    for every prompt, optional evict-to-make-room behaviour against
+    a planted 'cached' sequence in the pool."""
+
+    def __init__(self, caches, hit_len=4, evictable_seq=None):
+        self.caches = caches
+        self.hit_len = hit_len
+        self.evictable_seq = evictable_seq
+        self.mutations = 0
+        self.evictions = 0
+
+    def match(self, tokens, limit=None, align=1):
+        from paddle_tpu.inference.prefix_cache import PrefixMatch
+
+        n = min(self.hit_len,
+                limit if limit is not None else len(tokens))
+        n = max(n, 0)
+        pages = -(-n // self.caches[0].page_size) if n else 0
+        return PrefixMatch(
+            length=n, chains=[[0] * pages for _ in self.caches],
+            path=("stub",) if n else ())
+
+    def pin(self, path):
+        pass
+
+    def unpin(self, path):
+        pass
+
+    def insert(self, toks, chains):
+        return 0
+
+    def evict(self, deficit):
+        if self.evictable_seq is not None \
+                and self.evictable_seq in self.caches[0].lens:
+            del self.caches[0].lens[self.evictable_seq]
+            self.evictions += 1
+            self.mutations += 1
+            return deficit
+        return 0
+
+    def summary(self):
+        return {"cached_tokens": 0, "cached_pages": 0, "nodes": 0}
 
 
 # -- histograms --------------------------------------------------------------
@@ -381,6 +479,804 @@ class TestInventory:
         ids = {r["rule_id"] for r in inv["telemetry"]}
         assert {"serving.ttft_s", "serving.tpot_s", "pool.cow_forks",
                 "compile.count", "collective.ring_chunks",
-                "span:serving.prefill_chunk"} <= ids
+                "span:serving.prefill_chunk", "serving.goodput",
+                "serving.admit_reject_pool",
+                "pool.peak_utilization"} <= ids
         kinds = {r["severity"] for r in inv["telemetry"]}
         assert kinds <= {"counter", "gauge", "histogram", "span"}
+
+    def test_rules_inventory_lists_watchdog_classes(self, tel_off):
+        from paddle_tpu.framework.analysis import (
+            static_check_inventory,
+        )
+
+        inv = static_check_inventory()
+        ids = {r["rule_id"] for r in inv["watchdog"]}
+        assert ids == {cls for cls, _ in WATCHDOG_CLASSES}
+        assert len(WATCHDOG_CLASSES) == 5
+
+
+# -- epoch-windowed views -----------------------------------------------------
+
+
+class TestWindowedViews:
+    def test_histogram_windowed_by_epoch(self, tel_off):
+        h = telemetry.Histogram(samples=256)
+        for e in range(1, 11):
+            h.observe(float(e), epoch=e)
+        # full-history vs window [6, 10]
+        assert h.percentile(50) == 5.0
+        assert h.percentile(50, min_epoch=6) == 8.0
+        w = h.windowed(6)
+        assert w["count"] == 5
+        assert w["min"] == 6.0 and w["max"] == 10.0
+        assert w["p99"] == 10.0 and w["from_epoch"] == 6
+        assert h.windowed(99)["count"] == 0
+        assert h.windowed(99)["p50"] is None
+
+    def test_registry_stamps_current_epoch(self, tel_off):
+        r = telemetry.MetricsRegistry()
+        r.observe("serving.x", 1.0)
+        r.set_epoch(7)
+        r.observe("serving.x", 2.0)
+        assert r.hist_samples("serving.x") == [(0, 1.0), (7, 2.0)]
+        assert r.hist_samples("serving.x", min_epoch=7) == [(7, 2.0)]
+        assert r.hist_samples("nope") == []
+
+
+# -- SLO config + goodput -----------------------------------------------------
+
+
+class TestSLOConfig:
+    def test_from_flag_parse_and_disabled(self, tel_off):
+        cfg = telemetry.SLOConfig.from_flag(
+            "ttft_p99_s=0.5, tpot_p99_s=0.05")
+        assert cfg.ttft_p99_s == 0.5
+        assert cfg.tpot_p99_s == 0.05
+        assert cfg.queue_wait_p99_s is None
+        assert cfg.enabled()
+        assert not telemetry.SLOConfig.from_flag("").enabled()
+        with pytest.raises(ValueError):
+            telemetry.SLOConfig.from_flag("bogus_field=1")
+
+    def test_request_meets_partial_config(self, tel_off):
+        cfg = telemetry.SLOConfig(ttft_p99_s=1.0)
+        assert cfg.request_meets(0.5, None, None) == {"ttft": True}
+        assert cfg.request_meets(2.0, 99., 99.) == {"ttft": False}
+        # a missing measurement counts as met
+        assert cfg.request_meets(None, None, None) == {"ttft": True}
+        assert telemetry.SLOConfig.p99([3.0, 1.0, 2.0]) == 3.0
+        assert telemetry.SLOConfig.p99([]) is None
+
+
+class TestGoodput:
+    def test_goodput_exact_three_of_four(self, tel_metrics,
+                                         monkeypatch):
+        """Hand-stepped fake clock: four staggered submits, TTFTs of
+        11/9/7/5s against a 10s SLO -> exactly 3 of 4 requests meet
+        it -> goodput 0.75, and the per-SLO attainment gauges agree
+        with hand-computed fractions."""
+        now = [100.0]
+        monkeypatch.setattr(telemetry, "_clock", lambda: now[0])
+        slo = telemetry.SLOConfig(ttft_p99_s=10.0,
+                                  queue_wait_p99_s=7.0)
+        sched = BatchScheduler(_FakeModel(), max_batch_size=8,
+                               slo=slo)
+        for i, t in enumerate((100.0, 102.0, 104.0, 106.0)):
+            now[0] = t
+            sched.submit(Request(f"r{i}", [5, 6], max_new_tokens=1))
+        now[0] = 110.0
+        sched.step()   # admit all (queue waits 10/8/6/4), prompt 0
+        now[0] = 111.0
+        sched.step()   # prompt done -> first+only token, retire all
+        m = sched.metrics()
+        # TTFTs: 11, 9, 7, 5 vs 10.0 -> 3/4 meet
+        assert m["serving"]["slo_attain_ttft"] == 0.75
+        # queue waits: 10, 8, 6, 4 vs 7.0 -> 2/4 meet
+        assert m["serving"]["slo_attain_queue_wait"] == 0.5
+        # goodput = all-SLOs-met = requests {r2, r3} -> 0.5
+        assert m["serving"]["goodput"] == 0.5
+        assert m["serving"]["slo_window_requests"] == 4
+        assert m["slo"] == {"ttft_p99_s": 10.0, "tpot_p99_s": None,
+                            "queue_wait_p99_s": 7.0}
+
+    def test_goodput_window_slides_by_epoch(self, tel_metrics,
+                                            monkeypatch):
+        """Requests retired more than FLAGS_telemetry_window step
+        epochs ago fall out of the goodput window."""
+        now = [0.0]
+        monkeypatch.setattr(telemetry, "_clock", lambda: now[0])
+        set_flags({"telemetry_window": 4})
+        try:
+            slo = telemetry.SLOConfig(ttft_p99_s=5.0)
+            sched = BatchScheduler(_FakeModel(), max_batch_size=2,
+                                   slo=slo)
+            # r0 misses the SLO (slow first token)
+            sched.submit(Request("r0", [5], max_new_tokens=1))
+            now[0] = 10.0
+            sched.step()
+            m = sched.metrics()
+            assert m["serving"]["goodput"] == 0.0
+            # 6 empty epochs later, r0 is out of the window; a fresh
+            # fast request is the only occupant -> goodput 1.0
+            for _ in range(6):
+                sched.step()
+            sched.submit(Request("r1", [5], max_new_tokens=1))
+            now[0] = 10.5
+            sched.step()
+            m = sched.metrics()
+            assert m["serving"]["goodput"] == 1.0
+            assert m["serving"]["slo_window_requests"] == 1
+        finally:
+            set_flags({"telemetry_window": 128})
+
+    def test_empty_window_clears_stale_miss(self, tel_metrics,
+                                            monkeypatch):
+        """A miss must not outlive its window: once the goodput
+        window empties, the gauges republish 1.0 with population 0
+        instead of freezing at the stale value."""
+        now = [0.0]
+        monkeypatch.setattr(telemetry, "_clock", lambda: now[0])
+        set_flags({"telemetry_window": 4})
+        try:
+            slo = telemetry.SLOConfig(ttft_p99_s=5.0)
+            sched = BatchScheduler(_FakeModel(), max_batch_size=2,
+                                   slo=slo)
+            sched.submit(Request("r0", [5], max_new_tokens=1))
+            now[0] = 10.0
+            sched.step()  # TTFT 10 > 5 -> miss
+            assert sched.metrics()["serving"]["goodput"] == 0.0
+            for _ in range(6):  # idle past the window
+                sched.step()
+            m = sched.metrics()
+            assert m["serving"]["goodput"] == 1.0
+            assert m["serving"]["slo_attain_ttft"] == 1.0
+            assert m["serving"]["slo_window_requests"] == 0
+        finally:
+            set_flags({"telemetry_window": 128})
+
+    def test_windowed_latency_views_in_metrics(self, tel_metrics,
+                                               monkeypatch):
+        now = [0.0]
+        monkeypatch.setattr(telemetry, "_clock", lambda: now[0])
+        sched = BatchScheduler(_FakeModel(), max_batch_size=2)
+        sched.submit(Request("r0", [5], max_new_tokens=2))
+        for t in (1.0, 2.0, 3.0):
+            now[0] = t
+            sched.step()
+        m = sched.metrics()
+        w = m["serving"]["ttft_s"]["window"]
+        assert w["count"] == 1 and w["p50"] == 1.0
+        assert "window" in m["serving"]["step_wall_s"]
+
+
+# -- self-describing metrics + admission counters ----------------------------
+
+
+class TestSelfDescribingMetrics:
+    def test_uptime_steps_population_gauges(self, tel_metrics,
+                                            monkeypatch):
+        now = [50.0]
+        monkeypatch.setattr(telemetry, "_clock", lambda: now[0])
+        sched = BatchScheduler(_FakeModel(), max_batch_size=1)
+        sched.submit(Request("a", [3, 4], max_new_tokens=8))
+        sched.submit(Request("b", [3], max_new_tokens=1))
+        now[0] = 52.0
+        sched.step()  # a admitted (batch=1), b queued
+        m = sched.metrics()
+        assert m["serving"]["uptime_s"] == 2.0
+        assert m["serving"]["steps_per_s"] == 0.5
+        assert m["serving"]["step_epoch"] == 1.0
+        assert m["serving"]["active_requests"] == 1.0
+        assert m["serving"]["queued_requests"] == 1.0
+        assert m["serving"]["retired_requests"] == 0.0
+        # the legacy shapes stay as aliases
+        assert m["serving"]["steps"] == 1
+        assert "total_pages" in sched.page_pool_stats()
+
+    def test_admit_reject_pool_counted(self, tel_metrics):
+        # 4-page pool: r0 reserves 2 pages; r1's worst case cannot
+        # fit under the watermark until r0 retires
+        sched = BatchScheduler(_FakeModel(num_pages=4),
+                               max_batch_size=4)
+        sched.submit(Request("r0", [1, 2, 3], max_new_tokens=5))
+        sched.submit(Request("r1", [1, 2, 3], max_new_tokens=5))
+        sched.run_until_complete()
+        m = sched.metrics()
+        assert m["serving"]["admit_reject_pool"] > 0
+        assert m["serving"]["requests_finished"] == 2
+        assert "admit_evict_then_admit" not in m["serving"]
+
+    def test_admit_evict_then_admit_counted(self, tel_metrics):
+        model = _FakeModel(num_pages=4)
+        # plant a 'cached' sequence holding 2 pages that only the
+        # stub evictor can reclaim
+        model.caches[0].lens["cached"] = 8
+        stub = _StubPrefixCache(model.caches, hit_len=0,
+                                evictable_seq="cached")
+        sched = BatchScheduler(model, max_batch_size=2,
+                               prefix_cache=stub)
+        sched.submit(Request("r0", [1, 2, 3], max_new_tokens=5))
+        sched.step()
+        m = sched.metrics()
+        assert stub.evictions == 1
+        assert m["serving"]["admit_evict_then_admit"] == 1
+        assert "admit_reject_pool" not in m["serving"]
+
+    def test_pool_peak_utilization_gauge(self, tel_metrics):
+        from paddle_tpu.incubate.nn import PagedKVCacheManager
+
+        pool = PagedKVCacheManager(8, 4, 1, 4)
+        pool.alloc("s")
+        for _ in range(9):
+            pool.append("s", np.zeros((1, 4), np.float32),
+                        np.zeros((1, 4), np.float32))
+        assert pool.peak_used_pages == 3
+        pool.free("s")
+        assert pool.peak_used_pages == 3  # a high watermark
+
+
+# -- per-request traces -------------------------------------------------------
+
+
+class TestRequestTraces:
+    def test_token_per_step_trace_complete(self, tel_trace):
+        sched = BatchScheduler(_FakeModel(), max_batch_size=2)
+        sched.submit(Request("a", [3, 4, 5], max_new_tokens=2))
+        sched.run_until_complete()
+        book = telemetry.request_traces()
+        tr = book.get("a")
+        assert tr.done
+        kinds = tr.kinds()
+        assert kinds[0] == "submit" and kinds[1] == "admit"
+        assert kinds[-1] == "retire"
+        assert kinds.count("prefill_chunk") == 3  # 1-token chunks
+        assert kinds.count("token") == 2
+        assert tr.first("retire")["generated_tokens"] == 2
+        assert tr.first("submit")["prompt_tokens"] == 3
+
+    def test_chunked_prefill_trace_has_chunk_counts(self, tel_trace):
+        sched = BatchScheduler(_FakeChunkModel(), max_batch_size=2,
+                               chunked_prefill=True,
+                               prefill_chunk_tokens=4)
+        sched.submit(Request("a", list(range(1, 11)),
+                             max_new_tokens=2))
+        sched.run_until_complete()
+        tr = telemetry.request_traces().get("a")
+        chunks = [e for e in tr.events
+                  if e["kind"] == "prefill_chunk"]
+        # 10 prompt tokens at budget 4 -> chunks of 4, 4, 2
+        assert [c["tokens"] for c in chunks] == [4, 4, 2]
+        assert chunks[-1]["pos"] == 10
+        assert tr.kinds()[-1] == "retire"
+
+    def test_prefix_hit_trace_records_hit_tokens(self, tel_trace):
+        model = _FakeModel()
+        stub = _StubPrefixCache(model.caches, hit_len=4)
+        sched = BatchScheduler(model, max_batch_size=2,
+                               prefix_cache=stub)
+        sched.submit(Request("a", [1, 2, 3, 4, 5, 6],
+                             max_new_tokens=1))
+        sched.run_until_complete()
+        tr = telemetry.request_traces().get("a")
+        assert tr.first("admit")["prefix_hit_tokens"] == 4
+        assert tr.first("retire")["prefix_hit_tokens"] == 4
+        # only the 2 uncached prompt tokens were prefilled
+        chunks = [e for e in tr.events
+                  if e["kind"] == "prefill_chunk"]
+        assert sum(c["tokens"] for c in chunks) == 2
+
+    def test_spec_decode_trace_complete(self, tel_trace):
+        target = _FakeChunkModel()
+        draft = _FakeChunkModel()
+        sched = BatchScheduler(target, max_batch_size=2,
+                               draft_model=draft, draft_k=2,
+                               prefill_chunk_tokens=8)
+        sched.submit(Request("a", [3, 4, 5], max_new_tokens=3))
+        sched.run_until_complete()
+        tr = telemetry.request_traces().get("a")
+        assert tr.done and tr.kinds()[-1] == "retire"
+        # one spec round commits draft_k+1 = 3 tokens
+        assert tr.kinds().count("token") == 3
+        assert tr.first("retire")["generated_tokens"] == 3
+
+    def test_completed_lru_is_bounded(self, tel_off):
+        set_flags({"telemetry": "trace",
+                   "telemetry_request_traces": 3})
+        telemetry.reset()
+        try:
+            book = telemetry.request_traces()
+            for i in range(6):
+                book.begin(f"r{i}", float(i), i)
+                book.complete(f"r{i}", "retire", float(i) + 1, i)
+            assert book.completed_count == 3
+            assert book.dropped == 3
+            assert book.get("r0") is None
+            assert book.get("r5") is not None
+            assert book.summary()["capacity"] == 3
+        finally:
+            set_flags({"telemetry": "off",
+                       "telemetry_request_traces": 256})
+            telemetry.reset()
+
+    def test_chrome_lanes_round_trip(self, tel_trace):
+        sched = BatchScheduler(_FakeModel(), max_batch_size=4)
+        for i in range(3):
+            sched.submit(Request(f"r{i}", [3, 4], max_new_tokens=2))
+        sched.run_until_complete()
+        payload = json.loads(json.dumps(telemetry.chrome_payload()))
+        events = payload["traceEvents"]
+        lanes = {e["args"]["name"]: e["tid"] for e in events
+                 if e.get("ph") == "M"
+                 and e["name"] == "thread_name"}
+        assert set(lanes) == {"req r0", "req r1", "req r2"}
+        # each lane carries the queued/prefill/decode phase spans and
+        # instant chunk/token events
+        for tid in lanes.values():
+            mine = [e for e in events if e.get("tid") == tid]
+            spans = {e["name"] for e in mine if e.get("ph") == "X"}
+            assert {"queued", "prefill", "decode"} <= spans
+            assert any(e.get("ph") == "i" and e["name"] == "token"
+                       for e in mine)
+        # span stream still present alongside the lanes
+        assert any(e["name"] == "serving.step" for e in events)
+
+    def test_jsonl_dump_and_summarize_with_requests(self, tmp_path,
+                                                    tel_trace,
+                                                    capsys):
+        sched = BatchScheduler(_FakeModel(), max_batch_size=2)
+        sched.submit(Request("reqX", [3, 4], max_new_tokens=1))
+        sched.run_until_complete()
+        path = str(tmp_path / "t.jsonl")
+        tel_trace.dump_jsonl(path, telemetry.registry(),
+                             traces=telemetry.request_traces())
+        loaded = telemetry._load_jsonl(path)
+        assert len(loaded["requests"]) == 1
+        assert loaded["requests"][0]["req_id"] == "reqX"
+        assert telemetry.main(["--summarize", path]) == 0
+        out = capsys.readouterr().out
+        assert "request traces (1)" in out
+        assert "reqX" in out and "retire" in out
+        # chrome conversion renders the request lane too
+        outp = str(tmp_path / "t.chrome.json")
+        telemetry.chrome_from_jsonl(path, outp)
+        data = json.load(open(outp))
+        assert any(e.get("ph") == "M"
+                   and e["args"]["name"] == "req reqX"
+                   for e in data["traceEvents"])
+
+
+# -- watchdogs ---------------------------------------------------------------
+
+
+def _mk_registry():
+    return telemetry.MetricsRegistry()
+
+
+class TestWatchdogs:
+    def test_recompile_storm_seeded(self, tel_off):
+        reg = _mk_registry()
+        wd = Watchdog(reg, mode="warn", window=8, warmup=2,
+                      storm_compiles=3)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            for e in range(1, 8):
+                reg.inc("compile.count")
+                wd.check(e)
+        assert wd.counts.get("recompile-storm", 0) >= 1
+        assert any("recompile-storm" in str(x.message) for x in w)
+        ev = next(e for e in wd.events
+                  if e["class"] == "recompile-storm")
+        assert ev["detail"]["compiles_in_window"] >= 3
+        assert "count" in ev["snapshot"]  # compile-ns evidence
+
+    def test_storm_respects_warmup(self, tel_off):
+        reg = _mk_registry()
+        wd = Watchdog(reg, mode="strict", window=8, warmup=100,
+                      storm_compiles=2)
+        for e in range(1, 20):
+            reg.inc("compile.count")
+            wd.check(e)  # would raise without the warmup grace
+        assert len(wd.events) == 0
+
+    def test_warmup_compiles_never_leak_into_live_window(self,
+                                                         tel_off):
+        """Compiles that land DURING warmup must not count toward
+        the first post-warmup window (the detector re-baselines at
+        the warmup boundary)."""
+        reg = _mk_registry()
+        wd = Watchdog(reg, mode="strict", window=8, warmup=6,
+                      storm_compiles=2)
+        reg.inc("compile.count", 10)   # the startup burst
+        for e in range(1, 4):
+            wd.check(e)                # observed inside warmup
+        for e in range(6, 15):
+            wd.check(e)                # no NEW compiles: must stay
+        assert len(wd.events) == 0     # silent
+        # a genuine post-warmup storm still fires
+        reg.inc("compile.count", 5)
+        with pytest.raises(WatchdogError):
+            wd.check(15)
+
+    def test_pool_pressure_high_watermark_and_churn(self, tel_off):
+        reg = _mk_registry()
+        reg.gauge("pool.utilization", 0.99)
+        reg.gauge("pool.total_pages", 100)
+        wd = Watchdog(reg, mode="warn", window=8, warmup=0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            wd.check(1)
+        assert wd.counts["pool-pressure"] == 1
+        assert wd.events[-1]["detail"]["kind"] == "high-watermark"
+        # hysteresis: still high on the next check -> no second event
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            wd.check(2)
+        assert wd.counts["pool-pressure"] == 1
+        # churn thrash: allocs+frees > churn_factor x pool size
+        reg2 = _mk_registry()
+        reg2.gauge("pool.utilization", 0.1)
+        reg2.gauge("pool.total_pages", 10)
+        wd2 = Watchdog(reg2, mode="warn", window=8, warmup=0,
+                       churn_factor=2.0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            wd2.check(1)
+            reg2.inc("pool.page_allocs", 15)
+            reg2.inc("pool.page_frees", 15)
+            wd2.check(2)
+        assert wd2.events[-1]["detail"]["kind"] == "churn"
+
+    def test_prefix_collapse_vs_trailing_baseline(self, tel_off):
+        reg = _mk_registry()
+        # healthy baseline (epochs 1-16 at 0.8), then collapse
+        # (epochs 17-33 at 0.1); the check at 33 windows [17, 33]
+        for e in range(1, 17):
+            reg.set_epoch(e)
+            reg.observe("prefix.hit_frac", 0.8)
+        for e in range(17, 34):
+            reg.set_epoch(e)
+            reg.observe("prefix.hit_frac", 0.1)
+        wd = Watchdog(reg, mode="warn", window=16, warmup=0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            wd.check(33)
+        assert wd.counts["prefix-collapse"] == 1
+        d = wd.events[-1]["detail"]
+        assert d["baseline_hit_frac"] == 0.8
+        assert d["window_hit_frac"] == 0.1
+
+    def test_decode_stall_outlier_vs_window_median(self, tel_off):
+        reg = _mk_registry()
+        for e in range(1, 10):
+            reg.set_epoch(e)
+            reg.observe("serving.step_wall_s", 0.01)
+        reg.set_epoch(10)
+        reg.observe("serving.step_wall_s", 0.5)
+        wd = Watchdog(reg, mode="strict", window=16, warmup=0)
+        with pytest.raises(WatchdogError) as ei:
+            wd.check(10)
+        assert ei.value.events[0]["class"] == "decode-stall"
+        assert ei.value.events[0]["detail"]["step_wall_s"] == 0.5
+
+    def test_sanitizer_spike_carries_journal_tail(self, tel_off):
+        reg = _mk_registry()
+        reg.gauge("sanitizer.violations", 0)
+        wd = Watchdog(reg, mode="warn", window=8, warmup=0)
+        wd.check(1)
+        reg.gauge("sanitizer.violations", 2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fired = wd.check(
+                2, context={"sanitizer_journal_tail":
+                            [{"op": "free", "seq": "s0"}]})
+        assert fired[0]["class"] == "sanitizer-spike"
+        assert fired[0]["detail"]["new_violations"] == 2
+        assert fired[0]["sanitizer_journal_tail"][0]["op"] == "free"
+
+    def test_event_log_bounded_and_dumpable(self, tel_off, tmp_path):
+        reg = _mk_registry()
+        reg.gauge("sanitizer.violations", 0)
+        wd = Watchdog(reg, mode="warn", window=2, warmup=0,
+                      log_capacity=8)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for e in range(1, 30):
+                reg.gauge("sanitizer.violations", float(e))
+                wd.check(e)
+        assert len(wd.events) == 8
+        assert wd.dropped > 0
+        path = wd.dump_jsonl(str(tmp_path / "wd.jsonl"))
+        recs = [json.loads(ln) for ln in open(path)]
+        assert all(r["type"] == "watchdog_event" for r in recs)
+        assert telemetry._load_jsonl(path)["watchdog"] == recs
+
+    def test_scheduler_runs_watchdog_at_stride(self, tel_off):
+        set_flags({"telemetry": "metrics",
+                   "telemetry_watchdog": "warn",
+                   "telemetry_watchdog_stride": 2})
+        telemetry.reset()
+        try:
+            # plant a ghost occupant filling the whole 2-page pool:
+            # utilization 1.0 >= the high watermark -> pool-pressure
+            # at the first stride check
+            model = _FakeModel(num_pages=2)
+            model.caches[0].lens["ghost"] = 8
+            sched = BatchScheduler(model, max_batch_size=1)
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                sched.step()   # epoch 1: not a stride multiple
+                assert sched._watchdog.checks == 0
+                sched.step()   # epoch 2: detectors run
+            assert sched._watchdog.checks == 1
+            assert sched._watchdog.counts.get("pool-pressure") == 1
+            assert any("pool-pressure" in str(x.message) for x in w)
+            m = sched.metrics()
+            assert m["watchdog"]["events"] == 1
+            assert m["watchdog"]["by_class"] == {"pool-pressure": 1}
+        finally:
+            set_flags({"telemetry": "off",
+                       "telemetry_watchdog": "off",
+                       "telemetry_watchdog_stride": 32})
+            telemetry.reset()
+
+    def test_mode_validation(self, tel_off):
+        reg = _mk_registry()
+        with pytest.raises(ValueError):
+            Watchdog(reg, mode="off")
+        with pytest.raises(ValueError):
+            Watchdog(None, mode="warn")
+
+
+# -- shared-epoch ownership, warmup relativity, locking ----------------------
+
+
+class TestSharedEpochAndWarmup:
+    def test_advance_epoch_monotonic_set_epoch_never_rewinds(
+            self, tel_off):
+        r = telemetry.MetricsRegistry()
+        assert r.advance_epoch() == 1
+        assert r.advance_epoch() == 2
+        r.set_epoch(9)
+        assert r.epoch == 9
+        r.set_epoch(3)   # a stale setter must not rewind the stamp
+        assert r.epoch == 9
+
+    def test_second_scheduler_does_not_rewind_windows(
+            self, tel_metrics, monkeypatch):
+        """The registry owns the epoch: a scheduler built after
+        another has stepped must join the shared stamp, not restart
+        it — or the first scheduler's fresh samples would fall
+        outside its own trailing window."""
+        now = [0.0]
+        monkeypatch.setattr(telemetry, "_clock", lambda: now[0])
+        a = BatchScheduler(_FakeModel(), max_batch_size=2)
+        a.submit(Request("a0", [5], max_new_tokens=1))
+        now[0] = 1.0
+        a.step()                     # shared epoch 1, first TTFT
+        b = BatchScheduler(_FakeModel(), max_batch_size=2)
+        b.step()                     # late joiner: epoch 2, no rewind
+        assert telemetry.registry().epoch == 2
+        a.submit(Request("a1", [5], max_new_tokens=1))
+        now[0] = 2.0
+        a.step()                     # epoch 3, second TTFT
+        w = a.metrics()["serving"]["ttft_s"]["window"]
+        assert w["count"] == 2       # both samples inside a's window
+
+    def test_storm_counts_max_of_redundant_signals_not_sum(
+            self, tel_off):
+        """compile.count and serving.compile_count are redundant
+        views of the same recompiles: 3 real recompiles mirrored in
+        both must read as 3 (max), never 6 (sum)."""
+        reg = _mk_registry()
+        wd = Watchdog(reg, mode="strict", window=8, warmup=0,
+                      storm_compiles=4)
+        wd.check(1)
+        for e in range(2, 5):
+            reg.inc("compile.count")
+            reg.gauge("serving.compile_count", e - 1.0)
+            wd.check(e)   # sum semantics would see 6 >= 4 and raise
+        assert len(wd.events) == 0
+        reg.inc("compile.count", 2)   # now 5 real recompiles
+        reg.gauge("serving.compile_count", 5.0)
+        with pytest.raises(WatchdogError):
+            wd.check(5)
+
+    def test_late_built_watchdog_gets_full_warmup(self, tel_off):
+        """Warmup counts from the watchdog's FIRST check epoch, not
+        the absolute shared registry epoch — a watchdog built at
+        epoch 5000 still gets its startup grace."""
+        reg = _mk_registry()
+        wd = Watchdog(reg, mode="strict", window=8, warmup=4,
+                      storm_compiles=2)
+        for e in range(5000, 5004):
+            reg.inc("compile.count", 3)  # burst on every check
+            wd.check(e)                  # inside RELATIVE warmup
+        assert len(wd.events) == 0
+        reg.inc("compile.count", 2)
+        wd.check(5004)                   # post-warmup re-baseline
+        reg.inc("compile.count", 2)
+        with pytest.raises(WatchdogError):
+            wd.check(5005)               # a genuine storm still fires
+
+    def test_decode_stall_respects_warmup(self, tel_off):
+        """Startup steps that trace new bucket programs are
+        legitimate wall outliers — stall must honor warmup too."""
+        reg = _mk_registry()
+        for e in range(1, 10):
+            reg.set_epoch(e)
+            reg.observe("serving.step_wall_s", 0.01)
+        reg.set_epoch(10)
+        reg.observe("serving.step_wall_s", 0.5)   # compile-step spike
+        wd = Watchdog(reg, mode="strict", window=16, warmup=4)
+        wd.check(10)           # first check: inside relative warmup
+        assert len(wd.events) == 0
+        for e in range(11, 14):
+            reg.set_epoch(e)
+            reg.observe("serving.step_wall_s", 0.01)
+        reg.set_epoch(14)
+        reg.observe("serving.step_wall_s", 0.5)
+        with pytest.raises(WatchdogError) as ei:
+            wd.check(14)       # identical outlier AFTER warmup fires
+        assert ei.value.events[0]["class"] == "decode-stall"
+
+    def test_hist_windowed_locked_read(self, tel_off):
+        r = telemetry.MetricsRegistry()
+        r.set_epoch(5)
+        r.observe("serving.x", 2.0)
+        w = r.hist_windowed("serving.x", 4)
+        assert w["count"] == 1 and w["p50"] == 2.0
+        assert r.hist_windowed("nope", 0) is None
+
+    def test_explicit_slo_with_telemetry_off_warns(self, tel_off):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            BatchScheduler(_FakeModel(), max_batch_size=1,
+                           slo=telemetry.SLOConfig(ttft_p99_s=1.0))
+        assert any("FLAGS_telemetry is off" in str(x.message)
+                   for x in w)
+
+    def test_armed_profiler_trace_epochs_advance(self, tel_off):
+        """A profiler window with the flag off still collects request
+        traces — their epoch field must advance per step instead of
+        stamping 0 everywhere."""
+        telemetry.arm_tracer()
+        try:
+            sched = BatchScheduler(_FakeModel(), max_batch_size=1)
+            sched.submit(Request("r0", [5], max_new_tokens=2))
+            for _ in range(4):
+                sched.step()
+            tr = telemetry.request_traces().get("r0")
+            epochs = [ev["epoch"] for ev in tr.events]
+            assert max(epochs) > 0
+            assert epochs == sorted(epochs)
+        finally:
+            telemetry.disarm_tracer()
+
+
+# -- Prometheus export --------------------------------------------------------
+
+
+class TestPrometheusExport:
+    def _seed(self):
+        r = telemetry.MetricsRegistry()
+        r.inc("serving.steps", 42)
+        r.gauge("pool.utilization", 0.25)
+        for v in (0.5, 1.5, 3.0):
+            r.observe("serving.ttft_s", v)
+        return r
+
+    def test_text_format_shapes(self, tel_off):
+        text = telemetry.prometheus_text(registry=self._seed())
+        assert "# TYPE paddle_serving_steps counter" in text
+        assert "paddle_serving_steps 42" in text
+        assert "# TYPE paddle_pool_utilization gauge" in text
+        assert "paddle_pool_utilization 0.25" in text
+        assert "# TYPE paddle_serving_ttft_s histogram" in text
+        # cumulative buckets: 0.5 -> le=0.5; 1.5 -> le=2; 3.0 -> le=4
+        assert 'paddle_serving_ttft_s_bucket{le="0.5"} 1' in text
+        assert 'paddle_serving_ttft_s_bucket{le="2"} 2' in text
+        assert 'paddle_serving_ttft_s_bucket{le="4"} 3' in text
+        assert 'paddle_serving_ttft_s_bucket{le="+Inf"} 3' in text
+        assert "paddle_serving_ttft_s_sum 5" in text
+        assert "paddle_serving_ttft_s_count 3" in text
+        assert ('paddle_serving_ttft_s_quantile{quantile="0.5",'
+                'exactness="exact"} 1.5') in text
+
+    def test_no_registry_and_nonnumeric_skipped(self, tel_off):
+        assert "off" in telemetry.prometheus_text()
+        snap = {"serving": {"steps": 1, "mode": "trace",
+                            "list": [1, 2]},
+                "telemetry": "trace"}
+        text = telemetry.prometheus_text(snapshot=snap)
+        assert "paddle_serving_steps 1" in text
+        assert "mode" not in text and "list" not in text
+
+    def test_write_prometheus_atomic(self, tel_off, tmp_path):
+        path = str(tmp_path / "metrics.prom")
+        telemetry.write_prometheus(path, registry=self._seed())
+        text = open(path).read()
+        assert "paddle_serving_steps 42" in text
+        assert not (tmp_path / "metrics.prom.tmp").exists()
+
+    def test_cli_export_prom(self, tel_off, tmp_path, capsys):
+        tr = telemetry.Tracer(ring=16)
+        with tr.span("serving.step"):
+            pass
+        path = str(tmp_path / "t.jsonl")
+        tr.dump_jsonl(path, self._seed())
+        assert telemetry.main(["--export-prom", path]) == 0
+        out = capsys.readouterr().out
+        assert "paddle_serving_steps 42" in out
+        outp = str(tmp_path / "m.prom")
+        assert telemetry.main(
+            ["--export-prom", path, "--prom-out", outp]) == 0
+        assert "paddle_serving_steps 42" in open(outp).read()
+
+    def test_scheduler_periodic_export(self, tel_off, tmp_path):
+        path = str(tmp_path / "serve.prom")
+        set_flags({"telemetry": "metrics",
+                   "telemetry_export_path": path,
+                   "telemetry_watchdog_stride": 2})
+        telemetry.reset()
+        try:
+            sched = BatchScheduler(_FakeModel(), max_batch_size=2)
+            sched.submit(Request("a", [3, 4], max_new_tokens=3))
+            sched.step()
+            assert not (tmp_path / "serve.prom").exists()
+            sched.step()  # stride hit -> snapshot written
+            text = open(path).read()
+            assert "paddle_serving_steps 2" in text
+            assert "paddle_pool_total_pages" in text
+        finally:
+            set_flags({"telemetry": "off",
+                       "telemetry_export_path": "",
+                       "telemetry_watchdog_stride": 32})
+            telemetry.reset()
+
+
+# -- truncated-JSONL tolerance ------------------------------------------------
+
+
+class TestTruncatedJsonl:
+    def _dump(self, tmp_path):
+        tr = telemetry.Tracer(ring=16)
+        reg = telemetry.MetricsRegistry()
+        with tr.span("serving.step"):
+            pass
+        reg.inc("serving.steps", 2)
+        path = str(tmp_path / "t.jsonl")
+        tr.dump_jsonl(path, reg)
+        return path
+
+    def test_truncated_final_line_tolerated(self, tmp_path, capsys,
+                                            tel_off):
+        path = self._dump(tmp_path)
+        # a process killed mid-write leaves a partial record with NO
+        # newline terminator
+        with open(path, "a") as f:
+            f.write('{"type": "span", "name": "cut-off", "ts"')
+        loaded = telemetry._load_jsonl(path)
+        assert loaded["truncated"] is True
+        assert loaded["metrics"]["serving"]["steps"] == 2
+        assert telemetry.main(["--summarize", path]) == 0
+        out = capsys.readouterr().out
+        assert "final JSONL line was truncated" in out
+        assert "killed mid-write" in out
+
+    def test_newline_terminated_garbage_still_raises(self, tmp_path,
+                                                     tel_off):
+        path = self._dump(tmp_path)
+        with open(path, "a") as f:
+            f.write("not json at all\n")  # complete line: corruption
+        with pytest.raises(ValueError):
+            telemetry.summarize_jsonl(path)
+
+    def test_mid_file_garbage_still_raises(self, tmp_path, tel_off):
+        path = self._dump(tmp_path)
+        lines = open(path).read().splitlines()
+        lines.insert(0, "garbage mid-file")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))  # garbage is NOT final now
+        with pytest.raises(ValueError):
+            telemetry.summarize_jsonl(path)

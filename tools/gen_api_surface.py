@@ -65,6 +65,7 @@ NAMESPACES = [
     "paddle_tpu.quantization",
     "paddle_tpu.inference",
     "paddle_tpu.framework.telemetry",
+    "paddle_tpu.framework.watchdog",
     "paddle_tpu.profiler",
     "paddle_tpu.models",
     "paddle_tpu.models.convert",

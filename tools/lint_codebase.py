@@ -112,6 +112,7 @@ _WAIVER_MARK = "# trace-lint: ok"
 HOST_ONLY_FILES = (
     os.path.join("paddle_tpu", "inference", "prefix_cache.py"),
     os.path.join("paddle_tpu", "framework", "telemetry.py"),
+    os.path.join("paddle_tpu", "framework", "watchdog.py"),
 )
 
 _HOST_ONLY_BANNED_MODULES = ("jax", "jax.numpy")
@@ -330,6 +331,28 @@ def check_clock_discipline(root=REPO):
     for f in CLOCK_DISCIPLINE_FILES:
         out.extend(lint_clock_discipline_file(os.path.join(root, f)))
     return out
+
+
+# watchdog read-only discipline (the framework/watchdog.py
+# observability contract): detector code may READ the telemetry
+# registry (counter / gauge_value / histogram / hist_samples /
+# snapshot) but must never mutate it, and must never reach into
+# serving/pool state — a detector that writes the metrics it watches
+# (or perturbs the pool it diagnoses) produces evidence nobody can
+# trust. Evidence that requires pool access (the sanitizer journal
+# tail) is gathered by the SCHEDULER through public API and handed
+# in via the check() context.
+WATCHDOG_FILES = (
+    os.path.join("paddle_tpu", "framework", "watchdog.py"),
+)
+
+# registry mutators (MetricsRegistry write surface) banned in
+# detector code
+_REGISTRY_MUTATORS = frozenset({
+    "inc", "gauge", "observe", "set_epoch", "advance_epoch",
+})
+# (the visitor itself — _WatchdogReadOnlyVisitor — subclasses the
+# pool-mutation visitor and is defined after it, below)
 
 
 # serving-layer modules barred from writing the quantized-page scale
@@ -607,6 +630,64 @@ def check_pool_mutation_audit(root=REPO):
                 out.extend(lint_pool_state_file(path))
     for f in POOL_API_FILES:
         out.extend(lint_pool_api_file(os.path.join(root, f)))
+    return out
+
+
+class _WatchdogReadOnlyVisitor(_PoolStateWriteVisitor):
+    """Flags watchdog/detector code stepping off the read-only
+    surface: registry mutator calls (obj.inc/gauge/observe/
+    set_epoch), pool-private underscore method calls, and — via the
+    inherited pool-mutation visitor — any write to
+    PagedKVCacheManager state attrs."""
+
+    def _flag(self, lineno, what):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        if _WAIVER_MARK not in line:
+            self.violations.append(
+                "%s:%d: %s — watchdog/detector code is registry-READ-"
+                "ONLY (no registry mutation, no serving/pool state "
+                "mutation, no pool-private calls; evidence needing "
+                "pool access is handed in via check()'s context); "
+                "fix it or waive with '%s(<reason>)'"
+                % (self.relpath, lineno, what, _WAIVER_MARK))
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _REGISTRY_MUTATORS:
+                self._flag(node.lineno,
+                           "registry mutator call .%s(...)" % fn.attr)
+                self.generic_visit(node)
+                return
+            if fn.attr in _POOL_PRIVATE_METHODS:
+                self._flag(node.lineno,
+                           "call into pool-private .%s()" % fn.attr)
+                self.generic_visit(node)
+                return
+        # the inherited check (container mutations on pool state)
+        super().visit_Call(node)
+
+
+def lint_watchdog_file(path, text=None):
+    """Watchdog read-only audit for one file; returns violations."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _WatchdogReadOnlyVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_watchdog_readonly(root=REPO):
+    out = []
+    for f in WATCHDOG_FILES:
+        out.extend(lint_watchdog_file(os.path.join(root, f)))
     return out
 
 
@@ -976,7 +1057,13 @@ RULES = (
      "registry; no raw jax callables leaking through"),
     ("host-only-hygiene",
      "declared host-only modules (prefix_cache.py, framework/"
-     "telemetry.py) must not touch jax/jnp at all"),
+     "telemetry.py, framework/watchdog.py) must not touch jax/jnp "
+     "at all"),
+    ("watchdog-read-only",
+     "watchdog/detector code (framework/watchdog.py) may only READ "
+     "the telemetry registry — no registry mutators (inc/gauge/"
+     "observe/set_epoch), no pool-private calls, no pool state "
+     "writes"),
     ("clock-discipline",
      "no direct time.time/perf_counter reads in serving.py/"
      "paged_cache.py/prefix_cache.py — telemetry spans/clock() are "
@@ -1012,6 +1099,7 @@ def run_lint(root=REPO, with_op_table=True):
     out = check_traced_paths(root)
     out.extend(check_host_only(root))
     out.extend(check_clock_discipline(root))
+    out.extend(check_watchdog_readonly(root))
     out.extend(check_quant_sidecar_writes(root))
     out.extend(check_pool_mutation_audit(root))
     out.extend(check_serving_buckets(root))
