@@ -898,9 +898,12 @@ def static_check_inventory() -> dict:
     metric/span surface (framework/telemetry.py — the observability
     layer the serving and compile paths report through), the anomaly
     watchdog classes (framework/watchdog.py — the registry-read-only
-    detectors the scheduler runs at the watchdog stride), and the
-    AST rules of tools/lint_codebase.py. Emitted in the CLI's --json
-    payload under ``static_checks`` and printable standalone with
+    detectors the scheduler runs at the watchdog stride), the
+    serving fault-injection classes (incubate/nn/fault_injection.py —
+    the deterministic step-boundary perturbations the overload
+    harness must absorb), and the AST rules of
+    tools/lint_codebase.py. Emitted in the CLI's --json payload
+    under ``static_checks`` and printable standalone with
     ``--rules``."""
     inv = {"jaxpr": [dataclasses.asdict(r) for r in RULES.values()]}
     try:
@@ -919,6 +922,14 @@ def static_check_inventory() -> dict:
             for rid, s in WATCHDOG_CLASSES]
     except Exception:  # pragma: no cover - circulars in odd installs
         inv["watchdog"] = []
+    try:
+        from ..incubate.nn.fault_injection import FAULT_KINDS
+
+        inv["serving_faults"] = [
+            {"rule_id": rid, "severity": "info", "summary": s}
+            for rid, s in FAULT_KINDS]
+    except Exception:  # pragma: no cover - circulars in odd installs
+        inv["serving_faults"] = []
     try:
         from ..incubate.nn.page_sanitizer import VIOLATIONS
 

@@ -263,6 +263,48 @@ define_flag("moe_dense_dispatch", False,
             "route MoE tokens via the dense (N,E,C) one-hot "
             "dispatch/combine einsums instead of the sparse index "
             "scatter/gather path (oracle/debug; same semantics)")
+define_flag("serving_max_queue", 0,
+            "bound on the BatchScheduler submit queue (inference/"
+            "serving.py): submit() past this many waiting requests "
+            "raises QueueFullError instead of growing the backlog "
+            "without limit — the backpressure half of admission "
+            "control (docs/SERVING.md 'Overload behavior'). 0 "
+            "(default) keeps the queue unbounded")
+define_flag("serving_swap_bytes", 256 << 20,
+            "host-memory budget for the tiered KV swap space "
+            "(incubate/nn/paged_cache.py HostKVSwapSpace): preempted "
+            "sequences page their PRIVATE KV pages (payload + int8 "
+            "scale sidecars) out to host buffers under this byte cap "
+            "and restore them bitwise on re-admission; shared "
+            "(prefix) pages stay on-device under an external "
+            "reference. 0 disables the swap tier (preemption then "
+            "declines and admission blocks, the pre-ISSUE-9 "
+            "behavior)")
+define_flag("serving_preempt", True,
+            "sequence preemption for the serving scheduler "
+            "(inference/serving.py): when admission cannot reserve "
+            "pages for a request, victims with STRICTLY lower "
+            "priority (lowest priority first, then most pages held, "
+            "then least progress) are swapped out to the host tier "
+            "(FLAGS_serving_swap_bytes) instead of the request being "
+            "blocked behind them — capacity pressure means slower, "
+            "never failed. Off restores wait-in-queue admission "
+            "exactly")
+define_flag("serving_faults", "",
+            "deterministic fault-injection plan for the serving "
+            "scheduler (incubate/nn/fault_injection.py): comma-"
+            "separated 'kind@step', 'kind@step+duration', or "
+            "'kind@step:param' entries over kinds exhaust / "
+            "preempt_storm / delay_swap_in / fail_step, e.g. "
+            "'exhaust@10+5,preempt_storm@20:2,fail_step@30+3'. "
+            "Faults perturb the scheduler at step boundaries only; "
+            "empty (default) constructs no injector and costs one "
+            "is-None check per step")
+define_flag("serving_fault_seed", 0,
+            "seed for FaultInjector.random() plans (the fault-"
+            "injection harness's randomized mode: same seed + same "
+            "step count -> the identical fault schedule, so every "
+            "injected-fault run is replayable)")
 if os.environ.get("FLAGS_flash_pallas_interpret"):
     # pre-rename env alias (was flash-only before covering all kernels)
     _REGISTRY["pallas_interpret"] = True

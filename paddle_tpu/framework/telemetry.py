@@ -455,10 +455,11 @@ class RequestTrace:
     """One request's lifecycle timeline: an ordered list of
     ``{"t": wall, "epoch": step, "kind": ..., **payload}`` events
     from ``submit`` through ``admit`` / ``prefill_chunk`` (token
-    counts + prefix-hit tokens) / ``token`` to the terminal
-    ``retire`` (or ``evict``, once preemption exists). ``lane`` is
-    the stable integer track id the Chrome export renders the
-    request under."""
+    counts + prefix-hit tokens) / ``token`` / ``evict`` (preemption:
+    KV swapped to host; NON-terminal — a later ``admit`` with
+    ``swapped_in=True`` marks the resume) to the terminal ``retire``
+    or ``abort`` (deadline expiry). ``lane`` is the stable integer
+    track id the Chrome export renders the request under."""
 
     __slots__ = ("req_id", "lane", "events", "done")
 
@@ -527,8 +528,9 @@ class RequestTraceBook:
 
     def complete(self, req_id: str, kind: str, t: float, epoch: int,
                  **payload) -> None:
-        """Record the terminal event (``retire`` today; ``evict``
-        reserved for preemption) and move the trace to the LRU."""
+        """Record the terminal event (``retire``, or ``abort`` for a
+        deadline expiry — preemption's ``evict`` is NOT terminal and
+        goes through :meth:`event`) and move the trace to the LRU."""
         with self._lock:
             tr = self._active.pop(req_id, None)
             if tr is None:
@@ -578,7 +580,8 @@ class RequestTraceBook:
         carrying phase spans derived from the lifecycle timestamps —
         ``queued`` (submit -> admit), ``prefill`` (admit -> first
         token), ``decode`` (first token -> retire) — plus an instant
-        event per recorded chunk/token."""
+        event per recorded chunk/token and per preemption
+        ``evict``/``abort`` marker."""
         return _request_lane_events(
             self.to_jsonl_records(), base, pid)
 
@@ -624,7 +627,8 @@ def _request_lane_events(records, base, pid) -> List[dict]:
                 phase, "request", tid, t0, max(t1 - t0, 0.0),
                 {"req_id": rid}, base, pid))
         for ev in events:
-            if ev["kind"] not in ("prefill_chunk", "token"):
+            if ev["kind"] not in ("prefill_chunk", "token", "evict",
+                                  "abort"):
                 continue
             args = {k: v for k, v in ev.items()
                     if k not in ("t", "kind")}
@@ -1015,6 +1019,39 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
      "windowed fraction meeting the queue-wait SLO"),
     ("serving.slo_window_requests", "gauge",
      "retired requests inside the SLO window right now"),
+    # overload survival (docs/SERVING.md "Overload behavior")
+    ("serving.admit_reject_queue_full", "counter",
+     "submit() rejections on the bounded queue "
+     "(FLAGS_serving_max_queue backpressure)"),
+    ("serving.admit_preempt_then_admit", "counter",
+     "admissions that succeeded only after preempting lower-"
+     "priority victims to the host swap tier"),
+    ("serving.aborted_deadline", "counter",
+     "requests aborted at a step boundary because their deadline_s "
+     "expired (the distinct terminal state; an SLO miss by "
+     "definition)"),
+    ("serving.preempt_victims", "counter",
+     "sequences swapped out to the host tier (the preemption-"
+     "thrash watchdog's signal)"),
+    ("serving.preempt_pages", "counter",
+     "device pages released by preemption swap-outs"),
+    ("serving.preempt_swap_full", "counter",
+     "preemption attempts declined because the host swap space "
+     "could not hold the victim (FLAGS_serving_swap_bytes)"),
+    ("serving.swap_out_bytes", "counter",
+     "bytes copied to the host swap tier at preemption"),
+    ("serving.swap_in_requests", "counter",
+     "swapped-out sequences restored and re-admitted"),
+    ("serving.swap_in_pages", "counter",
+     "device pages redrawn and bitwise-restored at swap-in"),
+    ("serving.swapped_requests", "gauge",
+     "sequences currently paged out to the host tier"),
+    ("serving.swap_used_bytes", "gauge",
+     "host swap-space bytes in use right now"),
+    ("serving.step_retries", "counter",
+     "step attempts abandoned by an injected fail_step fault"),
+    ("serving.step_backoff_steps", "counter",
+     "no-op steps spent in post-failure exponential backoff"),
     # KV page pool (incubate/nn/paged_cache.py)
     ("pool.cow_forks", "counter",
      "copy-on-write page forks (summed across layer pools)"),
@@ -1029,6 +1066,10 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
     ("pool.peak_utilization", "gauge",
      "high watermark: max fraction of pages ever simultaneously in "
      "use (peak_used_pages summed across layer pools)"),
+    ("pool.swap_out_pages", "counter",
+     "pages released to the free list by host-tier swap-outs"),
+    ("pool.swap_in_pages", "counter",
+     "pages redrawn and bitwise-restored by host-tier swap-ins"),
     # prefix cache (inference/prefix_cache.py)
     ("prefix.hits", "counter", "prompt lookups that matched"),
     ("prefix.misses", "counter", "prompt lookups that missed"),
@@ -1075,6 +1116,10 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
     ("span:serving.decode", "span",
      "logits -> token commit (sampling + bookkeeping)"),
     ("span:serving.retire", "span", "one request's retirement"),
+    ("span:serving.preempt", "span",
+     "one victim's swap-out to the host tier (req/reason attrs)"),
+    ("span:serving.swap_in", "span",
+     "one sequence's bitwise restore from the host tier"),
     ("span:jit.compile", "span",
      "one to_static trace (program/variant/n_eqns/lint attrs)"),
 )

@@ -24,6 +24,11 @@ structured event to a bounded log when its signature fires:
 * ``sanitizer-spike`` — ``sanitizer.violations`` increasing inside
   the window; the event carries the journal tail the caller passed
   in via ``context`` (the detector itself never touches a pool).
+* ``preemption-thrash`` — ``serving.preempt_victims`` climbing
+  faster than ``thrash_preempts`` per window after warmup: victims
+  are bouncing between the device pool and the host swap tier
+  without retiring, so steps go to KV copies instead of decode
+  (docs/SERVING.md "Overload behavior").
 
 Events are plain dicts (``{"type": "watchdog_event", "class": ...,
 "epoch": ..., "detail": ..., "snapshot": ...}``), JSONL-dumpable via
@@ -71,6 +76,11 @@ WATCHDOG_CLASSES = (
      "median"),
     ("sanitizer-spike",
      "page-sanitizer violation count increased inside the window"),
+    ("preemption-thrash",
+     "preemption swap-outs per trailing window above "
+     "thrash_preempts: victims are being swapped out/in faster "
+     "than they make progress (capacity is oversubscribed beyond "
+     "what graceful degradation can absorb)"),
 )
 
 
@@ -113,7 +123,8 @@ class Watchdog:
                  collapse_min_baseline: float = 0.2,
                  collapse_min_samples: int = 8,
                  stall_factor: float = 8.0,
-                 stall_min_samples: int = 8):
+                 stall_min_samples: int = 8,
+                 thrash_preempts: int = 6):
         if registry is None:
             raise ValueError(
                 "Watchdog needs a live MetricsRegistry "
@@ -138,6 +149,7 @@ class Watchdog:
         self.collapse_min_samples = int(collapse_min_samples)
         self.stall_factor = float(stall_factor)
         self.stall_min_samples = int(stall_min_samples)
+        self.thrash_preempts = int(thrash_preempts)
         self.events = collections.deque(maxlen=max(8, log_capacity))
         self.dropped = 0
         self.checks = 0
@@ -147,6 +159,7 @@ class Watchdog:
         self._compile_obs = collections.deque()
         self._churn_obs = collections.deque()
         self._san_obs = collections.deque()
+        self._preempt_obs = collections.deque()
         # hysteresis latches: fire once per excursion, re-arm on
         # recovery instead of re-firing every stride
         self._latched = {cls: False for cls, _ in WATCHDOG_CLASSES}
@@ -154,7 +167,8 @@ class Watchdog:
         # their observation window at the first post-warmup check,
         # so compiles/churn that landed DURING warmup never count
         # toward the first live window
-        self._baselined = {"storm": False, "churn": False}
+        self._baselined = {"storm": False, "churn": False,
+                           "preempt": False}
         # the registry epoch at the first check(): warmup is RELATIVE
         # to it (the shared epoch never restarts per watchdog)
         self._first_epoch: Optional[int] = None
@@ -361,6 +375,38 @@ class Watchdog:
             self._san_obs.clear()
             self._san_obs.append((int(epoch), float(viol)))
 
+    def _check_preemption_thrash(self, epoch, fired):
+        # serving.preempt_victims is cumulative across the process
+        # (like compile.count); rate it over the window. A burst that
+        # preempts once and moves on is healthy degradation — the
+        # thrash signature is REPEATED swap-outs inside one window,
+        # i.e. victims bouncing between device and host without
+        # retiring (each bounce re-copies whole page chains, so the
+        # scheduler spends its steps moving KV instead of decoding)
+        viol = self.registry.counter("serving.preempt_victims")
+        delta = self._rate(self._preempt_obs, epoch, viol)
+        if self._warming(self._preempt_obs, epoch,
+                         (int(epoch), float(viol)), "preempt"):
+            return
+        if delta >= self.thrash_preempts:
+            if not self._latched["preemption-thrash"]:
+                self._latched["preemption-thrash"] = True
+                self._emit(
+                    "preemption-thrash", epoch,
+                    {"preemptions_in_window": delta,
+                     "swapped_now": self.registry.gauge_value(
+                         "serving.swapped_requests"),
+                     "swap_declines": self.registry.counter(
+                         "serving.preempt_swap_full"),
+                     "window": self.window,
+                     "threshold": self.thrash_preempts},
+                    self._ns_snapshot("serving"), fired)
+            # judge recovery on fresh data, like the storm detector
+            self._preempt_obs.clear()
+            self._preempt_obs.append((int(epoch), float(viol)))
+        else:
+            self._latched["preemption-thrash"] = False
+
     # -- the pass ----------------------------------------------------------
     def check(self, epoch: int,
               context: Optional[dict] = None) -> List[dict]:
@@ -383,6 +429,7 @@ class Watchdog:
         self._check_prefix_collapse(epoch, fired)
         self._check_decode_stall(epoch, fired)
         self._check_sanitizer_spike(epoch, fired, context)
+        self._check_preemption_thrash(epoch, fired)
         if fired and self.mode == "strict":
             raise WatchdogError(fired)
         for ev in fired:

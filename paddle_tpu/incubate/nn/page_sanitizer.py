@@ -516,6 +516,78 @@ class PageSanitizer:
         if pool is not None:
             self.verify_pages([src, dst], pool)
 
+    def _ev_swap_out(self, ev, pool):
+        """Host-tier swap-out: shared (kept) pages gain an external
+        swap-hold reference before the sequence's own references
+        drop; private pages return to the free list (their bytes
+        live on host now)."""
+        s = ev["seq"]
+        chain = self.chains.get(s)
+        if chain is None:
+            self._violate(
+                "double-free",
+                "swap_out(%r): unknown or already-freed sequence"
+                % (s,), ev)
+            return
+        kept = list(ev.get("kept") or [])
+        for (p, g), keep in zip(chain, kept):
+            if keep:
+                self.ref[p] += 1
+                self.ext[p] += 1
+        for p, g in reversed(chain):
+            self._release(p, g, ev, "swap_out(%r)" % (s,))
+        del self.chains[s]
+        del self.lens[s]
+
+    def _ev_swap_in(self, ev, pool):
+        """Host-tier swap-in: private positions are fresh draws
+        (restored bytes), kept positions must still be live, in the
+        SAME incarnation captured at swap-out, and carrying a swap
+        hold — a hold lost while the sequence was out is a
+        use-after-free here, not silent KV aliasing later."""
+        s = ev["seq"]
+        if s in self.chains:  # pool raises its own ValueError
+            return
+        gens = list(ev.get("gens") or [])
+        gi = 0
+        chain = []
+        for p, keep in zip(ev["pages"], ev["kept"]):
+            p = int(p)
+            if keep:
+                g = int(gens[gi]) if gi < len(gens) else self.gen[p]
+                gi += 1
+                if p in self.free or self.ref[p] == 0:
+                    self._violate(
+                        "use-after-free",
+                        "swap_in(%r): kept page %d was freed while "
+                        "the sequence was swapped out (the swap hold "
+                        "was lost)" % (s, p), ev)
+                elif self.gen[p] != g:
+                    self._violate(
+                        "use-after-free",
+                        "swap_in(%r): kept page %d was recycled while "
+                        "swapped out (captured generation %d, page at "
+                        "%d)" % (s, p, g, self.gen[p]), ev)
+                if self.ext[p] > 0:
+                    self.ext[p] -= 1
+                    if self.ext[p] == 0:
+                        del self.ext[p]
+                else:
+                    self._violate(
+                        "double-free",
+                        "swap_in(%r): no swap hold (external "
+                        "reference) on kept page %d" % (s, p), ev)
+                # the sequence reference replaces the hold: refcount
+                # net-unchanged
+                chain.append([p, self.gen[p]])
+            else:
+                g = self._draw(p, ev, "swap_in(%r)" % (s,))
+                chain.append([p, g])
+        self.chains[s] = chain
+        self.lens[s] = int(ev["length"])
+        if pool is not None and ev["pages"]:
+            self.verify_pages([int(p) for p in ev["pages"]], pool)
+
     def _ev_append(self, ev, pool):
         pages, offs = ev["pages"], ev["offs"]
         i = 0

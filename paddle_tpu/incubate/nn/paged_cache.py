@@ -48,15 +48,33 @@ validated against a shadow heap with per-page generation counters
 (use-after-free, double-free, refcount leaks, COW violations, stale
 kernel inputs, capacity drift). ``off`` (the default) allocates no
 shadow objects: each instrumented method pays one ``is None`` check.
+Tiered swap (``HostKVSwapSpace``): preemption pages a victim
+sequence's KV out to HOST buffers and back. ``swap_out`` copies the
+sequence's PRIVATE pages (refcount 1 — payload plus, when quantized,
+the per-page scale sidecar rows) to host bitwise and releases them;
+SHARED pages (a prefix-cache hit, a still-shared COW tail) stay
+on-device under an external "swap hold" reference, so pinning blocks
+eviction of shared pages but never blocks swapping the private ones.
+``swap_in`` draws fresh pages, restores the private bytes bitwise,
+takes the sequence references back and drops the holds — the restored
+chain is byte-identical to the swapped-out one, so greedy decode
+resumes exactly where it stopped. Swap records live ONLY in the
+byte-budgeted :class:`HostKVSwapSpace`; every transition is mirrored
+into the sanitizer shadow heap (``swap_out``/``swap_in`` events with
+generation-tagged kept pages — a hold lost while swapped out surfaces
+as use-after-free at swap-in, not as silent KV aliasing).
+
 ALL pool state (``k_pages``/``v_pages``/``k_scales``/``v_scales``,
-``_refcnt``/``_free``/``_tables``/``_lens``/``_ext_refs``) is
-pool-private — tools/lint_codebase.py's mutation audit rejects writes
-or private-method calls from serving code, so the sanitizer's event
+``_refcnt``/``_free``/``_tables``/``_lens``/``_ext_refs``, and the
+swap tier's ``_swap_store``/``_swap_used``) is pool-private —
+tools/lint_codebase.py's mutation audit rejects writes or
+private-method calls from serving code, so the sanitizer's event
 coverage is complete by construction.
 """
 from __future__ import annotations
 
 import collections
+import itertools
 
 import numpy as np
 
@@ -72,7 +90,119 @@ from ...ops.kernels.paged_attention import (
 )
 from ...ops.kernels.quant import kv_head_scale, quantize_kv
 
-__all__ = ["PagedKVCacheManager", "paged_attention"]
+__all__ = ["PagedKVCacheManager", "paged_attention",
+           "HostKVSwapSpace", "SwapSpaceFull"]
+
+_pool_uids = itertools.count()
+
+
+class SwapSpaceFull(RuntimeError):
+    """The host swap space cannot hold another record under its byte
+    budget (FLAGS_serving_swap_bytes) — the caller should pick a
+    different victim or fall back to blocking admission."""
+
+
+class _SwapRecord:
+    """One swapped-out sequence for ONE layer pool: the page chain as
+    it stood (``pages``/``kept``/``length``), host copies of the
+    private pages' payload (+ int8 scale rows), and the sanitizer
+    generations of the kept pages captured at swap-out."""
+
+    __slots__ = ("pages", "kept", "length", "k_host", "v_host",
+                 "k_scales_host", "v_scales_host", "gens", "nbytes")
+
+    def __init__(self, pages, kept, length, k_host, v_host,
+                 k_scales_host, v_scales_host, gens, nbytes):
+        self.pages = pages
+        self.kept = kept
+        self.length = length
+        self.k_host = k_host
+        self.v_host = v_host
+        self.k_scales_host = k_scales_host
+        self.v_scales_host = v_scales_host
+        self.gens = gens
+        self.nbytes = nbytes
+
+
+class HostKVSwapSpace:
+    """Byte-budgeted host tier for swapped-out KV page chains.
+
+    One space is shared by every layer pool of a model (and budgets
+    them jointly); records are keyed by (pool uid, seq id). The store
+    itself (``_swap_store``/``_swap_used``) is swap-tier-private
+    state, writable only through the pool's ``swap_out`` /
+    ``swap_in`` / ``swap_discard`` — the lint pool-mutation audit
+    extends to it, so the sanitizer's swap events see every
+    transition. Serving code reads the public byte/record accessors
+    only."""
+
+    def __init__(self, capacity_bytes):
+        self.capacity_bytes = int(capacity_bytes)
+        self._swap_store = {}
+        self._swap_used = 0
+        # lifetime counters (bench/test visibility)
+        self.swapped_out_records = 0
+        self.swapped_in_records = 0
+        self.peak_used_bytes = 0
+
+    # -- public (serving-visible) readout ----------------------------------
+    @property
+    def used_bytes(self) -> int:
+        return self._swap_used
+
+    @property
+    def free_bytes(self) -> int:
+        return max(self.capacity_bytes - self._swap_used, 0)
+
+    @property
+    def num_records(self) -> int:
+        return len(self._swap_store)
+
+    def would_fit(self, nbytes: int) -> bool:
+        return self._swap_used + int(nbytes) <= self.capacity_bytes
+
+    def holds(self, seq_id) -> bool:
+        """True if ANY pool holds a swap record for ``seq_id``."""
+        return any(k[1] == seq_id for k in self._swap_store)
+
+    def summary(self) -> dict:
+        return {
+            "capacity_bytes": self.capacity_bytes,
+            "used_bytes": self._swap_used,
+            "peak_used_bytes": self.peak_used_bytes,
+            "records": len(self._swap_store),
+            "swapped_out_records": self.swapped_out_records,
+            "swapped_in_records": self.swapped_in_records,
+        }
+
+    # -- pool-only entry points (audited like pool-private methods) --------
+    def _swap_put(self, key, rec):
+        if key in self._swap_store:
+            raise ValueError(
+                f"swap space already holds a record for {key!r}")
+        if self._swap_used + rec.nbytes > self.capacity_bytes:
+            raise SwapSpaceFull(
+                f"swap space full: record needs {rec.nbytes} bytes, "
+                f"{self.free_bytes} of {self.capacity_bytes} free")
+        self._swap_store[key] = rec
+        self._swap_used += rec.nbytes
+        self.swapped_out_records += 1
+        if self._swap_used > self.peak_used_bytes:
+            self.peak_used_bytes = self._swap_used
+
+    def _swap_get(self, key):
+        rec = self._swap_store.get(key)
+        if rec is None:
+            raise KeyError(f"no swap record for {key!r}")
+        return rec
+
+    def _swap_pop(self, key):
+        """Remove and return a record (swap-in restore or a deadline-
+        abort discard — the caller counts which)."""
+        rec = self._swap_get(key)
+        del self._swap_store[key]
+        self._swap_used -= rec.nbytes
+        return rec
 
 
 class PagedKVCacheManager:
@@ -122,6 +252,9 @@ class PagedKVCacheManager:
         self._free = list(range(num_pages))[::-1]
         self._tables = {}   # seq_id -> [page ids]
         self._lens = {}     # seq_id -> token count
+        # stable identity for swap-space keys (layer pools of one
+        # model share ONE HostKVSwapSpace; records key on (uid, seq))
+        self._uid = next(_pool_uids)
         self._refcnt = [0] * num_pages
         # references held by non-sequence owners (the prefix tree),
         # tracked separately so invariants are checkable without the
@@ -317,6 +450,12 @@ class PagedKVCacheManager:
         """The sequence's physical page chain (copy)."""
         return list(self._tables[seq_id])
 
+    def seq_page_count(self, seq_id) -> int:
+        """Pages the sequence holds, without materializing the chain
+        (victim scoring reads this for every active sequence on every
+        pick — ``len(seq_pages())`` would copy the table each time)."""
+        return len(self._tables[seq_id])
+
     def pending_cow(self, seq_id) -> bool:
         """True if the sequence's next append must fork a shared page
         (admission accounting: that fork draws one page from the
@@ -345,6 +484,173 @@ class PagedKVCacheManager:
         self._lens[seq_id] = n
         if self._san is not None and dropped:
             self._san.verify_pages(dropped, self)
+
+    # -- tiered host swap (preemption; HostKVSwapSpace) --------------------
+    def swap_out_pages(self, seq_id) -> int:
+        """Device pages a ``swap_out`` of this sequence would FREE
+        (its PRIVATE pages only — shared pages stay on-device under a
+        hold). Read-only: the scheduler sums this over candidate
+        victims to decide whether preemption can close an admission
+        deficit at all before swapping anyone out."""
+        tbl = self._tables.get(seq_id)
+        if tbl is None:
+            raise KeyError(f"swap_out_pages({seq_id!r}): unknown "
+                           "sequence")
+        return sum(1 for p in tbl if self._refcnt[p] == 1)
+
+    def swap_out_nbytes(self, seq_id) -> int:
+        """Host bytes a ``swap_out`` of this sequence would store
+        (its PRIVATE pages only). Read-only: the scheduler
+        budget-checks the swap space with this BEFORE picking a
+        victim."""
+        return self.swap_out_pages(seq_id) * self.page_nbytes
+
+    def swap_out(self, seq_id, space):
+        """Page the sequence out to the host tier: private pages
+        (refcount 1) are copied to host buffers BITWISE (payload +
+        int8 scale rows) and released back to the pool; shared pages
+        (prefix-cache chains, still-shared COW tails) stay on-device
+        under an external "swap hold" reference so they can neither
+        be freed nor recycled while the sequence is out. Atomic: the
+        host copy and the swap-space reservation both happen before
+        any bookkeeping mutation, so a full space
+        (:class:`SwapSpaceFull`) aborts with the pool untouched.
+        Returns ``(pages_freed, nbytes_swapped)``."""
+        tbl = self._tables.get(seq_id)
+        if tbl is None:
+            raise KeyError(f"swap_out({seq_id!r}): unknown sequence")
+        length = self._lens[seq_id]
+        kept = [self._refcnt[p] > 1 for p in tbl]
+        priv = [p for p, k in zip(tbl, kept) if not k]
+        shared = [p for p, k in zip(tbl, kept) if k]
+        k_host = v_host = ks_host = vs_host = None
+        if priv:
+            pg = jnp.asarray(priv, jnp.int32)
+            k_host = np.asarray(self.k_pages[pg])
+            v_host = np.asarray(self.v_pages[pg])
+            if self.quantized:
+                ks_host = np.asarray(self.k_scales[pg])
+                vs_host = np.asarray(self.v_scales[pg])
+        gens = (self._san.page_gens(shared)
+                if self._san is not None else None)
+        rec = _SwapRecord(
+            pages=list(tbl), kept=kept, length=length, k_host=k_host,
+            v_host=v_host, k_scales_host=ks_host,
+            v_scales_host=vs_host, gens=gens,
+            nbytes=len(priv) * self.page_nbytes)
+        space._swap_put((self._uid, seq_id), rec)
+        if self._san is not None:
+            self._san.event("swap_out", seq=seq_id,
+                            pages=[int(p) for p in tbl],
+                            kept=[bool(k) for k in kept],
+                            length=int(length))
+        # the swap hold: each shared page gains an external reference
+        # BEFORE the sequence's own references drop, so its refcount
+        # never transits zero
+        for p in shared:
+            self._refcnt[p] += 1
+            self._ext_refs[p] += 1
+        del self._tables[seq_id]
+        self._lens.pop(seq_id)
+        freed = 0
+        for p in reversed(tbl):
+            freed += self._release_page(p)
+        if self._san is not None and tbl:
+            self._san.verify_pages(tbl, self)
+        if self._reg is not None:
+            self._reg.inc("pool.swap_out_pages", freed)
+        return freed, rec.nbytes
+
+    def swap_in_pages_needed(self, seq_id, space,
+                             worst_tokens=None) -> int:
+        """Free-list draws a ``swap_in`` (plus, when ``worst_tokens``
+        is given, growing to that worst-case length afterwards) would
+        make: one per private page to restore, the remaining growth
+        pages past the restored length, and the pending COW fork when
+        the restored tail page is shared and mid-page — the admission
+        reservation a re-admit must hold."""
+        rec = space._swap_get((self._uid, seq_id))
+        need = sum(1 for k in rec.kept if not k)
+        have = -(-rec.length // self.page_size) if rec.length else 0
+        if worst_tokens is not None:
+            need += max(
+                -(-int(worst_tokens) // self.page_size) - have, 0)
+        if rec.kept and rec.kept[-1] and rec.length % self.page_size:
+            need += 1
+        return need
+
+    def swap_in(self, seq_id, space):
+        """Restore a swapped-out sequence: draw fresh pages for the
+        private positions and write their host bytes back BITWISE,
+        re-take the sequence references on the kept (shared) pages
+        and drop their swap holds. The restored chain is
+        byte-identical to the swapped-out one (the page IDS of
+        private positions change; contents and order do not).
+        Atomic: capacity is validated before any mutation. Returns
+        the number of pages restored from host."""
+        if seq_id in self._tables:
+            raise ValueError(
+                f"swap_in({seq_id!r}): sequence already allocated")
+        key = (self._uid, seq_id)
+        rec = space._swap_get(key)
+        priv_n = sum(1 for k in rec.kept if not k)
+        if priv_n > len(self._free):
+            raise RuntimeError(
+                f"KV page pool exhausted: swap_in needs {priv_n} "
+                f"pages, {len(self._free)} free")
+        chain = []
+        new_priv = []
+        for p, k in zip(rec.pages, rec.kept):
+            if k:
+                chain.append(p)
+            else:
+                q = self._alloc_page()
+                chain.append(q)
+                new_priv.append(q)
+        if new_priv:
+            pg = jnp.asarray(new_priv, jnp.int32)
+            self.k_pages = self.k_pages.at[pg].set(
+                jnp.asarray(rec.k_host, self.k_pages.dtype))
+            self.v_pages = self.v_pages.at[pg].set(
+                jnp.asarray(rec.v_host, self.v_pages.dtype))
+            if self.quantized:
+                self.k_scales = self.k_scales.at[pg].set(
+                    jnp.asarray(rec.k_scales_host, jnp.float32))
+                self.v_scales = self.v_scales.at[pg].set(
+                    jnp.asarray(rec.v_scales_host, jnp.float32))
+        for p, k in zip(rec.pages, rec.kept):
+            if k:
+                # the sequence reference replaces the swap hold: net
+                # refcount unchanged, ownership moves back
+                self._ext_refs[p] -= 1
+                if self._ext_refs[p] == 0:
+                    del self._ext_refs[p]
+        self._tables[seq_id] = chain
+        self._lens[seq_id] = rec.length
+        if self._san is not None:
+            self._san.event(
+                "swap_in", seq=seq_id,
+                pages=[int(p) for p in chain],
+                kept=[bool(k) for k in rec.kept],
+                length=int(rec.length),
+                gens=None if rec.gens is None
+                else [int(g) for g in rec.gens],
+                pool=self)
+        space._swap_pop(key)
+        space.swapped_in_records += 1
+        if self._reg is not None:
+            self._reg.inc("pool.swap_in_pages", len(new_priv))
+        return len(new_priv)
+
+    def swap_discard(self, seq_id, space):
+        """Drop a swap record without restoring it (deadline abort of
+        a swapped-out request): releases the swap holds on the kept
+        pages through the instrumented ``decref`` path and frees the
+        host bytes. Returns the pages released back to the pool."""
+        rec = space._swap_pop((self._uid, seq_id))
+        shared = [p for p, k in zip(rec.pages, rec.kept) if k]
+        freed = self.decref(shared) if shared else 0
+        return freed
 
     @property
     def num_free_pages(self) -> int:

@@ -21,6 +21,7 @@ __all__ = [
     "Request",
     "BatchScheduler",
     "RequestState",
+    "QueueFullError",
     "PagedLlamaAdapter",
     "RadixPrefixCache",
     "PrefixMatch",
@@ -29,6 +30,7 @@ __all__ = [
 
 from .serving import (  # noqa: E402
     BatchScheduler,
+    QueueFullError,
     Request,
     RequestState,
     bucket_packed_tokens,

@@ -151,6 +151,23 @@ class PagedLlamaAdapter:
         scheduler hands the radix tree at retire."""
         return [c.seq_pages(seq_id) for c in self.caches]
 
+    # -- preemption hooks (tiered KV swap; docs/SERVING.md) ----------------
+    def swap_out(self, seq_id, space):
+        """Page the sequence out of EVERY layer pool into the shared
+        host swap space (scheduler preemption). Returns
+        (pages_freed, nbytes_swapped) summed across layers."""
+        freed = nbytes = 0
+        for c in self.caches:
+            fp, nb = c.swap_out(seq_id, space)
+            freed += fp
+            nbytes += nb
+        return freed, nbytes
+
+    def swap_in(self, seq_id, space):
+        """Restore a swapped-out sequence into every layer pool
+        (bitwise). Returns pages restored from host."""
+        return sum(c.swap_in(seq_id, space) for c in self.caches)
+
     def decode_token(self, token_ids, seq_ids):
         """One token per listed sequence; returns logits (B, vocab)."""
         cfg = self.cfg

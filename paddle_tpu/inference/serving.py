@@ -53,6 +53,35 @@ sequence's cached tokens are inserted into the tree instead of dying
 with the sequence, and an LRU-by-leaf evictor reclaims unpinned
 cached pages whenever admission would otherwise cross the watermark.
 
+Overload survival (docs/SERVING.md "Overload behavior"): capacity
+pressure means SLOWER, never FAILED. The submit queue is bounded
+(``FLAGS_serving_max_queue`` -> :class:`QueueFullError` backpressure)
+and ordered by per-request ``priority`` (FIFO within a priority;
+``max_inflight_per_tenant`` caps any one tenant's active share). When
+admission cannot reserve pages for a request even after prefix-cache
+eviction, the scheduler PREEMPTS strictly-lower-priority victims
+(lowest priority, then most pages held, then least progress) instead
+of blocking behind them: a victim's private KV pages swap out
+BITWISE to the host tier (``HostKVSwapSpace``,
+``FLAGS_serving_swap_bytes``; shared prefix pages stay on-device
+under swap holds — pins block eviction of shared pages, never the
+swap of private ones) and restore bitwise on re-admission, which is
+just another packed prompt resume through the ragged chunked-prefill
+path. Per-request deadlines (``deadline_s``) abort expired work at
+step boundaries into the distinct ``aborted_deadline`` terminal
+state, releasing every reservation (queued, active mid-prefill, or
+swapped-out alike). Admission failures are counted DISTINCTLY
+(``admit_reject_pool`` vs ``admit_evict_then_admit`` vs
+``admit_preempt_then_admit`` vs ``admit_reject_queue_full`` vs
+``aborted_deadline``) so goodput/SLO attainment stays truthful under
+overload — aborted requests count as SLO misses in the goodput
+window. A deterministic fault-injection harness
+(incubate/nn/fault_injection.py, ``FLAGS_serving_faults``) perturbs
+the scheduler at step boundaries only — forced pool exhaustion,
+preemption storms, delayed swap-in, simulated step failure with
+retry/backoff — and every fault must be absorbed with greedy outputs
+bit-identical to an uninjected run.
+
 Telemetry (``FLAGS_telemetry=metrics|trace``; framework/telemetry.py):
 the scheduler is the primary producer of the ``serving.*`` registry
 namespace — per-request TTFT / TPOT / queue-wait / retire-latency
@@ -82,7 +111,14 @@ from ..framework.flags import flag
 from ..framework.telemetry import NULL_SPAN as _NULL
 
 __all__ = ["Request", "BatchScheduler", "RequestState",
-           "bucket_packed_tokens"]
+           "bucket_packed_tokens", "QueueFullError"]
+
+
+class QueueFullError(RuntimeError):
+    """submit() backpressure: the bounded queue
+    (``FLAGS_serving_max_queue`` / ``max_queue=``) is at capacity —
+    the caller should shed load or retry later (the counted-distinct
+    ``serving.admit_reject_queue_full`` signal)."""
 
 
 def _parse_buckets(spec) -> tuple:
@@ -120,7 +156,12 @@ class RequestState:
     QUEUED = "queued"
     PREFILL = "prefill"
     DECODE = "decode"
+    # preempted: KV paged out to the host tier, awaiting re-admission
+    SWAPPED = "swapped"
     FINISHED = "finished"
+    # terminal, DISTINCT from finished: the deadline expired before
+    # completion and every reservation was released
+    ABORTED_DEADLINE = "aborted_deadline"
 
 
 @dataclass
@@ -136,11 +177,22 @@ class Request:
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
     on_token: Optional[Callable] = None
+    # overload-survival knobs: admission orders by priority (higher
+    # wins; FIFO within), preemption only ever evicts STRICTLY
+    # lower-priority victims; tenant feeds max_inflight_per_tenant;
+    # deadline_s (seconds from submit) aborts expired work at step
+    # boundaries into the aborted_deadline terminal state
+    priority: int = 0
+    tenant: str = "default"
+    deadline_s: Optional[float] = None
     state: str = RequestState.QUEUED
     generated_ids: List[int] = field(default_factory=list)
     _pos: int = 0  # prompt tokens consumed so far
     _prefix_hit: int = 0  # prompt tokens served from the prefix cache
     _prefix_path: tuple = ()  # pinned radix nodes (unpinned at retire)
+    _order: int = 0  # submit sequence number (FIFO within priority)
+    _t_deadline: float = 0.0  # absolute clock deadline (0 = none)
+    _preemptions: int = 0  # times this request was swapped out
     # telemetry timestamps (telemetry.clock(); 0.0 = never stamped —
     # only written when the scheduler's registry handle is live)
     _t_submit: float = 0.0
@@ -155,6 +207,13 @@ class Request:
     @property
     def finished(self) -> bool:
         return self.state == RequestState.FINISHED
+
+    @property
+    def terminal(self) -> bool:
+        """Finished OR deadline-aborted — the request left the
+        scheduler either way (both land in ``result()``)."""
+        return self.state in (RequestState.FINISHED,
+                              RequestState.ABORTED_DEADLINE)
 
     def total_tokens(self) -> int:
         return len(self.prompt_ids) + self.max_new_tokens
@@ -175,7 +234,9 @@ class BatchScheduler:
                  sampler=None, draft_model=None, draft_k=4,
                  prefix_cache=None, chunked_prefill=None,
                  prefill_chunk_tokens=None, serving_buckets=None,
-                 prefix_align=1, slo=None, watchdog=None):
+                 prefix_align=1, slo=None, watchdog=None,
+                 max_queue=None, max_inflight_per_tenant=None,
+                 preempt=None, swap_bytes=None, fault_injector=None):
         self.model = model
         self.max_batch_size = int(max_batch_size)
         self.page_watermark = float(page_watermark)
@@ -261,6 +322,50 @@ class BatchScheduler:
                 "speculative decoding")
         self.spec_stats = {"rounds": 0, "target_calls": 0,
                            "draft_calls": 0, "committed_tokens": 0}
+        # overload survival (module docstring "Overload survival"):
+        # bounded submit queue + per-tenant in-flight cap + sequence
+        # preemption onto the host swap tier + deadline aborts
+        self.max_queue = int(flag("serving_max_queue")
+                             if max_queue is None else max_queue)
+        self.max_inflight_per_tenant = (
+            None if max_inflight_per_tenant is None
+            else max(1, int(max_inflight_per_tenant)))
+        self._submit_seq = 0
+        self._swapped = {}  # req_id -> Request (insertion = FIFO)
+        # admission fast-path latches: until a nonzero priority (or a
+        # deadline) is ever submitted, candidate picking stays the
+        # O(1) FIFO head and the per-step deadline sweep is skipped —
+        # the defaults cost nothing extra under a deep backlog
+        self._plain_fifo = True
+        self._deadline_seen = False
+        preempt = bool(flag("serving_preempt")
+                       if preempt is None else preempt)
+        swap_bytes = int(flag("serving_swap_bytes")
+                         if swap_bytes is None else swap_bytes)
+        self.swap_space = None
+        if preempt and swap_bytes > 0 and draft_model is None:
+            # the draft adapter keeps its OWN KV pool; swapping the
+            # target without the draft would desynchronize them, so
+            # speculative scheduling keeps wait-in-queue admission
+            from ..incubate.nn.paged_cache import HostKVSwapSpace
+
+            self.swap_space = HostKVSwapSpace(swap_bytes)
+        self._preempt_enabled = self.swap_space is not None
+        # deterministic fault injection (fault_injection.py): None
+        # (the default, empty FLAGS_serving_faults) costs one is-None
+        # check per step and imports nothing
+        if fault_injector is None:
+            spec = str(flag("serving_faults"))
+            if spec.strip():
+                from ..incubate.nn.fault_injection import FaultInjector
+
+                fault_injector = FaultInjector(spec)
+        self._faults = fault_injector
+        self._fault_step = 0
+        self._consec_fails = 0
+        self._resume_at = 0
+        self._step_extras = {}
+        self._admitted_step = 0
         # page-sanitizer epoch cross-check (page_sanitizer.py): every
         # stride steps, shadow-vs-real on every cache; strict-mode
         # pools also run assert_ref_invariants there
@@ -384,6 +489,9 @@ class BatchScheduler:
             # mean different things — keep them in separate blocks
             stats["prefix_cache"] = dict(self.prefix_stats)
             stats["prefix_cache"]["tree"] = self.prefix_cache.summary()
+        if self.swap_space is not None:
+            stats["swap"] = self.swap_space.summary()
+            stats["swap"]["swapped_requests"] = len(self._swapped)
         all_caches = caches + (list(self.draft.caches)
                                if self.draft is not None else [])
         san = [s for s in (getattr(c, "sanitizer_stats", None)
@@ -486,6 +594,10 @@ class BatchScheduler:
         m.gauge("serving.active_requests", len(self._active))
         m.gauge("serving.queued_requests", len(self._queue))
         m.gauge("serving.retired_requests", len(self._finished))
+        m.gauge("serving.swapped_requests", len(self._swapped))
+        if self.swap_space is not None:
+            m.gauge("serving.swap_used_bytes",
+                    self.swap_space.used_bytes)
         self._publish_slo_gauges()
         return stats
 
@@ -537,6 +649,27 @@ class BatchScheduler:
                 f"but the pool watermark admits at most "
                 f"{int(self.page_watermark * total)} of {total}"
             )
+        # bounded-queue backpressure: past max_queue waiting requests,
+        # shedding load at submit beats unbounded memory growth and a
+        # silently exploding queue-wait tail
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            if self._metrics is not None:
+                self._metrics.inc("serving.admit_reject_queue_full")
+            raise QueueFullError(
+                f"request {req.req_id!r} rejected: submit queue at "
+                f"capacity ({self.max_queue}); shed load or retry "
+                "(FLAGS_serving_max_queue)")
+        if req.deadline_s is not None:
+            if req.deadline_s <= 0:
+                raise ValueError(
+                    f"request {req.req_id!r}: deadline_s must be "
+                    f"positive, got {req.deadline_s}")
+            req._t_deadline = telemetry.clock() + float(req.deadline_s)
+            self._deadline_seen = True
+        if req.priority:
+            self._plain_fifo = False
+        self._submit_seq += 1
+        req._order = self._submit_seq
         if self._metrics is not None:
             req._t_submit = telemetry.clock()
         if self._traces is not None:
@@ -547,10 +680,67 @@ class BatchScheduler:
         self._queue.append(req)
         return req.req_id
 
+    def _tenant_full(self, tenant) -> bool:
+        """True when the tenant already holds its max in-flight share
+        of the active batch (multi-tenant fairness; None = no cap)."""
+        if self.max_inflight_per_tenant is None:
+            return False
+        n = sum(1 for r in self._active.values()
+                if r.tenant == tenant)
+        return n >= self.max_inflight_per_tenant
+
+    def _pick_queued(self):
+        """The admission candidate: highest priority first, FIFO
+        within a priority, skipping tenant-capped requests. With
+        default priorities and no tenant cap this is exactly the old
+        FIFO head — and costs exactly the old O(1), not a scan (a
+        deep backlog is precisely when admission runs hottest)."""
+        if self._plain_fifo and self.max_inflight_per_tenant is None:
+            return self._queue[0] if self._queue else None
+        cap = self.max_inflight_per_tenant
+        # one O(active) tenant census per scan, not one per queued
+        # element — a deep backlog is exactly when this runs hottest
+        counts = (collections.Counter(r.tenant
+                                      for r in self._active.values())
+                  if cap is not None else None)
+        best, bk = None, None
+        for req in self._queue:
+            if counts is not None and counts[req.tenant] >= cap:
+                continue
+            k = (-req.priority, req._order)
+            if best is None or k < bk:
+                best, bk = req, k
+        return best
+
+    def _pop_queued(self, req):
+        """Remove an admitted candidate from the queue (O(1) for the
+        head — the plain-FIFO common case)."""
+        if self._queue and self._queue[0] is req:
+            self._queue.popleft()
+        else:
+            self._queue.remove(req)
+
     def _try_admit(self):
         hit_tokens_admitted = 0
+        if self._faults is not None \
+                and self._faults.pool_exhausted(self._fault_step):
+            # injected pool exhaustion: admission (and swap-in) sees
+            # a full pool; active decode continues untouched
+            self._note_fault("exhaust")
+            return 0
+        head = self._pick_queued()
+        self._admit_swapped(None if head is None else head.priority)
         while self._queue and len(self._active) < self.max_batch_size:
-            req = self._queue[0]
+            # the head pick is still the right candidate unless the
+            # swap-ins above filled its tenant's in-flight share —
+            # don't pay a second full queue scan to rediscover it
+            if head is not None and not self._tenant_full(head.tenant):
+                req = head
+            else:
+                req = self._pick_queued()
+            head = None
+            if req is None:
+                break  # every queued request is tenant-capped
             hit = None
             if self.prefix_cache is not None:
                 # a blocked head-of-queue request would re-walk the
@@ -597,13 +787,48 @@ class BatchScheduler:
                     projected = (used
                                  + self._reserved_pages_outstanding()
                                  + need)
+            preempted = False
+            if (projected > self.page_watermark * total
+                    and self._preempt_enabled):
+                # preempt-instead-of-reject: swap strictly-lower-
+                # priority victims out to the host tier until the
+                # candidate's reservation fits (or no victim remains).
+                # Guarded on the victims' reachable releasable pages
+                # covering the deficit: swapping a victim out only to
+                # learn the candidate STILL doesn't fit buys nothing —
+                # next step's idle-capacity swap-in undoes it and the
+                # same admission attempt preempts it again, a
+                # deterministic host-copy ping-pong until the blocking
+                # peer retires
+                relief, space_blocked = self._releasable_pages(
+                    req.priority)
+                if relief >= projected - self.page_watermark * total:
+                    while projected > self.page_watermark * total:
+                        victim = self._pick_victim(
+                            max_priority=req.priority)
+                        if victim is None or not self._preempt(
+                                victim, reason="admit"):
+                            break
+                        preempted = True
+                        total, free = self._pool()
+                        used = total - free
+                        projected = (
+                            used + self._reserved_pages_outstanding()
+                            + need)
+                elif space_blocked and self._metrics is not None:
+                    # the guard declined because the HOST TIER cannot
+                    # hold the victims, not because the pool math
+                    # falls short — keep that signal distinct (it
+                    # used to be counted by _preempt's own refusal)
+                    self._metrics.inc("serving.preempt_swap_full")
             if projected > self.page_watermark * total:
                 if hit_len:
                     self.prefix_cache.unpin(hit.path)
-                # admission-side failure accounting (ISSUE 8): a
-                # pool-capacity reject is ITS OWN signal — the future
+                # admission-side failure accounting (ISSUE 8/9): a
+                # pool-capacity block is ITS OWN signal — the
                 # admission controller must distinguish "the pool is
                 # full" from "we made room by evicting cached pages"
+                # from "we made room by preempting" (counted below)
                 if self._metrics is not None:
                     self._metrics.inc("serving.admit_reject_pool")
                 return hit_tokens_admitted
@@ -624,7 +849,7 @@ class BatchScheduler:
                         self._metrics.inc(
                             "serving.admit_reject_draft_pool")
                     return hit_tokens_admitted
-            self._queue.popleft()
+            self._pop_queued(req)
             self._match_memo = None
             if hit_len:
                 # cached prefill: share the matched chain and start
@@ -651,6 +876,7 @@ class BatchScheduler:
                 self.draft.alloc(req.req_id)
             req.state = RequestState.PREFILL
             self._active[req.req_id] = req
+            self._admitted_step += 1
             if self._metrics is not None:
                 req._qwait = telemetry.clock() - req._t_submit
                 self._metrics.observe("serving.queue_wait_s",
@@ -659,6 +885,9 @@ class BatchScheduler:
                 if evicted:
                     self._metrics.inc(
                         "serving.admit_evict_then_admit")
+                if preempted:
+                    self._metrics.inc(
+                        "serving.admit_preempt_then_admit")
             if self._traces is not None:
                 self._traces.event(
                     req.req_id, "admit", telemetry.clock(),
@@ -666,26 +895,273 @@ class BatchScheduler:
                     evicted_for_room=evicted)
         return hit_tokens_admitted
 
-    def _reserved_pages_outstanding(self) -> int:
-        """Worst-case free-list draws still ahead of active requests:
-        pages to reach the worst-case table size, measured from the
-        caches' actual state (the freshly sampled token is only
-        appended next step, and an attached prefix chain was shared
-        rather than drawn), plus one draw per cache whose partial tail
-        page is still shared (the pending copy-on-write fork)."""
-        slack = (self.draft_k + 1) if self.draft is not None else 0
-        out = 0
-        for req in self._active.values():
-            worst = req.total_tokens() + slack
+    # -- preemption + tiered KV swap ---------------------------------------
+    def _admit_swapped(self, queued_priority=None):
+        """Re-admit swapped-out requests (highest priority first,
+        FIFO within) while their restore + worst-case growth
+        reservation fits under the watermark. A blocked
+        highest-priority victim blocks the ones behind it — swapped
+        requests must never be starved by smaller late arrivals.
+        ``queued_priority`` is the best queued candidate's priority:
+        a swapped request of STRICTLY lower priority yields to it
+        (restoring first would either steal the last batch slot from
+        the higher-priority arrival or be re-preempted right after —
+        a wasted host round trip); equal priority resumes first (it
+        was admitted once already and its submit order is older)."""
+        if not self._swapped:
+            return
+        if self._faults is not None \
+                and self._faults.swap_in_delayed(self._fault_step):
+            self._note_fault("delay_swap_in")
+            return
+        order = sorted(self._swapped.values(),
+                       key=lambda r: (-r.priority, r._order))
+        for req in order:
+            if queued_priority is not None \
+                    and req.priority < queued_priority:
+                break  # the queue's best outranks this one and the
+                #        rest of the (sorted) swapped set
+            if len(self._active) >= self.max_batch_size:
+                break
+            if self._tenant_full(req.tenant):
+                continue
+            worst = req.total_tokens() + (
+                (self.draft_k + 1) if self.draft is not None else 0)
+            need = sum(
+                c.swap_in_pages_needed(req.req_id, self.swap_space,
+                                       worst)
+                for c in self.model.caches)
+            total, free = self._pool()
+            used = total - free
+            projected = (used + self._reserved_pages_outstanding()
+                         + need)
+            if (projected > self.page_watermark * total
+                    and self.prefix_cache is not None):
+                deficit = int(np.ceil(
+                    projected - self.page_watermark * total))
+                if self.prefix_cache.evict(deficit):
+                    total, free = self._pool()
+                    used = total - free
+                    projected = (used
+                                 + self._reserved_pages_outstanding()
+                                 + need)
+            if projected > self.page_watermark * total:
+                break
+            self._swap_in(req)
+
+    def _swap_in(self, req: "Request"):
+        """Restore a swapped-out request: bitwise page restore
+        through the pool's swap tier, then back into the active set —
+        resuming is just another packed prompt/decode row next step
+        (the chunked-prefill path needs no special case)."""
+        rid = req.req_id
+        with self._span("serving.swap_in", req=rid):
+            fn = getattr(self.model, "swap_in", None)
+            if fn is not None:
+                restored = fn(rid, self.swap_space)
+            else:
+                restored = sum(c.swap_in(rid, self.swap_space)
+                               for c in self.model.caches)
+        del self._swapped[rid]
+        req.state = (RequestState.DECODE if req.generated_ids
+                     else RequestState.PREFILL)
+        self._active[rid] = req
+        self._admitted_step += 1
+        self._step_extras["resumed"] = \
+            self._step_extras.get("resumed", 0) + 1
+        if self._metrics is not None:
+            self._metrics.inc("serving.swap_in_requests")
+            self._metrics.inc("serving.swap_in_pages", restored)
+        if self._traces is not None:
+            self._traces.event(
+                req.req_id, "admit", telemetry.clock(),
+                self._step_epoch, swapped_in=True, pages=restored)
+
+    def _victim_key(self, r):
+        """Victim scoring: lowest priority first, then most pages
+        held (frees the most room), then least progress (throws away
+        the least work), then submit order for determinism. ONE
+        definition, shared by the preempt loop's pick and the relief
+        guard's walk — if they ordered victims differently the guard
+        would mispredict what the loop can actually free."""
+        held = sum(c.seq_page_count(r.req_id)
+                   for c in self.model.caches)
+        return (r.priority, -held, len(r.generated_ids), r._order)
+
+    def _pick_victim(self, max_priority=None):
+        """The preemption victim by :meth:`_victim_key`.
+        ``max_priority`` restricts to STRICTLY lower priorities (an
+        admission candidate may never preempt its own class)."""
+        cands = [r for r in self._active.values()
+                 if max_priority is None or r.priority < max_priority]
+        return min(cands, key=self._victim_key) if cands else None
+
+    def _releasable_pages(self, max_priority):
+        """``(pages, space_blocked)``: the projected-demand relief
+        preempting the strictly-lower-priority active victims would
+        buy — each victim frees its private pages (shared pages stay
+        resident under swap holds) AND its remaining worst-case
+        reservation leaves the admission projection with it. Victims
+        are walked in the preempt loop's own order and stop counting
+        at the first whose host copy no longer fits the swap space —
+        ``_preempt`` would refuse it there and the loop would break,
+        so pages past that point are unreachable relief
+        (``space_blocked`` reports that cut so the caller can count
+        the decline as a swap-space failure, not a pool reject). The
+        admission pass checks the total against its deficit before
+        swapping anyone out."""
+        space = self.swap_space
+        if space is None:
+            return 0, False
+        victims = sorted(
+            (r for r in self._active.values()
+             if r.priority < max_priority), key=self._victim_key)
+        budget = space.free_bytes
+        pages = 0
+        for r in victims:
+            nbytes = sum(c.swap_out_nbytes(r.req_id)
+                         for c in self.model.caches)
+            if nbytes > budget:
+                return pages, True
+            budget -= nbytes
             for c in self.model.caches:
-                n = c.seq_len(req.req_id)
-                have = -(-n // c.page_size) if n else 0
-                rem = -(-worst // c.page_size) - have
-                pcow = getattr(c, "pending_cow", None)
-                if pcow is not None and pcow(req.req_id):
-                    rem += 1
-                out += max(rem, 0)
-        return out
+                pages += (c.swap_out_pages(r.req_id)
+                          + self._growth_pages(r, c))
+        return pages, False
+
+    def _preempt(self, req: "Request", reason: str) -> bool:
+        """Swap one active request out to the host tier. Returns
+        False (and changes nothing — swap_out is atomic) when the
+        swap space cannot hold the victim's private pages."""
+        rid = req.req_id
+        space = self.swap_space
+        if space is None:
+            return False
+        est = sum(c.swap_out_nbytes(rid) for c in self.model.caches)
+        if not space.would_fit(est):
+            if self._metrics is not None:
+                self._metrics.inc("serving.preempt_swap_full")
+            return False
+        freed = 0
+        nbytes = 0
+        with self._span("serving.preempt", req=rid, reason=reason):
+            fn = getattr(self.model, "swap_out", None)
+            if fn is not None:
+                freed, nbytes = fn(rid, space)
+            else:
+                for c in self.model.caches:
+                    fp, nb = c.swap_out(rid, space)
+                    freed += fp
+                    nbytes += nb
+        req.state = RequestState.SWAPPED
+        req._preemptions += 1
+        self._active.pop(rid)
+        self._swapped[rid] = req
+        self._step_extras["preempted"] = \
+            self._step_extras.get("preempted", 0) + 1
+        if self._metrics is not None:
+            self._metrics.inc("serving.preempt_victims")
+            self._metrics.inc("serving.preempt_pages", freed)
+            self._metrics.inc("serving.swap_out_bytes", nbytes)
+        if self._traces is not None:
+            # the PR-8-reserved "evict" request-trace event, live:
+            # non-terminal (the request resumes), rendered as an
+            # instant marker on the request's chrome lane
+            self._traces.event(
+                rid, "evict", telemetry.clock(), self._step_epoch,
+                reason=reason, pages=freed, bytes=nbytes)
+        return True
+
+    # -- deadlines ---------------------------------------------------------
+    def _expire_deadlines(self):
+        """Abort every request whose deadline passed — queued, active
+        mid-generation, or swapped-out alike — at the step boundary
+        (never mid-model-call). One clock read per step; until any
+        deadlined request is submitted the sweep is skipped
+        entirely."""
+        if not self._deadline_seen:
+            return
+        now = telemetry.clock()
+
+        def gone(req):
+            return req._t_deadline and now >= req._t_deadline
+
+        for req in [r for r in self._queue if gone(r)]:
+            self._queue.remove(req)
+            self._abort_deadline(req, "queued")
+        for req in [r for r in self._active.values() if gone(r)]:
+            self._abort_deadline(req, "active")
+        for req in [r for r in self._swapped.values() if gone(r)]:
+            self._abort_deadline(req, "swapped")
+
+    def _abort_deadline(self, req: "Request", where: str):
+        """Terminal deadline abort: release EVERY reservation this
+        request holds (pins, pages, swap records), count it
+        distinctly, and emit the terminal trace event. Lands in
+        ``result()`` with state ``aborted_deadline``."""
+        rid = req.req_id
+        if self.prefix_cache is not None and req._prefix_path:
+            self.prefix_cache.unpin(req._prefix_path)
+            req._prefix_path = ()
+        if where == "active":
+            self.model.free(rid)
+            if self.draft is not None:
+                self.draft.free(rid)
+            self._active.pop(rid)
+        elif where == "swapped":
+            for c in self.model.caches:
+                c.swap_discard(rid, self.swap_space)
+            del self._swapped[rid]
+        req.state = RequestState.ABORTED_DEADLINE
+        self._finished[rid] = req
+        self._step_extras["aborted"] = \
+            self._step_extras.get("aborted", 0) + 1
+        if self._metrics is not None:
+            self._metrics.inc("serving.aborted_deadline")
+            self._slo_note_abort(req)
+        if self._traces is not None:
+            self._traces.complete(
+                rid, "abort", telemetry.clock(), self._step_epoch,
+                reason="deadline", where=where,
+                generated_tokens=len(req.generated_ids))
+
+    def _slo_note_abort(self, req: "Request"):
+        """A deadline abort is an SLO MISS by definition: it enters
+        the goodput window with every configured SLO unmet, so
+        attainment stays truthful under overload (dropping aborts
+        would inflate goodput exactly when it matters most)."""
+        if self._slo is None:
+            return
+        met = {key: False
+               for key in self._slo.request_meets(None, None, None)}
+        self._slo_window.append((self._step_epoch, False, met))
+        self._publish_slo_gauges()
+
+    def _growth_pages(self, req: "Request", c) -> int:
+        """Worst-case free-list draws still ahead of ``req`` on cache
+        ``c``: pages to reach the worst-case table size, measured
+        from the cache's actual state (the freshly sampled token is
+        only appended next step, and an attached prefix chain was
+        shared rather than drawn), plus one draw when the partial
+        tail page is still shared (the pending copy-on-write fork).
+        ONE definition, shared by the admission reservation and the
+        preemption relief guard."""
+        slack = (self.draft_k + 1) if self.draft is not None else 0
+        worst = req.total_tokens() + slack
+        n = c.seq_len(req.req_id)
+        have = -(-n // c.page_size) if n else 0
+        rem = -(-worst // c.page_size) - have
+        pcow = getattr(c, "pending_cow", None)
+        if pcow is not None and pcow(req.req_id):
+            rem += 1
+        return max(rem, 0)
+
+    def _reserved_pages_outstanding(self) -> int:
+        """Worst-case free-list draws still ahead of the whole active
+        set (see :meth:`_growth_pages`)."""
+        return sum(self._growth_pages(req, c)
+                   for req in self._active.values()
+                   for c in self.model.caches)
 
     def _attach_prefix(self, seq_id, chains, length):
         """Model hook with a caches-level fallback, so any model
@@ -757,6 +1233,12 @@ class BatchScheduler:
                 generated_tokens=len(req.generated_ids),
                 prefix_hit_tokens=req._prefix_hit,
                 slo_met=met)
+        # terminal bookkeeping lives HERE, next to the terminal trace
+        # emit above — the serving-terminal-trace lint rule holds any
+        # function that drops a request to that pairing
+        req.state = RequestState.FINISHED
+        del self._active[req.req_id]
+        self._finished[req.req_id] = req
 
     def _slo_note_retire(self, req: Request):
         """Per-request SLO verdicts at retire: record the request in
@@ -825,9 +1307,6 @@ class BatchScheduler:
         self.model.free(rid)
         if self.draft is not None:
             self.draft.free(rid)
-        req.state = RequestState.FINISHED
-        del self._active[rid]
-        self._finished[rid] = req
 
     # -- the step ----------------------------------------------------------
     def step(self) -> dict:
@@ -860,6 +1339,11 @@ class BatchScheduler:
             self._step_epoch += 1
         with self._span("serving.step"):
             ev = self._step_impl()
+        if self._step_extras:
+            # per-step overload/fault annotations (preempted /
+            # resumed / aborted counts, the active fault kind) ride
+            # the event dict of every step shape uniformly
+            ev.update(self._step_extras)
         if self._metrics is not None:
             m = self._metrics
             m.inc("serving.steps")
@@ -930,12 +1414,86 @@ class BatchScheduler:
                     RuntimeWarning)
                 self._export_path = None
 
+    def _noop_event(self) -> dict:
+        return {"admitted": 0, "advanced": 0, "finished": 0,
+                "prefix_hit_tokens": 0, "prefill_tokens": 0,
+                "decode_tokens": 0}
+
+    def _note_fault(self, kind: str):
+        """Annotate the step event with an active fault kind. Two
+        faults can fire on one step (the shipped bench plan lands a
+        preempt storm inside a delay_swap_in window) — both must
+        survive onto the event, "+"-joined, not last-writer-wins."""
+        cur = self._step_extras.get("faulted")
+        if cur is None:
+            self._step_extras["faulted"] = kind
+        elif kind not in cur.split("+"):
+            self._step_extras["faulted"] = cur + "+" + kind
+
+    def _fault_gate(self):
+        """Simulated step failure with retry/backoff: a ``fail_step``
+        fault abandons the attempt BEFORE the model call (no state
+        was mutated, so the retry is trivially safe); consecutive
+        failures back off exponentially (0, 1, 3, 7, capped 8 skipped
+        steps). Returns a no-op event while failing/backing off, None
+        to run the step normally."""
+        if self._faults is None:
+            return None
+        step = self._fault_step
+        if step < self._resume_at:
+            self._note_fault("backoff")
+            if self._metrics is not None:
+                self._metrics.inc("serving.step_backoff_steps")
+            return self._noop_event()
+        if self._faults.fail_step(step):
+            self._consec_fails += 1
+            skip = min(2 ** (self._consec_fails - 1) - 1, 8)
+            self._resume_at = step + 1 + skip
+            self._note_fault("fail_step")
+            if self._metrics is not None:
+                self._metrics.inc("serving.step_retries")
+            return self._noop_event()
+        self._consec_fails = 0
+        return None
+
     def _step_impl(self) -> dict:
+        self._step_extras = {}
+        self._fault_step += 1
+        noop = self._fault_gate()
+        if noop is not None:
+            return noop
+        self._expire_deadlines()
+        if self._faults is not None:
+            # forced preemption storm: swap out N victims regardless
+            # of pool pressure (they must restore bitwise later)
+            n = self._faults.forced_preemptions(self._fault_step)
+            if n:
+                self._note_fault("preempt_storm")
+                for _ in range(n):
+                    victim = self._pick_victim()
+                    if victim is None or not self._preempt(
+                            victim, reason="fault"):
+                        break
         self._sanitizer_epoch()
-        n_before = len(self._active)
+        self._admitted_step = 0
         with self._span("serving.admit"):
             hit_tokens = self._try_admit()
-        admitted = len(self._active) - n_before
+            if (self._swapped and self._admitted_step == 0
+                    and len(self._active) < self.max_batch_size
+                    and not self._step_extras.get("faulted")):
+                # the queue's best candidate (which swapped requests
+                # of lower priority yielded to) turned out to be
+                # blocked this step — hand the idle capacity to the
+                # swapped set after all, so a stuck arrival can never
+                # freeze already-admitted work out of resuming. NOT
+                # on faulted steps: an exhaust/delay window must keep
+                # swap-in blocked (and a second consult would double-
+                # count the fault in the injector's audit log)
+                self._admit_swapped(None)
+        # actual admissions + swap-in resumes, NOT the active-set
+        # delta: a preempt-then-reject step would otherwise report a
+        # NEGATIVE admission count to every event consumer
+        admitted = self._admitted_step
         if not self._active:
             return {"admitted": admitted, "advanced": 0, "finished": 0,
                     "prefix_hit_tokens": hit_tokens,
@@ -1296,17 +1854,24 @@ class BatchScheduler:
         return len(req.generated_ids) >= req.max_new_tokens
 
     def run_until_complete(self, max_steps=10_000) -> dict:
-        """Drain the queue + active set; returns finished requests by
-        id."""
+        """Drain the queue + active + swapped sets; returns terminal
+        requests by id (finished AND deadline-aborted — check
+        ``req.state``)."""
         for _ in range(max_steps):
-            if not self._queue and not self._active:
+            if not self._queue and not self._active \
+                    and not self._swapped:
                 break
             ev = self.step()
             if (ev["advanced"] == 0 and ev["admitted"] == 0
-                    and self._queue):
+                    and (self._queue or self._swapped)
+                    and not ev.get("faulted")
+                    and not ev.get("aborted")
+                    and not ev.get("preempted")):
                 # defensive: submit() rejects never-admissible requests
                 # and active requests always finish, so this fires only
                 # on an accounting bug or external pool interference
+                # (injected faults and deadline sweeps are progress in
+                # their own right and exempt)
                 raise RuntimeError(
                     "scheduler stalled: nothing active yet the queue "
                     "head cannot be admitted; "
@@ -1324,6 +1889,10 @@ class BatchScheduler:
     @property
     def num_queued(self):
         return len(self._queue)
+
+    @property
+    def num_swapped(self):
+        return len(self._swapped)
 
     def result(self, req_id: str) -> Request:
         return self._finished[req_id]

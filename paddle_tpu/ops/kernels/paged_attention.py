@@ -18,6 +18,13 @@ TPU paged-attention recipe ("Ragged Paged Attention" — see PAPERS.md):
 GQA maps q-head h to kv-head h // (H // KVH) in the index maps — no KV
 replication in HBM. Off-TPU (tests) the same kernel runs in pallas
 interpret mode against a dense reference.
+
+Dispatch caching: eager callers (the serving step loop, tests) hit a
+shape-keyed LRU of ``jax.jit``-ted entry points, so stepping the same
+shapes never re-traces the pallas call — the historical per-call
+build cost was pure trace/compile overhead. Callers already under an
+outer trace (``to_static``) inline the identical lowering; the
+surrounding program owns compilation and caching there.
 """
 from __future__ import annotations
 
@@ -100,6 +107,74 @@ def _decode_kernel(scale, page_size, kvh_per_q, max_pages, window,
         o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
 
 
+def _build_decode_call(b, h, d, npages, page_size, kvh, max_pages,
+                       scale, window, quant, interpret):
+    """The decode pallas dispatch as a pure function of the static
+    config: returns ``run(q, k_pages, v_pages, *scalar_args)``.
+    Traced callers inline it (identical to the historical lowering);
+    eager callers go through :func:`_jitted_decode_call`'s cached
+    ``jax.jit`` of the same body, so a serving loop stepping the same
+    shapes never re-traces the kernel."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    group = h // kvh
+
+    def q_map(b_, h_, p_, *pref):
+        return (b_, h_, 0, 0)
+
+    def kv_map(b_, h_, p_, tbl, *pref):
+        return (h_ // group, tbl[b_, p_], 0, 0)
+
+    n_scalars = 4 if quant else 2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_scalars,
+        grid=(b, h, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, d), q_map),
+            pl.BlockSpec((1, 1, page_size, d), kv_map),
+            pl.BlockSpec((1, 1, page_size, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, d), q_map),
+        scratch_shapes=[
+            pltpu.SMEM((1, 1), jnp.float32),
+            pltpu.SMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, scale, page_size, group, max_pages, window,
+        quant,
+    )
+
+    def run(q, k_pages, v_pages, *scalar_args):
+        # (NP, P, KVH, D) -> (KVH, NP, P, D): page-major per kv head
+        kp = jnp.transpose(k_pages, (2, 0, 1, 3))
+        vp = jnp.transpose(v_pages, (2, 0, 1, 3))
+        q4 = q.reshape(b, h, 1, d)
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
+            interpret=interpret,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")
+            ) if not interpret else None,
+        )(
+            *scalar_args,
+            q4, kp.reshape(kvh, npages, page_size, d),
+            vp.reshape(kvh, npages, page_size, d),
+        )
+        return out.reshape(b, h, d)
+
+    return run
+
+
+@functools.lru_cache(maxsize=512)
+def _jitted_decode_call(cfg):
+    return jax.jit(_build_decode_call(*cfg))
+
+
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
                     sm_scale=None, interpret=None, window=0,
                     k_scales=None, v_scales=None):
@@ -118,7 +193,6 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
     npages, page_size, kvh, _ = k_pages.shape
     max_pages = page_table.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    group = h // kvh
     quant = k_scales is not None
     if quant != (v_scales is not None):
         raise ValueError(
@@ -128,58 +202,21 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    from jax.experimental.pallas import tpu as pltpu
-
-    # (NP, P, KVH, D) -> (KVH, NP, P, D): page-major per kv head
-    kp = jnp.transpose(k_pages, (2, 0, 1, 3))
-    vp = jnp.transpose(v_pages, (2, 0, 1, 3))
-    q4 = q.reshape(b, h, 1, d)
-
-    def q_map(b_, h_, p_, *pref):
-        return (b_, h_, 0, 0)
-
-    def kv_map(b_, h_, p_, tbl, *pref):
-        return (h_ // group, tbl[b_, p_], 0, 0)
-
     scalar_args = [page_table.astype(jnp.int32),
                    seq_lens.astype(jnp.int32)]
     if quant:
         scalar_args += [k_scales.astype(jnp.float32),
                         v_scales.astype(jnp.float32)]
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=len(scalar_args),
-        grid=(b, h, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, 1, 1, d), q_map),
-            pl.BlockSpec((1, 1, page_size, d), kv_map),
-            pl.BlockSpec((1, 1, page_size, d), kv_map),
-        ],
-        out_specs=pl.BlockSpec((1, 1, 1, d), q_map),
-        scratch_shapes=[
-            pltpu.SMEM((1, 1), jnp.float32),
-            pltpu.SMEM((1, 1), jnp.float32),
-            pltpu.VMEM((1, d), jnp.float32),
-        ],
-    )
-    kernel = functools.partial(
-        _decode_kernel, float(scale), page_size, group, max_pages,
-        int(window or 0), quant,
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
-        interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
-        ) if not interpret else None,
-    )(
-        *scalar_args,
-        q4, kp.reshape(kvh, npages, page_size, d),
-        vp.reshape(kvh, npages, page_size, d),
-    )
-    return out.reshape(b, h, d)
+    cfg = (b, h, d, npages, page_size, kvh, max_pages, float(scale),
+           int(window or 0), quant, bool(interpret))
+    args = (q, k_pages, v_pages, *scalar_args)
+    if any(isinstance(x, jax.core.Tracer) for x in args):
+        # already under an outer trace (to_static / jit): inline —
+        # the surrounding program owns compilation and caching
+        return _build_decode_call(*cfg)(*args)
+    # eager serving/test loops: same shapes hit the cached compiled
+    # program instead of re-tracing the pallas call every step
+    return _jitted_decode_call(cfg)(*args)
 
 
 def paged_attention_reference(q, k_pages, v_pages, page_table,
@@ -338,7 +375,6 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
     npages, page_size, kvh, _ = k_pages.shape
     max_pages = page_table.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    group = h // kvh
     quant = k_scales is not None
     if quant != (v_scales is not None):
         raise ValueError(
@@ -348,22 +384,6 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    from jax.experimental.pallas import tpu as pltpu
-
-    kp = jnp.transpose(k_pages, (2, 0, 1, 3)).reshape(
-        kvh, npages, page_size, d
-    )
-    vp = jnp.transpose(v_pages, (2, 0, 1, 3)).reshape(
-        kvh, npages, page_size, d
-    )
-    q4 = jnp.transpose(q, (0, 2, 1, 3))  # (B, H, T, D)
-
-    def q_map(b_, h_, p_, *pref):
-        return (b_, h_, 0, 0)
-
-    def kv_map(b_, h_, p_, tbl, *pref):
-        return (h_ // group, tbl[b_, p_], 0, 0)
-
     ragged = q_lens is not None
     scalar_args = [page_table.astype(jnp.int32),
                    seq_lens.astype(jnp.int32)]
@@ -372,9 +392,33 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
     if quant:
         scalar_args += [k_scales.astype(jnp.float32),
                         v_scales.astype(jnp.float32)]
+    cfg = (b, t, h, d, npages, page_size, kvh, max_pages,
+           float(scale), int(window or 0), quant, ragged,
+           bool(interpret))
+    args = (q, k_pages, v_pages, *scalar_args)
+    if any(isinstance(x, jax.core.Tracer) for x in args):
+        return _build_prefill_call(*cfg)(*args)
+    return _jitted_prefill_call(cfg)(*args)
 
+
+def _build_prefill_call(b, t, h, d, npages, page_size, kvh, max_pages,
+                        scale, window, quant, ragged, interpret):
+    """The chunked-prefill pallas dispatch as a pure function of the
+    static config — same inline-under-trace / cached-jit-when-eager
+    split as :func:`_build_decode_call`."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    group = h // kvh
+
+    def q_map(b_, h_, p_, *pref):
+        return (b_, h_, 0, 0)
+
+    def kv_map(b_, h_, p_, tbl, *pref):
+        return (h_ // group, tbl[b_, p_], 0, 0)
+
+    n_scalars = 2 + (1 if ragged else 0) + (2 if quant else 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=len(scalar_args),
+        num_scalar_prefetch=n_scalars,
         grid=(b, h, max_pages),
         in_specs=[
             pl.BlockSpec((1, 1, t, d), q_map),
@@ -389,19 +433,36 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
         ],
     )
     kernel = functools.partial(
-        _prefill_kernel, float(scale), page_size, group, max_pages, t,
-        int(window or 0), quant, ragged,
+        _prefill_kernel, scale, page_size, group, max_pages, t,
+        window, quant, ragged,
     )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
-        interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
-        ) if not interpret else None,
-    )(
-        *scalar_args,
-        q4, kp, vp,
-    )
-    return jnp.transpose(out, (0, 2, 1, 3))
+
+    def run(q, k_pages, v_pages, *scalar_args):
+        kp = jnp.transpose(k_pages, (2, 0, 1, 3)).reshape(
+            kvh, npages, page_size, d
+        )
+        vp = jnp.transpose(v_pages, (2, 0, 1, 3)).reshape(
+            kvh, npages, page_size, d
+        )
+        q4 = jnp.transpose(q, (0, 2, 1, 3))  # (B, H, T, D)
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+            interpret=interpret,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")
+            ) if not interpret else None,
+        )(
+            *scalar_args,
+            q4, kp, vp,
+        )
+        return jnp.transpose(out, (0, 2, 1, 3))
+
+    return run
+
+
+@functools.lru_cache(maxsize=512)
+def _jitted_prefill_call(cfg):
+    return jax.jit(_build_prefill_call(*cfg))

@@ -621,3 +621,134 @@ class TestPoolMutationAudit:
         assert "pool-mutation-audit" in ids
         assert "pool-private-api" in ids
         assert len(ids) == len(set(ids))
+
+
+class TestSwapTierAudit:
+    """ISSUE-9 extension of the pool-mutation audit: the host swap
+    tier's store (HostKVSwapSpace._swap_store/_swap_used) is
+    swap-tier-private — writable only inside paged_cache.py — and
+    the _swap_put/_swap_get/_swap_pop entry points are pool-private
+    methods serving code may never call."""
+
+    def test_seeded_swap_state_writes_flagged(self):
+        bad = (
+            "def steal(space, key, rec):\n"
+            "    space._swap_store[key] = rec\n"
+            "    space._swap_used += rec.nbytes\n"
+            "    space._swap_store.pop(key)\n"
+        )
+        v = lint_codebase.lint_pool_state_file("fake/sw.py", text=bad)
+        joined = "\n".join(v)
+        assert "_swap_store" in joined
+        assert "_swap_used" in joined
+        assert len(v) == 3, v
+
+    def test_seeded_swap_private_calls_flagged(self):
+        bad = (
+            "def bypass(space, cache, key):\n"
+            "    rec = space._swap_get(key)\n"
+            "    space._swap_pop(key)\n"
+            "    space._swap_put(key, rec)\n"
+        )
+        v = lint_codebase.lint_pool_api_file("fake/sb.py", text=bad)
+        joined = "\n".join(v)
+        assert "_swap_get" in joined
+        assert "_swap_pop" in joined
+        assert "_swap_put" in joined
+        assert len(v) == 3, v
+
+    def test_public_swap_readout_clean(self):
+        ok = (
+            "def pressure(space):\n"
+            "    if not space.would_fit(4096):\n"
+            "        return space.summary()\n"
+            "    return space.used_bytes, space.free_bytes\n"
+        )
+        assert lint_codebase.lint_pool_api_file(
+            "fake/so.py", text=ok) == []
+
+    def test_swap_tier_in_audited_attrs(self):
+        assert "_swap_store" in lint_codebase._POOL_STATE_ATTRS
+        assert "_swap_used" in lint_codebase._POOL_STATE_ATTRS
+        assert "_swap_put" in lint_codebase._POOL_PRIVATE_METHODS
+        # and the live serving stack is clean under the extension
+        assert lint_codebase.check_pool_mutation_audit() == []
+
+    def test_fault_injection_is_host_only(self):
+        assert any("fault_injection.py" in f
+                   for f in lint_codebase.HOST_ONLY_FILES)
+        assert lint_codebase.check_host_only() == []
+
+
+class TestServingTerminalTrace:
+    """ISSUE-9: serving.py must never drop a request without its
+    terminal trace event — any function that moves a request to a
+    terminal state must call self._traces.complete(...) itself."""
+
+    def test_seeded_silent_finish_flagged(self):
+        bad = (
+            "def _retire(self, req):\n"
+            "    req.state = RequestState.FINISHED\n"
+            "    del self._active[req.req_id]\n"
+        )
+        v = lint_codebase.lint_serving_terminal_file(
+            "fake/sched.py", text=bad)
+        assert len(v) == 1 and "_retire" in v[0], v
+        assert "terminal" in v[0]
+
+    def test_seeded_silent_finished_write_flagged(self):
+        bad = (
+            "def _drop(self, req):\n"
+            "    self._finished[req.req_id] = req\n"
+        )
+        v = lint_codebase.lint_serving_terminal_file(
+            "fake/d.py", text=bad)
+        assert len(v) == 1 and "_drop" in v[0], v
+
+    def test_seeded_abort_state_flagged(self):
+        bad = (
+            "def _kill(self, req):\n"
+            "    req.state = RequestState.ABORTED_DEADLINE\n"
+        )
+        v = lint_codebase.lint_serving_terminal_file(
+            "fake/k.py", text=bad)
+        assert len(v) == 1 and "_kill" in v[0], v
+
+    def test_terminal_with_trace_emit_clean(self):
+        ok = (
+            "def _retire(self, req):\n"
+            "    req.state = RequestState.FINISHED\n"
+            "    self._finished[req.req_id] = req\n"
+            "    if self._traces is not None:\n"
+            "        self._traces.complete(req.req_id, 'retire',\n"
+            "                              0.0, 0)\n"
+        )
+        assert lint_codebase.lint_serving_terminal_file(
+            "fake/ok.py", text=ok) == []
+
+    def test_non_terminal_states_clean(self):
+        ok = (
+            "def _preempt(self, req):\n"
+            "    req.state = RequestState.SWAPPED\n"
+            "    self._swapped[req.req_id] = req\n"
+        )
+        assert lint_codebase.lint_serving_terminal_file(
+            "fake/p.py", text=ok) == []
+
+    def test_waiver_comment_suppresses(self):
+        text = (
+            "def _quiet(self, req):  # trace-lint: ok(test waiver)\n"
+            "    req.state = RequestState.FINISHED\n"
+        )
+        assert lint_codebase.lint_serving_terminal_file(
+            "fake/w.py", text=text) == []
+
+    def test_scheduler_module_is_covered_and_clean(self):
+        assert any("serving.py" in f
+                   for f in lint_codebase.SERVING_TERMINAL_FILES)
+        assert lint_codebase.check_serving_terminal_trace() == []
+
+    def test_rule_inventory_has_terminal_rule(self):
+        ids = [r for r, _ in lint_codebase.RULES]
+        assert "serving-terminal-trace" in ids
+        assert len(ids) == len(set(ids))

@@ -1,0 +1,796 @@
+"""Overload survival (ISSUE 9): preemption + tiered KV swap,
+admission control, and deadline aborts.
+
+Pool level: host-tier swap round trips must restore page chains
+BITWISE (payload + int8 scale sidecars) across kv {float32, int8} x
+prefix-shared chains x mid-page COW resumes, under sanitizer=strict
+with zero leaks; a full swap space must abort atomically; a swap
+hold lost while a sequence is out must surface at swap-in.
+
+Scheduler level: bounded-queue backpressure (QueueFullError),
+priority admission with per-tenant in-flight caps, preempt-instead-
+of-reject with greedy outputs identical to an uncontended run
+(including a pinned-prefix victim), deadline aborts from every
+residence (queued / active mid-prefill / swapped) releasing every
+reservation, and the counted-distinct admission-failure accounting.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework import telemetry
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.incubate.nn import PagedKVCacheManager
+from paddle_tpu.incubate.nn.paged_cache import (
+    HostKVSwapSpace,
+    SwapSpaceFull,
+)
+from paddle_tpu.inference import (
+    BatchScheduler,
+    QueueFullError,
+    Request,
+    RequestState,
+)
+
+PAGE = 4
+HEADS, HDIM = 2, 8
+KV_MODES = (None, "int8")
+
+
+def _pool(kv=None, num_pages=32, sanitizer="strict"):
+    return PagedKVCacheManager(num_pages, PAGE, HEADS, HDIM,
+                               dtype=jnp.float32, kv_dtype=kv,
+                               sanitizer=sanitizer)
+
+
+def _fill(pool, sid, n, seed=0, alloc=True):
+    """Append ``n`` random tokens (deterministic per seed)."""
+    rng = np.random.RandomState(seed)
+    if alloc:
+        pool.alloc(sid)
+    for _ in range(n):
+        pool.append(sid, rng.randn(HEADS, HDIM).astype(np.float32),
+                    rng.randn(HEADS, HDIM).astype(np.float32))
+
+
+def _chain_snapshot(pool, sid):
+    """The sequence's page payloads (+ scale sidecars) in chain
+    order — position-wise comparable across swap round trips even
+    though private page IDS change."""
+    pg = np.asarray(pool.seq_pages(sid), np.int32)
+    out = [np.asarray(pool.k_pages)[pg], np.asarray(pool.v_pages)[pg]]
+    if pool.quantized:
+        out += [np.asarray(pool.k_scales)[pg],
+                np.asarray(pool.v_scales)[pg]]
+    return out
+
+
+def _assert_bitwise(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.dtype == y.dtype
+        assert np.array_equal(x, y)  # exact, not allclose
+
+
+class TestSwapRoundTrip:
+    @pytest.mark.parametrize("kv", KV_MODES)
+    def test_private_chain_roundtrip_bitwise(self, kv):
+        pool = _pool(kv)
+        space = HostKVSwapSpace(64 << 20)
+        _fill(pool, "s", 9)  # 3 pages, last partial
+        before = _chain_snapshot(pool, "s")
+        free0 = pool.num_free_pages
+        est = pool.swap_out_nbytes("s")
+        freed, nbytes = pool.swap_out("s", space)
+        assert freed == 3 and nbytes == est == 3 * pool.page_nbytes
+        assert pool.num_free_pages == free0 + 3
+        assert space.num_records == 1
+        assert space.used_bytes == nbytes
+        with pytest.raises(KeyError):
+            pool.seq_pages("s")
+        restored = pool.swap_in("s", space)
+        assert restored == 3
+        assert space.num_records == 0 and space.used_bytes == 0
+        _assert_bitwise(before, _chain_snapshot(pool, "s"))
+        pool.assert_ref_invariants()
+        # the sequence decodes on: appends resume at the old length
+        _fill(pool, "s", 1, seed=7, alloc=False)
+        pool.free("s")
+        assert pool.num_free_pages == pool.num_pages
+
+    @pytest.mark.parametrize("kv", KV_MODES)
+    def test_shared_pages_stay_on_device(self, kv):
+        """A prefix-shared chain: swap-out moves ONLY the private
+        tail; the shared pages stay resident under a swap hold (so a
+        pin blocks eviction, never the swap of private pages)."""
+        pool = _pool(kv)
+        space = HostKVSwapSpace(64 << 20)
+        _fill(pool, "a", 8)  # 2 full pages
+        chain_a = list(pool.seq_pages("a"))
+        pool.attach("b", chain_a, 8)
+        _fill(pool, "b", 3, seed=1, alloc=False)  # +1 private page
+        before = _chain_snapshot(pool, "b")
+        free0 = pool.num_free_pages
+        freed, nbytes = pool.swap_out("b", space)
+        assert freed == 1 and nbytes == 1 * pool.page_nbytes
+        assert pool.num_free_pages == free0 + 1
+        # the shared pages are still a's live chain, untouched
+        assert list(pool.seq_pages("a")) == chain_a
+        pool.swap_in("b", space)
+        after = _chain_snapshot(pool, "b")
+        _assert_bitwise(before, after)
+        assert list(pool.seq_pages("b"))[:2] == chain_a  # still shared
+        pool.assert_ref_invariants()
+        pool.free("a")
+        pool.free("b")
+        assert pool.num_free_pages == pool.num_pages
+
+    @pytest.mark.parametrize("kv", KV_MODES)
+    def test_midpage_cow_resume_roundtrip(self, kv):
+        """Mid-page COW: b attaches a's partial tail page, writes
+        into it (fork), is swapped out and back — the forked private
+        page restores bitwise and a's original page never moves."""
+        pool = _pool(kv)
+        space = HostKVSwapSpace(64 << 20)
+        _fill(pool, "a", 6)  # p0 full, p1 holds 2 of 4 slots
+        a_before = _chain_snapshot(pool, "a")
+        pool.attach("b", list(pool.seq_pages("a")), 6)
+        _fill(pool, "b", 1, seed=2, alloc=False)  # forks p1
+        assert pool.cow_forks >= 1
+        b_before = _chain_snapshot(pool, "b")
+        freed, _ = pool.swap_out("b", space)
+        assert freed == 1  # only the forked page is private
+        pool.swap_in("b", space)
+        _assert_bitwise(b_before, _chain_snapshot(pool, "b"))
+        _assert_bitwise(a_before, _chain_snapshot(pool, "a"))
+        pool.assert_ref_invariants()
+        pool.free("a")
+        pool.free("b")
+        assert pool.num_free_pages == pool.num_pages
+
+    def test_swap_in_pages_needed_accounting(self):
+        pool = _pool()
+        space = HostKVSwapSpace(64 << 20)
+        # fully-shared chain ending mid-page: zero private pages to
+        # restore, but the resume's first append must COW-fork the
+        # shared tail — the reservation carries that pending draw
+        _fill(pool, "a", 6)
+        pool.attach("b", list(pool.seq_pages("a")), 6)
+        pool.swap_out("b", space)
+        assert pool.swap_in_pages_needed("b", space) == 1
+        # worst-case growth: restore to 6 tokens then grow to 14
+        # (4 pages) = 2 beyond the restored chain, plus the fork
+        assert pool.swap_in_pages_needed("b", space,
+                                         worst_tokens=14) == 3
+        pool.swap_in("b", space)
+        pool.free("a")
+        pool.free("b")
+        # private chain, no pending fork
+        _fill(pool, "c", 9)
+        pool.swap_out("c", space)
+        assert pool.swap_in_pages_needed("c", space) == 3
+        assert pool.swap_in_pages_needed("c", space,
+                                         worst_tokens=17) == 5
+        pool.swap_in("c", space)
+        pool.free("c")
+        assert pool.num_free_pages == pool.num_pages
+
+    def test_swap_space_full_is_atomic(self):
+        pool = _pool()
+        tiny = HostKVSwapSpace(1)  # can hold nothing
+        _fill(pool, "s", 9)
+        chain = list(pool.seq_pages("s"))
+        free0 = pool.num_free_pages
+        with pytest.raises(SwapSpaceFull):
+            pool.swap_out("s", tiny)
+        # nothing moved: table, free list, and refcounts are intact
+        assert list(pool.seq_pages("s")) == chain
+        assert pool.num_free_pages == free0
+        assert tiny.num_records == 0 and tiny.used_bytes == 0
+        pool.assert_ref_invariants()
+        _fill(pool, "s", 1, seed=3, alloc=False)  # still appendable
+        pool.free("s")
+        assert pool.num_free_pages == pool.num_pages
+
+    def test_swap_discard_releases_holds(self):
+        """Deadline abort of a swapped-out sequence: the discard
+        drops the host record and the swap holds; once every other
+        owner frees, the pool is empty — zero leaks."""
+        pool = _pool()
+        space = HostKVSwapSpace(64 << 20)
+        _fill(pool, "a", 8)
+        pool.attach("b", list(pool.seq_pages("a")), 8)
+        _fill(pool, "b", 3, seed=1, alloc=False)
+        pool.swap_out("b", space)
+        assert space.num_records == 1
+        pool.swap_discard("b", space)
+        assert space.num_records == 0 and space.used_bytes == 0
+        pool.assert_ref_invariants()
+        pool.free("a")
+        assert pool.num_free_pages == pool.num_pages
+
+    def test_lost_hold_caught_at_swap_in(self):
+        """A swap hold dropped while the sequence is out (simulated
+        out-of-band decref) is a lifecycle bug; strict sanitizer
+        reports it AT swap-in instead of silently aliasing KV."""
+        from paddle_tpu.incubate.nn.page_sanitizer import (
+            PageSanitizerError,
+        )
+
+        pool = _pool()
+        space = HostKVSwapSpace(64 << 20)
+        _fill(pool, "a", 4)
+        shared = list(pool.seq_pages("a"))
+        pool.attach("b", shared, 4)
+        _fill(pool, "b", 2, seed=1, alloc=False)
+        pool.swap_out("b", space)
+        pool.decref(shared)  # the buggy actor steals b's swap hold
+        with pytest.raises(PageSanitizerError):
+            pool.swap_in("b", space)
+
+    def test_double_swap_out_rejected(self):
+        pool = _pool()
+        space = HostKVSwapSpace(64 << 20)
+        _fill(pool, "s", 4)
+        pool.swap_out("s", space)
+        with pytest.raises(KeyError):
+            pool.swap_out("s", space)  # no table entry anymore
+        pool.swap_in("s", space)
+        with pytest.raises(ValueError):
+            pool.swap_in("s", space)  # already resident again
+        pool.free("s")
+        with pytest.raises(KeyError):
+            pool.swap_in("s", space)  # record consumed
+
+    def test_space_is_shared_across_layer_pools(self):
+        """Two layer pools of one model share one space: records key
+        on (pool uid, seq id) so the same seq id never collides."""
+        p1, p2 = _pool(), _pool()
+        space = HostKVSwapSpace(64 << 20)
+        _fill(p1, "s", 5)
+        _fill(p2, "s", 5, seed=9)
+        b1, b2 = _chain_snapshot(p1, "s"), _chain_snapshot(p2, "s")
+        p1.swap_out("s", space)
+        p2.swap_out("s", space)
+        assert space.num_records == 2
+        assert space.holds("s")
+        p1.swap_in("s", space)
+        p2.swap_in("s", space)
+        _assert_bitwise(b1, _chain_snapshot(p1, "s"))
+        _assert_bitwise(b2, _chain_snapshot(p2, "s"))
+        assert not space.holds("s")
+        assert space.summary()["swapped_in_records"] == 2
+
+
+# -- scheduler level ---------------------------------------------------------
+
+
+class TinyPagedDecoder(nn.Layer):
+    """1-layer paged decoder implementing the scheduler's model
+    protocol (alloc/free/decode_token/caches) — token-per-step, so
+    preemption can land mid-prefill too."""
+
+    def __init__(self, vocab=37, dim=32, heads=2, page_size=PAGE,
+                 num_pages=32, sanitizer="strict"):
+        super().__init__()
+        self.dim, self.heads, self.hd = dim, heads, dim // heads
+        self.embed = nn.Embedding(vocab, dim)
+        self.qkv = nn.Linear(dim, 3 * dim)
+        self.head = nn.Linear(dim, vocab)
+        self.caches = [
+            PagedKVCacheManager(num_pages, page_size, heads, self.hd,
+                                dtype=jnp.float32,
+                                sanitizer=sanitizer)
+        ]
+
+    def alloc(self, sid):
+        for c in self.caches:
+            c.alloc(sid)
+
+    def free(self, sid):
+        for c in self.caches:
+            c.free(sid)
+
+    def decode_token(self, token_ids, seq_ids):
+        b = len(seq_ids)
+        x = self.embed(paddle.to_tensor(
+            np.asarray(token_ids, "int64")[:, None]))[:, 0]
+        qkv = self.qkv(x).reshape([b, 3, self.heads, self.hd])
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        for bi, sid in enumerate(seq_ids):
+            self.caches[0].append(sid, k.numpy()[bi], v.numpy()[bi])
+        attn = self.caches[0].attend(q, seq_ids)
+        return self.head(x + attn.reshape([b, self.dim]))
+
+
+PROMPTS = {f"r{i}": [3 + i, 17, 5, 9, 2 + i, 11, 7, 1 + i]
+           for i in range(4)}
+HI_PROMPT = [9, 8, 7, 6, 5, 4, 3, 2]
+N_NEW = 6
+
+
+def _tiny(num_pages=32, **kw):
+    paddle.seed(11)
+    model = TinyPagedDecoder(num_pages=num_pages)
+    return model, BatchScheduler(model, **kw)
+
+
+def _uncontended_reference():
+    """Greedy outputs with zero capacity pressure, once."""
+    _, ref = _tiny(num_pages=128)
+    for rid, p in PROMPTS.items():
+        ref.submit(Request(rid, list(p), max_new_tokens=N_NEW))
+    ref.submit(Request("hi", list(HI_PROMPT), max_new_tokens=N_NEW))
+    done = ref.run_until_complete()
+    return {k: list(v.generated_ids) for k, v in done.items()}
+
+
+_REF = None
+
+
+def _ref():
+    global _REF
+    if _REF is None:
+        _REF = _uncontended_reference()
+    return _REF
+
+
+def _contended(warm_steps=8, **sched_kw):
+    """Low-priority requests first, then a high-priority arrival
+    that cannot fit without making room. Returns (sched, done)."""
+    kw = dict(max_batch_size=4, page_watermark=1.0, preempt=True,
+              swap_bytes=64 << 20)
+    kw.update(sched_kw)
+    _, sched = _tiny(num_pages=12, **kw)
+    for rid, p in PROMPTS.items():
+        sched.submit(Request(rid, list(p), max_new_tokens=N_NEW,
+                             priority=0))
+    for _ in range(warm_steps):
+        sched.step()
+    sched.submit(Request("hi", list(HI_PROMPT), max_new_tokens=N_NEW,
+                         priority=5))
+    done = sched.run_until_complete(max_steps=2000)
+    return sched, done
+
+
+class TestPreemption:
+    def test_preempt_then_admit_greedy_identical(self):
+        sched, done = _contended()
+        swap = sched.page_pool_stats()["swap"]
+        assert swap["swapped_out_records"] >= 1  # preemption really ran
+        assert swap["swapped_in_records"] == swap["swapped_out_records"]
+        assert swap["records"] == 0 and swap["used_bytes"] == 0
+        ref = _ref()
+        for rid in list(PROMPTS) + ["hi"]:
+            assert done[rid].generated_ids == ref[rid], rid
+        assert any(r._preemptions for r in done.values())
+        # sanitizer-strict, zero leaks once everything retired
+        st = sched.page_pool_stats()
+        assert st["free_pages"] == st["total_pages"]
+
+    def test_preempt_off_restores_wait_in_queue(self):
+        sched, done = _contended(preempt=False)
+        assert "swap" not in sched.page_pool_stats()
+        ref = _ref()
+        for rid in list(PROMPTS) + ["hi"]:  # slower, still correct
+            assert done[rid].generated_ids == ref[rid], rid
+        assert all(r._preemptions == 0 for r in done.values())
+
+    def test_victim_selection_strictly_lower_priority(self):
+        """An admission candidate never preempts its own class: with
+        every active request at the arrival's priority, admission
+        waits instead."""
+        _, sched = _tiny(num_pages=12, max_batch_size=4,
+                         page_watermark=1.0, preempt=True,
+                         swap_bytes=64 << 20)
+        for rid, p in PROMPTS.items():
+            sched.submit(Request(rid, list(p), max_new_tokens=N_NEW,
+                                 priority=5))
+        for _ in range(10):
+            sched.step()
+        sched.submit(Request("hi", list(HI_PROMPT),
+                             max_new_tokens=N_NEW, priority=5))
+        done = sched.run_until_complete(max_steps=2000)
+        assert sched.page_pool_stats()["swap"][
+            "swapped_out_records"] == 0
+        assert done["hi"].generated_ids == _ref()["hi"]
+
+    def test_swapped_lower_priority_yields_to_queued_higher(self):
+        """A swapped priority-0 request must NOT consume a freed
+        batch slot ahead of a queued priority-9 arrival (and must
+        still resume once the arrival is served)."""
+        from paddle_tpu.incubate.nn.fault_injection import (
+            FaultInjector,
+        )
+
+        _, sched = _tiny(num_pages=64, max_batch_size=2,
+                         preempt=True, swap_bytes=64 << 20)
+        sched._faults = FaultInjector(
+            "preempt_storm@4:1,delay_swap_in@4+1")
+        sched.submit(Request("lo1", [1, 2, 3], max_new_tokens=8,
+                             priority=0))
+        sched.submit(Request("lo2", [4, 5, 6], max_new_tokens=8,
+                             priority=0))
+        for _ in range(4):  # both active; the storm swaps one out
+            sched.step()
+        assert sched.num_swapped == 1
+        sched.submit(Request("hi", [7, 8], max_new_tokens=2,
+                             priority=9))
+        sched.step()  # one slot free: hi outranks the swapped req
+        assert "hi" in sched._active
+        assert sched.num_swapped == 1  # still yielding
+        done = sched.run_until_complete()
+        assert all(r.finished for r in done.values())
+        assert set(done) == {"lo1", "lo2", "hi"}
+
+    def test_futile_preemption_skipped(self):
+        """A candidate blocked by a same-priority peer must not swap
+        a small lower-priority victim out when preempting it can
+        never close the deficit: the host round trip would be undone
+        by the next step's idle-capacity swap-in and retried forever
+        (preemption ping-pong) while the candidate gains nothing."""
+        _, sched = _tiny(num_pages=8, max_batch_size=4, preempt=True,
+                         swap_bytes=64 << 20)
+        sched.submit(Request("big", [1] * 8, max_new_tokens=8,
+                             priority=1))
+        sched.submit(Request("lo", [2, 3], max_new_tokens=6,
+                             priority=0))
+        for _ in range(3):
+            sched.step()
+        assert "lo" in sched._active  # the victim is still running
+        # worst case 4 pages: even swapping "lo" fully out cannot
+        # make room while "big" (same class as the candidate) holds
+        # its reservation
+        sched.submit(Request("cand", [4] * 8, max_new_tokens=8,
+                             priority=1))
+        for _ in range(4):
+            ev = sched.step()
+            assert "preempted" not in ev
+        assert sched.num_swapped == 0
+        assert sched.page_pool_stats()["swap"][
+            "swapped_out_records"] == 0
+        done = sched.run_until_complete(max_steps=2000)
+        assert all(done[r].finished for r in ("big", "lo", "cand"))
+
+    def test_preempt_then_admit_event_counts(self):
+        """The step event reports GROSS admissions: a preempt-then-
+        admit step is one admission (the active-set delta would say
+        zero — and a preempt-then-reject step would go negative)."""
+        _, sched = _tiny(num_pages=8, max_batch_size=4, preempt=True,
+                         swap_bytes=64 << 20)
+        sched.submit(Request("lo", [1] * 8, max_new_tokens=8,
+                             priority=0))
+        for _ in range(2):
+            sched.step()
+        sched.submit(Request("hi", [2] * 8, max_new_tokens=8,
+                             priority=1))
+        ev = sched.step()
+        assert ev.get("preempted") == 1
+        assert ev["admitted"] == 1
+        done = sched.run_until_complete(max_steps=2000)
+        assert done["lo"].finished and done["hi"].finished
+
+    def test_swapped_requests_visible_in_stats(self):
+        _, sched = _tiny(num_pages=12, max_batch_size=4,
+                         page_watermark=1.0, preempt=True,
+                         swap_bytes=64 << 20)
+        for rid, p in PROMPTS.items():
+            sched.submit(Request(rid, list(p), max_new_tokens=N_NEW,
+                                 priority=0))
+        for _ in range(10):
+            sched.step()
+        sched.submit(Request("hi", list(HI_PROMPT),
+                             max_new_tokens=N_NEW, priority=5))
+        seen_swapped = 0
+        while sched.num_active or sched.num_queued or sched.num_swapped:
+            sched.step()
+            seen_swapped = max(seen_swapped, sched.num_swapped)
+            if seen_swapped:
+                st = sched.page_pool_stats()
+                assert st["swap"]["swapped_requests"] == \
+                    sched.num_swapped
+                break
+        assert seen_swapped >= 1
+        sched.run_until_complete(max_steps=2000)
+
+
+class TestAdmissionControl:
+    def test_bounded_queue_backpressure(self):
+        _, sched = _tiny(max_queue=2)
+        sched.submit(Request("a", [1, 2], max_new_tokens=1))
+        sched.submit(Request("b", [3, 4], max_new_tokens=1))
+        with pytest.raises(QueueFullError):
+            sched.submit(Request("c", [5, 6], max_new_tokens=1))
+        sched.step()  # a+b admitted, queue drains
+        sched.submit(Request("c", [5, 6], max_new_tokens=1))
+        done = sched.run_until_complete()
+        assert set(done) == {"a", "b", "c"}
+
+    def test_priority_order_and_fifo_within(self):
+        _, sched = _tiny(max_batch_size=1)
+        sched.submit(Request("lo", [1, 2], max_new_tokens=1,
+                             priority=0))
+        sched.submit(Request("hi1", [3, 4], max_new_tokens=1,
+                             priority=9))
+        sched.submit(Request("hi2", [5, 6], max_new_tokens=1,
+                             priority=9))
+        order = []
+        while sched.num_queued or sched.num_active:
+            ev = sched.step()
+            if ev["admitted"]:
+                order.append(next(iter(sched._active)))
+        assert order == ["hi1", "hi2", "lo"]
+
+    def test_tenant_inflight_cap(self):
+        _, sched = _tiny(max_batch_size=4, max_inflight_per_tenant=1)
+        for i in range(3):
+            sched.submit(Request(f"a{i}", [1 + i, 2], max_new_tokens=2,
+                                 tenant="acme"))
+        sched.submit(Request("b0", [7, 8], max_new_tokens=2,
+                             tenant="beta"))
+        while sched.num_queued or sched.num_active:
+            sched.step()
+            by_tenant = {}
+            for r in sched._active.values():
+                by_tenant[r.tenant] = by_tenant.get(r.tenant, 0) + 1
+            assert all(n <= 1 for n in by_tenant.values()), by_tenant
+        assert len(sched._finished) == 4
+
+
+class TestDeadlines:
+    def _clockable(self, monkeypatch, **kw):
+        now = [100.0]
+        monkeypatch.setattr(telemetry, "_clock", lambda: now[0])
+        model, sched = _tiny(**kw)
+        return now, model, sched
+
+    def test_abort_mid_prefill_releases_everything(self, monkeypatch):
+        now, model, sched = self._clockable(monkeypatch)
+        sched.submit(Request("d", [1, 2, 3, 4, 5, 6],
+                             max_new_tokens=4, deadline_s=5.0))
+        sched.step()  # admitted, prefill under way
+        assert sched.num_active == 1
+        assert model.caches[0].num_free_pages < 32
+        now[0] = 106.0  # past the deadline, mid-prefill
+        ev = sched.step()
+        assert ev["aborted"] == 1
+        req = sched.result("d")
+        assert req.state == RequestState.ABORTED_DEADLINE
+        assert req.terminal and not req.finished
+        assert req.generated_ids == []
+        # every reservation released, sanitizer-strict clean
+        assert model.caches[0].num_free_pages == 32
+        model.caches[0].assert_ref_invariants()
+
+    def test_abort_while_queued(self, monkeypatch):
+        now, _, sched = self._clockable(monkeypatch, max_batch_size=1)
+        sched.submit(Request("a", [1, 2], max_new_tokens=8))
+        sched.step()  # a occupies the only slot
+        sched.submit(Request("d", [3, 4], max_new_tokens=1,
+                             deadline_s=2.0))
+        now[0] = 103.0
+        sched.step()
+        assert sched.result("d").state == RequestState.ABORTED_DEADLINE
+        done = sched.run_until_complete()
+        assert done["a"].finished
+
+    def test_abort_while_swapped_discards_record(self, monkeypatch):
+        now, model, sched = self._clockable(
+            monkeypatch, num_pages=12, max_batch_size=4,
+            page_watermark=1.0, preempt=True, swap_bytes=64 << 20)
+        for rid, p in PROMPTS.items():
+            sched.submit(Request(rid, list(p), max_new_tokens=N_NEW,
+                                 priority=0, deadline_s=50.0))
+        for _ in range(10):
+            sched.step()
+        sched.submit(Request("hi", list(HI_PROMPT),
+                             max_new_tokens=N_NEW, priority=5))
+        while sched.num_swapped == 0 and (sched.num_queued
+                                          or sched.num_active):
+            sched.step()
+        assert sched.num_swapped >= 1
+        swapped = [r.req_id for r in sched._swapped.values()]
+        now[0] = 200.0  # past every low-priority deadline
+        sched.step()
+        for rid in swapped:
+            assert sched.result(rid).state == \
+                RequestState.ABORTED_DEADLINE
+        done = sched.run_until_complete(max_steps=2000)
+        assert done["hi"].generated_ids == _ref()["hi"]
+        st = sched.page_pool_stats()
+        assert st["swap"]["records"] == 0
+        assert st["free_pages"] == st["total_pages"]
+        model.caches[0].assert_ref_invariants()
+
+    def test_deadline_validation(self):
+        _, sched = _tiny()
+        with pytest.raises(ValueError):
+            sched.submit(Request("x", [1], max_new_tokens=1,
+                                 deadline_s=0.0))
+
+
+class TestAdmissionAccounting:
+    """Satellite 2: reject vs preempt-then-admit vs deadline-abort
+    are DISTINCT registry signals."""
+
+    @pytest.fixture
+    def reg(self):
+        set_flags({"telemetry": "metrics"})
+        telemetry.reset()
+        yield telemetry.registry()
+        set_flags({"telemetry": "off"})
+        telemetry.reset()
+
+    def test_counters_are_distinct(self, reg, monkeypatch):
+        now = [100.0]
+        monkeypatch.setattr(telemetry, "_clock", lambda: now[0])
+        _, sched = _tiny(num_pages=12, max_batch_size=4,
+                         page_watermark=1.0, preempt=True,
+                         swap_bytes=64 << 20, max_queue=8)
+        for rid, p in PROMPTS.items():
+            sched.submit(Request(rid, list(p), max_new_tokens=N_NEW,
+                                 priority=0))
+        for _ in range(10):
+            sched.step()
+        sched.submit(Request("hi", list(HI_PROMPT),
+                             max_new_tokens=N_NEW, priority=5))
+        sched.run_until_complete(max_steps=2000)
+        assert reg.counter("serving.admit_preempt_then_admit") >= 1
+        assert reg.counter("serving.preempt_victims") >= 1
+        assert reg.counter("serving.swap_in_requests") >= 1
+        assert reg.counter("serving.swap_out_bytes") > 0
+        assert reg.counter("serving.aborted_deadline") == 0
+        assert reg.counter("serving.admit_reject_queue_full") == 0
+        # deadline abort is its own signal
+        sched.submit(Request("d", [1, 2], max_new_tokens=2,
+                             deadline_s=1.0))
+        now[0] = 500.0
+        sched.step()
+        assert reg.counter("serving.aborted_deadline") == 1
+        # queue-full rejects are their own signal
+        _, s2 = _tiny(max_queue=1)
+        s2.submit(Request("q0", [1], max_new_tokens=1))
+        with pytest.raises(QueueFullError):
+            s2.submit(Request("q1", [2], max_new_tokens=1))
+        assert reg.counter("serving.admit_reject_queue_full") == 1
+
+    def test_preempt_swap_full_counted(self, reg):
+        """A swap space too small for any victim: preemption
+        declines (counted) and admission falls back to waiting."""
+        _, sched = _tiny(num_pages=12, max_batch_size=4,
+                         page_watermark=1.0, preempt=True,
+                         swap_bytes=1)
+        for rid, p in PROMPTS.items():
+            sched.submit(Request(rid, list(p), max_new_tokens=N_NEW,
+                                 priority=0))
+        for _ in range(10):
+            sched.step()
+        sched.submit(Request("hi", list(HI_PROMPT),
+                             max_new_tokens=N_NEW, priority=5))
+        done = sched.run_until_complete(max_steps=2000)
+        assert reg.counter("serving.preempt_swap_full") >= 1
+        assert sched.page_pool_stats()["swap"][
+            "swapped_out_records"] == 0
+        assert done["hi"].generated_ids == _ref()["hi"]
+
+
+# -- full-model matrix: kv dtype x prefix cache ------------------------------
+
+
+@pytest.fixture(scope="module")
+def llama():
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(17)
+    return LlamaForCausalLM(llama_tiny(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=128))
+
+
+_RNG = np.random.RandomState(0)
+L_PROMPTS = {
+    "a": _RNG.randint(1, 500, 14).tolist(),  # long: mid-prefill at
+    "b": _RNG.randint(1, 500, 6).tolist(),   # the storm step
+    "c": _RNG.randint(1, 500, 9).tolist(),
+}
+
+
+def _llama_serve(model, kv, prefix, faults=None):
+    from paddle_tpu.incubate.nn.fault_injection import FaultInjector
+    from paddle_tpu.inference import PagedLlamaAdapter
+
+    adapter = PagedLlamaAdapter(model, num_pages=96, page_size=PAGE,
+                                max_length=64, kv_cache_dtype=kv,
+                                sanitizer="strict")
+    sched = BatchScheduler(
+        adapter, max_batch_size=4, prefix_cache=prefix,
+        prefill_chunk_tokens=6, preempt=True, swap_bytes=64 << 20,
+        fault_injector=FaultInjector(faults) if faults else None)
+    for rid, p in L_PROMPTS.items():
+        sched.submit(Request(rid, list(p), max_new_tokens=4))
+    done = sched.run_until_complete(max_steps=1000)
+    return {k: list(v.generated_ids) for k, v in done.items()}, sched
+
+
+_STORM_REFS = {}
+
+
+class TestAdapterSwapMatrix:
+    """Satellite 3's acceptance matrix on the REAL model: forced
+    swap round trips (mid-prefill victims included) across kv
+    {float32, int8} x prefix on/off must leave greedy outputs
+    identical to an unperturbed run — int8 proves the scale
+    sidecars ride the swap, prefix-on proves shared chains stay
+    attached through it."""
+
+    @pytest.mark.parametrize("kv", KV_MODES)
+    @pytest.mark.parametrize("prefix", [False, True])
+    def test_storm_roundtrip_greedy_identical(self, llama, kv,
+                                              prefix):
+        # the unperturbed reference is a pure function of
+        # (kv, prefix) — cache it across the matrix (the llama
+        # fixture is deterministic), halving each cell's cost
+        if (kv, prefix) not in _STORM_REFS:
+            _STORM_REFS[(kv, prefix)] = _llama_serve(
+                llama, kv, prefix)[0]
+        ref = _STORM_REFS[(kv, prefix)]
+        got, sched = _llama_serve(
+            llama, kv, prefix,
+            faults="preempt_storm@3:2,delay_swap_in@3+2")
+        swap = sched.page_pool_stats()["swap"]
+        assert swap["swapped_out_records"] >= 1
+        assert swap["records"] == 0 and swap["used_bytes"] == 0
+        assert got == ref
+        st = sched.page_pool_stats()
+        if not prefix:  # the radix tree deliberately retains pages
+            assert st["free_pages"] == st["total_pages"]
+        for c in sched.model.caches:
+            c.assert_ref_invariants()
+
+    def test_pinned_prefix_victim(self, llama):
+        """Preempting a request that sits on a PINNED cached prefix:
+        the pin blocks eviction of the shared pages (they stay
+        on-device under the swap hold) but never blocks swapping the
+        private tail — and the resumed request is greedy-identical."""
+        from paddle_tpu.incubate.nn.fault_injection import (
+            FaultInjector,
+        )
+        from paddle_tpu.inference import PagedLlamaAdapter
+
+        seed_prompt = L_PROMPTS["a"]
+        victim_prompt = list(seed_prompt) + [7, 11, 13]
+
+        def run(faults):
+            adapter = PagedLlamaAdapter(
+                llama, num_pages=96, page_size=PAGE, max_length=64,
+                kv_cache_dtype=None, sanitizer="strict")
+            sched = BatchScheduler(
+                adapter, max_batch_size=4, prefix_cache=True,
+                prefill_chunk_tokens=6, preempt=True,
+                swap_bytes=64 << 20,
+                fault_injector=FaultInjector(faults)
+                if faults else None)
+            sched.submit(Request("seed", list(seed_prompt),
+                                 max_new_tokens=2, priority=9))
+            sched.run_until_complete(max_steps=200)  # inserts prefix
+            sched.submit(Request("victim", list(victim_prompt),
+                                 max_new_tokens=6, priority=0))
+            done = sched.run_until_complete(max_steps=1000)
+            return done["victim"], sched
+
+        ref, ref_sched = run(None)
+        assert ref._prefix_hit > 0  # the cache really was hit
+        # storm lands while the victim decodes on its pinned prefix
+        got, sched = run("preempt_storm@9:1,delay_swap_in@9+2")
+        assert got._preemptions >= 1
+        assert got._prefix_hit == ref._prefix_hit
+        assert got.generated_ids == ref.generated_ids
+        swap = sched.page_pool_stats()["swap"]
+        assert swap["swapped_out_records"] >= 1
+        assert swap["records"] == 0
+        for c in sched.model.caches:
+            c.assert_ref_invariants()

@@ -481,6 +481,9 @@ class TestCLI:
         groups = payload["static_checks"]
         assert set(groups) == {"jaxpr", "page_sanitizer",
                                "codebase_lint", "telemetry",
-                               "watchdog"}
+                               "watchdog", "serving_faults"}
         assert {r["rule_id"] for r in groups["page_sanitizer"]} \
             == set(VIOLATIONS)
+        assert {r["rule_id"] for r in groups["serving_faults"]} \
+            == {"exhaust", "preempt_storm", "delay_swap_in",
+                "fail_step"}

@@ -493,7 +493,7 @@ class TestInventory:
         inv = static_check_inventory()
         ids = {r["rule_id"] for r in inv["watchdog"]}
         assert ids == {cls for cls, _ in WATCHDOG_CLASSES}
-        assert len(WATCHDOG_CLASSES) == 5
+        assert len(WATCHDOG_CLASSES) == 6
 
 
 # -- epoch-windowed views -----------------------------------------------------
@@ -973,6 +973,42 @@ class TestWatchdogs:
         assert fired[0]["class"] == "sanitizer-spike"
         assert fired[0]["detail"]["new_violations"] == 2
         assert fired[0]["sanitizer_journal_tail"][0]["op"] == "free"
+
+    def test_preemption_thrash_rate_and_hysteresis(self, tel_off):
+        """ISSUE 9: swap-outs per trailing window above the
+        threshold fire once (latched); healthy one-off preemptions
+        below it never do; recovery re-arms the latch."""
+        reg = _mk_registry()
+        reg.inc("serving.preempt_victims", 0)
+        reg.gauge("serving.swapped_requests", 0)
+        wd = Watchdog(reg, mode="warn", window=8, warmup=0,
+                      thrash_preempts=4)
+        wd.check(1)  # baseline observation
+        reg.inc("serving.preempt_victims", 2)  # healthy burst
+        assert wd.check(2) == []
+        reg.inc("serving.preempt_victims", 5)  # thrash: 5 > 4/window
+        reg.gauge("serving.swapped_requests", 3)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fired = wd.check(3)
+        assert [e["class"] for e in fired] == ["preemption-thrash"]
+        # the trailing window still holds the healthy +2: 2 + 5
+        assert fired[0]["detail"]["preemptions_in_window"] == 7.0
+        assert fired[0]["detail"]["swapped_now"] == 3.0
+        # latched: still elevated next check -> no second event
+        reg.inc("serving.preempt_victims", 5)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert wd.counts["preemption-thrash"] == 1
+            wd.check(4)
+        assert wd.counts["preemption-thrash"] == 1
+        # recovery re-arms, a fresh excursion fires again
+        assert wd.check(5) == []
+        reg.inc("serving.preempt_victims", 6)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fired = wd.check(6)
+        assert [e["class"] for e in fired] == ["preemption-thrash"]
 
     def test_event_log_bounded_and_dumpable(self, tel_off, tmp_path):
         reg = _mk_registry()

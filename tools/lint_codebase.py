@@ -39,18 +39,27 @@ Checks:
 6. pool-mutation audit (the static half of the KV page-pool
    sanitizer, incubate/nn/page_sanitizer.py): the paged pool's state
    — page payloads (``k_pages``/``v_pages``), quantization sidecars
-   (``k_scales``/``v_scales``), and refcount bookkeeping
-   (``_refcnt``/``_free``/``_tables``/``_lens``/``_ext_refs``) — may
-   be written ONLY inside PagedKVCacheManager methods
-   (paged_cache.py). Any other inference/incubate module assigning,
-   aug-assigning, or ``.at[...]``-updating them bypasses the
-   sanitizer's event instrumentation; and the serving consumers
+   (``k_scales``/``v_scales``), refcount bookkeeping
+   (``_refcnt``/``_free``/``_tables``/``_lens``/``_ext_refs``), and
+   the host swap tier's store (``_swap_store``/``_swap_used`` on
+   HostKVSwapSpace) — may be written ONLY inside PagedKVCacheManager
+   methods (paged_cache.py). Any other inference/incubate module
+   assigning, aug-assigning, or ``.at[...]``-updating them bypasses
+   the sanitizer's event instrumentation; and the serving consumers
    (inference/serving.py, prefix_cache.py, paged_llama.py) must stay
    on the public audited pool API — calling a pool-private underscore
-   method (``_next_slot``/``_release_page``/``_fork_page``/...) or
-   touching the private bookkeeping attrs from there is an error.
-   Together these guarantee the dynamic sanitizer's event coverage
-   statically: there is no un-instrumented mutation path.
+   method (``_next_slot``/``_release_page``/``_fork_page``/
+   ``_swap_put``/...) or touching the private bookkeeping attrs from
+   there is an error. Together these guarantee the dynamic
+   sanitizer's event coverage statically: there is no
+   un-instrumented mutation path (the swap tier included).
+6b. serving terminal-trace discipline: any function in
+   inference/serving.py that moves a request to a terminal state
+   (assigns ``RequestState.FINISHED``/``ABORTED_DEADLINE`` or writes
+   ``self._finished[...]``) must call ``self._traces.complete(...)``
+   in the same function — the scheduler may never drop a request
+   without its terminal request-trace event, so per-request
+   timelines stay complete under preemption and deadline aborts.
 7. clock discipline (the framework/telemetry.py observability
    contract): the instrumented serving modules
    (inference/serving.py, incubate/nn/paged_cache.py,
@@ -113,6 +122,7 @@ HOST_ONLY_FILES = (
     os.path.join("paddle_tpu", "inference", "prefix_cache.py"),
     os.path.join("paddle_tpu", "framework", "telemetry.py"),
     os.path.join("paddle_tpu", "framework", "watchdog.py"),
+    os.path.join("paddle_tpu", "incubate", "nn", "fault_injection.py"),
 )
 
 _HOST_ONLY_BANNED_MODULES = ("jax", "jax.numpy")
@@ -450,15 +460,22 @@ POOL_MUTATION_EXEMPT = (
 
 # every attr here is PagedKVCacheManager-private mutable state; the
 # tree's own `node.pages` lists are tree state and deliberately NOT in
-# this set (the pool's page payloads are k_pages/v_pages)
+# this set (the pool's page payloads are k_pages/v_pages). The host
+# swap tier's store (_swap_store/_swap_used on HostKVSwapSpace) is
+# swap-tier-private by the same contract: writable only through the
+# pool's swap_out/swap_in/swap_discard so the sanitizer's swap events
+# see every transition
 _POOL_STATE_ATTRS = (
     "k_pages", "v_pages", "k_scales", "v_scales",
     "_refcnt", "_free", "_tables", "_lens", "_ext_refs",
+    "_swap_store", "_swap_used",
 )
 # the refcount-bookkeeping subset: reading these from serving code is
-# also an API bypass (the pool exposes num_free_pages/seq_pages/...)
+# also an API bypass (the pool exposes num_free_pages/seq_pages/...;
+# the swap space exposes used_bytes/free_bytes/num_records/summary)
 _POOL_BOOKKEEPING_ATTRS = (
     "_refcnt", "_free", "_tables", "_lens", "_ext_refs",
+    "_swap_store", "_swap_used",
 )
 
 # serving modules restricted to the PUBLIC audited pool API
@@ -475,6 +492,7 @@ _POOL_PRIVATE_METHODS = (
     "_next_slot", "_release_page", "_alloc_page", "_fork_page",
     "_copy_page", "_quant_write", "_padded_kernel_inputs",
     "_ref_pages", "_drop_refs", "_needs_fork",
+    "_swap_put", "_swap_get", "_swap_pop",
 )
 
 
@@ -630,6 +648,87 @@ def check_pool_mutation_audit(root=REPO):
                 out.extend(lint_pool_state_file(path))
     for f in POOL_API_FILES:
         out.extend(lint_pool_api_file(os.path.join(root, f)))
+    return out
+
+
+# the serving scheduler may never DROP a request silently: any
+# function that moves a request to a terminal state (writes
+# self._finished[...] or assigns RequestState.FINISHED /
+# RequestState.ABORTED_DEADLINE) must emit the terminal request-trace
+# event (self._traces.complete(...)) in the SAME function, so every
+# retired/aborted request has a complete timeline when tracing is on
+SERVING_TERMINAL_FILES = (
+    os.path.join("paddle_tpu", "inference", "serving.py"),
+)
+_TERMINAL_STATES = ("FINISHED", "ABORTED_DEADLINE")
+
+
+def _fn_drops_request(fn_node):
+    """(drops, emits) for one function body: does it move a request
+    to a terminal state, and does it call ._traces.complete(...)?"""
+    drops = emits = False
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                # self._finished[rid] = req
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Attribute) \
+                        and t.value.attr == "_finished":
+                    drops = True
+                # req.state = RequestState.FINISHED / ABORTED_DEADLINE
+                if isinstance(t, ast.Attribute) \
+                        and t.attr == "state" \
+                        and isinstance(node.value, ast.Attribute) \
+                        and isinstance(node.value.value, ast.Name) \
+                        and node.value.value.id == "RequestState" \
+                        and node.value.attr in _TERMINAL_STATES:
+                    drops = True
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "complete" \
+                and isinstance(node.func.value, ast.Attribute) \
+                and node.func.value.attr == "_traces":
+            emits = True
+    return drops, emits
+
+
+def lint_serving_terminal_file(path, text=None):
+    """Terminal-trace audit for one scheduler file; returns
+    violations."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    lines = text.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        drops, emits = _fn_drops_request(node)
+        if drops and not emits:
+            line = lines[node.lineno - 1] \
+                if node.lineno - 1 < len(lines) else ""
+            if _WAIVER_MARK not in line:
+                out.append(
+                    "%s:%d: %s() moves a request to a terminal state "
+                    "without calling self._traces.complete(...) — the "
+                    "scheduler must never drop a request silently "
+                    "(every retired/aborted request needs its "
+                    "terminal trace event); fix it or waive with "
+                    "'%s(<reason>)'"
+                    % (rel, node.lineno, node.name, _WAIVER_MARK))
+    return out
+
+
+def check_serving_terminal_trace(root=REPO):
+    out = []
+    for f in SERVING_TERMINAL_FILES:
+        out.extend(lint_serving_terminal_file(os.path.join(root, f)))
     return out
 
 
@@ -1076,7 +1175,8 @@ RULES = (
      "(k_scales/v_scales are pool-private calibration state)"),
     ("pool-mutation-audit",
      "PagedKVCacheManager state (k_pages/v_pages/k_scales/v_scales/"
-     "_refcnt/_free/_tables/_lens/_ext_refs) is writable only inside "
+     "_refcnt/_free/_tables/_lens/_ext_refs) and the host swap "
+     "tier's store (_swap_store/_swap_used) are writable only inside "
      "the pool module — everything else goes through the sanitizer-"
      "instrumented public API"),
     ("pool-private-api",
@@ -1086,6 +1186,11 @@ RULES = (
     ("serving-bucket-discipline",
      "every prefill_chunk feed must be padded via "
      "bucket_packed_tokens (bounded XLA compile count)"),
+    ("serving-terminal-trace",
+     "any serving.py function that moves a request to a terminal "
+     "state (FINISHED/ABORTED_DEADLINE or a _finished[] write) must "
+     "emit the terminal request-trace event (_traces.complete) in "
+     "the same function — no request is ever dropped silently"),
     ("jax-only-kernel-imports",
      "collective-matmul kernel module must not import host-side "
      "modules"),
@@ -1103,6 +1208,7 @@ def run_lint(root=REPO, with_op_table=True):
     out.extend(check_quant_sidecar_writes(root))
     out.extend(check_pool_mutation_audit(root))
     out.extend(check_serving_buckets(root))
+    out.extend(check_serving_terminal_trace(root))
     out.extend(check_jax_only(root))
     out.extend(check_tp_routing(root))
     if with_op_table:
