@@ -175,6 +175,17 @@ def _emit_final(headline, configs, stalled=False):
             full["jit_lint"] = lint
     except Exception:
         pass
+    try:
+        # per-program static resource plans (framework/planner.py):
+        # planned peak HBM + per-axis collective bytes per compiled
+        # step, for the same artifact rounds
+        from paddle_tpu.framework.planner import live_plan_summaries
+
+        plans = live_plan_summaries()
+        if plans:
+            full["jit_plan"] = plans
+    except Exception:
+        pass
     _atomic_json_dump(_DETAIL_FILE, full)
 
     compact = {}
@@ -963,6 +974,56 @@ def bench_chunked_prefill(users=8, prompt_len=96, new_tokens=8,
             "steps": sched.chunk_stats["steps"] or None,
         }
 
+    def plan_pool(check_tol=0.10):
+        """Static-planner validation (ISSUE 10): trace ONE layer's
+        paged-attend program of the chunked-prefill serving step (the
+        pool's page arrays and scale sidecars ride in as closed-over
+        consts — the planner's const accounting), attribute the
+        page-shaped const buffers, scale to every layer, and compare
+        against the pool's own byte accounting. The model predicts
+        from shapes alone — no step runs."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.framework import planner as _planner
+
+        adapter = PagedLlamaAdapter(
+            model, num_pages=num_pages, page_size=page_size,
+            max_length=cfg.max_position_embeddings)
+        c0 = adapter.caches[0]
+        seq = "__plan_probe__"
+        c0.alloc(seq)
+        kvh, hd = c0.k_pages.shape[2], c0.k_pages.shape[3]
+        kv_dt = jnp.float32  # append calibrates quantized pools too
+        c0.append(seq, jnp.zeros((kvh, hd), kv_dt),
+                  jnp.zeros((kvh, hd), kv_dt))
+        nh = cfg.num_attention_heads
+        qs = jax.ShapeDtypeStruct((1, nh, hd), jnp.float32)
+        closed = jax.make_jaxpr(
+            lambda q: c0.attend_padded(
+                q, [seq], rows_pad=1, max_pages=4)._data)(qs)
+        plan, _ = _planner.plan_jaxpr(
+            closed, name="serving_chunked_prefill_attend")
+        page_bytes = sum(
+            b.nbytes for b in plan.buffers_of("const")
+            if b.shape and b.shape[0] == c0.num_pages)
+        predicted = page_bytes * len(adapter.caches)
+        c0.free(seq)
+        actual = BatchScheduler(
+            adapter,
+            max_batch_size=users).page_pool_stats()["pool_bytes"]
+        rel_err = abs(predicted - actual) / max(actual, 1)
+        assert rel_err <= check_tol, (
+            f"planner predicted {predicted} pool bytes vs "
+            f"page_pool_stats {actual} ({rel_err:.1%} > {check_tol:.0%})")
+        return {
+            "predicted_pool_bytes": int(predicted),
+            "actual_pool_bytes": int(actual),
+            "rel_err": round(rel_err, 4),
+            "within_10pct": rel_err <= check_tol,
+            "plan": plan.to_dict(max_buffers=4),
+        }
+
     run(None)          # warmup: kernel compiles land outside timing
     base = run(None)
     arms = {}
@@ -1000,6 +1061,7 @@ def bench_chunked_prefill(users=8, prompt_len=96, new_tokens=8,
         "serving_buckets": str(flag("serving_buckets")),
         "num_buckets": n_buckets,
         "budgets": arms,
+        "planner": plan_pool(),
     }
     return _merge_serving_rec("chunked_prefill", rec)
 
@@ -2162,6 +2224,20 @@ def _cpu_mesh_tp_overlap():
     t_r = timed(ring, x, w)
     err = float(jnp.max(jnp.abs(
         plain(x, w).astype(jnp.float32) - ring(x, w).astype(jnp.float32))))
+    # static-planner validation (ISSUE 10): the planned per-device
+    # ring traffic of the forward decomposition must match the chunk
+    # schedule EXACTLY — ws-1 ppermute hops, each moving this
+    # device's (rows/ws, K) fp32 x-chunk
+    from paddle_tpu.framework import planner as _planner
+
+    plan_ag, _ = _planner.plan_jaxpr(
+        jax.make_jaxpr(ring)(x, w), name="ag_matmul_ring",
+        mesh_axis_sizes={"mp": ws})
+    sched_ag = (ws - 1) * (B * S // ws) * K * 4
+    got_ag = plan_ag.comm_bytes_by_axis.get("mp", 0)
+    assert got_ag == sched_ag, (
+        f"planner ring bytes {got_ag} != chunk schedule {sched_ag}")
+    assert plan_ag.ring_chunks_by_axis.get("mp") == ws - 1
     arms["ag_matmul"] = {
         "plain_ms": round(1000 * t_p, 2),
         "decomposed_ms": round(1000 * t_r, 2),
@@ -2169,6 +2245,9 @@ def _cpu_mesh_tp_overlap():
         "chunks": ws,
         "chunk_rows": B * S // ws,
         "max_abs_err": err,
+        "planned_ring_bytes": int(got_ag),
+        "planned_ring_hops": plan_ag.ring_chunks_by_axis.get("mp"),
+        "plan_comm_exact": got_ag == sched_ag,
     }
 
     # --- SP exit: psum_scatter(x @ w) -------------------------------------
@@ -2186,6 +2265,16 @@ def _cpu_mesh_tp_overlap():
     t_r = timed(ring, x, w)
     err = float(jnp.max(jnp.abs(
         plain(x, w).astype(jnp.float32) - ring(x, w).astype(jnp.float32))))
+    # planner vs chunk schedule, exact (see ag_matmul above): the RS
+    # ring's carry is the (rows/ws, N) fp32 partial-sum chunk
+    plan_rs, _ = _planner.plan_jaxpr(
+        jax.make_jaxpr(ring)(x, w), name="matmul_rs_ring",
+        mesh_axis_sizes={"mp": ws})
+    sched_rs = (ws - 1) * (B * S // ws) * N * 4
+    got_rs = plan_rs.comm_bytes_by_axis.get("mp", 0)
+    assert got_rs == sched_rs, (
+        f"planner ring bytes {got_rs} != chunk schedule {sched_rs}")
+    assert plan_rs.ring_chunks_by_axis.get("mp") == ws - 1
     arms["matmul_reduce_scatter"] = {
         "plain_ms": round(1000 * t_p, 2),
         "decomposed_ms": round(1000 * t_r, 2),
@@ -2193,11 +2282,15 @@ def _cpu_mesh_tp_overlap():
         "chunks": ws,
         "chunk_rows": B * S // ws,
         "max_abs_err": err,
+        "planned_ring_bytes": int(got_rs),
+        "planned_ring_hops": plan_rs.ring_chunks_by_axis.get("mp"),
+        "plan_comm_exact": got_rs == sched_rs,
     }
 
     flops = 2.0 * B * S * K * N * 3.0  # fwd + ~2x bwd per pair
     ok = all(a["max_abs_err"] < 1e-3 and
-             a["decomposed_ms"] > 0 for a in arms.values())
+             a["decomposed_ms"] > 0 and
+             a.get("plan_comm_exact", True) for a in arms.values())
     return {
         "config": "tp_overlap", "mode": "cpu-mesh-dryrun",
         "mesh": "mp%d" % ws,
@@ -2300,6 +2393,11 @@ def main() -> int:
             max(a["prefill_speedup"] for a in big) >= 2.0 and \
             all((a["compile_count"] or 0) <= crec["num_buckets"]
                 for a in crec.get("budgets", {}).values())
+        # ISSUE-10 planner acceptance: the static resource plan of the
+        # serving attend program predicts the page-pool bytes within
+        # 10% of the pool's own accounting
+        chunk_ok = chunk_ok and \
+            bool(crec.get("planner", {}).get("within_10pct"))
         # ISSUE-6 sanitizer acceptance: off-mode serving allocates
         # NOTHING in page_sanitizer.py, strict mode is output-identical
         # and violation-free on a healthy pool

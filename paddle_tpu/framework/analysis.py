@@ -38,6 +38,20 @@ otherwise only surface as slow steps or hangs on real TPUs:
                                        collective-matmul ring would
                                        decompose; docs/OVERLAP.md)
 
+Planner rules (framework/planner.py, FLAGS_jit_plan — judged from
+the static resource plan, not the jaxpr walk; registered here so the
+3-scope suppression covers them):
+
+  hbm-over-budget            critical  planned peak live HBM exceeds
+                                       FLAGS_jit_budget_hbm
+  comm-over-budget           critical  planned per-device collective
+                                       bytes exceed FLAGS_jit_budget_comm
+  comm-bound-program         warning   flops-per-comm-byte below the
+                                       threshold with fp32+ collectives
+                                       (quantized-ring candidates)
+  dead-collective            warning   collective whose result is
+                                       never consumed
+
 Modes (FLAGS_jit_lint): ``off`` — analysis never runs, compiled
 programs are bit-for-bit unaffected; ``warn`` (default) — findings go
 to the report + VLOG(1), criticals also to the console; ``strict`` —
@@ -117,6 +131,36 @@ OVERLAP_MISS = _rule(
     "blocking all_gather whose sole consumer is a large dot_general: "
     "the dependent pair serializes instead of riding the "
     "collective-matmul ring")
+
+# -- planner rules (framework/planner.py) -----------------------------------
+# Computed from the static resource plan a compiled program gets under
+# FLAGS_jit_plan (not from the jaxpr walk above). Registered HERE so
+# the 3-scope suppression plumbing (FLAGS_jit_lint_suppress /
+# @to_static(lint_suppress) / per-call suppress) covers them without
+# importing the planner; the --rules inventory lists them under their
+# own "planner" group (PLANNER_RULE_IDS).
+HBM_OVER_BUDGET = _rule(
+    "hbm-over-budget", "critical",
+    "planned peak live HBM of the compiled program exceeds "
+    "FLAGS_jit_budget_hbm (a planned OOM, caught at compile time)")
+COMM_OVER_BUDGET = _rule(
+    "comm-over-budget", "critical",
+    "planned per-device collective traffic of the compiled program "
+    "exceeds FLAGS_jit_budget_comm")
+COMM_BOUND_PROGRAM = _rule(
+    "comm-bound-program", "warning",
+    "compute/comm ratio below FLAGS_jit_plan_comm_bound_ratio with "
+    "wide (>= 4-byte) collectives: traffic a quantized ring "
+    "(int8/fp8 quantize-on-the-wire, ROADMAP item 3) would halve or "
+    "quarter")
+DEAD_COLLECTIVE = _rule(
+    "dead-collective", "warning",
+    "collective whose result is never consumed: pure ICI traffic "
+    "(and a deadlock hazard if any rewrite drops it on a subset of "
+    "devices)")
+
+PLANNER_RULE_IDS = ("hbm-over-budget", "comm-over-budget",
+                    "comm-bound-program", "dead-collective")
 
 # primitives allowed to consume low precision and produce wide floats:
 # numerically-motivated accumulation (the reference's CINN/AMP lists
@@ -905,7 +949,13 @@ def static_check_inventory() -> dict:
     tools/lint_codebase.py. Emitted in the CLI's --json payload
     under ``static_checks`` and printable standalone with
     ``--rules``."""
-    inv = {"jaxpr": [dataclasses.asdict(r) for r in RULES.values()]}
+    inv = {"jaxpr": [dataclasses.asdict(r) for r in RULES.values()
+                     if r.rule_id not in PLANNER_RULE_IDS],
+           # the resource-planner rules (framework/planner.py) are
+           # registered in RULES for suppression but inventoried as
+           # their own group — they judge the PLAN, not the jaxpr walk
+           "planner": [dataclasses.asdict(RULES[rid])
+                       for rid in PLANNER_RULE_IDS]}
     try:
         from .telemetry import SURFACE
 
@@ -971,15 +1021,21 @@ def static_check_inventory() -> dict:
 # CLI: python -m paddle_tpu.framework.analysis script.py [--json out]
 # ---------------------------------------------------------------------------
 
-def _cli_collect_reports(suppress):
+def _cli_collect_reports(suppress, with_plans=False):
     from ..jit.api import live_static_functions
 
-    reports = []
+    reports, plans = [], []
     for sf in live_static_functions():
         for entry in sf._finalized_entries():
             reports.append(lint_static_entry(sf, entry,
                                              suppress=suppress))
-    return reports
+            if with_plans:
+                from . import planner
+
+                plan, prep = planner.plan_static_entry(
+                    sf, entry, suppress=suppress)
+                plans.append((plan, prep))
+    return reports, plans
 
 
 def main(argv=None) -> int:
@@ -1003,9 +1059,17 @@ def main(argv=None) -> int:
                     "('-' for stdout)")
     ap.add_argument("--rules", action="store_true",
                     help="print the full static-check inventory "
-                    "(jaxpr lint rules + page-sanitizer violation "
-                    "classes + codebase AST lint rules) and exit; "
-                    "honors --json")
+                    "(jaxpr lint rules + planner rules + page-"
+                    "sanitizer violation classes + codebase AST lint "
+                    "rules) and exit; honors --json")
+    ap.add_argument("--plan", action="store_true",
+                    help="also run the static resource planner "
+                    "(framework/planner.py) over every compiled "
+                    "program: peak live HBM, per-axis collective "
+                    "bytes, output-vs-transient breakdown, and the "
+                    "planner findings (hbm-over-budget / comm-over-"
+                    "budget / comm-bound-program / dead-collective); "
+                    "plans ride the --json payload under 'plans'")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on any warning/critical finding "
                     "(default: only criticals fail)")
@@ -1046,10 +1110,12 @@ def main(argv=None) -> int:
         print("error: %r has no callable %r" % (entry, fn_name),
               file=sys.stderr)
         return 2
-    reports = _cli_collect_reports(suppress)
+    reports, plans = _cli_collect_reports(suppress,
+                                          with_plans=args.plan)
     if callable(target) and not reports:
         target()
-        reports = _cli_collect_reports(suppress)
+        reports, plans = _cli_collect_reports(suppress,
+                                              with_plans=args.plan)
 
     if not reports:
         print("no compiled @to_static programs found in %r (call the "
@@ -1063,19 +1129,31 @@ def main(argv=None) -> int:
         payload = {"version": 1, "entrypoint": args.entrypoint,
                    "programs": [r.to_dict() for r in reports],
                    "static_checks": static_check_inventory()}
+        if args.plan:
+            payload["plans"] = [
+                dict(plan.to_dict(), findings=[
+                    f.to_dict() for f in prep.findings])
+                for plan, prep in plans]
     if args.json == "-":
         print(json.dumps(payload, indent=1))
     else:
         for r in reports:
             print(r)
             print()
+        for plan, prep in plans:
+            print(plan)
+            if prep.findings or prep.suppressed:
+                print(prep.format())
+            print()
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(payload, f, indent=1)
             print("wrote %s" % args.json)
 
-    n_crit = sum(len(r.critical()) for r in reports)
-    n_block = sum(len(r.blocking()) for r in reports)
+    n_crit = sum(len(r.critical()) for r in reports) \
+        + sum(len(p.critical()) for _, p in plans)
+    n_block = sum(len(r.blocking()) for r in reports) \
+        + sum(len(p.blocking()) for _, p in plans)
     return 1 if (n_crit or (args.strict and n_block)) else 0
 
 

@@ -110,6 +110,38 @@ define_flag("jit_lint_suppress", "",
             "comma-separated lint rule ids to suppress globally "
             "(e.g. 'dtype-drift,donation-miss'; see "
             "framework/analysis.RULES for the id list)")
+define_flag("jit_plan", "report",
+            "static resource planner over @to_static programs "
+            "(framework/planner.py): 'off' skips planning entirely "
+            "(the module is never imported; zero allocations), "
+            "'report' (default) computes each compiled program's "
+            "peak-live-HBM / collective-byte plan, attaches it to "
+            "the cache entry, emits compile.hbm_peak_bytes and "
+            "compile.comm_bytes.<axis> telemetry, and logs planner "
+            "findings, 'strict' raises JitPlanError at compile time "
+            "on any hbm-over-budget / comm-over-budget / comm-bound-"
+            "program / dead-collective finding (suppression shares "
+            "the linter's three scopes; docs/ANALYSIS.md)")
+define_flag("jit_budget_hbm", 0,
+            "per-program peak-live-HBM budget in bytes for the "
+            "static resource planner: a compiled program whose "
+            "planned peak (linear-scan buffer lifetimes, donation/"
+            "alias aware) exceeds this fires hbm-over-budget "
+            "(critical; compile fails under FLAGS_jit_plan=strict). "
+            "0 (default) disables the gate")
+define_flag("jit_budget_comm", 0,
+            "per-program per-device collective-traffic budget in "
+            "bytes for the static resource planner: a compiled "
+            "program whose planned wire bytes (summed over all mesh "
+            "axes) exceed this fires comm-over-budget (critical). "
+            "0 (default) disables the gate")
+define_flag("jit_plan_comm_bound_ratio", 8.0,
+            "comm-bound-program threshold for the static resource "
+            "planner: a compiled program whose flops-per-comm-byte "
+            "ratio falls below this while moving >=4-byte collective "
+            "elements is flagged as a quantized-ring candidate "
+            "(EQuARX-style quantize-on-the-wire would halve the "
+            "bytes; ROADMAP item 3). 0 disables the check")
 define_flag("jit_lint_donation_min_bytes", 1 << 20,
             "donation-miss threshold: written-each-step state buffers "
             "at least this large must be donated into the compiled "

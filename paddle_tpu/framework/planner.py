@@ -1,0 +1,806 @@
+"""Static resource planner: jaxpr-level HBM footprint + collective
+cost model for every compiled program.
+
+Upstream analog: the memory-optimization and cost-model passes the
+reference runs over a static Program before execution
+(paddle/fluid/framework/ir/memory_optimize_pass, the inplace pass, and
+the op cost model feeding its parallel executors). Here every
+``@to_static`` program materializes a closed jaxpr (jit/api.py); this
+module is an abstract interpreter over the same walked items the
+trace-time linter (framework/analysis.py) visits, answering — WITHOUT
+running on a chip — the two questions ROADMAP items 3-4 hinge on:
+
+* **Peak live HBM** — a linear-scan buffer-lifetime pass over the
+  program: inputs + closed-over consts are resident, each equation
+  allocates its outputs, operands are freed at their last use when
+  freeable (intermediates, and donated inputs once dead). Donation
+  aliasing is honored (a donated state input aliased into its own
+  output slot allocates nothing new — the jit/api.py in-place update),
+  duplicate/passthrough outputs are deduped, and weak-typed scalar
+  consts are excluded (they bake to immediates, not buffers).
+  Sub-jaxprs (cond/scan/pjit/shard_map bodies) contribute their own
+  transient peak at the equation that runs them.
+
+* **Collective traffic** — per-collective per-device wire bytes from
+  an EQuARX-style byte model (all_gather moves (ws-1)/ws of its
+  output, psum 2x(ws-1)/ws of its operand, ppermute one full-operand
+  hop — the decomposed-ring chunk of ops/kernels/collective_matmul.py),
+  rolled up into bytes-per-mesh-axis, ring-chunk (ppermute hop)
+  counts, and a compute/comm flops-per-byte ratio reusing the
+  linter's ``_eqn_flops`` table. ``scan`` bodies multiply by their
+  trip count.
+
+* **Output-vs-transient breakdown** — bytes that leave the program
+  (its outputs; the serving pool's page arrays, a train step's updated
+  state) attributed separately from activation transients that only
+  live inside it.
+
+Modes (``FLAGS_jit_plan``): ``off`` — the planner never runs and is
+never even imported (one flag read per compile; zero allocations,
+gated in tests/bench); ``report`` (default) — the plan is attached to
+the compiled entry, ``compile.hbm_peak_bytes`` /
+``compile.comm_bytes.<axis>`` telemetry is emitted per program, and
+planner findings route like lint warnings; ``strict`` — any planner
+finding raises ``JitPlanError`` at compile time.
+
+Findings (registered in analysis.RULES, so the linter's 3-scope
+suppression — FLAGS_jit_lint_suppress, @to_static(lint_suppress=...),
+per-call suppress — applies unchanged):
+
+  hbm-over-budget     critical  plan peak > FLAGS_jit_budget_hbm
+  comm-over-budget    critical  plan comm bytes > FLAGS_jit_budget_comm
+  comm-bound-program  warning   flops/comm-byte below
+                                FLAGS_jit_plan_comm_bound_ratio with
+                                >= 4-byte collectives (a quantized
+                                ring would halve the wire bytes)
+  dead-collective     warning   collective whose result is unused
+
+On-demand API: ``paddle.jit.plan(fn_or_compiled, *example_args)``
+traces (never executes) and returns a ``ResourcePlan``.
+CLI: ``python -m paddle_tpu.framework.analysis script.py --plan
+[--json out]``. Every plan lands in the bench artifacts via
+``live_plan_summaries()`` (bench.py / tools/roofline.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import analysis
+from .analysis import (
+    COMM_BOUND_PROGRAM,
+    COMM_OVER_BUDGET,
+    DEAD_COLLECTIVE,
+    HBM_OVER_BUDGET,
+    AnalysisReport,
+    JitLintError,
+    _aval_dtype,
+    _aval_shape,
+    _collective_axes,
+    _eqn_flops,
+    _flag,
+    _prod,
+    _RuleLimiter,
+    _sub_jaxprs,
+    _vlog,
+    resolve_suppressions,
+)
+
+
+class JitPlanError(JitLintError):
+    """Raised under FLAGS_jit_plan=strict when a compiled program's
+    resource plan has blocking findings (budget overruns, dead
+    collectives) — a compile-time failure, before any step runs."""
+
+    def __init__(self, report: AnalysisReport):
+        self.report = report
+        RuntimeError.__init__(
+            self,
+            "jit plan (strict): %d blocking finding(s) in '%s'\n%s\n"
+            "Raise the budget (FLAGS_jit_budget_hbm / "
+            "FLAGS_jit_budget_comm), suppress individual rules with "
+            "FLAGS_jit_lint_suppress='<rule-id>,...' or "
+            "@to_static(lint_suppress=(...)), or set "
+            "FLAGS_jit_plan=report."
+            % (len(report.blocking()), report.name, report.format()))
+
+
+# primitives that move bytes over ICI, with their per-device wire-byte
+# model (EQuARX's accounting): f(nbytes, ws) -> bytes this device
+# sends+receives for one execution of the eqn. ``nbytes`` is the
+# operand total for reduce-side ops and the OUTPUT total for
+# gather-side ops (chosen per prim below). ws <= 1 means no wire.
+def _ring_factor(ws: int) -> float:
+    return (ws - 1) / ws if ws > 1 else 0.0
+
+
+_COMM_MODEL = {
+    # gather-side: every device receives the other ws-1 shards
+    "all_gather": ("out", lambda n, ws: n * _ring_factor(ws)),
+    "pgather": ("out", lambda n, ws: n * _ring_factor(ws)),
+    # reduce-side: ring reduce-scatter moves (ws-1)/ws of the operand
+    "reduce_scatter": ("in", lambda n, ws: n * _ring_factor(ws)),
+    "psum_scatter": ("in", lambda n, ws: n * _ring_factor(ws)),
+    # all-reduce = reduce-scatter + all-gather
+    "psum": ("in", lambda n, ws: 2.0 * n * _ring_factor(ws)),
+    "psum2": ("in", lambda n, ws: 2.0 * n * _ring_factor(ws)),
+    "pmax": ("in", lambda n, ws: 2.0 * n * _ring_factor(ws)),
+    "pmin": ("in", lambda n, ws: 2.0 * n * _ring_factor(ws)),
+    # one neighbor hop of the full operand — the decomposed-ring chunk
+    # (ops/kernels/collective_matmul.py sends one chunk per hop)
+    "ppermute": ("in", lambda n, ws: float(n) if ws != 1 else 0.0),
+    "pbroadcast": ("in", lambda n, ws: n * _ring_factor(ws)),
+    "all_to_all": ("in", lambda n, ws: n * _ring_factor(ws)),
+}
+
+# ppermute is how the PR-4 ring decomposition moves chunks — each hop
+# is one ring chunk in the plan's per-axis rollup
+_RING_PRIMS = frozenset({"ppermute"})
+
+
+@dataclasses.dataclass
+class CollectiveCost:
+    """One collective equation's planned traffic (per device)."""
+
+    prim: str
+    axis: str
+    axis_size: int
+    nbytes: int          # wire bytes per device (x trip multiplier)
+    dtype: str
+    itemsize: int
+    ring_chunk: bool     # a ppermute hop (decomposed-ring chunk)
+    mult: float          # scan trip multiplier applied
+    where: str
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in (
+            "prim", "axis", "axis_size", "nbytes", "dtype",
+            "itemsize", "ring_chunk", "mult", "where")}
+
+
+@dataclasses.dataclass
+class BufferUse:
+    """One program-level buffer in the plan's footprint accounting."""
+
+    kind: str            # input | donated-input | const | output
+    nbytes: int
+    shape: Tuple[int, ...]
+    dtype: str
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "nbytes": self.nbytes,
+                "shape": list(self.shape), "dtype": self.dtype}
+
+
+class ResourcePlan:
+    """Structured result of one planner pass over a compiled program.
+
+    Byte fields are per-device estimates: ``hbm_peak_bytes`` is the
+    linear-scan peak (inputs + consts + live intermediates, donation-
+    and alias-aware); ``output_bytes`` is what leaves the program
+    (newly allocated — passthrough and donated-alias outputs add
+    nothing); ``transient_peak_bytes`` is the peak of intermediates
+    that are NOT outputs (activation transients). ``collectives`` is
+    the per-eqn traffic table and ``comm_bytes_by_axis`` its rollup;
+    ``flops_per_comm_byte`` is None for communication-free programs.
+    """
+
+    def __init__(self, name: str, n_eqns: int = 0):
+        self.name = name
+        self.n_eqns = n_eqns
+        self.hbm_peak_bytes = 0
+        self.peak_at = ""
+        self.input_bytes = 0
+        self.donated_bytes = 0
+        self.const_bytes = 0
+        self.output_bytes = 0
+        self.transient_peak_bytes = 0
+        self.weak_consts_excluded = 0
+        self.collectives: List[CollectiveCost] = []
+        self.dead_collectives: List[Tuple[str, str, str]] = []
+        self.buffers: List[BufferUse] = []
+        self.flops_total = 0.0
+
+    # -- rollups ------------------------------------------------------
+    @property
+    def comm_bytes_by_axis(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for c in self.collectives:
+            out[c.axis] = out.get(c.axis, 0) + c.nbytes
+        return out
+
+    @property
+    def ring_chunks_by_axis(self) -> Dict[str, int]:
+        """ppermute hops per axis — the decomposed-ring chunk count of
+        the PR-4 collective-matmul paths (one chunk moves per hop)."""
+        out: Dict[str, int] = {}
+        for c in self.collectives:
+            if c.ring_chunk:
+                out[c.axis] = out.get(c.axis, 0) + max(
+                    1, int(round(c.mult)))
+        return out
+
+    @property
+    def comm_bytes_total(self) -> int:
+        return sum(c.nbytes for c in self.collectives)
+
+    @property
+    def flops_per_comm_byte(self) -> Optional[float]:
+        total = self.comm_bytes_total
+        if total <= 0:
+            return None
+        return self.flops_total / total
+
+    def buffers_of(self, kind: str) -> List[BufferUse]:
+        return [b for b in self.buffers if b.kind == kind]
+
+    # -- serialization ------------------------------------------------
+    def to_dict(self, max_buffers: int = 16) -> dict:
+        bufs = sorted(self.buffers, key=lambda b: -b.nbytes)
+        ratio = self.flops_per_comm_byte
+        return {
+            "program": self.name,
+            "n_eqns": self.n_eqns,
+            "hbm_peak_bytes": int(self.hbm_peak_bytes),
+            "peak_at": self.peak_at,
+            "input_bytes": int(self.input_bytes),
+            "donated_bytes": int(self.donated_bytes),
+            "const_bytes": int(self.const_bytes),
+            "output_bytes": int(self.output_bytes),
+            "transient_peak_bytes": int(self.transient_peak_bytes),
+            "weak_consts_excluded": int(self.weak_consts_excluded),
+            "flops_total": float(self.flops_total),
+            "comm_bytes_total": int(self.comm_bytes_total),
+            "comm_bytes_by_axis": {
+                k: int(v) for k, v in self.comm_bytes_by_axis.items()},
+            "ring_chunks_by_axis": dict(self.ring_chunks_by_axis),
+            "flops_per_comm_byte": (
+                round(ratio, 3) if ratio is not None else None),
+            "collectives": [c.to_dict() for c in self.collectives],
+            "dead_collectives": [list(d)
+                                 for d in self.dead_collectives],
+            "largest_buffers": [b.to_dict()
+                                for b in bufs[:max_buffers]],
+        }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+    def format(self) -> str:
+        lines = [
+            "  hbm peak     %s  (at %s)" % (
+                _fmt_bytes(self.hbm_peak_bytes), self.peak_at or "<entry>"),
+            "  inputs       %s  (+ %s donated)" % (
+                _fmt_bytes(self.input_bytes),
+                _fmt_bytes(self.donated_bytes)),
+            "  consts       %s  (%d weak scalar(s) excluded)" % (
+                _fmt_bytes(self.const_bytes), self.weak_consts_excluded),
+            "  outputs      %s" % _fmt_bytes(self.output_bytes),
+            "  transients   %s peak" % _fmt_bytes(
+                self.transient_peak_bytes),
+            "  flops        %.3g" % self.flops_total,
+        ]
+        by_axis = self.comm_bytes_by_axis
+        if by_axis:
+            chunks = self.ring_chunks_by_axis
+            for ax in sorted(by_axis):
+                lines.append(
+                    "  comm[%s]     %s%s" % (
+                        ax, _fmt_bytes(by_axis[ax]),
+                        "  (%d ring chunk hop(s))" % chunks[ax]
+                        if ax in chunks else ""))
+            ratio = self.flops_per_comm_byte
+            if ratio is not None:
+                lines.append("  flops/comm-byte  %.2f" % ratio)
+        else:
+            lines.append("  comm         none")
+        if self.dead_collectives:
+            for prim, ax, where in self.dead_collectives:
+                lines.append("  DEAD collective %s over %r at %s"
+                             % (prim, ax, where))
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return "ResourcePlan('%s', %d eqns)\n%s" % (
+            self.name, self.n_eqns, self.format())
+
+    def __repr__(self) -> str:
+        return self.__str__()
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return ("%.1f %s" if unit != "B" else "%.0f %s") % (n, unit)
+        n /= 1024.0
+    return "%.1f GiB" % n  # pragma: no cover
+
+
+# ---------------------------------------------------------------------------
+# var/size helpers
+# ---------------------------------------------------------------------------
+
+def _is_literal(v) -> bool:
+    # Literals carry .val (Vars never do); DropVars are discarded
+    # outputs XLA never materializes
+    return hasattr(v, "val") or type(v).__name__ == "DropVar"
+
+
+def _itemsize(v) -> int:
+    dt = getattr(getattr(v, "aval", None), "dtype", None)
+    return int(getattr(dt, "itemsize", 4) or 4)
+
+
+def _var_bytes(v) -> int:
+    aval = getattr(v, "aval", None)
+    if aval is None:
+        return 0
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    return int(_prod(shape)) * _itemsize(v)
+
+
+def _is_weak_scalar(v) -> bool:
+    aval = getattr(v, "aval", None)
+    return (aval is not None
+            and getattr(aval, "shape", None) == ()
+            and bool(getattr(aval, "weak_type", False)))
+
+
+# ---------------------------------------------------------------------------
+# the buffer-lifetime pass (linear scan)
+# ---------------------------------------------------------------------------
+
+def _inner_transient_peak(jaxpr) -> int:
+    """Peak bytes of intermediates live INSIDE a sub-jaxpr beyond its
+    own invars/outvars (both are accounted by the enclosing equation's
+    operands/results) — the workspace a cond branch or scan body adds
+    at the step that runs it."""
+    out_ids = {id(v) for v in jaxpr.outvars if not _is_literal(v)}
+    last: Dict[int, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not _is_literal(v):
+                last[id(v)] = i
+    live = 0
+    peak = 0
+    sizes: Dict[int, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        subs = _sub_jaxprs(eqn)
+        inner = max((_inner_transient_peak(s) for s in subs), default=0)
+        alloc = 0
+        for ov in eqn.outvars:
+            if _is_literal(ov) or id(ov) in out_ids:
+                continue
+            sz = _var_bytes(ov)
+            sizes[id(ov)] = sz
+            alloc += sz
+        live += alloc
+        peak = max(peak, live + inner)
+        for ov in eqn.outvars:  # dead (never-consumed) results
+            k = id(ov)
+            if k in sizes and k not in last:
+                live -= sizes.pop(k)
+        for v in eqn.invars:
+            k = id(v)
+            if k in sizes and last.get(k) == i:
+                live -= sizes.pop(k)
+    return peak
+
+
+def _lifetime_scan(closed, donated_pos: Sequence[int],
+                   alias_out_to_in: Dict[int, int],
+                   plan: ResourcePlan):
+    """Linear-scan the top-level jaxpr, filling the plan's HBM fields.
+
+    ``donated_pos``: invar positions whose buffers the caller donates
+    (freeable at last use / aliasable into outputs).
+    ``alias_out_to_in``: outvar position -> invar position pairs the
+    runtime aliases (jit/api.py donates written state into its own
+    output slot) — the aliased output allocates nothing new and the
+    donated input stays resident as the output.
+    """
+    jaxpr = closed.jaxpr
+    invars = list(jaxpr.invars)
+    donated_ids = {id(invars[p]) for p in donated_pos
+                   if 0 <= p < len(invars)}
+    # outvars aliased into a DONATED input: allocation elided (XLA
+    # reuses the input buffer — the in-place state update)
+    alias_ids = set()
+    for out_pos, in_pos in alias_out_to_in.items():
+        if (0 <= out_pos < len(jaxpr.outvars)
+                and 0 <= in_pos < len(invars)
+                and id(invars[in_pos]) in donated_ids):
+            ov = jaxpr.outvars[out_pos]
+            if not _is_literal(ov):
+                alias_ids.add(id(ov))
+
+    # program outputs, alias-deduped: a var listed twice counts once;
+    # an outvar that IS an invar (state passthrough) allocates nothing
+    in_ids = {id(v) for v in invars if not _is_literal(v)}
+    out_ids = []
+    seen = set()
+    for v in jaxpr.outvars:
+        if _is_literal(v) or id(v) in seen:
+            continue
+        seen.add(id(v))
+        out_ids.append(v)
+    prog_out_ids = {id(v) for v in out_ids}
+
+    # resident base: inputs + consts (weak scalars excluded)
+    live = 0
+    for p, v in enumerate(invars):
+        if _is_literal(v):
+            continue
+        nb = _var_bytes(v)
+        live += nb
+        if id(v) in donated_ids:
+            plan.donated_bytes += nb
+            plan.buffers.append(BufferUse(
+                "donated-input", nb, _aval_shape(v), _aval_dtype(v)))
+        else:
+            plan.input_bytes += nb
+            plan.buffers.append(BufferUse(
+                "input", nb, _aval_shape(v), _aval_dtype(v)))
+    for v in getattr(jaxpr, "constvars", ()):
+        if _is_weak_scalar(v):
+            plan.weak_consts_excluded += 1
+            continue
+        nb = _var_bytes(v)
+        live += nb
+        plan.const_bytes += nb
+        plan.buffers.append(BufferUse(
+            "const", nb, _aval_shape(v), _aval_dtype(v)))
+
+    for v in out_ids:
+        if id(v) in in_ids or id(v) in alias_ids:
+            continue  # passthrough / donated-alias: no new bytes
+        nb = _var_bytes(v)
+        plan.output_bytes += nb
+        plan.buffers.append(BufferUse(
+            "output", nb, _aval_shape(v), _aval_dtype(v)))
+
+    # last use per var (freeable set: intermediates + donated inputs,
+    # EXCEPT donated inputs that morph into an aliased output)
+    morphing = set()
+    for out_pos, in_pos in alias_out_to_in.items():
+        if 0 <= in_pos < len(invars) \
+                and id(invars[in_pos]) in donated_ids \
+                and 0 <= out_pos < len(jaxpr.outvars) \
+                and id(jaxpr.outvars[out_pos]) in alias_ids:
+            morphing.add(id(invars[in_pos]))
+    last: Dict[int, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not _is_literal(v):
+                last[id(v)] = i
+
+    peak = live
+    peak_at = ""
+    transient_live = 0
+    sizes: Dict[int, int] = {}     # freeable intermediate sizes
+    donated_sizes = {id(invars[p]): _var_bytes(invars[p])
+                     for p in donated_pos if 0 <= p < len(invars)}
+    for i, eqn in enumerate(jaxpr.eqns):
+        path = "eqns[%d]:%s" % (i, eqn.primitive.name)
+        subs = _sub_jaxprs(eqn)
+        inner = max((_inner_transient_peak(s) for s in subs), default=0)
+        for ov in eqn.outvars:
+            if _is_literal(ov) or id(ov) in alias_ids:
+                continue
+            sz = _var_bytes(ov)
+            live += sz
+            if id(ov) not in prog_out_ids:
+                sizes[id(ov)] = sz
+                transient_live += sz
+        if live + inner > peak:
+            peak = live + inner
+            peak_at = path
+        plan.transient_peak_bytes = max(
+            plan.transient_peak_bytes, transient_live + inner)
+        # free dead results immediately, then operands at last use
+        for ov in eqn.outvars:
+            k = id(ov)
+            if k in sizes and k not in last and k not in prog_out_ids:
+                live -= sizes[k]
+                transient_live -= sizes.pop(k)
+        for v in eqn.invars:
+            k = id(v)
+            if last.get(k) != i:
+                continue
+            if k in sizes and k not in prog_out_ids:
+                live -= sizes[k]
+                transient_live -= sizes.pop(k)
+            elif k in donated_sizes and k not in morphing \
+                    and k not in prog_out_ids:
+                live -= donated_sizes.pop(k)
+    plan.hbm_peak_bytes = int(peak)
+    plan.peak_at = peak_at
+
+
+# ---------------------------------------------------------------------------
+# the collective cost model
+# ---------------------------------------------------------------------------
+
+def _axis_sizes_default() -> Dict[str, int]:
+    try:
+        from ..distributed.mesh import active_axis_info
+
+        return {str(k): int(v) for k, v in
+                active_axis_info().get("degrees", {}).items()}
+    except Exception:
+        return {}
+
+
+def _walk_costed(jaxpr, plan: ResourcePlan,
+                 axis_sizes: Dict[str, int],
+                 mult: float = 1.0, path: str = ""):
+    """Flops + collective traffic over the jaxpr tree with trip
+    multipliers: ``scan`` bodies run ``length`` times; ``cond``
+    branches all contribute (an upper bound — only one runs); other
+    sub-jaxprs (pjit/shard_map/custom_vjp) run once."""
+    for i, eqn in enumerate(jaxpr.eqns):
+        name = eqn.primitive.name
+        p = "%seqns[%d]:%s" % (path, i, name)
+        plan.flops_total += mult * _eqn_flops(eqn)
+        model = _COMM_MODEL.get(name)
+        if model is not None:
+            side, fn = model
+            vs = eqn.outvars if side == "out" else eqn.invars
+            nbytes = sum(_var_bytes(v) for v in vs
+                         if not _is_literal(v))
+            dts = [_aval_dtype(v) for v in vs if not _is_literal(v)]
+            axes = _collective_axes(eqn) or ("<unnamed>",)
+            for ax in axes:
+                ws = int(axis_sizes.get(ax, 0))
+                wire = int(round(mult * fn(nbytes, ws if ws else 0)))
+                if ws == 0:
+                    # unknown axis (no live mesh): assume wire = full
+                    # payload x multiplier — better than silent zero
+                    wire = int(round(mult * nbytes))
+                if wire == 0:
+                    # a size-1 axis (or empty operand) moves nothing:
+                    # recording it would make comm_bytes_by_axis
+                    # truthy with a None flops/comm-byte ratio
+                    continue
+                plan.collectives.append(CollectiveCost(
+                    prim=name, axis=ax, axis_size=ws, nbytes=wire,
+                    dtype=dts[0] if dts else "", ring_chunk=(
+                        name in _RING_PRIMS),
+                    itemsize=max((_itemsize(v) for v in vs
+                                  if not _is_literal(v)), default=4),
+                    mult=mult, where=p))
+        sub_mult = mult
+        if name == "scan":
+            sub_mult = mult * float(eqn.params.get("length", 1) or 1)
+        for sub in _sub_jaxprs(eqn):
+            _walk_costed(sub, plan, axis_sizes, sub_mult, p + "/")
+
+
+def _find_dead_collectives(jaxpr, plan: ResourcePlan, path: str = ""):
+    """Per scope: a collective eqn none of whose results is consumed
+    or returned is pure wire traffic (make_jaxpr does not DCE, and the
+    to_static prune keeps every eqn)."""
+    consumed = set()
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if not _is_literal(v):
+                consumed.add(id(v))
+    for v in jaxpr.outvars:
+        if not _is_literal(v):
+            consumed.add(id(v))
+    for i, eqn in enumerate(jaxpr.eqns):
+        name = eqn.primitive.name
+        p = "%seqns[%d]:%s" % (path, i, name)
+        if name in _COMM_MODEL:
+            outs = [v for v in eqn.outvars if not _is_literal(v)
+                    and type(v).__name__ != "DropVar"]
+            dead = all(id(v) not in consumed for v in outs) \
+                if outs else True
+            if dead:
+                axes = _collective_axes(eqn)
+                plan.dead_collectives.append(
+                    (name, axes[0] if axes else "<unnamed>", p))
+        for sub in _sub_jaxprs(eqn):
+            _find_dead_collectives(sub, plan, p + "/")
+
+
+# ---------------------------------------------------------------------------
+# findings on top of the plan
+# ---------------------------------------------------------------------------
+
+def check_plan(plan: ResourcePlan, out: _RuleLimiter):
+    """The four planner rules, judged from a finished plan."""
+    hbm_budget = int(_flag("jit_budget_hbm", 0) or 0)
+    if hbm_budget and plan.hbm_peak_bytes > hbm_budget:
+        out.add(
+            HBM_OVER_BUDGET,
+            "planned peak live HBM %s exceeds FLAGS_jit_budget_hbm "
+            "%s (inputs %s + consts %s + transients %s peak)" % (
+                _fmt_bytes(plan.hbm_peak_bytes), _fmt_bytes(hbm_budget),
+                _fmt_bytes(plan.input_bytes + plan.donated_bytes),
+                _fmt_bytes(plan.const_bytes),
+                _fmt_bytes(plan.transient_peak_bytes)),
+            where=plan.peak_at,
+            suggestion="shard or donate the largest buffers (see "
+            "plan.buffers), lower the batch/sequence, or raise "
+            "FLAGS_jit_budget_hbm",
+        )
+    comm_budget = int(_flag("jit_budget_comm", 0) or 0)
+    if comm_budget and plan.comm_bytes_total > comm_budget:
+        by_axis = ", ".join(
+            "%s=%s" % (a, _fmt_bytes(b))
+            for a, b in sorted(plan.comm_bytes_by_axis.items()))
+        out.add(
+            COMM_OVER_BUDGET,
+            "planned per-device collective traffic %s exceeds "
+            "FLAGS_jit_budget_comm %s (%s)" % (
+                _fmt_bytes(plan.comm_bytes_total),
+                _fmt_bytes(comm_budget), by_axis),
+            suggestion="quantize the wire (ROADMAP item 3), overlap "
+            "via the collective-matmul ring (docs/OVERLAP.md), or "
+            "raise FLAGS_jit_budget_comm",
+        )
+    ratio = plan.flops_per_comm_byte
+    threshold = float(_flag("jit_plan_comm_bound_ratio", 8.0) or 0.0)
+    if ratio is not None and threshold > 0 and ratio < threshold:
+        wide = [c for c in plan.collectives
+                if c.itemsize >= 4 and c.axis_size != 1]
+        if wide:
+            wide_bytes = sum(c.nbytes for c in wide)
+            out.add(
+                COMM_BOUND_PROGRAM,
+                "%.2f flops per comm byte (threshold %.2f) with %d "
+                "wide collective(s) moving %s in >=4-byte elements: "
+                "the program is communication-bound and an int8/fp8 "
+                "quantized ring would halve-to-quarter the wire bytes"
+                % (ratio, threshold, len(wide), _fmt_bytes(wide_bytes)),
+                where=wide[0].where,
+                suggestion="route the pair through a quantize-on-the-"
+                "wire collective when ROADMAP item 3 lands, cast the "
+                "collective operand to bf16, or raise "
+                "FLAGS_jit_plan_comm_bound_ratio",
+            )
+    for prim, ax, where in plan.dead_collectives:
+        out.add(
+            DEAD_COLLECTIVE,
+            "%s over %r produces a result no equation consumes: the "
+            "wire traffic is pure waste and any rewrite that drops "
+            "it on a subset of devices deadlocks the rest" % (prim, ax),
+            where=where,
+            suggestion="delete the collective or consume its result "
+            "(a reduction kept only for debugging belongs behind a "
+            "flag)",
+        )
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def plan_jaxpr(closed, *, name: str = "<jaxpr>",
+               mesh_axis_sizes: Optional[Dict[str, int]] = None,
+               donated_invars: Sequence[int] = (),
+               alias_out_to_in: Optional[Dict[int, int]] = None,
+               suppress: Sequence[str] = (),
+               ) -> Tuple[ResourcePlan, AnalysisReport]:
+    """Plan a ClosedJaxpr: returns (ResourcePlan, AnalysisReport of
+    planner findings). ``mesh_axis_sizes`` defaults to the active
+    global mesh's per-axis degrees; ``donated_invars`` are donated
+    invar positions; ``alias_out_to_in`` maps outvar position ->
+    donated invar position for runtime-aliased slots (jit/api.py
+    state donation)."""
+    if mesh_axis_sizes is None:
+        mesh_axis_sizes = _axis_sizes_default()
+    n_eqns = len(analysis._walk(closed.jaxpr))
+    plan = ResourcePlan(name, n_eqns=n_eqns)
+    _lifetime_scan(closed, tuple(donated_invars),
+                   dict(alias_out_to_in or {}), plan)
+    _walk_costed(closed.jaxpr, plan, mesh_axis_sizes)
+    _find_dead_collectives(closed.jaxpr, plan)
+    report = AnalysisReport(name, n_eqns=n_eqns)
+    out = _RuleLimiter(report, resolve_suppressions(suppress))
+    check_plan(plan, out)
+    out.finish()
+    return plan, report
+
+
+def plan_static_entry(static_fn, entry, suppress: Sequence[str] = ()
+                      ) -> Tuple[ResourcePlan, AnalysisReport]:
+    """Plan one finalized StaticFunction cache entry (jit/api.py):
+    the pruned jaxpr plus the donation layout only the StaticFunction
+    knows — donated rw-state slots alias into their own output slots
+    (out position n_out + changed order), so the in-place update
+    neither double-counts nor frees early."""
+    name = getattr(static_fn, "__name__", None) or getattr(
+        getattr(static_fn, "_fn", None), "__name__", "<to_static>")
+    kept = list(entry.get("kept_state_idx", ()))
+    kept_order = {i: pos for pos, i in enumerate(kept)}
+    donated: Tuple[int, ...] = ()
+    alias: Dict[int, int] = {}
+    if entry.get("donates"):
+        rw = [i for i in entry.get("rw_idx", ()) if i in kept_order]
+        donated = tuple(kept_order[i] for i in rw)
+        changed = list(entry.get("changed_idx", ()))
+        aux = entry.get("aux") or {}
+        n_out = sum(1 for k, _ in (aux.get("out_slots") or ())
+                    if k == "arr")
+        for i in rw:
+            if i in changed:
+                alias[n_out + changed.index(i)] = kept_order[i]
+    extra = tuple(suppress) + tuple(
+        getattr(static_fn, "_lint_suppress", ()) or ())
+    return plan_jaxpr(
+        entry["pruned_jaxpr"], name=name, donated_invars=donated,
+        alias_out_to_in=alias, suppress=extra)
+
+
+def emit_plan_report(report: AnalysisReport, mode: str):
+    """Route planner findings per FLAGS_jit_plan: VLOG(1) always,
+    console warning for criticals under 'report', JitPlanError under
+    'strict' when any blocking finding survived suppression."""
+    for f in report.findings:
+        _vlog(1, "jit_plan[%s] %s %s: %s", report.name, f.severity,
+              f.rule, f.message)
+    if mode == "strict" and report.blocking():
+        raise JitPlanError(report)
+    crits = report.critical()
+    if crits:
+        try:
+            from .log import LOG
+
+            LOG("warning",
+                "jit_plan: %d CRITICAL finding(s) in compiled program "
+                "'%s' (FLAGS_jit_plan=strict to fail the compile):\n%s",
+                len(crits), report.name,
+                "\n".join("  %s: %s" % (f.rule, f.message)
+                          for f in crits))
+        except Exception:
+            pass
+
+
+def live_plan_summaries() -> List[dict]:
+    """Compact per-program plan summaries for every compiled
+    StaticFunction alive in the process — attached by bench.py /
+    tools/roofline.py to their JSON artifacts. Honors
+    FLAGS_jit_plan=off (no rows, no late planner passes)."""
+    out: List[dict] = []
+    if _flag("jit_plan", "report") == "off":
+        return out
+    try:
+        from ..jit.api import live_static_functions
+    except Exception:
+        return out
+    for sf in live_static_functions():
+        for entry in sf._finalized_entries():
+            plan = entry.get("resource_plan")
+            if plan is None:
+                try:
+                    plan, _ = plan_static_entry(sf, entry)
+                    # cache like the compile hook does: both artifact
+                    # writers call this per arm/dump — a lazily-built
+                    # plan must not be recomputed fleet-wide each time
+                    entry["resource_plan"] = plan
+                except Exception:
+                    continue
+            ratio = plan.flops_per_comm_byte
+            row = {
+                "program": plan.name,
+                "hbm_peak_bytes": int(plan.hbm_peak_bytes),
+                "output_bytes": int(plan.output_bytes),
+                "transient_peak_bytes": int(plan.transient_peak_bytes),
+                "flops_total": float(plan.flops_total),
+            }
+            by_axis = plan.comm_bytes_by_axis
+            if by_axis:
+                row["comm_bytes_by_axis"] = {
+                    k: int(v) for k, v in by_axis.items()}
+                if ratio is not None:
+                    row["flops_per_comm_byte"] = round(ratio, 3)
+            if plan.dead_collectives:
+                row["dead_collectives"] = len(plan.dead_collectives)
+            out.append(row)
+    return out

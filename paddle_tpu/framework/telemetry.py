@@ -1093,6 +1093,12 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
      "wall time per to_static trace+lower (lint included)"),
     ("compile.by_program.<name>", "counter",
      "to_static trace/lower events per program (storm attribution)"),
+    ("compile.hbm_peak_bytes", "histogram",
+     "planned peak live HBM per compiled program (static resource "
+     "planner, framework/planner.py; FLAGS_jit_plan)"),
+    ("compile.comm_bytes.<axis>", "counter",
+     "planned per-device collective wire bytes per mesh axis, summed "
+     "over compiled programs (static resource planner)"),
     # sanitizer mirror (published by the scheduler's watchdog stride)
     ("sanitizer.events", "gauge",
      "page-sanitizer events recorded (summed across pools)"),

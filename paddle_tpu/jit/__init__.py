@@ -19,7 +19,7 @@ import numpy as np
 from ..framework.core import Tensor, no_grad
 from ..framework.io import _pack, _unpack
 from .api import (StaticFunction, analyze, enable_to_static,
-                  ignore_module, not_to_static, to_static)
+                  ignore_module, not_to_static, plan, to_static)
 
 _FORMAT = "stablehlo_v1"
 

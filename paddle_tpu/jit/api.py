@@ -474,6 +474,31 @@ class StaticFunction:
             if report is not None:
                 analysis.emit_report(report, mode)
 
+        # static resource planner (framework/planner.py): per-program
+        # HBM footprint + collective-byte plan behind FLAGS_jit_plan.
+        # 'off' never imports the module (this flag read is the whole
+        # cost); computation failures never break a compile — under
+        # strict, any blocking finding (budget overruns AND the
+        # warning-severity dead-collective / comm-bound-program rules)
+        # raises via emit_plan_report below.
+        pmode = flag("jit_plan")
+        plan = plan_report = None
+        if lint and pmode != "off":
+            from ..framework import planner
+
+            try:
+                plan, plan_report = planner.plan_static_entry(
+                    self, entry)
+                entry["resource_plan"] = plan
+                entry["plan_report"] = plan_report
+            except Exception as e:
+                from ..framework.log import VLOG
+
+                VLOG(1, "jit_plan: planning failed: %r", e,
+                     module="jit.api")
+            if plan_report is not None:
+                planner.emit_plan_report(plan_report, pmode)
+
         if _t0 is not None:
             dur = _telemetry.clock() - _t0
             prog = getattr(self, "__name__", "<static>")
@@ -486,6 +511,16 @@ class StaticFunction:
                 # event snapshot name the offender
                 _reg.inc("compile.by_program." + str(prog))
                 _reg.observe("compile.wall_s", dur)
+                if plan is not None:
+                    # resource-plan telemetry (framework/planner.py):
+                    # planned peak HBM per compile and wire bytes per
+                    # mesh axis — the budget dashboards of ROADMAP
+                    # items 3-4 read these, not the chip
+                    _reg.observe("compile.hbm_peak_bytes",
+                                 float(plan.hbm_peak_bytes))
+                    for _ax, _nb in plan.comm_bytes_by_axis.items():
+                        _reg.inc("compile.comm_bytes." + str(_ax),
+                                 int(_nb))
             if _tr is not None:
                 _tr.add_complete(
                     "jit.compile", _t0, dur, cat="compile",
@@ -547,6 +582,46 @@ def analyze(function, *example_args, suppress=(), **example_kwargs):
         return reports[0]
     return analysis.AnalysisReport.merge(
         reports, name=reports[0].name + " (%d variants)" % len(reports))
+
+
+def plan(function, *example_args, **example_kwargs):
+    """Run the static resource planner (framework/planner.py) on a
+    compiled function and return its ``ResourcePlan`` — without
+    executing it.
+
+    * ``plan(static_fn)`` — plan every program variant the
+      ``@to_static`` function has already compiled (returns one
+      ``ResourcePlan``, or a list when several variants exist);
+    * ``plan(fn_or_static_fn, *example_args)`` — trace the function
+      against the example inputs (shapes/dtypes are what matter) and
+      plan the resulting program.
+
+    Runs regardless of FLAGS_jit_plan (the flag only governs the
+    automatic compile-time hook) and never raises on findings — this
+    returns the PLAN only; planner findings (and their suppression)
+    live on the compile hook and the CLI ``--plan``."""
+    from ..framework import planner
+
+    sf = function if isinstance(function, StaticFunction) \
+        else StaticFunction(function)
+    if example_args or example_kwargs:
+        def as_tensor(x):
+            return Tensor(x) if _is_arr(x) and not isinstance(x, Tensor) \
+                else x
+
+        args = tuple(as_tensor(a) for a in example_args)
+        kwargs = {k: as_tensor(v) for k, v in example_kwargs.items()}
+        entries = [sf.trace_for_analysis(*args, **kwargs)]
+    else:
+        entries = sf._finalized_entries()
+        if not entries:
+            raise ValueError(
+                "plan(fn) without example args needs an already-"
+                "compiled @to_static function (call it once, or pass "
+                "example inputs: plan(fn, x, y))"
+            )
+    plans = [planner.plan_static_entry(sf, e)[0] for e in entries]
+    return plans[0] if len(plans) == 1 else plans
 
 
 def not_to_static(fn=None):

@@ -1,0 +1,48 @@
+"""API-surface drift gate (ISSUE 10 satellite): docs/API_SURFACE.md
+must exactly match what tools/gen_api_surface.py would generate
+against the current code, so the inventory can never silently drift —
+regeneration stops being a manual per-PR chore and becomes a tier-1
+failure with a one-command fix."""
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_generator():
+    path = os.path.join(REPO, "tools", "gen_api_surface.py")
+    spec = importlib.util.spec_from_file_location(
+        "_gen_api_surface_gate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestApiSurfaceDrift:
+    def test_no_unresolvable_namespaces(self):
+        mod = _load_generator()
+        _, _, skipped = mod.render()
+        assert skipped == [], (
+            "gen_api_surface.py can no longer resolve: %s" % skipped)
+
+    def test_committed_surface_matches_regeneration(self):
+        mod = _load_generator()
+        text, total, _ = mod.render()
+        path = os.path.join(REPO, "docs", "API_SURFACE.md")
+        with open(path, encoding="utf-8") as f:
+            committed = f.read()
+        if committed != text:
+            got = committed.splitlines()
+            want = text.splitlines()
+            diffs = [
+                "line %d:\n  committed: %s\n  generated: %s"
+                % (i + 1, a, b)
+                for i, (a, b) in enumerate(zip(got, want)) if a != b]
+            if len(got) != len(want):
+                diffs.append("length: committed %d vs generated %d "
+                             "lines" % (len(got), len(want)))
+            raise AssertionError(
+                "docs/API_SURFACE.md is stale (%d symbol(s) in the "
+                "regenerated surface) — run `python tools/"
+                "gen_api_surface.py` and commit the result.\nFirst "
+                "drift:\n%s" % (total, "\n".join(diffs[:5])))

@@ -752,3 +752,85 @@ class TestServingTerminalTrace:
         ids = [r for r, _ in lint_codebase.RULES]
         assert "serving-terminal-trace" in ids
         assert len(ids) == len(set(ids))
+
+
+class TestFlagInventory:
+    """Every FLAGS_* in framework/flags.py needs a docstring and a
+    docs/ mention (docs/FLAGS.md is the catch-all reference) — the
+    flag-inventory rule catches undocumented knobs at review time."""
+
+    def test_seeded_missing_docstring_flagged(self):
+        bad = (
+            "def define_flag(name, default, help_str=''):\n"
+            "    pass\n"
+            "define_flag('mystery_knob', 0)\n"
+        )
+        v = lint_codebase.lint_flag_inventory(
+            bad, docs_text="FLAGS_mystery_knob is documented here")
+        assert len(v) == 1, v
+        assert "FLAGS_mystery_knob" in v[0]
+        assert "docstring" in v[0]
+
+    def test_seeded_empty_docstring_flagged(self):
+        bad = "define_flag('blank_knob', 0, '')\n"
+        v = lint_codebase.lint_flag_inventory(
+            bad, docs_text="FLAGS_blank_knob")
+        assert len(v) == 1 and "docstring" in v[0]
+
+    def test_seeded_missing_docs_mention_flagged(self):
+        bad = "define_flag('ghost_knob', 1, 'does a thing')\n"
+        v = lint_codebase.lint_flag_inventory(bad, docs_text="")
+        assert len(v) == 1, v
+        assert "FLAGS_ghost_knob" in v[0]
+        assert "docs/" in v[0]
+
+    def test_seeded_both_missing_yields_two(self):
+        bad = "define_flag('dark_knob', 1)\n"
+        v = lint_codebase.lint_flag_inventory(bad, docs_text="")
+        assert len(v) == 2, v
+
+    def test_documented_flag_clean(self):
+        ok = (
+            "define_flag('fine_knob', 'auto',\n"
+            "            'a knob with a real docstring '\n"
+            "            'spanning literals')\n"
+        )
+        v = lint_codebase.lint_flag_inventory(
+            ok, docs_text="see FLAGS_fine_knob in docs")
+        assert v == []
+
+    def test_keyword_help_str_accepted(self):
+        ok = "define_flag('kw_knob', 0, help_str='documented knob')\n"
+        assert lint_codebase.lint_flag_inventory(
+            ok, docs_text="FLAGS_kw_knob") == []
+
+    def test_prefix_collision_not_vacuous(self):
+        # a docs mention of the LONGER flag must not satisfy the
+        # shorter prefix flag (FLAGS_jit_plan vs
+        # FLAGS_jit_plan_comm_bound_ratio families)
+        bad = (
+            "define_flag('knob', 0, 'short flag')\n"
+            "define_flag('knob_extra_ratio', 0, 'long flag')\n"
+        )
+        v = lint_codebase.lint_flag_inventory(
+            bad, docs_text="only FLAGS_knob_extra_ratio is here")
+        assert len(v) == 1, v
+        assert "FLAGS_knob " in v[0] or "FLAGS_knob is" in v[0]
+
+    def test_repo_flags_all_documented(self):
+        v = lint_codebase.check_flag_inventory()
+        assert v == [], "\n".join(v)
+
+    def test_every_planner_flag_in_inventory(self):
+        # the ISSUE-10 flags ride the same contract from day one
+        with open(os.path.join(
+                REPO, lint_codebase.FLAGS_FILE)) as f:
+            names = [n for n, _, _ in
+                     lint_codebase._defined_flags(f.read())]
+        for flag in ("jit_plan", "jit_budget_hbm", "jit_budget_comm",
+                     "jit_plan_comm_bound_ratio"):
+            assert flag in names
+
+    def test_rule_inventory_has_flag_rule(self):
+        ids = [r for r, _ in lint_codebase.RULES]
+        assert "flag-inventory" in ids

@@ -479,7 +479,7 @@ class TestCLI:
         assert rc == 0
         payload = json.loads(capsys.readouterr().out)
         groups = payload["static_checks"]
-        assert set(groups) == {"jaxpr", "page_sanitizer",
+        assert set(groups) == {"jaxpr", "planner", "page_sanitizer",
                                "codebase_lint", "telemetry",
                                "watchdog", "serving_faults"}
         assert {r["rule_id"] for r in groups["page_sanitizer"]} \

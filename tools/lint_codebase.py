@@ -69,7 +69,14 @@ Checks:
    an ad-hoc ``time.time()``. framework/telemetry.py itself is also
    held jax-free (HOST_ONLY_FILES): it is imported by host-only
    modules and backs the admission loop's accounting.
-8. collective-matmul discipline: ops/kernels/collective_matmul.py is
+8. flag inventory: every flag defined in framework/flags.py must
+   carry a non-empty docstring and be mentioned (``FLAGS_<name>``)
+   somewhere under docs/ — an env knob nobody can discover from the
+   docs is configuration drift waiting to happen. docs/FLAGS.md is
+   the catch-all reference that keeps the rule satisfiable for every
+   flag; feature pages (SERVING/ANALYSIS/OBSERVABILITY/...) carry
+   the load-bearing ones.
+9. collective-matmul discipline: ops/kernels/collective_matmul.py is
    jax-only (every body runs inside jit traces under shard_map) — no
    host-side module imports (os/sys/time/numpy/threading/...); and the
    TP/SP layer modules (mpu/mp_layers.py, mpu/mp_ops.py,
@@ -1065,6 +1072,79 @@ def check_tp_routing(root=REPO):
     return out
 
 
+# flag inventory (the FLAGS registry contract): every flag defined in
+# framework/flags.py must carry a non-empty docstring AND be mentioned
+# (as FLAGS_<name>) somewhere under docs/ — an undocumented knob is a
+# knob nobody can discover, and the docs/FLAGS.md reference exists
+# precisely so this check is satisfiable for every flag
+FLAGS_FILE = os.path.join("paddle_tpu", "framework", "flags.py")
+FLAG_DOCS_DIR = "docs"
+
+
+def _defined_flags(text, relpath=FLAGS_FILE):
+    """(name, help_str, lineno) for every top-level define_flag call
+    in the flags module source (help_str None = missing arg)."""
+    tree = ast.parse(text, filename=relpath)
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "define_flag"):
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant):
+            continue
+        name = node.args[0].value
+        help_str = None
+        if len(node.args) >= 3 and isinstance(node.args[2],
+                                              ast.Constant):
+            help_str = node.args[2].value
+        for kw in node.keywords:
+            if kw.arg == "help_str" and isinstance(kw.value,
+                                                   ast.Constant):
+                help_str = kw.value.value
+        out.append((name, help_str, node.lineno))
+    return out
+
+
+def lint_flag_inventory(flags_text, docs_text, relpath=FLAGS_FILE):
+    """Flag-inventory check over given sources (testable without the
+    repo): ``docs_text`` is the concatenated documentation corpus a
+    FLAGS_<name> mention must appear in."""
+    import re
+
+    out = []
+    for name, help_str, lineno in _defined_flags(flags_text, relpath):
+        if not (help_str or "").strip():
+            out.append(
+                "%s:%d: FLAGS_%s has no docstring — every flag needs "
+                "a help string explaining what it does and what reads "
+                "it (define_flag's third argument)"
+                % (relpath, lineno, name))
+        # word-boundary match: FLAGS_jit_plan must not be satisfied
+        # by a mention of FLAGS_jit_plan_comm_bound_ratio (the repo
+        # has many prefix-colliding flag families)
+        if not re.search(r"FLAGS_%s\b" % re.escape(name), docs_text):
+            out.append(
+                "%s:%d: FLAGS_%s is not mentioned anywhere under "
+                "docs/ — add it to the flag reference (docs/FLAGS.md) "
+                "or the feature's doc page"
+                % (relpath, lineno, name))
+    return out
+
+
+def check_flag_inventory(root=REPO):
+    with open(os.path.join(root, FLAGS_FILE), encoding="utf-8") as f:
+        flags_text = f.read()
+    docs_text = []
+    docs_dir = os.path.join(root, FLAG_DOCS_DIR)
+    for fn in sorted(os.listdir(docs_dir)):
+        if fn.endswith(".md"):
+            with open(os.path.join(docs_dir, fn),
+                      encoding="utf-8") as f:
+                docs_text.append(f.read())
+    return lint_flag_inventory(flags_text, "\n".join(docs_text))
+
+
 def check_inference_surface():
     """No raw jax callable may leak through the public
     ``paddle_tpu.inference`` namespace (same leak rule the op
@@ -1191,6 +1271,10 @@ RULES = (
      "state (FINISHED/ABORTED_DEADLINE or a _finished[] write) must "
      "emit the terminal request-trace event (_traces.complete) in "
      "the same function — no request is ever dropped silently"),
+    ("flag-inventory",
+     "every FLAGS_* defined in framework/flags.py must carry a "
+     "non-empty docstring and be mentioned (FLAGS_<name>) somewhere "
+     "under docs/ (docs/FLAGS.md is the catch-all reference)"),
     ("jax-only-kernel-imports",
      "collective-matmul kernel module must not import host-side "
      "modules"),
@@ -1209,6 +1293,7 @@ def run_lint(root=REPO, with_op_table=True):
     out.extend(check_pool_mutation_audit(root))
     out.extend(check_serving_buckets(root))
     out.extend(check_serving_terminal_trace(root))
+    out.extend(check_flag_inventory(root))
     out.extend(check_jax_only(root))
     out.extend(check_tp_routing(root))
     if with_op_table:

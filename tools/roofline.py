@@ -359,6 +359,17 @@ def liveness(argv=None):
             out["jit_lint"] = lint
     except Exception:
         pass
+    try:
+        # the compiled step's static resource plan rides along too:
+        # planned peak HBM + collective bytes next to the measured
+        # roofline numbers (framework/planner.py)
+        from paddle_tpu.framework.planner import live_plan_summaries
+
+        plans = live_plan_summaries()
+        if plans:
+            out["jit_plan"] = plans
+    except Exception:
+        pass
     print(json.dumps(out, indent=1))
     return 0
 
