@@ -1024,6 +1024,77 @@ def bench_chunked_prefill(users=8, prompt_len=96, new_tokens=8,
             "plan": plan.to_dict(max_buffers=4),
         }
 
+    def ledger_probe(attend_plan, budget=64):
+        """Performance-ledger validation (ISSUE 12): re-run the
+        budget-64 chunked workload under FLAGS_telemetry=metrics
+        with the attend program's static plan registered under the
+        scheduler's ``prefill_chunk`` exec key, an explicit tight
+        watchdog (warmup 0, so plan-drift is REALLY evaluated, not
+        hidden by warmup), and read the plan-vs-actual join back
+        from BatchScheduler.metrics()["ledger"]: the attend
+        program's achieved bytes/s must be finite and the
+        plan-drift class must stay silent — the cpu run is far
+        SLOWER than the TPU-peak roofline bound, which is exactly
+        the healthy direction."""
+        import math as _math
+
+        from paddle_tpu.framework import perf_ledger as _pl
+        from paddle_tpu.framework import telemetry as _tel
+        from paddle_tpu.framework.flags import set_flags as _sf
+        from paddle_tpu.framework.watchdog import Watchdog
+
+        _tel.reset()
+        _sf({"telemetry": "metrics",
+             "telemetry_watchdog_stride": 1})
+        try:
+            adapter = PagedLlamaAdapter(
+                model, num_pages=num_pages, page_size=page_size,
+                max_length=cfg.max_position_embeddings)
+            reg = _tel.registry()
+            wd = Watchdog(reg, mode="warn", window=8, warmup=0)
+            sched = BatchScheduler(
+                adapter, max_batch_size=users,
+                chunked_prefill=True, prefill_chunk_tokens=budget,
+                watchdog=wd)
+            _pl.register_plan("prefill_chunk", attend_plan)
+            for i, p in enumerate(prompts):
+                sched.submit(Request(f"r{i}", list(p),
+                                     max_new_tokens=new_tokens))
+            import warnings as _warnings
+
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore", RuntimeWarning)
+                while sched.num_active or sched.num_queued:
+                    sched.step()
+            m = sched.metrics()
+            row = m.get("ledger", {}).get("prefill_chunk", {})
+            bps = row.get("hbm_bytes_per_s")
+            bytes_finite = bps is not None \
+                and _math.isfinite(float(bps)) and bps > 0
+            trips = m.get("watchdog", {}).get("by_class", {}).get(
+                "plan-drift", 0)
+            assert bytes_finite, (
+                f"ledger attend-program bytes/s not finite: {row}")
+            assert row.get("drifting") is not True, (
+                f"plan-drift tripped on the validated attend "
+                f"program: {row}")
+            assert trips == 0, m.get("watchdog")
+            return {
+                "program": "prefill_chunk",
+                "calls": int(row.get("count", 0)),
+                "hbm_bytes_per_s": float(bps),
+                "wire_bytes_per_s": row.get("wire_bytes_per_s"),
+                "mfu": row.get("mfu"),
+                "drift_ratio": row.get("drift_ratio"),
+                "drifting": bool(row.get("drifting", False)),
+                "plan_drift_trips": int(trips),
+                "bytes_per_s_finite": True,
+            }
+        finally:
+            _sf({"telemetry": "off",
+                 "telemetry_watchdog_stride": 32})
+            _tel.reset()
+
     run(None)          # warmup: kernel compiles land outside timing
     base = run(None)
     arms = {}
@@ -1046,6 +1117,8 @@ def bench_chunked_prefill(users=8, prompt_len=96, new_tokens=8,
     from paddle_tpu.inference.serving import _parse_buckets
 
     n_buckets = len(_parse_buckets(flag("serving_buckets")))
+    planner_rec = plan_pool()
+    ledger_rec = ledger_probe(planner_rec["plan"])
     rec = {
         "config": "serving_chunked_prefill",
         "mode": "tpu-single-chip" if not cpu else "cpu",
@@ -1061,7 +1134,8 @@ def bench_chunked_prefill(users=8, prompt_len=96, new_tokens=8,
         "serving_buckets": str(flag("serving_buckets")),
         "num_buckets": n_buckets,
         "budgets": arms,
-        "planner": plan_pool(),
+        "planner": planner_rec,
+        "ledger": ledger_rec,
     }
     return _merge_serving_rec("chunked_prefill", rec)
 
@@ -1396,14 +1470,27 @@ def bench_telemetry_serving(users=4, prompt_len=48, new_tokens=8,
         a DISTINCT bucketed token count — a fresh ragged program per
         step, exactly the unbucketed-shape storm the detector exists
         to catch. A tight Watchdog (warmup 2, window 6) must record
-        at least one recompile-storm event within the run."""
+        at least one recompile-storm event within the run.
+
+        ISSUE 12 extends the trip into the flight-recorder gate: the
+        run executes in trace mode with FLAGS_telemetry_incident_dir
+        set, so the trip itself must land ONE complete incident
+        bundle — every manifest entry present on disk, the chrome
+        member valid JSON with events, the ledger member non-empty
+        (the scheduler's own prefill_chunk exec stamps), and
+        --summarize-incident reconstructing the storm."""
+        import shutil as _shutil
+        import tempfile as _tempfile
         import warnings as _warnings
 
+        from paddle_tpu.framework import flight_recorder as _frm
         from paddle_tpu.framework.watchdog import Watchdog
 
+        inc_dir = _tempfile.mkdtemp(prefix="bench-incident-")
         telemetry.reset()
-        set_flags({"telemetry": "metrics",
-                   "telemetry_watchdog_stride": 1})
+        set_flags({"telemetry": "trace",
+                   "telemetry_watchdog_stride": 1,
+                   "telemetry_incident_dir": inc_dir})
         reg = telemetry.registry()
         wd = Watchdog(reg, mode="warn", window=6, warmup=2,
                       storm_compiles=3)
@@ -1426,13 +1513,50 @@ def bench_telemetry_serving(users=4, prompt_len=48, new_tokens=8,
                 sched.step()
                 steps += 1
         summ = sched.metrics().get("watchdog", {})
-        return {
+        out = {
             "tripped": summ.get("by_class", {}).get(
                 "recompile-storm", 0) > 0,
             "events": int(summ.get("events", 0)),
             "by_class": summ.get("by_class", {}),
             "compile_count": adapter.compile_count,
         }
+        # the incident-bundle gate (ISSUE 12)
+        bundles = sorted(
+            n for n in os.listdir(inc_dir)
+            if n.startswith("incident-") and not n.endswith(".tmp"))
+        out["bundles"] = len(bundles)
+        complete = chrome_ok = ledger_ok = summarize_ok = False
+        if bundles:
+            bpath = os.path.join(inc_dir, bundles[0])
+            manifest = json.loads(open(
+                os.path.join(bpath, "manifest.json")).read())
+            entries = manifest.get("entries", {})
+            complete = bool(entries) and all(
+                os.path.isfile(os.path.join(bpath, f))
+                for f in entries.values())
+            out["manifest_entries"] = sorted(entries)
+            if "chrome_trace" in entries:
+                chrome = json.loads(open(os.path.join(
+                    bpath, entries["chrome_trace"])).read())
+                chrome_ok = len(chrome.get("traceEvents") or []) > 0
+            if "ledger" in entries:
+                led = json.loads(open(os.path.join(
+                    bpath, entries["ledger"])).read())
+                ledger_ok = len(led) > 0
+            try:
+                text = _frm.summarize_incident(bpath)
+                summarize_ok = ("recompile-storm" in text
+                                and "MISSING" not in text)
+            except Exception as e:
+                out["summarize_error"] = str(e)[:200]
+        out["bundle_complete"] = bool(complete)
+        out["bundle_chrome_valid"] = bool(chrome_ok)
+        out["bundle_ledger_nonempty"] = bool(ledger_ok)
+        out["bundle_summarize_ok"] = bool(summarize_ok)
+        out["bundle_ok"] = bool(
+            complete and chrome_ok and ledger_ok and summarize_ok)
+        _shutil.rmtree(inc_dir, ignore_errors=True)
+        return out
 
     try:
         run("off")                 # warmup: compiles out of timing
@@ -1441,7 +1565,8 @@ def bench_telemetry_serving(users=4, prompt_len=48, new_tokens=8,
         wd_trip = trip_recompile_watchdog()
     finally:
         set_flags({"telemetry": "off",
-                   "telemetry_watchdog_stride": 32})
+                   "telemetry_watchdog_stride": 32,
+                   "telemetry_incident_dir": ""})
         telemetry.reset()
     pair_pct = [p["pct"] for p in pairs]
     # the reported overhead and both headline p50 columns come from
@@ -1504,6 +1629,21 @@ def bench_telemetry_serving(users=4, prompt_len=48, new_tokens=8,
         "watchdog_tripped": bool(wd_trip.get("tripped")),
         "watchdog_events": wd_trip.get("events", 0),
         "watchdog_by_class": wd_trip.get("by_class", {}),
+        # the incident bundle the trip wrote (ISSUE 12): every
+        # manifest entry present, chrome valid, ledger non-empty,
+        # and --summarize-incident reconstructing the story
+        "incident_bundles": wd_trip.get("bundles", 0),
+        "incident_manifest_entries": wd_trip.get(
+            "manifest_entries", []),
+        "incident_bundle_complete": bool(
+            wd_trip.get("bundle_complete")),
+        "incident_chrome_valid": bool(
+            wd_trip.get("bundle_chrome_valid")),
+        "incident_ledger_nonempty": bool(
+            wd_trip.get("bundle_ledger_nonempty")),
+        "incident_summarize_ok": bool(
+            wd_trip.get("bundle_summarize_ok")),
+        "incident_bundle_ok": bool(wd_trip.get("bundle_ok")),
         # the off-mode zero-cost gate: tracemalloc saw NO allocation
         # attributed to framework/telemetry.py across the loop
         "off_telemetry_alloc_blocks": int(
@@ -2398,6 +2538,15 @@ def main() -> int:
         # 10% of the pool's own accounting
         chunk_ok = chunk_ok and \
             bool(crec.get("planner", {}).get("within_10pct"))
+        # ISSUE-12 ledger acceptance: the performance ledger joins
+        # the attend program's static plan with the live exec stamps
+        # — achieved bytes/s finite, and the plan-drift watchdog
+        # class stays SILENT on the validated program (the cpu run
+        # is slower than the TPU roofline bound, never faster)
+        chunk_ok = chunk_ok and \
+            bool(crec.get("ledger", {}).get("bytes_per_s_finite")) \
+            and not crec.get("ledger", {}).get("drifting", True) \
+            and crec.get("ledger", {}).get("plan_drift_trips", 1) == 0
         # ISSUE-6 sanitizer acceptance: off-mode serving allocates
         # NOTHING in page_sanitizer.py, strict mode is output-identical
         # and violation-free on a healthy pool
@@ -2430,6 +2579,11 @@ def main() -> int:
             bool(trec.get("lanes_complete")) and \
             bool(trec.get("lane_phases_ok")) and \
             bool(trec.get("watchdog_tripped"))
+        # ISSUE-12 flight-recorder acceptance: the deliberate trip
+        # wrote one complete incident bundle (all manifest entries
+        # present, chrome valid, ledger non-empty) that
+        # --summarize-incident reconstructs
+        tel_ok = tel_ok and bool(trec.get("incident_bundle_ok"))
         # ISSUE-9 overload acceptance: the 2x-capacity burst
         # completes every request (no rejects, no aborts) with at
         # least one real swap round trip, greedy outputs identical
@@ -2490,6 +2644,14 @@ def main() -> int:
                    bool(trec.get("lanes_complete")),
                "telemetry_watchdog_tripped":
                    bool(trec.get("watchdog_tripped")),
+               "telemetry_incident_bundle_ok":
+                   bool(trec.get("incident_bundle_ok")),
+               "chunked_ledger_hbm_bytes_per_s":
+                   crec.get("ledger", {}).get("hbm_bytes_per_s"),
+               "chunked_ledger_drift_ratio":
+                   crec.get("ledger", {}).get("drift_ratio"),
+               "chunked_plan_drift_trips":
+                   crec.get("ledger", {}).get("plan_drift_trips"),
                "overload_capacity_ratio":
                    orec.get("capacity_ratio"),
                "overload_all_completed":

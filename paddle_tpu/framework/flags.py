@@ -271,7 +271,10 @@ define_flag("telemetry_watchdog", "off",
             "FLAGS_telemetry_watchdog_stride scheduler steps — "
             "recompile storm, page-pool high-watermark / alloc-free "
             "churn, prefix-cache hit-rate collapse, decode stall, "
-            "sanitizer-violation spike — appending structured events "
+            "sanitizer-violation spike, preemption thrash, and plan "
+            "drift (the performance ledger's predicted-vs-measured "
+            "wall ratio, FLAGS_telemetry_drift_ratio) — appending "
+            "structured events "
             "to a bounded log and raising RuntimeWarning; 'strict' "
             "raises WatchdogError at the detecting step instead. "
             "Requires FLAGS_telemetry=metrics|trace (detectors only "
@@ -291,6 +294,47 @@ define_flag("telemetry_export_path", "",
             "write, so a scraper or the multi-host router never reads "
             "a torn file; the renderer is jax-free — "
             "telemetry.prometheus_text / --export-prom)")
+define_flag("telemetry_peak_flops", 1.97e14,
+            "device peak flops/s the per-program performance ledger "
+            "(framework/perf_ledger.py) judges live MFU against, and "
+            "the compute leg of its roofline-predicted per-invocation "
+            "wall (the plan-drift denominator). Default is the v5e "
+            "bf16 peak (197 TFLOP/s); set it to the deployed chip's "
+            "peak, or 0 to drop the MFU column and the compute bound")
+define_flag("telemetry_peak_hbm_gbs", 819.0,
+            "device HBM bandwidth in GB/s for the performance "
+            "ledger's roofline math: the memory leg of the predicted "
+            "per-invocation wall and the attained-arithmetic-"
+            "intensity column. Default is v5e (819 GB/s); 0 drops "
+            "the memory bound")
+define_flag("telemetry_drift_ratio", 4.0,
+            "plan-drift threshold for the performance ledger and the "
+            "plan-drift watchdog class (framework/watchdog.py): a "
+            "program whose roofline-predicted lower-bound wall "
+            "(planned flops / FLAGS_telemetry_peak_flops vs planned "
+            "HBM bytes / FLAGS_telemetry_peak_hbm_gbs) exceeds its "
+            "SUSTAINED measured wall (windowed mean over "
+            "FLAGS_telemetry_window epochs) by at least this ratio "
+            "is running faster than the plan says is possible — the "
+            "cost model is off (falsified/stale plan) and the "
+            "watchdog fires plan-drift. 0 disables the check")
+define_flag("telemetry_incident_dir", "",
+            "when non-empty and FLAGS_telemetry is on, the serving "
+            "scheduler attaches a telemetry.FlightRecorder and every "
+            "watchdog fire (plus explicit dump_incident() calls) "
+            "writes one atomic, bounded incident bundle directory "
+            "here — chrome trace with request lanes, registry "
+            "snapshot, Prometheus text, sanitizer journal tail, "
+            "resource-plan summaries, ledger top-N, flags snapshot, "
+            "and the watchdog event log — replayable via python -m "
+            "paddle_tpu.framework.telemetry --summarize-incident "
+            "<bundle>. Empty (default) builds no recorder")
+define_flag("telemetry_incident_keep", 8,
+            "bound on retained incident bundles per "
+            "FLAGS_telemetry_incident_dir: when a new bundle would "
+            "exceed this many, the oldest bundles are pruned first "
+            "(incident storage stays fixed no matter how long the "
+            "process watchdogs)")
 define_flag("moe_dense_dispatch", False,
             "route MoE tokens via the dense (N,E,C) one-hot "
             "dispatch/combine einsums instead of the sparse index "

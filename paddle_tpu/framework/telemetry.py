@@ -51,11 +51,24 @@ Request-lifecycle layer (PR 8, on top of the two surfaces above):
   :mod:`paddle_tpu.framework.watchdog` (registry-READ-ONLY by lint
   contract).
 
+Performance-ledger layer (ISSUE 12, siblings
+:mod:`paddle_tpu.framework.perf_ledger` /
+:mod:`paddle_tpu.framework.flight_recorder`): compiled entry points
+stamp per-invocation walls into ``exec.wall_s.<program>`` histograms,
+the ledger joins them with the static resource plans into live
+plan-vs-actual attribution (MFU, bytes/s, plan drift — the
+``--ledger`` CLI mode and the top-programs table in ``--summarize``),
+and :class:`FlightRecorder` (re-exported here) turns every watchdog
+trip into an atomic incident bundle replayable with
+``--summarize-incident``.
+
 CLI::
 
     python -m paddle_tpu.framework.telemetry --summarize trace.jsonl
     python -m paddle_tpu.framework.telemetry --export-chrome trace.jsonl -o trace.json
     python -m paddle_tpu.framework.telemetry --export-prom trace.jsonl
+    python -m paddle_tpu.framework.telemetry --ledger trace.jsonl
+    python -m paddle_tpu.framework.telemetry --summarize-incident <bundle-dir>
 
 ``--summarize`` prints the aggregated span tree, the per-request
 trace and watchdog-event digests, plus the counter/gauge/histogram
@@ -90,10 +103,12 @@ from .flags import flag
 __all__ = [
     "MetricsRegistry", "Histogram", "Tracer", "Span",
     "SLOConfig", "RequestTrace", "RequestTraceBook",
+    "FlightRecorder",
     "telemetry_mode", "metrics_on", "tracing_on", "registry", "tracer",
     "request_traces", "clock", "reset", "arm_tracer", "disarm_tracer",
     "export_chrome", "chrome_payload", "prometheus_text",
-    "write_prometheus", "summarize_jsonl", "chrome_from_jsonl",
+    "write_prometheus", "atomic_write_text", "summarize_jsonl",
+    "chrome_from_jsonl", "summarize_incident",
     "SURFACE", "NULL_SPAN",
 ]
 
@@ -915,13 +930,18 @@ def disarm_tracer() -> None:
 def reset() -> None:
     """Drop the process-wide registry, tracer, and request-trace book
     (bench/test arm isolation). Handles cached by live
-    schedulers/pools keep working against the detached objects."""
+    schedulers/pools keep working against the detached objects. The
+    performance ledger rides along: its singleton wraps the registry
+    being dropped, so the two must never skew."""
     global _REGISTRY, _TRACER, _TRACES, _ARMED
     with _STATE_LOCK:
         _REGISTRY = None
         _TRACER = None
         _TRACES = None
         _ARMED = 0
+    from . import perf_ledger
+
+    perf_ledger.reset()
 
 
 def chrome_payload(tracer_obj: Optional[Tracer] = None,
@@ -998,7 +1018,13 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
     ("serving.compile_count", "gauge",
      "the model's distinct compiled ragged programs "
      "(adapter.compile_count; the recompile-storm watchdog's "
-     "serving-side signal)"),
+     "serving-side signal). Shared across schedulers and therefore "
+     "LAST-WRITER-WINS — kept as an alias; per-scheduler truth lives "
+     "in serving.compile_count.<scheduler>"),
+    ("serving.compile_count.<scheduler>", "gauge",
+     "per-scheduler compiled ragged program count, namespaced by the "
+     "scheduler's uid (s1, s2, ...) so two live schedulers never "
+     "overwrite each other's counts"),
     ("serving.admit_reject_pool", "counter",
      "admission refusals on page-pool capacity (head-of-queue "
      "blocked after any eviction attempt)"),
@@ -1099,6 +1125,45 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
     ("compile.comm_bytes.<axis>", "counter",
      "planned per-device collective wire bytes per mesh axis, summed "
      "over compiled programs (static resource planner)"),
+    # execution stamps + performance ledger (framework/perf_ledger.py)
+    ("exec.wall_s.<program>", "histogram",
+     "per-invocation wall of a compiled entry point (stamped by "
+     "jit/api.py around every StaticFunction call) or of the "
+     "scheduler's ragged model calls (prefill_chunk/decode_token; "
+     "inference/serving.py) — the measured half of the performance "
+     "ledger's plan-vs-actual join"),
+    ("exec.count.<program>", "counter",
+     "invocations of a compiled program (rides next to "
+     "exec.wall_s.<program>)"),
+    ("ledger.mfu.<program>", "gauge",
+     "live model-flops utilization: planned flops over measured mean "
+     "wall, against FLAGS_telemetry_peak_flops (performance ledger)"),
+    ("ledger.attained_flops_per_s.<program>", "gauge",
+     "planned per-invocation flops over measured mean wall"),
+    ("ledger.hbm_bytes_per_s.<program>", "gauge",
+     "achieved HBM traffic rate: the plan's per-invocation byte "
+     "floor over measured mean wall"),
+    ("ledger.wire_bytes_per_s.<program>", "gauge",
+     "achieved collective wire rate: planned comm bytes over "
+     "measured mean wall (the live check ROADMAP item 3's quantized "
+     "collectives gate on)"),
+    ("ledger.share_of_step_wall.<program>", "gauge",
+     "the program's total measured wall as a fraction of the total "
+     "serving step wall (exec-wall total when no scheduler ran)"),
+    ("ledger.predicted_wall_s.<program>", "gauge",
+     "the planner's roofline-predicted lower-bound wall per "
+     "invocation (max of compute at peak flops and HBM at peak "
+     "bandwidth)"),
+    ("ledger.drift_ratio.<program>", "gauge",
+     "predicted lower-bound wall over the SUSTAINED (windowed) "
+     "measured wall — above FLAGS_telemetry_drift_ratio the plan "
+     "claims more work than the wall can explain (the plan-drift "
+     "watchdog's signal)"),
+    ("ledger.drift_samples.<program>", "gauge",
+     "windowed exec.wall_s samples behind the drift ratio (the "
+     "watchdog's min-samples guard reads it)"),
+    ("ledger.programs", "gauge",
+     "programs currently in the ledger report"),
     # sanitizer mirror (published by the scheduler's watchdog stride)
     ("sanitizer.events", "gauge",
      "page-sanitizer events recorded (summed across pools)"),
@@ -1212,21 +1277,31 @@ def prometheus_text(snapshot: Optional[dict] = None,
     return "\n".join(lines) + "\n"
 
 
-def write_prometheus(path: str,
-                     registry: Optional[MetricsRegistry] = None,
-                     snapshot: Optional[dict] = None,
-                     prefix: str = "paddle") -> str:
-    """Atomically (tmp + rename) write :func:`prometheus_text` to
-    ``path`` — the FLAGS_telemetry_export_path periodic snapshot the
-    scheduler refreshes every watchdog stride. A concurrent reader
-    never observes a torn file."""
-    text = prometheus_text(snapshot=snapshot, registry=registry,
-                           prefix=prefix)
+def atomic_write_text(path: str, text: str) -> str:
+    """Write ``text`` to ``path`` atomically (tmp + rename): a
+    concurrent reader never observes a torn file. The SINGLE write
+    path of every telemetry artifact a live consumer may race — the
+    periodic Prometheus snapshot and every incident-bundle member
+    (tools/lint_codebase.py's bundle-atomicity rule holds the
+    FlightRecorder to this helper)."""
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write(text)
     os.replace(tmp, path)
     return path
+
+
+def write_prometheus(path: str,
+                     registry: Optional[MetricsRegistry] = None,
+                     snapshot: Optional[dict] = None,
+                     prefix: str = "paddle") -> str:
+    """Atomically (:func:`atomic_write_text`) write
+    :func:`prometheus_text` to ``path`` — the
+    FLAGS_telemetry_export_path periodic snapshot the scheduler
+    refreshes every watchdog stride."""
+    return atomic_write_text(
+        path, prometheus_text(snapshot=snapshot, registry=registry,
+                              prefix=prefix))
 
 
 # ---------------------------------------------------------------------------
@@ -1344,6 +1419,16 @@ def summarize_jsonl(path: str) -> str:
             lines.append("counters / gauges")
             for name, v in plain:
                 lines.append(f"{name[:43]:<44}{_fmt_val(v):>12}")
+        # the performance-ledger digest (framework/perf_ledger.py):
+        # top programs by total wall, with count/p50/p99/MFU and the
+        # plan-drift verdict, reconstructed from the snapshot's
+        # exec.* histograms + ledger.* gauges
+        from . import perf_ledger
+
+        ledger_rows = perf_ledger.rows_from_snapshot(metrics)
+        if ledger_rows:
+            lines.append("")
+            lines.append(perf_ledger.format_rows(ledger_rows))
     if loaded["requests"]:
         lines.append("")
         lines.append(f"request traces ({len(loaded['requests'])})")
@@ -1393,6 +1478,17 @@ def main(argv=None) -> int:
                     help="render the dump's metrics snapshot in the "
                     "Prometheus text exposition format (stdout, or "
                     "--prom-out FILE)")
+    ap.add_argument("--ledger", metavar="TRACE_JSONL", default=None,
+                    help="print the performance-ledger table (top "
+                    "programs by total wall: count, p50/p99 wall, "
+                    "MFU, plan-drift) from the dump's metrics "
+                    "snapshot (framework/perf_ledger.py)")
+    ap.add_argument("--summarize-incident", metavar="BUNDLE_DIR",
+                    default=None,
+                    help="reconstruct an incident bundle written by "
+                    "telemetry.FlightRecorder "
+                    "(FLAGS_telemetry_incident_dir): watchdog "
+                    "events, ledger top-N, registry digest")
     ap.add_argument("-o", "--out", default=None,
                     help="output path for --export-chrome "
                     "(default: <input>.chrome.json)")
@@ -1402,11 +1498,27 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.summarize is None and args.export_chrome is None \
-            and args.export_prom is None:
-        ap.error("pass --summarize, --export-chrome and/or "
-                 "--export-prom")
+            and args.export_prom is None and args.ledger is None \
+            and args.summarize_incident is None:
+        ap.error("pass --summarize, --export-chrome, --export-prom, "
+                 "--ledger and/or --summarize-incident")
     if args.summarize is not None:
         print(summarize_jsonl(args.summarize))
+    if args.summarize_incident is not None:
+        print(summarize_incident(args.summarize_incident))
+    if args.ledger is not None:
+        from . import perf_ledger
+
+        snap = _load_jsonl(args.ledger)["metrics"]
+        if snap is None:
+            ap.error(f"{args.ledger} carries no metrics snapshot "
+                     "record (dump_jsonl with a registry)")
+        rows = perf_ledger.rows_from_snapshot(snap)
+        if rows:
+            print(perf_ledger.format_rows(rows))
+        else:
+            print("no exec.* stamps in the snapshot — nothing ran "
+                  "through the performance ledger")
     if args.export_chrome is not None:
         out = args.out or (args.export_chrome + ".chrome.json")
         chrome_from_jsonl(args.export_chrome, out)
@@ -1425,6 +1537,14 @@ def main(argv=None) -> int:
             print(text, end="")
     return 0
 
+
+# the incident flight recorder (its own module so the watchdog-read-
+# only and bundle-atomicity lint rules can hold it file-scoped) is
+# part of this module's public surface: telemetry.FlightRecorder
+from .flight_recorder import (  # noqa: E402  (intentional tail import)
+    FlightRecorder,
+    summarize_incident,
+)
 
 if __name__ == "__main__":  # pragma: no cover
     import sys

@@ -29,6 +29,16 @@ structured event to a bounded log when its signature fires:
   are bouncing between the device pool and the host swap tier
   without retiring, so steps go to KV copies instead of decode
   (docs/SERVING.md "Overload behavior").
+* ``plan-drift`` — a program whose SUSTAINED measured throughput
+  implies the static planner's cost model is off: the performance
+  ledger (framework/perf_ledger.py) publishes, per program, the
+  ratio of the roofline-predicted lower-bound wall to the windowed
+  measured wall as ``ledger.drift_ratio.<program>`` gauges; a ratio
+  at/above ``drift_ratio`` (``FLAGS_telemetry_drift_ratio``) with
+  enough windowed samples means the plan claims more work than the
+  wall can explain (falsified/stale plan, or the planner's byte/flop
+  model diverged) — exactly the check ROADMAP item 3's quantized
+  collectives need before wire-dtype decisions trust the plan.
 
 Events are plain dicts (``{"type": "watchdog_event", "class": ...,
 "epoch": ..., "detail": ..., "snapshot": ...}``), JSONL-dumpable via
@@ -81,6 +91,12 @@ WATCHDOG_CLASSES = (
      "thrash_preempts: victims are being swapped out/in faster "
      "than they make progress (capacity is oversubscribed beyond "
      "what graceful degradation can absorb)"),
+    ("plan-drift",
+     "a program's sustained measured wall beats the planner's "
+     "roofline-predicted lower bound by more than "
+     "FLAGS_telemetry_drift_ratio (ledger.drift_ratio.<program> "
+     "gauges, framework/perf_ledger.py): the static cost model is "
+     "off and must not be trusted to gate decisions"),
 )
 
 
@@ -124,7 +140,9 @@ class Watchdog:
                  collapse_min_samples: int = 8,
                  stall_factor: float = 8.0,
                  stall_min_samples: int = 8,
-                 thrash_preempts: int = 6):
+                 thrash_preempts: int = 6,
+                 drift_ratio: Optional[float] = None,
+                 drift_min_samples: int = 4):
         if registry is None:
             raise ValueError(
                 "Watchdog needs a live MetricsRegistry "
@@ -150,6 +168,10 @@ class Watchdog:
         self.stall_factor = float(stall_factor)
         self.stall_min_samples = int(stall_min_samples)
         self.thrash_preempts = int(thrash_preempts)
+        self.drift_ratio = float(flag("telemetry_drift_ratio")
+                                 if drift_ratio is None
+                                 else drift_ratio)
+        self.drift_min_samples = int(drift_min_samples)
         self.events = collections.deque(maxlen=max(8, log_capacity))
         self.dropped = 0
         self.checks = 0
@@ -407,6 +429,46 @@ class Watchdog:
         else:
             self._latched["preemption-thrash"] = False
 
+    def _check_plan_drift(self, epoch, fired):
+        """The seventh class (registry-read-only like the rest): the
+        performance ledger publishes per-program drift ratios as
+        ``ledger.drift_ratio.<program>`` gauges (predicted lower-
+        bound wall over the windowed measured wall) plus the windowed
+        sample counts; this detector only READS them. It fires on
+        the worst program at/above the threshold — once per
+        excursion (hysteresis latch), and never during warmup (the
+        first windows measure compile-laden steps)."""
+        if self.drift_ratio <= 0 or self._in_warmup(epoch):
+            return
+        led = self._ns_snapshot("ledger")
+        worst = None
+        for key, val in led.items():
+            if not key.startswith("drift_ratio."):
+                continue
+            prog = key[len("drift_ratio."):]
+            n = led.get("drift_samples." + prog, 0)
+            if n is None or n < self.drift_min_samples:
+                continue
+            if val >= self.drift_ratio \
+                    and (worst is None or val > worst[1]):
+                worst = (prog, float(val), int(n))
+        if worst is not None:
+            if not self._latched["plan-drift"]:
+                self._latched["plan-drift"] = True
+                prog, ratio, n = worst
+                self._emit(
+                    "plan-drift", epoch,
+                    {"program": prog,
+                     "drift_ratio": round(ratio, 3),
+                     "threshold": self.drift_ratio,
+                     "windowed_samples": n,
+                     "predicted_wall_s": led.get(
+                         "predicted_wall_s." + prog),
+                     "mfu": led.get("mfu." + prog)},
+                    led, fired)
+        else:
+            self._latched["plan-drift"] = False
+
     # -- the pass ----------------------------------------------------------
     def check(self, epoch: int,
               context: Optional[dict] = None) -> List[dict]:
@@ -430,6 +492,7 @@ class Watchdog:
         self._check_decode_stall(epoch, fired)
         self._check_sanitizer_spike(epoch, fired, context)
         self._check_preemption_thrash(epoch, fired)
+        self._check_plan_drift(epoch, fired)
         if fired and self.mode == "strict":
             raise WatchdogError(fired)
         for ev in fired:

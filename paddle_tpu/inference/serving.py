@@ -96,6 +96,19 @@ exportable). Off (the default) allocates nothing and costs one
 ``is None`` check per site; all timing goes through
 ``telemetry.clock()`` — tools/lint_codebase.py's clock-discipline
 rule bans direct ``time.*`` reads in this module.
+
+Performance ledger + flight recorder (ISSUE 12;
+framework/perf_ledger.py, framework/flight_recorder.py): under live
+metrics the scheduler stamps every ragged model call into
+``exec.wall_s.prefill_chunk`` / ``exec.wall_s.decode_token``
+histograms, and :meth:`BatchScheduler.metrics` surfaces the ledger's
+per-program plan-vs-actual rows under ``"ledger"`` (attained
+flops/s, MFU, bytes/s, step-wall share, plan drift). The
+``ledger.*`` gauges republish every watchdog stride so the
+``plan-drift`` detector stays registry-read-only, and with
+``FLAGS_telemetry_incident_dir`` set every watchdog fire (or an
+explicit :meth:`BatchScheduler.dump_incident`) writes one atomic
+incident bundle capturing the trip's own evidence.
 """
 from __future__ import annotations
 
@@ -112,6 +125,12 @@ from ..framework.telemetry import NULL_SPAN as _NULL
 
 __all__ = ["Request", "BatchScheduler", "RequestState",
            "bucket_packed_tokens", "QueueFullError"]
+
+# scheduler uid sequence: the namespaced serving.compile_count.<uid>
+# gauges (two schedulers must never overwrite each other's program
+# counts — the old shared gauge was last-writer-wins and stays only
+# as an alias)
+_SCHED_SEQ = [0]
 
 
 class QueueFullError(RuntimeError):
@@ -394,6 +413,13 @@ class BatchScheduler:
         self._watchdog = None
         self._export_path = None
         self._t_start = 0.0
+        # performance ledger + incident flight recorder (ISSUE 12):
+        # both exist only under live metrics — the off path holds
+        # None handles and never imports either module
+        self._ledger = None
+        self._recorder = None
+        _SCHED_SEQ[0] += 1
+        self._sched_uid = "s%d" % _SCHED_SEQ[0]
         if self._metrics is None:
             if slo is not None or watchdog is not None:
                 warnings.warn(
@@ -437,6 +463,22 @@ class BatchScheduler:
                 1, int(flag("telemetry_watchdog_stride")))
             self._export_path = \
                 str(flag("telemetry_export_path")) or None
+            # the per-program performance ledger joins the planner's
+            # static cost model with the exec.wall_s.<program> stamps
+            # this scheduler (and jit/api.py) records — surfaced via
+            # metrics()["ledger"] and the ledger.* gauges the
+            # plan-drift watchdog reads
+            from ..framework import perf_ledger as _perf_ledger
+
+            self._ledger = _perf_ledger.ledger()
+            if str(flag("telemetry_incident_dir")):
+                # every watchdog fire writes an atomic incident
+                # bundle (chrome lanes, registry snapshot, ledger
+                # top-N, sanitizer tail, ...) — see dump_incident()
+                self._recorder = telemetry.FlightRecorder(
+                    registry=self._metrics, tracer=self._tracer,
+                    traces=self._traces, watchdog=self._watchdog,
+                    ledger=self._ledger)
 
     # -- pool accounting ---------------------------------------------------
     def _pool(self, model=None):
@@ -555,6 +597,12 @@ class BatchScheduler:
             snap["watchdog"] = self._watchdog.summary()
         if self._traces is not None:
             snap["request_traces"] = self._traces.summary()
+        if self._ledger is not None:
+            # plan-vs-actual attribution per program (framework/
+            # perf_ledger.py): the "ledger" block REPLACES the raw
+            # exec.* histograms as the intended read (those stay in
+            # the snapshot as the measured source of truth)
+            snap["ledger"] = self._ledger.report()
         return snap
 
     def _publish_gauges(self) -> dict:
@@ -1355,7 +1403,13 @@ class BatchScheduler:
             m.observe("serving.step_wall_s", telemetry.clock() - t0)
             cc = getattr(self.model, "compile_count", None)
             if cc is not None:
+                # the shared gauge is LAST-WRITER-WINS across
+                # schedulers (kept as an alias for single-scheduler
+                # dashboards); the namespaced per-scheduler gauge is
+                # the truthful series
                 m.gauge("serving.compile_count", cc)
+                m.gauge("serving.compile_count." + self._sched_uid,
+                        cc)
             # stride on THIS scheduler's own step count: with two
             # schedulers interleaving, the shared epoch advances by 2
             # per iteration and `epoch % stride` could starve one of
@@ -1369,8 +1423,15 @@ class BatchScheduler:
         pool/prefix/sanitizer/serving gauges, run the watchdog
         detectors (read-only; evidence like the sanitizer journal
         tail is gathered HERE, through public pool API, and handed
-        in), and rewrite the Prometheus export file."""
+        in), and rewrite the Prometheus export file. The performance
+        ledger republishes its plan-vs-actual gauges FIRST, so the
+        plan-drift detector judges current ratios; any watchdog fire
+        — warn or strict — lands an incident bundle through the
+        flight recorder before a strict error propagates."""
         self._publish_gauges()
+        if self._ledger is not None:
+            self._ledger.publish()
+        context = None
         if self._watchdog is not None:
             context = {}
             # THIS scheduler's own adapter program count — the shared
@@ -1397,8 +1458,19 @@ class BatchScheduler:
                     worst, worst_n = san, n
             if worst is not None:
                 context["sanitizer_journal_tail"] = worst.tail(16)
-            self._watchdog.check(self._step_epoch,
-                                 context=context or None)
+            try:
+                fired = self._watchdog.check(self._step_epoch,
+                                             context=context or None)
+            except Exception as e:
+                # strict mode raises WatchdogError AT the detecting
+                # step — capture the evidence bundle first, then let
+                # the error propagate (the bundle carries e.events)
+                evs = getattr(e, "events", None)
+                if evs is not None:
+                    self._record_incident(evs, context)
+                raise
+            if fired:
+                self._record_incident(fired, context)
         if self._export_path is not None:
             # a scrape-file failure must never take down serving:
             # warn once and stop trying (the observability layer may
@@ -1413,6 +1485,36 @@ class BatchScheduler:
                     "disabling the periodic Prometheus export",
                     RuntimeWarning)
                 self._export_path = None
+
+    def _record_incident(self, events, context):
+        """Write one incident bundle for a watchdog trip (no-op
+        without a recorder). A bundle-write failure must never take
+        down serving — warn once and stop recording, like the
+        Prometheus export."""
+        if self._recorder is None:
+            return
+        try:
+            self._recorder.record(events, context=context)
+        except OSError as e:
+            warnings.warn(
+                "FLAGS_telemetry_incident_dir is unwritable "
+                f"({e}); disabling the incident flight recorder",
+                RuntimeWarning)
+            self._recorder = None
+
+    def dump_incident(self, reason: str = "manual"):
+        """Explicitly capture an incident bundle RIGHT NOW (the
+        on-demand half of the flight recorder): current gauges are
+        republished first so the bundle reflects this instant, then
+        the recorder writes one atomic bundle under
+        ``FLAGS_telemetry_incident_dir``. Returns the bundle path,
+        or None when no recorder is configured."""
+        if self._recorder is None:
+            return None
+        self._publish_gauges()
+        if self._ledger is not None:
+            self._ledger.publish()
+        return self._recorder.dump_incident(reason=reason)
 
     def _noop_event(self) -> dict:
         return {"admitted": 0, "advanced": 0, "finished": 0,
@@ -1519,10 +1621,19 @@ class BatchScheduler:
         # gives it (the documented span schema: retire nests inside)
         with self._span("serving.decode", rows=len(sids),
                         prefill=n_pre):
+            # execution stamp for the performance ledger (framework/
+            # perf_ledger.py): the model call + its device->host sync
+            # is the program wall, the sampling loop below is not
+            t_exec = telemetry.clock() if self._metrics is not None \
+                else 0.0
             logits = self.model.decode_token(feed, sids)
             logits_np = np.asarray(
                 logits.numpy() if hasattr(logits, "numpy") else logits
             )
+            if self._metrics is not None:
+                self._metrics.observe("exec.wall_s.decode_token",
+                                      telemetry.clock() - t_exec)
+                self._metrics.inc("exec.count.decode_token")
 
             finished = 0
             for bi, s in enumerate(sids):
@@ -1644,6 +1755,8 @@ class BatchScheduler:
         rows, feeds, starts, n_pre, n_dec = self._chunk_feeds(sids)
         packed = sum(len(f) for f in feeds)
         pad_to = bucket_packed_tokens(packed, self.serving_buckets)
+        t_exec = telemetry.clock() if self._metrics is not None \
+            else 0.0
         with self._span("serving.prefill_chunk", rows=len(rows),
                         packed=packed, pad_to=pad_to, prefill=n_pre,
                         decode=n_dec):
@@ -1652,6 +1765,15 @@ class BatchScheduler:
             logits_np = np.asarray(
                 logits.numpy() if hasattr(logits, "numpy")
                 else logits)
+        if self._metrics is not None:
+            # execution stamp for the performance ledger: one ragged
+            # program invocation per step under the "prefill_chunk"
+            # key — register a plan under the same name (bench.py
+            # does, for the paged attend program) and the ledger
+            # reports its attained bytes/s, MFU and plan drift
+            self._metrics.observe("exec.wall_s.prefill_chunk",
+                                  telemetry.clock() - t_exec)
+            self._metrics.inc("exec.count.prefill_chunk")
 
         finished = 0
         with self._span("serving.decode", rows=len(rows)):

@@ -221,6 +221,12 @@ class StaticFunction:
                     rep = None
             if rep is not None and rep.blocking():
                 raise analysis.JitLintError(rep)
+        # per-invocation execution stamp (framework/perf_ledger.py):
+        # the handle tuple is attached at finalize ONLY when the
+        # registry was live, so the off path pays one dict get + one
+        # `is None` check and allocates nothing
+        _exec = entry.get("_exec")
+        _t_exec = _telemetry.clock() if _exec is not None else 0.0
         rw_raws = [state[i]._data for i in entry["rw_idx"]]
         ro_raws = [state[i]._data for i in entry["ro_idx"]]
         if entry.get("donates"):
@@ -245,6 +251,14 @@ class StaticFunction:
         out_arrs, changed_state, grad_raws = entry["jitted"](
             rw_raws, ro_raws, tensor_raws
         )
+        if _exec is not None:
+            # host-observed dispatch wall of the compiled program —
+            # the measured half of the performance ledger's
+            # plan-vs-actual join (exec.wall_s.<program> histogram +
+            # exec.count.<program> counter)
+            _reg, _wall_key, _count_key = _exec
+            _reg.observe(_wall_key, _telemetry.clock() - _t_exec)
+            _reg.inc(_count_key)
         aux = entry["aux"]
 
         for i, r in zip(entry["changed_idx"], changed_state):
@@ -505,6 +519,18 @@ class StaticFunction:
             variants = len(self._finalized_entries())
             lint_counts = report.counts() if report is not None else {}
             if _reg is not None:
+                # arm the per-invocation execution stamp for this
+                # entry (performance ledger, framework/perf_ledger.py)
+                # and hand the ledger the program's resource plan so
+                # live walls join the static cost model. Like the
+                # telemetry mode itself, read at COMPILE time.
+                entry["_exec"] = (_reg,
+                                  "exec.wall_s." + str(prog),
+                                  "exec.count." + str(prog))
+                if plan is not None:
+                    from ..framework import perf_ledger as _ledger
+
+                    _ledger.register_plan(str(prog), plan)
                 _reg.inc("compile.count")
                 # per-program attribution: when the recompile-storm
                 # watchdog fires, the by_program counters in its

@@ -274,6 +274,86 @@ class TestWatchdogReadOnly:
         ids = [r for r, _ in lint_codebase.RULES]
         assert "watchdog-read-only" in ids
 
+    def test_flight_recorder_is_covered_by_readonly_rule(self):
+        # ISSUE 12: the incident flight recorder is held to the same
+        # read-only surface as the detectors whose trips it records
+        assert any(
+            f.endswith(os.path.join("framework", "flight_recorder.py"))
+            for f in lint_codebase.WATCHDOG_FILES)
+
+
+class TestBundleAtomicity:
+    """Bundle-atomicity discipline (ISSUE 12): incident-bundle
+    writers must route every file write through telemetry's
+    atomic-write helper — no direct write-mode open() calls."""
+
+    def test_seeded_write_mode_open_flagged(self):
+        bad = (
+            "import json, io, os\n"
+            "def write(self, path, obj):\n"
+            "    with open(path, 'w') as f:\n"
+            "        json.dump(obj, f)\n"
+            "    with open(path + '.log', 'a') as f:\n"
+            "        f.write('x')\n"
+            "    io.open(path, 'w+')\n"
+        )
+        v = lint_codebase.lint_incident_writer_file(
+            "fake/flight_recorder.py", text=bad)
+        rules = "\n".join(v)
+        assert len(v) == 3, v
+        assert "open(..., 'w')" in rules
+        assert "open(..., 'a')" in rules
+        assert "atomic_write_text" in rules
+
+    def test_seeded_dynamic_mode_flagged(self):
+        bad = (
+            "def write(self, path, mode):\n"
+            "    return open(path, mode)\n"
+        )
+        v = lint_codebase.lint_incident_writer_file(
+            "fake/flight_recorder.py", text=bad)
+        assert len(v) == 1, v
+        assert "dynamic mode" in v[0]
+
+    def test_reads_allowed(self):
+        text = (
+            "import json\n"
+            "def read(self, path):\n"
+            "    with open(path) as f:\n"
+            "        return json.load(f)\n"
+            "def read2(self, path):\n"
+            "    return open(path, 'r', encoding='utf-8').read()\n"
+        )
+        assert lint_codebase.lint_incident_writer_file(
+            "fake/flight_recorder.py", text=text) == []
+
+    def test_waiver_comment_suppresses(self):
+        text = (
+            "def write(self, path):\n"
+            "    open(path, 'w')"
+            "  # trace-lint: ok(test waiver)\n"
+        )
+        assert lint_codebase.lint_incident_writer_file(
+            "fake/flight_recorder.py", text=text) == []
+
+    def test_recorder_module_is_covered_and_clean(self):
+        assert any(
+            f.endswith(os.path.join("framework", "flight_recorder.py"))
+            for f in lint_codebase.INCIDENT_WRITER_FILES)
+        assert lint_codebase.check_bundle_atomicity() == []
+
+    def test_ledger_and_recorder_are_host_only(self):
+        # ISSUE 12: the performance ledger and the flight recorder
+        # run inside the scheduler's step loop — jax-free by lint
+        for tail in ("perf_ledger.py", "flight_recorder.py"):
+            assert any(
+                f.endswith(os.path.join("framework", tail))
+                for f in lint_codebase.HOST_ONLY_FILES), tail
+
+    def test_rule_inventory_has_bundle_atomicity(self):
+        ids = [r for r, _ in lint_codebase.RULES]
+        assert "bundle-atomicity" in ids
+
 
 class TestOpTableMessages:
     """The small-fix satellite: undeclared/waiver failures must name

@@ -493,7 +493,7 @@ class TestInventory:
         inv = static_check_inventory()
         ids = {r["rule_id"] for r in inv["watchdog"]}
         assert ids == {cls for cls, _ in WATCHDOG_CLASSES}
-        assert len(WATCHDOG_CLASSES) == 6
+        assert len(WATCHDOG_CLASSES) == 7  # ISSUE 12: + plan-drift
 
 
 # -- epoch-windowed views -----------------------------------------------------

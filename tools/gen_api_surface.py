@@ -66,6 +66,8 @@ NAMESPACES = [
     "paddle_tpu.inference",
     "paddle_tpu.framework.telemetry",
     "paddle_tpu.framework.watchdog",
+    "paddle_tpu.framework.perf_ledger",
+    "paddle_tpu.framework.flight_recorder",
     "paddle_tpu.profiler",
     "paddle_tpu.models",
     "paddle_tpu.models.convert",

@@ -129,6 +129,8 @@ HOST_ONLY_FILES = (
     os.path.join("paddle_tpu", "inference", "prefix_cache.py"),
     os.path.join("paddle_tpu", "framework", "telemetry.py"),
     os.path.join("paddle_tpu", "framework", "watchdog.py"),
+    os.path.join("paddle_tpu", "framework", "perf_ledger.py"),
+    os.path.join("paddle_tpu", "framework", "flight_recorder.py"),
     os.path.join("paddle_tpu", "incubate", "nn", "fault_injection.py"),
 )
 
@@ -358,9 +360,14 @@ def check_clock_discipline(root=REPO):
 # (or perturbs the pool it diagnoses) produces evidence nobody can
 # trust. Evidence that requires pool access (the sanitizer journal
 # tail) is gathered by the SCHEDULER through public API and handed
-# in via the check() context.
+# in via the check() context. The incident flight recorder
+# (framework/flight_recorder.py) is held to the SAME read-only
+# surface: a recorder that perturbs the metrics it snapshots (or
+# reaches into a pool for "better" evidence) corrupts the incident
+# bundle it exists to preserve.
 WATCHDOG_FILES = (
     os.path.join("paddle_tpu", "framework", "watchdog.py"),
+    os.path.join("paddle_tpu", "framework", "flight_recorder.py"),
 )
 
 # registry mutators (MetricsRegistry write surface) banned in
@@ -794,6 +801,97 @@ def check_watchdog_readonly(root=REPO):
     out = []
     for f in WATCHDOG_FILES:
         out.extend(lint_watchdog_file(os.path.join(root, f)))
+    return out
+
+
+# bundle-atomicity discipline (the incident flight recorder's write
+# contract): every file an incident-bundle writer produces must go
+# through telemetry's atomic-write helper (atomic_write_text: tmp +
+# rename) — a torn half-written evidence file defeats the bundle's
+# whole purpose. Operationally: NO direct write/append-mode open()
+# calls in the incident-writer modules (reads stay allowed — the
+# --summarize-incident replay lives next door), and a dynamic (non-
+# literal) mode is flagged too because the linter cannot prove it
+# read-only. Directory-level renames (the bundle's own atomicity
+# point) are the writer's job and stay allowed.
+INCIDENT_WRITER_FILES = (
+    os.path.join("paddle_tpu", "framework", "flight_recorder.py"),
+)
+
+_WRITE_MODE_CHARS = frozenset("wax+")
+
+
+class _BundleAtomicityVisitor(ast.NodeVisitor):
+    """Flags direct write-mode ``open()`` (and ``io.open``/
+    ``os.fdopen``) calls in incident-writer modules."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+
+    def _flag(self, lineno, what):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        if _WAIVER_MARK not in line:
+            self.violations.append(
+                "%s:%d: %s — incident-bundle writers must go through "
+                "telemetry.atomic_write_text (tmp + rename; a torn "
+                "half-written evidence file defeats the bundle); fix "
+                "it or waive with '%s(<reason>)'"
+                % (self.relpath, lineno, what, _WAIVER_MARK))
+
+    def _is_open(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "open":
+            return "open"
+        dotted = _dotted_head(node)
+        if dotted in (("io", "open"), ("os", "fdopen")):
+            return "%s.%s" % dotted
+        return None
+
+    def visit_Call(self, node):
+        name = self._is_open(node)
+        if name is not None:
+            mode = None
+            if len(node.args) >= 2:
+                mode = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if mode is None:
+                pass  # default "r": a read, allowed
+            elif isinstance(mode, ast.Constant) \
+                    and isinstance(mode.value, str):
+                if _WRITE_MODE_CHARS & set(mode.value):
+                    self._flag(node.lineno,
+                               "%s(..., %r)" % (name, mode.value))
+            else:
+                self._flag(node.lineno,
+                           "%s(...) with a dynamic mode (cannot be "
+                           "proven read-only)" % name)
+        self.generic_visit(node)
+
+
+def lint_incident_writer_file(path, text=None):
+    """Bundle-atomicity check for one file; returns violations."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _BundleAtomicityVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_bundle_atomicity(root=REPO):
+    out = []
+    for f in INCIDENT_WRITER_FILES:
+        out.extend(lint_incident_writer_file(os.path.join(root, f)))
     return out
 
 
@@ -1236,13 +1334,18 @@ RULES = (
      "registry; no raw jax callables leaking through"),
     ("host-only-hygiene",
      "declared host-only modules (prefix_cache.py, framework/"
-     "telemetry.py, framework/watchdog.py) must not touch jax/jnp "
-     "at all"),
+     "telemetry.py, framework/watchdog.py, framework/perf_ledger.py, "
+     "framework/flight_recorder.py) must not touch jax/jnp at all"),
     ("watchdog-read-only",
-     "watchdog/detector code (framework/watchdog.py) may only READ "
-     "the telemetry registry — no registry mutators (inc/gauge/"
-     "observe/set_epoch), no pool-private calls, no pool state "
-     "writes"),
+     "watchdog/detector AND incident-recorder code (framework/"
+     "watchdog.py, framework/flight_recorder.py) may only READ the "
+     "telemetry registry — no registry mutators (inc/gauge/observe/"
+     "set_epoch), no pool-private calls, no pool state writes"),
+    ("bundle-atomicity",
+     "incident-bundle writers (framework/flight_recorder.py) may not "
+     "open files in write/append mode directly — every member goes "
+     "through telemetry.atomic_write_text (tmp + rename), so a "
+     "reader never sees a torn evidence file"),
     ("clock-discipline",
      "no direct time.time/perf_counter reads in serving.py/"
      "paged_cache.py/prefix_cache.py — telemetry spans/clock() are "
@@ -1289,6 +1392,7 @@ def run_lint(root=REPO, with_op_table=True):
     out.extend(check_host_only(root))
     out.extend(check_clock_discipline(root))
     out.extend(check_watchdog_readonly(root))
+    out.extend(check_bundle_atomicity(root))
     out.extend(check_quant_sidecar_writes(root))
     out.extend(check_pool_mutation_audit(root))
     out.extend(check_serving_buckets(root))

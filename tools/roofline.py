@@ -374,6 +374,135 @@ def liveness(argv=None):
     return 0
 
 
+def ledger_mode(argv=None):
+    """--ledger mode: merge LIVE performance-ledger points onto the
+    planner's static roofline. Builds a small Llama train step,
+    compiles it with FLAGS_jit_plan=report under
+    FLAGS_telemetry=metrics, runs a few measured steps (the jit/api
+    execution stamps land in exec.wall_s.<program> and the compile
+    hook registers the program's ResourcePlan with the ledger), then
+    reports — per program — the planner's static position (flops,
+    planned HBM bytes, arithmetic intensity, per-chip roofline
+    ceilings) next to the measured position (attained flops/s, MFU
+    vs FLAGS_telemetry_peak_flops, achieved bytes/s, plan-drift
+    ratio). Run: JAX_PLATFORMS=cpu python tools/roofline.py --ledger
+    [--steps N --seq S --batch B]"""
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--ledger", action="store_true")  # consumed
+    args = ap.parse_args(argv)
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.framework import perf_ledger, telemetry
+    from paddle_tpu.framework.flags import flag, set_flags
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    mode0 = flag("telemetry")
+    set_flags({"telemetry": "metrics"})
+    telemetry.reset()
+    try:
+        cfg = LlamaConfig(
+            vocab_size=1024, hidden_size=args.hidden,
+            intermediate_size=args.hidden * 11008 // 4096,
+            num_hidden_layers=args.layers,
+            num_attention_heads=args.hidden // 64,
+            num_key_value_heads=args.hidden // 64,
+            max_position_embeddings=args.seq,
+            tie_word_embeddings=True,
+        )
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = optim.AdamW(3e-4, parameters=model.parameters())
+        opt._create_accumulators()
+
+        @paddle.jit.to_static
+        def train_step(x, y):
+            _, loss = model(x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randint(
+            0, cfg.vocab_size, (args.batch, args.seq)).astype("int32"))
+        y = paddle.to_tensor(rng.randint(
+            0, cfg.vocab_size, (args.batch, args.seq)).astype("int64"))
+        train_step(x, y)  # compile (plan registered, stamp armed)
+        for _ in range(max(1, args.steps)):
+            train_step(x, y)  # measured: exec.wall_s stamps
+
+        led = perf_ledger.ledger()
+        rows = led.publish() if led is not None else {}
+        out = {
+            "mode": "ledger (live plan-vs-actual on the static "
+                    "roofline)",
+            "config": {"hidden": cfg.hidden_size,
+                       "layers": cfg.num_hidden_layers,
+                       "seq": args.seq, "batch": args.batch,
+                       "steps": args.steps,
+                       "n_params": cfg.num_params()},
+            "peaks": {
+                "flops_per_s": float(flag("telemetry_peak_flops")),
+                "hbm_gbs": float(flag("telemetry_peak_hbm_gbs")),
+            },
+            "programs": {},
+        }
+        for prog, row in rows.items():
+            plan = row.get("plan") or {}
+            entry = {
+                "static": {
+                    "flops": plan.get("flops_total"),
+                    "hbm_bytes_per_call": plan.get(
+                        "hbm_bytes_per_call"),
+                    "hbm_peak_bytes": plan.get("hbm_peak_bytes"),
+                    "ai_planned": row.get("ai_planned"),
+                },
+                "live": {
+                    "calls": row.get("count"),
+                    "mean_wall_ms": round(
+                        1e3 * row["mean_wall_s"], 3)
+                    if row.get("mean_wall_s") is not None else None,
+                    "attained_flops_per_s": row.get(
+                        "attained_flops_per_s"),
+                    "mfu": row.get("mfu"),
+                    "hbm_bytes_per_s": row.get("hbm_bytes_per_s"),
+                    "ai_attained": row.get("ai_attained"),
+                    "drift_ratio": row.get("drift_ratio"),
+                    "drifting": row.get("drifting"),
+                },
+            }
+            ai = row.get("ai_planned")
+            if ai is not None:
+                chips = {}
+                for chip, (tf, bw) in CHIPS.items():
+                    # the static roofline ceiling at this program's
+                    # planned intensity: min(peak compute, AI x BW)
+                    chips[chip] = {
+                        "roofline_flops_per_s": min(
+                            tf * 1e12, ai * bw * 1e9),
+                        "compute_bound": ai * bw * 1e9 >= tf * 1e12,
+                    }
+                entry["static"]["roofline"] = chips
+            out["programs"][prog] = entry
+        print(json.dumps(out, indent=1, default=str))
+        return 0
+    finally:
+        set_flags({"telemetry": mode0})
+        telemetry.reset()
+
+
 def analytic(args=None):
     """Closed-form roofline of the TPU train step.
 
@@ -506,4 +635,6 @@ if __name__ == "__main__":
         sys.exit(analytic(sys.argv[1:]))
     if "--liveness" in sys.argv[1:]:
         sys.exit(liveness(sys.argv[1:]))
+    if "--ledger" in sys.argv[1:]:
+        sys.exit(ledger_mode(sys.argv[1:]))
     sys.exit(main())
