@@ -998,12 +998,15 @@ def bench_chunked_prefill(users=8, prompt_len=96, new_tokens=8,
         c0.append(seq, jnp.zeros((kvh, hd), kv_dt),
                   jnp.zeros((kvh, hd), kv_dt))
         nh = cfg.num_attention_heads
-        qs = jax.ShapeDtypeStruct((1, nh, hd), jnp.float32)
+        # the attend program of the packed step is the UNIFIED ragged
+        # kernel since ISSUE 13 — plan the program serving actually
+        # compiles (one per packed config, decode rows at q_lens=1)
+        qs = jax.ShapeDtypeStruct((1, 1, nh, hd), jnp.float32)
         closed = jax.make_jaxpr(
-            lambda q: c0.attend_padded(
-                q, [seq], rows_pad=1, max_pages=4)._data)(qs)
+            lambda q: c0.attend_ragged(
+                q, [seq], [1], rows_pad=1, max_pages=4)._data)(qs)
         plan, _ = _planner.plan_jaxpr(
-            closed, name="serving_chunked_prefill_attend")
+            closed, name="serving_ragged_attend")
         page_bytes = sum(
             b.nbytes for b in plan.buffers_of("const")
             if b.shape and b.shape[0] == c0.num_pages)
@@ -1138,6 +1141,232 @@ def bench_chunked_prefill(users=8, prompt_len=96, new_tokens=8,
         "ledger": ledger_rec,
     }
     return _merge_serving_rec("chunked_prefill", rec)
+
+
+# aux: unified ragged attention — two-kernel routing vs ONE program
+# ---------------------------------------------------------------------------
+
+
+def bench_ragged_serving(budget=64):
+    """Unified ragged-attention arm (ISSUE 13, ROADMAP item 2): the
+    chunked workload run under FLAGS_ragged_attention=off (the legacy
+    per-row-kind decode/prefill kernel pair) vs auto (ONE ragged
+    kernel per packed config, plus the FlashFuser-fused qkv+RoPE
+    prologue / o_proj epilogue where eligible). Records per-step
+    walls, the attend KERNEL PROGRAM counts (the per-bucket doubling
+    the unification removes), the per-layer attend dispatch counts
+    (exactly halved on mixed decode+prefill steps), and the ledger's
+    share_of_step_wall attribution of the unified program. The
+    --serving gate requires greedy identity, >= 1 mixed step whose
+    dispatches halved, and no attend-program growth."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.inference import (
+        BatchScheduler,
+        PagedLlamaAdapter,
+        Request,
+    )
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    kind = _device_kind()
+    cpu = kind.startswith("cpu")
+    page_size = 4
+    if cpu:
+        users, prompt_len, new_tokens = 4, 48, 6
+        cfg = llama_tiny(num_hidden_layers=2,
+                         max_position_embeddings=256)
+    else:
+        users, prompt_len, new_tokens = 8, 256, 16
+        cfg = llama_tiny(
+            hidden_size=512, intermediate_size=1024,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=2048,
+        )
+        page_size = 16
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(users)]
+    pages_per_seq = -(-(prompt_len + new_tokens) // page_size)
+    num_pages = 2 * users * pages_per_seq + 16
+    layers = cfg.num_hidden_layers
+
+    def _kernel_caches():
+        from paddle_tpu.ops.kernels.paged_attention import (
+            _jitted_decode_call,
+            _jitted_fused_call,
+            _jitted_ragged_call,
+        )
+
+        return (_jitted_decode_call, _jitted_ragged_call,
+                _jitted_fused_call)
+
+    def _cold_compile_count(mode):
+        """REAL compiled pallas entry count for one cold run of the
+        arm: clear the shape-keyed dispatch caches, run, and count
+        the entries that landed — a regression that silently splits
+        the unified cfg key (per row kind, per real-token count)
+        shows up here even when the adapter's own accounting looks
+        stable."""
+        for c in _kernel_caches():
+            c.cache_clear()
+        run(mode)
+        return sum(c.cache_info().currsize for c in _kernel_caches())
+
+    def run(mode, telemetry_mode=None):
+        set_flags({"ragged_attention": mode})
+        adapter = PagedLlamaAdapter(
+            model, num_pages=num_pages, page_size=page_size,
+            max_length=cfg.max_position_embeddings)
+        sched = BatchScheduler(adapter, max_batch_size=users,
+                               chunked_prefill=True,
+                               prefill_chunk_tokens=budget)
+        for i, p in enumerate(prompts):
+            sched.submit(Request(f"r{i}", list(p),
+                                 max_new_tokens=new_tokens))
+        step_walls = []
+        mixed_walls = []
+        while sched.num_active or sched.num_queued:
+            ts = time.perf_counter()
+            ev = sched.step()
+            dt = time.perf_counter() - ts
+            step_walls.append(dt)
+            if ev["prefill_tokens"] and ev["decode_tokens"]:
+                mixed_walls.append(dt)
+        gen = {f"r{i}": sched.result(f"r{i}").generated_ids
+               for i in range(users)}
+        share = None
+        if telemetry_mode is not None:
+            row = sched.metrics().get("ledger", {}).get(
+                "prefill_chunk", {})
+            share = row.get("share_of_step_wall")
+        return {
+            "gen": gen,
+            "step_p50_ms": 1e3 * float(np.median(step_walls)),
+            "mixed_step_p50_ms": 1e3 * float(np.median(mixed_walls))
+            if mixed_walls else None,
+            "attend_programs": adapter.attend_program_count,
+            "attend_calls": adapter.chunk_stats["attend_calls"],
+            "chunk_calls": adapter.chunk_stats["calls"],
+            "kernel_kinds": sorted(
+                {k for k, *_ in adapter._kernel_shapes}),
+            "kinds_by_bucket": {
+                str(b): kinds for b, kinds in
+                sorted(adapter.attend_kinds_by_bucket.items())},
+            "compile_count": adapter.compile_count,
+            "ledger_share_of_step_wall": share,
+        }
+
+    def ledger_share():
+        """The PR-12 ledger attributes the unified program: run the
+        auto arm under FLAGS_telemetry=metrics and read the attend
+        program's share of total step wall back from the plan-vs-
+        actual join (the model call rides the prefill_chunk exec
+        stamp; bench_chunked_prefill registers the ragged attend
+        plan under the same key)."""
+        from paddle_tpu.framework import telemetry as _tel
+        from paddle_tpu.framework.flags import set_flags as _sf
+
+        _tel.reset()
+        _sf({"telemetry": "metrics"})
+        try:
+            return run("auto", telemetry_mode="metrics")
+        finally:
+            _sf({"telemetry": "off"})
+            _tel.reset()
+
+    try:
+        # cold passes double as warmups (compiles land outside the
+        # measured runs) and count the REAL compiled pallas entries
+        off_compiles = _cold_compile_count("off")
+        off = run("off")
+        auto_compiles = _cold_compile_count("auto")
+        auto = run("auto")
+        ledger = ledger_share()
+    finally:
+        set_flags({"ragged_attention": "auto"})
+
+    assert auto["gen"] == off["gen"], (
+        "unified ragged dispatch diverged from the two-kernel path")
+    assert ledger["gen"] == off["gen"]
+    # the adapter's claimed program count is the TRUE compile count:
+    # every unified attend program is one dispatch-cache entry (no
+    # hidden per-row-kind or per-real-token-count cfg splits)
+    assert auto_compiles == auto["attend_programs"], (
+        auto_compiles, auto["attend_programs"])
+    # ISSUE-13 acceptance, measured per bucket: the legacy arm pays
+    # the decode+prefill PAIR on mixed buckets; unified runs exactly
+    # ONE kernel kind on every bucket
+    assert all(len(k) == 1 for k in auto["kinds_by_bucket"].values()
+               ), auto["kinds_by_bucket"]
+    doubled = [b for b, k in off["kinds_by_bucket"].items()
+               if len(k) == 2]
+    assert doubled, (
+        "no bucket paid the two-kernel pair in the legacy arm — the "
+        "halving claim was not exercised")
+    # the new DEFAULT must not regress step wall (generous bound for
+    # CPU noise; the cpu run is ~25-35% FASTER from the fusion)
+    assert auto["step_p50_ms"] <= off["step_p50_ms"] * 1.25, (
+        auto["step_p50_ms"], off["step_p50_ms"])
+    # the unified path issues EXACTLY one attend dispatch per layer
+    # per packed step; the legacy path adds one more per layer on
+    # every step that mixes single-token and multi-token rows — the
+    # per-step dispatch halving of ROADMAP item 2
+    assert auto["attend_calls"] == auto["chunk_calls"] * layers, auto
+    mixed_kernel_steps = (off["attend_calls"]
+                          - off["chunk_calls"] * layers) // layers
+    assert mixed_kernel_steps >= 1, (
+        "workload produced no mixed steps — the two-kernel arm never "
+        "paid the pair")
+    assert auto["attend_programs"] <= off["attend_programs"], (
+        off["attend_programs"], auto["attend_programs"])
+    share = ledger["ledger_share_of_step_wall"]
+    share_ok = share is not None and 0.0 < float(share) <= 1.0
+    rec = {
+        "config": "serving_ragged_attention",
+        "mode": "tpu-single-chip" if not cpu else "cpu",
+        "users": users,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "budget": budget,
+        "layers": layers,
+        "greedy_identical": True,        # asserted above
+        "two_kernel": {
+            "step_p50_ms": round(off["step_p50_ms"], 2),
+            "mixed_step_p50_ms": round(off["mixed_step_p50_ms"], 2)
+            if off["mixed_step_p50_ms"] is not None else None,
+            "attend_programs": off["attend_programs"],
+            "attend_calls": off["attend_calls"],
+            "kernel_kinds": off["kernel_kinds"],
+            "kinds_by_bucket": off["kinds_by_bucket"],
+            "cold_pallas_compiles": int(off_compiles),
+            "compile_count": off["compile_count"],
+        },
+        "unified": {
+            "step_p50_ms": round(auto["step_p50_ms"], 2),
+            "mixed_step_p50_ms": round(auto["mixed_step_p50_ms"], 2)
+            if auto["mixed_step_p50_ms"] is not None else None,
+            "attend_programs": auto["attend_programs"],
+            "attend_calls": auto["attend_calls"],
+            "kernel_kinds": auto["kernel_kinds"],
+            "kinds_by_bucket": auto["kinds_by_bucket"],
+            "cold_pallas_compiles": int(auto_compiles),
+            "compile_count": auto["compile_count"],
+        },
+        "doubled_buckets_two_kernel": sorted(doubled),
+        "per_bucket_kinds_halved": True,        # asserted above
+        "step_wall_ratio": round(
+            auto["step_p50_ms"] / max(off["step_p50_ms"], 1e-9), 3),
+        "mixed_kernel_steps": int(mixed_kernel_steps),
+        "attend_calls_saved": off["attend_calls"]
+        - auto["attend_calls"],
+        "mixed_step_dispatches_halved": True,   # asserted above
+        "ledger_share_of_step_wall": round(float(share), 4)
+        if share is not None else None,
+        "ledger_share_ok": bool(share_ok),
+    }
+    return _merge_serving_rec("ragged", rec)
 
 
 # aux: page-sanitizer overhead — strict shadow-heap checking vs off
@@ -2490,6 +2719,8 @@ def main() -> int:
                     help="run only the serving workloads: shared-"
                          "prefix (radix prefix cache on vs off), "
                          "quantized, chunked-prefill budget sweep, "
+                         "the unified ragged-attention arm (two-"
+                         "kernel vs one program per bucket), "
                          "the page-sanitizer overhead arm, the "
                          "runtime-telemetry overhead arm (trace vs "
                          "off + TTFT/TPOT columns), and the bursty "
@@ -2518,6 +2749,7 @@ def main() -> int:
         rec = _emit(bench_prefix_serving())
         qrec = _emit(bench_quant_serving())
         crec = _emit(bench_chunked_prefill())
+        rgrec = _emit(bench_ragged_serving())
         srec = _emit(bench_sanitizer_serving())
         trec = _emit(bench_telemetry_serving())
         orec = _emit(bench_overload_serving())
@@ -2547,6 +2779,23 @@ def main() -> int:
             bool(crec.get("ledger", {}).get("bytes_per_s_finite")) \
             and not crec.get("ledger", {}).get("drifting", True) \
             and crec.get("ledger", {}).get("plan_drift_trips", 1) == 0
+        # ISSUE-13 unified-ragged acceptance: greedy outputs identical
+        # to the two-kernel path, at least one mixed step whose
+        # per-layer attend dispatches halved (2 -> 1), no attend-
+        # program growth, and the ledger attributing the unified
+        # program's share of step wall
+        ragged_ok = bool(rgrec.get("greedy_identical")) and \
+            bool(rgrec.get("mixed_step_dispatches_halved")) and \
+            bool(rgrec.get("per_bucket_kinds_halved")) and \
+            rgrec.get("mixed_kernel_steps", 0) >= 1 and \
+            len(rgrec.get("doubled_buckets_two_kernel", [])) >= 1 \
+            and rgrec.get("unified", {}).get(
+                "attend_programs", 1 << 30) \
+            <= rgrec.get("two_kernel", {}).get("attend_programs", 0) \
+            and rgrec.get("unified", {}).get("cold_pallas_compiles") \
+            == rgrec.get("unified", {}).get("attend_programs") \
+            and rgrec.get("step_wall_ratio", 9.9) <= 1.25 \
+            and bool(rgrec.get("ledger_share_ok"))
         # ISSUE-6 sanitizer acceptance: off-mode serving allocates
         # NOTHING in page_sanitizer.py, strict mode is output-identical
         # and violation-free on a healthy pool
@@ -2602,7 +2851,7 @@ def main() -> int:
             rec.get("prefill_skip_frac", 0.0) >= 0.5 and \
             qrec.get("greedy_match_rate", 0.0) >= 1.0 and \
             qrec.get("seq_capacity_ratio", 0.0) >= 1.8 and \
-            chunk_ok and san_ok and tel_ok and over_ok
+            chunk_ok and ragged_ok and san_ok and tel_ok and over_ok
         _emit({"metric": "serving_prefix_cache",
                "value": rec.get("prefill_skip_frac", 0.0),
                "unit": "prefill_skip_frac",
@@ -2620,6 +2869,16 @@ def main() -> int:
                    max((a["compile_count"] or 0
                         for a in crec.get("budgets", {}).values()),
                        default=0),
+               "ragged_attend_programs_two_kernel":
+                   rgrec.get("two_kernel", {}).get("attend_programs"),
+               "ragged_attend_programs_unified":
+                   rgrec.get("unified", {}).get("attend_programs"),
+               "ragged_mixed_kernel_steps":
+                   rgrec.get("mixed_kernel_steps"),
+               "ragged_attend_calls_saved":
+                   rgrec.get("attend_calls_saved"),
+               "ragged_ledger_share_of_step_wall":
+                   rgrec.get("ledger_share_of_step_wall"),
                "sanitizer_overhead_pct": srec.get("overhead_pct"),
                "sanitizer_events": srec.get("sanitizer_events", 0),
                "sanitizer_off_zero_alloc":

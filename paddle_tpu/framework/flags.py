@@ -173,6 +173,23 @@ define_flag("prefill_chunk_tokens", 64,
             "PagedLlamaAdapter.prefill_chunk — Sarathi-style budget "
             "packing keeps decode latency flat while prefill "
             "saturates the chip (docs/SERVING.md)")
+define_flag("ragged_attention", "auto",
+            "unified ragged paged-attention dispatch for the chunked "
+            "serving step (ops/kernels/paged_attention.py): 'auto' "
+            "(default) routes every packed row — single-token decode "
+            "rows and multi-token prefill chunks alike — through ONE "
+            "ragged kernel call per layer (per-row q_lens/kv_lens "
+            "ride scalar prefetch; right-aligned rows) and, where "
+            "eligible (fp KV pages, unquantized non-distributed "
+            "projection weights), fuses the packed dense prologue "
+            "(qkv projection + RoPE + page scatter) and epilogue "
+            "(o_proj) into the same compiled program FlashFuser-"
+            "style; 'on' forces the unified kernel but never the "
+            "fused prologue/epilogue (the pure-kernel unification, "
+            "for A/B isolation); 'off' restores the historical "
+            "two-kernel lowering (decode rows via the paged decode "
+            "kernel, prefill rows via the q_lens-masked prefill "
+            "kernel) bitwise (docs/SERVING.md)")
 define_flag("serving_buckets", "8,16,32,64,128,256",
             "comma-separated packed-token buckets for the chunked-"
             "prefill ragged dispatch: the per-step packed token count "

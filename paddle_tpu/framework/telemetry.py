@@ -1025,6 +1025,14 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
      "per-scheduler compiled ragged program count, namespaced by the "
      "scheduler's uid (s1, s2, ...) so two live schedulers never "
      "overwrite each other's counts"),
+    ("serving.attend_programs", "gauge",
+     "distinct paged-attention kernel programs the packed step has "
+     "compiled (adapter.attend_program_count): ONE per packed config "
+     "under FLAGS_ragged_attention=auto|on, a decode/prefill pair "
+     "per mixed config under off. Shared alias, last-writer-wins"),
+    ("serving.attend_programs.<scheduler>", "gauge",
+     "per-scheduler attend kernel program count (uid-namespaced, "
+     "same contract as serving.compile_count.<scheduler>)"),
     ("serving.admit_reject_pool", "counter",
      "admission refusals on page-pool capacity (head-of-queue "
      "blocked after any eviction attempt)"),

@@ -88,6 +88,13 @@ from ...ops.kernels.paged_attention import paged_attention as _kernel
 from ...ops.kernels.paged_attention import (
     paged_prefill_attention as _prefill_kernel,
 )
+from ...ops.kernels.paged_attention import (
+    paged_ragged_attention as _ragged_kernel_fn,
+)
+from ...ops.kernels.paged_attention import (
+    paged_ragged_fused_step as _fused_step_fn,
+)
+from ...ops.kernels.paged_attention import pad_plan_i32 as _pad_plan
 from ...ops.kernels.quant import kv_head_scale, quantize_kv
 
 __all__ = ["PagedKVCacheManager", "paged_attention",
@@ -887,6 +894,35 @@ class PagedKVCacheManager:
                 need += 1
         return need
 
+    def _ragged_slots(self, seq_ids, counts):
+        """Bookkeeping half of a ragged append: atomic capacity
+        precheck (nothing mutates on failure — the validation runs
+        BEFORE any bookkeeping, same contract as append_batch), slot
+        assignment (COW forks included), length advance, and the
+        sanitizer event. Returns the (pages, offs) write plan; the
+        device scatter belongs to the caller — :meth:`append_ragged`,
+        or the fused program that owns it as its prologue
+        (:meth:`fused_ragged_step`)."""
+        need = self.ragged_pages_needed(seq_ids, counts)
+        if need > len(self._free):
+            raise RuntimeError(
+                f"KV page pool exhausted: ragged append needs {need} "
+                f"new pages, {len(self._free)} free")
+        pages = []
+        offs = []
+        for s, c in zip(seq_ids, counts):
+            for _ in range(c):
+                page, off = self._next_slot(s)
+                self._lens[s] += 1
+                pages.append(page)
+                offs.append(off)
+        if pages and self._san is not None:
+            self._san.event("append_ragged", seq_ids=list(seq_ids),
+                            counts=list(counts),
+                            pages=[int(p) for p in pages],
+                            offs=[int(o) for o in offs], pool=self)
+        return pages, offs
+
     def append_ragged(self, seq_ids, counts, k_toks, v_toks):
         """Write ``counts[i]`` consecutive tokens' K/V for EVERY listed
         sequence in one scatter per pages array (the chunked-prefill
@@ -901,29 +937,9 @@ class PagedKVCacheManager:
             raise ValueError(
                 f"append_ragged: counts sum to {sum(counts)} but "
                 f"{k_toks.shape[0]} token rows were passed")
-        # atomicity: validate capacity BEFORE any bookkeeping mutation
-        # (same contract as append_batch) — a mid-chunk exhaustion must
-        # not leave some sequences' lens ahead of their device writes
-        need = self.ragged_pages_needed(seq_ids, counts)
-        if need > len(self._free):
-            raise RuntimeError(
-                f"KV page pool exhausted: ragged append needs {need} "
-                f"new pages, {len(self._free)} free")
-        pages = []
-        offs = []
-        for s, c in zip(seq_ids, counts):
-            for _ in range(c):
-                page, off = self._next_slot(s)
-                self._lens[s] += 1
-                pages.append(page)
-                offs.append(off)
+        pages, offs = self._ragged_slots(seq_ids, counts)
         if not pages:
             return
-        if self._san is not None:
-            self._san.event("append_ragged", seq_ids=list(seq_ids),
-                            counts=list(counts),
-                            pages=[int(p) for p in pages],
-                            offs=[int(o) for o in offs], pool=self)
         if self.quantized:
             # replay the per-token calibration ORDER (wave j = the
             # j-th token of every chunk): scale growth requantizes
@@ -996,7 +1012,12 @@ class PagedKVCacheManager:
         (rows_pad, H, D) whose first ``len(seq_ids)`` rows are real
         decode tokens; padding rows (any content) return exact zeros.
         ``max_pages`` pads the page-table width. The shape-stable
-        flavor of :meth:`attend` the bucketed ragged dispatch needs."""
+        flavor of :meth:`attend` the bucketed ragged dispatch needs.
+
+        .. deprecated:: thin single-kind wrapper — under
+           ``FLAGS_ragged_attention=auto|on`` the kernel beneath is
+           the unified ragged program at T=1; mixed packed batches
+           should call :meth:`attend_ragged` directly."""
         q = _as_tensor(q)
         tbl, lens = self._padded_kernel_inputs(
             seq_ids, rows_pad, max_pages)
@@ -1018,7 +1039,11 @@ class PagedKVCacheManager:
         (rows_pad, T, H, D); row i's last ``q_lens[i]`` rows are the
         newest tokens of seq_ids[i] (K/V already appended — seq_len
         counts them), earlier rows and batch-padding rows return exact
-        zeros. One fused kernel call for the whole mixed batch."""
+        zeros. One fused kernel call for the whole mixed batch.
+
+        .. deprecated:: alias shape of :meth:`attend_ragged` (the
+           q_lens-masked prefill kernel WAS the unified ragged kernel
+           all along) — new packed-step callers use attend_ragged."""
         q = _as_tensor(q)
         tbl, lens = self._padded_kernel_inputs(
             seq_ids, rows_pad, max_pages)
@@ -1038,6 +1063,125 @@ class PagedKVCacheManager:
 
         return apply_op("paged_prefill_attend", f, q,
                         differentiable=False)
+
+    def attend_ragged(self, q, seq_ids, q_lens, rows_pad=None,
+                      max_pages=None, sm_scale=None, window=0):
+        """THE unified packed-step attend (ROADMAP item 2): ``q`` is
+        (rows_pad, T, H, D) with row i's last ``q_lens[i]`` rows the
+        newest tokens of seq_ids[i] — 1 for decode rows, n for
+        prefill chunks (K/V already appended; seq_len counts them).
+        Earlier rows and batch-padding rows return exact zeros. One
+        ragged kernel call for the whole mixed batch: the single
+        attend program per packed config that replaces the
+        attend_padded/attend_prefill pair (which remain as thin
+        shape wrappers for single-kind callers)."""
+        q = _as_tensor(q)
+        tbl, lens = self._padded_kernel_inputs(
+            seq_ids, rows_pad, max_pages)
+        if self._san is not None:
+            self._san_check_table(seq_ids, tbl, lens)
+        ql = jnp.zeros((tbl.shape[0],), jnp.int32)
+        ql = ql.at[:len(seq_ids)].set(
+            jnp.asarray(list(q_lens), jnp.int32))
+        kp, vp = self.k_pages, self.v_pages
+        ks = self.k_scales if self.quantized else None
+        vs = self.v_scales if self.quantized else None
+
+        def f(qr):
+            return _ragged_kernel_fn(
+                qr, kp, vp, tbl, lens, q_lens=ql, sm_scale=sm_scale,
+                window=window, k_scales=ks, v_scales=vs)
+
+        return apply_op("paged_ragged_attend", f, q,
+                        differentiable=False)
+
+    def fused_ragged_step(self, x, weights, rope, positions, seq_ids,
+                          counts, gather_map, scatter_plan,
+                          rows_pad=None, max_pages=None, sm_scale=None,
+                          window=0):
+        """FlashFuser-fused packed attention layer step: qkv
+        projection + RoPE + THIS chunk's K/V page scatter run as the
+        unified ragged kernel's PROLOGUE and o_proj as its EPILOGUE —
+        one compiled program per packed config
+        (ops/kernels/paged_attention.paged_ragged_fused_step). The
+        pool owns the page mutation: the ragged slot plan is booked
+        here (capacity precheck, COW forks, sanitizer events — the
+        forks run BEFORE the program captures the page arrays) and
+        the program's returned pages are committed before the output
+        is handed back.
+
+        ``x``: (n_pad, E) normed packed hidden states; ``weights`` =
+        (wq, wk, wv, wo, biases) raw [in, out] arrays (biases None or
+        (bq, bk, bv)); ``rope`` = (cos, sin); ``positions`` (n_pad,)
+        absolute positions; ``gather_map`` (rows_pad, T) flat packed
+        indices right-aligning each row; ``scatter_plan`` = (rows,
+        cols, flat) arrays mapping kernel output back to packed
+        slots (real-token length — padded HERE to the bucketed
+        packed length with out-of-bounds drop entries, so the fused
+        dispatch cache keys only bucketed shapes, never the per-step
+        real-token count). Returns the o_proj output (n_pad, E) as a
+        Tensor. Float pools only — int8 page calibration is a
+        host-driven per-token wave replay the fused program cannot
+        express (callers use append_ragged + attend_ragged instead).
+
+        Failure atomicity matches :meth:`append_ragged`: the capacity
+        precheck runs before ANY mutation; past it, the only raises
+        left between slot booking and the page commit are
+        config-class errors (operand shape mismatch — fails the
+        first call, never mid-serving) or a strict-sanitizer
+        violation (the pool was already corrupt), the same window
+        the unfused path's device scatter has."""
+        if self.quantized:
+            raise ValueError(
+                "fused_ragged_step: int8 KV pools calibrate per "
+                "token on the host — use append_ragged + "
+                "attend_ragged")
+        x = _as_tensor(x)
+        counts = [int(c) for c in counts]
+        n_pad = x._data.shape[0]
+        n_real = sum(counts)
+        mr, mc, mflat = scatter_plan
+        # operand-consistency precheck BEFORE any bookkeeping mutates
+        # (same contract as append_ragged's counts-vs-rows guard): a
+        # mismatched plan must not leave seq lens ahead of device
+        # writes
+        if n_real > n_pad:
+            raise ValueError(
+                f"fused_ragged_step: counts sum to {n_real} but the "
+                f"packed operand carries {n_pad} rows")
+        plan_lens = {len(a) for a in (mr, mc, mflat)}
+        if len(plan_lens) != 1 or next(iter(plan_lens)) not in (
+                n_real, n_pad):
+            raise ValueError(
+                f"fused_ragged_step: scatter plan lengths "
+                f"{[len(a) for a in (mr, mc, mflat)]} match neither "
+                f"the {n_real} real packed tokens nor the padded "
+                f"{n_pad} (pre-padded plans carry out-of-bounds "
+                "drop entries)")
+        pages, offs = self._ragged_slots(seq_ids, counts)
+        tbl, lens = self._padded_kernel_inputs(
+            seq_ids, rows_pad, max_pages)
+        if self._san is not None:
+            self._san_check_table(seq_ids, tbl, lens)
+        ql = jnp.zeros((tbl.shape[0],), jnp.int32)
+        ql = ql.at[:len(seq_ids)].set(jnp.asarray(counts, jnp.int32))
+        wq, wk, wv, wo, biases = weights
+        cos, sin = rope
+        # padding entries: page id num_pages / flat slot n_pad are
+        # OUT OF BOUNDS — the fused program's mode="drop" scatters
+        # skip them, keeping every operand bucket-shaped
+        pg = _pad_plan(np.asarray(pages, np.int32), n_pad,
+                       self.num_pages)
+        of = _pad_plan(np.asarray(offs, np.int32), n_pad, 0)
+        y, kp, vp = _fused_step_fn(
+            x._data, wq, wk, wv, wo, biases, cos, sin, positions,
+            pg, of, gather_map, _pad_plan(mr, n_pad, 0),
+            _pad_plan(mc, n_pad, 0), _pad_plan(mflat, n_pad, n_pad),
+            self.k_pages, self.v_pages, tbl, lens, ql,
+            sm_scale=sm_scale, window=window)
+        self.k_pages = kp
+        self.v_pages = vp
+        return Tensor(y)
 
     def dense_kv(self, seq_ids):
         """Dense (dequantized) gather of the listed sequences' pages:

@@ -22,7 +22,9 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor, no_grad
+from ..framework.flags import flag
 from ..incubate.nn import PagedKVCacheManager
+from ..ops.kernels.paged_attention import pad_plan_i32 as _pad_plan
 from ..ops.kernels.rope import apply_rotary_emb, build_rope_cache
 from ..tensor.manipulation import reshape
 
@@ -115,8 +117,10 @@ class PagedLlamaAdapter:
         # attention sub-calls underneath.
         self._dispatch_shapes = set()
         self._kernel_shapes = set()
+        self._bucket_programs = {}   # pad_to -> set of kernel shapes
+        self._fused_ok = None
         self.chunk_stats = {"calls": 0, "packed_tokens": 0,
-                            "padded_tokens": 0}
+                            "padded_tokens": 0, "attend_calls": 0}
 
     @property
     def compile_count(self) -> int:
@@ -124,6 +128,63 @@ class PagedLlamaAdapter:
         dispatch has compiled (<= number of configured buckets in
         steady state)."""
         return len(self._dispatch_shapes)
+
+    @property
+    def attend_program_count(self) -> int:
+        """Distinct paged-attention kernel programs the packed step
+        dispatch has compiled. Unified mode
+        (``FLAGS_ragged_attention=auto|on``) launches ONE ragged
+        program per packed config; the legacy two-kernel routing
+        (``off``) compiles a decode AND a prefill program for every
+        mixed config — the per-bucket doubling ROADMAP item 2
+        removes (bench.py --serving gates on the halving)."""
+        return len(self._kernel_shapes)
+
+    @property
+    def attend_kinds_by_bucket(self) -> dict:
+        """Per dispatch bucket (pad_to): the distinct attend KERNEL
+        KINDS its steps launched — the direct measurement of the
+        ISSUE-13 acceptance 'one attend program per bucket, not two':
+        unified mode records exactly {'ragged'} or {'ragged_fused'}
+        per bucket; the legacy routing records {'decode', 'prefill'}
+        on every mixed bucket."""
+        return {b: sorted({k for k, *_ in shapes})
+                for b, shapes in self._bucket_programs.items()}
+
+    def _fusion_eligible(self) -> bool:
+        """auto-mode fusion gate, computed once per adapter: the
+        fused prologue/epilogue consumes raw [in, out] projection
+        weights and writes fp pages, so every layer's q/k/v/o
+        projection must be a plain (non-distributed, non-weight-
+        quantized) linear and the KV pool must be float — int8 page
+        calibration is a host-driven per-token wave replay. Ineligible
+        adapters keep the unified attend, just unfused."""
+        if self._fused_ok is None:
+            ok = not self.caches[0].quantized \
+                and self.weight_dtype is None
+            if ok:
+                for layer in self.model.model.layers:
+                    att = layer.self_attn
+                    projs = (att.q_proj, att.k_proj, att.v_proj,
+                             att.o_proj)
+                    for proj in projs:
+                        w = getattr(proj, "weight", None)
+                        if (w is None
+                                or getattr(w, "is_distributed", False)
+                                or getattr(getattr(w, "_data", None),
+                                           "ndim", 0) != 2):
+                            ok = False
+                            break
+                    has = [getattr(p, "bias", None) is not None
+                           for p in projs[:3]]
+                    if any(has) and not all(has):
+                        ok = False
+                    if getattr(att.o_proj, "bias", None) is not None:
+                        ok = False  # epilogue models bias-free o_proj
+                    if not ok:
+                        break
+            self._fused_ok = ok
+        return self._fused_ok
 
     # -- scheduler protocol ------------------------------------------------
     def alloc(self, seq_id):
@@ -296,6 +357,29 @@ def _pow2(n: int) -> int:
     return 1 << (max(int(n), 1) - 1).bit_length()
 
 
+def _right_align_plan(row_indices, starts, counts, t_pad, rows_pad):
+    """Host-built gather/scatter plan right-aligning each listed
+    packed row into a (rows_pad, t_pad) block: returns (gm, mr, mc,
+    mflat) — ``gm`` gathers flat packed token indices into the block
+    (row r's last counts[i] columns), and ``mr``/``mc``/``mflat``
+    map the kernel output back to flat packed slots. Shared by the
+    unified dispatch (every row) and the off-mode legacy prefill
+    routing (multi-token rows only), so the two A/B paths can never
+    drift apart on alignment."""
+    gm = np.zeros((rows_pad, t_pad), np.int64)
+    rr, cc, ff = [], [], []
+    for r, i in enumerate(row_indices):
+        c = counts[i]
+        st = starts[i]
+        gm[r, t_pad - c:] = np.arange(st, st + c)
+        for j in range(c):
+            rr.append(r)
+            cc.append(t_pad - c + j)
+            ff.append(st + j)
+    return (jnp.asarray(gm, jnp.int32), jnp.asarray(rr, jnp.int32),
+            jnp.asarray(cc, jnp.int32), jnp.asarray(ff, jnp.int32))
+
+
 def _prefill_chunk(self, token_ids, seq_ids, start_positions=None,
                    pad_to=None):
     """One ragged mixed prefill/decode step (the Ragged Paged
@@ -311,11 +395,19 @@ def _prefill_chunk(self, token_ids, seq_ids, start_positions=None,
     ONE flat packed token axis padded to ``pad_to`` (the scheduler
     buckets it — serving.bucket_packed_tokens — so steady-state
     serving compiles one program per bucket, not per packed length).
-    Attention routes per row kind: single-token rows through the
-    paged DECODE kernel, multi-token rows right-aligned through
-    ``paged_prefill_attention`` (fused int8-KV dequant included),
-    each padded to power-of-two row/length/page-table shapes so the
-    kernel programs are shape-stable too."""
+    Attention is ONE ``cache.attend_ragged`` call per layer for the
+    whole mixed batch (``FLAGS_ragged_attention=auto|on``): every row
+    — single-token decode rows and multi-token chunks alike — rides
+    the unified ragged kernel right-aligned with its own q_lens
+    (fused int8-KV dequant included), padded to power-of-two
+    row/length/page-table shapes so the kernel programs are
+    shape-stable. Where eligible (auto + fp pages + plain projection
+    weights) the whole layer attention step fuses FlashFuser-style:
+    qkv + RoPE + page scatter as the kernel's prologue, o_proj as its
+    epilogue (``cache.fused_ragged_step``). ``off`` restores the
+    historical two-kernel per-row-kind routing bitwise (decode rows
+    via the paged decode kernel, prefill rows via the q_lens-masked
+    prefill kernel)."""
     cfg = self.cfg
     b = len(seq_ids)
     counts = [len(t) for t in token_ids]
@@ -365,47 +457,73 @@ def _prefill_chunk(self, token_ids, seq_ids, start_positions=None,
     last_idx = starts + np.asarray(counts) - 1
     pos = jnp.asarray(pos_np)[None, :]             # (1, N)
 
-    singles = [i for i, c in enumerate(counts) if c == 1]
-    multis = [i for i, c in enumerate(counts) if c > 1]
     self._dispatch_shapes.add(pad_to)
     self.chunk_stats["calls"] += 1
     self.chunk_stats["packed_tokens"] += n_real
     self.chunk_stats["padded_tokens"] += pad_to - n_real
 
-    # gather/scatter plans (host-built once, shared by every layer)
-    if singles:
-        bs = len(singles)
-        bs_pad = _pow2(bs)
-        s_idx = jnp.asarray(
-            np.concatenate([last_idx[singles],
-                            np.zeros(bs_pad - bs, np.int64)]),
-            jnp.int32)
-        s_seqs = [seq_ids[i] for i in singles]
-    if multis:
-        t_pad = _pow2(max(counts[i] for i in multis))
-        bm = len(multis)
-        bm_pad = _pow2(bm)
-        gm = np.zeros((bm_pad, t_pad), np.int64)
-        q_lens = []
-        m_rows = []                               # (row, col) per token
-        m_flat = []                               # flat slot per token
-        for r, i in enumerate(multis):
-            c = counts[i]
-            gm[r, t_pad - c:] = np.arange(starts[i], starts[i] + c)
-            q_lens.append(c)
-            for j in range(c):
-                m_rows.append((r, t_pad - c + j))
-                m_flat.append(starts[i] + j)
-        gm = jnp.asarray(gm, jnp.int32)
-        m_seqs = [seq_ids[i] for i in multis]
-        mr = jnp.asarray([r for r, _ in m_rows], jnp.int32)
-        mc = jnp.asarray([cc for _, cc in m_rows], jnp.int32)
-        m_flat = jnp.asarray(m_flat, jnp.int32)
+    mode = str(flag("ragged_attention"))
+    unified = mode != "off"
     # every layer's cache shares one page size (adapter construction),
     # so the padded page-table width is loop-invariant
     mp_pad = _pow2(max(
         -(-(n + c) // self.caches[0].page_size)
         for n, c in zip(lens0, counts)))
+
+    # gather/scatter plans (host-built once, shared by every layer)
+    s_plan = m_plan = None
+    fuse = False
+    if unified:
+        # ONE right-aligned ragged block for EVERY row: decode rows
+        # are q_lens=1 rows of the same kernel call (the Ragged Paged
+        # Attention shape), so each packed config compiles ONE attend
+        # program instead of a decode/prefill pair
+        t_pad = _pow2(max(counts))
+        b_pad = _pow2(b)
+        gm, mr, mc, m_flat = _right_align_plan(
+            range(b), starts, counts, t_pad, b_pad)
+        fuse = mode == "auto" and self._fusion_eligible()
+        # the fused program embeds the packed dense prologue/epilogue,
+        # so its REAL dispatch key includes the packed bucket (pad_to)
+        # — the pure attend program's does not
+        shape = ("ragged_fused", b_pad, t_pad, mp_pad, pad_to) \
+            if fuse else ("ragged", b_pad, t_pad, mp_pad)
+        self._kernel_shapes.add(shape)
+        self._bucket_programs.setdefault(pad_to, set()).add(shape)
+        pos_flat = jnp.asarray(pos_np)
+        if fuse:
+            # loop-invariant across layers: pad the scatter plan to
+            # the bucket ONCE (out-of-bounds fills drop in the fused
+            # program's scatters) instead of once per layer
+            mr = _pad_plan(mr, pad_to, 0)
+            mc = _pad_plan(mc, pad_to, 0)
+            m_flat = _pad_plan(m_flat, pad_to, pad_to)
+    else:
+        singles = [i for i, c in enumerate(counts) if c == 1]
+        multis = [i for i, c in enumerate(counts) if c > 1]
+        if singles:
+            bs = len(singles)
+            bs_pad = _pow2(bs)
+            s_idx = jnp.asarray(
+                np.concatenate([last_idx[singles],
+                                np.zeros(bs_pad - bs, np.int64)]),
+                jnp.int32)
+            s_seqs = [seq_ids[i] for i in singles]
+            shape = ("decode", bs_pad, 1, mp_pad)
+            self._kernel_shapes.add(shape)
+            self._bucket_programs.setdefault(pad_to, set()).add(shape)
+            s_plan = (s_idx, s_seqs, bs, bs_pad)
+        if multis:
+            t_pad = _pow2(max(counts[i] for i in multis))
+            bm_pad = _pow2(len(multis))
+            gm, mr, mc, m_flat = _right_align_plan(
+                multis, starts, counts, t_pad, bm_pad)
+            q_lens = [counts[i] for i in multis]
+            m_seqs = [seq_ids[i] for i in multis]
+            shape = ("prefill", bm_pad, t_pad, mp_pad)
+            self._kernel_shapes.add(shape)
+            self._bucket_programs.setdefault(pad_to, set()).add(shape)
+            m_plan = (gm, m_seqs, q_lens, bm_pad, mr, mc, m_flat)
 
     with no_grad():
         ids = Tensor(flat[:, None])
@@ -413,6 +531,28 @@ def _prefill_chunk(self, token_ids, seq_ids, start_positions=None,
         for li, layer in enumerate(self.model.model.layers):
             cache = self.caches[li]
             xi = layer.input_layernorm(x)
+            if fuse:
+                # FlashFuser path: qkv + RoPE + page scatter fold
+                # into the ragged kernel's prologue and o_proj into
+                # its epilogue — one program, one dispatch per layer
+                att = layer.self_attn
+                biases = None
+                if att.q_proj.bias is not None:
+                    biases = (att.q_proj.bias._data,
+                              att.k_proj.bias._data,
+                              att.v_proj.bias._data)
+                self.chunk_stats["attend_calls"] += 1
+                y = cache.fused_ragged_step(
+                    xi,
+                    (att.q_proj.weight._data, att.k_proj.weight._data,
+                     att.v_proj.weight._data, att.o_proj.weight._data,
+                     biases),
+                    (self._cos, self._sin), pos_flat, seq_ids, counts,
+                    gm, (mr, mc, m_flat), rows_pad=b_pad,
+                    max_pages=mp_pad, window=self._window)
+                x = x + y
+                x = x + layer.mlp(layer.post_attention_layernorm(x))
+                continue
             q = layer.self_attn.q_proj(xi)
             k = layer.self_attn.k_proj(xi)
             v = layer.self_attn.v_proj(xi)
@@ -426,22 +566,18 @@ def _prefill_chunk(self, token_ids, seq_ids, start_positions=None,
             vh = vh[0]
             cache.append_ragged(
                 seq_ids, counts, kh[:n_real], vh[:n_real])
-            attn = jnp.zeros((pad_to, nh, hd), qh.dtype)
-            if singles:
-                qs = qh[s_idx]                   # (bs_pad, nh, hd)
-                self._kernel_shapes.add(("decode", bs_pad, 1, mp_pad))
-                out = cache.attend_padded(
-                    Tensor(qs), s_seqs, rows_pad=bs_pad,
+            if unified:
+                qm = qh[gm]                  # (b_pad, t_pad, nh, hd)
+                self.chunk_stats["attend_calls"] += 1
+                out = cache.attend_ragged(
+                    Tensor(qm), seq_ids, counts, rows_pad=b_pad,
                     max_pages=mp_pad, window=self._window)
-                attn = attn.at[s_idx[:bs]].set(out._data[:bs])
-            if multis:
-                qm = qh[gm]                      # (bm_pad, t_pad, nh, hd)
-                self._kernel_shapes.add(
-                    ("prefill", bm_pad, t_pad, mp_pad))
-                out = cache.attend_prefill(
-                    Tensor(qm), m_seqs, q_lens, rows_pad=bm_pad,
-                    max_pages=mp_pad, window=self._window)
+                attn = jnp.zeros((pad_to, nh, hd), qh.dtype)
                 attn = attn.at[m_flat].set(out._data[mr, mc])
+            else:
+                attn = self._attend_rows_two_kernel(
+                    cache, qh, jnp.zeros((pad_to, nh, hd), qh.dtype),
+                    s_plan, m_plan, mp_pad)
             attn_flat = Tensor(attn.reshape(pad_to, nh * hd))
             x = x + layer.self_attn.o_proj(attn_flat)
             x = x + layer.mlp(layer.post_attention_layernorm(x))
@@ -450,6 +586,34 @@ def _prefill_chunk(self, token_ids, seq_ids, start_positions=None,
         return self.model._head(h)               # (B, vocab)
 
 
+def _attend_rows_two_kernel(self, cache, qh, attn, s_plan, m_plan,
+                            mp_pad):
+    """``FLAGS_ragged_attention=off``: the historical per-row-kind
+    routing — decode rows through the paged decode kernel, prefill
+    rows right-aligned through the q_lens-masked prefill kernel —
+    kept bitwise for A/B against the unified path. The codebase lint
+    (unified-attention rule) bars NEW two-kernel call sites; this is
+    the one sanctioned legacy body."""
+    if s_plan is not None:
+        s_idx, s_seqs, bs, bs_pad = s_plan
+        qs = qh[s_idx]                       # (bs_pad, nh, hd)
+        self.chunk_stats["attend_calls"] += 1
+        out = cache.attend_padded(  # trace-lint: ok (off-mode legacy two-kernel routing)
+            Tensor(qs), s_seqs, rows_pad=bs_pad,
+            max_pages=mp_pad, window=self._window)
+        attn = attn.at[s_idx[:bs]].set(out._data[:bs])
+    if m_plan is not None:
+        gm, m_seqs, q_lens, bm_pad, mr, mc, m_flat = m_plan
+        qm = qh[gm]                          # (bm_pad, t_pad, nh, hd)
+        self.chunk_stats["attend_calls"] += 1
+        out = cache.attend_prefill(  # trace-lint: ok (off-mode legacy two-kernel routing)
+            Tensor(qm), m_seqs, q_lens, rows_pad=bm_pad,
+            max_pages=mp_pad, window=self._window)
+        attn = attn.at[m_flat].set(out._data[mr, mc])
+    return attn
+
+
 PagedLlamaAdapter.decode_window = _window_logits
 PagedLlamaAdapter.prefill_chunk = _prefill_chunk
-del _window_logits, _prefill_chunk
+PagedLlamaAdapter._attend_rows_two_kernel = _attend_rows_two_kernel
+del _window_logits, _prefill_chunk, _attend_rows_two_kernel

@@ -1410,6 +1410,15 @@ class BatchScheduler:
                 m.gauge("serving.compile_count", cc)
                 m.gauge("serving.compile_count." + self._sched_uid,
                         cc)
+            apc = getattr(self.model, "attend_program_count", None)
+            if apc is not None:
+                # distinct attend kernel programs (ONE per packed
+                # config under FLAGS_ragged_attention=auto|on, a
+                # decode/prefill pair per mixed config under off) —
+                # same per-scheduler namespacing as compile_count
+                m.gauge("serving.attend_programs", apc)
+                m.gauge("serving.attend_programs." + self._sched_uid,
+                        apc)
             # stride on THIS scheduler's own step count: with two
             # schedulers interleaving, the shared epoch advances by 2
             # per iteration and `epoch % stride` could starve one of
@@ -1809,6 +1818,8 @@ class BatchScheduler:
             "chunk_utilization": round(packed / pad_to, 4),
             "compile_count": getattr(self.model, "compile_count",
                                      None),
+            "attend_programs": getattr(
+                self.model, "attend_program_count", None),
         }
 
     def _step_spec(self, admitted) -> dict:

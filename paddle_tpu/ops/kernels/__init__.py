@@ -59,6 +59,9 @@ from .paged_attention import (  # noqa
     paged_attention,
     paged_attention_reference,
     paged_prefill_attention,
+    paged_ragged_attention,
+    paged_ragged_attention_reference,
+    paged_ragged_fused_step,
 )
 from .collective_matmul import (  # noqa
     all_gather_matmul,

@@ -1,4 +1,4 @@
-"""Paged KV-cache decode attention — Pallas TPU kernel.
+"""Paged KV-cache attention — one unified ragged Pallas TPU kernel.
 
 Upstream analogs: paddle/fluid/operators/fused/fused_multi_transformer
 _op.cu's cache-KV decode path and the block-attention kernels the
@@ -8,23 +8,42 @@ TPU paged-attention recipe ("Ragged Paged Attention" — see PAPERS.md):
 * the KV cache lives in HBM as fixed-size pages
   ``(num_pages, page_size, kv_heads, head_dim)``;
 * a per-sequence ``page_table (B, max_pages)`` maps logical pages to
-  physical ones; ``seq_lens (B,)`` bounds the ragged lengths;
+  physical ones; ``seq_lens (B,)`` bounds the ragged KV lengths and a
+  per-row ``q_lens (B,)`` bounds the ragged QUERY lengths — 1 for
+  decode rows, n for prefill chunks, so one kernel handles a mixed
+  packed batch uniformly (:func:`paged_ragged_attention`);
 * the kernel grid is (batch, q_heads, logical_pages); the page table
-  rides scalar prefetch so each step's BlockSpec index_map can DMA the
-  right physical page while the previous one computes;
+  and both length vectors ride scalar prefetch so each step's
+  BlockSpec index_map can DMA the right physical page while the
+  previous one computes;
 * online softmax (m, l, acc) accumulates in VMEM scratch across the
-  page loop — one decode token per sequence (q: (B, H, D)).
+  page loop, rows right-aligned (row i's last q_lens[i] rows are its
+  newest tokens; padded leading rows return exact zeros).
 
 GQA maps q-head h to kv-head h // (H // KVH) in the index maps — no KV
-replication in HBM. Off-TPU (tests) the same kernel runs in pallas
-interpret mode against a dense reference.
+replication in HBM. Int8 pages dequantize in VMEM right after the page
+DMA (per-page per-head scale sidecars ride scalar prefetch). Off-TPU
+(tests) the same kernel runs in pallas interpret mode against a dense
+reference.
+
+FlashFuser-style fusion (:func:`paged_ragged_fused_step`): once the
+attention path is ONE program, the packed dense neighbours fold into
+it — qkv projection + RoPE + the K/V page scatter run as the kernel's
+prologue and o_proj as its epilogue, inside the same compiled program,
+so a serving layer step is a single dispatch instead of five.
+
+``FLAGS_ragged_attention`` gates the dispatch: ``auto``/``on`` route
+the legacy decode entry through the ragged kernel at T=1; ``off``
+restores the historical dedicated decode kernel bitwise (and the
+serving adapter's two-kernel row routing with it).
 
 Dispatch caching: eager callers (the serving step loop, tests) hit a
 shape-keyed LRU of ``jax.jit``-ted entry points, so stepping the same
 shapes never re-traces the pallas call — the historical per-call
-build cost was pure trace/compile overhead. Callers already under an
-outer trace (``to_static``) inline the identical lowering; the
-surrounding program owns compilation and caching there.
+build cost was pure trace/compile overhead. The unified kernel keys
+ONE cache for every row kind (no decode/prefill split). Callers
+already under an outer trace (``to_static``) inline the identical
+lowering; the surrounding program owns compilation and caching there.
 """
 from __future__ import annotations
 
@@ -35,11 +54,17 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ...framework.flags import flag
+from .rope import apply_rotary_emb
+
 NEG_INF = -1e30
 
 
 def _decode_kernel(scale, page_size, kvh_per_q, max_pages, window,
                    quant, *refs):
+    """Legacy dedicated decode kernel — the FLAGS_ragged_attention=off
+    lowering. The unified :func:`_ragged_kernel` at T=1 supersedes it;
+    kept verbatim so ``off`` restores the historical program bitwise."""
     if quant:
         # int8 pages: per-page, per-head scale sidecars ride scalar
         # prefetch; dequant happens in VMEM right after the page DMA
@@ -109,8 +134,8 @@ def _decode_kernel(scale, page_size, kvh_per_q, max_pages, window,
 
 def _build_decode_call(b, h, d, npages, page_size, kvh, max_pages,
                        scale, window, quant, interpret):
-    """The decode pallas dispatch as a pure function of the static
-    config: returns ``run(q, k_pages, v_pages, *scalar_args)``.
+    """The legacy decode pallas dispatch as a pure function of the
+    static config: returns ``run(q, k_pages, v_pages, *scalar_args)``.
     Traced callers inline it (identical to the historical lowering);
     eager callers go through :func:`_jitted_decode_call`'s cached
     ``jax.jit`` of the same body, so a serving loop stepping the same
@@ -178,7 +203,9 @@ def _jitted_decode_call(cfg):
 def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
                     sm_scale=None, interpret=None, window=0,
                     k_scales=None, v_scales=None):
-    """q: (B, H, D); k_pages/v_pages: (NP, P, KVH, D);
+    """Decode attend over a paged KV cache — one token per sequence.
+
+    q: (B, H, D); k_pages/v_pages: (NP, P, KVH, D);
     page_table: (B, max_pages) int32 physical-page ids;
     seq_lens: (B,) int32. ``window`` > 0 keeps only the last
     ``window`` keys (Mistral sliding attention; out-of-window pages
@@ -188,8 +215,21 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
     scale sidecars k_scales/v_scales (NP, KVH) f32 — the pages DMA as
     int8 (half the HBM traffic) and dequantize in VMEM inside the
     kernel, scales riding scalar prefetch.
+
+    .. deprecated:: this is now a thin T=1 wrapper over the unified
+       :func:`paged_ragged_attention` kernel (one compiled program per
+       packed config serves decode AND prefill rows). Under
+       ``FLAGS_ragged_attention=off`` the historical dedicated decode
+       kernel lowers bitwise instead.
     """
     b, h, d = q.shape
+    if str(flag("ragged_attention")) != "off":
+        out = paged_ragged_attention(
+            q[:, None], k_pages, v_pages, page_table, seq_lens,
+            q_lens=jnp.ones((b,), jnp.int32), sm_scale=sm_scale,
+            interpret=interpret, window=window, k_scales=k_scales,
+            v_scales=v_scales)
+        return out[:, 0]
     npages, page_size, kvh, _ = k_pages.shape
     max_pages = page_table.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
@@ -222,7 +262,7 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
 def paged_attention_reference(q, k_pages, v_pages, page_table,
                               seq_lens, sm_scale=None, window=0,
                               k_scales=None, v_scales=None):
-    """Dense float32 reference for tests."""
+    """Dense float32 decode reference for tests."""
     import numpy as np
 
     b, h, d = q.shape
@@ -259,18 +299,64 @@ def paged_attention_reference(q, k_pages, v_pages, page_table,
     return out
 
 
-def _prefill_kernel(scale, page_size, group, max_pages, t, window,
-                    quant, ragged, *refs):
-    """Chunked-prefill: T new tokens per sequence attend causally to
-    the whole paged prefix (the new tokens' K/V already live in the
+def paged_ragged_attention_reference(q, k_pages, v_pages, page_table,
+                                     seq_lens, q_lens=None,
+                                     sm_scale=None, window=0,
+                                     k_scales=None, v_scales=None):
+    """Dense float32 reference for the unified ragged kernel: q is
+    (B, T, H, D) right-aligned (row i's last q_lens[i] rows are real;
+    padded leading rows return exact zeros). ``q_lens=None`` treats
+    every row as real. Returns (B, T, H, D) float32."""
+    import numpy as np
+
+    b, t, h, d = q.shape
+    npages, page_size, kvh, _ = k_pages.shape
+    group = h // kvh
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    qn = np.asarray(q, np.float32)
+    kn = np.asarray(k_pages, np.float32)
+    vn = np.asarray(v_pages, np.float32)
+    if k_scales is not None:
+        kn = kn * np.asarray(k_scales, np.float32)[:, None, :, None]
+        vn = vn * np.asarray(v_scales, np.float32)[:, None, :, None]
+    tbl = np.asarray(page_table)
+    lens = np.asarray(seq_lens)
+    ql = np.full((b,), t) if q_lens is None else np.asarray(q_lens)
+    out = np.zeros((b, t, h, d), np.float32)
+    for i in range(b):
+        L = int(lens[i])
+        if not L:
+            continue
+        n_used = -(-L // page_size)
+        ks = np.concatenate(
+            [kn[tbl[i, p]] for p in range(n_used)], axis=0)[:L]
+        vs = np.concatenate(
+            [vn[tbl[i, p]] for p in range(n_used)], axis=0)[:L]
+        for r in range(t - int(ql[i]), t):
+            qpos = L - t + r
+            lo = max(0, qpos - window + 1) if window else 0
+            for j in range(h):
+                kj = ks[lo:qpos + 1, j // group]
+                vj = vs[lo:qpos + 1, j // group]
+                s = kj @ qn[i, r, j] * scale
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[i, r, j] = p @ vj
+    return out
+
+
+def _ragged_kernel(scale, page_size, group, max_pages, t, window,
+                   quant, ragged, *refs):
+    """THE unified kernel: T tokens per row attend causally to the
+    whole paged prefix (the new tokens' K/V already live in the
     pages; seq_lens counts them). ``window`` > 0 bands the mask
     (0 <= qpos - kpos < window) and skips pages below every row's
     window. ``quant``: int8 pages dequantized in VMEM via the
     scalar-prefetched per-page scale sidecars. ``ragged``: a
     scalar-prefetched q_lens vector marks how many TRAILING rows of
-    each sequence's T-row block are real new tokens (mixed
-    prefill/decode batches right-align shorter chunks); the padded
-    leading rows produce exact zeros."""
+    each sequence's T-row block are real new tokens — 1 for decode
+    rows, n for prefill chunks, so one program serves a mixed packed
+    batch; the padded leading rows produce exact zeros."""
     refs = list(refs)
     page_tbl_ref = refs.pop(0)
     lens_ref = refs.pop(0)
@@ -356,19 +442,20 @@ def _prefill_kernel(scale, page_size, group, max_pages, t, window,
         o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
-def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
-                            sm_scale=None, interpret=None, window=0,
-                            k_scales=None, v_scales=None, q_lens=None):
-    """Ragged chunked-prefill over a paged KV cache.
+def paged_ragged_attention(q, k_pages, v_pages, page_table, seq_lens,
+                           q_lens=None, sm_scale=None, interpret=None,
+                           window=0, k_scales=None, v_scales=None):
+    """The unified ragged paged-attention entry (PAPERS.md: Ragged
+    Paged Attention) — ONE kernel for decode rows and prefill chunks.
 
-    q: (B, T, H, D) — the T newest tokens of each sequence, whose K/V
-    have already been appended to the pages; seq_lens counts them.
-    ``q_lens`` (B,) optionally marks how many TRAILING rows of each
-    sequence are real new tokens (a ragged batch right-aligns chunks
-    shorter than T); the padded leading rows return exact zeros.
-    Without q_lens every row is treated as real (positions follow
-    seq_len) and short rows must be masked by the caller. Returns
-    (B, T, H, D). Int8 pages: pass k_scales/v_scales (NP, KVH) as in
+    q: (B, T, H, D) — each row's newest tokens RIGHT-ALIGNED, whose
+    K/V have already been appended to the pages; seq_lens counts them.
+    ``q_lens`` (B,) marks how many TRAILING rows of each sequence are
+    real new tokens: 1 for a decode row, n for an n-token prefill
+    chunk; the padded leading rows return exact zeros. Without q_lens
+    every row is treated as real (positions follow seq_len) and short
+    rows must be masked by the caller. Returns (B, T, H, D). Int8
+    pages: pass k_scales/v_scales (NP, KVH) as in
     :func:`paged_attention`.
     """
     b, t, h, d = q.shape
@@ -378,7 +465,7 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
     quant = k_scales is not None
     if quant != (v_scales is not None):
         raise ValueError(
-            "paged_prefill_attention: pass both k_scales and v_scales "
+            "paged_ragged_attention: pass both k_scales and v_scales "
             "or neither")
 
     if interpret is None:
@@ -397,13 +484,30 @@ def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
            bool(interpret))
     args = (q, k_pages, v_pages, *scalar_args)
     if any(isinstance(x, jax.core.Tracer) for x in args):
-        return _build_prefill_call(*cfg)(*args)
-    return _jitted_prefill_call(cfg)(*args)
+        return _build_ragged_call(*cfg)(*args)
+    return _jitted_ragged_call(cfg)(*args)
 
 
-def _build_prefill_call(b, t, h, d, npages, page_size, kvh, max_pages,
-                        scale, window, quant, ragged, interpret):
-    """The chunked-prefill pallas dispatch as a pure function of the
+def paged_prefill_attention(q, k_pages, v_pages, page_table, seq_lens,
+                            sm_scale=None, interpret=None, window=0,
+                            k_scales=None, v_scales=None, q_lens=None):
+    """Ragged chunked-prefill over a paged KV cache.
+
+    .. deprecated:: alias of :func:`paged_ragged_attention` — the
+       q_lens-masked prefill kernel WAS the unified ragged kernel all
+       along; this name is kept for existing callers and compiles the
+       identical program (there is no separate prefill lowering to
+       restore under ``FLAGS_ragged_attention=off``).
+    """
+    return paged_ragged_attention(
+        q, k_pages, v_pages, page_table, seq_lens, q_lens=q_lens,
+        sm_scale=sm_scale, interpret=interpret, window=window,
+        k_scales=k_scales, v_scales=v_scales)
+
+
+def _build_ragged_call(b, t, h, d, npages, page_size, kvh, max_pages,
+                       scale, window, quant, ragged, interpret):
+    """The unified ragged pallas dispatch as a pure function of the
     static config — same inline-under-trace / cached-jit-when-eager
     split as :func:`_build_decode_call`."""
     from jax.experimental.pallas import tpu as pltpu
@@ -433,7 +537,7 @@ def _build_prefill_call(b, t, h, d, npages, page_size, kvh, max_pages,
         ],
     )
     kernel = functools.partial(
-        _prefill_kernel, scale, page_size, group, max_pages, t,
+        _ragged_kernel, scale, page_size, group, max_pages, t,
         window, quant, ragged,
     )
 
@@ -464,5 +568,150 @@ def _build_prefill_call(b, t, h, d, npages, page_size, kvh, max_pages,
 
 
 @functools.lru_cache(maxsize=512)
-def _jitted_prefill_call(cfg):
-    return jax.jit(_build_prefill_call(*cfg))
+def _jitted_ragged_call(cfg):
+    """ONE shape-keyed dispatch cache for every row kind — decode
+    (T=1), prefill, and mixed ragged batches share it, so warm serving
+    never splits compile work per row kind and compiled programs are
+    shared across pool instances."""
+    return jax.jit(_build_ragged_call(*cfg))
+
+
+def _build_fused_call(n_pad, e, nh, kvh, hd, npages,
+                      page_size, b_pad, t_pad, max_pages, scale,
+                      window, has_bias, interpret):
+    """FlashFuser-style fused packed attention step: qkv projection +
+    RoPE + the K/V page scatter as the ragged kernel's PROLOGUE and
+    o_proj as its EPILOGUE, one compiled program per packed config.
+
+    Operands (all arrays; statics live in the cfg key — every operand
+    is padded to the BUCKETED shapes, so the per-step real-token
+    count never re-keys the dispatch cache):
+
+    * ``x`` (n_pad, e) — the normed packed hidden states;
+    * ``wq/wk/wv`` (e, nh*hd / kvh*hd) and ``wo`` (nh*hd, e) — the
+      layer's projection weights ([in, out] paddle layout); optional
+      q/k/v biases when ``has_bias``;
+    * ``cos/sin`` (S, hd) RoPE tables, ``pos`` (n_pad,) per-token
+      absolute positions;
+    * ``pg/of`` (n_pad,) physical page / in-page slot per written
+      token; PADDING entries carry an out-of-bounds page id and the
+      scatter runs mode="drop", so they write nothing;
+    * ``gm`` (b_pad, t_pad) flat-index gather map right-aligning each
+      row's tokens, ``mr/mc/mflat`` (n_pad,) the inverse scatter
+      (padding entries gather slot (0, 0) and drop on an
+      out-of-bounds ``mflat``);
+    * ``k_pages/v_pages`` + ``tbl/lens/q_lens`` as in
+      :func:`paged_ragged_attention`.
+
+    Returns ``(y (n_pad, e), new_k_pages, new_v_pages)`` — the caller
+    (the pool, which owns page state) commits the returned pages.
+    """
+    attend = _build_ragged_call(
+        b_pad, t_pad, nh, hd, npages, page_size, kvh, max_pages,
+        scale, window, False, True, interpret)
+
+    def run(x, wq, wk, wv, wo, *rest):
+        rest = list(rest)
+        bq = bk = bv = None
+        if has_bias:
+            bq, bk, bv = rest[:3]
+            rest = rest[3:]
+        (cos, sin, pos, pg, of, gm, mr, mc, mflat,
+         k_pages, v_pages, tbl, lens, q_lens) = rest
+        # -- prologue: qkv projection + RoPE (same jnp.matmul as
+        # F.linear, so the fused program is numerically identical to
+        # the eager layer path)
+        xq = jnp.matmul(x, wq)
+        xk = jnp.matmul(x, wk)
+        xv = jnp.matmul(x, wv)
+        if has_bias:
+            xq, xk, xv = xq + bq, xk + bk, xv + bv
+        qh = xq.reshape(1, n_pad, nh, hd)
+        kh = xk.reshape(1, n_pad, kvh, hd)
+        vh = xv.reshape(n_pad, kvh, hd)
+        qh = apply_rotary_emb(qh, cos, sin, position_ids=pos)[0]
+        kh = apply_rotary_emb(kh, cos, sin, position_ids=pos)[0]
+        # -- prologue: land this chunk's K/V in the pages (the pool
+        # computed the slot plan; the scatter itself fuses here —
+        # padding rows carry out-of-bounds page ids and drop)
+        kp = k_pages.at[pg, of].set(
+            kh.astype(k_pages.dtype), mode="drop")
+        vp = v_pages.at[pg, of].set(
+            vh.astype(v_pages.dtype), mode="drop")
+        # -- the unified ragged kernel over the right-aligned rows
+        qm = qh[gm]                        # (b_pad, t_pad, nh, hd)
+        out = attend(qm, kp, vp, tbl, lens, q_lens)
+        # -- epilogue: scatter back to the packed axis + o_proj
+        # (padding entries target the out-of-bounds slot n_pad: drop)
+        attn = jnp.zeros((n_pad, nh, hd), qh.dtype)
+        attn = attn.at[mflat].set(out[mr, mc], mode="drop")
+        y = jnp.matmul(attn.reshape(n_pad, nh * hd), wo)
+        return y, kp, vp
+
+    return run
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_fused_call(cfg):
+    return jax.jit(_build_fused_call(*cfg))
+
+
+def pad_plan_i32(a, n, fill):
+    """Pad a 1-D int32 plan operand of :func:`paged_ragged_fused_step`
+    to ``n`` entries with ``fill`` — the single place the fused
+    program's out-of-bounds drop-entry contract is encoded for both
+    the adapter-side scatter plan (fill = packed length) and the
+    pool-side page plan (fill = num_pages)."""
+    a = jnp.asarray(a, jnp.int32)
+    short = n - a.shape[0]
+    if short <= 0:
+        return a
+    return jnp.concatenate(
+        [a, jnp.full((short,), fill, jnp.int32)])
+
+
+def paged_ragged_fused_step(x, wq, wk, wv, wo, biases, cos, sin, pos,
+                            pg, of, gm, mr, mc, mflat, k_pages,
+                            v_pages, page_table, seq_lens, q_lens,
+                            sm_scale=None, window=0,
+                            interpret=None):
+    """One fused packed attention layer step (see
+    :func:`_build_fused_call` for the operand contract: pg/of and
+    mr/mc/mflat arrive PADDED to the bucketed packed length, with
+    padding entries out-of-bounds so the mode="drop" scatters skip
+    them — the dispatch cache keys only bucketed shapes, never the
+    per-step real-token count). ``biases`` is ``None`` or the
+    (bq, bk, bv) triple. Float KV pages only — int8 calibration is a
+    host-driven wave replay the fused program cannot express (callers
+    fall back to the unfused unified path).
+
+    Returns ``(y, new_k_pages, new_v_pages)``; the page-pool owner
+    commits the returned page arrays.
+    """
+    n_pad, e = x.shape
+    hd = cos.shape[1]
+    nh = wq.shape[1] // hd
+    kvh = wk.shape[1] // hd
+    npages, page_size, _, _ = k_pages.shape
+    b_pad, t_pad = gm.shape
+    max_pages = page_table.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    has_bias = biases is not None
+    cfg = (n_pad, e, nh, kvh, hd, npages, page_size,
+           b_pad, t_pad, max_pages, float(scale), int(window or 0),
+           has_bias, bool(interpret))
+    args = [x, wq, wk, wv, wo]
+    if has_bias:
+        args += list(biases)
+    args += [cos, sin, jnp.asarray(pos, jnp.int32),
+             jnp.asarray(pg, jnp.int32), jnp.asarray(of, jnp.int32),
+             jnp.asarray(gm, jnp.int32), jnp.asarray(mr, jnp.int32),
+             jnp.asarray(mc, jnp.int32), jnp.asarray(mflat, jnp.int32),
+             k_pages, v_pages, page_table.astype(jnp.int32),
+             seq_lens.astype(jnp.int32),
+             jnp.asarray(q_lens).astype(jnp.int32)]
+    if any(isinstance(a, jax.core.Tracer) for a in args):
+        return _build_fused_call(*cfg)(*args)
+    return _jitted_fused_call(cfg)(*args)

@@ -914,3 +914,106 @@ class TestFlagInventory:
     def test_rule_inventory_has_flag_rule(self):
         ids = [r for r, _ in lint_codebase.RULES]
         assert "flag-inventory" in ids
+
+
+class TestUnifiedAttention:
+    """ISSUE-13 satellite: packed-step attention in the serving
+    layers routes through the single attend_ragged/fused_ragged_step
+    pool API — no function may re-grow the legacy attend_padded +
+    attend_prefill kernel pair, and a ragged append's function must
+    attend through the unified entry in the same scope."""
+
+    def test_seeded_two_kernel_pair_flagged(self):
+        bad = (
+            "class Adapter:\n"
+            "    def step(self, cache, q):\n"
+            "        a = cache.attend_padded(q, self.sids)\n"
+            "        b = cache.attend_prefill(q, self.sids, [2])\n"
+            "        return a, b\n"
+        )
+        v = lint_codebase.lint_unified_attention_file(
+            "fake/paged_llama.py", text=bad)
+        assert len(v) == 1, v
+        assert "attend_padded" in v[0] and "attend_prefill" in v[0]
+        assert "attend_ragged" in v[0]
+
+    def test_single_kind_call_is_clean(self):
+        # one kernel kind alone is a thin-wrapper caller (tests,
+        # decode-only paths) — only the PAIR is the two-kernel routing
+        ok = (
+            "def decode(cache, q, sids):\n"
+            "    return cache.attend_padded(q, sids)\n"
+            "def prefill(cache, q, sids):\n"
+            "    return cache.attend_prefill(q, sids, [4])\n"
+        )
+        assert lint_codebase.lint_unified_attention_file(
+            "fake/serving.py", text=ok) == []
+
+    def test_pair_waiver_suppresses(self):
+        waived = (
+            "def legacy(cache, q, sids):\n"
+            "    a = cache.attend_padded(q, sids)"
+            "  # trace-lint: ok(off-mode legacy)\n"
+            "    b = cache.attend_prefill(q, sids, [2])\n"
+            "    return a, b\n"
+        )
+        assert lint_codebase.lint_unified_attention_file(
+            "fake/paged_llama.py", text=waived) == []
+
+    def test_seeded_ragged_append_without_unified_attend(self):
+        bad = (
+            "def chunk(cache, sids, counts, kh, vh, q):\n"
+            "    cache.append_ragged(sids, counts, kh, vh)\n"
+            "    return cache.attend_padded(q, sids)\n"
+        )
+        v = lint_codebase.lint_unified_attention_file(
+            "fake/paged_llama.py", text=bad)
+        assert len(v) == 1, v
+        assert "append_ragged" in v[0]
+
+    def test_ragged_append_with_unified_attend_clean(self):
+        ok = (
+            "def chunk(cache, sids, counts, kh, vh, q):\n"
+            "    cache.append_ragged(sids, counts, kh, vh)\n"
+            "    return cache.attend_ragged(q, sids, counts)\n"
+        )
+        assert lint_codebase.lint_unified_attention_file(
+            "fake/paged_llama.py", text=ok) == []
+
+    def test_fused_step_counts_as_unified(self):
+        ok = (
+            "def chunk(cache, x, w, sids, counts):\n"
+            "    cache.append_ragged(sids, counts, x, x)\n"
+            "    return cache.fused_ragged_step(x, w, sids, counts)\n"
+        )
+        assert lint_codebase.lint_unified_attention_file(
+            "fake/paged_llama.py", text=ok) == []
+
+    def test_nested_scope_does_not_sanction(self):
+        # the unified call must be in the SAME scope as the append —
+        # a nested def that never runs cannot sanction the site
+        bad = (
+            "def chunk(cache, sids, counts, kh, vh):\n"
+            "    def unused(q):\n"
+            "        return cache.attend_ragged(q, sids, counts)\n"
+            "    cache.append_ragged(sids, counts, kh, vh)\n"
+        )
+        v = lint_codebase.lint_unified_attention_file(
+            "fake/paged_llama.py", text=bad)
+        assert len(v) == 1, v
+
+    def test_serving_layers_covered_and_clean(self):
+        covered = [os.path.join(REPO, f)
+                   for f in lint_codebase.UNIFIED_ATTENTION_FILES]
+        assert any(p.endswith(os.path.join("inference", "serving.py"))
+                   for p in covered)
+        assert any(p.endswith(os.path.join("inference",
+                                           "paged_llama.py"))
+                   for p in covered)
+        for p in covered:
+            assert os.path.exists(p), p
+        assert lint_codebase.check_unified_attention() == []
+
+    def test_rule_inventory_has_unified_attention(self):
+        ids = [r for r, _ in lint_codebase.RULES]
+        assert "unified-attention" in ids

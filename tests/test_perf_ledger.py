@@ -682,12 +682,24 @@ class TestFlightRecorder:
                        if n.startswith("incident-")
                        and not n.endswith(".tmp")]
             assert bundles, "watchdog fired but no bundle landed"
-            manifest = json.loads(open(os.path.join(
-                tmp_path, bundles[0], "manifest.json")).read())
-            assert "recompile-storm" in manifest["classes"]
+            # under ambient load a wall-clock detector (decode-stall)
+            # can fire first and land its own bundle; the storm trip
+            # must be named by SOME bundle, in no particular
+            # listdir order
+            classes = set()
+            storm_bundle = None
+            for b in bundles:
+                manifest = json.loads(open(os.path.join(
+                    tmp_path, b, "manifest.json")).read())
+                classes.update(manifest["classes"])
+                if "recompile-storm" in manifest["classes"]:
+                    storm_bundle = b
+            assert "recompile-storm" in classes, classes
             # the scheduler's exec stamps made the ledger non-empty
+            # (read from the STORM bundle — an earlier wall-clock
+            # trip's bundle may predate the first exec stamp)
             led = json.loads(open(os.path.join(
-                tmp_path, bundles[0], "ledger.json")).read())
+                tmp_path, storm_bundle, "ledger.json")).read())
             assert "prefill_chunk" in led
         finally:
             set_flags({"telemetry_incident_dir": "",

@@ -1,0 +1,556 @@
+"""Unified ragged paged-attention kernel (ISSUE 13, ROADMAP item 2).
+
+One Pallas kernel serves every packed row kind — single-token decode
+rows and multi-token prefill chunks alike carry their own q_lens and
+ride right-aligned through ONE program per packed config, replacing
+the decode/prefill kernel pair. The acceptance matrix here: kernel
+parity vs the dense reference for decode-only / prefill-only / mixed
+batches x kv {float32, int8} x window on/off, the pool's
+attend_ragged vs the legacy pair, warm LRU-dispatch reuse across pool
+instances, the FlashFuser-fused prologue/epilogue (qkv + RoPE + page
+scatter in, o_proj out), end-to-end scheduler greedy identity across
+FLAGS_ragged_attention={off,on,auto} x prefix on/off, and the attend
+program count bound (one program per config, not two).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import PagedKVCacheManager
+from paddle_tpu.inference import (
+    BatchScheduler,
+    PagedLlamaAdapter,
+    Request,
+)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.ops.kernels.paged_attention import (
+    _jitted_ragged_call,
+    paged_attention,
+    paged_ragged_attention,
+    paged_ragged_attention_reference,
+)
+
+PAGE = 4
+_slow = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def _auto_mode():
+    """Every test starts from the default unified dispatch."""
+    paddle.set_flags({"ragged_attention": "auto"})
+    yield
+    paddle.set_flags({"ragged_attention": "auto"})
+
+
+def _pages(rng, NP, P, KVH, D, quant=False):
+    if quant:
+        kp = rng.randint(-127, 128, (NP, P, KVH, D)).astype(np.int8)
+        vp = rng.randint(-127, 128, (NP, P, KVH, D)).astype(np.int8)
+        ks = rng.rand(NP, KVH).astype("float32") * 0.1 + 1e-3
+        vs = rng.rand(NP, KVH).astype("float32") * 0.1 + 1e-3
+        return (jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(ks), jnp.asarray(vs))
+    kp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+    vp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+    return kp, vp, None, None
+
+
+class TestUnifiedKernelParity:
+    """paged_ragged_attention vs the dense reference over the full
+    row-kind matrix — the tentpole's correctness core."""
+
+    def _run(self, lens, q_lens, T, quant=False, window=0, H=4,
+             KVH=2, D=32, seed=0):
+        rng = np.random.RandomState(seed)
+        B = len(lens)
+        P = PAGE
+        MAXP = max(-(-max(lens) // P), 1)
+        NP = B * MAXP + 4
+        kp, vp, ks, vs = _pages(rng, NP, P, KVH, D, quant)
+        tbl = jnp.asarray(
+            rng.permutation(NP)[:B * MAXP].reshape(B, MAXP), jnp.int32)
+        ln = jnp.asarray(lens, jnp.int32)
+        ql = jnp.asarray(q_lens, jnp.int32)
+        q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+        out = paged_ragged_attention(
+            q, kp, vp, tbl, ln, q_lens=ql, window=window,
+            k_scales=ks, v_scales=vs)
+        ref = paged_ragged_attention_reference(
+            q, kp, vp, tbl, ln, q_lens=ql, window=window,
+            k_scales=ks, v_scales=vs)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4,
+                                   rtol=2e-4)
+        return np.asarray(out)
+
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_decode_only_rows(self, quant):
+        # every row q_lens=1 at T=1: the decode shape through the
+        # unified kernel
+        self._run(lens=(9, 17, 4), q_lens=(1, 1, 1), T=1, quant=quant)
+
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_prefill_only_rows(self, quant):
+        self._run(lens=(11, 7), q_lens=(4, 3), T=4, quant=quant)
+
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_mixed_decode_and_prefill_rows(self, quant):
+        # the chunked-serving shape: decode rows (q_lens=1) and
+        # prefill chunks share one call, right-aligned
+        out = self._run(lens=(13, 9, 6, 21), q_lens=(1, 4, 2, 1),
+                        T=4, quant=quant)
+        # padded leading rows are exact zeros
+        np.testing.assert_array_equal(out[0, :3], 0.0)
+        np.testing.assert_array_equal(out[2, :2], 0.0)
+
+    @pytest.mark.parametrize("window", [3, PAGE, 7])
+    def test_windowed_mixed_rows(self, window):
+        self._run(lens=(13, 9, 21), q_lens=(1, 3, 2), T=4,
+                  window=window)
+
+    @_slow
+    @pytest.mark.parametrize("quant", [False, True])
+    @pytest.mark.parametrize("window", [0, 5])
+    def test_full_matrix_gqa(self, quant, window):
+        self._run(lens=(19, 8, 26, 5), q_lens=(1, 3, 4, 2), T=4,
+                  quant=quant, window=window, H=8, KVH=2, seed=3)
+
+    def test_padding_rows_inert(self):
+        # a seq_len=0 padding row (the bucketed dispatch's filler)
+        # returns exact zeros without poisoning the softmax state
+        out = self._run(lens=(9, 0), q_lens=(2, 1), T=2)
+        np.testing.assert_array_equal(out[1], 0.0)
+
+
+class TestThinWrappers:
+    """Satellite: the legacy entries stay as thin wrappers — decode
+    routes through the unified kernel at T=1 under auto/on, and off
+    restores the dedicated decode kernel lowering bitwise."""
+
+    def _case(self, seed=0):
+        rng = np.random.RandomState(seed)
+        B, H, KVH, D, NP, P, MAXP = 2, 4, 2, 32, 8, 8, 3
+        q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+        kp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+        tbl = jnp.asarray(
+            rng.permutation(NP)[:B * MAXP].reshape(B, MAXP),
+            jnp.int32)
+        lens = jnp.asarray([20, 9], jnp.int32)
+        return q, kp, vp, tbl, lens
+
+    def test_decode_wrapper_matches_legacy_kernel(self):
+        q, kp, vp, tbl, lens = self._case()
+        out = paged_attention(q, kp, vp, tbl, lens)   # unified T=1
+        paddle.set_flags({"ragged_attention": "off"})
+        legacy = paged_attention(q, kp, vp, tbl, lens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(legacy),
+                                   atol=1e-6)
+
+    def test_off_restores_decode_lowering_bitwise(self):
+        # under off the public wrapper lowers EXACTLY the historical
+        # dedicated decode program (jaxpr-identical to the builder)
+        from paddle_tpu.ops.kernels.paged_attention import (
+            _build_decode_call,
+        )
+
+        q, kp, vp, tbl, lens = self._case()
+        paddle.set_flags({"ragged_attention": "off"})
+        b, h, d = q.shape
+        npages, P, kvh, _ = kp.shape
+        import math
+
+        cfg = (b, h, d, npages, P, kvh, tbl.shape[1],
+               1.0 / math.sqrt(d), 0, False, True)
+        wrapped = jax.make_jaxpr(
+            lambda *a: paged_attention(*a, interpret=True))(
+            q, kp, vp, tbl, lens)
+        direct = jax.make_jaxpr(_build_decode_call(*cfg))(
+            q, kp, vp, tbl, lens)
+        assert str(wrapped) == str(direct)
+
+    def test_prefill_wrapper_is_unified_alias(self):
+        rng = np.random.RandomState(1)
+        from paddle_tpu.ops.kernels import paged_prefill_attention
+
+        B, T, H, KVH, D, NP, P, MAXP = 2, 3, 4, 2, 32, 8, 8, 3
+        q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+        kp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(NP, P, KVH, D), jnp.float32)
+        tbl = jnp.asarray(
+            rng.permutation(NP)[:B * MAXP].reshape(B, MAXP),
+            jnp.int32)
+        lens = jnp.asarray([14, 9], jnp.int32)
+        ql = jnp.asarray([3, 2], jnp.int32)
+        a = paged_prefill_attention(q, kp, vp, tbl, lens, q_lens=ql)
+        b_ = paged_ragged_attention(q, kp, vp, tbl, lens, q_lens=ql)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+class TestPoolAttendRagged:
+    def _pool(self, kv=None, seed=2, lens=(6, 9, 1)):
+        rng = np.random.RandomState(seed)
+        pool = PagedKVCacheManager(32, PAGE, 2, 8, dtype=jnp.float32,
+                                   kv_dtype=kv)
+        for i, n in enumerate(lens):
+            sid = f"s{i}"
+            pool.alloc(sid)
+            for _ in range(n):
+                pool.append(sid, rng.randn(2, 8).astype("float32"),
+                            rng.randn(2, 8).astype("float32"))
+        return pool, rng
+
+    @pytest.mark.parametrize("kv", [None, "int8"])
+    def test_matches_legacy_pair_composition(self, kv):
+        # one attend_ragged call == the decode-kernel rows + the
+        # prefill-kernel rows of the legacy two-kernel routing
+        pool, rng = self._pool(kv=kv)
+        sids = ["s0", "s1", "s2"]
+        T = 4
+        q = rng.randn(4, T, 2, 8).astype("float32")
+        q_lens = [2, 3, 1]
+        out = pool.attend_ragged(jnp.asarray(q), sids, q_lens,
+                                 rows_pad=4, max_pages=4)
+        ref = pool.attend_prefill(jnp.asarray(q), sids, q_lens,
+                                  rows_pad=4, max_pages=4)
+        np.testing.assert_array_equal(out.numpy(), ref.numpy())
+        # the decode row agrees with attend_padded on its token
+        dec = pool.attend_padded(
+            jnp.asarray(q[:, T - 1]), ["s2"], rows_pad=4, max_pages=4)
+        np.testing.assert_allclose(out.numpy()[2, T - 1],
+                                   dec.numpy()[0], atol=1e-5)
+
+    def test_warm_dispatch_reuse_across_pools(self):
+        # satellite: the unified kernel keys ONE shape-keyed LRU —
+        # a second pool instance at the same shapes reuses the
+        # compiled entry instead of re-tracing
+        pool_a, rng = self._pool(seed=3)
+        q = jnp.asarray(rng.randn(4, 2, 2, 8), jnp.float32)
+        pool_a.attend_ragged(q, ["s0", "s1"], [2, 1], rows_pad=4,
+                             max_pages=4)
+        info0 = _jitted_ragged_call.cache_info()
+        pool_b, _ = self._pool(seed=4)
+        pool_b.attend_ragged(q, ["s0", "s1"], [2, 1], rows_pad=4,
+                             max_pages=4)
+        info1 = _jitted_ragged_call.cache_info()
+        assert info1.currsize == info0.currsize
+        assert info1.hits == info0.hits + 1
+
+    def test_single_cache_serves_decode_and_prefill_kinds(self):
+        # no per-row-kind cache split: a decode-shaped (T=1) call and
+        # a prefill-shaped call both land in _jitted_ragged_call
+        pool, rng = self._pool(seed=5)
+        size0 = _jitted_ragged_call.cache_info().currsize
+        q1 = jnp.asarray(rng.randn(2, 1, 2, 8), jnp.float32)
+        pool.attend_ragged(q1, ["s0", "s1"], [1, 1], max_pages=4)
+        qT = jnp.asarray(rng.randn(2, 4, 2, 8), jnp.float32)
+        pool.attend_ragged(qT, ["s0", "s1"], [3, 4], max_pages=4)
+        assert _jitted_ragged_call.cache_info().currsize >= size0 + 1
+
+
+class TestFusedStep:
+    """FlashFuser prologue/epilogue: qkv + RoPE + page scatter fold
+    into the ragged kernel's program, o_proj into its epilogue — the
+    fused pool step must be numerically identical to the unfused
+    unified path AND leave identical page state behind."""
+
+    def _setup(self, seed=7):
+        from paddle_tpu.ops.kernels.rope import build_rope_cache
+
+        rng = np.random.RandomState(seed)
+        E, NH, KVH, HD = 16, 2, 2, 8
+        pool_f = PagedKVCacheManager(16, PAGE, KVH, HD,
+                                     dtype=jnp.float32)
+        pool_u = PagedKVCacheManager(16, PAGE, KVH, HD,
+                                     dtype=jnp.float32)
+        lens = (5, 1)
+        for pool in (pool_f, pool_u):
+            for i, n in enumerate(lens):
+                sid = f"s{i}"
+                pool.alloc(sid)
+                for _ in range(n):
+                    rs = np.random.RandomState(100 + i)
+                    pool.append(sid,
+                                rs.randn(KVH, HD).astype("float32"),
+                                rs.randn(KVH, HD).astype("float32"))
+        wq = jnp.asarray(rng.randn(E, NH * HD) * 0.1, jnp.float32)
+        wk = jnp.asarray(rng.randn(E, KVH * HD) * 0.1, jnp.float32)
+        wv = jnp.asarray(rng.randn(E, KVH * HD) * 0.1, jnp.float32)
+        wo = jnp.asarray(rng.randn(NH * HD, E) * 0.1, jnp.float32)
+        cos, sin = build_rope_cache(64, HD)
+        return (rng, pool_f, pool_u, lens, E, NH, KVH, HD,
+                (wq, wk, wv, wo), (cos, sin))
+
+    def test_fused_matches_unfused_and_pages_identical(self):
+        from paddle_tpu.framework.core import Tensor
+        from paddle_tpu.ops.kernels.rope import apply_rotary_emb
+
+        (rng, pool_f, pool_u, lens, E, NH, KVH, HD,
+         (wq, wk, wv, wo), (cos, sin)) = self._setup()
+        sids = ["s0", "s1"]
+        counts = [3, 1]            # one prefill chunk + one decode row
+        n_real, n_pad = 4, 8
+        x = jnp.asarray(rng.randn(n_pad, E), jnp.float32)
+        pos = np.zeros(n_pad, np.int32)
+        pos[0:3] = [5, 6, 7]
+        pos[3] = 1
+        t_pad, b_pad = 4, 2
+        gm = np.zeros((b_pad, t_pad), np.int64)
+        gm[0, 1:] = [0, 1, 2]
+        gm[1, 3:] = [3]
+        mr = jnp.asarray([0, 0, 0, 1], jnp.int32)
+        mc = jnp.asarray([1, 2, 3, 3], jnp.int32)
+        mflat = jnp.asarray([0, 1, 2, 3], jnp.int32)
+        y = pool_f.fused_ragged_step(
+            x, (wq, wk, wv, wo, None), (cos, sin),
+            jnp.asarray(pos), sids, counts, jnp.asarray(gm, jnp.int32),
+            (mr, mc, mflat), rows_pad=b_pad, max_pages=4)
+
+        # unfused unified path on the twin pool
+        xq = (x @ wq).reshape(1, n_pad, NH, HD)
+        xk = (x @ wk).reshape(1, n_pad, KVH, HD)
+        vh = (x @ wv).reshape(n_pad, KVH, HD)
+        qh = apply_rotary_emb(xq, cos, sin,
+                              position_ids=jnp.asarray(pos))[0]
+        kh = apply_rotary_emb(xk, cos, sin,
+                              position_ids=jnp.asarray(pos))[0]
+        pool_u.append_ragged(sids, counts, kh[:n_real], vh[:n_real])
+        out = pool_u.attend_ragged(
+            Tensor(qh[jnp.asarray(gm, jnp.int32)]), sids, counts,
+            rows_pad=b_pad, max_pages=4)
+        attn = jnp.zeros((n_pad, NH, HD), jnp.float32)
+        attn = attn.at[mflat].set(out._data[mr, mc])
+        y_ref = attn.reshape(n_pad, NH * HD) @ wo
+
+        np.testing.assert_allclose(y.numpy(), np.asarray(y_ref),
+                                   atol=1e-6)
+        # page payloads: the fused program computes K/V in-graph, so
+        # XLA's fusion may differ from the eager path by float ulps —
+        # allclose, while the BOOKKEEPING (tables, lens) is exact
+        np.testing.assert_allclose(np.asarray(pool_f.k_pages),
+                                   np.asarray(pool_u.k_pages),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(pool_f.v_pages),
+                                   np.asarray(pool_u.v_pages),
+                                   atol=1e-6)
+        for s in sids:
+            assert pool_f.seq_pages(s) == pool_u.seq_pages(s)
+        assert pool_f.seq_len("s0") == lens[0] + 3
+        assert pool_f.seq_len("s1") == lens[1] + 1
+
+    def test_fused_cache_stable_across_real_token_counts(self):
+        # the fused dispatch cache keys only BUCKETED shapes: a
+        # second step with a different real-token count but the same
+        # padded config reuses the compiled program instead of
+        # re-tracing (the padded plans' out-of-bounds entries drop)
+        from paddle_tpu.ops.kernels.paged_attention import (
+            _jitted_fused_call,
+        )
+
+        (rng, pool, _, lens, E, NH, KVH, HD,
+         weights, rope) = self._setup(seed=11)
+        wq, wk, wv, wo = weights
+        n_pad, t_pad, b_pad = 8, 4, 2
+
+        def step(counts, positions):
+            n_real = sum(counts)
+            gm = np.zeros((b_pad, t_pad), np.int64)
+            rr, cc, ff = [], [], []
+            off = 0
+            for r, c in enumerate(counts):
+                gm[r, t_pad - c:] = np.arange(off, off + c)
+                for j in range(c):
+                    rr.append(r)
+                    cc.append(t_pad - c + j)
+                    ff.append(off + j)
+                off += c
+            x = jnp.asarray(rng.randn(n_pad, E), jnp.float32)
+            pos = np.zeros(n_pad, np.int32)
+            pos[:n_real] = positions
+            return pool.fused_ragged_step(
+                x, (wq, wk, wv, wo, None), rope, jnp.asarray(pos),
+                ["s0", "s1"], counts, jnp.asarray(gm, jnp.int32),
+                (jnp.asarray(rr, jnp.int32), jnp.asarray(cc, jnp.int32),
+                 jnp.asarray(ff, jnp.int32)),
+                rows_pad=b_pad, max_pages=4)
+
+        step([3, 1], [5, 6, 7, 1])
+        info0 = _jitted_fused_call.cache_info()
+        step([2, 1], [8, 9, 2])      # fewer real tokens, same buckets
+        info1 = _jitted_fused_call.cache_info()
+        assert info1.currsize == info0.currsize
+        assert info1.hits == info0.hits + 1
+
+    def test_int8_pool_refuses_fusion(self):
+        pool = PagedKVCacheManager(8, PAGE, 2, 8, dtype=jnp.float32,
+                                   kv_dtype="int8")
+        pool.alloc("s")
+        with pytest.raises(ValueError, match="int8"):
+            pool.fused_ragged_step(
+                jnp.zeros((4, 16)), (None,) * 5, (None, None),
+                None, ["s"], [1], None, (None, None, None))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the chunked scheduler across dispatch modes
+
+
+def _tiny_cfg(**kw):
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("num_hidden_layers", 1)
+    kw.setdefault("num_attention_heads", 2)
+    kw.setdefault("num_key_value_heads", 2)
+    kw.setdefault("max_position_embeddings", 128)
+    return llama_tiny(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(17)
+    return LlamaForCausalLM(_tiny_cfg())
+
+
+_RNG = np.random.RandomState(0)
+PROMPTS = {
+    "a": _RNG.randint(1, 500, 11).tolist(),
+    "b": _RNG.randint(1, 500, 3).tolist(),
+    "c": _RNG.randint(1, 500, 7).tolist(),
+}
+N_NEW = {"a": 4, "b": 5, "c": 3}
+
+
+def _serve(model, mode, kv=None, prefix=False, budget=8):
+    paddle.set_flags({"ragged_attention": mode})
+    try:
+        adapter = PagedLlamaAdapter(model, num_pages=96,
+                                    page_size=PAGE, max_length=128,
+                                    kv_cache_dtype=kv)
+        sched = BatchScheduler(
+            adapter, max_batch_size=4, prefix_cache=prefix,
+            chunked_prefill=True, prefill_chunk_tokens=budget)
+        out = {}
+        for wave in (0, 1) if prefix else (0,):
+            for rid, p in PROMPTS.items():
+                sched.submit(Request(f"{rid}w{wave}", list(p),
+                                     max_new_tokens=N_NEW[rid]))
+            done = sched.run_until_complete()
+            for k, v in done.items():
+                out[k] = v.generated_ids
+        return out, sched, adapter
+    finally:
+        paddle.set_flags({"ragged_attention": "auto"})
+
+
+class TestEndToEndGreedyIdentity:
+    """The scheduler's greedy outputs must be token-identical across
+    off (legacy two-kernel), on (unified kernel), and auto (unified +
+    fused prologue/epilogue where eligible)."""
+
+    @pytest.mark.parametrize("kv,prefix", [
+        (None, False),
+        ("int8", False),
+        pytest.param(None, True, marks=_slow),
+        pytest.param("int8", True, marks=_slow),
+    ])
+    def test_modes_agree(self, model, kv, prefix):
+        base, _, ad_off = _serve(model, "off", kv=kv, prefix=prefix)
+        got_on, _, ad_on = _serve(model, "on", kv=kv, prefix=prefix)
+        got_auto, _, ad_auto = _serve(model, "auto", kv=kv,
+                                      prefix=prefix)
+        assert got_on == base, (kv, prefix)
+        assert got_auto == base, (kv, prefix)
+        # unified mode compiled ONE attend program per packed config
+        for ad in (ad_on, ad_auto):
+            kinds = {k for k, *_ in ad._kernel_shapes}
+            assert kinds <= {"ragged", "ragged_fused"}, kinds
+        # the legacy run compiled the decode/prefill pair
+        assert {k for k, *_ in ad_off._kernel_shapes} <= \
+            {"decode", "prefill"}
+        assert ad_on.attend_program_count <= \
+            ad_off.attend_program_count
+
+    def test_auto_fuses_fp_and_declines_int8(self, model):
+        _, _, ad_fp = _serve(model, "auto")
+        assert {k for k, *_ in ad_fp._kernel_shapes} == \
+            {"ragged_fused"}
+        _, _, ad_i8 = _serve(model, "auto", kv="int8")
+        assert {k for k, *_ in ad_i8._kernel_shapes} == {"ragged"}
+
+    def test_attend_program_count_bounded_by_buckets(self, model):
+        got, sched, adapter = _serve(model, "auto")
+        assert got == _serve(model, "off")[0]
+        # satellite acceptance: one attend program per packed config
+        # keeps the compiled-program count within the bucket ladder
+        # (the legacy pair pushed it toward 2x)
+        assert adapter.compile_count <= len(sched.serving_buckets)
+        assert adapter.attend_program_count <= \
+            len(sched.serving_buckets)
+        # one attend kernel KIND per dispatch bucket, never a pair
+        assert all(len(kinds) == 1 for kinds in
+                   adapter.attend_kinds_by_bucket.values()), \
+            adapter.attend_kinds_by_bucket
+
+    def test_fused_program_count_includes_packed_bucket(self, model):
+        # two packed buckets sharing (b_pad, t_pad, mp_pad) compile
+        # two REAL fused programs — the dense prologue/epilogue is
+        # bucket-shaped — and the accounting must not collapse them
+        # (review find: the cfg keys n_pad, the shape tuple must too)
+        from paddle_tpu.ops.kernels.paged_attention import (
+            _jitted_fused_call,
+        )
+
+        paddle.set_flags({"ragged_attention": "auto"})
+        ad = PagedLlamaAdapter(model, num_pages=32, page_size=16,
+                               max_length=128)
+        for s in "abcd":
+            ad.alloc(s)
+        rng = np.random.RandomState(3)
+
+        def toks(n):
+            return rng.randint(1, 400, n).tolist()
+
+        miss0 = _jitted_fused_call.cache_info().misses
+        ad.prefill_chunk([toks(5), toks(1), toks(1), toks(1)],
+                         list("abcd"), [0, 0, 0, 0], pad_to=8)
+        ad.prefill_chunk([toks(5), toks(2), toks(2), toks(2)],
+                         list("abcd"), [5, 1, 1, 1], pad_to=16)
+        compiled = _jitted_fused_call.cache_info().misses - miss0
+        assert ad.attend_program_count == compiled == 2, (
+            ad.attend_program_count, compiled, ad._kernel_shapes)
+        for s in "abcd":
+            ad.free(s)
+
+    def test_step_event_reports_attend_programs(self, model):
+        paddle.set_flags({"ragged_attention": "auto"})
+        adapter = PagedLlamaAdapter(model, num_pages=96,
+                                    page_size=PAGE, max_length=128)
+        sched = BatchScheduler(adapter, max_batch_size=4,
+                               chunked_prefill=True,
+                               prefill_chunk_tokens=8)
+        for rid, p in PROMPTS.items():
+            sched.submit(Request(rid, list(p),
+                                 max_new_tokens=N_NEW[rid]))
+        ev = sched.step()
+        assert ev["attend_programs"] == adapter.attend_program_count
+        assert ev["attend_programs"] >= 1
+
+    def test_qkv_bias_model_fuses_and_agrees(self):
+        # Qwen2-style q/k/v biases ride the fused prologue
+        paddle.seed(29)
+        bmodel = LlamaForCausalLM(_tiny_cfg(attention_bias=True))
+        base, _, _ = _serve(bmodel, "off")
+        got_auto, _, ad = _serve(bmodel, "auto")
+        assert got_auto == base
+        assert {k for k, *_ in ad._kernel_shapes} == {"ragged_fused"}
+
+    @_slow
+    def test_windowed_model_modes_agree(self):
+        paddle.seed(23)
+        wmodel = LlamaForCausalLM(_tiny_cfg(sliding_window=6))
+        base, _, _ = _serve(wmodel, "off")
+        got_auto, _, ad = _serve(wmodel, "auto")
+        assert got_auto == base
+        assert {k for k, *_ in ad._kernel_shapes} == {"ragged_fused"}

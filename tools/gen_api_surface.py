@@ -63,6 +63,7 @@ NAMESPACES = [
     "paddle_tpu.text",
     "paddle_tpu.audio",
     "paddle_tpu.quantization",
+    "paddle_tpu.ops.kernels",
     "paddle_tpu.inference",
     "paddle_tpu.framework.telemetry",
     "paddle_tpu.framework.watchdog",
