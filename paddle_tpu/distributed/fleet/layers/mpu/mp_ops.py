@@ -277,6 +277,31 @@ def collective_matmul_dispatch(kind, x, w, bias=None, group=None,
         return None
     cm.record_dispatch(kind, True, chunks=ws)
 
+    # quantize-on-the-wire (FLAGS_collective_dtype): the wire dtype is
+    # resolved HERE, at the dispatch decision point, and handed to the
+    # kernels as a static argument — the quant/dequant math itself
+    # lives only in ops/kernels/collective_matmul.py (enforced by the
+    # wire-quant-ownership codebase lint). The savings counters record
+    # the TOTAL elements the program's rings move over ICI (every hop
+    # of every ring this dispatch emits), so the aggregate stays one
+    # currency across kinds.
+    if kind == "ag_mm":
+        # the x shard rotates: ws-1 hops of the local chunk
+        loc = x.size if manual else x.size // ws
+        elems, last = (ws - 1) * loc, int(x.shape[-1])
+    elif kind == "mm_ag":
+        # the weight column-shard rotates
+        loc = w.size if manual else w.size // ws
+        elems, last = (ws - 1) * loc, n_out
+    elif kind == "mm_rs":
+        # ws-1 hops of the (rows/ws, n_out) partial-sum carry
+        elems, last = (ws - 1) * (rows // ws) * n_out, n_out
+    else:  # mm_ar: the carry ring plus the tiled re-gather
+        elems, last = 2 * (ws - 1) * (rows // ws) * n_out, n_out
+    wire = cm.resolve_wire(comm, last, itemsize)
+    if wire != "off":
+        cm.record_wire(kind, wire, elems, last, itemsize)
+
     # ONE local ring per kind, shared by both execution contexts so the
     # lowerings cannot desynchronize. mm_ar/mm_ag take the cotangent
     # convention switch: tape_ct under the manual tape (replicated,
@@ -285,16 +310,16 @@ def collective_matmul_dispatch(kind, x, w, bias=None, group=None,
     local = {
         "ag_mm": functools.partial(
             cm.all_gather_matmul, axis_name=ax, axis_size=ws,
-            gather_axis=sa),
+            gather_axis=sa, wire=wire),
         "mm_rs": functools.partial(
             cm.matmul_reduce_scatter, axis_name=ax, axis_size=ws,
-            scatter_axis=sa),
+            scatter_axis=sa, wire=wire),
         "mm_ar": functools.partial(
             cm.matmul_all_reduce, axis_name=ax, axis_size=ws,
-            scatter_axis=sa, tape_ct=manual),
+            scatter_axis=sa, tape_ct=manual, wire=wire),
         "mm_ag": functools.partial(
             cm.matmul_all_gather, axis_name=ax, axis_size=ws,
-            tape_ct=manual),
+            tape_ct=manual, wire=wire),
     }[kind]
 
     if manual:
@@ -342,7 +367,7 @@ def collective_matmul_dispatch(kind, x, w, bias=None, group=None,
             or isinstance(w._data, jax.core.Tracer):
         global_fn = sm_fn
     else:
-        key = (kind, ax, ws, sa, nd, mesh)
+        key = (kind, ax, ws, sa, nd, wire, mesh)
         global_fn = _CM_JIT_CACHE.get(key)
         if global_fn is None:
             # evict signatures of dead meshes (rebuilt via
@@ -353,6 +378,58 @@ def collective_matmul_dispatch(kind, x, w, bias=None, group=None,
 
     out = apply_op("collective_matmul_" + kind, global_fn, x, w)
     return out if bias is None else out + bias
+
+
+def grad_allreduce_dispatch(tensor, group=None):
+    """Route a DP gradient-sync all-reduce through the chunked
+    (optionally quantized) ring (ops/kernels/collective_matmul.py
+    ring_all_reduce) — the blocking-psum replacement
+    fleet/utils/hybrid_parallel_util.fused_allreduce_gradients calls
+    before falling back to the plain collective.
+
+    Returns the reduced Tensor (NOT averaged — the caller owns the
+    1/world scaling exactly as before), or None when the policy
+    declines: FLAGS_collective_matmul off/auto-below-threshold, degree
+    1, a grad whose element count the ring does not divide, or a
+    non-manual context (under GSPMD the grads of global arrays are
+    already reduced in-program — there is no blocking psum to
+    replace). The off-path lowering stays bit-identical."""
+    from .....ops.kernels import collective_matmul as cm
+
+    if cm.decompose_mode() == "off":
+        cm.record_dispatch("dp_ar", False, "off")
+        return None
+    g = _resolve(group)
+    ax = _axis(group)
+    ws = g.nranks
+    if not isinstance(ax, str) or ws <= 1:
+        cm.record_dispatch("dp_ar", False, "degree")
+        return None
+    if not in_manual_context(g.axis_names):
+        cm.record_dispatch("dp_ar", False, "no_mesh")
+        return None
+    tensor = _as_tensor(tensor)
+    itemsize = jax.numpy.dtype(tensor._data.dtype).itemsize
+    comm = 2 * tensor.size * itemsize  # allreduce = RS + AG
+    divisible = tensor.size % ws == 0
+    deny = cm.decline_reason(comm, ws, divisible)
+    if deny is not None:
+        cm.record_dispatch("dp_ar", False, deny)
+        return None
+    # the ring chunks are (size/ws,) flat vectors — the scale blocks
+    # tile that length
+    chunk_len = max(tensor.size // ws, 1)
+    wire = cm.resolve_wire(comm, chunk_len, itemsize)
+    cm.record_dispatch("dp_ar", True, chunks=ws)
+    # RS ships ws-1 chunks of size/ws, the re-gather (ws-1)/ws of the
+    # whole grad: 2*(ws-1)*size/ws elements over the wire in total
+    cm.record_wire("dp_ar", wire, 2 * (ws - 1) * (tensor.size // ws),
+                   chunk_len, itemsize)
+    return apply_op(
+        "grad_sync_ring",
+        functools.partial(cm.ring_all_reduce, axis_name=ax,
+                          axis_size=ws, wire=wire),
+        tensor)
 
 
 def split(x, size, operation="linear", axis=0, num_partitions=1,

@@ -23,9 +23,14 @@ __all__ = [
 def fused_allreduce_gradients(parameter_list, hcg=None):
     """Allreduce every parameter's .grad across the data-parallel group
     (upstream fuses into buckets; XLA's collective combiner plays that
-    role here)."""
+    role here). In a manual (shard_map) context the blocking psum is
+    routed through the chunked — and, under FLAGS_collective_dtype,
+    quantized-on-the-wire — ring all-reduce
+    (mp_ops.grad_allreduce_dispatch); when the policy declines, the
+    plain blocking collective runs unchanged."""
     from ... import env
     from ...collective import all_reduce
+    from ..layers.mpu.mp_ops import grad_allreduce_dispatch
 
     group = hcg.get_data_parallel_group() if hcg is not None else None
     world = group.nranks if group is not None else env.get_world_size()
@@ -33,11 +38,16 @@ def fused_allreduce_gradients(parameter_list, hcg=None):
         return
     with no_grad():
         for p in parameter_list:
-            if p._grad is not None:
+            if p._grad is None:
+                continue
+            ringed = grad_allreduce_dispatch(p._grad, group=group)
+            if ringed is not None:
+                p._grad._data = ringed._data
+            else:
                 all_reduce(p._grad, group=group)
-                p._grad._data = (
-                    p._grad._data / world
-                ).astype(p._grad._data.dtype)
+            p._grad._data = (
+                p._grad._data / world
+            ).astype(p._grad._data.dtype)
 
 
 def _broadcast_params(parameters, group):

@@ -150,17 +150,26 @@ COMM_OVER_BUDGET = _rule(
 COMM_BOUND_PROGRAM = _rule(
     "comm-bound-program", "warning",
     "compute/comm ratio below FLAGS_jit_plan_comm_bound_ratio with "
-    "wide (>= 4-byte) collectives: traffic a quantized ring "
-    "(int8/fp8 quantize-on-the-wire, ROADMAP item 3) would halve or "
-    "quarter")
+    "wide (>= 4-byte) collectives: traffic an int8/fp8 "
+    "quantize-on-the-wire ring (FLAGS_collective_dtype) would halve "
+    "or quarter. Dtype-aware: axes whose wire is already quantized "
+    "(sub-2-byte payloads dominating, f32 scale sidecars riding "
+    "along) do not count as wide")
 DEAD_COLLECTIVE = _rule(
     "dead-collective", "warning",
     "collective whose result is never consumed: pure ICI traffic "
     "(and a deadlock hazard if any rewrite drops it on a subset of "
     "devices)")
+WIRE_SAVINGS_MISS = _rule(
+    "wire-savings-miss", "critical",
+    "a quantized-wire program's planned wire bytes (payload + scale "
+    "sidecars) exceed the asserted fraction of its fp reference "
+    "lowering's wire — the quantized ring is not delivering the "
+    "savings the planner predicted (planner.verify_wire_savings)")
 
 PLANNER_RULE_IDS = ("hbm-over-budget", "comm-over-budget",
-                    "comm-bound-program", "dead-collective")
+                    "comm-bound-program", "dead-collective",
+                    "wire-savings-miss")
 
 # primitives allowed to consume low precision and produce wide floats:
 # numerically-motivated accumulation (the reference's CINN/AMP lists

@@ -163,7 +163,25 @@ define_flag("collective_matmul_min_bytes", 4 << 20,
             "auto-mode decomposition threshold: decompose a dependent "
             "collective+matmul pair only when the blocking collective "
             "would move at least this many bytes; also the trace "
-            "linter's overlap-miss threshold (framework/analysis.py)")
+            "linter's overlap-miss threshold (framework/analysis.py) "
+            "and the quantize-on-the-wire auto-decline floor "
+            "(FLAGS_collective_dtype)")
+define_flag("collective_dtype", "off",
+            "quantize-on-the-wire dtype for the chunked ring "
+            "collectives (ops/kernels/collective_matmul.py): 'off' "
+            "(default) ships fp chunks and keeps every ring lowering "
+            "bit-identical to the unquantized path (pinned like "
+            "FLAGS_collective_matmul=off); 'int8' ships each ring hop "
+            "as an EQuARX-style block-scaled int8 payload plus one "
+            "f32 scale per wire_block (128) of the trailing dim, with "
+            "dequant fused chunk-local before the partial matmul and "
+            "the custom-VJP backwards quantizing their cotangent "
+            "rings the same way; 'fp8' uses float8_e4m3 where the "
+            "jax build supports it (falls back to int8 otherwise). "
+            "Applies to the TP/SP collective-matmul rings, the DP "
+            "grad-sync ring (mp_ops.grad_allreduce_dispatch) and the "
+            "MoE expert all-to-all overlap; auto-declines below "
+            "FLAGS_collective_matmul_min_bytes (docs/OVERLAP.md)")
 define_flag("prefill_chunk_tokens", 64,
             "chunked-prefill token budget for the paged serving "
             "scheduler (inference/serving.py): each BatchScheduler "

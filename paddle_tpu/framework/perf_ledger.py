@@ -77,7 +77,7 @@ EXEC_COUNT_PREFIX = "exec.count."
 _PLAN_FIELDS = (
     "flops_total", "hbm_peak_bytes", "input_bytes", "donated_bytes",
     "const_bytes", "output_bytes", "transient_peak_bytes",
-    "comm_bytes_total",
+    "comm_bytes_total", "comm_bytes_quantized",
 )
 
 

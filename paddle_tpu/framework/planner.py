@@ -52,8 +52,19 @@ per-call suppress — applies unchanged):
   comm-bound-program  warning   flops/comm-byte below
                                 FLAGS_jit_plan_comm_bound_ratio with
                                 >= 4-byte collectives (a quantized
-                                ring would halve the wire bytes)
+                                ring would halve the wire bytes);
+                                dtype-aware — axes already moving a
+                                quantized wire (int8/fp8 payload
+                                dominating, f32 scale sidecars riding
+                                along) are not re-flagged
   dead-collective     warning   collective whose result is unused
+  wire-savings-miss   critical  a quantized-wire program's planned
+                                bytes (payload + scale sidecars,
+                                modeled exactly) exceed the asserted
+                                fraction of its fp reference's wire
+                                (:func:`verify_wire_savings`, the
+                                strict-mode savings assertion the
+                                tp_overlap bench pins)
 
 On-demand API: ``paddle.jit.plan(fn_or_compiled, *example_args)``
 traces (never executes) and returns a ``ResourcePlan``.
@@ -73,6 +84,7 @@ from .analysis import (
     COMM_OVER_BUDGET,
     DEAD_COLLECTIVE,
     HBM_OVER_BUDGET,
+    WIRE_SAVINGS_MISS,
     AnalysisReport,
     JitLintError,
     _aval_dtype,
@@ -226,6 +238,25 @@ class ResourcePlan:
         return sum(c.nbytes for c in self.collectives)
 
     @property
+    def comm_bytes_quantized(self) -> int:
+        """Wire bytes moved in sub-2-byte (int8/fp8 quantized)
+        elements — the payload half of a quantize-on-the-wire ring
+        (its f32 scale sidecars stay in comm_bytes_total only). The
+        byte model is dtype-aware by construction: each collective's
+        nbytes already uses its operand itemsize, so a quantized
+        chunk counts 1 byte/element and its sidecar 4/wire_block."""
+        return sum(c.nbytes for c in self.collectives
+                   if c.itemsize <= 1)
+
+    @property
+    def quantized_comm_bytes_by_axis(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for c in self.collectives:
+            if c.itemsize <= 1:
+                out[c.axis] = out.get(c.axis, 0) + c.nbytes
+        return out
+
+    @property
     def flops_per_comm_byte(self) -> Optional[float]:
         total = self.comm_bytes_total
         if total <= 0:
@@ -252,6 +283,7 @@ class ResourcePlan:
             "weak_consts_excluded": int(self.weak_consts_excluded),
             "flops_total": float(self.flops_total),
             "comm_bytes_total": int(self.comm_bytes_total),
+            "comm_bytes_quantized": int(self.comm_bytes_quantized),
             "comm_bytes_by_axis": {
                 k: int(v) for k, v in self.comm_bytes_by_axis.items()},
             "ring_chunks_by_axis": dict(self.ring_chunks_by_axis),
@@ -647,8 +679,20 @@ def check_plan(plan: ResourcePlan, out: _RuleLimiter):
     ratio = plan.flops_per_comm_byte
     threshold = float(_flag("jit_plan_comm_bound_ratio", 8.0) or 0.0)
     if ratio is not None and threshold > 0 and ratio < threshold:
+        # dtype-aware: a >=4-byte collective that is SIDECAR-SIZED
+        # next to quantized traffic on its axis is part of a
+        # quantize-on-the-wire ring, not a quantization candidate.
+        # Sidecars are payload * 4/wire_block of their ring, so at
+        # most 1/8 of the axis's quantized bytes for any block >= 32
+        # (the common case — typical hidden dims block at 128; rings
+        # whose blocks degenerate further are declined at dispatch by
+        # the sidecar_overhead gate). A wide collective larger than
+        # that still flags: an unrelated fp32 psum sharing an axis
+        # with int8 traffic is exactly what the rule exists to catch.
+        q_by_axis = plan.quantized_comm_bytes_by_axis
         wide = [c for c in plan.collectives
-                if c.itemsize >= 4 and c.axis_size != 1]
+                if c.itemsize >= 4 and c.axis_size != 1
+                and 8 * c.nbytes > q_by_axis.get(c.axis, 0)]
         if wide:
             wide_bytes = sum(c.nbytes for c in wide)
             out.add(
@@ -656,13 +700,14 @@ def check_plan(plan: ResourcePlan, out: _RuleLimiter):
                 "%.2f flops per comm byte (threshold %.2f) with %d "
                 "wide collective(s) moving %s in >=4-byte elements: "
                 "the program is communication-bound and an int8/fp8 "
-                "quantized ring would halve-to-quarter the wire bytes"
+                "quantized ring (FLAGS_collective_dtype) would halve-"
+                "to-quarter the wire bytes"
                 % (ratio, threshold, len(wide), _fmt_bytes(wide_bytes)),
                 where=wide[0].where,
-                suggestion="route the pair through a quantize-on-the-"
-                "wire collective when ROADMAP item 3 lands, cast the "
-                "collective operand to bf16, or raise "
-                "FLAGS_jit_plan_comm_bound_ratio",
+                suggestion="route the pair through the quantize-on-"
+                "the-wire ring (FLAGS_collective_dtype=int8, "
+                "docs/OVERLAP.md), cast the collective operand to "
+                "bf16, or raise FLAGS_jit_plan_comm_bound_ratio",
             )
     for prim, ax, where in plan.dead_collectives:
         out.add(
@@ -736,6 +781,77 @@ def plan_static_entry(static_fn, entry, suppress: Sequence[str] = ()
     return plan_jaxpr(
         entry["pruned_jaxpr"], name=name, donated_invars=donated,
         alias_out_to_in=alias, suppress=extra)
+
+
+# suppress-every-planner-rule token for the internal plan passes of
+# verify_wire_savings: the comparison judges WIRE bytes only, and a
+# comm-bound/dead-collective finding from a bench-shaped microprogram
+# must not fail the savings assertion. Sourced from the registry so a
+# future planner rule cannot silently fall outside the suppression.
+RULES_ALL_SUPPRESSED = analysis.PLANNER_RULE_IDS
+
+
+def verify_wire_savings(quant, ref, *, max_ratio=0.55,
+                        mesh_axis_sizes: Optional[Dict[str, int]] = None,
+                        suppress: Sequence[str] = (),
+                        ) -> Tuple[Optional[float], AnalysisReport]:
+    """Strict-mode planner assertion that a quantized-wire lowering
+    delivers its predicted savings: the quantized program's planned
+    wire bytes (int8/fp8 payload + f32 scale sidecars, both modeled
+    exactly per chunk) must be at most ``max_ratio`` x the reference
+    (fp-wire) program's planned bytes for the same computation.
+
+    ``quant``/``ref`` are ResourcePlans or ClosedJaxprs (jaxprs are
+    planned in place with ``mesh_axis_sizes``). Returns
+    (ratio, AnalysisReport); the wire-savings-miss finding fires when
+    the ratio exceeds ``max_ratio`` — or when the quantized program
+    ships NO sub-2-byte traffic at all (a 'quantized' lowering that
+    never quantized is the savings miss in its purest form) — and is
+    routed through :func:`emit_plan_report` under FLAGS_jit_plan, so
+    strict mode raises JitPlanError at the verification point. The
+    tp_overlap bench pins this against the live chunk schedule."""
+    def _as_plan(p, name):
+        if isinstance(p, ResourcePlan):
+            return p
+        plan, _ = plan_jaxpr(p, name=name,
+                             mesh_axis_sizes=mesh_axis_sizes,
+                             suppress=RULES_ALL_SUPPRESSED)
+        return plan
+
+    qp = _as_plan(quant, "<quantized>")
+    rp = _as_plan(ref, "<reference>")
+    name = "%s vs %s" % (qp.name, rp.name)
+    report = AnalysisReport(name, n_eqns=qp.n_eqns)
+    out = _RuleLimiter(report, resolve_suppressions(suppress))
+    ref_bytes = rp.comm_bytes_total
+    q_bytes = qp.comm_bytes_total
+    ratio = (q_bytes / float(ref_bytes)) if ref_bytes > 0 else None
+    if qp.comm_bytes_quantized <= 0:
+        out.add(
+            WIRE_SAVINGS_MISS,
+            "program '%s' claims a quantized wire but plans no "
+            "sub-2-byte collective traffic (%s total wire) — the "
+            "quantization never reached the ring" % (
+                qp.name, _fmt_bytes(q_bytes)),
+            suggestion="check FLAGS_collective_dtype and the "
+            "dispatch decline counters "
+            "(collective.declined.<reason>)",
+        )
+    elif ratio is not None and ratio > max_ratio:
+        out.add(
+            WIRE_SAVINGS_MISS,
+            "quantized wire %s is %.3fx the reference wire %s "
+            "(asserted <= %.2fx): payload + scale sidecars are not "
+            "delivering the predicted savings" % (
+                _fmt_bytes(q_bytes), ratio, _fmt_bytes(ref_bytes),
+                max_ratio),
+            suggestion="check the scale-block size (tiny trailing "
+            "dims pay 4/block overhead per element), or that the "
+            "reference arm really is the fp lowering",
+        )
+    out.finish()
+    emit_plan_report(report, str(_flag("jit_plan", "report")))
+    return ratio, report
 
 
 def emit_plan_report(report: AnalysisReport, mode: str):

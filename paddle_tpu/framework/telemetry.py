@@ -1181,12 +1181,25 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
     # collective-matmul dispatch (ops/kernels/collective_matmul.py)
     ("collective.decomposed.<kind>", "counter",
      "ring decompositions taken, by dispatch kind "
-     "(ag_mm/mm_rs/mm_ar/mm_ag)"),
+     "(ag_mm/mm_rs/mm_ar/mm_ag, dp_ar for the DP grad-sync ring, "
+     "moe_a2a for the expert all-to-all overlap)"),
     ("collective.declined.<reason>", "counter",
      "dispatch declines, by reason (off/degree/indivisible/"
      "below_threshold/shape/no_mesh/legacy_multi_axis)"),
     ("collective.ring_chunks", "counter",
      "total ring hops dispatched (overlap coverage)"),
+    ("collective.quantized.<kind>", "counter",
+     "quantize-on-the-wire rings taken, by dispatch kind "
+     "(FLAGS_collective_dtype; recorded at the same dispatch "
+     "decision points as collective.decomposed.<kind>)"),
+    ("collective.wire_bytes_quantized", "counter",
+     "bytes quantized rings actually ship per dispatch decision "
+     "(int8/fp8 payload + f32 scale sidecars — the planner-exact "
+     "chunk accounting of wire_chunk_bytes)"),
+    ("collective.wire_bytes_saved", "counter",
+     "fp wire bytes avoided by quantize-on-the-wire (fp payload "
+     "minus quantized payload+sidecars; the live side of the "
+     "planner's wire-savings assertion)"),
     # spans (trace mode)
     ("span:serving.step", "span", "one scheduler iteration"),
     ("span:serving.admit", "span", "admission pass of a step"),

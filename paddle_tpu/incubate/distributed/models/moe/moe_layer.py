@@ -302,14 +302,45 @@ def _expert_compute(expert_in, w0, b0, w1, b1, act, manual):
     paths: the ep all_to_all pair (global_scatter/global_gather roles)
     in manual shard_map regions, sharding constraints under GSPMD.
     Single definition so the two routing representations cannot drift
-    in their communication placement."""
+    in their communication placement.
+
+    In manual regions the a2a pair routes through the chunked-ppermute
+    overlap kernel (ops/kernels/collective_matmul.py
+    expert_alltoall_ffn) behind FLAGS_collective_matmul — expert
+    dispatch/combine hops ride the wire while the expert FFN of the
+    previously received block runs, optionally quantized on the wire
+    (FLAGS_collective_dtype). When the policy declines (off, auto
+    below threshold, E indivisible by the ep degree) the blocking
+    tiled all_to_all pair runs unchanged."""
     if manual:
+        from .....ops.kernels import collective_matmul as cm
+
+        ws = _ep_degree()
+        e = int(expert_in.shape[0])
+        itemsize = jnp.dtype(expert_in.dtype).itemsize
+        comm = 2 * expert_in.size * itemsize  # dispatch + combine
+        divisible = ws > 0 and e % ws == 0
+        if cm.should_decompose(comm, ws, divisible):
+            wire = cm.resolve_wire(
+                comm, int(expert_in.shape[-1]), itemsize)
+            cm.record_dispatch("moe_a2a", True, chunks=ws)
+            # each direction moves (ws-1)/ws of the buffer (the local
+            # block never crosses the wire)
+            cm.record_wire(
+                "moe_a2a", wire,
+                2 * (ws - 1) * (expert_in.size // ws),
+                int(expert_in.shape[-1]), itemsize)
+            return cm.expert_alltoall_ffn(
+                expert_in, w0, b0, w1, b1, axis_name="ep",
+                axis_size=ws, ffn=_expert_ffn, act=act, wire=wire)
+        cm.record_dispatch(
+            "moe_a2a", False, cm.decline_reason(comm, ws, divisible))
         expert_in = jax.lax.all_to_all(
-            expert_in, "ep", split_axis=0, concat_axis=1
+            expert_in, "ep", split_axis=0, concat_axis=1, tiled=True
         )
         expert_out = _expert_ffn(expert_in, w0, b0, w1, b1, act)
         return jax.lax.all_to_all(
-            expert_out, "ep", split_axis=1, concat_axis=0
+            expert_out, "ep", split_axis=1, concat_axis=0, tiled=True
         )
     if _ep_degree() > 1:
         expert_in = _constrain(expert_in, "ep", None, None)

@@ -65,7 +65,9 @@ from .paged_attention import (  # noqa
 )
 from .collective_matmul import (  # noqa
     all_gather_matmul,
+    expert_alltoall_ffn,
     matmul_all_gather,
     matmul_all_reduce,
     matmul_reduce_scatter,
+    ring_all_reduce,
 )

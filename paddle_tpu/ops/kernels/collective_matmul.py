@@ -51,6 +51,28 @@ collective would move at least FLAGS_collective_matmul_min_bytes (tiny
 matmuls lose to ring latency: w-1 hops of launch overhead against a
 sub-microsecond gather).
 
+Quantize-on-the-wire (`FLAGS_collective_dtype=off|int8|fp8`): every
+ring hop can ship its chunk EQuARX-style (arxiv 2506.17615) — an
+int8/fp8 payload plus one f32 scale per ``wire_block`` of the trailing
+dim — with dequantization fused chunk-local before the partial matmul.
+Quant/dequant never touches local compute: only the bytes that cross
+ICI shrink (payload to 1 byte/element; the scale sidecar adds
+4/wire_block per element). The custom-VJP backwards quantize their
+cotangent rings the same way, so the savings survive autodiff.
+``off`` leaves every ring bit-identical to the unquantized lowering
+(the same pinned-fallback discipline as FLAGS_collective_matmul=off),
+and the wire auto-declines below FLAGS_collective_matmul_min_bytes —
+tiny chunks don't repay the quant math and the sidecar overhead.
+
+Beyond the matmul pairs, the same chunked-ring + custom-VJP pattern
+covers the two remaining blocking collectives of the training step:
+``ring_all_reduce`` (DP gradient sync — chunked ring reduce-scatter +
+tiled re-gather over the dp axis, routed via
+mp_ops.grad_allreduce_dispatch) and ``expert_alltoall_ffn`` (the MoE
+expert-parallel all_to_all pair decomposed into per-peer ppermute
+block hops that overlap with the expert FFN — T3's fine-grained
+fusion applied to dispatch/combine).
+
 This module is jax-only (no host-side imports): every function body
 runs inside jit traces under shard_map; tools/lint_codebase.py enforces
 the discipline.
@@ -142,6 +164,208 @@ def record_dispatch(kind, decomposed, reason=None, chunks=0):
 
 
 # ---------------------------------------------------------------------------
+# quantize-on-the-wire policy (FLAGS_collective_dtype)
+# ---------------------------------------------------------------------------
+
+_WIRE_MODES = ("off", "int8", "fp8")
+
+# EQuARX block-scaling target: one f32 scale per up-to-this-many
+# trailing-dim elements (wire_block() shrinks it to a divisor so
+# blocks always tile the dim exactly — no padded wire bytes, and the
+# planner's byte model stays exact)
+WIRE_BLOCK = 128
+
+_WIRE_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def _fp8_dtype():
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def wire_dtype() -> str:
+    """FLAGS_collective_dtype, normalized to 'off' | 'int8' | 'fp8'.
+    Unknown values read 'off' (a typo'd deployment flag must not
+    silently change lowering); 'fp8' falls back to int8 on jax builds
+    without a float8 type."""
+    try:
+        from ...framework.flags import flag
+
+        mode = str(flag("collective_dtype")).lower()
+    except Exception:
+        return "off"
+    if mode not in _WIRE_MODES:
+        return "off"
+    if mode == "fp8" and _fp8_dtype() is None:
+        return "int8"
+    return mode
+
+
+def wire_decline_reason(comm_bytes, last_dim=None, fp_itemsize=4):
+    """Why quantize-on-the-wire would decline this payload — None
+    means quantize. Shares decline_reason's auto threshold (below
+    FLAGS_collective_matmul_min_bytes the quant/dequant math and the
+    scale sidecar's relative overhead outweigh the byte savings), and
+    when the caller supplies the chunk's trailing dim, declines
+    payloads whose scale blocks degenerate ('sidecar_overhead': a
+    trailing dim with only tiny divisors — e.g. a prime — pays one f32
+    scale per few elements, so the quantized wire would be AS LARGE OR
+    LARGER than the fp wire it replaces)."""
+    mode = wire_dtype()
+    if mode == "off":
+        return "off"
+    if int(comm_bytes) < min_bytes():
+        return "below_threshold"
+    if last_dim is not None:
+        pay, sc = wire_chunk_bytes((1, int(last_dim)), mode)
+        if pay + sc >= int(last_dim) * int(fp_itemsize):
+            return "sidecar_overhead"
+    return None
+
+
+def resolve_wire(comm_bytes, last_dim=None, fp_itemsize=4) -> str:
+    """The wire dtype the policy selects for a payload of
+    ``comm_bytes`` (trailing dim ``last_dim`` when known): 'off'
+    unless FLAGS_collective_dtype is on, the payload clears
+    FLAGS_collective_matmul_min_bytes, and the scale sidecar would
+    not erase the savings."""
+    return "off" if wire_decline_reason(
+        comm_bytes, last_dim, fp_itemsize) is not None \
+        else wire_dtype()
+
+
+def wire_block(d) -> int:
+    """Scale-block length for a trailing dim of ``d``: the largest
+    divisor of d at most WIRE_BLOCK (>= 1)."""
+    d = int(d)
+    b = min(d, WIRE_BLOCK)
+    while b > 1 and d % b:
+        b -= 1
+    return max(b, 1)
+
+
+def wire_chunk_bytes(shape, wire, fp_itemsize=4):
+    """(payload_bytes, scale_bytes) that ONE ring hop of a chunk of
+    ``shape`` ships under ``wire`` — the exact accounting the planner
+    model reproduces and the tp_overlap bench pins (payload at 1
+    byte/element for int8/fp8, one f32 scale per wire_block of the
+    trailing dim; fp chunks ship fp_itemsize/element, no sidecar)."""
+    n = 1
+    for s in shape:
+        n *= int(s)
+    if wire == "off" or not shape or n == 0:
+        return (n * int(fp_itemsize), 0)
+    d = int(shape[-1])
+    blocks = d // wire_block(d)
+    return (n, (n // d) * blocks * 4)
+
+
+def record_wire(kind, wire, elems, last_dim, fp_itemsize=4):
+    """Telemetry counters for one quantized-wire dispatch decision
+    (called next to record_dispatch, never from a traced ring body):
+    ``collective.quantized.<kind>`` on take, plus the wire-savings
+    counters ``collective.wire_bytes_quantized`` (payload + scale
+    sidecar bytes actually shipped) and
+    ``collective.wire_bytes_saved`` (fp bytes avoided).
+
+    ``elems`` is the TOTAL element count this dispatch's program moves
+    over ICI — every hop of every ring it emits, the unit every
+    dispatch site computes so the aggregate counter stays one
+    currency (ag_mm: (ws-1) rotating-shard chunks; mm_rs: (ws-1)
+    carry chunks; mm_ar: carry ring + re-gather; dp_ar/moe_a2a: both
+    directions) — and ``last_dim`` the trailing dim the scale blocks
+    tile. A no-op when the wire is off or FLAGS_telemetry is off."""
+    if wire == "off":
+        return
+    from ...framework import telemetry
+
+    reg = telemetry.registry()
+    if reg is None:
+        return
+    elems = int(elems)
+    last_dim = max(int(last_dim), 1)
+    payload, scales = wire_chunk_bytes(
+        (max(elems // last_dim, 1), last_dim), wire, fp_itemsize)
+    reg.inc("collective.quantized." + str(kind))
+    reg.inc("collective.wire_bytes_quantized", payload + scales)
+    reg.inc("collective.wire_bytes_saved",
+            max(elems * int(fp_itemsize) - payload - scales, 0))
+
+
+# ---------------------------------------------------------------------------
+# quantize-on-the-wire kernels (EQuARX-style block scaling)
+# ---------------------------------------------------------------------------
+
+
+def _quant_wire(x, wire):
+    """Block-scaled wire quantization of one ring payload: symmetric
+    absmax blocks of wire_block(d) along the trailing dim. Returns
+    (payload int8/fp8 of x.shape, scales f32 (..., d // block))."""
+    d = x.shape[-1]
+    b = wire_block(d)
+    xe = x.astype(jnp.float32).reshape(x.shape[:-1] + (d // b, b))
+    s = jnp.maximum(
+        jnp.max(jnp.abs(xe), axis=-1) / _WIRE_QMAX[wire], 1e-20)
+    q = xe / s[..., None]
+    if wire == "fp8":
+        q = q.astype(_fp8_dtype())
+    else:
+        q = jnp.clip(jnp.round(q), -127.0, 127.0).astype(jnp.int8)
+    return q.reshape(x.shape), s
+
+
+def _dequant_wire(q, s, dtype):
+    """Inverse of :func:`_quant_wire` (block count inferred from the
+    scale sidecar's trailing dim)."""
+    d = q.shape[-1]
+    b = d // s.shape[-1]
+    xe = q.astype(jnp.float32).reshape(q.shape[:-1] + (s.shape[-1], b))
+    return (xe * s[..., None]).reshape(q.shape).astype(dtype)
+
+
+def _wire_send(x, axis_name, perm, wire):
+    """One ring hop of ``x``: quantized payload + per-block scale
+    sidecar when the wire dtype is on, the raw fp chunk otherwise.
+    The off path emits EXACTLY the prior single ppermute — the
+    bitwise FLAGS_collective_dtype=off pin depends on it."""
+    if wire == "off":
+        return jax.lax.ppermute(x, axis_name, perm)
+    q, s = _quant_wire(x, wire)
+    q = jax.lax.ppermute(q, axis_name, perm)
+    s = jax.lax.ppermute(s, axis_name, perm)
+    return _dequant_wire(q, s, x.dtype)
+
+
+def _wire_all_gather_raw(x, axis_name, axis, wire):
+    """Tiled all_gather with the payload quantized on the wire (no
+    VJP of its own — callers sit inside hand-written backwards or
+    wrap it in one)."""
+    if wire == "off":
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+    q, s = _quant_wire(x, wire)
+    q = jax.lax.all_gather(q, axis_name, axis=axis, tiled=True)
+    s = jax.lax.all_gather(s, axis_name, axis=axis, tiled=True)
+    return _dequant_wire(q, s, x.dtype)
+
+
+def _ring_rs(x, axis_name, ws, axis, wire):
+    """Chunked ring reduce-scatter of ``x`` along ``axis`` (the
+    psum_scatter decomposition shared by ring_all_reduce and the
+    quantized re-gather transpose): the partial-sum carry rotates one
+    (optionally quantized) hop per step; after ws steps the carry at
+    device d is the fully reduced chunk d."""
+    my = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(ws)
+    s_loc = x.shape[axis] // ws
+    carry = None
+    for t in range(ws):
+        c = (my - 1 - t) % ws
+        p = _chunk(x, c, s_loc, axis)
+        carry = p if carry is None else \
+            _wire_send(carry, axis_name, perm, wire) + p
+    return carry
+
+
+# ---------------------------------------------------------------------------
 # ring helpers
 # ---------------------------------------------------------------------------
 
@@ -171,8 +395,8 @@ def _batch_dims(x):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
-def _ag_matmul(axis_name, ws, gather_axis, x, w):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _ag_matmul(axis_name, ws, gather_axis, wire, x, w):
     my = jax.lax.axis_index(axis_name)
     perm = _ring_perm(ws)
     s_loc = x.shape[gather_axis]
@@ -187,18 +411,19 @@ def _ag_matmul(axis_name, ws, gather_axis, x, w):
         src = (my - t) % ws
         out = _put_chunk(out, part, src, s_loc, gather_axis)
         if t < ws - 1:
-            cur = jax.lax.ppermute(cur, axis_name, perm)
+            cur = _wire_send(cur, axis_name, perm, wire)
     return out
 
 
-def _ag_matmul_fwd(axis_name, ws, gather_axis, x, w):
-    return _ag_matmul(axis_name, ws, gather_axis, x, w), (x, w)
+def _ag_matmul_fwd(axis_name, ws, gather_axis, wire, x, w):
+    return _ag_matmul(axis_name, ws, gather_axis, wire, x, w), (x, w)
 
 
-def _ag_matmul_bwd(axis_name, ws, gather_axis, res, ct):
+def _ag_matmul_bwd(axis_name, ws, gather_axis, wire, res, ct):
     # dx = psum_scatter(ct @ w^T, gather_axis)  -> carry ring
     # dw = AG(x)^T @ ct                          -> shard ring
-    # one fused loop, two in-flight ppermutes per step
+    # one fused loop, two in-flight ppermutes per step; both rings'
+    # hops quantize on the wire like the forward's
     x, w = res
     my = jax.lax.axis_index(axis_name)
     perm = _ring_perm(ws)
@@ -214,25 +439,29 @@ def _ag_matmul_bwd(axis_name, ws, gather_axis, res, ct):
         if carry is None:
             carry = p
         else:
-            carry = jax.lax.ppermute(carry, axis_name, perm) + p
+            carry = _wire_send(carry, axis_name, perm, wire) + p
         src = (my - t) % ws
         contrib = jnp.tensordot(
             cur, _chunk(ct, src, s_loc, gather_axis), axes=(dims, dims))
         dw = contrib if dw is None else dw + contrib
         if t < ws - 1:
-            cur = jax.lax.ppermute(cur, axis_name, perm)
+            cur = _wire_send(cur, axis_name, perm, wire)
     return carry, dw.astype(w.dtype)
 
 
 _ag_matmul.defvjp(_ag_matmul_fwd, _ag_matmul_bwd)
 
 
-def all_gather_matmul(x, w, *, axis_name, axis_size, gather_axis=0):
+def all_gather_matmul(x, w, *, axis_name, axis_size, gather_axis=0,
+                      wire="off"):
     """Ring-decomposed ``all_gather(x, gather_axis) @ w`` over a manual
     mesh axis. x: the LOCAL shard (chunk ``axis_index`` of the gathered
     operand); w: the local weight (full or column-shard — the ring
-    never moves it). Output carries the full gathered leading dim."""
-    return _ag_matmul(axis_name, int(axis_size), int(gather_axis), x, w)
+    never moves it). Output carries the full gathered leading dim.
+    ``wire`` quantizes every hop's payload (FLAGS_collective_dtype,
+    resolved by the dispatcher)."""
+    return _ag_matmul(
+        axis_name, int(axis_size), int(gather_axis), str(wire), x, w)
 
 
 # ---------------------------------------------------------------------------
@@ -240,8 +469,8 @@ def all_gather_matmul(x, w, *, axis_name, axis_size, gather_axis=0):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
-def _matmul_rs(axis_name, ws, scatter_axis, x, w):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _matmul_rs(axis_name, ws, scatter_axis, wire, x, w):
     my = jax.lax.axis_index(axis_name)
     perm = _ring_perm(ws)
     s_loc = x.shape[scatter_axis] // ws
@@ -252,15 +481,15 @@ def _matmul_rs(axis_name, ws, scatter_axis, x, w):
         if carry is None:
             carry = p
         else:
-            carry = jax.lax.ppermute(carry, axis_name, perm) + p
+            carry = _wire_send(carry, axis_name, perm, wire) + p
     return carry
 
 
-def _matmul_rs_fwd(axis_name, ws, scatter_axis, x, w):
-    return _matmul_rs(axis_name, ws, scatter_axis, x, w), (x, w)
+def _matmul_rs_fwd(axis_name, ws, scatter_axis, wire, x, w):
+    return _matmul_rs(axis_name, ws, scatter_axis, wire, x, w), (x, w)
 
 
-def _matmul_rs_bwd(axis_name, ws, scatter_axis, res, ct):
+def _matmul_rs_bwd(axis_name, ws, scatter_axis, wire, res, ct):
     # dx = AG(ct, scatter_axis) @ w^T  and  dw = x^T @ AG(ct): both
     # consume the rotating ct shard — a single ring serves both.
     x, w = res
@@ -284,19 +513,22 @@ def _matmul_rs_bwd(axis_name, ws, scatter_axis, res, ct):
             _chunk(x, src, s_loc, scatter_axis), cur, axes=(dims, dims))
         dw = contrib if dw is None else dw + contrib
         if t < ws - 1:
-            cur = jax.lax.ppermute(cur, axis_name, perm)
+            cur = _wire_send(cur, axis_name, perm, wire)
     return dx, dw.astype(w.dtype)
 
 
 _matmul_rs.defvjp(_matmul_rs_fwd, _matmul_rs_bwd)
 
 
-def matmul_reduce_scatter(x, w, *, axis_name, axis_size, scatter_axis=0):
+def matmul_reduce_scatter(x, w, *, axis_name, axis_size, scatter_axis=0,
+                          wire="off"):
     """Ring-decomposed ``psum_scatter(x @ w, scatter_axis)`` over a
     manual mesh axis. x: local rows with the FULL scatter dim (it must
     divide axis_size); w: the local (row-shard) weight. Output holds
-    this device's reduced chunk of the scatter dim."""
-    return _matmul_rs(axis_name, int(axis_size), int(scatter_axis), x, w)
+    this device's reduced chunk of the scatter dim. ``wire`` quantizes
+    the rotating partial-sum carry on every hop."""
+    return _matmul_rs(
+        axis_name, int(axis_size), int(scatter_axis), str(wire), x, w)
 
 
 # -- tiled re-gather with the eager-tape VJP convention ---------------------
@@ -307,16 +539,16 @@ def matmul_reduce_scatter(x, w, *, axis_name, axis_size, scatter_axis=0):
 # gather slices this device's chunk instead — the _c_concat rule.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
-def _tape_all_gather(axis_name, ws, axis, x):
-    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _tape_all_gather(axis_name, ws, axis, wire, x):
+    return _wire_all_gather_raw(x, axis_name, axis, wire)
 
 
-def _tape_ag_fwd(axis_name, ws, axis, x):
-    return _tape_all_gather(axis_name, ws, axis, x), x.shape[axis]
+def _tape_ag_fwd(axis_name, ws, axis, wire, x):
+    return _tape_all_gather(axis_name, ws, axis, wire, x), x.shape[axis]
 
 
-def _tape_ag_bwd(axis_name, ws, axis, s_loc, ct):
+def _tape_ag_bwd(axis_name, ws, axis, wire, s_loc, ct):
     my = jax.lax.axis_index(axis_name)
     return (_chunk(ct, my, s_loc, axis),)
 
@@ -324,23 +556,49 @@ def _tape_ag_bwd(axis_name, ws, axis, s_loc, ct):
 _tape_all_gather.defvjp(_tape_ag_fwd, _tape_ag_bwd)
 
 
+# quantized tiled re-gather under shard_map transpose semantics: jax
+# cannot differentiate through round(), so the quantized gather needs
+# its own VJP — the transpose of a tiled all_gather is psum_scatter,
+# run here as the quantized ring reduce-scatter (the backward wire
+# shrinks with the forward's)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _wire_all_gather(axis_name, ws, axis, wire, x):
+    return _wire_all_gather_raw(x, axis_name, axis, wire)
+
+
+def _wire_ag_fwd(axis_name, ws, axis, wire, x):
+    return _wire_all_gather(axis_name, ws, axis, wire, x), None
+
+
+def _wire_ag_bwd(axis_name, ws, axis, wire, _, ct):
+    return (_ring_rs(ct, axis_name, ws, axis, wire),)
+
+
+_wire_all_gather.defvjp(_wire_ag_fwd, _wire_ag_bwd)
+
+
 def matmul_all_reduce(x, w, *, axis_name, axis_size, scatter_axis=0,
-                      tape_ct=False):
+                      tape_ct=False, wire="off"):
     """Ring-decomposed ``psum(x @ w)``: the matmul-reduce-scatter ring
     (the reduction half, overlapped) followed by a tiled re-gather of
     the reduced chunks (the only blocking half left). ``tape_ct=True``
     selects the eager-tape backward convention of the framework's
     manual regions for the re-gather (replicated, already-complete
     cotangents are SLICED, not psum-scattered — the same convention
-    switch matmul_all_gather takes)."""
+    switch matmul_all_gather takes). ``wire`` quantizes both halves:
+    the carry ring's hops and the re-gather's payload."""
+    wire = str(wire)
     part = matmul_reduce_scatter(
         x, w, axis_name=axis_name, axis_size=axis_size,
-        scatter_axis=scatter_axis)
+        scatter_axis=scatter_axis, wire=wire)
     if tape_ct:
         return _tape_all_gather(
-            axis_name, int(axis_size), int(scatter_axis), part)
-    return jax.lax.all_gather(
-        part, axis_name, axis=scatter_axis, tiled=True)
+            axis_name, int(axis_size), int(scatter_axis), wire, part)
+    if wire == "off":
+        return jax.lax.all_gather(
+            part, axis_name, axis=scatter_axis, tiled=True)
+    return _wire_all_gather(
+        axis_name, int(axis_size), int(scatter_axis), wire, part)
 
 
 # ---------------------------------------------------------------------------
@@ -348,8 +606,8 @@ def matmul_all_reduce(x, w, *, axis_name, axis_size, scatter_axis=0,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
-def _matmul_ag(axis_name, ws, tape_ct, x, w):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _matmul_ag(axis_name, ws, tape_ct, wire, x, w):
     my = jax.lax.axis_index(axis_name)
     perm = _ring_perm(ws)
     n_loc = w.shape[1]
@@ -365,15 +623,15 @@ def _matmul_ag(axis_name, ws, tape_ct, x, w):
         src = (my - t) % ws
         out = _put_chunk(out, part, src, n_loc, axis)
         if t < ws - 1:
-            cur = jax.lax.ppermute(cur, axis_name, perm)
+            cur = _wire_send(cur, axis_name, perm, wire)
     return out
 
 
-def _matmul_ag_fwd(axis_name, ws, tape_ct, x, w):
-    return _matmul_ag(axis_name, ws, tape_ct, x, w), (x, w)
+def _matmul_ag_fwd(axis_name, ws, tape_ct, wire, x, w):
+    return _matmul_ag(axis_name, ws, tape_ct, wire, x, w), (x, w)
 
 
-def _matmul_ag_bwd(axis_name, ws, tape_ct, res, ct):
+def _matmul_ag_bwd(axis_name, ws, tape_ct, wire, res, ct):
     # dx = ct @ W_full^T = sum over column chunks (rotate w again; the
     # ring sums every weight shard locally, REPLACING the plain path's
     # grad psum). dw = x^T @ (the summed-over-devices ct chunk that hit
@@ -405,9 +663,9 @@ def _matmul_ag_bwd(axis_name, ws, tape_ct, res, ct):
             if carry is None:
                 carry = piece
             else:
-                carry = jax.lax.ppermute(carry, axis_name, perm) + piece
+                carry = _wire_send(carry, axis_name, perm, wire) + piece
         if t < ws - 1:
-            cur = jax.lax.ppermute(cur, axis_name, perm)
+            cur = _wire_send(cur, axis_name, perm, wire)
     if tape_ct:
         carry = _chunk(ct, my, n_loc, axis)
     dw = jnp.tensordot(x, carry, axes=(dims, dims))
@@ -417,7 +675,8 @@ def _matmul_ag_bwd(axis_name, ws, tape_ct, res, ct):
 _matmul_ag.defvjp(_matmul_ag_fwd, _matmul_ag_bwd)
 
 
-def matmul_all_gather(x, w, *, axis_name, axis_size, tape_ct=False):
+def matmul_all_gather(x, w, *, axis_name, axis_size, tape_ct=False,
+                      wire="off"):
     """Ring-decomposed ``all_gather(x @ w, axis=-1)`` over a manual
     mesh axis, rotating the WEIGHT column-shard (K x N/w bytes per hop
     instead of the S x N/w output chunk). x: local activations
@@ -425,5 +684,112 @@ def matmul_all_gather(x, w, *, axis_name, axis_size, tape_ct=False):
     is the full gathered feature dim, identical on every device.
     ``tape_ct=True`` selects the eager-tape backward convention of the
     framework's manual regions (replicated, already-complete
-    cotangents) instead of shard_map transpose semantics."""
-    return _matmul_ag(axis_name, int(axis_size), bool(tape_ct), x, w)
+    cotangents) instead of shard_map transpose semantics. ``wire``
+    quantizes the rotating weight shard (and the backward's cotangent
+    carry) on every hop."""
+    return _matmul_ag(
+        axis_name, int(axis_size), bool(tape_ct), str(wire), x, w)
+
+
+# ---------------------------------------------------------------------------
+# ring_all_reduce: the DP gradient-sync psum as a chunked ring
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ring_ar(axis_name, ws, wire, x):
+    flat = x.reshape((x.size,))
+    part = _ring_rs(flat, axis_name, ws, 0, wire)
+    full = _wire_all_gather_raw(part, axis_name, 0, wire)
+    return full.reshape(x.shape)
+
+
+def _ring_ar_fwd(axis_name, ws, wire, x):
+    return _ring_ar(axis_name, ws, wire, x), None
+
+
+def _ring_ar_bwd(axis_name, ws, wire, _, ct):
+    # the grad-sync convention (mp_ops._mp_allreduce): psum forward,
+    # identity backward — under the eager tape the cotangent arrives
+    # replicated and already complete
+    return (ct,)
+
+
+_ring_ar.defvjp(_ring_ar_fwd, _ring_ar_bwd)
+
+
+def ring_all_reduce(x, *, axis_name, axis_size, wire="off"):
+    """Chunked ring all-reduce: ring reduce-scatter (the overlapped
+    half — every hop is in flight while the next chunk adds) plus a
+    tiled re-gather, both optionally quantized on the wire. The
+    blocking-psum replacement for DP gradient sync
+    (fleet/utils/hybrid_parallel_util.py routes here via
+    mp_ops.grad_allreduce_dispatch). ``axis_size`` must divide
+    ``x.size`` — callers decline to the plain psum otherwise."""
+    return _ring_ar(axis_name, int(axis_size), str(wire), x)
+
+
+# ---------------------------------------------------------------------------
+# expert_alltoall_ffn: the MoE expert-parallel a2a pair, overlapped
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _wire_hop(axis_name, perm, wire, x):
+    """One a2a block hop with its own VJP: jax's transpose cannot see
+    through round(), so the cotangent rides the INVERSE permutation,
+    quantized the same way as the forward payload."""
+    return _wire_send(x, axis_name, list(perm), wire)
+
+
+def _wire_hop_fwd(axis_name, perm, wire, x):
+    return _wire_hop(axis_name, perm, wire, x), None
+
+
+def _wire_hop_bwd(axis_name, perm, wire, _, ct):
+    inv = tuple((dst, src) for src, dst in perm)
+    return (_wire_send(ct, axis_name, list(inv), wire),)
+
+
+_wire_hop.defvjp(_wire_hop_fwd, _wire_hop_bwd)
+
+
+def expert_alltoall_ffn(x, w0, b0, w1, b1, *, axis_name, axis_size,
+                        ffn, act, wire="off"):
+    """Chunked-ppermute decomposition of the MoE expert-parallel
+    ``all_to_all(dispatch) -> expert FFN -> all_to_all(combine)``
+    chain (moe_layer._expert_compute's manual path).
+
+    x: the local (E, C, d) dispatch buffer, E grouped by owning rank
+    (axis_size must divide E — the dispatcher declines otherwise).
+    Hop t ships the block destined for peer ``my + t`` while the FFN
+    of the block received at hop t-1 runs, and each result block
+    returns on the inverse permutation as soon as it is computed —
+    expert compute hides the dispatch/combine wire the blocking
+    all_to_all pair serializes. Total wire equals the blocking pair's
+    exactly ((ws-1)/ws of each buffer per direction), optionally
+    quantized per block. ``ffn(block, w0, b0, w1, b1, act)`` is the
+    caller's batched expert FFN (single definition stays in
+    moe_layer.py so the two paths cannot drift)."""
+    ws = int(axis_size)
+    wire = str(wire)
+    e = x.shape[0]
+    e_loc = e // ws
+    my = jax.lax.axis_index(axis_name)
+    xg = x.reshape((ws, e_loc) + tuple(x.shape[1:]))
+    out = None
+    for t in range(ws):
+        blk_idx = (my + t) % ws
+        blk = jax.lax.dynamic_index_in_dim(
+            xg, blk_idx, 0, keepdims=False)
+        if t:
+            fwd_perm = tuple((i, (i + t) % ws) for i in range(ws))
+            blk = _wire_hop(axis_name, fwd_perm, wire, blk)
+        y = ffn(blk, w0, b0, w1, b1, act)
+        if t:
+            ret_perm = tuple((i, (i - t) % ws) for i in range(ws))
+            y = _wire_hop(axis_name, ret_perm, wire, y)
+        if out is None:
+            out = jnp.zeros((ws,) + tuple(y.shape), y.dtype)
+        out = jax.lax.dynamic_update_index_in_dim(out, y, blk_idx, 0)
+    return out.reshape((e,) + tuple(out.shape[2:]))

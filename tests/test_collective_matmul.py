@@ -4,7 +4,16 @@ all_gather-matmul / matmul-reduce_scatter / matmul-all_gather must be
 numerically equivalent to the plain blocking chains — forward AND
 grads — on CPU meshes at mp in {2, 4}, with odd chunk remainders and
 in bf16 as well as fp32; and FLAGS_collective_matmul=off must restore
-the exact prior lowering (bit-identical jaxpr)."""
+the exact prior lowering (bit-identical jaxpr).
+
+ISSUE 14 additions: quantize-on-the-wire (FLAGS_collective_dtype) —
+int8/fp8 block-scaled ring payloads must stay within quantization
+tolerance of the fp chains fwd+grads, 'off' must keep the ring
+lowering bit-identical (jaxpr pin), and the wire must auto-decline
+below FLAGS_collective_matmul_min_bytes; the DP grad-sync ring
+(ring_all_reduce + mp_ops.grad_allreduce_dispatch) and the MoE
+expert all-to-all overlap (expert_alltoall_ffn) ride the same
+pattern — parity fwd+grads, odd chunk counts, decline-on-indivisible."""
 import contextlib
 import functools
 
@@ -471,3 +480,543 @@ class TestManualContext:
             np.testing.assert_allclose(
                 np.asarray(a, np.float32), np.asarray(b, np.float32),
                 rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# quantize-on-the-wire (ISSUE 14, FLAGS_collective_dtype)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.ops.kernels.collective_matmul import (  # noqa: E402
+    expert_alltoall_ffn,
+    ring_all_reduce,
+)
+
+_HAS_FP8 = cm._fp8_dtype() is not None
+_WIRES = ["int8"] + (["fp8"] if _HAS_FP8 else [])
+
+# relative-to-absmax tolerance: int8 block scaling is ~0.8% per
+# element; fp8 e4m3 (3 mantissa bits) ~6%; ring sums accumulate a few
+# hops' worth on top
+_WIRE_TOL = {"int8": 0.05, "fp8": 0.2}
+
+
+def _assert_close_rel(got, ref, tol):
+    got = np.asarray(got, np.float32)
+    ref = np.asarray(ref, np.float32)
+    scale = max(float(np.abs(ref).max()), 1e-6)
+    assert float(np.abs(got - ref).max()) / scale < tol, (
+        float(np.abs(got - ref).max()), scale)
+
+
+class TestWirePolicy:
+    def test_wire_dtype_normalization(self):
+        with flags(collective_dtype="int8"):
+            assert cm.wire_dtype() == "int8"
+        with flags(collective_dtype="bogus"):
+            assert cm.wire_dtype() == "off"
+        with flags(collective_dtype="off"):
+            assert cm.wire_dtype() == "off"
+        if _HAS_FP8:
+            with flags(collective_dtype="fp8"):
+                assert cm.wire_dtype() == "fp8"
+
+    def test_resolve_wire_thresholds(self):
+        with flags(collective_dtype="int8",
+                   collective_matmul_min_bytes=1024):
+            assert cm.resolve_wire(2048) == "int8"
+            assert cm.resolve_wire(512) == "off"
+            assert cm.wire_decline_reason(512) == "below_threshold"
+        with flags(collective_dtype="off"):
+            assert cm.resolve_wire(1 << 40) == "off"
+            assert cm.wire_decline_reason(1 << 40) == "off"
+
+    def test_resolve_wire_sidecar_overhead_declines(self):
+        # a trailing dim with no usable divisor (prime 8191) blocks at
+        # 1 elt/scale: 1 B payload + 4 B sidecar per element is MORE
+        # wire than the 4 B fp it replaces — the policy must decline
+        with flags(collective_dtype="int8",
+                   collective_matmul_min_bytes=1):
+            assert cm.wire_decline_reason(1 << 20, 8191) \
+                == "sidecar_overhead"
+            assert cm.resolve_wire(1 << 20, 8191) == "off"
+            assert cm.resolve_wire(1 << 20, 8192) == "int8"
+            # unknown trailing dim: the gate cannot judge, wire stays
+            assert cm.resolve_wire(1 << 20) == "int8"
+
+    def test_wire_block_divides(self):
+        assert cm.wire_block(1024) == 128
+        assert cm.wire_block(96) == 96
+        assert cm.wire_block(200) == 100
+        assert cm.wire_block(7) == 7
+        assert cm.wire_block(1) == 1
+
+    def test_wire_chunk_bytes_exact(self):
+        # int8 payload at 1 byte/elt + one f32 scale per block
+        pay, sc = cm.wire_chunk_bytes((256, 1024), "int8")
+        assert pay == 256 * 1024
+        assert sc == 256 * (1024 // 128) * 4
+        pay, sc = cm.wire_chunk_bytes((4, 6), "off")
+        assert (pay, sc) == (4 * 6 * 4, 0)
+
+    def test_record_wire_counters(self):
+        from paddle_tpu.framework import telemetry
+
+        telemetry.reset()
+        try:
+            with flags(telemetry="metrics"):
+                cm.record_wire("ag_mm", "int8", 1024 * 64, 64, 4)
+                coll = telemetry.registry().snapshot()["collective"]
+                assert coll["quantized.ag_mm"] == 1
+                pay, sc = cm.wire_chunk_bytes((1024, 64), "int8")
+                assert coll["wire_bytes_quantized"] == pay + sc
+                assert coll["wire_bytes_saved"] \
+                    == 1024 * 64 * 4 - pay - sc
+                # off wire records nothing
+                cm.record_wire("ag_mm", "off", 1024, 64, 4)
+                coll2 = telemetry.registry().snapshot()["collective"]
+                assert coll2["quantized.ag_mm"] == 1
+        finally:
+            telemetry.reset()
+
+
+@pytest.fixture
+def mp4_mesh():
+    """Multi-hop ring mesh for the quantized-parity tier: ws=4
+    exercises requantization chains (a ws=2 ring has ONE hop, which a
+    single quant round trip would also pass); the fp32 rings already
+    cover both degrees above, so quantized parity pins one mesh to
+    keep the tier-1 wall in budget."""
+    reset_mesh()
+    mesh = build_global_mesh(("mp",), (4,))
+    yield 4, mesh
+    reset_mesh()
+
+
+class TestQuantizedRings:
+    """Kernel-level parity of the quantized rings vs the plain
+    blocking chains, fwd + grads (the custom-VJP backwards quantize
+    their cotangent rings — parity here covers them). fp8 rides one
+    representative ring (ag_mm — same _wire_send + hand-written
+    backward machinery everywhere); the other rings pin int8 to keep
+    the tier-1 wall inside budget."""
+
+    def _check(self, f_plain, f_ring, x, w, cot, tol):
+        _assert_close_rel(f_ring(x, w), f_plain(x, w), tol)
+
+        def loss(fn):
+            return lambda a, b: jnp.sum(
+                fn(a, b).astype(jnp.float32) * cot.astype(jnp.float32))
+
+        g_p = jax.grad(loss(f_plain), argnums=(0, 1))(x, w)
+        g_r = jax.grad(loss(f_ring), argnums=(0, 1))(x, w)
+        for a, b in zip(g_p, g_r):
+            _assert_close_rel(b, a, tol)
+
+    @pytest.mark.parametrize("wire", _WIRES)
+    def test_all_gather_matmul_quantized(self, mp4_mesh, wire):
+        ws, mesh = mp4_mesh
+        x, w, cot = _data(ws, jnp.float32)
+        specs = dict(in_specs=(P("mp", None, None), P(None, "mp")),
+                     out_specs=P(None, None, "mp"))
+        plain = shard_map(
+            lambda xl, wl: jnp.matmul(
+                jax.lax.all_gather(xl, "mp", axis=0, tiled=True), wl),
+            mesh=mesh, **specs)
+        ring = shard_map(
+            functools.partial(cm.all_gather_matmul, axis_name="mp",
+                              axis_size=ws, gather_axis=0, wire=wire),
+            mesh=mesh, **specs)
+        self._check(plain, ring, x, w, cot, _WIRE_TOL[wire])
+
+    @pytest.mark.parametrize("wire", ["int8"])
+    def test_matmul_reduce_scatter_quantized(self, mp4_mesh, wire):
+        ws, mesh = mp4_mesh
+        x, w, cot = _data(ws, jnp.float32)
+        specs = dict(in_specs=(P(None, None, "mp"), P("mp", None)),
+                     out_specs=P("mp", None, None))
+        plain = shard_map(
+            lambda xl, wl: jax.lax.psum_scatter(
+                jnp.matmul(xl, wl), "mp", scatter_dimension=0,
+                tiled=True),
+            mesh=mesh, **specs)
+        ring = shard_map(
+            functools.partial(cm.matmul_reduce_scatter,
+                              axis_name="mp", axis_size=ws,
+                              scatter_axis=0, wire=wire),
+            mesh=mesh, **specs)
+        self._check(plain, ring, x, w, cot,
+                    _WIRE_TOL[wire] * (2 if wire == "fp8" else 1))
+
+    @pytest.mark.parametrize("wire", ["int8"])
+    def test_matmul_all_gather_quantized(self, mp4_mesh, wire):
+        ws, mesh = mp4_mesh
+        x, w, cot = _data(ws, jnp.float32)
+        specs = dict(in_specs=(P(None, None, None), P(None, "mp")),
+                     out_specs=P(None, None, None))
+        plain = shard_map(
+            lambda xl, wl: jax.lax.all_gather(
+                jnp.matmul(xl, wl), "mp", axis=2, tiled=True),
+            mesh=mesh, **specs)
+        ring = shard_map(
+            functools.partial(cm.matmul_all_gather, axis_name="mp",
+                              axis_size=ws, wire=wire),
+            mesh=mesh, **specs)
+        self._check(plain, ring, x, w, cot, _WIRE_TOL[wire])
+
+    @pytest.mark.parametrize("wire", ["int8"])
+    def test_matmul_all_reduce_quantized(self, mp4_mesh, wire):
+        ws, mesh = mp4_mesh
+        x, w, cot = _data(ws, jnp.float32)
+        cot_full = jnp.asarray(
+            np.random.RandomState(7).randn(*x.shape[:-1], N),
+            jnp.float32)
+        specs = dict(in_specs=(P(None, None, "mp"), P("mp", None)),
+                     out_specs=P(None, None, None))
+        plain = shard_map(
+            lambda xl, wl: jax.lax.psum(jnp.matmul(xl, wl), "mp"),
+            mesh=mesh, **specs)
+        ring = shard_map(
+            functools.partial(cm.matmul_all_reduce, axis_name="mp",
+                              axis_size=ws, scatter_axis=0,
+                              wire=wire),
+            mesh=mesh, **specs)
+        self._check(plain, ring, x, w, cot_full,
+                    _WIRE_TOL[wire] * (2 if wire == "fp8" else 1))
+
+
+class TestQuantizedLowering:
+    """Jaxpr pins: FLAGS_collective_dtype=off keeps the ring lowering
+    bit-identical (no quantized converts, same jaxpr as the default
+    trace); int8 adds the payload + scale-sidecar hops; the wire
+    auto-declines below FLAGS_collective_matmul_min_bytes."""
+
+    def _trace_row_parallel(self, x):
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+            RowParallelLinear,
+        )
+
+        paddle.seed(0)
+        with paddle.utils.unique_name.guard():
+            layer = RowParallelLinear(32, 16, has_bias=False,
+                                      input_is_parallel=True)
+        return str(jax.make_jaxpr(
+            lambda xr: layer(paddle.to_tensor(xr))._data)(x))
+
+    @staticmethod
+    def _sig(closed_str_or_jaxpr):
+        """Structural lowering signature: every equation's primitive,
+        operand/result avals, and plain static params, recursively —
+        the content of the lowering without the custom_vjp closure
+        reprs whose embedded object addresses vary per trace."""
+        from paddle_tpu.framework.analysis import _sub_jaxprs
+
+        out = []
+
+        def walk(jaxpr, depth):
+            for eqn in jaxpr.eqns:
+                out.append((
+                    depth, eqn.primitive.name,
+                    tuple(str(getattr(v, "aval", "")) for v in
+                          eqn.invars),
+                    tuple(str(getattr(v, "aval", "")) for v in
+                          eqn.outvars),
+                    tuple(sorted(
+                        (k, str(v)) for k, v in eqn.params.items()
+                        if isinstance(v, (int, float, str, bool,
+                                          tuple, frozenset))))))
+                for sub in _sub_jaxprs(eqn):
+                    walk(sub, depth + 1)
+
+        walk(closed_str_or_jaxpr.jaxpr, 0)
+        return out
+
+    def _trace_row_parallel_jaxpr(self, x):
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+            RowParallelLinear,
+        )
+
+        paddle.seed(0)
+        with paddle.utils.unique_name.guard():
+            layer = RowParallelLinear(32, 16, has_bias=False,
+                                      input_is_parallel=True)
+        return jax.make_jaxpr(
+            lambda xr: layer(paddle.to_tensor(xr))._data)(x)
+
+    def test_off_is_bitwise_prior_ring_lowering(self, mp_grid):
+        x = np.random.RandomState(0).randn(8, 6, 32).astype("float32")
+        with flags(collective_matmul="on"):
+            j_default = self._trace_row_parallel_jaxpr(x)
+        with flags(collective_matmul="on", collective_dtype="off"):
+            j_off = self._trace_row_parallel_jaxpr(x)
+        assert self._sig(j_off) == self._sig(j_default)
+        s = str(j_off)
+        assert "i8" not in s and "f8" not in s
+
+    def test_int8_wire_changes_lowering(self, mp_grid):
+        x = np.random.RandomState(0).randn(8, 6, 32).astype("float32")
+        with flags(collective_matmul="on", collective_dtype="int8",
+                   collective_matmul_min_bytes=1):
+            j_q = self._trace_row_parallel(x)
+        assert "i8" in j_q
+        assert "ppermute" in j_q
+
+    def test_wire_auto_declines_below_threshold(self, mp_grid):
+        # ring engages (flag on) but the wire stays fp: the payload is
+        # far below the min-bytes floor
+        x = np.random.RandomState(0).randn(8, 6, 32).astype("float32")
+        with flags(collective_matmul="on", collective_dtype="int8",
+                   collective_matmul_min_bytes=1 << 40):
+            j = self._trace_row_parallel(x)
+        assert "ppermute" in j
+        assert "i8" not in j
+
+    def test_quantized_layer_matches_plain(self, mp_grid):
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+            RowParallelLinear,
+        )
+
+        x = np.random.RandomState(5).randn(8, 12, 32).astype("float32")
+        ctor = lambda: RowParallelLinear(  # noqa: E731
+            32, 16, has_bias=True, input_is_parallel=True)
+        ref = _run_layer(ctor, x, "off")
+        with flags(collective_dtype="int8",
+                   collective_matmul_min_bytes=1):
+            got = _run_layer(ctor, x, "on")
+        for a, b in zip(got, ref):
+            _assert_close_rel(a, b, 0.05)
+
+
+# ---------------------------------------------------------------------------
+# DP gradient-sync ring (ring_all_reduce + grad_allreduce_dispatch)
+# ---------------------------------------------------------------------------
+
+
+class TestGradSyncRing:
+    def test_ring_all_reduce_matches_psum(self, mp4_mesh):
+        ws, mesh = mp4_mesh
+        rng = np.random.RandomState(0)
+        g = jnp.asarray(rng.randn(ws * 2, 6, 8), jnp.float32)
+        specs = dict(in_specs=P("mp", None, None),
+                     out_specs=P("mp", None, None))
+        plain = shard_map(lambda v: jax.lax.psum(v, "mp"),
+                          mesh=mesh, **specs)
+        ref = np.asarray(plain(g))
+        for wire, tol in (("off", 1e-5), ("int8", 0.05)):
+            ring = shard_map(
+                functools.partial(ring_all_reduce, axis_name="mp",
+                                  axis_size=ws, wire=wire),
+                mesh=mesh, **specs)
+            _assert_close_rel(ring(g), ref, tol)
+
+    def test_dispatch_rings_in_manual_region(self, mp_grid):
+        """grad_allreduce_dispatch replaces the blocking psum inside a
+        manual region; outside one (GSPMD grads are already reduced)
+        and under FLAGS_collective_matmul=off it declines (None)."""
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_ops import (
+            grad_allreduce_dispatch,
+        )
+        from paddle_tpu.distributed.mesh import (
+            global_mesh,
+            manual_axes,
+        )
+        from paddle_tpu.framework.core import Tensor
+
+        ws = mp_grid
+        mesh = global_mesh()
+        rng = np.random.RandomState(1)
+        g = rng.randn(ws * 3, 4).astype("float32")
+
+        def run(mode, wire="off"):
+            def local(gl):
+                with manual_axes(("mp",)):
+                    with flags(collective_matmul=mode,
+                               collective_dtype=wire,
+                               collective_matmul_min_bytes=1):
+                        from paddle_tpu.distributed.collective import (
+                            Group,
+                        )
+
+                        out = grad_allreduce_dispatch(
+                            Tensor(gl), group=Group("mp"))
+                        if out is None:
+                            return jax.lax.psum(gl, "mp")
+                        return out._data
+
+            return np.asarray(shard_map(
+                local, mesh=mesh, in_specs=P("mp", None),
+                out_specs=P("mp", None))(g))
+
+        ref = run("off")          # dispatch declines -> blocking psum
+        got = run("on")           # ring, fp wire
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+        got_q = run("on", "int8")  # ring, quantized wire
+        _assert_close_rel(got_q, ref, 0.05)
+
+        # outside a manual region the dispatch must decline
+        from paddle_tpu.distributed.collective import Group
+        from paddle_tpu.framework.core import Tensor as T
+
+        with flags(collective_matmul="on",
+                   collective_matmul_min_bytes=1):
+            assert grad_allreduce_dispatch(
+                T(np.ones((ws * 2, 2), np.float32)),
+                group=Group("mp")) is None
+
+    def test_dispatch_declines_indivisible(self, mp_grid):
+        # a grad whose element count the ring cannot chunk: decline
+        from paddle_tpu.distributed.collective import Group
+        from paddle_tpu.distributed.mesh import manual_axes
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_ops import (
+            grad_allreduce_dispatch,
+        )
+        from paddle_tpu.framework.core import Tensor
+
+        ws = mp_grid
+        n = ws * 4 + 1  # coprime with the ring
+        with manual_axes(("mp",)):
+            with flags(collective_matmul="on",
+                       collective_matmul_min_bytes=1):
+                assert grad_allreduce_dispatch(
+                    Tensor(np.ones((n,), np.float32)),
+                    group=Group("mp")) is None
+
+
+# ---------------------------------------------------------------------------
+# MoE expert all-to-all overlap (expert_alltoall_ffn)
+# ---------------------------------------------------------------------------
+
+
+def _moe_data(ws, e_per_dev, c=5, d=8, f=12, seed=0):
+    """Odd capacity (5) and odd expert multiples exercise the no-power-
+    of-two chunk paths."""
+    rng = np.random.RandomState(seed)
+    e = e_per_dev
+    x = jnp.asarray(rng.randn(ws * e, c, d) * 0.3, jnp.float32)
+    w0 = jnp.asarray(rng.randn(e, d, f) * 0.2, jnp.float32)
+    b0 = jnp.asarray(rng.randn(e, f) * 0.1, jnp.float32)
+    w1 = jnp.asarray(rng.randn(e, f, d) * 0.2, jnp.float32)
+    b1 = jnp.asarray(rng.randn(e, d) * 0.1, jnp.float32)
+    return x, w0, b0, w1, b1
+
+
+def _moe_ffn(blk, w0, b0, w1, b1, act):
+    h = jnp.einsum("ecd,edf->ecf", blk, w0) + b0[:, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    return jnp.einsum("ecf,efd->ecd", h, w1) + b1[:, None, :]
+
+
+class TestMoEAllToAllOverlap:
+    def _pair(self, ws, mesh, wire):
+        def blocking(xl, w0l, b0l, w1l, b1l):
+            ei = jax.lax.all_to_all(
+                xl, "mp", split_axis=0, concat_axis=1, tiled=True)
+            eo = _moe_ffn(ei, w0l, b0l, w1l, b1l, "gelu")
+            return jax.lax.all_to_all(
+                eo, "mp", split_axis=1, concat_axis=0, tiled=True)
+
+        in_specs = (P("mp", None, None), P("mp", None, None),
+                    P("mp", None), P("mp", None, None), P("mp", None))
+        plain = shard_map(blocking, mesh=mesh, in_specs=in_specs,
+                          out_specs=P("mp", None, None))
+        ring = shard_map(
+            functools.partial(expert_alltoall_ffn, axis_name="mp",
+                              axis_size=ws, ffn=_moe_ffn, act="gelu",
+                              wire=wire),
+            mesh=mesh, in_specs=in_specs,
+            out_specs=P("mp", None, None))
+        return plain, ring
+
+    @pytest.mark.parametrize("e_mult", [1, 3], ids=["e=ws", "e=3ws"])
+    def test_parity_fwd_and_grads(self, mp4_mesh, e_mult):
+        """The chunked ppermute decomposition must reproduce the
+        blocking a2a -> FFN -> a2a chain bitwise (wire off) — fwd and
+        grads for tokens AND expert weights — including odd chunk
+        counts (3 expert groups per hop, capacity 5)."""
+        ws, mesh = mp4_mesh
+        args = _moe_data(ws, e_mult * ws)
+        plain, ring = self._pair(ws, mesh, "off")
+        np.testing.assert_allclose(
+            np.asarray(ring(*args)), np.asarray(plain(*args)),
+            rtol=1e-5, atol=1e-5)
+        g_p = jax.grad(lambda *a: jnp.sum(plain(*a) ** 2),
+                       argnums=(0, 1, 2, 3, 4))(*args)
+        g_r = jax.grad(lambda *a: jnp.sum(ring(*a) ** 2),
+                       argnums=(0, 1, 2, 3, 4))(*args)
+        for a, b in zip(g_p, g_r):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("wire", ["int8"])
+    def test_quantized_parity(self, mp4_mesh, wire):
+        ws, mesh = mp4_mesh
+        args = _moe_data(ws, 2 * ws)
+        plain, ring = self._pair(ws, mesh, wire)
+        _assert_close_rel(ring(*args), plain(*args), _WIRE_TOL[wire])
+        g_p = jax.grad(lambda *a: jnp.sum(plain(*a) ** 2),
+                       argnums=(0, 1, 3))(*args)
+        g_r = jax.grad(lambda *a: jnp.sum(ring(*a) ** 2),
+                       argnums=(0, 1, 3))(*args)
+        for a, b in zip(g_p, g_r):
+            _assert_close_rel(b, a, _WIRE_TOL[wire])
+
+    def test_layer_path_rings_and_declines(self, mp4_mesh):
+        """moe_layer._expert_compute's manual path routes through the
+        overlap kernel when the policy allows (ppermute ring, no
+        blocking all_to_all in the jaxpr) and keeps the blocking pair
+        under FLAGS_collective_matmul=off; an expert count the ep ring
+        does not divide declines at the policy gate."""
+        ws, _ = mp4_mesh
+        from paddle_tpu.distributed.mesh import (
+            build_global_mesh,
+            reset_mesh,
+        )
+        from paddle_tpu.incubate.distributed.models.moe import (
+            moe_layer as ml,
+        )
+
+        reset_mesh()
+        mesh = build_global_mesh(("ep",), (ws,))
+        try:
+            args = _moe_data(ws, 2 * ws)
+            in_specs = (P("ep", None, None), P("ep", None, None),
+                        P("ep", None), P("ep", None, None),
+                        P("ep", None))
+
+            def local(xl, w0l, b0l, w1l, b1l):
+                return ml._expert_compute(
+                    xl, w0l, b0l, w1l, b1l, "gelu", manual=True)
+
+            def trace(mode):
+                with flags(collective_matmul=mode,
+                           collective_matmul_min_bytes=1):
+                    return str(jax.make_jaxpr(shard_map(
+                        local, mesh=mesh, in_specs=in_specs,
+                        out_specs=P("ep", None, None)))(*args))
+
+            j_ring = trace("on")
+            assert "ppermute" in j_ring
+            assert "all_to_all" not in j_ring
+            j_plain = trace("off")
+            assert "all_to_all" in j_plain
+            assert "ppermute" not in j_plain
+
+            # parity of the two layer paths (fwd)
+            def run(mode):
+                with flags(collective_matmul=mode,
+                           collective_matmul_min_bytes=1):
+                    return np.asarray(shard_map(
+                        local, mesh=mesh, in_specs=in_specs,
+                        out_specs=P("ep", None, None))(*args))
+
+            np.testing.assert_allclose(
+                run("on"), run("off"), rtol=1e-5, atol=1e-5)
+
+            # indivisible expert count: the policy gate declines
+            with flags(collective_matmul="on",
+                       collective_matmul_min_bytes=1):
+                assert not cm.should_decompose(
+                    1 << 30, ws, divisible=False)
+                assert cm.decline_reason(
+                    1 << 30, ws, divisible=False) == "indivisible"
+        finally:
+            reset_mesh()

@@ -153,6 +153,45 @@ class TestHybridEquivalence:
             _reset()
         np.testing.assert_allclose(got, base, rtol=RTOL, atol=RTOL)
 
+    def test_mp4_collective_dtype_int8_trajectory_gate(self):
+        # ISSUE-14: the quantized wire engaged on every TP ring
+        # (FLAGS_collective_dtype=int8 with the byte floor dropped so
+        # the small test shapes quantize) must track the fp ring
+        # trajectory within quantization tolerance — block-scaled int8
+        # perturbs each hop by ~1%, so the gate is a LOOSE tolerance
+        # plus the convergence check, not bitwise equality.
+        _grid(mp_degree=4)
+        try:
+            paddle.set_flags({"FLAGS_collective_matmul": "on"})
+            base = _train_llama(_llama_cfg())
+            paddle.set_flags({"FLAGS_collective_dtype": "int8",
+                              "FLAGS_collective_matmul_min_bytes": 1})
+            got = _train_llama(_llama_cfg())
+        finally:
+            paddle.set_flags({"FLAGS_collective_matmul": "auto",
+                              "FLAGS_collective_dtype": "off",
+                              "FLAGS_collective_matmul_min_bytes":
+                              4 << 20})
+            _reset()
+        _assert_converges(got)
+        np.testing.assert_allclose(got, base, rtol=0.08, atol=0.08)
+
+    def test_mp4_collective_dtype_off_is_bitwise_unchanged(self):
+        # the fp32 pin: FLAGS_collective_dtype=off must not perturb
+        # the ring lowering AT ALL — same trajectory bit for bit as
+        # the default (off-by-default) run
+        _grid(mp_degree=4)
+        try:
+            paddle.set_flags({"FLAGS_collective_matmul": "on"})
+            base = _train_llama(_llama_cfg())
+            paddle.set_flags({"FLAGS_collective_dtype": "off"})
+            got = _train_llama(_llama_cfg())
+        finally:
+            paddle.set_flags({"FLAGS_collective_matmul": "auto",
+                              "FLAGS_collective_dtype": "off"})
+            _reset()
+        assert got == base, (got, base)
+
     def test_dp2_mp4_collective_matmul_on_grid_safe(self):
         # multi-axis grid with the flag forced on: on jax<0.5 the
         # legacy-shard_map gate must keep the lowering identical to

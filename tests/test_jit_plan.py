@@ -435,9 +435,121 @@ class TestPlannerRules:
         inv = analysis.static_check_inventory()
         ids = {r["rule_id"] for r in inv["planner"]}
         assert ids == {"hbm-over-budget", "comm-over-budget",
-                       "comm-bound-program", "dead-collective"}
+                       "comm-bound-program", "dead-collective",
+                       "wire-savings-miss"}
         jaxpr_ids = {r["rule_id"] for r in inv["jaxpr"]}
         assert not (ids & jaxpr_ids)
+        # the comm-bound inventory row documents its dtype-awareness
+        row = next(r for r in inv["planner"]
+                   if r["rule_id"] == "comm-bound-program")
+        assert "quantized" in row["summary"].lower()
+
+
+# ---------------------------------------------------------------------------
+# quantized-wire planning (ISSUE 14): dtype-aware bytes, no false
+# comm-bound flag on quantized rings, verify_wire_savings assertion
+# ---------------------------------------------------------------------------
+
+class TestQuantizedWirePlanning:
+    def _ring_ar_jaxpr(self, wire, n=2, shape=(8, 64)):
+        import functools
+
+        from paddle_tpu.ops.kernels import collective_matmul as cm
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mp_mesh(n)
+        f = shard_map(
+            functools.partial(cm.ring_all_reduce, axis_name="mp",
+                              axis_size=n, wire=wire),
+            mesh=mesh, in_specs=P("mp", None),
+            out_specs=P("mp", None), check_rep=False)
+        return jax.make_jaxpr(f)(jnp.ones(shape, jnp.float32))
+
+    def _plan(self, wire, **kw):
+        plan, rep = planner.plan_jaxpr(
+            self._ring_ar_jaxpr(wire), name="ring_" + wire,
+            mesh_axis_sizes={"mp": 2}, **kw)
+        return plan, rep
+
+    def test_comm_bound_seeded_both_ways(self):
+        # fp wire: a pure-communication ring MUST fire comm-bound —
+        # the same ring with its wire quantized MUST NOT (the >=4-byte
+        # collectives left are the f32 scale sidecars)
+        with flags(jit_plan_comm_bound_ratio=8.0):
+            _, rep_fp = self._plan("off")
+            plan_q, rep_q = self._plan("int8")
+        assert "comm-bound-program" in _rules(rep_fp)
+        assert "comm-bound-program" not in _rules(rep_q)
+        assert plan_q.comm_bytes_quantized > 0
+
+    def test_quantized_bytes_match_chunk_schedule_exactly(self):
+        from paddle_tpu.ops.kernels import collective_matmul as cm
+
+        plan_q, _ = self._plan("int8")
+        plan_fp, _ = self._plan("off")
+        ws = 2
+        n_loc = (8 // ws) * 64          # 256 elements per device
+        chunk_elems = n_loc // ws       # 128 per ring chunk
+        pay, sc = cm.wire_chunk_bytes((chunk_elems,), "int8")
+        # RS: ws-1 hops of (payload + sidecar); AG: (ws-1)/ws of the
+        # gathered int8 payload and of the f32 sidecar
+        sched = (ws - 1) * (pay + sc) \
+            + (n_loc * 1) * (ws - 1) // ws \
+            + (ws * sc) * (ws - 1) // ws
+        assert plan_q.comm_bytes_total == sched, (
+            plan_q.comm_bytes_total, sched)
+        # fp reference: ws-1 fp hops + (ws-1)/ws of the fp gather
+        sched_fp = (ws - 1) * chunk_elems * 4 \
+            + n_loc * 4 * (ws - 1) // ws
+        assert plan_fp.comm_bytes_total == sched_fp
+        assert plan_q.comm_bytes_quantized == \
+            (ws - 1) * pay + n_loc * (ws - 1) // ws
+
+    def test_verify_wire_savings_passes(self):
+        plan_q, _ = self._plan("int8")
+        plan_fp, _ = self._plan("off")
+        with flags(jit_plan="strict"):
+            ratio, rep = planner.verify_wire_savings(
+                plan_q, plan_fp, max_ratio=0.55)
+        assert rep.findings == []
+        assert ratio is not None and ratio <= 0.55
+
+    def test_verify_wire_savings_seeded_miss(self):
+        plan_q, _ = self._plan("int8")
+        plan_fp, _ = self._plan("off")
+        with flags(jit_plan="strict"):
+            with pytest.raises(planner.JitPlanError):
+                planner.verify_wire_savings(
+                    plan_q, plan_fp, max_ratio=0.01)
+        with flags(jit_plan="report"):
+            ratio, rep = planner.verify_wire_savings(
+                plan_q, plan_fp, max_ratio=0.01)
+        assert "wire-savings-miss" in _rules(rep)
+
+    def test_verify_wire_savings_unquantized_arm_is_a_miss(self):
+        # a 'quantized' arm that never quantized (no sub-2-byte
+        # traffic) is the purest savings miss
+        plan_fp, _ = self._plan("off")
+        with flags(jit_plan="report"):
+            _, rep = planner.verify_wire_savings(
+                plan_fp, plan_fp, max_ratio=0.55)
+        assert "wire-savings-miss" in _rules(rep)
+
+    def test_verify_accepts_jaxprs(self):
+        with flags(jit_plan="report"):
+            ratio, rep = planner.verify_wire_savings(
+                self._ring_ar_jaxpr("int8"),
+                self._ring_ar_jaxpr("off"),
+                mesh_axis_sizes={"mp": 2}, max_ratio=0.55)
+        assert rep.findings == []
+        assert ratio is not None and ratio <= 0.55
+
+    def test_plan_dict_carries_quantized_bytes(self):
+        plan_q, _ = self._plan("int8")
+        d = plan_q.to_dict()
+        assert d["comm_bytes_quantized"] == plan_q.comm_bytes_quantized
+        assert 0 < d["comm_bytes_quantized"] < d["comm_bytes_total"]
 
 
 # ---------------------------------------------------------------------------

@@ -1017,3 +1017,107 @@ class TestUnifiedAttention:
     def test_rule_inventory_has_unified_attention(self):
         ids = [r for r, _ in lint_codebase.RULES]
         assert "unified-attention" in ids
+
+
+class TestWireQuantOwnership:
+    """ISSUE-14 wire-quant ownership rule: quantize-on-the-wire
+    (FLAGS_collective_dtype) lives only in the jax-only kernel module
+    — a raw int8/fp8 cast next to a raw collective in the TP/SP,
+    grad-sync, or MoE layer modules is a hand-rolled wire quantization
+    bypassing the block scales, cotangent rings, and byte model."""
+
+    def test_seeded_quant_cast_around_collective_flagged(self):
+        bad = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def sync(grad):\n"
+            "    q = grad.astype(jnp.int8)\n"
+            "    return jax.lax.psum(q, 'dp')\n"
+        )
+        v = lint_codebase.lint_wire_quant_file("fake/mp_ops.py",
+                                               text=bad)
+        assert len(v) == 1, v
+        assert "collective_matmul.py" in v[0]
+        assert "FLAGS_collective_dtype" in v[0]
+
+    def test_seeded_string_dtype_flagged(self):
+        bad = (
+            "import jax\n"
+            "def hop(x):\n"
+            "    y = x.astype('int8')\n"
+            "    return jax.lax.ppermute(y, 'mp', [(0, 1)])\n"
+        )
+        v = lint_codebase.lint_wire_quant_file("fake/moe_layer.py",
+                                               text=bad)
+        assert len(v) == 1, v
+
+    def test_fp8_cast_flagged(self):
+        bad = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def hop(x):\n"
+            "    y = x.astype(jnp.float8_e4m3fn)\n"
+            "    return jax.lax.all_gather(x, 'mp', axis=0)\n"
+        )
+        v = lint_codebase.lint_wire_quant_file("fake/mp_layers.py",
+                                               text=bad)
+        assert len(v) == 1, v
+
+    def test_cast_without_collective_clean(self):
+        ok = (
+            "import jax.numpy as jnp\n"
+            "def pack(w):\n"
+            "    return w.astype(jnp.int8)\n"
+        )
+        assert lint_codebase.lint_wire_quant_file(
+            "fake/mp_ops.py", text=ok) == []
+
+    def test_collective_with_fp_cast_clean(self):
+        ok = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def combine(x):\n"
+            "    y = x.astype(jnp.float32)\n"
+            "    return jax.lax.psum(y, 'ep')\n"
+        )
+        assert lint_codebase.lint_wire_quant_file(
+            "fake/moe_layer.py", text=ok) == []
+
+    def test_nested_scope_does_not_pair(self):
+        ok = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def layer(x):\n"
+            "    def quantize(v):\n"
+            "        return v.astype(jnp.int8)\n"
+            "    return jax.lax.psum(x, 'dp')\n"
+        )
+        assert lint_codebase.lint_wire_quant_file(
+            "fake/mp_ops.py", text=ok) == []
+
+    def test_waiver_comment_suppresses(self):
+        bad = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def sync(grad):\n"
+            "    q = grad.astype(jnp.int8)"
+            "  # trace-lint: ok(test waiver)\n"
+            "    return jax.lax.psum(q, 'dp')\n"
+        )
+        assert lint_codebase.lint_wire_quant_file(
+            "fake/mp_ops.py", text=bad) == []
+
+    def test_wire_quant_modules_covered_and_clean(self):
+        covered = [os.path.join(REPO, f)
+                   for f in lint_codebase.WIRE_QUANT_FILES]
+        names = "\n".join(covered)
+        assert "mp_ops.py" in names and "mp_layers.py" in names
+        assert "hybrid_parallel_util.py" in names
+        assert "moe_layer.py" in names
+        for p in covered:
+            assert os.path.exists(p), p
+        assert lint_codebase.check_wire_quant() == []
+
+    def test_rule_inventory_has_wire_quant(self):
+        ids = [r for r, _ in lint_codebase.RULES]
+        assert "wire-quant-ownership" in ids

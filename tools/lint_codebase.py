@@ -85,6 +85,14 @@ Checks:
    instead of hand-rolling new blocking chains: no single function may
    call both a raw lax collective (all_gather/psum/psum_scatter/...)
    and a raw matmul (jnp.matmul/dot_general/F.linear/...).
+10. wire-quant ownership: quantize-on-the-wire for ring collectives
+   (FLAGS_collective_dtype) is implemented once, in the jax-only
+   kernel module — the TP/SP layer modules, the DP grad-sync helper
+   (fleet/utils/hybrid_parallel_util.py) and the MoE layer
+   (incubate/.../moe_layer.py) must not cast a payload to
+   int8/float8 in the same function as a raw collective: a
+   hand-rolled wire cast bypasses the block-scale format, the
+   custom-VJP cotangent rings, and the planner's exact byte model.
 
 Run: JAX_PLATFORMS=cpu python tools/lint_codebase.py
 Wired as a tier-1 test in tests/test_lint_codebase.py.
@@ -1296,6 +1304,101 @@ def check_tp_routing(root=REPO):
     return out
 
 
+# quantize-on-the-wire ownership: the quant/dequant of ring payloads
+# (FLAGS_collective_dtype) lives ONLY in the jax-only kernel module —
+# a raw int8/fp8 dtype cast next to a raw collective in the TP/SP
+# layer modules, the DP grad-sync helper, or the MoE layer is a
+# hand-rolled wire quantization that bypasses the block-scale format,
+# the custom-VJP cotangent rings, and the planner's exact byte model
+WIRE_QUANT_FILES = TP_ROUTING_FILES + (
+    os.path.join("paddle_tpu", "distributed", "fleet", "utils",
+                 "hybrid_parallel_util.py"),
+    os.path.join("paddle_tpu", "incubate", "distributed", "models",
+                 "moe", "moe_layer.py"),
+)
+
+_WIRE_QUANT_DTYPES = frozenset({
+    "int8", "uint8", "float8_e4m3fn", "float8_e4m3", "float8_e5m2",
+})
+
+
+class _WireQuantVisitor(_TPRoutingVisitor):
+    """Per innermost function: a raw lax collective AND a quantized
+    dtype cast (``.astype('int8')`` / ``.astype(jnp.int8)`` /
+    ``convert_element_type(..., int8)``) in the same body is wire
+    quantization hand-rolled outside ops/kernels/collective_matmul.py."""
+
+    def _quant_cast(self, node):
+        """True when the Call quantize-casts: astype/convert with an
+        int8/fp8 dtype argument (literal string, jnp attribute, or
+        bare name)."""
+        name = self._call_name(node)
+        if name not in ("astype", "convert_element_type", "asarray",
+                        "array"):
+            return False
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and arg.value in _WIRE_QUANT_DTYPES:
+                return True
+            if isinstance(arg, ast.Attribute) \
+                    and arg.attr in _WIRE_QUANT_DTYPES:
+                return True
+            if isinstance(arg, ast.Name) \
+                    and arg.id in _WIRE_QUANT_DTYPES:
+                return True
+        return False
+
+    def _check_fn(self, node):
+        colls, casts = [], []
+        for sub in self._scoped_calls(node):
+            name = self._call_name(sub)
+            if name in _RAW_COLLECTIVE_CALLS:
+                colls.append((sub.lineno, name))
+            if self._quant_cast(sub):
+                casts.append((sub.lineno, name))
+        if colls and casts:
+            lineno = min(casts)[0]
+            line = self.lines[lineno - 1] \
+                if lineno - 1 < len(self.lines) else ""
+            if _WAIVER_MARK not in line:
+                self.violations.append(
+                    "%s:%d: function %r casts a wire payload to a "
+                    "quantized dtype (%s) next to a raw collective "
+                    "(%s) — quantize-on-the-wire belongs in "
+                    "ops/kernels/collective_matmul.py behind "
+                    "FLAGS_collective_dtype (block scales, custom-VJP "
+                    "cotangent rings, planner-exact bytes); route the "
+                    "pair through the dispatch or waive with "
+                    "'%s(<reason>)'"
+                    % (self.relpath, lineno, node.name,
+                       ", ".join(sorted({n for _, n in casts if n})),
+                       ", ".join(sorted({n for _, n in colls})),
+                       _WAIVER_MARK))
+
+
+def lint_wire_quant_file(path, text=None):
+    """Wire-quantization ownership check; returns violation strings."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _WireQuantVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_wire_quant(root=REPO):
+    out = []
+    for f in WIRE_QUANT_FILES:
+        out.extend(lint_wire_quant_file(os.path.join(root, f)))
+    return out
+
+
 # flag inventory (the FLAGS registry contract): every flag defined in
 # framework/flags.py must carry a non-empty docstring AND be mentioned
 # (as FLAGS_<name>) somewhere under docs/ — an undocumented knob is a
@@ -1517,6 +1620,12 @@ RULES = (
     ("tp-collective-routing",
      "no hand-rolled raw collective + matmul pair in the TP/SP layer "
      "modules — route through collective_matmul_dispatch"),
+    ("wire-quant-ownership",
+     "no raw int8/fp8 dtype cast next to a raw collective in the "
+     "TP/SP layer modules, the DP grad-sync helper, or the MoE layer "
+     "— quantize-on-the-wire (FLAGS_collective_dtype) lives only in "
+     "ops/kernels/collective_matmul.py (block scales, custom-VJP "
+     "cotangent rings, planner-exact wire bytes)"),
 )
 
 
@@ -1534,6 +1643,7 @@ def run_lint(root=REPO, with_op_table=True):
     out.extend(check_flag_inventory(root))
     out.extend(check_jax_only(root))
     out.extend(check_tp_routing(root))
+    out.extend(check_wire_quant(root))
     if with_op_table:
         out.extend(check_op_table())
         out.extend(check_inference_surface())
