@@ -1882,6 +1882,36 @@ def bench_telemetry_serving(users=4, prompt_len=48, new_tokens=8,
         # the artifact for offline inspection
         "metrics": m,
     }
+    # ISSUE 15: every bench round carries its telemetry artifact —
+    # the registry snapshot + SLO window land in TELEMETRY_LAST.json
+    # next to the bench JSON, in exactly the shape the fleet
+    # aggregation CLI consumes:
+    #   python -m paddle_tpu.framework.telemetry aggregate \
+    #       TELEMETRY_LAST.json <other-workers...>
+    serving = m.get("serving", {}) or {}
+    tel_art = {
+        "config": "serving_telemetry",
+        "worker": "bench-serving",
+        "mode": rec["mode"],
+        "git_rev": _git_rev(),
+        "snapshot": m,
+        "slo_window": {
+            "goodput": rec["goodput"],
+            "slo_attain_ttft": rec["slo_attain_ttft"],
+            "slo_attain_tpot": rec["slo_attain_tpot"],
+            "slo_attain_queue_wait": rec["slo_attain_queue_wait"],
+            "window_requests": serving.get("slo_window_requests"),
+            "windows": {
+                name: (serving.get(name) or {}).get("window")
+                for name in ("ttft_s", "tpot_s", "queue_wait_s",
+                             "step_wall_s")
+            },
+        },
+    }
+    _atomic_json_dump(
+        os.path.join(os.path.dirname(_SERVING_FILE),
+                     "TELEMETRY_LAST.json"), tel_art)
+    rec["telemetry_artifact"] = "TELEMETRY_LAST.json"
     return _merge_serving_rec("telemetry", rec)
 
 

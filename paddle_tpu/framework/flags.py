@@ -364,6 +364,21 @@ define_flag("telemetry_incident_dir", "",
             "and the watchdog event log — replayable via python -m "
             "paddle_tpu.framework.telemetry --summarize-incident "
             "<bundle>. Empty (default) builds no recorder")
+define_flag("ops_server_port", 0,
+            "embedded live-ops debug HTTP server "
+            "(framework/ops_server.py): 0 (default) builds nothing — "
+            "the serving scheduler pays one integer check at "
+            "construction; a positive port starts ONE process-wide, "
+            "read-only, stdlib-only server on 127.0.0.1:<port> "
+            "serving /metrics (byte-identical to "
+            "telemetry.prometheus_text), /statusz (build/flags/"
+            "uptime + SLO-window and watchdog state), /tracez "
+            "(recent spans + chrome/perfetto payload), /planz "
+            "(resource plans + perf-ledger plan-vs-actual), /flagz, "
+            "and /incidentz (flight-recorder bundle index + "
+            "summarize view). Requires FLAGS_telemetry=metrics|trace "
+            "— with telemetry off the server refuses to start "
+            "(docs/OBSERVABILITY.md)")
 define_flag("telemetry_incident_keep", 8,
             "bound on retained incident bundles per "
             "FLAGS_telemetry_incident_dir: when a new bundle would "

@@ -254,6 +254,13 @@ class PerfLedger:
                     plan["hbm_bytes_per_call"] / mean)
                 row["wire_bytes_per_s"] = (
                     plan["comm_bytes_total"] / mean)
+                if plan["comm_bytes_quantized"] > 0:
+                    # PR-14's quantized-bytes plan field, live: the
+                    # achieved quantize-on-the-wire rate — published
+                    # as a ledger gauge so it reaches Prometheus
+                    # instead of living only in plans.json
+                    row["wire_bytes_quantized_per_s"] = (
+                        plan["comm_bytes_quantized"] / mean)
                 # where the measured throughput puts the program on
                 # the roofline: the arithmetic intensity it would
                 # NEED at peak HBM bandwidth to sustain the attained
@@ -300,7 +307,8 @@ class PerfLedger:
     # watchdog reads drift_ratio/drift_samples; Prometheus gets all)
     _GAUGE_FIELDS = (
         "mfu", "attained_flops_per_s", "hbm_bytes_per_s",
-        "wire_bytes_per_s", "share_of_step_wall", "predicted_wall_s",
+        "wire_bytes_per_s", "wire_bytes_quantized_per_s",
+        "share_of_step_wall", "predicted_wall_s",
         "drift_ratio", "drift_samples",
     )
 

@@ -62,6 +62,22 @@ and :class:`FlightRecorder` (re-exported here) turns every watchdog
 trip into an atomic incident bundle replayable with
 ``--summarize-incident``.
 
+Live-ops layer (ISSUE 15, docs/OBSERVABILITY.md "Live ops plane"):
+
+* :class:`TraceContext` — serializable per-request trace identity
+  (trace id, root span, tenant, deadline) with ``inject``/``extract``
+  carrier helpers; the span stack and the ambient context live in
+  :mod:`contextvars`, so nesting survives asyncio tasks and executor
+  hops (``tid`` is stamped by the thread doing the work).
+* :func:`merge_snapshots` / :func:`merged_prometheus_text` — fleet
+  aggregation: N worker snapshots into one ``worker``-labelled
+  exposition (exact counter/histogram sums, declared gauge
+  semantics), plus OpenMetrics exemplars linking TTFT/TPOT buckets
+  to trace ids.
+* the embedded debug server lives in the sibling
+  :mod:`paddle_tpu.framework.ops_server` (``FLAGS_ops_server_port``;
+  its ``/metrics`` is byte-identical to :func:`prometheus_text`).
+
 CLI::
 
     python -m paddle_tpu.framework.telemetry --summarize trace.jsonl
@@ -69,6 +85,7 @@ CLI::
     python -m paddle_tpu.framework.telemetry --export-prom trace.jsonl
     python -m paddle_tpu.framework.telemetry --ledger trace.jsonl
     python -m paddle_tpu.framework.telemetry --summarize-incident <bundle-dir>
+    python -m paddle_tpu.framework.telemetry aggregate w0.json w1.jsonl -o fleet.prom
 
 ``--summarize`` prints the aggregated span tree, the per-request
 trace and watchdog-event digests, plus the counter/gauge/histogram
@@ -90,6 +107,8 @@ the serving stack: ``inference/serving.py``, ``paged_cache.py`` and
 from __future__ import annotations
 
 import collections
+import contextvars
+import itertools
 import json
 import math
 import os
@@ -103,12 +122,14 @@ from .flags import flag
 __all__ = [
     "MetricsRegistry", "Histogram", "Tracer", "Span",
     "SLOConfig", "RequestTrace", "RequestTraceBook",
-    "FlightRecorder",
+    "FlightRecorder", "TraceContext",
     "telemetry_mode", "metrics_on", "tracing_on", "registry", "tracer",
     "request_traces", "clock", "reset", "arm_tracer", "disarm_tracer",
+    "current_trace_context", "use_trace_context", "span_in",
     "export_chrome", "chrome_payload", "prometheus_text",
     "write_prometheus", "atomic_write_text", "summarize_jsonl",
     "chrome_from_jsonl", "summarize_incident",
+    "merge_snapshots", "merged_prometheus_text",
     "SURFACE", "NULL_SPAN",
 ]
 
@@ -188,7 +209,7 @@ class Histogram:
     clock."""
 
     __slots__ = ("count", "total", "min", "max", "_buckets",
-                 "_samples")
+                 "_samples", "_exemplars")
 
     def __init__(self, samples: Optional[int] = None):
         cap = int(flag("telemetry_samples")) if samples is None \
@@ -200,8 +221,13 @@ class Histogram:
         self.total = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        # OpenMetrics-style exemplars: newest (label, value) per
+        # bucket — the TTFT/TPOT -> trace-id link the fleet
+        # aggregation story documents. None until the first exemplar
+        # lands (most histograms never carry any)
+        self._exemplars: Optional[Dict[Optional[int], tuple]] = None
 
-    def observe(self, value, epoch: int = 0) -> None:
+    def observe(self, value, epoch: int = 0, exemplar=None) -> None:
         v = float(value)
         self.count += 1
         self.total += v
@@ -212,6 +238,12 @@ class Histogram:
         e = _bucket_exp(v)
         self._buckets[e] = self._buckets.get(e, 0) + 1
         self._samples.append((int(epoch), v))
+        if exemplar is not None:
+            # one exemplar per bucket, newest wins (bounded by the
+            # bucket count, which log2 bounds by value range)
+            if self._exemplars is None:
+                self._exemplars = {}
+            self._exemplars[e] = (str(exemplar), v)
 
     def samples(self) -> List[Tuple[int, float]]:
         """The retained ``(epoch, value)`` reservoir, oldest first —
@@ -260,9 +292,21 @@ class Histogram:
             out.append((0.0 if e is None else float(2.0 ** e), n))
         return sorted(out)
 
+    def exemplars(self) -> List[Tuple[float, str, float]]:
+        """Sorted (bucket_upper_bound, label, value) triples — one
+        exemplar per bucket that ever received one (empty for the
+        common no-exemplar histogram)."""
+        if not self._exemplars:
+            return []
+        out = []
+        for e, (label, v) in self._exemplars.items():
+            out.append((0.0 if e is None else float(2.0 ** e),
+                        label, v))
+        return sorted(out)
+
     def summary(self) -> dict:
         cap = self._samples.maxlen
-        return {
+        out = {
             "count": self.count,
             "sum": self.total,
             "min": self.min,
@@ -274,6 +318,10 @@ class Histogram:
             "exact": self.count <= cap,
             "buckets": self.buckets(),
         }
+        ex = self.exemplars()
+        if ex:
+            out["exemplars"] = [list(t) for t in ex]
+        return out
 
 
 class MetricsRegistry:
@@ -302,12 +350,16 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = float(value)
 
-    def observe(self, name: str, value) -> None:
+    def observe(self, name: str, value, exemplar=None) -> None:
+        """Record one histogram sample. ``exemplar`` (optional, e.g.
+        a trace id) attaches an OpenMetrics exemplar to the sample's
+        bucket — the link between a latency bucket and the request
+        trace that landed in it."""
         with self._lock:
             h = self._hists.get(name)
             if h is None:
                 h = self._hists.setdefault(name, Histogram())
-            h.observe(value, self.epoch)
+            h.observe(value, self.epoch, exemplar)
 
     def advance_epoch(self) -> int:
         """Advance the REGISTRY-OWNED monotonic epoch stamp by one
@@ -657,6 +709,146 @@ def _request_lane_events(records, base, pid) -> List[dict]:
 
 
 # ---------------------------------------------------------------------------
+# trace context — async- and cross-worker-safe trace identity
+# ---------------------------------------------------------------------------
+
+# process-unique id sequences (no wall clock, no randomness: ids are
+# deterministic within a process and namespaced by pid across a fleet)
+_TRACE_SEQ = itertools.count(1)
+_SPAN_SEQ = itertools.count(1)
+
+
+def _new_trace_id() -> str:
+    return "%x-%x" % (os.getpid(), next(_TRACE_SEQ))
+
+
+class TraceContext:
+    """Serializable trace identity for ONE request: the trace id
+    every span and request-trace event of that request stamps, the
+    root span id children parent to, plus the tenant and deadline
+    that must survive a cross-worker hop.
+
+    This is the Dapper-style propagation contract of the ops plane:
+    the scheduler creates one context at ``submit`` (or adopts one a
+    front-end injected), request-scoped spans record under it
+    (:func:`use_trace_context` / :func:`span_in`), the KV pool pins
+    it to the sequence's page chains (``set_trace_context``) so a
+    swap record or a COW chain handoff carries it, and a future
+    prefill/decode worker split re-extracts it on the receiving side
+    — one request, ONE stitched trace, no matter how many hosts or
+    asyncio tasks touched it.
+
+    Wire format (:meth:`to_wire`/:meth:`from_wire`) is a compact JSON
+    object; :meth:`inject`/:meth:`extract` move it through a dict
+    carrier (HTTP headers, a swap-record sidecar, an RPC metadata
+    map) under :data:`WIRE_KEY`."""
+
+    __slots__ = ("trace_id", "span_id", "tenant", "deadline_s")
+    WIRE_KEY = "x-paddle-trace"
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 span_id: Optional[int] = None,
+                 tenant: str = "default",
+                 deadline_s: Optional[float] = None):
+        self.trace_id = str(trace_id) if trace_id else _new_trace_id()
+        self.span_id = int(span_id) if span_id is not None \
+            else next(_SPAN_SEQ)
+        self.tenant = str(tenant)
+        self.deadline_s = None if deadline_s is None \
+            else float(deadline_s)
+
+    def child(self, span_id: int) -> "TraceContext":
+        """The context a child scope propagates onward: same trace,
+        ``span_id`` becomes the new parent link."""
+        return TraceContext(self.trace_id, span_id, self.tenant,
+                            self.deadline_s)
+
+    # -- serialization ------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "tenant": self.tenant, "deadline_s": self.deadline_s}
+
+    def to_wire(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True,
+                          separators=(",", ":"))
+
+    @classmethod
+    def from_wire(cls, wire: str) -> "TraceContext":
+        d = json.loads(wire)
+        if not isinstance(d, dict) or "trace_id" not in d:
+            raise ValueError(
+                "not a TraceContext wire payload: %r" % (wire,))
+        return cls(trace_id=d["trace_id"],
+                   span_id=d.get("span_id", 0),
+                   tenant=d.get("tenant", "default"),
+                   deadline_s=d.get("deadline_s"))
+
+    def inject(self, carrier: dict) -> dict:
+        """Write the wire form into a dict carrier (headers/metadata)
+        under :data:`WIRE_KEY`; returns the carrier."""
+        carrier[self.WIRE_KEY] = self.to_wire()
+        return carrier
+
+    @classmethod
+    def extract(cls, carrier) -> Optional["TraceContext"]:
+        """Read a context back out of a dict carrier; None when the
+        carrier holds none (the caller then starts a fresh trace)."""
+        wire = (carrier or {}).get(cls.WIRE_KEY)
+        return None if wire is None else cls.from_wire(wire)
+
+    def __repr__(self):
+        return ("TraceContext(trace_id=%r, span_id=%d, tenant=%r, "
+                "deadline_s=%r)" % (self.trace_id, self.span_id,
+                                    self.tenant, self.deadline_s))
+
+    def __eq__(self, other):
+        return isinstance(other, TraceContext) and \
+            self.to_dict() == other.to_dict()
+
+
+# the ambient trace context: a ContextVar, so it follows asyncio tasks
+# (each task branches its own copy) and threads (each thread starts
+# empty) — exactly the propagation threading.local() could not give
+# the future async step pump
+_TRACE_CTX: "contextvars.ContextVar[Optional[TraceContext]]" = \
+    contextvars.ContextVar("paddle_tpu_trace_ctx", default=None)
+
+
+def current_trace_context() -> Optional[TraceContext]:
+    """The ambient :class:`TraceContext` of the calling task/thread
+    (None outside any :func:`use_trace_context` scope)."""
+    return _TRACE_CTX.get()
+
+
+class use_trace_context:
+    """``with use_trace_context(ctx): ...`` — every span opened (and
+    every ``add_complete`` recorded) inside the scope stamps ``ctx``'s
+    trace id and parents to its span id. Reentrant; exiting restores
+    the previous ambient context, tolerating an exit on a different
+    thread than the enter (the executor-handoff case)."""
+
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self._ctx = ctx
+        self._token = None
+
+    def __enter__(self) -> Optional[TraceContext]:
+        self._token = _TRACE_CTX.set(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        try:
+            _TRACE_CTX.reset(self._token)
+        except ValueError:
+            # exited in a different context than it entered (an
+            # executor hop): clear rather than corrupt the hopping
+            # thread's ambient state
+            _TRACE_CTX.set(None)
+        return False
+
+
+# ---------------------------------------------------------------------------
 # tracer
 # ---------------------------------------------------------------------------
 
@@ -665,10 +857,21 @@ class Span:
     """One finished (or in-flight) wall span. ``path`` is the
     slash-joined ancestor chain captured at begin ("serving.step/"
     "serving.admit"), which keeps the tree reconstructible after
-    ring rollover drops parents."""
+    ring rollover drops parents.
+
+    Trace identity (``span_id``/``parent_id``/``trace_id``) is
+    stamped at ``__enter__``: the parent is the enclosing open span,
+    or — when an explicit :class:`TraceContext` is ambient — that
+    context's root span, which is what stitches one request's spans
+    across steps, threads, asyncio tasks, and (via the serialized
+    context) workers. ``tid`` is ALSO stamped at enter: the thread
+    actually doing the work owns the span, even when an executor
+    handoff closes it somewhere else (the historical
+    ``threading.get_ident()``-at-construction stamp silently
+    mis-attributed exactly that case)."""
 
     __slots__ = ("name", "cat", "t0", "dur", "tid", "depth", "path",
-                 "attrs")
+                 "attrs", "span_id", "parent_id", "trace_id")
 
     def __init__(self, name, cat="app", attrs=None):
         self.name = str(name)
@@ -679,12 +882,40 @@ class Span:
         self.tid = threading.get_ident()
         self.depth = 0
         self.path = self.name
+        self.span_id = 0
+        self.parent_id: Optional[int] = None
+        self.trace_id: Optional[str] = None
+
+    def _stamp_identity(self, parent: Optional["Span"]) -> None:
+        """Assign the span id and the trace linkage: the enclosing
+        open span wins for BOTH when no explicit context is ambient;
+        an ambient TraceContext pins the trace id and (when the
+        enclosing span belongs to a different trace, or there is
+        none) the parent link to its root span."""
+        self.span_id = next(_SPAN_SEQ)
+        ctx = _TRACE_CTX.get()
+        if ctx is not None:
+            self.trace_id = ctx.trace_id
+            if parent is not None and parent.trace_id == ctx.trace_id:
+                self.parent_id = parent.span_id
+            else:
+                self.parent_id = ctx.span_id or None
+        elif parent is not None:
+            self.trace_id = parent.trace_id
+            self.parent_id = parent.span_id
 
     def to_dict(self) -> dict:
-        return {"type": "span", "name": self.name, "cat": self.cat,
-                "ts": self.t0, "dur": self.dur, "tid": self.tid,
-                "depth": self.depth, "path": self.path,
-                "args": dict(self.attrs)}
+        d = {"type": "span", "name": self.name, "cat": self.cat,
+             "ts": self.t0, "dur": self.dur, "tid": self.tid,
+             "depth": self.depth, "path": self.path,
+             "args": dict(self.attrs)}
+        if self.span_id:
+            d["id"] = self.span_id
+        if self.parent_id is not None:
+            d["parent"] = self.parent_id
+        if self.trace_id is not None:
+            d["trace"] = self.trace_id
+        return d
 
 
 class _NullSpan:
@@ -730,12 +961,21 @@ def _chrome_doc(span_recs, request_recs) -> dict:
               if r.get("events")]
     base = min(bases) if bases else 0.0
     pid = os.getpid()
-    events = [
-        _chrome_event(s.get("name", "?"), s.get("cat", "app"),
-                      s.get("tid", 0), s.get("ts", 0.0),
-                      s.get("dur", 0.0), s.get("args", {}),
-                      base, pid)
-        for s in spans]
+    events = []
+    for s in spans:
+        args = dict(s.get("args") or {})
+        # trace identity rides the args so a stitched request reads
+        # back out of the chrome/perfetto payload directly
+        if s.get("trace") is not None:
+            args["trace_id"] = s["trace"]
+            if s.get("parent") is not None:
+                args["parent_span"] = s["parent"]
+            if s.get("id"):
+                args["span_id"] = s["id"]
+        events.append(_chrome_event(
+            s.get("name", "?"), s.get("cat", "app"),
+            s.get("tid", 0), s.get("ts", 0.0),
+            s.get("dur", 0.0), args, base, pid))
     events.extend(_request_lane_events(request_recs, base, pid))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
@@ -749,49 +989,73 @@ class _SpanCtx:
 
     def __enter__(self) -> Span:
         s = self._span
-        stack = self._tr._stack()
+        var = self._tr._stack_var
+        stack = var.get()
+        # the thread DOING the work owns the span — an executor
+        # handoff that closes it elsewhere must not re-attribute it
+        s.tid = threading.get_ident()
         s.depth = len(stack)
-        if stack:
-            s.path = stack[-1].path + "/" + s.name
-        stack.append(s)
+        parent = stack[-1] if stack else None
+        if parent is not None:
+            s.path = parent.path + "/" + s.name
+        s._stamp_identity(parent)
+        var.set(stack + (s,))
         s.t0 = clock()
         return s
 
     def __exit__(self, *exc):
         s = self._span
         s.dur = clock() - s.t0
-        stack = self._tr._stack()
+        var = self._tr._stack_var
+        stack = var.get()
         if stack and stack[-1] is s:
-            stack.pop()
+            var.set(stack[:-1])
         elif s in stack:  # mis-nested exit: drop up to and incl. s
-            del stack[stack.index(s):]
+            var.set(stack[:stack.index(s)])
+        # else: closed in a different context/thread than it opened
+        # in (executor handoff) — the stacks are immutable per-context
+        # snapshots, so there is nothing to repair HERE; the opening
+        # context prunes the stale entry via the mis-nest branch
+        # above, exactly like the old per-thread model did
         self._tr._commit(s)
         return False
 
 
 class Tracer:
-    """Bounded ring of finished spans + a per-thread open-span stack
+    """Bounded ring of finished spans + a per-CONTEXT open-span stack
     for nesting. ``span()`` is the context-manager entry point;
     ``add_complete()`` records an externally timed range (the legacy
-    profiler RecordEvent bridge)."""
+    profiler RecordEvent bridge).
+
+    The open-span stack lives in a :mod:`contextvars` ContextVar as
+    an immutable tuple: every thread still gets its own stack (each
+    thread starts from an empty context — the old ``threading.local``
+    behavior, preserved), and every asyncio task additionally gets a
+    copy-on-write branch of its parent's stack, so two tasks
+    interleaving awaits on ONE loop thread can no longer corrupt each
+    other's nesting — the failure mode that blocked the async
+    scheduler of ROADMAP item 1."""
 
     def __init__(self, ring: Optional[int] = None):
         cap = int(flag("telemetry_ring")) if ring is None \
             else int(ring)
         self._ring = collections.deque(maxlen=max(16, cap))
-        self._tls = threading.local()
+        # async-safe nesting state: an immutable tuple per context
+        # (tracers are process singletons, so the per-instance
+        # ContextVar does not churn)
+        self._stack_var: "contextvars.ContextVar[tuple]" = \
+            contextvars.ContextVar("paddle_tpu_span_stack",
+                                   default=())
         # serializes commits against ring reads: exporting from one
         # thread while another finishes a span must not hit "deque
         # mutated during iteration"
         self._lock = threading.Lock()
         self.dropped = 0  # spans evicted by ring rollover
 
-    def _stack(self) -> list:
-        st = getattr(self._tls, "stack", None)
-        if st is None:
-            st = []
-            self._tls.stack = st
-        return st
+    def open_depth(self) -> int:
+        """Open-span nesting depth of the CALLING context (test and
+        debug surface for the contextvars stack)."""
+        return len(self._stack_var.get())
 
     def _commit(self, span: Span) -> None:
         with self._lock:
@@ -806,10 +1070,14 @@ class Tracer:
 
     def add_complete(self, name, t0, dur, cat="event",
                      attrs=None) -> Span:
-        """Record an already-timed range (t0 from :func:`clock`)."""
+        """Record an already-timed range (t0 from :func:`clock`).
+        Stamps the ambient trace context (if any), so bridged
+        profiler ranges stitch into the surrounding trace too."""
         s = Span(name, cat, attrs)
         s.t0 = float(t0)
         s.dur = float(dur)
+        stack = self._stack_var.get()
+        s._stamp_identity(stack[-1] if stack else None)
         self._commit(s)
         return s
 
@@ -851,6 +1119,36 @@ class Tracer:
                     {"type": "metrics", "data": registry.snapshot()},
                     default=str) + "\n")
         return path
+
+
+class _CtxSpan:
+    """A span recorded under an EXPLICIT TraceContext (the combined
+    context manager :func:`span_in` returns): enters the context,
+    then the span, and unwinds both."""
+
+    __slots__ = ("_use", "_span")
+
+    def __init__(self, tracer_obj, ctx, name, cat, attrs):
+        self._use = use_trace_context(ctx)
+        self._span = _SpanCtx(tracer_obj, Span(name, cat, attrs))
+
+    def __enter__(self) -> Span:
+        self._use.__enter__()
+        return self._span.__enter__()
+
+    def __exit__(self, *exc):
+        r = self._span.__exit__(*exc)
+        self._use.__exit__(*exc)
+        return r
+
+
+def span_in(tracer_obj: "Tracer", ctx: Optional[TraceContext],
+            name: str, cat: str = "app", **attrs) -> _CtxSpan:
+    """``with span_in(tracer, req_ctx, "serving.preempt", ...):`` —
+    a span stamped with ``ctx``'s trace id and parented to its root
+    span, regardless of which thread/task/step it runs on. THE
+    request-scoped span entry point of the serving scheduler."""
+    return _CtxSpan(tracer_obj, ctx, name, cat, attrs)
 
 
 # ---------------------------------------------------------------------------
@@ -1170,6 +1468,14 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
     ("ledger.drift_samples.<program>", "gauge",
      "windowed exec.wall_s samples behind the drift ratio (the "
      "watchdog's min-samples guard reads it)"),
+    ("ledger.drifting.<program>", "gauge",
+     "the recorded plan-drift VERDICT (0/1) at publish time, so a "
+     "dumped snapshot replays the threshold in effect when it fired"),
+    ("ledger.wire_bytes_quantized_per_s.<program>", "gauge",
+     "achieved QUANTIZED collective wire rate: the plan's "
+     "comm_bytes_quantized (PR-14's quantized-bytes plan field) over "
+     "measured mean wall — the Prometheus-visible live check of the "
+     "quantize-on-the-wire savings"),
     ("ledger.programs", "gauge",
      "programs currently in the ledger report"),
     # sanitizer mirror (published by the scheduler's watchdog stride)
@@ -1265,18 +1571,28 @@ def prometheus_text(snapshot: Optional[dict] = None,
             name = _prom_name(f"{prefix}_{ns}_{key}")
             if isinstance(v, dict) and "buckets" in v:
                 lines.append(f"# TYPE {name} histogram")
+                # OpenMetrics exemplars (Histogram.exemplars): the
+                # trace id that landed in a bucket rides its bucket
+                # line — the TTFT/TPOT -> trace link
+                exemplars = {float(ub): (lab, val) for ub, lab, val
+                             in (v.get("exemplars") or [])}
                 cum = 0
                 for ub, n in v.get("buckets") or []:
                     cum += int(n)
-                    lines.append(
-                        f'{name}_bucket{{le="{float(ub):g}"}} {cum}')
+                    line = f'{name}_bucket{{le="{float(ub):g}"}} {cum}'
+                    ex = exemplars.get(float(ub))
+                    if ex is not None:
+                        line += (f' # {{trace_id="{ex[0]}"}} '
+                                 f'{_prom_val(ex[1])}')
+                    lines.append(line)
                 lines.append(f'{name}_bucket{{le="+Inf"}} '
                              f'{int(v.get("count") or 0)}')
                 lines.append(f"{name}_sum {_prom_val(v.get('sum'))}")
                 lines.append(f"{name}_count "
                              f"{int(v.get('count') or 0)}")
-                exact = "exact" if v.get("exact", True) \
-                    else "windowed-exact"
+                exact = v.get("exactness") or (
+                    "exact" if v.get("exact", True)
+                    else "windowed-exact")
                 for q, k in ((0.5, "p50"), (0.9, "p90"),
                              (0.99, "p99")):
                     if v.get(k) is not None:
@@ -1323,6 +1639,229 @@ def write_prometheus(path: str,
     return atomic_write_text(
         path, prometheus_text(snapshot=snapshot, registry=registry,
                               prefix=prefix))
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation: merge N worker snapshots into one exposition
+# ---------------------------------------------------------------------------
+
+# gauge merge semantics for merge_snapshots: counters always SUM and
+# histograms always merge their buckets; gauges must DECLARE how a
+# fleet combines them. Pool sizes and populations add across workers;
+# attainment fractions take the WORST worker (the conservative fleet
+# signal an admission controller should gate on); everything else —
+# utilizations, watermarks, epochs, uptimes — takes the max.
+_GAUGE_MERGE_SUM = frozenset({
+    "pool.total_pages", "pool.free_pages", "pool.shared_pages",
+    "pool.used_bytes",
+    "serving.active_requests", "serving.queued_requests",
+    "serving.retired_requests", "serving.swapped_requests",
+    "serving.swap_used_bytes", "serving.slo_window_requests",
+    "serving.steps_per_s",
+    "sanitizer.events", "sanitizer.violations",
+    "ledger.programs",
+})
+_GAUGE_MERGE_MIN_PREFIXES = ("serving.goodput",
+                             "serving.slo_attain_")
+
+
+def gauge_merge_kind(name: str) -> str:
+    """'sum' | 'min' | 'max' — how :func:`merge_snapshots` combines
+    the gauge ``name`` across workers (see the declaration tables
+    above; 'max' is the default)."""
+    if name in _GAUGE_MERGE_SUM:
+        return "sum"
+    if name.startswith(_GAUGE_MERGE_MIN_PREFIXES):
+        return "min"
+    return "max"
+
+
+def _norm_snapshots(snapshots) -> "collections.OrderedDict":
+    """Normalize a worker->snapshot mapping (or a plain sequence of
+    snapshots, named w0..wN) into an ordered dict."""
+    if isinstance(snapshots, dict):
+        return collections.OrderedDict(
+            (str(k), v) for k, v in snapshots.items())
+    return collections.OrderedDict(
+        ("w%d" % i, s) for i, s in enumerate(snapshots))
+
+
+def _bucket_quantile(buckets, count, p, vmax):
+    """Nearest-rank quantile ESTIMATE from merged bucket counts: the
+    upper bound of the bucket the rank falls in, clamped to the
+    merged max — therefore always bounded by the per-worker maxima
+    (raw reservoirs do not cross the wire, only bucket counts do)."""
+    if not count:
+        return None
+    rank = max(1, math.ceil(p / 100.0 * count))
+    cum = 0
+    for ub, n in buckets:
+        cum += int(n)
+        if cum >= rank:
+            est = float(ub)
+            return min(est, vmax) if vmax is not None else est
+    return vmax
+
+
+def _merge_hists(summaries) -> dict:
+    """Merge histogram SUMMARY dicts: counts/sums add exactly,
+    min/max combine, bucket counts add by upper bound, quantiles are
+    re-estimated from the merged buckets (``exactness:
+    "bucket-upper-bound"`` — the renderer labels them so)."""
+    count = sum(int(s.get("count") or 0) for s in summaries)
+    total = sum(float(s.get("sum") or 0.0) for s in summaries)
+    mins = [s.get("min") for s in summaries if s.get("min") is not None]
+    maxs = [s.get("max") for s in summaries if s.get("max") is not None]
+    buckets: Dict[float, int] = {}
+    for s in summaries:
+        for ub, n in s.get("buckets") or []:
+            buckets[float(ub)] = buckets.get(float(ub), 0) + int(n)
+    merged_buckets = sorted(buckets.items())
+    vmax = max(maxs) if maxs else None
+    out = {
+        "count": count,
+        "sum": total,
+        "min": min(mins) if mins else None,
+        "max": vmax,
+        "avg": (total / count) if count else None,
+        "p50": _bucket_quantile(merged_buckets, count, 50, vmax),
+        "p90": _bucket_quantile(merged_buckets, count, 90, vmax),
+        "p99": _bucket_quantile(merged_buckets, count, 99, vmax),
+        "exact": False,
+        "exactness": "bucket-upper-bound",
+        "buckets": merged_buckets,
+        "workers": len(summaries),
+    }
+    ex = [e for s in summaries for e in (s.get("exemplars") or [])]
+    if ex:
+        # newest-wins per bucket is meaningless across workers; keep
+        # one exemplar per bucket (first worker listed wins)
+        seen = {}
+        for ub, lab, val in ex:
+            seen.setdefault(float(ub), [float(ub), lab, val])
+        out["exemplars"] = [seen[k] for k in sorted(seen)]
+    return out
+
+
+def merge_snapshots(snapshots) -> dict:
+    """Combine N registry snapshots (``MetricsRegistry.snapshot()``
+    shapes, keyed by worker name — or a plain list, auto-named
+    w0..wN) into ONE snapshot of the same shape: counters sum
+    EXACTLY, histogram bucket counts / ``count`` / ``sum`` add
+    exactly (quantiles become bucket-upper-bound estimates clamped
+    to the merged max), gauges combine by their declared semantics
+    (:func:`gauge_merge_kind`). Non-numeric leaves (mode markers,
+    nested digests) are dropped — the merged snapshot is a pure
+    metrics surface, renderable by :func:`prometheus_text` and by
+    :func:`merged_prometheus_text` (which adds per-worker
+    ``worker``-labelled series)."""
+    snaps = _norm_snapshots(snapshots)
+    merged: Dict[str, dict] = {}
+    # union of (ns, key) across workers, with each leaf classified
+    leaves: Dict[Tuple[str, str], list] = {}
+    for snap in snaps.values():
+        for ns, group in (snap or {}).items():
+            if not isinstance(group, dict):
+                continue
+            for key, v in group.items():
+                leaves.setdefault((ns, key), []).append(v)
+    for (ns, key), vals in sorted(leaves.items()):
+        hists = [v for v in vals
+                 if isinstance(v, dict) and "buckets" in v]
+        if hists:
+            merged.setdefault(ns, {})[key] = _merge_hists(hists)
+            continue
+        nums = [v for v in vals
+                if isinstance(v, (int, float))
+                and not isinstance(v, bool)]
+        if not nums:
+            continue  # strings / digests / markers: not mergeable
+        if all(isinstance(v, int) for v in nums):
+            merged.setdefault(ns, {})[key] = sum(nums)  # counter
+            continue
+        kind = gauge_merge_kind(f"{ns}.{key}")
+        fn = {"sum": sum, "min": min, "max": max}[kind]
+        merged.setdefault(ns, {})[key] = float(fn(
+            float(v) for v in nums))
+    return merged
+
+
+def merged_prometheus_text(snapshots, prefix: str = "paddle") -> str:
+    """ONE Prometheus exposition for a fleet: the merged aggregate
+    series (unlabelled — counter sums, merged histograms, semantic
+    gauge merges) plus one ``worker``-labelled series per worker for
+    every counter and gauge, and per-worker ``_count``/``_sum``
+    series for every histogram. The aggregate numbers are EXACT sums
+    of the per-worker series by construction (the acceptance gate of
+    the fleet-aggregation CLI)."""
+    snaps = _norm_snapshots(snapshots)
+    merged = merge_snapshots(snaps)
+    lines = []
+    for ns in sorted(merged):
+        group = merged[ns]
+        for key in sorted(group):
+            v = group[key]
+            name = _prom_name(f"{prefix}_{ns}_{key}")
+
+            def worker_vals():
+                for w, snap in snaps.items():
+                    wv = (snap or {}).get(ns, {}).get(key)
+                    if wv is not None:
+                        yield w, wv
+
+            if isinstance(v, dict) and "buckets" in v:
+                lines.append(f"# TYPE {name} histogram")
+                exemplars = {float(ub): (lab, val) for ub, lab, val
+                             in (v.get("exemplars") or [])}
+                cum = 0
+                for ub, n in v["buckets"]:
+                    cum += int(n)
+                    line = f'{name}_bucket{{le="{float(ub):g}"}} {cum}'
+                    ex = exemplars.get(float(ub))
+                    if ex is not None:
+                        line += (f' # {{trace_id="{ex[0]}"}} '
+                                 f'{_prom_val(ex[1])}')
+                    lines.append(line)
+                lines.append(f'{name}_bucket{{le="+Inf"}} '
+                             f'{int(v["count"])}')
+                lines.append(f"{name}_sum {_prom_val(v['sum'])}")
+                lines.append(f"{name}_count {int(v['count'])}")
+                for q, k in ((0.5, "p50"), (0.9, "p90"),
+                             (0.99, "p99")):
+                    if v.get(k) is not None:
+                        lines.append(
+                            f'{name}_quantile{{quantile="{q}",'
+                            f'exactness="bucket-upper-bound"}} '
+                            f'{_prom_val(v[k])}')
+                for w, wv in worker_vals():
+                    if isinstance(wv, dict) and "buckets" in wv:
+                        lines.append(
+                            f'{name}_count{{worker="{w}"}} '
+                            f'{int(wv.get("count") or 0)}')
+                        lines.append(
+                            f'{name}_sum{{worker="{w}"}} '
+                            f'{_prom_val(wv.get("sum"))}')
+            elif isinstance(v, int):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {v}")
+                for w, wv in worker_vals():
+                    if isinstance(wv, int) \
+                            and not isinstance(wv, bool):
+                        lines.append(
+                            f'{name}{{worker="{w}"}} {wv}')
+            elif isinstance(v, float):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(
+                    f'# HELP {name} merged: '
+                    f'{gauge_merge_kind(f"{ns}.{key}")} over workers')
+                lines.append(f"{name} {_prom_val(v)}")
+                for w, wv in worker_vals():
+                    if isinstance(wv, (int, float)) \
+                            and not isinstance(wv, bool):
+                        lines.append(
+                            f'{name}{{worker="{w}"}} '
+                            f'{_prom_val(float(wv))}')
+    return "\n".join(lines) + "\n"
 
 
 # ---------------------------------------------------------------------------
@@ -1480,14 +2019,107 @@ def summarize_jsonl(path: str) -> str:
     return "\n".join(lines)
 
 
-def main(argv=None) -> int:
+def _load_snapshot_file(path: str) -> dict:
+    """A registry snapshot from any of the artifact shapes the repo
+    writes: a JSONL dump (its ``{"type": "metrics"}`` record), a
+    ``TELEMETRY_LAST.json`` bench artifact (its ``"snapshot"``
+    member), an incident bundle's ``metrics.json`` (a raw snapshot),
+    or a bare snapshot dict."""
+    if path.endswith(".jsonl"):
+        snap = _load_jsonl(path)["metrics"]
+        if snap is None:
+            raise ValueError(
+                f"{path} carries no metrics snapshot record")
+        return snap
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: not a snapshot JSON object")
+    if isinstance(data.get("snapshot"), dict):
+        return data["snapshot"]
+    if data.get("type") == "metrics":
+        return data.get("data") or {}
+    return data
+
+
+def _aggregate_main(argv) -> int:
+    """``python -m paddle_tpu.framework.telemetry aggregate`` — the
+    fleet-aggregation CLI: merge N per-worker snapshot files into one
+    Prometheus exposition with ``worker`` labels
+    (:func:`merged_prometheus_text`)."""
     import argparse
 
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.framework.telemetry aggregate",
+        description="Merge N worker registry snapshots (JSONL dumps, "
+        "TELEMETRY_LAST.json artifacts, incident metrics.json, or "
+        "bare snapshot JSON) into one Prometheus exposition with "
+        "worker labels: counters sum exactly, histogram buckets "
+        "merge, gauges combine by declared semantics.")
+    ap.add_argument("files", nargs="*", metavar="SNAPSHOT",
+                    help="snapshot files; worker names default to "
+                    "the file basenames (use --worker to override)")
+    ap.add_argument("--worker", action="append", default=[],
+                    metavar="NAME=PATH",
+                    help="explicit worker-name/file pair "
+                    "(repeatable; combines with positional files, "
+                    "which keep their basename-derived names)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the merged exposition here "
+                    "(atomic tmp+rename; default: stdout)")
+    ap.add_argument("--merged-json", default=None, metavar="PATH",
+                    help="additionally write the merged snapshot "
+                    "(merge_snapshots dict) as JSON")
+    args = ap.parse_args(argv)
+
+    if not args.files and not args.worker:
+        ap.error("pass snapshot files (positional) and/or "
+                 "--worker NAME=PATH pairs")
+    pairs = []
+    for spec in args.worker:
+        name, _, path = spec.partition("=")
+        if not name or not path:
+            ap.error(f"--worker expects NAME=PATH, got {spec!r}")
+        pairs.append((name, path))
+    for path in args.files:
+        stem = os.path.splitext(os.path.basename(path))[0]
+        name = stem
+        i = 1
+        while any(name == n for n, _ in pairs):
+            i += 1
+            name = f"{stem}#{i}"
+        pairs.append((name, path))
+    snaps = collections.OrderedDict(
+        (name, _load_snapshot_file(path)) for name, path in pairs)
+    text = merged_prometheus_text(snaps)
+    if args.out:
+        atomic_write_text(args.out, text)
+        print(f"wrote {args.out} ({len(snaps)} worker(s))")
+    else:
+        print(text, end="")
+    if args.merged_json:
+        atomic_write_text(
+            args.merged_json,
+            json.dumps(merge_snapshots(snaps), indent=1,
+                       default=str))
+        print(f"wrote {args.merged_json}")
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys as _sys
+
+    argv = list(_sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "aggregate":
+        return _aggregate_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.framework.telemetry",
         description="Post-process a telemetry JSONL dump "
         "(Tracer.dump_jsonl): print an aggregated span tree + metric "
-        "table, or convert to Chrome trace JSON.")
+        "table, or convert to Chrome trace JSON. The `aggregate` "
+        "subcommand merges N worker snapshots into one Prometheus "
+        "exposition with worker labels (fleet aggregation).")
     ap.add_argument("--summarize", metavar="TRACE_JSONL", default=None,
                     help="print the span tree and histogram table")
     ap.add_argument("--export-chrome", metavar="TRACE_JSONL",

@@ -116,10 +116,12 @@ class _SwapRecord:
     generations of the kept pages captured at swap-out."""
 
     __slots__ = ("pages", "kept", "length", "k_host", "v_host",
-                 "k_scales_host", "v_scales_host", "gens", "nbytes")
+                 "k_scales_host", "v_scales_host", "gens", "nbytes",
+                 "trace_ctx")
 
     def __init__(self, pages, kept, length, k_host, v_host,
-                 k_scales_host, v_scales_host, gens, nbytes):
+                 k_scales_host, v_scales_host, gens, nbytes,
+                 trace_ctx=None):
         self.pages = pages
         self.kept = kept
         self.length = length
@@ -129,6 +131,11 @@ class _SwapRecord:
         self.v_scales_host = v_scales_host
         self.gens = gens
         self.nbytes = nbytes
+        # serialized TraceContext wire (telemetry.TraceContext): the
+        # swapped-out sequence's trace identity travels WITH the
+        # record, so a restore — on this worker or, once records go
+        # over the wire, on a decode worker — resumes the same trace
+        self.trace_ctx = trace_ctx
 
 
 class HostKVSwapSpace:
@@ -171,6 +178,17 @@ class HostKVSwapSpace:
     def holds(self, seq_id) -> bool:
         """True if ANY pool holds a swap record for ``seq_id``."""
         return any(k[1] == seq_id for k in self._swap_store)
+
+    def trace_context(self, seq_id):
+        """The swapped-out sequence's serialized TraceContext wire
+        (telemetry.TraceContext.to_wire()), read off its swap
+        records — what a receiving decode worker extracts to resume
+        the request's trace. None when the sequence is not swapped
+        here or was never stamped."""
+        for k, rec in self._swap_store.items():
+            if k[1] == seq_id and rec.trace_ctx is not None:
+                return rec.trace_ctx
+        return None
 
     def summary(self) -> dict:
         return {
@@ -288,6 +306,14 @@ class PagedKVCacheManager:
         # counters under the "pool." namespace; None when
         # FLAGS_telemetry=off — each event site pays one check
         self._reg = telemetry.registry()
+        # per-sequence serialized TraceContext wires (the ops-plane
+        # propagation contract, docs/OBSERVABILITY.md): stamped by
+        # the scheduler at admission (set_trace_context), carried on
+        # the swap records across the host tier, and handed over
+        # with a COW chain attach — so one request's trace survives
+        # preemption round trips and the future prefill/decode
+        # worker split. Plain strings only; never device state
+        self._trace_ctxs = {}
 
     # -- bookkeeping -------------------------------------------------------
     def alloc(self, seq_id):
@@ -298,11 +324,14 @@ class PagedKVCacheManager:
         self._tables[seq_id] = []
         self._lens[seq_id] = 0
 
-    def attach(self, seq_id, pages, length):
+    def attach(self, seq_id, pages, length, trace_ctx=None):
         """Register ``seq_id`` on an existing page chain covering its
-        first ``length`` tokens (a prefix-cache hit). Every chain page
-        gains a reference; the content is shared until this sequence
-        writes into the (partial) last page, which forks it."""
+        first ``length`` tokens (a prefix-cache hit, or a page-chain
+        handoff from another worker). Every chain page gains a
+        reference; the content is shared until this sequence writes
+        into the (partial) last page, which forks it. ``trace_ctx``
+        (a serialized TraceContext wire string) rides along so the
+        chain's trace identity transfers with its ownership."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already allocated")
         need = -(-int(length) // self.page_size) if length else 0
@@ -324,8 +353,27 @@ class PagedKVCacheManager:
         self._ref_pages(pages)
         self._tables[seq_id] = list(pages)
         self._lens[seq_id] = int(length)
+        if trace_ctx is not None:
+            self._trace_ctxs[seq_id] = str(trace_ctx)
         if self._san is not None:
             self._san.verify_pages(pages, self)
+
+    # -- trace-context propagation (framework/telemetry.py) ----------------
+    def set_trace_context(self, seq_id, wire) -> None:
+        """Pin a SERIALIZED TraceContext (``TraceContext.to_wire()``)
+        to a live sequence: it rides the sequence's swap records
+        through the host tier and is what a receiving worker
+        extracts after a page-chain handoff. Host-only metadata —
+        never touches device state."""
+        if seq_id not in self._tables:
+            raise KeyError(
+                f"set_trace_context({seq_id!r}): unknown sequence")
+        self._trace_ctxs[seq_id] = str(wire)
+
+    def seq_trace_context(self, seq_id):
+        """The sequence's serialized TraceContext wire (None when
+        never stamped)."""
+        return self._trace_ctxs.get(seq_id)
 
     def _ref_pages(self, pages):
         """Take one reference per chain page (attach)."""
@@ -348,6 +396,7 @@ class PagedKVCacheManager:
         del self._tables[seq_id]
         self._drop_refs(tbl)
         self._lens.pop(seq_id)
+        self._trace_ctxs.pop(seq_id, None)
         if self._san is not None:
             self._san.verify_pages(tbl, self)
 
@@ -544,8 +593,10 @@ class PagedKVCacheManager:
             pages=list(tbl), kept=kept, length=length, k_host=k_host,
             v_host=v_host, k_scales_host=ks_host,
             v_scales_host=vs_host, gens=gens,
-            nbytes=len(priv) * self.page_nbytes)
+            nbytes=len(priv) * self.page_nbytes,
+            trace_ctx=self._trace_ctxs.get(seq_id))
         space._swap_put((self._uid, seq_id), rec)
+        self._trace_ctxs.pop(seq_id, None)
         if self._san is not None:
             self._san.event("swap_out", seq=seq_id,
                             pages=[int(p) for p in tbl],
@@ -645,6 +696,9 @@ class PagedKVCacheManager:
                 pool=self)
         space._swap_pop(key)
         space.swapped_in_records += 1
+        if rec.trace_ctx is not None:
+            # the restored sequence resumes its own trace
+            self._trace_ctxs[seq_id] = rec.trace_ctx
         if self._reg is not None:
             self._reg.inc("pool.swap_in_pages", len(new_priv))
         return len(new_priv)

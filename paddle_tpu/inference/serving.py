@@ -109,6 +109,21 @@ flops/s, MFU, bytes/s, step-wall share, plan drift). The
 ``FLAGS_telemetry_incident_dir`` set every watchdog fire (or an
 explicit :meth:`BatchScheduler.dump_incident`) writes one atomic
 incident bundle capturing the trip's own evidence.
+
+Live ops plane (ISSUE 15; framework/ops_server.py,
+docs/OBSERVABILITY.md "Live ops plane"): with
+``FLAGS_ops_server_port`` set the scheduler starts the process-wide
+read-only debug server (``/metrics``, ``/statusz``, ``/tracez``,
+``/planz``, ``/flagz``, ``/incidentz``) and registers its own
+``/statusz`` section. Every request carries a serializable
+:class:`telemetry.TraceContext` (created at :meth:`submit`, or
+adopted via ``Request(trace_ctx=...)``): request-scoped spans
+(preempt/swap-in/retire) record under it, the serialized context is
+pinned to the request's page chains and rides the swap records, so
+one request renders as ONE stitched trace across preemption round
+trips, asyncio executor hops, and the future prefill/decode worker
+split; TTFT/TPOT observations attach the trace id as an OpenMetrics
+exemplar.
 """
 from __future__ import annotations
 
@@ -204,6 +219,13 @@ class Request:
     priority: int = 0
     tenant: str = "default"
     deadline_s: Optional[float] = None
+    # trace identity (framework/telemetry.py TraceContext): None
+    # under FLAGS_telemetry=off; auto-created at submit otherwise,
+    # or adopted from an ingress — pass a TraceContext (or its
+    # to_wire() string, e.g. extracted from a front-end carrier) and
+    # every span/lane event of this request stitches to that trace
+    # id, across preemption round trips and worker hops
+    trace_ctx: Optional[object] = None
     state: str = RequestState.QUEUED
     generated_ids: List[int] = field(default_factory=list)
     _pos: int = 0  # prompt tokens consumed so far
@@ -479,6 +501,20 @@ class BatchScheduler:
                     registry=self._metrics, tracer=self._tracer,
                     traces=self._traces, watchdog=self._watchdog,
                     ledger=self._ledger)
+            if int(flag("ops_server_port")) > 0:
+                # embedded live-ops debug server (framework/
+                # ops_server.py): one per process, read-only —
+                # /metrics, /statusz, /tracez, /planz, /flagz,
+                # /incidentz. Flag 0 (default) never imports the
+                # module; the server refuses to exist without a
+                # live registry
+                from ..framework import ops_server as _ops_server
+
+                srv = _ops_server.maybe_start()
+                if srv is not None:
+                    srv.add_status_provider(
+                        "scheduler." + self._sched_uid,
+                        self._statusz_info)
 
     # -- pool accounting ---------------------------------------------------
     def _pool(self, model=None):
@@ -605,6 +641,30 @@ class BatchScheduler:
             snap["ledger"] = self._ledger.report()
         return snap
 
+    def _statusz_info(self) -> dict:
+        """This scheduler's ``/statusz`` section (framework/
+        ops_server.py provider contract): population counts, SLO
+        window, and the watchdog state — the live operator view."""
+        info = {
+            "steps": self._steps,
+            "active": len(self._active),
+            "queued": len(self._queue),
+            "swapped": len(self._swapped),
+            "retired": len(self._finished),
+            "chunked_prefill": self.chunked_prefill,
+        }
+        if self._slo is not None:
+            info["slo"] = self._slo.to_dict()
+            m = self._metrics
+            info["slo_window"] = {
+                "goodput": m.gauge_value("serving.goodput"),
+                "requests": m.gauge_value(
+                    "serving.slo_window_requests"),
+            }
+        if self._watchdog is not None:
+            info["watchdog"] = self._watchdog.summary()
+        return info
+
     def _publish_gauges(self) -> dict:
         """Publish every derived gauge into the registry and return
         the legacy-shape stats dict. ONE source of truth for the
@@ -720,11 +780,27 @@ class BatchScheduler:
         req._order = self._submit_seq
         if self._metrics is not None:
             req._t_submit = telemetry.clock()
+        if self._metrics is not None or self._traces is not None \
+                or self._tracer is not None:
+            # trace identity: adopt an injected context (object or
+            # wire string — a front-end/ingress handoff), else start
+            # a fresh trace. NEVER under off — the hot path must
+            # allocate nothing (the zero-alloc gate covers this)
+            ctx = req.trace_ctx
+            if isinstance(ctx, str):
+                ctx = telemetry.TraceContext.from_wire(ctx)
+            if ctx is None:
+                ctx = telemetry.TraceContext(
+                    tenant=req.tenant, deadline_s=req.deadline_s)
+            req.trace_ctx = ctx
         if self._traces is not None:
+            payload = {"prompt_tokens": len(req.prompt_ids),
+                       "max_new_tokens": req.max_new_tokens}
+            if req.trace_ctx is not None:
+                payload["trace_id"] = req.trace_ctx.trace_id
             self._traces.begin(
                 req.req_id, telemetry.clock(), self._step_epoch,
-                prompt_tokens=len(req.prompt_ids),
-                max_new_tokens=req.max_new_tokens)
+                **payload)
         self._queue.append(req)
         return req.req_id
 
@@ -922,6 +998,9 @@ class BatchScheduler:
                     self.prefix_stats["request_hits"] += 1
             if self.draft is not None:
                 self.draft.alloc(req.req_id)
+            # the admitted chains carry the request's trace context
+            # from here on (swap records and COW handoffs inherit it)
+            self._tag_pool_trace(req)
             req.state = RequestState.PREFILL
             self._active[req.req_id] = req
             self._admitted_step += 1
@@ -1003,13 +1082,17 @@ class BatchScheduler:
         resuming is just another packed prompt/decode row next step
         (the chunked-prefill path needs no special case)."""
         rid = req.req_id
-        with self._span("serving.swap_in", req=rid):
+        with self._req_span("serving.swap_in", req, req=rid):
             fn = getattr(self.model, "swap_in", None)
             if fn is not None:
                 restored = fn(rid, self.swap_space)
             else:
                 restored = sum(c.swap_in(rid, self.swap_space)
                                for c in self.model.caches)
+        # the restored chains re-carry the context (pools that
+        # round-trip it through their swap records already do; this
+        # covers model-level swap hooks and fresh chains)
+        self._tag_pool_trace(req)
         del self._swapped[rid]
         req.state = (RequestState.DECODE if req.generated_ids
                      else RequestState.PREFILL)
@@ -1092,7 +1175,8 @@ class BatchScheduler:
             return False
         freed = 0
         nbytes = 0
-        with self._span("serving.preempt", req=rid, reason=reason):
+        with self._req_span("serving.preempt", req, req=rid,
+                            reason=reason):
             fn = getattr(self.model, "swap_out", None)
             if fn is not None:
                 freed, nbytes = fn(rid, space)
@@ -1235,6 +1319,45 @@ class BatchScheduler:
         tr = self._tracer
         return tr.span(name, **attrs) if tr is not None else _NULL
 
+    def _req_span(self, name, request, **attrs):
+        """Request-scoped span: recorded under the request's
+        :class:`telemetry.TraceContext`, so its trace id and parent
+        link stitch one request's spans across steps, preemption
+        round trips, asyncio executor hops, and (via the serialized
+        context on the swap records / page chains) a future
+        cross-worker handoff. NULL_SPAN when no tracer is live.
+        (``request`` is positional-by-convention: the ``req=`` span
+        ATTRIBUTE carries the id, like every other span site.)"""
+        tr = self._tracer
+        if tr is None:
+            return _NULL
+        ctx = request.trace_ctx
+        if not isinstance(ctx, telemetry.TraceContext):
+            # None, or a raw wire string left unparsed because no
+            # telemetry was live at submit: plain span
+            return tr.span(name, **attrs)
+        return telemetry.span_in(tr, ctx, name, **attrs)
+
+    def _tag_pool_trace(self, req):
+        """Stamp the request's SERIALIZED TraceContext onto its page
+        chains (pool-level ``set_trace_context``): the swap records
+        (``HostKVSwapSpace``) and COW chain attaches then carry the
+        trace across the prefill/decode worker split of ROADMAP
+        item 4 — the receiving worker re-extracts the context from
+        the record instead of starting a fresh trace."""
+        ctx = req.trace_ctx
+        if ctx is None:
+            return
+        # under FLAGS_telemetry=off an ingress-provided context stays
+        # the raw wire string (submit builds nothing) — propagate it
+        # as-is: the cross-worker handoff must not depend on THIS
+        # box's telemetry mode
+        wire = ctx if isinstance(ctx, str) else ctx.to_wire()
+        for c in self.model.caches:
+            fn = getattr(c, "set_trace_context", None)
+            if fn is not None:
+                fn(req.req_id, wire)
+
     def _note_gen_token(self, req: Request):
         """TTFT/TPOT accounting — call right after a GENERATED token
         is appended (prompt tokens never count). The first token
@@ -1251,12 +1374,18 @@ class BatchScheduler:
             return
         self._metrics.inc("serving.generated_tokens")
         now = telemetry.clock()
+        # the OpenMetrics exemplar: the trace id that landed in the
+        # bucket — /metrics readers can jump from a latency bucket
+        # straight to the request trace behind it
+        ex = req.trace_ctx.trace_id \
+            if req.trace_ctx is not None else None
         if len(req.generated_ids) == 1:
             req._ttft = now - req._t_submit
-            self._metrics.observe("serving.ttft_s", req._ttft)
+            self._metrics.observe("serving.ttft_s", req._ttft,
+                                  exemplar=ex)
         else:
             gap = now - req._t_last_tok
-            self._metrics.observe("serving.tpot_s", gap)
+            self._metrics.observe("serving.tpot_s", gap, exemplar=ex)
             if req._gaps is None:
                 req._gaps = []
             req._gaps.append(gap)
@@ -1266,7 +1395,7 @@ class BatchScheduler:
         # span and histogram gate independently: a tracer armed by a
         # profiler window (metrics off) still gets its retire spans
         t0 = telemetry.clock() if self._metrics is not None else 0.0
-        with self._span("serving.retire", req=req.req_id):
+        with self._req_span("serving.retire", req, req=req.req_id):
             self._retire_impl(req)
         met = None
         if self._metrics is not None:

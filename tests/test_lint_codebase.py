@@ -1121,3 +1121,130 @@ class TestWireQuantOwnership:
     def test_rule_inventory_has_wire_quant(self):
         ids = [r for r, _ in lint_codebase.RULES]
         assert "wire-quant-ownership" in ids
+
+
+class TestMetricNameDiscipline:
+    """Seeded violations + clean patterns for the metric-name rule
+    (ISSUE 15): registry emits must use Prometheus-safe literals
+    registered in telemetry.SURFACE — no ad-hoc f-string names."""
+
+    SURFACE = ("serving.ttft_s", "serving.steps", "pool.cow_forks",
+               "ledger.mfu.<program>", "exec.wall_s.<program>",
+               "serving.slo_attain_ttft")
+
+    def lint(self, src):
+        return lint_codebase.lint_metric_names_file(
+            "paddle_tpu/fake_mod.py", text=src,
+            surface_names=self.SURFACE)
+
+    def test_registered_literal_clean(self):
+        src = (
+            "def f(reg):\n"
+            "    reg.inc('serving.steps')\n"
+            "    reg.observe('serving.ttft_s', 0.1)\n"
+            "    reg.gauge('pool.cow_forks', 2)\n"
+        )
+        assert self.lint(src) == []
+
+    def test_fstring_name_flagged(self):
+        src = (
+            "def f(reg, x):\n"
+            "    reg.inc(f'serving.{x}')\n"
+        )
+        v = self.lint(src)
+        assert len(v) == 1 and "f-string" in v[0]
+
+    def test_unregistered_name_flagged(self):
+        src = (
+            "def f(reg):\n"
+            "    reg.inc('serving.totally_new_counter')\n"
+        )
+        v = self.lint(src)
+        assert len(v) == 1 and "not registered" in v[0]
+
+    def test_prom_unsafe_chars_flagged(self):
+        src = (
+            "def f(reg):\n"
+            "    reg.inc('serving.Bad-Name')\n"
+        )
+        v = self.lint(src)
+        assert len(v) == 1 and "round trip" in v[0]
+
+    def test_fully_dynamic_flagged_and_waivable(self):
+        bad = (
+            "def f(reg, key):\n"
+            "    reg.observe(key, 0.5)\n"
+        )
+        v = self.lint(bad)
+        assert len(v) == 1 and "fully dynamic" in v[0]
+        waived = (
+            "def f(reg, key):\n"
+            "    # metric-name: ok (pre-resolved hot-path key)\n"
+            "    reg.observe(key, 0.5)\n"
+        )
+        assert self.lint(waived) == []
+        inline = (
+            "def f(reg, key):\n"
+            "    reg.observe(key, 0.5)  # metric-name: ok (test)\n"
+        )
+        assert self.lint(inline) == []
+
+    def test_dynamic_suffix_matches_placeholder_row(self):
+        src = (
+            "def f(reg, prog):\n"
+            "    reg.gauge('ledger.mfu.' + prog, 0.4)\n"
+            "    reg.gauge('serving.slo_attain_' + 'ttft', 1.0)\n"
+        )
+        assert self.lint(src) == []
+
+    def test_percent_template_matches_placeholder_row(self):
+        src = (
+            "def f(reg, field, prog):\n"
+            "    reg.gauge('ledger.%s.%s' % (field, prog), 0.4)\n"
+        )
+        assert self.lint(src) == []
+
+    def test_concrete_instantiation_of_placeholder_row(self):
+        src = (
+            "def f(reg):\n"
+            "    reg.observe('exec.wall_s.decode_token', 0.1)\n"
+        )
+        assert self.lint(src) == []
+
+    def test_module_const_prefix_resolves(self):
+        src = (
+            "PREFIX = 'exec.wall_s.'\n"
+            "def f(reg, prog):\n"
+            "    reg.observe(PREFIX + str(prog), 0.1)\n"
+        )
+        assert self.lint(src) == []
+
+    def test_dynamic_namespace_head_flagged(self):
+        src = (
+            "def f(reg, ns):\n"
+            "    reg.inc(ns + '.steps')\n"
+        )
+        v = self.lint(src)
+        assert len(v) == 1 and "dynamic namespace head" in v[0]
+
+    def test_non_registry_receiver_ignored(self):
+        src = (
+            "def f(h, counterish):\n"
+            "    h.observe(0.5)\n"
+            "    counterish.inc('whatever.name')\n"
+        )
+        assert self.lint(src) == []
+
+    def test_surface_parses_from_real_module(self):
+        names = lint_codebase.surface_metric_names()
+        assert "serving.ttft_s" in names
+        assert "ledger.wire_bytes_quantized_per_s.<program>" in names
+        assert not any(n.startswith("span:") for n in names)
+
+    def test_repo_metric_names_clean(self):
+        v = lint_codebase.check_metric_names()
+        assert v == [], "\n".join(v)
+
+    def test_rule_inventory_has_metric_name_discipline(self):
+        assert any(rid == "metric-name-discipline"
+                   for rid, _ in lint_codebase.RULES)

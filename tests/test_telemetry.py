@@ -358,11 +358,19 @@ class TestOffModeZeroAlloc:
     def test_serving_loop_allocates_nothing_in_telemetry(self,
                                                          tel_off):
         sched = BatchScheduler(_FakeModel(), max_batch_size=4)
+        reqs = []
         for i in range(3):
-            sched.submit(Request(f"r{i}", [2, 3, 4],
-                                 max_new_tokens=4))
+            reqs.append(Request(f"r{i}", [2, 3, 4],
+                                max_new_tokens=4))
+            sched.submit(reqs[-1])
         tracemalloc.start()
         snap0 = tracemalloc.take_snapshot()
+        # the TraceContext extension of the off contract (ISSUE 15):
+        # requests submitted while the loop runs must not grow trace
+        # identity either — TraceContext lives in telemetry.py, so
+        # the filter below catches any construction
+        late = Request("late", [2, 3], max_new_tokens=2)
+        sched.submit(late)
         sched.run_until_complete()
         snap1 = tracemalloc.take_snapshot()
         tracemalloc.stop()
@@ -373,6 +381,8 @@ class TestOffModeZeroAlloc:
         assert new_blocks == 0, (
             f"FLAGS_telemetry=off allocated {new_blocks} blocks in "
             "telemetry.py — the off-is-free contract is broken")
+        # off mode never builds trace identity
+        assert all(r.trace_ctx is None for r in reqs + [late])
 
 
 # -- CLI ---------------------------------------------------------------------
@@ -1316,3 +1326,720 @@ class TestTruncatedJsonl:
             f.write("\n".join(lines))  # garbage is NOT final now
         with pytest.raises(ValueError):
             telemetry.summarize_jsonl(path)
+
+
+# -- ISSUE 15: live ops plane — trace context, contextvars tracer, ----------
+# -- fleet aggregation, exemplars, quantized-wire export --------------------
+
+
+class TestTraceContext:
+    def test_wire_round_trip(self, tel_off):
+        ctx = telemetry.TraceContext(tenant="acme", deadline_s=2.5)
+        back = telemetry.TraceContext.from_wire(ctx.to_wire())
+        assert back == ctx
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        assert back.tenant == "acme"
+        assert back.deadline_s == 2.5
+
+    def test_ids_are_process_unique(self, tel_off):
+        a = telemetry.TraceContext()
+        b = telemetry.TraceContext()
+        assert a.trace_id != b.trace_id
+        assert a.span_id != b.span_id
+
+    def test_inject_extract_carrier(self, tel_off):
+        ctx = telemetry.TraceContext(tenant="t9")
+        carrier = {}
+        ctx.inject(carrier)
+        assert telemetry.TraceContext.WIRE_KEY in carrier
+        assert telemetry.TraceContext.extract(carrier) == ctx
+        assert telemetry.TraceContext.extract({}) is None
+        assert telemetry.TraceContext.extract(None) is None
+
+    def test_child_keeps_trace_moves_parent(self, tel_off):
+        ctx = telemetry.TraceContext()
+        kid = ctx.child(777)
+        assert kid.trace_id == ctx.trace_id
+        assert kid.span_id == 777
+
+    def test_from_wire_rejects_garbage(self, tel_off):
+        with pytest.raises(ValueError):
+            telemetry.TraceContext.from_wire('{"nope": 1}')
+
+    def test_off_mode_wire_string_ctx_still_serves(self, tel_off):
+        """Review regression: a Request carrying an ingress wire
+        STRING under FLAGS_telemetry=off must serve normally (no
+        local context is built — the raw wire propagates to the
+        pool untouched, so the cross-worker handoff survives a box
+        with telemetry disabled)."""
+        ctx = telemetry.TraceContext(tenant="edge")
+        sched = BatchScheduler(_FakeSwapModel(), max_batch_size=2)
+        req = Request("w0", [2, 3], max_new_tokens=2,
+                      trace_ctx=ctx.to_wire())
+        sched.submit(req)
+        sched.run_until_complete()
+        assert req.finished
+        # off built nothing: still the raw string
+        assert req.trace_ctx == ctx.to_wire()
+
+    def test_ambient_context_manager(self, tel_off):
+        assert telemetry.current_trace_context() is None
+        ctx = telemetry.TraceContext()
+        with telemetry.use_trace_context(ctx):
+            assert telemetry.current_trace_context() is ctx
+            inner = telemetry.TraceContext()
+            with telemetry.use_trace_context(inner):
+                assert telemetry.current_trace_context() is inner
+            assert telemetry.current_trace_context() is ctx
+        assert telemetry.current_trace_context() is None
+
+
+class TestContextvarsTracer:
+    """The Tracer's contextvars migration: per-task isolation, the
+    executor-handoff tid fix, and trace-id stamping."""
+
+    def test_cross_thread_close_attributes_opening_thread(self,
+                                                          tel_off):
+        import threading as _threading
+
+        tr = telemetry.Tracer(ring=64)
+        cm = tr.span("handoff")
+        opener_tid = []
+
+        def opener():
+            cm.__enter__()
+            opener_tid.append(_threading.get_ident())
+
+        th = _threading.Thread(target=opener)
+        th.start()
+        th.join()
+        # the executor handoff: the span is CLOSED on this thread
+        cm.__exit__(None, None, None)
+        s = tr.spans()[-1]
+        assert s.name == "handoff"
+        # the regression: tid must be the thread that DID the work,
+        # not whoever happened to close (or construct) the span
+        assert s.tid == opener_tid[0]
+        assert s.tid != _threading.get_ident()
+        # and this thread's nesting state is not corrupted
+        with tr.span("after") as s2:
+            assert s2.depth == 0
+        assert tr.spans()[-1].path == "after"
+
+    def test_asyncio_tasks_keep_isolated_stacks(self, tel_off):
+        """Two tasks interleaving awaits on ONE loop thread: under
+        the old threading.local stack their spans would nest into
+        each other; under contextvars each task sees only its own
+        ancestry."""
+        import asyncio
+
+        tr = telemetry.Tracer(ring=128)
+
+        async def worker(i):
+            with tr.span(f"outer{i}") as outer:
+                await asyncio.sleep(0.01 * (2 - i))
+                with tr.span(f"inner{i}") as inner:
+                    await asyncio.sleep(0.01 * i)
+                    assert inner.depth == 1
+                return outer, inner
+
+        async def main():
+            return await asyncio.gather(worker(0), worker(1))
+
+        (o0, i0), (o1, i1) = asyncio.run(main())
+        assert i0.path == "outer0/inner0"
+        assert i1.path == "outer1/inner1"
+        assert i0.parent_id == o0.span_id
+        assert i1.parent_id == o1.span_id
+        assert o0.depth == 0 and o1.depth == 0
+
+    def test_span_ids_and_parent_links(self, tel_off):
+        tr = telemetry.Tracer(ring=16)
+        with tr.span("a") as a:
+            with tr.span("b") as b:
+                pass
+        assert b.parent_id == a.span_id
+        assert a.parent_id is None
+        assert a.trace_id is None  # no ambient context
+
+    def test_ambient_context_stamps_spans(self, tel_off):
+        tr = telemetry.Tracer(ring=16)
+        ctx = telemetry.TraceContext()
+        with telemetry.span_in(tr, ctx, "root") as root:
+            assert root.trace_id == ctx.trace_id
+            assert root.parent_id == ctx.span_id
+            with tr.span("kid") as kid:
+                pass
+        # the nested span inherits the trace and parents to the
+        # enclosing span (same trace)
+        assert kid.trace_id == ctx.trace_id
+        assert kid.parent_id == root.span_id
+
+    def test_add_complete_stamps_ambient_context(self, tel_off):
+        tr = telemetry.Tracer(ring=16)
+        ctx = telemetry.TraceContext()
+        with telemetry.use_trace_context(ctx):
+            s = tr.add_complete("bridged", 1.0, 0.5)
+        assert s.trace_id == ctx.trace_id
+        assert s.parent_id == ctx.span_id
+
+    def test_executor_hop_keeps_request_trace(self, tel_off):
+        """A span opened under a request context, with the actual
+        work hopped to an executor thread that opens its own child
+        spans under the SAME context — one trace id throughout."""
+        import asyncio
+        from concurrent.futures import ThreadPoolExecutor
+
+        tr = telemetry.Tracer(ring=64)
+        ctx = telemetry.TraceContext()
+
+        def blocking_work():
+            with telemetry.span_in(tr, ctx, "work.inner"):
+                pass
+
+        async def main():
+            loop = asyncio.get_event_loop()
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                with telemetry.span_in(tr, ctx, "work.outer"):
+                    await loop.run_in_executor(pool, blocking_work)
+
+        asyncio.run(main())
+        spans = {s.name: s for s in tr.spans()}
+        assert spans["work.inner"].trace_id == ctx.trace_id
+        assert spans["work.outer"].trace_id == ctx.trace_id
+        # the inner span ran on a DIFFERENT thread yet still parents
+        # to the request's root span
+        assert spans["work.inner"].tid != spans["work.outer"].tid
+        assert spans["work.inner"].parent_id == ctx.span_id
+
+    def test_chrome_export_carries_trace_ids(self, tel_off):
+        tr = telemetry.Tracer(ring=16)
+        ctx = telemetry.TraceContext()
+        with telemetry.span_in(tr, ctx, "traced", req="r1"):
+            pass
+        with tr.span("plain"):
+            pass
+        doc = tr.to_chrome()
+        by_name = {e["name"]: e for e in doc["traceEvents"]}
+        assert by_name["traced"]["args"]["trace_id"] == ctx.trace_id
+        assert by_name["traced"]["args"]["parent_span"] == ctx.span_id
+        assert by_name["traced"]["args"]["req"] == "r1"
+        assert "trace_id" not in by_name["plain"]["args"]
+
+
+# -- a swap-capable fake for the stitched-trace scenario ---------------------
+
+
+class _FakeSwapCache(_FakeCache):
+    """Host-only cache fake implementing the pool swap + trace-
+    context protocol the scheduler drives (records live in the REAL
+    HostKVSwapSpace via its pool-only entry points)."""
+
+    PAGE_NBYTES = 64
+
+    def __init__(self, num_pages=1024, page_size=4):
+        super().__init__(num_pages=num_pages, page_size=page_size)
+        self._uid = id(self)
+        self._trace_ctxs = {}
+
+    def _pages(self, s):
+        n = self.lens[s]
+        return -(-n // self.page_size) if n else 0
+
+    def seq_page_count(self, s):
+        return self._pages(s)
+
+    def swap_out_pages(self, s):
+        return self._pages(s)
+
+    def swap_out_nbytes(self, s):
+        return self._pages(s) * self.PAGE_NBYTES
+
+    def swap_out(self, s, space):
+        import types as _types
+
+        rec = _types.SimpleNamespace(
+            nbytes=self.swap_out_nbytes(s), length=self.lens[s],
+            trace_ctx=self._trace_ctxs.pop(s, None))
+        space._swap_put((self._uid, s), rec)
+        pages = self._pages(s)
+        del self.lens[s]
+        return pages, rec.nbytes
+
+    def swap_in_pages_needed(self, s, space, worst_tokens=None):
+        rec = space._swap_get((self._uid, s))
+        return -(-rec.length // self.page_size) if rec.length else 0
+
+    def swap_in(self, s, space):
+        rec = space._swap_pop((self._uid, s))
+        space.swapped_in_records += 1
+        self.lens[s] = rec.length
+        if rec.trace_ctx is not None:
+            self._trace_ctxs[s] = rec.trace_ctx
+        return -(-rec.length // self.page_size) if rec.length else 0
+
+    def swap_discard(self, s, space):
+        space._swap_pop((self._uid, s))
+
+    def set_trace_context(self, s, wire):
+        self._trace_ctxs[s] = wire
+
+    def seq_trace_context(self, s):
+        return self._trace_ctxs.get(s)
+
+
+class _FakeSwapModel(_FakeModel):
+    def __init__(self, vocab=16, num_pages=1024):
+        self.vocab = vocab
+        self.caches = [_FakeSwapCache(num_pages=num_pages)]
+
+
+class TestStitchedTrace:
+    """ISSUE 15 acceptance: one request traced through admission ->
+    preemption/swap-out -> swap-in -> completion yields ONE stitched
+    trace (single trace id, correct parent links) in the chrome
+    export — including when the steps hop across asyncio executor
+    threads."""
+
+    def _run(self, step_driver):
+        from paddle_tpu.incubate.nn.fault_injection import (
+            FaultInjector,
+        )
+
+        sched = BatchScheduler(
+            _FakeSwapModel(), max_batch_size=4,
+            swap_bytes=1 << 20,
+            fault_injector=FaultInjector("preempt_storm@3:1"))
+        reqs = [Request(f"r{i}", [2, 3, 4, 5], max_new_tokens=3)
+                for i in range(2)]
+        for r in reqs:
+            sched.submit(r)
+        step_driver(sched)
+        assert all(r.finished for r in reqs)
+        victims = [r for r in reqs if r._preemptions]
+        assert victims, "the storm must have preempted someone"
+        return sched, victims[0]
+
+    def _assert_stitched(self, sched, victim):
+        ctx = victim.trace_ctx
+        assert ctx is not None
+        tr = telemetry.tracer()
+        book = telemetry.request_traces()
+        mine = [s for s in tr.spans() if s.trace_id == ctx.trace_id]
+        names = {s.name for s in mine}
+        assert {"serving.preempt", "serving.swap_in",
+                "serving.retire"} <= names
+        # correct parent links: every request-scoped span parents to
+        # the request's root span, under ONE trace id
+        assert all(s.parent_id == ctx.span_id for s in mine)
+        # no other trace bleeds in: spans of the OTHER request carry
+        # a different trace id
+        others = [s for s in tr.spans()
+                  if s.trace_id not in (None, ctx.trace_id)]
+        assert others, "the non-victim request must trace too"
+        # the request-trace lane stitches: submit -> evict ->
+        # admit(swapped_in) -> retire, opened with the trace id
+        rec = book.get(victim.req_id).to_dict()
+        kinds = [e["kind"] for e in rec["events"]]
+        assert kinds[0] == "submit"
+        assert "evict" in kinds and "retire" in kinds
+        assert rec["events"][0]["trace_id"] == ctx.trace_id
+        resumed = [e for e in rec["events"] if e["kind"] == "admit"
+                   and e.get("swapped_in")]
+        assert resumed, "the swap-in re-admission must be on the lane"
+        # and the chrome export carries the stitched trace
+        chrome = telemetry.chrome_payload(tr, book)
+        traced = [e for e in chrome["traceEvents"]
+                  if e.get("args", {}).get("trace_id")
+                  == ctx.trace_id and e.get("ph") == "X"]
+        assert {e["name"] for e in traced} >= {
+            "serving.preempt", "serving.swap_in", "serving.retire"}
+        assert all(e["args"]["parent_span"] == ctx.span_id
+                   for e in traced)
+
+    def test_preempt_swap_in_complete_single_trace(self, tel_trace):
+        def drive(sched):
+            for _ in range(50):
+                if not (sched.num_active or sched.num_queued
+                        or sched.num_swapped):
+                    break
+                sched.step()
+
+        sched, victim = self._run(drive)
+        self._assert_stitched(sched, victim)
+
+    def test_stitches_across_asyncio_executor_hop(self, tel_trace):
+        """The same scenario with every scheduler step dispatched
+        through loop.run_in_executor over TWO alternating single-
+        thread executors — consecutive steps run on different
+        threads, the trace must not care."""
+        import asyncio
+        from concurrent.futures import ThreadPoolExecutor
+
+        step_tids = []
+
+        def drive(sched):
+            async def main():
+                loop = asyncio.get_event_loop()
+                pools = [ThreadPoolExecutor(max_workers=1)
+                         for _ in range(2)]
+                try:
+                    for i in range(50):
+                        if not (sched.num_active or sched.num_queued
+                                or sched.num_swapped):
+                            break
+
+                        def one_step():
+                            import threading as _t
+
+                            step_tids.append(_t.get_ident())
+                            sched.step()
+
+                        await loop.run_in_executor(
+                            pools[i % 2], one_step)
+                finally:
+                    for p in pools:
+                        p.shutdown()
+
+            asyncio.run(main())
+
+        sched, victim = self._run(drive)
+        assert len(set(step_tids)) >= 2, \
+            "the driver must actually hop threads"
+        self._assert_stitched(sched, victim)
+
+    def test_swap_record_carries_context_wire(self, tel_trace):
+        """The fake-pool contract mirrored by the REAL pool: the
+        serialized context rides the swap record through the host
+        tier (HostKVSwapSpace) and comes back at swap-in."""
+        sched, victim = self._run(lambda s: [s.step()
+                                             for _ in range(40)])
+        # after completion the cache-side wire survived the round
+        # trip and still parses to the victim's context
+        cache = sched.model.caches[0]
+        # the sequence is freed at retire; what we assert is the
+        # space is drained and nothing leaked
+        assert sched.swap_space.num_records == 0
+        assert sched.swap_space.swapped_in_records >= 1
+
+
+class TestPoolTraceContextRoundTrip:
+    """The REAL PagedKVCacheManager + HostKVSwapSpace: a serialized
+    TraceContext pinned at admission rides the swap record bitwise
+    through the host tier, is readable off the space (the future
+    decode-worker ingress), and restores at swap-in; free() drops
+    it; attach() hands it over with the chain."""
+
+    def test_round_trip(self, tel_off):
+        from paddle_tpu.incubate.nn.paged_cache import (
+            HostKVSwapSpace,
+            PagedKVCacheManager,
+        )
+
+        pool = PagedKVCacheManager(num_pages=8, page_size=2,
+                                   kv_heads=1, head_dim=4)
+        space = HostKVSwapSpace(1 << 20)
+        tok = np.ones((1, 4), np.float32)
+        pool.alloc("s")
+        for _ in range(3):
+            pool.append("s", tok, tok)
+        ctx = telemetry.TraceContext(tenant="t1")
+        pool.set_trace_context("s", ctx.to_wire())
+        assert pool.seq_trace_context("s") == ctx.to_wire()
+        pool.swap_out("s", space)
+        # the record carries it; the pool forgot it
+        assert pool.seq_trace_context("s") is None
+        assert space.trace_context("s") == ctx.to_wire()
+        back = telemetry.TraceContext.from_wire(
+            space.trace_context("s"))
+        assert back == ctx
+        pool.swap_in("s", space)
+        assert space.trace_context("s") is None
+        assert pool.seq_trace_context("s") == ctx.to_wire()
+        pool.free("s")
+        assert pool.seq_trace_context("s") is None
+
+    def test_attach_hands_over_context(self, tel_off):
+        from paddle_tpu.incubate.nn.paged_cache import (
+            PagedKVCacheManager,
+        )
+
+        pool = PagedKVCacheManager(num_pages=8, page_size=2,
+                                   kv_heads=1, head_dim=4)
+        tok = np.ones((1, 4), np.float32)
+        pool.alloc("a")
+        for _ in range(4):
+            pool.append("a", tok, tok)
+        chain = list(pool.seq_pages("a"))
+        pool.incref(chain)
+        pool.free("a")
+        ctx = telemetry.TraceContext()
+        pool.attach("b", chain, 4, trace_ctx=ctx.to_wire())
+        assert pool.seq_trace_context("b") == ctx.to_wire()
+        assert pool.set_trace_context  # public surface exists
+        with pytest.raises(KeyError):
+            pool.set_trace_context("nope", ctx.to_wire())
+
+
+class TestMergeSnapshots:
+    """Fleet aggregation: counter sums and histogram totals EXACT,
+    gauges by declared semantics, merged quantiles bounded by the
+    per-worker maxima, worker labels in the exposition."""
+
+    def _worlds(self):
+        regs = {}
+        for w in ("w0", "w1", "w2"):
+            reg = telemetry.MetricsRegistry()
+            regs[w] = reg
+        regs["w0"].inc("serving.steps", 10)
+        regs["w1"].inc("serving.steps", 12)
+        regs["w2"].inc("serving.steps", 5)
+        regs["w0"].gauge("pool.free_pages", 10.0)
+        regs["w1"].gauge("pool.free_pages", 20.0)
+        regs["w2"].gauge("pool.free_pages", 30.0)
+        regs["w0"].gauge("pool.utilization", 0.5)
+        regs["w1"].gauge("pool.utilization", 0.9)
+        regs["w2"].gauge("pool.utilization", 0.7)
+        regs["w0"].gauge("serving.goodput", 1.0)
+        regs["w1"].gauge("serving.goodput", 0.6)
+        regs["w2"].gauge("serving.goodput", 0.8)
+        for w, vals in (("w0", [0.1, 0.2]), ("w1", [0.4]),
+                        ("w2", [0.05, 0.3, 0.6])):
+            for v in vals:
+                regs[w].observe("serving.ttft_s", v)
+        return {w: r.snapshot() for w, r in regs.items()}
+
+    def test_counters_sum_exactly(self, tel_off):
+        merged = telemetry.merge_snapshots(self._worlds())
+        assert merged["serving"]["steps"] == 27
+
+    def test_histogram_totals_sum_exactly(self, tel_off):
+        snaps = self._worlds()
+        merged = telemetry.merge_snapshots(snaps)
+        h = merged["serving"]["ttft_s"]
+        assert h["count"] == 6
+        assert h["sum"] == pytest.approx(0.1 + 0.2 + 0.4 + 0.05
+                                         + 0.3 + 0.6)
+        assert h["min"] == 0.05 and h["max"] == 0.6
+        assert h["exactness"] == "bucket-upper-bound"
+        # bucket counts add across workers
+        total_bucketed = sum(n for _, n in h["buckets"])
+        assert total_bucketed == 6
+
+    def test_gauge_semantics(self, tel_off):
+        merged = telemetry.merge_snapshots(self._worlds())
+        assert merged["pool"]["free_pages"] == 60.0        # sum
+        assert merged["pool"]["utilization"] == 0.9        # max
+        assert merged["serving"]["goodput"] == 0.6         # min
+        assert telemetry.gauge_merge_kind(
+            "pool.free_pages") == "sum"
+        assert telemetry.gauge_merge_kind(
+            "serving.slo_attain_ttft") == "min"
+        assert telemetry.gauge_merge_kind(
+            "serving.uptime_s") == "max"
+
+    def test_merged_p99_bounded_by_worker_maxima(self, tel_off):
+        """Property (ISSUE 15 satellite): over random worker
+        histograms, the merged p99 estimate never exceeds the max of
+        the per-worker maxima."""
+        rng = random.Random(7)
+        for trial in range(25):
+            snaps = {}
+            maxima = []
+            for w in range(3):
+                reg = telemetry.MetricsRegistry()
+                vals = [rng.uniform(1e-4, 10.0) ** 2
+                        for _ in range(rng.randint(1, 40))]
+                for v in vals:
+                    reg.observe("serving.tpot_s", v)
+                maxima.append(max(vals))
+                snaps[f"w{w}"] = reg.snapshot()
+            merged = telemetry.merge_snapshots(snaps)
+            h = merged["serving"]["tpot_s"]
+            for q in ("p50", "p90", "p99"):
+                assert h[q] is not None
+                assert h[q] <= max(maxima) + 1e-12, (
+                    trial, q, h[q], maxima)
+
+    def test_exposition_worker_labels_and_exact_sums(self, tel_off):
+        import re
+
+        snaps = self._worlds()
+        text = telemetry.merged_prometheus_text(snaps)
+        # aggregate == sum of the labelled per-worker series, parsed
+        # back OUT of the exposition
+        agg = int(re.search(
+            r"^paddle_serving_steps (\d+)$", text, re.M).group(1))
+        per = [int(v) for v in re.findall(
+            r'^paddle_serving_steps\{worker="w\d"\} (\d+)$',
+            text, re.M)]
+        assert len(per) == 3 and agg == sum(per) == 27
+        # histogram totals: the same exactness, from the text
+        hagg = int(re.search(
+            r"^paddle_serving_ttft_s_count (\d+)$", text,
+            re.M).group(1))
+        hper = [int(v) for v in re.findall(
+            r'^paddle_serving_ttft_s_count\{worker="w\d"\} (\d+)$',
+            text, re.M)]
+        assert len(hper) == 3 and hagg == sum(hper) == 6
+        sums = [float(v) for v in re.findall(
+            r'^paddle_serving_ttft_s_sum\{worker="w\d"\} (\S+)$',
+            text, re.M)]
+        total = float(re.search(
+            r"^paddle_serving_ttft_s_sum (\S+)$", text,
+            re.M).group(1))
+        assert total == pytest.approx(sum(sums))
+        # merged quantiles are labelled as estimates
+        assert 'exactness="bucket-upper-bound"' in text
+
+    def test_list_input_auto_names(self, tel_off):
+        reg = telemetry.MetricsRegistry()
+        reg.inc("serving.steps", 1)
+        text = telemetry.merged_prometheus_text(
+            [reg.snapshot(), reg.snapshot()])
+        assert 'worker="w0"' in text and 'worker="w1"' in text
+
+
+class TestAggregateCLI:
+    def _snap_files(self, tmp_path):
+        reg = telemetry.MetricsRegistry()
+        reg.inc("serving.steps", 4)
+        reg.observe("serving.ttft_s", 0.2)
+        raw = tmp_path / "worker_a.json"
+        raw.write_text(json.dumps(reg.snapshot()))
+        # the TELEMETRY_LAST.json bench-artifact shape
+        art = tmp_path / "worker_b.json"
+        art.write_text(json.dumps(
+            {"config": "serving_telemetry",
+             "snapshot": reg.snapshot(), "slo_window": {}}))
+        # a JSONL dump with a metrics record
+        tr = telemetry.Tracer(ring=8)
+        with tr.span("serving.step"):
+            pass
+        dump = tmp_path / "worker_c.jsonl"
+        tr.dump_jsonl(str(dump), reg)
+        return [str(raw), str(art), str(dump)]
+
+    def test_aggregate_round_trip(self, tmp_path, capsys, tel_off):
+        files = self._snap_files(tmp_path)
+        assert telemetry.main(["aggregate"] + files) == 0
+        out = capsys.readouterr().out
+        assert "paddle_serving_steps 12" in out  # 3 x 4, exact
+        assert 'paddle_serving_steps{worker="worker_a"} 4' in out
+        assert 'worker="worker_c"' in out
+
+    def test_aggregate_to_file_and_json(self, tmp_path, capsys,
+                                        tel_off):
+        files = self._snap_files(tmp_path)
+        out_prom = tmp_path / "fleet.prom"
+        out_json = tmp_path / "fleet.json"
+        assert telemetry.main(
+            ["aggregate"] + files
+            + ["-o", str(out_prom), "--merged-json",
+               str(out_json)]) == 0
+        text = out_prom.read_text()
+        assert "paddle_serving_steps 12" in text
+        merged = json.loads(out_json.read_text())
+        assert merged["serving"]["steps"] == 12
+
+    def test_aggregate_explicit_worker_names(self, tmp_path, capsys,
+                                             tel_off):
+        files = self._snap_files(tmp_path)
+        assert telemetry.main(
+            ["aggregate", "--worker", "east=" + files[0],
+             "--worker", "west=" + files[1]]) == 0
+        out = capsys.readouterr().out
+        assert 'worker="east"' in out and 'worker="west"' in out
+
+
+class TestExemplars:
+    def test_observe_with_exemplar_renders_openmetrics(self,
+                                                       tel_off):
+        reg = telemetry.MetricsRegistry()
+        reg.observe("serving.ttft_s", 0.25, exemplar="pid-7")
+        reg.observe("serving.ttft_s", 0.26)  # no exemplar: kept
+        text = telemetry.prometheus_text(registry=reg)
+        assert '# {trace_id="pid-7"} 0.25' in text
+        summ = reg.histogram("serving.ttft_s").summary()
+        assert summ["exemplars"] == [[0.25, "pid-7", 0.25]]
+
+    def test_no_exemplar_means_no_key(self, tel_off):
+        reg = telemetry.MetricsRegistry()
+        reg.observe("serving.ttft_s", 0.25)
+        assert "exemplars" not in reg.histogram(
+            "serving.ttft_s").summary()
+
+    def test_merged_exposition_keeps_exemplars(self, tel_off):
+        """Review regression: the fleet exposition must render the
+        exemplars merge_snapshots carries, not just collect them."""
+        reg = telemetry.MetricsRegistry()
+        reg.observe("serving.ttft_s", 0.25, exemplar="tr-9")
+        text = telemetry.merged_prometheus_text(
+            {"w0": reg.snapshot(), "w1": reg.snapshot()})
+        assert '# {trace_id="tr-9"} 0.25' in text
+
+    def test_scheduler_links_ttft_to_trace_id(self, tel_metrics):
+        sched = BatchScheduler(_FakeModel(), max_batch_size=2)
+        req = Request("rx", [3, 4], max_new_tokens=2)
+        sched.submit(req)
+        sched.run_until_complete()
+        assert req.trace_ctx is not None
+        text = telemetry.prometheus_text(registry=tel_metrics)
+        assert ('trace_id="%s"' % req.trace_ctx.trace_id) in text
+
+
+class TestQuantizedWireExport:
+    """ISSUE 15 satellite: PR-14's quantized-wire counters and the
+    perf-ledger quantized-bytes plan field reach the Prometheus
+    exposition (and therefore /metrics and the aggregation CLI)."""
+
+    def test_collective_counters_render(self, tel_metrics):
+        reg = tel_metrics
+        reg.inc("collective.quantized.ag_mm", 3)
+        reg.inc("collective.wire_bytes_quantized", 1024)
+        reg.inc("collective.wire_bytes_saved", 2048)
+        text = telemetry.prometheus_text(registry=reg)
+        assert "paddle_collective_quantized_ag_mm 3" in text
+        assert "paddle_collective_wire_bytes_quantized 1024" in text
+        assert "paddle_collective_wire_bytes_saved 2048" in text
+        # and they survive fleet aggregation with exact sums
+        merged = telemetry.merged_prometheus_text(
+            {"a": reg.snapshot(), "b": reg.snapshot()})
+        assert "paddle_collective_wire_bytes_saved 4096" in merged
+
+    def test_ledger_quantized_bytes_field(self, tel_metrics):
+        from paddle_tpu.framework import perf_ledger
+
+        led = perf_ledger.PerfLedger(tel_metrics)
+        led.register_plan("ring_prog", {
+            "flops_total": 1e9, "hbm_peak_bytes": 1e6,
+            "input_bytes": 1e5, "donated_bytes": 0,
+            "const_bytes": 0, "output_bytes": 1e5,
+            "comm_bytes_total": 8e4, "comm_bytes_quantized": 2e4,
+        })
+        led.record("ring_prog", 0.25)
+        row = led.report()["ring_prog"]
+        assert row["wire_bytes_quantized_per_s"] == pytest.approx(
+            2e4 / 0.25)
+        led.publish()
+        assert tel_metrics.gauge_value(
+            "ledger.wire_bytes_quantized_per_s.ring_prog") \
+            == pytest.approx(2e4 / 0.25)
+        text = telemetry.prometheus_text(registry=tel_metrics)
+        assert ("paddle_ledger_wire_bytes_quantized_per_s_ring_prog"
+                in text)
+
+    def test_unquantized_plan_has_no_column(self, tel_metrics):
+        from paddle_tpu.framework import perf_ledger
+
+        led = perf_ledger.PerfLedger(tel_metrics)
+        led.register_plan("fp_prog", {
+            "flops_total": 1e9, "hbm_peak_bytes": 1e6,
+            "input_bytes": 1e5, "donated_bytes": 0,
+            "const_bytes": 0, "output_bytes": 1e5,
+            "comm_bytes_total": 8e4, "comm_bytes_quantized": 0,
+        })
+        led.record("fp_prog", 0.25)
+        assert "wire_bytes_quantized_per_s" not in \
+            led.report()["fp_prog"]

@@ -139,6 +139,7 @@ HOST_ONLY_FILES = (
     os.path.join("paddle_tpu", "framework", "watchdog.py"),
     os.path.join("paddle_tpu", "framework", "perf_ledger.py"),
     os.path.join("paddle_tpu", "framework", "flight_recorder.py"),
+    os.path.join("paddle_tpu", "framework", "ops_server.py"),
     os.path.join("paddle_tpu", "incubate", "nn", "fault_injection.py"),
 )
 
@@ -376,6 +377,10 @@ def check_clock_discipline(root=REPO):
 WATCHDOG_FILES = (
     os.path.join("paddle_tpu", "framework", "watchdog.py"),
     os.path.join("paddle_tpu", "framework", "flight_recorder.py"),
+    # the live-ops debug server is a READ-ONLY surface by the same
+    # contract: it renders registry/ledger/bundle state, never
+    # mutates it
+    os.path.join("paddle_tpu", "framework", "ops_server.py"),
 )
 
 # registry mutators (MetricsRegistry write surface) banned in
@@ -1404,6 +1409,240 @@ def check_wire_quant(root=REPO):
 # (as FLAGS_<name>) somewhere under docs/ — an undocumented knob is a
 # knob nobody can discover, and the docs/FLAGS.md reference exists
 # precisely so this check is satisfiable for every flag
+# metric-name discipline (ISSUE 15): every metric name emitted into
+# the telemetry registry anywhere in the package must (a) be built
+# from Prometheus-safe literal parts — lowercase [a-z0-9_.] only, so
+# the name survives telemetry._prom_name unchanged modulo the dot
+# separator (the round-trip contract of the /metrics endpoint and
+# the fleet aggregation CLI), (b) never be an ad-hoc f-string, and
+# (c) resolve to a row of the CENTRAL inventory telemetry.SURFACE —
+# dynamic segments ("prefix." + var, "%s" templates) match the
+# inventory's <placeholder> rows. The SURFACE tuple is parsed from
+# telemetry.py's AST, so the check needs no package import. A
+# deliberately dynamic emit (pre-resolved keys on a hot path) can
+# waive a line (or its preceding comment) with '# metric-name: ok'.
+TELEMETRY_SURFACE_FILE = os.path.join(
+    "paddle_tpu", "framework", "telemetry.py")
+_METRIC_EMIT_METHODS = frozenset({"inc", "observe", "gauge"})
+# receiver names that ARE (by repo convention) a MetricsRegistry
+# handle — obj.inc/observe/gauge on anything else is not a metric
+_METRIC_RECEIVERS = frozenset({
+    "m", "reg", "registry", "_reg", "_metrics", "_registry",
+})
+_METRIC_WAIVER = "# metric-name: ok"
+_METRIC_NAME_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyz0123456789._")
+
+
+def surface_metric_names(root=REPO, text=None):
+    """The metric names of telemetry.SURFACE, parsed from the module
+    SOURCE (ast.literal_eval of the tuple literal — no package
+    import), span rows excluded."""
+    if text is None:
+        with open(os.path.join(root, TELEMETRY_SURFACE_FILE),
+                  encoding="utf-8") as f:
+            text = f.read()
+    tree = ast.parse(text)
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if any(isinstance(t, ast.Name) and t.id == "SURFACE"
+               for t in targets):
+            rows = ast.literal_eval(node.value)
+            return tuple(name for name, _kind, _desc in rows
+                         if not str(name).startswith("span:"))
+    raise RuntimeError(
+        "telemetry.SURFACE literal not found in %s"
+        % TELEMETRY_SURFACE_FILE)
+
+
+def _metric_name_parts(node, consts):
+    """Decompose a metric-name EXPRESSION into literal/dynamic parts
+    (None = dynamic). Handles literals, module-constant Names,
+    '+'-concatenation, and '%'-templates; returns (parts,
+    is_fstring)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value], False
+    if isinstance(node, ast.Name):
+        lit = consts.get(node.id)
+        return ([lit] if lit is not None else [None]), False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        lparts, lf = _metric_name_parts(node.left, consts)
+        rparts, rf = _metric_name_parts(node.right, consts)
+        return lparts + rparts, lf or rf
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        if isinstance(node.left, ast.Constant) \
+                and isinstance(node.left.value, str):
+            import re
+
+            frags = re.split(
+                r"%[#0\- +]?[0-9]*(?:\.[0-9]+)?[sdifeEgGxXr]",
+                node.left.value)
+            parts = []
+            for i, frag in enumerate(frags):
+                if i:
+                    parts.append(None)
+                if frag:
+                    parts.append(frag)
+            return (parts or [None]), False
+        return [None], False
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) \
+                    and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append(None)
+        return (parts or [None]), True
+    return [None], False
+
+
+def _metric_matches_surface(parts, surface_names):
+    """True when the emitted name pattern resolves to an inventory
+    row. Both sides may carry wildcards (the emit's dynamic parts,
+    the inventory's ``<placeholder>`` segments), so the match is
+    two-way: the emit pattern against a concretized inventory row
+    ('ledger.%s.%s' -> 'ledger.mfu.x'), AND the inventory pattern
+    against a concretized emit ('exec.wall_s.<program>' matches the
+    literal 'exec.wall_s.decode_token')."""
+    import re
+
+    emit_rx = re.compile("".join(
+        ".+" if p is None else re.escape(p) for p in parts) + "$")
+    emit_probe = "".join("x" if p is None else p for p in parts)
+    for name in surface_names:
+        if emit_rx.match(re.sub(r"<[^>]+>", "x", name)):
+            return True
+        surf_rx = re.compile(
+            re.sub(r"<[^>]+>", ".+",
+                   re.escape(name).replace(r"\<", "<")
+                   .replace(r"\>", ">")) + "$")
+        if surf_rx.match(emit_probe):
+            return True
+    return False
+
+
+class _MetricNameVisitor(ast.NodeVisitor):
+    """Flags registry emits (`<registry>.inc/observe/gauge(name,...)`)
+    whose name is an f-string, fully dynamic, Prometheus-unsafe, or
+    unregistered in telemetry.SURFACE."""
+
+    def __init__(self, relpath, source_lines, surface_names):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.surface = surface_names
+        self.violations = []
+        self.consts = {}
+
+    def visit_Module(self, node):
+        # module-level string constants (EXEC_WALL_PREFIX-style name
+        # prefixes) resolve into literal parts
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) \
+                    and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, str):
+                self.consts[stmt.targets[0].id] = stmt.value.value
+        self.generic_visit(node)
+
+    def _waived(self, node) -> bool:
+        lo = max(node.lineno - 2, 0)  # the line above counts too
+        hi = min(getattr(node, "end_lineno", node.lineno),
+                 len(self.lines))
+        return any(_METRIC_WAIVER in ln
+                   for ln in self.lines[lo:hi])
+
+    def _flag(self, node, what):
+        self.violations.append(
+            "%s:%d: %s — metric names are registered surface: use a "
+            "lowercase [a-z0-9_.] literal (head) registered in "
+            "telemetry.SURFACE (+ '+ suffix' / '%%s' templates for "
+            "dynamic segments), or waive a deliberately pre-resolved "
+            "emit with '%s (<reason>)'"
+            % (self.relpath, node.lineno, what, _METRIC_WAIVER))
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) \
+                and fn.attr in _METRIC_EMIT_METHODS and node.args:
+            recv = fn.value
+            rname = recv.id if isinstance(recv, ast.Name) else (
+                recv.attr if isinstance(recv, ast.Attribute)
+                else None)
+            if rname in _METRIC_RECEIVERS:
+                self._check_name(node)
+        self.generic_visit(node)
+
+    def _check_name(self, node):
+        if self._waived(node):
+            return
+        parts, is_fstring = _metric_name_parts(node.args[0],
+                                               self.consts)
+        lits = [p for p in parts if p is not None]
+        if is_fstring:
+            self._flag(node, "ad-hoc f-string metric name")
+            return
+        if not lits:
+            self._flag(node, "fully dynamic metric name (nothing to "
+                       "register or round-trip)")
+            return
+        for lit in lits:
+            bad = set(lit) - _METRIC_NAME_CHARS
+            if bad:
+                self._flag(node, "metric name part %r fails the "
+                           "_prom_name round trip (bad chars %s)"
+                           % (lit, "".join(sorted(bad))))
+                return
+        if parts[0] is None:
+            self._flag(node, "metric name has a dynamic namespace "
+                       "head (the '<ns>.' prefix must be literal)")
+            return
+        if parts[0][:1].isdigit():
+            self._flag(node, "metric name starts with a digit")
+            return
+        if not _metric_matches_surface(parts, self.surface):
+            shown = "".join("<?>" if p is None else p for p in parts)
+            self._flag(node, "metric name %r is not registered in "
+                       "telemetry.SURFACE" % shown)
+
+
+def lint_metric_names_file(path, text=None, surface_names=None):
+    """Metric-name audit for one file; returns violations."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    if surface_names is None:
+        surface_names = surface_metric_names()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _MetricNameVisitor(rel, text.splitlines(), surface_names)
+    v.visit(tree)
+    return v.violations
+
+
+def check_metric_names(root=REPO):
+    surface = surface_metric_names(root)
+    out = []
+    base = os.path.join(root, "paddle_tpu")
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.extend(lint_metric_names_file(
+                    os.path.join(dirpath, fn),
+                    surface_names=surface))
+    return out
+
+
 FLAGS_FILE = os.path.join("paddle_tpu", "framework", "flags.py")
 FLAG_DOCS_DIR = "docs"
 
@@ -1566,10 +1805,11 @@ RULES = (
      "telemetry.py, framework/watchdog.py, framework/perf_ledger.py, "
      "framework/flight_recorder.py) must not touch jax/jnp at all"),
     ("watchdog-read-only",
-     "watchdog/detector AND incident-recorder code (framework/"
-     "watchdog.py, framework/flight_recorder.py) may only READ the "
-     "telemetry registry — no registry mutators (inc/gauge/observe/"
-     "set_epoch), no pool-private calls, no pool state writes"),
+     "watchdog/detector, incident-recorder AND live-ops-server code "
+     "(framework/watchdog.py, framework/flight_recorder.py, "
+     "framework/ops_server.py) may only READ the telemetry registry "
+     "— no registry mutators (inc/gauge/observe/set_epoch), no "
+     "pool-private calls, no pool state writes"),
     ("bundle-atomicity",
      "incident-bundle writers (framework/flight_recorder.py) may not "
      "open files in write/append mode directly — every member goes "
@@ -1620,6 +1860,13 @@ RULES = (
     ("tp-collective-routing",
      "no hand-rolled raw collective + matmul pair in the TP/SP layer "
      "modules — route through collective_matmul_dispatch"),
+    ("metric-name-discipline",
+     "every metric name emitted into the telemetry registry "
+     "(<registry>.inc/observe/gauge) must be a Prometheus-safe "
+     "lowercase literal (surviving telemetry._prom_name unchanged "
+     "modulo dots) registered in the central telemetry.SURFACE "
+     "inventory — no ad-hoc f-string metric names; dynamic "
+     "segments match the inventory's <placeholder> rows"),
     ("wire-quant-ownership",
      "no raw int8/fp8 dtype cast next to a raw collective in the "
      "TP/SP layer modules, the DP grad-sync helper, or the MoE layer "
@@ -1641,6 +1888,7 @@ def run_lint(root=REPO, with_op_table=True):
     out.extend(check_unified_attention(root))
     out.extend(check_serving_terminal_trace(root))
     out.extend(check_flag_inventory(root))
+    out.extend(check_metric_names(root))
     out.extend(check_jax_only(root))
     out.extend(check_tp_routing(root))
     out.extend(check_wire_quant(root))
