@@ -954,10 +954,12 @@ def static_check_inventory() -> dict:
     detectors the scheduler runs at the watchdog stride), the
     serving fault-injection classes (incubate/nn/fault_injection.py —
     the deterministic step-boundary perturbations the overload
-    harness must absorb), and the AST rules of
-    tools/lint_codebase.py. Emitted in the CLI's --json payload
-    under ``static_checks`` and printable standalone with
-    ``--rules``."""
+    harness must absorb), the host-plane concurrency sanitizer's
+    race/deadlock classes (framework/concurrency.py — the lockset +
+    happens-before detector whose static twin is the concurrency-*
+    lint rules), and the AST rules of tools/lint_codebase.py.
+    Emitted in the CLI's --json payload under ``static_checks`` and
+    printable standalone with ``--rules``."""
     inv = {"jaxpr": [dataclasses.asdict(r) for r in RULES.values()
                      if r.rule_id not in PLANNER_RULE_IDS],
            # the resource-planner rules (framework/planner.py) are
@@ -997,6 +999,17 @@ def static_check_inventory() -> dict:
             for rid, s in VIOLATIONS.items()]
     except Exception:  # pragma: no cover - circulars in odd installs
         inv["page_sanitizer"] = []
+    try:
+        from .concurrency import VIOLATIONS as _CONC_VIOLATIONS
+
+        # the host-plane race sanitizer's dynamic classes; the
+        # matching concurrency-* AST rules ride the codebase_lint
+        # group below — docs/ANALYSIS.md "Concurrency" covers both
+        inv["concurrency"] = [
+            {"rule_id": rid, "severity": "critical", "summary": s}
+            for rid, s in _CONC_VIOLATIONS.items()]
+    except Exception:  # pragma: no cover - circulars in odd installs
+        inv["concurrency"] = []
     inv["codebase_lint"] = []
     try:
         import importlib.util
@@ -1069,7 +1082,8 @@ def main(argv=None) -> int:
     ap.add_argument("--rules", action="store_true",
                     help="print the full static-check inventory "
                     "(jaxpr lint rules + planner rules + page-"
-                    "sanitizer violation classes + codebase AST lint "
+                    "sanitizer violation classes + concurrency-"
+                    "sanitizer race classes + codebase AST lint "
                     "rules) and exit; honors --json")
     ap.add_argument("--plan", action="store_true",
                     help="also run the static resource planner "

@@ -245,6 +245,30 @@ define_flag("page_sanitizer_stride", 16,
             "sequence lens, num_free_pages capacity accounting) and, "
             "in strict mode, assert_ref_invariants() runs on every "
             "cache")
+define_flag("concurrency_sanitizer", "off",
+            "host-plane concurrency sanitizer (framework/"
+            "concurrency.py): 'off' (default) is zero-cost — no "
+            "shadow objects are allocated, guarded() hands back a "
+            "plain threading.Lock and every instrumented site pays "
+            "one attribute check (same tracemalloc-gated discipline "
+            "as FLAGS_page_sanitizer=off); 'warn' runs the lockset + "
+            "vector-clock happens-before race detector over the "
+            "instrumented serving/telemetry modules (unguarded "
+            "shared writes, lockset-empty read-write races, "
+            "lock-order inversions, blocking acquires on a running "
+            "event loop, unsanctioned writer threads) and reports "
+            "violations as RuntimeWarning; 'strict' raises "
+            "ConcurrencyError carrying the journal tail. The mode is "
+            "read when the instrumented object is CONSTRUCTED "
+            "(docs/ANALYSIS.md)")
+define_flag("concurrency_journal", 512,
+            "bounded event-journal chunk size for the concurrency "
+            "sanitizer: the journal keeps a state snapshot plus up "
+            "to this many typed events (acquire/release/read/write/"
+            "spawn), re-snapshotting on overflow, so a dumped "
+            "journal always replays (python -m "
+            "paddle_tpu.framework.concurrency --replay <file>) from "
+            "a sound state regardless of how long the process ran")
 define_flag("telemetry", "off",
             "runtime telemetry (framework/telemetry.py): 'off' "
             "(default) allocates NOTHING — no registry, no tracer, "

@@ -27,6 +27,9 @@ manifest entry          file                  contents
 ``flags``               flags.json            FLAGS registry snapshot
 ``sanitizer_journal``   sanitizer_journal     page-sanitizer journal
                         .jsonl                tail (when handed in)
+``concurrency_journal`` concurrency_journal   concurrency-sanitizer
+                        .jsonl                race-journal tail (when
+                                              handed in)
 ======================  ====================  =========================
 
 Atomicity: every member is written through telemetry's atomic-write
@@ -60,6 +63,7 @@ import os
 import shutil
 from typing import Dict, List, Optional
 
+from . import concurrency as _concurrency
 from . import telemetry as _telemetry
 from .flags import flag
 
@@ -111,6 +115,15 @@ class FlightRecorder:
         self.ledger = ledger
         self._seq = 0
         self.bundles_written = 0
+        # concurrency-sanitizer handle: bundle staging is single-
+        # writer by contract (the scheduler's step loop is the only
+        # caller of record()/dump_incident()); a watchdog firing from
+        # a second thread becomes a journaled violation instead of a
+        # torn bundle
+        _csan = _concurrency.sanitizer()
+        self._cv = None if _csan is None else _csan.shared(
+            "flight_recorder.bundles", owner=self,
+            single_writer=True)
 
     # -- public entry points ------------------------------------------------
     def record(self, events: List[dict],
@@ -132,6 +145,8 @@ class FlightRecorder:
 
     # -- bundle assembly ----------------------------------------------------
     def _write_bundle(self, reason, classes, events, context) -> str:
+        if self._cv is not None:
+            self._cv.write()
         os.makedirs(self.out_dir, exist_ok=True)
         self._seq = next(_BUNDLE_SEQ)  # process-unique, not per-
         # instance: sibling recorders must never collide on a name
@@ -182,6 +197,10 @@ class FlightRecorder:
         if tail:
             put_jsonl("sanitizer_journal", "sanitizer_journal.jsonl",
                       list(tail))
+        ctail = (context or {}).get("concurrency_journal_tail")
+        if ctail:
+            put_jsonl("concurrency_journal",
+                      "concurrency_journal.jsonl", list(ctail))
         epoch = getattr(self.registry, "epoch", 0) \
             if self.registry is not None else 0
         manifest = {
@@ -347,6 +366,14 @@ def summarize_incident(bundle_dir: str) -> str:
             os.path.join(bundle_dir, san_name)).splitlines() if ln)
         lines.append("")
         lines.append("sanitizer journal tail: %d event(s)" % n)
+
+    conc_name = entries.get("concurrency_journal")
+    if conc_name and os.path.isfile(
+            os.path.join(bundle_dir, conc_name)):
+        n = sum(1 for ln in _read_text(
+            os.path.join(bundle_dir, conc_name)).splitlines() if ln)
+        lines.append("")
+        lines.append("concurrency race-journal tail: %d event(s)" % n)
 
     if missing:
         lines.append("")

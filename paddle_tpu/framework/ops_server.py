@@ -61,6 +61,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from . import concurrency as _concurrency
 from . import telemetry
 from .flags import flag
 
@@ -98,7 +99,11 @@ class OpsServer:
         self._traces = traces
         self._ledger = ledger
         self._providers: Dict[str, Callable[[], Optional[dict]]] = {}
-        self._plock = threading.Lock()
+        self._plock = _concurrency.guarded("ops_server.providers")
+        _csan = _concurrency.sanitizer()
+        self._cv = None if _csan is None else _csan.shared(
+            "ops_server.providers", owner=self,
+            guard="ops_server.providers")
         self._t_start = telemetry.clock()
         port = int(flag("ops_server_port") if port is None else port)
         ops = self
@@ -114,10 +119,11 @@ class OpsServer:
         self._httpd = ThreadingHTTPServer((host, max(port, 0)),
                                           _Handler)
         self._httpd.daemon_threads = True
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name="paddle-ops-server", daemon=True)
-        self._thread.start()
+        # the sanctioned thread helper: named, daemon, and (when the
+        # concurrency sanitizer is live) registered with a
+        # parent->child happens-before edge
+        self._thread = _concurrency.spawn_thread(
+            "paddle-ops-server", self._httpd.serve_forever)
 
     # -- lifecycle ----------------------------------------------------------
     @property
@@ -150,11 +156,15 @@ class OpsServer:
         except TypeError:
             wrapped = fn
         with self._plock:
+            if self._cv is not None:
+                self._cv.write()
             self._providers[str(key)] = wrapped
 
     def _status_sections(self) -> Dict[str, dict]:
         out = {}
         with self._plock:
+            if self._cv is not None:
+                self._cv.read()
             items = list(self._providers.items())
         dead = []
         for key, fn in items:
@@ -168,6 +178,8 @@ class OpsServer:
             out[key] = info
         if dead:
             with self._plock:
+                if self._cv is not None:
+                    self._cv.write()
                 for key in dead:
                     self._providers.pop(key, None)
         return out
@@ -194,6 +206,10 @@ class OpsServer:
 
     # -- request routing ----------------------------------------------------
     def _handle(self, h: BaseHTTPRequestHandler) -> None:
+        if self._cv is not None:
+            # ThreadingHTTPServer spawns a stdlib thread per request
+            # that spawn_thread cannot wrap — sanction it here
+            _concurrency.sanitizer().adopt("ops-server-handler")
         parsed = urlparse(h.path)
         q = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
         route = {
@@ -411,7 +427,7 @@ class OpsServer:
 # process-wide singleton (the registry()/tracer() discipline)
 # ---------------------------------------------------------------------------
 
-_SERVER: Optional[OpsServer] = None
+_SERVER: Optional[OpsServer] = None  # guarded-by: ops_server.state
 _LOCK = threading.Lock()
 
 

@@ -118,6 +118,7 @@ from typing import Dict, List, Optional, Tuple
 import time as _time
 
 from .flags import flag
+from . import concurrency as _concurrency
 
 __all__ = [
     "MetricsRegistry", "Histogram", "Tracer", "Span",
@@ -332,7 +333,7 @@ class MetricsRegistry:
     held outside the registry is NOT thread-safe on its own."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _concurrency.guarded("telemetry.registry")
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Histogram] = {}
@@ -340,14 +341,24 @@ class MetricsRegistry:
         # histogram sample so windowed views (SLO attainment,
         # watchdog rates) are keyed by step count, not wall clock
         self.epoch = 0
+        # concurrency-sanitizer shadow handle (None when off): every
+        # metric table access below reports through it
+        _csan = _concurrency.sanitizer()
+        self._cv = None if _csan is None else _csan.shared(
+            "telemetry.registry.metrics", owner=self,
+            guard="telemetry.registry")
 
     # -- writes ------------------------------------------------------------
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
+            if self._cv is not None:
+                self._cv.write()
             self._counters[name] = self._counters.get(name, 0) + int(n)
 
     def gauge(self, name: str, value) -> None:
         with self._lock:
+            if self._cv is not None:
+                self._cv.write()
             self._gauges[name] = float(value)
 
     def observe(self, name: str, value, exemplar=None) -> None:
@@ -356,6 +367,8 @@ class MetricsRegistry:
         bucket — the link between a latency bucket and the request
         trace that landed in it."""
         with self._lock:
+            if self._cv is not None:
+                self._cv.write()
             h = self._hists.get(name)
             if h is None:
                 h = self._hists.setdefault(name, Histogram())
@@ -369,6 +382,8 @@ class MetricsRegistry:
         the process-wide registry advance ONE monotonic stamp
         instead of rewinding each other's windowed views."""
         with self._lock:
+            if self._cv is not None:
+                self._cv.write()
             self.epoch += 1
             return self.epoch
 
@@ -379,17 +394,33 @@ class MetricsRegistry:
         a stale setter (an older scheduler, a replayed fixture) must
         not invalidate samples already stamped ahead of it."""
         with self._lock:
+            if self._cv is not None:
+                self._cv.write()
             self.epoch = max(self.epoch, int(epoch))
 
     # -- reads -------------------------------------------------------------
+    # counter/gauge_value/histogram used to read the metric tables
+    # WITHOUT the lock — the same scrape-vs-mutate class PR 8 fixed
+    # in hist_windowed (a /statusz provider reading a counter while
+    # the serving thread rehashes the dict under it). All reads now
+    # take the registry lock; the concurrency sanitizer audits them.
     def counter(self, name: str) -> int:
-        return self._counters.get(name, 0)
+        with self._lock:
+            if self._cv is not None:
+                self._cv.read()
+            return self._counters.get(name, 0)
 
     def gauge_value(self, name: str) -> Optional[float]:
-        return self._gauges.get(name)
+        with self._lock:
+            if self._cv is not None:
+                self._cv.read()
+            return self._gauges.get(name)
 
     def histogram(self, name: str) -> Optional[Histogram]:
-        return self._hists.get(name)
+        with self._lock:
+            if self._cv is not None:
+                self._cv.read()
+            return self._hists.get(name)
 
     def hist_windowed(self, name: str,
                       min_epoch: int) -> Optional[dict]:
@@ -399,6 +430,8 @@ class MetricsRegistry:
         observes into it would hit "deque mutated during
         iteration")."""
         with self._lock:
+            if self._cv is not None:
+                self._cv.read()
             h = self._hists.get(name)
             return None if h is None else h.windowed(min_epoch)
 
@@ -409,6 +442,8 @@ class MetricsRegistry:
         under the registry lock — the sanctioned read for watchdog
         detectors (no mutation surface)."""
         with self._lock:
+            if self._cv is not None:
+                self._cv.read()
             h = self._hists.get(name)
             if h is None:
                 return []
@@ -427,6 +462,8 @@ class MetricsRegistry:
             out.setdefault(ns, {})[key or ns] = value
 
         with self._lock:
+            if self._cv is not None:
+                self._cv.read()
             for name, v in sorted(self._counters.items()):
                 put(name, v)
             for name, v in sorted(self._gauges.items()):
@@ -570,28 +607,42 @@ class RequestTraceBook:
         cap = int(flag("telemetry_request_traces")) \
             if capacity is None else int(capacity)
         self.capacity = max(1, cap)
-        self._lock = threading.Lock()
+        self._lock = _concurrency.guarded("telemetry.tracebook")
         self._active: Dict[str, RequestTrace] = {}
         self._done = collections.OrderedDict()
         self._lane_seq = 0
         self.dropped = 0  # completed traces evicted by the LRU
+        _csan = _concurrency.sanitizer()
+        self._cv = None if _csan is None else _csan.shared(
+            "telemetry.tracebook.traces", owner=self,
+            guard="telemetry.tracebook")
 
     def begin(self, req_id: str, t: float, epoch: int,
               **payload) -> RequestTrace:
+        # the submit event is appended UNDER the lock: begin() used
+        # to drop the lock first, racing a scrape thread iterating
+        # the trace's event list via traces()/to_jsonl_records()
         with self._lock:
+            if self._cv is not None:
+                self._cv.write()
             tr = self._active.get(req_id)
             if tr is None:
                 self._lane_seq += 1
                 tr = RequestTrace(req_id, self._lane_seq)
                 self._active[req_id] = tr
-        tr.event("submit", t, epoch, **payload)
+            tr.event("submit", t, epoch, **payload)
         return tr
 
     def event(self, req_id: str, kind: str, t: float, epoch: int,
               **payload) -> None:
-        tr = self._active.get(req_id)
-        if tr is not None:
-            tr.event(kind, t, epoch, **payload)
+        # mutates the trace's event list: same lock as the readers
+        # (was an unlocked dict read + list append)
+        with self._lock:
+            if self._cv is not None:
+                self._cv.write()
+            tr = self._active.get(req_id)
+            if tr is not None:
+                tr.event(kind, t, epoch, **payload)
 
     def complete(self, req_id: str, kind: str, t: float, epoch: int,
                  **payload) -> None:
@@ -599,6 +650,8 @@ class RequestTraceBook:
         deadline expiry — preemption's ``evict`` is NOT terminal and
         goes through :meth:`event`) and move the trace to the LRU."""
         with self._lock:
+            if self._cv is not None:
+                self._cv.write()
             tr = self._active.pop(req_id, None)
             if tr is None:
                 return
@@ -611,10 +664,15 @@ class RequestTraceBook:
 
     # -- readout -----------------------------------------------------------
     def get(self, req_id: str) -> Optional[RequestTrace]:
-        return self._active.get(req_id) or self._done.get(req_id)
+        with self._lock:
+            if self._cv is not None:
+                self._cv.read()
+            return self._active.get(req_id) or self._done.get(req_id)
 
     def traces(self) -> List[RequestTrace]:
         with self._lock:
+            if self._cv is not None:
+                self._cv.read()
             return list(self._active.values()) + list(
                 self._done.values())
 
@@ -628,6 +686,8 @@ class RequestTraceBook:
 
     def clear(self) -> None:
         with self._lock:
+            if self._cv is not None:
+                self._cv.write()
             self._active.clear()
             self._done.clear()
             self.dropped = 0
@@ -1049,8 +1109,12 @@ class Tracer:
         # serializes commits against ring reads: exporting from one
         # thread while another finishes a span must not hit "deque
         # mutated during iteration"
-        self._lock = threading.Lock()
+        self._lock = _concurrency.guarded("telemetry.tracer")
         self.dropped = 0  # spans evicted by ring rollover
+        _csan = _concurrency.sanitizer()
+        self._cv = None if _csan is None else _csan.shared(
+            "telemetry.tracer.ring", owner=self,
+            guard="telemetry.tracer")
 
     def open_depth(self) -> int:
         """Open-span nesting depth of the CALLING context (test and
@@ -1059,6 +1123,8 @@ class Tracer:
 
     def _commit(self, span: Span) -> None:
         with self._lock:
+            if self._cv is not None:
+                self._cv.write()
             if len(self._ring) == self._ring.maxlen:
                 self.dropped += 1
             self._ring.append(span)
@@ -1084,10 +1150,14 @@ class Tracer:
     # -- readout -----------------------------------------------------------
     def spans(self) -> List[Span]:
         with self._lock:
+            if self._cv is not None:
+                self._cv.read()
             return list(self._ring)
 
     def clear(self) -> None:
         with self._lock:
+            if self._cv is not None:
+                self._cv.write()
             self._ring.clear()
             self.dropped = 0
 
@@ -1155,10 +1225,11 @@ def span_in(tracer_obj: "Tracer", ctx: Optional[TraceContext],
 # process-wide singletons (lazily built; nothing exists while off)
 # ---------------------------------------------------------------------------
 
-_REGISTRY: Optional[MetricsRegistry] = None
-_TRACER: Optional[Tracer] = None
-_TRACES: Optional[RequestTraceBook] = None
-_ARMED = 0  # profiler-window arming (profiler/__init__.py bridge)
+_REGISTRY: Optional[MetricsRegistry] = None  # guarded-by: telemetry.state
+_TRACER: Optional[Tracer] = None  # guarded-by: telemetry.state
+_TRACES: Optional[RequestTraceBook] = None  # guarded-by: telemetry.state
+# profiler-window arming (profiler/__init__.py bridge)
+_ARMED = 0  # guarded-by: telemetry.state
 # guards singleton creation and the arm counter: two threads building
 # schedulers concurrently must cache the SAME registry, or the
 # loser's metrics silently vanish from every snapshot

@@ -81,6 +81,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ...framework import concurrency as _concurrency
 from ...framework import telemetry
 from ...framework.core import Tensor, apply_op, _as_tensor
 from ...framework.flags import flag
@@ -158,6 +159,13 @@ class HostKVSwapSpace:
         self.swapped_out_records = 0
         self.swapped_in_records = 0
         self.peak_used_bytes = 0
+        # concurrency-sanitizer handle (framework/concurrency.py):
+        # the store is single-writer by contract — only the thread
+        # driving the pools' swap_out/swap_in mutates it, while the
+        # ops-server scrape reads summary() as a GIL-atomic snapshot
+        _csan = _concurrency.sanitizer()
+        self._cv = None if _csan is None else _csan.shared(
+            "paged_cache.swap.store", owner=self, single_writer=True)
 
     # -- public (serving-visible) readout ----------------------------------
     @property
@@ -209,6 +217,8 @@ class HostKVSwapSpace:
             raise SwapSpaceFull(
                 f"swap space full: record needs {rec.nbytes} bytes, "
                 f"{self.free_bytes} of {self.capacity_bytes} free")
+        if self._cv is not None:
+            self._cv.write()
         self._swap_store[key] = rec
         self._swap_used += rec.nbytes
         self.swapped_out_records += 1
@@ -225,6 +235,8 @@ class HostKVSwapSpace:
         """Remove and return a record (swap-in restore or a deadline-
         abort discard — the caller counts which)."""
         rec = self._swap_get(key)
+        if self._cv is not None:
+            self._cv.write()
         del self._swap_store[key]
         self._swap_used -= rec.nbytes
         return rec

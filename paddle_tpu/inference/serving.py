@@ -134,6 +134,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..framework import concurrency as _concurrency
 from ..framework import telemetry
 from ..framework.flags import flag
 from ..framework.telemetry import NULL_SPAN as _NULL
@@ -145,7 +146,7 @@ __all__ = ["Request", "BatchScheduler", "RequestState",
 # gauges (two schedulers must never overwrite each other's program
 # counts — the old shared gauge was last-writer-wins and stays only
 # as an alias)
-_SCHED_SEQ = [0]
+_SCHED_SEQ = [0]  # concurrency: single-writer
 
 
 class QueueFullError(RuntimeError):
@@ -442,6 +443,25 @@ class BatchScheduler:
         self._recorder = None
         _SCHED_SEQ[0] += 1
         self._sched_uid = "s%d" % _SCHED_SEQ[0]
+        # host-plane concurrency sanitizer (framework/concurrency.py):
+        # the submit queue and the active/finished/swapped maps are
+        # single-writer BY CONTRACT (the thread driving the step loop
+        # also submits); the registered vars turn a second writer
+        # thread — the async-engine hazard — into a journaled
+        # violation, while scrape-thread reads of the /statusz
+        # provider stay unchecked GIL-atomic snapshots. Off mode
+        # holds None handles: one `is not None` check per site.
+        self._csan = _concurrency.sanitizer()
+        if self._csan is None:
+            self._cv_queue = None
+            self._cv_state = None
+        else:
+            self._cv_queue = self._csan.shared(
+                "serving.%s.queue" % self._sched_uid, owner=self,
+                single_writer=True)
+            self._cv_state = self._csan.shared(
+                "serving.%s.state" % self._sched_uid, owner=self,
+                single_writer=True)
         if self._metrics is None:
             if slo is not None or watchdog is not None:
                 warnings.warn(
@@ -801,6 +821,8 @@ class BatchScheduler:
             self._traces.begin(
                 req.req_id, telemetry.clock(), self._step_epoch,
                 **payload)
+        if self._cv_queue is not None:
+            self._cv_queue.write()
         self._queue.append(req)
         return req.req_id
 
@@ -839,6 +861,8 @@ class BatchScheduler:
     def _pop_queued(self, req):
         """Remove an admitted candidate from the queue (O(1) for the
         head — the plain-FIFO common case)."""
+        if self._cv_queue is not None:
+            self._cv_queue.write()
         if self._queue and self._queue[0] is req:
             self._queue.popleft()
         else:
@@ -1002,6 +1026,8 @@ class BatchScheduler:
             # from here on (swap records and COW handoffs inherit it)
             self._tag_pool_trace(req)
             req.state = RequestState.PREFILL
+            if self._cv_state is not None:
+                self._cv_state.write()
             self._active[req.req_id] = req
             self._admitted_step += 1
             if self._metrics is not None:
@@ -1093,6 +1119,8 @@ class BatchScheduler:
         # round-trip it through their swap records already do; this
         # covers model-level swap hooks and fresh chains)
         self._tag_pool_trace(req)
+        if self._cv_state is not None:
+            self._cv_state.write()
         del self._swapped[rid]
         req.state = (RequestState.DECODE if req.generated_ids
                      else RequestState.PREFILL)
@@ -1187,6 +1215,8 @@ class BatchScheduler:
                     nbytes += nb
         req.state = RequestState.SWAPPED
         req._preemptions += 1
+        if self._cv_state is not None:
+            self._cv_state.write()
         self._active.pop(rid)
         self._swapped[rid] = req
         self._step_extras["preempted"] = \
@@ -1219,6 +1249,8 @@ class BatchScheduler:
             return req._t_deadline and now >= req._t_deadline
 
         for req in [r for r in self._queue if gone(r)]:
+            if self._cv_queue is not None:
+                self._cv_queue.write()
             self._queue.remove(req)
             self._abort_deadline(req, "queued")
         for req in [r for r in self._active.values() if gone(r)]:
@@ -1235,6 +1267,8 @@ class BatchScheduler:
         if self.prefix_cache is not None and req._prefix_path:
             self.prefix_cache.unpin(req._prefix_path)
             req._prefix_path = ()
+        if self._cv_state is not None:
+            self._cv_state.write()
         if where == "active":
             self.model.free(rid)
             if self.draft is not None:
@@ -1414,6 +1448,8 @@ class BatchScheduler:
         # emit above — the serving-terminal-trace lint rule holds any
         # function that drops a request to that pairing
         req.state = RequestState.FINISHED
+        if self._cv_state is not None:
+            self._cv_state.write()
         del self._active[req.req_id]
         self._finished[req.req_id] = req
 
@@ -1596,6 +1632,12 @@ class BatchScheduler:
                     worst, worst_n = san, n
             if worst is not None:
                 context["sanitizer_journal_tail"] = worst.tail(16)
+            # race-journal evidence: any concurrency-sanitizer
+            # activity rides the same incident bundle as the page-
+            # sanitizer tail (concurrency_journal.jsonl member)
+            if self._csan is not None and self._csan.has_events():
+                context["concurrency_journal_tail"] = \
+                    self._csan.tail(16)
             try:
                 fired = self._watchdog.check(self._step_epoch,
                                              context=context or None)

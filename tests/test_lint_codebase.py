@@ -1248,3 +1248,317 @@ class TestMetricNameDiscipline:
     def test_rule_inventory_has_metric_name_discipline(self):
         assert any(rid == "metric-name-discipline"
                    for rid, _ in lint_codebase.RULES)
+
+
+class TestConcurrencyGuardedBy:
+    """ISSUE-16 lock-discipline rule: module-level mutable shared
+    state in the concurrency-bearing host modules must declare its
+    guard ('# guarded-by: <lock>') or carry the single-writer
+    waiver — the static twin of the runtime sanitizer's
+    unguarded-shared-write class."""
+
+    def test_seeded_unmarked_mutable_flagged(self):
+        bad = (
+            "_CACHE = {}\n"
+            "def put(k, v):\n"
+            "    _CACHE[k] = v\n"
+        )
+        v = lint_codebase.lint_guarded_by_file("fake/mod.py",
+                                               text=bad)
+        assert len(v) == 1, v
+        assert "_CACHE" in v[0]
+        assert "guarded-by" in v[0]
+
+    def test_seeded_global_rebind_flagged(self):
+        bad = (
+            "_SERVER = None\n"
+            "def start():\n"
+            "    global _SERVER\n"
+            "    _SERVER = object()\n"
+        )
+        v = lint_codebase.lint_guarded_by_file("fake/mod.py",
+                                               text=bad)
+        assert len(v) == 1, v
+        assert "_SERVER" in v[0]
+
+    def test_guard_mark_suppresses(self):
+        ok = (
+            "_CACHE = {}  # guarded-by: mod.state\n"
+            "_SEQ = [0]  # concurrency: single-writer\n"
+            "def put(k, v):\n"
+            "    _CACHE[k] = v\n"
+            "    _SEQ[0] += 1\n"
+        )
+        assert lint_codebase.lint_guarded_by_file(
+            "fake/mod.py", text=ok) == []
+
+    def test_untouched_and_local_state_clean(self):
+        ok = (
+            "_TABLE = {}\n"          # never mutated from a function
+            "CONST = 3\n"
+            "def f():\n"
+            "    local = {}\n"
+            "    local['k'] = 1\n"
+            "    return _TABLE, CONST\n"
+        )
+        assert lint_codebase.lint_guarded_by_file(
+            "fake/mod.py", text=ok) == []
+
+    def test_mutator_method_call_flagged(self):
+        bad = (
+            "import collections\n"
+            "_RING = collections.deque()\n"
+            "def push(x):\n"
+            "    _RING.append(x)\n"
+        )
+        v = lint_codebase.lint_guarded_by_file("fake/mod.py",
+                                               text=bad)
+        assert len(v) == 1, v
+        assert "_RING" in v[0]
+
+    def test_concurrency_files_covered_and_clean(self):
+        names = "\n".join(lint_codebase.CONCURRENCY_FILES)
+        for stem in ("telemetry.py", "ops_server.py", "serving.py",
+                     "concurrency.py", "flight_recorder.py",
+                     "paged_cache.py"):
+            assert stem in names, stem
+        for f in lint_codebase.CONCURRENCY_FILES:
+            assert os.path.exists(os.path.join(REPO, f)), f
+        assert lint_codebase.check_guarded_by() == []
+
+    def test_rule_inventory_has_guarded_by(self):
+        assert any(rid == "concurrency-guarded-by"
+                   for rid, _ in lint_codebase.RULES)
+
+
+class TestConcurrencyLockOrder:
+    """Lock acquisition order must be a DAG at AST level — nested
+    `with lock:` blocks merged across the concurrency files; a cycle
+    is the static twin of lock-order-inversion."""
+
+    def test_seeded_inversion_flagged(self):
+        bad = (
+            "import threading\n"
+            "a_lock = threading.Lock()\n"
+            "b_lock = threading.Lock()\n"
+            "def p1():\n"
+            "    with a_lock:\n"
+            "        with b_lock:\n"
+            "            pass\n"
+            "def p2():\n"
+            "    with b_lock:\n"
+            "        with a_lock:\n"
+            "            pass\n"
+        )
+        v = lint_codebase.lint_lock_order_file("fake/mod.py",
+                                               text=bad)
+        assert len(v) == 1, v
+        assert "lock-order inversion" in v[0]
+
+    def test_consistent_order_clean(self):
+        ok = (
+            "import threading\n"
+            "a_lock = threading.Lock()\n"
+            "b_lock = threading.Lock()\n"
+            "def p1():\n"
+            "    with a_lock:\n"
+            "        with b_lock:\n"
+            "            pass\n"
+            "def p2():\n"
+            "    with a_lock, b_lock:\n"
+            "        pass\n"
+        )
+        assert lint_codebase.lint_lock_order_file(
+            "fake/mod.py", text=ok) == []
+
+    def test_guarded_names_canonicalize_across_files(self):
+        """Two files binding DIFFERENT attribute names to the same
+        guarded('...') locks still merge into one digraph."""
+        f1 = (
+            "from paddle_tpu.framework import concurrency\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._reg_lock = concurrency.guarded('x.reg')\n"
+            "        self._q_lock = concurrency.guarded('x.queue')\n"
+            "    def go(self):\n"
+            "        with self._reg_lock:\n"
+            "            with self._q_lock:\n"
+            "                pass\n"
+        )
+        f2 = (
+            "from paddle_tpu.framework import concurrency\n"
+            "class B:\n"
+            "    def __init__(self):\n"
+            "        self._a_lock = concurrency.guarded('x.queue')\n"
+            "        self._b_lock = concurrency.guarded('x.reg')\n"
+            "    def go(self):\n"
+            "        with self._a_lock:\n"
+            "            with self._b_lock:\n"
+            "                pass\n"
+        )
+        e1, err1 = lint_codebase._lock_order_edges("fake/one.py",
+                                                   text=f1)
+        e2, err2 = lint_codebase._lock_order_edges("fake/two.py",
+                                                   text=f2)
+        assert err1 == [] and err2 == []
+        v = lint_codebase._lock_order_violations(e1 + e2)
+        assert len(v) == 1, v
+        # neither file alone has a cycle
+        assert lint_codebase._lock_order_violations(e1) == []
+        assert lint_codebase._lock_order_violations(e2) == []
+
+    def test_nested_def_resets_held_set(self):
+        ok = (
+            "import threading\n"
+            "a_lock = threading.Lock()\n"
+            "b_lock = threading.Lock()\n"
+            "def p1():\n"
+            "    with b_lock:\n"
+            "        def later():\n"
+            "            with a_lock:\n"
+            "                pass\n"
+            "        return later\n"
+            "def p2():\n"
+            "    with a_lock:\n"
+            "        with b_lock:\n"
+            "            pass\n"
+        )
+        assert lint_codebase.lint_lock_order_file(
+            "fake/mod.py", text=ok) == []
+
+    def test_repo_lock_order_clean(self):
+        assert lint_codebase.check_lock_order() == []
+
+    def test_rule_inventory_has_lock_order(self):
+        assert any(rid == "concurrency-lock-order"
+                   for rid, _ in lint_codebase.RULES)
+
+
+class TestConcurrencyBlockingAsync:
+    """No blocking calls lexically inside `async def` — the static
+    twin of blocking-acquire-on-loop."""
+
+    def test_seeded_blocking_calls_flagged(self):
+        bad = (
+            "import time\n"
+            "async def pump(lock):\n"
+            "    time.sleep(0.1)\n"
+            "    lock.acquire()\n"
+            "    open('/tmp/x')\n"
+        )
+        v = lint_codebase.lint_blocking_async_file("fake/mod.py",
+                                                   text=bad)
+        assert len(v) == 3, v
+        joined = "\n".join(v)
+        assert "time.sleep" in joined
+        assert "acquire" in joined
+        assert "open()" in joined
+
+    def test_nonblocking_acquire_clean(self):
+        ok = (
+            "async def pump(lock):\n"
+            "    if lock.acquire(blocking=False):\n"
+            "        lock.release()\n"
+            "    if lock.acquire(False):\n"
+            "        lock.release()\n"
+        )
+        assert lint_codebase.lint_blocking_async_file(
+            "fake/mod.py", text=ok) == []
+
+    def test_sync_helper_nested_in_async_clean(self):
+        ok = (
+            "import time\n"
+            "async def pump(loop):\n"
+            "    def worker():\n"
+            "        time.sleep(0.1)\n"
+            "    await loop.run_in_executor(None, worker)\n"
+        )
+        assert lint_codebase.lint_blocking_async_file(
+            "fake/mod.py", text=ok) == []
+
+    def test_sync_function_blocking_clean(self):
+        ok = (
+            "import time\n"
+            "def pump():\n"
+            "    time.sleep(0.1)\n"
+        )
+        assert lint_codebase.lint_blocking_async_file(
+            "fake/mod.py", text=ok) == []
+
+    def test_waiver_suppresses(self):
+        ok = (
+            "import time\n"
+            "async def pump():\n"
+            "    time.sleep(0.1)  # trace-lint: ok(test waiver)\n"
+        )
+        assert lint_codebase.lint_blocking_async_file(
+            "fake/mod.py", text=ok) == []
+
+    def test_repo_async_defs_clean(self):
+        assert lint_codebase.check_blocking_async() == []
+
+    def test_rule_inventory_has_blocking_async(self):
+        assert any(rid == "concurrency-blocking-async"
+                   for rid, _ in lint_codebase.RULES)
+
+
+class TestConcurrencyThreadDiscipline:
+    """Host-plane threads are created only through the sanctioned
+    concurrency.spawn_thread helper."""
+
+    def test_seeded_raw_thread_flagged(self):
+        bad = (
+            "import threading\n"
+            "def start():\n"
+            "    t = threading.Thread(target=print, daemon=True)\n"
+            "    t.start()\n"
+        )
+        v = lint_codebase.lint_thread_discipline_file(
+            "fake/mod.py", text=bad)
+        assert len(v) == 1, v
+        assert "spawn_thread" in v[0]
+
+    def test_seeded_bare_and_aliased_thread_flagged(self):
+        bad = (
+            "from threading import Thread as T\n"
+            "from threading import Thread\n"
+            "def start():\n"
+            "    Thread(target=print).start()\n"
+            "    T(target=print).start()\n"
+        )
+        v = lint_codebase.lint_thread_discipline_file(
+            "fake/mod.py", text=bad)
+        assert len(v) == 2, v
+
+    def test_spawn_thread_clean(self):
+        ok = (
+            "from paddle_tpu.framework import concurrency\n"
+            "def start():\n"
+            "    return concurrency.spawn_thread('worker', print)\n"
+        )
+        assert lint_codebase.lint_thread_discipline_file(
+            "fake/mod.py", text=ok) == []
+
+    def test_waiver_suppresses(self):
+        ok = (
+            "import threading\n"
+            "def start():\n"
+            "    t = threading.Thread(target=print)"
+            "  # trace-lint: ok(test waiver)\n"
+            "    t.start()\n"
+        )
+        assert lint_codebase.lint_thread_discipline_file(
+            "fake/mod.py", text=ok) == []
+
+    def test_discipline_files_covered_and_clean(self):
+        names = "\n".join(lint_codebase.THREAD_DISCIPLINE_FILES)
+        assert "ops_server.py" in names
+        assert "flight_recorder.py" in names
+        assert "concurrency.py" not in names  # hosts the helper
+        for f in lint_codebase.THREAD_DISCIPLINE_FILES:
+            assert os.path.exists(os.path.join(REPO, f)), f
+        assert lint_codebase.check_thread_discipline() == []
+
+    def test_rule_inventory_has_thread_discipline(self):
+        assert any(rid == "concurrency-thread-discipline"
+                   for rid, _ in lint_codebase.RULES)

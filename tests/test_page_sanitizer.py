@@ -481,7 +481,8 @@ class TestCLI:
         groups = payload["static_checks"]
         assert set(groups) == {"jaxpr", "planner", "page_sanitizer",
                                "codebase_lint", "telemetry",
-                               "watchdog", "serving_faults"}
+                               "watchdog", "serving_faults",
+                               "concurrency"}
         assert {r["rule_id"] for r in groups["page_sanitizer"]} \
             == set(VIOLATIONS)
         assert {r["rule_id"] for r in groups["serving_faults"]} \

@@ -141,6 +141,7 @@ HOST_ONLY_FILES = (
     os.path.join("paddle_tpu", "framework", "flight_recorder.py"),
     os.path.join("paddle_tpu", "framework", "ops_server.py"),
     os.path.join("paddle_tpu", "incubate", "nn", "fault_injection.py"),
+    os.path.join("paddle_tpu", "framework", "concurrency.py"),
 )
 
 _HOST_ONLY_BANNED_MODULES = ("jax", "jax.numpy")
@@ -1789,6 +1790,514 @@ def check_op_table():
     return out
 
 
+# concurrency lock discipline (the static half of framework/
+# concurrency.py — the runtime race sanitizer is the dynamic half;
+# docs/ANALYSIS.md "Concurrency"). Four rules over the concurrency-
+# bearing host-plane modules:
+#   * concurrency-guarded-by — module-level mutable shared state
+#     (rebound via `global`, or mutated in place from function
+#     bodies) must declare its guard with a trailing
+#     `# guarded-by: <lock>` or waive with
+#     `# concurrency: single-writer`;
+#   * concurrency-lock-order — the statically-visible lock
+#     acquisition order (nested `with <lock>:` blocks) must form a
+#     DAG across ALL the checked files — a cycle is a potential
+#     deadlock, the AST-level twin of the sanitizer's
+#     lock-order-inversion class;
+#   * concurrency-blocking-async — no time.sleep, blocking lock
+#     acquire, or blocking IO inside `async def` (checked repo-wide:
+#     one blocking call stalls every task on the loop — the static
+#     twin of blocking-acquire-on-loop);
+#   * concurrency-thread-discipline — host-plane modules create
+#     threads only through concurrency.spawn_thread (named, daemon,
+#     sanitizer-registered) — never raw threading.Thread.
+
+CONCURRENCY_FILES = (
+    os.path.join("paddle_tpu", "framework", "telemetry.py"),
+    os.path.join("paddle_tpu", "framework", "ops_server.py"),
+    os.path.join("paddle_tpu", "framework", "flight_recorder.py"),
+    os.path.join("paddle_tpu", "framework", "concurrency.py"),
+    os.path.join("paddle_tpu", "inference", "serving.py"),
+    os.path.join("paddle_tpu", "incubate", "nn", "paged_cache.py"),
+)
+
+# thread creation is checked over the concurrency files plus the rest
+# of the host observability plane (concurrency.py itself hosts the
+# sanctioned helper and is exempt)
+THREAD_DISCIPLINE_FILES = tuple(
+    f for f in CONCURRENCY_FILES
+    if not f.endswith("concurrency.py")) + (
+    os.path.join("paddle_tpu", "framework", "watchdog.py"),
+    os.path.join("paddle_tpu", "framework", "perf_ledger.py"),
+)
+
+_GUARD_MARKS = ("# guarded-by:", "# concurrency: single-writer")
+
+_MUTABLE_CTORS = {"deque", "Counter", "defaultdict", "OrderedDict",
+                  "dict", "list", "set"}
+_MUTATOR_ATTRS = {"append", "appendleft", "add", "insert", "extend",
+                  "update", "pop", "popleft", "popitem", "remove",
+                  "discard", "clear", "setdefault"}
+
+
+def _is_mutable_value(node):
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in _MUTABLE_CTORS:
+            return True
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTABLE_CTORS:
+            return True
+    return False
+
+
+def _has_guard_mark(lines, lineno):
+    line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+    return any(m in line for m in _GUARD_MARKS) \
+        or _WAIVER_MARK in line
+
+
+class _SharedStateVisitor(ast.NodeVisitor):
+    """Collects module-level mutable names and how function bodies
+    touch them: `global` rebinding, subscript stores, and mutating
+    method calls."""
+
+    def __init__(self):
+        self.module_assign = {}   # name -> first top-level def line
+        self.module_mutable = {}  # name -> def line (mutable value)
+        self.rebound = {}         # name -> lineno of global stmt
+        self.mutated = {}         # name -> lineno of in-place write
+        self._depth = 0
+        self._globals = set()
+
+    def visit_Module(self, node):
+        for stmt in node.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                targets = [stmt.target]
+                value = stmt.value
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self.module_assign.setdefault(t.id, stmt.lineno)
+                    if _is_mutable_value(value):
+                        self.module_mutable.setdefault(
+                            t.id, stmt.lineno)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        outer = self._globals
+        self._depth += 1
+        if self._depth == 1:
+            self._globals = set()
+        self.generic_visit(node)
+        self._depth -= 1
+        if self._depth == 0:
+            self._globals = outer
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Global(self, node):
+        if self._depth:
+            self._globals.update(node.names)
+        self.generic_visit(node)
+
+    def _note_store(self, target, lineno):
+        # <name> = ... under a `global` declaration -> rebinding;
+        # <name>[...] = ... -> in-place mutation of module state
+        if isinstance(target, ast.Name) and self._depth \
+                and target.id in self._globals:
+            self.rebound.setdefault(target.id, lineno)
+        if isinstance(target, ast.Subscript) \
+                and isinstance(target.value, ast.Name) and self._depth:
+            self.mutated.setdefault(target.value.id, lineno)
+
+    def visit_Assign(self, node):
+        if self._depth:
+            for t in node.targets:
+                self._note_store(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if self._depth:
+            self._note_store(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        fn = node.func
+        if self._depth and isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Name) \
+                and fn.attr in _MUTATOR_ATTRS:
+            self.mutated.setdefault(fn.value.id, node.lineno)
+        self.generic_visit(node)
+
+
+def lint_guarded_by_file(path, text=None):
+    """GuardedBy declarations on module-level shared state for one
+    file; returns violation strings."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _SharedStateVisitor()
+    v.visit(tree)
+    lines = text.splitlines()
+    out = []
+    shared = {}
+    for name, lineno in v.rebound.items():
+        shared[name] = v.module_assign.get(name, lineno)
+    for name, lineno in v.mutated.items():
+        if name in v.module_mutable:
+            shared.setdefault(name, v.module_mutable[name])
+    for name in sorted(shared):
+        lineno = shared[name]
+        if not _has_guard_mark(lines, lineno):
+            out.append(
+                "%s:%d: module-level shared attribute %r is mutated "
+                "from function bodies but declares no guard — add a "
+                "trailing '# guarded-by: <lock>' (and hold that lock "
+                "at every write) or waive with "
+                "'# concurrency: single-writer' (one writer thread "
+                "by contract); the runtime half is "
+                "framework/concurrency.py" % (rel, lineno, name))
+    return out
+
+
+def check_guarded_by(root=REPO):
+    out = []
+    for f in CONCURRENCY_FILES:
+        out.extend(lint_guarded_by_file(os.path.join(root, f)))
+    return out
+
+
+def _is_lockish(expr):
+    """Name/attribute heuristic for lock objects in `with` items."""
+    if isinstance(expr, ast.Attribute):
+        n = expr.attr
+    elif isinstance(expr, ast.Name):
+        n = expr.id
+    else:
+        return None
+    low = n.lower()
+    if "lock" in low or low == "_mu" or low.endswith("_mutex"):
+        return n
+    return None
+
+
+class _LockOrderVisitor(ast.NodeVisitor):
+    """Collects statically-visible acquisition edges: `with A:`
+    lexically containing `with B:` (or `with A, B:`) yields edge
+    A -> B. Canonical lock names come from `= guarded("name")`
+    assignments where resolvable, else <module-stem>.<attr>."""
+
+    def __init__(self, relpath, stem, canon):
+        self.relpath = relpath
+        self.stem = stem
+        self.canon = canon  # raw attr/name -> canonical name
+        self.edges = []     # (src, dst, lineno)
+        self._held = []
+
+    def _canonical(self, raw):
+        return self.canon.get(raw, "%s.%s" % (self.stem, raw))
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            raw = _is_lockish(item.context_expr)
+            if raw is not None:
+                name = self._canonical(raw)
+                for held in self._held + acquired:
+                    if held != name:
+                        self.edges.append((held, name, node.lineno))
+                acquired.append(name)
+        self._held.extend(acquired)
+        self.generic_visit(node)
+        for _ in acquired:
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node):
+        # a nested def runs later, not under the enclosing `with`
+        held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = held
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _lock_canon_map(tree):
+    """raw attr/name -> canonical sanitizer lock name, from
+    `<target> = [mod.]guarded("name", ...)` assignments."""
+    canon = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        fn = call.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if fname != "guarded" or not call.args:
+            continue
+        arg = call.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute):
+                canon[t.attr] = arg.value
+            elif isinstance(t, ast.Name):
+                canon[t.id] = arg.value
+    return canon
+
+
+def _lock_order_edges(path, text=None):
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return [], ["%s: syntax error during lint: %s" % (rel, e)]
+    stem = os.path.splitext(os.path.basename(rel))[0]
+    v = _LockOrderVisitor(rel, stem, _lock_canon_map(tree))
+    v.visit(tree)
+    return [(src, dst, rel, lineno) for src, dst, lineno in v.edges], []
+
+
+def _lock_order_violations(edges):
+    """Cycle check over the merged acquisition digraph: an edge
+    (u, v) whose reverse is reachable through OTHER edges closes a
+    cycle — both orders exist somewhere, a potential deadlock."""
+    graph = {}
+    for src, dst, rel, lineno in edges:
+        graph.setdefault(src, set()).add(dst)
+    out = []
+    seen_pairs = set()
+    for src, dst, rel, lineno in edges:
+        # the edge src -> dst closes a cycle iff dst reaches src
+        stack, visited = [dst], set()
+        found = False
+        while stack:
+            n = stack.pop()
+            if n == src:
+                found = True
+                break
+            if n in visited:
+                continue
+            visited.add(n)
+            stack.extend(graph.get(n, ()))
+        key = tuple(sorted((src, dst)))
+        if found and key not in seen_pairs:
+            seen_pairs.add(key)
+            out.append(
+                "%s:%d: lock-order inversion: %r is acquired while "
+                "holding %r here, but another code path acquires "
+                "them in the opposite order — the declared "
+                "acquisition order must be a DAG (potential "
+                "deadlock; the runtime twin is the sanitizer's "
+                "lock-order-inversion class)"
+                % (rel, lineno, dst, src))
+    return out
+
+
+def lint_lock_order_file(path, text=None):
+    """Per-file lock-order DAG check; returns violation strings."""
+    edges, errs = _lock_order_edges(path, text)
+    return errs + _lock_order_violations(edges)
+
+
+def check_lock_order(root=REPO):
+    edges, out = [], []
+    for f in CONCURRENCY_FILES:
+        e, errs = _lock_order_edges(os.path.join(root, f))
+        edges.extend(e)
+        out.extend(errs)
+    out.extend(_lock_order_violations(edges))
+    return out
+
+
+_BLOCKING_IO_CALLS = {
+    ("time", "sleep"): "time.sleep",
+    ("os", "system"): "os.system",
+    ("subprocess", "run"): "subprocess.run",
+    ("subprocess", "call"): "subprocess.call",
+    ("subprocess", "check_call"): "subprocess.check_call",
+    ("subprocess", "check_output"): "subprocess.check_output",
+    ("subprocess", "Popen"): "subprocess.Popen",
+}
+
+
+def _acquire_is_nonblocking(node):
+    """True when an .acquire(...) call is explicitly non-blocking:
+    blocking=False / timeout=0 keywords or a literal False/0 first
+    positional."""
+    for kw in node.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+        if kw.arg == "timeout" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value == 0:
+            return True
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and node.args[0].value is False:
+        return True
+    return False
+
+
+class _BlockingAsyncVisitor(ast.NodeVisitor):
+    """Flags blocking calls lexically inside `async def` bodies."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+        self._async_depth = 0
+
+    def _flag(self, lineno, what):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        if _WAIVER_MARK not in line:
+            self.violations.append(
+                "%s:%d: %s inside `async def` — a blocking call "
+                "stalls EVERY task on the event loop (the sanitizer's "
+                "blocking-acquire-on-loop class, statically); hop to "
+                "an executor, use the async primitive, or waive with "
+                "'%s(<reason>)'"
+                % (self.relpath, lineno, what, _WAIVER_MARK))
+
+    def visit_AsyncFunctionDef(self, node):
+        self._async_depth += 1
+        self.generic_visit(node)
+        self._async_depth -= 1
+
+    def visit_FunctionDef(self, node):
+        # a sync helper DEFINED inside an async def runs wherever it
+        # is called — do not blame the enclosing coroutine
+        depth, self._async_depth = self._async_depth, 0
+        self.generic_visit(node)
+        self._async_depth = depth
+
+    def visit_Call(self, node):
+        if self._async_depth:
+            dotted = _dotted_head(node)
+            if dotted in _BLOCKING_IO_CALLS:
+                self._flag(node.lineno,
+                           "%s()" % _BLOCKING_IO_CALLS[dotted])
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "acquire" \
+                    and not _acquire_is_nonblocking(node):
+                self._flag(node.lineno, "blocking .acquire()")
+            if isinstance(fn, ast.Name) and fn.id == "open":
+                self._flag(node.lineno, "open() file IO")
+        self.generic_visit(node)
+
+
+def lint_blocking_async_file(path, text=None):
+    """Blocking-in-async check for one file; returns violations."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _BlockingAsyncVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_blocking_async(root=REPO):
+    """Repo-wide: async defs are rare and every one matters."""
+    out = []
+    pkg = os.path.join(root, "paddle_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.extend(lint_blocking_async_file(
+                    os.path.join(dirpath, fn)))
+    return out
+
+
+class _ThreadDisciplineVisitor(ast.NodeVisitor):
+    """Flags raw thread construction: threading.Thread(...) or a
+    bare Thread(...) imported from threading."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+        self._thread_aliases = {"Thread"}
+
+    def visit_ImportFrom(self, node):
+        if (node.module or "") == "threading":
+            for a in node.names:
+                if a.name == "Thread":
+                    self._thread_aliases.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    def _flag(self, lineno, what):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        if _WAIVER_MARK not in line:
+            self.violations.append(
+                "%s:%d: %s in a host-plane module — threads are "
+                "created ONLY through concurrency.spawn_thread "
+                "(named, daemon, sanitizer-registered with a "
+                "parent->child happens-before edge); or waive with "
+                "'%s(<reason>)'"
+                % (self.relpath, lineno, what, _WAIVER_MARK))
+
+    def visit_Call(self, node):
+        fn = node.func
+        dotted = _dotted_head(node)
+        if dotted is not None and dotted[0] == "threading" \
+                and dotted[1] == "Thread":
+            self._flag(node.lineno, "raw threading.Thread(...)")
+        elif isinstance(fn, ast.Name) \
+                and fn.id in self._thread_aliases:
+            self._flag(node.lineno, "raw %s(...)" % fn.id)
+        self.generic_visit(node)
+
+
+def lint_thread_discipline_file(path, text=None):
+    """Thread-discipline check for one file; returns violations."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _ThreadDisciplineVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_thread_discipline(root=REPO):
+    out = []
+    for f in THREAD_DISCIPLINE_FILES:
+        out.extend(lint_thread_discipline_file(os.path.join(root, f)))
+    return out
+
+
 # rule inventory: (rule id, one-line summary) for every AST check in
 # this linter — merged into `python -m paddle_tpu.framework.analysis
 # --rules` alongside the jaxpr rules and the page-sanitizer violation
@@ -1873,6 +2382,28 @@ RULES = (
      "— quantize-on-the-wire (FLAGS_collective_dtype) lives only in "
      "ops/kernels/collective_matmul.py (block scales, custom-VJP "
      "cotangent rings, planner-exact wire bytes)"),
+    ("concurrency-guarded-by",
+     "module-level mutable shared state in the concurrency-bearing "
+     "host-plane modules (telemetry.py, ops_server.py, "
+     "flight_recorder.py, concurrency.py, serving.py, "
+     "paged_cache.py) must declare its guard with a trailing "
+     "'# guarded-by: <lock>' or waive with "
+     "'# concurrency: single-writer'"),
+    ("concurrency-lock-order",
+     "the statically-visible lock acquisition order (nested "
+     "'with <lock>:' blocks, merged across the concurrency files) "
+     "must be a DAG — a cycle is a potential deadlock (the AST twin "
+     "of the sanitizer's lock-order-inversion class)"),
+    ("concurrency-blocking-async",
+     "no time.sleep / blocking .acquire() / blocking IO (open, "
+     "os.system, subprocess.*) inside 'async def', repo-wide — one "
+     "blocking call stalls every task on the event loop (the static "
+     "twin of blocking-acquire-on-loop)"),
+    ("concurrency-thread-discipline",
+     "host-plane modules create threads only through "
+     "concurrency.spawn_thread (named daemon threads, "
+     "sanitizer-registered with a parent->child happens-before "
+     "edge) — never raw threading.Thread"),
 )
 
 
@@ -1892,6 +2423,10 @@ def run_lint(root=REPO, with_op_table=True):
     out.extend(check_jax_only(root))
     out.extend(check_tp_routing(root))
     out.extend(check_wire_quant(root))
+    out.extend(check_guarded_by(root))
+    out.extend(check_lock_order(root))
+    out.extend(check_blocking_async(root))
+    out.extend(check_thread_discipline(root))
     if with_op_table:
         out.extend(check_op_table())
         out.extend(check_inference_surface())
