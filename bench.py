@@ -1670,6 +1670,379 @@ def bench_concurrency_serving(users=4, prompt_len=48, new_tokens=8,
     return _merge_serving_rec("concurrency", rec)
 
 
+# aux: async serving engine — streamed decode + goodput-gated admission
+# ---------------------------------------------------------------------------
+
+
+def bench_engine_serving(users=4, prompt_len=48, new_tokens=8,
+                         budget=32):
+    """Async-engine arm (ISSUE 17): the chunked serving workload
+    driven through inference.engine.ServingEngine — background step
+    pump, per-caller TokenStream consumers on an asyncio loop —
+    compared against the hand-cranked sync step loop. Three gates:
+    (1) greedy outputs identical across sync / engine-off /
+    engine-strict, with streamed-TTFT p50/p99 read from the registry
+    and the commit->receipt delivery lag bounded by a step wall;
+    (2) the strict run violation-free while a scraper thread hammers
+    /metrics and /enginez, with the off/strict per-step overhead
+    recorded from serving.step_wall_s; (3) a 2x-capacity overload
+    burst against a live (unmeetable) SLO trips the goodput gate,
+    sheds a low-priority probe, keeps streaming to already-admitted
+    callers, and recovers to open with hysteresis once the miss
+    window drains. Results land under "engine" in
+    BENCH_SERVING_LAST.json."""
+    import asyncio
+    import threading
+    import urllib.request
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import concurrency as _conc
+    from paddle_tpu.framework import ops_server, telemetry
+    from paddle_tpu.framework.flags import flag, set_flags
+    from paddle_tpu.inference import (
+        BatchScheduler,
+        EngineOverloadError,
+        PagedLlamaAdapter,
+        Request,
+        ServingEngine,
+    )
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    kind = _device_kind()
+    cpu = kind.startswith("cpu")
+    page_size = 4
+    if cpu:
+        users, prompt_len, new_tokens = 4, 32, 6
+        cfg = llama_tiny(num_hidden_layers=2,
+                         max_position_embeddings=256)
+    else:
+        cfg = llama_tiny(
+            hidden_size=512, intermediate_size=1024,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=2048,
+        )
+        page_size = 16
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(users)]
+    pages_per_seq = -(-(prompt_len + new_tokens) // page_size)
+    num_pages = 2 * users * pages_per_seq + 16
+
+    def hist_ms(snap, ns, key):
+        h = snap.get(ns, {}).get(key) or {}
+        return {k: (None if h.get(k) is None
+                    else round(1e3 * h[k], 3))
+                for k in ("p50", "p99", "max")} | \
+            {"count": int(h.get("count", 0) or 0)}
+
+    def run_sync():
+        # the baseline the engine must match token-for-token: same
+        # model/pool/flags, scheduler hand-cranked on this thread
+        set_flags({"concurrency_sanitizer": "off",
+                   "telemetry": "metrics"})
+        _conc.reset()
+        telemetry.reset()
+        adapter = PagedLlamaAdapter(
+            model, num_pages=num_pages, page_size=page_size,
+            max_length=cfg.max_position_embeddings)
+        sched = BatchScheduler(adapter, max_batch_size=users,
+                               chunked_prefill=True,
+                               prefill_chunk_tokens=budget)
+        for i, p in enumerate(prompts):
+            sched.submit(Request(f"r{i}", list(p),
+                                 max_new_tokens=new_tokens))
+        while sched.num_active or sched.num_queued:
+            sched.step()
+        snap = telemetry.registry().snapshot()
+        gen = {f"r{i}": list(sched.result(f"r{i}").generated_ids)
+               for i in range(users)}
+        return {"gen": gen,
+                "step_ms": hist_ms(snap, "serving", "step_wall_s"),
+                "ttft_ms": hist_ms(snap, "serving", "ttft_s")}
+
+    def run_engine(mode):
+        # same workload through the async engine: pump thread steps,
+        # one consumer task per stream; strict mode adds the live
+        # /metrics + /enginez scraper on top of the full audit
+        set_flags({"concurrency_sanitizer": mode,
+                   "telemetry": "metrics"})
+        _conc.reset()
+        telemetry.reset()
+        adapter = PagedLlamaAdapter(
+            model, num_pages=num_pages, page_size=page_size,
+            max_length=cfg.max_position_embeddings)
+        sched = BatchScheduler(adapter, max_batch_size=users,
+                               chunked_prefill=True,
+                               prefill_chunk_tokens=budget)
+        stop = threading.Event()
+        scrapes = [0]
+        scraper = None
+        srv = None
+        if mode == "strict":
+            srv = ops_server.maybe_start(port=0)
+            set_flags({"ops_server_port": srv.port})
+
+            def scrape():
+                while not stop.is_set():
+                    for path in ("/metrics", "/enginez"):
+                        try:
+                            urllib.request.urlopen(
+                                srv.url + path, timeout=5).read()
+                            scrapes[0] += 1
+                        except Exception:
+                            pass
+
+            scraper = _conc.spawn_thread("bench-engine-scraper",
+                                         scrape)
+        commits = {f"r{i}": [] for i in range(users)}
+        recvs = {f"r{i}": [] for i in range(users)}
+
+        def hook(req, tok, is_prompt):
+            # pump-thread side of the delivery-lag probe: stamp the
+            # commit instant of every generated token
+            if not is_prompt:
+                commits[req.req_id].append(time.perf_counter())
+
+        async def main():
+            gen = {}
+            async with ServingEngine(sched) as eng:
+                streams = []
+                for i, p in enumerate(prompts):
+                    streams.append(await eng.submit(Request(
+                        f"r{i}", list(p),
+                        max_new_tokens=new_tokens,
+                        on_token=hook)))
+
+                async def consume(s):
+                    toks = []
+                    async for t in s:
+                        recvs[s.req_id].append(time.perf_counter())
+                        toks.append(int(t))
+                    gen[s.req_id] = toks
+
+                await asyncio.gather(*(consume(s) for s in streams))
+            return gen
+
+        try:
+            gen = asyncio.run(asyncio.wait_for(main(), timeout=300))
+            snap = telemetry.registry().snapshot()
+        finally:
+            stop.set()
+            if scraper is not None:
+                scraper.join(timeout=10)
+            if srv is not None:
+                ops_server.stop()
+                set_flags({"ops_server_port": 0})
+        lags = [r - c
+                for rid in commits
+                for c, r in zip(commits[rid], recvs[rid])]
+        san = _conc.sanitizer()
+        stats = san.stats() if san is not None else None
+        return {"gen": gen,
+                "step_ms": hist_ms(snap, "serving", "step_wall_s"),
+                "ttft_ms": hist_ms(snap, "serving", "ttft_s"),
+                "lag_p99_ms": round(
+                    1e3 * float(np.percentile(lags, 99)), 3),
+                "lag_max_ms": round(1e3 * max(lags), 3),
+                "stats": stats, "scrapes": scrapes[0]}
+
+    def run_burst():
+        # 2x-capacity burst against an unmeetable live SLO: every
+        # retire is a miss, goodput collapses, the gate trips. A
+        # high-priority anchor request keeps the pump stepping after
+        # the burst drains, so the miss window empties (goodput
+        # republishes 1.0) and the gate walks back to open through
+        # its hysteresis — no synthetic gauge writes anywhere.
+        burst_users = 2 * users
+        saved = {k: flag(k) for k in (
+            "engine_gate_stride", "engine_trip_steps",
+            "engine_recover_steps", "engine_min_window",
+            "telemetry_window")}
+        set_flags({"concurrency_sanitizer": "off",
+                   "telemetry": "metrics",
+                   "telemetry_window": 16,
+                   "engine_gate_stride": 1,
+                   "engine_trip_steps": 1,
+                   "engine_recover_steps": 2,
+                   "engine_min_window": 2})
+        _conc.reset()
+        telemetry.reset()
+        # pool = anchor's worst case + ~half the burst demand, so
+        # the 2x burst genuinely overloads while the anchor always
+        # clears admission
+        anchor_new = 160
+        anchor_pages = -(-(prompt_len + anchor_new + 2) // page_size)
+        adapter = PagedLlamaAdapter(
+            model,
+            num_pages=users * pages_per_seq + anchor_pages + 8,
+            page_size=page_size,
+            max_length=cfg.max_position_embeddings)
+        sched = BatchScheduler(
+            adapter, max_batch_size=users,
+            chunked_prefill=True, prefill_chunk_tokens=budget,
+            preempt=True, swap_bytes=64 << 20,
+            slo=telemetry.SLOConfig(ttft_p99_s=1e-6))
+        anchor_commits = []
+        anchor_recvs = []
+
+        def anchor_hook(req, tok, is_prompt):
+            if not is_prompt:
+                anchor_commits.append(time.perf_counter())
+
+        out = {"tripped": False, "recovered": False,
+               "shed_rejections": 0, "post_admitted": False,
+               "all_completed": False, "trips": 0,
+               "recoveries": 0}
+
+        async def main():
+            async with ServingEngine(sched) as eng:
+                anchor = await eng.submit(Request(
+                    "anchor", list(prompts[0]),
+                    max_new_tokens=anchor_new,
+                    priority=2, on_token=anchor_hook))
+
+                async def drain_anchor():
+                    async for t in anchor:
+                        anchor_recvs.append(time.perf_counter())
+
+                anchor_task = asyncio.ensure_future(drain_anchor())
+                streams = []
+                for i in range(burst_users):
+                    streams.append(await eng.submit(Request(
+                        f"b{i}", list(prompts[i % users]),
+                        max_new_tokens=new_tokens)))
+                gen = {}
+
+                async def consume(s):
+                    toks = []
+                    async for t in s:
+                        toks.append(int(t))
+                    gen[s.req_id] = toks
+
+                burst = asyncio.gather(*(consume(s)
+                                         for s in streams))
+                # wait for the gate to trip on the live goodput
+                # collapse, then prove shedding with a priority-0
+                # probe while the burst is still in flight
+                for _ in range(3000):
+                    bp = eng._enginez_info()["backpressure"]
+                    if bp["trips"] >= 1:
+                        out["tripped"] = True
+                        break
+                    await asyncio.sleep(0.01)
+                for _ in range(100):
+                    try:
+                        s = await eng.submit(Request(
+                            "probe", list(prompts[0]),
+                            max_new_tokens=2))
+                    except EngineOverloadError:
+                        out["shed_rejections"] += 1
+                        break
+                    async for t in s:  # raced a recovery: drain it
+                        pass
+                    await asyncio.sleep(0.01)
+                await burst
+                out["all_completed"] = (
+                    len(gen) == burst_users
+                    and all(len(v) == new_tokens
+                            for v in gen.values()))
+                # anchor decode keeps stepping: the miss window
+                # slides empty and the gate de-escalates to open
+                for _ in range(6000 if out["tripped"] else 1):
+                    bp = eng._enginez_info()["backpressure"]
+                    out["trips"] = bp["trips"]
+                    out["recoveries"] = bp["recoveries"]
+                    if out["tripped"] and bp["state"] == "open" \
+                            and bp["recoveries"] >= 1:
+                        out["recovered"] = True
+                        break
+                    await asyncio.sleep(0.01)
+                if out["recovered"]:
+                    post = await eng.submit(Request(
+                        "post", list(prompts[0]),
+                        max_new_tokens=2))
+                    async for t in post:
+                        pass
+                    out["post_admitted"] = True
+                await anchor.cancel()
+                await anchor_task
+
+        try:
+            asyncio.run(asyncio.wait_for(main(), timeout=300))
+            snap = telemetry.registry().snapshot()
+        finally:
+            set_flags(saved)
+        lags = [r - c for c, r in zip(anchor_commits, anchor_recvs)]
+        step_max_ms = (hist_ms(snap, "serving", "step_wall_s")
+                       .get("max") or 0.0)
+        lag_max_ms = round(1e3 * max(lags), 3) if lags else None
+        out.update({
+            "users": burst_users, "capacity_users": users,
+            "anchor_tokens": len(anchor_recvs),
+            "anchor_lag_p99_ms": round(
+                1e3 * float(np.percentile(lags, 99)), 3)
+            if lags else None,
+            "anchor_lag_max_ms": lag_max_ms,
+            "step_wall_max_ms": step_max_ms,
+            # "no stall beyond a step wall": token delivery from the
+            # pump commit to the consumer stays under the worst
+            # observed step (floored at 50ms for scheduler jitter)
+            "stall_ok": lag_max_ms is not None
+            and lag_max_ms <= max(step_max_ms, 50.0),
+        })
+        return out
+
+    try:
+        run_sync()                  # warmup: compiles out of timing
+        sync = run_sync()
+        off = run_engine("off")
+        strict = run_engine("strict")
+        burst = run_burst()
+    finally:
+        set_flags({"concurrency_sanitizer": "off",
+                   "telemetry": "off"})
+        _conc.reset()
+        telemetry.reset()
+    for r in (off, strict):
+        assert r["gen"] == sync["gen"], \
+            "async engine changed the greedy outputs"
+    st = strict["stats"] or {}
+    off_p50 = off["step_ms"].get("p50") or 0.0
+    strict_p50 = strict["step_ms"].get("p50") or 0.0
+    rec = {
+        "config": "serving_engine",
+        "mode": "tpu-single-chip" if not cpu else "cpu",
+        "users": users,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "budget": budget,
+        "greedy_identical": True,  # asserted above
+        "sync_step_p50_ms": sync["step_ms"].get("p50"),
+        "engine_off_step_p50_ms": off_p50,
+        "engine_strict_step_p50_ms": strict_p50,
+        "engine_overhead_pct": round(
+            100.0 * (strict_p50 - off_p50)
+            / max(off_p50, 1e-9), 1),
+        # streamed-TTFT straight from the registry histogram
+        "ttft_p50_ms": off["ttft_ms"].get("p50"),
+        "ttft_p99_ms": off["ttft_ms"].get("p99"),
+        "delivery_lag_p99_ms": off["lag_p99_ms"],
+        "delivery_lag_max_ms": off["lag_max_ms"],
+        "sanitizer_events": int(st.get("events", 0)),
+        "sanitizer_violations": int(st.get("violations", 0)),
+        "scrapes": int(strict["scrapes"]),
+        "burst": burst,
+        # gate mirrors
+        "bp_tripped": bool(burst["tripped"]),
+        "bp_shed": int(burst["shed_rejections"]),
+        "bp_recovered": bool(burst["recovered"]),
+        "stall_ok": bool(burst["stall_ok"]),
+    }
+    return _merge_serving_rec("engine", rec)
+
+
 # aux: runtime-telemetry overhead — trace spans + metrics vs off
 # ---------------------------------------------------------------------------
 
@@ -3020,7 +3393,10 @@ def main() -> int:
                          "runtime-telemetry overhead arm (trace vs "
                          "off + TTFT/TPOT columns), and the bursty "
                          "overload arm (2x-capacity preemption + "
-                         "fault injection); emits "
+                         "fault injection), and the async-engine "
+                         "arm (sync loop vs ServingEngine streams "
+                         "+ goodput-gated admission under an "
+                         "overload burst); emits "
                          "BENCH_SERVING_LAST.json")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--seq", type=int, default=2048)
@@ -3049,6 +3425,7 @@ def main() -> int:
         ccrec = _emit(bench_concurrency_serving())
         trec = _emit(bench_telemetry_serving())
         orec = _emit(bench_overload_serving())
+        erec = _emit(bench_engine_serving())
         # the gate covers ALL arms: the prefix-cache contract, the
         # ISSUE-3 quantized acceptance (token-identical greedy decode,
         # >= 1.8x sequence capacity at equal HBM budget), and the
@@ -3153,12 +3530,29 @@ def main() -> int:
             bool(orec.get("ttft_bounded")) and \
             bool(orec.get("faults_ok")) and \
             bool(orec.get("off_zero_alloc"))
+        # ISSUE-17 async-engine acceptance: greedy outputs identical
+        # through the engine in off AND strict modes, the strict run
+        # violation-free with a live /metrics + /enginez scraper,
+        # streamed TTFT present from the registry, and the overload
+        # burst tripping the goodput gate (shedding a low-priority
+        # probe), streaming without stalls to admitted callers, and
+        # recovering to open through the hysteresis
+        engine_ok = bool(erec.get("greedy_identical")) and \
+            erec.get("sanitizer_violations", 1) == 0 and \
+            erec.get("sanitizer_events", 0) > 0 and \
+            erec.get("scrapes", 0) > 0 and \
+            erec.get("ttft_p99_ms") is not None and \
+            bool(erec.get("bp_tripped")) and \
+            erec.get("bp_shed", 0) >= 1 and \
+            bool(erec.get("bp_recovered")) and \
+            bool(erec.get("stall_ok")) and \
+            bool(erec.get("burst", {}).get("all_completed"))
         ok = bool(rec.get("greedy_identical")) and \
             rec.get("prefill_skip_frac", 0.0) >= 0.5 and \
             qrec.get("greedy_match_rate", 0.0) >= 1.0 and \
             qrec.get("seq_capacity_ratio", 0.0) >= 1.8 and \
             chunk_ok and ragged_ok and san_ok and conc_ok and \
-            tel_ok and over_ok
+            tel_ok and over_ok and engine_ok
         _emit({"metric": "serving_prefix_cache",
                "value": rec.get("prefill_skip_frac", 0.0),
                "unit": "prefill_skip_frac",
@@ -3236,6 +3630,20 @@ def main() -> int:
                "overload_faults_ok": bool(orec.get("faults_ok")),
                "overload_off_zero_alloc":
                    bool(orec.get("off_zero_alloc")),
+               "engine_overhead_pct":
+                   erec.get("engine_overhead_pct"),
+               "engine_ttft_p50_ms": erec.get("ttft_p50_ms"),
+               "engine_ttft_p99_ms": erec.get("ttft_p99_ms"),
+               "engine_delivery_lag_p99_ms":
+                   erec.get("delivery_lag_p99_ms"),
+               "engine_scrapes": erec.get("scrapes", 0),
+               "engine_sanitizer_violations":
+                   erec.get("sanitizer_violations", -1),
+               "engine_bp_tripped": bool(erec.get("bp_tripped")),
+               "engine_bp_shed": erec.get("bp_shed", 0),
+               "engine_bp_recovered":
+                   bool(erec.get("bp_recovered")),
+               "engine_stall_ok": bool(erec.get("stall_ok")),
                "artifact": os.path.basename(_SERVING_FILE),
                "git_rev": _git_rev()})
         return 0

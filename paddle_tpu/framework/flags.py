@@ -455,6 +455,63 @@ define_flag("serving_fault_seed", 0,
             "injection harness's randomized mode: same seed + same "
             "step count -> the identical fault schedule, so every "
             "injected-fault run is replayable)")
+define_flag("engine_goodput_low", 0.75,
+            "trip threshold for the ServingEngine admission gate "
+            "(inference/engine.py): when the live serving.goodput "
+            "windowed gauge falls below this fraction (and the SLO "
+            "window holds at least FLAGS_engine_min_window "
+            "requests), the gate counts a bad signal toward "
+            "escalating backpressure (open -> shed -> clamp). Must "
+            "be < FLAGS_engine_goodput_high — the gap is the "
+            "hysteresis band in which the gate holds state")
+define_flag("engine_goodput_high", 0.9,
+            "recovery threshold for the ServingEngine admission "
+            "gate: goodput at or above this fraction (with no fresh "
+            "watchdog events) counts a good signal toward de-"
+            "escalating backpressure one level. Goodput between "
+            "FLAGS_engine_goodput_low and this value is the "
+            "hysteresis band: both trip and recovery streaks freeze "
+            "so the gate doesn't flap at a single threshold")
+define_flag("engine_min_window", 4,
+            "minimum serving.slo_window_requests before the "
+            "ServingEngine admission gate trusts the goodput gauge: "
+            "with fewer retired requests in the SLO window the "
+            "goodput signal is noise (one slow request swings it to "
+            "0.0) and the gate ignores it. Watchdog-event signals "
+            "are not window-gated")
+define_flag("engine_trip_steps", 2,
+            "consecutive bad gate evaluations (goodput below "
+            "FLAGS_engine_goodput_low, or fresh watchdog events in "
+            "the six overload classes) required before the "
+            "ServingEngine escalates backpressure one level — the "
+            "trip half of the gate's hysteresis")
+define_flag("engine_recover_steps", 4,
+            "consecutive good gate evaluations (goodput at or above "
+            "FLAGS_engine_goodput_high or no SLO signal, and no "
+            "fresh watchdog events) required before the "
+            "ServingEngine de-escalates backpressure one level — "
+            "deliberately larger than FLAGS_engine_trip_steps so "
+            "recovery is slower than tripping")
+define_flag("engine_gate_stride", 2,
+            "the ServingEngine re-evaluates its admission gate "
+            "every this-many pump steps: the SLO gauges it reads "
+            "are themselves windowed per scheduler step, so "
+            "per-step evaluation buys nothing and doubles the "
+            "gauge-read overhead on the pump thread")
+define_flag("engine_shed_keep_priority", 1,
+            "priority floor while the ServingEngine gate is in the "
+            "shed state: submissions with request.priority below "
+            "this value are rejected with EngineOverloadError "
+            "(lowest-priority admissions shed first); at or above "
+            "it they are still admitted. The clamp state rejects "
+            "all new admissions regardless of priority")
+define_flag("engine_idle_wait_s", 0.002,
+            "how long the ServingEngine pump thread parks on its "
+            "wake event when the scheduler has no queued, active, "
+            "or swapped work: long enough to avoid a busy spin, "
+            "short enough that a submit landing between the inbox "
+            "drain and the wait (which also sets the event) is "
+            "picked up immediately")
 if os.environ.get("FLAGS_flash_pallas_interpret"):
     # pre-rename env alias (was flash-only before covering all kernels)
     _REGISTRY["pallas_interpret"] = True

@@ -74,6 +74,7 @@ _INDEX = (
     ("/planz", "resource plans + perf-ledger plan-vs-actual"),
     ("/flagz", "FLAGS registry snapshot"),
     ("/incidentz", "incident bundles; ?bundle=<name> to replay one"),
+    ("/enginez", "async serving engines: pump, streams, backpressure"),
 )
 
 
@@ -99,6 +100,7 @@ class OpsServer:
         self._traces = traces
         self._ledger = ledger
         self._providers: Dict[str, Callable[[], Optional[dict]]] = {}
+        self._eproviders: Dict[str, Callable[[], Optional[dict]]] = {}
         self._plock = _concurrency.guarded("ops_server.providers")
         _csan = _concurrency.sanitizer()
         self._cv = None if _csan is None else _csan.shared(
@@ -147,6 +149,17 @@ class OpsServer:
         able dict (or None to drop the section). Bound methods are
         held by weakref — a garbage-collected scheduler silently
         leaves the page instead of being pinned alive by it."""
+        self._add_provider(self._providers, key, fn)
+
+    def add_engine_provider(self, key: str,
+                            fn: Callable[[], Optional[dict]]) -> None:
+        """Register a ``/enginez`` section (one per ServingEngine):
+        same contract and weakref semantics as
+        ``add_status_provider`` — a garbage-collected engine drops
+        off the page instead of being pinned alive by it."""
+        self._add_provider(self._eproviders, key, fn)
+
+    def _add_provider(self, store, key, fn) -> None:
         try:
             wm = weakref.WeakMethod(fn)
 
@@ -158,14 +171,20 @@ class OpsServer:
         with self._plock:
             if self._cv is not None:
                 self._cv.write()
-            self._providers[str(key)] = wrapped
+            store[str(key)] = wrapped
 
     def _status_sections(self) -> Dict[str, dict]:
+        return self._sections(self._providers)
+
+    def _engine_sections(self) -> Dict[str, dict]:
+        return self._sections(self._eproviders)
+
+    def _sections(self, store) -> Dict[str, dict]:
         out = {}
         with self._plock:
             if self._cv is not None:
                 self._cv.read()
-            items = list(self._providers.items())
+            items = list(store.items())
         dead = []
         for key, fn in items:
             try:
@@ -181,7 +200,7 @@ class OpsServer:
                 if self._cv is not None:
                     self._cv.write()
                 for key in dead:
-                    self._providers.pop(key, None)
+                    store.pop(key, None)
         return out
 
     # -- live handles (re-read per request) ---------------------------------
@@ -220,6 +239,7 @@ class OpsServer:
             "/planz": self._page_planz,
             "/flagz": self._page_flagz,
             "/incidentz": self._page_incidentz,
+            "/enginez": self._page_enginez,
         }.get(parsed.path)
         if route is None:
             self._send(h, 404, "text/plain",
@@ -297,6 +317,29 @@ class OpsServer:
                     if k in serving:
                         lines.append("  %-24s %s" % (k, serving[k]))
         sections = self._status_sections()
+        for key in sorted(sections):
+            lines.append("")
+            lines.append(key)
+            lines.append(json.dumps(sections[key], indent=1,
+                                    default=str, sort_keys=True))
+        return 200, "text/plain", "\n".join(lines) + "\n"
+
+    def _page_enginez(self, q):
+        reg = self._reg()
+        lines = ["paddle-tpu enginez", ""]
+        if reg is not None:
+            eng = reg.snapshot().get("engine", {}) or {}
+            keys = ("backpressure_state", "inflight_streams",
+                    "submitted", "shed_total", "cancelled")
+            if any(k in eng for k in keys):
+                lines.append("engine metrics")
+                for k in keys:
+                    if k in eng:
+                        lines.append("  %-24s %s" % (k, eng[k]))
+        sections = self._engine_sections()
+        if not sections:
+            lines.append("")
+            lines.append("(no live engines registered)")
         for key in sorted(sections):
             lines.append("")
             lines.append(key)

@@ -1577,6 +1577,28 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
      "fp wire bytes avoided by quantize-on-the-wire (fp payload "
      "minus quantized payload+sidecars; the live side of the "
      "planner's wire-savings assertion)"),
+    # async serving engine (inference/engine.py)
+    ("engine.backpressure_state", "gauge",
+     "ServingEngine admission-gate level: 0 open, 1 shed "
+     "(rejecting below FLAGS_engine_shed_keep_priority), 2 clamp "
+     "(rejecting all) — driven by live goodput + watchdog signals "
+     "with streak hysteresis"),
+    ("engine.inflight_streams", "gauge",
+     "TokenStreams currently open on the engine (submitted and not "
+     "yet retired/cancelled)"),
+    ("engine.shed_total", "counter",
+     "submissions rejected by the backpressure gate "
+     "(EngineOverloadError; shed + clamp states combined)"),
+    ("engine.submitted", "counter",
+     "requests admitted through the engine into the scheduler"),
+    ("engine.cancelled", "counter",
+     "engine-side cancellations (explicit stream.cancel() or "
+     "consumer disconnect) that reached the scheduler"),
+    ("engine.step_lag_s", "histogram",
+     "pump scheduling lag: host seconds between the end of one "
+     "scheduler.step() and the start of the next while work was "
+     "pending — the engine's 'no stall longer than one step wall' "
+     "acceptance signal"),
     # spans (trace mode)
     ("span:serving.step", "span", "one scheduler iteration"),
     ("span:serving.admit", "span", "admission pass of a step"),
@@ -1731,6 +1753,7 @@ _GAUGE_MERGE_SUM = frozenset({
     "serving.steps_per_s",
     "sanitizer.events", "sanitizer.violations",
     "ledger.programs",
+    "engine.inflight_streams",
 })
 _GAUGE_MERGE_MIN_PREFIXES = ("serving.goodput",
                              "serving.slo_attain_")

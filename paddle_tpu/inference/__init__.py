@@ -26,6 +26,10 @@ __all__ = [
     "RadixPrefixCache",
     "PrefixMatch",
     "bucket_packed_tokens",
+    "ServingEngine",
+    "TokenStream",
+    "EngineClosedError",
+    "EngineOverloadError",
 ]
 
 from .serving import (  # noqa: E402
@@ -34,6 +38,12 @@ from .serving import (  # noqa: E402
     Request,
     RequestState,
     bucket_packed_tokens,
+)
+from .engine import (  # noqa: E402
+    EngineClosedError,
+    EngineOverloadError,
+    ServingEngine,
+    TokenStream,
 )
 from .paged_llama import PagedLlamaAdapter  # noqa: E402
 from .prefix_cache import RadixPrefixCache, PrefixMatch  # noqa: E402

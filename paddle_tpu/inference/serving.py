@@ -1258,11 +1258,15 @@ class BatchScheduler:
         for req in [r for r in self._swapped.values() if gone(r)]:
             self._abort_deadline(req, "swapped")
 
-    def _abort_deadline(self, req: "Request", where: str):
+    def _abort_deadline(self, req: "Request", where: str,
+                        reason: str = "deadline"):
         """Terminal deadline abort: release EVERY reservation this
         request holds (pins, pages, swap records), count it
         distinctly, and emit the terminal trace event. Lands in
-        ``result()`` with state ``aborted_deadline``."""
+        ``result()`` with state ``aborted_deadline``. ``reason``
+        only relabels the trace event (engine-side cancels reuse
+        this path with reason="cancelled"); the counter and SLO
+        accounting are identical — a cancel is an abort."""
         rid = req.req_id
         if self.prefix_cache is not None and req._prefix_path:
             self.prefix_cache.unpin(req._prefix_path)
@@ -1288,8 +1292,55 @@ class BatchScheduler:
         if self._traces is not None:
             self._traces.complete(
                 rid, "abort", telemetry.clock(), self._step_epoch,
-                reason="deadline", where=where,
+                reason=reason, where=where,
                 generated_tokens=len(req.generated_ids))
+
+    def expire_queued_deadlines(self) -> int:
+        """Abort *queued* requests whose deadline already passed,
+        without waiting for the next step boundary. The async
+        engine's pump calls this between steps so a request whose
+        ``deadline_s`` lapsed while waiting never burns a prefill
+        before aborting (still counted under
+        ``serving.aborted_deadline``). Must run on the stepping
+        thread — it mutates the single-writer queue/state vars.
+        Returns how many requests were aborted."""
+        if not self._deadline_seen or not self._queue:
+            return 0
+        now = telemetry.clock()
+        expired = [r for r in self._queue
+                   if r._t_deadline and now >= r._t_deadline]
+        for req in expired:
+            if self._cv_queue is not None:
+                self._cv_queue.write()
+            self._queue.remove(req)
+            self._abort_deadline(req, "queued")
+        return len(expired)
+
+    def cancel(self, req_id: str, reason: str = "cancelled") -> bool:
+        """Abort one request by id wherever it currently lives —
+        queued, active mid-generation, or swapped out — releasing
+        every reservation it holds, exactly like a deadline abort
+        (same counter, same SLO miss accounting, same terminal
+        ``aborted_deadline`` state; the trace event carries
+        ``reason``). The async engine routes caller cancellation /
+        client disconnect here. Must run on the stepping thread.
+        Returns False when the id is unknown or already terminal."""
+        for req in self._queue:
+            if req.req_id == req_id:
+                if self._cv_queue is not None:
+                    self._cv_queue.write()
+                self._queue.remove(req)
+                self._abort_deadline(req, "queued", reason=reason)
+                return True
+        if req_id in self._active:
+            self._abort_deadline(self._active[req_id], "active",
+                                 reason=reason)
+            return True
+        if req_id in self._swapped:
+            self._abort_deadline(self._swapped[req_id], "swapped",
+                                 reason=reason)
+            return True
+        return False
 
     def _slo_note_abort(self, req: "Request"):
         """A deadline abort is an SLO MISS by definition: it enters
@@ -2197,6 +2248,13 @@ class BatchScheduler:
     @property
     def num_swapped(self):
         return len(self._swapped)
+
+    @property
+    def watchdog(self):
+        """The scheduler's Watchdog (or None when telemetry/watchdog
+        is off) — read-only; the engine's admission gate polls its
+        ``summary()['by_class']`` counts for fresh events."""
+        return self._watchdog
 
     def result(self, req_id: str) -> Request:
         return self._finished[req_id]

@@ -1562,3 +1562,91 @@ class TestConcurrencyThreadDiscipline:
     def test_rule_inventory_has_thread_discipline(self):
         assert any(rid == "concurrency-thread-discipline"
                    for rid, _ in lint_codebase.RULES)
+
+
+class TestEngineDiscipline:
+    """Engine-discipline composite rule (ISSUE 17): scheduler.step()
+    only from _pump* functions, spawn_thread-only thread creation,
+    and guarded-by declarations — applied to inference/engine.py."""
+
+    def test_seeded_step_outside_pump_flagged(self):
+        bad = (
+            "class Engine:\n"
+            "    async def submit(self, req):\n"
+            "        self.scheduler.submit(req)\n"
+            "        self.scheduler.step()\n"
+        )
+        v = lint_codebase.lint_engine_discipline_file(
+            "fake/engine.py", text=bad)
+        assert len(v) == 1, v
+        assert "single-writer" in v[0]
+
+    def test_seeded_step_in_nested_helper_flagged(self):
+        bad = (
+            "def _drive(sched):\n"
+            "    def crank():\n"
+            "        sched.step()\n"
+            "    crank()\n"
+        )
+        v = lint_codebase.lint_engine_discipline_file(
+            "fake/engine.py", text=bad)
+        assert len(v) == 1, v
+
+    def test_step_inside_pump_clean(self):
+        ok = (
+            "class Engine:\n"
+            "    def _pump_main(self):\n"
+            "        while True:\n"
+            "            self.scheduler.step()\n"
+            "    def _pump_iteration(self):\n"
+            "        def crank():\n"
+            "            self.scheduler.step()\n"
+            "        crank()\n"
+        )
+        assert lint_codebase.lint_engine_discipline_file(
+            "fake/engine.py", text=ok) == []
+
+    def test_waiver_suppresses_step_rule(self):
+        ok = (
+            "def drive(sched):\n"
+            "    sched.step()  # trace-lint: ok(test harness)\n"
+        )
+        assert lint_codebase.lint_engine_discipline_file(
+            "fake/engine.py", text=ok) == []
+
+    def test_composes_thread_discipline(self):
+        bad = (
+            "import threading\n"
+            "def _pump_main(self):\n"
+            "    threading.Thread(target=print).start()\n"
+        )
+        v = lint_codebase.lint_engine_discipline_file(
+            "fake/engine.py", text=bad)
+        assert len(v) == 1, v
+        assert "spawn_thread" in v[0]
+
+    def test_composes_guarded_by(self):
+        bad = (
+            "_SEQ = [0]\n"
+            "def bump():\n"
+            "    _SEQ[0] += 1\n"
+        )
+        v = lint_codebase.lint_engine_discipline_file(
+            "fake/engine.py", text=bad)
+        assert len(v) == 1, v
+        assert "guarded-by" in v[0]
+
+    def test_engine_file_owned_here_not_by_concurrency_lists(self):
+        # the composite rule owns engine.py; the generic lists must
+        # not double-report the same findings
+        assert lint_codebase.ENGINE_FILE not in \
+            lint_codebase.CONCURRENCY_FILES
+        assert lint_codebase.ENGINE_FILE not in \
+            lint_codebase.THREAD_DISCIPLINE_FILES
+        assert os.path.exists(
+            os.path.join(REPO, lint_codebase.ENGINE_FILE))
+        assert lint_codebase.check_engine_discipline() == []
+
+    def test_rule_inventory_has_engine_discipline(self):
+        assert any(rid == "engine-discipline"
+                   for rid, _ in lint_codebase.RULES)

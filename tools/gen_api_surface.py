@@ -65,6 +65,7 @@ NAMESPACES = [
     "paddle_tpu.quantization",
     "paddle_tpu.ops.kernels",
     "paddle_tpu.inference",
+    "paddle_tpu.inference.engine",
     "paddle_tpu.framework.telemetry",
     "paddle_tpu.framework.concurrency",
     "paddle_tpu.framework.watchdog",

@@ -2298,6 +2298,79 @@ def check_thread_discipline(root=REPO):
     return out
 
 
+# the async serving engine's own discipline: the scheduler registers
+# its queue/state as SINGLE-WRITER shared vars, so every
+# scheduler.step() in engine.py must come from the pump thread's
+# functions (def _pump_*) — a step from submit()/a handler/a helper
+# is the exact multi-writer hazard the engine exists to prevent
+ENGINE_FILE = "paddle_tpu/inference/engine.py"
+
+
+class _EngineStepVisitor(ast.NodeVisitor):
+    """Flags ``<x>.step(...)`` calls outside ``_pump*`` functions."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+        self._func_stack = []
+
+    def _in_pump(self):
+        return any(n.startswith("_pump") for n in self._func_stack)
+
+    def visit_FunctionDef(self, node):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "step" \
+                and not self._in_pump():
+            line = self.lines[node.lineno - 1] \
+                if node.lineno - 1 < len(self.lines) else ""
+            if _WAIVER_MARK not in line:
+                self.violations.append(
+                    "%s:%d: scheduler.step() outside a _pump* "
+                    "function — the scheduler's queue/state are "
+                    "single-writer shared vars owned by the pump "
+                    "thread; stepping from anywhere else is a "
+                    "multi-writer race (marshal an op to the pump "
+                    "instead, or waive with '%s(<reason>)')"
+                    % (self.relpath, node.lineno, _WAIVER_MARK))
+        self.generic_visit(node)
+
+
+def lint_engine_discipline_file(path, text=None):
+    """Engine-discipline check for one file: the step-only-in-pump
+    rule plus the thread-discipline and guarded-by rules (the engine
+    is a host-plane module but is owned by this composite rule, not
+    the CONCURRENCY_FILES lists, so each finding is reported once)."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _EngineStepVisitor(rel, text.splitlines())
+    v.visit(tree)
+    out = list(v.violations)
+    out.extend(lint_thread_discipline_file(path, text))
+    out.extend(lint_guarded_by_file(path, text))
+    return out
+
+
+def check_engine_discipline(root=REPO):
+    path = os.path.join(root, ENGINE_FILE)
+    if not os.path.exists(path):
+        return []
+    return lint_engine_discipline_file(path)
+
+
 # rule inventory: (rule id, one-line summary) for every AST check in
 # this linter — merged into `python -m paddle_tpu.framework.analysis
 # --rules` alongside the jaxpr rules and the page-sanitizer violation
@@ -2404,6 +2477,12 @@ RULES = (
      "concurrency.spawn_thread (named daemon threads, "
      "sanitizer-registered with a parent->child happens-before "
      "edge) — never raw threading.Thread"),
+    ("engine-discipline",
+     "inference/engine.py: scheduler.step() is called ONLY from "
+     "pump-thread functions (def _pump_*) — anywhere else breaks "
+     "the scheduler's single-writer contract; plus the thread-"
+     "discipline (spawn_thread only) and guarded-by (module state "
+     "declares its guard) rules applied to the engine module"),
 )
 
 
@@ -2427,6 +2506,7 @@ def run_lint(root=REPO, with_op_table=True):
     out.extend(check_lock_order(root))
     out.extend(check_blocking_async(root))
     out.extend(check_thread_discipline(root))
+    out.extend(check_engine_discipline(root))
     if with_op_table:
         out.extend(check_op_table())
         out.extend(check_inference_surface())
