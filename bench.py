@@ -2043,6 +2043,346 @@ def bench_engine_serving(users=4, prompt_len=48, new_tokens=8,
     return _merge_serving_rec("engine", rec)
 
 
+# aux: disaggregated serving — prefill/decode split + session router
+# ---------------------------------------------------------------------------
+
+
+def bench_disagg_serving(users=4, prompt_len=48, new_tokens=8,
+                         budget=32):
+    """Disaggregated-serving arm (ISSUE 18): the serving workload
+    run through inference.disagg on a dp x mp cpu-mesh layout —
+    a SessionRouter spreading sessions round-robin over dp=2
+    replicas, each request prefilled on that replica's prefill
+    scheduler, its int8 page chains shipped over the versioned
+    HostKVSwapSpace wire format split into mp=2 shard payloads
+    (payload + scale sidecars, bitwise), and adopted by the same
+    replica's decode engine. Gates: (1) streamed outputs greedy-
+    identical to the single-box sync run for every session; (2) one
+    request renders as ONE stitched trace — its serving.handoff_out
+    (prefill box) and serving.swap_in (decode box) spans share a
+    single trace id, for every session; (3) per-role planner budgets
+    enforced in strict mode — an absurd FLAGS_disagg_<role>_budget_
+    hbm fails the attend-program plan with JitPlanError, a generous
+    one passes, for both roles; (4) a two-phase role-split run emits
+    a role-labelled aggregated fleet exposition (prefill0/decode0
+    worker series) with handoff-out counters on the prefill worker
+    and handoff-in on the decode worker. Results land under "disagg"
+    in BENCH_SERVING_LAST.json."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import planner as _planner
+    from paddle_tpu.framework import telemetry
+    from paddle_tpu.framework.flags import flag, set_flags
+    from paddle_tpu.inference import (
+        BatchScheduler,
+        DecodeWorker,
+        DisaggReplica,
+        PagedLlamaAdapter,
+        PrefillWorker,
+        Request,
+        ServingEngine,
+        SessionRouter,
+        SessionStream,
+        apply_role_budgets,
+        role_scheduler_kwargs,
+    )
+    from paddle_tpu.incubate.nn.paged_cache import SWAP_WIRE_MAGIC
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    kind = _device_kind()
+    cpu = kind.startswith("cpu")
+    page_size = 4
+    if cpu:
+        users, prompt_len, new_tokens = 4, 32, 6
+        cfg = llama_tiny(num_hidden_layers=2,
+                         max_position_embeddings=256)
+    else:
+        cfg = llama_tiny(
+            hidden_size=512, intermediate_size=1024,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=2048,
+        )
+        page_size = 16
+    dp, mp_shards = 2, 2
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(users)]
+    pages_per_seq = -(-(prompt_len + new_tokens) // page_size)
+    num_pages = 2 * users * pages_per_seq + 16
+
+    def mk_adapter():
+        return PagedLlamaAdapter(
+            model, num_pages=num_pages, page_size=page_size,
+            max_length=cfg.max_position_embeddings,
+            kv_cache_dtype="int8")
+
+    def mk_sched(role):
+        kw = role_scheduler_kwargs(role)
+        if role == "prefill":
+            kw["chunked_prefill"] = True
+        return BatchScheduler(mk_adapter(), max_batch_size=users,
+                              preempt=True, swap_bytes=64 << 20,
+                              **kw)
+
+    def run_single():
+        # the reference every disagg session must match token-for-
+        # token: same weights, one box, hand-cranked sync loop
+        set_flags({"telemetry": "metrics"})
+        telemetry.reset()
+        sched = BatchScheduler(mk_adapter(), max_batch_size=users,
+                               chunked_prefill=True,
+                               prefill_chunk_tokens=budget)
+        for i, p in enumerate(prompts):
+            sched.submit(Request(f"r{i}", list(p),
+                                 max_new_tokens=new_tokens))
+        while sched.num_active or sched.num_queued:
+            sched.step()
+        return {f"r{i}": list(sched.result(f"r{i}").generated_ids)
+                for i in range(users)}
+
+    def assert_role_budgets():
+        # strict-mode per-role planner budgets: plan the decode
+        # attend program (page pools ride as consts) under each
+        # role's budget — absurd budget must FAIL the plan, generous
+        # must pass; the role flags really steer the planner
+        adapter = mk_adapter()
+        c0 = adapter.caches[0]
+        seq = "__plan_probe__"
+        c0.alloc(seq)
+        kvh, hd = c0.k_pages.shape[2], c0.k_pages.shape[3]
+        c0.append(seq, jnp.zeros((kvh, hd), jnp.float32),
+                  jnp.zeros((kvh, hd), jnp.float32))
+        nh = cfg.num_attention_heads
+        qs = jax.ShapeDtypeStruct((1, 1, nh, hd), jnp.float32)
+        closed = jax.make_jaxpr(
+            lambda q: c0.attend_ragged(
+                q, [seq], [1], rows_pad=1, max_pages=4)._data)(qs)
+        out = {}
+        for role in ("prefill", "decode"):
+            set_flags({f"disagg_{role}_budget_hbm": 1})
+            applied = apply_role_budgets(role)
+            assert applied == {"jit_budget_hbm": 1}, applied
+            _, report = _planner.plan_jaxpr(
+                closed, name=f"disagg_{role}_attend")
+            tripped = False
+            try:
+                _planner.emit_plan_report(report, "strict")
+            except _planner.JitPlanError:
+                tripped = True
+            assert tripped, (
+                f"{role}: 1-byte role HBM budget did not fail the "
+                "strict plan")
+            set_flags({f"disagg_{role}_budget_hbm": 1 << 40,
+                       f"disagg_{role}_budget_comm": 1 << 40})
+            applied = apply_role_budgets(role)
+            assert set(applied) == {"jit_budget_hbm",
+                                    "jit_budget_comm"}
+            _, report = _planner.plan_jaxpr(
+                closed, name=f"disagg_{role}_attend")
+            _planner.emit_plan_report(report, "strict")  # must pass
+            out[role] = {"strict_trip": True, "strict_pass": True}
+        c0.free(seq)
+        return out
+
+    def run_router(single):
+        # dp=2 replicas behind the router, mp=2 shard payloads on
+        # the wire, full trace mode for the stitching assert
+        set_flags({"telemetry": "trace",
+                   "disagg_mp_shards": mp_shards,
+                   "disagg_router_policy": "rr",
+                   "disagg_prefill_chunk_tokens": budget})
+        telemetry.reset()
+        out = {}
+
+        async def main():
+            scheds = [(mk_sched("prefill"), mk_sched("decode"))
+                      for _ in range(dp)]
+            async with ServingEngine(scheds[0][1]) as e0, \
+                    ServingEngine(scheds[1][1]) as e1:
+                engines = [e0, e1]
+                router = SessionRouter(
+                    [DisaggReplica(f"rep{i}", scheds[i][0],
+                                   engines[i])
+                     for i in range(dp)])
+                # wire probe: one manual handoff exposes the shard
+                # payloads the router path ships (same machinery)
+                probe = Request("probe0", list(prompts[0]),
+                                max_new_tokens=new_tokens)
+                kind_, env = PrefillWorker(
+                    scheds[0][0], mp_shards=mp_shards).run(probe)
+                assert kind_ == "handoff"
+                out["shard_payloads"] = len(env["payloads"])
+                out["wire_bytes"] = sum(
+                    len(p) for p in env["payloads"])
+                assert all(p[:4] == SWAP_WIRE_MAGIC
+                           for p in env["payloads"])
+                stream = await DecodeWorker(e0).adopt(env)
+                psess = SessionStream(
+                    list(env["req"]["generated_ids"]), stream,
+                    stream.req)
+                sessions = []
+                for i, p in enumerate(prompts):
+                    sessions.append(await router.submit(Request(
+                        f"r{i}", list(p),
+                        max_new_tokens=new_tokens)))
+                toks = await asyncio.gather(
+                    psess.tokens(),
+                    *(s.tokens() for s in sessions))
+                out["probe_gen"] = toks[0]
+                out["gen"] = {f"r{i}": toks[1 + i]
+                              for i in range(users)}
+                out["adopted"] = [e._adopted for e in engines]
+                out["routerz"] = router._routerz_info()
+            return out
+
+        asyncio.run(asyncio.wait_for(main(), timeout=300))
+        snap = telemetry.registry().snapshot()
+        srv = snap.get("serving", {})
+        out["handoff_out"] = int(srv.get("handoff_out_requests", 0))
+        out["handoff_in"] = int(srv.get("handoff_in_requests", 0))
+        out["bytes_out"] = int(srv.get("handoff_out_bytes", 0))
+        out["bytes_in"] = int(srv.get("handoff_in_bytes", 0))
+        out["router_replicas"] = snap.get(
+            "router", {}).get("replicas")
+        # ONE stitched trace per session: the prefill-box
+        # handoff_out span and the decode-box swap_in span share a
+        # single trace id
+        by_trace = {}
+        for s in telemetry.tracer().spans():
+            if s.name in ("serving.handoff_out", "serving.swap_in"):
+                by_trace.setdefault(s.trace_id, set()).add(s.name)
+        out["stitched_traces"] = sum(
+            1 for names in by_trace.values()
+            if names >= {"serving.handoff_out", "serving.swap_in"})
+        out["greedy_identical"] = (
+            out["gen"] == single
+            and out["probe_gen"] == single["r0"])
+        return out
+
+    def run_roles(single):
+        # two-phase role split for the fleet exposition: every
+        # prefill leg on a prefill-role world, snapshot, fresh
+        # telemetry world, every decode leg on a decode-role world —
+        # then the aggregator merges the two snapshots with
+        # role-labelled worker series
+        set_flags({"telemetry": "metrics",
+                   "disagg_mp_shards": mp_shards,
+                   "disagg_prefill_chunk_tokens": budget})
+        telemetry.reset()
+        apply_role_budgets("prefill")
+        sp = mk_sched("prefill")
+        envelopes = []
+        for i, p in enumerate(prompts):
+            req = Request(f"r{i}", list(p),
+                          max_new_tokens=new_tokens)
+            kind_, env = PrefillWorker(sp).run(req)
+            assert kind_ == "handoff", kind_
+            envelopes.append(env)
+        pre_snap = telemetry.registry().snapshot()
+        telemetry.reset()  # the decode "host" is a separate world
+        apply_role_budgets("decode")
+        sd = mk_sched("decode")
+
+        async def drain():
+            gen = {}
+            async with ServingEngine(sd) as eng:
+                dw = DecodeWorker(eng)
+                sess = []
+                for env in envelopes:
+                    stream = await dw.adopt(env)
+                    sess.append(SessionStream(
+                        list(env["req"]["generated_ids"]), stream,
+                        stream.req))
+                for s in sess:
+                    gen[s.req_id] = await s.tokens()
+            return gen
+
+        gen = asyncio.run(asyncio.wait_for(drain(), timeout=300))
+        dec_snap = telemetry.registry().snapshot()
+        text = telemetry.merged_prometheus_text(
+            {"prefill0": pre_snap, "decode0": dec_snap})
+        n_out = int(pre_snap["serving"]["handoff_out_requests"])
+        n_in = int(dec_snap["serving"]["handoff_in_requests"])
+        labels_ok = (
+            'paddle_serving_handoff_out_requests{worker="prefill0"}'
+            f" {n_out}" in text
+            and 'paddle_serving_handoff_in_requests'
+            f'{{worker="decode0"}} {n_in}' in text
+            and 'paddle_engine_adopted{worker="decode0"}' in text)
+        return {
+            "greedy_identical": gen == single,
+            "handoff_out": n_out,
+            "handoff_in": n_in,
+            "role_labels_ok": bool(labels_ok),
+            "merge_kinds": {
+                "router.sessions": telemetry.gauge_merge_kind(
+                    "router.sessions"),
+                "engine.backpressure_state":
+                    telemetry.gauge_merge_kind(
+                        "engine.backpressure_state"),
+            },
+        }
+
+    saved = {k: flag(k) for k in (
+        "jit_budget_hbm", "jit_budget_comm", "disagg_mp_shards",
+        "disagg_router_policy", "disagg_prefill_chunk_tokens",
+        "disagg_prefill_budget_hbm", "disagg_prefill_budget_comm",
+        "disagg_decode_budget_hbm", "disagg_decode_budget_comm")}
+    try:
+        single = run_single()
+        budgets = assert_role_budgets()
+        t0 = time.perf_counter()
+        router = run_router(single)
+        router_wall = time.perf_counter() - t0
+        roles = run_roles(single)
+    finally:
+        set_flags(dict(saved, telemetry="off"))
+        telemetry.reset()
+    n_handoffs = users + 1  # the router sessions + the wire probe
+    rec = {
+        "config": "serving_disagg",
+        "mode": "tpu-single-chip" if not cpu else "cpu",
+        "users": users,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "dp": dp,
+        "mp_shards": mp_shards,
+        "greedy_identical": bool(router["greedy_identical"]
+                                 and roles["greedy_identical"]),
+        "shard_payloads": router["shard_payloads"],
+        "wire_bytes_per_request": router["wire_bytes"],
+        "handoff_out": router["handoff_out"],
+        "handoff_in": router["handoff_in"],
+        "handoff_bytes_match":
+            router["bytes_out"] == router["bytes_in"] > 0,
+        "handoffs_complete":
+            router["handoff_out"] == router["handoff_in"]
+            == n_handoffs,
+        "stitched_traces": router["stitched_traces"],
+        "one_trace_per_session":
+            router["stitched_traces"] == n_handoffs,
+        "rr_spread": router["adopted"],
+        # rr over dp=2: users split evenly, +1 on rep0 for the probe
+        "rr_balanced": sorted(router["adopted"]) == [
+            users // 2, users // 2 + 1],
+        "router_replicas": router["router_replicas"],
+        "routerz": router["routerz"],
+        "router_wall_s": round(router_wall, 3),
+        "tok_s": round(users * new_tokens / max(router_wall, 1e-9),
+                       1),
+        "role_budgets": budgets,
+        "role_labels_ok": bool(roles["role_labels_ok"]),
+        "merge_kinds": roles["merge_kinds"],
+    }
+    return _merge_serving_rec("disagg", rec)
+
+
 # aux: runtime-telemetry overhead — trace spans + metrics vs off
 # ---------------------------------------------------------------------------
 
@@ -3396,7 +3736,11 @@ def main() -> int:
                          "fault injection), and the async-engine "
                          "arm (sync loop vs ServingEngine streams "
                          "+ goodput-gated admission under an "
-                         "overload burst); emits "
+                         "overload burst), and the disaggregated "
+                         "arm (dp x mp prefill/decode split behind "
+                         "a session router, sharded page-chain "
+                         "transfers, stitched cross-worker traces, "
+                         "per-role planner budgets); emits "
                          "BENCH_SERVING_LAST.json")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--seq", type=int, default=2048)
@@ -3426,6 +3770,7 @@ def main() -> int:
         trec = _emit(bench_telemetry_serving())
         orec = _emit(bench_overload_serving())
         erec = _emit(bench_engine_serving())
+        drec = _emit(bench_disagg_serving())
         # the gate covers ALL arms: the prefix-cache contract, the
         # ISSUE-3 quantized acceptance (token-identical greedy decode,
         # >= 1.8x sequence capacity at equal HBM budget), and the
@@ -3547,12 +3892,30 @@ def main() -> int:
             bool(erec.get("bp_recovered")) and \
             bool(erec.get("stall_ok")) and \
             bool(erec.get("burst", {}).get("all_completed"))
+        # ISSUE-18 disaggregated-serving acceptance: every routed
+        # session greedy-identical to the single-box run, the wire
+        # split into the configured mp shard payloads, every handoff
+        # rendering as ONE stitched trace (handoff_out + swap_in
+        # spans under a single trace id), round-robin balanced over
+        # the dp replicas, per-role planner budgets enforced in
+        # strict mode, and the two-phase role run emitting a role-
+        # labelled aggregated exposition
+        disagg_ok = bool(drec.get("greedy_identical")) and \
+            drec.get("shard_payloads") == drec.get("mp_shards") and \
+            bool(drec.get("handoffs_complete")) and \
+            bool(drec.get("handoff_bytes_match")) and \
+            bool(drec.get("one_trace_per_session")) and \
+            bool(drec.get("rr_balanced")) and \
+            all(v.get("strict_trip") and v.get("strict_pass")
+                for v in drec.get("role_budgets", {}).values()) and \
+            len(drec.get("role_budgets", {})) == 2 and \
+            bool(drec.get("role_labels_ok"))
         ok = bool(rec.get("greedy_identical")) and \
             rec.get("prefill_skip_frac", 0.0) >= 0.5 and \
             qrec.get("greedy_match_rate", 0.0) >= 1.0 and \
             qrec.get("seq_capacity_ratio", 0.0) >= 1.8 and \
             chunk_ok and ragged_ok and san_ok and conc_ok and \
-            tel_ok and over_ok and engine_ok
+            tel_ok and over_ok and engine_ok and disagg_ok
         _emit({"metric": "serving_prefix_cache",
                "value": rec.get("prefill_skip_frac", 0.0),
                "unit": "prefill_skip_frac",
@@ -3644,6 +4007,16 @@ def main() -> int:
                "engine_bp_recovered":
                    bool(erec.get("bp_recovered")),
                "engine_stall_ok": bool(erec.get("stall_ok")),
+               "disagg_greedy_identical":
+                   bool(drec.get("greedy_identical")),
+               "disagg_shard_payloads": drec.get("shard_payloads"),
+               "disagg_stitched_traces":
+                   drec.get("stitched_traces"),
+               "disagg_wire_bytes_per_request":
+                   drec.get("wire_bytes_per_request"),
+               "disagg_rr_spread": drec.get("rr_spread"),
+               "disagg_role_labels_ok":
+                   bool(drec.get("role_labels_ok")),
                "artifact": os.path.basename(_SERVING_FILE),
                "git_rev": _git_rev()})
         return 0

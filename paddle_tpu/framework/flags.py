@@ -512,6 +512,50 @@ define_flag("engine_idle_wait_s", 0.002,
             "short enough that a submit landing between the inbox "
             "drain and the wait (which also sets the event) is "
             "picked up immediately")
+define_flag("disagg_router_policy", "rr",
+            "replica-selection policy for the disaggregated "
+            "SessionRouter (inference/disagg.py): 'rr' round-robins "
+            "new sessions over the DP replicas; 'least' picks the "
+            "replica with the fewest live sessions (better under "
+            "skewed session lifetimes, one extra scan per submit)")
+define_flag("disagg_mp_shards", 1,
+            "KV-head shard count for the disaggregated page-chain "
+            "transfer (incubate/nn/paged_cache.py export_seq): a "
+            "handed-off chain is split into this many wire payloads "
+            "along the KV-head axis — one per mp-mesh shard on the "
+            "decode side — so each decode shard imports only the "
+            "heads it owns; must divide the pool's KV head count")
+define_flag("disagg_prefill_chunk_tokens", 0,
+            "chunked-prefill token budget override for PREFILL-role "
+            "schedulers in the disaggregated split (inference/"
+            "disagg.py): prefill workers run chunk-budget-heavy "
+            "steps, so this (when > 0) replaces the single-box "
+            "FLAGS_prefill_chunk_tokens on the prefill side only; "
+            "0 keeps the single-box value")
+define_flag("disagg_prefill_budget_hbm", 0,
+            "per-role override of FLAGS_jit_budget_hbm applied by "
+            "disagg.apply_role_budgets('prefill'): prefill workers "
+            "hold full prompt activations so their peak-live-HBM "
+            "budget differs from decode's; 0 leaves the global "
+            "budget untouched (strict mode still raises "
+            "JitPlanError on breach)")
+define_flag("disagg_prefill_budget_comm", 0,
+            "per-role override of FLAGS_jit_budget_comm applied by "
+            "disagg.apply_role_budgets('prefill'): the prefill "
+            "role's per-device collective-traffic budget in bytes; "
+            "0 leaves the global budget untouched")
+define_flag("disagg_decode_budget_hbm", 0,
+            "per-role override of FLAGS_jit_budget_hbm applied by "
+            "disagg.apply_role_budgets('decode'): decode workers "
+            "are KV-pool-dominated, so their peak-live-HBM budget "
+            "differs from prefill's; 0 leaves the global budget "
+            "untouched (strict mode still raises JitPlanError on "
+            "breach)")
+define_flag("disagg_decode_budget_comm", 0,
+            "per-role override of FLAGS_jit_budget_comm applied by "
+            "disagg.apply_role_budgets('decode'): the decode role's "
+            "per-device collective-traffic budget in bytes; 0 "
+            "leaves the global budget untouched")
 if os.environ.get("FLAGS_flash_pallas_interpret"):
     # pre-rename env alias (was flash-only before covering all kernels)
     _REGISTRY["pallas_interpret"] = True

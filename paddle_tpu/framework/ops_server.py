@@ -75,6 +75,7 @@ _INDEX = (
     ("/flagz", "FLAGS registry snapshot"),
     ("/incidentz", "incident bundles; ?bundle=<name> to replay one"),
     ("/enginez", "async serving engines: pump, streams, backpressure"),
+    ("/routerz", "disagg session routers: policy, replicas, sessions"),
 )
 
 
@@ -101,6 +102,7 @@ class OpsServer:
         self._ledger = ledger
         self._providers: Dict[str, Callable[[], Optional[dict]]] = {}
         self._eproviders: Dict[str, Callable[[], Optional[dict]]] = {}
+        self._rproviders: Dict[str, Callable[[], Optional[dict]]] = {}
         self._plock = _concurrency.guarded("ops_server.providers")
         _csan = _concurrency.sanitizer()
         self._cv = None if _csan is None else _csan.shared(
@@ -159,6 +161,14 @@ class OpsServer:
         off the page instead of being pinned alive by it."""
         self._add_provider(self._eproviders, key, fn)
 
+    def add_router_provider(self, key: str,
+                            fn: Callable[[], Optional[dict]]) -> None:
+        """Register a ``/routerz`` section (one per disaggregated
+        SessionRouter): same contract and weakref semantics as
+        ``add_status_provider`` — a garbage-collected router drops
+        off the page instead of being pinned alive by it."""
+        self._add_provider(self._rproviders, key, fn)
+
     def _add_provider(self, store, key, fn) -> None:
         try:
             wm = weakref.WeakMethod(fn)
@@ -178,6 +188,9 @@ class OpsServer:
 
     def _engine_sections(self) -> Dict[str, dict]:
         return self._sections(self._eproviders)
+
+    def _router_sections(self) -> Dict[str, dict]:
+        return self._sections(self._rproviders)
 
     def _sections(self, store) -> Dict[str, dict]:
         out = {}
@@ -240,6 +253,7 @@ class OpsServer:
             "/flagz": self._page_flagz,
             "/incidentz": self._page_incidentz,
             "/enginez": self._page_enginez,
+            "/routerz": self._page_routerz,
         }.get(parsed.path)
         if route is None:
             self._send(h, 404, "text/plain",
@@ -340,6 +354,29 @@ class OpsServer:
         if not sections:
             lines.append("")
             lines.append("(no live engines registered)")
+        for key in sorted(sections):
+            lines.append("")
+            lines.append(key)
+            lines.append(json.dumps(sections[key], indent=1,
+                                    default=str, sort_keys=True))
+        return 200, "text/plain", "\n".join(lines) + "\n"
+
+    def _page_routerz(self, q):
+        reg = self._reg()
+        lines = ["paddle-tpu routerz", ""]
+        if reg is not None:
+            rt = reg.snapshot().get("router", {}) or {}
+            keys = ("backpressure_state", "sessions", "replicas",
+                    "submitted", "cancelled")
+            if any(k in rt for k in keys):
+                lines.append("router metrics")
+                for k in keys:
+                    if k in rt:
+                        lines.append("  %-24s %s" % (k, rt[k]))
+        sections = self._router_sections()
+        if not sections:
+            lines.append("")
+            lines.append("(no live routers registered)")
         for key in sorted(sections):
             lines.append("")
             lines.append(key)

@@ -1599,6 +1599,49 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
      "scheduler.step() and the start of the next while work was "
      "pending — the engine's 'no stall longer than one step wall' "
      "acceptance signal"),
+    ("engine.adopted", "counter",
+     "handed-off requests adopted from prefill workers "
+     "(ServingEngine.adopt; registered swapped-out, restored on "
+     "the next step's swap-in path)"),
+    # disaggregated serving (inference/disagg.py + the page-chain
+    # wire transfer in incubate/nn/paged_cache.py)
+    ("serving.handoff_out_requests", "counter",
+     "prefill-complete requests exported off this box "
+     "(BatchScheduler.export_request; state -> migrated)"),
+    ("serving.handoff_out_bytes", "counter",
+     "wire payload bytes shipped by export_request (headers + "
+     "bitwise KV + int8 scale sidecars, all mp shards)"),
+    ("serving.handoff_in_requests", "counter",
+     "handed-off requests adopted by this box's scheduler "
+     "(adopt_swapped; decode resumes via the swap-in path)"),
+    ("serving.handoff_in_bytes", "counter",
+     "wire payload bytes received by adopt_swapped"),
+    ("pool.transfer_out_records", "counter",
+     "per-pool page-chain swap records serialized onto the wire "
+     "by HostKVSwapSpace.export_seq"),
+    ("pool.transfer_out_bytes", "counter",
+     "per-pool host bytes serialized onto the wire by export_seq"),
+    ("pool.transfer_in_records", "counter",
+     "per-pool page-chain swap records restored from wire "
+     "payloads by HostKVSwapSpace.import_seq"),
+    ("pool.transfer_in_bytes", "counter",
+     "per-pool host bytes restored from wire payloads by "
+     "import_seq"),
+    ("router.backpressure_state", "gauge",
+     "fleet-wide max of the replica engines' admission-gate "
+     "levels, republished by the SessionRouter (0 open, 1 shed, "
+     "2 clamp; merges as max — the fleet is as backpressured as "
+     "its worst worker)"),
+    ("router.sessions", "gauge",
+     "live routed sessions (decode legs not yet retired); merges "
+     "as sum across a fleet of routers"),
+    ("router.replicas", "gauge",
+     "DP replicas behind this router; merges as sum"),
+    ("router.submitted", "counter",
+     "sessions routed through SessionRouter.submit"),
+    ("router.cancelled", "counter",
+     "session cancels forwarded to a replica engine that still "
+     "knew the request"),
     # spans (trace mode)
     ("span:serving.step", "span", "one scheduler iteration"),
     ("span:serving.admit", "span", "admission pass of a step"),
@@ -1611,6 +1654,9 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
      "one victim's swap-out to the host tier (req/reason attrs)"),
     ("span:serving.swap_in", "span",
      "one sequence's bitwise restore from the host tier"),
+    ("span:serving.handoff_out", "span",
+     "one request's export off the box: swap-out + wire "
+     "serialization (req/shards attrs)"),
     ("span:jit.compile", "span",
      "one to_static trace (program/variant/n_eqns/lint attrs)"),
 )
@@ -1740,10 +1786,14 @@ def write_prometheus(path: str,
 
 # gauge merge semantics for merge_snapshots: counters always SUM and
 # histograms always merge their buckets; gauges must DECLARE how a
-# fleet combines them. Pool sizes and populations add across workers;
-# attainment fractions take the WORST worker (the conservative fleet
-# signal an admission controller should gate on); everything else —
-# utilizations, watermarks, epochs, uptimes — takes the max.
+# fleet combines them. Pool sizes and populations add across workers
+# (a mixed prefill/decode fleet's router.sessions is the total, not
+# any one worker's); attainment fractions take the WORST worker (the
+# conservative fleet signal an admission controller should gate on);
+# backpressure states take the max EXPLICITLY — the fleet is as
+# backpressured as its most backpressured worker, and a sum of enum
+# levels would be meaningless; everything else — utilizations,
+# watermarks, epochs, uptimes — takes the max by default.
 _GAUGE_MERGE_SUM = frozenset({
     "pool.total_pages", "pool.free_pages", "pool.shared_pages",
     "pool.used_bytes",
@@ -1754,19 +1804,28 @@ _GAUGE_MERGE_SUM = frozenset({
     "sanitizer.events", "sanitizer.violations",
     "ledger.programs",
     "engine.inflight_streams",
+    "router.sessions", "router.replicas",
 })
 _GAUGE_MERGE_MIN_PREFIXES = ("serving.goodput",
                              "serving.slo_attain_")
+_GAUGE_MERGE_MAX = frozenset({
+    "engine.backpressure_state",
+    "router.backpressure_state",
+})
 
 
 def gauge_merge_kind(name: str) -> str:
     """'sum' | 'min' | 'max' — how :func:`merge_snapshots` combines
     the gauge ``name`` across workers (see the declaration tables
-    above; 'max' is the default)."""
+    above; 'max' is the default). Membership in the explicit
+    ``_GAUGE_MERGE_MAX`` table distinguishes a DECLARED max (the
+    backpressure enums) from the fallthrough default."""
     if name in _GAUGE_MERGE_SUM:
         return "sum"
     if name.startswith(_GAUGE_MERGE_MIN_PREFIXES):
         return "min"
+    if name in _GAUGE_MERGE_MAX:
+        return "max"
     return "max"
 
 

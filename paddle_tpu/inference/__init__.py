@@ -30,6 +30,13 @@ __all__ = [
     "TokenStream",
     "EngineClosedError",
     "EngineOverloadError",
+    "PrefillWorker",
+    "DecodeWorker",
+    "DisaggReplica",
+    "SessionRouter",
+    "SessionStream",
+    "apply_role_budgets",
+    "role_scheduler_kwargs",
 ]
 
 from .serving import (  # noqa: E402
@@ -44,6 +51,15 @@ from .engine import (  # noqa: E402
     EngineOverloadError,
     ServingEngine,
     TokenStream,
+)
+from .disagg import (  # noqa: E402
+    DecodeWorker,
+    DisaggReplica,
+    PrefillWorker,
+    SessionRouter,
+    SessionStream,
+    apply_role_budgets,
+    role_scheduler_kwargs,
 )
 from .paged_llama import PagedLlamaAdapter  # noqa: E402
 from .prefix_cache import RadixPrefixCache, PrefixMatch  # noqa: E402

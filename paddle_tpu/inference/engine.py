@@ -239,6 +239,7 @@ class ServingEngine:
         self._last_step_wall = 0.0
         self._pump_error = None
         self._submitted = 0
+        self._adopted = 0
         self._completed = 0
         self._cancelled = 0
         self._shed = 0
@@ -264,6 +265,15 @@ class ServingEngine:
         self._gate_stride = max(1, int(flag("engine_gate_stride")))
         self._keep_priority = int(flag("engine_shed_keep_priority"))
         self._idle_wait = float(flag("engine_idle_wait_s"))
+
+    @property
+    def backpressure_state(self):
+        """Live admission-gate level (``BP_OPEN``/``BP_SHED``/
+        ``BP_CLAMP``) — a GIL-atomic snapshot of pump-owned state,
+        safe from any thread. The disaggregated ``SessionRouter``
+        republishes the fleet-wide max of this as
+        ``router.backpressure_state``."""
+        return self._bp_state
 
     # -- lifecycle (event-loop side) -------------------------------
 
@@ -301,6 +311,27 @@ class ServingEngine:
         stream = TokenStream(self, req)
         fut = self._loop.create_future()
         self._post(("submit", req, stream, fut))
+        return await fut
+
+    async def adopt(self, req, payloads):
+        """Adopt a handed-off request from a prefill worker (see
+        ``BatchScheduler.adopt_swapped``) and return its
+        ``TokenStream`` — decode-side tokens stream exactly like a
+        locally submitted request's.
+
+        The backpressure gate applies only its CLAMP level here: a
+        shedding decode worker still adopts, because the prefill
+        worker already spent the FLOPs and shipped the bytes —
+        dropping the chain now would waste both, whereas a clamped
+        engine is past the point where finishing foreign work is
+        safe. Raises ``EngineOverloadError`` on clamp,
+        ``EngineClosedError`` when not running, and re-raises
+        scheduler validation errors unchanged.
+        """
+        self._require_running()
+        stream = TokenStream(self, req)
+        fut = self._loop.create_future()
+        self._post(("adopt", (req, payloads), stream, fut))
         return await fut
 
     async def cancel(self, req_id):
@@ -468,6 +499,8 @@ class ServingEngine:
             kind, arg, stream, fut = op
             if kind == "submit":
                 self._pump_submit(arg, stream, fut)
+            elif kind == "adopt":
+                self._pump_adopt(arg[0], arg[1], stream, fut)
             elif kind == "cancel":
                 self._pump_cancel(arg, fut)
             elif kind == "drain":
@@ -509,6 +542,40 @@ class ServingEngine:
         self._submitted += 1
         if self._metrics is not None:
             self._metrics.inc("engine.submitted")
+            self._metrics.gauge(
+                "engine.inflight_streams", len(self._streams))
+        self._resolve(fut, result=stream)
+
+    def _pump_adopt(self, req, payloads, stream, fut):
+        if self._draining or self._stop:
+            self._resolve(fut, exc=EngineClosedError(
+                "engine is draining/stopping; adoption rejected"))
+            return
+        if self._bp_state == BP_CLAMP:
+            # SHED still adopts (the prefill FLOPs and wire bytes
+            # are already spent); only a clamped engine refuses
+            self._note_write()
+            self._shed += 1
+            self._last_shed = ((req.req_id, req.priority,
+                                "adopt-clamp"),) + self._last_shed[:7]
+            if self._metrics is not None:
+                self._metrics.inc("engine.shed_total")
+            self._resolve(fut, exc=EngineOverloadError(
+                "queue-clamp (%s)" % self._bp_reason))
+            return
+        inner = req.on_token
+        req.on_token = self._make_on_token(stream, inner)
+        try:
+            self.scheduler.adopt_swapped(req, payloads)
+        except Exception as e:
+            req.on_token = inner
+            self._resolve(fut, exc=e)
+            return
+        self._note_write()
+        self._streams[req.req_id] = stream
+        self._adopted += 1
+        if self._metrics is not None:
+            self._metrics.inc("engine.adopted")
             self._metrics.gauge(
                 "engine.inflight_streams", len(self._streams))
         self._resolve(fut, result=stream)
@@ -707,6 +774,7 @@ class ServingEngine:
             "streams": {
                 "inflight": len(self._streams),
                 "submitted": self._submitted,
+                "adopted": self._adopted,
                 "completed": self._completed,
                 "cancelled": self._cancelled,
                 "shed": self._shed,

@@ -197,6 +197,10 @@ class RequestState:
     # terminal, DISTINCT from finished: the deadline expired before
     # completion and every reservation was released
     ABORTED_DEADLINE = "aborted_deadline"
+    # the request was handed off to a decode worker
+    # (export_request): its KV page chains left this box over the
+    # HostKVSwapSpace wire format — gone locally, live remotely
+    MIGRATED = "migrated"
 
 
 @dataclass
@@ -1233,6 +1237,194 @@ class BatchScheduler:
                 rid, "evict", telemetry.clock(), self._step_epoch,
                 reason=reason, pages=freed, bytes=nbytes)
         return True
+
+    # -- disaggregated prefill/decode handoff (inference/disagg.py) --------
+    def export_request(self, req_id, mp_shards=1):
+        """Hand one prefill-complete active request off to a decode
+        worker: swap its page chains out to the host tier BITWISE
+        (payload + int8 scale sidecars), serialize them over the
+        versioned ``HostKVSwapSpace`` wire format (one payload per
+        ``mp`` shard, split on the KV-head axis), and return the
+        handoff envelope — request metadata (prompt, committed
+        tokens, budget/priority/tenant, remaining deadline, trace
+        wire) plus the payloads. The request leaves THIS scheduler
+        with state ``migrated`` and a terminal ``handoff`` trace
+        event; the receiving scheduler's :meth:`adopt_swapped`
+        re-registers it and resumes decode through the standard
+        swap-in path, so the streamed output is greedy-identical to
+        never having moved. Requires the host swap tier
+        (``FLAGS_serving_swap_bytes``); chains still sharing pages
+        with the prefix cache cannot travel (``SwapWireError``).
+        Must run on the stepping thread."""
+        req = self._active.get(req_id)
+        if req is None:
+            raise KeyError(
+                f"export_request({req_id!r}): not an active request")
+        space = self.swap_space
+        if space is None:
+            raise RuntimeError(
+                "export_request needs the host swap tier — construct "
+                "the scheduler with preempt=True and swap_bytes>0 "
+                "(FLAGS_serving_preempt / FLAGS_serving_swap_bytes)")
+        if self.draft is not None:
+            raise RuntimeError(
+                "export_request: speculative scheduling keeps a "
+                "draft-model KV pool that cannot travel — hand off "
+                "from non-speculative schedulers only")
+        if req._pos < len(req.prompt_ids) or not req.generated_ids:
+            raise ValueError(
+                f"export_request({req_id!r}): prefill incomplete "
+                f"({req._pos}/{len(req.prompt_ids)} prompt tokens, "
+                f"{len(req.generated_ids)} committed) — decode "
+                "workers adopt only prefill-complete chains")
+        if self.prefix_cache is not None and req._prefix_path:
+            # drop the radix pins; pages STILL shared with the tree
+            # after this stay on-device and export_seq refuses them
+            self.prefix_cache.unpin(req._prefix_path)
+            req._prefix_path = ()
+        est = sum(c.swap_out_nbytes(req_id)
+                  for c in self.model.caches)
+        if not space.would_fit(est):
+            from ..incubate.nn.paged_cache import SwapSpaceFull
+
+            raise SwapSpaceFull(
+                f"export_request({req_id!r}): the handoff staging "
+                f"needs {est} bytes, {space.free_bytes} of "
+                f"{space.capacity_bytes} free")
+        self._tag_pool_trace(req)
+        with self._req_span("serving.handoff_out", req, req=req_id,
+                            shards=int(mp_shards)):
+            for c in self.model.caches:
+                c.swap_out(req_id, space)
+            payloads = space.export_seq(
+                req_id, list(self.model.caches),
+                mp_shards=mp_shards)
+        deadline_left = None
+        if req._t_deadline:
+            deadline_left = max(
+                req._t_deadline - telemetry.clock(), 1e-3)
+        elif req.deadline_s is not None:
+            deadline_left = float(req.deadline_s)
+        ctx = req.trace_ctx
+        wire = None
+        if ctx is not None:
+            wire = ctx if isinstance(ctx, str) else ctx.to_wire()
+        req.state = RequestState.MIGRATED
+        if self._cv_state is not None:
+            self._cv_state.write()
+        self._active.pop(req_id)
+        self._step_extras["migrated"] = \
+            self._step_extras.get("migrated", 0) + 1
+        wire_bytes = sum(len(p) for p in payloads)
+        if self._metrics is not None:
+            self._metrics.inc("serving.handoff_out_requests")
+            self._metrics.inc("serving.handoff_out_bytes",
+                              wire_bytes)
+        if self._traces is not None:
+            # terminal ON THIS WORKER only: the decode worker's
+            # adopt_swapped continues the same trace id
+            self._traces.complete(
+                req_id, "handoff", telemetry.clock(),
+                self._step_epoch, shards=int(mp_shards),
+                wire_bytes=wire_bytes,
+                generated_tokens=len(req.generated_ids))
+        return {
+            "req": {
+                "req_id": req.req_id,
+                "prompt_ids": list(req.prompt_ids),
+                "generated_ids": list(req.generated_ids),
+                "max_new_tokens": req.max_new_tokens,
+                "eos_id": req.eos_id,
+                "priority": req.priority,
+                "tenant": req.tenant,
+                "deadline_s": deadline_left,
+                "trace_ctx": wire,
+            },
+            "payloads": payloads,
+        }
+
+    def adopt_swapped(self, req, payloads):
+        """Adopt a handed-off request from a prefill worker: restore
+        its page-chain payloads into THIS scheduler's host swap tier
+        (magic/version/shard-set/geometry validated loudly) and
+        register the request as swapped-out — the next step's
+        standard ``_admit_swapped``/``_swap_in`` path restores the
+        chains bitwise and decode resumes exactly where the prefill
+        worker stopped. The trace identity rides the swap records:
+        ``swap_space.trace_context(req_id)`` is the decode-worker
+        ingress, so the request's decode-side spans stitch under ONE
+        trace id across the prefill -> transfer -> decode hop. Must
+        run on the stepping thread (the async engine marshals it via
+        ``ServingEngine.adopt``)."""
+        rid = req.req_id
+        if (rid in self._active or rid in self._swapped
+                or rid in self._finished
+                or any(r.req_id == rid for r in self._queue)):
+            raise ValueError(
+                f"adopt_swapped({rid!r}): this scheduler already "
+                "knows the request id")
+        space = self.swap_space
+        if space is None:
+            raise RuntimeError(
+                "adopt_swapped needs the host swap tier — construct "
+                "the scheduler with preempt=True and swap_bytes>0 "
+                "(FLAGS_serving_preempt / FLAGS_serving_swap_bytes)")
+        if self.draft is not None:
+            raise RuntimeError(
+                "adopt_swapped: speculative scheduling cannot adopt "
+                "a foreign chain (the draft pool never saw the "
+                "prompt)")
+        if not req.generated_ids:
+            raise ValueError(
+                f"adopt_swapped({rid!r}): no committed token rides "
+                "the envelope — only prefill-complete requests hand "
+                "off")
+        space.import_seq(rid, payloads, list(self.model.caches))
+        req._pos = len(req.prompt_ids)
+        req.state = RequestState.SWAPPED
+        self._submit_seq += 1
+        req._order = self._submit_seq
+        if req.priority:
+            self._plain_fifo = False
+        if req.deadline_s is not None:
+            req._t_deadline = \
+                telemetry.clock() + float(req.deadline_s)
+            self._deadline_seen = True
+        if req.trace_ctx is None:
+            # the decode-worker trace ingress: the identity the
+            # swap records carried over the wire
+            req.trace_ctx = space.trace_context(rid)
+        if self._metrics is not None or self._traces is not None \
+                or self._tracer is not None:
+            ctx = req.trace_ctx
+            if isinstance(ctx, str):
+                ctx = telemetry.TraceContext.from_wire(ctx)
+            if ctx is None:
+                ctx = telemetry.TraceContext(
+                    tenant=req.tenant, deadline_s=req.deadline_s)
+            req.trace_ctx = ctx
+        if self._metrics is not None:
+            req._t_submit = telemetry.clock()
+            # the NEXT token's inter-token gap starts at adoption —
+            # without this the first decode-side TPOT sample would
+            # span back to an unset (zero) timestamp
+            req._t_last_tok = req._t_submit
+            self._metrics.inc("serving.handoff_in_requests")
+            self._metrics.inc("serving.handoff_in_bytes",
+                              sum(len(p) for p in payloads))
+        if self._traces is not None:
+            payload = {"adopted": True,
+                       "prompt_tokens": len(req.prompt_ids),
+                       "generated_tokens": len(req.generated_ids),
+                       "max_new_tokens": req.max_new_tokens}
+            if req.trace_ctx is not None:
+                payload["trace_id"] = req.trace_ctx.trace_id
+            self._traces.begin(rid, telemetry.clock(),
+                               self._step_epoch, **payload)
+        if self._cv_state is not None:
+            self._cv_state.write()
+        self._swapped[rid] = req
+        return rid
 
     # -- deadlines ---------------------------------------------------------
     def _expire_deadlines(self):

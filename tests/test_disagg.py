@@ -1,0 +1,536 @@
+"""Disaggregated serving (inference/disagg.py, ISSUE 18).
+
+Wire level: the versioned page-chain format round-trips BITWISE
+(payload + int8 scale sidecars), splits along the KV-head axis into
+per-mp-shard payloads that sharded destination pools reassemble, and
+refuses bad magic / version drift / incomplete shard sets / geometry
+mismatches LOUDLY.
+
+Scheduler level: export_request -> adopt_swapped moves a
+prefill-complete request between schedulers with greedy outputs
+identical to never having moved, and the trace identity rides the
+swap records — one trace id across the prefill -> transfer -> decode
+hop, decode-side spans parented under the request root.
+
+Front end: the SessionRouter spreads sessions over DP replicas
+(rr/least), forwards cancels to the owning replica, republishes
+fleet backpressure, and the role-budget helpers map the
+FLAGS_disagg_* budgets onto the planner flags.
+"""
+import asyncio
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import concurrency as conc
+from paddle_tpu.framework import telemetry
+from paddle_tpu.framework.flags import flag, set_flags
+from paddle_tpu.incubate.nn import PagedKVCacheManager
+from paddle_tpu.incubate.nn.paged_cache import (
+    SWAP_WIRE_MAGIC,
+    SWAP_WIRE_VERSION,
+    HostKVSwapSpace,
+    SwapSpaceFull,
+    SwapWireError,
+)
+from paddle_tpu.inference import (
+    BatchScheduler,
+    DecodeWorker,
+    DisaggReplica,
+    PrefillWorker,
+    Request,
+    RequestState,
+    ServingEngine,
+    SessionRouter,
+    apply_role_budgets,
+    role_scheduler_kwargs,
+)
+
+from test_overload import N_NEW, PROMPTS, TinyPagedDecoder
+
+PAGE = 4
+HEADS, HDIM = 4, 8
+
+
+@pytest.fixture
+def tel_trace():
+    set_flags({"telemetry": "trace"})
+    telemetry.reset()
+    conc.reset()
+    yield telemetry.tracer()
+    set_flags({"telemetry": "off"})
+    telemetry.reset()
+    conc.reset()
+
+
+@pytest.fixture
+def tel_metrics():
+    set_flags({"telemetry": "metrics"})
+    telemetry.reset()
+    conc.reset()
+    yield telemetry.registry()
+    set_flags({"telemetry": "off"})
+    telemetry.reset()
+    conc.reset()
+
+
+def _pool(kv=None, num_pages=32, heads=HEADS, mp_size=1, mp_rank=0):
+    return PagedKVCacheManager(num_pages, PAGE, heads, HDIM,
+                               dtype=jnp.float32, kv_dtype=kv,
+                               mp_size=mp_size, mp_rank=mp_rank)
+
+
+def _fill(pool, sid, n, seed=0):
+    rng = np.random.RandomState(seed)
+    pool.alloc(sid)
+    h = pool.kv_heads_local
+    for _ in range(n):
+        pool.append(sid, rng.randn(h, HDIM).astype(np.float32),
+                    rng.randn(h, HDIM).astype(np.float32))
+
+
+def _chain_snapshot(pool, sid):
+    pg = np.asarray(pool.seq_pages(sid), np.int32)
+    out = [np.asarray(pool.k_pages)[pg], np.asarray(pool.v_pages)[pg]]
+    if pool.quantized:
+        out += [np.asarray(pool.k_scales)[pg],
+                np.asarray(pool.v_scales)[pg]]
+    return out
+
+
+def _export(pool, sid, mp_shards=1, cap=1 << 20):
+    """Swap one chain out and serialize it; returns (space,
+    payloads)."""
+    space = HostKVSwapSpace(cap)
+    pool.swap_out(sid, space)
+    return space, space.export_seq(sid, [pool], mp_shards=mp_shards)
+
+
+class TestWireFormat:
+    @pytest.mark.parametrize("kv", [None, "int8"])
+    def test_roundtrip_bitwise(self, kv):
+        src = _pool(kv)
+        _fill(src, "s", 9, seed=3)
+        before = _chain_snapshot(src, "s")
+        _, payloads = _export(src, "s")
+        assert len(payloads) == 1
+        assert payloads[0][:4] == SWAP_WIRE_MAGIC
+
+        dst = _pool(kv)
+        space2 = HostKVSwapSpace(1 << 20)
+        n = space2.import_seq("s", payloads, [dst])
+        assert n > 0 and space2.holds("s")
+        dst.swap_in("s", space2)
+        after = _chain_snapshot(dst, "s")
+        assert dst.seq_len("s") == 9
+        for a, b in zip(before, after):
+            assert np.array_equal(a, b)
+
+    def test_magic_mismatch_is_loud(self):
+        src = _pool()
+        _fill(src, "s", 5)
+        _, payloads = _export(src, "s")
+        bad = b"NOPE" + payloads[0][4:]
+        dst = _pool()
+        with pytest.raises(SwapWireError, match="magic"):
+            HostKVSwapSpace(1 << 20).import_seq("s", [bad], [dst])
+
+    def test_version_mismatch_is_loud(self):
+        import struct
+
+        src = _pool()
+        _fill(src, "s", 5)
+        _, payloads = _export(src, "s")
+        drifted = (payloads[0][:4]
+                   + struct.pack("<I", SWAP_WIRE_VERSION + 1)
+                   + payloads[0][8:])
+        dst = _pool()
+        with pytest.raises(SwapWireError, match="version mismatch"):
+            HostKVSwapSpace(1 << 20).import_seq("s", [drifted], [dst])
+
+    def test_truncated_payload_is_loud(self):
+        src = _pool()
+        _fill(src, "s", 5)
+        _, payloads = _export(src, "s")
+        dst = _pool()
+        with pytest.raises(SwapWireError):
+            HostKVSwapSpace(1 << 20).import_seq(
+                "s", [payloads[0][:-16]], [dst])
+
+    def test_incomplete_shard_set_is_loud(self):
+        src = _pool()
+        _fill(src, "s", 6)
+        _, payloads = _export(src, "s", mp_shards=2)
+        assert len(payloads) == 2
+        dst = _pool()
+        with pytest.raises(SwapWireError, match="shard"):
+            HostKVSwapSpace(1 << 20).import_seq(
+                "s", payloads[:1], [dst])
+
+    def test_geometry_mismatch_is_loud(self):
+        src = _pool()
+        _fill(src, "s", 6)
+        _, payloads = _export(src, "s")
+        wrong = PagedKVCacheManager(32, PAGE, HEADS, HDIM * 2,
+                                    dtype=jnp.float32)
+        with pytest.raises(SwapWireError):
+            HostKVSwapSpace(1 << 20).import_seq("s", payloads, [wrong])
+
+    def test_import_respects_capacity(self):
+        src = _pool()
+        _fill(src, "s", 6)
+        _, payloads = _export(src, "s")
+        dst = _pool()
+        with pytest.raises(SwapSpaceFull):
+            HostKVSwapSpace(8).import_seq("s", payloads, [dst])
+
+    def test_export_pops_source_records(self):
+        src = _pool()
+        _fill(src, "s", 6)
+        space, _ = _export(src, "s")
+        assert not space.holds("s")
+        assert space.used_bytes == 0
+        assert space.exported_records == 1
+
+    @pytest.mark.parametrize("kv", [None, "int8"])
+    def test_shard_split_reassembles_on_sharded_pools(self, kv):
+        """A 4-head chain exported as 2 shards lands bitwise on two
+        mp-sharded destination pools, each holding only its own
+        heads — and the shard payloads cover disjoint head ranges."""
+        src = _pool(kv)
+        _fill(src, "s", 7, seed=5)
+        k_full = _chain_snapshot(src, "s")[0]  # (pages, PAGE, 4, HD)
+        _, payloads = _export(src, "s", mp_shards=2)
+        assert len(payloads) == 2
+        for rank in (0, 1):
+            dst = _pool(kv, mp_size=2, mp_rank=rank)
+            assert dst.kv_heads_local == HEADS // 2
+            space = HostKVSwapSpace(1 << 20)
+            space.import_seq("s", payloads, [dst])
+            dst.swap_in("s", space)
+            got = _chain_snapshot(dst, "s")[0]
+            lo = rank * (HEADS // 2)
+            assert np.array_equal(got, k_full[:, :, lo:lo + 2, :])
+
+
+class TestShardedPool:
+    def test_geometry_attrs(self):
+        p = _pool(mp_size=2, mp_rank=1)
+        assert p.kv_heads_global == HEADS
+        assert p.kv_heads_local == HEADS // 2
+        assert p.head_start == HEADS // 2
+        assert p.mp_size == 2 and p.mp_rank == 1
+        assert p.k_pages.shape[2] == HEADS // 2
+
+    def test_default_is_unsharded(self):
+        p = _pool()
+        assert p.mp_size == 1 and p.mp_rank == 0
+        assert p.head_start == 0
+        assert p.kv_heads_local == p.kv_heads_global == HEADS
+
+    def test_heads_must_divide(self):
+        with pytest.raises(ValueError, match="shard"):
+            PagedKVCacheManager(16, PAGE, 3, HDIM,
+                                dtype=jnp.float32, mp_size=2)
+
+    def test_rank_bounds(self):
+        with pytest.raises(ValueError):
+            _pool(mp_size=2, mp_rank=5)
+
+
+def _sched(num_pages=32, **kw):
+    paddle.seed(11)
+    model = TinyPagedDecoder(num_pages=num_pages)
+    kw.setdefault("preempt", True)
+    kw.setdefault("swap_bytes", 64 << 20)
+    return model, BatchScheduler(model, **kw)
+
+
+PROMPT = [3, 17, 5, 9, 2, 11, 7, 1]
+
+
+def _single_box_tokens(rid="h0", prompt=PROMPT, n=N_NEW):
+    _, ref = _sched()
+    ref.submit(Request(rid, list(prompt), max_new_tokens=n))
+    return list(ref.run_until_complete()[rid].generated_ids)
+
+
+class TestSchedulerHandoff:
+    def test_export_adopt_greedy_identical(self):
+        ref = _single_box_tokens()
+        _, sp = _sched()
+        req = Request("h0", list(PROMPT), max_new_tokens=N_NEW)
+        kind, env = PrefillWorker(sp, mp_shards=1).run(req)
+        assert kind == "handoff"
+        assert req.state == RequestState.MIGRATED
+        assert sp.num_active == 0
+        # prefill committed exactly the first token
+        assert env["req"]["generated_ids"] == ref[:1]
+
+        _, sd = _sched()
+        req2 = DecodeWorker.request_from_envelope(env)
+        sd.adopt_swapped(req2, env["payloads"])
+        assert sd.num_swapped == 1
+        done = sd.run_until_complete()
+        assert list(done["h0"].generated_ids) == ref
+
+    def test_export_requires_prefill_complete(self):
+        _, sp = _sched()
+        req = Request("h0", list(PROMPT), max_new_tokens=N_NEW)
+        sp.submit(req)
+        sp.step()  # admitted; prompt barely started
+        with pytest.raises(ValueError, match="prefill incomplete"):
+            sp.export_request("h0")
+
+    def test_export_unknown_request(self):
+        _, sp = _sched()
+        with pytest.raises(KeyError):
+            sp.export_request("ghost")
+
+    def test_export_needs_swap_tier(self):
+        _, sp = _sched(preempt=False, swap_bytes=0)
+        req = Request("h0", list(PROMPT), max_new_tokens=N_NEW)
+        sp.submit(req)
+        while not req.generated_ids:
+            sp.step()
+        with pytest.raises(RuntimeError, match="swap"):
+            sp.export_request("h0")
+
+    def test_adopt_rejects_duplicate_id(self):
+        _, sp = _sched()
+        req = Request("h0", list(PROMPT), max_new_tokens=N_NEW)
+        kind, env = PrefillWorker(sp).run(req)
+        assert kind == "handoff"
+        _, sd = _sched()
+        sd.submit(Request("h0", list(PROMPT), max_new_tokens=2))
+        req2 = DecodeWorker.request_from_envelope(env)
+        with pytest.raises(ValueError, match="already"):
+            sd.adopt_swapped(req2, env["payloads"])
+
+    def test_adopt_requires_committed_token(self):
+        _, sd = _sched()
+        bare = Request("h0", list(PROMPT), max_new_tokens=N_NEW)
+        with pytest.raises(ValueError, match="prefill-complete"):
+            sd.adopt_swapped(bare, [])
+
+    def test_tiny_budget_finishes_on_prefill_box(self):
+        _, sp = _sched()
+        req = Request("h0", list(PROMPT), max_new_tokens=1)
+        kind, val = PrefillWorker(sp).run(req)
+        assert kind == "finished"
+        assert val.state == RequestState.FINISHED
+        assert list(val.generated_ids) == \
+            _single_box_tokens(n=1)
+
+    def test_handoff_metrics(self, tel_metrics):
+        reg = tel_metrics
+        _, sp = _sched()
+        req = Request("h0", list(PROMPT), max_new_tokens=N_NEW)
+        _, env = PrefillWorker(sp).run(req)
+        snap = reg.snapshot()
+        assert snap["serving"]["handoff_out_requests"] == 1
+        wire = sum(len(p) for p in env["payloads"])
+        assert snap["serving"]["handoff_out_bytes"] == wire
+        assert snap["pool"]["transfer_out_records"] == 1
+        _, sd = _sched()
+        sd.adopt_swapped(DecodeWorker.request_from_envelope(env),
+                         env["payloads"])
+        snap = reg.snapshot()
+        assert snap["serving"]["handoff_in_requests"] == 1
+        assert snap["serving"]["handoff_in_bytes"] == wire
+        assert snap["pool"]["transfer_in_records"] == 1
+
+
+class TestTraceHandoff:
+    def test_one_trace_id_across_workers(self, tel_trace):
+        """Acceptance: a chain serialized in one telemetry world and
+        restored in a fresh one (simulating a second process) keeps
+        ONE trace id, with the decode-side swap-in span parented
+        under the request root carried by the swap records."""
+        ref = _single_box_tokens()
+        telemetry.reset()  # the ref run polluted the trace book
+        _, sp = _sched()
+        req = Request("h0", list(PROMPT), max_new_tokens=N_NEW)
+        kind, env = PrefillWorker(sp).run(req)
+        assert kind == "handoff"
+        root = req.trace_ctx
+        assert root is not None
+        assert env["req"]["trace_ctx"] == root.to_wire()
+
+        # "another process": tear the telemetry world down and build
+        # a new one before the decode-side scheduler exists
+        set_flags({"telemetry": "trace"})
+        telemetry.reset()
+        _, sd = _sched()
+        req2 = DecodeWorker.request_from_envelope(env)
+        # drop the envelope's context to prove the swap-record
+        # ingress (space.trace_context) re-derives the identity
+        req2.trace_ctx = None
+        sd.adopt_swapped(req2, env["payloads"])
+        assert req2.trace_ctx is not None
+        assert req2.trace_ctx.trace_id == root.trace_id
+        done = sd.run_until_complete()
+        assert list(done["h0"].generated_ids) == ref
+
+        # decode-side spans joined the SAME trace, parented under
+        # the request root span the prefill box created
+        spans = [s for s in telemetry.tracer().spans()
+                 if s.trace_id == root.trace_id]
+        assert spans, "no decode-side span adopted the wire trace id"
+        swapin = [s for s in spans if s.name == "serving.swap_in"]
+        assert swapin
+        assert all(s.parent_id == root.span_id for s in swapin)
+        # and the adopted request's trace book entry carries it too
+        book = telemetry.request_traces()
+        tr = book.get("h0")
+        assert tr is not None and tr.done
+        first = tr.first("submit")
+        assert first["adopted"] is True
+        assert first["trace_id"] == root.trace_id
+
+    def test_prefill_side_emits_terminal_handoff(self, tel_trace):
+        _, sp = _sched()
+        req = Request("h0", list(PROMPT), max_new_tokens=N_NEW)
+        PrefillWorker(sp).run(req)
+        tr = telemetry.request_traces().get("h0")
+        assert tr is not None and tr.done
+        assert tr.kinds()[-1] == "handoff"
+
+
+def _mk_replica(name):
+    _, sp = _sched()
+    _, sd = _sched()
+    return sp, sd, name
+
+
+class TestRouterAndEngine:
+    def _run_fleet(self, policy, reqs):
+        async def main():
+            sp0, sd0, _ = _mk_replica("rep0")
+            sp1, sd1, _ = _mk_replica("rep1")
+            outs, adopted = {}, {}
+            async with ServingEngine(sd0) as e0, \
+                    ServingEngine(sd1) as e1:
+                router = SessionRouter(
+                    [DisaggReplica("rep0", sp0, e0),
+                     DisaggReplica("rep1", sp1, e1)],
+                    policy=policy)
+                for req in reqs:
+                    sess = await router.submit(req)
+                    outs[req.req_id] = await sess.tokens()
+                adopted["rep0"] = e0._adopted
+                adopted["rep1"] = e1._adopted
+                info = router._routerz_info()
+            return outs, adopted, info
+        return asyncio.run(main())
+
+    def test_rr_greedy_identical_across_replicas(self):
+        ref = {rid: _single_box_tokens(rid, p)
+               for rid, p in PROMPTS.items()}
+        reqs = [Request(rid, list(p), max_new_tokens=N_NEW)
+                for rid, p in PROMPTS.items()]
+        outs, adopted, info = self._run_fleet("rr", reqs)
+        assert outs == ref
+        # rr over 2 replicas: 4 sessions split 2/2
+        assert adopted == {"rep0": 2, "rep1": 2}
+        assert info["policy"] == "rr"
+        assert info["submitted"] == 4
+        assert [r["name"] for r in info["replicas"]] == \
+            ["rep0", "rep1"]
+
+    def test_cancel_forwards_to_owning_replica(self):
+        async def main():
+            sp, sd, _ = _mk_replica("rep0")
+            async with ServingEngine(sd) as eng:
+                router = SessionRouter(
+                    [DisaggReplica("rep0", sp, eng)], policy="rr")
+                req = Request("c0", list(PROMPT), max_new_tokens=64)
+                sess = await router.submit(req)
+                ok = await router.cancel("c0")
+                toks = await sess.tokens()
+                missing = await router.cancel("ghost")
+            return ok, missing, toks, sess.req.state
+        ok, missing, toks, state = asyncio.run(main())
+        assert ok is True
+        assert missing is False
+        assert state == RequestState.ABORTED_DEADLINE
+        assert len(toks) < 64
+
+    def test_least_policy_picks_unloaded_replica(self):
+        set_flags({"telemetry": "off"})
+        telemetry.reset()
+        rep0 = DisaggReplica("rep0", SimpleNamespace(),
+                             SimpleNamespace())
+        rep1 = DisaggReplica("rep1", SimpleNamespace(),
+                             SimpleNamespace())
+        router = SessionRouter([rep0, rep1], policy="least")
+        live = SimpleNamespace(req=SimpleNamespace(terminal=False))
+        router._live["a"] = (rep0, live)
+        router._live["b"] = (rep0, live)
+        assert router._pick() is rep1
+        assert router.num_sessions == 2
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(ValueError, match="policy"):
+            SessionRouter([DisaggReplica("r", SimpleNamespace(),
+                                         SimpleNamespace())],
+                          policy="hash")
+        with pytest.raises(ValueError, match="replica"):
+            SessionRouter([])
+
+    def test_router_gauges(self, tel_metrics):
+        reg = tel_metrics
+
+        async def main():
+            sp, sd, _ = _mk_replica("rep0")
+            async with ServingEngine(sd) as eng:
+                router = SessionRouter(
+                    [DisaggReplica("rep0", sp, eng)])
+                sess = await router.submit(Request(
+                    "g0", list(PROMPT), max_new_tokens=N_NEW))
+                mid = reg.snapshot()
+                await sess.tokens()
+            return mid
+        mid = asyncio.run(main())
+        snap = reg.snapshot()
+        assert snap["router"]["replicas"] == 1
+        assert snap["router"]["submitted"] == 1
+        assert snap["router"]["backpressure_state"] == 0
+        assert snap["engine"]["adopted"] == 1
+        assert mid["router"]["sessions"] >= 0
+
+
+class TestRoleConfig:
+    def test_apply_role_budgets(self):
+        old = {"jit_budget_hbm": int(flag("jit_budget_hbm")),
+               "jit_budget_comm": int(flag("jit_budget_comm"))}
+        try:
+            set_flags({"disagg_prefill_budget_hbm": 123456,
+                       "disagg_prefill_budget_comm": 0})
+            applied = apply_role_budgets("prefill")
+            assert applied == {"jit_budget_hbm": 123456}
+            assert int(flag("jit_budget_hbm")) == 123456
+            assert int(flag("jit_budget_comm")) == \
+                old["jit_budget_comm"]
+            assert apply_role_budgets("decode") == {}
+            with pytest.raises(ValueError):
+                apply_role_budgets("router")
+        finally:
+            set_flags(dict(old, disagg_prefill_budget_hbm=0,
+                           disagg_prefill_budget_comm=0))
+
+    def test_role_scheduler_kwargs(self):
+        try:
+            set_flags({"disagg_prefill_chunk_tokens": 96})
+            assert role_scheduler_kwargs("prefill") == \
+                {"prefill_chunk_tokens": 96}
+            assert role_scheduler_kwargs("decode") == {}
+            with pytest.raises(ValueError):
+                role_scheduler_kwargs("frontend")
+        finally:
+            set_flags({"disagg_prefill_chunk_tokens": 0})
+        assert role_scheduler_kwargs("prefill") == {}

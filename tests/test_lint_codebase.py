@@ -1650,3 +1650,77 @@ class TestEngineDiscipline:
     def test_rule_inventory_has_engine_discipline(self):
         assert any(rid == "engine-discipline"
                    for rid, _ in lint_codebase.RULES)
+
+
+class TestRoleDiscipline:
+    """Disagg role-discipline rule (ISSUE 18): prefill-role scopes in
+    inference/disagg.py must not call the decode-only restore surface
+    (swap_in / import_seq / adopt_swapped / adopt)."""
+
+    def test_seeded_prefill_calling_restore_flagged(self):
+        bad = (
+            "class PrefillWorker:\n"
+            "    def run(self, sched, req, space, pools):\n"
+            "        sched.adopt_swapped(req, [])\n"
+            "        space.import_seq(req.req_id, [], pools)\n"
+        )
+        v = lint_codebase.lint_role_discipline_file(
+            "fake/disagg.py", text=bad)
+        assert len(v) == 2, v
+        assert all("decode-only" in m for m in v)
+        assert ".adopt_swapped()" in v[0]
+        assert ".import_seq()" in v[1]
+
+    def test_seeded_prefill_named_function_flagged(self):
+        # scope matching is by NAME anywhere on the stack, so a
+        # helper nested under a prefill-named function is covered too
+        bad = (
+            "def run_prefill_leg(pool, space):\n"
+            "    def finish(sid):\n"
+            "        pool.swap_in(sid, space)\n"
+            "    finish('s')\n"
+        )
+        v = lint_codebase.lint_role_discipline_file(
+            "fake/disagg.py", text=bad)
+        assert len(v) == 1, v
+        assert ".swap_in()" in v[0]
+
+    def test_decode_scope_clean(self):
+        ok = (
+            "class DecodeWorker:\n"
+            "    async def adopt(self, envelope):\n"
+            "        return await self.engine.adopt(\n"
+            "            envelope, envelope['payloads'])\n"
+            "def restore(sched, req, payloads):\n"
+            "    sched.adopt_swapped(req, payloads)\n"
+        )
+        assert lint_codebase.lint_role_discipline_file(
+            "fake/disagg.py", text=ok) == []
+
+    def test_waiver_suppresses(self):
+        ok = (
+            "def prefill_probe(pool, space):\n"
+            "    pool.swap_in('s', space)  "
+            "# trace-lint: ok(loopback self-test)\n"
+        )
+        assert lint_codebase.lint_role_discipline_file(
+            "fake/disagg.py", text=ok) == []
+
+    def test_disagg_file_covered_and_clean(self):
+        rel = os.path.join("paddle_tpu", "inference", "disagg.py")
+        assert rel in lint_codebase.ROLE_DISCIPLINE_FILES
+        assert rel in lint_codebase.HOST_ONLY_FILES
+        assert rel in lint_codebase.POOL_API_FILES
+        assert os.path.exists(os.path.join(REPO, rel))
+        assert lint_codebase.check_role_discipline() == []
+
+    def test_sharded_pool_state_audited(self):
+        # the mp-shard geometry is pool state: writes from outside
+        # the pool must be caught by the pool-mutation audit
+        for attr in ("kv_heads_global", "head_start",
+                     "mp_size", "mp_rank"):
+            assert attr in lint_codebase._POOL_STATE_ATTRS
+
+    def test_rule_inventory_has_role_discipline(self):
+        assert any(rid == "disagg-role-discipline"
+                   for rid, _ in lint_codebase.RULES)

@@ -1901,6 +1901,76 @@ class TestMergeSnapshots:
         assert 'worker="w0"' in text and 'worker="w1"' in text
 
 
+class TestDisaggMergeKinds:
+    """ISSUE 18 satellite: the engine/router gauges declare their
+    fleet-merge semantics — populations SUM (sessions, replicas,
+    inflight streams), health floors MIN (goodput), backpressure
+    states MAX (the fleet is as backpressured as its worst member) —
+    and a mixed prefill/decode fleet merges accordingly with
+    role-labelled series in the exposition."""
+
+    def test_declared_kinds(self, tel_off):
+        assert telemetry.gauge_merge_kind(
+            "engine.inflight_streams") == "sum"
+        assert telemetry.gauge_merge_kind(
+            "router.sessions") == "sum"
+        assert telemetry.gauge_merge_kind(
+            "router.replicas") == "sum"
+        assert telemetry.gauge_merge_kind(
+            "engine.backpressure_state") == "max"
+        assert telemetry.gauge_merge_kind(
+            "router.backpressure_state") == "max"
+        assert telemetry.gauge_merge_kind("serving.goodput") == "min"
+
+    def _fleet(self):
+        """One prefill-role worker, two decode-role workers."""
+        pre = telemetry.MetricsRegistry()
+        pre.inc("serving.handoff_out_requests", 4)
+        pre.gauge("engine.backpressure_state", 0.0)
+        d0 = telemetry.MetricsRegistry()
+        d0.inc("serving.handoff_in_requests", 3)
+        d0.inc("engine.adopted", 3)
+        d0.gauge("engine.backpressure_state", 2.0)
+        d0.gauge("engine.inflight_streams", 3.0)
+        d0.gauge("router.sessions", 3.0)
+        d0.gauge("serving.goodput", 0.5)
+        d1 = telemetry.MetricsRegistry()
+        d1.inc("serving.handoff_in_requests", 1)
+        d1.inc("engine.adopted", 1)
+        d1.gauge("engine.backpressure_state", 1.0)
+        d1.gauge("engine.inflight_streams", 1.0)
+        d1.gauge("router.sessions", 1.0)
+        d1.gauge("serving.goodput", 0.9)
+        return {"prefill0": pre.snapshot(), "decode0": d0.snapshot(),
+                "decode1": d1.snapshot()}
+
+    def test_mixed_role_fleet_merge(self, tel_off):
+        merged = telemetry.merge_snapshots(self._fleet())
+        # counters: exact sums across roles
+        assert merged["serving"]["handoff_out_requests"] == 4
+        assert merged["serving"]["handoff_in_requests"] == 4
+        assert merged["engine"]["adopted"] == 4
+        # populations sum, backpressure takes the worst member,
+        # goodput the weakest
+        assert merged["engine"]["inflight_streams"] == 4.0
+        assert merged["router"]["sessions"] == 4.0
+        assert merged["engine"]["backpressure_state"] == 2.0
+        assert merged["serving"]["goodput"] == 0.5
+
+    def test_role_labelled_exposition(self, tel_off):
+        text = telemetry.merged_prometheus_text(self._fleet())
+        assert 'worker="prefill0"' in text
+        assert 'worker="decode0"' in text
+        assert ('paddle_engine_backpressure_state'
+                '{worker="decode0"} 2') in text
+        # the unlabelled aggregate is the declared-max merge
+        import re
+
+        agg = re.search(
+            r"^paddle_engine_backpressure_state (\S+)$", text, re.M)
+        assert agg is not None and float(agg.group(1)) == 2.0
+
+
 class TestAggregateCLI:
     def _snap_files(self, tmp_path):
         reg = telemetry.MetricsRegistry()

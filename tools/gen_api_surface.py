@@ -66,6 +66,7 @@ NAMESPACES = [
     "paddle_tpu.ops.kernels",
     "paddle_tpu.inference",
     "paddle_tpu.inference.engine",
+    "paddle_tpu.inference.disagg",
     "paddle_tpu.framework.telemetry",
     "paddle_tpu.framework.concurrency",
     "paddle_tpu.framework.watchdog",

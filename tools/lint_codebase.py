@@ -142,6 +142,11 @@ HOST_ONLY_FILES = (
     os.path.join("paddle_tpu", "framework", "ops_server.py"),
     os.path.join("paddle_tpu", "incubate", "nn", "fault_injection.py"),
     os.path.join("paddle_tpu", "framework", "concurrency.py"),
+    # the disaggregated router/transfer plane is host orchestration:
+    # it serializes host swap buffers and marshals requests between
+    # schedulers — a jax import here would put device compute on the
+    # session-routing path
+    os.path.join("paddle_tpu", "inference", "disagg.py"),
 )
 
 _HOST_ONLY_BANNED_MODULES = ("jax", "jax.numpy")
@@ -497,6 +502,10 @@ _POOL_STATE_ATTRS = (
     "k_pages", "v_pages", "k_scales", "v_scales",
     "_refcnt", "_free", "_tables", "_lens", "_ext_refs",
     "_swap_store", "_swap_used",
+    # sharded-pool geometry (mp-mesh KV-head split): rewriting any of
+    # these after construction would silently misroute every wire
+    # transfer's head-axis reassembly
+    "kv_heads_global", "head_start", "mp_size", "mp_rank",
 )
 # the refcount-bookkeeping subset: reading these from serving code is
 # also an API bypass (the pool exposes num_free_pages/seq_pages/...;
@@ -511,6 +520,7 @@ POOL_API_FILES = (
     os.path.join("paddle_tpu", "inference", "serving.py"),
     os.path.join("paddle_tpu", "inference", "prefix_cache.py"),
     os.path.join("paddle_tpu", "inference", "paged_llama.py"),
+    os.path.join("paddle_tpu", "inference", "disagg.py"),
 )
 
 # pool-private methods a serving module must never call (each is an
@@ -2371,6 +2381,88 @@ def check_engine_discipline(root=REPO):
     return lint_engine_discipline_file(path)
 
 
+# disaggregated role discipline: in the role-split modules, code
+# whose enclosing scope is prefill-role (a class or function with
+# "prefill" in its name) must never call the decode-only restore
+# surface — a prefill worker that swaps a chain back IN (or adopts a
+# foreign one) collapses the role split and double-materializes the
+# KV pages the decode worker is about to import
+ROLE_DISCIPLINE_FILES = (
+    os.path.join("paddle_tpu", "inference", "disagg.py"),
+)
+
+# the decode-only half of the pool/scheduler/engine surface: restore
+# and adoption entry points (export_seq/export_request/swap_out stay
+# prefill-legal — they are the handoff itself)
+_ROLE_DECODE_ONLY = (
+    "swap_in", "import_seq", "adopt_swapped", "adopt",
+)
+
+
+class _RoleDisciplineVisitor(ast.NodeVisitor):
+    """Flags decode-only API calls from prefill-role scopes."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+        self._scope_stack = []
+
+    def _in_prefill_scope(self):
+        return any("prefill" in n.lower() for n in self._scope_stack)
+
+    def _push(self, node):
+        self._scope_stack.append(node.name)
+        self.generic_visit(node)
+        self._scope_stack.pop()
+
+    visit_FunctionDef = _push
+    visit_AsyncFunctionDef = _push
+    visit_ClassDef = _push
+
+    def visit_Call(self, node):
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) \
+            else (fn.id if isinstance(fn, ast.Name) else None)
+        if name in _ROLE_DECODE_ONLY and self._in_prefill_scope():
+            line = self.lines[node.lineno - 1] \
+                if node.lineno - 1 < len(self.lines) else ""
+            if _WAIVER_MARK not in line:
+                self.violations.append(
+                    "%s:%d: prefill-role scope calls decode-only "
+                    ".%s() — the restore/adoption surface belongs to "
+                    "the decode role (a prefill worker re-importing "
+                    "a chain collapses the role split and double-"
+                    "materializes pages); move it to a decode-role "
+                    "scope or waive with '%s(<reason>)'"
+                    % (self.relpath, node.lineno, name, _WAIVER_MARK))
+        self.generic_visit(node)
+
+
+def lint_role_discipline_file(path, text=None):
+    """Role-discipline check for one file; returns violations."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _RoleDisciplineVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_role_discipline(root=REPO):
+    out = []
+    for f in ROLE_DISCIPLINE_FILES:
+        path = os.path.join(root, f)
+        if os.path.exists(path):
+            out.extend(lint_role_discipline_file(path))
+    return out
+
+
 # rule inventory: (rule id, one-line summary) for every AST check in
 # this linter — merged into `python -m paddle_tpu.framework.analysis
 # --rules` alongside the jaxpr rules and the page-sanitizer violation
@@ -2409,14 +2501,15 @@ RULES = (
      "(k_scales/v_scales are pool-private calibration state)"),
     ("pool-mutation-audit",
      "PagedKVCacheManager state (k_pages/v_pages/k_scales/v_scales/"
-     "_refcnt/_free/_tables/_lens/_ext_refs) and the host swap "
-     "tier's store (_swap_store/_swap_used) are writable only inside "
-     "the pool module — everything else goes through the sanitizer-"
-     "instrumented public API"),
+     "_refcnt/_free/_tables/_lens/_ext_refs), the host swap "
+     "tier's store (_swap_store/_swap_used) AND the sharded-pool "
+     "geometry (kv_heads_global/head_start/mp_size/mp_rank) are "
+     "writable only inside the pool module — everything else goes "
+     "through the sanitizer-instrumented public API"),
     ("pool-private-api",
-     "serving.py/prefix_cache.py/paged_llama.py may only call the "
-     "public audited pool API — no pool-private underscore methods "
-     "or bookkeeping attrs"),
+     "serving.py/prefix_cache.py/paged_llama.py/disagg.py may only "
+     "call the public audited pool API — no pool-private underscore "
+     "methods or bookkeeping attrs"),
     ("serving-bucket-discipline",
      "every prefill_chunk feed must be padded via "
      "bucket_packed_tokens (bounded XLA compile count)"),
@@ -2483,6 +2576,12 @@ RULES = (
      "the scheduler's single-writer contract; plus the thread-"
      "discipline (spawn_thread only) and guarded-by (module state "
      "declares its guard) rules applied to the engine module"),
+    ("disagg-role-discipline",
+     "in the disaggregated role-split modules (inference/disagg.py) "
+     "prefill-role scopes (classes/functions named *prefill*) must "
+     "never call the decode-only restore surface (swap_in / "
+     "import_seq / adopt_swapped / adopt) — a prefill worker "
+     "re-importing a chain collapses the role split"),
 )
 
 
@@ -2507,6 +2606,7 @@ def run_lint(root=REPO, with_op_table=True):
     out.extend(check_blocking_async(root))
     out.extend(check_thread_discipline(root))
     out.extend(check_engine_discipline(root))
+    out.extend(check_role_discipline(root))
     if with_op_table:
         out.extend(check_op_table())
         out.extend(check_inference_surface())
