@@ -1369,6 +1369,189 @@ def bench_ragged_serving(budget=64):
     return _merge_serving_rec("ragged", rec)
 
 
+# aux: unified speculative decoding — verify rows on the ragged kernel
+# ---------------------------------------------------------------------------
+
+
+def bench_spec_serving(users=4, prompt_len=48, new_tokens=32,
+                       draft_k=8, budget=64):
+    """Unified speculative-decoding arm (ISSUE 19): the decode-heavy
+    workload served three ways — FLAGS_spec_decode=off (plain packed
+    decode), legacy (per-sequence ``decode_window`` target passes),
+    and ragged (each spec-active row rides the ordinary packed
+    ``prefill_chunk`` step as ONE right-aligned (k+1)-token verify
+    row; draft propose + target verify = two bucketed ragged programs
+    per round).
+
+    The draft is PERFECTLY DISTILLED from the target: the target's
+    layers beyond the first have their o_proj / down_proj weights
+    zeroed (pre-norm residual blocks collapse to identity), so a
+    1-layer weight-shared draft reproduces the target logits exactly
+    — acceptance is 100% by construction and the measured win is the
+    verify-row packing, not draft luck. Gates: greedy identity to
+    BOTH non-spec and legacy arms, decode tokens/s >= 1.3x off, and
+    no attend-program growth over the non-spec bucket bound."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (
+        BatchScheduler,
+        PagedLlamaAdapter,
+        Request,
+    )
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    kind = _device_kind()
+    cpu = kind.startswith("cpu")
+    page_size = 4
+    layers = 10
+    if cpu:
+        cfg = llama_tiny(num_hidden_layers=layers,
+                         max_position_embeddings=256)
+        dcfg = llama_tiny(num_hidden_layers=1,
+                          max_position_embeddings=256)
+    else:
+        users, prompt_len, new_tokens = 8, 128, 48
+        layers = 8
+        mk = dict(hidden_size=512, intermediate_size=1024,
+                  num_attention_heads=8, num_key_value_heads=8,
+                  max_position_embeddings=2048)
+        cfg = llama_tiny(num_hidden_layers=layers, **mk)
+        dcfg = llama_tiny(num_hidden_layers=1, **mk)
+        page_size = 16
+    paddle.seed(3)
+    target = LlamaForCausalLM(cfg)
+    for layer in target.model.layers[1:]:
+        for lin in (layer.self_attn.o_proj, layer.mlp.down_proj):
+            lin.weight._data = jnp.zeros_like(lin.weight._data)
+    draft = LlamaForCausalLM(dcfg)
+    tgt_params = dict(target.named_parameters())
+    for name, p in draft.named_parameters():
+        p._data = tgt_params[name]._data
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(users)]
+    pages_per_seq = -(-(prompt_len + new_tokens) // page_size)
+    num_pages = 2 * users * pages_per_seq + 16
+
+    def run(mode):
+        adapter = PagedLlamaAdapter(
+            target, num_pages=num_pages, page_size=page_size,
+            max_length=cfg.max_position_embeddings)
+        kw = {}
+        if mode != "off":
+            kw = dict(
+                draft_model=PagedLlamaAdapter(
+                    draft, num_pages=num_pages, page_size=page_size,
+                    max_length=cfg.max_position_embeddings),
+                draft_k=draft_k, spec_decode=mode)
+        sched = BatchScheduler(adapter, max_batch_size=users,
+                               chunked_prefill=True,
+                               prefill_chunk_tokens=budget, **kw)
+        for i, p in enumerate(prompts):
+            sched.submit(Request(f"r{i}", list(p),
+                                 max_new_tokens=new_tokens))
+        step_walls = []
+        dec_walls = []
+        dec_tokens = 0
+        while sched.num_active or sched.num_queued:
+            ts = time.perf_counter()
+            ev = sched.step()
+            dt = time.perf_counter() - ts
+            step_walls.append(dt)
+            if ev["decode_tokens"] and not ev["prefill_tokens"]:
+                dec_walls.append(dt)
+                dec_tokens += ev["decode_tokens"]
+        gen = {f"r{i}": sched.result(f"r{i}").generated_ids
+               for i in range(users)}
+        st = dict(sched.spec_stats) if sched.draft is not None \
+            else None
+        return {
+            "gen": gen,
+            "decode_tok_s": dec_tokens / max(sum(dec_walls), 1e-9),
+            "decode_steps": len(dec_walls),
+            "step_p50_ms": 1e3 * float(np.median(step_walls)),
+            "accepted_tok_per_step": (
+                st["committed_tokens"] / max(st["rounds"], 1)
+                if st else dec_tokens / max(len(dec_walls), 1)),
+            "attend_programs": adapter.attend_program_count,
+            "compile_count": adapter.compile_count,
+            "kernel_kinds": sorted(
+                {k for k, *_ in adapter._kernel_shapes}),
+            "spec_stats": st,
+            "num_buckets": len(sched.serving_buckets),
+        }
+
+    for mode in ("off", "legacy", "ragged"):
+        run(mode)        # warmup: compiles land outside the timing
+    off = run("off")
+    legacy = run("legacy")
+    ragged = run("ragged")
+
+    # ISSUE-19 acceptance: the unified lowering changes the SCHEDULE,
+    # never the tokens — identical to the non-spec scheduler AND to
+    # the legacy per-sequence lowering it replaces
+    assert ragged["gen"] == off["gen"], (
+        "ragged spec decode diverged from the non-spec scheduler")
+    assert legacy["gen"] == off["gen"], (
+        "legacy spec decode diverged from the non-spec scheduler")
+    st = ragged["spec_stats"]
+    accept_rate = (st["accepted_draft_tokens"]
+                   / max(st["proposed_tokens"], 1))
+    assert accept_rate == 1.0, (
+        "distilled draft must be accepted verbatim", st)
+    # verify rows ride the EXISTING packed buckets: no program growth
+    # over the non-spec arm, compile count bounded by the buckets
+    assert ragged["attend_programs"] <= off["attend_programs"] \
+        or ragged["compile_count"] <= ragged["num_buckets"], (
+        off["attend_programs"], ragged["attend_programs"])
+    assert ragged["kernel_kinds"] == off["kernel_kinds"], (
+        off["kernel_kinds"], ragged["kernel_kinds"])
+    speedup = ragged["decode_tok_s"] / max(off["decode_tok_s"], 1e-9)
+    assert speedup >= 1.3, (
+        "unified spec decode won less than 1.3x over non-spec "
+        "decode", ragged["decode_tok_s"], off["decode_tok_s"])
+
+    def _arm(a):
+        return {
+            "decode_tok_s": round(a["decode_tok_s"], 1),
+            "decode_steps": a["decode_steps"],
+            "step_p50_ms": round(a["step_p50_ms"], 2),
+            "accepted_tok_per_step":
+                round(a["accepted_tok_per_step"], 2),
+            "attend_programs": a["attend_programs"],
+            "compile_count": a["compile_count"],
+            "kernel_kinds": a["kernel_kinds"],
+        }
+
+    rec = {
+        "config": "serving_spec_decode",
+        "mode": "tpu-single-chip" if not cpu else "cpu",
+        "users": users,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "draft_k": draft_k,
+        "target_layers": layers,
+        "draft_layers": 1,
+        "greedy_identical": True,       # asserted above
+        "legacy_identical": True,       # asserted above
+        "accept_rate": round(accept_rate, 4),
+        "decode_speedup_vs_off": round(speedup, 3),
+        "decode_speedup_vs_legacy": round(
+            ragged["decode_tok_s"]
+            / max(legacy["decode_tok_s"], 1e-9), 3),
+        "num_buckets": ragged["num_buckets"],
+        "program_count_bounded": True,  # asserted above
+        "off": _arm(off),
+        "legacy": _arm(legacy),
+        "ragged": _arm(ragged),
+        "spec_rounds": st["rounds"],
+        "spec_refill_tokens": st["refill_tokens"],
+    }
+    return _merge_serving_rec("spec", rec)
+
+
 # aux: page-sanitizer overhead — strict shadow-heap checking vs off
 # ---------------------------------------------------------------------------
 
@@ -3765,6 +3948,7 @@ def main() -> int:
         qrec = _emit(bench_quant_serving())
         crec = _emit(bench_chunked_prefill())
         rgrec = _emit(bench_ragged_serving())
+        sprec = _emit(bench_spec_serving())
         srec = _emit(bench_sanitizer_serving())
         ccrec = _emit(bench_concurrency_serving())
         trec = _emit(bench_telemetry_serving())
@@ -3814,6 +3998,18 @@ def main() -> int:
             == rgrec.get("unified", {}).get("attend_programs") \
             and rgrec.get("step_wall_ratio", 9.9) <= 1.25 \
             and bool(rgrec.get("ledger_share_ok"))
+        # ISSUE-19 unified-spec acceptance: ragged verify rows greedy-
+        # identical to BOTH the non-spec scheduler and the legacy
+        # decode_window lowering, the distilled draft accepted
+        # verbatim, decode tokens/s >= 1.3x non-spec, and the target
+        # program count bounded by the existing packed buckets
+        spec_ok = bool(sprec.get("greedy_identical")) and \
+            bool(sprec.get("legacy_identical")) and \
+            sprec.get("accept_rate", 0.0) >= 1.0 and \
+            sprec.get("decode_speedup_vs_off", 0.0) >= 1.3 and \
+            bool(sprec.get("program_count_bounded")) and \
+            sprec.get("ragged", {}).get("kernel_kinds") \
+            == sprec.get("off", {}).get("kernel_kinds")
         # ISSUE-6 sanitizer acceptance: off-mode serving allocates
         # NOTHING in page_sanitizer.py, strict mode is output-identical
         # and violation-free on a healthy pool
@@ -3914,8 +4110,9 @@ def main() -> int:
             rec.get("prefill_skip_frac", 0.0) >= 0.5 and \
             qrec.get("greedy_match_rate", 0.0) >= 1.0 and \
             qrec.get("seq_capacity_ratio", 0.0) >= 1.8 and \
-            chunk_ok and ragged_ok and san_ok and conc_ok and \
-            tel_ok and over_ok and engine_ok and disagg_ok
+            chunk_ok and ragged_ok and spec_ok and san_ok and \
+            conc_ok and tel_ok and over_ok and engine_ok and \
+            disagg_ok
         _emit({"metric": "serving_prefix_cache",
                "value": rec.get("prefill_skip_frac", 0.0),
                "unit": "prefill_skip_frac",
@@ -3943,6 +4140,18 @@ def main() -> int:
                    rgrec.get("attend_calls_saved"),
                "ragged_ledger_share_of_step_wall":
                    rgrec.get("ledger_share_of_step_wall"),
+               "spec_decode_speedup_vs_off":
+                   sprec.get("decode_speedup_vs_off"),
+               "spec_decode_speedup_vs_legacy":
+                   sprec.get("decode_speedup_vs_legacy"),
+               "spec_accept_rate": sprec.get("accept_rate"),
+               "spec_accepted_tok_per_step":
+                   sprec.get("ragged", {}).get(
+                       "accepted_tok_per_step"),
+               "spec_step_p50_ms":
+                   sprec.get("ragged", {}).get("step_p50_ms"),
+               "spec_attend_programs":
+                   sprec.get("ragged", {}).get("attend_programs"),
                "sanitizer_overhead_pct": srec.get("overhead_pct"),
                "sanitizer_events": srec.get("sanitizer_events", 0),
                "sanitizer_off_zero_alloc":
@@ -4161,6 +4370,7 @@ def main() -> int:
         _single("serving_prefix_cache", bench_prefix_serving)
         _single("serving_quantized", bench_quant_serving)
         _single("serving_chunked_prefill", bench_chunked_prefill)
+        _single("serving_spec", bench_spec_serving)
         _single("serving_sanitizer", bench_sanitizer_serving)
         _single("serving_telemetry", bench_telemetry_serving)
         _single("serving_overload", bench_overload_serving)

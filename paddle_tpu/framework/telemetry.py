@@ -1453,6 +1453,20 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
      "host swap-space bytes in use right now"),
     ("serving.step_retries", "counter",
      "step attempts abandoned by an injected fail_step fault"),
+    # unified speculative decoding (FLAGS_spec_decode; ISSUE 19)
+    ("serving.spec_accept_rate", "histogram",
+     "per-row draft acceptance per verify round: accepted draft "
+     "tokens / draft_k (both spec lowerings observe it through the "
+     "shared commit helper)"),
+    ("serving.spec_rounds", "counter",
+     "draft-propose / target-verify rounds executed (one per step "
+     "with any spec-active decode row)"),
+    ("serving.spec_committed_tokens", "counter",
+     "tokens committed by speculative verify rounds (accepted draft "
+     "prefix + the target's bonus token)"),
+    ("serving.spec_rollback_tokens", "counter",
+     "window tokens rolled back by cache.truncate after a verify "
+     "round (draft_k+1 minus committed, per non-retiring row)"),
     ("serving.step_backoff_steps", "counter",
      "no-op steps spent in post-failure exponential backoff"),
     # KV page pool (incubate/nn/paged_cache.py)
@@ -1649,6 +1663,11 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
      "the ragged model call (packed/pad_to/prefill/decode attrs)"),
     ("span:serving.decode", "span",
      "logits -> token commit (sampling + bookkeeping)"),
+    ("span:serving.draft_propose", "span",
+     "the DRAFT adapter's packed chunked calls of one unified-spec "
+     "round: propose + prompt mirror + lag refill "
+     "(rows/refill/draft_k attrs; exec.wall_s.draft_propose stamps "
+     "the same wall for the ledger)"),
     ("span:serving.retire", "span", "one request's retirement"),
     ("span:serving.preempt", "span",
      "one victim's swap-out to the host tier (req/reason attrs)"),

@@ -181,6 +181,14 @@ class TokenStream:
         if not self._ended:
             self._q.put_nowait(tok)
 
+    def _deliver_many(self, toks):
+        # one loop hop delivers a whole step's committed tokens —
+        # speculative rounds commit up to draft_k+1 per stream per
+        # step (see ServingEngine._flush_tokens)
+        if not self._ended:
+            for tok in toks:
+                self._q.put_nowait(tok)
+
     def _finish(self):
         self._q.put_nowait(_EOS)
 
@@ -224,6 +232,14 @@ class ServingEngine:
         # GIL-atomic snapshots only. _cv_pump is the sanitizer's
         # witness for that contract.
         self._streams = {}
+        # per-step token coalescing (ISSUE 19): the on_token hook
+        # only QUEUES committed tokens here (pump thread, inside
+        # scheduler.step()); _flush_tokens marshals each stream's
+        # whole batch with ONE call_soon_threadsafe after the step —
+        # a speculative round commits up to draft_k+1 tokens per
+        # stream per step, and one loop hop per token would multiply
+        # the marshalling cost by the acceptance rate
+        self._pending_toks = {}
         self._bp_state = BP_OPEN
         self._bp_reason = ""
         self._bp_since = 0
@@ -581,15 +597,36 @@ class ServingEngine:
         self._resolve(fut, result=stream)
 
     def _make_on_token(self, stream, inner):
-        call_loop = self._call_loop
+        pending = self._pending_toks
 
         def hook(req, tok, is_prompt):
             if inner is not None:
                 inner(req, tok, is_prompt)
             if not is_prompt:
-                call_loop(stream._deliver, int(tok))
+                # pump thread (inside scheduler.step()): queue only;
+                # _flush_tokens ships the step's batch in one hop
+                ent = pending.get(req.req_id)
+                if ent is None:
+                    pending[req.req_id] = ent = (stream, [])
+                ent[1].append(int(tok))
 
         return hook
+
+    def _flush_tokens(self):
+        """Deliver every token queued by the on_token hooks since the
+        last flush — one ``call_soon_threadsafe`` per STREAM, not per
+        token. Runs before any ``_finish`` marshalling (same FIFO
+        loop queue), so a retiring stream's last tokens always
+        precede its EOS."""
+        if not self._pending_toks:
+            return
+        self._note_write()
+        # drain IN PLACE: the on_token hooks hold a reference to this
+        # dict, so swapping in a fresh one would orphan them
+        pending = list(self._pending_toks.values())
+        self._pending_toks.clear()
+        for stream, toks in pending:
+            self._call_loop(stream._deliver_many, toks)
 
     def _pump_cancel(self, req_id, fut):
         ok = False
@@ -604,6 +641,7 @@ class ServingEngine:
         self._resolve(fut, result=ok)
 
     def _pump_retire(self):
+        self._flush_tokens()
         if not self._streams:
             return
         done = [rid for rid, s in self._streams.items()
@@ -635,6 +673,7 @@ class ServingEngine:
             self._resolve(f, result=True)
 
     def _pump_shutdown(self):
+        self._flush_tokens()
         self._note_write()
         self._stop = True
         self._reject_inbox()

@@ -24,7 +24,10 @@ import jax.numpy as jnp
 from ..framework.core import Tensor, no_grad
 from ..framework.flags import flag
 from ..incubate.nn import PagedKVCacheManager
-from ..ops.kernels.paged_attention import pad_plan_i32 as _pad_plan
+from ..ops.kernels.paged_attention import (
+    pad_plan_i32 as _pad_plan,
+    packed_position_index as _packed_position_index,
+)
 from ..ops.kernels.rope import apply_rotary_emb, build_rope_cache
 from ..tensor.manipulation import reshape
 
@@ -381,7 +384,7 @@ def _right_align_plan(row_indices, starts, counts, t_pad, rows_pad):
 
 
 def _prefill_chunk(self, token_ids, seq_ids, start_positions=None,
-                   pad_to=None):
+                   pad_to=None, logits_rows=None):
     """One ragged mixed prefill/decode step (the Ragged Paged
     Attention shape — see PAPERS.md): row i appends the
     ``len(token_ids[i])`` tokens of ``token_ids[i]`` to sequence
@@ -390,6 +393,19 @@ def _prefill_chunk(self, token_ids, seq_ids, start_positions=None,
     ``decode_token`` rows, multi-token rows are prefill chunks
     resuming at ``start_positions[i]`` (validated against the cache;
     mid-prompt resume and mid-page cached-prefix resume both work).
+
+    ``logits_rows`` (ISSUE 19, speculative VERIFY rows): a list of
+    row indices whose PER-POSITION logits the caller needs — the
+    greedy verify step compares the target argmax at every window
+    slot against the draft proposal there. The return value becomes
+    ``(last_logits, full_logits)`` where ``full_logits`` is the
+    ``(sum(counts[i] for i in logits_rows), vocab)`` concatenation
+    of the listed rows' positions in list order (split host-side by
+    the known counts). The multi-row sampling epilogue is a gather
+    (ops/kernels/paged_attention.packed_position_index) + norm +
+    lm-head over the packed activations the step already computed —
+    eager like the chunk body, so verify rows add NO compiled attend
+    program beyond the existing bucketed ragged family.
 
     All dense compute (embed / qkv / o_proj / mlp / norms) runs over
     ONE flat packed token axis padded to ``pad_to`` (the scheduler
@@ -583,7 +599,15 @@ def _prefill_chunk(self, token_ids, seq_ids, start_positions=None,
             x = x + layer.mlp(layer.post_attention_layernorm(x))
         x_last = Tensor(x._data[jnp.asarray(last_idx, jnp.int32)])
         h = self.model.model.norm(x_last)
-        return self.model._head(h)               # (B, vocab)
+        last = self.model._head(h)               # (B, vocab)
+        if logits_rows is None:
+            return last
+        # multi-row sampling epilogue: per-position logits for the
+        # listed (verify) rows, concatenated in list order
+        vidx = _packed_position_index(starts, counts, logits_rows)
+        x_full = Tensor(x._data[vidx])
+        full = self.model._head(self.model.model.norm(x_full))
+        return last, full
 
 
 def _attend_rows_two_kernel(self, cache, qh, attn, s_plan, m_plan,

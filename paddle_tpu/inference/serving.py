@@ -187,6 +187,21 @@ def bucket_packed_tokens(n: int, buckets=None) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def _accepts_logits_rows(model) -> bool:
+    """True when ``model.prefill_chunk`` exposes the per-position
+    logits epilogue (``logits_rows=`` keyword) the unified ragged
+    speculative step samples verify windows from."""
+    fn = getattr(model, "prefill_chunk", None)
+    if fn is None:
+        return False
+    try:
+        import inspect
+
+        return "logits_rows" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 class RequestState:
     QUEUED = "queued"
     PREFILL = "prefill"
@@ -282,7 +297,8 @@ class BatchScheduler:
                  prefill_chunk_tokens=None, serving_buckets=None,
                  prefix_align=1, slo=None, watchdog=None,
                  max_queue=None, max_inflight_per_tenant=None,
-                 preempt=None, swap_bytes=None, fault_injector=None):
+                 preempt=None, swap_bytes=None, fault_injector=None,
+                 spec_decode=None):
         self.model = model
         self.max_batch_size = int(max_batch_size)
         self.page_watermark = float(page_watermark)
@@ -290,6 +306,19 @@ class BatchScheduler:
         self._queue = collections.deque()
         self._active = {}
         self._finished = {}
+        # speculative-decoding lowering (ISSUE 19): 'ragged' packs
+        # verify windows as rows of the ordinary prefill_chunk step,
+        # 'legacy' keeps the PR-4 decode_window pass for A/B, 'off'
+        # ignores the draft entirely (the trivial non-spec baseline)
+        self.spec_mode = str(
+            flag("spec_decode") if spec_decode is None
+            else spec_decode).lower()
+        if self.spec_mode not in ("off", "legacy", "ragged"):
+            raise ValueError(
+                "spec_decode must be 'off', 'legacy' or 'ragged', "
+                f"got {self.spec_mode!r} (FLAGS_spec_decode)")
+        if self.spec_mode == "off":
+            draft_model = None
         # chunked prefill (module docstring): None -> auto (on when
         # the model implements prefill_chunk), True/False force.
         # Models that only speak decode_token keep the token-per-step
@@ -313,6 +342,18 @@ class BatchScheduler:
         self._spec_chunked = self.chunked_prefill and (
             draft_model is None
             or hasattr(draft_model, "prefill_chunk"))
+        # unified ragged spec (ISSUE 19): verify windows ride the
+        # ordinary packed prefill_chunk step as (k+1)-token rows, so a
+        # decode round is two bucketed ragged programs (draft propose +
+        # target verify) instead of a per-round decode_window pass.
+        # Needs chunked prefill on both adapters and the per-position
+        # logits epilogue (prefill_chunk(..., logits_rows=)).
+        self._spec_ragged = bool(
+            draft_model is not None
+            and self.spec_mode == "ragged"
+            and self._spec_chunked
+            and hasattr(draft_model, "prefill_chunk")
+            and _accepts_logits_rows(model))
         self.chunk_stats = {
             "steps": 0, "chunk_calls": 0, "prefill_tokens": 0,
             "decode_tokens": 0, "packed_tokens": 0, "padded_tokens": 0,
@@ -321,12 +362,15 @@ class BatchScheduler:
         # True builds a RadixPrefixCache over the model's own caches;
         # or pass a pre-built instance (shared across schedulers)
         if prefix_cache:
-            if draft_model is not None:
+            if draft_model is not None and not self._spec_ragged:
                 raise ValueError(
-                    "prefix caching is not supported with speculative "
-                    "decoding: the draft adapter keeps its OWN KV "
-                    "pool, so a cached (skipped) target prefill would "
-                    "leave the draft cache without the prompt")
+                    "prefix caching is not supported with LEGACY "
+                    "speculative decoding: the draft adapter keeps its "
+                    "OWN KV pool, so a cached (skipped) target prefill "
+                    "would leave the draft cache without the prompt; "
+                    "spec_decode='ragged' lifts this (the ragged spec "
+                    "step refills a lagging draft cache from the "
+                    "committed prefix)")
             if prefix_cache is True:
                 from .prefix_cache import RadixPrefixCache
 
@@ -367,7 +411,10 @@ class BatchScheduler:
                 "use models.speculative_generate for sampled "
                 "speculative decoding")
         self.spec_stats = {"rounds": 0, "target_calls": 0,
-                           "draft_calls": 0, "committed_tokens": 0}
+                           "draft_calls": 0, "committed_tokens": 0,
+                           "proposed_tokens": 0,
+                           "accepted_draft_tokens": 0,
+                           "refill_tokens": 0, "draft_discards": 0}
         # overload survival (module docstring "Overload survival"):
         # bounded submit queue + per-tenant in-flight cap + sequence
         # preemption onto the host swap tier + deadline aborts
@@ -389,10 +436,15 @@ class BatchScheduler:
         swap_bytes = int(flag("serving_swap_bytes")
                          if swap_bytes is None else swap_bytes)
         self.swap_space = None
-        if preempt and swap_bytes > 0 and draft_model is None:
-            # the draft adapter keeps its OWN KV pool; swapping the
-            # target without the draft would desynchronize them, so
-            # speculative scheduling keeps wait-in-queue admission
+        if preempt and swap_bytes > 0 and (draft_model is None
+                                           or self._spec_ragged):
+            # legacy spec: the draft adapter keeps its OWN KV pool;
+            # swapping the target without the draft would
+            # desynchronize them, so it keeps wait-in-queue admission.
+            # Ragged spec lifts this: the draft KV is disposable — it
+            # is discarded at swap-out and re-prefilled from the
+            # committed prefix at swap-in (the draft pool never swaps,
+            # so it stays wait-free)
             from ..incubate.nn.paged_cache import HostKVSwapSpace
 
             self.swap_space = HostKVSwapSpace(swap_bytes)
@@ -677,6 +729,24 @@ class BatchScheduler:
             "retired": len(self._finished),
             "chunked_prefill": self.chunked_prefill,
         }
+        if self.draft is not None:
+            # accept-rate column (ISSUE 19 satellite): committed /
+            # proposed over the scheduler's lifetime, plus the round
+            # counters behind it
+            ss = self.spec_stats
+            proposed = ss["proposed_tokens"]
+            rounds = ss["rounds"]
+            info["spec"] = {
+                "mode": "ragged" if self._spec_ragged else "legacy",
+                "rounds": rounds,
+                "committed_tokens": ss["committed_tokens"],
+                "accept_rate": (
+                    round(ss["accepted_draft_tokens"] / proposed, 4)
+                    if proposed else None),
+                "tokens_per_round": (
+                    round(ss["committed_tokens"] / rounds, 3)
+                    if rounds else None),
+            }
         if self._slo is not None:
             info["slo"] = self._slo.to_dict()
             m = self._metrics
@@ -1126,6 +1196,12 @@ class BatchScheduler:
         if self._cv_state is not None:
             self._cv_state.write()
         del self._swapped[rid]
+        if self.draft is not None:
+            # fresh (empty) draft chain: the ragged spec step's
+            # draft-refill rows re-prefill it from the committed
+            # prefix over the next steps (the row verifies again
+            # once the draft pool has caught up)
+            self.draft.alloc(rid)
         req.state = (RequestState.DECODE if req.generated_ids
                      else RequestState.PREFILL)
         self._active[rid] = req
@@ -1217,6 +1293,14 @@ class BatchScheduler:
                     fp, nb = c.swap_out(rid, space)
                     freed += fp
                     nbytes += nb
+            if self.draft is not None:
+                # ragged spec only (legacy never builds a swap space
+                # with a draft): the draft KV is disposable — discard
+                # it here and let the ragged step re-prefill it from
+                # the committed prefix after swap-in. The draft pool
+                # itself never swaps, so it stays wait-free.
+                self.draft.free(rid)
+                self.spec_stats["draft_discards"] += 1
         req.state = RequestState.SWAPPED
         req._preemptions += 1
         if self._cv_state is not None:
@@ -2025,6 +2109,8 @@ class BatchScheduler:
                     "prefill_tokens": 0, "decode_tokens": 0}
 
         if self.draft is not None:
+            if self._spec_ragged:
+                return self._step_spec_ragged(admitted, hit_tokens)
             return self._step_spec(admitted)
         if self.chunked_prefill:
             return self._step_chunked(admitted, hit_tokens)
@@ -2339,52 +2425,26 @@ class BatchScheduler:
                     [[self._active[s].generated_ids[-1]]
                      + [props[j][i] for j in range(k)]
                      for i, s in enumerate(dec)], np.int64)
-                tl = self.model.decode_window(windows, dec)
+                # the legacy dense verify pass this PR's unified
+                # ragged lowering replaces — kept verbatim behind
+                # FLAGS_spec_decode=legacy as the A/B oracle
+                tl = self.model.decode_window(windows, dec)  # trace-lint: ok(legacy A/B lowering)
                 preds = np.argmax(
                     np.asarray(tl._data), axis=-1)  # (B, k+1)
                 self.spec_stats["rounds"] += 1
                 self.spec_stats["target_calls"] += 1
                 self.spec_stats["draft_calls"] += k + 1
+                if self._metrics is not None:
+                    self._metrics.inc("serving.spec_rounds")
 
                 # accept/commit (and retire/rollback) stay inside the
                 # decode span — same schema as the non-spec paths
                 for i, s in enumerate(dec):
-                    req = self._active[s]
-                    n_acc = 0
-                    while (n_acc < k
-                           and props[n_acc][i] == int(preds[i, n_acc])):
-                        n_acc += 1
-                        if (req.eos_id is not None
-                                and props[n_acc - 1][i] == req.eos_id):
-                            break
-                    accepted = [props[j][i] for j in range(n_acc)]
-                    if (req.eos_id is None or not accepted
-                            or accepted[-1] != req.eos_id):
-                        accepted.append(int(preds[i, n_acc]))
-                    done = False
-                    committed = 0
-                    for t in accepted:
-                        req.generated_ids.append(t)
-                        self._note_gen_token(req)
-                        committed += 1
-                        dec_tokens += 1
-                        self.spec_stats["committed_tokens"] += 1
-                        if req.on_token is not None:
-                            req.on_token(req, t, False)
-                        if self._done(req, t):
-                            done = True
-                            break
-                    if done:
-                        self._retire(req)
-                        finished += 1
-                    else:
-                        # committed prefix back in the caches:
-                        # everything except the newest token (fed
-                        # next round)
-                        for c in self.model.caches:
-                            c.truncate(s, base_t[s] + committed)
-                        for c in self.draft.caches:
-                            c.truncate(s, base_d[s] + committed)
+                    committed, retired = self._commit_spec_row(
+                        s, [props[j][i] for j in range(k)], preds[i],
+                        base_t[s], base_d[s])
+                    dec_tokens += committed
+                    finished += int(retired)
             advanced += len(dec)
 
         # prefix caching is mutually exclusive with speculative
@@ -2394,6 +2454,286 @@ class BatchScheduler:
                 "finished": finished, "prefix_hit_tokens": 0,
                 "prefill_tokens": pre_tokens,
                 "decode_tokens": dec_tokens}
+
+    def _commit_spec_row(self, s, props_i, preds_i, base_t, base_d):
+        """Greedy acceptance for ONE spec-active decode row: commit
+        the longest draft-proposal prefix matching the target's
+        per-position argmax, plus the target's bonus token, then roll
+        BOTH pools back to the committed prefix (everything except
+        the newest token, which feeds the next round). Shared by the
+        legacy ``decode_window`` path and the unified ragged step —
+        one acceptance rule is the token-identity guarantee between
+        the two lowerings. ``props_i`` is the row's draft_k
+        proposals; ``preds_i`` the target argmax at each of the
+        draft_k+1 window positions; ``base_t``/``base_d`` the
+        target/draft cache lengths before the round. Returns
+        ``(committed, retired)``."""
+        req = self._active[s]
+        k = len(props_i)
+        n_acc = 0
+        while n_acc < k and props_i[n_acc] == int(preds_i[n_acc]):
+            n_acc += 1
+            if (req.eos_id is not None
+                    and props_i[n_acc - 1] == req.eos_id):
+                break
+        accepted = list(props_i[:n_acc])
+        if (req.eos_id is None or not accepted
+                or accepted[-1] != req.eos_id):
+            accepted.append(int(preds_i[n_acc]))
+        done = False
+        committed = 0
+        for t in accepted:
+            req.generated_ids.append(t)
+            self._note_gen_token(req)
+            committed += 1
+            self.spec_stats["committed_tokens"] += 1
+            if req.on_token is not None:
+                req.on_token(req, t, False)
+            if self._done(req, t):
+                done = True
+                break
+        self.spec_stats["proposed_tokens"] += k
+        self.spec_stats["accepted_draft_tokens"] += n_acc
+        if self._metrics is not None:
+            self._metrics.observe("serving.spec_accept_rate",
+                                  (n_acc / k) if k else 0.0)
+            self._metrics.inc("serving.spec_committed_tokens",
+                              committed)
+        if done:
+            if self.prefix_cache is not None:
+                # retire inserts the chain into the radix tree keyed
+                # by the COMMITTED token stream — drop the unverified
+                # window tail first so cached KV == committed tokens
+                for c in self.model.caches:
+                    c.truncate(s, base_t + committed)
+            self._retire(req)
+            return committed, True
+        if self._metrics is not None:
+            self._metrics.inc("serving.spec_rollback_tokens",
+                              (k + 1) - committed)
+        # committed prefix back in the caches: everything except the
+        # newest token (fed next round)
+        for c in self.model.caches:
+            c.truncate(s, base_t + committed)
+        for c in self.draft.caches:
+            c.truncate(s, base_d + committed)
+        return committed, False
+
+    def _step_spec_ragged(self, admitted, hit_tokens) -> dict:
+        """Unified speculative scheduler step (ISSUE 19,
+        ``FLAGS_spec_decode=ragged``): one decode round is exactly
+        TWO bucketed ragged program families. The draft adapter
+        proposes ``draft_k`` tokens through its OWN chunked step —
+        call 0 packs every propose row together with prompt-mirror
+        chunks and draft-refill rows, calls 1..k feed successive
+        proposals (the k-th feed keeps the draft pool at committed
+        prefix + window, as in the legacy path) — then the target
+        verifies EVERY window in the ordinary :meth:`prefill_chunk`
+        step: each spec-active sequence contributes one right-aligned
+        ``draft_k+1``-token row next to the regular prefill-chunk
+        rows, and the per-position logits epilogue
+        (``logits_rows=``) hands back the window argmax for greedy
+        acceptance. ``cache.truncate`` rolls both pools back past
+        the first mismatch (COW/prefix-shared pages survive — page
+        sanitizer strict). No per-sequence target forward exists on
+        this path (tools/lint_codebase.py ``spec-row-discipline``).
+
+        Draft-lag rows: after a prefix-cache hit or a swap-in the
+        draft pool is behind the committed prefix (its KV was never
+        built, or was discarded at swap-out). Such rows pause
+        target-side and instead REFILL the draft cache from the
+        committed token stream under the chunk budget until it
+        catches up — wait-free, no separate prefill pass, and they
+        count as advanced so the stall watchdog stays quiet."""
+        sids = sorted(self._active)
+        t_cache = self.model.caches[0]
+        d_cache = self.draft.caches[0]
+        k = self.draft_k
+        pre, dec, lag = [], [], []
+        for s in sids:
+            req = self._active[s]
+            if req.state == RequestState.PREFILL:
+                pre.append(s)
+            elif d_cache.seq_len(s) == t_cache.seq_len(s):
+                dec.append(s)
+            else:
+                lag.append(s)
+        base_t = {s: t_cache.seq_len(s) for s in dec}
+        base_d = {s: d_cache.seq_len(s) for s in dec}
+        # target-side chunk plan for the prefill rows (shared budget)
+        if pre:
+            rows, feeds, starts, n_pre, _ = self._chunk_feeds(pre)
+        else:
+            rows, feeds, starts, n_pre = [], [], [], 0
+
+        # ---- draft program: propose, mirror, refill — all rows of
+        # the draft adapter's own bucketed chunked step
+        props = []  # props[j][i] = (j+1)-th proposal for dec[i]
+        lag_refilled = 0
+        refill_tokens = 0
+        t_draft = telemetry.clock() if self._metrics is not None \
+            else 0.0
+        with self._span("serving.draft_propose", rows=len(dec),
+                        refill=len(lag), draft_k=k):
+            d_rows, d_feeds, d_starts = [], [], []
+            for i, s in enumerate(dec):
+                d_rows.append(s)
+                d_feeds.append([self._active[s].generated_ids[-1]])
+                d_starts.append(base_d[s])
+            # refill lagging draft chains from the committed stream
+            # (lag rows first — they block verify entirely — then
+            # prefix-hit prefill rows whose draft never saw the hit)
+            d_budget = self.prefill_chunk_tokens
+            for s in lag + [r for r in pre
+                            if d_cache.seq_len(r) < t_cache.seq_len(r)]:
+                if d_budget <= 0:
+                    break
+                req = self._active[s]
+                d_len = d_cache.seq_len(s)
+                gap = t_cache.seq_len(s) - d_len
+                take = min(gap, d_budget)
+                if take <= 0:
+                    continue
+                d_budget -= take
+                allt = req.prompt_ids + req.generated_ids
+                d_rows.append(s)
+                d_feeds.append(allt[d_len:d_len + take])
+                d_starts.append(d_len)
+                refill_tokens += take
+                if req.state == RequestState.DECODE:
+                    lag_refilled += 1
+            # mirror this step's prompt chunks for draft-synced
+            # prefill rows (same feed, same start — the legacy
+            # prompt-phase mirroring, packed into the same call)
+            for bi, r in enumerate(rows):
+                if d_cache.seq_len(r) == starts[bi]:
+                    d_rows.append(r)
+                    d_feeds.append(feeds[bi])
+                    d_starts.append(starts[bi])
+            if d_rows:
+                packed0 = sum(len(f) for f in d_feeds)
+                pad0 = bucket_packed_tokens(packed0,
+                                            self.serving_buckets)
+                dl = self.draft.prefill_chunk(
+                    d_feeds, d_rows, d_starts, pad_to=pad0)
+            if dec:
+                dl_np = np.asarray(
+                    dl.numpy() if hasattr(dl, "numpy") else dl)
+                cur = [int(np.argmax(dl_np[i]))
+                       for i in range(len(dec))]
+                props.append(cur)
+                pad_j = bucket_packed_tokens(len(dec),
+                                             self.serving_buckets)
+                for j in range(1, k + 1):
+                    dl = self.draft.prefill_chunk(
+                        [[c] for c in cur], dec,
+                        [base_d[s] + j for s in dec], pad_to=pad_j)
+                    if j == k:
+                        # k-th proposal fed for pool symmetry with
+                        # the window; its logits are never sampled
+                        break
+                    dl_np = np.asarray(
+                        dl.numpy() if hasattr(dl, "numpy") else dl)
+                    cur = [int(np.argmax(dl_np[i]))
+                           for i in range(len(dec))]
+                    props.append(cur)
+        if self._metrics is not None:
+            # performance-ledger stamp for the DRAFT program: its
+            # share_of_step_wall is the draft overhead the acceptance
+            # rate has to pay for (framework/perf_ledger.py)
+            self._metrics.observe("exec.wall_s.draft_propose",
+                                  telemetry.clock() - t_draft)
+            self._metrics.inc("exec.count.draft_propose")
+        self.spec_stats["refill_tokens"] += refill_tokens
+
+        # ---- target program: ONE packed ragged step — verify rows
+        # (right-aligned k+1-token windows, listed first) next to the
+        # ordinary prefill-chunk rows
+        t_rows, t_feeds, t_starts = [], [], []
+        for i, s in enumerate(dec):
+            t_rows.append(s)
+            t_feeds.append([self._active[s].generated_ids[-1]]
+                           + [props[j][i] for j in range(k)])
+            t_starts.append(base_t[s])
+        t_rows += rows
+        t_feeds += feeds
+        t_starts += starts
+
+        finished = 0
+        dec_tokens = 0
+        preds = last_np = None
+        packed = pad_to = 0
+        if t_rows:
+            packed = sum(len(f) for f in t_feeds)
+            pad_to = bucket_packed_tokens(packed, self.serving_buckets)
+            t_exec = telemetry.clock() if self._metrics is not None \
+                else 0.0
+            with self._span("serving.prefill_chunk", rows=len(t_rows),
+                            packed=packed, pad_to=pad_to,
+                            prefill=n_pre, decode=0, verify=len(dec)):
+                out = self.model.prefill_chunk(
+                    t_feeds, t_rows, t_starts, pad_to=pad_to,
+                    logits_rows=(list(range(len(dec))) if dec
+                                 else None))
+                if dec:
+                    last, full = out
+                    full_np = np.asarray(
+                        full.numpy() if hasattr(full, "numpy")
+                        else full)
+                    preds = np.argmax(
+                        full_np.reshape(len(dec), k + 1, -1), axis=-1)
+                else:
+                    last = out
+                last_np = np.asarray(
+                    last.numpy() if hasattr(last, "numpy") else last)
+            if self._metrics is not None:
+                self._metrics.observe("exec.wall_s.prefill_chunk",
+                                      telemetry.clock() - t_exec)
+                self._metrics.inc("exec.count.prefill_chunk")
+            cs = self.chunk_stats
+            cs["steps"] += 1
+            cs["chunk_calls"] += 1
+            cs["prefill_tokens"] += n_pre
+            cs["packed_tokens"] += packed
+            cs["padded_tokens"] += pad_to - packed
+            if dec:
+                self.spec_stats["rounds"] += 1
+                self.spec_stats["target_calls"] += 1
+                self.spec_stats["draft_calls"] += k + 1
+                if self._metrics is not None:
+                    self._metrics.inc("serving.spec_rounds")
+
+            # accept/commit (and retire/rollback) inside the decode
+            # span — same schema as every other scheduler path
+            with self._span("serving.decode", rows=len(t_rows),
+                            draft_k=k):
+                for i, s in enumerate(dec):
+                    committed, retired = self._commit_spec_row(
+                        s, [props[j][i] for j in range(k)], preds[i],
+                        base_t[s], base_d[s])
+                    dec_tokens += committed
+                    finished += int(retired)
+                for bi, r in enumerate(rows):
+                    finished += self._advance_prefill_row(
+                        self._active[r], feeds[bi],
+                        last_np[len(dec) + bi])
+
+        out = {
+            "admitted": admitted,
+            "advanced": len(t_rows) + lag_refilled,
+            "finished": finished,
+            "prefix_hit_tokens": hit_tokens,
+            "prefill_tokens": n_pre,
+            "decode_tokens": dec_tokens,
+            "spec_verify_rows": len(dec),
+            "compile_count": getattr(self.model, "compile_count",
+                                     None),
+            "attend_programs": getattr(
+                self.model, "attend_program_count", None),
+        }
+        if t_rows:
+            out["chunk_utilization"] = round(packed / pad_to, 4)
+        return out
 
     def _done(self, req: Request, last_tok: int) -> bool:
         if req.eos_id is not None and last_tok == req.eos_id:

@@ -56,6 +56,7 @@ from .rms_norm import rms_norm, layer_norm_fused
 from .flash_attention import flash_attention, flash_attention_with_lse
 from .rope import apply_rotary_emb
 from .paged_attention import (  # noqa
+    packed_position_index,
     paged_attention,
     paged_attention_reference,
     paged_prefill_attention,

@@ -10,7 +10,10 @@ TPU paged-attention recipe ("Ragged Paged Attention" — see PAPERS.md):
 * a per-sequence ``page_table (B, max_pages)`` maps logical pages to
   physical ones; ``seq_lens (B,)`` bounds the ragged KV lengths and a
   per-row ``q_lens (B,)`` bounds the ragged QUERY lengths — 1 for
-  decode rows, n for prefill chunks, so one kernel handles a mixed
+  decode rows, n for prefill chunks, k+1 for speculative VERIFY rows
+  (a draft window riding right-aligned like any other chunk; the
+  caller samples per-position logits via
+  :func:`packed_position_index`), so one kernel handles a mixed
   packed batch uniformly (:func:`paged_ragged_attention`);
 * the kernel grid is (batch, q_heads, logical_pages); the page table
   and both length vectors ride scalar prefetch so each step's
@@ -668,6 +671,27 @@ def pad_plan_i32(a, n, fill):
         return a
     return jnp.concatenate(
         [a, jnp.full((short,), fill, jnp.int32)])
+
+
+def packed_position_index(starts, counts, rows):
+    """Flat packed-axis indices of EVERY position of the listed rows,
+    in row order — the multi-row sampling epilogue's gather plan.
+
+    The unified ragged step computes the head over each row's LAST
+    packed position only (one sampled token per row). Speculative
+    VERIFY rows need the logits of all ``counts[i]`` positions (the
+    per-position greedy acceptance compares the target's argmax at
+    window slot j against draft proposal j), so the epilogue gathers
+    ``starts[i] .. starts[i] + counts[i] - 1`` for each verify row
+    and runs norm + lm-head over that concatenation — host-built like
+    the right-align plan, eager like the chunk body, so it adds no
+    compiled program (the acceptance bound of ISSUE 19: spec rows
+    reuse the existing bucketed kernel family)."""
+    idx = []
+    for i in rows:
+        s = int(starts[i])
+        idx.append(jnp.arange(s, s + int(counts[i]), dtype=jnp.int32))
+    return jnp.concatenate(idx)
 
 
 def paged_ragged_fused_step(x, wq, wk, wv, wo, biases, cos, sin, pos,

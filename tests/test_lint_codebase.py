@@ -1019,6 +1019,62 @@ class TestUnifiedAttention:
         assert "unified-attention" in ids
 
 
+class TestSpecRowDiscipline:
+    """ISSUE-19 satellite: no per-sequence target forward outside the
+    packed ragged step in the serving layers — speculative verify
+    windows ride prefill_chunk as (draft_k+1)-token rows; a
+    decode_window call is the banned legacy dispatch lane unless it
+    carries the explicit legacy-body waiver."""
+
+    def test_seeded_decode_window_call_flagged(self):
+        bad = (
+            "def verify(model, windows, sids):\n"
+            "    return model.decode_window(windows, sids)\n"
+        )
+        v = lint_codebase.lint_spec_rows_file(
+            "fake/serving.py", text=bad)
+        assert len(v) == 1, v
+        assert "decode_window" in v[0]
+        assert "prefill_chunk" in v[0]
+
+    def test_waiver_suppresses(self):
+        waived = (
+            "def verify(model, windows, sids):\n"
+            "    return model.decode_window(windows, sids)"
+            "  # trace-lint: ok(legacy A/B)\n"
+        )
+        assert lint_codebase.lint_spec_rows_file(
+            "fake/serving.py", text=waived) == []
+
+    def test_binding_the_legacy_entry_is_clean(self):
+        # defining/attaching the legacy surface is fine — only a
+        # CALL re-opens the per-sequence verify dispatch lane
+        ok = (
+            "def _window_logits(self, windows, sids):\n"
+            "    return windows\n"
+            "class A:\n"
+            "    pass\n"
+            "A.decode_window = _window_logits\n"
+        )
+        assert lint_codebase.lint_spec_rows_file(
+            "fake/paged_llama.py", text=ok) == []
+
+    def test_serving_layers_covered_and_clean(self):
+        covered = [os.path.join(REPO, f)
+                   for f in lint_codebase.SPEC_ROW_FILES]
+        assert any(p.endswith(os.path.join("inference", "serving.py"))
+                   for p in covered)
+        for p in covered:
+            assert os.path.exists(p), p
+        # the retained legacy body carries its waiver; everything
+        # else routes verify through the packed ragged step
+        assert lint_codebase.check_spec_rows() == []
+
+    def test_rule_inventory_has_spec_row_discipline(self):
+        ids = [r for r, _ in lint_codebase.RULES]
+        assert "spec-row-discipline" in ids
+
+
 class TestWireQuantOwnership:
     """ISSUE-14 wire-quant ownership rule: quantize-on-the-wire
     (FLAGS_collective_dtype) lives only in the jax-only kernel module

@@ -113,17 +113,24 @@ class _L:
 
 class _FakeChunkModel(_FakeModel):
     """Ragged chunked-prefill + spec-decode fake: implements
-    prefill_chunk and decode_window on host arrays, always emitting
-    token 1 (so draft and target agree and every proposal is
-    accepted)."""
+    prefill_chunk (with the per-position ``logits_rows`` epilogue the
+    unified ragged spec step samples verify windows from) and the
+    legacy decode_window, on host arrays, always emitting token 1
+    (so draft and target agree and every proposal is accepted)."""
 
-    def prefill_chunk(self, feeds, rows, starts, pad_to=None):
+    def prefill_chunk(self, feeds, rows, starts, pad_to=None,
+                      logits_rows=None):
         c = self.caches[0]
         for s, f in zip(rows, feeds):
             c.lens[s] += len(f)
         logits = np.zeros((len(rows), self.vocab), np.float32)
         logits[:, 1] = 1.0
-        return logits
+        if logits_rows is None:
+            return logits
+        n_full = sum(len(feeds[i]) for i in logits_rows)
+        full = np.zeros((n_full, self.vocab), np.float32)
+        full[:, 1] = 1.0
+        return logits, full
 
     def decode_token(self, feed, sids):
         return _L(super().decode_token(feed, sids))

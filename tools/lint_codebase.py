@@ -1141,6 +1141,79 @@ def check_unified_attention(root=REPO):
     return out
 
 
+# unified speculative decoding (ISSUE 19): the packed ragged
+# prefill_chunk step IS the target verify pass — each spec-active
+# sequence rides it as one right-aligned (draft_k+1)-token row with
+# per-position logits out of the epilogue. A per-sequence / dense
+# target forward outside that step (`decode_window`, the legacy
+# dense-gather verify) re-opens the extra dispatch lane per decode
+# round the unification removed; the sanctioned legacy body behind
+# FLAGS_spec_decode=legacy carries an explicit waiver.
+SPEC_ROW_FILES = UNIFIED_ATTENTION_FILES
+
+_SPEC_ROW_BANNED = frozenset({"decode_window"})
+
+
+class _SpecRowVisitor(ast.NodeVisitor):
+    """Flag every ``decode_window`` CALL in the serving layers that
+    does not carry a same-line waiver (defining/binding the legacy
+    entry point is fine — only invoking it re-splits the verify
+    dispatch)."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+
+    def _call_name(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        if isinstance(fn, ast.Name):
+            return fn.id
+        return None
+
+    def _waived(self, lineno):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        return _WAIVER_MARK in line
+
+    def visit_Call(self, node):
+        name = self._call_name(node)
+        if name in _SPEC_ROW_BANNED and not self._waived(node.lineno):
+            self.violations.append(
+                "%s:%d: %r is a per-sequence target forward outside "
+                "the packed ragged step — speculative verify windows "
+                "must ride prefill_chunk as (draft_k+1)-token rows "
+                "(ISSUE 19 spec-row-discipline); fix it or waive the "
+                "sanctioned FLAGS_spec_decode=legacy body with "
+                "'%s(<reason>)'"
+                % (self.relpath, node.lineno, name, _WAIVER_MARK))
+        self.generic_visit(node)
+
+
+def lint_spec_rows_file(path, text=None):
+    """Spec-row-discipline check; returns violation strings."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _SpecRowVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_spec_rows(root=REPO):
+    out = []
+    for f in SPEC_ROW_FILES:
+        out.extend(lint_spec_rows_file(os.path.join(root, f)))
+    return out
+
+
 # modules that must stay pure-jax: collective-matmul ring kernels run
 # entirely inside jit traces under shard_map — a host-side import is
 # either dead weight or a per-step host sync waiting to happen
@@ -2520,6 +2593,13 @@ RULES = (
      "kernel pair (one attend program per packed config, not two; "
      "the FLAGS_ragged_attention=off legacy body carries a waiver), "
      "and a ragged append's function must attend unified in-scope"),
+    ("spec-row-discipline",
+     "no per-sequence target forward outside the packed ragged step "
+     "in serving.py/paged_llama.py — speculative verify windows ride "
+     "prefill_chunk as (draft_k+1)-token rows with per-position "
+     "logits out of the epilogue (decode_window calls are banned; "
+     "the sanctioned FLAGS_spec_decode=legacy body carries a "
+     "waiver)"),
     ("serving-terminal-trace",
      "any serving.py function that moves a request to a terminal "
      "state (FINISHED/ABORTED_DEADLINE or a _finished[] write) must "
@@ -2595,6 +2675,7 @@ def run_lint(root=REPO, with_op_table=True):
     out.extend(check_pool_mutation_audit(root))
     out.extend(check_serving_buckets(root))
     out.extend(check_unified_attention(root))
+    out.extend(check_spec_rows(root))
     out.extend(check_serving_terminal_trace(root))
     out.extend(check_flag_inventory(root))
     out.extend(check_metric_names(root))
