@@ -2566,6 +2566,233 @@ def bench_disagg_serving(users=4, prompt_len=48, new_tokens=8,
     return _merge_serving_rec("disagg", rec)
 
 
+# aux: closed-loop capacity autotuner — planner-scored search +
+# live goodput hill-climb from a deliberately bad starting config
+# ---------------------------------------------------------------------------
+
+
+def bench_autotune_serving(users=8, prompt_len=96, new_tokens=8):
+    """Capacity-autotuner arm (ISSUE 20): start the chunked-prefill
+    serving workload from a deliberately BAD hand-picked config
+    (oversized chunk budget, one coarse bucket — every step, even a
+    4-token decode, pads to the top bucket), then let the closed
+    loop fix it: a planner-seeded static search prices the candidate
+    space and discards a strict-budget-infeasible point before it
+    can ever deploy, and the live hill-climb probes the surviving
+    frontier on measured goodput windows until it converges. The
+    chosen config must improve decode tokens/s or goodput by >= 15%
+    over the bad start while keeping greedy outputs identical, and
+    the reproducible TUNED_CONFIG_LAST.json artifact must round-trip
+    through load_artifact. Merges an "autotune" section into
+    BENCH_SERVING_LAST.json."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import autotuner as at
+    from paddle_tpu.framework.flags import flag
+    from paddle_tpu.inference import (
+        BatchScheduler,
+        PagedLlamaAdapter,
+        Request,
+    )
+    from paddle_tpu.inference.serving import _parse_buckets
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    kind = _device_kind()
+    cpu = kind.startswith("cpu")
+    page_size = 4
+    if cpu:
+        users, prompt_len, new_tokens = 4, 48, 4
+        cfg = llama_tiny(num_hidden_layers=2,
+                         max_position_embeddings=256)
+    else:
+        cfg = llama_tiny(
+            hidden_size=512, intermediate_size=1024,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=2048,
+        )
+        page_size = 16
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    system = rng.randint(1, cfg.vocab_size, prompt_len // 2).tolist()
+    prompts = [system + rng.randint(
+        1, cfg.vocab_size, prompt_len - len(system)).tolist()
+        for _ in range(users)]
+    pages_per_seq = -(-(prompt_len + new_tokens) // page_size)
+    num_pages = 2 * users * pages_per_seq + 16
+
+    # the deliberately bad start: chunk budget far above the prompt
+    # mix and a single coarse bucket, so every packed step (decode
+    # included) pads to 256 tokens
+    bad = at.CandidateConfig(256, (256,))
+    # a strict-budget victim: its biggest compiled program (512
+    # padded tokens) must be discarded statically, never deployed
+    monster = at.CandidateConfig(256, (512,))
+    candidates = [
+        bad,
+        monster,
+        at.CandidateConfig(16, (8, 16, 32, 64)),
+        at.CandidateConfig(32, (8, 16, 32, 64)),
+        at.CandidateConfig(64, (16, 64, 256)),
+    ]
+
+    def run():
+        """One full serve of the workload under the CURRENTLY
+        flagged capacity config (the apply seam sets the flags; the
+        scheduler ctor reads them). Returns greedy outputs plus the
+        goodput window the tuner hill-climbs on."""
+        buckets = _parse_buckets(flag("serving_buckets"))
+        adapter = PagedLlamaAdapter(
+            model, num_pages=num_pages, page_size=page_size,
+            max_length=cfg.max_position_embeddings)
+        sched = BatchScheduler(adapter, max_batch_size=users,
+                               chunked_prefill=True)
+        for i, p in enumerate(prompts):
+            sched.submit(Request(f"r{i}", list(p),
+                                 max_new_tokens=new_tokens))
+        walls = []
+        decode_wall = 0.0
+        decode_toks = 0
+        useful = padded = 0
+        while sched.num_active or sched.num_queued:
+            ts = time.perf_counter()
+            ev = sched.step()
+            dt = time.perf_counter() - ts
+            walls.append(dt)
+            toks = (ev["prefill_tokens"] or 0) + \
+                (ev["decode_tokens"] or 0)
+            if toks:
+                useful += toks
+                padded += at._bucket_pad(toks, buckets)
+            if ev["decode_tokens"] and not ev["prefill_tokens"]:
+                decode_wall += dt
+                decode_toks += ev["decode_tokens"]
+        gen = {r: sched.result(r).generated_ids
+               for r in (f"r{i}" for i in range(users))}
+        return {
+            "gen": gen,
+            "goodput": useful / max(padded, 1),
+            "step_p50_s": float(np.median(walls)),
+            "decode_tok_s": decode_toks / max(decode_wall, 1e-9),
+        }
+
+    def plan_profile():
+        """Planner-seeded cost coefficients: trace one layer's
+        unified ragged-attend program at a known packed size and
+        let WorkloadProfile.from_plan split the plan's HBM/comm
+        totals into per-token coefficients."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.framework import planner as _planner
+
+        adapter = PagedLlamaAdapter(
+            model, num_pages=num_pages, page_size=page_size,
+            max_length=cfg.max_position_embeddings)
+        c0 = adapter.caches[0]
+        seq = "__tune_probe__"
+        c0.alloc(seq)
+        kvh, hd = c0.k_pages.shape[2], c0.k_pages.shape[3]
+        c0.append(seq, jnp.zeros((kvh, hd), jnp.float32),
+                  jnp.zeros((kvh, hd), jnp.float32))
+        nh = cfg.num_attention_heads
+        qs = jax.ShapeDtypeStruct((1, 1, nh, hd), jnp.float32)
+        closed = jax.make_jaxpr(
+            lambda q: c0.attend_ragged(
+                q, [seq], [1], rows_pad=1, max_pages=4)._data)(qs)
+        plan, _ = _planner.plan_jaxpr(
+            closed, name="autotune_attend_probe")
+        c0.free(seq)
+        # packed demand: each user's prompt arrives as one wave,
+        # then per-step decode packs ~users tokens
+        packed = [prompt_len] * users + [users] * new_tokens
+        return at.WorkloadProfile.from_plan(
+            plan.to_dict(), planned_tokens=1, packed_tokens=packed,
+            wall_per_token_s=1e-4, compile_cost_s=0.05,
+            amortize_steps=64), plan.to_dict(max_buffers=4)
+
+    snapshot = {k: flag(k) for k in at.CAPACITY_KNOBS}
+    deployed = []
+
+    def apply_fn(flags_dict):
+        deployed.append(dict(flags_dict))
+        return at.apply_config(flags_dict)
+
+    try:
+        profile, plan_dict = plan_profile()
+        # strict-budget probe: a budget between the largest feasible
+        # program (256 padded tokens) and the monster's 512 — the
+        # monster must land in rejected, everything else survives
+        hbm_budget = int(profile.hbm_fixed_bytes
+                         + 300 * profile.hbm_per_token)
+        # the bad start is the seeded hand-picked config
+        at.apply_config(bad.flags())
+        run()                       # warmup: compiles outside timing
+        base = run()
+        tn = at.Autotuner(candidates=candidates, profile=profile,
+                          apply_fn=apply_fn, hbm_budget=hbm_budget,
+                          eval_windows=1, min_improve=0.05)
+        infeasible_rejected = any(
+            e["candidate"] == monster and not e["feasible"]
+            for e in tn.rejected)
+        tn.start()
+        probes = 0
+        while tn.state != "converged" and probes < 3 * len(candidates):
+            probes += 1
+            run()                   # per-candidate compile warmup
+            m = run()
+            tn.observe(at.Measurement(
+                goodput=m["goodput"], step_p50_s=m["step_p50_s"],
+                drift_ratio=0.0, decode_tok_s=m["decode_tok_s"]))
+        chosen = tn.best()["candidate"]
+        at.apply_config(chosen.flags())
+        run()
+        tuned = run()
+        infeasible_never_deployed = all(
+            d.get("serving_buckets") != "512" for d in deployed)
+        art_path = os.path.join(os.path.dirname(_SERVING_FILE),
+                                "TUNED_CONFIG_LAST.json")
+        tn.write_artifact(art_path)
+        art = at.load_artifact(art_path)
+        artifact_ok = (art["kind"] == "paddle_tpu.tuned_config"
+                       and art["flags"] == chosen.flags())
+    finally:
+        at.apply_config(snapshot)
+
+    decode_speedup = tuned["decode_tok_s"] / max(
+        base["decode_tok_s"], 1e-9)
+    goodput_ratio = tuned["goodput"] / max(base["goodput"], 1e-9)
+    rec = {
+        "config": "serving_autotune",
+        "mode": "tpu-single-chip" if not cpu else "cpu",
+        "users": users,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "page_size": page_size,
+        "start": bad.key(),
+        "chosen": chosen.key(),
+        "state": tn.state,
+        "switches": tn.switches,
+        "probes": probes,
+        "candidates": len(candidates),
+        "feasible": len(tn.frontier),
+        "greedy_identical": tuned["gen"] == base["gen"],
+        "baseline_decode_tok_s": round(base["decode_tok_s"], 1),
+        "tuned_decode_tok_s": round(tuned["decode_tok_s"], 1),
+        "decode_speedup": round(decode_speedup, 2),
+        "baseline_goodput": round(base["goodput"], 4),
+        "tuned_goodput": round(tuned["goodput"], 4),
+        "goodput_ratio": round(goodput_ratio, 2),
+        "hbm_budget": hbm_budget,
+        "infeasible_rejected": infeasible_rejected,
+        "infeasible_never_deployed": infeasible_never_deployed,
+        "artifact_path": os.path.basename(art_path),
+        "artifact_ok": artifact_ok,
+        "plan": plan_dict,
+        "plan_vs_chosen": tn.plan_vs_chosen(),
+    }
+    return _merge_serving_rec("autotune", rec)
+
+
 # aux: runtime-telemetry overhead — trace spans + metrics vs off
 # ---------------------------------------------------------------------------
 
@@ -3955,6 +4182,7 @@ def main() -> int:
         orec = _emit(bench_overload_serving())
         erec = _emit(bench_engine_serving())
         drec = _emit(bench_disagg_serving())
+        arec = _emit(bench_autotune_serving())
         # the gate covers ALL arms: the prefix-cache contract, the
         # ISSUE-3 quantized acceptance (token-identical greedy decode,
         # >= 1.8x sequence capacity at equal HBM budget), and the
@@ -4106,13 +4334,26 @@ def main() -> int:
                 for v in drec.get("role_budgets", {}).values()) and \
             len(drec.get("role_budgets", {})) == 2 and \
             bool(drec.get("role_labels_ok"))
+        # ISSUE-20 autotuner acceptance: from the deliberately bad
+        # start the chosen config improves decode tokens/s OR
+        # goodput by >= 15% with greedy outputs identical, the
+        # strict-budget infeasible candidate is discarded statically
+        # and never deployed, and the reproducible tuned-config
+        # artifact is written and round-trips
+        autotune_ok = bool(arec.get("greedy_identical")) and \
+            (arec.get("decode_speedup", 0.0) >= 1.15
+             or arec.get("goodput_ratio", 0.0) >= 1.15) and \
+            bool(arec.get("infeasible_rejected")) and \
+            bool(arec.get("infeasible_never_deployed")) and \
+            bool(arec.get("artifact_ok")) and \
+            arec.get("state") == "converged"
         ok = bool(rec.get("greedy_identical")) and \
             rec.get("prefill_skip_frac", 0.0) >= 0.5 and \
             qrec.get("greedy_match_rate", 0.0) >= 1.0 and \
             qrec.get("seq_capacity_ratio", 0.0) >= 1.8 and \
             chunk_ok and ragged_ok and spec_ok and san_ok and \
             conc_ok and tel_ok and over_ok and engine_ok and \
-            disagg_ok
+            disagg_ok and autotune_ok
         _emit({"metric": "serving_prefix_cache",
                "value": rec.get("prefill_skip_frac", 0.0),
                "unit": "prefill_skip_frac",
@@ -4226,6 +4467,17 @@ def main() -> int:
                "disagg_rr_spread": drec.get("rr_spread"),
                "disagg_role_labels_ok":
                    bool(drec.get("role_labels_ok")),
+               "autotune_chosen": arec.get("chosen"),
+               "autotune_decode_speedup":
+                   arec.get("decode_speedup"),
+               "autotune_goodput_ratio":
+                   arec.get("goodput_ratio"),
+               "autotune_greedy_identical":
+                   bool(arec.get("greedy_identical")),
+               "autotune_infeasible_rejected":
+                   bool(arec.get("infeasible_rejected")),
+               "autotune_artifact": arec.get("artifact_path"),
+               "autotune_ok": autotune_ok,
                "artifact": os.path.basename(_SERVING_FILE),
                "git_rev": _git_rev()})
         return 0

@@ -575,6 +575,41 @@ define_flag("disagg_decode_budget_comm", 0,
             "disagg.apply_role_budgets('decode'): the decode role's "
             "per-device collective-traffic budget in bytes; 0 "
             "leaves the global budget untouched")
+define_flag("autotune", "off",
+            "capacity-autotuner mode (framework/autotuner.py): "
+            "'off' (hand-picked knobs, the default), 'static' "
+            "(planner-scored search only — the best statically "
+            "feasible candidate is chosen, nothing is measured "
+            "live), 'live' (deploy the static frontier and "
+            "hill-climb on the live goodput window with hysteresis "
+            "and watchdog quarantine)")
+define_flag("autotune_space", "",
+            "capacity-autotuner search-space override, a "
+            "';'-separated list of knob=alt|alt clauses — e.g. "
+            "'chunk=16|32|64;buckets=8,16,32|8,16,32,64,128;"
+            "swap=0|268435456;dtype=off|int8;band=0.75:0.9' — "
+            "knobs omitted from the spec keep their built-in "
+            "alternatives (autotuner.DEFAULT_SPACE); empty uses "
+            "the built-in space for every knob")
+define_flag("autotune_eval_windows", 3,
+            "live goodput windows the capacity autotuner averages "
+            "per candidate before scoring it (one window = one "
+            "Autotuner.observe() with signal): the hysteresis "
+            "half-width — a single noisy window can never adopt or "
+            "reject a candidate because the decision waits for the "
+            "median of this many")
+define_flag("autotune_min_improve", 0.05,
+            "relative live-score improvement a challenger "
+            "candidate must sustain over the incumbent before the "
+            "capacity autotuner adopts it (0.05 = 5% better on the "
+            "goodput-window score); challengers inside the dead "
+            "band are reverted, so config churn needs a real win")
+define_flag("autotune_artifact", "",
+            "path the capacity autotuner writes its reproducible "
+            "tuned-config JSON artifact to "
+            "(TUNED_CONFIG_LAST.json-style: chosen config, the "
+            "scored candidate table, quarantine list, and the "
+            "flags dict to re-apply it); empty disables the write")
 if os.environ.get("FLAGS_flash_pallas_interpret"):
     # pre-rename env alias (was flash-only before covering all kernels)
     _REGISTRY["pallas_interpret"] = True

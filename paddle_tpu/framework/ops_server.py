@@ -76,6 +76,7 @@ _INDEX = (
     ("/incidentz", "incident bundles; ?bundle=<name> to replay one"),
     ("/enginez", "async serving engines: pump, streams, backpressure"),
     ("/routerz", "disagg session routers: policy, replicas, sessions"),
+    ("/tunez", "capacity autotuner: candidate table, scores, winner"),
 )
 
 
@@ -103,6 +104,7 @@ class OpsServer:
         self._providers: Dict[str, Callable[[], Optional[dict]]] = {}
         self._eproviders: Dict[str, Callable[[], Optional[dict]]] = {}
         self._rproviders: Dict[str, Callable[[], Optional[dict]]] = {}
+        self._tproviders: Dict[str, Callable[[], Optional[dict]]] = {}
         self._plock = _concurrency.guarded("ops_server.providers")
         _csan = _concurrency.sanitizer()
         self._cv = None if _csan is None else _csan.shared(
@@ -169,6 +171,15 @@ class OpsServer:
         off the page instead of being pinned alive by it."""
         self._add_provider(self._rproviders, key, fn)
 
+    def add_tuner_provider(self, key: str,
+                           fn: Callable[[], Optional[dict]]) -> None:
+        """Register a ``/tunez`` section (one per capacity
+        Autotuner; also feeds the /planz plan-vs-chosen column):
+        same contract and weakref semantics as
+        ``add_status_provider`` — a garbage-collected tuner drops
+        off the page instead of being pinned alive by it."""
+        self._add_provider(self._tproviders, key, fn)
+
     def _add_provider(self, store, key, fn) -> None:
         try:
             wm = weakref.WeakMethod(fn)
@@ -191,6 +202,9 @@ class OpsServer:
 
     def _router_sections(self) -> Dict[str, dict]:
         return self._sections(self._rproviders)
+
+    def _tuner_sections(self) -> Dict[str, dict]:
+        return self._sections(self._tproviders)
 
     def _sections(self, store) -> Dict[str, dict]:
         out = {}
@@ -254,6 +268,7 @@ class OpsServer:
             "/incidentz": self._page_incidentz,
             "/enginez": self._page_enginez,
             "/routerz": self._page_routerz,
+            "/tunez": self._page_tunez,
         }.get(parsed.path)
         if route is None:
             self._send(h, 404, "text/plain",
@@ -384,6 +399,62 @@ class OpsServer:
                                     default=str, sort_keys=True))
         return 200, "text/plain", "\n".join(lines) + "\n"
 
+    def _page_tunez(self, q):
+        reg = self._reg()
+        lines = ["paddle-tpu tunez", ""]
+        if reg is not None:
+            at = reg.snapshot().get("autotune", {}) or {}
+            keys = ("state", "frontier", "best_score", "applies",
+                    "windows", "quarantines")
+            if any(k in at for k in keys):
+                lines.append("autotune metrics")
+                for k in keys:
+                    if k in at:
+                        lines.append("  %-24s %s" % (k, at[k]))
+        sections = self._tuner_sections()
+        if not sections:
+            lines.append("")
+            lines.append("(no live capacity autotuner registered)")
+        for key in sorted(sections):
+            info = sections[key]
+            lines.append("")
+            lines.append("%s  state=%s  switches=%s  quarantined=%s"
+                         % (key, info.get("state"),
+                            info.get("switches"),
+                            info.get("quarantined")))
+            rows = info.get("candidates") or []
+            if rows:
+                lines.append(
+                    "  %-44s %12s %12s %4s %s"
+                    % ("candidate", "static", "live", "win",
+                       "status"))
+                for r in rows:
+                    live = r.get("live_score")
+                    status = "quarantined:%s" % r.get(
+                        "quarantine_reason") if r.get("quarantined") \
+                        else ("infeasible:%s" % r.get(
+                            "why_infeasible")
+                            if not r.get("feasible") else "ok")
+                    lines.append(
+                        "  %-44s %12.4g %12s %4s %s"
+                        % (str(r.get("key")),
+                           r.get("static_score", float("nan")),
+                           ("%.4g" % live) if live is not None
+                           else "-",
+                           "*" if r.get("winner") else "",
+                           status))
+            pvc = info.get("plan_vs_chosen") or []
+            if pvc:
+                lines.append("  plan-vs-chosen")
+                for row in pvc:
+                    lines.append(
+                        "    %-24s %-22s -> %-22s%s"
+                        % (row.get("knob"), row.get("plan"),
+                           row.get("chosen"),
+                           "  (changed)" if row.get("changed")
+                           else ""))
+        return 200, "text/plain", "\n".join(lines) + "\n"
+
     def _page_tracez(self, q):
         tr = self._trc()
         if q.get("format") in ("chrome", "perfetto"):
@@ -440,6 +511,24 @@ class OpsServer:
                    p.get("hbm_peak_bytes", 0),
                    p.get("comm_bytes_total", 0),
                    p.get("comm_bytes_quantized", 0)))
+        # plan-vs-chosen: what the capacity autotuner picked against
+        # the hand-seeded flags (full table on /tunez)
+        tuners = self._tuner_sections()
+        for key in sorted(tuners):
+            pvc = tuners[key].get("plan_vs_chosen") or []
+            if not pvc:
+                continue
+            lines.append("")
+            lines.append("capacity autotuner plan-vs-chosen (%s)"
+                         % key)
+            lines.append("  %-24s %-22s %-22s" % ("knob", "plan",
+                                                  "chosen"))
+            for row in pvc:
+                lines.append(
+                    "  %-24s %-22s %-22s%s"
+                    % (row.get("knob"), row.get("plan"),
+                       row.get("chosen"),
+                       "  (changed)" if row.get("changed") else ""))
         return 200, "text/plain", "\n".join(lines) + "\n"
 
     @staticmethod

@@ -404,7 +404,14 @@ def rows_from_snapshot(snapshot: dict) -> Dict[str, dict]:
     for key, v in exec_ns.items():
         if key.startswith("count."):
             prog = key[len("count."):]
-            rows.setdefault(prog, {"program": prog})["count"] = int(v)
+            try:
+                rows.setdefault(prog, {"program": prog})["count"] = \
+                    int(v or 0)
+            except (TypeError, ValueError):
+                # a malformed/partial fleet snapshot degrades to "no
+                # signal" for this field, never a crash — the
+                # autotuner hill-climbs on these rows
+                rows.setdefault(prog, {"program": prog})["count"] = 0
     for key, v in (snapshot.get("ledger", {}) or {}).items():
         field, _, prog = key.partition(".")
         if not prog or field == "programs":
@@ -418,10 +425,15 @@ def rows_from_snapshot(snapshot: dict) -> Dict[str, dict]:
             row["drifting"] = bool(row["drifting"])
         elif "drift_ratio" in row:
             # older snapshots without the verdict gauge: fall back
-            # to the local threshold
-            row["drifting"] = (
-                float(row["drift_ratio"])
-                >= float(flag("telemetry_drift_ratio")))
+            # to the local threshold; a None/garbage gauge from a
+            # partial merge is "no signal", not a crash
+            try:
+                row["drifting"] = (
+                    float(row["drift_ratio"])
+                    >= float(flag("telemetry_drift_ratio")))
+            except (TypeError, ValueError):
+                row["drift_ratio"] = None
+                row["drifting"] = False
     return rows
 
 

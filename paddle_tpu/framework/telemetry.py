@@ -1617,6 +1617,28 @@ SURFACE: Tuple[Tuple[str, str, str], ...] = (
      "handed-off requests adopted from prefill workers "
      "(ServingEngine.adopt; registered swapped-out, restored on "
      "the next step's swap-in path)"),
+    # capacity autotuner (framework/autotuner.py)
+    ("autotune.state", "gauge",
+     "capacity-autotuner controller state: 0 seeded (static table "
+     "built), 1 measuring (frontier head deployed), 2 probing "
+     "(challenger under live evaluation), 3 converged"),
+    ("autotune.frontier", "gauge",
+     "statically feasible, non-quarantined candidates remaining on "
+     "the autotuner's frontier"),
+    ("autotune.best_score", "gauge",
+     "score of the current winner (live median when measured, else "
+     "its planner-seeded static score; lower is better)"),
+    ("autotune.applies", "counter",
+     "capacity configs applied through the autotuner.apply_config "
+     "seam (flag writes + step-boundary scheduler applies)"),
+    ("autotune.windows", "counter",
+     "live goodput windows with signal consumed by "
+     "Autotuner.observe (no-signal windows are skipped, not "
+     "counted)"),
+    ("autotune.quarantines", "counter",
+     "candidates quarantined on watchdog trips (recompile-storm / "
+     "plan-drift are hard negative signal) or via the /tunez "
+     "escape hatch"),
     # disaggregated serving (inference/disagg.py + the page-chain
     # wire transfer in incubate/nn/paged_cache.py)
     ("serving.handoff_out_requests", "counter",

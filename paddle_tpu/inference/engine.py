@@ -358,6 +358,20 @@ class ServingEngine:
         self._post(("cancel", req_id, None, fut))
         return await fut
 
+    async def apply_config(self, config):
+        """Apply a capacity config (framework/autotuner.py knobs) at
+        the next step boundary: the dict is marshalled onto the pump
+        thread and applied between ``scheduler.step()`` calls through
+        ``autotuner.apply_config`` — the one sanctioned seam — so
+        the single-writer contract and the scheduler's
+        boundary-only rule both hold by construction. Engine-owned
+        knobs (the goodput band) retarget the live gate thresholds
+        too. Returns the dict of knobs actually applied."""
+        self._require_running()
+        fut = self._loop.create_future()
+        self._post(("tune", dict(config), None, fut))
+        return await fut
+
     async def drain(self):
         """Stop admitting, then wait until every inflight stream has
         retired."""
@@ -519,6 +533,8 @@ class ServingEngine:
                 self._pump_adopt(arg[0], arg[1], stream, fut)
             elif kind == "cancel":
                 self._pump_cancel(arg, fut)
+            elif kind == "tune":
+                self._pump_tune(arg, fut)
             elif kind == "drain":
                 self._note_write()
                 self._draining = True
@@ -639,6 +655,27 @@ class ServingEngine:
                 self._metrics.inc("engine.cancelled")
         self._pump_retire()
         self._resolve(fut, result=ok)
+
+    def _pump_tune(self, cfg, fut):
+        # runs between step()s on the pump thread: the autotuner
+        # seam mutates the flags + scheduler knobs, then the
+        # engine-owned goodput band retargets the live gate
+        self._note_write()
+        try:
+            from ..framework import autotuner as _autotuner
+
+            applied = _autotuner.apply_config(
+                cfg, scheduler=self.scheduler)
+            if "engine_goodput_low" in cfg:
+                self._gp_low = float(cfg["engine_goodput_low"])
+                applied["engine_goodput_low"] = self._gp_low
+            if "engine_goodput_high" in cfg:
+                self._gp_high = float(cfg["engine_goodput_high"])
+                applied["engine_goodput_high"] = self._gp_high
+        except Exception as e:
+            self._resolve(fut, exc=e)
+            return
+        self._resolve(fut, result=applied)
 
     def _pump_retire(self):
         self._flush_tokens()

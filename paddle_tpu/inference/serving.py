@@ -337,6 +337,10 @@ class BatchScheduler:
         self.serving_buckets = _parse_buckets(
             serving_buckets if serving_buckets is not None
             else flag("serving_buckets"))
+        # capacity apply seam (framework/autotuner.py): knob changes
+        # land only BETWEEN steps — apply_capacity_config refuses to
+        # run while this is True
+        self._in_step = False
         # speculative prompt phase rides chunked prefill only when the
         # DRAFT adapter can mirror the chunks too
         self._spec_chunked = self.chunked_prefill and (
@@ -1849,6 +1853,46 @@ class BatchScheduler:
             self.draft.free(rid)
 
     # -- the step ----------------------------------------------------------
+    def apply_capacity_config(self, config: dict) -> dict:
+        """Step-boundary capacity apply seam (the scheduler half of
+        ``framework.autotuner.apply_config``): retarget the
+        scheduler-owned capacity knobs — chunk budget, bucket ladder,
+        host swap budget — on a LIVE scheduler. Must run on the
+        thread that drives :meth:`step` (single-writer contract; the
+        async engine marshals it onto the pump thread) and only
+        between steps: calling mid-step raises, because a chunk
+        budget that changes under ``_step_impl`` would desynchronize
+        the packed feed already being built. Unknown keys are
+        ignored; returns the dict of knobs actually changed."""
+        if self._in_step:
+            raise RuntimeError(
+                "apply_capacity_config called mid-step — capacity "
+                "knobs may only change at step boundaries (post it "
+                "through ServingEngine.apply_config, or call "
+                "between step()s)")
+        applied = {}
+        if "prefill_chunk_tokens" in config:
+            v = max(1, int(config["prefill_chunk_tokens"]))
+            if v != self.prefill_chunk_tokens:
+                self.prefill_chunk_tokens = v
+                applied["prefill_chunk_tokens"] = v
+        if "serving_buckets" in config:
+            bl = _parse_buckets(config["serving_buckets"])
+            if bl != self.serving_buckets:
+                self.serving_buckets = bl
+                applied["serving_buckets"] = ",".join(
+                    str(b) for b in bl)
+        if "serving_swap_bytes" in config \
+                and self.swap_space is not None:
+            # never shrink below what is already resident: swapped
+            # chains stay valid, the tier just stops admitting more
+            v = max(int(config["serving_swap_bytes"]),
+                    self.swap_space.used_bytes)
+            if v != self.swap_space.capacity_bytes:
+                self.swap_space.capacity_bytes = v
+                applied["serving_swap_bytes"] = v
+        return applied
+
     def step(self) -> dict:
         """One scheduler iteration: admit, advance the active set,
         retire completions. Returns event counters
@@ -1877,8 +1921,12 @@ class BatchScheduler:
             # dumped events correlate to steps instead of all
             # stamping 0
             self._step_epoch += 1
-        with self._span("serving.step"):
-            ev = self._step_impl()
+        self._in_step = True
+        try:
+            with self._span("serving.step"):
+                ev = self._step_impl()
+        finally:
+            self._in_step = False
         if self._step_extras:
             # per-step overload/fault annotations (preempted /
             # resumed / aborted counts, the active fault kind) ride

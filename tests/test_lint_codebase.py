@@ -1780,3 +1780,99 @@ class TestRoleDiscipline:
     def test_rule_inventory_has_role_discipline(self):
         assert any(rid == "disagg-role-discipline"
                    for rid, _ in lint_codebase.RULES)
+
+
+class TestKnobDiscipline:
+    """Capacity knob-discipline rule (ISSUE 20): the serving-layer
+    modules must not mutate the capacity flags (set_flags) or poke
+    the scheduler's capacity attrs outside the autotuner apply seam
+    (framework/autotuner.py apply_config ->
+    BatchScheduler.apply_capacity_config -> engine _pump_tune)."""
+
+    def test_seeded_capacity_set_flags_flagged(self):
+        bad = (
+            "from paddle_tpu.framework.flags import set_flags\n"
+            "def tighten(sched):\n"
+            "    set_flags({'prefill_chunk_tokens': 16,\n"
+            "               'serving_buckets': '8,16'})\n"
+            "    set_flags({'telemetry': 'off'})\n"
+        )
+        v = lint_codebase.lint_knob_discipline_file(
+            "fake/serving.py", text=bad)
+        assert len(v) == 1, v
+        assert "prefill_chunk_tokens" in v[0]
+        assert "serving_buckets" in v[0]
+        assert "apply seam" in v[0]
+
+    def test_seeded_capacity_attr_poke_flagged(self):
+        bad = (
+            "def shrink(sched):\n"
+            "    sched.prefill_chunk_tokens = 8\n"
+            "def grow(s):\n"
+            "    s.serving_buckets = (8, 16)\n"
+        )
+        v = lint_codebase.lint_knob_discipline_file(
+            "fake/engine.py", text=bad)
+        assert len(v) == 2, v
+        assert ".prefill_chunk_tokens" in v[0]
+        assert ".serving_buckets" in v[1]
+
+    def test_seam_functions_allowed(self):
+        ok = (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self.prefill_chunk_tokens = 64\n"
+            "        self.serving_buckets = (8, 16)\n"
+            "    def apply_capacity_config(self, cfg):\n"
+            "        self.prefill_chunk_tokens = \\\n"
+            "            cfg['prefill_chunk_tokens']\n"
+            "        self.serving_buckets = cfg['serving_buckets']\n"
+            "class E:\n"
+            "    def _pump_tune(self, cfg, fut):\n"
+            "        self.scheduler.prefill_chunk_tokens = 1\n"
+        )
+        assert lint_codebase.lint_knob_discipline_file(
+            "fake/serving.py", text=ok) == []
+
+    def test_non_capacity_flags_and_attrs_clean(self):
+        ok = (
+            "from paddle_tpu.framework.flags import set_flags\n"
+            "def f(x):\n"
+            "    set_flags({'telemetry': 'metrics'})\n"
+            "    x.max_batch_size = 4\n"
+        )
+        assert lint_codebase.lint_knob_discipline_file(
+            "fake/serving.py", text=ok) == []
+
+    def test_waiver_suppresses(self):
+        ok = (
+            "from paddle_tpu.framework.flags import set_flags\n"
+            "def probe(sched):\n"
+            "    set_flags({'collective_dtype': 'int8'})  "
+            "# trace-lint: ok(loopback probe)\n"
+            "    sched.serving_buckets = (8,)  "
+            "# trace-lint: ok(loopback probe)\n"
+        )
+        assert lint_codebase.lint_knob_discipline_file(
+            "fake/serving.py", text=ok) == []
+
+    def test_capacity_flag_set_matches_autotuner(self):
+        from paddle_tpu.framework import autotuner
+
+        assert set(autotuner.CAPACITY_KNOBS) \
+            == set(lint_codebase._CAPACITY_FLAGS)
+
+    def test_serving_layers_covered_and_clean(self):
+        for rel in (
+                os.path.join("paddle_tpu", "inference",
+                             "serving.py"),
+                os.path.join("paddle_tpu", "inference", "engine.py"),
+                os.path.join("paddle_tpu", "framework",
+                             "ops_server.py")):
+            assert rel in lint_codebase.KNOB_DISCIPLINE_FILES
+        assert os.path.join("paddle_tpu", "framework",
+                            "autotuner.py") \
+            in lint_codebase.HOST_ONLY_FILES
+        assert lint_codebase.check_knob_discipline() == []
+        assert ("knob-discipline",
+                ) in tuple((r[0],) for r in lint_codebase.RULES)

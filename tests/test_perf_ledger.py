@@ -735,3 +735,97 @@ class TestCLI:
         out = capsys.readouterr().out
         assert "ledger: top programs" in out
         assert "drift" in out
+
+
+class TestRowsFromPartialSnapshots:
+    """Satellite (ISSUE 20): the capacity autotuner hill-climbs on
+    rows_from_snapshot over dumped/merged FLEET snapshots, so a
+    partial or malformed snapshot — missing plan keys, zero-wall
+    programs, None/garbage gauges from a lossy merge — must degrade
+    to 'no signal' rows, never crash."""
+
+    def test_missing_plan_keys_degrade_to_no_signal(self):
+        # wall histograms only: no ledger namespace was ever
+        # published (e.g. a worker dumped before the first
+        # publish()), so plan-derived fields are simply absent
+        snap = {"exec": {"wall_s.attend": {"count": 3, "sum": 0.3,
+                                           "p50": 0.1, "p99": 0.1},
+                         "count.attend": 3}}
+        rows = perf_ledger.rows_from_snapshot(snap)
+        assert rows["attend"]["count"] == 3
+        assert "mfu" not in rows["attend"]
+        assert "drifting" not in rows["attend"]
+        table = perf_ledger.format_rows(rows)
+        assert "attend" in table and "-" in table
+
+    def test_zero_wall_programs_do_not_crash(self):
+        snap = {"exec": {"wall_s.idle": {"count": 0, "sum": 0,
+                                         "p50": None, "p99": None},
+                         "count.idle": 0},
+                "ledger": {"share_of_step_wall.idle": 0.0}}
+        rows = perf_ledger.rows_from_snapshot(snap)
+        assert rows["idle"]["count"] == 0
+        assert rows["idle"]["total_wall_s"] == 0.0
+        assert rows["idle"]["p50_wall_s"] is None
+        assert "idle" in perf_ledger.format_rows(rows)
+
+    def test_none_and_garbage_leaves_degrade_not_crash(self):
+        snap = {"exec": {"wall_s.p": {"count": None, "sum": None,
+                                      "p50": None, "p99": None},
+                         "count.p": None,
+                         "count.q": "garbage"},
+                "ledger": {"drift_ratio.p": None,
+                           "drift_ratio.q": "bogus",
+                           "mfu.p": None,
+                           "programs": 2.0}}
+        rows = perf_ledger.rows_from_snapshot(snap)
+        assert rows["p"]["count"] == 0
+        assert rows["p"]["drifting"] is False
+        assert rows["p"]["drift_ratio"] is None
+        assert rows["q"]["drifting"] is False
+        assert "programs" not in rows
+        perf_ledger.format_rows(rows)   # renders, no crash
+
+    def test_empty_and_none_namespaces(self):
+        assert perf_ledger.rows_from_snapshot({}) == {}
+        assert perf_ledger.rows_from_snapshot(
+            {"exec": None, "ledger": None}) == {}
+
+    def test_merged_fleet_snapshot_with_partial_worker(
+            self, tel_metrics):
+        # worker A published ledger gauges; worker B died before its
+        # first publish (exec stamps only, no ledger namespace) —
+        # the merged rows must still build, with B-only programs
+        # carrying no plan signal
+        led = perf_ledger.PerfLedger(tel_metrics, peak_flops=1e10,
+                                     peak_hbm_gbs=1.0)
+        led.register_plan("p", dict(_PLAN))
+        for _ in range(4):
+            led.record("p", 0.5)
+        led.publish()
+        snap_a = tel_metrics.snapshot()
+        snap_b = {"exec": {"wall_s.q": {"count": 2, "sum": 0.2,
+                                        "min": 0.1, "max": 0.1,
+                                        "p50": 0.1, "p99": 0.1,
+                                        "buckets": {}},
+                           "count.q": 2}}
+        merged = telemetry.merge_snapshots(
+            {"a": snap_a, "b": snap_b})
+        rows = perf_ledger.rows_from_snapshot(merged)
+        assert rows["p"]["count"] == 4
+        assert rows["q"]["count"] == 2
+        assert "mfu" not in rows["q"]
+        table = perf_ledger.format_rows(rows)
+        assert "p" in table and "q" in table
+
+    def test_autotuner_measure_over_partial_rows(self, tel_metrics):
+        # the consumer contract end-to-end: a snapshot with no
+        # serving/goodput signal yields a no-signal Measurement the
+        # tuner skips (never a crash, never a counted window)
+        from paddle_tpu.framework import autotuner as at
+
+        snap = {"exec": {"wall_s.p": {"count": 0, "sum": 0}},
+                "ledger": {"drift_ratio.p": None}}
+        m = at.measure_from_snapshot(snap)
+        assert not m.has_signal()
+        assert at.live_score(m) is None

@@ -73,6 +73,7 @@ NAMESPACES = [
     "paddle_tpu.framework.perf_ledger",
     "paddle_tpu.framework.flight_recorder",
     "paddle_tpu.framework.ops_server",
+    "paddle_tpu.framework.autotuner",
     "paddle_tpu.profiler",
     "paddle_tpu.models",
     "paddle_tpu.models.convert",

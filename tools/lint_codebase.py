@@ -147,6 +147,10 @@ HOST_ONLY_FILES = (
     # schedulers — a jax import here would put device compute on the
     # session-routing path
     os.path.join("paddle_tpu", "inference", "disagg.py"),
+    # the capacity autotuner scores duck-typed plan dicts and fleet
+    # snapshots shipped from other hosts — it must stay importable
+    # (and runnable) with no accelerator runtime at all
+    os.path.join("paddle_tpu", "framework", "autotuner.py"),
 )
 
 _HOST_ONLY_BANNED_MODULES = ("jax", "jax.numpy")
@@ -2536,6 +2540,145 @@ def check_role_discipline(root=REPO):
     return out
 
 
+# capacity knob discipline: the serving-layer modules must never
+# mutate the capacity flags (or poke the scheduler's capacity attrs)
+# directly — every change funnels through the autotuner apply seam
+# (framework/autotuner.py apply_config -> scheduler
+# apply_capacity_config -> engine _pump_tune), which is the only
+# path that guarantees step-boundary application, flag/attr
+# coherence, and the knob-discipline audit trail
+# (autotune.applies). A mid-step set_flags("prefill_chunk_tokens")
+# would desynchronize the packed feed being built; an ad-hoc
+# `sched.serving_buckets = ...` skips the bucket re-parse and the
+# boundary guard.
+KNOB_DISCIPLINE_FILES = (
+    os.path.join("paddle_tpu", "inference", "serving.py"),
+    os.path.join("paddle_tpu", "inference", "engine.py"),
+    os.path.join("paddle_tpu", "inference", "disagg.py"),
+    os.path.join("paddle_tpu", "inference", "paged_llama.py"),
+    os.path.join("paddle_tpu", "inference", "prefix_cache.py"),
+    os.path.join("paddle_tpu", "framework", "ops_server.py"),
+)
+
+# the tuner-owned capacity flags (autotuner.CAPACITY_KNOBS — kept as
+# literals here so the linter never imports the package under lint)
+_CAPACITY_FLAGS = frozenset({
+    "prefill_chunk_tokens", "serving_buckets", "serving_swap_bytes",
+    "collective_dtype", "engine_goodput_low", "engine_goodput_high",
+})
+# scheduler-instance capacity attrs: stores allowed only in the
+# sanctioned seam functions below (construction reads the flags;
+# apply_capacity_config is the boundary-guarded mutator; the engine
+# pump op marshals onto it)
+_CAPACITY_ATTRS = frozenset({
+    "prefill_chunk_tokens", "serving_buckets",
+})
+_KNOB_SEAM_FUNCS = frozenset({
+    "__init__", "apply_capacity_config", "_pump_tune",
+})
+
+
+class _KnobDisciplineVisitor(ast.NodeVisitor):
+    """Flags capacity-flag set_flags() calls and capacity-attr
+    stores outside the autotuner apply seam."""
+
+    def __init__(self, relpath, source_lines):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.violations = []
+        self._func_stack = []
+
+    def _waived(self, lineno):
+        line = self.lines[lineno - 1] \
+            if lineno - 1 < len(self.lines) else ""
+        return _WAIVER_MARK in line
+
+    def _push(self, node):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _push
+    visit_AsyncFunctionDef = _push
+
+    def visit_Call(self, node):
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) \
+            else (fn.id if isinstance(fn, ast.Name) else None)
+        if name == "set_flags" and node.args:
+            d = node.args[0]
+            keys = set()
+            if isinstance(d, ast.Dict):
+                keys = {k.value for k in d.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+            bad = sorted(keys & _CAPACITY_FLAGS)
+            if bad and not self._waived(node.lineno):
+                self.violations.append(
+                    "%s:%d: set_flags(%s) mutates capacity knob(s) "
+                    "outside the autotuner apply seam — route "
+                    "through framework.autotuner.apply_config (or "
+                    "ServingEngine.apply_config for a live engine) "
+                    "so the change lands at a step boundary, or "
+                    "waive with '%s(<reason>)'"
+                    % (self.relpath, node.lineno, ", ".join(bad),
+                       _WAIVER_MARK))
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            self._check_store(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_store(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def _check_store(self, tgt, lineno):
+        if not isinstance(tgt, ast.Attribute):
+            return
+        if tgt.attr not in _CAPACITY_ATTRS:
+            return
+        if self._func_stack \
+                and self._func_stack[-1] in _KNOB_SEAM_FUNCS:
+            return
+        if self._waived(lineno):
+            return
+        self.violations.append(
+            "%s:%d: direct store to .%s outside the capacity apply "
+            "seam (%s) — an ad-hoc capacity poke skips the "
+            "step-boundary guard and the bucket re-parse; call "
+            "scheduler.apply_capacity_config (via "
+            "framework.autotuner.apply_config) instead, or waive "
+            "with '%s(<reason>)'"
+            % (self.relpath, lineno, tgt.attr,
+               "/".join(sorted(_KNOB_SEAM_FUNCS)), _WAIVER_MARK))
+
+
+def lint_knob_discipline_file(path, text=None):
+    """Knob-discipline check for one file; returns violations."""
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return ["%s: syntax error during lint: %s" % (rel, e)]
+    v = _KnobDisciplineVisitor(rel, text.splitlines())
+    v.visit(tree)
+    return v.violations
+
+
+def check_knob_discipline(root=REPO):
+    out = []
+    for f in KNOB_DISCIPLINE_FILES:
+        path = os.path.join(root, f)
+        if os.path.exists(path):
+            out.extend(lint_knob_discipline_file(path))
+    return out
+
+
 # rule inventory: (rule id, one-line summary) for every AST check in
 # this linter — merged into `python -m paddle_tpu.framework.analysis
 # --rules` alongside the jaxpr rules and the page-sanitizer violation
@@ -2662,6 +2805,14 @@ RULES = (
      "never call the decode-only restore surface (swap_in / "
      "import_seq / adopt_swapped / adopt) — a prefill worker "
      "re-importing a chain collapses the role split"),
+    ("knob-discipline",
+     "the serving-layer modules must not mutate capacity flags "
+     "(set_flags with prefill_chunk_tokens / serving_buckets / "
+     "serving_swap_bytes / collective_dtype / engine_goodput_*) or "
+     "poke scheduler capacity attrs directly — every change routes "
+     "through the autotuner apply seam "
+     "(framework/autotuner.py apply_config -> "
+     "BatchScheduler.apply_capacity_config, step-boundary only)"),
 )
 
 
@@ -2688,6 +2839,7 @@ def run_lint(root=REPO, with_op_table=True):
     out.extend(check_thread_discipline(root))
     out.extend(check_engine_discipline(root))
     out.extend(check_role_discipline(root))
+    out.extend(check_knob_discipline(root))
     if with_op_table:
         out.extend(check_op_table())
         out.extend(check_inference_surface())
